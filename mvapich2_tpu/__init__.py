@@ -1,0 +1,23 @@
+"""mvapich2-tpu: a TPU-native MPI-3.1-style communication framework.
+
+Brand-new design with the capabilities of MVAPICH2 (see SURVEY.md at the
+repo root for the reference's structural analysis): communicators, derived
+datatypes, two-sided pt2pt with eager/rendezvous protocols, one-sided RMA,
+and a tuned collective layer — built TPU-first: collectives lower to XLA
+``psum``/``all_gather``/``all_to_all`` over ICI on a ``jax.sharding.Mesh``
+(mvapich2_tpu.ops / mvapich2_tpu.parallel), while the host runtime provides
+the MPI process model (launcher, matching engine, progress loop, shm/tcp
+channels) for rank-style programs and the OSU benchmark contract.
+
+Layer map (mirrors SURVEY.md §1, re-targeted):
+  L5  mvapich2_tpu.mpi        — user API surface
+  L4  core/ + coll/           — MPI semantics, datatypes, algorithm zoo
+  L3  pt2pt/ + transport/     — protocols, matching, progress
+  L2  transport channels      — local/tcp/shm + the ICI (XLA mesh) path
+  L1  runtime/                — KVS bootstrap, launcher, config, logging
+"""
+
+from .version import VERSION as __version__
+
+from . import core, coll, pt2pt, transport, runtime, utils  # noqa: F401
+from .runtime.universe import run_ranks, local_universe  # noqa: F401

@@ -1,0 +1,2 @@
+from . import algorithms, api, nonblocking, tuning
+from .api import IN_PLACE

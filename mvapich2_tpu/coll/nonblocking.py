@@ -1,0 +1,294 @@
+"""Nonblocking collectives via a schedule engine.
+
+Analog of the device sched (SURVEY §2.1: MPID_Sched_send/recv/reduce/
+barrier/start, /root/reference/src/mpid/common/sched/mpid_sched.c:337-856,
+progressed by MPIDU_Sched_progress from a progress hook :979).
+
+A Schedule is a list of *phases* (barrier-separated); each phase holds
+send/recv entries (issued when the phase starts) and local compute entries
+(run when the phase starts, before issuing — they prepare buffers from
+earlier phases). The engine's progress hook advances phases as their
+requests complete and completes the user-visible request at the end.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..core.datatype import from_numpy_dtype
+from ..core.op import Op
+from ..core.request import Request
+from .algorithms import _block_ranges
+
+
+class Sched:
+    def __init__(self, comm, tag: int):
+        self.comm = comm
+        self.tag = tag
+        self.phases: List[List[tuple]] = [[]]
+
+    # -- entry constructors ----------------------------------------------
+    def send(self, buf: np.ndarray, dest: int) -> None:
+        self.phases[-1].append(("send", buf, dest))
+
+    def recv(self, buf: np.ndarray, src: int) -> None:
+        self.phases[-1].append(("recv", buf, src))
+
+    def call(self, fn: Callable[[], None]) -> None:
+        """Local compute (reduce/copy) run when its phase starts."""
+        self.phases[-1].append(("call", fn))
+
+    def barrier(self) -> None:
+        """Close the current phase (MPID_Sched_barrier)."""
+        if self.phases[-1]:
+            self.phases.append([])
+
+    # -- execution --------------------------------------------------------
+    def start(self) -> Request:
+        comm = self.comm
+        engine = comm.u.engine
+        req = Request(engine, "sched-coll")
+        state = {"phase": 0, "reqs": []}
+
+        def start_phase() -> None:
+            while state["phase"] < len(self.phases):
+                entries = self.phases[state["phase"]]
+                reqs = []
+                for e in entries:
+                    if e[0] == "call":
+                        e[1]()
+                # issue recvs before sends within the phase
+                for e in entries:
+                    if e[0] == "recv":
+                        _, buf, src = e
+                        reqs.append(comm.u.protocol.irecv(
+                            buf, buf.size, from_numpy_dtype(buf.dtype), src,
+                            comm.ctx_coll, self.tag))
+                for e in entries:
+                    if e[0] == "send":
+                        _, buf, dest = e
+                        r = comm.u.protocol.isend(
+                            buf, buf.size, from_numpy_dtype(buf.dtype),
+                            comm.world_of(dest), comm.rank, comm.ctx_coll,
+                            self.tag)
+                        if not r.complete_flag:
+                            reqs.append(r)
+                state["reqs"] = [r for r in reqs if not r.complete_flag]
+                if state["reqs"]:
+                    return          # wait for this phase
+                state["phase"] += 1  # empty/instant phase: fall through
+            finish()
+
+        def finish() -> None:
+            engine.hooks.remove(hook)
+            req.complete()
+
+        def hook() -> bool:
+            if req.complete_flag:
+                return False
+            if any(not r.complete_flag for r in state["reqs"]):
+                return False
+            if state["phase"] >= len(self.phases):
+                finish()
+                return True
+            state["phase"] += 1
+            start_phase()
+            return True
+
+        engine.register_hook(hook)
+        start_phase()
+        # poke once so trivial schedules complete without an explicit wait
+        return req
+
+
+# ---------------------------------------------------------------------------
+# schedule builders (MPIR_I<coll>_MV2 analogs, ch3i_comm.c:31-61)
+# ---------------------------------------------------------------------------
+
+def ibarrier(comm) -> Request:
+    tag = comm.next_coll_tag()
+    s = Sched(comm, tag)
+    size, rank = comm.size, comm.rank
+    tok = np.zeros(1, np.uint8)
+    mask = 1
+    while mask < size:
+        rtok = np.zeros(1, np.uint8)
+        s.send(tok, (rank + mask) % size)
+        s.recv(rtok, (rank - mask) % size)
+        s.barrier()
+        mask <<= 1
+    return s.start()
+
+
+def ibcast(comm, buf, count: int, datatype, root: int) -> Request:
+    tag = comm.next_coll_tag()
+    size, rank = comm.size, comm.rank
+    s = Sched(comm, tag)
+    data = datatype.pack(buf, count) if rank == root else \
+        np.empty(datatype.size * count, dtype=np.uint8)
+    data = np.ascontiguousarray(data)
+    vrank = (rank - root) % size
+    mask = 1
+    while mask < size:
+        if vrank & mask:
+            s.recv(data, ((vrank - mask) + root) % size)
+            s.barrier()
+            break
+        mask <<= 1
+    mask >>= 1
+    while mask > 0:
+        if vrank + mask < size:
+            s.send(data, ((vrank + mask) + root) % size)
+        mask >>= 1
+    if rank != root:
+        s.barrier()
+        s.call(lambda: datatype.unpack(data, buf, count))
+    return s.start()
+
+
+def iallreduce(comm, sendbuf, recvbuf, count: int, datatype, op: Op
+               ) -> Request:
+    tag = comm.next_coll_tag()
+    size, rank = comm.size, comm.rank
+    s = Sched(comm, tag)
+    acc = datatype.to_numpy(sendbuf, count).copy()
+    if not op.commutative:
+        # order-preserving fallback (mirrors the blocking path's guard):
+        # linear pipeline fold 0->1->...->p-1, then binomial bcast back
+        if rank > 0:
+            prev = np.empty_like(acc)
+            s.recv(prev, rank - 1)
+            s.barrier()
+            s.call(lambda: acc.__setitem__(slice(None), op.fn(prev, acc)))
+            s.barrier()
+        if rank < size - 1:
+            s.send(acc, rank + 1)
+            s.barrier()
+        root = size - 1
+        vrank = (rank - root) % size
+        mask = 1
+        while mask < size:
+            if vrank & mask:
+                s.recv(acc, ((vrank - mask) + root) % size)
+                s.barrier()
+                break
+            mask <<= 1
+        mask >>= 1
+        while mask > 0:
+            if vrank + mask < size:
+                s.send(acc, ((vrank + mask) + root) % size)
+            mask >>= 1
+        s.barrier()
+        s.call(lambda: datatype.unpack(
+            np.ascontiguousarray(acc).view(np.uint8), recvbuf, count))
+        return s.start()
+    # recursive doubling (power-of-2 only; remainder folded like blocking rd)
+    pof2 = 1 << (size.bit_length() - 1)
+    rem = size - pof2
+    tmp = np.empty_like(acc)
+    newrank = rank
+    if rank < 2 * rem:
+        if rank % 2 == 0:
+            s.send(acc, rank + 1)
+            newrank = -1
+        else:
+            s.recv(tmp, rank - 1)
+            s.barrier()
+            s.call(lambda: acc.__setitem__(slice(None), op(tmp, acc)))
+            newrank = rank // 2
+    elif rem:
+        newrank = rank - rem
+    if newrank != -1:
+        mask = 1
+        while mask < pof2:
+            peer_new = newrank ^ mask
+            peer = peer_new * 2 + 1 if peer_new < rem else peer_new + rem
+            rbuf = np.empty_like(acc)
+            s.barrier()
+            # acc is sent live: the phase engine issues this send only after
+            # the previous phase's reduce ran, and won't mutate acc again
+            # until this phase's requests (incl. the send) complete.
+            s.send(acc, peer)
+            s.recv(rbuf, peer)
+            s.barrier()
+            s.call(lambda rb=rbuf: acc.__setitem__(slice(None), op(rb, acc)))
+            mask <<= 1
+    if rank < 2 * rem:
+        s.barrier()
+        if rank % 2:
+            s.send(acc, rank - 1)
+        else:
+            s.recv(acc, rank + 1)
+    s.barrier()
+    s.call(lambda: datatype.unpack(
+        np.ascontiguousarray(acc).view(np.uint8), recvbuf, count))
+    return s.start()
+
+
+def iallgather(comm, sendbuf, recvbuf, count: int, datatype) -> Request:
+    tag = comm.next_coll_tag()
+    size, rank = comm.size, comm.rank
+    s = Sched(comm, tag)
+    nb = datatype.size * count
+    stage = np.empty(size * nb, dtype=np.uint8)
+    mine = np.ascontiguousarray(datatype.pack(sendbuf, count))
+    stage[rank * nb:(rank + 1) * nb] = mine
+    right, left = (rank + 1) % size, (rank - 1) % size
+    for step in range(size - 1):
+        sblk = (rank - step) % size
+        rblk = (rank - step - 1) % size
+        s.send(stage[sblk * nb:(sblk + 1) * nb], right)
+        s.recv(stage[rblk * nb:(rblk + 1) * nb], left)
+        s.barrier()
+    s.call(lambda: datatype.unpack(stage, recvbuf, count * size))
+    return s.start()
+
+
+def ialltoall(comm, sendbuf, recvbuf, count: int, datatype) -> Request:
+    tag = comm.next_coll_tag()
+    size, rank = comm.size, comm.rank
+    s = Sched(comm, tag)
+    nb = datatype.size * count
+    sb = np.ascontiguousarray(datatype.pack(sendbuf, count * size))
+    rb = np.empty(size * nb, dtype=np.uint8)
+    rb[rank * nb:(rank + 1) * nb] = sb[rank * nb:(rank + 1) * nb]
+    for i in range(1, size):
+        src = (rank + i) % size
+        dst = (rank - i) % size
+        s.recv(rb[src * nb:(src + 1) * nb], src)
+        s.send(sb[dst * nb:(dst + 1) * nb], dst)
+    s.barrier()
+    s.call(lambda: datatype.unpack(rb, recvbuf, count * size))
+    return s.start()
+
+
+def ireduce(comm, sendbuf, recvbuf, count: int, datatype, op: Op,
+            root: int) -> Request:
+    tag = comm.next_coll_tag()
+    size, rank = comm.size, comm.rank
+    s = Sched(comm, tag)
+    acc = datatype.to_numpy(sendbuf, count).copy()
+    vrank = (rank - root) % size
+    mask = 1
+    sent = False
+    while mask < size and not sent:
+        if vrank & mask:
+            s.barrier()
+            s.send(acc, ((vrank - mask) + root) % size)
+            sent = True
+        else:
+            peer_v = vrank + mask
+            if peer_v < size:
+                tmp = np.empty_like(acc)
+                s.recv(tmp, (peer_v + root) % size)
+                s.barrier()
+                s.call(lambda t=tmp: acc.__setitem__(slice(None),
+                                                     op(t, acc)))
+            mask <<= 1
+    if rank == root:
+        s.barrier()
+        s.call(lambda: datatype.unpack(
+            np.ascontiguousarray(acc).view(np.uint8), recvbuf, count))
+    return s.start()

@@ -1,0 +1,189 @@
+"""Collective algorithm selection — the tuning-table machinery.
+
+Analog of the MV2 tuning layer (SURVEY §2.3): the reference ships 1,377
+generated per-(arch × HCA × ppn) headers (src/mpi/coll/tuning/, 284,869 LoC)
+whose rows map {comm-size, msg-size bin} -> algorithm function pointer, with
+env overrides (MV2_INTER_ALLREDUCE_TUNING etc., allreduce_tuning.h:28-37)
+and per-comm installation in init_MV2_collops (ch3i_comm.c:27-100).
+
+TPU-first redesign: tables are data (this module + optional JSON profiles
+emitted by the autotuner in mvapich2_tpu.mpit.autotune), keyed by the arch
+key from utils.detect (tpu generation × topology). Selection order:
+  1. MV2T_<COLL>_ALGO env override,
+  2. device (XLA/ICI) path when the comm is mesh-bound and the op lowers,
+  3. two-level hierarchy when the comm spans multiple nodes,
+  4. msg-size binned host algorithm.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils.config import cvar, get_config
+from ..utils.mlog import get_logger
+from . import algorithms as alg
+
+log = get_logger("tuning")
+
+for _c in ("ALLREDUCE", "BCAST", "ALLGATHER", "ALLTOALL", "REDUCE",
+           "BARRIER", "REDUCE_SCATTER"):
+    cvar(f"{_c}_ALGO", "", str, "coll",
+         f"Force the {_c.lower()} algorithm (empty = tuned selection). "
+         f"Analog of MV2_INTER_{_c}_TUNING.")
+cvar("USE_TWO_LEVEL", True, bool, "coll",
+     "Enable hierarchical (node-aware) collectives "
+     "(analog of MV2_USE_SHMEM_COLL / two-level paths).")
+
+# ---------------------------------------------------------------------------
+# algorithm registries (name -> fn), per collective
+# ---------------------------------------------------------------------------
+
+ALGOS: Dict[str, Dict[str, Callable]] = {
+    "barrier": {
+        "dissemination": alg.barrier_dissemination,
+    },
+    "bcast": {
+        "binomial": alg.bcast_binomial,
+        "scatter_ring_allgather": alg.bcast_scatter_ring_allgather,
+    },
+    "reduce": {
+        "binomial": alg.reduce_binomial,
+        "gather_local": alg.reduce_gather_local,
+    },
+    "allreduce": {
+        "rd": alg.allreduce_recursive_doubling,
+        "rsa": alg.allreduce_reduce_scatter_allgather,
+        "ring": alg.allreduce_ring,
+        "two_level": alg.allreduce_two_level,
+        "gather_bcast": alg.allreduce_gather_bcast,
+    },
+    "allgather": {
+        "rd": alg.allgather_recursive_doubling,
+        "bruck": alg.allgather_bruck,
+        "ring": alg.allgather_ring,
+    },
+    "alltoall": {
+        "bruck": alg.alltoall_bruck,
+        "scattered": alg.alltoall_scattered,
+        "pairwise": alg.alltoall_pairwise,
+    },
+}
+
+# ---------------------------------------------------------------------------
+# default tables: rows of (msg-size upper bound, algo name); the last row's
+# bound is None (infinity). Mirrors the shape of e.g. allreduce_tuning.h:38-90
+# with {comm size ranges} x {msg bins}.
+# ---------------------------------------------------------------------------
+
+Table = List[Tuple[Optional[int], str]]
+
+DEFAULT_TABLES: Dict[str, Dict[str, Table]] = {
+    # comm-size class: "small" (<= 8), "large" (> 8)
+    "allreduce": {
+        "small": [(16 * 1024, "rd"), (None, "ring")],
+        "large": [(8 * 1024, "rd"), (512 * 1024, "rsa"), (None, "ring")],
+    },
+    "bcast": {
+        "small": [(64 * 1024, "binomial"), (None, "scatter_ring_allgather")],
+        "large": [(16 * 1024, "binomial"), (None, "scatter_ring_allgather")],
+    },
+    "allgather": {
+        "small": [(32 * 1024, "bruck"), (None, "ring")],
+        "large": [(8 * 1024, "bruck"), (None, "ring")],
+    },
+    "alltoall": {
+        "small": [(4 * 1024, "bruck"), (None, "scattered")],
+        "large": [(1024, "bruck"), (64 * 1024, "scattered"),
+                  (None, "pairwise")],
+    },
+    "reduce": {
+        "small": [(None, "binomial")],
+        "large": [(None, "binomial")],
+    },
+    "barrier": {
+        "small": [(None, "dissemination")],
+        "large": [(None, "dissemination")],
+    },
+}
+
+# runtime-measured overrides loaded from a profile (autotuner output)
+_PROFILE_TABLES: Dict[str, Dict[str, Table]] = {}
+
+
+def load_profile(tables: Dict[str, Dict[str, Table]]) -> None:
+    """Install autotuned tables (analog of regenerating tuning headers)."""
+    _PROFILE_TABLES.update(tables)
+
+
+def _size_class(comm) -> str:
+    return "small" if comm.size <= 8 else "large"
+
+
+def _lookup(name: str, comm, nbytes: int) -> str:
+    tables = _PROFILE_TABLES.get(name) or DEFAULT_TABLES.get(name)
+    if not tables:
+        raise KeyError(name)
+    rows = tables[_size_class(comm)]
+    for bound, algo in rows:
+        if bound is None or nbytes <= bound:
+            return algo
+    return rows[-1][1]
+
+
+def select_algorithm(comm, name: str, nbytes: int, op=None) -> Callable:
+    cfg = get_config()
+    # 1. env override
+    forced = cfg.get(f"{name.upper()}_ALGO", "")
+    if forced:
+        fn = ALGOS[name].get(forced)
+        if fn is None:
+            log.warn("unknown %s algorithm %r; using tuned selection",
+                     name, forced)
+        else:
+            return fn
+    # 2. op constraints: non-commutative ops need order-preserving algos
+    if op is not None and not op.commutative:
+        if name == "allreduce":
+            return alg.allreduce_gather_bcast
+        if name == "reduce":
+            return alg.reduce_gather_local
+    # 3. two-level hierarchy when the comm spans nodes (node-aware path)
+    if (name == "allreduce" and cfg["USE_TWO_LEVEL"]
+            and comm.u.num_nodes() > 1 and comm.size > 2
+            and _spans_nodes(comm) and nbytes >= 4096):
+        return alg.allreduce_two_level
+    # 4. tuned table
+    algo = _lookup(name, comm, nbytes)
+    return ALGOS[name][algo]
+
+
+def _spans_nodes(comm) -> bool:
+    nodes = {comm.u.node_ids[comm.world_of(r)] for r in range(comm.size)}
+    return len(nodes) > 1
+
+
+def install_coll_ops(comm) -> None:
+    """Per-comm collective table — init_MV2_collops analog. The comm's
+    methods dispatch through these entries, so a channel (e.g. the ICI mesh
+    channel) can overwrite individual entries with native implementations."""
+    from . import api
+    comm.coll_fns = {
+        "barrier": api.barrier,
+        "bcast": api.bcast,
+        "reduce": api.reduce,
+        "allreduce": api.allreduce,
+        "allgather": api.allgather,
+        "allgatherv": api.allgatherv,
+        "gather": api.gather,
+        "gatherv": api.gatherv,
+        "scatter": api.scatter,
+        "scatterv": api.scatterv,
+        "alltoall": api.alltoall,
+        "alltoallv": api.alltoallv,
+        "reduce_scatter_block": api.reduce_scatter_block,
+        "reduce_scatter": api.reduce_scatter,
+        "scan": api.scan,
+        "exscan": api.exscan,
+        "_select": lambda name, nbytes, op=None:
+            select_algorithm(comm, name, nbytes, op),
+    }

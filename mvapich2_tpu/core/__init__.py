@@ -1,0 +1,5 @@
+from . import attr, comm, datatype, errors, group, info, op, request, status
+from .comm import Comm
+from .group import Group
+from .request import Request, waitall, waitany, testall
+from .status import Status, ANY_SOURCE, ANY_TAG, PROC_NULL, UNDEFINED

@@ -1,0 +1,509 @@
+"""Communicators (src/mpi/comm/ + MV2 2-level extensions, SURVEY §2.1).
+
+A Comm is a Group bound to a context id pair (pt2pt ctx, coll ctx = ctx+1 —
+the reference's context-id offsetting) plus the MV2-style extras: a per-comm
+collective-ops table installed by the tuning layer (the
+``comm_ptr->coll_fns`` seam, ch3i_comm.c:27-100) and lazily-built 2-level
+sub-communicators (shmem/leader — create_2level_comm.c:57-96).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import datatype as dtmod
+from .attr import AttrCache
+from .datatype import Datatype
+from .errors import (ERRORS_ARE_FATAL, Errhandler, MPIException, MPI_ERR_COMM,
+                     MPI_ERR_RANK, MPI_ERR_TAG, mpi_assert)
+from .group import Group
+from .request import CompletedRequest, Request
+from .status import ANY_SOURCE, ANY_TAG, PROC_NULL, Status, UNDEFINED
+
+COMM_NULL = None
+
+
+def _is_in_place(buf) -> bool:
+    return type(buf).__name__ == "_InPlace"
+
+
+def _resolve(buf, count: Optional[int], datatype: Optional[Datatype],
+             alt=None) -> Tuple[int, Datatype]:
+    """Infer (count, datatype) from a numpy buffer when not given.
+    ``alt`` is the fallback buffer when ``buf`` is MPI_IN_PLACE."""
+    if _is_in_place(buf):
+        buf = alt
+    if datatype is None:
+        if isinstance(buf, np.ndarray):
+            datatype = dtmod.from_numpy_dtype(buf.dtype)
+        elif isinstance(buf, (bytes, bytearray, memoryview)):
+            datatype = dtmod.BYTE
+        elif buf is None:
+            datatype = dtmod.BYTE
+        else:
+            raise MPIException(MPI_ERR_COMM, f"cannot infer datatype "
+                               f"for {type(buf)}")
+    if count is None:
+        if isinstance(buf, np.ndarray):
+            count = buf.size
+        elif buf is None:
+            count = 0
+        else:
+            count = len(buf) // max(datatype.size, 1)
+    return count, datatype
+
+
+class Comm:
+    def __init__(self, universe, group: Group, context_id: int,
+                 name: str = "", parent: Optional["Comm"] = None):
+        self.u = universe
+        self.group = group
+        self.context_id = context_id
+        self.name = name
+        self.rank = group.rank_of_world(universe.world_rank)
+        self.size = group.size
+        self.attrs = AttrCache()
+        self.errhandler: Errhandler = ERRORS_ARE_FATAL
+        self.topo = None            # set by mvapich2_tpu.core.topo
+        self.is_inter = False
+        self.freed = False
+        self.revoked = False        # ULFM
+        self._coll_seq = 0          # collective tag sequencing
+        self.coll_fns: Dict[str, Callable] = {}
+        self._shmem_comm: Optional["Comm"] = None
+        self._leader_comm: Optional["Comm"] = None
+        self._twolevel_ready = False
+        # device-mesh binding (ICI channel): set by parallel/mesh layer when
+        # this comm maps onto a jax Mesh axis
+        self.mesh_axis = None
+
+    # ------------------------------------------------------------------
+    @property
+    def ctx_pt2pt(self) -> int:
+        return self.context_id
+
+    @property
+    def ctx_coll(self) -> int:
+        return self.context_id + 1
+
+    def world_of(self, rank: int) -> int:
+        if rank in (PROC_NULL, ANY_SOURCE):
+            return rank
+        return self.group.world_of_rank(rank)
+
+    def next_coll_tag(self) -> int:
+        self._coll_seq = (self._coll_seq + 1) % 32768
+        return self._coll_seq
+
+    def _check(self) -> None:
+        if self.freed:
+            raise MPIException(MPI_ERR_COMM, "communicator is freed")
+        if self.revoked:
+            from .errors import MPIX_ERR_REVOKED
+            raise MPIException(MPIX_ERR_REVOKED, "communicator revoked")
+
+    def _check_rank(self, r: int, allow_any: bool = False) -> None:
+        if r == PROC_NULL or (allow_any and r == ANY_SOURCE):
+            return
+        mpi_assert(0 <= r < self.size, MPI_ERR_RANK,
+                   f"rank {r} invalid for comm of size {self.size}")
+
+    # ------------------------------------------------------------------
+    # point-to-point
+    # ------------------------------------------------------------------
+    def isend(self, buf, dest: int, tag: int = 0, count: Optional[int] = None,
+              datatype: Optional[Datatype] = None,
+              mode: str = "standard") -> Request:
+        self._check()
+        self._check_rank(dest)
+        count, datatype = _resolve(buf, count, datatype)
+        return self.u.protocol.isend(buf, count, datatype,
+                                     self.world_of(dest), self.rank,
+                                     self.ctx_pt2pt, tag, mode)
+
+    def irecv(self, buf, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+              count: Optional[int] = None,
+              datatype: Optional[Datatype] = None) -> Request:
+        self._check()
+        self._check_rank(source, allow_any=True)
+        count, datatype = _resolve(buf, count, datatype)
+        return self.u.protocol.irecv(buf, count, datatype, source,
+                                     self.ctx_pt2pt, tag)
+
+    def send(self, buf, dest: int, tag: int = 0, **kw) -> None:
+        self.isend(buf, dest, tag, **kw).wait()
+
+    def ssend(self, buf, dest: int, tag: int = 0, **kw) -> None:
+        self.isend(buf, dest, tag, mode="sync", **kw).wait()
+
+    def bsend(self, buf, dest: int, tag: int = 0, **kw) -> None:
+        self.isend(buf, dest, tag, mode="buffered", **kw).wait()
+
+    def rsend(self, buf, dest: int, tag: int = 0, **kw) -> None:
+        self.isend(buf, dest, tag, mode="standard", **kw).wait()
+
+    def issend(self, buf, dest: int, tag: int = 0, **kw) -> Request:
+        return self.isend(buf, dest, tag, mode="sync", **kw)
+
+    def recv(self, buf, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+             **kw) -> Status:
+        return self.irecv(buf, source, tag, **kw).wait()
+
+    def sendrecv(self, sendbuf, dest: int, sendtag: int,
+                 recvbuf, source: int, recvtag: int,
+                 send_count: Optional[int] = None,
+                 send_datatype: Optional[Datatype] = None,
+                 recv_count: Optional[int] = None,
+                 recv_datatype: Optional[Datatype] = None) -> Status:
+        rreq = self.irecv(recvbuf, source, recvtag, recv_count, recv_datatype)
+        sreq = self.isend(sendbuf, dest, sendtag, send_count, send_datatype)
+        st = rreq.wait()
+        sreq.wait()
+        return st
+
+    def sendrecv_replace(self, buf, dest: int, sendtag: int, source: int,
+                         recvtag: int) -> Status:
+        tmp = np.array(buf, copy=True)
+        return self.sendrecv(tmp, dest, sendtag, buf, source, recvtag)
+
+    def probe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG) -> Status:
+        self._check()
+        return self.u.protocol.probe(source, self.ctx_pt2pt, tag)
+
+    def iprobe(self, source: int = ANY_SOURCE,
+               tag: int = ANY_TAG) -> Optional[Status]:
+        self._check()
+        return self.u.protocol.iprobe(source, self.ctx_pt2pt, tag)
+
+    def improbe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG):
+        self._check()
+        return self.u.protocol.improbe(source, self.ctx_pt2pt, tag)
+
+    def mrecv(self, message, buf, count: Optional[int] = None,
+              datatype: Optional[Datatype] = None) -> Status:
+        count, datatype = _resolve(buf, count, datatype)
+        return self.u.protocol.mrecv(message, buf, count, datatype).wait()
+
+    # persistent requests (MPI_Send_init / MPI_Recv_init / MPI_Start)
+    def send_init(self, buf, dest: int, tag: int = 0, **kw) -> Request:
+        req = Request(self.u.engine, "persistent-send")
+        req.persistent = True
+        inner: List[Request] = []
+
+        def starter(r):
+            i = self.isend(buf, dest, tag, **kw)
+            inner.append(i)
+            i.add_callback(lambda _: r.complete())
+
+        req._start_fn = starter
+        return req
+
+    def recv_init(self, buf, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+                  **kw) -> Request:
+        req = Request(self.u.engine, "persistent-recv")
+        req.persistent = True
+
+        def starter(r):
+            i = self.irecv(buf, source, tag, **kw)
+
+            def done(ireq):
+                r.status = ireq.status
+                r.complete(ireq.error)
+
+            i.add_callback(done)
+
+        req._start_fn = starter
+        return req
+
+    # ------------------------------------------------------------------
+    # collectives — dispatch through coll_fns (the MV2 seam)
+    # ------------------------------------------------------------------
+    def _coll(self, name: str):
+        if not self.coll_fns:
+            from ..coll.tuning import install_coll_ops
+            install_coll_ops(self)
+        return self.coll_fns[name]
+
+    def barrier(self) -> None:
+        self._check()
+        self._coll("barrier")(self)
+
+    def bcast(self, buf, root: int = 0, count: Optional[int] = None,
+              datatype: Optional[Datatype] = None):
+        self._check()
+        count, datatype = _resolve(buf, count, datatype)
+        self._coll("bcast")(self, buf, count, datatype, root)
+        return buf
+
+    def reduce(self, sendbuf, recvbuf=None, op=None, root: int = 0,
+               count: Optional[int] = None,
+               datatype: Optional[Datatype] = None):
+        self._check()
+        from . import op as opmod
+        op = op or opmod.SUM
+        count, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
+        if recvbuf is None and self.rank == root:
+            recvbuf = np.empty_like(np.asarray(sendbuf))
+        self._coll("reduce")(self, sendbuf, recvbuf, count, datatype, op, root)
+        return recvbuf
+
+    def allreduce(self, sendbuf, recvbuf=None, op=None,
+                  count: Optional[int] = None,
+                  datatype: Optional[Datatype] = None):
+        self._check()
+        from . import op as opmod
+        op = op or opmod.SUM
+        count, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
+        if recvbuf is None:
+            recvbuf = np.empty_like(np.asarray(sendbuf))
+        self._coll("allreduce")(self, sendbuf, recvbuf, count, datatype, op)
+        return recvbuf
+
+    def allgather(self, sendbuf, recvbuf=None, count: Optional[int] = None,
+                  datatype: Optional[Datatype] = None):
+        self._check()
+        count, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
+        if recvbuf is None:
+            sb = np.asarray(sendbuf)
+            recvbuf = np.empty((self.size * count,), dtype=sb.dtype)
+        self._coll("allgather")(self, sendbuf, recvbuf, count, datatype)
+        return recvbuf
+
+    def gather(self, sendbuf, recvbuf=None, root: int = 0,
+               count: Optional[int] = None,
+               datatype: Optional[Datatype] = None):
+        self._check()
+        count, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
+        if recvbuf is None and self.rank == root:
+            sb = np.asarray(sendbuf)
+            recvbuf = np.empty((self.size * count,), dtype=sb.dtype)
+        self._coll("gather")(self, sendbuf, recvbuf, count, datatype, root)
+        return recvbuf
+
+    def scatter(self, sendbuf, recvbuf, root: int = 0,
+                count: Optional[int] = None,
+                datatype: Optional[Datatype] = None):
+        self._check()
+        count, datatype = _resolve(recvbuf, count, datatype)
+        self._coll("scatter")(self, sendbuf, recvbuf, count, datatype, root)
+        return recvbuf
+
+    def alltoall(self, sendbuf, recvbuf=None, count: Optional[int] = None,
+                 datatype: Optional[Datatype] = None):
+        self._check()
+        if count is None:
+            sb = np.asarray(recvbuf if _is_in_place(sendbuf) else sendbuf)
+            count = sb.size // self.size
+        _, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
+        if recvbuf is None:
+            recvbuf = np.empty_like(np.asarray(sendbuf))
+        self._coll("alltoall")(self, sendbuf, recvbuf, count, datatype)
+        return recvbuf
+
+    def reduce_scatter_block(self, sendbuf, recvbuf=None, op=None,
+                             count: Optional[int] = None,
+                             datatype: Optional[Datatype] = None):
+        self._check()
+        from . import op as opmod
+        op = op or opmod.SUM
+        if count is None:
+            count = np.asarray(recvbuf if _is_in_place(sendbuf)
+                               else sendbuf).size // self.size
+        _, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
+        if recvbuf is None:
+            sb = np.asarray(sendbuf)
+            recvbuf = np.empty((count,), dtype=sb.dtype)
+        self._coll("reduce_scatter_block")(self, sendbuf, recvbuf, count,
+                                           datatype, op)
+        return recvbuf
+
+    def scan(self, sendbuf, recvbuf=None, op=None,
+             count: Optional[int] = None,
+             datatype: Optional[Datatype] = None):
+        self._check()
+        from . import op as opmod
+        op = op or opmod.SUM
+        count, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
+        if recvbuf is None:
+            recvbuf = np.empty_like(np.asarray(sendbuf))
+        self._coll("scan")(self, sendbuf, recvbuf, count, datatype, op)
+        return recvbuf
+
+    def exscan(self, sendbuf, recvbuf=None, op=None,
+               count: Optional[int] = None,
+               datatype: Optional[Datatype] = None):
+        self._check()
+        from . import op as opmod
+        op = op or opmod.SUM
+        count, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
+        if recvbuf is None:
+            recvbuf = np.empty_like(np.asarray(sendbuf))
+        self._coll("exscan")(self, sendbuf, recvbuf, count, datatype, op)
+        return recvbuf
+
+    def allgatherv(self, sendbuf, recvbuf, counts: Sequence[int],
+                   displs: Optional[Sequence[int]] = None,
+                   datatype: Optional[Datatype] = None):
+        self._check()
+        _, datatype = _resolve(sendbuf, None, datatype)
+        self._coll("allgatherv")(self, sendbuf, recvbuf, list(counts),
+                                 list(displs) if displs is not None else None,
+                                 datatype)
+        return recvbuf
+
+    def alltoallv(self, sendbuf, sendcounts, sdispls, recvbuf, recvcounts,
+                  rdispls, datatype: Optional[Datatype] = None):
+        self._check()
+        _, datatype = _resolve(sendbuf, None, datatype)
+        self._coll("alltoallv")(self, sendbuf, list(sendcounts), list(sdispls),
+                                recvbuf, list(recvcounts), list(rdispls),
+                                datatype)
+        return recvbuf
+
+    def gatherv(self, sendbuf, recvbuf, counts, displs=None, root: int = 0,
+                datatype: Optional[Datatype] = None):
+        self._check()
+        _, datatype = _resolve(sendbuf, None, datatype)
+        self._coll("gatherv")(self, sendbuf, recvbuf, list(counts),
+                              list(displs) if displs is not None else None,
+                              datatype, root)
+        return recvbuf
+
+    def scatterv(self, sendbuf, counts, displs, recvbuf, root: int = 0,
+                 datatype: Optional[Datatype] = None):
+        self._check()
+        _, datatype = _resolve(recvbuf, None, datatype)
+        self._coll("scatterv")(self, sendbuf,
+                               list(counts) if counts is not None else None,
+                               list(displs) if displs is not None else None,
+                               recvbuf, datatype, root)
+        return recvbuf
+
+    # nonblocking collectives
+    def ibarrier(self) -> Request:
+        from ..coll import nonblocking as nb
+        return nb.ibarrier(self)
+
+    def ibcast(self, buf, root: int = 0, count: Optional[int] = None,
+               datatype: Optional[Datatype] = None) -> Request:
+        from ..coll import nonblocking as nb
+        count, datatype = _resolve(buf, count, datatype)
+        return nb.ibcast(self, buf, count, datatype, root)
+
+    def iallreduce(self, sendbuf, recvbuf, op=None,
+                   count: Optional[int] = None,
+                   datatype: Optional[Datatype] = None) -> Request:
+        from ..coll import nonblocking as nb
+        from . import op as opmod
+        op = op or opmod.SUM
+        count, datatype = _resolve(sendbuf, count, datatype)
+        return nb.iallreduce(self, sendbuf, recvbuf, count, datatype, op)
+
+    def iallgather(self, sendbuf, recvbuf, count: Optional[int] = None,
+                   datatype: Optional[Datatype] = None) -> Request:
+        from ..coll import nonblocking as nb
+        count, datatype = _resolve(sendbuf, count, datatype)
+        return nb.iallgather(self, sendbuf, recvbuf, count, datatype)
+
+    def ialltoall(self, sendbuf, recvbuf, count: Optional[int] = None,
+                  datatype: Optional[Datatype] = None) -> Request:
+        from ..coll import nonblocking as nb
+        if count is None:
+            count = np.asarray(sendbuf).size // self.size
+        _, datatype = _resolve(sendbuf, count, datatype)
+        return nb.ialltoall(self, sendbuf, recvbuf, count, datatype)
+
+    # ------------------------------------------------------------------
+    # communicator management
+    # ------------------------------------------------------------------
+    def dup(self) -> "Comm":
+        self._check()
+        ctx = self.u.allocate_context_id(self)
+        new = Comm(self.u, self.group, ctx, self.name + "_dup", self)
+        self.attrs.copy_all(self, new.attrs)
+        new.errhandler = self.errhandler
+        new.topo = self.topo
+        return new
+
+    def create(self, group: Group) -> Optional["Comm"]:
+        """MPI_Comm_create: collective over self; returns None for
+        non-members."""
+        self._check()
+        ctx = self.u.allocate_context_id(self)
+        if group.rank_of_world(self.u.world_rank) == UNDEFINED:
+            return None
+        return Comm(self.u, group, ctx, self.name + "_create", self)
+
+    def split(self, color: int, key: int = 0) -> Optional["Comm"]:
+        self._check()
+        # allgather (color, key, world_rank) triples, then bucket
+        mine = np.array([color if color is not None else UNDEFINED, key,
+                         self.u.world_rank], dtype=np.int64)
+        allv = np.empty(3 * self.size, dtype=np.int64)
+        self.allgather(mine, allv, count=3)
+        ctx = self.u.allocate_context_id(self)
+        my_color = int(mine[0])
+        if my_color == UNDEFINED:
+            return None
+        members = []
+        for r in range(self.size):
+            c, k, wr = (int(allv[3 * r]), int(allv[3 * r + 1]),
+                        int(allv[3 * r + 2]))
+            if c == my_color:
+                members.append((k, r, wr))   # sort by key, then comm rank
+        members.sort()
+        return Comm(self.u, Group([wr for _, _, wr in members]), ctx,
+                    f"{self.name}_split", self)
+
+    def split_type_shared(self, key: int = 0) -> "Comm":
+        """MPI_Comm_split_type(COMM_TYPE_SHARED): ranks on my node."""
+        return self.split(self.u.node_ids[self.u.world_rank], key)
+
+    def compare(self, other: "Comm") -> str:
+        if self is other:
+            return "ident"
+        g = self.group.compare(other.group)
+        if g == "ident":
+            return "congruent"
+        return g
+
+    def free(self) -> None:
+        if self.freed:
+            return
+        self.attrs.delete_all(self)
+        self.freed = True
+
+    # ------------------------------------------------------------------
+    # MV2-style 2-level substructure (create_2level_comm analog)
+    # ------------------------------------------------------------------
+    def build_2level(self) -> Tuple[Optional["Comm"], Optional["Comm"]]:
+        """Returns (shmem_comm, leader_comm). shmem = ranks on my node;
+        leader = lowest rank of each node (None on non-leaders)."""
+        if self._twolevel_ready:
+            return self._shmem_comm, self._leader_comm
+        node_of_me = self.u.node_ids[self.u.world_rank]
+        shmem = self.split(node_of_me, self.rank)
+        am_leader = shmem.rank == 0
+        leader = self.split(0 if am_leader else None, self.rank)
+        self._shmem_comm = shmem
+        self._leader_comm = leader if am_leader else None
+        self._twolevel_ready = True
+        return self._shmem_comm, self._leader_comm
+
+    # -- misc -------------------------------------------------------------
+    def set_name(self, name: str) -> None:
+        self.name = name
+
+    def get_name(self) -> str:
+        return self.name
+
+    def abort(self, errorcode: int = 1) -> None:
+        import os
+        os._exit(errorcode)
+
+    def __repr__(self):
+        return (f"Comm({self.name or 'anon'}, rank={self.rank}/{self.size}, "
+                f"ctx={self.context_id})")

@@ -1,0 +1,102 @@
+"""MPI error classes and error handlers.
+
+Analog of the reference's error machinery (src/mpi/errhan/, multi-level error
+stack, SURVEY §5.5). Error *classes* follow the MPI-3.1 numbering closely
+enough for tests; instance-specific messages ride the Python exception.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+MPI_SUCCESS = 0
+MPI_ERR_BUFFER = 1
+MPI_ERR_COUNT = 2
+MPI_ERR_TYPE = 3
+MPI_ERR_TAG = 4
+MPI_ERR_COMM = 5
+MPI_ERR_RANK = 6
+MPI_ERR_REQUEST = 7
+MPI_ERR_ROOT = 8
+MPI_ERR_GROUP = 9
+MPI_ERR_OP = 10
+MPI_ERR_TOPOLOGY = 11
+MPI_ERR_DIMS = 12
+MPI_ERR_ARG = 13
+MPI_ERR_UNKNOWN = 14
+MPI_ERR_TRUNCATE = 15
+MPI_ERR_OTHER = 16
+MPI_ERR_INTERN = 17
+MPI_ERR_IN_STATUS = 18
+MPI_ERR_PENDING = 19
+MPI_ERR_KEYVAL = 20
+MPI_ERR_INFO = 28
+MPI_ERR_WIN = 45
+MPI_ERR_RMA_SYNC = 50
+MPI_ERR_FILE = 30
+MPI_ERR_IO = 32
+MPI_ERR_AMODE = 38
+MPI_ERR_NO_SUCH_FILE = 37
+# ULFM extension classes (reference: src/mpi/comm/comm_revoke.c et al.)
+MPIX_ERR_PROC_FAILED = 75
+MPIX_ERR_REVOKED = 76
+
+MPI_MAX_ERROR_STRING = 512
+
+_CLASS_NAMES = {v: k for k, v in list(globals().items())
+                if k.startswith(("MPI_ERR", "MPI_SUCCESS", "MPIX_ERR"))}
+
+
+class MPIException(Exception):
+    """Carries an MPI error class plus a human message and an error stack."""
+
+    def __init__(self, error_class: int, message: str = ""):
+        self.error_class = error_class
+        self.stack = [message] if message else []
+        super().__init__(message or _CLASS_NAMES.get(error_class, "MPI error"))
+
+    def push(self, frame: str) -> "MPIException":
+        """Multi-level error stack, analog of MPIR_Err_create_code chaining."""
+        self.stack.append(frame)
+        return self
+
+    @property
+    def message(self) -> str:
+        return " <- ".join(reversed(self.stack)) if self.stack else str(self)
+
+
+def error_class_name(klass: int) -> str:
+    return _CLASS_NAMES.get(klass, f"MPI_ERR_<{klass}>")
+
+
+def error_string(klass: int) -> str:
+    return error_class_name(klass)
+
+
+class Errhandler:
+    """MPI_Errhandler: ERRORS_ARE_FATAL, ERRORS_RETURN, or a user callback."""
+
+    def __init__(self, fn: Optional[Callable] = None, fatal: bool = False,
+                 name: str = "user"):
+        self.fn = fn
+        self.fatal = fatal
+        self.name = name
+
+    def invoke(self, obj, exc: MPIException):
+        if self.fn is not None:
+            self.fn(obj, exc.error_class)
+            return
+        if self.fatal:
+            raise exc
+        # ERRORS_RETURN: in the Python surface we still raise (the exception
+        # *is* the return code); the C shim maps it to an int.
+        raise exc
+
+
+ERRORS_ARE_FATAL = Errhandler(fatal=True, name="MPI_ERRORS_ARE_FATAL")
+ERRORS_RETURN = Errhandler(fatal=False, name="MPI_ERRORS_RETURN")
+
+
+def mpi_assert(cond: bool, klass: int, msg: str) -> None:
+    if not cond:
+        raise MPIException(klass, msg)

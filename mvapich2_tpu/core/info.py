@@ -1,0 +1,39 @@
+"""MPI_Info objects (src/mpi/info/ analog): ordered string key-value sets."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+MAX_INFO_KEY = 255
+MAX_INFO_VAL = 1024
+
+
+class Info:
+    def __init__(self, items: Optional[Dict[str, str]] = None):
+        self._d: Dict[str, str] = dict(items or {})
+
+    def set(self, key: str, value: str) -> None:
+        self._d[key] = value
+
+    def get(self, key: str) -> Optional[str]:
+        return self._d.get(key)
+
+    def delete(self, key: str) -> None:
+        self._d.pop(key, None)
+
+    @property
+    def nkeys(self) -> int:
+        return len(self._d)
+
+    def nthkey(self, n: int) -> str:
+        return list(self._d.keys())[n]
+
+    def dup(self) -> "Info":
+        return Info(self._d)
+
+    def items(self):
+        return self._d.items()
+
+
+INFO_NULL = None
+INFO_ENV = Info()

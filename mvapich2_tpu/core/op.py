@@ -1,0 +1,77 @@
+"""Reduction operations.
+
+Analog of src/mpi/coll/op*.c. Ops are numpy-vectorized on the host path and
+map 1:1 onto jax.lax collective reducers (psum/pmax/pmin) on the device path —
+``jax_name`` is the hook the ICI channel uses to pick the XLA-native lowering.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from .errors import MPIException, MPI_ERR_OP
+
+
+class Op:
+    def __init__(self, fn: Callable, name: str, commutative: bool = True,
+                 jax_name: Optional[str] = None):
+        self.fn = fn            # fn(invec, inoutvec) -> reduced ndarray
+        self.name = name
+        self.commutative = commutative
+        self.jax_name = jax_name  # "psum" | "pmax" | "pmin" | None
+        self.is_user = False
+
+    def __call__(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        """reduce(a, b) — a is the incoming vector, b the accumulator."""
+        return self.fn(a, b)
+
+    def __repr__(self):
+        return f"Op({self.name})"
+
+
+def _logical(npfn):
+    def fn(a, b):
+        return npfn(a.astype(bool), b.astype(bool)).astype(b.dtype)
+    return fn
+
+
+def _minloc(a, b):
+    out = b.copy()
+    take = (a["val"] < b["val"]) | ((a["val"] == b["val"]) &
+                                    (a["loc"] < b["loc"]))
+    out[take] = a[take]
+    return out
+
+
+def _maxloc(a, b):
+    out = b.copy()
+    take = (a["val"] > b["val"]) | ((a["val"] == b["val"]) &
+                                    (a["loc"] < b["loc"]))
+    out[take] = a[take]
+    return out
+
+
+SUM = Op(lambda a, b: a + b, "MPI_SUM", True, "psum")
+PROD = Op(lambda a, b: a * b, "MPI_PROD", True, None)
+MAX = Op(np.maximum, "MPI_MAX", True, "pmax")
+MIN = Op(np.minimum, "MPI_MIN", True, "pmin")
+LAND = Op(_logical(np.logical_and), "MPI_LAND", True)
+LOR = Op(_logical(np.logical_or), "MPI_LOR", True)
+LXOR = Op(_logical(np.logical_xor), "MPI_LXOR", True)
+BAND = Op(np.bitwise_and, "MPI_BAND", True)
+BOR = Op(np.bitwise_or, "MPI_BOR", True)
+BXOR = Op(np.bitwise_xor, "MPI_BXOR", True)
+MINLOC = Op(_minloc, "MPI_MINLOC", True)
+MAXLOC = Op(_maxloc, "MPI_MAXLOC", True)
+REPLACE = Op(lambda a, b: a, "MPI_REPLACE", False)   # RMA accumulate
+NO_OP = Op(lambda a, b: b, "MPI_NO_OP", False)       # RMA get_accumulate
+OP_NULL = None
+
+
+def create_op(fn: Callable, commute: bool = True, name: str = "user_op") -> Op:
+    """MPI_Op_create: fn(invec: ndarray, inoutvec: ndarray) -> ndarray."""
+    op = Op(fn, name, commute, None)
+    op.is_user = True
+    return op

@@ -1,0 +1,128 @@
+"""MPI-flavored top-level surface (the src/mpi/init + constants analog).
+
+Usage patterns:
+  * in-process test harness: ``run_ranks(n, fn)`` hands each rank thread its
+    COMM_WORLD (module attribute access also resolves per-thread).
+  * process mode: ``mpi.Init()`` under the mpirun launcher (env carries
+    rank/size/KVS address — the PMI handshake, SURVEY §3.1).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+from typing import Optional
+
+from .core import datatype as _dt
+from .core import op as _op
+from .core.comm import Comm
+from .core.errors import MPIException, MPI_ERR_OTHER
+from .core.status import ANY_SOURCE, ANY_TAG, PROC_NULL, UNDEFINED, Status
+from .coll.api import IN_PLACE
+from .runtime import universe as _uni
+from .utils.config import get_config
+from .version import version_string
+
+# thread support levels
+THREAD_SINGLE = 0
+THREAD_FUNNELED = 1
+THREAD_SERIALIZED = 2
+THREAD_MULTIPLE = 3
+
+_provided_level = THREAD_SERIALIZED
+
+
+def Init(required: int = THREAD_SINGLE) -> int:
+    """Initialize process-mode MPI (no-op if a universe is already bound,
+    e.g. inside the in-process harness)."""
+    u = _uni.current_universe()
+    if u is not None and u.initialized:
+        return min(required, _provided_level)
+    from .runtime.bootstrap import bootstrap_from_env
+    u = bootstrap_from_env()
+    _uni.set_universe(u, process_wide=True)
+    if get_config()["SHOW_ENV_INFO"] and u.world_rank == 0:
+        print(get_config().dump())
+    return min(required, _provided_level)
+
+
+Init_thread = Init
+
+
+def Initialized() -> bool:
+    u = _uni.current_universe()
+    return u is not None and u.initialized
+
+
+def Finalized() -> bool:
+    u = _uni.current_universe()
+    return u is not None and u.finalized
+
+
+def Finalize() -> None:
+    u = _uni.current_universe()
+    if u is None:
+        return
+    # quiesce: complete outstanding traffic before teardown
+    if u.comm_world is not None and u.world_size > 1 and not u.finalized:
+        u.comm_world.barrier()
+    u.finalize()
+
+
+def Abort(comm=None, errorcode: int = 1) -> None:
+    os._exit(errorcode)
+
+
+def _world() -> Comm:
+    u = _uni.current_universe()
+    if u is None or u.comm_world is None:
+        raise MPIException(MPI_ERR_OTHER,
+                           "MPI not initialized (no universe bound)")
+    return u.comm_world
+
+
+def _self() -> Comm:
+    u = _uni.current_universe()
+    if u is None or u.comm_self is None:
+        raise MPIException(MPI_ERR_OTHER, "MPI not initialized")
+    return u.comm_self
+
+
+def __getattr__(name: str):
+    if name == "COMM_WORLD":
+        return _world()
+    if name == "COMM_SELF":
+        return _self()
+    raise AttributeError(name)
+
+
+def Wtime() -> float:
+    return time.perf_counter()
+
+
+def Wtick() -> float:
+    return time.get_clock_info("perf_counter").resolution
+
+
+def Get_processor_name() -> str:
+    return socket.gethostname()
+
+
+def Get_version():
+    return (3, 1)
+
+
+def Get_library_version() -> str:
+    return version_string()
+
+
+# constant re-exports for MPI-ish call sites
+SUM, PROD, MAX, MIN = _op.SUM, _op.PROD, _op.MAX, _op.MIN
+LAND, LOR, LXOR = _op.LAND, _op.LOR, _op.LXOR
+BAND, BOR, BXOR = _op.BAND, _op.BOR, _op.BXOR
+MINLOC, MAXLOC = _op.MINLOC, _op.MAXLOC
+BYTE, INT, FLOAT, DOUBLE = _dt.BYTE, _dt.INT, _dt.FLOAT, _dt.DOUBLE
+LONG, CHAR = _dt.LONG, _dt.CHAR
+BFLOAT16 = _dt.BFLOAT16
+run_ranks = _uni.run_ranks

@@ -1,0 +1,94 @@
+"""Tag matching: posted & unexpected receive queues.
+
+Analog of /root/reference/src/mpid/ch3/src/ch3u_recvq.c:46-59 (SURVEY §2.1).
+One matcher per rank process; the match key is (context_id, source rank in
+comm, tag) with MPI wildcard semantics, FIFO-ordered per envelope to honor
+MPI's non-overtaking rule. Match counters are exported as MPI_T-style pvars
+(ch3u_recvq.c:95-105 instruments the same).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import List, Optional
+
+from ..core.status import ANY_SOURCE, ANY_TAG
+from ..transport.base import Packet
+
+
+class Matcher:
+    def __init__(self):
+        self.posted: collections.deque = collections.deque()     # RecvRequest
+        self.unexpected: collections.deque = collections.deque() # Packet
+        # pvars (SURVEY §5.1)
+        self.posted_hwm = 0
+        self.unexpected_hwm = 0
+        self.match_attempts = 0
+
+    # -- incoming message path -------------------------------------------
+    def match_incoming(self, pkt: Packet):
+        """Find & remove the first posted recv matching this envelope."""
+        self.match_attempts += 1
+        for req in self.posted:
+            m = req.match
+            if m[0] != pkt.ctx:
+                continue
+            if m[1] != ANY_SOURCE and m[1] != pkt.comm_src:
+                continue
+            if m[2] != ANY_TAG and m[2] != pkt.tag:
+                continue
+            self.posted.remove(req)
+            return req
+        self.unexpected.append(pkt)
+        self.unexpected_hwm = max(self.unexpected_hwm, len(self.unexpected))
+        return None
+
+    # -- posted recv path -------------------------------------------------
+    def match_posted(self, ctx: int, source: int, tag: int) -> Optional[Packet]:
+        """Find & remove the first unexpected message matching the recv."""
+        self.match_attempts += 1
+        for pkt in self.unexpected:
+            if not self._env_match(pkt, ctx, source, tag):
+                continue
+            self.unexpected.remove(pkt)
+            return pkt
+        return None
+
+    def peek_unexpected(self, ctx: int, source: int, tag: int,
+                        remove: bool = False) -> Optional[Packet]:
+        """Probe support: find (optionally remove, for Mprobe) a message."""
+        for pkt in self.unexpected:
+            if self._env_match(pkt, ctx, source, tag):
+                if remove:
+                    self.unexpected.remove(pkt)
+                return pkt
+        return None
+
+    @staticmethod
+    def _env_match(pkt: Packet, ctx: int, source: int, tag: int) -> bool:
+        if pkt.ctx != ctx:
+            return False
+        if source != ANY_SOURCE and pkt.comm_src != source:
+            return False
+        if tag != ANY_TAG and pkt.tag != tag:
+            return False
+        return True
+
+    def post(self, req) -> None:
+        self.posted.append(req)
+        self.posted_hwm = max(self.posted_hwm, len(self.posted))
+
+    def cancel_posted(self, req) -> bool:
+        """Remove a posted recv (MPI_Cancel); True if it was still queued."""
+        try:
+            self.posted.remove(req)
+            return True
+        except ValueError:
+            return False
+
+    def counts(self):
+        return {"posted": len(self.posted),
+                "unexpected": len(self.unexpected),
+                "posted_hwm": self.posted_hwm,
+                "unexpected_hwm": self.unexpected_hwm,
+                "match_attempts": self.match_attempts}

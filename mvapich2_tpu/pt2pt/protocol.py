@@ -1,0 +1,306 @@
+"""Eager / rendezvous protocol state machines.
+
+Analog of the ADI3 protocol layer (SURVEY §2.1, §3.2-3.3):
+  * eager path — MPIDI_CH3_EagerContigSend (ch3u_eager.c:208): payload rides
+    the first packet; sender completes locally.
+  * rendezvous path — MPIDI_CH3_RndvSend (ch3u_rndv.c:48) with the mrail
+    protocol set (gen2/ibv_rndv.c:45-180): RGET (receiver pulls an exposed
+    buffer — the RDMA-read analog, and the default as in ibv_param.c:116),
+    RPUT (sender pushes after CTS), R3 (packetized through the channel —
+    here RPUT with a chunk size is exactly R3).
+
+Thresholds are cvars with per-channel defaults (EAGER_THRESHOLD /
+SMP_EAGERSIZE — the ibv_param.c:776-837,2354-2361 analog).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..core import datatype as dtmod
+from ..core.datatype import Datatype, as_bytes_view
+from ..core.errors import (MPIException, MPI_ERR_TRUNCATE, MPI_ERR_INTERN,
+                           MPI_ERR_RANK, mpi_assert)
+from ..core.request import Request, CompletedRequest
+from ..core.status import Status, ANY_SOURCE, ANY_TAG, PROC_NULL
+from ..transport.base import Packet, PktType
+from ..utils.config import cvar, get_config
+from ..utils.mlog import get_logger
+from .matching import Matcher
+
+log = get_logger("pt2pt")
+
+cvar("R3_CHUNK_SIZE", 1 << 18, int, "pt2pt",
+     "Chunk size for packetized rendezvous data (R3 path).")
+
+
+class SendRequest(Request):
+    def __init__(self, engine, dest_world: int):
+        super().__init__(engine, "send")
+        self.dest_world = dest_world
+        self.packed: Optional[np.ndarray] = None
+        self.handle = None
+        self.channel = None
+        self.protocol = ""
+
+
+class RecvRequest(Request):
+    def __init__(self, engine, match: Tuple[int, int, int], buf, count: int,
+                 datatype: Datatype):
+        super().__init__(engine, "recv")
+        self.match = match      # (ctx, source, tag)
+        self.buf = buf
+        self.count = count
+        self.datatype = datatype
+        self.scratch: Optional[np.ndarray] = None
+        self.bytes_expected = 0
+        self.bytes_received = 0
+        self.truncated = False
+
+    @property
+    def capacity(self) -> int:
+        return self.datatype.size * self.count
+
+
+class Pt2ptProtocol:
+    """Per-rank protocol instance, bound to a progress engine + channels."""
+
+    def __init__(self, universe):
+        self.u = universe
+        self.engine = universe.engine
+        self.matcher = Matcher()
+        eng = self.engine
+        eng.register_handler(PktType.EAGER_SEND, self._on_eager)
+        eng.register_handler(PktType.RNDV_RTS, self._on_rts)
+        eng.register_handler(PktType.RNDV_CTS, self._on_cts)
+        eng.register_handler(PktType.RNDV_DATA, self._on_data)
+        eng.register_handler(PktType.RNDV_FIN, self._on_fin)
+        self.cfg = get_config()
+
+    # ------------------------------------------------------------------
+    # send side
+    # ------------------------------------------------------------------
+    def isend(self, buf, count: int, datatype: Datatype, dest_world: int,
+              comm_src: int, ctx: int, tag: int,
+              mode: str = "standard") -> Request:
+        """Start a send; returns the request (already complete for eager)."""
+        if dest_world == PROC_NULL:
+            return CompletedRequest()
+        channel = self.u.channel_for(dest_world)
+        is_local = self.u.is_local(dest_world)
+        nbytes = datatype.size * count
+        threshold = (self.cfg["SMP_EAGERSIZE"] if is_local
+                     else self.cfg["EAGER_THRESHOLD"])
+
+        if mode == "buffered":
+            # MPI_Bsend: copy now (pack always returns a fresh buffer),
+            # complete immediately; the transfer proceeds on a shadow
+            # request (the attached-buffer semantics). Track the shadow so
+            # a failed transfer is at least logged.
+            shadow = self.isend(np.asarray(datatype.pack(buf, count)),
+                                nbytes, dtmod.BYTE, dest_world, comm_src,
+                                ctx, tag, "standard")
+            shadow.add_callback(
+                lambda r: r.error and log.error(
+                    "buffered send to %d failed: %s", dest_world, r.error))
+            return CompletedRequest()
+
+        if nbytes <= threshold and mode != "sync":
+            packed = datatype.pack(buf, count)
+            pkt = Packet(PktType.EAGER_SEND, self.u.world_rank, ctx, comm_src,
+                         tag, nbytes, np.asarray(packed))
+            channel.send_packet(dest_world, pkt)
+            return CompletedRequest()
+
+        # rendezvous (always used for Ssend so completion implies matching)
+        sreq = SendRequest(self.engine, dest_world)
+        sreq.channel = channel
+        packed = datatype.pack(buf, count)
+        sreq.packed = np.asarray(packed)
+        proto = self.cfg["RNDV_PROTOCOL"]
+        if proto == "RGET" and channel.supports_rget:
+            sreq.protocol = "RGET"
+            sreq.handle = channel.expose_buffer(sreq.packed)
+        else:
+            sreq.protocol = "RPUT"
+        with self.engine.mutex:
+            self.engine.track(sreq)
+        pkt = Packet(PktType.RNDV_RTS, self.u.world_rank, ctx, comm_src, tag,
+                     nbytes, None, sreq_id=sreq.req_id, protocol=sreq.protocol,
+                     extra={"handle": sreq.handle} if sreq.handle is not None
+                     else None)
+        channel.send_packet(dest_world, pkt)
+        return sreq
+
+    # ------------------------------------------------------------------
+    # recv side
+    # ------------------------------------------------------------------
+    def irecv(self, buf, count: int, datatype: Datatype, source: int,
+              ctx: int, tag: int) -> Request:
+        if source == PROC_NULL:
+            req = CompletedRequest()
+            req.status.source = PROC_NULL
+            req.status.tag = ANY_TAG
+            return req
+        req = RecvRequest(self.engine, (ctx, source, tag), buf, count,
+                          datatype)
+        with self.engine.mutex:
+            pkt = self.matcher.match_posted(ctx, source, tag)
+            if pkt is not None:
+                self._deliver(req, pkt)
+            else:
+                self.matcher.post(req)
+                req._cancel_fn = lambda: self.matcher.cancel_posted(req)
+        return req
+
+    # -- probe ----------------------------------------------------------
+    def iprobe(self, source: int, ctx: int, tag: int) -> Optional[Status]:
+        with self.engine.mutex:
+            pkt = self.matcher.peek_unexpected(ctx, source, tag)
+        if pkt is None:
+            self.engine.progress_poke()
+            with self.engine.mutex:
+                pkt = self.matcher.peek_unexpected(ctx, source, tag)
+        return self._pkt_status(pkt) if pkt is not None else None
+
+    def probe(self, source: int, ctx: int, tag: int) -> Status:
+        box: list = []
+
+        def pred():
+            pkt = self.matcher.peek_unexpected(ctx, source, tag)
+            if pkt is not None:
+                box.append(pkt)
+                return True
+            return False
+
+        self.engine.progress_wait(pred)
+        return self._pkt_status(box[0])
+
+    def improbe(self, source: int, ctx: int, tag: int):
+        """Returns a matched-message token (the pkt) or None."""
+        with self.engine.mutex:
+            pkt = self.matcher.peek_unexpected(ctx, source, tag, remove=True)
+        if pkt is None:
+            self.engine.progress_poke()
+            with self.engine.mutex:
+                pkt = self.matcher.peek_unexpected(ctx, source, tag,
+                                                   remove=True)
+        return pkt
+
+    def mrecv(self, message: Packet, buf, count: int,
+              datatype: Datatype) -> Request:
+        req = RecvRequest(self.engine, (message.ctx, message.comm_src,
+                                        message.tag), buf, count, datatype)
+        with self.engine.mutex:
+            self._deliver(req, message)
+        return req
+
+    @staticmethod
+    def _pkt_status(pkt: Packet) -> Status:
+        return Status(source=pkt.comm_src, tag=pkt.tag, count=pkt.nbytes)
+
+    # ------------------------------------------------------------------
+    # delivery / handlers (engine mutex held)
+    # ------------------------------------------------------------------
+    def _deliver(self, req: RecvRequest, pkt: Packet) -> None:
+        if pkt.type == PktType.EAGER_SEND:
+            self._deliver_eager(req, pkt)
+        elif pkt.type == PktType.RNDV_RTS:
+            self._rndv_recv_start(req, pkt)
+        else:  # pragma: no cover
+            raise MPIException(MPI_ERR_INTERN, f"bad matched pkt {pkt.type}")
+
+    def _finish_recv(self, req: RecvRequest, pkt_or_none, nbytes: int,
+                     src: int, tag: int) -> None:
+        req.status.source = src
+        req.status.tag = tag
+        req.status.count = min(nbytes, req.capacity)
+        err = None
+        if nbytes > req.capacity:
+            err = MPIException(MPI_ERR_TRUNCATE,
+                               f"message truncated: {nbytes} > {req.capacity}")
+        req.complete(err)
+
+    def _deliver_eager(self, req: RecvRequest, pkt: Packet) -> None:
+        n = min(pkt.nbytes, req.capacity)
+        if n > 0 and req.buf is not None:
+            req.datatype.unpack(pkt.data[:n], req.buf, req.count)
+        self._finish_recv(req, pkt, pkt.nbytes, pkt.comm_src, pkt.tag)
+
+    def _rndv_recv_start(self, req: RecvRequest, pkt: Packet) -> None:
+        req.bytes_expected = pkt.nbytes
+        src_world = pkt.src_world
+        channel = self.u.channel_for(src_world)
+        if pkt.protocol == "RGET":
+            n = min(pkt.nbytes, req.capacity)
+            if n > 0:
+                data = channel.pull_buffer(src_world, pkt.extra["handle"], n)
+                req.datatype.unpack(data, req.buf, req.count)
+            fin = Packet(PktType.RNDV_FIN, self.u.world_rank,
+                         sreq_id=pkt.sreq_id)
+            channel.send_packet(src_world, fin)
+            self._finish_recv(req, pkt, pkt.nbytes, pkt.comm_src, pkt.tag)
+            return
+        # RPUT/R3: stage into scratch, ask sender to push
+        req.scratch = np.empty(min(pkt.nbytes, req.capacity), dtype=np.uint8)
+        req._rndv_env = (pkt.comm_src, pkt.tag, pkt.nbytes)
+        self.engine.track(req)
+        cts = Packet(PktType.RNDV_CTS, self.u.world_rank,
+                     sreq_id=pkt.sreq_id, rreq_id=req.req_id)
+        channel.send_packet(src_world, cts)
+
+    # -- handlers --------------------------------------------------------
+    def _on_eager(self, pkt: Packet) -> None:
+        req = self.matcher.match_incoming(pkt)
+        if req is not None:
+            self._deliver_eager(req, pkt)
+
+    def _on_rts(self, pkt: Packet) -> None:
+        req = self.matcher.match_incoming(pkt)
+        if req is not None:
+            self._rndv_recv_start(req, pkt)
+
+    def _on_cts(self, pkt: Packet) -> None:
+        sreq = self.engine.outstanding.get(pkt.sreq_id)
+        if sreq is None:  # pragma: no cover
+            raise MPIException(MPI_ERR_INTERN, "CTS for unknown send")
+        data = sreq.packed
+        chunk = self.cfg["R3_CHUNK_SIZE"]
+        total = len(data)
+        off = 0
+        while True:
+            end = min(off + chunk, total)
+            dpkt = Packet(PktType.RNDV_DATA, self.u.world_rank,
+                          nbytes=end - off, data=data[off:end],
+                          rreq_id=pkt.rreq_id, offset=off,
+                          extra={"last": end >= total})
+            sreq.channel.send_packet(pkt.src_world, dpkt)
+            off = end
+            if off >= total:
+                break
+        sreq.complete()
+
+    def _on_data(self, pkt: Packet) -> None:
+        rreq = self.engine.outstanding.get(pkt.rreq_id)
+        if rreq is None:  # pragma: no cover
+            raise MPIException(MPI_ERR_INTERN, "DATA for unknown recv")
+        cap = len(rreq.scratch)
+        if pkt.offset < cap and pkt.nbytes > 0:
+            n = min(pkt.nbytes, cap - pkt.offset)
+            rreq.scratch[pkt.offset:pkt.offset + n] = pkt.data[:n]
+        rreq.bytes_received += pkt.nbytes
+        if pkt.extra and pkt.extra.get("last"):
+            if cap > 0:
+                rreq.datatype.unpack(rreq.scratch, rreq.buf, rreq.count)
+            src, tag, nbytes = rreq._rndv_env
+            self._finish_recv(rreq, pkt, nbytes, src, tag)
+
+    def _on_fin(self, pkt: Packet) -> None:
+        sreq = self.engine.outstanding.get(pkt.sreq_id)
+        if sreq is None:  # pragma: no cover
+            raise MPIException(MPI_ERR_INTERN, "FIN for unknown send")
+        if sreq.handle is not None:
+            sreq.channel.release_buffer(sreq.handle)
+        sreq.complete()

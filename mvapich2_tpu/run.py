@@ -1,0 +1,8 @@
+"""``python -m mvapich2_tpu.run -np N prog args...`` — mpirun entry point."""
+
+import sys
+
+from .runtime.launcher import main
+
+if __name__ == "__main__":
+    sys.exit(main())

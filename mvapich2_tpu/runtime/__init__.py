@@ -1,0 +1,2 @@
+from . import universe
+from .universe import Universe, current_universe, local_universe, run_ranks

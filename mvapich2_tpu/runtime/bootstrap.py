@@ -1,0 +1,74 @@
+"""Process-mode bootstrap: build this rank's Universe from the environment.
+
+The analog of MPID_Init's InitPG + address exchange (SURVEY §3.1): the
+launcher exports MV2T_RANK / MV2T_SIZE / MV2T_KVS, ranks publish their
+channel addresses ("business cards") to the KVS, fence, and wire up
+channels. Node topology is derived by exchanging host names — the analog of
+MPIDI_Populate_vc_node_ids (mpid_init.c:373) — so the SMP/2-level paths know
+which ranks are co-located.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import List, Optional
+
+from ..utils.config import get_config
+from ..utils.mlog import get_logger
+from .kvs import KVSClient
+from .universe import Universe
+
+log = get_logger("bootstrap")
+
+
+def bootstrap_from_env() -> Universe:
+    rank = int(os.environ.get("MV2T_RANK", os.environ.get("PMI_RANK", "0")))
+    size = int(os.environ.get("MV2T_SIZE", os.environ.get("PMI_SIZE", "1")))
+    kvs_addr = os.environ.get("MV2T_KVS")
+    get_config().reload()
+
+    if size == 1 or kvs_addr is None:
+        # singleton init (mpiexec-less a.out, like MPICH singleton PMI)
+        from ..transport.local import LocalChannel, LocalFabric
+        u = Universe(0, 1)
+        fabric = LocalFabric(1)
+        u.set_default_channel(LocalChannel(fabric, 0))
+        fabric.register(0, u.engine)
+        u.initialize()
+        return u
+
+    kvs = KVSClient(kvs_addr)
+    # node topology: exchange host identifiers. MV2T_FAKE_NODE lets tests
+    # emulate multi-node placement on one host.
+    nodekey = os.environ.get("MV2T_FAKE_NODE", socket.gethostname())
+    kvs.put(f"node-{rank}", nodekey)
+    kvs.fence()
+    names = [kvs.get(f"node-{r}") for r in range(size)]
+    ids: dict = {}
+    node_ids: List[int] = []
+    for n in names:
+        node_ids.append(ids.setdefault(n, len(ids)))
+
+    u = Universe(rank, size, node_ids)
+    u.kvs = kvs
+
+    from ..transport.tcp import TcpChannel
+    tcp = TcpChannel(rank, kvs)
+    u.set_default_channel(tcp)
+
+    # intra-node fast path: shared-memory channel for co-located ranks
+    try:
+        from ..transport.shm import ShmChannel
+        local = [r for r in range(size) if node_ids[r] == node_ids[rank]]
+        if len(local) > 1:
+            shm = ShmChannel(rank, local, kvs)
+            for r in local:
+                if r != rank:
+                    u.set_channel(r, shm)
+    except Exception as e:  # pragma: no cover — fall back to tcp
+        log.warn("shm channel unavailable (%s); using tcp intra-node", e)
+
+    kvs.fence()   # everyone's business cards are published
+    u.initialize()
+    return u

@@ -1,0 +1,143 @@
+"""Key-value store bootstrap service — the PMI analog.
+
+The reference bootstraps channels by exchanging "business cards" through the
+launcher's PMI tree (SURVEY §1 L2→L1 seam: UPMI_KVS_PUT/GET/FENCE,
+/root/reference/src/mpid/ch3/src/mpid_init.c:345-420, served by mpispawn's
+pmi_tree.c). Here: a tiny TCP JSON-line server owned by the launcher, with
+PUT / GET (blocking until the key appears) / FENCE (barrier) / ABORT verbs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.mlog import get_logger
+
+log = get_logger("kvs")
+
+
+class _KVSState:
+    def __init__(self, nranks: int):
+        self.nranks = nranks
+        self.data: Dict[str, str] = {}
+        self.cond = threading.Condition()
+        self.fence_count = 0
+        self.fence_gen = 0
+        self.aborted: Optional[str] = None
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        state: _KVSState = self.server.state  # type: ignore
+        for line in self.rfile:
+            try:
+                msg = json.loads(line)
+            except json.JSONDecodeError:
+                break
+            cmd = msg.get("cmd")
+            if cmd == "put":
+                with state.cond:
+                    state.data[msg["key"]] = msg["val"]
+                    state.cond.notify_all()
+                self._reply({"ok": True})
+            elif cmd == "get":
+                with state.cond:
+                    while msg["key"] not in state.data and not state.aborted:
+                        state.cond.wait(timeout=60)
+                    val = state.data.get(msg["key"])
+                self._reply({"ok": val is not None, "val": val})
+            elif cmd == "fence":
+                with state.cond:
+                    gen = state.fence_gen
+                    state.fence_count += 1
+                    if state.fence_count == state.nranks:
+                        state.fence_count = 0
+                        state.fence_gen += 1
+                        state.cond.notify_all()
+                    else:
+                        while state.fence_gen == gen and not state.aborted:
+                            state.cond.wait(timeout=60)
+                self._reply({"ok": True})
+            elif cmd == "abort":
+                with state.cond:
+                    state.aborted = msg.get("why", "abort")
+                    state.cond.notify_all()
+                self._reply({"ok": True})
+            else:
+                self._reply({"ok": False, "err": f"bad cmd {cmd}"})
+
+    def _reply(self, obj) -> None:
+        self.wfile.write((json.dumps(obj) + "\n").encode())
+        self.wfile.flush()
+
+
+class KVSServer:
+    """Launcher-side server; one per job."""
+
+    def __init__(self, nranks: int, host: str = "127.0.0.1"):
+        self.state = _KVSState(nranks)
+        self._srv = socketserver.ThreadingTCPServer((host, 0), _Handler,
+                                                    bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self._srv.state = self.state  # type: ignore
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True, name="kvs-server")
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        h, p = self._srv.server_address[:2]
+        return f"{h}:{p}"
+
+    def shutdown(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class KVSClient:
+    """Rank-side client (the UPMI analog)."""
+
+    def __init__(self, address: str):
+        host, port = address.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)), timeout=120)
+        self._f = self._sock.makefile("rwb")
+        self._lock = threading.Lock()
+
+    def _rpc(self, obj) -> dict:
+        with self._lock:
+            self._f.write((json.dumps(obj) + "\n").encode())
+            self._f.flush()
+            line = self._f.readline()
+        if not line:
+            raise ConnectionError("KVS server closed connection")
+        return json.loads(line)
+
+    def put(self, key: str, val: str) -> None:
+        self._rpc({"cmd": "put", "key": key, "val": val})
+
+    def get(self, key: str) -> str:
+        r = self._rpc({"cmd": "get", "key": key})
+        if not r.get("ok"):
+            raise KeyError(key)
+        return r["val"]
+
+    def fence(self) -> None:
+        self._rpc({"cmd": "fence"})
+
+    def abort(self, why: str = "") -> None:
+        try:
+            self._rpc({"cmd": "abort", "why": why})
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+            self._sock.close()
+        except Exception:
+            pass

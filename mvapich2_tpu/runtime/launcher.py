@@ -1,0 +1,103 @@
+"""mpirun — the process launcher.
+
+Analog of mpirun_rsh/mpispawn (SURVEY §3.6, /root/reference/src/pm/mpirun/):
+parse -np/-hostfile-ish args, start the KVS service (the PMI tree analog),
+spawn one OS process per rank with the bootstrap env, forward stdio, and
+reap exit codes — killing the job if any rank dies (the launcher-driven
+failure detection of SURVEY §5.3).
+
+Single-host only for now; ranks map to TPU work through the device mesh,
+not through multi-host ssh trees (multi-host uses jax.distributed's own
+coordinator when available).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from .kvs import KVSServer
+
+
+def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
+           fake_nodes: Optional[List[int]] = None,
+           timeout: Optional[float] = None) -> int:
+    """Run ``argv`` as ``nranks`` rank processes; returns max exit code."""
+    srv = KVSServer(nranks)
+    procs: List[subprocess.Popen] = []
+    try:
+        for r in range(nranks):
+            env = dict(os.environ)
+            env["MV2T_RANK"] = str(r)
+            env["MV2T_SIZE"] = str(nranks)
+            env["MV2T_KVS"] = srv.address
+            if fake_nodes is not None:
+                env["MV2T_FAKE_NODE"] = f"fakenode{fake_nodes[r]}"
+            if env_extra:
+                env.update(env_extra)
+            # rank processes must not grab the TPU: host runtime is CPU-side
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            procs.append(subprocess.Popen(argv, env=env))
+        deadline = time.monotonic() + timeout if timeout else None
+        exit_codes: List[Optional[int]] = [None] * nranks
+        while any(c is None for c in exit_codes):
+            for i, p in enumerate(procs):
+                if exit_codes[i] is None:
+                    exit_codes[i] = p.poll()
+            # a dead rank with nonzero status kills the job (mpirun_rsh
+            # behavior: cleanup on abnormal exit)
+            bad = [i for i, c in enumerate(exit_codes)
+                   if c is not None and c != 0]
+            if bad:
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                time.sleep(0.2)
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                return max(c or 0 for c in exit_codes if c is not None) or 1
+            if deadline and time.monotonic() > deadline:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                raise TimeoutError(f"job exceeded {timeout}s")
+            time.sleep(0.01)
+        return max(c or 0 for c in exit_codes)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        srv.shutdown()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mpirun",
+        description="mvapich2-tpu process launcher (mpirun_rsh analog)")
+    ap.add_argument("-np", "-n", type=int, default=1, dest="np")
+    ap.add_argument("--fake-nodes", type=str, default=None,
+                    help="comma-separated fake node id per rank "
+                         "(emulate multi-node on one host)")
+    ap.add_argument("--timeout", type=float, default=None)
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no command given")
+    fake = None
+    if args.fake_nodes:
+        fake = [int(x) for x in args.fake_nodes.split(",")]
+        if len(fake) != args.np:
+            ap.error("--fake-nodes length must equal -np")
+    return launch(args.np, args.command, fake_nodes=fake,
+                  timeout=args.timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,202 @@
+"""Per-rank runtime state and in-process multi-rank harness.
+
+The Universe is the analog of the reference's process-group + VC table state
+built in MPID_Init (SURVEY §3.1, /root/reference/src/mpid/ch3/src/
+mpid_init.c): world rank/size, the channel set, node topology (which ranks
+share a node — src/util/procmap/local_proc.c), and context-id allocation.
+
+Two instantiation modes:
+  * ``local_universe(n)`` / ``run_ranks`` — every rank is a thread in this
+    process wired through a LocalFabric. This is the unit-test harness and
+    the analog of running the MPICH suite with all ranks on one node.
+  * process mode (mvapich2_tpu.runtime.bootstrap) — one rank per OS process,
+    bootstrapped through the KVS (PMI analog) with tcp/shm channels.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..core.errors import MPIException, MPI_ERR_INTERN
+from ..pt2pt.protocol import Pt2ptProtocol
+from ..transport.base import Channel
+from ..transport.local import LocalChannel, LocalFabric
+from ..transport.progress import ProgressEngine
+from ..utils.config import get_config
+from ..utils.mlog import get_logger
+
+log = get_logger("runtime")
+
+
+class Universe:
+    def __init__(self, world_rank: int, world_size: int,
+                 node_ids: Optional[Sequence[int]] = None):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.node_ids: List[int] = list(node_ids) if node_ids is not None \
+            else [0] * world_size
+        self.engine = ProgressEngine(world_rank)
+        self.protocol: Optional[Pt2ptProtocol] = None
+        self._channels: Dict[int, Channel] = {}   # world rank -> channel
+        self._default_channel: Optional[Channel] = None
+        self.comm_world = None
+        self.comm_self = None
+        self._next_ctx = 8  # 0/1: world pt2pt/coll, 2/3: self, 4+: spare
+        self.finalized = False
+        self.initialized = False
+        self.windows: Dict[int, object] = {}      # win_id -> Win (RMA)
+        self.failed_ranks: set = set()            # ULFM state
+        self.attrs = {}
+
+    # -- wiring -----------------------------------------------------------
+    def set_default_channel(self, ch: Channel) -> None:
+        self.engine.add_channel(ch)
+        self._default_channel = ch
+
+    def set_channel(self, world_rank: int, ch: Channel) -> None:
+        if ch not in self.engine.channels:
+            self.engine.add_channel(ch)
+        self._channels[world_rank] = ch
+
+    def channel_for(self, dest_world: int) -> Channel:
+        ch = self._channels.get(dest_world, self._default_channel)
+        if ch is None:
+            raise MPIException(MPI_ERR_INTERN,
+                               f"no channel for rank {dest_world}")
+        return ch
+
+    def is_local(self, dest_world: int) -> bool:
+        """Same node? Feeds the SMP-path routing decision
+        (mpid_send.c:267 analog) and 2-level collective splits."""
+        return self.node_ids[dest_world] == self.node_ids[self.world_rank]
+
+    @property
+    def my_node(self) -> int:
+        return self.node_ids[self.world_rank]
+
+    def local_world_ranks(self) -> List[int]:
+        me = self.my_node
+        return [r for r in range(self.world_size) if self.node_ids[r] == me]
+
+    def num_nodes(self) -> int:
+        return len(set(self.node_ids))
+
+    # -- init / finalize --------------------------------------------------
+    def initialize(self) -> None:
+        from ..core.comm import Comm
+        from ..core.group import Group
+        get_config().reload()
+        self.protocol = Pt2ptProtocol(self)
+        self.comm_world = Comm(self, Group(range(self.world_size)),
+                               context_id=0, name="MPI_COMM_WORLD")
+        self.comm_self = Comm(self, Group([self.world_rank]),
+                              context_id=2, name="MPI_COMM_SELF")
+        self.initialized = True
+
+    def allocate_context_id(self, parent_comm) -> int:
+        """Collective over parent_comm: agree on a fresh context id.
+
+        The reference allocates from a collectively-ANDed bitmask
+        (MPIR_Get_contextid); agreeing on max(next_free) via allreduce has
+        the same safety property (all members get the same unused id)."""
+        import numpy as np
+        from ..coll import api as coll
+        from ..core import op as opmod
+        mine = np.array([self._next_ctx], dtype=np.int64)
+        out = np.zeros_like(mine)
+        coll.allreduce(parent_comm, mine, out, 1, None, opmod.MAX)
+        ctx = int(out[0])
+        self._next_ctx = ctx + 2
+        return ctx
+
+    def finalize(self) -> None:
+        if self.finalized:
+            return
+        self.engine.drain_all()
+        self.engine.close()
+        self.finalized = True
+
+
+# ---------------------------------------------------------------------------
+# current-universe plumbing (thread-local first, then process-global)
+# ---------------------------------------------------------------------------
+
+_tls = threading.local()
+_process_universe: Optional[Universe] = None
+
+
+def set_universe(u: Optional[Universe], process_wide: bool = False) -> None:
+    global _process_universe
+    if process_wide:
+        _process_universe = u
+    else:
+        _tls.universe = u
+
+
+def current_universe() -> Optional[Universe]:
+    u = getattr(_tls, "universe", None)
+    return u if u is not None else _process_universe
+
+
+# ---------------------------------------------------------------------------
+# in-process harness
+# ---------------------------------------------------------------------------
+
+def local_universe(nranks: int, nodes: Optional[Sequence[int]] = None
+                   ) -> List[Universe]:
+    """Build ``nranks`` thread-rank universes over one LocalFabric.
+
+    ``nodes`` optionally assigns a fake node id per rank so node-aware
+    (2-level) paths can be exercised without multiple hosts."""
+    fabric = LocalFabric(nranks)
+    universes = []
+    for r in range(nranks):
+        u = Universe(r, nranks, nodes)
+        u.set_default_channel(LocalChannel(fabric, r))
+        fabric.register(r, u.engine)
+        universes.append(u)
+    for u in universes:
+        u.initialize()
+    return universes
+
+
+def run_ranks(nranks: int, fn: Callable, *args,
+              nodes: Optional[Sequence[int]] = None,
+              timeout: float = 120.0) -> List:
+    """Run ``fn(comm_world, *args)`` on every rank (threads); return the
+    per-rank results. Any rank's exception is re-raised with its rank noted.
+    This is the in-process testing harness for the MPICH-style corpus."""
+    universes = local_universe(nranks, nodes)
+    results: List = [None] * nranks
+    errors: List = [None] * nranks
+
+    def body(r: int):
+        set_universe(universes[r])
+        try:
+            results[r] = fn(universes[r].comm_world, *args)
+        except BaseException as e:  # noqa: BLE001
+            errors[r] = e
+            # wake peers stuck waiting on us
+            for u in universes:
+                u.engine.wakeup()
+        finally:
+            set_universe(None)
+
+    threads = [threading.Thread(target=body, args=(r,), daemon=True,
+                                name=f"rank-{r}")
+               for r in range(nranks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        if t.is_alive():
+            raise TimeoutError(
+                f"rank thread {t.name} did not finish within {timeout}s "
+                f"(errors so far: {[e for e in errors if e]})")
+    for u in universes:
+        u.finalize()
+    for r, e in enumerate(errors):
+        if e is not None:
+            raise RuntimeError(f"rank {r} failed: {e!r}") from e
+    return results

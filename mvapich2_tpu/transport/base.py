@@ -1,0 +1,136 @@
+"""Channel interface — the L3→L2 seam.
+
+Analog of the CH3 channel API (SURVEY §1: MPIDI_CH3_iStartMsg / iSendv /
+Rndv_transfer / MPIDI_CH3I_Progress, declared in
+/root/reference/src/mpid/ch3/include/mpidimpl.h:1510-1640). A channel moves
+opaque packets between world ranks; the protocol layer above it implements
+matching and eager/rendezvous semantics. Channels in-tree:
+
+  * local  — in-process threaded fabric (unit tests; nemesis-shm analog)
+  * tcp    — sockets between rank processes (sock channel analog)
+  * shm    — shared-memory rings between co-located processes (mrail SMP
+             analog; C++ fast path)
+  * ici    — the TPU path: collectives don't go through packets at all but
+             lower to XLA ops on the device mesh (SURVEY §5.8)
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class PktType(enum.IntEnum):
+    """Wire packet types — analog of MPIDI_CH3_Pkt_type_t
+    (/root/reference/src/mpid/ch3/include/mpidpkt.h:96-182)."""
+
+    EAGER_SEND = 1
+    RNDV_RTS = 2           # request-to-send (no payload)
+    RNDV_CTS = 3           # clear-to-send (receiver matched)
+    RNDV_DATA = 4          # RPUT/R3 payload chunk
+    RNDV_FIN = 5           # transfer complete
+    # one-sided (SURVEY §2.1 RMA)
+    RMA_PUT = 10
+    RMA_GET = 11
+    RMA_GET_RESP = 12
+    RMA_ACC = 13
+    RMA_GET_ACC = 14
+    RMA_GET_ACC_RESP = 15
+    RMA_CAS = 16
+    RMA_CAS_RESP = 17
+    RMA_FOP = 18
+    RMA_FOP_RESP = 19
+    RMA_LOCK = 20
+    RMA_LOCK_GRANTED = 21
+    RMA_UNLOCK = 22
+    RMA_FLUSH = 23
+    RMA_FLUSH_ACK = 24
+    # control
+    BARRIER_CTL = 30
+    REVOKE = 31            # ULFM comm revoke propagation
+    SHUTDOWN = 32
+
+
+class Packet:
+    """One wire message. ``data`` is a contiguous uint8 ndarray or None."""
+
+    __slots__ = ("type", "src_world", "ctx", "comm_src", "tag", "nbytes",
+                 "data", "sreq_id", "rreq_id", "protocol", "offset", "extra")
+
+    def __init__(self, type: PktType, src_world: int, ctx: int = 0,
+                 comm_src: int = 0, tag: int = 0, nbytes: int = 0,
+                 data: Optional[np.ndarray] = None, sreq_id: int = 0,
+                 rreq_id: int = 0, protocol: str = "", offset: int = 0,
+                 extra: Optional[Dict[str, Any]] = None):
+        self.type = type
+        self.src_world = src_world
+        self.ctx = ctx
+        self.comm_src = comm_src
+        self.tag = tag
+        self.nbytes = nbytes
+        self.data = data
+        self.sreq_id = sreq_id
+        self.rreq_id = rreq_id
+        self.protocol = protocol
+        self.offset = offset
+        self.extra = extra
+
+    def header_tuple(self):
+        return (int(self.type), self.src_world, self.ctx, self.comm_src,
+                self.tag, self.nbytes, self.sreq_id, self.rreq_id,
+                self.protocol, self.offset, self.extra)
+
+    @classmethod
+    def from_header(cls, hdr, data):
+        (ptype, src_world, ctx, comm_src, tag, nbytes, sreq_id, rreq_id,
+         protocol, offset, extra) = hdr
+        return cls(PktType(ptype), src_world, ctx, comm_src, tag, nbytes,
+                   data, sreq_id, rreq_id, protocol, offset, extra)
+
+    def __repr__(self):
+        return (f"Packet({self.type.name}, src={self.src_world}, "
+                f"ctx={self.ctx}, tag={self.tag}, nbytes={self.nbytes})")
+
+
+class Channel:
+    """Transport ABC — the seam where mrail/nemesis/psm/sock plug in."""
+
+    name = "abstract"
+    # True if RTS packets may carry a zero-copy handle the receiver can pull
+    # from directly (RGET analog). Local/shm channels support this.
+    supports_rget = False
+
+    def attach(self, engine) -> None:
+        """Bind to the owning rank's progress engine."""
+        self.engine = engine
+
+    def send_packet(self, dest_world: int, pkt: Packet) -> None:
+        raise NotImplementedError
+
+    def poll(self) -> bool:
+        """Advance I/O; return True if any packet was processed."""
+        raise NotImplementedError
+
+    def wait_for_event(self, timeout: float) -> None:
+        """Block up to ``timeout`` seconds for inbound traffic (may return
+        early spuriously). Default: busy-poll granularity sleep."""
+        import time
+        time.sleep(min(timeout, 0.0002))
+
+    # -- zero-copy rendezvous hooks (RGET path) ---------------------------
+    def expose_buffer(self, array: np.ndarray) -> Any:
+        """Register a send buffer for remote pull; returns an opaque handle
+        carried in the RTS (the rkey analog, gen2/ibv_rndv.c:171)."""
+        raise NotImplementedError
+
+    def pull_buffer(self, src_world: int, handle: Any, nbytes: int) -> np.ndarray:
+        """RGET: read the peer's exposed buffer."""
+        raise NotImplementedError
+
+    def release_buffer(self, handle: Any) -> None:
+        pass
+
+    def close(self) -> None:
+        pass

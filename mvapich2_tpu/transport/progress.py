@@ -1,0 +1,157 @@
+"""Per-rank progress engine — the hot loop every blocking call funnels into.
+
+Analog of MPIDI_CH3I_Progress (SURVEY §3.5,
+/root/reference/src/mpid/ch3/channels/mrail/src/rdma/ch3_progress.c:186):
+
+    loop { drain inbox; poll channels; run progress hooks; sleep-or-spin }
+
+Design differences from the reference, driven by the runtime model:
+  * One engine per rank. In the in-process ("local") fabric, rank peers are
+    threads and deliver packets by appending to this engine's inbox and
+    signalling its condition variable — so blocking waits are event-driven,
+    not spin-polls. Socket/shm channels are polled like the reference's CQs.
+  * All rank-local protocol state (matching queues, requests, windows) is
+    mutated only while holding ``mutex`` — the analog of MPICH's coarse
+    global CS (SURVEY §5.2) — which the owning thread holds for the duration
+    of an MPI call.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..core.errors import MPIException, MPI_ERR_INTERN
+from ..core.request import Request
+from ..utils.mlog import get_logger
+from .base import Channel, Packet, PktType
+
+log = get_logger("progress")
+
+
+class ProgressEngine:
+    def __init__(self, rank: int):
+        self.rank = rank
+        self.mutex = threading.RLock()
+        self._inbox: collections.deque = collections.deque()
+        self._inbox_lock = threading.Lock()
+        self._inbox_cond = threading.Condition(self._inbox_lock)
+        self.channels: List[Channel] = []
+        # pkt type -> handler(pkt); populated by protocol/rma layers
+        self.pkt_handlers: Dict[int, Callable[[Packet], None]] = {}
+        # req_id -> Request, for CTS/FIN/RESP lookup
+        self.outstanding: Dict[int, Request] = {}
+        # registered progress hooks (nonblocking-coll scheduler, RMA flush)
+        self.hooks: List[Callable[[], bool]] = []
+        self.poll_count = 0      # MPI_T pvar analog (ch3_progress.c:218)
+        self.shutdown = False
+
+    # -- wiring -----------------------------------------------------------
+    def add_channel(self, ch: Channel) -> None:
+        ch.attach(self)
+        self.channels.append(ch)
+
+    def register_handler(self, ptype: PktType, fn: Callable) -> None:
+        self.pkt_handlers[int(ptype)] = fn
+
+    def register_hook(self, fn: Callable[[], bool]) -> None:
+        self.hooks.append(fn)
+
+    # -- packet delivery (any thread) -------------------------------------
+    def enqueue_incoming(self, pkt: Packet) -> None:
+        with self._inbox_cond:
+            self._inbox.append(pkt)
+            self._inbox_cond.notify_all()
+
+    def wakeup(self) -> None:
+        with self._inbox_cond:
+            self._inbox_cond.notify_all()
+
+    # -- completion (owning thread, mutex held) ---------------------------
+    def complete_request(self, req: Request) -> None:
+        with self.mutex:
+            self.outstanding.pop(req.req_id, None)
+            req._fire()
+        self.wakeup()
+
+    def track(self, req: Request) -> Request:
+        self.outstanding[req.req_id] = req
+        return req
+
+    # -- the loop ---------------------------------------------------------
+    def _dispatch(self, pkt: Packet) -> None:
+        fn = self.pkt_handlers.get(int(pkt.type))
+        if fn is None:
+            raise MPIException(MPI_ERR_INTERN,
+                               f"no handler for packet {pkt.type.name}")
+        fn(pkt)
+
+    def _drain_inbox(self) -> int:
+        n = 0
+        while True:
+            with self._inbox_lock:
+                if not self._inbox:
+                    break
+                pkt = self._inbox.popleft()
+            self._dispatch(pkt)
+            n += 1
+        return n
+
+    def progress_poke(self) -> bool:
+        """One nonblocking pass (MPID_Progress_test analog)."""
+        with self.mutex:
+            self.poll_count += 1
+            did = self._drain_inbox() > 0
+            for ch in self.channels:
+                if ch.poll():
+                    did = True
+            did = self._drain_inbox() > 0 or did
+            for hook in list(self.hooks):
+                if hook():
+                    did = True
+        return did
+
+    def progress_wait(self, pred: Callable[[], bool],
+                      timeout: Optional[float] = None) -> None:
+        """Poll/sleep until ``pred()`` — MPID_Progress_wait analog."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spin = 0
+        while True:
+            with self.mutex:
+                if pred():
+                    return
+            self.progress_poke()
+            with self.mutex:
+                if pred():
+                    return
+            spin += 1
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("progress_wait timed out")
+            # After a few empty spins, sleep on the inbox condition so the
+            # threaded fabric wakes us instantly; polled channels bound the
+            # sleep with their own event wait.
+            if spin > 16:
+                with self._inbox_cond:
+                    if not self._inbox:
+                        self._inbox_cond.wait(timeout=0.0005)
+
+    def drain_all(self, timeout: float = 5.0) -> None:
+        """Progress until no work remains (used at Finalize/quiesce)."""
+        end = time.monotonic() + timeout
+        idle = 0
+        while time.monotonic() < end:
+            if self.progress_poke():
+                idle = 0
+            else:
+                idle += 1
+                if idle > 3:
+                    return
+                time.sleep(0.0002)
+
+    def close(self) -> None:
+        self.shutdown = True
+        for ch in self.channels:
+            ch.close()
+        self.wakeup()

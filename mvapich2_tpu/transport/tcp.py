@@ -1,0 +1,207 @@
+"""TCP channel — the sock-channel analog for process-mode ranks.
+
+Design notes vs the reference (SURVEY §2.2):
+  * Connections are made **on demand** at first send to a peer, like the
+    mrail on-demand CM (common/src/cm/cm.c:1520) — no N² connect storm at
+    init. Each connection is unidirectional (initiator -> acceptor), which
+    removes the simultaneous-connect dedup handshake entirely.
+  * Outgoing data is queued and flushed from poll() with nonblocking
+    writes — the backlog-queue/credit pattern of ibv_send.c:320-360 — so a
+    rank never blocks in send_packet while its peer is also mid-send
+    (head-of-line deadlock on bidirectional large messages).
+  * Wire frame: [4B header length][pickled header][payload bytes].
+"""
+
+from __future__ import annotations
+
+import collections
+import errno
+import pickle
+import selectors
+import socket
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.mlog import get_logger
+from .base import Channel, Packet
+
+log = get_logger("tcp")
+
+_LEN = struct.Struct("<I")
+
+
+class _Conn:
+    """One inbound or outbound stream with reassembly state."""
+
+    __slots__ = ("sock", "rbuf", "need", "stage", "outq", "osent")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.rbuf = bytearray()
+        self.need = None        # (header, payload_len) while reading payload
+        self.stage = 0          # 0: reading len, 1: reading header+payload
+        self.outq: collections.deque = collections.deque()
+        self.osent = 0
+
+
+class TcpChannel(Channel):
+    name = "tcp"
+    supports_rget = False
+
+    def __init__(self, my_rank: int, kvs):
+        self.my_rank = my_rank
+        self.kvs = kvs
+        self.sel = selectors.DefaultSelector()
+        self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.listener.bind(("127.0.0.1", 0))
+        self.listener.listen(128)
+        self.listener.setblocking(False)
+        self.sel.register(self.listener, selectors.EVENT_READ, "accept")
+        host, port = self.listener.getsockname()[:2]
+        kvs.put(f"tcp-addr-{my_rank}", f"{host}:{port}")
+        self._out: Dict[int, _Conn] = {}      # dest rank -> conn
+        self._in: List[_Conn] = []
+        self._closed = False
+
+    # -- outgoing ---------------------------------------------------------
+    def _connect(self, dest: int) -> _Conn:
+        addr = self.kvs.get(f"tcp-addr-{dest}")
+        host, port = addr.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)))
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.setblocking(False)
+        conn = _Conn(s)
+        self._out[dest] = conn
+        self.sel.register(s, selectors.EVENT_READ, ("out", conn))
+        return conn
+
+    def send_packet(self, dest_world: int, pkt: Packet) -> None:
+        conn = self._out.get(dest_world) or self._connect(dest_world)
+        data = pkt.data
+        payload = b""
+        if data is not None:
+            payload = np.ascontiguousarray(data).tobytes()
+        hdr = pickle.dumps((pkt.header_tuple(), len(payload)), protocol=5)
+        conn.outq.append(_LEN.pack(len(hdr)))
+        conn.outq.append(hdr)
+        if payload:
+            conn.outq.append(payload)
+        self._flush(conn)
+
+    def _flush(self, conn: _Conn) -> bool:
+        """Nonblocking flush of the backlog; True if fully drained."""
+        while conn.outq:
+            buf = conn.outq[0]
+            off = conn.osent
+            try:
+                n = conn.sock.send(memoryview(buf)[off:])
+            except (BlockingIOError, InterruptedError):
+                return False
+            except OSError as e:  # peer died
+                log.error("send to peer failed: %s", e)
+                conn.outq.clear()
+                return True
+            conn.osent += n
+            if conn.osent >= len(buf):
+                conn.outq.popleft()
+                conn.osent = 0
+            if n == 0:
+                return False
+        return True
+
+    # -- incoming ---------------------------------------------------------
+    def _on_readable(self, conn: _Conn) -> bool:
+        try:
+            chunk = conn.sock.recv(1 << 20)
+        except (BlockingIOError, InterruptedError):
+            return False
+        except OSError:
+            chunk = b""
+        if not chunk:
+            try:
+                self.sel.unregister(conn.sock)
+            except (KeyError, ValueError):
+                pass
+            conn.sock.close()
+            return False
+        conn.rbuf.extend(chunk)
+        any_pkt = False
+        while self._try_extract(conn):
+            any_pkt = True
+        return any_pkt
+
+    def _try_extract(self, conn: _Conn) -> bool:
+        buf = conn.rbuf
+        if conn.need is None:
+            if len(buf) < 4:
+                return False
+            hlen = _LEN.unpack_from(buf, 0)[0]
+            if len(buf) < 4 + hlen:
+                return False
+            hdr, plen = pickle.loads(bytes(buf[4:4 + hlen]))
+            del buf[:4 + hlen]
+            conn.need = (hdr, plen)
+        hdr, plen = conn.need
+        if len(buf) < plen:
+            return False
+        payload = np.frombuffer(bytes(buf[:plen]), dtype=np.uint8) \
+            if plen else None
+        del buf[:plen]
+        conn.need = None
+        pkt = Packet.from_header(hdr, payload)
+        self.engine.enqueue_incoming(pkt)
+        return True
+
+    # -- progress ---------------------------------------------------------
+    def poll(self) -> bool:
+        if self._closed:
+            return False
+        did = False
+        for key, _ in self.sel.select(timeout=0):
+            data = key.data
+            if data == "accept":
+                try:
+                    s, _ = self.listener.accept()
+                except OSError:
+                    continue
+                s.setblocking(False)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                conn = _Conn(s)
+                self._in.append(conn)
+                self.sel.register(s, selectors.EVENT_READ, ("in", conn))
+                did = True
+            else:
+                _, conn = data
+                if self._on_readable(conn):
+                    did = True
+        for conn in self._out.values():
+            if conn.outq:
+                self._flush(conn)
+                did = True
+        return did
+
+    def wait_for_event(self, timeout: float) -> None:
+        self.sel.select(timeout=timeout)
+
+    def close(self) -> None:
+        # flush best-effort before teardown
+        import time
+        deadline = time.monotonic() + 2.0
+        while any(c.outq for c in self._out.values()) and \
+                time.monotonic() < deadline:
+            for c in self._out.values():
+                self._flush(c)
+        self._closed = True
+        for conn in list(self._out.values()) + self._in:
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+        try:
+            self.listener.close()
+            self.sel.close()
+        except OSError:
+            pass

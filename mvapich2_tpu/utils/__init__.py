@@ -1,0 +1,3 @@
+from .config import cvar, get_config, Config
+from .mlog import get_logger, set_level
+from .handles import HandlePool

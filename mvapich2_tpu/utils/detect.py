@@ -1,0 +1,75 @@
+"""Platform / accelerator detection.
+
+Analog of the reference's arch/HCA detection (SURVEY §2.5:
+common/src/detect/arch/mv2_arch_detect.c) which keys the collective tuning
+tables. Here the "arch × HCA" key becomes "tpu generation × topology", and we
+detect it from JAX lazily (JAX import is deferred so that host-only rank
+processes never touch the accelerator runtime).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class PlatformInfo:
+    platform: str          # "tpu" | "cpu" | "gpu"
+    device_kind: str       # e.g. "TPU v5 lite0"
+    num_devices: int
+    num_processes: int
+    # Rough per-link ICI bandwidth in GB/s (one direction), used by tuning
+    # tables to pick crossovers and by bench to compute vs_baseline.
+    ici_bw_gbps: float
+    hbm_bw_gbps: float
+
+
+# Published peak numbers per TPU generation (GB/s). These play the role of
+# the per-arch constant tables in ibv_param.c:2354-2361 — they seed tuning
+# defaults; measured profiles override them.
+_TPU_SPECS = {
+    # substring key: (ici per-link GB/s one-dir, hbm GB/s)
+    "v5 lite": (400.0, 819.0),     # v5e: 400 GB/s per chip interconnect, 819 GB/s HBM
+    "v5e": (400.0, 819.0),
+    "v5p": (600.0, 2765.0),        # v5p: 4800 Gbps ICI per chip ~ 600GB/s, 2.77 TB/s HBM
+    "v4": (300.0, 1228.0),
+    "v6": (896.0, 1640.0),         # trillium
+    "v3": (162.0, 900.0),
+    "v2": (124.0, 700.0),
+}
+
+
+def _lookup_tpu_spec(device_kind: str):
+    dk = device_kind.lower()
+    for key, spec in _TPU_SPECS.items():
+        if key in dk:
+            return spec
+    return (300.0, 819.0)
+
+
+@functools.lru_cache(maxsize=1)
+def detect() -> PlatformInfo:
+    try:
+        import jax
+        devs = jax.devices()
+        platform = devs[0].platform
+        kind = getattr(devs[0], "device_kind", platform)
+        nproc = getattr(jax, "process_count", lambda: 1)()
+        ndev = len(devs)
+    except Exception:
+        platform, kind, ndev, nproc = "cpu", "cpu", 1, 1
+    if platform in ("tpu", "axon"):
+        ici, hbm = _lookup_tpu_spec(kind)
+    else:
+        ici, hbm = (10.0, 50.0)  # host shm-ish numbers for the CPU mesh
+    return PlatformInfo(platform=platform, device_kind=kind,
+                        num_devices=ndev, num_processes=nproc,
+                        ici_bw_gbps=ici, hbm_bw_gbps=hbm)
+
+
+def arch_key() -> str:
+    """Tuning-table key, analog of mv2_arch_hca_type."""
+    info = detect()
+    return f"{info.platform}:{info.device_kind}:{info.num_devices}"

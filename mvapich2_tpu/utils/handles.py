@@ -1,0 +1,91 @@
+"""MPI-style handle pools.
+
+Analog of the reference's handle allocator (src/util/mem/handlemem.c:408-433,
+SURVEY §2.5): MPI objects (comms, datatypes, requests, ops, wins, ...) are
+identified by bit-packed integer handles mapping into object pools with free
+lists. We keep the same shape — a handle is ``(kind << KIND_SHIFT) | index`` —
+so that a future C-ABI shim can hand plain ints across the boundary, while
+Python code can also pass the objects themselves.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+KIND_SHIFT = 24
+KIND_MASK = 0xFF << KIND_SHIFT
+INDEX_MASK = (1 << KIND_SHIFT) - 1
+
+# Handle kinds (reference: MPID_Comm etc. kind bits, handlemem.c:226,320)
+KIND_COMM = 1
+KIND_GROUP = 2
+KIND_DATATYPE = 3
+KIND_REQUEST = 4
+KIND_OP = 5
+KIND_ERRHANDLER = 6
+KIND_INFO = 7
+KIND_WIN = 8
+KIND_FILE = 9
+KIND_KEYVAL = 10
+KIND_SESSION = 11
+
+HANDLE_NULL = 0
+
+
+class HandlePool:
+    """Object pool with free-list for one handle kind."""
+
+    def __init__(self, kind: int):
+        self.kind = kind
+        self._objs: List[Optional[Any]] = [None]  # index 0 reserved (NULL)
+        self._free: List[int] = []
+        self._lock = threading.Lock()
+
+    def alloc(self, obj: Any) -> int:
+        with self._lock:
+            if self._free:
+                idx = self._free.pop()
+                self._objs[idx] = obj
+            else:
+                idx = len(self._objs)
+                self._objs.append(obj)
+            handle = (self.kind << KIND_SHIFT) | idx
+            return handle
+
+    def lookup(self, handle: int) -> Any:
+        if handle == HANDLE_NULL:
+            raise KeyError("null handle")
+        kind = (handle & KIND_MASK) >> KIND_SHIFT
+        if kind != self.kind:
+            raise KeyError(f"handle kind mismatch: {kind} != {self.kind}")
+        idx = handle & INDEX_MASK
+        with self._lock:
+            obj = self._objs[idx] if idx < len(self._objs) else None
+        if obj is None:
+            raise KeyError(f"stale handle {handle:#x}")
+        return obj
+
+    def free(self, handle: int) -> None:
+        idx = handle & INDEX_MASK
+        with self._lock:
+            if 0 < idx < len(self._objs) and self._objs[idx] is not None:
+                self._objs[idx] = None
+                self._free.append(idx)
+
+    def live_count(self) -> int:
+        """Outstanding objects — used by the leak-check at Finalize
+        (the analog of mtest.c's resource-leak summary)."""
+        with self._lock:
+            return sum(1 for i, o in enumerate(self._objs) if i and o is not None)
+
+
+_pools: Dict[int, HandlePool] = {}
+_pools_lock = threading.Lock()
+
+
+def pool(kind: int) -> HandlePool:
+    with _pools_lock:
+        if kind not in _pools:
+            _pools[kind] = HandlePool(kind)
+        return _pools[kind]

@@ -1,0 +1,87 @@
+"""Leveled, per-subsystem debug logging.
+
+Analog of the reference's debug_utils.c (SURVEY §5.5): 20+ subsystem
+verbosity switches set from ``MV2_DEBUG_*`` env vars with ``PRINT_DEBUG``
+macros at call sites. Here: ``MV2T_DEBUG_<SUBSYS>=<level>`` env vars and
+cheap ``log.dbg(level, ...)`` guards.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Dict
+
+_SUBSYS_LEVELS: Dict[str, int] = {}
+_lock = threading.RLock()
+_t0 = time.monotonic()
+
+
+def _level_for(subsys: str) -> int:
+    with _lock:
+        if subsys not in _SUBSYS_LEVELS:
+            raw = os.environ.get(f"MV2T_DEBUG_{subsys.upper()}",
+                                 os.environ.get("MV2T_DEBUG_LEVEL", "0"))
+            try:
+                _SUBSYS_LEVELS[subsys] = int(raw)
+            except ValueError:
+                _SUBSYS_LEVELS[subsys] = 0
+        return _SUBSYS_LEVELS[subsys]
+
+
+def set_level(subsys: str, level: int) -> None:
+    with _lock:
+        _SUBSYS_LEVELS[subsys] = level
+
+
+class Logger:
+    """Per-subsystem logger. Zero cost when the subsystem level is 0."""
+
+    __slots__ = ("subsys", "_level", "_rank")
+
+    def __init__(self, subsys: str):
+        self.subsys = subsys
+        self._level = _level_for(subsys)
+        self._rank = None
+
+    @property
+    def level(self) -> int:
+        return self._level
+
+    def refresh(self) -> None:
+        self._level = _level_for(self.subsys)
+
+    def _emit(self, tag: str, msg: str) -> None:
+        rank = self._rank
+        if rank is None:
+            rank = os.environ.get("MV2T_RANK", "?")
+            self._rank = rank
+        t = time.monotonic() - _t0
+        sys.stderr.write(f"[{t:10.6f}] [{tag}] [rank {rank}] "
+                         f"[{self.subsys}] {msg}\n")
+
+    def dbg(self, level: int, msg: str, *args) -> None:
+        if self._level >= level:
+            self._emit("D", msg % args if args else msg)
+
+    def info(self, msg: str, *args) -> None:
+        if self._level >= 1:
+            self._emit("I", msg % args if args else msg)
+
+    def warn(self, msg: str, *args) -> None:
+        self._emit("W", msg % args if args else msg)
+
+    def error(self, msg: str, *args) -> None:
+        self._emit("E", msg % args if args else msg)
+
+
+_loggers: Dict[str, Logger] = {}
+
+
+def get_logger(subsys: str) -> Logger:
+    with _lock:
+        if subsys not in _loggers:
+            _loggers[subsys] = Logger(subsys)
+    return _loggers[subsys]

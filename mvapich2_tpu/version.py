@@ -1,0 +1,13 @@
+"""Version and build info.
+
+Analog of the reference's `mpichversion` / `mpiname` build-info tools
+(/root/reference/src/env/), exposed programmatically.
+"""
+
+VERSION = "0.1.0"
+MPI_STANDARD = "3.1-subset"
+FRAMEWORK_NAME = "mvapich2-tpu"
+
+
+def version_string() -> str:
+    return f"{FRAMEWORK_NAME} {VERSION} (MPI {MPI_STANDARD}, TPU-native/JAX-XLA)"

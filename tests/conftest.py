@@ -1,0 +1,17 @@
+"""Test harness configuration.
+
+Multi-chip paths are tested on a virtual 8-device CPU mesh
+(xla_force_host_platform_device_count) — the analog of the reference suite
+running N ranks on localhost (SURVEY §4: "no fake backend; N processes on
+localhost"). Env must be set before jax is first imported.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+# keep CI deterministic and quiet
+os.environ.setdefault("JAX_ENABLE_X64", "0")

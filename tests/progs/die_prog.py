@@ -1,0 +1,16 @@
+"""FT test helper: rank 1 dies mid-job (the analog of test/mpi/ft/die.c)."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+from mvapich2_tpu import mpi  # noqa: E402
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+if comm.rank == 1:
+    os._exit(3)
+# surviving ranks hang around; launcher must kill the job
+time.sleep(30)
+sys.exit(0)

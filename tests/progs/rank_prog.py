@@ -1,0 +1,56 @@
+"""Process-mode smoke program: the 'prints No Errors' contract (SURVEY §4).
+
+Launched by tests via: python -m mvapich2_tpu.run -np N tests/progs/rank_prog.py
+"""
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from mvapich2_tpu import mpi  # noqa: E402
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+rank, size = comm.rank, comm.size
+
+errs = 0
+
+# pt2pt ring shift, eager
+mine = np.array([rank], np.int64)
+got = np.zeros(1, np.int64)
+comm.sendrecv(mine, (rank + 1) % size, 0, got, (rank - 1) % size, 0)
+if got[0] != (rank - 1) % size:
+    errs += 1
+    print(f"rank {rank}: ring shift wrong: {got[0]}")
+
+# rendezvous-sized pt2pt
+big = np.full(1 << 17, float(rank), np.float64)
+rbig = np.zeros(1 << 17, np.float64)
+comm.sendrecv(big, (rank + 1) % size, 1, rbig, (rank - 1) % size, 1)
+if rbig[0] != float((rank - 1) % size):
+    errs += 1
+    print(f"rank {rank}: big sendrecv wrong")
+
+# collectives
+out = comm.allreduce(np.full(1000, float(rank + 1)))
+if abs(out[0] - sum(range(1, size + 1))) > 1e-9:
+    errs += 1
+    print(f"rank {rank}: allreduce wrong: {out[0]}")
+
+buf = np.arange(64, dtype=np.int32) if rank == 0 else np.zeros(64, np.int32)
+comm.bcast(buf, root=0)
+if buf[10] != 10:
+    errs += 1
+    print(f"rank {rank}: bcast wrong")
+
+gat = comm.allgather(np.array([rank * 7], np.int32))
+if gat.tolist() != [r * 7 for r in range(size)]:
+    errs += 1
+    print(f"rank {rank}: allgather wrong: {gat}")
+
+comm.barrier()
+if rank == 0 and errs == 0:
+    print("No Errors")
+mpi.Finalize()
+sys.exit(1 if errs else 0)

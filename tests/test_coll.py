@@ -1,0 +1,277 @@
+"""Collective tests vs numpy references across algorithms and sizes
+(mirrors test/mpi/coll/ — 91 tests in the reference suite)."""
+
+import numpy as np
+import pytest
+
+from mvapich2_tpu import run_ranks
+from mvapich2_tpu.coll import IN_PLACE
+from mvapich2_tpu.coll import tuning
+from mvapich2_tpu.core import op as opmod
+from mvapich2_tpu.utils.config import get_config
+
+SIZES = [4, 5, 8]  # pof2 and non-pof2 comm sizes
+COUNTS = [1, 7, 1024, 20000]  # eager and rendezvous territory
+
+
+@pytest.mark.parametrize("nranks", SIZES)
+@pytest.mark.parametrize("count", COUNTS)
+@pytest.mark.parametrize("algo", ["rd", "rsa", "ring", "gather_bcast"])
+def test_allreduce_algorithms(nranks, count, algo):
+    def fn(comm):
+        sb = (np.arange(count, dtype=np.float64) + comm.rank)
+        rb = comm.allreduce(sb)
+        expected = (np.arange(count, dtype=np.float64) * comm.size
+                    + sum(range(comm.size)))
+        np.testing.assert_allclose(rb, expected)
+    cfg = get_config()
+    cfg.set("ALLREDUCE_ALGO", algo)
+    try:
+        run_ranks(nranks, fn)
+    finally:
+        cfg.set("ALLREDUCE_ALGO", "")
+
+
+@pytest.mark.parametrize("nranks", SIZES)
+@pytest.mark.parametrize("count", COUNTS)
+@pytest.mark.parametrize("algo", ["binomial", "scatter_ring_allgather"])
+def test_bcast_algorithms(nranks, count, algo):
+    def fn(comm):
+        buf = (np.arange(count, dtype=np.int64) if comm.rank == 2 % comm.size
+               else np.zeros(count, dtype=np.int64))
+        comm.bcast(buf, root=2 % comm.size)
+        np.testing.assert_array_equal(buf, np.arange(count))
+    cfg = get_config()
+    cfg.set("BCAST_ALGO", algo)
+    try:
+        run_ranks(nranks, fn)
+    finally:
+        cfg.set("BCAST_ALGO", "")
+
+
+@pytest.mark.parametrize("nranks", SIZES)
+@pytest.mark.parametrize("count", [1, 100, 5000])
+@pytest.mark.parametrize("algo", ["rd", "bruck", "ring"])
+def test_allgather_algorithms(nranks, count, algo):
+    def fn(comm):
+        sb = np.full(count, comm.rank, np.int32)
+        rb = comm.allgather(sb)
+        expected = np.repeat(np.arange(comm.size, dtype=np.int32), count)
+        np.testing.assert_array_equal(rb, expected)
+    cfg = get_config()
+    cfg.set("ALLGATHER_ALGO", algo)
+    try:
+        run_ranks(nranks, fn)
+    finally:
+        cfg.set("ALLGATHER_ALGO", "")
+
+
+@pytest.mark.parametrize("nranks", SIZES)
+@pytest.mark.parametrize("count", [1, 64, 3000])
+@pytest.mark.parametrize("algo", ["bruck", "scattered", "pairwise"])
+def test_alltoall_algorithms(nranks, count, algo):
+    def fn(comm):
+        sb = np.arange(comm.size * count, dtype=np.int32) + \
+            comm.rank * 1000000
+        rb = comm.alltoall(sb)
+        for src in range(comm.size):
+            blk = rb[src * count:(src + 1) * count]
+            expected = (np.arange(comm.rank * count, (comm.rank + 1) * count,
+                                  dtype=np.int32) + src * 1000000)
+            np.testing.assert_array_equal(blk, expected)
+    cfg = get_config()
+    cfg.set("ALLTOALL_ALGO", algo)
+    try:
+        run_ranks(nranks, fn)
+    finally:
+        cfg.set("ALLTOALL_ALGO", "")
+
+
+@pytest.mark.parametrize("nranks", SIZES)
+def test_reduce(nranks):
+    def fn(comm):
+        sb = np.full(100, comm.rank + 1, np.float64)
+        rb = comm.reduce(sb, root=1 % comm.size)
+        if comm.rank == 1 % comm.size:
+            total = sum(range(1, comm.size + 1))
+            np.testing.assert_allclose(rb, total)
+    run_ranks(nranks, fn)
+
+
+@pytest.mark.parametrize("nranks", SIZES)
+def test_gather_scatter(nranks):
+    def fn(comm):
+        root = comm.size - 1
+        sb = np.full(4, comm.rank, np.int32)
+        rb = comm.gather(sb, root=root)
+        if comm.rank == root:
+            np.testing.assert_array_equal(
+                rb, np.repeat(np.arange(comm.size, dtype=np.int32), 4))
+        full = (np.repeat(np.arange(comm.size, dtype=np.int32) * 2, 3)
+                if comm.rank == root else None)
+        mine = np.zeros(3, np.int32)
+        comm.scatter(full, mine, root=root)
+        np.testing.assert_array_equal(mine, comm.rank * 2)
+    run_ranks(nranks, fn)
+
+
+@pytest.mark.parametrize("nranks", [4, 6])
+def test_barrier(nranks):
+    import time
+
+    def fn(comm):
+        t0 = time.monotonic()
+        if comm.rank == 0:
+            time.sleep(0.05)
+        comm.barrier()
+        dt_s = time.monotonic() - t0
+        assert dt_s >= 0.045  # nobody leaves before rank 0 arrives
+    run_ranks(nranks, fn)
+
+
+@pytest.mark.parametrize("nranks", SIZES)
+def test_reduce_scatter_block(nranks):
+    def fn(comm):
+        count = 6
+        sb = np.arange(comm.size * count, dtype=np.float64) + comm.rank
+        rb = comm.reduce_scatter_block(sb, count=count)
+        base = np.arange(comm.rank * count, (comm.rank + 1) * count,
+                         dtype=np.float64)
+        expected = base * comm.size + sum(range(comm.size))
+        np.testing.assert_allclose(rb, expected)
+    run_ranks(nranks, fn)
+
+
+@pytest.mark.parametrize("nranks", SIZES)
+def test_scan_exscan(nranks):
+    def fn(comm):
+        sb = np.full(5, comm.rank + 1, np.int64)
+        rb = comm.scan(sb)
+        np.testing.assert_array_equal(rb, sum(range(1, comm.rank + 2)))
+        eb = comm.exscan(sb)
+        if comm.rank > 0:
+            np.testing.assert_array_equal(eb, sum(range(1, comm.rank + 1)))
+    run_ranks(nranks, fn)
+
+
+def test_allgatherv():
+    def fn(comm):
+        counts = [r + 1 for r in range(comm.size)]
+        displs = [sum(counts[:r]) for r in range(comm.size)]
+        sb = np.full(counts[comm.rank], comm.rank, np.int32)
+        rb = np.zeros(sum(counts), np.int32)
+        comm.allgatherv(sb, rb, counts, displs)
+        expected = np.concatenate([np.full(r + 1, r, np.int32)
+                                   for r in range(comm.size)])
+        np.testing.assert_array_equal(rb, expected)
+    run_ranks(5, fn)
+
+
+def test_alltoallv():
+    def fn(comm):
+        p = comm.size
+        scounts = [(comm.rank + d) % p + 1 for d in range(p)]
+        sdispls = [sum(scounts[:i]) for i in range(p)]
+        rcounts = [(s + comm.rank) % p + 1 for s in range(p)]
+        rdispls = [sum(rcounts[:i]) for i in range(p)]
+        sb = np.concatenate([np.full(scounts[d], comm.rank * 100 + d,
+                                     np.int32) for d in range(p)])
+        rb = np.zeros(sum(rcounts), np.int32)
+        comm.alltoallv(sb, scounts, sdispls, rb, rcounts, rdispls)
+        for s in range(p):
+            blk = rb[rdispls[s]:rdispls[s] + rcounts[s]]
+            np.testing.assert_array_equal(blk, s * 100 + comm.rank)
+    run_ranks(4, fn)
+
+
+def test_gatherv_scatterv():
+    def fn(comm):
+        root = 0
+        counts = [2 * (r + 1) for r in range(comm.size)]
+        displs = [sum(counts[:r]) for r in range(comm.size)]
+        sb = np.full(counts[comm.rank], comm.rank + 10, np.int64)
+        rb = np.zeros(sum(counts), np.int64) if comm.rank == root else None
+        comm.gatherv(sb, rb, counts, displs, root=root)
+        if comm.rank == root:
+            expected = np.concatenate([np.full(c, r + 10, np.int64)
+                                       for r, c in enumerate(counts)])
+            np.testing.assert_array_equal(rb, expected)
+        # scatterv back
+        mine = np.zeros(counts[comm.rank], np.int64)
+        comm.scatterv(rb if comm.rank == root else None, counts, displs,
+                      mine, root=root)
+        np.testing.assert_array_equal(mine, comm.rank + 10)
+    run_ranks(4, fn)
+
+
+def test_in_place_allreduce():
+    def fn(comm):
+        buf = np.full(10, float(comm.rank + 1))
+        comm.allreduce(IN_PLACE, buf)
+        np.testing.assert_allclose(buf, sum(range(1, comm.size + 1)))
+    run_ranks(4, fn)
+
+
+def test_ops_min_max_prod():
+    def fn(comm):
+        v = np.array([comm.rank + 1, 10 - comm.rank], np.float64)
+        assert comm.allreduce(v, op=opmod.MAX)[0] == comm.size
+        assert comm.allreduce(v, op=opmod.MIN)[1] == 10 - (comm.size - 1)
+        prod = comm.allreduce(v, op=opmod.PROD)
+        assert prod[0] == np.prod(np.arange(1, comm.size + 1))
+    run_ranks(4, fn)
+
+
+def test_logical_bitwise_ops():
+    def fn(comm):
+        v = np.array([comm.rank % 2, 1], np.int32)
+        assert comm.allreduce(v, op=opmod.LAND)[0] == 0
+        assert comm.allreduce(v, op=opmod.LOR)[0] == 1
+        b = np.array([1 << comm.rank], np.int32)
+        assert comm.allreduce(b, op=opmod.BOR)[0] == (1 << comm.size) - 1
+    run_ranks(4, fn)
+
+
+def test_minloc():
+    def fn(comm):
+        from mvapich2_tpu.core import datatype as dt
+        buf = np.zeros(1, dtype=dt.FLOAT_INT.basic)
+        buf["val"] = float((comm.rank * 3 + 1) % comm.size)
+        buf["loc"] = comm.rank
+        out = comm.allreduce(buf, op=opmod.MINLOC, datatype=dt.FLOAT_INT,
+                             count=1)
+        vals = [(r * 3 + 1) % comm.size for r in range(comm.size)]
+        assert out["val"][0] == min(vals)
+        assert out["loc"][0] == vals.index(min(vals))
+    run_ranks(4, fn)
+
+
+def test_user_op_noncommutative():
+    def fn(comm):
+        # "last nonzero wins" — order matters
+        def f(invec, inout):
+            return inout.copy()
+        myop = opmod.create_op(f, commute=False)
+        v = np.array([comm.rank], np.int32)
+        out = comm.allreduce(v, op=myop)
+        assert out[0] == comm.size - 1  # rightmost operand
+    run_ranks(4, fn)
+
+
+def test_two_level_allreduce_fake_nodes():
+    def fn(comm):
+        sb = np.full(4096, float(comm.rank))
+        rb = comm.allreduce(sb)
+        np.testing.assert_allclose(rb, sum(range(comm.size)))
+    # 8 ranks on 2 fake "nodes" exercises shmem+leader hierarchy
+    run_ranks(8, fn, nodes=[0, 0, 0, 0, 1, 1, 1, 1])
+
+
+def test_two_level_explicit():
+    def fn(comm):
+        from mvapich2_tpu.coll import algorithms as alg
+        arr = np.full(100, float(comm.rank + 1))
+        out = alg.allreduce_two_level(comm, arr, opmod.SUM,
+                                      comm.next_coll_tag())
+        np.testing.assert_allclose(out, sum(range(1, comm.size + 1)))
+    run_ranks(6, fn, nodes=[0, 0, 0, 1, 1, 1])

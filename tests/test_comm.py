@@ -1,0 +1,121 @@
+"""Communicator management tests (mirrors test/mpi/comm/)."""
+
+import numpy as np
+import pytest
+
+from mvapich2_tpu import run_ranks
+from mvapich2_tpu.core.attr import Keyval
+
+
+def test_dup_isolated_context():
+    def fn(comm):
+        dup = comm.dup()
+        assert dup.size == comm.size and dup.rank == comm.rank
+        assert dup.context_id != comm.context_id
+        # traffic on dup doesn't collide with comm
+        peer = 1 - comm.rank
+        a = np.array([1], np.int32)
+        b = np.array([2], np.int32)
+        ra = np.zeros(1, np.int32)
+        rb = np.zeros(1, np.int32)
+        r1 = comm.irecv(ra, source=peer, tag=0)
+        r2 = dup.irecv(rb, source=peer, tag=0)
+        dup.send(b, dest=peer, tag=0)
+        comm.send(a, dest=peer, tag=0)
+        r1.wait(); r2.wait()
+        assert ra[0] == 1 and rb[0] == 2
+        dup.free()
+    run_ranks(2, fn)
+
+
+def test_split():
+    def fn(comm):
+        color = comm.rank % 2
+        sub = comm.split(color, key=comm.rank)
+        assert sub.size == comm.size // 2
+        rb = sub.allgather(np.array([comm.rank], np.int32))
+        np.testing.assert_array_equal(rb, np.arange(color, comm.size, 2))
+    run_ranks(8, fn)
+
+
+def test_split_undefined():
+    def fn(comm):
+        sub = comm.split(None if comm.rank == 0 else 5)
+        if comm.rank == 0:
+            assert sub is None
+        else:
+            assert sub.size == comm.size - 1
+    run_ranks(4, fn)
+
+
+def test_split_key_reorders():
+    def fn(comm):
+        sub = comm.split(0, key=-comm.rank)  # reverse order
+        assert sub.rank == comm.size - 1 - comm.rank
+    run_ranks(4, fn)
+
+
+def test_comm_create():
+    def fn(comm):
+        g = comm.group if hasattr(comm, 'group') else None
+        sub_group = comm.group.incl([0, 2])
+        sub = comm.create(sub_group)
+        if comm.rank in (0, 2):
+            assert sub.size == 2
+            out = sub.allgather(np.array([comm.rank], np.int32))
+            np.testing.assert_array_equal(out, [0, 2])
+        else:
+            assert sub is None
+    run_ranks(4, fn)
+
+
+def test_split_type_shared():
+    def fn(comm):
+        node = comm.split_type_shared()
+        assert node.size == 4
+        me = comm.rank
+        out = node.allgather(np.array([me], np.int32))
+        base = (me // 4) * 4
+        np.testing.assert_array_equal(out, np.arange(base, base + 4))
+    run_ranks(8, fn, nodes=[0, 0, 0, 0, 1, 1, 1, 1])
+
+
+def test_attributes():
+    def fn(comm):
+        copies = []
+        deletes = []
+        kv = Keyval(
+            copy_fn=lambda obj, k, extra, val: (copies.append(val) or
+                                                (True, val * 2)),
+            delete_fn=lambda obj, k, val, extra: deletes.append(val))
+        comm.attrs.set(comm, kv, 21)
+        found, val = comm.attrs.get(kv)
+        assert found and val == 21
+        dup = comm.dup()
+        found, val = dup.attrs.get(kv)
+        assert found and val == 42
+        dup.free()
+        assert 42 in deletes
+        comm.attrs.delete(comm, kv)
+        found, _ = comm.attrs.get(kv)
+        assert not found
+    run_ranks(2, fn)
+
+
+def test_compare():
+    def fn(comm):
+        dup = comm.dup()
+        assert comm.compare(comm) == "ident"
+        assert comm.compare(dup) == "congruent"
+    run_ranks(2, fn)
+
+
+def test_2level_build():
+    def fn(comm):
+        shmem, leader = comm.build_2level()
+        assert shmem.size == 2
+        if comm.rank % 2 == 0:
+            assert leader is not None and leader.size == 3
+        else:
+            assert leader is None
+    run_ranks(6, fn, nodes=[0, 0, 1, 1, 2, 2])

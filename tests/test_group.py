@@ -1,0 +1,51 @@
+import pytest
+
+from mvapich2_tpu.core.group import Group, GROUP_EMPTY
+from mvapich2_tpu.core.status import UNDEFINED
+
+
+def test_basic():
+    g = Group(range(8))
+    assert g.size == 8
+    assert g.world_of_rank(3) == 3
+    assert g.rank_of_world(5) == 5
+
+
+def test_incl_excl():
+    g = Group(range(8))
+    gi = g.incl([1, 3, 5])
+    assert gi.world_ranks == (1, 3, 5)
+    ge = g.excl([0, 7])
+    assert ge.world_ranks == tuple(range(1, 7))
+
+
+def test_set_ops():
+    a = Group([0, 1, 2, 3])
+    b = Group([2, 3, 4, 5])
+    assert a.union(b).world_ranks == (0, 1, 2, 3, 4, 5)
+    assert a.intersection(b).world_ranks == (2, 3)
+    assert a.difference(b).world_ranks == (0, 1)
+
+
+def test_translate():
+    a = Group([0, 1, 2, 3])
+    b = Group([3, 2, 1, 0])
+    assert a.translate_ranks([0, 3], b) == [3, 0]
+    c = Group([5, 6])
+    assert a.translate_ranks([1], c) == [UNDEFINED]
+
+
+def test_range_incl():
+    g = Group(range(10))
+    gr = g.range_incl([(0, 8, 2)])
+    assert gr.world_ranks == (0, 2, 4, 6, 8)
+    gr2 = g.range_incl([(9, 5, -2)])
+    assert gr2.world_ranks == (9, 7, 5)
+
+
+def test_compare():
+    a = Group([0, 1, 2])
+    assert a.compare(Group([0, 1, 2])) == "ident"
+    assert a.compare(Group([2, 1, 0])) == "similar"
+    assert a.compare(Group([0, 1])) == "unequal"
+    assert GROUP_EMPTY.size == 0

@@ -1,0 +1,54 @@
+"""Process-mode tests: KVS bootstrap + mpirun launcher + TCP channel
+(mirrors the reference's runtests driver contract: exit 0 + 'No Errors')."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROG = os.path.join(REPO, "tests", "progs", "rank_prog.py")
+
+
+def _run(np_, extra=None, timeout=120):
+    cmd = [sys.executable, "-m", "mvapich2_tpu.run", "-np", str(np_)]
+    if extra:
+        cmd.extend(extra)
+    cmd.extend([sys.executable, PROG])
+    return subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                          timeout=timeout)
+
+
+@pytest.mark.parametrize("np_", [2, 4])
+def test_mpirun_rank_prog(np_):
+    r = _run(np_)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+
+
+def test_mpirun_fake_nodes_two_level():
+    r = _run(4, extra=["--fake-nodes", "0,0,1,1"])
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+
+
+def test_mpirun_failing_rank_kills_job():
+    prog = os.path.join(REPO, "tests", "progs", "die_prog.py")
+    cmd = [sys.executable, "-m", "mvapich2_tpu.run", "-np", "2",
+           sys.executable, prog]
+    r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                       timeout=60)
+    assert r.returncode != 0
+
+
+def test_singleton_init():
+    code = ("import sys; sys.path.insert(0, '.');"
+            "from mvapich2_tpu import mpi; mpi.Init();"
+            "c = mpi.COMM_WORLD; assert c.size == 1;"
+            "import numpy as np;"
+            "assert c.allreduce(np.ones(4))[0] == 1.0;"
+            "mpi.Finalize(); print('No Errors')")
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0 and "No Errors" in r.stdout

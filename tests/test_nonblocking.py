@@ -1,0 +1,71 @@
+"""Nonblocking collective (sched engine) tests."""
+
+import numpy as np
+import pytest
+
+from mvapich2_tpu import run_ranks
+
+
+def test_ibarrier():
+    def fn(comm):
+        req = comm.ibarrier()
+        req.wait()
+    run_ranks(4, fn)
+
+
+def test_ibcast():
+    def fn(comm):
+        buf = (np.arange(1000, dtype=np.float64) if comm.rank == 0
+               else np.zeros(1000))
+        req = comm.ibcast(buf, root=0)
+        req.wait()
+        np.testing.assert_array_equal(buf, np.arange(1000))
+    run_ranks(5, fn)
+
+
+@pytest.mark.parametrize("nranks", [4, 6])
+def test_iallreduce(nranks):
+    def fn(comm):
+        sb = np.full(256, float(comm.rank + 1))
+        rb = np.zeros(256)
+        comm.iallreduce(sb, rb).wait()
+        np.testing.assert_allclose(rb, sum(range(1, comm.size + 1)))
+    run_ranks(nranks, fn)
+
+
+def test_iallgather():
+    def fn(comm):
+        sb = np.full(8, comm.rank, np.int32)
+        rb = np.zeros(8 * comm.size, np.int32)
+        comm.iallgather(sb, rb).wait()
+        np.testing.assert_array_equal(
+            rb, np.repeat(np.arange(comm.size, dtype=np.int32), 8))
+    run_ranks(4, fn)
+
+
+def test_ialltoall():
+    def fn(comm):
+        p = comm.size
+        sb = np.arange(p * 3, dtype=np.int32) + comm.rank * 100
+        rb = np.zeros(p * 3, np.int32)
+        comm.ialltoall(sb, rb).wait()
+        for src in range(p):
+            np.testing.assert_array_equal(
+                rb[src * 3:(src + 1) * 3],
+                np.arange(comm.rank * 3, (comm.rank + 1) * 3) + src * 100)
+    run_ranks(4, fn)
+
+
+def test_overlap_compute():
+    """Nonblocking collective progresses while the rank computes."""
+    def fn(comm):
+        sb = np.full(100000, float(comm.rank))
+        rb = np.zeros(100000)
+        req = comm.iallreduce(sb, rb)
+        acc = 0.0
+        for _ in range(50):
+            acc += float(np.sum(np.ones(1000)))
+        req.wait()
+        np.testing.assert_allclose(rb, sum(range(comm.size)))
+        assert acc == 50000.0
+    run_ranks(4, fn)

@@ -1,0 +1,92 @@
+"""Regression tests: non-commutative user ops must fold in rank order on
+EVERY reduction path (blocking, nonblocking, scan, reduce_scatter), and
+unsupported negative datatype displacements must be rejected loudly."""
+
+import functools
+
+import numpy as np
+import pytest
+
+from mvapich2_tpu import run_ranks
+from mvapich2_tpu.core import datatype as dt
+from mvapich2_tpu.core import op as opmod
+from mvapich2_tpu.core.errors import MPIException
+
+
+def _matmul_op():
+    # 2x2 matrix multiply flattened into 4 doubles — order-sensitive
+    def f(invec, inout):
+        a = invec.reshape(-1, 2, 2)
+        b = inout.reshape(-1, 2, 2)
+        return np.matmul(a, b).reshape(invec.shape)
+    return opmod.create_op(f, commute=False)
+
+
+def _mat(rank, nblk=1):
+    m = np.array([[1.0, rank + 1], [0.0, 1.0]])
+    return np.tile(m.reshape(-1), nblk)
+
+
+def _expected_prefix(upto, nblk=1):
+    acc = np.eye(2)
+    for r in range(upto + 1):
+        acc = acc @ np.array([[1.0, r + 1], [0.0, 1.0]])
+    return np.tile(acc.reshape(-1), nblk)
+
+
+@pytest.mark.parametrize("nranks", [3, 4])
+def test_allreduce_noncommutative(nranks):
+    def fn(comm):
+        out = comm.allreduce(_mat(comm.rank), op=_matmul_op())
+        np.testing.assert_allclose(out, _expected_prefix(comm.size - 1))
+    run_ranks(nranks, fn)
+
+
+@pytest.mark.parametrize("nranks", [3, 4])
+def test_iallreduce_noncommutative(nranks):
+    def fn(comm):
+        rb = np.zeros(4)
+        comm.iallreduce(_mat(comm.rank), rb, op=_matmul_op()).wait()
+        np.testing.assert_allclose(rb, _expected_prefix(comm.size - 1))
+    run_ranks(nranks, fn)
+
+
+@pytest.mark.parametrize("nranks", [3, 4])
+def test_scan_noncommutative(nranks):
+    def fn(comm):
+        out = comm.scan(_mat(comm.rank), op=_matmul_op())
+        np.testing.assert_allclose(out, _expected_prefix(comm.rank))
+    run_ranks(nranks, fn)
+
+
+@pytest.mark.parametrize("nranks", [3, 4])
+def test_exscan_noncommutative(nranks):
+    def fn(comm):
+        out = comm.exscan(_mat(comm.rank), op=_matmul_op())
+        if comm.rank > 0:
+            np.testing.assert_allclose(out, _expected_prefix(comm.rank - 1))
+    run_ranks(nranks, fn)
+
+
+@pytest.mark.parametrize("nranks", [4])
+def test_reduce_scatter_block_noncommutative(nranks):
+    def fn(comm):
+        sb = _mat(comm.rank, nblk=comm.size)
+        rb = comm.reduce_scatter_block(sb, op=_matmul_op(), count=4)
+        np.testing.assert_allclose(rb, _expected_prefix(comm.size - 1))
+    run_ranks(nranks, fn)
+
+
+def test_reduce_noncommutative_nonroot_order():
+    def fn(comm):
+        out = comm.reduce(_mat(comm.rank), op=_matmul_op(), root=2)
+        if comm.rank == 2:
+            np.testing.assert_allclose(out, _expected_prefix(comm.size - 1))
+    run_ranks(4, fn)
+
+
+def test_negative_stride_rejected():
+    with pytest.raises(MPIException):
+        dt.create_vector(2, 1, -1, dt.INT)
+    with pytest.raises(MPIException):
+        dt.create_hindexed([1, 1], [0, -8], dt.DOUBLE)

@@ -1,0 +1,249 @@
+"""Point-to-point tests (mirrors test/mpi/pt2pt/ of the reference suite):
+eager + rendezvous, wildcards, ordering, probe, truncation, persistent."""
+
+import numpy as np
+import pytest
+
+from mvapich2_tpu import run_ranks
+from mvapich2_tpu.core import datatype as dt
+from mvapich2_tpu.core.errors import MPIException, MPI_ERR_TRUNCATE
+from mvapich2_tpu.core.status import ANY_SOURCE, ANY_TAG, PROC_NULL
+from mvapich2_tpu.utils.config import get_config
+
+
+def test_send_recv_eager():
+    def fn(comm):
+        if comm.rank == 0:
+            comm.send(np.arange(10, dtype=np.int32), dest=1, tag=7)
+        elif comm.rank == 1:
+            buf = np.zeros(10, dtype=np.int32)
+            st = comm.recv(buf, source=0, tag=7)
+            np.testing.assert_array_equal(buf, np.arange(10))
+            assert st.source == 0 and st.tag == 7 and st.count == 40
+    run_ranks(2, fn)
+
+
+def test_send_recv_rendezvous_large():
+    n = 1 << 20  # 4 MiB of int32 — far above the eager threshold
+    def fn(comm):
+        if comm.rank == 0:
+            comm.send(np.arange(n, dtype=np.int32), dest=1)
+        elif comm.rank == 1:
+            buf = np.zeros(n, dtype=np.int32)
+            comm.recv(buf, source=0)
+            assert buf[0] == 0 and buf[-1] == n - 1
+            assert buf.sum(dtype=np.int64) == (n - 1) * n // 2
+    run_ranks(2, fn)
+
+
+def test_rput_protocol():
+    n = 1 << 19
+    def fn(comm):
+        if comm.rank == 0:
+            comm.send(np.arange(n, dtype=np.float64), dest=1)
+        else:
+            buf = np.zeros(n, dtype=np.float64)
+            comm.recv(buf, source=0)
+            assert buf[-1] == n - 1
+    cfg = get_config()
+    old = cfg["RNDV_PROTOCOL"]
+    cfg.set("RNDV_PROTOCOL", "RPUT")
+    try:
+        run_ranks(2, fn)
+    finally:
+        cfg.set("RNDV_PROTOCOL", old)
+
+
+def test_any_source_any_tag():
+    def fn(comm):
+        if comm.rank == 0:
+            buf = np.zeros(1, dtype=np.int32)
+            seen = set()
+            for _ in range(comm.size - 1):
+                st = comm.recv(buf, source=ANY_SOURCE, tag=ANY_TAG)
+                assert buf[0] == st.source * 100 + st.tag
+                seen.add(st.source)
+            assert seen == {1, 2, 3}
+        else:
+            comm.send(np.array([comm.rank * 100 + comm.rank], np.int32),
+                      dest=0, tag=comm.rank)
+    run_ranks(4, fn)
+
+
+def test_nonovertaking_order():
+    def fn(comm):
+        if comm.rank == 0:
+            for i in range(50):
+                comm.send(np.array([i], np.int64), dest=1, tag=5)
+        else:
+            buf = np.zeros(1, np.int64)
+            for i in range(50):
+                comm.recv(buf, source=0, tag=5)
+                assert buf[0] == i
+    run_ranks(2, fn)
+
+
+def test_isend_irecv_waitall():
+    def fn(comm):
+        from mvapich2_tpu.core.request import waitall
+        peer = 1 - comm.rank
+        sbuf = np.full(64, comm.rank, np.int32)
+        rbuf = np.zeros(64, np.int32)
+        reqs = [comm.irecv(rbuf, source=peer, tag=1),
+                comm.isend(sbuf, dest=peer, tag=1)]
+        waitall(reqs)
+        assert (rbuf == peer).all()
+    run_ranks(2, fn)
+
+
+def test_sendrecv():
+    def fn(comm):
+        right = (comm.rank + 1) % comm.size
+        left = (comm.rank - 1) % comm.size
+        sbuf = np.array([comm.rank], np.int32)
+        rbuf = np.zeros(1, np.int32)
+        st = comm.sendrecv(sbuf, right, 3, rbuf, left, 3)
+        assert rbuf[0] == left and st.source == left
+    run_ranks(4, fn)
+
+
+def test_sendrecv_replace():
+    def fn(comm):
+        peer = 1 - comm.rank
+        buf = np.array([comm.rank], np.int32)
+        comm.sendrecv_replace(buf, peer, 0, peer, 0)
+        assert buf[0] == peer
+    run_ranks(2, fn)
+
+
+def test_probe_iprobe():
+    def fn(comm):
+        if comm.rank == 0:
+            comm.send(np.arange(5, dtype=np.float64), dest=1, tag=42)
+        else:
+            st = comm.probe(source=0, tag=42)
+            assert st.count == 40 and st.tag == 42
+            buf = np.zeros(st.count // 8, np.float64)
+            comm.recv(buf, source=0, tag=42)
+            assert comm.iprobe(source=0, tag=42) is None
+    run_ranks(2, fn)
+
+
+def test_mprobe_mrecv():
+    def fn(comm):
+        if comm.rank == 0:
+            comm.send(np.array([123], np.int64), dest=1, tag=9)
+        else:
+            msg = None
+            while msg is None:
+                msg = comm.improbe(source=0, tag=9)
+            buf = np.zeros(1, np.int64)
+            st = comm.mrecv(msg, buf)
+            assert buf[0] == 123 and st.source == 0
+    run_ranks(2, fn)
+
+
+def test_truncation_error():
+    def fn(comm):
+        if comm.rank == 0:
+            comm.send(np.arange(10, dtype=np.int32), dest=1)
+        else:
+            buf = np.zeros(5, dtype=np.int32)
+            with pytest.raises(MPIException) as exc:
+                comm.recv(buf, source=0)
+            assert exc.value.error_class == MPI_ERR_TRUNCATE
+            # the first `capacity` bytes still landed
+            np.testing.assert_array_equal(buf, np.arange(5))
+    run_ranks(2, fn)
+
+
+def test_ssend_completes_after_match():
+    def fn(comm):
+        if comm.rank == 0:
+            comm.ssend(np.arange(4, dtype=np.int32), dest=1, tag=2)
+        else:
+            import time
+            time.sleep(0.05)
+            buf = np.zeros(4, np.int32)
+            comm.recv(buf, source=0, tag=2)
+            np.testing.assert_array_equal(buf, np.arange(4))
+    run_ranks(2, fn)
+
+
+def test_proc_null():
+    def fn(comm):
+        comm.send(np.zeros(1, np.int32), dest=PROC_NULL)
+        st = comm.recv(np.zeros(1, np.int32), source=PROC_NULL)
+        assert st.source == PROC_NULL
+    run_ranks(2, fn)
+
+
+def test_self_send():
+    def fn(comm):
+        req = comm.isend(np.array([7], np.int32), dest=comm.rank, tag=0)
+        buf = np.zeros(1, np.int32)
+        comm.recv(buf, source=comm.rank, tag=0)
+        req.wait()
+        assert buf[0] == 7
+    run_ranks(2, fn)
+
+
+def test_persistent_requests():
+    def fn(comm):
+        peer = 1 - comm.rank
+        sbuf = np.zeros(8, np.int32)
+        rbuf = np.zeros(8, np.int32)
+        sreq = comm.send_init(sbuf, dest=peer, tag=4)
+        rreq = comm.recv_init(rbuf, source=peer, tag=4)
+        for it in range(3):
+            sbuf[...] = comm.rank * 10 + it
+            rreq.start()
+            sreq.start()
+            sreq.wait()
+            rreq.wait()
+            assert (rbuf == peer * 10 + it).all()
+    run_ranks(2, fn)
+
+
+def test_derived_datatype_transfer():
+    def fn(comm):
+        t = dt.create_vector(4, 1, 2, dt.INT).commit()
+        if comm.rank == 0:
+            a = np.arange(8, dtype=np.int32)
+            comm.send(a, dest=1, count=1, datatype=t)
+        else:
+            out = np.zeros(8, dtype=np.int32)
+            comm.recv(out, source=0, count=1, datatype=t)
+            np.testing.assert_array_equal(out[::2], [0, 2, 4, 6])
+            assert (out[1::2] == 0).all()
+    run_ranks(2, fn)
+
+
+def test_cancel_recv():
+    def fn(comm):
+        buf = np.zeros(1, np.int32)
+        req = comm.irecv(buf, source=0, tag=99)
+        if comm.rank == 1:
+            req.cancel()
+            st = req.wait()
+            assert st.cancelled
+        else:
+            req.cancel()
+            req.wait()
+    run_ranks(2, fn)
+
+
+def test_waitany():
+    def fn(comm):
+        from mvapich2_tpu.core.request import waitany
+        if comm.rank == 0:
+            comm.send(np.array([1], np.int32), dest=1, tag=11)
+        else:
+            b1 = np.zeros(1, np.int32)
+            b2 = np.zeros(1, np.int32)
+            r1 = comm.irecv(b1, source=0, tag=10)
+            r2 = comm.irecv(b2, source=0, tag=11)
+            idx = waitany([r1, r2])
+            assert idx == 1 and b2[0] == 1
+            r1.cancel()
+    run_ranks(2, fn)
