@@ -1,0 +1,6 @@
+from . import collectives
+from .collectives import (all_gather, all_to_all, allreduce, axis_rank,
+                          axis_size, barrier, bcast, halo_exchange,
+                          moe_shuffle, ppermute, reduce_scatter,
+                          ring_allreduce_manual, ring_shift, scan_axis,
+                          sendrecv_shift)

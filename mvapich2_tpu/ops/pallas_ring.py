@@ -1,0 +1,217 @@
+"""Pallas ring collectives — hand-scheduled ICI kernels.
+
+The pallas analog of the mrail RDMA fast path (SURVEY §3.2:
+MPIDI_CH3I_MRAILI_Fast_rdma_send_complete, gen2/ibv_send_inline.h:493):
+where the reference RDMA-writes into the peer's paired vbuf ring and polls
+head/tail flags, these kernels `make_async_remote_copy` into the neighbor's
+double-buffered VMEM slots and wait on DMA semaphores. Flow control is a
+per-direction credit handshake (the vbuf credit-return of ibv_send.c:
+320-360): each round a shard grants one credit to each neighbor and
+consumes one from each, bounding ring skew to ±1 round so double buffering
+is race-free (verified with the pallas interpret-mode race detector).
+
+They exist (1) as the explicit, schedulable form of the ring collectives
+for cases XLA's fused lowering can't express — fusing the reduction into
+the transfer loop, custom communication/compute interleaving — and (2) as
+the skeleton the ring-attention kernel in models/ follows.
+
+Both kernels are VMEM-resident (shard + 2 comm slots must fit in ~16 MiB);
+callers fall back to lax.psum / lax.all_gather beyond that — the
+eager->rendezvous style crossover, chosen by the tuning layer.
+
+Usage: inside shard_map over a 1-D mesh axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils.mlog import get_logger
+
+log = get_logger("pallas")
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAVE_PALLAS = True
+except ImportError:  # pragma: no cover
+    HAVE_PALLAS = False
+
+# VMEM budget guard: shard + out + 2 slots, leave headroom
+VMEM_LIMIT_BYTES = 4 * 1024 * 1024
+
+FROM_LEFT = 0   # credit slots, indexed by which neighbor granted it
+FROM_RIGHT = 1
+
+
+def _grant_credits(cap_sem, left, right):
+    """Grant one slot-credit to each neighbor (I am my left neighbor's
+    RIGHT, so I bump its FROM_RIGHT slot, and vice versa)."""
+    pltpu.semaphore_signal(cap_sem.at[FROM_RIGHT], inc=1, device_id=left,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(cap_sem.at[FROM_LEFT], inc=1, device_id=right,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+
+def _take_credits(cap_sem):
+    """Consume one credit from each direction — blocks until both
+    neighbors granted this round's slot."""
+    pltpu.semaphore_wait(cap_sem.at[FROM_LEFT], 1)
+    pltpu.semaphore_wait(cap_sem.at[FROM_RIGHT], 1)
+
+
+def _ring_all_gather_kernel(axis_name, num_devices, x_ref, out_ref,
+                            comm_buf, send_sem, recv_sem, cap_sem):
+    my_id = lax.axis_index(axis_name)
+    right = lax.rem(my_id + 1, num_devices)
+    left = lax.rem(my_id - 1 + num_devices, num_devices)
+    chunk = x_ref.shape[0]
+
+    _grant_credits(cap_sem, left, right)   # initial slot availability
+    out_ref[pl.ds(my_id * chunk, chunk)] = x_ref[...]
+    comm_buf[0] = x_ref[...]
+
+    for step in range(num_devices - 1):
+        send_slot = step % 2
+        recv_slot = (step + 1) % 2
+        _take_credits(cap_sem)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm_buf.at[send_slot],
+            dst_ref=comm_buf.at[recv_slot],
+            send_sem=send_sem.at[send_slot],
+            recv_sem=recv_sem.at[recv_slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        src_dev = lax.rem(my_id - step - 1 + num_devices, num_devices)
+        out_ref[pl.ds(src_dev * chunk, chunk)] = comm_buf[recv_slot]
+        _grant_credits(cap_sem, left, right)   # slot consumed: return credit
+    # consume the final grants: also a completion barrier so no neighbor
+    # still has an in-flight write into our buffers at kernel exit
+    _take_credits(cap_sem)
+
+
+def ring_all_gather(x: jax.Array, axis_name: str, num_devices: int,
+                    interpret=False) -> jax.Array:
+    """All-gather along ``axis_name`` via an explicit RDMA ring.
+    ``x``: this shard's block [chunk, ...]; returns [p*chunk, ...]."""
+    if not HAVE_PALLAS or num_devices == 1:
+        return lax.all_gather(x, axis_name, tiled=True)
+    chunk = x.shape[0]
+    out_shape = jax.ShapeDtypeStruct((num_devices * chunk,) + x.shape[1:],
+                                     x.dtype)
+    kernel = functools.partial(_ring_all_gather_kernel, axis_name,
+                               num_devices)
+    return pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk) + x.shape[1:], x.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=7),
+        interpret=interpret,
+    )(x)
+
+
+def _ring_all_reduce_kernel(axis_name, num_devices, x_ref, out_ref,
+                            comm_buf, send_sem, recv_sem, cap_sem):
+    """Reduce-scatter ring + all-gather ring with the reduction fused into
+    the receive path (the SHARP-style in-transit reduce, done in VMEM)."""
+    my_id = lax.axis_index(axis_name)
+    right = lax.rem(my_id + 1, num_devices)
+    left = lax.rem(my_id - 1 + num_devices, num_devices)
+    p = num_devices
+    n = x_ref.shape[0]
+    blk = n // p  # caller guarantees divisibility
+
+    _grant_credits(cap_sem, left, right)
+    out_ref[...] = x_ref[...]
+
+    # Phase 1 (rounds 0..p-2): reduce-scatter — round s passes the partial
+    # of block (my-s-1) rightward and folds the arriving partial into block
+    # (my-s-2); after p-1 rounds block `my_id` is fully reduced (same
+    # convention as reduce_scatter_ring in coll/algorithms.py).
+    for step in range(p - 1):
+        send_blk = lax.rem(my_id - step - 1 + 2 * p, p)
+        recv_blk = lax.rem(my_id - step - 2 + 2 * p, p)
+        send_slot = step % 2
+        recv_slot = (step + 1) % 2
+        _take_credits(cap_sem)
+        comm_buf[send_slot] = out_ref[pl.ds(send_blk * blk, blk)]
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm_buf.at[send_slot],
+            dst_ref=comm_buf.at[recv_slot],
+            send_sem=send_sem.at[send_slot],
+            recv_sem=recv_sem.at[recv_slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        out_ref[pl.ds(recv_blk * blk, blk)] = (
+            out_ref[pl.ds(recv_blk * blk, blk)] + comm_buf[recv_slot])
+        _grant_credits(cap_sem, left, right)
+
+    # Phase 2 (rounds p-1..2p-3): all-gather — round s passes block (my-s)
+    # rightward and receives block (my-s-1). Slot parity continues from
+    # phase 1 so credits and buffers stay consistent.
+    for step in range(p - 1):
+        send_blk = lax.rem(my_id - step + 2 * p, p)
+        recv_blk = lax.rem(my_id - step - 1 + 2 * p, p)
+        send_slot = (p - 1 + step) % 2
+        recv_slot = (p + step) % 2
+        _take_credits(cap_sem)
+        comm_buf[send_slot] = out_ref[pl.ds(send_blk * blk, blk)]
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm_buf.at[send_slot],
+            dst_ref=comm_buf.at[recv_slot],
+            send_sem=send_sem.at[send_slot],
+            recv_sem=recv_sem.at[recv_slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        out_ref[pl.ds(recv_blk * blk, blk)] = comm_buf[recv_slot]
+        _grant_credits(cap_sem, left, right)
+    _take_credits(cap_sem)   # drain final grants; exit-time completion barrier
+
+
+def ring_all_reduce(x: jax.Array, axis_name: str, num_devices: int,
+                    interpret=False) -> jax.Array:
+    """Sum-allreduce along ``axis_name`` via an explicit fused ring.
+    Requires x.shape[0] % num_devices == 0 and VMEM-resident sizes;
+    callers fall back to lax.psum otherwise (the tuning-layer crossover)."""
+    if not HAVE_PALLAS or num_devices == 1:
+        return lax.psum(x, axis_name)
+    p = num_devices
+    if x.shape[0] % p != 0 or x.nbytes > VMEM_LIMIT_BYTES:
+        return lax.psum(x, axis_name)
+    blk = x.shape[0] // p
+    kernel = functools.partial(_ring_all_reduce_kernel, axis_name, p)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, blk) + x.shape[1:], x.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=8),
+        interpret=interpret,
+    )(x)

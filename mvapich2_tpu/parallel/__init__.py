@@ -1,0 +1,2 @@
+from . import mesh
+from .mesh import MeshComm, make_mesh, mesh_shape_for

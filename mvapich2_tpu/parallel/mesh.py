@@ -1,0 +1,152 @@
+"""Device-mesh communicators — binding MPI-style semantics to jax Meshes.
+
+The analog of the reference's rank<->VC binding (SURVEY §3.1: MPIDI_PG /
+VC tables) re-imagined for SPMD: a MeshComm names a mesh axis; "ranks" are
+shards along that axis; collectives are the XLA-native ops from
+mvapich2_tpu.ops. Hierarchical (2-level) communicators map to factored mesh
+axes — intra-host axis over ICI-local devices + inter-host axis over DCN —
+mirroring create_2level_comm's shmem/leader split (create_2level_comm.c:
+57-96) with XLA's per-axis collective lowering doing the topology routing.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import ops
+from ..utils.detect import detect
+from ..utils.mlog import get_logger
+
+log = get_logger("mesh")
+
+shard_map = jax.shard_map if hasattr(jax, "shard_map") else None
+if shard_map is None:  # pragma: no cover — jax < 0.4.35
+    from jax.experimental.shard_map import shard_map as _sm
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None, **kw):
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def mesh_shape_for(n: int, naxes: int = 2) -> Tuple[int, ...]:
+    """Near-square factorization of n devices into naxes axes (the arch
+    detect -> topology-shape step, mv2_arch_detect.c analog)."""
+    if naxes == 1:
+        return (n,)
+    best = (1, n)
+    for a in range(1, int(math.isqrt(n)) + 1):
+        if n % a == 0:
+            best = (a, n // a)
+    if naxes == 2:
+        return best
+    rest = mesh_shape_for(best[1], naxes - 1)
+    return (best[0],) + rest
+
+
+def make_mesh(shape: Optional[Sequence[int]] = None,
+              axis_names: Sequence[str] = ("x",),
+              devices=None) -> Mesh:
+    """Build a Mesh over the available devices (row-major assignment)."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if shape is None:
+        shape = mesh_shape_for(n, len(axis_names))
+    total = math.prod(shape)
+    if total > n:
+        raise ValueError(f"mesh shape {shape} needs {total} devices, "
+                         f"have {n}")
+    arr = np.asarray(devices[:total]).reshape(shape)
+    return Mesh(arr, tuple(axis_names))
+
+
+class MeshComm:
+    """A communicator over one mesh axis (or all axes).
+
+    Inside a jitted/shard_mapped function, methods are the XLA collectives;
+    outside, ``run`` wraps a function in shard_map over the mesh. The
+    ``split``/``sub`` methods mirror MPI_Comm_split along orthogonal axes.
+    """
+
+    def __init__(self, mesh: Mesh, axis: Optional[str] = None):
+        self.mesh = mesh
+        self.axis = axis if axis is not None else mesh.axis_names[0]
+        if self.axis not in mesh.axis_names:
+            raise ValueError(f"axis {self.axis!r} not in {mesh.axis_names}")
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def size(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    def rank(self):
+        """Traced rank along the axis (call inside shard_map)."""
+        return ops.axis_rank(self.axis)
+
+    def sub(self, axis: str) -> "MeshComm":
+        """Communicator over a different axis of the same mesh — the
+        2-level split (e.g. 'host' × 'dcn' axes)."""
+        return MeshComm(self.mesh, axis)
+
+    # -- collectives (inside shard_map) ----------------------------------
+    def allreduce(self, x, op: str = "sum"):
+        return ops.allreduce(x, self.axis, op)
+
+    def bcast(self, x, root: int = 0):
+        return ops.bcast(x, self.axis, root)
+
+    def all_gather(self, x, tiled: bool = False, gather_axis: int = 0):
+        return ops.all_gather(x, self.axis, tiled=tiled,
+                              gather_axis=gather_axis)
+
+    def reduce_scatter(self, x, scatter_dimension: int = 0):
+        return ops.reduce_scatter(x, self.axis,
+                                  scatter_dimension=scatter_dimension)
+
+    def all_to_all(self, x, split_axis: int = 0, concat_axis: int = 0):
+        return ops.all_to_all(x, self.axis, split_axis=split_axis,
+                              concat_axis=concat_axis)
+
+    def ring_shift(self, x, shift: int = 1):
+        return ops.ring_shift(x, self.axis, shift)
+
+    def halo_exchange(self, x, halo: int, dim: int = 0,
+                      periodic: bool = True):
+        return ops.halo_exchange(x, self.axis, halo, dim, periodic)
+
+    def scan(self, x):
+        return ops.scan_axis(x, self.axis)
+
+    def barrier(self, token=None):
+        return ops.barrier(self.axis)
+
+    # -- launching SPMD regions ------------------------------------------
+    def run(self, fn: Callable, *args, in_specs=None, out_specs=None,
+            check_vma: bool = False):
+        """shard_map ``fn`` over the mesh. Default: shard arg dim 0 over
+        this axis; replicate output."""
+        if in_specs is None:
+            in_specs = tuple(P(self.axis) for _ in args)
+        if out_specs is None:
+            out_specs = P(self.axis)
+        wrapped = shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                            out_specs=out_specs, check_vma=check_vma)
+        return wrapped(*args)
+
+    def device_put_sharded(self, x, spec: Optional[P] = None):
+        spec = spec if spec is not None else P(self.axis)
+        return jax.device_put(x, NamedSharding(self.mesh, spec))
+
+    def __repr__(self):
+        return (f"MeshComm(axis={self.axis!r}, size={self.size}, "
+                f"mesh={dict(self.mesh.shape)})")
+
+
+@functools.lru_cache(maxsize=None)
+def default_mesh_comm(naxes: int = 1) -> MeshComm:
+    names = ("x", "y", "z")[:naxes]
+    return MeshComm(make_mesh(axis_names=names))

@@ -8,10 +8,22 @@ localhost"). Env must be set before jax is first imported.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU for the test suite even when the session env points at a real
+# accelerator (e.g. JAX_PLATFORMS=axon, whose sitecustomize overrides the
+# env var — jax.config must be updated post-import): tests need the virtual
+# 8-device mesh. Set MV2T_TEST_ON_TPU=1 to run against real hardware.
+if not os.environ.get("MV2T_TEST_ON_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 # keep CI deterministic and quiet
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+if not os.environ.get("MV2T_TEST_ON_TPU"):
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except ImportError:
+        pass
