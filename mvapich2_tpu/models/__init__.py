@@ -1,0 +1,1 @@
+from . import ring_attention, stencil, transformer

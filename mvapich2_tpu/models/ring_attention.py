@@ -1,0 +1,107 @@
+"""Ring attention: sequence-parallel attention over an ICI ring.
+
+The long-context path (SURVEY §5.7): the reference's contribution to
+sequence scaling is its ring primitive set (MPIR_Allreduce_pt2pt_ring_MV2 /
+MPI_Sendrecv shifts); ring attention is exactly that communication skeleton
+— KV blocks circulate the ring via ppermute while each shard accumulates
+its queries' attention in streaming (flash) form, so sequence length scales
+with the number of shards and communication overlaps compute.
+
+Causal masking across ring steps: at step s this shard (index i) holds the
+KV block that originated at shard j = (i - s) mod p; keys with global
+positions beyond the query's are masked (blockwise for j > i, triangular
+for j == i).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops import ring_shift
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_pos, k_pos, scale, causal):
+    """One KV block's contribution: returns (scores_max, exp_scores@v,
+    exp_scores row-sum) in streaming-softmax form.
+
+    q [T, H, Dh], k/v [Tk, H, Dh]; positions are global token indices."""
+    s = jnp.einsum("thd,khd->htk", q, k) * scale          # [H, T, Tk]
+    if causal:
+        mask = q_pos[None, :, None] >= k_pos[None, None, :]
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)                                # [H, T]
+    p = jnp.exp(s - m[..., None])
+    # fully-masked rows: exp(NEG_INF - NEG_INF) = 1 per element; zero them
+    valid = m > NEG_INF / 2
+    p = jnp.where(valid[..., None], p, 0.0)
+    m = jnp.where(valid, m, NEG_INF)
+    num = jnp.einsum("htk,khd->thd", p, v)                 # [T, H, Dh]
+    den = jnp.sum(p, axis=-1)                              # [H, T]
+    return m, num, den
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True,
+                   scale: Optional[float] = None):
+    """Streaming attention with KV blocks rotating around ``axis_name``.
+
+    q/k/v: [T_local, H, Dh] for this sequence shard. Returns [T_local, H,
+    Dh]. Accumulators are f32 regardless of input dtype."""
+    p = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    T, H, Dh = q.shape
+    scale = scale if scale is not None else Dh ** -0.5
+    q32 = q.astype(jnp.float32)
+
+    q_pos = my * T + jnp.arange(T)
+
+    def step(carry, s):
+        kk, vv, m_acc, num_acc, den_acc = carry
+        j = lax.rem(my - s + p, p)           # origin shard of current block
+        k_pos = j * T + jnp.arange(kk.shape[0])
+        m_blk, num_blk, den_blk = _block_attend(
+            q32, kk.astype(jnp.float32), vv.astype(jnp.float32),
+            q_pos, k_pos, scale, causal)
+        new_m = jnp.maximum(m_acc, m_blk)
+        # rescale previous accumulators and the new block to the new max
+        alpha = jnp.exp(m_acc - new_m)                    # [H, T]
+        beta = jnp.exp(m_blk - new_m)
+        num_acc = (num_acc * alpha.T[..., None]
+                   + num_blk * beta.T[..., None])
+        den_acc = den_acc * alpha + den_blk * beta
+        m_acc = new_m
+        # rotate KV to the right neighbor; at step s+1 I hold block my-s-1
+        kk = ring_shift(kk, axis_name, 1)
+        vv = ring_shift(vv, axis_name, 1)
+        return (kk, vv, m_acc, num_acc, den_acc), None
+
+    m0 = jnp.full((H, T), NEG_INF, jnp.float32)
+    num0 = jnp.zeros((T, H, Dh), jnp.float32)
+    den0 = jnp.zeros((H, T), jnp.float32)
+    (ck, cv, m_f, num_f, den_f), _ = lax.scan(
+        step, (k, v, m0, num0, den0), jnp.arange(p))
+    den_f = jnp.maximum(den_f, 1e-20)
+    out = num_f / den_f.T[..., None]
+    return out.astype(q.dtype)
+
+
+def local_attention_reference(q, k, v, causal: bool = True,
+                              scale: Optional[float] = None):
+    """Dense single-device attention for correctness checks.
+    q/k/v: [T, H, Dh] full sequence."""
+    T, H, Dh = q.shape
+    scale = scale if scale is not None else Dh ** -0.5
+    s = jnp.einsum("thd,khd->htk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+        s = jnp.where(mask[None], s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("htk,khd->thd", w,
+                      v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,291 @@
+"""Flagship model: a transformer trained entirely through the framework's
+collective layer, demonstrating every parallelism axis the reference's
+communication patterns support (SURVEY §5.7):
+
+  dp — data parallel:      gradient psum over the "dp" axis (allreduce —
+                           the north-star collective)
+  sp — sequence parallel:  ring attention (ppermute KV ring)
+  tp — tensor parallel:    column/row-parallel matmuls with psum reduction
+                           (the two-level shmem-reduce analog: tp should
+                           map to the intra-host mesh axis)
+  ep — expert parallel:    MoE FFN with all_to_all token dispatch over the
+                           dp axis (the MoE-shuffle acceptance config)
+
+Everything is shard_map'd over a Mesh("dp", "sp", "tp") — XLA inserts the
+ICI collectives; no hand-rolled transport.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import all_to_all, allreduce
+from ..parallel.mesh import make_mesh, mesh_shape_for, shard_map
+from .ring_attention import ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 8
+    n_layers: int = 2
+    d_ff: int = 256
+    seq_len: int = 128          # global sequence length
+    batch: int = 8              # global batch
+    n_experts: int = 4          # MoE experts (layer 1 only), sharded over dp
+    moe_layer: int = 1          # which layer index uses the MoE FFN
+    dtype: Any = jnp.float32
+    lr: float = 1e-2
+
+
+def param_specs(cfg: Config) -> Dict[str, Any]:
+    """PartitionSpec per parameter: tp-sharded matmuls, ep-sharded experts,
+    everything else replicated (grads psum'd over dp+sp)."""
+    specs = {
+        "emb": P(),
+        "ln_f": P(),
+    }
+    for i in range(cfg.n_layers):
+        L = f"layer_{i}"
+        specs[f"{L}/ln1"] = P()
+        specs[f"{L}/ln2"] = P()
+        specs[f"{L}/wq"] = P(None, "tp")
+        specs[f"{L}/wk"] = P(None, "tp")
+        specs[f"{L}/wv"] = P(None, "tp")
+        specs[f"{L}/wo"] = P("tp", None)
+        if i == cfg.moe_layer:
+            specs[f"{L}/gate"] = P()
+            specs[f"{L}/w1"] = P("dp", None, None)   # experts over ep(=dp)
+            specs[f"{L}/w2"] = P("dp", None, None)
+        else:
+            specs[f"{L}/w1"] = P(None, "tp")
+            specs[f"{L}/w2"] = P("tp", None)
+    return specs
+
+
+def init_params(cfg: Config, key) -> Dict[str, jnp.ndarray]:
+    """Global (unsharded) parameter pytree; shard with param_specs."""
+    ks = jax.random.split(key, 4 + 8 * cfg.n_layers)
+    ki = iter(ks)
+    D, H, F = cfg.d_model, cfg.n_heads, cfg.d_ff
+    Dh = D // H
+    p = {
+        "emb": jax.random.normal(next(ki), (cfg.vocab, D), cfg.dtype) * 0.02,
+        "ln_f": jnp.ones((D,), cfg.dtype),
+    }
+    for i in range(cfg.n_layers):
+        L = f"layer_{i}"
+        p[f"{L}/ln1"] = jnp.ones((D,), cfg.dtype)
+        p[f"{L}/ln2"] = jnp.ones((D,), cfg.dtype)
+        p[f"{L}/wq"] = jax.random.normal(next(ki), (D, D), cfg.dtype) * 0.02
+        p[f"{L}/wk"] = jax.random.normal(next(ki), (D, D), cfg.dtype) * 0.02
+        p[f"{L}/wv"] = jax.random.normal(next(ki), (D, D), cfg.dtype) * 0.02
+        p[f"{L}/wo"] = jax.random.normal(next(ki), (D, D), cfg.dtype) * 0.02
+        if i == cfg.moe_layer:
+            p[f"{L}/gate"] = jax.random.normal(next(ki),
+                                               (D, cfg.n_experts),
+                                               cfg.dtype) * 0.02
+            p[f"{L}/w1"] = jax.random.normal(
+                next(ki), (cfg.n_experts, D, F), cfg.dtype) * 0.02
+            p[f"{L}/w2"] = jax.random.normal(
+                next(ki), (cfg.n_experts, F, D), cfg.dtype) * 0.02
+        else:
+            p[f"{L}/w1"] = jax.random.normal(next(ki), (D, F),
+                                             cfg.dtype) * 0.02
+            p[f"{L}/w2"] = jax.random.normal(next(ki), (F, D),
+                                             cfg.dtype) * 0.02
+    return p
+
+
+def _layernorm(x, g):
+    m = jnp.mean(x, axis=-1, keepdims=True)
+    v = jnp.var(x, axis=-1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + 1e-6) * g
+
+
+def _attention_block(p, L, x, cfg: Config):
+    """Ring attention over sp with heads column-sharded over tp.
+    x: [B_local, T_local, D]."""
+    B, T, D = x.shape
+    h = _layernorm(x, p[f"{L}/ln1"])
+    # local head count = H / tp (wq is [D, D/tp] on this shard)
+    Hl = p[f"{L}/wq"].shape[1] // (D // cfg.n_heads)
+    Dh = D // cfg.n_heads
+    q = jnp.einsum("btd,de->bte", h, p[f"{L}/wq"]).reshape(B, T, Hl, Dh)
+    k = jnp.einsum("btd,de->bte", h, p[f"{L}/wk"]).reshape(B, T, Hl, Dh)
+    v = jnp.einsum("btd,de->bte", h, p[f"{L}/wv"]).reshape(B, T, Hl, Dh)
+    attn = jax.vmap(lambda qq, kk, vv: ring_attention(qq, kk, vv, "sp"))(
+        q, k, v)
+    attn = attn.reshape(B, T, Hl * Dh)
+    out = jnp.einsum("bte,ed->btd", attn, p[f"{L}/wo"])
+    # row-parallel output projection: partial sums reduced over tp — the
+    # intra-host shmem-reduce of the 2-level scheme
+    out = allreduce(out, "tp")
+    return x + out
+
+
+def _dense_ffn(p, L, x):
+    h = _layernorm(x, p[f"{L}/ln2"])
+    h = jax.nn.gelu(jnp.einsum("btd,df->btf", h, p[f"{L}/w1"]))
+    out = jnp.einsum("btf,fd->btd", h, p[f"{L}/w2"])
+    return x + allreduce(out, "tp")
+
+
+def _moe_ffn(p, L, x, cfg: Config):
+    """Top-1 MoE with expert parallelism over the dp axis: tokens are
+    dispatched to their expert's shard via all_to_all (BASELINE config 3's
+    MoE-style shuffle) and return the same way."""
+    B, T, D = x.shape
+    ep = lax.axis_size("dp")
+    E_local = p[f"{L}/w1"].shape[0]          # experts on this shard
+    E = E_local * ep
+    h = _layernorm(x, p[f"{L}/ln2"])
+    tokens = h.reshape(-1, D)                # [N, D]
+    N = tokens.shape[0]
+    gate = jnp.einsum("nd,de->ne", tokens, p[f"{L}/gate"])  # [N, E]
+    expert = jnp.argmax(gate, axis=-1)                       # [N]
+    gate_w = jax.nn.softmax(gate, axis=-1)
+    sel_w = jnp.take_along_axis(gate_w, expert[:, None], axis=1)[:, 0]
+
+    # fixed-capacity dispatch: C slots per (dest shard, local expert)
+    C = max(1, (2 * N) // E)
+    dest_shard = expert // E_local
+    # position of each token within its expert's capacity
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)
+    pos_in_e = jnp.cumsum(onehot, axis=0) * onehot
+    slot = jnp.sum(pos_in_e, axis=-1) - 1                    # [N]
+    keep = slot < C
+    # buffer layout: [ep, E_local, C, D] flattened over first dim for a2a
+    buf = jnp.zeros((ep, E_local, C, D), tokens.dtype)
+    w_buf = jnp.zeros((ep, E_local, C), tokens.dtype)
+    le = expert % E_local
+    buf = buf.at[dest_shard, le, jnp.minimum(slot, C - 1)].add(
+        jnp.where(keep[:, None], tokens, 0.0))
+    w_buf = w_buf.at[dest_shard, le, jnp.minimum(slot, C - 1)].add(
+        jnp.where(keep, sel_w, 0.0))
+    # dispatch: every shard sends its [dest] slab to dest — ICI all_to_all
+    recv = all_to_all(buf.reshape(ep, -1), "dp", split_axis=0,
+                      concat_axis=0, tiled=False)
+    recv = recv.reshape(ep, E_local, C, D)
+    # expert compute on local experts (batched over source shards)
+    hexp = jax.nn.gelu(jnp.einsum("secd,edf->secf", recv, p[f"{L}/w1"]))
+    yexp = jnp.einsum("secf,efd->secd", hexp, p[f"{L}/w2"])
+    # return shuffle
+    back = all_to_all(yexp.reshape(ep, -1), "dp", split_axis=0,
+                      concat_axis=0, tiled=False)
+    back = back.reshape(ep, E_local, C, D)
+    # gather back into token order
+    y = back[dest_shard, le, jnp.minimum(slot, C - 1)]
+    y = jnp.where(keep[:, None], y, 0.0) * sel_w[:, None]
+    return x + y.reshape(B, T, D)
+
+
+def forward(params, tokens, cfg: Config):
+    """tokens: [B_local, T_local] int32 (this shard's batch x seq block).
+    Returns logits [B_local, T_local, vocab]."""
+    x = params["emb"][tokens]
+    for i in range(cfg.n_layers):
+        L = f"layer_{i}"
+        x = _attention_block(params, L, x, cfg)
+        if i == cfg.moe_layer and f"{L}/gate" in params:
+            x = _moe_ffn(params, L, x, cfg)
+        else:
+            x = _dense_ffn(params, L, x)
+    x = _layernorm(x, params["ln_f"])
+    return jnp.einsum("btd,vd->btv", x, params["emb"])
+
+
+def loss_fn(params, tokens, cfg: Config):
+    """Next-token loss on this shard; psum-averaged over dp+sp."""
+    logits = forward(params, tokens, cfg)
+    targets = jnp.roll(tokens, -1, axis=1)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    local = jnp.mean(nll[:, :-1])
+    return lax.pmean(local, ("dp", "sp"))
+
+
+def make_train_step(cfg: Config, mesh: Mesh):
+    """Returns (jitted step fn, sharded-init fn). The step runs fully
+    inside shard_map: grads psum over dp+sp (the gradient allreduce — the
+    north-star collective), SGD update, new params out."""
+    specs = param_specs(cfg)
+
+    def spec_of(name):
+        return specs[name]
+
+    in_param_specs = {k: specs[k] for k in specs}
+
+    def sharded_step(params, tokens):
+        def step(params, tokens):
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+            # replicated params: sum contributions over dp and sp;
+            # tp/ep-sharded params hold distinct slices — their grads are
+            # reduced over the axes they're replicated on only.
+            def sync(name, g):
+                spec = specs[name]
+                axes_used = {a for part in spec if part
+                             for a in ((part,) if isinstance(part, str)
+                                       else part)}
+                reduce_over = tuple(a for a in ("dp", "sp", "tp")
+                                    if a not in axes_used)
+                return lax.psum(g, reduce_over) if reduce_over else g
+            grads = {k: sync(k, g) for k, g in grads.items()}
+            new_params = jax.tree.map(lambda p, g: p - cfg.lr * g,
+                                      params, grads)
+            return new_params, loss
+
+        fn = shard_map(
+            step, mesh=mesh,
+            in_specs=(in_param_specs, P("dp", "sp")),
+            out_specs=(in_param_specs, P()),
+            check_vma=False)
+        return fn(params, tokens)
+
+    return jax.jit(sharded_step)
+
+
+def shard_params(params, cfg: Config, mesh: Mesh):
+    specs = param_specs(cfg)
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}
+
+
+def demo_setup(cfg: Optional[Config] = None,
+               mesh_shape: Optional[Tuple[int, int, int]] = None,
+               devices=None):
+    """Build (cfg, mesh, params, tokens, step_fn) over available devices."""
+    cfg = cfg or Config()
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if mesh_shape is None:
+        # prefer sp over tp over dp for small device counts
+        if n == 1:
+            mesh_shape = (1, 1, 1)
+        elif n == 2:
+            mesh_shape = (1, 2, 1)
+        elif n == 4:
+            mesh_shape = (1, 2, 2)
+        elif n == 8:
+            mesh_shape = (2, 2, 2)
+        else:
+            a = mesh_shape_for(n, 2)
+            mesh_shape = (1, a[0], a[1])
+    mesh = make_mesh(mesh_shape, ("dp", "sp", "tp"), devices)
+    key = jax.random.PRNGKey(0)
+    params = init_params(cfg, key)
+    params = shard_params(params, cfg, mesh)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (cfg.batch,
+                                cfg.seq_len), 0, cfg.vocab, jnp.int32)
+    tokens = jax.device_put(tokens, NamedSharding(mesh, P("dp", "sp")))
+    step = make_train_step(cfg, mesh)
+    return cfg, mesh, params, tokens, step

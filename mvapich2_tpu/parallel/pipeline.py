@@ -1,0 +1,56 @@
+"""Pipeline parallelism over a mesh axis (GPipe-style).
+
+Stages are shards along the "pp" axis; activations move stage->stage with
+ppermute ring shifts (the ICI neighbor transfer), microbatches streamed so
+all stages fill. This is the pp building block the dryrun exercises; the
+reference analog is the mpispawn tree's neighbor pattern re-purposed as a
+compute pipeline (communication skeleton = MPI_Sendrecv chain).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops import ring_shift
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, micro, axis: str):
+    """Run ``stage_fn(params, x)`` as a pipeline over ``axis``.
+
+    stage_params: this shard's stage parameters.
+    micro: [n_micro, mb, ...] microbatches (same on every stage; only
+    stage 0 injects them).
+    Returns [n_micro, mb, ...] outputs (valid on the LAST stage; other
+    stages return zeros — broadcast with a psum/bcast if needed)."""
+    p = lax.axis_size(axis)
+    stage = lax.axis_index(axis)
+    n_micro = micro.shape[0]
+    mb_shape = micro.shape[1:]
+    ticks = n_micro + p - 1
+
+    outs0 = jnp.zeros((n_micro,) + mb_shape, micro.dtype)
+    carry0 = jnp.zeros(mb_shape, micro.dtype)
+
+    def tick(carry, t):
+        act_in, outs = carry
+        # stage 0 injects microbatch t (while available); others consume
+        # what arrived from the left
+        inject = jnp.where(t < n_micro, t, 0)
+        act = jnp.where(stage == 0, micro[inject], act_in)
+        out = stage_fn(stage_params, act)
+        # last stage emits a result once the pipeline is full
+        emit_idx = t - (p - 1)
+        do_emit = jnp.logical_and(stage == p - 1, emit_idx >= 0)
+        outs = lax.cond(
+            do_emit,
+            lambda o: o.at[jnp.maximum(emit_idx, 0)].set(out),
+            lambda o: o, outs)
+        nxt = ring_shift(out, axis, 1)   # stage i -> i+1 (wrap ignored)
+        return (nxt, outs), None
+
+    (_, outs), _ = lax.scan(tick, (carry0, outs0), jnp.arange(ticks))
+    return outs
