@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""osu_allgather — allgather latency (port of osu_allgather.c)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mvapich2_tpu import mpi
+from mvapich2_tpu.bench import osu_util as u
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+opts = u.options("allgather", default_max=1 << 18, collective=True)
+
+_bufs = {}
+
+
+def run_one(size: int) -> None:
+    if size not in _bufs:
+        _bufs[size] = (np.zeros(size, np.uint8),
+                       np.zeros(size * comm.size, np.uint8))
+    sb, rb = _bufs[size]
+    comm.allgather(sb, rb, count=size)
+
+
+u.collective_latency(comm, "Allgather Latency Test", run_one, opts)
+u.finalize_ok(comm)

@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""osu_allreduce — float32 allreduce latency (port of osu_allreduce.c,
+the north-star benchmark: BASELINE.md row 1)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mvapich2_tpu import mpi
+from mvapich2_tpu.bench import osu_util as u
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+opts = u.options("allreduce", default_max=1 << 20, collective=True)
+
+_bufs = {}
+
+
+def run_one(size: int) -> None:
+    n = max(size // 4, 1)
+    if n not in _bufs:
+        _bufs[n] = (np.ones(n, np.float32), np.empty(n, np.float32))
+    sb, rb = _bufs[n]
+    comm.allreduce(sb, rb)
+
+
+u.collective_latency(comm, "Allreduce Latency Test", run_one, opts)
+u.finalize_ok(comm)

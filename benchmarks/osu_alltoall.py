@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""osu_alltoall — alltoall latency (port of osu_alltoall.c; the MoE-style
+shuffle of BASELINE config 3)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mvapich2_tpu import mpi
+from mvapich2_tpu.bench import osu_util as u
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+opts = u.options("alltoall", default_max=1 << 18, collective=True)
+
+_bufs = {}
+
+
+def run_one(size: int) -> None:
+    if size not in _bufs:
+        _bufs[size] = (np.zeros(size * comm.size, np.uint8),
+                       np.zeros(size * comm.size, np.uint8))
+    sb, rb = _bufs[size]
+    comm.alltoall(sb, rb, count=size)
+
+
+u.collective_latency(comm, "All-to-All Personalized Exchange Latency Test",
+                     run_one, opts)
+u.finalize_ok(comm)

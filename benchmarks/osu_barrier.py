@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""osu_barrier — barrier latency (port of osu_barrier.c)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mvapich2_tpu import mpi
+from mvapich2_tpu.bench import osu_util as u
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+opts = u.options("barrier", default_max=4, collective=True)
+opts.min_size = 4
+opts.max_size = 4
+
+
+def run_one(size: int) -> None:
+    comm.barrier()
+
+
+u.collective_latency(comm, "Barrier Latency Test", run_one, opts)
+u.finalize_ok(comm)

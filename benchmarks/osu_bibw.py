@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""osu_bibw — bidirectional bandwidth (port of osu_bibw.c)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mvapich2_tpu import mpi
+from mvapich2_tpu.bench import osu_util as u
+from mvapich2_tpu.core.request import waitall
+
+WINDOW = 64
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+assert comm.size == 2, "osu_bibw requires exactly 2 ranks"
+opts = u.options("bibw", default_max=1 << 22)
+u.header(comm, "Bi-Directional Bandwidth Test", "Bandwidth (MB/s)")
+
+peer = 1 - comm.rank
+for size in u.sizes(opts):
+    iters = max(10, u.scale_iters(opts, size) // 10)
+    sbuf = np.zeros(size, np.uint8)
+    rbufs = [np.zeros(size, np.uint8) for _ in range(WINDOW)]
+    comm.barrier()
+    for i in range(iters + opts.skip):
+        if i == opts.skip:
+            t0 = mpi.Wtime()
+        rreqs = [comm.irecv(rbufs[w], source=peer, tag=4)
+                 for w in range(WINDOW)]
+        sreqs = [comm.isend(sbuf, dest=peer, tag=4) for _ in range(WINDOW)]
+        waitall(rreqs)
+        waitall(sreqs)
+    total = mpi.Wtime() - t0
+    if comm.rank == 0:
+        mbps = 2.0 * size * WINDOW * iters / total / 1e6
+        print(f"{size:<12} {mbps:>14.2f}")
+        sys.stdout.flush()
+
+u.finalize_ok(comm)

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""osu_bw — unidirectional bandwidth (port of osu_bw.c): a window of
+nonblocking sends answered by one ack per window."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mvapich2_tpu import mpi
+from mvapich2_tpu.bench import osu_util as u
+from mvapich2_tpu.core.request import waitall
+
+WINDOW = 64
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+assert comm.size == 2, "osu_bw requires exactly 2 ranks"
+opts = u.options("bw", default_max=1 << 22)
+u.header(comm, "Bandwidth Test", "Bandwidth (MB/s)")
+
+for size in u.sizes(opts):
+    iters = max(10, u.scale_iters(opts, size) // 10)
+    sbuf = np.zeros(size, np.uint8)
+    rbufs = [np.zeros(size, np.uint8) for _ in range(WINDOW)]
+    ack = np.zeros(1, np.uint8)
+    comm.barrier()
+    if comm.rank == 0:
+        for i in range(iters + opts.skip):
+            if i == opts.skip:
+                t0 = mpi.Wtime()
+            reqs = [comm.isend(sbuf, dest=1, tag=2) for _ in range(WINDOW)]
+            waitall(reqs)
+            comm.recv(ack, source=1, tag=3)
+        total = mpi.Wtime() - t0
+        mbps = size * WINDOW * iters / total / 1e6
+        print(f"{size:<12} {mbps:>14.2f}")
+        sys.stdout.flush()
+    else:
+        for i in range(iters + opts.skip):
+            reqs = [comm.irecv(rbufs[w], source=0, tag=2)
+                    for w in range(WINDOW)]
+            waitall(reqs)
+            comm.send(ack, dest=0, tag=3)
+
+u.finalize_ok(comm)

@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""osu_init — MPI_Init time at scale (port of osu_init.c)."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+t0 = time.perf_counter()
+from mvapich2_tpu import mpi  # noqa: E402
+
+mpi.Init()
+t1 = time.perf_counter()
+comm = mpi.COMM_WORLD
+import numpy as np  # noqa: E402
+
+mine = np.array([(t1 - t0) * 1e3])
+from mvapich2_tpu.core import op as opmod  # noqa: E402
+
+avg = float(comm.allreduce(mine)[0]) / comm.size
+mx = float(comm.allreduce(mine, op=opmod.MAX)[0])
+mn = float(comm.allreduce(mine, op=opmod.MIN)[0])
+if comm.rank == 0:
+    print("# OSU MPI Init Test")
+    print(f"nprocs: {comm.size}, min: {mn:.0f} ms, max: {mx:.0f} ms, "
+          f"avg: {avg:.0f} ms")
+mpi.Finalize()

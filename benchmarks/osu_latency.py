@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""osu_latency — ping-pong latency (port of osu_benchmarks/mpi/pt2pt/
+osu_latency.c; run with: python -m mvapich2_tpu.run -np 2 python
+benchmarks/osu_latency.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mvapich2_tpu import mpi
+from mvapich2_tpu.bench import osu_util as u
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+assert comm.size == 2, "osu_latency requires exactly 2 ranks"
+opts = u.options("latency", default_max=1 << 22)
+u.header(comm, "Latency Test")
+
+for size in u.sizes(opts):
+    iters = u.scale_iters(opts, size)
+    sbuf = np.zeros(size, np.uint8)
+    rbuf = np.zeros(size, np.uint8)
+    comm.barrier()
+    if comm.rank == 0:
+        for i in range(iters + opts.skip):
+            if i == opts.skip:
+                t0 = mpi.Wtime()
+            comm.send(sbuf, dest=1, tag=1)
+            comm.recv(rbuf, source=1, tag=1)
+        total = mpi.Wtime() - t0
+        lat = total / iters / 2 * 1e6
+        print(f"{size:<12} {lat:>12.2f}")
+        sys.stdout.flush()
+    else:
+        for i in range(iters + opts.skip):
+            comm.recv(rbuf, source=0, tag=1)
+            comm.send(sbuf, dest=0, tag=1)
+
+u.finalize_ok(comm)

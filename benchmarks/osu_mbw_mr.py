@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""osu_mbw_mr — multiple-pair bandwidth and message rate (port of
+osu_mbw_mr.c): ranks [0, p/2) send to ranks [p/2, p)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mvapich2_tpu import mpi
+from mvapich2_tpu.bench import osu_util as u
+from mvapich2_tpu.core.request import waitall
+
+WINDOW = 64
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+assert comm.size % 2 == 0, "osu_mbw_mr requires an even number of ranks"
+pairs = comm.size // 2
+opts = u.options("mbw_mr", default_max=1 << 20)
+if comm.rank == 0:
+    print("# OSU MPI Multiple Bandwidth / Message Rate Test")
+    print(f"# [ pairs: {pairs} ] [ window size: {WINDOW} ]")
+    print(f"# {'Size':<10} {'MB/s':>14} {'Messages/s':>16}")
+
+is_sender = comm.rank < pairs
+peer = comm.rank + pairs if is_sender else comm.rank - pairs
+
+for size in u.sizes(opts):
+    iters = max(10, u.scale_iters(opts, size) // 10)
+    sbuf = np.zeros(size, np.uint8)
+    rbufs = [np.zeros(size, np.uint8) for _ in range(WINDOW)]
+    ack = np.zeros(1, np.uint8)
+    comm.barrier()
+    t0 = mpi.Wtime()
+    for i in range(iters + opts.skip):
+        if i == opts.skip:
+            comm.barrier()
+            t0 = mpi.Wtime()
+        if is_sender:
+            reqs = [comm.isend(sbuf, dest=peer, tag=5) for _ in range(WINDOW)]
+            waitall(reqs)
+            comm.recv(ack, source=peer, tag=6)
+        else:
+            reqs = [comm.irecv(rbufs[w], source=peer, tag=5)
+                    for w in range(WINDOW)]
+            waitall(reqs)
+            comm.send(ack, dest=peer, tag=6)
+    total = mpi.Wtime() - t0
+    local = np.array([size * WINDOW * iters / total / 1e6
+                      if is_sender else 0.0])
+    agg = comm.allreduce(local)
+    if comm.rank == 0:
+        mbps = float(agg[0])
+        print(f"{size:<12} {mbps:>14.2f} {mbps * 1e6 / size:>16.0f}")
+        sys.stdout.flush()
+
+u.finalize_ok(comm)

@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""osu_reduce_scatter — reduce_scatter latency (port of
+osu_reduce_scatter.c)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mvapich2_tpu import mpi
+from mvapich2_tpu.bench import osu_util as u
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+opts = u.options("reduce_scatter", default_max=1 << 18, collective=True)
+
+_bufs = {}
+
+
+def run_one(size: int) -> None:
+    n = max(size // 4, comm.size)
+    blk = n // comm.size
+    if n not in _bufs:
+        _bufs[n] = (np.ones(blk * comm.size, np.float32),
+                    np.empty(blk, np.float32))
+    sb, rb = _bufs[n]
+    comm.reduce_scatter_block(sb, rb, count=blk)
+
+
+u.collective_latency(comm, "Reduce-Scatter Latency Test", run_one, opts)
+u.finalize_ok(comm)

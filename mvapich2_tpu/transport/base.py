@@ -101,6 +101,10 @@ class Channel:
     # True if RTS packets may carry a zero-copy handle the receiver can pull
     # from directly (RGET analog). Local/shm channels support this.
     supports_rget = False
+    # True for channels whose progress is pure memory polling (shm): the
+    # engine spins instead of sleeping — the reference's CQ polling
+    # discipline (SURVEY §3.5: "this polling loop is THE cpu hot loop").
+    busy_poll = False
 
     def attach(self, engine) -> None:
         """Bind to the owning rank's progress engine."""
@@ -118,6 +122,12 @@ class Channel:
         early spuriously). Default: busy-poll granularity sleep."""
         import time
         time.sleep(min(timeout, 0.0002))
+
+    def wait_fds(self):
+        """File objects that become readable when this channel has inbound
+        traffic; the engine selects on the union across channels so a
+        blocked rank wakes immediately (doorbells/sockets)."""
+        return []
 
     # -- zero-copy rendezvous hooks (RGET path) ---------------------------
     def expose_buffer(self, array: np.ndarray) -> Any:

@@ -186,6 +186,14 @@ class TcpChannel(Channel):
     def wait_for_event(self, timeout: float) -> None:
         self.sel.select(timeout=timeout)
 
+    def wait_fds(self):
+        if self._closed:
+            return []
+        fds = [self.listener]
+        fds.extend(c.sock for c in self._in)
+        fds.extend(c.sock for c in self._out.values())
+        return fds
+
     def close(self) -> None:
         # flush best-effort before teardown
         import time

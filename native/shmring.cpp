@@ -1,0 +1,166 @@
+// Shared-memory SPSC ring transport — the native intra-node fast path.
+//
+// TPU-native analog of the reference's SMP channel + nemesis cell queues
+// (SURVEY §2.2: ch3_smp_progress.c shared-memory eager ring;
+// nemesis/include/mpid_nem_queue.h lock-free cells): one mmap'd segment per
+// node holds an SPSC byte ring for every ordered (src, dst) rank pair.
+// Producers bump `tail`, consumers bump `head` (release/acquire atomics);
+// messages are length-prefixed, 8-byte aligned, with a wrap marker when a
+// message would straddle the end — the same head/tail flag polling
+// discipline as the mrail RDMA fast-path vbuf ring (ibv_send_inline.h).
+//
+// Build: make -C native   ->  libshmring.so (loaded via ctypes from
+// mvapich2_tpu/transport/shm.py, which also carries a pure-Python fallback
+// implementing this exact layout).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kHeaderBytes = 128;   // per-ring control block
+constexpr uint32_t kWrapMarker = 0xFFFFFFFFu;
+constexpr uint64_t kAlign = 8;
+
+struct RingHdr {
+  std::atomic<uint64_t> head;  // consumer position (bytes, monotonic)
+  std::atomic<uint64_t> tail;  // producer position (bytes, monotonic)
+};
+
+struct Region {
+  uint8_t* base;
+  uint64_t ring_bytes;   // total per-ring size incl. header
+  int nranks;
+  uint64_t map_len;
+  int fd;
+};
+
+inline uint64_t data_bytes(const Region* r) {
+  return r->ring_bytes - kHeaderBytes;
+}
+
+inline RingHdr* hdr(const Region* r, int src, int dst) {
+  uint64_t idx = static_cast<uint64_t>(src) * r->nranks + dst;
+  return reinterpret_cast<RingHdr*>(r->base + idx * r->ring_bytes);
+}
+
+inline uint8_t* data(const Region* r, int src, int dst) {
+  uint64_t idx = static_cast<uint64_t>(src) * r->nranks + dst;
+  return r->base + idx * r->ring_bytes + kHeaderBytes;
+}
+
+inline uint64_t align_up(uint64_t v) { return (v + kAlign - 1) & ~(kAlign - 1); }
+
+}  // namespace
+
+extern "C" {
+
+// Create (create=1) or attach to the node segment. Returns nullptr on error.
+void* sr_attach(const char* path, int nranks, long ring_bytes, int create) {
+  uint64_t rb = static_cast<uint64_t>(ring_bytes);
+  uint64_t total = static_cast<uint64_t>(nranks) * nranks * rb;
+  int flags = create ? (O_CREAT | O_RDWR) : O_RDWR;
+  int fd = ::open(path, flags, 0600);
+  if (fd < 0) return nullptr;
+  if (create && ::ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* mem = ::mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  Region* r = new Region{static_cast<uint8_t*>(mem), rb, nranks, total, fd};
+  if (create) std::memset(mem, 0, total);
+  return r;
+}
+
+// Enqueue one message ([4B len][bytes]) into the (src -> dst) ring.
+// Returns 1 on success, 0 if the ring is full (caller backlogs: the
+// credit-exhausted path of ibv_send.c:941).
+int sr_send(void* handle, int src, int dst, const void* buf, long len_in) {
+  Region* r = static_cast<Region*>(handle);
+  RingHdr* h = hdr(r, src, dst);
+  uint8_t* d = data(r, src, dst);
+  uint64_t cap = data_bytes(r);
+  uint64_t len = static_cast<uint64_t>(len_in);
+  uint64_t need = align_up(4 + len);
+  if (need + kAlign >= cap) return -1;  // message can never fit
+
+  uint64_t head = h->head.load(std::memory_order_acquire);
+  uint64_t tail = h->tail.load(std::memory_order_relaxed);
+  uint64_t used = tail - head;
+  uint64_t pos = tail % cap;
+  uint64_t contig = cap - pos;
+
+  if (contig < need) {
+    // need a wrap marker plus the message at the ring start
+    if (used + contig + need > cap) return 0;
+    if (contig >= 4)
+      *reinterpret_cast<uint32_t*>(d + pos) = kWrapMarker;
+    h->tail.store(tail + contig, std::memory_order_release);
+    tail += contig;
+    pos = 0;
+  } else if (used + need > cap) {
+    return 0;
+  }
+  *reinterpret_cast<uint32_t*>(d + pos) = static_cast<uint32_t>(len);
+  std::memcpy(d + pos + 4, buf, len);
+  h->tail.store(tail + need, std::memory_order_release);
+  return 1;
+}
+
+// Peek the next message length in (src -> dst), or 0 if empty.
+long sr_peek(void* handle, int src, int dst) {
+  Region* r = static_cast<Region*>(handle);
+  RingHdr* h = hdr(r, src, dst);
+  uint8_t* d = data(r, src, dst);
+  uint64_t cap = data_bytes(r);
+  uint64_t head = h->head.load(std::memory_order_relaxed);
+  uint64_t tail = h->tail.load(std::memory_order_acquire);
+  while (true) {
+    if (head == tail) return 0;
+    uint64_t pos = head % cap;
+    uint32_t len = *reinterpret_cast<const uint32_t*>(d + pos);
+    if (len == kWrapMarker || cap - pos < 4) {
+      head += cap - pos;  // consume wrap filler
+      h->head.store(head, std::memory_order_release);
+      continue;
+    }
+    return static_cast<long>(len);
+  }
+}
+
+// Dequeue one message into buf (caller sized it via sr_peek). Returns the
+// message length, 0 if empty, -1 if buf too small.
+long sr_recv(void* handle, int src, int dst, void* buf, long maxlen) {
+  Region* r = static_cast<Region*>(handle);
+  long len = sr_peek(handle, src, dst);
+  if (len <= 0) return len;
+  if (len > maxlen) return -1;
+  RingHdr* h = hdr(r, src, dst);
+  uint8_t* d = data(r, src, dst);
+  uint64_t cap = data_bytes(r);
+  uint64_t head = h->head.load(std::memory_order_relaxed);
+  uint64_t pos = head % cap;
+  std::memcpy(buf, d + pos + 4, static_cast<uint64_t>(len));
+  h->head.store(head + align_up(4 + static_cast<uint64_t>(len)),
+                std::memory_order_release);
+  return len;
+}
+
+void sr_detach(void* handle) {
+  Region* r = static_cast<Region*>(handle);
+  ::munmap(r->base, r->map_len);
+  ::close(r->fd);
+  delete r;
+}
+
+}  // extern "C"
