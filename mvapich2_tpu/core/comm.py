@@ -493,6 +493,25 @@ class Comm:
         self._twolevel_ready = True
         return self._shmem_comm, self._leader_comm
 
+    # ------------------------------------------------------------------
+    # RMA window constructors (SURVEY §2.1 RMA; src/mpi/rma/win_create.c)
+    # ------------------------------------------------------------------
+    def win_create(self, buf, disp_unit: int = 1):
+        from ..rma import win as _rw
+        return _rw.win_create(self, buf, disp_unit)
+
+    def win_allocate(self, size: int, disp_unit: int = 1):
+        from ..rma import win as _rw
+        return _rw.win_allocate(self, size, disp_unit)
+
+    def win_allocate_shared(self, size: int, disp_unit: int = 1):
+        from ..rma import win as _rw
+        return _rw.win_allocate_shared(self, size, disp_unit)
+
+    def win_create_dynamic(self):
+        from ..rma import win as _rw
+        return _rw.win_create_dynamic(self)
+
     # -- misc -------------------------------------------------------------
     def set_name(self, name: str) -> None:
         self.name = name

@@ -1,0 +1,11 @@
+"""One-sided (RMA) subsystem — SURVEY §2.1 "RMA (one-sided) semantics".
+
+Window types, communication ops and the three synchronization families
+(fence / PSCW / passive-target locks) over the packet transport.
+"""
+
+from .win import (LOCK_EXCLUSIVE, LOCK_SHARED, Win, win_allocate,
+                  win_allocate_shared, win_create, win_create_dynamic)
+
+__all__ = ["Win", "win_create", "win_allocate", "win_allocate_shared",
+           "win_create_dynamic", "LOCK_EXCLUSIVE", "LOCK_SHARED"]

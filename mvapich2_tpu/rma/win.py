@@ -1,0 +1,856 @@
+"""RMA windows: creation flavors, communication ops, synchronization.
+
+Analog of the reference's one-sided stack (SURVEY §2.1 "RMA semantics":
+window types at /root/reference/src/mpi/rma/win_create.c etc., sync modes in
+src/mpid/ch3/src/ch3u_rma_sync.c — MPID_Win_lock :1466, MPID_Win_flush
+:1698 — and op issuing in ch3u_rma_ops.c / mpid_rma_issue.h; the mrail
+direct-RDMA path gen2/rdma_iba_1sc.c).
+
+TPU-first redesign notes:
+  * Window memory is host (numpy) memory, the staging side of the HBM
+    story; device-resident RMA (Put = one-sided ``ppermute`` neighbor DMA)
+    rides the ici channel's collective path instead (SURVEY §7 step 7).
+  * The reference issues verbs RDMA ops and tracks completions per target;
+    here every op is a packet applied at the target inside its progress
+    engine's mutex — which makes every accumulate element-atomic (stronger
+    than MPI's same-op guarantee, and exactly the semantics the
+    shared-memory windows in mv2_rma_allocate_shm get from CPU atomics).
+  * Channel FIFO ordering per rank pair is what makes FLUSH/UNLOCK a
+    completion fence: a FLUSH_ACK answers only after all earlier ops from
+    that origin were applied (the reference instead counts verbs CQEs).
+  * ``win_allocate_shared`` is a real cross-process shared segment
+    (multiprocessing.shared_memory), the mv2_rma_allocate_shm analog.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import datatype as dtmod
+from ..core import op as opmod
+from ..core.datatype import Datatype, as_bytes_view
+from ..core.errors import (MPIException, MPI_ERR_ARG, MPI_ERR_RANK,
+                           MPI_ERR_RMA_SYNC, MPI_ERR_WIN, mpi_assert)
+from ..core.request import CompletedRequest, Request
+from ..transport.base import Packet, PktType
+from ..utils.mlog import get_logger
+
+log = get_logger("rma")
+
+LOCK_EXCLUSIVE = 1
+LOCK_SHARED = 2
+
+# shared segments whose mappings outlive their window (see Win.free)
+_leaked_shm: list = []
+
+# MPI_Win_flavor / memory model constants
+FLAVOR_CREATE = 1
+FLAVOR_ALLOCATE = 2
+FLAVOR_DYNAMIC = 3
+FLAVOR_SHARED = 4
+WIN_SEPARATE = 1
+WIN_UNIFIED = 2
+
+
+def _ser_dt(dt: Datatype) -> dict:
+    return {"spans": list(dt.spans), "extent": dt.extent, "lb": dt.lb,
+            "basic": (dt.basic.str if dt.basic is not None else None)}
+
+
+def _deser_dt(d: dict) -> Datatype:
+    return Datatype([tuple(s) for s in d["spans"]], d["extent"], d["lb"],
+                    np.dtype(d["basic"]) if d["basic"] else None,
+                    "rma_wire", True)
+
+
+class _TargetSync:
+    """Per-window target-side (exposure) state."""
+
+    def __init__(self):
+        self.lock_mode = 0              # 0 free, else LOCK_EXCLUSIVE/SHARED
+        self.lock_holders: set = set()  # origin world ranks
+        # pending lock requests: (origin, mode, rreq_id)
+        self.lock_queue: List[Tuple[int, int, int]] = []
+        self.posts_from: set = set()    # PSCW: origins we posted to
+        self.completes: set = set()     # PSCW: origins that completed
+
+
+class Win:
+    """An RMA window (MPID_Win analog)."""
+
+    _next_id = 1
+    _id_lock = threading.Lock()
+
+    def __init__(self, comm, base: Optional[np.ndarray], size: int,
+                 disp_unit: int, flavor: int, win_id: int):
+        self.comm = comm
+        self.u = comm.u
+        self.group = comm.group
+        self.base = base                  # uint8 ndarray or None (dynamic)
+        self.size = size
+        self.disp_unit = disp_unit
+        self.flavor = flavor
+        self.model = WIN_UNIFIED
+        self.win_id = win_id
+        self.name = f"win{win_id}"
+        self.info: Dict[str, str] = {}
+        self.attrs: Dict[int, object] = {}
+        self.freed = False
+        # dynamic windows: address -> attached array
+        self._attached: Dict[int, np.ndarray] = {}
+        self._next_addr = 0x1000
+        # origin-side sync state
+        self.epoch: Optional[str] = None  # None|fence|start|lock|lock_all
+        self._locked_targets: Dict[int, int] = {}   # target -> mode
+        self._start_group = None
+        self._posts_seen: set = set()
+        self._touched: set = set()        # targets with ops since last sync
+        self._acks_wanted = 0             # outstanding FLUSH/UNLOCK acks
+        self._acks_seen = 0
+        # target-side sync state
+        self.tsync = _TargetSync()
+        # shared-window bookkeeping
+        self._shm = None
+        self._shm_owner = False
+        self._peers: Dict[int, Tuple[int, int]] = {}  # rank->(offset,size)
+        # register with the universe's RMA manager
+        _manager(self.u).add_window(self)
+
+    # ------------------------------------------------------------------
+    # memory addressing
+    # ------------------------------------------------------------------
+    def _region(self, disp: int, nbytes: int) -> np.ndarray:
+        """Byte view of [disp*unit, +nbytes) in this window (target side)."""
+        if self.flavor == FLAVOR_DYNAMIC:
+            for addr, arr in self._attached.items():
+                raw = arr.reshape(-1).view(np.uint8)
+                if addr <= disp and disp + nbytes <= addr + raw.nbytes:
+                    off = disp - addr
+                    return raw[off:off + nbytes]
+            raise MPIException(MPI_ERR_ARG,
+                               f"dynamic window: no region at {disp}")
+        off = disp * self.disp_unit
+        mpi_assert(0 <= off and off + nbytes <= self.size, MPI_ERR_ARG,
+                   f"window access [{off},{off + nbytes}) outside size "
+                   f"{self.size}")
+        return self.base[off:off + nbytes]
+
+    # -- dynamic windows ------------------------------------------------
+    def attach(self, arr: np.ndarray) -> int:
+        """MPI_Win_attach; returns the region's address token (the value
+        remote ranks use as target_disp)."""
+        mpi_assert(self.flavor == FLAVOR_DYNAMIC, MPI_ERR_WIN,
+                   "attach on non-dynamic window")
+        addr = self._next_addr
+        self._next_addr += int(arr.nbytes) + 64
+        self._attached[addr] = arr
+        return addr
+
+    def detach(self, addr_or_arr) -> None:
+        if isinstance(addr_or_arr, (int, np.integer)):
+            self._attached.pop(int(addr_or_arr), None)
+            return
+        for a, arr in list(self._attached.items()):
+            if arr is addr_or_arr:
+                del self._attached[a]
+
+    # ------------------------------------------------------------------
+    # epoch guards
+    # ------------------------------------------------------------------
+    def _need_access_epoch(self, target: int) -> None:
+        if self.epoch is None:
+            raise MPIException(MPI_ERR_RMA_SYNC,
+                               "RMA op outside an access epoch "
+                               "(call fence/start/lock first)")
+        if self.epoch == "lock" and target not in self._locked_targets:
+            raise MPIException(MPI_ERR_RMA_SYNC,
+                               f"target {target} is not locked")
+
+    def _check_target(self, rank: int) -> None:
+        if not (0 <= rank < self.comm.size):
+            raise MPIException(MPI_ERR_RANK, f"bad target rank {rank}")
+
+    def _send(self, target: int, pkt: Packet) -> None:
+        self._send_world(self.comm.world_of(target), pkt)
+
+    def _send_world(self, world: int, pkt: Packet) -> None:
+        _manager(self.u).send_to(world, pkt)
+
+    # ------------------------------------------------------------------
+    # communication ops (origin side)
+    # ------------------------------------------------------------------
+    def put(self, origin, target_rank: int, target_disp: int = 0,
+            count: Optional[int] = None, origin_dt: Optional[Datatype] = None,
+            target_dt: Optional[Datatype] = None) -> None:
+        self.rput(origin, target_rank, target_disp, count, origin_dt,
+                  target_dt)  # local completion is immediate (data copied)
+
+    def rput(self, origin, target_rank: int, target_disp: int = 0,
+             count: Optional[int] = None, origin_dt: Optional[Datatype] = None,
+             target_dt: Optional[Datatype] = None) -> Request:
+        self._check_target(target_rank)
+        self._need_access_epoch(target_rank)
+        odt, cnt = _resolve_dt(origin, count, origin_dt)
+        tdt = target_dt or odt
+        data = np.asarray(odt.pack(origin, cnt))
+        pkt = Packet(PktType.RMA_PUT, self.u.world_rank, nbytes=len(data),
+                     data=data,
+                     extra={"win": self.win_id, "disp": int(target_disp),
+                            "count": cnt, "tdt": _ser_dt(tdt)})
+        self._touched.add(target_rank)
+        self._send(target_rank, pkt)
+        return CompletedRequest()
+
+    def get(self, origin, target_rank: int, target_disp: int = 0,
+            count: Optional[int] = None, origin_dt: Optional[Datatype] = None,
+            target_dt: Optional[Datatype] = None) -> None:
+        req = self.rget(origin, target_rank, target_disp, count, origin_dt,
+                        target_dt)
+        req.wait()
+
+    def rget(self, origin, target_rank: int, target_disp: int = 0,
+             count: Optional[int] = None, origin_dt: Optional[Datatype] = None,
+             target_dt: Optional[Datatype] = None) -> Request:
+        self._check_target(target_rank)
+        self._need_access_epoch(target_rank)
+        odt, cnt = _resolve_dt(origin, count, origin_dt)
+        tdt = target_dt or odt
+        req = _GetRequest(self.u.engine, origin, cnt, odt)
+        with self.u.engine.mutex:
+            self.u.engine.track(req)
+        pkt = Packet(PktType.RMA_GET, self.u.world_rank, rreq_id=req.req_id,
+                     extra={"win": self.win_id, "disp": int(target_disp),
+                            "count": cnt, "tdt": _ser_dt(tdt)})
+        self._touched.add(target_rank)
+        self._send(target_rank, pkt)
+        return req
+
+    def accumulate(self, origin, target_rank: int, target_disp: int = 0,
+                   count: Optional[int] = None, op: opmod.Op = opmod.SUM,
+                   origin_dt: Optional[Datatype] = None,
+                   target_dt: Optional[Datatype] = None) -> None:
+        self.raccumulate(origin, target_rank, target_disp, count, op,
+                         origin_dt, target_dt)
+
+    def raccumulate(self, origin, target_rank: int, target_disp: int = 0,
+                    count: Optional[int] = None, op: opmod.Op = opmod.SUM,
+                    origin_dt: Optional[Datatype] = None,
+                    target_dt: Optional[Datatype] = None) -> Request:
+        self._check_target(target_rank)
+        self._need_access_epoch(target_rank)
+        odt, cnt = _resolve_dt(origin, count, origin_dt)
+        tdt = target_dt or odt
+        data = np.asarray(odt.pack(origin, cnt))
+        pkt = Packet(PktType.RMA_ACC, self.u.world_rank, nbytes=len(data),
+                     data=data,
+                     extra={"win": self.win_id, "disp": int(target_disp),
+                            "count": cnt, "tdt": _ser_dt(tdt),
+                            "op": op.name})
+        self._touched.add(target_rank)
+        self._send(target_rank, pkt)
+        return CompletedRequest()
+
+    def get_accumulate(self, origin, result, target_rank: int,
+                       target_disp: int = 0, count: Optional[int] = None,
+                       op: opmod.Op = opmod.SUM,
+                       origin_dt: Optional[Datatype] = None,
+                       target_dt: Optional[Datatype] = None) -> None:
+        self.rget_accumulate(origin, result, target_rank, target_disp, count,
+                             op, origin_dt, target_dt).wait()
+
+    def rget_accumulate(self, origin, result, target_rank: int,
+                        target_disp: int = 0, count: Optional[int] = None,
+                        op: opmod.Op = opmod.SUM,
+                        origin_dt: Optional[Datatype] = None,
+                        target_dt: Optional[Datatype] = None) -> Request:
+        self._check_target(target_rank)
+        self._need_access_epoch(target_rank)
+        odt, cnt = _resolve_dt(result, count, origin_dt)
+        tdt = target_dt or odt
+        if op is opmod.NO_OP or origin is None:
+            data = np.empty(0, dtype=np.uint8)
+        else:
+            data = np.asarray(odt.pack(origin, cnt))
+        req = _GetRequest(self.u.engine, result, cnt, odt)
+        with self.u.engine.mutex:
+            self.u.engine.track(req)
+        pkt = Packet(PktType.RMA_GET_ACC, self.u.world_rank,
+                     nbytes=len(data), data=data, rreq_id=req.req_id,
+                     extra={"win": self.win_id, "disp": int(target_disp),
+                            "count": cnt, "tdt": _ser_dt(tdt),
+                            "op": op.name})
+        self._touched.add(target_rank)
+        self._send(target_rank, pkt)
+        return req
+
+    def fetch_and_op(self, origin, result, target_rank: int,
+                     target_disp: int = 0, op: opmod.Op = opmod.SUM,
+                     datatype: Optional[Datatype] = None) -> None:
+        self.rget_accumulate(origin, result, target_rank, target_disp, 1, op,
+                             datatype, datatype).wait()
+
+    def compare_and_swap(self, origin, compare, result, target_rank: int,
+                         target_disp: int = 0,
+                         datatype: Optional[Datatype] = None) -> None:
+        self._check_target(target_rank)
+        self._need_access_epoch(target_rank)
+        dt, _ = _resolve_dt(origin, 1, datatype)
+        req = _GetRequest(self.u.engine, result, 1, dt)
+        with self.u.engine.mutex:
+            self.u.engine.track(req)
+        pkt = Packet(PktType.RMA_CAS, self.u.world_rank, rreq_id=req.req_id,
+                     nbytes=2 * dt.size,   # new value + compare operand
+                     data=np.concatenate([np.asarray(dt.pack(origin, 1)),
+                                          np.asarray(dt.pack(compare, 1))]),
+                     extra={"win": self.win_id, "disp": int(target_disp),
+                            "tdt": _ser_dt(dt)})
+        self._touched.add(target_rank)
+        self._send(target_rank, pkt)
+        req.wait()
+
+    # ------------------------------------------------------------------
+    # synchronization: fence
+    # ------------------------------------------------------------------
+    def fence(self, assertion: int = 0) -> None:
+        """MPI_Win_fence: complete my issued ops everywhere, then barrier
+        so everyone's exposure epoch closes together."""
+        self._flush_targets(sorted(self._touched))
+        self.comm.barrier()
+        self.epoch = "fence"
+
+    # ------------------------------------------------------------------
+    # synchronization: PSCW (general active target)
+    # ------------------------------------------------------------------
+    def post(self, group) -> None:
+        """Expose this window to ``group`` (a Group of origin ranks)."""
+        me = self.u.world_rank
+        with self.u.engine.mutex:
+            self.tsync.completes.clear()
+            self.tsync.posts_from = set(group.world_ranks)
+        for wr in group.world_ranks:
+            pkt = Packet(PktType.RMA_PSCW_POST, me,
+                         extra={"win": self.win_id})
+            self._send_world(wr, pkt)
+
+    def start(self, group) -> None:
+        """Begin an access epoch to ``group`` (target ranks). Blocks until
+        all targets have posted (the strict interpretation)."""
+        self._start_group = group
+        worlds = set(group.world_ranks)
+        self.u.engine.progress_wait(
+            lambda: worlds.issubset(self._posts_seen))
+        with self.u.engine.mutex:
+            self._posts_seen -= worlds
+        self.epoch = "start"
+
+    def complete(self) -> None:
+        """End the access epoch begun by start(): flush, notify targets."""
+        mpi_assert(self.epoch == "start", MPI_ERR_RMA_SYNC,
+                   "complete() without start()")
+        group = self._start_group
+        self._flush_targets([self.comm.group.rank_of_world(wr)
+                             for wr in group.world_ranks])
+        for wr in group.world_ranks:
+            self._send_world(wr, Packet(PktType.RMA_PSCW_COMPLETE,
+                                        self.u.world_rank,
+                                        extra={"win": self.win_id}))
+        self._start_group = None
+        self.epoch = None
+
+    def wait(self) -> None:
+        """Close the exposure epoch: wait for COMPLETE from every origin."""
+        ts = self.tsync
+        self.u.engine.progress_wait(
+            lambda: ts.posts_from.issubset(ts.completes))
+        with self.u.engine.mutex:
+            ts.posts_from.clear()
+            ts.completes.clear()
+
+    def test(self) -> bool:
+        self.u.engine.progress_poke()
+        ts = self.tsync
+        with self.u.engine.mutex:
+            done = ts.posts_from.issubset(ts.completes)
+            if done:
+                ts.posts_from.clear()
+                ts.completes.clear()
+        return done
+
+    # ------------------------------------------------------------------
+    # synchronization: passive target (lock/flush)
+    # ------------------------------------------------------------------
+    def lock(self, rank: int, lock_type: int = LOCK_SHARED,
+             assertion: int = 0) -> None:
+        self._check_target(rank)
+        req = _LockRequest(self.u.engine)
+        with self.u.engine.mutex:
+            self.u.engine.track(req)
+        self._send(rank, Packet(PktType.RMA_LOCK, self.u.world_rank,
+                                rreq_id=req.req_id,
+                                extra={"win": self.win_id,
+                                       "mode": lock_type}))
+        req.wait()
+        self._locked_targets[rank] = lock_type
+        self.epoch = "lock"
+
+    def unlock(self, rank: int) -> None:
+        mpi_assert(rank in self._locked_targets, MPI_ERR_RMA_SYNC,
+                   f"unlock of unlocked target {rank}")
+        # UNLOCK is ordered after all my ops on this channel, and its ack
+        # confirms both application and lock release (flush semantics).
+        self._await_acks(rank, PktType.RMA_UNLOCK)
+        del self._locked_targets[rank]
+        self._touched.discard(rank)
+        if not self._locked_targets:
+            self.epoch = None
+
+    def lock_all(self, assertion: int = 0) -> None:
+        for r in range(self.comm.size):
+            self.lock(r, LOCK_SHARED, assertion)
+        self.epoch = "lock_all"
+
+    def unlock_all(self) -> None:
+        self.epoch = "lock"   # so unlock() bookkeeping runs
+        for r in list(self._locked_targets):
+            self.unlock(r)
+
+    def flush(self, rank: int) -> None:
+        self._await_acks(rank, PktType.RMA_FLUSH)
+
+    def flush_all(self) -> None:
+        self._flush_targets(sorted(self._touched))
+
+    def flush_local(self, rank: int) -> None:
+        # all ops buffer their payload at issue time → locally complete
+        pass
+
+    def flush_local_all(self) -> None:
+        pass
+
+    def sync(self) -> None:
+        """Memory barrier between window copies — unified model no-op."""
+        self.u.engine.progress_poke()
+
+    def _await_acks(self, rank: int, ptype: PktType) -> None:
+        with self.u.engine.mutex:
+            self._acks_wanted += 1
+        self._send(rank, Packet(ptype, self.u.world_rank,
+                                extra={"win": self.win_id}))
+        self.u.engine.progress_wait(
+            lambda: self._acks_seen >= self._acks_wanted)
+        self._touched.discard(rank)
+
+    def _flush_targets(self, targets: Sequence[int]) -> None:
+        if not targets:
+            return
+        with self.u.engine.mutex:
+            self._acks_wanted += len(targets)
+        for r in targets:
+            self._send(r, Packet(PktType.RMA_FLUSH, self.u.world_rank,
+                                 extra={"win": self.win_id}))
+        self.u.engine.progress_wait(
+            lambda: self._acks_seen >= self._acks_wanted)
+        self._touched.clear()
+
+    # ------------------------------------------------------------------
+    # shared windows
+    # ------------------------------------------------------------------
+    def shared_query(self, rank: int) -> Tuple[np.ndarray, int, int]:
+        """(memory view, size, disp_unit) of ``rank``'s segment."""
+        mpi_assert(self.flavor == FLAVOR_SHARED, MPI_ERR_WIN,
+                   "shared_query on non-shared window")
+        if rank == -1:   # MPI_PROC_NULL: lowest rank with a nonzero segment
+            rank = min(r for r, (_, sz) in self._peers.items() if sz > 0)
+        off, size = self._peers[rank]
+        seg = np.frombuffer(self._shm.buf, dtype=np.uint8)
+        return seg[off:off + size], size, self.disp_unit
+
+    # ------------------------------------------------------------------
+    # admin
+    # ------------------------------------------------------------------
+    def get_group(self):
+        return self.group
+
+    def set_name(self, name: str) -> None:
+        self.name = name
+
+    def get_name(self) -> str:
+        return self.name
+
+    def set_info(self, info: Dict[str, str]) -> None:
+        self.info.update(info)
+
+    def get_info(self) -> Dict[str, str]:
+        return dict(self.info)
+
+    def free(self) -> None:
+        if self.freed:
+            return
+        self.comm.barrier()
+        _manager(self.u).remove_window(self)
+        if self._shm is not None:
+            self.base = None
+            if self._shm_owner:
+                try:
+                    self._shm.unlink()   # POSIX: ok while still mapped
+                except FileNotFoundError:
+                    pass
+            try:
+                self._shm.close()
+            except BufferError:
+                # user-held views (shared_query results) keep the mapping
+                # alive; the segment is already unlinked, so it dies with
+                # the last view. Pin the handle so __del__ doesn't retry
+                # (and noisily fail) at GC time.
+                _leaked_shm.append(self._shm)
+        self.freed = True
+
+    def __repr__(self):
+        return (f"Win(id={self.win_id}, flavor={self.flavor}, "
+                f"size={self.size}, epoch={self.epoch})")
+
+
+class _GetRequest(Request):
+    """Origin-side request completed by a *_RESP packet."""
+
+    def __init__(self, engine, buf, count: int, dt: Datatype):
+        super().__init__(engine, "rma_get")
+        self.buf = buf
+        self.count = count
+        self.dt = dt
+
+
+class _LockRequest(Request):
+    def __init__(self, engine):
+        super().__init__(engine, "rma_lock")
+
+
+def _resolve_dt(buf, count, dt) -> Tuple[Datatype, int]:
+    if dt is None:
+        arr = np.asarray(buf)
+        dt = dtmod.from_numpy_dtype(arr.dtype)
+        if count is None:
+            count = arr.size
+    elif count is None:
+        raw = as_bytes_view(buf)
+        count = len(raw) // dt.extent if dt.extent else 0
+    return dt, int(count)
+
+
+# ---------------------------------------------------------------------------
+# target-side manager (packet handlers)
+# ---------------------------------------------------------------------------
+
+class RmaManager:
+    """Per-universe handler hub for RMA packets (the ch3u_rma_* packet
+    handler table analog). All handlers run under the engine mutex."""
+
+    def __init__(self, universe):
+        self.u = universe
+        eng = universe.engine
+        for pt, fn in [(PktType.RMA_PUT, self._on_put),
+                       (PktType.RMA_GET, self._on_get),
+                       (PktType.RMA_GET_RESP, self._on_get_resp),
+                       (PktType.RMA_ACC, self._on_acc),
+                       (PktType.RMA_GET_ACC, self._on_get_acc),
+                       (PktType.RMA_CAS, self._on_cas),
+                       (PktType.RMA_LOCK, self._on_lock),
+                       (PktType.RMA_LOCK_GRANTED, self._on_lock_granted),
+                       (PktType.RMA_UNLOCK, self._on_unlock),
+                       (PktType.RMA_FLUSH, self._on_flush),
+                       (PktType.RMA_FLUSH_ACK, self._on_flush_ack),
+                       (PktType.RMA_PSCW_POST, self._on_post),
+                       (PktType.RMA_PSCW_COMPLETE, self._on_complete)]:
+            eng.register_handler(pt, fn)
+
+    def add_window(self, win: Win) -> None:
+        self.u.windows[win.win_id] = win
+
+    def remove_window(self, win: Win) -> None:
+        self.u.windows.pop(win.win_id, None)
+
+    def _win(self, pkt: Packet) -> Win:
+        win = self.u.windows.get(pkt.extra["win"])
+        if win is None:
+            raise MPIException(MPI_ERR_WIN,
+                               f"packet for unknown window {pkt.extra}")
+        return win
+
+    def send_to(self, dest_world: int, pkt: Packet) -> None:
+        """Single routing point: self-targets dispatch inline under the
+        engine RLock (reentrant — safe from inside handlers too), remote
+        targets go through the channel."""
+        if dest_world == self.u.world_rank:
+            with self.u.engine.mutex:
+                self.u.engine._dispatch(pkt)
+        else:
+            self.u.channel_for(dest_world).send_packet(dest_world, pkt)
+
+    # back-compat alias used by Win._send_world
+    def handle_local(self, pkt: Packet) -> None:
+        self.send_to(self.u.world_rank, pkt)
+
+    def _reply(self, pkt: Packet, out: Packet) -> None:
+        self.send_to(pkt.src_world, out)
+
+    # -- data ops --------------------------------------------------------
+    def _on_put(self, pkt: Packet) -> None:
+        win = self._win(pkt)
+        tdt = _deser_dt(pkt.extra["tdt"])
+        cnt = pkt.extra["count"]
+        region = win._region(pkt.extra["disp"], tdt.extent * cnt
+                             if cnt else 0)
+        if cnt:
+            tdt.unpack(pkt.data, region, cnt)
+
+    def _on_get(self, pkt: Packet) -> None:
+        win = self._win(pkt)
+        tdt = _deser_dt(pkt.extra["tdt"])
+        cnt = pkt.extra["count"]
+        region = win._region(pkt.extra["disp"], tdt.extent * cnt
+                             if cnt else 0)
+        data = np.asarray(tdt.pack(region, cnt)) if cnt else \
+            np.empty(0, np.uint8)
+        self._reply(pkt, Packet(PktType.RMA_GET_RESP, self.u.world_rank,
+                                nbytes=len(data), data=data,
+                                rreq_id=pkt.rreq_id))
+
+    def _on_get_resp(self, pkt: Packet) -> None:
+        req = self.u.engine.outstanding.get(pkt.rreq_id)
+        if req is None:
+            return
+        if req.buf is not None and pkt.nbytes:
+            req.dt.unpack(pkt.data, req.buf, req.count)
+        req.complete()
+
+    def _apply_acc(self, win: Win, pkt: Packet, fetch: bool) -> Optional[np.ndarray]:
+        tdt = _deser_dt(pkt.extra["tdt"])
+        cnt = pkt.extra["count"]
+        op = _op_by_name(pkt.extra["op"])
+        region = win._region(pkt.extra["disp"], tdt.extent * cnt
+                             if cnt else 0)
+        old = np.asarray(tdt.pack(region, cnt)) if cnt else \
+            np.empty(0, np.uint8)
+        if cnt and op is not opmod.NO_OP and pkt.nbytes:
+            basic = tdt.basic if tdt.basic is not None else np.dtype(np.uint8)
+            cur = old.view(basic).copy()
+            inc = pkt.data[:len(old)].view(basic)
+            res = op(inc, cur)
+            tdt.unpack(np.ascontiguousarray(res).view(np.uint8), region, cnt)
+        return old if fetch else None
+
+    def _on_acc(self, pkt: Packet) -> None:
+        self._apply_acc(self._win(pkt), pkt, fetch=False)
+
+    def _on_get_acc(self, pkt: Packet) -> None:
+        old = self._apply_acc(self._win(pkt), pkt, fetch=True)
+        self._reply(pkt, Packet(PktType.RMA_GET_RESP, self.u.world_rank,
+                                nbytes=len(old), data=old,
+                                rreq_id=pkt.rreq_id))
+
+    def _on_cas(self, pkt: Packet) -> None:
+        win = self._win(pkt)
+        tdt = _deser_dt(pkt.extra["tdt"])
+        region = win._region(pkt.extra["disp"], tdt.extent)
+        old = np.asarray(tdt.pack(region, 1))
+        n = tdt.size
+        newv, comp = pkt.data[:n], pkt.data[n:2 * n]
+        if np.array_equal(old, comp):
+            tdt.unpack(newv, region, 1)
+        self._reply(pkt, Packet(PktType.RMA_GET_RESP, self.u.world_rank,
+                                nbytes=len(old), data=old,
+                                rreq_id=pkt.rreq_id))
+
+    # -- locks -----------------------------------------------------------
+    def _grant(self, win: Win, origin: int, rreq_id: int) -> None:
+        self.send_to(origin, Packet(PktType.RMA_LOCK_GRANTED,
+                                    self.u.world_rank, rreq_id=rreq_id,
+                                    extra={"win": win.win_id}))
+
+    def _on_lock(self, pkt: Packet) -> None:
+        win = self._win(pkt)
+        ts = win.tsync
+        mode = pkt.extra["mode"]
+        origin = pkt.src_world
+        if ts.lock_mode == 0 or (ts.lock_mode == LOCK_SHARED
+                                 and mode == LOCK_SHARED
+                                 and not ts.lock_queue):
+            ts.lock_mode = mode
+            ts.lock_holders.add(origin)
+            self._grant(win, origin, pkt.rreq_id)
+        else:
+            ts.lock_queue.append((origin, mode, pkt.rreq_id))
+
+    def _on_lock_granted(self, pkt: Packet) -> None:
+        req = self.u.engine.outstanding.get(pkt.rreq_id)
+        if req is not None:
+            req.complete()
+
+    def _on_unlock(self, pkt: Packet) -> None:
+        win = self._win(pkt)
+        ts = win.tsync
+        ts.lock_holders.discard(pkt.src_world)
+        if not ts.lock_holders:
+            ts.lock_mode = 0
+            while ts.lock_queue:
+                origin, mode, rid = ts.lock_queue[0]
+                if ts.lock_mode == 0:
+                    ts.lock_mode = mode
+                    ts.lock_holders.add(origin)
+                    ts.lock_queue.pop(0)
+                    self._grant(win, origin, rid)
+                    if mode == LOCK_EXCLUSIVE:
+                        break
+                elif ts.lock_mode == LOCK_SHARED and mode == LOCK_SHARED:
+                    ts.lock_holders.add(origin)
+                    ts.lock_queue.pop(0)
+                    self._grant(win, origin, rid)
+                else:
+                    break
+        # unlock acks like a flush (ops already applied: FIFO order)
+        self._reply(pkt, Packet(PktType.RMA_FLUSH_ACK, self.u.world_rank,
+                                extra={"win": win.win_id}))
+
+    def _on_flush(self, pkt: Packet) -> None:
+        win = self._win(pkt)
+        self._reply(pkt, Packet(PktType.RMA_FLUSH_ACK, self.u.world_rank,
+                                extra={"win": win.win_id}))
+
+    def _on_flush_ack(self, pkt: Packet) -> None:
+        win = self._win(pkt)
+        win._acks_seen += 1
+        self.u.engine.wakeup()
+
+    # -- PSCW ------------------------------------------------------------
+    def _on_post(self, pkt: Packet) -> None:
+        win = self._win(pkt)
+        win._posts_seen.add(pkt.src_world)
+        self.u.engine.wakeup()
+
+    def _on_complete(self, pkt: Packet) -> None:
+        win = self._win(pkt)
+        win.tsync.completes.add(pkt.src_world)
+        self.u.engine.wakeup()
+
+
+_OPS_BY_NAME = {op.name: op for op in
+                (opmod.SUM, opmod.PROD, opmod.MAX, opmod.MIN, opmod.LAND,
+                 opmod.LOR, opmod.LXOR, opmod.BAND, opmod.BOR, opmod.BXOR,
+                 opmod.MINLOC, opmod.MAXLOC, opmod.REPLACE, opmod.NO_OP)}
+
+
+def _op_by_name(name: str) -> opmod.Op:
+    op = _OPS_BY_NAME.get(name)
+    if op is None:
+        raise MPIException(MPI_ERR_ARG, f"unknown RMA op {name}")
+    return op
+
+
+def _manager(universe) -> RmaManager:
+    mgr = getattr(universe, "_rma_manager", None)
+    if mgr is None:
+        mgr = RmaManager(universe)
+        universe._rma_manager = mgr
+    return mgr
+
+
+# ---------------------------------------------------------------------------
+# window constructors (all collective over comm)
+# ---------------------------------------------------------------------------
+
+def _alloc_win_id(comm) -> int:
+    """Collectively agree on a fresh window id (context-id discipline)."""
+    import numpy as np
+    from ..coll import api as coll
+    with Win._id_lock:
+        mine = Win._next_id
+    arr = np.array([mine], dtype=np.int64)
+    out = np.zeros_like(arr)
+    coll.allreduce(comm, arr, out, 1, None, opmod.MAX)
+    wid = int(out[0])
+    with Win._id_lock:
+        Win._next_id = max(Win._next_id, wid + 1)
+    return wid
+
+
+def win_create(comm, buf: Optional[np.ndarray], disp_unit: int = 1) -> Win:
+    """MPI_Win_create: expose caller-provided memory."""
+    wid = _alloc_win_id(comm)
+    if buf is None:
+        base, size = np.empty(0, np.uint8), 0
+    else:
+        if not buf.flags["C_CONTIGUOUS"]:
+            # reshape(-1) would copy and silently decouple the window
+            raise MPIException(MPI_ERR_ARG,
+                               "window buffer must be C-contiguous")
+        raw = buf.reshape(-1).view(np.uint8)
+        base, size = raw, raw.nbytes
+    win = Win(comm, base, size, disp_unit, FLAVOR_CREATE, wid)
+    comm.barrier()   # all ranks registered before any op can arrive
+    return win
+
+
+def win_allocate(comm, size: int, disp_unit: int = 1) -> Win:
+    """MPI_Win_allocate: framework-allocated memory (win.base)."""
+    wid = _alloc_win_id(comm)
+    base = np.zeros(size, dtype=np.uint8)
+    win = Win(comm, base, size, disp_unit, FLAVOR_ALLOCATE, wid)
+    comm.barrier()
+    return win
+
+
+def win_create_dynamic(comm) -> Win:
+    """MPI_Win_create_dynamic: no memory until attach()."""
+    wid = _alloc_win_id(comm)
+    win = Win(comm, None, 0, 1, FLAVOR_DYNAMIC, wid)
+    comm.barrier()
+    return win
+
+
+def win_allocate_shared(comm, size: int, disp_unit: int = 1) -> Win:
+    """MPI_Win_allocate_shared: one cross-process segment, contiguous
+    rank-ordered layout (mv2_rma_allocate_shm analog,
+    /root/reference/src/mpid/ch3/channels/mrail/src/gen2/rdma_iba_1sc.c:394).
+    """
+    from multiprocessing import shared_memory
+    import numpy as np
+    from ..coll import api as coll
+
+    wid = _alloc_win_id(comm)
+    sizes = np.zeros(comm.size, dtype=np.int64)
+    coll.allgather(comm, np.array([size], dtype=np.int64), sizes, 1, None)
+    offsets = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+    total = max(1, int(sizes.sum()))
+
+    # unique segment name generated by rank 0 and broadcast, so concurrent
+    # jobs on one host can't collide (same discipline as transport/shm.py)
+    shm = None
+    owner = False
+    namebuf = np.zeros(64, dtype=np.uint8)
+    if comm.rank == 0:
+        import os
+        import uuid
+        name = f"mv2tpu_win_{os.getpid()}_{uuid.uuid4().hex[:12]}"
+        shm = shared_memory.SharedMemory(name=name, create=True, size=total)
+        shm.buf[:total] = b"\0" * total
+        owner = True
+        enc = name.encode()
+        namebuf[:len(enc)] = np.frombuffer(enc, dtype=np.uint8)
+    comm.bcast(namebuf, 0)
+    if shm is None:
+        name = bytes(namebuf[namebuf != 0]).decode()
+        shm = shared_memory.SharedMemory(name=name, create=False)
+
+    seg = np.frombuffer(shm.buf, dtype=np.uint8)
+    off = int(offsets[comm.rank])
+    base = seg[off:off + size]
+    win = Win(comm, base, size, disp_unit, FLAVOR_SHARED, wid)
+    win._shm = shm
+    win._shm_owner = owner
+    win._peers = {r: (int(offsets[r]), int(sizes[r]))
+                  for r in range(comm.size)}
+    comm.barrier()
+    return win

@@ -47,6 +47,8 @@ class PktType(enum.IntEnum):
     RMA_UNLOCK = 22
     RMA_FLUSH = 23
     RMA_FLUSH_ACK = 24
+    RMA_PSCW_POST = 25
+    RMA_PSCW_COMPLETE = 26
     # control
     BARRIER_CTL = 30
     REVOKE = 31            # ULFM comm revoke propagation

@@ -1,0 +1,281 @@
+"""RMA (one-sided) tests — modeled on the reference's test/mpi/rma area
+(putfence, getfence, accfence, lockcontention, fetchandadd, compare_and_swap,
+pscw — each prints "No Errors" on success there; here they are asserts)."""
+
+import numpy as np
+import pytest
+
+from mvapich2_tpu import mpi
+from mvapich2_tpu.core import op as opmod
+from mvapich2_tpu.rma.win import LOCK_EXCLUSIVE, LOCK_SHARED
+from mvapich2_tpu.runtime.universe import run_ranks
+
+
+N = 4
+
+
+def test_put_fence():
+    def body(comm):
+        size = comm.size
+        buf = np.full(8, comm.rank, dtype=np.int64)
+        win = comm.win_create(buf, disp_unit=8)
+        win.fence()
+        # everyone puts its rank into the right neighbor's slot 0..7
+        right = (comm.rank + 1) % size
+        src = np.full(8, comm.rank + 100, dtype=np.int64)
+        win.put(src, right, 0)
+        win.fence()
+        left = (comm.rank - 1) % size
+        assert np.all(buf == left + 100), buf
+        win.free()
+    run_ranks(N, body)
+
+
+def test_get_fence():
+    def body(comm):
+        buf = np.arange(16, dtype=np.float64) * (comm.rank + 1)
+        win = comm.win_create(buf, disp_unit=8)
+        win.fence()
+        out = np.zeros(16, dtype=np.float64)
+        target = (comm.rank + 1) % comm.size
+        win.get(out, target, 0)
+        win.fence()
+        assert np.allclose(out, np.arange(16) * (target + 1))
+        win.free()
+    run_ranks(N, body)
+
+
+def test_accumulate_sum_fence():
+    def body(comm):
+        buf = np.zeros(4, dtype=np.int64)
+        win = comm.win_create(buf, disp_unit=8)
+        win.fence()
+        # all ranks accumulate into rank 0
+        contrib = np.full(4, comm.rank + 1, dtype=np.int64)
+        win.accumulate(contrib, 0, 0, op=opmod.SUM)
+        win.fence()
+        if comm.rank == 0:
+            expect = sum(r + 1 for r in range(comm.size))
+            assert np.all(buf == expect), buf
+        win.free()
+    run_ranks(N, body)
+
+
+def test_accumulate_replace_and_disp():
+    def body(comm):
+        buf = np.zeros(8, dtype=np.int32)
+        win = comm.win_create(buf, disp_unit=4)
+        win.fence()
+        # each rank replaces its own slot in every peer's window
+        val = np.array([comm.rank + 7], dtype=np.int32)
+        for t in range(comm.size):
+            win.accumulate(val, t, comm.rank, op=opmod.REPLACE)
+        win.fence()
+        for r in range(comm.size):
+            assert buf[r] == r + 7, buf
+        win.free()
+    run_ranks(N, body)
+
+
+def test_get_accumulate_and_fetch_op():
+    def body(comm):
+        buf = np.zeros(1, dtype=np.int64)
+        win = comm.win_create(buf, disp_unit=8)
+        win.lock(0, LOCK_EXCLUSIVE)
+        one = np.array([1], dtype=np.int64)
+        old = np.zeros(1, dtype=np.int64)
+        win.fetch_and_op(one, old, 0, 0, op=opmod.SUM)
+        win.unlock(0)
+        comm.barrier()
+        if comm.rank == 0:
+            assert buf[0] == comm.size   # every rank added exactly 1
+        # the fetched "old" values must be a permutation of 0..size-1
+        allold = np.zeros(comm.size, dtype=np.int64)
+        comm.allgather(old, allold, count=1)
+        assert sorted(allold.tolist()) == list(range(comm.size))
+        win.free()
+    run_ranks(N, body)
+
+
+def test_compare_and_swap():
+    def body(comm):
+        buf = np.zeros(1, dtype=np.int64)
+        win = comm.win_create(buf, disp_unit=8)
+        win.lock_all()
+        # everyone tries to CAS 0 -> rank+1 at rank 0; exactly one wins
+        mine = np.array([comm.rank + 1], dtype=np.int64)
+        comp = np.array([0], dtype=np.int64)
+        result = np.array([-1], dtype=np.int64)
+        win.compare_and_swap(mine, comp, result, 0, 0)
+        win.unlock_all()
+        comm.barrier()
+        wins = np.zeros(comm.size, dtype=np.int64)
+        got = np.array([1 if result[0] == 0 else 0], dtype=np.int64)
+        comm.allgather(got, wins, count=1)
+        assert wins.sum() == 1, wins          # exactly one CAS succeeded
+        if comm.rank == 0:
+            assert buf[0] in range(1, comm.size + 1)
+        win.free()
+    run_ranks(N, body)
+
+
+def test_lock_exclusive_counter():
+    """Contended exclusive-lock increments (lockcontention analog)."""
+    def body(comm):
+        buf = np.zeros(1, dtype=np.int64)
+        win = comm.win_create(buf, disp_unit=8)
+        for _ in range(5):
+            win.lock(0, LOCK_EXCLUSIVE)
+            cur = np.zeros(1, dtype=np.int64)
+            win.get(cur, 0, 0)
+            win.flush(0)
+            cur += 1
+            win.put(cur, 0, 0)
+            win.unlock(0)
+        comm.barrier()
+        if comm.rank == 0:
+            assert buf[0] == 5 * comm.size, buf
+        win.free()
+    run_ranks(N, body)
+
+
+def test_pscw():
+    """post/start/complete/wait generic active target (pscw analog)."""
+    def body(comm):
+        size = comm.size
+        buf = np.zeros(4, dtype=np.int64)
+        win = comm.win_create(buf, disp_unit=8)
+        even = comm.rank % 2 == 0
+        peer = comm.rank + 1 if even else comm.rank - 1
+        if peer >= size:
+            win.free()
+            return
+        peer_group = comm.group.incl([peer])
+        if even:
+            # origin: start/put/complete
+            win.start(peer_group)
+            win.put(np.full(4, comm.rank + 50, dtype=np.int64), peer, 0)
+            win.complete()
+        else:
+            win.post(peer_group)
+            win.wait()
+            assert np.all(buf == peer + 50), buf
+        win.free()
+    run_ranks(N, body)
+
+
+def test_win_allocate_and_flush():
+    def body(comm):
+        win = comm.win_allocate(64, disp_unit=8)
+        win.lock_all()
+        v = np.array([comm.rank * 11], dtype=np.int64)
+        win.put(v, (comm.rank + 1) % comm.size, 2)
+        win.flush_all()
+        win.unlock_all()
+        comm.barrier()
+        left = (comm.rank - 1) % comm.size
+        local = win.base.view(np.int64)
+        assert local[2] == left * 11
+        win.free()
+    run_ranks(N, body)
+
+
+def test_dynamic_window():
+    def body(comm):
+        win = comm.win_create_dynamic()
+        arr = np.zeros(8, dtype=np.float32)
+        addr = win.attach(arr)
+        addrs = np.zeros(comm.size, dtype=np.int64)
+        comm.allgather(np.array([addr], dtype=np.int64), addrs, count=1)
+        win.fence()
+        t = (comm.rank + 1) % comm.size
+        win.put(np.full(8, 2.5 * (comm.rank + 1), dtype=np.float32),
+                t, int(addrs[t]))
+        win.fence()
+        left = (comm.rank - 1) % comm.size
+        assert np.allclose(arr, 2.5 * (left + 1))
+        win.detach(addr)
+        win.free()
+    run_ranks(N, body)
+
+
+def test_derived_datatype_put():
+    """Put with a vector target datatype (non-contiguous scatter)."""
+    from mvapich2_tpu.core import datatype as dt
+    def body(comm):
+        buf = np.zeros(16, dtype=np.int32)
+        win = comm.win_create(buf, disp_unit=1)
+        win.fence()
+        if comm.rank == 0:
+            # every 2nd int in ranks' windows
+            vec = dt.create_vector(4, 1, 2, dt.INT).commit()
+            src = np.arange(4, dtype=np.int32) + 1
+            for t in range(comm.size):
+                win.put(src, t, 0, count=1,
+                        origin_dt=dt.create_contiguous(4, dt.INT).commit(),
+                        target_dt=vec)
+        win.fence()
+        assert np.all(buf[0:8:2] == np.arange(4) + 1), buf
+        assert np.all(buf[1:8:2] == 0)
+        win.free()
+    run_ranks(N, body)
+
+
+def test_rget_rput_requests():
+    def body(comm):
+        buf = np.full(4, comm.rank, dtype=np.int64)
+        win = comm.win_create(buf, disp_unit=8)
+        win.lock_all()
+        t = (comm.rank + 1) % comm.size
+        out = np.zeros(4, dtype=np.int64)
+        req = win.rget(out, t, 0)
+        req.wait()
+        assert np.all(out == t)
+        win.unlock_all()
+        win.free()
+    run_ranks(N, body)
+
+
+def test_shared_window():
+    def body(comm):
+        win = comm.win_allocate_shared(32, disp_unit=8)
+        mine = win.base.view(np.int64)
+        mine[:] = comm.rank + 1
+        comm.barrier()
+        # direct load/store into a peer's segment
+        peer = (comm.rank + 1) % comm.size
+        pbuf, psize, punit = win.shared_query(peer)
+        assert psize == 32 and punit == 8
+        assert np.all(pbuf.view(np.int64) == peer + 1)
+        comm.barrier()
+        win.free()
+    run_ranks(N, body)
+
+
+def test_rma_sync_errors():
+    def body(comm):
+        buf = np.zeros(2, dtype=np.int64)
+        win = comm.win_create(buf, disp_unit=8)
+        from mvapich2_tpu.core.errors import MPIException
+        with pytest.raises(MPIException):
+            win.put(np.array([1], dtype=np.int64), 0, 0)  # no epoch
+        win.fence()
+        win.free()
+    run_ranks(2, body)
+
+
+def test_self_rma():
+    """COMM_SELF-style loopback window ops."""
+    def body(comm):
+        buf = np.zeros(4, dtype=np.int64)
+        win = comm.win_create(buf, disp_unit=8)
+        win.fence()
+        win.put(np.arange(4, dtype=np.int64), comm.rank, 0)
+        win.fence()
+        assert np.all(buf == np.arange(4))
+        out = np.zeros(4, dtype=np.int64)
+        win.get(out, comm.rank, 0)
+        win.fence()
+        assert np.all(out == np.arange(4))
+        win.free()
+    run_ranks(2, body)
