@@ -494,6 +494,91 @@ class Comm:
         return self._shmem_comm, self._leader_comm
 
     # ------------------------------------------------------------------
+    # topologies (src/mpi/topo/ analog; core/topo.py)
+    # ------------------------------------------------------------------
+    def cart_create(self, dims, periods=None, reorder: bool = False):
+        from . import topo as _topo
+        if periods is None:
+            periods = [False] * len(dims)
+        return _topo.cart_create(self, dims, periods, reorder)
+
+    def graph_create(self, index, edges, reorder: bool = False):
+        from . import topo as _topo
+        return _topo.graph_create(self, index, edges, reorder)
+
+    def dist_graph_create_adjacent(self, sources, destinations,
+                                   sweights=None, dweights=None,
+                                   reorder: bool = False):
+        from . import topo as _topo
+        return _topo.dist_graph_create_adjacent(self, sources, destinations,
+                                                sweights, dweights, reorder)
+
+    def dist_graph_create(self, sources, degrees, destinations,
+                          reorder: bool = False):
+        from . import topo as _topo
+        return _topo.dist_graph_create(self, sources, degrees, destinations,
+                                       reorder)
+
+    def topo_test(self) -> str:
+        from . import topo as _topo
+        return _topo.topo_test(self)
+
+    def cart_coords(self, rank: Optional[int] = None):
+        from . import topo as _topo
+        t = _topo._cart(self)
+        return t.coords_of(self.rank if rank is None else rank)
+
+    def cart_rank(self, coords) -> int:
+        from . import topo as _topo
+        return _topo._cart(self).rank_of(coords)
+
+    def cart_get(self):
+        from . import topo as _topo
+        t = _topo._cart(self)
+        return list(t.dims), list(t.periods), t.coords_of(self.rank)
+
+    def cartdim_get(self) -> int:
+        from . import topo as _topo
+        return _topo._cart(self).ndims
+
+    def cart_shift(self, direction: int, disp: int = 1):
+        from . import topo as _topo
+        return _topo.cart_shift(self, direction, disp)
+
+    def cart_sub(self, remain_dims):
+        from . import topo as _topo
+        return _topo.cart_sub(self, remain_dims)
+
+    def graph_neighbors(self, rank: Optional[int] = None):
+        if self.topo is None:
+            from .errors import MPI_ERR_TOPOLOGY
+            raise MPIException(MPI_ERR_TOPOLOGY, "no topology")
+        return self.topo.neighbors_of(self.rank if rank is None else rank)
+
+    def dist_graph_neighbors(self):
+        """(sources, destinations) of a dist-graph comm."""
+        from . import topo as _topo
+        if not isinstance(self.topo, _topo.DistGraphTopology):
+            from .errors import MPI_ERR_TOPOLOGY
+            raise MPIException(MPI_ERR_TOPOLOGY,
+                               "not a distributed-graph communicator")
+        return (list(self.topo.sources), list(self.topo.destinations))
+
+    def neighbor_allgather(self, sendbuf, recvbuf, count=None, datatype=None):
+        from . import topo as _topo
+        _topo.neighbor_allgather(self, sendbuf, recvbuf, count, datatype)
+
+    def neighbor_alltoall(self, sendbuf, recvbuf, count=None, datatype=None):
+        from . import topo as _topo
+        _topo.neighbor_alltoall(self, sendbuf, recvbuf, count, datatype)
+
+    def neighbor_alltoallv(self, sendbuf, sendcounts, sdispls, recvbuf,
+                           recvcounts, rdispls, datatype=None):
+        from . import topo as _topo
+        _topo.neighbor_alltoallv(self, sendbuf, sendcounts, sdispls, recvbuf,
+                                 recvcounts, rdispls, datatype)
+
+    # ------------------------------------------------------------------
     # RMA window constructors (SURVEY §2.1 RMA; src/mpi/rma/win_create.c)
     # ------------------------------------------------------------------
     def win_create(self, buf, disp_unit: int = 1):

@@ -1,0 +1,369 @@
+"""Process topologies: cartesian, graph, distributed graph + neighborhood
+collectives.
+
+Analog of the reference's src/mpi/topo/ (SURVEY §2.1 "topologies", §5.7 —
+halo exchange via Isend/Irecv + MPI_Cart is the long-context stencil
+skeleton). TPU mapping: a cartesian communicator whose dims mirror the
+jax Mesh axes is exactly the object the device-side halo exchange
+(ops/collectives ppermute rings, models/stencil) rides; cart_shift's
+(src, dst) pair is the host-side ppermute permutation entry.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .errors import (MPIException, MPI_ERR_ARG, MPI_ERR_DIMS, MPI_ERR_RANK,
+                     MPI_ERR_TOPOLOGY, mpi_assert)
+from .status import PROC_NULL, UNDEFINED
+
+
+class CartTopology:
+    kind = "cart"
+
+    def __init__(self, dims: Sequence[int], periods: Sequence[bool]):
+        self.dims = list(dims)
+        self.periods = [bool(p) for p in periods]
+        self.ndims = len(self.dims)
+
+    def coords_of(self, rank: int) -> List[int]:
+        """Row-major (C order) coordinates — matches MPI_Cart_coords."""
+        mpi_assert(0 <= rank < self.nnodes(), MPI_ERR_RANK,
+                   f"rank {rank} outside cart of {self.nnodes()}")
+        coords = []
+        for i in range(self.ndims - 1, -1, -1):
+            coords.append(rank % self.dims[i])
+            rank //= self.dims[i]
+        return coords[::-1]
+
+    def rank_of(self, coords: Sequence[int]) -> int:
+        rank = 0
+        for i, c in enumerate(coords):
+            d = self.dims[i]
+            if self.periods[i]:
+                c = c % d
+            elif not (0 <= c < d):
+                return PROC_NULL
+            rank = rank * d + c
+        return rank
+
+    def nnodes(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def neighbors_of(self, rank: int) -> List[int]:
+        """Neighbor order for cart neighborhood collectives (MPI 7.6):
+        for each dimension, (source_-1, dest_+1) i.e. [-1, +1] per dim."""
+        out = []
+        coords = self.coords_of(rank)
+        for dim in range(self.ndims):
+            for disp in (-1, +1):
+                c = list(coords)
+                c[dim] += disp
+                out.append(self.rank_of(c))
+        return out
+
+
+class GraphTopology:
+    kind = "graph"
+
+    def __init__(self, index: Sequence[int], edges: Sequence[int]):
+        self.index = list(index)
+        self.edges = list(edges)
+
+    def neighbors_of(self, rank: int) -> List[int]:
+        lo = self.index[rank - 1] if rank > 0 else 0
+        return self.edges[lo:self.index[rank]]
+
+
+class DistGraphTopology:
+    kind = "dist_graph"
+
+    def __init__(self, sources: Sequence[int], destinations: Sequence[int],
+                 sweights=None, dweights=None):
+        self.sources = list(sources)          # ranks that send to me
+        self.destinations = list(destinations)  # ranks I send to
+        self.sweights = list(sweights) if sweights is not None else None
+        self.dweights = list(dweights) if dweights is not None else None
+
+    def neighbors_of(self, rank: int) -> List[int]:
+        # for neighborhood collectives: recv from sources, send to dests
+        return list(self.destinations)
+
+
+# ---------------------------------------------------------------------------
+# constructors (collective)
+# ---------------------------------------------------------------------------
+
+def dims_create(nnodes: int, ndims: int,
+                dims: Optional[Sequence[int]] = None) -> List[int]:
+    """MPI_Dims_create: balanced factorization, honoring fixed entries."""
+    out = list(dims) if dims is not None else [0] * ndims
+    mpi_assert(len(out) == ndims, MPI_ERR_DIMS, "dims length mismatch")
+    fixed = 1
+    free_idx = [i for i, d in enumerate(out) if d == 0]
+    for d in out:
+        if d:
+            mpi_assert(d > 0, MPI_ERR_DIMS, f"negative dim {d}")
+            fixed *= d
+    mpi_assert(nnodes % max(fixed, 1) == 0, MPI_ERR_DIMS,
+               f"nnodes {nnodes} not divisible by fixed dims {fixed}")
+    rem = nnodes // max(fixed, 1)
+    if not free_idx:
+        mpi_assert(rem == 1, MPI_ERR_DIMS, "dims don't cover nnodes")
+        return out
+    # factor rem into len(free_idx) balanced factors, largest first
+    nfree = len(free_idx)
+    factors = [1] * nfree
+    # prime factorization, assign largest primes to smallest buckets
+    n = rem
+    primes = []
+    p = 2
+    while p * p <= n:
+        while n % p == 0:
+            primes.append(p)
+            n //= p
+        p += 1
+    if n > 1:
+        primes.append(n)
+    for prime in sorted(primes, reverse=True):
+        k = factors.index(min(factors))
+        factors[k] *= prime
+    factors.sort(reverse=True)
+    for i, f in zip(free_idx, factors):
+        out[i] = f
+    return out
+
+
+def cart_create(comm, dims: Sequence[int], periods: Sequence[bool],
+                reorder: bool = False):
+    """MPI_Cart_create: returns a new comm with cartesian topology (None on
+    ranks left out)."""
+    for d in dims:
+        mpi_assert(d > 0, MPI_ERR_DIMS, f"non-positive cart dim {d}")
+    nnodes = int(np.prod(dims)) if len(dims) else 1
+    mpi_assert(nnodes <= comm.size, MPI_ERR_DIMS,
+               f"cart of {nnodes} > comm size {comm.size}")
+    sub = comm.split(0 if comm.rank < nnodes else None, comm.rank)
+    if sub is None:
+        return None
+    sub.topo = CartTopology(dims, periods)
+    sub.set_name(f"{comm.get_name()}_cart")
+    return sub
+
+
+def graph_create(comm, index: Sequence[int], edges: Sequence[int],
+                 reorder: bool = False):
+    nnodes = len(index)
+    mpi_assert(nnodes <= comm.size, MPI_ERR_TOPOLOGY,
+               f"graph of {nnodes} > comm size {comm.size}")
+    sub = comm.split(0 if comm.rank < nnodes else None, comm.rank)
+    if sub is None:
+        return None
+    sub.topo = GraphTopology(index, edges)
+    return sub
+
+
+def dist_graph_create_adjacent(comm, sources: Sequence[int],
+                               destinations: Sequence[int],
+                               sweights=None, dweights=None,
+                               reorder: bool = False):
+    sub = comm.dup()
+    sub.topo = DistGraphTopology(sources, destinations, sweights, dweights)
+    return sub
+
+
+def dist_graph_create(comm, sources: Sequence[int],
+                      degrees: Sequence[int], destinations: Sequence[int],
+                      reorder: bool = False):
+    """General constructor: each rank contributes edges (sources[i] ->
+    destinations chunk); assemble the full adjacency by allgatherv-style
+    exchange, then each rank extracts its in/out neighbor lists."""
+    # flatten my contributed edges as (src, dst) pairs
+    pairs = []
+    off = 0
+    for s, deg in zip(sources, degrees):
+        for k in range(deg):
+            pairs.append((int(s), int(destinations[off + k])))
+        off += deg
+    mine = np.array(pairs, dtype=np.int64).reshape(-1) if pairs else \
+        np.empty(0, dtype=np.int64)
+    counts = np.zeros(comm.size, dtype=np.int64)
+    comm.allgather(np.array([mine.size], dtype=np.int64), counts, count=1)
+    total = int(counts.sum())
+    allpairs = np.zeros(total, dtype=np.int64)
+    comm.allgatherv(mine, allpairs, [int(c) for c in counts])
+    edges = allpairs.reshape(-1, 2)
+    me = comm.rank
+    in_n = [int(s) for s, d in edges if d == me]
+    out_n = [int(d) for s, d in edges if s == me]
+    sub = comm.dup()
+    sub.topo = DistGraphTopology(in_n, out_n)
+    return sub
+
+
+# ---------------------------------------------------------------------------
+# accessors (operate on a comm carrying .topo)
+# ---------------------------------------------------------------------------
+
+def _cart(comm) -> CartTopology:
+    t = comm.topo
+    if not isinstance(t, CartTopology):
+        raise MPIException(MPI_ERR_TOPOLOGY, "no cartesian topology")
+    return t
+
+
+def topo_test(comm) -> str:
+    """MPI_Topo_test: 'cart' | 'graph' | 'dist_graph' | 'undefined'."""
+    return comm.topo.kind if comm.topo is not None else "undefined"
+
+
+def cart_shift(comm, direction: int, disp: int = 1) -> Tuple[int, int]:
+    """(rank_source, rank_dest) for a shift along ``direction``."""
+    t = _cart(comm)
+    mpi_assert(0 <= direction < t.ndims, MPI_ERR_ARG,
+               f"bad direction {direction}")
+    coords = t.coords_of(comm.rank)
+    up = list(coords)
+    up[direction] += disp
+    down = list(coords)
+    down[direction] -= disp
+    return t.rank_of(down), t.rank_of(up)
+
+
+def cart_sub(comm, remain_dims: Sequence[bool]):
+    """MPI_Cart_sub: slice the grid into sub-grids keeping remain dims."""
+    t = _cart(comm)
+    coords = t.coords_of(comm.rank)
+    color = 0
+    for i, keep in enumerate(remain_dims):
+        if not keep:
+            color = color * t.dims[i] + coords[i]
+    key = 0
+    for i, keep in enumerate(remain_dims):
+        if keep:
+            key = key * t.dims[i] + coords[i]
+    sub = comm.split(color, key)
+    sub.topo = CartTopology([d for d, k in zip(t.dims, remain_dims) if k],
+                            [p for p, k in zip(t.periods, remain_dims) if k])
+    return sub
+
+
+def cart_map(comm, dims: Sequence[int], periods: Sequence[bool]) -> int:
+    """MPI_Cart_map: suggested rank (identity placement here)."""
+    nnodes = int(np.prod(dims))
+    return comm.rank if comm.rank < nnodes else UNDEFINED
+
+
+# ---------------------------------------------------------------------------
+# neighborhood collectives (MPI 7.6)
+# ---------------------------------------------------------------------------
+
+def _neighbor_lists(comm) -> Tuple[List[int], List[int]]:
+    """(recv_from, send_to) in standard neighbor order."""
+    t = comm.topo
+    if t is None:
+        raise MPIException(MPI_ERR_TOPOLOGY, "no topology on comm")
+    if isinstance(t, DistGraphTopology):
+        return list(t.sources), list(t.destinations)
+    n = t.neighbors_of(comm.rank)
+    return list(n), list(n)
+
+
+def neighbor_allgather(comm, sendbuf, recvbuf, count: Optional[int] = None,
+                       datatype=None) -> None:
+    """Each rank sends its buffer to every out-neighbor; receives one block
+    per in-neighbor into recvbuf (block i at element offset i*count).
+
+    Duplicate neighbors (e.g. a 2-rank periodic cart where left == right)
+    match in post order — recv slot k gets the peer's k-th send — the same
+    FIFO discipline MPICH's isend/irecv schedules produce."""
+    from . import datatype as dtmod
+    srcs, dsts = _neighbor_lists(comm)
+    if not srcs and not dsts:
+        return
+    arr = np.asarray(sendbuf)
+    if count is None:
+        count = arr.size
+    dt = datatype or dtmod.from_numpy_dtype(arr.dtype)
+    rflat = np.asarray(recvbuf).reshape(-1)
+    mpi_assert(rflat.size >= len(srcs) * count, MPI_ERR_ARG,
+               f"recvbuf too small: {rflat.size} < {len(srcs) * count}")
+    reqs = []
+    tag = comm.next_coll_tag()
+    for i, s in enumerate(srcs):
+        if s == PROC_NULL:
+            continue   # MPI: PROC_NULL neighbor leaves recvbuf unchanged
+        seg = rflat[i * count:(i + 1) * count]
+        reqs.append(comm.irecv(seg, s, tag, count=count, datatype=dt))
+    for d in dsts:
+        if d == PROC_NULL:
+            continue
+        reqs.append(comm.isend(sendbuf, d, tag, count=count, datatype=dt))
+    for r in reqs:
+        r.wait()
+
+
+def neighbor_alltoall(comm, sendbuf, recvbuf, count: Optional[int] = None,
+                      datatype=None) -> None:
+    """Distinct block per neighbor in both directions (block j of sendbuf
+    to out-neighbor j; block i of recvbuf from in-neighbor i). Duplicate
+    neighbors match in post order (see neighbor_allgather)."""
+    from . import datatype as dtmod
+    srcs, dsts = _neighbor_lists(comm)
+    if not srcs and not dsts:
+        return
+    sflat = np.asarray(sendbuf).reshape(-1)
+    rflat = np.asarray(recvbuf).reshape(-1)
+    if count is None:
+        mpi_assert(dsts and sflat.size % len(dsts) == 0, MPI_ERR_ARG,
+                   "cannot infer block count")
+        count = sflat.size // len(dsts)
+    mpi_assert(sflat.size >= len(dsts) * count, MPI_ERR_ARG,
+               f"sendbuf too small: {sflat.size} < {len(dsts) * count}")
+    mpi_assert(rflat.size >= len(srcs) * count, MPI_ERR_ARG,
+               f"recvbuf too small: {rflat.size} < {len(srcs) * count}")
+    dt = datatype or dtmod.from_numpy_dtype(sflat.dtype)
+    tag = comm.next_coll_tag()
+    reqs = []
+    for i, s in enumerate(srcs):
+        if s == PROC_NULL:
+            continue   # MPI: PROC_NULL neighbor leaves recvbuf unchanged
+        seg = rflat[i * count:(i + 1) * count]
+        reqs.append(comm.irecv(seg, s, tag, count=count, datatype=dt))
+    for j, d in enumerate(dsts):
+        if d == PROC_NULL:
+            continue
+        seg = sflat[j * count:(j + 1) * count]
+        reqs.append(comm.isend(seg, d, tag, count=count, datatype=dt))
+    for r in reqs:
+        r.wait()
+
+
+def neighbor_alltoallv(comm, sendbuf, sendcounts, sdispls, recvbuf,
+                       recvcounts, rdispls, datatype=None) -> None:
+    from . import datatype as dtmod
+    srcs, dsts = _neighbor_lists(comm)
+    sarr = np.asarray(sendbuf)
+    rarr = np.asarray(recvbuf)
+    dt = datatype or dtmod.from_numpy_dtype(sarr.dtype)
+    tag = comm.next_coll_tag()
+    reqs = []
+    for i, s in enumerate(srcs):
+        if s == PROC_NULL or recvcounts[i] == 0:
+            continue
+        seg = rarr[rdispls[i]:rdispls[i] + recvcounts[i]]
+        reqs.append(comm.irecv(seg, s, tag, count=recvcounts[i],
+                               datatype=dt))
+    for i, d in enumerate(dsts):
+        if d == PROC_NULL or sendcounts[i] == 0:
+            continue
+        seg = sarr[sdispls[i]:sdispls[i] + sendcounts[i]]
+        reqs.append(comm.isend(seg, d, tag, count=sendcounts[i],
+                               datatype=dt))
+    for r in reqs:
+        r.wait()

@@ -1,0 +1,214 @@
+"""Topology tests — modeled on the reference's test/mpi/topo area
+(cartmap, cartshift, cartsuball, dims, graphmap, dgraph_adjacent,
+neighb_coll)."""
+
+import numpy as np
+import pytest
+
+from mvapich2_tpu.core import topo
+from mvapich2_tpu.core.errors import MPIException
+from mvapich2_tpu.core.status import PROC_NULL
+from mvapich2_tpu.runtime.universe import run_ranks
+
+
+def test_dims_create():
+    assert sorted(topo.dims_create(12, 2), reverse=True) == [4, 3]
+    assert topo.dims_create(8, 3) == [2, 2, 2]
+    assert topo.dims_create(7, 1) == [7]
+    assert topo.dims_create(6, 2, [3, 0]) == [3, 2]
+    assert topo.dims_create(1, 2) == [1, 1]
+    with pytest.raises(MPIException):
+        topo.dims_create(7, 2, [2, 0])  # 7 not divisible by 2
+
+
+def test_cart_coords_rank_roundtrip():
+    t = topo.CartTopology([2, 3, 4], [True, False, True])
+    for r in range(24):
+        assert t.rank_of(t.coords_of(r)) == r
+    # periodic wrap in dim 0 and 2, PROC_NULL off-edge in dim 1
+    assert t.rank_of([2, 0, 0]) == t.rank_of([0, 0, 0])
+    assert t.rank_of([0, 3, 0]) == PROC_NULL
+    assert t.rank_of([0, 0, 4]) == t.rank_of([0, 0, 0])
+
+
+def test_cart_create_shift_ring():
+    def body(comm):
+        cart = comm.cart_create([comm.size], periods=[True])
+        src, dst = cart.cart_shift(0, 1)
+        assert src == (cart.rank - 1) % cart.size
+        assert dst == (cart.rank + 1) % cart.size
+        assert cart.topo_test() == "cart"
+        # shift data around the ring via sendrecv
+        buf = np.array([cart.rank], dtype=np.int64)
+        out = np.zeros(1, dtype=np.int64)
+        cart.sendrecv(buf, dst, 0, out, src, 0)
+        assert out[0] == src
+        return True
+    assert all(run_ranks(4, body))
+
+
+def test_cart_nonperiodic_edges():
+    def body(comm):
+        cart = comm.cart_create([comm.size], periods=[False])
+        src, dst = cart.cart_shift(0, 1)
+        if cart.rank == 0:
+            assert src == PROC_NULL
+        if cart.rank == cart.size - 1:
+            assert dst == PROC_NULL
+        # sendrecv with PROC_NULL peers must still complete
+        buf = np.array([cart.rank], dtype=np.int64)
+        out = np.full(1, -1, dtype=np.int64)
+        cart.sendrecv(buf, dst, 0, out, src, 0)
+        if src != PROC_NULL:
+            assert out[0] == src
+        return True
+    assert all(run_ranks(4, body))
+
+
+def test_cart_2d_sub():
+    def body(comm):
+        cart = comm.cart_create([2, 2], periods=[False, False])
+        dims, periods, coords = cart.cart_get()
+        assert dims == [2, 2]
+        assert coords == [cart.rank // 2, cart.rank % 2]
+        # rows: keep dim 1
+        row = cart.cart_sub([False, True])
+        assert row.size == 2
+        assert row.rank == coords[1]
+        # row members share coords[0]
+        got = np.zeros(row.size, dtype=np.int64)
+        row.allgather(np.array([coords[0]], dtype=np.int64), got, count=1)
+        assert np.all(got == coords[0])
+        return True
+    assert all(run_ranks(4, body))
+
+
+def test_graph_create_neighbors():
+    def body(comm):
+        # square ring graph: 0-1-2-3-0
+        index = [2, 4, 6, 8]
+        edges = [1, 3, 0, 2, 1, 3, 2, 0]
+        g = comm.graph_create(index, edges)
+        n = g.graph_neighbors()
+        assert sorted(n) == sorted([(g.rank - 1) % 4, (g.rank + 1) % 4])
+        assert g.topo_test() == "graph"
+        return True
+    assert all(run_ranks(4, body))
+
+
+def test_dist_graph_adjacent_and_neighbor_alltoall():
+    def body(comm):
+        left = (comm.rank - 1) % comm.size
+        right = (comm.rank + 1) % comm.size
+        dg = comm.dist_graph_create_adjacent([left, right], [left, right])
+        srcs, dsts = dg.dist_graph_neighbors()
+        assert srcs == [left, right] and dsts == [left, right]
+        # neighbor_alltoall: send distinct value to each side
+        sbuf = np.array([dg.rank * 10 + 1, dg.rank * 10 + 2], dtype=np.int64)
+        rbuf = np.zeros(2, dtype=np.int64)
+        dg.neighbor_alltoall(sbuf, rbuf, count=1)
+        # from left neighbor we get its block-for-right (= l*10+2);
+        # from right neighbor its block-for-left (= r*10+1)
+        assert rbuf[0] == left * 10 + 2, (dg.rank, rbuf)
+        assert rbuf[1] == right * 10 + 1, (dg.rank, rbuf)
+        return True
+    assert all(run_ranks(4, body))
+
+
+def test_dist_graph_general():
+    def body(comm):
+        # each rank declares one edge: rank -> (rank+1)%size
+        dg = comm.dist_graph_create([comm.rank], [1],
+                                    [(comm.rank + 1) % comm.size])
+        srcs, dsts = dg.dist_graph_neighbors()
+        assert dsts == [(comm.rank + 1) % comm.size]
+        assert srcs == [(comm.rank - 1) % comm.size]
+        return True
+    assert all(run_ranks(4, body))
+
+
+def test_neighbor_allgather_cart():
+    def body(comm):
+        cart = comm.cart_create([comm.size], periods=[True])
+        sbuf = np.array([cart.rank + 100], dtype=np.int64)
+        rbuf = np.zeros(2, dtype=np.int64)   # [-1, +1] neighbors
+        cart.neighbor_allgather(sbuf, rbuf, count=1)
+        left = (cart.rank - 1) % cart.size
+        right = (cart.rank + 1) % cart.size
+        assert rbuf[0] == left + 100 and rbuf[1] == right + 100, rbuf
+        return True
+    assert all(run_ranks(4, body))
+
+
+def test_neighbor_allgather_halo_2d():
+    """The stencil halo-exchange skeleton (SURVEY §5.7) on a 2x2 torus."""
+    def body(comm):
+        cart = comm.cart_create([2, 2], periods=[True, True])
+        interior = np.full(4, float(cart.rank), dtype=np.float64)
+        halo = np.zeros((4, 4), dtype=np.float64)   # 4 neighbors
+        cart.neighbor_allgather(interior, halo, count=4)
+        nb = cart.topo.neighbors_of(cart.rank)
+        for i, r in enumerate(nb):
+            assert np.all(halo[i] == float(r)), (cart.rank, i, halo)
+        return True
+    assert all(run_ranks(4, body))
+
+
+def test_neighbor_alltoallv():
+    def body(comm):
+        left = (comm.rank - 1) % comm.size
+        right = (comm.rank + 1) % comm.size
+        dg = comm.dist_graph_create_adjacent([left, right], [left, right])
+        # send 1 elem to left, 2 to right
+        sbuf = np.array([dg.rank, dg.rank + 500, dg.rank + 501],
+                        dtype=np.int64)
+        rbuf = np.zeros(3, dtype=np.int64)
+        dg.neighbor_alltoallv(sbuf, [1, 2], [0, 1], rbuf, [2, 1], [0, 2])
+        # left sent me its right-block (2 elems), right its left-block (1)
+        assert rbuf[0] == left + 500 and rbuf[1] == left + 501, rbuf
+        assert rbuf[2] == right, rbuf
+        return True
+    assert all(run_ranks(4, body))
+
+
+def test_cart_create_fewer_ranks():
+    def body(comm):
+        cart = comm.cart_create([2], periods=[False])
+        if comm.rank >= 2:
+            assert cart is None
+            return True
+        assert cart.size == 2
+        return True
+    assert all(run_ranks(4, body))
+
+
+def test_neighbor_duplicate_peer_2rank_ring():
+    """2-rank periodic cart: left == right. FIFO post-order matching
+    (MPICH-compatible): recv slot k gets the peer's k-th send block."""
+    def body(comm):
+        cart = comm.cart_create([2], periods=[True])
+        sbuf = np.array([cart.rank * 10, cart.rank * 10 + 1], dtype=np.int64)
+        rbuf = np.full(2, -1, dtype=np.int64)
+        cart.neighbor_alltoall(sbuf, rbuf, count=1)
+        peer = 1 - cart.rank
+        assert rbuf[0] == peer * 10 and rbuf[1] == peer * 10 + 1, rbuf
+        return True
+    assert all(run_ranks(2, body))
+
+
+def test_neighbor_empty_and_oversized():
+    def body(comm):
+        dg = comm.dist_graph_create_adjacent([], [])
+        dg.neighbor_alltoall(np.empty(0, np.int64), np.empty(0, np.int64),
+                             count=1)   # no-op, must not crash
+        # over-allocated recvbuf: blocks land at i*count, not spread out
+        left = (comm.rank - 1) % comm.size
+        right = (comm.rank + 1) % comm.size
+        dg2 = comm.dist_graph_create_adjacent([left, right], [left, right])
+        rbuf = np.full(8, -1, dtype=np.int64)
+        dg2.neighbor_allgather(np.array([comm.rank], dtype=np.int64),
+                               rbuf, count=1)
+        assert rbuf[0] == left and rbuf[1] == right
+        assert np.all(rbuf[2:] == -1)
+        return True
+    assert all(run_ranks(4, body))
