@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence
 
+
 import numpy as np
 
 from ..core.datatype import Datatype, as_bytes_view, from_numpy_dtype
@@ -312,8 +313,31 @@ def exscan(comm, sendbuf, recvbuf, count: int, datatype: Optional[Datatype],
 
 
 def _select(comm, name: str, nbytes: int, op: Optional[Op] = None):
-    """Dispatch through the per-comm table (installed by tuning layer)."""
+    """Dispatch through the per-comm table (installed by tuning layer).
+    Wraps the chosen algorithm with an MPI_T timer+counter pvar pair —
+    the MPIR_T_PVAR_DOUBLE_TIMER analog of allreduce_osu.c:35-50."""
     if not comm.coll_fns:
         from .tuning import install_coll_ops
         install_coll_ops(comm)
-    return comm.coll_fns["_select"](name, nbytes, op)
+    fn = comm.coll_fns["_select"](name, nbytes, op)
+    cached = _timed_cache.get((name, fn))
+    if cached is None:
+        from .. import mpit
+        algo = getattr(fn, "__name__", "unknown")
+        timer = mpit.pvar(f"coll_{name}_{algo}_time", mpit.PVAR_CLASS_TIMER,
+                          "coll", f"cumulative seconds in {name}/{algo}")
+        counter = mpit.pvar(f"coll_{name}_{algo}_calls",
+                            mpit.PVAR_CLASS_COUNTER, "coll",
+                            f"invocations of {name}/{algo}")
+        def cached(*a, _fn=fn, _t=timer, _c=counter, **kw):
+            _c.inc()
+            with _t.timing():
+                return _fn(*a, **kw)
+
+        cached.__name__ = algo
+        _timed_cache[(name, fn)] = cached
+    return cached
+
+
+# (coll name, algorithm fn) -> timed wrapper; bounded by the algorithm zoo
+_timed_cache: dict = {}

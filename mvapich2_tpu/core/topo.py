@@ -76,6 +76,8 @@ class GraphTopology:
         self.edges = list(edges)
 
     def neighbors_of(self, rank: int) -> List[int]:
+        mpi_assert(0 <= rank < len(self.index), MPI_ERR_RANK,
+                   f"rank {rank} outside graph of {len(self.index)}")
         lo = self.index[rank - 1] if rank > 0 else 0
         return self.edges[lo:self.index[rank]]
 
@@ -263,6 +265,21 @@ def cart_map(comm, dims: Sequence[int], periods: Sequence[bool]) -> int:
 # neighborhood collectives (MPI 7.6)
 # ---------------------------------------------------------------------------
 
+def _flat_recv(recvbuf) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Contiguous flat view of recvbuf, or a scratch copy + writeback
+    target when the buffer is strided (reshape(-1) would silently copy
+    and drop the received data)."""
+    arr = np.asarray(recvbuf)
+    if arr.flags["C_CONTIGUOUS"]:
+        return arr.reshape(-1), None
+    return arr.flatten(), arr   # flatten preserves untouched slots
+
+
+def _writeback(flat: np.ndarray, orig: Optional[np.ndarray]) -> None:
+    if orig is not None:
+        orig.flat[:] = flat
+
+
 def _neighbor_lists(comm) -> Tuple[List[int], List[int]]:
     """(recv_from, send_to) in standard neighbor order."""
     t = comm.topo
@@ -290,7 +307,7 @@ def neighbor_allgather(comm, sendbuf, recvbuf, count: Optional[int] = None,
     if count is None:
         count = arr.size
     dt = datatype or dtmod.from_numpy_dtype(arr.dtype)
-    rflat = np.asarray(recvbuf).reshape(-1)
+    rflat, orig = _flat_recv(recvbuf)
     mpi_assert(rflat.size >= len(srcs) * count, MPI_ERR_ARG,
                f"recvbuf too small: {rflat.size} < {len(srcs) * count}")
     reqs = []
@@ -306,6 +323,7 @@ def neighbor_allgather(comm, sendbuf, recvbuf, count: Optional[int] = None,
         reqs.append(comm.isend(sendbuf, d, tag, count=count, datatype=dt))
     for r in reqs:
         r.wait()
+    _writeback(rflat, orig)
 
 
 def neighbor_alltoall(comm, sendbuf, recvbuf, count: Optional[int] = None,
@@ -317,8 +335,8 @@ def neighbor_alltoall(comm, sendbuf, recvbuf, count: Optional[int] = None,
     srcs, dsts = _neighbor_lists(comm)
     if not srcs and not dsts:
         return
-    sflat = np.asarray(sendbuf).reshape(-1)
-    rflat = np.asarray(recvbuf).reshape(-1)
+    sflat = np.ascontiguousarray(np.asarray(sendbuf)).reshape(-1)
+    rflat, orig = _flat_recv(recvbuf)
     if count is None:
         mpi_assert(dsts and sflat.size % len(dsts) == 0, MPI_ERR_ARG,
                    "cannot infer block count")
@@ -342,14 +360,15 @@ def neighbor_alltoall(comm, sendbuf, recvbuf, count: Optional[int] = None,
         reqs.append(comm.isend(seg, d, tag, count=count, datatype=dt))
     for r in reqs:
         r.wait()
+    _writeback(rflat, orig)
 
 
 def neighbor_alltoallv(comm, sendbuf, sendcounts, sdispls, recvbuf,
                        recvcounts, rdispls, datatype=None) -> None:
     from . import datatype as dtmod
     srcs, dsts = _neighbor_lists(comm)
-    sarr = np.asarray(sendbuf)
-    rarr = np.asarray(recvbuf)
+    sarr = np.ascontiguousarray(np.asarray(sendbuf)).reshape(-1)
+    rarr, orig = _flat_recv(recvbuf)
     dt = datatype or dtmod.from_numpy_dtype(sarr.dtype)
     tag = comm.next_coll_tag()
     reqs = []
@@ -367,3 +386,4 @@ def neighbor_alltoallv(comm, sendbuf, sendcounts, sdispls, recvbuf,
                                datatype=dt))
     for r in reqs:
         r.wait()
+    _writeback(rarr, orig)

@@ -40,10 +40,14 @@ def Init(required: int = THREAD_SINGLE) -> int:
     if u is not None and u.initialized:
         return min(required, _provided_level)
     from .runtime.bootstrap import bootstrap_from_env
-    u = bootstrap_from_env()
-    _uni.set_universe(u, process_wide=True)
+    from .utils import timestamps as ts
+    with ts.phase("MPI_Init"):
+        u = bootstrap_from_env()
+        _uni.set_universe(u, process_wide=True)
     if get_config()["SHOW_ENV_INFO"] and u.world_rank == 0:
         print(get_config().dump())
+    if u.world_rank == 0:
+        ts.print_timestamps()
     return min(required, _provided_level)
 
 

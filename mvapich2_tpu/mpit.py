@@ -1,0 +1,247 @@
+"""MPI_T tools-information interface: cvars, pvars, categories.
+
+Analog of the reference's src/mpi_t/ (SURVEY §5.5 — cvar_read.c,
+pvar_session_create.c; 14.7k LoC) plus the MV2 channel counters in
+src/mpi_t/mv2_mpit.c:17-39 and the per-algorithm collective timers
+(allreduce_osu.c:35-50).
+
+Redesign: the cvar surface is a thin indexed view over utils.config's
+declarative registry (one declaration serves env parsing, enumeration and
+MPI_T, collapsing the reference's three cooperating layers). Pvars live in
+a process-global registry; counters are either owned (incremented by
+instrumented code) or sourced (a callable sampled at read time, e.g. a
+progress engine's poll count). Sessions follow MPI_T semantics: a handle
+bound in a session accumulates from its start value, so concurrent tools
+don't perturb each other.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .utils.config import CVar, get_config
+
+# MPI_T verbosity / scope / binding constants (subset)
+VERBOSITY_USER_BASIC = 221
+VERBOSITY_TUNER_BASIC = 333
+SCOPE_LOCAL = 0
+SCOPE_ALL = 1
+PVAR_CLASS_COUNTER = 0
+PVAR_CLASS_TIMER = 1
+PVAR_CLASS_LEVEL = 2
+PVAR_CLASS_HIGHWATERMARK = 3
+
+
+# ---------------------------------------------------------------------------
+# cvar surface (indexed view of the config registry)
+# ---------------------------------------------------------------------------
+
+def _cvar_list() -> List[CVar]:
+    return [get_config().cvars()[k] for k in sorted(get_config().cvars())]
+
+
+def cvar_get_num() -> int:
+    return len(_cvar_list())
+
+
+def cvar_get_index(name: str) -> int:
+    for i, cv in enumerate(_cvar_list()):
+        if cv.name == name:
+            return i
+    raise KeyError(name)
+
+
+def cvar_get_info(index: int) -> Dict[str, Any]:
+    cv = _cvar_list()[index]
+    return {"name": cv.name, "type": cv.typ.__name__, "default": cv.default,
+            "category": cv.group, "desc": cv.desc,
+            "env": cv.env_name, "scope": SCOPE_LOCAL,
+            "verbosity": VERBOSITY_USER_BASIC}
+
+
+def cvar_read(index: int) -> Any:
+    return _cvar_list()[index].value
+
+
+def cvar_write(index: int, value: Any) -> None:
+    _cvar_list()[index].set_value(value)
+
+
+# ---------------------------------------------------------------------------
+# pvars
+# ---------------------------------------------------------------------------
+
+class PVar:
+    """One performance variable. Owned pvars are incremented by the
+    instrumented code path; sourced pvars sample ``source()`` at read."""
+
+    def __init__(self, name: str, klass: int, group: str, desc: str,
+                 source: Optional[Callable[[], float]] = None):
+        self.name = name
+        self.klass = klass
+        self.group = group
+        self.desc = desc
+        self.source = source
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    # -- instrumentation API ---------------------------------------------
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def mark(self, v: float) -> None:
+        """High-watermark update."""
+        with self._lock:
+            if v > self._value:
+                self._value = v
+
+    def add_time(self, dt: float) -> None:
+        self.inc(dt)
+
+    class _Timer:
+        def __init__(self, pv: "PVar"):
+            self.pv = pv
+
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self.pv.add_time(time.perf_counter() - self.t0)
+            return False
+
+    def timing(self) -> "PVar._Timer":
+        return PVar._Timer(self)
+
+    # -- read ------------------------------------------------------------
+    def read(self) -> float:
+        if self.source is not None:
+            return float(self.source())
+        with self._lock:
+            return self._value
+
+    def reset(self) -> None:
+        if self.source is None:
+            with self._lock:
+                self._value = 0.0
+
+
+class _PvarRegistry:
+    def __init__(self):
+        self._vars: Dict[str, PVar] = {}
+        self._lock = threading.Lock()
+
+    def declare(self, name: str, klass: int, group: str, desc: str,
+                source: Optional[Callable[[], float]] = None) -> PVar:
+        with self._lock:
+            pv = self._vars.get(name)
+            if pv is None:
+                pv = PVar(name, klass, group, desc, source)
+                self._vars[name] = pv
+            elif source is not None:
+                pv.source = source   # rebind live source (fresh universe)
+            return pv
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._vars)
+
+    def get(self, name: str) -> PVar:
+        return self._vars[name]
+
+
+_pvars = _PvarRegistry()
+
+
+def pvar(name: str, klass: int = PVAR_CLASS_COUNTER, group: str = "general",
+         desc: str = "", source: Optional[Callable[[], float]] = None) -> PVar:
+    """Declare (or fetch) a pvar — instrumentation-side entry point."""
+    return _pvars.declare(name, klass, group, desc, source)
+
+
+def pvar_get_num() -> int:
+    return len(_pvars.names())
+
+
+def pvar_get_info(index: int) -> Dict[str, Any]:
+    pv = _pvars.get(_pvars.names()[index])
+    return {"name": pv.name, "class": pv.klass, "category": pv.group,
+            "desc": pv.desc, "continuous": pv.source is not None}
+
+
+def pvar_get_index(name: str) -> int:
+    return _pvars.names().index(name)
+
+
+class PvarSession:
+    """MPI_T pvar session: handles accumulate relative to their start."""
+
+    def __init__(self):
+        self._handles: Dict[int, tuple] = {}   # handle -> (pvar, base)
+        self._next = 1
+
+    def handle_alloc(self, name_or_index) -> int:
+        name = name_or_index if isinstance(name_or_index, str) \
+            else _pvars.names()[name_or_index]
+        pv = _pvars.get(name)
+        h = self._next
+        self._next += 1
+        self._handles[h] = (pv, 0.0)
+        return h
+
+    def start(self, handle: int) -> None:
+        pv, _ = self._handles[handle]
+        self._handles[handle] = (pv, pv.read())
+
+    def read(self, handle: int) -> float:
+        """Counters/timers read relative to session start; watermark and
+        level pvars are instantaneous — a delta would be meaningless."""
+        pv, base = self._handles[handle]
+        if pv.klass in (PVAR_CLASS_HIGHWATERMARK, PVAR_CLASS_LEVEL):
+            return pv.read()
+        return pv.read() - base
+
+    def reset(self, handle: int) -> None:
+        self.start(handle)
+
+    def handle_free(self, handle: int) -> None:
+        self._handles.pop(handle, None)
+
+
+def pvar_session_create() -> PvarSession:
+    return PvarSession()
+
+
+# ---------------------------------------------------------------------------
+# categories
+# ---------------------------------------------------------------------------
+
+def category_get_num() -> int:
+    return len(category_names())
+
+
+def category_names() -> List[str]:
+    groups = {cv.group for cv in _cvar_list()}
+    groups.update(pv_group for pv_group in
+                  (_pvars.get(n).group for n in _pvars.names()))
+    return sorted(groups)
+
+
+def category_get_info(index: int) -> Dict[str, Any]:
+    name = category_names()[index]
+    cvars = [cv.name for cv in _cvar_list() if cv.group == name]
+    pvars = [n for n in _pvars.names() if _pvars.get(n).group == name]
+    return {"name": name, "num_cvars": len(cvars), "num_pvars": len(pvars),
+            "cvars": cvars, "pvars": pvars}
+
+
+def dump() -> str:
+    """Tool-style dump of every pvar's current value."""
+    lines = []
+    for n in _pvars.names():
+        pv = _pvars.get(n)
+        lines.append(f"{pv.name:<44} = {pv.read():<14g} [{pv.group}]")
+    return "\n".join(lines)

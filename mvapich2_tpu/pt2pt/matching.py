@@ -14,6 +14,17 @@ from typing import List, Optional
 
 from ..core.status import ANY_SOURCE, ANY_TAG
 from ..transport.base import Packet
+from .. import mpit
+
+# process-wide matching pvars (ch3u_recvq.c:95-105 instruments the same)
+_pv_attempts = mpit.pvar("recvq_match_attempts", mpit.PVAR_CLASS_COUNTER,
+                         "pt2pt", "envelope match attempts")
+_pv_unexp_hwm = mpit.pvar("recvq_unexpected_hwm",
+                          mpit.PVAR_CLASS_HIGHWATERMARK, "pt2pt",
+                          "unexpected-queue length high watermark")
+_pv_posted_hwm = mpit.pvar("recvq_posted_hwm",
+                           mpit.PVAR_CLASS_HIGHWATERMARK, "pt2pt",
+                           "posted-queue length high watermark")
 
 
 class Matcher:
@@ -29,6 +40,7 @@ class Matcher:
     def match_incoming(self, pkt: Packet):
         """Find & remove the first posted recv matching this envelope."""
         self.match_attempts += 1
+        _pv_attempts.inc()
         for req in self.posted:
             m = req.match
             if m[0] != pkt.ctx:
@@ -41,12 +53,14 @@ class Matcher:
             return req
         self.unexpected.append(pkt)
         self.unexpected_hwm = max(self.unexpected_hwm, len(self.unexpected))
+        _pv_unexp_hwm.mark(self.unexpected_hwm)
         return None
 
     # -- posted recv path -------------------------------------------------
     def match_posted(self, ctx: int, source: int, tag: int) -> Optional[Packet]:
         """Find & remove the first unexpected message matching the recv."""
         self.match_attempts += 1
+        _pv_attempts.inc()
         for pkt in self.unexpected:
             if not self._env_match(pkt, ctx, source, tag):
                 continue
@@ -77,6 +91,7 @@ class Matcher:
     def post(self, req) -> None:
         self.posted.append(req)
         self.posted_hwm = max(self.posted_hwm, len(self.posted))
+        _pv_posted_hwm.mark(self.posted_hwm)
 
     def cancel_posted(self, req) -> bool:
         """Remove a posted recv (MPI_Cancel); True if it was still queued."""

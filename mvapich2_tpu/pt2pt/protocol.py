@@ -35,6 +35,15 @@ log = get_logger("pt2pt")
 cvar("R3_CHUNK_SIZE", 1 << 18, int, "pt2pt",
      "Chunk size for packetized rendezvous data (R3 path).")
 
+from .. import mpit  # noqa: E402  (after cvar decls, same registry)
+
+_pv_eager = mpit.pvar("pt2pt_eager_sent", mpit.PVAR_CLASS_COUNTER, "pt2pt",
+                      "messages sent on the eager path")
+_pv_rndv = mpit.pvar("pt2pt_rndv_sent", mpit.PVAR_CLASS_COUNTER, "pt2pt",
+                     "messages sent on the rendezvous path")
+_pv_bytes = mpit.pvar("pt2pt_bytes_sent", mpit.PVAR_CLASS_COUNTER, "pt2pt",
+                      "total payload bytes sent")
+
 
 class SendRequest(Request):
     def __init__(self, engine, dest_world: int):
@@ -112,6 +121,8 @@ class Pt2ptProtocol:
             pkt = Packet(PktType.EAGER_SEND, self.u.world_rank, ctx, comm_src,
                          tag, nbytes, np.asarray(packed))
             channel.send_packet(dest_world, pkt)
+            _pv_eager.inc()
+            _pv_bytes.inc(nbytes)
             return CompletedRequest()
 
         # rendezvous (always used for Ssend so completion implies matching)
@@ -132,6 +143,8 @@ class Pt2ptProtocol:
                      extra={"handle": sreq.handle} if sreq.handle is not None
                      else None)
         channel.send_packet(dest_world, pkt)
+        _pv_rndv.inc()
+        _pv_bytes.inc(nbytes)
         return sreq
 
     # ------------------------------------------------------------------

@@ -86,12 +86,17 @@ class Universe:
     def initialize(self) -> None:
         from ..core.comm import Comm
         from ..core.group import Group
-        get_config().reload()
-        self.protocol = Pt2ptProtocol(self)
-        self.comm_world = Comm(self, Group(range(self.world_size)),
-                               context_id=0, name="MPI_COMM_WORLD")
-        self.comm_self = Comm(self, Group([self.world_rank]),
-                              context_id=2, name="MPI_COMM_SELF")
+        from ..utils import timestamps as ts
+        with ts.phase("MPID_Init"):
+            with ts.phase("config reload"):
+                get_config().reload()
+            with ts.phase("protocol + matcher"):
+                self.protocol = Pt2ptProtocol(self)
+            with ts.phase("comm_world/self"):
+                self.comm_world = Comm(self, Group(range(self.world_size)),
+                                       context_id=0, name="MPI_COMM_WORLD")
+                self.comm_self = Comm(self, Group([self.world_rank]),
+                                      context_id=2, name="MPI_COMM_SELF")
         self.initialized = True
 
     def allocate_context_id(self, parent_comm) -> int:

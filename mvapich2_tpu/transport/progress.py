@@ -47,6 +47,11 @@ class ProgressEngine:
         self.hooks: List[Callable[[], bool]] = []
         self.poll_count = 0      # MPI_T pvar analog (ch3_progress.c:218)
         self.shutdown = False
+        from .. import mpit
+        self._pv_polls = mpit.pvar("progress_polls",
+                                   mpit.PVAR_CLASS_COUNTER, "progress",
+                                   "progress-engine poll passes "
+                                   "(all ranks in this process)")
 
     # -- wiring -----------------------------------------------------------
     def add_channel(self, ch: Channel) -> None:
@@ -103,6 +108,7 @@ class ProgressEngine:
         """One nonblocking pass (MPID_Progress_test analog)."""
         with self.mutex:
             self.poll_count += 1
+            self._pv_polls.inc()
             did = self._drain_inbox() > 0
             for ch in self.channels:
                 if ch.poll():

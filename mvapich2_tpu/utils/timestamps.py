@@ -1,0 +1,118 @@
+"""Startup phase profiling — mv2_take_timestamp analog.
+
+The reference brackets every init phase with mv2_take_timestamp /
+mv2_print_timestamps probes (/root/reference/src/mpi/init/timestamp.c:122,
+253, used from initthread.c:489-492). Here: ``take_timestamp(label)`` marks
+enter/exit pairs (nesting allowed), ``print_timestamps()`` renders the tree
+with durations. Enabled by the STARTUP_TIMING cvar.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, TextIO
+
+from .config import cvar, get_config
+
+cvar("STARTUP_TIMING", False, bool, "debug",
+     "Record and print init-phase timestamps "
+     "(analog of MV2_TAKE_TIMESTAMP / mv2_print_timestamps).")
+
+
+class _Record:
+    __slots__ = ("label", "depth", "t_enter", "t_exit")
+
+    def __init__(self, label: str, depth: int, t_enter: float):
+        self.label = label
+        self.depth = depth
+        self.t_enter = t_enter
+        self.t_exit: Optional[float] = None
+
+
+class Timestamps:
+    def __init__(self):
+        self._records: List[_Record] = []
+        self._stack: List[_Record] = []
+        self._lock = threading.Lock()
+        self.t0 = time.perf_counter()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(get_config()["STARTUP_TIMING"])
+
+    def enter(self, label: str) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            rec = _Record(label, len(self._stack), time.perf_counter())
+            self._records.append(rec)
+            self._stack.append(rec)
+
+    def exit(self, label: str = "") -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            if self._stack:
+                self._stack.pop().t_exit = time.perf_counter()
+
+    class _Phase:
+        def __init__(self, ts: "Timestamps", label: str):
+            self.ts = ts
+            self.label = label
+
+        def __enter__(self):
+            self.ts.enter(self.label)
+            return self
+
+        def __exit__(self, *exc):
+            self.ts.exit(self.label)
+            return False
+
+    def phase(self, label: str) -> "Timestamps._Phase":
+        return Timestamps._Phase(self, label)
+
+    def render(self) -> str:
+        lines = ["startup timestamps (s since t0):"]
+        with self._lock:
+            for rec in self._records:
+                dur = ("%.6f" % (rec.t_exit - rec.t_enter)
+                       if rec.t_exit is not None else "open")
+                lines.append(f"  {'  ' * rec.depth}{rec.label:<40} "
+                             f"@{rec.t_enter - self.t0:.6f}  dur={dur}")
+        return "\n".join(lines)
+
+    def print(self, fh: Optional[TextIO] = None) -> None:
+        if not self.enabled:
+            return
+        import sys
+        print(self.render(), file=fh or sys.stderr)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._stack.clear()
+            self.t0 = time.perf_counter()
+
+
+_global = Timestamps()
+
+
+def take_timestamp(label: str, enter: bool = True) -> None:
+    """mv2_take_timestamp-style probe."""
+    if enter:
+        _global.enter(label)
+    else:
+        _global.exit(label)
+
+
+def phase(label: str):
+    return _global.phase(label)
+
+
+def print_timestamps() -> None:
+    _global.print()
+
+
+def get_timestamps() -> Timestamps:
+    return _global

@@ -1,0 +1,150 @@
+"""MPI_T introspection tests — modeled on the reference's test/mpi/mpi_t
+area (cvarwrite, getindex, mpit_vars) plus startup-timestamp checks."""
+
+import numpy as np
+
+from mvapich2_tpu import mpit
+from mvapich2_tpu.runtime.universe import run_ranks
+from mvapich2_tpu.utils import timestamps
+from mvapich2_tpu.utils.config import get_config
+
+
+def test_cvar_enumeration_and_info():
+    n = mpit.cvar_get_num()
+    assert n >= 5   # core knobs at minimum
+    names = set()
+    for i in range(n):
+        info = mpit.cvar_get_info(i)
+        assert info["name"] and info["env"].startswith("MV2T_")
+        assert info["type"] in ("int", "bool", "str", "float")
+        names.add(info["name"])
+    assert "EAGER_THRESHOLD" in names
+    assert "RNDV_PROTOCOL" in names
+
+
+def test_cvar_read_write_roundtrip():
+    i = mpit.cvar_get_index("EAGER_THRESHOLD")
+    old = mpit.cvar_read(i)
+    try:
+        mpit.cvar_write(i, 1234)
+        assert mpit.cvar_read(i) == 1234
+        assert get_config()["EAGER_THRESHOLD"] == 1234  # same registry
+    finally:
+        mpit.cvar_write(i, old)
+
+
+def test_pvar_counters_grow_with_traffic():
+    pv_names = mpit._pvars.names()
+    assert "recvq_match_attempts" in pv_names
+    assert "pt2pt_eager_sent" in pv_names
+
+    sess = mpit.pvar_session_create()
+    h_match = sess.handle_alloc("recvq_match_attempts")
+    h_eager = sess.handle_alloc("pt2pt_eager_sent")
+    h_bytes = sess.handle_alloc("pt2pt_bytes_sent")
+    sess.start(h_match)
+    sess.start(h_eager)
+    sess.start(h_bytes)
+
+    def body(comm):
+        buf = np.full(64, comm.rank, dtype=np.float64)
+        out = np.zeros(64, dtype=np.float64)
+        comm.sendrecv(buf, (comm.rank + 1) % comm.size, 7,
+                      out, (comm.rank - 1) % comm.size, 7)
+        return True
+
+    run_ranks(4, body)
+    assert sess.read(h_match) >= 4          # one recv match per rank
+    assert sess.read(h_eager) >= 4          # 64*8B rides eager
+    assert sess.read(h_bytes) >= 4 * 64 * 8
+    sess.handle_free(h_match)
+
+
+def test_pvar_session_isolation():
+    pv = mpit.pvar("test_isolated_counter", mpit.PVAR_CLASS_COUNTER,
+                   "test", "session isolation probe")
+    s1 = mpit.pvar_session_create()
+    s2 = mpit.pvar_session_create()
+    h1 = s1.handle_alloc("test_isolated_counter")
+    s1.start(h1)
+    pv.inc(5)
+    h2 = s2.handle_alloc("test_isolated_counter")
+    s2.start(h2)
+    pv.inc(2)
+    assert s1.read(h1) == 7
+    assert s2.read(h2) == 2
+
+
+def test_coll_algorithm_timers():
+    def body(comm):
+        out = comm.allreduce(np.ones(16))
+        assert out[0] == comm.size
+        return True
+
+    sess = mpit.pvar_session_create()
+    run_ranks(4, body)
+    # some allreduce algorithm timer + counter must now exist and be > 0
+    names = [n for n in mpit._pvars.names()
+             if n.startswith("coll_allreduce") and n.endswith("_calls")]
+    assert names, mpit._pvars.names()
+    assert any(mpit._pvars.get(n).read() > 0 for n in names)
+    tnames = [n.replace("_calls", "_time") for n in names]
+    assert all(mpit._pvars.get(n).klass == mpit.PVAR_CLASS_TIMER
+               for n in tnames)
+
+
+def test_categories():
+    cats = mpit.category_names()
+    assert "pt2pt" in cats and "coll" in cats
+    i = cats.index("pt2pt")
+    info = mpit.category_get_info(i)
+    assert info["num_cvars"] >= 1
+    assert "recvq_match_attempts" in info["pvars"]
+
+
+def test_progress_poll_pvar():
+    i = mpit.pvar_get_index("progress_polls")
+    info = mpit.pvar_get_info(i)
+    assert info["continuous"] is False
+    before = mpit._pvars.get("progress_polls").read()
+
+    def body(comm):
+        comm.barrier()
+        return True
+
+    run_ranks(2, body)
+    assert mpit._pvars.get("progress_polls").read() > before
+
+
+def test_dump_renders():
+    text = mpit.dump()
+    assert "recvq_match_attempts" in text
+
+
+def test_startup_timestamps():
+    get_config().set("STARTUP_TIMING", True)
+    try:
+        ts = timestamps.get_timestamps()
+        ts.reset()
+        with ts.phase("outer"):
+            with ts.phase("inner"):
+                pass
+        text = ts.render()
+        assert "outer" in text and "inner" in text
+        # inner is nested one level deeper
+        outer_line = next(l for l in text.splitlines() if "outer" in l)
+        inner_line = next(l for l in text.splitlines() if "inner" in l)
+        assert len(inner_line) - len(inner_line.lstrip()) > \
+            len(outer_line) - len(outer_line.lstrip())
+    finally:
+        get_config().set("STARTUP_TIMING", False)
+        timestamps.get_timestamps().reset()
+
+
+def test_timestamps_disabled_no_overhead():
+    ts = timestamps.get_timestamps()
+    ts.reset()
+    assert not ts.enabled
+    with ts.phase("should_not_record"):
+        pass
+    assert "should_not_record" not in ts.render()
