@@ -70,7 +70,8 @@ class Comm:
         self.topo = None            # set by mvapich2_tpu.core.topo
         self.is_inter = False
         self.freed = False
-        self.revoked = False        # ULFM
+        self.revoked = False        # ULFM (ft/ulfm.py)
+        self._acked_failures: set = set()   # world ranks acked (ULFM)
         self._coll_seq = 0          # collective tag sequencing
         self.coll_fns: Dict[str, Callable] = {}
         self._shmem_comm: Optional["Comm"] = None
@@ -79,6 +80,8 @@ class Comm:
         # device-mesh binding (ICI channel): set by parallel/mesh layer when
         # this comm maps onto a jax Mesh axis
         self.mesh_axis = None
+        # revoke-packet routing + failure unwind need ctx -> comm
+        universe.comms_by_ctx[context_id] = self
 
     # ------------------------------------------------------------------
     @property
@@ -474,6 +477,7 @@ class Comm:
         if self.freed:
             return
         self.attrs.delete_all(self)
+        self.u.comms_by_ctx.pop(self.context_id, None)
         self.freed = True
 
     # ------------------------------------------------------------------
@@ -596,6 +600,36 @@ class Comm:
     def win_create_dynamic(self):
         from ..rma import win as _rw
         return _rw.win_create_dynamic(self)
+
+    # ------------------------------------------------------------------
+    # ULFM fault tolerance (SURVEY §5.3; ft/ulfm.py)
+    # ------------------------------------------------------------------
+    def revoke(self) -> None:
+        from ..ft import ulfm
+        ulfm.revoke(self)
+
+    def is_revoked(self) -> bool:
+        return self.revoked
+
+    def shrink(self) -> "Comm":
+        from ..ft import ulfm
+        return ulfm.shrink(self)
+
+    def agree(self, flag: int) -> int:
+        from ..ft import ulfm
+        return ulfm.agree(self, flag)
+
+    def failure_ack(self) -> None:
+        from ..ft import ulfm
+        ulfm.failure_ack(self)
+
+    def failure_get_acked(self) -> Group:
+        from ..ft import ulfm
+        return ulfm.failure_get_acked(self)
+
+    def get_failed(self) -> Group:
+        from ..ft import ulfm
+        return ulfm.get_failed(self)
 
     # -- misc -------------------------------------------------------------
     def set_name(self, name: str) -> None:

@@ -1,0 +1,7 @@
+"""Fault tolerance (SURVEY §5.3): ULFM semantics + failure detection."""
+
+from .ulfm import (agree, failure_ack, failure_get_acked, get_failed,
+                   install, mark_failed, revoke, shrink)
+
+__all__ = ["agree", "failure_ack", "failure_get_acked", "get_failed",
+           "install", "mark_failed", "revoke", "shrink"]

@@ -1,0 +1,338 @@
+"""ULFM-style fault tolerance: revoke / shrink / agree / failure_ack.
+
+Analog of the reference's user-level failure-mitigation subset (SURVEY
+§5.3): MPIX_Comm_revoke (src/mpi/comm/comm_revoke.c, device side
+src/mpid/ch3/src/mpid_comm_revoke.c + ch3u_handle_revoke_pkt.c),
+MPIX_Comm_shrink (comm_shrink.c), MPIX_Comm_agree (comm_agree.c),
+MPIX_Comm_failure_ack / failure_get_acked (comm_failure_ack.c), and
+MPID_Comm_get_all_failed_procs (mpid_comm_get_all_failed_procs.c).
+
+Failure model (mirrors the reference's launcher-driven detection):
+  * a rank is *failed* once it lands in ``universe.failed_ranks`` — fed by
+    the mpirun job monitor through the KVS (process mode), by channel-level
+    connection errors, or by tests directly (the fault-injection analog of
+    test/mpi/ft/die.c).
+  * sends to a failed rank raise MPIX_ERR_PROC_FAILED; posted receives
+    that can no longer be satisfied are completed with the same class so
+    blocked collectives unwind (the reference surfaces this as VC failures
+    bubbling through the progress engine).
+  * revocation floods a REVOKE packet over the communicator
+    (ch3u_handle_revoke_pkt.c behavior): every member re-floods once,
+    pending operations on the revoked context complete with
+    MPIX_ERR_REVOKED.
+
+Shrink/agree run a failure-tolerant exchange directly over the pt2pt
+protocol (bypassing the comm's revoked/failed checks) among the believed
+survivors: two confirmation rounds of an all-to-all union of failure
+bitmaps — the flooding consensus the reference drives through its
+all-reduce on the "alive" group. Failures discovered mid-protocol mark the
+peer and the round is re-run (bounded by comm size).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+from ..core import datatype as dtmod
+from ..core.errors import (MPIException, MPIX_ERR_PROC_FAILED,
+                           MPIX_ERR_REVOKED)
+from ..core.group import Group
+from ..transport.base import Packet, PktType
+from ..utils.mlog import get_logger
+
+log = get_logger("ft")
+
+# tag space reserved for the FT agreement protocol — far above the
+# collective sequencer's 15-bit window (core/comm.py next_coll_tag)
+_FT_TAG_BASE = 0x7F0000
+
+
+# ---------------------------------------------------------------------------
+# failure detection plumbing
+# ---------------------------------------------------------------------------
+
+def install(universe) -> None:
+    """Wire the REVOKE packet handler into a rank's progress engine
+    (registered from Universe.initialize, the MPID_Init analog)."""
+    universe.engine.register_handler(
+        PktType.REVOKE, lambda pkt: _on_revoke(universe, pkt))
+
+
+def mark_failed(universe, world_rank: int) -> None:
+    """Record a process failure and unwind operations that depend on it.
+
+    This is the sink for every detection source: the KVS failure watcher
+    (process mode), channel connection errors, and test injection."""
+    eng = universe.engine
+    with eng.mutex:
+        if world_rank in universe.failed_ranks:
+            return
+        universe.failed_ranks.add(world_rank)
+        log.info("rank %d detected failure of world rank %d",
+                 universe.world_rank, world_rank)
+        _fail_dependent_recvs(universe, world_rank)
+    eng.wakeup()
+
+
+def _fail_dependent_recvs(universe, world_rank: int) -> None:
+    """Complete posted receives that the dead rank can never satisfy
+    (engine mutex held). Named-source recvs targeting the dead rank fail;
+    ANY_SOURCE recvs fail only while the failure is unacknowledged —
+    failure_ack() re-arms wildcard receives, per ULFM."""
+    from ..core.status import ANY_SOURCE
+    matcher = universe.protocol.matcher
+    for req in list(matcher.posted):
+        ctx, src, _tag = req.match
+        comm = universe.comms_by_ctx.get(ctx & ~1)
+        if comm is None or comm.freed:
+            continue
+        if src == ANY_SOURCE:
+            if world_rank in comm.group.world_ranks \
+                    and world_rank not in comm._acked_failures:
+                matcher.posted.remove(req)
+                req.complete(MPIException(
+                    MPIX_ERR_PROC_FAILED,
+                    f"wildcard recv with failed rank {world_rank}"))
+        elif comm.world_of(src) == world_rank:
+            matcher.posted.remove(req)
+            req.complete(MPIException(
+                MPIX_ERR_PROC_FAILED,
+                f"recv source (world rank {world_rank}) failed"))
+
+
+def comm_failed_world(comm) -> List[int]:
+    """World ranks of comm members currently known failed."""
+    return [w for w in comm.group.world_ranks
+            if w in comm.u.failed_ranks]
+
+
+def get_failed(comm) -> Group:
+    """MPID_Comm_get_all_failed_procs analog: Group of failed members."""
+    return Group(comm_failed_world(comm))
+
+
+def failure_ack(comm) -> None:
+    """MPIX_Comm_failure_ack: acknowledge current failures so ANY_SOURCE
+    receives are re-enabled over the survivors."""
+    comm._acked_failures = set(comm_failed_world(comm))
+
+
+def failure_get_acked(comm) -> Group:
+    """MPIX_Comm_failure_get_acked: the group acked by failure_ack."""
+    return Group(sorted(comm._acked_failures))
+
+
+# ---------------------------------------------------------------------------
+# revoke
+# ---------------------------------------------------------------------------
+
+def revoke(comm) -> None:
+    """MPIX_Comm_revoke: mark the communicator unusable everywhere.
+
+    Not collective — any member may call it; propagation floods a REVOKE
+    packet to every other live member (ch3u_handle_revoke_pkt.c re-floods
+    on first receipt, giving delivery despite failed intermediaries)."""
+    u = comm.u
+    with u.engine.mutex:
+        if comm.revoked:
+            return
+        comm.revoked = True
+        _fail_ctx_recvs(u, comm)
+    _flood_revoke(u, comm)
+    u.engine.wakeup()
+
+
+def _flood_revoke(u, comm) -> None:
+    for r in range(comm.size):
+        w = comm.world_of(r)
+        if w == u.world_rank or w in u.failed_ranks:
+            continue
+        try:
+            u.channel_for(w).send_packet(
+                w, Packet(PktType.REVOKE, u.world_rank,
+                          ctx=comm.context_id))
+        except Exception:
+            # peer died while we flooded: record, keep flooding the rest
+            mark_failed(u, w)
+
+
+def _on_revoke(u, pkt: Packet) -> None:
+    comm = u.comms_by_ctx.get(pkt.ctx & ~1)
+    if comm is None or comm.revoked:
+        return
+    comm.revoked = True
+    _fail_ctx_recvs(u, comm)
+    _flood_revoke(u, comm)   # re-flood once; `revoked` guards against storms
+    u.engine.wakeup()
+
+
+def _fail_ctx_recvs(u, comm) -> None:
+    """Complete posted recvs on the revoked contexts (engine mutex held).
+
+    Recvs in the FT tag range are exempt: shrink/agree must keep working
+    on a revoked comm, so a REVOKE landing mid-agreement must not kill the
+    agreement's own exchange (which would falsely mark live peers dead)."""
+    matcher = u.protocol.matcher
+    for req in list(matcher.posted):
+        if req.match[0] in (comm.ctx_pt2pt, comm.ctx_coll) \
+                and req.match[2] < _FT_TAG_BASE:
+            matcher.posted.remove(req)
+            req.complete(MPIException(MPIX_ERR_REVOKED,
+                                      "communicator revoked"))
+
+
+# ---------------------------------------------------------------------------
+# survivor agreement (the engine under shrink & agree)
+# ---------------------------------------------------------------------------
+
+def _agreement(comm, flag: int, timeout: float = 10.0):
+    """Failure-tolerant agreement among comm's surviving members.
+
+    Returns (failed_world_set, agreed_ctx, agreed_flag) — identical on all
+    survivors. Payload per round: a failure bitmap over the world, the
+    sender's next-free context id, the running AND of ``flag``, and a
+    "learned something last round" bit.
+
+    Protocol: repeated all-to-all union rounds. Termination: after the
+    first round in which my own and every received learned-bit is zero.
+    The bitmaps are monotone (failures are permanent), so once no rank
+    learned anything in round r-1, all bitmaps are equal and frozen —
+    every survivor then observes all-zero learned-bits in round r and
+    exits at the same round. A failure discovered mid-round (send error,
+    recv timeout, peer bitmap) sets the learned bit and extends the
+    protocol; the round count is bounded by comm size since each
+    extension consumes a distinct failure."""
+    u = comm.u
+    W = u.world_size
+    my_failed = np.zeros(W, np.uint8)
+    for w in u.failed_ranks:
+        my_failed[w] = 1
+    my_ctx = np.int64(u._next_ctx)
+    my_flag = np.int64(flag)
+    prev_learned = np.int64(1)   # force at least two rounds
+
+    for rnd in range(comm.size + 4):
+        tag = _FT_TAG_BASE + rnd
+        alive = [r for r in range(comm.size)
+                 if not my_failed[comm.world_of(r)]]
+        payload = np.concatenate(
+            [my_failed.astype(np.int64), [my_ctx, my_flag, prev_learned]])
+        views = _xchg_round(comm, alive, payload, tag, timeout)
+        learned = False
+        all_quiet = prev_learned == 0
+        union = my_failed.copy()
+        for r, view in views.items():
+            if view is None:            # r died mid-round
+                w = comm.world_of(r)
+                if not union[w]:
+                    union[w] = 1
+                learned = True
+                all_quiet = False
+                mark_failed(u, w)
+                continue
+            bits = (view[:W] != 0).astype(np.uint8)
+            if np.any(bits & ~union):
+                learned = True
+            union |= bits
+            my_ctx = max(my_ctx, np.int64(view[W]))
+            my_flag = np.int64(my_flag & view[W + 1])
+            if view[W + 2] != 0:
+                all_quiet = False
+        my_failed = union
+        prev_learned = np.int64(1 if learned else 0)
+        if all_quiet and not learned:
+            break
+    failed = {w for w in range(W) if my_failed[w]}
+    return failed, int(my_ctx), int(my_flag)
+
+
+def _xchg_round(comm, alive: List[int], payload: np.ndarray, tag: int,
+                timeout: float) -> Dict[int, Optional[np.ndarray]]:
+    """One all-to-all among ``alive`` over raw pt2pt (bypasses the comm's
+    revoked check — shrink must work on revoked comms). A peer that can't
+    be sent to or doesn't answer within ``timeout`` maps to None."""
+    u = comm.u
+    proto = u.protocol
+    n = payload.size
+    views: Dict[int, Optional[np.ndarray]] = {}
+    recvs = {}
+    for r in alive:
+        if r == comm.rank:
+            continue
+        buf = np.empty(n, np.int64)
+        recvs[r] = (proto.irecv(buf, n, dtmod.from_numpy_dtype(buf.dtype),
+                                r, comm.ctx_coll, tag), buf)
+    for r in alive:
+        if r == comm.rank:
+            continue
+        try:
+            proto.isend(payload, n, dtmod.from_numpy_dtype(payload.dtype),
+                        comm.world_of(r), comm.rank, comm.ctx_coll, tag)
+        except MPIException:
+            views[r] = None
+    deadline = time.monotonic() + timeout
+    for r, (req, buf) in recvs.items():
+        if views.get(r, "") is None:
+            req.cancel()
+            continue
+        ok = _wait_until(u, req, deadline,
+                         lambda r=r: comm.world_of(r) in u.failed_ranks)
+        if ok:
+            views[r] = buf
+        else:
+            req.cancel()
+            views[r] = None
+    return views
+
+
+def _wait_until(u, req, deadline: float, dead_pred) -> bool:
+    """Progress until req completes; False on peer death or timeout."""
+    while not req.test():
+        if req.error is not None:
+            return False
+        if dead_pred() or time.monotonic() > deadline:
+            return False
+        u.engine.progress_poke()
+        time.sleep(0.0005)
+    return req.error is None
+
+
+# ---------------------------------------------------------------------------
+# shrink / agree
+# ---------------------------------------------------------------------------
+
+def shrink(comm):
+    """MPIX_Comm_shrink: collective over survivors; returns a working
+    communicator containing exactly the agreed-alive members, with an
+    agreed fresh context id (comm_shrink.c semantics)."""
+    from ..core.comm import Comm
+    u = comm.u
+    failed, ctx, _ = _agreement(comm, 1)
+    survivors = [w for w in comm.group.world_ranks if w not in failed]
+    u._next_ctx = max(u._next_ctx, ctx + 2)
+    newcomm = Comm(u, Group(survivors), ctx, comm.name + "_shrink")
+    newcomm._acked_failures = set()
+    return newcomm
+
+
+def agree(comm, flag: int) -> int:
+    """MPIX_Comm_agree: agreement on the bitwise AND of ``flag`` over the
+    surviving members. Raises MPIX_ERR_PROC_FAILED if the communicator has
+    failures not yet acknowledged via failure_ack (comm_agree.c contract —
+    the agreed value is still established first, so survivors stay in
+    lockstep)."""
+    failed, ctx, val = _agreement(comm, flag)
+    comm.u._next_ctx = max(comm.u._next_ctx, ctx)
+    unacked = {w for w in failed if w in comm.group.world_ranks} \
+        - comm._acked_failures
+    if unacked:
+        exc = MPIException(
+            MPIX_ERR_PROC_FAILED,
+            f"agree with unacknowledged failures: world ranks "
+            f"{sorted(unacked)}")
+        exc.agreed_flag = val
+        raise exc
+    return val

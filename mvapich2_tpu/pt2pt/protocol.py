@@ -21,7 +21,8 @@ import numpy as np
 
 from ..core import datatype as dtmod
 from ..core.datatype import Datatype, as_bytes_view
-from ..core.errors import (MPIException, MPI_ERR_TRUNCATE, MPI_ERR_INTERN,
+from ..core.errors import (MPIException, MPIX_ERR_PROC_FAILED,
+                           MPI_ERR_TRUNCATE, MPI_ERR_INTERN,
                            MPI_ERR_RANK, mpi_assert)
 from ..core.request import Request, CompletedRequest
 from ..core.status import Status, ANY_SOURCE, ANY_TAG, PROC_NULL
@@ -97,6 +98,9 @@ class Pt2ptProtocol:
         """Start a send; returns the request (already complete for eager)."""
         if dest_world == PROC_NULL:
             return CompletedRequest()
+        if dest_world in self.u.failed_ranks:
+            raise MPIException(MPIX_ERR_PROC_FAILED,
+                               f"send to failed world rank {dest_world}")
         channel = self.u.channel_for(dest_world)
         is_local = self.u.is_local(dest_world)
         nbytes = datatype.size * count
@@ -120,7 +124,7 @@ class Pt2ptProtocol:
             packed = datatype.pack(buf, count)
             pkt = Packet(PktType.EAGER_SEND, self.u.world_rank, ctx, comm_src,
                          tag, nbytes, np.asarray(packed))
-            channel.send_packet(dest_world, pkt)
+            self._send_pkt(channel, dest_world, pkt)
             _pv_eager.inc()
             _pv_bytes.inc(nbytes)
             return CompletedRequest()
@@ -142,10 +146,23 @@ class Pt2ptProtocol:
                      nbytes, None, sreq_id=sreq.req_id, protocol=sreq.protocol,
                      extra={"handle": sreq.handle} if sreq.handle is not None
                      else None)
-        channel.send_packet(dest_world, pkt)
+        self._send_pkt(channel, dest_world, pkt)
         _pv_rndv.inc()
         _pv_bytes.inc(nbytes)
         return sreq
+
+    def _send_pkt(self, channel, dest_world: int, pkt: Packet) -> None:
+        """Channel send with failure surfacing: a connection-level error
+        marks the peer failed (the VC-failure analog, SURVEY §5.3) and
+        raises MPIX_ERR_PROC_FAILED."""
+        try:
+            channel.send_packet(dest_world, pkt)
+        except OSError as e:
+            from ..ft import ulfm
+            ulfm.mark_failed(self.u, dest_world)
+            raise MPIException(
+                MPIX_ERR_PROC_FAILED,
+                f"transport to world rank {dest_world} failed: {e}") from e
 
     # ------------------------------------------------------------------
     # recv side
@@ -163,10 +180,29 @@ class Pt2ptProtocol:
             pkt = self.matcher.match_posted(ctx, source, tag)
             if pkt is not None:
                 self._deliver(req, pkt)
+            elif self._recv_source_failed(ctx, source):
+                req.complete(MPIException(
+                    MPIX_ERR_PROC_FAILED,
+                    f"recv source failed (ctx={ctx}, src={source})"))
             else:
                 self.matcher.post(req)
                 req._cancel_fn = lambda: self.matcher.cancel_posted(req)
         return req
+
+    def _recv_source_failed(self, ctx: int, source: int) -> bool:
+        """ULFM: a named-source recv from a failed rank (no message already
+        queued) can never complete; a wildcard recv fails while the comm
+        has *unacknowledged* failures (failure_ack re-arms it)."""
+        if not self.u.failed_ranks:
+            return False
+        comm = self.u.comms_by_ctx.get(ctx & ~1)
+        if comm is None:
+            return False
+        if source == ANY_SOURCE:
+            return any(w in self.u.failed_ranks
+                       and w not in comm._acked_failures
+                       for w in comm.group.world_ranks)
+        return comm.world_of(source) in self.u.failed_ranks
 
     # -- probe ----------------------------------------------------------
     def iprobe(self, source: int, ctx: int, tag: int) -> Optional[Status]:

@@ -71,4 +71,32 @@ def bootstrap_from_env() -> Universe:
 
     kvs.fence()   # everyone's business cards are published
     u.initialize()
+
+    if os.environ.get("MV2T_FT") == "1":
+        _start_failure_watcher(u, kvs_addr)
     return u
+
+
+def _start_failure_watcher(u: Universe, kvs_addr: str) -> None:
+    """FT mode: a daemon thread blocks on launcher-published failure events
+    (__failure_ev_N keys) and feeds them into the ULFM detection sink —
+    the analog of mpispawn noticing dead children and PMI reporting them
+    (SURVEY §5.3). Uses its own KVS connection so blocking gets don't
+    serialize with the rank's bootstrap client."""
+    import threading
+
+    def watch():
+        try:
+            # no socket timeout: a healthy job may run arbitrarily long
+            # between failure events (or see none at all)
+            w = KVSClient(kvs_addr, timeout=None)
+            n = 0
+            while True:
+                dead = int(w.get(f"__failure_ev_{n}"))   # blocks until put
+                u.mark_failed(dead)
+                n += 1
+        except Exception:
+            pass   # KVS gone = job tearing down
+
+    threading.Thread(target=watch, daemon=True,
+                     name="ft-failure-watcher").start()

@@ -94,6 +94,13 @@ class KVSServer:
         h, p = self._srv.server_address[:2]
         return f"{h}:{p}"
 
+    def publish(self, key: str, val: str) -> None:
+        """Launcher-side put (e.g. failure events — SURVEY §5.3: 'failure
+        detection is launcher-driven; PMI reports')."""
+        with self.state.cond:
+            self.state.data[key] = val
+            self.state.cond.notify_all()
+
     def shutdown(self) -> None:
         self._srv.shutdown()
         self._srv.server_close()
@@ -102,9 +109,10 @@ class KVSServer:
 class KVSClient:
     """Rank-side client (the UPMI analog)."""
 
-    def __init__(self, address: str):
+    def __init__(self, address: str, timeout: Optional[float] = 120):
         host, port = address.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)), timeout=120)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
         self._f = self._sock.makefile("rwb")
         self._lock = threading.Lock()
 

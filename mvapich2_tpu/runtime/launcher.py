@@ -27,8 +27,15 @@ from .kvs import KVSServer
 
 def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
            fake_nodes: Optional[List[int]] = None,
-           timeout: Optional[float] = None) -> int:
-    """Run ``argv`` as ``nranks`` rank processes; returns max exit code."""
+           timeout: Optional[float] = None, ft: bool = False) -> int:
+    """Run ``argv`` as ``nranks`` rank processes; returns max exit code.
+
+    ``ft=False`` (default): a rank dying with nonzero status kills the job
+    (mpirun_rsh cleanup-on-abnormal-exit behavior). ``ft=True`` (the
+    ``mpiexec -disable-auto-cleanup`` analog): a dead rank is published to
+    the KVS as a failure event instead — survivors learn of it through the
+    bootstrap failure watcher and can revoke/shrink (SURVEY §5.3); the job
+    result is then the survivors' max exit code."""
     srv = KVSServer(nranks)
     procs: List[subprocess.Popen] = []
     try:
@@ -37,6 +44,8 @@ def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
             env["MV2T_RANK"] = str(r)
             env["MV2T_SIZE"] = str(nranks)
             env["MV2T_KVS"] = srv.address
+            if ft:
+                env["MV2T_FT"] = "1"
             if fake_nodes is not None:
                 env["MV2T_FAKE_NODE"] = f"fakenode{fake_nodes[r]}"
             if env_extra:
@@ -46,15 +55,20 @@ def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
             procs.append(subprocess.Popen(argv, env=env))
         deadline = time.monotonic() + timeout if timeout else None
         exit_codes: List[Optional[int]] = [None] * nranks
+        failed: List[int] = []   # ranks published as failure events
+        n_events = 0
         while any(c is None for c in exit_codes):
             for i, p in enumerate(procs):
                 if exit_codes[i] is None:
                     exit_codes[i] = p.poll()
-            # a dead rank with nonzero status kills the job (mpirun_rsh
-            # behavior: cleanup on abnormal exit)
             bad = [i for i, c in enumerate(exit_codes)
-                   if c is not None and c != 0]
-            if bad:
+                   if c is not None and c != 0 and i not in failed]
+            if bad and ft:
+                for i in bad:
+                    failed.append(i)
+                    srv.publish(f"__failure_ev_{n_events}", str(i))
+                    n_events += 1
+            elif bad:
                 for p in procs:
                     if p.poll() is None:
                         p.terminate()
@@ -69,6 +83,10 @@ def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
                         p.kill()
                 raise TimeoutError(f"job exceeded {timeout}s")
             time.sleep(0.01)
+        if ft:
+            survivors = [c for i, c in enumerate(exit_codes)
+                         if i not in failed]
+            return max(survivors) if survivors else 1
         return max(c or 0 for c in exit_codes)
     finally:
         for p in procs:
@@ -85,6 +103,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--fake-nodes", type=str, default=None,
                     help="comma-separated fake node id per rank "
                          "(emulate multi-node on one host)")
+    ap.add_argument("--ft", "--disable-auto-cleanup", action="store_true",
+                    dest="ft", help="fault-tolerant mode: dead ranks become "
+                    "failure events instead of killing the job (ULFM)")
     ap.add_argument("--timeout", type=float, default=None)
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -96,7 +117,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         if len(fake) != args.np:
             ap.error("--fake-nodes length must equal -np")
     return launch(args.np, args.command, fake_nodes=fake,
-                  timeout=args.timeout)
+                  timeout=args.timeout, ft=args.ft)
 
 
 if __name__ == "__main__":

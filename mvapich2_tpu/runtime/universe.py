@@ -46,7 +46,9 @@ class Universe:
         self.finalized = False
         self.initialized = False
         self.windows: Dict[int, object] = {}      # win_id -> Win (RMA)
-        self.failed_ranks: set = set()            # ULFM state
+        self.failed_ranks: set = set()            # ULFM state (ft/ulfm.py)
+        self.comms_by_ctx: Dict[int, object] = {} # even ctx -> Comm (revoke
+                                                  # routing + failure unwind)
         self.attrs = {}
 
     # -- wiring -----------------------------------------------------------
@@ -92,6 +94,8 @@ class Universe:
                 get_config().reload()
             with ts.phase("protocol + matcher"):
                 self.protocol = Pt2ptProtocol(self)
+                from ..ft import ulfm
+                ulfm.install(self)
             with ts.phase("comm_world/self"):
                 self.comm_world = Comm(self, Group(range(self.world_size)),
                                        context_id=0, name="MPI_COMM_WORLD")
@@ -114,6 +118,11 @@ class Universe:
         ctx = int(out[0])
         self._next_ctx = ctx + 2
         return ctx
+
+    def mark_failed(self, world_rank: int) -> None:
+        """Record a process failure (detection sink — SURVEY §5.3)."""
+        from ..ft import ulfm
+        ulfm.mark_failed(self, world_rank)
 
     def finalize(self) -> None:
         if self.finalized:

@@ -1,0 +1,75 @@
+"""FT acceptance program (the analog of test/mpi/ft/revoke_shrink.c):
+rank 1 dies mid-job; survivors detect it through the launcher's failure
+events, ack, shrink, and finish a collective on the shrunken comm.
+
+Run: python -m mvapich2_tpu.run -np 4 --ft python ft_shrink_prog.py
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from mvapich2_tpu import mpi  # noqa: E402
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+rank, size = comm.rank, comm.size
+u = comm.u
+
+if rank == 1:
+    os._exit(3)
+
+# wait for launcher-driven detection (KVS failure watcher)
+deadline = time.time() + 30
+while 1 not in u.failed_ranks:
+    if time.time() > deadline:
+        print(f"rank {rank}: failure of rank 1 never detected")
+        sys.exit(1)
+    time.sleep(0.02)
+
+errs = 0
+
+# sends to the dead rank must raise MPIX_ERR_PROC_FAILED
+from mvapich2_tpu.core.errors import MPIX_ERR_PROC_FAILED, MPIException
+try:
+    comm.send(np.ones(1), dest=1)
+    errs += 1
+    print(f"rank {rank}: send to dead rank did not fail")
+except MPIException as e:
+    if e.error_class != MPIX_ERR_PROC_FAILED:
+        errs += 1
+        print(f"rank {rank}: wrong error class {e.error_class}")
+
+# agree raises before ack, succeeds after
+try:
+    comm.agree(1)
+    errs += 1
+    print(f"rank {rank}: agree succeeded with unacked failure")
+except MPIException:
+    pass
+comm.failure_ack()
+acked = comm.failure_get_acked()
+if list(acked.world_ranks) != [1]:
+    errs += 1
+    print(f"rank {rank}: acked group wrong: {acked.world_ranks}")
+if comm.agree(1) != 1:
+    errs += 1
+    print(f"rank {rank}: agree value wrong")
+
+# shrink and run a collective over the survivors
+newcomm = comm.shrink()
+if newcomm.size != size - 1:
+    errs += 1
+    print(f"rank {rank}: shrunk size {newcomm.size} != {size - 1}")
+out = newcomm.allreduce(np.full(8, 1.0))
+if abs(out[0] - (size - 1)) > 1e-9:
+    errs += 1
+    print(f"rank {rank}: allreduce on shrunk comm wrong: {out[0]}")
+
+newcomm.barrier()
+if newcomm.rank == 0 and errs == 0:
+    print("No Errors")
+sys.exit(1 if errs else 0)

@@ -1,0 +1,196 @@
+"""ULFM fault-tolerance tests (SURVEY §5.3; the test/mpi/ft/ analog).
+
+Local-mode tests inject failures directly through the detection sink
+(universe.mark_failed) — the fault-injection pattern of test/mpi/ft/die.c —
+then exercise revoke/shrink/agree semantics. The process-mode test kills a
+real rank under the --ft launcher and drives detection end-to-end through
+the KVS failure events.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mvapich2_tpu.core.errors import (MPIException, MPIX_ERR_PROC_FAILED,
+                                      MPIX_ERR_REVOKED)
+from mvapich2_tpu.runtime.universe import run_ranks
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEAD = 3   # the rank that "dies" in local-mode tests
+
+
+def _mark_dead_and(fn):
+    """Rank body: DEAD exits silently; survivors locally detect DEAD."""
+    def body(comm):
+        if comm.rank == DEAD:
+            return None
+        comm.u.mark_failed(DEAD)
+        return fn(comm)
+    return body
+
+
+def test_send_to_failed_raises():
+    def body(comm):
+        try:
+            comm.send(np.ones(4), dest=DEAD)
+            return "no-error"
+        except MPIException as e:
+            return e.error_class
+
+    out = run_ranks(4, _mark_dead_and(body))
+    assert all(r == MPIX_ERR_PROC_FAILED for i, r in enumerate(out)
+               if i != DEAD)
+
+
+def test_recv_from_failed_raises():
+    def body(comm):
+        buf = np.zeros(4)
+        try:
+            comm.recv(buf, source=DEAD)
+            return "no-error"
+        except MPIException as e:
+            return e.error_class
+
+    out = run_ranks(4, _mark_dead_and(body))
+    assert all(r == MPIX_ERR_PROC_FAILED for i, r in enumerate(out)
+               if i != DEAD)
+
+
+def test_wildcard_recv_fails_until_acked():
+    from mvapich2_tpu.core.status import ANY_SOURCE
+
+    def body(comm):
+        buf = np.zeros(1)
+        try:
+            comm.recv(buf, source=ANY_SOURCE)
+            return "no-error"
+        except MPIException as e:
+            pre = e.error_class
+        comm.failure_ack()
+        # after ack, wildcard recvs are re-armed: a live peer can satisfy it
+        peers = [r for r in range(comm.size) if r != DEAD]
+        me = peers.index(comm.rank)
+        nxt = peers[(me + 1) % len(peers)]
+        prv = peers[(me - 1) % len(peers)]
+        comm.isend(np.array([float(comm.rank)]), dest=nxt, tag=9)
+        st = comm.recv(buf, source=ANY_SOURCE, tag=9)
+        return (pre, st.source == prv and buf[0] == float(prv))
+
+    out = run_ranks(4, _mark_dead_and(body))
+    for i, r in enumerate(out):
+        if i != DEAD:
+            assert r == (MPIX_ERR_PROC_FAILED, True)
+
+
+def test_get_failed_and_ack_groups():
+    def body(comm):
+        comm.failure_ack()
+        return (comm.get_failed().world_ranks,
+                comm.failure_get_acked().world_ranks)
+
+    out = run_ranks(4, _mark_dead_and(body))
+    for i, r in enumerate(out):
+        if i != DEAD:
+            assert r == ((DEAD,), (DEAD,))
+
+
+def test_shrink_produces_working_comm():
+    def body(comm):
+        new = comm.shrink()
+        out = new.allreduce(np.full(16, 1.0))
+        ranks = new.allgather(np.array([comm.rank], np.int64))
+        return (new.size, float(out[0]), ranks.tolist())
+
+    out = run_ranks(4, _mark_dead_and(body))
+    for i, r in enumerate(out):
+        if i != DEAD:
+            assert r == (3, 3.0, [0, 1, 2])
+
+
+def test_shrink_without_failures_is_dup():
+    def body(comm):
+        new = comm.shrink()
+        return (new.size, float(new.allreduce(np.ones(4))[0]))
+
+    out = run_ranks(4, body)
+    assert out == [(4, 4.0)] * 4
+
+
+def test_agree_semantics():
+    def body(comm):
+        flags = 0b111 if comm.rank != 0 else 0b101
+        try:
+            comm.agree(flags)
+            pre = None
+        except MPIException as e:
+            pre = e.error_class
+        comm.failure_ack()
+        return (pre, comm.agree(flags))
+
+    out = run_ranks(4, _mark_dead_and(body))
+    for i, r in enumerate(out):
+        if i != DEAD:
+            assert r == (MPIX_ERR_PROC_FAILED, 0b101)
+
+
+def test_revoke_propagates():
+    def body(comm):
+        if comm.rank == 0:
+            comm.revoke()
+        else:
+            # blocked recv must unwind with MPIX_ERR_REVOKED when the
+            # revoke packet lands
+            buf = np.zeros(1)
+            try:
+                comm.recv(buf, source=0, tag=77)
+                return "recv-completed"
+            except MPIException as e:
+                assert e.error_class == MPIX_ERR_REVOKED
+        # every subsequent op on the revoked comm raises
+        try:
+            comm.barrier()
+            return "barrier-ok"
+        except MPIException as e:
+            return e.error_class
+
+    out = run_ranks(4, body)
+    assert out == [MPIX_ERR_REVOKED] * 4
+
+
+def test_shrink_of_revoked_comm():
+    def body(comm):
+        if comm.rank == DEAD:
+            return None
+        comm.u.mark_failed(DEAD)
+        if comm.rank == 0:
+            comm.revoke()
+        # wait until the revoke reaches us, then shrink (the
+        # revoke_shrink.c pattern: revoke -> shrink -> continue)
+        import time
+        deadline = time.time() + 10
+        while not comm.revoked and time.time() < deadline:
+            comm.u.engine.progress_poke()
+            time.sleep(0.001)
+        assert comm.revoked
+        new = comm.shrink()
+        return float(new.allreduce(np.ones(2))[0])
+
+    out = run_ranks(4, body)
+    for i, r in enumerate(out):
+        if i != DEAD:
+            assert r == 3.0
+
+
+def test_mpirun_ft_end_to_end():
+    """Process mode: rank dies, launcher publishes the failure, survivors
+    ack + shrink + finish (exit 0, 'No Errors')."""
+    prog = os.path.join(REPO, "tests", "progs", "ft_shrink_prog.py")
+    cmd = [sys.executable, "-m", "mvapich2_tpu.run", "-np", "4", "--ft",
+           sys.executable, prog]
+    r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
