@@ -1,0 +1,16 @@
+"""Checkpoint/resume subsystem (SURVEY §5.4).
+
+The reference checkpoints process images via BLCR with channel quiesce
+(common/src/ft/cr.c), adds SCR-style multi-level redundancy with XOR
+rebuild (common/src/scr/), aggregates checkpoint writes (CRFS), and
+orchestrates restart from the launcher. The TPU-native equivalent
+checkpoints *mesh/application state* (SURVEY §5.4: "application/JAX-level
+checkpoint of mesh state + collective-quiesce barrier, not process-image
+dumps"): a collective save of a state pytree with cache-level redundancy
+(LOCAL / PARTNER / XOR) and rebuild of lost ranks at restore time.
+"""
+
+from .api import Checkpointer
+from .redundancy import SCHEMES
+
+__all__ = ["Checkpointer", "SCHEMES"]

@@ -1,0 +1,202 @@
+"""Checkpointer — the collective save/restore protocol.
+
+Protocol shape follows the reference's CR flow (SURVEY §5.4) re-targeted
+at mesh state:
+
+  save:    quiesce barrier (drain in-flight traffic — the analog of
+           cr.c suspending channels before BLCR) -> serialize pytree ->
+           local write -> redundancy exchange (SCR reddesc_apply) ->
+           commit barrier -> commit markers.  A checkpoint is *complete*
+           only when every rank committed.
+  restore: scan cache -> agree (MIN-allreduce) on the newest step every
+           rank considers rebuildable -> rebuild lost ranks from
+           partner/XOR data (scr_rebuild_xor) -> deserialize.
+  flush:   async copy of a committed checkpoint to slow/stable storage
+           (scr_flush_async + the CRFS write-aggregation role).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..core.errors import MPIException, MPI_ERR_IO
+from ..utils.mlog import get_logger
+from . import redundancy as red
+from .store import RankStore, deserialize_state, serialize_state
+
+log = get_logger("ckpt")
+
+
+class Checkpointer:
+    """Collective checkpoint manager bound to a communicator.
+
+    ``scheme``: 'local' | 'partner' | 'xor' (SCR redundancy levels).
+    ``group_size``: failure-group width (contiguous comm ranks; the SCR
+    XOR-set size). Defaults to the whole comm.
+    ``flush_dir``: optional stable-storage directory for async flush.
+    """
+
+    def __init__(self, comm, directory: str, scheme: str = "xor",
+                 group_size: Optional[int] = None,
+                 flush_dir: Optional[str] = None):
+        if scheme not in red.SCHEMES:
+            raise MPIException(MPI_ERR_IO, f"bad scheme {scheme}")
+        self.comm = comm
+        self.scheme = scheme
+        self.store = RankStore(directory, comm.rank)
+        self.flush_dir = flush_dir
+        gs = group_size or comm.size
+        self.gcomm = comm.split(comm.rank // gs, comm.rank) \
+            if gs < comm.size else comm.dup()
+        self._flush_threads: List[threading.Thread] = []
+        self._flush_errors: List[Exception] = []
+
+    # ------------------------------------------------------------------
+    def save(self, step: int, state) -> None:
+        """Collective: checkpoint ``state`` (a pytree of arrays) as
+        dataset ``step``."""
+        comm = self.comm
+        comm.barrier()                       # quiesce: drain the fabric
+        payload = serialize_state(state)
+        sizes = self._allgather_sizes(len(payload))
+        self.store.write_payload(
+            step, payload,
+            meta_extra={"scheme": self.scheme,
+                        "group_sizes": sizes,
+                        "grank": self.gcomm.rank})
+        red.apply_redundancy(self.scheme, self.gcomm, self.store, step,
+                             payload, sizes)
+        comm.barrier()                       # all writes landed
+        self.store.commit(step)
+        log.info("rank %d: checkpoint step %d committed (%d B, %s)",
+                 comm.rank, step, len(payload), self.scheme)
+
+    def restore(self, template, step: Optional[int] = None):
+        """Collective: returns (step, state). Picks the newest step that
+        every rank can produce (own data or rebuildable); rebuilds lost
+        payloads through the group. Raises MPI_ERR_IO if no step
+        qualifies."""
+        comm = self.comm
+        candidates = self._agree_candidates() if step is None else [step]
+        for s in reversed(candidates):
+            payload = self._restore_step(s)
+            if payload is not None:
+                return s, deserialize_state(payload, template)
+        raise MPIException(MPI_ERR_IO, "no complete checkpoint found")
+
+    def available_steps(self) -> List[int]:
+        return self._agree_candidates()
+
+    # ------------------------------------------------------------------
+    # async flush to stable storage (scr_flush_async / CRFS analog)
+    # ------------------------------------------------------------------
+    def flush(self, step: int) -> None:
+        """Start an async copy of this rank's step files to flush_dir."""
+        if self.flush_dir is None:
+            raise MPIException(MPI_ERR_IO, "no flush_dir configured")
+        src = self.store.step_dir(step)
+        dst = os.path.join(self.flush_dir, f"step_{step}")
+        me = f"rank{self.comm.rank}."
+
+        def run():
+            try:
+                os.makedirs(dst, exist_ok=True)
+                for name in os.listdir(src):
+                    if name.startswith(me):
+                        shutil.copy2(os.path.join(src, name),
+                                     os.path.join(dst, name))
+            except Exception as e:   # surfaced by wait_flush
+                self._flush_errors.append(e)
+
+        t = threading.Thread(target=run, daemon=True, name="ckpt-flush")
+        t.start()
+        self._flush_threads.append(t)
+
+    def wait_flush(self) -> None:
+        for t in self._flush_threads:
+            t.join()
+        self._flush_threads.clear()
+        if self._flush_errors:
+            errs, self._flush_errors = self._flush_errors, []
+            raise MPIException(MPI_ERR_IO, f"flush failed: {errs[0]}")
+
+    # ------------------------------------------------------------------
+    def _allgather_sizes(self, mine: int) -> List[int]:
+        out = np.zeros(self.gcomm.size, np.int64)
+        self.gcomm.allgather(np.array([mine], np.int64), out, count=1)
+        return [int(x) for x in out]
+
+    def _agree_candidates(self) -> List[int]:
+        """Steps at least one rank has on disk, oldest..newest, agreed
+        via a union allgather (a lost rank may have nothing on disk)."""
+        mine = self.store.steps_on_disk()
+        pad = np.full(64, -1, np.int64)
+        pad[:min(len(mine), 64)] = mine[-64:]
+        allv = np.empty(64 * self.comm.size, np.int64)
+        self.comm.allgather(pad, allv, count=64)
+        return sorted({int(x) for x in allv if x >= 0})
+
+    def _restore_step(self, step: int) -> Optional[bytes]:
+        """Try to produce this rank's payload for ``step`` (rebuilding
+        through the group if needed). Collective; returns None (on all
+        ranks) if the step is not recoverable."""
+        payload = self.store.read_payload(step)
+        have = np.zeros(self.gcomm.size, np.int64)
+        self.gcomm.allgather(
+            np.array([1 if payload is not None else 0], np.int64),
+            have, count=1)
+        ok = 1
+        rebuilt: Optional[bytes] = None
+        sizes: Optional[List[int]] = None
+        if not all(have):
+            sizes = self._bcast_sizes_from_survivor(step, have)
+            if sizes is None:
+                ok = 0
+            else:
+                try:
+                    rebuilt = red.rebuild(self.scheme, self.gcomm,
+                                          self.store, step,
+                                          [int(x) for x in have], sizes)
+                except MPIException as e:
+                    log.warn("step %d not rebuildable: %s", step, e)
+                    ok = 0
+        # global verdict: every group must have succeeded
+        out = np.zeros(1, np.int64)
+        from ..core import op as opmod
+        self.comm.allreduce(np.array([ok], np.int64), out, op=opmod.MIN)
+        if not int(out[0]):
+            return None
+        if payload is None:
+            payload = rebuilt
+            # re-adopt into the local cache so the next failure is covered
+            if payload is not None:
+                meta = {"scheme": self.scheme, "grank": self.gcomm.rank,
+                        "group_sizes": sizes or []}
+                self.store.write_payload(step, payload, meta_extra=meta)
+                self.store.commit(step)
+        return payload
+
+    def _bcast_sizes_from_survivor(self, step: int,
+                                   have) -> Optional[List[int]]:
+        """Group payload sizes come from any survivor's meta (the lost
+        rank's meta died with its files)."""
+        src = next((r for r in range(self.gcomm.size) if have[r]), None)
+        if src is None:
+            return None
+        if self.gcomm.rank == src:
+            m = self.store.meta(step) or {}
+            sizes = m.get("group_sizes", [])
+        else:
+            sizes = []
+        pad = np.full(self.gcomm.size, -1, np.int64)
+        if sizes:
+            pad[:len(sizes)] = sizes
+        self.gcomm.bcast(pad, root=src)
+        if pad[0] < 0:
+            return None
+        return [int(x) for x in pad]

@@ -1,0 +1,236 @@
+"""SCR-style checkpoint redundancy: LOCAL / PARTNER / XOR.
+
+Analog of the reference's vendored SCR library (SURVEY §5.4,
+common/src/scr/): redundancy descriptors applied per checkpoint
+(scr_reddesc_apply.c), single-failure rebuild from XOR parity
+(scr_rebuild_xor.c). Groups are contiguous rank blocks of the saving
+communicator (SCR's failure-group = node; here the group size is a knob).
+
+XOR layout (the RAID-5 / Gropp construction scr_rebuild_xor implements):
+for a group of k ranks, every rank's payload is padded to the group max L
+and split into k-1 chunks. Stripe p (p = 0..k-1) takes exactly one chunk
+from every rank except p — rank s contributes chunk i(s,p) = p if p < s
+else p-1 — and its parity  P_p = XOR of those chunks  is stored by rank p.
+Since stripe p contains no data of rank p, losing any single rank j loses
+one chunk per stripe p≠j plus the dataless parity P_j, so every chunk of
+D_j is recoverable:  chunk_{i(j,p)}(D_j) = P_p XOR (chunks of s not in
+{p,j}).  Storage overhead per rank is L/(k-1) — the 1/k-scaling that
+distinguishes XOR from PARTNER's full copy.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.errors import MPIException, MPI_ERR_IO
+from ..utils.mlog import get_logger
+from .store import RankStore
+
+log = get_logger("ckpt")
+
+SCHEMES = ("local", "partner", "xor")
+
+_TAG_RED = 0x5C01     # redundancy exchange
+_TAG_RBD = 0x5C02     # rebuild exchange
+
+
+def _pad(payload: bytes, total: int) -> np.ndarray:
+    buf = np.zeros(total, np.uint8)
+    buf[:len(payload)] = np.frombuffer(payload, np.uint8)
+    return buf
+
+
+def _chunk_of(s: int, p: int) -> int:
+    """Index of rank s's chunk that belongs to stripe p (p != s)."""
+    return p if p < s else p - 1
+
+
+def _padded_len(sizes: List[int], k: int) -> int:
+    L = max(sizes)
+    step = max(k - 1, 1)
+    return (L + step - 1) // step * step
+
+
+# ---------------------------------------------------------------------------
+# save-side
+# ---------------------------------------------------------------------------
+
+def apply_redundancy(scheme: str, gcomm, store: RankStore, step: int,
+                     payload: bytes, sizes: List[int]) -> None:
+    """Collective over the group comm; ``sizes`` = payload size per group
+    rank (already allgathered by the caller)."""
+    if scheme == "local" or gcomm.size == 1:
+        return
+    if scheme == "partner":
+        _partner_apply(gcomm, store, step, payload)
+    elif scheme == "xor":
+        _xor_apply(gcomm, store, step, payload, sizes)
+    else:
+        raise MPIException(MPI_ERR_IO, f"unknown redundancy scheme {scheme}")
+
+
+def _partner_apply(gcomm, store: RankStore, step: int,
+                   payload: bytes) -> None:
+    """Each rank ships its payload to its right neighbor, which stores it
+    as the 'partner' copy (scr_reddesc PARTNER)."""
+    k, r = gcomm.size, gcomm.rank
+    right, left = (r + 1) % k, (r - 1) % k
+    mine = np.frombuffer(payload, np.uint8)
+    lo = np.zeros(1, np.int64)
+    gcomm.sendrecv(np.array([mine.size], np.int64), right, _TAG_RED,
+                   lo, left, _TAG_RED)
+    theirs = np.empty(int(lo[0]), np.uint8)
+    gcomm.sendrecv(mine, right, _TAG_RED + 1, theirs, left, _TAG_RED + 1)
+    store.write_aux(step, "partner", theirs.tobytes())
+
+
+def _xor_apply(gcomm, store: RankStore, step: int, payload: bytes,
+               sizes: List[int]) -> None:
+    k, s = gcomm.size, gcomm.rank
+    if k < 3:      # XOR needs k-1 >= 2 chunks to beat PARTNER; fall back
+        _partner_apply(gcomm, store, step, payload)
+        return
+    L = _padded_len(sizes, k)
+    csz = L // (k - 1)
+    mine = _pad(payload, L)
+    # ship chunk i(s,p) to every stripe-parity holder p != s
+    reqs = []
+    for p in range(k):
+        if p == s:
+            continue
+        i = _chunk_of(s, p)
+        reqs.append(gcomm.isend(mine[i * csz:(i + 1) * csz], p,
+                                _TAG_RED + 2 + p))
+    parity = np.zeros(csz, np.uint8)
+    recv = np.empty(csz, np.uint8)
+    for src in range(k):
+        if src == s:
+            continue
+        gcomm.recv(recv, src, _TAG_RED + 2 + s)
+        parity ^= recv
+    for rq in reqs:
+        rq.wait()
+    store.write_aux(step, "parity", parity.tobytes())
+
+
+# ---------------------------------------------------------------------------
+# restore-side rebuild
+# ---------------------------------------------------------------------------
+
+def rebuild(scheme: str, gcomm, store: RankStore, step: int,
+            have: List[int], sizes: List[int]) -> Optional[bytes]:
+    """Collective over the group comm. ``have[r]`` nonzero if group rank r
+    can read its own payload; ``sizes`` = payload sizes (from surviving
+    meta, bcast by caller). Returns the payload for ranks that were
+    missing theirs (None for ranks that already have data). Raises if the
+    failure pattern exceeds what the scheme tolerates — the
+    scr_rebuild_xor single-failure contract."""
+    missing = [r for r in range(gcomm.size) if not have[r]]
+    if not missing:
+        return None
+    if scheme == "local" or gcomm.size == 1:
+        raise MPIException(MPI_ERR_IO,
+                           f"LOCAL checkpoint lost on ranks {missing}")
+    if len(missing) > 1:
+        raise MPIException(
+            MPI_ERR_IO,
+            f"{scheme} redundancy cannot rebuild {len(missing)} lost "
+            f"ranks {missing} in one group")
+    j = missing[0]
+    use_partner = scheme == "partner" or gcomm.size < 3
+    # capability pre-check: every survivor verifies it can serve its part
+    # BEFORE anyone engages the exchange — a raise mid-protocol would
+    # leave rank j blocked in recv (consistent abort instead)
+    if gcomm.rank == j:
+        ok = 1
+    elif use_partner:
+        ok = 1 if (gcomm.rank != (j + 1) % gcomm.size
+                   or store.read_aux(step, "partner") is not None) else 0
+    else:
+        ok = 1 if (store.read_payload(step) is not None
+                   and store.read_aux(step, "parity") is not None) else 0
+    oks = np.zeros(gcomm.size, np.int64)
+    gcomm.allgather(np.array([ok], np.int64), oks, count=1)
+    if not all(oks):
+        raise MPIException(
+            MPI_ERR_IO,
+            f"rebuild of rank {j} impossible: redundancy data also lost "
+            f"at group ranks {[r for r in range(gcomm.size) if not oks[r]]}")
+    if use_partner:
+        return _partner_rebuild(gcomm, store, step, j)
+    return _xor_rebuild(gcomm, store, step, j, sizes)
+
+
+def _partner_rebuild(gcomm, store: RankStore, step: int,
+                     j: int) -> Optional[bytes]:
+    k, r = gcomm.size, gcomm.rank
+    holder = (j + 1) % k       # right neighbor stores j's copy
+    if r == holder:
+        data = store.read_aux(step, "partner")
+        if data is None:
+            raise MPIException(MPI_ERR_IO,
+                               f"partner copy of rank {j} also lost")
+        gcomm.send(np.array([len(data)], np.int64), j, _TAG_RBD)
+        gcomm.send(np.frombuffer(data, np.uint8), j, _TAG_RBD + 1)
+    if r == j:
+        n = np.zeros(1, np.int64)
+        gcomm.recv(n, holder, _TAG_RBD)
+        buf = np.empty(int(n[0]), np.uint8)
+        gcomm.recv(buf, holder, _TAG_RBD + 1)
+        return buf.tobytes()
+    return None
+
+
+def _xor_rebuild(gcomm, store: RankStore, step: int, j: int,
+                 sizes: List[int]) -> Optional[bytes]:
+    """Single-failure XOR rebuild: for each stripe p != j, the lost chunk
+    is P_p XOR (every surviving rank's chunk of stripe p)."""
+    k, s = gcomm.size, gcomm.rank
+    L = _padded_len(sizes, k)
+    csz = L // (k - 1)
+
+    if s != j:
+        payload = store.read_payload(step)
+        if payload is None:
+            raise MPIException(MPI_ERR_IO,
+                               f"xor rebuild: survivor {s} lost data too")
+        mine = _pad(payload, L)
+        reqs = []
+        # my parity slice (if I'm not the dataless stripe j's holder —
+        # stripe j's parity protects nothing and isn't needed)
+        for p in range(k):
+            if p == j:
+                continue
+            if p == s:
+                par = store.read_aux(step, "parity")
+                if par is None:
+                    raise MPIException(MPI_ERR_IO,
+                                       f"xor parity lost at rank {s}")
+                reqs.append(gcomm.isend(
+                    np.frombuffer(par, np.uint8), j, _TAG_RBD + 2 + p))
+            else:
+                i = _chunk_of(s, p)
+                reqs.append(gcomm.isend(mine[i * csz:(i + 1) * csz], j,
+                                        _TAG_RBD + 100 + p * k + s))
+        for rq in reqs:
+            rq.wait()
+        return None
+
+    # rank j: reassemble each of its k-1 chunks
+    out = np.zeros(L, np.uint8)
+    acc = np.empty(csz, np.uint8)
+    recv = np.empty(csz, np.uint8)
+    for p in range(k):
+        if p == j:
+            continue
+        gcomm.recv(acc, p, _TAG_RBD + 2 + p)          # parity P_p
+        for srank in range(k):
+            if srank in (p, j):
+                continue
+            gcomm.recv(recv, srank, _TAG_RBD + 100 + p * k + srank)
+            acc ^= recv
+        i = _chunk_of(j, p)
+        out[i * csz:(i + 1) * csz] = acc
+    return out[:sizes[j]].tobytes()

@@ -1,0 +1,159 @@
+"""Per-rank checkpoint store: serialization, layout, integrity.
+
+Layout (the scr_cache analog — one directory per checkpoint "dataset"):
+
+    <dir>/step_<N>/rank<r>.npz        state payload (pytree leaves)
+    <dir>/step_<N>/rank<r>.parity     XOR parity slice (xor scheme)
+    <dir>/step_<N>/rank<r>.partner    partner's full payload (partner)
+    <dir>/step_<N>/rank<r>.meta.json  sizes + crc + group map
+    <dir>/step_<N>/rank<r>.commit     written after the commit barrier
+
+A rank's checkpoint is valid iff commit marker exists, the payload file
+reads, and its crc32 matches the meta record (the scr filemap + crc
+discipline, common/src/scr/scr_meta.c analog).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.errors import MPIException, MPI_ERR_IO
+from ..utils.mlog import get_logger
+
+log = get_logger("ckpt")
+
+
+def _leaves(state) -> Tuple[List[np.ndarray], object]:
+    """Flatten a pytree of arrays to numpy leaves + treedef. Works for
+    plain dicts/lists/tuples and jax pytrees alike; jax arrays are pulled
+    to host (the device->host stage of the quiesce+save)."""
+    import jax
+    flat, treedef = jax.tree_util.tree_flatten(state)
+    return [np.asarray(x) for x in flat], treedef
+
+
+def serialize_state(state) -> bytes:
+    """State pytree -> npz bytes (leaf order is treedef order)."""
+    flat, _ = _leaves(state)
+    bio = io.BytesIO()
+    np.savez(bio, **{f"leaf_{i}": a for i, a in enumerate(flat)})
+    return bio.getvalue()
+
+
+def deserialize_state(payload: bytes, template):
+    """npz bytes -> pytree shaped like ``template``. Template leaves that
+    are jax arrays get the data placed back with their sharding/device
+    (mesh-state restore); numpy leaves stay numpy."""
+    import jax
+    flat_t, treedef = jax.tree_util.tree_flatten(template)
+    with np.load(io.BytesIO(payload)) as z:
+        flat = [z[f"leaf_{i}"] for i in range(len(flat_t))]
+    out = []
+    for tmpl, arr in zip(flat_t, flat):
+        if isinstance(tmpl, np.ndarray):
+            out.append(arr.astype(tmpl.dtype).reshape(tmpl.shape))
+        else:   # jax array: restore onto its sharding
+            out.append(jax.device_put(
+                arr.astype(tmpl.dtype).reshape(tmpl.shape),
+                getattr(tmpl, "sharding", None)))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class RankStore:
+    """Filesystem access for one rank's slice of the checkpoint cache."""
+
+    def __init__(self, directory: str, rank: int):
+        self.dir = directory
+        self.rank = rank
+
+    # -- paths ------------------------------------------------------------
+    def step_dir(self, step: int) -> str:
+        return os.path.join(self.dir, f"step_{step}")
+
+    def _p(self, step: int, ext: str) -> str:
+        return os.path.join(self.step_dir(step), f"rank{self.rank}.{ext}")
+
+    # -- write ------------------------------------------------------------
+    def write_payload(self, step: int, payload: bytes,
+                      meta_extra: Optional[dict] = None) -> dict:
+        os.makedirs(self.step_dir(step), exist_ok=True)
+        with open(self._p(step, "npz"), "wb") as f:
+            f.write(payload)
+        meta = {"rank": self.rank, "size": len(payload),
+                "crc": zlib.crc32(payload)}
+        if meta_extra:
+            meta.update(meta_extra)
+        with open(self._p(step, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        return meta
+
+    def write_aux(self, step: int, ext: str, data: bytes) -> None:
+        with open(self._p(step, ext), "wb") as f:
+            f.write(data)
+
+    def commit(self, step: int) -> None:
+        """Post-barrier commit marker (atomic create)."""
+        with open(self._p(step, "commit"), "w") as f:
+            f.write("ok")
+
+    # -- read -------------------------------------------------------------
+    def meta(self, step: int) -> Optional[dict]:
+        try:
+            with open(self._p(step, "meta.json")) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def read_payload(self, step: int) -> Optional[bytes]:
+        """Payload bytes if present, committed, and crc-clean; else None."""
+        m = self.meta(step)
+        if m is None or not os.path.exists(self._p(step, "commit")):
+            return None
+        try:
+            with open(self._p(step, "npz"), "rb") as f:
+                payload = f.read()
+        except OSError:
+            return None
+        if len(payload) != m["size"] or zlib.crc32(payload) != m["crc"]:
+            log.warn("rank %d step %d: checkpoint crc mismatch",
+                     self.rank, step)
+            return None
+        return payload
+
+    def read_aux(self, step: int, ext: str) -> Optional[bytes]:
+        try:
+            with open(self._p(step, ext), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def have(self, step: int) -> bool:
+        return self.read_payload(step) is not None
+
+    def steps_on_disk(self) -> List[int]:
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return []
+        out = []
+        for n in names:
+            if n.startswith("step_"):
+                try:
+                    out.append(int(n[5:]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def drop(self, step: int) -> None:
+        """Remove this rank's files for a step (cache eviction)."""
+        for ext in ("npz", "parity", "partner", "meta.json", "commit"):
+            try:
+                os.remove(self._p(step, ext))
+            except OSError:
+                pass

@@ -1,0 +1,186 @@
+"""Checkpoint/resume tests (SURVEY §5.4; SCR redundancy + rebuild).
+
+The loss-injection pattern mirrors the SCR rebuild tests: checkpoint,
+delete one rank's cache files, restore — the payload must come back
+through partner/XOR redundancy. All collective protocols run on the
+in-process rank harness.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from mvapich2_tpu.ckpt import Checkpointer
+from mvapich2_tpu.ckpt.store import RankStore
+from mvapich2_tpu.core.errors import MPIException
+from mvapich2_tpu.runtime.universe import run_ranks
+
+
+def _state(rank: int, scale: float = 1.0):
+    """Per-rank pytree with mixed shapes/dtypes (shard-like payload)."""
+    return {
+        "w": np.arange(128, dtype=np.float32).reshape(8, 16) * (rank + 1),
+        "step_count": np.array(7 + rank, np.int64),
+        "nested": {"b": np.full(37, scale * rank, np.float64)},
+    }
+
+
+def _template(rank: int):
+    return {
+        "w": np.zeros((8, 16), np.float32),
+        "step_count": np.array(0, np.int64),
+        "nested": {"b": np.zeros(37, np.float64)},
+    }
+
+
+def _check_state(st, rank: int, scale: float = 1.0):
+    assert np.array_equal(
+        st["w"], np.arange(128, dtype=np.float32).reshape(8, 16) * (rank + 1))
+    assert int(st["step_count"]) == 7 + rank
+    assert np.allclose(st["nested"]["b"], scale * rank)
+
+
+@pytest.mark.parametrize("scheme", ["local", "partner", "xor"])
+def test_save_restore_roundtrip(tmp_path, scheme):
+    d = str(tmp_path)
+
+    def body(comm):
+        ck = Checkpointer(comm, d, scheme=scheme)
+        ck.save(3, _state(comm.rank))
+        step, st = ck.restore(_template(comm.rank))
+        assert step == 3
+        _check_state(st, comm.rank)
+        return True
+
+    assert all(run_ranks(4, body))
+
+
+@pytest.mark.parametrize("scheme", ["partner", "xor"])
+def test_rebuild_single_lost_rank(tmp_path, scheme):
+    d = str(tmp_path)
+    lost = 2
+
+    def save(comm):
+        Checkpointer(comm, d, scheme=scheme).save(5, _state(comm.rank))
+
+    run_ranks(4, save)
+    # simulate rank 2 losing its node-local cache (the restart-after-
+    # failure scenario scr_rebuild_xor covers)
+    RankStore(d, lost).drop(5)
+    assert not RankStore(d, lost).have(5)
+
+    def restore(comm):
+        ck = Checkpointer(comm, d, scheme=scheme)
+        step, st = ck.restore(_template(comm.rank))
+        assert step == 5
+        _check_state(st, comm.rank)
+        # rebuilt payload was re-adopted into the cache
+        return ck.store.have(5)
+
+    assert all(run_ranks(4, restore))
+
+
+def test_xor_two_losses_in_group_fails_cleanly(tmp_path):
+    d = str(tmp_path)
+
+    def save(comm):
+        Checkpointer(comm, d, scheme="xor").save(1, _state(comm.rank))
+
+    run_ranks(4, save)
+    RankStore(d, 1).drop(1)
+    RankStore(d, 3).drop(1)
+
+    def restore(comm):
+        ck = Checkpointer(comm, d, scheme="xor")
+        try:
+            ck.restore(_template(comm.rank))
+            return "restored"
+        except MPIException:
+            return "failed"
+
+    assert run_ranks(4, restore) == ["failed"] * 4
+
+
+def test_xor_groups_smaller_than_comm(tmp_path):
+    d = str(tmp_path)
+
+    def body(comm):
+        ck = Checkpointer(comm, d, scheme="xor", group_size=4)
+        ck.save(2, _state(comm.rank))
+        return ck.gcomm.size
+
+    out = run_ranks(8, body)
+    assert out == [4] * 8
+    # one loss per group is recoverable
+    RankStore(d, 1).drop(2)
+    RankStore(d, 6).drop(2)
+
+    def restore(comm):
+        ck = Checkpointer(comm, d, scheme="xor", group_size=4)
+        step, st = ck.restore(_template(comm.rank))
+        _check_state(st, comm.rank)
+        return step
+
+    assert run_ranks(8, restore) == [2] * 8
+
+
+def test_latest_complete_step_wins(tmp_path):
+    d = str(tmp_path)
+
+    def body(comm):
+        ck = Checkpointer(comm, d, scheme="local")
+        ck.save(1, _state(comm.rank, scale=1.0))
+        ck.save(2, _state(comm.rank, scale=2.0))
+        return ck.available_steps()
+
+    out = run_ranks(4, body)
+    assert out == [[1, 2]] * 4
+    # corrupt rank 0's step-2 payload: restore must fall back to step 1
+    st0 = RankStore(d, 0)
+    with open(os.path.join(st0.step_dir(2), "rank0.npz"), "wb") as f:
+        f.write(b"garbage")
+
+    def restore(comm):
+        ck = Checkpointer(comm, d, scheme="local")
+        step, st = ck.restore(_template(comm.rank))
+        _check_state(st, comm.rank, scale=1.0)
+        return step
+
+    assert run_ranks(4, restore) == [1] * 4
+
+
+def test_async_flush(tmp_path):
+    cache = str(tmp_path / "cache")
+    pfs = str(tmp_path / "pfs")
+
+    def body(comm):
+        ck = Checkpointer(comm, cache, scheme="local", flush_dir=pfs)
+        ck.save(9, _state(comm.rank))
+        ck.flush(9)
+        ck.wait_flush()
+        return True
+
+    assert all(run_ranks(4, body))
+    # flushed copies are loadable as a cache in their own right
+    for r in range(4):
+        assert RankStore(pfs, r).have(9)
+
+
+def test_jax_pytree_checkpoint(tmp_path):
+    """Mesh-state payloads: jax arrays round-trip through device_put."""
+    import jax
+    import jax.numpy as jnp
+    d = str(tmp_path)
+
+    def body(comm):
+        ck = Checkpointer(comm, d, scheme="partner")
+        params = {"k": jnp.arange(64, dtype=jnp.float32) * (comm.rank + 1)}
+        ck.save(0, params)
+        _, st = ck.restore({"k": jnp.zeros(64, jnp.float32)})
+        assert isinstance(st["k"], jax.Array)
+        assert np.allclose(np.asarray(st["k"]),
+                           np.arange(64) * (comm.rank + 1))
+        return True
+
+    assert all(run_ranks(2, body))
