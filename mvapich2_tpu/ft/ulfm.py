@@ -78,10 +78,12 @@ def mark_failed(universe, world_rank: int) -> None:
 
 
 def _fail_dependent_recvs(universe, world_rank: int) -> None:
-    """Complete posted receives that the dead rank can never satisfy
-    (engine mutex held). Named-source recvs targeting the dead rank fail;
-    ANY_SOURCE recvs fail only while the failure is unacknowledged —
-    failure_ack() re-arms wildcard receives, per ULFM."""
+    """Complete operations the dead rank can never satisfy (engine mutex
+    held). Named-source recvs targeting the dead rank fail; ANY_SOURCE
+    recvs fail only while the failure is unacknowledged — failure_ack()
+    re-arms wildcard receives, per ULFM. In-flight rendezvous requests
+    (sends awaiting CTS/FIN from the dead peer, recvs awaiting its data)
+    fail too, so waiters unwind instead of hanging."""
     from ..core.status import ANY_SOURCE
     matcher = universe.protocol.matcher
     for req in list(matcher.posted):
@@ -101,6 +103,23 @@ def _fail_dependent_recvs(universe, world_rank: int) -> None:
             req.complete(MPIException(
                 MPIX_ERR_PROC_FAILED,
                 f"recv source (world rank {world_rank}) failed"))
+    # rendezvous in flight: tracked sends to the dead rank and matched
+    # recvs whose data must come from it
+    for req in list(universe.engine.outstanding.values()):
+        if getattr(req, "dest_world", None) == world_rank:
+            req.complete(MPIException(
+                MPIX_ERR_PROC_FAILED,
+                f"rendezvous send peer (world rank {world_rank}) failed"))
+            continue
+        env = getattr(req, "_rndv_env", None)
+        if env is not None:
+            comm = universe.comms_by_ctx.get(req.match[0] & ~1)
+            if comm is not None and not comm.freed \
+                    and comm.world_of(env[0]) == world_rank:
+                req.complete(MPIException(
+                    MPIX_ERR_PROC_FAILED,
+                    f"rendezvous data source (world rank "
+                    f"{world_rank}) failed"))
 
 
 def comm_failed_world(comm) -> List[int]:
@@ -191,10 +210,12 @@ def _fail_ctx_recvs(u, comm) -> None:
 def _agreement(comm, flag: int, timeout: float = 10.0):
     """Failure-tolerant agreement among comm's surviving members.
 
-    Returns (failed_world_set, agreed_ctx, agreed_flag) — identical on all
-    survivors. Payload per round: a failure bitmap over the world, the
-    sender's next-free context id, the running AND of ``flag``, and a
-    "learned something last round" bit.
+    Returns (failed_world_set, agreed_ctx, agreed_flag, agreed_unacked) —
+    identical on all survivors. Payload per round: a failure bitmap over
+    the world, the sender's next-free context id, the running AND of
+    ``flag``, a "learned something last round" bit, and an ORed
+    "this comm has failures I have not acked" bit (so agree() raises
+    uniformly — the comm_agree.c fail-bit second agreement).
 
     Protocol: repeated all-to-all union rounds. Termination: after the
     first round in which my own and every received learned-bit is zero.
@@ -212,14 +233,19 @@ def _agreement(comm, flag: int, timeout: float = 10.0):
         my_failed[w] = 1
     my_ctx = np.int64(u._next_ctx)
     my_flag = np.int64(flag)
+    my_unacked = np.int64(0)
     prev_learned = np.int64(1)   # force at least two rounds
 
     for rnd in range(comm.size + 4):
         tag = _FT_TAG_BASE + rnd
         alive = [r for r in range(comm.size)
                  if not my_failed[comm.world_of(r)]]
+        if any(my_failed[w] and w not in comm._acked_failures
+               for w in comm.group.world_ranks):
+            my_unacked = np.int64(1)
         payload = np.concatenate(
-            [my_failed.astype(np.int64), [my_ctx, my_flag, prev_learned]])
+            [my_failed.astype(np.int64),
+             [my_ctx, my_flag, prev_learned, my_unacked]])
         views = _xchg_round(comm, alive, payload, tag, timeout)
         learned = False
         all_quiet = prev_learned == 0
@@ -241,12 +267,13 @@ def _agreement(comm, flag: int, timeout: float = 10.0):
             my_flag = np.int64(my_flag & view[W + 1])
             if view[W + 2] != 0:
                 all_quiet = False
+            my_unacked = np.int64(my_unacked | view[W + 3])
         my_failed = union
         prev_learned = np.int64(1 if learned else 0)
         if all_quiet and not learned:
             break
     failed = {w for w in range(W) if my_failed[w]}
-    return failed, int(my_ctx), int(my_flag)
+    return failed, int(my_ctx), int(my_flag), int(my_unacked)
 
 
 def _xchg_round(comm, alive: List[int], payload: np.ndarray, tag: int,
@@ -310,7 +337,7 @@ def shrink(comm):
     agreed fresh context id (comm_shrink.c semantics)."""
     from ..core.comm import Comm
     u = comm.u
-    failed, ctx, _ = _agreement(comm, 1)
+    failed, ctx, _, _ = _agreement(comm, 1)
     survivors = [w for w in comm.group.world_ranks if w not in failed]
     u._next_ctx = max(u._next_ctx, ctx + 2)
     newcomm = Comm(u, Group(survivors), ctx, comm.name + "_shrink")
@@ -320,19 +347,17 @@ def shrink(comm):
 
 def agree(comm, flag: int) -> int:
     """MPIX_Comm_agree: agreement on the bitwise AND of ``flag`` over the
-    surviving members. Raises MPIX_ERR_PROC_FAILED if the communicator has
-    failures not yet acknowledged via failure_ack (comm_agree.c contract —
-    the agreed value is still established first, so survivors stay in
-    lockstep)."""
-    failed, ctx, val = _agreement(comm, flag)
+    surviving members. Raises MPIX_ERR_PROC_FAILED — uniformly on every
+    participant, via an ORed unacked bit carried in the agreement itself —
+    if *any* member has comm failures not yet acknowledged via
+    failure_ack (comm_agree.c contract: the agreed value is still
+    established first, so survivors stay in lockstep)."""
+    _failed, ctx, val, unacked = _agreement(comm, flag)
     comm.u._next_ctx = max(comm.u._next_ctx, ctx)
-    unacked = {w for w in failed if w in comm.group.world_ranks} \
-        - comm._acked_failures
     if unacked:
         exc = MPIException(
             MPIX_ERR_PROC_FAILED,
-            f"agree with unacknowledged failures: world ranks "
-            f"{sorted(unacked)}")
+            "agree: some participant has unacknowledged failures")
         exc.agreed_flag = val
         raise exc
     return val

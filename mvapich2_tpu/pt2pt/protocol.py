@@ -212,6 +212,9 @@ class Pt2ptProtocol:
             self.engine.progress_poke()
             with self.engine.mutex:
                 pkt = self.matcher.peek_unexpected(ctx, source, tag)
+        if pkt is None and self._recv_source_failed(ctx, source):
+            raise MPIException(MPIX_ERR_PROC_FAILED,
+                               f"probe source failed (src={source})")
         return self._pkt_status(pkt) if pkt is not None else None
 
     def probe(self, source: int, ctx: int, tag: int) -> Status:
@@ -222,9 +225,14 @@ class Pt2ptProtocol:
             if pkt is not None:
                 box.append(pkt)
                 return True
-            return False
+            # a probe on a source that can never send again must unwind,
+            # like the equivalent posted recv (ULFM)
+            return self._recv_source_failed(ctx, source)
 
         self.engine.progress_wait(pred)
+        if not box:
+            raise MPIException(MPIX_ERR_PROC_FAILED,
+                               f"probe source failed (src={source})")
         return self._pkt_status(box[0])
 
     def improbe(self, source: int, ctx: int, tag: int):
@@ -236,6 +244,9 @@ class Pt2ptProtocol:
             with self.engine.mutex:
                 pkt = self.matcher.peek_unexpected(ctx, source, tag,
                                                    remove=True)
+        if pkt is None and self._recv_source_failed(ctx, source):
+            raise MPIException(MPIX_ERR_PROC_FAILED,
+                               f"probe source failed (src={source})")
         return pkt
 
     def mrecv(self, message: Packet, buf, count: int,

@@ -95,8 +95,11 @@ def _start_failure_watcher(u: Universe, kvs_addr: str) -> None:
                 dead = int(w.get(f"__failure_ev_{n}"))   # blocks until put
                 u.mark_failed(dead)
                 n += 1
-        except Exception:
+        except (OSError, ConnectionError):
             pass   # KVS gone = job tearing down
+        except Exception as e:   # anything else disables detection: say so
+            log.error("failure watcher died: %r — process failures will "
+                      "no longer be detected on this rank", e)
 
     threading.Thread(target=watch, daemon=True,
                      name="ft-failure-watcher").start()

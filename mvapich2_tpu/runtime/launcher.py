@@ -32,10 +32,12 @@ def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
 
     ``ft=False`` (default): a rank dying with nonzero status kills the job
     (mpirun_rsh cleanup-on-abnormal-exit behavior). ``ft=True`` (the
-    ``mpiexec -disable-auto-cleanup`` analog): a dead rank is published to
-    the KVS as a failure event instead — survivors learn of it through the
-    bootstrap failure watcher and can revoke/shrink (SURVEY §5.3); the job
-    result is then the survivors' max exit code."""
+    ``mpiexec -disable-auto-cleanup`` analog): a rank killed by a signal
+    (process death — negative returncode) is published to the KVS as a
+    failure event — survivors learn of it through the bootstrap failure
+    watcher and can revoke/shrink (SURVEY §5.3). A plain nonzero exit is
+    an *application error*, not a process failure: it is never published,
+    and the job result is the max exit code over non-failed ranks."""
     srv = KVSServer(nranks)
     procs: List[subprocess.Popen] = []
     try:
@@ -63,11 +65,14 @@ def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
                     exit_codes[i] = p.poll()
             bad = [i for i, c in enumerate(exit_codes)
                    if c is not None and c != 0 and i not in failed]
-            if bad and ft:
+            if ft:
+                # only signal deaths are process failures; error exits
+                # are the application's business (reported at job end)
                 for i in bad:
-                    failed.append(i)
-                    srv.publish(f"__failure_ev_{n_events}", str(i))
-                    n_events += 1
+                    if exit_codes[i] < 0:
+                        failed.append(i)
+                        srv.publish(f"__failure_ev_{n_events}", str(i))
+                        n_events += 1
             elif bad:
                 for p in procs:
                     if p.poll() is None:
@@ -86,7 +91,7 @@ def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
         if ft:
             survivors = [c for i, c in enumerate(exit_codes)
                          if i not in failed]
-            return max(survivors) if survivors else 1
+            return max(survivors, default=1)
         return max(c or 0 for c in exit_codes)
     finally:
         for p in procs:

@@ -20,7 +20,10 @@ rank, size = comm.rank, comm.size
 u = comm.u
 
 if rank == 1:
-    os._exit(3)
+    # die like a crashed process (signal death = a *process failure*;
+    # a plain sys.exit(1) is an application error and is not published)
+    import signal
+    os.kill(os.getpid(), signal.SIGKILL)
 
 # wait for launcher-driven detection (KVS failure watcher)
 deadline = time.time() + 30

@@ -59,6 +59,41 @@ def test_recv_from_failed_raises():
                if i != DEAD)
 
 
+def test_inflight_rendezvous_send_unwinds():
+    """A rendezvous send already in flight (RTS sent, no CTS yet) must
+    complete with MPIX_ERR_PROC_FAILED when the peer is marked failed."""
+    def body(comm):
+        if comm.rank == DEAD:
+            return None
+        if comm.rank == 0:
+            big = np.ones(1 << 18)          # above eager threshold
+            req = comm.isend(big, dest=DEAD)
+            comm.u.mark_failed(DEAD)        # detection lands mid-flight
+            try:
+                req.wait()
+                return "no-error"
+            except MPIException as e:
+                return e.error_class
+        return MPIX_ERR_PROC_FAILED
+
+    out = run_ranks(4, body)
+    assert all(r == MPIX_ERR_PROC_FAILED for i, r in enumerate(out)
+               if i != DEAD)
+
+
+def test_probe_of_failed_source_raises():
+    def body(comm):
+        try:
+            comm.probe(source=DEAD)
+            return "no-error"
+        except MPIException as e:
+            return e.error_class
+
+    out = run_ranks(4, _mark_dead_and(body))
+    assert all(r == MPIX_ERR_PROC_FAILED for i, r in enumerate(out)
+               if i != DEAD)
+
+
 def test_wildcard_recv_fails_until_acked():
     from mvapich2_tpu.core.status import ANY_SOURCE
 
@@ -182,6 +217,20 @@ def test_shrink_of_revoked_comm():
     for i, r in enumerate(out):
         if i != DEAD:
             assert r == 3.0
+
+
+def test_mpirun_ft_error_exit_not_masked():
+    """--ft: a survivor's nonzero *application* exit is not a process
+    failure — it must surface in the job's exit code, not be published."""
+    code = ("import sys; sys.path.insert(0, '.');"
+            "from mvapich2_tpu import mpi; mpi.Init();"
+            "c = mpi.COMM_WORLD; c.barrier();"
+            "sys.exit(1 if c.rank == 0 else 0)")
+    cmd = [sys.executable, "-m", "mvapich2_tpu.run", "-np", "3", "--ft",
+           sys.executable, "-c", code]
+    r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                       timeout=60)
+    assert r.returncode == 1, f"stdout={r.stdout}\nstderr={r.stderr}"
 
 
 def test_mpirun_ft_end_to_end():
