@@ -1,0 +1,168 @@
+"""Intercommunicator collectives.
+
+Analog of MPICH's generic intercomm algorithms (the reference dispatches
+inter-communicator collectives through the same coll_fns seam,
+src/mpi/coll/allreduce.c:772-789 — the `!MPIR_Comm_is_intra` branch): data
+moves between the two disjoint groups, with MPI-2 root semantics
+(root == MPI_ROOT on the origin side, root == rank-in-remote-group on the
+receiving side, MPI_PROC_NULL elsewhere).
+
+Structure of every algorithm: a local intracomm phase on
+``comm.local_comm`` + a leader bridge (local rank 0 <-> remote rank 0) over
+the intercomm's collective context. Both sides call collectives in the same
+order (an MPI requirement), so ``next_coll_tag`` stays in lockstep.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ..core.status import PROC_NULL, ROOT
+from .algorithms import crecv, csend, csendrecv
+
+
+def _packed(datatype, buf, count) -> np.ndarray:
+    return np.asarray(datatype.pack(buf, count))
+
+
+def barrier(comm) -> None:
+    tag = comm.next_coll_tag()
+    comm.local_comm.barrier()
+    if comm.rank == 0:
+        tok = np.zeros(1, dtype=np.uint8)
+        rtok = np.zeros(1, dtype=np.uint8)
+        csendrecv(comm, tok, 0, rtok, 0, tag)
+    comm.local_comm.barrier()
+
+
+def bcast(comm, buf, count, datatype, root) -> None:
+    tag = comm.next_coll_tag()
+    if root == PROC_NULL:
+        return
+    if root == ROOT:
+        # origin side: this rank holds the data; ship to remote local-0
+        csend(comm, _packed(datatype, buf, count), 0, tag).wait()
+        return
+    # receiving side: remote rank ``root`` sends to our local rank 0
+    nbytes = datatype.size * count
+    stage = np.empty(nbytes, dtype=np.uint8)
+    if comm.local_comm.rank == 0:
+        crecv(comm, stage, root, tag).wait()
+    comm.local_comm.bcast(stage, root=0)
+    datatype.unpack(stage, buf, count)
+
+
+def reduce(comm, sendbuf, recvbuf, count, datatype, op, root) -> None:
+    tag = comm.next_coll_tag()
+    if root == PROC_NULL:
+        return
+    if root == ROOT:
+        # origin of the *result*: receive remote side's reduction
+        nbytes = datatype.size * count
+        stage = np.empty(nbytes, dtype=np.uint8)
+        crecv(comm, stage, 0, tag).wait()
+        datatype.unpack(stage, recvbuf, count)
+        return
+    # contributing side: reduce locally to local rank 0, forward to root
+    part = comm.local_comm.reduce(np.asarray(sendbuf), root=0,
+                                  op=op, count=count, datatype=datatype)
+    if comm.local_comm.rank == 0:
+        csend(comm, _packed(datatype, part, count), root, tag).wait()
+
+
+def allreduce(comm, sendbuf, recvbuf, count, datatype, op) -> None:
+    """Each side receives the reduction of the *remote* group's data
+    (MPI-3.1 §5.2.3 intercomm semantics)."""
+    tag = comm.next_coll_tag()
+    lc = comm.local_comm
+    part = lc.reduce(np.asarray(sendbuf), root=0, op=op,
+                     count=count, datatype=datatype)
+    nbytes = datatype.size * count
+    stage = np.empty(nbytes, dtype=np.uint8)
+    if lc.rank == 0:
+        csendrecv(comm, _packed(datatype, part, count), 0, stage, 0, tag)
+    lc.bcast(stage, root=0)
+    datatype.unpack(stage, recvbuf, count)
+
+
+def allgather(comm, sendbuf, recvbuf, count, datatype) -> None:
+    """recvbuf gathers the remote group's contributions."""
+    tag = comm.next_coll_tag()
+    lc = comm.local_comm
+    nbytes = datatype.size * count
+    mine = _packed(datatype, sendbuf, count)
+    local_all = np.empty(nbytes * lc.size, dtype=np.uint8)
+    lc.gather(mine, local_all, root=0, count=nbytes)
+    remote_all = np.empty(nbytes * comm.remote_size, dtype=np.uint8)
+    if lc.rank == 0:
+        csendrecv(comm, local_all, 0, remote_all, 0, tag)
+    lc.bcast(remote_all, root=0)
+    datatype.unpack(remote_all, recvbuf, count * comm.remote_size)
+
+
+def gather(comm, sendbuf, recvbuf, count, datatype, root) -> None:
+    tag = comm.next_coll_tag()
+    if root == PROC_NULL:
+        return
+    nbytes = datatype.size * count
+    if root == ROOT:
+        stage = np.empty(nbytes * comm.remote_size, dtype=np.uint8)
+        crecv(comm, stage, 0, tag).wait()
+        datatype.unpack(stage, recvbuf, count * comm.remote_size)
+        return
+    lc = comm.local_comm
+    mine = _packed(datatype, sendbuf, count)
+    local_all = np.empty(nbytes * lc.size, dtype=np.uint8) \
+        if lc.rank == 0 else None
+    lc.gather(mine, local_all, root=0, count=nbytes)
+    if lc.rank == 0:
+        csend(comm, local_all, root, tag).wait()
+
+
+def scatter(comm, sendbuf, recvbuf, count, datatype, root) -> None:
+    tag = comm.next_coll_tag()
+    if root == PROC_NULL:
+        return
+    nbytes = datatype.size * count
+    if root == ROOT:
+        csend(comm, _packed(datatype, sendbuf, count * comm.remote_size),
+              0, tag).wait()
+        return
+    lc = comm.local_comm
+    local_all = np.empty(nbytes * lc.size, dtype=np.uint8)
+    if lc.rank == 0:
+        crecv(comm, local_all, root, tag).wait()
+    mine = np.empty(nbytes, dtype=np.uint8)
+    lc.scatter(local_all, mine, root=0, count=nbytes)
+    datatype.unpack(mine, recvbuf, count)
+
+
+def alltoall(comm, sendbuf, recvbuf, count, datatype) -> None:
+    """Direct pairwise exchange: block j of sendbuf goes to remote rank j;
+    block i of recvbuf comes from remote rank i."""
+    tag = comm.next_coll_tag()
+    nbytes = datatype.size * count
+    packed = _packed(datatype, sendbuf, count * comm.remote_size)
+    stage = np.empty(nbytes * comm.remote_size, dtype=np.uint8)
+    reqs = []
+    for j in range(comm.remote_size):
+        reqs.append(crecv(comm, stage[j * nbytes:(j + 1) * nbytes], j, tag))
+    for j in range(comm.remote_size):
+        reqs.append(csend(comm, packed[j * nbytes:(j + 1) * nbytes], j, tag))
+    for r in reqs:
+        r.wait()
+    datatype.unpack(stage, recvbuf, count * comm.remote_size)
+
+
+COLL_FNS: Dict[str, callable] = {
+    "barrier": barrier,
+    "bcast": bcast,
+    "reduce": reduce,
+    "allreduce": allreduce,
+    "allgather": allgather,
+    "gather": gather,
+    "scatter": scatter,
+    "alltoall": alltoall,
+}

@@ -1,0 +1,268 @@
+"""Intercommunicators (MPI-3.1 §6.6).
+
+Analog of src/mpi/comm/intercomm_create.c + intercomm_merge.c: two disjoint
+groups bridged by a leader pair. The context id is agreed across both sides
+(each side's collectively-agreed max, exchanged between leaders — the same
+safety argument as Universe.allocate_context_id), so matching works with a
+single shared id even though the sides allocate ids independently.
+
+Rank semantics: ``rank``/``size`` describe the local group;
+point-to-point dest/source ranks and collective roots name ranks in the
+*remote* group (world_of resolves through remote_group).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .comm import Comm, _resolve
+from .datatype import Datatype
+from .errors import MPIException, MPI_ERR_COMM, MPI_ERR_RANK, mpi_assert
+from .group import Group
+from .status import ANY_SOURCE, PROC_NULL, ROOT
+
+
+def bcast_json(comm: Comm, obj, root: int):
+    """Broadcast a JSON-serializable object over ``comm`` (length first)."""
+    import json
+    if comm.rank == root:
+        payload = np.frombuffer(json.dumps(obj).encode(), dtype=np.uint8)
+        n = np.array([payload.size], dtype=np.int64)
+        comm.bcast(n, root=root)
+        comm.bcast(payload.copy(), root=root)
+        return obj
+    n = np.zeros(1, dtype=np.int64)
+    comm.bcast(n, root=root)
+    payload = np.empty(int(n[0]), dtype=np.uint8)
+    comm.bcast(payload, root=root)
+    return json.loads(payload.tobytes().decode())
+
+
+def bridge_agree(local_comm: Comm, leader: int, exchange) -> dict:
+    """The one ctx-agreement protocol behind every two-sided communicator
+    constructor (intercomm_create / merge / dup / connect / accept /
+    spawn): allreduce-max of the side's next free context id, a leader
+    bridge (``exchange(lmax) -> dict with at least {"ctx": agreed}``,
+    run on the leader only — it must fold the other side's max in), a
+    local bcast of the leader's result, and reservation past the agreed
+    id. Returns the leader's dict on every rank."""
+    u = local_comm.u
+    from . import op as opmod
+    from .errors import MPI_ERR_OTHER
+    mine = np.array([u._next_ctx], dtype=np.int64)
+    lmax = np.zeros_like(mine)
+    local_comm.allreduce(mine, lmax, op=opmod.MAX)
+    hdr = None
+    if local_comm.rank == leader:
+        try:
+            hdr = exchange(int(lmax[0]))
+        except MPIException as e:
+            # propagate uniformly: a leader-side failure must not leave
+            # the other ranks blocked in the bcast below
+            hdr = {"ctx": int(lmax[0]), "error": str(e),
+                   "eclass": e.error_class}
+    hdr = bcast_json(local_comm, hdr, leader)
+    u._next_ctx = max(u._next_ctx, int(hdr["ctx"]) + 2)
+    if hdr.get("error"):
+        raise MPIException(hdr.get("eclass", MPI_ERR_OTHER), hdr["error"])
+    return hdr
+
+
+def _xchg_i64(comm: Comm, peer: int, tag: int, arr: np.ndarray) -> np.ndarray:
+    """Leader bridge: exchange variable-length int64 arrays with ``peer``
+    over ``comm`` (probe for the incoming length)."""
+    sreq = comm.isend(arr, peer, tag)
+    st = comm.probe(peer, tag)
+    out = np.empty(st.count // 8, dtype=np.int64)
+    comm.recv(out, peer, tag)
+    sreq.wait()
+    return out
+
+
+class Intercomm(Comm):
+    def __init__(self, universe, local_group: Group, remote_group: Group,
+                 context_id: int, local_comm: Comm, name: str = ""):
+        super().__init__(universe, local_group, context_id, name)
+        self.is_inter = True
+        self.remote_group = remote_group
+        self.local_comm = local_comm   # private intracomm over local group
+
+    # -- rank resolution: pt2pt/root ranks name the remote group ---------
+    @property
+    def remote_size(self) -> int:
+        return self.remote_group.size
+
+    def world_of(self, rank: int) -> int:
+        if rank in (PROC_NULL, ANY_SOURCE):
+            return rank
+        return self.remote_group.world_of_rank(rank)
+
+    def _check_rank(self, r: int, allow_any: bool = False) -> None:
+        if r == PROC_NULL or (allow_any and r == ANY_SOURCE):
+            return
+        mpi_assert(0 <= r < self.remote_size, MPI_ERR_RANK,
+                   f"rank {r} invalid for remote group of size "
+                   f"{self.remote_size}")
+
+    # -- collectives: the intercomm algorithm set ------------------------
+    def _coll(self, name: str):
+        from ..coll import inter
+        fn = inter.COLL_FNS.get(name)
+        if fn is None:
+            raise MPIException(
+                MPI_ERR_COMM, f"collective '{name}' not defined on "
+                f"intercommunicators")
+        return fn
+
+    # root==ROOT-aware wrappers (base class allocates on rank==root only)
+    def reduce(self, sendbuf, recvbuf=None, op=None, root: int = 0,
+               count: Optional[int] = None,
+               datatype: Optional[Datatype] = None):
+        self._check()
+        from . import op as opmod
+        op = op or opmod.SUM
+        count, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
+        if recvbuf is None and root == ROOT:
+            recvbuf = np.empty_like(np.asarray(sendbuf))
+        self._coll("reduce")(self, sendbuf, recvbuf, count, datatype, op,
+                             root)
+        return recvbuf
+
+    def allgather(self, sendbuf, recvbuf=None, count: Optional[int] = None,
+                  datatype: Optional[Datatype] = None):
+        self._check()
+        count, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
+        if recvbuf is None:
+            sb = np.asarray(sendbuf)
+            recvbuf = np.empty((self.remote_size * count,), dtype=sb.dtype)
+        self._coll("allgather")(self, sendbuf, recvbuf, count, datatype)
+        return recvbuf
+
+    def gather(self, sendbuf, recvbuf=None, root: int = 0,
+               count: Optional[int] = None,
+               datatype: Optional[Datatype] = None):
+        self._check()
+        count, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
+        if recvbuf is None and root == ROOT:
+            sb = np.asarray(sendbuf) if not isinstance(sendbuf, (bytes,
+                bytearray)) else np.frombuffer(sendbuf, dtype=np.uint8)
+            recvbuf = np.empty((self.remote_size * count,), dtype=sb.dtype)
+        self._coll("gather")(self, sendbuf, recvbuf, count, datatype, root)
+        return recvbuf
+
+    def alltoall(self, sendbuf, recvbuf=None, count: Optional[int] = None,
+                 datatype: Optional[Datatype] = None):
+        self._check()
+        if count is None:
+            sb = np.asarray(sendbuf)
+            count = sb.size // self.remote_size
+        _, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
+        if recvbuf is None:
+            recvbuf = np.empty_like(np.asarray(sendbuf))
+        self._coll("alltoall")(self, sendbuf, recvbuf, count, datatype)
+        return recvbuf
+
+    # -- ctx agreement across both sides ---------------------------------
+    def _agree_ctx(self) -> int:
+        """Collective over the intercomm: a context id fresh on both sides
+        (bridge_agree with a leader sendrecv over the coll context)."""
+        tag = self.next_coll_tag()
+        from ..coll.algorithms import csendrecv
+
+        def exchange(lmax: int) -> dict:
+            mine = np.array([lmax], dtype=np.int64)
+            other = np.zeros(1, dtype=np.int64)
+            csendrecv(self, mine, 0, other, 0, tag)
+            return {"ctx": max(lmax, int(other[0]))}
+
+        return int(bridge_agree(self.local_comm, 0, exchange)["ctx"])
+
+    def dup(self) -> "Intercomm":
+        self._check()
+        ctx = self._agree_ctx()
+        new = Intercomm(self.u, self.group, self.remote_group, ctx,
+                        self.local_comm.dup(), self.name + "_dup")
+        self.attrs.copy_all(self, new.attrs)
+        new.errhandler = self.errhandler
+        return new
+
+    def merge(self, high: bool = False) -> Comm:
+        """MPI_Intercomm_merge: union intracomm, low group's ranks first
+        (intercomm_merge.c analog; tie on equal ``high`` broken by the
+        smaller minimum world id, which both sides compute identically)."""
+        self._check()
+        tag = self.next_coll_tag()
+        lc = self.local_comm
+        from . import op as opmod
+        # uniform-high check (MPI requires all local ranks agree)
+        hs = np.array([int(high)], dtype=np.int64)
+        hmin, hmax = np.zeros(1, np.int64), np.zeros(1, np.int64)
+        lc.allreduce(hs, hmin, op=opmod.MIN)
+        lc.allreduce(hs, hmax, op=opmod.MAX)
+        if int(hmin[0]) != int(hmax[0]):
+            raise MPIException(MPI_ERR_COMM,
+                               "inconsistent high flags in Intercomm_merge")
+        from ..coll.algorithms import csendrecv
+
+        def exchange(lmax: int) -> dict:
+            mine = np.array([lmax, int(high)], dtype=np.int64)
+            other = np.zeros(2, dtype=np.int64)
+            csendrecv(self, mine, 0, other, 0, tag)
+            return {"ctx": max(lmax, int(other[0])), "rh": int(other[1])}
+
+        hdr = bridge_agree(lc, 0, exchange)
+        ctx = int(hdr["ctx"])
+        remote_high = bool(hdr["rh"])
+        local_ranks = list(self.group.world_ranks)
+        remote_ranks = list(self.remote_group.world_ranks)
+        if high == remote_high:
+            i_am_low = min(local_ranks) < min(remote_ranks)
+        else:
+            i_am_low = not high
+        order = (local_ranks + remote_ranks) if i_am_low \
+            else (remote_ranks + local_ranks)
+        return Comm(self.u, Group(order), ctx, self.name + "_merged")
+
+    def disconnect(self) -> None:
+        """MPI_Comm_disconnect: collective teardown (quiesce + free)."""
+        self.barrier()
+        self.free()
+
+    def free(self) -> None:
+        if not self.freed and self.local_comm is not None:
+            self.local_comm.free()
+        super().free()
+
+    def __repr__(self):
+        return (f"Intercomm({self.name or 'anon'}, rank={self.rank}/"
+                f"{self.size}|remote {self.remote_size}, "
+                f"ctx={self.context_id})")
+
+
+def intercomm_create(local_comm: Comm, local_leader: int,
+                     peer_comm: Comm, remote_leader: int,
+                     tag: int = 0) -> Intercomm:
+    """MPI_Intercomm_create (intercomm_create.c analog).
+
+    Collective over both local groups; the leader pair must be able to talk
+    over ``peer_comm``. Leaders exchange (agreed-max ctx, group world ids),
+    broadcast to their sides, and everyone constructs the intercomm."""
+    u = local_comm.u
+    private = local_comm.dup()
+
+    def exchange(lmax: int) -> dict:
+        msg = np.array([lmax] + list(private.group.world_ranks),
+                       dtype=np.int64)
+        other = _xchg_i64(peer_comm, remote_leader, tag, msg)
+        return {"ctx": max(lmax, int(other[0])),
+                "remote": [int(x) for x in other[1:]]}
+
+    hdr = bridge_agree(private, local_leader, exchange)
+    ctx, remote_ranks = int(hdr["ctx"]), hdr["remote"]
+    if u.world_rank in remote_ranks:
+        raise MPIException(MPI_ERR_COMM,
+                           "intercomm_create groups overlap")
+    return Intercomm(u, private.group, Group(remote_ranks), ctx, private,
+                     name="intercomm")

@@ -1,0 +1,176 @@
+"""Intercommunicator tests (MPICH test/mpi/comm ic* analogs)."""
+
+import numpy as np
+import pytest
+
+from mvapich2_tpu.core.intercomm import intercomm_create
+from mvapich2_tpu.core.status import PROC_NULL, ROOT
+from mvapich2_tpu.runtime.universe import run_ranks
+
+
+def _make_inter(world):
+    """Split world into low/high halves, bridge leaders over world."""
+    half = world.size // 2
+    low = world.rank < half
+    local = world.split(0 if low else 1, world.rank)
+    remote_leader = half if low else 0
+    inter = intercomm_create(local, 0, world, remote_leader, tag=99)
+    return inter, low, local
+
+
+def test_create_and_sizes():
+    def body(world):
+        inter, low, local = _make_inter(world)
+        assert inter.is_inter
+        assert inter.size == world.size // 2
+        assert inter.remote_size == world.size // 2
+        assert inter.rank == local.rank
+        return True
+
+    assert all(run_ranks(4, body))
+
+
+def test_pt2pt_across():
+    def body(world):
+        inter, low, _ = _make_inter(world)
+        me = np.array([world.rank], dtype=np.int64)
+        peer = np.zeros(1, dtype=np.int64)
+        # pairwise: local rank i <-> remote rank i
+        st = inter.sendrecv(me, inter.rank, 5, peer, inter.rank, 5)
+        assert st.source == inter.rank
+        half = world.size // 2
+        expect = world.rank + half if low else world.rank - half
+        assert int(peer[0]) == expect
+        return True
+
+    assert all(run_ranks(4, body))
+
+
+def test_barrier_and_bcast():
+    def body(world):
+        inter, low, _ = _make_inter(world)
+        inter.barrier()
+        buf = np.zeros(4, dtype=np.int32)
+        if low:
+            # low side's rank 0 is the origin
+            if inter.rank == 0:
+                buf[:] = [3, 1, 4, 1]
+                inter.bcast(buf, root=ROOT)
+            else:
+                inter.bcast(buf, root=PROC_NULL)
+            return True
+        inter.bcast(buf, root=0)
+        assert list(buf) == [3, 1, 4, 1]
+        return True
+
+    assert all(run_ranks(4, body))
+
+
+def test_allreduce_remote_sum():
+    def body(world):
+        inter, low, _ = _make_inter(world)
+        mine = np.array([world.rank + 1], dtype=np.int64)
+        out = np.zeros(1, dtype=np.int64)
+        inter.allreduce(mine, out)
+        half = world.size // 2
+        remote = range(half, world.size) if low else range(half)
+        assert int(out[0]) == sum(r + 1 for r in remote)
+        return True
+
+    assert all(run_ranks(4, body))
+
+
+def test_allgather_and_alltoall():
+    def body(world):
+        inter, low, _ = _make_inter(world)
+        half = world.size // 2
+        mine = np.array([world.rank], dtype=np.int64)
+        got = inter.allgather(mine, count=1)
+        remote = list(range(half, world.size)) if low else list(range(half))
+        assert list(got) == remote
+        sb = np.array([world.rank * 10 + j for j in range(half)],
+                      dtype=np.int64)
+        rb = inter.alltoall(sb, count=1)
+        expect = [r * 10 + inter.rank for r in remote]
+        assert list(rb) == expect
+        return True
+
+    assert all(run_ranks(4, body))
+
+
+def test_reduce_gather_scatter_root():
+    def body(world):
+        inter, low, _ = _make_inter(world)
+        half = world.size // 2
+        mine = np.array([world.rank + 1], dtype=np.int64)
+        if low:
+            if inter.rank == 0:
+                out = inter.reduce(mine, root=ROOT)
+                assert int(out[0]) == sum(r + 1
+                                          for r in range(half, world.size))
+                g = inter.gather(mine, root=ROOT, count=1)
+                assert list(g) == list(range(half + 1, world.size + 1))
+                sv = np.array(
+                    [100 + j for j in range(inter.remote_size)],
+                    dtype=np.int64)
+                inter.scatter(sv, np.zeros(1, np.int64), root=ROOT)
+            else:
+                inter.reduce(mine, root=PROC_NULL)
+                inter.gather(mine, root=PROC_NULL)
+                inter.scatter(None, None, root=PROC_NULL, count=1,
+                              datatype=None)
+            return True
+        inter.reduce(mine, root=0)
+        inter.gather(mine, root=0)
+        rv = np.zeros(1, dtype=np.int64)
+        inter.scatter(None, rv, root=0)
+        assert int(rv[0]) == 100 + inter.rank
+        return True
+
+    assert all(run_ranks(4, body))
+
+
+def test_merge_low_first():
+    def body(world):
+        inter, low, _ = _make_inter(world)
+        merged = inter.merge(high=not low)
+        assert merged.size == world.size
+        # low side first: merged rank == world rank (low ids come first)
+        assert merged.rank == world.rank
+        out = np.zeros(1, dtype=np.int64)
+        merged.allreduce(np.array([1], dtype=np.int64), out)
+        assert int(out[0]) == world.size
+        return True
+
+    assert all(run_ranks(4, body))
+
+
+def test_dup_and_disconnect():
+    def body(world):
+        inter, low, _ = _make_inter(world)
+        d = inter.dup()
+        assert d.is_inter and d.remote_size == inter.remote_size
+        out = np.zeros(1, dtype=np.int64)
+        d.allreduce(np.array([2], dtype=np.int64), out)
+        assert int(out[0]) == 2 * inter.remote_size
+        d.disconnect()
+        inter.barrier()   # original still usable
+        return True
+
+    assert all(run_ranks(6, body))
+
+
+def test_odd_split_sizes():
+    def body(world):
+        # 1-vs-3 split
+        low = world.rank < 1
+        local = world.split(0 if low else 1, world.rank)
+        inter = intercomm_create(local, 0, world, 1 if low else 0, tag=7)
+        assert inter.remote_size == (3 if low else 1)
+        mine = np.array([world.rank + 1], dtype=np.int64)
+        out = np.zeros(1, dtype=np.int64)
+        inter.allreduce(mine, out)
+        assert int(out[0]) == (2 + 3 + 4 if low else 1)
+        return True
+
+    assert all(run_ranks(4, body))
