@@ -37,6 +37,10 @@ MPI_ERR_FILE = 30
 MPI_ERR_IO = 32
 MPI_ERR_AMODE = 38
 MPI_ERR_NO_SUCH_FILE = 37
+MPI_ERR_NAME = 33
+MPI_ERR_PORT = 27
+MPI_ERR_SERVICE = 41
+MPI_ERR_SPAWN = 42
 # ULFM extension classes (reference: src/mpi/comm/comm_revoke.c et al.)
 MPIX_ERR_PROC_FAILED = 75
 MPIX_ERR_REVOKED = 76

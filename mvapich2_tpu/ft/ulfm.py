@@ -227,10 +227,18 @@ def _agreement(comm, flag: int, timeout: float = 10.0):
     protocol; the round count is bounded by comm size since each
     extension consumes a distinct failure."""
     u = comm.u
-    W = u.world_size
+    # bitmap spans the comm's member proc ids — a value every member
+    # computes identically (len(node_ids) is rank-local once dynamic spawn
+    # extends some ranks' proc tables and not others')
+    members = list(comm.group.world_ranks)
+    if getattr(comm, "is_inter", False) and \
+            getattr(comm, "remote_group", None) is not None:
+        members += list(comm.remote_group.world_ranks)
+    W = max(members) + 1
     my_failed = np.zeros(W, np.uint8)
     for w in u.failed_ranks:
-        my_failed[w] = 1
+        if w < W:
+            my_failed[w] = 1
     my_ctx = np.int64(u._next_ctx)
     my_flag = np.int64(flag)
     my_unacked = np.int64(0)

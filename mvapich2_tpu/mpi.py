@@ -130,3 +130,83 @@ BYTE, INT, FLOAT, DOUBLE = _dt.BYTE, _dt.INT, _dt.FLOAT, _dt.DOUBLE
 LONG, CHAR = _dt.LONG, _dt.CHAR
 BFLOAT16 = _dt.BFLOAT16
 run_ranks = _uni.run_ranks
+
+
+# ---------------------------------------------------------------------------
+# dynamic processes (MPI-3.1 §10; runtime/spawn.py) and name service
+# ---------------------------------------------------------------------------
+
+def _u():
+    u = _uni.current_universe()
+    if u is None:
+        raise MPIException(MPI_ERR_OTHER, "MPI not initialized")
+    return u
+
+
+def Comm_spawn(command, args=(), maxprocs=1, root=0, comm=None, info=None):
+    from .runtime import spawn as _sp
+    return _sp.comm_spawn(comm or _world(), command, args, maxprocs, root,
+                          info)
+
+
+def Comm_spawn_multiple(cmds, root=0, comm=None, info=None):
+    from .runtime import spawn as _sp
+    return _sp.comm_spawn_multiple(comm or _world(), cmds, root, info)
+
+
+def Comm_get_parent():
+    from .runtime import spawn as _sp
+    return _sp.get_parent(_u())
+
+
+def Get_appnum():
+    """MPI_APPNUM: which command of a Comm_spawn_multiple this process
+    runs; None when not spawned (the attribute is undefined)."""
+    return getattr(_u(), "appnum", None)
+
+
+def Open_port(info=None) -> str:
+    from .runtime import spawn as _sp
+    return _sp.open_port(_u(), info)
+
+
+def Close_port(port_name: str) -> None:
+    from .runtime import spawn as _sp
+    _sp.close_port(_u(), port_name)
+
+
+def Comm_accept(port_name: str, comm=None, root: int = 0, info=None):
+    from .runtime import spawn as _sp
+    return _sp.comm_accept(port_name, comm or _world(), root, info)
+
+
+def Comm_connect(port_name: str, comm=None, root: int = 0, info=None):
+    from .runtime import spawn as _sp
+    return _sp.comm_connect(port_name, comm or _world(), root, info)
+
+
+def Intercomm_create(local_comm, local_leader, peer_comm, remote_leader,
+                     tag=0):
+    from .core.intercomm import intercomm_create
+    return intercomm_create(local_comm, local_leader, peer_comm,
+                            remote_leader, tag)
+
+
+def Intercomm_merge(intercomm, high: bool = False):
+    return intercomm.merge(high)
+
+
+def Publish_name(service_name: str, port_name: str, info=None) -> None:
+    from .runtime import nameserv as _ns
+    _ns.publish_name(_u(), service_name, port_name, info)
+
+
+def Lookup_name(service_name: str, info=None) -> str:
+    from .runtime import nameserv as _ns
+    return _ns.lookup_name(_u(), service_name, info)
+
+
+def Unpublish_name(service_name: str, port_name: str = "",
+                   info=None) -> None:
+    from .runtime import nameserv as _ns
+    _ns.unpublish_name(_u(), service_name, port_name, info)

@@ -28,6 +28,9 @@ def bootstrap_from_env() -> Universe:
     kvs_addr = os.environ.get("MV2T_KVS")
     get_config().reload()
 
+    if os.environ.get("MV2T_WORLD_BASE") is not None and kvs_addr:
+        return _bootstrap_spawned(rank, size, kvs_addr)
+
     if size == 1 or kvs_addr is None:
         # singleton init (mpiexec-less a.out, like MPICH singleton PMI)
         from ..transport.local import LocalChannel, LocalFabric
@@ -51,27 +54,77 @@ def bootstrap_from_env() -> Universe:
         node_ids.append(ids.setdefault(n, len(ids)))
 
     u = Universe(rank, size, node_ids)
+    u.node_name_to_id = ids
     u.kvs = kvs
+    _wire_channels(u, kvs)
+    kvs.fence()   # everyone's business cards are published
+    u.initialize()
 
+    if os.environ.get("MV2T_FT") == "1":
+        _start_failure_watcher(u, kvs_addr)
+    return u
+
+
+def _wire_channels(u: Universe, kvs) -> None:
+    """Default tcp channel + shm fast path for co-located ranks (shared by
+    the original-world and spawned-child bootstrap paths)."""
     from ..transport.tcp import TcpChannel
-    tcp = TcpChannel(rank, kvs)
-    u.set_default_channel(tcp)
-
-    # intra-node fast path: shared-memory channel for co-located ranks
+    pid = u.world_rank
+    u.set_default_channel(TcpChannel(pid, kvs))
     try:
         from ..transport.shm import ShmChannel
-        local = [r for r in range(size) if node_ids[r] == node_ids[rank]]
+        local = [r for r in u.world_ranks
+                 if u.node_ids[r] == u.node_ids[pid]]
         if len(local) > 1:
-            shm = ShmChannel(rank, local, kvs)
+            shm = ShmChannel(pid, local, kvs)
             for r in local:
-                if r != rank:
+                if r != pid:
                     u.set_channel(r, shm)
     except Exception as e:  # pragma: no cover — fall back to tcp
         log.warn("shm channel unavailable (%s); using tcp intra-node", e)
 
-    kvs.fence()   # everyone's business cards are published
-    u.initialize()
 
+def _bootstrap_spawned(local: int, size: int, kvs_addr: str) -> Universe:
+    """Bootstrap of an MPI_Comm_spawn child (runtime/spawn.py): this rank
+    is proc id base+local in the parents' universe; its MPI_COMM_WORLD is
+    the sibling group; the parent intercomm is reconstructed from the
+    deterministic spawn envelope (ctx + parent group ids in the env) —
+    the mpid_comm_spawn_multiple.c:46 parent/child port handshake collapses
+    to env plumbing because both sides already share the KVS."""
+    import json
+
+    from ..core.group import Group
+    from ..core.intercomm import Intercomm
+
+    base = int(os.environ["MV2T_WORLD_BASE"])
+    ctx = int(os.environ["MV2T_SPAWN_CTX"])
+    parent_ranks = json.loads(os.environ["MV2T_PARENT_RANKS"])
+    pid = base + local
+
+    kvs = KVSClient(kvs_addr)
+    nodekey = os.environ.get("MV2T_FAKE_NODE", socket.gethostname())
+    kvs.put(f"node-{pid}", nodekey)
+    kvs.fence(group=f"spawn-{base}", count=size)
+    names = [kvs.get(f"node-{r}") for r in range(base + size)]
+    ids: dict = {}
+    node_ids: List[int] = [ids.setdefault(n, len(ids)) for n in names]
+
+    u = Universe(pid, size, node_ids, world_ranks=range(base, base + size))
+    u.node_name_to_id = ids
+    u.kvs = kvs
+    u.appnum = int(os.environ.get("MV2T_APPNUM", "0"))
+    _wire_channels(u, kvs)
+    kvs.fence(group=f"spawn-{base}-cards", count=size)
+    u.initialize()
+    u._next_ctx = max(u._next_ctx, ctx + 2)
+
+    private = u.comm_world.dup()
+    u.parent_intercomm = Intercomm(u, private.group, Group(parent_ranks),
+                                   ctx, private, name="spawn_child")
+    # signal the spawn root: every child's business card is published
+    if local == 0:
+        kvs.put(f"__spawn_ready_{base}",
+                json.dumps(names[base:base + size]))
     if os.environ.get("MV2T_FT") == "1":
         _start_failure_watcher(u, kvs_addr)
     return u

@@ -26,8 +26,9 @@ class _KVSState:
         self.nranks = nranks
         self.data: Dict[str, str] = {}
         self.cond = threading.Condition()
-        self.fence_count = 0
-        self.fence_gen = 0
+        # named fence groups (dynamic-process spawn barriers ride named
+        # groups with their own member counts; "" = the original world)
+        self.fences: Dict[str, List[int]] = {"": [nranks, 0, 0]}
         self.aborted: Optional[str] = None
 
 
@@ -52,16 +53,36 @@ class _Handler(socketserver.StreamRequestHandler):
                     val = state.data.get(msg["key"])
                 self._reply({"ok": val is not None, "val": val})
             elif cmd == "fence":
+                grp = msg.get("group", "")
                 with state.cond:
-                    gen = state.fence_gen
-                    state.fence_count += 1
-                    if state.fence_count == state.nranks:
-                        state.fence_count = 0
-                        state.fence_gen += 1
+                    f = state.fences.setdefault(
+                        grp, [int(msg.get("count", state.nranks)), 0, 0])
+                    gen = f[2]
+                    f[1] += 1
+                    if f[1] == f[0]:
+                        f[1] = 0
+                        f[2] += 1
                         state.cond.notify_all()
                     else:
-                        while state.fence_gen == gen and not state.aborted:
+                        while f[2] == gen and not state.aborted:
                             state.cond.wait(timeout=60)
+                self._reply({"ok": True})
+            elif cmd == "add":
+                # atomic fetch-add on an integer key (proc-id allocation)
+                with state.cond:
+                    cur = int(state.data.get(msg["key"], "0"))
+                    cur += int(msg.get("delta", 1))
+                    state.data[msg["key"]] = str(cur)
+                    state.cond.notify_all()
+                self._reply({"ok": True, "val": cur})
+            elif cmd == "peek":
+                # nonblocking get (nameserv lookup must be able to fail)
+                with state.cond:
+                    val = state.data.get(msg["key"])
+                self._reply({"ok": val is not None, "val": val})
+            elif cmd == "del":
+                with state.cond:
+                    state.data.pop(msg["key"], None)
                 self._reply({"ok": True})
             elif cmd == "abort":
                 with state.cond:
@@ -81,6 +102,8 @@ class KVSServer:
 
     def __init__(self, nranks: int, host: str = "127.0.0.1"):
         self.state = _KVSState(nranks)
+        # proc-id watermark for dynamic spawn (runtime/spawn.py)
+        self.state.data["__next_proc"] = str(nranks)
         self._srv = socketserver.ThreadingTCPServer((host, 0), _Handler,
                                                     bind_and_activate=True)
         self._srv.daemon_threads = True
@@ -134,8 +157,23 @@ class KVSClient:
             raise KeyError(key)
         return r["val"]
 
-    def fence(self) -> None:
-        self._rpc({"cmd": "fence"})
+    def fence(self, group: str = "", count: Optional[int] = None) -> None:
+        msg = {"cmd": "fence", "group": group}
+        if count is not None:
+            msg["count"] = count
+        self._rpc(msg)
+
+    def add(self, key: str, delta: int = 1) -> int:
+        """Atomic fetch-add; returns the post-add value."""
+        return int(self._rpc({"cmd": "add", "key": key, "delta": delta})
+                   ["val"])
+
+    def peek(self, key: str) -> Optional[str]:
+        r = self._rpc({"cmd": "peek", "key": key})
+        return r["val"] if r.get("ok") else None
+
+    def delete(self, key: str) -> None:
+        self._rpc({"cmd": "del", "key": key})
 
     def abort(self, why: str = "") -> None:
         try:

@@ -1,0 +1,60 @@
+"""Name service: MPI_Publish_name / MPI_Lookup_name / MPI_Unpublish_name.
+
+Analog of src/nameserv/ (file- and PMI-backed name publishing). Backends:
+  * KVS (process mode) — names live in the job's KVS under __ns_ keys,
+    the "PMI backend" analog;
+  * in-process registry (thread mode) — the "file backend" analog for the
+    unit-test harness.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from ..core.errors import MPIException, MPI_ERR_NAME, MPI_ERR_SERVICE
+
+_LOCAL_NS: Dict[str, str] = {}
+_LOCAL_LOCK = threading.Lock()
+
+
+def _kvs(u):
+    return getattr(u, "kvs", None)
+
+
+def publish_name(u, service_name: str, port_name: str, info=None) -> None:
+    kvs = _kvs(u)
+    if kvs is not None:
+        kvs.put(f"__ns_{service_name}", port_name)
+        return
+    with _LOCAL_LOCK:
+        _LOCAL_NS[service_name] = port_name
+
+
+def lookup_name(u, service_name: str, info=None) -> str:
+    kvs = _kvs(u)
+    if kvs is not None:
+        val = kvs.peek(f"__ns_{service_name}")
+    else:
+        with _LOCAL_LOCK:
+            val = _LOCAL_NS.get(service_name)
+    if val is None:
+        raise MPIException(MPI_ERR_NAME,
+                           f"service {service_name!r} not published")
+    return val
+
+
+def unpublish_name(u, service_name: str, port_name: str = "",
+                   info=None) -> None:
+    kvs = _kvs(u)
+    if kvs is not None:
+        if kvs.peek(f"__ns_{service_name}") is None:
+            raise MPIException(MPI_ERR_SERVICE,
+                               f"service {service_name!r} not published")
+        kvs.delete(f"__ns_{service_name}")
+        return
+    with _LOCAL_LOCK:
+        if service_name not in _LOCAL_NS:
+            raise MPIException(MPI_ERR_SERVICE,
+                               f"service {service_name!r} not published")
+        del _LOCAL_NS[service_name]

@@ -1,0 +1,297 @@
+"""Dynamic processes: spawn, ports, connect/accept (MPI-3.1 §10).
+
+Analog of the reference's dynamic-process machinery:
+  * MPID_Comm_spawn_multiple (src/mpid/ch3/src/mpid_comm_spawn_multiple.c:46)
+    — here the spawn root forks the child ranks itself and they join the
+    job's KVS, extending the universe proc table (no separate PMI spawn
+    round-trip to the launcher).
+  * port machinery (src/mpid/ch3/src/ch3u_port.c) — a port is a
+    (proc id, tag) pair; connect/accept is a leader handshake on a reserved
+    context id followed by the same group/ctx agreement as
+    MPI_Intercomm_create (core.intercomm.bridge_agree).
+
+Two modes, matching the two Universe instantiation modes:
+  * process mode — children are OS processes bootstrapped through the KVS
+    (tcp/shm channels dial new proc ids lazily by KVS business card).
+  * thread mode (the unit-test harness) — ``command`` is a Python callable
+    and children are rank threads registered on the shared LocalFabric.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import threading
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..core.comm import Comm
+from ..core.errors import (MPIException, MPI_ERR_OTHER, MPI_ERR_PORT,
+                           MPI_ERR_SPAWN, MPI_SUCCESS, mpi_assert)
+from ..core.group import Group
+from ..core.intercomm import Intercomm, bcast_json, bridge_agree
+from ..core.status import ANY_SOURCE
+from ..utils.mlog import get_logger
+
+log = get_logger("spawn")
+
+# reserved context id for the port handshake (Universe._next_ctx starts at
+# 8; 0/2 world+self, 4 ports, 6 spare — the "tmp ctx" discipline of
+# ch3u_port.c)
+PORT_CTX = 4
+
+
+def _my_node_name() -> str:
+    return os.environ.get("MV2T_FAKE_NODE", socket.gethostname())
+
+
+# ---------------------------------------------------------------------------
+# MPI_Comm_spawn / MPI_Comm_spawn_multiple
+# ---------------------------------------------------------------------------
+
+def comm_spawn(comm: Comm, command: Union[str, Sequence[str], Callable],
+               args: Sequence[str] = (), maxprocs: int = 1, root: int = 0,
+               info=None) -> Tuple[Intercomm, List[int]]:
+    cmds = [(command, list(args), maxprocs)]
+    return comm_spawn_multiple(comm, cmds, root, info)
+
+
+def comm_spawn_multiple(comm: Comm, cmds: Sequence[Tuple], root: int = 0,
+                        info=None) -> Tuple[Intercomm, List[int]]:
+    """``cmds`` is a list of (command, args, maxprocs) triples. All children
+    share one child MPI_COMM_WORLD; MPI_APPNUM (universe.appnum, exposed as
+    mpi.Get_appnum) tells them which command they run."""
+    u = comm.u
+    total = sum(m for _, _, m in cmds)
+    mpi_assert(total > 0, MPI_ERR_SPAWN, "spawn of zero processes")
+    ctx = u.allocate_context_id(comm)
+    if callable(cmds[0][0]):
+        return _spawn_threads(comm, cmds, root, ctx, total)
+    return _spawn_procs(comm, cmds, root, ctx, total)
+
+
+def _finish_spawn(comm: Comm, hdr, root: int, ctx: int):
+    """Shared parent-side tail: broadcast the spawn envelope, extend the
+    proc table, build the parent side of the intercomm."""
+    u = comm.u
+    hdr = bcast_json(comm, hdr, root)
+    if hdr.get("error"):
+        raise MPIException(MPI_ERR_SPAWN, hdr["error"])
+    base, total = hdr["base"], hdr["total"]
+    u.extend_procs(base, hdr["names"])
+    private = comm.dup()
+    inter = Intercomm(u, private.group, Group(range(base, base + total)),
+                      ctx, private, name="spawn_parent")
+    return inter, hdr.get("errcodes", [MPI_SUCCESS] * total)
+
+
+def _spawn_procs(comm: Comm, cmds, root: int, ctx: int,
+                 total: int) -> Tuple[Intercomm, List[int]]:
+    u = comm.u
+    kvs = getattr(u, "kvs", None)
+    if kvs is None:
+        raise MPIException(MPI_ERR_OTHER,
+                           "process-mode spawn needs a KVS (launched job)")
+    hdr = None
+    if comm.rank == root:
+        base = kvs.add("__next_proc", total) - total
+        errcodes = [MPI_SUCCESS] * total
+        procs: List[subprocess.Popen] = []
+        i = 0
+        for appnum, (command, args, m) in enumerate(cmds):
+            argv = ([command] if isinstance(command, str)
+                    else list(command)) + list(args)
+            for _ in range(m):
+                env = dict(os.environ)
+                env["MV2T_RANK"] = str(i)
+                env["MV2T_SIZE"] = str(total)
+                env["MV2T_KVS"] = os.environ.get("MV2T_KVS", "")
+                env["MV2T_WORLD_BASE"] = str(base)
+                env["MV2T_SPAWN_CTX"] = str(ctx)
+                env["MV2T_APPNUM"] = str(appnum)
+                env["MV2T_PARENT_RANKS"] = json.dumps(
+                    list(comm.group.world_ranks))
+                env.setdefault("JAX_PLATFORMS", "cpu")
+                try:
+                    procs.append(subprocess.Popen(argv, env=env))
+                except OSError as e:
+                    errcodes[i] = MPI_ERR_SPAWN
+                    log.error("spawn of %r failed: %s", argv, e)
+                i += 1
+        if any(c != MPI_SUCCESS for c in errcodes):
+            # a partial world would deadlock in the child bootstrap fence
+            # (count never reached) — tear down what started and error out
+            # uniformly on the parent side
+            for p in procs:
+                p.kill()
+            hdr = {"error": f"spawn failed: errcodes {errcodes}"}
+        else:
+            # children publish their node names once their world is wired
+            child_names = json.loads(kvs.get(f"__spawn_ready_{base}"))
+            hdr = {"base": base, "total": total, "names": child_names,
+                   "errcodes": errcodes}
+    return _finish_spawn(comm, hdr, root, ctx)
+
+
+def _spawn_threads(comm: Comm, cmds, root: int, ctx: int,
+                   total: int) -> Tuple[Intercomm, List[int]]:
+    """Thread-mode spawn for the in-process harness: children are rank
+    threads over the parent's LocalFabric, running ``command(child_world)``.
+    Children inherit the spawn root's (synthetic) node, named through the
+    shared __node_<id> table so every rank extends its proc table
+    identically (universe.extend_procs)."""
+    from ..transport.local import LocalChannel
+    from .universe import Universe, set_universe
+    u = comm.u
+    parent_ranks = list(comm.group.world_ranks)
+    hdr = None
+    if comm.rank == root:
+        fabric = u.channel_for(u.world_rank).fabric
+        with fabric._lock:
+            base = getattr(fabric, "_next_proc", None)
+            if base is None:
+                base = fabric.nranks
+            fabric._next_proc = base + total
+        child_nodes = [f"__node_{u.my_node}"] * total
+        # build + register child universes before any parent can send
+        children: List[Universe] = []
+        node_ids_child = list(u.node_ids)
+        while len(node_ids_child) < base:
+            node_ids_child.append(-1000 - len(node_ids_child))
+        node_ids_child += [u.my_node] * total
+        for i in range(total):
+            cu = Universe(base + i, total, node_ids_child,
+                          world_ranks=range(base, base + total))
+            cu.node_name_to_id = {f"__node_{v}": v
+                                  for v in sorted(set(node_ids_child))
+                                  if v >= 0}
+            cu.set_default_channel(LocalChannel(fabric, base + i))
+            fabric.register(base + i, cu.engine)
+            children.append(cu)
+        for cu in children:
+            cu.initialize()
+            cu._next_ctx = max(cu._next_ctx, ctx + 2)
+
+        def body(i: int):
+            cu = children[i]
+            set_universe(cu)
+            try:
+                private = cu.comm_world.dup()
+                cu.parent_intercomm = Intercomm(
+                    cu, private.group, Group(parent_ranks), ctx, private,
+                    name="spawn_child")
+                fn = None
+                k = i
+                for appnum, (command, _args, m) in enumerate(cmds):
+                    if k < m:
+                        fn = command
+                        cu.appnum = appnum
+                        break
+                    k -= m
+                fn(cu.comm_world)
+            finally:
+                set_universe(None)
+
+        for i in range(total):
+            threading.Thread(target=body, args=(i,), daemon=True,
+                             name=f"spawned-{base + i}").start()
+        hdr = {"base": base, "total": total, "names": child_nodes}
+    return _finish_spawn(comm, hdr, root, ctx)
+
+
+def get_parent(u) -> Optional[Intercomm]:
+    """MPI_Comm_get_parent: the spawn intercomm on spawned ranks."""
+    return getattr(u, "parent_intercomm", None)
+
+
+# ---------------------------------------------------------------------------
+# ports: MPI_Open_port / MPI_Comm_accept / MPI_Comm_connect
+# ---------------------------------------------------------------------------
+
+def open_port(u, info=None) -> str:
+    tag = int.from_bytes(os.urandom(4), "little") & 0x0FFFFFFF
+    name = f"mv2t-port:{u.world_rank}:{tag}"
+    u.ports[tag] = name
+    return name
+
+
+def close_port(u, port_name: str) -> None:
+    try:
+        _, _, tag = _parse_port(port_name)
+        u.ports.pop(tag, None)
+    except MPIException:
+        pass
+
+
+def _parse_port(port_name: str) -> Tuple[str, int, int]:
+    parts = port_name.split(":")
+    if len(parts) != 3 or parts[0] != "mv2t-port":
+        raise MPIException(MPI_ERR_PORT, f"bad port name {port_name!r}")
+    return parts[0], int(parts[1]), int(parts[2])
+
+
+def _port_send(u, dest_world: int, tag: int, arr: np.ndarray) -> None:
+    from ..core.datatype import INT64_T
+    u.protocol.isend(arr, arr.size, INT64_T, dest_world, u.world_rank,
+                     PORT_CTX, tag).wait()
+
+
+def _port_recv(u, source: int, tag: int) -> Tuple[np.ndarray, int]:
+    """Blocking probe+recv of an int64 array on the port context; returns
+    (data, sender proc id)."""
+    from ..core.datatype import INT64_T
+    st = u.protocol.probe(source, PORT_CTX, tag)
+    out = np.empty(st.count // 8, dtype=np.int64)
+    u.protocol.irecv(out, out.size, INT64_T, st.source, PORT_CTX,
+                     tag).wait()
+    return out, st.source
+
+
+def comm_accept(port_name: str, comm: Comm, root: int = 0,
+                info=None) -> Intercomm:
+    """Collective over ``comm``; root must be the rank that opened the
+    port. Handshake mirrors intercomm_create's leader exchange."""
+    u = comm.u
+    private = comm.dup()
+
+    def exchange(lmax: int) -> dict:
+        _, owner, tag = _parse_port(port_name)
+        if owner != u.world_rank:
+            raise MPIException(MPI_ERR_PORT,
+                               f"accept on foreign port {port_name!r}")
+        if tag not in u.ports:
+            raise MPIException(MPI_ERR_PORT,
+                               f"port {port_name!r} is not open")
+        req, peer = _port_recv(u, ANY_SOURCE, tag)
+        ctx = max(lmax, int(req[0]))
+        remote_ranks = [int(x) for x in req[1:]]
+        reply = np.array([ctx] + list(private.group.world_ranks),
+                         dtype=np.int64)
+        _port_send(u, peer, tag, reply)
+        return {"ctx": ctx, "remote": remote_ranks}
+
+    hdr = bridge_agree(private, root, exchange)
+    return Intercomm(u, private.group, Group(hdr["remote"]),
+                     int(hdr["ctx"]), private, name="accepted")
+
+
+def comm_connect(port_name: str, comm: Comm, root: int = 0,
+                 info=None) -> Intercomm:
+    u = comm.u
+    private = comm.dup()
+
+    def exchange(lmax: int) -> dict:
+        _, owner, tag = _parse_port(port_name)
+        req = np.array([lmax] + list(private.group.world_ranks),
+                       dtype=np.int64)
+        _port_send(u, owner, tag, req)
+        reply, _ = _port_recv(u, owner, tag)
+        return {"ctx": int(reply[0]),
+                "remote": [int(x) for x in reply[1:]]}
+
+    hdr = bridge_agree(private, root, exchange)
+    return Intercomm(u, private.group, Group(hdr["remote"]),
+                     int(hdr["ctx"]), private, name="connected")

@@ -31,11 +31,23 @@ log = get_logger("runtime")
 
 class Universe:
     def __init__(self, world_rank: int, world_size: int,
-                 node_ids: Optional[Sequence[int]] = None):
+                 node_ids: Optional[Sequence[int]] = None,
+                 world_ranks: Optional[Sequence[int]] = None):
+        """``world_rank`` is this proc's universe-wide proc id.
+        ``world_ranks`` is the proc-id set of MPI_COMM_WORLD — for a
+        spawned child world it is range(base, base+n) rather than
+        range(world_size) (dynamic processes, runtime/spawn.py).
+        ``node_ids`` is indexed by proc id and must cover every proc this
+        rank can address (len >= max proc id + 1)."""
         self.world_rank = world_rank
         self.world_size = world_size
+        self.world_ranks: List[int] = list(world_ranks) \
+            if world_ranks is not None else list(range(world_size))
         self.node_ids: List[int] = list(node_ids) if node_ids is not None \
-            else [0] * world_size
+            else [0] * (max(self.world_ranks, default=0) + 1)
+        self.node_name_to_id: Dict[str, int] = {}
+        self.parent_intercomm = None      # set on spawned ranks
+        self.ports: Dict[int, str] = {}   # open ports (tag -> port name)
         self.engine = ProgressEngine(world_rank)
         self.protocol: Optional[Pt2ptProtocol] = None
         self._channels: Dict[int, Channel] = {}   # world rank -> channel
@@ -79,7 +91,32 @@ class Universe:
 
     def local_world_ranks(self) -> List[int]:
         me = self.my_node
-        return [r for r in range(self.world_size) if self.node_ids[r] == me]
+        return [r for r in self.world_ranks if self.node_ids[r] == me]
+
+    def extend_procs(self, base: int, node_names: Sequence[str]) -> None:
+        """Grow the proc table for dynamically-spawned processes with ids
+        ``base..base+len(node_names)-1`` (the analog of connecting a new
+        MPIDI_PG and extending the VC table, mpidi_pg.c). Node names map
+        through node_name_to_id — populated at bootstrap with the *same*
+        name->id table on every rank, so all ranks extend identically and
+        node-aware (2-level) collectives stay consistent. Unknown names
+        get fresh ids deterministically (same inputs everywhere)."""
+        m = self.node_name_to_id
+        # ids for procs we never heard of (gaps from sibling spawns):
+        # unique negatives, so is_local() is never wrongly true
+        while len(self.node_ids) < base:
+            self.node_ids.append(-1000 - len(self.node_ids))
+        fresh = max(max(self.node_ids, default=0),
+                    max(m.values(), default=0)) + 1
+        for i, name in enumerate(node_names):
+            if name not in m:
+                m[name] = fresh
+                fresh += 1
+            pid = base + i
+            if pid < len(self.node_ids):
+                self.node_ids[pid] = m[name]
+            else:
+                self.node_ids.append(m[name])
 
     def num_nodes(self) -> int:
         return len(set(self.node_ids))
@@ -97,7 +134,7 @@ class Universe:
                 from ..ft import ulfm
                 ulfm.install(self)
             with ts.phase("comm_world/self"):
-                self.comm_world = Comm(self, Group(range(self.world_size)),
+                self.comm_world = Comm(self, Group(self.world_ranks),
                                        context_id=0, name="MPI_COMM_WORLD")
                 self.comm_self = Comm(self, Group([self.world_rank]),
                                       context_id=2, name="MPI_COMM_SELF")
@@ -167,6 +204,9 @@ def local_universe(nranks: int, nodes: Optional[Sequence[int]] = None
     universes = []
     for r in range(nranks):
         u = Universe(r, nranks, nodes)
+        # synthetic node-name table (spawn extends proc tables through it;
+        # every rank must hold the same map — see extend_procs)
+        u.node_name_to_id = {f"__node_{v}": v for v in sorted(set(u.node_ids))}
         u.set_default_channel(LocalChannel(fabric, r))
         fabric.register(r, u.engine)
         universes.append(u)

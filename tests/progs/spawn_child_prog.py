@@ -1,0 +1,28 @@
+"""Spawned child: joins via KVS, talks to parents over the spawn
+intercomm (launched by spawn_parent_prog.py)."""
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from mvapich2_tpu import mpi  # noqa: E402
+
+mpi.Init()
+cw = mpi.COMM_WORLD
+parent = mpi.Comm_get_parent()
+assert parent is not None and parent.is_inter, "no parent intercomm"
+assert cw.size == 2, f"child world size {cw.size}"
+
+out = parent.allreduce(np.array([100 + cw.rank], dtype=np.int64))
+# parents contributed rank+1 each over a 2-rank world: 1 + 2
+assert int(out[0]) == 3, f"child saw parent sum {out[0]}"
+
+merged = parent.merge(high=True)
+assert merged.size == parent.remote_size + cw.size
+tot = merged.allreduce(np.ones(1))
+assert int(tot[0]) == merged.size
+
+parent.barrier()
+mpi.Finalize()
+sys.exit(0)

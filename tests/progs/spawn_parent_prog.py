@@ -1,0 +1,46 @@
+"""Process-mode dynamic-process smoke: spawn children, intercomm
+collectives, merge — prints 'No Errors' (SURVEY §4 contract)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from mvapich2_tpu import mpi  # noqa: E402
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+child = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "spawn_child_prog.py")
+
+errs = 0
+inter, codes = mpi.Comm_spawn([sys.executable, child], maxprocs=2,
+                              root=0, comm=comm)
+if any(codes):
+    errs += 1
+    print(f"rank {comm.rank}: spawn errcodes {codes}")
+if inter.remote_size != 2:
+    errs += 1
+    print(f"rank {comm.rank}: remote_size {inter.remote_size}")
+
+# children contribute 100 + child_rank
+out = inter.allreduce(np.array([comm.rank + 1], dtype=np.int64))
+if int(out[0]) != 201:
+    errs += 1
+    print(f"rank {comm.rank}: inter allreduce {out[0]}")
+
+merged = inter.merge(high=False)
+if merged.size != comm.size + 2 or merged.rank != comm.rank:
+    errs += 1
+    print(f"rank {comm.rank}: merge wrong {merged.rank}/{merged.size}")
+tot = merged.allreduce(np.ones(1))
+if int(tot[0]) != merged.size:
+    errs += 1
+    print(f"rank {comm.rank}: merged allreduce {tot[0]}")
+
+inter.barrier()
+if comm.rank == 0 and errs == 0:
+    print("No Errors")
+mpi.Finalize()
+sys.exit(1 if errs else 0)

@@ -1,0 +1,124 @@
+"""Dynamic-process tests: spawn, ports, connect/accept, nameserv
+(MPICH test/mpi/spawn analogs)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from mvapich2_tpu.runtime import nameserv as ns
+from mvapich2_tpu.runtime import spawn as sp
+from mvapich2_tpu.runtime import universe as uni
+from mvapich2_tpu.runtime.universe import run_ranks
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_thread_spawn_intercomm():
+    child_box = {}
+
+    def child_main(cw):
+        u = uni.current_universe()
+        parent = sp.get_parent(u)
+        assert parent is not None and parent.is_inter
+        out = parent.allreduce(np.array([10 + cw.rank], dtype=np.int64))
+        child_box[cw.rank] = int(out[0])
+        parent.barrier()
+
+    def body(world):
+        inter, errs = sp.comm_spawn(world, child_main, maxprocs=2, root=0)
+        assert all(e == 0 for e in errs)
+        assert inter.remote_size == 2
+        out = inter.allreduce(np.array([world.rank + 1], dtype=np.int64))
+        assert int(out[0]) == 10 + 11          # children's contributions
+        inter.barrier()
+        return True
+
+    assert all(run_ranks(2, body))
+    # children saw the parents' sum (1 + 2)
+    assert child_box == {0: 3, 1: 3}
+
+
+def test_thread_spawn_merge():
+    def child_main(cw):
+        u = uni.current_universe()
+        parent = sp.get_parent(u)
+        merged = parent.merge(high=True)
+        out = merged.allreduce(np.array([1], dtype=np.int64))
+        assert int(out[0]) == merged.size
+
+    def body(world):
+        inter, _ = sp.comm_spawn(world, child_main, maxprocs=3, root=0)
+        merged = inter.merge(high=False)
+        assert merged.size == world.size + 3
+        # low (parent) side first
+        assert merged.rank == world.rank
+        out = merged.allreduce(np.array([1], dtype=np.int64))
+        assert int(out[0]) == merged.size
+        return True
+
+    assert all(run_ranks(2, body))
+
+
+def test_ports_connect_accept():
+    def body(world):
+        half = world.size // 2
+        server = world.rank < half
+        local = world.split(0 if server else 1, world.rank)
+        if server:
+            if local.rank == 0:
+                port = sp.open_port(world.u)
+                ns.publish_name(world.u, "svc-test", port)
+            else:
+                port = "mv2t-port:0:0"   # only the root's port matters
+            inter = sp.comm_accept(port, local, 0)
+        else:
+            port = ns_wait("svc-test")
+            inter = sp.comm_connect(port, local, 0)
+        assert inter.remote_size == half
+        out = inter.allreduce(np.array([world.rank], dtype=np.int64))
+        remote = range(half, world.size) if server else range(half)
+        assert int(out[0]) == sum(remote)
+        inter.disconnect()
+        return True
+
+    def ns_wait(name):
+        u = uni.current_universe()
+        for _ in range(500):
+            try:
+                return ns.lookup_name(u, name)
+            except Exception:
+                time.sleep(0.01)
+        raise TimeoutError(name)
+
+    assert all(run_ranks(4, body))
+
+
+def test_nameserv_errors():
+    def body(world):
+        if world.rank == 0:
+            ns.publish_name(world.u, "ephemeral", "mv2t-port:0:1")
+            assert ns.lookup_name(world.u, "ephemeral") == "mv2t-port:0:1"
+            ns.unpublish_name(world.u, "ephemeral")
+            with pytest.raises(Exception):
+                ns.lookup_name(world.u, "ephemeral")
+            with pytest.raises(Exception):
+                ns.unpublish_name(world.u, "ephemeral")
+        return True
+
+    assert all(run_ranks(1, body))
+
+
+def test_process_spawn():
+    """End-to-end: launched parent job spawns child processes which join
+    via the KVS and talk over the parent/child intercomm."""
+    prog = os.path.join(REPO, "tests", "progs", "spawn_parent_prog.py")
+    cmd = [sys.executable, "-m", "mvapich2_tpu.run", "-np", "2",
+           sys.executable, prog]
+    r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                       timeout=180)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
