@@ -1,0 +1,19 @@
+"""MPI-IO subsystem (the ROMIO analog — reference: src/mpi/romio/).
+
+Layers: adio.py (per-driver file access: ufs/memfs), view.py (file-view
+flattening), file.py (MPI_File semantics: independent/collective/shared/
+ordered/nonblocking IO, data sieving, two-phase collective buffering).
+"""
+
+from .adio import (MODE_APPEND, MODE_CREATE, MODE_DELETE_ON_CLOSE,
+                   MODE_EXCL, MODE_RDONLY, MODE_RDWR, MODE_SEQUENTIAL,
+                   MODE_UNIQUE_OPEN, MODE_WRONLY, delete_file)
+from .file import (SEEK_CUR, SEEK_END, SEEK_SET, File, file_delete,
+                   file_open)
+
+__all__ = [
+    "File", "file_open", "file_delete", "delete_file",
+    "MODE_RDONLY", "MODE_RDWR", "MODE_WRONLY", "MODE_CREATE", "MODE_EXCL",
+    "MODE_DELETE_ON_CLOSE", "MODE_UNIQUE_OPEN", "MODE_SEQUENTIAL",
+    "MODE_APPEND", "SEEK_SET", "SEEK_CUR", "SEEK_END",
+]

@@ -1,0 +1,228 @@
+"""ADIO — the abstract IO device layer.
+
+Analog of ROMIO's ADIO (reference: src/mpi/romio/adio/ — 18 per-filesystem
+drivers behind one open/read/write/resize contract, e.g. adio/ad_ufs,
+adio/ad_testfs). Here two drivers:
+
+  * ``ufs``   — POSIX files via os.pread/os.pwrite (positional, so
+    concurrent rank processes and IO threads never race a shared seek
+    pointer; the ad_ufs analog).
+  * ``memfs`` — an in-process shared store (the ad_testfs analog and the
+    thread-mode harness backend; also the model for a future HBM-staged
+    checkpoint target).
+
+Driver selection mirrors ROMIO's prefix convention: "ufs:fname",
+"memfs:fname", default ufs.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..core.errors import (MPIException, MPI_ERR_AMODE, MPI_ERR_FILE,
+                           MPI_ERR_IO, MPI_ERR_NO_SUCH_FILE)
+
+# MPI_File amode bits (MPI-3.1 §13.2.1 values as in mpi.h)
+MODE_RDONLY = 2
+MODE_RDWR = 8
+MODE_WRONLY = 4
+MODE_CREATE = 1
+MODE_EXCL = 64
+MODE_DELETE_ON_CLOSE = 16
+MODE_UNIQUE_OPEN = 32
+MODE_SEQUENTIAL = 256
+MODE_APPEND = 128
+
+
+def parse_filename(filename: str) -> Tuple[str, str]:
+    """'driver:path' -> (driver, path); bare paths mean ufs."""
+    if ":" in filename:
+        drv, _, path = filename.partition(":")
+        if drv in _DRIVERS:
+            return drv, path
+    return "ufs", filename
+
+
+class ADIOFile:
+    """One opened file on one rank (the fd-level contract)."""
+
+    def read_at(self, offset: int, nbytes: int) -> bytes:
+        raise NotImplementedError
+
+    def write_at(self, offset: int, data) -> int:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def resize(self, size: int) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+    def lock_all(self) -> None:
+        """Whole-file advisory lock (atomic-mode read-modify-write)."""
+
+    def unlock_all(self) -> None:
+        pass
+
+
+class UfsFile(ADIOFile):
+    def __init__(self, path: str, amode: int):
+        flags = 0
+        if amode & MODE_RDWR:
+            flags |= os.O_RDWR
+        elif amode & MODE_WRONLY:
+            flags |= os.O_WRONLY
+        else:
+            flags |= os.O_RDONLY
+        if amode & MODE_CREATE:
+            flags |= os.O_CREAT
+        if amode & MODE_EXCL:
+            flags |= os.O_EXCL
+        # note: MPI MODE_APPEND only positions file pointers at EOF
+        # (io/file.py); O_APPEND must NOT be set — pwrite on an O_APPEND
+        # fd ignores the offset and lands at EOF on Linux
+        try:
+            self.fd = os.open(path, flags, 0o644)
+        except FileNotFoundError as e:
+            raise MPIException(MPI_ERR_NO_SUCH_FILE, str(e)) from e
+        except OSError as e:
+            raise MPIException(MPI_ERR_IO, f"open {path!r}: {e}") from e
+        self.path = path
+
+    def read_at(self, offset: int, nbytes: int) -> bytes:
+        try:
+            return os.pread(self.fd, nbytes, offset)
+        except OSError as e:
+            raise MPIException(MPI_ERR_IO, f"pread: {e}") from e
+
+    def write_at(self, offset: int, data) -> int:
+        try:
+            return os.pwrite(self.fd, bytes(data), offset)
+        except OSError as e:
+            raise MPIException(MPI_ERR_IO, f"pwrite: {e}") from e
+
+    def size(self) -> int:
+        return os.fstat(self.fd).st_size
+
+    def resize(self, size: int) -> None:
+        os.ftruncate(self.fd, size)
+
+    def sync(self) -> None:
+        os.fsync(self.fd)
+
+    def lock_all(self) -> None:
+        import fcntl
+        fcntl.lockf(self.fd, fcntl.LOCK_EX)
+
+    def unlock_all(self) -> None:
+        import fcntl
+        fcntl.lockf(self.fd, fcntl.LOCK_UN)
+
+    def close(self) -> None:
+        try:
+            os.close(self.fd)
+        except OSError:
+            pass
+
+
+# shared in-process store for memfs (thread-mode ranks see one namespace)
+_MEMFS: Dict[str, bytearray] = {}
+_MEMFS_LOCKS: Dict[str, threading.RLock] = {}
+_MEMFS_GUARD = threading.Lock()
+
+
+class MemFile(ADIOFile):
+    def __init__(self, path: str, amode: int):
+        with _MEMFS_GUARD:
+            exists = path in _MEMFS
+            if not exists:
+                if not (amode & MODE_CREATE):
+                    raise MPIException(MPI_ERR_NO_SUCH_FILE,
+                                       f"memfs:{path} does not exist")
+                _MEMFS[path] = bytearray()
+                _MEMFS_LOCKS[path] = threading.RLock()
+            elif amode & MODE_EXCL:
+                raise MPIException(MPI_ERR_AMODE,
+                                   f"memfs:{path} exists (MODE_EXCL)")
+            self.buf = _MEMFS[path]
+            self.lock = _MEMFS_LOCKS[path]
+        self.path = path
+
+    def read_at(self, offset: int, nbytes: int) -> bytes:
+        with self.lock:
+            return bytes(self.buf[offset:offset + nbytes])
+
+    def write_at(self, offset: int, data) -> int:
+        data = bytes(data)
+        with self.lock:
+            if offset + len(data) > len(self.buf):
+                self.buf.extend(b"\0" * (offset + len(data) - len(self.buf)))
+            self.buf[offset:offset + len(data)] = data
+        return len(data)
+
+    def size(self) -> int:
+        with self.lock:
+            return len(self.buf)
+
+    def resize(self, size: int) -> None:
+        with self.lock:
+            if size < len(self.buf):
+                del self.buf[size:]
+            else:
+                self.buf.extend(b"\0" * (size - len(self.buf)))
+
+    def sync(self) -> None:
+        pass
+
+    def lock_all(self) -> None:
+        self.lock.acquire()
+
+    def unlock_all(self) -> None:
+        self.lock.release()
+
+    def close(self) -> None:
+        pass
+
+    @staticmethod
+    def delete(path: str) -> None:
+        with _MEMFS_GUARD:
+            if path not in _MEMFS:
+                raise MPIException(MPI_ERR_NO_SUCH_FILE, f"memfs:{path}")
+            del _MEMFS[path]
+            _MEMFS_LOCKS.pop(path, None)
+
+
+_DRIVERS = {"ufs": UfsFile, "memfs": MemFile}
+
+
+def open_file(filename: str, amode: int) -> ADIOFile:
+    n_access = sum(1 for bit in (MODE_RDONLY, MODE_WRONLY, MODE_RDWR)
+                   if amode & bit)
+    if n_access != 1:
+        raise MPIException(MPI_ERR_AMODE,
+                           "exactly one of RDONLY, WRONLY, RDWR required")
+    if (amode & MODE_SEQUENTIAL) and (amode & MODE_RDWR):
+        raise MPIException(MPI_ERR_AMODE, "SEQUENTIAL with RDWR")
+    drv, path = parse_filename(filename)
+    return _DRIVERS[drv](path, amode)
+
+
+def delete_file(filename: str) -> None:
+    drv, path = parse_filename(filename)
+    if drv == "memfs":
+        MemFile.delete(path)
+        return
+    try:
+        os.unlink(path)
+    except FileNotFoundError as e:
+        raise MPIException(MPI_ERR_NO_SUCH_FILE, str(e)) from e
+    except OSError as e:
+        raise MPIException(MPI_ERR_IO, str(e)) from e

@@ -1,0 +1,545 @@
+"""MPI_File — MPI-IO semantics over the ADIO layer.
+
+Analog of ROMIO's MPI-IO surface (reference: src/mpi/romio/mpi-io/ +
+adio/common/): file views (set_view), independent IO at explicit offsets
+and individual file pointers (with data sieving for noncontiguous views —
+ad_read_str.c/ad_write_str.c), two-phase collective buffering for
+read_at_all/write_at_all (adio/common/ad_aggregate.c + ad_write_coll.c:
+file-domain partitioning among aggregators and an exchange phase), shared
+file pointers (ROMIO keeps them in a hidden file; here an RMA window
+fetch-add on rank 0 — the TPU-idiomatic shared counter), ordered-mode
+collectives, nonblocking IO, sync/atomicity.
+
+All offsets are internally byte-based; the MPI surface converts from etype
+units at the boundary (§13.3: offsets are in etypes).
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..coll.algorithms import crecv, csend
+from ..core import op as opmod
+from ..core.datatype import BYTE, Datatype, from_numpy_dtype
+from ..core.errors import (MPIException, MPI_ERR_AMODE, MPI_ERR_ARG,
+                           MPI_ERR_FILE, MPI_ERR_IO)
+from ..core.request import Request
+from ..core.status import Status
+from . import adio
+from .adio import (MODE_APPEND, MODE_CREATE, MODE_DELETE_ON_CLOSE,
+                   MODE_EXCL, MODE_RDONLY, MODE_RDWR, MODE_SEQUENTIAL,
+                   MODE_UNIQUE_OPEN, MODE_WRONLY)
+from .view import FileView
+
+SEEK_SET, SEEK_CUR, SEEK_END = 600, 602, 604
+
+
+def _resolve(buf, count: Optional[int], datatype: Optional[Datatype]):
+    if datatype is None:
+        if isinstance(buf, np.ndarray):
+            datatype = from_numpy_dtype(buf.dtype)
+        else:
+            datatype = BYTE
+    if count is None:
+        count = buf.size if isinstance(buf, np.ndarray) \
+            else len(buf) // max(datatype.size, 1)
+    return count, datatype
+
+
+class File:
+    """An open MPI file (collective over the opening comm)."""
+
+    def __init__(self, comm, filename: str, amode: int, info=None):
+        self.comm = comm.dup()            # IO traffic on a private comm
+        self.filename = filename
+        self.amode = amode
+        self.info = dict(info or {})
+        self.atomicity = False
+        self.closed = False
+        self.fh = adio.open_file(filename, amode)
+        self.view = FileView()
+        self._pos = 0                     # individual pointer, bytes
+        self._lock = threading.Lock()     # pointer + view updates
+        # shared file pointer: an int64 on rank 0, fetch-add via RMA
+        self._sp_win = self.comm.win_allocate(8 if self.comm.rank == 0
+                                              else 0)
+        if self.comm.rank == 0:
+            self._sp_win.base[:8] = 0
+        if amode & MODE_APPEND:
+            # MPI §13.2.1: ALL file pointers start at end of file
+            eof = self.view.stream_size_to(self.fh.size())
+            self._pos = eof
+            if self.comm.rank == 0:
+                self._sp_win.base[:8] = np.frombuffer(
+                    int(eof).to_bytes(8, "little", signed=True), np.uint8)
+        self.comm.barrier()               # open is collective
+
+    # ------------------------------------------------------------------
+    def _check(self, writing: bool = False) -> None:
+        if self.closed:
+            raise MPIException(MPI_ERR_FILE, "file is closed")
+        if writing and (self.amode & MODE_RDONLY):
+            raise MPIException(MPI_ERR_AMODE, "write on MODE_RDONLY file")
+        if not writing and (self.amode & MODE_WRONLY):
+            raise MPIException(MPI_ERR_AMODE, "read on MODE_WRONLY file")
+
+    # -- view ----------------------------------------------------------
+    def set_view(self, disp: int = 0, etype: Datatype = BYTE,
+                 filetype: Optional[Datatype] = None, datarep: str =
+                 "native", info=None) -> None:
+        self._check_closed()
+        if datarep != "native":
+            raise MPIException(MPI_ERR_ARG,
+                               f"datarep {datarep!r} unsupported")
+        with self._lock:
+            self.view = FileView(disp, etype, filetype)
+            self._pos = 0
+
+    def get_view(self):
+        return (self.view.disp, self.view.etype, self.view.filetype,
+                "native")
+
+    def _check_closed(self):
+        if self.closed:
+            raise MPIException(MPI_ERR_FILE, "file is closed")
+
+    # -- raw run IO (data sieving for noncontiguous views) -------------
+    _SIEVE_MAX = 4 << 20
+
+    def _read_runs(self, runs: List[Tuple[int, int]], out: bytearray) -> int:
+        """Fill ``out`` from physical runs; data sieving: one big pread
+        spanning the runs when the holes are small (ad_read_str.c)."""
+        if not runs:
+            return 0
+        lo, hi = runs[0][0], runs[-1][0] + runs[-1][1]
+        total = sum(l for _, l in runs)
+        got = 0
+        if len(runs) > 1 and hi - lo <= max(self._SIEVE_MAX, total * 2):
+            blob = self.fh.read_at(lo, hi - lo)
+            pos = 0
+            for off, ln in runs:
+                piece = blob[off - lo:off - lo + ln]
+                out[pos:pos + len(piece)] = piece
+                pos += ln           # short file: later runs read as holes
+                got += len(piece)
+        else:
+            pos = 0
+            for off, ln in runs:
+                piece = self.fh.read_at(off, ln)
+                out[pos:pos + len(piece)] = piece
+                pos += ln
+                got += len(piece)
+        return got
+
+    def _write_runs(self, runs: List[Tuple[int, int]], data) -> int:
+        """Write ``data`` over physical runs; read-modify-write sieving
+        under atomicity, plain per-run writes otherwise."""
+        if not runs:
+            return 0
+        data = memoryview(bytes(data))
+        if self.atomicity:
+            self.fh.lock_all()
+        try:
+            pos = 0
+            for off, ln in runs:
+                self.fh.write_at(off, data[pos:pos + ln])
+                pos += ln
+            return pos
+        finally:
+            if self.atomicity:
+                self.fh.unlock_all()
+
+    # -- independent, explicit offset ----------------------------------
+    def read_at(self, offset: int, buf, count: Optional[int] = None,
+                datatype: Optional[Datatype] = None) -> Status:
+        """``offset`` in etype units (MPI semantics)."""
+        self._check(writing=False)
+        count, datatype = _resolve(buf, count, datatype)
+        nbytes = count * datatype.size
+        runs = self.view.map_range(offset * self.view.etype.size, nbytes)
+        out = bytearray(nbytes)
+        got = self._read_runs(runs, out)
+        datatype.unpack(np.frombuffer(bytes(out[:nbytes]), np.uint8),
+                        buf, count)
+        st = Status(count=min(got, nbytes))
+        return st
+
+    def write_at(self, offset: int, buf, count: Optional[int] = None,
+                 datatype: Optional[Datatype] = None) -> Status:
+        self._check(writing=True)
+        count, datatype = _resolve(buf, count, datatype)
+        packed = np.asarray(datatype.pack(buf, count))
+        runs = self.view.map_range(offset * self.view.etype.size,
+                                   packed.size)
+        n = self._write_runs(runs, packed.tobytes())
+        return Status(count=n)
+
+    # -- individual file pointer ---------------------------------------
+    def _advance(self, nbytes: int) -> int:
+        with self._lock:
+            old = self._pos
+            self._pos += nbytes
+        return old
+
+    def read(self, buf, count: Optional[int] = None,
+             datatype: Optional[Datatype] = None) -> Status:
+        self._check(writing=False)
+        count, datatype = _resolve(buf, count, datatype)
+        old = self._advance(count * datatype.size)
+        return self.read_at(self._etypes(old), buf, count, datatype)
+
+    def write(self, buf, count: Optional[int] = None,
+              datatype: Optional[Datatype] = None) -> Status:
+        self._check(writing=True)
+        count, datatype = _resolve(buf, count, datatype)
+        old = self._advance(count * datatype.size)
+        return self.write_at(self._etypes(old), buf, count, datatype)
+
+    def _etypes(self, nbytes: int) -> int:
+        return nbytes // max(self.view.etype.size, 1)
+
+    def seek(self, offset: int, whence: int = SEEK_SET) -> None:
+        """``offset`` in etype units."""
+        self._check_closed()
+        nb = offset * self.view.etype.size
+        with self._lock:
+            if whence == SEEK_SET:
+                new = nb
+            elif whence == SEEK_CUR:
+                new = self._pos + nb
+            elif whence == SEEK_END:
+                new = self.view.stream_size_to(self.fh.size()) + nb
+            else:
+                raise MPIException(MPI_ERR_ARG, f"bad whence {whence}")
+            if new < 0:
+                raise MPIException(MPI_ERR_ARG, "seek before file start")
+            self._pos = new
+
+    def get_position(self) -> int:
+        return self._etypes(self._pos)
+
+    def get_byte_offset(self, offset: int) -> int:
+        return self.view.physical(offset * self.view.etype.size)
+
+    # -- collective (two-phase) ----------------------------------------
+    def read_at_all(self, offset: int, buf, count: Optional[int] = None,
+                    datatype: Optional[Datatype] = None) -> Status:
+        return self._coll_io(offset, buf, count, datatype, writing=False)
+
+    def write_at_all(self, offset: int, buf, count: Optional[int] = None,
+                     datatype: Optional[Datatype] = None) -> Status:
+        return self._coll_io(offset, buf, count, datatype, writing=True)
+
+    def read_all(self, buf, count: Optional[int] = None,
+                 datatype: Optional[Datatype] = None) -> Status:
+        self._check(writing=False)
+        count, datatype = _resolve(buf, count, datatype)
+        old = self._advance(count * datatype.size)
+        return self.read_at_all(self._etypes(old), buf, count, datatype)
+
+    def write_all(self, buf, count: Optional[int] = None,
+                  datatype: Optional[Datatype] = None) -> Status:
+        self._check(writing=True)
+        count, datatype = _resolve(buf, count, datatype)
+        old = self._advance(count * datatype.size)
+        return self.write_at_all(self._etypes(old), buf, count, datatype)
+
+    def _coll_io(self, offset: int, buf, count, datatype,
+                 writing: bool) -> Status:
+        """Two-phase collective IO (ad_write_coll.c analog): partition the
+        aggregate file range into per-rank file domains; each rank ships
+        the run pieces that fall into domain d to aggregator d; aggregators
+        do one contiguous (sieved) file access per domain."""
+        self._check(writing=writing)
+        comm = self.comm
+        count, datatype = _resolve(buf, count, datatype)
+        nbytes = count * datatype.size
+        runs = self.view.map_range(offset * self.view.etype.size, nbytes)
+        data = memoryview(np.asarray(datatype.pack(buf, count)).tobytes()) \
+            if writing else None
+        # aggregate extent over all ranks (runs are ascending)
+        lo = runs[0][0] if runs else (1 << 62)
+        hi = runs[-1][0] + runs[-1][1] if runs else 0
+        ext = np.zeros(2, np.int64)
+        comm.allreduce(np.array([-lo, hi], np.int64), ext, op=opmod.MAX)
+        glo, ghi = -int(ext[0]), int(ext[1])
+        if ghi <= glo:                       # nobody moves data
+            comm.barrier()
+            return Status(count=0)
+        P = comm.size
+        dsz = -(-(ghi - glo) // P)           # file-domain size (ceil)
+
+        # split my runs into per-domain pieces; record the production
+        # order so a read can be reassembled into logical-stream order
+        per_dest: List[List[Tuple[int, int, bytes]]] = [[] for _ in range(P)]
+        emit: List[int] = []                 # domain of the k-th piece
+        pos = 0
+        for off, ln in runs:
+            while ln > 0:
+                d = min((off - glo) // dsz, P - 1)
+                dom_end = ghi if d == P - 1 else glo + (d + 1) * dsz
+                take = min(ln, dom_end - off)
+                per_dest[d].append(
+                    (off, take, bytes(data[pos:pos + take]) if writing
+                     else b""))
+                emit.append(d)
+                off += take
+                ln -= take
+                pos += take
+        got = self._exchange_and_apply(per_dest, emit, writing, glo, dsz,
+                                       ghi)
+        if not writing:
+            actual = min(len(got), nbytes)
+            if len(got) < nbytes:            # short read (EOF holes)
+                got = got + b"\0" * (nbytes - len(got))
+            datatype.unpack(np.frombuffer(got[:nbytes], np.uint8), buf,
+                            count)
+            return Status(count=actual)
+        return Status(count=nbytes)
+
+    def _exchange_and_apply(self, per_dest, emit, writing: bool, glo: int,
+                            dsz: int, ghi: int) -> bytes:
+        """The exchange phase: pickled piece lists pairwise; aggregators
+        apply writes / serve reads from one sieved access per domain."""
+        comm = self.comm
+        P = comm.size
+        tag = comm.next_coll_tag()
+
+        def a2a_blobs(blobs: List[bytes], t: int) -> List[bytes]:
+            lens = np.array([len(b) for b in blobs], np.int64)
+            all_lens = np.empty(P, np.int64)
+            comm.alltoall(lens, all_lens, count=1)
+            rreqs = [(src, np.empty(int(all_lens[src]), np.uint8))
+                     for src in range(P)]
+            rqs = [crecv(comm, rb, src, t) for src, rb in rreqs]
+            sqs = [csend(comm, np.frombuffer(blobs[d], np.uint8), d, t)
+                   for d in range(P)]
+            for q in rqs + sqs:
+                q.wait()
+            return [rb.tobytes() for _, rb in rreqs]
+
+        incoming = [pickle.loads(b) for b in a2a_blobs(
+            [pickle.dumps(per_dest[d], protocol=4) for d in range(P)], tag)]
+
+        if writing:
+            if self.atomicity:
+                self.fh.lock_all()
+            try:
+                for pieces in incoming:
+                    for off, ln, payload in pieces:
+                        self.fh.write_at(off, payload)
+            finally:
+                if self.atomicity:
+                    self.fh.unlock_all()
+            comm.barrier()        # all domains durable before return
+            return b""
+
+        # read: one sieved access over my file domain, serve pieces back
+        d_lo = glo + comm.rank * dsz
+        d_hi = ghi if comm.rank == P - 1 else min(glo + (comm.rank + 1)
+                                                  * dsz, ghi)
+        dom = self.fh.read_at(d_lo, d_hi - d_lo) if d_hi > d_lo else b""
+        replies = []
+        for pieces in incoming:
+            parts = [bytes(dom[off - d_lo:off - d_lo + ln])
+                     for off, ln, _ in pieces]
+            replies.append(pickle.dumps(parts, protocol=4))
+        by_src = [pickle.loads(b) for b in a2a_blobs(replies, tag + 1)]
+        # reassemble in production order: piece k came from domain emit[k]
+        out = bytearray()
+        next_idx = [0] * P
+        for d in emit:
+            out.extend(by_src[d][next_idx[d]])
+            next_idx[d] += 1
+        return bytes(out)
+
+    # -- shared file pointer -------------------------------------------
+    def _shared_fetch_add(self, nbytes: int) -> int:
+        from ..rma.win import LOCK_EXCLUSIVE
+        old = np.zeros(1, np.int64)
+        add = np.array([nbytes], np.int64)
+        self._sp_win.lock(0, LOCK_EXCLUSIVE)
+        self._sp_win.fetch_and_op(add, old, 0, 0, op=opmod.SUM)
+        self._sp_win.unlock(0)
+        return int(old[0])
+
+    def read_shared(self, buf, count: Optional[int] = None,
+                    datatype: Optional[Datatype] = None) -> Status:
+        self._check(writing=False)
+        count, datatype = _resolve(buf, count, datatype)
+        old = self._shared_fetch_add(count * datatype.size)
+        return self.read_at(self._etypes(old), buf, count, datatype)
+
+    def write_shared(self, buf, count: Optional[int] = None,
+                     datatype: Optional[Datatype] = None) -> Status:
+        self._check(writing=True)
+        count, datatype = _resolve(buf, count, datatype)
+        old = self._shared_fetch_add(count * datatype.size)
+        return self.write_at(self._etypes(old), buf, count, datatype)
+
+    def seek_shared(self, offset: int, whence: int = SEEK_SET) -> None:
+        """Collective; all ranks must give the same offset."""
+        nb = offset * self.view.etype.size
+        if whence == SEEK_CUR or whence == SEEK_END:
+            base = self.view.stream_size_to(self.fh.size()) \
+                if whence == SEEK_END else self._shared_fetch_add(0)
+            nb += base
+        if self.comm.rank == 0:
+            from ..rma.win import LOCK_EXCLUSIVE
+            self._sp_win.lock(0, LOCK_EXCLUSIVE)
+            self._sp_win.base[:8] = np.frombuffer(
+                int(nb).to_bytes(8, "little", signed=True), np.uint8)
+            self._sp_win.unlock(0)
+        self.comm.barrier()
+
+    def get_position_shared(self) -> int:
+        return self._etypes(self._shared_fetch_add(0))
+
+    # -- ordered mode --------------------------------------------------
+    def _ordered_base(self, nbytes: int) -> int:
+        sizes = self.comm.allgather(np.array([nbytes], np.int64), count=1)
+        total = int(sizes.sum())
+        if self.comm.rank == 0:
+            base = self._shared_fetch_add(total)
+        else:
+            base = 0
+        b = np.array([base], np.int64)
+        self.comm.bcast(b, root=0)
+        return int(b[0]) + int(sizes[:self.comm.rank].sum())
+
+    def read_ordered(self, buf, count: Optional[int] = None,
+                     datatype: Optional[Datatype] = None) -> Status:
+        self._check(writing=False)
+        count, datatype = _resolve(buf, count, datatype)
+        my = self._ordered_base(count * datatype.size)
+        return self.read_at(self._etypes(my), buf, count, datatype)
+
+    def write_ordered(self, buf, count: Optional[int] = None,
+                      datatype: Optional[Datatype] = None) -> Status:
+        self._check(writing=True)
+        count, datatype = _resolve(buf, count, datatype)
+        my = self._ordered_base(count * datatype.size)
+        return self.write_at(self._etypes(my), buf, count, datatype)
+
+    # -- nonblocking ---------------------------------------------------
+    def _async(self, fn, *a) -> Request:
+        req = Request(self.comm.u.engine, "io")
+
+        def run():
+            try:
+                st = fn(*a)
+                req.status = st
+                req.complete()
+            except MPIException as e:
+                req.complete(e)
+
+        threading.Thread(target=run, daemon=True, name="mpiio").start()
+        return req
+
+    def iread_at(self, offset, buf, count=None, datatype=None) -> Request:
+        return self._async(self.read_at, offset, buf, count, datatype)
+
+    def iwrite_at(self, offset, buf, count=None, datatype=None) -> Request:
+        return self._async(self.write_at, offset, buf, count, datatype)
+
+    def iread(self, buf, count=None, datatype=None) -> Request:
+        self._check(writing=False)
+        count, datatype = _resolve(buf, count, datatype)
+        old = self._advance(count * datatype.size)
+        return self._async(self.read_at, self._etypes(old), buf, count,
+                           datatype)
+
+    def iwrite(self, buf, count=None, datatype=None) -> Request:
+        self._check(writing=True)
+        count, datatype = _resolve(buf, count, datatype)
+        old = self._advance(count * datatype.size)
+        return self._async(self.write_at, self._etypes(old), buf, count,
+                           datatype)
+
+    def iread_shared(self, buf, count=None, datatype=None) -> Request:
+        self._check(writing=False)
+        count, datatype = _resolve(buf, count, datatype)
+        old = self._shared_fetch_add(count * datatype.size)
+        return self._async(self.read_at, self._etypes(old), buf, count,
+                           datatype)
+
+    def iwrite_shared(self, buf, count=None, datatype=None) -> Request:
+        self._check(writing=True)
+        count, datatype = _resolve(buf, count, datatype)
+        old = self._shared_fetch_add(count * datatype.size)
+        return self._async(self.write_at, self._etypes(old), buf, count,
+                           datatype)
+
+    # -- management ----------------------------------------------------
+    def get_size(self) -> int:
+        self._check_closed()
+        return self.fh.size()
+
+    def set_size(self, size: int) -> None:
+        """Collective."""
+        self._check(writing=True)
+        if self.comm.rank == 0:
+            self.fh.resize(size)
+        self.comm.barrier()
+
+    def preallocate(self, size: int) -> None:
+        self._check(writing=True)
+        if self.comm.rank == 0 and self.fh.size() < size:
+            self.fh.resize(size)
+        self.comm.barrier()
+
+    def get_amode(self) -> int:
+        return self.amode
+
+    def get_group(self):
+        return self.comm.group
+
+    def get_info(self):
+        return dict(self.info)
+
+    def set_info(self, info) -> None:
+        self.info.update(info or {})
+
+    def set_atomicity(self, flag: bool) -> None:
+        self.atomicity = bool(flag)
+
+    def get_atomicity(self) -> bool:
+        return self.atomicity
+
+    def sync(self) -> None:
+        """Collective flush."""
+        self._check_closed()
+        self.fh.sync()
+        self.comm.barrier()
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.comm.barrier()
+        self.fh.sync()
+        self.fh.close()
+        if (self.amode & MODE_DELETE_ON_CLOSE) and self.comm.rank == 0:
+            try:
+                adio.delete_file(self.filename)
+            except MPIException:
+                pass
+        self.comm.barrier()
+        self._sp_win.free()
+        self.comm.free()
+        self.closed = True
+
+    def __repr__(self):
+        return f"File({self.filename!r}, amode={self.amode})"
+
+
+def file_open(comm, filename: str, amode: int = MODE_RDONLY,
+              info=None) -> File:
+    return File(comm, filename, amode, info)
+
+
+def file_delete(filename: str, info=None) -> None:
+    adio.delete_file(filename)

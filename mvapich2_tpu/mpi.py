@@ -196,6 +196,22 @@ def Intercomm_merge(intercomm, high: bool = False):
     return intercomm.merge(high)
 
 
+# ---------------------------------------------------------------------------
+# MPI-IO (ROMIO analog; mvapich2_tpu.io)
+# ---------------------------------------------------------------------------
+
+def File_open(comm, filename: str, amode: int = None, info=None):
+    from . import io as _io
+    if amode is None:
+        amode = _io.MODE_RDONLY
+    return _io.file_open(comm, filename, amode, info)
+
+
+def File_delete(filename: str, info=None) -> None:
+    from . import io as _io
+    _io.file_delete(filename, info)
+
+
 def Publish_name(service_name: str, port_name: str, info=None) -> None:
     from .runtime import nameserv as _ns
     _ns.publish_name(_u(), service_name, port_name, info)

@@ -1,0 +1,297 @@
+"""MPI-IO tests (MPICH test/mpi/io analogs: simple IO, views, collective
+two-phase, shared/ordered pointers, nonblocking, consistency)."""
+
+import os
+import tempfile
+import uuid
+
+import numpy as np
+import pytest
+
+from mvapich2_tpu import io as mio
+from mvapich2_tpu.core import datatype as dt
+from mvapich2_tpu.core.errors import MPIException
+from mvapich2_tpu.runtime.universe import run_ranks
+
+
+def _memname():
+    return f"memfs:iotest-{uuid.uuid4().hex[:8]}"
+
+
+def _tmpname():
+    return os.path.join(tempfile.gettempdir(),
+                        f"mv2t-iotest-{uuid.uuid4().hex[:8]}")
+
+
+RW_CREATE = mio.MODE_RDWR | mio.MODE_CREATE
+
+
+def test_write_read_at_memfs():
+    name = _memname()
+
+    def body(comm):
+        f = mio.file_open(comm, name, RW_CREATE)
+        mine = np.full(16, comm.rank, np.int32)
+        f.write_at(comm.rank * 64, mine)          # offsets in etypes=bytes
+        f.sync()
+        comm.barrier()
+        other = np.zeros(16, np.int32)
+        peer = (comm.rank + 1) % comm.size
+        st = f.read_at(peer * 64, other)
+        assert st.count == 64
+        assert (other == peer).all()
+        assert f.get_size() == comm.size * 64
+        f.close()
+        return True
+
+    assert all(run_ranks(4, body))
+    mio.file_delete("memfs:" + name.split(":", 1)[1])
+
+
+def test_ufs_backend_process_independent():
+    name = _tmpname()
+
+    def body(comm):
+        f = mio.file_open(comm, name, RW_CREATE)
+        data = np.arange(8, dtype=np.float64) + comm.rank * 100
+        f.write_at(comm.rank * 64, data)
+        f.sync()
+        comm.barrier()
+        back = np.zeros(8, np.float64)
+        f.read_at(((comm.rank + 1) % comm.size) * 64, back)
+        assert back[3] == ((comm.rank + 1) % comm.size) * 100 + 3
+        f.close()
+        return True
+
+    try:
+        assert all(run_ranks(2, body))
+    finally:
+        os.unlink(name)
+
+
+def test_file_pointer_and_seek():
+    name = _memname()
+
+    def body(comm):
+        f = mio.file_open(comm, name, RW_CREATE)
+        if comm.rank == 0:
+            f.write(np.arange(10, dtype=np.int64))
+            assert f.get_position() == 80
+            f.seek(16, mio.SEEK_SET)
+            buf = np.zeros(2, np.int64)
+            f.read(buf)
+            assert list(buf) == [2, 3]
+            f.seek(-8, mio.SEEK_END)
+            f.read(buf, count=1)
+            assert buf[0] == 9
+        f.close()
+        return True
+
+    assert all(run_ranks(2, body))
+
+
+def test_vector_view_partitioning():
+    """Classic striped view: rank r sees every P-th block of 4 ints."""
+    name = _memname()
+
+    def body(comm):
+        P = comm.size
+        f = mio.file_open(comm, name, RW_CREATE)
+        etype = dt.INT
+        # filetype: 4 ints of data at offset r*4, extent P*4 ints
+        ft = dt.create_resized(
+            dt.create_vector(1, 4, 4 * P, etype), 0, 4 * P * etype.size)
+        f.set_view(disp=comm.rank * 4 * etype.size, etype=etype,
+                   filetype=ft)
+        mine = np.full(8, comm.rank, np.int32)   # 2 tiles worth
+        f.write_at(0, mine)
+        f.sync()
+        comm.barrier()
+        # raw check: the file interleaves rank blocks
+        f.set_view()  # back to bytes
+        raw = np.zeros(8 * P, np.int32)
+        f.read_at(0, raw)
+        expect = []
+        for tile in range(2):
+            for r in range(P):
+                expect.extend([r] * 4)
+        assert list(raw) == expect
+        f.close()
+        return True
+
+    assert all(run_ranks(4, body))
+
+
+def test_write_at_all_two_phase():
+    name = _memname()
+
+    def body(comm):
+        P = comm.size
+        f = mio.file_open(comm, name, RW_CREATE)
+        etype = dt.INT
+        ft = dt.create_resized(
+            dt.create_vector(1, 2, 2 * P, etype), 0, 2 * P * etype.size)
+        f.set_view(disp=comm.rank * 2 * etype.size, etype=etype,
+                   filetype=ft)
+        mine = (np.arange(6, dtype=np.int32) + 10 * comm.rank)
+        f.write_at_all(0, mine)      # 3 tiles, two-phase exchange
+        f.sync()
+        comm.barrier()
+        # every rank collectively reads it back through the same view
+        back = np.zeros(6, np.int32)
+        f.read_at_all(0, back)
+        assert (back == mine).all()
+        # and the raw interleave is right
+        f.set_view()
+        raw = np.zeros(6 * P, np.int32)
+        f.read_at(0, raw)
+        for tile in range(3):
+            for r in range(P):
+                seg = raw[(tile * P + r) * 2:(tile * P + r) * 2 + 2]
+                assert list(seg) == [10 * r + 2 * tile,
+                                     10 * r + 2 * tile + 1]
+        f.close()
+        return True
+
+    assert all(run_ranks(4, body))
+
+
+def test_shared_pointer():
+    name = _memname()
+
+    def body(comm):
+        f = mio.file_open(comm, name, RW_CREATE)
+        mine = np.full(4, comm.rank, np.int32)
+        f.write_shared(mine)
+        f.sync()
+        comm.barrier()
+        assert f.get_position_shared() == comm.size * 16
+        # every 16-byte chunk is one rank's data
+        f.seek_shared(0)
+        raw = np.zeros(4 * comm.size, np.int32)
+        if comm.rank == 0:
+            f.read_at(0, raw)
+            chunks = sorted(raw.reshape(comm.size, 4)[:, 0].tolist())
+            assert chunks == list(range(comm.size))
+        f.close()
+        return True
+
+    assert all(run_ranks(4, body))
+
+
+def test_ordered_write():
+    name = _memname()
+
+    def body(comm):
+        f = mio.file_open(comm, name, RW_CREATE)
+        mine = np.full(3, comm.rank, np.int32)
+        f.write_ordered(mine)
+        f.sync()
+        comm.barrier()
+        if comm.rank == 0:
+            raw = np.zeros(3 * comm.size, np.int32)
+            f.read_at(0, raw)
+            assert list(raw) == sum([[r] * 3 for r in range(comm.size)], [])
+        f.close()
+        return True
+
+    assert all(run_ranks(4, body))
+
+
+def test_nonblocking_io():
+    name = _memname()
+
+    def body(comm):
+        f = mio.file_open(comm, name, RW_CREATE)
+        mine = np.arange(1000, dtype=np.float32) * (comm.rank + 1)
+        req = f.iwrite_at(comm.rank * 4000, mine)
+        req.wait()
+        f.sync()
+        comm.barrier()
+        back = np.zeros(1000, np.float32)
+        rq = f.iread_at(comm.rank * 4000, back)
+        st = rq.wait()
+        assert st.count == 4000
+        assert (back == mine).all()
+        f.close()
+        return True
+
+    assert all(run_ranks(2, body))
+
+
+def test_set_size_preallocate_append():
+    name = _memname()
+
+    def body(comm):
+        f = mio.file_open(comm, name, RW_CREATE)
+        f.set_size(256)
+        assert f.get_size() == 256
+        comm.barrier()               # don't let rank 0 mutate size while
+        f.preallocate(128)           # peers still check the old one
+        assert f.get_size() == 256
+        comm.barrier()
+        f.set_size(16)
+        assert f.get_size() == 16
+        f.close()
+        return True
+
+    assert all(run_ranks(2, body))
+
+
+def test_amode_errors():
+    name = _memname()
+
+    def body(comm):
+        f = mio.file_open(comm, name, mio.MODE_WRONLY | mio.MODE_CREATE)
+        with pytest.raises(MPIException):
+            f.read_at(0, np.zeros(4, np.uint8))
+        f.close()
+        g = mio.file_open(comm, name, mio.MODE_RDONLY)
+        with pytest.raises(MPIException):
+            g.write_at(0, np.zeros(4, np.uint8))
+        g.close()
+        with pytest.raises(MPIException):
+            mio.file_open(comm, _memname(), mio.MODE_RDONLY)  # no CREATE
+        return True
+
+    assert all(run_ranks(1, body))
+
+
+def test_delete_on_close():
+    name = _memname()
+
+    def body(comm):
+        f = mio.file_open(comm, name,
+                          RW_CREATE | mio.MODE_DELETE_ON_CLOSE)
+        f.write_at(0, np.ones(4, np.uint8))
+        f.close()
+        comm.barrier()
+        with pytest.raises(MPIException):
+            mio.file_open(comm, name, mio.MODE_RDONLY)
+        return True
+
+    assert all(run_ranks(2, body))
+
+
+def test_view_read_back_through_view():
+    """Write through a strided view, read back through the same view."""
+    name = _memname()
+
+    def body(comm):
+        f = mio.file_open(comm, name, RW_CREATE)
+        etype = dt.DOUBLE
+        ft = dt.create_resized(dt.create_vector(1, 1, 2, etype), 0,
+                               2 * etype.size)
+        f.set_view(disp=(comm.rank % 2) * etype.size, etype=etype,
+                   filetype=ft)
+        mine = np.arange(5, dtype=np.float64) + comm.rank * 1000
+        f.write_at(0, mine)
+        f.sync()
+        comm.barrier()
+        back = np.zeros(5, np.float64)
+        f.read_at(0, back)
+        assert (back == mine).all()
+        f.close()
+        return True
+
+    assert all(run_ranks(2, body))
