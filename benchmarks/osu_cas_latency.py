@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""osu_cas_latency — MPI_Compare_and_swap latency (port of
+osu_benchmarks/mpi/one-sided/osu_cas_latency.c; 8-byte operand)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mvapich2_tpu import mpi
+from mvapich2_tpu.bench import osu_util as u
+from mvapich2_tpu.rma.win import LOCK_SHARED
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+assert comm.size == 2, "osu_cas_latency requires exactly 2 ranks"
+opts = u.options("compare-and-swap latency", default_max=8)
+u.header(comm, "One Sided Compare_and_swap latency Test")
+
+win = comm.win_allocate(8)
+origin = np.zeros(1, np.int64)
+compare = np.zeros(1, np.int64)
+result = np.zeros(1, np.int64)
+comm.barrier()
+if comm.rank == 0:
+    iters = opts.iterations
+    win.lock(1, LOCK_SHARED)
+    for i in range(iters + opts.skip):
+        if i == opts.skip:
+            t0 = mpi.Wtime()
+        win.compare_and_swap(origin, compare, result, 1)
+    total = mpi.Wtime() - t0
+    win.unlock(1)
+    print(f"{8:<12} {total / iters * 1e6:>12.2f}")
+    sys.stdout.flush()
+comm.barrier()
+win.free()
+
+u.finalize_ok(comm)

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""osu_fop_latency — MPI_Fetch_and_op latency (port of
+osu_benchmarks/mpi/one-sided/osu_fop_latency.c; 8-byte operand)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mvapich2_tpu import mpi
+from mvapich2_tpu.bench import osu_util as u
+from mvapich2_tpu.rma.win import LOCK_SHARED
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+assert comm.size == 2, "osu_fop_latency requires exactly 2 ranks"
+opts = u.options("fetch-and-op latency", default_max=8)
+u.header(comm, "One Sided Fetch_and_op latency Test")
+
+win = comm.win_allocate(8)
+origin = np.ones(1, np.int64)
+result = np.zeros(1, np.int64)
+comm.barrier()
+if comm.rank == 0:
+    iters = opts.iterations
+    win.lock(1, LOCK_SHARED)
+    for i in range(iters + opts.skip):
+        if i == opts.skip:
+            t0 = mpi.Wtime()
+        win.fetch_and_op(origin, result, 1, op=mpi.SUM)
+    total = mpi.Wtime() - t0
+    win.unlock(1)
+    print(f"{8:<12} {total / iters * 1e6:>12.2f}")
+    sys.stdout.flush()
+comm.barrier()
+win.free()
+
+u.finalize_ok(comm)

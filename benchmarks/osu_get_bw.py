@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""osu_get_bw — MPI_Get bandwidth (port of
+osu_benchmarks/mpi/one-sided/osu_get_bw.c)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mvapich2_tpu import mpi
+from mvapich2_tpu.bench import osu_util as u
+from mvapich2_tpu.rma.win import LOCK_SHARED
+
+WINDOW = 32
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+assert comm.size == 2, "osu_get_bw requires exactly 2 ranks"
+opts = u.options("get bandwidth", default_max=1 << 22)
+u.header(comm, "One Sided Get Bandwidth Test", cols="Bandwidth (MB/s)")
+
+for size in u.sizes(opts):
+    iters = max(10, u.scale_iters(opts, size) // WINDOW)
+    win = comm.win_allocate(size)
+    obuf = np.zeros(size, np.uint8)
+    comm.barrier()
+    if comm.rank == 0:
+        win.lock(1, LOCK_SHARED)
+        for i in range(iters + opts.skip):
+            if i == opts.skip:
+                t0 = mpi.Wtime()
+            for _ in range(WINDOW):
+                win.get(obuf, 1)
+            win.flush(1)
+        total = mpi.Wtime() - t0
+        win.unlock(1)
+        mbps = size * WINDOW * iters / total / 1e6
+        print(f"{size:<12} {mbps:>12.2f}")
+        sys.stdout.flush()
+    comm.barrier()
+    win.free()
+
+u.finalize_ok(comm)

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""osu_get_latency — MPI_Get latency with lock/unlock sync (port of
+osu_benchmarks/mpi/one-sided/osu_get_latency.c)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mvapich2_tpu import mpi
+from mvapich2_tpu.bench import osu_util as u
+from mvapich2_tpu.rma.win import LOCK_SHARED
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+assert comm.size == 2, "osu_get_latency requires exactly 2 ranks"
+opts = u.options("get latency", default_max=1 << 22)
+u.header(comm, "One Sided Get Latency Test")
+
+for size in u.sizes(opts):
+    iters = u.scale_iters(opts, size)
+    win = comm.win_allocate(size)
+    obuf = np.zeros(size, np.uint8)
+    comm.barrier()
+    if comm.rank == 0:
+        for i in range(iters + opts.skip):
+            if i == opts.skip:
+                t0 = mpi.Wtime()
+            win.lock(1, LOCK_SHARED)
+            win.get(obuf, 1)
+            win.unlock(1)
+        total = mpi.Wtime() - t0
+        print(f"{size:<12} {total / iters * 1e6:>12.2f}")
+        sys.stdout.flush()
+    comm.barrier()
+    win.free()
+
+u.finalize_ok(comm)

@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""osu_hello — startup smoke: init + hello + finalize (port of
+osu_benchmarks/mpi/startup/osu_hello.c)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mvapich2_tpu import mpi
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+if comm.rank == 0:
+    print(f"# OSU MPI Hello World Test")
+    print(f"This is a test with {comm.size} processes")
+mpi.Finalize()
