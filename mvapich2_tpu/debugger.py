@@ -1,0 +1,75 @@
+"""Debugger interface: message-queue dumping.
+
+Analog of the reference's TotalView/MPIR debugger DLL
+(src/mpi/debugger/dll_mpich.c + dbginit.c): a debugger attaches and walks
+the posted-receive, unexpected-message, and pending-send queues of each
+rank. Here the same three queues are snapshotted from the live matcher /
+engine state — usable from a REPL, a failure handler, or test assertions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class QueueEntry:
+    kind: str                 # "posted-recv" | "unexpected" | "send"
+    ctx: int = -1
+    source: int = -1          # rank-in-comm (or sender for unexpected)
+    tag: int = -1
+    nbytes: int = -1
+    comm_name: str = ""
+
+
+@dataclass
+class MessageQueues:
+    rank: int
+    posted: List[QueueEntry] = field(default_factory=list)
+    unexpected: List[QueueEntry] = field(default_factory=list)
+    sends: List[QueueEntry] = field(default_factory=list)
+
+    def format(self) -> str:
+        lines = [f"# message queues, world rank {self.rank}"]
+        for title, q in (("posted receives", self.posted),
+                         ("unexpected messages", self.unexpected),
+                         ("pending sends", self.sends)):
+            lines.append(f"## {title} ({len(q)})")
+            for e in q:
+                lines.append(
+                    f"  ctx={e.ctx} {'comm=' + e.comm_name + ' ' if e.comm_name else ''}"
+                    f"src={e.source} tag={e.tag} bytes={e.nbytes}")
+        return "\n".join(lines)
+
+
+def dump_message_queues(u=None) -> MessageQueues:
+    """Snapshot this rank's matching/engine state (dll_mpich.c's
+    mqs_setup_operation_iterator analog)."""
+    from .runtime.universe import current_universe
+    u = u or current_universe()
+    if u is None or u.protocol is None:
+        raise RuntimeError("MPI not initialized on this rank")
+    m = u.protocol.matcher
+    out = MessageQueues(rank=u.world_rank)
+
+    def comm_of(ctx: int) -> str:
+        c = u.comms_by_ctx.get(ctx & ~1)
+        return getattr(c, "name", "") if c is not None else ""
+
+    with u.engine.mutex:
+        for req in m.posted:
+            ctx, src, tag = req.match
+            out.posted.append(QueueEntry("posted-recv", ctx, src, tag,
+                                         req.capacity, comm_of(ctx)))
+        for pkt in m.unexpected:
+            out.unexpected.append(QueueEntry("unexpected", pkt.ctx,
+                                             pkt.comm_src, pkt.tag,
+                                             pkt.nbytes, comm_of(pkt.ctx)))
+        for req in u.engine.outstanding.values():
+            if getattr(req, "kind", "") == "send":
+                out.sends.append(QueueEntry(
+                    "send", -1, getattr(req, "dest_world", -1), -1,
+                    len(req.packed) if getattr(req, "packed", None)
+                    is not None else -1))
+    return out
