@@ -564,7 +564,9 @@ class RmaManager:
                        (PktType.RMA_FLUSH_ACK, self._on_flush_ack),
                        (PktType.RMA_PSCW_POST, self._on_post),
                        (PktType.RMA_PSCW_COMPLETE, self._on_complete)]:
-            eng.register_handler(pt, fn)
+            # asynchronous: passive-target ops must progress while the
+            # target rank is idle (progress.py ProgressEngine.async_types)
+            eng.register_handler(pt, fn, asynchronous=True)
 
     def add_window(self, win: Win) -> None:
         self.u.windows[win.win_id] = win

@@ -41,6 +41,13 @@ class ProgressEngine:
         self.channels: List[Channel] = []
         # pkt type -> handler(pkt); populated by protocol/rma layers
         self.pkt_handlers: Dict[int, Callable[[Packet], None]] = {}
+        # packet types that must make progress even while the owning rank
+        # is idle (passive-target RMA: the target of a lock/accumulate may
+        # be busy computing or already past its last MPI call). Delivery
+        # of such a packet triggers an inline drain from the delivering
+        # thread — the software analog of the NIC servicing RDMA ops
+        # without target CPU involvement (SURVEY §2.2 one-sided over RDMA).
+        self.async_types: set = set()
         # req_id -> Request, for CTS/FIN/RESP lookup
         self.outstanding: Dict[int, Request] = {}
         # registered progress hooks (nonblocking-coll scheduler, RMA flush)
@@ -58,8 +65,11 @@ class ProgressEngine:
         ch.attach(self)
         self.channels.append(ch)
 
-    def register_handler(self, ptype: PktType, fn: Callable) -> None:
+    def register_handler(self, ptype: PktType, fn: Callable,
+                         asynchronous: bool = False) -> None:
         self.pkt_handlers[int(ptype)] = fn
+        if asynchronous:
+            self.async_types.add(int(ptype))
 
     def register_hook(self, fn: Callable[[], bool]) -> None:
         self.hooks.append(fn)
@@ -69,6 +79,31 @@ class ProgressEngine:
         with self._inbox_cond:
             self._inbox.append(pkt)
             self._inbox_cond.notify_all()
+        if int(pkt.type) in self.async_types:
+            self._async_drain()
+
+    def _async_drain(self) -> None:
+        """Inline inbox drain from the delivering thread. FIFO is
+        preserved because the full inbox is drained in order. Safe from
+        any thread — all rank-local protocol state is engine-mutex-guarded
+        and reply sends that loop back to the deliverer's own engine
+        re-enter through its RLock. Loops until the inbox is observed
+        empty: a bare try-lock would strand a packet when the current
+        mutex holder has already passed its own drain check."""
+        while not self.shutdown:
+            with self._inbox_lock:
+                if not self._inbox:
+                    return
+            if self.mutex.acquire(blocking=False):
+                try:
+                    self._drain_inbox(swallow_errors=True)
+                finally:
+                    self.mutex.release()
+                continue    # re-check: an append may have raced the drain
+            # mutex holder is mid-progress and will (re)drain — wake it in
+            # case it is parked in the idle wait, then yield and re-check
+            self.wakeup()
+            time.sleep(0.0001)
 
     def wakeup(self) -> None:
         with self._inbox_cond:
@@ -93,14 +128,24 @@ class ProgressEngine:
                                f"no handler for packet {pkt.type.name}")
         fn(pkt)
 
-    def _drain_inbox(self) -> int:
+    def _drain_inbox(self, swallow_errors: bool = False) -> int:
+        """``swallow_errors`` is set on the async-delivery path: a handler
+        exception there would otherwise unwind into the *sender's* call
+        stack (or a channel thread) and abandon the rest of the inbox —
+        log it and keep draining instead."""
         n = 0
         while True:
             with self._inbox_lock:
                 if not self._inbox:
                     break
                 pkt = self._inbox.popleft()
-            self._dispatch(pkt)
+            try:
+                self._dispatch(pkt)
+            except Exception:
+                if not swallow_errors:
+                    raise
+                log.error("async handler for %s failed", pkt.type,
+                          exc_info=True)
             n += 1
         return n
 

@@ -166,6 +166,8 @@ def test_shared_pointer():
         f.sync()
         comm.barrier()
         assert f.get_position_shared() == comm.size * 16
+        comm.barrier()   # seek_shared resets the pointer rank-0-side; all
+        # position reads must complete first (MPI shared-fp sync rules)
         # every 16-byte chunk is one rank's data
         f.seek_shared(0)
         raw = np.zeros(4 * comm.size, np.int32)
