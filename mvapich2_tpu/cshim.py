@@ -1,0 +1,469 @@
+"""C-ABI shim: the Python side of native/mpi/libmpi.c.
+
+The reference's hard boundary is the MPI C ABI (SURVEY §7 hard part (a):
+"the OSU benchmarks are C programs"). libmpi.so embeds CPython and calls
+the functions here; handles cross the boundary as small integers, buffers
+as writable memoryviews over the caller's memory (zero-copy in/out via
+numpy frombuffer).
+
+Handle tables: comm 0 = MPI_COMM_WORLD, 1 = MPI_COMM_SELF, dynamic ids
+from 2. Datatype/op codes are fixed enums mirrored in native/mpi/mpi.h.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+import numpy as np
+
+from . import mpi
+from .core import datatype as dt
+from .core import op as opmod
+from .core.errors import MPIException
+from .core.status import ANY_SOURCE, ANY_TAG, PROC_NULL
+from .runtime import universe as uni
+
+# ---------------------------------------------------------------------------
+# handle tables (mirror the enum values in native/mpi/mpi.h)
+# ---------------------------------------------------------------------------
+
+_DTYPES = {
+    0: np.dtype(np.uint8),     # MPI_BYTE
+    1: np.dtype(np.int8),      # MPI_CHAR
+    2: np.dtype(np.int32),     # MPI_INT
+    3: np.dtype(np.float32),   # MPI_FLOAT
+    4: np.dtype(np.float64),   # MPI_DOUBLE
+    5: np.dtype(np.int64),     # MPI_LONG / MPI_LONG_LONG
+    6: np.dtype(np.uint64),    # MPI_UNSIGNED_LONG
+    7: np.dtype(np.int16),     # MPI_SHORT
+    8: np.dtype(np.uint8),     # MPI_UNSIGNED_CHAR
+    9: np.dtype(np.int64),     # MPI_AINT
+}
+
+_OPS = {
+    0: opmod.SUM, 1: opmod.PROD, 2: opmod.MAX, 3: opmod.MIN,
+    4: opmod.LAND, 5: opmod.LOR, 6: opmod.BAND, 7: opmod.BOR,
+}
+
+_lock = threading.Lock()
+_comms: Dict[int, object] = {}
+_reqs: Dict[int, object] = {}
+_wins: Dict[int, object] = {}
+_next_comm = 2
+_next_req = 1
+_next_win = 1
+
+
+def _comm(h: int):
+    if h == 0:
+        return uni.current_universe().comm_world
+    if h == 1:
+        return uni.current_universe().comm_self
+    return _comms[h]
+
+
+def _arr(view, count: int, dtcode: int) -> np.ndarray:
+    """Zero-copy numpy array over the C caller's buffer."""
+    d = _DTYPES[dtcode]
+    return np.frombuffer(view, dtype=d, count=count)
+
+
+# ---------------------------------------------------------------------------
+# init / world
+# ---------------------------------------------------------------------------
+
+def init() -> int:
+    mpi.Init()
+    return 0
+
+
+def finalize() -> int:
+    mpi.Finalize()
+    return 0
+
+
+def initialized() -> int:
+    return 1 if mpi.Initialized() else 0
+
+
+def comm_rank(ch: int) -> int:
+    return _comm(ch).rank
+
+
+def comm_size(ch: int) -> int:
+    return _comm(ch).size
+
+
+def abort(ch: int, code: int) -> int:
+    mpi.Abort(None, code)
+    return 0
+
+
+def comm_split(ch: int, color: int, key: int) -> int:
+    global _next_comm
+    c = _comm(ch).split(color if color >= 0 else None, key)
+    if c is None:          # MPI_UNDEFINED color: no handle slot burned
+        return -1
+    with _lock:
+        h = _next_comm
+        _next_comm += 1
+        _comms[h] = c
+    return h
+
+
+def comm_dup(ch: int) -> int:
+    global _next_comm
+    c = _comm(ch).dup()
+    with _lock:
+        h = _next_comm
+        _next_comm += 1
+        _comms[h] = c
+    return h
+
+
+def comm_free(ch: int) -> int:
+    with _lock:
+        c = _comms.pop(ch, None)
+    if c is not None:
+        c.free()
+    return 0
+
+
+def get_processor_name() -> str:
+    return mpi.Get_processor_name()
+
+
+# ---------------------------------------------------------------------------
+# pt2pt
+# ---------------------------------------------------------------------------
+
+def send(view, count: int, dtcode: int, dest: int, tag: int,
+         ch: int) -> int:
+    buf = _arr(view, count, dtcode)
+    _comm(ch).send(buf, dest, tag)
+    return 0
+
+
+def recv(view, count: int, dtcode: int, source: int, tag: int,
+         ch: int):
+    """Returns (source, tag, count_bytes)."""
+    buf = _arr(view, count, dtcode)
+    st = _comm(ch).recv(buf, source, tag)
+    return (st.source, st.tag, st.count)
+
+
+def isend(view, count: int, dtcode: int, dest: int, tag: int,
+          ch: int) -> int:
+    global _next_req
+    buf = _arr(view, count, dtcode)
+    r = _comm(ch).isend(buf, dest, tag)
+    with _lock:
+        h = _next_req
+        _next_req += 1
+        _reqs[h] = r
+    return h
+
+
+def irecv(view, count: int, dtcode: int, source: int, tag: int,
+          ch: int) -> int:
+    global _next_req
+    buf = _arr(view, count, dtcode)
+    r = _comm(ch).irecv(buf, source, tag)
+    with _lock:
+        h = _next_req
+        _next_req += 1
+        _reqs[h] = r
+    return h
+
+
+def wait(rh: int):
+    """Returns (source, tag, count_bytes)."""
+    with _lock:
+        r = _reqs.pop(rh, None)
+    if r is None:
+        return (-1, -1, 0)
+    st = r.wait()
+    return (st.source, st.tag, st.count)
+
+
+def test(rh: int) -> int:
+    with _lock:
+        r = _reqs.get(rh)
+    if r is None:
+        return 1
+    done = r.test()
+    if done:
+        with _lock:
+            _reqs.pop(rh, None)
+        r.wait()
+    return 1 if done else 0
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+
+def barrier(ch: int) -> int:
+    _comm(ch).barrier()
+    return 0
+
+
+def bcast(view, count: int, dtcode: int, root: int, ch: int) -> int:
+    buf = _arr(view, count, dtcode)
+    _comm(ch).bcast(buf, root=root)
+    return 0
+
+
+def allreduce(sview, rview, count: int, dtcode: int, opcode: int,
+              ch: int) -> int:
+    rb = _arr(rview, count, dtcode)
+    c = _comm(ch)
+    if sview is None:                       # MPI_IN_PLACE
+        sb = rb.copy()
+    else:
+        sb = _arr(sview, count, dtcode)
+    c.allreduce(sb, rb, op=_OPS[opcode])
+    return 0
+
+
+def reduce(sview, rview, count: int, dtcode: int, opcode: int, root: int,
+           ch: int) -> int:
+    c = _comm(ch)
+    sb = _arr(sview, count, dtcode)
+    rb = _arr(rview, count, dtcode) if rview is not None else None
+    c.reduce(sb, rb, op=_OPS[opcode], root=root)
+    return 0
+
+
+def allgather(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
+              ch: int) -> int:
+    c = _comm(ch)
+    rb = _arr(rview, rcount * c.size, rdt)
+    sb = _arr(sview, scount, sdt) if sview is not None \
+        else rb[c.rank * rcount:(c.rank + 1) * rcount].copy()
+    c.allgather(sb, rb, count=rcount)
+    return 0
+
+
+def alltoall(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
+             ch: int) -> int:
+    c = _comm(ch)
+    rb = _arr(rview, rcount * c.size, rdt)
+    sb = _arr(sview, scount * c.size, sdt) if sview is not None \
+        else rb.copy()
+    c.alltoall(sb, rb, count=rcount)
+    return 0
+
+
+def gather(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
+           root: int, ch: int) -> int:
+    c = _comm(ch)
+    sb = _arr(sview, scount, sdt)
+    rb = _arr(rview, rcount * c.size, rdt) if rview is not None else None
+    c.gather(sb, rb, root=root, count=rcount)
+    return 0
+
+
+def scatter(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
+            root: int, ch: int) -> int:
+    c = _comm(ch)
+    sb = _arr(sview, scount * c.size, sdt) if sview is not None else None
+    rb = _arr(rview, rcount, rdt)
+    c.scatter(sb, rb, root=root, count=rcount)
+    return 0
+
+
+def reduce_scatter_block(sview, rview, rcount: int, dtcode: int,
+                         opcode: int, ch: int) -> int:
+    c = _comm(ch)
+    sb = _arr(sview, rcount * c.size, dtcode)
+    rb = _arr(rview, rcount, dtcode)
+    c.reduce_scatter_block(sb, rb, op=_OPS[opcode], count=rcount)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# groups (PSCW sync in the OSU one-sided benchmarks)
+# ---------------------------------------------------------------------------
+
+_groups: Dict[int, object] = {}
+_next_group = 1
+
+
+def comm_group(ch: int) -> int:
+    global _next_group
+    with _lock:
+        h = _next_group
+        _next_group += 1
+        _groups[h] = _comm(ch).group
+    return h
+
+
+def group_incl(gh: int, ranks) -> int:
+    global _next_group
+    g = _groups[gh].incl(list(ranks))
+    with _lock:
+        h = _next_group
+        _next_group += 1
+        _groups[h] = g
+    return h
+
+
+def group_free(gh: int) -> int:
+    with _lock:
+        _groups.pop(gh, None)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# one-sided (the OSU one-sided benchmark surface)
+# ---------------------------------------------------------------------------
+
+def win_allocate(size: int, ch: int):
+    """Returns (win_handle, base_memoryview)."""
+    global _next_win
+    w = _comm(ch).win_allocate(size)
+    with _lock:
+        h = _next_win
+        _next_win += 1
+        _wins[h] = w
+    base = w.base if w.base is not None else np.empty(0, np.uint8)
+    return (h, memoryview(base))
+
+
+def win_create(view, ch: int) -> int:
+    """Window over the C caller's memory (zero-copy frombuffer)."""
+    global _next_win
+    base = np.frombuffer(view, dtype=np.uint8) if view is not None \
+        else np.empty(0, np.uint8)
+    w = _comm(ch).win_create(base)
+    with _lock:
+        h = _next_win
+        _next_win += 1
+        _wins[h] = w
+    return h
+
+
+def win_create_dynamic(ch: int) -> int:
+    global _next_win
+    w = _comm(ch).win_create_dynamic()
+    with _lock:
+        h = _next_win
+        _next_win += 1
+        _wins[h] = w
+    return h
+
+
+def win_attach(wh: int, view, c_addr: int) -> int:
+    """Dynamic-window attach. The C caller addresses targets by raw
+    pointer (MPI_Get_address); our Win.attach assigns its own address, so
+    record the C address alias too."""
+    arr = np.frombuffer(view, dtype=np.uint8)
+    w = _wins[wh]
+    addr = w.attach(arr)
+    alias = getattr(w, "_c_addr_alias", None)
+    if alias is None:
+        alias = {}
+        w._c_addr_alias = alias
+    alias[c_addr] = addr
+    return 0
+
+
+def win_detach(wh: int, c_addr: int) -> int:
+    w = _wins[wh]
+    alias = getattr(w, "_c_addr_alias", {})
+    addr = alias.pop(c_addr, c_addr)
+    try:
+        w.detach(addr)
+    except Exception:
+        pass
+    return 0
+
+
+def win_lock_all(wh: int) -> int:
+    _wins[wh].lock_all()
+    return 0
+
+
+def win_unlock_all(wh: int) -> int:
+    _wins[wh].unlock_all()
+    return 0
+
+
+def win_flush_local(wh: int, rank: int) -> int:
+    _wins[wh].flush_local(rank)
+    return 0
+
+
+def win_post(wh: int, gh: int) -> int:
+    _wins[wh].post(_groups[gh])
+    return 0
+
+
+def win_start(wh: int, gh: int) -> int:
+    _wins[wh].start(_groups[gh])
+    return 0
+
+
+def win_complete(wh: int) -> int:
+    _wins[wh].complete()
+    return 0
+
+
+def win_wait(wh: int) -> int:
+    _wins[wh].wait()
+    return 0
+
+
+def win_free(wh: int) -> int:
+    with _lock:
+        w = _wins.pop(wh, None)
+    if w is not None:
+        w.free()
+    return 0
+
+
+def win_lock(wh: int, lock_type: int, rank: int) -> int:
+    from .rma.win import LOCK_EXCLUSIVE, LOCK_SHARED
+    _wins[wh].lock(rank, LOCK_EXCLUSIVE if lock_type == 1 else LOCK_SHARED)
+    return 0
+
+
+def win_unlock(wh: int, rank: int) -> int:
+    _wins[wh].unlock(rank)
+    return 0
+
+
+def win_fence(wh: int) -> int:
+    _wins[wh].fence()
+    return 0
+
+
+def win_flush(wh: int, rank: int) -> int:
+    _wins[wh].flush(rank)
+    return 0
+
+
+def put(wh: int, oview, count: int, dtcode: int, target: int,
+        tdisp: int) -> int:
+    buf = _arr(oview, count, dtcode)
+    _wins[wh].put(buf, target, tdisp)
+    return 0
+
+
+def get(wh: int, oview, count: int, dtcode: int, target: int,
+        tdisp: int) -> int:
+    buf = _arr(oview, count, dtcode)
+    _wins[wh].get(buf, target, tdisp)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# error translation
+# ---------------------------------------------------------------------------
+
+def errclass(exc) -> int:
+    if isinstance(exc, MPIException):
+        return exc.error_class
+    return 16   # MPI_ERR_OTHER

@@ -1,0 +1,729 @@
+/* libmpi.c — the MPI C ABI over an embedded CPython runtime.
+ *
+ * The reference's C surface (src/binding + src/mpi entry points) is pure
+ * C; here the C boundary embeds CPython and forwards every call into
+ * mvapich2_tpu.cshim (SURVEY §7 hard part (a)): C benchmarks and Python
+ * ranks share one matching engine, collective stack, transport set and
+ * launcher. Buffers cross as writable memoryviews (zero-copy numpy
+ * frombuffer on the Python side).
+ *
+ * Build: make -C native libmpi.so   (links libpython, embeds REPO_ROOT)
+ * Use:   bin/mpicc osu_latency.c -o osu_latency
+ *        python -m mvapich2_tpu.run -np 2 ./osu_latency
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+#include "mpi.h"
+
+#ifndef MV2T_REPO_ROOT
+#define MV2T_REPO_ROOT "."
+#endif
+
+static PyObject *g_shim = NULL;        /* mvapich2_tpu.cshim module */
+static int g_we_initialized_python = 0;
+
+static const int DT_SIZE[] = {1, 1, 4, 4, 8, 8, 8, 2, 1, 8};
+
+static int dt_size(MPI_Datatype dt) {
+    if (dt < 0 || dt >= (int)(sizeof(DT_SIZE) / sizeof(DT_SIZE[0])))
+        return 1;
+    return DT_SIZE[dt];
+}
+
+/* ------------------------------------------------------------------ */
+/* embedded interpreter plumbing                                       */
+/* ------------------------------------------------------------------ */
+
+static int ensure_python(void) {
+    if (g_shim != NULL)
+        return MPI_SUCCESS;
+    if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+        g_we_initialized_python = 1;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    /* make the repo importable */
+    PyObject *sys_path = PySys_GetObject("path");     /* borrowed */
+    PyObject *root = PyUnicode_FromString(MV2T_REPO_ROOT);
+    if (sys_path && root)
+        PyList_Insert(sys_path, 0, root);
+    Py_XDECREF(root);
+    g_shim = PyImport_ImportModule("mvapich2_tpu.cshim");
+    if (g_shim == NULL) {
+        PyErr_Print();
+        fprintf(stderr, "libmpi: cannot import mvapich2_tpu.cshim "
+                        "(repo root: %s)\n", MV2T_REPO_ROOT);
+        PyGILState_Release(st);
+        return MPI_ERR_INTERN;
+    }
+    PyGILState_Release(st);
+    /* allow other threads (progress engine) to run while C computes */
+    if (g_we_initialized_python)
+        (void)PyEval_SaveThread();
+    return MPI_SUCCESS;
+}
+
+/* call shim.<name>(fmt...) for its side effect -> MPI status code.
+ * Only for shim functions whose return value is a status (0), never for
+ * value-returning ones — those use shim_call_v so a Python exception
+ * cannot masquerade as a valid handle/rank. */
+static int shim_call_i(const char *name, const char *fmt, ...) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    va_list ap;
+    va_start(ap, fmt);
+    PyObject *args = Py_VaBuildValue(fmt, ap);
+    va_end(ap);
+    int rc = MPI_ERR_OTHER;
+    PyObject *fn = args ? PyObject_GetAttrString(g_shim, name) : NULL;
+    PyObject *res = fn ? PyObject_CallObject(fn, args) : NULL;
+    if (res) {
+        rc = (int)PyLong_AsLong(res);
+        if (PyErr_Occurred()) { PyErr_Clear(); rc = MPI_SUCCESS; }
+        Py_DECREF(res);
+    } else {
+        PyErr_Print();
+    }
+    Py_XDECREF(fn);
+    Py_XDECREF(args);
+    PyGILState_Release(st);
+    return rc < 0 ? MPI_ERR_OTHER : rc;
+}
+
+/* call shim.<name>(fmt...) -> long value; *ok = 0 on Python exception
+ * (value and error travel on separate channels). */
+static long shim_call_v(const char *name, int *ok, const char *fmt, ...) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    va_list ap;
+    va_start(ap, fmt);
+    PyObject *args = Py_VaBuildValue(fmt, ap);
+    va_end(ap);
+    long val = 0;
+    *ok = 0;
+    PyObject *fn = args ? PyObject_GetAttrString(g_shim, name) : NULL;
+    PyObject *res = fn ? PyObject_CallObject(fn, args) : NULL;
+    if (res) {
+        val = PyLong_AsLong(res);
+        if (!PyErr_Occurred())
+            *ok = 1;
+        else
+            PyErr_Clear();
+        Py_DECREF(res);
+    } else {
+        PyErr_Print();
+    }
+    Py_XDECREF(fn);
+    Py_XDECREF(args);
+    PyGILState_Release(st);
+    return val;
+}
+
+/* call shim.<name>(...) -> (source, tag, count) into status */
+static int shim_call_status(const char *name, MPI_Status *status,
+                            const char *fmt, ...) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    va_list ap;
+    va_start(ap, fmt);
+    PyObject *args = Py_VaBuildValue(fmt, ap);
+    va_end(ap);
+    int rc = MPI_ERR_OTHER;
+    PyObject *fn = args ? PyObject_GetAttrString(g_shim, name) : NULL;
+    PyObject *res = fn ? PyObject_CallObject(fn, args) : NULL;
+    if (res) {
+        int src = -1, tag = -1, cnt = 0;
+        if (PyArg_ParseTuple(res, "iii", &src, &tag, &cnt)) {
+            if (status != MPI_STATUS_IGNORE) {
+                status->MPI_SOURCE = src;
+                status->MPI_TAG = tag;
+                status->MPI_ERROR = MPI_SUCCESS;
+                status->_count = cnt;
+            }
+            rc = MPI_SUCCESS;
+        } else {
+            PyErr_Print();
+        }
+        Py_DECREF(res);
+    } else {
+        PyErr_Print();
+    }
+    Py_XDECREF(fn);
+    Py_XDECREF(args);
+    PyGILState_Release(st);
+    return rc;
+}
+
+static PyObject *mv_view(const void *buf, long nbytes) {
+    if (buf == MPI_IN_PLACE || buf == NULL) {
+        Py_RETURN_NONE;
+    }
+    return PyMemoryView_FromMemory((char *)buf, nbytes, PyBUF_WRITE);
+}
+
+/* ------------------------------------------------------------------ */
+/* init / env                                                          */
+/* ------------------------------------------------------------------ */
+
+int MPI_Init(int *argc, char ***argv) {
+    (void)argc; (void)argv;
+    int rc = ensure_python();
+    if (rc != MPI_SUCCESS)
+        return rc;
+    return shim_call_i("init", "()");
+}
+
+int MPI_Init_thread(int *argc, char ***argv, int required, int *provided) {
+    if (provided)
+        *provided = required < MPI_THREAD_SERIALIZED
+                    ? required : MPI_THREAD_SERIALIZED;
+    return MPI_Init(argc, argv);
+}
+
+int MPI_Finalize(void) {
+    return shim_call_i("finalize", "()");
+}
+
+int MPI_Initialized(int *flag) {
+    int ok;
+    if (g_shim == NULL) { *flag = 0; return MPI_SUCCESS; }
+    *flag = (int)shim_call_v("initialized", &ok, "()");
+    if (!ok)
+        *flag = 0;
+    return MPI_SUCCESS;
+}
+
+int MPI_Abort(MPI_Comm comm, int errorcode) {
+    (void)comm;
+    exit(errorcode);
+}
+
+double MPI_Wtime(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
+}
+
+double MPI_Wtick(void) { return 1e-9; }
+
+int MPI_Get_processor_name(char *name, int *resultlen) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "get_processor_name", "()");
+    int rc = MPI_ERR_OTHER;
+    if (res) {
+        const char *s = PyUnicode_AsUTF8(res);
+        if (s) {
+            strncpy(name, s, MPI_MAX_PROCESSOR_NAME - 1);
+            name[MPI_MAX_PROCESSOR_NAME - 1] = 0;
+            *resultlen = (int)strlen(name);
+            rc = MPI_SUCCESS;
+        }
+        Py_DECREF(res);
+    } else {
+        PyErr_Print();
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Get_version(int *version, int *subversion) {
+    *version = 3; *subversion = 1;
+    return MPI_SUCCESS;
+}
+
+/* ------------------------------------------------------------------ */
+/* communicators                                                       */
+/* ------------------------------------------------------------------ */
+
+int MPI_Comm_rank(MPI_Comm comm, int *rank) {
+    int ok;
+    *rank = (int)shim_call_v("comm_rank", &ok, "(i)", comm);
+    return ok ? MPI_SUCCESS : MPI_ERR_COMM;
+}
+
+int MPI_Comm_size(MPI_Comm comm, int *size) {
+    int ok;
+    *size = (int)shim_call_v("comm_size", &ok, "(i)", comm);
+    return ok ? MPI_SUCCESS : MPI_ERR_COMM;
+}
+
+int MPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm *newcomm) {
+    int ok;
+    *newcomm = (int)shim_call_v("comm_split", &ok, "(iii)", comm, color,
+                                key);
+    if (!ok) {
+        *newcomm = MPI_COMM_NULL;
+        return MPI_ERR_COMM;
+    }
+    if (*newcomm < 0)
+        *newcomm = MPI_COMM_NULL;
+    return MPI_SUCCESS;
+}
+
+int MPI_Comm_dup(MPI_Comm comm, MPI_Comm *newcomm) {
+    int ok;
+    *newcomm = (int)shim_call_v("comm_dup", &ok, "(i)", comm);
+    if (!ok) {
+        *newcomm = MPI_COMM_NULL;
+        return MPI_ERR_COMM;
+    }
+    return MPI_SUCCESS;
+}
+
+int MPI_Comm_free(MPI_Comm *comm) {
+    shim_call_i("comm_free", "(i)", *comm);
+    *comm = MPI_COMM_NULL;
+    return MPI_SUCCESS;
+}
+
+int MPI_Comm_group(MPI_Comm comm, MPI_Group *group) {
+    int ok;
+    *group = (int)shim_call_v("comm_group", &ok, "(i)", comm);
+    if (!ok) {
+        *group = MPI_GROUP_NULL;
+        return MPI_ERR_COMM;
+    }
+    return MPI_SUCCESS;
+}
+
+int MPI_Group_incl(MPI_Group group, int n, const int ranks[],
+                   MPI_Group *newgroup) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *lst = PyList_New(n);
+    for (int i = 0; i < n; i++)
+        PyList_SET_ITEM(lst, i, PyLong_FromLong(ranks[i]));
+    PyObject *res = PyObject_CallMethod(g_shim, "group_incl", "(iO)",
+                                        group, lst);
+    *newgroup = MPI_GROUP_NULL;
+    if (res) {
+        *newgroup = (MPI_Group)PyLong_AsLong(res);
+        Py_DECREF(res);
+    } else {
+        PyErr_Print();
+    }
+    Py_DECREF(lst);
+    PyGILState_Release(st);
+    return *newgroup != MPI_GROUP_NULL ? MPI_SUCCESS : MPI_ERR_OTHER;
+}
+
+int MPI_Group_free(MPI_Group *group) {
+    shim_call_i("group_free", "(i)", *group);
+    *group = MPI_GROUP_NULL;
+    return MPI_SUCCESS;
+}
+
+int MPI_Get_address(const void *location, MPI_Aint *address) {
+    *address = (MPI_Aint)(size_t)location;
+    return MPI_SUCCESS;
+}
+
+/* ------------------------------------------------------------------ */
+/* pt2pt                                                               */
+/* ------------------------------------------------------------------ */
+
+int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
+             int tag, MPI_Comm comm) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *view = mv_view(buf, (long)count * dt_size(dt));
+    PyObject *res = PyObject_CallMethod(g_shim, "send", "(Oiiiii)", view,
+                                        count, dt, dest, tag, comm);
+    int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
+    if (!res) PyErr_Print();
+    Py_XDECREF(res);
+    Py_XDECREF(view);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
+             MPI_Comm comm, MPI_Status *status) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *view = mv_view(buf, (long)count * dt_size(dt));
+    PyObject *res = PyObject_CallMethod(g_shim, "recv", "(Oiiiii)", view,
+                                        count, dt, source, tag, comm);
+    int rc = MPI_ERR_OTHER;
+    if (res) {
+        int src = -1, t = -1, cnt = 0;
+        if (PyArg_ParseTuple(res, "iii", &src, &t, &cnt)) {
+            if (status != MPI_STATUS_IGNORE) {
+                status->MPI_SOURCE = src;
+                status->MPI_TAG = t;
+                status->MPI_ERROR = MPI_SUCCESS;
+                status->_count = cnt;
+            }
+            rc = MPI_SUCCESS;
+        }
+        Py_DECREF(res);
+    } else {
+        PyErr_Print();
+    }
+    Py_XDECREF(view);
+    PyGILState_Release(st);
+    return rc;
+}
+
+static MPI_Request isend_irecv(const char *fn, void *buf, int count,
+                               MPI_Datatype dt, int peer, int tag,
+                               MPI_Comm comm) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *view = mv_view(buf, (long)count * dt_size(dt));
+    PyObject *res = PyObject_CallMethod(g_shim, fn, "(Oiiiii)", view,
+                                        count, dt, peer, tag, comm);
+    MPI_Request h = MPI_REQUEST_NULL;
+    if (res) {
+        h = (MPI_Request)PyLong_AsLong(res);
+        Py_DECREF(res);
+    } else {
+        PyErr_Print();
+    }
+    Py_XDECREF(view);
+    PyGILState_Release(st);
+    return h;
+}
+
+int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest,
+              int tag, MPI_Comm comm, MPI_Request *req) {
+    *req = isend_irecv("isend", (void *)buf, count, dt, dest, tag, comm);
+    return *req != MPI_REQUEST_NULL ? MPI_SUCCESS : MPI_ERR_OTHER;
+}
+
+int MPI_Irecv(void *buf, int count, MPI_Datatype dt, int source, int tag,
+              MPI_Comm comm, MPI_Request *req) {
+    *req = isend_irecv("irecv", buf, count, dt, source, tag, comm);
+    return *req != MPI_REQUEST_NULL ? MPI_SUCCESS : MPI_ERR_OTHER;
+}
+
+int MPI_Wait(MPI_Request *req, MPI_Status *status) {
+    if (*req == MPI_REQUEST_NULL)
+        return MPI_SUCCESS;
+    int rc = shim_call_status("wait", status, "(l)", (long)*req);
+    *req = MPI_REQUEST_NULL;
+    return rc;
+}
+
+int MPI_Waitall(int count, MPI_Request reqs[], MPI_Status statuses[]) {
+    for (int i = 0; i < count; i++) {
+        MPI_Status *s = statuses == MPI_STATUSES_IGNORE
+                        ? MPI_STATUS_IGNORE : &statuses[i];
+        int rc = MPI_Wait(&reqs[i], s);
+        if (rc != MPI_SUCCESS)
+            return rc;
+    }
+    return MPI_SUCCESS;
+}
+
+int MPI_Test(MPI_Request *req, int *flag, MPI_Status *status) {
+    (void)status;
+    if (*req == MPI_REQUEST_NULL) { *flag = 1; return MPI_SUCCESS; }
+    {
+        int ok;
+        *flag = (int)shim_call_v("test", &ok, "(l)", (long)*req);
+        if (!ok)
+            return MPI_ERR_OTHER;
+    }
+    if (*flag)
+        *req = MPI_REQUEST_NULL;
+    return MPI_SUCCESS;
+}
+
+int MPI_Get_count(const MPI_Status *status, MPI_Datatype dt, int *count) {
+    int sz = dt_size(dt);
+    if (sz == 0 || status->_count % sz) { *count = MPI_UNDEFINED; }
+    else { *count = status->_count / sz; }
+    return MPI_SUCCESS;
+}
+
+/* ------------------------------------------------------------------ */
+/* collectives                                                         */
+/* ------------------------------------------------------------------ */
+
+int MPI_Barrier(MPI_Comm comm) {
+    return shim_call_i("barrier", "(i)", comm);
+}
+
+static int coll2(const char *fn, const void *sb, void *rb, long snb,
+                 long rnb, const char *fmt, ...) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *sv = mv_view(sb, snb);
+    PyObject *rv = mv_view(rb, rnb);
+    va_list ap;
+    va_start(ap, fmt);
+    PyObject *rest = Py_VaBuildValue(fmt, ap);
+    va_end(ap);
+    int rc = MPI_ERR_OTHER;
+    if (sv && rv && rest) {
+        PyObject *args = PyTuple_New(2 + PyTuple_Size(rest));
+        Py_INCREF(sv); Py_INCREF(rv);
+        PyTuple_SET_ITEM(args, 0, sv);
+        PyTuple_SET_ITEM(args, 1, rv);
+        for (Py_ssize_t i = 0; i < PyTuple_Size(rest); i++) {
+            PyObject *it = PyTuple_GET_ITEM(rest, i);
+            Py_INCREF(it);
+            PyTuple_SET_ITEM(args, 2 + i, it);
+        }
+        PyObject *f = PyObject_GetAttrString(g_shim, fn);
+        PyObject *res = f ? PyObject_CallObject(f, args) : NULL;
+        if (res) { rc = MPI_SUCCESS; Py_DECREF(res); }
+        else PyErr_Print();
+        Py_XDECREF(f);
+        Py_DECREF(args);
+    }
+    Py_XDECREF(sv);
+    Py_XDECREF(rv);
+    Py_XDECREF(rest);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root,
+              MPI_Comm comm) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *view = mv_view(buf, (long)count * dt_size(dt));
+    PyObject *res = PyObject_CallMethod(g_shim, "bcast", "(Oiiii)", view,
+                                        count, dt, root, comm);
+    int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
+    if (!res) PyErr_Print();
+    Py_XDECREF(res);
+    Py_XDECREF(view);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
+    long nb = (long)count * dt_size(dt);
+    return coll2("allreduce", sendbuf, recvbuf, nb, nb, "(iiii)",
+                 count, dt, op, comm);
+}
+
+int MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
+               MPI_Datatype dt, MPI_Op op, int root, MPI_Comm comm) {
+    long nb = (long)count * dt_size(dt);
+    return coll2("reduce", sendbuf, recvbuf, nb, nb, "(iiiii)",
+                 count, dt, op, root, comm);
+}
+
+int MPI_Allgather(const void *sendbuf, int scount, MPI_Datatype sdt,
+                  void *recvbuf, int rcount, MPI_Datatype rdt,
+                  MPI_Comm comm) {
+    int size;
+    MPI_Comm_size(comm, &size);
+    return coll2("allgather", sendbuf, recvbuf,
+                 (long)scount * dt_size(sdt),
+                 (long)rcount * dt_size(rdt) * size,
+                 "(iiiii)", scount, sdt, rcount, rdt, comm);
+}
+
+int MPI_Alltoall(const void *sendbuf, int scount, MPI_Datatype sdt,
+                 void *recvbuf, int rcount, MPI_Datatype rdt,
+                 MPI_Comm comm) {
+    int size;
+    MPI_Comm_size(comm, &size);
+    return coll2("alltoall", sendbuf, recvbuf,
+                 (long)scount * dt_size(sdt) * size,
+                 (long)rcount * dt_size(rdt) * size,
+                 "(iiiii)", scount, sdt, rcount, rdt, comm);
+}
+
+int MPI_Gather(const void *sendbuf, int scount, MPI_Datatype sdt,
+               void *recvbuf, int rcount, MPI_Datatype rdt, int root,
+               MPI_Comm comm) {
+    int size;
+    MPI_Comm_size(comm, &size);
+    return coll2("gather", sendbuf, recvbuf,
+                 (long)scount * dt_size(sdt),
+                 (long)rcount * dt_size(rdt) * size,
+                 "(iiiiii)", scount, sdt, rcount, rdt, root, comm);
+}
+
+int MPI_Scatter(const void *sendbuf, int scount, MPI_Datatype sdt,
+                void *recvbuf, int rcount, MPI_Datatype rdt, int root,
+                MPI_Comm comm) {
+    int size;
+    MPI_Comm_size(comm, &size);
+    return coll2("scatter", sendbuf, recvbuf,
+                 (long)scount * dt_size(sdt) * size,
+                 (long)rcount * dt_size(rdt),
+                 "(iiiiii)", scount, sdt, rcount, rdt, root, comm);
+}
+
+int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
+                             int rcount, MPI_Datatype dt, MPI_Op op,
+                             MPI_Comm comm) {
+    int size;
+    MPI_Comm_size(comm, &size);
+    return coll2("reduce_scatter_block", sendbuf, recvbuf,
+                 (long)rcount * dt_size(dt) * size,
+                 (long)rcount * dt_size(dt),
+                 "(iiii)", rcount, dt, op, comm);
+}
+
+/* ------------------------------------------------------------------ */
+/* one-sided                                                           */
+/* ------------------------------------------------------------------ */
+
+int MPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
+                     MPI_Comm comm, void *baseptr, MPI_Win *win) {
+    (void)disp_unit; (void)info;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "win_allocate", "(Li)",
+                                        (long long)size, comm);
+    int rc = MPI_ERR_OTHER;
+    if (res) {
+        int h;
+        PyObject *mv;
+        if (PyArg_ParseTuple(res, "iO", &h, &mv)) {
+            *win = h;
+            Py_buffer b;
+            if (PyObject_GetBuffer(mv, &b, PyBUF_SIMPLE) == 0) {
+                *(void **)baseptr = b.buf;
+                PyBuffer_Release(&b);   /* numpy array owns the memory */
+                rc = MPI_SUCCESS;
+            }
+        }
+        Py_DECREF(res);
+    } else {
+        PyErr_Print();
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Win_create(void *base, MPI_Aint size, int disp_unit,
+                   MPI_Info info, MPI_Comm comm, MPI_Win *win) {
+    (void)disp_unit; (void)info;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *view = mv_view(base, (long)size);
+    PyObject *res = PyObject_CallMethod(g_shim, "win_create", "(Oi)",
+                                        view, comm);
+    int rc = MPI_ERR_OTHER;
+    if (res) {
+        *win = (MPI_Win)PyLong_AsLong(res);
+        rc = MPI_SUCCESS;
+        Py_DECREF(res);
+    } else {
+        PyErr_Print();
+    }
+    Py_XDECREF(view);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Win_create_dynamic(MPI_Info info, MPI_Comm comm, MPI_Win *win) {
+    int ok;
+    (void)info;
+    *win = (int)shim_call_v("win_create_dynamic", &ok, "(i)", comm);
+    if (!ok) {
+        *win = MPI_WIN_NULL;
+        return MPI_ERR_OTHER;
+    }
+    return MPI_SUCCESS;
+}
+
+int MPI_Win_attach(MPI_Win win, void *base, MPI_Aint size) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *view = mv_view(base, (long)size);
+    PyObject *res = PyObject_CallMethod(g_shim, "win_attach", "(iOL)",
+                                        win, view,
+                                        (long long)(size_t)base);
+    int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
+    if (!res) PyErr_Print();
+    Py_XDECREF(res);
+    Py_XDECREF(view);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Win_detach(MPI_Win win, const void *base) {
+    return shim_call_i("win_detach", "(iL)", win,
+                       (long long)(size_t)base);
+}
+
+int MPI_Win_free(MPI_Win *win) {
+    shim_call_i("win_free", "(i)", *win);
+    *win = MPI_WIN_NULL;
+    return MPI_SUCCESS;
+}
+
+int MPI_Win_lock(int lock_type, int rank, int assert_, MPI_Win win) {
+    (void)assert_;
+    return shim_call_i("win_lock", "(iii)", win,
+                       lock_type == MPI_LOCK_EXCLUSIVE ? 1 : 2, rank);
+}
+
+int MPI_Win_unlock(int rank, MPI_Win win) {
+    return shim_call_i("win_unlock", "(ii)", win, rank);
+}
+
+int MPI_Win_lock_all(int assert_, MPI_Win win) {
+    (void)assert_;
+    return shim_call_i("win_lock_all", "(i)", win);
+}
+
+int MPI_Win_unlock_all(MPI_Win win) {
+    return shim_call_i("win_unlock_all", "(i)", win);
+}
+
+int MPI_Win_fence(int assert_, MPI_Win win) {
+    (void)assert_;
+    return shim_call_i("win_fence", "(i)", win);
+}
+
+int MPI_Win_flush(int rank, MPI_Win win) {
+    return shim_call_i("win_flush", "(ii)", win, rank);
+}
+
+int MPI_Win_flush_local(int rank, MPI_Win win) {
+    return shim_call_i("win_flush_local", "(ii)", win, rank);
+}
+
+int MPI_Win_post(MPI_Group group, int assert_, MPI_Win win) {
+    (void)assert_;
+    return shim_call_i("win_post", "(ii)", win, group);
+}
+
+int MPI_Win_start(MPI_Group group, int assert_, MPI_Win win) {
+    (void)assert_;
+    return shim_call_i("win_start", "(ii)", win, group);
+}
+
+int MPI_Win_complete(MPI_Win win) {
+    return shim_call_i("win_complete", "(i)", win);
+}
+
+int MPI_Win_wait(MPI_Win win) {
+    return shim_call_i("win_wait", "(i)", win);
+}
+
+static int rma_op(const char *fn, MPI_Win win, const void *origin,
+                  int count, MPI_Datatype dt, int target, MPI_Aint disp) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *view = mv_view(origin, (long)count * dt_size(dt));
+    PyObject *res = PyObject_CallMethod(g_shim, fn, "(iOiiiL)", win, view,
+                                        count, dt, target,
+                                        (long long)disp);
+    int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
+    if (!res) PyErr_Print();
+    Py_XDECREF(res);
+    Py_XDECREF(view);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Put(const void *origin, int ocount, MPI_Datatype odt,
+            int target_rank, MPI_Aint target_disp, int tcount,
+            MPI_Datatype tdt, MPI_Win win) {
+    (void)tcount; (void)tdt;
+    return rma_op("put", win, origin, ocount, odt, target_rank,
+                  target_disp);
+}
+
+int MPI_Get(void *origin, int ocount, MPI_Datatype odt,
+            int target_rank, MPI_Aint target_disp, int tcount,
+            MPI_Datatype tdt, MPI_Win win) {
+    (void)tcount; (void)tdt;
+    return rma_op("get", win, origin, ocount, odt, target_rank,
+                  target_disp);
+}

@@ -1,0 +1,205 @@
+/* mpi.h — C ABI for the mvapich2-tpu framework.
+ *
+ * The MPI-C surface the OSU benchmark suite compiles against (SURVEY §7
+ * hard part (a)). Handles are small integers; the implementation
+ * (libmpi.c) embeds CPython and forwards into mvapich2_tpu.cshim, so C
+ * programs and Python ranks share one runtime (matching engine,
+ * collectives, transports, launcher).
+ *
+ * Subset: the types/calls used by osu_benchmarks' pt2pt, collective,
+ * one-sided and startup suites, plus common test-program surface.
+ */
+#ifndef MV2T_MPI_H
+#define MV2T_MPI_H
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef int MPI_Comm;
+typedef int MPI_Datatype;
+typedef int MPI_Op;
+typedef int MPI_Win;
+typedef long MPI_Request;
+typedef long long MPI_Aint;
+typedef long long MPI_Offset;
+typedef int MPI_Errhandler;
+typedef int MPI_Info;
+typedef int MPI_Group;
+
+typedef struct MPI_Status {
+    int MPI_SOURCE;
+    int MPI_TAG;
+    int MPI_ERROR;
+    int _count;     /* bytes received */
+} MPI_Status;
+
+/* communicators */
+#define MPI_COMM_WORLD ((MPI_Comm)0)
+#define MPI_COMM_SELF  ((MPI_Comm)1)
+#define MPI_COMM_NULL  ((MPI_Comm)-1)
+
+/* datatypes (codes mirrored in mvapich2_tpu/cshim.py) */
+#define MPI_BYTE            ((MPI_Datatype)0)
+#define MPI_CHAR            ((MPI_Datatype)1)
+#define MPI_INT             ((MPI_Datatype)2)
+#define MPI_FLOAT           ((MPI_Datatype)3)
+#define MPI_DOUBLE          ((MPI_Datatype)4)
+#define MPI_LONG            ((MPI_Datatype)5)
+#define MPI_LONG_LONG       ((MPI_Datatype)5)
+#define MPI_LONG_LONG_INT   ((MPI_Datatype)5)
+#define MPI_UNSIGNED_LONG   ((MPI_Datatype)6)
+#define MPI_SHORT           ((MPI_Datatype)7)
+#define MPI_UNSIGNED_CHAR   ((MPI_Datatype)8)
+#define MPI_SIGNED_CHAR     ((MPI_Datatype)1)
+#define MPI_AINT            ((MPI_Datatype)9)
+#define MPI_DATATYPE_NULL   ((MPI_Datatype)-1)
+
+#define MPI_VERSION    3
+#define MPI_SUBVERSION 1
+
+/* ops (codes mirrored in cshim.py) */
+#define MPI_SUM  ((MPI_Op)0)
+#define MPI_PROD ((MPI_Op)1)
+#define MPI_MAX  ((MPI_Op)2)
+#define MPI_MIN  ((MPI_Op)3)
+#define MPI_LAND ((MPI_Op)4)
+#define MPI_LOR  ((MPI_Op)5)
+#define MPI_BAND ((MPI_Op)6)
+#define MPI_BOR  ((MPI_Op)7)
+#define MPI_OP_NULL ((MPI_Op)-1)
+
+/* special values */
+#define MPI_ANY_SOURCE   (-1)
+#define MPI_ANY_TAG      (-2)
+#define MPI_PROC_NULL    (-3)
+#define MPI_ROOT         (-4)
+#define MPI_UNDEFINED    (-32766)
+#define MPI_IN_PLACE     ((void *)-1)
+#define MPI_STATUS_IGNORE   ((MPI_Status *)0)
+#define MPI_STATUSES_IGNORE ((MPI_Status *)0)
+#define MPI_REQUEST_NULL ((MPI_Request)0)
+#define MPI_WIN_NULL     ((MPI_Win)-1)
+#define MPI_INFO_NULL    ((MPI_Info)-1)
+#define MPI_GROUP_NULL   ((MPI_Group)-1)
+#define MPI_GROUP_EMPTY  ((MPI_Group)-2)
+#define MPI_BOTTOM       ((void *)0)
+#define MPI_MAX_PROCESSOR_NAME 256
+#define MPI_MAX_ERROR_STRING   512
+
+/* error classes (subset; mirrors mvapich2_tpu/core/errors.py) */
+#define MPI_SUCCESS      0
+#define MPI_ERR_BUFFER   1
+#define MPI_ERR_COUNT    2
+#define MPI_ERR_TYPE     3
+#define MPI_ERR_TAG      4
+#define MPI_ERR_COMM     5
+#define MPI_ERR_RANK     6
+#define MPI_ERR_TRUNCATE 15
+#define MPI_ERR_OTHER    16
+#define MPI_ERR_INTERN   17
+
+/* thread levels */
+#define MPI_THREAD_SINGLE     0
+#define MPI_THREAD_FUNNELED   1
+#define MPI_THREAD_SERIALIZED 2
+#define MPI_THREAD_MULTIPLE   3
+
+/* one-sided lock types */
+#define MPI_LOCK_EXCLUSIVE 1
+#define MPI_LOCK_SHARED    2
+
+/* ---- init / env ---- */
+int MPI_Init(int *argc, char ***argv);
+int MPI_Init_thread(int *argc, char ***argv, int required, int *provided);
+int MPI_Finalize(void);
+int MPI_Initialized(int *flag);
+int MPI_Abort(MPI_Comm comm, int errorcode);
+double MPI_Wtime(void);
+double MPI_Wtick(void);
+int MPI_Get_processor_name(char *name, int *resultlen);
+int MPI_Get_version(int *version, int *subversion);
+
+/* ---- communicators ---- */
+int MPI_Comm_rank(MPI_Comm comm, int *rank);
+int MPI_Comm_size(MPI_Comm comm, int *size);
+int MPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm *newcomm);
+int MPI_Comm_dup(MPI_Comm comm, MPI_Comm *newcomm);
+int MPI_Comm_free(MPI_Comm *comm);
+int MPI_Comm_group(MPI_Comm comm, MPI_Group *group);
+int MPI_Group_incl(MPI_Group group, int n, const int ranks[],
+                   MPI_Group *newgroup);
+int MPI_Group_free(MPI_Group *group);
+int MPI_Get_address(const void *location, MPI_Aint *address);
+
+/* ---- pt2pt ---- */
+int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
+             int tag, MPI_Comm comm);
+int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
+             MPI_Comm comm, MPI_Status *status);
+int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest,
+              int tag, MPI_Comm comm, MPI_Request *req);
+int MPI_Irecv(void *buf, int count, MPI_Datatype dt, int source, int tag,
+              MPI_Comm comm, MPI_Request *req);
+int MPI_Wait(MPI_Request *req, MPI_Status *status);
+int MPI_Waitall(int count, MPI_Request reqs[], MPI_Status statuses[]);
+int MPI_Test(MPI_Request *req, int *flag, MPI_Status *status);
+int MPI_Get_count(const MPI_Status *status, MPI_Datatype dt, int *count);
+
+/* ---- collectives ---- */
+int MPI_Barrier(MPI_Comm comm);
+int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root,
+              MPI_Comm comm);
+int MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
+               MPI_Datatype dt, MPI_Op op, int root, MPI_Comm comm);
+int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype dt, MPI_Op op, MPI_Comm comm);
+int MPI_Allgather(const void *sendbuf, int sendcount, MPI_Datatype sdt,
+                  void *recvbuf, int recvcount, MPI_Datatype rdt,
+                  MPI_Comm comm);
+int MPI_Alltoall(const void *sendbuf, int sendcount, MPI_Datatype sdt,
+                 void *recvbuf, int recvcount, MPI_Datatype rdt,
+                 MPI_Comm comm);
+int MPI_Gather(const void *sendbuf, int sendcount, MPI_Datatype sdt,
+               void *recvbuf, int recvcount, MPI_Datatype rdt, int root,
+               MPI_Comm comm);
+int MPI_Scatter(const void *sendbuf, int sendcount, MPI_Datatype sdt,
+                void *recvbuf, int recvcount, MPI_Datatype rdt, int root,
+                MPI_Comm comm);
+int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
+                             int recvcount, MPI_Datatype dt, MPI_Op op,
+                             MPI_Comm comm);
+
+/* ---- one-sided ---- */
+int MPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
+                     MPI_Comm comm, void *baseptr, MPI_Win *win);
+int MPI_Win_create(void *base, MPI_Aint size, int disp_unit,
+                   MPI_Info info, MPI_Comm comm, MPI_Win *win);
+int MPI_Win_create_dynamic(MPI_Info info, MPI_Comm comm, MPI_Win *win);
+int MPI_Win_attach(MPI_Win win, void *base, MPI_Aint size);
+int MPI_Win_detach(MPI_Win win, const void *base);
+int MPI_Win_free(MPI_Win *win);
+int MPI_Win_lock(int lock_type, int rank, int assert_, MPI_Win win);
+int MPI_Win_unlock(int rank, MPI_Win win);
+int MPI_Win_lock_all(int assert_, MPI_Win win);
+int MPI_Win_unlock_all(MPI_Win win);
+int MPI_Win_fence(int assert_, MPI_Win win);
+int MPI_Win_flush(int rank, MPI_Win win);
+int MPI_Win_flush_local(int rank, MPI_Win win);
+int MPI_Win_post(MPI_Group group, int assert_, MPI_Win win);
+int MPI_Win_start(MPI_Group group, int assert_, MPI_Win win);
+int MPI_Win_complete(MPI_Win win);
+int MPI_Win_wait(MPI_Win win);
+int MPI_Put(const void *origin, int origin_count, MPI_Datatype odt,
+            int target_rank, MPI_Aint target_disp, int target_count,
+            MPI_Datatype tdt, MPI_Win win);
+int MPI_Get(void *origin, int origin_count, MPI_Datatype odt,
+            int target_rank, MPI_Aint target_disp, int target_count,
+            MPI_Datatype tdt, MPI_Win win);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* MV2T_MPI_H */

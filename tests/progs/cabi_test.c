@@ -1,0 +1,104 @@
+/* C-ABI conformance smoke: the "prints No Errors" contract (SURVEY §4)
+ * exercised through libmpi.so — pt2pt, collectives, one-sided, from a
+ * plain C program compiled with bin/mpicc. */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define CHECK(x) do { if ((x) != MPI_SUCCESS) { \
+    fprintf(stderr, "rank %d: %s failed\n", rank, #x); errs++; } } while (0)
+
+int main(int argc, char **argv) {
+    int rank, size, errs = 0;
+    MPI_Init(&argc, &argv);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+    /* pt2pt ring (eager) */
+    long mine = rank, got = -1;
+    MPI_Status st;
+    MPI_Request rq;
+    CHECK(MPI_Irecv(&got, 1, MPI_LONG, (rank - 1 + size) % size, 7,
+                    MPI_COMM_WORLD, &rq));
+    CHECK(MPI_Send(&mine, 1, MPI_LONG, (rank + 1) % size, 7,
+                   MPI_COMM_WORLD));
+    CHECK(MPI_Wait(&rq, &st));
+    if (got != (rank - 1 + size) % size) {
+        fprintf(stderr, "rank %d: ring got %ld\n", rank, got);
+        errs++;
+    }
+    if (st.MPI_SOURCE != (rank - 1 + size) % size || st.MPI_TAG != 7)
+        errs++;
+
+    /* rendezvous-sized pt2pt */
+    int big_n = 1 << 16;
+    double *sb = malloc(big_n * sizeof(double));
+    double *rb = malloc(big_n * sizeof(double));
+    for (int i = 0; i < big_n; i++) sb[i] = rank + 0.5;
+    CHECK(MPI_Irecv(rb, big_n, MPI_DOUBLE, (rank - 1 + size) % size, 8,
+                    MPI_COMM_WORLD, &rq));
+    CHECK(MPI_Send(sb, big_n, MPI_DOUBLE, (rank + 1) % size, 8,
+                   MPI_COMM_WORLD));
+    CHECK(MPI_Wait(&rq, MPI_STATUS_IGNORE));
+    if (rb[big_n - 1] != (rank - 1 + size) % size + 0.5) errs++;
+
+    /* collectives */
+    double v = rank + 1, sum = 0;
+    CHECK(MPI_Allreduce(&v, &sum, 1, MPI_DOUBLE, MPI_SUM,
+                        MPI_COMM_WORLD));
+    if (sum != size * (size + 1) / 2.0) {
+        fprintf(stderr, "rank %d: allreduce %f\n", rank, sum);
+        errs++;
+    }
+    int bval = rank == 0 ? 314 : 0;
+    CHECK(MPI_Bcast(&bval, 1, MPI_INT, 0, MPI_COMM_WORLD));
+    if (bval != 314) errs++;
+
+    int *gat = malloc(size * sizeof(int));
+    int me = rank * 7;
+    CHECK(MPI_Allgather(&me, 1, MPI_INT, gat, 1, MPI_INT,
+                        MPI_COMM_WORLD));
+    for (int i = 0; i < size; i++)
+        if (gat[i] != i * 7) errs++;
+
+    CHECK(MPI_Barrier(MPI_COMM_WORLD));
+
+    /* one-sided */
+    void *base = NULL;
+    MPI_Win win;
+    CHECK(MPI_Win_allocate(64, 1, MPI_INFO_NULL, MPI_COMM_WORLD, &base,
+                           &win));
+    if (size >= 2 && rank == 0) {
+        long payload = 4242;
+        CHECK(MPI_Win_lock(MPI_LOCK_SHARED, 1, 0, win));
+        CHECK(MPI_Put(&payload, 1, MPI_LONG, 1, 0, 1, MPI_LONG, win));
+        CHECK(MPI_Win_unlock(1, win));
+    }
+    CHECK(MPI_Barrier(MPI_COMM_WORLD));
+    if (size >= 2 && rank == 1) {
+        long *p = (long *)base;
+        if (p[0] != 4242) {
+            fprintf(stderr, "rank 1: window has %ld\n", p[0]);
+            errs++;
+        }
+    }
+    CHECK(MPI_Win_free(&win));
+
+    /* split */
+    MPI_Comm half;
+    CHECK(MPI_Comm_split(MPI_COMM_WORLD, rank % 2, rank, &half));
+    int hrank, hsize;
+    MPI_Comm_rank(half, &hrank);
+    MPI_Comm_size(half, &hsize);
+    if (hrank != rank / 2) errs++;
+    CHECK(MPI_Comm_free(&half));
+
+    int total = 0;
+    MPI_Allreduce(&errs, &total, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD);
+    if (rank == 0 && total == 0)
+        printf("No Errors\n");
+    MPI_Finalize();
+    free(sb); free(rb); free(gat);
+    return total ? 1 : 0;
+}

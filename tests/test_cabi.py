@@ -1,0 +1,78 @@
+"""C-ABI tests: compile MPI C programs with bin/mpicc against
+native/libmpi.so (embedded-CPython bridge) and run them under the
+launcher — SURVEY §7 hard part (a), the unmodified-OSU contract."""
+
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MPICC = os.path.join(REPO, "bin", "mpicc")
+OSU = "/root/reference/osu_benchmarks"
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("gcc") is None or shutil.which("python3-config") is None,
+    reason="no C toolchain")
+
+
+def _compile(srcs, out, extra=()):
+    r = subprocess.run([MPICC, *srcs, "-o", out, *extra],
+                       capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, f"mpicc failed:\n{r.stdout}\n{r.stderr}"
+
+
+def _mpirun(np_, prog, *args, timeout=240):
+    cmd = [sys.executable, "-m", "mvapich2_tpu.run", "-np", str(np_),
+           prog, *args]
+    return subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def test_cabi_conformance_prog():
+    out = os.path.join(tempfile.mkdtemp(), "cabi_test")
+    _compile([os.path.join(REPO, "tests", "progs", "cabi_test.c")], out)
+    r = _mpirun(2, out)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+
+
+@pytest.mark.skipif(not os.path.isdir(OSU),
+                    reason="reference OSU suite not mounted")
+def test_unmodified_osu_latency():
+    """The north-star contract: the reference's osu_latency.c builds and
+    runs UNMODIFIED (BASELINE.json acceptance harness)."""
+    out = os.path.join(tempfile.mkdtemp(), "osu_latency")
+    _compile([os.path.join(OSU, "mpi", "pt2pt", "osu_latency.c"),
+              os.path.join(OSU, "util", "osu_util.c"),
+              os.path.join(OSU, "util", "osu_util_mpi.c")],
+             out, extra=[f"-I{OSU}/util", "-DFIELD_WIDTH=18",
+                         "-DFLOAT_PRECISION=2"])
+    r = _mpirun(2, out, "-m", "1024", "-i", "40")
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "# OSU MPI Latency Test" in r.stdout
+    # a sweep line per power-of-two size, each with a numeric latency
+    lines = [l for l in r.stdout.splitlines()
+             if l and not l.startswith("#")]
+    assert len(lines) >= 8
+    float(lines[0].split()[1])
+
+
+@pytest.mark.skipif(not os.path.isdir(OSU),
+                    reason="reference OSU suite not mounted")
+def test_unmodified_osu_allreduce():
+    out = os.path.join(tempfile.mkdtemp(), "osu_allreduce")
+    _compile([os.path.join(OSU, "mpi", "collective", "osu_allreduce.c"),
+              os.path.join(OSU, "util", "osu_util.c"),
+              os.path.join(OSU, "util", "osu_util_mpi.c")],
+             out, extra=[f"-I{OSU}/util", "-DFIELD_WIDTH=18",
+                         "-DFLOAT_PRECISION=2"])
+    r = _mpirun(3, out, "-m", "512", "-i", "30")
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "Allreduce" in r.stdout
+    lines = [l for l in r.stdout.splitlines()
+             if l and not l.startswith("#")]
+    assert len(lines) >= 7
