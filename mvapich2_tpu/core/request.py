@@ -137,3 +137,80 @@ def waitany(requests: List[Optional[Request]]) -> int:
 
 def testall(requests: List[Optional[Request]]) -> bool:
     return all(r is None or r.test() for r in requests)
+
+
+def testany(requests: List[Optional[Request]]):
+    """(index, flag): first completed request's index, or (-1, False)."""
+    for i, r in enumerate(requests):
+        if r is not None and r.test():
+            if r.error is not None:
+                raise r.error
+            return i, True
+    return -1, False
+
+
+def waitsome(requests: List[Optional[Request]]) -> List[int]:
+    """Indices of all completed requests after at least one completes."""
+    first = waitany(requests)
+    if first < 0:
+        return []
+    out = []
+    for i, r in enumerate(requests):
+        if r is not None and r.complete_flag:
+            if r.error is not None:
+                raise r.error
+            out.append(i)
+    return out
+
+
+def testsome(requests: List[Optional[Request]]) -> List[int]:
+    out = []
+    for i, r in enumerate(requests):
+        if r is not None and r.test():
+            if r.error is not None:
+                raise r.error
+            out.append(i)
+    return out
+
+
+class Grequest(Request):
+    """Generalized request (MPI-3.1 §12.2, MPI_Grequest_start analog).
+
+    The application completes it via ``complete()``; ``query_fn(status)``
+    fills the status when the request is inspected at completion;
+    ``free_fn``/``cancel_fn`` hook teardown and cancellation."""
+
+    def __init__(self, engine, query_fn=None, free_fn=None,
+                 cancel_fn=None):
+        super().__init__(engine, "grequest")
+        self._query_fn = query_fn
+        self._free_fn = free_fn
+        self._user_cancel_fn = cancel_fn
+        if engine is not None:
+            with engine.mutex:
+                engine.track(self)
+
+    def complete(self, error=None) -> None:  # MPI_Grequest_complete
+        if self._query_fn is not None:
+            self._query_fn(self.status)
+        super().complete(error)
+
+    def cancel(self) -> None:
+        if self.complete_flag:
+            return
+        if self._user_cancel_fn is not None:
+            self._user_cancel_fn(not self.complete_flag)
+        self.cancelled = True
+        self.status.cancelled = True
+        super().complete(None)
+
+    def free(self) -> None:
+        if self._free_fn is not None:
+            self._free_fn()
+
+
+def grequest_start(query_fn=None, free_fn=None, cancel_fn=None) -> Grequest:
+    from ..runtime.universe import current_universe
+    u = current_universe()
+    return Grequest(u.engine if u is not None else None, query_fn,
+                    free_fn, cancel_fn)

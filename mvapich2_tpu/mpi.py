@@ -197,6 +197,43 @@ def Intercomm_merge(intercomm, high: bool = False):
 
 
 # ---------------------------------------------------------------------------
+# pack/unpack (MPI-3.1 §4.2) and generalized requests (§12.2)
+# ---------------------------------------------------------------------------
+
+def Pack(inbuf, incount, datatype, outbuf, position: int) -> int:
+    """Pack into outbuf at byte ``position``; returns the new position."""
+    import numpy as np
+    data = np.asarray(datatype.pack(inbuf, incount))
+    out = np.frombuffer(outbuf, dtype=np.uint8) \
+        if not isinstance(outbuf, np.ndarray) else outbuf.view(np.uint8)
+    out[position:position + data.size] = data
+    return position + data.size
+
+
+def Unpack(inbuf, position: int, outbuf, outcount, datatype) -> int:
+    import numpy as np
+    nbytes = datatype.size * outcount
+    src = np.frombuffer(inbuf, dtype=np.uint8) \
+        if not isinstance(inbuf, np.ndarray) else inbuf.view(np.uint8)
+    datatype.unpack(src[position:position + nbytes], outbuf, outcount)
+    return position + nbytes
+
+
+def Pack_size(incount: int, datatype) -> int:
+    return incount * datatype.size
+
+
+def Grequest_start(query_fn=None, free_fn=None, cancel_fn=None):
+    from .core.request import grequest_start
+    return grequest_start(query_fn, free_fn, cancel_fn)
+
+
+# request helpers (MPI_Waitall/any/some, Test* analogs)
+from .core.request import (testall, testany, testsome, waitall,  # noqa: E402
+                           waitany, waitsome)
+
+
+# ---------------------------------------------------------------------------
 # MPI-IO (ROMIO analog; mvapich2_tpu.io)
 # ---------------------------------------------------------------------------
 

@@ -52,3 +52,13 @@ def test_singleton_init():
     r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
                        capture_output=True, text=True, timeout=60)
     assert r.returncode == 0 and "No Errors" in r.stdout
+
+
+def test_runtests_driver():
+    """bin/runtests: the testlist-driven conformance runner (SURVEY §4)."""
+    runner = os.path.join(REPO, "bin", "runtests")
+    testlist = os.path.join(REPO, "tests", "progs", "testlist")
+    r = subprocess.run([sys.executable, runner, testlist], cwd=REPO,
+                       capture_output=True, text=True, timeout=500)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "0 failures" in r.stdout

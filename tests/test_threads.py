@@ -1,0 +1,148 @@
+"""MPI_THREAD_MULTIPLE-style safety tests (MPICH test/mpi/threads analog):
+multiple application threads per rank doing concurrent pt2pt, collectives
+(one comm per thread, as MPI requires), RMA, and IO."""
+
+import threading
+
+import numpy as np
+
+from mvapich2_tpu.core.request import grequest_start, waitall
+from mvapich2_tpu.runtime.universe import run_ranks
+
+
+def _par(nthreads, fn):
+    """Run fn(tid) on nthreads threads; re-raise the first error."""
+    errs = []
+
+    def wrap(t):
+        try:
+            fn(t)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=wrap, args=(t,)) for t in range(nthreads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    if errs:
+        raise errs[0]
+
+
+def test_multithreaded_pt2pt():
+    T = 4
+
+    def body(comm):
+        def worker(tid):
+            peer = (comm.rank + 1) % comm.size
+            src = (comm.rank - 1) % comm.size
+            for i in range(20):
+                tag = tid * 100 + i
+                sreq = comm.isend(np.array([comm.rank * 1000 + tag],
+                                           np.int64), peer, tag)
+                buf = np.zeros(1, np.int64)
+                comm.recv(buf, src, tag)
+                assert int(buf[0]) == src * 1000 + tag
+                sreq.wait()
+
+        _par(T, worker)
+        return True
+
+    assert all(run_ranks(2, body))
+
+
+def test_multithreaded_collectives_on_dup_comms():
+    T = 3
+
+    def body(comm):
+        # MPI: concurrent collectives need distinct communicators
+        comms = [comm.dup() for _ in range(T)]
+
+        def worker(tid):
+            c = comms[tid]
+            for i in range(10):
+                out = c.allreduce(np.array([tid + i + c.rank], np.int64))
+                expect = sum(tid + i + r for r in range(c.size))
+                assert int(out[0]) == expect
+                c.barrier()
+
+        _par(T, worker)
+        return True
+
+    assert all(run_ranks(3, body))
+
+
+def test_multithreaded_rma():
+    T = 3
+
+    def body(comm):
+        from mvapich2_tpu.rma.win import LOCK_EXCLUSIVE
+        from mvapich2_tpu.core import op as opmod
+        wins = [comm.win_allocate(8 if comm.rank == 0 else 0)
+                for _ in range(T)]
+        comm.barrier()
+
+        def worker(tid):
+            w = wins[tid]
+            old = np.zeros(1, np.int64)
+            for _ in range(10):
+                w.lock(0, LOCK_EXCLUSIVE)
+                w.fetch_and_op(np.array([1], np.int64), old, 0, 0,
+                               op=opmod.SUM)
+                w.unlock(0)
+
+        _par(T, worker)
+        comm.barrier()
+        if comm.rank == 0:
+            for w in wins:
+                total = int(np.frombuffer(bytes(w.base[:8]), np.int64)[0])
+                assert total == comm.size * 10, total
+        comm.barrier()
+        return True
+
+    assert all(run_ranks(3, body))
+
+
+def test_grequest():
+    def body(comm):
+        seen = {}
+
+        def query(st):
+            st.count = 42
+            seen["queried"] = True
+
+        req = grequest_start(query_fn=query, free_fn=lambda: None)
+        assert not req.test()
+
+        def completer():
+            req.complete()
+
+        t = threading.Thread(target=completer)
+        t.start()
+        st = req.wait()
+        t.join()
+        assert st.count == 42 and seen.get("queried")
+        return True
+
+    assert all(run_ranks(2, body))
+
+
+def test_pack_unpack_roundtrip():
+    from mvapich2_tpu import mpi
+    from mvapich2_tpu.core import datatype as dt
+
+    def body(comm):
+        src = np.arange(10, dtype=np.int32)
+        buf = np.zeros(256, np.uint8)
+        pos = mpi.Pack(src, 10, dt.INT, buf, 0)
+        pos = mpi.Pack(np.array([2.5, 3.5]), 2, dt.DOUBLE, buf, pos)
+        assert pos == 40 + 16
+        out_i = np.zeros(10, np.int32)
+        out_d = np.zeros(2, np.float64)
+        p2 = mpi.Unpack(buf, 0, out_i, 10, dt.INT)
+        p2 = mpi.Unpack(buf, p2, out_d, 2, dt.DOUBLE)
+        assert (out_i == src).all() and out_d[1] == 3.5
+        assert mpi.Pack_size(10, dt.INT) == 40
+        return True
+
+    assert all(run_ranks(1, body))
