@@ -1,0 +1,51 @@
+"""Elastic recovery: rebuild a world after process failures.
+
+The TPU-native answer to the reference's process-migration machinery
+(SURVEY §5.3: FTB CR_FTB_MIGRATE events + mpirun_ckpt.c + mv2_trigger —
+move a rank's process image between nodes). Process images don't migrate
+on a TPU pod; the idiomatic recovery is elastic reconstruction:
+
+    failure detected (launcher event / transport error, ft/ulfm.py)
+      -> MPIX_Comm_revoke + shrink          (survivors agree on the dead)
+      -> MPI_Comm_spawn replacements        (runtime/spawn.py)
+      -> MPI_Intercomm_merge                (survivors first, stable order)
+      -> application state restore          (SCR-style ckpt subsystem —
+         single-loss XOR rebuild, ckpt/redundancy.py — or app-level bcast)
+
+`rebuild_world` packages the middle three steps.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+from ..core.comm import Comm
+from ..utils.mlog import get_logger
+
+log = get_logger("elastic")
+
+
+def rebuild_world(comm: Comm,
+                  command: Union[str, Sequence[str], Callable],
+                  args: Sequence[str] = (),
+                  info=None) -> Tuple[Comm, int]:
+    """Collective over the survivors of ``comm`` (call after failures are
+    detected; revokes ``comm`` if not already revoked). Returns
+    ``(newcomm, nreplaced)`` where newcomm spans survivors (low ranks,
+    original order) + freshly spawned replacements (high ranks).
+    Replacement processes reach the same comm via
+    ``Comm_get_parent().merge(high=True)``."""
+    from ..runtime.spawn import comm_spawn
+    if not comm.revoked:
+        comm.revoke()
+    shrunk = comm.shrink()
+    lost = comm.size - shrunk.size
+    if lost == 0:
+        log.info("rebuild_world: no failures; returning shrunk dup")
+        return shrunk, 0
+    log.info("rebuild_world: %d lost; spawning replacements", lost)
+    inter, errcodes = comm_spawn(shrunk, command, args, maxprocs=lost,
+                                 root=0, info=info)
+    merged = inter.merge(high=False)
+    merged.set_name("rebuilt_world")
+    return merged, lost

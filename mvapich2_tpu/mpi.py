@@ -68,9 +68,15 @@ def Finalize() -> None:
     u = _uni.current_universe()
     if u is None:
         return
-    # quiesce: complete outstanding traffic before teardown
-    if u.comm_world is not None and u.world_size > 1 and not u.finalized:
-        u.comm_world.barrier()
+    # quiesce: complete outstanding traffic before teardown. A revoked
+    # world (post-failure, ULFM) cannot barrier — and must still finalize
+    # (MPI_Finalize is required to succeed after revoke+shrink recovery).
+    if u.comm_world is not None and u.world_size > 1 and not u.finalized \
+            and not u.comm_world.revoked:
+        try:
+            u.comm_world.barrier()
+        except MPIException:
+            pass   # failed peers: quiesce best-effort
     u.finalize()
 
 

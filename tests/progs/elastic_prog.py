@@ -1,0 +1,53 @@
+"""Elastic-recovery smoke (SURVEY §5.3 migration analog): rank 1 dies;
+survivors shrink + spawn a replacement + merge; state is restored to the
+newcomer; prints 'No Errors'. Run under: mpirun --ft -np 3."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from mvapich2_tpu import mpi  # noqa: E402
+from mvapich2_tpu.ft.elastic import rebuild_world  # noqa: E402
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+me = os.path.abspath(__file__)
+
+parent = mpi.Comm_get_parent()
+if parent is not None:
+    # replacement incarnation: join the rebuilt world, receive state
+    merged = parent.merge(high=True)
+    state = np.zeros(4, np.float64)
+    merged.bcast(state, root=0)
+    assert state[0] == 123.0, state
+    out = merged.allreduce(np.ones(1))
+    assert int(out[0]) == merged.size
+    merged.barrier()
+    mpi.Finalize()
+    sys.exit(0)
+
+state = np.array([123.0, 4.0, 5.0, 6.0])   # application state to survive
+
+if comm.rank == 1:
+    os.kill(os.getpid(), 9)                # process failure (die.c analog)
+
+# survivors: wait for launcher-driven detection (SURVEY §5.3)
+for _ in range(600):
+    if comm.get_failed().size > 0:
+        break
+    time.sleep(0.05)
+assert comm.get_failed().size == 1, "failure not detected"
+
+merged, lost = rebuild_world(comm, [sys.executable, me])
+assert lost == 1 and merged.size == comm.size, (lost, merged.size)
+merged.bcast(state, root=0)                # restore state to the newcomer
+out = merged.allreduce(np.ones(1))
+assert int(out[0]) == merged.size
+merged.barrier()
+if merged.rank == 0:
+    print("No Errors")
+mpi.Finalize()
+sys.exit(0)

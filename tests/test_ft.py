@@ -243,3 +243,15 @@ def test_mpirun_ft_end_to_end():
                        timeout=120)
     assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
     assert "No Errors" in r.stdout
+
+
+def test_elastic_rebuild_world():
+    """SURVEY §5.3 migration analog: kill a rank, shrink, spawn a
+    replacement, merge, restore state (ft/elastic.py)."""
+    prog = os.path.join(REPO, "tests", "progs", "elastic_prog.py")
+    cmd = [sys.executable, "-m", "mvapich2_tpu.run", "--ft", "-np", "3",
+           sys.executable, prog]
+    r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                       timeout=240)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
