@@ -58,11 +58,15 @@ def bridge_agree(local_comm: Comm, leader: int, exchange) -> dict:
     if local_comm.rank == leader:
         try:
             hdr = exchange(int(lmax[0]))
-        except MPIException as e:
-            # propagate uniformly: a leader-side failure must not leave
-            # the other ranks blocked in the bcast below
-            hdr = {"ctx": int(lmax[0]), "error": str(e),
-                   "eclass": e.error_class}
+        except Exception as e:
+            # propagate uniformly: ANY leader-side failure (MPIException,
+            # but also socket/OS errors out of the KVS/TCP channels or a
+            # failed spawn) must not leave the other ranks blocked in the
+            # bcast below
+            eclass = getattr(e, "error_class", MPI_ERR_OTHER)
+            hdr = {"ctx": int(lmax[0]),
+                   "error": f"{type(e).__name__}: {e}",
+                   "eclass": eclass}
     hdr = bcast_json(local_comm, hdr, leader)
     u._next_ctx = max(u._next_ctx, int(hdr["ctx"]) + 2)
     if hdr.get("error"):

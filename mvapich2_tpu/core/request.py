@@ -196,10 +196,15 @@ class Grequest(Request):
         super().complete(error)
 
     def cancel(self) -> None:
+        # MPI-3.1 §12.2: cancel_fn is invoked unconditionally, with
+        # complete=true when the request has already completed (the
+        # cancel then has no effect on the request's state)
         if self.complete_flag:
+            if self._user_cancel_fn is not None:
+                self._user_cancel_fn(True)
             return
         if self._user_cancel_fn is not None:
-            self._user_cancel_fn(not self.complete_flag)
+            self._user_cancel_fn(False)
         self.cancelled = True
         self.status.cancelled = True
         super().complete(None)

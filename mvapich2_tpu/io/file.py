@@ -179,17 +179,30 @@ class File:
         return Status(count=n)
 
     # -- individual file pointer ---------------------------------------
-    def _advance(self, nbytes: int) -> int:
+    def _advance(self, nbytes: int, reading: bool = False) -> int:
+        """Atomically reserve [pos, pos+nbytes) and return the old pos.
+
+        For reads the advance is clamped to the last whole-etype boundary
+        of the view's stream so a short read at EOF leaves the pointer
+        after the last etype actually read (MPI-3.1 §13.4.3), not past it
+        into a hole — and a drain loop sees count 0 at EOF.
+        """
         with self._lock:
             old = self._pos
-            self._pos += nbytes
+            new = self._pos + nbytes
+            if reading:
+                es = max(self.view.etype.size, 1)
+                end = self.view.stream_size_to(self.fh.size())
+                end -= end % es
+                new = min(new, max(end, old))
+            self._pos = new
         return old
 
     def read(self, buf, count: Optional[int] = None,
              datatype: Optional[Datatype] = None) -> Status:
         self._check(writing=False)
         count, datatype = _resolve(buf, count, datatype)
-        old = self._advance(count * datatype.size)
+        old = self._advance(count * datatype.size, reading=True)
         return self.read_at(self._etypes(old), buf, count, datatype)
 
     def write(self, buf, count: Optional[int] = None,
@@ -238,7 +251,7 @@ class File:
                  datatype: Optional[Datatype] = None) -> Status:
         self._check(writing=False)
         count, datatype = _resolve(buf, count, datatype)
-        old = self._advance(count * datatype.size)
+        old = self._advance(count * datatype.size, reading=True)
         return self.read_at_all(self._etypes(old), buf, count, datatype)
 
     def write_all(self, buf, count: Optional[int] = None,
@@ -367,11 +380,29 @@ class File:
         self._sp_win.unlock(0)
         return int(old[0])
 
+    def _shared_advance_read(self, nbytes: int) -> int:
+        """Shared-pointer advance clamped to the last whole-etype boundary
+        of the stream (EOF): a short read must leave the pointer after the
+        last etype read, and a multi-rank drain loop must observe EOF."""
+        from ..rma.win import LOCK_EXCLUSIVE
+        es = max(self.view.etype.size, 1)
+        end = self.view.stream_size_to(self.fh.size())
+        end -= end % es
+        cur = np.zeros(1, np.int64)
+        self._sp_win.lock(0, LOCK_EXCLUSIVE)
+        self._sp_win.get(cur, 0, 0)
+        self._sp_win.flush(0)
+        old = int(cur[0])
+        new = min(old + nbytes, max(end, old))
+        self._sp_win.put(np.array([new], np.int64), 0, 0)
+        self._sp_win.unlock(0)
+        return old
+
     def read_shared(self, buf, count: Optional[int] = None,
                     datatype: Optional[Datatype] = None) -> Status:
         self._check(writing=False)
         count, datatype = _resolve(buf, count, datatype)
-        old = self._shared_fetch_add(count * datatype.size)
+        old = self._shared_advance_read(count * datatype.size)
         return self.read_at(self._etypes(old), buf, count, datatype)
 
     def write_shared(self, buf, count: Optional[int] = None,
@@ -449,6 +480,10 @@ class File:
     def iread(self, buf, count=None, datatype=None) -> Request:
         self._check(writing=False)
         count, datatype = _resolve(buf, count, datatype)
+        # no EOF clamp for nonblocking ops: the short-read amount is
+        # unknowable at issue time, and an outstanding iwrite may extend
+        # the file before this read executes — the pointer advances by
+        # the full request (standard practice for i-ops)
         old = self._advance(count * datatype.size)
         return self._async(self.read_at, self._etypes(old), buf, count,
                            datatype)
@@ -463,6 +498,7 @@ class File:
     def iread_shared(self, buf, count=None, datatype=None) -> Request:
         self._check(writing=False)
         count, datatype = _resolve(buf, count, datatype)
+        # full advance, no EOF clamp — see iread
         old = self._shared_fetch_add(count * datatype.size)
         return self._async(self.read_at, self._etypes(old), buf, count,
                            datatype)

@@ -56,13 +56,24 @@ def _load_native():
         return _lib
     _lib_tried = True
     so = os.path.join(_REPO, "native", "libshmring.so")
-    if not os.path.exists(so):
-        try:
-            subprocess.run(["make", "-C", os.path.join(_REPO, "native")],
-                           capture_output=True, timeout=120, check=True)
-        except Exception as e:
+    # always run make (no-op when fresh): an existence check would keep
+    # loading a stale .so after shmring.cpp edits. fcntl.flock serializes
+    # co-launched ranks racing on the shared build target.
+    try:
+        import fcntl
+        native_dir = os.path.join(_REPO, "native")
+        with open(os.path.join(native_dir, ".build.lock"), "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                subprocess.run(["make", "-C", native_dir, "libshmring.so"],
+                               capture_output=True, timeout=120, check=True)
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
+    except Exception as e:
+        if not os.path.exists(so):
             log.warn("native shmring build failed (%s); python fallback", e)
             return None
+        log.warn("shmring rebuild failed (%s); using existing .so", e)
     try:
         lib = ctypes.CDLL(so)
         lib.sr_attach.restype = ctypes.c_void_p
