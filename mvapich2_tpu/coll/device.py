@@ -1,0 +1,444 @@
+"""The ICI device-collective channel — XLA collectives behind the MPI seam.
+
+This is the analog of the mrail channel installing tuned collectives
+per-communicator in init_MV2_collops (reference:
+src/mpid/ch3/channels/mrail/src/rdma/ch3i_comm.c:27-100): a mesh-bound
+``Comm`` gets its ``coll_fns`` entries overwritten with wrappers that
+dispatch to the XLA-native ops (ops/collectives.py) when the tuning layer
+selects the device transport, and fall back to the host algorithm zoo
+otherwise.
+
+Execution model (TPU-first): MPI ranks are bound 1:1 to the devices of a
+1-D ``jax.sharding.Mesh``. A collective call is executed *once* as a jitted
+``shard_map`` program over the mesh — each rank deposits its local shard at
+a rendezvous, the lowest rank runs the XLA op (which lowers to ICI
+ring/tree collectives in one fused program), and every rank picks up its
+output shard. This is exactly how a single-controller JAX job drives a TPU
+pod slice; on a multi-controller (multi-host) job the same ops run under
+``jax.distributed`` with each host contributing its local shards.
+
+The rendezvous requires all bound ranks to share one process (rank threads
+— the virtual-pod harness, ``mpirun --vpod``) or one jax.distributed
+runtime; process-mode ranks without either keep the host path (the install
+is a no-op, logged).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils.config import cvar, get_config
+from ..utils.mlog import get_logger
+
+log = get_logger("device_coll")
+
+cvar("USE_DEVICE_COLL", True, bool, "coll",
+     "Enable the ICI device-collective channel on mesh-bound comms "
+     "(analog of MV2_USE_RDMA_COLL-style channel toggles).")
+cvar("DEVICE_COLL_MIN_BYTES", 16384, int, "coll",
+     "Host->device transport crossover: host-buffer collectives below "
+     "this size keep the host path (device dispatch has fixed "
+     "rendezvous+dispatch overhead). Device-resident buffers always take "
+     "the device path. Measured profiles override this.")
+
+def is_device_array(buf) -> bool:
+    """True for jax Arrays without importing jax (host-only rank processes
+    must never pull in the accelerator runtime)."""
+    return type(buf).__module__.split(".")[0] in ("jax", "jaxlib")
+
+
+def _op_name(op) -> Optional[str]:
+    """Map a core.op builtin to an XLA reduction name (None = no analog)."""
+    from ..core import op as opmod
+    table = {id(opmod.SUM): "sum", id(opmod.MAX): "max",
+             id(opmod.MIN): "min", id(opmod.PROD): "prod"}
+    return table.get(id(op))
+
+
+def _dtype_lowers(dtype: np.dtype) -> bool:
+    """True when the dtype round-trips through the device unchanged.
+    With jax x64 disabled, 64-bit types would be silently downcast —
+    wrong answers, so they stay on the host path."""
+    import jax
+    if dtype.itemsize == 8 and not jax.config.jax_enable_x64:
+        return False
+    return dtype.kind in "fiu"
+
+
+class _Rendezvous:
+    """Per-bound-comm meeting point: slots for each rank's shard, two
+    barrier phases per collective (deposit -> leader compute -> pickup).
+    MPI already requires every rank to issue collectives on a comm in the
+    same order, so one in-flight collective per comm is the contract."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self.barrier = threading.Barrier(size)
+        self.slots: List = [None] * size
+        self.result: List = [None] * size
+        self.error: Optional[BaseException] = None
+
+    def abort(self) -> None:
+        """Break the barrier so peers blocked in a device collective see
+        a failure instead of deadlocking (called when a rank dies)."""
+        self.barrier.abort()
+
+
+class DeviceCollChannel:
+    """One rank's handle on the mesh-bound collective engine."""
+
+    def __init__(self, mesh, axis: str, rendezvous: _Rendezvous, rank: int):
+        self.mesh = mesh
+        self.axis = axis
+        self.rv = rendezvous
+        self.rank = rank
+        devices = list(np.asarray(mesh.devices).reshape(-1))
+        self.device = devices[rank]
+        self.devices = devices
+        self.size = len(devices)
+        # per-instance program cache (a class-level lru_cache would pin
+        # freed channels + their compiled executables for process life)
+        self._programs: Dict = {}
+
+    def abort(self) -> None:
+        self.rv.abort()
+
+    # -- jitted program cache (per mesh, keyed by op signature) ----------
+    def _program(self, name: str, n: int, dtype_str: str, op: str,
+                 root: int):
+        key = (name, n, dtype_str, op, root)
+        got = self._programs.get(key)
+        if got is None:
+            got = self._programs[key] = self._build(name, n, op, root)
+        return got
+
+    def _build(self, name: str, n: int, op: str, root: int):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from .. import ops
+        from ..parallel.mesh import shard_map
+        axis, p = self.axis, self.size
+
+        if name in ("allreduce", "reduce"):
+            def f(x):                       # block [1, n]
+                return ops.allreduce(x, axis, op)
+            out_specs = P(None, None)       # replicated [1, n]
+        elif name == "bcast":
+            def f(x):
+                return ops.bcast(x, axis, root)
+            out_specs = P(None, None)
+        elif name == "allgather":
+            def f(x):
+                return ops.all_gather(x, axis, tiled=True, gather_axis=0)
+            out_specs = P(None, None)       # replicated [p, n]
+        elif name == "alltoall":
+            c = n // p
+
+            def f(x):                       # block [1, n] -> [p, c]
+                v = x.reshape(p, c)
+                return ops.all_to_all(v, axis, split_axis=0, concat_axis=0)
+            out_specs = P(axis, None)       # global [p*p, c]
+        elif name == "reduce_scatter_block":
+            c = n // p
+            if op == "sum":
+                def f(x):
+                    y = ops.reduce_scatter(x.reshape(n), axis,
+                                           scatter_dimension=0, tiled=True)
+                    return y.reshape(1, c)
+            else:
+                # non-sum ops: full allreduce then keep this shard's block
+                # (psum_scatter lowers natively only for sum)
+                from jax import lax
+
+                def f(x):
+                    y = ops.allreduce(x.reshape(n), axis, op)
+                    i = lax.axis_index(axis)
+                    return lax.dynamic_slice(y, (i * c,), (c,)).reshape(1, c)
+            out_specs = P(axis, None)       # global [p, c]
+        else:  # pragma: no cover
+            raise KeyError(name)
+
+        sm = shard_map(f, mesh=self.mesh, in_specs=(P(axis, None),),
+                       out_specs=out_specs, check_vma=False)
+        return jax.jit(sm)
+
+    # -- the rendezvous execution ----------------------------------------
+    def _execute(self, name: str, local: np.ndarray, op: str = "sum",
+                 root: int = 0):
+        """Run one device collective; ``local`` is this rank's shard
+        ([n] host numpy or device array). Returns this rank's result as
+        whatever the leader deposited (device array shard)."""
+        import jax
+
+        rv = self.rv
+        rv.slots[self.rank] = local
+        try:
+            rv.barrier.wait()
+        except threading.BrokenBarrierError:
+            raise RuntimeError(
+                "device collective aborted: a peer rank failed") from None
+        if self.rank == 0:
+            try:
+                n = int(np.asarray(rv.slots[0]).shape[0]) \
+                    if not is_device_array(rv.slots[0]) \
+                    else int(rv.slots[0].shape[0])
+                dtype = np.dtype(rv.slots[0].dtype)
+                shards = []
+                for r in range(self.size):
+                    s = rv.slots[r]
+                    if is_device_array(s) and \
+                            s.devices() == {self.devices[r]}:
+                        shards.append(s.reshape(1, n))
+                    else:
+                        shards.append(jax.device_put(
+                            np.asarray(s).reshape(1, n), self.devices[r]))
+                from jax.sharding import (NamedSharding,
+                                          PartitionSpec as P)
+                global_arr = jax.make_array_from_single_device_arrays(
+                    (self.size, n),
+                    NamedSharding(self.mesh, P(self.axis, None)), shards)
+                out = self._program(name, n, str(dtype), op, root)(
+                    global_arr)
+                per_dev: Dict = {}
+                for s in out.addressable_shards:
+                    per_dev[s.device] = s.data
+                rv.error = None
+                rv.result = [per_dev[self.devices[r]]
+                             for r in range(self.size)]
+            except BaseException as e:   # noqa: BLE001 — must release peers
+                rv.error = e
+                rv.result = [None] * self.size
+        try:
+            rv.barrier.wait()
+        except threading.BrokenBarrierError:
+            raise RuntimeError(
+                "device collective aborted: a peer rank failed") from None
+        if rv.error is not None:
+            raise RuntimeError(
+                f"device collective {name} failed on the leader"
+            ) from rv.error
+        res = rv.result[self.rank]
+        rv.slots[self.rank] = None
+        return res
+
+    # -- MPI-shaped entry points (match coll_fns signatures) -------------
+    def allreduce(self, comm, sendbuf, recvbuf, count, datatype, op):
+        local = _as_local(sendbuf, recvbuf, count)
+        out = self._execute("allreduce", local, op=_op_name(op))
+        return _deliver(out, recvbuf)
+
+    def reduce(self, comm, sendbuf, recvbuf, count, datatype, op, root):
+        local = _as_local(sendbuf, recvbuf, count)
+        out = self._execute("reduce", local, op=_op_name(op))
+        if comm.rank != root:
+            return None
+        return _deliver(out, recvbuf)
+
+    def bcast(self, comm, buf, count, datatype, root):
+        out = self._execute("bcast", _as_local(buf, buf, count), root=root)
+        return _deliver(out, buf)
+
+    def allgather(self, comm, sendbuf, recvbuf, count, datatype):
+        local = _as_local(sendbuf, recvbuf, count,
+                          in_place_start=comm.rank * count)
+        out = self._execute("allgather", local)
+        return _deliver(out, recvbuf)
+
+    def alltoall(self, comm, sendbuf, recvbuf, count, datatype):
+        local = _as_local(sendbuf, recvbuf, count * comm.size)
+        out = self._execute("alltoall", local)
+        return _deliver(out, recvbuf)
+
+    def reduce_scatter_block(self, comm, sendbuf, recvbuf, count, datatype,
+                             op):
+        local = _as_local(sendbuf, recvbuf, count * comm.size)
+        out = self._execute("reduce_scatter_block", local, op=_op_name(op))
+        return _deliver(out, recvbuf)
+
+
+def _as_local(sendbuf, recvbuf, count: int, in_place_start: int = 0):
+    """This rank's contribution as a flat [count] array (device or host).
+    MPI_IN_PLACE reads from recvbuf; ``in_place_start`` selects the
+    rank's chunk (allgather-style in-place semantics)."""
+    buf = sendbuf
+    start = 0
+    if type(sendbuf).__name__ == "_InPlace":
+        buf = recvbuf
+        start = in_place_start
+    if is_device_array(buf):
+        return buf.reshape(-1)[start:start + count]
+    return np.ascontiguousarray(
+        np.asarray(buf).reshape(-1)[start:start + count])
+
+
+def _deliver(out, recvbuf):
+    """Write the device result into a host recvbuf (host-staged mode) or
+    hand the flat device array back (device-resident mode — the comm
+    methods return it to the caller)."""
+    if recvbuf is None or is_device_array(recvbuf) \
+            or type(recvbuf).__name__ == "_InPlace":
+        return out.reshape(-1)
+    host = np.asarray(out).reshape(-1)
+    dst = np.asarray(recvbuf)
+    if dst.size == host.size:
+        # copyto writes through views, including non-contiguous ones
+        # (a flat reshape of a strided view would silently copy)
+        np.copyto(dst, host.reshape(dst.shape))
+    else:
+        if not dst.flags.c_contiguous:
+            raise ValueError(
+                "device collective: non-contiguous recvbuf larger than "
+                "the result is not supported")
+        dst.reshape(-1)[:host.size] = host
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-comm install (the init_MV2_collops moment)
+# ---------------------------------------------------------------------------
+
+# wrapper name -> cvar prefix (reduce_scatter_block shares the
+# REDUCE_SCATTER override, matching the MPI-level collective family)
+_CVAR_OF = {"allreduce": "ALLREDUCE", "bcast": "BCAST",
+            "allgather": "ALLGATHER", "alltoall": "ALLTOALL",
+            "reduce": "REDUCE", "reduce_scatter_block": "REDUCE_SCATTER"}
+
+
+def _select_transport(comm, name: str, nbytes: int, op, buf) -> str:
+    """'device' or 'host' for this call — step 2 of the tuning order
+    (coll/tuning.py docstring). Note: the decision must be identical on
+    every rank of the call; all inputs (msg size, op, dtype, env) are
+    required-uniform by MPI except buffer residency, which therefore must
+    also be uniform across ranks (device arrays everywhere or nowhere)."""
+    cfg = get_config()
+    forced = cfg.get(f"{_CVAR_OF[name]}_ALGO", "")
+    lowers = ((op is None or _op_name(op) is not None)
+              and _dtype_ok(buf))
+    if forced == "device":
+        if not lowers:
+            log.warn("%s forced to device but op/dtype does not lower; "
+                     "using host path", name)
+            return "host"
+        return "device"
+    if forced:
+        return "host"          # a named host algorithm wins
+    if not cfg["USE_DEVICE_COLL"] or not lowers:
+        return "host"
+    if is_device_array(buf):
+        return "device"        # already resident: never stage through host
+    # host buffer: crossover (autotuner-overridable)
+    from .tuning import device_crossover
+    return "device" if nbytes >= device_crossover(name, comm) else "host"
+
+
+def _dtype_ok(buf) -> bool:
+    if not hasattr(buf, "dtype"):
+        return False
+    return _dtype_lowers(np.dtype(buf.dtype))
+
+
+def install_device_coll(comm, channel: DeviceCollChannel) -> None:
+    """Overwrite the device-capable entries of ``comm.coll_fns`` with
+    transport-selecting wrappers — the channel's init_MV2_collops moment
+    (ch3i_comm.c:27-100). The host entries installed by install_coll_ops
+    remain the fallback."""
+    from .tuning import install_coll_ops
+    if not comm.coll_fns:
+        install_coll_ops(comm)
+    host = dict(comm.coll_fns)
+    comm.device_channel = channel
+    sz = comm.size
+
+    # per-coll (bytes-on-the-wire, op-position, recv-count) metadata; the
+    # args tuple `a` excludes the leading comm (core/comm.py signatures)
+    meta = {
+        "allreduce": (lambda a: a[2] * a[3].size, 4, lambda a: a[2]),
+        "reduce": (lambda a: a[2] * a[3].size, 4, lambda a: a[2]),
+        "bcast": (lambda a: a[1] * a[2].size, None, lambda a: a[1]),
+        "allgather": (lambda a: a[2] * a[3].size * sz, None,
+                      lambda a: a[2] * sz),
+        "alltoall": (lambda a: a[2] * a[3].size * sz, None,
+                     lambda a: a[2] * sz),
+        "reduce_scatter_block": (lambda a: a[2] * a[3].size * sz, 4,
+                                 lambda a: a[2]),
+    }
+
+    def wrap(name):
+        hostfn = host[name]
+        devfn = getattr(channel, name)
+        nbytes_of, op_pos, out_count_of = meta[name]
+
+        def entry(comm_, *a):
+            buf = a[0]
+            op = a[op_pos] if op_pos is not None else None
+            if _select_transport(comm_, name, nbytes_of(a), op,
+                                 buf) == "device":
+                return devfn(comm_, *a)
+            # host path selected (forced algo / op or dtype doesn't lower):
+            # device-array buffers are staged through the host and the
+            # result pushed back to this rank's device
+            if name == "bcast":
+                if not is_device_array(a[0]):
+                    return hostfn(comm_, *a)
+                import jax
+                h = np.asarray(a[0])
+                hostfn(comm_, h, *a[1:])
+                return jax.device_put(h, channel.device)
+            send, recv = a[0], a[1]
+            if not (is_device_array(send) or is_device_array(recv)):
+                return hostfn(comm_, *a)
+            if type(send).__name__ == "_InPlace" and is_device_array(recv):
+                raise ValueError("MPI_IN_PLACE with a device recvbuf is "
+                                 "not supported on the host transport")
+            import jax
+            send_h = np.asarray(send) if is_device_array(send) else send
+            recv_h = recv
+            if recv_h is None or is_device_array(recv_h):
+                if name == "reduce" and comm_.rank != a[5]:
+                    recv_h = None
+                else:
+                    recv_h = np.empty((out_count_of(a),),
+                                      dtype=np.asarray(send_h).dtype)
+            hostfn(comm_, send_h, recv_h, *a[2:])
+            if recv_h is None:
+                return None
+            return jax.device_put(recv_h, channel.device)
+        return entry
+
+    for name in meta:
+        comm.coll_fns[name] = wrap(name)
+
+
+# ---------------------------------------------------------------------------
+# binding helpers (harness / launcher entry points)
+# ---------------------------------------------------------------------------
+
+def bind_universes(universes, mesh=None, axis: str = "x") -> bool:
+    """Bind each thread-rank universe's COMM_WORLD to the device mesh —
+    called by the in-process harness (run_ranks(device_mesh=...)) and the
+    --vpod launcher before rank threads start. Returns False (no-op) when
+    the mesh cannot cover the ranks."""
+    import jax
+
+    n = len(universes)
+    if mesh is None:
+        from ..parallel.mesh import make_mesh
+        devs = jax.devices()
+        if len(devs) < n:
+            log.warn("device mesh unavailable: %d ranks > %d devices; "
+                     "host path only", n, len(devs))
+            return False
+        mesh = make_mesh((n,), (axis,), devs[:n])
+    if int(np.prod(list(mesh.shape.values()))) != n:
+        log.warn("mesh shape %s does not match %d ranks; host path only",
+                 dict(mesh.shape), n)
+        return False
+    rv = _Rendezvous(n)
+    for r, u in enumerate(universes):
+        ch = DeviceCollChannel(mesh, axis, rv, r)
+        install_device_coll(u.comm_world, ch)
+    return True

@@ -9,10 +9,12 @@ and per-comm installation in init_MV2_collops (ch3i_comm.c:27-100).
 TPU-first redesign: tables are data (this module + optional JSON profiles
 emitted by the autotuner in mvapich2_tpu.mpit.autotune), keyed by the arch
 key from utils.detect (tpu generation × topology). Selection order:
-  1. MV2T_<COLL>_ALGO env override,
-  2. device (XLA/ICI) path when the comm is mesh-bound and the op lowers,
+  1. MV2T_<COLL>_ALGO env override ("device" forces the ICI path),
+  2. device (XLA/ICI) path when the comm is mesh-bound and the op lowers
+     — decided by coll/device.py's _select_transport wrappers installed
+     over these entries (install_device_coll), using device_crossover(),
   3. two-level hierarchy when the comm spans multiple nodes,
-  4. msg-size binned host algorithm.
+  4. msg-size binned host algorithm (select_algorithm below).
 """
 
 from __future__ import annotations
@@ -108,11 +110,29 @@ DEFAULT_TABLES: Dict[str, Dict[str, Table]] = {
 
 # runtime-measured overrides loaded from a profile (autotuner output)
 _PROFILE_TABLES: Dict[str, Dict[str, Table]] = {}
+# measured host->device transport crossovers (bytes) per collective
+_DEVICE_CROSSOVERS: Dict[str, int] = {}
 
 
-def load_profile(tables: Dict[str, Dict[str, Table]]) -> None:
-    """Install autotuned tables (analog of regenerating tuning headers)."""
-    _PROFILE_TABLES.update(tables)
+def load_profile(tables: Optional[Dict[str, Dict[str, Table]]] = None,
+                 device_crossovers: Optional[Dict[str, int]] = None) -> None:
+    """Install autotuned tables (analog of regenerating tuning headers).
+    Produced by mvapich2_tpu.mpit.autotune; see autotune.load_profile_file
+    for the JSON artifact form."""
+    if tables:
+        _PROFILE_TABLES.update(tables)
+    if device_crossovers:
+        _DEVICE_CROSSOVERS.update(device_crossovers)
+
+
+def device_crossover(name: str, comm) -> int:
+    """Bytes at which a host-buffer collective on a mesh-bound comm moves
+    to the device (XLA/ICI) transport. Measured profile wins; falls back
+    to the DEVICE_COLL_MIN_BYTES cvar."""
+    got = _DEVICE_CROSSOVERS.get(name)
+    if got is not None:
+        return got
+    return get_config()["DEVICE_COLL_MIN_BYTES"]
 
 
 def _size_class(comm) -> str:

@@ -30,15 +30,21 @@ def _is_in_place(buf) -> bool:
     return type(buf).__name__ == "_InPlace"
 
 
+def _is_device(buf) -> bool:
+    """jax Array check without importing jax (host-only ranks must never
+    pull in the accelerator runtime) — see coll/device.py."""
+    return type(buf).__module__.split(".")[0] in ("jax", "jaxlib")
+
+
 def _resolve(buf, count: Optional[int], datatype: Optional[Datatype],
              alt=None) -> Tuple[int, Datatype]:
-    """Infer (count, datatype) from a numpy buffer when not given.
+    """Infer (count, datatype) from a numpy/device buffer when not given.
     ``alt`` is the fallback buffer when ``buf`` is MPI_IN_PLACE."""
     if _is_in_place(buf):
         buf = alt
     if datatype is None:
-        if isinstance(buf, np.ndarray):
-            datatype = dtmod.from_numpy_dtype(buf.dtype)
+        if isinstance(buf, np.ndarray) or _is_device(buf):
+            datatype = dtmod.from_numpy_dtype(np.dtype(buf.dtype))
         elif isinstance(buf, (bytes, bytearray, memoryview)):
             datatype = dtmod.BYTE
         elif buf is None:
@@ -47,8 +53,8 @@ def _resolve(buf, count: Optional[int], datatype: Optional[Datatype],
             raise MPIException(MPI_ERR_COMM, f"cannot infer datatype "
                                f"for {type(buf)}")
     if count is None:
-        if isinstance(buf, np.ndarray):
-            count = buf.size
+        if isinstance(buf, np.ndarray) or _is_device(buf):
+            count = int(buf.size)
         elif buf is None:
             count = 0
         else:
@@ -80,6 +86,8 @@ class Comm:
         # device-mesh binding (ICI channel): set by parallel/mesh layer when
         # this comm maps onto a jax Mesh axis
         self.mesh_axis = None
+        # ICI collective channel (coll/device.py install_device_coll)
+        self.device_channel = None
         # revoke-packet routing + failure unwind need ctx -> comm
         universe.comms_by_ctx[context_id] = self
 
@@ -230,6 +238,21 @@ class Comm:
             install_coll_ops(self)
         return self.coll_fns[name]
 
+    def _stage_if_unbound(self, sendbuf, recvbuf):
+        """Device-array buffers on a comm with no device channel are
+        staged through the host (result comes back as numpy). A device
+        recvbuf cannot be written in place (jax arrays are immutable), so
+        it needs the mesh-bound path."""
+        if self.device_channel is not None:
+            return sendbuf, recvbuf
+        if _is_device(recvbuf):
+            raise MPIException(
+                MPI_ERR_COMM, "device-array recvbuf requires a mesh-bound "
+                "communicator (see coll/device.py)")
+        if _is_device(sendbuf):
+            sendbuf = np.asarray(sendbuf)
+        return sendbuf, recvbuf
+
     def barrier(self) -> None:
         self._check()
         self._coll("barrier")(self)
@@ -238,8 +261,11 @@ class Comm:
               datatype: Optional[Datatype] = None):
         self._check()
         count, datatype = _resolve(buf, count, datatype)
-        self._coll("bcast")(self, buf, count, datatype, root)
-        return buf
+        staged, _ = self._stage_if_unbound(buf, None)
+        ret = self._coll("bcast")(self, staged, count, datatype, root)
+        if ret is not None:
+            return ret
+        return staged if staged is not buf else buf
 
     def reduce(self, sendbuf, recvbuf=None, op=None, root: int = 0,
                count: Optional[int] = None,
@@ -248,10 +274,12 @@ class Comm:
         from . import op as opmod
         op = op or opmod.SUM
         count, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
-        if recvbuf is None and self.rank == root:
+        sendbuf, recvbuf = self._stage_if_unbound(sendbuf, recvbuf)
+        if recvbuf is None and self.rank == root and not _is_device(sendbuf):
             recvbuf = np.empty_like(np.asarray(sendbuf))
-        self._coll("reduce")(self, sendbuf, recvbuf, count, datatype, op, root)
-        return recvbuf
+        ret = self._coll("reduce")(self, sendbuf, recvbuf, count, datatype,
+                                   op, root)
+        return ret if ret is not None else recvbuf
 
     def allreduce(self, sendbuf, recvbuf=None, op=None,
                   count: Optional[int] = None,
@@ -260,20 +288,23 @@ class Comm:
         from . import op as opmod
         op = op or opmod.SUM
         count, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
-        if recvbuf is None:
+        sendbuf, recvbuf = self._stage_if_unbound(sendbuf, recvbuf)
+        if recvbuf is None and not _is_device(sendbuf):
             recvbuf = np.empty_like(np.asarray(sendbuf))
-        self._coll("allreduce")(self, sendbuf, recvbuf, count, datatype, op)
-        return recvbuf
+        ret = self._coll("allreduce")(self, sendbuf, recvbuf, count,
+                                      datatype, op)
+        return ret if ret is not None else recvbuf
 
     def allgather(self, sendbuf, recvbuf=None, count: Optional[int] = None,
                   datatype: Optional[Datatype] = None):
         self._check()
         count, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
-        if recvbuf is None:
+        sendbuf, recvbuf = self._stage_if_unbound(sendbuf, recvbuf)
+        if recvbuf is None and not _is_device(sendbuf):
             sb = np.asarray(sendbuf)
             recvbuf = np.empty((self.size * count,), dtype=sb.dtype)
-        self._coll("allgather")(self, sendbuf, recvbuf, count, datatype)
-        return recvbuf
+        ret = self._coll("allgather")(self, sendbuf, recvbuf, count, datatype)
+        return ret if ret is not None else recvbuf
 
     def gather(self, sendbuf, recvbuf=None, root: int = 0,
                count: Optional[int] = None,
@@ -298,13 +329,14 @@ class Comm:
                  datatype: Optional[Datatype] = None):
         self._check()
         if count is None:
-            sb = np.asarray(recvbuf if _is_in_place(sendbuf) else sendbuf)
-            count = sb.size // self.size
+            sb = recvbuf if _is_in_place(sendbuf) else sendbuf
+            count = int(getattr(sb, "size", 0) or len(sb)) // self.size
         _, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
-        if recvbuf is None:
+        sendbuf, recvbuf = self._stage_if_unbound(sendbuf, recvbuf)
+        if recvbuf is None and not _is_device(sendbuf):
             recvbuf = np.empty_like(np.asarray(sendbuf))
-        self._coll("alltoall")(self, sendbuf, recvbuf, count, datatype)
-        return recvbuf
+        ret = self._coll("alltoall")(self, sendbuf, recvbuf, count, datatype)
+        return ret if ret is not None else recvbuf
 
     def reduce_scatter_block(self, sendbuf, recvbuf=None, op=None,
                              count: Optional[int] = None,
@@ -313,15 +345,16 @@ class Comm:
         from . import op as opmod
         op = op or opmod.SUM
         if count is None:
-            count = np.asarray(recvbuf if _is_in_place(sendbuf)
-                               else sendbuf).size // self.size
+            sb = recvbuf if _is_in_place(sendbuf) else sendbuf
+            count = int(getattr(sb, "size", 0) or len(sb)) // self.size
         _, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
-        if recvbuf is None:
+        sendbuf, recvbuf = self._stage_if_unbound(sendbuf, recvbuf)
+        if recvbuf is None and not _is_device(sendbuf):
             sb = np.asarray(sendbuf)
             recvbuf = np.empty((count,), dtype=sb.dtype)
-        self._coll("reduce_scatter_block")(self, sendbuf, recvbuf, count,
-                                           datatype, op)
-        return recvbuf
+        ret = self._coll("reduce_scatter_block")(self, sendbuf, recvbuf,
+                                                 count, datatype, op)
+        return ret if ret is not None else recvbuf
 
     def scan(self, sendbuf, recvbuf=None, op=None,
              count: Optional[int] = None,

@@ -57,7 +57,9 @@ def allreduce(x, axis: AxisName, op: str = "sum"):
     if op == "min":
         return lax.pmin(x, axis)
     if op == "prod":
-        return jnp.exp(lax.psum(jnp.log(x), axis))  # positive-domain prod
+        # exact product (ints, zeros, negatives): gather the axis and
+        # reduce locally — log/exp tricks are positive-float-only
+        return jnp.prod(lax.all_gather(x, axis), axis=0)
     if op == "mean":
         return lax.pmean(x, axis)
     raise ValueError(f"unsupported device op {op!r}")

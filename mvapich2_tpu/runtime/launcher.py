@@ -100,6 +100,91 @@ def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
         srv.shutdown()
 
 
+def launch_vpod(nranks: int, argv: List[str],
+                timeout: Optional[float] = None) -> int:
+    """Virtual-pod mode: N rank *threads* in one process, COMM_WORLD bound
+    1:1 to an N-device jax mesh, so collectives take the ICI device path
+    (coll/device.py). This is the single-controller execution model of a
+    TPU pod slice; on a short host the launcher re-execs itself onto a
+    virtual N-device CPU mesh (the test-suite recipe).
+
+    ``argv`` must be a python program (leading interpreter token is
+    stripped); it runs per rank thread with mpi.Init() resolving to the
+    thread's pre-bound universe."""
+    prog = list(argv)
+    if prog and os.path.basename(prog[0]).startswith("python"):
+        prog = prog[1:]
+    if not prog:
+        print("mpirun --vpod: need a python script", file=sys.stderr)
+        return 2
+
+    # Default: a virtual nranks-device CPU mesh (re-exec with the forced
+    # env; never queries the accelerator runtime from the parent — a
+    # remote TPU tunnel may be single-client or slow). MV2T_VPOD_REAL=1
+    # opts into the host's real devices instead.
+    if not os.environ.get("MV2T_VPOD_CHILD") \
+            and not os.environ.get("MV2T_VPOD_REAL"):
+        import re
+        env = dict(os.environ)
+        env["MV2T_VPOD_CHILD"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       env.get("XLA_FLAGS", ""))
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={nranks}"
+        ).strip()
+        cmd = [sys.executable, "-m", "mvapich2_tpu.run", "-np", str(nranks),
+               "--vpod"] + (["--timeout", str(timeout)] if timeout else []) \
+            + argv
+        return subprocess.run(cmd, env=env).returncode
+
+    import jax
+    if os.environ.get("MV2T_VPOD_CHILD"):
+        jax.config.update("jax_platforms", "cpu")   # sitecustomize guard
+    if len(jax.devices()) < nranks:
+        print(f"mpirun --vpod: need {nranks} devices, have "
+              f"{len(jax.devices())}", file=sys.stderr)
+        return 1
+
+    import runpy
+    import traceback
+
+    from .universe import local_universe, set_universe
+    universes = local_universe(nranks, device_mesh=True)
+    sys.argv = prog
+    codes: List[int] = [0] * nranks
+
+    def body(r: int) -> None:
+        set_universe(universes[r])
+        try:
+            runpy.run_path(prog[0], run_name="__main__")
+        except SystemExit as e:
+            codes[r] = int(e.code or 0) if not isinstance(e.code, str) else 1
+        except BaseException:   # noqa: BLE001 — rank error = job error
+            traceback.print_exc()
+            codes[r] = 1
+            ch = getattr(universes[r].comm_world, "device_channel", None)
+            if ch is not None:
+                ch.abort()   # break the device-collective rendezvous
+            for u in universes:
+                u.engine.wakeup()
+        finally:
+            set_universe(None)
+
+    threads = [threading.Thread(target=body, args=(r,), daemon=True,
+                                name=f"vpod-rank-{r}")
+               for r in range(nranks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        if t.is_alive():
+            print(f"mpirun --vpod: {t.name} hung past {timeout}s",
+                  file=sys.stderr)
+            return 1
+    return max(codes)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="mpirun",
@@ -111,11 +196,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--ft", "--disable-auto-cleanup", action="store_true",
                     dest="ft", help="fault-tolerant mode: dead ranks become "
                     "failure events instead of killing the job (ULFM)")
+    ap.add_argument("--vpod", action="store_true",
+                    help="virtual-pod mode: rank threads bound to a device "
+                         "mesh; collectives take the XLA/ICI path")
     ap.add_argument("--timeout", type=float, default=None)
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if not args.command:
         ap.error("no command given")
+    if args.vpod:
+        return launch_vpod(args.np, args.command, timeout=args.timeout)
     fake = None
     if args.fake_nodes:
         fake = [int(x) for x in args.fake_nodes.split(",")]

@@ -194,12 +194,15 @@ def current_universe() -> Optional[Universe]:
 # in-process harness
 # ---------------------------------------------------------------------------
 
-def local_universe(nranks: int, nodes: Optional[Sequence[int]] = None
-                   ) -> List[Universe]:
+def local_universe(nranks: int, nodes: Optional[Sequence[int]] = None,
+                   device_mesh=None) -> List[Universe]:
     """Build ``nranks`` thread-rank universes over one LocalFabric.
 
     ``nodes`` optionally assigns a fake node id per rank so node-aware
-    (2-level) paths can be exercised without multiple hosts."""
+    (2-level) paths can be exercised without multiple hosts.
+    ``device_mesh``: True binds each rank's COMM_WORLD to a device of a
+    1-D jax mesh over the visible devices (the ICI collective channel,
+    coll/device.py); pass a Mesh to bind to it explicitly."""
     fabric = LocalFabric(nranks)
     universes = []
     for r in range(nranks):
@@ -212,16 +215,20 @@ def local_universe(nranks: int, nodes: Optional[Sequence[int]] = None
         universes.append(u)
     for u in universes:
         u.initialize()
+    if device_mesh is not None and device_mesh is not False:
+        from ..coll.device import bind_universes
+        mesh = None if device_mesh is True else device_mesh
+        bind_universes(universes, mesh)
     return universes
 
 
 def run_ranks(nranks: int, fn: Callable, *args,
               nodes: Optional[Sequence[int]] = None,
-              timeout: float = 120.0) -> List:
+              timeout: float = 120.0, device_mesh=None) -> List:
     """Run ``fn(comm_world, *args)`` on every rank (threads); return the
     per-rank results. Any rank's exception is re-raised with its rank noted.
     This is the in-process testing harness for the MPICH-style corpus."""
-    universes = local_universe(nranks, nodes)
+    universes = local_universe(nranks, nodes, device_mesh=device_mesh)
     results: List = [None] * nranks
     errors: List = [None] * nranks
 
@@ -232,6 +239,9 @@ def run_ranks(nranks: int, fn: Callable, *args,
         except BaseException as e:  # noqa: BLE001
             errors[r] = e
             # wake peers stuck waiting on us
+            ch = getattr(universes[r].comm_world, "device_channel", None)
+            if ch is not None:
+                ch.abort()   # break the device-collective rendezvous
             for u in universes:
                 u.engine.wakeup()
         finally:
