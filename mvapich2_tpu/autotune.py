@@ -1,0 +1,278 @@
+"""Collective autotuner — measured tuning tables (mpit.autotune).
+
+The reference ships 1,377 pre-generated per-(arch × HCA × ppn) tuning
+headers (src/mpi/coll/tuning/, 284,869 LoC) produced by offline OSU runs
+on named clusters. The TPU-first replacement measures on the machine at
+hand and emits a small JSON profile:
+
+  * per collective × comm-size-class × msg-size bin: the fastest host
+    algorithm (replacing the guessed DEFAULT_TABLES rows), and
+  * per collective: the host->device transport crossover in bytes (the
+    point where the XLA/ICI path beats every host algorithm) consumed by
+    coll/device.py's per-call selection.
+
+Artifacts are keyed by utils.detect.arch_key() (tpu generation ×
+topology — the mv2_arch_hca_type analog) and auto-loaded by
+load_default_profile() when a matching file exists under
+mvapich2_tpu/profiles/.
+
+CLI (the "generate a tuning header" moment):
+    python -m mvapich2_tpu.autotune -np 8 -o mvapich2_tpu/profiles/auto.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .utils.mlog import get_logger
+
+log = get_logger("autotune")
+
+PROFILE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "profiles")
+
+# msg-size sweep for table bins (bytes); bins close at these bounds
+SIZES = [1024, 4096, 16384, 65536, 262144, 1048576]
+_DTYPE = np.float32
+# crossover sentinel: the device transport never beat the host at any
+# measured size — effectively "never cross over"
+NEVER_CROSS = 1 << 62
+
+
+def _time_call(comm, fn, reps: int, warm: int = 2) -> float:
+    """Max-over-ranks median time of ``fn()`` — every rank times, the comm
+    agrees on the slowest rank (the OSU avg/min/max discipline, reduced to
+    the scheduling-relevant number)."""
+    from .core import op as opmod
+    for _ in range(warm):
+        fn()
+    ts = []
+    for _ in range(reps):
+        comm.barrier()
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    med = np.array([ts[len(ts) // 2]], np.float64)
+    out = np.zeros_like(med)
+    comm.allreduce(med, out, op=opmod.MAX)
+    return float(out[0])
+
+
+def _host_candidates(name: str) -> Dict[str, object]:
+    from .coll.tuning import ALGOS
+    return ALGOS[name]
+
+
+def _msg_elems(comm, nbytes: int) -> int:
+    """Element count: a multiple of comm.size (block collectives)."""
+    n = max(nbytes // np.dtype(_DTYPE).itemsize, comm.size)
+    return n - n % comm.size
+
+
+def _run_host_algo(comm, name: str, algo_fn, nbytes: int) -> None:
+    """Invoke one host algorithm directly, bypassing selection — the
+    signatures are coll/algorithms.py's raw forms (arr/op/root/tag)."""
+    from .core import op as opmod
+    n = _msg_elems(comm, nbytes)
+    tag = comm.next_coll_tag()
+    if name == "allreduce":
+        algo_fn(comm, np.ones(n, _DTYPE), opmod.SUM, tag)
+    elif name == "bcast":
+        algo_fn(comm, np.ones(n, _DTYPE), 0, tag)
+    elif name == "allgather":
+        c = n // comm.size
+        algo_fn(comm, np.ones(c, _DTYPE), np.empty(n, _DTYPE), tag)
+    elif name == "alltoall":
+        algo_fn(comm, np.ones(n, _DTYPE), np.empty(n, _DTYPE), tag)
+    elif name == "reduce":
+        algo_fn(comm, np.ones(n, _DTYPE), opmod.SUM, 0, tag)
+    elif name == "barrier":
+        algo_fn(comm, tag)
+    else:
+        raise KeyError(name)
+
+
+def _run_device(comm, name: str, nbytes: int) -> None:
+    """Invoke the device transport entry points (coll/device.py)."""
+    from .core import op as opmod
+    from .core.datatype import from_numpy_dtype
+    ch = comm.device_channel
+    n = _msg_elems(comm, nbytes)
+    dt = from_numpy_dtype(np.dtype(_DTYPE))
+    send = np.ones(n, _DTYPE)
+    recv = np.empty(n, _DTYPE)
+    if name == "allreduce":
+        ch.allreduce(comm, send, recv, n, dt, opmod.SUM)
+    elif name == "bcast":
+        ch.bcast(comm, send, n, dt, 0)
+    elif name == "allgather":
+        c = n // comm.size
+        ch.allgather(comm, send[:c], recv, c, dt)
+    elif name == "alltoall":
+        c = n // comm.size
+        ch.alltoall(comm, send, recv, c, dt)
+    elif name == "reduce":
+        ch.reduce(comm, send, recv, n, dt, opmod.SUM, 0)
+    else:
+        raise KeyError(name)
+
+
+def profile_comm(comm, colls: Tuple[str, ...] = ("allreduce", "bcast",
+                                                 "allgather", "alltoall"),
+                 sizes: Optional[List[int]] = None,
+                 reps: int = 5) -> Dict:
+    """Measure host algorithms (and the device transport when bound) over
+    ``comm``; every rank must call this collectively. Returns the profile
+    dict on every rank (identical — built from agreed max-times)."""
+    sizes = sizes or SIZES
+    out: Dict = {"tables": {}, "device_crossovers": {}, "raw": {}}
+    size_class = "small" if comm.size <= 8 else "large"
+    for name in colls:
+        rows: List = []
+        raw: Dict = {}
+        cross: Optional[int] = None
+        for nbytes in sizes:
+            best_algo, best_t = None, float("inf")
+            for algo, fn in _host_candidates(name).items():
+                if algo == "two_level":
+                    continue   # needs multi-node comm; measured separately
+                t = _time_call(
+                    comm, lambda: _run_host_algo(comm, name, fn, nbytes),
+                    reps)
+                raw.setdefault(algo, {})[str(nbytes)] = t
+                if t < best_t:
+                    best_algo, best_t = algo, t
+            rows.append([nbytes, best_algo])
+            if comm.device_channel is not None:
+                td = _time_call(
+                    comm, lambda: _run_device(comm, name, nbytes), reps)
+                raw.setdefault("device", {})[str(nbytes)] = td
+                if td < best_t and cross is None:
+                    cross = nbytes
+        # collapse consecutive rows with the same winner; open the last bin
+        table: List = []
+        for bound, algo in rows:
+            if table and table[-1][1] == algo:
+                table[-1][0] = bound
+            else:
+                table.append([bound, algo])
+        table[-1][0] = None
+        out["tables"][name] = {size_class: table}
+        out["raw"][name] = raw
+        if comm.device_channel is not None:
+            # "device never won" is itself a measurement: record a
+            # never-cross sentinel so the runtime doesn't fall back to
+            # the (smaller) cvar default and route to the slower path
+            out["device_crossovers"][name] = (cross if cross is not None
+                                              else NEVER_CROSS)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# artifacts
+# ---------------------------------------------------------------------------
+
+def save_profile(profile: Dict, path: str) -> None:
+    from .utils.detect import arch_key
+    doc = {"arch_key": arch_key(), "profile": profile,
+           "format": "mv2t-tuning-profile-v1"}
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    log.info("wrote tuning profile %s (arch %s)", path, doc["arch_key"])
+
+
+def load_profile_file(path: str, check_arch: bool = True) -> bool:
+    """Install a measured profile into the tuning layer. Returns False
+    when the file is missing or was measured on a different arch."""
+    from .coll import tuning
+    from .utils.detect import arch_key
+    if not os.path.exists(path):
+        return False
+    with open(path) as f:
+        doc = json.load(f)
+    if check_arch and doc.get("arch_key") != arch_key():
+        log.warn("profile %s is for arch %r, this is %r; skipping",
+                 path, doc.get("arch_key"), arch_key())
+        return False
+    prof = doc["profile"]
+    tables = {name: {cls: [tuple(row) for row in rows]
+                     for cls, rows in classes.items()}
+              for name, classes in prof.get("tables", {}).items()}
+    tuning.load_profile(tables=tables,
+                        device_crossovers=prof.get("device_crossovers"))
+    return True
+
+
+def _arch_file() -> str:
+    from .utils.detect import arch_key
+    return os.path.join(
+        PROFILE_DIR, arch_key().replace(":", "_").replace(" ", "-")
+        + ".json")
+
+
+_default_attempted = False
+
+
+def load_default_profile() -> bool:
+    """Auto-load the measured profile for this arch — MV2T_TUNING_PROFILE
+    env first (no arch check: the user said so), else the committed
+    arch-keyed file under profiles/. The analog of the reference
+    selecting the generated tuning header for the detected arch
+    (allreduce_tuning.c:22-220). Idempotent per process."""
+    global _default_attempted
+    if _default_attempted:
+        return False
+    _default_attempted = True
+    forced = os.environ.get("MV2T_TUNING_PROFILE")
+    if forced:
+        return load_profile_file(forced, check_arch=False)
+    return load_profile_file(_arch_file())
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="mv2t-autotune",
+        description="measure collective algorithm crossovers and emit a "
+                    "tuning profile")
+    ap.add_argument("-np", type=int, default=8)
+    ap.add_argument("-o", "--out", default=None,
+                    help="output path (default: the arch-keyed file under "
+                         "mvapich2_tpu/profiles/)")
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--no-device", action="store_true",
+                    help="skip the device transport (host tables only)")
+    args = ap.parse_args(argv)
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # honor the caller's env even when a sitecustomize overrode it
+        # post-spawn (tests/conftest.py documents this environment quirk)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from .runtime.universe import run_ranks
+    holder: Dict = {}
+
+    def app(comm):
+        p = profile_comm(comm, reps=args.reps)
+        if comm.rank == 0:
+            holder["profile"] = p
+
+    run_ranks(args.np, app, device_mesh=not args.no_device)
+    path = args.out or _arch_file()
+    save_profile(holder["profile"], path)
+    print(f"tuning profile written: {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -44,10 +44,7 @@ cvar("DEVICE_COLL_MIN_BYTES", 16384, int, "coll",
      "rendezvous+dispatch overhead). Device-resident buffers always take "
      "the device path. Measured profiles override this.")
 
-def is_device_array(buf) -> bool:
-    """True for jax Arrays without importing jax (host-only rank processes
-    must never pull in the accelerator runtime)."""
-    return type(buf).__module__.split(".")[0] in ("jax", "jaxlib")
+from ..utils import is_device_array  # noqa: E402 — shared predicate
 
 
 def _op_name(op) -> Optional[str]:
@@ -215,14 +212,17 @@ class DeviceCollChannel:
         try:
             rv.barrier.wait()
         except threading.BrokenBarrierError:
+            rv.slots[self.rank] = None
             raise RuntimeError(
                 "device collective aborted: a peer rank failed") from None
+        # release this rank's references promptly — retained slots/results
+        # would pin device memory for the life of an idle comm
+        res, rv.result[self.rank] = rv.result[self.rank], None
+        rv.slots[self.rank] = None
         if rv.error is not None:
             raise RuntimeError(
                 f"device collective {name} failed on the leader"
             ) from rv.error
-        res = rv.result[self.rank]
-        rv.slots[self.rank] = None
         return res
 
     # -- MPI-shaped entry points (match coll_fns signatures) -------------
@@ -374,6 +374,8 @@ def install_device_coll(comm, channel: DeviceCollChannel) -> None:
 
         def entry(comm_, *a):
             buf = a[0]
+            if type(buf).__name__ == "_InPlace" and len(a) > 1:
+                buf = a[1]   # selection looks at the effective buffer
             op = a[op_pos] if op_pos is not None else None
             if _select_transport(comm_, name, nbytes_of(a), op,
                                  buf) == "device":
@@ -417,11 +419,12 @@ def install_device_coll(comm, channel: DeviceCollChannel) -> None:
 # binding helpers (harness / launcher entry points)
 # ---------------------------------------------------------------------------
 
-def bind_universes(universes, mesh=None, axis: str = "x") -> bool:
+def bind_universes(universes, mesh=None, axis: Optional[str] = None) -> bool:
     """Bind each thread-rank universe's COMM_WORLD to the device mesh —
     called by the in-process harness (run_ranks(device_mesh=...)) and the
     --vpod launcher before rank threads start. Returns False (no-op) when
-    the mesh cannot cover the ranks."""
+    the mesh cannot cover the ranks. ``axis`` defaults to the mesh's first
+    axis name (ranks lay out over the flattened device order)."""
     import jax
 
     n = len(universes)
@@ -432,7 +435,13 @@ def bind_universes(universes, mesh=None, axis: str = "x") -> bool:
             log.warn("device mesh unavailable: %d ranks > %d devices; "
                      "host path only", n, len(devs))
             return False
-        mesh = make_mesh((n,), (axis,), devs[:n])
+        mesh = make_mesh((n,), (axis or "x",), devs[:n])
+    if axis is None:
+        axis = mesh.axis_names[0]
+    if len(mesh.axis_names) > 1:
+        log.warn("mesh %s has %d axes; the MPI binding needs a 1-D mesh; "
+                 "host path only", dict(mesh.shape), len(mesh.axis_names))
+        return False
     if int(np.prod(list(mesh.shape.values()))) != n:
         log.warn("mesh shape %s does not match %d ranks; host path only",
                  dict(mesh.shape), n)
@@ -441,4 +450,8 @@ def bind_universes(universes, mesh=None, axis: str = "x") -> bool:
     for r, u in enumerate(universes):
         ch = DeviceCollChannel(mesh, axis, rv, r)
         install_device_coll(u.comm_world, ch)
+    # arch is known here (jax initialized): pull in the measured tuning
+    # profile for this mesh, if one is committed/pointed-to
+    from ..autotune import load_default_profile
+    load_default_profile()
     return True

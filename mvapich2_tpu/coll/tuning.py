@@ -127,12 +127,18 @@ def load_profile(tables: Optional[Dict[str, Dict[str, Table]]] = None,
 
 def device_crossover(name: str, comm) -> int:
     """Bytes at which a host-buffer collective on a mesh-bound comm moves
-    to the device (XLA/ICI) transport. Measured profile wins; falls back
-    to the DEVICE_COLL_MIN_BYTES cvar."""
+    to the device (XLA/ICI) transport. Precedence: explicitly-set cvar
+    (env or config.set — the user said so) > measured profile > cvar
+    default."""
+    cfg = get_config()
+    cv = cfg._vars["DEVICE_COLL_MIN_BYTES"]
+    val = cv.value          # forces the lazy env load
+    if cv._explicit:
+        return val
     got = _DEVICE_CROSSOVERS.get(name)
     if got is not None:
         return got
-    return get_config()["DEVICE_COLL_MIN_BYTES"]
+    return val
 
 
 def _size_class(comm) -> str:

@@ -30,10 +30,7 @@ def _is_in_place(buf) -> bool:
     return type(buf).__name__ == "_InPlace"
 
 
-def _is_device(buf) -> bool:
-    """jax Array check without importing jax (host-only ranks must never
-    pull in the accelerator runtime) — see coll/device.py."""
-    return type(buf).__module__.split(".")[0] in ("jax", "jaxlib")
+from ..utils import is_device_array as _is_device  # noqa: E402
 
 
 def _resolve(buf, count: Optional[int], datatype: Optional[Datatype],

@@ -245,3 +245,9 @@ def dump() -> str:
         pv = _pvars.get(n)
         lines.append(f"{pv.name:<44} = {pv.read():<14g} [{pv.group}]")
     return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# the autotuner lives beside MPI_T (tools space): mpit.autotune
+# ---------------------------------------------------------------------------
+from . import autotune  # noqa: E402  (re-export: mpit.autotune.profile_comm)

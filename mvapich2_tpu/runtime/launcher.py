@@ -163,12 +163,16 @@ def launch_vpod(nranks: int, argv: List[str],
         except BaseException:   # noqa: BLE001 — rank error = job error
             traceback.print_exc()
             codes[r] = 1
-            ch = getattr(universes[r].comm_world, "device_channel", None)
-            if ch is not None:
-                ch.abort()   # break the device-collective rendezvous
-            for u in universes:
-                u.engine.wakeup()
         finally:
+            if codes[r] != 0:
+                # a failing rank (exception OR sys.exit(nonzero)) must
+                # release peers blocked in collectives
+                ch = getattr(universes[r].comm_world, "device_channel",
+                             None)
+                if ch is not None:
+                    ch.abort()   # break the device-collective rendezvous
+                for u in universes:
+                    u.engine.wakeup()
             set_universe(None)
 
     threads = [threading.Thread(target=body, args=(r,), daemon=True,

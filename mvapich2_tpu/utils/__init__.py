@@ -1,3 +1,10 @@
 from .config import cvar, get_config, Config
 from .mlog import get_logger, set_level
 from .handles import HandlePool
+
+
+def is_device_array(buf) -> bool:
+    """True for jax Arrays, detected WITHOUT importing jax — host-only
+    rank processes must never pull in the accelerator runtime. Shared by
+    core/comm.py and coll/device.py."""
+    return type(buf).__module__.split(".")[0] in ("jax", "jaxlib")
