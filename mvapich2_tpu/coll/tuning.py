@@ -146,10 +146,15 @@ def _size_class(comm) -> str:
 
 
 def _lookup(name: str, comm, nbytes: int) -> str:
+    cls = _size_class(comm)
     tables = _PROFILE_TABLES.get(name) or DEFAULT_TABLES.get(name)
     if not tables:
         raise KeyError(name)
-    rows = tables[_size_class(comm)]
+    if cls not in tables:
+        # a measured profile only covers the comm-size class it ran at;
+        # other classes keep the defaults
+        tables = DEFAULT_TABLES[name]
+    rows = tables[cls]
     for bound, algo in rows:
         if bound is None or nbytes <= bound:
             return algo

@@ -1,0 +1,99 @@
+"""Hostfile parsing + rank->node mapping.
+
+Analog of the reference's hostfile grammar
+(src/pm/mpirun/src/hostfile/parser.y — mpirun_rsh accepts
+``host[:slots[:hca]]`` lines) reduced to the TPU-relevant core:
+
+    # comment
+    nodeA            # 1 slot
+    nodeB:4          # 4 slots
+    nodeC slots=8    # openmpi-style also accepted
+
+Mapping is block by default (fill each host's slots in declaration
+order — mpirun_rsh's default) or cyclic (round-robin one rank per host —
+the MV2_CPU_MAPPING-ish alternative).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+
+@dataclass(frozen=True)
+class HostSpec:
+    name: str
+    slots: int
+
+
+def parse_hostfile_text(text: str) -> List[HostSpec]:
+    hosts: List[HostSpec] = []
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        slots = 1
+        name = line
+        if ":" in line:
+            name, _, s = line.partition(":")
+            slots = int(s)
+        elif " " in line or "\t" in line:
+            parts = line.split()
+            name = parts[0]
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p[len("slots="):])
+                else:
+                    raise ValueError(
+                        f"hostfile line {lineno}: unknown token {p!r}")
+        if slots < 1:
+            raise ValueError(f"hostfile line {lineno}: slots must be >= 1")
+        name = name.strip()
+        # repeated host lines accumulate slots (mpirun_rsh semantics)
+        for i, h in enumerate(hosts):
+            if h.name == name:
+                hosts[i] = HostSpec(name, h.slots + slots)
+                break
+        else:
+            hosts.append(HostSpec(name, slots))
+    if not hosts:
+        raise ValueError("hostfile is empty")
+    return hosts
+
+
+def parse_hostfile(path: str) -> List[HostSpec]:
+    with open(path) as f:
+        return parse_hostfile_text(f.read())
+
+
+def map_ranks(hosts: List[HostSpec], nranks: int,
+              policy: str = "block") -> List[Tuple[int, str]]:
+    """Returns [(rank, hostname)] for every rank. ``block`` fills each
+    host's slots in order; ``cyclic`` round-robins one rank at a time.
+    Oversubscription past the total slot count wraps around (with a
+    warning left to the caller)."""
+    total = sum(h.slots for h in hosts)
+    out: List[Tuple[int, str]] = []
+    if policy == "block":
+        seq: List[str] = []
+        for h in hosts:
+            seq.extend([h.name] * h.slots)
+        for r in range(nranks):
+            out.append((r, seq[r % total]))
+    elif policy == "cyclic":
+        counts = [0] * len(hosts)
+        i = 0
+        for r in range(nranks):
+            # advance to the next host with a free slot (wrap = oversub)
+            for _ in range(len(hosts)):
+                if counts[i] < hosts[i].slots:
+                    break
+                i = (i + 1) % len(hosts)
+            else:
+                counts = [0] * len(hosts)   # all full: new round
+            out.append((r, hosts[i].name))
+            counts[i] += 1
+            i = (i + 1) % len(hosts)
+    else:
+        raise ValueError(f"unknown mapping policy {policy!r}")
+    return out

@@ -100,6 +100,105 @@ def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
         srv.shutdown()
 
 
+def _node_is_local(name: str) -> bool:
+    """Emulated node names (no DNS entry) and this host's own names run
+    the agent as a local subprocess; resolvable foreign names go over
+    ssh (the mpirun_rsh remote-start path)."""
+    import socket
+    if name in ("localhost", "127.0.0.1", socket.gethostname()):
+        return True
+    try:
+        addr = socket.gethostbyname(name)
+    except OSError:
+        return True    # unresolvable = emulated node on this host
+    try:
+        local_addrs = {ai[4][0] for ai in socket.getaddrinfo(
+            socket.gethostname(), None)}
+    except OSError:
+        local_addrs = set()
+    return addr in local_addrs | {"127.0.0.1"}
+
+
+def launch_tree(nranks: int, argv: List[str], hostfile_path: str,
+                env_extra: Optional[dict] = None,
+                timeout: Optional[float] = None, ft: bool = False,
+                policy: str = "block") -> int:
+    """Multi-node launch through per-node mpispawn agents (the
+    mpirun_rsh -> mpispawn tree, src/pm/mpirun/mpispawn_tree.c analog,
+    two-level). Each agent starts its node's rank processes with the
+    node identity in the bootstrap env, so node_ids — and with them the
+    shm intra-node channel and the two-level collectives' inter-leader
+    TCP phase — follow the hostfile placement."""
+    import json as _json
+    import socket
+
+    from .hostfile import map_ranks, parse_hostfile
+    hosts = parse_hostfile(hostfile_path)
+    mapping = map_ranks(hosts, nranks, policy)
+    total_slots = sum(h.slots for h in hosts)
+    if nranks > total_slots:
+        print(f"mpirun: oversubscribing {nranks} ranks onto "
+              f"{total_slots} slots", file=sys.stderr)
+    by_node: dict = {}
+    for r, h in mapping:
+        by_node.setdefault(h, []).append(r)
+
+    any_remote = any(not _node_is_local(n) for n in by_node)
+    srv = KVSServer(nranks, host=socket.gethostname() if any_remote
+                    else "127.0.0.1")
+    agents: List[subprocess.Popen] = []
+    try:
+        for node, ranks in by_node.items():
+            spec = {"node": node, "ranks": ranks, "size": nranks,
+                    "kvs": srv.address, "argv": argv,
+                    "env": env_extra or {}, "ft": ft}
+            cmd = [sys.executable, "-m", "mvapich2_tpu.runtime.mpispawn",
+                   _json.dumps(spec)]
+            if _node_is_local(node):
+                agents.append(subprocess.Popen(cmd))
+            else:
+                import shlex
+                agents.append(subprocess.Popen(
+                    ["ssh", "-o", "BatchMode=yes", node,
+                     " ".join(shlex.quote(c) for c in cmd)]))
+        deadline = time.monotonic() + timeout if timeout else None
+        rcs: List[Optional[int]] = [None] * len(agents)
+        while any(c is None for c in rcs):
+            for i, a in enumerate(agents):
+                if rcs[i] is None:
+                    rcs[i] = a.poll()
+            bad = [c for c in rcs if c is not None and c != 0]
+            if bad and not ft:
+                _stop_agents(agents)
+                return max(bad)
+            if any(c is not None and c < 0 for c in rcs):
+                # a dead agent orphans its ranks: abort the job
+                _stop_agents(agents)
+                return 1
+            if deadline and time.monotonic() > deadline:
+                _stop_agents(agents)
+                raise TimeoutError(f"job exceeded {timeout}s")
+            time.sleep(0.02)
+        return max(c or 0 for c in rcs)
+    finally:
+        _stop_agents(agents)
+        srv.shutdown()
+
+
+def _stop_agents(agents: List[subprocess.Popen]) -> None:
+    """SIGTERM first — the agent's handler kills its rank processes —
+    then SIGKILL stragglers after a grace period (a straight kill() would
+    orphan every rank on the node)."""
+    live = [a for a in agents if a.poll() is None]
+    for a in live:
+        a.terminate()
+    if live:
+        time.sleep(0.3)
+    for a in agents:
+        if a.poll() is None:
+            a.kill()
+
+
 def launch_vpod(nranks: int, argv: List[str],
                 timeout: Optional[float] = None) -> int:
     """Virtual-pod mode: N rank *threads* in one process, COMM_WORLD bound
@@ -203,6 +302,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--vpod", action="store_true",
                     help="virtual-pod mode: rank threads bound to a device "
                          "mesh; collectives take the XLA/ICI path")
+    ap.add_argument("--hostfile", "-f", default=None,
+                    help="multi-node launch: one mpispawn agent per host "
+                         "(unresolvable names = emulated nodes here)")
+    ap.add_argument("--map", choices=("block", "cyclic"), default="block",
+                    help="rank->host mapping policy for --hostfile")
     ap.add_argument("--timeout", type=float, default=None)
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -210,6 +314,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         ap.error("no command given")
     if args.vpod:
         return launch_vpod(args.np, args.command, timeout=args.timeout)
+    if args.hostfile:
+        return launch_tree(args.np, args.command, args.hostfile,
+                           timeout=args.timeout, ft=args.ft,
+                           policy=args.map)
     fake = None
     if args.fake_nodes:
         fake = [int(x) for x in args.fake_nodes.split(",")]

@@ -1,0 +1,104 @@
+"""mpispawn — the per-node launch agent.
+
+Analog of the reference's mpispawn (src/pm/mpirun/mpispawn.c,
+mpispawn_tree.c): mpirun_rsh starts one agent per node; the agent spawns
+its node's rank processes, watches them, and reports exits up the tree.
+Here the tree is two-level (mpirun -> one agent per node -> ranks), the
+control channel is the job KVS (the PMI tree analog), and "remote start"
+is ssh when the node is remote or a plain subprocess for emulated nodes
+on localhost (MV2T_FAKE_NODE carries the node identity either way).
+
+Agent protocol (KVS keys):
+    __agent_up_<node>     agent started, pid published
+    __agent_exit_<node>   JSON {rank: exitcode} when all its ranks ended
+    __failure_ev_<n>      (ft mode) a rank died by signal — same key the
+                          single-host launcher publishes, so the ULFM
+                          failure watcher needs no changes
+
+The spawn spec arrives as one JSON argv blob (the mpispawn env-block
+handoff, mpirun_rsh.c:296 analog).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from .kvs import KVSClient
+
+
+def run_agent(spec: Dict) -> int:
+    """Spawn this node's ranks per ``spec`` and babysit them.
+
+    spec = {node, ranks: [int], size, kvs, argv: [...], env: {...},
+            ft: bool}
+    """
+    node = spec["node"]
+    kvs = KVSClient(spec["kvs"])
+    kvs.put(f"__agent_up_{node}", str(os.getpid()))
+
+    procs: Dict[int, subprocess.Popen] = {}
+    for r in spec["ranks"]:
+        env = dict(os.environ)
+        env.update(spec.get("env") or {})
+        env["MV2T_RANK"] = str(r)
+        env["MV2T_SIZE"] = str(spec["size"])
+        env["MV2T_KVS"] = spec["kvs"]
+        env["MV2T_FAKE_NODE"] = node
+        if spec.get("ft"):
+            env["MV2T_FT"] = "1"
+        # rank processes must not grab the accelerator: host runtime only
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        procs[r] = subprocess.Popen(spec["argv"], env=env)
+
+    def _kill_all(*_a):
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        sys.exit(1)
+
+    signal.signal(signal.SIGTERM, _kill_all)
+
+    codes: Dict[int, Optional[int]] = {r: None for r in procs}
+    while any(c is None for c in codes.values()):
+        for r, p in procs.items():
+            if codes[r] is None:
+                rc = p.poll()
+                if rc is None:
+                    continue
+                codes[r] = rc
+                if spec.get("ft") and rc < 0:
+                    # signal death = process failure event (the
+                    # launcher-driven detection path, SURVEY 5.3).
+                    # Atomically claim the next global event slot so
+                    # agents on different nodes never collide and the
+                    # sequential failure watcher sees no gaps.
+                    n = kvs.add("__failure_ev_seq", 1) - 1
+                    kvs.put(f"__failure_ev_{n}", str(r))
+        time.sleep(0.01)
+    kvs.put(f"__agent_exit_{node}", json.dumps(codes))
+    if spec.get("ft"):
+        # signal-killed ranks were reported as failure events; the job
+        # result is the max exit code over NON-failed ranks (the launch()
+        # ft contract) — a clean-surviving node must exit 0
+        survivors = [c for c in codes.values() if c is not None and c >= 0]
+        return max(survivors, default=0)
+    return max((c or 0) for c in codes.values())
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 1:
+        print("usage: python -m mvapich2_tpu.runtime.mpispawn "
+              "'<json spec>'", file=sys.stderr)
+        return 2
+    return run_agent(json.loads(argv[0]))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,141 @@
+"""Multi-node launch: hostfile grammar, rank mapping, mpispawn agent tree.
+
+The reference's backbone is mpirun_rsh starting one mpispawn per node
+(mpispawn_tree.c); here the tree is exercised with emulated nodes on
+localhost — unresolvable hostnames run the agent as a local subprocess
+with the node identity carried in the bootstrap env, so node_ids, the shm
+intra-node channel, and the two-level inter-leader TCP phase all follow
+the hostfile placement.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mvapich2_tpu.runtime.hostfile import (HostSpec, map_ranks,
+                                           parse_hostfile_text)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# hostfile grammar + mapping
+# ---------------------------------------------------------------------------
+
+def test_parse_forms():
+    hosts = parse_hostfile_text(
+        "# cluster\n"
+        "nodeA\n"
+        "nodeB:4\n"
+        "nodeC slots=8\n"
+        "\n"
+        "nodeA:3   # accumulate\n")
+    assert hosts == [HostSpec("nodeA", 4), HostSpec("nodeB", 4),
+                     HostSpec("nodeC", 8)]
+
+
+def test_parse_errors():
+    with pytest.raises(ValueError):
+        parse_hostfile_text("")
+    with pytest.raises(ValueError):
+        parse_hostfile_text("nodeA:0\n")
+    with pytest.raises(ValueError):
+        parse_hostfile_text("nodeA gpus=2\n")
+
+
+def test_map_block_and_cyclic():
+    hosts = [HostSpec("a", 2), HostSpec("b", 2)]
+    assert map_ranks(hosts, 4, "block") == [
+        (0, "a"), (1, "a"), (2, "b"), (3, "b")]
+    assert map_ranks(hosts, 4, "cyclic") == [
+        (0, "a"), (1, "b"), (2, "a"), (3, "b")]
+    # oversubscription wraps
+    assert [h for _, h in map_ranks(hosts, 6, "block")] == [
+        "a", "a", "b", "b", "a", "a"]
+
+
+# ---------------------------------------------------------------------------
+# agent-tree end-to-end
+# ---------------------------------------------------------------------------
+
+def _write_hostfile(tmp_path, text):
+    p = tmp_path / "hosts"
+    p.write_text(text)
+    return str(p)
+
+
+def test_tree_two_nodes_placement(tmp_path):
+    """8 ranks over 2 emulated nodes: every rank must see 2 nodes, with
+    its node peers matching the hostfile block mapping."""
+    prog = tmp_path / "prog.py"
+    prog.write_text(
+        "import sys; sys.path.insert(0, %r)\n"
+        "import numpy as np\n"
+        "from mvapich2_tpu import mpi\n"
+        "mpi.Init()\n"
+        "c = mpi.COMM_WORLD\n"
+        "u = c.u\n"
+        "assert u.num_nodes() == 2, u.node_ids\n"
+        "expect_node = 0 if c.rank < 4 else 1\n"
+        "assert u.node_ids[c.rank] == expect_node, (c.rank, u.node_ids)\n"
+        "out = c.allreduce(np.full(4096, float(c.rank), np.float32))\n"
+        "assert out[0] == sum(range(c.size))\n"
+        "shm = c.split_type_shared()\n"
+        "assert shm.size == 4\n"
+        "if c.rank == 0: print('No Errors')\n"
+        "mpi.Finalize()\n" % REPO)
+    hf = _write_hostfile(tmp_path, "nodeA:4\nnodeB:4\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "mvapich2_tpu.run", "-np", "8",
+         "--hostfile", hf, "--timeout", "120", sys.executable, str(prog)],
+        cwd=REPO, capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+
+
+def test_tree_cyclic_mapping(tmp_path):
+    prog = tmp_path / "prog.py"
+    prog.write_text(
+        "import sys; sys.path.insert(0, %r)\n"
+        "from mvapich2_tpu import mpi\n"
+        "mpi.Init()\n"
+        "c = mpi.COMM_WORLD\n"
+        "u = c.u\n"
+        "assert u.node_ids[c.rank] == c.rank %% 2, u.node_ids\n"
+        "c.barrier()\n"
+        "if c.rank == 0: print('No Errors')\n"
+        "mpi.Finalize()\n" % REPO)
+    hf = _write_hostfile(tmp_path, "nodeA:2\nnodeB:2\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "mvapich2_tpu.run", "-np", "4",
+         "--hostfile", hf, "--map", "cyclic", "--timeout", "90",
+         sys.executable, str(prog)],
+        cwd=REPO, capture_output=True, text=True, timeout=150)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+
+
+def test_tree_ft_failure_events_cross_agents(tmp_path):
+    """FT mode through the agent tree: a rank killed on one emulated node
+    becomes a global failure event (atomic cross-agent sequencing) and
+    survivors on both nodes ack + shrink + finish."""
+    prog = os.path.join(REPO, "tests", "progs", "ft_shrink_prog.py")
+    hf = _write_hostfile(tmp_path, "nodeA:2\nnodeB:2\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "mvapich2_tpu.run", "-np", "4", "--ft",
+         "--hostfile", hf, "--timeout", "120", sys.executable, prog],
+        cwd=REPO, capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+
+
+def test_tree_failing_rank_kills_job(tmp_path):
+    prog = os.path.join(REPO, "tests", "progs", "die_prog.py")
+    hf = _write_hostfile(tmp_path, "nodeA:2\nnodeB:2\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "mvapich2_tpu.run", "-np", "4",
+         "--hostfile", hf, "--timeout", "90", sys.executable, prog],
+        cwd=REPO, capture_output=True, text=True, timeout=150)
+    assert r.returncode != 0
