@@ -1,0 +1,262 @@
+"""Slotted shared-memory collective segment — the intra-node fast phase.
+
+Analog of the reference's shmem collective buffers
+(src/mpi/coll/ch3_shmem_coll.c: a persistent mmap'd per-node segment of
+pipelined 8192-byte slots, init at :1365, slot length at :527-528): the
+two-level allreduce's intra-node reduce and bcast phases stream through
+fixed slots in one shared mapping instead of making pt2pt-over-shm
+packet hops per message. Chunk k can be reduced by the leader while the
+writers fill chunk k+1 — the pipelining that hides the copy latency.
+
+Layout (one file per (node, comm), created by the node leader):
+
+    written[p]          u64  per-rank count of reduce chunks published
+    consumed[p]         u64  leader's count of reduce chunks drained
+    bcast_written[1]    u64  leader's count of bcast chunks published
+    bcast_consumed[p]   u64  per-rank count of bcast chunks drained
+    reduce slots        p x NSLOTS x SLOT bytes
+    bcast slots         NSLOTS x SLOT bytes
+
+Counters are monotonic across calls (collectives are issued in the same
+order on every rank of a comm, so absolute chunk ids agree). x86/ARM
+store ordering + the GIL-free mmap stores make the flag-after-data
+pattern safe for the numpy bulk copies used here.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..utils.config import cvar, get_config
+from ..utils.mlog import get_logger
+
+log = get_logger("shmcoll")
+
+cvar("USE_SLOTTED_SHM_COLL", True, bool, "coll",
+     "Use the slotted shared-memory segment for the intra-node phase of "
+     "two-level collectives (MV2_USE_SHMEM_COLL analog).")
+cvar("SHM_COLL_SLOT_LEN", 8192, int, "coll",
+     "Slot length in bytes for the shm collective segment "
+     "(ch3_shmem_coll.c:527 uses 8192).")
+cvar("SHM_COLL_NSLOTS", 4, int, "coll",
+     "Pipeline depth (slots per rank) of the shm collective segment.")
+
+_POLL_TIMEOUT = 120.0
+
+
+def _shm_dir() -> str:
+    return "/dev/shm" if os.path.isdir("/dev/shm") else \
+        os.environ.get("TMPDIR", "/tmp")
+
+
+class ShmCollSegment:
+    """One rank's mapping of the per-node segment (collective ctor over
+    the shmem comm; the leader creates, everyone maps)."""
+
+    def __init__(self, shmem_comm):
+        self.comm = shmem_comm
+        self.p = shmem_comm.size
+        self.rank = shmem_comm.rank
+        cfg = get_config()
+        self.slot = int(cfg["SHM_COLL_SLOT_LEN"])
+        self.nslots = int(cfg["SHM_COLL_NSLOTS"])
+        self._base = 0   # absolute chunk id base (monotonic)
+
+        hdr = 8 * (self.p + self.p + 1 + self.p)
+        size = hdr + self.p * self.nslots * self.slot \
+            + self.nslots * self.slot
+        if self.rank == 0:
+            path = os.path.join(
+                _shm_dir(),
+                f"mv2t-collseg-{os.getpid()}-{id(shmem_comm):x}")
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+            os.ftruncate(fd, size)
+            pb = np.frombuffer(path.encode(), np.uint8)
+            n = np.array([pb.size], np.int64)
+            shmem_comm.bcast(n, root=0)
+            shmem_comm.bcast(pb.copy(), root=0)
+        else:
+            n = np.zeros(1, np.int64)
+            shmem_comm.bcast(n, root=0)
+            pb = np.empty(int(n[0]), np.uint8)
+            shmem_comm.bcast(pb, root=0)
+            path = pb.tobytes().decode()
+            fd = os.open(path, os.O_RDWR)
+        self.path = path
+        self.mm = mmap.mmap(fd, size)
+        os.close(fd)
+        buf = np.frombuffer(self.mm, np.uint8)
+        o = 0
+        self.written = buf[o:o + 8 * self.p].view(np.uint64); o += 8 * self.p
+        self.consumed = buf[o:o + 8 * self.p].view(np.uint64)
+        o += 8 * self.p
+        self.bw = buf[o:o + 8].view(np.uint64); o += 8
+        self.bc = buf[o:o + 8 * self.p].view(np.uint64); o += 8 * self.p
+        self.rslots = buf[o:o + self.p * self.nslots * self.slot].reshape(
+            self.p, self.nslots, self.slot)
+        o += self.p * self.nslots * self.slot
+        self.bslots = buf[o:o + self.nslots * self.slot].reshape(
+            self.nslots, self.slot)
+        if self.rank == 0:
+            self.written[:] = 0
+            self.consumed[:] = 0
+            self.bw[0] = 0
+            self.bc[:] = 0
+        shmem_comm.barrier()
+        # the file stays linked for the comm's life; leader unlinks on
+        # free (a crashed job leaves it for the OS tmp reaper)
+
+    # -- polling ---------------------------------------------------------
+    @staticmethod
+    def _wait(pred) -> None:
+        deadline = time.monotonic() + _POLL_TIMEOUT
+        spins = 0
+        while not pred():
+            spins += 1
+            if spins & 0x3FF == 0:
+                if time.monotonic() > deadline:
+                    raise TimeoutError("shm collective segment stalled "
+                                       "(peer died?)")
+                time.sleep(0.0005)
+
+    # -- intra-node reduce (everyone -> leader) --------------------------
+    def reduce_to_leader(self, arr: np.ndarray, op) -> Optional[np.ndarray]:
+        """Pipelined slotted reduce: returns the reduced array on the
+        leader (rank 0 of the shmem comm), None elsewhere. Chunks are
+        element-aligned so the leader can reduce slot views in dtype."""
+        a = np.ascontiguousarray(arr)
+        raw = a.view(np.uint8).reshape(-1)
+        total = raw.size
+        slot = self.slot - self.slot % max(a.itemsize, 1)
+        if slot <= 0:
+            raise ValueError(f"element size {a.itemsize} exceeds slot "
+                             f"length {self.slot}")
+        nchunks = max((total + slot - 1) // slot, 1)
+        base = self._base
+        self._base += nchunks
+        if self.rank != 0:
+            w = self.written
+            cons = self.consumed
+            for k in range(nchunks):
+                cid = base + k
+                self._wait(lambda: cid - int(cons[self.rank])
+                           < self.nslots)
+                lo = k * slot
+                chunk = raw[lo:lo + slot]
+                self.rslots[self.rank, cid % self.nslots,
+                            :chunk.size] = chunk
+                w[self.rank] = cid + 1
+            return None
+        # leader: drain every writer per chunk, folding into its own data
+        acc = a.copy()
+        aview = acc.view(np.uint8).reshape(-1)
+        for k in range(nchunks):
+            cid = base + k
+            lo = k * slot
+            hi = min(lo + slot, total)
+            span = hi - lo
+            # fold in shmem-rank order (deterministic)
+            for r in range(1, self.p):
+                wr = self.written
+                self._wait(lambda: int(wr[r]) > cid)
+                peer = self.rslots[r, cid % self.nslots, :span]
+                mine = aview[lo:hi].view(a.dtype)
+                folded = op.fn(peer.view(a.dtype), mine)
+                aview[lo:hi] = np.ascontiguousarray(folded).view(np.uint8)
+                self.consumed[r] = cid + 1
+        return acc.reshape(arr.shape)
+
+    # -- intra-node bcast (leader -> everyone) ---------------------------
+    def bcast_from_leader(self, arr: np.ndarray) -> None:
+        """Pipelined slotted bcast: leader publishes ``arr``; every other
+        rank copies it into its own ``arr`` (in place)."""
+        a = arr  # must be contiguous for the in-place fill
+        raw = a.view(np.uint8).reshape(-1)
+        total = raw.size
+        nchunks = max((total + self.slot - 1) // self.slot, 1)
+        base = self._base
+        self._base += nchunks
+        if self.rank == 0:
+            for k in range(nchunks):
+                cid = base + k
+                self._wait(lambda: all(
+                    cid - int(self.bc[r]) < self.nslots
+                    for r in range(1, self.p)))
+                lo = k * self.slot
+                chunk = raw[lo:lo + self.slot]
+                self.bslots[cid % self.nslots, :chunk.size] = chunk
+                self.bw[0] = cid + 1
+            return
+        for k in range(nchunks):
+            cid = base + k
+            self._wait(lambda: int(self.bw[0]) > cid)
+            lo = k * self.slot
+            hi = min(lo + self.slot, total)
+            raw[lo:hi] = self.bslots[cid % self.nslots, :hi - lo]
+            self.bc[self.rank] = cid + 1
+
+    def free(self) -> None:
+        try:
+            self.mm.close()
+        except BufferError:   # numpy views still alive — leave to GC
+            pass
+        if self.rank == 0:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# the slotted two-level allreduce algorithm
+# ---------------------------------------------------------------------------
+
+def _segment_for(comm) -> Optional[ShmCollSegment]:
+    """Lazily build (collectively!) and cache the segment for a comm's
+    shmem sub-comm. Every rank of the comm must reach this together —
+    callers are collective contexts only."""
+    seg = getattr(comm, "_shm_coll_seg", None)
+    if seg is not None:
+        return seg if seg is not False else None
+    shmem, _ = comm.build_2level()
+    if shmem is None or shmem.size < 2:
+        comm._shm_coll_seg = False
+        return None
+    try:
+        seg = ShmCollSegment(shmem)
+    except Exception as e:   # mmap/tmpfs unavailable: fall back
+        log.warn("shm collective segment unavailable (%s); "
+                 "pt2pt-over-shm fallback", e)
+        comm._shm_coll_seg = False
+        return None
+    comm._shm_coll_seg = seg
+    return seg
+
+
+def allreduce_two_level_slotted(comm, arr: np.ndarray, op, tag: int,
+                                inter_algo=None) -> np.ndarray:
+    """Two-level allreduce with the slotted-segment intra-node phases
+    (the ch3_shmem_coll fast path). Falls back to the pt2pt-over-shm
+    two-level when no segment can be built."""
+    from . import algorithms as alg
+    inter = inter_algo or alg.allreduce_recursive_doubling
+    shmem, leader = comm.build_2level()
+    if shmem is None or shmem.size < 2:
+        return inter(comm, arr, op, tag)
+    seg = None
+    if np.asarray(arr).itemsize <= get_config()["SHM_COLL_SLOT_LEN"]:
+        seg = _segment_for(comm)
+    if seg is None:
+        return alg.allreduce_two_level(comm, arr, op, tag, inter)
+    local = seg.reduce_to_leader(arr, op)
+    if leader is not None and leader.size > 1:
+        local = inter(leader, local, op, tag)
+    out = local if local is not None else np.empty_like(
+        np.ascontiguousarray(arr))
+    seg.bcast_from_leader(out)
+    return out.reshape(arr.shape)

@@ -71,6 +71,10 @@ ALGOS: Dict[str, Dict[str, Callable]] = {
     },
 }
 
+from .shmcoll import allreduce_two_level_slotted  # noqa: E402
+
+ALGOS["allreduce"]["two_level_slotted"] = allreduce_two_level_slotted
+
 # ---------------------------------------------------------------------------
 # default tables: rows of (msg-size upper bound, algo name); the last row's
 # bound is None (infinity). Mirrors the shape of e.g. allreduce_tuning.h:38-90
