@@ -18,15 +18,20 @@ Layout (one file per (node, comm), created by the node leader):
     bcast slots         NSLOTS x SLOT bytes
 
 Counters are monotonic across calls (collectives are issued in the same
-order on every rank of a comm, so absolute chunk ids agree). x86/ARM
-store ordering + the GIL-free mmap stores make the flag-after-data
-pattern safe for the numpy bulk copies used here.
+order on every rank of a comm, so absolute chunk ids agree). The
+flag-after-data pattern relies on store ordering: guaranteed on x86
+(TSO); on weakly-ordered CPUs (aarch64) an explicit fence is emitted
+between the data copy and the counter store (and between the counter
+load and the data read) — `_fence()` below issues an atomic RMW, which
+compiles to a full barrier on ARM and is ~free on x86.
 """
 
 from __future__ import annotations
 
+import atexit
 import mmap
 import os
+import threading
 import time
 from typing import Optional
 
@@ -48,6 +53,15 @@ cvar("SHM_COLL_NSLOTS", 4, int, "coll",
 
 _POLL_TIMEOUT = 120.0
 
+_fence_lock = threading.Lock()
+
+
+def _fence() -> None:
+    """Full memory barrier (atomic RMW): orders the preceding slot-data
+    stores before the following counter store on weakly-ordered CPUs."""
+    with _fence_lock:
+        pass
+
 
 def _shm_dir() -> str:
     return "/dev/shm" if os.path.isdir("/dev/shm") else \
@@ -65,17 +79,35 @@ class ShmCollSegment:
         cfg = get_config()
         self.slot = int(cfg["SHM_COLL_SLOT_LEN"])
         self.nslots = int(cfg["SHM_COLL_NSLOTS"])
-        self._base = 0   # absolute chunk id base (monotonic)
+        # per-phase chunk-id bases (monotonic). They must be separate:
+        # the reduce flow control compares ids against consumed[] and the
+        # bcast flow control against bc[], so a shared base would open an
+        # unclosable gap of one phase's chunk count in the other's
+        # window once a message spans >= nslots chunks.
+        self._rbase = 0
+        self._bbase = 0
 
         hdr = 8 * (self.p + self.p + 1 + self.p)
         size = hdr + self.p * self.nslots * self.slot \
             + self.nslots * self.slot
+        # Construction is collective: a failure on ANY rank must be
+        # agreed by all (a lone rank falling back while peers sit in a
+        # bcast/barrier would hang the node). The leader broadcasts
+        # n = -1 on create failure; after mapping, an allreduce(MIN ok)
+        # decides jointly whether the segment is usable.
         if self.rank == 0:
-            path = os.path.join(
-                _shm_dir(),
-                f"mv2t-collseg-{os.getpid()}-{id(shmem_comm):x}")
-            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
-            os.ftruncate(fd, size)
+            path, fd = None, -1
+            try:
+                path = os.path.join(
+                    _shm_dir(),
+                    f"mv2t-collseg-{os.getpid()}-{id(shmem_comm):x}")
+                fd = os.open(path,
+                             os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+                os.ftruncate(fd, size)
+            except OSError:
+                n = np.array([-1], np.int64)
+                shmem_comm.bcast(n, root=0)
+                raise
             pb = np.frombuffer(path.encode(), np.uint8)
             n = np.array([pb.size], np.int64)
             shmem_comm.bcast(n, root=0)
@@ -83,13 +115,36 @@ class ShmCollSegment:
         else:
             n = np.zeros(1, np.int64)
             shmem_comm.bcast(n, root=0)
+            if int(n[0]) < 0:
+                raise OSError("leader could not create shm segment")
             pb = np.empty(int(n[0]), np.uint8)
             shmem_comm.bcast(pb, root=0)
             path = pb.tobytes().decode()
-            fd = os.open(path, os.O_RDWR)
+        ok = 1
+        self.mm = None
+        try:
+            if self.rank != 0:
+                fd = os.open(path, os.O_RDWR)
+            self.mm = mmap.mmap(fd, size)
+        except OSError:
+            ok = 0
+        finally:
+            if fd >= 0:
+                os.close(fd)
+        agreed = shmem_comm.allreduce(np.array([ok], np.int64),
+                                      op=None)   # SUM; p == all ok
+        if int(agreed[0]) != self.p:
+            if self.rank == 0:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            raise OSError("shm collective segment mapping failed on a "
+                          "peer (agreed fallback)")
         self.path = path
-        self.mm = mmap.mmap(fd, size)
-        os.close(fd)
+        self._unlinked = False
+        if self.rank == 0:
+            atexit.register(self._unlink)
         buf = np.frombuffer(self.mm, np.uint8)
         o = 0
         self.written = buf[o:o + 8 * self.p].view(np.uint64); o += 8 * self.p
@@ -108,8 +163,8 @@ class ShmCollSegment:
             self.bw[0] = 0
             self.bc[:] = 0
         shmem_comm.barrier()
-        # the file stays linked for the comm's life; leader unlinks on
-        # free (a crashed job leaves it for the OS tmp reaper)
+        # the leader unlinks at free()/Comm.free()/interpreter exit
+        # (atexit); a SIGKILLed job leaves the file to the tmp reaper
 
     # -- polling ---------------------------------------------------------
     @staticmethod
@@ -137,8 +192,8 @@ class ShmCollSegment:
             raise ValueError(f"element size {a.itemsize} exceeds slot "
                              f"length {self.slot}")
         nchunks = max((total + slot - 1) // slot, 1)
-        base = self._base
-        self._base += nchunks
+        base = self._rbase
+        self._rbase += nchunks
         if self.rank != 0:
             w = self.written
             cons = self.consumed
@@ -150,6 +205,7 @@ class ShmCollSegment:
                 chunk = raw[lo:lo + slot]
                 self.rslots[self.rank, cid % self.nslots,
                             :chunk.size] = chunk
+                _fence()
                 w[self.rank] = cid + 1
             return None
         # leader: drain every writer per chunk, folding into its own data
@@ -164,6 +220,7 @@ class ShmCollSegment:
             for r in range(1, self.p):
                 wr = self.written
                 self._wait(lambda: int(wr[r]) > cid)
+                _fence()
                 peer = self.rslots[r, cid % self.nslots, :span]
                 mine = aview[lo:hi].view(a.dtype)
                 folded = op.fn(peer.view(a.dtype), mine)
@@ -179,8 +236,8 @@ class ShmCollSegment:
         raw = a.view(np.uint8).reshape(-1)
         total = raw.size
         nchunks = max((total + self.slot - 1) // self.slot, 1)
-        base = self._base
-        self._base += nchunks
+        base = self._bbase
+        self._bbase += nchunks
         if self.rank == 0:
             for k in range(nchunks):
                 cid = base + k
@@ -190,26 +247,32 @@ class ShmCollSegment:
                 lo = k * self.slot
                 chunk = raw[lo:lo + self.slot]
                 self.bslots[cid % self.nslots, :chunk.size] = chunk
+                _fence()
                 self.bw[0] = cid + 1
             return
         for k in range(nchunks):
             cid = base + k
             self._wait(lambda: int(self.bw[0]) > cid)
+            _fence()
             lo = k * self.slot
             hi = min(lo + self.slot, total)
             raw[lo:hi] = self.bslots[cid % self.nslots, :hi - lo]
             self.bc[self.rank] = cid + 1
+
+    def _unlink(self) -> None:
+        if self.rank == 0 and not self._unlinked:
+            self._unlinked = True
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
 
     def free(self) -> None:
         try:
             self.mm.close()
         except BufferError:   # numpy views still alive — leave to GC
             pass
-        if self.rank == 0:
-            try:
-                os.unlink(self.path)
-            except OSError:
-                pass
+        self._unlink()
 
 
 # ---------------------------------------------------------------------------

@@ -508,6 +508,9 @@ class Comm:
             return
         self.attrs.delete_all(self)
         self.u.comms_by_ctx.pop(self.context_id, None)
+        seg = getattr(self, "_shm_coll_seg", None)
+        if seg not in (None, False):       # slotted shm collective segment
+            seg.free()
         self.freed = True
 
     # ------------------------------------------------------------------

@@ -147,11 +147,15 @@ class Universe:
         (MPIR_Get_contextid); agreeing on max(next_free) via allreduce has
         the same safety property (all members get the same unused id)."""
         import numpy as np
-        from ..coll import api as coll
+        from ..coll import algorithms as alg
         from ..core import op as opmod
         mine = np.array([self._next_ctx], dtype=np.int64)
-        out = np.zeros_like(mine)
-        coll.allreduce(parent_comm, mine, out, 1, None, opmod.MAX)
+        # fixed base algorithm, NOT the tunable dispatch: a forced
+        # two-level algorithm would re-enter build_2level -> split ->
+        # allocate_context_id here (the reference likewise runs the
+        # context-id protocol on its own reserved path, MPIR_Get_contextid)
+        out = alg.allreduce_recursive_doubling(
+            parent_comm, mine, opmod.MAX, parent_comm.next_coll_tag())
         ctx = int(out[0])
         self._next_ctx = ctx + 2
         return ctx

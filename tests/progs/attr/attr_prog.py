@@ -1,0 +1,39 @@
+"""Attribute caching: keyvals, copy-on-dup, delete callbacks
+(ref: attr/attrt, attrdeleteget, fkeyvalcomm)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import mtest
+from mvapich2_tpu.core.attr import Keyval
+
+comm = mtest.init()
+
+deleted = []
+kv = Keyval(copy_fn=lambda obj, k, extra, val: (True, val * 2),
+            delete_fn=lambda obj, k, val, extra: deleted.append(val))
+comm.attrs.set(comm, kv, 10)
+found, val = comm.attrs.get(kv)
+mtest.check(found and val == 10, "set/get")
+
+dup = comm.dup()
+found, val = dup.attrs.get(kv)
+mtest.check(found and val == 20, "copy_fn applied on dup")
+
+dup.attrs.delete(dup, kv)
+mtest.check_eq(deleted, [20], "delete_fn called")
+found, _ = dup.attrs.get(kv)
+mtest.check(not found, "deleted attr gone")
+dup.free()
+
+# no-copy keyval: attribute does not propagate to dup
+kv2 = Keyval()
+comm.attrs.set(comm, kv2, "x")
+d2 = comm.dup()
+found, _ = d2.attrs.get(kv2)
+mtest.check(not found, "default keyval not copied")
+d2.free()
+
+found, val = comm.attrs.get(kv)
+mtest.check(found and val == 10, "original untouched")
+
+mtest.finalize()

@@ -1,0 +1,27 @@
+"""Info objects: set/get/delete/dup/nkeys (ref: info/infotest, infodup)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import mtest
+from mvapich2_tpu.core.info import Info
+
+comm = mtest.init()
+
+info = Info()
+info.set("file", "runfile.txt")
+info.set("soft", "2:4")
+mtest.check_eq(info.nkeys, 2, "nkeys")
+mtest.check_eq(info.get("file"), "runfile.txt", "get")
+mtest.check(info.get("missing") is None, "missing key")
+
+d = info.dup()
+d.set("wdir", "/tmp")
+mtest.check_eq(d.nkeys, 3, "dup nkeys")
+mtest.check_eq(info.nkeys, 2, "dup isolation")
+
+info.delete("soft")
+mtest.check_eq(info.nkeys, 1, "delete")
+keys = [d.nthkey(i) for i in range(d.nkeys)]
+mtest.check("wdir" in keys and "file" in keys, "nthkey enumeration")
+
+mtest.finalize()

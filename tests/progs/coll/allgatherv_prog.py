@@ -1,0 +1,27 @@
+"""allgather/allgatherv uneven counts on comm variants (ref: coll/
+allgatherv*)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+
+comm = mtest.init()
+
+for c, name, must_free in mtest.intracomms(comm):
+    r, s = c.rank, c.size
+    got = c.allgather(np.array([r, r + 100], np.int64))
+    want = np.concatenate([[i, i + 100] for i in range(s)])
+    mtest.check_eq(got, want, f"allgather {name}")
+
+    counts = [2 * i + 1 for i in range(s)]
+    mine = np.full(counts[r], float(r * 11))
+    rv = np.zeros(sum(counts))
+    c.allgatherv(mine, rv, counts)
+    want = np.concatenate([np.full(counts[i], float(i * 11))
+                           for i in range(s)])
+    mtest.check_eq(rv, want, f"allgatherv {name}")
+    if must_free:
+        c.free()
+
+mtest.finalize()

@@ -1,0 +1,30 @@
+"""alltoall/alltoallv with asymmetric counts (ref: coll/alltoallv*)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+# alltoall: rank r sends value r*s+j to rank j
+sb = np.arange(r * s, r * s + s, dtype=np.int64)
+rb = np.zeros(s, np.int64)
+comm.alltoall(sb, rb)
+mtest.check_eq(rb, np.arange(s, dtype=np.int64) * s + r, "alltoall")
+
+# alltoallv: rank r sends (j+1) copies of r*100+j to rank j
+scounts = [j + 1 for j in range(s)]
+sdispls = np.concatenate([[0], np.cumsum(scounts)[:-1]]).tolist()
+sbuf = np.concatenate(
+    [np.full(j + 1, r * 100 + j, np.int64) for j in range(s)])
+rcounts = [r + 1] * s
+rdispls = [i * (r + 1) for i in range(s)]
+rbuf = np.zeros(sum(rcounts), np.int64)
+comm.alltoallv(sbuf, scounts, sdispls, rbuf, rcounts, rdispls)
+want = np.concatenate(
+    [np.full(r + 1, i * 100 + r, np.int64) for i in range(s)])
+mtest.check_eq(rbuf, want, "alltoallv")
+
+mtest.finalize()

@@ -1,0 +1,25 @@
+"""Barrier ordering: a rank cannot exit before all have entered
+(ref: coll/barrier variants)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import time
+import numpy as np
+import mtest
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+for round_ in range(3):
+    if r == round_ % s:
+        time.sleep(0.2)     # late entrant
+    t0 = time.monotonic()
+    comm.barrier()
+    dt = time.monotonic() - t0
+    # every rank must have waited for the late one (all-but-late see >=
+    # ~the sleep remaining); just verify no deadlock + data after barrier
+    flag = comm.allreduce(np.array([round_], np.int64))
+    mtest.check_eq(flag[0], round_ * s, f"post-barrier allreduce {round_}")
+    del dt, t0
+
+mtest.finalize()

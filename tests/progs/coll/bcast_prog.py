@@ -1,0 +1,26 @@
+"""bcast over roots/sizes/dtypes and comm variants (ref: coll/bcasttest)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+
+comm = mtest.init()
+
+for c, name, must_free in mtest.intracomms(comm):
+    for root in range(min(c.size, 3)):
+        for n in (1, 33, 4096):
+            buf = (np.arange(n, dtype=np.float64) * (root + 2)
+                   if c.rank == root else np.zeros(n))
+            c.bcast(buf, root=root)
+            mtest.check_eq(buf, np.arange(n, dtype=np.float64) * (root + 2),
+                           f"bcast {name} root={root} n={n}")
+    ibuf = np.full(7, c.rank, np.int32)
+    if c.rank == 0:
+        ibuf[:] = 42
+    c.bcast(ibuf, root=0)
+    mtest.check_eq(ibuf, np.full(7, 42, np.int32), f"bcast int {name}")
+    if must_free:
+        c.free()
+
+mtest.finalize()

@@ -1,0 +1,42 @@
+"""gather/scatter/gatherv/scatterv (ref: coll/gather*, scatter*)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+# gather
+out = comm.gather(np.array([r * 3, r * 3 + 1], np.int32), root=0)
+if r == 0:
+    mtest.check_eq(out, np.arange(2 * s, dtype=np.int32) + np.repeat(
+        np.arange(s, dtype=np.int32), 2), "gather")
+
+# scatter
+sbuf = (np.arange(2 * s, dtype=np.float64) if r == 0
+        else np.zeros(2 * s))
+rbuf = np.zeros(2)
+comm.scatter(sbuf, rbuf, root=0)
+mtest.check_eq(rbuf, np.array([2 * r, 2 * r + 1], np.float64), "scatter")
+
+# gatherv: rank i contributes i+1 elements
+counts = [i + 1 for i in range(s)]
+total = sum(counts)
+mine = np.full(r + 1, float(r), np.float64)
+rv = np.zeros(total) if r == 0 else np.zeros(total)
+comm.gatherv(mine, rv, counts, root=0)
+if r == 0:
+    want = np.concatenate([np.full(i + 1, float(i)) for i in range(s)])
+    mtest.check_eq(rv, want, "gatherv")
+
+# scatterv with displacements (reversed layout)
+displs = [total - sum(counts[: i + 1]) for i in range(s)]
+sv = np.arange(total, dtype=np.float64) if r == 0 else np.zeros(total)
+rsv = np.zeros(counts[r])
+comm.scatterv(sv, counts, displs, rsv, root=0)
+mtest.check_eq(rsv, np.arange(total, dtype=np.float64)[
+    displs[r]: displs[r] + counts[r]], "scatterv")
+
+mtest.finalize()

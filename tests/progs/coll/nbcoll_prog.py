@@ -1,0 +1,42 @@
+"""Nonblocking collectives: ibarrier/ibcast/iallreduce/iallgather/ialltoall
+(ref: coll/nonblocking*, sched-driven per mpid_sched.c shape)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+from mvapich2_tpu.core import request as rq
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+req = comm.ibarrier()
+req.wait()
+
+buf = np.full(9, 3.0) if r == 0 else np.zeros(9)
+comm.ibcast(buf, root=0).wait()
+mtest.check_eq(buf, np.full(9, 3.0), "ibcast")
+
+sb = np.full(5, float(r + 1))
+rb = np.zeros(5)
+comm.iallreduce(sb, rb).wait()
+mtest.check_eq(rb, np.full(5, s * (s + 1) / 2), "iallreduce")
+
+ag = np.zeros(s, np.int64)
+comm.iallgather(np.array([r * 2], np.int64), ag).wait()
+mtest.check_eq(ag, np.arange(s, dtype=np.int64) * 2, "iallgather")
+
+a2a_s = np.arange(r * s, r * s + s, dtype=np.int64)
+a2a_r = np.zeros(s, np.int64)
+comm.ialltoall(a2a_s, a2a_r).wait()
+mtest.check_eq(a2a_r, np.arange(s, dtype=np.int64) * s + r, "ialltoall")
+
+# several outstanding nonblocking collectives issued together
+b1 = np.full(4, 1.0) if r == 0 else np.zeros(4)
+b2 = np.zeros(2)
+reqs = [comm.ibcast(b1, root=0), comm.iallreduce(np.full(2, 1.0), b2)]
+rq.waitall(reqs)
+mtest.check_eq(b1, np.full(4, 1.0), "overlapped ibcast")
+mtest.check_eq(b2, np.full(2, float(s)), "overlapped iallreduce")
+
+mtest.finalize()

@@ -1,0 +1,31 @@
+"""reduce_scatter_block + scan/exscan (ref: coll/redscat*, scantst)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+from mvapich2_tpu.core import op as ops
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+B = 4
+
+sb = np.arange(s * B, dtype=np.float64) + r
+rb = np.zeros(B)
+comm.reduce_scatter_block(sb, rb)
+want = (np.arange(r * B, (r + 1) * B, dtype=np.float64) * s
+        + s * (s - 1) / 2)
+mtest.check_eq(rb, want, "reduce_scatter_block")
+
+sc = comm.scan(np.full(3, float(r + 1)))
+mtest.check_eq(sc, np.full(3, sum(range(1, r + 2)), np.float64), "scan")
+
+ex = comm.exscan(np.full(3, float(r + 1)))
+if r > 0:
+    mtest.check_eq(ex, np.full(3, sum(range(1, r + 1)), np.float64),
+                   "exscan")
+
+mx = comm.scan(np.array([float(r)]), op=ops.MAX)
+mtest.check_eq(mx[0], float(r), "scan max")
+
+mtest.finalize()

@@ -1,0 +1,48 @@
+"""reduce with builtin ops incl. MINLOC/MAXLOC (ref: coll/red*, minmaxloc)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+from mvapich2_tpu.core import op as ops
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+for root in range(min(s, 2)):
+    data = np.arange(10, dtype=np.float64) + r
+    out = comm.reduce(data, op=ops.SUM, root=root)
+    if r == root:
+        want = np.arange(10, dtype=np.float64) * s + s * (s - 1) / 2
+        mtest.check_eq(out, want, f"reduce sum root={root}")
+    out = comm.reduce(data, op=ops.MAX, root=root)
+    if r == root:
+        mtest.check_eq(out, np.arange(10, dtype=np.float64) + s - 1,
+                       "reduce max")
+    out = comm.reduce(data, op=ops.MIN, root=root)
+    if r == root:
+        mtest.check_eq(out, np.arange(10, dtype=np.float64), "reduce min")
+
+prod = comm.reduce(np.full(3, 2.0), op=ops.PROD, root=0)
+if r == 0:
+    mtest.check_eq(prod, np.full(3, 2.0 ** s), "reduce prod")
+
+# logical/bitwise
+lv = comm.allreduce(np.array([r % 2, 1], np.int32), op=ops.LAND)
+mtest.check_eq(lv, np.array([1 if s == 1 else 0, 1], np.int32), "land")
+bv = comm.allreduce(np.array([1 << r], np.int64), op=ops.BOR)
+mtest.check_eq(bv[0], (1 << s) - 1, "bor")
+
+# MINLOC/MAXLOC on (val, loc) structured vectors
+from mvapich2_tpu.core import datatype as dt
+pair = np.zeros(2, dtype=dt.DOUBLE_INT.basic)
+pair["val"] = [float((r + 1) % s), float(s - r)]
+pair["loc"] = r
+mn = comm.allreduce(pair, op=ops.MINLOC, datatype=dt.DOUBLE_INT, count=2)
+mtest.check_eq(mn["val"][0], 0.0, "minloc val")
+mtest.check_eq(mn["loc"][0], s - 1, "minloc loc")
+mx = comm.allreduce(pair, op=ops.MAXLOC, datatype=dt.DOUBLE_INT, count=2)
+mtest.check_eq(mx["val"][1], float(s), "maxloc val")
+mtest.check_eq(mx["loc"][1], 0, "maxloc loc")
+
+mtest.finalize()

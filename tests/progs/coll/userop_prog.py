@@ -1,0 +1,32 @@
+"""User-defined ops: commutative and non-commutative reduction order
+(ref: coll/op_commutative, opband-style)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+from mvapich2_tpu.core.op import create_op
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+# commutative user op: elementwise hypot
+hyp = create_op(lambda a, b: np.sqrt(a * a + b * b), commute=True,
+                name="hypot")
+out = comm.allreduce(np.full(4, 3.0), op=hyp)
+mtest.check(np.allclose(out, np.full(4, 3.0 * np.sqrt(s))), "hypot")
+
+# non-commutative user op: 2x2 matrix multiply in rank order encoded as
+# flat vec [a,b,c,d]; result must be M_0 @ M_1 @ ... @ M_{s-1}
+def matmul2(invec, inout):
+    a = invec.reshape(2, 2)
+    b = inout.reshape(2, 2)
+    return (a @ b).reshape(-1)
+
+mm = create_op(matmul2, commute=False, name="matmul2")
+mine = np.array([1.0, float(r + 1), 0.0, 1.0])
+got = comm.allreduce(mine, op=mm)
+want = np.array([1.0, sum(range(1, s + 1)), 0.0, 1.0])
+mtest.check(np.allclose(got, want), f"noncommutative order: {got}")
+
+mtest.finalize()

@@ -1,0 +1,36 @@
+"""comm_create from subgroups + group ops (ref: comm/comm_create_group,
+group/grouptest)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+from mvapich2_tpu.core.group import Group
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+world_g = comm.group
+evens = Group([i for i in range(s) if i % 2 == 0])
+sub = comm.create(evens)
+if r % 2 == 0:
+    mtest.check(sub is not None, "member got comm")
+    mtest.check_eq(sub.rank, r // 2, "create rank order")
+    tot = sub.allreduce(np.array([r], np.int64))
+    mtest.check_eq(tot[0], sum(i for i in range(s) if i % 2 == 0),
+                   "subcomm allreduce")
+    sub.free()
+else:
+    mtest.check(sub is None, "non-member got None")
+
+# group algebra
+odds = world_g.difference(evens)
+mtest.check_eq(odds.size, s // 2, "difference size")
+uni = evens.union(odds)
+mtest.check_eq(uni.size, s, "union size")
+inter = evens.intersection(world_g)
+mtest.check_eq(inter.size, (s + 1) // 2, "intersection size")
+tr = world_g.translate_ranks(list(range(evens.size)), evens)
+mtest.check(all(t is not None for t in tr), "translate")
+
+mtest.finalize()

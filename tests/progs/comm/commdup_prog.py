@@ -1,0 +1,36 @@
+"""comm dup/compare/free + context isolation (ref: comm/dup, ctxalloc)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+dup = comm.dup()
+mtest.check_eq(dup.rank, r, "dup rank")
+mtest.check_eq(dup.size, s, "dup size")
+mtest.check_eq(comm.compare(dup), "congruent", "compare dup")
+mtest.check_eq(comm.compare(comm), "ident", "compare self")
+
+# context isolation: same tag on comm vs dup must not cross-match
+if s >= 2 and r < 2:
+    peer = 1 - r
+    a = comm.isend(np.array([1], np.int32), peer, tag=7)
+    b = dup.isend(np.array([2], np.int32), peer, tag=7)
+    gd = np.zeros(1, np.int32)
+    gc = np.zeros(1, np.int32)
+    dup.recv(gd, peer, tag=7)
+    comm.recv(gc, peer, tag=7)
+    a.wait(); b.wait()
+    mtest.check_eq(gc[0], 1, "world-context payload")
+    mtest.check_eq(gd[0], 2, "dup-context payload")
+
+# dup of dup, then free both
+dd = dup.dup()
+mtest.check_eq(dd.allreduce(np.array([1.0]))[0], float(s), "dup-dup coll")
+dd.free()
+dup.free()
+
+mtest.finalize()

@@ -1,0 +1,36 @@
+"""comm split colors/keys + split_type_shared (ref: comm/cmsplit*)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+from mvapich2_tpu.core.comm import UNDEFINED
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+# color by parity, key reversed: ranks ordered by descending world rank
+sub = comm.split(r % 2, s - r)
+n_same = (s + 1 - (r % 2)) // 2 if s % 2 else s // 2
+mtest.check_eq(sub.size, n_same, "split size")
+got = sub.allgather(np.array([r], np.int64))
+want = sorted([i for i in range(s) if i % 2 == r % 2], reverse=True)
+mtest.check_eq(got, want, "split ordering by key")
+sub.free()
+
+# UNDEFINED color: excluded ranks get None
+sub2 = comm.split(0 if r == 0 else UNDEFINED, 0)
+if r == 0:
+    mtest.check(sub2 is not None and sub2.size == 1, "color-0 comm")
+    sub2.free()
+else:
+    mtest.check(sub2 is None, "UNDEFINED color yields None")
+
+# split_type_shared: all ranks of one node (here: all)
+node = comm.split_type_shared()
+mtest.check(node.size >= 1, "split_type_shared size")
+tot = node.allreduce(np.array([1], np.int64))
+mtest.check_eq(tot[0], node.size, "node-comm coll")
+node.free()
+
+mtest.finalize()

@@ -1,0 +1,38 @@
+"""Intercommunicators: create/merge, remote group, inter-collectives
+(ref: comm/ic1, icm, iccreate)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+from mvapich2_tpu import mpi
+from mvapich2_tpu.core.status import PROC_NULL, ROOT
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+if s >= 2:
+    half = comm.split(0 if r < (s + 1) // 2 else 1, r)
+    lo = r < (s + 1) // 2
+    inter = mpi.Intercomm_create(half, 0, comm, 0 if not lo else (s + 1) // 2)
+    mtest.check_eq(inter.remote_size, s - half.size, "remote size")
+
+    # inter bcast: low-group root 0 broadcasts to the high group
+    buf = np.full(4, 5.0) if (lo and half.rank == 0) else np.zeros(4)
+    if lo:
+        root = ROOT if half.rank == 0 else PROC_NULL
+    else:
+        root = 0
+    inter.bcast(buf, root=root)
+    if not lo:
+        mtest.check_eq(buf, np.full(4, 5.0), "inter bcast payload")
+
+    # merge and verify total size; high group appended after low
+    merged = mpi.Intercomm_merge(inter, high=not lo)
+    mtest.check_eq(merged.size, s, "merged size")
+    tot = merged.allreduce(np.array([1], np.int64))
+    mtest.check_eq(tot[0], s, "merged coll")
+    merged.free()
+    half.free()
+
+mtest.finalize()

@@ -1,0 +1,46 @@
+"""MPI_Pack/Unpack/Pack_size API surface (ref: datatype/pack-tests)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+from mvapich2_tpu import mpi
+from mvapich2_tpu.core import datatype as dt
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+sz = mpi.Pack_size(10, dt.DOUBLE)
+mtest.check_eq(sz, 80, "Pack_size contiguous")
+
+outbuf = np.zeros(200, np.uint8)
+pos = 0
+pos = mpi.Pack(np.arange(10, dtype=np.float64), 10, dt.DOUBLE, outbuf, pos)
+mtest.check_eq(pos, 80, "Pack position")
+pos = mpi.Pack(np.array([7, 8, 9], np.int32), 3, dt.INT, outbuf, pos)
+mtest.check_eq(pos, 92, "Pack position 2")
+
+d = np.zeros(10)
+i = np.zeros(3, np.int32)
+upos = 0
+upos = mpi.Unpack(outbuf, upos, d, 10, dt.DOUBLE)
+upos = mpi.Unpack(outbuf, upos, i, 3, dt.INT)
+mtest.check_eq(d, np.arange(10, dtype=np.float64), "Unpack doubles")
+mtest.check_eq(i, np.array([7, 8, 9], np.int32), "Unpack ints")
+mtest.check_eq(upos, 92, "Unpack position")
+
+# packed data is wire-compatible: send packed, recv typed
+if s >= 2 and r < 2:
+    peer = 1 - r
+    if r == 0:
+        comm.send(outbuf[:92], 1, tag=1)
+    else:
+        blob = np.zeros(92, np.uint8)
+        comm.recv(blob, 0, tag=1)
+        dd = np.zeros(10)
+        mpi.Unpack(blob, 0, dd, 10, dt.DOUBLE)
+        mtest.check_eq(dd, np.arange(10, dtype=np.float64),
+                       "packed over wire")
+
+comm.barrier()
+mtest.finalize()

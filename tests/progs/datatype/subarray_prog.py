@@ -1,0 +1,32 @@
+"""subarray datatype: 2-D halo-block exchange (ref: datatype/subarray,
+the stencil ghost-cell pattern)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+from mvapich2_tpu.core import datatype as dt
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+# interior 4x4 block of an 8x8 grid
+sub = dt.create_subarray([8, 8], [4, 4], [2, 2], dt.DOUBLE).commit()
+grid = (np.arange(64, dtype=np.float64).reshape(8, 8) + 1000 * r)
+packed = sub.pack(grid, 1)
+mtest.check_eq(np.frombuffer(packed.tobytes(), np.float64),
+               grid[2:6, 2:6].reshape(-1), "subarray pack")
+
+if s >= 2 and r < 2:
+    peer = 1 - r
+    dstg = np.zeros((8, 8))
+    comm.sendrecv(grid, peer, 3, dstg, peer, 3,
+                  send_count=1, send_datatype=sub,
+                  recv_count=1, recv_datatype=sub)
+    want = np.zeros((8, 8))
+    want[2:6, 2:6] = (np.arange(64, dtype=np.float64).reshape(8, 8)
+                      + 1000 * peer)[2:6, 2:6]
+    mtest.check_eq(dstg, want, "subarray exchange")
+
+comm.barrier()
+mtest.finalize()

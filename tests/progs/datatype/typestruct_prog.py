@@ -1,0 +1,58 @@
+"""struct/indexed/resized datatypes: pack/unpack + wire roundtrip
+(ref: datatype/struct-pack, indexed tests)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+from mvapich2_tpu.core import datatype as dt
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+# indexed: scattered blocks
+idx = dt.create_indexed([2, 1, 3], [0, 4, 9], dt.INT).commit()
+src = np.arange(16, dtype=np.int32)
+packed = idx.pack(src, 1)
+mtest.check_eq(np.frombuffer(packed.tobytes(), np.int32),
+               np.array([0, 1, 4, 9, 10, 11], np.int32), "indexed pack")
+back = np.zeros(16, np.int32)
+idx.unpack(packed, back, 1)
+want = np.zeros(16, np.int32)
+for b, d in ((2, 0), (1, 4), (3, 9)):
+    want[d: d + b] = np.arange(d, d + b)
+mtest.check_eq(back, want, "indexed unpack")
+
+# struct over a heterogeneous record: int32 + 2x float64
+rec = np.dtype([("a", np.int32), ("pad", np.int32), ("xy", np.float64, 2)])
+st_dt = dt.create_struct([1, 2], [0, 8], [dt.INT, dt.DOUBLE]).commit()
+buf = np.zeros(3, rec)
+buf["a"] = [1, 2, 3]
+buf["xy"] = [[1.5, 2.5], [3.5, 4.5], [5.5, 6.5]]
+packed = st_dt.pack(buf, 3)
+mtest.check_eq(len(packed), 3 * (4 + 16), "struct packed size")
+
+out = np.zeros(3, rec)
+st_dt.unpack(packed, out, 3)
+mtest.check_eq(out["a"], buf["a"], "struct unpack ints")
+mtest.check_eq(out["xy"], buf["xy"], "struct unpack doubles")
+
+# resized: extent change affects count-striding
+res = dt.create_resized(dt.create_contiguous(2, dt.DOUBLE), 0, 32).commit()
+mtest.check_eq(res.extent, 32, "resized extent")
+src2 = np.arange(8, dtype=np.float64)
+p2 = res.pack(src2, 2)
+mtest.check_eq(np.frombuffer(p2.tobytes(), np.float64),
+               np.array([0.0, 1.0, 4.0, 5.0]), "resized pack")
+
+# wire roundtrip of indexed type
+if s >= 2 and r < 2:
+    peer = 1 - r
+    dst = np.zeros(16, np.int32)
+    comm.sendrecv(src, peer, 7, dst, peer, 7,
+                  send_count=1, send_datatype=idx,
+                  recv_count=1, recv_datatype=idx)
+    mtest.check_eq(dst, want, "indexed wire roundtrip")
+
+comm.barrier()
+mtest.finalize()

@@ -1,0 +1,56 @@
+"""Derived datatypes over the wire: vector/hvector strided send/recv
+(ref: datatype/transpose-style vector tests)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+from mvapich2_tpu.core import datatype as dt
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+if s >= 2 and r < 2:
+    peer = 1 - r
+    # send every other element of a 16-vector (one column of an 8x2 matrix)
+    vec = dt.create_vector(8, 1, 2, dt.DOUBLE).commit()
+    src = np.arange(16, dtype=np.float64) + 100 * r
+    dstv = np.zeros(16)
+    st = comm.sendrecv(src, peer, 1, dstv, peer, 1,
+                       send_count=1, send_datatype=vec,
+                       recv_count=1, recv_datatype=vec)
+    want = np.zeros(16)
+    want[0::2] = (np.arange(16, dtype=np.float64) + 100 * peer)[0::2]
+    mtest.check_eq(dstv, want, "vector->vector")
+    mtest.check_eq(st.get_count(vec), 1, "get_count(vector)")
+    mtest.check_eq(st.get_elements(vec), 8, "get_elements(vector)")
+
+    # vector send received as contiguous: strided gather on the send side
+    dstc = np.zeros(8)
+    if r == 0:
+        comm.send(src, 1, tag=2, count=1, datatype=vec)
+        comm.recv(dstc, 1, tag=3)
+    else:
+        comm.recv(dstc, 0, tag=2)
+        mtest.check_eq(dstc, src[0::2] - 100 + 0, "vector->contig")
+        comm.send(src, 0, tag=3, count=1, datatype=dt.create_contiguous(
+            8, dt.DOUBLE).commit())
+    if r == 0:
+        mtest.check_eq(dstc, (np.arange(8, dtype=np.float64) + 100),
+                       "contig(8) from rank1")
+
+    # hvector with byte stride
+    hv = dt.create_hvector(4, 2, 32, dt.DOUBLE).commit()
+    hsrc = np.arange(16, dtype=np.float64) * (r + 1)
+    hdst = np.zeros(16)
+    comm.sendrecv(hsrc, peer, 4, hdst, peer, 4,
+                  send_count=1, send_datatype=hv,
+                  recv_count=1, recv_datatype=hv)
+    want = np.zeros(16)
+    for blk in range(4):
+        want[blk * 4: blk * 4 + 2] = hsrc[blk * 4: blk * 4 + 2] \
+            / (r + 1) * (peer + 1)
+    mtest.check_eq(hdst, want, "hvector roundtrip")
+
+comm.barrier()
+mtest.finalize()

@@ -1,0 +1,48 @@
+"""Error classes + exceptions on invalid usage (ref: errhan/errstring,
+adderr)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+from mvapich2_tpu.core import errors as err
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+try:
+    comm.send(np.zeros(1), dest=s + 5)
+    mtest.error("send to invalid rank did not raise")
+except err.MPIException as e:
+    mtest.check_eq(e.error_class, err.MPI_ERR_RANK, "invalid rank class")
+
+try:
+    comm.split(0, 0).free() if False else None
+    bad = comm.bcast(np.zeros(1), root=-3)
+    mtest.error("bcast invalid root did not raise")
+except err.MPIException as e:
+    mtest.check(e.error_class in (err.MPI_ERR_ROOT, err.MPI_ERR_RANK),
+                "invalid root class")
+
+# error strings exist for every class
+for cls in (err.MPI_ERR_RANK, err.MPI_ERR_TAG, err.MPI_ERR_COMM,
+            err.MPI_ERR_TRUNCATE, err.MPI_ERR_OTHER):
+    msg = err.error_string(cls)
+    mtest.check(isinstance(msg, str) and msg, f"error_string({cls})")
+
+# truncation: recv buffer smaller than message
+if s >= 2 and r < 2:
+    peer = 1 - r
+    if r == 0:
+        comm.send(np.zeros(8), 1, tag=1)
+        comm.recv(np.zeros(1), 1, tag=2)
+    else:
+        try:
+            comm.recv(np.zeros(2), 0, tag=1)
+            mtest.error("truncation did not raise")
+        except err.MPIException as e:
+            mtest.check_eq(e.error_class, err.MPI_ERR_TRUNCATE, "truncate class")
+        comm.send(np.zeros(1), 0, tag=2)
+
+comm.barrier()
+mtest.finalize()

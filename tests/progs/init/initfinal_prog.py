@@ -1,0 +1,33 @@
+"""Init/Finalize/world attributes/Wtime (ref: init/initstat, timer tests)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import mtest
+from mvapich2_tpu import mpi
+
+mtest.check(not mpi.Initialized(), "not initialized before Init")
+comm = mtest.init()
+mtest.check(mpi.Initialized(), "Initialized after Init")
+mtest.check(not mpi.Finalized(), "not finalized yet")
+
+t0 = mpi.Wtime()
+t1 = mpi.Wtime()
+mtest.check(t1 >= t0, "Wtime monotonic")
+mtest.check(mpi.Wtick() > 0, "Wtick positive")
+
+name = mpi.Get_processor_name()
+mtest.check(isinstance(name, str) and name, "processor name")
+
+ver, subver = mpi.Get_version()
+mtest.check(ver >= 3, "MPI version >= 3")
+lib = mpi.Get_library_version()
+mtest.check("mvapich2_tpu" in lib or "MVAPICH" in lib.upper(),
+            "library version string")
+
+self_comm = mpi.COMM_SELF
+mtest.check_eq(self_comm.size, 1, "COMM_SELF size")
+import numpy as np
+out = self_comm.allreduce(np.array([5.0]))
+mtest.check_eq(out[0], 5.0, "COMM_SELF allreduce")
+
+mtest.finalize()

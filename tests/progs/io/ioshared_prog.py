@@ -1,0 +1,43 @@
+"""Shared/ordered file pointers (ref: io/shared_fp, ordered_fp)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import tempfile
+import numpy as np
+import mtest
+from mvapich2_tpu import mpi
+from mvapich2_tpu.io import adio
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+job = os.environ.get("MV2T_KVS", "local").replace("/", "_").replace(
+    ":", "_")
+path = os.path.join(tempfile.gettempdir(), f"mv2t_ioshared_{job}.bin")
+
+fh = mpi.File_open(comm, path, adio.MODE_RDWR | adio.MODE_CREATE)
+
+# ordered write: rank order deterministic
+fh.write_ordered(np.full(4, float(r), np.float64))
+comm.barrier()
+if r == 0:
+    raw = np.fromfile(path, np.float64)
+    want = np.concatenate([np.full(4, float(i)) for i in range(s)])
+    mtest.check_eq(raw, want, "write_ordered layout")
+
+# shared-pointer writes land in disjoint regions (order unspecified)
+fh.seek_shared(s * 4 * 8)
+comm.barrier()
+fh.write_shared(np.full(2, float(100 + r), np.float64))
+comm.barrier()
+if r == 0:
+    raw = np.fromfile(path, np.float64)[s * 4:]
+    got = sorted(raw.tolist())
+    want = sorted(sum([[100.0 + i] * 2 for i in range(s)], []))
+    mtest.check_eq(got, want, "write_shared disjoint")
+fh.close()
+comm.barrier()
+if r == 0:
+    os.unlink(path)
+
+mtest.finalize()

@@ -1,0 +1,38 @@
+"""MPI_T tools interface: cvar enumeration/read, pvar sessions
+(ref: mpi_t/mpi_t_str, cvarwrite)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import mtest
+from mvapich2_tpu import mpit
+
+comm = mtest.init()
+
+n = mpit.cvar_get_num()
+mtest.check(n > 10, f"cvar count {n}")
+for i in range(n):
+    info = mpit.cvar_get_info(i)
+    mtest.check("name" in info and info["name"], f"cvar {i} info")
+    mpit.cvar_read(i)   # must not raise
+
+idx = mpit.cvar_get_index("ALLREDUCE_ALGO")
+mtest.check(idx >= 0, "known cvar index")
+
+npv = mpit.pvar_get_num()
+mtest.check(npv > 0, "pvar count")
+sess = mpit.pvar_session_create()
+h = sess.handle_alloc("recvq_match_attempts")
+sess.start(h)
+
+# drive some traffic so counters move
+import numpy as np
+comm.allreduce(np.ones(128))
+comm.barrier()
+v = sess.read(h)
+mtest.check(v >= 0, "pvar session delta")
+sess.handle_free(h)
+
+cats = mpit.category_names()
+mtest.check(len(cats) >= 1, "categories exist")
+
+mtest.finalize()

@@ -1,0 +1,69 @@
+"""mtest — shared harness for conformance programs.
+
+Analog of the reference suite's test/mpi/util/mtest.c:34-80: init/finalize
+wrappers, communicator iterators, error accounting, and the exact
+"No Errors" success contract checked by bin/runtests (runtests.in shape).
+
+Programs do:
+
+    import mtest
+    comm = mtest.init()
+    ...mtest.check(cond, "msg")...
+    mtest.finalize()          # prints 'No Errors' on rank 0 iff no rank
+                              # recorded an error; exits nonzero otherwise
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+from mvapich2_tpu import mpi  # noqa: E402
+
+_errs = 0
+
+
+def error(msg: str) -> None:
+    global _errs
+    _errs += 1
+    r = mpi.COMM_WORLD.rank if mpi.Initialized() else -1
+    print(f"rank {r}: ERROR: {msg}", file=sys.stderr, flush=True)
+
+
+def check(cond, msg: str) -> bool:
+    if not cond:
+        error(msg)
+    return bool(cond)
+
+
+def check_eq(got, want, msg: str) -> bool:
+    ok = np.array_equal(np.asarray(got), np.asarray(want))
+    if not ok:
+        error(f"{msg}: got {got!r} want {want!r}")
+    return ok
+
+
+def init(required: int = mpi.THREAD_SINGLE):
+    mpi.Init(required)
+    return mpi.COMM_WORLD
+
+
+def intracomms(comm):
+    """Communicator iterator (MTestGetIntracomm shape): yields (comm,
+    name, must_free) variants — world, dup, reversed-rank split, and the
+    even/odd halves when size allows."""
+    yield comm, "world", False
+    yield comm.dup(), "dup", True
+    yield comm.split(0, comm.size - comm.rank), "rev", True
+    if comm.size >= 4:
+        yield comm.split(comm.rank % 2, comm.rank), "halves", True
+
+
+def finalize() -> None:
+    comm = mpi.COMM_WORLD
+    tot = int(comm.allreduce(np.array([_errs], np.int64))[0])
+    if comm.rank == 0 and tot == 0:
+        print("No Errors")
+    mpi.Finalize()
+    sys.exit(1 if tot else 0)

@@ -1,0 +1,26 @@
+"""Wildcard source/tag matching + status interrogation (ref: pt2pt/anyall,
+status/*)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+from mvapich2_tpu.core.status import ANY_SOURCE, ANY_TAG
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+if r == 0:
+    seen = set()
+    for _ in range(s - 1):
+        buf = np.zeros(2, np.int64)
+        st = comm.recv(buf, ANY_SOURCE, ANY_TAG)
+        mtest.check_eq(st.source, buf[0], "status.source vs payload")
+        mtest.check_eq(st.tag, 10 + buf[0], "status.tag vs payload")
+        mtest.check_eq(st.count, 16, "status count")
+        seen.add(int(buf[0]))
+    mtest.check_eq(sorted(seen), list(range(1, s)), "all senders seen")
+else:
+    comm.send(np.array([r, r * r], np.int64), 0, tag=10 + r)
+
+mtest.finalize()

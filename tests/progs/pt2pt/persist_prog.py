@@ -1,0 +1,30 @@
+"""Persistent requests: send_init/recv_init restarted rounds (ref: pt2pt/
+ sendself, persistent patterns)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+if s >= 2 and r < 2:
+    peer = 1 - r
+    sbuf = np.zeros(8, np.float64)
+    rbuf = np.zeros(8, np.float64)
+    ps = comm.send_init(sbuf, peer, tag=2)
+    pr = comm.recv_init(rbuf, peer, tag=2)
+    for round_ in range(5):
+        sbuf[:] = r * 1000 + round_
+        pr.start()
+        ps.start()
+        ps.wait()
+        pr.wait()
+        mtest.check_eq(rbuf, np.full(8, peer * 1000 + round_),
+                       f"round {round_}")
+    ps.free()
+    pr.free()
+
+comm.barrier()
+mtest.finalize()

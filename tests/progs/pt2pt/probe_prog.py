@@ -1,0 +1,38 @@
+"""probe/iprobe/improbe/mrecv + status fields (ref: pt2pt/probe*, mprobe)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+from mvapich2_tpu.core.status import ANY_SOURCE, ANY_TAG
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+if s >= 2:
+    if r == 0:
+        comm.send(np.arange(7, dtype=np.float64), 1, tag=3)
+        comm.send(np.arange(9, dtype=np.int32), 1, tag=4)
+    elif r == 1:
+        st = comm.probe(source=0, tag=3)
+        mtest.check_eq(st.source, 0, "probe source")
+        mtest.check_eq(st.tag, 3, "probe tag")
+        mtest.check_eq(st.count, 7 * 8, "probe count")
+        buf = np.zeros(7, np.float64)
+        comm.recv(buf, 0, 3)
+        mtest.check_eq(buf, np.arange(7, dtype=np.float64), "probed payload")
+
+        # improbe + mrecv: matched message removed from matching
+        msg = None
+        while msg is None:
+            msg = comm.improbe(ANY_SOURCE, ANY_TAG)
+        buf2 = np.zeros(9, np.int32)
+        st2 = comm.mrecv(msg, buf2)
+        mtest.check_eq(st2.tag, 4, "mrecv tag")
+        mtest.check_eq(buf2, np.arange(9, dtype=np.int32), "mrecv payload")
+
+        # iprobe on empty queue returns None
+        mtest.check(comm.iprobe(source=0, tag=99) is None,
+                    "iprobe matched nonexistent message")
+
+mtest.finalize()

@@ -1,0 +1,49 @@
+"""Send modes: ssend/issend/bsend/rsend semantics (ref: pt2pt/*send*).
+
+rsend note: ready mode is treated as standard mode (an implementation is
+permitted to do so; erroneous-usage detection is intentionally dropped —
+see core/comm.py rsend).
+"""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+if s >= 2 and r < 2:
+    peer = 1 - r
+    # issend completes only after the receive is posted
+    req = comm.issend(np.full(4, 7.0 + r), peer, tag=1)
+    got = np.zeros(4)
+    comm.recv(got, peer, tag=1)
+    req.wait()
+    mtest.check_eq(got, np.full(4, 7.0 + peer), "issend payload")
+
+    # bsend returns immediately (buffered), recv later
+    comm.bsend(np.arange(5, dtype=np.int32) * (r + 1), peer, tag=2)
+    got2 = np.zeros(5, np.int32)
+    comm.recv(got2, peer, tag=2)
+    mtest.check_eq(got2, np.arange(5, dtype=np.int32) * (peer + 1),
+                   "bsend payload")
+
+    # rsend (as-standard semantics)
+    if r == 0:
+        got3 = np.zeros(3, np.int64)
+        comm.recv(got3, 1, tag=3)
+        mtest.check_eq(got3, np.array([9, 9, 9], np.int64), "rsend payload")
+    else:
+        comm.rsend(np.array([9, 9, 9], np.int64), 0, tag=3)
+
+    # ssend blocking form
+    if r == 0:
+        comm.ssend(np.array([1.5]), 1, tag=4)
+    else:
+        g = np.zeros(1)
+        comm.recv(g, 0, tag=4)
+        mtest.check_eq(g[0], 1.5, "ssend payload")
+
+comm.barrier()
+mtest.finalize()

@@ -1,0 +1,37 @@
+"""pt2pt basics across dtypes/tags (ref suite pattern: pt2pt/sendrecv*)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+for dt in (np.int32, np.int64, np.float32, np.float64, np.uint8):
+    mine = np.arange(16, dtype=dt) + dt(r)
+    got = np.zeros(16, dt)
+    comm.sendrecv(mine, (r + 1) % s, 5, got, (r - 1) % s, 5)
+    mtest.check_eq(got, np.arange(16, dtype=dt) + dt((r - 1) % s),
+                   f"ring {np.dtype(dt).name}")
+
+# distinct tags don't cross-match
+if s >= 2 and r < 2:
+    peer = 1 - r
+    a = comm.isend(np.array([10 + r], np.int32), peer, tag=1)
+    b = comm.isend(np.array([20 + r], np.int32), peer, tag=2)
+    g2 = np.zeros(1, np.int32)
+    g1 = np.zeros(1, np.int32)
+    comm.recv(g2, peer, tag=2)
+    comm.recv(g1, peer, tag=1)
+    a.wait(); b.wait()
+    mtest.check_eq(g1[0], 10 + peer, "tag 1 payload")
+    mtest.check_eq(g2[0], 20 + peer, "tag 2 payload")
+
+# zero-count message
+if s >= 2 and r < 2:
+    peer = 1 - r
+    comm.sendrecv(np.zeros(0, np.int32), peer, 9,
+                  np.zeros(0, np.int32), peer, 9)
+
+mtest.finalize()

@@ -1,0 +1,27 @@
+"""Message-size spectrum across the eager/rendezvous crossover + ordering
+(ref: pt2pt/bsend5-ish size sweeps; protocol split pt2pt/protocol.py)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+if s >= 2 and r < 2:
+    peer = 1 - r
+    sizes = [1, 64, 1024, 8192, 65536, 1 << 18]
+    # all sends posted before any recv: ordering must hold per (src,tag)
+    reqs = [comm.isend(np.full(n, float(n % 97 + r)), peer, tag=6)
+            for n in sizes]
+    for n in sizes:
+        buf = np.zeros(n)
+        comm.recv(buf, peer, tag=6)
+        mtest.check_eq(buf[0], float(n % 97 + peer), f"size {n} in order")
+        mtest.check_eq(buf[-1], float(n % 97 + peer), f"size {n} tail")
+    for q in reqs:
+        q.wait()
+
+comm.barrier()
+mtest.finalize()

@@ -1,0 +1,58 @@
+"""waitall/waitany/waitsome/testall over request batches (ref: pt2pt/wait*)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+from mvapich2_tpu.core import request as rq
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+N = 8
+
+if s >= 2 and r < 2:
+    peer = 1 - r
+    recvs = [np.zeros(4, np.int32) for _ in range(N)]
+    rr = [comm.irecv(recvs[i], peer, tag=i) for i in range(N)]
+    sr = [comm.isend(np.full(4, 100 * r + i, np.int32), peer, tag=i)
+          for i in range(N)]
+
+    # waitany drains one at a time
+    done = set()
+    pending = list(rr)
+    while pending:
+        idx = rq.waitany(pending)
+        done.add(id(pending[idx]))
+        pending = [q for j, q in enumerate(pending) if j != idx]
+    mtest.check_eq(len(done), N, "waitany drained all recvs")
+    rq.waitall(sr)
+    for i in range(N):
+        mtest.check_eq(recvs[i], np.full(4, 100 * peer + i, np.int32),
+                       f"payload {i}")
+
+    # testall on fresh batch
+    recvs2 = [np.zeros(2, np.int32) for _ in range(N)]
+    rr2 = [comm.irecv(recvs2[i], peer, tag=50 + i) for i in range(N)]
+    sr2 = [comm.isend(np.full(2, i, np.int32), peer, tag=50 + i)
+           for i in range(N)]
+    while not rq.testall(rr2):
+        pass
+    rq.waitall(sr2)
+    for i in range(N):
+        mtest.check_eq(recvs2[i], np.full(2, i, np.int32), f"payload2 {i}")
+
+    # waitsome returns a nonempty batch
+    recvs3 = [np.zeros(1, np.int32) for _ in range(4)]
+    rr3 = [comm.irecv(recvs3[i], peer, tag=80 + i) for i in range(4)]
+    sr3 = [comm.isend(np.array([i], np.int32), peer, tag=80 + i)
+           for i in range(4)]
+    remaining = list(rr3)
+    while remaining:
+        idxs = rq.waitsome(remaining)
+        mtest.check(len(idxs) >= 1, "waitsome empty batch")
+        remaining = [q for j, q in enumerate(remaining)
+                     if j not in set(idxs)]
+    rq.waitall(sr3)
+
+comm.barrier()
+mtest.finalize()

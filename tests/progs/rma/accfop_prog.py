@@ -1,0 +1,56 @@
+"""accumulate/get_accumulate/fetch_and_op/compare_and_swap under locks
+(ref: rma/accfence1, fetchandadd, compare_and_swap)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+from mvapich2_tpu.core import op as ops
+from mvapich2_tpu.rma.win import LOCK_EXCLUSIVE, LOCK_SHARED
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+buf = np.zeros(4, np.int64)
+win = comm.win_create(buf, disp_unit=8)
+
+# every rank accumulates 1+r into slot 0 of rank 0 — sum must be exact
+win.fence()
+win.accumulate(np.array([1 + r], np.int64), 0, target_disp=0, op=ops.SUM)
+win.fence()
+if r == 0:
+    mtest.check_eq(buf[0], s * (s + 1) // 2, "accumulate sum")
+
+# fetch_and_op: atomic counter on rank 0 slot 1
+res = np.zeros(1, np.int64)
+win.lock(0, LOCK_SHARED)
+win.fetch_and_op(np.array([1], np.int64), res, 0, target_disp=1,
+                 op=ops.SUM)
+win.unlock(0)
+comm.barrier()
+if r == 0:
+    mtest.check_eq(buf[1], s, "fetch_and_op total")
+vals = comm.allgather(res)
+mtest.check_eq(sorted(vals.tolist()), list(range(s)),
+               "fetch_and_op tickets unique")
+
+# compare_and_swap: only one rank wins the swap on slot 2
+winner = np.zeros(1, np.int64)
+win.lock(0, LOCK_EXCLUSIVE)
+win.compare_and_swap(np.array([r + 1], np.int64),
+                     np.array([0], np.int64), winner, 0, target_disp=2)
+win.unlock(0)
+comm.barrier()
+nwin = comm.allreduce(np.array([1 if winner[0] == 0 else 0], np.int64))
+mtest.check_eq(nwin[0], 1, "exactly one CAS winner")
+
+# get_accumulate with NO_OP = atomic read
+snap = np.zeros(1, np.int64)
+win.lock(0, LOCK_SHARED)
+win.get_accumulate(np.array([0], np.int64), snap, 0, target_disp=0,
+                   op=ops.NO_OP)
+win.unlock(0)
+mtest.check_eq(snap[0], s * (s + 1) // 2, "get_accumulate NO_OP read")
+
+win.free()
+mtest.finalize()

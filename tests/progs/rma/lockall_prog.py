@@ -1,0 +1,30 @@
+"""Passive target: lock_all/flush/unlock_all, rput/rget request forms
+(ref: rma/lockall_dt, rput variants)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+buf = np.zeros(s, np.float64)
+win = comm.win_create(buf, disp_unit=8)
+
+win.lock_all()
+# scatter my rank into everyone's slot r
+reqs = [win.rput(np.array([float(r + 100)]), t, target_disp=r)
+        for t in range(s)]
+for q in reqs:
+    q.wait()
+win.flush_all()
+comm.barrier()          # all puts flushed everywhere
+got = np.zeros(s)
+win.rget(got, r, count=s).wait()
+win.unlock_all()
+mtest.check_eq(got, np.arange(s, dtype=np.float64) + 100,
+               "lock_all rput/rget")
+
+win.free()
+mtest.finalize()

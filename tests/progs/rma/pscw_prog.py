@@ -1,0 +1,31 @@
+"""PSCW generalized active target sync (ref: rma/test2, post/start/
+complete/wait patterns)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+from mvapich2_tpu.core.group import Group
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+if s >= 2:
+    buf = np.zeros(4, np.float64)
+    win = comm.win_create(buf, disp_unit=8)
+    origin_g = Group([0])
+    target_g = Group([1])
+
+    if r == 1:
+        win.post(origin_g)
+        win.wait()
+        mtest.check_eq(buf, np.array([5.0, 6.0, 0.0, 0.0]), "pscw payload")
+    elif r == 0:
+        win.start(target_g)
+        win.put(np.array([5.0, 6.0]), 1, target_disp=0)
+        win.complete()
+
+    comm.barrier()
+    win.free()
+
+mtest.finalize()

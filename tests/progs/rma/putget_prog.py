@@ -1,0 +1,32 @@
+"""RMA put/get under fence epochs (ref: rma/putfence1, getfence1)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+buf = np.full(8, float(r), np.float64)
+win = comm.win_create(buf, disp_unit=8)
+
+win.fence()
+# everyone puts its rank into slot r of the right neighbor
+win.put(np.array([float(r * 10)]), (r + 1) % s, target_disp=r % 8)
+win.fence()
+mtest.check_eq(buf[(r - 1) % s % 8], float(((r - 1) % s) * 10),
+               "put landed")
+
+# get from left neighbor
+got = np.zeros(2)
+win.fence()
+win.get(got, (r - 1) % s, target_disp=0, count=2)
+win.fence()
+left = (r - 1) % s
+ll = (left - 1) % s        # the rank that put into `left`'s window
+want0 = float(ll * 10) if ll % 8 == 0 else float(left)
+mtest.check_eq(got[0], want0 if s > 1 else float(r * 10), "get value")
+
+win.free()
+mtest.finalize()

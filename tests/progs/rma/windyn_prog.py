@@ -1,0 +1,37 @@
+"""Dynamic windows: attach/detach + win_allocate (ref: rma/win_dynamic_acc,
+winallocate)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+# win_allocate: library-provided buffer, exposed as win.base
+win = comm.win_allocate(8 * 8, disp_unit=8)
+local = win.base.view(np.float64)
+local[:] = r
+win.fence()
+win.put(np.full(2, float(100 + r)), (r + 1) % s, 2)
+win.fence()
+mtest.check_eq(local[2], float(100 + (r - 1) % s), "allocate+put")
+win.free()
+
+# dynamic: attach a region, exchange absolute addresses, put into it
+dwin = comm.win_create_dynamic()
+region = np.zeros(16, np.float64)
+addr = dwin.attach(region)
+addrs = np.zeros(s, np.int64)
+comm.allgather(np.array([addr], np.int64), addrs, count=1)
+dwin.fence()
+t = (r + 1) % s
+dwin.put(np.array([float(r + 1)]), t, int(addrs[t]) + 8 * (r % 16))
+dwin.fence()
+left = (r - 1) % s
+mtest.check_eq(region[left % 16], float(left + 1), "dynamic put")
+dwin.detach(addr)
+dwin.free()
+
+mtest.finalize()

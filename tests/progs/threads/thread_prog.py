@@ -1,0 +1,39 @@
+"""THREAD_MULTIPLE: concurrent per-thread tag lanes (ref: threads/pt2pt/
+multisend)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import threading
+import numpy as np
+import mtest
+from mvapich2_tpu import mpi
+
+comm = mtest.init(mpi.THREAD_MULTIPLE)
+r, s = comm.rank, comm.size
+NT = 4
+fails = []
+
+if s >= 2 and r < 2:
+    peer = 1 - r
+
+    def worker(t):
+        try:
+            for round_ in range(5):
+                sb = np.full(16, float(1000 * t + round_ + r))
+                rb = np.zeros(16)
+                comm.sendrecv(sb, peer, 100 + t, rb, peer, 100 + t)
+                if not np.array_equal(
+                        rb, np.full(16, float(1000 * t + round_ + peer))):
+                    fails.append((t, round_))
+        except Exception as e:       # noqa: BLE001
+            fails.append((t, repr(e)))
+
+    ts = [threading.Thread(target=worker, args=(t,)) for t in range(NT)]
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join()
+    mtest.check(not fails, f"thread lanes: {fails[:3]}")
+
+comm.barrier()
+mtest.finalize()

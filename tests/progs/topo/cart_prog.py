@@ -1,0 +1,42 @@
+"""Cartesian topology: create/shift/sub/coords + halo sendrecv
+(ref: topo/cartshift, cartsuball)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+from mvapich2_tpu.core import topo
+from mvapich2_tpu.core.status import PROC_NULL
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+dims = topo.dims_create(s, 2)
+cart = comm.cart_create(dims, [True, False])
+mtest.check_eq(cart.topo_test(), "cart", "topo_test")
+mtest.check_eq(cart.cartdim_get(), 2, "cartdim")
+coords = cart.cart_coords()
+mtest.check_eq(cart.cart_rank(coords), cart.rank, "coords roundtrip")
+
+# shift in the periodic dim: always a neighbor; halo exchange
+src, dst = cart.cart_shift(0, 1)
+mtest.check(dst != PROC_NULL, "periodic dim has neighbor")
+got = np.zeros(1, np.int64)
+cart.sendrecv(np.array([cart.rank], np.int64), dst, 1, got, src, 1)
+mtest.check_eq(got[0], src, "halo shift payload")
+
+# shift in nonperiodic dim: edges get PROC_NULL
+src2, dst2 = cart.cart_shift(1, 1)
+d1 = dims[1]
+if coords[1] == d1 - 1:
+    mtest.check_eq(dst2, PROC_NULL, "edge dst PROC_NULL")
+if coords[1] == 0:
+    mtest.check_eq(src2, PROC_NULL, "edge src PROC_NULL")
+
+# cart_sub: rows of the grid
+row = cart.cart_sub([False, True])
+mtest.check_eq(row.size, dims[1], "cart_sub size")
+tot = row.allreduce(np.array([1], np.int64))
+mtest.check_eq(tot[0], dims[1], "row coll")
+
+mtest.finalize()

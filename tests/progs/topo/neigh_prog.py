@@ -1,0 +1,41 @@
+"""dist_graph + neighborhood collectives (ref: topo/dgraph_adjacent,
+neighb_coll)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+from mvapich2_tpu.core import topo
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+# ring as a dist graph: recv from left, send to right
+left, right = (r - 1) % s, (r + 1) % s
+dg = comm.dist_graph_create_adjacent([left], [right])
+srcs, dsts = dg.dist_graph_neighbors()
+mtest.check_eq(srcs, [left], "sources")
+mtest.check_eq(dsts, [right], "destinations")
+
+rb = np.zeros(1, np.int64)
+topo.neighbor_allgather(dg, np.array([r * 5], np.int64), rb)
+mtest.check_eq(rb[0], left * 5, "neighbor_allgather ring")
+
+sb = np.array([r * 7], np.int64)
+rb2 = np.zeros(1, np.int64)
+topo.neighbor_alltoall(dg, sb, rb2)
+mtest.check_eq(rb2[0], left * 7, "neighbor_alltoall ring")
+
+# cart neighborhood
+dims = topo.dims_create(s, 1)
+cart = comm.cart_create(dims, [True])
+n = cart.graph_neighbors() if cart.topo_test() == "cart" else []
+rbc = np.zeros(2 * len(n) // 2 * 2, np.int64) if n else np.zeros(0)
+if n:
+    rbc = np.zeros(len(n), np.int64)
+    topo.neighbor_allgather(cart, np.array([cart.rank], np.int64), rbc)
+    mtest.check_eq(sorted(set(rbc.tolist())),
+                   sorted(set(((cart.rank - 1) % s, (cart.rank + 1) % s))),
+                   "cart neighbor_allgather")
+
+mtest.finalize()

@@ -275,3 +275,29 @@ def test_two_level_explicit():
                                       comm.next_coll_tag())
         np.testing.assert_allclose(out, sum(range(1, comm.size + 1)))
     run_ranks(6, fn, nodes=[0, 0, 0, 1, 1, 1])
+
+
+def test_allreduce_two_level_slotted_multichunk():
+    """Messages spanning >= NSLOTS slots must pipeline, not deadlock:
+    regression for the shared reduce/bcast chunk-id base (the bcast
+    window opened at reduce's final id, stalling once nchunks >= nslots).
+    64 KiB f64 = 8 chunks at the default 8192-byte slot, nslots=4."""
+    from mvapich2_tpu.coll.shmcoll import allreduce_two_level_slotted
+
+    def fn(comm):
+        arr = np.arange(8192, dtype=np.float64) + comm.rank
+        out = allreduce_two_level_slotted(comm, arr, opmod.SUM,
+                                          comm.next_coll_tag())
+        want = (np.arange(8192, dtype=np.float64) * comm.size
+                + sum(range(comm.size)))
+        np.testing.assert_array_equal(out, want)
+        # repeat with odd sizes: per-phase chunk counters are monotonic
+        # across calls and must stay in step on every rank
+        for n in (1, 5000):
+            o2 = allreduce_two_level_slotted(
+                comm, np.full(n, 1.0 + comm.rank), opmod.SUM,
+                comm.next_coll_tag())
+            np.testing.assert_allclose(
+                o2, comm.size + sum(range(comm.size)))
+
+    run_ranks(4, fn, nodes=[0, 0, 0, 0])

@@ -55,10 +55,17 @@ def test_singleton_init():
 
 
 def test_runtests_driver():
-    """bin/runtests: the testlist-driven conformance runner (SURVEY §4)."""
+    """bin/runtests: the testlist-driven conformance runner (SURVEY §4).
+
+    CI runs the per-area subset (testlist.ci); the full 63-entry corpus
+    is tests/progs/testlist, run with MV2T_CONFORMANCE_FULL=1 or
+    directly via `python bin/runtests tests/progs/testlist -j4`."""
     runner = os.path.join(REPO, "bin", "runtests")
-    testlist = os.path.join(REPO, "tests", "progs", "testlist")
-    r = subprocess.run([sys.executable, runner, testlist], cwd=REPO,
-                       capture_output=True, text=True, timeout=500)
+    name = ("testlist" if os.environ.get("MV2T_CONFORMANCE_FULL")
+            else "testlist.ci")
+    testlist = os.path.join(REPO, "tests", "progs", name)
+    r = subprocess.run([sys.executable, runner, testlist, "-j", "2"],
+                       cwd=REPO, capture_output=True, text=True,
+                       timeout=1200)
     assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
     assert "0 failures" in r.stdout
