@@ -39,12 +39,30 @@ _DTYPES = {
     7: np.dtype(np.int16),     # MPI_SHORT
     8: np.dtype(np.uint8),     # MPI_UNSIGNED_CHAR
     9: np.dtype(np.int64),     # MPI_AINT
+    10: np.dtype(np.uint32),   # MPI_UNSIGNED
+    11: np.dtype(np.uint16),   # MPI_UNSIGNED_SHORT
+    12: np.dtype(np.longdouble),  # MPI_LONG_DOUBLE (16B on x86-64)
+    13: np.dtype(np.bool_),    # MPI_C_BOOL
 }
 
 _OPS = {
     0: opmod.SUM, 1: opmod.PROD, 2: opmod.MAX, 3: opmod.MIN,
     4: opmod.LAND, 5: opmod.LOR, 6: opmod.BAND, 7: opmod.BOR,
+    8: opmod.BXOR, 9: opmod.LXOR, 10: opmod.MINLOC, 11: opmod.MAXLOC,
+    12: opmod.REPLACE, 13: opmod.NO_OP,
 }
+
+# derived datatypes: integer handles from 100 (MPI_Type_* constructors)
+_DERIVED_BASE = 100
+_derived: Dict[int, dt.Datatype] = {}
+_next_derived = _DERIVED_BASE
+
+
+def _dt(code: int) -> dt.Datatype:
+    """Datatype object for a C handle (builtin enum or derived)."""
+    if code >= _DERIVED_BASE:
+        return _derived[code]
+    return dt.from_numpy_dtype(_DTYPES[code])
 
 _lock = threading.Lock()
 _comms: Dict[int, object] = {}
@@ -64,9 +82,75 @@ def _comm(h: int):
 
 
 def _arr(view, count: int, dtcode: int) -> np.ndarray:
-    """Zero-copy numpy array over the C caller's buffer."""
+    """Zero-copy numpy array over the C caller's buffer (basic types
+    only — paths without explicit derived-type handling fail loudly
+    instead of silently reinterpreting bytes)."""
+    if dtcode >= _DERIVED_BASE:
+        from .core.errors import MPI_ERR_TYPE
+        raise MPIException(MPI_ERR_TYPE,
+                           "derived datatype not supported on this path")
     d = _DTYPES[dtcode]
     return np.frombuffer(view, dtype=d, count=count)
+
+
+def _send_args(view, count: int, dtcode: int):
+    """(buf, kwargs) for a pt2pt call honoring derived datatypes."""
+    if dtcode >= _DERIVED_BASE:
+        return (np.frombuffer(view, np.uint8),
+                {"count": count, "datatype": _derived[dtcode]})
+    return _arr(view, count, dtcode), {}
+
+
+def _esz(dtcode: int) -> int:
+    """Packed (type-signature) bytes per element."""
+    return _dt(dtcode).size if dtcode >= _DERIVED_BASE \
+        else _DTYPES[dtcode].itemsize
+
+
+def _gather_in(view, off_elems: int, count: int, dtcode: int) -> np.ndarray:
+    """Packed uint8 bytes of `count` elements starting at element offset
+    `off_elems` of the caller's buffer (extent-strided for derived)."""
+    raw = np.frombuffer(view, np.uint8)
+    if dtcode < _DERIVED_BASE:
+        esz = _DTYPES[dtcode].itemsize
+        return raw[off_elems * esz:(off_elems + count) * esz]
+    d = _derived[dtcode]
+    seg = raw[off_elems * d.extent:]
+    return np.asarray(d.pack(seg, count)).view(np.uint8).reshape(-1)
+
+
+def _scatter_out(view, off_elems: int, count: int, dtcode: int,
+                 data_u8) -> None:
+    """Write `count` packed elements into the caller's buffer at element
+    offset `off_elems` (unpacking through the datatype for derived)."""
+    raw = np.frombuffer(view, np.uint8)
+    if dtcode < _DERIVED_BASE:
+        esz = _DTYPES[dtcode].itemsize
+        raw[off_elems * esz:(off_elems + count) * esz] = data_u8
+    else:
+        d = _derived[dtcode]
+        d.unpack(np.asarray(data_u8), raw[off_elems * d.extent:], count)
+
+
+def _red_view(view, count: int, dtcode: int):
+    """(typed contiguous array, writeback) for a reduction operand.
+    Basic types are zero-copy; homogeneous derived types are packed to a
+    contiguous typed temp (written back by the returned callable);
+    heterogeneous derived types are rejected (MPI-3.1 §5.9.2 restricts
+    predefined ops to suitable types)."""
+    if dtcode < _DERIVED_BASE:
+        return _arr(view, count, dtcode), None
+    d = _derived[dtcode]
+    if d.basic is None:
+        from .core.errors import MPI_ERR_TYPE
+        raise MPIException(MPI_ERR_TYPE,
+                           "reduction on heterogeneous derived type")
+    raw = np.frombuffer(view, np.uint8)
+    arr = np.asarray(d.pack(raw, count)).view(d.basic)
+
+    def writeback():
+        d.unpack(arr.view(np.uint8), raw, count)
+    return arr, writeback
 
 
 # ---------------------------------------------------------------------------
@@ -140,24 +224,24 @@ def get_processor_name() -> str:
 
 def send(view, count: int, dtcode: int, dest: int, tag: int,
          ch: int) -> int:
-    buf = _arr(view, count, dtcode)
-    _comm(ch).send(buf, dest, tag)
+    buf, kw = _send_args(view, count, dtcode)
+    _comm(ch).send(buf, dest, tag, **kw)
     return 0
 
 
 def recv(view, count: int, dtcode: int, source: int, tag: int,
          ch: int):
     """Returns (source, tag, count_bytes)."""
-    buf = _arr(view, count, dtcode)
-    st = _comm(ch).recv(buf, source, tag)
+    buf, kw = _send_args(view, count, dtcode)
+    st = _comm(ch).recv(buf, source, tag, **kw)
     return (st.source, st.tag, st.count)
 
 
 def isend(view, count: int, dtcode: int, dest: int, tag: int,
           ch: int) -> int:
     global _next_req
-    buf = _arr(view, count, dtcode)
-    r = _comm(ch).isend(buf, dest, tag)
+    buf, kw = _send_args(view, count, dtcode)
+    r = _comm(ch).isend(buf, dest, tag, **kw)
     with _lock:
         h = _next_req
         _next_req += 1
@@ -168,8 +252,8 @@ def isend(view, count: int, dtcode: int, dest: int, tag: int,
 def irecv(view, count: int, dtcode: int, source: int, tag: int,
           ch: int) -> int:
     global _next_req
-    buf = _arr(view, count, dtcode)
-    r = _comm(ch).irecv(buf, source, tag)
+    buf, kw = _send_args(view, count, dtcode)
+    r = _comm(ch).irecv(buf, source, tag, **kw)
     with _lock:
         h = _next_req
         _next_req += 1
@@ -178,26 +262,40 @@ def irecv(view, count: int, dtcode: int, source: int, tag: int,
 
 
 def wait(rh: int):
-    """Returns (source, tag, count_bytes)."""
-    with _lock:
-        r = _reqs.pop(rh, None)
-    if r is None:
-        return (-1, -1, 0)
-    st = r.wait()
-    return (st.source, st.tag, st.count)
-
-
-def test(rh: int) -> int:
+    """Returns (source, tag, count_bytes, persistent). Persistent
+    requests stay allocated (inactive) after completion (MPI-3.1 §3.9);
+    others are deallocated."""
     with _lock:
         r = _reqs.get(rh)
     if r is None:
-        return 1
-    done = r.test()
-    if done:
+        return (-1, -1, 0, 0)
+    persistent = bool(getattr(r, "persistent", False))
+    st = r.wait()
+    if not persistent:
         with _lock:
             _reqs.pop(rh, None)
-        r.wait()
-    return 1 if done else 0
+    if st is None:
+        return (-1, -1, 0, 1 if persistent else 0)
+    return (st.source, st.tag, st.count, 1 if persistent else 0)
+
+
+def test(rh: int):
+    """Returns (flag, persistent, source, tag, count_bytes)."""
+    with _lock:
+        r = _reqs.get(rh)
+    if r is None:
+        return (1, 0, -1, -1, 0)
+    done = r.test()
+    if not done:
+        return (0, 0, -1, -1, 0)
+    persistent = bool(getattr(r, "persistent", False))
+    if not persistent:
+        with _lock:
+            _reqs.pop(rh, None)
+    st = r.wait()
+    if st is None:
+        return (1, 1 if persistent else 0, -1, -1, 0)
+    return (1, 1 if persistent else 0, st.source, st.tag, st.count)
 
 
 # ---------------------------------------------------------------------------
@@ -210,35 +308,50 @@ def barrier(ch: int) -> int:
 
 
 def bcast(view, count: int, dtcode: int, root: int, ch: int) -> int:
-    buf = _arr(view, count, dtcode)
-    _comm(ch).bcast(buf, root=root)
+    c = _comm(ch)
+    if dtcode >= _DERIVED_BASE:
+        payload = np.array(_gather_in(view, 0, count, dtcode)) \
+            if c.rank == root else np.empty(count * _esz(dtcode), np.uint8)
+        c.bcast(payload, root=root)
+        if c.rank != root:
+            _scatter_out(view, 0, count, dtcode, payload)
+        return 0
+    c.bcast(_arr(view, count, dtcode), root=root)
     return 0
 
 
 def allreduce(sview, rview, count: int, dtcode: int, opcode: int,
               ch: int) -> int:
-    rb = _arr(rview, count, dtcode)
     c = _comm(ch)
+    rb, wb = _red_view(rview, count, dtcode)
     if sview is None:                       # MPI_IN_PLACE
         sb = rb.copy()
     else:
-        sb = _arr(sview, count, dtcode)
+        sb, _ = _red_view(sview, count, dtcode)
     c.allreduce(sb, rb, op=_OPS[opcode])
+    if wb is not None:
+        wb()
     return 0
 
 
 def reduce(sview, rview, count: int, dtcode: int, opcode: int, root: int,
            ch: int) -> int:
     c = _comm(ch)
-    sb = _arr(sview, count, dtcode)
-    rb = _arr(rview, count, dtcode) if rview is not None else None
+    sb, _ = _red_view(sview, count, dtcode)
+    rb, wb = _red_view(rview, count, dtcode) if rview is not None \
+        else (None, None)
     c.reduce(sb, rb, op=_OPS[opcode], root=root)
+    if wb is not None:
+        wb()
     return 0
 
 
 def allgather(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
               ch: int) -> int:
     c = _comm(ch)
+    if sdt >= _DERIVED_BASE or rdt >= _DERIVED_BASE:
+        return allgatherv(sview, rview, scount, sdt, [rcount] * c.size,
+                          [i * rcount for i in range(c.size)], rdt, ch)
     rb = _arr(rview, rcount * c.size, rdt)
     sb = _arr(sview, scount, sdt) if sview is not None \
         else rb[c.rank * rcount:(c.rank + 1) * rcount].copy()
@@ -249,6 +362,14 @@ def allgather(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
 def alltoall(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
              ch: int) -> int:
     c = _comm(ch)
+    if sdt >= _DERIVED_BASE or rdt >= _DERIVED_BASE:
+        if sview is None:                   # MPI_IN_PLACE
+            sview = bytes(np.frombuffer(rview, np.uint8))
+        n = c.size
+        return alltoallv(sview, rview, [scount] * n,
+                         [i * scount for i in range(n)],
+                         [rcount] * n, [i * rcount for i in range(n)],
+                         sdt, rdt, ch)
     rb = _arr(rview, rcount * c.size, rdt)
     sb = _arr(sview, scount * c.size, sdt) if sview is not None \
         else rb.copy()
@@ -259,6 +380,9 @@ def alltoall(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
 def gather(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
            root: int, ch: int) -> int:
     c = _comm(ch)
+    if sdt >= _DERIVED_BASE or rdt >= _DERIVED_BASE:
+        return gatherv(sview, rview, scount, sdt, [rcount] * c.size,
+                       [i * rcount for i in range(c.size)], rdt, root, ch)
     sb = _arr(sview, scount, sdt)
     rb = _arr(rview, rcount * c.size, rdt) if rview is not None else None
     c.gather(sb, rb, root=root, count=rcount)
@@ -268,6 +392,10 @@ def gather(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
 def scatter(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
             root: int, ch: int) -> int:
     c = _comm(ch)
+    if sdt >= _DERIVED_BASE or rdt >= _DERIVED_BASE:
+        return scatterv(sview, rview, [scount] * c.size,
+                        [i * scount for i in range(c.size)], sdt, rcount,
+                        rdt, root, ch)
     sb = _arr(sview, scount * c.size, sdt) if sview is not None else None
     rb = _arr(rview, rcount, rdt)
     c.scatter(sb, rb, root=root, count=rcount)
@@ -277,9 +405,11 @@ def scatter(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
 def reduce_scatter_block(sview, rview, rcount: int, dtcode: int,
                          opcode: int, ch: int) -> int:
     c = _comm(ch)
-    sb = _arr(sview, rcount * c.size, dtcode)
-    rb = _arr(rview, rcount, dtcode)
+    sb, _ = _red_view(sview, rcount * c.size, dtcode)
+    rb, wb = _red_view(rview, rcount, dtcode)
     c.reduce_scatter_block(sb, rb, op=_OPS[opcode], count=rcount)
+    if wb is not None:
+        wb()
     return 0
 
 
@@ -320,10 +450,10 @@ def group_free(gh: int) -> int:
 # one-sided (the OSU one-sided benchmark surface)
 # ---------------------------------------------------------------------------
 
-def win_allocate(size: int, ch: int):
+def win_allocate(size: int, disp_unit: int, ch: int):
     """Returns (win_handle, base_memoryview)."""
     global _next_win
-    w = _comm(ch).win_allocate(size)
+    w = _comm(ch).win_allocate(size, disp_unit=disp_unit)
     with _lock:
         h = _next_win
         _next_win += 1
@@ -332,12 +462,12 @@ def win_allocate(size: int, ch: int):
     return (h, memoryview(base))
 
 
-def win_create(view, ch: int) -> int:
+def win_create(view, disp_unit: int, ch: int) -> int:
     """Window over the C caller's memory (zero-copy frombuffer)."""
     global _next_win
     base = np.frombuffer(view, dtype=np.uint8) if view is not None \
         else np.empty(0, np.uint8)
-    w = _comm(ch).win_create(base)
+    w = _comm(ch).win_create(base, disp_unit=disp_unit)
     with _lock:
         h = _next_win
         _next_win += 1
@@ -460,6 +590,429 @@ def get(wh: int, oview, count: int, dtcode: int, target: int,
 
 
 # ---------------------------------------------------------------------------
+# send modes / combined sendrecv / probe (MPI_Ssend, MPI_Sendrecv, ...)
+# ---------------------------------------------------------------------------
+
+def ssend(view, count: int, dtcode: int, dest: int, tag: int,
+          ch: int) -> int:
+    buf, kw = _send_args(view, count, dtcode)
+    _comm(ch).ssend(buf, dest, tag, **kw)
+    return 0
+
+
+def bsend(view, count: int, dtcode: int, dest: int, tag: int,
+          ch: int) -> int:
+    buf, kw = _send_args(view, count, dtcode)
+    _comm(ch).bsend(buf, dest, tag, **kw)
+    return 0
+
+
+def rsend(view, count: int, dtcode: int, dest: int, tag: int,
+          ch: int) -> int:
+    buf, kw = _send_args(view, count, dtcode)
+    _comm(ch).rsend(buf, dest, tag, **kw)
+    return 0
+
+
+def issend(view, count: int, dtcode: int, dest: int, tag: int,
+           ch: int) -> int:
+    global _next_req
+    buf, kw = _send_args(view, count, dtcode)
+    r = _comm(ch).issend(buf, dest, tag, **kw)
+    with _lock:
+        h = _next_req
+        _next_req += 1
+        _reqs[h] = r
+    return h
+
+
+def probe(source: int, tag: int, ch: int):
+    """Blocking probe; returns (source, tag, count_bytes)."""
+    st = _comm(ch).probe(source, tag)
+    return (st.source, st.tag, st.count)
+
+
+def iprobe(source: int, tag: int, ch: int):
+    """Returns (flag, source, tag, count_bytes)."""
+    st = _comm(ch).iprobe(source, tag)
+    if st is None:
+        return (0, -1, -1, 0)
+    return (1, st.source, st.tag, st.count)
+
+
+# ---------------------------------------------------------------------------
+# persistent requests (MPI_Send_init / MPI_Recv_init / MPI_Start)
+# ---------------------------------------------------------------------------
+
+def send_init(view, count: int, dtcode: int, dest: int, tag: int,
+              ch: int) -> int:
+    global _next_req
+    buf, kw = _send_args(view, count, dtcode)
+    r = _comm(ch).send_init(buf, dest, tag, **kw)
+    with _lock:
+        h = _next_req
+        _next_req += 1
+        _reqs[h] = r
+    return h
+
+
+def recv_init(view, count: int, dtcode: int, source: int, tag: int,
+              ch: int) -> int:
+    global _next_req
+    buf, kw = _send_args(view, count, dtcode)
+    r = _comm(ch).recv_init(buf, source, tag, **kw)
+    with _lock:
+        h = _next_req
+        _next_req += 1
+        _reqs[h] = r
+    return h
+
+
+def start(rh: int) -> int:
+    _reqs[rh].start()
+    return 0
+
+
+def testall(handles):
+    """All-or-nothing MPI_Testall (MPI-3.1 §3.7.5: no request is
+    modified unless all complete). Returns (flag, [(src, tag, count,
+    persistent), ...])."""
+    with _lock:
+        rs = [_reqs.get(h) for h in handles]
+    if not all(r is None or r.test() for r in rs):
+        return (0, [])
+    out = []
+    for h, r in zip(handles, rs):
+        if r is None:
+            out.append((-1, -1, 0, 0))
+            continue
+        persistent = bool(getattr(r, "persistent", False))
+        st = r.wait()
+        if not persistent:
+            with _lock:
+                _reqs.pop(h, None)
+        if st is None:
+            out.append((-1, -1, 0, 1 if persistent else 0))
+        else:
+            out.append((st.source, st.tag, st.count,
+                        1 if persistent else 0))
+    return (1, out)
+
+
+def request_free(rh: int) -> int:
+    with _lock:
+        r = _reqs.pop(rh, None)
+    if r is not None and getattr(r, "persistent", False):
+        r.free()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# v-collectives + scan family
+# ---------------------------------------------------------------------------
+
+def allgatherv(sview, rview, scount: int, sdt: int, rcounts, displs,
+               rdt: int, ch: int) -> int:
+    """Byte-based: counts/displs scale by the type's packed size /
+    extent, so basic AND derived datatypes take one path."""
+    c = _comm(ch)
+    rcounts = list(rcounts)
+    displs = list(displs)
+    esz = _esz(rdt)
+    if sview is None:                     # MPI_IN_PLACE
+        sb = np.array(_gather_in(rview, displs[c.rank], rcounts[c.rank],
+                                 rdt))
+    else:
+        sb = _gather_in(sview, 0, scount, sdt)
+    tmp = np.empty(sum(rcounts) * esz, np.uint8)
+    c.allgatherv(sb, tmp, [n * esz for n in rcounts])
+    off = 0
+    for i, n in enumerate(rcounts):
+        _scatter_out(rview, displs[i], n, rdt, tmp[off: off + n * esz])
+        off += n * esz
+    return 0
+
+
+def alltoallv(sview, rview, scounts, sdispls, rcounts, rdispls,
+              sdt: int, rdt: int, ch: int) -> int:
+    c = _comm(ch)
+    scounts, sdispls = list(scounts), list(sdispls)
+    rcounts, rdispls = list(rcounts), list(rdispls)
+    esz_s, esz_r = _esz(sdt), _esz(rdt)
+    # pack per-destination segments contiguously (displs may be sparse)
+    segs = [_gather_in(sview, sdispls[j], scounts[j], sdt)
+            for j in range(c.size)]
+    sb = np.concatenate(segs) if segs else np.empty(0, np.uint8)
+    sdispls_b = np.concatenate(
+        [[0], np.cumsum([n * esz_s for n in scounts])[:-1]]).tolist()
+    rtmp = np.empty(sum(rcounts) * esz_r, np.uint8)
+    rdispls_b = np.concatenate(
+        [[0], np.cumsum([n * esz_r for n in rcounts])[:-1]]).tolist()
+    c.alltoallv(sb, [n * esz_s for n in scounts], sdispls_b,
+                rtmp, [n * esz_r for n in rcounts], rdispls_b)
+    for i in range(c.size):
+        _scatter_out(rview, rdispls[i], rcounts[i], rdt,
+                     rtmp[rdispls_b[i]: rdispls_b[i] + rcounts[i] * esz_r])
+    return 0
+
+
+def gatherv(sview, rview, scount: int, sdt: int, rcounts, displs,
+            rdt: int, root: int, ch: int) -> int:
+    c = _comm(ch)
+    sb = _gather_in(sview, 0, scount, sdt)
+    if c.rank == root:
+        rcounts, displs = list(rcounts), list(displs)
+        esz = _esz(rdt)
+        tmp = np.empty(sum(rcounts) * esz, np.uint8)
+        c.gatherv(sb, tmp, [n * esz for n in rcounts], root=root)
+        off = 0
+        for i, n in enumerate(rcounts):
+            _scatter_out(rview, displs[i], n, rdt,
+                         tmp[off: off + n * esz])
+            off += n * esz
+    else:
+        # non-root: rcounts/displs are not significant (MPI-3.1 §5.5);
+        # the linear algorithm only reads counts[rank] = my byte count
+        c.gatherv(sb, None, [sb.size] * c.size, root=root)
+    return 0
+
+
+def scatterv(sview, rview, scounts, displs, sdt: int, rcount: int,
+             rdt: int, root: int, ch: int) -> int:
+    c = _comm(ch)
+    esz = _esz(rdt)
+    rtmp = np.empty(rcount * esz, np.uint8)
+    if c.rank == root:
+        scounts = list(scounts)
+        displs = list(displs)
+        esz_s = _esz(sdt)
+        segs = [_gather_in(sview, displs[j], scounts[j], sdt)
+                for j in range(c.size)]
+        sb = np.concatenate(segs) if segs else np.empty(0, np.uint8)
+        displs_b = np.concatenate(
+            [[0], np.cumsum([n * esz_s for n in scounts])[:-1]]).tolist()
+        c.scatterv(sb, [n * esz_s for n in scounts], displs_b, rtmp,
+                   root=root)
+    else:
+        # non-root: sendcounts/displs are not significant (MPI-3.1 §5.6);
+        # counts=None makes the algorithm size the receive from recvbuf
+        c.scatterv(None, None, None, rtmp, root=root)
+    _scatter_out(rview, 0, rcount, rdt, rtmp)
+    return 0
+
+
+def reduce_scatter(sview, rview, rcounts, dtcode: int, opcode: int,
+                   ch: int) -> int:
+    """MPI_Reduce_scatter with per-rank counts: allreduce + slice (the
+    irregular-counts generalization of reduce_scatter_block)."""
+    c = _comm(ch)
+    rcounts = list(rcounts)
+    total = sum(rcounts)
+    if sview is None:
+        raise MPIException(1, "MPI_IN_PLACE reduce_scatter unsupported")
+    sb, _ = _red_view(sview, total, dtcode)
+    tmp = np.empty_like(sb)
+    c.allreduce(sb, tmp, op=_OPS[opcode])
+    epb = sb.size // total if total else 1   # basic elems per MPI elem
+    off = sum(rcounts[: c.rank]) * epb
+    mine = tmp[off: off + rcounts[c.rank] * epb]
+    _scatter_out(rview, 0, rcounts[c.rank], dtcode, mine.view(np.uint8))
+    return 0
+
+
+def scan(sview, rview, count: int, dtcode: int, opcode: int,
+         ch: int) -> int:
+    c = _comm(ch)
+    rb, wb = _red_view(rview, count, dtcode)
+    sb = rb.copy() if sview is None else _red_view(sview, count, dtcode)[0]
+    c.scan(sb, rb, op=_OPS[opcode])
+    if wb is not None:
+        wb()
+    return 0
+
+
+def exscan(sview, rview, count: int, dtcode: int, opcode: int,
+           ch: int) -> int:
+    c = _comm(ch)
+    rb, wb = _red_view(rview, count, dtcode)
+    sb = rb.copy() if sview is None else _red_view(sview, count, dtcode)[0]
+    c.exscan(sb, rb, op=_OPS[opcode])
+    if wb is not None:
+        wb()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# comm/group extras
+# ---------------------------------------------------------------------------
+
+_COMPARE = {"ident": 0, "congruent": 1, "similar": 2, "unequal": 3}
+
+
+def comm_compare(ch1: int, ch2: int) -> int:
+    return _COMPARE[_comm(ch1).compare(_comm(ch2))]
+
+
+def comm_create(ch: int, gh: int) -> int:
+    global _next_comm
+    c = _comm(ch).create(_groups[gh])
+    if c is None:
+        return -1
+    with _lock:
+        h = _next_comm
+        _next_comm += 1
+        _comms[h] = c
+    return h
+
+
+def group_size(gh: int) -> int:
+    return _groups[gh].size
+
+
+def group_rank(gh: int) -> int:
+    from .core.status import UNDEFINED
+    g = _groups[gh]
+    r = g.rank_of_world(uni.current_universe().world_rank)
+    return r if r != UNDEFINED else -32766
+
+
+def group_excl(gh: int, ranks) -> int:
+    global _next_group
+    g = _groups[gh].excl(list(ranks))
+    with _lock:
+        h = _next_group
+        _next_group += 1
+        _groups[h] = g
+    return h
+
+
+def group_translate_ranks(gh1: int, ranks, gh2: int):
+    from .core.status import UNDEFINED
+    out = _groups[gh1].translate_ranks(list(ranks), _groups[gh2])
+    return [(-32766 if r in (None, UNDEFINED) else r) for r in out]
+
+
+# ---------------------------------------------------------------------------
+# derived datatypes (MPI_Type_* constructors)
+# ---------------------------------------------------------------------------
+
+def _new_derived(d: dt.Datatype) -> int:
+    global _next_derived
+    with _lock:
+        h = _next_derived
+        _next_derived += 1
+        _derived[h] = d
+    return h
+
+
+def type_contiguous(count: int, oldcode: int) -> int:
+    return _new_derived(dt.create_contiguous(count, _dt(oldcode)))
+
+
+def type_vector(count: int, blocklength: int, stride: int,
+                oldcode: int) -> int:
+    return _new_derived(dt.create_vector(count, blocklength, stride,
+                                         _dt(oldcode)))
+
+
+def type_create_hvector(count: int, blocklength: int, stride_bytes: int,
+                        oldcode: int) -> int:
+    return _new_derived(dt.create_hvector(count, blocklength, stride_bytes,
+                                          _dt(oldcode)))
+
+
+def type_indexed(blocklengths, displacements, oldcode: int) -> int:
+    return _new_derived(dt.create_indexed(list(blocklengths),
+                                          list(displacements),
+                                          _dt(oldcode)))
+
+
+def type_create_struct(blocklengths, disp_bytes, oldcodes) -> int:
+    types = [_dt(c) for c in oldcodes]
+    return _new_derived(dt.create_struct(list(blocklengths),
+                                         list(disp_bytes), types))
+
+
+def type_create_resized(oldcode: int, lb: int, extent: int) -> int:
+    return _new_derived(dt.create_resized(_dt(oldcode), lb, extent))
+
+
+def type_commit(code: int) -> int:
+    if code >= _DERIVED_BASE:
+        _derived[code].commit()
+    return 0
+
+
+def type_free(code: int) -> int:
+    with _lock:
+        _derived.pop(code, None)
+    return 0
+
+
+def type_size(code: int) -> int:
+    return _dt(code).size
+
+
+def type_extent(code: int):
+    """Returns (lb, extent) in bytes."""
+    d = _dt(code)
+    return (d.lb, d.extent)
+
+
+# ---------------------------------------------------------------------------
+# RMA atomics (MPI_Accumulate / MPI_Fetch_and_op / MPI_Compare_and_swap)
+# ---------------------------------------------------------------------------
+
+def accumulate(wh: int, oview, count: int, dtcode: int, target: int,
+               tdisp: int, opcode: int) -> int:
+    buf = _arr(oview, count, dtcode)
+    _wins[wh].accumulate(buf, target, tdisp, op=_OPS[opcode])
+    return 0
+
+
+def get_accumulate(wh: int, oview, rview, count: int, dtcode: int,
+                   target: int, tdisp: int, opcode: int) -> int:
+    obuf = _arr(oview, count, dtcode) if oview is not None else \
+        np.zeros(count, _DTYPES[dtcode])
+    rbuf = _arr(rview, count, dtcode)
+    _wins[wh].get_accumulate(obuf, rbuf, target, tdisp, op=_OPS[opcode])
+    return 0
+
+
+def fetch_and_op(wh: int, oview, rview, dtcode: int, target: int,
+                 tdisp: int, opcode: int) -> int:
+    obuf = _arr(oview, 1, dtcode) if oview is not None else \
+        np.zeros(1, _DTYPES[dtcode])
+    rbuf = _arr(rview, 1, dtcode)
+    _wins[wh].fetch_and_op(obuf, rbuf, target, tdisp, op=_OPS[opcode])
+    return 0
+
+
+def compare_and_swap(wh: int, oview, cview, rview, dtcode: int,
+                     target: int, tdisp: int) -> int:
+    obuf = _arr(oview, 1, dtcode)
+    cbuf = _arr(cview, 1, dtcode)
+    rbuf = _arr(rview, 1, dtcode)
+    _wins[wh].compare_and_swap(obuf, cbuf, rbuf, target, tdisp)
+    return 0
+
+
+def win_flush_all(wh: int) -> int:
+    _wins[wh].flush_all()
+    return 0
+
+
+def win_flush_local_all(wh: int) -> int:
+    _wins[wh].flush_local_all()
+    return 0
+
+
+def win_sync(wh: int) -> int:
+    _wins[wh].sync()
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # error translation
 # ---------------------------------------------------------------------------
 
@@ -467,3 +1020,8 @@ def errclass(exc) -> int:
     if isinstance(exc, MPIException):
         return exc.error_class
     return 16   # MPI_ERR_OTHER
+
+
+def error_string(klass: int) -> str:
+    from .core.errors import error_string as _es
+    return _es(klass)

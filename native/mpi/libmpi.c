@@ -27,13 +27,24 @@
 static PyObject *g_shim = NULL;        /* mvapich2_tpu.cshim module */
 static int g_we_initialized_python = 0;
 
-static const int DT_SIZE[] = {1, 1, 4, 4, 8, 8, 8, 2, 1, 8};
+static const int DT_SIZE[] = {1, 1, 4, 4, 8, 8, 8, 2, 1, 8, 4, 2, 16, 1};
 
+static long shim_call_v(const char *name, int *ok, const char *fmt, ...);
+
+/* size in bytes of one element; derived handles (>= 100) ask the shim */
 static int dt_size(MPI_Datatype dt) {
+    if (dt >= 100) {
+        int ok;
+        long v = shim_call_v("type_size", &ok, "(i)", dt);
+        return ok ? (int)v : 1;
+    }
     if (dt < 0 || dt >= (int)(sizeof(DT_SIZE) / sizeof(DT_SIZE[0])))
         return 1;
     return DT_SIZE[dt];
 }
+
+/* extent in bytes (buffer stride per element); == size for basics */
+static long dt_extent_b(MPI_Datatype dt);
 
 /* ------------------------------------------------------------------ */
 /* embedded interpreter plumbing                                       */
@@ -326,7 +337,7 @@ int MPI_Get_address(const void *location, MPI_Aint *address) {
 int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
              int tag, MPI_Comm comm) {
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *view = mv_view(buf, (long)count * dt_size(dt));
+    PyObject *view = mv_view(buf, (long)count * dt_extent_b(dt));
     PyObject *res = PyObject_CallMethod(g_shim, "send", "(Oiiiii)", view,
                                         count, dt, dest, tag, comm);
     int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
@@ -340,7 +351,7 @@ int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
 int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
              MPI_Comm comm, MPI_Status *status) {
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *view = mv_view(buf, (long)count * dt_size(dt));
+    PyObject *view = mv_view(buf, (long)count * dt_extent_b(dt));
     PyObject *res = PyObject_CallMethod(g_shim, "recv", "(Oiiiii)", view,
                                         count, dt, source, tag, comm);
     int rc = MPI_ERR_OTHER;
@@ -368,7 +379,7 @@ static MPI_Request isend_irecv(const char *fn, void *buf, int count,
                                MPI_Datatype dt, int peer, int tag,
                                MPI_Comm comm) {
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *view = mv_view(buf, (long)count * dt_size(dt));
+    PyObject *view = mv_view(buf, (long)count * dt_extent_b(dt));
     PyObject *res = PyObject_CallMethod(g_shim, fn, "(Oiiiii)", view,
                                         count, dt, peer, tag, comm);
     MPI_Request h = MPI_REQUEST_NULL;
@@ -398,8 +409,32 @@ int MPI_Irecv(void *buf, int count, MPI_Datatype dt, int source, int tag,
 int MPI_Wait(MPI_Request *req, MPI_Status *status) {
     if (*req == MPI_REQUEST_NULL)
         return MPI_SUCCESS;
-    int rc = shim_call_status("wait", status, "(l)", (long)*req);
-    *req = MPI_REQUEST_NULL;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "wait", "(l)",
+                                        (long)*req);
+    int rc = MPI_ERR_OTHER;
+    if (res) {
+        int src = -1, tag = -1, cnt = 0, persistent = 0;
+        if (PyArg_ParseTuple(res, "iiii", &src, &tag, &cnt,
+                             &persistent)) {
+            if (status != MPI_STATUS_IGNORE) {
+                status->MPI_SOURCE = src;
+                status->MPI_TAG = tag;
+                status->MPI_ERROR = MPI_SUCCESS;
+                status->_count = cnt;
+            }
+            /* persistent requests stay valid (inactive) after wait */
+            if (!persistent)
+                *req = MPI_REQUEST_NULL;
+            rc = MPI_SUCCESS;
+        } else {
+            PyErr_Print();
+        }
+        Py_DECREF(res);
+    } else {
+        PyErr_Print();
+    }
+    PyGILState_Release(st);
     return rc;
 }
 
@@ -415,17 +450,36 @@ int MPI_Waitall(int count, MPI_Request reqs[], MPI_Status statuses[]) {
 }
 
 int MPI_Test(MPI_Request *req, int *flag, MPI_Status *status) {
-    (void)status;
     if (*req == MPI_REQUEST_NULL) { *flag = 1; return MPI_SUCCESS; }
-    {
-        int ok;
-        *flag = (int)shim_call_v("test", &ok, "(l)", (long)*req);
-        if (!ok)
-            return MPI_ERR_OTHER;
+    *flag = 0;    /* defined even on shim-error returns */
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "test", "(l)",
+                                        (long)*req);
+    int rc = MPI_ERR_OTHER;
+    if (res) {
+        int f = 0, persistent = 0, src = -1, tag = -1, cnt = 0;
+        if (PyArg_ParseTuple(res, "iiiii", &f, &persistent, &src, &tag,
+                             &cnt)) {
+            *flag = f;
+            if (f && status != MPI_STATUS_IGNORE) {
+                status->MPI_SOURCE = src;
+                status->MPI_TAG = tag;
+                status->MPI_ERROR = MPI_SUCCESS;
+                status->_count = cnt;
+            }
+            /* persistent requests stay valid (inactive) after test */
+            if (f && !persistent)
+                *req = MPI_REQUEST_NULL;
+            rc = MPI_SUCCESS;
+        } else {
+            PyErr_Print();
+        }
+        Py_DECREF(res);
+    } else {
+        PyErr_Print();
     }
-    if (*flag)
-        *req = MPI_REQUEST_NULL;
-    return MPI_SUCCESS;
+    PyGILState_Release(st);
+    return rc;
 }
 
 int MPI_Get_count(const MPI_Status *status, MPI_Datatype dt, int *count) {
@@ -480,7 +534,7 @@ static int coll2(const char *fn, const void *sb, void *rb, long snb,
 int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root,
               MPI_Comm comm) {
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *view = mv_view(buf, (long)count * dt_size(dt));
+    PyObject *view = mv_view(buf, (long)count * dt_extent_b(dt));
     PyObject *res = PyObject_CallMethod(g_shim, "bcast", "(Oiiii)", view,
                                         count, dt, root, comm);
     int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
@@ -493,14 +547,14 @@ int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root,
 
 int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
                   MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
-    long nb = (long)count * dt_size(dt);
+    long nb = (long)count * dt_extent_b(dt);
     return coll2("allreduce", sendbuf, recvbuf, nb, nb, "(iiii)",
                  count, dt, op, comm);
 }
 
 int MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
                MPI_Datatype dt, MPI_Op op, int root, MPI_Comm comm) {
-    long nb = (long)count * dt_size(dt);
+    long nb = (long)count * dt_extent_b(dt);
     return coll2("reduce", sendbuf, recvbuf, nb, nb, "(iiiii)",
                  count, dt, op, root, comm);
 }
@@ -511,8 +565,8 @@ int MPI_Allgather(const void *sendbuf, int scount, MPI_Datatype sdt,
     int size;
     MPI_Comm_size(comm, &size);
     return coll2("allgather", sendbuf, recvbuf,
-                 (long)scount * dt_size(sdt),
-                 (long)rcount * dt_size(rdt) * size,
+                 (long)scount * dt_extent_b(sdt),
+                 (long)rcount * dt_extent_b(rdt) * size,
                  "(iiiii)", scount, sdt, rcount, rdt, comm);
 }
 
@@ -522,8 +576,8 @@ int MPI_Alltoall(const void *sendbuf, int scount, MPI_Datatype sdt,
     int size;
     MPI_Comm_size(comm, &size);
     return coll2("alltoall", sendbuf, recvbuf,
-                 (long)scount * dt_size(sdt) * size,
-                 (long)rcount * dt_size(rdt) * size,
+                 (long)scount * dt_extent_b(sdt) * size,
+                 (long)rcount * dt_extent_b(rdt) * size,
                  "(iiiii)", scount, sdt, rcount, rdt, comm);
 }
 
@@ -533,8 +587,8 @@ int MPI_Gather(const void *sendbuf, int scount, MPI_Datatype sdt,
     int size;
     MPI_Comm_size(comm, &size);
     return coll2("gather", sendbuf, recvbuf,
-                 (long)scount * dt_size(sdt),
-                 (long)rcount * dt_size(rdt) * size,
+                 (long)scount * dt_extent_b(sdt),
+                 (long)rcount * dt_extent_b(rdt) * size,
                  "(iiiiii)", scount, sdt, rcount, rdt, root, comm);
 }
 
@@ -544,8 +598,8 @@ int MPI_Scatter(const void *sendbuf, int scount, MPI_Datatype sdt,
     int size;
     MPI_Comm_size(comm, &size);
     return coll2("scatter", sendbuf, recvbuf,
-                 (long)scount * dt_size(sdt) * size,
-                 (long)rcount * dt_size(rdt),
+                 (long)scount * dt_extent_b(sdt) * size,
+                 (long)rcount * dt_extent_b(rdt),
                  "(iiiiii)", scount, sdt, rcount, rdt, root, comm);
 }
 
@@ -555,8 +609,8 @@ int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
     int size;
     MPI_Comm_size(comm, &size);
     return coll2("reduce_scatter_block", sendbuf, recvbuf,
-                 (long)rcount * dt_size(dt) * size,
-                 (long)rcount * dt_size(dt),
+                 (long)rcount * dt_extent_b(dt) * size,
+                 (long)rcount * dt_extent_b(dt),
                  "(iiii)", rcount, dt, op, comm);
 }
 
@@ -566,10 +620,10 @@ int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
 
 int MPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
                      MPI_Comm comm, void *baseptr, MPI_Win *win) {
-    (void)disp_unit; (void)info;
+    (void)info;
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *res = PyObject_CallMethod(g_shim, "win_allocate", "(Li)",
-                                        (long long)size, comm);
+    PyObject *res = PyObject_CallMethod(g_shim, "win_allocate", "(Lii)",
+                                        (long long)size, disp_unit, comm);
     int rc = MPI_ERR_OTHER;
     if (res) {
         int h;
@@ -593,11 +647,11 @@ int MPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
 
 int MPI_Win_create(void *base, MPI_Aint size, int disp_unit,
                    MPI_Info info, MPI_Comm comm, MPI_Win *win) {
-    (void)disp_unit; (void)info;
+    (void)info;
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *view = mv_view(base, (long)size);
-    PyObject *res = PyObject_CallMethod(g_shim, "win_create", "(Oi)",
-                                        view, comm);
+    PyObject *res = PyObject_CallMethod(g_shim, "win_create", "(Oii)",
+                                        view, disp_unit, comm);
     int rc = MPI_ERR_OTHER;
     if (res) {
         *win = (MPI_Win)PyLong_AsLong(res);
@@ -697,10 +751,750 @@ int MPI_Win_wait(MPI_Win win) {
     return shim_call_i("win_wait", "(i)", win);
 }
 
+/* ------------------------------------------------------------------ */
+/* widened surface: send modes, probes, persistent, v-collectives,     */
+/* derived datatypes, comm/group extras, errors, RMA atomics           */
+/* ------------------------------------------------------------------ */
+
+static long dt_extent_b(MPI_Datatype dt) {
+    if (dt >= 100) {
+        PyGILState_STATE st = PyGILState_Ensure();
+        long ext = 0;
+        PyObject *res = PyObject_CallMethod(g_shim, "type_extent", "(i)",
+                                            dt);
+        if (res) {
+            long long lb = 0, e = 0;
+            if (PyArg_ParseTuple(res, "LL", &lb, &e))
+                ext = (long)e;
+            Py_DECREF(res);
+        } else {
+            PyErr_Clear();
+        }
+        PyGILState_Release(st);
+        return ext > 0 ? ext : dt_size(dt);
+    }
+    return dt_size(dt);
+}
+
+static int sendlike(const char *fn, const void *buf, int count,
+                    MPI_Datatype dt, int dest, int tag, MPI_Comm comm) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *view = mv_view(buf, (long)count * dt_extent_b(dt));
+    PyObject *res = PyObject_CallMethod(g_shim, fn, "(Oiiiii)", view,
+                                        count, dt, dest, tag, comm);
+    int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
+    if (!res) PyErr_Print();
+    Py_XDECREF(res);
+    Py_XDECREF(view);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Ssend(const void *buf, int count, MPI_Datatype dt, int dest,
+              int tag, MPI_Comm comm) {
+    return sendlike("ssend", buf, count, dt, dest, tag, comm);
+}
+
+int MPI_Bsend(const void *buf, int count, MPI_Datatype dt, int dest,
+              int tag, MPI_Comm comm) {
+    return sendlike("bsend", buf, count, dt, dest, tag, comm);
+}
+
+int MPI_Rsend(const void *buf, int count, MPI_Datatype dt, int dest,
+              int tag, MPI_Comm comm) {
+    return sendlike("rsend", buf, count, dt, dest, tag, comm);
+}
+
+/* request-returning shim calls share isend_irecv's plumbing */
+#define reqlike(fn, buf, count, dt, peer, tag, comm) \
+    isend_irecv((fn), (void *)(buf), (count), (dt), (peer), (tag), (comm))
+
+int MPI_Issend(const void *buf, int count, MPI_Datatype dt, int dest,
+               int tag, MPI_Comm comm, MPI_Request *req) {
+    *req = reqlike("issend", buf, count, dt, dest, tag, comm);
+    return *req != MPI_REQUEST_NULL ? MPI_SUCCESS : MPI_ERR_OTHER;
+}
+
+int MPI_Sendrecv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
+                 int dest, int sendtag, void *recvbuf, int recvcount,
+                 MPI_Datatype rdt, int source, int recvtag, MPI_Comm comm,
+                 MPI_Status *status) {
+    MPI_Request rreq, sreq;
+    int rc = MPI_Irecv(recvbuf, recvcount, rdt, source, recvtag, comm,
+                       &rreq);
+    if (rc != MPI_SUCCESS) return rc;
+    rc = MPI_Isend(sendbuf, sendcount, sdt, dest, sendtag, comm, &sreq);
+    if (rc != MPI_SUCCESS) return rc;
+    rc = MPI_Wait(&rreq, status);
+    if (rc != MPI_SUCCESS) return rc;
+    return MPI_Wait(&sreq, MPI_STATUS_IGNORE);
+}
+
+int MPI_Sendrecv_replace(void *buf, int count, MPI_Datatype dt, int dest,
+                         int sendtag, int source, int recvtag,
+                         MPI_Comm comm, MPI_Status *status) {
+    long nb = (long)count * dt_extent_b(dt);
+    void *tmp = malloc(nb > 0 ? nb : 1);
+    if (!tmp) return MPI_ERR_OTHER;
+    memcpy(tmp, buf, nb);
+    int rc = MPI_Sendrecv(tmp, count, dt, dest, sendtag, buf, count, dt,
+                          source, recvtag, comm, status);
+    free(tmp);
+    return rc;
+}
+
+int MPI_Probe(int source, int tag, MPI_Comm comm, MPI_Status *status) {
+    return shim_call_status("probe", status, "(iii)", source, tag, comm);
+}
+
+int MPI_Iprobe(int source, int tag, MPI_Comm comm, int *flag,
+               MPI_Status *status) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "iprobe", "(iii)", source,
+                                        tag, comm);
+    int rc = MPI_ERR_OTHER;
+    if (res) {
+        int f = 0, src = -1, t = -1, cnt = 0;
+        if (PyArg_ParseTuple(res, "iiii", &f, &src, &t, &cnt)) {
+            *flag = f;
+            if (f && status != MPI_STATUS_IGNORE) {
+                status->MPI_SOURCE = src;
+                status->MPI_TAG = t;
+                status->MPI_ERROR = MPI_SUCCESS;
+                status->_count = cnt;
+            }
+            rc = MPI_SUCCESS;
+        } else {
+            PyErr_Print();
+        }
+        Py_DECREF(res);
+    } else {
+        PyErr_Print();
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Waitany(int count, MPI_Request reqs[], int *index,
+                MPI_Status *status) {
+    int live = 0;
+    for (int i = 0; i < count; i++)
+        if (reqs[i] != MPI_REQUEST_NULL) live++;
+    if (live == 0) { *index = MPI_UNDEFINED; return MPI_SUCCESS; }
+    for (;;) {
+        for (int i = 0; i < count; i++) {
+            if (reqs[i] == MPI_REQUEST_NULL) continue;
+            int flag = 0;
+            int rc = MPI_Test(&reqs[i], &flag, status);
+            if (rc != MPI_SUCCESS) return rc;
+            if (flag) { *index = i; return MPI_SUCCESS; }
+        }
+    }
+}
+
+int MPI_Testall(int count, MPI_Request reqs[], int *flag,
+                MPI_Status statuses[]) {
+    /* MPI-3.1 §3.7.5: requests/statuses are modified only when ALL
+     * complete; the shim's testall does the all-or-nothing check */
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *hl = PyList_New(count);
+    for (int i = 0; i < count; i++)
+        PyList_SET_ITEM(hl, i, PyLong_FromLong((long)reqs[i]));
+    PyObject *res = PyObject_CallMethod(g_shim, "testall", "(O)", hl);
+    int rc = MPI_ERR_OTHER;
+    if (res) {
+        PyObject *sts = NULL;
+        int f = 0;
+        if (PyArg_ParseTuple(res, "iO", &f, &sts)) {
+            *flag = f;
+            rc = MPI_SUCCESS;
+            if (f) {
+                for (int i = 0; i < count; i++) {
+                    PyObject *t = PyList_Size(sts) > i
+                                  ? PyList_GET_ITEM(sts, i) : NULL;
+                    int src = -1, tag = -1, cnt = 0, persistent = 0;
+                    if (t)
+                        PyArg_ParseTuple(t, "iiii", &src, &tag, &cnt,
+                                         &persistent);
+                    if (statuses != MPI_STATUSES_IGNORE) {
+                        statuses[i].MPI_SOURCE = src;
+                        statuses[i].MPI_TAG = tag;
+                        statuses[i].MPI_ERROR = MPI_SUCCESS;
+                        statuses[i]._count = cnt;
+                    }
+                    if (!persistent)
+                        reqs[i] = MPI_REQUEST_NULL;
+                }
+            }
+        } else {
+            PyErr_Print();
+        }
+        Py_DECREF(res);
+    } else {
+        PyErr_Print();
+    }
+    Py_XDECREF(hl);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Send_init(const void *buf, int count, MPI_Datatype dt, int dest,
+                  int tag, MPI_Comm comm, MPI_Request *req) {
+    *req = reqlike("send_init", buf, count, dt, dest, tag, comm);
+    return *req != MPI_REQUEST_NULL ? MPI_SUCCESS : MPI_ERR_OTHER;
+}
+
+int MPI_Recv_init(void *buf, int count, MPI_Datatype dt, int source,
+                  int tag, MPI_Comm comm, MPI_Request *req) {
+    *req = reqlike("recv_init", buf, count, dt, source, tag, comm);
+    return *req != MPI_REQUEST_NULL ? MPI_SUCCESS : MPI_ERR_OTHER;
+}
+
+int MPI_Start(MPI_Request *req) {
+    return shim_call_i("start", "(l)", (long)*req);
+}
+
+int MPI_Startall(int count, MPI_Request reqs[]) {
+    for (int i = 0; i < count; i++) {
+        int rc = MPI_Start(&reqs[i]);
+        if (rc != MPI_SUCCESS) return rc;
+    }
+    return MPI_SUCCESS;
+}
+
+int MPI_Request_free(MPI_Request *req) {
+    int rc = shim_call_i("request_free", "(l)", (long)*req);
+    *req = MPI_REQUEST_NULL;
+    return rc;
+}
+
+/* bsend is internally buffered; the attach/detach surface is kept for
+ * source compatibility (reference: MPI-3.1 §3.6) */
+static void *g_bsend_buf = NULL;
+static int g_bsend_size = 0;
+
+int MPI_Buffer_attach(void *buffer, int size) {
+    g_bsend_buf = buffer;
+    g_bsend_size = size;
+    return MPI_SUCCESS;
+}
+
+int MPI_Buffer_detach(void *buffer_addr, int *size) {
+    *(void **)buffer_addr = g_bsend_buf;
+    *size = g_bsend_size;
+    g_bsend_buf = NULL;
+    g_bsend_size = 0;
+    return MPI_SUCCESS;
+}
+
+/* ---- v-collectives --------------------------------------------------- */
+
+static PyObject *int_list(const int *a, int n) {
+    PyObject *l = PyList_New(n);
+    for (int i = 0; i < n; i++)
+        PyList_SET_ITEM(l, i, PyLong_FromLong(a ? a[i] : 0));
+    return l;
+}
+
+static int comm_np(MPI_Comm comm) {
+    int n = 0;
+    MPI_Comm_size(comm, &n);
+    return n;
+}
+
+static long vspan(const int *counts, const int *displs, int n) {
+    long m = 0;
+    for (int i = 0; i < n; i++) {
+        long e = (displs ? displs[i] : 0) + counts[i];
+        if (e > m) m = e;
+    }
+    return m;
+}
+
+int MPI_Allgatherv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
+                   void *recvbuf, const int recvcounts[],
+                   const int displs[], MPI_Datatype rdt, MPI_Comm comm) {
+    int n = comm_np(comm);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *sv = mv_view(sendbuf, (long)sendcount * dt_extent_b(sdt));
+    PyObject *rv = mv_view(recvbuf, vspan(recvcounts, displs, n) * dt_extent_b(rdt));
+    PyObject *rc_l = int_list(recvcounts, n);
+    PyObject *dp_l = int_list(displs, n);
+    PyObject *res = PyObject_CallMethod(g_shim, "allgatherv",
+                                        "(OOiiOOii)", sv, rv, sendcount,
+                                        sdt, rc_l, dp_l, rdt, comm);
+    int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
+    if (!res) PyErr_Print();
+    Py_XDECREF(res); Py_XDECREF(rc_l); Py_XDECREF(dp_l);
+    Py_XDECREF(sv); Py_XDECREF(rv);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Alltoallv(const void *sendbuf, const int sendcounts[],
+                  const int sdispls[], MPI_Datatype sdt, void *recvbuf,
+                  const int recvcounts[], const int rdispls[],
+                  MPI_Datatype rdt, MPI_Comm comm) {
+    int n = comm_np(comm);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *sv = mv_view(sendbuf, vspan(sendcounts, sdispls, n) * dt_extent_b(sdt));
+    PyObject *rv = mv_view(recvbuf, vspan(recvcounts, rdispls, n) * dt_extent_b(rdt));
+    PyObject *sc = int_list(sendcounts, n), *sd = int_list(sdispls, n);
+    PyObject *rc_l = int_list(recvcounts, n), *rd = int_list(rdispls, n);
+    PyObject *res = PyObject_CallMethod(g_shim, "alltoallv",
+                                        "(OOOOOOiii)", sv, rv, sc, sd,
+                                        rc_l, rd, sdt, rdt, comm);
+    int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
+    if (!res) PyErr_Print();
+    Py_XDECREF(res); Py_XDECREF(sc); Py_XDECREF(sd);
+    Py_XDECREF(rc_l); Py_XDECREF(rd); Py_XDECREF(sv); Py_XDECREF(rv);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Gatherv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
+                void *recvbuf, const int recvcounts[], const int displs[],
+                MPI_Datatype rdt, int root, MPI_Comm comm) {
+    int n = comm_np(comm);
+    int me = -1;
+    MPI_Comm_rank(comm, &me);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *sv = mv_view(sendbuf, (long)sendcount * dt_extent_b(sdt));
+    PyObject *rv = (me == root)
+        ? mv_view(recvbuf, vspan(recvcounts, displs, n) * dt_extent_b(rdt))
+        : mv_view(NULL, 0);
+    PyObject *rc_l = int_list(me == root ? recvcounts : NULL, n);
+    PyObject *dp_l = int_list(me == root ? displs : NULL, n);
+    PyObject *res = PyObject_CallMethod(g_shim, "gatherv", "(OOiiOOiii)",
+                                        sv, rv, sendcount, sdt, rc_l,
+                                        dp_l, rdt, root, comm);
+    int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
+    if (!res) PyErr_Print();
+    Py_XDECREF(res); Py_XDECREF(rc_l); Py_XDECREF(dp_l);
+    Py_XDECREF(sv); Py_XDECREF(rv);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Scatterv(const void *sendbuf, const int sendcounts[],
+                 const int displs[], MPI_Datatype sdt, void *recvbuf,
+                 int recvcount, MPI_Datatype rdt, int root,
+                 MPI_Comm comm) {
+    int n = comm_np(comm);
+    int me = -1;
+    MPI_Comm_rank(comm, &me);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *sv = (me == root)
+        ? mv_view(sendbuf, vspan(sendcounts, displs, n) * dt_extent_b(sdt))
+        : mv_view(NULL, 0);
+    PyObject *rv = mv_view(recvbuf, (long)recvcount * dt_extent_b(rdt));
+    PyObject *sc = int_list(me == root ? sendcounts : NULL, n);
+    PyObject *dp = int_list(me == root ? displs : NULL, n);
+    PyObject *res = PyObject_CallMethod(g_shim, "scatterv", "(OOOOiiiii)",
+                                        sv, rv, sc, dp, sdt, recvcount,
+                                        rdt, root, comm);
+    int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
+    if (!res) PyErr_Print();
+    Py_XDECREF(res); Py_XDECREF(sc); Py_XDECREF(dp);
+    Py_XDECREF(sv); Py_XDECREF(rv);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Reduce_scatter(const void *sendbuf, void *recvbuf,
+                       const int recvcounts[], MPI_Datatype dt, MPI_Op op,
+                       MPI_Comm comm) {
+    int n = comm_np(comm);
+    int me = -1;
+    MPI_Comm_rank(comm, &me);
+    long total = 0;
+    for (int i = 0; i < n; i++) total += recvcounts[i];
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *sv = mv_view(sendbuf, total * dt_extent_b(dt));
+    PyObject *rv = mv_view(recvbuf, (long)recvcounts[me] * dt_extent_b(dt));
+    PyObject *rc_l = int_list(recvcounts, n);
+    PyObject *res = PyObject_CallMethod(g_shim, "reduce_scatter",
+                                        "(OOOiii)", sv, rv, rc_l, dt, op,
+                                        comm);
+    int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
+    if (!res) PyErr_Print();
+    Py_XDECREF(res); Py_XDECREF(rc_l); Py_XDECREF(sv); Py_XDECREF(rv);
+    PyGILState_Release(st);
+    return rc;
+}
+
+static int scanlike(const char *fn, const void *sendbuf, void *recvbuf,
+                    int count, MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *sv = mv_view(sendbuf, (long)count * dt_extent_b(dt));
+    PyObject *rv = mv_view(recvbuf, (long)count * dt_extent_b(dt));
+    PyObject *res = PyObject_CallMethod(g_shim, fn, "(OOiiii)", sv, rv,
+                                        count, dt, op, comm);
+    int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
+    if (!res) PyErr_Print();
+    Py_XDECREF(res); Py_XDECREF(sv); Py_XDECREF(rv);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Scan(const void *sendbuf, void *recvbuf, int count,
+             MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
+    return scanlike("scan", sendbuf, recvbuf, count, dt, op, comm);
+}
+
+int MPI_Exscan(const void *sendbuf, void *recvbuf, int count,
+               MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
+    return scanlike("exscan", sendbuf, recvbuf, count, dt, op, comm);
+}
+
+/* ---- derived datatypes ----------------------------------------------- */
+
+static int newtype_from(long h, MPI_Datatype *newtype) {
+    if (h < 100) return MPI_ERR_TYPE;
+    *newtype = (MPI_Datatype)h;
+    return MPI_SUCCESS;
+}
+
+int MPI_Type_contiguous(int count, MPI_Datatype oldtype,
+                        MPI_Datatype *newtype) {
+    int ok;
+    long h = shim_call_v("type_contiguous", &ok, "(ii)", count, oldtype);
+    return ok ? newtype_from(h, newtype) : MPI_ERR_TYPE;
+}
+
+int MPI_Type_vector(int count, int blocklength, int stride,
+                    MPI_Datatype oldtype, MPI_Datatype *newtype) {
+    int ok;
+    long h = shim_call_v("type_vector", &ok, "(iiii)", count, blocklength,
+                         stride, oldtype);
+    return ok ? newtype_from(h, newtype) : MPI_ERR_TYPE;
+}
+
+int MPI_Type_create_hvector(int count, int blocklength, MPI_Aint stride,
+                            MPI_Datatype oldtype, MPI_Datatype *newtype) {
+    int ok;
+    long h = shim_call_v("type_create_hvector", &ok, "(iiLi)", count,
+                         blocklength, (long long)stride, oldtype);
+    return ok ? newtype_from(h, newtype) : MPI_ERR_TYPE;
+}
+
+int MPI_Type_indexed(int count, const int blocklengths[],
+                     const int displacements[], MPI_Datatype oldtype,
+                     MPI_Datatype *newtype) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *bl = int_list(blocklengths, count);
+    PyObject *dp = int_list(displacements, count);
+    PyObject *res = PyObject_CallMethod(g_shim, "type_indexed", "(OOi)",
+                                        bl, dp, oldtype);
+    int rc = MPI_ERR_TYPE;
+    if (res) {
+        rc = newtype_from(PyLong_AsLong(res), newtype);
+        Py_DECREF(res);
+    } else {
+        PyErr_Print();
+    }
+    Py_XDECREF(bl); Py_XDECREF(dp);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Type_create_struct(int count, const int blocklengths[],
+                           const MPI_Aint displacements[],
+                           const MPI_Datatype types[],
+                           MPI_Datatype *newtype) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *bl = int_list(blocklengths, count);
+    PyObject *dp = PyList_New(count);
+    PyObject *ty = PyList_New(count);
+    for (int i = 0; i < count; i++) {
+        PyList_SET_ITEM(dp, i, PyLong_FromLongLong(displacements[i]));
+        PyList_SET_ITEM(ty, i, PyLong_FromLong(types[i]));
+    }
+    PyObject *res = PyObject_CallMethod(g_shim, "type_create_struct",
+                                        "(OOO)", bl, dp, ty);
+    int rc = MPI_ERR_TYPE;
+    if (res) {
+        rc = newtype_from(PyLong_AsLong(res), newtype);
+        Py_DECREF(res);
+    } else {
+        PyErr_Print();
+    }
+    Py_XDECREF(bl); Py_XDECREF(dp); Py_XDECREF(ty);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Type_create_resized(MPI_Datatype oldtype, MPI_Aint lb,
+                            MPI_Aint extent, MPI_Datatype *newtype) {
+    int ok;
+    long h = shim_call_v("type_create_resized", &ok, "(iLL)", oldtype,
+                         (long long)lb, (long long)extent);
+    return ok ? newtype_from(h, newtype) : MPI_ERR_TYPE;
+}
+
+int MPI_Type_commit(MPI_Datatype *datatype) {
+    return shim_call_i("type_commit", "(i)", *datatype);
+}
+
+int MPI_Type_free(MPI_Datatype *datatype) {
+    int rc = shim_call_i("type_free", "(i)", *datatype);
+    *datatype = MPI_DATATYPE_NULL;
+    return rc;
+}
+
+int MPI_Type_size(MPI_Datatype datatype, int *size) {
+    *size = dt_size(datatype);
+    return MPI_SUCCESS;
+}
+
+int MPI_Type_get_extent(MPI_Datatype datatype, MPI_Aint *lb,
+                        MPI_Aint *extent) {
+    if (datatype >= 100) {
+        PyGILState_STATE st = PyGILState_Ensure();
+        PyObject *res = PyObject_CallMethod(g_shim, "type_extent", "(i)",
+                                            datatype);
+        int rc = MPI_ERR_TYPE;
+        if (res) {
+            long long l = 0, e = 0;
+            if (PyArg_ParseTuple(res, "LL", &l, &e)) {
+                *lb = l;
+                *extent = e;
+                rc = MPI_SUCCESS;
+            }
+            Py_DECREF(res);
+        } else {
+            PyErr_Print();
+        }
+        PyGILState_Release(st);
+        return rc;
+    }
+    *lb = 0;
+    *extent = dt_size(datatype);
+    return MPI_SUCCESS;
+}
+
+/* ---- comm/group extras ----------------------------------------------- */
+
+int MPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int *result) {
+    int ok;
+    long v = shim_call_v("comm_compare", &ok, "(ii)", comm1, comm2);
+    if (!ok) return MPI_ERR_COMM;
+    *result = (int)v;
+    return MPI_SUCCESS;
+}
+
+int MPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm) {
+    int ok;
+    long v = shim_call_v("comm_create", &ok, "(ii)", comm, group);
+    if (!ok) return MPI_ERR_COMM;
+    *newcomm = v < 0 ? MPI_COMM_NULL : (MPI_Comm)v;
+    return MPI_SUCCESS;
+}
+
+int MPI_Comm_test_inter(MPI_Comm comm, int *flag) {
+    (void)comm;
+    *flag = 0;      /* C-surface comms are intracommunicators */
+    return MPI_SUCCESS;
+}
+
+int MPI_Group_size(MPI_Group group, int *size) {
+    int ok;
+    long v = shim_call_v("group_size", &ok, "(i)", group);
+    if (!ok) return MPI_ERR_GROUP;
+    *size = (int)v;
+    return MPI_SUCCESS;
+}
+
+int MPI_Group_rank(MPI_Group group, int *rank) {
+    int ok;
+    long v = shim_call_v("group_rank", &ok, "(i)", group);
+    if (!ok) return MPI_ERR_GROUP;
+    *rank = (int)v;
+    return MPI_SUCCESS;
+}
+
+int MPI_Group_excl(MPI_Group group, int n, const int ranks[],
+                   MPI_Group *newgroup) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *rl = int_list(ranks, n);
+    PyObject *res = PyObject_CallMethod(g_shim, "group_excl", "(iO)",
+                                        group, rl);
+    int rc = MPI_ERR_GROUP;
+    if (res) {
+        *newgroup = (MPI_Group)PyLong_AsLong(res);
+        rc = MPI_SUCCESS;
+        Py_DECREF(res);
+    } else {
+        PyErr_Print();
+    }
+    Py_XDECREF(rl);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Group_translate_ranks(MPI_Group group1, int n, const int ranks1[],
+                              MPI_Group group2, int ranks2[]) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *rl = int_list(ranks1, n);
+    PyObject *res = PyObject_CallMethod(g_shim, "group_translate_ranks",
+                                        "(iOi)", group1, rl, group2);
+    int rc = MPI_ERR_GROUP;
+    if (res && PyList_Check(res) && PyList_Size(res) == n) {
+        for (int i = 0; i < n; i++)
+            ranks2[i] = (int)PyLong_AsLong(PyList_GET_ITEM(res, i));
+        rc = MPI_SUCCESS;
+    } else if (!res) {
+        PyErr_Print();
+    }
+    Py_XDECREF(res);
+    Py_XDECREF(rl);
+    PyGILState_Release(st);
+    return rc;
+}
+
+/* ---- errors ---------------------------------------------------------- */
+
+int MPI_Error_string(int errorcode, char *string, int *resultlen) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "error_string", "(i)",
+                                        errorcode);
+    int rc = MPI_ERR_OTHER;
+    if (res) {
+        const char *s = PyUnicode_AsUTF8(res);
+        if (s) {
+            snprintf(string, MPI_MAX_ERROR_STRING, "%s", s);
+            *resultlen = (int)strlen(string);
+            rc = MPI_SUCCESS;
+        }
+        Py_DECREF(res);
+    } else {
+        PyErr_Clear();
+        snprintf(string, MPI_MAX_ERROR_STRING, "MPI error %d", errorcode);
+        *resultlen = (int)strlen(string);
+        rc = MPI_SUCCESS;
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Error_class(int errorcode, int *errorclass) {
+    *errorclass = errorcode;   /* codes are classes in this implementation */
+    return MPI_SUCCESS;
+}
+
+static MPI_Errhandler g_errhandler = MPI_ERRORS_RETURN;
+
+int MPI_Comm_set_errhandler(MPI_Comm comm, MPI_Errhandler errhandler) {
+    (void)comm;
+    g_errhandler = errhandler;
+    return MPI_SUCCESS;
+}
+
+int MPI_Comm_get_errhandler(MPI_Comm comm, MPI_Errhandler *errhandler) {
+    (void)comm;
+    *errhandler = g_errhandler;
+    return MPI_SUCCESS;
+}
+
+int MPI_Errhandler_free(MPI_Errhandler *errhandler) {
+    *errhandler = MPI_ERRHANDLER_NULL;
+    return MPI_SUCCESS;
+}
+
+/* ---- RMA atomics ----------------------------------------------------- */
+
+int MPI_Accumulate(const void *origin, int ocount, MPI_Datatype odt,
+                   int target_rank, MPI_Aint target_disp, int tcount,
+                   MPI_Datatype tdt, MPI_Op op, MPI_Win win) {
+    (void)tcount; (void)tdt;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *view = mv_view(origin, (long)ocount * dt_size(odt));
+    PyObject *res = PyObject_CallMethod(g_shim, "accumulate", "(iOiiiLi)",
+                                        win, view, ocount, odt,
+                                        target_rank,
+                                        (long long)target_disp, op);
+    int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
+    if (!res) PyErr_Print();
+    Py_XDECREF(res);
+    Py_XDECREF(view);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Get_accumulate(const void *origin, int ocount, MPI_Datatype odt,
+                       void *result, int rcount, MPI_Datatype rdt,
+                       int target_rank, MPI_Aint target_disp, int tcount,
+                       MPI_Datatype tdt, MPI_Op op, MPI_Win win) {
+    (void)tcount; (void)tdt;
+    /* result geometry governs the fetch (origin is ignored for
+     * MPI_NO_OP and may have ocount == 0, MPI-3.1 §11.3.4) */
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *ov = ocount > 0
+        ? mv_view(origin, (long)ocount * dt_size(odt))
+        : mv_view(NULL, 0);
+    PyObject *rv = mv_view(result, (long)rcount * dt_extent_b(rdt));
+    PyObject *res = PyObject_CallMethod(g_shim, "get_accumulate",
+                                        "(iOOiiiLi)", win, ov, rv, rcount,
+                                        rdt, target_rank,
+                                        (long long)target_disp, op);
+    int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
+    if (!res) PyErr_Print();
+    Py_XDECREF(res); Py_XDECREF(ov); Py_XDECREF(rv);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Fetch_and_op(const void *origin, void *result, MPI_Datatype dt,
+                     int target_rank, MPI_Aint target_disp, MPI_Op op,
+                     MPI_Win win) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *ov = mv_view(origin, dt_size(dt));
+    PyObject *rv = mv_view(result, dt_size(dt));
+    PyObject *res = PyObject_CallMethod(g_shim, "fetch_and_op",
+                                        "(iOOiiLi)", win, ov, rv, dt,
+                                        target_rank,
+                                        (long long)target_disp, op);
+    int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
+    if (!res) PyErr_Print();
+    Py_XDECREF(res); Py_XDECREF(ov); Py_XDECREF(rv);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Compare_and_swap(const void *origin, const void *compare,
+                         void *result, MPI_Datatype dt, int target_rank,
+                         MPI_Aint target_disp, MPI_Win win) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *ov = mv_view(origin, dt_size(dt));
+    PyObject *cv = mv_view(compare, dt_size(dt));
+    PyObject *rv = mv_view(result, dt_size(dt));
+    PyObject *res = PyObject_CallMethod(g_shim, "compare_and_swap",
+                                        "(iOOOiiL)", win, ov, cv, rv, dt,
+                                        target_rank,
+                                        (long long)target_disp);
+    int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
+    if (!res) PyErr_Print();
+    Py_XDECREF(res); Py_XDECREF(ov); Py_XDECREF(cv); Py_XDECREF(rv);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Win_flush_all(MPI_Win win) {
+    return shim_call_i("win_flush_all", "(i)", win);
+}
+
+int MPI_Win_flush_local_all(MPI_Win win) {
+    return shim_call_i("win_flush_local_all", "(i)", win);
+}
+
+int MPI_Win_sync(MPI_Win win) {
+    return shim_call_i("win_sync", "(i)", win);
+}
+
 static int rma_op(const char *fn, MPI_Win win, const void *origin,
                   int count, MPI_Datatype dt, int target, MPI_Aint disp) {
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *view = mv_view(origin, (long)count * dt_size(dt));
+    PyObject *view = mv_view(origin, (long)count * dt_extent_b(dt));
     PyObject *res = PyObject_CallMethod(g_shim, fn, "(iOiiiL)", win, view,
                                         count, dt, target,
                                         (long long)disp);

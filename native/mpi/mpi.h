@@ -55,6 +55,13 @@ typedef struct MPI_Status {
 #define MPI_UNSIGNED_CHAR   ((MPI_Datatype)8)
 #define MPI_SIGNED_CHAR     ((MPI_Datatype)1)
 #define MPI_AINT            ((MPI_Datatype)9)
+#define MPI_UNSIGNED            ((MPI_Datatype)10)
+#define MPI_UNSIGNED_SHORT      ((MPI_Datatype)11)
+#define MPI_UNSIGNED_LONG_LONG  ((MPI_Datatype)6)
+#define MPI_LONG_DOUBLE         ((MPI_Datatype)12)
+#define MPI_C_BOOL              ((MPI_Datatype)13)
+#define MPI_OFFSET              ((MPI_Datatype)5)
+#define MPI_COUNT               ((MPI_Datatype)5)
 #define MPI_DATATYPE_NULL   ((MPI_Datatype)-1)
 
 #define MPI_VERSION    3
@@ -69,7 +76,25 @@ typedef struct MPI_Status {
 #define MPI_LOR  ((MPI_Op)5)
 #define MPI_BAND ((MPI_Op)6)
 #define MPI_BOR  ((MPI_Op)7)
+#define MPI_BXOR   ((MPI_Op)8)
+#define MPI_LXOR   ((MPI_Op)9)
+#define MPI_MINLOC ((MPI_Op)10)
+#define MPI_MAXLOC ((MPI_Op)11)
+#define MPI_REPLACE ((MPI_Op)12)
+#define MPI_NO_OP   ((MPI_Op)13)
 #define MPI_OP_NULL ((MPI_Op)-1)
+
+/* comm compare results */
+#define MPI_IDENT     0
+#define MPI_CONGRUENT 1
+#define MPI_SIMILAR   2
+#define MPI_UNEQUAL   3
+
+/* errhandlers (stored per-comm; this implementation always returns
+ * error codes rather than aborting, matching MPI_ERRORS_RETURN) */
+#define MPI_ERRORS_ARE_FATAL ((MPI_Errhandler)0)
+#define MPI_ERRORS_RETURN    ((MPI_Errhandler)1)
+#define MPI_ERRHANDLER_NULL  ((MPI_Errhandler)-1)
 
 /* special values */
 #define MPI_ANY_SOURCE   (-1)
@@ -89,7 +114,7 @@ typedef struct MPI_Status {
 #define MPI_MAX_PROCESSOR_NAME 256
 #define MPI_MAX_ERROR_STRING   512
 
-/* error classes (subset; mirrors mvapich2_tpu/core/errors.py) */
+/* error classes (mirrors mvapich2_tpu/core/errors.py) */
 #define MPI_SUCCESS      0
 #define MPI_ERR_BUFFER   1
 #define MPI_ERR_COUNT    2
@@ -97,9 +122,18 @@ typedef struct MPI_Status {
 #define MPI_ERR_TAG      4
 #define MPI_ERR_COMM     5
 #define MPI_ERR_RANK     6
+#define MPI_ERR_REQUEST  7
+#define MPI_ERR_ROOT     8
+#define MPI_ERR_GROUP    9
+#define MPI_ERR_OP       10
+#define MPI_ERR_TOPOLOGY 11
+#define MPI_ERR_DIMS     12
+#define MPI_ERR_ARG      13
+#define MPI_ERR_UNKNOWN  14
 #define MPI_ERR_TRUNCATE 15
 #define MPI_ERR_OTHER    16
 #define MPI_ERR_INTERN   17
+#define MPI_ERR_LASTCODE 100
 
 /* thread levels */
 #define MPI_THREAD_SINGLE     0
@@ -135,6 +169,37 @@ int MPI_Group_free(MPI_Group *group);
 int MPI_Get_address(const void *location, MPI_Aint *address);
 
 /* ---- pt2pt ---- */
+int MPI_Ssend(const void *buf, int count, MPI_Datatype dt, int dest,
+              int tag, MPI_Comm comm);
+int MPI_Bsend(const void *buf, int count, MPI_Datatype dt, int dest,
+              int tag, MPI_Comm comm);
+int MPI_Rsend(const void *buf, int count, MPI_Datatype dt, int dest,
+              int tag, MPI_Comm comm);
+int MPI_Issend(const void *buf, int count, MPI_Datatype dt, int dest,
+               int tag, MPI_Comm comm, MPI_Request *req);
+int MPI_Sendrecv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
+                 int dest, int sendtag, void *recvbuf, int recvcount,
+                 MPI_Datatype rdt, int source, int recvtag, MPI_Comm comm,
+                 MPI_Status *status);
+int MPI_Sendrecv_replace(void *buf, int count, MPI_Datatype dt, int dest,
+                         int sendtag, int source, int recvtag,
+                         MPI_Comm comm, MPI_Status *status);
+int MPI_Probe(int source, int tag, MPI_Comm comm, MPI_Status *status);
+int MPI_Iprobe(int source, int tag, MPI_Comm comm, int *flag,
+               MPI_Status *status);
+int MPI_Waitany(int count, MPI_Request reqs[], int *index,
+                MPI_Status *status);
+int MPI_Testall(int count, MPI_Request reqs[], int *flag,
+                MPI_Status statuses[]);
+int MPI_Send_init(const void *buf, int count, MPI_Datatype dt, int dest,
+                  int tag, MPI_Comm comm, MPI_Request *req);
+int MPI_Recv_init(void *buf, int count, MPI_Datatype dt, int source,
+                  int tag, MPI_Comm comm, MPI_Request *req);
+int MPI_Start(MPI_Request *req);
+int MPI_Startall(int count, MPI_Request reqs[]);
+int MPI_Request_free(MPI_Request *req);
+int MPI_Buffer_attach(void *buffer, int size);
+int MPI_Buffer_detach(void *buffer_addr, int *size);
 int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
              int tag, MPI_Comm comm);
 int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
@@ -171,6 +236,66 @@ int MPI_Scatter(const void *sendbuf, int sendcount, MPI_Datatype sdt,
 int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
                              int recvcount, MPI_Datatype dt, MPI_Op op,
                              MPI_Comm comm);
+int MPI_Reduce_scatter(const void *sendbuf, void *recvbuf,
+                       const int recvcounts[], MPI_Datatype dt, MPI_Op op,
+                       MPI_Comm comm);
+int MPI_Allgatherv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
+                   void *recvbuf, const int recvcounts[],
+                   const int displs[], MPI_Datatype rdt, MPI_Comm comm);
+int MPI_Alltoallv(const void *sendbuf, const int sendcounts[],
+                  const int sdispls[], MPI_Datatype sdt, void *recvbuf,
+                  const int recvcounts[], const int rdispls[],
+                  MPI_Datatype rdt, MPI_Comm comm);
+int MPI_Gatherv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
+                void *recvbuf, const int recvcounts[], const int displs[],
+                MPI_Datatype rdt, int root, MPI_Comm comm);
+int MPI_Scatterv(const void *sendbuf, const int sendcounts[],
+                 const int displs[], MPI_Datatype sdt, void *recvbuf,
+                 int recvcount, MPI_Datatype rdt, int root, MPI_Comm comm);
+int MPI_Scan(const void *sendbuf, void *recvbuf, int count,
+             MPI_Datatype dt, MPI_Op op, MPI_Comm comm);
+int MPI_Exscan(const void *sendbuf, void *recvbuf, int count,
+               MPI_Datatype dt, MPI_Op op, MPI_Comm comm);
+
+/* ---- derived datatypes ---- */
+int MPI_Type_contiguous(int count, MPI_Datatype oldtype,
+                        MPI_Datatype *newtype);
+int MPI_Type_vector(int count, int blocklength, int stride,
+                    MPI_Datatype oldtype, MPI_Datatype *newtype);
+int MPI_Type_create_hvector(int count, int blocklength, MPI_Aint stride,
+                            MPI_Datatype oldtype, MPI_Datatype *newtype);
+int MPI_Type_indexed(int count, const int blocklengths[],
+                     const int displacements[], MPI_Datatype oldtype,
+                     MPI_Datatype *newtype);
+int MPI_Type_create_struct(int count, const int blocklengths[],
+                           const MPI_Aint displacements[],
+                           const MPI_Datatype types[],
+                           MPI_Datatype *newtype);
+int MPI_Type_create_resized(MPI_Datatype oldtype, MPI_Aint lb,
+                            MPI_Aint extent, MPI_Datatype *newtype);
+int MPI_Type_commit(MPI_Datatype *datatype);
+int MPI_Type_free(MPI_Datatype *datatype);
+int MPI_Type_size(MPI_Datatype datatype, int *size);
+int MPI_Type_get_extent(MPI_Datatype datatype, MPI_Aint *lb,
+                        MPI_Aint *extent);
+
+/* ---- comm/group extras ---- */
+int MPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int *result);
+int MPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm);
+int MPI_Comm_test_inter(MPI_Comm comm, int *flag);
+int MPI_Group_size(MPI_Group group, int *size);
+int MPI_Group_rank(MPI_Group group, int *rank);
+int MPI_Group_excl(MPI_Group group, int n, const int ranks[],
+                   MPI_Group *newgroup);
+int MPI_Group_translate_ranks(MPI_Group group1, int n, const int ranks1[],
+                              MPI_Group group2, int ranks2[]);
+
+/* ---- errors ---- */
+int MPI_Error_string(int errorcode, char *string, int *resultlen);
+int MPI_Error_class(int errorcode, int *errorclass);
+int MPI_Comm_set_errhandler(MPI_Comm comm, MPI_Errhandler errhandler);
+int MPI_Comm_get_errhandler(MPI_Comm comm, MPI_Errhandler *errhandler);
+int MPI_Errhandler_free(MPI_Errhandler *errhandler);
 
 /* ---- one-sided ---- */
 int MPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
@@ -198,6 +323,23 @@ int MPI_Put(const void *origin, int origin_count, MPI_Datatype odt,
 int MPI_Get(void *origin, int origin_count, MPI_Datatype odt,
             int target_rank, MPI_Aint target_disp, int target_count,
             MPI_Datatype tdt, MPI_Win win);
+int MPI_Accumulate(const void *origin, int origin_count, MPI_Datatype odt,
+                   int target_rank, MPI_Aint target_disp, int target_count,
+                   MPI_Datatype tdt, MPI_Op op, MPI_Win win);
+int MPI_Get_accumulate(const void *origin, int origin_count,
+                       MPI_Datatype odt, void *result, int result_count,
+                       MPI_Datatype rdt, int target_rank,
+                       MPI_Aint target_disp, int target_count,
+                       MPI_Datatype tdt, MPI_Op op, MPI_Win win);
+int MPI_Fetch_and_op(const void *origin, void *result, MPI_Datatype dt,
+                     int target_rank, MPI_Aint target_disp, MPI_Op op,
+                     MPI_Win win);
+int MPI_Compare_and_swap(const void *origin, const void *compare,
+                         void *result, MPI_Datatype dt, int target_rank,
+                         MPI_Aint target_disp, MPI_Win win);
+int MPI_Win_flush_all(MPI_Win win);
+int MPI_Win_flush_local_all(MPI_Win win);
+int MPI_Win_sync(MPI_Win win);
 
 #ifdef __cplusplus
 }

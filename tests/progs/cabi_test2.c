@@ -1,0 +1,342 @@
+/* cabi_test2.c — conformance for the widened C ABI surface:
+ * v-collectives, derived datatypes, send modes, probe, waitany/testall,
+ * persistent requests, scan/exscan, comm/group extras, RMA atomics,
+ * error strings. Prints "No Errors" on success (runtests contract). */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static int errs = 0;
+
+#define CHECK(cond, msg) do { \
+    if (!(cond)) { errs++; fprintf(stderr, "rank %d: %s\n", rank, msg); } \
+} while (0)
+
+int main(int argc, char **argv) {
+    int rank, size;
+    MPI_Init(&argc, &argv);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+    /* ---- allgatherv with displs (reversed layout) ---- */
+    {
+        int *rcounts = malloc(size * sizeof(int));
+        int *displs = malloc(size * sizeof(int));
+        int total = 0;
+        for (int i = 0; i < size; i++) { rcounts[i] = i + 1; total += i + 1; }
+        int off = total;
+        for (int i = 0; i < size; i++) { off -= rcounts[i]; displs[i] = off; }
+        double *sb = malloc((rank + 1) * sizeof(double));
+        for (int i = 0; i <= rank; i++) sb[i] = rank * 10.0;
+        double *rb = calloc(total, sizeof(double));
+        MPI_Allgatherv(sb, rank + 1, MPI_DOUBLE, rb, rcounts, displs,
+                       MPI_DOUBLE, MPI_COMM_WORLD);
+        for (int i = 0; i < size; i++)
+            for (int k = 0; k < rcounts[i]; k++)
+                CHECK(rb[displs[i] + k] == i * 10.0, "allgatherv payload");
+        free(sb); free(rb); free(rcounts); free(displs);
+    }
+
+    /* ---- alltoallv ---- */
+    {
+        int *sc = malloc(size * sizeof(int)), *sd = malloc(size * sizeof(int));
+        int *rc = malloc(size * sizeof(int)), *rd = malloc(size * sizeof(int));
+        int stot = 0, rtot = 0;
+        for (int j = 0; j < size; j++) {
+            sc[j] = j + 1; sd[j] = stot; stot += sc[j];
+            rc[j] = rank + 1; rd[j] = rtot; rtot += rc[j];
+        }
+        int *sb = malloc(stot * sizeof(int));
+        for (int j = 0; j < size; j++)
+            for (int k = 0; k < sc[j]; k++)
+                sb[sd[j] + k] = rank * 100 + j;
+        int *rb = calloc(rtot, sizeof(int));
+        MPI_Alltoallv(sb, sc, sd, MPI_INT, rb, rc, rd, MPI_INT,
+                      MPI_COMM_WORLD);
+        for (int i = 0; i < size; i++)
+            for (int k = 0; k < rc[i]; k++)
+                CHECK(rb[rd[i] + k] == i * 100 + rank, "alltoallv payload");
+        free(sb); free(rb); free(sc); free(sd); free(rc); free(rd);
+    }
+
+    /* ---- reduce_scatter (irregular counts) ---- */
+    {
+        int *rcounts = malloc(size * sizeof(int));
+        int total = 0;
+        for (int i = 0; i < size; i++) { rcounts[i] = i + 1; total += i + 1; }
+        double *sb = malloc(total * sizeof(double));
+        for (int i = 0; i < total; i++) sb[i] = (double)i;
+        double *rb = calloc(rcounts[rank], sizeof(double));
+        MPI_Reduce_scatter(sb, rb, rcounts, MPI_DOUBLE, MPI_SUM,
+                           MPI_COMM_WORLD);
+        int off = 0;
+        for (int i = 0; i < rank; i++) off += rcounts[i];
+        for (int k = 0; k < rcounts[rank]; k++)
+            CHECK(rb[k] == (double)(off + k) * size, "reduce_scatter");
+        free(sb); free(rb); free(rcounts);
+    }
+
+    /* ---- scatterv ---- */
+    {
+        int *sc = malloc(size * sizeof(int));
+        int *dp = malloc(size * sizeof(int));
+        int total = 0;
+        for (int i = 0; i < size; i++) {
+            sc[i] = i + 1; dp[i] = total; total += sc[i];
+        }
+        double *sb = NULL;
+        if (rank == 0) {
+            sb = malloc(total * sizeof(double));
+            for (int i = 0; i < total; i++) sb[i] = (double)i * 3.0;
+        }
+        double *rb = calloc(rank + 1, sizeof(double));
+        MPI_Scatterv(sb, sc, dp, MPI_DOUBLE, rb, rank + 1, MPI_DOUBLE, 0,
+                     MPI_COMM_WORLD);
+        for (int k = 0; k <= rank; k++)
+            CHECK(rb[k] == (double)(dp[rank] + k) * 3.0, "scatterv");
+        free(sb); free(rb); free(sc); free(dp);
+    }
+
+    /* ---- scan / exscan ---- */
+    {
+        long v = rank + 1, out = 0;
+        MPI_Scan(&v, &out, 1, MPI_LONG, MPI_SUM, MPI_COMM_WORLD);
+        CHECK(out == (long)(rank + 1) * (rank + 2) / 2, "scan");
+        long ex = -1;
+        MPI_Exscan(&v, &ex, 1, MPI_LONG, MPI_SUM, MPI_COMM_WORLD);
+        if (rank > 0)
+            CHECK(ex == (long)rank * (rank + 1) / 2, "exscan");
+    }
+
+    /* ---- derived datatypes: vector over the wire ---- */
+    if (size >= 2 && rank < 2) {
+        MPI_Datatype vec;
+        MPI_Type_vector(4, 1, 2, MPI_DOUBLE, &vec);
+        MPI_Type_commit(&vec);
+        int tsz; MPI_Type_size(vec, &tsz);
+        CHECK(tsz == 4 * 8, "type_size(vector)");
+        MPI_Aint lb, ext; MPI_Type_get_extent(vec, &lb, &ext);
+        CHECK(ext == 7 * 8, "type_extent(vector)");
+        double buf[8], got[8];
+        for (int i = 0; i < 8; i++) { buf[i] = rank * 50.0 + i; got[i] = -1.0; }
+        int peer = 1 - rank;
+        MPI_Sendrecv(buf, 1, vec, peer, 11, got, 1, vec, peer, 11,
+                     MPI_COMM_WORLD, MPI_STATUS_IGNORE);
+        for (int i = 0; i < 8; i += 2)
+            CHECK(got[i] == peer * 50.0 + i, "vector sendrecv strided");
+        CHECK(got[1] == -1.0, "vector gap untouched");
+        MPI_Type_free(&vec);
+
+        MPI_Datatype ctg;
+        MPI_Type_contiguous(3, MPI_INT, &ctg);
+        MPI_Type_commit(&ctg);
+        int ib[6] = {0}, ig[6] = {0};
+        for (int i = 0; i < 6; i++) ib[i] = rank * 7 + i;
+        MPI_Sendrecv(ib, 2, ctg, peer, 12, ig, 2, ctg, peer, 12,
+                     MPI_COMM_WORLD, MPI_STATUS_IGNORE);
+        for (int i = 0; i < 6; i++)
+            CHECK(ig[i] == peer * 7 + i, "contiguous(3) x2");
+        MPI_Type_free(&ctg);
+    }
+
+    /* ---- derived datatypes in collectives ---- */
+    {
+        MPI_Datatype pair;
+        MPI_Type_contiguous(2, MPI_INT, &pair);
+        MPI_Type_commit(&pair);
+        /* bcast of 3 pair elements */
+        int pb[6];
+        for (int i = 0; i < 6; i++) pb[i] = (rank == 0) ? 70 + i : -1;
+        MPI_Bcast(pb, 3, pair, 0, MPI_COMM_WORLD);
+        for (int i = 0; i < 6; i++)
+            CHECK(pb[i] == 70 + i, "bcast derived");
+        /* allgatherv of 1 pair per rank, reversed displs */
+        int *rc2 = malloc(size * sizeof(int));
+        int *dp2 = malloc(size * sizeof(int));
+        for (int i = 0; i < size; i++) {
+            rc2[i] = 1; dp2[i] = size - 1 - i;
+        }
+        int mine2[2] = {rank * 2, rank * 2 + 1};
+        int *gath = calloc(2 * size, sizeof(int));
+        MPI_Allgatherv(mine2, 1, pair, gath, rc2, dp2, pair,
+                       MPI_COMM_WORLD);
+        for (int i = 0; i < size; i++) {
+            CHECK(gath[2 * dp2[i]] == i * 2, "allgatherv derived lo");
+            CHECK(gath[2 * dp2[i] + 1] == i * 2 + 1,
+                  "allgatherv derived hi");
+        }
+        /* allreduce on a homogeneous derived type */
+        int ar[4], arr_out[4];
+        for (int i = 0; i < 4; i++) ar[i] = rank + i;
+        MPI_Allreduce(ar, arr_out, 2, pair, MPI_SUM, MPI_COMM_WORLD);
+        for (int i = 0; i < 4; i++)
+            CHECK(arr_out[i] == size * i + size * (size - 1) / 2,
+                  "allreduce derived");
+        MPI_Type_free(&pair);
+        free(rc2); free(dp2); free(gath);
+    }
+
+    /* ---- ssend / probe / iprobe ---- */
+    if (size >= 2 && rank < 2) {
+        int peer = 1 - rank;
+        if (rank == 0) {
+            double d[5] = {1, 2, 3, 4, 5};
+            MPI_Ssend(d, 5, MPI_DOUBLE, 1, 21, MPI_COMM_WORLD);
+        } else {
+            MPI_Status st;
+            MPI_Probe(0, 21, MPI_COMM_WORLD, &st);
+            int n; MPI_Get_count(&st, MPI_DOUBLE, &n);
+            CHECK(n == 5, "probe count");
+            double d[5];
+            MPI_Recv(d, 5, MPI_DOUBLE, 0, 21, MPI_COMM_WORLD, &st);
+            CHECK(d[4] == 5.0, "ssend payload");
+            int flag = 1;
+            MPI_Iprobe(0, 99, MPI_COMM_WORLD, &flag, &st);
+            CHECK(flag == 0, "iprobe empty");
+        }
+        (void)peer;
+    }
+
+    /* ---- waitany / testall ---- */
+    if (size >= 2 && rank < 2) {
+        int peer = 1 - rank;
+        MPI_Request reqs[4];
+        int rbuf[4][2], sbuf[4][2];
+        for (int i = 0; i < 4; i++) {
+            sbuf[i][0] = rank * 10 + i; sbuf[i][1] = i;
+            MPI_Irecv(rbuf[i], 2, MPI_INT, peer, 30 + i, MPI_COMM_WORLD,
+                      &reqs[i]);
+        }
+        MPI_Request sreqs[4];
+        for (int i = 0; i < 4; i++)
+            MPI_Isend(sbuf[i], 2, MPI_INT, peer, 30 + i, MPI_COMM_WORLD,
+                      &sreqs[i]);
+        int seen = 0;
+        while (seen < 4) {
+            int idx; MPI_Status st;
+            MPI_Waitany(4, reqs, &idx, &st);
+            if (idx == MPI_UNDEFINED) break;
+            CHECK(rbuf[idx][0] == peer * 10 + idx, "waitany payload");
+            CHECK(st.MPI_TAG == 30 + idx, "waitany status tag");
+            CHECK(st.MPI_SOURCE == peer, "waitany status source");
+            seen++;
+        }
+        CHECK(seen == 4, "waitany drained");
+        int flag = 0;
+        while (!flag)
+            MPI_Testall(4, sreqs, &flag, MPI_STATUSES_IGNORE);
+    }
+
+    /* ---- persistent requests ---- */
+    if (size >= 2 && rank < 2) {
+        int peer = 1 - rank;
+        double sb[4], rb[4];
+        MPI_Request ps, pr;
+        MPI_Send_init(sb, 4, MPI_DOUBLE, peer, 40, MPI_COMM_WORLD, &ps);
+        MPI_Recv_init(rb, 4, MPI_DOUBLE, peer, 40, MPI_COMM_WORLD, &pr);
+        for (int round = 0; round < 3; round++) {
+            for (int i = 0; i < 4; i++) sb[i] = rank * 1000 + round;
+            MPI_Start(&pr);
+            MPI_Start(&ps);
+            MPI_Wait(&ps, MPI_STATUS_IGNORE);
+            MPI_Wait(&pr, MPI_STATUS_IGNORE);
+            CHECK(rb[0] == peer * 1000 + round, "persistent round");
+        }
+        /* complete a round via MPI_Test: handle must stay restartable */
+        for (int i = 0; i < 4; i++) sb[i] = rank * 1000 + 99;
+        MPI_Start(&pr);
+        MPI_Start(&ps);
+        int pf = 0;
+        while (!pf) MPI_Test(&pr, &pf, MPI_STATUS_IGNORE);
+        CHECK(pr != MPI_REQUEST_NULL, "persistent survives Test");
+        MPI_Wait(&ps, MPI_STATUS_IGNORE);
+        CHECK(rb[0] == peer * 1000 + 99, "persistent via Test");
+        MPI_Start(&pr);
+        MPI_Start(&ps);
+        MPI_Wait(&ps, MPI_STATUS_IGNORE);
+        MPI_Wait(&pr, MPI_STATUS_IGNORE);
+        CHECK(rb[0] == peer * 1000 + 99, "persistent restart after Test");
+        MPI_Request_free(&ps);
+        MPI_Request_free(&pr);
+    }
+
+    /* ---- comm/group extras ---- */
+    {
+        MPI_Comm dup;
+        MPI_Comm_dup(MPI_COMM_WORLD, &dup);
+        int cmp;
+        MPI_Comm_compare(MPI_COMM_WORLD, dup, &cmp);
+        CHECK(cmp == MPI_CONGRUENT, "comm_compare dup");
+        MPI_Comm_compare(MPI_COMM_WORLD, MPI_COMM_WORLD, &cmp);
+        CHECK(cmp == MPI_IDENT, "comm_compare self");
+
+        MPI_Group wg, evens;
+        MPI_Comm_group(MPI_COMM_WORLD, &wg);
+        int gs; MPI_Group_size(wg, &gs);
+        CHECK(gs == size, "group_size");
+        int n_even = (size + 1) / 2;
+        int *er = malloc(n_even * sizeof(int));
+        for (int i = 0; i < n_even; i++) er[i] = 2 * i;
+        MPI_Group_incl(wg, n_even, er, &evens);
+        MPI_Comm sub;
+        MPI_Comm_create(MPI_COMM_WORLD, evens, &sub);
+        if (rank % 2 == 0) {
+            CHECK(sub != MPI_COMM_NULL, "comm_create member");
+            int sr; MPI_Comm_rank(sub, &sr);
+            CHECK(sr == rank / 2, "comm_create rank");
+            MPI_Comm_free(&sub);
+        } else {
+            CHECK(sub == MPI_COMM_NULL, "comm_create nonmember");
+        }
+        int tr_in[1] = {0}, tr_out[1] = {-5};
+        MPI_Group_translate_ranks(evens, 1, tr_in, wg, tr_out);
+        CHECK(tr_out[0] == 0, "translate_ranks");
+        MPI_Group_free(&evens);
+        MPI_Group_free(&wg);
+        MPI_Comm_free(&dup);
+        free(er);
+    }
+
+    /* ---- RMA atomics ---- */
+    {
+        long lbuf[2] = {0, 0};
+        MPI_Win win;
+        MPI_Win_create(lbuf, 2 * sizeof(long), sizeof(long),
+                       MPI_INFO_NULL, MPI_COMM_WORLD, &win);
+        MPI_Win_fence(0, win);
+        long one = 1 + rank;
+        MPI_Accumulate(&one, 1, MPI_LONG, 0, 0, 1, MPI_LONG, MPI_SUM, win);
+        MPI_Win_fence(0, win);
+        if (rank == 0)
+            CHECK(lbuf[0] == (long)size * (size + 1) / 2, "accumulate");
+
+        long ticket = -1, inc = 1;
+        MPI_Win_lock(MPI_LOCK_SHARED, 0, 0, win);
+        MPI_Fetch_and_op(&inc, &ticket, MPI_LONG, 0, 1, MPI_SUM, win);
+        MPI_Win_unlock(0, win);
+        MPI_Barrier(MPI_COMM_WORLD);
+        CHECK(ticket >= 0 && ticket < size, "fetch_and_op ticket");
+        if (rank == 0)
+            CHECK(lbuf[1] == size, "fetch_and_op total");
+        MPI_Win_free(&win);
+    }
+
+    /* ---- error strings ---- */
+    {
+        char msg[MPI_MAX_ERROR_STRING];
+        int len = 0;
+        MPI_Error_string(MPI_ERR_RANK, msg, &len);
+        CHECK(len > 0 && strlen(msg) > 0, "error_string");
+        int cls = -1;
+        MPI_Error_class(MPI_ERR_TRUNCATE, &cls);
+        CHECK(cls == MPI_ERR_TRUNCATE, "error_class");
+    }
+
+    int tot = 0;
+    MPI_Allreduce(&errs, &tot, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD);
+    if (rank == 0 && tot == 0)
+        printf("No Errors\n");
+    MPI_Finalize();
+    return tot ? 1 : 0;
+}

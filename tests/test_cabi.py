@@ -76,3 +76,47 @@ def test_unmodified_osu_allreduce():
     lines = [l for l in r.stdout.splitlines()
              if l and not l.startswith("#")]
     assert len(lines) >= 7
+
+
+def test_cabi_widened_surface():
+    """cabi_test2.c: v-collectives, derived datatypes, send modes,
+    probe/waitany/testall, persistent requests, scan/exscan, comm/group
+    extras, RMA atomics, error strings (VERDICT r1 missing #9)."""
+    out = os.path.join(tempfile.mkdtemp(), "cabi_test2")
+    _compile([os.path.join(REPO, "tests", "progs", "cabi_test2.c")], out)
+    r = _mpirun(4, out)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+
+
+@pytest.mark.skipif(not os.path.isdir(OSU),
+                    reason="reference OSU suite not mounted")
+def test_unmodified_osu_allgatherv():
+    """The v-collective OSU programs build and run unmodified."""
+    out = os.path.join(tempfile.mkdtemp(), "osu_allgatherv")
+    _compile([os.path.join(OSU, "mpi", "collective", "osu_allgatherv.c"),
+              os.path.join(OSU, "util", "osu_util.c"),
+              os.path.join(OSU, "util", "osu_util_mpi.c")],
+             out, extra=[f"-I{OSU}/util", "-DFIELD_WIDTH=18",
+                         "-DFLOAT_PRECISION=2"])
+    r = _mpirun(3, out, "-m", "512", "-i", "20")
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "Allgatherv" in r.stdout
+    lines = [l for l in r.stdout.splitlines()
+             if l and not l.startswith("#")]
+    assert len(lines) >= 7
+
+
+@pytest.mark.skipif(not os.path.isdir(OSU),
+                    reason="reference OSU suite not mounted")
+def test_unmodified_osu_reduce_scatter():
+    out = os.path.join(tempfile.mkdtemp(), "osu_reduce_scatter")
+    _compile([os.path.join(OSU, "mpi", "collective",
+                           "osu_reduce_scatter.c"),
+              os.path.join(OSU, "util", "osu_util.c"),
+              os.path.join(OSU, "util", "osu_util_mpi.c")],
+             out, extra=[f"-I{OSU}/util", "-DFIELD_WIDTH=18",
+                         "-DFLOAT_PRECISION=2"])
+    r = _mpirun(3, out, "-m", "512", "-i", "20")
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "Reduce_scatter" in r.stdout
