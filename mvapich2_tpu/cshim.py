@@ -264,13 +264,18 @@ def irecv(view, count: int, dtcode: int, source: int, tag: int,
 def wait(rh: int):
     """Returns (source, tag, count_bytes, persistent). Persistent
     requests stay allocated (inactive) after completion (MPI-3.1 §3.9);
-    others are deallocated."""
+    others are deallocated. Wait on an INACTIVE persistent request
+    returns at once with an empty status (§3.7.3)."""
     with _lock:
         r = _reqs.get(rh)
     if r is None:
         return (-1, -1, 0, 0)
     persistent = bool(getattr(r, "persistent", False))
+    if persistent and not getattr(r, "_c_active", False):
+        return (-1, -1, 0, 1)
     st = r.wait()
+    if persistent:
+        r._c_active = False
     if not persistent:
         with _lock:
             _reqs.pop(rh, None)
@@ -280,19 +285,24 @@ def wait(rh: int):
 
 
 def test(rh: int):
-    """Returns (flag, persistent, source, tag, count_bytes)."""
+    """Returns (flag, persistent, source, tag, count_bytes). Test on an
+    INACTIVE persistent request returns flag=1, empty status (§3.7.3)."""
     with _lock:
         r = _reqs.get(rh)
     if r is None:
         return (1, 0, -1, -1, 0)
+    persistent = bool(getattr(r, "persistent", False))
+    if persistent and not getattr(r, "_c_active", False):
+        return (1, 1, -1, -1, 0)
     done = r.test()
     if not done:
         return (0, 0, -1, -1, 0)
-    persistent = bool(getattr(r, "persistent", False))
     if not persistent:
         with _lock:
             _reqs.pop(rh, None)
     st = r.wait()
+    if persistent:
+        r._c_active = False
     if st is None:
         return (1, 1 if persistent else 0, -1, -1, 0)
     return (1, 1 if persistent else 0, st.source, st.tag, st.count)
@@ -363,8 +373,9 @@ def alltoall(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
              ch: int) -> int:
     c = _comm(ch)
     if sdt >= _DERIVED_BASE or rdt >= _DERIVED_BASE:
-        if sview is None:                   # MPI_IN_PLACE
+        if sview is None:                   # MPI_IN_PLACE: sendcount and
             sview = bytes(np.frombuffer(rview, np.uint8))
+            scount, sdt = rcount, rdt       # sendtype are ignored (§5.8)
         n = c.size
         return alltoallv(sview, rview, [scount] * n,
                          [i * scount for i in range(n)],
@@ -669,7 +680,9 @@ def recv_init(view, count: int, dtcode: int, source: int, tag: int,
 
 
 def start(rh: int) -> int:
-    _reqs[rh].start()
+    r = _reqs[rh]
+    r.start()
+    r._c_active = True
     return 0
 
 
@@ -688,7 +701,9 @@ def testall(handles):
             continue
         persistent = bool(getattr(r, "persistent", False))
         st = r.wait()
-        if not persistent:
+        if persistent:
+            r._c_active = False
+        else:
             with _lock:
                 _reqs.pop(h, None)
         if st is None:
@@ -697,6 +712,36 @@ def testall(handles):
             out.append((st.source, st.tag, st.count,
                         1 if persistent else 0))
     return (1, out)
+
+
+def waitany(handles):
+    """Blocking MPI_Waitany over live handles: returns (pos, src, tag,
+    count, persistent) with pos = index into `handles`, or pos = -1 when
+    every handle is null/absent. Blocks on the progress engine's
+    condition variable instead of busy-polling."""
+    from .core import request as rq
+    with _lock:
+        pairs = [(i, _reqs.get(h)) for i, h in enumerate(handles)]
+    live = [(i, r) for i, r in pairs if r is not None]
+    # inactive persistent requests complete immediately (§3.7.3)
+    for i, r in live:
+        if getattr(r, "persistent", False) and \
+                not getattr(r, "_c_active", False):
+            return (i, -1, -1, 0, 1)
+    if not live:
+        return (-1, -1, -1, 0, 0)
+    idx = rq.waitany([r for _, r in live])
+    i, r = live[idx]
+    persistent = bool(getattr(r, "persistent", False))
+    st = r.wait()
+    if persistent:
+        r._c_active = False
+    else:
+        with _lock:
+            _reqs.pop(handles[i], None)
+    if st is None:
+        return (i, -1, -1, 0, 1 if persistent else 0)
+    return (i, st.source, st.tag, st.count, 1 if persistent else 0)
 
 
 def request_free(rh: int) -> int:

@@ -824,10 +824,15 @@ int MPI_Sendrecv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
                        &rreq);
     if (rc != MPI_SUCCESS) return rc;
     rc = MPI_Isend(sendbuf, sendcount, sdt, dest, sendtag, comm, &sreq);
-    if (rc != MPI_SUCCESS) return rc;
+    if (rc != MPI_SUCCESS) {
+        /* don't abandon the posted receive: drop its shim handle so it
+         * cannot later write into a reused stack buffer's handle slot */
+        MPI_Request_free(&rreq);
+        return rc;
+    }
     rc = MPI_Wait(&rreq, status);
-    if (rc != MPI_SUCCESS) return rc;
-    return MPI_Wait(&sreq, MPI_STATUS_IGNORE);
+    int rc2 = MPI_Wait(&sreq, MPI_STATUS_IGNORE);
+    return rc != MPI_SUCCESS ? rc : rc2;
 }
 
 int MPI_Sendrecv_replace(void *buf, int count, MPI_Datatype dt, int dest,
@@ -877,19 +882,40 @@ int MPI_Iprobe(int source, int tag, MPI_Comm comm, int *flag,
 
 int MPI_Waitany(int count, MPI_Request reqs[], int *index,
                 MPI_Status *status) {
-    int live = 0;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *hl = PyList_New(count);
     for (int i = 0; i < count; i++)
-        if (reqs[i] != MPI_REQUEST_NULL) live++;
-    if (live == 0) { *index = MPI_UNDEFINED; return MPI_SUCCESS; }
-    for (;;) {
-        for (int i = 0; i < count; i++) {
-            if (reqs[i] == MPI_REQUEST_NULL) continue;
-            int flag = 0;
-            int rc = MPI_Test(&reqs[i], &flag, status);
-            if (rc != MPI_SUCCESS) return rc;
-            if (flag) { *index = i; return MPI_SUCCESS; }
+        PyList_SET_ITEM(hl, i, PyLong_FromLong((long)reqs[i]));
+    PyObject *res = PyObject_CallMethod(g_shim, "waitany", "(O)", hl);
+    int rc = MPI_ERR_OTHER;
+    if (res) {
+        int pos = -1, src = -1, tag = -1, cnt = 0, persistent = 0;
+        if (PyArg_ParseTuple(res, "iiiii", &pos, &src, &tag, &cnt,
+                             &persistent)) {
+            rc = MPI_SUCCESS;
+            if (pos < 0) {
+                *index = MPI_UNDEFINED;
+            } else {
+                *index = pos;
+                if (status != MPI_STATUS_IGNORE) {
+                    status->MPI_SOURCE = src;
+                    status->MPI_TAG = tag;
+                    status->MPI_ERROR = MPI_SUCCESS;
+                    status->_count = cnt;
+                }
+                if (!persistent)
+                    reqs[pos] = MPI_REQUEST_NULL;
+            }
+        } else {
+            PyErr_Print();
         }
+        Py_DECREF(res);
+    } else {
+        PyErr_Print();
     }
+    Py_XDECREF(hl);
+    PyGILState_Release(st);
+    return rc;
 }
 
 int MPI_Testall(int count, MPI_Request reqs[], int *flag,

@@ -1,0 +1,316 @@
+/* mpif.c — Fortran-77 bindings over the MPI C ABI.
+ *
+ * The reference carries generated mpif.h wrappers
+ * (src/binding/fortran/mpif_h/); here the C ABI already uses small
+ * integer handles, so the Fortran layer is a thin calling-convention
+ * shim: lowercase_ names, every argument by reference, INTEGER status
+ * arrays of MPI_STATUS_SIZE=4 (SOURCE, TAG, ERROR, count-bytes), and
+ * hidden string lengths appended for CHARACTER arguments (the gfortran
+ * ABI). MPI_BOTTOM / MPI_IN_PLACE are recognized by address via the
+ * MPIPRIV common block declared in mpif.h (the MPICH MPIFCMB scheme).
+ *
+ * Built into libmpi.so; compile Fortran programs with bin/mpifort.
+ */
+#include <string.h>
+
+#include "mpi.h"
+
+/* mpif.h declares: COMMON /MPIPRIV/ MPI_BOTTOM, MPI_IN_PLACE */
+struct mv2t_mpipriv {
+    int bottom;
+    int in_place;
+};
+struct mv2t_mpipriv mpipriv_;
+
+static void *f2c_buf(void *p) {
+    if (p == (void *)&mpipriv_.in_place)
+        return MPI_IN_PLACE;
+    if (p == (void *)&mpipriv_.bottom)
+        return MPI_BOTTOM;
+    return p;
+}
+
+static void st_c2f(const MPI_Status *st, int *fst) {
+    fst[0] = st->MPI_SOURCE;
+    fst[1] = st->MPI_TAG;
+    fst[2] = st->MPI_ERROR;
+    fst[3] = st->_count;
+}
+
+/* ---- init / env ------------------------------------------------------ */
+
+void mpi_init_(int *ierr) {
+    *ierr = MPI_Init(NULL, NULL);
+}
+
+void mpi_init_thread_(int *required, int *provided, int *ierr) {
+    *ierr = MPI_Init_thread(NULL, NULL, *required, provided);
+}
+
+void mpi_finalize_(int *ierr) {
+    *ierr = MPI_Finalize();
+}
+
+void mpi_initialized_(int *flag, int *ierr) {
+    *ierr = MPI_Initialized(flag);
+}
+
+void mpi_abort_(int *comm, int *errorcode, int *ierr) {
+    *ierr = MPI_Abort(*comm, *errorcode);
+}
+
+double mpi_wtime_(void) {
+    return MPI_Wtime();
+}
+
+double mpi_wtick_(void) {
+    return MPI_Wtick();
+}
+
+void mpi_get_processor_name_(char *name, int *resultlen, int *ierr,
+                             long name_len) {
+    char buf[MPI_MAX_PROCESSOR_NAME];
+    *ierr = MPI_Get_processor_name(buf, resultlen);
+    if (*ierr == MPI_SUCCESS) {
+        long n = *resultlen < name_len ? *resultlen : name_len;
+        memset(name, ' ', name_len);
+        memcpy(name, buf, n);
+    }
+}
+
+void mpi_get_version_(int *version, int *subversion, int *ierr) {
+    *ierr = MPI_Get_version(version, subversion);
+}
+
+void mpi_error_string_(int *errorcode, char *string, int *resultlen,
+                       int *ierr, long string_len) {
+    char buf[MPI_MAX_ERROR_STRING];
+    *ierr = MPI_Error_string(*errorcode, buf, resultlen);
+    if (*ierr == MPI_SUCCESS) {
+        long n = *resultlen < string_len ? *resultlen : string_len;
+        memset(string, ' ', string_len);
+        memcpy(string, buf, n);
+    }
+}
+
+/* ---- communicators ---------------------------------------------------- */
+
+void mpi_comm_rank_(int *comm, int *rank, int *ierr) {
+    *ierr = MPI_Comm_rank(*comm, rank);
+}
+
+void mpi_comm_size_(int *comm, int *size, int *ierr) {
+    *ierr = MPI_Comm_size(*comm, size);
+}
+
+void mpi_comm_dup_(int *comm, int *newcomm, int *ierr) {
+    *ierr = MPI_Comm_dup(*comm, newcomm);
+}
+
+void mpi_comm_split_(int *comm, int *color, int *key, int *newcomm,
+                     int *ierr) {
+    *ierr = MPI_Comm_split(*comm, *color, *key, newcomm);
+}
+
+void mpi_comm_free_(int *comm, int *ierr) {
+    MPI_Comm c = *comm;
+    *ierr = MPI_Comm_free(&c);
+    *comm = c;
+}
+
+void mpi_comm_compare_(int *c1, int *c2, int *result, int *ierr) {
+    *ierr = MPI_Comm_compare(*c1, *c2, result);
+}
+
+/* ---- pt2pt ------------------------------------------------------------ */
+
+void mpi_send_(void *buf, int *count, int *dt, int *dest, int *tag,
+               int *comm, int *ierr) {
+    *ierr = MPI_Send(f2c_buf(buf), *count, *dt, *dest, *tag, *comm);
+}
+
+void mpi_ssend_(void *buf, int *count, int *dt, int *dest, int *tag,
+                int *comm, int *ierr) {
+    *ierr = MPI_Ssend(f2c_buf(buf), *count, *dt, *dest, *tag, *comm);
+}
+
+void mpi_recv_(void *buf, int *count, int *dt, int *source, int *tag,
+               int *comm, int *status, int *ierr) {
+    MPI_Status st;
+    *ierr = MPI_Recv(f2c_buf(buf), *count, *dt, *source, *tag, *comm,
+                     &st);
+    st_c2f(&st, status);
+}
+
+void mpi_isend_(void *buf, int *count, int *dt, int *dest, int *tag,
+                int *comm, int *request, int *ierr) {
+    MPI_Request r;
+    *ierr = MPI_Isend(f2c_buf(buf), *count, *dt, *dest, *tag, *comm, &r);
+    *request = (int)r;
+}
+
+void mpi_irecv_(void *buf, int *count, int *dt, int *source, int *tag,
+                int *comm, int *request, int *ierr) {
+    MPI_Request r;
+    *ierr = MPI_Irecv(f2c_buf(buf), *count, *dt, *source, *tag, *comm,
+                      &r);
+    *request = (int)r;
+}
+
+void mpi_wait_(int *request, int *status, int *ierr) {
+    MPI_Request r = *request;
+    MPI_Status st;
+    st.MPI_SOURCE = -1; st.MPI_TAG = -1;
+    st.MPI_ERROR = MPI_SUCCESS; st._count = 0;
+    *ierr = MPI_Wait(&r, &st);
+    *request = (int)r;
+    st_c2f(&st, status);
+}
+
+void mpi_waitall_(int *count, int *requests, int *statuses, int *ierr) {
+    *ierr = MPI_SUCCESS;
+    for (int i = 0; i < *count; i++) {
+        int rc;
+        mpi_wait_(&requests[i], &statuses[4 * i], &rc);
+        if (rc != MPI_SUCCESS)
+            *ierr = rc;
+    }
+}
+
+void mpi_test_(int *request, int *flag, int *status, int *ierr) {
+    MPI_Request r = *request;
+    MPI_Status st;
+    st.MPI_SOURCE = -1; st.MPI_TAG = -1;
+    st.MPI_ERROR = MPI_SUCCESS; st._count = 0;
+    *ierr = MPI_Test(&r, flag, &st);
+    *request = (int)r;
+    if (*flag)
+        st_c2f(&st, status);
+}
+
+void mpi_probe_(int *source, int *tag, int *comm, int *status,
+                int *ierr) {
+    MPI_Status st;
+    *ierr = MPI_Probe(*source, *tag, *comm, &st);
+    st_c2f(&st, status);
+}
+
+void mpi_get_count_(int *status, int *dt, int *count, int *ierr) {
+    MPI_Status st;
+    st.MPI_SOURCE = status[0];
+    st.MPI_TAG = status[1];
+    st.MPI_ERROR = status[2];
+    st._count = status[3];
+    *ierr = MPI_Get_count(&st, *dt, count);
+}
+
+void mpi_sendrecv_(void *sendbuf, int *scount, int *sdt, int *dest,
+                   int *stag, void *recvbuf, int *rcount, int *rdt,
+                   int *source, int *rtag, int *comm, int *status,
+                   int *ierr) {
+    MPI_Status st;
+    *ierr = MPI_Sendrecv(f2c_buf(sendbuf), *scount, *sdt, *dest, *stag,
+                         f2c_buf(recvbuf), *rcount, *rdt, *source, *rtag,
+                         *comm, &st);
+    st_c2f(&st, status);
+}
+
+/* ---- collectives ------------------------------------------------------ */
+
+void mpi_barrier_(int *comm, int *ierr) {
+    *ierr = MPI_Barrier(*comm);
+}
+
+void mpi_bcast_(void *buf, int *count, int *dt, int *root, int *comm,
+                int *ierr) {
+    *ierr = MPI_Bcast(f2c_buf(buf), *count, *dt, *root, *comm);
+}
+
+void mpi_reduce_(void *sendbuf, void *recvbuf, int *count, int *dt,
+                 int *op, int *root, int *comm, int *ierr) {
+    *ierr = MPI_Reduce(f2c_buf(sendbuf), f2c_buf(recvbuf), *count, *dt,
+                       *op, *root, *comm);
+}
+
+void mpi_allreduce_(void *sendbuf, void *recvbuf, int *count, int *dt,
+                    int *op, int *comm, int *ierr) {
+    *ierr = MPI_Allreduce(f2c_buf(sendbuf), f2c_buf(recvbuf), *count,
+                          *dt, *op, *comm);
+}
+
+void mpi_allgather_(void *sendbuf, int *scount, int *sdt, void *recvbuf,
+                    int *rcount, int *rdt, int *comm, int *ierr) {
+    *ierr = MPI_Allgather(f2c_buf(sendbuf), *scount, *sdt,
+                          f2c_buf(recvbuf), *rcount, *rdt, *comm);
+}
+
+void mpi_alltoall_(void *sendbuf, int *scount, int *sdt, void *recvbuf,
+                   int *rcount, int *rdt, int *comm, int *ierr) {
+    *ierr = MPI_Alltoall(f2c_buf(sendbuf), *scount, *sdt,
+                         f2c_buf(recvbuf), *rcount, *rdt, *comm);
+}
+
+void mpi_gather_(void *sendbuf, int *scount, int *sdt, void *recvbuf,
+                 int *rcount, int *rdt, int *root, int *comm,
+                 int *ierr) {
+    *ierr = MPI_Gather(f2c_buf(sendbuf), *scount, *sdt, f2c_buf(recvbuf),
+                       *rcount, *rdt, *root, *comm);
+}
+
+void mpi_scatter_(void *sendbuf, int *scount, int *sdt, void *recvbuf,
+                  int *rcount, int *rdt, int *root, int *comm,
+                  int *ierr) {
+    *ierr = MPI_Scatter(f2c_buf(sendbuf), *scount, *sdt,
+                        f2c_buf(recvbuf), *rcount, *rdt, *root, *comm);
+}
+
+void mpi_scan_(void *sendbuf, void *recvbuf, int *count, int *dt,
+               int *op, int *comm, int *ierr) {
+    *ierr = MPI_Scan(f2c_buf(sendbuf), f2c_buf(recvbuf), *count, *dt,
+                     *op, *comm);
+}
+
+void mpi_exscan_(void *sendbuf, void *recvbuf, int *count, int *dt,
+                 int *op, int *comm, int *ierr) {
+    *ierr = MPI_Exscan(f2c_buf(sendbuf), f2c_buf(recvbuf), *count, *dt,
+                       *op, *comm);
+}
+
+void mpi_allgatherv_(void *sendbuf, int *scount, int *sdt, void *recvbuf,
+                     int *rcounts, int *displs, int *rdt, int *comm,
+                     int *ierr) {
+    *ierr = MPI_Allgatherv(f2c_buf(sendbuf), *scount, *sdt,
+                           f2c_buf(recvbuf), rcounts, displs, *rdt,
+                           *comm);
+}
+
+void mpi_reduce_scatter_(void *sendbuf, void *recvbuf, int *rcounts,
+                         int *dt, int *op, int *comm, int *ierr) {
+    *ierr = MPI_Reduce_scatter(f2c_buf(sendbuf), f2c_buf(recvbuf),
+                               rcounts, *dt, *op, *comm);
+}
+
+/* ---- datatypes -------------------------------------------------------- */
+
+void mpi_type_contiguous_(int *count, int *oldtype, int *newtype,
+                          int *ierr) {
+    *ierr = MPI_Type_contiguous(*count, *oldtype, newtype);
+}
+
+void mpi_type_vector_(int *count, int *blocklength, int *stride,
+                      int *oldtype, int *newtype, int *ierr) {
+    *ierr = MPI_Type_vector(*count, *blocklength, *stride, *oldtype,
+                            newtype);
+}
+
+void mpi_type_commit_(int *datatype, int *ierr) {
+    *ierr = MPI_Type_commit(datatype);
+}
+
+void mpi_type_free_(int *datatype, int *ierr) {
+    *ierr = MPI_Type_free(datatype);
+}
+
+void mpi_type_size_(int *datatype, int *size, int *ierr) {
+    *ierr = MPI_Type_size(*datatype, size);
+}

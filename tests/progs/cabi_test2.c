@@ -235,6 +235,12 @@ int main(int argc, char **argv) {
         MPI_Request ps, pr;
         MPI_Send_init(sb, 4, MPI_DOUBLE, peer, 40, MPI_COMM_WORLD, &ps);
         MPI_Recv_init(rb, 4, MPI_DOUBLE, peer, 40, MPI_COMM_WORLD, &pr);
+        /* wait/test on an INACTIVE persistent request returns at once */
+        int inf = 0;
+        MPI_Test(&ps, &inf, MPI_STATUS_IGNORE);
+        CHECK(inf == 1, "inactive persistent test");
+        MPI_Wait(&ps, MPI_STATUS_IGNORE);
+        CHECK(ps != MPI_REQUEST_NULL, "inactive persistent wait");
         for (int round = 0; round < 3; round++) {
             for (int i = 0; i < 4; i++) sb[i] = rank * 1000 + round;
             MPI_Start(&pr);

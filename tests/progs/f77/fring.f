@@ -1,0 +1,56 @@
+C     fring.f — f77 conformance smoke: ring sendrecv + allreduce +
+C     bcast + wtime. Prints 'No Errors' on rank 0 (runtests contract).
+      PROGRAM FRING
+      IMPLICIT NONE
+      INCLUDE 'mpif.h'
+      INTEGER IERR, RANK, SIZE, LEFT, RIGHT, I, ERRS
+      INTEGER STATUS(MPI_STATUS_SIZE)
+      INTEGER SBUF(8), RBUF(8)
+      DOUBLE PRECISION V(4), W(4), T0, T1
+      DOUBLE PRECISION MPI_WTIME
+      ERRS = 0
+      CALL MPI_INIT(IERR)
+      CALL MPI_COMM_RANK(MPI_COMM_WORLD, RANK, IERR)
+      CALL MPI_COMM_SIZE(MPI_COMM_WORLD, SIZE, IERR)
+      RIGHT = MOD(RANK + 1, SIZE)
+      LEFT = MOD(RANK + SIZE - 1, SIZE)
+      DO 10 I = 1, 8
+         SBUF(I) = RANK * 100 + I
+         RBUF(I) = -1
+ 10   CONTINUE
+      CALL MPI_SENDRECV(SBUF, 8, MPI_INTEGER, RIGHT, 5,
+     $     RBUF, 8, MPI_INTEGER, LEFT, 5,
+     $     MPI_COMM_WORLD, STATUS, IERR)
+      DO 20 I = 1, 8
+         IF (RBUF(I) .NE. LEFT * 100 + I) ERRS = ERRS + 1
+ 20   CONTINUE
+      IF (STATUS(MPI_SOURCE) .NE. LEFT) ERRS = ERRS + 1
+      IF (STATUS(MPI_TAG) .NE. 5) ERRS = ERRS + 1
+      DO 30 I = 1, 4
+         V(I) = DBLE(RANK + I)
+ 30   CONTINUE
+      T0 = MPI_WTIME()
+      CALL MPI_ALLREDUCE(V, W, 4, MPI_DOUBLE_PRECISION, MPI_SUM,
+     $     MPI_COMM_WORLD, IERR)
+      T1 = MPI_WTIME()
+      IF (T1 .LT. T0) ERRS = ERRS + 1
+      DO 40 I = 1, 4
+         IF (ABS(W(I) - DBLE(SIZE * I + SIZE * (SIZE - 1) / 2))
+     $        .GT. 1D-9) ERRS = ERRS + 1
+ 40   CONTINUE
+      IF (RANK .EQ. 0) THEN
+         DO 50 I = 1, 8
+            SBUF(I) = 700 + I
+ 50      CONTINUE
+      ENDIF
+      CALL MPI_BCAST(SBUF, 8, MPI_INTEGER, 0, MPI_COMM_WORLD, IERR)
+      DO 60 I = 1, 8
+         IF (SBUF(I) .NE. 700 + I) ERRS = ERRS + 1
+ 60   CONTINUE
+      CALL MPI_ALLREDUCE(ERRS, I, 1, MPI_INTEGER, MPI_SUM,
+     $     MPI_COMM_WORLD, IERR)
+      IF (RANK .EQ. 0 .AND. I .EQ. 0) THEN
+         PRINT *, 'No Errors'
+      ENDIF
+      CALL MPI_FINALIZE(IERR)
+      END

@@ -1,0 +1,121 @@
+/* f77_abi_test.c — drives the Fortran binding layer (mpif.c) from C
+ * through the exact f77 calling convention (by-reference args, status
+ * arrays, hidden string lengths, MPIPRIV common for MPI_IN_PLACE), so
+ * the binding is validated even on hosts without a Fortran compiler.
+ * Prints "No Errors" (runtests contract). */
+#include <math.h>
+#include <stdio.h>
+#include <string.h>
+
+/* f77-ABI prototypes (as gfortran would emit calls) */
+void mpi_init_(int *ierr);
+void mpi_finalize_(int *ierr);
+void mpi_comm_rank_(int *comm, int *rank, int *ierr);
+void mpi_comm_size_(int *comm, int *size, int *ierr);
+void mpi_sendrecv_(void *sb, int *sc, int *sdt, int *dest, int *stag,
+                   void *rb, int *rc, int *rdt, int *src, int *rtag,
+                   int *comm, int *status, int *ierr);
+void mpi_allreduce_(void *sb, void *rb, int *count, int *dt, int *op,
+                    int *comm, int *ierr);
+void mpi_bcast_(void *buf, int *count, int *dt, int *root, int *comm,
+                int *ierr);
+void mpi_isend_(void *buf, int *count, int *dt, int *dest, int *tag,
+                int *comm, int *req, int *ierr);
+void mpi_irecv_(void *buf, int *count, int *dt, int *src, int *tag,
+                int *comm, int *req, int *ierr);
+void mpi_waitall_(int *count, int *reqs, int *statuses, int *ierr);
+void mpi_get_count_(int *status, int *dt, int *count, int *ierr);
+void mpi_get_processor_name_(char *name, int *len, int *ierr,
+                             long name_len);
+void mpi_scan_(void *sb, void *rb, int *count, int *dt, int *op,
+               int *comm, int *ierr);
+void mpi_type_vector_(int *count, int *bl, int *stride, int *oldtype,
+                      int *newtype, int *ierr);
+void mpi_type_commit_(int *dt, int *ierr);
+void mpi_type_free_(int *dt, int *ierr);
+double mpi_wtime_(void);
+extern struct { int bottom; int in_place; } mpipriv_;
+
+#define F_COMM_WORLD 0
+#define F_INTEGER 2
+#define F_DOUBLE 4
+#define F_SUM 0
+
+static int errs = 0;
+static int rank, size;
+
+#define CHECK(c, m) do { \
+    if (!(c)) { errs++; fprintf(stderr, "rank %d: %s\n", rank, m); } \
+} while (0)
+
+int main(void) {
+    int ierr, comm = F_COMM_WORLD;
+    mpi_init_(&ierr);
+    mpi_comm_rank_(&comm, &rank, &ierr);
+    mpi_comm_size_(&comm, &size, &ierr);
+
+    /* ring sendrecv with a Fortran status array */
+    int idt = F_INTEGER, tag = 5;
+    int right = (rank + 1) % size, left = (rank + size - 1) % size;
+    int sbuf[8], rbuf[8], status[4], n = 8;
+    for (int i = 0; i < 8; i++) { sbuf[i] = rank * 100 + i; rbuf[i] = -1; }
+    mpi_sendrecv_(sbuf, &n, &idt, &right, &tag, rbuf, &n, &idt, &left,
+                  &tag, &comm, status, &ierr);
+    CHECK(ierr == 0, "sendrecv ierr");
+    for (int i = 0; i < 8; i++)
+        CHECK(rbuf[i] == left * 100 + i, "ring payload");
+    CHECK(status[0] == left && status[1] == 5, "status fields");
+    int got = 0;
+    mpi_get_count_(status, &idt, &got, &ierr);
+    CHECK(got == 8, "get_count");
+
+    /* allreduce doubles + MPI_IN_PLACE via the MPIPRIV common */
+    int ddt = F_DOUBLE, op = F_SUM, c4 = 4;
+    double v[4], w[4];
+    for (int i = 0; i < 4; i++) v[i] = rank + i + 1.0;
+    mpi_allreduce_(v, w, &c4, &ddt, &op, &comm, &ierr);
+    for (int i = 0; i < 4; i++)
+        CHECK(fabs(w[i] - (size * (i + 1.0) + size * (size - 1) / 2.0))
+              < 1e-9, "allreduce");
+    double ip[2] = {1.0 + rank, 2.0};
+    int c2 = 2;
+    mpi_allreduce_(&mpipriv_.in_place, ip, &c2, &ddt, &op, &comm, &ierr);
+    CHECK(fabs(ip[0] - (size + size * (size - 1) / 2.0)) < 1e-9,
+          "allreduce IN_PLACE");
+
+    /* isend/irecv + waitall */
+    int reqs[2], sts[8], two = 2, one = 1;
+    int sv = rank * 7, rv = -1;
+    mpi_irecv_(&rv, &one, &idt, &left, &tag, &comm, &reqs[0], &ierr);
+    mpi_isend_(&sv, &one, &idt, &right, &tag, &comm, &reqs[1], &ierr);
+    mpi_waitall_(&two, reqs, sts, &ierr);
+    CHECK(rv == left * 7, "isend/irecv");
+
+    /* scan */
+    int si = rank + 1, so = 0;
+    mpi_scan_(&si, &so, &one, &idt, &op, &comm, &ierr);
+    CHECK(so == (rank + 1) * (rank + 2) / 2, "scan");
+
+    /* hidden-length CHARACTER arg */
+    char name[64];
+    int nl = 0;
+    memset(name, 0, sizeof(name));
+    mpi_get_processor_name_(name, &nl, &ierr, (long)sizeof(name));
+    CHECK(nl > 0 && name[0] != ' ', "processor name");
+
+    /* derived type handle through the f77 layer */
+    int vec = -1, cnt2 = 2, bl = 1, stride = 2;
+    mpi_type_vector_(&cnt2, &bl, &stride, &idt, &vec, &ierr);
+    CHECK(vec >= 100, "type_vector handle");
+    mpi_type_commit_(&vec, &ierr);
+    mpi_type_free_(&vec, &ierr);
+
+    CHECK(mpi_wtime_() > 0.0, "wtime");
+
+    int tot = 0;
+    mpi_allreduce_(&errs, &tot, &one, &idt, &op, &comm, &ierr);
+    if (rank == 0 && tot == 0)
+        printf("No Errors\n");
+    mpi_finalize_(&ierr);
+    return tot ? 1 : 0;
+}
