@@ -1,6 +1,15 @@
 #!/usr/bin/env python
 """osu_put_bw — MPI_Put bandwidth, window_size puts per flush (port of
-osu_benchmarks/mpi/one-sided/osu_put_bw.c)."""
+osu_benchmarks/mpi/one-sided/osu_put_bw.c).
+
+Two window modes:
+  * default — host windows (rma/win.py packet protocol) under the
+    launcher, 2 ranks.
+  * MV2T_DEVICE_WIN=1 — device-resident HBM windows over a 2-device
+    jax mesh (rma/device.py): puts ride the epoch-compiled ICI program;
+    the flush is the closing fence. Single process, no launcher
+    (the direct-RDMA path of gen2/rdma_iba_1sc.c).
+"""
 
 import os
 import sys
@@ -9,11 +18,57 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+WINDOW = 32
+
+
+def device_mode() -> None:
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from mvapich2_tpu.parallel import MeshComm, make_mesh
+    from mvapich2_tpu.rma.device import DeviceWin
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        print("# device-window mode needs >= 2 devices", file=sys.stderr)
+        sys.exit(1)
+    comm = MeshComm(make_mesh((2,), ("x",), devs[:2]))
+    print("# OSU One Sided Put Bandwidth Test [device windows, "
+          f"{devs[0].platform} x2]")
+    print(f"# {'Size':<10} {'Bandwidth (MB/s)':>16}")
+    size = 1024
+    while size <= (1 << 22):
+        n = max(size // 4, 1)          # f32 elements
+        win = DeviceWin(comm, n, jnp.float32)
+        src = jnp.ones((n,), jnp.float32)
+        iters, skip = 12, 3
+        for _ in range(skip):
+            for _ in range(WINDOW):
+                win.put(src, origin=0, target=1)
+            win.fence()
+        jax.block_until_ready(win.win)   # drain async warmup dispatch
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            for _ in range(WINDOW):
+                win.put(src, origin=0, target=1)
+            win.fence()
+        jax.block_until_ready(win.win)
+        dt = time.perf_counter() - t0
+        mbps = 4.0 * n * WINDOW * iters / dt / 1e6
+        print(f"{size:<12} {mbps:>12.2f}")
+        sys.stdout.flush()
+        size *= 4
+    sys.exit(0)
+
+
+if os.environ.get("MV2T_DEVICE_WIN") == "1":
+    device_mode()
+
 from mvapich2_tpu import mpi
 from mvapich2_tpu.bench import osu_util as u
 from mvapich2_tpu.rma.win import LOCK_SHARED
-
-WINDOW = 32
 
 mpi.Init()
 comm = mpi.COMM_WORLD

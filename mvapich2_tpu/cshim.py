@@ -690,14 +690,22 @@ def testall(handles):
     """All-or-nothing MPI_Testall (MPI-3.1 §3.7.5: no request is
     modified unless all complete). Returns (flag, [(src, tag, count,
     persistent), ...])."""
+    def _inactive(r):
+        return getattr(r, "persistent", False) and \
+            not getattr(r, "_c_active", False)
+
     with _lock:
         rs = [_reqs.get(h) for h in handles]
-    if not all(r is None or r.test() for r in rs):
+    # inactive persistent handles count as complete-with-empty-status
+    if not all(r is None or _inactive(r) or r.test() for r in rs):
         return (0, [])
     out = []
     for h, r in zip(handles, rs):
         if r is None:
             out.append((-1, -1, 0, 0))
+            continue
+        if _inactive(r):
+            out.append((-1, -1, 0, 1))
             continue
         persistent = bool(getattr(r, "persistent", False))
         st = r.wait()
@@ -722,12 +730,11 @@ def waitany(handles):
     from .core import request as rq
     with _lock:
         pairs = [(i, _reqs.get(h)) for i, h in enumerate(handles)]
-    live = [(i, r) for i, r in pairs if r is not None]
-    # inactive persistent requests complete immediately (§3.7.3)
-    for i, r in live:
-        if getattr(r, "persistent", False) and \
-                not getattr(r, "_c_active", False):
-            return (i, -1, -1, 0, 1)
+    # Waitany IGNORES null and inactive-persistent handles (§3.7.5);
+    # all-ignored returns MPI_UNDEFINED
+    live = [(i, r) for i, r in pairs
+            if r is not None and not (getattr(r, "persistent", False) and
+                                      not getattr(r, "_c_active", False))]
     if not live:
         return (-1, -1, -1, 0, 0)
     idx = rq.waitany([r for _, r in live])

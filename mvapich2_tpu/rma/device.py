@@ -1,0 +1,263 @@
+"""Device-resident RMA windows — one-sided ops on HBM over the mesh.
+
+The host windows in rma/win.py are the packet-protocol analog of the
+reference's one-sided path; THIS module is the direct-RDMA analog
+(gen2/rdma_iba_1sc.c:143-160, where puts/gets post verbs work requests
+straight to the HCA): windows live in device HBM as mesh-sharded jax
+arrays, and synchronization epochs compile to XLA programs over the
+mesh.
+
+TPU-first design:
+
+* A ``DeviceWin`` is a jax array of shape (p, n) sharded over a 1-D mesh
+  axis — row r is rank r's exposed window memory, resident in its HBM.
+* Communication ops (put/get/accumulate) enqueue static descriptors;
+  ``fence()`` closes the epoch by compiling (and caching, keyed on the
+  epoch's op signature) ONE ``shard_map`` program that applies every op
+  via ``lax.ppermute`` routes + dynamic-slice updates, then executes it.
+  "Fence = one fused collective program" is the XLA-native counterpart
+  of the reference draining its RDMA work queue at MPI_Win_fence.
+* ``pallas_put`` is the explicit remote-DMA form of a contiguous put —
+  ``pltpu.make_async_remote_copy`` from the origin's source buffer into
+  the target's window shard, recv-semaphore-waited on the target (the
+  literal rdma_iba_1sc.c analog; the primitive is proven in
+  ops/pallas_ring.py). It exists for the cases the epoch compiler can't
+  express: overlapping a put with compute inside one kernel.
+
+Single-controller note: the driving Python program is global (it sees
+all ranks), so op descriptors carry explicit origin/target ranks; the
+per-rank view materializes inside shard_map.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..utils.mlog import get_logger
+
+log = get_logger("rma.device")
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAVE_PALLAS = True
+except ImportError:  # pragma: no cover
+    HAVE_PALLAS = False
+
+
+class DeviceWin:
+    """An MPI-style window whose memory is a mesh-sharded HBM array.
+
+    Epoch model: ``fence()`` opens/closes access epochs (MPI_Win_fence
+    semantics). Ops enqueued between fences are applied, in order, by
+    the epoch program; ``get`` results become available after the
+    closing fence via the handle's ``value()``.
+    """
+
+    def __init__(self, comm, n: int, dtype=jnp.float32):
+        self.comm = comm            # parallel.mesh.MeshComm
+        self.axis = comm.axis
+        self.p = comm.size
+        self.n = int(n)
+        self.dtype = jnp.dtype(dtype)
+        self.win = jax.device_put(
+            jnp.zeros((self.p, self.n), self.dtype),
+            NamedSharding(comm.mesh, P(self.axis)))
+        self._ops: List[tuple] = []          # static descriptors
+        self._payloads: List[jnp.ndarray] = []
+        self._gets: List["_GetHandle"] = []
+        self._epoch_cache = {}
+
+    # -- local access -----------------------------------------------------
+    def local(self, rank: int) -> np.ndarray:
+        """Rank ``rank``'s window contents (host copy — debugging/tests)."""
+        return np.asarray(self.win[rank])
+
+    def store(self, rank: int, disp: int, values) -> None:
+        """Local store into one rank's window region (outside epochs)."""
+        vals = jnp.asarray(values, self.dtype)
+        self.win = self.win.at[rank, disp:disp + vals.size].set(vals)
+
+    # -- one-sided ops (enqueue; applied at the closing fence) ------------
+    def put(self, src, origin: int, target: int, disp: int = 0) -> None:
+        src = jnp.asarray(src, self.dtype)
+        self._ops.append(("put", origin, target, disp, src.size))
+        self._payloads.append(src)
+
+    def accumulate(self, src, origin: int, target: int,
+                   disp: int = 0) -> None:
+        """MPI_Accumulate with MPI_SUM (the only device-native op the
+        epoch compiler emits today; others via the host window)."""
+        src = jnp.asarray(src, self.dtype)
+        self._ops.append(("acc", origin, target, disp, src.size))
+        self._payloads.append(src)
+
+    def get(self, n: int, origin: int, target: int,
+            disp: int = 0) -> "_GetHandle":
+        h = _GetHandle(n)
+        self._ops.append(("get", origin, target, disp, n))
+        self._payloads.append(jnp.zeros((n,), self.dtype))
+        self._gets.append(h)
+        return h
+
+    # -- synchronization ---------------------------------------------------
+    def fence(self) -> None:
+        """Close the access epoch: apply all enqueued ops in one compiled
+        mesh program, publish get results."""
+        if not self._ops:
+            return
+        sig = tuple(self._ops)
+        fn = self._epoch_cache.get(sig)
+        if fn is None:
+            fn = self._build_epoch(sig)
+            self._epoch_cache[sig] = fn
+        maxn = max(op[4] for op in sig)
+        pay = jnp.stack([jnp.pad(p, (0, maxn - p.size))
+                         for p in self._payloads])
+        self.win, gets = fn(self.win, pay)
+        gi = 0
+        for op in sig:
+            if op[0] == "get":
+                self._gets[gi]._value = np.asarray(
+                    gets[gi])[: op[4]]
+                gi += 1
+        self._ops, self._payloads, self._gets = [], [], []
+
+    def _build_epoch(self, sig: Tuple[tuple, ...]):
+        """Compile the epoch: each descriptor becomes a ppermute route +
+        slice update inside one shard_map over the window's axis."""
+        axis, p = self.axis, self.p
+        ngets = sum(1 for op in sig if op[0] == "get")
+
+        def epoch(win_row, pay):
+            # win_row: (1, n) this rank's shard; pay: (nops, maxn) repl.
+            me = lax.axis_index(axis)
+            row = win_row[0]
+            gets = []
+            for i, (kind, origin, target, disp, n) in enumerate(sig):
+                if kind in ("put", "acc"):
+                    # route origin's payload to the target rank
+                    data = lax.ppermute(pay[i, :n], axis,
+                                        [(origin, target)])
+                    cur = lax.dynamic_slice(row, (disp,), (n,))
+                    new = data + cur if kind == "acc" else data
+                    upd = lax.dynamic_update_slice(row, new, (disp,))
+                    row = jnp.where(me == target, upd, row)
+                else:  # get: route the target's window slice to origin
+                    chunk = lax.dynamic_slice(row, (disp,), (n,))
+                    back = lax.ppermute(chunk, axis, [(target, origin)])
+                    got = jnp.where(me == origin, back,
+                                    jnp.zeros_like(back))
+                    # publish via psum so the (replicated) output is
+                    # origin's data on every shard
+                    gets.append(lax.psum(got, axis))
+            gout = (jnp.stack([jnp.pad(g, (0, max(op[4] for op in sig)
+                                           - g.size)) for g in gets])
+                    if gets else jnp.zeros((1, 1), self.dtype))
+            return row[None, :], gout
+
+        mesh = self.comm.mesh
+
+        from ..parallel.mesh import shard_map
+
+        f = shard_map(epoch, mesh=mesh,
+                      in_specs=(P(axis), P()),
+                      out_specs=(P(axis), P()), check_vma=False)
+        jf = jax.jit(f)
+
+        def run(win, pay):
+            win2, gout = jf(win, pay)
+            if ngets:
+                return win2, [gout[i] for i in range(ngets)]
+            return win2, []
+        return run
+
+
+class _GetHandle:
+    def __init__(self, n: int):
+        self.n = n
+        self._value: Optional[np.ndarray] = None
+
+    def value(self) -> np.ndarray:
+        if self._value is None:
+            raise RuntimeError("get not yet completed (fence the epoch)")
+        return self._value
+
+
+# ---------------------------------------------------------------------------
+# the explicit remote-DMA put (rdma_iba_1sc.c analog)
+# ---------------------------------------------------------------------------
+
+def _pallas_put_kernel(axis, origin, target, disp, src_ref, win_ref,
+                       out_ref, stage, landing, send_sem, recv_sem):
+    """Symmetric remote-DMA put: every rank runs the same DMA sequence
+    (required — the transfer is a collective under the hood), routed by
+    a permutation that is identity except origin<->target. Data lands in
+    a staging buffer (the vbuf model: gen2/vbuf.h) and the target alone
+    copies it into its window region."""
+    me = lax.axis_index(axis)
+    out_ref[...] = win_ref[...]
+    n = src_ref.shape[0]
+
+    @pl.when(me == origin)
+    def _():
+        stage[...] = src_ref[...]
+
+    @pl.when(me != origin)
+    def _():
+        stage[...] = jnp.zeros_like(src_ref[...])
+
+    partner = jnp.where(me == origin, target,
+                        jnp.where(me == target, origin, me))
+    rdma = pltpu.make_async_remote_copy(
+        src_ref=stage,
+        dst_ref=landing,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=partner,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    rdma.start()
+    rdma.wait()          # my outbound is on the wire
+    rdma.wait_recv()     # my inbound landed
+
+    @pl.when(me == target)
+    def _():
+        out_ref[pl.ds(disp, n)] = landing[...]
+
+
+def pallas_put(src, win_shard, axis: str, origin: int, target: int,
+               disp: int = 0, *, interpret: bool = False):
+    """One-sided contiguous put as a single remote DMA: origin pushes
+    ``src`` into the target's window shard at element offset ``disp``.
+    Call inside shard_map over ``axis``. Returns the updated shard
+    (in-place on the target via input/output aliasing).
+
+    interpret=True runs the Mosaic interpreter (CPU-mesh CI); on real
+    ICI the copy is a hardware remote DMA.
+    """
+    if not HAVE_PALLAS:
+        raise RuntimeError("pallas unavailable")
+    n = src.shape[0]
+    kern = functools.partial(_pallas_put_kernel, axis, origin, target,
+                             disp)
+    return pl.pallas_call(
+        kern,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        out_shape=jax.ShapeDtypeStruct(win_shard.shape, win_shard.dtype),
+        scratch_shapes=[pltpu.VMEM((n,), src.dtype),
+                        pltpu.VMEM((n,), src.dtype),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(())],
+        input_output_aliases={1: 0},
+        interpret=interpret,
+    )(src, win_shard)

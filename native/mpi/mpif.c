@@ -136,7 +136,7 @@ void mpi_ssend_(void *buf, int *count, int *dt, int *dest, int *tag,
 
 void mpi_recv_(void *buf, int *count, int *dt, int *source, int *tag,
                int *comm, int *status, int *ierr) {
-    MPI_Status st;
+    MPI_Status st = {-1, -1, MPI_SUCCESS, 0};
     *ierr = MPI_Recv(f2c_buf(buf), *count, *dt, *source, *tag, *comm,
                      &st);
     st_c2f(&st, status);
@@ -190,7 +190,7 @@ void mpi_test_(int *request, int *flag, int *status, int *ierr) {
 
 void mpi_probe_(int *source, int *tag, int *comm, int *status,
                 int *ierr) {
-    MPI_Status st;
+    MPI_Status st = {-1, -1, MPI_SUCCESS, 0};
     *ierr = MPI_Probe(*source, *tag, *comm, &st);
     st_c2f(&st, status);
 }
@@ -208,7 +208,7 @@ void mpi_sendrecv_(void *sendbuf, int *scount, int *sdt, int *dest,
                    int *stag, void *recvbuf, int *rcount, int *rdt,
                    int *source, int *rtag, int *comm, int *status,
                    int *ierr) {
-    MPI_Status st;
+    MPI_Status st = {-1, -1, MPI_SUCCESS, 0};
     *ierr = MPI_Sendrecv(f2c_buf(sendbuf), *scount, *sdt, *dest, *stag,
                          f2c_buf(recvbuf), *rcount, *rdt, *source, *rtag,
                          *comm, &st);

@@ -7,7 +7,6 @@ C     bcast + wtime. Prints 'No Errors' on rank 0 (runtests contract).
       INTEGER STATUS(MPI_STATUS_SIZE)
       INTEGER SBUF(8), RBUF(8)
       DOUBLE PRECISION V(4), W(4), T0, T1
-      DOUBLE PRECISION MPI_WTIME
       ERRS = 0
       CALL MPI_INIT(IERR)
       CALL MPI_COMM_RANK(MPI_COMM_WORLD, RANK, IERR)
