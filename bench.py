@@ -4,24 +4,38 @@ Measurement contract mirrors the OSU harness (BASELINE.md:
 osu_allreduce.c:110-142): warm-up skips, timed iterations, bus bandwidth
 via the ring model busbw = 2*(p-1)/p * m / t.
 
-Two adaptations for this environment:
+Adaptations for this environment:
   * On a multi-chip host this times lax.psum over a mesh of all real
-    devices (ICI). On a single chip (no wire for an allreduce to cross) it
-    times an emulated 8-rank allreduce resident on-chip — 8 rank-buffers
-    reduced and re-broadcast through HBM — tracking the chip-local
-    roofline of the real collective's reduce/bcast phases. vs_baseline is
+    devices (ICI). On a single chip (no wire for an allreduce to cross)
+    it times an emulated 8-rank allreduce resident on-chip — 8
+    rank-buffers reduced and re-broadcast through HBM — tracking the
+    chip-local roofline of the real collective's reduce/bcast phases.
+    The rank buffers are stored interleaved as (m/128, 8, 128): each
+    (8,128) tile holds one 128-lane slice of all 8 ranks, so one pass
+    reads 8m + writes 8m with no broadcast re-read. vs_baseline is
     measured against 0.8*HBM (single-chip) or 0.8*ICI (multi-chip, the
     BASELINE.json north-star form).
+  * The emulated reduce+bcast is selected from a small candidate set at
+    run time (pallas fused kernel at two block sizes + the XLA
+    sum/broadcast fallback) — the bench-local form of the tuning
+    layer's measured-crossover discipline. The pallas kernel reads each
+    (Bm,8,128) block once, sublane-reduces in VMEM, and writes the
+    broadcast rows from registers (XLA's fused sum+broadcast re-reads
+    the reduced row per output row and measures ~15% slower).
   * The axon tunnel completes `block_until_ready` without waiting for
     device execution and adds a ~65 ms host round-trip on readback, so
     per-op time is derived by the two-point slope method: run the op K1
-    and K2 times inside one jitted fori_loop (forcing a scalar readback
-    each), t_op = (T(K2) - T(K1)) / (K2 - K1). This cancels both the
-    tunnel latency and dispatch overhead exactly.
+    and K2 times inside one jitted program (forcing a scalar readback),
+    t_op = (T(K2) - T(K1)) / (K2 - K1). Chains of pallas calls are
+    opaque to XLA so an unrolled chain cannot be algebraically
+    collapsed; the XLA fallback uses lax.fori_loop for the same reason.
+    Timing is min-of-iters (constant overhead + positive noise), slope
+    is median-of-3.
 
 Prints exactly ONE JSON line.
 """
 
+import functools
 import json
 import os
 import sys
@@ -29,16 +43,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-SKIP = 3
+SKIP = 2
 ITERS = 10
 K1, K2 = 4, 16
 MSG_BYTES = 64 * 1024 * 1024   # 64 MiB float32 — the north-star point
 EMU_RANKS = 8
 
 
-def _timed(fn_k, x, k):
-    """Median wall time of fn_k(x, k) with scalar-readback completion."""
-    import jax
+def _timed_min(fn_k, x, k):
     for _ in range(SKIP):
         float(fn_k(x, k))
     ts = []
@@ -46,16 +58,88 @@ def _timed(fn_k, x, k):
         t0 = time.perf_counter()
         float(fn_k(x, k))
         ts.append(time.perf_counter() - t0)
-    ts.sort()
-    return ts[len(ts) // 2]
+    return min(ts)
+
+
+def _slope(fn_k, x, nrep=3):
+    """Median-of-nrep two-point slopes (cancels tunnel+dispatch)."""
+    ss = []
+    for _ in range(nrep):
+        t1 = _timed_min(fn_k, x, K1)
+        t2 = _timed_min(fn_k, x, K2)
+        ss.append(max((t2 - t1) / (K2 - K1), 1e-9))
+    ss.sort()
+    return ss[len(ss) // 2]
+
+
+def _emulated_candidates(M):
+    """(name, fn_k) candidates for the 1-chip emulated allreduce on the
+    interleaved (M, 8, 128) f32 layout."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    cands = []
+
+    def wrap_unroll(body):
+        @functools.partial(jax.jit, static_argnums=1)
+        def fn_k(v, k):
+            a = v
+            for _ in range(k):
+                a = body(a)
+            return jnp.sum(a[:64, 0, 0])
+        return fn_k
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        try:
+            from jax.experimental import pallas as pl
+            from jax.experimental.pallas import tpu as pltpu
+
+            def krnl(x_ref, o_ref):
+                s = x_ref[...].sum(axis=1, keepdims=True) \
+                    * (1.0 / EMU_RANKS)
+                o_ref[...] = jnp.broadcast_to(s, o_ref.shape)
+
+            def mk(Bm):
+                def op(a):
+                    return pl.pallas_call(
+                        krnl, grid=(M // Bm,),
+                        in_specs=[pl.BlockSpec((Bm, 8, 128),
+                                               lambda i: (i, 0, 0))],
+                        out_specs=pl.BlockSpec((Bm, 8, 128),
+                                               lambda i: (i, 0, 0)),
+                        out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype),
+                        compiler_params=pltpu.CompilerParams(
+                            dimension_semantics=("arbitrary",)),
+                    )(a)
+                return op
+
+            for Bm in (128, 256):
+                if M % Bm == 0:
+                    cands.append((f"pallas_fused_b{Bm}",
+                                  wrap_unroll(mk(Bm))))
+        except Exception:   # pallas unavailable: XLA fallback below
+            pass
+
+    # XLA fallback (and the only candidate off-TPU): fori_loop so the
+    # chain isn't algebraically collapsed
+    def xla_body(a):
+        s = a.sum(axis=1, keepdims=True) * (1.0 / EMU_RANKS)
+        return jnp.broadcast_to(s, a.shape)
+
+    @functools.partial(jax.jit, static_argnums=1)
+    def xla_fn(v, k):
+        out = lax.fori_loop(0, k, lambda _, a: xla_body(a), v)
+        return jnp.sum(out[:64, 0, 0])
+
+    cands.append(("xla_sum_bcast", xla_fn))
+    return cands
 
 
 def main() -> None:
-    import functools
-
     import jax
     import jax.numpy as jnp
-    import numpy as np
     from jax import lax
 
     from mvapich2_tpu.parallel import MeshComm, make_mesh
@@ -87,44 +171,42 @@ def main() -> None:
                           check_vma=False)
             return f(v, k)
 
+        t_op = _slope(fn_k, x)
         ranks = p
-        fabric = "ici"
         raw_gbps = info.ici_bw_gbps
-    else:
-        ranks = EMU_RANKS
-        x = jax.random.normal(jax.random.PRNGKey(0), (EMU_RANKS, n_f32),
-                              jnp.float32)
-        @functools.partial(jax.jit, static_argnums=1)
-        def fn_k(v, k):
-            def body(_, acc):
-                # reduce phase as a VPU sublane sum (fastest measured on
-                # v5e: 622 GB/s vs 604 einsum-MXU, 330 pallas manual-DMA;
-                # the pure read+write stream ceiling measured 647 = 79%
-                # of nominal HBM), then the bcast phase
-                s = acc.sum(axis=0) * (1.0 / EMU_RANKS)
-                return jnp.broadcast_to(s[None, :], acc.shape)
-            out = lax.fori_loop(0, k, body, v)
-            return jnp.sum(out[:, :8])
-
-        fabric = "hbm(1chip-emulated)"
-        raw_gbps = info.hbm_bw_gbps
-
-    t1 = _timed(fn_k, x, K1)
-    t2 = _timed(fn_k, x, K2)
-    t_op = max((t2 - t1) / (K2 - K1), 1e-9)
-
-    m = MSG_BYTES
-    target = 0.8 * raw_gbps
-    if p > 1:
+        target = 0.8 * raw_gbps
+        m = MSG_BYTES
         # the OSU ring busbw model: each rank's NIC moves 2(p-1)/p * m
         value = 2.0 * (ranks - 1) / ranks * m / t_op / 1e9
         metric = f"osu_allreduce_busbw_64MiB_f32[ici,p={ranks}]"
+        chosen = "xla_psum"
     else:
-        # single chip: the fabric is HBM; report achieved HBM bandwidth of
-        # the emulated reduce+bcast (read p*m + write p*m per op)
+        M = n_f32 // 128
+        x = jax.random.normal(jax.random.PRNGKey(0), (M, 8, 128),
+                              jnp.float32)
+        best_t, chosen = None, None
+        for name, fn_k in _emulated_candidates(M):
+            try:
+                t = _slope(fn_k, x)
+            except Exception as e:   # e.g. Mosaic compile failure on an
+                print(f"# candidate {name} failed: {e}",
+                      file=sys.stderr)   # unexpected TPU generation
+                continue
+            if best_t is None or t < best_t:
+                best_t, chosen = t, name
+        if best_t is None:
+            raise RuntimeError("no allreduce candidate ran")
+        t_op = best_t
+        ranks = EMU_RANKS
+        raw_gbps = info.hbm_bw_gbps
+        target = 0.8 * raw_gbps
+        m = MSG_BYTES
+        # single chip: the fabric is HBM; report achieved HBM bandwidth
+        # of the fused reduce+bcast (read 8m + write 8m per op)
         value = 2.0 * ranks * m / t_op / 1e9
-        metric = (f"osu_allreduce_effbw_64MiB_f32[{fabric},"
+        metric = (f"osu_allreduce_effbw_64MiB_f32[hbm(1chip-emulated),"
                   f"emu_ranks={ranks}]")
+
     print(json.dumps({
         "metric": metric,
         "value": round(value, 3),
@@ -133,6 +215,7 @@ def main() -> None:
         "detail": {
             "device": info.device_kind,
             "devices": p,
+            "algo": chosen,
             "t_op_ms": round(t_op * 1e3, 3),
             "target_GBps(0.8*raw)": round(target, 1),
             "slope_window": [K1, K2],

@@ -151,6 +151,11 @@ class Comm:
         self.isend(buf, dest, tag, mode="buffered", **kw).wait()
 
     def rsend(self, buf, dest: int, tag: int = 0, **kw) -> None:
+        # ready mode is deliberately treated as standard mode — an MPI
+        # implementation may do so (MPI-3.1 §3.4); the erroneous-usage
+        # detection (no matching receive posted) is intentionally
+        # dropped, matching the reference's default RC path. Covered by
+        # tests/progs/pt2pt/sendmodes_prog.py.
         self.isend(buf, dest, tag, mode="standard", **kw).wait()
 
     def issend(self, buf, dest: int, tag: int = 0, **kw) -> Request:

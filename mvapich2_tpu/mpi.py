@@ -81,6 +81,18 @@ def Finalize() -> None:
 
 
 def Abort(comm=None, errorcode: int = 1) -> None:
+    """Best-effort comm-wide kill (MPI-3.1 §8.7; the mpirun_rsh
+    cleanup-on-abort behavior): broadcast an abort event through the
+    job's KVS — the launcher watches it and kills every rank, and the
+    KVS server unblocks peers parked in get/fence — then exit hard."""
+    u = _uni.current_universe()
+    kvs = getattr(u, "kvs", None) if u is not None else None
+    if kvs is not None:
+        try:
+            rank = u.world_rank
+            kvs.abort(f"rank {rank} called MPI_Abort({errorcode})")
+        except Exception:
+            pass
     os._exit(errorcode)
 
 

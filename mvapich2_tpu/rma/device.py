@@ -225,8 +225,11 @@ def _pallas_put_kernel(axis, origin, target, disp, src_ref, win_ref,
         device_id_type=pltpu.DeviceIdType.LOGICAL,
     )
     rdma.start()
-    rdma.wait()          # my outbound is on the wire
-    rdma.wait_recv()     # my inbound landed
+    # wait() = wait_send() + wait_recv(): my outbound is on the wire
+    # and my (single) inbound has landed — every device sends exactly
+    # one copy and receives exactly one, so one wait pair consumes both
+    # semaphores (a second wait_recv would deadlock on hardware)
+    rdma.wait()
 
     @pl.when(me == target)
     def _():
@@ -250,9 +253,9 @@ def pallas_put(src, win_shard, axis: str, origin: int, target: int,
                              disp)
     return pl.pallas_call(
         kern,
-        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY),
-                  pl.BlockSpec(memory_space=pltpu.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
         out_shape=jax.ShapeDtypeStruct(win_shard.shape, win_shard.dtype),
         scratch_shapes=[pltpu.VMEM((n,), src.dtype),
                         pltpu.VMEM((n,), src.dtype),

@@ -25,6 +25,17 @@ from typing import List, Optional
 from .kvs import KVSServer
 
 
+def _kill_all(procs: List[subprocess.Popen]) -> None:
+    """SIGTERM, grace period, SIGKILL stragglers."""
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    time.sleep(0.2)
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+
+
 def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
            fake_nodes: Optional[List[int]] = None,
            timeout: Optional[float] = None, ft: bool = False) -> int:
@@ -63,6 +74,18 @@ def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
             for i, p in enumerate(procs):
                 if exit_codes[i] is None:
                     exit_codes[i] = p.poll()
+            if srv.state.aborted is not None:
+                # MPI_Abort broadcast through the KVS: kill the whole
+                # job at once (even in FT mode — §8.7 overrides ULFM
+                # survivability; the aborting rank asked for teardown)
+                print(f"mv2t-launch: {srv.state.aborted}",
+                      file=sys.stderr)
+                _kill_all(procs)
+                # reap everything so the aborting rank's errorcode is
+                # visible (mpirun_rsh propagates MPI_Abort's code)
+                codes = [p.wait() for p in procs]
+                pos = [c for c in codes if c > 0]
+                return max(pos) if pos else 1
             bad = [i for i, c in enumerate(exit_codes)
                    if c is not None and c != 0 and i not in failed]
             if ft:
@@ -74,13 +97,7 @@ def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
                         srv.publish(f"__failure_ev_{n_events}", str(i))
                         n_events += 1
             elif bad:
-                for p in procs:
-                    if p.poll() is None:
-                        p.terminate()
-                time.sleep(0.2)
-                for p in procs:
-                    if p.poll() is None:
-                        p.kill()
+                _kill_all(procs)
                 return max(c or 0 for c in exit_codes if c is not None) or 1
             if deadline and time.monotonic() > deadline:
                 for p in procs:

@@ -1,0 +1,19 @@
+"""Abort semantics: rank 1 calls MPI_Abort while others block — the
+launcher must tear the whole job down (no hang). Driven by
+tests/test_launcher.py, NOT the testlist (it exits nonzero by design)."""
+import sys
+import time
+
+sys.path.insert(0, ".")
+import numpy as np  # noqa: E402
+
+from mvapich2_tpu import mpi  # noqa: E402
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+if comm.rank == 1:
+    time.sleep(0.3)
+    mpi.Abort(comm, 7)
+# everyone else blocks forever in a recv that will never match: only
+# the abort teardown can end the job
+comm.recv(np.zeros(1), source=comm.rank, tag=12345)

@@ -3,6 +3,7 @@
 
 import os
 import subprocess
+import time
 import sys
 
 import pytest
@@ -69,3 +70,22 @@ def test_runtests_driver():
                        timeout=1200)
     assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
     assert "0 failures" in r.stdout
+
+
+def test_abort_kills_job():
+    """MPI_Abort on one rank tears down the whole job — even ranks
+    blocked in never-matching receives (MPI-3.1 §8.7; mpirun_rsh
+    cleanup-on-abort). Both default and FT modes."""
+    prog = os.path.join(REPO, "tests", "progs", "abort_prog.py")
+    for ft_args in ([], ["--ft"]):
+        t0 = time.monotonic()
+        r = subprocess.run(
+            [sys.executable, "-m", "mvapich2_tpu.run", "-np", "4",
+             *ft_args, sys.executable, prog],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        dt = time.monotonic() - t0
+        assert r.returncode == 7, \
+            f"MPI_Abort errorcode not propagated: rc={r.returncode}"
+        assert "MPI_Abort(7)" in r.stderr, \
+            f"abort banner missing ({ft_args}): {r.stderr[-300:]}"
+        assert dt < 30, f"abort teardown too slow ({dt:.1f}s, {ft_args})"
