@@ -23,8 +23,15 @@ log = get_logger("bootstrap")
 
 
 def bootstrap_from_env() -> Universe:
-    rank = int(os.environ.get("MV2T_RANK", os.environ.get("PMI_RANK", "0")))
-    size = int(os.environ.get("MV2T_SIZE", os.environ.get("PMI_SIZE", "1")))
+    if "MV2T_RANK" in os.environ:
+        rank = int(os.environ["MV2T_RANK"])
+        size = int(os.environ.get("MV2T_SIZE", "1"))
+    else:
+        # resource-manager adapters: Slurm/PBS/PMI task env (srun'd
+        # ranks carry identity without our launcher; runtime/rm.py)
+        from .rm import detect_rm_rank
+        rm = detect_rm_rank()
+        rank, size = rm if rm is not None else (0, 1)
     kvs_addr = os.environ.get("MV2T_KVS")
     get_config().reload()
 
@@ -56,6 +63,12 @@ def bootstrap_from_env() -> Universe:
     u = Universe(rank, size, node_ids)
     u.node_name_to_id = ids
     u.kvs = kvs
+    # CPU binding (hwloc_bind.c analog): bind by node-local rank so
+    # co-located ranks take disjoint core slices
+    from ..utils.affinity import apply_binding
+    my_node = node_ids[rank]
+    locals_ = [r for r in range(size) if node_ids[r] == my_node]
+    apply_binding(locals_.index(rank), len(locals_))
     _wire_channels(u, kvs)
     kvs.fence()   # everyone's business cards are published
     u.initialize()
@@ -113,6 +126,12 @@ def _bootstrap_spawned(local: int, size: int, kvs_addr: str) -> Universe:
     u.node_name_to_id = ids
     u.kvs = kvs
     u.appnum = int(os.environ.get("MV2T_APPNUM", "0"))
+    # bind among ALL job processes sharing my node (parents + spawned),
+    # not just this world's — co-located slices must stay disjoint
+    from ..utils.affinity import apply_binding
+    my_node = node_ids[pid]
+    co = [r for r in range(len(node_ids)) if node_ids[r] == my_node]
+    apply_binding(co.index(pid), len(co))
     _wire_channels(u, kvs)
     kvs.fence(group=f"spawn-{base}-cards", count=size)
     u.initialize()

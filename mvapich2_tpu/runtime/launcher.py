@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -184,6 +185,15 @@ def launch_tree(nranks: int, argv: List[str], hostfile_path: str,
             for i, a in enumerate(agents):
                 if rcs[i] is None:
                     rcs[i] = a.poll()
+            if srv.state.aborted is not None:
+                # MPI_Abort: tear the whole tree down (agents SIGTERM
+                # their rank processes); propagate the abort errorcode
+                print(f"mv2t-launch: {srv.state.aborted}",
+                      file=sys.stderr)
+                _stop_agents(agents)
+                m = re.search(r"MPI_Abort\((\d+)\)",
+                              srv.state.aborted or "")
+                return int(m.group(1)) if m else 1
             bad = [c for c in rcs if c is not None and c != 0]
             if bad and not ft:
                 _stop_agents(agents)
@@ -331,10 +341,35 @@ def main(argv: Optional[List[str]] = None) -> int:
         ap.error("no command given")
     if args.vpod:
         return launch_vpod(args.np, args.command, timeout=args.timeout)
+    rm_tmp = None
+    if not args.hostfile and not args.fake_nodes:
+        # inside a multi-node resource-manager allocation (Slurm/PBS),
+        # adopt its node list as the hostfile (src/pm/mpirun slurm/pbs
+        # adapters; runtime/rm.py). --fake-nodes/--hostfile take
+        # precedence: explicit placement beats the allocation.
+        from .rm import rm_hosts
+        hosts = rm_hosts()
+        if hosts and len(hosts) > 1:
+            import tempfile
+            fd, rm_tmp = tempfile.mkstemp(suffix=".hosts",
+                                          prefix="mv2t-rm-")
+            with os.fdopen(fd, "w") as hf:
+                for h in hosts:
+                    hf.write(f"{h.name} slots={h.slots}\n")
+            print(f"mpirun: using {len(hosts)}-node allocation from the "
+                  f"resource manager", file=sys.stderr)
+            args.hostfile = rm_tmp
     if args.hostfile:
-        return launch_tree(args.np, args.command, args.hostfile,
-                           timeout=args.timeout, ft=args.ft,
-                           policy=args.map)
+        try:
+            return launch_tree(args.np, args.command, args.hostfile,
+                               timeout=args.timeout, ft=args.ft,
+                               policy=args.map)
+        finally:
+            if rm_tmp is not None:
+                try:
+                    os.unlink(rm_tmp)
+                except OSError:
+                    pass
     fake = None
     if args.fake_nodes:
         fake = [int(x) for x in args.fake_nodes.split(",")]

@@ -1,0 +1,108 @@
+"""Resource-manager glue: Slurm / PBS / generic-PMI environment adapters.
+
+Analog of the reference's PM integration (src/pm/ slurm glue and the
+mpirun nodelist adapters, src/pm/mpirun/src/{slurm,pbs}): jobs started
+by a resource manager's own launcher (srun, pbsdsh) carry rank/size in
+RM-specific env vars and the node list in a compact RM grammar. This
+module detects those and translates to the framework's bootstrap
+contract (MV2T_RANK / MV2T_SIZE) and hostfile model.
+
+Under Slurm the framework also honors srun's PMI-ish vars directly in
+bootstrap_from_env (no mpirun needed — each srun task becomes a rank,
+pointing MV2T_KVS at a KVS started by rank 0 via the shared filesystem
+is the deployment's business; single-node srun works out of the box).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List, Optional, Tuple
+
+from .hostfile import HostSpec
+
+
+def detect_rm_rank() -> Optional[Tuple[int, int]]:
+    """(rank, size) from a resource manager's task env, or None.
+
+    Checked in order: Slurm (SLURM_PROCID/SLURM_NTASKS), PBS/Torque
+    (PBS_TASKNUM/PBS_NP), generic PMI (PMI_RANK/PMI_SIZE — also set by
+    many PMI-speaking launchers)."""
+    e = os.environ
+    if "SLURM_PROCID" in e and "SLURM_NTASKS" in e:
+        return int(e["SLURM_PROCID"]), int(e["SLURM_NTASKS"])
+    if "PBS_TASKNUM" in e and "PBS_NP" in e:
+        # PBS task numbers are 1-based
+        return int(e["PBS_TASKNUM"]) - 1, int(e["PBS_NP"])
+    if "PMI_RANK" in e and "PMI_SIZE" in e:
+        return int(e["PMI_RANK"]), int(e["PMI_SIZE"])
+    return None
+
+
+def expand_slurm_nodelist(nodelist: str) -> List[str]:
+    """Expand Slurm's compact nodelist grammar:
+    ``tpu[001-003,007],login1`` -> [tpu001, tpu002, tpu003, tpu007,
+    login1] (the scontrol-hostnames subset used in hostfiles)."""
+    out: List[str] = []
+    i = 0
+    n = len(nodelist)
+    while i < n:
+        j = i
+        while j < n and nodelist[j] not in ",[":
+            j += 1
+        prefix = nodelist[i:j]
+        if j < n and nodelist[j] == "[":
+            k = nodelist.index("]", j)
+            for part in nodelist[j + 1: k].split(","):
+                if "-" in part:
+                    a, b = part.split("-")
+                    width = len(a)
+                    for v in range(int(a), int(b) + 1):
+                        out.append(f"{prefix}{v:0{width}d}")
+                else:
+                    out.append(prefix + part)
+            i = k + 1
+            if i < n and nodelist[i] == ",":
+                i += 1
+        else:
+            if prefix:
+                out.append(prefix)
+            i = j + 1
+    return out
+
+
+def rm_hosts() -> Optional[List[HostSpec]]:
+    """HostSpecs from the resource manager's allocation, or None.
+
+    Slurm: SLURM_JOB_NODELIST (+ SLURM_TASKS_PER_NODE like ``4(x2),2``).
+    PBS: the PBS_NODEFILE (one line per slot, repeated names)."""
+    e = os.environ
+    if "SLURM_JOB_NODELIST" in e:
+        names = expand_slurm_nodelist(e["SLURM_JOB_NODELIST"])
+        slots = [1] * len(names)
+        tpn = e.get("SLURM_TASKS_PER_NODE")
+        if tpn:
+            counts: List[int] = []
+            for part in tpn.split(","):
+                m = re.fullmatch(r"(\d+)\(x(\d+)\)", part)
+                if m:
+                    counts.extend([int(m.group(1))] * int(m.group(2)))
+                else:
+                    counts.append(int(part))
+            if len(counts) == len(names):
+                slots = counts
+        return [HostSpec(nm, sl) for nm, sl in zip(names, slots)]
+    nodefile = e.get("PBS_NODEFILE")
+    if nodefile and os.path.exists(nodefile):
+        order: List[str] = []
+        count: dict = {}
+        with open(nodefile) as f:
+            for line in f:
+                nm = line.strip()
+                if not nm:
+                    continue
+                if nm not in count:
+                    order.append(nm)
+                count[nm] = count.get(nm, 0) + 1
+        return [HostSpec(nm, count[nm]) for nm in order]
+    return None

@@ -1,0 +1,81 @@
+"""CPU affinity / process binding.
+
+Analog of the reference's hwloc-based binding
+(common/src/affinity/hwloc_bind.c:65-283: policies bunch/scatter over a
+linear core map). On TPU hosts the chips do the math, but rank
+processes still contend for host cores (progress threads, IO,
+grad-staging) — binding keeps co-located ranks off each other's caches.
+
+Topology source is the portable one the OS gives us
+(os.sched_getaffinity of the inherited mask), so container cpusets are
+respected. Policies:
+
+  bunch    — co-located ranks get adjacent equal slices of the core
+             list (cache-friendly; hwloc_bind.c POLICY_BUNCH)
+  scatter  — ranks take cores strided round-robin across the list
+             (bandwidth-friendly; POLICY_SCATTER)
+  none     — leave the inherited mask alone
+
+Enabled by MV2T_ENABLE_AFFINITY (MV2_ENABLE_AFFINITY analog), policy by
+MV2T_CPU_BINDING_POLICY; applied at bootstrap once the node-local rank
+and node size are known.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Set
+
+from .config import cvar, get_config
+from .mlog import get_logger
+
+log = get_logger("affinity")
+
+cvar("CPU_BINDING_POLICY", "bunch", str, "runtime",
+     "Binding policy when ENABLE_AFFINITY is set: bunch | scatter | "
+     "none (analog of MV2_CPU_BINDING_POLICY, hwloc_bind.c:65).",
+     choices=("bunch", "scatter", "none"))
+
+
+def slice_for(local_rank: int, local_size: int, cores: List[int],
+              policy: str) -> Set[int]:
+    """The core set rank ``local_rank`` of ``local_size`` node-local
+    ranks binds to, from the allowed ``cores`` (sorted)."""
+    n = len(cores)
+    if n == 0 or local_size <= 0 or policy == "none":
+        return set(cores)
+    if local_size >= n:
+        # oversubscribed: one core each, round-robin
+        return {cores[local_rank % n]}
+    if policy == "scatter":
+        return {cores[i] for i in range(local_rank, n, local_size)}
+    # bunch: adjacent equal slices (remainder to the low ranks)
+    per, rem = divmod(n, local_size)
+    lo = local_rank * per + min(local_rank, rem)
+    hi = lo + per + (1 if local_rank < rem else 0)
+    return set(cores[lo:hi])
+
+
+def apply_binding(local_rank: int, local_size: int,
+                  policy: Optional[str] = None) -> Optional[Set[int]]:
+    """Bind the calling process; returns the applied core set (None when
+    binding is disabled or unsupported on this OS)."""
+    cfg = get_config()
+    if not cfg["ENABLE_AFFINITY"]:
+        return None
+    if not hasattr(os, "sched_setaffinity"):   # pragma: no cover
+        log.warn("affinity requested but unsupported on this OS")
+        return None
+    policy = policy or str(cfg["CPU_BINDING_POLICY"])
+    cores = sorted(os.sched_getaffinity(0))
+    cpuset = slice_for(local_rank, local_size, cores, policy)
+    if not cpuset:
+        return None
+    try:
+        os.sched_setaffinity(0, cpuset)
+    except OSError as e:   # pragma: no cover
+        log.warn("sched_setaffinity failed: %s", e)
+        return None
+    log.dbg(1, "bound local rank %d/%d to cpus %s (%s)", local_rank,
+            local_size, sorted(cpuset), policy)
+    return cpuset

@@ -139,3 +139,20 @@ def test_tree_failing_rank_kills_job(tmp_path):
          "--hostfile", hf, "--timeout", "90", sys.executable, prog],
         cwd=REPO, capture_output=True, text=True, timeout=150)
     assert r.returncode != 0
+
+
+def test_abort_kills_tree_job(tmp_path):
+    """MPI_Abort tears down a multi-node (agent-tree) job too: the
+    launcher watches the same KVS abort event on the tree path and
+    propagates the errorcode."""
+    import subprocess
+    import sys
+    hf = tmp_path / "hosts"
+    hf.write_text("emuA slots=2\nemuB slots=2\n")
+    prog = os.path.join(REPO, "tests", "progs", "abort_prog.py")
+    r = subprocess.run(
+        [sys.executable, "-m", "mvapich2_tpu.run", "-np", "4",
+         "--hostfile", str(hf), sys.executable, prog],
+        cwd=REPO, capture_output=True, text=True, timeout=90)
+    assert r.returncode == 7, (r.returncode, r.stderr[-300:])
+    assert "MPI_Abort(7)" in r.stderr

@@ -1,0 +1,120 @@
+"""Resource-manager glue + CPU binding (runtime/rm.py, utils/affinity.py
+— analogs of src/pm/mpirun/src/{slurm,pbs} and hwloc_bind.c)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mvapich2_tpu.runtime.hostfile import HostSpec
+from mvapich2_tpu.runtime.rm import (detect_rm_rank,
+                                     expand_slurm_nodelist, rm_hosts)
+from mvapich2_tpu.utils.affinity import slice_for
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_slurm_nodelist_grammar():
+    assert expand_slurm_nodelist("tpu[001-003,007],login1") == [
+        "tpu001", "tpu002", "tpu003", "tpu007", "login1"]
+    assert expand_slurm_nodelist("n1,n2") == ["n1", "n2"]
+    assert expand_slurm_nodelist("host[9-11]") == ["host9", "host10",
+                                                   "host11"]
+    assert expand_slurm_nodelist("solo") == ["solo"]
+
+
+RM_VARS = ("SLURM_PROCID", "SLURM_NTASKS", "SLURM_JOB_NODELIST",
+           "SLURM_TASKS_PER_NODE", "PBS_TASKNUM", "PBS_NP",
+           "PBS_NODEFILE", "PMI_RANK", "PMI_SIZE")
+
+
+def _clear_rm(monkeypatch):
+    for v in RM_VARS:
+        monkeypatch.delenv(v, raising=False)
+
+
+def test_detect_rm_rank(monkeypatch):
+    _clear_rm(monkeypatch)
+    assert detect_rm_rank() is None
+    monkeypatch.setenv("SLURM_PROCID", "3")
+    monkeypatch.setenv("SLURM_NTASKS", "8")
+    assert detect_rm_rank() == (3, 8)
+    monkeypatch.delenv("SLURM_PROCID")
+    monkeypatch.delenv("SLURM_NTASKS")
+    monkeypatch.setenv("PBS_TASKNUM", "2")   # 1-based
+    monkeypatch.setenv("PBS_NP", "4")
+    assert detect_rm_rank() == (1, 4)
+
+
+def test_rm_hosts_slurm(monkeypatch):
+    _clear_rm(monkeypatch)
+    monkeypatch.setenv("SLURM_JOB_NODELIST", "n[1-3]")
+    monkeypatch.setenv("SLURM_TASKS_PER_NODE", "4(x2),2")
+    hosts = rm_hosts()
+    assert hosts == [HostSpec("n1", 4), HostSpec("n2", 4),
+                     HostSpec("n3", 2)]
+
+
+def test_rm_hosts_pbs(monkeypatch, tmp_path):
+    _clear_rm(monkeypatch)
+    nf = tmp_path / "nodes"
+    nf.write_text("a\na\nb\n")
+    monkeypatch.setenv("PBS_NODEFILE", str(nf))
+    assert rm_hosts() == [HostSpec("a", 2), HostSpec("b", 1)]
+
+
+def test_affinity_slices():
+    cores = list(range(8))
+    # bunch: adjacent slices
+    assert slice_for(0, 2, cores, "bunch") == {0, 1, 2, 3}
+    assert slice_for(1, 2, cores, "bunch") == {4, 5, 6, 7}
+    # remainder to low ranks
+    assert slice_for(0, 3, cores, "bunch") == {0, 1, 2}
+    assert slice_for(2, 3, cores, "bunch") == {6, 7}
+    # scatter: strided
+    assert slice_for(0, 2, cores, "scatter") == {0, 2, 4, 6}
+    assert slice_for(1, 2, cores, "scatter") == {1, 3, 5, 7}
+    # oversubscription: one core each, wrapped
+    assert slice_for(9, 12, cores, "bunch") == {1}
+    # disjoint + complete cover
+    got = set()
+    for r in range(3):
+        s = slice_for(r, 3, cores, "bunch")
+        assert not (got & s)
+        got |= s
+    assert got == set(cores)
+
+
+@pytest.mark.skipif(not hasattr(os, "sched_getaffinity"),
+                    reason="no sched_getaffinity")
+def test_binding_applied_end_to_end(tmp_path):
+    """Ranks launched with MV2T_ENABLE_AFFINITY get disjoint masks when
+    cores allow, and the job still runs collectives."""
+    ncores = len(os.sched_getaffinity(0))
+    prog = tmp_path / "aff_prog.py"
+    prog.write_text(
+        "import os, sys\n"
+        "sys.path.insert(0, %r)\n"
+        "from mvapich2_tpu import mpi\n"
+        "import numpy as np\n"
+        "mpi.Init()\n"
+        "c = mpi.COMM_WORLD\n"
+        "mask = sorted(os.sched_getaffinity(0))\n"
+        "out = c.allreduce(np.array([float(len(mask))]))\n"
+        "if c.rank == 0:\n"
+        "    print('MASKSUM', out[0])\n"
+        "    print('No Errors')\n"
+        "mpi.Finalize()\n" % REPO)
+    r = subprocess.run(
+        [sys.executable, "-m", "mvapich2_tpu.run", "-np", "2",
+         sys.executable, str(prog)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "MV2T_ENABLE_AFFINITY": "1"})
+    assert r.returncode == 0, r.stderr[-400:]
+    assert "No Errors" in r.stdout
+    # with >=2 cores the two ranks' masks are disjoint slices covering
+    # all cores: the mask sizes sum to ncores
+    if ncores >= 2:
+        masksum = float(r.stdout.split("MASKSUM")[1].split()[0])
+        assert masksum == ncores
