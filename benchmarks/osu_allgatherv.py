@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""osu_allgatherv — allgatherv latency (port of osu_allgatherv.c;
+per-rank counts like the reference: rank i contributes size bytes,
+displacements contiguous)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mvapich2_tpu import mpi
+from mvapich2_tpu.bench import osu_util as u
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+opts = u.options("allgatherv", default_max=1 << 20, collective=True)
+
+_bufs = {}
+
+
+def run_one(size: int) -> None:
+    if size not in _bufs:
+        _bufs[size] = (np.zeros(size, np.uint8),
+                       np.zeros(size * comm.size, np.uint8),
+                       [size] * comm.size)
+    sb, rb, counts = _bufs[size]
+    comm.allgatherv(sb, rb, counts)
+
+
+u.collective_latency(comm, "Allgatherv Latency Test", run_one, opts)
+u.finalize_ok(comm)

@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""osu_alltoallv — alltoallv latency (port of osu_alltoallv.c)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mvapich2_tpu import mpi
+from mvapich2_tpu.bench import osu_util as u
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+opts = u.options("alltoallv", default_max=1 << 18, collective=True)
+
+_bufs = {}
+
+
+def run_one(size: int) -> None:
+    if size not in _bufs:
+        p = comm.size
+        counts = [size] * p
+        displs = [i * size for i in range(p)]
+        _bufs[size] = (np.zeros(size * p, np.uint8),
+                       np.zeros(size * p, np.uint8), counts, displs)
+    sb, rb, counts, displs = _bufs[size]
+    comm.alltoallv(sb, counts, displs, rb, counts, displs)
+
+
+u.collective_latency(comm, "Alltoallv Latency Test", run_one, opts)
+u.finalize_ok(comm)

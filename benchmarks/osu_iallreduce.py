@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""osu_iallreduce — nonblocking allreduce latency + compute/communication
+overlap (port of osu_iallreduce.c: reports pure latency, latency with
+overlapped dummy compute, and the achieved overlap %)."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mvapich2_tpu import mpi
+from mvapich2_tpu.bench import osu_util as u
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+opts = u.options("iallreduce", default_max=1 << 18, collective=True)
+u.header(comm, "Iallreduce Latency Test",
+         cols=f"{'Pure(us)':>12} {'Overlapped(us)':>15} {'Overlap(%)':>11}")
+
+
+def _compute(dur: float) -> None:
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < dur:
+        pass
+
+
+for size in u.sizes(opts):
+    n = max(size // 4, 1)
+    sb = np.ones(n, np.float32)
+    rb = np.zeros(n, np.float32)
+    iters = max(10, u.scale_iters(opts, size) // 4)
+
+    # pure nonblocking latency
+    for i in range(iters + opts.skip):
+        if i == opts.skip:
+            comm.barrier()
+            t0 = mpi.Wtime()
+        comm.iallreduce(sb, rb).wait()
+    pure = (mpi.Wtime() - t0) / iters
+
+    # overlapped: issue, compute for ~pure, then wait
+    for i in range(iters + opts.skip):
+        if i == opts.skip:
+            comm.barrier()
+            t0 = mpi.Wtime()
+        req = comm.iallreduce(sb, rb)
+        _compute(pure)
+        req.wait()
+    total = (mpi.Wtime() - t0) / iters
+    # OSU overlap model: how much of the communication hid under compute
+    overlap = max(0.0, min(100.0, (1.0 - (total - pure) / pure) * 100.0))
+
+    la = comm.allreduce(np.array([pure, total]))
+    if comm.rank == 0:
+        p_us = la[0] / comm.size * 1e6
+        t_us = la[1] / comm.size * 1e6
+        print(f"{size:<12} {p_us:>12.2f} {t_us:>15.2f} {overlap:>11.1f}")
+        sys.stdout.flush()
+comm.barrier()
+u.finalize_ok(comm)

@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""osu_scan — scan latency (port of osu_scan.c; float32 MPI_SUM)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mvapich2_tpu import mpi
+from mvapich2_tpu.bench import osu_util as u
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+opts = u.options("scan", default_max=1 << 20, collective=True)
+
+_bufs = {}
+
+
+def run_one(size: int) -> None:
+    n = max(size // 4, 1)
+    if size not in _bufs:
+        _bufs[size] = (np.ones(n, np.float32), np.zeros(n, np.float32))
+    sb, rb = _bufs[size]
+    comm.scan(sb, rb)
+
+
+u.collective_latency(comm, "Scan Latency Test", run_one, opts)
+u.finalize_ok(comm)

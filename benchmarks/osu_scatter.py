@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""osu_scatter — scatter latency (port of osu_scatter.c)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mvapich2_tpu import mpi
+from mvapich2_tpu.bench import osu_util as u
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+opts = u.options("scatter", default_max=1 << 20, collective=True)
+
+_bufs = {}
+
+
+def run_one(size: int) -> None:
+    if size not in _bufs:
+        _bufs[size] = (np.zeros(size * comm.size, np.uint8),
+                       np.zeros(size, np.uint8))
+    sb, rb = _bufs[size]
+    comm.scatter(sb if comm.rank == 0 else None, rb, root=0)
+
+
+u.collective_latency(comm, "Scatter Latency Test", run_one, opts)
+u.finalize_ok(comm)

@@ -65,10 +65,8 @@ def bootstrap_from_env() -> Universe:
     u.kvs = kvs
     # CPU binding (hwloc_bind.c analog): bind by node-local rank so
     # co-located ranks take disjoint core slices
-    from ..utils.affinity import apply_binding
-    my_node = node_ids[rank]
-    locals_ = [r for r in range(size) if node_ids[r] == my_node]
-    apply_binding(locals_.index(rank), len(locals_))
+    from ..utils.affinity import bind_among
+    bind_among(node_ids, rank)
     _wire_channels(u, kvs)
     kvs.fence()   # everyone's business cards are published
     u.initialize()
@@ -126,12 +124,11 @@ def _bootstrap_spawned(local: int, size: int, kvs_addr: str) -> Universe:
     u.node_name_to_id = ids
     u.kvs = kvs
     u.appnum = int(os.environ.get("MV2T_APPNUM", "0"))
-    # bind among ALL job processes sharing my node (parents + spawned),
-    # not just this world's — co-located slices must stay disjoint
-    from ..utils.affinity import apply_binding
-    my_node = node_ids[pid]
-    co = [r for r in range(len(node_ids)) if node_ids[r] == my_node]
-    apply_binding(co.index(pid), len(co))
+    # bind among ALL job processes sharing my node (parents + spawned);
+    # parents symmetrically rebind in _finish_spawn when the proc table
+    # grows, keeping co-located slices disjoint across the whole job
+    from ..utils.affinity import bind_among
+    bind_among(node_ids, pid)
     _wire_channels(u, kvs)
     kvs.fence(group=f"spawn-{base}-cards", count=size)
     u.initialize()

@@ -193,7 +193,9 @@ def launch_tree(nranks: int, argv: List[str], hostfile_path: str,
                 _stop_agents(agents)
                 m = re.search(r"MPI_Abort\((\d+)\)",
                               srv.state.aborted or "")
-                return int(m.group(1)) if m else 1
+                # an aborted job is never a success (code 0 -> 1), same
+                # as the single-host path
+                return (int(m.group(1)) if m else 1) or 1
             bad = [c for c in rcs if c is not None and c != 0]
             if bad and not ft:
                 _stop_agents(agents)

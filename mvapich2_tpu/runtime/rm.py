@@ -39,35 +39,52 @@ def detect_rm_rank() -> Optional[Tuple[int, int]]:
     return None
 
 
+def _split_hostlist(nodelist: str) -> List[str]:
+    """Split on commas OUTSIDE bracket groups."""
+    toks: List[str] = []
+    depth = 0
+    cur = ""
+    for ch in nodelist:
+        if ch == "," and depth == 0:
+            if cur:
+                toks.append(cur)
+            cur = ""
+            continue
+        if ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth -= 1
+        cur += ch
+    if cur:
+        toks.append(cur)
+    return toks
+
+
 def expand_slurm_nodelist(nodelist: str) -> List[str]:
     """Expand Slurm's compact nodelist grammar:
     ``tpu[001-003,007],login1`` -> [tpu001, tpu002, tpu003, tpu007,
-    login1] (the scontrol-hostnames subset used in hostfiles)."""
+    login1]; suffixes after a group (``c[1-2]n1``) and multiple groups
+    per name expand combinatorially (the scontrol-hostnames subset)."""
     out: List[str] = []
-    i = 0
-    n = len(nodelist)
-    while i < n:
-        j = i
-        while j < n and nodelist[j] not in ",[":
-            j += 1
-        prefix = nodelist[i:j]
-        if j < n and nodelist[j] == "[":
-            k = nodelist.index("]", j)
-            for part in nodelist[j + 1: k].split(","):
-                if "-" in part:
-                    a, b = part.split("-")
-                    width = len(a)
-                    for v in range(int(a), int(b) + 1):
-                        out.append(f"{prefix}{v:0{width}d}")
-                else:
-                    out.append(prefix + part)
-            i = k + 1
-            if i < n and nodelist[i] == ",":
-                i += 1
-        else:
-            if prefix:
-                out.append(prefix)
-            i = j + 1
+    for tok in _split_hostlist(nodelist):
+        lb = tok.find("[")
+        if lb < 0:
+            out.append(tok)
+            continue
+        rb = tok.index("]", lb)
+        prefix, body, rest = tok[:lb], tok[lb + 1: rb], tok[rb + 1:]
+        expanded: List[str] = []
+        for part in body.split(","):
+            if "-" in part:
+                a, b = part.split("-")
+                width = len(a)
+                expanded.extend(f"{v:0{width}d}"
+                                for v in range(int(a), int(b) + 1))
+            else:
+                expanded.append(part)
+        out.extend(expand_slurm_nodelist(
+            ",".join(prefix + e + rest for e in expanded)) if "[" in rest
+            else [prefix + e + rest for e in expanded])
     return out
 
 

@@ -82,6 +82,11 @@ def _finish_spawn(comm: Comm, hdr, root: int, ctx: int):
         raise MPIException(MPI_ERR_SPAWN, hdr["error"])
     base, total = hdr["base"], hdr["total"]
     u.extend_procs(base, hdr["names"])
+    # spawn is collective over the parent comm: every parent re-applies
+    # its CPU binding now that co-located children joined the node, so
+    # the per-node core slices stay disjoint across the whole job
+    from ..utils.affinity import bind_among
+    bind_among(u.node_ids, u.world_rank)
     private = comm.dup()
     inter = Intercomm(u, private.group, Group(range(base, base + total)),
                       ctx, private, name="spawn_parent")

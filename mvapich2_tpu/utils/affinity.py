@@ -56,6 +56,16 @@ def slice_for(local_rank: int, local_size: int, cores: List[int],
     return set(cores[lo:hi])
 
 
+def bind_among(node_ids, me: int,
+               policy: Optional[str] = None) -> Optional[Set[int]]:
+    """Bind process ``me`` among all job processes sharing its node
+    (``node_ids`` maps proc id -> node id). The shared entry point for
+    bootstrap and post-spawn rebinding so the slicing logic lives once."""
+    my_node = node_ids[me]
+    co = [r for r in range(len(node_ids)) if node_ids[r] == my_node]
+    return apply_binding(co.index(me), len(co), policy)
+
+
 def apply_binding(local_rank: int, local_size: int,
                   policy: Optional[str] = None) -> Optional[Set[int]]:
     """Bind the calling process; returns the applied core set (None when

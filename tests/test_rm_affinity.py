@@ -22,6 +22,10 @@ def test_slurm_nodelist_grammar():
     assert expand_slurm_nodelist("host[9-11]") == ["host9", "host10",
                                                    "host11"]
     assert expand_slurm_nodelist("solo") == ["solo"]
+    # suffix after a bracket group, and multiple groups per name
+    assert expand_slurm_nodelist("c[1-2]n1") == ["c1n1", "c2n1"]
+    assert expand_slurm_nodelist("a[1-2]b[3-4]") == [
+        "a1b3", "a1b4", "a2b3", "a2b4"]
 
 
 RM_VARS = ("SLURM_PROCID", "SLURM_NTASKS", "SLURM_JOB_NODELIST",
