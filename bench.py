@@ -46,11 +46,21 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 SKIP = 2
 ITERS = 10
 K1, K2 = 4, 16
-MSG_BYTES = int(os.environ.get("MV2T_BENCH_BYTES",
-                                64 * 1024 * 1024))
 # 64 MiB float32 per rank is the north-star point; MV2T_BENCH_BYTES
-# shrinks it for CI mechanics tests on the virtual CPU mesh
+# shrinks it for CI mechanics tests on the virtual CPU mesh (rounded up
+# to the 512-byte granularity of the emulated (m/512, 8, 128) layout so
+# the bandwidth formula matches the bytes actually moved)
+MSG_BYTES = max(512, int(os.environ.get("MV2T_BENCH_BYTES",
+                                        64 * 1024 * 1024)) // 512 * 512)
 EMU_RANKS = 8
+
+
+def _sz_label() -> str:
+    if MSG_BYTES % (1024 * 1024) == 0:
+        return f"{MSG_BYTES // (1024 * 1024)}MiB"
+    if MSG_BYTES % 1024 == 0:
+        return f"{MSG_BYTES // 1024}KiB"
+    return f"{MSG_BYTES}B"
 
 
 def _timed_min(fn_k, x, k):
@@ -204,8 +214,8 @@ def main() -> None:
         m = MSG_BYTES
         # the OSU ring busbw model: each rank's NIC moves 2(p-1)/p * m
         value = 2.0 * (ranks - 1) / ranks * m / t_op / 1e9
-        mib = MSG_BYTES // (1024 * 1024)
-        metric = f"osu_allreduce_busbw_{mib}MiB_f32[ici,p={ranks}]"
+        metric = (f"osu_allreduce_busbw_{_sz_label()}_f32"
+                  f"[ici,p={ranks}]")
     else:
         M = n_f32 // 128
         x = jax.random.normal(jax.random.PRNGKey(0), (M, 8, 128),
@@ -230,9 +240,8 @@ def main() -> None:
         # single chip: the fabric is HBM; report achieved HBM bandwidth
         # of the fused reduce+bcast (read 8m + write 8m per op)
         value = 2.0 * ranks * m / t_op / 1e9
-        mib = MSG_BYTES // (1024 * 1024)
-        metric = (f"osu_allreduce_effbw_{mib}MiB_f32[hbm(1chip-emulated),"
-                  f"emu_ranks={ranks}]")
+        metric = (f"osu_allreduce_effbw_{_sz_label()}_f32"
+                  f"[hbm(1chip-emulated),emu_ranks={ranks}]")
 
     print(json.dumps({
         "metric": metric,

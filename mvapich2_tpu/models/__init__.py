@@ -1,1 +1,1 @@
-from . import ring_attention, stencil, transformer
+from . import ring_attention, stencil, transformer, ulysses

@@ -1,0 +1,63 @@
+"""Ulysses sequence parallelism: attention via head<->sequence all-to-all.
+
+The second long-context strategy of SURVEY §5.7 (next to ring
+attention): where the ring circulates KV blocks over ppermute
+(MPI_Sendrecv-shift skeleton), Ulysses re-shards with the reference's
+alltoall family (alltoall_osu.c -> one fused ICI all-to-all here). Each
+shard holds a sequence block of ALL heads; two all-to-alls convert that
+to all tokens of a head block, dense attention runs locally per head,
+and one more all-to-all restores sequence sharding:
+
+    [T/p tokens, H heads]  --a2a-->  [T tokens, H/p heads]
+        (attention, embarrassingly parallel over the head block)
+    [T tokens, H/p heads]  --a2a-->  [T/p tokens, H heads]
+
+Communication: 3-4 all-to-alls of the activations per attention call
+(vs the ring's p-1 KV shifts) — the better trade when heads >= shards
+and ICI all-to-all bandwidth is plentiful (v5p tori), while ring
+attention wins at extreme sequence lengths; the tuning-layer crossover
+discipline applies (models pick per mesh shape).
+
+Call inside shard_map over the sequence axis; the head count must be
+divisible by the axis size.
+"""
+
+from __future__ import annotations
+
+from jax import lax
+
+from ..ops import all_to_all
+from .ring_attention import local_attention_reference
+
+
+def _seq_to_heads(x, axis: str):
+    """[T/p, H, Dh] -> [T, H/p, Dh]: gather the sequence, scatter heads."""
+    return all_to_all(x, axis, split_axis=1, concat_axis=0)
+
+
+def _heads_to_seq(x, axis: str):
+    """[T, H/p, Dh] -> [T/p, H, Dh]: the inverse reshard."""
+    return all_to_all(x, axis, split_axis=0, concat_axis=1)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
+    """Sequence-parallel attention via head/sequence all-to-all reshard.
+
+    q/k/v: [T/p, H, Dh] — this shard's sequence block of every head
+    (p = size of ``axis_name``; H % p == 0). Returns the attention
+    output in the same [T/p, H, Dh] sharding.
+
+    Numerically identical to dense attention over the gathered
+    sequence (tested against it); the all-to-alls are the only
+    communication. The local attention runs in f32 regardless of input
+    dtype (like ring_attention's accumulators).
+    """
+    H = q.shape[1]
+    p = lax.axis_size(axis_name)
+    if H % p != 0:
+        raise ValueError(f"heads {H} not divisible by axis size {p}")
+    qh = _seq_to_heads(q, axis_name)     # [T, H/p, Dh]
+    kh = _seq_to_heads(k, axis_name)
+    vh = _seq_to_heads(v, axis_name)
+    oh = local_attention_reference(qh, kh, vh, causal=causal)
+    return _heads_to_seq(oh, axis_name).astype(q.dtype)  # [T/p, H, Dh]
