@@ -69,13 +69,35 @@ class Datatype:
     def basic_size(self) -> int:
         return self.basic.itemsize if self.basic is not None else 1
 
+    @property
+    def attrs(self):
+        """Keyval attribute cache (MPI_Type_set_attr family), lazy."""
+        a = getattr(self, "_attrs", None)
+        if a is None:
+            from .attr import AttrCache
+            a = self._attrs = AttrCache()
+        return a
+
+    def get_envelope(self):
+        """(combiner, integers, addresses, datatypes) — MPI_Type_get_
+        envelope/get_contents introspection. Basic types report
+        COMBINER_NAMED with empty argument lists."""
+        env = getattr(self, "_envelope", None)
+        if env is None:
+            return ("named", [], [], [])
+        return env
+
     def commit(self) -> "Datatype":
         self.committed = True
         return self
 
     def dup(self) -> "Datatype":
-        return Datatype(list(self.spans), self.extent, self.lb, self.basic,
-                        self.name + "_dup", self.committed)
+        new = Datatype(list(self.spans), self.extent, self.lb, self.basic,
+                       self.name + "_dup", self.committed)
+        new._envelope = ("dup", [], [], [self])
+        if getattr(self, "_attrs", None) is not None:
+            self.attrs.copy_all(self, new.attrs)   # keyval copy_fn fires
+        return new
 
     def __repr__(self) -> str:
         return (f"Datatype({self.name or 'derived'}, size={self.size}, "
@@ -241,19 +263,28 @@ def from_numpy_dtype(dt) -> Datatype:
 # Derived-type constructors (MPI-3.1 set; reference src/mpi/datatype/)
 # ---------------------------------------------------------------------------
 
+def _env(dt: Datatype, combiner: str, ints, aints, types) -> Datatype:
+    dt._envelope = (combiner, list(ints), list(aints), list(types))
+    return dt
+
+
 def create_contiguous(count: int, oldtype: Datatype) -> Datatype:
     spans = []
     for i in range(count):
         base = i * oldtype.extent
         spans.extend((base + o, l) for o, l in oldtype.spans)
-    return Datatype(spans, count * oldtype.extent, oldtype.lb, oldtype.basic,
-                    f"contig({count},{oldtype.name})")
+    return _env(
+        Datatype(spans, count * oldtype.extent, oldtype.lb, oldtype.basic,
+                 f"contig({count},{oldtype.name})"),
+        "contiguous", [count], [], [oldtype])
 
 
 def create_vector(count: int, blocklength: int, stride: int,
                   oldtype: Datatype) -> Datatype:
     """stride in elements of oldtype (MPI_Type_vector)."""
-    return create_hvector(count, blocklength, stride * oldtype.extent, oldtype)
+    return _env(create_hvector(count, blocklength,
+                               stride * oldtype.extent, oldtype),
+                "vector", [count, blocklength, stride], [], [oldtype])
 
 
 def create_hvector(count: int, blocklength: int, stride_bytes: int,
@@ -265,15 +296,20 @@ def create_hvector(count: int, blocklength: int, stride_bytes: int,
             b2 = base + j * oldtype.extent
             spans.extend((b2 + o, l) for o, l in oldtype.spans)
     extent = _extent_of(spans, oldtype)
-    return Datatype(sorted(spans), extent, 0, oldtype.basic,
-                    f"hvector({count},{blocklength},{stride_bytes})")
+    return _env(
+        Datatype(sorted(spans), extent, 0, oldtype.basic,
+                 f"hvector({count},{blocklength},{stride_bytes})"),
+        "hvector", [count, blocklength], [stride_bytes], [oldtype])
 
 
 def create_indexed(blocklengths: Sequence[int], displacements: Sequence[int],
                    oldtype: Datatype) -> Datatype:
     """displacements in elements of oldtype (MPI_Type_indexed)."""
     disp_b = [d * oldtype.extent for d in displacements]
-    return create_hindexed(blocklengths, disp_b, oldtype)
+    return _env(create_hindexed(blocklengths, disp_b, oldtype),
+                "indexed",
+                [len(blocklengths)] + list(blocklengths)
+                + list(displacements), [], [oldtype])
 
 
 def create_hindexed(blocklengths: Sequence[int], disp_bytes: Sequence[int],
@@ -286,14 +322,21 @@ def create_hindexed(blocklengths: Sequence[int], disp_bytes: Sequence[int],
             base = disp + j * oldtype.extent
             spans.extend((base + o, l) for o, l in oldtype.spans)
     extent = _extent_of(spans, oldtype)
-    return Datatype(sorted(spans), extent, 0, oldtype.basic,
-                    f"hindexed({len(blocklengths)})")
+    return _env(
+        Datatype(sorted(spans), extent, 0, oldtype.basic,
+                 f"hindexed({len(blocklengths)})"),
+        "hindexed", [len(blocklengths)] + list(blocklengths),
+        list(disp_bytes), [oldtype])
 
 
 def create_indexed_block(blocklength: int, displacements: Sequence[int],
                          oldtype: Datatype) -> Datatype:
-    return create_indexed([blocklength] * len(displacements), displacements,
-                          oldtype)
+    return _env(
+        create_indexed([blocklength] * len(displacements), displacements,
+                       oldtype),
+        "indexed_block",
+        [len(displacements), blocklength] + list(displacements), [],
+        [oldtype])
 
 
 def create_struct(blocklengths: Sequence[int], disp_bytes: Sequence[int],
@@ -310,14 +353,18 @@ def create_struct(blocklengths: Sequence[int], disp_bytes: Sequence[int],
     basic = basics.pop() if len(basics) == 1 else None
     max_ub = max((d + bl * t.extent for d, bl, t
                   in zip(disp_bytes, blocklengths, types)), default=0)
-    return Datatype(sorted(spans), max_ub, 0, basic,
-                    f"struct({len(types)})")
+    return _env(
+        Datatype(sorted(spans), max_ub, 0, basic,
+                 f"struct({len(types)})"),
+        "struct", [len(types)] + list(blocklengths), list(disp_bytes),
+        list(types))
 
 
 def create_subarray(sizes: Sequence[int], subsizes: Sequence[int],
                     starts: Sequence[int], oldtype: Datatype,
                     order: str = "C") -> Datatype:
     """MPI_Type_create_subarray (C order or Fortran order)."""
+    orig = (list(sizes), list(subsizes), list(starts))
     ndim = len(sizes)
     mpi_assert(len(subsizes) == ndim and len(starts) == ndim, MPI_ERR_ARG,
                "subarray dims mismatch")
@@ -345,13 +392,18 @@ def create_subarray(sizes: Sequence[int], subsizes: Sequence[int],
     total = 1
     for s in sizes:
         total *= s
-    return Datatype(sorted(spans), total * oldtype.extent, 0, oldtype.basic,
-                    f"subarray{tuple(subsizes)}")
+    return _env(
+        Datatype(sorted(spans), total * oldtype.extent, 0, oldtype.basic,
+                 f"subarray{tuple(subsizes)}"),
+        "subarray", [ndim] + orig[0] + orig[1] + orig[2]
+        + [0 if order == "C" else 1], [], [oldtype])
 
 
 def create_resized(oldtype: Datatype, lb: int, extent: int) -> Datatype:
-    return Datatype(list(oldtype.spans), extent, lb, oldtype.basic,
-                    f"resized({oldtype.name})")
+    return _env(
+        Datatype(list(oldtype.spans), extent, lb, oldtype.basic,
+                 f"resized({oldtype.name})"),
+        "resized", [], [lb, extent], [oldtype])
 
 
 def _extent_of(spans: Sequence[Span], oldtype: Datatype) -> int:

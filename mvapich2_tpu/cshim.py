@@ -1005,6 +1005,19 @@ def type_size(code: int) -> int:
     return _dt(code).size
 
 
+_COMBINERS = {"named": 0, "contiguous": 1, "vector": 2, "hvector": 3,
+              "indexed": 4, "hindexed": 5, "struct": 6, "subarray": 7,
+              "resized": 8, "indexed_block": 9, "dup": 10}
+
+
+def type_get_envelope(code: int):
+    """Returns (combiner_code, num_ints, num_aints, num_types) — the
+    MPI_Type_get_envelope counts."""
+    env = _dt(code).get_envelope()
+    return (_COMBINERS.get(env[0], 0), len(env[1]), len(env[2]),
+            len(env[3]))
+
+
 def type_extent(code: int):
     """Returns (lb, extent) in bytes."""
     d = _dt(code)

@@ -97,7 +97,8 @@ class Win:
         self.win_id = win_id
         self.name = f"win{win_id}"
         self.info: Dict[str, str] = {}
-        self.attrs: Dict[int, object] = {}
+        from ..core.attr import AttrCache
+        self.attrs = AttrCache()          # keyval attribute cache
         self.freed = False
         # dynamic windows: address -> attached array
         self._attached: Dict[int, np.ndarray] = {}
@@ -487,6 +488,7 @@ class Win:
         return dict(self.info)
 
     def free(self) -> None:
+        self.attrs.delete_all(self)
         if self.freed:
             return
         self.comm.barrier()

@@ -1299,6 +1299,32 @@ int MPI_Type_get_extent(MPI_Datatype datatype, MPI_Aint *lb,
     return MPI_SUCCESS;
 }
 
+int MPI_Type_get_envelope(MPI_Datatype datatype, int *num_integers,
+                          int *num_addresses, int *num_datatypes,
+                          int *combiner) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "type_get_envelope",
+                                        "(i)", datatype);
+    int rc = MPI_ERR_TYPE;
+    if (res) {
+        int comb = 0, ni = 0, na = 0, nt = 0;
+        if (PyArg_ParseTuple(res, "iiii", &comb, &ni, &na, &nt)) {
+            *combiner = comb;
+            *num_integers = ni;
+            *num_addresses = na;
+            *num_datatypes = nt;
+            rc = MPI_SUCCESS;
+        } else {
+            PyErr_Print();
+        }
+        Py_DECREF(res);
+    } else {
+        PyErr_Print();
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
 /* ---- comm/group extras ----------------------------------------------- */
 
 int MPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int *result) {

@@ -278,6 +278,22 @@ int MPI_Type_free(MPI_Datatype *datatype);
 int MPI_Type_size(MPI_Datatype datatype, int *size);
 int MPI_Type_get_extent(MPI_Datatype datatype, MPI_Aint *lb,
                         MPI_Aint *extent);
+int MPI_Type_get_envelope(MPI_Datatype datatype, int *num_integers,
+                          int *num_addresses, int *num_datatypes,
+                          int *combiner);
+
+/* combiner codes (MPI_Type_get_envelope) */
+#define MPI_COMBINER_NAMED      0
+#define MPI_COMBINER_CONTIGUOUS 1
+#define MPI_COMBINER_VECTOR     2
+#define MPI_COMBINER_HVECTOR    3
+#define MPI_COMBINER_INDEXED    4
+#define MPI_COMBINER_HINDEXED   5
+#define MPI_COMBINER_STRUCT     6
+#define MPI_COMBINER_SUBARRAY   7
+#define MPI_COMBINER_RESIZED    8
+#define MPI_COMBINER_INDEXED_BLOCK 9
+#define MPI_COMBINER_DUP        10
 
 /* ---- comm/group extras ---- */
 int MPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int *result);

@@ -1,0 +1,52 @@
+"""Attributes on windows and datatypes + datatype envelope introspection
+(ref: attr/fkeyval{win,type}, datatype/contents)."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import mtest
+from mvapich2_tpu.core import datatype as dt
+from mvapich2_tpu.core.attr import Keyval
+
+comm = mtest.init()
+r, s = comm.rank, comm.size
+
+# window attributes with a delete callback fired at free
+deleted = []
+kv = Keyval(delete_fn=lambda obj, k, val, extra: deleted.append(val))
+buf = np.zeros(4, np.float64)
+win = comm.win_create(buf, disp_unit=8)
+win.attrs.set(win, kv, 5 + r)
+found, val = win.attrs.get(kv)
+mtest.check(found and val == 5 + r, "win attr set/get")
+win.free()
+mtest.check_eq(deleted, [5 + r], "win attr delete_fn at free")
+
+# datatype attributes
+vec = dt.create_vector(3, 1, 2, dt.DOUBLE).commit()
+kv2 = Keyval()
+vec.attrs.set(vec, kv2, "tagged")
+found, val = vec.attrs.get(kv2)
+mtest.check(found and val == "tagged", "type attr set/get")
+
+# envelope introspection: constructor call reconstructable
+comb, ints, aints, types = vec.get_envelope()
+mtest.check_eq(comb, "vector", "vector combiner")
+mtest.check_eq(ints, [3, 1, 2], "vector ints")
+mtest.check_eq(types[0].name, "MPI_DOUBLE", "vector oldtype")
+
+sub = dt.create_subarray([4, 6], [2, 3], [1, 2], dt.INT)
+comb, ints, _, _ = sub.get_envelope()
+mtest.check_eq(comb, "subarray", "subarray combiner")
+mtest.check_eq(ints, [2, 4, 6, 2, 3, 1, 2, 0],
+               "subarray ints (orig order + order flag)")
+
+st_dt = dt.create_struct([1, 2], [0, 8], [dt.INT, dt.DOUBLE])
+comb, ints, aints, types = st_dt.get_envelope()
+mtest.check_eq(comb, "struct", "struct combiner")
+mtest.check_eq(aints, [0, 8], "struct displacements")
+mtest.check_eq(len(types), 2, "struct types")
+
+mtest.check_eq(dt.DOUBLE.get_envelope()[0], "named", "basic = named")
+
+mtest.finalize()
