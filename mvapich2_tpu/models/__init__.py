@@ -1,1 +1,1 @@
-from . import ring_attention, stencil, transformer, ulysses
+from . import flash, ring_attention, stencil, transformer, ulysses

@@ -1,0 +1,138 @@
+"""Blockwise flash attention — the pallas kernel for the attention hot op.
+
+Ring attention (models/ring_attention.py) streams KV blocks over the ICI
+ring and accumulates each block's contribution in flash (streaming
+softmax) form; THIS module is the on-chip half of that design done as a
+hand-scheduled pallas kernel: Q tiles stay VMEM-resident while the
+kernel walks K/V tiles, keeping the running (max, numerator, denominator)
+in scratch — attention never materializes the [T, T] score matrix in HBM.
+The kernel is the single-shard building block: ring/Ulysses provide the
+cross-shard movement, flash provides the per-shard FLOPs on the MXU.
+
+Positions are parametrized by global offsets (q0, k0) so the SAME kernel
+computes a ring step's block: shard i's queries live at q0 = i*T, the
+circulating KV block at k0 = j*T.
+
+VMEM budget: per (head, q-tile) grid step the kernel holds one
+[Bq, D] Q tile, the full [Tk, D] K and V for that head, and [Bq, D]+2
+accumulators — fine for the per-shard sequence lengths ring attention
+produces (the whole point of sequence parallelism is that Tk/shard is
+modest). Interpret mode runs the identical kernel on the CPU mesh in CI.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAVE_PALLAS = True
+except ImportError:  # pragma: no cover
+    HAVE_PALLAS = False
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(Bk, causal, q0, k0, q_ref, k_ref, v_ref, o_ref):
+    """Grid step = (head, q-tile): stream K/V tiles of this head.
+
+    q_ref [1, Bq, D]; k_ref/v_ref [1, Tk, D]; o_ref [1, Bq, D] (the
+    leading 1 is the head-block dimension). q0/k0 are static global
+    position offsets (the ring-step parametrization)."""
+    _, Bq, D = q_ref.shape
+    Tk = k_ref.shape[1]
+    qi = pl.program_id(1)
+    scale = D ** -0.5
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    q_pos = q0 + qi * Bq + jax.lax.broadcasted_iota(
+        jnp.int32, (Bq, Bk), 0)
+
+    def step(kt, carry):
+        m_acc, num_acc, den_acc = carry
+        k = k_ref[0, pl.ds(kt * Bk, Bk), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kt * Bk, Bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = k0 + kt * Bk + jax.lax.broadcasted_iota(
+                jnp.int32, (Bq, Bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_blk = jnp.max(s, axis=1)
+        new_m = jnp.maximum(m_acc, m_blk)
+        # guard fully-masked rows: keep them at NEG_INF with zero weight
+        safe_m = jnp.where(new_m > NEG_INF / 2, new_m, 0.0)
+        p = jnp.exp(s - safe_m[:, None])
+        p = jnp.where(s > NEG_INF / 2, p, 0.0)
+        alpha = jnp.where(m_acc > NEG_INF / 2,
+                          jnp.exp(m_acc - safe_m), 0.0)
+        num_acc = num_acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        den_acc = den_acc * alpha + jnp.sum(p, axis=1)
+        return new_m, num_acc, den_acc
+
+    m0 = jnp.full((Bq,), NEG_INF, jnp.float32)
+    num0 = jnp.zeros((Bq, D), jnp.float32)
+    den0 = jnp.zeros((Bq,), jnp.float32)
+    nk = Tk // Bk
+    if causal:
+        # skip K tiles entirely above the diagonal: this q tile's last
+        # query position is q0 + (qi+1)*Bq - 1, so only tiles whose
+        # first key position <= that can contribute (halves the MXU
+        # work of causal self-attention; fully-masked rows stay 0 via
+        # the den guard)
+        last_q = q0 + (qi + 1) * Bq - 1
+        nk_eff = jnp.clip((last_q - k0) // Bk + 1, 0, nk)
+    else:
+        nk_eff = nk
+    m_f, num_f, den_f = jax.lax.fori_loop(0, nk_eff, step,
+                                          (m0, num0, den0))
+    den_f = jnp.maximum(den_f, 1e-20)
+    o_ref[0] = (num_f / den_f[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal: bool = True, q0: int = 0,
+                    k0: int = 0, block_q: int = 128, block_k: int = 128,
+                    *, interpret: bool = False):
+    """Fused attention over one device's data. q [T, H, D],
+    k/v [Tk, H, D] -> [T, H, D]; q0/k0 are the global position offsets
+    (ring-step parametrization). Accumulates in f32.
+
+    Block sizes shrink automatically (gcd) when T/Tk aren't multiples
+    of the requested blocks, so any shape the jnp path accepts works.
+    """
+    if not HAVE_PALLAS:
+        raise RuntimeError("pallas unavailable")
+    import math
+    T, H, D = q.shape
+    Tk = k.shape[0]
+    # largest divisor of T (Tk) not exceeding the requested block size —
+    # non-power-of-two sequence lengths shrink the tile instead of
+    # erroring (the jnp path accepts any shape; this one must too)
+    bq = math.gcd(T, block_q) if T % min(block_q, T) else min(block_q, T)
+    bk = math.gcd(Tk, block_k) if Tk % min(block_k, Tk) \
+        else min(block_k, Tk)
+    # [T, H, D] -> [H, T, D] so the head is a grid dimension
+    qh = jnp.swapaxes(q, 0, 1)
+    kh = jnp.swapaxes(k, 0, 1)
+    vh = jnp.swapaxes(v, 0, 1)
+
+    kern = functools.partial(_flash_kernel, bk, causal, int(q0), int(k0))
+    out = pl.pallas_call(
+        kern,
+        grid=(H, T // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((1, Tk, D), lambda h, i: (h, 0, 0)),
+            pl.BlockSpec((1, Tk, D), lambda h, i: (h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda h, i: (h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        interpret=interpret,
+    )(qh, kh, vh)
+    return jnp.swapaxes(out, 0, 1)

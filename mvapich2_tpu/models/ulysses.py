@@ -27,6 +27,7 @@ from __future__ import annotations
 from jax import lax
 
 from ..ops import all_to_all
+from .flash import flash_attention
 from .ring_attention import local_attention_reference
 
 
@@ -40,7 +41,9 @@ def _heads_to_seq(x, axis: str):
     return all_to_all(x, axis, split_axis=0, concat_axis=1)
 
 
-def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
+                      use_flash: bool = False, interpret: bool = False,
+                      block_q: int = 128, block_k: int = 128):
     """Sequence-parallel attention via head/sequence all-to-all reshard.
 
     q/k/v: [T/p, H, Dh] — this shard's sequence block of every head
@@ -59,5 +62,12 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
     qh = _seq_to_heads(q, axis_name)     # [T, H/p, Dh]
     kh = _seq_to_heads(k, axis_name)
     vh = _seq_to_heads(v, axis_name)
-    oh = local_attention_reference(qh, kh, vh, causal=causal)
+    if use_flash:
+        # the pallas hot-op kernel (models/flash.py): blockwise fused
+        # attention, never materializing [T, T] scores in HBM
+        oh = flash_attention(qh, kh, vh, causal=causal,
+                             block_q=block_q, block_k=block_k,
+                             interpret=interpret)
+    else:
+        oh = local_attention_reference(qh, kh, vh, causal=causal)
     return _heads_to_seq(oh, axis_name).astype(q.dtype)  # [T/p, H, Dh]
