@@ -34,20 +34,16 @@ try:
 except ImportError:  # pragma: no cover
     HAVE_PALLAS = False
 
-NEG_INF = -1e30
+from .ring_attention import NEG_INF
 
 
-def _flash_kernel(Bk, causal, q0, k0, q_ref, k_ref, v_ref, o_ref):
-    """Grid step = (head, q-tile): stream K/V tiles of this head.
-
-    q_ref [1, Bq, D]; k_ref/v_ref [1, Tk, D]; o_ref [1, Bq, D] (the
-    leading 1 is the head-block dimension). q0/k0 are static global
-    position offsets (the ring-step parametrization)."""
+def _stream_blocks(Bk, causal, q0, k0, qi, q_ref, k_ref, v_ref):
+    """The shared streaming-softmax core: walk K/V tiles of this head,
+    carrying (max, numerator, denominator). q0/k0 are static global
+    position offsets; refs are [1, ., D] head blocks."""
     _, Bq, D = q_ref.shape
     Tk = k_ref.shape[1]
-    qi = pl.program_id(1)
     scale = D ** -0.5
-
     q = q_ref[0].astype(jnp.float32) * scale
     q_pos = q0 + qi * Bq + jax.lax.broadcasted_iota(
         jnp.int32, (Bq, Bk), 0)
@@ -81,19 +77,45 @@ def _flash_kernel(Bk, causal, q0, k0, q_ref, k_ref, v_ref, o_ref):
     den0 = jnp.zeros((Bq,), jnp.float32)
     nk = Tk // Bk
     if causal:
-        # skip K tiles entirely above the diagonal: this q tile's last
-        # query position is q0 + (qi+1)*Bq - 1, so only tiles whose
-        # first key position <= that can contribute (halves the MXU
-        # work of causal self-attention; fully-masked rows stay 0 via
-        # the den guard)
+        # skip K tiles entirely above the diagonal: only tiles whose
+        # first key position <= this q tile's last query position can
+        # contribute (halves the MXU work of causal self-attention)
         last_q = q0 + (qi + 1) * Bq - 1
         nk_eff = jnp.clip((last_q - k0) // Bk + 1, 0, nk)
     else:
         nk_eff = nk
-    m_f, num_f, den_f = jax.lax.fori_loop(0, nk_eff, step,
-                                          (m0, num0, den0))
+    return jax.lax.fori_loop(0, nk_eff, step, (m0, num0, den0))
+
+
+def _flash_kernel(Bk, causal, q0, k0, q_ref, k_ref, v_ref, o_ref):
+    """Grid step = (head, q-tile): normalized attention output."""
+    m_f, num_f, den_f = _stream_blocks(Bk, causal, q0, k0,
+                                       pl.program_id(1), q_ref, k_ref,
+                                       v_ref)
     den_f = jnp.maximum(den_f, 1e-20)
     o_ref[0] = (num_f / den_f[:, None]).astype(o_ref.dtype)
+
+
+def _flash_parts_kernel(Bk, causal, q_ref, k_ref, v_ref, m_ref, num_ref,
+                        den_ref):
+    """Grid step = (head, q-tile): unnormalized streaming parts (block-
+    local positions) for the ring-step merge."""
+    m_f, num_f, den_f = _stream_blocks(Bk, causal, 0, 0,
+                                       pl.program_id(1), q_ref, k_ref,
+                                       v_ref)
+    m_ref[0] = m_f
+    num_ref[0] = num_f
+    den_ref[0] = den_f
+
+
+def _block_sizes(T, Tk, block_q, block_k):
+    """Largest divisors of T/Tk not exceeding the requested blocks —
+    non-power-of-two lengths shrink the tile instead of erroring."""
+    import math
+    bq = math.gcd(T, block_q) if T % min(block_q, T) else min(block_q, T)
+    bk = math.gcd(Tk, block_k) if Tk % min(block_k, Tk) \
+        else min(block_k, Tk)
+    return bq, bk
 
 
 def flash_attention(q, k, v, causal: bool = True, q0: int = 0,
@@ -108,15 +130,9 @@ def flash_attention(q, k, v, causal: bool = True, q0: int = 0,
     """
     if not HAVE_PALLAS:
         raise RuntimeError("pallas unavailable")
-    import math
     T, H, D = q.shape
     Tk = k.shape[0]
-    # largest divisor of T (Tk) not exceeding the requested block size —
-    # non-power-of-two sequence lengths shrink the tile instead of
-    # erroring (the jnp path accepts any shape; this one must too)
-    bq = math.gcd(T, block_q) if T % min(block_q, T) else min(block_q, T)
-    bk = math.gcd(Tk, block_k) if Tk % min(block_k, Tk) \
-        else min(block_k, Tk)
+    bq, bk = _block_sizes(T, Tk, block_q, block_k)
     # [T, H, D] -> [H, T, D] so the head is a grid dimension
     qh = jnp.swapaxes(q, 0, 1)
     kh = jnp.swapaxes(k, 0, 1)
@@ -136,3 +152,42 @@ def flash_attention(q, k, v, causal: bool = True, q0: int = 0,
         interpret=interpret,
     )(qh, kh, vh)
     return jnp.swapaxes(out, 0, 1)
+
+
+def flash_attention_parts(q, k, v, causal: bool, block_q: int = 128,
+                          block_k: int = 128, *,
+                          interpret: bool = False):
+    """Streaming-softmax parts of one KV block's attention:
+    (m [H, T], num [T, H, D], den [H, T]) in the layout ring_attention's
+    merge expects. causal=True masks block-locally (the diagonal ring
+    step); past blocks use causal=False."""
+    if not HAVE_PALLAS:
+        raise RuntimeError("pallas unavailable")
+    T, H, D = q.shape
+    Tk = k.shape[0]
+    bq, bk = _block_sizes(T, Tk, block_q, block_k)
+    qh = jnp.swapaxes(q, 0, 1)
+    kh = jnp.swapaxes(k, 0, 1)
+    vh = jnp.swapaxes(v, 0, 1)
+    kern = functools.partial(_flash_parts_kernel, bk, causal)
+    m, num, den = pl.pallas_call(
+        kern,
+        grid=(H, T // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((1, Tk, D), lambda h, i: (h, 0, 0)),
+            pl.BlockSpec((1, Tk, D), lambda h, i: (h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq), lambda h, i: (h, i)),
+            pl.BlockSpec((1, bq, D), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((1, bq), lambda h, i: (h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((H, T), jnp.float32),
+            jax.ShapeDtypeStruct((H, T, D), jnp.float32),
+            jax.ShapeDtypeStruct((H, T), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qh, kh, vh)
+    return m, jnp.swapaxes(num, 0, 1), den

@@ -105,3 +105,69 @@ def local_attention_reference(q, k, v, causal: bool = True,
     w = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("htk,khd->thd", w,
                       v.astype(jnp.float32)).astype(q.dtype)
+
+
+def ring_attention_flash(q, k, v, axis_name: str, causal: bool = True,
+                         block_q: int = 128, block_k: int = 128,
+                         interpret: bool = False):
+    """Ring attention with the pallas flash kernel as the per-step
+    compute: KV movement stays lax.ppermute (XLA/ICI), each block's
+    (max, numerator, denominator) parts come from models/flash.py, and
+    the streaming merge is the same rescaling as ring_attention.
+
+    The block's causal relationship is decided per ring step at block
+    granularity — the circulating block originated at shard
+    j = (i - s) mod p, so it is entirely in this shard's past (j < i:
+    unmasked), the diagonal (j == i: block-local causal mask), or
+    entirely in the future (j > i: skipped) — the blockwise-causal
+    structure ring attention is built on. lax.switch executes exactly
+    one kernel per step.
+    """
+    from .flash import flash_attention_parts
+
+    p = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    T, H, Dh = q.shape
+
+    def parts_causal(kk, vv):
+        return flash_attention_parts(q, kk, vv, True, block_q, block_k,
+                                     interpret=interpret)
+
+    def parts_past(kk, vv):
+        return flash_attention_parts(q, kk, vv, False, block_q, block_k,
+                                     interpret=interpret)
+
+    def parts_future(kk, vv):
+        return (jnp.full((H, T), NEG_INF, jnp.float32),
+                jnp.zeros((T, H, Dh), jnp.float32),
+                jnp.zeros((H, T), jnp.float32))
+
+    def step(carry, s):
+        kk, vv, m_acc, num_acc, den_acc = carry
+        j = lax.rem(my - s + p, p)        # origin shard of this block
+        if causal:
+            case = jnp.where(j == my, 1, jnp.where(j < my, 2, 0))
+            m_blk, num_blk, den_blk = lax.switch(
+                case, [parts_future, parts_causal, parts_past], kk, vv)
+        else:
+            m_blk, num_blk, den_blk = parts_past(kk, vv)
+        new_m = jnp.maximum(m_acc, m_blk)
+        safe = jnp.where(new_m > NEG_INF / 2, new_m, 0.0)
+        alpha = jnp.where(m_acc > NEG_INF / 2,
+                          jnp.exp(m_acc - safe), 0.0)
+        beta = jnp.where(m_blk > NEG_INF / 2,
+                         jnp.exp(m_blk - safe), 0.0)
+        num_acc = (num_acc * alpha.T[..., None]
+                   + num_blk * beta.T[..., None])
+        den_acc = den_acc * alpha + den_blk * beta
+        kk = ring_shift(kk, axis_name, 1)
+        vv = ring_shift(vv, axis_name, 1)
+        return (kk, vv, new_m, num_acc, den_acc), None
+
+    m0 = jnp.full((H, T), NEG_INF, jnp.float32)
+    num0 = jnp.zeros((T, H, Dh), jnp.float32)
+    den0 = jnp.zeros((H, T), jnp.float32)
+    (_, _, m_acc, num_acc, den_acc), _ = lax.scan(
+        step, (k, v, m0, num0, den0), jnp.arange(p))
+    den_acc = jnp.maximum(den_acc, 1e-20)
+    return (num_acc / den_acc.T[..., None]).astype(q.dtype)

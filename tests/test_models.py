@@ -195,3 +195,24 @@ def test_ulysses_with_flash_kernel():
     want = ra.local_attention_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want),
                                rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_flash_matches_dense(causal):
+    """Ring attention with the pallas flash per-step kernel (interpret
+    mode) is exact against dense attention over the full sequence."""
+    comm = MeshComm(make_mesh((8,), ("sp",)))
+    T, H, Dh = 128, 2, 32
+    rng = np.random.default_rng(11)
+    q, k, v = (jnp.asarray(rng.standard_normal((T, H, Dh)),
+                           dtype=jnp.float32) for _ in range(3))
+
+    def run(qs, ks, vs):
+        return ra.ring_attention_flash(qs, ks, vs, "sp", causal=causal,
+                                       block_q=16, block_k=16,
+                                       interpret=True)
+
+    out = comm.run(run, q, k, v)
+    want = ra.local_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
