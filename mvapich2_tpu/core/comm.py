@@ -475,6 +475,51 @@ class Comm:
             return None
         return Comm(self.u, group, ctx, self.name + "_create", self)
 
+    def create_group(self, group: Group, tag: int = 0) -> Optional["Comm"]:
+        """MPI_Comm_create_group: collective only over ``group``'s members
+        (MPI-3.1 §6.4.2) — non-members return immediately with None.
+        Context agreement runs a binomial max-reduce+bcast over the group
+        members using parent pt2pt with ``tag`` (the standard's contract:
+        the tag namespace of the parent carries the internal traffic).
+        Disjoint groups may agree on equal ctx ids concurrently; matching
+        keys are (ctx, src, tag) and member sets are disjoint, so the
+        namespaces cannot collide."""
+        self._check()
+        me = group.rank_of_world(self.u.world_rank)
+        if me == UNDEFINED:
+            return None
+        m = group.size
+        parent_of = {g: self.group.rank_of_world(group.world_of_rank(g))
+                     for g in range(m)}
+        val = np.array([self.u._next_ctx], dtype=np.int64)
+        other = np.empty(1, dtype=np.int64)
+        # binomial reduce (max) to group rank 0
+        mask = 1
+        while mask < m:
+            if me & mask:
+                self.send(val, parent_of[me & ~mask], tag)
+                break
+            partner = me | mask
+            if partner < m:
+                self.recv(other, parent_of[partner], tag)
+                val[0] = max(val[0], other[0])
+            mask <<= 1
+        # binomial bcast of the agreed ctx from group rank 0
+        mask = 1
+        while mask < m:
+            if me & mask:
+                self.recv(val, parent_of[me - mask], tag)
+                break
+            mask <<= 1
+        mask >>= 1
+        while mask > 0:
+            if me + mask < m:
+                self.send(val, parent_of[me + mask], tag)
+            mask >>= 1
+        ctx = int(val[0])
+        self.u._next_ctx = max(self.u._next_ctx, ctx + 2)
+        return Comm(self.u, group, ctx, self.name + "_create_group", self)
+
     def split(self, color: int, key: int = 0) -> Optional["Comm"]:
         self._check()
         # allgather (color, key, world_rank) triples, then bucket

@@ -1078,6 +1078,315 @@ def win_sync(wh: int) -> int:
 
 
 # ---------------------------------------------------------------------------
+# info objects (MPI_Info_*)
+# ---------------------------------------------------------------------------
+
+_infos: Dict[int, object] = {}
+_next_info = 1
+
+
+def info_create() -> int:
+    global _next_info
+    from .core.info import Info
+    with _lock:
+        h = _next_info
+        _next_info += 1
+        _infos[h] = Info()
+    return h
+
+
+def info_free(ih: int) -> int:
+    _infos.pop(ih, None)
+    return 0
+
+
+def info_set(ih: int, key: str, value: str) -> int:
+    _infos[ih].set(key, value)
+    return 0
+
+
+def info_get(ih: int, key: str):
+    """None when unset (C side turns that into flag=0)."""
+    return _infos[ih].get(key)
+
+
+def info_delete(ih: int, key: str) -> int:
+    _infos[ih].delete(key)
+    return 0
+
+
+def info_dup(ih: int) -> int:
+    global _next_info
+    with _lock:
+        h = _next_info
+        _next_info += 1
+        _infos[h] = _infos[ih].dup()
+    return h
+
+
+def info_nkeys(ih: int) -> int:
+    return _infos[ih].nkeys
+
+
+def info_nthkey(ih: int, n: int) -> str:
+    return _infos[ih].nthkey(n)
+
+
+# ---------------------------------------------------------------------------
+# communicator extras: names, create_group, split_type, intercomms
+# ---------------------------------------------------------------------------
+
+def _new_comm_handle(c) -> int:
+    global _next_comm
+    with _lock:
+        h = _next_comm
+        _next_comm += 1
+        _comms[h] = c
+    return h
+
+
+_named_comms: set = set()
+
+
+def comm_set_name(ch: int, name: str) -> int:
+    _comm(ch).set_name(name)
+    _named_comms.add(ch)
+    return 0
+
+
+def comm_get_name(ch: int) -> str:
+    """Predefined comms have their standard names; user comms are
+    unnamed ("" per MPI-3.1 §6.8) until MPI_Comm_set_name — the
+    internal synthesized names (comm.name) are not exposed here."""
+    if ch in _named_comms:
+        return _comm(ch).get_name()
+    if ch == 0:
+        return "MPI_COMM_WORLD"
+    if ch == 1:
+        return "MPI_COMM_SELF"
+    return ""
+
+
+def comm_create_group(ch: int, gh: int, tag: int) -> int:
+    c = _comm(ch).create_group(_groups[gh], tag)
+    if c is None:
+        return -1
+    return _new_comm_handle(c)
+
+
+def comm_split_type(ch: int, split_type: int, key: int) -> int:
+    if split_type == -32766:      # MPI_UNDEFINED
+        return -1
+    if split_type != 0:           # only MPI_COMM_TYPE_SHARED is defined
+        from .core.errors import MPI_ERR_ARG
+        raise MPIException(MPI_ERR_ARG,
+                           f"unknown split_type {split_type}")
+    c = _comm(ch).split_type_shared(key)
+    return _new_comm_handle(c)
+
+
+def comm_test_inter(ch: int) -> int:
+    from .core.intercomm import Intercomm
+    return 1 if isinstance(_comm(ch), Intercomm) else 0
+
+
+def comm_remote_size(ch: int) -> int:
+    return _comm(ch).remote_size
+
+
+def intercomm_create(local_ch: int, local_leader: int, peer_ch: int,
+                     remote_leader: int, tag: int) -> int:
+    from .core.intercomm import intercomm_create as icreate
+    # peer_comm is significant only at the leaders (MPI-3.1 §6.6.2):
+    # non-leaders legally pass MPI_COMM_NULL (-1)
+    peer = _comm(peer_ch) if peer_ch >= 0 else None
+    ic = icreate(_comm(local_ch), local_leader, peer, remote_leader, tag)
+    return _new_comm_handle(ic)
+
+
+def intercomm_merge(ch: int, high: int) -> int:
+    c = _comm(ch).merge(bool(high))
+    return _new_comm_handle(c)
+
+
+# ---------------------------------------------------------------------------
+# group set operations
+# ---------------------------------------------------------------------------
+
+def _new_group_handle(g) -> int:
+    global _next_group
+    with _lock:
+        h = _next_group
+        _next_group += 1
+        _groups[h] = g
+    return h
+
+
+def group_range_incl(gh: int, ranges) -> int:
+    return _new_group_handle(
+        _groups[gh].range_incl([tuple(r) for r in ranges]))
+
+
+def group_range_excl(gh: int, ranges) -> int:
+    return _new_group_handle(
+        _groups[gh].range_excl([tuple(r) for r in ranges]))
+
+
+def group_union(gh1: int, gh2: int) -> int:
+    return _new_group_handle(_groups[gh1].union(_groups[gh2]))
+
+
+def group_intersection(gh1: int, gh2: int) -> int:
+    return _new_group_handle(_groups[gh1].intersection(_groups[gh2]))
+
+
+def group_difference(gh1: int, gh2: int) -> int:
+    return _new_group_handle(_groups[gh1].difference(_groups[gh2]))
+
+
+_COMPARE = {"ident": 0, "congruent": 1, "similar": 2, "unequal": 3}
+
+
+def group_compare(gh1: int, gh2: int) -> int:
+    return _COMPARE[_groups[gh1].compare(_groups[gh2])]
+
+
+def comm_remote_group(ch: int) -> int:
+    return _new_group_handle(_comm(ch).remote_group)
+
+
+# ---------------------------------------------------------------------------
+# datatype extras
+# ---------------------------------------------------------------------------
+
+def type_dup(code: int) -> int:
+    d = _dt(code)
+    return _new_derived(d.dup() if hasattr(d, "dup") else d)
+
+
+def type_indexed_block(blocklength: int, displacements, oldcode: int) -> int:
+    return _new_derived(dt.create_indexed_block(
+        blocklength, list(displacements), _dt(oldcode)))
+
+
+def type_hindexed(blocklengths, disp_bytes, oldcode: int) -> int:
+    d = dt.create_hindexed(list(blocklengths), list(disp_bytes),
+                           _dt(oldcode))
+    return _new_derived(d)
+
+
+def type_true_extent(code: int):
+    """(true_lb, true_extent): tightest byte span actually touched."""
+    if code < _DERIVED_BASE:
+        sz = _DTYPES[code].itemsize
+        return (0, sz)
+    d = _dt(code)
+    if not d.spans:
+        return (0, 0)
+    lo = min(off for off, _ in d.spans)
+    hi = max(off + ln for off, ln in d.spans)
+    return (lo, hi - lo)
+
+
+def pack(inview, incount: int, dtcode: int, outview, position: int) -> int:
+    """Returns the new position (bytes)."""
+    d = _dt(dtcode)
+    raw_in = np.frombuffer(inview, np.uint8)
+    raw_out = np.frombuffer(outview, np.uint8)
+    data = np.asarray(d.pack(raw_in, incount)).view(np.uint8).reshape(-1) \
+        if dtcode >= _DERIVED_BASE else \
+        raw_in[:incount * _DTYPES[dtcode].itemsize]
+    raw_out[position:position + data.size] = data
+    return position + data.size
+
+
+def unpack(inview, position: int, outview, outcount: int,
+           dtcode: int) -> int:
+    d = _dt(dtcode)
+    raw_in = np.frombuffer(inview, np.uint8)
+    raw_out = np.frombuffer(outview, np.uint8)
+    nbytes = _esz(dtcode) * outcount
+    if dtcode >= _DERIVED_BASE:
+        d.unpack(raw_in[position:position + nbytes], raw_out, outcount)
+    else:
+        raw_out[:nbytes] = raw_in[position:position + nbytes]
+    return position + nbytes
+
+
+def pack_size(incount: int, dtcode: int) -> int:
+    return incount * _esz(dtcode)
+
+
+# ---------------------------------------------------------------------------
+# nonblocking collectives (sched-based; request handles interop with
+# wait/test/waitall like pt2pt requests)
+# ---------------------------------------------------------------------------
+
+def _new_req(r) -> int:
+    global _next_req
+    with _lock:
+        h = _next_req
+        _next_req += 1
+        _reqs[h] = r
+    return h
+
+
+def ibarrier(ch: int) -> int:
+    return _new_req(_comm(ch).ibarrier())
+
+
+def ibcast(view, count: int, dtcode: int, root: int, ch: int) -> int:
+    buf = _arr(view, count, dtcode)
+    return _new_req(_comm(ch).ibcast(buf, root, count=count))
+
+
+def iallreduce(sview, rview, count: int, dtcode: int, opcode: int,
+               ch: int) -> int:
+    recv = _arr(rview, count, dtcode)
+    send = recv.copy() if sview is None else _arr(sview, count, dtcode)
+    return _new_req(_comm(ch).iallreduce(send, recv, op=_OPS[opcode]))
+
+
+def ireduce(sview, rview, count: int, dtcode: int, opcode: int, root: int,
+            ch: int) -> int:
+    from .coll import nonblocking as nb
+    c = _comm(ch)
+    if rview is None:
+        recv = np.empty(count, dtype=_DTYPES[dtcode])
+    else:
+        recv = _arr(rview, count, dtcode)
+    send = recv.copy() if sview is None else _arr(sview, count, dtcode)
+    return _new_req(nb.ireduce(c, send, recv, count, _dt(dtcode),
+                               _OPS[opcode], root))
+
+
+def iallgather(sview, rview, count: int, dtcode: int, ch: int) -> int:
+    from .coll import nonblocking as nb
+    c = _comm(ch)
+    recv = _arr(rview, count * c.size, dtcode)
+    send = recv[c.rank * count:(c.rank + 1) * count].copy() \
+        if sview is None else _arr(sview, count, dtcode)
+    return _new_req(nb.iallgather(c, send, recv, count, _dt(dtcode)))
+
+
+def ialltoall(sview, rview, count: int, dtcode: int, ch: int) -> int:
+    from .coll import nonblocking as nb
+    c = _comm(ch)
+    recv = _arr(rview, count * c.size, dtcode)
+    send = recv.copy() if sview is None \
+        else _arr(sview, count * c.size, dtcode)
+    return _new_req(nb.ialltoall(c, send, recv, count, _dt(dtcode)))
+
+
+def finalized() -> int:
+    return 1 if mpi.Finalized() else 0
+
+
+def query_thread() -> int:
+    return mpi._provided_level
+
+
+# ---------------------------------------------------------------------------
 # error translation
 # ---------------------------------------------------------------------------
 
@@ -1085,6 +1394,28 @@ def errclass(exc) -> int:
     if isinstance(exc, MPIException):
         return exc.error_class
     return 16   # MPI_ERR_OTHER
+
+
+def c_error_class(exc) -> int:
+    """Error class for a Python exception escaping to the C boundary.
+    MPI errors map through errclass; anything else is also logged (it
+    is a framework bug, not an erroneous-program error)."""
+    if not isinstance(exc, MPIException):
+        import sys
+        import traceback
+        print("libmpi: unexpected exception at the C boundary:",
+              file=sys.stderr)
+        traceback.print_exception(type(exc), exc, exc.__traceback__)
+    return errclass(exc)
+
+
+def type_basic_size(code: int) -> int:
+    """Bytes per basic element of a homogeneous derived type (0 when
+    heterogeneous — MPI_Get_elements falls back to packed size)."""
+    if code < _DERIVED_BASE:
+        return _DTYPES[code].itemsize
+    d = _derived[code]
+    return d.basic.itemsize if d.basic is not None else 0
 
 
 def error_string(klass: int) -> str:

@@ -19,20 +19,21 @@
 #include <time.h>
 
 #include "mpi.h"
+#include "libmpi_internal.h"
 
 #ifndef MV2T_REPO_ROOT
 #define MV2T_REPO_ROOT "."
 #endif
 
-static PyObject *g_shim = NULL;        /* mvapich2_tpu.cshim module */
+PyObject *g_shim = NULL;        /* mvapich2_tpu.cshim module */
 static int g_we_initialized_python = 0;
 
 static const int DT_SIZE[] = {1, 1, 4, 4, 8, 8, 8, 2, 1, 8, 4, 2, 16, 1};
 
-static long shim_call_v(const char *name, int *ok, const char *fmt, ...);
+long shim_call_v(const char *name, int *ok, const char *fmt, ...);
 
 /* size in bytes of one element; derived handles (>= 100) ask the shim */
-static int dt_size(MPI_Datatype dt) {
+int dt_size(MPI_Datatype dt) {
     if (dt >= 100) {
         int ok;
         long v = shim_call_v("type_size", &ok, "(i)", dt);
@@ -44,13 +45,13 @@ static int dt_size(MPI_Datatype dt) {
 }
 
 /* extent in bytes (buffer stride per element); == size for basics */
-static long dt_extent_b(MPI_Datatype dt);
+long dt_extent_b(MPI_Datatype dt);
 
 /* ------------------------------------------------------------------ */
 /* embedded interpreter plumbing                                       */
 /* ------------------------------------------------------------------ */
 
-static int ensure_python(void) {
+int ensure_python(void) {
     if (g_shim != NULL)
         return MPI_SUCCESS;
     if (!Py_IsInitialized()) {
@@ -83,7 +84,7 @@ static int ensure_python(void) {
  * Only for shim functions whose return value is a status (0), never for
  * value-returning ones — those use shim_call_v so a Python exception
  * cannot masquerade as a valid handle/rank. */
-static int shim_call_i(const char *name, const char *fmt, ...) {
+int shim_call_i(const char *name, const char *fmt, ...) {
     PyGILState_STATE st = PyGILState_Ensure();
     va_list ap;
     va_start(ap, fmt);
@@ -107,7 +108,7 @@ static int shim_call_i(const char *name, const char *fmt, ...) {
 
 /* call shim.<name>(fmt...) -> long value; *ok = 0 on Python exception
  * (value and error travel on separate channels). */
-static long shim_call_v(const char *name, int *ok, const char *fmt, ...) {
+long shim_call_v(const char *name, int *ok, const char *fmt, ...) {
     PyGILState_STATE st = PyGILState_Ensure();
     va_list ap;
     va_start(ap, fmt);
@@ -167,7 +168,7 @@ static int shim_call_status(const char *name, MPI_Status *status,
     return rc;
 }
 
-static PyObject *mv_view(const void *buf, long nbytes) {
+PyObject *mv_view(const void *buf, long nbytes) {
     if (buf == MPI_IN_PLACE || buf == NULL) {
         Py_RETURN_NONE;
     }
@@ -280,10 +281,17 @@ int MPI_Comm_dup(MPI_Comm comm, MPI_Comm *newcomm) {
         *newcomm = MPI_COMM_NULL;
         return MPI_ERR_COMM;
     }
+    int arc = mv2t_attr_copy_all(0, comm, *newcomm);  /* §6.7.2 */
+    if (arc != MPI_SUCCESS) {
+        shim_call_i("comm_free", "(i)", *newcomm);
+        *newcomm = MPI_COMM_NULL;
+        return arc;
+    }
     return MPI_SUCCESS;
 }
 
 int MPI_Comm_free(MPI_Comm *comm) {
+    mv2t_attr_delete_all(0, *comm);
     shim_call_i("comm_free", "(i)", *comm);
     *comm = MPI_COMM_NULL;
     return MPI_SUCCESS;
@@ -547,6 +555,9 @@ int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root,
 
 int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
                   MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
+    if (mv2t_is_userop(op))
+        return mv2t_userop_coll(0, sendbuf, recvbuf, count, dt, op, 0,
+                                comm);
     long nb = (long)count * dt_extent_b(dt);
     return coll2("allreduce", sendbuf, recvbuf, nb, nb, "(iiii)",
                  count, dt, op, comm);
@@ -554,6 +565,9 @@ int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
 
 int MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
                MPI_Datatype dt, MPI_Op op, int root, MPI_Comm comm) {
+    if (mv2t_is_userop(op))
+        return mv2t_userop_coll(1, sendbuf, recvbuf, count, dt, op, root,
+                                comm);
     long nb = (long)count * dt_extent_b(dt);
     return coll2("reduce", sendbuf, recvbuf, nb, nb, "(iiiii)",
                  count, dt, op, root, comm);
@@ -606,6 +620,9 @@ int MPI_Scatter(const void *sendbuf, int scount, MPI_Datatype sdt,
 int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
                              int rcount, MPI_Datatype dt, MPI_Op op,
                              MPI_Comm comm) {
+    if (mv2t_is_userop(op))
+        return mv2t_userop_coll(4, sendbuf, recvbuf, rcount, dt, op, 0,
+                                comm);
     int size;
     MPI_Comm_size(comm, &size);
     return coll2("reduce_scatter_block", sendbuf, recvbuf,
@@ -634,6 +651,7 @@ int MPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
             if (PyObject_GetBuffer(mv, &b, PyBUF_SIMPLE) == 0) {
                 *(void **)baseptr = b.buf;
                 PyBuffer_Release(&b);   /* numpy array owns the memory */
+                mv2t_win_record(h, *(void **)baseptr, size, disp_unit);
                 rc = MPI_SUCCESS;
             }
         }
@@ -655,6 +673,7 @@ int MPI_Win_create(void *base, MPI_Aint size, int disp_unit,
     int rc = MPI_ERR_OTHER;
     if (res) {
         *win = (MPI_Win)PyLong_AsLong(res);
+        mv2t_win_record(*win, base, size, disp_unit);
         rc = MPI_SUCCESS;
         Py_DECREF(res);
     } else {
@@ -696,6 +715,8 @@ int MPI_Win_detach(MPI_Win win, const void *base) {
 }
 
 int MPI_Win_free(MPI_Win *win) {
+    mv2t_attr_delete_all(1, *win);
+    mv2t_win_forget(*win);
     shim_call_i("win_free", "(i)", *win);
     *win = MPI_WIN_NULL;
     return MPI_SUCCESS;
@@ -756,7 +777,7 @@ int MPI_Win_wait(MPI_Win win) {
 /* derived datatypes, comm/group extras, errors, RMA atomics           */
 /* ------------------------------------------------------------------ */
 
-static long dt_extent_b(MPI_Datatype dt) {
+long dt_extent_b(MPI_Datatype dt) {
     if (dt >= 100) {
         PyGILState_STATE st = PyGILState_Ensure();
         long ext = 0;
@@ -1015,14 +1036,14 @@ int MPI_Buffer_detach(void *buffer_addr, int *size) {
 
 /* ---- v-collectives --------------------------------------------------- */
 
-static PyObject *int_list(const int *a, int n) {
+PyObject *int_list(const int *a, int n) {
     PyObject *l = PyList_New(n);
     for (int i = 0; i < n; i++)
         PyList_SET_ITEM(l, i, PyLong_FromLong(a ? a[i] : 0));
     return l;
 }
 
-static int comm_np(MPI_Comm comm) {
+int comm_np(MPI_Comm comm) {
     int n = 0;
     MPI_Comm_size(comm, &n);
     return n;
@@ -1165,11 +1186,17 @@ static int scanlike(const char *fn, const void *sendbuf, void *recvbuf,
 
 int MPI_Scan(const void *sendbuf, void *recvbuf, int count,
              MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
+    if (mv2t_is_userop(op))
+        return mv2t_userop_coll(2, sendbuf, recvbuf, count, dt, op, 0,
+                                comm);
     return scanlike("scan", sendbuf, recvbuf, count, dt, op, comm);
 }
 
 int MPI_Exscan(const void *sendbuf, void *recvbuf, int count,
                MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
+    if (mv2t_is_userop(op))
+        return mv2t_userop_coll(3, sendbuf, recvbuf, count, dt, op, 0,
+                                comm);
     return scanlike("exscan", sendbuf, recvbuf, count, dt, op, comm);
 }
 
@@ -1263,6 +1290,7 @@ int MPI_Type_commit(MPI_Datatype *datatype) {
 }
 
 int MPI_Type_free(MPI_Datatype *datatype) {
+    mv2t_attr_delete_all(2, *datatype);
     int rc = shim_call_i("type_free", "(i)", *datatype);
     *datatype = MPI_DATATYPE_NULL;
     return rc;
@@ -1344,8 +1372,9 @@ int MPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm) {
 }
 
 int MPI_Comm_test_inter(MPI_Comm comm, int *flag) {
-    (void)comm;
-    *flag = 0;      /* C-surface comms are intracommunicators */
+    int ok;
+    long v = shim_call_v("comm_test_inter", &ok, "(i)", comm);
+    *flag = ok ? (int)v : 0;
     return MPI_SUCCESS;
 }
 
@@ -1407,6 +1436,12 @@ int MPI_Group_translate_ranks(MPI_Group group1, int n, const int ranks1[],
 /* ---- errors ---------------------------------------------------------- */
 
 int MPI_Error_string(int errorcode, char *string, int *resultlen) {
+    const char *us = mv2t_user_error_string(errorcode);
+    if (us != NULL) {
+        snprintf(string, MPI_MAX_ERROR_STRING, "%s", us);
+        *resultlen = (int)strlen(string);
+        return MPI_SUCCESS;
+    }
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *res = PyObject_CallMethod(g_shim, "error_string", "(i)",
                                         errorcode);

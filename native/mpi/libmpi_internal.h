@@ -1,0 +1,34 @@
+/* libmpi_internal.h — helpers shared between libmpi.c (core surface)
+ * and libmpi_ext.c (tools/attrs/info/intercomm surface). Not installed;
+ * C programs include only mpi.h. */
+#ifndef MV2T_LIBMPI_INTERNAL_H
+#define MV2T_LIBMPI_INTERNAL_H
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include "mpi.h"
+
+extern PyObject *g_shim;               /* mvapich2_tpu.cshim module */
+
+int ensure_python(void);
+int shim_call_i(const char *name, const char *fmt, ...);
+long shim_call_v(const char *name, int *ok, const char *fmt, ...);
+PyObject *mv_view(const void *buf, long nbytes);
+int dt_size(MPI_Datatype dt);
+long dt_extent_b(MPI_Datatype dt);
+PyObject *int_list(const int *a, int n);
+int comm_np(MPI_Comm comm);
+
+/* hooks implemented in libmpi_ext.c (attribute machinery, user ops) */
+int mv2t_errcode_from_pyerr(void);
+int mv2t_attr_copy_all(int kind, int oldobj, int newobj);
+void mv2t_attr_delete_all(int kind, int obj);
+void mv2t_win_record(int win, void *base, MPI_Aint size, int disp_unit);
+void mv2t_win_forget(int win);
+int mv2t_is_userop(MPI_Op op);
+int mv2t_userop_coll(int kind, const void *sendbuf, void *recvbuf,
+                     int count, MPI_Datatype dt, MPI_Op op, int root,
+                     MPI_Comm comm);
+const char *mv2t_user_error_string(int errorcode);
+
+#endif /* MV2T_LIBMPI_INTERNAL_H */

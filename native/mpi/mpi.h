@@ -357,6 +357,234 @@ int MPI_Win_flush_all(MPI_Win win);
 int MPI_Win_flush_local_all(MPI_Win win);
 int MPI_Win_sync(MPI_Win win);
 
+/* ================================================================== */
+/* Extended surface (libmpi_ext.c): memory, info, names, intercomms,  */
+/* attributes/keyvals, user ops, packing, nonblocking collectives.    */
+/* ================================================================== */
+
+#define MPI_MAX_OBJECT_NAME            128
+#define MPI_MAX_INFO_KEY               255
+#define MPI_MAX_INFO_VAL              1024
+#define MPI_MAX_LIBRARY_VERSION_STRING 256
+#define MPI_MAX_PORT_NAME              256
+
+/* predefined attribute keyvals (comm) */
+#define MPI_TAG_UB          1
+#define MPI_HOST            2
+#define MPI_IO              3
+#define MPI_WTIME_IS_GLOBAL 4
+#define MPI_UNIVERSE_SIZE   5
+#define MPI_LASTUSEDCODE    6
+#define MPI_APPNUM          7
+/* predefined attribute keyvals (win) */
+#define MPI_WIN_BASE        8
+#define MPI_WIN_SIZE        9
+#define MPI_WIN_DISP_UNIT   10
+#define MPI_KEYVAL_INVALID  (-1)
+
+/* MPI_Comm_split_type */
+#define MPI_COMM_TYPE_SHARED 0
+
+/* attribute callback typedefs (comm/win/type share the int-handle ABI) */
+typedef int (MPI_Comm_copy_attr_function)(MPI_Comm, int, void *, void *,
+                                          void *, int *);
+typedef int (MPI_Comm_delete_attr_function)(MPI_Comm, int, void *, void *);
+typedef MPI_Comm_copy_attr_function MPI_Win_copy_attr_function;
+typedef MPI_Comm_delete_attr_function MPI_Win_delete_attr_function;
+typedef MPI_Comm_copy_attr_function MPI_Type_copy_attr_function;
+typedef MPI_Comm_delete_attr_function MPI_Type_delete_attr_function;
+/* deprecated MPI-1 names */
+typedef MPI_Comm_copy_attr_function MPI_Copy_function;
+typedef MPI_Comm_delete_attr_function MPI_Delete_function;
+
+/* no-op callbacks (functions in libmpi_ext.c, usable as values) */
+int MPI_NULL_COPY_FN_IMPL(MPI_Comm, int, void *, void *, void *, int *);
+int MPI_DUP_FN_IMPL(MPI_Comm, int, void *, void *, void *, int *);
+int MPI_NULL_DELETE_FN_IMPL(MPI_Comm, int, void *, void *);
+#define MPI_NULL_COPY_FN    MPI_NULL_COPY_FN_IMPL
+#define MPI_DUP_FN          MPI_DUP_FN_IMPL
+#define MPI_NULL_DELETE_FN  MPI_NULL_DELETE_FN_IMPL
+#define MPI_COMM_NULL_COPY_FN    MPI_NULL_COPY_FN_IMPL
+#define MPI_COMM_DUP_FN          MPI_DUP_FN_IMPL
+#define MPI_COMM_NULL_DELETE_FN  MPI_NULL_DELETE_FN_IMPL
+#define MPI_WIN_NULL_COPY_FN     MPI_NULL_COPY_FN_IMPL
+#define MPI_WIN_DUP_FN           MPI_DUP_FN_IMPL
+#define MPI_WIN_NULL_DELETE_FN   MPI_NULL_DELETE_FN_IMPL
+#define MPI_TYPE_NULL_COPY_FN    MPI_NULL_COPY_FN_IMPL
+#define MPI_TYPE_DUP_FN          MPI_DUP_FN_IMPL
+#define MPI_TYPE_NULL_DELETE_FN  MPI_NULL_DELETE_FN_IMPL
+
+/* user-defined reduction */
+typedef void (MPI_User_function)(void *invec, void *inoutvec, int *len,
+                                 MPI_Datatype *datatype);
+
+/* ---- memory ---- */
+int MPI_Alloc_mem(MPI_Aint size, MPI_Info info, void *baseptr);
+int MPI_Free_mem(void *base);
+
+/* ---- info ---- */
+int MPI_Info_create(MPI_Info *info);
+int MPI_Info_free(MPI_Info *info);
+int MPI_Info_set(MPI_Info info, const char *key, const char *value);
+int MPI_Info_get(MPI_Info info, const char *key, int valuelen, char *value,
+                 int *flag);
+int MPI_Info_delete(MPI_Info info, const char *key);
+int MPI_Info_dup(MPI_Info info, MPI_Info *newinfo);
+int MPI_Info_get_nkeys(MPI_Info info, int *nkeys);
+int MPI_Info_get_nthkey(MPI_Info info, int n, char *key);
+int MPI_Info_get_valuelen(MPI_Info info, const char *key, int *valuelen,
+                          int *flag);
+
+/* ---- communicator extras ---- */
+int MPI_Comm_set_name(MPI_Comm comm, const char *name);
+int MPI_Comm_get_name(MPI_Comm comm, char *name, int *resultlen);
+int MPI_Comm_create_group(MPI_Comm comm, MPI_Group group, int tag,
+                          MPI_Comm *newcomm);
+int MPI_Comm_split_type(MPI_Comm comm, int split_type, int key,
+                        MPI_Info info, MPI_Comm *newcomm);
+int MPI_Comm_remote_size(MPI_Comm comm, int *size);
+int MPI_Comm_remote_group(MPI_Comm comm, MPI_Group *group);
+int MPI_Intercomm_create(MPI_Comm local_comm, int local_leader,
+                         MPI_Comm peer_comm, int remote_leader, int tag,
+                         MPI_Comm *newintercomm);
+int MPI_Intercomm_merge(MPI_Comm intercomm, int high,
+                        MPI_Comm *newintracomm);
+
+/* ---- group set operations ---- */
+int MPI_Group_range_incl(MPI_Group group, int n, int ranges[][3],
+                         MPI_Group *newgroup);
+int MPI_Group_range_excl(MPI_Group group, int n, int ranges[][3],
+                         MPI_Group *newgroup);
+int MPI_Group_union(MPI_Group g1, MPI_Group g2, MPI_Group *newgroup);
+int MPI_Group_intersection(MPI_Group g1, MPI_Group g2,
+                           MPI_Group *newgroup);
+int MPI_Group_difference(MPI_Group g1, MPI_Group g2, MPI_Group *newgroup);
+int MPI_Group_compare(MPI_Group g1, MPI_Group g2, int *result);
+
+/* ---- attributes / keyvals ---- */
+int MPI_Comm_create_keyval(MPI_Comm_copy_attr_function *copy_fn,
+                           MPI_Comm_delete_attr_function *delete_fn,
+                           int *keyval, void *extra_state);
+int MPI_Comm_free_keyval(int *keyval);
+int MPI_Comm_set_attr(MPI_Comm comm, int keyval, void *attribute_val);
+int MPI_Comm_get_attr(MPI_Comm comm, int keyval, void *attribute_val,
+                      int *flag);
+int MPI_Comm_delete_attr(MPI_Comm comm, int keyval);
+int MPI_Win_create_keyval(MPI_Win_copy_attr_function *copy_fn,
+                          MPI_Win_delete_attr_function *delete_fn,
+                          int *keyval, void *extra_state);
+int MPI_Win_free_keyval(int *keyval);
+int MPI_Win_set_attr(MPI_Win win, int keyval, void *attribute_val);
+int MPI_Win_get_attr(MPI_Win win, int keyval, void *attribute_val,
+                     int *flag);
+int MPI_Win_delete_attr(MPI_Win win, int keyval);
+int MPI_Type_create_keyval(MPI_Type_copy_attr_function *copy_fn,
+                           MPI_Type_delete_attr_function *delete_fn,
+                           int *keyval, void *extra_state);
+int MPI_Type_free_keyval(int *keyval);
+int MPI_Type_set_attr(MPI_Datatype type, int keyval, void *attribute_val);
+int MPI_Type_get_attr(MPI_Datatype type, int keyval, void *attribute_val,
+                      int *flag);
+int MPI_Type_delete_attr(MPI_Datatype type, int keyval);
+/* deprecated MPI-1 attribute interface */
+int MPI_Keyval_create(MPI_Copy_function *copy_fn,
+                      MPI_Delete_function *delete_fn, int *keyval,
+                      void *extra_state);
+int MPI_Keyval_free(int *keyval);
+int MPI_Attr_put(MPI_Comm comm, int keyval, void *attribute_val);
+int MPI_Attr_get(MPI_Comm comm, int keyval, void *attribute_val,
+                 int *flag);
+int MPI_Attr_delete(MPI_Comm comm, int keyval);
+
+/* ---- user-defined ops ---- */
+int MPI_Op_create(MPI_User_function *user_fn, int commute, MPI_Op *op);
+int MPI_Op_free(MPI_Op *op);
+int MPI_Op_commutative(MPI_Op op, int *commute);
+
+/* ---- packing ---- */
+int MPI_Pack(const void *inbuf, int incount, MPI_Datatype datatype,
+             void *outbuf, int outsize, int *position, MPI_Comm comm);
+int MPI_Unpack(const void *inbuf, int insize, int *position, void *outbuf,
+               int outcount, MPI_Datatype datatype, MPI_Comm comm);
+int MPI_Pack_size(int incount, MPI_Datatype datatype, MPI_Comm comm,
+                  int *size);
+
+/* ---- datatype extras ---- */
+int MPI_Type_dup(MPI_Datatype oldtype, MPI_Datatype *newtype);
+int MPI_Type_create_indexed_block(int count, int blocklength,
+                                  const int displacements[],
+                                  MPI_Datatype oldtype,
+                                  MPI_Datatype *newtype);
+int MPI_Type_create_hindexed(int count, const int blocklengths[],
+                             const MPI_Aint displacements[],
+                             MPI_Datatype oldtype, MPI_Datatype *newtype);
+int MPI_Type_get_true_extent(MPI_Datatype datatype, MPI_Aint *true_lb,
+                             MPI_Aint *true_extent);
+int MPI_Get_elements(const MPI_Status *status, MPI_Datatype datatype,
+                     int *count);
+/* deprecated MPI-1 datatype interface */
+int MPI_Type_struct(int count, int blocklengths[], MPI_Aint displs[],
+                    MPI_Datatype types[], MPI_Datatype *newtype);
+int MPI_Type_hindexed(int count, int blocklengths[], MPI_Aint displs[],
+                      MPI_Datatype oldtype, MPI_Datatype *newtype);
+int MPI_Type_hvector(int count, int blocklength, MPI_Aint stride,
+                     MPI_Datatype oldtype, MPI_Datatype *newtype);
+int MPI_Type_extent(MPI_Datatype datatype, MPI_Aint *extent);
+int MPI_Type_lb(MPI_Datatype datatype, MPI_Aint *displacement);
+int MPI_Type_ub(MPI_Datatype datatype, MPI_Aint *displacement);
+int MPI_Address(const void *location, MPI_Aint *address);
+
+/* ---- request helpers ---- */
+int MPI_Waitsome(int incount, MPI_Request reqs[], int *outcount,
+                 int indices[], MPI_Status statuses[]);
+int MPI_Testsome(int incount, MPI_Request reqs[], int *outcount,
+                 int indices[], MPI_Status statuses[]);
+int MPI_Testany(int count, MPI_Request reqs[], int *index, int *flag,
+                MPI_Status *status);
+
+/* ---- env extras ---- */
+int MPI_Finalized(int *flag);
+int MPI_Query_thread(int *provided);
+int MPI_Is_thread_main(int *flag);
+int MPI_Get_library_version(char *version, int *resultlen);
+/* deprecated errhandler names */
+int MPI_Errhandler_set(MPI_Comm comm, MPI_Errhandler errhandler);
+int MPI_Win_set_errhandler(MPI_Win win, MPI_Errhandler errhandler);
+int MPI_Add_error_class(int *errorclass);
+int MPI_Add_error_code(int errorclass, int *errorcode);
+int MPI_Add_error_string(int errorcode, const char *string);
+int MPI_Comm_call_errhandler(MPI_Comm comm, int errorcode);
+
+/* ---- nonblocking collectives ---- */
+int MPI_Ibarrier(MPI_Comm comm, MPI_Request *req);
+int MPI_Ibcast(void *buf, int count, MPI_Datatype dt, int root,
+               MPI_Comm comm, MPI_Request *req);
+int MPI_Iallreduce(const void *sendbuf, void *recvbuf, int count,
+                   MPI_Datatype dt, MPI_Op op, MPI_Comm comm,
+                   MPI_Request *req);
+int MPI_Ireduce(const void *sendbuf, void *recvbuf, int count,
+                MPI_Datatype dt, MPI_Op op, int root, MPI_Comm comm,
+                MPI_Request *req);
+int MPI_Iallgather(const void *sendbuf, int sendcount, MPI_Datatype sdt,
+                   void *recvbuf, int recvcount, MPI_Datatype rdt,
+                   MPI_Comm comm, MPI_Request *req);
+int MPI_Ialltoall(const void *sendbuf, int sendcount, MPI_Datatype sdt,
+                  void *recvbuf, int recvcount, MPI_Datatype rdt,
+                  MPI_Comm comm, MPI_Request *req);
+
+/* ---- request-based RMA (completes at the enclosing sync; the
+ * returned request is pre-completed) ---- */
+int MPI_Rput(const void *origin, int origin_count, MPI_Datatype odt,
+             int target_rank, MPI_Aint target_disp, int target_count,
+             MPI_Datatype tdt, MPI_Win win, MPI_Request *req);
+int MPI_Rget(void *origin, int origin_count, MPI_Datatype odt,
+             int target_rank, MPI_Aint target_disp, int target_count,
+             MPI_Datatype tdt, MPI_Win win, MPI_Request *req);
+int MPI_Raccumulate(const void *origin, int origin_count, MPI_Datatype odt,
+                    int target_rank, MPI_Aint target_disp,
+                    int target_count, MPI_Datatype tdt, MPI_Op op,
+                    MPI_Win win, MPI_Request *req);
+
 #ifdef __cplusplus
 }
 #endif

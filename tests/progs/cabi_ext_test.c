@@ -1,0 +1,316 @@
+/* cabi_ext_test.c — exercises the extended C ABI surface: info objects,
+ * comm/win/type attributes with copy/delete callbacks, user-defined
+ * reduction ops, pack/unpack, group set operations, comm names,
+ * create_group, split_type, intercomm create/merge, nonblocking
+ * collectives, Waitsome/Testany. Prints "No Errors" on success
+ * (the reference suite's contract, test/mpi/runtests.in). */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static int errs = 0;
+
+#define CHECK(cond) do { if (!(cond)) { \
+    fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+    errs++; } } while (0)
+
+static int delete_calls = 0;
+
+static int my_copy(MPI_Comm c, int k, void *es, void *in, void *out,
+                   int *flag) {
+    (void)c; (void)k;
+    CHECK(es == (void *)0x42);
+    *(void **)out = (char *)in + 1;   /* copied value = old + 1 */
+    *flag = 1;
+    return MPI_SUCCESS;
+}
+
+static int my_delete(MPI_Comm c, int k, void *val, void *es) {
+    (void)c; (void)k; (void)val;
+    CHECK(es == (void *)0x42);
+    delete_calls++;
+    return MPI_SUCCESS;
+}
+
+static void user_max3(void *invec, void *inoutvec, int *len,
+                      MPI_Datatype *dt) {
+    (void)dt;
+    int *a = invec, *b = inoutvec;
+    for (int i = 0; i < *len; i++)
+        b[i] = a[i] > b[i] ? a[i] : b[i];
+}
+
+int main(int argc, char **argv) {
+    int rank, size;
+    MPI_Init(&argc, &argv);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+    /* ---- info ---- */
+    MPI_Info info;
+    MPI_Info_create(&info);
+    MPI_Info_set(info, "file", "runfile");
+    MPI_Info_set(info, "soft", "host");
+    int nkeys = -1, flag = 0, vlen = -1;
+    char val[MPI_MAX_INFO_VAL];
+    MPI_Info_get_nkeys(info, &nkeys);
+    CHECK(nkeys == 2);
+    MPI_Info_get(info, "file", MPI_MAX_INFO_VAL - 1, val, &flag);
+    CHECK(flag && strcmp(val, "runfile") == 0);
+    MPI_Info_get_valuelen(info, "soft", &vlen, &flag);
+    CHECK(flag && vlen == 4);
+    MPI_Info info2;
+    MPI_Info_dup(info, &info2);
+    MPI_Info_delete(info2, "file");
+    MPI_Info_get(info2, "file", MPI_MAX_INFO_VAL - 1, val, &flag);
+    CHECK(!flag);
+    MPI_Info_get(info, "file", MPI_MAX_INFO_VAL - 1, val, &flag);
+    CHECK(flag);   /* dup is a deep copy */
+    MPI_Info_free(&info);
+    MPI_Info_free(&info2);
+
+    /* ---- predefined attributes ---- */
+    int *tag_ub = NULL;
+    MPI_Comm_get_attr(MPI_COMM_WORLD, MPI_TAG_UB, &tag_ub, &flag);
+    CHECK(flag && *tag_ub >= 32767);
+
+    /* ---- user keyvals + copy/delete on dup/free ---- */
+    int kv;
+    MPI_Comm_create_keyval(my_copy, my_delete, &kv, (void *)0x42);
+    MPI_Comm_set_attr(MPI_COMM_WORLD, kv, (void *)100);
+    void *got = NULL;
+    MPI_Comm_get_attr(MPI_COMM_WORLD, kv, &got, &flag);
+    CHECK(flag && got == (void *)100);
+    MPI_Comm dup;
+    MPI_Comm_dup(MPI_COMM_WORLD, &dup);
+    MPI_Comm_get_attr(dup, kv, &got, &flag);
+    CHECK(flag && got == (void *)101);   /* my_copy added 1 */
+    /* a new (dup'ed) comm is unnamed until MPI_Comm_set_name (§6.8) */
+    {
+        char dn[MPI_MAX_OBJECT_NAME];
+        int dl = -1;
+        MPI_Comm_get_name(dup, dn, &dl);
+        CHECK(dl == 0);
+        MPI_Comm_set_name(dup, "mydup");
+        MPI_Comm_get_name(dup, dn, &dl);
+        CHECK(dl == 5 && strcmp(dn, "mydup") == 0);
+    }
+    int before = delete_calls;
+    MPI_Comm_free(&dup);
+    CHECK(delete_calls == before + 1);
+    MPI_Comm_delete_attr(MPI_COMM_WORLD, kv);
+    MPI_Comm_get_attr(MPI_COMM_WORLD, kv, &got, &flag);
+    CHECK(!flag);
+    MPI_Comm_free_keyval(&kv);
+    CHECK(kv == MPI_KEYVAL_INVALID);
+
+    /* ---- comm names ---- */
+    char name[MPI_MAX_OBJECT_NAME];
+    int rlen;
+    MPI_Comm_get_name(MPI_COMM_WORLD, name, &rlen);
+    CHECK(strcmp(name, "MPI_COMM_WORLD") == 0);
+
+    /* ---- group set operations ---- */
+    MPI_Group wg, evens, odds, un, inter, diff;
+    MPI_Comm_group(MPI_COMM_WORLD, &wg);
+    int nev = (size + 1) / 2;
+    int ranges[1][3] = {{0, size - 1, 2}};
+    MPI_Group_range_incl(wg, 1, ranges, &evens);
+    int gsz;
+    MPI_Group_size(evens, &gsz);
+    CHECK(gsz == nev);
+    MPI_Group_range_excl(wg, 1, ranges, &odds);
+    MPI_Group_size(odds, &gsz);
+    CHECK(gsz == size - nev);
+    MPI_Group_union(evens, odds, &un);
+    MPI_Group_size(un, &gsz);
+    CHECK(gsz == size);
+    MPI_Group_intersection(evens, odds, &inter);
+    MPI_Group_size(inter, &gsz);
+    CHECK(gsz == 0);
+    MPI_Group_difference(wg, odds, &diff);
+    int cmp;
+    MPI_Group_compare(diff, evens, &cmp);
+    CHECK(cmp == MPI_IDENT);
+
+    /* ---- create_group: only members call ---- */
+    if (rank % 2 == 0) {
+        MPI_Comm ec;
+        MPI_Comm_create_group(MPI_COMM_WORLD, evens, 3, &ec);
+        CHECK(ec != MPI_COMM_NULL);
+        int esz;
+        MPI_Comm_size(ec, &esz);
+        CHECK(esz == nev);
+        int sum = -1, mine = 1;
+        MPI_Allreduce(&mine, &sum, 1, MPI_INT, MPI_SUM, ec);
+        CHECK(sum == nev);
+        MPI_Comm_free(&ec);
+    }
+
+    /* ---- split_type ---- */
+    MPI_Comm node;
+    MPI_Comm_split_type(MPI_COMM_WORLD, MPI_COMM_TYPE_SHARED, 0,
+                        MPI_INFO_NULL, &node);
+    CHECK(node != MPI_COMM_NULL);
+    MPI_Comm_free(&node);
+
+    /* ---- user-defined op (non-commutative-safe path) ---- */
+    MPI_Op op;
+    MPI_Op_create(user_max3, 0, &op);
+    int commute = -1;
+    MPI_Op_commutative(op, &commute);
+    CHECK(commute == 0);
+    int mine2[2] = {rank, size - rank}, out2[2] = {-1, -1};
+    MPI_Allreduce(mine2, out2, 2, MPI_INT, op, MPI_COMM_WORLD);
+    CHECK(out2[0] == size - 1 && out2[1] == size);
+    int red[2] = {-1, -1};
+    MPI_Reduce(mine2, red, 2, MPI_INT, op, 0, MPI_COMM_WORLD);
+    if (rank == 0)
+        CHECK(red[0] == size - 1 && red[1] == size);
+    int scanv[1] = {rank}, scano[1] = {-1};
+    MPI_Scan(scanv, scano, 1, MPI_INT, op, MPI_COMM_WORLD);
+    CHECK(scano[0] == rank);   /* max of 0..rank */
+    MPI_Op_free(&op);
+    CHECK(op == MPI_OP_NULL);
+
+    /* ---- pack/unpack round trip with a vector type ---- */
+    MPI_Datatype vec;
+    MPI_Type_vector(3, 2, 4, MPI_INT, &vec);
+    MPI_Type_commit(&vec);
+    int src[12], dst[12], packed_sz = 0;
+    for (int i = 0; i < 12; i++) { src[i] = 100 + i; dst[i] = -1; }
+    MPI_Pack_size(1, vec, MPI_COMM_WORLD, &packed_sz);
+    CHECK(packed_sz == 6 * (int)sizeof(int));
+    char pbuf[64];
+    int pos = 0;
+    MPI_Pack(src, 1, vec, pbuf, sizeof pbuf, &pos, MPI_COMM_WORLD);
+    CHECK(pos == packed_sz);
+    pos = 0;
+    MPI_Unpack(pbuf, sizeof pbuf, &pos, dst, 1, vec, MPI_COMM_WORLD);
+    for (int blk = 0; blk < 3; blk++)
+        for (int j = 0; j < 2; j++)
+            CHECK(dst[4 * blk + j] == 100 + 4 * blk + j);
+    MPI_Aint tlb, text;
+    MPI_Type_get_true_extent(vec, &tlb, &text);
+    CHECK(tlb == 0 && text == 10 * (int)sizeof(int));
+    MPI_Type_free(&vec);
+
+    /* ---- type dup + attributes ---- */
+    MPI_Datatype ctg, ctg2;
+    MPI_Type_contiguous(4, MPI_INT, &ctg);
+    MPI_Type_commit(&ctg);
+    int tkv;
+    MPI_Type_create_keyval(MPI_TYPE_DUP_FN, MPI_TYPE_NULL_DELETE_FN,
+                           &tkv, NULL);
+    MPI_Type_set_attr(ctg, tkv, (void *)7);
+    MPI_Type_dup(ctg, &ctg2);
+    MPI_Type_get_attr(ctg2, tkv, &got, &flag);
+    CHECK(flag && got == (void *)7);
+    MPI_Type_free(&ctg);
+    MPI_Type_free(&ctg2);
+    MPI_Type_free_keyval(&tkv);
+
+    /* ---- intercomm create + merge (needs >= 2 ranks) ---- */
+    if (size >= 2) {
+        int color = rank < size / 2 ? 0 : 1;
+        MPI_Comm half;
+        MPI_Comm_split(MPI_COMM_WORLD, color, rank, &half);
+        int rleader = color == 0 ? size / 2 : 0;
+        MPI_Comm inter_c, merged;
+        /* peer_comm is significant only at the leaders (§6.6.2) */
+        int hrank;
+        MPI_Comm_rank(half, &hrank);
+        MPI_Comm peer = hrank == 0 ? MPI_COMM_WORLD : MPI_COMM_NULL;
+        MPI_Intercomm_create(half, 0, peer, rleader, 99, &inter_c);
+        int is_inter = 0, rsize = 0;
+        MPI_Comm_test_inter(inter_c, &is_inter);
+        CHECK(is_inter);
+        MPI_Comm_remote_size(inter_c, &rsize);
+        CHECK(rsize == (color == 0 ? size - size / 2 : size / 2));
+        MPI_Intercomm_merge(inter_c, color, &merged);
+        int msz;
+        MPI_Comm_size(merged, &msz);
+        CHECK(msz == size);
+        MPI_Comm_free(&merged);
+        MPI_Comm_free(&inter_c);
+        MPI_Comm_free(&half);
+    }
+
+    /* ---- nonblocking collectives ---- */
+    MPI_Request req;
+    MPI_Ibarrier(MPI_COMM_WORLD, &req);
+    MPI_Wait(&req, MPI_STATUS_IGNORE);
+    int bval = rank == 0 ? 31337 : -1;
+    MPI_Ibcast(&bval, 1, MPI_INT, 0, MPI_COMM_WORLD, &req);
+    MPI_Wait(&req, MPI_STATUS_IGNORE);
+    CHECK(bval == 31337);
+    int isum = -1, one = 1;
+    MPI_Iallreduce(&one, &isum, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD,
+                   &req);
+    MPI_Wait(&req, MPI_STATUS_IGNORE);
+    CHECK(isum == size);
+
+    /* ---- Waitsome / Testany over pt2pt ---- */
+    if (size >= 2) {
+        if (rank == 0) {
+            int r0 = -1, r1 = -1;
+            MPI_Request rr[2];
+            MPI_Irecv(&r0, 1, MPI_INT, 1, 5, MPI_COMM_WORLD, &rr[0]);
+            MPI_Irecv(&r1, 1, MPI_INT, 1, 6, MPI_COMM_WORLD, &rr[1]);
+            int outcount = 0, indices[2], done = 0;
+            while (done < 2) {
+                MPI_Status sts[2];
+                MPI_Waitsome(2, rr, &outcount, indices, sts);
+                CHECK(outcount != MPI_UNDEFINED);
+                done += outcount;
+            }
+            CHECK(r0 == 50 && r1 == 60);
+        } else if (rank == 1) {
+            int v0 = 50, v1 = 60;
+            MPI_Send(&v0, 1, MPI_INT, 0, 5, MPI_COMM_WORLD);
+            MPI_Send(&v1, 1, MPI_INT, 0, 6, MPI_COMM_WORLD);
+        }
+    }
+
+    /* ---- env extras ---- */
+    int fin = -1, thr = -1, main_th = -1;
+    MPI_Finalized(&fin);
+    CHECK(fin == 0);
+    MPI_Query_thread(&thr);
+    CHECK(thr >= MPI_THREAD_SINGLE && thr <= MPI_THREAD_MULTIPLE);
+    MPI_Is_thread_main(&main_th);
+    CHECK(main_th == 1);
+    char lib[MPI_MAX_LIBRARY_VERSION_STRING];
+    MPI_Get_library_version(lib, &rlen);
+    CHECK(rlen > 0);
+
+    /* ---- dynamic error classes ---- */
+    int eclass, ecode;
+    MPI_Add_error_class(&eclass);
+    CHECK(eclass > MPI_ERR_LASTCODE);
+    MPI_Add_error_code(eclass, &ecode);
+    MPI_Add_error_string(ecode, "my custom failure");
+    char es[MPI_MAX_ERROR_STRING];
+    MPI_Error_string(ecode, es, &rlen);
+    CHECK(strcmp(es, "my custom failure") == 0);
+
+    MPI_Group_free(&wg);
+    MPI_Group_free(&evens);
+    MPI_Group_free(&odds);
+    MPI_Group_free(&un);
+    MPI_Group_free(&inter);
+    MPI_Group_free(&diff);
+
+    /* aggregate errs across ranks so a failure anywhere is visible */
+    int total = 0;
+    MPI_Allreduce(&errs, &total, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD);
+    if (rank == 0) {
+        if (total == 0)
+            printf("No Errors\n");
+        else
+            printf("Found %d errors\n", total);
+    }
+    MPI_Finalize();
+    return total ? 1 : 0;
+}

@@ -40,6 +40,18 @@ def test_cabi_conformance_prog():
     assert "No Errors" in r.stdout
 
 
+def test_cabi_extended_surface():
+    """cabi_ext_test.c: info objects, attributes/keyvals with callbacks,
+    user-defined ops, pack/unpack, group set ops, create_group,
+    split_type, intercomms, nonblocking collectives, Waitsome."""
+    out = os.path.join(tempfile.mkdtemp(), "cabi_ext_test")
+    _compile([os.path.join(REPO, "tests", "progs", "cabi_ext_test.c")],
+             out)
+    r = _mpirun(4, out)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+
+
 @pytest.mark.skipif(not os.path.isdir(OSU),
                     reason="reference OSU suite not mounted")
 def test_unmodified_osu_latency():
