@@ -82,7 +82,12 @@ class Sched:
             finish()
 
         def finish() -> None:
-            engine.hooks.remove(hook)
+            # idempotent: with a threaded nonblocking op (comm_idup) the
+            # worker's progress tick and the waiter can race to finish
+            try:
+                engine.hooks.remove(hook)
+            except ValueError:
+                pass
             req.complete()
 
         def hook() -> bool:
@@ -97,9 +102,13 @@ class Sched:
             start_phase()
             return True
 
-        engine.register_hook(hook)
-        start_phase()
-        # poke once so trivial schedules complete without an explicit wait
+        # register + issue phase 0 under the engine mutex: the hook runs
+        # mutex-held from any progressing thread (e.g. a comm_idup worker
+        # pumping the same engine), and must never observe — or advance —
+        # a phase that is still being posted
+        with engine.mutex:
+            engine.register_hook(hook)
+            start_phase()
         return req
 
 
@@ -291,4 +300,89 @@ def ireduce(comm, sendbuf, recvbuf, count: int, datatype, op: Op,
         s.barrier()
         s.call(lambda: datatype.unpack(
             np.ascontiguousarray(acc).view(np.uint8), recvbuf, count))
+    return s.start()
+
+
+def iscan(comm, sendbuf, recvbuf, count: int, datatype, op: Op) -> Request:
+    """Linear pipelined scan: recv prefix from rank-1, fold own
+    contribution, forward to rank+1 (MPIR_Iscan sched shape)."""
+    tag = comm.next_coll_tag()
+    size, rank = comm.size, comm.rank
+    s = Sched(comm, tag)
+    acc = datatype.to_numpy(sendbuf, count).copy()
+    if rank > 0:
+        prev = np.empty_like(acc)
+        s.recv(prev, rank - 1)
+        s.barrier()
+        s.call(lambda: acc.__setitem__(slice(None), op(prev, acc)))
+        s.barrier()
+    if rank + 1 < size:
+        s.send(acc, rank + 1)
+    s.barrier()
+    s.call(lambda: datatype.unpack(
+        np.ascontiguousarray(acc).view(np.uint8), recvbuf, count))
+    return s.start()
+
+
+def iexscan(comm, sendbuf, recvbuf, count: int, datatype, op: Op) -> Request:
+    """Linear exclusive scan: forward the inclusive prefix, deliver the
+    exclusive one (rank 0's recvbuf is untouched, MPI-3.1 §5.11.2)."""
+    tag = comm.next_coll_tag()
+    size, rank = comm.size, comm.rank
+    s = Sched(comm, tag)
+    acc = datatype.to_numpy(sendbuf, count).copy()
+    if rank > 0:
+        prev = np.empty_like(acc)
+        s.recv(prev, rank - 1)
+        s.barrier()
+        s.call(lambda: datatype.unpack(
+            np.ascontiguousarray(prev).view(np.uint8), recvbuf, count))
+        s.call(lambda: acc.__setitem__(slice(None), op(prev, acc)))
+        s.barrier()
+    if rank + 1 < size:
+        s.send(acc, rank + 1)
+    return s.start()
+
+
+def igather(comm, sendbuf, recvbuf, count: int, datatype,
+            root: int) -> Request:
+    """Linear gather into root (sched form)."""
+    tag = comm.next_coll_tag()
+    size, rank = comm.size, comm.rank
+    s = Sched(comm, tag)
+    nb = datatype.size * count
+    if rank == root:
+        rb = np.empty(size * nb, dtype=np.uint8)
+        rb[root * nb:(root + 1) * nb] = \
+            np.ascontiguousarray(datatype.pack(sendbuf, count))
+        for src in range(size):
+            if src != root:
+                s.recv(rb[src * nb:(src + 1) * nb], src)
+        s.barrier()
+        s.call(lambda: datatype.unpack(rb, recvbuf, count * size))
+    else:
+        sb = np.ascontiguousarray(datatype.pack(sendbuf, count))
+        s.send(sb, root)
+    return s.start()
+
+
+def iscatter(comm, sendbuf, recvbuf, count: int, datatype,
+             root: int) -> Request:
+    """Linear scatter from root (sched form)."""
+    tag = comm.next_coll_tag()
+    size, rank = comm.size, comm.rank
+    s = Sched(comm, tag)
+    nb = datatype.size * count
+    if rank == root:
+        sb = np.ascontiguousarray(datatype.pack(sendbuf, count * size))
+        for dst in range(size):
+            if dst != root:
+                s.send(sb[dst * nb:(dst + 1) * nb], dst)
+        s.call(lambda: datatype.unpack(
+            sb[root * nb:(root + 1) * nb], recvbuf, count))
+    else:
+        rb = np.empty(nb, dtype=np.uint8)
+        s.recv(rb, root)
+        s.barrier()
+        s.call(lambda: datatype.unpack(rb, recvbuf, count))
     return s.start()

@@ -35,7 +35,12 @@ class Group:
 
     # -- MPI group ops ---------------------------------------------------
     def translate_ranks(self, ranks: Sequence[int], other: "Group") -> List[int]:
-        return [other.rank_of_world(self.world_of_rank(r)) for r in ranks]
+        """MPI_Group_translate_ranks; MPI_PROC_NULL passes through
+        unchanged (MPI-3.1 §6.3.2)."""
+        from .status import PROC_NULL
+        return [PROC_NULL if r == PROC_NULL
+                else other.rank_of_world(self.world_of_rank(r))
+                for r in ranks]
 
     def compare(self, other: "Group") -> str:
         if self.world_ranks == other.world_ranks:

@@ -192,6 +192,89 @@ class Intercomm(Comm):
         new.errhandler = self.errhandler
         return new
 
+    def split(self, color, key: int = 0) -> Optional["Intercomm"]:
+        """MPI_Comm_split on an intercommunicator (MPI-3.1 §6.4.2): the
+        split is performed within each local group, and new intercomms
+        pair equal colors across the two sides. A rank whose color has
+        no members on the remote side — or who passed MPI_UNDEFINED —
+        gets MPI_COMM_NULL (None).
+
+        Distinct colors share the agreed ctx pair; their member sets
+        are disjoint, so the (ctx, src, tag) matching namespaces cannot
+        collide (same argument as Comm.create_group)."""
+        from .status import UNDEFINED
+        self._check()
+        tag = self.next_coll_tag()
+        lc = self.local_comm
+        mycolor = UNDEFINED if color is None else int(color)
+        mine = np.array([mycolor, key, self.u.world_rank],
+                        dtype=np.int64)
+        table = np.empty(3 * lc.size, dtype=np.int64)
+        lc.allgather(mine, table, count=3)
+
+        def exchange(lmax: int) -> dict:
+            msg = np.concatenate([np.array([lmax], np.int64), table])
+            other = _xchg_i64(self, 0, tag, msg)
+            return {"ctx": max(lmax, int(other[0])),
+                    "rtable": [int(x) for x in other[1:]]}
+
+        hdr = bridge_agree(lc, 0, exchange)
+        ctx, rtable = int(hdr["ctx"]), hdr["rtable"]
+        local_ctx = ctx + 2     # the new intercomm's private local comm
+        self.u._next_ctx = max(self.u._next_ctx, ctx + 4)
+        if mycolor == UNDEFINED:
+            return None
+
+        def members(tab):
+            sel = []
+            for i in range(len(tab) // 3):
+                c, k, wr = tab[3 * i], tab[3 * i + 1], tab[3 * i + 2]
+                if int(c) == mycolor:
+                    sel.append((int(k), i, int(wr)))
+            sel.sort()
+            return [wr for _, _, wr in sel]
+
+        locm = members([int(x) for x in table])
+        remm = members(rtable)
+        lg = Group(locm)
+        new_local = Comm(self.u, lg, local_ctx,
+                         self.name + "_split_local")
+        if not remm:
+            new_local.free()
+            return None
+        return Intercomm(self.u, lg, Group(remm), ctx, new_local,
+                         self.name + "_split")
+
+    def create(self, group: Group) -> Optional["Intercomm"]:
+        """MPI_Comm_create on an intercommunicator (MPI-3.1 §6.4.2):
+        each side passes its local subgroup; the result pairs the two
+        subgroups. Non-members get MPI_COMM_NULL (None)."""
+        self._check()
+        tag = self.next_coll_tag()
+        lc = self.local_comm
+
+        def exchange(lmax: int) -> dict:
+            msg = np.concatenate([
+                np.array([lmax], np.int64),
+                np.array(group.world_ranks, np.int64)])
+            other = _xchg_i64(self, 0, tag, msg)
+            return {"ctx": max(lmax, int(other[0])),
+                    "remote": [int(x) for x in other[1:]]}
+
+        hdr = bridge_agree(lc, 0, exchange)
+        ctx, remm = int(hdr["ctx"]), hdr["remote"]
+        local_ctx = ctx + 2
+        self.u._next_ctx = max(self.u._next_ctx, ctx + 4)
+        if self.u.world_rank not in group.world_ranks:
+            return None
+        new_local = Comm(self.u, group, local_ctx,
+                         self.name + "_create_local")
+        if not remm:
+            new_local.free()
+            return None
+        return Intercomm(self.u, group, Group(remm), ctx, new_local,
+                         self.name + "_create")
+
     def merge(self, high: bool = False) -> Comm:
         """MPI_Intercomm_merge: union intracomm, low group's ranks first
         (intercomm_merge.c analog; tie on equal ``high`` broken by the

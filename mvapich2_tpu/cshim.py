@@ -43,6 +43,14 @@ _DTYPES = {
     11: np.dtype(np.uint16),   # MPI_UNSIGNED_SHORT
     12: np.dtype(np.longdouble),  # MPI_LONG_DOUBLE (16B on x86-64)
     13: np.dtype(np.bool_),    # MPI_C_BOOL
+    # MINLOC/MAXLOC pair types: layout matches the C structs
+    # {T val; int loc;} including padding (align=True)
+    14: np.dtype([("val", np.float32), ("loc", np.int32)], align=True),
+    15: np.dtype([("val", np.float64), ("loc", np.int32)], align=True),
+    16: np.dtype([("val", np.int64), ("loc", np.int32)], align=True),
+    17: np.dtype([("val", np.int32), ("loc", np.int32)], align=True),
+    18: np.dtype([("val", np.int16), ("loc", np.int32)], align=True),
+    19: np.dtype([("val", np.longdouble), ("loc", np.int32)], align=True),
 }
 
 _OPS = {
@@ -72,6 +80,18 @@ _next_comm = 2
 _next_req = 1
 _next_win = 1
 
+
+
+def _group(h: int):
+    """Group object for a C handle; MPI_GROUP_EMPTY (-2) is predefined."""
+    if h == -2:
+        from .core.group import Group
+        return Group([])
+    g = _groups.get(h)
+    if g is None:
+        from .core.errors import MPI_ERR_GROUP
+        raise MPIException(MPI_ERR_GROUP, f"invalid group handle {h}")
+    return g
 
 def _comm(h: int):
     if h == 0:
@@ -347,9 +367,12 @@ def allreduce(sview, rview, count: int, dtcode: int, opcode: int,
 def reduce(sview, rview, count: int, dtcode: int, opcode: int, root: int,
            ch: int) -> int:
     c = _comm(ch)
-    sb, _ = _red_view(sview, count, dtcode)
     rb, wb = _red_view(rview, count, dtcode) if rview is not None \
         else (None, None)
+    if sview is None:          # MPI_IN_PLACE: root contributes recvbuf
+        sb = rb.copy() if rb is not None else None
+    else:
+        sb, _ = _red_view(sview, count, dtcode)
     c.reduce(sb, rb, op=_OPS[opcode], root=root)
     if wb is not None:
         wb()
@@ -442,13 +465,7 @@ def comm_group(ch: int) -> int:
 
 
 def group_incl(gh: int, ranks) -> int:
-    global _next_group
-    g = _groups[gh].incl(list(ranks))
-    with _lock:
-        h = _next_group
-        _next_group += 1
-        _groups[h] = g
-    return h
+    return _new_group_handle(_group(gh).incl(list(ranks)))
 
 
 def group_free(gh: int) -> int:
@@ -538,12 +555,12 @@ def win_flush_local(wh: int, rank: int) -> int:
 
 
 def win_post(wh: int, gh: int) -> int:
-    _wins[wh].post(_groups[gh])
+    _wins[wh].post(_group(gh))
     return 0
 
 
 def win_start(wh: int, gh: int) -> int:
-    _wins[wh].start(_groups[gh])
+    _wins[wh].start(_group(gh))
     return 0
 
 
@@ -907,7 +924,7 @@ def comm_compare(ch1: int, ch2: int) -> int:
 
 def comm_create(ch: int, gh: int) -> int:
     global _next_comm
-    c = _comm(ch).create(_groups[gh])
+    c = _comm(ch).create(_group(gh))
     if c is None:
         return -1
     with _lock:
@@ -918,29 +935,23 @@ def comm_create(ch: int, gh: int) -> int:
 
 
 def group_size(gh: int) -> int:
-    return _groups[gh].size
+    return _group(gh).size
 
 
 def group_rank(gh: int) -> int:
     from .core.status import UNDEFINED
-    g = _groups[gh]
+    g = _group(gh)
     r = g.rank_of_world(uni.current_universe().world_rank)
     return r if r != UNDEFINED else -32766
 
 
 def group_excl(gh: int, ranks) -> int:
-    global _next_group
-    g = _groups[gh].excl(list(ranks))
-    with _lock:
-        h = _next_group
-        _next_group += 1
-        _groups[h] = g
-    return h
+    return _new_group_handle(_group(gh).excl(list(ranks)))
 
 
 def group_translate_ranks(gh1: int, ranks, gh2: int):
     from .core.status import UNDEFINED
-    out = _groups[gh1].translate_ranks(list(ranks), _groups[gh2])
+    out = _group(gh1).translate_ranks(list(ranks), _group(gh2))
     return [(-32766 if r in (None, UNDEFINED) else r) for r in out]
 
 
@@ -1085,6 +1096,24 @@ _infos: Dict[int, object] = {}
 _next_info = 1
 
 
+def _info(ih: int):
+    if ih == -2:               # MPI_INFO_ENV (MPI-3.1 §9.1.1)
+        import sys
+        from .core.info import Info
+        u = uni.current_universe()
+        return Info({
+            "command": sys.argv[0] if sys.argv else "",
+            "argv": " ".join(sys.argv[1:]),
+            "maxprocs": str(u.world_size),
+            "soft": str(u.world_size),
+            "host": __import__("socket").gethostname(),
+            "arch": __import__("platform").machine(),
+            "wdir": __import__("os").getcwd(),
+            "thread_level": "MPI_THREAD_SERIALIZED",
+        })
+    return _infos[ih]
+
+
 def info_create() -> int:
     global _next_info
     from .core.info import Info
@@ -1101,13 +1130,13 @@ def info_free(ih: int) -> int:
 
 
 def info_set(ih: int, key: str, value: str) -> int:
-    _infos[ih].set(key, value)
+    _info(ih).set(key, value)
     return 0
 
 
 def info_get(ih: int, key: str):
     """None when unset (C side turns that into flag=0)."""
-    return _infos[ih].get(key)
+    return _info(ih).get(key)
 
 
 def info_delete(ih: int, key: str) -> int:
@@ -1120,16 +1149,16 @@ def info_dup(ih: int) -> int:
     with _lock:
         h = _next_info
         _next_info += 1
-        _infos[h] = _infos[ih].dup()
+        _infos[h] = _info(ih).dup()
     return h
 
 
 def info_nkeys(ih: int) -> int:
-    return _infos[ih].nkeys
+    return _info(ih).nkeys
 
 
 def info_nthkey(ih: int, n: int) -> str:
-    return _infos[ih].nthkey(n)
+    return _info(ih).nthkey(n)
 
 
 # ---------------------------------------------------------------------------
@@ -1168,14 +1197,16 @@ def comm_get_name(ch: int) -> str:
 
 
 def comm_create_group(ch: int, gh: int, tag: int) -> int:
-    c = _comm(ch).create_group(_groups[gh], tag)
+    c = _comm(ch).create_group(_group(gh), tag)
     if c is None:
         return -1
     return _new_comm_handle(c)
 
 
 def comm_split_type(ch: int, split_type: int, key: int) -> int:
-    if split_type == -32766:      # MPI_UNDEFINED
+    if split_type == -32766:      # MPI_UNDEFINED: still collective —
+        c = _comm(ch).split(None, key)   # participate with no color
+        assert c is None
         return -1
     if split_type != 0:           # only MPI_COMM_TYPE_SHARED is defined
         from .core.errors import MPI_ERR_ARG
@@ -1191,7 +1222,12 @@ def comm_test_inter(ch: int) -> int:
 
 
 def comm_remote_size(ch: int) -> int:
-    return _comm(ch).remote_size
+    c = _comm(ch)
+    if not hasattr(c, "remote_size"):
+        from .core.errors import MPI_ERR_COMM
+        raise MPIException(MPI_ERR_COMM,
+                           "remote_size on an intracommunicator")
+    return c.remote_size
 
 
 def intercomm_create(local_ch: int, local_leader: int, peer_ch: int,
@@ -1214,6 +1250,8 @@ def intercomm_merge(ch: int, high: int) -> int:
 # ---------------------------------------------------------------------------
 
 def _new_group_handle(g) -> int:
+    if g.size == 0:
+        return -2              # MPI_GROUP_EMPTY is predefined
     global _next_group
     with _lock:
         h = _next_group
@@ -1224,31 +1262,31 @@ def _new_group_handle(g) -> int:
 
 def group_range_incl(gh: int, ranges) -> int:
     return _new_group_handle(
-        _groups[gh].range_incl([tuple(r) for r in ranges]))
+        _group(gh).range_incl([tuple(r) for r in ranges]))
 
 
 def group_range_excl(gh: int, ranges) -> int:
     return _new_group_handle(
-        _groups[gh].range_excl([tuple(r) for r in ranges]))
+        _group(gh).range_excl([tuple(r) for r in ranges]))
 
 
 def group_union(gh1: int, gh2: int) -> int:
-    return _new_group_handle(_groups[gh1].union(_groups[gh2]))
+    return _new_group_handle(_group(gh1).union(_group(gh2)))
 
 
 def group_intersection(gh1: int, gh2: int) -> int:
-    return _new_group_handle(_groups[gh1].intersection(_groups[gh2]))
+    return _new_group_handle(_group(gh1).intersection(_group(gh2)))
 
 
 def group_difference(gh1: int, gh2: int) -> int:
-    return _new_group_handle(_groups[gh1].difference(_groups[gh2]))
+    return _new_group_handle(_group(gh1).difference(_group(gh2)))
 
 
 _COMPARE = {"ident": 0, "congruent": 1, "similar": 2, "unequal": 3}
 
 
 def group_compare(gh1: int, gh2: int) -> int:
-    return _COMPARE[_groups[gh1].compare(_groups[gh2])]
+    return _COMPARE[_group(gh1).compare(_group(gh2))]
 
 
 def comm_remote_group(ch: int) -> int:
@@ -1273,6 +1311,46 @@ def type_hindexed(blocklengths, disp_bytes, oldcode: int) -> int:
     d = dt.create_hindexed(list(blocklengths), list(disp_bytes),
                            _dt(oldcode))
     return _new_derived(d)
+
+
+def type_create_subarray(sizes, subsizes, starts, order: int,
+                         oldcode: int) -> int:
+    return _new_derived(dt.create_subarray(
+        list(sizes), list(subsizes), list(starts), _dt(oldcode),
+        order="F" if order == 57 else "C"))   # MPI_ORDER_FORTRAN = 57
+
+
+def type_hindexed_block(blocklength: int, disp_bytes, oldcode: int) -> int:
+    return type_hindexed([blocklength] * len(list(disp_bytes)),
+                         disp_bytes, oldcode)
+
+
+_type_names: Dict[int, str] = {}
+
+
+def type_set_name(code: int, name: str) -> int:
+    _type_names[code] = name
+    return 0
+
+
+def type_get_name(code: int) -> str:
+    got = _type_names.get(code)
+    if got is not None:
+        return got
+    if code < _DERIVED_BASE:
+        return _BUILTIN_TYPE_NAMES.get(code, "")
+    return ""   # derived types are unnamed until set (MPI-3.1 §8.4)
+
+
+_BUILTIN_TYPE_NAMES = {
+    0: "MPI_BYTE", 1: "MPI_CHAR", 2: "MPI_INT", 3: "MPI_FLOAT",
+    4: "MPI_DOUBLE", 5: "MPI_LONG_LONG", 6: "MPI_UNSIGNED_LONG",
+    7: "MPI_SHORT", 8: "MPI_UNSIGNED_CHAR", 9: "MPI_AINT",
+    10: "MPI_UNSIGNED", 11: "MPI_UNSIGNED_SHORT", 12: "MPI_LONG_DOUBLE",
+    13: "MPI_C_BOOL", 14: "MPI_FLOAT_INT", 15: "MPI_DOUBLE_INT",
+    16: "MPI_LONG_INT", 17: "MPI_2INT", 18: "MPI_SHORT_INT",
+    19: "MPI_LONG_DOUBLE_INT",
+}
 
 
 def type_true_extent(code: int):
@@ -1331,6 +1409,114 @@ def _new_req(r) -> int:
     return h
 
 
+class _ThreadRequest:
+    """Request backed by a worker thread (nonblocking comm dup — the
+    reference's MPIR_Comm_idup runs the context-id protocol from the
+    progress engine; here the host progress engine is thread-driven, so
+    a thread IS the idiomatic nonblocking engine)."""
+
+    persistent = False
+
+    def __init__(self, fn):
+        self._result = None
+        self._exc = None
+
+        def run():
+            try:
+                self._result = fn()
+            except BaseException as e:   # noqa: BLE001 — joined in wait
+                self._exc = e
+
+        self._t = threading.Thread(target=run, daemon=True)
+        self._t.start()
+
+    def wait(self):
+        self._t.join()
+        if self._exc is not None:
+            raise self._exc
+        return None        # empty status
+
+    def test(self) -> bool:
+        return not self._t.is_alive()
+
+
+def comm_idup(view, ch: int) -> int:
+    """Nonblocking MPI_Comm_idup: the dup's context-agreement collective
+    runs on a worker thread; completion writes the new handle into the
+    caller's MPI_Comm storage (``view``). Must not block — the MPICH
+    comm_idup tests overlap it with pt2pt traffic before MPI_Wait.
+
+    Concurrency contract: the coll tag AND a fresh ctx base are reserved
+    HERE (the caller's thread, where idup calls are issued in the same
+    order on every rank), so any number of in-flight idups pair their
+    internal messages by tag and agree on distinct context ids."""
+    out = np.frombuffer(view, dtype=np.int32)
+    parent = _comm(ch)
+    from .core.intercomm import Intercomm
+    if isinstance(parent, Intercomm):
+        # fully reserved intercomm idup: tags on the private local comm
+        # and the intercomm bridge plus a fresh ctx base are taken here,
+        # so any number of in-flight idups pair correctly
+        lc = parent.local_comm
+        t_red = lc.next_coll_tag()
+        t_bc = lc.next_coll_tag()
+        t_x = parent.next_coll_tag()
+        u = parent.u
+        with _lock:
+            base = u._next_ctx
+            u._next_ctx = base + 4  # new inter ctx pair + local ctx pair
+
+        def run():
+            from .coll import algorithms as alg
+            from .core.comm import Comm
+            from .core.intercomm import Intercomm as IC
+            mine = np.array([base], dtype=np.int64)
+            lmax = alg.allreduce_recursive_doubling(lc, mine, opmod.MAX,
+                                                    t_red)
+            agreed = lmax.copy()
+            if lc.rank == 0:
+                other = np.zeros(1, dtype=np.int64)
+                alg.csendrecv(parent, lmax, 0, other, 0, t_x)
+                agreed[0] = max(int(lmax[0]), int(other[0]))
+            alg.bcast_binomial(lc, agreed, 0, t_bc)
+            ctx = int(agreed[0])
+            with _lock:
+                u._next_ctx = max(u._next_ctx, ctx + 4)
+            # the dup's private local comm is derived deterministically
+            # (ctx+2) — both sides do the same, member sets are disjoint
+            new_local = Comm(u, lc.group, ctx + 2,
+                             lc.name + "_dup", lc)
+            new = IC(u, parent.group, parent.remote_group, ctx,
+                     new_local, parent.name + "_dup")
+            parent.attrs.copy_all(parent, new.attrs)
+            new.errhandler = parent.errhandler
+            out[0] = _new_comm_handle(new)
+
+        return _new_req(_ThreadRequest(run))
+    tag = parent.next_coll_tag()
+    u = parent.u
+    with _lock:
+        base = u._next_ctx
+        u._next_ctx = base + 2     # distinct base per in-flight idup
+
+    def run():
+        from .coll import algorithms as alg
+        from .core.comm import Comm
+        mine = np.array([base], dtype=np.int64)
+        agreed = alg.allreduce_recursive_doubling(parent, mine,
+                                                  opmod.MAX, tag)
+        ctx = int(agreed[0])
+        with _lock:
+            u._next_ctx = max(u._next_ctx, ctx + 2)
+        new = Comm(u, parent.group, ctx, parent.name + "_dup", parent)
+        parent.attrs.copy_all(parent, new.attrs)
+        new.errhandler = parent.errhandler
+        new.topo = parent.topo
+        out[0] = _new_comm_handle(new)
+
+    return _new_req(_ThreadRequest(run))
+
+
 def ibarrier(ch: int) -> int:
     return _new_req(_comm(ch).ibarrier())
 
@@ -1376,6 +1562,47 @@ def ialltoall(sview, rview, count: int, dtcode: int, ch: int) -> int:
     send = recv.copy() if sview is None \
         else _arr(sview, count * c.size, dtcode)
     return _new_req(nb.ialltoall(c, send, recv, count, _dt(dtcode)))
+
+
+def iscan(sview, rview, count: int, dtcode: int, opcode: int,
+          ch: int) -> int:
+    from .coll import nonblocking as nb
+    c = _comm(ch)
+    recv = _arr(rview, count, dtcode)
+    send = recv.copy() if sview is None else _arr(sview, count, dtcode)
+    return _new_req(nb.iscan(c, send, recv, count, _dt(dtcode),
+                             _OPS[opcode]))
+
+
+def iexscan(sview, rview, count: int, dtcode: int, opcode: int,
+            ch: int) -> int:
+    from .coll import nonblocking as nb
+    c = _comm(ch)
+    recv = _arr(rview, count, dtcode)
+    send = recv.copy() if sview is None else _arr(sview, count, dtcode)
+    return _new_req(nb.iexscan(c, send, recv, count, _dt(dtcode),
+                               _OPS[opcode]))
+
+
+def igather(sview, rview, count: int, dtcode: int, root: int,
+            ch: int) -> int:
+    from .coll import nonblocking as nb
+    c = _comm(ch)
+    recv = _arr(rview, count * c.size, dtcode) if rview is not None         else None
+    if sview is None and recv is not None:   # IN_PLACE at root
+        send = recv[root * count:(root + 1) * count].copy()
+    else:
+        send = _arr(sview, count, dtcode)
+    return _new_req(nb.igather(c, send, recv, count, _dt(dtcode), root))
+
+
+def iscatter(sview, rview, count: int, dtcode: int, root: int,
+             ch: int) -> int:
+    from .coll import nonblocking as nb
+    c = _comm(ch)
+    send = _arr(sview, count * c.size, dtcode) if sview is not None         else None
+    recv = _arr(rview, count, dtcode)
+    return _new_req(nb.iscatter(c, send, recv, count, _dt(dtcode), root))
 
 
 def finalized() -> int:

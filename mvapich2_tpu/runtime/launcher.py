@@ -52,6 +52,19 @@ def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
     and the job result is the max exit code over non-failed ranks."""
     srv = KVSServer(nranks)
     procs: List[subprocess.Popen] = []
+    # a soft kill of the launcher must take the rank children with it —
+    # an orphaned rank spins in the progress loop forever (mpirun_rsh
+    # cleanup-on-signal behavior; SIGKILL needs a process group instead)
+    prev_term = signal.getsignal(signal.SIGTERM)
+
+    def _on_term(signum, frame):
+        _kill_all(procs)
+        raise SystemExit(128 + signum)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        pass    # not the main thread: caller owns signal handling
     try:
         for r in range(nranks):
             env = dict(os.environ)
@@ -112,6 +125,10 @@ def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
             return max(survivors, default=1)
         return max(c or 0 for c in exit_codes)
     finally:
+        try:
+            signal.signal(signal.SIGTERM, prev_term)
+        except ValueError:
+            pass
         for p in procs:
             if p.poll() is None:
                 p.kill()

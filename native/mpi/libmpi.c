@@ -21,6 +21,8 @@
 #include "mpi.h"
 #include "libmpi_internal.h"
 
+
+
 #ifndef MV2T_REPO_ROOT
 #define MV2T_REPO_ROOT "."
 #endif
@@ -28,7 +30,8 @@
 PyObject *g_shim = NULL;        /* mvapich2_tpu.cshim module */
 static int g_we_initialized_python = 0;
 
-static const int DT_SIZE[] = {1, 1, 4, 4, 8, 8, 8, 2, 1, 8, 4, 2, 16, 1};
+static const int DT_SIZE[] = {1, 1, 4, 4, 8, 8, 8, 2, 1, 8, 4, 2, 16, 1,
+                              8, 16, 16, 8, 8, 32};  /* + pair types */
 
 long shim_call_v(const char *name, int *ok, const char *fmt, ...);
 
@@ -195,6 +198,10 @@ int MPI_Init_thread(int *argc, char ***argv, int required, int *provided) {
 }
 
 int MPI_Finalize(void) {
+    /* delete callbacks run on COMM_SELF first, then COMM_WORLD
+     * (MPI-3.1 §8.7.1) before the runtime goes down */
+    mv2t_attr_delete_all(0, MPI_COMM_SELF);
+    mv2t_attr_delete_all(0, MPI_COMM_WORLD);
     return shim_call_i("finalize", "()");
 }
 
@@ -271,6 +278,9 @@ int MPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm *newcomm) {
     }
     if (*newcomm < 0)
         *newcomm = MPI_COMM_NULL;
+    else
+        mv2t_set_comm_errhandler(*newcomm,
+                                 mv2t_get_comm_errhandler(comm));
     return MPI_SUCCESS;
 }
 
@@ -281,6 +291,7 @@ int MPI_Comm_dup(MPI_Comm comm, MPI_Comm *newcomm) {
         *newcomm = MPI_COMM_NULL;
         return MPI_ERR_COMM;
     }
+    mv2t_set_comm_errhandler(*newcomm, mv2t_get_comm_errhandler(comm));
     int arc = mv2t_attr_copy_all(0, comm, *newcomm);  /* §6.7.2 */
     if (arc != MPI_SUCCESS) {
         shim_call_i("comm_free", "(i)", *newcomm);
@@ -292,6 +303,7 @@ int MPI_Comm_dup(MPI_Comm comm, MPI_Comm *newcomm) {
 
 int MPI_Comm_free(MPI_Comm *comm) {
     mv2t_attr_delete_all(0, *comm);
+    mv2t_comm_eh_forget(*comm);
     shim_call_i("comm_free", "(i)", *comm);
     *comm = MPI_COMM_NULL;
     return MPI_SUCCESS;
@@ -349,11 +361,12 @@ int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
     PyObject *res = PyObject_CallMethod(g_shim, "send", "(Oiiiii)", view,
                                         count, dt, dest, tag, comm);
     int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
-    if (!res) PyErr_Print();
+    if (!res)
+        rc = mv2t_errcode_from_pyerr();
     Py_XDECREF(res);
     Py_XDECREF(view);
     PyGILState_Release(st);
-    return rc;
+    return mv2t_errcheck(comm, rc);
 }
 
 int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
@@ -432,6 +445,7 @@ int MPI_Wait(MPI_Request *req, MPI_Status *status) {
                 status->_count = cnt;
             }
             /* persistent requests stay valid (inactive) after wait */
+            mv2t_request_completed(*req);
             if (!persistent)
                 *req = MPI_REQUEST_NULL;
             rc = MPI_SUCCESS;
@@ -476,6 +490,8 @@ int MPI_Test(MPI_Request *req, int *flag, MPI_Status *status) {
                 status->_count = cnt;
             }
             /* persistent requests stay valid (inactive) after test */
+            if (f)
+                mv2t_request_completed(*req);
             if (f && !persistent)
                 *req = MPI_REQUEST_NULL;
             rc = MPI_SUCCESS;
@@ -502,7 +518,7 @@ int MPI_Get_count(const MPI_Status *status, MPI_Datatype dt, int *count) {
 /* ------------------------------------------------------------------ */
 
 int MPI_Barrier(MPI_Comm comm) {
-    return shim_call_i("barrier", "(i)", comm);
+    return mv2t_errcheck(comm, shim_call_i("barrier", "(i)", comm));
 }
 
 static int coll2(const char *fn, const void *sb, void *rb, long snb,
@@ -559,8 +575,8 @@ int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
         return mv2t_userop_coll(0, sendbuf, recvbuf, count, dt, op, 0,
                                 comm);
     long nb = (long)count * dt_extent_b(dt);
-    return coll2("allreduce", sendbuf, recvbuf, nb, nb, "(iiii)",
-                 count, dt, op, comm);
+    return mv2t_errcheck(comm, coll2("allreduce", sendbuf, recvbuf, nb, nb, "(iiii)",
+                 count, dt, op, comm));
 }
 
 int MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
@@ -569,8 +585,8 @@ int MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
         return mv2t_userop_coll(1, sendbuf, recvbuf, count, dt, op, root,
                                 comm);
     long nb = (long)count * dt_extent_b(dt);
-    return coll2("reduce", sendbuf, recvbuf, nb, nb, "(iiiii)",
-                 count, dt, op, root, comm);
+    return mv2t_errcheck(comm, coll2("reduce", sendbuf, recvbuf, nb, nb, "(iiiii)",
+                 count, dt, op, root, comm));
 }
 
 int MPI_Allgather(const void *sendbuf, int scount, MPI_Datatype sdt,
@@ -578,10 +594,10 @@ int MPI_Allgather(const void *sendbuf, int scount, MPI_Datatype sdt,
                   MPI_Comm comm) {
     int size;
     MPI_Comm_size(comm, &size);
-    return coll2("allgather", sendbuf, recvbuf,
+    return mv2t_errcheck(comm, coll2("allgather", sendbuf, recvbuf,
                  (long)scount * dt_extent_b(sdt),
                  (long)rcount * dt_extent_b(rdt) * size,
-                 "(iiiii)", scount, sdt, rcount, rdt, comm);
+                 "(iiiii)", scount, sdt, rcount, rdt, comm));
 }
 
 int MPI_Alltoall(const void *sendbuf, int scount, MPI_Datatype sdt,
@@ -589,10 +605,10 @@ int MPI_Alltoall(const void *sendbuf, int scount, MPI_Datatype sdt,
                  MPI_Comm comm) {
     int size;
     MPI_Comm_size(comm, &size);
-    return coll2("alltoall", sendbuf, recvbuf,
+    return mv2t_errcheck(comm, coll2("alltoall", sendbuf, recvbuf,
                  (long)scount * dt_extent_b(sdt) * size,
                  (long)rcount * dt_extent_b(rdt) * size,
-                 "(iiiii)", scount, sdt, rcount, rdt, comm);
+                 "(iiiii)", scount, sdt, rcount, rdt, comm));
 }
 
 int MPI_Gather(const void *sendbuf, int scount, MPI_Datatype sdt,
@@ -600,10 +616,10 @@ int MPI_Gather(const void *sendbuf, int scount, MPI_Datatype sdt,
                MPI_Comm comm) {
     int size;
     MPI_Comm_size(comm, &size);
-    return coll2("gather", sendbuf, recvbuf,
+    return mv2t_errcheck(comm, coll2("gather", sendbuf, recvbuf,
                  (long)scount * dt_extent_b(sdt),
                  (long)rcount * dt_extent_b(rdt) * size,
-                 "(iiiiii)", scount, sdt, rcount, rdt, root, comm);
+                 "(iiiiii)", scount, sdt, rcount, rdt, root, comm));
 }
 
 int MPI_Scatter(const void *sendbuf, int scount, MPI_Datatype sdt,
@@ -611,10 +627,10 @@ int MPI_Scatter(const void *sendbuf, int scount, MPI_Datatype sdt,
                 MPI_Comm comm) {
     int size;
     MPI_Comm_size(comm, &size);
-    return coll2("scatter", sendbuf, recvbuf,
+    return mv2t_errcheck(comm, coll2("scatter", sendbuf, recvbuf,
                  (long)scount * dt_extent_b(sdt) * size,
                  (long)rcount * dt_extent_b(rdt),
-                 "(iiiiii)", scount, sdt, rcount, rdt, root, comm);
+                 "(iiiiii)", scount, sdt, rcount, rdt, root, comm));
 }
 
 int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
@@ -625,10 +641,10 @@ int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
                                 comm);
     int size;
     MPI_Comm_size(comm, &size);
-    return coll2("reduce_scatter_block", sendbuf, recvbuf,
+    return mv2t_errcheck(comm, coll2("reduce_scatter_block", sendbuf, recvbuf,
                  (long)rcount * dt_extent_b(dt) * size,
                  (long)rcount * dt_extent_b(dt),
-                 "(iiii)", rcount, dt, op, comm);
+                 "(iiii)", rcount, dt, op, comm));
 }
 
 /* ------------------------------------------------------------------ */
@@ -813,17 +829,23 @@ static int sendlike(const char *fn, const void *buf, int count,
 
 int MPI_Ssend(const void *buf, int count, MPI_Datatype dt, int dest,
               int tag, MPI_Comm comm) {
-    return sendlike("ssend", buf, count, dt, dest, tag, comm);
+    return mv2t_errcheck(comm,
+                     sendlike("ssend", buf, count, dt, dest, tag,
+                              comm));
 }
 
 int MPI_Bsend(const void *buf, int count, MPI_Datatype dt, int dest,
               int tag, MPI_Comm comm) {
-    return sendlike("bsend", buf, count, dt, dest, tag, comm);
+    return mv2t_errcheck(comm,
+                     sendlike("bsend", buf, count, dt, dest, tag,
+                              comm));
 }
 
 int MPI_Rsend(const void *buf, int count, MPI_Datatype dt, int dest,
               int tag, MPI_Comm comm) {
-    return sendlike("rsend", buf, count, dt, dest, tag, comm);
+    return mv2t_errcheck(comm,
+                     sendlike("rsend", buf, count, dt, dest, tag,
+                              comm));
 }
 
 /* request-returning shim calls share isend_irecv's plumbing */
@@ -1189,7 +1211,7 @@ int MPI_Scan(const void *sendbuf, void *recvbuf, int count,
     if (mv2t_is_userop(op))
         return mv2t_userop_coll(2, sendbuf, recvbuf, count, dt, op, 0,
                                 comm);
-    return scanlike("scan", sendbuf, recvbuf, count, dt, op, comm);
+    return mv2t_errcheck(comm, scanlike("scan", sendbuf, recvbuf, count, dt, op, comm));
 }
 
 int MPI_Exscan(const void *sendbuf, void *recvbuf, int count,
@@ -1197,7 +1219,7 @@ int MPI_Exscan(const void *sendbuf, void *recvbuf, int count,
     if (mv2t_is_userop(op))
         return mv2t_userop_coll(3, sendbuf, recvbuf, count, dt, op, 0,
                                 comm);
-    return scanlike("exscan", sendbuf, recvbuf, count, dt, op, comm);
+    return mv2t_errcheck(comm, scanlike("exscan", sendbuf, recvbuf, count, dt, op, comm));
 }
 
 /* ---- derived datatypes ----------------------------------------------- */
@@ -1368,6 +1390,9 @@ int MPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm) {
     long v = shim_call_v("comm_create", &ok, "(ii)", comm, group);
     if (!ok) return MPI_ERR_COMM;
     *newcomm = v < 0 ? MPI_COMM_NULL : (MPI_Comm)v;
+    if (*newcomm != MPI_COMM_NULL)
+        mv2t_set_comm_errhandler(*newcomm,
+                                 mv2t_get_comm_errhandler(comm));
     return MPI_SUCCESS;
 }
 
@@ -1465,25 +1490,27 @@ int MPI_Error_string(int errorcode, char *string, int *resultlen) {
 }
 
 int MPI_Error_class(int errorcode, int *errorclass) {
-    *errorclass = errorcode;   /* codes are classes in this implementation */
+    int uc = mv2t_user_error_class(errorcode);
+    if (uc >= 0) {
+        *errorclass = uc;
+        return MPI_SUCCESS;
+    }
+    *errorclass = errorcode;   /* builtin codes are classes here */
     return MPI_SUCCESS;
 }
 
-static MPI_Errhandler g_errhandler = MPI_ERRORS_RETURN;
-
 int MPI_Comm_set_errhandler(MPI_Comm comm, MPI_Errhandler errhandler) {
-    (void)comm;
-    g_errhandler = errhandler;
+    mv2t_set_comm_errhandler(comm, errhandler);
     return MPI_SUCCESS;
 }
 
 int MPI_Comm_get_errhandler(MPI_Comm comm, MPI_Errhandler *errhandler) {
-    (void)comm;
-    *errhandler = g_errhandler;
+    *errhandler = mv2t_get_comm_errhandler(comm);
     return MPI_SUCCESS;
 }
 
 int MPI_Errhandler_free(MPI_Errhandler *errhandler) {
+    mv2t_errhandler_free(*errhandler);
     *errhandler = MPI_ERRHANDLER_NULL;
     return MPI_SUCCESS;
 }

@@ -20,6 +20,8 @@
 
 #define MV2T_USEROP_BASE 100
 
+static int icoll_req(PyObject *res, MPI_Request *req);
+
 /* ------------------------------------------------------------------ */
 /* error translation: Python exception -> MPI error class              */
 /* ------------------------------------------------------------------ */
@@ -217,6 +219,9 @@ int MPI_Comm_create_group(MPI_Comm comm, MPI_Group group, int tag,
     if (!ok)
         return MPI_ERR_OTHER;
     *newcomm = h < 0 ? MPI_COMM_NULL : (MPI_Comm)h;
+    if (*newcomm != MPI_COMM_NULL)
+        mv2t_set_comm_errhandler(*newcomm,
+                                 mv2t_get_comm_errhandler(comm));
     return MPI_SUCCESS;
 }
 
@@ -229,6 +234,9 @@ int MPI_Comm_split_type(MPI_Comm comm, int split_type, int key,
     if (!ok)
         return MPI_ERR_OTHER;
     *newcomm = h < 0 ? MPI_COMM_NULL : (MPI_Comm)h;
+    if (*newcomm != MPI_COMM_NULL)
+        mv2t_set_comm_errhandler(*newcomm,
+                                 mv2t_get_comm_errhandler(comm));
     return MPI_SUCCESS;
 }
 
@@ -259,6 +267,8 @@ int MPI_Intercomm_create(MPI_Comm local_comm, int local_leader,
     if (!ok)
         return MPI_ERR_COMM;
     *newintercomm = (MPI_Comm)h;
+    mv2t_set_comm_errhandler(*newintercomm,
+                             mv2t_get_comm_errhandler(local_comm));
     return MPI_SUCCESS;
 }
 
@@ -269,6 +279,8 @@ int MPI_Intercomm_merge(MPI_Comm intercomm, int high,
     if (!ok)
         return MPI_ERR_COMM;
     *newintracomm = (MPI_Comm)h;
+    mv2t_set_comm_errhandler(*newintracomm,
+                             mv2t_get_comm_errhandler(intercomm));
     return MPI_SUCCESS;
 }
 
@@ -355,7 +367,7 @@ int MPI_Group_range_excl(MPI_Group group, int n, int ranges[][3],
 /* (TAG_UB & co) are answered from static storage.                     */
 /* ------------------------------------------------------------------ */
 
-#define MAX_KEYVALS 256
+#define MAX_KEYVALS 4096
 #define KV_BASE 64             /* below: predefined keyvals */
 
 typedef struct {
@@ -381,15 +393,38 @@ typedef struct attr_node {
 /* kind: 0 = comm, 1 = win, 2 = type */
 static attr_node *g_attrs[3];
 
+static int keyval_slot_referenced(int k) {
+    for (int kind = 0; kind < 3; kind++)
+        for (attr_node *n = g_attrs[kind]; n != NULL; n = n->next)
+            if (n->keyval == k)
+                return 1;
+    return 0;
+}
+
 static int keyval_alloc(void *copy_fn, void *delete_fn, int *keyval,
                         void *extra_state) {
-    /* monotonic: freed slots are never reused, so attributes attached
-     * under a freed keyval can neither be resurrected by a new keyval
-     * nor lose their delete callbacks (MPI-3.1 §6.7.2: a freed keyval
-     * remains functional for already-attached attributes) */
-    if (g_next_keyval >= MAX_KEYVALS)
+    /* Prefer never-used slots (freed keyvals stay functional for
+     * already-attached attributes, MPI-3.1 §6.7.2, so a freed slot
+     * cannot be handed out while any attribute still references it).
+     * When the table is exhausted, reclaim freed slots that no
+     * attribute references anymore. */
+    int i = -1;
+    for (int k = g_next_keyval; k < MAX_KEYVALS; k++)
+        if (!g_keyvals[k].used) {
+            i = k;
+            break;
+        }
+    if (i < 0) {
+        for (int k = KV_BASE; k < MAX_KEYVALS; k++)
+            if (g_keyvals[k].used && g_keyvals[k].freed
+                && !keyval_slot_referenced(k)) {
+                i = k;
+                break;
+            }
+    }
+    if (i < 0)
         return MPI_ERR_INTERN;
-    int i = g_next_keyval++;
+    g_next_keyval = i + 1;
     g_keyvals[i].used = 1;
     g_keyvals[i].freed = 0;
     g_keyvals[i].copy_fn = (MPI_Comm_copy_attr_function *)copy_fn;
@@ -411,7 +446,7 @@ static attr_node **attr_find(int kind, int obj, int keyval) {
 
 static int attr_set(int kind, int obj, int keyval, void *val) {
     if (keyval < KV_BASE || keyval >= MAX_KEYVALS
-        || !g_keyvals[keyval].used || g_keyvals[keyval].freed)
+        || !g_keyvals[keyval].used)
         return MPI_ERR_ARG;    /* MPI_ERR_KEYVAL class */
     attr_node **p = attr_find(kind, obj, keyval);
     if (p != NULL) {
@@ -548,7 +583,8 @@ int MPI_Comm_free_keyval(int *keyval) {
 }
 
 int MPI_Comm_set_attr(MPI_Comm comm, int keyval, void *attribute_val) {
-    if (keyval < KV_BASE)
+    if (keyval < KV_BASE || (keyval < MAX_KEYVALS
+                             && g_keyvals[keyval].freed))
         return MPI_ERR_ARG;    /* predefined keys are read-only */
     return attr_set(0, comm, keyval, attribute_val);
 }
@@ -641,7 +677,8 @@ void mv2t_win_forget(int win) {
 }
 
 int MPI_Win_set_attr(MPI_Win win, int keyval, void *attribute_val) {
-    if (keyval < KV_BASE)
+    if (keyval < KV_BASE || (keyval < MAX_KEYVALS
+                             && g_keyvals[keyval].freed))
         return MPI_ERR_ARG;
     return attr_set(1, win, keyval, attribute_val);
 }
@@ -686,7 +723,8 @@ int MPI_Type_free_keyval(int *keyval) {
 }
 
 int MPI_Type_set_attr(MPI_Datatype type, int keyval, void *attribute_val) {
-    if (keyval < KV_BASE)
+    if (keyval < KV_BASE || (keyval < MAX_KEYVALS
+                             && g_keyvals[keyval].freed))
         return MPI_ERR_ARG;
     return attr_set(2, type, keyval, attribute_val);
 }
@@ -1042,6 +1080,97 @@ int MPI_Type_get_true_extent(MPI_Datatype datatype, MPI_Aint *true_lb,
     return rc;
 }
 
+int MPI_Type_create_subarray(int ndims, const int sizes[],
+                             const int subsizes[], const int starts[],
+                             int order, MPI_Datatype oldtype,
+                             MPI_Datatype *newtype) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *sz = int_list(sizes, ndims);
+    PyObject *ss = int_list(subsizes, ndims);
+    PyObject *sa = int_list(starts, ndims);
+    PyObject *res = PyObject_CallMethod(g_shim, "type_create_subarray",
+                                        "(OOOii)", sz, ss, sa, order,
+                                        oldtype);
+    int rc = MPI_ERR_TYPE;
+    if (res != NULL) {
+        long h = PyLong_AsLong(res);
+        if (!PyErr_Occurred()) {
+            *newtype = (MPI_Datatype)h;
+            rc = MPI_SUCCESS;
+        } else {
+            rc = mv2t_errcode_from_pyerr();
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    Py_XDECREF(sz);
+    Py_XDECREF(ss);
+    Py_XDECREF(sa);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Type_create_hindexed_block(int count, int blocklength,
+                                   const MPI_Aint displacements[],
+                                   MPI_Datatype oldtype,
+                                   MPI_Datatype *newtype) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *dl = PyList_New(count);
+    for (int i = 0; i < count; i++)
+        PyList_SET_ITEM(dl, i,
+                        PyLong_FromLongLong((long long)displacements[i]));
+    PyObject *res = PyObject_CallMethod(g_shim, "type_hindexed_block",
+                                        "(iOi)", blocklength, dl, oldtype);
+    int rc = MPI_ERR_TYPE;
+    if (res != NULL) {
+        long h = PyLong_AsLong(res);
+        if (!PyErr_Occurred()) {
+            *newtype = (MPI_Datatype)h;
+            rc = MPI_SUCCESS;
+        } else {
+            rc = mv2t_errcode_from_pyerr();
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    Py_XDECREF(dl);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Type_set_name(MPI_Datatype type, const char *name) {
+    return shim_call_i("type_set_name", "(is)", type, name);
+}
+
+int MPI_Type_get_name(MPI_Datatype type, char *name, int *resultlen) {
+    int found;
+    int rc = shim_call_str("type_get_name", name, MPI_MAX_OBJECT_NAME,
+                           &found, "(i)", type);
+    if (rc == MPI_SUCCESS) {
+        if (!found)
+            name[0] = '\0';
+        *resultlen = (int)strlen(name);
+    }
+    return rc;
+}
+
+int MPI_Type_size_x(MPI_Datatype datatype, MPI_Count *size) {
+    int s, rc = MPI_Type_size(datatype, &s);
+    if (rc == MPI_SUCCESS)
+        *size = s;
+    return rc;
+}
+
+int MPI_Get_elements_x(const MPI_Status *status, MPI_Datatype datatype,
+                       MPI_Count *count) {
+    int c, rc = MPI_Get_elements(status, datatype, &c);
+    if (rc == MPI_SUCCESS)
+        *count = c;
+    return rc;
+}
+
 int MPI_Get_elements(const MPI_Status *status, MPI_Datatype datatype,
                      int *count) {
     /* basic types: elements == received bytes / element size; derived
@@ -1232,23 +1361,35 @@ int MPI_Win_set_errhandler(MPI_Win win, MPI_Errhandler errhandler) {
     return MPI_SUCCESS;
 }
 
-/* dynamic error classes/codes/strings (MPI-3.1 §8.5) */
-#define MAX_USER_ERRS 64
+/* dynamic error classes/codes/strings (MPI-3.1 §8.5): user values
+ * live above MPI_ERR_LASTCODE; each code remembers its class */
+#define MAX_USER_ERRS 256
 static char *g_user_errstr[MAX_USER_ERRS];
+static int g_user_errclass[MAX_USER_ERRS];   /* code idx -> class */
 static int g_next_user_err = 0;
 
 int MPI_Add_error_class(int *errorclass) {
     if (g_next_user_err >= MAX_USER_ERRS)
         return MPI_ERR_INTERN;
-    *errorclass = MPI_ERR_LASTCODE + 1 + g_next_user_err++;
-    if (*errorclass > g_lastusedcode)
-        g_lastusedcode = *errorclass;
+    int v = MPI_ERR_LASTCODE + 1 + g_next_user_err;
+    g_user_errclass[g_next_user_err] = v;    /* a class is its own class */
+    g_next_user_err++;
+    *errorclass = v;
+    if (v > g_lastusedcode)
+        g_lastusedcode = v;
     return MPI_SUCCESS;
 }
 
 int MPI_Add_error_code(int errorclass, int *errorcode) {
-    (void)errorclass;
-    return MPI_Add_error_class(errorcode);   /* codes are classes here */
+    if (g_next_user_err >= MAX_USER_ERRS)
+        return MPI_ERR_INTERN;
+    int v = MPI_ERR_LASTCODE + 1 + g_next_user_err;
+    g_user_errclass[g_next_user_err] = errorclass;
+    g_next_user_err++;
+    *errorcode = v;
+    if (v > g_lastusedcode)
+        g_lastusedcode = v;
+    return MPI_SUCCESS;
 }
 
 int MPI_Add_error_string(int errorcode, const char *string) {
@@ -1260,17 +1401,269 @@ int MPI_Add_error_string(int errorcode, const char *string) {
     return MPI_SUCCESS;
 }
 
-/* consulted by MPI_Error_string for user codes */
+/* consulted by MPI_Error_string for user codes; a dynamic code with no
+ * string yet reads as "" (MPI-3.1 §8.5: "error string is empty") */
 const char *mv2t_user_error_string(int errorcode) {
     int i = errorcode - MPI_ERR_LASTCODE - 1;
-    if (i >= 0 && i < MAX_USER_ERRS)
-        return g_user_errstr[i];
+    if (i >= 0 && i < g_next_user_err)
+        return g_user_errstr[i] ? g_user_errstr[i] : "";
     return NULL;
 }
 
-int MPI_Comm_call_errhandler(MPI_Comm comm, int errorcode) {
-    (void)comm; (void)errorcode;   /* ERRORS_RETURN semantics */
+/* consulted by MPI_Error_class for user codes; -1 = not a user code */
+int mv2t_user_error_class(int errorcode) {
+    int i = errorcode - MPI_ERR_LASTCODE - 1;
+    if (i >= 0 && i < g_next_user_err)
+        return g_user_errclass[i];
+    return -1;
+}
+
+/* ------------------------------------------------------------------ */
+/* errhandler objects and fatal-error semantics                        */
+/*                                                                     */
+/* Predefined handlers are small ints (ARE_FATAL=0, RETURN=1); user    */
+/* handlers from MPI_Comm_create_errhandler get ids >= 16 backed by a  */
+/* C function-pointer table. Per-comm handler map defaults to          */
+/* ERRORS_ARE_FATAL on COMM_WORLD (MPI-3.1 §8.3), and mv2t_errcheck    */
+/* is wired into the pt2pt/collective entry points in libmpi.c.        */
+/* ------------------------------------------------------------------ */
+
+#define EH_BASE 16
+#define MAX_EH 1024
+typedef struct {
+    MPI_Comm_errhandler_function *fn;
+    int used;
+    int freed;                 /* user freed; reusable once no comm
+                                * references it (keyval-style) */
+} eh_slot;
+static eh_slot g_eh[MAX_EH];
+static int g_next_eh = 0;
+
+typedef struct eh_node {
+    int comm;
+    MPI_Errhandler eh;
+    struct eh_node *next;
+} eh_node;
+static eh_node *g_comm_eh;
+
+static MPI_Errhandler eh_of(int comm) {
+    for (eh_node *n = g_comm_eh; n != NULL; n = n->next)
+        if (n->comm == comm)
+            return n->eh;
+    return MPI_ERRORS_ARE_FATAL;   /* the MPI default */
+}
+
+void mv2t_set_comm_errhandler(int comm, MPI_Errhandler eh) {
+    for (eh_node *n = g_comm_eh; n != NULL; n = n->next)
+        if (n->comm == comm) {
+            n->eh = eh;
+            return;
+        }
+    eh_node *n = malloc(sizeof *n);
+    if (n == NULL)
+        return;
+    n->comm = comm;
+    n->eh = eh;
+    n->next = g_comm_eh;
+    g_comm_eh = n;
+}
+
+MPI_Errhandler mv2t_get_comm_errhandler(int comm) {
+    return eh_of(comm);
+}
+
+void mv2t_comm_eh_forget(int comm) {
+    eh_node **p = &g_comm_eh;
+    while (*p != NULL) {
+        if ((*p)->comm == comm) {
+            eh_node *d = *p;
+            *p = d->next;
+            free(d);
+            return;
+        }
+        p = &(*p)->next;
+    }
+}
+
+/* funnel: applies the comm's errhandler to a nonzero rc */
+int mv2t_errcheck(MPI_Comm comm, int rc) {
+    if (rc == MPI_SUCCESS)
+        return rc;
+    MPI_Errhandler eh = eh_of(comm);
+    if (eh == MPI_ERRORS_RETURN)
+        return rc;
+    if (eh >= EH_BASE && eh < EH_BASE + MAX_EH
+        && g_eh[eh - EH_BASE].used && g_eh[eh - EH_BASE].fn != NULL) {
+        g_eh[eh - EH_BASE].fn(&comm, &rc);
+        return rc;
+    }
+    /* MPI_ERRORS_ARE_FATAL */
+    char msg[MPI_MAX_ERROR_STRING];
+    int len = 0;
+    MPI_Error_string(rc, msg, &len);
+    fprintf(stderr,
+            "Fatal error in MPI call on comm %d: %s (code %d); "
+            "MPI_ERRORS_ARE_FATAL is set — aborting\n", comm, msg, rc);
+    exit(rc > 255 || rc <= 0 ? 1 : rc);
+}
+
+static int eh_referenced(int slot) {
+    for (eh_node *n = g_comm_eh; n != NULL; n = n->next)
+        if (n->eh == EH_BASE + slot)
+            return 1;
+    return 0;
+}
+
+int MPI_Comm_create_errhandler(MPI_Comm_errhandler_function *fn,
+                               MPI_Errhandler *errhandler) {
+    int i = -1;
+    for (int k = g_next_eh; k < MAX_EH; k++)
+        if (!g_eh[k].used) {
+            i = k;
+            break;
+        }
+    if (i < 0) {
+        for (int k = 0; k < MAX_EH; k++)
+            if (g_eh[k].used && g_eh[k].freed && !eh_referenced(k)) {
+                i = k;
+                break;
+            }
+    }
+    if (i < 0)
+        return MPI_ERR_INTERN;
+    g_next_eh = i + 1;
+    g_eh[i].fn = fn;
+    g_eh[i].used = 1;
+    g_eh[i].freed = 0;
+    *errhandler = EH_BASE + i;
     return MPI_SUCCESS;
+}
+
+/* called by MPI_Errhandler_free in libmpi.c for user handlers */
+void mv2t_errhandler_free(MPI_Errhandler eh) {
+    if (eh >= EH_BASE && eh < EH_BASE + MAX_EH)
+        g_eh[eh - EH_BASE].freed = 1;
+}
+
+int MPI_Errhandler_create(MPI_Handler_function *fn,
+                          MPI_Errhandler *errhandler) {
+    return MPI_Comm_create_errhandler(fn, errhandler);
+}
+
+int MPI_Win_create_errhandler(MPI_Win_errhandler_function *fn,
+                              MPI_Errhandler *errhandler) {
+    return MPI_Comm_create_errhandler(fn, errhandler);
+}
+
+int MPI_Win_call_errhandler(MPI_Win win, int errorcode) {
+    (void)win;
+    return errorcode == MPI_SUCCESS ? MPI_SUCCESS : errorcode;
+}
+
+int MPI_Comm_call_errhandler(MPI_Comm comm, int errorcode) {
+    if (errorcode == MPI_SUCCESS)
+        return MPI_SUCCESS;
+    MPI_Errhandler eh = eh_of(comm);
+    if (eh >= EH_BASE && eh < EH_BASE + MAX_EH
+        && g_eh[eh - EH_BASE].used && g_eh[eh - EH_BASE].fn != NULL) {
+        g_eh[eh - EH_BASE].fn(&comm, &errorcode);
+        return MPI_SUCCESS;
+    }
+    if (eh == MPI_ERRORS_ARE_FATAL)
+        return mv2t_errcheck(comm, errorcode), MPI_SUCCESS;
+    return MPI_SUCCESS;        /* ERRORS_RETURN: no-op */
+}
+
+/* ------------------------------------------------------------------ */
+/* comm info / idup                                                    */
+/* ------------------------------------------------------------------ */
+
+int MPI_Comm_dup_with_info(MPI_Comm comm, MPI_Info info,
+                           MPI_Comm *newcomm) {
+    (void)info;                /* hints do not affect semantics */
+    return MPI_Comm_dup(comm, newcomm);
+}
+
+/* deferred errhandler inheritance for idup: the new handle exists only
+ * once the request completes, so record (req, storage, parent handler)
+ * and resolve from the Wait/Test completion hook */
+typedef struct idup_node {
+    MPI_Request req;
+    MPI_Comm *slot;            /* valid until completion (MPI contract) */
+    MPI_Errhandler eh;
+    struct idup_node *next;
+} idup_node;
+static idup_node *g_idups;
+
+void mv2t_request_completed(MPI_Request req) {
+    idup_node **p = &g_idups;
+    while (*p != NULL) {
+        if ((*p)->req == req) {
+            idup_node *d = *p;
+            if (*d->slot != MPI_COMM_NULL)
+                mv2t_set_comm_errhandler(*d->slot, d->eh);
+            *p = d->next;
+            free(d);
+            return;
+        }
+        p = &(*p)->next;
+    }
+}
+
+int MPI_Comm_idup(MPI_Comm comm, MPI_Comm *newcomm, MPI_Request *req) {
+    /* genuinely nonblocking: the ctx-agreement collective runs on a
+     * shim worker thread; completion (MPI_Wait) fills *newcomm */
+    *newcomm = MPI_COMM_NULL;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *v = mv_view(newcomm, sizeof(MPI_Comm));
+    PyObject *res = PyObject_CallMethod(g_shim, "comm_idup", "(Oi)", v,
+                                        comm);
+    int rc = icoll_req(res, req);
+    Py_XDECREF(v);
+    PyGILState_Release(st);
+    if (rc == MPI_SUCCESS) {
+        idup_node *n = malloc(sizeof *n);
+        if (n != NULL) {
+            n->req = *req;
+            n->slot = newcomm;
+            n->eh = mv2t_get_comm_errhandler(comm);
+            n->next = g_idups;
+            g_idups = n;
+        }
+    }
+    return rc;
+}
+
+typedef struct cinfo_node {
+    int comm;
+    MPI_Info info;
+    struct cinfo_node *next;
+} cinfo_node;
+static cinfo_node *g_comm_info;
+
+int MPI_Comm_set_info(MPI_Comm comm, MPI_Info info) {
+    /* only recognized hints are retained (MPI-3.1 §6.4.4: unknown keys
+     * are ignored and must not come back from MPI_Comm_get_info); this
+     * implementation recognizes no comm hints yet, so the stored info
+     * is empty regardless of input */
+    (void)info;
+    for (cinfo_node *n = g_comm_info; n != NULL; n = n->next)
+        if (n->comm == comm)
+            return MPI_SUCCESS;
+    cinfo_node *n = malloc(sizeof *n);
+    if (n == NULL)
+        return MPI_ERR_INTERN;
+    n->comm = comm;
+    n->next = g_comm_info;
+    g_comm_info = n;
+    return MPI_Info_create(&n->info);
+}
+
+int MPI_Comm_get_info(MPI_Comm comm, MPI_Info *info_used) {
+    for (cinfo_node *n = g_comm_info; n != NULL; n = n->next)
+        if (n->comm == comm)
+            return MPI_Info_dup(n->info, info_used);
+    return MPI_Info_create(info_used);   /* no hints set: empty info */
 }
 
 /* ------------------------------------------------------------------ */
@@ -1387,6 +1780,78 @@ int MPI_Ialltoall(const void *sendbuf, int sendcount, MPI_Datatype sdt,
     PyObject *rv = mv_view(recvbuf, nb);
     PyObject *res = PyObject_CallMethod(g_shim, "ialltoall", "(OOiii)",
                                         sv, rv, recvcount, rdt, comm);
+    int rc = icoll_req(res, req);
+    Py_XDECREF(sv);
+    Py_XDECREF(rv);
+    PyGILState_Release(st);
+    return rc;
+}
+
+static int iscanlike(const char *fn, const void *sendbuf, void *recvbuf,
+                     int count, MPI_Datatype dt, MPI_Op op,
+                     MPI_Comm comm, MPI_Request *req) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    long nb = (long)count * dt_extent_b(dt);
+    PyObject *sv = mv_view(sendbuf, nb);
+    PyObject *rv = mv_view(recvbuf, nb);
+    PyObject *res = PyObject_CallMethod(g_shim, fn, "(OOiiii)", sv, rv,
+                                        count, dt, op, comm);
+    int rc = icoll_req(res, req);
+    Py_XDECREF(sv);
+    Py_XDECREF(rv);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Iscan(const void *sendbuf, void *recvbuf, int count,
+              MPI_Datatype dt, MPI_Op op, MPI_Comm comm,
+              MPI_Request *req) {
+    return iscanlike("iscan", sendbuf, recvbuf, count, dt, op, comm, req);
+}
+
+int MPI_Iexscan(const void *sendbuf, void *recvbuf, int count,
+                MPI_Datatype dt, MPI_Op op, MPI_Comm comm,
+                MPI_Request *req) {
+    return iscanlike("iexscan", sendbuf, recvbuf, count, dt, op, comm,
+                     req);
+}
+
+int MPI_Igather(const void *sendbuf, int sendcount, MPI_Datatype sdt,
+                void *recvbuf, int recvcount, MPI_Datatype rdt, int root,
+                MPI_Comm comm, MPI_Request *req) {
+    int rank;
+    MPI_Comm_rank(comm, &rank);
+    PyGILState_STATE st = PyGILState_Ensure();
+    int p = comm_np(comm);
+    PyObject *sv = mv_view(sendbuf, (long)sendcount * dt_extent_b(sdt));
+    /* recvcount/rdt are significant only at the root (MPI-3.1 §5.5) */
+    PyObject *rv = rank == root
+        ? mv_view(recvbuf, (long)recvcount * p * dt_extent_b(rdt))
+        : mv_view(NULL, 0);
+    PyObject *res = PyObject_CallMethod(g_shim, "igather", "(OOiiiiii)",
+                                        sv, rv, sendcount, sdt,
+                                        recvcount, rdt, root, comm);
+    int rc = icoll_req(res, req);
+    Py_XDECREF(sv);
+    Py_XDECREF(rv);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Iscatter(const void *sendbuf, int sendcount, MPI_Datatype sdt,
+                 void *recvbuf, int recvcount, MPI_Datatype rdt, int root,
+                 MPI_Comm comm, MPI_Request *req) {
+    int rank;
+    MPI_Comm_rank(comm, &rank);
+    PyGILState_STATE st = PyGILState_Ensure();
+    int p = comm_np(comm);
+    PyObject *sv = rank == root
+        ? mv_view(sendbuf, (long)sendcount * p * dt_extent_b(sdt))
+        : mv_view(NULL, 0);
+    PyObject *rv = mv_view(recvbuf, (long)recvcount * dt_extent_b(rdt));
+    PyObject *res = PyObject_CallMethod(g_shim, "iscatter", "(OOiiii)",
+                                        sv, rv, recvcount, rdt, root,
+                                        comm);
     int rc = icoll_req(res, req);
     Py_XDECREF(sv);
     Py_XDECREF(rv);

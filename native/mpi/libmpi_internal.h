@@ -30,5 +30,12 @@ int mv2t_userop_coll(int kind, const void *sendbuf, void *recvbuf,
                      int count, MPI_Datatype dt, MPI_Op op, int root,
                      MPI_Comm comm);
 const char *mv2t_user_error_string(int errorcode);
+int mv2t_user_error_class(int errorcode);
+void mv2t_set_comm_errhandler(int comm, MPI_Errhandler eh);
+MPI_Errhandler mv2t_get_comm_errhandler(int comm);
+int mv2t_errcheck(MPI_Comm comm, int rc);
+void mv2t_errhandler_free(MPI_Errhandler eh);
+void mv2t_comm_eh_forget(int comm);
+void mv2t_request_completed(MPI_Request req);
 
 #endif /* MV2T_LIBMPI_INTERNAL_H */

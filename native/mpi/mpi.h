@@ -25,6 +25,7 @@ typedef int MPI_Win;
 typedef long MPI_Request;
 typedef long long MPI_Aint;
 typedef long long MPI_Offset;
+typedef long long MPI_Count;
 typedef int MPI_Errhandler;
 typedef int MPI_Info;
 typedef int MPI_Group;
@@ -62,6 +63,23 @@ typedef struct MPI_Status {
 #define MPI_C_BOOL              ((MPI_Datatype)13)
 #define MPI_OFFSET              ((MPI_Datatype)5)
 #define MPI_COUNT               ((MPI_Datatype)5)
+/* MINLOC/MAXLOC pair types ({T val; int loc;} C layout) */
+#define MPI_FLOAT_INT           ((MPI_Datatype)14)
+#define MPI_DOUBLE_INT          ((MPI_Datatype)15)
+#define MPI_LONG_INT            ((MPI_Datatype)16)
+#define MPI_2INT                ((MPI_Datatype)17)
+#define MPI_SHORT_INT           ((MPI_Datatype)18)
+#define MPI_LONG_DOUBLE_INT     ((MPI_Datatype)19)
+/* fixed-width aliases */
+#define MPI_INT8_T              ((MPI_Datatype)1)
+#define MPI_INT16_T             ((MPI_Datatype)7)
+#define MPI_INT32_T             ((MPI_Datatype)2)
+#define MPI_INT64_T             ((MPI_Datatype)5)
+#define MPI_UINT8_T             ((MPI_Datatype)8)
+#define MPI_UINT16_T            ((MPI_Datatype)11)
+#define MPI_UINT32_T            ((MPI_Datatype)10)
+#define MPI_UINT64_T            ((MPI_Datatype)6)
+#define MPI_WCHAR               ((MPI_Datatype)2)
 #define MPI_DATATYPE_NULL   ((MPI_Datatype)-1)
 
 #define MPI_VERSION    3
@@ -108,6 +126,7 @@ typedef struct MPI_Status {
 #define MPI_REQUEST_NULL ((MPI_Request)0)
 #define MPI_WIN_NULL     ((MPI_Win)-1)
 #define MPI_INFO_NULL    ((MPI_Info)-1)
+#define MPI_INFO_ENV     ((MPI_Info)-2)
 #define MPI_GROUP_NULL   ((MPI_Group)-1)
 #define MPI_GROUP_EMPTY  ((MPI_Group)-2)
 #define MPI_BOTTOM       ((void *)0)
@@ -509,6 +528,14 @@ int MPI_Unpack(const void *inbuf, int insize, int *position, void *outbuf,
 int MPI_Pack_size(int incount, MPI_Datatype datatype, MPI_Comm comm,
                   int *size);
 
+/* array orders (MPI_Type_create_subarray) */
+#define MPI_ORDER_C       56
+#define MPI_ORDER_FORTRAN 57
+#define MPI_DISTRIBUTE_BLOCK 121
+#define MPI_DISTRIBUTE_CYCLIC 122
+#define MPI_DISTRIBUTE_NONE 123
+#define MPI_DISTRIBUTE_DFLT_DARG (-49767)
+
 /* ---- datatype extras ---- */
 int MPI_Type_dup(MPI_Datatype oldtype, MPI_Datatype *newtype);
 int MPI_Type_create_indexed_block(int count, int blocklength,
@@ -520,6 +547,19 @@ int MPI_Type_create_hindexed(int count, const int blocklengths[],
                              MPI_Datatype oldtype, MPI_Datatype *newtype);
 int MPI_Type_get_true_extent(MPI_Datatype datatype, MPI_Aint *true_lb,
                              MPI_Aint *true_extent);
+int MPI_Type_create_subarray(int ndims, const int sizes[],
+                             const int subsizes[], const int starts[],
+                             int order, MPI_Datatype oldtype,
+                             MPI_Datatype *newtype);
+int MPI_Type_create_hindexed_block(int count, int blocklength,
+                                   const MPI_Aint displacements[],
+                                   MPI_Datatype oldtype,
+                                   MPI_Datatype *newtype);
+int MPI_Type_set_name(MPI_Datatype type, const char *name);
+int MPI_Type_get_name(MPI_Datatype type, char *name, int *resultlen);
+int MPI_Type_size_x(MPI_Datatype datatype, MPI_Count *size);
+int MPI_Get_elements_x(const MPI_Status *status, MPI_Datatype datatype,
+                       MPI_Count *count);
 int MPI_Get_elements(const MPI_Status *status, MPI_Datatype datatype,
                      int *count);
 /* deprecated MPI-1 datatype interface */
@@ -571,6 +611,37 @@ int MPI_Iallgather(const void *sendbuf, int sendcount, MPI_Datatype sdt,
 int MPI_Ialltoall(const void *sendbuf, int sendcount, MPI_Datatype sdt,
                   void *recvbuf, int recvcount, MPI_Datatype rdt,
                   MPI_Comm comm, MPI_Request *req);
+int MPI_Iscan(const void *sendbuf, void *recvbuf, int count,
+              MPI_Datatype dt, MPI_Op op, MPI_Comm comm,
+              MPI_Request *req);
+int MPI_Iexscan(const void *sendbuf, void *recvbuf, int count,
+                MPI_Datatype dt, MPI_Op op, MPI_Comm comm,
+                MPI_Request *req);
+int MPI_Igather(const void *sendbuf, int sendcount, MPI_Datatype sdt,
+                void *recvbuf, int recvcount, MPI_Datatype rdt, int root,
+                MPI_Comm comm, MPI_Request *req);
+int MPI_Iscatter(const void *sendbuf, int sendcount, MPI_Datatype sdt,
+                 void *recvbuf, int recvcount, MPI_Datatype rdt, int root,
+                 MPI_Comm comm, MPI_Request *req);
+
+/* ---- errhandler objects ---- */
+typedef void (MPI_Comm_errhandler_function)(MPI_Comm *, int *, ...);
+typedef MPI_Comm_errhandler_function MPI_Handler_function;
+typedef MPI_Comm_errhandler_function MPI_Win_errhandler_function;
+int MPI_Comm_create_errhandler(MPI_Comm_errhandler_function *fn,
+                               MPI_Errhandler *errhandler);
+int MPI_Errhandler_create(MPI_Handler_function *fn,
+                          MPI_Errhandler *errhandler);
+int MPI_Win_create_errhandler(MPI_Win_errhandler_function *fn,
+                              MPI_Errhandler *errhandler);
+int MPI_Win_call_errhandler(MPI_Win win, int errorcode);
+
+/* ---- comm info / idup ---- */
+int MPI_Comm_idup(MPI_Comm comm, MPI_Comm *newcomm, MPI_Request *req);
+int MPI_Comm_dup_with_info(MPI_Comm comm, MPI_Info info,
+                           MPI_Comm *newcomm);
+int MPI_Comm_set_info(MPI_Comm comm, MPI_Info info);
+int MPI_Comm_get_info(MPI_Comm comm, MPI_Info *info_used);
 
 /* ---- request-based RMA (completes at the enclosing sync; the
  * returned request is pre-completed) ---- */
