@@ -204,12 +204,36 @@ class Comm:
     def send_init(self, buf, dest: int, tag: int = 0, **kw) -> Request:
         req = Request(self.u.engine, "persistent-send")
         req.persistent = True
-        inner: List[Request] = []
 
         def starter(r):
             i = self.isend(buf, dest, tag, **kw)
-            inner.append(i)
-            i.add_callback(lambda _: r.complete())
+            # MPI_Cancel on the persistent handle cancels the active
+            # communication (MPI-3.1 §3.9) — even one that is already
+            # locally complete (eager/buffered), matching send-cancel
+            # semantics; cancelled-ness lands in r.status at resolution
+            r._cancel_override = True
+
+            def pcancel():
+                with self.u.engine.mutex:
+                    r.complete_flag = False
+                i.cancel()
+
+                def redone(ireq):
+                    r.status.cancelled = bool(
+                        getattr(ireq, "cancelled", False)
+                        or ireq.status.cancelled)
+                    r.complete(ireq.error)
+                i.add_callback(redone)
+                return False
+            r._cancel_fn = pcancel
+
+            def done(ireq):
+                r.status.cancelled = bool(
+                    getattr(ireq, "cancelled", False)
+                    or ireq.status.cancelled)
+                r.complete(ireq.error)
+
+            i.add_callback(done)
 
         req._start_fn = starter
         return req
@@ -221,9 +245,14 @@ class Comm:
 
         def starter(r):
             i = self.irecv(buf, source, tag, **kw)
+            r._cancel_fn = (lambda: (i.cancel(), False)[1]) \
+                if not i.complete_flag else None
 
             def done(ireq):
                 r.status = ireq.status
+                r.status.cancelled = bool(
+                    getattr(ireq, "cancelled", False)
+                    or ireq.status.cancelled)
                 r.complete(ireq.error)
 
             i.add_callback(done)
@@ -601,10 +630,10 @@ class Comm:
                                                 sweights, dweights, reorder)
 
     def dist_graph_create(self, sources, degrees, destinations,
-                          reorder: bool = False):
+                          weights=None, reorder: bool = False):
         from . import topo as _topo
-        return _topo.dist_graph_create(self, sources, degrees, destinations,
-                                       reorder)
+        return _topo.dist_graph_create(self, sources, degrees,
+                                       destinations, weights, reorder)
 
     def topo_test(self) -> str:
         from . import topo as _topo

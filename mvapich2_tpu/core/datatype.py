@@ -42,7 +42,12 @@ class Datatype:
         # stride < 0) would index before the buffer origin; our numpy-backed
         # pack/unpack can't express that, so reject at construction rather
         # than silently read from the end of the buffer.
-        if any(off < 0 for off, _ in spans):
+        if len(spans) > 256:
+            _sp = np.asarray(spans, dtype=np.int64).reshape(len(spans), 2)
+            _neg = bool((_sp[:, 0] < 0).any())
+        else:
+            _neg = any(off < 0 for off, _ in spans)
+        if _neg:
             raise MPIException(
                 MPI_ERR_TYPE,
                 "negative byte displacements are not supported "
@@ -114,6 +119,25 @@ class Datatype:
             out.extend((base + off, ln) for off, ln in self.spans)
         return _merge_spans(out)
 
+    def _byte_index(self) -> np.ndarray:
+        """Flat source-byte index for one element (cached): the gather
+        map of the dataloop. Vectorized pack/unpack for many-span types
+        is a single numpy fancy-index instead of a span loop."""
+        idx = getattr(self, "_idx_cache", None)
+        if idx is None:
+            arr = np.asarray(self.spans, dtype=np.int64).reshape(-1, 2)
+            starts, lens = arr[:, 0], arr[:, 1]
+            ends = np.cumsum(lens)
+            total = int(ends[-1])
+            step = np.ones(total, dtype=np.int64)
+            step[0] = starts[0]
+            if len(starts) > 1:
+                step[ends[:-1]] = starts[1:] - (starts[:-1] + lens[:-1]) \
+                    + 1
+            idx = np.cumsum(step)
+            self._idx_cache = idx
+        return idx
+
     def pack(self, buf, count: int) -> np.ndarray:
         """Gather ``count`` elements from ``buf`` into contiguous bytes."""
         raw = as_bytes_view(buf)
@@ -122,8 +146,16 @@ class Datatype:
             mpi_assert(len(raw) >= n, MPI_ERR_ARG,
                        f"buffer too small: {len(raw)} < {n}")
             return np.frombuffer(raw, dtype=np.uint8, count=n).copy()
-        out = np.empty(self.size * count, dtype=np.uint8)
         src = np.frombuffer(raw, dtype=np.uint8)
+        if len(self.spans) > 64:
+            idx = self._byte_index()
+            if count == 1:
+                return src[idx]
+            full = (idx[None, :]
+                    + (np.arange(count, dtype=np.int64)
+                       * self.extent)[:, None]).reshape(-1)
+            return src[full]
+        out = np.empty(self.size * count, dtype=np.uint8)
         pos = 0
         for off, ln in self.flatten(count):
             out[pos:pos + ln] = src[off:off + ln]
@@ -138,6 +170,16 @@ class Datatype:
         if self.is_contiguous:
             n = min(len(src), self.size * count)
             dst[:n] = src[:n]
+            return
+        if len(self.spans) > 64 and len(src) >= self.size * count:
+            idx = self._byte_index()
+            if count == 1:
+                dst[idx] = src[:idx.size]
+                return
+            full = (idx[None, :]
+                    + (np.arange(count, dtype=np.int64)
+                       * self.extent)[:, None]).reshape(-1)
+            dst[full] = src[:full.size]
             return
         pos = 0
         for off, ln in self.flatten(count):
@@ -157,7 +199,27 @@ class Datatype:
 
 
 def _merge_spans(spans: Sequence[Span]) -> List[Span]:
-    """Coalesce adjacent byte spans (the dataloop optimization)."""
+    """Coalesce adjacent byte spans (the dataloop optimization).
+    Vectorized for large span lists — the MTest datatype generators
+    build indexed types with 10^4-10^5 blocks, where a Python loop is
+    the difference between milliseconds and minutes."""
+    n = len(spans)
+    if n > 256:
+        arr = np.asarray(spans, dtype=np.int64).reshape(n, 2)
+        off, ln = arr[:, 0], arr[:, 1]
+        keep = ln > 0
+        off, ln = off[keep], ln[keep]
+        if off.size == 0:
+            return []
+        # new group wherever a span does not extend its predecessor
+        brk = np.empty(off.size, dtype=bool)
+        brk[0] = True
+        np.not_equal(off[1:], off[:-1] + ln[:-1], out=brk[1:])
+        gid = np.cumsum(brk) - 1
+        starts = off[brk]
+        ends = np.zeros(int(gid[-1]) + 1, dtype=np.int64)
+        np.maximum.at(ends, gid, off + ln)
+        return list(zip(starts.tolist(), (ends - starts).tolist()))
     out: List[Span] = []
     for off, ln in spans:
         if ln <= 0:
@@ -289,6 +351,18 @@ def create_vector(count: int, blocklength: int, stride: int,
 
 def create_hvector(count: int, blocklength: int, stride_bytes: int,
                    oldtype: Datatype) -> Datatype:
+    if oldtype.is_contiguous and count > 16 and stride_bytes >= 0:
+        # vectorized fast path: one span per block (the MTest vector
+        # generators build 64k-block vectors)
+        starts = (np.arange(count, dtype=np.int64)
+                  * stride_bytes).tolist()
+        ln = blocklength * oldtype.size
+        spans = [(s, ln) for s in starts]
+        extent = _extent_of(spans, oldtype)
+        return _env(
+            Datatype(spans, extent, 0, oldtype.basic,
+                     f"hvector({count},{blocklength},{stride_bytes})"),
+            "hvector", [count, blocklength], [stride_bytes], [oldtype])
     spans = []
     for i in range(count):
         base = i * stride_bytes
@@ -316,6 +390,21 @@ def create_hindexed(blocklengths: Sequence[int], disp_bytes: Sequence[int],
                     oldtype: Datatype) -> Datatype:
     mpi_assert(len(blocklengths) == len(disp_bytes), MPI_ERR_ARG,
                "blocklengths/displacements length mismatch")
+    if oldtype.is_contiguous and len(blocklengths) > 16:
+        # fast path: each block is ONE span (bl * size bytes at disp) —
+        # vectorized; the generic path below materializes bl spans per
+        # block, quadratic-ish for the MTest generators' 64k-block types
+        bls = np.asarray(blocklengths, dtype=np.int64)
+        dps = np.asarray(disp_bytes, dtype=np.int64)
+        # typemap (declaration) order — MPI_Pack serializes blocks in
+        # the order they were declared, not by address
+        spans = list(zip(dps.tolist(), (bls * oldtype.size).tolist()))
+        extent = _extent_of(spans, oldtype)
+        return _env(
+            Datatype(spans, extent, 0, oldtype.basic,
+                     f"hindexed({len(blocklengths)})"),
+            "hindexed", [len(blocklengths)] + list(blocklengths),
+            list(disp_bytes), [oldtype])
     spans = []
     for bl, disp in zip(blocklengths, disp_bytes):
         for j in range(bl):
@@ -323,7 +412,7 @@ def create_hindexed(blocklengths: Sequence[int], disp_bytes: Sequence[int],
             spans.extend((base + o, l) for o, l in oldtype.spans)
     extent = _extent_of(spans, oldtype)
     return _env(
-        Datatype(sorted(spans), extent, 0, oldtype.basic,
+        Datatype(spans, extent, 0, oldtype.basic,
                  f"hindexed({len(blocklengths)})"),
         "hindexed", [len(blocklengths)] + list(blocklengths),
         list(disp_bytes), [oldtype])
@@ -347,6 +436,11 @@ def create_struct(blocklengths: Sequence[int], disp_bytes: Sequence[int],
     basics = set()
     for bl, disp, t in zip(blocklengths, disp_bytes, types):
         basics.add(t.basic)
+        if t.is_contiguous:
+            # one span per member block regardless of blocklength —
+            # the MTest struct generators use 64k-element blocks
+            spans.append((disp, bl * t.size))
+            continue
         for j in range(bl):
             base = disp + j * t.extent
             spans.extend((base + o, l) for o, l in t.spans)
@@ -354,7 +448,7 @@ def create_struct(blocklengths: Sequence[int], disp_bytes: Sequence[int],
     max_ub = max((d + bl * t.extent for d, bl, t
                   in zip(disp_bytes, blocklengths, types)), default=0)
     return _env(
-        Datatype(sorted(spans), max_ub, 0, basic,
+        Datatype(spans, max_ub, 0, basic,
                  f"struct({len(types)})"),
         "struct", [len(types)] + list(blocklengths), list(disp_bytes),
         list(types))
@@ -377,23 +471,39 @@ def create_subarray(sizes: Sequence[int], subsizes: Sequence[int],
     for i in range(ndim - 2, -1, -1):
         strides[i] = strides[i + 1] * sizes[i + 1]
     spans: List[Span] = []
-
-    def rec(dim: int, elem_off: int):
-        if dim == ndim - 1:
-            base = (elem_off + starts[dim]) * oldtype.extent
+    nrows = 1
+    for s in subsizes[:-1]:
+        nrows *= s
+    if oldtype.is_contiguous and nrows * subsizes[-1] > 64:
+        # vectorized: one span per innermost row; row-start element
+        # offsets built by broadcasting over the outer dimensions
+        # (row-major, so the result is already sorted)
+        offs = np.zeros(1, dtype=np.int64)
+        for d in range(ndim - 1):
+            o_d = ((starts[d] + np.arange(subsizes[d], dtype=np.int64))
+                   * strides[d])
+            offs = (offs[:, None] + o_d[None, :]).reshape(-1)
+        offs = (offs + starts[-1]) * oldtype.extent
+        row_len = subsizes[-1] * oldtype.size
+        spans = [(int(o), row_len) for o in offs.tolist()]
+    else:
+        def rec(dim: int, elem_off: int):
+            if dim == ndim - 1:
+                base = (elem_off + starts[dim]) * oldtype.extent
+                for j in range(subsizes[dim]):
+                    b2 = base + j * oldtype.extent
+                    spans.extend((b2 + o, l) for o, l in oldtype.spans)
+                return
             for j in range(subsizes[dim]):
-                b2 = base + j * oldtype.extent
-                spans.extend((b2 + o, l) for o, l in oldtype.spans)
-            return
-        for j in range(subsizes[dim]):
-            rec(dim + 1, elem_off + (starts[dim] + j) * strides[dim])
+                rec(dim + 1, elem_off + (starts[dim] + j) * strides[dim])
 
-    rec(0, 0)
+        rec(0, 0)
+        spans = sorted(spans)
     total = 1
     for s in sizes:
         total *= s
     return _env(
-        Datatype(sorted(spans), total * oldtype.extent, 0, oldtype.basic,
+        Datatype(spans, total * oldtype.extent, 0, oldtype.basic,
                  f"subarray{tuple(subsizes)}"),
         "subarray", [ndim] + orig[0] + orig[1] + orig[2]
         + [0 if order == "C" else 1], [], [oldtype])

@@ -73,8 +73,12 @@ class Request:
         return self.status
 
     def cancel(self) -> None:
-        # Only matching-queue removal is supported (like most MPIs).
-        if self.complete_flag:
+        # Recv cancel = matching-queue removal; send cancel resolves
+        # asynchronously through the protocol (see pt2pt/protocol.py).
+        # _cancel_override marks requests (persistent sends) whose
+        # local completion does not preclude cancelling.
+        if self.complete_flag and not getattr(self, "_cancel_override",
+                                              False):
             return
         canceller = getattr(self, "_cancel_fn", None)
         if canceller is not None and canceller():

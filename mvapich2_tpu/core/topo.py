@@ -86,11 +86,16 @@ class DistGraphTopology:
     kind = "dist_graph"
 
     def __init__(self, sources: Sequence[int], destinations: Sequence[int],
-                 sweights=None, dweights=None):
+                 sweights=None, dweights=None, weighted=None):
         self.sources = list(sources)          # ranks that send to me
         self.destinations = list(destinations)  # ranks I send to
         self.sweights = list(sweights) if sweights is not None else None
         self.dweights = list(dweights) if dweights is not None else None
+        # MPI_Dist_graph_neighbors_count's weighted flag: set iff the
+        # constructor was NOT given MPI_UNWEIGHTED (an empty weight
+        # array still counts as weighted — MPI-3.1 §7.5.4)
+        self.weighted = bool(weighted) if weighted is not None else (
+            sweights is not None or dweights is not None)
 
     def neighbors_of(self, rank: int) -> List[int]:
         # for neighborhood collectives: recv from sources, send to dests
@@ -173,38 +178,44 @@ def graph_create(comm, index: Sequence[int], edges: Sequence[int],
 def dist_graph_create_adjacent(comm, sources: Sequence[int],
                                destinations: Sequence[int],
                                sweights=None, dweights=None,
-                               reorder: bool = False):
+                               reorder: bool = False, weighted=None):
     sub = comm.dup()
-    sub.topo = DistGraphTopology(sources, destinations, sweights, dweights)
+    sub.topo = DistGraphTopology(sources, destinations, sweights,
+                                 dweights, weighted)
     return sub
 
 
 def dist_graph_create(comm, sources: Sequence[int],
                       degrees: Sequence[int], destinations: Sequence[int],
-                      reorder: bool = False):
+                      weights=None, reorder: bool = False,
+                      weighted=None):
     """General constructor: each rank contributes edges (sources[i] ->
-    destinations chunk); assemble the full adjacency by allgatherv-style
-    exchange, then each rank extracts its in/out neighbor lists."""
-    # flatten my contributed edges as (src, dst) pairs
-    pairs = []
+    destinations chunk, with optional per-edge weights); assemble the
+    full adjacency by allgatherv-style exchange, then each rank extracts
+    its in/out neighbor lists (and their weights)."""
+    # flatten my contributed edges as (src, dst, w) triples
+    triples = []
     off = 0
     for s, deg in zip(sources, degrees):
         for k in range(deg):
-            pairs.append((int(s), int(destinations[off + k])))
+            w = int(weights[off + k]) if weights is not None else 1
+            triples.append((int(s), int(destinations[off + k]), w))
         off += deg
-    mine = np.array(pairs, dtype=np.int64).reshape(-1) if pairs else \
-        np.empty(0, dtype=np.int64)
+    mine = np.array(triples, dtype=np.int64).reshape(-1) if triples \
+        else np.empty(0, dtype=np.int64)
     counts = np.zeros(comm.size, dtype=np.int64)
     comm.allgather(np.array([mine.size], dtype=np.int64), counts, count=1)
     total = int(counts.sum())
     allpairs = np.zeros(total, dtype=np.int64)
     comm.allgatherv(mine, allpairs, [int(c) for c in counts])
-    edges = allpairs.reshape(-1, 2)
+    edges = allpairs.reshape(-1, 3)
     me = comm.rank
-    in_n = [int(s) for s, d in edges if d == me]
-    out_n = [int(d) for s, d in edges if s == me]
+    in_n = [(int(s), int(w)) for s, d, w in edges if d == me]
+    out_n = [(int(d), int(w)) for s, d, w in edges if s == me]
     sub = comm.dup()
-    sub.topo = DistGraphTopology(in_n, out_n)
+    sub.topo = DistGraphTopology(
+        [s for s, _ in in_n], [d for d, _ in out_n],
+        [w for _, w in in_n], [w for _, w in out_n], weighted)
     return sub
 
 
@@ -238,8 +249,16 @@ def cart_shift(comm, direction: int, disp: int = 1) -> Tuple[int, int]:
 
 
 def cart_sub(comm, remain_dims: Sequence[bool]):
-    """MPI_Cart_sub: slice the grid into sub-grids keeping remain dims."""
+    """MPI_Cart_sub: slice the grid into sub-grids keeping remain dims.
+    All-false remain_dims matches the reference implementation's
+    behavior (test/mpi/topo/cartsuball.c): rank 0 gets a zero-dim comm
+    congruent to SELF, everyone else MPI_COMM_NULL."""
     t = _cart(comm)
+    if not any(remain_dims):
+        sub = comm.split(0 if comm.rank == 0 else None, 0)
+        if sub is not None:
+            sub.topo = CartTopology([], [])
+        return sub
     coords = t.coords_of(comm.rank)
     color = 0
     for i, keep in enumerate(remain_dims):

@@ -110,6 +110,13 @@ def _arr(view, count: int, dtcode: int) -> np.ndarray:
         raise MPIException(MPI_ERR_TYPE,
                            "derived datatype not supported on this path")
     d = _DTYPES[dtcode]
+    if view is None:
+        # NULL buffer: legal for zero-count operations (MPI-3.1 §3.2.2)
+        from .core.errors import MPI_ERR_BUFFER
+        if count > 0:
+            raise MPIException(MPI_ERR_BUFFER,
+                               "NULL buffer with nonzero count")
+        return np.empty(0, dtype=d)
     return np.frombuffer(view, dtype=d, count=count)
 
 
@@ -178,6 +185,14 @@ def _red_view(view, count: int, dtcode: int):
 # ---------------------------------------------------------------------------
 
 def init() -> int:
+    # debugging aid (MV2_DEBUG-style): SIGUSR1 dumps all Python thread
+    # stacks of a rank — how a hung conformance run is diagnosed
+    try:
+        import faulthandler
+        import signal as _sig
+        faulthandler.register(_sig.SIGUSR1, all_threads=True)
+    except (ImportError, AttributeError, ValueError):
+        pass
     mpi.Init()
     return 0
 
@@ -226,7 +241,15 @@ def comm_dup(ch: int) -> int:
     return h
 
 
+def _drop_worker(ch: int) -> None:
+    with _lock:
+        w = _workers.pop(ch, None)
+    if w is not None:
+        w.q.put(None)          # worker thread exits after the queue drains
+
+
 def comm_free(ch: int) -> int:
+    _drop_worker(ch)
     with _lock:
         c = _comms.pop(ch, None)
     if c is not None:
@@ -282,50 +305,60 @@ def irecv(view, count: int, dtcode: int, source: int, tag: int,
 
 
 def wait(rh: int):
-    """Returns (source, tag, count_bytes, persistent). Persistent
+    """Returns (source, tag, count_bytes, persistent, cancelled).
+    Persistent
     requests stay allocated (inactive) after completion (MPI-3.1 §3.9);
     others are deallocated. Wait on an INACTIVE persistent request
     returns at once with an empty status (§3.7.3)."""
     with _lock:
         r = _reqs.get(rh)
     if r is None:
-        return (-1, -1, 0, 0)
+        return (-1, -2, 0, 0, 0)
     persistent = bool(getattr(r, "persistent", False))
     if persistent and not getattr(r, "_c_active", False):
-        return (-1, -1, 0, 1)
+        return (-1, -2, 0, 1, 0)
     st = r.wait()
     if persistent:
         r._c_active = False
     if not persistent:
         with _lock:
             _reqs.pop(rh, None)
+    cancelled = 1 if (st is not None
+                      and getattr(st, "cancelled", False)) \
+        or getattr(r, "cancelled", False) else 0
     if st is None:
-        return (-1, -1, 0, 1 if persistent else 0)
-    return (st.source, st.tag, st.count, 1 if persistent else 0)
+        return (-1, -2, 0, 1 if persistent else 0, cancelled)
+    return (st.source, st.tag, st.count, 1 if persistent else 0,
+            cancelled)
 
 
 def test(rh: int):
-    """Returns (flag, persistent, source, tag, count_bytes). Test on an
-    INACTIVE persistent request returns flag=1, empty status (§3.7.3)."""
+    """Returns (flag, persistent, source, tag, count_bytes, cancelled).
+    Test on an INACTIVE persistent request returns flag=1, empty status
+    (§3.7.3)."""
     with _lock:
         r = _reqs.get(rh)
     if r is None:
-        return (1, 0, -1, -1, 0)
+        return (1, 0, -1, -2, 0, 0)
     persistent = bool(getattr(r, "persistent", False))
     if persistent and not getattr(r, "_c_active", False):
-        return (1, 1, -1, -1, 0)
+        return (1, 1, -1, -2, 0, 0)
     done = r.test()
     if not done:
-        return (0, 0, -1, -1, 0)
+        return (0, 0, -1, -2, 0, 0)
     if not persistent:
         with _lock:
             _reqs.pop(rh, None)
     st = r.wait()
     if persistent:
         r._c_active = False
+    cancelled = 1 if (st is not None
+                      and getattr(st, "cancelled", False)) \
+        or getattr(r, "cancelled", False) else 0
     if st is None:
-        return (1, 1 if persistent else 0, -1, -1, 0)
-    return (1, 1 if persistent else 0, st.source, st.tag, st.count)
+        return (1, 1 if persistent else 0, -1, -2, 0, cancelled)
+    return (1, 1 if persistent else 0, st.source, st.tag, st.count,
+            cancelled)
 
 
 # ---------------------------------------------------------------------------
@@ -642,6 +675,19 @@ def rsend(view, count: int, dtcode: int, dest: int, tag: int,
     return 0
 
 
+def ibsend(view, count: int, dtcode: int, dest: int, tag: int,
+           ch: int) -> int:
+    buf, kw = _send_args(view, count, dtcode)
+    return _new_req(_comm(ch).isend(buf, dest, tag, mode="buffered",
+                                    **kw))
+
+
+def irsend(view, count: int, dtcode: int, dest: int, tag: int,
+           ch: int) -> int:
+    buf, kw = _send_args(view, count, dtcode)
+    return _new_req(_comm(ch).isend(buf, dest, tag, **kw))
+
+
 def issend(view, count: int, dtcode: int, dest: int, tag: int,
            ch: int) -> int:
     global _next_req
@@ -673,15 +719,11 @@ def iprobe(source: int, tag: int, ch: int):
 # ---------------------------------------------------------------------------
 
 def send_init(view, count: int, dtcode: int, dest: int, tag: int,
-              ch: int) -> int:
-    global _next_req
+              ch: int, mode: str = "standard") -> int:
     buf, kw = _send_args(view, count, dtcode)
-    r = _comm(ch).send_init(buf, dest, tag, **kw)
-    with _lock:
-        h = _next_req
-        _next_req += 1
-        _reqs[h] = r
-    return h
+    if mode != "standard":
+        kw["mode"] = mode
+    return _new_req(_comm(ch).send_init(buf, dest, tag, **kw))
 
 
 def recv_init(view, count: int, dtcode: int, source: int, tag: int,
@@ -719,10 +761,10 @@ def testall(handles):
     out = []
     for h, r in zip(handles, rs):
         if r is None:
-            out.append((-1, -1, 0, 0))
+            out.append((-1, -2, 0, 0, 0))
             continue
         if _inactive(r):
-            out.append((-1, -1, 0, 1))
+            out.append((-1, -2, 0, 1, 0))
             continue
         persistent = bool(getattr(r, "persistent", False))
         st = r.wait()
@@ -731,11 +773,13 @@ def testall(handles):
         else:
             with _lock:
                 _reqs.pop(h, None)
+        canc = 1 if (st is not None and getattr(st, "cancelled", False)) \
+            or getattr(r, "cancelled", False) else 0
         if st is None:
-            out.append((-1, -1, 0, 1 if persistent else 0))
+            out.append((-1, -2, 0, 1 if persistent else 0, canc))
         else:
             out.append((st.source, st.tag, st.count,
-                        1 if persistent else 0))
+                        1 if persistent else 0, canc))
     return (1, out)
 
 
@@ -753,7 +797,7 @@ def waitany(handles):
             if r is not None and not (getattr(r, "persistent", False) and
                                       not getattr(r, "_c_active", False))]
     if not live:
-        return (-1, -1, -1, 0, 0)
+        return (-1, -1, -2, 0, 0, 0)
     idx = rq.waitany([r for _, r in live])
     i, r = live[idx]
     persistent = bool(getattr(r, "persistent", False))
@@ -763,9 +807,11 @@ def waitany(handles):
     else:
         with _lock:
             _reqs.pop(handles[i], None)
+    canc = 1 if (st is not None and getattr(st, "cancelled", False)) \
+        or getattr(r, "cancelled", False) else 0
     if st is None:
-        return (i, -1, -1, 0, 1 if persistent else 0)
-    return (i, st.source, st.tag, st.count, 1 if persistent else 0)
+        return (i, -1, -2, 0, 1 if persistent else 0, canc)
+    return (i, st.source, st.tag, st.count, 1 if persistent else 0, canc)
 
 
 def request_free(rh: int) -> int:
@@ -1409,6 +1455,70 @@ def _new_req(r) -> int:
     return h
 
 
+class _CommWorker:
+    """Per-communicator FIFO worker: nonblocking operations on an
+    INTERCOMM (icolls, idup) execute serially in call order on one
+    thread. Queue order equals call order — identical on every rank by
+    MPI's collective-ordering rule — so internal tag allocation inside
+    the worker pairs correctly across ranks with no reservation
+    protocol."""
+
+    def __init__(self):
+        import queue
+        self.q: "queue.Queue" = queue.Queue()
+        self.t = threading.Thread(target=self._run, daemon=True)
+        self.t.start()
+
+    def _run(self):
+        while True:
+            item = self.q.get()
+            if item is None:
+                return
+            fn, done = item
+            try:
+                fn()
+            except BaseException as e:   # noqa: BLE001 — raised at wait
+                done[1] = e
+            done[0].set()
+
+    def submit(self, fn):
+        done = [threading.Event(), None]
+        self.q.put((fn, done))
+        return done
+
+
+class _QueuedRequest:
+    persistent = False
+
+    def __init__(self, done):
+        self._done = done
+
+    def wait(self):
+        self._done[0].wait()
+        if self._done[1] is not None:
+            raise self._done[1]
+        return None
+
+    def test(self) -> bool:
+        return self._done[0].is_set()
+
+
+_workers: Dict[int, _CommWorker] = {}
+
+
+def _queued(ch: int, fn) -> int:
+    with _lock:
+        w = _workers.get(ch)
+        if w is None:
+            w = _workers[ch] = _CommWorker()
+    return _new_req(_QueuedRequest(w.submit(fn)))
+
+
+def _is_inter(c) -> bool:
+    from .core.intercomm import Intercomm
+    return isinstance(c, Intercomm)
+
+
 class _ThreadRequest:
     """Request backed by a worker thread (nonblocking comm dup — the
     reference's MPIR_Comm_idup runs the context-id protocol from the
@@ -1452,47 +1562,13 @@ def comm_idup(view, ch: int) -> int:
     internal messages by tag and agree on distinct context ids."""
     out = np.frombuffer(view, dtype=np.int32)
     parent = _comm(ch)
-    from .core.intercomm import Intercomm
-    if isinstance(parent, Intercomm):
-        # fully reserved intercomm idup: tags on the private local comm
-        # and the intercomm bridge plus a fresh ctx base are taken here,
-        # so any number of in-flight idups pair correctly
-        lc = parent.local_comm
-        t_red = lc.next_coll_tag()
-        t_bc = lc.next_coll_tag()
-        t_x = parent.next_coll_tag()
-        u = parent.u
-        with _lock:
-            base = u._next_ctx
-            u._next_ctx = base + 4  # new inter ctx pair + local ctx pair
-
+    if _is_inter(parent):
+        # rides the per-intercomm worker queue: serialized in call order
+        # with any queued icolls, so internal tag/ctx agreement pairs
+        # across ranks without a reservation protocol
         def run():
-            from .coll import algorithms as alg
-            from .core.comm import Comm
-            from .core.intercomm import Intercomm as IC
-            mine = np.array([base], dtype=np.int64)
-            lmax = alg.allreduce_recursive_doubling(lc, mine, opmod.MAX,
-                                                    t_red)
-            agreed = lmax.copy()
-            if lc.rank == 0:
-                other = np.zeros(1, dtype=np.int64)
-                alg.csendrecv(parent, lmax, 0, other, 0, t_x)
-                agreed[0] = max(int(lmax[0]), int(other[0]))
-            alg.bcast_binomial(lc, agreed, 0, t_bc)
-            ctx = int(agreed[0])
-            with _lock:
-                u._next_ctx = max(u._next_ctx, ctx + 4)
-            # the dup's private local comm is derived deterministically
-            # (ctx+2) — both sides do the same, member sets are disjoint
-            new_local = Comm(u, lc.group, ctx + 2,
-                             lc.name + "_dup", lc)
-            new = IC(u, parent.group, parent.remote_group, ctx,
-                     new_local, parent.name + "_dup")
-            parent.attrs.copy_all(parent, new.attrs)
-            new.errhandler = parent.errhandler
-            out[0] = _new_comm_handle(new)
-
-        return _new_req(_ThreadRequest(run))
+            out[0] = _new_comm_handle(parent.dup())
+        return _queued(ch, run)
     tag = parent.next_coll_tag()
     u = parent.u
     with _lock:
@@ -1518,25 +1594,41 @@ def comm_idup(view, ch: int) -> int:
 
 
 def ibarrier(ch: int) -> int:
-    return _new_req(_comm(ch).ibarrier())
+    c = _comm(ch)
+    if _is_inter(c):
+        return _queued(ch, c.barrier)
+    return _new_req(c.ibarrier())
 
 
 def ibcast(view, count: int, dtcode: int, root: int, ch: int) -> int:
-    buf = _arr(view, count, dtcode)
-    return _new_req(_comm(ch).ibcast(buf, root, count=count))
+    c = _comm(ch)
+    buf = _arr(view, count, dtcode) if view is not None else None
+    if _is_inter(c):
+        return _queued(ch, lambda: c.bcast(buf, root=root, count=count))
+    return _new_req(c.ibcast(buf, root, count=count))
 
 
 def iallreduce(sview, rview, count: int, dtcode: int, opcode: int,
                ch: int) -> int:
+    c = _comm(ch)
     recv = _arr(rview, count, dtcode)
     send = recv.copy() if sview is None else _arr(sview, count, dtcode)
-    return _new_req(_comm(ch).iallreduce(send, recv, op=_OPS[opcode]))
+    if _is_inter(c):
+        return _queued(ch, lambda: c.allreduce(
+            send, recv, op=_OPS[opcode], count=count))
+    return _new_req(c.iallreduce(send, recv, op=_OPS[opcode]))
 
 
 def ireduce(sview, rview, count: int, dtcode: int, opcode: int, root: int,
             ch: int) -> int:
     from .coll import nonblocking as nb
     c = _comm(ch)
+    if _is_inter(c):
+        recv0 = _arr(rview, count, dtcode) if rview is not None else None
+        send0 = _arr(sview, count, dtcode) if sview is not None else None
+        return _queued(ch, lambda: c.reduce(send0, recv0,
+                                            op=_OPS[opcode], root=root,
+                                            count=count))
     if rview is None:
         recv = np.empty(count, dtype=_DTYPES[dtcode])
     else:
@@ -1549,6 +1641,11 @@ def ireduce(sview, rview, count: int, dtcode: int, opcode: int, root: int,
 def iallgather(sview, rview, count: int, dtcode: int, ch: int) -> int:
     from .coll import nonblocking as nb
     c = _comm(ch)
+    if _is_inter(c):
+        recv0 = _arr(rview, count * c.remote_size, dtcode)
+        send0 = _arr(sview, count, dtcode) if sview is not None else None
+        return _queued(ch, lambda: c.allgather(send0, recv0,
+                                               count=count))
     recv = _arr(rview, count * c.size, dtcode)
     send = recv[c.rank * count:(c.rank + 1) * count].copy() \
         if sview is None else _arr(sview, count, dtcode)
@@ -1558,6 +1655,11 @@ def iallgather(sview, rview, count: int, dtcode: int, ch: int) -> int:
 def ialltoall(sview, rview, count: int, dtcode: int, ch: int) -> int:
     from .coll import nonblocking as nb
     c = _comm(ch)
+    if _is_inter(c):
+        recv0 = _arr(rview, count * c.remote_size, dtcode)
+        send0 = _arr(sview, count * c.remote_size, dtcode) \
+            if sview is not None else recv0.copy()
+        return _queued(ch, lambda: c.alltoall(send0, recv0, count=count))
     recv = _arr(rview, count * c.size, dtcode)
     send = recv.copy() if sview is None \
         else _arr(sview, count * c.size, dtcode)
@@ -1568,6 +1670,10 @@ def iscan(sview, rview, count: int, dtcode: int, opcode: int,
           ch: int) -> int:
     from .coll import nonblocking as nb
     c = _comm(ch)
+    if _is_inter(c):
+        from .core.errors import MPI_ERR_COMM
+        raise MPIException(MPI_ERR_COMM,
+                           "scan is undefined on intercommunicators")
     recv = _arr(rview, count, dtcode)
     send = recv.copy() if sview is None else _arr(sview, count, dtcode)
     return _new_req(nb.iscan(c, send, recv, count, _dt(dtcode),
@@ -1578,31 +1684,231 @@ def iexscan(sview, rview, count: int, dtcode: int, opcode: int,
             ch: int) -> int:
     from .coll import nonblocking as nb
     c = _comm(ch)
+    if _is_inter(c):
+        from .core.errors import MPI_ERR_COMM
+        raise MPIException(MPI_ERR_COMM,
+                           "exscan is undefined on intercommunicators")
     recv = _arr(rview, count, dtcode)
     send = recv.copy() if sview is None else _arr(sview, count, dtcode)
     return _new_req(nb.iexscan(c, send, recv, count, _dt(dtcode),
                                _OPS[opcode]))
 
 
-def igather(sview, rview, count: int, dtcode: int, root: int,
-            ch: int) -> int:
+def igather(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
+            root: int, ch: int) -> int:
+    """recvcount/recvtype are significant only at the root (MPI-3.1
+    §5.5); non-roots contribute sendcount elements of sendtype."""
     from .coll import nonblocking as nb
     c = _comm(ch)
-    recv = _arr(rview, count * c.size, dtcode) if rview is not None         else None
-    if sview is None and recv is not None:   # IN_PLACE at root
-        send = recv[root * count:(root + 1) * count].copy()
-    else:
-        send = _arr(sview, count, dtcode)
-    return _new_req(nb.igather(c, send, recv, count, _dt(dtcode), root))
+    if _is_inter(c):
+        recv0 = _arr(rview, rcount * c.remote_size, rdt) \
+            if rview is not None else None
+        send0 = _arr(sview, scount, sdt) if sview is not None else None
+        return _queued(ch, lambda: c.gather(
+            send0, recv0, root=root,
+            count=rcount if recv0 is not None else scount))
+    if c.rank == root:
+        recv = _arr(rview, rcount * c.size, rdt)
+        if sview is None:                    # IN_PLACE at root
+            send = recv[root * rcount:(root + 1) * rcount].copy()
+        else:
+            send = _arr(sview, scount, sdt)
+        return _new_req(nb.igather(c, send, recv, rcount, _dt(rdt),
+                                   root))
+    send = _arr(sview, scount, sdt)
+    return _new_req(nb.igather(c, send, None, scount, _dt(sdt), root))
 
 
-def iscatter(sview, rview, count: int, dtcode: int, root: int,
-             ch: int) -> int:
+def iscatter(sview, rview, scount: int, sdt: int, rcount: int,
+             rdt: int, root: int, ch: int) -> int:
+    """sendcount/sendtype are significant only at the root."""
     from .coll import nonblocking as nb
     c = _comm(ch)
-    send = _arr(sview, count * c.size, dtcode) if sview is not None         else None
-    recv = _arr(rview, count, dtcode)
-    return _new_req(nb.iscatter(c, send, recv, count, _dt(dtcode), root))
+    if _is_inter(c):
+        send0 = _arr(sview, scount * c.remote_size, sdt) \
+            if sview is not None else None
+        recv0 = _arr(rview, rcount, rdt) if rview is not None else None
+        return _queued(ch, lambda: c.scatter(
+            send0, recv0, root=root,
+            count=rcount if recv0 is not None else scount))
+    if c.rank == root:
+        send = _arr(sview, scount * c.size, sdt)
+        if rview is None:      # MPI_IN_PLACE at root: block stays put
+            recv = np.empty(scount, dtype=_DTYPES[sdt])
+            return _new_req(nb.iscatter(c, send, recv, scount, _dt(sdt),
+                                        root))
+        recv = _arr(rview, rcount, rdt)
+        return _new_req(nb.iscatter(c, send, recv, rcount, _dt(rdt),
+                                    root))
+    recv = _arr(rview, rcount, rdt)
+    return _new_req(nb.iscatter(c, None, recv, rcount, _dt(rdt), root))
+
+
+# ---------------------------------------------------------------------------
+# cancel / request status / generalized requests
+# ---------------------------------------------------------------------------
+
+def cancel(rh: int) -> int:
+    with _lock:
+        r = _reqs.get(rh)
+    if r is not None and hasattr(r, "cancel"):
+        r.cancel()
+    return 0
+
+
+def request_get_status(rh: int):
+    """(flag, src, tag, count, cancelled) WITHOUT freeing the request
+    (MPI_Request_get_status semantics)."""
+    with _lock:
+        r = _reqs.get(rh)
+    if r is None:
+        return (1, -1, -2, 0, 0)
+    done = bool(getattr(r, "complete_flag", False))
+    if not done and hasattr(r, "test"):
+        # poke progress nondestructively where the request supports it
+        try:
+            done = bool(r.test())
+        except TypeError:
+            done = False
+    st = getattr(r, "status", None)
+    if not done:
+        return (0, -1, -2, 0, 0)
+    if st is None:
+        return (1, -1, -2, 0, 0)
+    return (1, getattr(st, "source", -1), getattr(st, "tag", -2),
+            getattr(st, "count", 0),
+            1 if getattr(st, "cancelled", False) else 0)
+
+
+def grequest_start() -> int:
+    """Plain user-completed request (callbacks live on the C side —
+    libmpi_ext.c invokes them around completion)."""
+    r = mpi.Grequest_start(None, None, None)
+    return _new_req(r)
+
+
+def grequest_complete(rh: int) -> int:
+    with _lock:
+        r = _reqs.get(rh)
+    if r is not None:
+        r.complete()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# process topologies (core/topo.py over the C ABI)
+# ---------------------------------------------------------------------------
+
+def dims_create(nnodes: int, ndims: int, dims):
+    from .core import topo as tp
+    return tp.dims_create(nnodes, ndims, list(dims))
+
+
+def cart_create(ch: int, dims, periods, reorder: int) -> int:
+    from .core import topo as tp
+    c = tp.cart_create(_comm(ch), list(dims),
+                       [bool(p) for p in periods], bool(reorder))
+    if c is None:
+        return -1
+    return _new_comm_handle(c)
+
+
+def cart_rank(ch: int, coords) -> int:
+    return _comm(ch).topo.rank_of(list(coords))
+
+
+def cart_coords(ch: int, rank: int):
+    return _comm(ch).topo.coords_of(rank)
+
+
+def cart_shift(ch: int, direction: int, disp: int):
+    from .core import topo as tp
+    return tp.cart_shift(_comm(ch), direction, disp)
+
+
+def cart_sub(ch: int, remain_dims) -> int:
+    from .core import topo as tp
+    c = tp.cart_sub(_comm(ch), [bool(r) for r in remain_dims])
+    if c is None:
+        return -1
+    return _new_comm_handle(c)
+
+
+def cart_get(ch: int):
+    t = _comm(ch).topo
+    return (list(t.dims), [1 if p else 0 for p in t.periods],
+            t.coords_of(_comm(ch).rank))
+
+
+def cartdim_get(ch: int) -> int:
+    return _comm(ch).topo.ndims
+
+
+def cart_map(ch: int, dims, periods) -> int:
+    from .core import topo as tp
+    r = tp.cart_map(_comm(ch), list(dims), [bool(p) for p in periods])
+    return -32766 if r in (None, -32766) else r
+
+
+def graph_create(ch: int, index, edges, reorder: int) -> int:
+    from .core import topo as tp
+    c = tp.graph_create(_comm(ch), list(index), list(edges),
+                        bool(reorder))
+    if c is None:
+        return -1
+    return _new_comm_handle(c)
+
+
+def graphdims_get(ch: int):
+    t = _comm(ch).topo
+    return (len(t.index), len(t.edges))
+
+
+def graph_get(ch: int):
+    t = _comm(ch).topo
+    return (list(t.index), list(t.edges))
+
+
+def graph_neighbors(ch: int, rank: int):
+    return _comm(ch).topo.neighbors_of(rank)
+
+
+def topo_test(ch: int) -> int:
+    from .core import topo as tp
+    kind = tp.topo_test(_comm(ch))
+    return {"cart": 2, "graph": 1, "dist_graph": 3}.get(kind, -32766)
+
+
+def dist_graph_create_adjacent(ch: int, sources, sweights, dests,
+                               dweights, reorder: int,
+                               weighted: int) -> int:
+    from .core import topo as tp
+    c = tp.dist_graph_create_adjacent(
+        _comm(ch), list(sources), list(dests),
+        list(sweights) if sweights is not None else None,
+        list(dweights) if dweights is not None else None,
+        weighted=bool(weighted))
+    return _new_comm_handle(c)
+
+
+def dist_graph_create(ch: int, sources, degrees, dests, weights,
+                      reorder: int, weighted: int) -> int:
+    from .core import topo as tp
+    c = tp.dist_graph_create(
+        _comm(ch), list(sources), list(degrees), list(dests),
+        list(weights) if weights is not None else None,
+        bool(reorder), weighted=bool(weighted))
+    return _new_comm_handle(c)
+
+
+def dist_graph_neighbors(ch: int):
+    t = _comm(ch).topo
+    weighted = 1 if getattr(t, "weighted", False) else 0
+    sw = list(t.sweights) if getattr(t, "sweights", None) is not None \
+        else [1] * len(t.sources)
+    dw = list(t.dweights) if getattr(t, "dweights", None) is not None \
+        else [1] * len(t.destinations)
+    return (list(t.sources), sw, list(t.destinations), dw, weighted)
 
 
 def finalized() -> int:

@@ -68,6 +68,18 @@ class Matcher:
             return pkt
         return None
 
+    def cancel_unexpected(self, src_world: int, sreq_id: int) -> bool:
+        """Send-cancel protocol target side: retract a not-yet-matched
+        message identified by (sender world rank, send request id).
+        True iff it was still queued (MPI_Cancel on sends, ch3 cancel
+        packet analog)."""
+        for pkt in self.unexpected:
+            if pkt.src_world == src_world and pkt.sreq_id == sreq_id \
+                    and pkt.sreq_id != 0:
+                self.unexpected.remove(pkt)
+                return True
+        return False
+
     def peek_unexpected(self, ctx: int, source: int, tag: int,
                         remove: bool = False) -> Optional[Packet]:
         """Probe support: find (optionally remove, for Mprobe) a message."""

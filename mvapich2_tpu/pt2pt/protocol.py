@@ -55,6 +55,17 @@ class SendRequest(Request):
         self.channel = None
         self.protocol = ""
 
+    def cancel(self) -> None:
+        """Send-cancel differs from the base class: a LOCALLY-complete
+        eager/buffered send is still cancellable until the receiver has
+        matched it (MPI-3.1 §3.8.4); resolution is asynchronous via the
+        CANCEL_SEND_RESP packet."""
+        fn = getattr(self, "_cancel_fn", None)
+        if fn is None or self.cancelled \
+                or getattr(self, "_cancel_pending", False):
+            return
+        fn()
+
 
 class RecvRequest(Request):
     def __init__(self, engine, match: Tuple[int, int, int], buf, count: int,
@@ -87,6 +98,9 @@ class Pt2ptProtocol:
         eng.register_handler(PktType.RNDV_CTS, self._on_cts)
         eng.register_handler(PktType.RNDV_DATA, self._on_data)
         eng.register_handler(PktType.RNDV_FIN, self._on_fin)
+        eng.register_handler(PktType.CANCEL_SEND_REQ, self._on_cancel_req)
+        eng.register_handler(PktType.CANCEL_SEND_RESP,
+                             self._on_cancel_resp)
         self.cfg = get_config()
 
     # ------------------------------------------------------------------
@@ -110,24 +124,49 @@ class Pt2ptProtocol:
         if mode == "buffered":
             # MPI_Bsend: copy now (pack always returns a fresh buffer),
             # complete immediately; the transfer proceeds on a shadow
-            # request (the attached-buffer semantics). Track the shadow so
-            # a failed transfer is at least logged.
+            # request (the attached-buffer semantics). Cancel delegates
+            # to the shadow and holds completion until it resolves.
             shadow = self.isend(np.asarray(datatype.pack(buf, count)),
                                 nbytes, dtmod.BYTE, dest_world, comm_src,
                                 ctx, tag, "standard")
             shadow.add_callback(
                 lambda r: r.error and log.error(
                     "buffered send to %d failed: %s", dest_world, r.error))
-            return CompletedRequest()
+            breq = SendRequest(self.engine, dest_world)
+            breq._fire()
+            if isinstance(shadow, SendRequest):
+                def bcancel():
+                    with self.engine.mutex:
+                        if getattr(breq, "_cancel_pending", False):
+                            return False
+                        breq._cancel_pending = True
+                        breq.complete_flag = False
+                    shadow.cancel()
+
+                    def on_shadow(sr):
+                        breq.cancelled = bool(
+                            getattr(sr, "cancelled", False))
+                        breq.status.cancelled = breq.cancelled
+                        breq.complete()
+                    shadow.add_callback(on_shadow)
+                    return False
+                breq._cancel_fn = bcancel
+            return breq
 
         if nbytes <= threshold and mode != "sync":
             packed = datatype.pack(buf, count)
+            sreq = SendRequest(self.engine, dest_world)
             pkt = Packet(PktType.EAGER_SEND, self.u.world_rank, ctx, comm_src,
-                         tag, nbytes, np.asarray(packed))
+                         tag, nbytes, np.asarray(packed),
+                         sreq_id=sreq.req_id)
             self._send_pkt(channel, dest_world, pkt)
             _pv_eager.inc()
             _pv_bytes.inc(nbytes)
-            return CompletedRequest()
+            # locally complete, but cancellable until matched (§3.8.4)
+            sreq._fire()
+            sreq._cancel_fn = lambda: self._cancel_send(
+                sreq, dest_world, channel)
+            return sreq
 
         # rendezvous (always used for Ssend so completion implies matching)
         sreq = SendRequest(self.engine, dest_world)
@@ -147,9 +186,53 @@ class Pt2ptProtocol:
                      extra={"handle": sreq.handle} if sreq.handle is not None
                      else None)
         self._send_pkt(channel, dest_world, pkt)
+        # MPI_Cancel on an unmatched rendezvous send retracts the RTS
+        # from the peer's unexpected queue (the ch3 cancel-send protocol,
+        # mpidpkt.h CANCEL packets); completion arrives as a RESP
+        sreq._cancel_fn = lambda: self._cancel_send(sreq, dest_world,
+                                                    channel)
         _pv_rndv.inc()
         _pv_bytes.inc(nbytes)
         return sreq
+
+    def _cancel_send(self, sreq, dest_world: int, channel) -> bool:
+        """Initiate send-cancel; async — the RESP resolves it. A
+        locally-complete eager send is held incomplete until then so
+        MPI_Wait observes the cancel's outcome."""
+        eng = self.engine
+        with eng.mutex:
+            if sreq.cancelled or getattr(sreq, "_cancel_pending", False):
+                return False
+            sreq._cancel_pending = True
+            sreq._cancel_was_complete = sreq.complete_flag
+            sreq.complete_flag = False
+            eng.outstanding[sreq.req_id] = sreq
+        pkt = Packet(PktType.CANCEL_SEND_REQ, self.u.world_rank,
+                     sreq_id=sreq.req_id)
+        self._send_pkt(channel, dest_world, pkt)
+        return False
+
+    def _on_cancel_req(self, pkt: Packet) -> None:
+        ok = self.matcher.cancel_unexpected(pkt.src_world, pkt.sreq_id)
+        resp = Packet(PktType.CANCEL_SEND_RESP, self.u.world_rank,
+                      sreq_id=pkt.sreq_id, offset=1 if ok else 0)
+        channel = self.u.channel_for(pkt.src_world)
+        self._send_pkt(channel, pkt.src_world, resp)
+
+    def _on_cancel_resp(self, pkt: Packet) -> None:
+        sreq = self.engine.outstanding.get(pkt.sreq_id)
+        if sreq is None or sreq.complete_flag:
+            return            # already completed normally: not cancelled
+        if pkt.offset:        # retracted at the target
+            sreq.cancelled = True
+            sreq.status.cancelled = True
+            if sreq.handle is not None and sreq.channel is not None \
+                    and hasattr(sreq.channel, "unexpose_buffer"):
+                sreq.channel.unexpose_buffer(sreq.handle)
+            sreq.complete()
+        elif getattr(sreq, "_cancel_was_complete", False):
+            sreq.complete()   # restore the eager local completion
+        # else: an in-flight rendezvous completes via its normal FIN
 
     def _send_pkt(self, channel, dest_world: int, pkt: Packet) -> None:
         """Channel send with failure surfacing: a connection-level error

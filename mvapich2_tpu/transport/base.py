@@ -53,6 +53,8 @@ class PktType(enum.IntEnum):
     BARRIER_CTL = 30
     REVOKE = 31            # ULFM comm revoke propagation
     SHUTDOWN = 32
+    CANCEL_SEND_REQ = 33   # retract an unmatched send (mpidpkt.h CANCEL)
+    CANCEL_SEND_RESP = 34
 
 
 class Packet:

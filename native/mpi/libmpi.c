@@ -156,6 +156,7 @@ static int shim_call_status(const char *name, MPI_Status *status,
                 status->MPI_TAG = tag;
                 status->MPI_ERROR = MPI_SUCCESS;
                 status->_count = cnt;
+                status->_cancelled = 0;
             }
             rc = MPI_SUCCESS;
         } else {
@@ -384,6 +385,7 @@ int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
                 status->MPI_TAG = t;
                 status->MPI_ERROR = MPI_SUCCESS;
                 status->_count = cnt;
+                status->_cancelled = 0;
             }
             rc = MPI_SUCCESS;
         }
@@ -435,17 +437,19 @@ int MPI_Wait(MPI_Request *req, MPI_Status *status) {
                                         (long)*req);
     int rc = MPI_ERR_OTHER;
     if (res) {
-        int src = -1, tag = -1, cnt = 0, persistent = 0;
-        if (PyArg_ParseTuple(res, "iiii", &src, &tag, &cnt,
-                             &persistent)) {
+        int src = -1, tag = -1, cnt = 0, persistent = 0, canc = 0;
+        if (PyArg_ParseTuple(res, "iiiii", &src, &tag, &cnt,
+                             &persistent, &canc)) {
             if (status != MPI_STATUS_IGNORE) {
                 status->MPI_SOURCE = src;
                 status->MPI_TAG = tag;
                 status->MPI_ERROR = MPI_SUCCESS;
                 status->_count = cnt;
+                status->_cancelled = canc;
             }
             /* persistent requests stay valid (inactive) after wait */
             mv2t_request_completed(*req);
+            mv2t_greq_completed(*req, status);
             if (!persistent)
                 *req = MPI_REQUEST_NULL;
             rc = MPI_SUCCESS;
@@ -480,18 +484,22 @@ int MPI_Test(MPI_Request *req, int *flag, MPI_Status *status) {
     int rc = MPI_ERR_OTHER;
     if (res) {
         int f = 0, persistent = 0, src = -1, tag = -1, cnt = 0;
-        if (PyArg_ParseTuple(res, "iiiii", &f, &persistent, &src, &tag,
-                             &cnt)) {
+        int canc = 0;
+        if (PyArg_ParseTuple(res, "iiiiii", &f, &persistent, &src, &tag,
+                             &cnt, &canc)) {
             *flag = f;
             if (f && status != MPI_STATUS_IGNORE) {
                 status->MPI_SOURCE = src;
                 status->MPI_TAG = tag;
                 status->MPI_ERROR = MPI_SUCCESS;
                 status->_count = cnt;
+                status->_cancelled = canc;
             }
             /* persistent requests stay valid (inactive) after test */
-            if (f)
+            if (f) {
                 mv2t_request_completed(*req);
+                mv2t_greq_completed(*req, status);
+            }
             if (f && !persistent)
                 *req = MPI_REQUEST_NULL;
             rc = MPI_SUCCESS;
@@ -891,12 +899,33 @@ int MPI_Sendrecv_replace(void *buf, int count, MPI_Datatype dt, int dest,
     return rc;
 }
 
+static void procnull_status(MPI_Status *status) {
+    /* MPI-3.1 §3.8: probe/recv from MPI_PROC_NULL completes at once
+     * with source=MPI_PROC_NULL, tag=MPI_ANY_TAG, count 0 */
+    if (status != MPI_STATUS_IGNORE) {
+        status->MPI_SOURCE = MPI_PROC_NULL;
+        status->MPI_TAG = MPI_ANY_TAG;
+        status->MPI_ERROR = MPI_SUCCESS;
+        status->_count = 0;
+        status->_cancelled = 0;
+    }
+}
+
 int MPI_Probe(int source, int tag, MPI_Comm comm, MPI_Status *status) {
+    if (source == MPI_PROC_NULL) {
+        procnull_status(status);
+        return MPI_SUCCESS;
+    }
     return shim_call_status("probe", status, "(iii)", source, tag, comm);
 }
 
 int MPI_Iprobe(int source, int tag, MPI_Comm comm, int *flag,
                MPI_Status *status) {
+    if (source == MPI_PROC_NULL) {
+        *flag = 1;
+        procnull_status(status);
+        return MPI_SUCCESS;
+    }
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *res = PyObject_CallMethod(g_shim, "iprobe", "(iii)", source,
                                         tag, comm);
@@ -910,6 +939,7 @@ int MPI_Iprobe(int source, int tag, MPI_Comm comm, int *flag,
                 status->MPI_TAG = t;
                 status->MPI_ERROR = MPI_SUCCESS;
                 status->_count = cnt;
+                status->_cancelled = 0;
             }
             rc = MPI_SUCCESS;
         } else {
@@ -932,9 +962,10 @@ int MPI_Waitany(int count, MPI_Request reqs[], int *index,
     PyObject *res = PyObject_CallMethod(g_shim, "waitany", "(O)", hl);
     int rc = MPI_ERR_OTHER;
     if (res) {
-        int pos = -1, src = -1, tag = -1, cnt = 0, persistent = 0;
-        if (PyArg_ParseTuple(res, "iiiii", &pos, &src, &tag, &cnt,
-                             &persistent)) {
+        int pos = -1, src = -1, tag = -2, cnt = 0, persistent = 0;
+        int canc = 0;
+        if (PyArg_ParseTuple(res, "iiiiii", &pos, &src, &tag, &cnt,
+                             &persistent, &canc)) {
             rc = MPI_SUCCESS;
             if (pos < 0) {
                 *index = MPI_UNDEFINED;
@@ -945,7 +976,10 @@ int MPI_Waitany(int count, MPI_Request reqs[], int *index,
                     status->MPI_TAG = tag;
                     status->MPI_ERROR = MPI_SUCCESS;
                     status->_count = cnt;
+                    status->_cancelled = canc;
                 }
+                mv2t_request_completed(reqs[pos]);
+                mv2t_greq_completed(reqs[pos], status);
                 if (!persistent)
                     reqs[pos] = MPI_REQUEST_NULL;
             }
@@ -981,16 +1015,22 @@ int MPI_Testall(int count, MPI_Request reqs[], int *flag,
                 for (int i = 0; i < count; i++) {
                     PyObject *t = PyList_Size(sts) > i
                                   ? PyList_GET_ITEM(sts, i) : NULL;
-                    int src = -1, tag = -1, cnt = 0, persistent = 0;
+                    int src = -1, tag = -2, cnt = 0, persistent = 0;
+                    int canc = 0;
                     if (t)
-                        PyArg_ParseTuple(t, "iiii", &src, &tag, &cnt,
-                                         &persistent);
+                        PyArg_ParseTuple(t, "iiiii", &src, &tag, &cnt,
+                                         &persistent, &canc);
                     if (statuses != MPI_STATUSES_IGNORE) {
                         statuses[i].MPI_SOURCE = src;
                         statuses[i].MPI_TAG = tag;
                         statuses[i].MPI_ERROR = MPI_SUCCESS;
                         statuses[i]._count = cnt;
+                        statuses[i]._cancelled = canc;
                     }
+                    mv2t_request_completed(reqs[i]);
+                    mv2t_greq_completed(
+                        reqs[i], statuses == MPI_STATUSES_IGNORE
+                        ? MPI_STATUS_IGNORE : &statuses[i]);
                     if (!persistent)
                         reqs[i] = MPI_REQUEST_NULL;
                 }

@@ -21,6 +21,8 @@
 #define MV2T_USEROP_BASE 100
 
 static int icoll_req(PyObject *res, MPI_Request *req);
+static int topo_newcomm(const char *fn, MPI_Comm comm, PyObject *args,
+                        MPI_Comm *newcomm);
 
 /* ------------------------------------------------------------------ */
 /* error translation: Python exception -> MPI error class              */
@@ -1855,6 +1857,689 @@ int MPI_Iscatter(const void *sendbuf, int sendcount, MPI_Datatype sdt,
     int rc = icoll_req(res, req);
     Py_XDECREF(sv);
     Py_XDECREF(rv);
+    PyGILState_Release(st);
+    return rc;
+}
+
+/* ------------------------------------------------------------------ */
+/* persistent buffered/synchronous/ready sends                         */
+/* ------------------------------------------------------------------ */
+
+static int psend_init(const char *mode, const void *buf, int count,
+                      MPI_Datatype dt, int dest, int tag, MPI_Comm comm,
+                      MPI_Request *req) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *view = mv_view(buf, (long)count * dt_extent_b(dt));
+    PyObject *res = PyObject_CallMethod(g_shim, "send_init", "(Oiiiiis)",
+                                        view, count, dt, dest, tag, comm,
+                                        mode);
+    int rc = MPI_ERR_OTHER;
+    if (res != NULL) {
+        long h = PyLong_AsLong(res);
+        if (!PyErr_Occurred()) {
+            *req = (MPI_Request)h;
+            rc = MPI_SUCCESS;
+        } else {
+            rc = mv2t_errcode_from_pyerr();
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    Py_XDECREF(view);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Bsend_init(const void *buf, int count, MPI_Datatype dt, int dest,
+                   int tag, MPI_Comm comm, MPI_Request *req) {
+    return psend_init("buffered", buf, count, dt, dest, tag, comm, req);
+}
+
+int MPI_Ssend_init(const void *buf, int count, MPI_Datatype dt, int dest,
+                   int tag, MPI_Comm comm, MPI_Request *req) {
+    return psend_init("sync", buf, count, dt, dest, tag, comm, req);
+}
+
+int MPI_Rsend_init(const void *buf, int count, MPI_Datatype dt, int dest,
+                   int tag, MPI_Comm comm, MPI_Request *req) {
+    return psend_init("standard", buf, count, dt, dest, tag, comm, req);
+}
+
+int MPI_Dist_graph_create(MPI_Comm comm, int n, const int sources[],
+                          const int degrees[], const int destinations[],
+                          const int weights[], MPI_Info info, int reorder,
+                          MPI_Comm *newcomm) {
+    (void)info;
+    PyGILState_STATE st = PyGILState_Ensure();
+    int nedges = 0;
+    for (int i = 0; i < n; i++)
+        nedges += degrees[i];
+    PyObject *sl = int_list(sources, n);
+    PyObject *gl = int_list(degrees, n);
+    PyObject *dl = int_list(destinations, nedges);
+    PyObject *wl;
+    int weighted = weights != MPI_UNWEIGHTED;
+    if (!weighted || weights == MPI_WEIGHTS_EMPTY) {
+        wl = Py_None;
+        Py_INCREF(Py_None);
+    } else {
+        wl = int_list(weights, nedges);
+    }
+    PyObject *args = Py_BuildValue("(iOOOOii)", comm, sl, gl, dl, wl,
+                                   reorder, weighted);
+    PyGILState_Release(st);
+    int rc = topo_newcomm("dist_graph_create", comm, args, newcomm);
+    st = PyGILState_Ensure();
+    Py_XDECREF(args);
+    Py_XDECREF(sl);
+    Py_XDECREF(gl);
+    Py_XDECREF(dl);
+    Py_XDECREF(wl);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Ibsend(const void *buf, int count, MPI_Datatype dt, int dest,
+               int tag, MPI_Comm comm, MPI_Request *req) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *view = mv_view(buf, (long)count * dt_extent_b(dt));
+    PyObject *res = PyObject_CallMethod(g_shim, "ibsend", "(Oiiiii)",
+                                        view, count, dt, dest, tag,
+                                        comm);
+    int rc = icoll_req(res, req);
+    Py_XDECREF(view);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Irsend(const void *buf, int count, MPI_Datatype dt, int dest,
+               int tag, MPI_Comm comm, MPI_Request *req) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *view = mv_view(buf, (long)count * dt_extent_b(dt));
+    PyObject *res = PyObject_CallMethod(g_shim, "irsend", "(Oiiiii)",
+                                        view, count, dt, dest, tag,
+                                        comm);
+    int rc = icoll_req(res, req);
+    Py_XDECREF(view);
+    PyGILState_Release(st);
+    return rc;
+}
+
+/* ------------------------------------------------------------------ */
+/* cancel / request status / generalized requests                      */
+/* ------------------------------------------------------------------ */
+
+int MPI_Cancel(MPI_Request *req) {
+    if (*req == MPI_REQUEST_NULL)
+        return MPI_ERR_REQUEST;
+    return shim_call_i("cancel", "(l)", (long)*req);
+}
+
+int MPI_Test_cancelled(const MPI_Status *status, int *flag) {
+    *flag = status->_cancelled;
+    return MPI_SUCCESS;
+}
+
+int MPI_Status_set_cancelled(MPI_Status *status, int flag) {
+    status->_cancelled = flag;
+    return MPI_SUCCESS;
+}
+
+int MPI_Status_set_elements(MPI_Status *status, MPI_Datatype dt,
+                            int count) {
+    status->_count = count * dt_size(dt);
+    return MPI_SUCCESS;
+}
+
+int MPI_Request_get_status(MPI_Request req, int *flag,
+                           MPI_Status *status) {
+    if (req == MPI_REQUEST_NULL) {
+        *flag = 1;
+        if (status != MPI_STATUS_IGNORE) {
+            status->MPI_SOURCE = MPI_ANY_SOURCE;
+            status->MPI_TAG = MPI_ANY_TAG;
+            status->MPI_ERROR = MPI_SUCCESS;
+            status->_count = 0;
+            status->_cancelled = 0;
+        }
+        return MPI_SUCCESS;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "request_get_status",
+                                        "(l)", (long)req);
+    int rc = MPI_ERR_OTHER;
+    if (res != NULL) {
+        int f = 0, src = -1, tag = -2, cnt = 0, canc = 0;
+        if (PyArg_ParseTuple(res, "iiiii", &f, &src, &tag, &cnt,
+                             &canc)) {
+            *flag = f;
+            if (f && status != MPI_STATUS_IGNORE) {
+                status->MPI_SOURCE = src;
+                status->MPI_TAG = tag;
+                status->MPI_ERROR = MPI_SUCCESS;
+                status->_count = cnt;
+                status->_cancelled = canc;
+            }
+            rc = MPI_SUCCESS;
+        } else {
+            PyErr_Clear();
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+/* generalized requests: the callbacks are C function pointers invoked
+ * around completion — query fills the status at Wait/Test, free runs
+ * when the request is released (MPI-3.1 §12.2) */
+typedef struct greq_node {
+    MPI_Request req;
+    MPI_Grequest_query_function *query_fn;
+    MPI_Grequest_free_function *free_fn;
+    MPI_Grequest_cancel_function *cancel_fn;
+    void *extra;
+    struct greq_node *next;
+} greq_node;
+static greq_node *g_greqs;
+
+int MPI_Grequest_start(MPI_Grequest_query_function *query_fn,
+                       MPI_Grequest_free_function *free_fn,
+                       MPI_Grequest_cancel_function *cancel_fn,
+                       void *extra_state, MPI_Request *req) {
+    int ok;
+    long h = shim_call_v("grequest_start", &ok, "()");
+    if (!ok)
+        return MPI_ERR_OTHER;
+    greq_node *n = malloc(sizeof *n);
+    if (n == NULL)
+        return MPI_ERR_INTERN;
+    n->req = (MPI_Request)h;
+    n->query_fn = query_fn;
+    n->free_fn = free_fn;
+    n->cancel_fn = cancel_fn;
+    n->extra = extra_state;
+    n->next = g_greqs;
+    g_greqs = n;
+    *req = (MPI_Request)h;
+    return MPI_SUCCESS;
+}
+
+int MPI_Grequest_complete(MPI_Request req) {
+    return shim_call_i("grequest_complete", "(l)", (long)req);
+}
+
+/* MPI_Request_free on a generalized request: free_fn must still run
+ * (MPI-3.1 §12.2) */
+void mv2t_greq_freed(MPI_Request req) {
+    greq_node **p = &g_greqs;
+    while (*p != NULL) {
+        if ((*p)->req == req) {
+            greq_node *d = *p;
+            if (d->free_fn != NULL)
+                d->free_fn(d->extra);
+            *p = d->next;
+            free(d);
+            return;
+        }
+        p = &(*p)->next;
+    }
+}
+
+/* called from the Wait/Test completion hook in libmpi.c (alongside the
+ * idup resolution) — runs query_fn into the status then free_fn */
+int mv2t_greq_completed(MPI_Request req, MPI_Status *status) {
+    greq_node **p = &g_greqs;
+    while (*p != NULL) {
+        if ((*p)->req == req) {
+            greq_node *d = *p;
+            int rc = MPI_SUCCESS;
+            if (d->query_fn != NULL && status != MPI_STATUS_IGNORE)
+                rc = d->query_fn(d->extra, status);
+            if (d->free_fn != NULL)
+                d->free_fn(d->extra);
+            *p = d->next;
+            free(d);
+            return rc;
+        }
+        p = &(*p)->next;
+    }
+    return MPI_SUCCESS;
+}
+
+/* ------------------------------------------------------------------ */
+/* process topologies (forwarders into core/topo.py)                   */
+/* ------------------------------------------------------------------ */
+
+static int int_list_out(PyObject *seq, int out[], int maxn) {
+    /* copy a Python int sequence into a C array; returns count */
+    Py_ssize_t n = PySequence_Size(seq);
+    int m = (int)(n < maxn ? n : maxn);
+    for (int i = 0; i < m; i++) {
+        PyObject *it = PySequence_GetItem(seq, i);
+        out[i] = (int)PyLong_AsLong(it);
+        Py_XDECREF(it);
+    }
+    return m;
+}
+
+int MPI_Dims_create(int nnodes, int ndims, int dims[]) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *dl = int_list(dims, ndims);
+    PyObject *res = PyObject_CallMethod(g_shim, "dims_create", "(iiO)",
+                                        nnodes, ndims, dl);
+    int rc = MPI_ERR_DIMS;
+    if (res != NULL) {
+        int_list_out(res, dims, ndims);
+        rc = PyErr_Occurred() ? mv2t_errcode_from_pyerr() : MPI_SUCCESS;
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    Py_XDECREF(dl);
+    PyGILState_Release(st);
+    return rc;
+}
+
+static int topo_newcomm(const char *fn, MPI_Comm comm, PyObject *args,
+                        MPI_Comm *newcomm) {
+    /* args is a BORROWED tuple built by the caller (steals nothing) */
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *f = PyObject_GetAttrString(g_shim, fn);
+    PyObject *res = f ? PyObject_CallObject(f, args) : NULL;
+    int rc = MPI_ERR_TOPOLOGY;
+    if (res != NULL) {
+        long h = PyLong_AsLong(res);
+        if (!PyErr_Occurred()) {
+            *newcomm = h < 0 ? MPI_COMM_NULL : (MPI_Comm)h;
+            if (*newcomm != MPI_COMM_NULL)
+                mv2t_set_comm_errhandler(
+                    *newcomm, mv2t_get_comm_errhandler(comm));
+            rc = MPI_SUCCESS;
+        } else {
+            rc = mv2t_errcode_from_pyerr();
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    Py_XDECREF(f);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Cart_create(MPI_Comm comm, int ndims, const int dims[],
+                    const int periods[], int reorder, MPI_Comm *newcomm) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *dl = int_list(dims, ndims);
+    PyObject *pl = int_list(periods, ndims);
+    PyObject *args = Py_BuildValue("(iOOi)", comm, dl, pl, reorder);
+    PyGILState_Release(st);
+    int rc = topo_newcomm("cart_create", comm, args, newcomm);
+    st = PyGILState_Ensure();
+    Py_XDECREF(args);
+    Py_XDECREF(dl);
+    Py_XDECREF(pl);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Cart_rank(MPI_Comm comm, const int coords[], int *rank) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    int nd;
+    if (MPI_Cartdim_get(comm, &nd) != MPI_SUCCESS) {
+        PyGILState_Release(st);
+        return MPI_ERR_TOPOLOGY;
+    }
+    PyObject *cl = int_list(coords, nd);
+    PyObject *res = PyObject_CallMethod(g_shim, "cart_rank", "(iO)",
+                                        comm, cl);
+    int rc = MPI_ERR_TOPOLOGY;
+    if (res != NULL) {
+        long v = PyLong_AsLong(res);
+        if (!PyErr_Occurred()) {
+            *rank = (int)v;
+            rc = MPI_SUCCESS;
+        } else {
+            rc = mv2t_errcode_from_pyerr();
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    Py_XDECREF(cl);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Cart_coords(MPI_Comm comm, int rank, int maxdims, int coords[]) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "cart_coords", "(ii)",
+                                        comm, rank);
+    int rc = MPI_ERR_TOPOLOGY;
+    if (res != NULL) {
+        int_list_out(res, coords, maxdims);
+        rc = PyErr_Occurred() ? mv2t_errcode_from_pyerr() : MPI_SUCCESS;
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Cart_shift(MPI_Comm comm, int direction, int disp,
+                   int *rank_source, int *rank_dest) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "cart_shift", "(iii)",
+                                        comm, direction, disp);
+    int rc = MPI_ERR_TOPOLOGY;
+    if (res != NULL) {
+        int s = MPI_PROC_NULL, d = MPI_PROC_NULL;
+        if (PyArg_ParseTuple(res, "ii", &s, &d)) {
+            *rank_source = s;
+            *rank_dest = d;
+            rc = MPI_SUCCESS;
+        } else {
+            PyErr_Clear();
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Cart_sub(MPI_Comm comm, const int remain_dims[],
+                 MPI_Comm *newcomm) {
+    int nd;
+    int rc0 = MPI_Cartdim_get(comm, &nd);
+    if (rc0 != MPI_SUCCESS)
+        return rc0;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *rl = int_list(remain_dims, nd);
+    PyObject *args = Py_BuildValue("(iO)", comm, rl);
+    PyGILState_Release(st);
+    int rc = topo_newcomm("cart_sub", comm, args, newcomm);
+    st = PyGILState_Ensure();
+    Py_XDECREF(args);
+    Py_XDECREF(rl);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Cart_get(MPI_Comm comm, int maxdims, int dims[], int periods[],
+                 int coords[]) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "cart_get", "(i)", comm);
+    int rc = MPI_ERR_TOPOLOGY;
+    if (res != NULL) {
+        PyObject *dl, *pl, *cl;
+        if (PyArg_ParseTuple(res, "OOO", &dl, &pl, &cl)) {
+            int_list_out(dl, dims, maxdims);
+            int_list_out(pl, periods, maxdims);
+            int_list_out(cl, coords, maxdims);
+            rc = MPI_SUCCESS;
+        } else {
+            PyErr_Clear();
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Cartdim_get(MPI_Comm comm, int *ndims) {
+    int ok;
+    long v = shim_call_v("cartdim_get", &ok, "(i)", comm);
+    if (!ok)
+        return MPI_ERR_TOPOLOGY;
+    *ndims = (int)v;
+    return MPI_SUCCESS;
+}
+
+int MPI_Cart_map(MPI_Comm comm, int ndims, const int dims[],
+                 const int periods[], int *newrank) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *dl = int_list(dims, ndims);
+    PyObject *pl = int_list(periods, ndims);
+    PyObject *res = PyObject_CallMethod(g_shim, "cart_map", "(iOO)",
+                                        comm, dl, pl);
+    int rc = MPI_ERR_TOPOLOGY;
+    if (res != NULL) {
+        long v = PyLong_AsLong(res);
+        if (!PyErr_Occurred()) {
+            *newrank = (int)v;
+            rc = MPI_SUCCESS;
+        } else {
+            rc = mv2t_errcode_from_pyerr();
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    Py_XDECREF(dl);
+    Py_XDECREF(pl);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Graph_create(MPI_Comm comm, int nnodes, const int index[],
+                     const int edges[], int reorder, MPI_Comm *newcomm) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    int nedges = nnodes > 0 ? index[nnodes - 1] : 0;
+    PyObject *il = int_list(index, nnodes);
+    PyObject *el = int_list(edges, nedges);
+    PyObject *args = Py_BuildValue("(iOOi)", comm, il, el, reorder);
+    PyGILState_Release(st);
+    int rc = topo_newcomm("graph_create", comm, args, newcomm);
+    st = PyGILState_Ensure();
+    Py_XDECREF(args);
+    Py_XDECREF(il);
+    Py_XDECREF(el);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Graphdims_get(MPI_Comm comm, int *nnodes, int *nedges) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "graphdims_get", "(i)",
+                                        comm);
+    int rc = MPI_ERR_TOPOLOGY;
+    if (res != NULL) {
+        if (PyArg_ParseTuple(res, "ii", nnodes, nedges))
+            rc = MPI_SUCCESS;
+        else
+            PyErr_Clear();
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Graph_get(MPI_Comm comm, int maxindex, int maxedges, int index[],
+                  int edges[]) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "graph_get", "(i)", comm);
+    int rc = MPI_ERR_TOPOLOGY;
+    if (res != NULL) {
+        PyObject *il, *el;
+        if (PyArg_ParseTuple(res, "OO", &il, &el)) {
+            int_list_out(il, index, maxindex);
+            int_list_out(el, edges, maxedges);
+            rc = MPI_SUCCESS;
+        } else {
+            PyErr_Clear();
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Graph_neighbors_count(MPI_Comm comm, int rank, int *nneighbors) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "graph_neighbors", "(ii)",
+                                        comm, rank);
+    int rc = MPI_ERR_TOPOLOGY;
+    if (res != NULL) {
+        Py_ssize_t n = PySequence_Size(res);
+        if (n >= 0) {
+            *nneighbors = (int)n;
+            rc = MPI_SUCCESS;
+        } else {
+            PyErr_Clear();
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Graph_neighbors(MPI_Comm comm, int rank, int maxneighbors,
+                        int neighbors[]) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "graph_neighbors", "(ii)",
+                                        comm, rank);
+    int rc = MPI_ERR_TOPOLOGY;
+    if (res != NULL) {
+        int_list_out(res, neighbors, maxneighbors);
+        rc = PyErr_Occurred() ? mv2t_errcode_from_pyerr() : MPI_SUCCESS;
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Graph_map(MPI_Comm comm, int nnodes, const int index[],
+                  const int edges[], int *newrank) {
+    (void)index; (void)edges;
+    int rank;
+    MPI_Comm_rank(comm, &rank);
+    *newrank = rank < nnodes ? rank : MPI_UNDEFINED;
+    return MPI_SUCCESS;
+}
+
+int MPI_Topo_test(MPI_Comm comm, int *status) {
+    int ok;
+    long v = shim_call_v("topo_test", &ok, "(i)", comm);
+    if (!ok)
+        return MPI_ERR_COMM;
+    *status = (int)v;
+    return MPI_SUCCESS;
+}
+
+int MPI_Dist_graph_create_adjacent(MPI_Comm comm, int indegree,
+                                   const int sources[],
+                                   const int sourceweights[],
+                                   int outdegree,
+                                   const int destinations[],
+                                   const int destweights[],
+                                   MPI_Info info, int reorder,
+                                   MPI_Comm *newcomm) {
+    (void)info;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *sl = int_list(sources, indegree);
+    PyObject *dl = int_list(destinations, outdegree);
+    PyObject *sw, *dw;
+    if (sourceweights == MPI_UNWEIGHTED
+        || sourceweights == MPI_WEIGHTS_EMPTY) {
+        sw = Py_None;
+        Py_INCREF(Py_None);
+    } else {
+        sw = int_list(sourceweights, indegree);
+    }
+    if (destweights == MPI_UNWEIGHTED
+        || destweights == MPI_WEIGHTS_EMPTY) {
+        dw = Py_None;
+        Py_INCREF(Py_None);
+    } else {
+        dw = int_list(destweights, outdegree);
+    }
+    int weighted = sourceweights != MPI_UNWEIGHTED
+        && destweights != MPI_UNWEIGHTED;
+    PyObject *args = Py_BuildValue("(iOOOOii)", comm, sl, sw, dl, dw,
+                                   reorder, weighted);
+    PyGILState_Release(st);
+    int rc = topo_newcomm("dist_graph_create_adjacent", comm, args,
+                          newcomm);
+    st = PyGILState_Ensure();
+    Py_XDECREF(args);
+    Py_XDECREF(sl);
+    Py_XDECREF(dl);
+    Py_XDECREF(sw);
+    Py_XDECREF(dw);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Dist_graph_neighbors_count(MPI_Comm comm, int *indegree,
+                                   int *outdegree, int *weighted) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "dist_graph_neighbors",
+                                        "(i)", comm);
+    int rc = MPI_ERR_TOPOLOGY;
+    if (res != NULL) {
+        PyObject *sl, *sw, *dl, *dw;
+        int w;
+        if (PyArg_ParseTuple(res, "OOOOi", &sl, &sw, &dl, &dw, &w)) {
+            *indegree = (int)PySequence_Size(sl);
+            *outdegree = (int)PySequence_Size(dl);
+            *weighted = w;
+            rc = MPI_SUCCESS;
+        } else {
+            PyErr_Clear();
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Dist_graph_neighbors(MPI_Comm comm, int maxindegree,
+                             int sources[], int sourceweights[],
+                             int maxoutdegree, int destinations[],
+                             int destweights[]) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "dist_graph_neighbors",
+                                        "(i)", comm);
+    int rc = MPI_ERR_TOPOLOGY;
+    if (res != NULL) {
+        PyObject *sl, *sw, *dl, *dw;
+        int w;
+        if (PyArg_ParseTuple(res, "OOOOi", &sl, &sw, &dl, &dw, &w)) {
+            int_list_out(sl, sources, maxindegree);
+            int_list_out(dl, destinations, maxoutdegree);
+            if (sourceweights != MPI_UNWEIGHTED
+                && sourceweights != MPI_WEIGHTS_EMPTY)
+                int_list_out(sw, sourceweights, maxindegree);
+            if (destweights != MPI_UNWEIGHTED
+                && destweights != MPI_WEIGHTS_EMPTY)
+                int_list_out(dw, destweights, maxoutdegree);
+            rc = MPI_SUCCESS;
+        } else {
+            PyErr_Clear();
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
     PyGILState_Release(st);
     return rc;
 }

@@ -37,5 +37,6 @@ int mv2t_errcheck(MPI_Comm comm, int rc);
 void mv2t_errhandler_free(MPI_Errhandler eh);
 void mv2t_comm_eh_forget(int comm);
 void mv2t_request_completed(MPI_Request req);
+int mv2t_greq_completed(MPI_Request req, MPI_Status *status);
 
 #endif /* MV2T_LIBMPI_INTERNAL_H */

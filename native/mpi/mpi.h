@@ -35,6 +35,7 @@ typedef struct MPI_Status {
     int MPI_TAG;
     int MPI_ERROR;
     int _count;     /* bytes received */
+    int _cancelled;
 } MPI_Status;
 
 /* communicators */
@@ -132,6 +133,7 @@ typedef struct MPI_Status {
 #define MPI_BOTTOM       ((void *)0)
 #define MPI_MAX_PROCESSOR_NAME 256
 #define MPI_MAX_ERROR_STRING   512
+#define MPI_BSEND_OVERHEAD     96
 
 /* error classes (mirrors mvapich2_tpu/core/errors.py) */
 #define MPI_SUCCESS      0
@@ -196,6 +198,10 @@ int MPI_Rsend(const void *buf, int count, MPI_Datatype dt, int dest,
               int tag, MPI_Comm comm);
 int MPI_Issend(const void *buf, int count, MPI_Datatype dt, int dest,
                int tag, MPI_Comm comm, MPI_Request *req);
+int MPI_Ibsend(const void *buf, int count, MPI_Datatype dt, int dest,
+               int tag, MPI_Comm comm, MPI_Request *req);
+int MPI_Irsend(const void *buf, int count, MPI_Datatype dt, int dest,
+               int tag, MPI_Comm comm, MPI_Request *req);
 int MPI_Sendrecv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
                  int dest, int sendtag, void *recvbuf, int recvcount,
                  MPI_Datatype rdt, int source, int recvtag, MPI_Comm comm,
@@ -214,6 +220,12 @@ int MPI_Send_init(const void *buf, int count, MPI_Datatype dt, int dest,
                   int tag, MPI_Comm comm, MPI_Request *req);
 int MPI_Recv_init(void *buf, int count, MPI_Datatype dt, int source,
                   int tag, MPI_Comm comm, MPI_Request *req);
+int MPI_Bsend_init(const void *buf, int count, MPI_Datatype dt, int dest,
+                   int tag, MPI_Comm comm, MPI_Request *req);
+int MPI_Ssend_init(const void *buf, int count, MPI_Datatype dt, int dest,
+                   int tag, MPI_Comm comm, MPI_Request *req);
+int MPI_Rsend_init(const void *buf, int count, MPI_Datatype dt, int dest,
+                   int tag, MPI_Comm comm, MPI_Request *req);
 int MPI_Start(MPI_Request *req);
 int MPI_Startall(int count, MPI_Request reqs[]);
 int MPI_Request_free(MPI_Request *req);
@@ -642,6 +654,77 @@ int MPI_Comm_dup_with_info(MPI_Comm comm, MPI_Info info,
                            MPI_Comm *newcomm);
 int MPI_Comm_set_info(MPI_Comm comm, MPI_Info info);
 int MPI_Comm_get_info(MPI_Comm comm, MPI_Info *info_used);
+
+/* ---- cancel / request status ---- */
+int MPI_Cancel(MPI_Request *req);
+int MPI_Test_cancelled(const MPI_Status *status, int *flag);
+int MPI_Status_set_cancelled(MPI_Status *status, int flag);
+int MPI_Status_set_elements(MPI_Status *status, MPI_Datatype dt,
+                            int count);
+int MPI_Request_get_status(MPI_Request req, int *flag,
+                           MPI_Status *status);
+
+/* ---- generalized requests ---- */
+typedef int (MPI_Grequest_query_function)(void *extra_state,
+                                          MPI_Status *status);
+typedef int (MPI_Grequest_free_function)(void *extra_state);
+typedef int (MPI_Grequest_cancel_function)(void *extra_state,
+                                           int complete);
+int MPI_Grequest_start(MPI_Grequest_query_function *query_fn,
+                       MPI_Grequest_free_function *free_fn,
+                       MPI_Grequest_cancel_function *cancel_fn,
+                       void *extra_state, MPI_Request *req);
+int MPI_Grequest_complete(MPI_Request req);
+
+/* ---- process topologies ---- */
+#define MPI_GRAPH      1
+#define MPI_CART       2
+#define MPI_DIST_GRAPH 3
+#define MPI_UNWEIGHTED       ((int *)1)
+#define MPI_WEIGHTS_EMPTY    ((int *)2)
+int MPI_Dims_create(int nnodes, int ndims, int dims[]);
+int MPI_Cart_create(MPI_Comm comm, int ndims, const int dims[],
+                    const int periods[], int reorder, MPI_Comm *newcomm);
+int MPI_Cart_rank(MPI_Comm comm, const int coords[], int *rank);
+int MPI_Cart_coords(MPI_Comm comm, int rank, int maxdims, int coords[]);
+int MPI_Cart_shift(MPI_Comm comm, int direction, int disp,
+                   int *rank_source, int *rank_dest);
+int MPI_Cart_sub(MPI_Comm comm, const int remain_dims[],
+                 MPI_Comm *newcomm);
+int MPI_Cart_get(MPI_Comm comm, int maxdims, int dims[], int periods[],
+                 int coords[]);
+int MPI_Cartdim_get(MPI_Comm comm, int *ndims);
+int MPI_Cart_map(MPI_Comm comm, int ndims, const int dims[],
+                 const int periods[], int *newrank);
+int MPI_Graph_create(MPI_Comm comm, int nnodes, const int index[],
+                     const int edges[], int reorder, MPI_Comm *newcomm);
+int MPI_Graphdims_get(MPI_Comm comm, int *nnodes, int *nedges);
+int MPI_Graph_get(MPI_Comm comm, int maxindex, int maxedges, int index[],
+                  int edges[]);
+int MPI_Graph_neighbors_count(MPI_Comm comm, int rank, int *nneighbors);
+int MPI_Graph_neighbors(MPI_Comm comm, int rank, int maxneighbors,
+                        int neighbors[]);
+int MPI_Graph_map(MPI_Comm comm, int nnodes, const int index[],
+                  const int edges[], int *newrank);
+int MPI_Topo_test(MPI_Comm comm, int *status);
+int MPI_Dist_graph_create_adjacent(MPI_Comm comm, int indegree,
+                                   const int sources[],
+                                   const int sourceweights[],
+                                   int outdegree,
+                                   const int destinations[],
+                                   const int destweights[],
+                                   MPI_Info info, int reorder,
+                                   MPI_Comm *newcomm);
+int MPI_Dist_graph_create(MPI_Comm comm, int n, const int sources[],
+                          const int degrees[], const int destinations[],
+                          const int weights[], MPI_Info info, int reorder,
+                          MPI_Comm *newcomm);
+int MPI_Dist_graph_neighbors_count(MPI_Comm comm, int *indegree,
+                                   int *outdegree, int *weighted);
+int MPI_Dist_graph_neighbors(MPI_Comm comm, int maxindegree,
+                             int sources[], int sourceweights[],
+                             int maxoutdegree, int destinations[],
+                             int destweights[]);
 
 /* ---- request-based RMA (completes at the enclosing sync; the
  * returned request is pre-completed) ---- */
