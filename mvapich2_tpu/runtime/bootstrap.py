@@ -164,8 +164,10 @@ def _start_failure_watcher(u: Universe, kvs_addr: str) -> None:
                 dead = int(w.get(f"__failure_ev_{n}"))   # blocks until put
                 u.mark_failed(dead)
                 n += 1
-        except (OSError, ConnectionError):
-            pass   # KVS gone = job tearing down
+        except (OSError, ConnectionError, KeyError):
+            # KVS gone = job tearing down; a KeyError is the server
+            # unparking a blocked get because the job aborted
+            pass
         except Exception as e:   # anything else disables detection: say so
             log.error("failure watcher died: %r — process failures will "
                       "no longer be detected on this rank", e)

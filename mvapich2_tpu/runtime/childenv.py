@@ -1,0 +1,59 @@
+"""Child-process environment construction shared by the launch paths
+(launcher, mpispawn agents, MPI_Comm_spawn)."""
+
+# Env vars that trigger an accelerator-tunnel sitecustomize hook at
+# interpreter start (imports jax in *every* python child, ~7 s/process
+# on a 1-core host — visible directly in the osu_init startup metric).
+_TUNNEL_VARS = ("PALLAS_AXON_POOL_IPS",)
+_STASH = "MV2T_STASH_"
+
+
+def strip_tunnel(env: dict) -> dict:
+    """Stash (not drop) the tunnel trigger(s) so a downstream launch
+    path that opts a process back onto the accelerator can restore
+    them (mpispawn agent -> accelerator rank, spawned children)."""
+    for v in _TUNNEL_VARS:
+        if v in env:
+            env.setdefault(_STASH + v, env[v])
+            del env[v]
+    return env
+
+
+def restore_tunnel(env: dict) -> dict:
+    for v in _TUNNEL_VARS:
+        if v not in env and _STASH + v in env:
+            env[v] = env[_STASH + v]
+    return env
+
+
+def cpu_rank_env(env: dict, explicit: bool = False) -> dict:
+    """Finalize a rank child's environment.
+
+    Rank processes run the host runtime only (progress loop, matching,
+    channels) and must not grab the accelerator — so ``JAX_PLATFORMS``
+    is *forced* to cpu, not defaulted: the launcher's own environment
+    often carries the accelerator platform (e.g. a TPU tunnel), and
+    inheriting it makes every rank fight over the one device.
+
+    Opt-outs, both of which survive into the rank env so nested launch
+    paths (mpispawn agents, MPI_Comm_spawn children) keep them:
+      * ``MV2T_RANK_PLATFORM=<platform>`` — ranks get that platform;
+      * ``explicit=True`` (caller passed JAX_PLATFORMS via env_extra) —
+        recorded as ``MV2T_PLATFORM_EXPLICIT=1``.
+
+    CPU ranks additionally get the tunnel trigger stashed away (see
+    ``strip_tunnel``); accelerator ranks get it restored.
+    """
+    if explicit:
+        env["MV2T_PLATFORM_EXPLICIT"] = "1"
+    explicit = env.get("MV2T_PLATFORM_EXPLICIT") == "1"
+    want = env.get("MV2T_RANK_PLATFORM")
+    if want:
+        env["JAX_PLATFORMS"] = want
+    elif not explicit:
+        env["JAX_PLATFORMS"] = "cpu"
+    if env.get("JAX_PLATFORMS") == "cpu":
+        strip_tunnel(env)
+    else:
+        restore_tunnel(env)
+    return env

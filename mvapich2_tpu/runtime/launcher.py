@@ -23,7 +23,20 @@ import threading
 import time
 from typing import List, Optional
 
+from .childenv import cpu_rank_env, strip_tunnel
+
 from .kvs import KVSServer
+
+
+def _abort_exit_code(aborted: Optional[str], default: int = 1) -> int:
+    """Exit code for an MPI_Abort-ed job: the errorcode travels in the
+    abort event, not the aborting rank's exit status (the launcher's
+    kill can beat that rank to its own os._exit — mpirun_rsh likewise
+    propagates the code out-of-band). Codes that can't be an exit
+    status (<=0, >=256) degrade to the generic failure code."""
+    m = re.search(r"MPI_Abort\((-?\d+)\)", aborted or "")
+    code = int(m.group(1)) if m else default
+    return code if 0 < code < 256 else 1
 
 
 def _kill_all(procs: List[subprocess.Popen]) -> None:
@@ -78,7 +91,8 @@ def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
             if env_extra:
                 env.update(env_extra)
             # rank processes must not grab the TPU: host runtime is CPU-side
-            env.setdefault("JAX_PLATFORMS", "cpu")
+            cpu_rank_env(env,
+                         explicit="JAX_PLATFORMS" in (env_extra or {}))
             procs.append(subprocess.Popen(argv, env=env))
         deadline = time.monotonic() + timeout if timeout else None
         exit_codes: List[Optional[int]] = [None] * nranks
@@ -95,9 +109,9 @@ def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
                 print(f"mv2t-launch: {srv.state.aborted}",
                       file=sys.stderr)
                 _kill_all(procs)
-                # reap everything so the aborting rank's errorcode is
-                # visible (mpirun_rsh propagates MPI_Abort's code)
                 codes = [p.wait() for p in procs]
+                if re.search(r"MPI_Abort\(", srv.state.aborted or ""):
+                    return _abort_exit_code(srv.state.aborted)
                 pos = [c for c in codes if c > 0]
                 return max(pos) if pos else 1
             bad = [i for i, c in enumerate(exit_codes)
@@ -190,7 +204,13 @@ def launch_tree(nranks: int, argv: List[str], hostfile_path: str,
             cmd = [sys.executable, "-m", "mvapich2_tpu.runtime.mpispawn",
                    _json.dumps(spec)]
             if _node_is_local(node):
-                agents.append(subprocess.Popen(cmd))
+                # the agent is host-runtime only: don't let it pay the
+                # accelerator-tunnel interpreter-startup tax (the
+                # trigger is stashed, so the agent can still hand it to
+                # ranks that opt onto the accelerator)
+                agent_env = strip_tunnel(dict(os.environ))
+                agent_env["JAX_PLATFORMS"] = "cpu"
+                agents.append(subprocess.Popen(cmd, env=agent_env))
             else:
                 import shlex
                 agents.append(subprocess.Popen(
@@ -208,11 +228,9 @@ def launch_tree(nranks: int, argv: List[str], hostfile_path: str,
                 print(f"mv2t-launch: {srv.state.aborted}",
                       file=sys.stderr)
                 _stop_agents(agents)
-                m = re.search(r"MPI_Abort\((\d+)\)",
-                              srv.state.aborted or "")
-                # an aborted job is never a success (code 0 -> 1), same
-                # as the single-host path
-                return (int(m.group(1)) if m else 1) or 1
+                # an aborted job is never a success — same rule as the
+                # single-host path
+                return _abort_exit_code(srv.state.aborted)
             bad = [c for c in rcs if c is not None and c != 0]
             if bad and not ft:
                 _stop_agents(agents)
@@ -272,7 +290,8 @@ def launch_vpod(nranks: int, argv: List[str],
         import re
         env = dict(os.environ)
         env["MV2T_VPOD_CHILD"] = "1"
-        env["JAX_PLATFORMS"] = "cpu"
+        env["JAX_PLATFORMS"] = "cpu"   # deliberate: vpod emulation is host-side
+        strip_tunnel(env)
         flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
                        env.get("XLA_FLAGS", ""))
         env["XLA_FLAGS"] = (

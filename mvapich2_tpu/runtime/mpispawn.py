@@ -29,6 +29,7 @@ import sys
 import time
 from typing import Dict, List, Optional
 
+from .childenv import cpu_rank_env
 from .kvs import KVSClient
 
 
@@ -53,7 +54,8 @@ def run_agent(spec: Dict) -> int:
         if spec.get("ft"):
             env["MV2T_FT"] = "1"
         # rank processes must not grab the accelerator: host runtime only
-        env.setdefault("JAX_PLATFORMS", "cpu")
+        cpu_rank_env(env,
+                     explicit="JAX_PLATFORMS" in (spec.get("env") or {}))
         procs[r] = subprocess.Popen(spec["argv"], env=env)
 
     def _kill_all(*_a):

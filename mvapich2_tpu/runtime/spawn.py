@@ -35,6 +35,7 @@ from ..core.group import Group
 from ..core.intercomm import Intercomm, bcast_json, bridge_agree
 from ..core.status import ANY_SOURCE
 from ..utils.mlog import get_logger
+from .childenv import cpu_rank_env
 
 log = get_logger("spawn")
 
@@ -119,7 +120,7 @@ def _spawn_procs(comm: Comm, cmds, root: int, ctx: int,
                 env["MV2T_APPNUM"] = str(appnum)
                 env["MV2T_PARENT_RANKS"] = json.dumps(
                     list(comm.group.world_ranks))
-                env.setdefault("JAX_PLATFORMS", "cpu")
+                cpu_rank_env(env)
                 try:
                     procs.append(subprocess.Popen(argv, env=env))
                 except OSError as e:

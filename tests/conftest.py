@@ -27,3 +27,22 @@ if not os.environ.get("MV2T_TEST_ON_TPU"):
         jax.config.update("jax_platforms", "cpu")
     except ImportError:
         pass
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy multi-process / C-compile / large-model tests — "
+        "skipped by default so the suite finishes in minutes on a "
+        "1-core host; run everything with MV2T_TEST_FULL=1")
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("MV2T_TEST_FULL"):
+        return
+    skip = pytest.mark.skip(reason="slow lane: set MV2T_TEST_FULL=1")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

@@ -54,6 +54,7 @@ def test_cabi_extended_surface():
 
 @pytest.mark.skipif(not os.path.isdir(OSU),
                     reason="reference OSU suite not mounted")
+@pytest.mark.slow
 def test_unmodified_osu_latency():
     """The north-star contract: the reference's osu_latency.c builds and
     runs UNMODIFIED (BASELINE.json acceptance harness)."""
@@ -75,6 +76,7 @@ def test_unmodified_osu_latency():
 
 @pytest.mark.skipif(not os.path.isdir(OSU),
                     reason="reference OSU suite not mounted")
+@pytest.mark.slow
 def test_unmodified_osu_allreduce():
     out = os.path.join(tempfile.mkdtemp(), "osu_allreduce")
     _compile([os.path.join(OSU, "mpi", "collective", "osu_allreduce.c"),
@@ -103,6 +105,7 @@ def test_cabi_widened_surface():
 
 @pytest.mark.skipif(not os.path.isdir(OSU),
                     reason="reference OSU suite not mounted")
+@pytest.mark.slow
 def test_unmodified_osu_allgatherv():
     """The v-collective OSU programs build and run unmodified."""
     out = os.path.join(tempfile.mkdtemp(), "osu_allgatherv")
@@ -121,6 +124,7 @@ def test_unmodified_osu_allgatherv():
 
 @pytest.mark.skipif(not os.path.isdir(OSU),
                     reason="reference OSU suite not mounted")
+@pytest.mark.slow
 def test_unmodified_osu_reduce_scatter():
     out = os.path.join(tempfile.mkdtemp(), "osu_reduce_scatter")
     _compile([os.path.join(OSU, "mpi", "collective",

@@ -119,13 +119,17 @@ def test_gather_scatter(nranks):
 def test_barrier(nranks):
     import time
 
+    # ranks are threads in one process, so time.monotonic() is one clock:
+    # record when rank 0 actually enters and assert nobody exits earlier
+    # (a per-rank t0 would race against thread start skew)
+    enter0 = {}
+
     def fn(comm):
-        t0 = time.monotonic()
         if comm.rank == 0:
             time.sleep(0.05)
+            enter0["t"] = time.monotonic()
         comm.barrier()
-        dt_s = time.monotonic() - t0
-        assert dt_s >= 0.045  # nobody leaves before rank 0 arrives
+        assert "t" in enter0, "rank left barrier before rank 0 entered"
     run_ranks(nranks, fn)
 
 

@@ -110,6 +110,7 @@ def test_scan_axis(comm8):
     np.testing.assert_allclose(np.asarray(out), np.arange(1, 9))
 
 
+@pytest.mark.slow
 def test_ring_allreduce_manual_matches_psum(comm8):
     x = jnp.arange(80, dtype=jnp.float32).reshape(8, 10)
 

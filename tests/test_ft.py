@@ -233,6 +233,7 @@ def test_mpirun_ft_error_exit_not_masked():
     assert r.returncode == 1, f"stdout={r.stdout}\nstderr={r.stderr}"
 
 
+@pytest.mark.slow
 def test_mpirun_ft_end_to_end():
     """Process mode: rank dies, launcher publishes the failure, survivors
     ack + shrink + finish (exit 0, 'No Errors')."""
@@ -245,6 +246,7 @@ def test_mpirun_ft_end_to_end():
     assert "No Errors" in r.stdout
 
 
+@pytest.mark.slow
 def test_elastic_rebuild_world():
     """SURVEY §5.3 migration analog: kill a rank, shrink, spawn a
     replacement, merge, restore state (ft/elastic.py)."""

@@ -55,6 +55,7 @@ def test_singleton_init():
     assert r.returncode == 0 and "No Errors" in r.stdout
 
 
+@pytest.mark.slow
 def test_runtests_driver():
     """bin/runtests: the testlist-driven conformance runner (SURVEY §4).
 
@@ -72,6 +73,7 @@ def test_runtests_driver():
     assert "0 failures" in r.stdout
 
 
+@pytest.mark.slow
 def test_abort_kills_job():
     """MPI_Abort on one rank tears down the whole job — even ranks
     blocked in never-matching receives (MPI-3.1 §8.7; mpirun_rsh

@@ -47,6 +47,7 @@ def test_stencil_matches_reference():
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_train_step_runs_and_learns():
     cfg = tf.Config(vocab=64, d_model=64, n_heads=4, n_layers=2, d_ff=128,
                     seq_len=64, batch=8, n_experts=4, lr=5e-2)
@@ -61,6 +62,7 @@ def test_train_step_runs_and_learns():
     assert losses[-1] < losses[0], f"no learning: {losses}"
 
 
+@pytest.mark.slow
 def test_train_step_parallel_matches_single_device():
     """The sharded train step must compute the same loss as an unsharded
     run — the correctness contract of the whole parallelism stack."""
@@ -88,6 +90,7 @@ def test_train_step_parallel_matches_single_device():
     np.testing.assert_allclose(float(loss1), float(loss8), rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_moe_layer_forward_finite():
     cfg = tf.Config(vocab=32, d_model=32, n_heads=4, n_layers=2, d_ff=64,
                     seq_len=32, batch=8, n_experts=8, moe_layer=1)

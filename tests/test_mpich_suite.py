@@ -37,10 +37,14 @@ def harness(tmp_path_factory):
     bld = str(tmp_path_factory.mktemp("mpich_slice"))
     sys.path.insert(0, os.path.join(REPO, "bin"))
     import importlib.util
-    spec = importlib.util.spec_from_file_location(
+    from importlib.machinery import SourceFileLoader
+    # explicit loader: the runner has no .py suffix, and newer pythons
+    # return a loaderless spec for unrecognized suffixes
+    loader = SourceFileLoader(
         "run_mpich_tests", os.path.join(REPO, "bin", "run_mpich_tests"))
+    spec = importlib.util.spec_from_loader("run_mpich_tests", loader)
     mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    loader.exec_module(mod)
     objs, incs = mod.build_harness(REF, bld, need_dtypes=False)
     return mod, bld, objs, incs
 

@@ -66,6 +66,7 @@ def _write_hostfile(tmp_path, text):
     return str(p)
 
 
+@pytest.mark.slow
 def test_tree_two_nodes_placement(tmp_path):
     """8 ranks over 2 emulated nodes: every rank must see 2 nodes, with
     its node peers matching the hostfile block mapping."""
@@ -95,6 +96,7 @@ def test_tree_two_nodes_placement(tmp_path):
     assert "No Errors" in r.stdout
 
 
+@pytest.mark.slow
 def test_tree_cyclic_mapping(tmp_path):
     prog = tmp_path / "prog.py"
     prog.write_text(
@@ -117,6 +119,7 @@ def test_tree_cyclic_mapping(tmp_path):
     assert "No Errors" in r.stdout
 
 
+@pytest.mark.slow
 def test_tree_ft_failure_events_cross_agents(tmp_path):
     """FT mode through the agent tree: a rank killed on one emulated node
     becomes a global failure event (atomic cross-agent sequencing) and
@@ -131,6 +134,7 @@ def test_tree_ft_failure_events_cross_agents(tmp_path):
     assert "No Errors" in r.stdout
 
 
+@pytest.mark.slow
 def test_tree_failing_rank_kills_job(tmp_path):
     prog = os.path.join(REPO, "tests", "progs", "die_prog.py")
     hf = _write_hostfile(tmp_path, "nodeA:2\nnodeB:2\n")
@@ -141,6 +145,7 @@ def test_tree_failing_rank_kills_job(tmp_path):
     assert r.returncode != 0
 
 
+@pytest.mark.slow
 def test_abort_kills_tree_job(tmp_path):
     """MPI_Abort tears down a multi-node (agent-tree) job too: the
     launcher watches the same KVS abort event on the tree path and

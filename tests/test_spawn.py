@@ -112,6 +112,7 @@ def test_nameserv_errors():
     assert all(run_ranks(1, body))
 
 
+@pytest.mark.slow
 def test_process_spawn():
     """End-to-end: launched parent job spawns child processes which join
     via the KVS and talk over the parent/child intercomm."""
