@@ -174,6 +174,83 @@ def profile_comm(comm, colls: Tuple[str, ...] = ("allreduce", "bcast",
     return out
 
 
+def measure_kernel_params(msg_bytes: int = 64 * 1024 * 1024,
+                          ranks: int = 8, reps: int = 3) -> Dict[str, int]:
+    """Measure the pallas block sizes for the HBM slot-segment kernels
+    (ops/pallas_hbm.py) at the north-star point — the producer of the
+    profile's ``kernel_params`` (consumed via tuning.kernel_param).
+    TPU only; returns {} elsewhere."""
+    import functools
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    if jax.devices()[0].platform != "tpu":
+        return {}
+    from .ops import pallas_hbm as ph
+
+    M = msg_bytes // 4 // 128
+    x = jax.random.normal(jax.random.PRNGKey(0), (M, ranks, 128),
+                          jnp.float32)
+    K1, K2 = 2, 8
+
+    def slope(fn_k):
+        def tmin(k):
+            float(fn_k(x, k))   # warm
+            ts = []
+            for _ in range(reps * 2):
+                t0 = _time.perf_counter()
+                float(fn_k(x, k))
+                ts.append(_time.perf_counter() - t0)
+            return min(ts)
+        ss = sorted(max((tmin(K2) - tmin(K1)) / (K2 - K1), 1e-9)
+                    for _ in range(reps))
+        return ss[len(ss) // 2]
+
+    out: Dict[str, int] = {}
+    for key, blocks, mk in [
+        ("hbm_slot_block_m", (256, 512, 1024),
+         lambda bm: functools.partial(ph.fused_reduce_to_slot,
+                                      layout="interleaved", mean=True,
+                                      block_m=bm, side_effects=True)),
+        ("hbm_fused_block_m", (128, 256, 512),
+         lambda bm: functools.partial(ph.fused_allreduce, mean=True,
+                                      block_m=bm)),
+    ]:
+        best_bm, best_t = None, float("inf")
+        for bm in blocks:
+            if M % bm:
+                continue
+            op = mk(bm)
+            chains = key.startswith("hbm_fused")
+            if chains:
+                @functools.partial(jax.jit, static_argnums=1)
+                def fn_k(v, k, _op=op):
+                    a = v
+                    for _ in range(k):
+                        a = _op(a)
+                    return jnp.sum(a[:8, 0, 0])
+            else:
+                @functools.partial(jax.jit, static_argnums=1)
+                def fn_k(v, k, _op=op):
+                    acc = jnp.float32(0)
+                    for _ in range(k):
+                        acc = acc + _op(v)[0, 0]
+                    return acc
+            try:
+                t = slope(fn_k)
+            except Exception as e:   # Mosaic limits on other TPU gens
+                log.warn("kernel-param candidate %s b%d failed: %s",
+                         key, bm, e)
+                continue
+            if t < best_t:
+                best_bm, best_t = bm, t
+        if best_bm is not None:
+            out[key] = best_bm
+    return out
+
+
 # ---------------------------------------------------------------------------
 # artifacts
 # ---------------------------------------------------------------------------
@@ -206,7 +283,8 @@ def load_profile_file(path: str, check_arch: bool = True) -> bool:
                      for cls, rows in classes.items()}
               for name, classes in prof.get("tables", {}).items()}
     tuning.load_profile(tables=tables,
-                        device_crossovers=prof.get("device_crossovers"))
+                        device_crossovers=prof.get("device_crossovers"),
+                        kernel_params=prof.get("kernel_params"))
     return True
 
 
@@ -267,6 +345,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             holder["profile"] = p
 
     run_ranks(args.np, app, device_mesh=not args.no_device)
+    if not args.no_device:
+        kp = measure_kernel_params(reps=args.reps)
+        if kp:
+            holder["profile"]["kernel_params"] = kp
     path = args.out or _arch_file()
     save_profile(holder["profile"], path)
     print(f"tuning profile written: {path}")

@@ -164,13 +164,22 @@ class DeviceCollChannel:
         return jax.jit(sm)
 
     # -- the rendezvous execution ----------------------------------------
+    @staticmethod
+    def _slot_extent(slot):
+        """(n, dtype) of a deposited slot without pulling device arrays
+        back to the host."""
+        if is_device_array(slot):
+            return int(np.prod(slot.shape)), np.dtype(str(slot.dtype))
+        arr = np.asarray(slot)
+        return int(arr.size), arr.dtype
+
     def _execute(self, name: str, local: np.ndarray, op: str = "sum",
                  root: int = 0):
         """Run one device collective; ``local`` is this rank's shard
-        ([n] host numpy or device array). Returns this rank's result as
-        whatever the leader deposited (device array shard)."""
-        import jax
-
+        ([n] host numpy or device array). Deposit at the rendezvous,
+        rank 0 runs the channel's ``_leader`` hook, everyone picks up
+        its result. Returns whatever the leader deposited for this rank
+        (device array)."""
         rv = self.rv
         rv.slots[self.rank] = local
         try:
@@ -180,32 +189,8 @@ class DeviceCollChannel:
                 "device collective aborted: a peer rank failed") from None
         if self.rank == 0:
             try:
-                n = int(np.asarray(rv.slots[0]).shape[0]) \
-                    if not is_device_array(rv.slots[0]) \
-                    else int(rv.slots[0].shape[0])
-                dtype = np.dtype(rv.slots[0].dtype)
-                shards = []
-                for r in range(self.size):
-                    s = rv.slots[r]
-                    if is_device_array(s) and \
-                            s.devices() == {self.devices[r]}:
-                        shards.append(s.reshape(1, n))
-                    else:
-                        shards.append(jax.device_put(
-                            np.asarray(s).reshape(1, n), self.devices[r]))
-                from jax.sharding import (NamedSharding,
-                                          PartitionSpec as P)
-                global_arr = jax.make_array_from_single_device_arrays(
-                    (self.size, n),
-                    NamedSharding(self.mesh, P(self.axis, None)), shards)
-                out = self._program(name, n, str(dtype), op, root)(
-                    global_arr)
-                per_dev: Dict = {}
-                for s in out.addressable_shards:
-                    per_dev[s.device] = s.data
+                rv.result = self._leader(name, op, root)
                 rv.error = None
-                rv.result = [per_dev[self.devices[r]]
-                             for r in range(self.size)]
             except BaseException as e:   # noqa: BLE001 — must release peers
                 rv.error = e
                 rv.result = [None] * self.size
@@ -224,6 +209,32 @@ class DeviceCollChannel:
                 f"device collective {name} failed on the leader"
             ) from rv.error
         return res
+
+    def _leader(self, name: str, op: str, root: int) -> List:
+        """Leader compute: assemble the mesh-sharded global array, run
+        the jitted shard_map program, scatter output shards per rank."""
+        import jax
+
+        rv = self.rv
+        n, dtype = self._slot_extent(rv.slots[0])
+        shards = []
+        for r in range(self.size):
+            s = rv.slots[r]
+            if is_device_array(s) and \
+                    s.devices() == {self.devices[r]}:
+                shards.append(s.reshape(1, n))
+            else:
+                shards.append(jax.device_put(
+                    np.asarray(s).reshape(1, n), self.devices[r]))
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        global_arr = jax.make_array_from_single_device_arrays(
+            (self.size, n),
+            NamedSharding(self.mesh, P(self.axis, None)), shards)
+        out = self._program(name, n, str(dtype), op, root)(global_arr)
+        per_dev: Dict = {}
+        for s in out.addressable_shards:
+            per_dev[s.device] = s.data
+        return [per_dev[self.devices[r]] for r in range(self.size)]
 
     # -- MPI-shaped entry points (match coll_fns signatures) -------------
     def allreduce(self, comm, sendbuf, recvbuf, count, datatype, op):
@@ -258,6 +269,109 @@ class DeviceCollChannel:
         local = _as_local(sendbuf, recvbuf, count * comm.size)
         out = self._execute("reduce_scatter_block", local, op=_op_name(op))
         return _deliver(out, recvbuf)
+
+
+class HBMSlotChannel(DeviceCollChannel):
+    """All bound ranks share ONE device: collectives run through an HBM
+    slot segment — the device-side analog of the reference's slotted
+    shared-memory collective segment (ch3_shmem_coll.c:527-528; see
+    ops/pallas_hbm.py). Every rank deposits at the rendezvous, the
+    leader stages one planar ``(R, n)`` slot array and runs one program:
+
+      * allreduce/reduce: one fused slot-reduce pass writing the result
+        ONCE; the broadcast is zero-copy (every rank's result is a view
+        of the shared slot) — ``R*m`` read + ``m`` written instead of
+        the materialized ``2*R*m``.
+      * allgather: the slot array *is* the result (no device compute).
+      * alltoall: one transpose of the slot array.
+      * reduce_scatter_block: slot-reduce, then per-rank slice views.
+      * bcast: stage the root slot only; all ranks share it.
+
+    Used when more ranks than devices are bound (the mpirun-on-one-chip
+    model); the 1:1 mesh binding uses DeviceCollChannel above.
+    """
+
+    def __init__(self, device, rendezvous: _Rendezvous, rank: int,
+                 size: int):
+        self.mesh = None
+        self.axis = None
+        self.rv = rendezvous
+        self.rank = rank
+        self.device = device
+        self.devices = [device] * size
+        self.size = size
+        self._programs: Dict = {}
+
+    def _build(self, name: str, n: int, op: str, root: int):
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops import pallas_hbm as ph
+        R = self.size
+        red = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min,
+               "prod": jnp.prod}[op or "sum"]
+
+        if name in ("allreduce", "reduce"):
+            if op == "sum" and ph.HAVE_PALLAS:
+                def f(x):
+                    return ph.hbm_slot_allreduce(x)
+            else:
+                def f(x):
+                    return red(x, axis=0)
+        elif name == "bcast":
+            def f(x):                       # staged root slot [n]
+                return x
+        elif name == "allgather":
+            def f(x):                       # [R, n] -> [R*n], zero compute
+                return x.reshape(R * n)
+        elif name == "alltoall":
+            c = n // R
+
+            def f(x):                       # [R, n] -> [R, R, c] transpose
+                return jnp.transpose(x.reshape(R, R, c), (1, 0, 2))
+        elif name == "reduce_scatter_block":
+            if op == "sum" and ph.HAVE_PALLAS:
+                def f(x):
+                    return ph.hbm_slot_allreduce(x)
+            else:
+                def f(x):
+                    return red(x, axis=0)
+        else:  # pragma: no cover
+            raise KeyError(name)
+        return jax.jit(f)
+
+    def _leader(self, name: str, op: str, root: int) -> List:
+        """Leader compute: stage the planar slot array on the one
+        device, run the program, share/scatter the result."""
+        import jax
+
+        rv = self.rv
+        R = self.size
+        n, dtype = self._slot_extent(rv.slots[root])
+        if name == "bcast":
+            x = rv.slots[root]
+            x = (x.reshape(n) if is_device_array(x)
+                 else jax.device_put(
+                     np.asarray(x).reshape(n), self.device))
+        elif all(is_device_array(s) and s.devices() == {self.device}
+                 for s in rv.slots):
+            import jax.numpy as jnp
+            x = jnp.stack([s.reshape(n) for s in rv.slots])
+        else:
+            # host slots, or device arrays committed elsewhere on a
+            # multi-device host: stage everything onto the slot device
+            x = jax.device_put(
+                np.stack([np.asarray(s).reshape(n)
+                          for s in rv.slots]), self.device)
+        prog = self._program(name, n, str(dtype), op, root)
+        out = jax.block_until_ready(prog(x))
+        if name == "alltoall":
+            return [out[r] for r in range(R)]
+        if name == "reduce_scatter_block":
+            c = n // R
+            return [out[r * c:(r + 1) * c] for r in range(R)]
+        # the zero-copy share: every rank gets the same array
+        return [out] * R
 
 
 def _as_local(sendbuf, recvbuf, count: int, in_place_start: int = 0):
@@ -428,27 +542,41 @@ def bind_universes(universes, mesh=None, axis: Optional[str] = None) -> bool:
     import jax
 
     n = len(universes)
+    slot_device = None
     if mesh is None:
         from ..parallel.mesh import make_mesh
         devs = jax.devices()
         if len(devs) < n:
-            log.warn("device mesh unavailable: %d ranks > %d devices; "
-                     "host path only", n, len(devs))
+            # more ranks than devices: co-residence — the HBM
+            # slot-segment channel on the first device (mpirun on one
+            # chip; the shm-collective analog)
+            slot_device = devs[0]
+            log.info("%d ranks > %d devices; binding the HBM "
+                     "slot-segment channel on %s", n, len(devs),
+                     slot_device)
+        else:
+            mesh = make_mesh((n,), (axis or "x",), devs[:n])
+    if mesh is not None and slot_device is None:
+        if axis is None:
+            axis = mesh.axis_names[0]
+        if len(mesh.axis_names) > 1:
+            log.warn("mesh %s has %d axes; the MPI binding needs a 1-D "
+                     "mesh; host path only", dict(mesh.shape),
+                     len(mesh.axis_names))
             return False
-        mesh = make_mesh((n,), (axis or "x",), devs[:n])
-    if axis is None:
-        axis = mesh.axis_names[0]
-    if len(mesh.axis_names) > 1:
-        log.warn("mesh %s has %d axes; the MPI binding needs a 1-D mesh; "
-                 "host path only", dict(mesh.shape), len(mesh.axis_names))
-        return False
-    if int(np.prod(list(mesh.shape.values()))) != n:
-        log.warn("mesh shape %s does not match %d ranks; host path only",
-                 dict(mesh.shape), n)
-        return False
+        msize = int(np.prod(list(mesh.shape.values())))
+        if msize == 1 and n > 1:
+            slot_device = list(np.asarray(mesh.devices).reshape(-1))[0]
+        elif msize != n:
+            log.warn("mesh shape %s does not match %d ranks; host path "
+                     "only", dict(mesh.shape), n)
+            return False
     rv = _Rendezvous(n)
     for r, u in enumerate(universes):
-        ch = DeviceCollChannel(mesh, axis, rv, r)
+        if slot_device is not None:
+            ch = HBMSlotChannel(slot_device, rv, r, n)
+        else:
+            ch = DeviceCollChannel(mesh, axis, rv, r)
         install_device_coll(u.comm_world, ch)
     # arch is known here (jax initialized): pull in the measured tuning
     # profile for this mesh, if one is committed/pointed-to

@@ -116,10 +116,14 @@ DEFAULT_TABLES: Dict[str, Dict[str, Table]] = {
 _PROFILE_TABLES: Dict[str, Dict[str, Table]] = {}
 # measured host->device transport crossovers (bytes) per collective
 _DEVICE_CROSSOVERS: Dict[str, int] = {}
+# measured kernel parameters (e.g. pallas block sizes: hbm_slot_block_m,
+# hbm_fused_block_m — consumed by ops/pallas_hbm.py)
+_KERNEL_PARAMS: Dict[str, int] = {}
 
 
 def load_profile(tables: Optional[Dict[str, Dict[str, Table]]] = None,
-                 device_crossovers: Optional[Dict[str, int]] = None) -> None:
+                 device_crossovers: Optional[Dict[str, int]] = None,
+                 kernel_params: Optional[Dict[str, int]] = None) -> None:
     """Install autotuned tables (analog of regenerating tuning headers).
     Produced by mvapich2_tpu.mpit.autotune; see autotune.load_profile_file
     for the JSON artifact form."""
@@ -127,6 +131,14 @@ def load_profile(tables: Optional[Dict[str, Dict[str, Table]]] = None,
         _PROFILE_TABLES.update(tables)
     if device_crossovers:
         _DEVICE_CROSSOVERS.update(device_crossovers)
+    if kernel_params:
+        _KERNEL_PARAMS.update(kernel_params)
+
+
+def kernel_param(key: str, default: int) -> int:
+    """A measured kernel parameter from the loaded profile, or the
+    compiled-in default when no profile covers it."""
+    return _KERNEL_PARAMS.get(key, default)
 
 
 def device_crossover(name: str, comm) -> int:
