@@ -45,7 +45,6 @@ import functools
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -69,26 +68,12 @@ def _sz_label() -> str:
     return f"{MSG_BYTES}B"
 
 
-def _timed_min(fn_k, x, k):
-    for _ in range(SKIP):
-        float(fn_k(x, k))
-    ts = []
-    for _ in range(ITERS):
-        t0 = time.perf_counter()
-        float(fn_k(x, k))
-        ts.append(time.perf_counter() - t0)
-    return min(ts)
-
-
 def _slope(fn_k, x, nrep=5):
-    """Median-of-nrep two-point slopes (cancels tunnel+dispatch)."""
-    ss = []
-    for _ in range(nrep):
-        t1 = _timed_min(fn_k, x, K1)
-        t2 = _timed_min(fn_k, x, K2)
-        ss.append(max((t2 - t1) / (K2 - K1), 1e-9))
-    ss.sort()
-    return ss[len(ss) // 2]
+    """Median-of-nrep two-point slopes (cancels tunnel+dispatch);
+    shared harness, bench's iteration counts."""
+    from mvapich2_tpu.utils.slopetime import slope
+    return slope(fn_k, x, k1=K1, k2=K2, iters=ITERS, skip=SKIP,
+                 nrep=nrep)
 
 
 def _emulated_candidates(M):
@@ -99,29 +84,10 @@ def _emulated_candidates(M):
     import jax.numpy as jnp
     from jax import lax
 
+    from mvapich2_tpu.utils.slopetime import wrap_repeat
+
     m = M * 128 * 4
     cands = []
-
-    def wrap_repeat(op, chains):
-        """K dependent executions in one jitted program. ``chains``:
-        out feeds in (shapes match); otherwise the op is effectful and
-        repeated on the same input (slot-reduce: out is the result
-        slot, not the slot array)."""
-        if chains:
-            @functools.partial(jax.jit, static_argnums=1)
-            def fn_k(v, k):
-                a = v
-                for _ in range(k):
-                    a = op(a)
-                return jnp.sum(a[:64, 0, 0])
-        else:
-            @functools.partial(jax.jit, static_argnums=1)
-            def fn_k(v, k):
-                acc = jnp.float32(0)
-                for _ in range(k):
-                    acc = acc + op(v)[0, 0]
-                return acc
-        return fn_k
 
     if jax.devices()[0].platform == "tpu":
         try:

@@ -179,9 +179,10 @@ def measure_kernel_params(msg_bytes: int = 64 * 1024 * 1024,
     """Measure the pallas block sizes for the HBM slot-segment kernels
     (ops/pallas_hbm.py) at the north-star point — the producer of the
     profile's ``kernel_params`` (consumed via tuning.kernel_param).
-    TPU only; returns {} elsewhere."""
+    Each key is measured on the layout its consumer actually runs:
+    hbm_slot_block_m on planar (the HBMSlotChannel product path),
+    hbm_fused_block_m on interleaved. TPU only; returns {} elsewhere."""
     import functools
-    import time as _time
 
     import jax
     import jax.numpy as jnp
@@ -189,32 +190,20 @@ def measure_kernel_params(msg_bytes: int = 64 * 1024 * 1024,
     if jax.devices()[0].platform != "tpu":
         return {}
     from .ops import pallas_hbm as ph
+    from .utils.slopetime import slope, wrap_repeat
 
     M = msg_bytes // 4 // 128
-    x = jax.random.normal(jax.random.PRNGKey(0), (M, ranks, 128),
-                          jnp.float32)
-    K1, K2 = 2, 8
-
-    def slope(fn_k):
-        def tmin(k):
-            float(fn_k(x, k))   # warm
-            ts = []
-            for _ in range(reps * 2):
-                t0 = _time.perf_counter()
-                float(fn_k(x, k))
-                ts.append(_time.perf_counter() - t0)
-            return min(ts)
-        ss = sorted(max((tmin(K2) - tmin(K1)) / (K2 - K1), 1e-9)
-                    for _ in range(reps))
-        return ss[len(ss) // 2]
+    x_planar = jax.random.normal(jax.random.PRNGKey(0), (ranks, M, 128),
+                                 jnp.float32)
+    x_inter = jnp.transpose(x_planar, (1, 0, 2))
 
     out: Dict[str, int] = {}
-    for key, blocks, mk in [
-        ("hbm_slot_block_m", (256, 512, 1024),
+    for key, blocks, x, chains, mk in [
+        ("hbm_slot_block_m", (256, 512, 1024), x_planar, False,
          lambda bm: functools.partial(ph.fused_reduce_to_slot,
-                                      layout="interleaved", mean=True,
+                                      layout="planar", mean=True,
                                       block_m=bm, side_effects=True)),
-        ("hbm_fused_block_m", (128, 256, 512),
+        ("hbm_fused_block_m", (128, 256, 512), x_inter, True,
          lambda bm: functools.partial(ph.fused_allreduce, mean=True,
                                       block_m=bm)),
     ]:
@@ -222,24 +211,10 @@ def measure_kernel_params(msg_bytes: int = 64 * 1024 * 1024,
         for bm in blocks:
             if M % bm:
                 continue
-            op = mk(bm)
-            chains = key.startswith("hbm_fused")
-            if chains:
-                @functools.partial(jax.jit, static_argnums=1)
-                def fn_k(v, k, _op=op):
-                    a = v
-                    for _ in range(k):
-                        a = _op(a)
-                    return jnp.sum(a[:8, 0, 0])
-            else:
-                @functools.partial(jax.jit, static_argnums=1)
-                def fn_k(v, k, _op=op):
-                    acc = jnp.float32(0)
-                    for _ in range(k):
-                        acc = acc + _op(v)[0, 0]
-                    return acc
+            fn_k = wrap_repeat(mk(bm), chains)
             try:
-                t = slope(fn_k)
+                t = slope(fn_k, x, k1=2, k2=8, iters=reps * 2, skip=1,
+                          nrep=reps)
             except Exception as e:   # Mosaic limits on other TPU gens
                 log.warn("kernel-param candidate %s b%d failed: %s",
                          key, bm, e)
@@ -296,22 +271,25 @@ def _arch_file() -> str:
 
 
 _default_attempted = False
+_loaded_from: Optional[str] = None
 
 
-def load_default_profile() -> bool:
+def load_default_profile() -> Optional[str]:
     """Auto-load the measured profile for this arch — MV2T_TUNING_PROFILE
     env first (no arch check: the user said so), else the committed
     arch-keyed file under profiles/. The analog of the reference
     selecting the generated tuning header for the detected arch
-    (allreduce_tuning.c:22-220). Idempotent per process."""
-    global _default_attempted
+    (allreduce_tuning.c:22-220). Idempotent per process; returns the
+    path the tables were loaded from (None = compiled-in defaults)."""
+    global _default_attempted, _loaded_from
     if _default_attempted:
-        return False
+        return _loaded_from
     _default_attempted = True
     forced = os.environ.get("MV2T_TUNING_PROFILE")
-    if forced:
-        return load_profile_file(forced, check_arch=False)
-    return load_profile_file(_arch_file())
+    path = forced or _arch_file()
+    if load_profile_file(path, check_arch=not forced):
+        _loaded_from = path
+    return _loaded_from
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -301,6 +301,14 @@ class HBMSlotChannel(DeviceCollChannel):
         self.devices = [device] * size
         self.size = size
         self._programs: Dict = {}
+        # flipped (shared via the rendezvous, since each rank holds its
+        # own channel object) when Mosaic rejects the fused kernel on
+        # this TPU generation: reductions fall back to the XLA path
+        self.rv.no_pallas = getattr(self.rv, "no_pallas", False)
+
+    def _use_pallas(self, op: str) -> bool:
+        from ..ops import pallas_hbm as ph
+        return op == "sum" and ph.HAVE_PALLAS and not self.rv.no_pallas
 
     def _build(self, name: str, n: int, op: str, root: int):
         import jax
@@ -312,7 +320,7 @@ class HBMSlotChannel(DeviceCollChannel):
                "prod": jnp.prod}[op or "sum"]
 
         if name in ("allreduce", "reduce"):
-            if op == "sum" and ph.HAVE_PALLAS:
+            if self._use_pallas(op):
                 def f(x):
                     return ph.hbm_slot_allreduce(x)
             else:
@@ -330,7 +338,7 @@ class HBMSlotChannel(DeviceCollChannel):
             def f(x):                       # [R, n] -> [R, R, c] transpose
                 return jnp.transpose(x.reshape(R, R, c), (1, 0, 2))
         elif name == "reduce_scatter_block":
-            if op == "sum" and ph.HAVE_PALLAS:
+            if self._use_pallas(op):
                 def f(x):
                     return ph.hbm_slot_allreduce(x)
             else:
@@ -364,7 +372,20 @@ class HBMSlotChannel(DeviceCollChannel):
                 np.stack([np.asarray(s).reshape(n)
                           for s in rv.slots]), self.device)
         prog = self._program(name, n, str(dtype), op, root)
-        out = jax.block_until_ready(prog(x))
+        try:
+            out = jax.block_until_ready(prog(x))
+        except Exception:
+            if not self._use_pallas(op):
+                raise
+            # Mosaic rejected the fused kernel on this TPU generation
+            # (bench/autotune catch the same failure mode): fall back to
+            # the XLA reduction for the life of this binding
+            log.warn("pallas slot kernel failed for %s; falling back to "
+                     "the XLA reduction path", name)
+            self.rv.no_pallas = True
+            self._programs.clear()
+            prog = self._program(name, n, str(dtype), op, root)
+            out = jax.block_until_ready(prog(x))
         if name == "alltoall":
             return [out[r] for r in range(R)]
         if name == "reduce_scatter_block":

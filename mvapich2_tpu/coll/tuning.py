@@ -141,6 +141,14 @@ def kernel_param(key: str, default: int) -> int:
     return _KERNEL_PARAMS.get(key, default)
 
 
+def describe_profile() -> Dict:
+    """The loaded measured-profile state, for display tools (mpiname
+    -a): {} values when no profile is loaded."""
+    return {"tables": dict(_PROFILE_TABLES),
+            "kernel_params": dict(_KERNEL_PARAMS),
+            "device_crossovers": dict(_DEVICE_CROSSOVERS)}
+
+
 def device_crossover(name: str, comm) -> int:
     """Bytes at which a host-buffer collective on a mesh-bound comm moves
     to the device (XLA/ICI) transport. Precedence: explicitly-set cvar
