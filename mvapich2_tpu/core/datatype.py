@@ -35,25 +35,25 @@ class Datatype:
                   (needed by reduction ops), else None
     """
 
-    def __init__(self, spans: List[Span], extent: int, lb: int = 0,
+    def __init__(self, spans, extent: int, lb: int = 0,
                  basic: Optional[np.dtype] = None, name: str = "",
                  committed: bool = False):
+        # spans normalize to an (N, 2) int64 array — the dataloop is
+        # DATA, vectorized end-to-end (a 4M-span contig-of-indexed from
+        # the MTest generators costs milliseconds, not tens of seconds
+        # of tuple churn)
+        arr = np.asarray(spans, dtype=np.int64).reshape(-1, 2)
         # Negative displacements/strides (legal MPI, e.g. vector with
         # stride < 0) would index before the buffer origin; our numpy-backed
         # pack/unpack can't express that, so reject at construction rather
         # than silently read from the end of the buffer.
-        if len(spans) > 256:
-            _sp = np.asarray(spans, dtype=np.int64).reshape(len(spans), 2)
-            _neg = bool((_sp[:, 0] < 0).any())
-        else:
-            _neg = any(off < 0 for off, _ in spans)
-        if _neg:
+        if arr.size and bool((arr[:, 0] < 0).any()):
             raise MPIException(
                 MPI_ERR_TYPE,
                 "negative byte displacements are not supported "
                 f"(type {name or 'derived'})")
-        self.spans = _merge_spans(spans)
-        self.size = sum(l for _, l in self.spans)
+        self.spans = _merge_spans(arr)
+        self.size = int(self.spans[:, 1].sum()) if len(self.spans) else 0
         self.lb = lb
         self.extent = extent
         self.basic = np.dtype(basic) if basic is not None else None
@@ -97,7 +97,7 @@ class Datatype:
         return self
 
     def dup(self) -> "Datatype":
-        new = Datatype(list(self.spans), self.extent, self.lb, self.basic,
+        new = Datatype(self.spans, self.extent, self.lb, self.basic,
                        self.name + "_dup", self.committed)
         new._envelope = ("dup", [], [], [self])
         if getattr(self, "_attrs", None) is not None:
@@ -109,15 +109,14 @@ class Datatype:
                 f"extent={self.extent}, spans={len(self.spans)})")
 
     # -- pack / unpack ----------------------------------------------------
-    def flatten(self, count: int) -> List[Span]:
-        """Byte spans of ``count`` elements laid out with this type's extent."""
+    def flatten(self, count: int):
+        """Byte spans of ``count`` elements laid out with this type's
+        extent — an (N, 2) int64 array."""
         if self.is_contiguous:
-            return [(0, self.size * count)] if count else []
-        out: List[Span] = []
-        for i in range(count):
-            base = i * self.extent
-            out.extend((base + off, ln) for off, ln in self.spans)
-        return _merge_spans(out)
+            return (np.array([[0, self.size * count]], dtype=np.int64)
+                    if count else np.empty((0, 2), dtype=np.int64))
+        return _merge_spans(
+            _replicate_spans(self.spans, count, self.extent))
 
     def _byte_index(self) -> np.ndarray:
         """Flat source-byte index for one element (cached): the gather
@@ -198,37 +197,45 @@ class Datatype:
         return b.view(self.basic)
 
 
-def _merge_spans(spans: Sequence[Span]) -> List[Span]:
-    """Coalesce adjacent byte spans (the dataloop optimization).
-    Vectorized for large span lists — the MTest datatype generators
-    build indexed types with 10^4-10^5 blocks, where a Python loop is
-    the difference between milliseconds and minutes."""
-    n = len(spans)
-    if n > 256:
-        arr = np.asarray(spans, dtype=np.int64).reshape(n, 2)
-        off, ln = arr[:, 0], arr[:, 1]
-        keep = ln > 0
+def _merge_spans(spans) -> np.ndarray:
+    """Coalesce adjacent byte spans (the dataloop optimization),
+    vectorized — the MTest datatype generators build types with
+    10^4-10^6 blocks, where a Python loop is the difference between
+    milliseconds and minutes. Returns an (N, 2) int64 array."""
+    arr = np.asarray(spans, dtype=np.int64).reshape(-1, 2)
+    if len(arr) == 0:
+        return arr
+    off, ln = arr[:, 0], arr[:, 1]
+    keep = ln > 0
+    if not keep.all():
         off, ln = off[keep], ln[keep]
-        if off.size == 0:
-            return []
-        # new group wherever a span does not extend its predecessor
-        brk = np.empty(off.size, dtype=bool)
-        brk[0] = True
-        np.not_equal(off[1:], off[:-1] + ln[:-1], out=brk[1:])
-        gid = np.cumsum(brk) - 1
-        starts = off[brk]
-        ends = np.zeros(int(gid[-1]) + 1, dtype=np.int64)
-        np.maximum.at(ends, gid, off + ln)
-        return list(zip(starts.tolist(), (ends - starts).tolist()))
-    out: List[Span] = []
-    for off, ln in spans:
-        if ln <= 0:
-            continue
-        if out and out[-1][0] + out[-1][1] == off:
-            out[-1] = (out[-1][0], out[-1][1] + ln)
-        else:
-            out.append((off, ln))
-    return out
+    if off.size == 0:
+        return np.empty((0, 2), dtype=np.int64)
+    # new group wherever a span does not extend its predecessor
+    brk = np.empty(off.size, dtype=bool)
+    brk[0] = True
+    np.not_equal(off[1:], off[:-1] + ln[:-1], out=brk[1:])
+    if brk.all():
+        return np.stack([off, ln], axis=1)
+    gid = np.cumsum(brk) - 1
+    starts = off[brk]
+    ends = np.zeros(int(gid[-1]) + 1, dtype=np.int64)
+    np.maximum.at(ends, gid, off + ln)
+    return np.stack([starts, ends - starts], axis=1)
+
+
+def _replicate_spans(spans, count: int, stride: int) -> np.ndarray:
+    """``count`` copies of a span set at ``stride``-byte steps — the
+    vectorized dataloop replication every constructor builds on."""
+    arr = np.asarray(spans, dtype=np.int64).reshape(-1, 2)
+    if count == 0 or len(arr) == 0:
+        return np.empty((0, 2), dtype=np.int64)
+    if count == 1:
+        return arr
+    offs = (arr[:, 0][None, :]
+            + (np.arange(count, dtype=np.int64) * stride)[:, None])
+    lens = np.broadcast_to(arr[:, 1][None, :], offs.shape)
+    return np.stack([offs.reshape(-1), lens.reshape(-1)], axis=1)
 
 
 def as_bytes_view(buf, writable: bool = False):
@@ -331,10 +338,7 @@ def _env(dt: Datatype, combiner: str, ints, aints, types) -> Datatype:
 
 
 def create_contiguous(count: int, oldtype: Datatype) -> Datatype:
-    spans = []
-    for i in range(count):
-        base = i * oldtype.extent
-        spans.extend((base + o, l) for o, l in oldtype.spans)
+    spans = _replicate_spans(oldtype.spans, count, oldtype.extent)
     return _env(
         Datatype(spans, count * oldtype.extent, oldtype.lb, oldtype.basic,
                  f"contig({count},{oldtype.name})"),
@@ -363,15 +367,13 @@ def create_hvector(count: int, blocklength: int, stride_bytes: int,
             Datatype(spans, extent, 0, oldtype.basic,
                      f"hvector({count},{blocklength},{stride_bytes})"),
             "hvector", [count, blocklength], [stride_bytes], [oldtype])
-    spans = []
-    for i in range(count):
-        base = i * stride_bytes
-        for j in range(blocklength):
-            b2 = base + j * oldtype.extent
-            spans.extend((b2 + o, l) for o, l in oldtype.spans)
+    spans = _replicate_spans(
+        _replicate_spans(oldtype.spans, blocklength, oldtype.extent),
+        count, stride_bytes)
     extent = _extent_of(spans, oldtype)
+    spans = spans[np.argsort(spans[:, 0], kind="stable")]
     return _env(
-        Datatype(sorted(spans), extent, 0, oldtype.basic,
+        Datatype(spans, extent, 0, oldtype.basic,
                  f"hvector({count},{blocklength},{stride_bytes})"),
         "hvector", [count, blocklength], [stride_bytes], [oldtype])
 
@@ -405,11 +407,13 @@ def create_hindexed(blocklengths: Sequence[int], disp_bytes: Sequence[int],
                      f"hindexed({len(blocklengths)})"),
             "hindexed", [len(blocklengths)] + list(blocklengths),
             list(disp_bytes), [oldtype])
-    spans = []
-    for bl, disp in zip(blocklengths, disp_bytes):
-        for j in range(bl):
-            base = disp + j * oldtype.extent
-            spans.extend((base + o, l) for o, l in oldtype.spans)
+    parts = [
+        _replicate_spans(oldtype.spans, bl, oldtype.extent)
+        + np.array([disp, 0], dtype=np.int64)
+        for bl, disp in zip(blocklengths, disp_bytes) if bl
+    ]
+    spans = (np.concatenate(parts)
+             if parts else np.empty((0, 2), dtype=np.int64))
     extent = _extent_of(spans, oldtype)
     return _env(
         Datatype(spans, extent, 0, oldtype.basic,
@@ -432,18 +436,19 @@ def create_struct(blocklengths: Sequence[int], disp_bytes: Sequence[int],
                   types: Sequence[Datatype]) -> Datatype:
     mpi_assert(len(blocklengths) == len(disp_bytes) == len(types),
                MPI_ERR_ARG, "struct arg length mismatch")
-    spans = []
+    parts = []
     basics = set()
     for bl, disp, t in zip(blocklengths, disp_bytes, types):
         basics.add(t.basic)
         if t.is_contiguous:
             # one span per member block regardless of blocklength —
             # the MTest struct generators use 64k-element blocks
-            spans.append((disp, bl * t.size))
+            parts.append(np.array([[disp, bl * t.size]], dtype=np.int64))
             continue
-        for j in range(bl):
-            base = disp + j * t.extent
-            spans.extend((base + o, l) for o, l in t.spans)
+        parts.append(_replicate_spans(t.spans, bl, t.extent)
+                     + np.array([disp, 0], dtype=np.int64))
+    spans = (np.concatenate(parts)
+             if parts else np.empty((0, 2), dtype=np.int64))
     basic = basics.pop() if len(basics) == 1 else None
     max_ub = max((d + bl * t.extent for d, bl, t
                   in zip(disp_bytes, blocklengths, types)), default=0)
@@ -511,17 +516,17 @@ def create_subarray(sizes: Sequence[int], subsizes: Sequence[int],
 
 def create_resized(oldtype: Datatype, lb: int, extent: int) -> Datatype:
     return _env(
-        Datatype(list(oldtype.spans), extent, lb, oldtype.basic,
+        Datatype(oldtype.spans, extent, lb, oldtype.basic,
                  f"resized({oldtype.name})"),
         "resized", [], [lb, extent], [oldtype])
 
 
-def _extent_of(spans: Sequence[Span], oldtype: Datatype) -> int:
-    if not spans:
+def _extent_of(spans, oldtype: Datatype) -> int:
+    arr = np.asarray(spans, dtype=np.int64).reshape(-1, 2)
+    if len(arr) == 0:
         return 0
-    hi = max(o + l for o, l in spans)
     # natural extent rounds up to oldtype alignment
-    return hi
+    return int((arr[:, 0] + arr[:, 1]).max())
 
 
 DATATYPE_NULL = Datatype([], 0, 0, None, "MPI_DATATYPE_NULL", False)

@@ -12,6 +12,7 @@ from 2. Datatype/op codes are fixed enums mirrored in native/mpi/mpi.h.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Dict
 
@@ -128,6 +129,87 @@ def _send_args(view, count: int, dtcode: int):
     return _arr(view, count, dtcode), {}
 
 
+# -- MPI_BOTTOM (absolute addressing, MPI-3.1 §4.1.5) -----------------------
+# The C side passes view=None for a NULL buffer pointer: the datatype's
+# displacements are then absolute process addresses (built from
+# MPI_Get_address, e.g. reference test/mpi/pt2pt/bottom.c). The wire
+# format is the same packed stream a relative derived send produces
+# (ch3u_eager.c:208 operates on (char*)buf + dt_true_lb the same way) —
+# gather/scatter just runs against absolute memory through ctypes.
+
+def _bottom_spans(count: int, dtcode: int):
+    # precondition: dtcode is derived (callers gate on _DERIVED_BASE;
+    # basic-type MPI_BOTTOM with count>0 errors in _arr)
+    if count == 0:
+        return None, []
+    d = _derived[dtcode]
+    return d, d.flatten(count)
+
+
+def _bottom_gather(count: int, dtcode: int) -> np.ndarray:
+    import ctypes
+    d, spans = _bottom_spans(count, dtcode)
+    out = np.empty(d.size * count if d else 0, np.uint8)
+    pos = 0
+    for off, ln in spans:
+        src = (ctypes.c_ubyte * ln).from_address(off)
+        out[pos:pos + ln] = np.frombuffer(src, np.uint8)
+        pos += ln
+    return out
+
+
+def _bottom_scatter(tmp: np.ndarray, count: int, dtcode: int) -> None:
+    import ctypes
+    _, spans = _bottom_spans(count, dtcode)
+    pos = 0
+    for off, ln in spans:
+        dst = (ctypes.c_ubyte * ln).from_address(off)
+        np.frombuffer(dst, np.uint8)[:] = tmp[pos:pos + ln]
+        pos += ln
+
+
+def _bottom_tmp(count: int, dtcode: int) -> np.ndarray:
+    d, _ = _bottom_spans(count, dtcode)
+    return np.zeros(d.size * count if d else 0, np.uint8)
+
+
+def _send_args_b(view, count: int, dtcode: int):
+    """_send_args plus the send-side MPI_BOTTOM case: pre-pack from the
+    absolute addresses at post time (MPI forbids touching the send
+    buffer until completion, so the gathered snapshot is the message —
+    valid for every send mode, including nonblocking posts)."""
+    if view is None and dtcode >= _DERIVED_BASE:
+        return _bottom_gather(count, dtcode), {}
+    return _send_args(view, count, dtcode)
+
+
+class _BottomRecvReq:
+    """Completion wrapper for MPI_BOTTOM receives: the payload lands in
+    a temp packed buffer, scattered to the absolute addresses when the
+    request completes (wait/test both funnel through wait)."""
+
+    def __init__(self, inner, tmp, count, dtcode):
+        self._inner = inner
+        self._tmp = tmp
+        self._count = count
+        self._dtcode = dtcode
+        self._scattered = False
+
+    def wait(self):
+        st = self._inner.wait()
+        if not self._scattered:
+            self._scattered = True
+            if not getattr(self._inner, "cancelled", False):
+                _bottom_scatter(self._tmp, self._count, self._dtcode)
+        return st
+
+    def test(self):
+        return self._inner.test()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
 def _esz(dtcode: int) -> int:
     """Packed (type-signature) bytes per element."""
     return _dt(dtcode).size if dtcode >= _DERIVED_BASE \
@@ -193,12 +275,28 @@ def init() -> int:
         faulthandler.register(_sig.SIGUSR1, all_threads=True)
     except (ImportError, AttributeError, ValueError):
         pass
+    if os.environ.get("MV2T_CSHIM_PROFILE"):
+        import cProfile
+        global _profiler
+        _profiler = cProfile.Profile()
+        _profiler.enable()
     mpi.Init()
     return 0
 
 
+_profiler = None
+
+
 def finalize() -> int:
     mpi.Finalize()
+    if _profiler is not None:
+        _profiler.disable()
+        import pstats
+        path = os.environ.get("MV2T_CSHIM_PROFILE") + \
+            f".rank{os.environ.get('MV2T_RANK', '0')}"
+        with open(path, "w") as f:
+            pstats.Stats(_profiler, stream=f).sort_stats(
+                "cumulative").print_stats(40)
     return 0
 
 
@@ -267,7 +365,7 @@ def get_processor_name() -> str:
 
 def send(view, count: int, dtcode: int, dest: int, tag: int,
          ch: int) -> int:
-    buf, kw = _send_args(view, count, dtcode)
+    buf, kw = _send_args_b(view, count, dtcode)
     _comm(ch).send(buf, dest, tag, **kw)
     return 0
 
@@ -275,6 +373,11 @@ def send(view, count: int, dtcode: int, dest: int, tag: int,
 def recv(view, count: int, dtcode: int, source: int, tag: int,
          ch: int):
     """Returns (source, tag, count_bytes)."""
+    if view is None and dtcode >= _DERIVED_BASE:
+        tmp = _bottom_tmp(count, dtcode)
+        st = _comm(ch).recv(tmp, source, tag)
+        _bottom_scatter(tmp, count, dtcode)
+        return (st.source, st.tag, st.count)
     buf, kw = _send_args(view, count, dtcode)
     st = _comm(ch).recv(buf, source, tag, **kw)
     return (st.source, st.tag, st.count)
@@ -283,7 +386,7 @@ def recv(view, count: int, dtcode: int, source: int, tag: int,
 def isend(view, count: int, dtcode: int, dest: int, tag: int,
           ch: int) -> int:
     global _next_req
-    buf, kw = _send_args(view, count, dtcode)
+    buf, kw = _send_args_b(view, count, dtcode)
     r = _comm(ch).isend(buf, dest, tag, **kw)
     with _lock:
         h = _next_req
@@ -295,8 +398,13 @@ def isend(view, count: int, dtcode: int, dest: int, tag: int,
 def irecv(view, count: int, dtcode: int, source: int, tag: int,
           ch: int) -> int:
     global _next_req
-    buf, kw = _send_args(view, count, dtcode)
-    r = _comm(ch).irecv(buf, source, tag, **kw)
+    if view is None and dtcode >= _DERIVED_BASE:
+        tmp = _bottom_tmp(count, dtcode)
+        r = _BottomRecvReq(_comm(ch).irecv(tmp, source, tag), tmp,
+                           count, dtcode)
+    else:
+        buf, kw = _send_args(view, count, dtcode)
+        r = _comm(ch).irecv(buf, source, tag, **kw)
     with _lock:
         h = _next_req
         _next_req += 1
@@ -656,42 +764,42 @@ def get(wh: int, oview, count: int, dtcode: int, target: int,
 
 def ssend(view, count: int, dtcode: int, dest: int, tag: int,
           ch: int) -> int:
-    buf, kw = _send_args(view, count, dtcode)
+    buf, kw = _send_args_b(view, count, dtcode)
     _comm(ch).ssend(buf, dest, tag, **kw)
     return 0
 
 
 def bsend(view, count: int, dtcode: int, dest: int, tag: int,
           ch: int) -> int:
-    buf, kw = _send_args(view, count, dtcode)
+    buf, kw = _send_args_b(view, count, dtcode)
     _comm(ch).bsend(buf, dest, tag, **kw)
     return 0
 
 
 def rsend(view, count: int, dtcode: int, dest: int, tag: int,
           ch: int) -> int:
-    buf, kw = _send_args(view, count, dtcode)
+    buf, kw = _send_args_b(view, count, dtcode)
     _comm(ch).rsend(buf, dest, tag, **kw)
     return 0
 
 
 def ibsend(view, count: int, dtcode: int, dest: int, tag: int,
            ch: int) -> int:
-    buf, kw = _send_args(view, count, dtcode)
+    buf, kw = _send_args_b(view, count, dtcode)
     return _new_req(_comm(ch).isend(buf, dest, tag, mode="buffered",
                                     **kw))
 
 
 def irsend(view, count: int, dtcode: int, dest: int, tag: int,
            ch: int) -> int:
-    buf, kw = _send_args(view, count, dtcode)
+    buf, kw = _send_args_b(view, count, dtcode)
     return _new_req(_comm(ch).isend(buf, dest, tag, **kw))
 
 
 def issend(view, count: int, dtcode: int, dest: int, tag: int,
            ch: int) -> int:
     global _next_req
-    buf, kw = _send_args(view, count, dtcode)
+    buf, kw = _send_args_b(view, count, dtcode)
     r = _comm(ch).issend(buf, dest, tag, **kw)
     with _lock:
         h = _next_req
@@ -718,8 +826,17 @@ def iprobe(source: int, tag: int, ch: int):
 # persistent requests (MPI_Send_init / MPI_Recv_init / MPI_Start)
 # ---------------------------------------------------------------------------
 
+def _reject_bottom_persistent(view, count, dtcode):
+    if view is None and dtcode >= _DERIVED_BASE and count:
+        from .core.errors import MPI_ERR_BUFFER
+        raise MPIException(MPI_ERR_BUFFER,
+                           "MPI_BOTTOM with persistent requests is not "
+                           "supported (pack at Start would be needed)")
+
+
 def send_init(view, count: int, dtcode: int, dest: int, tag: int,
               ch: int, mode: str = "standard") -> int:
+    _reject_bottom_persistent(view, count, dtcode)
     buf, kw = _send_args(view, count, dtcode)
     if mode != "standard":
         kw["mode"] = mode
@@ -729,6 +846,7 @@ def send_init(view, count: int, dtcode: int, dest: int, tag: int,
 def recv_init(view, count: int, dtcode: int, source: int, tag: int,
               ch: int) -> int:
     global _next_req
+    _reject_bottom_persistent(view, count, dtcode)
     buf, kw = _send_args(view, count, dtcode)
     r = _comm(ch).recv_init(buf, source, tag, **kw)
     with _lock:
@@ -851,6 +969,10 @@ def allgatherv(sview, rview, scount: int, sdt: int, rcounts, displs,
 def alltoallv(sview, rview, scounts, sdispls, rcounts, rdispls,
               sdt: int, rdt: int, ch: int) -> int:
     c = _comm(ch)
+    if sview is None:
+        # MPI_IN_PLACE (§5.8): send from the recv buffer with the recv
+        # layout (the C side passes NULL count/displ vectors)
+        sview, scounts, sdispls, sdt = rview, rcounts, rdispls, rdt
     scounts, sdispls = list(scounts), list(sdispls)
     rcounts, rdispls = list(rcounts), list(rdispls)
     esz_s, esz_r = _esz(sdt), _esz(rdt)
@@ -1405,10 +1527,11 @@ def type_true_extent(code: int):
         sz = _DTYPES[code].itemsize
         return (0, sz)
     d = _dt(code)
-    if not d.spans:
+    if len(d.spans) == 0:
         return (0, 0)
-    lo = min(off for off, _ in d.spans)
-    hi = max(off + ln for off, ln in d.spans)
+    sp = np.asarray(d.spans, dtype=np.int64).reshape(-1, 2)
+    lo = int(sp[:, 0].min())
+    hi = int((sp[:, 0] + sp[:, 1]).max())
     return (lo, hi - lo)
 
 

@@ -56,7 +56,8 @@ WIN_UNIFIED = 2
 
 
 def _ser_dt(dt: Datatype) -> dict:
-    return {"spans": list(dt.spans), "extent": dt.extent, "lb": dt.lb,
+    return {"spans": np.asarray(dt.spans).tolist(),
+            "extent": dt.extent, "lb": dt.lb,
             "basic": (dt.basic.str if dt.basic is not None else None)}
 
 

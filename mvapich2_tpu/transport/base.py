@@ -133,6 +133,16 @@ class Channel:
         blocked rank wakes immediately (doorbells/sockets)."""
         return []
 
+    def pre_wait(self) -> None:
+        """Called by the engine BEFORE its last empty poll ahead of a
+        blocking wait — a channel can advertise 'receiver sleeping' so
+        senders know a doorbell is needed (see ShmChannel's adaptive
+        bell). The order closes the race: advertise, then final poll,
+        then sleep."""
+
+    def post_wait(self) -> None:
+        """Called after the blocking wait returns."""
+
     # -- zero-copy rendezvous hooks (RGET path) ---------------------------
     def expose_buffer(self, array: np.ndarray) -> Any:
         """Register a send buffer for remote pull; returns an opaque handle

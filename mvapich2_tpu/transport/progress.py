@@ -38,6 +38,19 @@ class ProgressEngine:
         self._inbox: collections.deque = collections.deque()
         self._inbox_lock = threading.Lock()
         self._inbox_cond = threading.Condition(self._inbox_lock)
+        # bumped on every wakeup/enqueue: the blocking wait re-checks it
+        # so a notify that lands between the final poll and the wait is
+        # never lost (it would otherwise cost a full idle timeout)
+        self._wake_gen = 0
+        # self-pipe: wakeup() must also interrupt a wait blocked in
+        # select() on channel fds (condvars can't); the pending byte is
+        # level-triggered, so a wakeup that lands before the select
+        # starts still ends it immediately
+        import os as _os
+        try:
+            self._wake_r, self._wake_w = _os.pipe2(_os.O_NONBLOCK)
+        except (AttributeError, OSError):  # pragma: no cover
+            self._wake_r = self._wake_w = None
         self.channels: List[Channel] = []
         # pkt type -> handler(pkt); populated by protocol/rma layers
         self.pkt_handlers: Dict[int, Callable[[Packet], None]] = {}
@@ -78,6 +91,7 @@ class ProgressEngine:
     def enqueue_incoming(self, pkt: Packet) -> None:
         with self._inbox_cond:
             self._inbox.append(pkt)
+            self._wake_gen += 1
             self._inbox_cond.notify_all()
         if int(pkt.type) in self.async_types:
             self._async_drain()
@@ -107,7 +121,14 @@ class ProgressEngine:
 
     def wakeup(self) -> None:
         with self._inbox_cond:
+            self._wake_gen += 1
             self._inbox_cond.notify_all()
+        if self._wake_w is not None:
+            import os as _os
+            try:
+                _os.write(self._wake_w, b"x")
+            except OSError:
+                pass   # pipe full: a wakeup byte is already pending
 
     # -- completion (owning thread, mutex held) ---------------------------
     def complete_request(self, req: Request) -> None:
@@ -173,34 +194,62 @@ class ProgressEngine:
             with self.mutex:
                 if pred():
                     return
-            self.progress_poke()
-            with self.mutex:
-                if pred():
-                    return
-            spin += 1
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError("progress_wait timed out")
-            # Idle strategy: block immediately on the union of the
-            # channels' wakeup fds (shm doorbells, tcp sockets) so a
-            # peer's send wakes us via a direct context switch. Never
-            # busy-yield and never spin while holding the core: on an
-            # oversubscribed host sched_yield only reschedules at the next
-            # tick (~350 us measured) and every extra spin delays the
-            # peer, while fd wakeup costs ~2 us. Push-only channels
-            # (threaded fabric) use the inbox condition instead.
-            import select as _select
-            fds = []
+            # Advertise intent to sleep BEFORE the final empty poll: a
+            # sender writing after that poll sees the flag and rings the
+            # doorbell; one writing before it is caught by the poll
+            # (ShmChannel's adaptive bell — senders skip the doorbell
+            # syscall for awake receivers).
             for ch in self.channels:
-                fds.extend(ch.wait_fds())
-            if fds:
-                try:
-                    _select.select(fds, [], [], 0.0005)
-                except (OSError, ValueError):
-                    pass
-            else:
-                with self._inbox_cond:
-                    if not self._inbox:
-                        self._inbox_cond.wait(timeout=0.0005)
+                ch.pre_wait()
+            gen = self._wake_gen   # sampled before the final poll
+            try:
+                if self.progress_poke():
+                    spin = 0
+                with self.mutex:
+                    if pred():
+                        return
+                spin += 1
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError("progress_wait timed out")
+                # Idle strategy: block on the union of the channels'
+                # wakeup fds (shm doorbells, tcp sockets) so a peer's
+                # send wakes us via a direct context switch. Never
+                # busy-yield and never spin while holding the core: on
+                # an oversubscribed host sched_yield only reschedules at
+                # the next tick (~350 us measured) and every extra spin
+                # delays the peer, while fd wakeup costs ~2 us.
+                # Push-only channels (threaded fabric) use the inbox
+                # condition instead. Futile wake->poll cycles back off
+                # exponentially (0.5 ms -> 8 ms): every futile poll on
+                # an oversubscribed core steals CPU from exactly the
+                # peer whose send we are waiting on, and the doorbell /
+                # condvar still ends the sleep early.
+                idle_t = min(0.0005 * (1 << min(spin - 1, 4)), 0.008)
+                import select as _select
+                fds = []
+                for ch in self.channels:
+                    fds.extend(ch.wait_fds())
+                if fds:
+                    if self._wake_r is not None:
+                        fds.append(self._wake_r)
+                    try:
+                        r, _, _ = _select.select(fds, [], [], idle_t)
+                    except (OSError, ValueError):
+                        pass
+                    else:
+                        if self._wake_r in r:
+                            import os as _os
+                            try:
+                                _os.read(self._wake_r, 4096)
+                            except OSError:
+                                pass
+                else:
+                    with self._inbox_cond:
+                        if not self._inbox and self._wake_gen == gen:
+                            self._inbox_cond.wait(timeout=idle_t)
+            finally:
+                for ch in self.channels:
+                    ch.post_wait()
 
     def drain_all(self, timeout: float = 5.0) -> None:
         """Progress until no work remains (used at Finalize/quiesce)."""
@@ -220,3 +269,11 @@ class ProgressEngine:
         for ch in self.channels:
             ch.close()
         self.wakeup()
+        if self._wake_r is not None:
+            import os as _os
+            for fd in (self._wake_r, self._wake_w):
+                try:
+                    _os.close(fd)
+                except OSError:
+                    pass
+            self._wake_r = self._wake_w = None

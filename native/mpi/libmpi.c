@@ -1113,6 +1113,8 @@ int comm_np(MPI_Comm comm) {
 
 static long vspan(const int *counts, const int *displs, int n) {
     long m = 0;
+    if (!counts)
+        return 0;   /* MPI_IN_PLACE passes NULL count/displ vectors */
     for (int i = 0; i < n; i++) {
         long e = (displs ? displs[i] : 0) + counts[i];
         if (e > m) m = e;
