@@ -44,6 +44,7 @@ MPI_ERR_SPAWN = 42
 # ULFM extension classes (reference: src/mpi/comm/comm_revoke.c et al.)
 MPIX_ERR_PROC_FAILED = 75
 MPIX_ERR_REVOKED = 76
+MPIX_ERR_PROC_FAILED_PENDING = 77
 
 MPI_MAX_ERROR_STRING = 512
 

@@ -52,7 +52,35 @@ _DTYPES = {
     17: np.dtype([("val", np.int32), ("loc", np.int32)], align=True),
     18: np.dtype([("val", np.int16), ("loc", np.int32)], align=True),
     19: np.dtype([("val", np.longdouble), ("loc", np.int32)], align=True),
+    # distinct handles for the LP64 aliases (mpi.h): same storage,
+    # per-name identity for Type_get_name / get_envelope
+    20: np.dtype(np.int64),    # MPI_LONG
+    21: np.dtype(np.int8),     # MPI_SIGNED_CHAR
+    22: np.dtype(np.int64),    # MPI_OFFSET
+    23: np.dtype(np.int64),    # MPI_COUNT
+    24: np.dtype(np.int8),     # MPI_INT8_T
+    25: np.dtype(np.int16),    # MPI_INT16_T
+    26: np.dtype(np.int32),    # MPI_INT32_T
+    27: np.dtype(np.int64),    # MPI_INT64_T
+    28: np.dtype(np.uint8),    # MPI_UINT8_T
+    29: np.dtype(np.uint16),   # MPI_UINT16_T
+    30: np.dtype(np.uint32),   # MPI_UINT32_T
+    31: np.dtype(np.uint64),   # MPI_UINT64_T
+    32: np.dtype(np.int32),    # MPI_WCHAR (wchar_t is int32 on linux)
+    33: np.dtype(np.complex64),     # MPI_C_FLOAT_COMPLEX
+    34: np.dtype(np.complex128),    # MPI_C_DOUBLE_COMPLEX
+    35: np.dtype(np.clongdouble),   # MPI_C_LONG_DOUBLE_COMPLEX
+    36: np.dtype(np.bool_),         # MPI_CXX_BOOL
+    37: np.dtype(np.complex64),     # MPI_CXX_FLOAT_COMPLEX
+    38: np.dtype(np.complex128),    # MPI_CXX_DOUBLE_COMPLEX
+    39: np.dtype(np.clongdouble),   # MPI_CXX_LONG_DOUBLE_COMPLEX
+    40: np.dtype(np.uint8),         # MPI_PACKED
 }
+
+# MPI-1 bound markers: zero-size pseudo-types legal only inside
+# Type_struct member lists (MPI-1 §3.12.3); mpi.h MPI_LB/MPI_UB
+_MARKER_LB = 41
+_MARKER_UB = 42
 
 _OPS = {
     0: opmod.SUM, 1: opmod.PROD, 2: opmod.MAX, 3: opmod.MIN,
@@ -152,6 +180,8 @@ def _bottom_gather(count: int, dtcode: int) -> np.ndarray:
     out = np.empty(d.size * count if d else 0, np.uint8)
     pos = 0
     for off, ln in spans:
+        # spans are an (N,2) int64 ndarray; ctypes needs exact ints
+        off, ln = int(off), int(ln)
         src = (ctypes.c_ubyte * ln).from_address(off)
         out[pos:pos + ln] = np.frombuffer(src, np.uint8)
         pos += ln
@@ -163,6 +193,7 @@ def _bottom_scatter(tmp: np.ndarray, count: int, dtcode: int) -> None:
     _, spans = _bottom_spans(count, dtcode)
     pos = 0
     for off, ln in spans:
+        off, ln = int(off), int(ln)
         dst = (ctypes.c_ubyte * ln).from_address(off)
         np.frombuffer(dst, np.uint8)[:] = tmp[pos:pos + ln]
         pos += ln
@@ -993,6 +1024,58 @@ def alltoallv(sview, rview, scounts, sdispls, rcounts, rdispls,
     return 0
 
 
+def _gather_bytes(raw: np.ndarray, off_bytes: int, count: int,
+                  dtcode: int) -> np.ndarray:
+    """Packed bytes of `count` elements at BYTE offset `off_bytes`
+    (alltoallw displacements are bytes, not elements)."""
+    return _gather_in(raw[off_bytes:], 0, count, dtcode)
+
+
+def _scatter_bytes(raw: np.ndarray, off_bytes: int, count: int,
+                   dtcode: int, data_u8) -> None:
+    _scatter_out(raw[off_bytes:], 0, count, dtcode, data_u8)
+
+
+def alltoallw(sview, rview, scounts, sdispls, stypes,
+              rcounts, rdispls, rtypes, ch: int) -> int:
+    """MPI_Alltoallw: per-peer datatypes, byte displacements (§5.8).
+    Pack every outgoing segment through its datatype, move the bytes
+    with the comm's alltoallv, unpack per-peer on the way out."""
+    c = _comm(ch)
+    if sview is None:              # MPI_IN_PLACE: recv layout describes both
+        sview, scounts, sdispls, stypes = rview, rcounts, rdispls, rtypes
+    scounts, sdispls, stypes = list(scounts), list(sdispls), list(stypes)
+    rcounts, rdispls, rtypes = list(rcounts), list(rdispls), list(rtypes)
+    raw_s = np.frombuffer(sview, np.uint8)
+    raw_r = np.frombuffer(rview, np.uint8)
+    segs = [_gather_bytes(raw_s, sdispls[j], scounts[j], stypes[j])
+            for j in range(c.size)]
+    sb = (np.concatenate([np.ascontiguousarray(s) for s in segs])
+          if segs else np.empty(0, np.uint8))
+    sbytes = [scounts[j] * _esz(stypes[j]) for j in range(c.size)]
+    rbytes = [rcounts[j] * _esz(rtypes[j]) for j in range(c.size)]
+    sdispls_b = np.concatenate([[0], np.cumsum(sbytes)[:-1]]).tolist()
+    rdispls_b = np.concatenate([[0], np.cumsum(rbytes)[:-1]]).tolist()
+    rtmp = np.empty(sum(rbytes), np.uint8)
+    c.alltoallv(sb, sbytes, sdispls_b, rtmp, rbytes, rdispls_b)
+    for i in range(c.size):
+        _scatter_bytes(raw_r, rdispls[i], rcounts[i], rtypes[i],
+                       rtmp[rdispls_b[i]: rdispls_b[i] + rbytes[i]])
+    return 0
+
+
+def reduce_local(inview, inoutview, count: int, dtcode: int,
+                 opcode: int) -> int:
+    """MPI_Reduce_local (MPI-3.1 §5.9.7): inout = op(in, inout), purely
+    local — no communication."""
+    ib, _ = _red_view(inview, count, dtcode)
+    ob, wb = _red_view(inoutview, count, dtcode)
+    ob[...] = _OPS[opcode](ib, ob)
+    if wb is not None:
+        wb()
+    return 0
+
+
 def gatherv(sview, rview, scount: int, sdt: int, rcounts, displs,
             rdt: int, root: int, ch: int) -> int:
     c = _comm(ch)
@@ -1159,9 +1242,27 @@ def type_indexed(blocklengths, displacements, oldcode: int) -> int:
 
 
 def type_create_struct(blocklengths, disp_bytes, oldcodes) -> int:
+    # MPI_LB/MPI_UB markers (MPI-1 §3.12.3): they carry no data but pin
+    # the bounds — lb = min displacement of any LB entry, ub = max of
+    # any UB entry; the rest of the struct is built from real members.
+    blocklengths, disp_bytes = list(blocklengths), list(disp_bytes)
+    oldcodes = list(oldcodes)
+    lb_pins = [d for d, c in zip(disp_bytes, oldcodes) if c == _MARKER_LB]
+    ub_pins = [d for d, c in zip(disp_bytes, oldcodes) if c == _MARKER_UB]
+    if lb_pins or ub_pins:
+        real = [(bl, d, c) for bl, d, c in
+                zip(blocklengths, disp_bytes, oldcodes)
+                if c not in (_MARKER_LB, _MARKER_UB)]
+        blocklengths = [r[0] for r in real]
+        disp_bytes = [r[1] for r in real]
+        oldcodes = [r[2] for r in real]
     types = [_dt(c) for c in oldcodes]
-    return _new_derived(dt.create_struct(list(blocklengths),
-                                         list(disp_bytes), types))
+    base = dt.create_struct(blocklengths, disp_bytes, types)
+    if lb_pins or ub_pins:
+        lb = min(lb_pins) if lb_pins else base.lb
+        ub = max(ub_pins) if ub_pins else base.ub
+        base = dt.create_resized(base, lb, ub - lb)
+    return _new_derived(base)
 
 
 def type_create_resized(oldcode: int, lb: int, extent: int) -> int:
@@ -1181,7 +1282,22 @@ def type_free(code: int) -> int:
 
 
 def type_size(code: int) -> int:
+    if code in (_MARKER_LB, _MARKER_UB):
+        return 0
     return _dt(code).size
+
+
+def type_span(code: int, count: int) -> int:
+    """Bytes a buffer must provide for `count` extent-strided elements
+    starting at byte 0 — true-extent aware: a derived type's last
+    element may trail past its extent (e.g. a column vector type)."""
+    if count <= 0 or code in (_MARKER_LB, _MARKER_UB):
+        return 0
+    if code < _DERIVED_BASE:
+        return count * _DTYPES[code].itemsize
+    d = _derived[code]
+    tlb, text = type_true_extent(code)
+    return (count - 1) * d.extent + max(tlb + text, d.extent, 0)
 
 
 _COMBINERS = {"named": 0, "contiguous": 1, "vector": 2, "hvector": 3,
@@ -1199,6 +1315,8 @@ def type_get_envelope(code: int):
 
 def type_extent(code: int):
     """Returns (lb, extent) in bytes."""
+    if code in (_MARKER_LB, _MARKER_UB):
+        return (0, 0)
     d = _dt(code)
     return (d.lb, d.extent)
 
@@ -1518,6 +1636,15 @@ _BUILTIN_TYPE_NAMES = {
     13: "MPI_C_BOOL", 14: "MPI_FLOAT_INT", 15: "MPI_DOUBLE_INT",
     16: "MPI_LONG_INT", 17: "MPI_2INT", 18: "MPI_SHORT_INT",
     19: "MPI_LONG_DOUBLE_INT",
+    20: "MPI_LONG", 21: "MPI_SIGNED_CHAR", 22: "MPI_OFFSET",
+    23: "MPI_COUNT", 24: "MPI_INT8_T", 25: "MPI_INT16_T",
+    26: "MPI_INT32_T", 27: "MPI_INT64_T", 28: "MPI_UINT8_T",
+    29: "MPI_UINT16_T", 30: "MPI_UINT32_T", 31: "MPI_UINT64_T",
+    32: "MPI_WCHAR", 33: "MPI_C_FLOAT_COMPLEX",
+    34: "MPI_C_DOUBLE_COMPLEX", 35: "MPI_C_LONG_DOUBLE_COMPLEX",
+    36: "MPI_CXX_BOOL", 37: "MPI_CXX_FLOAT_COMPLEX",
+    38: "MPI_CXX_DOUBLE_COMPLEX", 39: "MPI_CXX_LONG_DOUBLE_COMPLEX",
+    40: "MPI_PACKED", 41: "MPI_LB", 42: "MPI_UB",
 }
 
 
@@ -2077,3 +2204,47 @@ def type_basic_size(code: int) -> int:
 def error_string(klass: int) -> str:
     from .core.errors import error_string as _es
     return _es(klass)
+
+
+# ---------------------------------------------------------------------------
+# ULFM fault tolerance (MPIX_Comm_* — mirrors ft/ulfm.py over the C ABI;
+# reference: mvapich2 src/mpi/comm/comm_revoke.c, comm_shrink.c,
+# comm_agree.c)
+# ---------------------------------------------------------------------------
+
+def comm_revoke(ch: int) -> int:
+    from .ft import ulfm
+    ulfm.revoke(_comm(ch))
+    return 0
+
+
+def comm_is_revoked(ch: int) -> int:
+    return 1 if _comm(ch).revoked else 0
+
+
+def comm_shrink(ch: int) -> int:
+    from .ft import ulfm
+    return _new_comm_handle(ulfm.shrink(_comm(ch)))
+
+
+def comm_agree(ch: int, flag: int):
+    """Returns (errclass, agreed_flag): the agreed value is established
+    even when unacked failures force MPIX_ERR_PROC_FAILED (comm_agree.c
+    contract — survivors stay in lockstep)."""
+    from .ft import ulfm
+    try:
+        return (0, ulfm.agree(_comm(ch), flag))
+    except MPIException as e:
+        agreed = getattr(e, "agreed_flag", flag)
+        return (e.error_class, agreed)
+
+
+def comm_failure_ack(ch: int) -> int:
+    from .ft import ulfm
+    ulfm.failure_ack(_comm(ch))
+    return 0
+
+
+def comm_failure_get_acked(ch: int) -> int:
+    from .ft import ulfm
+    return _new_group_handle(ulfm.failure_get_acked(_comm(ch)))

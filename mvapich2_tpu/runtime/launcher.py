@@ -57,12 +57,13 @@ def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
 
     ``ft=False`` (default): a rank dying with nonzero status kills the job
     (mpirun_rsh cleanup-on-abnormal-exit behavior). ``ft=True`` (the
-    ``mpiexec -disable-auto-cleanup`` analog): a rank killed by a signal
-    (process death — negative returncode) is published to the KVS as a
-    failure event — survivors learn of it through the bootstrap failure
-    watcher and can revoke/shrink (SURVEY §5.3). A plain nonzero exit is
-    an *application error*, not a process failure: it is never published,
-    and the job result is the max exit code over non-failed ranks."""
+    ``mpiexec -disable-auto-cleanup`` analog): ANY nonzero rank death —
+    signal or error exit — is published to the KVS as a failure event, so
+    survivors blocked on that peer unwind with MPIX_ERR_PROC_FAILED and
+    can revoke/shrink (SURVEY §5.3; the reference's ft suite kills ranks
+    with exit(1), test/mpi/ft/senddead.c:30). Error exits additionally
+    surface in the job's exit code (max positive code over all ranks) —
+    publication gives ULFM visibility, it does not mask the error."""
     srv = KVSServer(nranks)
     procs: List[subprocess.Popen] = []
     # a soft kill of the launcher must take the rank children with it —
@@ -117,13 +118,10 @@ def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
             bad = [i for i, c in enumerate(exit_codes)
                    if c is not None and c != 0 and i not in failed]
             if ft:
-                # only signal deaths are process failures; error exits
-                # are the application's business (reported at job end)
                 for i in bad:
-                    if exit_codes[i] < 0:
-                        failed.append(i)
-                        srv.publish(f"__failure_ev_{n_events}", str(i))
-                        n_events += 1
+                    failed.append(i)
+                    srv.publish(f"__failure_ev_{n_events}", str(i))
+                    n_events += 1
             elif bad:
                 _kill_all(procs)
                 return max(c or 0 for c in exit_codes if c is not None) or 1
@@ -134,9 +132,13 @@ def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
                 raise TimeoutError(f"job exceeded {timeout}s")
             time.sleep(0.01)
         if ft:
-            survivors = [c for i, c in enumerate(exit_codes)
-                         if i not in failed]
-            return max(survivors, default=1)
+            # error exits count against the job even when published as
+            # failure events; a job in which NO rank completed cleanly
+            # (all died by signal) must still fail
+            app_err = [c for c in exit_codes if c is not None and c > 0]
+            if app_err:
+                return max(app_err)
+            return 0 if any(c == 0 for c in exit_codes) else 1
         return max(c or 0 for c in exit_codes)
     finally:
         try:

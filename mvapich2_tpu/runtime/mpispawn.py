@@ -74,9 +74,10 @@ def run_agent(spec: Dict) -> int:
                 if rc is None:
                     continue
                 codes[r] = rc
-                if spec.get("ft") and rc < 0:
-                    # signal death = process failure event (the
-                    # launcher-driven detection path, SURVEY 5.3).
+                if spec.get("ft") and rc != 0:
+                    # any nonzero death = process failure event (the
+                    # launcher-driven detection path, SURVEY 5.3; the
+                    # reference's ft suite kills ranks with exit(1)).
                     # Atomically claim the next global event slot so
                     # agents on different nodes never collide and the
                     # sequential failure watcher sees no gaps.
@@ -85,11 +86,14 @@ def run_agent(spec: Dict) -> int:
         time.sleep(0.01)
     kvs.put(f"__agent_exit_{node}", json.dumps(codes))
     if spec.get("ft"):
-        # signal-killed ranks were reported as failure events; the job
-        # result is the max exit code over NON-failed ranks (the launch()
-        # ft contract) — a clean-surviving node must exit 0
-        survivors = [c for c in codes.values() if c is not None and c >= 0]
-        return max(survivors, default=0)
+        # failed ranks were reported as failure events; error exits
+        # still count against the job (the launch() ft contract) —
+        # a clean-surviving node exits 0, a node with no clean rank
+        # fails even when every death was a signal
+        app_err = [c for c in codes.values() if c is not None and c > 0]
+        if app_err:
+            return max(app_err)
+        return 0 if any(c == 0 for c in codes.values()) else 1
     return max((c or 0) for c in codes.values())
 
 

@@ -31,7 +31,13 @@ PyObject *g_shim = NULL;        /* mvapich2_tpu.cshim module */
 static int g_we_initialized_python = 0;
 
 static const int DT_SIZE[] = {1, 1, 4, 4, 8, 8, 8, 2, 1, 8, 4, 2, 16, 1,
-                              8, 16, 16, 8, 8, 32};  /* + pair types */
+                              8, 16, 16, 8, 8, 32,   /* + pair types */
+                              /* 20-31: distinct LP64/fixed-width */
+                              8, 1, 8, 8, 1, 2, 4, 8, 1, 2, 4, 8,
+                              /* 32-40: wchar, complex, cxx, packed */
+                              4, 8, 16, 32, 1, 8, 16, 32, 1,
+                              /* 41-42: MPI_LB/MPI_UB markers */
+                              0, 0};
 
 long shim_call_v(const char *name, int *ok, const char *fmt, ...);
 
@@ -216,7 +222,11 @@ int MPI_Initialized(int *flag) {
 }
 
 int MPI_Abort(MPI_Comm comm, int errorcode) {
-    (void)comm;
+    /* broadcast the abort through the job KVS so the launcher kills
+     * every rank — required in FT mode, where a plain exit() would be
+     * published as a survivable failure event (§8.7 overrides ULFM) */
+    if (g_shim != NULL)
+        shim_call_i("abort", "(ii)", comm, errorcode);
     exit(errorcode);
 }
 
@@ -821,6 +831,31 @@ long dt_extent_b(MPI_Datatype dt) {
     return dt_size(dt);
 }
 
+/* bytes a buffer must span for `count` elements (true-extent aware —
+ * a derived type's last element may trail past its extent) */
+long dt_span_b(MPI_Datatype dt, long count) {
+    if (count <= 0)
+        return 0;
+    if (dt >= 100) {
+        PyGILState_STATE st = PyGILState_Ensure();
+        long span = 0;
+        int ok = 0;
+        PyObject *res = PyObject_CallMethod(g_shim, "type_span", "(il)",
+                                            dt, count);
+        if (res) {
+            span = PyLong_AsLong(res);
+            ok = (span >= 0);
+            Py_DECREF(res);
+        } else {
+            PyErr_Clear();
+        }
+        PyGILState_Release(st);
+        if (ok)
+            return span;
+    }
+    return count * dt_extent_b(dt);
+}
+
 static int sendlike(const char *fn, const void *buf, int count,
                     MPI_Datatype dt, int dest, int tag, MPI_Comm comm) {
     PyGILState_STATE st = PyGILState_Ensure();
@@ -1111,12 +1146,20 @@ int comm_np(MPI_Comm comm) {
     return n;
 }
 
-static long vspan(const int *counts, const int *displs, int n) {
-    long m = 0;
+/* byte span of a v-collective buffer: displacements stride by extent,
+ * but each segment's last element may trail past it (true extent) */
+static long vspan_b(const int *counts, const int *displs, MPI_Datatype dt,
+                    int n) {
+    long m = 0, ext, span1;
     if (!counts)
-        return 0;   /* MPI_IN_PLACE passes NULL count/displ vectors */
+        return 0;
+    /* span(count) = (count-1)*extent + span(1) — one Python round-trip
+     * for the whole vector, not one per rank */
+    ext = dt_extent_b(dt);
+    span1 = dt_span_b(dt, 1);
     for (int i = 0; i < n; i++) {
-        long e = (displs ? displs[i] : 0) + counts[i];
+        long e = (displs ? (long)displs[i] * ext : 0)
+                 + (counts[i] > 0 ? (long)(counts[i] - 1) * ext + span1 : 0);
         if (e > m) m = e;
     }
     return m;
@@ -1128,7 +1171,7 @@ int MPI_Allgatherv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
     int n = comm_np(comm);
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *sv = mv_view(sendbuf, (long)sendcount * dt_extent_b(sdt));
-    PyObject *rv = mv_view(recvbuf, vspan(recvcounts, displs, n) * dt_extent_b(rdt));
+    PyObject *rv = mv_view(recvbuf, vspan_b(recvcounts, displs, rdt, n));
     PyObject *rc_l = int_list(recvcounts, n);
     PyObject *dp_l = int_list(displs, n);
     PyObject *res = PyObject_CallMethod(g_shim, "allgatherv",
@@ -1148,8 +1191,8 @@ int MPI_Alltoallv(const void *sendbuf, const int sendcounts[],
                   MPI_Datatype rdt, MPI_Comm comm) {
     int n = comm_np(comm);
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *sv = mv_view(sendbuf, vspan(sendcounts, sdispls, n) * dt_extent_b(sdt));
-    PyObject *rv = mv_view(recvbuf, vspan(recvcounts, rdispls, n) * dt_extent_b(rdt));
+    PyObject *sv = mv_view(sendbuf, vspan_b(sendcounts, sdispls, sdt, n));
+    PyObject *rv = mv_view(recvbuf, vspan_b(recvcounts, rdispls, rdt, n));
     PyObject *sc = int_list(sendcounts, n), *sd = int_list(sdispls, n);
     PyObject *rc_l = int_list(recvcounts, n), *rd = int_list(rdispls, n);
     PyObject *res = PyObject_CallMethod(g_shim, "alltoallv",
@@ -1172,7 +1215,7 @@ int MPI_Gatherv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *sv = mv_view(sendbuf, (long)sendcount * dt_extent_b(sdt));
     PyObject *rv = (me == root)
-        ? mv_view(recvbuf, vspan(recvcounts, displs, n) * dt_extent_b(rdt))
+        ? mv_view(recvbuf, vspan_b(recvcounts, displs, rdt, n))
         : mv_view(NULL, 0);
     PyObject *rc_l = int_list(me == root ? recvcounts : NULL, n);
     PyObject *dp_l = int_list(me == root ? displs : NULL, n);
@@ -1196,7 +1239,7 @@ int MPI_Scatterv(const void *sendbuf, const int sendcounts[],
     MPI_Comm_rank(comm, &me);
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *sv = (me == root)
-        ? mv_view(sendbuf, vspan(sendcounts, displs, n) * dt_extent_b(sdt))
+        ? mv_view(sendbuf, vspan_b(sendcounts, displs, sdt, n))
         : mv_view(NULL, 0);
     PyObject *rv = mv_view(recvbuf, (long)recvcount * dt_extent_b(rdt));
     PyObject *sc = int_list(me == root ? sendcounts : NULL, n);

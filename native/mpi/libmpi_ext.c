@@ -2575,3 +2575,164 @@ int MPI_Raccumulate(const void *origin, int origin_count, MPI_Datatype odt,
     *req = MPI_REQUEST_NULL;
     return rc;
 }
+
+/* ------------------------------------------------------------------ */
+/* alltoallw / reduce_local (MPI-3.1 §5.8, §5.9.7)                     */
+/* ------------------------------------------------------------------ */
+
+/* byte span of an alltoallw buffer: displacements are bytes and each
+ * peer has its own datatype */
+static long wspan(const int *counts, const int *displs,
+                  const MPI_Datatype *types, int n) {
+    long m = 0;
+    if (!counts)
+        return 0;               /* MPI_IN_PLACE passes NULL vectors */
+    for (int i = 0; i < n; i++) {
+        long e = (displs ? displs[i] : 0)
+                 + dt_span_b(types[i], counts[i]);
+        if (e > m) m = e;
+    }
+    return m;
+}
+
+int MPI_Alltoallw(const void *sendbuf, const int sendcounts[],
+                  const int sdispls[], const MPI_Datatype sendtypes[],
+                  void *recvbuf, const int recvcounts[],
+                  const int rdispls[], const MPI_Datatype recvtypes[],
+                  MPI_Comm comm) {
+    int n = comm_np(comm);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *sv = mv_view(sendbuf, wspan(sendcounts, sdispls,
+                                          sendtypes, n));
+    PyObject *rv = mv_view(recvbuf, wspan(recvcounts, rdispls,
+                                          recvtypes, n));
+    PyObject *sc = int_list(sendcounts, n), *sd = int_list(sdispls, n);
+    PyObject *sT = int_list((const int *)sendtypes, n);
+    PyObject *rc_l = int_list(recvcounts, n), *rd = int_list(rdispls, n);
+    PyObject *rT = int_list((const int *)recvtypes, n);
+    PyObject *res = PyObject_CallMethod(g_shim, "alltoallw",
+                                        "(OOOOOOOOi)", sv, rv, sc, sd, sT,
+                                        rc_l, rd, rT, comm);
+    int rc = res ? MPI_SUCCESS : mv2t_errcode_from_pyerr();
+    Py_XDECREF(res); Py_XDECREF(sc); Py_XDECREF(sd); Py_XDECREF(sT);
+    Py_XDECREF(rc_l); Py_XDECREF(rd); Py_XDECREF(rT);
+    Py_XDECREF(sv); Py_XDECREF(rv);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Ialltoallw(const void *sendbuf, const int sendcounts[],
+                   const int sdispls[], const MPI_Datatype sendtypes[],
+                   void *recvbuf, const int recvcounts[],
+                   const int rdispls[], const MPI_Datatype recvtypes[],
+                   MPI_Comm comm, MPI_Request *req) {
+    int rc = MPI_Alltoallw(sendbuf, sendcounts, sdispls, sendtypes,
+                           recvbuf, recvcounts, rdispls, recvtypes, comm);
+    *req = MPI_REQUEST_NULL;
+    return rc;
+}
+
+int MPI_Reduce_local(const void *inbuf, void *inoutbuf, int count,
+                     MPI_Datatype datatype, MPI_Op op) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    long span = dt_span_b(datatype, count);
+    PyObject *iv = mv_view(inbuf, span);
+    PyObject *ov = mv_view(inoutbuf, span);
+    PyObject *res = PyObject_CallMethod(g_shim, "reduce_local",
+                                        "(OOiii)", iv, ov, count,
+                                        datatype, op);
+    int rc = res ? MPI_SUCCESS : mv2t_errcode_from_pyerr();
+    Py_XDECREF(res); Py_XDECREF(iv); Py_XDECREF(ov);
+    PyGILState_Release(st);
+    return rc;
+}
+
+/* ------------------------------------------------------------------ */
+/* ULFM fault tolerance (MPIX_Comm_* over ft/ulfm.py)                  */
+/* ------------------------------------------------------------------ */
+
+static int ulfm_simple(const char *name, MPI_Comm comm) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, name, "(i)", comm);
+    int rc = res ? MPI_SUCCESS : mv2t_errcode_from_pyerr();
+    Py_XDECREF(res);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPIX_Comm_revoke(MPI_Comm comm) {
+    return ulfm_simple("comm_revoke", comm);
+}
+
+int MPIX_Comm_failure_ack(MPI_Comm comm) {
+    return ulfm_simple("comm_failure_ack", comm);
+}
+
+int MPIX_Comm_is_revoked(MPI_Comm comm, int *flag) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "comm_is_revoked", "(i)",
+                                        comm);
+    int rc = MPI_ERR_COMM;
+    if (res != NULL) {
+        *flag = (int)PyLong_AsLong(res);
+        rc = MPI_SUCCESS;
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPIX_Comm_shrink(MPI_Comm comm, MPI_Comm *newcomm) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "comm_shrink", "(i)",
+                                        comm);
+    int rc = MPI_ERR_COMM;
+    if (res != NULL) {
+        *newcomm = (MPI_Comm)PyLong_AsLong(res);
+        rc = MPI_SUCCESS;
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPIX_Comm_agree(MPI_Comm comm, int *flag) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "comm_agree", "(ii)",
+                                        comm, *flag);
+    int rc = MPI_ERR_COMM;
+    if (res != NULL) {
+        int err = 0, val = 0;
+        if (PyArg_ParseTuple(res, "ii", &err, &val)) {
+            *flag = val;       /* agreed value set even on PROC_FAILED */
+            rc = err;
+        } else {
+            PyErr_Clear();
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPIX_Comm_failure_get_acked(MPI_Comm comm, MPI_Group *failedgrp) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "comm_failure_get_acked",
+                                        "(i)", comm);
+    int rc = MPI_ERR_COMM;
+    if (res != NULL) {
+        *failedgrp = (MPI_Group)PyLong_AsLong(res);
+        rc = MPI_SUCCESS;
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    PyGILState_Release(st);
+    return rc;
+}

@@ -16,6 +16,7 @@ long shim_call_v(const char *name, int *ok, const char *fmt, ...);
 PyObject *mv_view(const void *buf, long nbytes);
 int dt_size(MPI_Datatype dt);
 long dt_extent_b(MPI_Datatype dt);
+long dt_span_b(MPI_Datatype dt, long count);
 PyObject *int_list(const int *a, int n);
 int comm_np(MPI_Comm comm);
 

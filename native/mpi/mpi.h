@@ -49,21 +49,23 @@ typedef struct MPI_Status {
 #define MPI_INT             ((MPI_Datatype)2)
 #define MPI_FLOAT           ((MPI_Datatype)3)
 #define MPI_DOUBLE          ((MPI_Datatype)4)
-#define MPI_LONG            ((MPI_Datatype)5)
 #define MPI_LONG_LONG       ((MPI_Datatype)5)
 #define MPI_LONG_LONG_INT   ((MPI_Datatype)5)
 #define MPI_UNSIGNED_LONG   ((MPI_Datatype)6)
 #define MPI_SHORT           ((MPI_Datatype)7)
 #define MPI_UNSIGNED_CHAR   ((MPI_Datatype)8)
-#define MPI_SIGNED_CHAR     ((MPI_Datatype)1)
 #define MPI_AINT            ((MPI_Datatype)9)
 #define MPI_UNSIGNED            ((MPI_Datatype)10)
 #define MPI_UNSIGNED_SHORT      ((MPI_Datatype)11)
 #define MPI_UNSIGNED_LONG_LONG  ((MPI_Datatype)6)
 #define MPI_LONG_DOUBLE         ((MPI_Datatype)12)
 #define MPI_C_BOOL              ((MPI_Datatype)13)
-#define MPI_OFFSET              ((MPI_Datatype)5)
-#define MPI_COUNT               ((MPI_Datatype)5)
+/* distinct handles for the LP64 aliases so MPI_Type_get_name /
+ * get_envelope answer per-name (all map to 8-byte ints in cshim) */
+#define MPI_LONG            ((MPI_Datatype)20)
+#define MPI_SIGNED_CHAR     ((MPI_Datatype)21)
+#define MPI_OFFSET          ((MPI_Datatype)22)
+#define MPI_COUNT           ((MPI_Datatype)23)
 /* MINLOC/MAXLOC pair types ({T val; int loc;} C layout) */
 #define MPI_FLOAT_INT           ((MPI_Datatype)14)
 #define MPI_DOUBLE_INT          ((MPI_Datatype)15)
@@ -71,16 +73,29 @@ typedef struct MPI_Status {
 #define MPI_2INT                ((MPI_Datatype)17)
 #define MPI_SHORT_INT           ((MPI_Datatype)18)
 #define MPI_LONG_DOUBLE_INT     ((MPI_Datatype)19)
-/* fixed-width aliases */
-#define MPI_INT8_T              ((MPI_Datatype)1)
-#define MPI_INT16_T             ((MPI_Datatype)7)
-#define MPI_INT32_T             ((MPI_Datatype)2)
-#define MPI_INT64_T             ((MPI_Datatype)5)
-#define MPI_UINT8_T             ((MPI_Datatype)8)
-#define MPI_UINT16_T            ((MPI_Datatype)11)
-#define MPI_UINT32_T            ((MPI_Datatype)10)
-#define MPI_UINT64_T            ((MPI_Datatype)6)
-#define MPI_WCHAR               ((MPI_Datatype)2)
+/* fixed-width types (distinct handles; sizes match the C99 types) */
+#define MPI_INT8_T              ((MPI_Datatype)24)
+#define MPI_INT16_T             ((MPI_Datatype)25)
+#define MPI_INT32_T             ((MPI_Datatype)26)
+#define MPI_INT64_T             ((MPI_Datatype)27)
+#define MPI_UINT8_T             ((MPI_Datatype)28)
+#define MPI_UINT16_T            ((MPI_Datatype)29)
+#define MPI_UINT32_T            ((MPI_Datatype)30)
+#define MPI_UINT64_T            ((MPI_Datatype)31)
+#define MPI_WCHAR               ((MPI_Datatype)32)
+/* C/C++ complex (numpy complex64/complex128/clongdouble in cshim) */
+#define MPI_C_FLOAT_COMPLEX         ((MPI_Datatype)33)
+#define MPI_C_COMPLEX               ((MPI_Datatype)33)
+#define MPI_C_DOUBLE_COMPLEX        ((MPI_Datatype)34)
+#define MPI_C_LONG_DOUBLE_COMPLEX   ((MPI_Datatype)35)
+#define MPI_CXX_BOOL                ((MPI_Datatype)36)
+#define MPI_CXX_FLOAT_COMPLEX       ((MPI_Datatype)37)
+#define MPI_CXX_DOUBLE_COMPLEX      ((MPI_Datatype)38)
+#define MPI_CXX_LONG_DOUBLE_COMPLEX ((MPI_Datatype)39)
+#define MPI_PACKED              ((MPI_Datatype)40)
+/* MPI-1 bound markers (size 0; only meaningful inside Type_struct) */
+#define MPI_LB                  ((MPI_Datatype)41)
+#define MPI_UB                  ((MPI_Datatype)42)
 #define MPI_DATATYPE_NULL   ((MPI_Datatype)-1)
 
 #define MPI_VERSION    3
@@ -154,6 +169,10 @@ typedef struct MPI_Status {
 #define MPI_ERR_TRUNCATE 15
 #define MPI_ERR_OTHER    16
 #define MPI_ERR_INTERN   17
+/* ULFM fault-tolerance classes (mirrors core/errors.py) */
+#define MPIX_ERR_PROC_FAILED 75
+#define MPIX_ERR_REVOKED     76
+#define MPIX_ERR_PROC_FAILED_PENDING 77
 #define MPI_ERR_LASTCODE 100
 
 /* thread levels */
@@ -738,6 +757,28 @@ int MPI_Raccumulate(const void *origin, int origin_count, MPI_Datatype odt,
                     int target_rank, MPI_Aint target_disp,
                     int target_count, MPI_Datatype tdt, MPI_Op op,
                     MPI_Win win, MPI_Request *req);
+
+/* ---- remaining collectives ---- */
+int MPI_Alltoallw(const void *sendbuf, const int sendcounts[],
+                  const int sdispls[], const MPI_Datatype sendtypes[],
+                  void *recvbuf, const int recvcounts[],
+                  const int rdispls[], const MPI_Datatype recvtypes[],
+                  MPI_Comm comm);
+int MPI_Ialltoallw(const void *sendbuf, const int sendcounts[],
+                   const int sdispls[], const MPI_Datatype sendtypes[],
+                   void *recvbuf, const int recvcounts[],
+                   const int rdispls[], const MPI_Datatype recvtypes[],
+                   MPI_Comm comm, MPI_Request *req);
+int MPI_Reduce_local(const void *inbuf, void *inoutbuf, int count,
+                     MPI_Datatype datatype, MPI_Op op);
+
+/* ---- ULFM fault tolerance (MPI forum ticket 323 / mvapich2 ft) ---- */
+int MPIX_Comm_revoke(MPI_Comm comm);
+int MPIX_Comm_is_revoked(MPI_Comm comm, int *flag);
+int MPIX_Comm_shrink(MPI_Comm comm, MPI_Comm *newcomm);
+int MPIX_Comm_agree(MPI_Comm comm, int *flag);
+int MPIX_Comm_failure_ack(MPI_Comm comm);
+int MPIX_Comm_failure_get_acked(MPI_Comm comm, MPI_Group *failedgrp);
 
 #ifdef __cplusplus
 }
