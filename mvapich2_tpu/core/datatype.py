@@ -338,7 +338,13 @@ def _env(dt: Datatype, combiner: str, ints, aints, types) -> Datatype:
 
 
 def create_contiguous(count: int, oldtype: Datatype) -> Datatype:
-    spans = _replicate_spans(oldtype.spans, count, oldtype.extent)
+    if oldtype.is_contiguous:
+        # one span, any count — contig-of-contig must not materialize
+        # count spans (bigtype.c: MPI_Type_contiguous(2^31-1, MPI_BYTE))
+        spans = (np.array([[0, count * oldtype.size]], dtype=np.int64)
+                 if count else np.empty((0, 2), dtype=np.int64))
+    else:
+        spans = _replicate_spans(oldtype.spans, count, oldtype.extent)
     return _env(
         Datatype(spans, count * oldtype.extent, oldtype.lb, oldtype.basic,
                  f"contig({count},{oldtype.name})"),
@@ -367,13 +373,19 @@ def create_hvector(count: int, blocklength: int, stride_bytes: int,
             Datatype(spans, extent, 0, oldtype.basic,
                      f"hvector({count},{blocklength},{stride_bytes})"),
             "hvector", [count, blocklength], [stride_bytes], [oldtype])
-    spans = _replicate_spans(
-        _replicate_spans(oldtype.spans, blocklength, oldtype.extent),
-        count, stride_bytes)
-    extent = _extent_of(spans, oldtype)
-    spans = spans[np.argsort(spans[:, 0], kind="stable")]
+    # a block of a contiguous oldtype is ONE span — never materialize
+    # blocklength spans (bigtype.c builds 2^29-element blocks)
+    block = (np.array([[0, blocklength * oldtype.size]], dtype=np.int64)
+             if oldtype.is_contiguous else
+             _replicate_spans(oldtype.spans, blocklength, oldtype.extent))
+    spans = _replicate_spans(block, count, stride_bytes)
+    # spans stay in typemap (declaration) order — MPI serializes blocks
+    # in declared order, which matters when stride < blocklength (the
+    # blocks overlap, e.g. hvector stride 0 = N replicas of one block)
+    lb = _lb_of(spans)
     return _env(
-        Datatype(spans, extent, 0, oldtype.basic,
+        Datatype(spans, _extent_of(spans, oldtype) - lb, lb,
+                 oldtype.basic,
                  f"hvector({count},{blocklength},{stride_bytes})"),
         "hvector", [count, blocklength], [stride_bytes], [oldtype])
 
@@ -401,23 +413,28 @@ def create_hindexed(blocklengths: Sequence[int], disp_bytes: Sequence[int],
         # typemap (declaration) order — MPI_Pack serializes blocks in
         # the order they were declared, not by address
         spans = list(zip(dps.tolist(), (bls * oldtype.size).tolist()))
-        extent = _extent_of(spans, oldtype)
+        lb = _lb_of(spans)
         return _env(
-            Datatype(spans, extent, 0, oldtype.basic,
-                     f"hindexed({len(blocklengths)})"),
+            Datatype(spans, _extent_of(spans, oldtype) - lb, lb,
+                     oldtype.basic, f"hindexed({len(blocklengths)})"),
             "hindexed", [len(blocklengths)] + list(blocklengths),
             list(disp_bytes), [oldtype])
     parts = [
-        _replicate_spans(oldtype.spans, bl, oldtype.extent)
-        + np.array([disp, 0], dtype=np.int64)
+        (np.array([[disp, bl * oldtype.size]], dtype=np.int64)
+         if oldtype.is_contiguous else
+         _replicate_spans(oldtype.spans, bl, oldtype.extent)
+         + np.array([disp, 0], dtype=np.int64))
         for bl, disp in zip(blocklengths, disp_bytes) if bl
     ]
     spans = (np.concatenate(parts)
              if parts else np.empty((0, 2), dtype=np.int64))
-    extent = _extent_of(spans, oldtype)
+    # natural bounds (MPI-3.1 §4.1.7): lb = min typemap displacement —
+    # NOT 0 — so tiling count>1 elements (extent-strided) matches the
+    # standard when the first block starts at a positive displacement
+    lb = _lb_of(spans)
     return _env(
-        Datatype(spans, extent, 0, oldtype.basic,
-                 f"hindexed({len(blocklengths)})"),
+        Datatype(spans, _extent_of(spans, oldtype) - lb, lb,
+                 oldtype.basic, f"hindexed({len(blocklengths)})"),
         "hindexed", [len(blocklengths)] + list(blocklengths),
         list(disp_bytes), [oldtype])
 
@@ -450,10 +467,15 @@ def create_struct(blocklengths: Sequence[int], disp_bytes: Sequence[int],
     spans = (np.concatenate(parts)
              if parts else np.empty((0, 2), dtype=np.int64))
     basic = basics.pop() if len(basics) == 1 else None
-    max_ub = max((d + bl * t.extent for d, bl, t
-                  in zip(disp_bytes, blocklengths, types)), default=0)
+    # natural bounds over the real (nonzero-count) members: a member of
+    # blocklength bl spans [d + t.lb, d + (bl-1)*t.extent + t.ub]
+    real = [(d, bl, t) for d, bl, t
+            in zip(disp_bytes, blocklengths, types) if bl > 0]
+    min_lb = min((d + t.lb for d, _, t in real), default=0)
+    max_ub = max((d + (bl - 1) * t.extent + t.ub for d, bl, t in real),
+                 default=0)
     return _env(
-        Datatype(spans, max_ub, 0, basic,
+        Datatype(spans, max_ub - min_lb, min_lb, basic,
                  f"struct({len(types)})"),
         "struct", [len(types)] + list(blocklengths), list(disp_bytes),
         list(types))
@@ -514,11 +536,105 @@ def create_subarray(sizes: Sequence[int], subsizes: Sequence[int],
         + [0 if order == "C" else 1], [], [oldtype])
 
 
+# HPF distribution codes (values match mpi.h / the MPI standard)
+DISTRIBUTE_BLOCK = 121
+DISTRIBUTE_CYCLIC = 122
+DISTRIBUTE_NONE = 123
+DISTRIBUTE_DFLT_DARG = -49767
+
+
+def create_darray(size: int, rank: int, gsizes: Sequence[int],
+                  distribs: Sequence[int], dargs: Sequence[int],
+                  psizes: Sequence[int], oldtype: Datatype,
+                  order: str = "C") -> Datatype:
+    """MPI_Type_create_darray (MPI-3.1 §4.1.4): this rank's share of an
+    HPF block/cyclic-distributed global array. The reference builds it
+    by composing vectors (src/mpi/datatype/type_create_darray.c); here
+    the local global-index set is computed per dimension with vectorized
+    index arithmetic and emitted directly as ascending byte spans (the
+    constructor merges abutting runs)."""
+    ndim = len(gsizes)
+    mpi_assert(len(distribs) == ndim and len(dargs) == ndim
+               and len(psizes) == ndim, MPI_ERR_ARG,
+               "darray dims mismatch")
+    orig = (list(gsizes), list(distribs), list(dargs), list(psizes))
+    # process-grid coordinates: row-major over the ORIGINAL dim order
+    # (§4.1.4 — "as in the case of virtual Cartesian process topologies")
+    procs, tmp = 1, rank
+    for p in psizes:
+        procs *= p
+    mpi_assert(procs == size, MPI_ERR_ARG,
+               f"psizes product {procs} != size {size}")
+    coords = []
+    for p in psizes:
+        procs //= p
+        coords.append(tmp // procs)
+        tmp %= procs
+    gsizes, distribs, dargs, psizes = (list(gsizes), list(distribs),
+                                       list(dargs), list(psizes))
+    if order == "F":
+        gsizes.reverse(); distribs.reverse(); dargs.reverse()
+        psizes.reverse(); coords.reverse()
+    # per-dim sorted local global indices
+    idx: List[np.ndarray] = []
+    for d in range(ndim):
+        g, p, c = gsizes[d], psizes[d], coords[d]
+        dist, darg = distribs[d], dargs[d]
+        if dist == DISTRIBUTE_NONE:
+            mpi_assert(p == 1, MPI_ERR_ARG,
+                       "DISTRIBUTE_NONE needs psize 1")
+            ii = np.arange(g, dtype=np.int64)
+        elif dist == DISTRIBUTE_BLOCK:
+            b = darg if darg != DISTRIBUTE_DFLT_DARG else -(-g // p)
+            mpi_assert(b > 0 and b * p >= g, MPI_ERR_ARG,
+                       f"block darg {b} too small for gsize {g}/np {p}")
+            ii = np.arange(b * c, min(b * c + b, g), dtype=np.int64)
+        else:   # DISTRIBUTE_CYCLIC
+            b = darg if darg != DISTRIBUTE_DFLT_DARG else 1
+            mpi_assert(b > 0, MPI_ERR_ARG, f"bad cyclic darg {b}")
+            starts_ = np.arange(c * b, g, p * b, dtype=np.int64)
+            ii = (starts_[:, None]
+                  + np.arange(b, dtype=np.int64)[None, :]).reshape(-1)
+            ii = ii[ii < g]
+        idx.append(ii)
+    # element strides, C order (innermost dim contiguous)
+    strides = [1] * ndim
+    for i in range(ndim - 2, -1, -1):
+        strides[i] = strides[i + 1] * gsizes[i + 1]
+    offs = np.zeros(1, np.int64)
+    for d in range(ndim - 1):
+        offs = (offs[:, None] + (idx[d] * strides[d])[None, :]).reshape(-1)
+    flat = (offs[:, None] + idx[ndim - 1][None, :]).reshape(-1)
+    base = flat * oldtype.extent
+    if oldtype.is_contiguous:
+        spans = np.stack([base, np.full(len(base), oldtype.size,
+                                        np.int64)], axis=1)
+    else:
+        sp = np.asarray(oldtype.spans, np.int64).reshape(-1, 2)
+        spans = np.stack(
+            [(base[:, None] + sp[None, :, 0]).reshape(-1),
+             np.tile(sp[:, 1], len(base))], axis=1)
+    total = 1
+    for g in gsizes:
+        total *= g
+    return _env(
+        Datatype(spans, total * oldtype.extent, 0, oldtype.basic,
+                 f"darray(r{rank}/{size})"),
+        "darray", [size, rank, ndim] + orig[0] + orig[1] + orig[2]
+        + orig[3] + [0 if order == "C" else 1], [], [oldtype])
+
+
 def create_resized(oldtype: Datatype, lb: int, extent: int) -> Datatype:
     return _env(
         Datatype(oldtype.spans, extent, lb, oldtype.basic,
                  f"resized({oldtype.name})"),
         "resized", [], [lb, extent], [oldtype])
+
+
+def _lb_of(spans) -> int:
+    """Natural lower bound: min typemap byte displacement (0 if empty)."""
+    arr = np.asarray(spans, dtype=np.int64).reshape(-1, 2)
+    return int(arr[:, 0].min()) if len(arr) else 0
 
 
 def _extent_of(spans, oldtype: Datatype) -> int:

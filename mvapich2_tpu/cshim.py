@@ -1606,6 +1606,14 @@ def type_create_subarray(sizes, subsizes, starts, order: int,
         order="F" if order == 57 else "C"))   # MPI_ORDER_FORTRAN = 57
 
 
+def type_create_darray(size: int, rank: int, gsizes, distribs, dargs,
+                       psizes, order: int, oldcode: int) -> int:
+    return _new_derived(dt.create_darray(
+        size, rank, list(gsizes), list(distribs), list(dargs),
+        list(psizes), _dt(oldcode),
+        order="F" if order == 57 else "C"))
+
+
 def type_hindexed_block(blocklength: int, disp_bytes, oldcode: int) -> int:
     return type_hindexed([blocklength] * len(list(disp_bytes)),
                          disp_bytes, oldcode)
@@ -2248,3 +2256,181 @@ def comm_failure_ack(ch: int) -> int:
 def comm_failure_get_acked(ch: int) -> int:
     from .ft import ulfm
     return _new_group_handle(ulfm.failure_get_acked(_comm(ch)))
+
+
+# ---------------------------------------------------------------------------
+# MPI-IO (MPI_File_* — forwards to io/; reference: src/mpi/romio/mpi-io/
+# open.c, read.c, write_all.c, set_view.c ... The C side passes raw byte
+# views; pack/unpack placement runs through the datatype engine exactly
+# like the pt2pt paths.)
+# ---------------------------------------------------------------------------
+
+_files: Dict[int, object] = {}
+_next_file = 1
+
+# ops whose first MPI argument is an explicit offset
+_IO_AT_OPS = frozenset(
+    {"read_at", "write_at", "read_at_all", "write_at_all"})
+
+_DISPLACEMENT_CURRENT = -54278278
+
+
+def _file(fh: int):
+    f = _files.get(fh)
+    if f is None:
+        from .core.errors import MPI_ERR_FILE
+        raise MPIException(MPI_ERR_FILE, f"invalid file handle {fh}")
+    return f
+
+
+def file_open(ch: int, filename: str, amode: int, ih: int) -> int:
+    global _next_file
+    from .io.file import File
+    info = dict(_info(ih).items()) if ih >= 0 or ih == -2 else None
+    f = File(_comm(ch), filename, amode, info)
+    f._etype_code = 0            # current view's C datatype handles,
+    f._ftype_code = 0            # reported back by MPI_File_get_view
+    with _lock:
+        h = _next_file
+        _next_file += 1
+        _files[h] = f
+    return h
+
+
+def file_close(fh: int) -> int:
+    f = _file(fh)
+    f.close()
+    with _lock:
+        _files.pop(fh, None)
+    return 0
+
+
+def file_delete(filename: str) -> int:
+    from .io.file import file_delete as _fd
+    _fd(filename)
+    return 0
+
+
+def file_rw(fh: int, op: str, offset: int, view, count: int,
+            dtcode: int) -> int:
+    """Blocking read/write dispatch; returns transferred bytes."""
+    f = _file(fh)
+    d = _dt(dtcode)
+    buf = np.frombuffer(view, np.uint8) if view is not None \
+        else np.empty(0, np.uint8)
+    fn = getattr(f, op)
+    st = fn(offset, buf, count, d) if op in _IO_AT_OPS \
+        else fn(buf, count, d)
+    return st.count
+
+
+def file_irw(fh: int, op: str, offset: int, view, count: int,
+             dtcode: int) -> int:
+    """Nonblocking variant; returns a request handle for MPI_Wait/Test."""
+    global _next_req
+    f = _file(fh)
+    d = _dt(dtcode)
+    buf = np.frombuffer(view, np.uint8) if view is not None \
+        else np.empty(0, np.uint8)
+    fn = getattr(f, "i" + op)
+    r = fn(offset, buf, count, d) if op in _IO_AT_OPS \
+        else fn(buf, count, d)
+    with _lock:
+        h = _next_req
+        _next_req += 1
+        _reqs[h] = r
+    return h
+
+
+def file_set_view(fh: int, disp: int, et_code: int, ft_code: int,
+                  datarep: str) -> int:
+    f = _file(fh)
+    if disp == _DISPLACEMENT_CURRENT:
+        # MODE_SEQUENTIAL: the new displacement is the current absolute
+        # byte position (MPI-3.1 §13.3)
+        disp = f.view.physical(f._pos)
+    et = _dt(et_code)
+    ft = _dt(ft_code) if ft_code >= 0 else None
+    f.set_view(disp, et, ft, datarep)
+    f._etype_code = et_code
+    f._ftype_code = ft_code if ft_code >= 0 else et_code
+    return 0
+
+
+def file_get_view(fh: int):
+    f = _file(fh)
+    return (f.view.disp, f._etype_code, f._ftype_code)
+
+
+def file_seek(fh: int, offset: int, whence: int) -> int:
+    _file(fh).seek(offset, whence)
+    return 0
+
+
+def file_get_position(fh: int) -> int:
+    return _file(fh).get_position()
+
+
+def file_get_byte_offset(fh: int, offset: int) -> int:
+    return _file(fh).get_byte_offset(offset)
+
+
+def file_seek_shared(fh: int, offset: int, whence: int) -> int:
+    _file(fh).seek_shared(offset, whence)
+    return 0
+
+
+def file_get_position_shared(fh: int) -> int:
+    return _file(fh).get_position_shared()
+
+
+def file_get_size(fh: int) -> int:
+    return _file(fh).get_size()
+
+
+def file_set_size(fh: int, size: int) -> int:
+    _file(fh).set_size(size)
+    return 0
+
+
+def file_preallocate(fh: int, size: int) -> int:
+    _file(fh).preallocate(size)
+    return 0
+
+
+def file_get_amode(fh: int) -> int:
+    return _file(fh).get_amode()
+
+
+def file_get_group(fh: int) -> int:
+    return _new_group_handle(_file(fh).get_group())
+
+
+def file_set_info(fh: int, ih: int) -> int:
+    _file(fh).set_info(dict(_info(ih).items()) if ih >= 0 or ih == -2
+                       else None)
+    return 0
+
+
+def file_get_info(fh: int) -> int:
+    global _next_info
+    from .core.info import Info
+    with _lock:
+        h = _next_info
+        _next_info += 1
+        _infos[h] = Info(dict(_file(fh).get_info()))
+    return h
+
+
+def file_set_atomicity(fh: int, flag: int) -> int:
+    _file(fh).set_atomicity(bool(flag))
+    return 0
+
+
+def file_get_atomicity(fh: int) -> int:
+    return 1 if _file(fh).get_atomicity() else 0
+
+
+def file_sync(fh: int) -> int:
+    _file(fh).sync()
+    return 0

@@ -21,6 +21,7 @@ import os
 import threading
 from typing import Dict, Optional, Tuple
 
+from ..core.datatype import as_bytes_view
 from ..core.errors import (MPIException, MPI_ERR_AMODE, MPI_ERR_FILE,
                            MPI_ERR_IO, MPI_ERR_NO_SUCH_FILE)
 
@@ -53,6 +54,13 @@ class ADIOFile:
 
     def write_at(self, offset: int, data) -> int:
         raise NotImplementedError
+
+    def read_into(self, offset: int, mv: memoryview) -> int:
+        """Read directly into a writable byte view (zero extra copy when
+        the driver supports it); returns bytes read."""
+        b = self.read_at(offset, len(mv))
+        mv[:len(b)] = b
+        return len(b)
 
     def size(self) -> int:
         raise NotImplementedError
@@ -97,17 +105,50 @@ class UfsFile(ADIOFile):
             raise MPIException(MPI_ERR_IO, f"open {path!r}: {e}") from e
         self.path = path
 
+    # Linux caps a single pread/pwrite at MAX_RW_COUNT (2 GiB - 4 KiB)
+    # and either may be partial anyway — always loop (bigtype.c writes
+    # 2^31 bytes in one MPI call and checks the last bytes)
     def read_at(self, offset: int, nbytes: int) -> bytes:
+        chunks = []
+        got = 0
         try:
-            return os.pread(self.fd, nbytes, offset)
+            while got < nbytes:
+                b = os.pread(self.fd, min(nbytes - got, 1 << 30),
+                             offset + got)
+                if not b:
+                    break
+                chunks.append(b)
+                got += len(b)
         except OSError as e:
             raise MPIException(MPI_ERR_IO, f"pread: {e}") from e
+        return chunks[0] if len(chunks) == 1 else b"".join(chunks)
 
     def write_at(self, offset: int, data) -> int:
+        mv = as_bytes_view(data)
+        total = 0
         try:
-            return os.pwrite(self.fd, bytes(data), offset)
+            while total < len(mv):
+                n = os.pwrite(self.fd, mv[total:total + (1 << 30)],
+                              offset + total)
+                if n <= 0:
+                    break
+                total += n
         except OSError as e:
             raise MPIException(MPI_ERR_IO, f"pwrite: {e}") from e
+        return total
+
+    def read_into(self, offset: int, mv: memoryview) -> int:
+        total = 0
+        try:
+            while total < len(mv):
+                n = os.preadv(self.fd, [mv[total:total + (1 << 30)]],
+                              offset + total)
+                if n <= 0:
+                    break
+                total += n
+        except OSError as e:
+            raise MPIException(MPI_ERR_IO, f"preadv: {e}") from e
+        return total
 
     def size(self) -> int:
         return os.fstat(self.fd).st_size
@@ -161,12 +202,13 @@ class MemFile(ADIOFile):
             return bytes(self.buf[offset:offset + nbytes])
 
     def write_at(self, offset: int, data) -> int:
-        data = bytes(data)
+        mv = as_bytes_view(data)
+        n = len(mv)
         with self.lock:
-            if offset + len(data) > len(self.buf):
-                self.buf.extend(b"\0" * (offset + len(data) - len(self.buf)))
-            self.buf[offset:offset + len(data)] = data
-        return len(data)
+            if offset + n > len(self.buf):
+                self.buf.extend(b"\0" * (offset + n - len(self.buf)))
+            self.buf[offset:offset + n] = mv
+        return n
 
     def size(self) -> int:
         with self.lock:

@@ -17,6 +17,7 @@ units at the boundary (§13.3: offsets are in etypes).
 from __future__ import annotations
 
 import pickle
+import queue
 import threading
 from typing import List, Optional, Tuple
 
@@ -64,6 +65,8 @@ class File:
         self.view = FileView()
         self._pos = 0                     # individual pointer, bytes
         self._lock = threading.Lock()     # pointer + view updates
+        self._worker: Optional[threading.Thread] = None   # i-op drain
+        self._q: Optional[queue.Queue] = None
         # shared file pointer: an int64 on rank 0, fetch-add via RMA
         self._sp_win = self.comm.win_allocate(8 if self.comm.rank == 0
                                               else 0)
@@ -93,7 +96,8 @@ class File:
                  "native", info=None) -> None:
         self._check_closed()
         if datarep != "native":
-            raise MPIException(MPI_ERR_ARG,
+            from ..core.errors import MPI_ERR_UNSUPPORTED_DATAREP
+            raise MPIException(MPI_ERR_UNSUPPORTED_DATAREP,
                                f"datarep {datarep!r} unsupported")
         with self._lock:
             self.view = FileView(disp, etype, filetype)
@@ -140,7 +144,10 @@ class File:
         under atomicity, plain per-run writes otherwise."""
         if not runs:
             return 0
-        data = memoryview(bytes(data))
+        # zero-copy byte view — bigtype-scale payloads must not be
+        # duplicated here (the pack already produced the one copy)
+        from ..core.datatype import as_bytes_view
+        data = as_bytes_view(data)
         if self.atomicity:
             self.fh.lock_all()
         try:
@@ -155,27 +162,44 @@ class File:
 
     # -- independent, explicit offset ----------------------------------
     def read_at(self, offset: int, buf, count: Optional[int] = None,
-                datatype: Optional[Datatype] = None) -> Status:
-        """``offset`` in etype units (MPI semantics)."""
+                datatype: Optional[Datatype] = None,
+                view: Optional[FileView] = None) -> Status:
+        """``offset`` in etype units (MPI semantics). ``view`` overrides
+        the file's current view — nonblocking ops capture the view at
+        post time (§13.4.2: a later set_view must not retarget them)."""
         self._check(writing=False)
+        v = view if view is not None else self.view
         count, datatype = _resolve(buf, count, datatype)
         nbytes = count * datatype.size
-        runs = self.view.map_range(offset * self.view.etype.size, nbytes)
+        runs = v.map_range(offset * v.etype.size, nbytes)
+        if len(runs) == 1 and datatype.is_contiguous:
+            # zero-copy: one physical run straight into the user buffer
+            from ..core.datatype import as_bytes_view
+            mv = as_bytes_view(buf, writable=True)[:runs[0][1]]
+            got = self.fh.read_into(runs[0][0], mv)
+            return Status(count=min(got, nbytes))
         out = bytearray(nbytes)
         got = self._read_runs(runs, out)
-        datatype.unpack(np.frombuffer(bytes(out[:nbytes]), np.uint8),
+        datatype.unpack(np.frombuffer(out, np.uint8, count=nbytes),
                         buf, count)
         st = Status(count=min(got, nbytes))
         return st
 
     def write_at(self, offset: int, buf, count: Optional[int] = None,
-                 datatype: Optional[Datatype] = None) -> Status:
+                 datatype: Optional[Datatype] = None,
+                 view: Optional[FileView] = None) -> Status:
         self._check(writing=True)
+        v = view if view is not None else self.view
         count, datatype = _resolve(buf, count, datatype)
-        packed = np.asarray(datatype.pack(buf, count))
-        runs = self.view.map_range(offset * self.view.etype.size,
-                                   packed.size)
-        n = self._write_runs(runs, packed.tobytes())
+        nbytes = count * datatype.size
+        if datatype.is_contiguous:
+            # zero-copy: the user buffer IS the payload
+            from ..core.datatype import as_bytes_view
+            payload = as_bytes_view(buf)[:nbytes]
+        else:
+            payload = np.asarray(datatype.pack(buf, count))
+        runs = v.map_range(offset * v.etype.size, nbytes)
+        n = self._write_runs(runs, payload)
         return Status(count=n)
 
     # -- individual file pointer ---------------------------------------
@@ -240,12 +264,16 @@ class File:
 
     # -- collective (two-phase) ----------------------------------------
     def read_at_all(self, offset: int, buf, count: Optional[int] = None,
-                    datatype: Optional[Datatype] = None) -> Status:
-        return self._coll_io(offset, buf, count, datatype, writing=False)
+                    datatype: Optional[Datatype] = None,
+                    view: Optional[FileView] = None) -> Status:
+        return self._coll_io(offset, buf, count, datatype, writing=False,
+                             view=view)
 
     def write_at_all(self, offset: int, buf, count: Optional[int] = None,
-                     datatype: Optional[Datatype] = None) -> Status:
-        return self._coll_io(offset, buf, count, datatype, writing=True)
+                     datatype: Optional[Datatype] = None,
+                     view: Optional[FileView] = None) -> Status:
+        return self._coll_io(offset, buf, count, datatype, writing=True,
+                             view=view)
 
     def read_all(self, buf, count: Optional[int] = None,
                  datatype: Optional[Datatype] = None) -> Status:
@@ -262,16 +290,25 @@ class File:
         return self.write_at_all(self._etypes(old), buf, count, datatype)
 
     def _coll_io(self, offset: int, buf, count, datatype,
-                 writing: bool) -> Status:
+                 writing: bool,
+                 view: Optional[FileView] = None) -> Status:
         """Two-phase collective IO (ad_write_coll.c analog): partition the
         aggregate file range into per-rank file domains; each rank ships
         the run pieces that fall into domain d to aggregator d; aggregators
         do one contiguous (sieved) file access per domain."""
         self._check(writing=writing)
         comm = self.comm
+        v = view if view is not None else self.view
+        if comm.size == 1:
+            # degenerate collective: skip the exchange entirely (matters
+            # at bigtype scale — no 2 GiB pickle round-trip to self)
+            return (self.write_at(offset, buf, count, datatype, view=v)
+                    if writing
+                    else self.read_at(offset, buf, count, datatype,
+                                      view=v))
         count, datatype = _resolve(buf, count, datatype)
         nbytes = count * datatype.size
-        runs = self.view.map_range(offset * self.view.etype.size, nbytes)
+        runs = v.map_range(offset * v.etype.size, nbytes)
         data = memoryview(np.asarray(datatype.pack(buf, count)).tobytes()) \
             if writing else None
         # aggregate extent over all ranks (runs are ascending)
@@ -457,10 +494,29 @@ class File:
         return self.write_at(self._etypes(my), buf, count, datatype)
 
     # -- nonblocking ---------------------------------------------------
+    # One worker thread per file drains a FIFO of posted i-ops: every
+    # rank posts collective i-ops in the same program order, so the
+    # workers across ranks execute matching ops in matching order and
+    # two outstanding collectives can never interleave their exchange
+    # traffic on the file's dup comm (ROMIO serializes per-file the
+    # same way via the ADIOI request queue).
     def _async(self, fn, *a) -> Request:
         req = Request(self.comm.u.engine, "io")
+        with self._lock:
+            if self._worker is None:
+                self._q = queue.Queue()
+                self._worker = threading.Thread(
+                    target=self._drain, daemon=True, name="mpiio")
+                self._worker.start()
+        self._q.put((fn, a, req))
+        return req
 
-        def run():
+    def _drain(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, a, req = item
             try:
                 st = fn(*a)
                 req.status = st
@@ -468,14 +524,13 @@ class File:
             except MPIException as e:
                 req.complete(e)
 
-        threading.Thread(target=run, daemon=True, name="mpiio").start()
-        return req
-
     def iread_at(self, offset, buf, count=None, datatype=None) -> Request:
-        return self._async(self.read_at, offset, buf, count, datatype)
+        return self._async(self.read_at, offset, buf, count, datatype,
+                           self.view)
 
     def iwrite_at(self, offset, buf, count=None, datatype=None) -> Request:
-        return self._async(self.write_at, offset, buf, count, datatype)
+        return self._async(self.write_at, offset, buf, count, datatype,
+                           self.view)
 
     def iread(self, buf, count=None, datatype=None) -> Request:
         self._check(writing=False)
@@ -486,14 +541,57 @@ class File:
         # the full request (standard practice for i-ops)
         old = self._advance(count * datatype.size)
         return self._async(self.read_at, self._etypes(old), buf, count,
-                           datatype)
+                           datatype, self.view)
 
     def iwrite(self, buf, count=None, datatype=None) -> Request:
         self._check(writing=True)
         count, datatype = _resolve(buf, count, datatype)
         old = self._advance(count * datatype.size)
         return self._async(self.write_at, self._etypes(old), buf, count,
-                           datatype)
+                           datatype, self.view)
+
+    # nonblocking collectives (MPI-3.1 §13.4.5; one outstanding op per
+    # file is the supported discipline — the op's collective exchange
+    # runs on the file's private dup comm inside the worker thread)
+    def iread_at_all(self, offset, buf, count=None,
+                     datatype=None) -> Request:
+        return self._async(self.read_at_all, offset, buf, count, datatype,
+                           self.view)
+
+    def iwrite_at_all(self, offset, buf, count=None,
+                      datatype=None) -> Request:
+        return self._async(self.write_at_all, offset, buf, count,
+                           datatype, self.view)
+
+    def iread_all(self, buf, count=None, datatype=None) -> Request:
+        self._check(writing=False)
+        count, datatype = _resolve(buf, count, datatype)
+        old = self._advance(count * datatype.size)
+        return self._async(self.read_at_all, self._etypes(old), buf,
+                           count, datatype, self.view)
+
+    def iwrite_all(self, buf, count=None, datatype=None) -> Request:
+        self._check(writing=True)
+        count, datatype = _resolve(buf, count, datatype)
+        old = self._advance(count * datatype.size)
+        return self._async(self.write_at_all, self._etypes(old), buf,
+                           count, datatype, self.view)
+
+    # ordered-mode split collectives: the rank-ordered base is computed
+    # collectively at post time (begin IS collective), the IO overlaps
+    def iread_ordered(self, buf, count=None, datatype=None) -> Request:
+        self._check(writing=False)
+        count, datatype = _resolve(buf, count, datatype)
+        my = self._ordered_base(count * datatype.size)
+        return self._async(self.read_at, self._etypes(my), buf, count,
+                           datatype, self.view)
+
+    def iwrite_ordered(self, buf, count=None, datatype=None) -> Request:
+        self._check(writing=True)
+        count, datatype = _resolve(buf, count, datatype)
+        my = self._ordered_base(count * datatype.size)
+        return self._async(self.write_at, self._etypes(my), buf, count,
+                           datatype, self.view)
 
     def iread_shared(self, buf, count=None, datatype=None) -> Request:
         self._check(writing=False)
@@ -501,14 +599,14 @@ class File:
         # full advance, no EOF clamp — see iread
         old = self._shared_fetch_add(count * datatype.size)
         return self._async(self.read_at, self._etypes(old), buf, count,
-                           datatype)
+                           datatype, self.view)
 
     def iwrite_shared(self, buf, count=None, datatype=None) -> Request:
         self._check(writing=True)
         count, datatype = _resolve(buf, count, datatype)
         old = self._shared_fetch_add(count * datatype.size)
         return self._async(self.write_at, self._etypes(old), buf, count,
-                           datatype)
+                           datatype, self.view)
 
     # -- management ----------------------------------------------------
     def get_size(self) -> int:
@@ -555,6 +653,10 @@ class File:
     def close(self) -> None:
         if self.closed:
             return
+        if self._worker is not None:      # drain pending i-ops first
+            self._q.put(None)
+            self._worker.join()
+            self._worker = None
         self.comm.barrier()
         self.fh.sync()
         self.fh.close()

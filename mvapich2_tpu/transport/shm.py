@@ -230,6 +230,12 @@ class ShmChannel(Channel):
         # RGET exposure directory: handle -> mmap'd scratch file
         self._exposed: Dict[str, np.ndarray] = {}
         self._backlog: Dict[int, List[bytes]] = {}
+        # serializes the ring producer + backlog: the SPSC ring assumes
+        # one producer per (src,dst) pair, but sends arrive from any
+        # user thread (MPI-IO worker, THREAD_MULTIPLE) while poll()
+        # flushes the backlog under the engine mutex. Channel-local and
+        # never held across a wait, so no cross-engine cycle.
+        self._send_lock = threading.Lock()
         # Doorbell: a per-rank unix datagram socket. Senders fire one
         # best-effort datagram after each ring write so a receiver blocked
         # in wait_for_event wakes immediately — sched_yield on an
@@ -303,17 +309,20 @@ class ShmChannel(Channel):
         blob = pickle.dumps((pkt.header_tuple(), payload), protocol=5)
         src_i = self.local_index[self.my_rank]
         dst_i = self.local_index[dest_world]
-        bl = self._backlog.setdefault(dst_i, [])
-        if bl:
-            bl.append(blob)
-            self._flush(dst_i)
-        else:
-            rc = self._ring.send(src_i, dst_i, blob)
-            if rc == 0:
-                bl.append(blob)      # ring full: backlog, flush from poll
-            elif rc < 0:
-                # larger than the ring: stream via a scratch file RGET
-                self._send_oversize(dst_i, pkt, blob)
+        with self._send_lock:
+            bl = self._backlog.setdefault(dst_i, [])
+            if bl:
+                bl.append(blob)
+                self._flush(dst_i)
+            else:
+                rc = self._ring.send(src_i, dst_i, blob)
+                if rc == 0:
+                    bl.append(blob)  # ring full: backlog, flush from poll
+                elif rc < 0:
+                    # larger than the ring: stream via a scratch RGET
+                    note = self._spill_oversize(blob)
+                    if self._ring.send(src_i, dst_i, note) == 0:
+                        bl.append(note)
         self._ring_bell(dest_world)
 
     def wait_for_event(self, timeout: float) -> None:
@@ -340,14 +349,16 @@ class ShmChannel(Channel):
     def post_wait(self) -> None:
         self._flags[self.local_index[self.my_rank]] = 0
 
-    def _send_oversize(self, dst_i: int, pkt: Packet, blob: bytes) -> None:
+    def _spill_oversize(self, blob: bytes) -> bytes:
+        """Spill a larger-than-ring message to a scratch file; returns
+        the small ring note pointing at it. Never waits for ring space —
+        a spin here would run under _send_lock and block poll() from
+        draining inbound rings (cross-rank deadlock); a full ring just
+        backlogs the note like any other blob."""
         path = self.path + f".big-{self.my_rank}-{uuid.uuid4().hex[:8]}"
         with open(path, "wb") as f:
             f.write(blob)
-        note = pickle.dumps(("__bigmsg__", path, len(blob)), protocol=5)
-        src_i = self.local_index[self.my_rank]
-        while self._ring.send(src_i, dst_i, note) == 0:
-            pass
+        return pickle.dumps(("__bigmsg__", path, len(blob)), protocol=5)
 
     def _flush(self, dst_i: int) -> None:
         bl = self._backlog.get(dst_i) or []
@@ -358,15 +369,18 @@ class ShmChannel(Channel):
                 return
             blob = bl.pop(0)
             if rc < 0:
-                pkt = None
-                self._send_oversize(dst_i, pkt, blob)
+                note = self._spill_oversize(blob)
+                if self._ring.send(src_i, dst_i, note) == 0:
+                    bl.insert(0, note)   # keep FIFO order, retry later
+                    return
 
     def poll(self) -> bool:
         my_i = self.local_index[self.my_rank]
         self._drain_bell()
         did = False
-        for dst_i in list(self._backlog):
-            self._flush(dst_i)
+        with self._send_lock:
+            for dst_i in list(self._backlog):
+                self._flush(dst_i)
         for src_i in range(self.n_local):
             if src_i == my_i:
                 continue

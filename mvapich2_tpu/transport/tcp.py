@@ -20,6 +20,7 @@ import pickle
 import selectors
 import socket
 import struct
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -65,6 +66,13 @@ class TcpChannel(Channel):
         self._out: Dict[int, _Conn] = {}      # dest rank -> conn
         self._in: List[_Conn] = []
         self._closed = False
+        # serializes outbound conn state (outq + flush cursor): sends
+        # come from any user thread (e.g. the MPI-IO worker) while
+        # poll()'s backlog flush runs under the engine mutex — without
+        # this lock the two interleave and corrupt the stream. A plain
+        # channel-local lock (never held while waiting on a peer) so it
+        # cannot join a cross-engine wait cycle.
+        self._slock = threading.Lock()
 
     # -- outgoing ---------------------------------------------------------
     def _connect(self, dest: int) -> _Conn:
@@ -79,17 +87,18 @@ class TcpChannel(Channel):
         return conn
 
     def send_packet(self, dest_world: int, pkt: Packet) -> None:
-        conn = self._out.get(dest_world) or self._connect(dest_world)
         data = pkt.data
         payload = b""
         if data is not None:
             payload = np.ascontiguousarray(data).tobytes()
         hdr = pickle.dumps((pkt.header_tuple(), len(payload)), protocol=5)
-        conn.outq.append(_LEN.pack(len(hdr)))
-        conn.outq.append(hdr)
-        if payload:
-            conn.outq.append(payload)
-        self._flush(conn)
+        with self._slock:
+            conn = self._out.get(dest_world) or self._connect(dest_world)
+            conn.outq.append(_LEN.pack(len(hdr)))
+            conn.outq.append(hdr)
+            if payload:
+                conn.outq.append(payload)
+            self._flush(conn)
 
     def _flush(self, conn: _Conn) -> bool:
         """Nonblocking flush of the backlog; True if fully drained."""
@@ -177,10 +186,11 @@ class TcpChannel(Channel):
                 _, conn = data
                 if self._on_readable(conn):
                     did = True
-        for conn in self._out.values():
-            if conn.outq:
-                self._flush(conn)
-                did = True
+        with self._slock:
+            for conn in list(self._out.values()):
+                if conn.outq:
+                    self._flush(conn)
+                    did = True
         return did
 
     def wait_for_event(self, timeout: float) -> None:
@@ -200,8 +210,9 @@ class TcpChannel(Channel):
         deadline = time.monotonic() + 2.0
         while any(c.outq for c in self._out.values()) and \
                 time.monotonic() < deadline:
-            for c in self._out.values():
-                self._flush(c)
+            with self._slock:
+                for c in list(self._out.values()):
+                    self._flush(c)
         self._closed = True
         for conn in list(self._out.values()) + self._in:
             try:

@@ -107,7 +107,9 @@ int shim_call_i(const char *name, const char *fmt, ...) {
         if (PyErr_Occurred()) { PyErr_Clear(); rc = MPI_SUCCESS; }
         Py_DECREF(res);
     } else {
-        PyErr_Print();
+        /* map the MPIException to its error class (conformance tests
+         * check MPI_Error_class of the return) */
+        rc = mv2t_errcode_from_pyerr();
     }
     Py_XDECREF(fn);
     Py_XDECREF(args);
@@ -116,7 +118,10 @@ int shim_call_i(const char *name, const char *fmt, ...) {
 }
 
 /* call shim.<name>(fmt...) -> long value; *ok = 0 on Python exception
- * (value and error travel on separate channels). */
+ * (value and error travel on separate channels; the exception's MPI
+ * class is latched into mv2t_last_errclass — GIL-serialized). */
+int mv2t_last_errclass = MPI_ERR_OTHER;
+
 long shim_call_v(const char *name, int *ok, const char *fmt, ...) {
     PyGILState_STATE st = PyGILState_Ensure();
     va_list ap;
@@ -135,7 +140,7 @@ long shim_call_v(const char *name, int *ok, const char *fmt, ...) {
             PyErr_Clear();
         Py_DECREF(res);
     } else {
-        PyErr_Print();
+        mv2t_last_errclass = mv2t_errcode_from_pyerr();
     }
     Py_XDECREF(fn);
     Py_XDECREF(args);
@@ -155,8 +160,9 @@ static int shim_call_status(const char *name, MPI_Status *status,
     PyObject *fn = args ? PyObject_GetAttrString(g_shim, name) : NULL;
     PyObject *res = fn ? PyObject_CallObject(fn, args) : NULL;
     if (res) {
-        int src = -1, tag = -1, cnt = 0;
-        if (PyArg_ParseTuple(res, "iii", &src, &tag, &cnt)) {
+        int src = -1, tag = -1;
+        long long cnt = 0;
+        if (PyArg_ParseTuple(res, "iiL", &src, &tag, &cnt)) {
             if (status != MPI_STATUS_IGNORE) {
                 status->MPI_SOURCE = src;
                 status->MPI_TAG = tag;
@@ -388,8 +394,9 @@ int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
                                         count, dt, source, tag, comm);
     int rc = MPI_ERR_OTHER;
     if (res) {
-        int src = -1, t = -1, cnt = 0;
-        if (PyArg_ParseTuple(res, "iii", &src, &t, &cnt)) {
+        int src = -1, t = -1;
+        long long cnt = 0;
+        if (PyArg_ParseTuple(res, "iiL", &src, &t, &cnt)) {
             if (status != MPI_STATUS_IGNORE) {
                 status->MPI_SOURCE = src;
                 status->MPI_TAG = t;
@@ -447,8 +454,9 @@ int MPI_Wait(MPI_Request *req, MPI_Status *status) {
                                         (long)*req);
     int rc = MPI_ERR_OTHER;
     if (res) {
-        int src = -1, tag = -1, cnt = 0, persistent = 0, canc = 0;
-        if (PyArg_ParseTuple(res, "iiiii", &src, &tag, &cnt,
+        int src = -1, tag = -1, persistent = 0, canc = 0;
+        long long cnt = 0;
+        if (PyArg_ParseTuple(res, "iiLii", &src, &tag, &cnt,
                              &persistent, &canc)) {
             if (status != MPI_STATUS_IGNORE) {
                 status->MPI_SOURCE = src;
@@ -493,9 +501,10 @@ int MPI_Test(MPI_Request *req, int *flag, MPI_Status *status) {
                                         (long)*req);
     int rc = MPI_ERR_OTHER;
     if (res) {
-        int f = 0, persistent = 0, src = -1, tag = -1, cnt = 0;
+        int f = 0, persistent = 0, src = -1, tag = -1;
         int canc = 0;
-        if (PyArg_ParseTuple(res, "iiiiii", &f, &persistent, &src, &tag,
+        long long cnt = 0;
+        if (PyArg_ParseTuple(res, "iiiiLi", &f, &persistent, &src, &tag,
                              &cnt, &canc)) {
             *flag = f;
             if (f && status != MPI_STATUS_IGNORE) {
@@ -966,8 +975,9 @@ int MPI_Iprobe(int source, int tag, MPI_Comm comm, int *flag,
                                         tag, comm);
     int rc = MPI_ERR_OTHER;
     if (res) {
-        int f = 0, src = -1, t = -1, cnt = 0;
-        if (PyArg_ParseTuple(res, "iiii", &f, &src, &t, &cnt)) {
+        int f = 0, src = -1, t = -1;
+        long long cnt = 0;
+        if (PyArg_ParseTuple(res, "iiiL", &f, &src, &t, &cnt)) {
             *flag = f;
             if (f && status != MPI_STATUS_IGNORE) {
                 status->MPI_SOURCE = src;
@@ -997,9 +1007,10 @@ int MPI_Waitany(int count, MPI_Request reqs[], int *index,
     PyObject *res = PyObject_CallMethod(g_shim, "waitany", "(O)", hl);
     int rc = MPI_ERR_OTHER;
     if (res) {
-        int pos = -1, src = -1, tag = -2, cnt = 0, persistent = 0;
+        int pos = -1, src = -1, tag = -2, persistent = 0;
         int canc = 0;
-        if (PyArg_ParseTuple(res, "iiiiii", &pos, &src, &tag, &cnt,
+        long long cnt = 0;
+        if (PyArg_ParseTuple(res, "iiiLii", &pos, &src, &tag, &cnt,
                              &persistent, &canc)) {
             rc = MPI_SUCCESS;
             if (pos < 0) {
@@ -1050,10 +1061,11 @@ int MPI_Testall(int count, MPI_Request reqs[], int *flag,
                 for (int i = 0; i < count; i++) {
                     PyObject *t = PyList_Size(sts) > i
                                   ? PyList_GET_ITEM(sts, i) : NULL;
-                    int src = -1, tag = -2, cnt = 0, persistent = 0;
+                    int src = -1, tag = -2, persistent = 0;
                     int canc = 0;
+                    long long cnt = 0;
                     if (t)
-                        PyArg_ParseTuple(t, "iiiii", &src, &tag, &cnt,
+                        PyArg_ParseTuple(t, "iiLii", &src, &tag, &cnt,
                                          &persistent, &canc);
                     if (statuses != MPI_STATUSES_IGNORE) {
                         statuses[i].MPI_SOURCE = src;

@@ -1082,6 +1082,40 @@ int MPI_Type_get_true_extent(MPI_Datatype datatype, MPI_Aint *true_lb,
     return rc;
 }
 
+int MPI_Type_create_darray(int size, int rank, int ndims,
+                           const int gsizes[], const int distribs[],
+                           const int dargs[], const int psizes[],
+                           int order, MPI_Datatype oldtype,
+                           MPI_Datatype *newtype) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *gs = int_list(gsizes, ndims);
+    PyObject *di = int_list(distribs, ndims);
+    PyObject *da = int_list(dargs, ndims);
+    PyObject *ps = int_list(psizes, ndims);
+    PyObject *res = PyObject_CallMethod(g_shim, "type_create_darray",
+                                        "(iiOOOOii)", size, rank, gs, di,
+                                        da, ps, order, oldtype);
+    int rc = MPI_ERR_TYPE;
+    if (res != NULL) {
+        long h = PyLong_AsLong(res);
+        if (!PyErr_Occurred()) {
+            *newtype = (MPI_Datatype)h;
+            rc = MPI_SUCCESS;
+        } else {
+            rc = mv2t_errcode_from_pyerr();
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    Py_XDECREF(gs);
+    Py_XDECREF(di);
+    Py_XDECREF(da);
+    Py_XDECREF(ps);
+    PyGILState_Release(st);
+    return rc;
+}
+
 int MPI_Type_create_subarray(int ndims, const int sizes[],
                              const int subsizes[], const int starts[],
                              int order, MPI_Datatype oldtype,
@@ -1472,6 +1506,14 @@ void mv2t_set_comm_errhandler(int comm, MPI_Errhandler eh) {
 
 MPI_Errhandler mv2t_get_comm_errhandler(int comm) {
     return eh_of(comm);
+}
+
+/* invoke a user errhandler on any int-handle object (comm/file: the
+ * handler ABIs are identical) — used by libmpi_io.c's per-file table */
+void mv2t_eh_invoke(MPI_Errhandler eh, int *handle, int *rc) {
+    if (eh >= EH_BASE && eh - EH_BASE < MAX_EH
+        && g_eh[eh - EH_BASE].used && g_eh[eh - EH_BASE].fn != NULL)
+        g_eh[eh - EH_BASE].fn(handle, rc);
 }
 
 void mv2t_comm_eh_forget(int comm) {
@@ -1988,7 +2030,7 @@ int MPI_Status_set_cancelled(MPI_Status *status, int flag) {
 
 int MPI_Status_set_elements(MPI_Status *status, MPI_Datatype dt,
                             int count) {
-    status->_count = count * dt_size(dt);
+    status->_count = (long long)count * dt_size(dt);
     return MPI_SUCCESS;
 }
 
@@ -2010,8 +2052,9 @@ int MPI_Request_get_status(MPI_Request req, int *flag,
                                         "(l)", (long)req);
     int rc = MPI_ERR_OTHER;
     if (res != NULL) {
-        int f = 0, src = -1, tag = -2, cnt = 0, canc = 0;
-        if (PyArg_ParseTuple(res, "iiiii", &f, &src, &tag, &cnt,
+        int f = 0, src = -1, tag = -2, canc = 0;
+        long long cnt = 0;
+        if (PyArg_ParseTuple(res, "iiiLi", &f, &src, &tag, &cnt,
                              &canc)) {
             *flag = f;
             if (f && status != MPI_STATUS_IGNORE) {

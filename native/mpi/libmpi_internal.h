@@ -13,6 +13,7 @@ extern PyObject *g_shim;               /* mvapich2_tpu.cshim module */
 int ensure_python(void);
 int shim_call_i(const char *name, const char *fmt, ...);
 long shim_call_v(const char *name, int *ok, const char *fmt, ...);
+extern int mv2t_last_errclass;   /* class of the last shim_call_v error */
 PyObject *mv_view(const void *buf, long nbytes);
 int dt_size(MPI_Datatype dt);
 long dt_extent_b(MPI_Datatype dt);
@@ -33,6 +34,7 @@ int mv2t_userop_coll(int kind, const void *sendbuf, void *recvbuf,
 const char *mv2t_user_error_string(int errorcode);
 int mv2t_user_error_class(int errorcode);
 void mv2t_set_comm_errhandler(int comm, MPI_Errhandler eh);
+void mv2t_eh_invoke(MPI_Errhandler eh, int *handle, int *rc);
 MPI_Errhandler mv2t_get_comm_errhandler(int comm);
 int mv2t_errcheck(MPI_Comm comm, int rc);
 void mv2t_errhandler_free(MPI_Errhandler eh);

@@ -1,0 +1,617 @@
+/* libmpi_io.c — the MPI-IO C ABI surface (MPI-3.1 chapter 13).
+ *
+ * Forwards to the Python io/ package (mvapich2_tpu/io/file.py: views,
+ * data sieving, two-phase collective buffering, shared/ordered
+ * pointers) through the embedded-CPython bridge, the same way libmpi.c
+ * forwards the pt2pt/collective surface into cshim.py.
+ *
+ * Reference parity target: src/mpi/romio/mpi-io/ (open.c, read.c,
+ * write_all.c, set_view.c, seek.c ...) and the io area of the MPICH
+ * conformance suite (test/mpi/io/testlist.in) — the acceptance oracle.
+ *
+ * File error handling follows §13.7: the default errhandler on files is
+ * the one attached to MPI_FILE_NULL, initially MPI_ERRORS_RETURN (unlike
+ * communicators) — so every entry point returns error codes through the
+ * per-file handler table below instead of aborting.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "libmpi_internal.h"
+
+/* ------------------------------------------------------------------ */
+/* per-file C-side record: errhandler + pending split-collective op    */
+/* ------------------------------------------------------------------ */
+
+typedef struct file_node {
+    MPI_File fh;
+    MPI_Errhandler eh;
+    MPI_Request split;          /* pending begin/..._end op, or 0 */
+    struct file_node *next;
+} file_node;
+
+static file_node *g_files;
+/* §13.7: handler attached to MPI_FILE_NULL is the default for opens */
+static MPI_Errhandler g_file_null_eh = MPI_ERRORS_RETURN;
+
+static file_node *file_rec(MPI_File fh) {
+    for (file_node *n = g_files; n != NULL; n = n->next)
+        if (n->fh == fh)
+            return n;
+    return NULL;
+}
+
+static void file_rec_add(MPI_File fh) {
+    file_node *n = malloc(sizeof *n);
+    if (n == NULL)
+        return;
+    n->fh = fh;
+    n->eh = g_file_null_eh;
+    n->split = 0;
+    n->next = g_files;
+    g_files = n;
+}
+
+static void file_rec_del(MPI_File fh) {
+    file_node **p = &g_files;
+    while (*p != NULL) {
+        if ((*p)->fh == fh) {
+            file_node *dead = *p;
+            *p = dead->next;
+            free(dead);
+            return;
+        }
+        p = &(*p)->next;
+    }
+}
+
+/* route an error through the file's errhandler (§13.7) */
+static int file_errcheck(MPI_File fh, int rc) {
+    if (rc == MPI_SUCCESS)
+        return rc;
+    file_node *n = file_rec(fh);
+    MPI_Errhandler eh = n != NULL ? n->eh : g_file_null_eh;
+    if (eh == MPI_ERRORS_ARE_FATAL) {
+        fprintf(stderr, "Fatal error in MPI-IO: error class %d\n", rc);
+        MPI_Abort(MPI_COMM_WORLD, rc);
+    } else if (eh >= 16) {
+        int handle = fh;
+        mv2t_eh_invoke(eh, &handle, &rc);
+    }
+    return rc;
+}
+
+static void io_status(MPI_Status *status, long nbytes) {
+    if (status != MPI_STATUS_IGNORE) {
+        status->MPI_SOURCE = MPI_ANY_SOURCE;
+        status->MPI_TAG = MPI_ANY_TAG;
+        status->MPI_ERROR = MPI_SUCCESS;
+        status->_count = nbytes;
+        status->_cancelled = 0;
+    }
+}
+
+/* one helper for every blocking read/write variant: shim file_rw
+ * returns the transferred byte count (which becomes status._count,
+ * the same bytes-based convention the pt2pt status path uses) */
+static int file_rw(const char *op, MPI_File fh, MPI_Offset offset,
+                   void *buf, int count, MPI_Datatype dt,
+                   MPI_Status *status) {
+    int rc = ensure_python();
+    if (rc != MPI_SUCCESS)
+        return rc;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *view = mv_view(buf, dt_span_b(dt, count));
+    PyObject *res = PyObject_CallMethod(g_shim, "file_rw", "(isLOii)",
+                                        fh, op, (long long)offset, view,
+                                        count, dt);
+    if (res != NULL) {
+        io_status(status, PyLong_AsLong(res));
+        rc = MPI_SUCCESS;
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    Py_XDECREF(view);
+    PyGILState_Release(st);
+    return file_errcheck(fh, rc);
+}
+
+/* nonblocking variants: shim file_irw returns a request handle that the
+ * ordinary MPI_Wait/Test/Waitany machinery completes */
+static int file_irw(const char *op, MPI_File fh, MPI_Offset offset,
+                    void *buf, int count, MPI_Datatype dt,
+                    MPI_Request *request) {
+    int rc = ensure_python();
+    if (rc != MPI_SUCCESS)
+        return rc;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *view = mv_view(buf, dt_span_b(dt, count));
+    PyObject *res = PyObject_CallMethod(g_shim, "file_irw", "(isLOii)",
+                                        fh, op, (long long)offset, view,
+                                        count, dt);
+    if (res != NULL) {
+        *request = (MPI_Request)PyLong_AsLong(res);
+        rc = MPI_SUCCESS;
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    Py_XDECREF(view);
+    PyGILState_Release(st);
+    return file_errcheck(fh, rc);
+}
+
+/* ------------------------------------------------------------------ */
+/* open / close / management                                           */
+/* ------------------------------------------------------------------ */
+
+int MPI_File_open(MPI_Comm comm, const char *filename, int amode,
+                  MPI_Info info, MPI_File *fh) {
+    int rc = ensure_python();
+    if (rc != MPI_SUCCESS)
+        return rc;
+    int ok;
+    long h = shim_call_v("file_open", &ok, "(isii)", comm, filename,
+                         amode, info);
+    if (!ok) {
+        *fh = MPI_FILE_NULL;
+        /* open failures keep their real class (NO_SUCH_FILE, AMODE...)
+         * and route through the MPI_FILE_NULL handler */
+        return file_errcheck(MPI_FILE_NULL, mv2t_last_errclass);
+    }
+    *fh = (MPI_File)h;
+    file_rec_add(*fh);
+    return MPI_SUCCESS;
+}
+
+int MPI_File_close(MPI_File *fh) {
+    int rc = shim_call_i("file_close", "(i)", *fh);
+    file_rec_del(*fh);
+    *fh = MPI_FILE_NULL;
+    return rc;
+}
+
+int MPI_File_delete(const char *filename, MPI_Info info) {
+    (void)info;
+    int rc = ensure_python();
+    if (rc != MPI_SUCCESS)
+        return rc;
+    return file_errcheck(MPI_FILE_NULL,
+                         shim_call_i("file_delete", "(s)", filename));
+}
+
+int MPI_File_set_size(MPI_File fh, MPI_Offset size) {
+    return file_errcheck(fh, shim_call_i("file_set_size", "(iL)", fh,
+                                         (long long)size));
+}
+
+int MPI_File_preallocate(MPI_File fh, MPI_Offset size) {
+    return file_errcheck(fh, shim_call_i("file_preallocate", "(iL)", fh,
+                                         (long long)size));
+}
+
+int MPI_File_get_size(MPI_File fh, MPI_Offset *size) {
+    int ok;
+    long v = shim_call_v("file_get_size", &ok, "(i)", fh);
+    if (!ok)
+        return file_errcheck(fh, MPI_ERR_FILE);
+    *size = (MPI_Offset)v;
+    return MPI_SUCCESS;
+}
+
+int MPI_File_get_group(MPI_File fh, MPI_Group *group) {
+    int ok;
+    long v = shim_call_v("file_get_group", &ok, "(i)", fh);
+    if (!ok)
+        return file_errcheck(fh, MPI_ERR_FILE);
+    *group = (MPI_Group)v;
+    return MPI_SUCCESS;
+}
+
+int MPI_File_get_amode(MPI_File fh, int *amode) {
+    int ok;
+    long v = shim_call_v("file_get_amode", &ok, "(i)", fh);
+    if (!ok)
+        return file_errcheck(fh, MPI_ERR_FILE);
+    *amode = (int)v;
+    return MPI_SUCCESS;
+}
+
+int MPI_File_set_info(MPI_File fh, MPI_Info info) {
+    return file_errcheck(fh, shim_call_i("file_set_info", "(ii)", fh,
+                                         info));
+}
+
+int MPI_File_get_info(MPI_File fh, MPI_Info *info_used) {
+    int ok;
+    long v = shim_call_v("file_get_info", &ok, "(i)", fh);
+    if (!ok)
+        return file_errcheck(fh, MPI_ERR_FILE);
+    *info_used = (MPI_Info)v;
+    return MPI_SUCCESS;
+}
+
+/* ------------------------------------------------------------------ */
+/* views                                                               */
+/* ------------------------------------------------------------------ */
+
+int MPI_File_set_view(MPI_File fh, MPI_Offset disp, MPI_Datatype etype,
+                      MPI_Datatype filetype, const char *datarep,
+                      MPI_Info info) {
+    (void)info;
+    return file_errcheck(fh, shim_call_i("file_set_view", "(iLiis)", fh,
+                                         (long long)disp, etype,
+                                         filetype, datarep));
+}
+
+int MPI_File_get_view(MPI_File fh, MPI_Offset *disp, MPI_Datatype *etype,
+                      MPI_Datatype *filetype, char *datarep) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "file_get_view", "(i)",
+                                        fh);
+    int rc = MPI_ERR_FILE;
+    if (res != NULL) {
+        long long d = 0;
+        int et = 0, ft = 0;
+        if (PyArg_ParseTuple(res, "Lii", &d, &et, &ft)) {
+            *disp = (MPI_Offset)d;
+            *etype = (MPI_Datatype)et;
+            *filetype = (MPI_Datatype)ft;
+            if (datarep != NULL)
+                strcpy(datarep, "native");
+            rc = MPI_SUCCESS;
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    PyGILState_Release(st);
+    return file_errcheck(fh, rc);
+}
+
+int MPI_File_get_type_extent(MPI_File fh, MPI_Datatype datatype,
+                             MPI_Aint *extent) {
+    (void)fh;                   /* "native" datarep: memory extent */
+    *extent = (MPI_Aint)dt_extent_b(datatype);
+    return MPI_SUCCESS;
+}
+
+/* ------------------------------------------------------------------ */
+/* read / write                                                        */
+/* ------------------------------------------------------------------ */
+
+int MPI_File_read_at(MPI_File fh, MPI_Offset offset, void *buf, int count,
+                     MPI_Datatype datatype, MPI_Status *status) {
+    return file_rw("read_at", fh, offset, buf, count, datatype, status);
+}
+
+int MPI_File_read_at_all(MPI_File fh, MPI_Offset offset, void *buf,
+                         int count, MPI_Datatype datatype,
+                         MPI_Status *status) {
+    return file_rw("read_at_all", fh, offset, buf, count, datatype,
+                   status);
+}
+
+int MPI_File_write_at(MPI_File fh, MPI_Offset offset, const void *buf,
+                      int count, MPI_Datatype datatype,
+                      MPI_Status *status) {
+    return file_rw("write_at", fh, offset, (void *)buf, count, datatype,
+                   status);
+}
+
+int MPI_File_write_at_all(MPI_File fh, MPI_Offset offset, const void *buf,
+                          int count, MPI_Datatype datatype,
+                          MPI_Status *status) {
+    return file_rw("write_at_all", fh, offset, (void *)buf, count,
+                   datatype, status);
+}
+
+int MPI_File_iread_at(MPI_File fh, MPI_Offset offset, void *buf, int count,
+                      MPI_Datatype datatype, MPI_Request *request) {
+    return file_irw("read_at", fh, offset, buf, count, datatype, request);
+}
+
+int MPI_File_iwrite_at(MPI_File fh, MPI_Offset offset, const void *buf,
+                       int count, MPI_Datatype datatype,
+                       MPI_Request *request) {
+    return file_irw("write_at", fh, offset, (void *)buf, count, datatype,
+                    request);
+}
+
+int MPI_File_iread_at_all(MPI_File fh, MPI_Offset offset, void *buf,
+                          int count, MPI_Datatype datatype,
+                          MPI_Request *request) {
+    return file_irw("read_at_all", fh, offset, buf, count, datatype,
+                    request);
+}
+
+int MPI_File_iwrite_at_all(MPI_File fh, MPI_Offset offset, const void *buf,
+                           int count, MPI_Datatype datatype,
+                           MPI_Request *request) {
+    return file_irw("write_at_all", fh, offset, (void *)buf, count,
+                    datatype, request);
+}
+
+int MPI_File_read(MPI_File fh, void *buf, int count,
+                  MPI_Datatype datatype, MPI_Status *status) {
+    return file_rw("read", fh, 0, buf, count, datatype, status);
+}
+
+int MPI_File_read_all(MPI_File fh, void *buf, int count,
+                      MPI_Datatype datatype, MPI_Status *status) {
+    return file_rw("read_all", fh, 0, buf, count, datatype, status);
+}
+
+int MPI_File_write(MPI_File fh, const void *buf, int count,
+                   MPI_Datatype datatype, MPI_Status *status) {
+    return file_rw("write", fh, 0, (void *)buf, count, datatype, status);
+}
+
+int MPI_File_write_all(MPI_File fh, const void *buf, int count,
+                       MPI_Datatype datatype, MPI_Status *status) {
+    return file_rw("write_all", fh, 0, (void *)buf, count, datatype,
+                   status);
+}
+
+int MPI_File_iread(MPI_File fh, void *buf, int count,
+                   MPI_Datatype datatype, MPI_Request *request) {
+    return file_irw("read", fh, 0, buf, count, datatype, request);
+}
+
+int MPI_File_iread_all(MPI_File fh, void *buf, int count,
+                       MPI_Datatype datatype, MPI_Request *request) {
+    return file_irw("read_all", fh, 0, buf, count, datatype, request);
+}
+
+int MPI_File_iwrite(MPI_File fh, const void *buf, int count,
+                    MPI_Datatype datatype, MPI_Request *request) {
+    return file_irw("write", fh, 0, (void *)buf, count, datatype,
+                    request);
+}
+
+int MPI_File_iwrite_all(MPI_File fh, const void *buf, int count,
+                        MPI_Datatype datatype, MPI_Request *request) {
+    return file_irw("write_all", fh, 0, (void *)buf, count, datatype,
+                    request);
+}
+
+int MPI_File_seek(MPI_File fh, MPI_Offset offset, int whence) {
+    return file_errcheck(fh, shim_call_i("file_seek", "(iLi)", fh,
+                                         (long long)offset, whence));
+}
+
+int MPI_File_get_position(MPI_File fh, MPI_Offset *offset) {
+    int ok;
+    long v = shim_call_v("file_get_position", &ok, "(i)", fh);
+    if (!ok)
+        return file_errcheck(fh, MPI_ERR_FILE);
+    *offset = (MPI_Offset)v;
+    return MPI_SUCCESS;
+}
+
+int MPI_File_get_byte_offset(MPI_File fh, MPI_Offset offset,
+                             MPI_Offset *disp) {
+    int ok;
+    long v = shim_call_v("file_get_byte_offset", &ok, "(iL)", fh,
+                         (long long)offset);
+    if (!ok)
+        return file_errcheck(fh, MPI_ERR_FILE);
+    *disp = (MPI_Offset)v;
+    return MPI_SUCCESS;
+}
+
+/* ------------------------------------------------------------------ */
+/* shared / ordered                                                    */
+/* ------------------------------------------------------------------ */
+
+int MPI_File_read_shared(MPI_File fh, void *buf, int count,
+                         MPI_Datatype datatype, MPI_Status *status) {
+    return file_rw("read_shared", fh, 0, buf, count, datatype, status);
+}
+
+int MPI_File_write_shared(MPI_File fh, const void *buf, int count,
+                          MPI_Datatype datatype, MPI_Status *status) {
+    return file_rw("write_shared", fh, 0, (void *)buf, count, datatype,
+                   status);
+}
+
+int MPI_File_iread_shared(MPI_File fh, void *buf, int count,
+                          MPI_Datatype datatype, MPI_Request *request) {
+    return file_irw("read_shared", fh, 0, buf, count, datatype, request);
+}
+
+int MPI_File_iwrite_shared(MPI_File fh, const void *buf, int count,
+                           MPI_Datatype datatype, MPI_Request *request) {
+    return file_irw("write_shared", fh, 0, (void *)buf, count, datatype,
+                    request);
+}
+
+int MPI_File_read_ordered(MPI_File fh, void *buf, int count,
+                          MPI_Datatype datatype, MPI_Status *status) {
+    return file_rw("read_ordered", fh, 0, buf, count, datatype, status);
+}
+
+int MPI_File_write_ordered(MPI_File fh, const void *buf, int count,
+                           MPI_Datatype datatype, MPI_Status *status) {
+    return file_rw("write_ordered", fh, 0, (void *)buf, count, datatype,
+                   status);
+}
+
+int MPI_File_seek_shared(MPI_File fh, MPI_Offset offset, int whence) {
+    return file_errcheck(fh, shim_call_i("file_seek_shared", "(iLi)", fh,
+                                         (long long)offset, whence));
+}
+
+int MPI_File_get_position_shared(MPI_File fh, MPI_Offset *offset) {
+    int ok;
+    long v = shim_call_v("file_get_position_shared", &ok, "(i)", fh);
+    if (!ok)
+        return file_errcheck(fh, MPI_ERR_FILE);
+    *offset = (MPI_Offset)v;
+    return MPI_SUCCESS;
+}
+
+/* ------------------------------------------------------------------ */
+/* split collectives: begin posts the nonblocking op, end completes it */
+/* ------------------------------------------------------------------ */
+
+static int split_begin(const char *op, MPI_File fh, MPI_Offset offset,
+                       void *buf, int count, MPI_Datatype dt) {
+    file_node *n = file_rec(fh);
+    if (n == NULL || n->split != 0)       /* one pending op per file */
+        return file_errcheck(fh, MPI_ERR_FILE);
+    MPI_Request req = 0;
+    int rc = file_irw(op, fh, offset, buf, count, dt, &req);
+    if (rc == MPI_SUCCESS)
+        n->split = req;
+    return rc;
+}
+
+static int split_end(MPI_File fh, MPI_Status *status) {
+    file_node *n = file_rec(fh);
+    if (n == NULL || n->split == 0)
+        return file_errcheck(fh, MPI_ERR_FILE);
+    MPI_Request req = n->split;
+    n->split = 0;
+    return file_errcheck(fh, MPI_Wait(&req, status));
+}
+
+int MPI_File_read_at_all_begin(MPI_File fh, MPI_Offset offset, void *buf,
+                               int count, MPI_Datatype datatype) {
+    return split_begin("read_at_all", fh, offset, buf, count, datatype);
+}
+
+int MPI_File_read_at_all_end(MPI_File fh, void *buf, MPI_Status *status) {
+    (void)buf;
+    return split_end(fh, status);
+}
+
+int MPI_File_write_at_all_begin(MPI_File fh, MPI_Offset offset,
+                                const void *buf, int count,
+                                MPI_Datatype datatype) {
+    return split_begin("write_at_all", fh, offset, (void *)buf, count,
+                       datatype);
+}
+
+int MPI_File_write_at_all_end(MPI_File fh, const void *buf,
+                              MPI_Status *status) {
+    (void)buf;
+    return split_end(fh, status);
+}
+
+int MPI_File_read_all_begin(MPI_File fh, void *buf, int count,
+                            MPI_Datatype datatype) {
+    return split_begin("read_all", fh, 0, buf, count, datatype);
+}
+
+int MPI_File_read_all_end(MPI_File fh, void *buf, MPI_Status *status) {
+    (void)buf;
+    return split_end(fh, status);
+}
+
+int MPI_File_write_all_begin(MPI_File fh, const void *buf, int count,
+                             MPI_Datatype datatype) {
+    return split_begin("write_all", fh, 0, (void *)buf, count, datatype);
+}
+
+int MPI_File_write_all_end(MPI_File fh, const void *buf,
+                           MPI_Status *status) {
+    (void)buf;
+    return split_end(fh, status);
+}
+
+int MPI_File_read_ordered_begin(MPI_File fh, void *buf, int count,
+                                MPI_Datatype datatype) {
+    return split_begin("read_ordered", fh, 0, buf, count, datatype);
+}
+
+int MPI_File_read_ordered_end(MPI_File fh, void *buf,
+                              MPI_Status *status) {
+    (void)buf;
+    return split_end(fh, status);
+}
+
+int MPI_File_write_ordered_begin(MPI_File fh, const void *buf, int count,
+                                 MPI_Datatype datatype) {
+    return split_begin("write_ordered", fh, 0, (void *)buf, count,
+                       datatype);
+}
+
+int MPI_File_write_ordered_end(MPI_File fh, const void *buf,
+                               MPI_Status *status) {
+    (void)buf;
+    return split_end(fh, status);
+}
+
+/* ------------------------------------------------------------------ */
+/* consistency                                                         */
+/* ------------------------------------------------------------------ */
+
+int MPI_File_set_atomicity(MPI_File fh, int flag) {
+    return file_errcheck(fh, shim_call_i("file_set_atomicity", "(ii)",
+                                         fh, flag));
+}
+
+int MPI_File_get_atomicity(MPI_File fh, int *flag) {
+    int ok;
+    long v = shim_call_v("file_get_atomicity", &ok, "(i)", fh);
+    if (!ok)
+        return file_errcheck(fh, MPI_ERR_FILE);
+    *flag = (int)v;
+    return MPI_SUCCESS;
+}
+
+int MPI_File_sync(MPI_File fh) {
+    return file_errcheck(fh, shim_call_i("file_sync", "(i)", fh));
+}
+
+/* ------------------------------------------------------------------ */
+/* errhandlers (§13.7)                                                 */
+/* ------------------------------------------------------------------ */
+
+int MPI_File_create_errhandler(MPI_File_errhandler_function *fn,
+                               MPI_Errhandler *errhandler) {
+    /* file and comm handler signatures are ABI-compatible (both take
+     * int-handle* + int*, varargs); reuse the one C-side slot table */
+    return MPI_Comm_create_errhandler(
+        (MPI_Comm_errhandler_function *)fn, errhandler);
+}
+
+int MPI_File_set_errhandler(MPI_File fh, MPI_Errhandler errhandler) {
+    if (fh == MPI_FILE_NULL) {
+        g_file_null_eh = errhandler;
+        return MPI_SUCCESS;
+    }
+    file_node *n = file_rec(fh);
+    if (n == NULL)
+        return MPI_ERR_FILE;
+    n->eh = errhandler;
+    return MPI_SUCCESS;
+}
+
+int MPI_File_get_errhandler(MPI_File fh, MPI_Errhandler *errhandler) {
+    if (fh == MPI_FILE_NULL) {
+        *errhandler = g_file_null_eh;
+        return MPI_SUCCESS;
+    }
+    file_node *n = file_rec(fh);
+    if (n == NULL)
+        return MPI_ERR_FILE;
+    *errhandler = n->eh;
+    return MPI_SUCCESS;
+}
+
+int MPI_File_call_errhandler(MPI_File fh, int errorcode) {
+    file_errcheck(fh, errorcode);
+    return MPI_SUCCESS;
+}
+
+MPI_File MPI_File_f2c(int f) {
+    return (MPI_File)f;
+}
+
+int MPI_File_c2f(MPI_File fh) {
+    return (int)fh;
+}

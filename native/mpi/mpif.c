@@ -34,7 +34,7 @@ static void st_c2f(const MPI_Status *st, int *fst) {
     fst[0] = st->MPI_SOURCE;
     fst[1] = st->MPI_TAG;
     fst[2] = st->MPI_ERROR;
-    fst[3] = st->_count;
+    fst[3] = (int)st->_count;   /* f77 status is INTEGER array */
 }
 
 /* ---- init / env ------------------------------------------------------ */

@@ -101,3 +101,49 @@ def test_minloc_pairtype():
     out = MINLOC(a, b)
     assert out["val"].tolist() == [2.0, 1.0]
     assert out["loc"].tolist() == [7, 1]
+
+
+def test_hvector_overlapping_stride_zero():
+    """hvector stride 0 = N replicas of one block, serialized in
+    declaration order (hindexed_io.c's mem_type)."""
+    t = dt.create_hvector(3, 4, 0, dt.BYTE)
+    assert t.size == 12
+    a = np.arange(4, dtype=np.uint8)
+    packed = t.pack(a, 1)
+    np.testing.assert_array_equal(packed, np.tile(a, 3))
+
+
+def test_hindexed_natural_lb():
+    """natural lb = min displacement (MPI-3.1 §4.1.7), extent = ub-lb —
+    tiling count>1 elements must continue at lb + k*extent."""
+    t = dt.create_hindexed([4, 4], [256, 260], dt.BYTE)
+    assert t.lb == 256
+    assert t.extent == 8
+    assert t.size == 8
+
+
+def test_contig_of_contig_single_span():
+    big = dt.create_contiguous((1 << 31) - 1, dt.BYTE)
+    assert len(big.spans) == 1 and big.size == (1 << 31) - 1
+
+
+def test_darray_block():
+    """2x2 grid over a 4x4 array, BLOCK/BLOCK: rank 1 owns cols 2-3 of
+    rows 0-1."""
+    t = dt.create_darray(4, 1, [4, 4],
+                         [dt.DISTRIBUTE_BLOCK, dt.DISTRIBUTE_BLOCK],
+                         [dt.DISTRIBUTE_DFLT_DARG] * 2, [2, 2], dt.INT)
+    a = np.arange(16, dtype=np.int32)
+    packed = t.pack(a, 1).view(np.int32)
+    np.testing.assert_array_equal(packed, [2, 3, 6, 7])
+    assert t.extent == 64
+
+
+def test_darray_cyclic():
+    """1x2 grid, dim1 CYCLIC(1) over 1x4: rank 0 owns cols 0,2."""
+    t = dt.create_darray(2, 0, [4],
+                         [dt.DISTRIBUTE_CYCLIC],
+                         [dt.DISTRIBUTE_DFLT_DARG], [2], dt.INT)
+    a = np.arange(4, dtype=np.int32)
+    packed = t.pack(a, 1).view(np.int32)
+    np.testing.assert_array_equal(packed, [0, 2])
