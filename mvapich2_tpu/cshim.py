@@ -2434,3 +2434,187 @@ def file_get_atomicity(fh: int) -> int:
 def file_sync(fh: int) -> int:
     _file(fh).sync()
     return 0
+
+
+# ---------------------------------------------------------------------------
+# MPI_T tools interface (MPI_T_* — forwards to mpit.py; reference:
+# src/mpi_t/cvar_read.c, pvar_session_create.c et al.)
+# ---------------------------------------------------------------------------
+
+def _mpit_dtype_code(typ_name: str) -> int:
+    """C datatype handle for a cvar's Python type (codes from mpi.h)."""
+    return {"int": 2, "bool": 2, "str": 1, "float": 4}.get(typ_name, 2)
+
+
+def mpit_cvar_num() -> int:
+    from . import mpit
+    return mpit.cvar_get_num()
+
+
+def mpit_cvar_info(i: int):
+    """(name, desc, dtype_code, scope, verbosity) or None for bad index."""
+    from . import mpit
+    if not 0 <= i < mpit.cvar_get_num():
+        return None
+    info = mpit.cvar_get_info(i)
+    return (info["name"], info["desc"] or "",
+            _mpit_dtype_code(info["type"]), int(info["scope"]),
+            int(info["verbosity"]))
+
+
+def mpit_cvar_index(name: str) -> int:
+    from . import mpit
+    try:
+        return mpit.cvar_get_index(name)
+    except KeyError:
+        return -1
+
+
+def mpit_cvar_read_int(i: int) -> int:
+    from . import mpit
+    return int(mpit.cvar_read(i))
+
+
+def mpit_cvar_read_double(i: int) -> float:
+    from . import mpit
+    return float(mpit.cvar_read(i))
+
+
+def mpit_cvar_read_str(i: int) -> str:
+    from . import mpit
+    v = mpit.cvar_read(i)
+    return "" if v is None else str(v)
+
+
+def mpit_cvar_write_int(i: int, v: int) -> int:
+    from . import mpit
+    # bool cvars store the raw int so MPI_T round-trips exactly
+    # (cvarwrite.c writes 123 and expects to read 123 back; truthiness
+    # is what the consuming code paths test anyway)
+    mpit.cvar_write(i, int(v))
+    return 0
+
+
+def mpit_cvar_count(i: int) -> int:
+    """MPI_T handle element count: 1 for numerics; string cvars report
+    their buffer size (choice-restricted ones report 512 so generic
+    write-garbage probes — cvarwrite.c gates on count < 512 — skip
+    values the declarative registry would reject)."""
+    from . import mpit
+    cv = mpit._cvar_list()[i]
+    if cv.typ.__name__ == "str":
+        return 512 if cv.choices is not None else 256
+    return 1
+
+
+def mpit_cvar_write_double(i: int, v: float) -> int:
+    from . import mpit
+    mpit.cvar_write(i, float(v))
+    return 0
+
+
+def mpit_cvar_write_str(i: int, s: str) -> int:
+    from . import mpit
+    mpit.cvar_write(i, s)
+    return 0
+
+
+def mpit_pvar_num() -> int:
+    from . import mpit
+    return mpit.pvar_get_num()
+
+
+def mpit_pvar_info(i: int):
+    """(name, desc, class, continuous, readonly) or None."""
+    from . import mpit
+    if not 0 <= i < mpit.pvar_get_num():
+        return None
+    info = mpit.pvar_get_info(i)
+    cont = 1 if info["continuous"] else 0
+    return (info["name"], info["desc"] or "", int(info["class"]), cont, 1)
+
+
+def mpit_pvar_index(name: str) -> int:
+    from . import mpit
+    try:
+        return mpit.pvar_get_index(name)
+    except ValueError:
+        return -1
+
+
+_mpit_sessions: Dict[int, object] = {}
+_next_mpit_session = 1
+
+
+def mpit_pvar_session_create() -> int:
+    global _next_mpit_session
+    from . import mpit
+    with _lock:
+        h = _next_mpit_session
+        _next_mpit_session += 1
+        _mpit_sessions[h] = mpit.pvar_session_create()
+    return h
+
+
+def mpit_pvar_session_free(sh: int) -> int:
+    with _lock:
+        _mpit_sessions.pop(sh, None)
+    return 0
+
+
+def mpit_pvar_handle_alloc(sh: int, index: int) -> int:
+    return _mpit_sessions[sh].handle_alloc(index)
+
+
+def mpit_pvar_handle_free(sh: int, h: int) -> int:
+    _mpit_sessions[sh].handle_free(h)
+    return 0
+
+
+def mpit_pvar_start(sh: int, h: int) -> int:
+    _mpit_sessions[sh].start(h)
+    return 0
+
+
+def mpit_pvar_reset(sh: int, h: int) -> int:
+    _mpit_sessions[sh].reset(h)
+    return 0
+
+
+def mpit_pvar_read(sh: int, h: int) -> float:
+    return float(_mpit_sessions[sh].read(h))
+
+
+def mpit_cat_num() -> int:
+    from . import mpit
+    return mpit.category_get_num()
+
+
+def mpit_cat_info(i: int):
+    """(name, desc, num_cvars, num_pvars) or None."""
+    from . import mpit
+    if not 0 <= i < mpit.category_get_num():
+        return None
+    info = mpit.category_get_info(i)
+    return (info["name"], f"cvars/pvars in group {info['name']}",
+            info["num_cvars"], info["num_pvars"])
+
+
+def mpit_cat_index(name: str) -> int:
+    from . import mpit
+    try:
+        return mpit.category_names().index(name)
+    except ValueError:
+        return -1
+
+
+def mpit_cat_cvars(i: int):
+    from . import mpit
+    info = mpit.category_get_info(i)
+    return [mpit.cvar_get_index(n) for n in info["cvars"]]
+
+
+def mpit_cat_pvars(i: int):
+    from . import mpit
+    info = mpit.category_get_info(i)
+    return [mpit.pvar_get_index(n) for n in info["pvars"]]

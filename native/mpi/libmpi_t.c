@@ -1,0 +1,544 @@
+/* libmpi_t.c — the MPI_T tools-information C ABI (MPI-3.1 chapter 14).
+ *
+ * Forwards to mvapich2_tpu/mpit.py (cvars over the declarative config
+ * registry, pvar sessions, categories) via the embedded-CPython bridge.
+ * Reference parity target: src/mpi_t/ (cvar_read.c, cvar_write.c,
+ * pvar_session_create.c ...) and the mpi_t area of the MPICH suite
+ * (test/mpi/mpi_t/testlist.in) — the acceptance oracle.
+ *
+ * MPI_T error returns are plain codes (never routed through
+ * errhandlers, §14.3.1), and every entry point checks the init
+ * refcount (§14.2.1).
+ */
+#include <stdio.h>
+#include <string.h>
+
+#include "libmpi_internal.h"
+
+static int g_mpit_inited;       /* init_thread/finalize refcount */
+
+#define MPIT_CHECK_INIT()                         \
+    do {                                          \
+        if (g_mpit_inited <= 0)                   \
+            return MPI_T_ERR_NOT_INITIALIZED;     \
+    } while (0)
+
+/* §14.3.3 string convention: *len in = buffer size, out = full length
+ * including NUL; the copy is NUL-terminated and truncated to fit.
+ * NULL str or *len == 0 means "just tell me the length". */
+static void put_str(const char *s, char *out, int *len) {
+    int full = (int)strlen(s) + 1;
+    if (out != NULL && len != NULL && *len > 0) {
+        int n = *len < full ? *len : full;
+        memcpy(out, s, (size_t)(n - 1));
+        out[n - 1] = '\0';
+    }
+    if (len != NULL)
+        *len = full;
+}
+
+/* map mpit.py pvar class codes (counter/timer/level/hwm) to MPI_T's */
+static int pvar_class_c(int py_class) {
+    switch (py_class) {
+    case 0: return MPI_T_PVAR_CLASS_COUNTER;
+    case 1: return MPI_T_PVAR_CLASS_TIMER;
+    case 2: return MPI_T_PVAR_CLASS_LEVEL;
+    case 3: return MPI_T_PVAR_CLASS_HIGHWATERMARK;
+    default: return MPI_T_PVAR_CLASS_GENERIC;
+    }
+}
+
+/* ------------------------------------------------------------------ */
+/* init / finalize                                                     */
+/* ------------------------------------------------------------------ */
+
+int MPI_T_init_thread(int required, int *provided) {
+    (void)required;
+    int rc = ensure_python();
+    if (rc != MPI_SUCCESS)
+        return MPI_T_ERR_CANNOT_INIT;
+    if (provided != NULL)
+        *provided = MPI_THREAD_MULTIPLE;
+    g_mpit_inited++;
+    return MPI_SUCCESS;
+}
+
+int MPI_T_finalize(void) {
+    MPIT_CHECK_INIT();
+    g_mpit_inited--;
+    return MPI_SUCCESS;
+}
+
+/* ------------------------------------------------------------------ */
+/* cvars                                                               */
+/* ------------------------------------------------------------------ */
+
+int MPI_T_cvar_get_num(int *num_cvar) {
+    MPIT_CHECK_INIT();
+    int ok;
+    long n = shim_call_v("mpit_cvar_num", &ok, "()");
+    if (!ok)
+        return MPI_T_ERR_INVALID;
+    *num_cvar = (int)n;
+    return MPI_SUCCESS;
+}
+
+int MPI_T_cvar_get_info(int cvar_index, char *name, int *name_len,
+                        int *verbosity, MPI_Datatype *datatype,
+                        MPI_T_enum *enumtype, char *desc, int *desc_len,
+                        int *bind, int *scope) {
+    MPIT_CHECK_INIT();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "mpit_cvar_info", "(i)",
+                                        cvar_index);
+    int rc = MPI_T_ERR_INVALID_INDEX;
+    if (res != NULL && res != Py_None) {
+        const char *nm = NULL, *ds = NULL;
+        int dt = 0, sc = 0, verb = 0;
+        if (PyArg_ParseTuple(res, "ssiii", &nm, &ds, &dt, &sc, &verb)) {
+            put_str(nm, name, name_len);
+            put_str(ds, desc, desc_len);
+            if (verbosity != NULL)
+                *verbosity = verb;
+            if (datatype != NULL)
+                *datatype = (MPI_Datatype)dt;
+            if (enumtype != NULL)
+                *enumtype = MPI_T_ENUM_NULL;
+            if (bind != NULL)
+                *bind = MPI_T_BIND_NO_OBJECT;
+            if (scope != NULL)
+                *scope = sc == 1 ? MPI_T_SCOPE_ALL : MPI_T_SCOPE_LOCAL;
+            rc = MPI_SUCCESS;
+        } else {
+            PyErr_Clear();
+        }
+    } else {
+        PyErr_Clear();
+    }
+    Py_XDECREF(res);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_T_cvar_get_index(const char *name, int *cvar_index) {
+    MPIT_CHECK_INIT();
+    int ok;
+    long i = shim_call_v("mpit_cvar_index", &ok, "(s)", name);
+    if (!ok)
+        return MPI_T_ERR_INVALID;
+    if (i < 0)
+        return MPI_T_ERR_INVALID_NAME;
+    *cvar_index = (int)i;
+    return MPI_SUCCESS;
+}
+
+/* cvar handles: the handle IS the cvar index (no per-object binding
+ * state to carry — all our cvars bind MPI_T_BIND_NO_OBJECT) */
+
+int MPI_T_cvar_handle_alloc(int cvar_index, void *obj_handle,
+                            MPI_T_cvar_handle *handle, int *count) {
+    (void)obj_handle;
+    MPIT_CHECK_INIT();
+    int ok;
+    long n = shim_call_v("mpit_cvar_num", &ok, "()");
+    if (!ok || cvar_index < 0 || cvar_index >= n)
+        return MPI_T_ERR_INVALID_INDEX;
+    long c = shim_call_v("mpit_cvar_count", &ok, "(i)", cvar_index);
+    if (!ok)
+        return MPI_T_ERR_INVALID;
+    *handle = (MPI_T_cvar_handle)cvar_index;
+    if (count != NULL)
+        *count = (int)c;
+    return MPI_SUCCESS;
+}
+
+int MPI_T_cvar_handle_free(MPI_T_cvar_handle *handle) {
+    MPIT_CHECK_INIT();
+    *handle = MPI_T_CVAR_HANDLE_NULL;
+    return MPI_SUCCESS;
+}
+
+static int cvar_dtype(int idx, MPI_Datatype *dt) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "mpit_cvar_info", "(i)",
+                                        idx);
+    int rc = MPI_T_ERR_INVALID_HANDLE;
+    if (res != NULL && res != Py_None) {
+        const char *nm, *ds;
+        int d = 0, sc, verb;
+        if (PyArg_ParseTuple(res, "ssiii", &nm, &ds, &d, &sc, &verb)) {
+            *dt = (MPI_Datatype)d;
+            rc = MPI_SUCCESS;
+        } else {
+            PyErr_Clear();
+        }
+    } else {
+        PyErr_Clear();
+    }
+    Py_XDECREF(res);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_T_cvar_read(MPI_T_cvar_handle handle, void *buf) {
+    MPIT_CHECK_INIT();
+    MPI_Datatype dt;
+    int rc = cvar_dtype((int)handle, &dt);
+    if (rc != MPI_SUCCESS)
+        return rc;
+    int ok;
+    if (dt == MPI_CHAR) {
+        PyGILState_STATE st = PyGILState_Ensure();
+        PyObject *res = PyObject_CallMethod(
+            g_shim, "mpit_cvar_read_str", "(i)", (int)handle);
+        rc = MPI_T_ERR_INVALID_HANDLE;
+        if (res != NULL) {
+            const char *s = PyUnicode_AsUTF8(res);
+            if (s != NULL) {
+                strcpy((char *)buf, s);
+                rc = MPI_SUCCESS;
+            }
+            Py_DECREF(res);
+        } else {
+            PyErr_Clear();
+        }
+        PyGILState_Release(st);
+        return rc;
+    }
+    if (dt == MPI_DOUBLE) {
+        PyGILState_STATE st = PyGILState_Ensure();
+        PyObject *res = PyObject_CallMethod(
+            g_shim, "mpit_cvar_read_double", "(i)", (int)handle);
+        rc = MPI_T_ERR_INVALID_HANDLE;
+        if (res != NULL) {
+            *(double *)buf = PyFloat_AsDouble(res);
+            rc = MPI_SUCCESS;
+            Py_DECREF(res);
+        } else {
+            PyErr_Clear();
+        }
+        PyGILState_Release(st);
+        return rc;
+    }
+    long v = shim_call_v("mpit_cvar_read_int", &ok, "(i)", (int)handle);
+    if (!ok)
+        return MPI_T_ERR_INVALID_HANDLE;
+    *(int *)buf = (int)v;
+    return MPI_SUCCESS;
+}
+
+int MPI_T_cvar_write(MPI_T_cvar_handle handle, const void *buf) {
+    MPIT_CHECK_INIT();
+    MPI_Datatype dt;
+    int rc = cvar_dtype((int)handle, &dt);
+    if (rc != MPI_SUCCESS)
+        return rc;
+    if (dt == MPI_CHAR)
+        rc = shim_call_i("mpit_cvar_write_str", "(is)", (int)handle,
+                         (const char *)buf);
+    else if (dt == MPI_DOUBLE)
+        rc = shim_call_i("mpit_cvar_write_double", "(id)", (int)handle,
+                         *(const double *)buf);
+    else
+        rc = shim_call_i("mpit_cvar_write_int", "(ii)", (int)handle,
+                         *(const int *)buf);
+    return rc == MPI_SUCCESS ? MPI_SUCCESS : MPI_T_ERR_CVAR_SET_NOT_NOW;
+}
+
+/* ------------------------------------------------------------------ */
+/* pvars                                                               */
+/* ------------------------------------------------------------------ */
+
+int MPI_T_pvar_get_num(int *num_pvar) {
+    MPIT_CHECK_INIT();
+    int ok;
+    long n = shim_call_v("mpit_pvar_num", &ok, "()");
+    if (!ok)
+        return MPI_T_ERR_INVALID;
+    *num_pvar = (int)n;
+    return MPI_SUCCESS;
+}
+
+int MPI_T_pvar_get_info(int pvar_index, char *name, int *name_len,
+                        int *verbosity, int *var_class,
+                        MPI_Datatype *datatype, MPI_T_enum *enumtype,
+                        char *desc, int *desc_len, int *bind,
+                        int *readonly, int *continuous, int *atomic) {
+    MPIT_CHECK_INIT();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "mpit_pvar_info", "(i)",
+                                        pvar_index);
+    int rc = MPI_T_ERR_INVALID_INDEX;
+    if (res != NULL && res != Py_None) {
+        const char *nm = NULL, *ds = NULL;
+        int klass = 0, cont = 0, ro = 0;
+        if (PyArg_ParseTuple(res, "ssiii", &nm, &ds, &klass, &cont,
+                             &ro)) {
+            put_str(nm, name, name_len);
+            put_str(ds, desc, desc_len);
+            if (verbosity != NULL)
+                *verbosity = MPI_T_VERBOSITY_USER_BASIC;
+            if (var_class != NULL)
+                *var_class = pvar_class_c(klass);
+            if (datatype != NULL)
+                *datatype = MPI_DOUBLE;   /* all pvars read as double */
+            if (enumtype != NULL)
+                *enumtype = MPI_T_ENUM_NULL;
+            if (bind != NULL)
+                *bind = MPI_T_BIND_NO_OBJECT;
+            if (readonly != NULL)
+                *readonly = ro;
+            if (continuous != NULL)
+                *continuous = cont;
+            if (atomic != NULL)
+                *atomic = 0;
+            rc = MPI_SUCCESS;
+        } else {
+            PyErr_Clear();
+        }
+    } else {
+        PyErr_Clear();
+    }
+    Py_XDECREF(res);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_T_pvar_get_index(const char *name, int var_class,
+                         int *pvar_index) {
+    (void)var_class;      /* names are unique across classes here */
+    MPIT_CHECK_INIT();
+    int ok;
+    long i = shim_call_v("mpit_pvar_index", &ok, "(s)", name);
+    if (!ok)
+        return MPI_T_ERR_INVALID;
+    if (i < 0)
+        return MPI_T_ERR_INVALID_NAME;
+    *pvar_index = (int)i;
+    return MPI_SUCCESS;
+}
+
+int MPI_T_pvar_session_create(MPI_T_pvar_session *session) {
+    MPIT_CHECK_INIT();
+    int ok;
+    long h = shim_call_v("mpit_pvar_session_create", &ok, "()");
+    if (!ok)
+        return MPI_T_ERR_OUT_OF_SESSIONS;
+    *session = (MPI_T_pvar_session)h;
+    return MPI_SUCCESS;
+}
+
+int MPI_T_pvar_session_free(MPI_T_pvar_session *session) {
+    MPIT_CHECK_INIT();
+    shim_call_i("mpit_pvar_session_free", "(i)", (int)*session);
+    *session = MPI_T_PVAR_SESSION_NULL;
+    return MPI_SUCCESS;
+}
+
+int MPI_T_pvar_handle_alloc(MPI_T_pvar_session session, int pvar_index,
+                            void *obj_handle, MPI_T_pvar_handle *handle,
+                            int *count) {
+    (void)obj_handle;
+    MPIT_CHECK_INIT();
+    int ok;
+    long n = shim_call_v("mpit_pvar_num", &ok, "()");
+    if (!ok || pvar_index < 0 || pvar_index >= n)
+        return MPI_T_ERR_INVALID_INDEX;
+    long h = shim_call_v("mpit_pvar_handle_alloc", &ok, "(ii)",
+                         (int)session, pvar_index);
+    if (!ok)
+        return MPI_T_ERR_INVALID_SESSION;
+    *handle = (MPI_T_pvar_handle)h;
+    if (count != NULL)
+        *count = 1;
+    return MPI_SUCCESS;
+}
+
+int MPI_T_pvar_handle_free(MPI_T_pvar_session session,
+                           MPI_T_pvar_handle *handle) {
+    MPIT_CHECK_INIT();
+    shim_call_i("mpit_pvar_handle_free", "(ii)", (int)session,
+                (int)*handle);
+    *handle = MPI_T_PVAR_HANDLE_NULL;
+    return MPI_SUCCESS;
+}
+
+int MPI_T_pvar_start(MPI_T_pvar_session session,
+                     MPI_T_pvar_handle handle) {
+    MPIT_CHECK_INIT();
+    return shim_call_i("mpit_pvar_start", "(ii)", (int)session,
+                       (int)handle) == 0 ? MPI_SUCCESS
+                                         : MPI_T_ERR_INVALID_HANDLE;
+}
+
+int MPI_T_pvar_stop(MPI_T_pvar_session session,
+                    MPI_T_pvar_handle handle) {
+    (void)session;
+    (void)handle;      /* stop just freezes nothing: reads are deltas */
+    MPIT_CHECK_INIT();
+    return MPI_SUCCESS;
+}
+
+int MPI_T_pvar_read(MPI_T_pvar_session session, MPI_T_pvar_handle handle,
+                    void *buf) {
+    MPIT_CHECK_INIT();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "mpit_pvar_read", "(ii)",
+                                        (int)session, (int)handle);
+    int rc = MPI_T_ERR_INVALID_HANDLE;
+    if (res != NULL) {
+        *(double *)buf = PyFloat_AsDouble(res);
+        if (!PyErr_Occurred())
+            rc = MPI_SUCCESS;
+        else
+            PyErr_Clear();
+        Py_DECREF(res);
+    } else {
+        PyErr_Clear();
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_T_pvar_reset(MPI_T_pvar_session session,
+                     MPI_T_pvar_handle handle) {
+    MPIT_CHECK_INIT();
+    return shim_call_i("mpit_pvar_reset", "(ii)", (int)session,
+                       (int)handle) == 0 ? MPI_SUCCESS
+                                         : MPI_T_ERR_INVALID_HANDLE;
+}
+
+int MPI_T_pvar_write(MPI_T_pvar_session session, MPI_T_pvar_handle handle,
+                     const void *buf) {
+    (void)session;
+    (void)handle;
+    (void)buf;
+    MPIT_CHECK_INIT();
+    return MPI_T_ERR_PVAR_NO_WRITE;
+}
+
+/* ------------------------------------------------------------------ */
+/* categories                                                          */
+/* ------------------------------------------------------------------ */
+
+int MPI_T_category_get_num(int *num_cat) {
+    MPIT_CHECK_INIT();
+    int ok;
+    long n = shim_call_v("mpit_cat_num", &ok, "()");
+    if (!ok)
+        return MPI_T_ERR_INVALID;
+    *num_cat = (int)n;
+    return MPI_SUCCESS;
+}
+
+int MPI_T_category_get_info(int cat_index, char *name, int *name_len,
+                            char *desc, int *desc_len, int *num_cvars,
+                            int *num_pvars, int *num_categories) {
+    MPIT_CHECK_INIT();
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "mpit_cat_info", "(i)",
+                                        cat_index);
+    int rc = MPI_T_ERR_INVALID_INDEX;
+    if (res != NULL && res != Py_None) {
+        const char *nm = NULL, *ds = NULL;
+        int nc = 0, np = 0;
+        if (PyArg_ParseTuple(res, "ssii", &nm, &ds, &nc, &np)) {
+            put_str(nm, name, name_len);
+            put_str(ds, desc, desc_len);
+            if (num_cvars != NULL)
+                *num_cvars = nc;
+            if (num_pvars != NULL)
+                *num_pvars = np;
+            if (num_categories != NULL)
+                *num_categories = 0;    /* flat category space */
+            rc = MPI_SUCCESS;
+        } else {
+            PyErr_Clear();
+        }
+    } else {
+        PyErr_Clear();
+    }
+    Py_XDECREF(res);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_T_category_get_index(const char *name, int *cat_index) {
+    MPIT_CHECK_INIT();
+    int ok;
+    long i = shim_call_v("mpit_cat_index", &ok, "(s)", name);
+    if (!ok)
+        return MPI_T_ERR_INVALID;
+    if (i < 0)
+        return MPI_T_ERR_INVALID_NAME;
+    *cat_index = (int)i;
+    return MPI_SUCCESS;
+}
+
+static int cat_members(const char *shim_fn, int cat_index, int len,
+                       int indices[]) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, shim_fn, "(i)",
+                                        cat_index);
+    int rc = MPI_T_ERR_INVALID_INDEX;
+    if (res != NULL && PyList_Check(res)) {
+        Py_ssize_t n = PyList_Size(res);
+        for (Py_ssize_t k = 0; k < n && k < len; k++)
+            indices[k] = (int)PyLong_AsLong(PyList_GET_ITEM(res, k));
+        rc = MPI_SUCCESS;
+    } else {
+        PyErr_Clear();
+    }
+    Py_XDECREF(res);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_T_category_get_cvars(int cat_index, int len, int indices[]) {
+    MPIT_CHECK_INIT();
+    return cat_members("mpit_cat_cvars", cat_index, len, indices);
+}
+
+int MPI_T_category_get_pvars(int cat_index, int len, int indices[]) {
+    MPIT_CHECK_INIT();
+    return cat_members("mpit_cat_pvars", cat_index, len, indices);
+}
+
+int MPI_T_category_get_categories(int cat_index, int len, int indices[]) {
+    (void)cat_index;
+    (void)len;
+    (void)indices;
+    MPIT_CHECK_INIT();
+    return MPI_SUCCESS;     /* flat category space: never any members */
+}
+
+int MPI_T_category_changed(int *stamp) {
+    MPIT_CHECK_INIT();
+    *stamp = 1;             /* the registry is static after init */
+    return MPI_SUCCESS;
+}
+
+/* ------------------------------------------------------------------ */
+/* enums (no cvar/pvar exposes one: everything reports ENUM_NULL)      */
+/* ------------------------------------------------------------------ */
+
+int MPI_T_enum_get_info(MPI_T_enum enumtype, int *num, char *name,
+                        int *name_len) {
+    (void)enumtype;
+    (void)num;
+    (void)name;
+    (void)name_len;
+    MPIT_CHECK_INIT();
+    return MPI_T_ERR_INVALID_HANDLE;
+}
+
+int MPI_T_enum_get_item(MPI_T_enum enumtype, int index, int *value,
+                        char *name, int *name_len) {
+    (void)enumtype;
+    (void)index;
+    (void)value;
+    (void)name;
+    (void)name_len;
+    MPIT_CHECK_INIT();
+    return MPI_T_ERR_INVALID_HANDLE;
+}

@@ -936,6 +936,126 @@ int MPI_File_call_errhandler(MPI_File fh, int errorcode);
 MPI_File MPI_File_f2c(int f);
 int MPI_File_c2f(MPI_File fh);
 
+/* ---- MPI_T tools-information interface (MPI-3.1 ch. 14) ---- */
+typedef int MPI_T_enum;
+typedef int MPI_T_cvar_handle;
+typedef int MPI_T_pvar_handle;
+typedef int MPI_T_pvar_session;
+#define MPI_T_ENUM_NULL         ((MPI_T_enum)-1)
+#define MPI_T_CVAR_HANDLE_NULL  ((MPI_T_cvar_handle)-1)
+#define MPI_T_PVAR_HANDLE_NULL  ((MPI_T_pvar_handle)-1)
+#define MPI_T_PVAR_SESSION_NULL ((MPI_T_pvar_session)-1)
+#define MPI_T_PVAR_ALL_HANDLES  ((MPI_T_pvar_handle)-2)
+
+#define MPI_T_VERBOSITY_USER_BASIC   221
+#define MPI_T_VERBOSITY_USER_DETAIL  222
+#define MPI_T_VERBOSITY_USER_ALL     223
+#define MPI_T_VERBOSITY_TUNER_BASIC  224
+#define MPI_T_VERBOSITY_TUNER_DETAIL 225
+#define MPI_T_VERBOSITY_TUNER_ALL    226
+#define MPI_T_VERBOSITY_MPIDEV_BASIC 227
+#define MPI_T_VERBOSITY_MPIDEV_DETAIL 228
+#define MPI_T_VERBOSITY_MPIDEV_ALL   229
+
+#define MPI_T_BIND_NO_OBJECT    0
+#define MPI_T_BIND_MPI_COMM     1
+#define MPI_T_BIND_MPI_DATATYPE 2
+#define MPI_T_BIND_MPI_ERRHANDLER 3
+#define MPI_T_BIND_MPI_FILE     4
+#define MPI_T_BIND_MPI_GROUP    5
+#define MPI_T_BIND_MPI_OP       6
+#define MPI_T_BIND_MPI_REQUEST  7
+#define MPI_T_BIND_MPI_WIN      8
+#define MPI_T_BIND_MPI_MESSAGE  9
+#define MPI_T_BIND_MPI_INFO     10
+
+#define MPI_T_SCOPE_CONSTANT 0
+#define MPI_T_SCOPE_READONLY 1
+#define MPI_T_SCOPE_LOCAL    2
+#define MPI_T_SCOPE_GROUP    3
+#define MPI_T_SCOPE_GROUP_EQ 4
+#define MPI_T_SCOPE_ALL      5
+#define MPI_T_SCOPE_ALL_EQ   6
+
+#define MPI_T_PVAR_CLASS_STATE         240
+#define MPI_T_PVAR_CLASS_LEVEL         241
+#define MPI_T_PVAR_CLASS_SIZE          242
+#define MPI_T_PVAR_CLASS_PERCENTAGE    243
+#define MPI_T_PVAR_CLASS_HIGHWATERMARK 244
+#define MPI_T_PVAR_CLASS_LOWWATERMARK  245
+#define MPI_T_PVAR_CLASS_COUNTER       246
+#define MPI_T_PVAR_CLASS_AGGREGATE     247
+#define MPI_T_PVAR_CLASS_TIMER         248
+#define MPI_T_PVAR_CLASS_GENERIC       249
+
+/* MPI_T error codes (returned directly, never via errhandlers) */
+#define MPI_T_ERR_MEMORY            54
+#define MPI_T_ERR_NOT_INITIALIZED   55
+#define MPI_T_ERR_CANNOT_INIT       56
+#define MPI_T_ERR_INVALID_INDEX     57
+#define MPI_T_ERR_INVALID_ITEM      58
+#define MPI_T_ERR_INVALID_HANDLE    59
+#define MPI_T_ERR_OUT_OF_HANDLES    60
+#define MPI_T_ERR_OUT_OF_SESSIONS   61
+#define MPI_T_ERR_INVALID_SESSION   62
+#define MPI_T_ERR_CVAR_SET_NOT_NOW  63
+#define MPI_T_ERR_CVAR_SET_NEVER    64
+#define MPI_T_ERR_PVAR_NO_STARTSTOP 65
+#define MPI_T_ERR_PVAR_NO_WRITE     66
+#define MPI_T_ERR_PVAR_NO_ATOMIC    67
+#define MPI_T_ERR_INVALID_NAME      68
+#define MPI_T_ERR_INVALID           69
+
+int MPI_T_init_thread(int required, int *provided);
+int MPI_T_finalize(void);
+int MPI_T_cvar_get_num(int *num_cvar);
+int MPI_T_cvar_get_info(int cvar_index, char *name, int *name_len,
+                        int *verbosity, MPI_Datatype *datatype,
+                        MPI_T_enum *enumtype, char *desc, int *desc_len,
+                        int *bind, int *scope);
+int MPI_T_cvar_get_index(const char *name, int *cvar_index);
+int MPI_T_cvar_handle_alloc(int cvar_index, void *obj_handle,
+                            MPI_T_cvar_handle *handle, int *count);
+int MPI_T_cvar_handle_free(MPI_T_cvar_handle *handle);
+int MPI_T_cvar_read(MPI_T_cvar_handle handle, void *buf);
+int MPI_T_cvar_write(MPI_T_cvar_handle handle, const void *buf);
+int MPI_T_pvar_get_num(int *num_pvar);
+int MPI_T_pvar_get_info(int pvar_index, char *name, int *name_len,
+                        int *verbosity, int *var_class,
+                        MPI_Datatype *datatype, MPI_T_enum *enumtype,
+                        char *desc, int *desc_len, int *bind,
+                        int *readonly, int *continuous, int *atomic);
+int MPI_T_pvar_get_index(const char *name, int var_class,
+                         int *pvar_index);
+int MPI_T_pvar_session_create(MPI_T_pvar_session *session);
+int MPI_T_pvar_session_free(MPI_T_pvar_session *session);
+int MPI_T_pvar_handle_alloc(MPI_T_pvar_session session, int pvar_index,
+                            void *obj_handle, MPI_T_pvar_handle *handle,
+                            int *count);
+int MPI_T_pvar_handle_free(MPI_T_pvar_session session,
+                           MPI_T_pvar_handle *handle);
+int MPI_T_pvar_start(MPI_T_pvar_session session, MPI_T_pvar_handle handle);
+int MPI_T_pvar_stop(MPI_T_pvar_session session, MPI_T_pvar_handle handle);
+int MPI_T_pvar_read(MPI_T_pvar_session session, MPI_T_pvar_handle handle,
+                    void *buf);
+int MPI_T_pvar_reset(MPI_T_pvar_session session,
+                     MPI_T_pvar_handle handle);
+int MPI_T_pvar_write(MPI_T_pvar_session session, MPI_T_pvar_handle handle,
+                     const void *buf);
+int MPI_T_category_get_num(int *num_cat);
+int MPI_T_category_get_info(int cat_index, char *name, int *name_len,
+                            char *desc, int *desc_len, int *num_cvars,
+                            int *num_pvars, int *num_categories);
+int MPI_T_category_get_index(const char *name, int *cat_index);
+int MPI_T_category_get_cvars(int cat_index, int len, int indices[]);
+int MPI_T_category_get_pvars(int cat_index, int len, int indices[]);
+int MPI_T_category_get_categories(int cat_index, int len, int indices[]);
+int MPI_T_category_changed(int *stamp);
+int MPI_T_enum_get_info(MPI_T_enum enumtype, int *num, char *name,
+                        int *name_len);
+int MPI_T_enum_get_item(MPI_T_enum enumtype, int index, int *value,
+                        char *name, int *name_len);
+
 /* ---- ULFM fault tolerance (MPI forum ticket 323 / mvapich2 ft) ---- */
 int MPIX_Comm_revoke(MPI_Comm comm);
 int MPIX_Comm_is_revoked(MPI_Comm comm, int *flag);
