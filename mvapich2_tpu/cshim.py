@@ -378,11 +378,18 @@ def _drop_worker(ch: int) -> None:
 
 
 def comm_free(ch: int) -> int:
+    global _parent_handle
     _drop_worker(ch)
     with _lock:
         c = _comms.pop(ch, None)
     if c is not None:
         c.free()
+    if ch == _parent_handle:
+        # freed/disconnected parent: get_parent now yields MPI_COMM_NULL
+        _parent_handle = None
+        u = uni.current_universe()
+        if u is not None:
+            u.parent_intercomm = None
     return 0
 
 
@@ -2618,3 +2625,117 @@ def mpit_cat_pvars(i: int):
     from . import mpit
     info = mpit.category_get_info(i)
     return [mpit.pvar_get_index(n) for n in info["pvars"]]
+
+
+# ---------------------------------------------------------------------------
+# dynamic processes (MPI-3.1 §10): spawn, ports, name service
+# C surface: MPI_Comm_spawn / MPI_Open_port / MPI_Comm_connect etc.
+# (reference: src/mpi/spawn/ — spawn.c, open_port.c, comm_connect.c)
+# ---------------------------------------------------------------------------
+
+def _fill_errcodes(view, errcodes) -> None:
+    """Write spawn errcodes into the caller's int32 buffer, clamped to
+    its capacity — non-root ranks legally size it from root-only args
+    they don't know (MPI-3.1 §10.3.2), so never trust the length."""
+    if view is None:
+        return
+    arr = np.frombuffer(view, dtype=np.int32)
+    n = min(arr.size, len(errcodes))
+    arr[:n] = errcodes[:n]
+
+
+def comm_spawn(ch: int, command: str, argv_us: str, maxprocs: int,
+               root: int, errcodes_view=None) -> int:
+    """argv_us: argv strings joined with '\\x1f' ('' = no args).
+    Returns the intercomm handle; fills errcodes (int32) if given."""
+    args = argv_us.split("\x1f") if argv_us else []
+    ic, errcodes = mpi.Comm_spawn(command, args, maxprocs, root,
+                                  comm=_comm(ch))
+    _fill_errcodes(errcodes_view, errcodes)
+    return _new_comm_handle(ic)
+
+
+def comm_spawn_multiple(ch: int, cmds_us: str, root: int,
+                        errcodes_view=None) -> int:
+    """cmds_us: records joined with '\\x1e'; each record is
+    command '\\x1f' maxprocs '\\x1f' arg0 '\\x1f' arg1 ..."""
+    cmds = []
+    for rec in cmds_us.split("\x1e"):
+        parts = rec.split("\x1f")
+        if parts[0]:
+            cmds.append((parts[0], parts[2:], int(parts[1] or "0")))
+    ic, errcodes = mpi.Comm_spawn_multiple(cmds, root, comm=_comm(ch))
+    _fill_errcodes(errcodes_view, errcodes)
+    return _new_comm_handle(ic)
+
+
+_parent_handle = None
+
+
+def comm_get_parent() -> int:
+    """The spawn parent intercomm — same handle every call (the
+    reference's MPIR_Process.comm_parent singleton), -1 when none."""
+    global _parent_handle
+    if _parent_handle is None:
+        p = mpi.Comm_get_parent()
+        if p is None:
+            return -1
+        _parent_handle = _new_comm_handle(p)
+        # expose the predefined name "MPI_COMM_PARENT" (MPI-3.1 §6.8)
+        _named_comms.add(_parent_handle)
+    return _parent_handle
+
+
+def open_port() -> str:
+    return mpi.Open_port()
+
+
+def close_port(port_name: str) -> int:
+    mpi.Close_port(port_name)
+    return 0
+
+
+def comm_accept(port_name: str, ch: int, root: int) -> int:
+    return _new_comm_handle(mpi.Comm_accept(port_name, _comm(ch), root))
+
+
+def comm_connect(port_name: str, ch: int, root: int) -> int:
+    return _new_comm_handle(mpi.Comm_connect(port_name, _comm(ch), root))
+
+
+def comm_disconnect(ch: int) -> int:
+    """MPI_Comm_disconnect: collective free that waits for pending
+    communication (our free() already fences the channel). After
+    disconnecting (or freeing) the parent intercomm,
+    MPI_Comm_get_parent returns MPI_COMM_NULL (MPI-3.1 §10.3.2) —
+    handled in comm_free, which this shares."""
+    return comm_free(ch)
+
+
+def publish_name(service_name: str, port_name: str) -> int:
+    mpi.Publish_name(service_name, port_name)
+    return 0
+
+
+def unpublish_name(service_name: str, port_name: str) -> int:
+    mpi.Unpublish_name(service_name, port_name)
+    return 0
+
+
+def lookup_name(service_name: str):
+    return mpi.Lookup_name(service_name)
+
+
+def universe_size() -> int:
+    """MPI_UNIVERSE_SIZE: spawn capacity. MV2T_UNIVERSE_SIZE overrides;
+    default world+8 (process-mode spawn forks children freely, so the
+    universe is genuinely larger than the initial world)."""
+    env = os.environ.get("MV2T_UNIVERSE_SIZE")
+    if env:
+        return int(env)
+    return _comm(0).size + 8
+
+
+def get_appnum() -> int:
+    a = mpi.Get_appnum()
+    return -1 if a is None else int(a)

@@ -38,8 +38,11 @@ def bootstrap_from_env() -> Universe:
     if os.environ.get("MV2T_WORLD_BASE") is not None and kvs_addr:
         return _bootstrap_spawned(rank, size, kvs_addr)
 
-    if size == 1 or kvs_addr is None:
-        # singleton init (mpiexec-less a.out, like MPICH singleton PMI)
+    if kvs_addr is None:
+        # singleton init (mpiexec-less a.out, like MPICH singleton PMI).
+        # An np=1 job launched by mpirun still takes the KVS path below:
+        # it has a live KVS, so MPI_Comm_spawn / ports work from it
+        # (spawn1.c runs np=1 and spawns children).
         from ..transport.local import LocalChannel, LocalFabric
         u = Universe(0, 1)
         fabric = LocalFabric(1)
@@ -135,8 +138,9 @@ def _bootstrap_spawned(local: int, size: int, kvs_addr: str) -> Universe:
     u._next_ctx = max(u._next_ctx, ctx + 2)
 
     private = u.comm_world.dup()
+    # predefined name (MPI-3.1 §6.8: MPI_Comm_get_parent's communicator)
     u.parent_intercomm = Intercomm(u, private.group, Group(parent_ranks),
-                                   ctx, private, name="spawn_child")
+                                   ctx, private, name="MPI_COMM_PARENT")
     # signal the spawn root: every child's business card is published
     if local == 0:
         kvs.put(f"__spawn_ready_{base}",

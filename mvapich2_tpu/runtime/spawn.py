@@ -66,10 +66,15 @@ def comm_spawn_multiple(comm: Comm, cmds: Sequence[Tuple], root: int = 0,
     share one child MPI_COMM_WORLD; MPI_APPNUM (universe.appnum, exposed as
     mpi.Get_appnum) tells them which command they run."""
     u = comm.u
+    # cmds/maxprocs are significant only at root (MPI-3.1 §10.3.2):
+    # non-root callers may pass empty/garbage values, so only the root
+    # validates (total is root-only in process mode; thread-mode
+    # harness callers pass identical cmds everywhere)
     total = sum(m for _, _, m in cmds)
-    mpi_assert(total > 0, MPI_ERR_SPAWN, "spawn of zero processes")
+    if comm.rank == root:
+        mpi_assert(total > 0, MPI_ERR_SPAWN, "spawn of zero processes")
     ctx = u.allocate_context_id(comm)
-    if callable(cmds[0][0]):
+    if cmds and callable(cmds[0][0]):
         return _spawn_threads(comm, cmds, root, ctx, total)
     return _spawn_procs(comm, cmds, root, ctx, total)
 

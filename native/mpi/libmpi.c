@@ -374,7 +374,7 @@ int MPI_Get_address(const void *location, MPI_Aint *address) {
 int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
              int tag, MPI_Comm comm) {
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *view = mv_view(buf, (long)count * dt_extent_b(dt));
+    PyObject *view = mv_view(buf, dt_span_b(dt, count));
     PyObject *res = PyObject_CallMethod(g_shim, "send", "(Oiiiii)", view,
                                         count, dt, dest, tag, comm);
     int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
@@ -389,7 +389,7 @@ int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
 int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
              MPI_Comm comm, MPI_Status *status) {
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *view = mv_view(buf, (long)count * dt_extent_b(dt));
+    PyObject *view = mv_view(buf, dt_span_b(dt, count));
     PyObject *res = PyObject_CallMethod(g_shim, "recv", "(Oiiiii)", view,
                                         count, dt, source, tag, comm);
     int rc = MPI_ERR_OTHER;
@@ -419,7 +419,7 @@ static MPI_Request isend_irecv(const char *fn, void *buf, int count,
                                MPI_Datatype dt, int peer, int tag,
                                MPI_Comm comm) {
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *view = mv_view(buf, (long)count * dt_extent_b(dt));
+    PyObject *view = mv_view(buf, dt_span_b(dt, count));
     PyObject *res = PyObject_CallMethod(g_shim, fn, "(Oiiiii)", view,
                                         count, dt, peer, tag, comm);
     MPI_Request h = MPI_REQUEST_NULL;
@@ -585,7 +585,7 @@ static int coll2(const char *fn, const void *sb, void *rb, long snb,
 int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root,
               MPI_Comm comm) {
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *view = mv_view(buf, (long)count * dt_extent_b(dt));
+    PyObject *view = mv_view(buf, dt_span_b(dt, count));
     PyObject *res = PyObject_CallMethod(g_shim, "bcast", "(Oiiii)", view,
                                         count, dt, root, comm);
     int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
@@ -601,7 +601,7 @@ int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
     if (mv2t_is_userop(op))
         return mv2t_userop_coll(0, sendbuf, recvbuf, count, dt, op, 0,
                                 comm);
-    long nb = (long)count * dt_extent_b(dt);
+    long nb = dt_span_b(dt, count);
     return mv2t_errcheck(comm, coll2("allreduce", sendbuf, recvbuf, nb, nb, "(iiii)",
                  count, dt, op, comm));
 }
@@ -611,7 +611,7 @@ int MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
     if (mv2t_is_userop(op))
         return mv2t_userop_coll(1, sendbuf, recvbuf, count, dt, op, root,
                                 comm);
-    long nb = (long)count * dt_extent_b(dt);
+    long nb = dt_span_b(dt, count);
     return mv2t_errcheck(comm, coll2("reduce", sendbuf, recvbuf, nb, nb, "(iiiii)",
                  count, dt, op, root, comm));
 }
@@ -622,8 +622,8 @@ int MPI_Allgather(const void *sendbuf, int scount, MPI_Datatype sdt,
     int size;
     MPI_Comm_size(comm, &size);
     return mv2t_errcheck(comm, coll2("allgather", sendbuf, recvbuf,
-                 (long)scount * dt_extent_b(sdt),
-                 (long)rcount * dt_extent_b(rdt) * size,
+                 dt_span_b(sdt, scount),
+                 dt_span_b(rdt, (long)rcount * size),
                  "(iiiii)", scount, sdt, rcount, rdt, comm));
 }
 
@@ -633,8 +633,8 @@ int MPI_Alltoall(const void *sendbuf, int scount, MPI_Datatype sdt,
     int size;
     MPI_Comm_size(comm, &size);
     return mv2t_errcheck(comm, coll2("alltoall", sendbuf, recvbuf,
-                 (long)scount * dt_extent_b(sdt) * size,
-                 (long)rcount * dt_extent_b(rdt) * size,
+                 dt_span_b(sdt, (long)scount * size),
+                 dt_span_b(rdt, (long)rcount * size),
                  "(iiiii)", scount, sdt, rcount, rdt, comm));
 }
 
@@ -644,8 +644,8 @@ int MPI_Gather(const void *sendbuf, int scount, MPI_Datatype sdt,
     int size;
     MPI_Comm_size(comm, &size);
     return mv2t_errcheck(comm, coll2("gather", sendbuf, recvbuf,
-                 (long)scount * dt_extent_b(sdt),
-                 (long)rcount * dt_extent_b(rdt) * size,
+                 dt_span_b(sdt, scount),
+                 dt_span_b(rdt, (long)rcount * size),
                  "(iiiiii)", scount, sdt, rcount, rdt, root, comm));
 }
 
@@ -655,8 +655,8 @@ int MPI_Scatter(const void *sendbuf, int scount, MPI_Datatype sdt,
     int size;
     MPI_Comm_size(comm, &size);
     return mv2t_errcheck(comm, coll2("scatter", sendbuf, recvbuf,
-                 (long)scount * dt_extent_b(sdt) * size,
-                 (long)rcount * dt_extent_b(rdt),
+                 dt_span_b(sdt, (long)scount * size),
+                 dt_span_b(rdt, rcount),
                  "(iiiiii)", scount, sdt, rcount, rdt, root, comm));
 }
 
@@ -669,8 +669,8 @@ int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
     int size;
     MPI_Comm_size(comm, &size);
     return mv2t_errcheck(comm, coll2("reduce_scatter_block", sendbuf, recvbuf,
-                 (long)rcount * dt_extent_b(dt) * size,
-                 (long)rcount * dt_extent_b(dt),
+                 dt_span_b(dt, (long)rcount * size),
+                 dt_span_b(dt, rcount),
                  "(iiii)", rcount, dt, op, comm));
 }
 
@@ -868,7 +868,7 @@ long dt_span_b(MPI_Datatype dt, long count) {
 static int sendlike(const char *fn, const void *buf, int count,
                     MPI_Datatype dt, int dest, int tag, MPI_Comm comm) {
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *view = mv_view(buf, (long)count * dt_extent_b(dt));
+    PyObject *view = mv_view(buf, dt_span_b(dt, count));
     PyObject *res = PyObject_CallMethod(g_shim, fn, "(Oiiiii)", view,
                                         count, dt, dest, tag, comm);
     int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
@@ -933,7 +933,7 @@ int MPI_Sendrecv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
 int MPI_Sendrecv_replace(void *buf, int count, MPI_Datatype dt, int dest,
                          int sendtag, int source, int recvtag,
                          MPI_Comm comm, MPI_Status *status) {
-    long nb = (long)count * dt_extent_b(dt);
+    long nb = dt_span_b(dt, count);
     void *tmp = malloc(nb > 0 ? nb : 1);
     if (!tmp) return MPI_ERR_OTHER;
     memcpy(tmp, buf, nb);
@@ -1182,7 +1182,7 @@ int MPI_Allgatherv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
                    const int displs[], MPI_Datatype rdt, MPI_Comm comm) {
     int n = comm_np(comm);
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *sv = mv_view(sendbuf, (long)sendcount * dt_extent_b(sdt));
+    PyObject *sv = mv_view(sendbuf, dt_span_b(sdt, sendcount));
     PyObject *rv = mv_view(recvbuf, vspan_b(recvcounts, displs, rdt, n));
     PyObject *rc_l = int_list(recvcounts, n);
     PyObject *dp_l = int_list(displs, n);
@@ -1225,7 +1225,7 @@ int MPI_Gatherv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
     int me = -1;
     MPI_Comm_rank(comm, &me);
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *sv = mv_view(sendbuf, (long)sendcount * dt_extent_b(sdt));
+    PyObject *sv = mv_view(sendbuf, dt_span_b(sdt, sendcount));
     PyObject *rv = (me == root)
         ? mv_view(recvbuf, vspan_b(recvcounts, displs, rdt, n))
         : mv_view(NULL, 0);
@@ -1253,7 +1253,7 @@ int MPI_Scatterv(const void *sendbuf, const int sendcounts[],
     PyObject *sv = (me == root)
         ? mv_view(sendbuf, vspan_b(sendcounts, displs, sdt, n))
         : mv_view(NULL, 0);
-    PyObject *rv = mv_view(recvbuf, (long)recvcount * dt_extent_b(rdt));
+    PyObject *rv = mv_view(recvbuf, dt_span_b(rdt, recvcount));
     PyObject *sc = int_list(me == root ? sendcounts : NULL, n);
     PyObject *dp = int_list(me == root ? displs : NULL, n);
     PyObject *res = PyObject_CallMethod(g_shim, "scatterv", "(OOOOiiiii)",
@@ -1276,8 +1276,8 @@ int MPI_Reduce_scatter(const void *sendbuf, void *recvbuf,
     long total = 0;
     for (int i = 0; i < n; i++) total += recvcounts[i];
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *sv = mv_view(sendbuf, total * dt_extent_b(dt));
-    PyObject *rv = mv_view(recvbuf, (long)recvcounts[me] * dt_extent_b(dt));
+    PyObject *sv = mv_view(sendbuf, dt_span_b(dt, total));
+    PyObject *rv = mv_view(recvbuf, dt_span_b(dt, recvcounts[me]));
     PyObject *rc_l = int_list(recvcounts, n);
     PyObject *res = PyObject_CallMethod(g_shim, "reduce_scatter",
                                         "(OOOiii)", sv, rv, rc_l, dt, op,
@@ -1292,8 +1292,8 @@ int MPI_Reduce_scatter(const void *sendbuf, void *recvbuf,
 static int scanlike(const char *fn, const void *sendbuf, void *recvbuf,
                     int count, MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *sv = mv_view(sendbuf, (long)count * dt_extent_b(dt));
-    PyObject *rv = mv_view(recvbuf, (long)count * dt_extent_b(dt));
+    PyObject *sv = mv_view(sendbuf, dt_span_b(dt, count));
+    PyObject *rv = mv_view(recvbuf, dt_span_b(dt, count));
     PyObject *res = PyObject_CallMethod(g_shim, fn, "(OOiiii)", sv, rv,
                                         count, dt, op, comm);
     int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
@@ -1643,7 +1643,7 @@ int MPI_Get_accumulate(const void *origin, int ocount, MPI_Datatype odt,
     PyObject *ov = ocount > 0
         ? mv_view(origin, (long)ocount * dt_size(odt))
         : mv_view(NULL, 0);
-    PyObject *rv = mv_view(result, (long)rcount * dt_extent_b(rdt));
+    PyObject *rv = mv_view(result, dt_span_b(rdt, rcount));
     PyObject *res = PyObject_CallMethod(g_shim, "get_accumulate",
                                         "(iOOiiiLi)", win, ov, rv, rcount,
                                         rdt, target_rank,
@@ -1705,7 +1705,7 @@ int MPI_Win_sync(MPI_Win win) {
 static int rma_op(const char *fn, MPI_Win win, const void *origin,
                   int count, MPI_Datatype dt, int target, MPI_Aint disp) {
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *view = mv_view(origin, (long)count * dt_extent_b(dt));
+    PyObject *view = mv_view(origin, dt_span_b(dt, count));
     PyObject *res = PyObject_CallMethod(g_shim, fn, "(iOiiiL)", win, view,
                                         count, dt, target,
                                         (long long)disp);

@@ -188,6 +188,14 @@ int MPI_T_cvar_read(MPI_T_cvar_handle handle, void *buf) {
         return rc;
     int ok;
     if (dt == MPI_CHAR) {
+        /* the caller sized buf from handle_alloc's advertised count
+         * (mpit_cvar_count); the value may come from an arbitrary-
+         * length env var, so the copy must be bounded by the same
+         * count, never the value length */
+        long cap = shim_call_v("mpit_cvar_count", &ok, "(i)",
+                               (int)handle);
+        if (!ok || cap <= 0)
+            cap = 1;
         PyGILState_STATE st = PyGILState_Ensure();
         PyObject *res = PyObject_CallMethod(
             g_shim, "mpit_cvar_read_str", "(i)", (int)handle);
@@ -195,7 +203,7 @@ int MPI_T_cvar_read(MPI_T_cvar_handle handle, void *buf) {
         if (res != NULL) {
             const char *s = PyUnicode_AsUTF8(res);
             if (s != NULL) {
-                strcpy((char *)buf, s);
+                snprintf((char *)buf, (size_t)cap, "%s", s);
                 rc = MPI_SUCCESS;
             }
             Py_DECREF(res);

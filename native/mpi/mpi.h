@@ -180,6 +180,10 @@ typedef struct MPI_Status {
 #define MPI_ERR_AMODE        38
 #define MPI_ERR_UNSUPPORTED_DATAREP 43
 #define MPI_ERR_UNSUPPORTED_OPERATION 44
+#define MPI_ERR_PORT     27
+#define MPI_ERR_NAME     33
+#define MPI_ERR_SERVICE  41
+#define MPI_ERR_SPAWN    42
 #define MPI_ERR_WIN      45
 #define MPI_ERR_RMA_SYNC 50
 /* ULFM fault-tolerance classes (mirrors core/errors.py) */
@@ -431,6 +435,35 @@ int MPI_Win_sync(MPI_Win win);
 #define MPI_MAX_LIBRARY_VERSION_STRING 256
 #define MPI_MAX_PORT_NAME              256
 
+/* dynamic processes (MPI-3.1 §10) */
+#define MPI_ARGV_NULL        ((char **)0)
+#define MPI_ARGVS_NULL       ((char ***)0)
+#define MPI_ERRCODES_IGNORE  ((int *)0)
+int MPI_Comm_spawn(const char *command, char *argv[], int maxprocs,
+                   MPI_Info info, int root, MPI_Comm comm,
+                   MPI_Comm *intercomm, int array_of_errcodes[]);
+int MPI_Comm_spawn_multiple(int count, char *array_of_commands[],
+                            char **array_of_argv[],
+                            const int array_of_maxprocs[],
+                            const MPI_Info array_of_info[], int root,
+                            MPI_Comm comm, MPI_Comm *intercomm,
+                            int array_of_errcodes[]);
+int MPI_Comm_get_parent(MPI_Comm *parent);
+int MPI_Open_port(MPI_Info info, char *port_name);
+int MPI_Close_port(const char *port_name);
+int MPI_Comm_accept(const char *port_name, MPI_Info info, int root,
+                    MPI_Comm comm, MPI_Comm *newcomm);
+int MPI_Comm_connect(const char *port_name, MPI_Info info, int root,
+                     MPI_Comm comm, MPI_Comm *newcomm);
+int MPI_Comm_disconnect(MPI_Comm *comm);
+int MPI_Comm_join(int fd, MPI_Comm *intercomm);
+int MPI_Publish_name(const char *service_name, MPI_Info info,
+                     const char *port_name);
+int MPI_Unpublish_name(const char *service_name, MPI_Info info,
+                       const char *port_name);
+int MPI_Lookup_name(const char *service_name, MPI_Info info,
+                    char *port_name);
+
 /* predefined attribute keyvals (comm) */
 #define MPI_TAG_UB          1
 #define MPI_HOST            2
@@ -607,10 +640,16 @@ int MPI_Type_create_hindexed_block(int count, int blocklength,
 int MPI_Type_set_name(MPI_Datatype type, const char *name);
 int MPI_Type_get_name(MPI_Datatype type, char *name, int *resultlen);
 int MPI_Type_size_x(MPI_Datatype datatype, MPI_Count *size);
+int MPI_Type_get_extent_x(MPI_Datatype datatype, MPI_Count *lb,
+                          MPI_Count *extent);
+int MPI_Type_get_true_extent_x(MPI_Datatype datatype, MPI_Count *true_lb,
+                               MPI_Count *true_extent);
 int MPI_Get_elements_x(const MPI_Status *status, MPI_Datatype datatype,
                        MPI_Count *count);
 int MPI_Get_elements(const MPI_Status *status, MPI_Datatype datatype,
                      int *count);
+int MPI_Status_set_elements_x(MPI_Status *status, MPI_Datatype datatype,
+                              MPI_Count count);
 /* deprecated MPI-1 datatype interface */
 int MPI_Type_struct(int count, int blocklengths[], MPI_Aint displs[],
                     MPI_Datatype types[], MPI_Datatype *newtype);

@@ -1,0 +1,47 @@
+! fusempi.f90 -- `use mpi` (f90 module) ring + allreduce check.
+! Exercises the generated module's explicit interfaces (mpi_comm_rank,
+! mpi_probe with IMPORTed MPI_STATUS_SIZE) and an external
+! choice-buffer routine (mpi_allreduce).
+program fusempi
+  use mpi
+  implicit none
+  integer :: ierr, rank, nproc, val, total, expect
+  integer :: status(MPI_STATUS_SIZE)
+  integer :: left, right, token
+
+  call mpi_init(ierr)
+  call mpi_comm_rank(MPI_COMM_WORLD, rank, ierr)
+  call mpi_comm_size(MPI_COMM_WORLD, nproc, ierr)
+
+  val = rank + 1
+  total = -1
+  call mpi_allreduce(val, total, 1, MPI_INTEGER, MPI_SUM, &
+                     MPI_COMM_WORLD, ierr)
+  expect = nproc * (nproc + 1) / 2
+  if (total /= expect) then
+     print *, 'allreduce mismatch', total, expect
+     call mpi_abort(MPI_COMM_WORLD, 1, ierr)
+  end if
+
+  left = mod(rank + nproc - 1, nproc)
+  right = mod(rank + 1, nproc)
+  token = rank
+  if (rank == 0) then
+     call mpi_send(token, 1, MPI_INTEGER, right, 7, MPI_COMM_WORLD, ierr)
+     call mpi_recv(token, 1, MPI_INTEGER, left, 7, MPI_COMM_WORLD, &
+                   status, ierr)
+  else
+     call mpi_recv(token, 1, MPI_INTEGER, left, 7, MPI_COMM_WORLD, &
+                   status, ierr)
+     call mpi_send(token, 1, MPI_INTEGER, right, 7, MPI_COMM_WORLD, ierr)
+  end if
+
+  if (rank == 0) then
+     if (token /= nproc - 1) then
+        print *, 'ring token mismatch', token
+        call mpi_abort(MPI_COMM_WORLD, 1, ierr)
+     end if
+     print *, ' No Errors'
+  end if
+  call mpi_finalize(ierr)
+end program fusempi

@@ -1,0 +1,64 @@
+/* spawn_cabi_test — MPI_Comm_spawn / get_parent / disconnect through
+ * the C ABI (native/mpi/libmpi_ext.c dynamic-process surface over
+ * runtime/spawn.py). Parent spawns 2 copies of itself (argv[0]), sends
+ * each child its rank, children echo via the parent intercomm. */
+#include <mpi.h>
+#include <stdio.h>
+#include <string.h>
+
+int main(int argc, char *argv[])
+{
+    int errs = 0, rank, i;
+    MPI_Comm parent, inter;
+    int errcodes[2];
+
+    MPI_Init(&argc, &argv);
+    MPI_Comm_get_parent(&parent);
+
+    if (parent == MPI_COMM_NULL) {
+        int rsize, echoed;
+        MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+        if (MPI_Comm_spawn(argv[0], MPI_ARGV_NULL, 2, MPI_INFO_NULL, 0,
+                           MPI_COMM_WORLD, &inter, errcodes)
+            != MPI_SUCCESS) {
+            printf("spawn failed\n");
+            MPI_Abort(MPI_COMM_WORLD, 1);
+        }
+        for (i = 0; i < 2; i++)
+            if (errcodes[i] != MPI_SUCCESS)
+                errs++;
+        MPI_Comm_remote_size(inter, &rsize);
+        if (rsize != 2) {
+            printf("remote size %d != 2\n", rsize);
+            errs++;
+        }
+        for (i = 0; i < 2; i++) {
+            MPI_Send(&i, 1, MPI_INT, i, 7, inter);
+            MPI_Recv(&echoed, 1, MPI_INT, i, 8, inter,
+                     MPI_STATUS_IGNORE);
+            if (echoed != i * 10) {
+                printf("child %d echoed %d\n", i, echoed);
+                errs++;
+            }
+        }
+        MPI_Comm_disconnect(&inter);
+        if (errs == 0)
+            printf(" No Errors\n");
+        else
+            printf(" Found %d errors\n", errs);
+    } else {
+        int got, reply;
+        char cname[MPI_MAX_OBJECT_NAME];
+        int rlen = 0;
+        MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+        MPI_Comm_get_name(parent, cname, &rlen);
+        if (strcmp(cname, "MPI_COMM_PARENT") != 0)
+            fprintf(stderr, "child: bad parent name %s\n", cname);
+        MPI_Recv(&got, 1, MPI_INT, 0, 7, parent, MPI_STATUS_IGNORE);
+        reply = got * 10;
+        MPI_Send(&reply, 1, MPI_INT, 0, 8, parent);
+        MPI_Comm_disconnect(&parent);
+    }
+    MPI_Finalize();
+    return 0;
+}

@@ -150,6 +150,49 @@ def test_f77_abi_from_c():
     assert "No Errors" in r.stdout
 
 
+def test_cabi_spawn():
+    """MPI_Comm_spawn / MPI_Comm_get_parent / MPI_Comm_disconnect via
+    the C ABI: the program re-spawns itself (reference:
+    test/mpi/spawn/spawn1.c pattern)."""
+    out = os.path.join(tempfile.mkdtemp(), "spawn_cabi_test")
+    _compile([os.path.join(REPO, "tests", "progs",
+                           "spawn_cabi_test.c")], out)
+    r = _mpirun(1, out)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+
+
+def test_use_mpi_module_generated_current():
+    """The committed mpi.f90 matches its generator's output — the
+    module is generated from one declarative table, never hand-edited
+    (reference: src/binding/fortran/use_mpi/buildiface)."""
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "native", "mpi",
+                                     "genmpimod.py")],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    committed = open(os.path.join(REPO, "native", "mpi",
+                                  "mpi.f90")).read()
+    assert r.stdout == committed, \
+        "native/mpi/mpi.f90 is stale: rerun genmpimod.py > mpi.f90"
+
+
+@pytest.mark.skipif(shutil.which("gfortran") is None,
+                    reason="no Fortran compiler")
+def test_f90_use_mpi_program():
+    """A `use mpi` f90 program compiles against the generated module
+    and runs (reference: src/binding/fortran/use_mpi/)."""
+    out = os.path.join(tempfile.mkdtemp(), "fusempi")
+    r = subprocess.run([os.path.join(REPO, "bin", "mpifort"),
+                        os.path.join(REPO, "tests", "progs", "f77",
+                                     "fusempi.f90"), "-o", out],
+                       capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, f"mpifort failed:\n{r.stdout}\n{r.stderr}"
+    r = _mpirun(3, out)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+
+
 @pytest.mark.skipif(shutil.which("gfortran") is None,
                     reason="no Fortran compiler")
 def test_f77_program():
