@@ -119,7 +119,7 @@ def run_mesh_coll(kind: str, quick: bool):
         }[kind]
         out_spec = P(None) if kind == "allgather" else P("x")
         f = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("x"),),
-                              out_specs=out_spec))
+                              out_specs=out_spec, check_vma=False))
         t = _time_op(f, x)
         pts.append({"size": size, "lat_us": round(t * 1e6, 2)})
         size *= 4
@@ -162,13 +162,18 @@ def run_tpu_hbm_sweep(quick: bool):
         m = mib << 20
         M = m // 512           # (M, R, 128) f32 interleaved slots
         bufs = jnp.ones((M, R, 128), jnp.float32)
+        # the two-point slope needs (k2-k1)*t_op well above tunnel
+        # noise: small sizes use a much longer chain
+        k1, k2 = (4, 16) if mib >= 64 else (8, 96)
         best = None
         for name, op, traffic, chains in ph.bench_candidates(M, R):
             fn_k = wrap_repeat(op, chains)
             try:
-                t = slope(fn_k, bufs, k1=2, k2=6, iters=6, skip=2,
+                t = slope(fn_k, bufs, k1=k1, k2=k2, iters=6, skip=2,
                           nrep=3)
             except Exception:
+                continue
+            if t <= 1e-8:      # slope lost in noise: not a real number
                 continue
             if best is None or t < best[1]:
                 best = (name, t)
@@ -184,10 +189,6 @@ def run_tpu_hbm_sweep(quick: bool):
 
 MESH_ENV = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
             "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
-
-CONFIGS = ["cpu_allreduce", "mesh_bcast", "mesh_allgather",
-           "mesh_alltoall", "mesh_reduce_scatter", "stencil",
-           "twolevel_allreduce", "tpu_hbm_sweep"]
 
 
 def run_config(name: str, quick: bool):

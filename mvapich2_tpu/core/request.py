@@ -63,11 +63,15 @@ class Request:
         return self.complete_flag
 
     def wait(self) -> Status:
-        if self.engine is not None:
-            self.engine.progress_wait(lambda: self.complete_flag)
-        elif not self.complete_flag:
-            raise MPIException(MPI_ERR_REQUEST,
-                               "wait on engine-less incomplete request")
+        # already-complete fast path (every eager send): complete_flag
+        # only ever transitions False->True, so an unlocked read that
+        # sees True is safe and skips the progress mutex+poll
+        if not self.complete_flag:
+            if self.engine is not None:
+                self.engine.progress_wait(lambda: self.complete_flag)
+            else:
+                raise MPIException(MPI_ERR_REQUEST,
+                                   "wait on engine-less incomplete request")
         if self.error is not None:
             raise self.error
         return self.status

@@ -23,7 +23,7 @@ from ..core import datatype as dtmod
 from ..core.datatype import Datatype, as_bytes_view
 from ..core.errors import (MPIException, MPIX_ERR_PROC_FAILED,
                            MPI_ERR_TRUNCATE, MPI_ERR_INTERN,
-                           MPI_ERR_RANK, mpi_assert)
+                           MPI_ERR_RANK, MPI_ERR_ARG, mpi_assert)
 from ..core.request import Request, CompletedRequest
 from ..core.status import Status, ANY_SOURCE, ANY_TAG, PROC_NULL
 from ..transport.base import Packet, PktType
@@ -154,10 +154,21 @@ class Pt2ptProtocol:
             return breq
 
         if nbytes <= threshold and mode != "sync":
-            packed = datatype.pack(buf, count)
+            if datatype.is_contiguous:
+                # zero-copy injection: every channel's send_packet
+                # copies the payload before returning (encode_packet
+                # blob / LocalChannel's explicit copy), so handing a
+                # view preserves eager buffer-reuse semantics while
+                # skipping pack()'s extra copy
+                mv = as_bytes_view(buf)
+                mpi_assert(len(mv) >= nbytes, MPI_ERR_ARG,
+                           f"buffer too small: {len(mv)} < {nbytes}")
+                packed = mv[:nbytes]
+            else:
+                packed = np.asarray(datatype.pack(buf, count))
             sreq = SendRequest(self.engine, dest_world)
             pkt = Packet(PktType.EAGER_SEND, self.u.world_rank, ctx, comm_src,
-                         tag, nbytes, np.asarray(packed),
+                         tag, nbytes, packed,
                          sreq_id=sreq.req_id)
             self._send_pkt(channel, dest_world, pkt)
             _pv_eager.inc()

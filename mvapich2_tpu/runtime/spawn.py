@@ -115,6 +115,12 @@ def _spawn_procs(comm: Comm, cmds, root: int, ctx: int,
         for appnum, (command, args, m) in enumerate(cmds):
             argv = ([command] if isinstance(command, str)
                     else list(command)) + list(args)
+            # bare program names resolve against the cwd before PATH
+            # (spawn/spaconacc.c spawns "spaconacc"): exec() alone
+            # would only search PATH
+            if (argv and os.sep not in argv[0]
+                    and os.path.exists(argv[0])):
+                argv[0] = os.path.abspath(argv[0])
             for _ in range(m):
                 env = dict(os.environ)
                 env["MV2T_RANK"] = str(i)
@@ -250,8 +256,24 @@ def _parse_port(port_name: str) -> Tuple[str, int, int]:
     return parts[0], int(parts[1]), int(parts[2])
 
 
+def _ensure_proc(u, pid: int) -> None:
+    """Extend the proc table for a world rank this process has never
+    heard of (a sibling spawn's child: spaconacc's connector must dial
+    the acceptor it shares no ancestry with). The node key every rank
+    publishes at bootstrap (node-<pid>) supplies the identity; the
+    default tcp channel dials the business card lazily."""
+    if pid < len(u.node_ids):
+        return
+    kvs = getattr(u, "kvs", None)
+    mpi_assert(kvs is not None, MPI_ERR_PORT,
+               f"unknown process {pid} and no KVS to resolve it")
+    name = kvs.get(f"node-{pid}")
+    u.extend_procs(pid, [name])
+
+
 def _port_send(u, dest_world: int, tag: int, arr: np.ndarray) -> None:
     from ..core.datatype import INT64_T
+    _ensure_proc(u, dest_world)
     u.protocol.isend(arr, arr.size, INT64_T, dest_world, u.world_rank,
                      PORT_CTX, tag).wait()
 
@@ -291,6 +313,8 @@ def comm_accept(port_name: str, comm: Comm, root: int = 0,
         return {"ctx": ctx, "remote": remote_ranks}
 
     hdr = bridge_agree(private, root, exchange)
+    for r in hdr["remote"]:
+        _ensure_proc(u, r)
     return Intercomm(u, private.group, Group(hdr["remote"]),
                      int(hdr["ctx"]), private, name="accepted")
 
@@ -310,5 +334,7 @@ def comm_connect(port_name: str, comm: Comm, root: int = 0,
                 "remote": [int(x) for x in reply[1:]]}
 
     hdr = bridge_agree(private, root, exchange)
+    for r in hdr["remote"]:
+        _ensure_proc(u, r)
     return Intercomm(u, private.group, Group(hdr["remote"]),
                      int(hdr["ctx"]), private, name="connected")

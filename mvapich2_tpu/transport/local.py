@@ -64,9 +64,12 @@ class LocalChannel(Channel):
         self.my_rank = my_rank
 
     def send_packet(self, dest_world: int, pkt: Packet) -> None:
-        if pkt.data is not None and dest_world != self.my_rank:
+        if pkt.data is not None:
             # Eager payloads are copied at injection so the sender's buffer
             # is immediately reusable (MPI eager semantics; the vbuf copy).
+            # Self-sends included: the protocol may hand a live VIEW of
+            # the user buffer (zero-copy eager), which the user can
+            # overwrite the moment the send completes locally.
             pkt.data = np.array(pkt.data, dtype=np.uint8, copy=True)
         self.fabric.deliver(dest_world, pkt)
 

@@ -17,7 +17,6 @@ from __future__ import annotations
 import ctypes
 import mmap
 import os
-import pickle
 import select
 import socket
 import struct
@@ -32,7 +31,7 @@ import numpy as np
 
 from ..utils.config import cvar, get_config
 from ..utils.mlog import get_logger
-from .base import Channel, Packet
+from .base import Channel, Packet, decode_packet, encode_packet
 
 log = get_logger("shm")
 
@@ -305,8 +304,7 @@ class ShmChannel(Channel):
             pass    # full/raced doorbell is fine; receiver polls anyway
 
     def send_packet(self, dest_world: int, pkt: Packet) -> None:
-        payload = pkt.data.tobytes() if pkt.data is not None else b""
-        blob = pickle.dumps((pkt.header_tuple(), payload), protocol=5)
+        blob = encode_packet(pkt)
         src_i = self.local_index[self.my_rank]
         dst_i = self.local_index[dest_world]
         with self._send_lock:
@@ -358,7 +356,8 @@ class ShmChannel(Channel):
         path = self.path + f".big-{self.my_rank}-{uuid.uuid4().hex[:8]}"
         with open(path, "wb") as f:
             f.write(blob)
-        return pickle.dumps(("__bigmsg__", path, len(blob)), protocol=5)
+        # 0xFF discriminator: not a valid PktType first byte
+        return b"\xff" + path.encode()
 
     def _flush(self, dst_i: int) -> None:
         bl = self._backlog.get(dst_i) or []
@@ -388,16 +387,12 @@ class ShmChannel(Channel):
                 blob = self._ring.recv(src_i, my_i)
                 if blob is None:
                     break
-                obj = pickle.loads(blob)
-                if obj[0] == "__bigmsg__":
-                    _, path, ln = obj
+                if blob[0] == 0xFF:    # oversize spill note
+                    path = blob[1:].decode()
                     with open(path, "rb") as f:
-                        obj = pickle.loads(f.read())
+                        blob = f.read()
                     os.unlink(path)
-                hdr, payload = obj
-                data = np.frombuffer(payload, dtype=np.uint8) \
-                    if payload else None
-                self.engine.enqueue_incoming(Packet.from_header(hdr, data))
+                self.engine.enqueue_incoming(decode_packet(blob))
                 did = True
         return did
 

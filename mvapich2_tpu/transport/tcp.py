@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import collections
 import errno
-import pickle
 import selectors
 import socket
 import struct
@@ -26,7 +25,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..utils.mlog import get_logger
-from .base import Channel, Packet
+from .base import Channel, Packet, decode_packet, encode_packet
 
 log = get_logger("tcp")
 
@@ -36,12 +35,11 @@ _LEN = struct.Struct("<I")
 class _Conn:
     """One inbound or outbound stream with reassembly state."""
 
-    __slots__ = ("sock", "rbuf", "need", "stage", "outq", "osent")
+    __slots__ = ("sock", "rbuf", "stage", "outq", "osent")
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
         self.rbuf = bytearray()
-        self.need = None        # (header, payload_len) while reading payload
         self.stage = 0          # 0: reading len, 1: reading header+payload
         self.outq: collections.deque = collections.deque()
         self.osent = 0
@@ -87,17 +85,11 @@ class TcpChannel(Channel):
         return conn
 
     def send_packet(self, dest_world: int, pkt: Packet) -> None:
-        data = pkt.data
-        payload = b""
-        if data is not None:
-            payload = np.ascontiguousarray(data).tobytes()
-        hdr = pickle.dumps((pkt.header_tuple(), len(payload)), protocol=5)
+        blob = encode_packet(pkt)
         with self._slock:
             conn = self._out.get(dest_world) or self._connect(dest_world)
-            conn.outq.append(_LEN.pack(len(hdr)))
-            conn.outq.append(hdr)
-            if payload:
-                conn.outq.append(payload)
+            conn.outq.append(_LEN.pack(len(blob)))
+            conn.outq.append(blob)
             self._flush(conn)
 
     def _flush(self, conn: _Conn) -> bool:
@@ -144,23 +136,13 @@ class TcpChannel(Channel):
 
     def _try_extract(self, conn: _Conn) -> bool:
         buf = conn.rbuf
-        if conn.need is None:
-            if len(buf) < 4:
-                return False
-            hlen = _LEN.unpack_from(buf, 0)[0]
-            if len(buf) < 4 + hlen:
-                return False
-            hdr, plen = pickle.loads(bytes(buf[4:4 + hlen]))
-            del buf[:4 + hlen]
-            conn.need = (hdr, plen)
-        hdr, plen = conn.need
-        if len(buf) < plen:
+        if len(buf) < 4:
             return False
-        payload = np.frombuffer(bytes(buf[:plen]), dtype=np.uint8) \
-            if plen else None
-        del buf[:plen]
-        conn.need = None
-        pkt = Packet.from_header(hdr, payload)
+        blen = _LEN.unpack_from(buf, 0)[0]
+        if len(buf) < 4 + blen:
+            return False
+        pkt = decode_packet(bytes(buf[4:4 + blen]))
+        del buf[:4 + blen]
         self.engine.enqueue_incoming(pkt)
         return True
 

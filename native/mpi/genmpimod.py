@@ -101,6 +101,193 @@ def emit_iface(name, args, ierr):
     return lines
 
 
+def emit_f08():
+    """The mpi_f08 module (reference: src/binding/fortran/use_mpi_f08):
+    strong handle types wrapping the same integer values as the f77
+    ABI, generic interfaces MPI_X -> module procedure MPI_X_f08, and
+    wrappers forwarding to the f77 entry points through
+    bind(C, name="mpi_x_") interfaces (non-colliding internal names;
+    bind(C) without VALUE passes by reference, matching the f77 shim's
+    int* convention). Choice buffers are assumed-type assumed-size
+    (TS 29113)."""
+    handle_types = ["Comm", "Datatype", "Op", "Request", "Group",
+                    "Info", "Errhandler", "Win", "File"]
+
+    # (f08 name, f77 symbol, [(dummy, f08 decl, unwrap expr,
+    #                           f77-interface decl)])
+    BUF_IN = "type(*), dimension(*), intent(in) :: {}"
+    BUF = "type(*), dimension(*) :: {}"
+    INT_IN = "integer, intent(in) :: {}"
+    INT_OUT = "integer, intent(out) :: {}"
+    COMM = "type(MPI_Comm), intent(in) :: {}"
+    DT = "type(MPI_Datatype), intent(in) :: {}"
+    OP = "type(MPI_Op), intent(in) :: {}"
+
+    ROUTINES = [
+        ("MPI_Init", "mpi_init_", []),
+        ("MPI_Finalize", "mpi_finalize_", []),
+        ("MPI_Comm_rank", "mpi_comm_rank_",
+         [("comm", COMM, "comm%MPI_VAL", INT_IN),
+          ("rank", INT_OUT, "rank", INT_OUT)]),
+        ("MPI_Comm_size", "mpi_comm_size_",
+         [("comm", COMM, "comm%MPI_VAL", INT_IN),
+          ("size", INT_OUT, "size", INT_OUT)]),
+        ("MPI_Barrier", "mpi_barrier_",
+         [("comm", COMM, "comm%MPI_VAL", INT_IN)]),
+        ("MPI_Abort", "mpi_abort_",
+         [("comm", COMM, "comm%MPI_VAL", INT_IN),
+          ("errorcode", INT_IN, "errorcode", INT_IN)]),
+        ("MPI_Send", "mpi_send_",
+         [("buf", BUF_IN, "buf", BUF_IN),
+          ("count", INT_IN, "count", INT_IN),
+          ("datatype", DT, "datatype%MPI_VAL", INT_IN),
+          ("dest", INT_IN, "dest", INT_IN),
+          ("tag", INT_IN, "tag", INT_IN),
+          ("comm", COMM, "comm%MPI_VAL", INT_IN)]),
+        ("MPI_Bcast", "mpi_bcast_",
+         [("buffer", BUF, "buffer", BUF),
+          ("count", INT_IN, "count", INT_IN),
+          ("datatype", DT, "datatype%MPI_VAL", INT_IN),
+          ("root", INT_IN, "root", INT_IN),
+          ("comm", COMM, "comm%MPI_VAL", INT_IN)]),
+        ("MPI_Allreduce", "mpi_allreduce_",
+         [("sendbuf", BUF_IN, "sendbuf", BUF_IN),
+          ("recvbuf", BUF, "recvbuf", BUF),
+          ("count", INT_IN, "count", INT_IN),
+          ("datatype", DT, "datatype%MPI_VAL", INT_IN),
+          ("op", OP, "op%MPI_VAL", INT_IN),
+          ("comm", COMM, "comm%MPI_VAL", INT_IN)]),
+    ]
+
+    out = [
+        "! mpi_f08.f90 -- the `use mpi_f08` Fortran 2008 module.",
+        "! GENERATED by native/mpi/genmpimod.py -- do not edit.",
+        "! Strong handle types over the same integer handle values as",
+        "! mpi.h/mpif.h; wrappers forward to the f77 ABI (mpif.c).",
+        "module mpi_f08",
+        "  implicit none",
+        "  public",
+        "",
+    ]
+    for t in handle_types:
+        out += [
+            f"  type, bind(C) :: MPI_{t}",
+            "     integer :: MPI_VAL",
+            f"  end type MPI_{t}",
+        ]
+    out += [
+        "",
+        "  type :: MPI_Status",
+        "     integer :: MPI_SOURCE",
+        "     integer :: MPI_TAG",
+        "     integer :: MPI_ERROR",
+        "     integer :: internal_count   ! f77 status word 4",
+        "  end type MPI_Status",
+        "",
+        "  ! handle constants: same integer values as mpi.h / mpif.h",
+        "  type(MPI_Comm), parameter :: MPI_COMM_WORLD = MPI_Comm(0)",
+        "  type(MPI_Comm), parameter :: MPI_COMM_SELF = MPI_Comm(1)",
+        "  type(MPI_Comm), parameter :: MPI_COMM_NULL = MPI_Comm(-1)",
+        "  type(MPI_Datatype), parameter :: MPI_BYTE = MPI_Datatype(0)",
+        "  type(MPI_Datatype), parameter :: "
+        "MPI_CHARACTER = MPI_Datatype(1)",
+        "  type(MPI_Datatype), parameter :: "
+        "MPI_INTEGER = MPI_Datatype(2)",
+        "  type(MPI_Datatype), parameter :: MPI_REAL = MPI_Datatype(3)",
+        "  type(MPI_Datatype), parameter :: "
+        "MPI_DOUBLE_PRECISION = MPI_Datatype(4)",
+        "  type(MPI_Datatype), parameter :: "
+        "MPI_INTEGER8 = MPI_Datatype(5)",
+        "  type(MPI_Datatype), parameter :: "
+        "MPI_DATATYPE_NULL = MPI_Datatype(-1)",
+        "  type(MPI_Op), parameter :: MPI_SUM = MPI_Op(0)",
+        "  type(MPI_Op), parameter :: MPI_PROD = MPI_Op(1)",
+        "  type(MPI_Op), parameter :: MPI_MAX = MPI_Op(2)",
+        "  type(MPI_Op), parameter :: MPI_MIN = MPI_Op(3)",
+        "  type(MPI_Op), parameter :: MPI_OP_NULL = MPI_Op(-1)",
+        "  type(MPI_Request), parameter :: "
+        "MPI_REQUEST_NULL = MPI_Request(0)",
+        "  integer, parameter :: MPI_ANY_SOURCE = -1",
+        "  integer, parameter :: MPI_ANY_TAG = -2",
+        "  integer, parameter :: MPI_PROC_NULL = -3",
+        "  integer, parameter :: MPI_UNDEFINED = -32766",
+        "  integer, parameter :: MPI_SUCCESS = 0",
+        "  integer, parameter :: MPI_MAX_PROCESSOR_NAME = 256",
+        "  integer, parameter :: MPI_MAX_ERROR_STRING = 512",
+        "",
+        "  ! f77 entry points under non-colliding internal names",
+        "  ! (bind-C name = the gfortran-mangled f77 symbol)",
+        "  interface",
+    ]
+    for name, sym, args in ROUTINES:
+        low = name.lower().replace("mpi_", "f77_mpi_")
+        dummies = [a for a, _, _, _ in args] + ["ierror"]
+        out.append(f"     subroutine {low}({', '.join(dummies)}) &")
+        out.append(f"          bind(C, name=\"{sym}\")")
+        for a, _, _, fdecl in args:
+            out.append("       " + fdecl.format(a))
+        out.append("       integer, intent(out) :: ierror")
+        out.append(f"     end subroutine {low}")
+    out += [
+        "     subroutine f77_mpi_recv(buf, count, datatype, source, "
+        "tag, comm, status, ierror) &",
+        "          bind(C, name=\"mpi_recv_\")",
+        "       type(*), dimension(*) :: buf",
+        "       integer, intent(in) :: count, datatype, source, tag, "
+        "comm",
+        "       integer, intent(out) :: status(4)",
+        "       integer, intent(out) :: ierror",
+        "     end subroutine f77_mpi_recv",
+        "  end interface",
+        "",
+    ]
+    for name, _, _ in ROUTINES + [("MPI_Recv", None, None)]:
+        out += [
+            f"  interface {name}",
+            f"     module procedure {name}_f08",
+            f"  end interface {name}",
+        ]
+    out += ["", "contains", ""]
+
+    for name, sym, args in ROUTINES:
+        low = name.lower().replace("mpi_", "f77_mpi_")
+        dummies = [a for a, _, _, _ in args] + ["ierror"]
+        out.append(f"  subroutine {name}_f08({', '.join(dummies)})")
+        for a, decl, _, _ in args:
+            out.append("    " + decl.format(a))
+        out.append("    integer, intent(out), optional :: ierror")
+        out.append("    integer :: ierr_l")
+        calls = [u for _, _, u, _ in args]
+        out.append(f"    call {low}({', '.join(calls + ['ierr_l'])})")
+        out.append("    if (present(ierror)) ierror = ierr_l")
+        out.append(f"  end subroutine {name}_f08")
+        out.append("")
+
+    out += [
+        "  subroutine MPI_Recv_f08(buf, count, datatype, source, tag, "
+        "comm, status, ierror)",
+        "    type(*), dimension(*) :: buf",
+        "    integer, intent(in) :: count, source, tag",
+        "    type(MPI_Datatype), intent(in) :: datatype",
+        "    type(MPI_Comm), intent(in) :: comm",
+        "    type(MPI_Status), intent(out) :: status",
+        "    integer, intent(out), optional :: ierror",
+        "    integer :: ierr_l, st(4)",
+        "    call f77_mpi_recv(buf, count, datatype%MPI_VAL, source, "
+        "tag, comm%MPI_VAL, st, ierr_l)",
+        "    status%MPI_SOURCE = st(1)",
+        "    status%MPI_TAG = st(2)",
+        "    status%MPI_ERROR = st(3)",
+        "    status%internal_count = st(4)",
+        "    if (present(ierror)) ierror = ierr_l",
+        "  end subroutine MPI_Recv_f08",
+        "",
+        "end module mpi_f08",
+        "",
+    ]
+    return "\n".join(out)
+
+
 def main():
     out = [
         "! mpi.f90 -- the `use mpi` Fortran module.",
@@ -130,7 +317,11 @@ def main():
         "      end module mpi",
         "",
     ]
-    print("\n".join(out))
+    import sys
+    if "--f08" in sys.argv:
+        print(emit_f08())
+    else:
+        print("\n".join(out))
 
 
 if __name__ == "__main__":

@@ -166,15 +166,15 @@ def test_use_mpi_module_generated_current():
     """The committed mpi.f90 matches its generator's output — the
     module is generated from one declarative table, never hand-edited
     (reference: src/binding/fortran/use_mpi/buildiface)."""
-    r = subprocess.run([sys.executable,
-                        os.path.join(REPO, "native", "mpi",
-                                     "genmpimod.py")],
-                       capture_output=True, text=True, timeout=60)
-    assert r.returncode == 0, r.stderr
-    committed = open(os.path.join(REPO, "native", "mpi",
-                                  "mpi.f90")).read()
-    assert r.stdout == committed, \
-        "native/mpi/mpi.f90 is stale: rerun genmpimod.py > mpi.f90"
+    gen = os.path.join(REPO, "native", "mpi", "genmpimod.py")
+    for args, fname in [([], "mpi.f90"), (["--f08"], "mpi_f08.f90")]:
+        r = subprocess.run([sys.executable, gen, *args],
+                           capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stderr
+        committed = open(os.path.join(REPO, "native", "mpi",
+                                      fname)).read()
+        assert r.stdout == committed, \
+            f"native/mpi/{fname} is stale: rerun genmpimod.py"
 
 
 @pytest.mark.skipif(shutil.which("gfortran") is None,

@@ -247,3 +247,20 @@ def test_waitany():
             assert idx == 1 and b2[0] == 1
             r1.cancel()
     run_ranks(2, fn)
+
+
+def test_eager_selfsend_buffer_reuse():
+    """Eager buffer-reuse semantics on self-sends: after a completed
+    eager send the user may overwrite the buffer; the receiver must see
+    the ORIGINAL payload. Guards the zero-copy eager injection (the
+    channel, not pack(), owns the copy — including LocalChannel
+    self-delivery)."""
+    def fn(comm):
+        buf = np.arange(16, dtype=np.int32)
+        req = comm.isend(buf, dest=comm.rank, tag=3)
+        req.wait()          # eager: locally complete
+        buf[:] = -1         # legal overwrite after completion
+        out = np.zeros(16, np.int32)
+        comm.recv(out, source=comm.rank, tag=3)
+        assert (out == np.arange(16)).all(), out
+    run_ranks(2, fn)
