@@ -77,6 +77,16 @@ def mark_failed(universe, world_rank: int) -> None:
     eng.wakeup()
 
 
+def ft_members(comm):
+    """World ranks whose failure affects this comm's collectives —
+    local group plus, for intercommunicators, the remote group."""
+    members = list(comm.group.world_ranks)
+    rg = getattr(comm, "remote_group", None)
+    if rg is not None:
+        members += list(rg.world_ranks)
+    return members
+
+
 def _fail_dependent_recvs(universe, world_rank: int) -> None:
     """Complete operations the dead rank can never satisfy (engine mutex
     held). Named-source recvs targeting the dead rank fail; ANY_SOURCE
@@ -90,6 +100,23 @@ def _fail_dependent_recvs(universe, world_rank: int) -> None:
         ctx, src, _tag = req.match
         comm = universe.comms_by_ctx.get(ctx & ~1)
         if comm is None or comm.freed:
+            continue
+        if (ctx & 1) and world_rank in ft_members(comm) \
+                and _tag < _FT_TAG_BASE:
+            # collective disruption (ULFM): a member died while a
+            # collective is in flight on this comm. The op can never
+            # complete consistently — fail EVERY posted coll-ctx recv,
+            # including those from alive peers (the peer may itself
+            # have errored out of the collective and will never send:
+            # the rank0-waits-on-rank2 deadlock of ft/barrier.c).
+            # FT-tag-range recvs are the agreement's own exchange,
+            # which must keep working on a damaged comm (same
+            # exemption as _fail_ctx_recvs).
+            matcher.posted.remove(req)
+            req.complete(MPIException(
+                MPIX_ERR_PROC_FAILED,
+                f"collective disrupted by failure of world rank "
+                f"{world_rank}"))
             continue
         if src == ANY_SOURCE:
             if world_rank in comm.group.world_ranks \

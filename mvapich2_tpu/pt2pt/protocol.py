@@ -274,7 +274,7 @@ class Pt2ptProtocol:
             pkt = self.matcher.match_posted(ctx, source, tag)
             if pkt is not None:
                 self._deliver(req, pkt)
-            elif self._recv_source_failed(ctx, source):
+            elif self._recv_source_failed(ctx, source, tag):
                 req.complete(MPIException(
                     MPIX_ERR_PROC_FAILED,
                     f"recv source failed (ctx={ctx}, src={source})"))
@@ -283,15 +283,26 @@ class Pt2ptProtocol:
                 req._cancel_fn = lambda: self.matcher.cancel_posted(req)
         return req
 
-    def _recv_source_failed(self, ctx: int, source: int) -> bool:
+    def _recv_source_failed(self, ctx: int, source: int,
+                            tag: int) -> bool:
         """ULFM: a named-source recv from a failed rank (no message already
         queued) can never complete; a wildcard recv fails while the comm
-        has *unacknowledged* failures (failure_ack re-arms it)."""
+        has *unacknowledged* failures (failure_ack re-arms it). A recv on
+        a COLL context of a comm with ANY failed member (remote group
+        included for intercomms) fails too — collectives on a damaged
+        comm can never complete consistently (failure_ack does not
+        re-arm collectives). Recvs in the FT tag range are the ULFM
+        agreement's own exchange and are exempt (ft/ulfm.py)."""
         if not self.u.failed_ranks:
             return False
         comm = self.u.comms_by_ctx.get(ctx & ~1)
         if comm is None:
             return False
+        from ..ft.ulfm import _FT_TAG_BASE, ft_members
+        if (ctx & 1) and tag < _FT_TAG_BASE \
+                and any(w in self.u.failed_ranks
+                        for w in ft_members(comm)):
+            return True
         if source == ANY_SOURCE:
             return any(w in self.u.failed_ranks
                        and w not in comm._acked_failures
@@ -306,7 +317,7 @@ class Pt2ptProtocol:
             self.engine.progress_poke()
             with self.engine.mutex:
                 pkt = self.matcher.peek_unexpected(ctx, source, tag)
-        if pkt is None and self._recv_source_failed(ctx, source):
+        if pkt is None and self._recv_source_failed(ctx, source, tag):
             raise MPIException(MPIX_ERR_PROC_FAILED,
                                f"probe source failed (src={source})")
         return self._pkt_status(pkt) if pkt is not None else None
@@ -321,7 +332,7 @@ class Pt2ptProtocol:
                 return True
             # a probe on a source that can never send again must unwind,
             # like the equivalent posted recv (ULFM)
-            return self._recv_source_failed(ctx, source)
+            return self._recv_source_failed(ctx, source, tag)
 
         self.engine.progress_wait(pred)
         if not box:
@@ -338,7 +349,7 @@ class Pt2ptProtocol:
             with self.engine.mutex:
                 pkt = self.matcher.peek_unexpected(ctx, source, tag,
                                                    remove=True)
-        if pkt is None and self._recv_source_failed(ctx, source):
+        if pkt is None and self._recv_source_failed(ctx, source, tag):
             raise MPIException(MPIX_ERR_PROC_FAILED,
                                f"probe source failed (src={source})")
         return pkt
