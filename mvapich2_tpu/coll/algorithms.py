@@ -547,11 +547,16 @@ def scatter_binomial(comm, sendbuf: Optional[np.ndarray], mine: np.ndarray,
             top <<= 1
     else:
         # my subtree spans vranks [vrank, vrank + lowbit(vrank)), clipped
-        span = min(vrank & (-vrank), size - vrank)
+        # to the comm; the FAN-OUT width must stay the unclipped power
+        # of two — clipping it skips intermediate children (size=7:
+        # v4's span clips to 3, top=3 started the child loop at mask=1
+        # and never fed v6, deadlocking every 7-rank scatter)
+        width = vrank & (-vrank)
+        span = min(width, size - vrank)
         stage = np.empty(span * nb, dtype=mine.dtype)
         parent_v = vrank & (vrank - 1)
         crecv(comm, stage, (parent_v + root) % size, tag).wait()
-        top = span
+        top = width
     # forward child subtrees, largest offset first (matches gather order)
     mask = top >> 1
     while mask >= 1:

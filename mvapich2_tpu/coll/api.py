@@ -210,13 +210,16 @@ def scatterv(comm, sendbuf, counts, displs, recvbuf, datatype, root) -> None:
     tag = comm.next_coll_tag()
     from ..core.request import waitall
     if comm.rank == root:
+        if displs is None:
+            displs = _displs_from_counts(counts)
         total = max(displs[i] + counts[i] for i in range(comm.size))
         sb = np.asarray(datatype.pack(sendbuf, total))
         reqs = []
         for r in range(comm.size):
             seg = sb[displs[r] * esz:(displs[r] + counts[r]) * esz]
             if r == root:
-                datatype.unpack(seg, recvbuf, counts[r])
+                if recvbuf is not IN_PLACE:   # root's slice stays put
+                    datatype.unpack(seg, recvbuf, counts[r])
                 continue
             reqs.append(alg.csend(comm, seg.copy(), r, tag))
         waitall(reqs)

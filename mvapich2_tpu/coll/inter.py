@@ -88,13 +88,16 @@ def allreduce(comm, sendbuf, recvbuf, count, datatype, op) -> None:
 
 
 def allgather(comm, sendbuf, recvbuf, count, datatype) -> None:
-    """recvbuf gathers the remote group's contributions."""
+    """recvbuf gathers the remote group's contributions. ``count`` is
+    the per-REMOTE-rank recvcount; the send count comes from sendbuf
+    (the two groups may pass different counts — MPI-3.1 §5.7)."""
     tag = comm.next_coll_tag()
     lc = comm.local_comm
+    myc = _elem_count(sendbuf, datatype) if sendbuf is not None else 0
+    mine = _packed(datatype, sendbuf, myc)
+    local_all = np.empty(mine.size * lc.size, dtype=np.uint8)
+    lc.gather(mine, local_all, root=0, count=mine.size)
     nbytes = datatype.size * count
-    mine = _packed(datatype, sendbuf, count)
-    local_all = np.empty(nbytes * lc.size, dtype=np.uint8)
-    lc.gather(mine, local_all, root=0, count=nbytes)
     remote_all = np.empty(nbytes * comm.remote_size, dtype=np.uint8)
     if lc.rank == 0:
         csendrecv(comm, local_all, 0, remote_all, 0, tag)
@@ -140,20 +143,234 @@ def scatter(comm, sendbuf, recvbuf, count, datatype, root) -> None:
 
 
 def alltoall(comm, sendbuf, recvbuf, count, datatype) -> None:
-    """Direct pairwise exchange: block j of sendbuf goes to remote rank j;
-    block i of recvbuf comes from remote rank i."""
+    """Direct pairwise exchange: block j of sendbuf goes to remote rank
+    j; block i of recvbuf comes from remote rank i. ``count`` is the
+    per-remote-rank RECV count; send block size derives from sendbuf
+    (the groups may pass different counts)."""
     tag = comm.next_coll_tag()
     nbytes = datatype.size * count
-    packed = _packed(datatype, sendbuf, count * comm.remote_size)
+    myc = _elem_count(sendbuf, datatype) if sendbuf is not None else 0
+    packed = _packed(datatype, sendbuf, myc)
+    sblk = packed.size // comm.remote_size if comm.remote_size else 0
     stage = np.empty(nbytes * comm.remote_size, dtype=np.uint8)
     reqs = []
     for j in range(comm.remote_size):
         reqs.append(crecv(comm, stage[j * nbytes:(j + 1) * nbytes], j, tag))
     for j in range(comm.remote_size):
-        reqs.append(csend(comm, packed[j * nbytes:(j + 1) * nbytes], j, tag))
+        reqs.append(csend(comm, packed[j * sblk:(j + 1) * sblk], j, tag))
     for r in reqs:
         r.wait()
     datatype.unpack(stage, recvbuf, count * comm.remote_size)
+
+
+
+
+from .api import _displs_from_counts as _displs_from  # noqa: E402
+
+
+def _elem_count(buf, datatype) -> int:
+    """Element count of a typed/byte buffer under ``datatype``."""
+    b = np.asarray(buf)
+    return (b.size * b.itemsize) // max(datatype.size, 1)
+
+
+def _own_count(counts, lc):
+    """A contributor's own count: root-significant args mean non-root
+    callers may pass a 1-entry list (the C shim) or the full list."""
+    if counts is None:
+        return None
+    counts = list(counts)
+    if len(counts) == lc.size:
+        return counts[lc.rank]
+    return counts[0] if counts else 0
+
+
+def gatherv(comm, sendbuf, recvbuf, counts, displs, datatype,
+            root) -> None:
+    """counts/displs are remote-group-sized at the ROOT; contributors
+    need only their own count (MPI-3.1 §5.5 intercomm semantics)."""
+    tag = comm.next_coll_tag()
+    if root == PROC_NULL:
+        return
+    esz = datatype.size
+    lc = comm.local_comm
+    if root == ROOT:
+        counts = list(counts)
+        if displs is None:
+            displs = _displs_from(counts)
+        blob = np.empty(sum(counts) * esz, np.uint8)
+        crecv(comm, blob, 0, tag).wait()
+        total = max((displs[i] + counts[i]
+                     for i in range(comm.remote_size)), default=0)
+        rb = np.asarray(datatype.pack(recvbuf, total))
+        off = 0
+        for i in range(comm.remote_size):
+            n = counts[i] * esz
+            rb[displs[i] * esz: displs[i] * esz + n] = blob[off:off + n]
+            off += n
+        datatype.unpack(rb, recvbuf, total)
+        return
+    myc = _own_count(counts, lc)
+    if myc is None:
+        myc = _elem_count(sendbuf, datatype)
+    mine = np.asarray(datatype.pack(sendbuf, myc)).view(np.uint8)
+    sizes = np.zeros(lc.size, np.int64)
+    lc.gather(np.array([mine.size], np.int64), sizes, root=0, count=1)
+    if lc.rank == 0:
+        blob = np.empty(int(sizes.sum()), np.uint8)
+        lc.gatherv(mine, blob, [int(x) for x in sizes], root=0)
+        csend(comm, blob, root, tag).wait()
+    else:
+        lc.gatherv(mine, None, [int(mine.size)] * lc.size, root=0)
+
+
+def scatterv(comm, sendbuf, counts, displs, recvbuf, datatype,
+             root) -> None:
+    tag = comm.next_coll_tag()
+    if root == PROC_NULL:
+        return
+    esz = datatype.size
+    lc = comm.local_comm
+    if root == ROOT:
+        counts = list(counts)
+        if displs is None:
+            displs = _displs_from(counts)
+        total = max((displs[i] + counts[i]
+                     for i in range(comm.remote_size)), default=0)
+        sb = np.asarray(datatype.pack(sendbuf, total))
+        blob = np.empty(sum(counts) * esz, np.uint8)
+        off = 0
+        for i in range(comm.remote_size):
+            n = counts[i] * esz
+            blob[off:off + n] = sb[displs[i] * esz: displs[i] * esz + n]
+            off += n
+        csend(comm, blob, 0, tag).wait()
+        return
+    myc = _own_count(counts, lc)
+    if myc is None:
+        myc = _elem_count(recvbuf, datatype)
+    my_bytes = myc * esz
+    sizes = np.zeros(lc.size, np.int64)
+    lc.gather(np.array([my_bytes], np.int64), sizes, root=0, count=1)
+    mine = np.empty(my_bytes, np.uint8)
+    if lc.rank == 0:
+        blob = np.empty(int(sizes.sum()), np.uint8)
+        crecv(comm, blob, root, tag).wait()
+        lc.scatterv(blob, [int(x) for x in sizes], None, mine, root=0)
+    else:
+        lc.scatterv(None, [my_bytes] * lc.size, None, mine, root=0)
+    datatype.unpack(mine, recvbuf, myc)
+
+
+def allgatherv(comm, sendbuf, recvbuf, counts, displs, datatype) -> None:
+    """recvbuf gathers the REMOTE group's contributions; counts are
+    remote-group-sized on every rank (MPI-3.1 §5.7)."""
+    tag = comm.next_coll_tag()
+    esz = datatype.size
+    lc = comm.local_comm
+    counts = list(counts)
+    if displs is None:
+        displs = _displs_from(counts)
+    myc = _elem_count(sendbuf, datatype)
+    mine = np.asarray(datatype.pack(sendbuf, myc)).view(np.uint8)
+    sizes = np.zeros(lc.size, np.int64)
+    lc.gather(np.array([mine.size], np.int64), sizes, root=0, count=1)
+    if lc.rank == 0:
+        blob = np.empty(int(sizes.sum()), np.uint8)
+        lc.gatherv(mine, blob, [int(x) for x in sizes], root=0)
+    else:
+        blob = None
+        lc.gatherv(mine, None, [int(mine.size)] * lc.size, root=0)
+    stage = np.empty(sum(counts) * esz, np.uint8)
+    if lc.rank == 0:
+        csendrecv(comm, blob, 0, stage, 0, tag)
+    lc.bcast(stage, root=0)
+    total = max((displs[i] + counts[i]
+                 for i in range(comm.remote_size)), default=0)
+    rb = np.asarray(datatype.pack(recvbuf, total))
+    off = 0
+    for i in range(comm.remote_size):
+        n = counts[i] * esz
+        rb[displs[i] * esz: displs[i] * esz + n] = stage[off:off + n]
+        off += n
+    datatype.unpack(rb, recvbuf, total)
+
+
+def alltoallv(comm, sendbuf, sendcounts, sdispls, recvbuf, recvcounts,
+              rdispls, datatype) -> None:
+    """Pairwise exchange with per-remote-rank counts."""
+    tag = comm.next_coll_tag()
+    esz = datatype.size
+    sendcounts, recvcounts = list(sendcounts), list(recvcounts)
+    if sdispls is None:
+        sdispls = _displs_from(sendcounts)
+    if rdispls is None:
+        rdispls = _displs_from(recvcounts)
+    stotal = max((sdispls[i] + sendcounts[i]
+                  for i in range(comm.remote_size)), default=0)
+    sb = np.asarray(datatype.pack(sendbuf, stotal))
+    reqs, stages = [], []
+    for j in range(comm.remote_size):
+        st = np.empty(recvcounts[j] * esz, np.uint8)
+        stages.append(st)
+        reqs.append(crecv(comm, st, j, tag))
+    for j in range(comm.remote_size):
+        seg = sb[sdispls[j] * esz:(sdispls[j] + sendcounts[j]) * esz]
+        reqs.append(csend(comm, np.ascontiguousarray(seg), j, tag))
+    for r in reqs:
+        r.wait()
+    rtotal = max((rdispls[i] + recvcounts[i]
+                  for i in range(comm.remote_size)), default=0)
+    rb = np.asarray(datatype.pack(recvbuf, rtotal))
+    for j in range(comm.remote_size):
+        n = recvcounts[j] * esz
+        rb[rdispls[j] * esz: rdispls[j] * esz + n] = stages[j]
+    datatype.unpack(rb, recvbuf, rtotal)
+
+
+def reduce_scatter_block(comm, sendbuf, recvbuf, count, datatype,
+                         op) -> None:
+    """Each side receives count-per-rank slices of the reduction of the
+    REMOTE group's data (MPI-3.1 §5.10 intercomm semantics): a rank's
+    sendbuf holds count*remote_size elements."""
+    tag = comm.next_coll_tag()
+    lc = comm.local_comm
+    esz = datatype.size
+    send_elems = _elem_count(sendbuf, datatype)
+    part = lc.reduce(np.asarray(sendbuf), root=0, op=op,
+                     count=send_elems, datatype=datatype)
+    theirs = np.empty(count * lc.size * esz, np.uint8)
+    if lc.rank == 0:
+        csendrecv(comm, np.asarray(datatype.pack(part, send_elems)),
+                  0, theirs, 0, tag)
+    mine = np.empty(count * esz, np.uint8)
+    lc.scatter(theirs if lc.rank == 0 else None, mine, root=0,
+               count=count * esz)
+    datatype.unpack(mine, recvbuf, count)
+
+
+def reduce_scatter(comm, sendbuf, recvbuf, counts, datatype, op) -> None:
+    """Irregular-counts variant: counts are LOCAL-group-sized (my
+    side's slices of the remote reduction)."""
+    tag = comm.next_coll_tag()
+    lc = comm.local_comm
+    esz = datatype.size
+    counts = list(counts)
+    send_elems = _elem_count(sendbuf, datatype)
+    part = lc.reduce(np.asarray(sendbuf), root=0, op=op,
+                     count=send_elems, datatype=datatype)
+    theirs = np.empty(sum(counts) * esz, np.uint8)
+    if lc.rank == 0:
+        csendrecv(comm, np.asarray(datatype.pack(part, send_elems)),
+                  0, theirs, 0, tag)
+    mine = np.empty(counts[lc.rank] * esz, np.uint8)
+    if lc.rank == 0:
+        lc.scatterv(theirs, [n * esz for n in counts], None, mine,
+                    root=0)
+    else:
+        lc.scatterv(None, [counts[lc.rank] * esz] * lc.size, None,
+                    mine, root=0)
+    datatype.unpack(mine, recvbuf, counts[lc.rank])
 
 
 COLL_FNS: Dict[str, callable] = {
@@ -165,4 +382,10 @@ COLL_FNS: Dict[str, callable] = {
     "gather": gather,
     "scatter": scatter,
     "alltoall": alltoall,
+    "gatherv": gatherv,
+    "scatterv": scatterv,
+    "allgatherv": allgatherv,
+    "alltoallv": alltoallv,
+    "reduce_scatter": reduce_scatter,
+    "reduce_scatter_block": reduce_scatter_block,
 }

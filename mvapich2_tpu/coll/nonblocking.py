@@ -386,3 +386,165 @@ def iscatter(comm, sendbuf, recvbuf, count: int, datatype,
         s.barrier()
         s.call(lambda: datatype.unpack(rb, recvbuf, count))
     return s.start()
+
+
+from .api import _displs_from_counts as _pfx  # noqa: E402
+
+
+def igatherv(comm, sendbuf, sendcount: int, recvbuf, counts, displs,
+             datatype, root: int) -> Request:
+    """Linear gatherv (sched form); counts/displs root-significant."""
+    tag = comm.next_coll_tag()
+    size, rank = comm.size, comm.rank
+    s = Sched(comm, tag)
+    esz = datatype.size
+    if rank == root:
+        counts = list(counts)
+        displs = list(displs) if displs is not None else _pfx(counts)
+        total = max((displs[i] + counts[i] for i in range(size)),
+                    default=0)
+        rb = np.asarray(datatype.pack(recvbuf, total))
+        seg = rb[displs[rank] * esz:(displs[rank] + counts[rank]) * esz]
+        seg[:] = np.ascontiguousarray(
+            datatype.pack(sendbuf, counts[rank])).view(np.uint8)
+        for src in range(size):
+            if src != root:
+                s.recv(rb[displs[src] * esz:
+                          (displs[src] + counts[src]) * esz], src)
+        s.barrier()
+        s.call(lambda: datatype.unpack(rb, recvbuf, total))
+    else:
+        sb = np.ascontiguousarray(datatype.pack(sendbuf, sendcount))
+        s.send(sb.view(np.uint8), root)
+    return s.start()
+
+
+def iscatterv(comm, sendbuf, counts, displs, recvbuf, recvcount: int,
+              datatype, root: int) -> Request:
+    tag = comm.next_coll_tag()
+    size, rank = comm.size, comm.rank
+    s = Sched(comm, tag)
+    esz = datatype.size
+    if rank == root:
+        counts = list(counts)
+        displs = list(displs) if displs is not None else _pfx(counts)
+        total = max((displs[i] + counts[i] for i in range(size)),
+                    default=0)
+        sb = np.asarray(datatype.pack(sendbuf, total))
+        rb_cap = 0 if recvbuf is None else \
+            int(getattr(np.asarray(recvbuf), "size", 0))
+        for dst in range(size):
+            seg = sb[displs[dst] * esz:(displs[dst] + counts[dst]) * esz]
+            if dst == root:
+                if rb_cap:      # NULL/zero recvbuf: root keeps nothing
+                    s.call(lambda sg=seg, n=counts[dst]:
+                           datatype.unpack(sg, recvbuf, n))
+            else:
+                s.send(np.ascontiguousarray(seg), dst)
+    else:
+        rb = np.empty(recvcount * esz, np.uint8)
+        s.recv(rb, root)
+        s.barrier()
+        s.call(lambda: datatype.unpack(rb, recvbuf, recvcount))
+    return s.start()
+
+
+def iallgatherv(comm, sendbuf, sendcount: int, recvbuf, counts, displs,
+                datatype) -> Request:
+    """Ring allgatherv (sched form): linear send-to-all keeps it simple
+    at conformance sizes."""
+    tag = comm.next_coll_tag()
+    size, rank = comm.size, comm.rank
+    s = Sched(comm, tag)
+    esz = datatype.size
+    counts = list(counts)
+    displs = list(displs) if displs is not None else _pfx(counts)
+    total = max((displs[i] + counts[i] for i in range(size)), default=0)
+    rb = np.asarray(datatype.pack(recvbuf, total))
+    mine = np.ascontiguousarray(
+        datatype.pack(sendbuf, sendcount)).view(np.uint8)
+    rb[displs[rank] * esz: displs[rank] * esz + mine.size] = mine
+    for peer in range(size):
+        if peer == rank:
+            continue
+        s.send(mine, peer)
+        s.recv(rb[displs[peer] * esz:
+                  (displs[peer] + counts[peer]) * esz], peer)
+    s.barrier()
+    s.call(lambda: datatype.unpack(rb, recvbuf, total))
+    return s.start()
+
+
+def ialltoallv(comm, sendbuf, scounts, sdispls, recvbuf, rcounts,
+               rdispls, datatype) -> Request:
+    tag = comm.next_coll_tag()
+    size, rank = comm.size, comm.rank
+    s = Sched(comm, tag)
+    esz = datatype.size
+    scounts, rcounts = list(scounts), list(rcounts)
+    sdispls = list(sdispls) if sdispls is not None else _pfx(scounts)
+    rdispls = list(rdispls) if rdispls is not None else _pfx(rcounts)
+    stotal = max((sdispls[i] + scounts[i] for i in range(size)),
+                 default=0)
+    rtotal = max((rdispls[i] + rcounts[i] for i in range(size)),
+                 default=0)
+    sb = np.asarray(datatype.pack(sendbuf, stotal))
+    rb = np.asarray(datatype.pack(recvbuf, rtotal))
+    rb[rdispls[rank] * esz:(rdispls[rank] + rcounts[rank]) * esz] = \
+        sb[sdispls[rank] * esz:(sdispls[rank] + scounts[rank]) * esz]
+    for peer in range(size):
+        if peer == rank:
+            continue
+        s.send(np.ascontiguousarray(
+            sb[sdispls[peer] * esz:
+               (sdispls[peer] + scounts[peer]) * esz]), peer)
+        s.recv(rb[rdispls[peer] * esz:
+                  (rdispls[peer] + rcounts[peer]) * esz], peer)
+    s.barrier()
+    s.call(lambda: datatype.unpack(rb, recvbuf, rtotal))
+    return s.start()
+
+
+def _ired_scatter_common(comm, sendbuf, recvbuf, counts, datatype, op):
+    """Shared engine for ireduce_scatter[_block]: every rank exchanges
+    full contributions, folds in ascending-rank order (non-commutative
+    safe), and keeps its own slice."""
+    tag = comm.next_coll_tag()
+    size, rank = comm.size, comm.rank
+    s = Sched(comm, tag)
+    counts = list(counts)
+    total = sum(counts)
+    acc = datatype.to_numpy(sendbuf, total).copy()
+    parts = {rank: acc}
+    for peer in range(size):
+        if peer == rank:
+            continue
+        buf = np.empty_like(acc)
+        parts[peer] = buf
+        s.send(acc, peer)
+        s.recv(buf, peer)
+    s.barrier()
+
+    def fold():
+        out = parts[0].copy()
+        for r in range(1, size):
+            out[:] = op(out, parts[r])
+        epb = out.size // total if total else 1
+        off = sum(counts[:rank]) * epb
+        mine = out[off: off + counts[rank] * epb]
+        datatype.unpack(np.ascontiguousarray(mine).view(np.uint8),
+                        recvbuf, counts[rank])
+    s.call(fold)
+    return s.start()
+
+
+def ireduce_scatter(comm, sendbuf, recvbuf, counts, datatype,
+                    op) -> Request:
+    return _ired_scatter_common(comm, sendbuf, recvbuf, counts, datatype,
+                                op)
+
+
+def ireduce_scatter_block(comm, sendbuf, recvbuf, count: int, datatype,
+                          op) -> Request:
+    return _ired_scatter_common(comm, sendbuf, recvbuf,
+                                [count] * comm.size, datatype, op)

@@ -387,6 +387,25 @@ class Comm:
                                                  count, datatype, op)
         return ret if ret is not None else recvbuf
 
+    def reduce_scatter(self, sendbuf, recvbuf=None, counts=None, op=None,
+                       datatype: Optional[Datatype] = None):
+        """Irregular-counts reduce_scatter (MPI-3.1 §5.10); dispatches
+        through coll_fns so intercomms take the inter algorithm."""
+        self._check()
+        from . import op as opmod
+        op = op or opmod.SUM
+        if counts is None:
+            sb = recvbuf if _is_in_place(sendbuf) else sendbuf
+            n = int(getattr(sb, "size", 0) or len(sb)) // self.size
+            counts = [n] * self.size
+        _, datatype = _resolve(sendbuf, None, datatype, alt=recvbuf)
+        if recvbuf is None:
+            sb = np.asarray(sendbuf)
+            recvbuf = np.empty((list(counts)[self.rank],), dtype=sb.dtype)
+        self._coll("reduce_scatter")(self, sendbuf, recvbuf,
+                                     list(counts), datatype, op)
+        return recvbuf
+
     def scan(self, sendbuf, recvbuf=None, op=None,
              count: Optional[int] = None,
              datatype: Optional[Datatype] = None):

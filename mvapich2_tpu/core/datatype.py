@@ -163,6 +163,8 @@ class Datatype:
 
     def unpack(self, data, buf, count: int) -> None:
         """Scatter contiguous bytes ``data`` into ``buf``."""
+        if count == 0:
+            return
         raw = as_bytes_view(buf, writable=True)
         src = np.frombuffer(as_bytes_view(data), dtype=np.uint8)
         dst = np.frombuffer(raw, dtype=np.uint8)

@@ -23,6 +23,7 @@ from .core import datatype as dt
 from .core import op as opmod
 from .core.errors import MPIException
 from .core.status import ANY_SOURCE, ANY_TAG, PROC_NULL
+from .coll.api import IN_PLACE
 from .runtime import universe as uni
 
 # ---------------------------------------------------------------------------
@@ -241,8 +242,19 @@ class _BottomRecvReq:
         return getattr(self._inner, name)
 
 
+def _peer(c) -> int:
+    """Elements multiplier for the 'other side' of a collective: the
+    remote group's size on intercommunicators (MPI-3.1 §5.2.2), the
+    comm size otherwise."""
+    return c.remote_size if getattr(c, "is_inter", False) else c.size
+
+
 def _esz(dtcode: int) -> int:
-    """Packed (type-signature) bytes per element."""
+    """Packed (type-signature) bytes per element. MPI_DATATYPE_NULL
+    (negative) maps to 1: it only appears with zero counts/NULL
+    buffers (nonblocking.c calls every collective that way)."""
+    if dtcode < 0:
+        return 1
     return _dt(dtcode).size if dtcode >= _DERIVED_BASE \
         else _DTYPES[dtcode].itemsize
 
@@ -262,7 +274,11 @@ def _gather_in(view, off_elems: int, count: int, dtcode: int) -> np.ndarray:
 def _scatter_out(view, off_elems: int, count: int, dtcode: int,
                  data_u8) -> None:
     """Write `count` packed elements into the caller's buffer at element
-    offset `off_elems` (unpacking through the datatype for derived)."""
+    offset `off_elems` (unpacking through the datatype for derived).
+    count==0 writes nothing — the buffer may be a legal NULL (empty,
+    read-only bytes at the C boundary)."""
+    if count <= 0:
+        return
     raw = np.frombuffer(view, np.uint8)
     if dtcode < _DERIVED_BASE:
         esz = _DTYPES[dtcode].itemsize
@@ -546,7 +562,7 @@ def allreduce(sview, rview, count: int, dtcode: int, opcode: int,
 def reduce(sview, rview, count: int, dtcode: int, opcode: int, root: int,
            ch: int) -> int:
     c = _comm(ch)
-    rb, wb = _red_view(rview, count, dtcode) if rview is not None \
+    rb, wb = _red_view(rview, count, dtcode) if rview \
         else (None, None)
     if sview is None:          # MPI_IN_PLACE: root contributes recvbuf
         sb = rb.copy() if rb is not None else None
@@ -561,10 +577,11 @@ def reduce(sview, rview, count: int, dtcode: int, opcode: int, root: int,
 def allgather(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
               ch: int) -> int:
     c = _comm(ch)
+    n = _peer(c)
     if sdt >= _DERIVED_BASE or rdt >= _DERIVED_BASE:
-        return allgatherv(sview, rview, scount, sdt, [rcount] * c.size,
-                          [i * rcount for i in range(c.size)], rdt, ch)
-    rb = _arr(rview, rcount * c.size, rdt)
+        return allgatherv(sview, rview, scount, sdt, [rcount] * n,
+                          [i * rcount for i in range(n)], rdt, ch)
+    rb = _arr(rview, rcount * n, rdt)
     sb = _arr(sview, scount, sdt) if sview is not None \
         else rb[c.rank * rcount:(c.rank + 1) * rcount].copy()
     c.allgather(sb, rb, count=rcount)
@@ -578,13 +595,14 @@ def alltoall(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
         if sview is None:                   # MPI_IN_PLACE: sendcount and
             sview = bytes(np.frombuffer(rview, np.uint8))
             scount, sdt = rcount, rdt       # sendtype are ignored (§5.8)
-        n = c.size
+        n = _peer(c)
         return alltoallv(sview, rview, [scount] * n,
                          [i * scount for i in range(n)],
                          [rcount] * n, [i * rcount for i in range(n)],
                          sdt, rdt, ch)
-    rb = _arr(rview, rcount * c.size, rdt)
-    sb = _arr(sview, scount * c.size, sdt) if sview is not None \
+    n = _peer(c)
+    rb = _arr(rview, rcount * n, rdt)
+    sb = _arr(sview, scount * n, sdt) if sview is not None \
         else rb.copy()
     c.alltoall(sb, rb, count=rcount)
     return 0
@@ -592,32 +610,51 @@ def alltoall(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
 
 def gather(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
            root: int, ch: int) -> int:
+    """Always routed through the byte-level gatherv: the root-side
+    datatype is root-only-significant, so per-rank branching on it
+    would put the root and the contributors in DIFFERENT algorithms
+    (linear vs binomial) — messages cross-match and corrupt data
+    (scatter2.c's derived-at-root pattern)."""
     c = _comm(ch)
-    if sdt >= _DERIVED_BASE or rdt >= _DERIVED_BASE:
-        return gatherv(sview, rview, scount, sdt, [rcount] * c.size,
-                       [i * rcount for i in range(c.size)], rdt, root, ch)
-    sb = _arr(sview, scount, sdt)
-    rb = _arr(rview, rcount * c.size, rdt) if rview is not None else None
-    c.gather(sb, rb, root=root, count=rcount)
-    return 0
+    n = _peer(c)
+    return gatherv(sview, rview, scount, sdt, [rcount] * n,
+                   [i * rcount for i in range(n)], rdt, root, ch)
 
 
 def scatter(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
             root: int, ch: int) -> int:
+    """Always routed through the byte-level scatterv — see gather()."""
     c = _comm(ch)
-    if sdt >= _DERIVED_BASE or rdt >= _DERIVED_BASE:
-        return scatterv(sview, rview, [scount] * c.size,
-                        [i * scount for i in range(c.size)], sdt, rcount,
-                        rdt, root, ch)
-    sb = _arr(sview, scount * c.size, sdt) if sview is not None else None
-    rb = _arr(rview, rcount, rdt)
-    c.scatter(sb, rb, root=root, count=rcount)
-    return 0
+    n = _peer(c)
+    if rview is None:
+        # IN_PLACE root: recvcount/recvtype are ignored (§5.6)
+        rcount, rdt = 0, sdt
+    return scatterv(sview, rview, [scount] * n,
+                    [i * scount for i in range(n)], sdt, rcount,
+                    rdt, root, ch)
 
 
 def reduce_scatter_block(sview, rview, rcount: int, dtcode: int,
                          opcode: int, ch: int) -> int:
     c = _comm(ch)
+    if _is_inter(c):
+        # sendbuf holds rcount*local_size elements (redscatbkinter.c)
+        sb, _ = _red_view(sview, rcount * c.size, dtcode)
+        rb, wb = _red_view(rview, rcount, dtcode)
+        c.reduce_scatter_block(sb, rb, op=_OPS[opcode], count=rcount)
+        if wb is not None:
+            wb()
+        return 0
+    if sview is None:
+        # MPI_IN_PLACE: input is the full size*rcount array in recvbuf;
+        # the result lands in its first rcount elements (MPI-3.1 §5.10)
+        sb, _ = _red_view(rview, rcount * c.size, dtcode)
+        rb = np.empty(rcount * (sb.size // (rcount * c.size) if rcount
+                                else 1), sb.dtype)
+        c.reduce_scatter_block(sb.copy(), rb, op=_OPS[opcode],
+                               count=rcount)
+        _scatter_out(rview, 0, rcount, dtcode, rb.view(np.uint8))
+        return 0
     sb, _ = _red_view(sview, rcount * c.size, dtcode)
     rb, wb = _red_view(rview, rcount, dtcode)
     c.reduce_scatter_block(sb, rb, op=_OPS[opcode], count=rcount)
@@ -782,17 +819,38 @@ def win_flush(wh: int, rank: int) -> int:
     return 0
 
 
+def _dt_obj(dtcode: int):
+    """Datatype object for a C type code (basic or derived)."""
+    if dtcode >= _DERIVED_BASE:
+        return _derived[dtcode]
+    return dt.from_numpy_dtype(np.dtype(_DTYPES[dtcode]))
+
+
+def _rma_args(oview, count: int, dtcode: int):
+    """(buf, kwargs) for a window op honoring derived origin types."""
+    if dtcode >= _DERIVED_BASE:
+        return (np.frombuffer(oview, np.uint8),
+                {"count": count, "origin_dt": _derived[dtcode]})
+    return _arr(oview, count, dtcode), {}
+
+
 def put(wh: int, oview, count: int, dtcode: int, target: int,
-        tdisp: int) -> int:
-    buf = _arr(oview, count, dtcode)
-    _wins[wh].put(buf, target, tdisp)
+        tdisp: int, tcount: int = -1, tdtcode: int = -1) -> int:
+    buf, kw = _rma_args(oview, count, dtcode)
+    if tdtcode >= 0:
+        kw["target_dt"] = _dt_obj(tdtcode)
+        kw["target_count"] = tcount if tcount >= 0 else count
+    _wins[wh].put(buf, target, tdisp, **kw)
     return 0
 
 
 def get(wh: int, oview, count: int, dtcode: int, target: int,
-        tdisp: int) -> int:
-    buf = _arr(oview, count, dtcode)
-    _wins[wh].get(buf, target, tdisp)
+        tdisp: int, tcount: int = -1, tdtcode: int = -1) -> int:
+    buf, kw = _rma_args(oview, count, dtcode)
+    if tdtcode >= 0:
+        kw["target_dt"] = _dt_obj(tdtcode)
+        kw["target_count"] = tcount if tcount >= 0 else count
+    _wins[wh].get(buf, target, tdisp, **kw)
     return 0
 
 
@@ -998,7 +1056,8 @@ def allgatherv(sview, rview, scount: int, sdt: int, rcounts, displs,
     tmp = np.empty(sum(rcounts) * esz, np.uint8)
     c.allgatherv(sb, tmp, [n * esz for n in rcounts])
     off = 0
-    for i, n in enumerate(rcounts):
+    for i in range(_peer(c)):
+        n = rcounts[i]
         _scatter_out(rview, displs[i], n, rdt, tmp[off: off + n * esz])
         off += n * esz
     return 0
@@ -1016,7 +1075,7 @@ def alltoallv(sview, rview, scounts, sdispls, rcounts, rdispls,
     esz_s, esz_r = _esz(sdt), _esz(rdt)
     # pack per-destination segments contiguously (displs may be sparse)
     segs = [_gather_in(sview, sdispls[j], scounts[j], sdt)
-            for j in range(c.size)]
+            for j in range(_peer(c))]
     sb = np.concatenate(segs) if segs else np.empty(0, np.uint8)
     sdispls_b = np.concatenate(
         [[0], np.cumsum([n * esz_s for n in scounts])[:-1]]).tolist()
@@ -1025,7 +1084,7 @@ def alltoallv(sview, rview, scounts, sdispls, rcounts, rdispls,
         [[0], np.cumsum([n * esz_r for n in rcounts])[:-1]]).tolist()
     c.alltoallv(sb, [n * esz_s for n in scounts], sdispls_b,
                 rtmp, [n * esz_r for n in rcounts], rdispls_b)
-    for i in range(c.size):
+    for i in range(_peer(c)):
         _scatter_out(rview, rdispls[i], rcounts[i], rdt,
                      rtmp[rdispls_b[i]: rdispls_b[i] + rcounts[i] * esz_r])
     return 0
@@ -1055,17 +1114,18 @@ def alltoallw(sview, rview, scounts, sdispls, stypes,
     rcounts, rdispls, rtypes = list(rcounts), list(rdispls), list(rtypes)
     raw_s = np.frombuffer(sview, np.uint8)
     raw_r = np.frombuffer(rview, np.uint8)
+    n = _peer(c)
     segs = [_gather_bytes(raw_s, sdispls[j], scounts[j], stypes[j])
-            for j in range(c.size)]
+            for j in range(n)]
     sb = (np.concatenate([np.ascontiguousarray(s) for s in segs])
           if segs else np.empty(0, np.uint8))
-    sbytes = [scounts[j] * _esz(stypes[j]) for j in range(c.size)]
-    rbytes = [rcounts[j] * _esz(rtypes[j]) for j in range(c.size)]
+    sbytes = [scounts[j] * _esz(stypes[j]) for j in range(n)]
+    rbytes = [rcounts[j] * _esz(rtypes[j]) for j in range(n)]
     sdispls_b = np.concatenate([[0], np.cumsum(sbytes)[:-1]]).tolist()
     rdispls_b = np.concatenate([[0], np.cumsum(rbytes)[:-1]]).tolist()
     rtmp = np.empty(sum(rbytes), np.uint8)
     c.alltoallv(sb, sbytes, sdispls_b, rtmp, rbytes, rdispls_b)
-    for i in range(c.size):
+    for i in range(n):
         _scatter_bytes(raw_r, rdispls[i], rcounts[i], rtypes[i],
                        rtmp[rdispls_b[i]: rdispls_b[i] + rbytes[i]])
     return 0
@@ -1086,20 +1146,48 @@ def reduce_local(inview, inoutview, count: int, dtcode: int,
 def gatherv(sview, rview, scount: int, sdt: int, rcounts, displs,
             rdt: int, root: int, ch: int) -> int:
     c = _comm(ch)
-    sb = _gather_in(sview, 0, scount, sdt)
+    if _is_inter(c):
+        from .core.status import ROOT as _ROOT, PROC_NULL as _PN
+        if root == _ROOT:
+            rcounts, displs = list(rcounts), list(displs)
+            esz = _esz(rdt)
+            bcounts = [n * esz for n in rcounts]
+            tmp = np.empty(sum(bcounts), np.uint8)
+            c.gatherv(b"", tmp, bcounts, root=root)
+            off = 0
+            for i, n in enumerate(rcounts):
+                _scatter_out(rview, displs[i], n, rdt,
+                             tmp[off: off + n * esz])
+                off += n * esz
+        elif root == _PN:
+            c.gatherv(b"", None, [0], root=root)
+        else:
+            sb = _gather_in(sview, 0, scount, sdt)
+            c.gatherv(sb, None, [int(sb.size)], root=root)
+        return 0
+    sb = _gather_in(sview, 0, scount, sdt) if sview is not None \
+        else None
     if c.rank == root:
         rcounts, displs = list(rcounts), list(displs)
-        esz = _esz(rdt)
+        esz = _esz(rdt) if rdt >= 0 else 1
+        if sb is None:     # MPI_IN_PLACE: contribution already in place
+            sb = np.array(_gather_in(rview, displs[root],
+                                     rcounts[root], rdt)) \
+                if rview is not None and rcounts[root] > 0 \
+                else np.empty(0, np.uint8)
         tmp = np.empty(sum(rcounts) * esz, np.uint8)
         c.gatherv(sb, tmp, [n * esz for n in rcounts], root=root)
-        off = 0
-        for i, n in enumerate(rcounts):
-            _scatter_out(rview, displs[i], n, rdt,
-                         tmp[off: off + n * esz])
-            off += n * esz
+        if rview is not None:
+            off = 0
+            for i, n in enumerate(rcounts):
+                _scatter_out(rview, displs[i], n, rdt,
+                             tmp[off: off + n * esz])
+                off += n * esz
     else:
         # non-root: rcounts/displs are not significant (MPI-3.1 §5.5);
         # the linear algorithm only reads counts[rank] = my byte count
+        if sb is None:     # NULL sendbuf: legal for zero contributions
+            sb = np.empty(0, np.uint8)
         c.gatherv(sb, None, [sb.size] * c.size, root=root)
     return 0
 
@@ -1107,24 +1195,46 @@ def gatherv(sview, rview, scount: int, sdt: int, rcounts, displs,
 def scatterv(sview, rview, scounts, displs, sdt: int, rcount: int,
              rdt: int, root: int, ch: int) -> int:
     c = _comm(ch)
-    esz = _esz(rdt)
-    rtmp = np.empty(rcount * esz, np.uint8)
+    esz = _esz(rdt) if rview is not None else 0
+    if _is_inter(c):
+        from .core.status import ROOT as _ROOT, PROC_NULL as _PN
+        if root == _ROOT:
+            scounts, displs = list(scounts), list(displs)
+            esz_s = _esz(sdt)
+            segs = [_gather_in(sview, displs[j], scounts[j], sdt)
+                    for j in range(c.remote_size)]
+            sb = np.concatenate(segs) if segs else np.empty(0, np.uint8)
+            displs_b = np.concatenate(
+                [[0],
+                 np.cumsum([n * esz_s for n in scounts])[:-1]]).tolist()
+            c.scatterv(sb, [n * esz_s for n in scounts], displs_b,
+                       np.empty(0, np.uint8), root=root)
+        elif root == _PN:
+            c.scatterv(None, [0], None, np.empty(0, np.uint8), root=root)
+        else:
+            rtmp = np.empty(rcount * esz, np.uint8)
+            c.scatterv(None, [rcount * esz], None, rtmp, root=root)
+            _scatter_out(rview, 0, rcount, rdt, rtmp)
+        return 0
+    rtmp = np.empty(rcount * esz, np.uint8) if rview is not None else None
     if c.rank == root:
         scounts = list(scounts)
         displs = list(displs)
-        esz_s = _esz(sdt)
-        segs = [_gather_in(sview, displs[j], scounts[j], sdt)
-                for j in range(c.size)]
+        esz_s = _esz(sdt) if sdt >= 0 else 1
+        segs = ([_gather_in(sview, displs[j], scounts[j], sdt)
+                 for j in range(c.size)] if sview is not None else
+                [np.empty(0, np.uint8)] * c.size)
         sb = np.concatenate(segs) if segs else np.empty(0, np.uint8)
         displs_b = np.concatenate(
             [[0], np.cumsum([n * esz_s for n in scounts])[:-1]]).tolist()
-        c.scatterv(sb, [n * esz_s for n in scounts], displs_b, rtmp,
-                   root=root)
+        c.scatterv(sb, [n * esz_s for n in scounts], displs_b,
+                   rtmp if rtmp is not None else IN_PLACE, root=root)
     else:
         # non-root: sendcounts/displs are not significant (MPI-3.1 §5.6);
         # counts=None makes the algorithm size the receive from recvbuf
         c.scatterv(None, None, None, rtmp, root=root)
-    _scatter_out(rview, 0, rcount, rdt, rtmp)
+    if rview is not None:
+        _scatter_out(rview, 0, rcount, rdt, rtmp)
     return 0
 
 
@@ -1135,8 +1245,20 @@ def reduce_scatter(sview, rview, rcounts, dtcode: int, opcode: int,
     c = _comm(ch)
     rcounts = list(rcounts)
     total = sum(rcounts)
+    if _is_inter(c):
+        # intercomm: sendbuf holds the REMOTE side's total; my slice is
+        # rcounts[local rank] of the remote group's reduction
+        send_elems = 0 if sview is None else \
+            len(np.frombuffer(sview, np.uint8)) // _esz(dtcode)
+        sb, _ = _red_view(sview, send_elems, dtcode)
+        rb, wb = _red_view(rview, rcounts[c.rank], dtcode)
+        c.reduce_scatter(sb, rb, rcounts, op=_OPS[opcode])
+        if wb is not None:
+            wb()
+        return 0
     if sview is None:
-        raise MPIException(1, "MPI_IN_PLACE reduce_scatter unsupported")
+        # MPI_IN_PLACE: input is the full `total` array in recvbuf
+        sview = bytes(np.frombuffer(rview, np.uint8))
     sb, _ = _red_view(sview, total, dtcode)
     tmp = np.empty_like(sb)
     c.allreduce(sb, tmp, op=_OPS[opcode])
@@ -1333,15 +1455,19 @@ def type_extent(code: int):
 # ---------------------------------------------------------------------------
 
 def accumulate(wh: int, oview, count: int, dtcode: int, target: int,
-               tdisp: int, opcode: int) -> int:
-    buf = _arr(oview, count, dtcode)
-    _wins[wh].accumulate(buf, target, tdisp, op=_OPS[opcode])
+               tdisp: int, opcode: int, tcount: int = -1,
+               tdtcode: int = -1) -> int:
+    buf, kw = _rma_args(oview, count, dtcode)
+    if tdtcode >= 0:
+        kw["target_dt"] = _dt_obj(tdtcode)
+        kw["target_count"] = tcount if tcount >= 0 else count
+    _wins[wh].accumulate(buf, target, tdisp, op=_OPS[opcode], **kw)
     return 0
 
 
 def get_accumulate(wh: int, oview, rview, count: int, dtcode: int,
                    target: int, tdisp: int, opcode: int) -> int:
-    obuf = _arr(oview, count, dtcode) if oview is not None else \
+    obuf = _arr(oview, count, dtcode) if oview else \
         np.zeros(count, _DTYPES[dtcode])
     rbuf = _arr(rview, count, dtcode)
     _wins[wh].get_accumulate(obuf, rbuf, target, tdisp, op=_OPS[opcode])
@@ -1889,12 +2015,12 @@ def ireduce(sview, rview, count: int, dtcode: int, opcode: int, root: int,
     from .coll import nonblocking as nb
     c = _comm(ch)
     if _is_inter(c):
-        recv0 = _arr(rview, count, dtcode) if rview is not None else None
+        recv0 = _arr(rview, count, dtcode) if rview else None
         send0 = _arr(sview, count, dtcode) if sview is not None else None
         return _queued(ch, lambda: c.reduce(send0, recv0,
                                             op=_OPS[opcode], root=root,
                                             count=count))
-    if rview is None:
+    if not rview:
         recv = np.empty(count, dtype=_DTYPES[dtcode])
     else:
         recv = _arr(rview, count, dtcode)
@@ -1907,10 +2033,8 @@ def iallgather(sview, rview, count: int, dtcode: int, ch: int) -> int:
     from .coll import nonblocking as nb
     c = _comm(ch)
     if _is_inter(c):
-        recv0 = _arr(rview, count * c.remote_size, dtcode)
-        send0 = _arr(sview, count, dtcode) if sview is not None else None
-        return _queued(ch, lambda: c.allgather(send0, recv0,
-                                               count=count))
+        return _queued(ch, lambda: allgather(sview, rview, count,
+                                             dtcode, count, dtcode, ch))
     recv = _arr(rview, count * c.size, dtcode)
     send = recv[c.rank * count:(c.rank + 1) * count].copy() \
         if sview is None else _arr(sview, count, dtcode)
@@ -1921,10 +2045,8 @@ def ialltoall(sview, rview, count: int, dtcode: int, ch: int) -> int:
     from .coll import nonblocking as nb
     c = _comm(ch)
     if _is_inter(c):
-        recv0 = _arr(rview, count * c.remote_size, dtcode)
-        send0 = _arr(sview, count * c.remote_size, dtcode) \
-            if sview is not None else recv0.copy()
-        return _queued(ch, lambda: c.alltoall(send0, recv0, count=count))
+        return _queued(ch, lambda: alltoall(sview, rview, count, dtcode,
+                                            count, dtcode, ch))
     recv = _arr(rview, count * c.size, dtcode)
     send = recv.copy() if sview is None \
         else _arr(sview, count * c.size, dtcode)
@@ -1966,12 +2088,10 @@ def igather(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
     from .coll import nonblocking as nb
     c = _comm(ch)
     if _is_inter(c):
-        recv0 = _arr(rview, rcount * c.remote_size, rdt) \
-            if rview is not None else None
-        send0 = _arr(sview, scount, sdt) if sview is not None else None
-        return _queued(ch, lambda: c.gather(
-            send0, recv0, root=root,
-            count=rcount if recv0 is not None else scount))
+        # same count/type/root logic as the blocking path, run on the
+        # per-intercomm worker (issue-order serialized)
+        return _queued(ch, lambda: gather(sview, rview, scount, sdt,
+                                          rcount, rdt, root, ch))
     if c.rank == root:
         recv = _arr(rview, rcount * c.size, rdt)
         if sview is None:                    # IN_PLACE at root
@@ -1990,12 +2110,8 @@ def iscatter(sview, rview, scount: int, sdt: int, rcount: int,
     from .coll import nonblocking as nb
     c = _comm(ch)
     if _is_inter(c):
-        send0 = _arr(sview, scount * c.remote_size, sdt) \
-            if sview is not None else None
-        recv0 = _arr(rview, rcount, rdt) if rview is not None else None
-        return _queued(ch, lambda: c.scatter(
-            send0, recv0, root=root,
-            count=rcount if recv0 is not None else scount))
+        return _queued(ch, lambda: scatter(sview, rview, scount, sdt,
+                                           rcount, rdt, root, ch))
     if c.rank == root:
         send = _arr(sview, scount * c.size, sdt)
         if rview is None:      # MPI_IN_PLACE at root: block stays put
@@ -2739,3 +2855,191 @@ def universe_size() -> int:
 def get_appnum() -> int:
     a = mpi.Get_appnum()
     return -1 if a is None else int(a)
+
+
+def win_set_name(wh: int, name: str) -> int:
+    _wins[wh].set_name(name)
+    return 0
+
+
+def win_get_name(wh: int) -> str:
+    return _wins[wh].get_name()
+
+
+# ---------------------------------------------------------------------------
+# nonblocking v-collectives (MPI-3.0 §5.12; sched-based, byte-level)
+# ---------------------------------------------------------------------------
+
+def igatherv(sview, rview, scount: int, sdt: int, rcounts, displs,
+             rdt: int, root: int, ch: int) -> int:
+    from .coll import nonblocking as nb
+    c = _comm(ch)
+    if _is_inter(c):
+        args = (sview, rview, scount, sdt,
+                list(rcounts) if rcounts is not None else None,
+                list(displs) if displs is not None else None,
+                rdt, root, ch)
+        return _queued(ch, lambda: gatherv(*args))
+    esz = _esz(rdt)
+    if c.rank == root:
+        rcounts = [max(n, 0) for n in rcounts] if rview else \
+            [0] * c.size
+        displs = list(displs) if displs is not None and rview else \
+            [0] * c.size
+        bcounts = [n * esz for n in rcounts]
+        tmp = np.empty(sum(bcounts), np.uint8)
+        if sview is not None:
+            sb = _gather_in(sview, 0, scount, sdt)
+        elif rview and rcounts[root] > 0:
+            sb = np.array(_gather_in(rview, displs[root],
+                                     rcounts[root], rdt))
+        else:
+            sb = np.empty(0, np.uint8)
+        req = nb.igatherv(c, sb, sb.size, tmp, bcounts, None,
+                          dt.BYTE, root)
+
+        if rview:
+            def finish(_r, rv=rview, rcs=rcounts, dps=displs, t=tmp):
+                off = 0
+                for i, n in enumerate(rcs):
+                    _scatter_out(rv, dps[i], n, rdt,
+                                 t[off: off + n * esz])
+                    off += n * esz
+            req.add_callback(finish)
+        return _new_req(req)
+    sb = _gather_in(sview, 0, scount, sdt) if sview is not None \
+        else np.empty(0, np.uint8)
+    return _new_req(nb.igatherv(c, sb, sb.size, None, None, None,
+                                dt.BYTE, root))
+
+
+def iscatterv(sview, rview, scounts, displs, sdt: int, rcount: int,
+              rdt: int, root: int, ch: int) -> int:
+    from .coll import nonblocking as nb
+    c = _comm(ch)
+    if _is_inter(c):
+        args = (sview, rview,
+                list(scounts) if scounts is not None else None,
+                list(displs) if displs is not None else None,
+                sdt, rcount, rdt, root, ch)
+        return _queued(ch, lambda: scatterv(*args))
+    esz = _esz(rdt) if rview else 0
+    nrecv = max(rcount, 0) * esz if rview else 0
+    rtmp = np.empty(nrecv, np.uint8)
+    if c.rank == root:
+        scounts, displs = list(scounts), list(displs)
+        esz_s = _esz(sdt)
+        segs = ([_gather_in(sview, displs[j], scounts[j], sdt)
+                 for j in range(c.size)] if sview is not None else
+                [np.empty(0, np.uint8)] * c.size)
+        sb = np.concatenate(segs) if segs else np.empty(0, np.uint8)
+        req = nb.iscatterv(c, sb, [n * esz_s for n in scounts], None,
+                           rtmp, nrecv, dt.BYTE, root)
+    else:
+        req = nb.iscatterv(c, None, None, None, rtmp, nrecv,
+                           dt.BYTE, root)
+    if rview:
+        req.add_callback(lambda _r: _scatter_out(rview, 0, rcount, rdt,
+                                                 rtmp))
+    return _new_req(req)
+
+
+def iallgatherv(sview, rview, scount: int, sdt: int, rcounts, displs,
+                rdt: int, ch: int) -> int:
+    from .coll import nonblocking as nb
+    c = _comm(ch)
+    if _is_inter(c):
+        args = (sview, rview, scount, sdt, list(rcounts), list(displs),
+                rdt, ch)
+        return _queued(ch, lambda: allgatherv(*args))
+    rcounts, displs = list(rcounts), list(displs)
+    esz = _esz(rdt)
+    if sview is None:                     # MPI_IN_PLACE
+        sb = np.array(_gather_in(rview, displs[c.rank],
+                                 rcounts[c.rank], rdt))
+    else:
+        sb = _gather_in(sview, 0, scount, sdt)
+    bcounts = [n * esz for n in rcounts]
+    tmp = np.empty(sum(bcounts), np.uint8)
+    req = nb.iallgatherv(c, sb, sb.size, tmp, bcounts, None, dt.BYTE)
+
+    def finish(_r):
+        off = 0
+        for i, n in enumerate(rcounts):
+            _scatter_out(rview, displs[i], n, rdt,
+                         tmp[off: off + n * esz])
+            off += n * esz
+    req.add_callback(finish)
+    return _new_req(req)
+
+
+def ialltoallv(sview, rview, scounts, sdispls, rcounts, rdispls,
+               sdt: int, rdt: int, ch: int) -> int:
+    from .coll import nonblocking as nb
+    c = _comm(ch)
+    if _is_inter(c):
+        args = (sview, rview,
+                list(scounts) if scounts is not None else None,
+                list(sdispls) if sdispls is not None else None,
+                list(rcounts), list(rdispls), sdt, rdt, ch)
+        return _queued(ch, lambda: alltoallv(*args))
+    if sview is None:
+        sview, scounts, sdispls, sdt = rview, rcounts, rdispls, rdt
+        sview = bytes(np.frombuffer(sview, np.uint8))
+    scounts, sdispls = list(scounts), list(sdispls)
+    rcounts, rdispls = list(rcounts), list(rdispls)
+    esz_s, esz_r = _esz(sdt), _esz(rdt)
+    segs = [_gather_in(sview, sdispls[j], scounts[j], sdt)
+            for j in range(c.size)]
+    sb = np.concatenate(segs) if segs else np.empty(0, np.uint8)
+    rtmp = np.empty(sum(rcounts) * esz_r, np.uint8)
+    bs = [n * esz_s for n in scounts]
+    br = [n * esz_r for n in rcounts]
+    req = nb.ialltoallv(c, sb, bs, None, rtmp, br, None, dt.BYTE)
+
+    def finish(_r):
+        off = 0
+        for i in range(c.size):
+            _scatter_out(rview, rdispls[i], rcounts[i], rdt,
+                         rtmp[off: off + rcounts[i] * esz_r])
+            off += rcounts[i] * esz_r
+    req.add_callback(finish)
+    return _new_req(req)
+
+
+def ireduce_scatter(sview, rview, rcounts, dtcode: int, opcode: int,
+                    ch: int) -> int:
+    from .coll import nonblocking as nb
+    c = _comm(ch)
+    rcounts = list(rcounts)
+    if _is_inter(c):
+        return _queued(ch, lambda: reduce_scatter(sview, rview, rcounts,
+                                                  dtcode, opcode, ch))
+    total = sum(rcounts)
+    if sview is None:
+        sview = bytes(np.frombuffer(rview, np.uint8))
+    sb, _ = _red_view(sview, total, dtcode)
+    rb, wb = _red_view(rview, rcounts[c.rank], dtcode)
+    req = nb.ireduce_scatter(c, sb, rb, rcounts, _dt(dtcode),
+                             _OPS[opcode])
+    if wb is not None:
+        req.add_callback(lambda _r: wb())
+    return _new_req(req)
+
+
+def ireduce_scatter_block(sview, rview, rcount: int, dtcode: int,
+                          opcode: int, ch: int) -> int:
+    from .coll import nonblocking as nb
+    c = _comm(ch)
+    if _is_inter(c):
+        return _queued(ch, lambda: reduce_scatter_block(
+            sview, rview, rcount, dtcode, opcode, ch))
+    if sview is None:
+        sview = bytes(np.frombuffer(rview, np.uint8))
+    sb, _ = _red_view(sview, rcount * c.size, dtcode)
+    rb, wb = _red_view(rview, rcount, dtcode)
+    req = nb.ireduce_scatter_block(c, sb, rb, rcount, _dt(dtcode),
+                                   _OPS[opcode])
+    if wb is not None:
+        req.add_callback(lambda _r: wb())
+    return _new_req(req)

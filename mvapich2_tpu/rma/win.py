@@ -186,46 +186,52 @@ class Win:
     # ------------------------------------------------------------------
     def put(self, origin, target_rank: int, target_disp: int = 0,
             count: Optional[int] = None, origin_dt: Optional[Datatype] = None,
-            target_dt: Optional[Datatype] = None) -> None:
+            target_dt: Optional[Datatype] = None,
+            target_count: Optional[int] = None) -> None:
         self.rput(origin, target_rank, target_disp, count, origin_dt,
-                  target_dt)  # local completion is immediate (data copied)
+                  target_dt, target_count)  # locally complete (data copied)
 
     def rput(self, origin, target_rank: int, target_disp: int = 0,
              count: Optional[int] = None, origin_dt: Optional[Datatype] = None,
-             target_dt: Optional[Datatype] = None) -> Request:
+             target_dt: Optional[Datatype] = None,
+             target_count: Optional[int] = None) -> Request:
         self._check_target(target_rank)
         self._need_access_epoch(target_rank)
         odt, cnt = _resolve_dt(origin, count, origin_dt)
         tdt = target_dt or odt
+        tcnt = cnt if target_count is None else target_count
         data = np.asarray(odt.pack(origin, cnt))
         pkt = Packet(PktType.RMA_PUT, self.u.world_rank, nbytes=len(data),
                      data=data,
                      extra={"win": self.win_id, "disp": int(target_disp),
-                            "count": cnt, "tdt": _ser_dt(tdt)})
+                            "count": tcnt, "tdt": _ser_dt(tdt)})
         self._touched.add(target_rank)
         self._send(target_rank, pkt)
         return CompletedRequest()
 
     def get(self, origin, target_rank: int, target_disp: int = 0,
             count: Optional[int] = None, origin_dt: Optional[Datatype] = None,
-            target_dt: Optional[Datatype] = None) -> None:
+            target_dt: Optional[Datatype] = None,
+            target_count: Optional[int] = None) -> None:
         req = self.rget(origin, target_rank, target_disp, count, origin_dt,
-                        target_dt)
+                        target_dt, target_count)
         req.wait()
 
     def rget(self, origin, target_rank: int, target_disp: int = 0,
              count: Optional[int] = None, origin_dt: Optional[Datatype] = None,
-             target_dt: Optional[Datatype] = None) -> Request:
+             target_dt: Optional[Datatype] = None,
+             target_count: Optional[int] = None) -> Request:
         self._check_target(target_rank)
         self._need_access_epoch(target_rank)
         odt, cnt = _resolve_dt(origin, count, origin_dt)
         tdt = target_dt or odt
+        tcnt = cnt if target_count is None else target_count
         req = _GetRequest(self.u.engine, origin, cnt, odt)
         with self.u.engine.mutex:
             self.u.engine.track(req)
         pkt = Packet(PktType.RMA_GET, self.u.world_rank, rreq_id=req.req_id,
                      extra={"win": self.win_id, "disp": int(target_disp),
-                            "count": cnt, "tdt": _ser_dt(tdt)})
+                            "count": tcnt, "tdt": _ser_dt(tdt)})
         self._touched.add(target_rank)
         self._send(target_rank, pkt)
         return req
@@ -233,23 +239,26 @@ class Win:
     def accumulate(self, origin, target_rank: int, target_disp: int = 0,
                    count: Optional[int] = None, op: opmod.Op = opmod.SUM,
                    origin_dt: Optional[Datatype] = None,
-                   target_dt: Optional[Datatype] = None) -> None:
+                   target_dt: Optional[Datatype] = None,
+                   target_count: Optional[int] = None) -> None:
         self.raccumulate(origin, target_rank, target_disp, count, op,
-                         origin_dt, target_dt)
+                         origin_dt, target_dt, target_count)
 
     def raccumulate(self, origin, target_rank: int, target_disp: int = 0,
                     count: Optional[int] = None, op: opmod.Op = opmod.SUM,
                     origin_dt: Optional[Datatype] = None,
-                    target_dt: Optional[Datatype] = None) -> Request:
+                    target_dt: Optional[Datatype] = None,
+                    target_count: Optional[int] = None) -> Request:
         self._check_target(target_rank)
         self._need_access_epoch(target_rank)
         odt, cnt = _resolve_dt(origin, count, origin_dt)
         tdt = target_dt or odt
+        tcnt = cnt if target_count is None else target_count
         data = np.asarray(odt.pack(origin, cnt))
         pkt = Packet(PktType.RMA_ACC, self.u.world_rank, nbytes=len(data),
                      data=data,
                      extra={"win": self.win_id, "disp": int(target_disp),
-                            "count": cnt, "tdt": _ser_dt(tdt),
+                            "count": tcnt, "tdt": _ser_dt(tdt),
                             "op": op.name})
         self._touched.add(target_rank)
         self._send(target_rank, pkt)

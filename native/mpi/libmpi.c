@@ -185,8 +185,15 @@ static int shim_call_status(const char *name, MPI_Status *status,
 }
 
 PyObject *mv_view(const void *buf, long nbytes) {
-    if (buf == MPI_IN_PLACE || buf == NULL) {
+    /* MPI_IN_PLACE and NULL are distinct: in-place (None) reads the
+     * recv buffer; NULL (empty bytes) is a legal zero-contribution
+     * buffer — icalltoall.c sends (NULL, 0) one way, and treating it
+     * as in-place made the other side send garbage. */
+    if (buf == MPI_IN_PLACE) {
         Py_RETURN_NONE;
+    }
+    if (buf == NULL) {
+        return PyBytes_FromStringAndSize("", 0);
     }
     return PyMemoryView_FromMemory((char *)buf, nbytes, PyBUF_WRITE);
 }
@@ -548,6 +555,19 @@ int MPI_Barrier(MPI_Comm comm) {
     return mv2t_errcheck(comm, shim_call_i("barrier", "(i)", comm));
 }
 
+/* the element-count multiplier for the "other side" of a collective:
+ * the remote group's size on intercommunicators (MPI-3.1 §5.2.2) */
+int coll_peer_np(MPI_Comm comm) {
+    int ok;
+    long inter = shim_call_v("comm_test_inter", &ok, "(i)", comm);
+    if (ok && inter) {
+        long rs = shim_call_v("comm_remote_size", &ok, "(i)", comm);
+        if (ok && rs > 0)
+            return (int)rs;
+    }
+    return comm_np(comm);
+}
+
 static int coll2(const char *fn, const void *sb, void *rb, long snb,
                  long rnb, const char *fmt, ...) {
     PyGILState_STATE st = PyGILState_Ensure();
@@ -619,8 +639,7 @@ int MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
 int MPI_Allgather(const void *sendbuf, int scount, MPI_Datatype sdt,
                   void *recvbuf, int rcount, MPI_Datatype rdt,
                   MPI_Comm comm) {
-    int size;
-    MPI_Comm_size(comm, &size);
+    int size = coll_peer_np(comm);
     return mv2t_errcheck(comm, coll2("allgather", sendbuf, recvbuf,
                  dt_span_b(sdt, scount),
                  dt_span_b(rdt, (long)rcount * size),
@@ -630,8 +649,7 @@ int MPI_Allgather(const void *sendbuf, int scount, MPI_Datatype sdt,
 int MPI_Alltoall(const void *sendbuf, int scount, MPI_Datatype sdt,
                  void *recvbuf, int rcount, MPI_Datatype rdt,
                  MPI_Comm comm) {
-    int size;
-    MPI_Comm_size(comm, &size);
+    int size = coll_peer_np(comm);
     return mv2t_errcheck(comm, coll2("alltoall", sendbuf, recvbuf,
                  dt_span_b(sdt, (long)scount * size),
                  dt_span_b(rdt, (long)rcount * size),
@@ -641,8 +659,7 @@ int MPI_Alltoall(const void *sendbuf, int scount, MPI_Datatype sdt,
 int MPI_Gather(const void *sendbuf, int scount, MPI_Datatype sdt,
                void *recvbuf, int rcount, MPI_Datatype rdt, int root,
                MPI_Comm comm) {
-    int size;
-    MPI_Comm_size(comm, &size);
+    int size = coll_peer_np(comm);
     return mv2t_errcheck(comm, coll2("gather", sendbuf, recvbuf,
                  dt_span_b(sdt, scount),
                  dt_span_b(rdt, (long)rcount * size),
@@ -652,8 +669,7 @@ int MPI_Gather(const void *sendbuf, int scount, MPI_Datatype sdt,
 int MPI_Scatter(const void *sendbuf, int scount, MPI_Datatype sdt,
                 void *recvbuf, int rcount, MPI_Datatype rdt, int root,
                 MPI_Comm comm) {
-    int size;
-    MPI_Comm_size(comm, &size);
+    int size = coll_peer_np(comm);
     return mv2t_errcheck(comm, coll2("scatter", sendbuf, recvbuf,
                  dt_span_b(sdt, (long)scount * size),
                  dt_span_b(rdt, rcount),
@@ -666,11 +682,14 @@ int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
     if (mv2t_is_userop(op))
         return mv2t_userop_coll(4, sendbuf, recvbuf, rcount, dt, op, 0,
                                 comm);
-    int size;
-    MPI_Comm_size(comm, &size);
+    /* sendbuf holds rcount * LOCAL-group-size elements on both intra
+     * and intercomms (redscatbkinter.c: sendcount = recvcount*size) */
+    int size = comm_np(comm);
     return mv2t_errcheck(comm, coll2("reduce_scatter_block", sendbuf, recvbuf,
                  dt_span_b(dt, (long)rcount * size),
-                 dt_span_b(dt, rcount),
+                 sendbuf == MPI_IN_PLACE
+                     ? dt_span_b(dt, (long)rcount * comm_np(comm))
+                     : dt_span_b(dt, rcount),
                  "(iiii)", rcount, dt, op, comm));
 }
 
@@ -1160,7 +1179,7 @@ int comm_np(MPI_Comm comm) {
 
 /* byte span of a v-collective buffer: displacements stride by extent,
  * but each segment's last element may trail past it (true extent) */
-static long vspan_b(const int *counts, const int *displs, MPI_Datatype dt,
+long vspan_b(const int *counts, const int *displs, MPI_Datatype dt,
                     int n) {
     long m = 0, ext, span1;
     if (!counts)
@@ -1180,7 +1199,7 @@ static long vspan_b(const int *counts, const int *displs, MPI_Datatype dt,
 int MPI_Allgatherv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
                    void *recvbuf, const int recvcounts[],
                    const int displs[], MPI_Datatype rdt, MPI_Comm comm) {
-    int n = comm_np(comm);
+    int n = coll_peer_np(comm);
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *sv = mv_view(sendbuf, dt_span_b(sdt, sendcount));
     PyObject *rv = mv_view(recvbuf, vspan_b(recvcounts, displs, rdt, n));
@@ -1201,7 +1220,7 @@ int MPI_Alltoallv(const void *sendbuf, const int sendcounts[],
                   const int sdispls[], MPI_Datatype sdt, void *recvbuf,
                   const int recvcounts[], const int rdispls[],
                   MPI_Datatype rdt, MPI_Comm comm) {
-    int n = comm_np(comm);
+    int n = coll_peer_np(comm);
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *sv = mv_view(sendbuf, vspan_b(sendcounts, sdispls, sdt, n));
     PyObject *rv = mv_view(recvbuf, vspan_b(recvcounts, rdispls, rdt, n));
@@ -1221,16 +1240,17 @@ int MPI_Alltoallv(const void *sendbuf, const int sendcounts[],
 int MPI_Gatherv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
                 void *recvbuf, const int recvcounts[], const int displs[],
                 MPI_Datatype rdt, int root, MPI_Comm comm) {
-    int n = comm_np(comm);
+    int n = coll_peer_np(comm);
     int me = -1;
     MPI_Comm_rank(comm, &me);
+    int am_root = (me == root || root == MPI_ROOT);
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *sv = mv_view(sendbuf, dt_span_b(sdt, sendcount));
-    PyObject *rv = (me == root)
+    PyObject *rv = am_root
         ? mv_view(recvbuf, vspan_b(recvcounts, displs, rdt, n))
         : mv_view(NULL, 0);
-    PyObject *rc_l = int_list(me == root ? recvcounts : NULL, n);
-    PyObject *dp_l = int_list(me == root ? displs : NULL, n);
+    PyObject *rc_l = int_list(am_root ? recvcounts : NULL, n);
+    PyObject *dp_l = int_list(am_root ? displs : NULL, n);
     PyObject *res = PyObject_CallMethod(g_shim, "gatherv", "(OOiiOOiii)",
                                         sv, rv, sendcount, sdt, rc_l,
                                         dp_l, rdt, root, comm);
@@ -1246,16 +1266,17 @@ int MPI_Scatterv(const void *sendbuf, const int sendcounts[],
                  const int displs[], MPI_Datatype sdt, void *recvbuf,
                  int recvcount, MPI_Datatype rdt, int root,
                  MPI_Comm comm) {
-    int n = comm_np(comm);
+    int n = coll_peer_np(comm);
     int me = -1;
     MPI_Comm_rank(comm, &me);
+    int am_root = (me == root || root == MPI_ROOT);
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *sv = (me == root)
+    PyObject *sv = am_root
         ? mv_view(sendbuf, vspan_b(sendcounts, displs, sdt, n))
         : mv_view(NULL, 0);
     PyObject *rv = mv_view(recvbuf, dt_span_b(rdt, recvcount));
-    PyObject *sc = int_list(me == root ? sendcounts : NULL, n);
-    PyObject *dp = int_list(me == root ? displs : NULL, n);
+    PyObject *sc = int_list(am_root ? sendcounts : NULL, n);
+    PyObject *dp = int_list(am_root ? displs : NULL, n);
     PyObject *res = PyObject_CallMethod(g_shim, "scatterv", "(OOOOiiiii)",
                                         sv, rv, sc, dp, sdt, recvcount,
                                         rdt, root, comm);
@@ -1275,9 +1296,32 @@ int MPI_Reduce_scatter(const void *sendbuf, void *recvbuf,
     MPI_Comm_rank(comm, &me);
     long total = 0;
     for (int i = 0; i < n; i++) total += recvcounts[i];
+    if (mv2t_is_userop(op)) {
+        /* fold via the allgather scheme, then keep my slice */
+        if (total == 0)
+            return MPI_SUCCESS;     /* zero counts: nothing to move */
+        long ext = dt_extent_b(dt);
+        char *tmp = malloc((size_t)total * ext);
+        if (tmp == NULL)
+            return MPI_ERR_INTERN;
+        const void *sb2 = sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf;
+        int rc2 = mv2t_userop_coll(0, sb2, tmp, (int)total, dt, op, 0,
+                                   comm);
+        if (rc2 == MPI_SUCCESS) {
+            long off = 0;
+            for (int i = 0; i < me; i++) off += recvcounts[i];
+            memmove(recvbuf, tmp + off * ext,
+                    (size_t)recvcounts[me] * ext);
+        }
+        free(tmp);
+        return mv2t_errcheck(comm, rc2);
+    }
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *sv = mv_view(sendbuf, dt_span_b(dt, total));
-    PyObject *rv = mv_view(recvbuf, dt_span_b(dt, recvcounts[me]));
+    /* MPI_IN_PLACE: the input is the full `total` array in recvbuf */
+    PyObject *rv = mv_view(recvbuf, sendbuf == MPI_IN_PLACE
+                           ? dt_span_b(dt, total)
+                           : dt_span_b(dt, recvcounts[me]));
     PyObject *rc_l = int_list(recvcounts, n);
     PyObject *res = PyObject_CallMethod(g_shim, "reduce_scatter",
                                         "(OOOiii)", sv, rv, rc_l, dt, op,
@@ -1617,15 +1661,14 @@ int MPI_Errhandler_free(MPI_Errhandler *errhandler) {
 int MPI_Accumulate(const void *origin, int ocount, MPI_Datatype odt,
                    int target_rank, MPI_Aint target_disp, int tcount,
                    MPI_Datatype tdt, MPI_Op op, MPI_Win win) {
-    (void)tcount; (void)tdt;
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *view = mv_view(origin, (long)ocount * dt_size(odt));
-    PyObject *res = PyObject_CallMethod(g_shim, "accumulate", "(iOiiiLi)",
-                                        win, view, ocount, odt,
-                                        target_rank,
-                                        (long long)target_disp, op);
-    int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
-    if (!res) PyErr_Print();
+    PyObject *view = mv_view(origin, dt_span_b(odt, ocount));
+    PyObject *res = PyObject_CallMethod(g_shim, "accumulate",
+                                        "(iOiiiLiii)", win, view, ocount,
+                                        odt, target_rank,
+                                        (long long)target_disp, op,
+                                        tcount, (int)tdt);
+    int rc = res ? MPI_SUCCESS : mv2t_errcode_from_pyerr();
     Py_XDECREF(res);
     Py_XDECREF(view);
     PyGILState_Release(st);
@@ -1703,14 +1746,15 @@ int MPI_Win_sync(MPI_Win win) {
 }
 
 static int rma_op(const char *fn, MPI_Win win, const void *origin,
-                  int count, MPI_Datatype dt, int target, MPI_Aint disp) {
+                  int count, MPI_Datatype dt, int target, MPI_Aint disp,
+                  int tcount, MPI_Datatype tdt) {
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *view = mv_view(origin, dt_span_b(dt, count));
-    PyObject *res = PyObject_CallMethod(g_shim, fn, "(iOiiiL)", win, view,
-                                        count, dt, target,
-                                        (long long)disp);
-    int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
-    if (!res) PyErr_Print();
+    PyObject *res = PyObject_CallMethod(g_shim, fn, "(iOiiiLii)", win,
+                                        view, count, dt, target,
+                                        (long long)disp, tcount,
+                                        (int)tdt);
+    int rc = res ? MPI_SUCCESS : mv2t_errcode_from_pyerr();
     Py_XDECREF(res);
     Py_XDECREF(view);
     PyGILState_Release(st);
@@ -1720,15 +1764,13 @@ static int rma_op(const char *fn, MPI_Win win, const void *origin,
 int MPI_Put(const void *origin, int ocount, MPI_Datatype odt,
             int target_rank, MPI_Aint target_disp, int tcount,
             MPI_Datatype tdt, MPI_Win win) {
-    (void)tcount; (void)tdt;
     return rma_op("put", win, origin, ocount, odt, target_rank,
-                  target_disp);
+                  target_disp, tcount, tdt);
 }
 
 int MPI_Get(void *origin, int ocount, MPI_Datatype odt,
             int target_rank, MPI_Aint target_disp, int tcount,
             MPI_Datatype tdt, MPI_Win win) {
-    (void)tcount; (void)tdt;
     return rma_op("get", win, origin, ocount, odt, target_rank,
-                  target_disp);
+                  target_disp, tcount, tdt);
 }

@@ -201,6 +201,22 @@ int MPI_Comm_set_name(MPI_Comm comm, const char *name) {
     return shim_call_i("comm_set_name", "(is)", comm, name);
 }
 
+int MPI_Win_set_name(MPI_Win win, const char *name) {
+    return shim_call_i("win_set_name", "(is)", win, name);
+}
+
+int MPI_Win_get_name(MPI_Win win, char *name, int *resultlen) {
+    int found;
+    int rc = shim_call_str("win_get_name", name, MPI_MAX_OBJECT_NAME,
+                           &found, "(i)", win);
+    if (rc == MPI_SUCCESS) {
+        if (!found)
+            name[0] = '\0';
+        *resultlen = (int)strlen(name);
+    }
+    return rc;
+}
+
 int MPI_Comm_get_name(MPI_Comm comm, char *name, int *resultlen) {
     int found;
     int rc = shim_call_str("comm_get_name", name, MPI_MAX_OBJECT_NAME,
@@ -1920,10 +1936,11 @@ int MPI_Igather(const void *sendbuf, int sendcount, MPI_Datatype sdt,
     int rank;
     MPI_Comm_rank(comm, &rank);
     PyGILState_STATE st = PyGILState_Ensure();
-    int p = comm_np(comm);
+    int p = coll_peer_np(comm);
     PyObject *sv = mv_view(sendbuf, dt_span_b(sdt, sendcount));
-    /* recvcount/rdt are significant only at the root (MPI-3.1 §5.5) */
-    PyObject *rv = rank == root
+    /* recvcount/rdt are significant only at the root (MPI-3.1 §5.5);
+     * on intercomms the root passes MPI_ROOT */
+    PyObject *rv = (rank == root || root == MPI_ROOT)
         ? mv_view(recvbuf, dt_span_b(rdt, (long)recvcount * p))
         : mv_view(NULL, 0);
     PyObject *res = PyObject_CallMethod(g_shim, "igather", "(OOiiiiii)",
@@ -1942,14 +1959,14 @@ int MPI_Iscatter(const void *sendbuf, int sendcount, MPI_Datatype sdt,
     int rank;
     MPI_Comm_rank(comm, &rank);
     PyGILState_STATE st = PyGILState_Ensure();
-    int p = comm_np(comm);
-    PyObject *sv = rank == root
+    int p = coll_peer_np(comm);
+    PyObject *sv = (rank == root || root == MPI_ROOT)
         ? mv_view(sendbuf, dt_span_b(sdt, (long)sendcount * p))
         : mv_view(NULL, 0);
     PyObject *rv = mv_view(recvbuf, dt_span_b(rdt, recvcount));
-    PyObject *res = PyObject_CallMethod(g_shim, "iscatter", "(OOiiii)",
-                                        sv, rv, recvcount, rdt, root,
-                                        comm);
+    PyObject *res = PyObject_CallMethod(g_shim, "iscatter",
+                                        "(OOiiiiii)", sv, rv, sendcount,
+                                        sdt, recvcount, rdt, root, comm);
     int rc = icoll_req(res, req);
     Py_XDECREF(sv);
     Py_XDECREF(rv);
@@ -2697,7 +2714,7 @@ int MPI_Alltoallw(const void *sendbuf, const int sendcounts[],
                   void *recvbuf, const int recvcounts[],
                   const int rdispls[], const MPI_Datatype recvtypes[],
                   MPI_Comm comm) {
-    int n = comm_np(comm);
+    int n = coll_peer_np(comm);
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *sv = mv_view(sendbuf, wspan(sendcounts, sdispls,
                                           sendtypes, n));
@@ -3082,4 +3099,157 @@ int MPI_Lookup_name(const char *service_name, MPI_Info info,
     if (rc == MPI_SUCCESS && !found)
         return MPI_ERR_NAME;
     return rc;
+}
+
+/* ------------------------------------------------------------------ */
+/* nonblocking v-collectives (MPI-3.0 §5.12; sched-based shim)        */
+/* ------------------------------------------------------------------ */
+
+int MPI_Igatherv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
+                 void *recvbuf, const int recvcounts[],
+                 const int displs[], MPI_Datatype rdt, int root,
+                 MPI_Comm comm, MPI_Request *req) {
+    int n = coll_peer_np(comm);
+    int me = -1;
+    MPI_Comm_rank(comm, &me);
+    int am_root = (me == root || root == MPI_ROOT);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *sv = mv_view(sendbuf, dt_span_b(sdt, sendcount));
+    PyObject *rv = am_root
+        ? mv_view(recvbuf, vspan_b(recvcounts, displs, rdt, n))
+        : mv_view(NULL, 0);
+    PyObject *rc_l = int_list(am_root ? recvcounts : NULL, n);
+    PyObject *dp_l = int_list(am_root ? displs : NULL, n);
+    PyObject *res = PyObject_CallMethod(g_shim, "igatherv", "(OOiiOOiii)",
+                                        sv, rv, sendcount, sdt, rc_l,
+                                        dp_l, rdt, root, comm);
+    int rc = icoll_req(res, req);
+    Py_XDECREF(rc_l); Py_XDECREF(dp_l);
+    Py_XDECREF(sv); Py_XDECREF(rv);
+    PyGILState_Release(st);
+    return mv2t_errcheck(comm, rc);
+}
+
+int MPI_Iscatterv(const void *sendbuf, const int sendcounts[],
+                  const int displs[], MPI_Datatype sdt, void *recvbuf,
+                  int recvcount, MPI_Datatype rdt, int root,
+                  MPI_Comm comm, MPI_Request *req) {
+    int n = coll_peer_np(comm);
+    int me = -1;
+    MPI_Comm_rank(comm, &me);
+    int am_root = (me == root || root == MPI_ROOT);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *sv = am_root
+        ? mv_view(sendbuf, vspan_b(sendcounts, displs, sdt, n))
+        : mv_view(NULL, 0);
+    PyObject *rv = mv_view(recvbuf, dt_span_b(rdt, recvcount));
+    PyObject *sc = int_list(am_root ? sendcounts : NULL, n);
+    PyObject *dp = int_list(am_root ? displs : NULL, n);
+    PyObject *res = PyObject_CallMethod(g_shim, "iscatterv",
+                                        "(OOOOiiiii)", sv, rv, sc, dp,
+                                        sdt, recvcount, rdt, root, comm);
+    int rc = icoll_req(res, req);
+    Py_XDECREF(sc); Py_XDECREF(dp);
+    Py_XDECREF(sv); Py_XDECREF(rv);
+    PyGILState_Release(st);
+    return mv2t_errcheck(comm, rc);
+}
+
+int MPI_Iallgatherv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
+                    void *recvbuf, const int recvcounts[],
+                    const int displs[], MPI_Datatype rdt, MPI_Comm comm,
+                    MPI_Request *req) {
+    int n = coll_peer_np(comm);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *sv = mv_view(sendbuf, dt_span_b(sdt, sendcount));
+    PyObject *rv = mv_view(recvbuf, vspan_b(recvcounts, displs, rdt, n));
+    PyObject *rc_l = int_list(recvcounts, n);
+    PyObject *dp_l = int_list(displs, n);
+    PyObject *res = PyObject_CallMethod(g_shim, "iallgatherv",
+                                        "(OOiiOOii)", sv, rv, sendcount,
+                                        sdt, rc_l, dp_l, rdt, comm);
+    int rc = icoll_req(res, req);
+    Py_XDECREF(rc_l); Py_XDECREF(dp_l);
+    Py_XDECREF(sv); Py_XDECREF(rv);
+    PyGILState_Release(st);
+    return mv2t_errcheck(comm, rc);
+}
+
+int MPI_Ialltoallv(const void *sendbuf, const int sendcounts[],
+                   const int sdispls[], MPI_Datatype sdt, void *recvbuf,
+                   const int recvcounts[], const int rdispls[],
+                   MPI_Datatype rdt, MPI_Comm comm, MPI_Request *req) {
+    int n = coll_peer_np(comm);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *sv = sendbuf == MPI_IN_PLACE ? (Py_INCREF(Py_None), Py_None)
+        : mv_view(sendbuf, vspan_b(sendcounts, sdispls, sdt, n));
+    PyObject *rv = mv_view(recvbuf, vspan_b(recvcounts, rdispls, rdt, n));
+    PyObject *sc = int_list(sendbuf == MPI_IN_PLACE ? NULL : sendcounts,
+                            n);
+    PyObject *sd = int_list(sendbuf == MPI_IN_PLACE ? NULL : sdispls, n);
+    PyObject *rc_l = int_list(recvcounts, n);
+    PyObject *rd = int_list(rdispls, n);
+    PyObject *res = PyObject_CallMethod(g_shim, "ialltoallv",
+                                        "(OOOOOOiii)", sv, rv, sc, sd,
+                                        rc_l, rd, sdt, rdt, comm);
+    int rc = icoll_req(res, req);
+    Py_XDECREF(sc); Py_XDECREF(sd); Py_XDECREF(rc_l); Py_XDECREF(rd);
+    Py_XDECREF(sv); Py_XDECREF(rv);
+    PyGILState_Release(st);
+    return mv2t_errcheck(comm, rc);
+}
+
+int MPI_Ireduce_scatter(const void *sendbuf, void *recvbuf,
+                        const int recvcounts[], MPI_Datatype dt,
+                        MPI_Op op, MPI_Comm comm, MPI_Request *req) {
+    if (mv2t_is_userop(op)) {
+        /* user ops fold on the C side; blocking + completed request */
+        int rc = MPI_Reduce_scatter(sendbuf, recvbuf, recvcounts, dt, op,
+                                    comm);
+        *req = MPI_REQUEST_NULL;
+        return rc;
+    }
+    int n = comm_np(comm);
+    int me = -1;
+    MPI_Comm_rank(comm, &me);
+    long total = 0;
+    for (int i = 0; i < n; i++) total += recvcounts[i];
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *sv = mv_view(sendbuf, dt_span_b(dt, total));
+    PyObject *rv = mv_view(recvbuf, sendbuf == MPI_IN_PLACE
+                           ? dt_span_b(dt, total)
+                           : dt_span_b(dt, recvcounts[me]));
+    PyObject *rc_l = int_list(recvcounts, n);
+    PyObject *res = PyObject_CallMethod(g_shim, "ireduce_scatter",
+                                        "(OOOiii)", sv, rv, rc_l, dt, op,
+                                        comm);
+    int rc = icoll_req(res, req);
+    Py_XDECREF(rc_l); Py_XDECREF(sv); Py_XDECREF(rv);
+    PyGILState_Release(st);
+    return mv2t_errcheck(comm, rc);
+}
+
+int MPI_Ireduce_scatter_block(const void *sendbuf, void *recvbuf,
+                              int recvcount, MPI_Datatype dt, MPI_Op op,
+                              MPI_Comm comm, MPI_Request *req) {
+    if (mv2t_is_userop(op)) {
+        int rc = MPI_Reduce_scatter_block(sendbuf, recvbuf, recvcount,
+                                          dt, op, comm);
+        *req = MPI_REQUEST_NULL;
+        return rc;
+    }
+    /* sendbuf holds rcount * LOCAL size (same as the blocking path) */
+    int size = comm_np(comm);
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *sv = mv_view(sendbuf, dt_span_b(dt, (long)recvcount * size));
+    PyObject *rv = mv_view(recvbuf, sendbuf == MPI_IN_PLACE
+                           ? dt_span_b(dt, (long)recvcount * size)
+                           : dt_span_b(dt, recvcount));
+    PyObject *res = PyObject_CallMethod(g_shim, "ireduce_scatter_block",
+                                        "(OOiiii)", sv, rv, recvcount,
+                                        dt, op, comm);
+    int rc = icoll_req(res, req);
+    Py_XDECREF(sv); Py_XDECREF(rv);
+    PyGILState_Release(st);
+    return mv2t_errcheck(comm, rc);
 }

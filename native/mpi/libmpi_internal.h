@@ -20,6 +20,9 @@ long dt_extent_b(MPI_Datatype dt);
 long dt_span_b(MPI_Datatype dt, long count);
 PyObject *int_list(const int *a, int n);
 int comm_np(MPI_Comm comm);
+int coll_peer_np(MPI_Comm comm);
+long vspan_b(const int counts[], const int displs[], MPI_Datatype dt,
+             int n);
 
 /* hooks implemented in libmpi_ext.c (attribute machinery, user ops) */
 int mv2t_errcode_from_pyerr(void);

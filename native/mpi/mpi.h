@@ -89,6 +89,9 @@ typedef struct MPI_Status {
 #define MPI_C_DOUBLE_COMPLEX        ((MPI_Datatype)34)
 #define MPI_C_LONG_DOUBLE_COMPLEX   ((MPI_Datatype)35)
 #define MPI_CXX_BOOL                ((MPI_Datatype)36)
+/* Fortran complex from C (opsum.c/opprod.c use these names) */
+#define MPI_COMPLEX                 MPI_C_FLOAT_COMPLEX
+#define MPI_DOUBLE_COMPLEX          MPI_C_DOUBLE_COMPLEX
 #define MPI_CXX_FLOAT_COMPLEX       ((MPI_Datatype)37)
 #define MPI_CXX_DOUBLE_COMPLEX      ((MPI_Datatype)38)
 #define MPI_CXX_LONG_DOUBLE_COMPLEX ((MPI_Datatype)39)
@@ -181,6 +184,7 @@ typedef struct MPI_Status {
 #define MPI_ERR_UNSUPPORTED_DATAREP 43
 #define MPI_ERR_UNSUPPORTED_OPERATION 44
 #define MPI_ERR_PORT     27
+#define MPI_ERR_NO_MEM   34
 #define MPI_ERR_NAME     33
 #define MPI_ERR_SERVICE  41
 #define MPI_ERR_SPAWN    42
@@ -464,6 +468,13 @@ int MPI_Unpublish_name(const char *service_name, MPI_Info info,
 int MPI_Lookup_name(const char *service_name, MPI_Info info,
                     char *port_name);
 
+/* RMA synchronization assertions (MPI-3.1 §11.5; advisory here) */
+#define MPI_MODE_NOCHECK    1024
+#define MPI_MODE_NOSTORE    2048
+#define MPI_MODE_NOPUT      4096
+#define MPI_MODE_NOPRECEDE  8192
+#define MPI_MODE_NOSUCCEED 16384
+
 /* predefined attribute keyvals (comm) */
 #define MPI_TAG_UB          1
 #define MPI_HOST            2
@@ -533,6 +544,8 @@ int MPI_Info_get_valuelen(MPI_Info info, const char *key, int *valuelen,
 
 /* ---- communicator extras ---- */
 int MPI_Comm_set_name(MPI_Comm comm, const char *name);
+int MPI_Win_set_name(MPI_Win win, const char *name);
+int MPI_Win_get_name(MPI_Win win, char *name, int *resultlen);
 int MPI_Comm_get_name(MPI_Comm comm, char *name, int *resultlen);
 int MPI_Comm_create_group(MPI_Comm comm, MPI_Group group, int tag,
                           MPI_Comm *newcomm);
@@ -821,6 +834,28 @@ int MPI_Alltoallw(const void *sendbuf, const int sendcounts[],
                   void *recvbuf, const int recvcounts[],
                   const int rdispls[], const MPI_Datatype recvtypes[],
                   MPI_Comm comm);
+int MPI_Igatherv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
+                 void *recvbuf, const int recvcounts[],
+                 const int displs[], MPI_Datatype rdt, int root,
+                 MPI_Comm comm, MPI_Request *req);
+int MPI_Iscatterv(const void *sendbuf, const int sendcounts[],
+                  const int displs[], MPI_Datatype sdt, void *recvbuf,
+                  int recvcount, MPI_Datatype rdt, int root,
+                  MPI_Comm comm, MPI_Request *req);
+int MPI_Iallgatherv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
+                    void *recvbuf, const int recvcounts[],
+                    const int displs[], MPI_Datatype rdt, MPI_Comm comm,
+                    MPI_Request *req);
+int MPI_Ialltoallv(const void *sendbuf, const int sendcounts[],
+                   const int sdispls[], MPI_Datatype sdt, void *recvbuf,
+                   const int recvcounts[], const int rdispls[],
+                   MPI_Datatype rdt, MPI_Comm comm, MPI_Request *req);
+int MPI_Ireduce_scatter(const void *sendbuf, void *recvbuf,
+                        const int recvcounts[], MPI_Datatype dt,
+                        MPI_Op op, MPI_Comm comm, MPI_Request *req);
+int MPI_Ireduce_scatter_block(const void *sendbuf, void *recvbuf,
+                              int recvcount, MPI_Datatype dt, MPI_Op op,
+                              MPI_Comm comm, MPI_Request *req);
 int MPI_Ialltoallw(const void *sendbuf, const int sendcounts[],
                    const int sdispls[], const MPI_Datatype sendtypes[],
                    void *recvbuf, const int recvcounts[],

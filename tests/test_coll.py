@@ -305,3 +305,22 @@ def test_allreduce_two_level_slotted_multichunk():
                 o2, comm.size + sum(range(comm.size)))
 
     run_ranks(4, fn, nodes=[0, 0, 0, 0])
+
+
+def test_scatter_binomial_odd_sizes():
+    """Binomial scatter at sizes where a subtree clips (7, 11, 13):
+    the fan-out width must stay the unclipped power of two or
+    intermediate children starve (the redscatbkinter 7-group hang)."""
+    import numpy as np
+    from mvapich2_tpu import run_ranks
+
+    for p in (7, 11, 13):
+        def app(comm):
+            nb = 512
+            full = np.arange(comm.size * nb, dtype=np.uint8)
+            mine = np.empty(nb, np.uint8)
+            comm.scatter(full if comm.rank == 0 else None, mine,
+                         root=0, count=nb)
+            exp = full[comm.rank * nb:(comm.rank + 1) * nb]
+            assert (mine == exp).all()
+        run_ranks(p, app, timeout=60)
