@@ -210,7 +210,7 @@ def _send_args_b(view, count: int, dtcode: int):
     absolute addresses at post time (MPI forbids touching the send
     buffer until completion, so the gathered snapshot is the message —
     valid for every send mode, including nonblocking posts)."""
-    if view is None and dtcode >= _DERIVED_BASE:
+    if not view and dtcode >= _DERIVED_BASE:
         return _bottom_gather(count, dtcode), {}
     return _send_args(view, count, dtcode)
 
@@ -427,7 +427,7 @@ def send(view, count: int, dtcode: int, dest: int, tag: int,
 def recv(view, count: int, dtcode: int, source: int, tag: int,
          ch: int):
     """Returns (source, tag, count_bytes)."""
-    if view is None and dtcode >= _DERIVED_BASE:
+    if not view and dtcode >= _DERIVED_BASE:
         tmp = _bottom_tmp(count, dtcode)
         st = _comm(ch).recv(tmp, source, tag)
         _bottom_scatter(tmp, count, dtcode)
@@ -452,7 +452,7 @@ def isend(view, count: int, dtcode: int, dest: int, tag: int,
 def irecv(view, count: int, dtcode: int, source: int, tag: int,
           ch: int) -> int:
     global _next_req
-    if view is None and dtcode >= _DERIVED_BASE:
+    if not view and dtcode >= _DERIVED_BASE:
         tmp = _bottom_tmp(count, dtcode)
         r = _BottomRecvReq(_comm(ch).irecv(tmp, source, tag), tmp,
                            count, dtcode)
@@ -827,8 +827,14 @@ def _dt_obj(dtcode: int):
 
 
 def _rma_args(oview, count: int, dtcode: int):
-    """(buf, kwargs) for a window op honoring derived origin types."""
+    """(buf, kwargs) for a window op honoring derived origin types.
+    A NULL origin with a derived (absolute-typemap) type is MPI_BOTTOM:
+    gather the bytes from absolute addresses (rma/put_bottom.c)."""
     if dtcode >= _DERIVED_BASE:
+        if not oview:
+            # MPI_BOTTOM origin: gather the packed bytes from absolute
+            # addresses; the op then runs on contiguous BYTE data
+            return _bottom_gather(count, dtcode), {}
         return (np.frombuffer(oview, np.uint8),
                 {"count": count, "origin_dt": _derived[dtcode]})
     return _arr(oview, count, dtcode), {}
@@ -851,6 +857,8 @@ def get(wh: int, oview, count: int, dtcode: int, target: int,
         kw["target_dt"] = _dt_obj(tdtcode)
         kw["target_count"] = tcount if tcount >= 0 else count
     _wins[wh].get(buf, target, tdisp, **kw)
+    if not oview and dtcode >= _DERIVED_BASE and count:
+        _bottom_scatter(buf, count, dtcode)   # MPI_BOTTOM destination
     return 0
 
 
@@ -923,7 +931,7 @@ def iprobe(source: int, tag: int, ch: int):
 # ---------------------------------------------------------------------------
 
 def _reject_bottom_persistent(view, count, dtcode):
-    if view is None and dtcode >= _DERIVED_BASE and count:
+    if not view and dtcode >= _DERIVED_BASE and count:
         from .core.errors import MPI_ERR_BUFFER
         raise MPIException(MPI_ERR_BUFFER,
                            "MPI_BOTTOM with persistent requests is not "
@@ -1467,6 +1475,16 @@ def accumulate(wh: int, oview, count: int, dtcode: int, target: int,
 
 def get_accumulate(wh: int, oview, rview, count: int, dtcode: int,
                    target: int, tdisp: int, opcode: int) -> int:
+    if dtcode >= _DERIVED_BASE:
+        d = _derived[dtcode]
+        obuf = (np.frombuffer(oview, np.uint8) if oview else
+                np.zeros(count * d.size, np.uint8))
+        rbuf = np.empty(count * d.size, np.uint8)
+        _wins[wh].get_accumulate(obuf, rbuf, target, tdisp,
+                                 op=_OPS[opcode], count=count,
+                                 origin_dt=d, target_dt=d)
+        _scatter_out(rview, 0, count, dtcode, rbuf)
+        return 0
     obuf = _arr(oview, count, dtcode) if oview else \
         np.zeros(count, _DTYPES[dtcode])
     rbuf = _arr(rview, count, dtcode)
@@ -3043,3 +3061,47 @@ def ireduce_scatter_block(sview, rview, rcount: int, dtcode: int,
     if wb is not None:
         req.add_callback(lambda _r: wb())
     return _new_req(req)
+
+
+# ---------------------------------------------------------------------------
+# RMA surface extensions: shared windows, PSCW introspection, flavors
+# ---------------------------------------------------------------------------
+
+def win_allocate_shared(size: int, disp_unit: int, ch: int):
+    """Returns (win_handle, base_memoryview) — base lives in the
+    cross-process shared segment (rma/win.py win_allocate_shared)."""
+    global _next_win
+    w = _comm(ch).win_allocate_shared(size, disp_unit=disp_unit)
+    with _lock:
+        h = _next_win
+        _next_win += 1
+        _wins[h] = w
+    base = w.base if w.base is not None and len(w.base) else \
+        np.empty(0, np.uint8)
+    return (h, memoryview(base))
+
+
+def win_shared_query(wh: int, rank: int):
+    """(size, disp_unit, segment_memoryview) of rank's shared segment."""
+    seg, size, du = _wins[wh].shared_query(rank)
+    return (size, du, memoryview(seg))
+
+
+def win_get_group(wh: int) -> int:
+    return _new_group_handle(_wins[wh].comm.group)
+
+
+def win_test(wh: int) -> int:
+    return 1 if _wins[wh].test() else 0
+
+
+def win_flavor(wh: int) -> int:
+    return int(_wins[wh].flavor)
+
+
+def completed_request() -> int:
+    """An already-complete request handle (R-variant RMA ops complete
+    locally at call time but must still return a waitable request —
+    rma/reqops.c asserts it is not MPI_REQUEST_NULL)."""
+    from .core.request import CompletedRequest
+    return _new_req(CompletedRequest())

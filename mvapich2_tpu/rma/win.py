@@ -61,6 +61,19 @@ def _ser_dt(dt: Datatype) -> dict:
             "basic": (dt.basic.str if dt.basic is not None else None)}
 
 
+def _dt_span(dt: Datatype, count: int) -> int:
+    """Bytes the target region must cover for `count` extent-strided
+    elements — true-extent aware (the last element may trail past the
+    extent: transpose2's vector-of-vector target)."""
+    if count <= 0:
+        return 0
+    sp = np.asarray(dt.spans, dtype=np.int64).reshape(-1, 2)
+    if sp.size == 0:
+        return count * dt.extent
+    hi = int((sp[:, 0] + sp[:, 1]).max())
+    return (count - 1) * dt.extent + max(hi, dt.extent)
+
+
 def _deser_dt(d: dict) -> Datatype:
     return Datatype([tuple(s) for s in d["spans"]], d["extent"], d["lb"],
                     np.dtype(d["basic"]) if d["basic"] else None,
@@ -171,9 +184,14 @@ class Win:
             raise MPIException(MPI_ERR_RMA_SYNC,
                                f"target {target} is not locked")
 
-    def _check_target(self, rank: int) -> None:
+    def _check_target(self, rank: int) -> bool:
+        """False = MPI_PROC_NULL (the op is a no-op, MPI-3.1 §11.3)."""
+        from ..core.status import PROC_NULL
+        if rank == PROC_NULL:
+            return False
         if not (0 <= rank < self.comm.size):
             raise MPIException(MPI_ERR_RANK, f"bad target rank {rank}")
+        return True
 
     def _send(self, target: int, pkt: Packet) -> None:
         self._send_world(self.comm.world_of(target), pkt)
@@ -195,7 +213,8 @@ class Win:
              count: Optional[int] = None, origin_dt: Optional[Datatype] = None,
              target_dt: Optional[Datatype] = None,
              target_count: Optional[int] = None) -> Request:
-        self._check_target(target_rank)
+        if not self._check_target(target_rank):
+            return CompletedRequest()
         self._need_access_epoch(target_rank)
         odt, cnt = _resolve_dt(origin, count, origin_dt)
         tdt = target_dt or odt
@@ -221,7 +240,8 @@ class Win:
              count: Optional[int] = None, origin_dt: Optional[Datatype] = None,
              target_dt: Optional[Datatype] = None,
              target_count: Optional[int] = None) -> Request:
-        self._check_target(target_rank)
+        if not self._check_target(target_rank):
+            return CompletedRequest()
         self._need_access_epoch(target_rank)
         odt, cnt = _resolve_dt(origin, count, origin_dt)
         tdt = target_dt or odt
@@ -249,7 +269,8 @@ class Win:
                     origin_dt: Optional[Datatype] = None,
                     target_dt: Optional[Datatype] = None,
                     target_count: Optional[int] = None) -> Request:
-        self._check_target(target_rank)
+        if not self._check_target(target_rank):
+            return CompletedRequest()
         self._need_access_epoch(target_rank)
         odt, cnt = _resolve_dt(origin, count, origin_dt)
         tdt = target_dt or odt
@@ -277,7 +298,8 @@ class Win:
                         op: opmod.Op = opmod.SUM,
                         origin_dt: Optional[Datatype] = None,
                         target_dt: Optional[Datatype] = None) -> Request:
-        self._check_target(target_rank)
+        if not self._check_target(target_rank):
+            return CompletedRequest()
         self._need_access_epoch(target_rank)
         odt, cnt = _resolve_dt(result, count, origin_dt)
         tdt = target_dt or odt
@@ -306,7 +328,8 @@ class Win:
     def compare_and_swap(self, origin, compare, result, target_rank: int,
                          target_disp: int = 0,
                          datatype: Optional[Datatype] = None) -> None:
-        self._check_target(target_rank)
+        if not self._check_target(target_rank):
+            return None              # PROC_NULL: no-op, result untouched
         self._need_access_epoch(target_rank)
         dt, _ = _resolve_dt(origin, 1, datatype)
         req = _GetRequest(self.u.engine, result, 1, dt)
@@ -395,7 +418,12 @@ class Win:
     # ------------------------------------------------------------------
     def lock(self, rank: int, lock_type: int = LOCK_SHARED,
              assertion: int = 0) -> None:
-        self._check_target(rank)
+        if not self._check_target(rank):
+            # PROC_NULL epoch: legal and empty (rmanull.c) — track it so
+            # the matching unlock is accepted
+            self._locked_targets[rank] = lock_type
+            self.epoch = "lock"
+            return
         req = _LockRequest(self.u.engine)
         with self.u.engine.mutex:
             self.u.engine.track(req)
@@ -410,6 +438,11 @@ class Win:
     def unlock(self, rank: int) -> None:
         mpi_assert(rank in self._locked_targets, MPI_ERR_RMA_SYNC,
                    f"unlock of unlocked target {rank}")
+        if not self._check_target(rank):      # PROC_NULL: empty epoch
+            del self._locked_targets[rank]
+            if not self._locked_targets:
+                self.epoch = None
+            return
         # UNLOCK is ordered after all my ops on this channel, and its ack
         # confirms both application and lock release (flush semantics).
         self._await_acks(rank, PktType.RMA_UNLOCK)
@@ -429,6 +462,8 @@ class Win:
             self.unlock(r)
 
     def flush(self, rank: int) -> None:
+        if not self._check_target(rank):
+            return
         self._await_acks(rank, PktType.RMA_FLUSH)
 
     def flush_all(self) -> None:
@@ -473,8 +508,10 @@ class Win:
         """(memory view, size, disp_unit) of ``rank``'s segment."""
         mpi_assert(self.flavor == FLAVOR_SHARED, MPI_ERR_WIN,
                    "shared_query on non-shared window")
-        if rank == -1:   # MPI_PROC_NULL: lowest rank with a nonzero segment
-            rank = min(r for r, (_, sz) in self._peers.items() if sz > 0)
+        from ..core.status import PROC_NULL
+        if rank == PROC_NULL:   # lowest rank with a nonzero segment
+            nz = [r for r, (_, sz) in self._peers.items() if sz > 0]
+            rank = min(nz) if nz else 0
         off, size = self._peers[rank]
         seg = np.frombuffer(self._shm.buf, dtype=np.uint8)
         return seg[off:off + size], size, self.disp_unit
@@ -615,8 +652,7 @@ class RmaManager:
         win = self._win(pkt)
         tdt = _deser_dt(pkt.extra["tdt"])
         cnt = pkt.extra["count"]
-        region = win._region(pkt.extra["disp"], tdt.extent * cnt
-                             if cnt else 0)
+        region = win._region(pkt.extra["disp"], _dt_span(tdt, cnt))
         if cnt:
             tdt.unpack(pkt.data, region, cnt)
 
@@ -624,8 +660,7 @@ class RmaManager:
         win = self._win(pkt)
         tdt = _deser_dt(pkt.extra["tdt"])
         cnt = pkt.extra["count"]
-        region = win._region(pkt.extra["disp"], tdt.extent * cnt
-                             if cnt else 0)
+        region = win._region(pkt.extra["disp"], _dt_span(tdt, cnt))
         data = np.asarray(tdt.pack(region, cnt)) if cnt else \
             np.empty(0, np.uint8)
         self._reply(pkt, Packet(PktType.RMA_GET_RESP, self.u.world_rank,
@@ -644,8 +679,7 @@ class RmaManager:
         tdt = _deser_dt(pkt.extra["tdt"])
         cnt = pkt.extra["count"]
         op = _op_by_name(pkt.extra["op"])
-        region = win._region(pkt.extra["disp"], tdt.extent * cnt
-                             if cnt else 0)
+        region = win._region(pkt.extra["disp"], _dt_span(tdt, cnt))
         old = np.asarray(tdt.pack(region, cnt)) if cnt else \
             np.empty(0, np.uint8)
         if cnt and op is not opmod.NO_OP and pkt.nbytes:

@@ -725,8 +725,24 @@ int MPI_Win_set_attr(MPI_Win win, int keyval, void *attribute_val) {
     return attr_set(1, win, keyval, attribute_val);
 }
 
+static int g_win_flavor, g_win_model;
+
 int MPI_Win_get_attr(MPI_Win win, int keyval, void *attribute_val,
                      int *flag) {
+    if (keyval == MPI_WIN_CREATE_FLAVOR) {
+        int ok;
+        long f = shim_call_v("win_flavor", &ok, "(i)", win);
+        g_win_flavor = ok ? (int)f : MPI_WIN_FLAVOR_CREATE;
+        *(int **)attribute_val = &g_win_flavor;
+        *flag = 1;
+        return MPI_SUCCESS;
+    }
+    if (keyval == MPI_WIN_MODEL) {
+        g_win_model = MPI_WIN_UNIFIED;   /* shm-coherent host memory */
+        *(int **)attribute_val = &g_win_model;
+        *flag = 1;
+        return MPI_SUCCESS;
+    }
     if (keyval == MPI_WIN_BASE || keyval == MPI_WIN_SIZE
         || keyval == MPI_WIN_DISP_UNIT) {
         for (win_info *w = g_wininfo; w != NULL; w = w->next) {
@@ -2662,32 +2678,40 @@ int MPI_Dist_graph_neighbors(MPI_Comm comm, int maxindegree,
 /* request-based RMA: blocking op + pre-completed request              */
 /* ------------------------------------------------------------------ */
 
+static int mv2t_rma_req(int rc, MPI_Request *req) {
+    if (rc != MPI_SUCCESS) {
+        *req = MPI_REQUEST_NULL;
+        return rc;
+    }
+    int ok;
+    long h = shim_call_v("completed_request", &ok, "()");
+    *req = ok ? (MPI_Request)h : MPI_REQUEST_NULL;
+    return MPI_SUCCESS;
+}
+
 int MPI_Rput(const void *origin, int origin_count, MPI_Datatype odt,
              int target_rank, MPI_Aint target_disp, int target_count,
              MPI_Datatype tdt, MPI_Win win, MPI_Request *req) {
-    int rc = MPI_Put(origin, origin_count, odt, target_rank, target_disp,
-                     target_count, tdt, win);
-    *req = MPI_REQUEST_NULL;
-    return rc;
+    return mv2t_rma_req(MPI_Put(origin, origin_count, odt, target_rank,
+                                target_disp, target_count, tdt, win),
+                        req);
 }
 
 int MPI_Rget(void *origin, int origin_count, MPI_Datatype odt,
              int target_rank, MPI_Aint target_disp, int target_count,
              MPI_Datatype tdt, MPI_Win win, MPI_Request *req) {
-    int rc = MPI_Get(origin, origin_count, odt, target_rank, target_disp,
-                     target_count, tdt, win);
-    *req = MPI_REQUEST_NULL;
-    return rc;
+    return mv2t_rma_req(MPI_Get(origin, origin_count, odt, target_rank,
+                                target_disp, target_count, tdt, win),
+                        req);
 }
 
 int MPI_Raccumulate(const void *origin, int origin_count, MPI_Datatype odt,
                     int target_rank, MPI_Aint target_disp,
                     int target_count, MPI_Datatype tdt, MPI_Op op,
                     MPI_Win win, MPI_Request *req) {
-    int rc = MPI_Accumulate(origin, origin_count, odt, target_rank,
-                            target_disp, target_count, tdt, op, win);
-    *req = MPI_REQUEST_NULL;
-    return rc;
+    return mv2t_rma_req(MPI_Accumulate(origin, origin_count, odt,
+                                       target_rank, target_disp,
+                                       target_count, tdt, op, win), req);
 }
 
 /* ------------------------------------------------------------------ */
@@ -3252,4 +3276,124 @@ int MPI_Ireduce_scatter_block(const void *sendbuf, void *recvbuf,
     Py_XDECREF(sv); Py_XDECREF(rv);
     PyGILState_Release(st);
     return mv2t_errcheck(comm, rc);
+}
+
+/* ------------------------------------------------------------------ */
+/* RMA surface extensions: shared windows, PSCW introspection,        */
+/* request-returning gacc, info, Aint arithmetic (MPI-3.1 §11)        */
+/* ------------------------------------------------------------------ */
+
+int MPI_Win_allocate_shared(MPI_Aint size, int disp_unit, MPI_Info info,
+                            MPI_Comm comm, void *baseptr, MPI_Win *win) {
+    (void)info;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "win_allocate_shared",
+                                        "(Lii)", (long long)size,
+                                        disp_unit, comm);
+    int rc = MPI_ERR_OTHER;
+    if (res) {
+        int h;
+        PyObject *mv;
+        if (PyArg_ParseTuple(res, "iO", &h, &mv)) {
+            *win = h;
+            Py_buffer b;
+            if (PyObject_GetBuffer(mv, &b, PyBUF_SIMPLE) == 0) {
+                *(void **)baseptr = b.buf;
+                PyBuffer_Release(&b);
+                mv2t_win_record(h, *(void **)baseptr, size, disp_unit);
+                rc = MPI_SUCCESS;
+            }
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Win_shared_query(MPI_Win win, int rank, MPI_Aint *size,
+                         int *disp_unit, void *baseptr) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "win_shared_query",
+                                        "(ii)", win, rank);
+    int rc = MPI_ERR_OTHER;
+    if (res) {
+        long long sz;
+        int du;
+        PyObject *mv;
+        if (PyArg_ParseTuple(res, "LiO", &sz, &du, &mv)) {
+            Py_buffer b;
+            if (PyObject_GetBuffer(mv, &b, PyBUF_SIMPLE) == 0) {
+                *(void **)baseptr = b.buf;
+                PyBuffer_Release(&b);
+                *size = (MPI_Aint)sz;
+                *disp_unit = du;
+                rc = MPI_SUCCESS;
+            }
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Win_get_group(MPI_Win win, MPI_Group *group) {
+    int ok;
+    long g = shim_call_v("win_get_group", &ok, "(i)", win);
+    if (!ok) {
+        *group = MPI_GROUP_NULL;
+        return mv2t_last_errclass;
+    }
+    *group = (MPI_Group)g;
+    return MPI_SUCCESS;
+}
+
+int MPI_Win_test(MPI_Win win, int *flag) {
+    int ok;
+    long f = shim_call_v("win_test", &ok, "(i)", win);
+    if (!ok)
+        return mv2t_last_errclass;
+    *flag = (int)f;
+    return MPI_SUCCESS;
+}
+
+int MPI_Rget_accumulate(const void *origin, int ocount, MPI_Datatype odt,
+                        void *result, int rcount, MPI_Datatype rdt,
+                        int target_rank, MPI_Aint target_disp, int tcount,
+                        MPI_Datatype tdt, MPI_Op op, MPI_Win win,
+                        MPI_Request *req) {
+    return mv2t_rma_req(MPI_Get_accumulate(origin, ocount, odt, result,
+                                           rcount, rdt, target_rank,
+                                           target_disp, tcount, tdt, op,
+                                           win), req);
+}
+
+int MPI_Win_set_info(MPI_Win win, MPI_Info info) {
+    (void)win; (void)info;   /* hints are advisory (MPI-3.1 §11.2.7) */
+    return MPI_SUCCESS;
+}
+
+int MPI_Win_get_info(MPI_Win win, MPI_Info *info_used) {
+    (void)win;
+    int rc = MPI_Info_create(info_used);
+    if (rc != MPI_SUCCESS)
+        return rc;
+    /* the standard hint set with our actual values (win_info.c reads
+     * these back; locks always work, accumulates are fully ordered) */
+    MPI_Info_set(*info_used, "no_locks", "false");
+    MPI_Info_set(*info_used, "accumulate_ordering", "rar,raw,war,waw");
+    MPI_Info_set(*info_used, "accumulate_ops", "same_op_no_op");
+    MPI_Info_set(*info_used, "alloc_shared_noncontig", "false");
+    return MPI_SUCCESS;
+}
+
+MPI_Aint MPI_Aint_add(MPI_Aint base, MPI_Aint disp) {
+    return (MPI_Aint)((char *)base + disp);
+}
+
+MPI_Aint MPI_Aint_diff(MPI_Aint addr1, MPI_Aint addr2) {
+    return (MPI_Aint)((char *)addr1 - (char *)addr2);
 }

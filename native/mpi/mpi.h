@@ -487,6 +487,15 @@ int MPI_Lookup_name(const char *service_name, MPI_Info info,
 #define MPI_WIN_BASE        8
 #define MPI_WIN_SIZE        9
 #define MPI_WIN_DISP_UNIT   10
+#define MPI_WIN_CREATE_FLAVOR 11
+#define MPI_WIN_MODEL       12
+/* window flavors / memory models (MPI-3.1 §11.2.2) */
+#define MPI_WIN_FLAVOR_CREATE   1
+#define MPI_WIN_FLAVOR_ALLOCATE 2
+#define MPI_WIN_FLAVOR_DYNAMIC  3
+#define MPI_WIN_FLAVOR_SHARED   4
+#define MPI_WIN_SEPARATE 1
+#define MPI_WIN_UNIFIED  2
 #define MPI_KEYVAL_INVALID  (-1)
 
 /* MPI_Comm_split_type */
@@ -545,6 +554,21 @@ int MPI_Info_get_valuelen(MPI_Info info, const char *key, int *valuelen,
 /* ---- communicator extras ---- */
 int MPI_Comm_set_name(MPI_Comm comm, const char *name);
 int MPI_Win_set_name(MPI_Win win, const char *name);
+int MPI_Win_allocate_shared(MPI_Aint size, int disp_unit, MPI_Info info,
+                            MPI_Comm comm, void *baseptr, MPI_Win *win);
+int MPI_Win_shared_query(MPI_Win win, int rank, MPI_Aint *size,
+                         int *disp_unit, void *baseptr);
+int MPI_Win_get_group(MPI_Win win, MPI_Group *group);
+int MPI_Win_test(MPI_Win win, int *flag);
+int MPI_Rget_accumulate(const void *origin, int ocount, MPI_Datatype odt,
+                        void *result, int rcount, MPI_Datatype rdt,
+                        int target_rank, MPI_Aint target_disp, int tcount,
+                        MPI_Datatype tdt, MPI_Op op, MPI_Win win,
+                        MPI_Request *req);
+int MPI_Win_set_info(MPI_Win win, MPI_Info info);
+int MPI_Win_get_info(MPI_Win win, MPI_Info *info_used);
+MPI_Aint MPI_Aint_add(MPI_Aint base, MPI_Aint disp);
+MPI_Aint MPI_Aint_diff(MPI_Aint addr1, MPI_Aint addr2);
 int MPI_Win_get_name(MPI_Win win, char *name, int *resultlen);
 int MPI_Comm_get_name(MPI_Comm comm, char *name, int *resultlen);
 int MPI_Comm_create_group(MPI_Comm comm, MPI_Group group, int tag,
