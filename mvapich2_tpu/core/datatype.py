@@ -192,11 +192,34 @@ class Datatype:
 
     def to_numpy(self, buf, count: int) -> np.ndarray:
         """Pack and view as the basic dtype (for reductions)."""
-        b = self.pack(buf, count)
+        b = np.asarray(self.pack(buf, count))
         if self.basic is None:
             raise MPIException(MPI_ERR_TYPE,
                                "heterogeneous datatype in reduction")
+        if self.basic.itemsize != _sig_size(self):
+            # padded view dtype (pair types): place each packed
+            # signature into an aligned element
+            n = b.size // _sig_size(self)
+            out = np.zeros(n, dtype=self.basic)
+            out.view(np.uint8).reshape(n, self.basic.itemsize)[
+                :, :_sig_size(self)] = b.reshape(n, _sig_size(self))
+            return out
         return b.view(self.basic)
+
+    def from_basic_array(self, arr: np.ndarray) -> np.ndarray:
+        """Inverse of :meth:`to_numpy`: aligned elements -> packed
+        signature bytes."""
+        if self.basic is not None and \
+                self.basic.itemsize != _sig_size(self):
+            n = arr.size
+            return np.ascontiguousarray(
+                arr.view(np.uint8).reshape(n, self.basic.itemsize)
+                [:, :_sig_size(self)]).reshape(-1)
+        return np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
+
+
+def _sig_size(d: "Datatype") -> int:
+    return d.size
 
 
 def _merge_spans(spans) -> np.ndarray:
@@ -300,16 +323,26 @@ COUNT = _basic(np.int64, "MPI_COUNT")
 COMPLEX = _basic(np.complex64, "MPI_COMPLEX")
 DOUBLE_COMPLEX = _basic(np.complex128, "MPI_DOUBLE_COMPLEX")
 
-# pair types for MINLOC/MAXLOC
-FLOAT_INT = Datatype([(0, 8)], 8, 0,
-                     np.dtype([("val", np.float32), ("loc", np.int32)]),
-                     "MPI_FLOAT_INT", True)
-DOUBLE_INT = Datatype([(0, 16)], 16, 0,
-                      np.dtype([("val", np.float64), ("loc", np.int64)]),
-                      "MPI_DOUBLE_INT", True)
-TWOINT = Datatype([(0, 8)], 8, 0,
-                  np.dtype([("val", np.int32), ("loc", np.int32)]),
-                  "MPI_2INT", True)
+# pair types for MINLOC/MAXLOC. Layout matches the C structs
+# (pairtype-size-extent.c): the type SIGNATURE covers val+loc (size),
+# the EXTENT includes the struct's trailing alignment padding, and the
+# numpy view dtype mirrors the aligned C layout so arrays built from
+# .basic stride exactly like C arrays of the struct.
+def _pair(val_np, loc_np, extent, name):
+    v, l = np.dtype(val_np), np.dtype(loc_np)
+    basic = np.dtype({"names": ["val", "loc"], "formats": [v, l],
+                      "offsets": [0, v.itemsize], "itemsize": extent})
+    return Datatype([(0, v.itemsize + l.itemsize)], extent, 0, basic,
+                    name, True)
+
+
+FLOAT_INT = _pair(np.float32, np.int32, 8, "MPI_FLOAT_INT")
+DOUBLE_INT = _pair(np.float64, np.int32, 16, "MPI_DOUBLE_INT")
+TWOINT = _pair(np.int32, np.int32, 8, "MPI_2INT")
+LONG_INT = _pair(np.int64, np.int32, 16, "MPI_LONG_INT")
+SHORT_INT = _pair(np.int16, np.int32, 8, "MPI_SHORT_INT")
+LONG_DOUBLE_INT = _pair(np.float128, np.int32, 32,
+                        "MPI_LONG_DOUBLE_INT")
 
 _NP_TO_MPI = {}
 for _t in (BYTE, SHORT, INT, LONG, FLOAT, DOUBLE, HALF, C_BOOL,
@@ -476,8 +509,20 @@ def create_struct(blocklengths: Sequence[int], disp_bytes: Sequence[int],
     min_lb = min((d + t.lb for d, _, t in real), default=0)
     max_ub = max((d + (bl - 1) * t.extent + t.ub for d, bl, t in real),
                  default=0)
+    # alignment epsilon (MPI-3.1 §4.1.6 advice / the MPICH rule): the
+    # extent is padded to the strictest member alignment, so an array
+    # of the type strides like the corresponding C struct
+    # (structpack2.c compares extent against sizeof)
+    align = 1
+    for _, _, t in real:
+        b = t.basic
+        a = b.alignment if b is not None and hasattr(b, "alignment") \
+            else 8
+        align = max(align, a)
+    extent = max_ub - min_lb
+    extent += (-extent) % align
     return _env(
-        Datatype(spans, max_ub - min_lb, min_lb, basic,
+        Datatype(spans, extent, min_lb, basic,
                  f"struct({len(types)})"),
         "struct", [len(types)] + list(blocklengths), list(disp_bytes),
         list(types))
@@ -648,3 +693,64 @@ def _extent_of(spans, oldtype: Datatype) -> int:
 
 
 DATATYPE_NULL = Datatype([], 0, 0, None, "MPI_DATATYPE_NULL", False)
+
+
+def element_size_seq(dt: "Datatype", cap: int = 8192):
+    """The type signature as a sequence of basic-item byte sizes, in
+    typemap order — what MPI_Get_elements counts (§4.1.5). Homogeneous
+    types collapse to (basic_size, n_items); heterogeneous types walk
+    the constructor envelope. Returns None past `cap` items (callers
+    fall back to uniform division)."""
+    if dt.basic is not None and dt.basic.names is None:
+        esz = dt.basic.itemsize
+        return [esz] * min(dt.size // esz, cap) \
+            if dt.size // esz <= cap else None
+    if dt.basic is not None and dt.basic.names is not None:
+        # pair struct: val + loc items
+        return [dt.basic.fields[n][0].itemsize for n in dt.basic.names]
+    env = getattr(dt, "_envelope", None)
+    if env is None:
+        return None
+    combiner, ints, aints, types = env
+    def sub(t):
+        return element_size_seq(t, cap)
+    if combiner in ("dup", "resized"):
+        return sub(types[0])
+    if combiner == "contiguous":
+        inner = sub(types[0])
+        if inner is None or len(inner) * ints[0] > cap:
+            return None
+        return inner * ints[0]
+    if combiner in ("vector", "hvector"):
+        count, blocklen = ints[0], ints[1]
+        inner = sub(types[0])
+        if inner is None or len(inner) * count * blocklen > cap:
+            return None
+        return inner * blocklen * count
+    if combiner in ("indexed", "hindexed", "indexed_block",
+                    "hindexed_block"):
+        inner = sub(types[0])
+        if inner is None:
+            return None
+        if combiner == "indexed_block" or combiner == "hindexed_block":
+            blens = [ints[1]] * ints[0]
+        else:
+            blens = ints[1:1 + ints[0]]
+        total = sum(blens)
+        if len(inner) * total > cap:
+            return None
+        out = []
+        for b in blens:
+            out.extend(inner * b)
+        return out
+    if combiner == "struct":
+        n = ints[0]
+        blens = ints[1:1 + n]
+        out = []
+        for b, t in zip(blens, types):
+            inner = sub(t)
+            if inner is None or len(out) + len(inner) * b > cap:
+                return None
+            out.extend(inner * b)
+        return out
+    return None

@@ -96,10 +96,16 @@ _derived: Dict[int, dt.Datatype] = {}
 _next_derived = _DERIVED_BASE
 
 
+_PAIR_DT = {14: dt.FLOAT_INT, 15: dt.DOUBLE_INT, 16: dt.LONG_INT,
+            17: dt.TWOINT, 18: dt.SHORT_INT, 19: dt.LONG_DOUBLE_INT}
+
+
 def _dt(code: int) -> dt.Datatype:
     """Datatype object for a C handle (builtin enum or derived)."""
     if code >= _DERIVED_BASE:
         return _derived[code]
+    if code in _PAIR_DT:      # size 12 != extent 16 etc. (§5.9.4 pairs)
+        return _PAIR_DT[code]
     return dt.from_numpy_dtype(_DTYPES[code])
 
 _lock = threading.Lock()
@@ -1413,8 +1419,14 @@ def type_commit(code: int) -> int:
 
 
 def type_free(code: int) -> int:
+    """MPI_Type_free: the user handle dies, but operations posted with
+    the type may still be in flight (MPI-3.1 §4.1.9 reference
+    semantics) — keep the definition; only attributes are dropped.
+    (indexed-misc.c frees types whose sends are still pending.)"""
     with _lock:
-        _derived.pop(code, None)
+        d = _derived.get(code)
+        if d is not None:
+            d._freed = True
     return 0
 
 
@@ -1439,7 +1451,8 @@ def type_span(code: int, count: int) -> int:
 
 _COMBINERS = {"named": 0, "contiguous": 1, "vector": 2, "hvector": 3,
               "indexed": 4, "hindexed": 5, "struct": 6, "subarray": 7,
-              "resized": 8, "indexed_block": 9, "dup": 10}
+              "resized": 8, "indexed_block": 9, "dup": 10,
+              "hindexed_block": 11, "darray": 12}
 
 
 def type_get_envelope(code: int):
@@ -1766,8 +1779,15 @@ def type_create_darray(size: int, rank: int, gsizes, distribs, dargs,
 
 
 def type_hindexed_block(blocklength: int, disp_bytes, oldcode: int) -> int:
-    return type_hindexed([blocklength] * len(list(disp_bytes)),
+    disp_bytes = list(disp_bytes)
+    code = type_hindexed([blocklength] * len(disp_bytes),
                          disp_bytes, oldcode)
+    # the envelope must reflect HINDEXED_BLOCK with ints
+    # [count, blocklength] (hindexed_block_contents.c checks ni == 2)
+    d = _derived[code]
+    d._envelope = ("hindexed_block", [len(disp_bytes), blocklength],
+                   disp_bytes, [_dt(oldcode)])
+    return code
 
 
 _type_names: Dict[int, str] = {}
@@ -2779,12 +2799,18 @@ def _fill_errcodes(view, errcodes) -> None:
 
 
 def comm_spawn(ch: int, command: str, argv_us: str, maxprocs: int,
-               root: int, errcodes_view=None) -> int:
+               root: int, errcodes_view=None, wd: str = "",
+               path: str = "") -> int:
     """argv_us: argv strings joined with '\\x1f' ('' = no args).
     Returns the intercomm handle; fills errcodes (int32) if given."""
     args = argv_us.split("\x1f") if argv_us else []
+    info = {}
+    if wd:
+        info["wd"] = wd
+    if path:
+        info["path"] = path
     ic, errcodes = mpi.Comm_spawn(command, args, maxprocs, root,
-                                  comm=_comm(ch))
+                                  comm=_comm(ch), info=info or None)
     _fill_errcodes(errcodes_view, errcodes)
     return _new_comm_handle(ic)
 
@@ -2792,12 +2818,20 @@ def comm_spawn(ch: int, command: str, argv_us: str, maxprocs: int,
 def comm_spawn_multiple(ch: int, cmds_us: str, root: int,
                         errcodes_view=None) -> int:
     """cmds_us: records joined with '\\x1e'; each record is
-    command '\\x1f' maxprocs '\\x1f' arg0 '\\x1f' arg1 ..."""
+    command '\\x1f' maxprocs '\\x1f' wd '\\x1f' path
+    ['\\x1f' arg0 ...] — wd/path are the per-command spawn hints
+    (spawnminfo1.c gives each command its own wdir)."""
     cmds = []
     for rec in cmds_us.split("\x1e"):
         parts = rec.split("\x1f")
         if parts[0]:
-            cmds.append((parts[0], parts[2:], int(parts[1] or "0")))
+            info = {}
+            if len(parts) > 2 and parts[2]:
+                info["wd"] = parts[2]
+            if len(parts) > 3 and parts[3]:
+                info["path"] = parts[3]
+            cmds.append((parts[0], parts[4:], int(parts[1] or "0"),
+                         info))
     ic, errcodes = mpi.Comm_spawn_multiple(cmds, root, comm=_comm(ch))
     _fill_errcodes(errcodes_view, errcodes)
     return _new_comm_handle(ic)
@@ -3105,3 +3139,55 @@ def completed_request() -> int:
     rma/reqops.c asserts it is not MPI_REQUEST_NULL)."""
     from .core.request import CompletedRequest
     return _new_req(CompletedRequest())
+
+
+def type_elements_in(code: int, nbytes: int) -> int:
+    """MPI_Get_elements: complete basic items covered by `nbytes` of
+    packed data, walking the type signature in typemap order
+    (datatype/get-elements.c receives 1.5 pairs and expects 3).
+    Returns -1 when the signature is too large to walk (callers fall
+    back to uniform division)."""
+    if nbytes == 0:
+        return 0
+    seq = dt.element_size_seq(_dt(code))
+    if not seq:
+        return -1
+    per = sum(seq)
+    if per <= 0:
+        return 0
+    full, rem = divmod(int(nbytes), per)
+    count = full * len(seq)
+    for it in seq:
+        if rem >= it:
+            rem -= it
+            count += 1
+        else:
+            break
+    return count
+
+
+def _code_of_type(t) -> int:
+    """Reverse map a Datatype object to its C handle (builtin enum or
+    derived code) for MPI_Type_get_contents."""
+    for c in range(0, 43):
+        if c in (_MARKER_LB, _MARKER_UB):
+            continue
+        try:
+            if _dt(c) is t:
+                return c
+        except Exception:
+            continue
+    with _lock:
+        for c, d in _derived.items():
+            if d is t:
+                return c
+    return -1
+
+
+def type_get_contents(code: int):
+    """(integers, addresses, datatype codes) — the constructor args
+    recorded at creation (MPI-3.1 §4.1.13)."""
+    env = _dt(code).get_envelope()
+    return (list(int(x) for x in env[1]),
+            list(int(x) for x in env[2]),
+            [_code_of_type(t) for t in env[3]])

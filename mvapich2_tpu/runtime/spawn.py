@@ -70,13 +70,13 @@ def comm_spawn_multiple(comm: Comm, cmds: Sequence[Tuple], root: int = 0,
     # non-root callers may pass empty/garbage values, so only the root
     # validates (total is root-only in process mode; thread-mode
     # harness callers pass identical cmds everywhere)
-    total = sum(m for _, _, m in cmds)
+    total = sum(c[2] for c in cmds)
     if comm.rank == root:
         mpi_assert(total > 0, MPI_ERR_SPAWN, "spawn of zero processes")
     ctx = u.allocate_context_id(comm)
     if cmds and callable(cmds[0][0]):
         return _spawn_threads(comm, cmds, root, ctx, total)
-    return _spawn_procs(comm, cmds, root, ctx, total)
+    return _spawn_procs(comm, cmds, root, ctx, total, info)
 
 
 def _finish_spawn(comm: Comm, hdr, root: int, ctx: int):
@@ -100,7 +100,7 @@ def _finish_spawn(comm: Comm, hdr, root: int, ctx: int):
 
 
 def _spawn_procs(comm: Comm, cmds, root: int, ctx: int,
-                 total: int) -> Tuple[Intercomm, List[int]]:
+                 total: int, info=None) -> Tuple[Intercomm, List[int]]:
     u = comm.u
     kvs = getattr(u, "kvs", None)
     if kvs is None:
@@ -112,15 +112,30 @@ def _spawn_procs(comm: Comm, cmds, root: int, ctx: int,
         errcodes = [MPI_SUCCESS] * total
         procs: List[subprocess.Popen] = []
         i = 0
-        for appnum, (command, args, m) in enumerate(cmds):
+        gwd = (info or {}).get("wd") if isinstance(info, dict) else None
+        gpath = (info or {}).get("path") if isinstance(info, dict) \
+            else None
+        for appnum, cmd in enumerate(cmds):
+            command, args, m = cmd[0], cmd[1], cmd[2]
+            # per-command hints (4th tuple slot) override the global info
+            cinfo = cmd[3] if len(cmd) > 3 and isinstance(cmd[3], dict) \
+                else {}
+            wd = cinfo.get("wd") or gwd
+            spath = cinfo.get("path") or gpath
             argv = ([command] if isinstance(command, str)
                     else list(command)) + list(args)
-            # bare program names resolve against the cwd before PATH
-            # (spawn/spaconacc.c spawns "spaconacc"): exec() alone
-            # would only search PATH
-            if (argv and os.sep not in argv[0]
-                    and os.path.exists(argv[0])):
-                argv[0] = os.path.abspath(argv[0])
+            # bare program names resolve against the info "path" dirs,
+            # then the cwd, before PATH (spawn/spaconacc.c passes
+            # path="."; exec() alone would only search PATH)
+            if argv and os.sep not in argv[0]:
+                cands = [os.path.join(d, argv[0])
+                         for d in (spath.split(os.pathsep)
+                                   if spath else [])]
+                cands.append(argv[0])
+                for cand in cands:
+                    if os.path.exists(cand):
+                        argv[0] = os.path.abspath(cand)
+                        break
             for _ in range(m):
                 env = dict(os.environ)
                 env["MV2T_RANK"] = str(i)
@@ -133,7 +148,8 @@ def _spawn_procs(comm: Comm, cmds, root: int, ctx: int,
                     list(comm.group.world_ranks))
                 cpu_rank_env(env)
                 try:
-                    procs.append(subprocess.Popen(argv, env=env))
+                    procs.append(subprocess.Popen(argv, env=env,
+                                                  cwd=wd or None))
                 except OSError as e:
                     errcodes[i] = MPI_ERR_SPAWN
                     log.error("spawn of %r failed: %s", argv, e)

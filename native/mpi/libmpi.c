@@ -30,14 +30,23 @@
 PyObject *g_shim = NULL;        /* mvapich2_tpu.cshim module */
 static int g_we_initialized_python = 0;
 
+/* type-signature sizes (MPI_Type_size); pair types exclude the
+ * struct's alignment padding (pairtype-size-extent.c) */
 static const int DT_SIZE[] = {1, 1, 4, 4, 8, 8, 8, 2, 1, 8, 4, 2, 16, 1,
-                              8, 16, 16, 8, 8, 32,   /* + pair types */
+                              8, 12, 12, 8, 6, 20,   /* + pair types */
                               /* 20-31: distinct LP64/fixed-width */
                               8, 1, 8, 8, 1, 2, 4, 8, 1, 2, 4, 8,
                               /* 32-40: wchar, complex, cxx, packed */
                               4, 8, 16, 32, 1, 8, 16, 32, 1,
                               /* 41-42: MPI_LB/MPI_UB markers */
                               0, 0};
+
+/* extents (buffer stride): == size except the padded pair structs */
+static const int DT_EXT[] = {1, 1, 4, 4, 8, 8, 8, 2, 1, 8, 4, 2, 16, 1,
+                             8, 16, 16, 8, 8, 32,
+                             8, 1, 8, 8, 1, 2, 4, 8, 1, 2, 4, 8,
+                             4, 8, 16, 32, 1, 8, 16, 32, 1,
+                             0, 0};
 
 long shim_call_v(const char *name, int *ok, const char *fmt, ...);
 
@@ -542,8 +551,14 @@ int MPI_Test(MPI_Request *req, int *flag, MPI_Status *status) {
 
 int MPI_Get_count(const MPI_Status *status, MPI_Datatype dt, int *count) {
     int sz = dt_size(dt);
-    if (sz == 0 || status->_count % sz) { *count = MPI_UNDEFINED; }
-    else { *count = status->_count / sz; }
+    if (sz == 0) {
+        /* zero-size type: 0 bytes = 0 elements (hindexed-zeros.c) */
+        *count = status->_count == 0 ? 0 : MPI_UNDEFINED;
+    } else if (status->_count % sz) {
+        *count = MPI_UNDEFINED;
+    } else {
+        *count = status->_count / sz;
+    }
     return MPI_SUCCESS;
 }
 
@@ -840,6 +855,8 @@ int MPI_Win_wait(MPI_Win win) {
 /* ------------------------------------------------------------------ */
 
 long dt_extent_b(MPI_Datatype dt) {
+    if (dt >= 0 && dt < (int)(sizeof(DT_EXT) / sizeof(DT_EXT[0])))
+        return DT_EXT[dt];
     if (dt >= 100) {
         PyGILState_STATE st = PyGILState_Ensure();
         long ext = 0;
@@ -1486,7 +1503,7 @@ int MPI_Type_get_extent(MPI_Datatype datatype, MPI_Aint *lb,
         return rc;
     }
     *lb = 0;
-    *extent = dt_size(datatype);
+    *extent = dt_extent_b(datatype);   /* pair structs: size 12/ext 16 */
     return MPI_SUCCESS;
 }
 

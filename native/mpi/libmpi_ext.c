@@ -1260,8 +1260,23 @@ int MPI_Get_elements_x(const MPI_Status *status, MPI_Datatype datatype,
     /* true 64-bit path: status->_count is long long, so counts past
      * 2^31 elements survive (pt2pt/big_count_status.c) */
     int esz = dt_size(datatype);
+    if (esz == 0 && status->_count == 0) {
+        *count = 0;              /* zero-size type, nothing received */
+        return MPI_SUCCESS;
+    }
     if (esz <= 0)
         return MPI_ERR_TYPE;
+    if (datatype >= 100 || (datatype >= 14 && datatype <= 19)) {
+        /* walk the signature in typemap order: heterogeneous types
+         * (pairs, structs) count partial elements item by item */
+        int ok;
+        long n = shim_call_v("type_elements_in", &ok, "(iL)", datatype,
+                             (long long)status->_count);
+        if (ok && n >= 0) {
+            *count = n;
+            return MPI_SUCCESS;
+        }
+    }
     if (datatype >= 100) {
         int ok;
         long bsz = shim_call_v("type_basic_size", &ok, "(i)", datatype);
@@ -2926,9 +2941,16 @@ static int mv2t_sb_cat(char **buf, size_t *cap, size_t *off,
 int MPI_Comm_spawn(const char *command, char *argv[], int maxprocs,
                    MPI_Info info, int root, MPI_Comm comm,
                    MPI_Comm *intercomm, int array_of_errcodes[]) {
-    (void)info;
     /* command/argv/maxprocs are significant only at root (MPI-3.1
      * Â§10.3.2): non-root callers legally pass NULL/garbage */
+    char wd[1024] = "", path[1024] = "";
+    int iflag = 0;
+    if (info != MPI_INFO_NULL) {
+        MPI_Info_get(info, "wdir", sizeof wd - 1, wd, &iflag);
+        if (!iflag)
+            MPI_Info_get(info, "wd", sizeof wd - 1, wd, &iflag);
+        MPI_Info_get(info, "path", sizeof path - 1, path, &iflag);
+    }
     char *args = mv2t_join_argv(argv);
     if (args == NULL)
         return MPI_ERR_OTHER;
@@ -2944,9 +2966,9 @@ int MPI_Comm_spawn(const char *command, char *argv[], int maxprocs,
                      (long)maxprocs * (long)sizeof(int));
     }
     PyObject *res = ev ? PyObject_CallMethod(
-        g_shim, "comm_spawn", "(issiiO)", (int)comm,
+        g_shim, "comm_spawn", "(issiiOss)", (int)comm,
         command ? command : "", args, maxprocs > 0 ? maxprocs : 0,
-        root, ev) : NULL;
+        root, ev, wd, path) : NULL;
     if (res != NULL) {
         long h = PyLong_AsLong(res);
         if (!PyErr_Occurred()) {
@@ -2971,8 +2993,8 @@ int MPI_Comm_spawn_multiple(int count, char *array_of_commands[],
                             const MPI_Info array_of_info[], int root,
                             MPI_Comm comm, MPI_Comm *intercomm,
                             int array_of_errcodes[]) {
-    (void)array_of_info;
-    /* records joined with 0x1e; each: command 0x1f maxprocs 0x1f args */
+    /* records joined with 0x1e; each:
+     * command 0x1f maxprocs 0x1f wd 0x1f path [0x1f args...] */
     size_t cap = 256;
     size_t off = 0;
     char *payload = (char *)malloc(cap);
@@ -2986,15 +3008,30 @@ int MPI_Comm_spawn_multiple(int count, char *array_of_commands[],
         char *args = mv2t_join_argv(
             array_of_argv == MPI_ARGVS_NULL ? NULL : array_of_argv[i]);
         char head[32];
+        char wd[1024] = "", path[1024] = "";
+        int iflag = 0;
         if (args == NULL) {
             oom = 1;
             break;
         }
-        snprintf(head, sizeof head, "\x1f%d", array_of_maxprocs[i]);
+        if (array_of_info != NULL
+            && array_of_info[i] != MPI_INFO_NULL) {
+            MPI_Info_get(array_of_info[i], "wdir", sizeof wd - 1, wd,
+                         &iflag);
+            if (!iflag)
+                MPI_Info_get(array_of_info[i], "wd", sizeof wd - 1, wd,
+                             &iflag);
+            MPI_Info_get(array_of_info[i], "path", sizeof path - 1,
+                         path, &iflag);
+        }
+        snprintf(head, sizeof head, "\x1f%d\x1f", array_of_maxprocs[i]);
         oom |= (i && mv2t_sb_cat(&payload, &cap, &off, "\x1e") < 0);
         oom |= mv2t_sb_cat(&payload, &cap, &off,
                            array_of_commands[i]) < 0;
         oom |= mv2t_sb_cat(&payload, &cap, &off, head) < 0;
+        oom |= mv2t_sb_cat(&payload, &cap, &off, wd) < 0;
+        oom |= mv2t_sb_cat(&payload, &cap, &off, "\x1f") < 0;
+        oom |= mv2t_sb_cat(&payload, &cap, &off, path) < 0;
         if (args[0]) {
             oom |= mv2t_sb_cat(&payload, &cap, &off, "\x1f") < 0;
             oom |= mv2t_sb_cat(&payload, &cap, &off, args) < 0;
@@ -3396,4 +3433,69 @@ MPI_Aint MPI_Aint_add(MPI_Aint base, MPI_Aint disp) {
 
 MPI_Aint MPI_Aint_diff(MPI_Aint addr1, MPI_Aint addr2) {
     return (MPI_Aint)((char *)addr1 - (char *)addr2);
+}
+
+
+int MPI_Type_match_size(int typeclass, int size, MPI_Datatype *rtype) {
+    /* the local type of the given class and size (MPI-3.1 §17.2.6) */
+    switch (typeclass) {
+    case MPI_TYPECLASS_REAL:
+        if (size == 4)  { *rtype = MPI_FLOAT; return MPI_SUCCESS; }
+        if (size == 8)  { *rtype = MPI_DOUBLE; return MPI_SUCCESS; }
+        if (size == 16) { *rtype = MPI_LONG_DOUBLE; return MPI_SUCCESS; }
+        break;
+    case MPI_TYPECLASS_INTEGER:
+        if (size == 1)  { *rtype = MPI_INT8_T; return MPI_SUCCESS; }
+        if (size == 2)  { *rtype = MPI_SHORT; return MPI_SUCCESS; }
+        if (size == 4)  { *rtype = MPI_INT; return MPI_SUCCESS; }
+        if (size == 8)  { *rtype = MPI_INT64_T; return MPI_SUCCESS; }
+        break;
+    case MPI_TYPECLASS_COMPLEX:
+        if (size == 8)  { *rtype = MPI_C_FLOAT_COMPLEX;
+                          return MPI_SUCCESS; }
+        if (size == 16) { *rtype = MPI_C_DOUBLE_COMPLEX;
+                          return MPI_SUCCESS; }
+        if (size == 32) { *rtype = MPI_C_LONG_DOUBLE_COMPLEX;
+                          return MPI_SUCCESS; }
+        break;
+    }
+    *rtype = MPI_DATATYPE_NULL;
+    return MPI_ERR_ARG;
+}
+
+
+int MPI_Type_get_contents(MPI_Datatype datatype, int max_integers,
+                          int max_addresses, int max_datatypes,
+                          int array_of_integers[],
+                          MPI_Aint array_of_addresses[],
+                          MPI_Datatype array_of_datatypes[]) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "type_get_contents",
+                                        "(i)", datatype);
+    int rc = MPI_ERR_TYPE;
+    if (res != NULL) {
+        PyObject *ints, *aints, *types;
+        if (PyArg_ParseTuple(res, "OOO", &ints, &aints, &types)) {
+            Py_ssize_t ni = PyList_Size(ints);
+            Py_ssize_t na = PyList_Size(aints);
+            Py_ssize_t nt = PyList_Size(types);
+            for (Py_ssize_t i = 0; i < ni && i < max_integers; i++)
+                array_of_integers[i] =
+                    (int)PyLong_AsLong(PyList_GET_ITEM(ints, i));
+            for (Py_ssize_t i = 0; i < na && i < max_addresses; i++)
+                array_of_addresses[i] =
+                    (MPI_Aint)PyLong_AsLongLong(PyList_GET_ITEM(aints, i));
+            for (Py_ssize_t i = 0; i < nt && i < max_datatypes; i++)
+                array_of_datatypes[i] =
+                    (MPI_Datatype)PyLong_AsLong(PyList_GET_ITEM(types, i));
+            rc = PyErr_Occurred() ? MPI_ERR_TYPE : MPI_SUCCESS;
+            if (PyErr_Occurred())
+                PyErr_Clear();
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    PyGILState_Release(st);
+    return rc;
 }

@@ -92,6 +92,22 @@ typedef struct MPI_Status {
 /* Fortran complex from C (opsum.c/opprod.c use these names) */
 #define MPI_COMPLEX                 MPI_C_FLOAT_COMPLEX
 #define MPI_DOUBLE_COMPLEX          MPI_C_DOUBLE_COMPLEX
+#define MPI_COMPLEX8                MPI_C_FLOAT_COMPLEX
+#define MPI_COMPLEX16               MPI_C_DOUBLE_COMPLEX
+#define MPI_COMPLEX32               MPI_C_LONG_DOUBLE_COMPLEX
+/* Fortran fixed-size numerics (typename.c) */
+#define MPI_REAL4                   MPI_FLOAT
+#define MPI_REAL8                   MPI_DOUBLE
+#define MPI_REAL16                  MPI_LONG_DOUBLE
+#define MPI_INTEGER1                MPI_INT8_T
+#define MPI_INTEGER2                MPI_INT16_T
+#define MPI_INTEGER4                MPI_INT32_T
+#define MPI_INTEGER8                MPI_INT64_T
+/* MPI_Type_match_size type classes (MPI-3.1 §17.2.6) */
+#define MPI_TYPECLASS_REAL     1
+#define MPI_TYPECLASS_INTEGER  2
+#define MPI_TYPECLASS_COMPLEX  3
+int MPI_Type_match_size(int typeclass, int size, MPI_Datatype *rtype);
 #define MPI_CXX_FLOAT_COMPLEX       ((MPI_Datatype)37)
 #define MPI_CXX_DOUBLE_COMPLEX      ((MPI_Datatype)38)
 #define MPI_CXX_LONG_DOUBLE_COMPLEX ((MPI_Datatype)39)
@@ -365,6 +381,19 @@ int MPI_Type_get_envelope(MPI_Datatype datatype, int *num_integers,
 #define MPI_COMBINER_RESIZED    8
 #define MPI_COMBINER_INDEXED_BLOCK 9
 #define MPI_COMBINER_DUP        10
+#define MPI_COMBINER_HINDEXED_BLOCK 11
+#define MPI_COMBINER_DARRAY     12
+#define MPI_COMBINER_F90_REAL   13
+#define MPI_COMBINER_F90_COMPLEX 14
+#define MPI_COMBINER_F90_INTEGER 15
+#define MPI_COMBINER_HVECTOR_INTEGER 16
+#define MPI_COMBINER_HINDEXED_INTEGER 17
+#define MPI_COMBINER_STRUCT_INTEGER 18
+int MPI_Type_get_contents(MPI_Datatype datatype, int max_integers,
+                          int max_addresses, int max_datatypes,
+                          int array_of_integers[],
+                          MPI_Aint array_of_addresses[],
+                          MPI_Datatype array_of_datatypes[]);
 
 /* ---- comm/group extras ---- */
 int MPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int *result);
