@@ -3191,3 +3191,57 @@ def type_get_contents(code: int):
     return (list(int(x) for x in env[1]),
             list(int(x) for x in env[2]),
             [_code_of_type(t) for t in env[3]])
+
+
+# ---------------------------------------------------------------------------
+# external32 representation (MPI-3.1 §13.5.2): big-endian packed data
+# ---------------------------------------------------------------------------
+
+def _swap_items(data: np.ndarray, seq, count: int) -> np.ndarray:
+    """Byteswap little-endian packed data item-by-item (the host is
+    LE; external32 is BE). `seq` is one element's item-size sequence."""
+    out = data.copy()
+    if seq and all(s == seq[0] for s in seq):
+        s = seq[0]
+        if s > 1:
+            out = out.reshape(-1, s)[:, ::-1].reshape(-1)
+        return out
+    pos = 0
+    n = len(out)
+    while pos < n:
+        for s in seq:
+            if pos + s > n:
+                break
+            out[pos:pos + s] = out[pos:pos + s][::-1]
+            pos += s
+    return out
+
+
+def pack_external(iview, incount: int, dtcode: int, oview,
+                  position: int) -> int:
+    d = _dt(dtcode)
+    raw = np.frombuffer(iview, np.uint8) if iview is not None else \
+        np.empty(0, np.uint8)
+    data = np.asarray(d.pack(raw, incount)).view(np.uint8)
+    seq = dt.element_size_seq(d) or [1]
+    swapped = _swap_items(data, seq, incount)
+    out = np.frombuffer(oview, np.uint8)
+    out[position:position + swapped.size] = swapped
+    return position + int(swapped.size)
+
+
+def unpack_external(iview, insize: int, position: int, oview,
+                    outcount: int, dtcode: int) -> int:
+    d = _dt(dtcode)
+    src = np.frombuffer(iview, np.uint8)
+    nbytes = d.size * outcount
+    chunk = src[position:position + nbytes]
+    seq = dt.element_size_seq(d) or [1]
+    native = _swap_items(np.asarray(chunk), seq, outcount)
+    d.unpack(native, np.frombuffer(oview, np.uint8), outcount)
+    return position + nbytes
+
+
+def pack_external_size(dtcode: int, incount: int) -> int:
+    # our fixed-size representations match external32 widths
+    return _dt(dtcode).size * incount

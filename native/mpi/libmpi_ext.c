@@ -3499,3 +3499,80 @@ int MPI_Type_get_contents(MPI_Datatype datatype, int max_integers,
     PyGILState_Release(st);
     return rc;
 }
+
+
+/* ------------------------------------------------------------------ */
+/* external32 (MPI-3.1 §13.5.2) — big-endian canonical representation */
+/* ------------------------------------------------------------------ */
+
+int MPI_Pack_external(const char datarep[], const void *inbuf,
+                      int incount, MPI_Datatype datatype, void *outbuf,
+                      MPI_Aint outsize, MPI_Aint *position) {
+    (void)datarep;               /* only "external32" exists */
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *iv = mv_view(inbuf, dt_span_b(datatype, incount));
+    PyObject *ov = mv_view(outbuf, outsize);
+    PyObject *res = PyObject_CallMethod(g_shim, "pack_external",
+                                        "(OiiOL)", iv, incount,
+                                        datatype, ov,
+                                        (long long)*position);
+    int rc = MPI_ERR_OTHER;
+    if (res != NULL) {
+        long long np_ = PyLong_AsLongLong(res);
+        if (!PyErr_Occurred()) {
+            *position = (MPI_Aint)np_;
+            rc = MPI_SUCCESS;
+        } else {
+            PyErr_Clear();
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    Py_XDECREF(iv); Py_XDECREF(ov);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Unpack_external(const char datarep[], const void *inbuf,
+                        MPI_Aint insize, MPI_Aint *position,
+                        void *outbuf, int outcount,
+                        MPI_Datatype datatype) {
+    (void)datarep;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *iv = mv_view(inbuf, insize);
+    PyObject *ov = mv_view(outbuf, dt_span_b(datatype, outcount));
+    PyObject *res = PyObject_CallMethod(g_shim, "unpack_external",
+                                        "(OLLOii)", iv,
+                                        (long long)insize,
+                                        (long long)*position, ov,
+                                        outcount, datatype);
+    int rc = MPI_ERR_OTHER;
+    if (res != NULL) {
+        long long np_ = PyLong_AsLongLong(res);
+        if (!PyErr_Occurred()) {
+            *position = (MPI_Aint)np_;
+            rc = MPI_SUCCESS;
+        } else {
+            PyErr_Clear();
+        }
+        Py_DECREF(res);
+    } else {
+        rc = mv2t_errcode_from_pyerr();
+    }
+    Py_XDECREF(iv); Py_XDECREF(ov);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int MPI_Pack_external_size(const char datarep[], int incount,
+                           MPI_Datatype datatype, MPI_Aint *size) {
+    (void)datarep;
+    int ok;
+    long v = shim_call_v("pack_external_size", &ok, "(ii)", datatype,
+                         incount);
+    if (!ok)
+        return mv2t_last_errclass;
+    *size = (MPI_Aint)v;
+    return MPI_SUCCESS;
+}

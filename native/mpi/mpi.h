@@ -389,6 +389,15 @@ int MPI_Type_get_envelope(MPI_Datatype datatype, int *num_integers,
 #define MPI_COMBINER_HVECTOR_INTEGER 16
 #define MPI_COMBINER_HINDEXED_INTEGER 17
 #define MPI_COMBINER_STRUCT_INTEGER 18
+int MPI_Pack_external(const char datarep[], const void *inbuf,
+                      int incount, MPI_Datatype datatype, void *outbuf,
+                      MPI_Aint outsize, MPI_Aint *position);
+int MPI_Unpack_external(const char datarep[], const void *inbuf,
+                        MPI_Aint insize, MPI_Aint *position,
+                        void *outbuf, int outcount,
+                        MPI_Datatype datatype);
+int MPI_Pack_external_size(const char datarep[], int incount,
+                           MPI_Datatype datatype, MPI_Aint *size);
 int MPI_Type_get_contents(MPI_Datatype datatype, int max_integers,
                           int max_addresses, int max_datatypes,
                           int array_of_integers[],
