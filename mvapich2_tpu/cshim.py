@@ -2123,44 +2123,35 @@ def igather(sview, rview, scount: int, sdt: int, rcount: int, rdt: int,
             root: int, ch: int) -> int:
     """recvcount/recvtype are significant only at the root (MPI-3.1
     §5.5); non-roots contribute sendcount elements of sendtype."""
-    from .coll import nonblocking as nb
     c = _comm(ch)
     if _is_inter(c):
         # same count/type/root logic as the blocking path, run on the
         # per-intercomm worker (issue-order serialized)
         return _queued(ch, lambda: gather(sview, rview, scount, sdt,
                                           rcount, rdt, root, ch))
-    if c.rank == root:
-        recv = _arr(rview, rcount * c.size, rdt)
-        if sview is None:                    # IN_PLACE at root
-            send = recv[root * rcount:(root + 1) * rcount].copy()
-        else:
-            send = _arr(sview, scount, sdt)
-        return _new_req(nb.igather(c, send, recv, rcount, _dt(rdt),
-                                   root))
-    send = _arr(sview, scount, sdt)
-    return _new_req(nb.igather(c, send, None, scount, _dt(sdt), root))
+    # byte-level v-path unconditionally — mirrors blocking gather():
+    # per-rank branching on root-only datatypes diverges algorithms,
+    # and derived types (nonblocking2.c's dup'd recvtype) need the
+    # pack/unpack route anyway
+    n = c.size
+    return igatherv(sview, rview, scount, sdt, [rcount] * n,
+                    [i * rcount for i in range(n)], rdt, root, ch)
 
 
 def iscatter(sview, rview, scount: int, sdt: int, rcount: int,
              rdt: int, root: int, ch: int) -> int:
     """sendcount/sendtype are significant only at the root."""
-    from .coll import nonblocking as nb
     c = _comm(ch)
     if _is_inter(c):
         return _queued(ch, lambda: scatter(sview, rview, scount, sdt,
                                            rcount, rdt, root, ch))
-    if c.rank == root:
-        send = _arr(sview, scount * c.size, sdt)
-        if rview is None:      # MPI_IN_PLACE at root: block stays put
-            recv = np.empty(scount, dtype=_DTYPES[sdt])
-            return _new_req(nb.iscatter(c, send, recv, scount, _dt(sdt),
-                                        root))
-        recv = _arr(rview, rcount, rdt)
-        return _new_req(nb.iscatter(c, send, recv, rcount, _dt(rdt),
-                                    root))
-    recv = _arr(rview, rcount, rdt)
-    return _new_req(nb.iscatter(c, None, recv, rcount, _dt(rdt), root))
+    if rview is None:
+        # IN_PLACE root: recvcount/recvtype ignored (§5.6)
+        rcount, rdt = 0, sdt
+    n = c.size
+    return iscatterv(sview, rview, [scount] * n,
+                     [i * scount for i in range(n)], sdt, rcount, rdt,
+                     root, ch)
 
 
 # ---------------------------------------------------------------------------
