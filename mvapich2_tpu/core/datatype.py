@@ -196,30 +196,47 @@ class Datatype:
         if self.basic is None:
             raise MPIException(MPI_ERR_TYPE,
                                "heterogeneous datatype in reduction")
-        if self.basic.itemsize != _sig_size(self):
-            # padded view dtype (pair types): place each packed
-            # signature into an aligned element
-            n = b.size // _sig_size(self)
-            out = np.zeros(n, dtype=self.basic)
-            out.view(np.uint8).reshape(n, self.basic.itemsize)[
-                :, :_sig_size(self)] = b.reshape(n, _sig_size(self))
-            return out
-        return b.view(self.basic)
+        return packed_to_basic(b, self.basic)
 
     def from_basic_array(self, arr: np.ndarray) -> np.ndarray:
         """Inverse of :meth:`to_numpy`: aligned elements -> packed
         signature bytes."""
-        if self.basic is not None and \
-                self.basic.itemsize != _sig_size(self):
-            n = arr.size
-            return np.ascontiguousarray(
-                arr.view(np.uint8).reshape(n, self.basic.itemsize)
-                [:, :_sig_size(self)]).reshape(-1)
+        return basic_to_packed(arr)
+
+
+def _basic_sig(b: np.dtype) -> int:
+    """Data bytes of ONE basic item: field sizes for padded (pair)
+    struct dtypes, itemsize otherwise."""
+    if b.names:
+        return sum(b.fields[n][0].itemsize for n in b.names)
+    return b.itemsize
+
+
+def packed_to_basic(data_u8, basic: np.dtype) -> np.ndarray:
+    """Packed signature bytes -> array of the (possibly padded) basic
+    view dtype. Works per-ITEM, so contiguous-of-pair types restage
+    correctly (rma/acc-pairtype.c)."""
+    m = np.ascontiguousarray(np.asarray(data_u8)).view(np.uint8) \
+        .reshape(-1)
+    sig = _basic_sig(basic)
+    if basic.itemsize == sig:
+        return m.view(basic)
+    n = m.size // sig
+    out = np.zeros(n, dtype=basic)
+    out.view(np.uint8).reshape(n, basic.itemsize)[:, :sig] = \
+        m.reshape(n, sig)
+    return out
+
+
+def basic_to_packed(arr: np.ndarray) -> np.ndarray:
+    """Inverse of :func:`packed_to_basic`."""
+    b = arr.dtype
+    sig = _basic_sig(b)
+    if b.itemsize == sig:
         return np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
-
-
-def _sig_size(d: "Datatype") -> int:
-    return d.size
+    n = arr.size
+    return np.ascontiguousarray(
+        arr.view(np.uint8).reshape(n, b.itemsize)[:, :sig]).reshape(-1)
 
 
 def _merge_spans(spans) -> np.ndarray:

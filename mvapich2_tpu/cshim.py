@@ -1507,7 +1507,8 @@ def get_accumulate(wh: int, oview, rview, count: int, dtcode: int,
 
 def fetch_and_op(wh: int, oview, rview, dtcode: int, target: int,
                  tdisp: int, opcode: int) -> int:
-    obuf = _arr(oview, 1, dtcode) if oview is not None else \
+    # NULL origin is legal for MPI_NO_OP (empty-bytes at the boundary)
+    obuf = _arr(oview, 1, dtcode) if oview else \
         np.zeros(1, _DTYPES[dtcode])
     rbuf = _arr(rview, 1, dtcode)
     _wins[wh].fetch_and_op(obuf, rbuf, target, tdisp, op=_OPS[opcode])

@@ -55,10 +55,22 @@ WIN_SEPARATE = 1
 WIN_UNIFIED = 2
 
 
+def _ser_basic(b):
+    if b is None:
+        return None
+    if b.names:
+        # structured (pair) dtype incl. padding offsets
+        return {"names": list(b.names),
+                "formats": [b.fields[n][0].str for n in b.names],
+                "offsets": [int(b.fields[n][1]) for n in b.names],
+                "itemsize": int(b.itemsize)}
+    return b.str
+
+
 def _ser_dt(dt: Datatype) -> dict:
     return {"spans": np.asarray(dt.spans).tolist(),
             "extent": dt.extent, "lb": dt.lb,
-            "basic": (dt.basic.str if dt.basic is not None else None)}
+            "basic": _ser_basic(dt.basic)}
 
 
 def _dt_span(dt: Datatype, count: int) -> int:
@@ -75,9 +87,10 @@ def _dt_span(dt: Datatype, count: int) -> int:
 
 
 def _deser_dt(d: dict) -> Datatype:
+    b = d["basic"]
+    basic = None if b is None else np.dtype(b)
     return Datatype([tuple(s) for s in d["spans"]], d["extent"], d["lb"],
-                    np.dtype(d["basic"]) if d["basic"] else None,
-                    "rma_wire", True)
+                    basic, "rma_wire", True)
 
 
 class _TargetSync:
@@ -683,11 +696,12 @@ class RmaManager:
         old = np.asarray(tdt.pack(region, cnt)) if cnt else \
             np.empty(0, np.uint8)
         if cnt and op is not opmod.NO_OP and pkt.nbytes:
+            from ..core.datatype import basic_to_packed, packed_to_basic
             basic = tdt.basic if tdt.basic is not None else np.dtype(np.uint8)
-            cur = old.view(basic).copy()
-            inc = pkt.data[:len(old)].view(basic)
+            cur = packed_to_basic(old, basic).copy()
+            inc = packed_to_basic(pkt.data[:len(old)], basic)
             res = op(inc, cur)
-            tdt.unpack(np.ascontiguousarray(res).view(np.uint8), region, cnt)
+            tdt.unpack(basic_to_packed(np.asarray(res)), region, cnt)
         return old if fetch else None
 
     def _on_acc(self, pkt: Packet) -> None:

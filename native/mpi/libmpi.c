@@ -714,7 +714,6 @@ int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
 
 int MPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
                      MPI_Comm comm, void *baseptr, MPI_Win *win) {
-    (void)info;
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *res = PyObject_CallMethod(g_shim, "win_allocate", "(Lii)",
                                         (long long)size, disp_unit, comm);
@@ -729,6 +728,7 @@ int MPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
                 *(void **)baseptr = b.buf;
                 PyBuffer_Release(&b);   /* numpy array owns the memory */
                 mv2t_win_record(h, *(void **)baseptr, size, disp_unit);
+                mv2t_wininfo_set(h, info);
                 rc = MPI_SUCCESS;
             }
         }
@@ -742,7 +742,6 @@ int MPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
 
 int MPI_Win_create(void *base, MPI_Aint size, int disp_unit,
                    MPI_Info info, MPI_Comm comm, MPI_Win *win) {
-    (void)info;
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *view = mv_view(base, (long)size);
     PyObject *res = PyObject_CallMethod(g_shim, "win_create", "(Oii)",
@@ -750,6 +749,7 @@ int MPI_Win_create(void *base, MPI_Aint size, int disp_unit,
     int rc = MPI_ERR_OTHER;
     if (res) {
         *win = (MPI_Win)PyLong_AsLong(res);
+        mv2t_wininfo_set(*win, info);
         mv2t_win_record(*win, base, size, disp_unit);
         rc = MPI_SUCCESS;
         Py_DECREF(res);
@@ -763,8 +763,8 @@ int MPI_Win_create(void *base, MPI_Aint size, int disp_unit,
 
 int MPI_Win_create_dynamic(MPI_Info info, MPI_Comm comm, MPI_Win *win) {
     int ok;
-    (void)info;
     *win = (int)shim_call_v("win_create_dynamic", &ok, "(i)", comm);
+        mv2t_wininfo_set(*win, info);
     if (!ok) {
         *win = MPI_WIN_NULL;
         return MPI_ERR_OTHER;

@@ -706,6 +706,7 @@ void mv2t_win_record(int win, void *base, MPI_Aint size, int disp_unit) {
 }
 
 void mv2t_win_forget(int win) {
+    mv2t_wininfo_forget(win);
     win_info **p = &g_wininfo;
     while (*p != NULL) {
         if ((*p)->win == win) {
@@ -3322,7 +3323,6 @@ int MPI_Ireduce_scatter_block(const void *sendbuf, void *recvbuf,
 
 int MPI_Win_allocate_shared(MPI_Aint size, int disp_unit, MPI_Info info,
                             MPI_Comm comm, void *baseptr, MPI_Win *win) {
-    (void)info;
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *res = PyObject_CallMethod(g_shim, "win_allocate_shared",
                                         "(Lii)", (long long)size,
@@ -3338,6 +3338,7 @@ int MPI_Win_allocate_shared(MPI_Aint size, int disp_unit, MPI_Info info,
                 *(void **)baseptr = b.buf;
                 PyBuffer_Release(&b);
                 mv2t_win_record(h, *(void **)baseptr, size, disp_unit);
+                mv2t_wininfo_set(h, info);
                 rc = MPI_SUCCESS;
             }
         }
@@ -3408,8 +3409,47 @@ int MPI_Rget_accumulate(const void *origin, int ocount, MPI_Datatype odt,
                                            win), req);
 }
 
+/* remembered per-win "no_locks" hint (win_info.c reads it back) */
+static struct wininfo_kv { int win; int no_locks;
+                           struct wininfo_kv *next; } *g_wininfo_kv;
+
+void mv2t_wininfo_set(int win, MPI_Info info) {
+    char v[16] = "";
+    int flag = 0;
+    if (info == MPI_INFO_NULL)
+        return;
+    MPI_Info_get(info, "no_locks", sizeof v - 1, v, &flag);
+    if (!flag)
+        return;
+    for (struct wininfo_kv *q = g_wininfo_kv; q != NULL; q = q->next)
+        if (q->win == win) {               /* update in place */
+            q->no_locks = strcmp(v, "true") == 0;
+            return;
+        }
+    struct wininfo_kv *p = malloc(sizeof *p);
+    if (p == NULL)
+        return;
+    p->win = win;
+    p->no_locks = strcmp(v, "true") == 0;
+    p->next = g_wininfo_kv;
+    g_wininfo_kv = p;
+}
+
+void mv2t_wininfo_forget(int win) {
+    struct wininfo_kv **pp = &g_wininfo_kv;
+    while (*pp != NULL) {
+        if ((*pp)->win == win) {
+            struct wininfo_kv *dead = *pp;
+            *pp = dead->next;
+            free(dead);
+        } else {
+            pp = &(*pp)->next;
+        }
+    }
+}
+
 int MPI_Win_set_info(MPI_Win win, MPI_Info info) {
-    (void)win; (void)info;   /* hints are advisory (MPI-3.1 §11.2.7) */
+    mv2t_wininfo_set(win, info);   /* hints are advisory (§11.2.7) */
     return MPI_SUCCESS;
 }
 
@@ -3420,7 +3460,13 @@ int MPI_Win_get_info(MPI_Win win, MPI_Info *info_used) {
         return rc;
     /* the standard hint set with our actual values (win_info.c reads
      * these back; locks always work, accumulates are fully ordered) */
-    MPI_Info_set(*info_used, "no_locks", "false");
+    const char *nl = "false";
+    for (struct wininfo_kv *p = g_wininfo_kv; p != NULL; p = p->next)
+        if (p->win == win) {
+            nl = p->no_locks ? "true" : "false";
+            break;
+        }
+    MPI_Info_set(*info_used, "no_locks", nl);
     MPI_Info_set(*info_used, "accumulate_ordering", "rar,raw,war,waw");
     MPI_Info_set(*info_used, "accumulate_ops", "same_op_no_op");
     MPI_Info_set(*info_used, "alloc_shared_noncontig", "false");
