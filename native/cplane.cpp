@@ -1,0 +1,1092 @@
+// cplane.cpp — the native pt2pt data plane (eager fast path).
+//
+// TPU-native analog of the reference's native hot loop: the per-message
+// path of ch3_progress.c:186 (MPIDI_CH3I_Progress), the inline eager send
+// of gen2/ibv_send_inline.h:493, and the SMP ring progress of
+// ch3_smp_progress.c:740.  In round 3 every message crossed the Python
+// protocol layer at ~50-120 us/msg; this file moves the small-message
+// send/recv data plane into C:
+//
+//   * ordered injection: every packet bound for a co-located rank — the
+//     C fast path's eager packets AND the Python slow path's pre-encoded
+//     control/rendezvous packets — funnels through cp_inject, which owns
+//     the per-destination backlog.  One FIFO per (src,dst) pair, exactly
+//     like the vbuf send queue (ibv_send.c:941 credit backlog).
+//   * single consumer: cp_advance drains all rings in packet order and
+//     performs envelope matching (ctx, src, tag — the ch3u_recvq.c:46
+//     queues) in C for "plane-owned" contexts: communicators whose
+//     members all share this shm segment.  Everything else is forwarded
+//     to a Python-visible inbox, so the Python protocol layer keeps
+//     ownership of collectives contexts, RMA packets, rendezvous data,
+//     and remote-rank traffic.
+//   * rendezvous assist: an RNDV_RTS that matches a C-posted receive is
+//     parked on an assist queue; the Python side runs the rendezvous
+//     protocol into the C buffer and completes the request via
+//     cp_complete_assist (the ch3u_rndv.c handoff, inverted).
+//
+// Wire format: identical to the Python binary codec
+// (mvapich2_tpu/transport/base.py encode_packet): a packed 61-byte
+// little-endian header `<Biiiiqqqq8si` + optional pickled extra + payload.
+// C parses the header directly in the ring (zero copy until the final
+// memcpy into the user buffer).
+//
+// Build: part of libshmring.so (make -C native).  Consumed two ways:
+//   * ctypes from mvapich2_tpu/transport/shm.py (Python ranks)
+//   * directly from native/mpi/libmpi.c (C programs; no GIL on the path)
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/select.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/time.h>
+#include <sys/un.h>
+#include <time.h>
+#include <unistd.h>
+
+// ---------------------------------------------------------------------------
+// shared-ring primitives from shmring.cpp (same .so)
+// ---------------------------------------------------------------------------
+extern "C" {
+int sr_send(void* handle, int src, int dst, const void* buf, long len);
+long sr_peek(void* handle, int src, int dst);
+long sr_recv(void* handle, int src, int dst, void* buf, long maxlen);
+long sr_capacity(void* handle);
+// zero-copy drain (added alongside this file): expose the next message
+// in-place, then consume it after parsing.
+long sr_peek_view(void* handle, int src, int dst, const void** ptr);
+void sr_consume(void* handle, int src, int dst);
+}
+
+namespace {
+
+// Packet types we understand (transport/base.py PktType)
+constexpr uint8_t PKT_EAGER_SEND = 1;
+constexpr uint8_t PKT_RNDV_RTS = 2;
+constexpr uint8_t PKT_CANCEL_SEND_REQ = 33;
+constexpr uint8_t PKT_CANCEL_SEND_RESP = 34;
+
+constexpr int ANY_SOURCE = -1;
+constexpr int ANY_TAG = -2;
+
+#pragma pack(push, 1)
+struct PktHdr {              // struct.Struct("<Biiiiqqqq8si"), 61 bytes
+  uint8_t type;
+  int32_t src_world;
+  int32_t ctx;
+  int32_t comm_src;
+  int32_t tag;
+  int64_t nbytes;
+  int64_t sreq_id;
+  int64_t rreq_id;
+  int64_t offset;
+  char protocol[8];
+  int32_t exlen;
+};
+#pragma pack(pop)
+static_assert(sizeof(PktHdr) == 61, "wire header layout");
+
+// request states
+enum ReqState { RS_PENDING = 0, RS_ASSIST = 1, RS_DONE = 2, RS_FREE = 3 };
+
+struct Req {
+  int64_t id;
+  int state;
+  void* buf;
+  int64_t cap;
+  int32_t ctx, src, tag;          // match key (posted)
+  // completion status
+  int32_t st_src, st_tag;
+  int64_t st_nbytes;
+  int truncated;
+  int errclass;                   // 0 = success
+  Req* next;                      // posted-queue link
+  Req* prev;
+};
+
+struct UnexEntry {                // one unexpected message
+  uint8_t type;                   // EAGER or RTS
+  int32_t ctx, src, tag;
+  int32_t src_world;
+  int64_t sreq_id;
+  int64_t nbytes;                 // payload length (hdr.nbytes)
+  uint8_t* blob;                  // full packet blob copy
+  long blob_len;
+  long payload_off;               // offset of payload within blob
+  UnexEntry* next;
+  UnexEntry* prev;
+  int64_t token;                  // mprobe token (0 = queued normally)
+};
+
+struct Blob {                     // generic blob node (backlog / py inbox)
+  uint8_t* data;
+  long len;
+  Blob* next;
+};
+
+struct AssistEntry {              // RTS matched to a C recv -> python
+  int64_t req_id;
+  uint8_t* blob;
+  long len;
+  AssistEntry* next;
+};
+
+struct CancelEntry {              // origin-side send-cancel state
+  int64_t sreq_id;
+  int result;                     // -1 pending, 0 not cancelled, 1 cancelled
+  CancelEntry* next;
+};
+
+struct CtxSet {                   // enabled (plane-owned) context ids
+  int32_t* v;
+  int n, capn;
+  bool has(int32_t c) const {
+    for (int i = 0; i < n; i++)
+      if (v[i] == c) return true;
+    return false;
+  }
+  void add(int32_t c) {
+    if (has(c)) return;
+    if (n == capn) {
+      capn = capn ? capn * 2 : 16;
+      v = static_cast<int32_t*>(realloc(v, capn * sizeof(int32_t)));
+    }
+    v[n++] = c;
+  }
+  void del(int32_t c) {
+    for (int i = 0; i < n; i++)
+      if (v[i] == c) { v[i] = v[--n]; return; }
+  }
+};
+
+struct CPlane {
+  void* ring;                    // sr_attach handle (shared with python)
+  int me;                        // my ring index (== local index)
+  int n_local;
+  long ring_cap;                 // max blob that can ever fit a ring
+  pthread_mutex_t mu;            // guards all plane state
+  // ordered injection backlog, per destination
+  Blob** backlog_head;
+  Blob** backlog_tail;
+  // matching queues
+  Req* posted_head;
+  Req* posted_tail;
+  UnexEntry* unex_head;
+  UnexEntry* unex_tail;
+  // forwarded-to-python inbox
+  Blob* py_head;
+  Blob* py_tail;
+  std::atomic<int> py_count;
+  // rendezvous assist queue
+  AssistEntry* assist_head;
+  AssistEntry* assist_tail;
+  std::atomic<int> assist_count;
+  // origin-side cancels
+  CancelEntry* cancels;
+  // request table (id -> Req) — open chain on a growing array
+  Req** reqs;
+  int64_t reqs_cap;
+  int64_t next_req;
+  // mprobe-parked entries
+  UnexEntry* parked;
+  int64_t next_token;
+  // enabled ctx set
+  CtxSet ctxs;
+  // failure set (ring indices)
+  uint8_t* failed;
+  // wakeup plumbing (mirrors ShmChannel's adaptive doorbell)
+  uint8_t* flags;                // mmap'd sleep flags, one per local rank
+  long flags_len;
+  int bell_fd;                   // our bell socket (owned by python side)
+  struct sockaddr_un* bells;     // peer bell addresses
+  uint8_t* bell_set;
+  int bell_tx;                   // unbound dgram socket for sendto
+  // stats
+  uint64_t n_eager_tx, n_eager_rx, n_fwd_py;
+};
+
+inline uint64_t now_us() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000000u + ts.tv_nsec / 1000;
+}
+
+Req* get_req(CPlane* p, int64_t id) {
+  if (id < 1 || id >= p->next_req) return nullptr;
+  Req* r = p->reqs[id];
+  return (r && r->state != RS_FREE) ? r : nullptr;
+}
+
+Req* new_req(CPlane* p) {
+  int64_t id = p->next_req++;
+  if (id >= p->reqs_cap) {
+    int64_t nc = p->reqs_cap ? p->reqs_cap * 2 : 256;
+    p->reqs = static_cast<Req**>(realloc(p->reqs, nc * sizeof(Req*)));
+    memset(p->reqs + p->reqs_cap, 0, (nc - p->reqs_cap) * sizeof(Req*));
+    p->reqs_cap = nc;
+  }
+  Req* r = static_cast<Req*>(calloc(1, sizeof(Req)));
+  r->id = id;
+  p->reqs[id] = r;
+  return r;
+}
+
+void posted_push(CPlane* p, Req* r) {
+  r->next = nullptr;
+  r->prev = p->posted_tail;
+  if (p->posted_tail) p->posted_tail->next = r;
+  else p->posted_head = r;
+  p->posted_tail = r;
+}
+
+void posted_remove(CPlane* p, Req* r) {
+  if (r->prev) r->prev->next = r->next;
+  else p->posted_head = r->next;
+  if (r->next) r->next->prev = r->prev;
+  else p->posted_tail = r->prev;
+  r->prev = r->next = nullptr;
+}
+
+void unex_push(CPlane* p, UnexEntry* e) {
+  e->next = nullptr;
+  e->prev = p->unex_tail;
+  if (p->unex_tail) p->unex_tail->next = e;
+  else p->unex_head = e;
+  p->unex_tail = e;
+}
+
+void unex_remove(CPlane* p, UnexEntry* e) {
+  if (e->prev) e->prev->next = e->next;
+  else p->unex_head = e->next;
+  if (e->next) e->next->prev = e->prev;
+  else p->unex_tail = e->prev;
+  e->prev = e->next = nullptr;
+}
+
+inline bool env_match(int32_t pctx, int32_t psrc, int32_t ptag,
+                      int32_t ctx, int32_t src, int32_t tag) {
+  if (pctx != ctx) return false;
+  if (psrc != ANY_SOURCE && psrc != src) return false;
+  if (ptag != ANY_TAG && ptag != tag) return false;
+  return true;
+}
+
+void ring_bell(CPlane* p, int dst) {
+  if (dst < 0 || dst >= p->n_local) return;
+  if (p->flags && p->flags[dst] == 0) return;   // receiver awake: skip
+  if (!p->bell_set[dst] || p->bell_tx < 0) return;
+  (void)sendto(p->bell_tx, "x", 1, MSG_DONTWAIT,
+               reinterpret_cast<struct sockaddr*>(&p->bells[dst]),
+               sizeof(p->bells[dst]));
+}
+
+// try to push dst's backlog into the ring; returns #blobs moved, -1 if
+// the ring is still full
+int flush_backlog(CPlane* p, int dst) {
+  int moved = 0;
+  Blob* b = p->backlog_head[dst];
+  while (b) {
+    int rc = sr_send(p->ring, p->me, dst, b->data, b->len);
+    if (rc == 0) return moved ? moved : -1;      // ring still full
+    if (rc < 0) {
+      // unreachable: inject_locked rejects oversize blobs up front.
+      // Defensive: drop loudly rather than corrupt the FIFO.
+      fprintf(stderr, "cplane: dropping oversize backlog blob (%ld B)\n",
+              b->len);
+    }
+    p->backlog_head[dst] = b->next;
+    if (!b->next) p->backlog_tail[dst] = nullptr;
+    free(b->data);
+    free(b);
+    moved++;
+    b = p->backlog_head[dst];
+  }
+  return moved;
+}
+
+// inject one encoded blob, preserving per-destination FIFO order
+int inject_locked(CPlane* p, int dst, const void* blob, long len) {
+  if (dst < 0 || dst >= p->n_local) return -1;
+  if (len > p->ring_cap) return -1;      // oversize: caller must spill
+  if (p->backlog_head[dst] == nullptr) {
+    int rc = sr_send(p->ring, p->me, dst, blob, len);
+    if (rc > 0) return 1;
+    if (rc < 0) return -1;
+  }
+  Blob* b = static_cast<Blob*>(malloc(sizeof(Blob)));
+  b->data = static_cast<uint8_t*>(malloc(len));
+  memcpy(b->data, blob, len);
+  b->len = len;
+  b->next = nullptr;
+  if (p->backlog_tail[dst]) p->backlog_tail[dst]->next = b;
+  else p->backlog_head[dst] = b;
+  p->backlog_tail[dst] = b;
+  return 1;
+}
+
+void py_push(CPlane* p, const uint8_t* blob, long len) {
+  Blob* b = static_cast<Blob*>(malloc(sizeof(Blob)));
+  b->data = static_cast<uint8_t*>(malloc(len));
+  memcpy(b->data, blob, len);
+  b->len = len;
+  b->next = nullptr;
+  if (p->py_tail) p->py_tail->next = b;
+  else p->py_head = b;
+  p->py_tail = b;
+  p->py_count.fetch_add(1, std::memory_order_release);
+  p->n_fwd_py++;
+}
+
+void complete_eager(CPlane* p, Req* r, const PktHdr* h,
+                    const uint8_t* payload) {
+  int64_t n = h->nbytes < r->cap ? h->nbytes : r->cap;
+  if (n > 0 && r->buf) memcpy(r->buf, payload, n);
+  r->st_src = h->comm_src;
+  r->st_tag = h->tag;
+  r->st_nbytes = h->nbytes;
+  r->truncated = h->nbytes > r->cap;
+  r->state = RS_DONE;
+  (void)p;
+}
+
+void assist_push(CPlane* p, Req* r, const uint8_t* blob, long len) {
+  AssistEntry* a = static_cast<AssistEntry*>(malloc(sizeof(AssistEntry)));
+  a->req_id = r->id;
+  a->blob = static_cast<uint8_t*>(malloc(len));
+  memcpy(a->blob, blob, len);
+  a->len = len;
+  a->next = nullptr;
+  if (p->assist_tail) p->assist_tail->next = a;
+  else p->assist_head = a;
+  p->assist_tail = a;
+  r->state = RS_ASSIST;
+  p->assist_count.fetch_add(1, std::memory_order_release);
+}
+
+UnexEntry* unex_add(CPlane* p, const PktHdr* h, const uint8_t* blob,
+                    long len) {
+  UnexEntry* e = static_cast<UnexEntry*>(calloc(1, sizeof(UnexEntry)));
+  e->type = h->type;
+  e->ctx = h->ctx;
+  e->src = h->comm_src;
+  e->tag = h->tag;
+  e->src_world = h->src_world;
+  e->sreq_id = h->sreq_id;
+  e->nbytes = h->nbytes;
+  e->blob = static_cast<uint8_t*>(malloc(len));
+  memcpy(e->blob, blob, len);
+  e->blob_len = len;
+  e->payload_off = sizeof(PktHdr) + h->exlen;
+  unex_push(p, e);
+  return e;
+}
+
+// process one inbound packet blob (plane mutex held)
+void process_blob(CPlane* p, const uint8_t* blob, long len) {
+  if (len < static_cast<long>(sizeof(PktHdr))) {
+    py_push(p, blob, len);               // runt: let python decide
+    return;
+  }
+  const PktHdr* h = reinterpret_cast<const PktHdr*>(blob);
+  const bool owned = ((h->ctx & 1) == 0) && p->ctxs.has(h->ctx);
+  if (h->type == PKT_EAGER_SEND && owned) {
+    const uint8_t* payload = blob + sizeof(PktHdr) + h->exlen;
+    p->n_eager_rx++;
+    for (Req* r = p->posted_head; r; r = r->next) {
+      if (env_match(r->ctx, r->src, r->tag, h->ctx, h->comm_src, h->tag)) {
+        posted_remove(p, r);
+        complete_eager(p, r, h, payload);
+        return;
+      }
+    }
+    unex_add(p, h, blob, len);
+    return;
+  }
+  if (h->type == PKT_RNDV_RTS && owned) {
+    for (Req* r = p->posted_head; r; r = r->next) {
+      if (env_match(r->ctx, r->src, r->tag, h->ctx, h->comm_src, h->tag)) {
+        posted_remove(p, r);
+        assist_push(p, r, blob, len);
+        return;
+      }
+    }
+    unex_add(p, h, blob, len);
+    return;
+  }
+  if (h->type == PKT_CANCEL_SEND_REQ) {
+    // Target side: retract a not-yet-matched send by (src_world, sreq_id).
+    // A responder route exists only when the canceller shares this
+    // segment (src_world == ring index on a plane-active world); a REQ
+    // from outside was never plane-matched here, so forward it.
+    if (h->src_world >= 0 && h->src_world < p->n_local) {
+      for (UnexEntry* e = p->unex_head; e; e = e->next) {
+        if (e->src_world == h->src_world && e->sreq_id == h->sreq_id &&
+            e->sreq_id != 0) {
+          unex_remove(p, e);
+          free(e->blob);
+          free(e);
+          PktHdr resp;
+          memset(&resp, 0, sizeof(resp));
+          resp.type = PKT_CANCEL_SEND_RESP;
+          resp.src_world = p->me;
+          resp.sreq_id = h->sreq_id;
+          resp.offset = 1;                // retracted
+          inject_locked(p, h->src_world, &resp, sizeof(resp));
+          ring_bell(p, h->src_world);
+          return;
+        }
+      }
+    }
+    py_push(p, blob, len);               // not ours: python matcher's turn
+    return;
+  }
+  if (h->type == PKT_CANCEL_SEND_RESP) {
+    for (CancelEntry* c = p->cancels; c; c = c->next) {
+      if (c->sreq_id == h->sreq_id && c->result == -1) {
+        c->result = h->offset ? 1 : 0;
+        return;
+      }
+    }
+    py_push(p, blob, len);
+    return;
+  }
+  py_push(p, blob, len);
+}
+
+// drain every inbound ring once (plane mutex held); returns packets seen
+int advance_locked(CPlane* p) {
+  int did = 0;
+  for (int src = 0; src < p->n_local; src++) {
+    // opportunistically flush our backlog toward src too; a successful
+    // flush rings the doorbell — the original inject's bell may have
+    // fired before the data actually reached the ring
+    if (p->backlog_head[src] && flush_backlog(p, src) > 0)
+      ring_bell(p, src);
+    while (true) {
+      const void* ptr = nullptr;
+      long len = sr_peek_view(p->ring, src, p->me, &ptr);
+      if (len <= 0) break;
+      const uint8_t* blob = static_cast<const uint8_t*>(ptr);
+      if (blob[0] == 0xFF) {
+        // oversize spill note: path follows the discriminator byte
+        char path[512];
+        long pl = len - 1 < 511 ? len - 1 : 511;
+        memcpy(path, blob + 1, pl);
+        path[pl] = 0;
+        int fd = open(path, O_RDONLY);
+        if (fd >= 0) {
+          struct stat st;
+          if (fstat(fd, &st) == 0 && st.st_size > 0) {
+            uint8_t* big = static_cast<uint8_t*>(malloc(st.st_size));
+            long got = 0;
+            while (got < st.st_size) {
+              ssize_t r = read(fd, big + got, st.st_size - got);
+              if (r <= 0) break;
+              got += r;
+            }
+            if (got == st.st_size) process_blob(p, big, got);
+            free(big);
+          }
+          close(fd);
+          unlink(path);
+        }
+      } else {
+        process_blob(p, blob, len);
+      }
+      sr_consume(p->ring, src, p->me);
+      did++;
+    }
+  }
+  return did;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// exported API
+// ---------------------------------------------------------------------------
+extern "C" {
+
+// process-global plane registry: libmpi.c's C fast path finds the plane
+// created by the Python bootstrap without any Python round-trip.
+static std::atomic<void*> g_plane{nullptr};
+
+void* cp_global(void) { return g_plane.load(std::memory_order_acquire); }
+
+void cp_register_global(void* cp) {
+  g_plane.store(cp, std::memory_order_release);
+}
+
+void* cp_create(void* ring, int my_index, int n_local,
+                const char* flags_path) {
+  CPlane* p = static_cast<CPlane*>(calloc(1, sizeof(CPlane)));
+  p->ring = ring;
+  p->me = my_index;
+  p->n_local = n_local;
+  p->ring_cap = sr_capacity(ring);
+  pthread_mutex_init(&p->mu, nullptr);
+  p->backlog_head = static_cast<Blob**>(calloc(n_local, sizeof(Blob*)));
+  p->backlog_tail = static_cast<Blob**>(calloc(n_local, sizeof(Blob*)));
+  p->next_req = 1;
+  p->next_token = 1;
+  p->failed = static_cast<uint8_t*>(calloc(n_local, 1));
+  p->bells = static_cast<struct sockaddr_un*>(
+      calloc(n_local, sizeof(struct sockaddr_un)));
+  p->bell_set = static_cast<uint8_t*>(calloc(n_local, 1));
+  p->bell_fd = -1;
+  p->bell_tx = socket(AF_UNIX, SOCK_DGRAM, 0);
+  p->flags = nullptr;
+  if (flags_path && flags_path[0]) {
+    int fd = open(flags_path, O_RDWR);
+    if (fd >= 0) {
+      void* m = mmap(nullptr, n_local, PROT_READ | PROT_WRITE, MAP_SHARED,
+                     fd, 0);
+      if (m != MAP_FAILED) {
+        p->flags = static_cast<uint8_t*>(m);
+        p->flags_len = n_local;
+      }
+      close(fd);
+    }
+  }
+  return p;
+}
+
+void cp_destroy(void* cp) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (!p) return;
+  void* g = g_plane.load(std::memory_order_acquire);
+  if (g == cp) g_plane.store(nullptr, std::memory_order_release);
+  if (p->flags) munmap(p->flags, p->flags_len);
+  if (p->bell_tx >= 0) close(p->bell_tx);
+  for (int d = 0; d < p->n_local; d++) {
+    Blob* b = p->backlog_head[d];
+    while (b) { Blob* n = b->next; free(b->data); free(b); b = n; }
+  }
+  free(p->backlog_head);
+  free(p->backlog_tail);
+  UnexEntry* e = p->unex_head;
+  while (e) { UnexEntry* n = e->next; free(e->blob); free(e); e = n; }
+  e = p->parked;
+  while (e) { UnexEntry* n = e->next; free(e->blob); free(e); e = n; }
+  Blob* b = p->py_head;
+  while (b) { Blob* n = b->next; free(b->data); free(b); b = n; }
+  AssistEntry* a = p->assist_head;
+  while (a) { AssistEntry* n = a->next; free(a->blob); free(a); a = n; }
+  CancelEntry* c = p->cancels;
+  while (c) { CancelEntry* n = c->next; free(c); c = n; }
+  for (int64_t i = 1; i < p->next_req; i++)
+    if (p->reqs[i]) free(p->reqs[i]);
+  free(p->reqs);
+  free(p->failed);
+  free(p->bells);
+  free(p->bell_set);
+  free(p->ctxs.v);
+  pthread_mutex_destroy(&p->mu);
+  free(p);
+}
+
+int cp_set_bell(void* cp, int dst, const char* path) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (dst < 0 || dst >= p->n_local) return -1;
+  struct sockaddr_un* a = &p->bells[dst];
+  memset(a, 0, sizeof(*a));
+  a->sun_family = AF_UNIX;
+  strncpy(a->sun_path, path, sizeof(a->sun_path) - 1);
+  p->bell_set[dst] = 1;
+  return 0;
+}
+
+void cp_set_wait_fd(void* cp, int fd) {
+  static_cast<CPlane*>(cp)->bell_fd = fd;
+}
+
+void cp_ctx_enable(void* cp, int ctx) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  p->ctxs.add(ctx);
+  pthread_mutex_unlock(&p->mu);
+}
+
+void cp_ctx_disable(void* cp, int ctx) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  p->ctxs.del(ctx);
+  // purge unexpected messages for the retired context (comm freed)
+  UnexEntry* e = p->unex_head;
+  while (e) {
+    UnexEntry* n = e->next;
+    if (e->ctx == ctx) {
+      unex_remove(p, e);
+      free(e->blob);
+      free(e);
+    }
+    e = n;
+  }
+  pthread_mutex_unlock(&p->mu);
+}
+
+int cp_ctx_owned(void* cp, int ctx) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  int r = p->ctxs.has(ctx) ? 1 : 0;
+  pthread_mutex_unlock(&p->mu);
+  return r;
+}
+
+int cp_inject(void* cp, int dst, const void* blob, long len) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  int rc = inject_locked(p, dst, blob, len);
+  pthread_mutex_unlock(&p->mu);
+  if (rc > 0) ring_bell(p, dst);
+  return rc;
+}
+
+long long cp_send_eager(void* cp, int dst, int ctx, int comm_src, int tag,
+                        const void* payload, long nbytes,
+                        long long sreq_id) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (dst < 0 || dst >= p->n_local) return -1;
+  if (p->failed[dst]) return -2;               // MPIX_ERR_PROC_FAILED
+  // build header + payload contiguously; small messages fit the stack
+  long total = sizeof(PktHdr) + nbytes;
+  uint8_t stackbuf[4096 + sizeof(PktHdr)];
+  uint8_t* blob = total <= static_cast<long>(sizeof(stackbuf))
+                      ? stackbuf
+                      : static_cast<uint8_t*>(malloc(total));
+  PktHdr* h = reinterpret_cast<PktHdr*>(blob);
+  memset(h, 0, sizeof(*h));
+  h->type = PKT_EAGER_SEND;
+  h->src_world = p->me;
+  h->ctx = ctx;
+  h->comm_src = comm_src;
+  h->tag = tag;
+  h->nbytes = nbytes;
+  h->sreq_id = sreq_id;
+  if (nbytes > 0) memcpy(blob + sizeof(PktHdr), payload, nbytes);
+  pthread_mutex_lock(&p->mu);
+  int rc = inject_locked(p, dst, blob, total);
+  p->n_eager_tx++;
+  pthread_mutex_unlock(&p->mu);
+  if (blob != stackbuf) free(blob);
+  if (rc <= 0) return -1;
+  ring_bell(p, dst);
+  return 0;
+}
+
+long long cp_irecv(void* cp, void* buf, long cap, int ctx, int src,
+                   int tag) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  // match the unexpected queue first (arrival order)
+  for (UnexEntry* e = p->unex_head; e; e = e->next) {
+    if (!env_match(ctx, src, tag, e->ctx, e->src, e->tag)) continue;
+    unex_remove(p, e);
+    Req* r = new_req(p);
+    r->buf = buf;
+    r->cap = cap;
+    r->ctx = ctx;
+    r->src = src;
+    r->tag = tag;
+    if (e->type == PKT_EAGER_SEND) {
+      const PktHdr* h = reinterpret_cast<const PktHdr*>(e->blob);
+      complete_eager(p, r, h, e->blob + e->payload_off);
+      free(e->blob);
+      free(e);
+    } else {                                   // RTS -> python assist
+      assist_push(p, r, e->blob, e->blob_len);
+      free(e->blob);
+      free(e);
+    }
+    int64_t id = r->id;
+    pthread_mutex_unlock(&p->mu);
+    return id;
+  }
+  Req* r = new_req(p);
+  r->buf = buf;
+  r->cap = cap;
+  r->ctx = ctx;
+  r->src = src;
+  r->tag = tag;
+  r->state = RS_PENDING;
+  posted_push(p, r);
+  int64_t id = r->id;
+  pthread_mutex_unlock(&p->mu);
+  return id;
+}
+
+int cp_req_state(void* cp, long long req) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  Req* r = get_req(p, req);
+  int s = r ? r->state : RS_FREE;
+  pthread_mutex_unlock(&p->mu);
+  return s;
+}
+
+int cp_req_status(void* cp, long long req, int* src, int* tag,
+                  long long* nbytes, int* truncated, int* errclass) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  Req* r = get_req(p, req);
+  if (!r) { pthread_mutex_unlock(&p->mu); return -1; }
+  if (src) *src = r->st_src;
+  if (tag) *tag = r->st_tag;
+  if (nbytes) *nbytes = r->st_nbytes;
+  if (truncated) *truncated = r->truncated;
+  if (errclass) *errclass = r->errclass;
+  pthread_mutex_unlock(&p->mu);
+  return 0;
+}
+
+void cp_req_free(void* cp, long long req) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  Req* r = get_req(p, req);
+  if (r) {
+    if (r->state == RS_PENDING) posted_remove(p, r);
+    free(r);
+    p->reqs[req] = nullptr;
+  }
+  pthread_mutex_unlock(&p->mu);
+}
+
+int cp_cancel_recv(void* cp, long long req) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  Req* r = get_req(p, req);
+  int ok = 0;
+  if (r && r->state == RS_PENDING) {
+    posted_remove(p, r);
+    r->state = RS_DONE;
+    r->st_src = -1;
+    r->st_tag = ANY_TAG;
+    r->st_nbytes = 0;
+    ok = 1;
+  }
+  pthread_mutex_unlock(&p->mu);
+  return ok;
+}
+
+void cp_complete_assist(void* cp, long long req, long long nbytes, int src,
+                        int tag, int errclass) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  Req* r = get_req(p, req);
+  if (r) {
+    r->st_src = src;
+    r->st_tag = tag;
+    r->st_nbytes = nbytes;
+    r->truncated = nbytes > r->cap;
+    r->errclass = errclass;
+    r->state = RS_DONE;
+  }
+  pthread_mutex_unlock(&p->mu);
+}
+
+int cp_error_req(void* cp, long long req, int errclass) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  Req* r = get_req(p, req);
+  if (!r) { pthread_mutex_unlock(&p->mu); return -1; }
+  if (r->state == RS_PENDING) posted_remove(p, r);
+  r->errclass = errclass;
+  r->state = RS_DONE;
+  pthread_mutex_unlock(&p->mu);
+  return 0;
+}
+
+int cp_advance(void* cp) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  int did = advance_locked(p);
+  pthread_mutex_unlock(&p->mu);
+  return did;
+}
+
+int cp_py_pending(void* cp) {
+  return static_cast<CPlane*>(cp)->py_count.load(std::memory_order_acquire);
+}
+
+long cp_py_peek(void* cp) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  long n = p->py_head ? p->py_head->len : 0;
+  pthread_mutex_unlock(&p->mu);
+  return n;
+}
+
+long cp_py_pop(void* cp, void* buf, long maxlen) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  Blob* b = p->py_head;
+  if (!b) { pthread_mutex_unlock(&p->mu); return 0; }
+  if (b->len > maxlen) { pthread_mutex_unlock(&p->mu); return -b->len; }
+  memcpy(buf, b->data, b->len);
+  p->py_head = b->next;
+  if (!p->py_head) p->py_tail = nullptr;
+  p->py_count.fetch_sub(1, std::memory_order_release);
+  long n = b->len;
+  free(b->data);
+  free(b);
+  pthread_mutex_unlock(&p->mu);
+  return n;
+}
+
+int cp_assist_pending(void* cp) {
+  return static_cast<CPlane*>(cp)->assist_count.load(
+      std::memory_order_acquire);
+}
+
+long cp_assist_peek(void* cp) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  long n = p->assist_head ? p->assist_head->len : 0;
+  pthread_mutex_unlock(&p->mu);
+  return n;
+}
+
+long cp_assist_pop(void* cp, long long* req, void* buf, long maxlen) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  AssistEntry* a = p->assist_head;
+  if (!a) { pthread_mutex_unlock(&p->mu); return 0; }
+  if (a->len > maxlen) { pthread_mutex_unlock(&p->mu); return -a->len; }
+  *req = a->req_id;
+  memcpy(buf, a->blob, a->len);
+  p->assist_head = a->next;
+  if (!p->assist_head) p->assist_tail = nullptr;
+  p->assist_count.fetch_sub(1, std::memory_order_release);
+  long n = a->len;
+  free(a->blob);
+  free(a);
+  pthread_mutex_unlock(&p->mu);
+  return n;
+}
+
+// probe: 1 = eager found, 2 = RTS found, 0 = none.
+// remove_: 0 probe, 1 mprobe (parks the entry under *o_token).
+int cp_probe(void* cp, int ctx, int src, int tag, int remove_, int* o_src,
+             int* o_tag, long long* o_nbytes, long long* o_token) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  for (UnexEntry* e = p->unex_head; e; e = e->next) {
+    if (!env_match(ctx, src, tag, e->ctx, e->src, e->tag)) continue;
+    if (o_src) *o_src = e->src;
+    if (o_tag) *o_tag = e->tag;
+    if (o_nbytes) *o_nbytes = e->nbytes;
+    int kind = e->type == PKT_EAGER_SEND ? 1 : 2;
+    if (remove_) {
+      unex_remove(p, e);
+      e->token = p->next_token++;
+      e->next = p->parked;
+      e->prev = nullptr;
+      p->parked = e;
+      if (o_token) *o_token = e->token;
+    }
+    pthread_mutex_unlock(&p->mu);
+    return kind;
+  }
+  pthread_mutex_unlock(&p->mu);
+  return 0;
+}
+
+// receive a parked (mprobe'd) message; returns a request id or -1
+long long cp_mrecv_start(void* cp, long long token, void* buf, long cap) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  UnexEntry* prev = nullptr;
+  UnexEntry* e = p->parked;
+  while (e && e->token != token) { prev = e; e = e->next; }
+  if (!e) { pthread_mutex_unlock(&p->mu); return -1; }
+  if (prev) prev->next = e->next;
+  else p->parked = e->next;
+  Req* r = new_req(p);
+  r->buf = buf;
+  r->cap = cap;
+  r->ctx = e->ctx;
+  r->src = e->src;
+  r->tag = e->tag;
+  if (e->type == PKT_EAGER_SEND) {
+    const PktHdr* h = reinterpret_cast<const PktHdr*>(e->blob);
+    complete_eager(p, r, h, e->blob + e->payload_off);
+  } else {
+    assist_push(p, r, e->blob, e->blob_len);
+  }
+  free(e->blob);
+  free(e);
+  int64_t id = r->id;
+  pthread_mutex_unlock(&p->mu);
+  return id;
+}
+
+// origin-side send cancel: emit CANCEL_SEND_REQ toward dst, track result
+int cp_cancel_send(void* cp, long long sreq_id, int dst) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (dst < 0 || dst >= p->n_local) return -1;
+  PktHdr h;
+  memset(&h, 0, sizeof(h));
+  h.type = PKT_CANCEL_SEND_REQ;
+  h.src_world = p->me;
+  h.sreq_id = sreq_id;
+  pthread_mutex_lock(&p->mu);
+  CancelEntry* c = static_cast<CancelEntry*>(malloc(sizeof(CancelEntry)));
+  c->sreq_id = sreq_id;
+  c->result = -1;
+  c->next = p->cancels;
+  p->cancels = c;
+  inject_locked(p, dst, &h, sizeof(h));
+  pthread_mutex_unlock(&p->mu);
+  ring_bell(p, dst);
+  return 0;
+}
+
+// -1 pending, 0 not cancelled, 1 cancelled, -2 unknown
+int cp_cancel_result(void* cp, long long sreq_id) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  for (CancelEntry* c = p->cancels; c; c = c->next) {
+    if (c->sreq_id == sreq_id) {
+      int r = c->result;
+      pthread_mutex_unlock(&p->mu);
+      return r;
+    }
+  }
+  pthread_mutex_unlock(&p->mu);
+  return -2;
+}
+
+void cp_cancel_forget(void* cp, long long sreq_id) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  CancelEntry* prev = nullptr;
+  for (CancelEntry* c = p->cancels; c; prev = c, c = c->next) {
+    if (c->sreq_id == sreq_id) {
+      if (prev) prev->next = c->next;
+      else p->cancels = c->next;
+      free(c);
+      break;
+    }
+  }
+  pthread_mutex_unlock(&p->mu);
+}
+
+// failure support: mark a ring index failed; fail matching posted recvs
+void cp_mark_failed(void* cp, int ring_index) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (ring_index >= 0 && ring_index < p->n_local)
+    p->failed[ring_index] = 1;
+}
+
+int cp_posted_count(void* cp) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  int n = 0;
+  for (Req* r = p->posted_head; r; r = r->next) n++;
+  pthread_mutex_unlock(&p->mu);
+  return n;
+}
+
+int cp_posted_get(void* cp, int i, long long* req, int* ctx, int* src,
+                  int* tag) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  int n = 0;
+  for (Req* r = p->posted_head; r; r = r->next, n++) {
+    if (n == i) {
+      if (req) *req = r->id;
+      if (ctx) *ctx = r->ctx;
+      if (src) *src = r->src;
+      if (tag) *tag = r->tag;
+      pthread_mutex_unlock(&p->mu);
+      return 0;
+    }
+  }
+  pthread_mutex_unlock(&p->mu);
+  return -1;
+}
+
+int cp_unexpected_count(void* cp) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  int n = 0;
+  for (UnexEntry* e = p->unex_head; e; e = e->next) n++;
+  pthread_mutex_unlock(&p->mu);
+  return n;
+}
+
+void cp_stats(void* cp, unsigned long long* tx, unsigned long long* rx,
+              unsigned long long* fwd) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (tx) *tx = p->n_eager_tx;
+  if (rx) *rx = p->n_eager_rx;
+  if (fwd) *fwd = p->n_fwd_py;
+}
+
+// C-side blocking wait quantum for one request.
+// Returns: 2 request done, 1 python work pending (assist/inbox — caller
+// must run the python progress engine), 0 quantum elapsed with nothing.
+int cp_wait_quantum(void* cp, long long req, long spin_us, long block_ms) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  uint64_t spin_end = now_us() + spin_us;
+  while (true) {
+    pthread_mutex_lock(&p->mu);
+    advance_locked(p);
+    Req* r = get_req(p, req);
+    int st = r ? r->state : RS_FREE;
+    pthread_mutex_unlock(&p->mu);
+    if (st == RS_DONE || st == RS_FREE) return 2;
+    if (p->assist_count.load(std::memory_order_acquire) > 0 ||
+        p->py_count.load(std::memory_order_acquire) > 0)
+      return 1;
+    if (now_us() >= spin_end) break;
+    // brief pause between polls (PAUSE-like)
+    for (volatile int i = 0; i < 64; i++) {
+    }
+  }
+  // advertise sleep, final poll (race-free doorbell discipline), block
+  if (p->flags) p->flags[p->me] = 1;
+  pthread_mutex_lock(&p->mu);
+  advance_locked(p);
+  Req* r = get_req(p, req);
+  int st = r ? r->state : RS_FREE;
+  pthread_mutex_unlock(&p->mu);
+  if (st == RS_DONE || st == RS_FREE) {
+    if (p->flags) p->flags[p->me] = 0;
+    return 2;
+  }
+  if (p->assist_count.load(std::memory_order_acquire) > 0 ||
+      p->py_count.load(std::memory_order_acquire) > 0) {
+    if (p->flags) p->flags[p->me] = 0;
+    return 1;
+  }
+  if (p->bell_fd >= 0) {
+    fd_set rf;
+    FD_ZERO(&rf);
+    FD_SET(p->bell_fd, &rf);
+    struct timeval tv;
+    tv.tv_sec = block_ms / 1000;
+    tv.tv_usec = (block_ms % 1000) * 1000;
+    int sel = select(p->bell_fd + 1, &rf, nullptr, nullptr, &tv);
+    if (sel > 0) {
+      char tmp[512];
+      while (recv(p->bell_fd, tmp, sizeof(tmp), MSG_DONTWAIT) > 0) {
+      }
+    }
+  } else {
+    struct timespec ts = {0, 200000};          // 200 us fallback nap
+    nanosleep(&ts, nullptr);
+  }
+  if (p->flags) p->flags[p->me] = 0;
+  return 0;
+}
+
+}  // extern "C"

@@ -140,20 +140,51 @@ long sr_peek(void* handle, int src, int dst) {
 
 // Dequeue one message into buf (caller sized it via sr_peek). Returns the
 // message length, 0 if empty, -1 if buf too small.
+long sr_peek_view(void* handle, int src, int dst, const void** ptr);
+void sr_consume(void* handle, int src, int dst);
+
 long sr_recv(void* handle, int src, int dst, void* buf, long maxlen) {
+  const void* p = nullptr;
+  long len = sr_peek_view(handle, src, dst, &p);
+  if (len <= 0) return len;
+  if (len > maxlen) return -1;
+  std::memcpy(buf, p, static_cast<uint64_t>(len));
+  sr_consume(handle, src, dst);
+  return len;
+}
+
+// max usable message length for this region's rings
+long sr_capacity(void* handle) {
+  Region* r = static_cast<Region*>(handle);
+  return static_cast<long>(data_bytes(r) - 2 * kAlign - 4);
+}
+
+// Zero-copy drain support (cplane.cpp): expose the next message in-place.
+// Returns its length and sets *ptr to the payload (inside the ring), or 0
+// if empty. Messages never straddle the wrap, so the view is contiguous.
+// Caller parses, then calls sr_consume to advance the head.
+long sr_peek_view(void* handle, int src, int dst, const void** ptr) {
   Region* r = static_cast<Region*>(handle);
   long len = sr_peek(handle, src, dst);
   if (len <= 0) return len;
-  if (len > maxlen) return -1;
+  RingHdr* h = hdr(r, src, dst);
+  uint8_t* d = data(r, src, dst);
+  uint64_t cap = data_bytes(r);
+  uint64_t pos = h->head.load(std::memory_order_relaxed) % cap;
+  *ptr = d + pos + 4;
+  return len;
+}
+
+void sr_consume(void* handle, int src, int dst) {
+  Region* r = static_cast<Region*>(handle);
   RingHdr* h = hdr(r, src, dst);
   uint8_t* d = data(r, src, dst);
   uint64_t cap = data_bytes(r);
   uint64_t head = h->head.load(std::memory_order_relaxed);
   uint64_t pos = head % cap;
-  std::memcpy(buf, d + pos + 4, static_cast<uint64_t>(len));
+  uint32_t len = *reinterpret_cast<const uint32_t*>(d + pos);
   h->head.store(head + align_up(4 + static_cast<uint64_t>(len)),
                 std::memory_order_release);
-  return len;
 }
 
 void sr_detach(void* handle) {
