@@ -87,6 +87,26 @@ class Comm:
         self.device_channel = None
         # revoke-packet routing + failure unwind need ctx -> comm
         universe.comms_by_ctx[context_id] = self
+        # native data-plane ownership: when every member is co-resident
+        # on this process's shm segment, the C engine (native/cplane.cpp)
+        # owns envelope matching for BOTH this comm's contexts — senders
+        # and receivers route identically because membership is a
+        # comm-global property. Intercomm.__init__ re-evaluates with the
+        # remote group included.
+        self._plane_owned = False
+        self._plane_bind()
+
+    def _plane_bind(self) -> None:
+        # ownership is wire-carried (PLANE_CTX_FLAG): nothing to register
+        # with the C engine — sender and receiver derive the same answer
+        # from the same membership
+        pc = self.u.plane_channel
+        self._plane_owned = bool(
+            pc is not None and pc.plane
+            and all(w in pc.local_index for w in self._plane_members()))
+
+    def _plane_members(self):
+        return self.group.world_ranks
 
     # ------------------------------------------------------------------
     @property
@@ -606,6 +626,7 @@ class Comm:
             return
         self.attrs.delete_all(self)
         self.u.comms_by_ctx.pop(self.context_id, None)
+        self._plane_owned = False
         seg = getattr(self, "_shm_coll_seg", None)
         if seg not in (None, False):       # slotted shm collective segment
             seg.free()

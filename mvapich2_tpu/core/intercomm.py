@@ -92,6 +92,18 @@ class Intercomm(Comm):
         self.is_inter = True
         self.remote_group = remote_group
         self.local_comm = local_comm   # private intracomm over local group
+        # plane ownership must cover the remote group too (pt2pt targets
+        # name remote ranks); re-evaluate now that it is known
+        self._plane_bind()
+
+    def _plane_members(self):
+        # called once from Comm.__init__ before remote_group is set (the
+        # re-evaluation above runs again with it)
+        rg = getattr(self, "remote_group", None)
+        members = list(self.group.world_ranks)
+        if rg is not None:
+            members += list(rg.world_ranks)
+        return members
 
     # -- rank resolution: pt2pt/root ranks name the remote group ---------
     @property

@@ -415,6 +415,42 @@ def comm_free(ch: int) -> int:
     return 0
 
 
+def comm_plane_info(ch: int):
+    """C fast-path comm descriptor (native/mpi/fastpath.c): returns
+    (pt2pt ctx, my rank, size, [plane ring index per comm rank]) when the
+    communicator is plane-owned and intra, else None."""
+    try:
+        c = _comm(ch)
+    except Exception:
+        return None
+    u = c.u
+    pc = getattr(u, "plane_channel", None)
+    if pc is None or not pc.plane or not getattr(c, "_plane_owned", False) \
+            or c.is_inter:
+        return None
+    idx = []
+    for r in range(c.size):
+        w = c.group.world_of_rank(r)
+        i = pc.local_index.get(w, -1)
+        if i < 0:
+            return None
+        idx.append(i)
+    return (c.ctx_pt2pt, c.rank, c.size, idx)
+
+
+def plane_eager_threshold() -> int:
+    from .utils.config import get_config
+    return int(get_config()["SMP_EAGERSIZE"])
+
+
+def plane_progress() -> int:
+    """One python progress pass, driven from a C fast-path wait loop."""
+    u = uni.current_universe()
+    if u is None:
+        return 0
+    return 1 if u.engine.progress_poke() else 0
+
+
 def get_processor_name() -> str:
     return mpi.Get_processor_name()
 

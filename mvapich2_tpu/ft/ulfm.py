@@ -77,6 +77,48 @@ def mark_failed(universe, world_rank: int) -> None:
     eng.wakeup()
 
 
+def _fail_plane_recvs(universe, world_rank: int) -> None:
+    from ..core.status import ANY_SOURCE
+    pch = getattr(universe, "plane_channel", None)
+    if pch is None or not pch.plane:
+        return
+    import ctypes as ct
+    lib = pch._ring.lib
+    if world_rank in pch.local_index:
+        lib.cp_mark_failed(pch.plane, pch.local_index[world_rank])
+    to_fail = []
+    i = 0
+    while True:
+        rid = ct.c_longlong()
+        ctx = ct.c_int()
+        src = ct.c_int()
+        tag = ct.c_int()
+        if lib.cp_posted_get(pch.plane, i, rid, ctx, src, tag) != 0:
+            break
+        i += 1
+        comm = universe.comms_by_ctx.get(ctx.value & ~1)
+        if comm is None or comm.freed:
+            continue
+        if (ctx.value & 1) and world_rank in ft_members(comm) \
+                and tag.value < _FT_TAG_BASE:
+            to_fail.append(rid.value)
+        elif src.value == ANY_SOURCE:
+            if world_rank in comm.group.world_ranks \
+                    and world_rank not in comm._acked_failures:
+                to_fail.append(rid.value)
+        elif src.value != ANY_SOURCE \
+                and comm.world_of(src.value) == world_rank:
+            to_fail.append(rid.value)
+    for rid in to_fail:
+        lib.cp_error_req(pch.plane, rid, MPIX_ERR_PROC_FAILED)
+    # completed-with-error plane requests surface on the next poll; make
+    # sure blocked waiters re-check
+    for rid in to_fail:
+        req = pch._plane_recvs.get(rid)
+        if req is not None:
+            req._poll_plane()
+
+
 def ft_members(comm):
     """World ranks whose failure affects this comm's collectives —
     local group plus, for intercommunicators, the remote group."""
@@ -130,6 +172,11 @@ def _fail_dependent_recvs(universe, world_rank: int) -> None:
             req.complete(MPIException(
                 MPIX_ERR_PROC_FAILED,
                 f"recv source (world rank {world_rank}) failed"))
+    # plane-posted receives (native/cplane.cpp): same rules, applied to
+    # the C engine's posted queue. The error lands in the request slot
+    # (cp_error_req) and surfaces on the next completion poll — python
+    # wrappers raise it from _finalize; C waiters map the errclass.
+    _fail_plane_recvs(universe, world_rank)
     # rendezvous in flight: tracked sends to the dead rank and matched
     # recvs whose data must come from it
     for req in list(universe.engine.outstanding.values()):

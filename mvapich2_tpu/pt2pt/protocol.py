@@ -15,6 +15,7 @@ SMP_EAGERSIZE — the ibv_param.c:776-837,2354-2361 analog).
 
 from __future__ import annotations
 
+import ctypes as ct
 from typing import Optional, Tuple
 
 import numpy as np
@@ -26,7 +27,7 @@ from ..core.errors import (MPIException, MPIX_ERR_PROC_FAILED,
                            MPI_ERR_RANK, MPI_ERR_ARG, mpi_assert)
 from ..core.request import Request, CompletedRequest
 from ..core.status import Status, ANY_SOURCE, ANY_TAG, PROC_NULL
-from ..transport.base import Packet, PktType
+from ..transport.base import PLANE_CTX_FLAG, Packet, PktType
 from ..utils.config import cvar, get_config
 from ..utils.mlog import get_logger
 from .matching import Matcher
@@ -85,6 +86,130 @@ class RecvRequest(Request):
         return self.datatype.size * self.count
 
 
+class CPlaneRecvRequest(Request):
+    """Receive posted into the native data plane (native/cplane.cpp).
+
+    The C engine completes the match/copy; this wrapper finalizes lazily
+    (status fields, derived-type unpack from the scratch buffer) the
+    first time completion is observed — from the owning thread's wait
+    predicate or from the plane channel's progress pass."""
+
+    def __init__(self, engine, channel, buf, count: int, datatype: Datatype,
+                 match: Tuple[int, int, int]):
+        super().__init__(engine, "recv")
+        self.channel = channel
+        self.buf = buf
+        self.count = count
+        self.datatype = datatype
+        self.match = match
+        self.capacity = datatype.size * count
+        self.scratch: Optional[np.ndarray] = None
+        self.cpid = -1
+        self._view: Optional[np.ndarray] = None
+        if buf is not None and self.capacity > 0:
+            if datatype.is_contiguous:
+                mv = as_bytes_view(buf)
+                mpi_assert(len(mv) >= self.capacity, MPI_ERR_ARG,
+                           f"recv buffer too small: {len(mv)} "
+                           f"< {self.capacity}")
+                self._view = np.frombuffer(mv, dtype=np.uint8,
+                                           count=self.capacity)
+            else:
+                self.scratch = np.empty(self.capacity, dtype=np.uint8)
+                self._view = self.scratch
+        self._addr = self._view.ctypes.data if self._view is not None else 0
+
+    def post(self, poster) -> None:
+        """``poster(addr, cap) -> cp request id`` (cp_irecv / cp_mrecv)."""
+        ch = self.channel
+        self.cpid = poster(self._addr, self.capacity)
+        lib = ch._ring.lib
+        st = lib.cp_req_state(ch.plane, self.cpid)
+        if st == 2:
+            self._finalize()
+        else:
+            ch.plane_track_recv(self.cpid, self)
+            self._cancel_fn = self._plane_cancel
+
+    def _plane_cancel(self) -> bool:
+        ch = self.channel
+        if ch.plane and ch._ring.lib.cp_cancel_recv(ch.plane,
+                                                    self.cpid) == 1:
+            ch.plane_untrack_recv(self.cpid)
+            ch._ring.lib.cp_req_free(ch.plane, self.cpid)
+            return True
+        return False
+
+    def _poll_plane(self) -> bool:
+        """Engine-mutex-held completion check; finalizes once."""
+        if self.complete_flag:
+            return True
+        ch = self.channel
+        if not ch.plane or self.cpid < 0:
+            return False
+        if ch._ring.lib.cp_req_state(ch.plane, self.cpid) != 2:
+            return False
+        self._finalize()
+        return True
+
+    def _finalize(self) -> None:
+        ch = self.channel
+        lib = ch._ring.lib
+
+        src = ct.c_int()
+        tag = ct.c_int()
+        nb = ct.c_longlong()
+        tr = ct.c_int()
+        ec = ct.c_int()
+        lib.cp_req_status(ch.plane, self.cpid, src, tag, nb, tr, ec)
+        ch.plane_untrack_recv(self.cpid)
+        lib.cp_req_free(ch.plane, self.cpid)
+        if self.scratch is not None and self.buf is not None:
+            n = min(nb.value, self.capacity)
+            if n > 0:
+                self.datatype.unpack(self.scratch[:n], self.buf, self.count)
+        self.status.source = src.value
+        self.status.tag = tag.value
+        self.status.count = min(nb.value, self.capacity)
+        err = None
+        if ec.value:
+            err = MPIException(ec.value, "plane recv failed")
+        elif tr.value:
+            err = MPIException(MPI_ERR_TRUNCATE,
+                               f"message truncated: {nb.value} "
+                               f"> {self.capacity}")
+        self.complete(err)
+
+    def test(self) -> bool:
+        if not self.complete_flag and self.engine is not None:
+            self.engine.progress_poke()
+            with self.engine.mutex:
+                self._poll_plane()
+        return self.complete_flag
+
+    def wait(self) -> Status:
+        if not self.complete_flag and self.engine is not None:
+            self.engine.progress_wait(self._poll_plane)
+        if self.error is not None:
+            raise self.error
+        return self.status
+
+
+class PlaneMessage:
+    """Matched-message token from an mprobe on a plane-owned context
+    (the plane-side analog of the Packet returned by improbe)."""
+
+    __slots__ = ("token", "ctx", "comm_src", "tag", "nbytes")
+
+    def __init__(self, token: int, ctx: int, comm_src: int, tag: int,
+                 nbytes: int):
+        self.token = token
+        self.ctx = ctx
+        self.comm_src = comm_src
+        self.tag = tag
+        self.nbytes = nbytes
+
+
 class Pt2ptProtocol:
     """Per-rank protocol instance, bound to a progress engine + channels."""
 
@@ -102,6 +227,19 @@ class Pt2ptProtocol:
         eng.register_handler(PktType.CANCEL_SEND_RESP,
                              self._on_cancel_resp)
         self.cfg = get_config()
+        pch = getattr(universe, "plane_channel", None)
+        if pch is not None and pch.plane:
+            pch.plane_client = self
+
+    def _plane_route(self, ctx: int):
+        """The plane channel, iff ``ctx`` belongs to a plane-owned comm
+        (every member co-resident on this shm segment). Ownership is
+        decided once at comm creation (core/comm.py) so the sender and
+        receiver of any (ctx, src, dst) stream route identically."""
+        comm = self.u.comms_by_ctx.get(ctx & ~1)
+        if comm is not None and comm._plane_owned:
+            return self.u.plane_channel
+        return None
 
     # ------------------------------------------------------------------
     # send side
@@ -115,8 +253,16 @@ class Pt2ptProtocol:
         if dest_world in self.u.failed_ranks:
             raise MPIException(MPIX_ERR_PROC_FAILED,
                                f"send to failed world rank {dest_world}")
-        channel = self.u.channel_for(dest_world)
-        is_local = self.u.is_local(dest_world)
+        pch = self._plane_route(ctx)
+        if pch is not None:
+            # plane-owned ctx: ALL wire traffic (C-built eager below,
+            # python-encoded rendezvous/control here) rides the plane's
+            # ordered injector — one FIFO per (src,dst), self included
+            channel = pch
+            is_local = True
+        else:
+            channel = self.u.channel_for(dest_world)
+            is_local = self.u.is_local(dest_world)
         nbytes = datatype.size * count
         threshold = (self.cfg["SMP_EAGERSIZE"] if is_local
                      else self.cfg["EAGER_THRESHOLD"])
@@ -154,6 +300,38 @@ class Pt2ptProtocol:
             return breq
 
         if nbytes <= threshold and mode != "sync":
+            if pch is not None:
+                # C-built eager: header + payload assembled and injected
+                # natively (the ibv_send_inline.h:493 moment)
+                if datatype.is_contiguous:
+                    mv = as_bytes_view(buf)
+                    mpi_assert(len(mv) >= nbytes, MPI_ERR_ARG,
+                               f"buffer too small: {len(mv)} < {nbytes}")
+                    arr = np.frombuffer(mv, dtype=np.uint8, count=nbytes) \
+                        if nbytes else None
+                else:
+                    arr = np.asarray(datatype.pack(buf, count)) \
+                        .view(np.uint8).reshape(-1)
+                sreq = SendRequest(self.engine, dest_world)
+                rc = pch._ring.lib.cp_send_eager(
+                    pch.plane, pch.local_index[dest_world], ctx, comm_src,
+                    tag, arr.ctypes.data if arr is not None else None,
+                    nbytes, sreq.req_id)
+                if rc == -2:
+                    from ..ft import ulfm
+                    ulfm.mark_failed(self.u, dest_world)
+                    raise MPIException(
+                        MPIX_ERR_PROC_FAILED,
+                        f"send to failed world rank {dest_world}")
+                if rc < 0:
+                    raise MPIException(MPI_ERR_INTERN,
+                                       "plane eager injection failed")
+                _pv_eager.inc()
+                _pv_bytes.inc(nbytes)
+                sreq._fire()
+                sreq._cancel_fn = lambda: self._plane_cancel_send(
+                    sreq, pch, dest_world)
+                return sreq
             if datatype.is_contiguous:
                 # zero-copy injection: every channel's send_packet
                 # copies the payload before returning (encode_packet
@@ -192,8 +370,12 @@ class Pt2ptProtocol:
             sreq.protocol = "RPUT"
         with self.engine.mutex:
             self.engine.track(sreq)
-        pkt = Packet(PktType.RNDV_RTS, self.u.world_rank, ctx, comm_src, tag,
-                     nbytes, None, sreq_id=sreq.req_id, protocol=sreq.protocol,
+        # plane-owned ctx: flag the RTS so the receiver's C matcher claims
+        # it (wire-carried ownership, PLANE_CTX_FLAG in cplane.cpp)
+        wire_ctx = ctx | PLANE_CTX_FLAG if pch is not None else ctx
+        pkt = Packet(PktType.RNDV_RTS, self.u.world_rank, wire_ctx, comm_src,
+                     tag, nbytes, None, sreq_id=sreq.req_id,
+                     protocol=sreq.protocol,
                      extra={"handle": sreq.handle} if sreq.handle is not None
                      else None)
         self._send_pkt(channel, dest_world, pkt)
@@ -205,6 +387,64 @@ class Pt2ptProtocol:
         _pv_rndv.inc()
         _pv_bytes.inc(nbytes)
         return sreq
+
+    def _plane_cancel_send(self, sreq, pch, dest_world: int) -> bool:
+        """Send-cancel for a plane-injected eager: CANCEL_SEND_REQ goes
+        through the plane; the C target retracts from its unexpected
+        queue (or the python matcher answers); the result lands via
+        cp_cancel_result, drained in the channel's progress pass."""
+        eng = self.engine
+        with eng.mutex:
+            if sreq.cancelled or getattr(sreq, "_cancel_pending", False):
+                return False
+            sreq._cancel_pending = True
+            sreq._cancel_was_complete = sreq.complete_flag
+            sreq.complete_flag = False
+            pch.plane_track_cancel(sreq.req_id, sreq)
+        pch._ring.lib.cp_cancel_send(pch.plane, sreq.req_id,
+                                     pch.local_index[dest_world])
+        return False
+
+    def on_plane_cancel_result(self, sreq, retracted: bool) -> None:
+        """Channel progress callback: the plane resolved a send-cancel
+        (mirrors _on_cancel_resp)."""
+        if sreq.complete_flag:
+            return
+        if retracted:
+            sreq.cancelled = True
+            sreq.status.cancelled = True
+            sreq.complete()
+        elif getattr(sreq, "_cancel_was_complete", False):
+            sreq.complete()
+
+    def on_plane_assist(self, pch, cpid: int, pkt: Packet) -> None:
+        """Channel progress callback: the plane matched an RNDV_RTS to a
+        C-posted receive (python- or C-origin) — run the rendezvous into
+        the plane request's buffer and complete it via the plane."""
+
+        lib = pch._ring.lib
+        bufp = ct.c_void_p()
+        cap = ct.c_longlong()
+        lib.cp_req_buf(pch.plane, cpid, bufp, cap)
+        n = int(cap.value or 0)
+        view = None
+        if bufp.value and n > 0:
+            view = np.frombuffer((ct.c_char * n).from_address(bufp.value),
+                                 dtype=np.uint8)
+        shadow = RecvRequest(self.engine, (pkt.ctx, pkt.comm_src, pkt.tag),
+                             view, n, dtmod.BYTE)
+
+        def done(r):
+            ec = r.error.error_class if r.error is not None else 0
+            if ec == MPI_ERR_TRUNCATE:
+                ec = 0        # the plane recomputes truncation from cap
+            lib.cp_complete_assist(pch.plane, cpid, pkt.nbytes,
+                                   pkt.comm_src, pkt.tag, ec)
+            self.engine.wakeup()
+
+        shadow.add_callback(done)
+        with self.engine.mutex:
+            self._rndv_recv_start(shadow, pkt)
 
     def _cancel_send(self, sreq, dest_world: int, channel) -> bool:
         """Initiate send-cancel; async — the RESP resolves it. A
@@ -268,6 +508,19 @@ class Pt2ptProtocol:
             req.status.source = PROC_NULL
             req.status.tag = ANY_TAG
             return req
+        pch = self._plane_route(ctx)
+        if pch is not None:
+            req = CPlaneRecvRequest(self.engine, pch, buf, count, datatype,
+                                    (ctx, source, tag))
+            with self.engine.mutex:
+                if self._recv_source_failed(ctx, source, tag):
+                    req.complete(MPIException(
+                        MPIX_ERR_PROC_FAILED,
+                        f"recv source failed (ctx={ctx}, src={source})"))
+                    return req
+                req.post(lambda addr, cap: pch._ring.lib.cp_irecv(
+                    pch.plane, addr, cap, ctx, source, tag))
+            return req
         req = RecvRequest(self.engine, (ctx, source, tag), buf, count,
                           datatype)
         with self.engine.mutex:
@@ -310,7 +563,34 @@ class Pt2ptProtocol:
         return comm.world_of(source) in self.u.failed_ranks
 
     # -- probe ----------------------------------------------------------
+    def _plane_peek(self, pch, ctx: int, source: int, tag: int,
+                    remove: bool = False):
+        """cp_probe wrapper; returns a Status-bearing PlaneMessage or
+        None. (Non-removing probes reuse the token slot as scratch.)"""
+
+        lib = pch._ring.lib
+        src = ct.c_int()
+        tg = ct.c_int()
+        nb = ct.c_longlong()
+        tok = ct.c_longlong()
+        kind = lib.cp_probe(pch.plane, ctx, source, tag,
+                            1 if remove else 0, src, tg, nb, tok)
+        if kind == 0:
+            return None
+        return PlaneMessage(tok.value if remove else 0, ctx, src.value,
+                            tg.value, nb.value)
+
     def iprobe(self, source: int, ctx: int, tag: int) -> Optional[Status]:
+        pch = self._plane_route(ctx)
+        if pch is not None:
+            msg = self._plane_peek(pch, ctx, source, tag)
+            if msg is None:
+                self.engine.progress_poke()
+                msg = self._plane_peek(pch, ctx, source, tag)
+            if msg is None and self._recv_source_failed(ctx, source, tag):
+                raise MPIException(MPIX_ERR_PROC_FAILED,
+                                   f"probe source failed (src={source})")
+            return self._pkt_status(msg) if msg is not None else None
         with self.engine.mutex:
             pkt = self.matcher.peek_unexpected(ctx, source, tag)
         if pkt is None:
@@ -323,10 +603,13 @@ class Pt2ptProtocol:
         return self._pkt_status(pkt) if pkt is not None else None
 
     def probe(self, source: int, ctx: int, tag: int) -> Status:
+        pch = self._plane_route(ctx)
         box: list = []
 
         def pred():
-            pkt = self.matcher.peek_unexpected(ctx, source, tag)
+            pkt = (self._plane_peek(pch, ctx, source, tag)
+                   if pch is not None
+                   else self.matcher.peek_unexpected(ctx, source, tag))
             if pkt is not None:
                 box.append(pkt)
                 return True
@@ -341,7 +624,17 @@ class Pt2ptProtocol:
         return self._pkt_status(box[0])
 
     def improbe(self, source: int, ctx: int, tag: int):
-        """Returns a matched-message token (the pkt) or None."""
+        """Returns a matched-message token (pkt / PlaneMessage) or None."""
+        pch = self._plane_route(ctx)
+        if pch is not None:
+            msg = self._plane_peek(pch, ctx, source, tag, remove=True)
+            if msg is None:
+                self.engine.progress_poke()
+                msg = self._plane_peek(pch, ctx, source, tag, remove=True)
+            if msg is None and self._recv_source_failed(ctx, source, tag):
+                raise MPIException(MPIX_ERR_PROC_FAILED,
+                                   f"probe source failed (src={source})")
+            return msg
         with self.engine.mutex:
             pkt = self.matcher.peek_unexpected(ctx, source, tag, remove=True)
         if pkt is None:
@@ -354,8 +647,17 @@ class Pt2ptProtocol:
                                f"probe source failed (src={source})")
         return pkt
 
-    def mrecv(self, message: Packet, buf, count: int,
+    def mrecv(self, message, buf, count: int,
               datatype: Datatype) -> Request:
+        if isinstance(message, PlaneMessage):
+            pch = self.u.plane_channel
+            req = CPlaneRecvRequest(self.engine, pch, buf, count, datatype,
+                                    (message.ctx, message.comm_src,
+                                     message.tag))
+            with self.engine.mutex:
+                req.post(lambda addr, cap: pch._ring.lib.cp_mrecv_start(
+                    pch.plane, message.token, addr, cap))
+            return req
         req = RecvRequest(self.engine, (message.ctx, message.comm_src,
                                         message.tag), buf, count, datatype)
         with self.engine.mutex:

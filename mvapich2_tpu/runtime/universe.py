@@ -52,6 +52,7 @@ class Universe:
         self.protocol: Optional[Pt2ptProtocol] = None
         self._channels: Dict[int, Channel] = {}   # world rank -> channel
         self._default_channel: Optional[Channel] = None
+        self.plane_channel = None  # ShmChannel with native data plane
         self.comm_world = None
         self.comm_self = None
         self._next_ctx = 8  # 0/1: world pt2pt/coll, 2/3: self, 4+: spare

@@ -102,6 +102,12 @@ import struct as _struct
 _PKT_HDR = _struct.Struct("<Biiiiqqqq8si")
 PKT_HDR_SIZE = _PKT_HDR.size
 
+# Wire-carried plane ownership (native/cplane.cpp PLANE_CTX_FLAG): the
+# sender sets bit 30 of ctx on EAGER/RTS packets whose communicator is
+# plane-owned; the C matcher claims exactly those. decode_packet strips
+# it so a python fallback receiver (no native plane) still matches.
+PLANE_CTX_FLAG = 1 << 30
+
 
 def encode_packet(pkt: "Packet") -> bytes:
     """Serialize to one contiguous blob (single payload copy)."""
@@ -129,8 +135,8 @@ def decode_packet(blob) -> "Packet":
     data = None
     if len(blob) > pos:
         data = np.frombuffer(blob, dtype=np.uint8, offset=pos)
-    return Packet(PktType(ptype), src_world, ctx, comm_src, tag, nbytes,
-                  data, sreq_id, rreq_id,
+    return Packet(PktType(ptype), src_world, ctx & ~PLANE_CTX_FLAG,
+                  comm_src, tag, nbytes, data, sreq_id, rreq_id,
                   proto.rstrip(b"\0").decode("ascii"), offset, extra)
 
 

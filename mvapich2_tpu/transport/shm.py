@@ -37,6 +37,9 @@ log = get_logger("shm")
 
 cvar("SHM_RING_BYTES", 1 << 20, int, "shm",
      "Per-(src,dst)-pair ring size in bytes (analog of MV2_SMP_QUEUE_LENGTH).")
+cvar("USE_CPLANE", 1, int, "shm",
+     "Use the native C data plane (envelope matching in C) when the native "
+     "ring is available. 0 falls back to python-side matching.")
 
 _HEADER = 128
 _WRAP = 0xFFFFFFFF
@@ -88,11 +91,79 @@ def _load_native():
         lib.sr_recv.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
                                 ctypes.c_void_p, ctypes.c_long]
         lib.sr_detach.argtypes = [ctypes.c_void_p]
+        lib.sr_capacity.restype = ctypes.c_long
+        lib.sr_capacity.argtypes = [ctypes.c_void_p]
+        _bind_cplane(lib)
         _lib = lib
     except OSError as e:  # pragma: no cover
         log.warn("cannot load libshmring.so (%s); python fallback", e)
         _lib = None
     return _lib
+
+
+def _bind_cplane(lib) -> None:
+    """ctypes signatures for the native data plane (native/cplane.cpp)."""
+    L = ctypes
+    lib.cp_create.restype = L.c_void_p
+    lib.cp_create.argtypes = [L.c_void_p, L.c_int, L.c_int, L.c_char_p]
+    lib.cp_destroy.argtypes = [L.c_void_p]
+    lib.cp_register_global.argtypes = [L.c_void_p]
+    lib.cp_set_bell.argtypes = [L.c_void_p, L.c_int, L.c_char_p]
+    lib.cp_set_world.argtypes = [L.c_void_p, L.c_int, L.c_int]
+    lib.cp_set_wait_fd.argtypes = [L.c_void_p, L.c_int]
+    lib.cp_ctx_enable.argtypes = [L.c_void_p, L.c_int]
+    lib.cp_ctx_disable.argtypes = [L.c_void_p, L.c_int]
+    lib.cp_inject.argtypes = [L.c_void_p, L.c_int, L.c_char_p, L.c_long]
+    lib.cp_send_eager.restype = L.c_longlong
+    lib.cp_send_eager.argtypes = [L.c_void_p, L.c_int, L.c_int, L.c_int,
+                                  L.c_int, L.c_void_p, L.c_long, L.c_longlong]
+    lib.cp_irecv.restype = L.c_longlong
+    lib.cp_irecv.argtypes = [L.c_void_p, L.c_void_p, L.c_long, L.c_int,
+                             L.c_int, L.c_int]
+    lib.cp_req_state.argtypes = [L.c_void_p, L.c_longlong]
+    lib.cp_req_status.argtypes = [L.c_void_p, L.c_longlong,
+                                  L.POINTER(L.c_int), L.POINTER(L.c_int),
+                                  L.POINTER(L.c_longlong), L.POINTER(L.c_int),
+                                  L.POINTER(L.c_int)]
+    lib.cp_req_buf.argtypes = [L.c_void_p, L.c_longlong,
+                               L.POINTER(L.c_void_p), L.POINTER(L.c_longlong)]
+    lib.cp_req_free.argtypes = [L.c_void_p, L.c_longlong]
+    lib.cp_cancel_recv.argtypes = [L.c_void_p, L.c_longlong]
+    lib.cp_complete_assist.argtypes = [L.c_void_p, L.c_longlong, L.c_longlong,
+                                       L.c_int, L.c_int, L.c_int]
+    lib.cp_error_req.argtypes = [L.c_void_p, L.c_longlong, L.c_int]
+    lib.cp_advance.argtypes = [L.c_void_p]
+    lib.cp_py_pending.argtypes = [L.c_void_p]
+    lib.cp_py_peek.restype = L.c_long
+    lib.cp_py_peek.argtypes = [L.c_void_p]
+    lib.cp_py_pop.restype = L.c_long
+    lib.cp_py_pop.argtypes = [L.c_void_p, L.c_char_p, L.c_long]
+    lib.cp_assist_pending.argtypes = [L.c_void_p]
+    lib.cp_assist_peek.restype = L.c_long
+    lib.cp_assist_peek.argtypes = [L.c_void_p]
+    lib.cp_assist_pop.restype = L.c_long
+    lib.cp_assist_pop.argtypes = [L.c_void_p, L.POINTER(L.c_longlong),
+                                  L.c_char_p, L.c_long]
+    lib.cp_probe.argtypes = [L.c_void_p, L.c_int, L.c_int, L.c_int, L.c_int,
+                             L.POINTER(L.c_int), L.POINTER(L.c_int),
+                             L.POINTER(L.c_longlong), L.POINTER(L.c_longlong)]
+    lib.cp_mrecv_start.restype = L.c_longlong
+    lib.cp_mrecv_start.argtypes = [L.c_void_p, L.c_longlong, L.c_void_p,
+                                   L.c_long]
+    lib.cp_cancel_send.argtypes = [L.c_void_p, L.c_longlong, L.c_int]
+    lib.cp_cancel_result.argtypes = [L.c_void_p, L.c_longlong]
+    lib.cp_cancel_forget.argtypes = [L.c_void_p, L.c_longlong]
+    lib.cp_mark_failed.argtypes = [L.c_void_p, L.c_int]
+    lib.cp_posted_count.argtypes = [L.c_void_p]
+    lib.cp_posted_get.argtypes = [L.c_void_p, L.c_int,
+                                  L.POINTER(L.c_longlong), L.POINTER(L.c_int),
+                                  L.POINTER(L.c_int), L.POINTER(L.c_int)]
+    lib.cp_unexpected_count.argtypes = [L.c_void_p]
+    lib.cp_stats.argtypes = [L.c_void_p, L.POINTER(L.c_ulonglong),
+                             L.POINTER(L.c_ulonglong),
+                             L.POINTER(L.c_ulonglong)]
+    lib.cp_wait_quantum.argtypes = [L.c_void_p, L.c_longlong, L.c_long,
+                                    L.c_long]
 
 
 class _PyRing:
@@ -275,6 +346,41 @@ class ShmChannel(Channel):
         self._flags_path = flags_path
         self._flags_f = open(flags_path, "r+b")
         self._flags = mmap.mmap(self._flags_f.fileno(), self.n_local)
+        # -- native data plane (native/cplane.cpp) -----------------------
+        # C-side envelope matching for plane-owned contexts: created when
+        # the native ring is live; wired (bells, global registration) in
+        # finish_wiring() once every rank's business card is published.
+        self.plane = None
+        self._plane_recvs: Dict[int, object] = {}   # cp req id -> Request
+        self._plane_cancels: Dict[int, object] = {} # sreq id -> SendRequest
+        self.plane_client = None                    # Pt2ptProtocol hook
+        self._ring_cap = 0
+        if self.using_native and get_config()["USE_CPLANE"]:
+            lib = self._ring.lib
+            self.plane = lib.cp_create(self._ring.h, self.local_index[my_rank],
+                                       self.n_local, flags_path.encode())
+            self._ring_cap = lib.sr_capacity(self._ring.h)
+            if self.plane:
+                lib.cp_set_wait_fd(self.plane, self._bell.fileno())
+
+    def finish_wiring(self) -> None:
+        """Post-fence wiring: peer bell addresses into the plane, then
+        publish it process-globally so libmpi.c's C fast path can find it
+        (cp_global). Called by bootstrap after the business-card fence."""
+        if not self.plane:
+            return
+        lib = self._ring.lib
+        for r in self.local_ranks:
+            lib.cp_set_world(self.plane, self.local_index[r], r)
+            if r == self.my_rank:
+                continue
+            try:
+                addr = self.kvs.get(f"shm-bell-{r}")
+            except Exception:
+                continue
+            self._peer_bells[r] = addr
+            lib.cp_set_bell(self.plane, self.local_index[r], addr.encode())
+        lib.cp_register_global(self.plane)
 
     def _make_ring(self, path: str, ring_bytes: int, create: bool):
         lib = _load_native()
@@ -305,8 +411,15 @@ class ShmChannel(Channel):
 
     def send_packet(self, dest_world: int, pkt: Packet) -> None:
         blob = encode_packet(pkt)
-        src_i = self.local_index[self.my_rank]
         dst_i = self.local_index[dest_world]
+        if self.plane:
+            # plane mode: the C injector owns ordering + backlog; spill
+            # oversize blobs first so inject never sees one
+            if len(blob) > self._ring_cap:
+                blob = self._spill_oversize(blob)
+            self._ring.lib.cp_inject(self.plane, dst_i, blob, len(blob))
+            return
+        src_i = self.local_index[self.my_rank]
         with self._send_lock:
             bl = self._backlog.setdefault(dst_i, [])
             if bl:
@@ -374,6 +487,8 @@ class ShmChannel(Channel):
                     return
 
     def poll(self) -> bool:
+        if self.plane:
+            return self._poll_plane()
         my_i = self.local_index[self.my_rank]
         self._drain_bell()
         did = False
@@ -395,6 +510,69 @@ class ShmChannel(Channel):
                 self.engine.enqueue_incoming(decode_packet(blob))
                 did = True
         return did
+
+    # -- plane mode -------------------------------------------------------
+    def _poll_plane(self) -> bool:
+        """Progress pass in plane mode: the C engine drains the rings and
+        matches plane-owned envelopes; this drains what it forwarded —
+        python-owned packets, rendezvous assists, cancel results — and
+        finalizes any completed plane receives the engine is tracking."""
+        lib = self._ring.lib
+        self._drain_bell()
+        did = lib.cp_advance(self.plane) > 0
+        while lib.cp_py_pending(self.plane):
+            n = lib.cp_py_peek(self.plane)
+            if n <= 0:
+                break
+            buf = ctypes.create_string_buffer(n)
+            got = lib.cp_py_pop(self.plane, buf, n)
+            if got <= 0:
+                break
+            blob = buf.raw[:got]
+            if blob[0] == 0xFF:    # oversize spill note (python-owned pkt)
+                path = blob[1:].decode()
+                with open(path, "rb") as f:
+                    blob = f.read()
+                os.unlink(path)
+            self.engine.enqueue_incoming(decode_packet(blob))
+            did = True
+        client = self.plane_client
+        while client is not None and lib.cp_assist_pending(self.plane):
+            n = lib.cp_assist_peek(self.plane)
+            if n <= 0:
+                break
+            rid = ctypes.c_longlong()
+            buf = ctypes.create_string_buffer(n)
+            got = lib.cp_assist_pop(self.plane, rid, buf, n)
+            if got <= 0:
+                break
+            client.on_plane_assist(self, rid.value,
+                                   decode_packet(buf.raw[:got]))
+            did = True
+        if self._plane_cancels:
+            for sid in list(self._plane_cancels):
+                res = lib.cp_cancel_result(self.plane, sid)
+                if res >= 0:
+                    req = self._plane_cancels.pop(sid)
+                    lib.cp_cancel_forget(self.plane, sid)
+                    if client is not None:
+                        client.on_plane_cancel_result(req, bool(res))
+        if self._plane_recvs:
+            for cpid in list(self._plane_recvs):
+                req = self._plane_recvs.get(cpid)
+                if req is not None and req._poll_plane():
+                    did = True
+        return did
+
+    # registration hooks used by the protocol layer
+    def plane_track_recv(self, cpid: int, req) -> None:
+        self._plane_recvs[cpid] = req
+
+    def plane_untrack_recv(self, cpid: int) -> None:
+        self._plane_recvs.pop(cpid, None)
+
+    def plane_track_cancel(self, sreq_id: int, req) -> None:
+        self._plane_cancels[sreq_id] = req
 
     # -- zero-copy rendezvous (RGET over a scratch mmap — CMA analog) -----
     def expose_buffer(self, array: np.ndarray):
@@ -418,6 +596,12 @@ class ShmChannel(Channel):
             pass
 
     def close(self) -> None:
+        if self.plane:
+            try:
+                self._ring.lib.cp_destroy(self.plane)
+            except Exception:
+                pass
+            self.plane = None
         try:
             self._bell.close()
             os.unlink(self._bell_path)

@@ -77,6 +77,13 @@ constexpr uint8_t PKT_CANCEL_SEND_RESP = 34;
 constexpr int ANY_SOURCE = -1;
 constexpr int ANY_TAG = -2;
 
+// Wire-carried ownership: the SENDER flags packets whose communicator is
+// plane-owned (bit 30 of ctx).  Ownership is a static comm-global
+// property (all members co-resident), so sender and receiver always
+// agree — and there is no enable-ordering race when a comm is created on
+// one rank before another (the conformance create_group deadlock).
+constexpr int32_t PLANE_CTX_FLAG = 1 << 30;
+
 #pragma pack(push, 1)
 struct PktHdr {              // struct.Struct("<Biiiiqqqq8si"), 61 bytes
   uint8_t type;
@@ -202,6 +209,9 @@ struct CPlane {
   CtxSet ctxs;
   // failure set (ring indices)
   uint8_t* failed;
+  // ring index <-> world rank (wire src_world carries WORLD ranks so the
+  // python matcher and multi-node routing stay consistent)
+  int* world_of;
   // wakeup plumbing (mirrors ShmChannel's adaptive doorbell)
   uint8_t* flags;                // mmap'd sleep flags, one per local rank
   long flags_len;
@@ -362,6 +372,7 @@ void assist_push(CPlane* p, Req* r, const uint8_t* blob, long len) {
   a->req_id = r->id;
   a->blob = static_cast<uint8_t*>(malloc(len));
   memcpy(a->blob, blob, len);
+  reinterpret_cast<PktHdr*>(a->blob)->ctx &= ~PLANE_CTX_FLAG;
   a->len = len;
   a->next = nullptr;
   if (p->assist_tail) p->assist_tail->next = a;
@@ -375,7 +386,7 @@ UnexEntry* unex_add(CPlane* p, const PktHdr* h, const uint8_t* blob,
                     long len) {
   UnexEntry* e = static_cast<UnexEntry*>(calloc(1, sizeof(UnexEntry)));
   e->type = h->type;
-  e->ctx = h->ctx;
+  e->ctx = h->ctx & ~PLANE_CTX_FLAG;
   e->src = h->comm_src;
   e->tag = h->tag;
   e->src_world = h->src_world;
@@ -383,6 +394,8 @@ UnexEntry* unex_add(CPlane* p, const PktHdr* h, const uint8_t* blob,
   e->nbytes = h->nbytes;
   e->blob = static_cast<uint8_t*>(malloc(len));
   memcpy(e->blob, blob, len);
+  // python decodes assist blobs: hand it the clean ctx
+  reinterpret_cast<PktHdr*>(e->blob)->ctx = e->ctx;
   e->blob_len = len;
   e->payload_off = sizeof(PktHdr) + h->exlen;
   unex_push(p, e);
@@ -396,12 +409,16 @@ void process_blob(CPlane* p, const uint8_t* blob, long len) {
     return;
   }
   const PktHdr* h = reinterpret_cast<const PktHdr*>(blob);
-  const bool owned = ((h->ctx & 1) == 0) && p->ctxs.has(h->ctx);
+  // ownership travels on the wire (PLANE_CTX_FLAG, set by the sender);
+  // matching uses the clean ctx.  Both of the comm's contexts ride the
+  // C matcher, so host collectives are C-matched too.
+  const bool owned = (h->ctx & PLANE_CTX_FLAG) != 0;
+  const int32_t ctx = h->ctx & ~PLANE_CTX_FLAG;
   if (h->type == PKT_EAGER_SEND && owned) {
     const uint8_t* payload = blob + sizeof(PktHdr) + h->exlen;
     p->n_eager_rx++;
     for (Req* r = p->posted_head; r; r = r->next) {
-      if (env_match(r->ctx, r->src, r->tag, h->ctx, h->comm_src, h->tag)) {
+      if (env_match(r->ctx, r->src, r->tag, ctx, h->comm_src, h->tag)) {
         posted_remove(p, r);
         complete_eager(p, r, h, payload);
         return;
@@ -412,7 +429,7 @@ void process_blob(CPlane* p, const uint8_t* blob, long len) {
   }
   if (h->type == PKT_RNDV_RTS && owned) {
     for (Req* r = p->posted_head; r; r = r->next) {
-      if (env_match(r->ctx, r->src, r->tag, h->ctx, h->comm_src, h->tag)) {
+      if (env_match(r->ctx, r->src, r->tag, ctx, h->comm_src, h->tag)) {
         posted_remove(p, r);
         assist_push(p, r, blob, len);
         return;
@@ -423,10 +440,12 @@ void process_blob(CPlane* p, const uint8_t* blob, long len) {
   }
   if (h->type == PKT_CANCEL_SEND_REQ) {
     // Target side: retract a not-yet-matched send by (src_world, sreq_id).
-    // A responder route exists only when the canceller shares this
-    // segment (src_world == ring index on a plane-active world); a REQ
-    // from outside was never plane-matched here, so forward it.
-    if (h->src_world >= 0 && h->src_world < p->n_local) {
+    // src_world carries a WORLD rank; a responder route exists only when
+    // the canceller shares this segment (reverse-map to its ring index).
+    int src_ring = -1;
+    for (int i = 0; i < p->n_local; i++)
+      if (p->world_of[i] == h->src_world) { src_ring = i; break; }
+    if (src_ring >= 0) {
       for (UnexEntry* e = p->unex_head; e; e = e->next) {
         if (e->src_world == h->src_world && e->sreq_id == h->sreq_id &&
             e->sreq_id != 0) {
@@ -436,11 +455,11 @@ void process_blob(CPlane* p, const uint8_t* blob, long len) {
           PktHdr resp;
           memset(&resp, 0, sizeof(resp));
           resp.type = PKT_CANCEL_SEND_RESP;
-          resp.src_world = p->me;
+          resp.src_world = p->world_of[p->me];
           resp.sreq_id = h->sreq_id;
           resp.offset = 1;                // retracted
-          inject_locked(p, h->src_world, &resp, sizeof(resp));
-          ring_bell(p, h->src_world);
+          inject_locked(p, src_ring, &resp, sizeof(resp));
+          ring_bell(p, src_ring);
           return;
         }
       }
@@ -538,6 +557,8 @@ void* cp_create(void* ring, int my_index, int n_local,
   p->next_req = 1;
   p->next_token = 1;
   p->failed = static_cast<uint8_t*>(calloc(n_local, 1));
+  p->world_of = static_cast<int*>(calloc(n_local, sizeof(int)));
+  for (int i = 0; i < n_local; i++) p->world_of[i] = i;  // 1-node default
   p->bells = static_cast<struct sockaddr_un*>(
       calloc(n_local, sizeof(struct sockaddr_un)));
   p->bell_set = static_cast<uint8_t*>(calloc(n_local, 1));
@@ -586,11 +607,18 @@ void cp_destroy(void* cp) {
     if (p->reqs[i]) free(p->reqs[i]);
   free(p->reqs);
   free(p->failed);
+  free(p->world_of);
   free(p->bells);
   free(p->bell_set);
   free(p->ctxs.v);
   pthread_mutex_destroy(&p->mu);
   free(p);
+}
+
+void cp_set_world(void* cp, int ring_index, int world_rank) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (ring_index >= 0 && ring_index < p->n_local)
+    p->world_of[ring_index] = world_rank;
 }
 
 int cp_set_bell(void* cp, int dst, const char* path) {
@@ -665,8 +693,8 @@ long long cp_send_eager(void* cp, int dst, int ctx, int comm_src, int tag,
   PktHdr* h = reinterpret_cast<PktHdr*>(blob);
   memset(h, 0, sizeof(*h));
   h->type = PKT_EAGER_SEND;
-  h->src_world = p->me;
-  h->ctx = ctx;
+  h->src_world = p->world_of[p->me];
+  h->ctx = ctx | PLANE_CTX_FLAG;
   h->comm_src = comm_src;
   h->tag = tag;
   h->nbytes = nbytes;
@@ -743,6 +771,20 @@ int cp_req_status(void* cp, long long req, int* src, int* tag,
   if (nbytes) *nbytes = r->st_nbytes;
   if (truncated) *truncated = r->truncated;
   if (errclass) *errclass = r->errclass;
+  pthread_mutex_unlock(&p->mu);
+  return 0;
+}
+
+// buffer pointer + capacity of a request (assist path: python builds a
+// numpy view over the target buffer — including pure-C posted receives
+// whose buffer python never saw)
+int cp_req_buf(void* cp, long long req, void** buf, long long* cap) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  Req* r = get_req(p, req);
+  if (!r) { pthread_mutex_unlock(&p->mu); return -1; }
+  if (buf) *buf = r->buf;
+  if (cap) *cap = r->cap;
   pthread_mutex_unlock(&p->mu);
   return 0;
 }
@@ -935,7 +977,7 @@ int cp_cancel_send(void* cp, long long sreq_id, int dst) {
   PktHdr h;
   memset(&h, 0, sizeof(h));
   h.type = PKT_CANCEL_SEND_REQ;
-  h.src_world = p->me;
+  h.src_world = p->world_of[p->me];
   h.sreq_id = sreq_id;
   pthread_mutex_lock(&p->mu);
   CancelEntry* c = static_cast<CancelEntry*>(malloc(sizeof(CancelEntry)));
@@ -980,10 +1022,21 @@ void cp_cancel_forget(void* cp, long long sreq_id) {
 }
 
 // failure support: mark a ring index failed; fail matching posted recvs
+static std::atomic<int> g_any_failed{0};
+
 void cp_mark_failed(void* cp, int ring_index) {
   CPlane* p = static_cast<CPlane*>(cp);
   if (ring_index >= 0 && ring_index < p->n_local)
     p->failed[ring_index] = 1;
+  g_any_failed.store(1, std::memory_order_release);
+}
+
+// cheap global gate for the C fast path: after ANY failure it defers to
+// the python protocol layer, whose ULFM logic (acked failures, wildcard
+// re-arming) decides per-operation semantics
+int cp_any_failed(void* cp) {
+  (void)cp;
+  return g_any_failed.load(std::memory_order_acquire);
 }
 
 int cp_posted_count(void* cp) {

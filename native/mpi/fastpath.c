@@ -1,0 +1,547 @@
+/* fastpath.c — the C MPI fast path over the native data plane.
+ *
+ * The reference's small-message hot loop is native end-to-end
+ * (ch3_progress.c:186 progress, ibv_send_inline.h:493 inline send,
+ * ch3_smp_progress.c:740 SMP rings); rounds 1-3 forwarded every MPI call
+ * into the embedded interpreter at ~50-120 us/message.  This file keeps
+ * MPI_Send/Recv/Isend/Irecv/Wait/Test for contiguous builtin datatypes on
+ * plane-owned communicators entirely in C: no GIL, no Python frames —
+ * the envelope goes straight through native/cplane.cpp's matcher.
+ *
+ * Eligibility (checked per call, falls back to the shim path otherwise):
+ *   - the process plane exists (cp_global) and no failure is recorded
+ *   - the communicator is plane-owned (cached per handle; populated once
+ *     via cshim.comm_plane_info under the GIL)
+ *   - the datatype is a builtin with size == extent (contiguous packing)
+ *   - send payloads fit the eager threshold (SMP_EAGERSIZE)
+ *
+ * Blocking waits spin briefly then sleep on the shm doorbell
+ * (cp_wait_quantum); whenever the plane reports forwarded python work
+ * (rendezvous assists, collective packets) the loop takes the GIL once
+ * and runs the python progress engine, so large messages and mixed
+ * workloads keep flowing while a C rank blocks here. */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <dlfcn.h>
+#include <pthread.h>
+#include <stdatomic.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mpi.h"
+#include "libmpi_internal.h"
+
+#ifndef MV2T_REPO_ROOT
+#define MV2T_REPO_ROOT "."
+#endif
+
+typedef void *cph;
+
+static struct {
+    void *dl;
+    void *(*global)(void);
+    long long (*send_eager)(cph, int, int, int, int, const void *, long,
+                            long long);
+    long long (*irecv)(cph, void *, long, int, int, int);
+    int (*req_state)(cph, long long);
+    int (*req_status)(cph, long long, int *, int *, long long *, int *,
+                      int *);
+    void (*req_free)(cph, long long);
+    int (*cancel_recv)(cph, long long);
+    int (*advance)(cph);
+    int (*wait_quantum)(cph, long long, long, long);
+    int (*py_pending)(cph);
+    int (*assist_pending)(cph);
+    int (*cancel_send)(cph, long long, int);
+    int (*cancel_result)(cph, long long);
+    void (*cancel_forget)(cph, long long);
+    int (*any_failed)(cph);
+    int (*req_buf)(cph, long long, void **, long long *);
+} F;
+
+static int fp_state = -1;       /* -1 unknown, 0 unavailable, 1 ready */
+static long fp_threshold = 0;
+static pthread_mutex_t fp_mu = PTHREAD_MUTEX_INITIALIZER;
+static _Atomic long long fp_sreq_next = (1LL << 48);
+
+/* ------------------------------------------------------------------ */
+/* plumbing                                                            */
+/* ------------------------------------------------------------------ */
+
+static int fp_load_locked(void) {
+    char path[1024];
+    snprintf(path, sizeof(path), "%s/native/libshmring.so",
+             MV2T_REPO_ROOT);
+    F.dl = dlopen(path, RTLD_NOW | RTLD_GLOBAL);
+    if (F.dl == NULL)
+        return 0;
+#define SYM(field, name) \
+    do { \
+        *(void **)&F.field = dlsym(F.dl, name); \
+        if (F.field == NULL) return 0; \
+    } while (0)
+    SYM(global, "cp_global");
+    SYM(send_eager, "cp_send_eager");
+    SYM(irecv, "cp_irecv");
+    SYM(req_state, "cp_req_state");
+    SYM(req_status, "cp_req_status");
+    SYM(req_free, "cp_req_free");
+    SYM(cancel_recv, "cp_cancel_recv");
+    SYM(advance, "cp_advance");
+    SYM(wait_quantum, "cp_wait_quantum");
+    SYM(py_pending, "cp_py_pending");
+    SYM(assist_pending, "cp_assist_pending");
+    SYM(cancel_send, "cp_cancel_send");
+    SYM(cancel_result, "cp_cancel_result");
+    SYM(cancel_forget, "cp_cancel_forget");
+    SYM(any_failed, "cp_any_failed");
+    SYM(req_buf, "cp_req_buf");
+#undef SYM
+    return 1;
+}
+
+/* the live plane, or NULL when the fast path must stand down */
+static cph fp_plane(void) {
+    if (fp_state == 0)
+        return NULL;
+    if (fp_state < 0) {
+        pthread_mutex_lock(&fp_mu);
+        if (fp_state < 0)
+            fp_state = fp_load_locked() ? 1 : 0;
+        pthread_mutex_unlock(&fp_mu);
+        if (fp_state == 0)
+            return NULL;
+    }
+    cph p = F.global();
+    if (p == NULL)
+        return NULL;
+    if (F.any_failed(p))
+        return NULL;            /* ULFM semantics live in python */
+    return p;
+}
+
+/* one GIL-held python progress pass (assists, forwarded packets, tcp) */
+static void fp_py_progress(void) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "plane_progress", NULL);
+    if (res == NULL)
+        PyErr_Clear();
+    Py_XDECREF(res);
+    PyGILState_Release(st);
+}
+
+/* contiguous builtin datatype (size == extent, nonzero) */
+static int fp_dt_ok(MPI_Datatype dt) {
+    if (dt < 0 || dt >= 100)
+        return 0;
+    int sz = dt_size(dt);
+    return sz > 0 && (long)sz == dt_extent_b(dt);
+}
+
+/* ------------------------------------------------------------------ */
+/* per-communicator cache                                              */
+/* ------------------------------------------------------------------ */
+
+#define FP_MAX_COMM 4096
+
+typedef struct {
+    int state;                  /* 0 unknown, 1 plane-owned, 2 not */
+    int ctx, rank, size;
+    int *ring;                  /* comm rank -> plane ring index */
+} FpComm;
+
+static FpComm fp_comms[FP_MAX_COMM];
+
+static FpComm *fp_comm(MPI_Comm comm) {
+    if (comm < 0 || comm >= FP_MAX_COMM)
+        return NULL;
+    FpComm *fc = &fp_comms[comm];
+    if (fc->state == 1)
+        return fc;
+    if (fc->state == 2)
+        return NULL;
+    /* populate under the GIL (once per comm handle) */
+    PyGILState_STATE st = PyGILState_Ensure();
+    int ok = 0;
+    PyObject *res = PyObject_CallMethod(g_shim, "comm_plane_info", "(i)",
+                                        comm);
+    if (res != NULL && res != Py_None) {
+        PyObject *lst = NULL;
+        int ctx = 0, rank = 0, size = 0;
+        if (PyArg_ParseTuple(res, "iiiO", &ctx, &rank, &size, &lst)
+                && PyList_Check(lst)
+                && PyList_Size(lst) == size && size <= 1 << 20) {
+            int *ring = malloc(sizeof(int) * (size_t)size);
+            int good = ring != NULL;
+            for (int i = 0; good && i < size; i++) {
+                ring[i] = (int)PyLong_AsLong(PyList_GET_ITEM(lst, i));
+                if (ring[i] < 0)
+                    good = 0;
+            }
+            if (good) {
+                pthread_mutex_lock(&fp_mu);
+                if (fc->state == 0) {
+                    fc->ctx = ctx;
+                    fc->rank = rank;
+                    fc->size = size;
+                    fc->ring = ring;
+                    fc->state = 1;
+                } else {
+                    free(ring);
+                }
+                pthread_mutex_unlock(&fp_mu);
+                ok = 1;
+            } else {
+                free(ring);
+            }
+        }
+    }
+    if (PyErr_Occurred())
+        PyErr_Clear();
+    Py_XDECREF(res);
+    if (!ok && fc->state == 0)
+        fc->state = 2;
+    /* first successful bind also fetches the eager threshold */
+    if (ok && fp_threshold == 0) {
+        int tok;
+        long t = shim_call_v("plane_eager_threshold", &tok, "()");
+        if (tok && t > 0)
+            fp_threshold = t;
+    }
+    PyGILState_Release(st);
+    return fc->state == 1 ? fc : NULL;
+}
+
+void fp_comm_forget(MPI_Comm comm) {
+    if (comm < 0 || comm >= FP_MAX_COMM)
+        return;
+    pthread_mutex_lock(&fp_mu);
+    FpComm *fc = &fp_comms[comm];
+    if (fc->state == 1 && fc->ring != NULL)
+        free(fc->ring);
+    memset(fc, 0, sizeof(*fc));
+    pthread_mutex_unlock(&fp_mu);
+}
+
+/* ------------------------------------------------------------------ */
+/* request slots                                                       */
+/* ------------------------------------------------------------------ */
+
+#define FP_REQ_BASE 0x40000000
+#define FP_NREQ 65536
+
+enum { FPK_FREE = 0, FPK_RECV, FPK_SEND };
+
+typedef struct {
+    int kind;
+    long long cpid;             /* recv: plane request id */
+    long long sreq;             /* send: wire sreq id (cancel) */
+    int dst;                    /* send: ring index */
+    int comm;                   /* errhandler target */
+    int cancel_pending;
+} FpReq;
+
+static FpReq fp_reqs[FP_NREQ];
+static int fp_req_hint = 0;
+
+static int fp_slot_alloc(void) {
+    pthread_mutex_lock(&fp_mu);
+    for (int i = 0; i < FP_NREQ; i++) {
+        int s = (fp_req_hint + i) % FP_NREQ;
+        if (fp_reqs[s].kind == FPK_FREE) {
+            fp_reqs[s].kind = -1;       /* reserved */
+            fp_req_hint = s + 1;
+            pthread_mutex_unlock(&fp_mu);
+            return s;
+        }
+    }
+    pthread_mutex_unlock(&fp_mu);
+    return -1;
+}
+
+int fp_is_handle(MPI_Request req) {
+    return req >= FP_REQ_BASE && req < FP_REQ_BASE + FP_NREQ;
+}
+
+static void fp_slot_free(int s) {
+    pthread_mutex_lock(&fp_mu);
+    memset(&fp_reqs[s], 0, sizeof(fp_reqs[s]));
+    pthread_mutex_unlock(&fp_mu);
+}
+
+static void fp_status_empty(MPI_Status *st) {
+    if (st == MPI_STATUS_IGNORE)
+        return;
+    st->MPI_SOURCE = MPI_ANY_SOURCE;
+    st->MPI_TAG = MPI_ANY_TAG;
+    st->MPI_ERROR = MPI_SUCCESS;
+    st->_count = 0;
+    st->_cancelled = 0;
+}
+
+/* fill status from a DONE plane recv; returns the MPI error code */
+static int fp_recv_status(cph p, long long cpid, MPI_Status *stout) {
+    int src = 0, tag = 0, tr = 0, ec = 0;
+    long long nb = 0;
+    F.req_status(p, cpid, &src, &tag, &nb, &tr, &ec);
+    if (tr) {
+        /* delivered bytes are clamped to the buffer (MPI_Get_count
+         * must not over-report on truncation) */
+        void *b = NULL;
+        long long cap = 0;
+        F.req_buf(p, cpid, &b, &cap);
+        if (nb > cap)
+            nb = cap;
+    }
+    if (stout != MPI_STATUS_IGNORE) {
+        stout->MPI_SOURCE = src;
+        stout->MPI_TAG = tag;
+        stout->MPI_ERROR = MPI_SUCCESS;
+        stout->_count = nb;
+        stout->_cancelled = 0;
+    }
+    if (ec)
+        return ec;
+    if (tr)
+        return MPI_ERR_TRUNCATE;
+    return MPI_SUCCESS;
+}
+
+/* adaptive spin: grows when completions land during the spin window
+ * (busy peer on another core), shrinks when they arrive after the
+ * doorbell sleep (oversubscribed single core — don't burn the peer's
+ * timeslice).  Matches the reference's spin-count tuning knob
+ * (MV2_SPIN_COUNT, ch3_progress.c). */
+static long fp_spin_us = 40;
+
+static int fp_block_recv(cph p, long long cpid, MPI_Status *stout) {
+    int idle = 0;
+    for (;;) {
+        int rc = F.wait_quantum(p, cpid, fp_spin_us, 2);
+        if (rc == 2)
+            break;
+        if (rc == 1) {
+            fp_py_progress();
+        } else {
+            /* doorbell timeout: drop the spin, run python progress
+             * occasionally so non-plane work (tcp accepts, spawned
+             * children) cannot starve */
+            if (fp_spin_us > 4)
+                fp_spin_us /= 2;
+            if (++idle % 16 == 0)
+                fp_py_progress();
+        }
+        if (F.req_state(p, cpid) == 2)
+            break;
+    }
+    if (fp_spin_us < 200)
+        fp_spin_us += 4;
+    return fp_recv_status(p, cpid, stout);
+}
+
+/* ------------------------------------------------------------------ */
+/* operation entry points (called from libmpi.c wrappers)              */
+/* ------------------------------------------------------------------ */
+
+int fp_try_send(const void *buf, int count, MPI_Datatype dt, int dest,
+                int tag, MPI_Comm comm, int *out_rc) {
+    cph p = fp_plane();
+    if (p == NULL || dest < 0 || count < 0 || !fp_dt_ok(dt))
+        return 0;
+    FpComm *fc = fp_comm(comm);
+    if (fc == NULL || dest >= fc->size)
+        return 0;
+    long nb = (long)dt_size(dt) * count;
+    if (fp_threshold <= 0 || nb > fp_threshold)
+        return 0;
+    long long sid = atomic_fetch_add(&fp_sreq_next, 1);
+    if (F.send_eager(p, fc->ring[dest], fc->ctx, fc->rank, tag, buf, nb,
+                     sid) != 0)
+        return 0;               /* failed peer / full: slow path decides */
+    *out_rc = MPI_SUCCESS;
+    return 1;
+}
+
+int fp_try_recv(void *buf, int count, MPI_Datatype dt, int source,
+                int tag, MPI_Comm comm, MPI_Status *status, int *out_rc) {
+    cph p = fp_plane();
+    if (p == NULL || count < 0 || !fp_dt_ok(dt))
+        return 0;
+    if (source < 0 && source != MPI_ANY_SOURCE)
+        return 0;
+    FpComm *fc = fp_comm(comm);
+    if (fc == NULL || (source != MPI_ANY_SOURCE && source >= fc->size))
+        return 0;
+    long cap = (long)dt_size(dt) * count;
+    long long cpid = F.irecv(p, buf, cap, fc->ctx, source, tag);
+    *out_rc = fp_block_recv(p, cpid, status);
+    F.req_free(p, cpid);
+    return 1;
+}
+
+int fp_try_isend(const void *buf, int count, MPI_Datatype dt, int dest,
+                 int tag, MPI_Comm comm, MPI_Request *req, int *out_rc) {
+    cph p = fp_plane();
+    if (p == NULL || dest < 0 || count < 0 || !fp_dt_ok(dt))
+        return 0;
+    FpComm *fc = fp_comm(comm);
+    if (fc == NULL || dest >= fc->size)
+        return 0;
+    long nb = (long)dt_size(dt) * count;
+    if (fp_threshold <= 0 || nb > fp_threshold)
+        return 0;
+    int s = fp_slot_alloc();
+    if (s < 0)
+        return 0;
+    long long sid = atomic_fetch_add(&fp_sreq_next, 1);
+    if (F.send_eager(p, fc->ring[dest], fc->ctx, fc->rank, tag, buf, nb,
+                     sid) != 0) {
+        fp_slot_free(s);
+        return 0;
+    }
+    fp_reqs[s].kind = FPK_SEND;
+    fp_reqs[s].sreq = sid;
+    fp_reqs[s].dst = fc->ring[dest];
+    fp_reqs[s].comm = comm;
+    *req = FP_REQ_BASE + s;
+    *out_rc = MPI_SUCCESS;
+    return 1;
+}
+
+int fp_try_irecv(void *buf, int count, MPI_Datatype dt, int source,
+                 int tag, MPI_Comm comm, MPI_Request *req, int *out_rc) {
+    cph p = fp_plane();
+    if (p == NULL || count < 0 || !fp_dt_ok(dt))
+        return 0;
+    if (source < 0 && source != MPI_ANY_SOURCE)
+        return 0;
+    FpComm *fc = fp_comm(comm);
+    if (fc == NULL || (source != MPI_ANY_SOURCE && source >= fc->size))
+        return 0;
+    int s = fp_slot_alloc();
+    if (s < 0)
+        return 0;
+    long cap = (long)dt_size(dt) * count;
+    fp_reqs[s].cpid = F.irecv(p, buf, cap, fc->ctx, source, tag);
+    fp_reqs[s].kind = FPK_RECV;
+    fp_reqs[s].comm = comm;
+    *req = FP_REQ_BASE + s;
+    *out_rc = MPI_SUCCESS;
+    return 1;
+}
+
+int fp_wait(MPI_Request *req, MPI_Status *status) {
+    int s = *req - FP_REQ_BASE;
+    FpReq *r = &fp_reqs[s];
+    int rc = MPI_SUCCESS;
+    cph p = F.global ? F.global() : NULL;
+    if (r->kind == FPK_RECV) {
+        if (p != NULL) {
+            rc = fp_block_recv(p, r->cpid, status);
+            F.req_free(p, r->cpid);
+        } else {
+            fp_status_empty(status);
+        }
+        /* a retracted (cancelled) recv completes with the cancel bit */
+        if (r->cancel_pending && status != MPI_STATUS_IGNORE)
+            status->_cancelled = 1;
+    } else {                    /* send: locally complete */
+        fp_status_empty(status);
+        if (r->cancel_pending && p != NULL) {
+            int res;
+            while ((res = F.cancel_result(p, r->sreq)) < 0) {
+                if (res == -2)
+                    break;      /* unknown: treat as resolved, not       */
+                F.advance(p);   /* cancelled                              */
+                fp_py_progress();
+            }
+            F.cancel_forget(p, r->sreq);
+            if (status != MPI_STATUS_IGNORE)
+                status->_cancelled = res == 1;
+        }
+    }
+    int comm = r->comm;
+    fp_slot_free(s);
+    *req = MPI_REQUEST_NULL;
+    return mv2t_errcheck(comm, rc);
+}
+
+/* nondestructive completion check (Testall/Request_get_status) */
+int fp_peek_done(MPI_Request req) {
+    int s = req - FP_REQ_BASE;
+    FpReq *r = &fp_reqs[s];
+    cph p0 = F.global ? F.global() : NULL;
+    if (r->kind == FPK_SEND) {
+        /* a cancel-pending send is complete only once the cancel
+         * resolves — MPI_Test must stay nonblocking meanwhile */
+        if (r->cancel_pending && p0 != NULL) {
+            F.advance(p0);
+            if (F.py_pending(p0) > 0 || F.assist_pending(p0) > 0)
+                fp_py_progress();
+            return F.cancel_result(p0, r->sreq) != -1;
+        }
+        return 1;
+    }
+    cph p = p0;
+    if (p == NULL)
+        return 1;
+    F.advance(p);
+    if (F.py_pending(p) > 0 || F.assist_pending(p) > 0)
+        fp_py_progress();
+    return F.req_state(p, r->cpid) == 2;
+}
+
+int fp_test(MPI_Request *req, int *flag, MPI_Status *status) {
+    if (!fp_peek_done(*req)) {
+        *flag = 0;
+        return MPI_SUCCESS;
+    }
+    *flag = 1;
+    return fp_wait(req, status);
+}
+
+int fp_get_status(MPI_Request req, int *flag, MPI_Status *status) {
+    int s = req - FP_REQ_BASE;
+    FpReq *r = &fp_reqs[s];
+    if (!fp_peek_done(req)) {
+        *flag = 0;
+        return MPI_SUCCESS;
+    }
+    *flag = 1;
+    if (r->kind == FPK_RECV) {
+        cph p = F.global();
+        if (p != NULL)
+            (void)fp_recv_status(p, r->cpid, status);
+    } else {
+        fp_status_empty(status);
+    }
+    return MPI_SUCCESS;
+}
+
+int fp_cancel(MPI_Request req) {
+    int s = req - FP_REQ_BASE;
+    FpReq *r = &fp_reqs[s];
+    cph p = F.global ? F.global() : NULL;
+    if (p == NULL)
+        return MPI_SUCCESS;
+    if (r->kind == FPK_RECV) {
+        if (F.cancel_recv(p, r->cpid) == 1)
+            r->cancel_pending = 1;      /* retracted: surfaces in status */
+    } else if (!r->cancel_pending) {
+        r->cancel_pending = 1;
+        F.cancel_send(p, r->sreq, r->dst);
+    }
+    return MPI_SUCCESS;
+}
+
+int fp_free(MPI_Request *req) {
+    int s = *req - FP_REQ_BASE;
+    FpReq *r = &fp_reqs[s];
+    cph p = F.global ? F.global() : NULL;
+    if (r->kind == FPK_RECV && p != NULL)
+        F.req_free(p, r->cpid);
+    fp_slot_free(s);
+    *req = MPI_REQUEST_NULL;
+    return MPI_SUCCESS;
+}

@@ -337,6 +337,7 @@ int MPI_Comm_dup(MPI_Comm comm, MPI_Comm *newcomm) {
 int MPI_Comm_free(MPI_Comm *comm) {
     mv2t_attr_delete_all(0, *comm);
     mv2t_comm_eh_forget(*comm);
+    fp_comm_forget(*comm);
     shim_call_i("comm_free", "(i)", *comm);
     *comm = MPI_COMM_NULL;
     return MPI_SUCCESS;
@@ -389,6 +390,9 @@ int MPI_Get_address(const void *location, MPI_Aint *address) {
 
 int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
              int tag, MPI_Comm comm) {
+    int frc;
+    if (fp_try_send(buf, count, dt, dest, tag, comm, &frc))
+        return mv2t_errcheck(comm, frc);
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *view = mv_view(buf, dt_span_b(dt, count));
     PyObject *res = PyObject_CallMethod(g_shim, "send", "(Oiiiii)", view,
@@ -404,6 +408,9 @@ int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
 
 int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
              MPI_Comm comm, MPI_Status *status) {
+    int frc;
+    if (fp_try_recv(buf, count, dt, source, tag, comm, status, &frc))
+        return mv2t_errcheck(comm, frc);
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *view = mv_view(buf, dt_span_b(dt, count));
     PyObject *res = PyObject_CallMethod(g_shim, "recv", "(Oiiiii)", view,
@@ -452,12 +459,18 @@ static MPI_Request isend_irecv(const char *fn, void *buf, int count,
 
 int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest,
               int tag, MPI_Comm comm, MPI_Request *req) {
+    int frc;
+    if (fp_try_isend(buf, count, dt, dest, tag, comm, req, &frc))
+        return mv2t_errcheck(comm, frc);
     *req = isend_irecv("isend", (void *)buf, count, dt, dest, tag, comm);
     return *req != MPI_REQUEST_NULL ? MPI_SUCCESS : MPI_ERR_OTHER;
 }
 
 int MPI_Irecv(void *buf, int count, MPI_Datatype dt, int source, int tag,
               MPI_Comm comm, MPI_Request *req) {
+    int frc;
+    if (fp_try_irecv(buf, count, dt, source, tag, comm, req, &frc))
+        return mv2t_errcheck(comm, frc);
     *req = isend_irecv("irecv", buf, count, dt, source, tag, comm);
     return *req != MPI_REQUEST_NULL ? MPI_SUCCESS : MPI_ERR_OTHER;
 }
@@ -465,6 +478,8 @@ int MPI_Irecv(void *buf, int count, MPI_Datatype dt, int source, int tag,
 int MPI_Wait(MPI_Request *req, MPI_Status *status) {
     if (*req == MPI_REQUEST_NULL)
         return MPI_SUCCESS;
+    if (fp_is_handle(*req))
+        return fp_wait(req, status);
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *res = PyObject_CallMethod(g_shim, "wait", "(l)",
                                         (long)*req);
@@ -511,6 +526,8 @@ int MPI_Waitall(int count, MPI_Request reqs[], MPI_Status statuses[]) {
 
 int MPI_Test(MPI_Request *req, int *flag, MPI_Status *status) {
     if (*req == MPI_REQUEST_NULL) { *flag = 1; return MPI_SUCCESS; }
+    if (fp_is_handle(*req))
+        return fp_test(req, flag, status);
     *flag = 0;    /* defined even on shim-error returns */
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *res = PyObject_CallMethod(g_shim, "test", "(l)",
@@ -1036,6 +1053,36 @@ int MPI_Iprobe(int source, int tag, MPI_Comm comm, int *flag,
 
 int MPI_Waitany(int count, MPI_Request reqs[], int *index,
                 MPI_Status *status) {
+    /* fast-path handles are unknown to the shim: poll in C instead */
+    int has_fp = 0, active = 0;
+    for (int i = 0; i < count; i++) {
+        if (reqs[i] != MPI_REQUEST_NULL)
+            active = 1;
+        if (fp_is_handle(reqs[i]))
+            has_fp = 1;
+    }
+    if (has_fp) {
+        if (!active) {
+            *index = MPI_UNDEFINED;
+            return MPI_SUCCESS;
+        }
+        for (;;) {
+            for (int i = 0; i < count; i++) {
+                if (reqs[i] == MPI_REQUEST_NULL)
+                    continue;
+                int f = 0;
+                int rc = MPI_Test(&reqs[i], &f, status);
+                if (rc != MPI_SUCCESS)
+                    return rc;
+                if (f) {
+                    *index = i;
+                    return MPI_SUCCESS;
+                }
+            }
+            struct timespec ts = {0, 50000};    /* 50 us */
+            nanosleep(&ts, NULL);
+        }
+    }
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *hl = PyList_New(count);
     for (int i = 0; i < count; i++)
@@ -1081,6 +1128,40 @@ int MPI_Testall(int count, MPI_Request reqs[], int *flag,
                 MPI_Status statuses[]) {
     /* MPI-3.1 §3.7.5: requests/statuses are modified only when ALL
      * complete; the shim's testall does the all-or-nothing check */
+    int has_fp = 0;
+    for (int i = 0; i < count; i++)
+        if (fp_is_handle(reqs[i]))
+            has_fp = 1;
+    if (has_fp) {
+        /* nondestructive pass first (all-or-nothing semantics) */
+        for (int i = 0; i < count; i++) {
+            if (reqs[i] == MPI_REQUEST_NULL)
+                continue;
+            int f = 0;
+            if (fp_is_handle(reqs[i])) {
+                f = fp_peek_done(reqs[i]);
+            } else {
+                int rc = MPI_Request_get_status(reqs[i], &f,
+                                                MPI_STATUS_IGNORE);
+                if (rc != MPI_SUCCESS)
+                    return rc;
+            }
+            if (!f) {
+                *flag = 0;
+                return MPI_SUCCESS;
+            }
+        }
+        for (int i = 0; i < count; i++) {
+            MPI_Status *s = statuses == MPI_STATUSES_IGNORE
+                            ? MPI_STATUS_IGNORE : &statuses[i];
+            int f = 0;
+            int rc = MPI_Test(&reqs[i], &f, s);
+            if (rc != MPI_SUCCESS)
+                return rc;
+        }
+        *flag = 1;
+        return MPI_SUCCESS;
+    }
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *hl = PyList_New(count);
     for (int i = 0; i < count; i++)
@@ -1155,6 +1236,8 @@ int MPI_Startall(int count, MPI_Request reqs[]) {
 }
 
 int MPI_Request_free(MPI_Request *req) {
+    if (fp_is_handle(*req))
+        return fp_free(req);
     int rc = shim_call_i("request_free", "(l)", (long)*req);
     *req = MPI_REQUEST_NULL;
     return rc;

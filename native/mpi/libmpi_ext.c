@@ -2118,6 +2118,8 @@ int MPI_Irsend(const void *buf, int count, MPI_Datatype dt, int dest,
 int MPI_Cancel(MPI_Request *req) {
     if (*req == MPI_REQUEST_NULL)
         return MPI_ERR_REQUEST;
+    if (fp_is_handle(*req))
+        return fp_cancel(*req);
     return shim_call_i("cancel", "(l)", (long)*req);
 }
 
@@ -2150,6 +2152,8 @@ int MPI_Request_get_status(MPI_Request req, int *flag,
         }
         return MPI_SUCCESS;
     }
+    if (fp_is_handle(req))
+        return fp_get_status(req, flag, status);
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *res = PyObject_CallMethod(g_shim, "request_get_status",
                                         "(l)", (long)req);

@@ -46,4 +46,23 @@ void mv2t_comm_eh_forget(int comm);
 void mv2t_request_completed(MPI_Request req);
 int mv2t_greq_completed(MPI_Request req, MPI_Status *status);
 
+/* C fast path over the native data plane (fastpath.c). fp_try_* return 1
+ * when they handled the call (rc in *out_rc); 0 = take the shim path. */
+int fp_try_send(const void *buf, int count, MPI_Datatype dt, int dest,
+                int tag, MPI_Comm comm, int *out_rc);
+int fp_try_recv(void *buf, int count, MPI_Datatype dt, int source,
+                int tag, MPI_Comm comm, MPI_Status *status, int *out_rc);
+int fp_try_isend(const void *buf, int count, MPI_Datatype dt, int dest,
+                 int tag, MPI_Comm comm, MPI_Request *req, int *out_rc);
+int fp_try_irecv(void *buf, int count, MPI_Datatype dt, int source,
+                 int tag, MPI_Comm comm, MPI_Request *req, int *out_rc);
+int fp_is_handle(MPI_Request req);
+int fp_wait(MPI_Request *req, MPI_Status *status);
+int fp_test(MPI_Request *req, int *flag, MPI_Status *status);
+int fp_peek_done(MPI_Request req);
+int fp_get_status(MPI_Request req, int *flag, MPI_Status *status);
+int fp_cancel(MPI_Request req);
+int fp_free(MPI_Request *req);
+void fp_comm_forget(MPI_Comm comm);
+
 #endif /* MV2T_LIBMPI_INTERNAL_H */

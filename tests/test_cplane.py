@@ -19,6 +19,7 @@ from mvapich2_tpu.transport import shm as shm_mod
 PKT_HDR = struct.Struct("<Biiiiqqqq8si")
 EAGER = 1
 RTS = 2
+FLAG = 1 << 30          # PLANE_CTX_FLAG: wire-carried ownership
 
 RING_BYTES = 1 << 16
 
@@ -179,7 +180,8 @@ def test_rts_assist_and_order(pair):
     """An RTS between two eagers must match in wire order."""
     lib = pair.lib
     lib.cp_send_eager(pair.p[0], 1, 0, 0, 5, b"A", 1, 0)
-    rts = PKT_HDR.pack(RTS, 0, 0, 0, 5, 1000, 77, 0, 0, b"RGET\0\0\0\0", 0)
+    rts = PKT_HDR.pack(RTS, 0, FLAG | 0, 0, 5, 1000, 77, 0, 0,
+                       b"RGET\0\0\0\0", 0)
     lib.cp_inject(pair.p[0], 1, rts, len(rts))
     lib.cp_send_eager(pair.p[0], 1, 0, 0, 5, b"B", 1, 0)
     lib.cp_advance(pair.p[1])
@@ -258,23 +260,26 @@ def test_send_cancel(pair):
 
 
 def test_python_inbox_forwarding(pair):
-    """Odd-ctx eager and unknown packet types bypass the C matcher."""
+    """Unflagged eager (python-owned ctx) and unknown packet types bypass
+    the C matcher; flagged eager is claimed by it."""
     lib = pair.lib
-    lib.cp_send_eager(pair.p[0], 1, 1, 0, 3, b"c", 1, 0)   # coll ctx
+    # python-owned eager: NO ownership flag on the wire
+    e = PKT_HDR.pack(EAGER, 0, 42, 0, 3, 1, 0, 0, 0, b"\0" * 8, 0) + b"d"
+    lib.cp_inject(pair.p[0], 1, e, len(e))
     blob = PKT_HDR.pack(30, 0, 0, 0, 0, 0, 0, 0, 0, b"\0" * 8, 0)  # BARRIER
     lib.cp_inject(pair.p[0], 1, blob, len(blob))
-    # eager on an even but NOT enabled ctx is also forwarded
-    lib.cp_send_eager(pair.p[0], 1, 42, 0, 3, b"d", 1, 0)
+    # plane-owned eager (cp_send_eager flags the wire): C-matched
+    lib.cp_send_eager(pair.p[0], 1, 42, 0, 3, b"c", 1, 0)
     lib.cp_advance(pair.p[1])
-    assert lib.cp_py_pending(pair.p[1]) == 3
+    assert lib.cp_py_pending(pair.p[1]) == 2
     seen = []
     while lib.cp_py_pending(pair.p[1]):
         n = lib.cp_py_peek(pair.p[1])
         buf = ctypes.create_string_buffer(n)
         assert lib.cp_py_pop(pair.p[1], buf, n) == n
         seen.append(PKT_HDR.unpack_from(buf.raw, 0)[0])
-    assert seen == [EAGER, 30, EAGER]
-    assert lib.cp_unexpected_count(pair.p[1]) == 0
+    assert seen == [EAGER, 30]
+    assert lib.cp_unexpected_count(pair.p[1]) == 1
 
 
 def test_backlog_ring_full(pair):
