@@ -438,6 +438,24 @@ def comm_plane_info(ch: int):
     return (c.ctx_pt2pt, c.rank, c.size, idx)
 
 
+def type_spans(dtcode: int):
+    """Datatype layout for the C span engine (native/mpi/fastpath.c):
+    (elem_size, extent, [off0, len0, off1, len1, ...]) for ONE element,
+    or None when the type is unsuitable (zero size, span-count blowup).
+    Derived handles are never reused (monotonic), so C may cache this
+    forever — MPI_Type_free keeps the definition alive by design."""
+    import numpy as _np
+    try:
+        d = _dt(dtcode)
+    except Exception:
+        return None
+    arr = _np.asarray(d.spans, dtype=_np.int64).reshape(-1, 2)
+    if d.size <= 0 or len(arr) == 0 or len(arr) > 1024:
+        return None
+    return (int(d.size), int(d.extent),
+            [int(x) for x in arr.reshape(-1)])
+
+
 def plane_eager_threshold() -> int:
     from .utils.config import get_config
     return int(get_config()["SMP_EAGERSIZE"])

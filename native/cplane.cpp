@@ -104,12 +104,20 @@ static_assert(sizeof(PktHdr) == 61, "wire header layout");
 // request states
 enum ReqState { RS_PENDING = 0, RS_ASSIST = 1, RS_DONE = 2, RS_FREE = 3 };
 
+struct ScatterDesc {              // noncontiguous receive layout
+  int64_t* spans;                 // (off, len) pairs, one element
+  int nspans;
+  int64_t extent;                 // element stride in the user buffer
+  int64_t count;                  // elements
+};
+
 struct Req {
   int64_t id;
   int state;
   void* buf;
   int64_t cap;
   int32_t ctx, src, tag;          // match key (posted)
+  ScatterDesc* scatter;           // NULL = contiguous memcpy
   // completion status
   int32_t st_src, st_tag;
   int64_t st_nbytes;
@@ -227,6 +235,14 @@ inline uint64_t now_us() {
   struct timespec ts;
   clock_gettime(CLOCK_MONOTONIC, &ts);
   return static_cast<uint64_t>(ts.tv_sec) * 1000000u + ts.tv_nsec / 1000;
+}
+
+void req_destroy(Req* r) {
+  if (r->scatter) {
+    free(r->scatter->spans);
+    free(r->scatter);
+  }
+  free(r);
 }
 
 Req* get_req(CPlane* p, int64_t id) {
@@ -355,10 +371,32 @@ void py_push(CPlane* p, const uint8_t* blob, long len) {
   p->n_fwd_py++;
 }
 
+// scatter `n` packed bytes into a strided element layout (the
+// mpid_segment.c unpack loop, reduced to span memcpys)
+void scatter_bytes(uint8_t* base, const ScatterDesc* d,
+                   const uint8_t* src, int64_t n) {
+  int64_t done = 0;
+  for (int64_t e = 0; e < d->count && done < n; e++) {
+    uint8_t* eb = base + e * d->extent;
+    for (int s = 0; s < d->nspans && done < n; s++) {
+      int64_t off = d->spans[2 * s];
+      int64_t len = d->spans[2 * s + 1];
+      if (len > n - done) len = n - done;
+      memcpy(eb + off, src + done, len);
+      done += len;
+    }
+  }
+}
+
 void complete_eager(CPlane* p, Req* r, const PktHdr* h,
                     const uint8_t* payload) {
   int64_t n = h->nbytes < r->cap ? h->nbytes : r->cap;
-  if (n > 0 && r->buf) memcpy(r->buf, payload, n);
+  if (n > 0 && r->buf) {
+    if (r->scatter)
+      scatter_bytes(static_cast<uint8_t*>(r->buf), r->scatter, payload, n);
+    else
+      memcpy(r->buf, payload, n);
+  }
   r->st_src = h->comm_src;
   r->st_tag = h->tag;
   r->st_nbytes = h->nbytes;
@@ -604,7 +642,7 @@ void cp_destroy(void* cp) {
   CancelEntry* c = p->cancels;
   while (c) { CancelEntry* n = c->next; free(c); c = n; }
   for (int64_t i = 1; i < p->next_req; i++)
-    if (p->reqs[i]) free(p->reqs[i]);
+    if (p->reqs[i]) req_destroy(p->reqs[i]);
   free(p->reqs);
   free(p->failed);
   free(p->world_of);
@@ -710,9 +748,8 @@ long long cp_send_eager(void* cp, int dst, int ctx, int comm_src, int tag,
   return 0;
 }
 
-long long cp_irecv(void* cp, void* buf, long cap, int ctx, int src,
-                   int tag) {
-  CPlane* p = static_cast<CPlane*>(cp);
+static long long irecv_common(CPlane* p, void* buf, long cap, int ctx,
+                              int src, int tag, ScatterDesc* sd) {
   pthread_mutex_lock(&p->mu);
   // match the unexpected queue first (arrival order)
   for (UnexEntry* e = p->unex_head; e; e = e->next) {
@@ -724,6 +761,7 @@ long long cp_irecv(void* cp, void* buf, long cap, int ctx, int src,
     r->ctx = ctx;
     r->src = src;
     r->tag = tag;
+    r->scatter = sd;
     if (e->type == PKT_EAGER_SEND) {
       const PktHdr* h = reinterpret_cast<const PktHdr*>(e->blob);
       complete_eager(p, r, h, e->blob + e->payload_off);
@@ -744,11 +782,79 @@ long long cp_irecv(void* cp, void* buf, long cap, int ctx, int src,
   r->ctx = ctx;
   r->src = src;
   r->tag = tag;
+  r->scatter = sd;
   r->state = RS_PENDING;
   posted_push(p, r);
   int64_t id = r->id;
   pthread_mutex_unlock(&p->mu);
   return id;
+}
+
+// noncontiguous eager send: gather `count` elements of `extent` stride
+// (each laid out by (off,len) span pairs) into one packed payload —
+// the ibv_send_inline gather, generalized by the segment engine
+long long cp_send_eager_sp(void* cp, int dst, int ctx, int comm_src,
+                           int tag, const void* base, long long count,
+                           const long long* spans, int nspans,
+                           long long extent, long long elem_size,
+                           long long sreq_id) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (dst < 0 || dst >= p->n_local) return -1;
+  if (p->failed[dst]) return -2;
+  long nbytes = static_cast<long>(elem_size * count);
+  long total = sizeof(PktHdr) + nbytes;
+  uint8_t stackbuf[8192 + sizeof(PktHdr)];
+  uint8_t* blob = total <= static_cast<long>(sizeof(stackbuf))
+                      ? stackbuf
+                      : static_cast<uint8_t*>(malloc(total));
+  PktHdr* h = reinterpret_cast<PktHdr*>(blob);
+  memset(h, 0, sizeof(*h));
+  h->type = PKT_EAGER_SEND;
+  h->src_world = p->world_of[p->me];
+  h->ctx = ctx | PLANE_CTX_FLAG;
+  h->comm_src = comm_src;
+  h->tag = tag;
+  h->nbytes = nbytes;
+  h->sreq_id = sreq_id;
+  uint8_t* out = blob + sizeof(PktHdr);
+  const uint8_t* b = static_cast<const uint8_t*>(base);
+  for (long long e = 0; e < count; e++) {
+    const uint8_t* eb = b + e * extent;
+    for (int s = 0; s < nspans; s++) {
+      memcpy(out, eb + spans[2 * s], spans[2 * s + 1]);
+      out += spans[2 * s + 1];
+    }
+  }
+  pthread_mutex_lock(&p->mu);
+  int rc = inject_locked(p, dst, blob, total);
+  p->n_eager_tx++;
+  pthread_mutex_unlock(&p->mu);
+  if (blob != stackbuf) free(blob);
+  if (rc <= 0) return -1;
+  ring_bell(p, dst);
+  return 0;
+}
+
+long long cp_irecv(void* cp, void* buf, long cap, int ctx, int src,
+                   int tag) {
+  return irecv_common(static_cast<CPlane*>(cp), buf, cap, ctx, src, tag,
+                      nullptr);
+}
+
+// noncontiguous receive: packed bytes scatter into `count` elements of
+// `extent` stride, each laid out by (off,len) span pairs
+long long cp_irecv_sp(void* cp, void* buf, int ctx, int src, int tag,
+                      const long long* spans, int nspans, long long extent,
+                      long long elem_size, long long count) {
+  ScatterDesc* sd = static_cast<ScatterDesc*>(malloc(sizeof(ScatterDesc)));
+  sd->nspans = nspans;
+  sd->extent = extent;
+  sd->count = count;
+  sd->spans = static_cast<int64_t*>(malloc(2 * nspans * sizeof(int64_t)));
+  memcpy(sd->spans, spans, 2 * nspans * sizeof(int64_t));
+  return irecv_common(static_cast<CPlane*>(cp), buf,
+                      static_cast<long>(elem_size * count), ctx, src, tag,
+                      sd);
 }
 
 int cp_req_state(void* cp, long long req) {
@@ -795,7 +901,7 @@ void cp_req_free(void* cp, long long req) {
   Req* r = get_req(p, req);
   if (r) {
     if (r->state == RS_PENDING) posted_remove(p, r);
-    free(r);
+    req_destroy(r);
     p->reqs[req] = nullptr;
   }
   pthread_mutex_unlock(&p->mu);

@@ -58,6 +58,11 @@ static struct {
     void (*cancel_forget)(cph, long long);
     int (*any_failed)(cph);
     int (*req_buf)(cph, long long, void **, long long *);
+    long long (*send_eager_sp)(cph, int, int, int, int, const void *,
+                               long long, const long long *, int,
+                               long long, long long, long long);
+    long long (*irecv_sp)(cph, void *, int, int, int, const long long *,
+                          int, long long, long long, long long);
 } F;
 
 static int fp_state = -1;       /* -1 unknown, 0 unavailable, 1 ready */
@@ -97,6 +102,8 @@ static int fp_load_locked(void) {
     SYM(cancel_forget, "cp_cancel_forget");
     SYM(any_failed, "cp_any_failed");
     SYM(req_buf, "cp_req_buf");
+    SYM(send_eager_sp, "cp_send_eager_sp");
+    SYM(irecv_sp, "cp_irecv_sp");
 #undef SYM
     return 1;
 }
@@ -131,12 +138,82 @@ static void fp_py_progress(void) {
     PyGILState_Release(st);
 }
 
-/* contiguous builtin datatype (size == extent, nonzero) */
-static int fp_dt_ok(MPI_Datatype dt) {
-    if (dt < 0 || dt >= 100)
-        return 0;
-    int sz = dt_size(dt);
-    return sz > 0 && (long)sz == dt_extent_b(dt);
+/* ------------------------------------------------------------------ */
+/* datatype descriptors (the dataloop cache — mpid_segment.c analog)   */
+/* ------------------------------------------------------------------ */
+
+#define FP_MAX_DT 65536
+
+enum { FPD_UNKNOWN = 0, FPD_CONTIG, FPD_SPANS, FPD_NO };
+
+typedef struct {
+    int state;
+    long long size, extent;     /* per element */
+    int nspans;
+    long long *spans;           /* (off, len) pairs */
+} FpDt;
+
+static FpDt fp_dts[FP_MAX_DT];
+
+/* descriptor for a datatype handle, or NULL when the fast path cannot
+ * carry it. Derived handles are never reused (cshim _next_derived is
+ * monotonic and MPI_Type_free keeps definitions), so caching is safe. */
+static FpDt *fp_dt(MPI_Datatype dt) {
+    if (dt < 0 || dt >= FP_MAX_DT)
+        return NULL;
+    FpDt *d = &fp_dts[dt];
+    if (d->state == FPD_CONTIG || d->state == FPD_SPANS)
+        return d;
+    if (d->state == FPD_NO)
+        return NULL;
+    if (dt < 100) {
+        int sz = dt_size(dt);
+        long ext = dt_extent_b(dt);
+        if (sz > 0 && (long)sz == ext) {
+            d->size = sz;
+            d->extent = ext;
+            d->state = FPD_CONTIG;
+            return d;
+        }
+    }
+    /* derived (or padded builtin): fetch the span layout once */
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(g_shim, "type_spans", "(i)", dt);
+    int ok = 0;
+    if (res != NULL && res != Py_None) {
+        PyObject *lst = NULL;
+        long long size = 0, extent = 0;
+        if (PyArg_ParseTuple(res, "LLO", &size, &extent, &lst)
+                && PyList_Check(lst) && PyList_Size(lst) % 2 == 0) {
+            int n = (int)(PyList_Size(lst) / 2);
+            long long *sp = malloc(2 * (size_t)n * sizeof(long long));
+            if (sp != NULL) {
+                for (int i = 0; i < 2 * n; i++)
+                    sp[i] = PyLong_AsLongLong(PyList_GET_ITEM(lst, i));
+                pthread_mutex_lock(&fp_mu);
+                if (d->state == FPD_UNKNOWN || d->state == FPD_NO) {
+                    d->size = size;
+                    d->extent = extent;
+                    d->nspans = n;
+                    d->spans = sp;
+                    d->state = (n == 1 && sp[0] == 0 && sp[1] == size
+                                && size == extent)
+                               ? FPD_CONTIG : FPD_SPANS;
+                } else {
+                    free(sp);
+                }
+                pthread_mutex_unlock(&fp_mu);
+                ok = 1;
+            }
+        }
+    }
+    if (PyErr_Occurred())
+        PyErr_Clear();
+    Py_XDECREF(res);
+    PyGILState_Release(st);
+    if (!ok && d->state == FPD_UNKNOWN)
+        d->state = FPD_NO;
+    return (d->state == FPD_CONTIG || d->state == FPD_SPANS) ? d : NULL;
 }
 
 /* ------------------------------------------------------------------ */
@@ -344,20 +421,41 @@ static int fp_block_recv(cph p, long long cpid, MPI_Status *stout) {
 /* operation entry points (called from libmpi.c wrappers)              */
 /* ------------------------------------------------------------------ */
 
+static long long fp_do_send(cph p, FpDt *d, const void *buf, int count,
+                            FpComm *fc, int dest, int tag, long long sid) {
+    if (d->state == FPD_CONTIG)
+        return F.send_eager(p, fc->ring[dest], fc->ctx, fc->rank, tag,
+                            buf, (long)(d->size * count), sid);
+    return F.send_eager_sp(p, fc->ring[dest], fc->ctx, fc->rank, tag,
+                           buf, count, d->spans, d->nspans, d->extent,
+                           d->size, sid);
+}
+
+static long long fp_post_recv(cph p, FpDt *d, void *buf, int count,
+                              FpComm *fc, int source, int tag) {
+    if (d->state == FPD_CONTIG)
+        return F.irecv(p, buf, (long)(d->size * count), fc->ctx, source,
+                       tag);
+    return F.irecv_sp(p, buf, fc->ctx, source, tag, d->spans, d->nspans,
+                      d->extent, d->size, count);
+}
+
 int fp_try_send(const void *buf, int count, MPI_Datatype dt, int dest,
                 int tag, MPI_Comm comm, int *out_rc) {
     cph p = fp_plane();
-    if (p == NULL || dest < 0 || count < 0 || !fp_dt_ok(dt))
+    if (p == NULL || dest < 0 || count < 0)
+        return 0;
+    FpDt *d = fp_dt(dt);
+    if (d == NULL)
         return 0;
     FpComm *fc = fp_comm(comm);
     if (fc == NULL || dest >= fc->size)
         return 0;
-    long nb = (long)dt_size(dt) * count;
+    long nb = (long)(d->size * count);
     if (fp_threshold <= 0 || nb > fp_threshold)
         return 0;
     long long sid = atomic_fetch_add(&fp_sreq_next, 1);
-    if (F.send_eager(p, fc->ring[dest], fc->ctx, fc->rank, tag, buf, nb,
-                     sid) != 0)
+    if (fp_do_send(p, d, buf, count, fc, dest, tag, sid) != 0)
         return 0;               /* failed peer / full: slow path decides */
     *out_rc = MPI_SUCCESS;
     return 1;
@@ -366,15 +464,17 @@ int fp_try_send(const void *buf, int count, MPI_Datatype dt, int dest,
 int fp_try_recv(void *buf, int count, MPI_Datatype dt, int source,
                 int tag, MPI_Comm comm, MPI_Status *status, int *out_rc) {
     cph p = fp_plane();
-    if (p == NULL || count < 0 || !fp_dt_ok(dt))
+    if (p == NULL || count < 0)
         return 0;
     if (source < 0 && source != MPI_ANY_SOURCE)
+        return 0;
+    FpDt *d = fp_dt(dt);
+    if (d == NULL)
         return 0;
     FpComm *fc = fp_comm(comm);
     if (fc == NULL || (source != MPI_ANY_SOURCE && source >= fc->size))
         return 0;
-    long cap = (long)dt_size(dt) * count;
-    long long cpid = F.irecv(p, buf, cap, fc->ctx, source, tag);
+    long long cpid = fp_post_recv(p, d, buf, count, fc, source, tag);
     *out_rc = fp_block_recv(p, cpid, status);
     F.req_free(p, cpid);
     return 1;
@@ -383,20 +483,22 @@ int fp_try_recv(void *buf, int count, MPI_Datatype dt, int source,
 int fp_try_isend(const void *buf, int count, MPI_Datatype dt, int dest,
                  int tag, MPI_Comm comm, MPI_Request *req, int *out_rc) {
     cph p = fp_plane();
-    if (p == NULL || dest < 0 || count < 0 || !fp_dt_ok(dt))
+    if (p == NULL || dest < 0 || count < 0)
+        return 0;
+    FpDt *d = fp_dt(dt);
+    if (d == NULL)
         return 0;
     FpComm *fc = fp_comm(comm);
     if (fc == NULL || dest >= fc->size)
         return 0;
-    long nb = (long)dt_size(dt) * count;
+    long nb = (long)(d->size * count);
     if (fp_threshold <= 0 || nb > fp_threshold)
         return 0;
     int s = fp_slot_alloc();
     if (s < 0)
         return 0;
     long long sid = atomic_fetch_add(&fp_sreq_next, 1);
-    if (F.send_eager(p, fc->ring[dest], fc->ctx, fc->rank, tag, buf, nb,
-                     sid) != 0) {
+    if (fp_do_send(p, d, buf, count, fc, dest, tag, sid) != 0) {
         fp_slot_free(s);
         return 0;
     }
@@ -412,9 +514,12 @@ int fp_try_isend(const void *buf, int count, MPI_Datatype dt, int dest,
 int fp_try_irecv(void *buf, int count, MPI_Datatype dt, int source,
                  int tag, MPI_Comm comm, MPI_Request *req, int *out_rc) {
     cph p = fp_plane();
-    if (p == NULL || count < 0 || !fp_dt_ok(dt))
+    if (p == NULL || count < 0)
         return 0;
     if (source < 0 && source != MPI_ANY_SOURCE)
+        return 0;
+    FpDt *d = fp_dt(dt);
+    if (d == NULL)
         return 0;
     FpComm *fc = fp_comm(comm);
     if (fc == NULL || (source != MPI_ANY_SOURCE && source >= fc->size))
@@ -422,8 +527,7 @@ int fp_try_irecv(void *buf, int count, MPI_Datatype dt, int source,
     int s = fp_slot_alloc();
     if (s < 0)
         return 0;
-    long cap = (long)dt_size(dt) * count;
-    fp_reqs[s].cpid = F.irecv(p, buf, cap, fc->ctx, source, tag);
+    fp_reqs[s].cpid = fp_post_recv(p, d, buf, count, fc, source, tag);
     fp_reqs[s].kind = FPK_RECV;
     fp_reqs[s].comm = comm;
     *req = FP_REQ_BASE + s;
