@@ -626,6 +626,15 @@ class Comm:
             return
         self.attrs.delete_all(self)
         self.u.comms_by_ctx.pop(self.context_id, None)
+        if self._plane_owned:
+            pch = getattr(self.u, "plane_channel", None)
+            if pch is not None and getattr(pch, "plane", None):
+                # retire both contexts in the C matcher so unreceived
+                # messages for the freed comm don't accumulate in the
+                # unexpected/parked queues for the process lifetime
+                lib = pch._ring.lib
+                lib.cp_ctx_disable(pch.plane, self.context_id)
+                lib.cp_ctx_disable(pch.plane, self.ctx_coll)
         self._plane_owned = False
         seg = getattr(self, "_shm_coll_seg", None)
         if seg not in (None, False):       # slotted shm collective segment

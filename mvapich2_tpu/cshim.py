@@ -458,7 +458,12 @@ def type_spans(dtcode: int):
 
 def plane_eager_threshold() -> int:
     from .utils.config import get_config
-    return int(get_config()["SMP_EAGERSIZE"])
+    t = int(get_config()["SMP_EAGERSIZE"])
+    u = uni.current_universe()
+    pch = getattr(u, "plane_channel", None) if u is not None else None
+    if pch is not None and pch.plane_eager_max():
+        t = min(t, pch.plane_eager_max())
+    return t
 
 
 def plane_progress() -> int:

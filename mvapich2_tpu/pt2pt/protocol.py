@@ -123,6 +123,11 @@ class CPlaneRecvRequest(Request):
         """``poster(addr, cap) -> cp request id`` (cp_irecv / cp_mrecv)."""
         ch = self.channel
         self.cpid = poster(self._addr, self.capacity)
+        if self.cpid < 0:
+            # e.g. mrecv on a token purged by cp_ctx_disable (comm freed)
+            self.complete(MPIException(MPI_ERR_INTERN,
+                                       "plane request post failed"))
+            return
         lib = ch._ring.lib
         st = lib.cp_req_state(ch.plane, self.cpid)
         if st == 2:
@@ -132,12 +137,18 @@ class CPlaneRecvRequest(Request):
             self._cancel_fn = self._plane_cancel
 
     def _plane_cancel(self) -> bool:
+        # mutex-held: the retract-untrack-free sequence races the plane
+        # channel's _poll_plane finalize otherwise (the progress thread
+        # can observe RS_DONE and complete the request concurrently)
         ch = self.channel
-        if ch.plane and ch._ring.lib.cp_cancel_recv(ch.plane,
-                                                    self.cpid) == 1:
-            ch.plane_untrack_recv(self.cpid)
-            ch._ring.lib.cp_req_free(ch.plane, self.cpid)
-            return True
+        with self.engine.mutex:
+            if self.complete_flag:
+                return False
+            if ch.plane and ch._ring.lib.cp_cancel_recv(ch.plane,
+                                                        self.cpid) == 1:
+                ch.plane_untrack_recv(self.cpid)
+                ch._ring.lib.cp_req_free(ch.plane, self.cpid)
+                return True
         return False
 
     def _poll_plane(self) -> bool:
@@ -266,6 +277,10 @@ class Pt2ptProtocol:
         nbytes = datatype.size * count
         threshold = (self.cfg["SMP_EAGERSIZE"] if is_local
                      else self.cfg["EAGER_THRESHOLD"])
+        if pch is not None and pch.plane_eager_max():
+            # oversize configurations fall back to rendezvous instead of
+            # hard-failing cp_send_eager on a blob the ring can't hold
+            threshold = min(threshold, pch.plane_eager_max())
 
         if mode == "buffered":
             # MPI_Bsend: copy now (pack always returns a fresh buffer),

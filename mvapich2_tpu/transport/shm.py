@@ -128,6 +128,7 @@ def _bind_cplane(lib) -> None:
     lib.cp_req_buf.argtypes = [L.c_void_p, L.c_longlong,
                                L.POINTER(L.c_void_p), L.POINTER(L.c_longlong)]
     lib.cp_req_free.argtypes = [L.c_void_p, L.c_longlong]
+    lib.cp_req_orphan.argtypes = [L.c_void_p, L.c_longlong]
     lib.cp_cancel_recv.argtypes = [L.c_void_p, L.c_longlong]
     lib.cp_complete_assist.argtypes = [L.c_void_p, L.c_longlong, L.c_longlong,
                                        L.c_int, L.c_int, L.c_int]
@@ -362,6 +363,14 @@ class ShmChannel(Channel):
             self._ring_cap = lib.sr_capacity(self._ring.h)
             if self.plane:
                 lib.cp_set_wait_fd(self.plane, self._bell.fileno())
+
+    def plane_eager_max(self) -> int:
+        """Largest eager payload the plane can carry: an eager blob is a
+        61-byte header + payload and must fit the shm ring (with margin
+        for the ring's own length/align overhead). The single source of
+        truth for the clamp applied by both the python protocol layer
+        and the C fast path's cached threshold."""
+        return self._ring_cap - 128 if self._ring_cap else 0
 
     def finish_wiring(self) -> None:
         """Post-fence wiring: peer bell addresses into the plane, then

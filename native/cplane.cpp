@@ -123,6 +123,9 @@ struct Req {
   int64_t st_nbytes;
   int truncated;
   int errclass;                   // 0 = success
+  int orphan;                     // MPI_Request_free'd while active: the
+                                  // operation must still complete, then
+                                  // the slot reclaims itself
   Req* next;                      // posted-queue link
   Req* prev;
 };
@@ -215,6 +218,10 @@ struct CPlane {
   int64_t next_token;
   // enabled ctx set
   CtxSet ctxs;
+  // retired ctx set: comms freed locally — in-flight wire traffic for
+  // these must be dropped, not re-queued as unexpected (ids are
+  // allocated by max-allreduce and never reused, so the set only grows)
+  CtxSet retired;
   // failure set (ring indices)
   uint8_t* failed;
   // ring index <-> world rank (wire src_world carries WORLD ranks so the
@@ -388,6 +395,15 @@ void scatter_bytes(uint8_t* base, const ScatterDesc* d,
   }
 }
 
+// reclaim a request whose owner already called MPI_Request_free; must
+// run after every transition to RS_DONE (plane mutex held)
+void reap_orphan(CPlane* p, Req* r) {
+  if (r->orphan && r->state == RS_DONE) {
+    p->reqs[r->id] = nullptr;
+    req_destroy(r);
+  }
+}
+
 void complete_eager(CPlane* p, Req* r, const PktHdr* h,
                     const uint8_t* payload) {
   int64_t n = h->nbytes < r->cap ? h->nbytes : r->cap;
@@ -402,7 +418,7 @@ void complete_eager(CPlane* p, Req* r, const PktHdr* h,
   r->st_nbytes = h->nbytes;
   r->truncated = h->nbytes > r->cap;
   r->state = RS_DONE;
-  (void)p;
+  reap_orphan(p, r);
 }
 
 void assist_push(CPlane* p, Req* r, const uint8_t* blob, long len) {
@@ -452,6 +468,9 @@ void process_blob(CPlane* p, const uint8_t* blob, long len) {
   // C matcher, so host collectives are C-matched too.
   const bool owned = (h->ctx & PLANE_CTX_FLAG) != 0;
   const int32_t ctx = h->ctx & ~PLANE_CTX_FLAG;
+  if (owned && (h->type == PKT_EAGER_SEND || h->type == PKT_RNDV_RTS) &&
+      p->retired.has(ctx))
+    return;                              // freed comm: drop, don't queue
   if (h->type == PKT_EAGER_SEND && owned) {
     const uint8_t* payload = blob + sizeof(PktHdr) + h->exlen;
     p->n_eager_rx++;
@@ -649,6 +668,7 @@ void cp_destroy(void* cp) {
   free(p->bells);
   free(p->bell_set);
   free(p->ctxs.v);
+  free(p->retired.v);
   pthread_mutex_destroy(&p->mu);
   free(p);
 }
@@ -685,6 +705,7 @@ void cp_ctx_disable(void* cp, int ctx) {
   CPlane* p = static_cast<CPlane*>(cp);
   pthread_mutex_lock(&p->mu);
   p->ctxs.del(ctx);
+  p->retired.add(ctx);
   // purge unexpected messages for the retired context (comm freed)
   UnexEntry* e = p->unex_head;
   while (e) {
@@ -693,6 +714,21 @@ void cp_ctx_disable(void* cp, int ctx) {
       unex_remove(p, e);
       free(e->blob);
       free(e);
+    }
+    e = n;
+  }
+  // parked (mprobe'd) entries of the retired context go too
+  UnexEntry* prev = nullptr;
+  e = p->parked;
+  while (e) {
+    UnexEntry* n = e->next;
+    if (e->ctx == ctx) {
+      if (prev) prev->next = n;
+      else p->parked = n;
+      free(e->blob);
+      free(e);
+    } else {
+      prev = e;
     }
     e = n;
   }
@@ -907,6 +943,24 @@ void cp_req_free(void* cp, long long req) {
   pthread_mutex_unlock(&p->mu);
 }
 
+// MPI_Request_free on an ACTIVE receive: the operation must still
+// complete into the user buffer (MPI-3.1 §3.7.3); the request stays in
+// the matching queues and reclaims itself on completion.
+void cp_req_orphan(void* cp, long long req) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  Req* r = get_req(p, req);
+  if (r) {
+    if (r->state == RS_DONE) {
+      req_destroy(r);
+      p->reqs[req] = nullptr;
+    } else {
+      r->orphan = 1;
+    }
+  }
+  pthread_mutex_unlock(&p->mu);
+}
+
 int cp_cancel_recv(void* cp, long long req) {
   CPlane* p = static_cast<CPlane*>(cp);
   pthread_mutex_lock(&p->mu);
@@ -936,6 +990,7 @@ void cp_complete_assist(void* cp, long long req, long long nbytes, int src,
     r->truncated = nbytes > r->cap;
     r->errclass = errclass;
     r->state = RS_DONE;
+    reap_orphan(p, r);
   }
   pthread_mutex_unlock(&p->mu);
 }
@@ -948,6 +1003,7 @@ int cp_error_req(void* cp, long long req, int errclass) {
   if (r->state == RS_PENDING) posted_remove(p, r);
   r->errclass = errclass;
   r->state = RS_DONE;
+  reap_orphan(p, r);
   pthread_mutex_unlock(&p->mu);
   return 0;
 }
@@ -1143,6 +1199,14 @@ void cp_mark_failed(void* cp, int ring_index) {
 int cp_any_failed(void* cp) {
   (void)cp;
   return g_any_failed.load(std::memory_order_acquire);
+}
+
+// specific-peer failure check (for waits already in flight when a
+// failure lands: only the responder's death justifies standing down)
+int cp_rank_failed(void* cp, int ring_index) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (ring_index < 0 || ring_index >= p->n_local) return 1;
+  return p->failed[ring_index];
 }
 
 int cp_posted_count(void* cp) {

@@ -28,6 +28,7 @@
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <time.h>
 
 #include "mpi.h"
 #include "libmpi_internal.h"
@@ -48,6 +49,7 @@ static struct {
     int (*req_status)(cph, long long, int *, int *, long long *, int *,
                       int *);
     void (*req_free)(cph, long long);
+    void (*req_orphan)(cph, long long);
     int (*cancel_recv)(cph, long long);
     int (*advance)(cph);
     int (*wait_quantum)(cph, long long, long, long);
@@ -57,6 +59,7 @@ static struct {
     int (*cancel_result)(cph, long long);
     void (*cancel_forget)(cph, long long);
     int (*any_failed)(cph);
+    int (*rank_failed)(cph, int);
     int (*req_buf)(cph, long long, void **, long long *);
     long long (*send_eager_sp)(cph, int, int, int, int, const void *,
                                long long, const long long *, int,
@@ -92,6 +95,7 @@ static int fp_load_locked(void) {
     SYM(req_state, "cp_req_state");
     SYM(req_status, "cp_req_status");
     SYM(req_free, "cp_req_free");
+    SYM(req_orphan, "cp_req_orphan");
     SYM(cancel_recv, "cp_cancel_recv");
     SYM(advance, "cp_advance");
     SYM(wait_quantum, "cp_wait_quantum");
@@ -101,6 +105,7 @@ static int fp_load_locked(void) {
     SYM(cancel_result, "cp_cancel_result");
     SYM(cancel_forget, "cp_cancel_forget");
     SYM(any_failed, "cp_any_failed");
+    SYM(rank_failed, "cp_rank_failed");
     SYM(req_buf, "cp_req_buf");
     SYM(send_eager_sp, "cp_send_eager_sp");
     SYM(irecv_sp, "cp_irecv_sp");
@@ -559,6 +564,18 @@ int fp_wait(MPI_Request *req, MPI_Status *status) {
                     break;      /* unknown: treat as resolved, not       */
                 F.advance(p);   /* cancelled                              */
                 fp_py_progress();
+                res = F.cancel_result(p, r->sreq);
+                if (res >= 0 || res == -2)
+                    break;      /* progress pass just resolved it */
+                if (F.rank_failed(p, r->dst)) {
+                    /* the responder is dead: its CANCEL_SEND_RESP can
+                     * never arrive — stand down as "not cancelled"
+                     * (the ULFM rule; python owns failure semantics) */
+                    res = 0;
+                    break;
+                }
+                struct timespec ts = {0, 50000};        /* 50 us */
+                nanosleep(&ts, NULL);
             }
             F.cancel_forget(p, r->sreq);
             if (status != MPI_STATUS_IGNORE)
@@ -644,7 +661,10 @@ int fp_free(MPI_Request *req) {
     FpReq *r = &fp_reqs[s];
     cph p = F.global ? F.global() : NULL;
     if (r->kind == FPK_RECV && p != NULL)
-        F.req_free(p, r->cpid);
+        /* a freed ACTIVE receive must still complete into the user
+         * buffer (MPI-3.1 §3.7.3): orphan it — the plane finishes the
+         * match/copy, then reclaims the slot itself */
+        F.req_orphan(p, r->cpid);
     fp_slot_free(s);
     *req = MPI_REQUEST_NULL;
     return MPI_SUCCESS;

@@ -30,6 +30,7 @@ int mv2t_attr_copy_all(int kind, int oldobj, int newobj);
 void mv2t_attr_delete_all(int kind, int obj);
 void mv2t_win_record(int win, void *base, MPI_Aint size, int disp_unit);
 void mv2t_wininfo_set(int win, MPI_Info info);
+void mv2t_wininfo_forget(int win);
 void mv2t_win_forget(int win);
 int mv2t_is_userop(MPI_Op op);
 int mv2t_userop_coll(int kind, const void *sendbuf, void *recvbuf,

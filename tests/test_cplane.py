@@ -321,3 +321,43 @@ def test_cancel_recv(pair):
     lib.cp_send_eager(pair.p[0], 1, 0, 0, 88, b"x", 1, 0)
     lib.cp_advance(pair.p[1])
     assert lib.cp_unexpected_count(pair.p[1]) == 1
+
+
+def test_orphaned_recv_still_completes(pair):
+    """MPI_Request_free on an active receive: the operation must still
+    complete into the user buffer (the request reclaims itself)."""
+    lib = pair.lib
+    lib.cp_req_orphan.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+    buf = ctypes.create_string_buffer(16)
+    req = lib.cp_irecv(pair.p[1], buf, 16, 0, 0, 42)
+    lib.cp_req_orphan(pair.p[1], req)
+    # the slot is gone from the owner's view...
+    assert lib.cp_req_state(pair.p[1], req) in (0, 3)
+    # ...but a matching inbound message still lands in the user buffer
+    assert lib.cp_send_eager(pair.p[0], 1, 0, 0, 42, b"orphan!", 7, 0) == 0
+    lib.cp_advance(pair.p[1])
+    assert buf.raw[:7] == b"orphan!"
+    # and the plane request slot was reclaimed (state reads FREE)
+    assert lib.cp_req_state(pair.p[1], req) == 3
+    # nothing was diverted to the unexpected queue
+    assert lib.cp_unexpected_count(pair.p[1]) == 0
+
+
+def test_ctx_disable_purges_parked(pair):
+    """cp_ctx_disable drops unexpected AND mprobe-parked entries."""
+    lib = pair.lib
+    lib.cp_send_eager(pair.p[0], 1, 0, 0, 5, b"aa", 2, 0)
+    lib.cp_send_eager(pair.p[0], 1, 0, 0, 6, b"bb", 2, 0)
+    lib.cp_advance(pair.p[1])
+    src = ctypes.c_int()
+    tag = ctypes.c_int()
+    nb = ctypes.c_longlong()
+    tok = ctypes.c_longlong()
+    # park one entry via mprobe
+    assert lib.cp_probe(pair.p[1], 0, 0, 5, 1, src, tag, nb, tok) == 1
+    assert lib.cp_unexpected_count(pair.p[1]) == 1
+    lib.cp_ctx_disable(pair.p[1], 0)
+    assert lib.cp_unexpected_count(pair.p[1]) == 0
+    # the parked token is gone too: mrecv on it fails
+    buf = ctypes.create_string_buffer(8)
+    assert lib.cp_mrecv_start(pair.p[1], tok.value, buf, 8) == -1
