@@ -41,6 +41,20 @@ cvar("USE_CPLANE", 1, int, "shm",
      "Use the native C data plane (envelope matching in C) when the native "
      "ring is available. 0 falls back to python-side matching.")
 
+from .. import mpit as _mpit  # noqa: E402  (after cvar decls, same registry)
+
+# Plane counters (the mv2_mpit.c:17-39 channel-counter analog). Declared
+# at import so tools can enumerate them; finish_wiring() rebinds the
+# sources to the live plane.
+_PV_PLANE_DECLS = [
+    ("cplane_eager_tx", "eager sends injected by the C plane"),
+    ("cplane_eager_rx", "eager receives matched in the C plane"),
+    ("cplane_fwd_py",
+     "packets forwarded to the python protocol layer (fast-path misses)"),
+]
+for _n, _d in _PV_PLANE_DECLS:
+    _mpit.pvar(_n, _mpit.PVAR_CLASS_COUNTER, "shm", _d)
+
 _HEADER = 128
 _WRAP = 0xFFFFFFFF
 _ALIGN = 8
@@ -372,6 +386,16 @@ class ShmChannel(Channel):
         and the C fast path's cached threshold."""
         return self._ring_cap - 128 if self._ring_cap else 0
 
+    def plane_stats(self):
+        """(eager_tx, eager_rx, fwd_py) counters from the C plane."""
+        if not self.plane:
+            return (0, 0, 0)
+        tx = ctypes.c_ulonglong()
+        rx = ctypes.c_ulonglong()
+        fwd = ctypes.c_ulonglong()
+        self._ring.lib.cp_stats(self.plane, tx, rx, fwd)
+        return (tx.value, rx.value, fwd.value)
+
     def finish_wiring(self) -> None:
         """Post-fence wiring: peer bell addresses into the plane, then
         publish it process-globally so libmpi.c's C fast path can find it
@@ -390,6 +414,15 @@ class ShmChannel(Channel):
             self._peer_bells[r] = addr
             lib.cp_set_bell(self.plane, self.local_index[r], addr.encode())
         lib.cp_register_global(self.plane)
+        # rebind the plane counters' sources to this live plane:
+        # fast-path hit-rate is the one number that says whether a
+        # workload actually rides the C path. Totals from earlier planes
+        # in this process (latched at close) stay included.
+        for idx, (name, desc) in enumerate(_PV_PLANE_DECLS):
+            pv = _mpit.pvar(name, _mpit.PVAR_CLASS_COUNTER, "shm", desc)
+            base = pv._value
+            pv.source = (lambda i=idx, b=base:
+                         b + float(self.plane_stats()[i]))
 
     def _make_ring(self, path: str, ring_bytes: int, create: bool):
         lib = _load_native()
@@ -606,6 +639,16 @@ class ShmChannel(Channel):
 
     def close(self) -> None:
         if self.plane:
+            # latch final counters into the owned pvar values so tools
+            # reading after teardown still see the job's totals
+            try:
+                stats = self.plane_stats()
+                for (name, _), v in zip(_PV_PLANE_DECLS, stats):
+                    pv = _mpit.pvar(name)
+                    pv.source = None
+                    pv._value += float(v)   # _value held the prior total
+            except Exception:
+                pass
             try:
                 self._ring.lib.cp_destroy(self.plane)
             except Exception:

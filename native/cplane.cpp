@@ -468,9 +468,6 @@ void process_blob(CPlane* p, const uint8_t* blob, long len) {
   // C matcher, so host collectives are C-matched too.
   const bool owned = (h->ctx & PLANE_CTX_FLAG) != 0;
   const int32_t ctx = h->ctx & ~PLANE_CTX_FLAG;
-  if (owned && (h->type == PKT_EAGER_SEND || h->type == PKT_RNDV_RTS) &&
-      p->retired.has(ctx))
-    return;                              // freed comm: drop, don't queue
   if (h->type == PKT_EAGER_SEND && owned) {
     const uint8_t* payload = blob + sizeof(PktHdr) + h->exlen;
     p->n_eager_rx++;
@@ -481,6 +478,10 @@ void process_blob(CPlane* p, const uint8_t* blob, long len) {
         return;
       }
     }
+    // a pending recv on a freed comm must still complete (MPI-3.1
+    // §6.4.3 deferred free) — only UNMATCHED traffic for a retired
+    // context is dropped instead of queued
+    if (p->retired.has(ctx)) return;
     unex_add(p, h, blob, len);
     return;
   }
@@ -492,6 +493,7 @@ void process_blob(CPlane* p, const uint8_t* blob, long len) {
         return;
       }
     }
+    if (p->retired.has(ctx)) return;     // see eager comment above
     unex_add(p, h, blob, len);
     return;
   }
@@ -717,21 +719,9 @@ void cp_ctx_disable(void* cp, int ctx) {
     }
     e = n;
   }
-  // parked (mprobe'd) entries of the retired context go too
-  UnexEntry* prev = nullptr;
-  e = p->parked;
-  while (e) {
-    UnexEntry* n = e->next;
-    if (e->ctx == ctx) {
-      if (prev) prev->next = n;
-      else p->parked = n;
-      free(e->blob);
-      free(e);
-    } else {
-      prev = e;
-    }
-    e = n;
-  }
+  // parked (mprobe'd) entries are NOT purged: they are already-matched
+  // messages whose tokens the application still holds — the legal
+  // Mprobe -> Comm_free -> Mrecv sequence must keep working
   pthread_mutex_unlock(&p->mu);
 }
 

@@ -343,8 +343,11 @@ def test_orphaned_recv_still_completes(pair):
     assert lib.cp_unexpected_count(pair.p[1]) == 0
 
 
-def test_ctx_disable_purges_parked(pair):
-    """cp_ctx_disable drops unexpected AND mprobe-parked entries."""
+def test_ctx_disable_semantics(pair):
+    """cp_ctx_disable drops unmatched unexpected entries and future
+    unmatched traffic, but already-matched work survives: mprobe-parked
+    tokens stay receivable (Mprobe -> Comm_free -> Mrecv is legal) and
+    posted receives still complete (MPI-3.1 §6.4.3 deferred free)."""
     lib = pair.lib
     lib.cp_send_eager(pair.p[0], 1, 0, 0, 5, b"aa", 2, 0)
     lib.cp_send_eager(pair.p[0], 1, 0, 0, 6, b"bb", 2, 0)
@@ -353,11 +356,24 @@ def test_ctx_disable_purges_parked(pair):
     tag = ctypes.c_int()
     nb = ctypes.c_longlong()
     tok = ctypes.c_longlong()
-    # park one entry via mprobe
+    # park one entry via mprobe; post a recv for a third message
     assert lib.cp_probe(pair.p[1], 0, 0, 5, 1, src, tag, nb, tok) == 1
     assert lib.cp_unexpected_count(pair.p[1]) == 1
+    pbuf = ctypes.create_string_buffer(8)
+    posted = lib.cp_irecv(pair.p[1], pbuf, 8, 0, 0, 7)
     lib.cp_ctx_disable(pair.p[1], 0)
+    # unmatched unexpected entry purged
     assert lib.cp_unexpected_count(pair.p[1]) == 0
-    # the parked token is gone too: mrecv on it fails
+    # the parked token survives: mrecv still delivers the bytes
     buf = ctypes.create_string_buffer(8)
-    assert lib.cp_mrecv_start(pair.p[1], tok.value, buf, 8) == -1
+    req = lib.cp_mrecv_start(pair.p[1], tok.value, buf, 8)
+    assert req >= 0 and buf.raw[:2] == b"aa"
+    # a pending posted recv on the retired ctx still completes
+    assert lib.cp_send_eager(pair.p[0], 1, 0, 0, 7, b"cc", 2, 0) == 0
+    lib.cp_advance(pair.p[1])
+    assert lib.cp_req_state(pair.p[1], posted) == 2
+    assert pbuf.raw[:2] == b"cc"
+    # but fresh unmatched traffic for the retired ctx is dropped
+    lib.cp_send_eager(pair.p[0], 1, 0, 0, 99, b"zz", 2, 0)
+    lib.cp_advance(pair.p[1])
+    assert lib.cp_unexpected_count(pair.p[1]) == 0

@@ -148,3 +148,26 @@ def test_timestamps_disabled_no_overhead():
     with ts.phase("should_not_record"):
         pass
     assert "should_not_record" not in ts.render()
+
+
+def test_plane_pvars_observable():
+    """The C plane's counters (cp_stats) surface as MPI_T pvars — the
+    fast-path hit-rate for a workload is observable through a session
+    in-job (mv2_mpit.c:17-39 channel-counter discipline). The plane only
+    exists in process mode, so this drives the launcher."""
+    import subprocess
+    import sys as _sys
+    from mvapich2_tpu.transport.shm import _load_native
+    if _load_native() is None:
+        import pytest
+        pytest.skip("native plane unavailable")
+    assert mpit.pvar_get_index("cplane_eager_tx") >= 0
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prog = os.path.join(repo, "tests", "progs", "pvar_plane_prog.py")
+    r = subprocess.run([_sys.executable, "-m", "mvapich2_tpu.run", "-np",
+                        "2", _sys.executable, prog], cwd=repo,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+    assert "did not move" not in r.stdout
