@@ -466,6 +466,16 @@ def plane_eager_threshold() -> int:
     return t
 
 
+def plane_congest_min() -> int:
+    """RNDV_CONGEST_MIN for the C fast path's protocol choice (same
+    source of truth as the python layer's congestion switch)."""
+    from .utils.config import get_config
+    try:
+        return int(get_config()["RNDV_CONGEST_MIN"])
+    except KeyError:
+        return 8192
+
+
 def plane_progress() -> int:
     """One python progress pass, driven from a C fast-path wait loop."""
     u = uni.current_universe()

@@ -36,6 +36,10 @@ log = get_logger("pt2pt")
 
 cvar("R3_CHUNK_SIZE", 1 << 18, int, "pt2pt",
      "Chunk size for packetized rendezvous data (R3 path).")
+cvar("RNDV_CONGEST_MIN", 8192, int, "pt2pt",
+     "When the shm ring toward a peer is backlogged, payloads at or above "
+     "this size switch to the CMA rendezvous instead of deepening the "
+     "backlog (the ibv_send.c:320 credit-backpressure discipline).")
 
 from .. import mpit  # noqa: E402  (after cvar decls, same registry)
 
@@ -206,6 +210,55 @@ class CPlaneRecvRequest(Request):
         return self.status
 
 
+class CPlaneSendRequest(Request):
+    """Rendezvous send on the native CMA path (cp_send_rndv): the C
+    plane exposes (pid, address) in the RTS; the receiver pulls straight
+    from this buffer and answers FIN. Completion is observed by polling
+    the plane request, like CPlaneRecvRequest. Holds the exposed buffer
+    alive until then."""
+
+    def __init__(self, engine, channel, keepalive):
+        super().__init__(engine, "send")
+        self.channel = channel
+        self._keep = keepalive
+        self.cpid = -1
+
+    def _poll_plane(self) -> bool:
+        if self.complete_flag:
+            return True
+        ch = self.channel
+        if not ch.plane or self.cpid < 0:
+            return False
+        if getattr(self, "_cancel_pending", False) \
+                and not getattr(self, "_cancel_resolved", False):
+            return False        # outcome arrives via the cancel result
+        lib = ch._ring.lib
+        if lib.cp_req_state(ch.plane, self.cpid) != 2:
+            return False
+        ec = ct.c_int()
+        lib.cp_req_status(ch.plane, self.cpid, None, None, None, None, ec)
+        ch.plane_untrack_recv(self.cpid)
+        lib.cp_req_free(ch.plane, self.cpid)
+        self._keep = None
+        self.complete(MPIException(ec.value, "plane rndv send failed")
+                      if ec.value else None)
+        return True
+
+    def test(self) -> bool:
+        if not self.complete_flag and self.engine is not None:
+            self.engine.progress_poke()
+            with self.engine.mutex:
+                self._poll_plane()
+        return self.complete_flag
+
+    def wait(self) -> Status:
+        if not self.complete_flag and self.engine is not None:
+            self.engine.progress_wait(self._poll_plane)
+        if self.error is not None:
+            raise self.error
+        return self.status
+
+
 class PlaneMessage:
     """Matched-message token from an mprobe on a plane-owned context
     (the plane-side analog of the Packet returned by improbe)."""
@@ -314,7 +367,13 @@ class Pt2ptProtocol:
                 breq._cancel_fn = bcancel
             return breq
 
-        if nbytes <= threshold and mode != "sync":
+        congested = False
+        if pch is not None and nbytes >= self.cfg["RNDV_CONGEST_MIN"]:
+            _plib = pch._ring.lib
+            congested = bool(_plib.cp_cma_enabled(pch.plane)) and bool(
+                _plib.cp_congested(pch.plane,
+                                   pch.local_index[dest_world]))
+        if nbytes <= threshold and mode != "sync" and not congested:
             if pch is not None:
                 # C-built eager: header + payload assembled and injected
                 # natively (the ibv_send_inline.h:493 moment)
@@ -373,6 +432,42 @@ class Pt2ptProtocol:
             return sreq
 
         # rendezvous (always used for Ssend so completion implies matching)
+        if pch is not None and pch._ring.lib.cp_cma_enabled(pch.plane):
+            # native CMA rendezvous: the receiver pulls straight from
+            # this buffer via process_vm_readv and FINs — no staged copy,
+            # no python packet on the data path (ibv_rndv.c RGET analog)
+            lib = pch._ring.lib
+            if datatype.is_contiguous:
+                mv = as_bytes_view(buf)
+                mpi_assert(len(mv) >= nbytes, MPI_ERR_ARG,
+                           f"buffer too small: {len(mv)} < {nbytes}")
+                arr = np.frombuffer(mv, dtype=np.uint8, count=nbytes) \
+                    if nbytes else None
+            else:
+                arr = np.asarray(datatype.pack(buf, count)) \
+                    .view(np.uint8).reshape(-1)
+            sreq = CPlaneSendRequest(self.engine, pch, arr)
+            with self.engine.mutex:
+                rid = lib.cp_send_rndv(
+                    pch.plane, pch.local_index[dest_world], ctx, comm_src,
+                    tag,
+                    arr.ctypes.data if arr is not None and arr.size else
+                    None, nbytes)
+                if rid >= 0:
+                    sreq.cpid = rid
+                    pch.plane_track_recv(rid, sreq)
+                    sreq._cancel_fn = lambda: self._plane_cancel_rndv(
+                        sreq, pch, dest_world)
+                    _pv_rndv.inc()
+                    _pv_bytes.inc(nbytes)
+                    return sreq
+            if rid == -2:
+                from ..ft import ulfm
+                ulfm.mark_failed(self.u, dest_world)
+                raise MPIException(
+                    MPIX_ERR_PROC_FAILED,
+                    f"send to failed world rank {dest_world}")
+            # rid == -1: CMA raced off — fall through to staged rndv
         sreq = SendRequest(self.engine, dest_world)
         sreq.channel = channel
         packed = datatype.pack(buf, count)
@@ -420,9 +515,40 @@ class Pt2ptProtocol:
                                      pch.local_index[dest_world])
         return False
 
+    def _plane_cancel_rndv(self, sreq, pch, dest_world: int) -> bool:
+        """Send-cancel for a CMA rendezvous: the target's retraction
+        scan matches the namespaced WIRE id the RTS traveled under
+        (cp_rndv_wire), not the raw plane request id."""
+        wire = pch._ring.lib.cp_rndv_wire(sreq.cpid)
+        eng = self.engine
+        with eng.mutex:
+            if sreq.cancelled or getattr(sreq, "_cancel_pending", False):
+                return False
+            sreq._cancel_pending = True
+            sreq._cancel_was_complete = False
+            pch.plane_track_cancel(wire, sreq)
+        pch._ring.lib.cp_cancel_send(pch.plane, wire,
+                                     pch.local_index[dest_world])
+        return False
+
     def on_plane_cancel_result(self, sreq, retracted: bool) -> None:
         """Channel progress callback: the plane resolved a send-cancel
         (mirrors _on_cancel_resp)."""
+        if isinstance(sreq, CPlaneSendRequest):
+            sreq._cancel_resolved = True
+            if sreq.complete_flag:
+                return
+            if retracted:
+                # no FIN will ever come: reclaim the plane request
+                ch = sreq.channel
+                ch.plane_untrack_recv(sreq.cpid)
+                ch._ring.lib.cp_req_free(ch.plane, sreq.cpid)
+                sreq._keep = None
+                sreq.cancelled = True
+                sreq.status.cancelled = True
+                sreq.complete()
+            # else: the FIN completes it via _poll_plane
+            return
         if sreq.complete_flag:
             return
         if retracted:

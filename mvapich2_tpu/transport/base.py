@@ -55,6 +55,11 @@ class PktType(enum.IntEnum):
     SHUTDOWN = 32
     CANCEL_SEND_REQ = 33   # retract an unmatched send (mpidpkt.h CANCEL)
     CANCEL_SEND_RESP = 34
+    # CMA rendezvous — consumed entirely inside the C plane
+    # (native/cplane.cpp): RTS carries (pid, address); the receiver
+    # pulls via process_vm_readv and answers FIN (status in offset)
+    RNDV_RTS_CMA = 40
+    RNDV_FIN_CMA = 41
 
 
 class Packet:

@@ -35,11 +35,18 @@ from .base import Channel, Packet, decode_packet, encode_packet
 
 log = get_logger("shm")
 
-cvar("SHM_RING_BYTES", 1 << 20, int, "shm",
-     "Per-(src,dst)-pair ring size in bytes (analog of MV2_SMP_QUEUE_LENGTH).")
+cvar("SHM_RING_BYTES", 0, int, "shm",
+     "Per-(src,dst)-pair ring size in bytes (analog of "
+     "MV2_SMP_QUEUE_LENGTH). 0 = auto: sized by co-located rank count "
+     "(4 MiB for <=2, 2 MiB for <=4, 1 MiB beyond) so a 64-deep window "
+     "of eager-size payloads stays in flight without backpressure.")
 cvar("USE_CPLANE", 1, int, "shm",
      "Use the native C data plane (envelope matching in C) when the native "
      "ring is available. 0 falls back to python-side matching.")
+cvar("USE_CMA", 1, int, "shm",
+     "Use cross-memory-attach (process_vm_readv) for large intra-node "
+     "messages when the bootstrap probe succeeds (the CMA/LiMIC2 path of "
+     "ch3_smp_progress.c:525). 0 forces the staged rendezvous.")
 
 from .. import mpit as _mpit  # noqa: E402  (after cvar decls, same registry)
 
@@ -51,6 +58,8 @@ _PV_PLANE_DECLS = [
     ("cplane_eager_rx", "eager receives matched in the C plane"),
     ("cplane_fwd_py",
      "packets forwarded to the python protocol layer (fast-path misses)"),
+    ("cplane_rndv_tx", "CMA rendezvous sends exposed by the C plane"),
+    ("cplane_rndv_rx", "CMA rendezvous pulls completed by the C plane"),
 ]
 for _n, _d in _PV_PLANE_DECLS:
     _mpit.pvar(_n, _mpit.PVAR_CLASS_COUNTER, "shm", _d)
@@ -179,6 +188,16 @@ def _bind_cplane(lib) -> None:
                              L.POINTER(L.c_ulonglong)]
     lib.cp_wait_quantum.argtypes = [L.c_void_p, L.c_longlong, L.c_long,
                                     L.c_long]
+    lib.cp_send_rndv.restype = L.c_longlong
+    lib.cp_send_rndv.argtypes = [L.c_void_p, L.c_int, L.c_int, L.c_int,
+                                 L.c_int, L.c_void_p, L.c_longlong]
+    lib.cp_rndv_wire.restype = L.c_longlong
+    lib.cp_rndv_wire.argtypes = [L.c_longlong]
+    lib.cp_set_cma.argtypes = [L.c_void_p, L.c_int]
+    lib.cp_cma_enabled.argtypes = [L.c_void_p]
+    lib.cp_congested.argtypes = [L.c_void_p, L.c_int]
+    lib.cp_rndv_stats.argtypes = [L.c_void_p, L.POINTER(L.c_ulonglong),
+                                  L.POINTER(L.c_ulonglong)]
 
 
 class _PyRing:
@@ -295,7 +314,21 @@ class ShmChannel(Channel):
         self.local_index = {r: i for i, r in enumerate(self.local_ranks)}
         self.n_local = len(self.local_ranks)
         self.kvs = kvs
-        ring_bytes = ring_bytes or get_config()["SHM_RING_BYTES"]
+        if ring_bytes is None:
+            ring_bytes = get_config()["SHM_RING_BYTES"]
+            if not ring_bytes:
+                # auto (the vbuf-pool sizing discipline of ibv_param.c):
+                # with few co-located ranks the n^2 segment is cheap,
+                # and a deeper ring keeps a 64-message window of
+                # eager-size payloads in flight without backpressure
+                # (64 x 64 KiB = 4 MiB). Deterministic in n_local, so
+                # every rank computes the same segment layout.
+                if self.n_local <= 2:
+                    ring_bytes = 4 << 20
+                elif self.n_local <= 4:
+                    ring_bytes = 2 << 20
+                else:
+                    ring_bytes = 1 << 20
         ring_bytes = (ring_bytes + 7) & ~7
         leader = self.local_ranks[0]
         segkey = f"shm-seg-{leader}"
@@ -337,6 +370,14 @@ class ShmChannel(Channel):
         self._bell.setblocking(False)
         self._bell_path = bell_path
         kvs.put(f"shm-bell-{my_rank}", bell_path)
+        # CMA probe buffer: published pre-fence; finish_wiring() reads a
+        # neighbor's copy to decide whether process_vm_readv works here
+        # (kept alive for the channel lifetime)
+        self._cma_probe = np.frombuffer(
+            f"mv2t-cma-{my_rank:012d}".encode(), dtype=np.uint8).copy()
+        kvs.put(f"shm-cma-{my_rank}",
+                f"{os.getpid()}:{self._cma_probe.ctypes.data}"
+                f":{self._cma_probe.size}")
         self._peer_bells: Dict[int, str] = {}
         # Adaptive bell: a shared byte per local rank, set while that
         # rank is parked in the engine's blocking wait. Senders skip the
@@ -387,14 +428,59 @@ class ShmChannel(Channel):
         return self._ring_cap - 128 if self._ring_cap else 0
 
     def plane_stats(self):
-        """(eager_tx, eager_rx, fwd_py) counters from the C plane."""
+        """(eager_tx, eager_rx, fwd_py, rndv_tx, rndv_rx) from the C
+        plane."""
         if not self.plane:
-            return (0, 0, 0)
+            return (0, 0, 0, 0, 0)
         tx = ctypes.c_ulonglong()
         rx = ctypes.c_ulonglong()
         fwd = ctypes.c_ulonglong()
+        rtx = ctypes.c_ulonglong()
+        rrx = ctypes.c_ulonglong()
         self._ring.lib.cp_stats(self.plane, tx, rx, fwd)
-        return (tx.value, rx.value, fwd.value)
+        self._ring.lib.cp_rndv_stats(self.plane, rtx, rrx)
+        return (tx.value, rx.value, fwd.value, rtx.value, rrx.value)
+
+    def _probe_cma(self) -> bool:
+        """Can this process read a co-resident rank's memory via
+        process_vm_readv? Reads a neighbor's published probe buffer and
+        checks the bytes (the runtime capability probe the reference
+        performs for CMA/LiMIC2 availability)."""
+        idx = self.local_ranks.index(self.my_rank)
+        left = self.local_ranks[idx - 1]
+        if left == self.my_rank:
+            return True          # single local rank: self-copy path
+        try:
+            pid, addr, n = map(
+                int, self.kvs.get(f"shm-cma-{left}").split(":"))
+        except Exception:
+            return False
+        expect = f"mv2t-cma-{left:012d}".encode()
+        if n != len(expect):
+            return False
+        buf = ctypes.create_string_buffer(n)
+
+        class IoVec(ctypes.Structure):
+            _fields_ = [("iov_base", ctypes.c_void_p),
+                        ("iov_len", ctypes.c_size_t)]
+
+        try:
+            libc = ctypes.CDLL(None, use_errno=True)
+            libc.process_vm_readv.restype = ctypes.c_ssize_t
+            libc.process_vm_readv.argtypes = [
+                ctypes.c_int, ctypes.POINTER(IoVec), ctypes.c_ulong,
+                ctypes.POINTER(IoVec), ctypes.c_ulong, ctypes.c_ulong]
+            liov = IoVec(ctypes.cast(buf, ctypes.c_void_p), n)
+            riov = IoVec(addr, n)
+            got = libc.process_vm_readv(pid, ctypes.byref(liov), 1,
+                                        ctypes.byref(riov), 1, 0)
+        except Exception:
+            return False
+        ok = got == n and buf.raw[:n] == expect
+        if not ok:
+            log.warn("CMA probe failed (read %s from pid %d); using the "
+                     "staged rendezvous path", got, pid)
+        return ok
 
     def finish_wiring(self) -> None:
         """Post-fence wiring: peer bell addresses into the plane, then
@@ -414,6 +500,8 @@ class ShmChannel(Channel):
             self._peer_bells[r] = addr
             lib.cp_set_bell(self.plane, self.local_index[r], addr.encode())
         lib.cp_register_global(self.plane)
+        if get_config()["USE_CMA"] and self._probe_cma():
+            lib.cp_set_cma(self.plane, 1)
         # rebind the plane counters' sources to this live plane:
         # fast-path hit-rate is the one number that says whether a
         # workload actually rides the C path. Totals from earlier planes

@@ -168,8 +168,11 @@ cvar("DEBUG_LEVEL", 0, int, "debug",
 cvar("EAGER_THRESHOLD", 64 * 1024, int, "pt2pt",
      "Eager->rendezvous switch point in bytes "
      "(analog of MV2_IBA_EAGER_THRESHOLD, gen2/ibv_param.c:2354).")
-cvar("SMP_EAGERSIZE", 64 * 1024, int, "pt2pt",
-     "Intra-node eager size (analog of MV2_SMP_EAGERSIZE, ibv_param.c:776).")
+cvar("SMP_EAGERSIZE", 32 * 1024, int, "pt2pt",
+     "Intra-node eager size (analog of MV2_SMP_EAGERSIZE, ibv_param.c:776). "
+     "Default measured on the 1-core bench host (see "
+     "profiles/pt2pt_crossover.json): eager wins while a 64-deep window "
+     "fits the shm ring; the CMA rendezvous wins beyond.")
 cvar("RNDV_PROTOCOL", "RGET", str, "pt2pt",
      "Rendezvous protocol: RGET (receiver pulls), RPUT (sender pushes), "
      "R3 (packetized through channel). Default mirrors ibv_param.c:116.",

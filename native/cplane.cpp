@@ -48,6 +48,7 @@
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/time.h>
+#include <sys/uio.h>
 #include <sys/un.h>
 #include <time.h>
 #include <unistd.h>
@@ -73,6 +74,23 @@ constexpr uint8_t PKT_EAGER_SEND = 1;
 constexpr uint8_t PKT_RNDV_RTS = 2;
 constexpr uint8_t PKT_CANCEL_SEND_REQ = 33;
 constexpr uint8_t PKT_CANCEL_SEND_RESP = 34;
+// CMA rendezvous (this file's large-message path — the process_vm_readv
+// RGET of ch3_smp_progress.c:525-640 / ibv_rndv.c:45-180):
+//   RTS_CMA carries (pid, buffer address) in (rreq_id, offset); the
+//   receiver pulls the bytes directly from the sender's memory at match
+//   time and answers FIN_CMA (sreq_id echo, offset = status).
+constexpr uint8_t PKT_RNDV_RTS_CMA = 40;
+constexpr uint8_t PKT_RNDV_FIN_CMA = 41;
+
+constexpr int32_t ERRCLASS_INTERN = 17;       // MPI_ERR_INTERN (mpi.h)
+constexpr int32_t ERRCLASS_PROC_FAILED = 75;  // MPIX_ERR_PROC_FAILED
+
+// Wire-id namespace for CMA rendezvous sends. Three id spaces feed the
+// same target-side cancel retraction scan: python Request.req_id (small
+// ints), the C fast path's eager sreq counter (1<<48 base), and plane
+// request ids (small ints). Rendezvous wire ids are plane ids offset
+// into their own space so they can never collide with either.
+constexpr int64_t RNDV_WIRE_BASE = 1LL << 52;
 
 constexpr int ANY_SOURCE = -1;
 constexpr int ANY_TAG = -2;
@@ -126,6 +144,12 @@ struct Req {
   int orphan;                     // MPI_Request_free'd while active: the
                                   // operation must still complete, then
                                   // the slot reclaims itself
+  int is_send;                    // CMA rendezvous send (never in the
+                                  // posted queue; completes on FIN_CMA)
+  int send_dst;                   // rndv send: target ring index (the
+                                  // failure sweep needs it)
+  void* owned_tmp;                // rndv send: packed payload owned by
+                                  // the request (freed by req_destroy)
   Req* next;                      // posted-queue link
   Req* prev;
 };
@@ -234,8 +258,11 @@ struct CPlane {
   struct sockaddr_un* bells;     // peer bell addresses
   uint8_t* bell_set;
   int bell_tx;                   // unbound dgram socket for sendto
+  int cma_enabled;               // large-message CMA rendezvous usable
+                                 // (probed by bootstrap, cp_set_cma)
   // stats
   uint64_t n_eager_tx, n_eager_rx, n_fwd_py;
+  uint64_t n_rndv_tx, n_rndv_rx;
 };
 
 inline uint64_t now_us() {
@@ -249,6 +276,7 @@ void req_destroy(Req* r) {
     free(r->scatter->spans);
     free(r->scatter);
   }
+  free(r->owned_tmp);
   free(r);
 }
 
@@ -310,6 +338,12 @@ inline bool env_match(int32_t pctx, int32_t psrc, int32_t ptag,
   if (psrc != ANY_SOURCE && psrc != src) return false;
   if (ptag != ANY_TAG && ptag != tag) return false;
   return true;
+}
+
+int ring_of_world(CPlane* p, int world) {
+  for (int i = 0; i < p->n_local; i++)
+    if (p->world_of[i] == world) return i;
+  return -1;
 }
 
 void ring_bell(CPlane* p, int dst) {
@@ -421,6 +455,80 @@ void complete_eager(CPlane* p, Req* r, const PktHdr* h,
   reap_orphan(p, r);
 }
 
+// pull `n` packed bytes from (pid, raddr) into r's buffer, honoring the
+// scatter layout — the kernel-assisted zero-copy of the reference's CMA
+// dispatch (ch3_smp_progress.c:525-640). Returns 0 ok, -1 on failure.
+int cma_pull(Req* r, int64_t n, int32_t pid, uint64_t raddr) {
+  if (n <= 0) return 0;
+  uint8_t* tmp = nullptr;
+  uint8_t* dst;
+  if (r->scatter) {
+    tmp = static_cast<uint8_t*>(malloc(n));
+    if (!tmp) return -1;
+    dst = tmp;
+  } else {
+    dst = static_cast<uint8_t*>(r->buf);
+  }
+  int rc = 0;
+  if (pid == getpid()) {
+    memcpy(dst, reinterpret_cast<const void*>(
+                    static_cast<uintptr_t>(raddr)), n);
+  } else {
+    int64_t done = 0;
+    while (done < n) {
+      struct iovec liov = {dst + done, static_cast<size_t>(n - done)};
+      struct iovec riov = {reinterpret_cast<void*>(
+                               static_cast<uintptr_t>(raddr + done)),
+                           static_cast<size_t>(n - done)};
+      ssize_t got = process_vm_readv(pid, &liov, 1, &riov, 1, 0);
+      if (got <= 0) { rc = -1; break; }
+      done += got;
+    }
+  }
+  if (rc == 0 && r->scatter)
+    scatter_bytes(static_cast<uint8_t*>(r->buf), r->scatter, tmp, n);
+  free(tmp);
+  return rc;
+}
+
+void send_fin_cma(CPlane* p, int dst_ring, int64_t sreq, int64_t consumed,
+                  int64_t status) {
+  PktHdr f;
+  memset(&f, 0, sizeof(f));
+  f.type = PKT_RNDV_FIN_CMA;
+  f.src_world = p->world_of[p->me];
+  f.sreq_id = sreq;
+  f.nbytes = consumed;
+  f.offset = status;
+  inject_locked(p, dst_ring, &f, sizeof(f));
+  ring_bell(p, dst_ring);
+}
+
+// complete a matched CMA rendezvous receive: pull the bytes, answer FIN.
+// Runs with the plane mutex held — deliberately: dropping it mid-pull
+// would let a concurrent cp_advance re-process the same ring slot
+// (process_blob is still parked on it), and serializing progress behind
+// the copy matches the reference's global-CS progress engine
+// (MPIU_THREAD_CS around MPIDI_CH3I_Progress).
+void cma_complete(CPlane* p, Req* r, const PktHdr* h) {
+  int64_t n = h->nbytes < r->cap ? h->nbytes : r->cap;
+  int rc = 0;
+  if (r->buf && n > 0)
+    rc = cma_pull(r, n, static_cast<int32_t>(h->rreq_id),
+                  static_cast<uint64_t>(h->offset));
+  r->st_src = h->comm_src;
+  r->st_tag = h->tag;
+  r->st_nbytes = h->nbytes;
+  r->truncated = h->nbytes > r->cap;
+  r->errclass = rc ? ERRCLASS_INTERN : 0;
+  r->state = RS_DONE;
+  p->n_rndv_rx++;
+  int sr = ring_of_world(p, h->src_world);
+  if (sr >= 0)
+    send_fin_cma(p, sr, h->sreq_id, rc ? 0 : n, rc ? -1 : 0);
+  reap_orphan(p, r);
+}
+
 void assist_push(CPlane* p, Req* r, const uint8_t* blob, long len) {
   AssistEntry* a = static_cast<AssistEntry*>(malloc(sizeof(AssistEntry)));
   a->req_id = r->id;
@@ -495,6 +603,35 @@ void process_blob(CPlane* p, const uint8_t* blob, long len) {
     }
     if (p->retired.has(ctx)) return;     // see eager comment above
     unex_add(p, h, blob, len);
+    return;
+  }
+  if (h->type == PKT_RNDV_RTS_CMA && owned) {
+    for (Req* r = p->posted_head; r; r = r->next) {
+      if (env_match(r->ctx, r->src, r->tag, ctx, h->comm_src, h->tag)) {
+        posted_remove(p, r);
+        cma_complete(p, r, h);
+        return;
+      }
+    }
+    if (p->retired.has(ctx)) {
+      // freed comm: drop the message but release the sender (it holds
+      // its buffer until FIN)
+      int sr = ring_of_world(p, h->src_world);
+      if (sr >= 0) send_fin_cma(p, sr, h->sreq_id, 0, 1);
+      return;
+    }
+    unex_add(p, h, blob, len);
+    return;
+  }
+  if (h->type == PKT_RNDV_FIN_CMA) {
+    if (!(h->sreq_id & RNDV_WIRE_BASE)) return;
+    Req* r = get_req(p, h->sreq_id & ~RNDV_WIRE_BASE);
+    if (r && r->is_send && r->state != RS_DONE) {
+      r->st_nbytes = h->nbytes;
+      r->errclass = h->offset < 0 ? ERRCLASS_INTERN : 0;
+      r->state = RS_DONE;
+      reap_orphan(p, r);
+    }
     return;
   }
   if (h->type == PKT_CANCEL_SEND_REQ) {
@@ -708,12 +845,18 @@ void cp_ctx_disable(void* cp, int ctx) {
   pthread_mutex_lock(&p->mu);
   p->ctxs.del(ctx);
   p->retired.add(ctx);
-  // purge unexpected messages for the retired context (comm freed)
+  // purge unexpected messages for the retired context (comm freed); a
+  // purged rendezvous RTS must still release its sender (it holds the
+  // exposed buffer until FIN)
   UnexEntry* e = p->unex_head;
   while (e) {
     UnexEntry* n = e->next;
     if (e->ctx == ctx) {
       unex_remove(p, e);
+      if (e->type == PKT_RNDV_RTS_CMA) {
+        int sr = ring_of_world(p, e->src_world);
+        if (sr >= 0) send_fin_cma(p, sr, e->sreq_id, 0, 1);
+      }
       free(e->blob);
       free(e);
     }
@@ -791,13 +934,13 @@ static long long irecv_common(CPlane* p, void* buf, long cap, int ctx,
     if (e->type == PKT_EAGER_SEND) {
       const PktHdr* h = reinterpret_cast<const PktHdr*>(e->blob);
       complete_eager(p, r, h, e->blob + e->payload_off);
-      free(e->blob);
-      free(e);
+    } else if (e->type == PKT_RNDV_RTS_CMA) {  // pull now, FIN the sender
+      cma_complete(p, r, reinterpret_cast<const PktHdr*>(e->blob));
     } else {                                   // RTS -> python assist
       assist_push(p, r, e->blob, e->blob_len);
-      free(e->blob);
-      free(e);
     }
+    free(e->blob);
+    free(e);
     int64_t id = r->id;
     pthread_mutex_unlock(&p->mu);
     return id;
@@ -859,6 +1002,87 @@ long long cp_send_eager_sp(void* cp, int dst, int ctx, int comm_src,
   if (rc <= 0) return -1;
   ring_bell(p, dst);
   return 0;
+}
+
+// CMA rendezvous send: expose (pid, address) in an RTS; the receiver
+// pulls directly from our memory and FINs. Returns a plane request id
+// (completes on FIN_CMA) or -1 when CMA is unavailable / -2 failed peer.
+// The caller must keep `buf` stable until the request completes.
+long long cp_send_rndv(void* cp, int dst, int ctx, int comm_src, int tag,
+                       const void* buf, long long nbytes) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (dst < 0 || dst >= p->n_local) return -1;
+  if (!p->cma_enabled) return -1;
+  if (p->failed[dst]) return -2;
+  pthread_mutex_lock(&p->mu);
+  Req* r = new_req(p);
+  r->is_send = 1;
+  r->send_dst = dst;
+  r->state = RS_PENDING;
+  r->ctx = ctx;
+  r->src = comm_src;
+  r->tag = tag;
+  PktHdr h;
+  memset(&h, 0, sizeof(h));
+  h.type = PKT_RNDV_RTS_CMA;
+  h.src_world = p->world_of[p->me];
+  h.ctx = ctx | PLANE_CTX_FLAG;
+  h.comm_src = comm_src;
+  h.tag = tag;
+  h.nbytes = nbytes;
+  h.sreq_id = r->id | RNDV_WIRE_BASE;
+  h.rreq_id = static_cast<int64_t>(getpid());
+  h.offset = static_cast<int64_t>(reinterpret_cast<uintptr_t>(buf));
+  inject_locked(p, dst, &h, sizeof(h));
+  p->n_rndv_tx++;
+  long long id = r->id;
+  pthread_mutex_unlock(&p->mu);
+  ring_bell(p, dst);
+  return id;
+}
+
+void cp_set_cma(void* cp, int enabled) {
+  static_cast<CPlane*>(cp)->cma_enabled = enabled;
+}
+
+// the wire id a rendezvous send travels under (cancel initiators need
+// it: the target's retraction scan matches wire ids)
+long long cp_rndv_wire(long long rid) { return rid | RNDV_WIRE_BASE; }
+
+// transfer ownership of a packed payload to the plane request: freed by
+// req_destroy when the request completes/reaps (MPI_Request_free on an
+// active noncontiguous rendezvous isend)
+void cp_req_own_tmp(void* cp, long long req, void* tmp) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  Req* r = get_req(p, req);
+  if (r) r->owned_tmp = tmp;
+  else free(tmp);
+  pthread_mutex_unlock(&p->mu);
+}
+
+// capacity-aware protocol choice (the vbuf credit backpressure of
+// ibv_send.c:320-360, reduced to one bit): a non-empty backlog toward
+// dst means the ring is full — senders above RNDV_CONGEST_MIN should
+// switch to the CMA rendezvous instead of deepening the backlog.
+int cp_congested(void* cp, int dst) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (dst < 0 || dst >= p->n_local) return 0;
+  pthread_mutex_lock(&p->mu);
+  int c = p->backlog_head[dst] != nullptr;
+  pthread_mutex_unlock(&p->mu);
+  return c;
+}
+
+int cp_cma_enabled(void* cp) {
+  return static_cast<CPlane*>(cp)->cma_enabled;
+}
+
+void cp_rndv_stats(void* cp, unsigned long long* tx,
+                   unsigned long long* rx) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (tx) *tx = p->n_rndv_tx;
+  if (rx) *rx = p->n_rndv_rx;
 }
 
 long long cp_irecv(void* cp, void* buf, long cap, int ctx, int src,
@@ -926,7 +1150,7 @@ void cp_req_free(void* cp, long long req) {
   pthread_mutex_lock(&p->mu);
   Req* r = get_req(p, req);
   if (r) {
-    if (r->state == RS_PENDING) posted_remove(p, r);
+    if (r->state == RS_PENDING && !r->is_send) posted_remove(p, r);
     req_destroy(r);
     p->reqs[req] = nullptr;
   }
@@ -956,7 +1180,7 @@ int cp_cancel_recv(void* cp, long long req) {
   pthread_mutex_lock(&p->mu);
   Req* r = get_req(p, req);
   int ok = 0;
-  if (r && r->state == RS_PENDING) {
+  if (r && r->state == RS_PENDING && !r->is_send) {
     posted_remove(p, r);
     r->state = RS_DONE;
     r->st_src = -1;
@@ -990,7 +1214,7 @@ int cp_error_req(void* cp, long long req, int errclass) {
   pthread_mutex_lock(&p->mu);
   Req* r = get_req(p, req);
   if (!r) { pthread_mutex_unlock(&p->mu); return -1; }
-  if (r->state == RS_PENDING) posted_remove(p, r);
+  if (r->state == RS_PENDING && !r->is_send) posted_remove(p, r);
   r->errclass = errclass;
   r->state = RS_DONE;
   reap_orphan(p, r);
@@ -1112,6 +1336,8 @@ long long cp_mrecv_start(void* cp, long long token, void* buf, long cap) {
   if (e->type == PKT_EAGER_SEND) {
     const PktHdr* h = reinterpret_cast<const PktHdr*>(e->blob);
     complete_eager(p, r, h, e->blob + e->payload_off);
+  } else if (e->type == PKT_RNDV_RTS_CMA) {
+    cma_complete(p, r, reinterpret_cast<const PktHdr*>(e->blob));
   } else {
     assist_push(p, r, e->blob, e->blob_len);
   }
@@ -1181,6 +1407,21 @@ void cp_mark_failed(void* cp, int ring_index) {
   if (ring_index >= 0 && ring_index < p->n_local)
     p->failed[ring_index] = 1;
   g_any_failed.store(1, std::memory_order_release);
+  // pending rendezvous sends toward the dead rank can never FIN — fail
+  // them now so blocked waiters unwind with MPIX_ERR_PROC_FAILED (the
+  // recv-side sweep lives in ft/ulfm.py via cp_posted_get/cp_error_req;
+  // send requests are not in the posted queue, so they are swept here)
+  pthread_mutex_lock(&p->mu);
+  for (int64_t i = 1; i < p->next_req; i++) {
+    Req* r = p->reqs[i];
+    if (r && r->is_send && r->state == RS_PENDING
+        && r->send_dst == ring_index) {
+      r->errclass = ERRCLASS_PROC_FAILED;
+      r->state = RS_DONE;
+      reap_orphan(p, r);
+    }
+  }
+  pthread_mutex_unlock(&p->mu);
 }
 
 // cheap global gate for the C fast path: after ANY failure it defers to

@@ -66,10 +66,18 @@ static struct {
                                long long, long long, long long);
     long long (*irecv_sp)(cph, void *, int, int, int, const long long *,
                           int, long long, long long, long long);
+    long long (*send_rndv)(cph, int, int, int, int, const void *,
+                           long long);
+    int (*cma_enabled)(cph);
+    int (*congested)(cph, int);
+    long long (*rndv_wire)(long long);
+    void (*req_own_tmp)(cph, long long, void *);
 } F;
 
 static int fp_state = -1;       /* -1 unknown, 0 unavailable, 1 ready */
 static long fp_threshold = 0;
+static long fp_congest_min = 8192;  /* RNDV_CONGEST_MIN (fetched with
+                                     * the eager threshold) */
 static pthread_mutex_t fp_mu = PTHREAD_MUTEX_INITIALIZER;
 static _Atomic long long fp_sreq_next = (1LL << 48);
 
@@ -109,6 +117,11 @@ static int fp_load_locked(void) {
     SYM(req_buf, "cp_req_buf");
     SYM(send_eager_sp, "cp_send_eager_sp");
     SYM(irecv_sp, "cp_irecv_sp");
+    SYM(send_rndv, "cp_send_rndv");
+    SYM(cma_enabled, "cp_cma_enabled");
+    SYM(congested, "cp_congested");
+    SYM(rndv_wire, "cp_rndv_wire");
+    SYM(req_own_tmp, "cp_req_own_tmp");
 #undef SYM
     return 1;
 }
@@ -284,12 +297,15 @@ static FpComm *fp_comm(MPI_Comm comm) {
     Py_XDECREF(res);
     if (!ok && fc->state == 0)
         fc->state = 2;
-    /* first successful bind also fetches the eager threshold */
+    /* first successful bind also fetches the protocol thresholds */
     if (ok && fp_threshold == 0) {
         int tok;
         long t = shim_call_v("plane_eager_threshold", &tok, "()");
         if (tok && t > 0)
             fp_threshold = t;
+        t = shim_call_v("plane_congest_min", &tok, "()");
+        if (tok && t > 0)
+            fp_congest_min = t;
     }
     PyGILState_Release(st);
     return fc->state == 1 ? fc : NULL;
@@ -313,15 +329,17 @@ void fp_comm_forget(MPI_Comm comm) {
 #define FP_REQ_BASE 0x40000000
 #define FP_NREQ 65536
 
-enum { FPK_FREE = 0, FPK_RECV, FPK_SEND };
+enum { FPK_FREE = 0, FPK_RECV, FPK_SEND, FPK_SEND_RNDV };
 
 typedef struct {
     int kind;
-    long long cpid;             /* recv: plane request id */
+    long long cpid;             /* recv/rndv-send: plane request id */
     long long sreq;             /* send: wire sreq id (cancel) */
     int dst;                    /* send: ring index */
     int comm;                   /* errhandler target */
     int cancel_pending;
+    void *tmp;                  /* rndv-send: packed noncontig payload,
+                                 * freed at completion */
 } FpReq;
 
 static FpReq fp_reqs[FP_NREQ];
@@ -423,6 +441,91 @@ static int fp_block_recv(cph p, long long cpid, MPI_Status *stout) {
 }
 
 /* ------------------------------------------------------------------ */
+/* CMA rendezvous (large messages — the ch3_smp_progress.c:525 path)   */
+/* ------------------------------------------------------------------ */
+
+/* gather a strided layout into one contiguous packed buffer */
+static void *fp_pack_spans(FpDt *d, const void *buf, int count, long nb) {
+    uint8_t *tmp = malloc((size_t)nb);
+    if (tmp == NULL)
+        return NULL;
+    uint8_t *out = tmp;
+    const uint8_t *b = buf;
+    for (int e = 0; e < count; e++) {
+        const uint8_t *eb = b + (long long)e * d->extent;
+        for (int s = 0; s < d->nspans; s++) {
+            memcpy(out, eb + d->spans[2 * s], (size_t)d->spans[2 * s + 1]);
+            out += d->spans[2 * s + 1];
+        }
+    }
+    return tmp;
+}
+
+/* block until a rendezvous send request completes; frees it */
+static int fp_block_send_rndv(cph p, long long rid) {
+    int idle = 0;
+    for (;;) {
+        int rc = F.wait_quantum(p, rid, fp_spin_us, 2);
+        if (rc == 2)
+            break;
+        if (rc == 1) {
+            fp_py_progress();
+        } else {
+            if (fp_spin_us > 4)
+                fp_spin_us /= 2;
+            if (++idle % 16 == 0)
+                fp_py_progress();
+        }
+        if (F.req_state(p, rid) == 2)
+            break;
+    }
+    if (fp_spin_us < 200)
+        fp_spin_us += 4;
+    int ec = 0;
+    F.req_status(p, rid, NULL, NULL, NULL, NULL, &ec);
+    F.req_free(p, rid);
+    return ec ? ec : MPI_SUCCESS;
+}
+
+/* protocol choice (the eager/rndv crossover of ibv_param.c:776-837 plus
+ * the credit-backpressure switch of ibv_send.c:320): rendezvous for
+ * payloads over the eager threshold, and for medium payloads whenever
+ * the ring toward dst is already backlogged — deepening the backlog
+ * just serializes the window behind the copy loop. fp_congest_min is
+ * the RNDV_CONGEST_MIN cvar, fetched with the eager threshold. */
+static int fp_want_rndv(cph p, long nb, int dst_ring) {
+    if (nb > fp_threshold)
+        return 1;
+    return nb >= fp_congest_min && F.cma_enabled(p)
+           && F.congested(p, dst_ring);
+}
+
+/* start a rendezvous send; *o_tmp gets the packed copy (caller frees at
+ * completion). Returns the plane request id, or -1 = use the slow path */
+static long long fp_start_rndv(cph p, FpDt *d, const void *buf, int count,
+                               long nb, FpComm *fc, int dest, int tag,
+                               void **o_tmp) {
+    if (!F.cma_enabled(p))
+        return -1;
+    const void *src = buf;
+    void *tmp = NULL;
+    if (d->state != FPD_CONTIG) {
+        tmp = fp_pack_spans(d, buf, count, nb);
+        if (tmp == NULL)
+            return -1;
+        src = tmp;
+    }
+    long long rid = F.send_rndv(p, fc->ring[dest], fc->ctx, fc->rank, tag,
+                                src, nb);
+    if (rid < 0) {
+        free(tmp);
+        return -1;              /* failed peer: slow path raises */
+    }
+    *o_tmp = tmp;
+    return rid;
+}
+
+/* ------------------------------------------------------------------ */
 /* operation entry points (called from libmpi.c wrappers)              */
 /* ------------------------------------------------------------------ */
 
@@ -457,8 +560,22 @@ int fp_try_send(const void *buf, int count, MPI_Datatype dt, int dest,
     if (fc == NULL || dest >= fc->size)
         return 0;
     long nb = (long)(d->size * count);
-    if (fp_threshold <= 0 || nb > fp_threshold)
+    if (fp_threshold <= 0)
         return 0;
+    if (fp_want_rndv(p, nb, fc->ring[dest])) {
+        /* large (or ring-congested) message: CMA rendezvous, blocking
+         * until FIN */
+        void *tmp = NULL;
+        long long rid = fp_start_rndv(p, d, buf, count, nb, fc, dest,
+                                      tag, &tmp);
+        if (rid >= 0) {
+            *out_rc = fp_block_send_rndv(p, rid);
+            free(tmp);
+            return 1;
+        }
+        if (nb > fp_threshold)
+            return 0;           /* too big for eager: slow path */
+    }
     long long sid = atomic_fetch_add(&fp_sreq_next, 1);
     if (fp_do_send(p, d, buf, count, fc, dest, tag, sid) != 0)
         return 0;               /* failed peer / full: slow path decides */
@@ -497,8 +614,33 @@ int fp_try_isend(const void *buf, int count, MPI_Datatype dt, int dest,
     if (fc == NULL || dest >= fc->size)
         return 0;
     long nb = (long)(d->size * count);
-    if (fp_threshold <= 0 || nb > fp_threshold)
+    if (fp_threshold <= 0)
         return 0;
+    if (fp_want_rndv(p, nb, fc->ring[dest])) {
+        /* large (or ring-congested) message: nonblocking CMA rndv */
+        int s = fp_slot_alloc();
+        if (s < 0)
+            return 0;
+        void *tmp = NULL;
+        long long rid = fp_start_rndv(p, d, buf, count, nb, fc, dest,
+                                      tag, &tmp);
+        if (rid >= 0) {
+            fp_reqs[s].kind = FPK_SEND_RNDV;
+            fp_reqs[s].cpid = rid;
+            /* wire id (namespaced) — the target's cancel retraction
+             * scan matches wire ids, not plane ids */
+            fp_reqs[s].sreq = F.rndv_wire(rid);
+            fp_reqs[s].tmp = tmp;
+            fp_reqs[s].dst = fc->ring[dest];
+            fp_reqs[s].comm = comm;
+            *req = FP_REQ_BASE + s;
+            *out_rc = MPI_SUCCESS;
+            return 1;
+        }
+        fp_slot_free(s);
+        if (nb > fp_threshold)
+            return 0;           /* too big for eager: slow path */
+    }
     int s = fp_slot_alloc();
     if (s < 0)
         return 0;
@@ -545,6 +687,49 @@ int fp_wait(MPI_Request *req, MPI_Status *status) {
     FpReq *r = &fp_reqs[s];
     int rc = MPI_SUCCESS;
     cph p = F.global ? F.global() : NULL;
+    if (r->kind == FPK_SEND_RNDV) {
+        fp_status_empty(status);
+        if (p != NULL) {
+            if (r->cancel_pending) {
+                int res;
+                while ((res = F.cancel_result(p, r->sreq)) < 0) {
+                    if (res == -2)
+                        break;
+                    F.advance(p);
+                    fp_py_progress();
+                    res = F.cancel_result(p, r->sreq);
+                    if (res >= 0 || res == -2)
+                        break;
+                    if (F.req_state(p, r->cpid) == 2) {
+                        res = 0;        /* FIN raced the cancel */
+                        break;
+                    }
+                    if (F.rank_failed(p, r->dst)) {
+                        res = 0;
+                        break;
+                    }
+                    struct timespec ts = {0, 50000};
+                    nanosleep(&ts, NULL);
+                }
+                F.cancel_forget(p, r->sreq);
+                if (res == 1) {
+                    /* retracted: no FIN will ever come */
+                    F.req_free(p, r->cpid);
+                    if (status != MPI_STATUS_IGNORE)
+                        status->_cancelled = 1;
+                } else {
+                    rc = fp_block_send_rndv(p, r->cpid);
+                }
+            } else {
+                rc = fp_block_send_rndv(p, r->cpid);
+            }
+        }
+        free(r->tmp);
+        int comm = r->comm;
+        fp_slot_free(s);
+        *req = MPI_REQUEST_NULL;
+        return mv2t_errcheck(comm, rc);
+    }
     if (r->kind == FPK_RECV) {
         if (p != NULL) {
             rc = fp_block_recv(p, r->cpid, status);
@@ -593,6 +778,16 @@ int fp_peek_done(MPI_Request req) {
     int s = req - FP_REQ_BASE;
     FpReq *r = &fp_reqs[s];
     cph p0 = F.global ? F.global() : NULL;
+    if (r->kind == FPK_SEND_RNDV) {
+        if (p0 == NULL)
+            return 1;
+        F.advance(p0);
+        if (F.py_pending(p0) > 0 || F.assist_pending(p0) > 0)
+            fp_py_progress();
+        if (r->cancel_pending && F.cancel_result(p0, r->sreq) == 1)
+            return 1;           /* retracted: resolved */
+        return F.req_state(p0, r->cpid) == 2;
+    }
     if (r->kind == FPK_SEND) {
         /* a cancel-pending send is complete only once the cancel
          * resolves — MPI_Test must stay nonblocking meanwhile */
@@ -650,6 +845,9 @@ int fp_cancel(MPI_Request req) {
         if (F.cancel_recv(p, r->cpid) == 1)
             r->cancel_pending = 1;      /* retracted: surfaces in status */
     } else if (!r->cancel_pending) {
+        /* FPK_SEND and FPK_SEND_RNDV: r->sreq is the wire id the
+         * target's retraction scan matches (for rndv it is the plane
+         * request id carried in the RTS) */
         r->cancel_pending = 1;
         F.cancel_send(p, r->sreq, r->dst);
     }
@@ -660,11 +858,16 @@ int fp_free(MPI_Request *req) {
     int s = *req - FP_REQ_BASE;
     FpReq *r = &fp_reqs[s];
     cph p = F.global ? F.global() : NULL;
-    if (r->kind == FPK_RECV && p != NULL)
-        /* a freed ACTIVE receive must still complete into the user
-         * buffer (MPI-3.1 §3.7.3): orphan it — the plane finishes the
-         * match/copy, then reclaims the slot itself */
+    if ((r->kind == FPK_RECV || r->kind == FPK_SEND_RNDV) && p != NULL) {
+        /* a freed ACTIVE operation must still complete (MPI-3.1
+         * §3.7.3): orphan it — the plane finishes the match/copy (or
+         * the FIN lands), then reclaims the slot itself. A packed
+         * noncontig rndv payload transfers to the plane request so the
+         * reap frees it too. */
+        if (r->kind == FPK_SEND_RNDV && r->tmp != NULL)
+            F.req_own_tmp(p, r->cpid, r->tmp);
         F.req_orphan(p, r->cpid);
+    }
     fp_slot_free(s);
     *req = MPI_REQUEST_NULL;
     return MPI_SUCCESS;

@@ -377,3 +377,93 @@ def test_ctx_disable_semantics(pair):
     lib.cp_send_eager(pair.p[0], 1, 0, 0, 99, b"zz", 2, 0)
     lib.cp_advance(pair.p[1])
     assert lib.cp_unexpected_count(pair.p[1]) == 0
+
+
+def _bind_cma(lib):
+    lib.cp_set_cma.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.cp_cma_enabled.argtypes = [ctypes.c_void_p]
+    lib.cp_send_rndv.restype = ctypes.c_longlong
+    lib.cp_send_rndv.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                 ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                 ctypes.c_void_p, ctypes.c_longlong]
+
+
+def test_cma_rndv_posted_then_send(pair):
+    """CMA rendezvous: receiver pulls straight from the sender's buffer
+    at match time and FINs; sender request completes."""
+    lib = pair.lib
+    _bind_cma(lib)
+    for cp in pair.p:
+        lib.cp_set_cma(cp, 1)
+    n = 256 * 1024
+    payload = bytes(range(256)) * 1024
+    sbuf = ctypes.create_string_buffer(payload, n)
+    rbuf = ctypes.create_string_buffer(n)
+    rreq = lib.cp_irecv(pair.p[1], rbuf, n, 0, 0, 7)
+    sreq = lib.cp_send_rndv(pair.p[0], 1, 0, 0, 7, sbuf, n)
+    assert sreq > 0
+    lib.cp_advance(pair.p[1])        # receiver matches + pulls + FINs
+    assert lib.cp_req_state(pair.p[1], rreq) == 2
+    assert rbuf.raw[:n] == payload
+    lib.cp_advance(pair.p[0])        # sender sees the FIN
+    assert lib.cp_req_state(pair.p[0], sreq) == 2
+    src, tag, nb, tr, ec = pair.status(1, rreq)
+    assert (src, tag, nb, tr, ec) == (0, 7, n, 0, 0)
+    lib.cp_req_free(pair.p[1], rreq)
+    lib.cp_req_free(pair.p[0], sreq)
+
+
+def test_cma_rndv_unexpected_then_recv(pair):
+    """RTS_CMA arriving before the recv parks as unexpected; the pull
+    happens at irecv time. Probe sees it as a rendezvous."""
+    lib = pair.lib
+    _bind_cma(lib)
+    for cp in pair.p:
+        lib.cp_set_cma(cp, 1)
+    n = 100 * 1000
+    payload = b"\xab" * n
+    sbuf = ctypes.create_string_buffer(payload, n)
+    sreq = lib.cp_send_rndv(pair.p[0], 1, 0, 0, 9, sbuf, n)
+    lib.cp_advance(pair.p[1])
+    assert lib.cp_unexpected_count(pair.p[1]) == 1
+    src = ctypes.c_int()
+    tag = ctypes.c_int()
+    nb = ctypes.c_longlong()
+    tok = ctypes.c_longlong()
+    assert lib.cp_probe(pair.p[1], 0, -1, -2, 0, src, tag, nb, tok) == 2
+    assert nb.value == n
+    rbuf = ctypes.create_string_buffer(n)
+    rreq = lib.cp_irecv(pair.p[1], rbuf, n, 0, 0, 9)
+    assert lib.cp_req_state(pair.p[1], rreq) == 2
+    assert rbuf.raw[:n] == payload
+    lib.cp_advance(pair.p[0])
+    assert lib.cp_req_state(pair.p[0], sreq) == 2
+    lib.cp_req_free(pair.p[1], rreq)
+    lib.cp_req_free(pair.p[0], sreq)
+
+
+def test_cma_rndv_truncation(pair):
+    """Receiver buffer smaller than the message: clamp + truncated."""
+    lib = pair.lib
+    _bind_cma(lib)
+    for cp in pair.p:
+        lib.cp_set_cma(cp, 1)
+    sbuf = ctypes.create_string_buffer(b"x" * 1000, 1000)
+    rbuf = ctypes.create_string_buffer(100)
+    rreq = lib.cp_irecv(pair.p[1], rbuf, 100, 0, 0, 3)
+    sreq = lib.cp_send_rndv(pair.p[0], 1, 0, 0, 3, sbuf, 1000)
+    lib.cp_advance(pair.p[1])
+    src, tag, nb, tr, ec = pair.status(1, rreq)
+    assert (nb, tr) == (1000, 1)
+    assert rbuf.raw[:100] == b"x" * 100
+    lib.cp_advance(pair.p[0])
+    assert lib.cp_req_state(pair.p[0], sreq) == 2   # sender released
+    lib.cp_req_free(pair.p[1], rreq)
+    lib.cp_req_free(pair.p[0], sreq)
+
+
+def test_cma_disabled_send_rejected(pair):
+    lib = pair.lib
+    _bind_cma(lib)
+    sbuf = ctypes.create_string_buffer(64)
+    assert lib.cp_send_rndv(pair.p[0], 1, 0, 0, 1, sbuf, 64) == -1

@@ -264,3 +264,18 @@ def test_eager_selfsend_buffer_reuse():
         comm.recv(out, source=comm.rank, tag=3)
         assert (out == np.arange(16)).all(), out
     run_ranks(2, fn)
+
+
+def test_cma_rndv_process_mode():
+    """Large-message integrity over the native CMA rendezvous in real
+    process mode (contiguous + strided + ssend + truncation + pvar)."""
+    import os
+    import subprocess
+    import sys as _sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prog = os.path.join(repo, "tests", "progs", "cma_rndv_prog.py")
+    r = subprocess.run([_sys.executable, "-m", "mvapich2_tpu.run", "-np",
+                        "2", _sys.executable, prog], cwd=repo,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
