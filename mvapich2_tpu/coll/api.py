@@ -48,6 +48,45 @@ def _dt(buf, datatype):
         else from_numpy_dtype(np.asarray(buf).dtype)
 
 
+# ---------------------------------------------------------------------------
+# native-engine delegation (the C plane's collective schedules)
+#
+# Small host collectives on plane-owned comms run the SAME schedules and
+# tags as the C fast path (native/mpi/fastpath.c fp_try_* — recursive
+# doubling / binomial / dissemination with tags from cp_coll_tag's
+# shared per-context counter), so python-API ranks and C-ABI ranks
+# interoperate on the same wire. Checked BEFORE next_coll_tag so
+# delegated collectives never perturb the legacy tag sequence.
+# ---------------------------------------------------------------------------
+
+def _plane_engine(comm):
+    pch = getattr(comm.u, "plane_channel", None)
+    if pch is None or not pch.plane or comm.is_inter \
+            or not getattr(comm, "_plane_owned", False):
+        return None
+    return pch
+
+
+def _plane_thr(pch) -> int:
+    from ..utils.config import get_config
+    thr = get_config()["SMP_EAGERSIZE"]
+    cap = pch.plane_eager_max()
+    return min(thr, cap) if cap else thr
+
+
+def _plane_coll_tag(pch, comm) -> int:
+    return pch._ring.lib.cp_coll_tag(pch.plane, comm.ctx_coll)
+
+
+def _plane_red_ok(op: Op, arr: np.ndarray) -> bool:
+    """Same (op x element-kind) set the C kernels carry (fpc_reduce)."""
+    from ..core import op as opmod
+    if op in (opmod.BAND, opmod.BOR, opmod.BXOR):
+        return arr.dtype.kind in "iub"
+    return op in (opmod.SUM, opmod.PROD, opmod.MAX, opmod.MIN,
+                  opmod.LAND, opmod.LOR, opmod.LXOR)
+
+
 def _displs_from_counts(counts: Sequence[int]) -> List[int]:
     displs = [0] * len(counts)
     for i in range(1, len(counts)):
@@ -60,6 +99,11 @@ def _displs_from_counts(counts: Sequence[int]) -> List[int]:
 # ---------------------------------------------------------------------------
 
 def barrier(comm) -> None:
+    pch = _plane_engine(comm)
+    if pch is not None:
+        if comm.size > 1:
+            alg.barrier_dissemination(comm, _plane_coll_tag(pch, comm))
+        return
     tag = comm.next_coll_tag()
     fn = _select(comm, "barrier", 0)
     fn(comm, tag)
@@ -69,9 +113,16 @@ def bcast(comm, buf, count: int, datatype: Optional[Datatype],
           root: int) -> None:
     mpi_assert(0 <= root < comm.size, MPI_ERR_ROOT, f"bad root {root}")
     datatype = _dt(buf, datatype)
-    tag = comm.next_coll_tag()
     nbytes = datatype.size * count
-    fn = _select(comm, "bcast", nbytes)
+    pch = _plane_engine(comm)
+    if pch is not None and nbytes <= _plane_thr(pch):
+        # bcast mixes signature-equivalent datatypes legally, so the
+        # delegation gate is the SIGNATURE bytes only — identical on
+        # every rank, identical to the C fast path's gate
+        fn, tag = alg.bcast_binomial, _plane_coll_tag(pch, comm)
+    else:
+        tag = comm.next_coll_tag()
+        fn = _select(comm, "bcast", nbytes)
     if comm.size == 1:
         return
     data = datatype.pack(buf, count) if comm.rank == root \
@@ -85,10 +136,16 @@ def bcast(comm, buf, count: int, datatype: Optional[Datatype],
 def reduce(comm, sendbuf, recvbuf, count: int, datatype: Optional[Datatype],
            op: Op, root: int) -> None:
     datatype = _dt(recvbuf if sendbuf is IN_PLACE else sendbuf, datatype)
-    tag = comm.next_coll_tag()
     src = recvbuf if sendbuf is IN_PLACE else sendbuf
     arr = _packed(src, count, datatype)
-    fn = _select(comm, "reduce", arr.nbytes, op=op)
+    pch = _plane_engine(comm)
+    if pch is not None and datatype.basic is not None \
+            and arr.nbytes <= _plane_thr(pch) and _plane_red_ok(op, arr):
+        fn, tag = alg.reduce_binomial, _plane_coll_tag(pch, comm)
+        arr = np.ascontiguousarray(arr)
+    else:
+        tag = comm.next_coll_tag()
+        fn = _select(comm, "reduce", arr.nbytes, op=op)
     out = fn(comm, arr, op, root, tag)
     if comm.rank == root:
         _unpack(out, recvbuf, count, datatype)
@@ -98,9 +155,16 @@ def allreduce(comm, sendbuf, recvbuf, count: int,
               datatype: Optional[Datatype], op: Op) -> None:
     datatype = _dt(recvbuf if sendbuf is IN_PLACE else sendbuf, datatype)
     src = recvbuf if sendbuf is IN_PLACE else sendbuf
-    tag = comm.next_coll_tag()
     arr = _packed(src, count, datatype)
-    fn = _select(comm, "allreduce", arr.nbytes, op=op)
+    pch = _plane_engine(comm)
+    if pch is not None and datatype.basic is not None \
+            and arr.nbytes <= _plane_thr(pch) and _plane_red_ok(op, arr):
+        fn, tag = alg.allreduce_recursive_doubling, \
+            _plane_coll_tag(pch, comm)
+        arr = np.ascontiguousarray(arr)
+    else:
+        tag = comm.next_coll_tag()
+        fn = _select(comm, "allreduce", arr.nbytes, op=op)
     out = fn(comm, arr, op, tag)
     _unpack(out, recvbuf, count, datatype)
 

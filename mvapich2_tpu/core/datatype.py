@@ -196,11 +196,21 @@ class Datatype:
         if self.basic is None:
             raise MPIException(MPI_ERR_TYPE,
                                "heterogeneous datatype in reduction")
+        if self.basic.itemsize == self.size:
+            # this type's packed element already IS the basic layout
+            # (plain basics, and synthesized struct basics whose element
+            # carries its padding) — restaging would misparse it
+            return np.ascontiguousarray(b).view(np.uint8).reshape(-1) \
+                .view(self.basic)
+        # true pair types (size 12 != itemsize 16): packed signature
+        # bytes restage into the padded struct (rma/acc-pairtype.c)
         return packed_to_basic(b, self.basic)
 
     def from_basic_array(self, arr: np.ndarray) -> np.ndarray:
         """Inverse of :meth:`to_numpy`: aligned elements -> packed
         signature bytes."""
+        if self.basic is not None and self.basic.itemsize == self.size:
+            return arr.view(np.uint8)
         return basic_to_packed(arr)
 
 

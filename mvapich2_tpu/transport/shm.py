@@ -193,6 +193,7 @@ def _bind_cplane(lib) -> None:
                                  L.c_int, L.c_void_p, L.c_longlong]
     lib.cp_rndv_wire.restype = L.c_longlong
     lib.cp_rndv_wire.argtypes = [L.c_longlong]
+    lib.cp_coll_tag.argtypes = [L.c_void_p, L.c_int]
     lib.cp_set_cma.argtypes = [L.c_void_p, L.c_int]
     lib.cp_cma_enabled.argtypes = [L.c_void_p]
     lib.cp_congested.argtypes = [L.c_void_p, L.c_int]
@@ -500,7 +501,22 @@ class ShmChannel(Channel):
             self._peer_bells[r] = addr
             lib.cp_set_bell(self.plane, self.local_index[r], addr.encode())
         lib.cp_register_global(self.plane)
-        if get_config()["USE_CMA"] and self._probe_cma():
+        # CMA is enabled only by UNANIMOUS agreement: every co-resident
+        # rank publishes its own probe verdict (can it read a neighbor,
+        # is USE_CMA set) and reads everyone else's. The receiver
+        # performs the pull, so a single incapable/opted-out rank must
+        # disable the protocol for the whole node.
+        my_ok = bool(get_config()["USE_CMA"]) and self._probe_cma()
+        self.kvs.put(f"shm-cma-ok-{self.my_rank}", "1" if my_ok else "0")
+        all_ok = my_ok
+        for r in self.local_ranks:
+            if r == self.my_rank or not all_ok:
+                continue
+            try:
+                all_ok = self.kvs.get(f"shm-cma-ok-{r}") == "1"
+            except Exception:
+                all_ok = False
+        if all_ok:
             lib.cp_set_cma(self.plane, 1)
         # rebind the plane counters' sources to this live plane:
         # fast-path hit-rate is the one number that says whether a

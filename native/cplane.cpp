@@ -260,6 +260,10 @@ struct CPlane {
   int bell_tx;                   // unbound dgram socket for sendto
   int cma_enabled;               // large-message CMA rendezvous usable
                                  // (probed by bootstrap, cp_set_cma)
+  // per-collective-context tag sequence, shared by the python coll
+  // layer and the C fast path so their schedules use matching tags
+  int* ctags;                    // (ctx, seq) pairs
+  int ctags_n, ctags_cap;
   // stats
   uint64_t n_eager_tx, n_eager_rx, n_fwd_py;
   uint64_t n_rndv_tx, n_rndv_rx;
@@ -808,6 +812,7 @@ void cp_destroy(void* cp) {
   free(p->bell_set);
   free(p->ctxs.v);
   free(p->retired.v);
+  free(p->ctags);
   pthread_mutex_destroy(&p->mu);
   free(p);
 }
@@ -1048,6 +1053,33 @@ void cp_set_cma(void* cp, int enabled) {
 // the wire id a rendezvous send travels under (cancel initiators need
 // it: the target's retraction scan matches wire ids)
 long long cp_rndv_wire(long long rid) { return rid | RNDV_WIRE_BASE; }
+
+// next collective tag for a collective context. Collectives are ordered
+// per comm and every member draws from this shared counter (python coll
+// layer and C fast path alike), so adjacent collectives cannot
+// cross-match. The returned tags live above the python coll layer's
+// legacy 1..32767 tag range.
+int cp_coll_tag(void* cp, int cctx) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  pthread_mutex_lock(&p->mu);
+  int i;
+  for (i = 0; i < p->ctags_n; i++)
+    if (p->ctags[2 * i] == cctx) break;
+  if (i == p->ctags_n) {
+    if (p->ctags_n == p->ctags_cap) {
+      p->ctags_cap = p->ctags_cap ? p->ctags_cap * 2 : 16;
+      p->ctags = static_cast<int*>(
+          realloc(p->ctags, 2 * p->ctags_cap * sizeof(int)));
+    }
+    p->ctags[2 * i] = cctx;
+    p->ctags[2 * i + 1] = 0;
+    p->ctags_n++;
+  }
+  unsigned seq = static_cast<unsigned>(++p->ctags[2 * i + 1]);
+  int tag = (1 << 20) + static_cast<int>(seq & 0xFFFFFu);
+  pthread_mutex_unlock(&p->mu);
+  return tag;
+}
 
 // transfer ownership of a packed payload to the plane request: freed by
 // req_destroy when the request completes/reaps (MPI_Request_free on an

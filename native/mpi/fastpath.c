@@ -72,6 +72,7 @@ static struct {
     int (*congested)(cph, int);
     long long (*rndv_wire)(long long);
     void (*req_own_tmp)(cph, long long, void *);
+    int (*coll_tag)(cph, int);
 } F;
 
 static int fp_state = -1;       /* -1 unknown, 0 unavailable, 1 ready */
@@ -122,6 +123,7 @@ static int fp_load_locked(void) {
     SYM(congested, "cp_congested");
     SYM(rndv_wire, "cp_rndv_wire");
     SYM(req_own_tmp, "cp_req_own_tmp");
+    SYM(coll_tag, "cp_coll_tag");
 #undef SYM
     return 1;
 }
@@ -459,6 +461,21 @@ static void *fp_pack_spans(FpDt *d, const void *buf, int count, long nb) {
         }
     }
     return tmp;
+}
+
+/* inverse of fp_pack_spans: scatter packed bytes into the strided
+ * layout */
+static void fp_unpack_spans(FpDt *d, void *buf, int count,
+                            const void *packed) {
+    const uint8_t *in = packed;
+    uint8_t *b = buf;
+    for (int e = 0; e < count; e++) {
+        uint8_t *eb = b + (long long)e * d->extent;
+        for (int s = 0; s < d->nspans; s++) {
+            memcpy(eb + d->spans[2 * s], in, (size_t)d->spans[2 * s + 1]);
+            in += d->spans[2 * s + 1];
+        }
+    }
 }
 
 /* block until a rendezvous send request completes; frees it */
@@ -871,4 +888,398 @@ int fp_free(MPI_Request *req) {
     fp_slot_free(s);
     *req = MPI_REQUEST_NULL;
     return MPI_SUCCESS;
+}
+
+/* ------------------------------------------------------------------ */
+/* collectives over the plane                                          */
+/*                                                                     */
+/* The reference's small-message collectives never leave native code:  */
+/* the shm-slot segment (ch3_shmem_coll.c:528,1365) and the pt2pt      */
+/* algorithm zoo (allreduce_osu.c:360 recursive doubling,              */
+/* bcast_osu.c binomial) both issue their steps from C. Rounds 1-4     */
+/* forwarded every collective through the embedded interpreter at      */
+/* ~1 ms+ per step; here the small-message algorithms run their        */
+/* send/recv schedule straight on the plane (cp_send_eager/cp_irecv    */
+/* on the comm's collective context).                                  */
+/*                                                                     */
+/* Eligibility mirrors the pt2pt fast path and is DETERMINISTIC in the */
+/* call signature, so every member of the comm takes the same path:    */
+/* plane-owned comm, builtin contiguous datatype, builtin (non-MINLOC) */
+/* op, payload within the eager threshold.                             */
+/*                                                                     */
+/* The SCHEDULES (and tags, via cp_coll_tag's shared per-context       */
+/* counter) are byte-identical to the python layer's plane-delegated   */
+/* algorithms (coll/algorithms.py allreduce_recursive_doubling,        */
+/* bcast_binomial, reduce_binomial, barrier_dissemination), so python- */
+/* API ranks and C-ABI ranks interoperate on the same wire.            */
+/* ------------------------------------------------------------------ */
+
+/* one reduction step: inout[i] = inout[i] OP in[i] (builtin ops 0-9) */
+#define FPC_LOOP_INT(T) do {                                            \
+    T *a = (T *)inout; const T *b = (const T *)in; long i;              \
+    switch (op) {                                                       \
+    case 0: for (i = 0; i < n; i++) a[i] = (T)(a[i] + b[i]); break;     \
+    case 1: for (i = 0; i < n; i++) a[i] = (T)(a[i] * b[i]); break;     \
+    case 2: for (i = 0; i < n; i++) if (b[i] > a[i]) a[i] = b[i]; break;\
+    case 3: for (i = 0; i < n; i++) if (b[i] < a[i]) a[i] = b[i]; break;\
+    case 4: for (i = 0; i < n; i++) a[i] = a[i] && b[i]; break;         \
+    case 5: for (i = 0; i < n; i++) a[i] = a[i] || b[i]; break;         \
+    case 6: for (i = 0; i < n; i++) a[i] = (T)(a[i] & b[i]); break;     \
+    case 7: for (i = 0; i < n; i++) a[i] = (T)(a[i] | b[i]); break;     \
+    case 8: for (i = 0; i < n; i++) a[i] = (T)(a[i] ^ b[i]); break;     \
+    case 9: for (i = 0; i < n; i++) a[i] = (!!a[i]) ^ (!!b[i]); break;  \
+    default: return -1;                                                 \
+    }                                                                   \
+    return 0;                                                           \
+} while (0)
+
+#define FPC_LOOP_FLT(T) do {                                            \
+    T *a = (T *)inout; const T *b = (const T *)in; long i;              \
+    switch (op) {                                                       \
+    case 0: for (i = 0; i < n; i++) a[i] = a[i] + b[i]; break;          \
+    case 1: for (i = 0; i < n; i++) a[i] = a[i] * b[i]; break;          \
+    case 2: for (i = 0; i < n; i++) if (b[i] > a[i]) a[i] = b[i]; break;\
+    case 3: for (i = 0; i < n; i++) if (b[i] < a[i]) a[i] = b[i]; break;\
+    case 4: for (i = 0; i < n; i++) a[i] = a[i] && b[i]; break;         \
+    case 5: for (i = 0; i < n; i++) a[i] = a[i] || b[i]; break;         \
+    case 9: for (i = 0; i < n; i++) a[i] = (a[i] != 0) != (b[i] != 0);  \
+            break;                                                      \
+    default: return -1;                                                 \
+    }                                                                   \
+    return 0;                                                           \
+} while (0)
+
+static int fpc_reduce(int op, MPI_Datatype dt, void *inout, const void *in,
+                      long n) {
+    switch (dt) {
+    case 0: FPC_LOOP_INT(unsigned char);        /* MPI_BYTE */
+    case 1: FPC_LOOP_INT(char);
+    case 2: FPC_LOOP_INT(int);
+    case 3: FPC_LOOP_FLT(float);
+    case 4: FPC_LOOP_FLT(double);
+    case 5: FPC_LOOP_INT(long long);
+    case 6: FPC_LOOP_INT(unsigned long);
+    case 7: FPC_LOOP_INT(short);
+    case 8: FPC_LOOP_INT(unsigned char);
+    case 10: FPC_LOOP_INT(unsigned int);
+    case 11: FPC_LOOP_INT(unsigned short);
+    case 12: FPC_LOOP_FLT(long double);
+    case 20: FPC_LOOP_INT(long);
+    default: return -1;
+    }
+}
+
+/* can the C path carry this (dtype, op) at all? (probe without side
+ * effects — used for the all-ranks-identical dispatch decision) */
+static int fpc_op_ok(int op, MPI_Datatype dt) {
+    char a[16] = {0}, b[16] = {0};
+    if (op < 0 || op > 9)
+        return 0;
+    return fpc_reduce(op, dt, a, b, 1) == 0;
+}
+
+/* contiguous-builtin element size, or 0 */
+static long fpc_elsz(MPI_Datatype dt) {
+    if (dt < 0 || dt >= 100)
+        return 0;
+    int sz = dt_size(dt);
+    return (sz > 0 && (long)sz == dt_extent_b(dt)) ? sz : 0;
+}
+
+/* blocking exchange step on the comm's COLLECTIVE context: post the
+ * recv first, inject the send, wait. dst/src are comm ranks, -1 = none */
+static int fpc_sendrecv(cph p, FpComm *fc, int dst, int src, int tag,
+                        const void *sb, long snb, void *rb, long rnb) {
+    int cctx = fc->ctx + 1;
+    long long rid = -1;
+    if (src >= 0)
+        rid = F.irecv(p, rb, rnb, cctx, src, tag);
+    if (dst >= 0) {
+        long long sid = atomic_fetch_add(&fp_sreq_next, 1);
+        long long rc = F.send_eager(p, fc->ring[dst], cctx, fc->rank, tag,
+                                    sb, snb, sid);
+        if (rc != 0) {
+            if (rid >= 0) {
+                F.cancel_recv(p, rid);
+                F.req_free(p, rid);
+            }
+            return rc == -2 ? MPIX_ERR_PROC_FAILED : MPI_ERR_INTERN;
+        }
+    }
+    if (rid >= 0) {
+        int rc = fp_block_recv(p, rid, MPI_STATUS_IGNORE);
+        F.req_free(p, rid);
+        return rc;
+    }
+    return MPI_SUCCESS;
+}
+
+/* common eligibility; returns the plane or NULL, fills fc/nb */
+static cph fpc_enter(int count, MPI_Datatype dt, MPI_Comm comm,
+                     FpComm **o_fc, long *o_nb) {
+    static int dbg = -1;
+    if (dbg < 0)
+        dbg = getenv("MV2T_FPC_DEBUG") != NULL;
+    cph p = fp_plane();
+    if (p == NULL || count < 0) {
+        if (dbg)
+            fprintf(stderr, "fpc: plane=%p count=%d\n", p, count);
+        return NULL;
+    }
+    long elsz = fpc_elsz(dt);
+    if (elsz <= 0) {
+        if (dbg)
+            fprintf(stderr, "fpc: dt %d elsz %ld\n", dt, elsz);
+        return NULL;
+    }
+    /* bind the comm BEFORE the threshold check: the first successful
+     * bind is what fetches fp_threshold (a collective is often the
+     * very first MPI call of a program) */
+    FpComm *fc = fp_comm(comm);
+    if (fc == NULL) {
+        if (dbg)
+            fprintf(stderr, "fpc: comm %d not plane-bound\n", comm);
+        return NULL;
+    }
+    long nb = elsz * count;
+    if (fp_threshold <= 0 || nb > fp_threshold) {
+        if (dbg)
+            fprintf(stderr, "fpc: nb %ld vs thr %ld\n", nb, fp_threshold);
+        return NULL;
+    }
+    *o_fc = fc;
+    *o_nb = nb;
+    return p;
+}
+
+int fp_try_allreduce(const void *sendbuf, void *recvbuf, int count,
+                     MPI_Datatype dt, MPI_Op op, MPI_Comm comm,
+                     int *out_rc) {
+    FpComm *fc;
+    long nb;
+    cph p = fpc_enter(count, dt, comm, &fc, &nb);
+    if (p == NULL || !fpc_op_ok(op, dt))
+        return 0;
+    if (sendbuf != MPI_IN_PLACE && nb > 0)
+        memcpy(recvbuf, sendbuf, (size_t)nb);
+    int n = fc->size, rank = fc->rank;
+    if (n == 1) {
+        *out_rc = MPI_SUCCESS;
+        return 1;
+    }
+    int tag = F.coll_tag(p, fc->ctx + 1);
+    void *tmp = malloc(nb > 0 ? (size_t)nb : 1);
+    if (tmp == NULL)
+        return 0;
+    int rc = MPI_SUCCESS;
+    /* recursive doubling, byte-identical to the python mirror
+     * (coll/algorithms.py allreduce_recursive_doubling; the
+     * allreduce_osu.c:360 shape): fold the non-power-of-2 remainder,
+     * rd over the power-of-2 set, unfold */
+    int pof2 = 1;
+    while (pof2 * 2 <= n)
+        pof2 *= 2;
+    int rem = n - pof2;
+    int newrank;
+    if (rank < 2 * rem) {
+        if (rank % 2 == 0) {
+            rc = fpc_sendrecv(p, fc, rank + 1, -1, tag, recvbuf, nb,
+                              NULL, 0);
+            newrank = -1;
+        } else {
+            rc = fpc_sendrecv(p, fc, -1, rank - 1, tag, NULL, 0,
+                              tmp, nb);
+            if (rc == MPI_SUCCESS)
+                fpc_reduce(op, dt, recvbuf, tmp, count);
+            newrank = rank / 2;
+        }
+    } else {
+        newrank = rank - rem;
+    }
+    if (rc == MPI_SUCCESS && newrank != -1) {
+        for (int mask = 1; mask < pof2; mask <<= 1) {
+            int newdst = newrank ^ mask;
+            int dst = newdst < rem ? newdst * 2 + 1 : newdst + rem;
+            rc = fpc_sendrecv(p, fc, dst, dst, tag, recvbuf, nb, tmp, nb);
+            if (rc != MPI_SUCCESS)
+                break;
+            fpc_reduce(op, dt, recvbuf, tmp, count);
+        }
+    }
+    if (rc == MPI_SUCCESS && rank < 2 * rem) {
+        if (rank % 2)
+            rc = fpc_sendrecv(p, fc, rank - 1, -1, tag, recvbuf, nb,
+                              NULL, 0);
+        else
+            rc = fpc_sendrecv(p, fc, -1, rank + 1, tag, NULL, 0,
+                              recvbuf, nb);
+    }
+    free(tmp);
+    *out_rc = rc;
+    return 1;
+}
+
+int fp_try_bcast(void *buf, int count, MPI_Datatype dt, int root,
+                 MPI_Comm comm, int *out_rc) {
+    cph p = fp_plane();
+    if (p == NULL || count < 0 || root < 0)
+        return 0;
+    /* bcast legally mixes signature-equivalent datatypes across ranks
+     * (MPI-3.1 §5.4), so eligibility depends only on the SIGNATURE
+     * bytes — derived types ride via pack/unpack like the python
+     * mirror does */
+    FpDt *d = fp_dt(dt);
+    if (d == NULL)
+        return 0;
+    FpComm *fc = fp_comm(comm);     /* bind first: fetches fp_threshold */
+    if (fc == NULL)
+        return 0;
+    long nb = (long)(d->size * count);
+    if (fp_threshold <= 0 || nb > fp_threshold)
+        return 0;
+    int n = fc->size, rank = fc->rank;
+    if (root >= n)
+        return 0;
+    if (n == 1) {
+        *out_rc = MPI_SUCCESS;
+        return 1;
+    }
+    uint8_t *data;                  /* packed wire bytes */
+    void *tmp = NULL;
+    if (d->state == FPD_CONTIG) {
+        data = buf;
+    } else {
+        if (rank == root) {
+            tmp = fp_pack_spans(d, buf, count, nb);
+            if (tmp == NULL)
+                return 0;
+        } else {
+            tmp = malloc(nb > 0 ? (size_t)nb : 1);
+            if (tmp == NULL)
+                return 0;
+        }
+        data = tmp;
+    }
+    int tag = F.coll_tag(p, fc->ctx + 1);
+    int relrank = (rank - root + n) % n;
+    int rc = MPI_SUCCESS;
+    /* binomial, byte-identical to coll/algorithms.py bcast_binomial
+     * (the bcast_osu.c MPIR_Bcast_binomial_MV2 shape) */
+    int mask = 1;
+    while (mask < n) {
+        if (relrank & mask) {
+            int src = (rank - mask + n) % n;
+            rc = fpc_sendrecv(p, fc, -1, src, tag, NULL, 0, data, nb);
+            break;
+        }
+        mask <<= 1;
+    }
+    mask >>= 1;
+    while (rc == MPI_SUCCESS && mask > 0) {
+        if (relrank + mask < n) {
+            int dst = (rank + mask) % n;
+            rc = fpc_sendrecv(p, fc, dst, -1, tag, data, nb, NULL, 0);
+        }
+        mask >>= 1;
+    }
+    if (tmp != NULL) {
+        if (rc == MPI_SUCCESS && rank != root)
+            fp_unpack_spans(d, buf, count, tmp);
+        free(tmp);
+    }
+    *out_rc = rc;
+    return 1;
+}
+
+int fp_try_reduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype dt, MPI_Op op, int root, MPI_Comm comm,
+                  int *out_rc) {
+    FpComm *fc;
+    long nb;
+    cph p = fpc_enter(count, dt, comm, &fc, &nb);
+    if (p == NULL || root < 0 || !fpc_op_ok(op, dt))
+        return 0;
+    int n = fc->size, rank = fc->rank;
+    if (root >= n)
+        return 0;
+    /* accumulate into recvbuf at the root, a scratch result elsewhere */
+    void *result;
+    void *scratch = NULL;
+    if (rank == root) {
+        result = recvbuf;
+        if (sendbuf != MPI_IN_PLACE && nb > 0)
+            memcpy(result, sendbuf, (size_t)nb);
+    } else {
+        scratch = malloc(nb > 0 ? (size_t)nb : 1);
+        if (scratch == NULL)
+            return 0;
+        result = scratch;
+        if (nb > 0)
+            memcpy(result, sendbuf, (size_t)nb);
+    }
+    if (n == 1) {
+        free(scratch);
+        *out_rc = MPI_SUCCESS;
+        return 1;
+    }
+    int tag = F.coll_tag(p, fc->ctx + 1);
+    void *tmp = malloc(nb > 0 ? (size_t)nb : 1);
+    if (tmp == NULL) {
+        free(scratch);
+        return 0;
+    }
+    int rc = MPI_SUCCESS;
+    int relrank = (rank - root + n) % n;
+    /* commutative binomial gather-to-root, byte-identical to
+     * coll/algorithms.py reduce_binomial (the MPIR_Reduce_binomial
+     * shape; all builtin ops here are commutative) */
+    int mask = 1;
+    while (mask < n) {
+        if ((relrank & mask) == 0) {
+            int relsrc = relrank | mask;
+            if (relsrc < n) {
+                int src = (relsrc + root) % n;
+                rc = fpc_sendrecv(p, fc, -1, src, tag, NULL, 0, tmp, nb);
+                if (rc != MPI_SUCCESS)
+                    break;
+                fpc_reduce(op, dt, result, tmp, count);
+            }
+        } else {
+            int dst = ((relrank & ~mask) + root) % n;
+            rc = fpc_sendrecv(p, fc, dst, -1, tag, result, nb, NULL, 0);
+            break;
+        }
+        mask <<= 1;
+    }
+    free(tmp);
+    free(scratch);
+    *out_rc = rc;
+    return 1;
+}
+
+int fp_try_barrier(MPI_Comm comm, int *out_rc) {
+    FpComm *fc;
+    long nb;
+    cph p = fpc_enter(0, MPI_BYTE, comm, &fc, &nb);
+    if (p == NULL)
+        return 0;
+    int n = fc->size, rank = fc->rank;
+    if (n == 1) {
+        *out_rc = MPI_SUCCESS;
+        return 1;
+    }
+    int tag = F.coll_tag(p, fc->ctx + 1);
+    int rc = MPI_SUCCESS;
+    /* dissemination with 1-byte tokens, byte-identical to
+     * coll/algorithms.py barrier_dissemination */
+    unsigned char token = 0, rtoken = 0;
+    for (int mask = 1; mask < n; mask <<= 1) {
+        int dst = (rank + mask) % n;
+        int src = (rank - mask + n) % n;
+        rc = fpc_sendrecv(p, fc, dst, src, tag, &token, 1, &rtoken, 1);
+        if (rc != MPI_SUCCESS)
+            break;
+    }
+    *out_rc = rc;
+    return 1;
 }

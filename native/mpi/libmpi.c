@@ -584,6 +584,9 @@ int MPI_Get_count(const MPI_Status *status, MPI_Datatype dt, int *count) {
 /* ------------------------------------------------------------------ */
 
 int MPI_Barrier(MPI_Comm comm) {
+    int frc;
+    if (fp_try_barrier(comm, &frc))
+        return mv2t_errcheck(comm, frc);
     return mv2t_errcheck(comm, shim_call_i("barrier", "(i)", comm));
 }
 
@@ -636,6 +639,9 @@ static int coll2(const char *fn, const void *sb, void *rb, long snb,
 
 int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root,
               MPI_Comm comm) {
+    int frc;
+    if (fp_try_bcast(buf, count, dt, root, comm, &frc))
+        return mv2t_errcheck(comm, frc);
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *view = mv_view(buf, dt_span_b(dt, count));
     PyObject *res = PyObject_CallMethod(g_shim, "bcast", "(Oiiii)", view,
@@ -653,6 +659,9 @@ int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
     if (mv2t_is_userop(op))
         return mv2t_userop_coll(0, sendbuf, recvbuf, count, dt, op, 0,
                                 comm);
+    int frc;
+    if (fp_try_allreduce(sendbuf, recvbuf, count, dt, op, comm, &frc))
+        return mv2t_errcheck(comm, frc);
     long nb = dt_span_b(dt, count);
     return mv2t_errcheck(comm, coll2("allreduce", sendbuf, recvbuf, nb, nb, "(iiii)",
                  count, dt, op, comm));
@@ -663,6 +672,9 @@ int MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
     if (mv2t_is_userop(op))
         return mv2t_userop_coll(1, sendbuf, recvbuf, count, dt, op, root,
                                 comm);
+    int frc;
+    if (fp_try_reduce(sendbuf, recvbuf, count, dt, op, root, comm, &frc))
+        return mv2t_errcheck(comm, frc);
     long nb = dt_span_b(dt, count);
     return mv2t_errcheck(comm, coll2("reduce", sendbuf, recvbuf, nb, nb, "(iiiii)",
                  count, dt, op, root, comm));

@@ -64,6 +64,15 @@ int fp_peek_done(MPI_Request req);
 int fp_get_status(MPI_Request req, int *flag, MPI_Status *status);
 int fp_cancel(MPI_Request req);
 int fp_free(MPI_Request *req);
+int fp_try_allreduce(const void *sendbuf, void *recvbuf, int count,
+                     MPI_Datatype dt, MPI_Op op, MPI_Comm comm,
+                     int *out_rc);
+int fp_try_bcast(void *buf, int count, MPI_Datatype dt, int root,
+                 MPI_Comm comm, int *out_rc);
+int fp_try_reduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype dt, MPI_Op op, int root, MPI_Comm comm,
+                  int *out_rc);
+int fp_try_barrier(MPI_Comm comm, int *out_rc);
 void fp_comm_forget(MPI_Comm comm);
 
 #endif /* MV2T_LIBMPI_INTERNAL_H */
