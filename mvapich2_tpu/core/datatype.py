@@ -43,16 +43,17 @@ class Datatype:
         # the MTest generators costs milliseconds, not tens of seconds
         # of tuple churn)
         arr = np.asarray(spans, dtype=np.int64).reshape(-1, 2)
-        # Negative displacements/strides (legal MPI, e.g. vector with
-        # stride < 0) would index before the buffer origin; our numpy-backed
-        # pack/unpack can't express that, so reject at construction rather
-        # than silently read from the end of the buffer.
-        if arr.size and bool((arr[:, 0] < 0).any()):
-            raise MPIException(
-                MPI_ERR_TYPE,
-                "negative byte displacements are not supported "
-                f"(type {name or 'derived'})")
         self.spans = _merge_spans(arr)
+        # Negative displacements/strides are legal MPI (vector with
+        # stride < 0, MPI_LB markers — datatype/lbub.c,
+        # unusual-noncontigs.c). The numpy-backed pack/unpack walks a
+        # view that starts at the buffer pointer and cannot express
+        # bytes before it, so such types are flagged and routed through
+        # the absolute-address (ctypes) path at the C boundary
+        # (cshim._abs_gather/_abs_scatter); pack/unpack refuse rather
+        # than wrap-index from the end of the buffer.
+        self.min_off = (int(self.spans[:, 0].min())
+                        if len(self.spans) else 0)
         self.size = int(self.spans[:, 1].sum()) if len(self.spans) else 0
         self.lb = lb
         self.extent = extent
@@ -64,6 +65,15 @@ class Datatype:
     @property
     def ub(self) -> int:
         return self.lb + self.extent
+
+    def needs_abs(self, count: int = 1) -> bool:
+        """True when ``count`` elements reach bytes BEFORE the buffer
+        pointer (negative typemap displacements, or a negative extent
+        tiling backward) — pack/unpack on a pointer-based view cannot
+        express that; the absolute-address path must be used."""
+        if self.min_off < 0:
+            return True
+        return count > 1 and self.extent < 0 and len(self.spans) > 0
 
     @property
     def is_contiguous(self) -> bool:
@@ -139,6 +149,11 @@ class Datatype:
 
     def pack(self, buf, count: int) -> np.ndarray:
         """Gather ``count`` elements from ``buf`` into contiguous bytes."""
+        if count and self.needs_abs(count):
+            raise MPIException(
+                MPI_ERR_TYPE,
+                "negative-displacement type requires absolute "
+                f"addressing (type {self.name or 'derived'})")
         raw = as_bytes_view(buf)
         if self.is_contiguous:
             n = self.size * count
@@ -165,6 +180,11 @@ class Datatype:
         """Scatter contiguous bytes ``data`` into ``buf``."""
         if count == 0:
             return
+        if self.needs_abs(count):
+            raise MPIException(
+                MPI_ERR_TYPE,
+                "negative-displacement type requires absolute "
+                f"addressing (type {self.name or 'derived'})")
         raw = as_bytes_view(buf, writable=True)
         src = np.frombuffer(as_bytes_view(data), dtype=np.uint8)
         dst = np.frombuffer(raw, dtype=np.uint8)
@@ -407,8 +427,18 @@ def create_contiguous(count: int, oldtype: Datatype) -> Datatype:
                  if count else np.empty((0, 2), dtype=np.int64))
     else:
         spans = _replicate_spans(oldtype.spans, count, oldtype.extent)
+    # MPI-1 §3.12.3 bounds: lb/ub are the min/max of (disp + lb/ub)
+    # over the replicas — NOT lb + count*extent — so marker-pinned
+    # (sticky) bounds and negative extents tile correctly
+    # (datatype/lbub.c negextent contig: lb -12, extent 9)
+    if count > 0:
+        tail = (count - 1) * oldtype.extent
+        lb = oldtype.lb + min(0, tail)
+        extent = oldtype.ub + max(0, tail) - lb
+    else:
+        lb, extent = oldtype.lb, 0
     return _env(
-        Datatype(spans, count * oldtype.extent, oldtype.lb, oldtype.basic,
+        Datatype(spans, extent, lb, oldtype.basic,
                  f"contig({count},{oldtype.name})"),
         "contiguous", [count], [], [oldtype])
 
@@ -444,9 +474,20 @@ def create_hvector(count: int, blocklength: int, stride_bytes: int,
     # spans stay in typemap (declaration) order — MPI serializes blocks
     # in declared order, which matters when stride < blocklength (the
     # blocks overlap, e.g. hvector stride 0 = N replicas of one block)
-    lb = _lb_of(spans)
+    #
+    # bounds via the MPI-1 §3.12.3 min/max rule over the element
+    # displacements b*stride + i*extent (both ranges independent), so
+    # sticky lb/ub, negative strides, and negative extents all land
+    # where datatype/lbub.c expects
+    if count > 0 and blocklength > 0:
+        tail_i = (blocklength - 1) * oldtype.extent
+        tail_b = (count - 1) * stride_bytes
+        lb = oldtype.lb + min(0, tail_i) + min(0, tail_b)
+        extent = (oldtype.ub + max(0, tail_i) + max(0, tail_b)) - lb
+    else:
+        lb, extent = 0, 0
     return _env(
-        Datatype(spans, _extent_of(spans, oldtype) - lb, lb,
+        Datatype(spans, extent, lb,
                  oldtype.basic,
                  f"hvector({count},{blocklength},{stride_bytes})"),
         "hvector", [count, blocklength], [stride_bytes], [oldtype])
@@ -490,12 +531,17 @@ def create_hindexed(blocklengths: Sequence[int], disp_bytes: Sequence[int],
     ]
     spans = (np.concatenate(parts)
              if parts else np.empty((0, 2), dtype=np.int64))
-    # natural bounds (MPI-3.1 §4.1.7): lb = min typemap displacement —
-    # NOT 0 — so tiling count>1 elements (extent-strided) matches the
-    # standard when the first block starts at a positive displacement
-    lb = _lb_of(spans)
+    # bounds (MPI-1 §3.12.3): lb/ub = min/max over blocks of
+    # (disp + old.lb/ub + the block's extent-tiling tail) — NOT 0 —
+    # honoring sticky bounds and negative extents/displacements
+    lbs = [d + oldtype.lb + min(0, (bl - 1) * oldtype.extent)
+           for bl, d in zip(blocklengths, disp_bytes) if bl > 0]
+    ubs = [d + oldtype.ub + max(0, (bl - 1) * oldtype.extent)
+           for bl, d in zip(blocklengths, disp_bytes) if bl > 0]
+    lb = min(lbs, default=0)
+    extent = max(ubs, default=0) - lb if lbs else 0
     return _env(
-        Datatype(spans, _extent_of(spans, oldtype) - lb, lb,
+        Datatype(spans, extent, lb,
                  oldtype.basic, f"hindexed({len(blocklengths)})"),
         "hindexed", [len(blocklengths)] + list(blocklengths),
         list(disp_bytes), [oldtype])
@@ -533,9 +579,10 @@ def create_struct(blocklengths: Sequence[int], disp_bytes: Sequence[int],
     # blocklength bl spans [d + t.lb, d + (bl-1)*t.extent + t.ub]
     real = [(d, bl, t) for d, bl, t
             in zip(disp_bytes, blocklengths, types) if bl > 0]
-    min_lb = min((d + t.lb for d, _, t in real), default=0)
-    max_ub = max((d + (bl - 1) * t.extent + t.ub for d, bl, t in real),
-                 default=0)
+    min_lb = min((d + t.lb + min(0, (bl - 1) * t.extent)
+                  for d, bl, t in real), default=0)
+    max_ub = max((d + t.ub + max(0, (bl - 1) * t.extent)
+                  for d, bl, t in real), default=0)
     # alignment epsilon (MPI-3.1 §4.1.6 advice / the MPICH rule): the
     # extent is padded to the strictest member alignment, so an array
     # of the type strides like the corresponding C struct

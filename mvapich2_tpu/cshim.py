@@ -181,29 +181,48 @@ def _bottom_spans(count: int, dtcode: int):
     return d, d.flatten(count)
 
 
-def _bottom_gather(count: int, dtcode: int) -> np.ndarray:
+def _bottom_gather(count: int, dtcode: int, base: int = 0) -> np.ndarray:
     import ctypes
     d, spans = _bottom_spans(count, dtcode)
     out = np.empty(d.size * count if d else 0, np.uint8)
     pos = 0
     for off, ln in spans:
         # spans are an (N,2) int64 ndarray; ctypes needs exact ints
-        off, ln = int(off), int(ln)
+        off, ln = int(off) + base, int(ln)
         src = (ctypes.c_ubyte * ln).from_address(off)
         out[pos:pos + ln] = np.frombuffer(src, np.uint8)
         pos += ln
     return out
 
 
-def _bottom_scatter(tmp: np.ndarray, count: int, dtcode: int) -> None:
+def _bottom_scatter(tmp: np.ndarray, count: int, dtcode: int,
+                    base: int = 0) -> None:
     import ctypes
     _, spans = _bottom_spans(count, dtcode)
     pos = 0
     for off, ln in spans:
-        off, ln = int(off), int(ln)
+        off, ln = int(off) + base, int(ln)
         dst = (ctypes.c_ubyte * ln).from_address(off)
         np.frombuffer(dst, np.uint8)[:] = tmp[pos:pos + ln]
         pos += ln
+
+
+def _needs_abs(view, count: int, dtcode: int) -> bool:
+    """True when a non-NULL buffer must go through the absolute-address
+    (ctypes) path: the datatype reaches bytes BEFORE the buffer pointer
+    (negative typemap displacements / negative extent tiling —
+    datatype/unusual-noncontigs.c sends from sendbuf+2 with such
+    types). The pointer-view pack/unpack cannot express those."""
+    return (bool(view) and count > 0 and dtcode >= _DERIVED_BASE
+            and _derived[dtcode].needs_abs(count))
+
+
+def _view_addr(view) -> int:
+    """The raw address a C-boundary memoryview starts at (the user's
+    buffer pointer; type_span keeps these views ≥1 byte so the address
+    survives for abs-path types)."""
+    a = np.frombuffer(view, np.uint8)
+    return int(a.ctypes.data)
 
 
 def _bottom_tmp(count: int, dtcode: int) -> np.ndarray:
@@ -218,6 +237,8 @@ def _send_args_b(view, count: int, dtcode: int):
     valid for every send mode, including nonblocking posts)."""
     if not view and dtcode >= _DERIVED_BASE:
         return _bottom_gather(count, dtcode), {}
+    if _needs_abs(view, count, dtcode):
+        return _bottom_gather(count, dtcode, _view_addr(view)), {}
     return _send_args(view, count, dtcode)
 
 
@@ -226,11 +247,12 @@ class _BottomRecvReq:
     a temp packed buffer, scattered to the absolute addresses when the
     request completes (wait/test both funnel through wait)."""
 
-    def __init__(self, inner, tmp, count, dtcode):
+    def __init__(self, inner, tmp, count, dtcode, base=0):
         self._inner = inner
         self._tmp = tmp
         self._count = count
         self._dtcode = dtcode
+        self._base = base
         self._scattered = False
 
     def wait(self):
@@ -238,7 +260,8 @@ class _BottomRecvReq:
         if not self._scattered:
             self._scattered = True
             if not getattr(self._inner, "cancelled", False):
-                _bottom_scatter(self._tmp, self._count, self._dtcode)
+                _bottom_scatter(self._tmp, self._count, self._dtcode,
+                                self._base)
         return st
 
     def test(self):
@@ -452,6 +475,10 @@ def type_spans(dtcode: int):
     arr = _np.asarray(d.spans, dtype=_np.int64).reshape(-1, 2)
     if d.size <= 0 or len(arr) == 0 or len(arr) > 1024:
         return None
+    if d.min_off < 0 or d.extent < 0:
+        # negative displacements: the C engine's span walk is unsigned
+        # from the buffer pointer — leave these to the shim's abs path
+        return None
     return (int(d.size), int(d.extent),
             [int(x) for x in arr.reshape(-1)])
 
@@ -507,6 +534,11 @@ def recv(view, count: int, dtcode: int, source: int, tag: int,
         st = _comm(ch).recv(tmp, source, tag)
         _bottom_scatter(tmp, count, dtcode)
         return (st.source, st.tag, st.count)
+    if _needs_abs(view, count, dtcode):
+        tmp = _bottom_tmp(count, dtcode)
+        st = _comm(ch).recv(tmp, source, tag)
+        _bottom_scatter(tmp, count, dtcode, _view_addr(view))
+        return (st.source, st.tag, st.count)
     buf, kw = _send_args(view, count, dtcode)
     st = _comm(ch).recv(buf, source, tag, **kw)
     return (st.source, st.tag, st.count)
@@ -531,6 +563,10 @@ def irecv(view, count: int, dtcode: int, source: int, tag: int,
         tmp = _bottom_tmp(count, dtcode)
         r = _BottomRecvReq(_comm(ch).irecv(tmp, source, tag), tmp,
                            count, dtcode)
+    elif _needs_abs(view, count, dtcode):
+        tmp = _bottom_tmp(count, dtcode)
+        r = _BottomRecvReq(_comm(ch).irecv(tmp, source, tag), tmp,
+                           count, dtcode, _view_addr(view))
     else:
         buf, kw = _send_args(view, count, dtcode)
         r = _comm(ch).irecv(buf, source, tag, **kw)
@@ -910,6 +946,8 @@ def _rma_args(oview, count: int, dtcode: int):
             # MPI_BOTTOM origin: gather the packed bytes from absolute
             # addresses; the op then runs on contiguous BYTE data
             return _bottom_gather(count, dtcode), {}
+        if _needs_abs(oview, count, dtcode):
+            return _bottom_gather(count, dtcode, _view_addr(oview)), {}
         return (np.frombuffer(oview, np.uint8),
                 {"count": count, "origin_dt": _derived[dtcode]})
     return _arr(oview, count, dtcode), {}
@@ -932,8 +970,11 @@ def get(wh: int, oview, count: int, dtcode: int, target: int,
         kw["target_dt"] = _dt_obj(tdtcode)
         kw["target_count"] = tcount if tcount >= 0 else count
     _wins[wh].get(buf, target, tdisp, **kw)
-    if not oview and dtcode >= _DERIVED_BASE and count:
-        _bottom_scatter(buf, count, dtcode)   # MPI_BOTTOM destination
+    if dtcode >= _DERIVED_BASE and count:
+        if not oview:
+            _bottom_scatter(buf, count, dtcode)  # MPI_BOTTOM destination
+        elif _needs_abs(oview, count, dtcode):
+            _bottom_scatter(buf, count, dtcode, _view_addr(oview))
     return 0
 
 
@@ -1006,11 +1047,13 @@ def iprobe(source: int, tag: int, ch: int):
 # ---------------------------------------------------------------------------
 
 def _reject_bottom_persistent(view, count, dtcode):
-    if not view and dtcode >= _DERIVED_BASE and count:
+    if ((not view or _needs_abs(view, count, dtcode))
+            and dtcode >= _DERIVED_BASE and count):
         from .core.errors import MPI_ERR_BUFFER
         raise MPIException(MPI_ERR_BUFFER,
-                           "MPI_BOTTOM with persistent requests is not "
-                           "supported (pack at Start would be needed)")
+                           "MPI_BOTTOM/absolute-typemap buffers with "
+                           "persistent requests are not supported "
+                           "(pack at Start would be needed)")
 
 
 def send_init(view, count: int, dtcode: int, dest: int, tag: int,
@@ -1514,6 +1557,11 @@ def type_span(code: int, count: int) -> int:
     if code < _DERIVED_BASE:
         return count * _DTYPES[code].itemsize
     d = _derived[code]
+    if d.needs_abs(count):
+        # abs-path type: data reaches before the buffer pointer; the
+        # C-boundary view is only consulted for its base address
+        # (_view_addr), so keep it non-empty and cheap
+        return 1
     tlb, text = type_true_extent(code)
     return (count - 1) * d.extent + max(tlb + text, d.extent, 0)
 
@@ -1555,22 +1603,20 @@ def accumulate(wh: int, oview, count: int, dtcode: int, target: int,
     return 0
 
 
-def get_accumulate(wh: int, oview, rview, count: int, dtcode: int,
-                   target: int, tdisp: int, opcode: int) -> int:
-    if dtcode >= _DERIVED_BASE:
-        d = _derived[dtcode]
-        obuf = (np.frombuffer(oview, np.uint8) if oview else
-                np.zeros(count * d.size, np.uint8))
-        rbuf = np.empty(count * d.size, np.uint8)
-        _wins[wh].get_accumulate(obuf, rbuf, target, tdisp,
-                                 op=_OPS[opcode], count=count,
-                                 origin_dt=d, target_dt=d)
-        _scatter_out(rview, 0, count, dtcode, rbuf)
-        return 0
-    obuf = _arr(oview, count, dtcode) if oview else \
-        np.zeros(count, _DTYPES[dtcode])
-    rbuf = _arr(rview, count, dtcode)
-    _wins[wh].get_accumulate(obuf, rbuf, target, tdisp, op=_OPS[opcode])
+def get_accumulate(wh: int, oview, rview, ocount: int, odtcode: int,
+                   rcount: int, rdtcode: int, target: int, tdisp: int,
+                   tcount: int, tdtcode: int, opcode: int) -> int:
+    """Full three-geometry MPI_Get_accumulate: origin packs with
+    (ocount, odt), the fetch scatters into (rcount, rdt), the target
+    applies with (tcount, tdt)."""
+    rd = _dt_obj(rdtcode)
+    od = _dt_obj(odtcode)
+    td = _dt_obj(tdtcode)
+    rbuf = np.frombuffer(rview, np.uint8)
+    obuf = np.frombuffer(oview, np.uint8) if oview else None
+    _wins[wh].get_accumulate(obuf, rbuf, target, tdisp, op=_OPS[opcode],
+                             count=rcount, origin_dt=rd, target_dt=td,
+                             odt=od, ocount=ocount, tcount=tcount)
     return 0
 
 
@@ -1914,11 +1960,17 @@ def type_true_extent(code: int):
 def pack(inview, incount: int, dtcode: int, outview, position: int) -> int:
     """Returns the new position (bytes)."""
     d = _dt(dtcode)
-    raw_in = np.frombuffer(inview, np.uint8)
     raw_out = np.frombuffer(outview, np.uint8)
-    data = np.asarray(d.pack(raw_in, incount)).view(np.uint8).reshape(-1) \
-        if dtcode >= _DERIVED_BASE else \
-        raw_in[:incount * _DTYPES[dtcode].itemsize]
+    if _needs_abs(inview, incount, dtcode):
+        data = _bottom_gather(incount, dtcode, _view_addr(inview))
+    elif not inview and dtcode >= _DERIVED_BASE:
+        data = _bottom_gather(incount, dtcode)      # MPI_BOTTOM input
+    else:
+        raw_in = np.frombuffer(inview, np.uint8)
+        data = (np.asarray(d.pack(raw_in, incount)).view(np.uint8)
+                .reshape(-1)
+                if dtcode >= _DERIVED_BASE else
+                raw_in[:incount * _DTYPES[dtcode].itemsize])
     raw_out[position:position + data.size] = data
     return position + data.size
 
@@ -1927,12 +1979,22 @@ def unpack(inview, position: int, outview, outcount: int,
            dtcode: int) -> int:
     d = _dt(dtcode)
     raw_in = np.frombuffer(inview, np.uint8)
-    raw_out = np.frombuffer(outview, np.uint8)
     nbytes = _esz(dtcode) * outcount
-    if dtcode >= _DERIVED_BASE:
-        d.unpack(raw_in[position:position + nbytes], raw_out, outcount)
+    if _needs_abs(outview, outcount, dtcode):
+        _bottom_scatter(
+            np.ascontiguousarray(raw_in[position:position + nbytes]),
+            outcount, dtcode, _view_addr(outview))
+    elif not outview and dtcode >= _DERIVED_BASE:
+        _bottom_scatter(
+            np.ascontiguousarray(raw_in[position:position + nbytes]),
+            outcount, dtcode)                       # MPI_BOTTOM output
     else:
-        raw_out[:nbytes] = raw_in[position:position + nbytes]
+        raw_out = np.frombuffer(outview, np.uint8)
+        if dtcode >= _DERIVED_BASE:
+            d.unpack(raw_in[position:position + nbytes], raw_out,
+                     outcount)
+        else:
+            raw_out[:nbytes] = raw_in[position:position + nbytes]
     return position + nbytes
 
 

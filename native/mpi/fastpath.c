@@ -387,6 +387,9 @@ static int fp_recv_status(cph p, long long cpid, MPI_Status *stout) {
     int src = 0, tag = 0, tr = 0, ec = 0;
     long long nb = 0;
     F.req_status(p, cpid, &src, &tag, &nb, &tr, &ec);
+    if (tr && getenv("MV2T_DEBUG_ERRORS"))
+        fprintf(stderr, "FPTRUNC pid=%d src=%d tag=%d nb=%lld\n",
+                getpid(), src, tag, nb);
     if (tr) {
         /* delivered bytes are clamped to the buffer (MPI_Get_count
          * must not over-report on truncation) */

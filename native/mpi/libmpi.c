@@ -220,9 +220,14 @@ int MPI_Init(int *argc, char ***argv) {
 }
 
 int MPI_Init_thread(int *argc, char ***argv, int required, int *provided) {
+    /* MULTIPLE is granted: every shared structure on the C path is
+     * mutex-guarded (the plane's engine mutex, fastpath's fp_mu, the
+     * embedded interpreter's GIL), matching the reference's
+     * global-critical-section thread model (MPIU_THREAD_CS, SURVEY
+     * §5.2) — concurrency is safe, not parallel */
     if (provided)
-        *provided = required < MPI_THREAD_SERIALIZED
-                    ? required : MPI_THREAD_SERIALIZED;
+        *provided = required < MPI_THREAD_MULTIPLE
+                    ? required : MPI_THREAD_MULTIPLE;
     return MPI_Init(argc, argv);
 }
 
@@ -1791,18 +1796,24 @@ int MPI_Get_accumulate(const void *origin, int ocount, MPI_Datatype odt,
                        void *result, int rcount, MPI_Datatype rdt,
                        int target_rank, MPI_Aint target_disp, int tcount,
                        MPI_Datatype tdt, MPI_Op op, MPI_Win win) {
-    (void)tcount; (void)tdt;
-    /* result geometry governs the fetch (origin is ignored for
-     * MPI_NO_OP and may have ocount == 0, MPI-3.1 §11.3.4) */
+    /* all three geometries matter: origin packs with (ocount, odt),
+     * the fetch scatters into (rcount, rdt), the target applies with
+     * (tcount, tdt) — conflating them corrupts signature-equal but
+     * layout-different triples (rma/lock_dt's subarray pairs) */
     PyGILState_STATE st = PyGILState_Ensure();
+    /* views must cover the EXTENT footprint (pack walks the strided
+     * layout), not just the data bytes; origin may be absent for
+     * MPI_NO_OP (MPI-3.1 §11.3.4) */
     PyObject *ov = ocount > 0
-        ? mv_view(origin, (long)ocount * dt_size(odt))
+        ? mv_view(origin, dt_span_b(odt, ocount))
         : mv_view(NULL, 0);
     PyObject *rv = mv_view(result, dt_span_b(rdt, rcount));
     PyObject *res = PyObject_CallMethod(g_shim, "get_accumulate",
-                                        "(iOOiiiLi)", win, ov, rv, rcount,
-                                        rdt, target_rank,
-                                        (long long)target_disp, op);
+                                        "(iOOiiiiiLiii)", win, ov, rv,
+                                        ocount, odt, rcount, rdt,
+                                        target_rank,
+                                        (long long)target_disp,
+                                        tcount, tdt, op);
     int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
     if (!res) PyErr_Print();
     Py_XDECREF(res); Py_XDECREF(ov); Py_XDECREF(rv);

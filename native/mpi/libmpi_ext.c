@@ -32,6 +32,19 @@ int mv2t_errcode_from_pyerr(void) {
     /* caller holds the GIL and PyErr_Occurred() is true */
     PyObject *type, *val, *tb;
     PyErr_Fetch(&type, &val, &tb);
+    if (getenv("MV2T_DEBUG_ERRORS") && val != NULL) {
+        /* print the python traceback without consuming the error */
+        PyErr_NormalizeException(&type, &val, &tb);
+        PyObject *m = PyImport_ImportModule("traceback");
+        if (m != NULL) {
+            PyObject *r = PyObject_CallMethod(
+                m, "print_exception", "OOO", type, val,
+                tb ? tb : Py_None);
+            Py_XDECREF(r);
+            Py_DECREF(m);
+        }
+        PyErr_Clear();
+    }
     int cls = MPI_ERR_OTHER;
     if (val != NULL && g_shim != NULL) {
         PyObject *fn = PyObject_GetAttrString(g_shim, "c_error_class");
@@ -707,6 +720,7 @@ void mv2t_win_record(int win, void *base, MPI_Aint size, int disp_unit) {
 
 void mv2t_win_forget(int win) {
     mv2t_wininfo_forget(win);
+    mv2t_win_eh_forget(win);
     win_info **p = &g_wininfo;
     while (*p != NULL) {
         if ((*p)->win == win) {
@@ -1495,7 +1509,12 @@ int MPI_Errhandler_set(MPI_Comm comm, MPI_Errhandler errhandler) {
 }
 
 int MPI_Win_set_errhandler(MPI_Win win, MPI_Errhandler errhandler) {
-    (void)win; (void)errhandler;   /* this ABI always returns codes */
+    mv2t_set_win_errhandler(win, errhandler);
+    return MPI_SUCCESS;
+}
+
+int MPI_Win_get_errhandler(MPI_Win win, MPI_Errhandler *errhandler) {
+    *errhandler = mv2t_get_win_errhandler(win);
     return MPI_SUCCESS;
 }
 
@@ -1610,6 +1629,51 @@ MPI_Errhandler mv2t_get_comm_errhandler(int comm) {
     return eh_of(comm);
 }
 
+/* per-window errhandler attachments (MPI_Win_set/call_errhandler);
+ * same keyval-style lifetime discipline as the comm list —
+ * src/mpi/rma/win_call_errhandler.c:60-80 resolves win->errhandler
+ * exactly this way in the reference */
+static eh_node *g_win_eh;
+
+static MPI_Errhandler win_eh_of(int win) {
+    for (eh_node *n = g_win_eh; n != NULL; n = n->next)
+        if (n->comm == win)
+            return n->eh;
+    return MPI_ERRORS_ARE_FATAL;   /* the MPI default for windows */
+}
+
+void mv2t_set_win_errhandler(int win, MPI_Errhandler eh) {
+    for (eh_node *n = g_win_eh; n != NULL; n = n->next)
+        if (n->comm == win) {
+            n->eh = eh;
+            return;
+        }
+    eh_node *n = malloc(sizeof *n);
+    if (n == NULL)
+        return;
+    n->comm = win;
+    n->eh = eh;
+    n->next = g_win_eh;
+    g_win_eh = n;
+}
+
+MPI_Errhandler mv2t_get_win_errhandler(int win) {
+    return win_eh_of(win);
+}
+
+void mv2t_win_eh_forget(int win) {
+    eh_node **p = &g_win_eh;
+    while (*p != NULL) {
+        if ((*p)->comm == win) {
+            eh_node *d = *p;
+            *p = d->next;
+            free(d);
+            return;
+        }
+        p = &(*p)->next;
+    }
+}
+
 /* invoke a user errhandler on any int-handle object (comm/file: the
  * handler ABIs are identical) — used by libmpi_io.c's per-file table */
 void mv2t_eh_invoke(MPI_Errhandler eh, int *handle, int *rc) {
@@ -1631,6 +1695,19 @@ void mv2t_comm_eh_forget(int comm) {
     }
 }
 
+/* MPI_ERRORS_ARE_FATAL: report and abort the job (the launcher reaps
+ * a nonzero rank exit and tears the others down) */
+static void eh_fatal(const char *kind, int handle, int rc) {
+    char msg[MPI_MAX_ERROR_STRING];
+    int len = 0;
+    MPI_Error_string(rc, msg, &len);
+    fprintf(stderr,
+            "Fatal error in MPI call on %s %d: %s (code %d); "
+            "MPI_ERRORS_ARE_FATAL is set — aborting\n", kind, handle,
+            msg, rc);
+    exit(rc > 255 || rc <= 0 ? 1 : rc);
+}
+
 /* funnel: applies the comm's errhandler to a nonzero rc */
 int mv2t_errcheck(MPI_Comm comm, int rc) {
     if (rc == MPI_SUCCESS)
@@ -1643,18 +1720,15 @@ int mv2t_errcheck(MPI_Comm comm, int rc) {
         g_eh[eh - EH_BASE].fn(&comm, &rc);
         return rc;
     }
-    /* MPI_ERRORS_ARE_FATAL */
-    char msg[MPI_MAX_ERROR_STRING];
-    int len = 0;
-    MPI_Error_string(rc, msg, &len);
-    fprintf(stderr,
-            "Fatal error in MPI call on comm %d: %s (code %d); "
-            "MPI_ERRORS_ARE_FATAL is set — aborting\n", comm, msg, rc);
-    exit(rc > 255 || rc <= 0 ? 1 : rc);
+    eh_fatal("comm", comm, rc);
+    return rc;                  /* unreachable */
 }
 
 static int eh_referenced(int slot) {
     for (eh_node *n = g_comm_eh; n != NULL; n = n->next)
+        if (n->eh == EH_BASE + slot)
+            return 1;
+    for (eh_node *n = g_win_eh; n != NULL; n = n->next)
         if (n->eh == EH_BASE + slot)
             return 1;
     return 0;
@@ -1702,8 +1776,19 @@ int MPI_Win_create_errhandler(MPI_Win_errhandler_function *fn,
 }
 
 int MPI_Win_call_errhandler(MPI_Win win, int errorcode) {
-    (void)win;
-    return errorcode == MPI_SUCCESS ? MPI_SUCCESS : errorcode;
+    if (errorcode == MPI_SUCCESS)
+        return MPI_SUCCESS;
+    MPI_Errhandler eh = win_eh_of(win);
+    if (eh >= EH_BASE && eh < EH_BASE + MAX_EH
+        && g_eh[eh - EH_BASE].used && g_eh[eh - EH_BASE].fn != NULL) {
+        /* MPI_Win_errhandler_function and the comm handler type are
+         * ABI-identical here (both take an int-handle pointer) */
+        g_eh[eh - EH_BASE].fn(&win, &errorcode);
+        return MPI_SUCCESS;
+    }
+    if (eh == MPI_ERRORS_ARE_FATAL)
+        eh_fatal("win", win, errorcode);
+    return MPI_SUCCESS;        /* ERRORS_RETURN: no-op */
 }
 
 int MPI_Comm_call_errhandler(MPI_Comm comm, int errorcode) {

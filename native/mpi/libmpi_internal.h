@@ -32,6 +32,9 @@ void mv2t_win_record(int win, void *base, MPI_Aint size, int disp_unit);
 void mv2t_wininfo_set(int win, MPI_Info info);
 void mv2t_wininfo_forget(int win);
 void mv2t_win_forget(int win);
+void mv2t_set_win_errhandler(int win, MPI_Errhandler eh);
+MPI_Errhandler mv2t_get_win_errhandler(int win);
+void mv2t_win_eh_forget(int win);
 int mv2t_is_userop(MPI_Op op);
 int mv2t_userop_coll(int kind, const void *sendbuf, void *recvbuf,
                      int count, MPI_Datatype dt, MPI_Op op, int root,

@@ -85,8 +85,30 @@ def test_reduce_noncommutative_nonroot_order():
     run_ranks(4, fn)
 
 
-def test_negative_stride_rejected():
+def test_negative_stride_bounds_and_pack_guard():
+    # negative strides/displacements are legal MPI (datatype/lbub.c);
+    # bounds follow the MPI-1 §3.12.3 min/max rule and the pointer-view
+    # pack refuses (abs ctypes path required) instead of wrap-indexing
+    v = dt.create_vector(2, 1, -1, dt.INT)
+    assert v.lb == -4 and v.extent == 8 and v.size == 8
+    h = dt.create_hindexed([1, 1], [0, -8], dt.DOUBLE)
+    assert h.lb == -8 and h.extent == 16 and h.size == 16
+    buf = np.zeros(4, np.int32)
     with pytest.raises(MPIException):
-        dt.create_vector(2, 1, -1, dt.INT)
+        v.pack(buf, 1)
     with pytest.raises(MPIException):
-        dt.create_hindexed([1, 1], [0, -8], dt.DOUBLE)
+        h.unpack(np.zeros(16, np.uint8), buf, 1)
+
+
+def test_sticky_lb_ub_replication():
+    # resized(lb=-3, extent=9) over 4 bytes of data: vector(3,1,1)
+    # must report lb=-3, ub=24, extent=27 (datatype/lbub.c expectations)
+    base = dt.create_resized(dt.create_contiguous(4, dt.BYTE), -3, 9)
+    v = dt.create_vector(3, 1, 1, base)
+    assert (v.lb, v.ub, v.extent, v.size) == (-3, 24, 27, 12)
+    c = dt.create_contiguous(3, base)
+    assert (c.lb, c.ub, c.extent) == (-3, 24, 27)
+    # negative extent tiles backward: contig(3) of resized(lb=6, ext=-9)
+    neg = dt.create_resized(dt.create_contiguous(4, dt.BYTE), 6, -9)
+    cn = dt.create_contiguous(3, neg)
+    assert (cn.lb, cn.ub, cn.extent) == (-12, -3, 9)
