@@ -18,7 +18,7 @@ from . import datatype as dtmod
 from .attr import AttrCache
 from .datatype import Datatype
 from .errors import (ERRORS_ARE_FATAL, Errhandler, MPIException, MPI_ERR_COMM,
-                     MPI_ERR_RANK, MPI_ERR_TAG, mpi_assert)
+                     MPI_ERR_GROUP, MPI_ERR_RANK, MPI_ERR_TAG, mpi_assert)
 from .group import Group
 from .request import CompletedRequest, Request
 from .status import ANY_SOURCE, ANY_TAG, PROC_NULL, Status, UNDEFINED
@@ -99,11 +99,18 @@ class Comm:
     def _plane_bind(self) -> None:
         # ownership is wire-carried (PLANE_CTX_FLAG): nothing to register
         # with the C engine — sender and receiver derive the same answer
-        # from the same membership
+        # from the same membership. But a REUSED context id (mask
+        # allocator, Comm.free -> release_context_id) may still carry
+        # the C matcher's retired mark from its previous life, which
+        # drops unmatched traffic: clear it for both contexts.
         pc = self.u.plane_channel
         self._plane_owned = bool(
             pc is not None and pc.plane
             and all(w in pc.local_index for w in self._plane_members()))
+        if self._plane_owned and self.context_id >= 8:
+            lib = pc._ring.lib
+            lib.cp_ctx_enable(pc.plane, self.context_id)
+            lib.cp_ctx_enable(pc.plane, self.ctx_coll)
 
     def _plane_members(self):
         return self.group.world_ranks
@@ -538,8 +545,24 @@ class Comm:
         """MPI_Comm_create: collective over self; returns None for
         non-members."""
         self._check()
+        # the group must be a subset of this comm's group (MPI-3.1
+        # §6.4.2; errors/comm/ccreate1.c builds a high-ranks group and
+        # hands it to a low-ranks comm). Checked BEFORE the context
+        # collective: every member sees the same group, so the verdict
+        # is symmetric and nobody is left waiting in the allreduce.
+        mine = {self.group.world_of_rank(r)
+                for r in range(self.group.size)}
+        for r in range(group.size):
+            if group.world_of_rank(r) not in mine:
+                raise MPIException(
+                    MPI_ERR_GROUP,
+                    "Comm_create group is not a subset of the "
+                    "communicator's group")
         ctx = self.u.allocate_context_id(self)
         if group.rank_of_world(self.u.world_rank) == UNDEFINED:
+            # a non-member burns no budget: hand the bit straight back
+            # (MPICH likewise frees the id on non-members immediately)
+            self.u.release_context_id(ctx)
             return None
         return Comm(self.u, group, ctx, self.name + "_create", self)
 
@@ -559,9 +582,12 @@ class Comm:
         m = group.size
         parent_of = {g: self.group.rank_of_world(group.world_of_rank(g))
                      for g in range(m)}
-        val = np.array([self.u._next_ctx], dtype=np.int64)
-        other = np.empty(1, dtype=np.int64)
-        # binomial reduce (max) to group rank 0
+        # AND-combine the members' availability masks (the same
+        # MPIR_Get_contextid discipline allocate_context_id runs over a
+        # full comm, here as binomial reduce+bcast over group members)
+        val = self.u.ctx_mask().copy()
+        other = np.empty_like(val)
+        # binomial reduce (bitwise AND) to group rank 0
         mask = 1
         while mask < m:
             if me & mask:
@@ -570,7 +596,7 @@ class Comm:
             partner = me | mask
             if partner < m:
                 self.recv(other, parent_of[partner], tag)
-                val[0] = max(val[0], other[0])
+                val &= other
             mask <<= 1
         # binomial bcast of the agreed ctx from group rank 0
         mask = 1
@@ -584,8 +610,13 @@ class Comm:
             if me + mask < m:
                 self.send(val, parent_of[me + mask], tag)
             mask >>= 1
-        ctx = int(val[0])
-        self.u._next_ctx = max(self.u._next_ctx, ctx + 2)
+        from ..runtime.universe import CTX_MASK_BASE, _lowest_bit
+        bit = _lowest_bit(val)
+        if bit < 0:
+            from .errors import MPI_ERR_OTHER
+            raise MPIException(MPI_ERR_OTHER, "out of context ids")
+        self.u.ctx_mask()[bit // 64] &= np.uint64(~np.uint64(1 << (bit % 64)))
+        ctx = CTX_MASK_BASE + 2 * bit
         return Comm(self.u, group, ctx, self.name + "_create_group", self)
 
     def split(self, color: int, key: int = 0) -> Optional["Comm"]:
@@ -598,6 +629,8 @@ class Comm:
         ctx = self.u.allocate_context_id(self)
         my_color = int(mine[0])
         if my_color == UNDEFINED:
+            # UNDEFINED color burns no budget (see create())
+            self.u.release_context_id(ctx)
             return None
         members = []
         for r in range(self.size):
@@ -626,6 +659,10 @@ class Comm:
             return
         self.attrs.delete_all(self)
         self.u.comms_by_ctx.pop(self.context_id, None)
+        # return a mask-allocated context id to the availability pool
+        # (MPIR-style reuse: dup/free loops must never exhaust the
+        # 2048-comm budget — comm/ctxalloc.c, comm/ctxsplit.c)
+        self.u.release_context_id(self.context_id)
         if self._plane_owned:
             pch = getattr(self.u, "plane_channel", None)
             if pch is not None and getattr(pch, "plane", None):

@@ -455,14 +455,18 @@ def create_hvector(count: int, blocklength: int, stride_bytes: int,
                    oldtype: Datatype) -> Datatype:
     if oldtype.is_contiguous and count > 16 and stride_bytes >= 0:
         # vectorized fast path: one span per block (the MTest vector
-        # generators build 64k-block vectors)
+        # generators build 64k-block vectors); bounds use the SAME
+        # §3.12.3 min/max rule as the generic path below — a
+        # contiguous oldtype can still carry a resized (sticky) lb
         starts = (np.arange(count, dtype=np.int64)
                   * stride_bytes).tolist()
         ln = blocklength * oldtype.size
         spans = [(s, ln) for s in starts]
-        extent = _extent_of(spans, oldtype)
+        lb = oldtype.lb
+        extent = (oldtype.ub + (blocklength - 1) * oldtype.extent
+                  + (count - 1) * stride_bytes) - lb
         return _env(
-            Datatype(spans, extent, 0, oldtype.basic,
+            Datatype(spans, extent, lb, oldtype.basic,
                      f"hvector({count},{blocklength},{stride_bytes})"),
             "hvector", [count, blocklength], [stride_bytes], [oldtype])
     # a block of a contiguous oldtype is ONE span — never materialize
@@ -516,9 +520,19 @@ def create_hindexed(blocklengths: Sequence[int], disp_bytes: Sequence[int],
         # typemap (declaration) order — MPI_Pack serializes blocks in
         # the order they were declared, not by address
         spans = list(zip(dps.tolist(), (bls * oldtype.size).tolist()))
-        lb = _lb_of(spans)
+        # §3.12.3 min/max bounds, vectorized (same rule as the generic
+        # path — contiguous oldtypes can carry sticky resized lb; a
+        # contiguous oldtype's extent is its size, so block tails are
+        # non-negative and the per-block min(0, tail) term vanishes)
+        real = bls > 0
+        if bool(real.any()):
+            lb = int(dps[real].min()) + oldtype.lb
+            extent = int((dps[real] + (bls[real] - 1) * oldtype.extent)
+                         .max()) + oldtype.ub - lb
+        else:
+            lb, extent = 0, 0
         return _env(
-            Datatype(spans, _extent_of(spans, oldtype) - lb, lb,
+            Datatype(spans, extent, lb,
                      oldtype.basic, f"hindexed({len(blocklengths)})"),
             "hindexed", [len(blocklengths)] + list(blocklengths),
             list(disp_bytes), [oldtype])

@@ -39,6 +39,8 @@ MPI_ERR_AMODE = 38
 MPI_ERR_NO_SUCH_FILE = 37
 MPI_ERR_UNSUPPORTED_DATAREP = 43
 MPI_ERR_UNSUPPORTED_OPERATION = 44
+MPI_ERR_ACCESS = 39
+MPI_ERR_READ_ONLY = 40
 MPI_ERR_NAME = 33
 MPI_ERR_PORT = 27
 MPI_ERR_SERVICE = 41

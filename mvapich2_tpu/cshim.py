@@ -134,7 +134,13 @@ def _comm(h: int):
         return uni.current_universe().comm_world
     if h == 1:
         return uni.current_universe().comm_self
-    return _comms[h]
+    got = _comms.get(h)
+    if got is None:
+        # freed or never-allocated handle: a reportable MPI error, not
+        # a KeyError crash (errors/comm/cfree.c barriers a freed dup)
+        from .core.errors import MPI_ERR_COMM
+        raise MPIException(MPI_ERR_COMM, f"invalid communicator {h}")
+    return got
 
 
 def _arr(view, count: int, dtcode: int) -> np.ndarray:
@@ -463,8 +469,11 @@ def comm_plane_info(ch: int):
 
 def type_spans(dtcode: int):
     """Datatype layout for the C span engine (native/mpi/fastpath.c):
-    (elem_size, extent, [off0, len0, off1, len1, ...]) for ONE element,
-    or None when the type is unsuitable (zero size, span-count blowup).
+    (elem_size, extent, [off0, len0, ...], basic_item_size) for ONE
+    element, or None when the type is unsuitable (zero size, span-count
+    blowup). basic_item_size is the uniform signature granularity (0 if
+    heterogeneous) — the C recv path rejects deliveries that split a
+    basic item (errors/pt2pt/truncmsg2.c signature mismatch).
     Derived handles are never reused (monotonic), so C may cache this
     forever — MPI_Type_free keeps the definition alive by design."""
     import numpy as _np
@@ -479,8 +488,16 @@ def type_spans(dtcode: int):
         # negative displacements: the C engine's span walk is unsigned
         # from the buffer pointer — leave these to the shim's abs path
         return None
+    basic = 0
+    if d.basic is not None and not d.basic.names:
+        basic = int(d.basic.itemsize)
+    else:
+        from .core.datatype import element_size_seq
+        seq = element_size_seq(d)
+        if seq is not None and len(set(seq)) == 1:
+            basic = int(seq[0])
     return (int(d.size), int(d.extent),
-            [int(x) for x in arr.reshape(-1)])
+            [int(x) for x in arr.reshape(-1)], basic)
 
 
 def plane_eager_threshold() -> int:
@@ -901,6 +918,16 @@ def win_wait(wh: int) -> int:
     return 0
 
 
+def win_free_check(wh: int) -> int:
+    """Phase 1 of MPI_Win_free at the C boundary: validate the epoch
+    state WITHOUT destroying anything, so attribute delete callbacks
+    (run C-side between the phases) still see a live window."""
+    w = _wins.get(wh)
+    if w is not None and not w.freed:
+        w.check_free()
+    return 0
+
+
 def win_free(wh: int) -> int:
     with _lock:
         w = _wins.pop(wh, None)
@@ -1028,15 +1055,30 @@ def issend(view, count: int, dtcode: int, dest: int, tag: int,
     return h
 
 
+def _check_probe_rank(c, source: int) -> None:
+    """An out-of-range probe source is MPI_ERR_RANK, reported BEFORE
+    blocking (errors/pt2pt/proberank.c probes rank -80 and expects a
+    code, not a hang)."""
+    if source in (ANY_SOURCE, PROC_NULL):
+        return
+    if not 0 <= source < c.size:
+        from .core.errors import MPI_ERR_RANK
+        raise MPIException(MPI_ERR_RANK, f"bad probe source {source}")
+
+
 def probe(source: int, tag: int, ch: int):
     """Blocking probe; returns (source, tag, count_bytes)."""
-    st = _comm(ch).probe(source, tag)
+    c = _comm(ch)
+    _check_probe_rank(c, source)
+    st = c.probe(source, tag)
     return (st.source, st.tag, st.count)
 
 
 def iprobe(source: int, tag: int, ch: int):
     """Returns (flag, source, tag, count_bytes)."""
-    st = _comm(ch).iprobe(source, tag)
+    c = _comm(ch)
+    _check_probe_rank(c, source)
+    st = c.iprobe(source, tag)
     if st is None:
         return (0, -1, -1, 0)
     return (1, st.source, st.tag, st.count)
@@ -1608,15 +1650,37 @@ def get_accumulate(wh: int, oview, rview, ocount: int, odtcode: int,
                    tcount: int, tdtcode: int, opcode: int) -> int:
     """Full three-geometry MPI_Get_accumulate: origin packs with
     (ocount, odt), the fetch scatters into (rcount, rdt), the target
-    applies with (tcount, tdt)."""
+    applies with (tcount, tdt). Absolute-typemap (negative-lb) and
+    MPI_BOTTOM origin/result buffers route through the ctypes path,
+    same as send/recv/put/get: gather to packed bytes before the call,
+    scatter after it completes (the wrapper is blocking)."""
     rd = _dt_obj(rdtcode)
     od = _dt_obj(odtcode)
     td = _dt_obj(tdtcode)
-    rbuf = np.frombuffer(rview, np.uint8)
-    obuf = np.frombuffer(oview, np.uint8) if oview else None
+    if oview and _needs_abs(oview, ocount, odtcode):
+        obuf = _bottom_gather(ocount, odtcode, _view_addr(oview))
+        od, ocount = dt.create_contiguous(len(obuf), dt.BYTE), 1
+    elif not oview and odtcode >= _DERIVED_BASE and ocount:
+        obuf = _bottom_gather(ocount, odtcode)       # MPI_BOTTOM origin
+        od, ocount = dt.create_contiguous(len(obuf), dt.BYTE), 1
+    else:
+        obuf = np.frombuffer(oview, np.uint8) if oview else None
+    abs_r = (_needs_abs(rview, rcount, rdtcode)
+             or (not rview and rdtcode >= _DERIVED_BASE and rcount))
+    if abs_r:
+        tmp = _bottom_tmp(rcount, rdtcode)
+        rbuf, rd_eff, rcnt_eff = tmp, \
+            dt.create_contiguous(len(tmp), dt.BYTE), 1
+    else:
+        rbuf, rd_eff, rcnt_eff = np.frombuffer(rview, np.uint8), rd, \
+            rcount
     _wins[wh].get_accumulate(obuf, rbuf, target, tdisp, op=_OPS[opcode],
-                             count=rcount, origin_dt=rd, target_dt=td,
-                             odt=od, ocount=ocount, tcount=tcount)
+                             count=rcnt_eff, origin_dt=rd_eff,
+                             target_dt=td, odt=od, ocount=ocount,
+                             tcount=tcount)
+    if abs_r:
+        _bottom_scatter(tmp, rcount, rdtcode,
+                        _view_addr(rview) if rview else 0)
     return 0
 
 
@@ -2139,12 +2203,20 @@ def comm_idup(view, ch: int) -> int:
     def run():
         from .coll import algorithms as alg
         from .core.comm import Comm
-        mine = np.array([base], dtype=np.int64)
+        from .utils.config import get_config
+        # the live-comm count rides the ctx agreement so exhaustion is
+        # a symmetric verdict (errors/comm/too_many_icomms.c expects
+        # idup to fail once the 2048-comm budget is spent)
+        mine = np.array([base, len(u.comms_by_ctx)], dtype=np.int64)
         agreed = alg.allreduce_recursive_doubling(parent, mine,
                                                   opmod.MAX, tag)
         ctx = int(agreed[0])
         with _lock:
             u._next_ctx = max(u._next_ctx, ctx + 2)
+        if int(agreed[1]) >= int(get_config()["MAX_CONTEXTS"]):
+            from .core.errors import MPI_ERR_OTHER
+            raise MPIException(MPI_ERR_OTHER,
+                               "out of context ids (idup)")
         new = Comm(u, parent.group, ctx, parent.name + "_dup", parent)
         parent.attrs.copy_all(parent, new.attrs)
         new.errhandler = parent.errhandler

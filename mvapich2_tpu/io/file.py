@@ -86,7 +86,11 @@ class File:
         if self.closed:
             raise MPIException(MPI_ERR_FILE, "file is closed")
         if writing and (self.amode & MODE_RDONLY):
-            raise MPIException(MPI_ERR_AMODE, "write on MODE_RDONLY file")
+            # ROMIO reports this as the access class, not a bad amode
+            # (errors/io/openerr.c accepts READ_ONLY or ACCESS)
+            from ..core.errors import MPI_ERR_READ_ONLY
+            raise MPIException(MPI_ERR_READ_ONLY,
+                               "write on MODE_RDONLY file")
         if not writing and (self.amode & MODE_WRONLY):
             raise MPIException(MPI_ERR_AMODE, "read on MODE_WRONLY file")
 

@@ -28,7 +28,7 @@ from typing import Optional
 import numpy as np
 
 from ..core import op as opmod
-from ..core.datatype import Datatype, basic_to_packed, packed_to_basic
+from ..core.datatype import Datatype
 from ..core.errors import MPIException, MPI_ERR_ARG, MPI_ERR_INTERN
 
 # spans-per-op cap: beyond this the packet path is cheaper than
@@ -131,7 +131,13 @@ class CmaDirect:
     def acquire(self):
         f = self._lockfile()
         self._tlock.acquire()
-        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            fcntl.flock(f, fcntl.LOCK_EX)
+        except BaseException:
+            # a reportable flock error must not leave the process-local
+            # mutex held (that would hang the engine thread forever)
+            self._tlock.release()
+            raise
 
     def release(self):
         fcntl.flock(self._lockf, fcntl.LOCK_UN)
@@ -255,14 +261,10 @@ class CmaDirect:
             if iovs:
                 _vm_io(False, self.pids[rank], old, iovs)
             if tcount and op is not opmod.NO_OP and len(data):
-                basic = tdt.basic if tdt.basic is not None \
-                    else np.dtype(np.uint8)
-                cur = packed_to_basic(old, basic).copy()
-                inc = packed_to_basic(data[:len(old)], basic)
-                res = op(inc, cur)
+                from .win import _rmw_packed
                 _vm_io(True, self.pids[rank],
                        np.ascontiguousarray(
-                           basic_to_packed(np.asarray(res))), iovs)
+                           _rmw_packed(old, data, tdt, op)), iovs)
         finally:
             self.release()
         return old if fetch else np.empty(0, np.uint8)

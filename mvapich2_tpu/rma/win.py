@@ -86,6 +86,19 @@ def _dt_span(dt: Datatype, count: int) -> int:
     return (count - 1) * dt.extent + max(hi, dt.extent)
 
 
+def _rmw_packed(old: np.ndarray, inc: np.ndarray, tdt: Datatype,
+                op) -> np.ndarray:
+    """The accumulate read-modify-write core: new packed bytes =
+    op(inc, old) elementwise through tdt's basic dtype. ONE copy shared
+    by the packet handler and the direct CMA path (rma/cma.py) so op
+    application can never diverge between them."""
+    from ..core.datatype import basic_to_packed, packed_to_basic
+    basic = tdt.basic if tdt.basic is not None else np.dtype(np.uint8)
+    cur = packed_to_basic(old, basic).copy()
+    res = op(packed_to_basic(inc[:len(old)], basic), cur)
+    return basic_to_packed(np.asarray(res))
+
+
 def _deser_dt(d: dict) -> Datatype:
     b = d["basic"]
     basic = None if b is None else np.dtype(b)
@@ -449,6 +462,10 @@ class Win:
     def start(self, group) -> None:
         """Begin an access epoch to ``group`` (target ranks). Blocks until
         all targets have posted (the strict interpretation)."""
+        mpi_assert(self.epoch not in ("start", "lock", "lock_all"),
+                   MPI_ERR_RMA_SYNC,
+                   f"start() inside an open {self.epoch} epoch "
+                   "(errors/rma/win_sync_nested.c)")
         self._start_group = group
         worlds = set(group.world_ranks)
         self.u.engine.progress_wait(
@@ -495,6 +512,12 @@ class Win:
     # ------------------------------------------------------------------
     def lock(self, rank: int, lock_type: int = LOCK_SHARED,
              assertion: int = 0) -> None:
+        mpi_assert(self.epoch != "start", MPI_ERR_RMA_SYNC,
+                   "lock() inside an active-target (start) epoch "
+                   "(errors/rma/win_sync_lock_at.c)")
+        mpi_assert(rank not in self._locked_targets, MPI_ERR_RMA_SYNC,
+                   f"target {rank} is already locked "
+                   "(errors/rma/win_sync_lock_pt.c)")
         if not self._check_target(rank):
             # PROC_NULL epoch: legal and empty (rmanull.c) — track it so
             # the matching unlock is accepted
@@ -633,7 +656,23 @@ class Win:
     def get_info(self) -> Dict[str, str]:
         return dict(self.info)
 
+    def check_free(self) -> None:
+        """Free inside an open LOCK or PSCW epoch is an RMA sync error,
+        reported (not fatal) through the window's errhandler — the
+        window must survive (errors/rma/win_sync_free_pt.c frees while
+        locked, then unlocks and frees again). A closed fence sequence
+        leaves epoch == "fence"; that is NOT an open epoch (§11.5.1:
+        fence both closes and opens — free after a final fence is the
+        normal shutdown). Exposed separately so the C boundary can
+        validate BEFORE running attribute delete callbacks (which must
+        see a live window)."""
+        mpi_assert(self.epoch != "start" and not self._locked_targets
+                   and not self.tsync.posts_from, MPI_ERR_RMA_SYNC,
+                   "free of a window with an open epoch")
+
     def free(self) -> None:
+        if not self.freed:
+            self.check_free()
         self.attrs.delete_all(self)
         if self.freed:
             return
@@ -797,13 +836,8 @@ class RmaManager:
             old = np.asarray(tdt.pack(region, cnt)) if cnt else \
                 np.empty(0, np.uint8)
             if cnt and op is not opmod.NO_OP and pkt.nbytes:
-                from ..core.datatype import basic_to_packed, packed_to_basic
-                basic = tdt.basic if tdt.basic is not None \
-                    else np.dtype(np.uint8)
-                cur = packed_to_basic(old, basic).copy()
-                inc = packed_to_basic(pkt.data[:len(old)], basic)
-                res = op(inc, cur)
-                tdt.unpack(basic_to_packed(np.asarray(res)), region, cnt)
+                tdt.unpack(_rmw_packed(old, pkt.data, tdt, op), region,
+                           cnt)
         finally:
             if cma is not None:
                 cma.release()

@@ -64,6 +64,17 @@ def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
     with exit(1), test/mpi/ft/senddead.c:30). Error exits additionally
     surface in the job's exit code (max positive code over all ranks) —
     publication gives ULFM visibility, it does not mask the error."""
+    # MPIEXEC_ALLOW_FAULT (the MPICH faults-suite contract,
+    # errors/faults/testlist.in): simulated rank deaths are EXPECTED —
+    # publish them as failure events (so survivors unwind with
+    # MPIX_ERR_PROC_FAILED instead of hanging) and exclude them from
+    # the job's exit code; success = some rank completed cleanly.
+    allow_fault = str((env_extra or {}).get(
+        "MPIEXEC_ALLOW_FAULT",
+        os.environ.get("MPIEXEC_ALLOW_FAULT", ""))).lower() \
+        in ("1", "yes", "true")
+    if allow_fault:
+        ft = True
     srv = KVSServer(nranks)
     procs: List[subprocess.Popen] = []
     # a soft kill of the launcher must take the rank children with it —
@@ -131,6 +142,11 @@ def launch(nranks: int, argv: List[str], env_extra: Optional[dict] = None,
                         p.kill()
                 raise TimeoutError(f"job exceeded {timeout}s")
             time.sleep(0.01)
+        if allow_fault:
+            # faults are part of the test: the job succeeds when any
+            # rank finished cleanly (errors/faults/pt2ptf1.c survivors
+            # print the verdict)
+            return 0 if any(c == 0 for c in exit_codes) else 1
         if ft:
             # error exits count against the job even when published as
             # failure events; a job in which NO rank completed cleanly

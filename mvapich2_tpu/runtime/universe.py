@@ -26,6 +26,21 @@ from ..transport.progress import ProgressEngine
 from ..utils.config import get_config
 from ..utils.mlog import get_logger
 
+# mask-allocated context ids live HIGH so they can never collide with
+# the monotonic _next_ctx ids the specialized paths (intercomm merge,
+# spawn bootstrap, ULFM shrink, idup reservations) still mint
+CTX_MASK_BASE = 1 << 20
+
+
+def _lowest_bit(mask) -> int:
+    """Index of the lowest set bit across the uint64 word array, -1 if
+    none (the MPIR_Find_local_and_external lowest-free-bit scan)."""
+    for w in range(len(mask)):
+        v = int(mask[w])
+        if v:
+            return w * 64 + (v & -v).bit_length() - 1
+    return -1
+
 log = get_logger("runtime")
 
 
@@ -56,6 +71,7 @@ class Universe:
         self.comm_world = None
         self.comm_self = None
         self._next_ctx = 8  # 0/1: world pt2pt/coll, 2/3: self, 4+: spare
+        self._ctx_mask = None   # lazily sized (ctx_mask())
         self.finalized = False
         self.initialized = False
         self.windows: Dict[int, object] = {}      # win_id -> Win (RMA)
@@ -141,25 +157,58 @@ class Universe:
                                       context_id=2, name="MPI_COMM_SELF")
         self.initialized = True
 
-    def allocate_context_id(self, parent_comm) -> int:
-        """Collective over parent_comm: agree on a fresh context id.
+    def ctx_mask(self):
+        """Per-rank context-id availability bitmask — the reference's
+        MPIR_Get_contextid scheme (mpir_context_id.h: 2048-wide mask,
+        collectively ANDed so the chosen id is free at EVERY member).
+        Freed ids return to the mask (Comm.free), so dup/free loops
+        never exhaust; 2048 SIMULTANEOUS comms is the budget."""
+        if self._ctx_mask is None:
+            import numpy as np
+            from ..utils.config import get_config
+            nbits = max(64, int(get_config()["MAX_CONTEXTS"]))
+            self._ctx_mask = np.full((nbits + 63) // 64,
+                                     np.uint64(0xFFFFFFFFFFFFFFFF),
+                                     dtype=np.uint64)
+        return self._ctx_mask
 
-        The reference allocates from a collectively-ANDed bitmask
-        (MPIR_Get_contextid); agreeing on max(next_free) via allreduce has
-        the same safety property (all members get the same unused id)."""
+    def release_context_id(self, ctx: int) -> None:
+        if ctx < CTX_MASK_BASE or self._ctx_mask is None:
+            return   # predefined / legacy monotonic id: not pooled
+        import numpy as np
+        bit = (ctx - CTX_MASK_BASE) // 2
+        w, b = divmod(bit, 64)
+        if w < len(self._ctx_mask):
+            self._ctx_mask[w] |= np.uint64(1 << b)
+
+    def allocate_context_id(self, parent_comm) -> int:
+        """Collective over parent_comm: agree on a fresh context id —
+        allreduce-BAND of the members' availability masks, lowest common
+        free bit wins (the reference's MPIR_Get_contextid protocol)."""
         import numpy as np
         from ..coll import algorithms as alg
         from ..core import op as opmod
-        mine = np.array([self._next_ctx], dtype=np.int64)
+        mine = self.ctx_mask().copy()
         # fixed base algorithm, NOT the tunable dispatch: a forced
         # two-level algorithm would re-enter build_2level -> split ->
         # allocate_context_id here (the reference likewise runs the
         # context-id protocol on its own reserved path, MPIR_Get_contextid)
         out = alg.allreduce_recursive_doubling(
-            parent_comm, mine, opmod.MAX, parent_comm.next_coll_tag())
-        ctx = int(out[0])
-        self._next_ctx = ctx + 2
-        return ctx
+            parent_comm, mine, opmod.BAND, parent_comm.next_coll_tag())
+        bit = _lowest_bit(out)
+        if bit < 0:
+            # exhaustion is judged AFTER the agreement collective so
+            # every member reaches the identical verdict (a local
+            # pre-check could diverge and deadlock the allreduce) —
+            # errors/comm/too_many_comms.c expects this error
+            from ..core.errors import MPIException, MPI_ERR_OTHER
+            raise MPIException(
+                MPI_ERR_OTHER,
+                "out of context ids (MV2T_MAX_CONTEXTS="
+                f"{len(mine) * 64})")
+        w, b = divmod(bit, 64)
+        self._ctx_mask[w] &= np.uint64(~np.uint64(1 << b))
+        return CTX_MASK_BASE + 2 * bit
 
     def mark_failed(self, world_rank: int) -> None:
         """Record a process failure (detection sink — SURVEY §5.3)."""

@@ -177,6 +177,10 @@ cvar("RNDV_PROTOCOL", "RGET", str, "pt2pt",
      "Rendezvous protocol: RGET (receiver pulls), RPUT (sender pushes), "
      "R3 (packetized through channel). Default mirrors ibv_param.c:116.",
      choices=("RGET", "RPUT", "R3"))
+cvar("MAX_CONTEXTS", 2048, int, "runtime",
+     "Communicator context-id space (the reference's MPIR context-id "
+     "bitmask is 2048 wide, mpir_context_id.h); exhaustion returns "
+     "MPI_ERR_OTHER from comm creation (errors/comm/too_many_comms.c).")
 cvar("ENABLE_AFFINITY", False, bool, "runtime",
      "Pin rank processes to CPUs (analog of MV2_ENABLE_AFFINITY).")
 cvar("SHOW_ENV_INFO", False, bool, "runtime",

@@ -568,6 +568,12 @@ UnexEntry* unex_add(CPlane* p, const PktHdr* h, const uint8_t* blob,
   return e;
 }
 
+static int cp_dbg(void) {
+  static int v = -1;
+  if (v < 0) v = getenv("MV2T_CPLANE_DEBUG") != NULL;
+  return v;
+}
+
 // process one inbound packet blob (plane mutex held)
 void process_blob(CPlane* p, const uint8_t* blob, long len) {
   if (len < static_cast<long>(sizeof(PktHdr))) {
@@ -590,10 +596,13 @@ void process_blob(CPlane* p, const uint8_t* blob, long len) {
         return;
       }
     }
-    // a pending recv on a freed comm must still complete (MPI-3.1
-    // §6.4.3 deferred free) — only UNMATCHED traffic for a retired
-    // context is dropped instead of queued
-    if (p->retired.has(ctx)) return;
+    // Unmatched traffic is queued EVEN for a locally-retired context:
+    // context ids are REUSED (MPIR-style mask allocator), and the
+    // first collective on a new comm races the slower members'
+    // re-enable — dropping here deadlocked that collective. The
+    // freed-comm leak the retired set existed for is handled by the
+    // purge in cp_ctx_disable; late stragglers queue until the id's
+    // next disable.
     unex_add(p, h, blob, len);
     return;
   }
@@ -605,8 +614,7 @@ void process_blob(CPlane* p, const uint8_t* blob, long len) {
         return;
       }
     }
-    if (p->retired.has(ctx)) return;     // see eager comment above
-    unex_add(p, h, blob, len);
+    unex_add(p, h, blob, len);           // see eager comment above
     return;
   }
   if (h->type == PKT_RNDV_RTS_CMA && owned) {
@@ -617,13 +625,7 @@ void process_blob(CPlane* p, const uint8_t* blob, long len) {
         return;
       }
     }
-    if (p->retired.has(ctx)) {
-      // freed comm: drop the message but release the sender (it holds
-      // its buffer until FIN)
-      int sr = ring_of_world(p, h->src_world);
-      if (sr >= 0) send_fin_cma(p, sr, h->sreq_id, 0, 1);
-      return;
-    }
+
     unex_add(p, h, blob, len);
     return;
   }
@@ -841,13 +843,33 @@ void cp_set_wait_fd(void* cp, int fd) {
 void cp_ctx_enable(void* cp, int ctx) {
   CPlane* p = static_cast<CPlane*>(cp);
   pthread_mutex_lock(&p->mu);
+  if (cp_dbg())
+    fprintf(stderr, "CPDBG me=%d ENABLE ctx=%d\n", p->me, ctx);
   p->ctxs.add(ctx);
+  // a REUSED context id (the MPIR-style mask allocator returns freed
+  // ids to the pool) must shed its previous life's state:
+  //  - the retired mark, or unmatched eager traffic is dropped;
+  //  - the collective tag counter, or members inherit sequence
+  //    positions from the OLD comm's collectives — a comm whose
+  //    membership differs from its id's previous owner would then
+  //    draw mismatched tags across ranks and deadlock its first
+  //    collective (observed: create_group/split reuse + allgather).
+  p->retired.del(ctx);
+  for (int i = 0; i < p->ctags_n; i++)
+    if (p->ctags[2 * i] == ctx) {
+      p->ctags[2 * i] = p->ctags[2 * (p->ctags_n - 1)];
+      p->ctags[2 * i + 1] = p->ctags[2 * (p->ctags_n - 1) + 1];
+      p->ctags_n--;
+      break;
+    }
   pthread_mutex_unlock(&p->mu);
 }
 
 void cp_ctx_disable(void* cp, int ctx) {
   CPlane* p = static_cast<CPlane*>(cp);
   pthread_mutex_lock(&p->mu);
+  if (cp_dbg())
+    fprintf(stderr, "CPDBG me=%d DISABLE ctx=%d\n", p->me, ctx);
   p->ctxs.del(ctx);
   p->retired.add(ctx);
   // purge unexpected messages for the retired context (comm freed); a

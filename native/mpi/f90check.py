@@ -1,0 +1,282 @@
+"""Structural Fortran-90 checker for the generated MPI modules.
+
+The build host has no Fortran compiler, so the generated `use mpi` /
+`use mpi_f08` modules (native/mpi/mpi.f90, mpi_f08.f90 — the analog of
+the reference's src/binding/fortran/use_mpi generated interfaces) would
+otherwise never meet ANY parser.  This is a parser-level gate: it
+tokenizes free-form F90, checks block structure, statement grammar,
+parenthesis/quote balance, and dummy-argument declarations, and fails
+loudly on an injected syntax error (tests/test_f90gate.py proves it).
+
+It is deliberately a CHECKER for the generator's output dialect, not a
+general Fortran front end: any statement form the generator does not
+emit is an error, which is exactly what makes typos detectable.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+_TYPESPEC = re.compile(
+    r"(?:integer|logical|real|double\s+precision"
+    r"|character\s*\(\s*len\s*=\s*[*\w]+\s*\)"
+    r"|type\s*\(\s*[A-Za-z_]\w*\s*\)"
+    r"|type\s*\(\s*\*\s*\))", re.I)
+_ATTR = re.compile(
+    r"(?:parameter|public|optional|intent\s*\(\s*(?:in|out|inout)\s*\)"
+    r"|dimension\s*\(\s*[^)]*\s*\)|bind\s*\(\s*C[^)]*\))", re.I)
+_NAME = r"[A-Za-z_]\w*"
+
+
+class F90Error(Exception):
+    pass
+
+
+def _logical_lines(text: str) -> List[Tuple[int, str]]:
+    """(first_lineno, statement) with comments stripped and `&`
+    continuations joined; quote-aware for the `!` scan."""
+    out: List[Tuple[int, str]] = []
+    pend: Optional[str] = None
+    pend_ln = 0
+    for ln, raw in enumerate(text.splitlines(), 1):
+        # strip comment (respect single/double quotes)
+        buf = []
+        q = None
+        for ch in raw:
+            if q:
+                buf.append(ch)
+                if ch == q:
+                    q = None
+                continue
+            if ch in "'\"":
+                q = ch
+                buf.append(ch)
+                continue
+            if ch == "!":
+                break
+            buf.append(ch)
+        if q:
+            raise F90Error(f"line {ln}: unterminated quote")
+        s = "".join(buf).strip()
+        if not s:
+            if pend is None:
+                continue
+            raise F90Error(f"line {ln}: continuation into blank line")
+        if pend is not None:
+            s = pend + " " + s.lstrip("&").lstrip()
+            start = pend_ln
+        else:
+            start = ln
+        if s.endswith("&"):
+            pend = s[:-1].rstrip()
+            pend_ln = start
+            continue
+        pend = None
+        out.append((start, s))
+    if pend is not None:
+        raise F90Error(f"line {pend_ln}: dangling continuation")
+    return out
+
+
+def _balanced(stmt: str) -> bool:
+    depth = 0
+    q = None
+    for ch in stmt:
+        if q:
+            if ch == q:
+                q = None
+            continue
+        if ch in "'\"":
+            q = ch
+        elif ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth < 0:
+                return False
+    return depth == 0 and q is None
+
+
+def _decl_names(rest: str) -> List[str]:
+    """Entity names from the part after `::` (strip dims and inits)."""
+    names = []
+    depth = 0
+    item = []
+    items = []
+    for ch in rest + ",":
+        if ch == "," and depth == 0:
+            items.append("".join(item).strip())
+            item = []
+            continue
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        item.append(ch)
+    for it in items:
+        if not it:
+            continue
+        m = re.match(rf"({_NAME})", it)
+        if not m:
+            raise F90Error(f"bad declaration entity: {it!r}")
+        names.append(m.group(1).lower())
+    return names
+
+
+class _Sub:
+    def __init__(self, name: str, args: List[str], ln: int):
+        self.name = name
+        self.args = args
+        self.declared: set = set()
+        self.ln = ln
+
+
+def check_f90(text: str, path: str = "<f90>") -> List[str]:
+    """Returns a list of error strings (empty = clean)."""
+    errs: List[str] = []
+    try:
+        stmts = _logical_lines(text)
+    except F90Error as e:
+        return [f"{path}: {e}"]
+
+    stack: List[Tuple[str, str]] = []   # (kind, name)
+    sub: Optional[_Sub] = None
+    modules = 0
+
+    def err(ln, msg):
+        errs.append(f"{path}:{ln}: {msg}")
+
+    for ln, s in stmts:
+        low = s.lower()
+        if not _balanced(s):
+            err(ln, f"unbalanced parentheses/quotes: {s!r}")
+            continue
+
+        m = re.match(rf"module\s+({_NAME})\s*$", low)
+        if m and not low.startswith("module procedure"):
+            stack.append(("module", m.group(1)))
+            modules += 1
+            continue
+        m = re.match(rf"end\s+module\s+({_NAME})\s*$", low)
+        if m:
+            if not stack or stack[-1] != ("module", m.group(1)):
+                err(ln, f"mismatched 'end module {m.group(1)}'")
+            else:
+                stack.pop()
+            continue
+        if re.match(r"(implicit\s+none|public|private|contains"
+                    r"|return)\s*$", low):
+            continue
+        if re.match(rf"import\s*::\s*{_NAME}(\s*,\s*{_NAME})*\s*$", low):
+            continue
+        if re.match(r"include\s+'[^']+'\s*$", low):
+            continue
+        m = re.match(rf"interface(\s+{_NAME})?\s*$", low)
+        if m:
+            stack.append(("interface", (m.group(1) or "").strip()))
+            continue
+        m = re.match(rf"end\s+interface(\s+{_NAME})?\s*$", low)
+        if m:
+            if not stack or stack[-1][0] != "interface":
+                err(ln, "'end interface' without interface")
+            else:
+                want = stack.pop()[1]
+                got = (m.group(1) or "").strip()
+                if got and want and got != want:
+                    err(ln, f"interface name mismatch: {got} != {want}")
+            continue
+        m = re.match(rf"module\s+procedure\s+({_NAME})\s*$", low)
+        if m:
+            if not stack or stack[-1][0] != "interface":
+                err(ln, "'module procedure' outside interface")
+            continue
+        m = re.match(rf"type\s*(?:,\s*bind\s*\(\s*c\s*\))?\s*::\s*"
+                     rf"({_NAME})\s*$", low)
+        if m:
+            stack.append(("type", m.group(1)))
+            continue
+        m = re.match(rf"end\s+type\s+({_NAME})\s*$", low)
+        if m:
+            if not stack or stack[-1] != ("type", m.group(1)):
+                err(ln, f"mismatched 'end type {m.group(1)}'")
+            else:
+                stack.pop()
+            continue
+        m = re.match(rf"subroutine\s+({_NAME})\s*\(([^)]*)\)\s*"
+                     rf"(?:bind\s*\(\s*c\s*,\s*name\s*=\s*\"[^\"]+\"\s*\))?"
+                     rf"\s*$", low)
+        if m:
+            if sub is not None:
+                err(ln, f"nested subroutine {m.group(1)}")
+            args = [a.strip() for a in m.group(2).split(",") if a.strip()]
+            for a in args:
+                if not re.fullmatch(_NAME, a):
+                    err(ln, f"bad dummy argument {a!r}")
+            sub = _Sub(m.group(1), args, ln)
+            stack.append(("subroutine", m.group(1)))
+            continue
+        m = re.match(rf"end\s+subroutine\s+({_NAME})\s*$", low)
+        if m:
+            if not stack or stack[-1] != ("subroutine", m.group(1)):
+                err(ln, f"mismatched 'end subroutine {m.group(1)}'")
+            else:
+                stack.pop()
+            if sub is not None:
+                missing = [a for a in sub.args if a not in sub.declared]
+                if missing:
+                    err(sub.ln, f"subroutine {sub.name}: dummy args "
+                        f"never declared: {missing}")
+                sub = None
+            continue
+        m = re.match(rf"external\s*::\s*({_NAME})\s*$", low)
+        if m:
+            continue
+        # declarations: typespec[, attr]* :: entity-list
+        m = re.match(rf"({_TYPESPEC.pattern})((?:\s*,\s*{_ATTR.pattern})*)"
+                     rf"\s*::\s*(.+)$", low, re.I | re.X)
+        if m:
+            try:
+                names = _decl_names(m.group(3))
+            except F90Error as e:
+                err(ln, str(e))
+                continue
+            has_intent = "intent" in (m.group(2) or "")
+            if sub is not None:
+                for n in names:
+                    if n in sub.declared:
+                        err(ln, f"duplicate declaration of {n}")
+                    sub.declared.add(n)
+                    if has_intent and n not in sub.args:
+                        err(ln, f"intent on non-dummy {n}")
+            continue
+        # executable forms the generator emits (only inside a body)
+        if sub is not None or (stack and stack[-1][0] == "module"):
+            if re.match(rf"(?:if\s*\(.+\)\s*)?call\s+{_NAME}\s*\(.*\)\s*$",
+                        low):
+                continue
+            if re.match(rf"(?:if\s*\(.+\)\s*)?{_NAME}(?:%{_NAME})?"
+                        rf"(?:\s*\(\s*\d+\s*\))?\s*=\s*.+$", low):
+                continue
+        err(ln, f"unrecognized statement: {s!r}")
+
+    for kind, name in stack:
+        errs.append(f"{path}: unclosed {kind} {name!r}")
+    if modules != 1:
+        errs.append(f"{path}: expected exactly one module, saw {modules}")
+    return errs
+
+
+def main(argv: List[str]) -> int:
+    rc = 0
+    for p in argv:
+        es = check_f90(open(p).read(), p)
+        for e in es:
+            print(e)
+        rc |= bool(es)
+    return rc
+
+
+if __name__ == "__main__":
+    import sys
+    raise SystemExit(main(sys.argv[1:]))

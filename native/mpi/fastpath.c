@@ -169,6 +169,7 @@ enum { FPD_UNKNOWN = 0, FPD_CONTIG, FPD_SPANS, FPD_NO };
 typedef struct {
     int state;
     long long size, extent;     /* per element */
+    long long basic;            /* uniform signature item size (0 = n/a) */
     int nspans;
     long long *spans;           /* (off, len) pairs */
 } FpDt;
@@ -192,6 +193,7 @@ static FpDt *fp_dt(MPI_Datatype dt) {
         if (sz > 0 && (long)sz == ext) {
             d->size = sz;
             d->extent = ext;
+            d->basic = sz;
             d->state = FPD_CONTIG;
             return d;
         }
@@ -202,8 +204,8 @@ static FpDt *fp_dt(MPI_Datatype dt) {
     int ok = 0;
     if (res != NULL && res != Py_None) {
         PyObject *lst = NULL;
-        long long size = 0, extent = 0;
-        if (PyArg_ParseTuple(res, "LLO", &size, &extent, &lst)
+        long long size = 0, extent = 0, basic = 0;
+        if (PyArg_ParseTuple(res, "LLOL", &size, &extent, &lst, &basic)
                 && PyList_Check(lst) && PyList_Size(lst) % 2 == 0) {
             int n = (int)(PyList_Size(lst) / 2);
             long long *sp = malloc(2 * (size_t)n * sizeof(long long));
@@ -214,6 +216,7 @@ static FpDt *fp_dt(MPI_Datatype dt) {
                 if (d->state == FPD_UNKNOWN || d->state == FPD_NO) {
                     d->size = size;
                     d->extent = extent;
+                    d->basic = basic;
                     d->nspans = n;
                     d->spans = sp;
                     d->state = (n == 1 && sp[0] == 0 && sp[1] == size
@@ -339,6 +342,7 @@ typedef struct {
     long long sreq;             /* send: wire sreq id (cancel) */
     int dst;                    /* send: ring index */
     int comm;                   /* errhandler target */
+    long long basic;            /* recv: signature granularity check */
     int cancel_pending;
     void *tmp;                  /* rndv-send: packed noncontig payload,
                                  * freed at completion */
@@ -382,11 +386,17 @@ static void fp_status_empty(MPI_Status *st) {
     st->_cancelled = 0;
 }
 
-/* fill status from a DONE plane recv; returns the MPI error code */
-static int fp_recv_status(cph p, long long cpid, MPI_Status *stout) {
+/* fill status from a DONE plane recv; returns the MPI error code.
+ * basic > 0 = the receive type's uniform signature item size: a
+ * delivery that splits a basic item is a sender/receiver type-
+ * signature mismatch (errors/pt2pt/truncmsg2.c) */
+static int fp_recv_status(cph p, long long cpid, MPI_Status *stout,
+                          long long basic) {
     int src = 0, tag = 0, tr = 0, ec = 0;
     long long nb = 0;
     F.req_status(p, cpid, &src, &tag, &nb, &tr, &ec);
+    if (!tr && !ec && basic > 1 && nb % basic)
+        ec = MPI_ERR_TRUNCATE;
     if (tr && getenv("MV2T_DEBUG_ERRORS"))
         fprintf(stderr, "FPTRUNC pid=%d src=%d tag=%d nb=%lld\n",
                 getpid(), src, tag, nb);
@@ -420,7 +430,8 @@ static int fp_recv_status(cph p, long long cpid, MPI_Status *stout) {
  * (MV2_SPIN_COUNT, ch3_progress.c). */
 static long fp_spin_us = 40;
 
-static int fp_block_recv(cph p, long long cpid, MPI_Status *stout) {
+static int fp_block_recv(cph p, long long cpid, MPI_Status *stout,
+                         long long basic) {
     int idle = 0;
     for (;;) {
         int rc = F.wait_quantum(p, cpid, fp_spin_us, 2);
@@ -442,7 +453,7 @@ static int fp_block_recv(cph p, long long cpid, MPI_Status *stout) {
     }
     if (fp_spin_us < 200)
         fp_spin_us += 4;
-    return fp_recv_status(p, cpid, stout);
+    return fp_recv_status(p, cpid, stout, basic);
 }
 
 /* ------------------------------------------------------------------ */
@@ -617,7 +628,7 @@ int fp_try_recv(void *buf, int count, MPI_Datatype dt, int source,
     if (fc == NULL || (source != MPI_ANY_SOURCE && source >= fc->size))
         return 0;
     long long cpid = fp_post_recv(p, d, buf, count, fc, source, tag);
-    *out_rc = fp_block_recv(p, cpid, status);
+    *out_rc = fp_block_recv(p, cpid, status, d->basic);
     F.req_free(p, cpid);
     return 1;
 }
@@ -696,6 +707,7 @@ int fp_try_irecv(void *buf, int count, MPI_Datatype dt, int source,
         return 0;
     fp_reqs[s].cpid = fp_post_recv(p, d, buf, count, fc, source, tag);
     fp_reqs[s].kind = FPK_RECV;
+    fp_reqs[s].basic = d->basic;
     fp_reqs[s].comm = comm;
     *req = FP_REQ_BASE + s;
     *out_rc = MPI_SUCCESS;
@@ -752,7 +764,7 @@ int fp_wait(MPI_Request *req, MPI_Status *status) {
     }
     if (r->kind == FPK_RECV) {
         if (p != NULL) {
-            rc = fp_block_recv(p, r->cpid, status);
+            rc = fp_block_recv(p, r->cpid, status, r->basic);
             F.req_free(p, r->cpid);
         } else {
             fp_status_empty(status);
@@ -848,7 +860,7 @@ int fp_get_status(MPI_Request req, int *flag, MPI_Status *status) {
     if (r->kind == FPK_RECV) {
         cph p = F.global();
         if (p != NULL)
-            (void)fp_recv_status(p, r->cpid, status);
+            (void)fp_recv_status(p, r->cpid, status, r->basic);
     } else {
         fp_status_empty(status);
     }
@@ -991,8 +1003,9 @@ static long fpc_elsz(MPI_Datatype dt) {
 
 /* blocking exchange step on the comm's COLLECTIVE context: post the
  * recv first, inject the send, wait. dst/src are comm ranks, -1 = none */
-static int fpc_sendrecv(cph p, FpComm *fc, int dst, int src, int tag,
-                        const void *sb, long snb, void *rb, long rnb) {
+static int fpc_sendrecv2(cph p, FpComm *fc, int dst, int src, int tag,
+                         const void *sb, long snb, void *rb, long rnb,
+                         long *rgot) {
     int cctx = fc->ctx + 1;
     long long rid = -1;
     if (src >= 0)
@@ -1010,11 +1023,22 @@ static int fpc_sendrecv(cph p, FpComm *fc, int dst, int src, int tag,
         }
     }
     if (rid >= 0) {
-        int rc = fp_block_recv(p, rid, MPI_STATUS_IGNORE);
+        int rc = fp_block_recv(p, rid, MPI_STATUS_IGNORE, 0);
+        if (rgot != NULL) {
+            int s2 = 0, t2 = 0, tr2 = 0, ec2 = 0;
+            long long nb2 = 0;
+            F.req_status(p, rid, &s2, &t2, &nb2, &tr2, &ec2);
+            *rgot = (long)nb2;
+        }
         F.req_free(p, rid);
         return rc;
     }
     return MPI_SUCCESS;
+}
+
+static int fpc_sendrecv(cph p, FpComm *fc, int dst, int src, int tag,
+                        const void *sb, long snb, void *rb, long rnb) {
+    return fpc_sendrecv2(p, fc, dst, src, tag, sb, snb, rb, rnb, NULL);
 }
 
 /* common eligibility; returns the plane or NULL, fills fc/nb */
@@ -1166,25 +1190,57 @@ int fp_try_bcast(void *buf, int count, MPI_Datatype dt, int root,
     int tag = F.coll_tag(p, fc->ctx + 1);
     int relrank = (rank - root + n) % n;
     int rc = MPI_SUCCESS;
+    long have = nb;             /* bytes to relay (root: own payload) */
+    const uint8_t *relay = data;
+    void *poison = NULL;
     /* binomial, byte-identical to coll/algorithms.py bcast_binomial
      * (the bcast_osu.c MPIR_Bcast_binomial_MV2 shape) */
     int mask = 1;
     while (mask < n) {
         if (relrank & mask) {
             int src = (rank - mask + n) % n;
-            rc = fpc_sendrecv(p, fc, -1, src, tag, NULL, 0, data, nb);
+            long got = 0;
+            rc = fpc_sendrecv2(p, fc, -1, src, tag, NULL, 0, data, nb,
+                               &got);
+            /* a bcast root sending a DIFFERENT byte count than this
+             * rank expects is a length mismatch the WHOLE subtree must
+             * report (errors/coll/bcastlength.c) — keep relaying so
+             * children never hang behind the verdict, shaping the
+             * relay so they reach the same verdict:
+             *   long case (got < nb): relay only the received bytes,
+             *     never an uninitialized tail;
+             *   short case (truncated, got > nb): relay nb+1 bytes —
+             *     the valid nb plus one sentinel byte — so the child
+             *     sees the same truncation its parent did (the extra
+             *     byte is clamped away, never reaching user memory) */
+            if (rc == MPI_SUCCESS && got != nb) {
+                have = got;
+                rc = MPI_ERR_TRUNCATE;
+            } else if (rc == MPI_ERR_TRUNCATE && got > nb) {
+                poison = malloc((size_t)nb + 1);
+                if (poison != NULL) {
+                    memcpy(poison, data, (size_t)nb);
+                    ((uint8_t *)poison)[nb] = 0;
+                    relay = poison;
+                    have = nb + 1;
+                }
+            }
             break;
         }
         mask <<= 1;
     }
     mask >>= 1;
-    while (rc == MPI_SUCCESS && mask > 0) {
+    while (mask > 0) {
         if (relrank + mask < n) {
             int dst = (rank + mask) % n;
-            rc = fpc_sendrecv(p, fc, dst, -1, tag, data, nb, NULL, 0);
+            int rc2 = fpc_sendrecv(p, fc, dst, -1, tag, relay, have,
+                                   NULL, 0);
+            if (rc == MPI_SUCCESS)
+                rc = rc2;
         }
         mask >>= 1;
     }
+    free(poison);
     if (tmp != NULL) {
         if (rc == MPI_SUCCESS && rank != root)
             fp_unpack_spans(d, buf, count, tmp);

@@ -512,21 +512,31 @@ int MPI_Wait(MPI_Request *req, MPI_Status *status) {
         }
         Py_DECREF(res);
     } else {
-        PyErr_Print();
+        /* the request completed with an MPI error (e.g. truncation):
+         * surface the class, don't flatten to ERR_OTHER */
+        rc = mv2t_errcode_from_pyerr();
     }
     PyGILState_Release(st);
     return rc;
 }
 
 int MPI_Waitall(int count, MPI_Request reqs[], MPI_Status statuses[]) {
+    /* MPI-3.1 §3.7.5: individual failures land in statuses[i].MPI_ERROR
+     * and the call returns MPI_ERR_IN_STATUS; the remaining requests
+     * are still waited (errors/pt2pt/errinstatwa.c) */
+    int had_err = 0;
     for (int i = 0; i < count; i++) {
         MPI_Status *s = statuses == MPI_STATUSES_IGNORE
                         ? MPI_STATUS_IGNORE : &statuses[i];
         int rc = MPI_Wait(&reqs[i], s);
-        if (rc != MPI_SUCCESS)
-            return rc;
+        if (rc != MPI_SUCCESS) {
+            if (s != MPI_STATUS_IGNORE)
+                s->MPI_ERROR = rc;
+            reqs[i] = MPI_REQUEST_NULL;   /* completed, with error */
+            had_err = 1;
+        }
     }
-    return MPI_SUCCESS;
+    return had_err ? MPI_ERR_IN_STATUS : MPI_SUCCESS;
 }
 
 int MPI_Test(MPI_Request *req, int *flag, MPI_Status *status) {
@@ -565,7 +575,8 @@ int MPI_Test(MPI_Request *req, int *flag, MPI_Status *status) {
         }
         Py_DECREF(res);
     } else {
-        PyErr_Print();
+        /* completed-with-error (truncation etc.): keep the class */
+        rc = mv2t_errcode_from_pyerr();
     }
     PyGILState_Release(st);
     return rc;
@@ -631,7 +642,7 @@ static int coll2(const char *fn, const void *sb, void *rb, long snb,
         PyObject *f = PyObject_GetAttrString(g_shim, fn);
         PyObject *res = f ? PyObject_CallObject(f, args) : NULL;
         if (res) { rc = MPI_SUCCESS; Py_DECREF(res); }
-        else PyErr_Print();
+        else rc = mv2t_errcode_from_pyerr();
         Py_XDECREF(f);
         Py_DECREF(args);
     }
@@ -659,8 +670,73 @@ int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root,
     return rc;
 }
 
+/* op/type compatibility for the predefined reductions (MPI-3.1 §5.9.2
+ * type classes; errors/coll/rerr.c checks (BYTE, MAX)). Derived types
+ * (>= 100) are validated by the shim. */
+int mv2t_op_type_ok(MPI_Op op, MPI_Datatype dt) {
+    if (dt >= 100 || dt < 0)
+        return 1;
+    int is_pair = dt >= 14 && dt <= 19;
+    int is_cplx = dt == 33 || dt == 34 || dt == 35;
+    int is_float = dt == 3 || dt == 4 || dt == 12;
+    int is_byte = dt == 0;
+    switch (op) {
+    case MPI_MAX: case MPI_MIN:
+        return !(is_byte || is_cplx || is_pair);
+    case MPI_SUM: case MPI_PROD:
+        return !(is_byte || is_pair);
+    case MPI_LAND: case MPI_LOR: case MPI_LXOR:
+        return !(is_cplx || is_pair);
+    case MPI_BAND: case MPI_BOR: case MPI_BXOR:
+        return !(is_float || is_cplx || is_pair);
+    case MPI_MINLOC: case MPI_MAXLOC:
+        return is_pair;
+    default:
+        return 1;               /* REPLACE / NO_OP / user ops */
+    }
+}
+
+/* Local pre-communication sanity for collectives: buffer aliasing
+ * (errors/coll/noalias*.c — rank 0 calls the rooted variants ALONE, so
+ * the check must fail locally before any packet moves) and op/type
+ * compatibility. root < 0: the local buffer pair matters on every
+ * rank; root >= 0: only on the root. snb/rnb < 0: pointer-equality
+ * check only (the v/w variants, where spans vary per peer). Returns an
+ * errcheck-processed code (callers return it directly on nonzero). */
+int mv2t_coll_precheck(const void *sb, long snb, const void *rb,
+                       long rnb, int root, int op, MPI_Datatype dt,
+                       MPI_Comm comm) {
+    if (op >= 0 && !mv2t_op_type_ok(op, dt))
+        return mv2t_errcheck(comm, MPI_ERR_OP);
+    if (root < -1)
+        return MPI_SUCCESS;    /* intercomm sentinels (MPI_ROOT /
+                                * MPI_PROC_NULL): local buffers are not
+                                * significant the intracomm way */
+    if (root >= 0) {
+        int r = -1;
+        if (MPI_Comm_rank(comm, &r) != MPI_SUCCESS || r != root)
+            return MPI_SUCCESS;
+    }
+    if (sb == NULL || rb == NULL || sb == MPI_IN_PLACE
+        || rb == MPI_IN_PLACE)
+        return MPI_SUCCESS;
+    const char *a = (const char *)sb, *b = (const char *)rb;
+    int bad;
+    if (snb < 0 || rnb < 0)
+        bad = (a == b);
+    else
+        bad = snb > 0 && rnb > 0 && a < b + rnb && b < a + snb;
+    if (bad)
+        return mv2t_errcheck(comm, MPI_ERR_BUFFER);
+    return MPI_SUCCESS;
+}
+
 int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
                   MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
+    int pre = mv2t_coll_precheck(sendbuf, dt_span_b(dt, count), recvbuf,
+                                 dt_span_b(dt, count), -1, op, dt, comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     if (mv2t_is_userop(op))
         return mv2t_userop_coll(0, sendbuf, recvbuf, count, dt, op, 0,
                                 comm);
@@ -674,6 +750,11 @@ int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
 
 int MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
                MPI_Datatype dt, MPI_Op op, int root, MPI_Comm comm) {
+    int pre = mv2t_coll_precheck(sendbuf, dt_span_b(dt, count), recvbuf,
+                                 dt_span_b(dt, count), root, op, dt,
+                                 comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     if (mv2t_is_userop(op))
         return mv2t_userop_coll(1, sendbuf, recvbuf, count, dt, op, root,
                                 comm);
@@ -688,6 +769,13 @@ int MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
 int MPI_Allgather(const void *sendbuf, int scount, MPI_Datatype sdt,
                   void *recvbuf, int rcount, MPI_Datatype rdt,
                   MPI_Comm comm) {
+    int pre = mv2t_coll_precheck(sendbuf, dt_span_b(sdt, scount),
+                                 recvbuf,
+                                 dt_span_b(rdt, (long)rcount
+                                           * coll_peer_np(comm)),
+                                 -1, -1, 0, comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     int size = coll_peer_np(comm);
     return mv2t_errcheck(comm, coll2("allgather", sendbuf, recvbuf,
                  dt_span_b(sdt, scount),
@@ -698,6 +786,15 @@ int MPI_Allgather(const void *sendbuf, int scount, MPI_Datatype sdt,
 int MPI_Alltoall(const void *sendbuf, int scount, MPI_Datatype sdt,
                  void *recvbuf, int rcount, MPI_Datatype rdt,
                  MPI_Comm comm) {
+    int pre = mv2t_coll_precheck(sendbuf,
+                                 dt_span_b(sdt, (long)scount
+                                           * coll_peer_np(comm)),
+                                 recvbuf,
+                                 dt_span_b(rdt, (long)rcount
+                                           * coll_peer_np(comm)),
+                                 -1, -1, 0, comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     int size = coll_peer_np(comm);
     return mv2t_errcheck(comm, coll2("alltoall", sendbuf, recvbuf,
                  dt_span_b(sdt, (long)scount * size),
@@ -708,6 +805,13 @@ int MPI_Alltoall(const void *sendbuf, int scount, MPI_Datatype sdt,
 int MPI_Gather(const void *sendbuf, int scount, MPI_Datatype sdt,
                void *recvbuf, int rcount, MPI_Datatype rdt, int root,
                MPI_Comm comm) {
+    int pre = mv2t_coll_precheck(sendbuf, dt_span_b(sdt, scount),
+                                 recvbuf,
+                                 dt_span_b(rdt, (long)rcount
+                                           * coll_peer_np(comm)),
+                                 root, -1, 0, comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     int size = coll_peer_np(comm);
     return mv2t_errcheck(comm, coll2("gather", sendbuf, recvbuf,
                  dt_span_b(sdt, scount),
@@ -718,6 +822,13 @@ int MPI_Gather(const void *sendbuf, int scount, MPI_Datatype sdt,
 int MPI_Scatter(const void *sendbuf, int scount, MPI_Datatype sdt,
                 void *recvbuf, int rcount, MPI_Datatype rdt, int root,
                 MPI_Comm comm) {
+    int pre = mv2t_coll_precheck(sendbuf,
+                                 dt_span_b(sdt, (long)scount
+                                           * coll_peer_np(comm)),
+                                 recvbuf, dt_span_b(rdt, rcount),
+                                 root, -1, 0, comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     int size = coll_peer_np(comm);
     return mv2t_errcheck(comm, coll2("scatter", sendbuf, recvbuf,
                  dt_span_b(sdt, (long)scount * size),
@@ -728,6 +839,10 @@ int MPI_Scatter(const void *sendbuf, int scount, MPI_Datatype sdt,
 int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
                              int rcount, MPI_Datatype dt, MPI_Op op,
                              MPI_Comm comm) {
+    int pre = mv2t_coll_precheck(sendbuf, -1, recvbuf, -1, -1, op,
+                                 dt, comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     if (mv2t_is_userop(op))
         return mv2t_userop_coll(4, sendbuf, recvbuf, rcount, dt, op, 0,
                                 comm);
@@ -826,6 +941,13 @@ int MPI_Win_detach(MPI_Win win, const void *base) {
 }
 
 int MPI_Win_free(MPI_Win *win) {
+    /* a free inside an open epoch is a reportable RMA sync error and
+     * must leave the handle intact (errors/rma/win_sync_free_pt.c);
+     * the check runs FIRST so attribute delete callbacks still see a
+     * live window, then the object is actually torn down */
+    int rc = shim_call_i("win_free_check", "(i)", *win);
+    if (rc != MPI_SUCCESS)
+        return mv2t_win_errcheck(*win, rc);
     mv2t_attr_delete_all(1, *win);
     mv2t_win_forget(*win);
     shim_call_i("win_free", "(i)", *win);
@@ -835,52 +957,52 @@ int MPI_Win_free(MPI_Win *win) {
 
 int MPI_Win_lock(int lock_type, int rank, int assert_, MPI_Win win) {
     (void)assert_;
-    return shim_call_i("win_lock", "(iii)", win,
-                       lock_type == MPI_LOCK_EXCLUSIVE ? 1 : 2, rank);
+    return mv2t_win_errcheck(win, shim_call_i("win_lock", "(iii)", win,
+                       lock_type == MPI_LOCK_EXCLUSIVE ? 1 : 2, rank));
 }
 
 int MPI_Win_unlock(int rank, MPI_Win win) {
-    return shim_call_i("win_unlock", "(ii)", win, rank);
+    return mv2t_win_errcheck(win, shim_call_i("win_unlock", "(ii)", win, rank));
 }
 
 int MPI_Win_lock_all(int assert_, MPI_Win win) {
     (void)assert_;
-    return shim_call_i("win_lock_all", "(i)", win);
+    return mv2t_win_errcheck(win, shim_call_i("win_lock_all", "(i)", win));
 }
 
 int MPI_Win_unlock_all(MPI_Win win) {
-    return shim_call_i("win_unlock_all", "(i)", win);
+    return mv2t_win_errcheck(win, shim_call_i("win_unlock_all", "(i)", win));
 }
 
 int MPI_Win_fence(int assert_, MPI_Win win) {
     (void)assert_;
-    return shim_call_i("win_fence", "(i)", win);
+    return mv2t_win_errcheck(win, shim_call_i("win_fence", "(i)", win));
 }
 
 int MPI_Win_flush(int rank, MPI_Win win) {
-    return shim_call_i("win_flush", "(ii)", win, rank);
+    return mv2t_win_errcheck(win, shim_call_i("win_flush", "(ii)", win, rank));
 }
 
 int MPI_Win_flush_local(int rank, MPI_Win win) {
-    return shim_call_i("win_flush_local", "(ii)", win, rank);
+    return mv2t_win_errcheck(win, shim_call_i("win_flush_local", "(ii)", win, rank));
 }
 
 int MPI_Win_post(MPI_Group group, int assert_, MPI_Win win) {
     (void)assert_;
-    return shim_call_i("win_post", "(ii)", win, group);
+    return mv2t_win_errcheck(win, shim_call_i("win_post", "(ii)", win, group));
 }
 
 int MPI_Win_start(MPI_Group group, int assert_, MPI_Win win) {
     (void)assert_;
-    return shim_call_i("win_start", "(ii)", win, group);
+    return mv2t_win_errcheck(win, shim_call_i("win_start", "(ii)", win, group));
 }
 
 int MPI_Win_complete(MPI_Win win) {
-    return shim_call_i("win_complete", "(i)", win);
+    return mv2t_win_errcheck(win, shim_call_i("win_complete", "(i)", win));
 }
 
 int MPI_Win_wait(MPI_Win win) {
-    return shim_call_i("win_wait", "(i)", win);
+    return mv2t_win_errcheck(win, shim_call_i("win_wait", "(i)", win));
 }
 
 /* ------------------------------------------------------------------ */
@@ -1144,40 +1266,50 @@ int MPI_Waitany(int count, MPI_Request reqs[], int *index,
 int MPI_Testall(int count, MPI_Request reqs[], int *flag,
                 MPI_Status statuses[]) {
     /* MPI-3.1 §3.7.5: requests/statuses are modified only when ALL
-     * complete; the shim's testall does the all-or-nothing check */
-    int has_fp = 0;
+     * complete (errored requests COUNT as complete, reported via
+     * statuses[i].MPI_ERROR + MPI_ERR_IN_STATUS —
+     * errors/pt2pt/errinstatta.c) */
+    int has_fp = 0, may_err = 0;
     for (int i = 0; i < count; i++)
         if (fp_is_handle(reqs[i]))
             has_fp = 1;
-    if (has_fp) {
-        /* nondestructive pass first (all-or-nothing semantics) */
-        for (int i = 0; i < count; i++) {
-            if (reqs[i] == MPI_REQUEST_NULL)
-                continue;
-            int f = 0;
-            if (fp_is_handle(reqs[i])) {
-                f = fp_peek_done(reqs[i]);
-            } else {
-                int rc = MPI_Request_get_status(reqs[i], &f,
-                                                MPI_STATUS_IGNORE);
-                if (rc != MPI_SUCCESS)
-                    return rc;
-            }
-            if (!f) {
-                *flag = 0;
-                return MPI_SUCCESS;
+    /* nondestructive pass (all-or-nothing semantics); an error from
+     * get_status means completed-with-error */
+    for (int i = 0; i < count; i++) {
+        if (reqs[i] == MPI_REQUEST_NULL)
+            continue;
+        int f = 0;
+        if (fp_is_handle(reqs[i])) {
+            f = fp_peek_done(reqs[i]);
+        } else {
+            int rc = MPI_Request_get_status(reqs[i], &f,
+                                            MPI_STATUS_IGNORE);
+            if (rc != MPI_SUCCESS) {
+                f = 1;
+                may_err = 1;
             }
         }
+        if (!f) {
+            *flag = 0;
+            return MPI_SUCCESS;
+        }
+    }
+    if (has_fp || may_err) {
+        int had_err = 0;
         for (int i = 0; i < count; i++) {
             MPI_Status *s = statuses == MPI_STATUSES_IGNORE
                             ? MPI_STATUS_IGNORE : &statuses[i];
             int f = 0;
             int rc = MPI_Test(&reqs[i], &f, s);
-            if (rc != MPI_SUCCESS)
-                return rc;
+            if (rc != MPI_SUCCESS) {
+                if (s != MPI_STATUS_IGNORE)
+                    s->MPI_ERROR = rc;
+                reqs[i] = MPI_REQUEST_NULL;
+                had_err = 1;
+            }
         }
         *flag = 1;
-        return MPI_SUCCESS;
+        return had_err ? MPI_ERR_IN_STATUS : MPI_SUCCESS;
     }
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *hl = PyList_New(count);
@@ -1317,6 +1449,16 @@ int MPI_Allgatherv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
                    void *recvbuf, const int recvcounts[],
                    const int displs[], MPI_Datatype rdt, MPI_Comm comm) {
     int n = coll_peer_np(comm);
+    /* range (not just equality) overlap: noalias2's allgatherv sends
+     * from &sbuf[rank*rcounts[rank]] — inside the recv region but
+     * pointer-unequal on nonzero ranks; every rank must error locally
+     * or the detecting ranks leave the others hung in the collective */
+    int pre = mv2t_coll_precheck(sendbuf, dt_span_b(sdt, sendcount),
+                                 recvbuf,
+                                 vspan_b(recvcounts, displs, rdt, n),
+                                 -1, -1, 0, comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *sv = mv_view(sendbuf, dt_span_b(sdt, sendcount));
     PyObject *rv = mv_view(recvbuf, vspan_b(recvcounts, displs, rdt, n));
@@ -1337,6 +1479,10 @@ int MPI_Alltoallv(const void *sendbuf, const int sendcounts[],
                   const int sdispls[], MPI_Datatype sdt, void *recvbuf,
                   const int recvcounts[], const int rdispls[],
                   MPI_Datatype rdt, MPI_Comm comm) {
+    int pre = mv2t_coll_precheck(sendbuf, -1, recvbuf, -1, -1, -1, 0,
+                                 comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     int n = coll_peer_np(comm);
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *sv = mv_view(sendbuf, vspan_b(sendcounts, sdispls, sdt, n));
@@ -1357,6 +1503,10 @@ int MPI_Alltoallv(const void *sendbuf, const int sendcounts[],
 int MPI_Gatherv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
                 void *recvbuf, const int recvcounts[], const int displs[],
                 MPI_Datatype rdt, int root, MPI_Comm comm) {
+    int pre = mv2t_coll_precheck(sendbuf, -1, recvbuf, -1, root, -1, 0,
+                                 comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     int n = coll_peer_np(comm);
     int me = -1;
     MPI_Comm_rank(comm, &me);
@@ -1383,6 +1533,10 @@ int MPI_Scatterv(const void *sendbuf, const int sendcounts[],
                  const int displs[], MPI_Datatype sdt, void *recvbuf,
                  int recvcount, MPI_Datatype rdt, int root,
                  MPI_Comm comm) {
+    int pre = mv2t_coll_precheck(sendbuf, -1, recvbuf, -1, root, -1, 0,
+                                 comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     int n = coll_peer_np(comm);
     int me = -1;
     MPI_Comm_rank(comm, &me);
@@ -1408,6 +1562,10 @@ int MPI_Scatterv(const void *sendbuf, const int sendcounts[],
 int MPI_Reduce_scatter(const void *sendbuf, void *recvbuf,
                        const int recvcounts[], MPI_Datatype dt, MPI_Op op,
                        MPI_Comm comm) {
+    int pre = mv2t_coll_precheck(sendbuf, -1, recvbuf, -1, -1, op, dt,
+                                 comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     int n = comm_np(comm);
     int me = -1;
     MPI_Comm_rank(comm, &me);
@@ -1466,6 +1624,11 @@ static int scanlike(const char *fn, const void *sendbuf, void *recvbuf,
 
 int MPI_Scan(const void *sendbuf, void *recvbuf, int count,
              MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
+    int pre = mv2t_coll_precheck(sendbuf, dt_span_b(dt, count), recvbuf,
+                                 dt_span_b(dt, count), -1, op, dt,
+                                 comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     if (mv2t_is_userop(op))
         return mv2t_userop_coll(2, sendbuf, recvbuf, count, dt, op, 0,
                                 comm);
@@ -1474,6 +1637,11 @@ int MPI_Scan(const void *sendbuf, void *recvbuf, int count,
 
 int MPI_Exscan(const void *sendbuf, void *recvbuf, int count,
                MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
+    int pre = mv2t_coll_precheck(sendbuf, dt_span_b(dt, count), recvbuf,
+                                 dt_span_b(dt, count), -1, op, dt,
+                                 comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     if (mv2t_is_userop(op))
         return mv2t_userop_coll(3, sendbuf, recvbuf, count, dt, op, 0,
                                 comm);
@@ -1789,7 +1957,7 @@ int MPI_Accumulate(const void *origin, int ocount, MPI_Datatype odt,
     Py_XDECREF(res);
     Py_XDECREF(view);
     PyGILState_Release(st);
-    return rc;
+    return mv2t_win_errcheck(win, rc);
 }
 
 int MPI_Get_accumulate(const void *origin, int ocount, MPI_Datatype odt,
@@ -1814,11 +1982,10 @@ int MPI_Get_accumulate(const void *origin, int ocount, MPI_Datatype odt,
                                         target_rank,
                                         (long long)target_disp,
                                         tcount, tdt, op);
-    int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
-    if (!res) PyErr_Print();
+    int rc = res ? MPI_SUCCESS : mv2t_errcode_from_pyerr();
     Py_XDECREF(res); Py_XDECREF(ov); Py_XDECREF(rv);
     PyGILState_Release(st);
-    return rc;
+    return mv2t_win_errcheck(win, rc);
 }
 
 int MPI_Fetch_and_op(const void *origin, void *result, MPI_Datatype dt,
@@ -1831,16 +1998,21 @@ int MPI_Fetch_and_op(const void *origin, void *result, MPI_Datatype dt,
                                         "(iOOiiLi)", win, ov, rv, dt,
                                         target_rank,
                                         (long long)target_disp, op);
-    int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
-    if (!res) PyErr_Print();
+    int rc = res ? MPI_SUCCESS : mv2t_errcode_from_pyerr();
     Py_XDECREF(res); Py_XDECREF(ov); Py_XDECREF(rv);
     PyGILState_Release(st);
-    return rc;
+    return mv2t_win_errcheck(win, rc);
 }
 
 int MPI_Compare_and_swap(const void *origin, const void *compare,
                          void *result, MPI_Datatype dt, int target_rank,
                          MPI_Aint target_disp, MPI_Win win) {
+    /* CAS is defined only for integer/logical/byte/multi-language
+     * types (MPI-3.1 §11.3.4.3); floating point, pair, complex, and
+     * derived types are MPI_ERR_TYPE (errors/rma/cas_type_check.c) */
+    if (dt >= 100 || dt == 3 || dt == 4 || dt == 12
+        || (dt >= 14 && dt <= 19) || dt == 33 || dt == 34 || dt == 35)
+        return mv2t_win_errcheck(win, MPI_ERR_TYPE);
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *ov = mv_view(origin, dt_size(dt));
     PyObject *cv = mv_view(compare, dt_size(dt));
@@ -1849,11 +2021,10 @@ int MPI_Compare_and_swap(const void *origin, const void *compare,
                                         "(iOOOiiL)", win, ov, cv, rv, dt,
                                         target_rank,
                                         (long long)target_disp);
-    int rc = res ? MPI_SUCCESS : MPI_ERR_OTHER;
-    if (!res) PyErr_Print();
+    int rc = res ? MPI_SUCCESS : mv2t_errcode_from_pyerr();
     Py_XDECREF(res); Py_XDECREF(ov); Py_XDECREF(cv); Py_XDECREF(rv);
     PyGILState_Release(st);
-    return rc;
+    return mv2t_win_errcheck(win, rc);
 }
 
 int MPI_Win_flush_all(MPI_Win win) {
@@ -1861,11 +2032,11 @@ int MPI_Win_flush_all(MPI_Win win) {
 }
 
 int MPI_Win_flush_local_all(MPI_Win win) {
-    return shim_call_i("win_flush_local_all", "(i)", win);
+    return mv2t_win_errcheck(win, shim_call_i("win_flush_local_all", "(i)", win));
 }
 
 int MPI_Win_sync(MPI_Win win) {
-    return shim_call_i("win_sync", "(i)", win);
+    return mv2t_win_errcheck(win, shim_call_i("win_sync", "(i)", win));
 }
 
 static int rma_op(const char *fn, MPI_Win win, const void *origin,
@@ -1881,7 +2052,7 @@ static int rma_op(const char *fn, MPI_Win win, const void *origin,
     Py_XDECREF(res);
     Py_XDECREF(view);
     PyGILState_Release(st);
-    return rc;
+    return mv2t_win_errcheck(win, rc);
 }
 
 int MPI_Put(const void *origin, int ocount, MPI_Datatype odt,

@@ -15,6 +15,7 @@
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <time.h>
 
 #include "libmpi_internal.h"
 
@@ -406,6 +407,9 @@ typedef struct {
                                 * attached attrs keep their callbacks;
                                 * slots are never reused) */
     int freed;                 /* MPI_*_free_keyval called */
+    int kind;                  /* 0 comm / 1 win / 2 type: a keyval is
+                                * usable only with its own object class
+                                * (errors/attr/keyvalmis.c) */
     MPI_Comm_copy_attr_function *copy_fn;
     MPI_Comm_delete_attr_function *delete_fn;
     void *extra_state;
@@ -433,7 +437,7 @@ static int keyval_slot_referenced(int k) {
 }
 
 static int keyval_alloc(void *copy_fn, void *delete_fn, int *keyval,
-                        void *extra_state) {
+                        void *extra_state, int kind) {
     /* Prefer never-used slots (freed keyvals stay functional for
      * already-attached attributes, MPI-3.1 §6.7.2, so a freed slot
      * cannot be handed out while any attribute still references it).
@@ -458,6 +462,7 @@ static int keyval_alloc(void *copy_fn, void *delete_fn, int *keyval,
     g_next_keyval = i + 1;
     g_keyvals[i].used = 1;
     g_keyvals[i].freed = 0;
+    g_keyvals[i].kind = kind;
     g_keyvals[i].copy_fn = (MPI_Comm_copy_attr_function *)copy_fn;
     g_keyvals[i].delete_fn = (MPI_Comm_delete_attr_function *)delete_fn;
     g_keyvals[i].extra_state = extra_state;
@@ -479,6 +484,8 @@ static int attr_set(int kind, int obj, int keyval, void *val) {
     if (keyval < KV_BASE || keyval >= MAX_KEYVALS
         || !g_keyvals[keyval].used)
         return MPI_ERR_ARG;    /* MPI_ERR_KEYVAL class */
+    if (g_keyvals[keyval].kind != kind)
+        return MPI_ERR_ARG;    /* wrong object class for this keyval */
     attr_node **p = attr_find(kind, obj, keyval);
     if (p != NULL) {
         /* replace: run the delete callback on the old value (MPI-3.1
@@ -505,6 +512,9 @@ static int attr_set(int kind, int obj, int keyval, void *val) {
 
 static int attr_get(int kind, int obj, int keyval, void *attribute_val,
                     int *flag) {
+    if (keyval >= KV_BASE && keyval < MAX_KEYVALS
+        && g_keyvals[keyval].used && g_keyvals[keyval].kind != kind)
+        return MPI_ERR_ARG;    /* wrong object class for this keyval */
     attr_node **p = attr_find(kind, obj, keyval);
     if (p == NULL) {
         *flag = 0;
@@ -605,14 +615,22 @@ int MPI_Comm_create_keyval(MPI_Comm_copy_attr_function *copy_fn,
                            MPI_Comm_delete_attr_function *delete_fn,
                            int *keyval, void *extra_state) {
     return keyval_alloc((void *)copy_fn, (void *)delete_fn, keyval,
-                        extra_state);
+                        extra_state, 0);
+}
+
+static int keyval_free(int *keyval, int kind) {
+    if (*keyval >= KV_BASE && *keyval < MAX_KEYVALS
+        && g_keyvals[*keyval].used) {
+        if (g_keyvals[*keyval].kind != kind)
+            return MPI_ERR_ARG;   /* wrong class (errors/attr/keyvalmis) */
+        g_keyvals[*keyval].freed = 1;
+    }
+    *keyval = MPI_KEYVAL_INVALID;
+    return MPI_SUCCESS;
 }
 
 int MPI_Comm_free_keyval(int *keyval) {
-    if (*keyval >= KV_BASE && *keyval < MAX_KEYVALS)
-        g_keyvals[*keyval].freed = 1;
-    *keyval = MPI_KEYVAL_INVALID;
-    return MPI_SUCCESS;
+    return keyval_free(keyval, 0);
 }
 
 int MPI_Comm_set_attr(MPI_Comm comm, int keyval, void *attribute_val) {
@@ -688,11 +706,11 @@ int MPI_Win_create_keyval(MPI_Win_copy_attr_function *copy_fn,
                           MPI_Win_delete_attr_function *delete_fn,
                           int *keyval, void *extra_state) {
     return keyval_alloc((void *)copy_fn, (void *)delete_fn, keyval,
-                        extra_state);
+                        extra_state, 1);
 }
 
 int MPI_Win_free_keyval(int *keyval) {
-    return MPI_Comm_free_keyval(keyval);
+    return keyval_free(keyval, 1);
 }
 
 /* predefined win attributes recorded at creation (libmpi.c hook) */
@@ -788,11 +806,11 @@ int MPI_Type_create_keyval(MPI_Type_copy_attr_function *copy_fn,
                            MPI_Type_delete_attr_function *delete_fn,
                            int *keyval, void *extra_state) {
     return keyval_alloc((void *)copy_fn, (void *)delete_fn, keyval,
-                        extra_state);
+                        extra_state, 2);
 }
 
 int MPI_Type_free_keyval(int *keyval) {
-    return MPI_Comm_free_keyval(keyval);
+    return keyval_free(keyval, 2);
 }
 
 int MPI_Type_set_attr(MPI_Datatype type, int keyval, void *attribute_val) {
@@ -1409,29 +1427,53 @@ int MPI_Testany(int count, MPI_Request reqs[], int *index, int *flag,
     return MPI_SUCCESS;
 }
 
-int MPI_Testsome(int incount, MPI_Request reqs[], int *outcount,
-                 int indices[], MPI_Status statuses[]) {
-    int done = 0, active = 0;
+/* one nonblocking sweep over the request array, APPENDING at *done;
+ * errored requests count as completed with statuses[done].MPI_ERROR
+ * set (MPI-3.1 §3.7.5, errors/pt2pt/errinstatts.c expects them IN
+ * outcount) */
+static void some_sweep(int incount, MPI_Request reqs[], int indices[],
+                       MPI_Status statuses[], int *done, int *had_err) {
     for (int i = 0; i < incount; i++) {
         if (reqs[i] == MPI_REQUEST_NULL)
             continue;
-        active = 1;
         int f = 0;
         MPI_Status *s = statuses == MPI_STATUSES_IGNORE
-            ? MPI_STATUS_IGNORE : &statuses[done];
+            ? MPI_STATUS_IGNORE : &statuses[*done];
         int rc = MPI_Test(&reqs[i], &f, s);
-        if (rc != MPI_SUCCESS)
-            return rc;
-        if (f)
-            indices[done++] = i;
+        if (rc != MPI_SUCCESS) {
+            if (s != MPI_STATUS_IGNORE)
+                s->MPI_ERROR = rc;
+            reqs[i] = MPI_REQUEST_NULL;   /* completed, with error */
+            indices[(*done)++] = i;
+            *had_err = 1;
+        } else if (f) {
+            indices[(*done)++] = i;
+        }
     }
-    *outcount = active ? done : MPI_UNDEFINED;
-    return MPI_SUCCESS;
+}
+
+int MPI_Testsome(int incount, MPI_Request reqs[], int *outcount,
+                 int indices[], MPI_Status statuses[]) {
+    int active = 0;
+    for (int i = 0; i < incount; i++)
+        if (reqs[i] != MPI_REQUEST_NULL)
+            active = 1;
+    if (!active) {
+        *outcount = MPI_UNDEFINED;
+        return MPI_SUCCESS;
+    }
+    int done = 0, had_err = 0;
+    some_sweep(incount, reqs, indices, statuses, &done, &had_err);
+    *outcount = done;
+    return had_err ? MPI_ERR_IN_STATUS : MPI_SUCCESS;
 }
 
 int MPI_Waitsome(int incount, MPI_Request reqs[], int *outcount,
                  int indices[], MPI_Status statuses[]) {
-    /* block for at least one completion, then drain what's ready */
+    /* block until at least one completion via Waitany (which owns the
+     * doorbell/adaptive-spin discipline — no polling loop here), then
+     * drain whatever else is ready; an errored request surfaces
+     * through the sweep as completed-with-error (§3.7.5) */
     int any = 0;
     for (int i = 0; i < incount; i++)
         if (reqs[i] != MPI_REQUEST_NULL)
@@ -1440,32 +1482,29 @@ int MPI_Waitsome(int incount, MPI_Request reqs[], int *outcount,
         *outcount = MPI_UNDEFINED;
         return MPI_SUCCESS;
     }
-    int idx;
-    MPI_Status first;
-    int rc = MPI_Waitany(incount, reqs, &idx, &first);
-    if (rc != MPI_SUCCESS)
-        return rc;
-    int done = 0;
-    if (idx != MPI_UNDEFINED) {
-        indices[done] = idx;
-        if (statuses != MPI_STATUSES_IGNORE)
-            statuses[done] = first;
-        done++;
-    }
-    for (int i = 0; i < incount; i++) {
-        if (reqs[i] == MPI_REQUEST_NULL || i == idx)
-            continue;
-        int f = 0;
-        MPI_Status *s = statuses == MPI_STATUSES_IGNORE
-            ? MPI_STATUS_IGNORE : &statuses[done];
-        rc = MPI_Test(&reqs[i], &f, s);
-        if (rc != MPI_SUCCESS)
+    int done = 0, had_err = 0;
+    some_sweep(incount, reqs, indices, statuses, &done, &had_err);
+    while (done == 0) {
+        int idx = MPI_UNDEFINED;
+        MPI_Status first;
+        int rc = MPI_Waitany(incount, reqs, &idx, &first);
+        if (rc == MPI_SUCCESS && idx != MPI_UNDEFINED) {
+            indices[done] = idx;
+            if (statuses != MPI_STATUSES_IGNORE)
+                statuses[done] = first;
+            done++;
+        }
+        /* rc != SUCCESS: an errored request exists somewhere — the
+         * sweep below records it and nulls it, guaranteeing progress */
+        some_sweep(incount, reqs, indices, statuses, &done, &had_err);
+        if (rc != MPI_SUCCESS && done == 0) {
+            /* error consumed by Waitany without an index: report it */
+            *outcount = 0;
             return rc;
-        if (f)
-            indices[done++] = i;
+        }
     }
     *outcount = done;
-    return MPI_SUCCESS;
+    return had_err ? MPI_ERR_IN_STATUS : MPI_SUCCESS;
 }
 
 /* ------------------------------------------------------------------ */
@@ -1661,6 +1700,26 @@ MPI_Errhandler mv2t_get_win_errhandler(int win) {
     return win_eh_of(win);
 }
 
+static void eh_fatal(const char *kind, int handle, int rc);
+
+/* funnel: applies the WINDOW's errhandler to a nonzero rc from an RMA
+ * op or sync call (errors/rma/winerr.c: a bad-rank Put must invoke the
+ * window handler, not the comm one; default is ERRORS_ARE_FATAL) */
+int mv2t_win_errcheck(MPI_Win win, int rc) {
+    if (rc == MPI_SUCCESS)
+        return rc;
+    MPI_Errhandler eh = win_eh_of(win);
+    if (eh == MPI_ERRORS_RETURN)
+        return rc;
+    if (eh >= EH_BASE && eh < EH_BASE + MAX_EH
+        && g_eh[eh - EH_BASE].used && g_eh[eh - EH_BASE].fn != NULL) {
+        g_eh[eh - EH_BASE].fn(&win, &rc);
+        return rc;
+    }
+    eh_fatal("win", win, rc);
+    return rc;                  /* unreachable */
+}
+
 void mv2t_win_eh_forget(int win) {
     eh_node **p = &g_win_eh;
     while (*p != NULL) {
@@ -1712,6 +1771,20 @@ static void eh_fatal(const char *kind, int handle, int rc) {
 int mv2t_errcheck(MPI_Comm comm, int rc) {
     if (rc == MPI_SUCCESS)
         return rc;
+    if (rc == MPI_ERR_COMM) {
+        /* invalid/freed communicator: no comm owns the error — the
+         * reference routes these through MPI_COMM_WORLD's handler
+         * (errors/comm/cfree.c sets ERRORS_RETURN on WORLD and expects
+         * a code back from a barrier on a freed dup) */
+        int explicit = 0;
+        for (eh_node *n = g_comm_eh; n != NULL; n = n->next)
+            if (n->comm == comm) {
+                explicit = 1;
+                break;
+            }
+        if (!explicit)
+            comm = MPI_COMM_WORLD;
+    }
     MPI_Errhandler eh = eh_of(comm);
     if (eh == MPI_ERRORS_RETURN)
         return rc;
@@ -1941,6 +2014,11 @@ int MPI_Ibcast(void *buf, int count, MPI_Datatype dt, int root,
 int MPI_Iallreduce(const void *sendbuf, void *recvbuf, int count,
                    MPI_Datatype dt, MPI_Op op, MPI_Comm comm,
                    MPI_Request *req) {
+    int pre = mv2t_coll_precheck(sendbuf, dt_span_b(dt, count), recvbuf,
+                                 dt_span_b(dt, count), -1, op, dt,
+                                 comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     if (mv2t_is_userop(op)) {
         int rc = mv2t_userop_coll(0, sendbuf, recvbuf, count, dt, op, 0,
                                   comm);
@@ -1963,6 +2041,11 @@ int MPI_Iallreduce(const void *sendbuf, void *recvbuf, int count,
 int MPI_Ireduce(const void *sendbuf, void *recvbuf, int count,
                 MPI_Datatype dt, MPI_Op op, int root, MPI_Comm comm,
                 MPI_Request *req) {
+    int pre = mv2t_coll_precheck(sendbuf, dt_span_b(dt, count), recvbuf,
+                                 dt_span_b(dt, count), root, op, dt,
+                                 comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     if (mv2t_is_userop(op)) {
         int rc = mv2t_userop_coll(1, sendbuf, recvbuf, count, dt, op,
                                   root, comm);
@@ -1985,6 +2068,13 @@ int MPI_Ireduce(const void *sendbuf, void *recvbuf, int count,
 int MPI_Iallgather(const void *sendbuf, int sendcount, MPI_Datatype sdt,
                    void *recvbuf, int recvcount, MPI_Datatype rdt,
                    MPI_Comm comm, MPI_Request *req) {
+    int pre = mv2t_coll_precheck(sendbuf, dt_span_b(sdt, sendcount),
+                                 recvbuf,
+                                 dt_span_b(rdt, (long)recvcount
+                                           * coll_peer_np(comm)),
+                                 -1, -1, 0, comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     (void)sdt;
     PyGILState_STATE st = PyGILState_Ensure();
     int p = comm_np(comm);
@@ -2003,6 +2093,15 @@ int MPI_Iallgather(const void *sendbuf, int sendcount, MPI_Datatype sdt,
 int MPI_Ialltoall(const void *sendbuf, int sendcount, MPI_Datatype sdt,
                   void *recvbuf, int recvcount, MPI_Datatype rdt,
                   MPI_Comm comm, MPI_Request *req) {
+    int pre = mv2t_coll_precheck(sendbuf,
+                                 dt_span_b(sdt, (long)sendcount
+                                           * coll_peer_np(comm)),
+                                 recvbuf,
+                                 dt_span_b(rdt, (long)recvcount
+                                           * coll_peer_np(comm)),
+                                 -1, -1, 0, comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     (void)sdt; (void)sendcount;
     PyGILState_STATE st = PyGILState_Ensure();
     int p = comm_np(comm);
@@ -2037,12 +2136,22 @@ static int iscanlike(const char *fn, const void *sendbuf, void *recvbuf,
 int MPI_Iscan(const void *sendbuf, void *recvbuf, int count,
               MPI_Datatype dt, MPI_Op op, MPI_Comm comm,
               MPI_Request *req) {
+    int pre = mv2t_coll_precheck(sendbuf, dt_span_b(dt, count), recvbuf,
+                                 dt_span_b(dt, count), -1, op, dt,
+                                 comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     return iscanlike("iscan", sendbuf, recvbuf, count, dt, op, comm, req);
 }
 
 int MPI_Iexscan(const void *sendbuf, void *recvbuf, int count,
                 MPI_Datatype dt, MPI_Op op, MPI_Comm comm,
                 MPI_Request *req) {
+    int pre = mv2t_coll_precheck(sendbuf, dt_span_b(dt, count), recvbuf,
+                                 dt_span_b(dt, count), -1, op, dt,
+                                 comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     return iscanlike("iexscan", sendbuf, recvbuf, count, dt, op, comm,
                      req);
 }
@@ -2050,6 +2159,13 @@ int MPI_Iexscan(const void *sendbuf, void *recvbuf, int count,
 int MPI_Igather(const void *sendbuf, int sendcount, MPI_Datatype sdt,
                 void *recvbuf, int recvcount, MPI_Datatype rdt, int root,
                 MPI_Comm comm, MPI_Request *req) {
+    int pre = mv2t_coll_precheck(sendbuf, dt_span_b(sdt, sendcount),
+                                 recvbuf,
+                                 dt_span_b(rdt, (long)recvcount
+                                           * coll_peer_np(comm)),
+                                 root, -1, 0, comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     int rank;
     MPI_Comm_rank(comm, &rank);
     PyGILState_STATE st = PyGILState_Ensure();
@@ -2073,6 +2189,14 @@ int MPI_Igather(const void *sendbuf, int sendcount, MPI_Datatype sdt,
 int MPI_Iscatter(const void *sendbuf, int sendcount, MPI_Datatype sdt,
                  void *recvbuf, int recvcount, MPI_Datatype rdt, int root,
                  MPI_Comm comm, MPI_Request *req) {
+    int pre = mv2t_coll_precheck(sendbuf,
+                                 dt_span_b(sdt, (long)sendcount
+                                           * coll_peer_np(comm)),
+                                 recvbuf,
+                                 dt_span_b(rdt, recvcount),
+                                 root, -1, 0, comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     int rank;
     MPI_Comm_rank(comm, &rank);
     PyGILState_STATE st = PyGILState_Ensure();
@@ -2386,6 +2510,9 @@ static int topo_newcomm(const char *fn, MPI_Comm comm, PyObject *args,
     PyObject *f = PyObject_GetAttrString(g_shim, fn);
     PyObject *res = f ? PyObject_CallObject(f, args) : NULL;
     int rc = MPI_ERR_TOPOLOGY;
+    /* on any error the output handle must read as COMM_NULL
+     * (errors/topo/cartsmall.c checks both err and the handle) */
+    *newcomm = MPI_COMM_NULL;
     if (res != NULL) {
         long h = PyLong_AsLong(res);
         if (!PyErr_Occurred()) {
@@ -2843,6 +2970,10 @@ int MPI_Alltoallw(const void *sendbuf, const int sendcounts[],
                   void *recvbuf, const int recvcounts[],
                   const int rdispls[], const MPI_Datatype recvtypes[],
                   MPI_Comm comm) {
+    int pre = mv2t_coll_precheck(sendbuf, -1, recvbuf, -1, -1, -1, 0,
+                                 comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     int n = coll_peer_np(comm);
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *sv = mv_view(sendbuf, wspan(sendcounts, sdispls,
@@ -2869,6 +3000,7 @@ int MPI_Ialltoallw(const void *sendbuf, const int sendcounts[],
                    void *recvbuf, const int recvcounts[],
                    const int rdispls[], const MPI_Datatype recvtypes[],
                    MPI_Comm comm, MPI_Request *req) {
+    /* the blocking callee runs mv2t_coll_precheck itself */
     int rc = MPI_Alltoallw(sendbuf, sendcounts, sdispls, sendtypes,
                            recvbuf, recvcounts, rdispls, recvtypes, comm);
     *req = MPI_REQUEST_NULL;
@@ -2877,6 +3009,16 @@ int MPI_Ialltoallw(const void *sendbuf, const int sendcounts[],
 
 int MPI_Reduce_local(const void *inbuf, void *inoutbuf, int count,
                      MPI_Datatype datatype, MPI_Op op) {
+    /* errors/coll/reduce_local.c: IN_PLACE is illegal for either
+     * buffer, aliasing is illegal, and the op/type pair must be
+     * compatible; a local op returns codes directly (no communicator
+     * to own an errhandler) */
+    if (inbuf == MPI_IN_PLACE || inoutbuf == MPI_IN_PLACE)
+        return MPI_ERR_BUFFER;
+    if (count > 0 && inbuf != NULL && inbuf == (const void *)inoutbuf)
+        return MPI_ERR_BUFFER;
+    if (!mv2t_op_type_ok(op, datatype))
+        return MPI_ERR_OP;
     PyGILState_STATE st = PyGILState_Ensure();
     long span = dt_span_b(datatype, count);
     PyObject *iv = mv_view(inbuf, span);
@@ -3260,6 +3402,10 @@ int MPI_Igatherv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
                  void *recvbuf, const int recvcounts[],
                  const int displs[], MPI_Datatype rdt, int root,
                  MPI_Comm comm, MPI_Request *req) {
+    int pre = mv2t_coll_precheck(sendbuf, -1, recvbuf, -1, root, -1, 0,
+                                 comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     int n = coll_peer_np(comm);
     int me = -1;
     MPI_Comm_rank(comm, &me);
@@ -3285,6 +3431,10 @@ int MPI_Iscatterv(const void *sendbuf, const int sendcounts[],
                   const int displs[], MPI_Datatype sdt, void *recvbuf,
                   int recvcount, MPI_Datatype rdt, int root,
                   MPI_Comm comm, MPI_Request *req) {
+    int pre = mv2t_coll_precheck(sendbuf, -1, recvbuf, -1, root, -1, 0,
+                                 comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     int n = coll_peer_np(comm);
     int me = -1;
     MPI_Comm_rank(comm, &me);
@@ -3311,6 +3461,12 @@ int MPI_Iallgatherv(const void *sendbuf, int sendcount, MPI_Datatype sdt,
                     const int displs[], MPI_Datatype rdt, MPI_Comm comm,
                     MPI_Request *req) {
     int n = coll_peer_np(comm);
+    int pre = mv2t_coll_precheck(sendbuf, dt_span_b(sdt, sendcount),
+                                 recvbuf,
+                                 vspan_b(recvcounts, displs, rdt, n),
+                                 -1, -1, 0, comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *sv = mv_view(sendbuf, dt_span_b(sdt, sendcount));
     PyObject *rv = mv_view(recvbuf, vspan_b(recvcounts, displs, rdt, n));
@@ -3330,6 +3486,10 @@ int MPI_Ialltoallv(const void *sendbuf, const int sendcounts[],
                    const int sdispls[], MPI_Datatype sdt, void *recvbuf,
                    const int recvcounts[], const int rdispls[],
                    MPI_Datatype rdt, MPI_Comm comm, MPI_Request *req) {
+    int pre = mv2t_coll_precheck(sendbuf, -1, recvbuf, -1, -1, -1, 0,
+                                 comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     int n = coll_peer_np(comm);
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *sv = sendbuf == MPI_IN_PLACE ? (Py_INCREF(Py_None), Py_None)
@@ -3353,6 +3513,10 @@ int MPI_Ialltoallv(const void *sendbuf, const int sendcounts[],
 int MPI_Ireduce_scatter(const void *sendbuf, void *recvbuf,
                         const int recvcounts[], MPI_Datatype dt,
                         MPI_Op op, MPI_Comm comm, MPI_Request *req) {
+    int pre = mv2t_coll_precheck(sendbuf, -1, recvbuf, -1, -1, op, dt,
+                                 comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     if (mv2t_is_userop(op)) {
         /* user ops fold on the C side; blocking + completed request */
         int rc = MPI_Reduce_scatter(sendbuf, recvbuf, recvcounts, dt, op,
@@ -3383,6 +3547,10 @@ int MPI_Ireduce_scatter(const void *sendbuf, void *recvbuf,
 int MPI_Ireduce_scatter_block(const void *sendbuf, void *recvbuf,
                               int recvcount, MPI_Datatype dt, MPI_Op op,
                               MPI_Comm comm, MPI_Request *req) {
+    int pre = mv2t_coll_precheck(sendbuf, -1, recvbuf, -1, -1, op,
+                                 dt, comm);
+    if (pre != MPI_SUCCESS)
+        return pre;
     if (mv2t_is_userop(op)) {
         int rc = MPI_Reduce_scatter_block(sendbuf, recvbuf, recvcount,
                                           dt, op, comm);

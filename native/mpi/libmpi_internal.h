@@ -18,6 +18,10 @@ PyObject *mv_view(const void *buf, long nbytes);
 int dt_size(MPI_Datatype dt);
 long dt_extent_b(MPI_Datatype dt);
 long dt_span_b(MPI_Datatype dt, long count);
+int mv2t_op_type_ok(MPI_Op op, MPI_Datatype dt);
+int mv2t_coll_precheck(const void *sb, long snb, const void *rb,
+                       long rnb, int root, int op, MPI_Datatype dt,
+                       MPI_Comm comm);
 PyObject *int_list(const int *a, int n);
 int comm_np(MPI_Comm comm);
 int coll_peer_np(MPI_Comm comm);
@@ -35,6 +39,7 @@ void mv2t_win_forget(int win);
 void mv2t_set_win_errhandler(int win, MPI_Errhandler eh);
 MPI_Errhandler mv2t_get_win_errhandler(int win);
 void mv2t_win_eh_forget(int win);
+int mv2t_win_errcheck(MPI_Win win, int rc);
 int mv2t_is_userop(MPI_Op op);
 int mv2t_userop_coll(int kind, const void *sendbuf, void *recvbuf,
                      int count, MPI_Datatype dt, MPI_Op op, int root,

@@ -197,6 +197,16 @@ int MPI_Type_match_size(int typeclass, int size, MPI_Datatype *rtype);
 #define MPI_ERR_IO           32
 #define MPI_ERR_NO_SUCH_FILE 37
 #define MPI_ERR_AMODE        38
+#define MPI_ERR_ACCESS       39
+#define MPI_ERR_READ_ONLY    40
+#define MPI_ERR_FILE_EXISTS  60
+#define MPI_ERR_FILE_IN_USE  61
+#define MPI_ERR_BAD_FILE     62
+#define MPI_ERR_NOT_SAME     63
+#define MPI_ERR_NO_SPACE     64
+#define MPI_ERR_QUOTA        65
+#define MPI_ERR_DUP_DATAREP  66
+#define MPI_ERR_CONVERSION   67
 #define MPI_ERR_UNSUPPORTED_DATAREP 43
 #define MPI_ERR_UNSUPPORTED_OPERATION 44
 #define MPI_ERR_PORT     27
