@@ -348,7 +348,12 @@ class Pt2ptProtocol:
                     "buffered send to %d failed: %s", dest_world, r.error))
             breq = SendRequest(self.engine, dest_world)
             breq._fire()
-            if isinstance(shadow, SendRequest):
+            # any cancellable shadow gets the hook — a LARGE buffered
+            # send's shadow is a CPlaneSendRequest (CMA rendezvous),
+            # which is a Request but NOT a SendRequest subclass;
+            # keying on SendRequest silently dropped its cancel path
+            # (pt2pt/scancel.c's long Ibsend)
+            if isinstance(shadow, (SendRequest, CPlaneSendRequest)):
                 def bcancel():
                     with self.engine.mutex:
                         if getattr(breq, "_cancel_pending", False):

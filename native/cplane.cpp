@@ -445,7 +445,9 @@ void reap_orphan(CPlane* p, Req* r) {
 void complete_eager(CPlane* p, Req* r, const PktHdr* h,
                     const uint8_t* payload) {
   int64_t n = h->nbytes < r->cap ? h->nbytes : r->cap;
-  if (n > 0 && r->buf) {
+  /* an MPI_BOTTOM receive has a NULL base with ABSOLUTE span offsets
+   * (pt2pt/bottom.c) — a scatter must run regardless of the base */
+  if (n > 0 && (r->buf || r->scatter)) {
     if (r->scatter)
       scatter_bytes(static_cast<uint8_t*>(r->buf), r->scatter, payload, n);
     else
@@ -517,7 +519,8 @@ void send_fin_cma(CPlane* p, int dst_ring, int64_t sreq, int64_t consumed,
 void cma_complete(CPlane* p, Req* r, const PktHdr* h) {
   int64_t n = h->nbytes < r->cap ? h->nbytes : r->cap;
   int rc = 0;
-  if (r->buf && n > 0)
+  if ((r->buf || r->scatter) && n > 0)   /* NULL base + absolute spans
+                                          * is legal (MPI_BOTTOM) */
     rc = cma_pull(r, n, static_cast<int32_t>(h->rreq_id),
                   static_cast<uint64_t>(h->offset));
   r->st_src = h->comm_src;

@@ -619,6 +619,13 @@ int fp_try_recv(void *buf, int count, MPI_Datatype dt, int source,
     cph p = fp_plane();
     if (p == NULL || count < 0)
         return 0;
+    /* MPI_BOTTOM (NULL base + absolute typemap): the eager and CMA
+     * completions scatter fine, but the python-assist rendezvous path
+     * cannot reach the scatter descriptor — route BOTTOM receives
+     * through the python matcher, which handles absolute addressing
+     * on every protocol */
+    if (buf == NULL && count > 0)
+        return 0;
     if (source < 0 && source != MPI_ANY_SOURCE)
         return 0;
     FpDt *d = fp_dt(dt);
@@ -693,6 +700,9 @@ int fp_try_irecv(void *buf, int count, MPI_Datatype dt, int source,
                  int tag, MPI_Comm comm, MPI_Request *req, int *out_rc) {
     cph p = fp_plane();
     if (p == NULL || count < 0)
+        return 0;
+    if (buf == NULL && count > 0)   /* MPI_BOTTOM: python matcher
+                                     * (see fp_try_recv) */
         return 0;
     if (source < 0 && source != MPI_ANY_SOURCE)
         return 0;
