@@ -15,6 +15,8 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+import json
+
 import numpy as np
 
 from .comm import Comm, _resolve
@@ -24,20 +26,28 @@ from .group import Group
 from .status import ANY_SOURCE, PROC_NULL, ROOT
 
 
+def _json_to_arr(obj) -> np.ndarray:
+    """One encode convention for every bridge header path."""
+    return np.frombuffer(json.dumps(obj).encode(), dtype=np.uint8).copy()
+
+
+def _arr_to_json(arr: np.ndarray):
+    return json.loads(arr.tobytes().decode())
+
+
 def bcast_json(comm: Comm, obj, root: int):
     """Broadcast a JSON-serializable object over ``comm`` (length first)."""
-    import json
     if comm.rank == root:
-        payload = np.frombuffer(json.dumps(obj).encode(), dtype=np.uint8)
+        payload = _json_to_arr(obj)
         n = np.array([payload.size], dtype=np.int64)
         comm.bcast(n, root=root)
-        comm.bcast(payload.copy(), root=root)
+        comm.bcast(payload, root=root)
         return obj
     n = np.zeros(1, dtype=np.int64)
     comm.bcast(n, root=root)
     payload = np.empty(int(n[0]), dtype=np.uint8)
     comm.bcast(payload, root=root)
-    return json.loads(payload.tobytes().decode())
+    return _arr_to_json(payload)
 
 
 def bridge_agree(local_comm: Comm, leader: int, exchange) -> dict:
@@ -83,6 +93,17 @@ def _xchg_i64(comm: Comm, peer: int, tag: int, arr: np.ndarray) -> np.ndarray:
     comm.recv(out, peer, tag)
     sreq.wait()
     return out
+
+
+def _xchg_json(comm: Comm, peer: int, tag: int, obj: dict) -> dict:
+    """Leader bridge: exchange json payloads with ``peer`` (for
+    structured headers — member lists plus node topology)."""
+    sreq = comm.isend(_json_to_arr(obj), peer, tag)
+    st = comm.probe(peer, tag)
+    out = np.empty(st.count, dtype=np.uint8)
+    comm.recv(out, peer, tag)
+    sreq.wait()
+    return _arr_to_json(out)
 
 
 class Intercomm(Comm):
@@ -352,16 +373,24 @@ def intercomm_create(local_comm: Comm, local_leader: int,
     private = local_comm.dup()
 
     def exchange(lmax: int) -> dict:
-        msg = np.array([lmax] + list(private.group.world_ranks),
-                       dtype=np.int64)
-        other = _xchg_i64(peer_comm, remote_leader, tag, msg)
-        return {"ctx": max(lmax, int(other[0])),
-                "remote": [int(x) for x in other[1:]]}
+        # members AND their node identities travel the bridge: the other
+        # side's ranks may have never met these procs (a spawn from
+        # COMM_SELF leaves the non-spawners blind — spawn/spaiccreate.c)
+        # and need the topology to route (is_local / channel choice)
+        mine = {"max": lmax,
+                "members": [int(w) for w in private.group.world_ranks],
+                "nodes": [u.node_name_of(int(w))
+                          for w in private.group.world_ranks]}
+        other = _xchg_json(peer_comm, remote_leader, tag, mine)
+        return {"ctx": max(lmax, int(other["max"])),
+                "remote": [int(x) for x in other["members"]],
+                "rnodes": list(other["nodes"])}
 
     hdr = bridge_agree(private, local_leader, exchange)
     ctx, remote_ranks = int(hdr["ctx"]), hdr["remote"]
     if u.world_rank in remote_ranks:
         raise MPIException(MPI_ERR_COMM,
                            "intercomm_create groups overlap")
+    u.learn_procs(zip(remote_ranks, hdr.get("rnodes", [])))
     return Intercomm(u, private.group, Group(remote_ranks), ctx, private,
                      name="intercomm")

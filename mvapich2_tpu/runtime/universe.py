@@ -118,22 +118,63 @@ class Universe:
         name->id table on every rank, so all ranks extend identically and
         node-aware (2-level) collectives stay consistent. Unknown names
         get fresh ids deterministically (same inputs everywhere)."""
-        m = self.node_name_to_id
-        # ids for procs we never heard of (gaps from sibling spawns):
-        # unique negatives, so is_local() is never wrongly true
-        while len(self.node_ids) < base:
-            self.node_ids.append(-1000 - len(self.node_ids))
-        fresh = max(max(self.node_ids, default=0),
-                    max(m.values(), default=0)) + 1
+        if base > 0:
+            self._grow_proc_table(base - 1)
         for i, name in enumerate(node_names):
-            if name not in m:
-                m[name] = fresh
-                fresh += 1
             pid = base + i
+            nid = self._intern_node(name)
             if pid < len(self.node_ids):
-                self.node_ids[pid] = m[name]
+                self.node_ids[pid] = nid
             else:
-                self.node_ids.append(m[name])
+                self.node_ids.append(nid)
+
+    def node_name_of(self, pid: int) -> str:
+        """Canonical node name for a proc id — for shipping process
+        topology across an intercomm bridge (intercomm_create between
+        groups that have never met, e.g. spawn/spaiccreate.c: the
+        non-spawning ranks must learn where the spawned procs live).
+        Falls back to a deterministic synthetic name for nodes that
+        were never named (the bootstrap name table is identical on
+        every rank, so the fallback is too)."""
+        nid = self.node_ids[pid] if 0 <= pid < len(self.node_ids) else None
+        if nid is not None:
+            for name, i in self.node_name_to_id.items():
+                if i == nid:
+                    return name
+            return f"__node_{nid}"   # the local_universe/spawn convention
+        return f"__proc_{pid}"
+
+    def _grow_proc_table(self, pid: int) -> None:
+        """Gap-fill to cover ``pid`` (unique negatives so is_local is
+        never wrongly true) — shared by extend_procs and learn_procs so
+        the cross-rank identical-tables invariant has ONE formula."""
+        while len(self.node_ids) <= pid:
+            self.node_ids.append(-1000 - len(self.node_ids))
+
+    def _intern_node(self, name: str) -> int:
+        m = self.node_name_to_id
+        if name not in m:
+            m[name] = max(max(self.node_ids, default=0),
+                          max(m.values(), default=0)) + 1
+        return m[name]
+
+    def learn_procs(self, pairs) -> None:
+        """Extend the proc table with (proc_id, node_name) pairs learned
+        from a peer group (the intercomm-create analog of
+        extend_procs). Idempotent; same inputs give the same table on
+        every rank."""
+        for pid, name in pairs:
+            self._grow_proc_table(pid)
+            if name not in self.node_name_to_id \
+                    and name.startswith("__node_") \
+                    and name[7:].lstrip("-").isdigit():
+                # synthetic id-carrying name (node_name_of fallback;
+                # ids agree across ranks). A user-chosen name that
+                # merely LOOKS like one but has a non-numeric suffix
+                # falls through to normal interning.
+                self.node_ids[pid] = int(name[7:])
+                continue
+            self.node_ids[pid] = self._intern_node(name)
 
     def num_nodes(self) -> int:
         return len(set(self.node_ids))
