@@ -584,53 +584,125 @@ class Comm:
                      for g in range(m)}
         # AND-combine the members' availability masks (the same
         # MPIR_Get_contextid discipline allocate_context_id runs over a
-        # full comm, here as binomial reduce+bcast over group members)
-        val = self.u.ctx_mask().copy()
-        other = np.empty_like(val)
-        # binomial reduce (bitwise AND) to group rank 0
-        mask = 1
-        while mask < m:
-            if me & mask:
-                self.send(val, parent_of[me & ~mask], tag)
+        # full comm, here as binomial reduce+bcast over group members,
+        # carrying the guarded payload so concurrent-thread agreements
+        # on other comms force a collective retry instead of a
+        # duplicate id — threads/comm/comm_create_group_threads)
+        while True:
+            val, own = self.u.ctx_payload()
+            try:
+                other = np.empty_like(val)
+                # binomial reduce (bitwise AND) to group rank 0
+                mask = 1
+                while mask < m:
+                    if me & mask:
+                        self.send(val, parent_of[me & ~mask], tag)
+                        break
+                    partner = me | mask
+                    if partner < m:
+                        self.recv(other, parent_of[partner], tag)
+                        val &= other
+                    mask <<= 1
+                # binomial bcast of the agreed payload from group rank 0
+                mask = 1
+                while mask < m:
+                    if me & mask:
+                        self.recv(val, parent_of[me - mask], tag)
+                        break
+                    mask <<= 1
+                mask >>= 1
+                while mask > 0:
+                    if me + mask < m:
+                        self.send(val, parent_of[me + mask], tag)
+                    mask >>= 1
+            except BaseException:
+                self.u.ctx_release(own)
+                raise
+            ctx = self.u.ctx_resolve(val, own)
+            if ctx >= 0:
                 break
-            partner = me | mask
-            if partner < m:
-                self.recv(other, parent_of[partner], tag)
-                val &= other
-            mask <<= 1
-        # binomial bcast of the agreed ctx from group rank 0
-        mask = 1
-        while mask < m:
-            if me & mask:
-                self.recv(val, parent_of[me - mask], tag)
-                break
-            mask <<= 1
-        mask >>= 1
-        while mask > 0:
-            if me + mask < m:
-                self.send(val, parent_of[me + mask], tag)
-            mask >>= 1
-        from ..runtime.universe import CTX_MASK_BASE, _lowest_bit
-        bit = _lowest_bit(val)
-        if bit < 0:
-            from .errors import MPI_ERR_OTHER
-            raise MPIException(MPI_ERR_OTHER, "out of context ids")
-        self.u.ctx_mask()[bit // 64] &= np.uint64(~np.uint64(1 << (bit % 64)))
-        ctx = CTX_MASK_BASE + 2 * bit
+            import time
+            time.sleep(0.0002)
         return Comm(self.u, group, ctx, self.name + "_create_group", self)
+
+    def _plane_gather(self, payload: np.ndarray) -> Optional[np.ndarray]:
+        """Allgather one small fixed-size record from every member
+        through the C engine (cp_coll_gather) in a single ctypes call —
+        the comm-management control collectives are latency-bound chains
+        of tiny messages, and per-STEP interpreter frames are what makes
+        split/free churn (comm/ctxsplit.c) miss the suite budget.
+        Returns the (size, paysz) table, or None when the plane can't
+        take it (caller runs the stepped python algorithms)."""
+        pc = self.u.plane_channel
+        if (pc is None or not pc.plane or self.is_inter
+                or not self._plane_owned or self.size > 64):
+            return None
+        payload = np.ascontiguousarray(payload).view(np.uint8).reshape(-1)
+        paysz = payload.nbytes
+        cap = pc.plane_eager_max()
+        if cap and paysz > cap:
+            return None
+        rings = np.array([pc.local_index[w]
+                          for w in self.group.world_ranks],
+                         dtype=np.int32)
+        table = np.empty((self.size, paysz), dtype=np.uint8)
+        lib = pc._ring.lib
+        rc = lib.cp_coll_gather(pc.plane, self.ctx_coll, self.rank,
+                                self.size, rings.ctypes.data,
+                                payload.ctypes.data, paysz,
+                                table.ctypes.data)
+        if rc == -2:
+            from ..core.errors import MPIX_ERR_PROC_FAILED
+            raise MPIException(MPIX_ERR_PROC_FAILED,
+                               "peer failed during comm-management "
+                               "collective")
+        if rc != 0:
+            return None
+        return table
 
     def split(self, color: int, key: int = 0) -> Optional["Comm"]:
         self._check()
-        # allgather (color, key, world_rank) triples, then bucket
-        mine = np.array([color if color is not None else UNDEFINED, key,
-                         self.u.world_rank], dtype=np.int64)
-        allv = np.empty(3 * self.size, dtype=np.int64)
-        self.allgather(mine, allv, count=3)
-        ctx = self.u.allocate_context_id(self)
-        my_color = int(mine[0])
+        my_color = int(color) if color is not None else UNDEFINED
+        mine = np.array([my_color, key, self.u.world_rank],
+                        dtype=np.int64)
+        # fused agreement: ONE plane gather carries the (color, key,
+        # world) triple AND the guarded context-id payload, replacing
+        # the allgather + mask-allreduce pair (the same information the
+        # reference moves in MPIR_Comm_split_impl + MPIR_Get_contextid,
+        # commutil.c — here one C-engine round per attempt)
+        allv = None
+        ctx = -1
+        while ctx < 0:
+            pay, own = self.u.ctx_payload()
+            try:
+                fused = np.empty(3 + len(pay), dtype=np.uint64)
+                fused[:3] = mine.view(np.uint64)
+                fused[3:] = pay
+                table = self._plane_gather(fused)
+            except BaseException:
+                self.u.ctx_release(own)
+                raise
+            if table is None:
+                # stepped fallback: allgather triples, then the mask
+                # agreement collective (release the mask first — the
+                # stepped path takes it again per attempt)
+                self.u.ctx_release(own)
+                allv = np.empty(3 * self.size, dtype=np.int64)
+                self.allgather(mine, allv, count=3)
+                ctx = self.u.allocate_context_id(self)
+                if my_color == UNDEFINED:
+                    # UNDEFINED color burns no budget (see create())
+                    self.u.release_context_id(ctx)
+                break
+            rows = table.view(np.uint64).reshape(self.size, -1)
+            allv = rows[:, :3].copy().view(np.int64).reshape(-1)
+            agreed = np.bitwise_and.reduce(rows[:, 3:], axis=0)
+            ctx = self.u.ctx_resolve(agreed, own,
+                                     claim=my_color != UNDEFINED)
+            if ctx < 0:
+                import time
+                time.sleep(0.0002)
         if my_color == UNDEFINED:
-            # UNDEFINED color burns no budget (see create())
-            self.u.release_context_id(ctx)
             return None
         members = []
         for r in range(self.size):

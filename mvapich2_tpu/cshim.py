@@ -958,10 +958,12 @@ def win_flush(wh: int, rank: int) -> int:
 
 
 def _dt_obj(dtcode: int):
-    """Datatype object for a C type code (basic or derived)."""
-    if dtcode >= _DERIVED_BASE:
-        return _derived[dtcode]
-    return dt.from_numpy_dtype(np.dtype(_DTYPES[dtcode]))
+    """Datatype object for a C type code — one resolver (_dt) for the
+    whole shim so pair types always carry their CANONICAL typemaps
+    (size 20 for LONG_DOUBLE_INT), never the padded numpy struct
+    layout; RMA accumulate restaging depends on signature-packed
+    sizes (rma/atomic_get.c)."""
+    return _dt(dtcode)
 
 
 def _rma_args(oview, count: int, dtcode: int):
@@ -977,7 +979,12 @@ def _rma_args(oview, count: int, dtcode: int):
             return _bottom_gather(count, dtcode, _view_addr(oview)), {}
         return (np.frombuffer(oview, np.uint8),
                 {"count": count, "origin_dt": _derived[dtcode]})
-    return _arr(oview, count, dtcode), {}
+    # predefined types also carry their canonical typemap: re-deriving
+    # from the numpy dtype would widen pair types to the PADDED struct
+    # layout (LONG_DOUBLE_INT 20 -> 32 bytes) and corrupt accumulate
+    # restaging at the target (rma/atomic_get.c)
+    return _arr(oview, count, dtcode), \
+        {"count": count, "origin_dt": _dt_obj(dtcode)}
 
 
 def put(wh: int, oview, count: int, dtcode: int, target: int,
@@ -1655,15 +1662,20 @@ def get_accumulate(wh: int, oview, rview, ocount: int, odtcode: int,
     same as send/recv/put/get: gather to packed bytes before the call,
     scatter after it completes (the wrapper is blocking)."""
     rd = _dt_obj(rdtcode)
-    od = _dt_obj(odtcode)
     td = _dt_obj(tdtcode)
-    if oview and _needs_abs(oview, ocount, odtcode):
+    if odtcode < 0:
+        # MPI_NO_OP: origin triple is ignored per MPI-3.1 §11.3.4 and
+        # arrives as MPI_DATATYPE_NULL (rma/get_accumulate.c's GACC/
+        # NO_OP rounds)
+        obuf, od, ocount = None, None, 0
+    elif oview and _needs_abs(oview, ocount, odtcode):
         obuf = _bottom_gather(ocount, odtcode, _view_addr(oview))
         od, ocount = dt.create_contiguous(len(obuf), dt.BYTE), 1
     elif not oview and odtcode >= _DERIVED_BASE and ocount:
         obuf = _bottom_gather(ocount, odtcode)       # MPI_BOTTOM origin
         od, ocount = dt.create_contiguous(len(obuf), dt.BYTE), 1
     else:
+        od = _dt_obj(odtcode)
         obuf = np.frombuffer(oview, np.uint8) if oview else None
     abs_r = (_needs_abs(rview, rcount, rdtcode)
              or (not rview and rdtcode >= _DERIVED_BASE and rcount))
@@ -1686,11 +1698,16 @@ def get_accumulate(wh: int, oview, rview, ocount: int, odtcode: int,
 
 def fetch_and_op(wh: int, oview, rview, dtcode: int, target: int,
                  tdisp: int, opcode: int) -> int:
-    # NULL origin is legal for MPI_NO_OP (empty-bytes at the boundary)
+    # NULL origin is legal for MPI_NO_OP (empty-bytes at the boundary).
+    # The MPI handle's canonical typemap must ride along: resolving
+    # from the numpy struct dtype instead would widen pair types to
+    # their PADDED layout (LONG_DOUBLE_INT 20 -> 32 bytes) and corrupt
+    # the RMW restaging (rma/atomic_get.c Test #1/#2).
     obuf = _arr(oview, 1, dtcode) if oview else \
         np.zeros(1, _DTYPES[dtcode])
     rbuf = _arr(rview, 1, dtcode)
-    _wins[wh].fetch_and_op(obuf, rbuf, target, tdisp, op=_OPS[opcode])
+    _wins[wh].fetch_and_op(obuf, rbuf, target, tdisp, op=_OPS[opcode],
+                           datatype=_dt_obj(dtcode))
     return 0
 
 
@@ -1699,7 +1716,8 @@ def compare_and_swap(wh: int, oview, cview, rview, dtcode: int,
     obuf = _arr(oview, 1, dtcode)
     cbuf = _arr(cview, 1, dtcode)
     rbuf = _arr(rview, 1, dtcode)
-    _wins[wh].compare_and_swap(obuf, cbuf, rbuf, target, tdisp)
+    _wins[wh].compare_and_swap(obuf, cbuf, rbuf, target, tdisp,
+                               datatype=_dt_obj(dtcode))
     return 0
 
 

@@ -72,6 +72,8 @@ class Universe:
         self.comm_self = None
         self._next_ctx = 8  # 0/1: world pt2pt/coll, 2/3: self, 4+: spare
         self._ctx_mask = None   # lazily sized (ctx_mask())
+        self._ctx_lock = threading.Lock()
+        self._ctx_busy = False  # one agreement in flight per process
         self.finalized = False
         self.initialized = False
         self.windows: Dict[int, object] = {}      # win_id -> Win (RMA)
@@ -222,34 +224,98 @@ class Universe:
         if w < len(self._ctx_mask):
             self._ctx_mask[w] |= np.uint64(1 << b)
 
+    def ctx_payload(self):
+        """One agreement attempt's contribution: mask words + a guard
+        word, under the MPIR_Get_contextid thread protocol
+        (mpir_context_id.c): at most one thread per process owns the
+        live mask during an agreement; a contending thread contributes
+        an EMPTY mask and a ZERO guard. BAND semantics then make every
+        member see an empty agreed mask with guard 0 — the collective
+        "retry together" verdict — while guard all-ones with an empty
+        mask is genuine exhaustion. Returns (payload, owns_mask)."""
+        import numpy as np
+        mask = self.ctx_mask()
+        pay = np.empty(len(mask) + 1, dtype=np.uint64)
+        with self._ctx_lock:
+            if self._ctx_busy:
+                pay[:] = 0
+                return pay, False
+            self._ctx_busy = True
+        pay[:len(mask)] = mask
+        pay[len(mask)] = np.uint64(0xFFFFFFFFFFFFFFFF)
+        return pay, True
+
+    def ctx_release(self, own: bool) -> None:
+        """Drop the mask-holder flag after a FAILED agreement attempt
+        (peer death mid-collective): without this, an exception between
+        ctx_payload and ctx_resolve would leave _ctx_busy stuck and
+        wedge every later agreement in this process."""
+        if own:
+            with self._ctx_lock:
+                self._ctx_busy = False
+
+    def ctx_resolve(self, agreed, own: bool, claim: bool = True) -> int:
+        """Resolve an AGREED [mask..., guard] payload to a context id.
+        Returns -1 when some process's mask was thread-held (the whole
+        collective retries together — the verdict is a pure function of
+        the agreed payload, so every member reaches it identically);
+        raises on true exhaustion (errors/comm/too_many_comms.c expects
+        the error on all ranks); ``claim`` clears the bit in this
+        rank's own mask (non-members of a split skip the claim)."""
+        import numpy as np
+        bit = _lowest_bit(agreed[:-1])
+        with self._ctx_lock:
+            if own:
+                self._ctx_busy = False
+            if bit >= 0:
+                if claim:
+                    w, b = divmod(bit, 64)
+                    self._ctx_mask[w] &= np.uint64(~np.uint64(1 << b))
+                return CTX_MASK_BASE + 2 * bit
+        if int(agreed[-1]) == 0:
+            return -1
+        from ..core.errors import MPIException, MPI_ERR_OTHER
+        raise MPIException(
+            MPI_ERR_OTHER,
+            "out of context ids (MV2T_MAX_CONTEXTS="
+            f"{(len(agreed) - 1) * 64})")
+
     def allocate_context_id(self, parent_comm) -> int:
         """Collective over parent_comm: agree on a fresh context id —
         allreduce-BAND of the members' availability masks, lowest common
-        free bit wins (the reference's MPIR_Get_contextid protocol)."""
+        free bit wins (the reference's MPIR_Get_contextid protocol).
+        Plane-owned comms run the agreement as ONE C-engine gather
+        (cp_coll_gather) and AND the columns locally."""
         import numpy as np
+        import time
         from ..coll import algorithms as alg
         from ..core import op as opmod
-        mine = self.ctx_mask().copy()
-        # fixed base algorithm, NOT the tunable dispatch: a forced
-        # two-level algorithm would re-enter build_2level -> split ->
-        # allocate_context_id here (the reference likewise runs the
-        # context-id protocol on its own reserved path, MPIR_Get_contextid)
-        out = alg.allreduce_recursive_doubling(
-            parent_comm, mine, opmod.BAND, parent_comm.next_coll_tag())
-        bit = _lowest_bit(out)
-        if bit < 0:
-            # exhaustion is judged AFTER the agreement collective so
-            # every member reaches the identical verdict (a local
-            # pre-check could diverge and deadlock the allreduce) —
-            # errors/comm/too_many_comms.c expects this error
-            from ..core.errors import MPIException, MPI_ERR_OTHER
-            raise MPIException(
-                MPI_ERR_OTHER,
-                "out of context ids (MV2T_MAX_CONTEXTS="
-                f"{len(mine) * 64})")
-        w, b = divmod(bit, 64)
-        self._ctx_mask[w] &= np.uint64(~np.uint64(1 << b))
-        return CTX_MASK_BASE + 2 * bit
+        while True:
+            pay, own = self.ctx_payload()
+            try:
+                gather = getattr(parent_comm, "_plane_gather", None)
+                table = gather(pay) if gather is not None else None
+                if table is not None:
+                    agreed = np.bitwise_and.reduce(
+                        table.view(np.uint64)
+                        .reshape(parent_comm.size, -1), axis=0)
+                else:
+                    # fixed base algorithm, NOT the tunable dispatch: a
+                    # forced two-level algorithm would re-enter
+                    # build_2level -> split -> allocate_context_id here
+                    # (the reference likewise runs the context-id
+                    # protocol on its own reserved path,
+                    # MPIR_Get_contextid)
+                    agreed = alg.allreduce_recursive_doubling(
+                        parent_comm, pay, opmod.BAND,
+                        parent_comm.next_coll_tag())
+            except BaseException:
+                self.ctx_release(own)
+                raise
+            ctx = self.ctx_resolve(agreed, own)
+            if ctx >= 0:
+                return ctx
+            time.sleep(0.0002)   # let the mask-holding thread finish
 
     def mark_failed(self, world_rank: int) -> None:
         """Record a process failure (detection sink — SURVEY §5.3)."""

@@ -157,6 +157,10 @@ def _bind_cplane(lib) -> None:
                                        L.c_int, L.c_int, L.c_int]
     lib.cp_error_req.argtypes = [L.c_void_p, L.c_longlong, L.c_int]
     lib.cp_advance.argtypes = [L.c_void_p]
+    lib.cp_coll_gather.restype = L.c_int
+    lib.cp_coll_gather.argtypes = [L.c_void_p, L.c_int, L.c_int, L.c_int,
+                                   L.c_void_p, L.c_void_p, L.c_long,
+                                   L.c_void_p]
     lib.cp_py_pending.argtypes = [L.c_void_p]
     lib.cp_py_peek.restype = L.c_long
     lib.cp_py_peek.argtypes = [L.c_void_p]

@@ -40,6 +40,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <vector>
 
 #include <fcntl.h>
 #include <pthread.h>
@@ -1598,6 +1599,81 @@ int cp_wait_quantum(void* cp, long long req, long spin_us, long block_ms) {
   }
   if (p->flags) p->flags[p->me] = 0;
   return 0;
+}
+
+/* Control-plane allgather: one fixed-size record per member, executed
+ * wholly in C under a single ctypes call. The comm-management
+ * collectives — MPI_Comm_split's (color,key,world) exchange fused with
+ * the MPIR_Get_contextid mask agreement (the reference's protocol at
+ * src/mpi/comm/commutil.c) — are latency-bound chains of tiny
+ * messages; crossing the interpreter once per SPLIT instead of once
+ * per STEP is what lets split/free churn (test/mpi/comm/ctxsplit.c's
+ * 100k iterations) fit the suite budget. All-to-all broadcast shape:
+ * n-1 posted receives keyed (cctx, comm-rank, tag), n-1 eager sends,
+ * then the shared wait-quantum discipline.
+ * Returns 0 ok; -1 = not taken, and ONLY from the pre-checks before
+ * any message moves (caller falls back to the python path); -2 = peer
+ * failure mid-exchange (caller raises MPIX_ERR_PROC_FAILED). */
+int cp_coll_gather(void* cp, int cctx, int rank, int n, const int* rings,
+                   const void* mine, long paysz, void* table) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (p == nullptr || n <= 0 || rank < 0 || rank >= n || paysz <= 0)
+    return -1;
+  uint8_t* tab = static_cast<uint8_t*>(table);
+  memcpy(tab + static_cast<size_t>(rank) * paysz, mine, paysz);
+  if (n == 1) return 0;
+  /* The not-taken verdict must be failure-consistent across members:
+   * gating on the PROCESS-global g_any_failed would let one member
+   * (whose detector fired for some unrelated rank) take the python
+   * path while the rest wait here for its record. Check only THIS
+   * comm's members: a known-dead member means the python layer's ULFM
+   * semantics own the operation, and a member that proceeds anyway
+   * unwinds with -2 when its send or wait meets the same failure. */
+  for (int r = 0; r < n; r++) {
+    if (rings[r] < 0 || rings[r] >= p->n_local) return -1;
+    if (r != rank && p->failed[rings[r]]) return -1;
+  }
+  int tag = cp_coll_tag(cp, cctx);
+  static std::atomic<long long> g_gather_sreq{3LL << 60};
+  std::vector<long long> rids(n, -1);
+  for (int r = 0; r < n; r++) {
+    if (r == rank) continue;
+    rids[r] = cp_irecv(cp, tab + static_cast<size_t>(r) * paysz, paysz,
+                       cctx, r, tag);
+  }
+  int rc = 0;
+  for (int r = 0; r < n && rc == 0; r++) {
+    if (r == rank) continue;
+    for (;;) {
+      long long s = cp_send_eager(cp, rings[r], cctx, rank, tag, mine,
+                                  paysz, g_gather_sreq.fetch_add(1));
+      if (s == 0) break;
+      if (s == -2 || cp_rank_failed(cp, rings[r])) {
+        rc = -2;
+        break;
+      }
+      /* ring toward the peer is full: drain our own rx side (the
+       * peer may be wedged on ITS sends to us) and retry */
+      cp_advance(cp);
+      struct timespec ts = {0, 50000};
+      nanosleep(&ts, nullptr);
+    }
+  }
+  long spin = 40;
+  for (int r = 0; r < n; r++) {
+    if (r == rank || rids[r] < 0) continue;
+    while (rc == 0 && cp_req_state(cp, rids[r]) != 2) {
+      cp_wait_quantum(cp, rids[r], spin, 2);
+      if (spin < 200) spin += 8;
+      if (cp_req_state(cp, rids[r]) != 2 &&
+          cp_rank_failed(cp, rings[r]))
+        rc = -2;
+    }
+    if (rc != 0)
+      cp_cancel_recv(cp, rids[r]);
+    cp_req_free(cp, rids[r]);
+  }
+  return rc;
 }
 
 }  // extern "C"

@@ -1992,8 +1992,10 @@ int MPI_Fetch_and_op(const void *origin, void *result, MPI_Datatype dt,
                      int target_rank, MPI_Aint target_disp, MPI_Op op,
                      MPI_Win win) {
     PyGILState_STATE st = PyGILState_Ensure();
-    PyObject *ov = mv_view(origin, dt_size(dt));
-    PyObject *rv = mv_view(result, dt_size(dt));
+    /* span, not size: pair types (LONG_DOUBLE_INT) have padded
+     * extents, and the shim views one full element (rma/atomic_get.c) */
+    PyObject *ov = mv_view(origin, dt_span_b(dt, 1));
+    PyObject *rv = mv_view(result, dt_span_b(dt, 1));
     PyObject *res = PyObject_CallMethod(g_shim, "fetch_and_op",
                                         "(iOOiiLi)", win, ov, rv, dt,
                                         target_rank,

@@ -3619,7 +3619,14 @@ int MPI_Win_shared_query(MPI_Win win, int rank, MPI_Aint *size,
         PyObject *mv;
         if (PyArg_ParseTuple(res, "LiO", &sz, &du, &mv)) {
             Py_buffer b;
-            if (PyObject_GetBuffer(mv, &b, PyBUF_SIMPLE) == 0) {
+            if (sz == 0) {
+                /* zero-size contribution: NULL base, per the shared-
+                 * query contract (rma/win_shared_noncontig_put.c:78) */
+                *(void **)baseptr = NULL;
+                *size = 0;
+                *disp_unit = du;
+                rc = MPI_SUCCESS;
+            } else if (PyObject_GetBuffer(mv, &b, PyBUF_SIMPLE) == 0) {
                 *(void **)baseptr = b.buf;
                 PyBuffer_Release(&b);
                 *size = (MPI_Aint)sz;

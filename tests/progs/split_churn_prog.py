@@ -1,0 +1,65 @@
+"""Split/free churn across real rank processes (comm/ctxsplit.c's
+shape): exercises the fused native agreement (cp_coll_gather — one
+C-engine gather carrying color/key/world + the guarded context-id
+payload) plus id recycling through Comm.free.
+
+Launched by tests via: python -m mvapich2_tpu.run -np N <this file> [iters]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from mvapich2_tpu import mpi  # noqa: E402
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+rank, size = comm.rank, comm.size
+
+errs = 0
+iters = int(sys.argv[1]) if len(sys.argv) > 1 else 300
+
+ids = set()
+t0 = time.perf_counter()
+for i in range(iters):
+    # same color everywhere, key=-rank: full comm, reversed order
+    sub = comm.split(i % 3, key=-rank)
+    if sub is None or sub.size != size or sub.rank != size - 1 - rank:
+        errs += 1
+        print(f"rank {rank}: bad split result at iter {i}")
+        break
+    ids.add(sub.context_id)
+    got = sub.allreduce(np.array([1], np.int64))
+    if got[0] != size:
+        errs += 1
+        print(f"rank {rank}: allreduce on split comm wrong: {got[0]}")
+        break
+    sub.free()
+    # mixed membership: alternating ranks sit out with UNDEFINED
+    part = comm.split(None if (rank + i) % 2 else 1)
+    if (rank + i) % 2:
+        if part is not None:
+            errs += 1
+            print(f"rank {rank}: UNDEFINED split returned a comm")
+            break
+    else:
+        if part is None or part.size != (size + 1 - (i % 2)) // 2:
+            errs += 1
+            print(f"rank {rank}: partial split wrong size")
+            break
+        part.free()
+elapsed = time.perf_counter() - t0
+
+# freed ids recycle through the availability mask: the churn must reuse
+# a tiny pool, not grow with the iteration count
+if len(ids) > 8:
+    errs += 1
+    print(f"rank {rank}: context ids leaked: {len(ids)} distinct")
+
+comm.barrier()
+if rank == 0 and errs == 0:
+    print(f"No Errors ({iters} split/free in {elapsed:.2f}s)")
+mpi.Finalize()
+sys.exit(1 if errs else 0)

@@ -55,6 +55,23 @@ def test_split_key_reorders():
     run_ranks(4, fn)
 
 
+def test_split_free_churn():
+    """comm/ctxsplit.c's discipline: split/free loops must recycle
+    context ids through the fused plane gather (cp_coll_gather) without
+    leaking, including UNDEFINED rounds where nobody claims the bit."""
+    def fn(comm):
+        ids = set()
+        for i in range(60):
+            sub = comm.split(1, key=comm.rank)
+            assert sub.size == comm.size and sub.rank == comm.rank
+            ids.add(sub.context_id)
+            sub.free()
+            assert comm.split(None) is None
+        # freed ids return to the mask: the loop reuses a tiny pool
+        assert len(ids) <= 4
+    run_ranks(4, fn)
+
+
 def test_comm_create():
     def fn(comm):
         g = comm.group if hasattr(comm, 'group') else None

@@ -34,6 +34,18 @@ def test_mpirun_fake_nodes_two_level():
     assert "No Errors" in r.stdout
 
 
+def test_split_churn_over_plane():
+    """comm/ctxsplit.c's split/free churn across real rank processes:
+    the fused cp_coll_gather agreement plus context-id recycling."""
+    prog = os.path.join(REPO, "tests", "progs", "split_churn_prog.py")
+    cmd = [sys.executable, "-m", "mvapich2_tpu.run", "-np", "4",
+           sys.executable, prog, "200"]
+    r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+
+
 def test_mpirun_failing_rank_kills_job():
     prog = os.path.join(REPO, "tests", "progs", "die_prog.py")
     cmd = [sys.executable, "-m", "mvapich2_tpu.run", "-np", "2",
