@@ -580,6 +580,10 @@ class Comm:
         if me == UNDEFINED:
             return None
         m = group.size
+        if m == 1:
+            # single-member: no agreement (see alloc_context_local)
+            return Comm(self.u, group, self.u.alloc_context_local(),
+                        self.name + "_create_group", self)
         parent_of = {g: self.group.rank_of_world(group.world_of_rank(g))
                      for g in range(m)}
         # AND-combine the members' availability masks (the same
@@ -588,8 +592,9 @@ class Comm:
         # carrying the guarded payload so concurrent-thread agreements
         # on other comms force a collective retry instead of a
         # duplicate id — threads/comm/comm_create_group_threads)
+        key = (self.context_id, tag)
         while True:
-            val, own = self.u.ctx_payload()
+            val, own = self.u.ctx_payload(key)
             try:
                 other = np.empty_like(val)
                 # binomial reduce (bitwise AND) to group rank 0
@@ -616,9 +621,9 @@ class Comm:
                         self.send(val, parent_of[me + mask], tag)
                     mask >>= 1
             except BaseException:
-                self.u.ctx_release(own)
+                self.u.ctx_release(own, key, done=True)
                 raise
-            ctx = self.u.ctx_resolve(val, own)
+            ctx = self.u.ctx_resolve(val, own, key)
             if ctx >= 0:
                 break
             import time
@@ -670,23 +675,31 @@ class Comm:
         # the allgather + mask-allreduce pair (the same information the
         # reference moves in MPIR_Comm_split_impl + MPIR_Get_contextid,
         # commutil.c — here one C-engine round per attempt)
+        if self.size == 1:
+            # single-member: no agreement (see alloc_context_local)
+            if my_color == UNDEFINED:
+                return None
+            return Comm(self.u, Group([self.u.world_rank]),
+                        self.u.alloc_context_local(),
+                        f"{self.name}_split", self)
         allv = None
         ctx = -1
+        agree_key = (self.context_id, 0)
         while ctx < 0:
-            pay, own = self.u.ctx_payload()
+            pay, own = self.u.ctx_payload(agree_key)
             try:
                 fused = np.empty(3 + len(pay), dtype=np.uint64)
                 fused[:3] = mine.view(np.uint64)
                 fused[3:] = pay
                 table = self._plane_gather(fused)
             except BaseException:
-                self.u.ctx_release(own)
+                self.u.ctx_release(own, agree_key, done=True)
                 raise
             if table is None:
                 # stepped fallback: allgather triples, then the mask
                 # agreement collective (release the mask first — the
                 # stepped path takes it again per attempt)
-                self.u.ctx_release(own)
+                self.u.ctx_release(own, agree_key, done=True)
                 allv = np.empty(3 * self.size, dtype=np.int64)
                 self.allgather(mine, allv, count=3)
                 ctx = self.u.allocate_context_id(self)
@@ -697,7 +710,7 @@ class Comm:
             rows = table.view(np.uint64).reshape(self.size, -1)
             allv = rows[:, :3].copy().view(np.int64).reshape(-1)
             agreed = np.bitwise_and.reduce(rows[:, 3:], axis=0)
-            ctx = self.u.ctx_resolve(agreed, own,
+            ctx = self.u.ctx_resolve(agreed, own, agree_key,
                                      claim=my_color != UNDEFINED)
             if ctx < 0:
                 import time

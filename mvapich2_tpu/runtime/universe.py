@@ -73,7 +73,8 @@ class Universe:
         self._next_ctx = 8  # 0/1: world pt2pt/coll, 2/3: self, 4+: spare
         self._ctx_mask = None   # lazily sized (ctx_mask())
         self._ctx_lock = threading.Lock()
-        self._ctx_busy = False  # one agreement in flight per process
+        self._ctx_holder = None   # key of the agreement holding the mask
+        self._ctx_waiting = set()  # keys of locally-pending agreements
         self.finalized = False
         self.initialized = False
         self.windows: Dict[int, object] = {}      # win_id -> Win (RMA)
@@ -222,9 +223,21 @@ class Universe:
         bit = (ctx - CTX_MASK_BASE) // 2
         w, b = divmod(bit, 64)
         if w < len(self._ctx_mask):
-            self._ctx_mask[w] |= np.uint64(1 << b)
+            # under the lock: an unlocked OR would race ctx_resolve's
+            # AND in the same word and lose one of the two updates
+            with self._ctx_lock:
+                self._ctx_mask[w] |= np.uint64(1 << b)
 
-    def ctx_payload(self):
+    def _ctx_local_words(self) -> int:
+        """Words at the TOP of the mask reserved for single-member
+        allocations (alloc_context_local). Collective agreements
+        advertise these bits as unavailable (ctx_payload zeroes them),
+        so a self-comm allocated mid-agreement can never collide with
+        the id the in-flight agreement settles on — the snapshot the
+        holder sent is stale the moment another thread claims."""
+        return max(1, len(self.ctx_mask()) // 8)
+
+    def ctx_payload(self, key):
         """One agreement attempt's contribution: mask words + a guard
         word, under the MPIR_Get_contextid thread protocol
         (mpir_context_id.c): at most one thread per process owns the
@@ -232,29 +245,47 @@ class Universe:
         an EMPTY mask and a ZERO guard. BAND semantics then make every
         member see an empty agreed mask with guard 0 — the collective
         "retry together" verdict — while guard all-ones with an empty
-        mask is genuine exhaustion. Returns (payload, owns_mask)."""
+        mask is genuine exhaustion.
+
+        ``key`` = (parent context id, tag) orders contenders: the mask
+        goes to the LOWEST locally-pending key. Keys are globally
+        consistent (the same comm has the same context id everywhere),
+        so every process eventually grants the mask to the same
+        agreement and that one completes — the deadlock-avoidance rule
+        of the reference's protocol (threads/comm/comm_dup_deadlock.c
+        livelocks without it). Returns (payload, owns_mask)."""
         import numpy as np
         mask = self.ctx_mask()
         pay = np.empty(len(mask) + 1, dtype=np.uint64)
         with self._ctx_lock:
-            if self._ctx_busy:
+            self._ctx_waiting.add(key)
+            if self._ctx_holder is not None \
+                    or key != min(self._ctx_waiting):
                 pay[:] = 0
                 return pay, False
-            self._ctx_busy = True
-        pay[:len(mask)] = mask
+            self._ctx_holder = key
+            # snapshot under the lock; the reserved local-only words
+            # are advertised unavailable (see _ctx_local_words)
+            pay[:len(mask)] = mask
+            pay[len(mask) - self._ctx_local_words():len(mask)] = 0
         pay[len(mask)] = np.uint64(0xFFFFFFFFFFFFFFFF)
         return pay, True
 
-    def ctx_release(self, own: bool) -> None:
-        """Drop the mask-holder flag after a FAILED agreement attempt
-        (peer death mid-collective): without this, an exception between
-        ctx_payload and ctx_resolve would leave _ctx_busy stuck and
-        wedge every later agreement in this process."""
-        if own:
-            with self._ctx_lock:
-                self._ctx_busy = False
+    def ctx_release(self, own: bool, key, done: bool = False) -> None:
+        """Drop the mask-holder flag after a FAILED agreement attempt;
+        ``done`` additionally retires the key (success or exception —
+        a retry keeps its place in the priority queue). Without the
+        release, an exception between ctx_payload and ctx_resolve
+        would leave the holder stuck and wedge every later agreement
+        in this process."""
+        with self._ctx_lock:
+            if own:
+                self._ctx_holder = None
+            if done:
+                self._ctx_waiting.discard(key)
 
-    def ctx_resolve(self, agreed, own: bool, claim: bool = True) -> int:
+    def ctx_resolve(self, agreed, own: bool, key,
+                    claim: bool = True) -> int:
         """Resolve an AGREED [mask..., guard] payload to a context id.
         Returns -1 when some process's mask was thread-held (the whole
         collective retries together — the verdict is a pure function of
@@ -266,19 +297,50 @@ class Universe:
         bit = _lowest_bit(agreed[:-1])
         with self._ctx_lock:
             if own:
-                self._ctx_busy = False
+                self._ctx_holder = None
             if bit >= 0:
+                self._ctx_waiting.discard(key)
                 if claim:
                     w, b = divmod(bit, 64)
                     self._ctx_mask[w] &= np.uint64(~np.uint64(1 << b))
                 return CTX_MASK_BASE + 2 * bit
         if int(agreed[-1]) == 0:
             return -1
+        self.ctx_release(False, key, done=True)
         from ..core.errors import MPIException, MPI_ERR_OTHER
         raise MPIException(
             MPI_ERR_OTHER,
             "out of context ids (MV2T_MAX_CONTEXTS="
             f"{(len(agreed) - 1) * 64})")
+
+    def alloc_context_local(self) -> int:
+        """Single-member agreement (COMM_SELF dups, size-1 splits and
+        groups): no collective and no mask-holder — claim the lowest
+        local free bit under the lock. Bypassing the shared-mask hold
+        is load-bearing: threads/comm/comm_dup_deadlock.c's self-dups
+        must complete while another thread's world-scoped agreement is
+        blocked mid-collective, or the two ranks' threads deadlock
+        through each other's holders."""
+        import numpy as np
+        mask = self.ctx_mask()
+        lw = self._ctx_local_words()
+        base = len(mask) - lw
+        with self._ctx_lock:
+            # only the reserved top words: collective agreements never
+            # advertise these bits, so claiming here cannot collide
+            # with an in-flight agreement's stale snapshot
+            bit = _lowest_bit(mask[base:])
+            if bit < 0:
+                from ..core.errors import MPIException, MPI_ERR_OTHER
+                raise MPIException(
+                    MPI_ERR_OTHER,
+                    "out of single-member context ids "
+                    f"({lw * 64} reserved of MV2T_MAX_CONTEXTS="
+                    f"{len(mask) * 64})")
+            bit += base * 64
+            w, b = divmod(bit, 64)
+            self._ctx_mask[w] &= np.uint64(~np.uint64(1 << b))
+        return CTX_MASK_BASE + 2 * bit
 
     def allocate_context_id(self, parent_comm) -> int:
         """Collective over parent_comm: agree on a fresh context id —
@@ -290,8 +352,12 @@ class Universe:
         import time
         from ..coll import algorithms as alg
         from ..core import op as opmod
+        if getattr(parent_comm, "size", 0) == 1 \
+                and not getattr(parent_comm, "is_inter", False):
+            return self.alloc_context_local()
+        key = (parent_comm.context_id, 0)
         while True:
-            pay, own = self.ctx_payload()
+            pay, own = self.ctx_payload(key)
             try:
                 gather = getattr(parent_comm, "_plane_gather", None)
                 table = gather(pay) if gather is not None else None
@@ -310,9 +376,9 @@ class Universe:
                         parent_comm, pay, opmod.BAND,
                         parent_comm.next_coll_tag())
             except BaseException:
-                self.ctx_release(own)
+                self.ctx_release(own, key, done=True)
                 raise
-            ctx = self.ctx_resolve(agreed, own)
+            ctx = self.ctx_resolve(agreed, own, key)
             if ctx >= 0:
                 return ctx
             time.sleep(0.0002)   # let the mask-holding thread finish

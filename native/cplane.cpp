@@ -1665,9 +1665,14 @@ int cp_coll_gather(void* cp, int cctx, int rank, int n, const int* rings,
     while (rc == 0 && cp_req_state(cp, rids[r]) != 2) {
       cp_wait_quantum(cp, rids[r], spin, 2);
       if (spin < 200) spin += 8;
-      if (cp_req_state(cp, rids[r]) != 2 &&
-          cp_rank_failed(cp, rings[r]))
-        rc = -2;
+      if (cp_req_state(cp, rids[r]) == 2) break;
+      /* scan EVERY member, not just the awaited peer: a member that
+       * diverged to the python path (it detected a LATER member's
+       * death before we did) will never send its record — only the
+       * dead member's mark tells us why, whatever its rank order */
+      for (int m2 = 0; m2 < n && rc == 0; m2++)
+        if (m2 != rank && cp_rank_failed(cp, rings[m2]))
+          rc = -2;
     }
     if (rc != 0)
       cp_cancel_recv(cp, rids[r]);
