@@ -206,11 +206,14 @@ class Universe:
         MPIR_Get_contextid scheme (mpir_context_id.h: 2048-wide mask,
         collectively ANDed so the chosen id is free at EVERY member).
         Freed ids return to the mask (Comm.free), so dup/free loops
-        never exhaust; 2048 SIMULTANEOUS comms is the budget."""
+        never exhaust. The default budget is 2048 simultaneous comms:
+        the top eighth is reserved for single-member allocations
+        (alloc_context_local) and the rest feeds the collective
+        agreement. Floor of 128 bits so both regions always exist."""
         if self._ctx_mask is None:
             import numpy as np
             from ..utils.config import get_config
-            nbits = max(64, int(get_config()["MAX_CONTEXTS"]))
+            nbits = max(128, int(get_config()["MAX_CONTEXTS"]))
             self._ctx_mask = np.full((nbits + 63) // 64,
                                      np.uint64(0xFFFFFFFFFFFFFFFF),
                                      dtype=np.uint64)
@@ -234,8 +237,10 @@ class Universe:
         advertise these bits as unavailable (ctx_payload zeroes them),
         so a self-comm allocated mid-agreement can never collide with
         the id the in-flight agreement settles on — the snapshot the
-        holder sent is stale the moment another thread claims."""
-        return max(1, len(self.ctx_mask()) // 8)
+        holder sent is stale the moment another thread claims. Always
+        at least one word on each side (ctx_mask floors at 128 bits)."""
+        return min(max(1, len(self.ctx_mask()) // 8),
+                   len(self.ctx_mask()) - 1)
 
     def ctx_payload(self, key):
         """One agreement attempt's contribution: mask words + a guard
@@ -308,10 +313,13 @@ class Universe:
             return -1
         self.ctx_release(False, key, done=True)
         from ..core.errors import MPIException, MPI_ERR_OTHER
+        nw = len(agreed) - 1
         raise MPIException(
             MPI_ERR_OTHER,
-            "out of context ids (MV2T_MAX_CONTEXTS="
-            f"{(len(agreed) - 1) * 64})")
+            "out of collective context ids "
+            f"({(nw - self._ctx_local_words()) * 64} of "
+            f"MV2T_MAX_CONTEXTS={nw * 64}; the rest are reserved "
+            "single-member)")
 
     def alloc_context_local(self) -> int:
         """Single-member agreement (COMM_SELF dups, size-1 splits and
@@ -322,25 +330,38 @@ class Universe:
         blocked mid-collective, or the two ranks' threads deadlock
         through each other's holders."""
         import numpy as np
+        import time
         mask = self.ctx_mask()
         lw = self._ctx_local_words()
         base = len(mask) - lw
-        with self._ctx_lock:
-            # only the reserved top words: collective agreements never
-            # advertise these bits, so claiming here cannot collide
-            # with an in-flight agreement's stale snapshot
-            bit = _lowest_bit(mask[base:])
-            if bit < 0:
-                from ..core.errors import MPIException, MPI_ERR_OTHER
-                raise MPIException(
-                    MPI_ERR_OTHER,
-                    "out of single-member context ids "
-                    f"({lw * 64} reserved of MV2T_MAX_CONTEXTS="
-                    f"{len(mask) * 64})")
-            bit += base * 64
-            w, b = divmod(bit, 64)
-            self._ctx_mask[w] &= np.uint64(~np.uint64(1 << b))
-        return CTX_MASK_BASE + 2 * bit
+        while True:
+            with self._ctx_lock:
+                # the reserved top words first: collective agreements
+                # never advertise these bits, so claiming here cannot
+                # collide with an in-flight agreement's stale snapshot
+                bit = _lowest_bit(mask[base:])
+                if bit >= 0:
+                    bit += base * 64
+                elif self._ctx_holder is None:
+                    # reserved region exhausted: the shared region is
+                    # safe too while NO agreement is in flight — any
+                    # future snapshot is taken after this claim lands
+                    bit = _lowest_bit(mask[:base])
+                    if bit < 0:
+                        from ..core.errors import (MPIException,
+                                                   MPI_ERR_OTHER)
+                        raise MPIException(
+                            MPI_ERR_OTHER,
+                            "out of context ids (MV2T_MAX_CONTEXTS="
+                            f"{len(mask) * 64}, {lw * 64} reserved "
+                            "single-member)")
+                else:
+                    bit = -1    # wait out the in-flight agreement
+                if bit >= 0:
+                    w, b = divmod(bit, 64)
+                    self._ctx_mask[w] &= np.uint64(~np.uint64(1 << b))
+                    return CTX_MASK_BASE + 2 * bit
+            time.sleep(0.0002)
 
     def allocate_context_id(self, parent_comm) -> int:
         """Collective over parent_comm: agree on a fresh context id —

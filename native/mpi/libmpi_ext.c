@@ -113,7 +113,11 @@ static int shim_call_str(const char *name, char *out, int maxlen,
 
 int MPI_Alloc_mem(MPI_Aint size, MPI_Info info, void *baseptr) {
     (void)info;
-    void *p = malloc(size > 0 ? (size_t)size : 1);
+    /* zeroed, like the reference's observable behavior: its Alloc_mem
+     * lands on fresh mmap pages above the malloc threshold, and suite
+     * tests (rma/racc_local_comp.c) MAX-accumulate into windows whose
+     * backing memory they never initialize */
+    void *p = calloc(1, size > 0 ? (size_t)size : 1);
     if (p == NULL)
         return MPI_ERR_OTHER;   /* MPI_ERR_NO_MEM class */
     *(void **)baseptr = p;
