@@ -2551,6 +2551,13 @@ def query_thread() -> int:
     return mpi._provided_level
 
 
+def set_thread_level(level: int) -> int:
+    """Record what MPI_Init_thread granted so MPI_Query_thread agrees
+    (init/initstat.c checks the two answers match)."""
+    mpi._provided_level = level
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # error translation
 # ---------------------------------------------------------------------------

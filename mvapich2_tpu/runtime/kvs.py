@@ -132,10 +132,19 @@ class KVSServer:
 class KVSClient:
     """Rank-side client (the UPMI analog)."""
 
-    def __init__(self, address: str, timeout: Optional[float] = 120):
+    def __init__(self, address: str, timeout: Optional[float] = 600):
+        # 600 s READ timeout, not 120: a blocking get long-polls the
+        # server while a spawned child boots, and child startup on an
+        # oversubscribed 1-core host under concurrent jobs can exceed
+        # two minutes (threads/spawn/th_taskmaster.c under the -j2
+        # suite runner) — a true hang still surfaces through the
+        # test's own budget. The CONNECT keeps a short timeout so a
+        # dead launcher errors in seconds, not minutes.
         host, port = address.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=timeout)
+        self._sock = socket.create_connection(
+            (host, int(port)),
+            timeout=min(timeout, 60) if timeout else timeout)
+        self._sock.settimeout(timeout)
         self._f = self._sock.makefile("rwb")
         self._lock = threading.Lock()
 

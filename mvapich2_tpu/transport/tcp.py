@@ -181,9 +181,12 @@ class TcpChannel(Channel):
     def wait_fds(self):
         if self._closed:
             return []
+        # snapshot: another thread (MPI_THREAD_MULTIPLE spawn/connect,
+        # threads/spawn/th_taskmaster.c) can add a connection while the
+        # progress thread builds the fd list
         fds = [self.listener]
-        fds.extend(c.sock for c in self._in)
-        fds.extend(c.sock for c in self._out.values())
+        fds.extend(c.sock for c in list(self._in))
+        fds.extend(c.sock for c in list(self._out.values()))
         return fds
 
     def close(self) -> None:

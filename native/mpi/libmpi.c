@@ -225,10 +225,15 @@ int MPI_Init_thread(int *argc, char ***argv, int required, int *provided) {
      * embedded interpreter's GIL), matching the reference's
      * global-critical-section thread model (MPIU_THREAD_CS, SURVEY
      * §5.2) — concurrency is safe, not parallel */
+    int level = required < MPI_THREAD_MULTIPLE ? required
+                                               : MPI_THREAD_MULTIPLE;
     if (provided)
-        *provided = required < MPI_THREAD_MULTIPLE
-                    ? required : MPI_THREAD_MULTIPLE;
-    return MPI_Init(argc, argv);
+        *provided = level;
+    int rc = MPI_Init(argc, argv);
+    if (rc == MPI_SUCCESS)
+        /* record the grant so MPI_Query_thread agrees (initstat.c) */
+        shim_call_i("set_thread_level", "(i)", level);
+    return rc;
 }
 
 int MPI_Finalize(void) {
