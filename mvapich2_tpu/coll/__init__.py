@@ -1,2 +1,2 @@
-from . import algorithms, api, nonblocking, tuning
+from . import algorithms, api, nbc, nonblocking, tuning
 from .api import IN_PLACE

@@ -373,6 +373,16 @@ def reduce_scatter(comm, sendbuf, recvbuf, counts, datatype, op) -> None:
     datatype.unpack(mine, recvbuf, counts[lc.rank])
 
 
+# Nonblocking intercomm collectives do NOT run these blocking
+# algorithms on a worker thread any more: they are built as dependency
+# DAGs (leader bridge + local fan-in/broadcast, the same shapes as
+# below) and progressed event-driven by the NBC scheduler — see
+# coll/nbc/inter.py (ICOLL_FNS), dispatched from coll/nonblocking.py.
+def icoll_fns() -> Dict[str, callable]:
+    from .nbc.inter import ICOLL_FNS
+    return ICOLL_FNS
+
+
 COLL_FNS: Dict[str, callable] = {
     "barrier": barrier,
     "bcast": bcast,

@@ -1,0 +1,16 @@
+"""Event-driven nonblocking-collective scheduler subsystem.
+
+Modeled on MPICH's TSP/sched framework: schedules are DAGs of vertices
+(send / recv / local-call) with explicit dependency edges (dag.py),
+held in a per-ProgressEngine queue and advanced by request-completion
+callbacks plus a registered progress hook (engine.py). Intercomm
+schedule builders live in inter.py; the legacy phase-list ``Sched`` in
+coll/nonblocking.py is a thin facade that builds DAGs.
+
+Observability (MPI_T pvars, category "nbc"): nbc_scheds_active,
+nbc_vertices_issued, nbc_wakeups, nbc_futile_polls.
+"""
+
+from . import dag, engine, inter                                # noqa: F401
+from .dag import SchedDAG                                       # noqa: F401
+from .engine import NbcEngine, nbc_engine, start                # noqa: F401

@@ -1,0 +1,92 @@
+"""Schedule DAGs for nonblocking collectives.
+
+Analog of MPICH's TSP/sched vertex model (the generic transport in
+src/mpi/coll/transports/gentran — MPII_Genutil_vtx_t with incoming/
+outgoing edge lists): a schedule is a DAG of vertices, each a send, a
+recv, or a local call (reduce/copy/unpack), with explicit dependency
+edges instead of the barrier-separated phase lists the legacy ``Sched``
+used. Vertices become runnable when every dependency has completed; the
+engine (coll/nbc/engine.py) issues them and advances the DAG from
+request-completion callbacks.
+
+Vertex routing: every send/recv carries its own ``comm`` — one schedule
+may mix traffic over an intercommunicator's collective context and its
+private local intracomm (the leader-bridge shape of coll/nbc/inter.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+# vertex kinds; numeric order IS the issue order inside one ready batch
+# (locals prepare buffers, recvs pre-post before the matching sends go
+# out — the same discipline the legacy phase engine kept per phase)
+CALL = 0
+RECV = 1
+SEND = 2
+
+_KIND_NAMES = {CALL: "call", RECV: "recv", SEND: "send"}
+
+
+class Vertex:
+    __slots__ = ("vid", "kind", "comm", "buf", "peer", "tag", "fn", "out",
+                 "ndeps")
+
+    def __init__(self, vid: int, kind: int, comm=None, buf=None,
+                 peer: int = -1, tag: int = 0,
+                 fn: Optional[Callable[[], None]] = None):
+        self.vid = vid
+        self.kind = kind
+        self.comm = comm
+        self.buf = buf
+        self.peer = peer
+        self.tag = tag
+        self.fn = fn
+        self.out: List[int] = []     # vertices unblocked by my completion
+        self.ndeps = 0               # static in-degree
+
+    def __repr__(self):
+        return (f"Vertex({_KIND_NAMES[self.kind]} #{self.vid}, "
+                f"peer={self.peer}, deps={self.ndeps})")
+
+
+class SchedDAG:
+    """A per-rank collective schedule: this rank's vertices only (the
+    cross-rank structure is implicit in matched send/recv pairs)."""
+
+    def __init__(self):
+        self.vertices: List[Vertex] = []
+
+    # -- construction -----------------------------------------------------
+    def _add(self, v: Vertex, after: Sequence[int]) -> int:
+        for dep in after:
+            self.vertices[dep].out.append(v.vid)
+            v.ndeps += 1
+        self.vertices.append(v)
+        return v.vid
+
+    def send(self, comm, buf: np.ndarray, dest: int, tag: int,
+             after: Sequence[int] = ()) -> int:
+        """Send ``buf`` to comm rank ``dest`` over ``comm``'s collective
+        context once every vertex in ``after`` has completed."""
+        return self._add(Vertex(len(self.vertices), SEND, comm, buf, dest,
+                                tag), after)
+
+    def recv(self, comm, buf: np.ndarray, src: int, tag: int,
+             after: Sequence[int] = ()) -> int:
+        return self._add(Vertex(len(self.vertices), RECV, comm, buf, src,
+                                tag), after)
+
+    def call(self, fn: Callable[[], None],
+             after: Sequence[int] = ()) -> int:
+        """Local compute (reduce/copy/unpack) run when its deps finish."""
+        return self._add(Vertex(len(self.vertices), CALL, fn=fn), after)
+
+    # -- introspection ----------------------------------------------------
+    def roots(self) -> List[int]:
+        return [v.vid for v in self.vertices if v.ndeps == 0]
+
+    def __len__(self) -> int:
+        return len(self.vertices)

@@ -1,0 +1,258 @@
+"""Completion-driven scheduler for nonblocking-collective DAGs.
+
+Analog of MPIDU_Sched_progress (mpid_sched.c:979) rebuilt around events
+instead of polling: one ``NbcEngine`` rides each ProgressEngine, holds
+the queue of in-flight schedules, and advances them from REQUEST
+COMPLETION CALLBACKS — when a vertex's send/recv completes, the callback
+(running with the engine mutex held, from whichever thread progressed
+the engine) marks the vertex done, issues every newly-runnable vertex
+and, through ``ProgressEngine.complete_request``, rings the engine's
+doorbell (wakeup/self-pipe). A waiter blocked in ``progress_wait`` is
+therefore woken the moment a runnable vertex exists; it never sits out
+a futile-poll backoff interval the way the legacy phase engine's
+hook-only progression did (the 8 ms starvation behind the old
+coll/nbicallgather fails — see conformance/xfails history).
+
+The registered progress hook remains as (a) the safety net that issues
+any ready vertices a completion path missed and (b) the observability
+point: a poll pass that finds active schedules but advances nothing
+increments the ``nbc_futile_polls`` pvar, so starvation shows up in
+MPI_T instead of only in wall clock.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ... import mpit
+from ...core.datatype import from_numpy_dtype
+from ...core.errors import MPIException, MPI_ERR_INTERN
+from ...core.request import Request
+from .dag import CALL, RECV, SEND, SchedDAG
+
+_pv_active = mpit.pvar("nbc_scheds_active", mpit.PVAR_CLASS_LEVEL, "nbc",
+                       "nonblocking-collective schedules in flight "
+                       "(all ranks in this process)")
+_pv_issued = mpit.pvar("nbc_vertices_issued", mpit.PVAR_CLASS_COUNTER,
+                       "nbc", "schedule vertices issued (sends, recvs, "
+                       "local calls)")
+_pv_wakeups = mpit.pvar("nbc_wakeups", mpit.PVAR_CLASS_COUNTER, "nbc",
+                        "completion-driven schedule advancements (vertex "
+                        "completions that re-entered the scheduler)")
+_pv_futile = mpit.pvar("nbc_futile_polls", mpit.PVAR_CLASS_COUNTER, "nbc",
+                       "progress polls that found active schedules but "
+                       "advanced none (backoff-driven progression)")
+
+
+class _SchedState:
+    """One in-flight schedule: runtime dependency counters + requests."""
+
+    __slots__ = ("dag", "req", "remaining", "ndeps", "ready", "inflight",
+                 "advancing", "done")
+
+    def __init__(self, dag: SchedDAG, engine, kind: str):
+        self.dag = dag
+        self.req = Request(engine, kind)
+        self.remaining = len(dag.vertices)
+        self.ndeps = [v.ndeps for v in dag.vertices]
+        self.ready: List[int] = dag.roots()
+        self.inflight: Dict[int, Request] = {}   # vid -> vertex request
+        self.advancing = False
+        self.done = False
+
+
+class NbcEngine:
+    """Per-ProgressEngine schedule queue + the one registered hook."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.active: List[_SchedState] = []
+        self._gen = 0        # bumped on every advancement (issue/complete)
+        self._seen_gen = 0   # hook-side watermark for futile-poll counting
+        engine.register_hook(self._hook)
+
+    # -- entry point ------------------------------------------------------
+    def start(self, dag: SchedDAG, kind: str = "nbc-coll") -> Request:
+        eng = self.engine
+        st = _SchedState(dag, eng, kind)
+        st.req._cancel_fn = lambda: self._cancel(st)
+        with eng.mutex:
+            if not dag.vertices:
+                st.done = True
+                st.req.complete()
+                return st.req
+            self.active.append(st)
+            _pv_active.inc()
+            self._advance(st)
+        return st.req
+
+    # -- advancement (engine mutex held on every path) --------------------
+    def _advance(self, st: _SchedState) -> None:
+        """Issue every runnable vertex. Re-entrant completions (an eager
+        send or an already-matched recv finishing inside its own issue)
+        land in ``st.ready`` and are picked up by the outer loop — the
+        ``advancing`` guard keeps the recursion depth flat."""
+        if st.advancing or st.done:
+            return
+        st.advancing = True
+        try:
+            while st.ready and not st.done:
+                batch = sorted(st.ready,
+                               key=lambda vid: st.dag.vertices[vid].kind)
+                st.ready = []
+                for vid in batch:
+                    if st.done:
+                        break
+                    self._issue(st, vid)
+        finally:
+            st.advancing = False
+        if not st.done and st.remaining == 0:
+            self._complete(st, None)
+
+    def _issue(self, st: _SchedState, vid: int) -> None:
+        v = st.dag.vertices[vid]
+        _pv_issued.inc()
+        self._gen += 1
+        if v.kind == CALL:
+            try:
+                v.fn()
+            except MPIException as e:
+                self._complete(st, e)
+                return
+            except Exception as e:   # noqa: BLE001 — surfaced at wait()
+                self._complete(st, MPIException(
+                    MPI_ERR_INTERN, f"schedule local op failed: {e!r}"))
+                return
+            self._vertex_done(st, vid)
+            return
+        comm, buf = v.comm, v.buf
+        proto = comm.u.protocol
+        try:
+            if v.kind == RECV:
+                req = proto.irecv(buf, buf.size,
+                                  from_numpy_dtype(buf.dtype), v.peer,
+                                  comm.ctx_coll, v.tag)
+            else:
+                req = proto.isend(buf, buf.size,
+                                  from_numpy_dtype(buf.dtype),
+                                  comm.world_of(v.peer), comm.rank,
+                                  comm.ctx_coll, v.tag)
+        except MPIException as e:
+            # e.g. a ULFM-failed peer: the verdict belongs to the
+            # schedule's request, not to whichever thread happened to be
+            # progressing the engine when this vertex became runnable
+            self._complete(st, e)
+            return
+        if req.complete_flag:
+            if req.error is not None:
+                self._complete(st, req.error)
+                return
+            self._vertex_done(st, vid)
+            return
+        st.inflight[vid] = req
+        req.add_callback(
+            lambda r, st=st, vid=vid: self._on_completion(st, vid, r))
+
+    def _vertex_done(self, st: _SchedState, vid: int) -> None:
+        st.remaining -= 1
+        st.inflight.pop(vid, None)
+        for w in st.dag.vertices[vid].out:
+            st.ndeps[w] -= 1
+            if st.ndeps[w] == 0:
+                st.ready.append(w)
+        self._gen += 1
+
+    def _on_completion(self, st: _SchedState, vid: int,
+                       req: Request) -> None:
+        """Request-completion callback: runs mutex-held from
+        ``ProgressEngine.complete_request`` on whatever thread progressed
+        the engine. This is the event edge that replaces hook polling."""
+        if st.done:
+            return
+        _pv_wakeups.inc()
+        if req.error is not None:
+            self._complete(st, req.error)
+            return
+        self._vertex_done(st, vid)
+        self._advance(st)
+        if not st.done and st.remaining == 0:
+            self._complete(st, None)
+
+    def _complete(self, st: _SchedState,
+                  error: Optional[MPIException]) -> None:
+        st.done = True
+        try:
+            self.active.remove(st)
+            _pv_active.inc(-1)
+        except ValueError:
+            pass
+        if error is not None:
+            # unwind: retract what can be retracted (posted recvs leave
+            # the matching queue; unmatched rendezvous sends resolve via
+            # the cancel protocol). Peers unwind through their own ULFM
+            # failure checks — errors here are rank-local verdicts.
+            for req in list(st.inflight.values()):
+                try:
+                    req.cancel()
+                except MPIException:
+                    pass
+        st.inflight.clear()
+        st.req.complete(error)
+
+    def _cancel(self, st: _SchedState) -> bool:
+        """User-requested cancel of the schedule request (wired as the
+        request's ``_cancel_fn``): abandon unissued vertices, cancel
+        in-flight ones. Succeeds only while the schedule is incomplete."""
+        with self.engine.mutex:
+            if st.done:
+                return False
+            st.done = True
+            try:
+                self.active.remove(st)
+                _pv_active.inc(-1)
+            except ValueError:
+                pass
+            for req in list(st.inflight.values()):
+                try:
+                    req.cancel()
+                except MPIException:
+                    pass
+            st.inflight.clear()
+            return True
+
+    # -- progress hook (mutex held, from progress_poke) -------------------
+    def _hook(self) -> bool:
+        if not self.active:
+            return False
+        did = False
+        for st in list(self.active):
+            if st.ready and not st.advancing:
+                self._advance(st)
+                did = True
+            elif st.remaining == 0 and not st.done:
+                self._complete(st, None)
+                did = True
+        if self._gen != self._seen_gen:
+            self._seen_gen = self._gen
+            return did
+        _pv_futile.inc()
+        return False
+
+
+def nbc_engine(engine) -> NbcEngine:
+    """The engine's scheduler, created on first use (one per
+    ProgressEngine; the attribute lives on the engine so thread-rank
+    universes each get their own queue)."""
+    nbc = getattr(engine, "nbc", None)
+    if nbc is None:
+        with engine.mutex:
+            nbc = getattr(engine, "nbc", None)
+            if nbc is None:
+                nbc = NbcEngine(engine)
+                engine.nbc = nbc
+    return nbc
+
+
+def start(comm, dag: SchedDAG, kind: str = "nbc-coll") -> Request:
+    """Launch ``dag`` on ``comm``'s progress engine."""
+    return nbc_engine(comm.u.engine).start(dag, kind)

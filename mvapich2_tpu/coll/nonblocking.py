@@ -1,14 +1,16 @@
-"""Nonblocking collectives via a schedule engine.
+"""Nonblocking collectives: schedule builders over the NBC engine.
 
 Analog of the device sched (SURVEY §2.1: MPID_Sched_send/recv/reduce/
-barrier/start, /root/reference/src/mpid/common/sched/mpid_sched.c:337-856,
-progressed by MPIDU_Sched_progress from a progress hook :979).
+barrier/start, /root/reference/src/mpid/common/sched/mpid_sched.c:337-856).
 
-A Schedule is a list of *phases* (barrier-separated); each phase holds
-send/recv entries (issued when the phase starts) and local compute entries
-(run when the phase starts, before issuing — they prepare buffers from
-earlier phases). The engine's progress hook advances phases as their
-requests complete and completes the user-visible request at the end.
+``Sched`` is a thin compatibility facade: builders below still express
+algorithms as barrier-separated phase lists, and ``start()`` lowers the
+phases to a dependency DAG (each phase-k vertex depends on every
+phase-(k-1) vertex) executed by the completion-driven scheduler in
+coll/nbc/ — vertices are issued the moment their dependencies complete,
+from request-completion callbacks, instead of waiting for a poll pass
+to run a per-schedule hook. Intercommunicators dispatch to the
+leader-bridge schedules in coll/nbc/inter.py.
 """
 
 from __future__ import annotations
@@ -17,13 +19,26 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
-from ..core.datatype import from_numpy_dtype
 from ..core.op import Op
 from ..core.request import Request
 from .algorithms import _block_ranges
 
 
+def _inter_fn(comm, name: str):
+    """The intercomm schedule builder for ``name``, or None for
+    intracomms (import deferred: coll/__init__ imports this module)."""
+    if not getattr(comm, "is_inter", False):
+        return None
+    from .nbc import inter as nbci
+    return nbci.ICOLL_FNS[name]
+
+
 class Sched:
+    """Phase-list schedule facade (MPID_Sched_* surface) over the DAG
+    engine. Phase semantics preserved: local calls run when their phase
+    starts, recvs are posted before the phase's sends go out, and a
+    barrier() orders everything before it ahead of everything after."""
+
     def __init__(self, comm, tag: int):
         self.comm = comm
         self.tag = tag
@@ -47,69 +62,25 @@ class Sched:
 
     # -- execution --------------------------------------------------------
     def start(self) -> Request:
-        comm = self.comm
-        engine = comm.u.engine
-        req = Request(engine, "sched-coll")
-        state = {"phase": 0, "reqs": []}
-
-        def start_phase() -> None:
-            while state["phase"] < len(self.phases):
-                entries = self.phases[state["phase"]]
-                reqs = []
-                for e in entries:
-                    if e[0] == "call":
-                        e[1]()
-                # issue recvs before sends within the phase
-                for e in entries:
-                    if e[0] == "recv":
-                        _, buf, src = e
-                        reqs.append(comm.u.protocol.irecv(
-                            buf, buf.size, from_numpy_dtype(buf.dtype), src,
-                            comm.ctx_coll, self.tag))
-                for e in entries:
-                    if e[0] == "send":
-                        _, buf, dest = e
-                        r = comm.u.protocol.isend(
-                            buf, buf.size, from_numpy_dtype(buf.dtype),
-                            comm.world_of(dest), comm.rank, comm.ctx_coll,
-                            self.tag)
-                        if not r.complete_flag:
-                            reqs.append(r)
-                state["reqs"] = [r for r in reqs if not r.complete_flag]
-                if state["reqs"]:
-                    return          # wait for this phase
-                state["phase"] += 1  # empty/instant phase: fall through
-            finish()
-
-        def finish() -> None:
-            # idempotent: with a threaded nonblocking op (comm_idup) the
-            # worker's progress tick and the waiter can race to finish
-            try:
-                engine.hooks.remove(hook)
-            except ValueError:
-                pass
-            req.complete()
-
-        def hook() -> bool:
-            if req.complete_flag:
-                return False
-            if any(not r.complete_flag for r in state["reqs"]):
-                return False
-            if state["phase"] >= len(self.phases):
-                finish()
-                return True
-            state["phase"] += 1
-            start_phase()
-            return True
-
-        # register + issue phase 0 under the engine mutex: the hook runs
-        # mutex-held from any progressing thread (e.g. a comm_idup worker
-        # pumping the same engine), and must never observe — or advance —
-        # a phase that is still being posted
-        with engine.mutex:
-            engine.register_hook(hook)
-            start_phase()
-        return req
+        from .nbc import engine as nbc
+        from .nbc.dag import SchedDAG
+        dag = SchedDAG()
+        prev: List[int] = []
+        for phase in self.phases:
+            if not phase:
+                continue
+            cur: List[int] = []
+            for e in phase:
+                if e[0] == "call":
+                    cur.append(dag.call(e[1], after=prev))
+                elif e[0] == "recv":
+                    cur.append(dag.recv(self.comm, e[1], e[2], self.tag,
+                                        after=prev))
+                else:
+                    cur.append(dag.send(self.comm, e[1], e[2], self.tag,
+                                        after=prev))
+            prev = cur
+        return nbc.start(self.comm, dag, "sched-coll")
 
 
 # ---------------------------------------------------------------------------
@@ -117,6 +88,9 @@ class Sched:
 # ---------------------------------------------------------------------------
 
 def ibarrier(comm) -> Request:
+    fn = _inter_fn(comm, "ibarrier")
+    if fn is not None:
+        return fn(comm)
     tag = comm.next_coll_tag()
     s = Sched(comm, tag)
     size, rank = comm.size, comm.rank
@@ -132,6 +106,9 @@ def ibarrier(comm) -> Request:
 
 
 def ibcast(comm, buf, count: int, datatype, root: int) -> Request:
+    fn = _inter_fn(comm, "ibcast")
+    if fn is not None:
+        return fn(comm, buf, count, datatype, root)
     tag = comm.next_coll_tag()
     size, rank = comm.size, comm.rank
     s = Sched(comm, tag)
@@ -159,6 +136,9 @@ def ibcast(comm, buf, count: int, datatype, root: int) -> Request:
 
 def iallreduce(comm, sendbuf, recvbuf, count: int, datatype, op: Op
                ) -> Request:
+    fn = _inter_fn(comm, "iallreduce")
+    if fn is not None:
+        return fn(comm, sendbuf, recvbuf, count, datatype, op)
     tag = comm.next_coll_tag()
     size, rank = comm.size, comm.rank
     s = Sched(comm, tag)
@@ -237,6 +217,9 @@ def iallreduce(comm, sendbuf, recvbuf, count: int, datatype, op: Op
 
 
 def iallgather(comm, sendbuf, recvbuf, count: int, datatype) -> Request:
+    fn = _inter_fn(comm, "iallgather")
+    if fn is not None:
+        return fn(comm, sendbuf, recvbuf, count, datatype)
     tag = comm.next_coll_tag()
     size, rank = comm.size, comm.rank
     s = Sched(comm, tag)
@@ -256,6 +239,9 @@ def iallgather(comm, sendbuf, recvbuf, count: int, datatype) -> Request:
 
 
 def ialltoall(comm, sendbuf, recvbuf, count: int, datatype) -> Request:
+    fn = _inter_fn(comm, "ialltoall")
+    if fn is not None:
+        return fn(comm, sendbuf, recvbuf, count, datatype)
     tag = comm.next_coll_tag()
     size, rank = comm.size, comm.rank
     s = Sched(comm, tag)
@@ -275,6 +261,9 @@ def ialltoall(comm, sendbuf, recvbuf, count: int, datatype) -> Request:
 
 def ireduce(comm, sendbuf, recvbuf, count: int, datatype, op: Op,
             root: int) -> Request:
+    fn = _inter_fn(comm, "ireduce")
+    if fn is not None:
+        return fn(comm, sendbuf, recvbuf, count, datatype, op, root)
     tag = comm.next_coll_tag()
     size, rank = comm.size, comm.rank
     s = Sched(comm, tag)

@@ -76,6 +76,7 @@ class Comm:
         self.revoked = False        # ULFM (ft/ulfm.py)
         self._acked_failures: set = set()   # world ranks acked (ULFM)
         self._coll_seq = 0          # collective tag sequencing
+        self._tag_tls = threading.local()   # call-time tag reservations
         self.coll_fns: Dict[str, Callable] = {}
         self._shmem_comm: Optional["Comm"] = None
         self._leader_comm: Optional["Comm"] = None
@@ -130,8 +131,32 @@ class Comm:
         return self.group.world_of_rank(rank)
 
     def next_coll_tag(self) -> int:
+        # a tag reserved at CALL time for this thread (a _CommWorker
+        # running a deferred intercomm op — cshim._queued) takes
+        # precedence over the live counter: the reservation preserves
+        # call-order tag pairing across ranks even though the op itself
+        # runs later, concurrently with DAG-scheduled collectives that
+        # allocate at call time
+        stack = getattr(self._tag_tls, "stack", None)
+        if stack:
+            return stack.pop(0)
         self._coll_seq = (self._coll_seq + 1) % 32768
         return self._coll_seq
+
+    def push_reserved_coll_tag(self, tag: int) -> None:
+        """Hand a call-time-reserved collective tag to the current
+        thread; the next next_coll_tag() on this thread consumes it."""
+        stack = getattr(self._tag_tls, "stack", None)
+        if stack is None:
+            stack = self._tag_tls.stack = []
+        stack.append(tag)
+
+    def drop_reserved_coll_tag(self, tag: int) -> None:
+        """Retire an unconsumed reservation (op failed before its tag
+        use) so it cannot leak into the thread's next operation."""
+        stack = getattr(self._tag_tls, "stack", None)
+        if stack and tag in stack:
+            stack.remove(tag)
 
     def _check(self) -> None:
         if self.freed:
@@ -525,7 +550,9 @@ class Comm:
                   datatype: Optional[Datatype] = None) -> Request:
         from ..coll import nonblocking as nb
         if count is None:
-            count = np.asarray(sendbuf).size // self.size
+            # intercomm blocks address the REMOTE group (MPI-3.1 §5.8)
+            count = np.asarray(sendbuf).size \
+                // getattr(self, "remote_size", self.size)
         _, datatype = _resolve(sendbuf, count, datatype)
         return nb.ialltoall(self, sendbuf, recvbuf, count, datatype)
 

@@ -2099,12 +2099,15 @@ def _new_req(r) -> int:
 
 
 class _CommWorker:
-    """Per-communicator FIFO worker: nonblocking operations on an
-    INTERCOMM (icolls, idup) execute serially in call order on one
-    thread. Queue order equals call order — identical on every rank by
-    MPI's collective-ordering rule — so internal tag allocation inside
-    the worker pairs correctly across ranks with no reservation
-    protocol."""
+    """Per-communicator FIFO worker: nonblocking operations an INTERCOMM
+    cannot yet express as an NBC-engine schedule (the v-collectives,
+    comm_idup) execute serially in call order on one thread. Queue order
+    equals call order — identical on every rank by MPI's
+    collective-ordering rule. Collective TAGS are reserved on the
+    calling thread (see ``_queued``): since the six core icolls now run
+    on the DAG scheduler and allocate their tags at call time, a
+    worker-side allocation at RUN time could interleave differently
+    across ranks and mispair the bridge traffic."""
 
     def __init__(self):
         import queue
@@ -2117,32 +2120,43 @@ class _CommWorker:
             item = self.q.get()
             if item is None:
                 return
-            fn, done = item
+            fn, done, wake = item
             try:
                 fn()
             except BaseException as e:   # noqa: BLE001 — raised at wait
                 done[1] = e
             done[0].set()
+            if wake is not None:
+                wake()      # doorbell: the waiter sits in progress_wait
 
-    def submit(self, fn):
+    def submit(self, fn, wake=None):
         done = [threading.Event(), None]
-        self.q.put((fn, done))
+        self.q.put((fn, done, wake))
         return done
 
 
 class _QueuedRequest:
     persistent = False
 
-    def __init__(self, done):
+    def __init__(self, done, engine=None):
         self._done = done
+        self._engine = engine
 
     def wait(self):
-        self._done[0].wait()
+        if self._engine is not None and not self._done[0].is_set():
+            # wait INSIDE the progress engine: the caller keeps pumping
+            # packets for the worker (and everyone else) instead of
+            # parking on a bare Event while the engine idles
+            self._engine.progress_wait(self._done[0].is_set)
+        else:
+            self._done[0].wait()
         if self._done[1] is not None:
             raise self._done[1]
         return None
 
     def test(self) -> bool:
+        if self._engine is not None and not self._done[0].is_set():
+            self._engine.progress_poke()
         return self._done[0].is_set()
 
 
@@ -2150,11 +2164,26 @@ _workers: Dict[int, _CommWorker] = {}
 
 
 def _queued(ch: int, fn) -> int:
+    c = _comm(ch)
+    # reserve the operation's collective tag NOW, in call order on the
+    # caller's thread; the worker hands it back to the op's single
+    # next_coll_tag() call so tag pairing across ranks is independent
+    # of worker scheduling (DAG-scheduled icolls allocate at call time)
+    tag = c.next_coll_tag()
+
+    def run():
+        c.push_reserved_coll_tag(tag)
+        try:
+            fn()
+        finally:
+            c.drop_reserved_coll_tag(tag)
+
     with _lock:
         w = _workers.get(ch)
         if w is None:
             w = _workers[ch] = _CommWorker()
-    return _new_req(_QueuedRequest(w.submit(fn)))
+    eng = c.u.engine
+    return _new_req(_QueuedRequest(w.submit(run, wake=eng.wakeup), eng))
 
 
 def _is_inter(c) -> bool:
@@ -2245,17 +2274,15 @@ def comm_idup(view, ch: int) -> int:
 
 
 def ibarrier(ch: int) -> int:
-    c = _comm(ch)
-    if _is_inter(c):
-        return _queued(ch, c.barrier)
-    return _new_req(c.ibarrier())
+    # intercomms included: nb.ibarrier dispatches to the leader-bridge
+    # DAG schedule (coll/nbc/inter.py) — true nonblocking progression,
+    # no worker thread
+    return _new_req(_comm(ch).ibarrier())
 
 
 def ibcast(view, count: int, dtcode: int, root: int, ch: int) -> int:
     c = _comm(ch)
     buf = _arr(view, count, dtcode) if view is not None else None
-    if _is_inter(c):
-        return _queued(ch, lambda: c.bcast(buf, root=root, count=count))
     return _new_req(c.ibcast(buf, root, count=count))
 
 
@@ -2264,9 +2291,6 @@ def iallreduce(sview, rview, count: int, dtcode: int, opcode: int,
     c = _comm(ch)
     recv = _arr(rview, count, dtcode)
     send = recv.copy() if sview is None else _arr(sview, count, dtcode)
-    if _is_inter(c):
-        return _queued(ch, lambda: c.allreduce(
-            send, recv, op=_OPS[opcode], count=count))
     return _new_req(c.iallreduce(send, recv, op=_OPS[opcode]))
 
 
@@ -2277,9 +2301,8 @@ def ireduce(sview, rview, count: int, dtcode: int, opcode: int, root: int,
     if _is_inter(c):
         recv0 = _arr(rview, count, dtcode) if rview else None
         send0 = _arr(sview, count, dtcode) if sview is not None else None
-        return _queued(ch, lambda: c.reduce(send0, recv0,
-                                            op=_OPS[opcode], root=root,
-                                            count=count))
+        return _new_req(nb.ireduce(c, send0, recv0, count, _dt(dtcode),
+                                   _OPS[opcode], root))
     if not rview:
         recv = np.empty(count, dtype=_DTYPES[dtcode])
     else:
@@ -2293,8 +2316,9 @@ def iallgather(sview, rview, count: int, dtcode: int, ch: int) -> int:
     from .coll import nonblocking as nb
     c = _comm(ch)
     if _is_inter(c):
-        return _queued(ch, lambda: allgather(sview, rview, count,
-                                             dtcode, count, dtcode, ch))
+        recv = _arr(rview, count * c.remote_size, dtcode)
+        send = _arr(sview, count, dtcode)
+        return _new_req(nb.iallgather(c, send, recv, count, _dt(dtcode)))
     recv = _arr(rview, count * c.size, dtcode)
     send = recv[c.rank * count:(c.rank + 1) * count].copy() \
         if sview is None else _arr(sview, count, dtcode)
@@ -2305,8 +2329,9 @@ def ialltoall(sview, rview, count: int, dtcode: int, ch: int) -> int:
     from .coll import nonblocking as nb
     c = _comm(ch)
     if _is_inter(c):
-        return _queued(ch, lambda: alltoall(sview, rview, count, dtcode,
-                                            count, dtcode, ch))
+        recv = _arr(rview, count * c.remote_size, dtcode)
+        send = _arr(sview, count * c.remote_size, dtcode)
+        return _new_req(nb.ialltoall(c, send, recv, count, _dt(dtcode)))
     recv = _arr(rview, count * c.size, dtcode)
     send = recv.copy() if sview is None \
         else _arr(sview, count * c.size, dtcode)

@@ -209,14 +209,22 @@ class Universe:
         never exhaust. The default budget is 2048 simultaneous comms:
         the top eighth is reserved for single-member allocations
         (alloc_context_local) and the rest feeds the collective
-        agreement. Floor of 128 bits so both regions always exist."""
+        agreement. Floor of 128 bits so both regions always exist.
+
+        Double-checked locking under _ctx_lock: two threads racing the
+        lazy init could otherwise both build all-ones masks, and the
+        later assignment would resurrect a context-id bit the earlier
+        winner had already claimed (a duplicated live context id)."""
         if self._ctx_mask is None:
             import numpy as np
             from ..utils.config import get_config
             nbits = max(128, int(get_config()["MAX_CONTEXTS"]))
-            self._ctx_mask = np.full((nbits + 63) // 64,
-                                     np.uint64(0xFFFFFFFFFFFFFFFF),
-                                     dtype=np.uint64)
+            fresh = np.full((nbits + 63) // 64,
+                            np.uint64(0xFFFFFFFFFFFFFFFF),
+                            dtype=np.uint64)
+            with self._ctx_lock:
+                if self._ctx_mask is None:
+                    self._ctx_mask = fresh
         return self._ctx_mask
 
     def release_context_id(self, ctx: int) -> None:
@@ -334,6 +342,10 @@ class Universe:
         mask = self.ctx_mask()
         lw = self._ctx_local_words()
         base = len(mask) - lw
+        # bounded wait-out: an agreement that never resolves (a wedged
+        # peer, a lost mask-holder) must surface as a diagnostic error,
+        # not a silent livelock on the 0.2 ms poll
+        deadline = time.monotonic() + 60.0
         while True:
             with self._ctx_lock:
                 # the reserved top words first: collective agreements
@@ -361,6 +373,13 @@ class Universe:
                     w, b = divmod(bit, 64)
                     self._ctx_mask[w] &= np.uint64(~np.uint64(1 << b))
                     return CTX_MASK_BASE + 2 * bit
+            if time.monotonic() > deadline:
+                raise MPIException(
+                    MPI_ERR_INTERN,
+                    "alloc_context_local stalled 60s waiting out an "
+                    "in-flight context-id agreement (reserved region "
+                    "exhausted and the shared mask never came free) — "
+                    "a peer is likely wedged mid-agreement")
             time.sleep(0.0002)
 
     def allocate_context_id(self, parent_comm) -> int:

@@ -85,7 +85,23 @@ class ProgressEngine:
             self.async_types.add(int(ptype))
 
     def register_hook(self, fn: Callable[[], bool]) -> None:
+        """Register a progress hook, run (mutex-held) at the end of every
+        poll pass; it returns True when it made progress. Wakeup
+        contract: any event that can make a hook's work runnable — a
+        request completion (complete_request), an inbound packet
+        (enqueue_incoming) — rings this engine's doorbell, so a waiter
+        blocked in progress_wait re-polls immediately instead of
+        sleeping out its backoff interval. The NBC scheduler
+        (coll/nbc/engine.py) leans on exactly this: vertex completions
+        advance schedules from their completion callbacks and the
+        doorbell ends the waiter's sleep."""
         self.hooks.append(fn)
+
+    def remove_hook(self, fn: Callable[[], bool]) -> None:
+        try:
+            self.hooks.remove(fn)
+        except ValueError:
+            pass
 
     # -- packet delivery (any thread) -------------------------------------
     def enqueue_incoming(self, pkt: Packet) -> None:

@@ -1,0 +1,89 @@
+"""FT fault-injection program: kill a member MID split/dup churn.
+
+Exercises the mixed C-gather/Python-fallback unwind in the fused
+comm-management collective (native/cplane.cpp cp_coll_gather): ranks
+hammer split+dup+free on COMM_WORLD (the cp_coll_gather fast path when
+the shm plane owns the comm); rank 1 SIGKILLs itself mid-churn, so
+survivors meet the failure INSIDE an exchange — some unwound by the C
+engine's -2 verdict (peer record never arrives, failure mark observed
+in the wait loop), some by the python path's ULFM recv checks after a
+member diverged — and every survivor must surface a clean
+MPIX_ERR_PROC_FAILED, then ack + shrink + finish a collective.
+
+Run: python -m mvapich2_tpu.run -np 4 --ft python ft_churn_prog.py
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from mvapich2_tpu import mpi  # noqa: E402
+from mvapich2_tpu.core.errors import (MPIException,  # noqa: E402
+                                      MPIX_ERR_PROC_FAILED)
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+rank, size = comm.rank, comm.size
+
+KILL_AT = 25          # churn iterations before rank 1 dies
+errs = 0
+hit_failure = False
+
+deadline = time.time() + 60
+i = 0
+while time.time() < deadline:
+    if rank == 1 and i == KILL_AT:
+        # die like a crashed process, mid-churn: survivors may already
+        # be inside the next split's gather when detection lands
+        import signal
+        os.kill(os.getpid(), signal.SIGKILL)
+    try:
+        sub = comm.split(i % 2 if rank != 0 else 0, rank)
+        d = sub.dup()
+        d.free()
+        sub.free()
+    except MPIException as e:
+        if e.error_class != MPIX_ERR_PROC_FAILED:
+            errs += 1
+            print(f"rank {rank}: churn error class {e.error_class}, "
+                  f"not MPIX_ERR_PROC_FAILED (iter {i})")
+        hit_failure = True
+        break
+    i += 1
+
+if not hit_failure:
+    errs += 1
+    print(f"rank {rank}: never saw the failure ({i} iterations)")
+
+# the failure must (eventually) be attributed to rank 1
+wait_end = time.time() + 30
+while 1 not in comm.u.failed_ranks and time.time() < wait_end:
+    time.sleep(0.02)
+if 1 not in comm.u.failed_ranks:
+    errs += 1
+    print(f"rank {rank}: rank 1 never in failed set: "
+          f"{comm.u.failed_ranks}")
+
+# survivors recover: ack, shrink, and run a collective + another split
+comm.failure_ack()
+newcomm = comm.shrink()
+if newcomm.size != size - 1:
+    errs += 1
+    print(f"rank {rank}: shrunk size {newcomm.size} != {size - 1}")
+out = newcomm.allreduce(np.full(4, 1.0))
+if abs(out[0] - (size - 1)) > 1e-9:
+    errs += 1
+    print(f"rank {rank}: allreduce on shrunk comm wrong: {out[0]}")
+post = newcomm.split(0, newcomm.rank)   # churn machinery still sound
+if post.size != newcomm.size:
+    errs += 1
+    print(f"rank {rank}: post-shrink split size {post.size}")
+post.free()
+
+newcomm.barrier()
+if newcomm.rank == 0 and errs == 0:
+    print("No Errors")
+sys.exit(1 if errs else 0)

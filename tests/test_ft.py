@@ -247,6 +247,57 @@ def test_mpirun_ft_end_to_end():
 
 
 @pytest.mark.slow
+def test_mpirun_ft_split_dup_churn_kill():
+    """Process mode: rank 1 is SIGKILLed mid split/dup churn, so
+    survivors meet the failure inside the fused comm-management
+    collective — the mixed C-gather (-2 verdict) / python-fallback
+    unwind path of native/cplane.cpp cp_coll_gather. Every survivor
+    must surface MPIX_ERR_PROC_FAILED, ack, shrink and finish."""
+    prog = os.path.join(REPO, "tests", "progs", "ft_churn_prog.py")
+    cmd = [sys.executable, "-m", "mvapich2_tpu.run", "-np", "4", "--ft",
+           sys.executable, prog]
+    r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                       timeout=180)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+
+
+def test_split_churn_member_death_unwinds():
+    """Local-mode analog of the churn kill (fault injection through the
+    detection sink, die.c-style): a member stops participating mid
+    split/dup churn; survivors' next agreement must unwind with
+    MPIX_ERR_PROC_FAILED — not hang — and shrink must recover."""
+    KILL_AT = 5
+
+    def body(comm):
+        if comm.rank == DEAD:
+            # participate for a few rounds, then vanish silently
+            for i in range(KILL_AT):
+                sub = comm.split(i % 2, comm.rank)
+                sub.dup().free()
+                sub.free()
+            return None
+        got = None
+        for i in range(KILL_AT + 3):
+            if i == KILL_AT:
+                comm.u.mark_failed(DEAD)
+            try:
+                sub = comm.split(i % 2, comm.rank)
+                sub.dup().free()
+                sub.free()
+            except MPIException as e:
+                got = e.error_class
+                break
+        new = comm.shrink()
+        return (got, new.size, float(new.allreduce(np.ones(2))[0]))
+
+    out = run_ranks(4, body)
+    for i, r in enumerate(out):
+        if i != DEAD:
+            assert r == (MPIX_ERR_PROC_FAILED, 3, 3.0), (i, r)
+
+
+@pytest.mark.slow
 def test_elastic_rebuild_world():
     """SURVEY §5.3 migration analog: kill a rank, shrink, spawn a
     replacement, merge, restore state (ft/elastic.py)."""

@@ -130,6 +130,108 @@ def test_reduce_gather_scatter_root():
     assert all(run_ranks(4, body))
 
 
+def test_nbc_iallgather_np4_wakeup_driven():
+    """np=4 intercomm iallgather on the NBC scheduler (the shape behind
+    the retired coll/nbicallgather xfail): correct results AND
+    wakeup-driven progression — bounded nbc_futile_polls, nonzero
+    nbc_wakeups — instead of the old worker-queue path that advanced
+    on the progress engine's 8 ms futile-poll backoff."""
+    from mvapich2_tpu import mpit
+
+    fut = mpit.pvar("nbc_futile_polls")
+    wak = mpit.pvar("nbc_wakeups")
+    iss = mpit.pvar("nbc_vertices_issued")
+    f0, w0, i0 = fut.read(), wak.read(), iss.read()
+
+    def body(world):
+        inter, low, _ = _make_inter(world)
+        half = world.size // 2
+        remote = list(range(half, world.size)) if low \
+            else list(range(half))
+        for count in (1, 8, 64):
+            mine = np.full(count, world.rank, np.int64)
+            rb = np.zeros(count * inter.remote_size, np.int64)
+            inter.iallgather(mine, rb, count=count).wait()
+            np.testing.assert_array_equal(
+                rb, np.repeat(np.array(remote, np.int64), count))
+        return True
+
+    assert all(run_ranks(4, body))
+    df, dw, di = fut.read() - f0, wak.read() - w0, iss.read() - i0
+    assert dw > 0, "no completion-driven advancement"
+    assert df < di, f"futile polls ({df}) >= vertices issued ({di})"
+
+
+def test_nbc_ialltoall():
+    def body(world):
+        inter, low, _ = _make_inter(world)
+        half = world.size // 2
+        remote = list(range(half, world.size)) if low \
+            else list(range(half))
+        sb = np.array([world.rank * 10 + j
+                       for j in range(inter.remote_size)], np.int64)
+        rb = np.zeros(inter.remote_size, np.int64)
+        inter.ialltoall(sb, rb, count=1).wait()
+        assert list(rb) == [r * 10 + inter.rank for r in remote]
+        return True
+
+    assert all(run_ranks(4, body))
+
+
+def test_nbc_ibarrier_and_overlap():
+    """Several NBC ops in flight at once on one intercomm (distinct
+    call-time tags keep them paired)."""
+    def body(world):
+        inter, low, _ = _make_inter(world)
+        half = world.size // 2
+        remote = list(range(half, world.size)) if low \
+            else list(range(half))
+        r1 = inter.ibarrier()
+        mine = np.array([world.rank], np.int64)
+        rb = np.zeros(inter.remote_size, np.int64)
+        r2 = inter.iallgather(mine, rb, count=1)
+        out = np.zeros(1, np.int64)
+        r3 = inter.iallreduce(np.array([world.rank + 1], np.int64), out)
+        for r in (r3, r1, r2):    # completion order independent
+            r.wait()
+        assert list(rb) == remote
+        assert int(out[0]) == sum(r + 1 for r in remote)
+        return True
+
+    assert all(run_ranks(6, body))
+
+
+def test_nbc_ibcast_ireduce_root_semantics():
+    from mvapich2_tpu.coll import nonblocking as nb
+    from mvapich2_tpu.core import op as opmod
+    from mvapich2_tpu.core.datatype import from_numpy_dtype
+
+    def body(world):
+        inter, low, _ = _make_inter(world)
+        half = world.size // 2
+        i32 = from_numpy_dtype(np.dtype(np.int32))
+        i64 = from_numpy_dtype(np.dtype(np.int64))
+        buf = np.zeros(4, np.int32)
+        mine = np.array([world.rank + 1], np.int64)
+        acc = np.zeros(1, np.int64)
+        if low:
+            root = ROOT if inter.rank == 0 else PROC_NULL
+            if inter.rank == 0:
+                buf[:] = [3, 1, 4, 1]
+            nb.ibcast(inter, buf, 4, i32, root).wait()
+            nb.ireduce(inter, mine, acc, 1, i64, opmod.SUM, root).wait()
+            if inter.rank == 0:
+                assert int(acc[0]) == sum(
+                    r + 1 for r in range(half, world.size))
+        else:
+            nb.ibcast(inter, buf, 4, i32, 0).wait()
+            assert list(buf) == [3, 1, 4, 1]
+            nb.ireduce(inter, mine, acc, 1, i64, opmod.SUM, 0).wait()
+        return True
+
+    assert all(run_ranks(4, body))
+
+
 def test_merge_low_first():
     def body(world):
         inter, low, _ = _make_inter(world)
