@@ -83,6 +83,9 @@ class NbcEngine:
                 return st.req
             self.active.append(st)
             _pv_active.inc()
+            if (tr := eng.tracer) is not None:
+                tr.record("nbc", "sched_start", "i", sched=st.req.req_id,
+                          kind=kind, vertices=len(dag.vertices))
             self._advance(st)
         return st.req
 
@@ -113,6 +116,9 @@ class NbcEngine:
         v = st.dag.vertices[vid]
         _pv_issued.inc()
         self._gen += 1
+        if (tr := self.engine.tracer) is not None:
+            tr.record("nbc", "vertex_issue", "i", sched=st.req.req_id,
+                      vid=vid, kind=v.kind)
         if v.kind == CALL:
             try:
                 v.fn()
@@ -154,6 +160,9 @@ class NbcEngine:
             lambda r, st=st, vid=vid: self._on_completion(st, vid, r))
 
     def _vertex_done(self, st: _SchedState, vid: int) -> None:
+        if (tr := self.engine.tracer) is not None:
+            tr.record("nbc", "vertex_complete", "i", sched=st.req.req_id,
+                      vid=vid)
         st.remaining -= 1
         st.inflight.pop(vid, None)
         for w in st.dag.vertices[vid].out:
@@ -181,6 +190,9 @@ class NbcEngine:
     def _complete(self, st: _SchedState,
                   error: Optional[MPIException]) -> None:
         st.done = True
+        if (tr := self.engine.tracer) is not None:
+            tr.record("nbc", "sched_complete", "i", sched=st.req.req_id,
+                      error=error is not None)
         try:
             self.active.remove(st)
             _pv_active.inc(-1)

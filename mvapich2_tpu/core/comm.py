@@ -556,6 +556,114 @@ class Comm:
         _, datatype = _resolve(sendbuf, count, datatype)
         return nb.ialltoall(self, sendbuf, recvbuf, count, datatype)
 
+    def ireduce(self, sendbuf, recvbuf, op=None, root: int = 0,
+                count: Optional[int] = None,
+                datatype: Optional[Datatype] = None) -> Request:
+        from ..coll import nonblocking as nb
+        from . import op as opmod
+        op = op or opmod.SUM
+        count, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
+        return nb.ireduce(self, sendbuf, recvbuf, count, datatype, op,
+                          root)
+
+    def iscan(self, sendbuf, recvbuf, op=None,
+              count: Optional[int] = None,
+              datatype: Optional[Datatype] = None) -> Request:
+        from ..coll import nonblocking as nb
+        from . import op as opmod
+        op = op or opmod.SUM
+        count, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
+        return nb.iscan(self, sendbuf, recvbuf, count, datatype, op)
+
+    def iexscan(self, sendbuf, recvbuf, op=None,
+                count: Optional[int] = None,
+                datatype: Optional[Datatype] = None) -> Request:
+        from ..coll import nonblocking as nb
+        from . import op as opmod
+        op = op or opmod.SUM
+        count, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
+        return nb.iexscan(self, sendbuf, recvbuf, count, datatype, op)
+
+    def igather(self, sendbuf, recvbuf=None, root: int = 0,
+                count: Optional[int] = None,
+                datatype: Optional[Datatype] = None) -> Request:
+        from ..coll import nonblocking as nb
+        count, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
+        return nb.igather(self, sendbuf, recvbuf, count, datatype, root)
+
+    def iscatter(self, sendbuf, recvbuf, root: int = 0,
+                 count: Optional[int] = None,
+                 datatype: Optional[Datatype] = None) -> Request:
+        from ..coll import nonblocking as nb
+        count, datatype = _resolve(recvbuf, count, datatype)
+        return nb.iscatter(self, sendbuf, recvbuf, count, datatype, root)
+
+    def igatherv(self, sendbuf, recvbuf, counts, displs=None,
+                 root: int = 0,
+                 datatype: Optional[Datatype] = None) -> Request:
+        from ..coll import nonblocking as nb
+        _, datatype = _resolve(sendbuf, None, datatype)
+        sendcount = int(np.asarray(sendbuf).size)
+        return nb.igatherv(self, sendbuf, sendcount, recvbuf,
+                           list(counts) if counts is not None else None,
+                           list(displs) if displs is not None else None,
+                           datatype, root)
+
+    def iscatterv(self, sendbuf, counts, displs, recvbuf,
+                  root: int = 0,
+                  datatype: Optional[Datatype] = None) -> Request:
+        from ..coll import nonblocking as nb
+        _, datatype = _resolve(recvbuf, None, datatype)
+        recvcount = int(np.asarray(recvbuf).size) \
+            if recvbuf is not None else 0
+        return nb.iscatterv(self, sendbuf,
+                            list(counts) if counts is not None else None,
+                            list(displs) if displs is not None else None,
+                            recvbuf, recvcount, datatype, root)
+
+    def iallgatherv(self, sendbuf, recvbuf, counts, displs=None,
+                    datatype: Optional[Datatype] = None) -> Request:
+        from ..coll import nonblocking as nb
+        _, datatype = _resolve(sendbuf, None, datatype)
+        sendcount = int(np.asarray(sendbuf).size)
+        return nb.iallgatherv(self, sendbuf, sendcount, recvbuf,
+                              list(counts),
+                              list(displs) if displs is not None
+                              else None, datatype)
+
+    def ialltoallv(self, sendbuf, sendcounts, sdispls, recvbuf,
+                   recvcounts, rdispls,
+                   datatype: Optional[Datatype] = None) -> Request:
+        from ..coll import nonblocking as nb
+        _, datatype = _resolve(sendbuf, None, datatype)
+        return nb.ialltoallv(self, sendbuf, list(sendcounts),
+                             list(sdispls) if sdispls is not None
+                             else None, recvbuf, list(recvcounts),
+                             list(rdispls) if rdispls is not None
+                             else None, datatype)
+
+    def ireduce_scatter(self, sendbuf, recvbuf, counts, op=None,
+                        datatype: Optional[Datatype] = None) -> Request:
+        from ..coll import nonblocking as nb
+        from . import op as opmod
+        op = op or opmod.SUM
+        _, datatype = _resolve(sendbuf, None, datatype)
+        return nb.ireduce_scatter(self, sendbuf, recvbuf, list(counts),
+                                  datatype, op)
+
+    def ireduce_scatter_block(self, sendbuf, recvbuf, op=None,
+                              count: Optional[int] = None,
+                              datatype: Optional[Datatype] = None
+                              ) -> Request:
+        from ..coll import nonblocking as nb
+        from . import op as opmod
+        op = op or opmod.SUM
+        if count is None:
+            count = int(np.asarray(sendbuf).size) // self.size
+        _, datatype = _resolve(sendbuf, count, datatype)
+        return nb.ireduce_scatter_block(self, sendbuf, recvbuf, count,
+                                        datatype, op)
+
     # ------------------------------------------------------------------
     # communicator management
     # ------------------------------------------------------------------

@@ -32,6 +32,9 @@ PROFILED_METHODS = [
     "barrier", "bcast", "reduce", "allreduce", "allgather", "gather",
     "scatter", "alltoall", "reduce_scatter_block", "scan", "exscan",
     "ibarrier", "ibcast", "iallreduce", "iallgather", "ialltoall",
+    "ireduce", "iscan", "iexscan", "igather", "iscatter",
+    "igatherv", "iscatterv", "iallgatherv", "ialltoallv",
+    "ireduce_scatter", "ireduce_scatter_block",
 ]
 
 _lock = threading.Lock()

@@ -407,6 +407,9 @@ class Pt2ptProtocol:
                                        "plane eager injection failed")
                 _pv_eager.inc()
                 _pv_bytes.inc(nbytes)
+                if (tr := self.engine.tracer) is not None:
+                    tr.record("protocol", "eager_send", "i",
+                              dest=dest_world, bytes=nbytes, path="plane")
                 sreq._fire()
                 sreq._cancel_fn = lambda: self._plane_cancel_send(
                     sreq, pch, dest_world)
@@ -430,6 +433,9 @@ class Pt2ptProtocol:
             self._send_pkt(channel, dest_world, pkt)
             _pv_eager.inc()
             _pv_bytes.inc(nbytes)
+            if (tr := self.engine.tracer) is not None:
+                tr.record("protocol", "eager_send", "i",
+                          dest=dest_world, bytes=nbytes)
             # locally complete, but cancellable until matched (§3.8.4)
             sreq._fire()
             sreq._cancel_fn = lambda: self._cancel_send(
@@ -465,6 +471,10 @@ class Pt2ptProtocol:
                         sreq, pch, dest_world)
                     _pv_rndv.inc()
                     _pv_bytes.inc(nbytes)
+                    if (tr := self.engine.tracer) is not None:
+                        tr.record("protocol", "rndv_rts", "i",
+                                  dest=dest_world, bytes=nbytes,
+                                  proto="CMA")
                     return sreq
             if rid == -2:
                 from ..ft import ulfm
@@ -501,6 +511,9 @@ class Pt2ptProtocol:
                                                     channel)
         _pv_rndv.inc()
         _pv_bytes.inc(nbytes)
+        if (tr := self.engine.tracer) is not None:
+            tr.record("protocol", "rndv_rts", "i", dest=dest_world,
+                      bytes=nbytes, proto=sreq.protocol)
         return sreq
 
     def _plane_cancel_send(self, sreq, pch, dest_world: int) -> bool:
@@ -840,12 +853,18 @@ class Pt2ptProtocol:
         n = min(pkt.nbytes, req.capacity)
         if n > 0 and req.buf is not None:
             req.datatype.unpack(pkt.data[:n], req.buf, req.count)
+        if (tr := self.engine.tracer) is not None:
+            tr.record("protocol", "eager_recv", "i", src=pkt.src_world,
+                      bytes=pkt.nbytes)
         self._finish_recv(req, pkt, pkt.nbytes, pkt.comm_src, pkt.tag)
 
     def _rndv_recv_start(self, req: RecvRequest, pkt: Packet) -> None:
         req.bytes_expected = pkt.nbytes
         src_world = pkt.src_world
         channel = self.u.channel_for(src_world)
+        if (tr := self.engine.tracer) is not None:
+            tr.record("protocol", "rndv_rts_recv", "i", src=src_world,
+                      bytes=pkt.nbytes, proto=pkt.protocol)
         if pkt.protocol == "RGET":
             n = min(pkt.nbytes, req.capacity)
             if n > 0:
@@ -879,6 +898,10 @@ class Pt2ptProtocol:
         sreq = self.engine.outstanding.get(pkt.sreq_id)
         if sreq is None:  # pragma: no cover
             raise MPIException(MPI_ERR_INTERN, "CTS for unknown send")
+        if (tr := self.engine.tracer) is not None:
+            tr.record("protocol", "rndv_cts", "i", src=pkt.src_world,
+                      bytes=len(sreq.packed) if sreq.packed is not None
+                      else 0)
         data = sreq.packed
         chunk = self.cfg["R3_CHUNK_SIZE"]
         total = len(data)
@@ -914,6 +937,8 @@ class Pt2ptProtocol:
         sreq = self.engine.outstanding.get(pkt.sreq_id)
         if sreq is None:  # pragma: no cover
             raise MPIException(MPI_ERR_INTERN, "FIN for unknown send")
+        if (tr := self.engine.tracer) is not None:
+            tr.record("protocol", "rndv_fin", "i", src=pkt.src_world)
         if sreq.handle is not None:
             sreq.channel.release_buffer(sreq.handle)
         sreq.complete()

@@ -64,6 +64,7 @@ class Universe:
         self.parent_intercomm = None      # set on spawned ranks
         self.ports: Dict[int, str] = {}   # open ports (tag -> port name)
         self.engine = ProgressEngine(world_rank)
+        self.engine.universe = self   # watchdog/debugger back-reference
         self.protocol: Optional[Pt2ptProtocol] = None
         self._channels: Dict[int, Channel] = {}   # world rank -> channel
         self._default_channel: Optional[Channel] = None
@@ -190,6 +191,12 @@ class Universe:
         with ts.phase("MPID_Init"):
             with ts.phase("config reload"):
                 get_config().reload()
+            with ts.phase("trace attach"):
+                # after the reload so MV2T_TRACE*/MV2T_STALL_* set in the
+                # launcher env are honored; both are no-ops when off
+                from .. import trace
+                trace.maybe_attach(self.engine)
+                trace.watchdog.configure(self.engine)
             with ts.phase("protocol + matcher"):
                 self.protocol = Pt2ptProtocol(self)
                 from ..ft import ulfm
@@ -431,7 +438,13 @@ class Universe:
     def finalize(self) -> None:
         if self.finalized:
             return
-        self.engine.drain_all()
+        leftover = self.engine.drain_all()
+        if leftover:
+            log.info("finalize retired %d leftover packets/hook advances "
+                     "(rank %d)", leftover, self.world_rank)
+        from .. import trace
+        trace.dump_rank(self.engine)
+        trace.detach(self.engine)
         self.engine.close()
         self.finalized = True
 

@@ -1,0 +1,68 @@
+"""Distributed event tracing: ring-buffer recorder, Perfetto export,
+stall watchdog.
+
+Layer map (see trace/recorder.py for the event format and the
+one-attribute-check cost discipline):
+
+    mpi       entry/exit of every PROFILED_METHODS call (this module
+              rides the profile.py PMPI interposition table)
+    protocol  pt2pt eager / rendezvous transitions
+    channel   per-channel send/recv byte counts
+    progress  progress_wait spans, idle/wake cycles, watchdog trips
+    nbc       NBC DAG vertex issue/complete
+
+Workflow: set MV2T_TRACE=1 (+ MV2T_TRACE_DIR=<dir>) — or run under
+``bin/mpitrace`` which does both, merges the per-rank dumps written at
+Finalize into one Chrome trace-event / Perfetto JSON (rank→pid,
+layer→tid) and prints the per-layer summary. Load the merged file in
+`chrome://tracing` or https://ui.perfetto.dev.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .recorder import (LAYERS, Recorder, detach, dump_rank,  # noqa: F401
+                       maybe_attach)
+from .perfetto import merge, merge_dir, read_dumps, summarize  # noqa: F401
+from . import watchdog  # noqa: F401
+
+_mpi_lock = threading.Lock()
+_mpi_installed = False
+
+
+def _mpi_tracer(name, call, args, kwargs):
+    """profile.py interceptor: B/E span around every MPI entry point, in
+    the rank's own recorder (``args[0]`` is the comm the call was made
+    on). Ranks without a recorder — e.g. an untraced thread-rank
+    universe sharing the process-wide method table — pass through."""
+    comm = args[0]
+    u = getattr(comm, "u", None)
+    rec = u.engine.tracer if u is not None else None
+    if rec is None:
+        return call(*args[1:], **kwargs)
+    rec.record("mpi", name, "B")
+    try:
+        return call(*args[1:], **kwargs)
+    finally:
+        rec.record("mpi", name, "E")
+
+
+def _install_mpi_tracer() -> None:
+    global _mpi_installed
+    with _mpi_lock:
+        if _mpi_installed:
+            return
+        from .. import profile
+        profile.install(_mpi_tracer)
+        _mpi_installed = True
+
+
+def _uninstall_mpi_tracer() -> None:
+    global _mpi_installed
+    with _mpi_lock:
+        if not _mpi_installed:
+            return
+        from .. import profile
+        profile.uninstall(_mpi_tracer)
+        _mpi_installed = False

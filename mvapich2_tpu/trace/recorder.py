@@ -1,0 +1,142 @@
+"""Per-rank bounded ring-buffer event recorder.
+
+The distributed-tracing analog of the reference's debug_utils.c subsystem
+switches + mv2_mpit.c channel counters, redesigned as an event stream: each
+rank owns one bounded ring buffer (a deque with maxlen — old events fall
+off, memory is bounded by MV2T_TRACE_BUF) into which the five instrumented
+layers append (timestamp, layer, name, phase, args) tuples:
+
+    mpi       MPI entry/exit (profile.py interposition, trace/__init__.py)
+    protocol  eager vs RTS/CTS/FIN rendezvous transitions (pt2pt/protocol.py)
+    channel   per-channel send/recv with byte counts (transport/*.py)
+    progress  progress_wait / idle / wake cycles (transport/progress.py)
+    nbc       NBC DAG vertex issue/complete (coll/nbc/engine.py)
+
+Cost discipline: when tracing is off every instrumented site pays exactly
+ONE attribute check (``engine.tracer is None``) — the recorder attaches to
+the ProgressEngine only when the MV2T_TRACE cvar is set, so the hot paths
+never consult the config registry. Timestamps are CLOCK_MONOTONIC, which
+is system-wide on Linux, so per-process rank dumps merge on one time axis
+(trace/perfetto.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.config import cvar, get_config
+
+cvar("TRACE", False, bool, "trace",
+     "Enable the per-rank ring-buffer event recorder (near-zero cost when "
+     "off: one attribute check per instrumented site).")
+cvar("TRACE_BUF", 65536, int, "trace",
+     "Ring-buffer capacity in events per rank; the oldest events are "
+     "dropped first (bounded memory under any workload).")
+cvar("TRACE_DIR", "", str, "trace",
+     "Directory for per-rank trace dumps written at Finalize "
+     "(trace-r<rank>.json); empty keeps events in memory only. "
+     "bin/mpitrace sets this and merges the dumps into one Perfetto "
+     "JSON after the job exits.")
+
+# the five instrumented layers, in lane order for the Perfetto export
+LAYERS = ("mpi", "protocol", "channel", "progress", "nbc")
+
+
+class Recorder:
+    """One rank's bounded event ring. ``record`` is the only hot call."""
+
+    __slots__ = ("rank", "events", "dropped_floor")
+
+    def __init__(self, rank: int, capacity: int):
+        self.rank = rank
+        self.events: collections.deque = collections.deque(maxlen=capacity)
+        # number of events ever recorded minus len(events) = dropped count
+        self.dropped_floor = 0
+
+    def record(self, layer: str, name: str, ph: str = "i", **args) -> None:
+        """Append one event. ``ph`` follows the Chrome trace-event phases:
+        'B'egin / 'E'nd for spans, 'i' for instants. deque.append with a
+        maxlen is atomic under the GIL, so no lock on the hot path."""
+        self.events.append((time.monotonic(), layer, name, ph,
+                            args or None))
+
+    def tail(self, n: int) -> List[tuple]:
+        """The most recent ``n`` events (stall-watchdog post-mortem)."""
+        evs = list(self.events)
+        return evs[-n:]
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The per-rank dump payload (schema consumed by trace/perfetto)."""
+        return {
+            "rank": self.rank,
+            "clock": "monotonic",
+            "capacity": self.events.maxlen,
+            "events": [[t, layer, name, ph, args]
+                       for (t, layer, name, ph, args) in self.events],
+        }
+
+
+# ---------------------------------------------------------------------------
+# attach / detach (the only code that consults the config registry)
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_active: List[Recorder] = []
+
+
+def maybe_attach(engine) -> Optional[Recorder]:
+    """Attach a recorder to ``engine`` iff the MV2T_TRACE cvar is set
+    (called once per rank from Universe.initialize, after the config
+    reload). Also installs the MPI entry/exit interposition tool while
+    any recorder is live."""
+    cfg = get_config()
+    if not cfg.get("TRACE", False):
+        engine.tracer = None
+        return None
+    rec = Recorder(engine.rank, max(256, int(cfg["TRACE_BUF"])))
+    engine.tracer = rec
+    with _lock:
+        _active.append(rec)
+    from . import _install_mpi_tracer
+    _install_mpi_tracer()
+    return rec
+
+
+def detach(engine) -> None:
+    """Drop ``engine``'s recorder; uninstalls the MPI interposition tool
+    when the last recorder leaves (so an untraced run that follows a
+    traced one in the same process pays nothing)."""
+    rec = getattr(engine, "tracer", None)
+    if rec is None:
+        return
+    engine.tracer = None
+    last = False
+    with _lock:
+        if rec in _active:
+            _active.remove(rec)
+        last = not _active
+    if last:
+        from . import _uninstall_mpi_tracer
+        _uninstall_mpi_tracer()
+
+
+def dump_rank(engine) -> Optional[str]:
+    """Write ``engine``'s ring buffer to MV2T_TRACE_DIR/trace-r<rank>.json
+    (called at Finalize, before the recorder detaches). Returns the path,
+    or None when no recorder / no dump dir."""
+    rec = getattr(engine, "tracer", None)
+    if rec is None:
+        return None
+    out_dir = get_config().get("TRACE_DIR", "")
+    if not out_dir:
+        return None
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"trace-r{rec.rank}.json")
+    with open(path, "w") as f:
+        json.dump(rec.snapshot(), f)
+    return path

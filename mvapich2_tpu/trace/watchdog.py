@@ -1,0 +1,107 @@
+"""Stall watchdog: automatic hang diagnostics from inside progress_wait.
+
+PR 1's intercomm-NBC starvation was diagnosed blind — wall clock and
+aggregate pvars only. This watchdog makes the next one ship its own
+post-mortem: when one progress_wait call exceeds MV2T_STALL_TIMEOUT
+seconds, a ONE-SHOT diagnostic (per engine) is emitted to the mlog
+stream and latched on the engine:
+
+    * the debugger.py message-queue snapshot (posted / unexpected /
+      pending-send queues),
+    * outstanding requests tracked by the engine,
+    * active NBC schedules (remaining / in-flight vertices),
+    * the last MV2T_STALL_EVENTS trace events (when tracing is on).
+
+Independent of MV2T_TRACE: the queue/request/schedule sections come from
+live engine state, so the watchdog works untraced; the event tail is the
+only tracing-gated section. Default off (0.0) so tests that legitimately
+block never spam; env-settable for production runs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from .. import mpit
+from ..utils.config import cvar, get_config
+from ..utils.mlog import get_logger
+
+log = get_logger("watchdog")
+
+cvar("STALL_TIMEOUT", 0.0, float, "trace",
+     "Seconds one progress_wait may block before the stall watchdog "
+     "emits its one-shot diagnostic (0 = off; default off in tests).")
+cvar("STALL_EVENTS", 64, int, "trace",
+     "How many trailing trace events the stall diagnostic includes "
+     "(only when MV2T_TRACE is on).")
+
+_pv_trips = mpit.pvar("stall_watchdog_trips", mpit.PVAR_CLASS_COUNTER,
+                      "trace", "stall-watchdog diagnostics emitted "
+                      "(one-shot per progress engine)")
+
+
+def configure(engine) -> None:
+    """Arm (or disarm) the watchdog on ``engine`` from the cvar registry
+    — called from Universe.initialize after the config reload, so the
+    hot path only ever checks the cached ``_stall_limit`` attribute."""
+    limit = float(get_config().get("STALL_TIMEOUT", 0.0) or 0.0)
+    engine._stall_limit = limit if limit > 0 else None
+    engine._stall_tripped = False
+
+
+def build_report(engine) -> str:
+    """Assemble the diagnostic text from live engine state. Safe to call
+    from the stalled waiter: progress_wait holds no engine mutex at its
+    sleep point, and every section takes the mutex itself."""
+    lines = [f"# stall watchdog, world rank {engine.rank}: progress_wait "
+             f"exceeded {getattr(engine, '_stall_limit', 0)}s"]
+
+    u = getattr(engine, "universe", None)
+    if u is not None and getattr(u, "protocol", None) is not None:
+        from ..debugger import dump_message_queues
+        try:
+            lines.append(dump_message_queues(u).format())
+        except Exception as e:   # diagnostics must never kill the waiter
+            lines.append(f"## message queues unavailable: {e!r}")
+    else:
+        lines.append("## message queues unavailable (no universe bound)")
+
+    with engine.mutex:
+        reqs = list(engine.outstanding.values())
+        lines.append(f"## outstanding requests ({len(reqs)})")
+        for req in reqs[:32]:
+            lines.append(f"  {req!r}")
+        nbc = getattr(engine, "nbc", None)
+        scheds = list(nbc.active) if nbc is not None else []
+    lines.append(f"## active NBC schedules ({len(scheds)})")
+    for st in scheds[:16]:
+        lines.append(f"  {st.req.kind}: {st.remaining} vertices remaining, "
+                     f"in-flight={sorted(st.inflight)} "
+                     f"ready={sorted(st.ready)}")
+
+    tracer = getattr(engine, "tracer", None)
+    if tracer is not None:
+        n = int(get_config().get("STALL_EVENTS", 64))
+        tail = tracer.tail(n)
+        lines.append(f"## last {len(tail)} trace events")
+        for ts, layer, name, ph, args in tail:
+            lines.append(f"  {ts:.6f} [{layer}] {name} {ph}"
+                         f"{' ' + repr(args) if args else ''}")
+    return "\n".join(lines)
+
+
+def trip(engine) -> Optional[str]:
+    """One-shot diagnostic for ``engine`` (no-op after the first trip —
+    a hung job would otherwise emit one report per backoff cycle)."""
+    if getattr(engine, "_stall_tripped", False):
+        return None
+    engine._stall_tripped = True
+    _pv_trips.inc()
+    report = build_report(engine)
+    engine._stall_report = report
+    log.warn("%s", report)
+    if (tr := getattr(engine, "tracer", None)) is not None:
+        tr.record("progress", "stall_watchdog_trip", "i",
+                  t=time.monotonic())
+    return report

@@ -161,6 +161,48 @@ class Channel:
         """Bind to the owning rank's progress engine."""
         self.engine = engine
 
+    # -- traffic accounting (MPI_T per-channel counters + trace events) ---
+    def _acct_pvars(self):
+        """Lazily-declared per-channel-name pvars (the mv2_mpit.c channel
+        counter discipline: bytes/messages per direction). Shared by
+        every instance of a channel class in the process — same
+        aggregation scope as every other pvar here."""
+        pv = getattr(self, "_acct_pv", None)
+        if pv is None:
+            from .. import mpit
+            n = self.name
+            pv = (mpit.pvar(f"chan_{n}_msgs_sent",
+                            mpit.PVAR_CLASS_COUNTER, "channel",
+                            f"packets sent on the {n} channel"),
+                  mpit.pvar(f"chan_{n}_bytes_sent",
+                            mpit.PVAR_CLASS_COUNTER, "channel",
+                            f"wire bytes sent on the {n} channel"),
+                  mpit.pvar(f"chan_{n}_msgs_recv",
+                            mpit.PVAR_CLASS_COUNTER, "channel",
+                            f"packets received on the {n} channel"),
+                  mpit.pvar(f"chan_{n}_bytes_recv",
+                            mpit.PVAR_CLASS_COUNTER, "channel",
+                            f"wire bytes received on the {n} channel"))
+            self._acct_pv = pv
+        return pv
+
+    def account_send(self, dest_world: int, nbytes: int) -> None:
+        pv = self._acct_pvars()
+        pv[0].inc()
+        pv[1].inc(nbytes)
+        eng = getattr(self, "engine", None)
+        if eng is not None and (tr := eng.tracer) is not None:
+            tr.record("channel", f"{self.name}_send", "i",
+                      dest=dest_world, bytes=nbytes)
+
+    def account_recv(self, nbytes: int) -> None:
+        pv = self._acct_pvars()
+        pv[2].inc()
+        pv[3].inc(nbytes)
+        eng = getattr(self, "engine", None)
+        if eng is not None and (tr := eng.tracer) is not None:
+            tr.record("channel", f"{self.name}_recv", "i", bytes=nbytes)
+
     def send_packet(self, dest_world: int, pkt: Packet) -> None:
         raise NotImplementedError
 

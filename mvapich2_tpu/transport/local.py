@@ -71,6 +71,10 @@ class LocalChannel(Channel):
             # the user buffer (zero-copy eager), which the user can
             # overwrite the moment the send completes locally.
             pkt.data = np.array(pkt.data, dtype=np.uint8, copy=True)
+        # no wire blob on the thread fabric: the payload size is the
+        # honest byte count (delivery is a reference hop, recv side has
+        # no channel pass — send-side accounting covers the traffic)
+        self.account_send(dest_world, pkt.nbytes)
         self.fabric.deliver(dest_world, pkt)
 
     def poll(self) -> bool:

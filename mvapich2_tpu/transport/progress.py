@@ -65,8 +65,19 @@ class ProgressEngine:
         self.outstanding: Dict[int, Request] = {}
         # registered progress hooks (nonblocking-coll scheduler, RMA flush)
         self.hooks: List[Callable[[], bool]] = []
-        self.poll_count = 0      # MPI_T pvar analog (ch3_progress.c:218)
         self.shutdown = False
+        # retired-work counters (drain_all reports the delta so Finalize
+        # can log leftover traffic it had to flush)
+        self.retired_pkts = 0
+        self.retired_hooks = 0
+        # trace/watchdog attach points (trace/recorder.py sets tracer,
+        # trace/watchdog.py arms _stall_limit; both from
+        # Universe.initialize after the config reload). None/None keeps
+        # the hot paths at one attribute check when observability is off.
+        self.tracer = None
+        self.universe = None
+        self._stall_limit: Optional[float] = None
+        self._stall_tripped = False
         from .. import mpit
         self._pv_polls = mpit.pvar("progress_polls",
                                    mpit.PVAR_CLASS_COUNTER, "progress",
@@ -189,21 +200,41 @@ class ProgressEngine:
     def progress_poke(self) -> bool:
         """One nonblocking pass (MPID_Progress_test analog)."""
         with self.mutex:
-            self.poll_count += 1
             self._pv_polls.inc()
-            did = self._drain_inbox() > 0
+            npkts = self._drain_inbox()
+            chan_did = False
             for ch in self.channels:
                 if ch.poll():
-                    did = True
-            did = self._drain_inbox() > 0 or did
+                    chan_did = True
+            npkts += self._drain_inbox()
+            nhooks = 0
             for hook in list(self.hooks):
                 if hook():
-                    did = True
-        return did
+                    nhooks += 1
+            self.retired_pkts += npkts
+            self.retired_hooks += nhooks
+        return bool(npkts or chan_did or nhooks)
 
     def progress_wait(self, pred: Callable[[], bool],
                       timeout: Optional[float] = None) -> None:
         """Poll/sleep until ``pred()`` — MPID_Progress_wait analog."""
+        tr = self.tracer
+        if tr is None and self._stall_limit is None:
+            return self._progress_wait(pred, timeout, None, None)
+        stall_at = None
+        if self._stall_limit is not None and not self._stall_tripped:
+            stall_at = time.monotonic() + self._stall_limit
+        if tr is not None:
+            tr.record("progress", "progress_wait", "B")
+        try:
+            return self._progress_wait(pred, timeout, tr, stall_at)
+        finally:
+            if tr is not None:
+                tr.record("progress", "progress_wait", "E")
+
+    def _progress_wait(self, pred: Callable[[], bool],
+                       timeout: Optional[float], tr,
+                       stall_at: Optional[float]) -> None:
         deadline = None if timeout is None else time.monotonic() + timeout
         spin = 0
         while True:
@@ -227,6 +258,13 @@ class ProgressEngine:
                 spin += 1
                 if deadline is not None and time.monotonic() > deadline:
                     raise TimeoutError("progress_wait timed out")
+                if stall_at is not None and not self._stall_tripped \
+                        and time.monotonic() > stall_at:
+                    # one-shot hang diagnostic (queue snapshot, requests,
+                    # NBC schedules, trace tail) — the wait itself keeps
+                    # going; the watchdog observes, it does not unwind
+                    from ..trace import watchdog as _wd
+                    _wd.trip(self)
                 # Idle strategy: block on the union of the channels'
                 # wakeup fds (shm doorbells, tcp sockets) so a peer's
                 # send wakes us via a direct context switch. Never
@@ -241,6 +279,9 @@ class ProgressEngine:
                 # peer whose send we are waiting on, and the doorbell /
                 # condvar still ends the sleep early.
                 idle_t = min(0.0005 * (1 << min(spin - 1, 4)), 0.008)
+                if tr is not None:
+                    tr.record("progress", "idle", "B", spin=spin)
+                woken = False
                 import select as _select
                 fds = []
                 for ch in self.channels:
@@ -253,6 +294,7 @@ class ProgressEngine:
                     except (OSError, ValueError):
                         pass
                     else:
+                        woken = bool(r)
                         if self._wake_r in r:
                             import os as _os
                             try:
@@ -263,12 +305,22 @@ class ProgressEngine:
                     with self._inbox_cond:
                         if not self._inbox and self._wake_gen == gen:
                             self._inbox_cond.wait(timeout=idle_t)
+                        else:
+                            woken = True
+                if tr is not None:
+                    tr.record("progress", "idle", "E")
+                    if woken:
+                        tr.record("progress", "wake", "i")
             finally:
                 for ch in self.channels:
                     ch.post_wait()
 
-    def drain_all(self, timeout: float = 5.0) -> None:
-        """Progress until no work remains (used at Finalize/quiesce)."""
+    def drain_all(self, timeout: float = 5.0) -> int:
+        """Progress until no work remains (used at Finalize/quiesce).
+        Returns how much leftover work it retired — packets dispatched
+        plus hook advances — so Finalize can log traffic that was still
+        in flight when the application called it."""
+        p0, h0 = self.retired_pkts, self.retired_hooks
         end = time.monotonic() + timeout
         idle = 0
         while time.monotonic() < end:
@@ -277,8 +329,9 @@ class ProgressEngine:
             else:
                 idle += 1
                 if idle > 3:
-                    return
+                    break
                 time.sleep(0.0002)
+        return (self.retired_pkts - p0) + (self.retired_hooks - h0)
 
     def close(self) -> None:
         self.shutdown = True

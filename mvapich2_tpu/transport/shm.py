@@ -561,6 +561,10 @@ class ShmChannel(Channel):
 
     def send_packet(self, dest_world: int, pkt: Packet) -> None:
         blob = encode_packet(pkt)
+        # python-injected traffic only; the C plane's eager fast path
+        # bypasses send_packet entirely and keeps its own counters
+        # (cplane_eager_tx et al.)
+        self.account_send(dest_world, len(blob))
         dst_i = self.local_index[dest_world]
         if self.plane:
             # plane mode: the C injector owns ordering + backlog; spill
@@ -657,6 +661,7 @@ class ShmChannel(Channel):
                     with open(path, "rb") as f:
                         blob = f.read()
                     os.unlink(path)
+                self.account_recv(len(blob))
                 self.engine.enqueue_incoming(decode_packet(blob))
                 did = True
         return did

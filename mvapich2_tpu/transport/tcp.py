@@ -91,6 +91,7 @@ class TcpChannel(Channel):
             conn.outq.append(_LEN.pack(len(blob)))
             conn.outq.append(blob)
             self._flush(conn)
+        self.account_send(dest_world, 4 + len(blob))
 
     def _flush(self, conn: _Conn) -> bool:
         """Nonblocking flush of the backlog; True if fully drained."""
@@ -143,6 +144,7 @@ class TcpChannel(Channel):
             return False
         pkt = decode_packet(bytes(buf[4:4 + blen]))
         del buf[:4 + blen]
+        self.account_recv(4 + blen)
         self.engine.enqueue_incoming(pkt)
         return True
 

@@ -1,0 +1,85 @@
+"""Trace-overhead guard: tracing-off must stay in the noise on the
+osu_latency-shaped ping-pong path, so the recorder can stay compiled-in.
+
+The trace-off cost at every instrumented site is ONE attribute check
+(``engine.tracer is None``) plus, on the channel layer, the per-packet
+pvar increments. There is no un-instrumented build to A/B against, so
+the guard measures those exact unit costs on this host, scales them by a
+deliberately generous per-message site count, and asserts the total is
+under 5% of the measured per-message latency. If someone fattens the
+gate (a config lookup, a dict build) or slows PVar.inc, this trips.
+
+Launched via: python -m mvapich2_tpu.run -np 2 tests/progs/trace_overhead_prog.py
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from mvapich2_tpu import mpi, mpit  # noqa: E402
+
+ITERS = 300
+SKIP = 50
+# per ping-pong message, generous upper bounds for trace-off work:
+GATE_SITES = 16     # tracer-is-None checks (mpi/protocol/progress/nbc/chan)
+PVINC_SITES = 8     # channel + protocol counter increments
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+rank, size = comm.rank, comm.size
+assert size == 2, "trace_overhead_prog requires exactly 2 ranks"
+
+sbuf = np.zeros(8, np.uint8)
+rbuf = np.zeros(8, np.uint8)
+comm.barrier()
+if rank == 0:
+    for i in range(ITERS + SKIP):
+        if i == SKIP:
+            t0 = time.perf_counter()
+        comm.send(sbuf, dest=1, tag=1)
+        comm.recv(rbuf, source=1, tag=1)
+    lat = (time.perf_counter() - t0) / ITERS / 2    # one-way seconds
+else:
+    for i in range(ITERS + SKIP):
+        comm.recv(rbuf, source=0, tag=1)
+        comm.send(sbuf, dest=0, tag=1)
+
+errs = 0
+if rank == 0 and comm.u.engine.tracer is not None:
+    # run under bin/mpitrace: the off-cost guard is meaningless with the
+    # recorder attached — report and pass (the tier-1 test runs untraced)
+    print("tracing is ON; skipping the trace-off overhead guard")
+elif rank == 0:
+    eng = comm.u.engine
+    n = 200000
+    t0 = time.perf_counter()
+    hits = 0
+    for _ in range(n):
+        if eng.tracer is not None:      # the exact trace-off gate
+            hits += 1
+    t_gate = (time.perf_counter() - t0) / n
+    assert hits == 0
+
+    pv = mpit.pvar("trace_overhead_probe", mpit.PVAR_CLASS_COUNTER,
+                   "test", "overhead-guard probe counter")
+    t0 = time.perf_counter()
+    for _ in range(n):
+        pv.inc()
+    t_inc = (time.perf_counter() - t0) / n
+
+    overhead = GATE_SITES * t_gate + PVINC_SITES * t_inc
+    frac = overhead / lat
+    print(f"latency {lat * 1e6:.2f} us/msg; gate {t_gate * 1e9:.1f} ns; "
+          f"pvar.inc {t_inc * 1e9:.1f} ns; trace-off overhead "
+          f"{overhead * 1e6:.3f} us/msg = {frac * 100:.2f}% of latency")
+    if frac >= 0.05:
+        errs += 1
+        print(f"trace-off overhead {frac * 100:.2f}% >= 5% budget")
+
+comm.barrier()
+if rank == 0 and errs == 0:
+    print("No Errors")
+mpi.Finalize()
+sys.exit(1 if errs else 0)

@@ -102,6 +102,88 @@ def test_categories():
     assert "recvq_match_attempts" in info["pvars"]
 
 
+def test_channel_and_protocol_pvars_in_categories():
+    """The per-channel byte/message counters and the trace/watchdog pvars
+    enumerate under category_get_info (mv2_mpit.c channel-counter
+    discipline)."""
+    import mvapich2_tpu.trace  # noqa: F401  (declares the trace pvars)
+
+    def body(comm):
+        comm.sendrecv(np.ones(8), (comm.rank + 1) % comm.size, 1,
+                      np.zeros(8), (comm.rank - 1) % comm.size, 1)
+        return True
+
+    run_ranks(2, body)
+    cats = mpit.category_names()
+    assert "channel" in cats and "trace" in cats
+    info = mpit.category_get_info(cats.index("channel"))
+    assert "chan_local_msgs_sent" in info["pvars"]
+    assert "chan_local_bytes_sent" in info["pvars"]
+    assert mpit.pvar("chan_local_msgs_sent").read() > 0
+    assert mpit.pvar("chan_local_bytes_sent").read() >= 8 * 8
+    tinfo = mpit.category_get_info(cats.index("trace"))
+    assert "stall_watchdog_trips" in tinfo["pvars"]
+    assert "TRACE" in tinfo["cvars"] and "STALL_TIMEOUT" in tinfo["cvars"]
+    ptinfo = mpit.category_get_info(cats.index("pt2pt"))
+    assert "pt2pt_eager_sent" in ptinfo["pvars"]
+    assert "pt2pt_rndv_sent" in ptinfo["pvars"]
+
+
+def test_sourced_pvar_rebound_across_restart():
+    """MPI_T session vs a universe restart: a sourced pvar's callable is
+    rebound on re-declare (fresh universe), so a session created after
+    the restart reads the NEW source — the stale source must not
+    survive. Mirrors how progress/cplane counters rebind when process
+    mode re-initializes."""
+    old_engine = {"polls": 7.0}
+    pv = mpit.pvar("test_restart_sourced", mpit.PVAR_CLASS_COUNTER,
+                   "test", "restart rebind probe",
+                   source=lambda: old_engine["polls"])
+    sess = mpit.pvar_session_create()
+    h = sess.handle_alloc("test_restart_sourced")
+    sess.start(h)
+    old_engine["polls"] = 10.0
+    assert sess.read(h) == 3.0          # delta against the session base
+
+    # "universe restart": a new owner re-declares with its own source;
+    # the registry must swap callables in place (same PVar object)
+    new_engine = {"polls": 100.0}
+    pv2 = mpit.pvar("test_restart_sourced", mpit.PVAR_CLASS_COUNTER,
+                    "test", "restart rebind probe",
+                    source=lambda: new_engine["polls"])
+    assert pv2 is pv
+    assert pv.read() == 100.0           # stale source is gone
+    old_engine["polls"] = 99999.0       # the dead universe moves on
+    assert pv.read() == 100.0
+    sess2 = mpit.pvar_session_create()
+    h2 = sess2.handle_alloc("test_restart_sourced")
+    sess2.start(h2)
+    new_engine["polls"] = 130.0
+    assert sess2.read(h2) == 30.0
+
+
+def test_highwatermark_pvar_session_semantics():
+    """Watermark (and level) pvars read INSTANTANEOUS values through a
+    session — a delta against the session base would be meaningless —
+    and survive a run_ranks restart monotonically."""
+    pv = mpit.pvar("test_hwm_probe", mpit.PVAR_CLASS_HIGHWATERMARK,
+                   "test", "watermark session probe")
+    pv.mark(5.0)
+    sess = mpit.pvar_session_create()
+    h = sess.handle_alloc("test_hwm_probe")
+    sess.start(h)
+    assert sess.read(h) == 5.0          # not 0: no delta for watermarks
+    pv.mark(3.0)
+    assert sess.read(h) == 5.0          # lower mark never regresses
+    pv.mark(9.0)
+    assert sess.read(h) == 9.0
+    # level pvars behave the same through the restart of the owning
+    # universe: nbc_scheds_active returns to 0 after each run completes
+    run_ranks(2, lambda c: c.ibarrier().wait() or True)
+    run_ranks(2, lambda c: c.ibarrier().wait() or True)
+    assert mpit.pvar("nbc_scheds_active").read() == 0
+
+
 def test_progress_poll_pvar():
     i = mpit.pvar_get_index("progress_polls")
     info = mpit.pvar_get_info(i)

@@ -1,0 +1,245 @@
+"""Distributed event tracing + stall watchdog tests (trace/).
+
+Covers: ring-buffer recorder attach/dump over the thread harness,
+Perfetto merge schema + event ordering (enter<=exit, vertex issue before
+complete), the bin/mpitrace end-to-end flow on a 4-rank process-mode
+allreduce+NBC workload, the one-shot stall watchdog, drain_all leftover
+reporting, and the tracing-off overhead guard.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from mvapich2_tpu import mpit, trace
+from mvapich2_tpu.runtime.universe import local_universe, run_ranks
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _workload(comm):
+    comm.allreduce(np.full(64, float(comm.rank + 1)))
+    big = np.full(1 << 17, float(comm.rank), np.float64)
+    rbig = np.zeros(1 << 17, np.float64)
+    comm.sendrecv(big, (comm.rank + 1) % comm.size, 3,
+                  rbig, (comm.rank - 1) % comm.size, 3)
+    rg = np.zeros(comm.size, np.float64)
+    req = comm.iallgather(np.array([comm.rank * 2.0]), rg)
+    req.wait()
+    assert rg.tolist() == [r * 2.0 for r in range(comm.size)]
+    return True
+
+
+def _check_merged(merged, nranks):
+    """Shared schema/ordering assertions for a merged trace."""
+    evs = [e for e in merged["traceEvents"] if e["ph"] != "M"]
+    assert {e["pid"] for e in evs} == set(range(nranks))
+    layers = {e["cat"] for e in evs}
+    assert {"mpi", "protocol", "progress", "nbc"} <= layers
+    # B/E spans nest per (pid, cat, name): every E matches an open B at
+    # an earlier-or-equal timestamp
+    stacks = {}
+    for e in evs:
+        key = (e["pid"], e["cat"], e["name"])
+        if e["ph"] == "B":
+            stacks.setdefault(key, []).append(e["ts"])
+        elif e["ph"] == "E":
+            opens = stacks.get(key)
+            assert opens, f"E without B: {key}"
+            assert opens.pop() <= e["ts"]
+    # nbc: per (pid, sched, vid) issue precedes complete
+    marks = {}
+    for e in evs:
+        if e["cat"] != "nbc" or "args" not in e:
+            continue
+        a = e["args"]
+        if e["name"] in ("vertex_issue", "vertex_complete"):
+            key = (e["pid"], a["sched"], a["vid"])
+            marks.setdefault(key, {})[e["name"]] = e["ts"]
+    assert marks, "no nbc vertex events recorded"
+    for key, m in marks.items():
+        assert "vertex_issue" in m, f"complete without issue: {key}"
+        if "vertex_complete" in m:
+            assert m["vertex_issue"] <= m["vertex_complete"], key
+
+
+def test_trace_inprocess_merge_schema_and_ordering(tmp_path, monkeypatch):
+    """Thread-harness tracing: 4 ranks dump at finalize; the merged
+    Perfetto JSON carries all ranks across >=4 layers with consistent
+    event ordering."""
+    monkeypatch.setenv("MV2T_TRACE", "1")
+    monkeypatch.setenv("MV2T_TRACE_DIR", str(tmp_path))
+    assert all(run_ranks(4, _workload))
+    dumps = trace.read_dumps(str(tmp_path))
+    assert [d["rank"] for d in dumps] == [0, 1, 2, 3]
+    merged = trace.merge_dir(str(tmp_path),
+                             str(tmp_path / "merged.json"))
+    _check_merged(merged, 4)
+    # the thread fabric routes through python send_packet, so the
+    # channel lane is populated too (process mode may route around it
+    # via the C plane's own counters — see README)
+    assert "channel" in {e["cat"] for e in merged["traceEvents"]
+                         if e["ph"] != "M"}
+    assert json.load(open(tmp_path / "merged.json"))["traceEvents"]
+    text = trace.summarize(dumps)
+    assert "mpi" in text and "nbc" in text
+
+
+def test_trace_off_is_detached():
+    """Default (cvar off): no recorder attaches and the MPI method table
+    stays unwrapped after a traced run ends."""
+    from mvapich2_tpu import profile
+
+    def body(comm):
+        comm.barrier()
+        return comm.u.engine.tracer is None
+
+    assert all(run_ranks(2, body))
+    assert not profile._installed
+
+
+def test_trace_ring_buffer_bounded(monkeypatch):
+    monkeypatch.setenv("MV2T_TRACE", "1")
+    monkeypatch.setenv("MV2T_TRACE_BUF", "256")
+    caps = []
+
+    def body(comm):
+        for _ in range(50):
+            comm.allreduce(np.ones(4))
+        caps.append(len(comm.u.engine.tracer.events))
+        return True
+
+    assert all(run_ranks(2, body))
+    assert all(c <= 256 for c in caps)
+
+
+def test_mpitrace_end_to_end(tmp_path):
+    """Acceptance: bin/mpitrace -np 4 on an allreduce+iallgather+ireduce
+    prog produces ONE merged Perfetto JSON with events from all 4 ranks
+    across >=4 layers, plus the per-layer summary."""
+    out = tmp_path / "merged.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "mpitrace"),
+         "-np", "4", "--out", str(out), "--dir", str(tmp_path / "dumps"),
+         sys.executable,
+         os.path.join(REPO, "tests", "progs", "trace_workload_prog.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+    assert "# trace summary" in r.stdout
+    merged = json.load(open(out))
+    _check_merged(merged, 4)
+
+
+def test_stall_watchdog_trips_exactly_once(monkeypatch):
+    """A receiver that never posts trips the watchdog ONCE, dumping the
+    posted/unexpected queues, outstanding requests, and active NBC
+    schedules — then the wait keeps going and completes normally."""
+    monkeypatch.setenv("MV2T_STALL_TIMEOUT", "0.3")
+    before = mpit.pvar("stall_watchdog_trips").read()
+    reports = []
+
+    def body(comm):
+        if comm.rank == 0:
+            nbc_req = comm.ibarrier()       # peer is asleep: stays active
+            req = comm.irecv(np.zeros(4), source=1, tag=99)
+            comm.u.engine.progress_wait(lambda: req.complete_flag,
+                                        timeout=5.0)
+            nbc_req.wait()
+            reports.append(getattr(comm.u.engine, "_stall_report", ""))
+            assert comm.u.engine._stall_tripped
+        else:
+            time.sleep(1.0)                 # force the stall window
+            comm.send(np.ones(4), dest=0, tag=99)
+            comm.ibarrier().wait()
+        return True
+
+    assert all(run_ranks(2, body))
+    assert mpit.pvar("stall_watchdog_trips").read() - before == 1
+    rep = reports[0]
+    assert "stall watchdog" in rep
+    assert "posted receives" in rep and "tag=99" in rep
+    assert "unexpected messages" in rep
+    assert "outstanding requests" in rep
+    assert "active NBC schedules (1)" in rep
+
+
+def test_stall_watchdog_off_by_default():
+    def body(comm):
+        assert comm.u.engine._stall_limit is None
+        comm.barrier()
+        return True
+
+    assert all(run_ranks(2, body))
+
+
+def test_drain_all_reports_leftover_work():
+    """Satellite: drain_all returns how many packets/hook advances it
+    retired so Finalize can log leftover traffic."""
+    universes = local_universe(2)
+    try:
+        u0, u1 = universes
+        from mvapich2_tpu.core import datatype as dt
+        buf = np.ones(8, np.float64)
+        u0.protocol.isend(buf, 8, dt.DOUBLE, dest_world=1, comm_src=0,
+                          ctx=0, tag=5).wait()
+        # the eager packet sits undispatched in rank 1's inbox
+        assert u1.engine.drain_all() >= 1
+        assert u1.engine.drain_all() == 0   # idempotent once quiet
+    finally:
+        for u in universes:
+            u.finalize()
+
+
+def test_trace_off_overhead_guard():
+    """Satellite: tracing-off adds <5% to an osu_latency-shaped
+    ping-pong in process mode (gate + counter unit costs vs measured
+    latency; see the prog for the methodology)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "mvapich2_tpu.run", "-np", "2",
+         sys.executable,
+         os.path.join(REPO, "tests", "progs", "trace_overhead_prog.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+
+
+def test_new_nbc_entry_points_profiled():
+    """Satellite: ireduce and the v-collectives are on the PMPI
+    interposition surface (PROFILED_METHODS) and work end-to-end."""
+    from mvapich2_tpu import profile
+    for name in ("ireduce", "igatherv", "iscatterv", "iallgatherv",
+                 "ialltoallv", "iscan", "ireduce_scatter_block"):
+        assert name in profile.PROFILED_METHODS
+        assert hasattr(__import__("mvapich2_tpu.core.comm",
+                                  fromlist=["Comm"]).Comm, name)
+
+    def body(comm):
+        size, rank = comm.size, comm.rank
+        out = np.zeros(size, np.float64)
+        comm.iallgatherv(np.array([float(rank)]), out,
+                         [1] * size).wait()
+        assert out.tolist() == [float(r) for r in range(size)]
+        rr = np.zeros(2, np.float64)
+        comm.ireduce(np.full(2, 1.0), rr, root=0).wait()
+        if rank == 0:
+            assert rr[0] == size
+        sc = np.zeros(1, np.float64)
+        comm.iscan(np.array([1.0]), sc).wait()
+        assert sc[0] == rank + 1
+        rs = np.zeros(1, np.float64)
+        comm.ireduce_scatter_block(np.full(size, 1.0), rs).wait()
+        assert rs[0] == size
+        return True
+
+    with profile.Profiler() as prof:
+        assert all(run_ranks(3, body))
+    assert prof.calls["iallgatherv"] == 3
+    assert prof.calls["ireduce"] == 3
+    assert prof.calls["iscan"] == 3
+    assert prof.calls["ireduce_scatter_block"] == 3
