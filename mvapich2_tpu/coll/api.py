@@ -36,6 +36,25 @@ def _packed(buf, count: int, datatype: Optional[Datatype]) -> np.ndarray:
     return datatype.pack(buf, count)
 
 
+def _packed_ro(buf, count: int, datatype: Datatype) -> np.ndarray:
+    """Read-only packed VIEW for reduction sources: a contiguous basic
+    dtype needs no staging copy — every reduction algorithm copies
+    before it mutates, and the blocking call keeps the user buffer
+    stable. On an oversubscribed host the skipped 1 MiB memcpy is paid
+    by every co-located rank in turn, so it is pure serial latency."""
+    if datatype.basic is not None and datatype.is_contiguous \
+            and datatype.basic.itemsize == datatype.size:
+        try:
+            mv = as_bytes_view(buf)
+            n = datatype.size * count
+            if len(mv) >= n:
+                return np.frombuffer(mv, dtype=np.uint8,
+                                     count=n).view(datatype.basic)
+        except (ValueError, TypeError):
+            pass
+    return _packed(buf, count, datatype)
+
+
 def _unpack(arr: np.ndarray, buf, count: int,
             datatype: Optional[Datatype]) -> None:
     if datatype is None:
@@ -155,7 +174,7 @@ def allreduce(comm, sendbuf, recvbuf, count: int,
               datatype: Optional[Datatype], op: Op) -> None:
     datatype = _dt(recvbuf if sendbuf is IN_PLACE else sendbuf, datatype)
     src = recvbuf if sendbuf is IN_PLACE else sendbuf
-    arr = _packed(src, count, datatype)
+    arr = _packed_ro(src, count, datatype)
     pch = _plane_engine(comm)
     if pch is not None and datatype.basic is not None \
             and arr.nbytes <= _plane_thr(pch) and _plane_red_ok(op, arr):
@@ -165,7 +184,27 @@ def allreduce(comm, sendbuf, recvbuf, count: int,
     else:
         tag = comm.next_coll_tag()
         fn = _select(comm, "allreduce", arr.nbytes, op=op)
-    out = fn(comm, arr, op, tag)
+    dest = None
+    if sendbuf is not IN_PLACE and getattr(fn, "supports_out", False) \
+            and datatype.basic is not None and datatype.is_contiguous \
+            and datatype.basic.itemsize == datatype.size:
+        # hand the algorithm a writable view of recvbuf so the result
+        # lands in place (no staging copy; forbidden for IN_PLACE — the
+        # source stays exposed to peers until the exchange's barrier)
+        try:
+            mv = as_bytes_view(recvbuf, writable=True)
+            n = datatype.size * count
+            if len(mv) >= n:
+                dest = np.frombuffer(mv, dtype=np.uint8,
+                                     count=n).view(datatype.basic)
+        except (ValueError, TypeError):
+            dest = None
+    if dest is not None:
+        out = fn(comm, arr, op, tag, out=dest)
+        if out is dest:
+            return
+    else:
+        out = fn(comm, arr, op, tag)
     _unpack(out, recvbuf, count, datatype)
 
 
@@ -402,6 +441,7 @@ def _select(comm, name: str, nbytes: int, op: Optional[Op] = None):
                 return _fn(*a, **kw)
 
         cached.__name__ = algo
+        cached.supports_out = getattr(fn, "supports_out", False)
         _timed_cache[(name, fn)] = cached
     return cached
 

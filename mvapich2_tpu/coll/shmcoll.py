@@ -37,6 +37,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..transport.arena import cma_read
 from ..utils.config import cvar, get_config
 from ..utils.mlog import get_logger
 
@@ -45,11 +46,18 @@ log = get_logger("shmcoll")
 cvar("USE_SLOTTED_SHM_COLL", True, bool, "coll",
      "Use the slotted shared-memory segment for the intra-node phase of "
      "two-level collectives (MV2_USE_SHMEM_COLL analog).")
-cvar("SHM_COLL_SLOT_LEN", 8192, int, "coll",
+cvar("USE_ARENA_COLL", True, bool, "coll",
+     "Use the arena/CMA sectioned exchange (reduce-scatter+allgather "
+     "through the per-node scratch arena, no per-chunk packet "
+     "handshakes) for large-message single-node collectives.")
+cvar("SHM_COLL_SLOT_LEN", 0, int, "coll",
      "Slot length in bytes for the shm collective segment "
-     "(ch3_shmem_coll.c:527 uses 8192).")
-cvar("SHM_COLL_NSLOTS", 4, int, "coll",
-     "Pipeline depth (slots per rank) of the shm collective segment.")
+     "(ch3_shmem_coll.c:527 uses 8192). 0 = auto-scale for large "
+     "messages: 64 KiB, so the intra-node phase is not capped at "
+     "4x8 KiB in flight.")
+cvar("SHM_COLL_NSLOTS", 0, int, "coll",
+     "Pipeline depth (slots per rank) of the shm collective segment. "
+     "0 = auto (8).")
 
 _POLL_TIMEOUT = 120.0
 
@@ -61,6 +69,16 @@ def _fence() -> None:
     stores before the following counter store on weakly-ordered CPUs."""
     with _fence_lock:
         pass
+
+
+def _slot_params():
+    """(slot_len, nslots) with the auto-scale defaults applied: 64 KiB
+    x 8 unless a cvar override pins them (the large-message satellite —
+    8 KiB x 4 capped the intra-node phase at 32 KiB in flight)."""
+    cfg = get_config()
+    slot = int(cfg["SHM_COLL_SLOT_LEN"]) or 64 * 1024
+    nslots = int(cfg["SHM_COLL_NSLOTS"]) or 8
+    return slot, nslots
 
 
 def _shm_dir() -> str:
@@ -76,9 +94,7 @@ class ShmCollSegment:
         self.comm = shmem_comm
         self.p = shmem_comm.size
         self.rank = shmem_comm.rank
-        cfg = get_config()
-        self.slot = int(cfg["SHM_COLL_SLOT_LEN"])
-        self.nslots = int(cfg["SHM_COLL_NSLOTS"])
+        self.slot, self.nslots = _slot_params()
         # per-phase chunk-id bases (monotonic). They must be separate:
         # the reduce flow control compares ids against consumed[] and the
         # bcast flow control against bc[], so a shared base would open an
@@ -87,7 +103,10 @@ class ShmCollSegment:
         self._rbase = 0
         self._bbase = 0
 
-        hdr = 8 * (self.p + self.p + 1 + self.p)
+        # slotted counters + the sectioned-exchange header (xseq/xmeta:
+        # per-call buffer exposure, sdone/smeta: reduced-section
+        # publication, rdone: read-done barrier)
+        hdr = 8 * (self.p + self.p + 1 + self.p) + 8 * 11 * self.p
         size = hdr + self.p * self.nslots * self.slot \
             + self.nslots * self.slot
         # Construction is collective: a failure on ANY rank must be
@@ -152,16 +171,33 @@ class ShmCollSegment:
         o += 8 * self.p
         self.bw = buf[o:o + 8].view(np.uint64); o += 8
         self.bc = buf[o:o + 8 * self.p].view(np.uint64); o += 8 * self.p
+        self.xseq = buf[o:o + 8 * self.p].view(np.uint64); o += 8 * self.p
+        self.xmeta = buf[o:o + 8 * 4 * self.p].view(np.uint64).reshape(
+            self.p, 4)
+        o += 8 * 4 * self.p
+        self.sdone = buf[o:o + 8 * self.p].view(np.uint64); o += 8 * self.p
+        self.smeta = buf[o:o + 8 * 4 * self.p].view(np.uint64).reshape(
+            self.p, 4)
+        o += 8 * 4 * self.p
+        self.rdone = buf[o:o + 8 * self.p].view(np.uint64); o += 8 * self.p
         self.rslots = buf[o:o + self.p * self.nslots * self.slot].reshape(
             self.p, self.nslots, self.slot)
         o += self.p * self.nslots * self.slot
         self.bslots = buf[o:o + self.nslots * self.slot].reshape(
             self.nslots, self.slot)
+        # sectioned-exchange call counter (monotonic; collectives are
+        # issued in the same order on every rank of a comm)
+        self._xbase = 0
         if self.rank == 0:
             self.written[:] = 0
             self.consumed[:] = 0
             self.bw[0] = 0
             self.bc[:] = 0
+            self.xseq[:] = 0
+            self.xmeta[:] = 0
+            self.sdone[:] = 0
+            self.smeta[:] = 0
+            self.rdone[:] = 0
         shmem_comm.barrier()
         # the leader unlinks at free()/Comm.free()/interpreter exit
         # (atexit); a SIGKILLed job leaves the file to the tmp reaper
@@ -169,15 +205,25 @@ class ShmCollSegment:
     # -- polling ---------------------------------------------------------
     @staticmethod
     def _wait(pred) -> None:
-        deadline = time.monotonic() + _POLL_TIMEOUT
+        """Spin briefly, then yield the core, then sleep. On an
+        oversubscribed host the yield matters most: a hot 1024-spin loop
+        before the first sleep burns the very quantum the peer needs to
+        make the predicate true."""
+        deadline = None
         spins = 0
         while not pred():
             spins += 1
-            if spins & 0x3FF == 0:
-                if time.monotonic() > deadline:
+            if spins < 64:
+                continue
+            if spins & 7 == 0:
+                os.sched_yield()
+            if spins & 0xFFF == 0:
+                if deadline is None:
+                    deadline = time.monotonic() + _POLL_TIMEOUT
+                elif time.monotonic() > deadline:
                     raise TimeoutError("shm collective segment stalled "
                                        "(peer died?)")
-                time.sleep(0.0005)
+                time.sleep(0.0002)
 
     # -- intra-node reduce (everyone -> leader) --------------------------
     def reduce_to_leader(self, arr: np.ndarray, op) -> Optional[np.ndarray]:
@@ -259,6 +305,185 @@ class ShmCollSegment:
             raw[lo:hi] = self.bslots[cid % self.nslots, :hi - lo]
             self.bc[self.rank] = cid + 1
 
+    # -- sectioned arena/CMA exchange (large-message tier) ---------------
+    # The reduce-scatter+allgather shape of allreduce_osu.c:633 executed
+    # entirely through shared memory: each rank exposes its contribution
+    # (a CMA address when the unanimous probe passed, an arena-staged
+    # copy otherwise), reduces its OWN section by reading every peer's
+    # copy of that section, publishes the reduced section, and gathers
+    # the rest. Flow control is three monotonic counter waves (exposed /
+    # section-done / read-done) in the segment header — zero packet
+    # handshakes, which on an oversubscribed host is the entire cost of
+    # the per-chunk rendezvous this replaces.
+
+    XK_ABORT, XK_CMA, XK_ARENA = 0, 1, 2
+
+    def _publish(self, meta, kind: int, addr: int, nbytes: int,
+                 seq: int, seqs) -> None:
+        row = meta[self.rank]
+        row[0] = kind
+        row[1] = os.getpid()
+        row[2] = addr
+        row[3] = nbytes
+        _fence()
+        seqs[self.rank] = seq
+
+    def _fetch(self, meta, r: int, lo: int, out: np.ndarray,
+               arena, chunk: int, tracer=None) -> None:
+        """Copy ``out.nbytes`` bytes at offset ``lo`` of rank ``r``'s
+        exposed buffer into ``out``."""
+        kind = int(meta[r, 0])
+        if kind == self.XK_CMA:
+            cma_read(int(meta[r, 1]), int(meta[r, 2]) + lo, out,
+                     chunk=chunk, tracer=tracer)
+        else:
+            out[:] = arena.view(int(meta[r, 2]) + lo, out.nbytes)
+            if tracer is not None:
+                tracer.record("protocol", "rndv_chunk", "i", dir="coll",
+                              bytes=out.nbytes)
+
+    def allreduce_sections(self, arr: np.ndarray, op, arena, cma_ok: bool,
+                           tracer=None,
+                           out: Optional[np.ndarray] = None
+                           ) -> Optional[np.ndarray]:
+        """Sectioned allreduce across the node; returns the result array
+        (``out`` itself when a correctly-sized byte destination was
+        supplied — the gather lands straight in the caller's receive
+        buffer, skipping the staging copy) or None when the exchange
+        could not run (arena exhausted on any rank — the abort is
+        agreed, so every rank falls back together)."""
+        p, rank = self.p, self.rank
+        a = np.ascontiguousarray(arr)
+        raw = a.view(np.uint8).reshape(-1)
+        nb = raw.size
+        self._xbase += 1
+        seq = self._xbase
+        # element-aligned sections so the reduce runs in dtype
+        from .algorithms import _block_ranges
+        ecounts, edispls = _block_ranges(a.size, p)
+        isz = a.itemsize
+        chunk = int(get_config()["RNDV_CHUNK"]) or (256 * 1024)
+        # 1. expose my contribution
+        stage = None
+        if cma_ok:
+            self._publish(self.xmeta, self.XK_CMA, raw.ctypes.data, nb,
+                          seq, self.xseq)
+        else:
+            stage = arena.alloc(nb) if arena is not None else None
+            if stage is None:
+                self._publish(self.xmeta, self.XK_ABORT, 0, 0, seq,
+                              self.xseq)
+            else:
+                arena.view(stage.off, nb)[:] = raw
+                self._publish(self.xmeta, self.XK_ARENA, stage.off, nb,
+                              seq, self.xseq)
+        for r in range(p):
+            self._wait(lambda: int(self.xseq[r]) >= seq)
+        _fence()
+        if any(int(self.xmeta[r, 0]) == self.XK_ABORT for r in range(p)):
+            # agreed fallback: keep every counter wave advancing so the
+            # next exchange starts aligned, then bail out collectively
+            self.sdone[rank] = seq
+            self.rdone[rank] = seq
+            if stage is not None:
+                arena.free(stage)
+            return None
+        # 2. reduce my section from every peer's copy of it
+        lo_b = edispls[rank] * isz
+        span_b = ecounts[rank] * isz
+        acc = raw[lo_b:lo_b + span_b].copy()
+        tmp = np.empty(span_b, dtype=np.uint8)
+        for r in range(p):
+            if r == rank or span_b == 0:
+                continue
+            self._fetch(self.xmeta, r, lo_b, tmp, arena, chunk, tracer)
+            folded = op(tmp.view(a.dtype), acc.view(a.dtype))
+            acc = np.ascontiguousarray(folded).view(np.uint8).reshape(-1)
+        # 3. publish the reduced section. Staged mode reuses my stage
+        # slab in place: peers read DISJOINT section ranges of it during
+        # their reduce, and my own range is read by nobody else.
+        if cma_ok:
+            self._publish(self.smeta, self.XK_CMA, acc.ctypes.data,
+                          span_b, seq, self.sdone)
+        else:
+            if span_b:
+                arena.view(stage.off + lo_b, span_b)[:] = acc
+            self._publish(self.smeta, self.XK_ARENA, stage.off + lo_b,
+                          span_b, seq, self.sdone)
+        # 4. gather every section
+        if out is None or out.nbytes != nb:
+            out = np.empty(nb, dtype=np.uint8)
+        else:
+            out = out.view(np.uint8).reshape(-1)
+        if span_b:
+            out[lo_b:lo_b + span_b] = acc
+        for r in range(p):
+            rb = ecounts[r] * isz
+            if r == rank or rb == 0:
+                continue
+            self._wait(lambda: int(self.sdone[r]) >= seq)
+            _fence()
+            dlo = edispls[r] * isz
+            self._fetch(self.smeta, r, 0, out[dlo:dlo + rb], arena,
+                        chunk, tracer)
+        # 5. read-done barrier: my exposed buffer / stage slab / acc must
+        # outlive every peer's reads of them
+        _fence()
+        self.rdone[rank] = seq
+        for r in range(p):
+            self._wait(lambda: int(self.rdone[r]) >= seq)
+        if stage is not None:
+            arena.free(stage)
+        return out.view(a.dtype).reshape(a.shape)
+
+    def bcast_sections(self, data: np.ndarray, root: int, arena,
+                       cma_ok: bool, tracer=None) -> bool:
+        """One-shot exposed bcast: the root publishes its buffer (CMA) or
+        an arena-staged copy; every rank pulls it whole (chunked CMA
+        reads). Returns False on the agreed arena-exhausted fallback."""
+        p, rank = self.p, self.rank
+        raw = data.view(np.uint8).reshape(-1)
+        nb = raw.size
+        self._xbase += 1
+        seq = self._xbase
+        chunk = int(get_config()["RNDV_CHUNK"]) or (256 * 1024)
+        stage = None
+        if rank == root:
+            if cma_ok:
+                self._publish(self.xmeta, self.XK_CMA, raw.ctypes.data,
+                              nb, seq, self.xseq)
+            else:
+                stage = arena.alloc(nb) if arena is not None else None
+                if stage is None:
+                    self._publish(self.xmeta, self.XK_ABORT, 0, 0, seq,
+                                  self.xseq)
+                else:
+                    arena.view(stage.off, nb)[:] = raw
+                    self._publish(self.xmeta, self.XK_ARENA, stage.off,
+                                  nb, seq, self.xseq)
+            self.sdone[rank] = seq
+            ok = stage is not None or cma_ok
+            self.rdone[rank] = seq
+            for r in range(p):
+                self._wait(lambda: int(self.rdone[r]) >= seq)
+            if stage is not None:
+                arena.free(stage)
+            return ok
+        self.xseq[rank] = seq
+        self.sdone[rank] = seq
+        self._wait(lambda: int(self.xseq[root]) >= seq)
+        _fence()
+        ok = int(self.xmeta[root, 0]) != self.XK_ABORT
+        if ok and nb > 0:
+            self._fetch(self.xmeta, root, 0, raw, arena, chunk, tracer)
+        _fence()
+        self.rdone[rank] = seq
+        if not ok:
+            return False
+        # non-roots may leave immediately: their counters are all at seq
+        # and they expose nothing a peer could still be reading
+        return True
+
     def _unlink(self) -> None:
         if self.rank == 0 and not self._unlinked:
             self._unlinked = True
@@ -312,7 +537,7 @@ def allreduce_two_level_slotted(comm, arr: np.ndarray, op, tag: int,
     if shmem is None or shmem.size < 2:
         return inter(comm, arr, op, tag)
     seg = None
-    if np.asarray(arr).itemsize <= get_config()["SHM_COLL_SLOT_LEN"]:
+    if np.asarray(arr).itemsize <= _slot_params()[0]:
         seg = _segment_for(comm)
     if seg is None:
         return alg.allreduce_two_level(comm, arr, op, tag, inter)
@@ -323,3 +548,80 @@ def allreduce_two_level_slotted(comm, arr: np.ndarray, op, tag: int,
         np.ascontiguousarray(arr))
     seg.bcast_from_leader(out)
     return out.reshape(arr.shape)
+
+
+# ---------------------------------------------------------------------------
+# the large-message tier: arena/CMA sectioned exchange
+# ---------------------------------------------------------------------------
+
+def _node_exchange_ctx(comm):
+    """(segment, arena, cma_ok, tracer) when the sectioned exchange can
+    run on ``comm``: every rank on one node, a shared segment, and either
+    the unanimous CMA verdict or a usable arena. None otherwise."""
+    if not get_config()["USE_ARENA_COLL"]:
+        return None
+    if comm.size < 2:
+        return None
+    shmem, _ = comm.build_2level()
+    if shmem is None or shmem.size != comm.size:
+        return None
+    seg = _segment_for(comm)
+    if seg is None:
+        return None
+    ch = getattr(comm.u, "shm_channel", None)
+    if ch is not None:
+        arena = ch.arena if getattr(ch, "_arena_ready", False) else None
+        cma_ok = bool(getattr(ch, "cma_ok", False))
+    else:
+        # in-process fabric: co-located "ranks" are threads of this very
+        # process, so the CMA read path is trivially available
+        other = next((r for r in range(comm.size) if r != comm.rank))
+        chan = comm.u.channel_for(comm.world_of(other))
+        if getattr(chan, "name", "") != "local":
+            return None
+        arena, cma_ok = None, True
+    if arena is None and not cma_ok:
+        return None
+    tracer = getattr(comm.u.engine, "tracer", None)
+    return seg, arena, cma_ok, tracer
+
+
+def allreduce_rsa_arena(comm, arr: np.ndarray, op, tag: int,
+                        inter_algo=None, out=None) -> np.ndarray:
+    """Large-message allreduce tier: single-node comms run the sectioned
+    reduce-scatter+allgather through the arena/CMA exchange; multi-node
+    comms take the two-level path (slotted intra phases, Rabenseifner
+    between the leaders). ``out`` (same byte length as ``arr``) lets the
+    gather land straight in the caller's receive buffer."""
+    from . import algorithms as alg
+    inter = inter_algo or alg.allreduce_reduce_scatter_allgather
+    ctx = None
+    if np.asarray(arr).size >= comm.size:
+        ctx = _node_exchange_ctx(comm)
+    if ctx is None:
+        return allreduce_two_level_slotted(comm, arr, op, tag, inter)
+    seg, arena, cma_ok, tracer = ctx
+    dest = out if out is not None \
+        and out.nbytes == np.asarray(arr).nbytes else None
+    res = seg.allreduce_sections(arr, op, arena, cma_ok, tracer, dest)
+    if res is None:     # arena exhausted somewhere: agreed fallback
+        return alg.allreduce_two_level(comm, arr, op, tag, inter)
+    return dest if dest is not None else res
+
+
+allreduce_rsa_arena.supports_out = True
+
+
+def bcast_arena(comm, data: np.ndarray, root: int, tag: int) -> None:
+    """Large-message bcast tier: single-node comms pull straight from the
+    root's exposed buffer (CMA) or its arena-staged copy; everything else
+    falls back to scatter_ring_allgather."""
+    from . import algorithms as alg
+    ctx = _node_exchange_ctx(comm) if data.flags.c_contiguous else None
+    if ctx is None:
+        return alg.bcast_scatter_ring_allgather(comm, data, root, tag)
+    seg, arena, cma_ok, tracer = ctx
+    # single-node split keys on comm rank, so shmem rank == comm rank;
+    # non-roots receive in place through data's contiguous byte view
+    if not seg.bcast_sections(data, root, arena, cma_ok, tracer):
+        return alg.bcast_scatter_ring_allgather(comm, data, root, tag)

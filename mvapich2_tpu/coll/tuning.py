@@ -71,9 +71,12 @@ ALGOS: Dict[str, Dict[str, Callable]] = {
     },
 }
 
-from .shmcoll import allreduce_two_level_slotted  # noqa: E402
+from .shmcoll import (allreduce_rsa_arena,  # noqa: E402
+                      allreduce_two_level_slotted, bcast_arena)
 
 ALGOS["allreduce"]["two_level_slotted"] = allreduce_two_level_slotted
+ALGOS["allreduce"]["rsa_arena"] = allreduce_rsa_arena
+ALGOS["bcast"]["arena"] = bcast_arena
 
 # ---------------------------------------------------------------------------
 # default tables: rows of (msg-size upper bound, algo name); the last row's
@@ -84,14 +87,19 @@ ALGOS["allreduce"]["two_level_slotted"] = allreduce_two_level_slotted
 Table = List[Tuple[Optional[int], str]]
 
 DEFAULT_TABLES: Dict[str, Dict[str, Table]] = {
-    # comm-size class: "small" (<= 8), "large" (> 8)
+    # comm-size class: "small" (<= 8), "large" (> 8). The top bin is the
+    # large-message tier: the arena/CMA sectioned exchange (zero packet
+    # handshakes on a single node; reduce-scatter+allgather shape), with
+    # graceful internal fallback to two-level/ring when it cannot run.
     "allreduce": {
-        "small": [(16 * 1024, "rd"), (None, "ring")],
-        "large": [(8 * 1024, "rd"), (512 * 1024, "rsa"), (None, "ring")],
+        "small": [(16 * 1024, "rd"), (32 * 1024, "ring"),
+                  (None, "rsa_arena")],
+        "large": [(8 * 1024, "rd"), (64 * 1024, "rsa"),
+                  (None, "rsa_arena")],
     },
     "bcast": {
-        "small": [(64 * 1024, "binomial"), (None, "scatter_ring_allgather")],
-        "large": [(16 * 1024, "binomial"), (None, "scatter_ring_allgather")],
+        "small": [(64 * 1024, "binomial"), (None, "arena")],
+        "large": [(16 * 1024, "binomial"), (None, "arena")],
     },
     "allgather": {
         "small": [(32 * 1024, "bruck"), (None, "ring")],

@@ -287,6 +287,8 @@ class Pt2ptProtocol:
         eng.register_handler(PktType.RNDV_CTS, self._on_cts)
         eng.register_handler(PktType.RNDV_DATA, self._on_data)
         eng.register_handler(PktType.RNDV_FIN, self._on_fin)
+        eng.register_handler(PktType.RNDV_APUB, self._on_apipe_pub)
+        eng.register_handler(PktType.RNDV_AACK, self._on_apipe_ack)
         eng.register_handler(PktType.CANCEL_SEND_REQ, self._on_cancel_req)
         eng.register_handler(PktType.CANCEL_SEND_RESP,
                              self._on_cancel_resp)
@@ -488,6 +490,9 @@ class Pt2ptProtocol:
         packed = datatype.pack(buf, count)
         sreq.packed = np.asarray(packed)
         proto = self.cfg["RNDV_PROTOCOL"]
+        if proto == "RGET" and self._start_apipe(
+                sreq, channel, dest_world, ctx, comm_src, tag, nbytes, pch):
+            return sreq
         if proto == "RGET" and channel.supports_rget:
             sreq.protocol = "RGET"
             sreq.handle = channel.expose_buffer(sreq.packed)
@@ -636,6 +641,10 @@ class Pt2ptProtocol:
         if pkt.offset:        # retracted at the target
             sreq.cancelled = True
             sreq.status.cancelled = True
+            ap = getattr(sreq, "_ap", None)
+            if ap is not None:    # pipelined block never gets its FIN
+                ap["arena"].free(ap["block"])
+                sreq._ap = None
             if sreq.handle is not None and sreq.channel is not None \
                     and hasattr(sreq.channel, "unexpose_buffer"):
                 sreq.channel.unexpose_buffer(sreq.handle)
@@ -865,6 +874,9 @@ class Pt2ptProtocol:
         if (tr := self.engine.tracer) is not None:
             tr.record("protocol", "rndv_rts_recv", "i", src=src_world,
                       bytes=pkt.nbytes, proto=pkt.protocol)
+        if pkt.protocol == "APIPE":
+            self._apipe_recv_start(req, pkt)
+            return
         if pkt.protocol == "RGET":
             n = min(pkt.nbytes, req.capacity)
             if n > 0:
@@ -939,6 +951,209 @@ class Pt2ptProtocol:
             raise MPIException(MPI_ERR_INTERN, "FIN for unknown send")
         if (tr := self.engine.tracer) is not None:
             tr.record("protocol", "rndv_fin", "i", src=pkt.src_world)
-        if sreq.handle is not None:
-            sreq.channel.release_buffer(sreq.handle)
+        self._release_send_side(sreq)
         sreq.complete()
+
+    # ------------------------------------------------------------------
+    # pipelined arena rendezvous (APIPE): the sender copies chunk k+1
+    # into persistent arena slots while the receiver drains chunk k —
+    # the RGET pipelining of gen2/ibv_rndv.c over the per-node arena
+    # instead of RDMA reads. Flow control is BATCHED: the receiver
+    # drains every published chunk, then sends one AACK carrying the
+    # highest chunk consumed; the sender refills every slot that ACK
+    # freed (a chunk's slot may be overwritten once the chunk it
+    # carried is consumed) and answers with one APUB carrying the new
+    # publish frontier. Packets per message are ~2*nchunks/depth
+    # instead of 2*nchunks — on a host where packet handling is the
+    # cost, that is the difference between the pipeline winning and
+    # losing to the one-shot path.
+    # ------------------------------------------------------------------
+    def _start_apipe(self, sreq, channel, dest_world: int, ctx: int,
+                     comm_src: int, tag: int, nbytes: int, pch) -> bool:
+        """Start a pipelined chunked rendezvous if the channel has an
+        arena and the message spans multiple chunks. Returns False to
+        fall back to the one-shot RGET ladder (which includes the
+        zero-staging CMA handle when the probe passed — pipelining there
+        happens inside the chunked pull)."""
+        arena = getattr(channel, "arena", None)
+        if arena is None or not getattr(channel, "_arena_ready", False) \
+                or getattr(channel, "cma_ok", False):
+            return False
+        chunk = self.cfg["RNDV_CHUNK"]
+        depth = max(2, self.cfg["RNDV_DEPTH"])
+        if chunk <= 0 or nbytes < 2 * chunk:
+            return False
+        nchunks = (nbytes + chunk - 1) // chunk
+        # Publish window: cover the whole message up front when it fits
+        # 1/16 of the partition — a mid-message PUB/ACK round trip costs
+        # a scheduling quantum on a single-core host, so zero-round-trip
+        # transfers (RTS + FIN only) win whenever memory allows. The
+        # cvar depth is the floor the pipeline degrades to when the
+        # arena is tight (many sends in flight). The slot window is ONE
+        # contiguous block sliced into chunk-sized slots (chunk k lives
+        # at block + (k % nslots)*chunk): a single alloc/free, and
+        # consecutive chunks publish/drain as one streaming memcpy.
+        want = min(nchunks, max(depth, arena.part_bytes // 16 // chunk))
+        block = None
+        while want >= 2:
+            block = arena.alloc(want * chunk)
+            if block is not None:
+                break
+            want //= 2              # near-exhaustion: shallower pipeline
+        if block is None:           # exhausted: one-shot/file fallback
+            return False
+        nslots = want
+        d0 = min(nslots, nchunks)
+        from ..transport import arena as arena_mod
+        data = np.ascontiguousarray(sreq.packed).view(np.uint8).reshape(-1)
+        tr = self.engine.tracer
+        span0 = min(d0 * chunk, nbytes)   # first pass: no wraparound
+        arena.view(block.off, span0)[:] = data[:span0]
+        arena_mod.pv_pipeline.inc(d0)
+        if tr is not None:
+            tr.record("protocol", "rndv_chunk", "i", dir="pub", k=0,
+                      chunks=d0, bytes=span0)
+        sreq.protocol = "APIPE"
+        sreq._ap = {"block": block, "arena": arena, "chunk": chunk,
+                    "nslots": nslots, "nchunks": nchunks, "next": d0,
+                    "data": data}
+        with self.engine.mutex:
+            self.engine.track(sreq)
+        wire_ctx = ctx | PLANE_CTX_FLAG if pch is not None else ctx
+        pkt = Packet(PktType.RNDV_RTS, self.u.world_rank, wire_ctx,
+                     comm_src, tag, nbytes, None, sreq_id=sreq.req_id,
+                     protocol="APIPE",
+                     extra={"block": block.off, "chunk": chunk,
+                            "nslots": nslots, "pub": d0})
+        self._send_pkt(channel, dest_world, pkt)
+        sreq._cancel_fn = lambda: self._cancel_send(sreq, dest_world,
+                                                    channel)
+        _pv_rndv.inc()
+        _pv_bytes.inc(nbytes)
+        if tr is not None:
+            tr.record("protocol", "rndv_rts", "i", dest=dest_world,
+                      bytes=nbytes, proto="APIPE")
+        return True
+
+    def _release_send_side(self, sreq) -> None:
+        """Free the send-side rendezvous resources (arena pipeline slots
+        and/or the exposure handle) — on FIN or a successful cancel."""
+        ap = getattr(sreq, "_ap", None)
+        if ap is not None:
+            ap["arena"].free(ap["block"])
+            sreq._ap = None
+        if sreq.handle is not None and sreq.channel is not None:
+            sreq.channel.release_buffer(sreq.handle)
+            sreq.handle = None
+
+    def _apipe_recv_start(self, req: RecvRequest, pkt: Packet) -> None:
+        """Receiver side of the pipelined rendezvous (engine mutex held):
+        set up the drain state, consume the chunks the RTS says are
+        already published, and ACK the batch so the sender refills."""
+        channel = self.u.channel_for(pkt.src_world)
+        total = pkt.nbytes
+        cap = req.capacity
+        n = min(total, cap)
+        view = None
+        if n > 0 and req.buf is not None and req.datatype.is_contiguous:
+            try:
+                mv = as_bytes_view(req.buf)
+                view = np.frombuffer(mv, dtype=np.uint8, count=cap)
+            except (ValueError, TypeError):
+                view = None
+        if view is None and n > 0:
+            # derived datatype (or no byte view): stage + unpack at end
+            req.scratch = np.empty(n, dtype=np.uint8)
+            view = req.scratch
+        chunk = pkt.extra["chunk"]
+        req._ap = {"block": pkt.extra["block"], "chunk": chunk,
+                   "nslots": pkt.extra["nslots"],
+                   "nchunks": (total + chunk - 1) // chunk, "drained": 0,
+                   "view": view, "n": n, "src": pkt.src_world,
+                   "sreq_id": pkt.sreq_id, "channel": channel,
+                   "arena": channel.arena,
+                   "env": (pkt.comm_src, pkt.tag, total)}
+        self.engine.track(req)
+        self._apipe_drain(req, pkt.extra["pub"])
+
+    def _apipe_drain(self, req: RecvRequest, upto: int) -> None:
+        from ..transport import arena as arena_mod
+        ap = req._ap
+        tr = self.engine.tracer
+        chunk, n = ap["chunk"], ap["n"]
+        nslots, block = ap["nslots"], ap["block"]
+        upto = min(upto, ap["nchunks"])
+        k = ap["drained"]
+        while k < upto:
+            # drain slot-contiguous runs in one streaming copy: chunks
+            # k..k+run-1 are consecutive in the block (no slot wrap)
+            run = min(upto - k, nslots - (k % nslots))
+            lo = k * chunk
+            span = min(run * chunk, n - lo) if lo < n else 0
+            if span > 0:
+                off = block + (k % nslots) * chunk
+                ap["view"][lo:lo + span] = ap["arena"].view(off, span)
+            arena_mod.pv_pipeline.inc(run)
+            if tr is not None:
+                tr.record("protocol", "rndv_chunk", "i", dir="drain",
+                          k=k, chunks=run, bytes=span)
+            k += run
+        ap["drained"] = k
+        if ap["drained"] < ap["nchunks"]:
+            # one ACK for the whole batch: everything <= drained-1 is
+            # consumed, so the sender may refill those chunks' slots
+            ack = Packet(PktType.RNDV_AACK, self.u.world_rank,
+                         sreq_id=ap["sreq_id"], rreq_id=req.req_id,
+                         offset=ap["drained"] - 1)
+            ap["channel"].send_packet(ap["src"], ack)
+        else:
+            if req.scratch is not None and req.buf is not None and n > 0:
+                req.datatype.unpack(req.scratch, req.buf, req.count)
+            fin = Packet(PktType.RNDV_FIN, self.u.world_rank,
+                         sreq_id=ap["sreq_id"])
+            ap["channel"].send_packet(ap["src"], fin)
+            src, tag, total = ap["env"]
+            req._ap = None
+            self._finish_recv(req, None, total, src, tag)
+
+    def _on_apipe_pub(self, pkt: Packet) -> None:
+        req = self.engine.outstanding.get(pkt.rreq_id)
+        if req is None or getattr(req, "_ap", None) is None:
+            return     # raced completion/cancel: drop
+        self._apipe_drain(req, pkt.offset + 1)
+
+    def _on_apipe_ack(self, pkt: Packet) -> None:
+        from ..transport import arena as arena_mod
+        sreq = self.engine.outstanding.get(pkt.sreq_id)
+        if sreq is None or getattr(sreq, "_ap", None) is None:
+            return
+        ap = sreq._ap
+        if ap["next"] >= ap["nchunks"]:
+            return                 # everything already published
+        chunk = ap["chunk"]
+        nbytes = len(ap["data"])
+        nslots = ap["nslots"]
+        block = ap["block"]
+        tr = self.engine.tracer
+        # chunks <= pkt.offset are consumed; chunk j reuses the slot
+        # chunk j-nslots carried, so everything through offset+nslots
+        # may be published now (slot-contiguous runs, one copy each)
+        hi = min(pkt.offset + nslots + 1, ap["nchunks"])
+        k = ap["next"]
+        if hi <= k:
+            return
+        while k < hi:
+            run = min(hi - k, nslots - (k % nslots))
+            lo = k * chunk
+            span = min(run * chunk, nbytes - lo)
+            off = block.off + (k % nslots) * chunk
+            ap["arena"].view(off, span)[:] = ap["data"][lo:lo + span]
+            arena_mod.pv_pipeline.inc(run)
+            if tr is not None:
+                tr.record("protocol", "rndv_chunk", "i", dir="pub", k=k,
+                          chunks=run, bytes=span)
+            k += run
+        ap["next"] = hi
+        pub = Packet(PktType.RNDV_APUB, self.u.world_rank,
+                     rreq_id=pkt.rreq_id, offset=hi - 1)
+        sreq.channel.send_packet(pkt.src_world, pub)

@@ -72,8 +72,8 @@ def bootstrap_from_env() -> Universe:
     bind_among(node_ids, rank)
     _wire_channels(u, kvs)
     kvs.fence()   # everyone's business cards are published
-    if u.plane_channel is not None:
-        u.plane_channel.finish_wiring()
+    if u.shm_channel is not None:
+        u.shm_channel.finish_wiring()
     u.initialize()
 
     if os.environ.get("MV2T_FT") == "1":
@@ -96,6 +96,7 @@ def _wire_channels(u: Universe, kvs) -> None:
             for r in local:
                 if r != pid:
                     u.set_channel(r, shm)
+            u.shm_channel = shm
             if shm.plane:
                 u.plane_channel = shm
     except Exception as e:  # pragma: no cover — fall back to tcp
@@ -138,8 +139,8 @@ def _bootstrap_spawned(local: int, size: int, kvs_addr: str) -> Universe:
     bind_among(node_ids, pid)
     _wire_channels(u, kvs)
     kvs.fence(group=f"spawn-{base}-cards", count=size)
-    if u.plane_channel is not None:
-        u.plane_channel.finish_wiring()
+    if u.shm_channel is not None:
+        u.shm_channel.finish_wiring()
     u.initialize()
     u._next_ctx = max(u._next_ctx, ctx + 2)
 

@@ -69,6 +69,7 @@ class Universe:
         self._channels: Dict[int, Channel] = {}   # world rank -> channel
         self._default_channel: Optional[Channel] = None
         self.plane_channel = None  # ShmChannel with native data plane
+        self.shm_channel = None    # ShmChannel (plane or python ring)
         self.comm_world = None
         self.comm_self = None
         self._next_ctx = 8  # 0/1: world pt2pt/coll, 2/3: self, 4+: spare

@@ -31,6 +31,8 @@ class PktType(enum.IntEnum):
     RNDV_CTS = 3           # clear-to-send (receiver matched)
     RNDV_DATA = 4          # RPUT/R3 payload chunk
     RNDV_FIN = 5           # transfer complete
+    RNDV_APUB = 6          # pipelined arena rendezvous: chunk published
+    RNDV_AACK = 7          # pipelined arena rendezvous: chunk consumed
     # one-sided (SURVEY §2.1 RMA)
     RMA_PUT = 10
     RMA_GET = 11

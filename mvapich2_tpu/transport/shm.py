@@ -7,13 +7,18 @@ implementation of the identical layout serves as fallback when the .so
 can't be built. Bootstrap (who creates the segment, name exchange) rides
 the KVS like everything else.
 
-Zero-copy rendezvous: large messages use the RGET protocol through a
-shared scratch file exposed per-send (the CMA/LiMIC2 analog — one copy by
-the receiver instead of two through the ring).
+Zero-copy rendezvous: large messages use the RGET protocol with a
+size-ordered handle ladder — CMA (the receiver reads the sender's user
+buffer via process_vm_readv when the unanimous bootstrap probe passed),
+the persistent per-node scratch arena (transport/arena.py — one block
+allocation per send, reused across sends), and only as the last resort
+the legacy per-send scratch file. Oversize python packets (spills) stage
+through the arena too, reclaimed via its spill-consumed counters.
 """
 
 from __future__ import annotations
 
+import collections
 import ctypes
 import mmap
 import os
@@ -31,6 +36,7 @@ import numpy as np
 
 from ..utils.config import cvar, get_config
 from ..utils.mlog import get_logger
+from .arena import ShmArena, cma_read
 from .base import Channel, Packet, decode_packet, encode_packet
 
 log = get_logger("shm")
@@ -350,9 +356,55 @@ class ShmChannel(Channel):
             self._ring = self._make_ring(path, ring_bytes, create=False)
             self._owner = False
         self.path = path
-        # RGET exposure directory: handle -> mmap'd scratch file
-        self._exposed: Dict[str, np.ndarray] = {}
-        self._backlog: Dict[int, List[bytes]] = {}
+        # -- persistent per-node scratch arena (transport/arena.py) ------
+        # created by the leader alongside the ring segment; replaces the
+        # per-send scratch files for RGET exposure and oversize spills.
+        # Usability is agreed unanimously in finish_wiring() (like CMA)
+        # so sender and receiver always dispatch handles identically.
+        self.arena: Optional[ShmArena] = None
+        self.cma_ok = False          # python-level CMA verdict (post-fence)
+        self._arena_ready = False    # set after the unanimous agreement
+        base = os.path.dirname(path)
+        arena_key = f"shm-arena-{leader}"
+        try:
+            if self._owner:
+                ShmArena.sweep_stale(base)
+                apath = os.path.join(
+                    base, f"mv2t-arena-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+                try:
+                    self.arena = ShmArena(apath, self.n_local,
+                                          self.local_index[my_rank],
+                                          create=True)
+                    kvs.put(arena_key,
+                            f"{apath}:{self.arena.part_bytes}")
+                except Exception as e:
+                    log.warn("arena create failed (%s); scratch-file "
+                             "rendezvous", e)
+                    kvs.put(arena_key, "")
+            else:
+                card = kvs.get(arena_key)
+                if card:
+                    apath, part = card.rsplit(":", 1)
+                    self.arena = ShmArena(apath, self.n_local,
+                                          self.local_index[my_rank],
+                                          int(part), create=False)
+        except Exception as e:
+            log.warn("arena attach failed (%s); scratch-file rendezvous", e)
+            self.arena = None
+        # exposure table: wire handle -> keepalive (ndarray for CMA,
+        # ArenaHandle for arena blocks) — the registration-cache handle
+        # table; leak-checked at close()
+        self._exposed: Dict[tuple, object] = {}
+        self._expose_tok = 0
+        # arena-staged spill bookkeeping: dst local index -> deque of
+        # (seq, ArenaHandle), reclaimed when the receiver's consumed
+        # counter passes seq
+        self._spill_pending: Dict[int, collections.deque] = {}
+        self._spill_seq: Dict[int, int] = {}
+        # spill bookkeeping lock: plane-mode sends bypass _send_lock (the
+        # C injector owns ordering) but still stage spills here
+        self._spill_lock = threading.Lock()
+        self._backlog: Dict[int, collections.deque] = {}
         # serializes the ring producer + backlog: the SPSC ring assumes
         # one producer per (src,dst) pair, but sends arrive from any
         # user thread (MPI-IO worker, THREAD_MULTIPLE) while poll()
@@ -488,9 +540,38 @@ class ShmChannel(Channel):
         return ok
 
     def finish_wiring(self) -> None:
-        """Post-fence wiring: peer bell addresses into the plane, then
-        publish it process-globally so libmpi.c's C fast path can find it
+        """Post-fence wiring: the unanimous CMA + arena agreements (every
+        ShmChannel), then peer bell addresses into the plane and its
+        process-global publication so libmpi.c's C fast path can find it
         (cp_global). Called by bootstrap after the business-card fence."""
+        # CMA is enabled only by UNANIMOUS agreement: every co-resident
+        # rank publishes its own probe verdict (can it read a neighbor,
+        # is USE_CMA set) and reads everyone else's. The receiver
+        # performs the pull, so a single incapable/opted-out rank must
+        # disable the protocol for the whole node. The arena verdict
+        # rides the same exchange: a rank whose mapping failed would
+        # receive handles it cannot dereference.
+        my_ok = bool(get_config()["USE_CMA"]) and self._probe_cma()
+        my_arena = self.arena is not None
+        self.kvs.put(f"shm-cma-ok-{self.my_rank}", "1" if my_ok else "0")
+        self.kvs.put(f"shm-arena-ok-{self.my_rank}",
+                     "1" if my_arena else "0")
+        all_ok, all_arena = my_ok, my_arena
+        for r in self.local_ranks:
+            if r == self.my_rank:
+                continue
+            try:
+                all_ok = all_ok and \
+                    self.kvs.get(f"shm-cma-ok-{r}") == "1"
+                all_arena = all_arena and \
+                    self.kvs.get(f"shm-arena-ok-{r}") == "1"
+            except Exception:
+                all_ok = all_arena = False
+        self.cma_ok = all_ok
+        if not all_arena and self.arena is not None:
+            self.arena.close(unlink=self._owner)
+            self.arena = None
+        self._arena_ready = self.arena is not None
         if not self.plane:
             return
         lib = self._ring.lib
@@ -505,21 +586,6 @@ class ShmChannel(Channel):
             self._peer_bells[r] = addr
             lib.cp_set_bell(self.plane, self.local_index[r], addr.encode())
         lib.cp_register_global(self.plane)
-        # CMA is enabled only by UNANIMOUS agreement: every co-resident
-        # rank publishes its own probe verdict (can it read a neighbor,
-        # is USE_CMA set) and reads everyone else's. The receiver
-        # performs the pull, so a single incapable/opted-out rank must
-        # disable the protocol for the whole node.
-        my_ok = bool(get_config()["USE_CMA"]) and self._probe_cma()
-        self.kvs.put(f"shm-cma-ok-{self.my_rank}", "1" if my_ok else "0")
-        all_ok = my_ok
-        for r in self.local_ranks:
-            if r == self.my_rank or not all_ok:
-                continue
-            try:
-                all_ok = self.kvs.get(f"shm-cma-ok-{r}") == "1"
-            except Exception:
-                all_ok = False
         if all_ok:
             lib.cp_set_cma(self.plane, 1)
         # rebind the plane counters' sources to this live plane:
@@ -570,12 +636,12 @@ class ShmChannel(Channel):
             # plane mode: the C injector owns ordering + backlog; spill
             # oversize blobs first so inject never sees one
             if len(blob) > self._ring_cap:
-                blob = self._spill_oversize(blob)
+                blob = self._spill_oversize(blob, dst_i)
             self._ring.lib.cp_inject(self.plane, dst_i, blob, len(blob))
             return
         src_i = self.local_index[self.my_rank]
         with self._send_lock:
-            bl = self._backlog.setdefault(dst_i, [])
+            bl = self._backlog.setdefault(dst_i, collections.deque())
             if bl:
                 bl.append(blob)
                 self._flush(dst_i)
@@ -584,8 +650,8 @@ class ShmChannel(Channel):
                 if rc == 0:
                     bl.append(blob)  # ring full: backlog, flush from poll
                 elif rc < 0:
-                    # larger than the ring: stream via a scratch RGET
-                    note = self._spill_oversize(blob)
+                    # larger than the ring: stream via an arena/file spill
+                    note = self._spill_oversize(blob, dst_i)
                     if self._ring.send(src_i, dst_i, note) == 0:
                         bl.append(note)
         self._ring_bell(dest_world)
@@ -614,30 +680,75 @@ class ShmChannel(Channel):
     def post_wait(self) -> None:
         self._flags[self.local_index[self.my_rank]] = 0
 
-    def _spill_oversize(self, blob: bytes) -> bytes:
-        """Spill a larger-than-ring message to a scratch file; returns
-        the small ring note pointing at it. Never waits for ring space —
-        a spin here would run under _send_lock and block poll() from
-        draining inbound rings (cross-rank deadlock); a full ring just
-        backlogs the note like any other blob."""
+    def _spill_oversize(self, blob: bytes, dst_i: int) -> bytes:
+        """Spill a larger-than-ring message to the arena (falling back to
+        a scratch file); returns the small ring note pointing at it.
+        Never waits for ring space — a spin here would run under
+        _send_lock and block poll() from draining inbound rings
+        (cross-rank deadlock); a full ring just backlogs the note like
+        any other blob. Arena blocks are reclaimed lazily once the
+        receiver's spill-consumed counter passes the note's sequence
+        number (_reclaim_spills)."""
+        if self._arena_ready:
+            self._reclaim_spills()
+            h = self.arena.alloc(len(blob))
+            if h is not None:
+                self.arena.view(h.off, len(blob))[:] = \
+                    np.frombuffer(blob, dtype=np.uint8)
+                with self._spill_lock:
+                    seq = self._spill_seq.get(dst_i, 0) + 1
+                    self._spill_seq[dst_i] = seq
+                    self._spill_pending.setdefault(
+                        dst_i, collections.deque()).append((seq, h))
+                # 0xFE discriminator: arena spill note (0xFF = file)
+                return b"\xfe" + struct.pack(
+                    "<qqq", self.local_index[self.my_rank], h.off,
+                    len(blob))
         path = self.path + f".big-{self.my_rank}-{uuid.uuid4().hex[:8]}"
         with open(path, "wb") as f:
             f.write(blob)
         # 0xFF discriminator: not a valid PktType first byte
         return b"\xff" + path.encode()
 
+    def _reclaim_spills(self) -> None:
+        """Free arena spill blocks whose notes the receiver has consumed
+        (its counter in the arena header passed their sequence)."""
+        my_i = self.local_index[self.my_rank]
+        with self._spill_lock:
+            for dst_i, pend in self._spill_pending.items():
+                if not pend:
+                    continue
+                c = self.arena.spill_consumed(my_i, dst_i)
+                while pend and pend[0][0] <= c:
+                    self.arena.free(pend.popleft()[1])
+
+    def _consume_spill_note(self, blob) -> bytes:
+        """Dereference an inbound spill note (0xFE arena / 0xFF file)."""
+        if blob[0] == 0xFE:
+            src_i, off, n = struct.unpack_from("<qqq", blob, 1)
+            data = bytes(self.arena.view(off, n))
+            self.arena.bump_spill(src_i, self.local_index[self.my_rank])
+            return data
+        path = bytes(blob[1:]).decode()
+        with open(path, "rb") as f:
+            data = f.read()
+        os.unlink(path)
+        return data
+
     def _flush(self, dst_i: int) -> None:
-        bl = self._backlog.get(dst_i) or []
+        bl = self._backlog.get(dst_i)
+        if bl is None:
+            return
         src_i = self.local_index[self.my_rank]
         while bl:
             rc = self._ring.send(src_i, dst_i, bl[0])
             if rc == 0:
                 return
-            blob = bl.pop(0)
+            blob = bl.popleft()
             if rc < 0:
-                note = self._spill_oversize(blob)
+                note = self._spill_oversize(blob, dst_i)
                 if self._ring.send(src_i, dst_i, note) == 0:
-                    bl.insert(0, note)   # keep FIFO order, retry later
+                    bl.appendleft(note)   # keep FIFO order, retry later
                     return
 
     def poll(self) -> bool:
@@ -649,6 +760,8 @@ class ShmChannel(Channel):
         with self._send_lock:
             for dst_i in list(self._backlog):
                 self._flush(dst_i)
+        if self._spill_pending:
+            self._reclaim_spills()
         for src_i in range(self.n_local):
             if src_i == my_i:
                 continue
@@ -656,11 +769,8 @@ class ShmChannel(Channel):
                 blob = self._ring.recv(src_i, my_i)
                 if blob is None:
                     break
-                if blob[0] == 0xFF:    # oversize spill note
-                    path = blob[1:].decode()
-                    with open(path, "rb") as f:
-                        blob = f.read()
-                    os.unlink(path)
+                if blob[0] in (0xFE, 0xFF):    # oversize spill note
+                    blob = self._consume_spill_note(blob)
                 self.account_recv(len(blob))
                 self.engine.enqueue_incoming(decode_packet(blob))
                 did = True
@@ -675,6 +785,8 @@ class ShmChannel(Channel):
         lib = self._ring.lib
         self._drain_bell()
         did = lib.cp_advance(self.plane) > 0
+        if self._spill_pending:
+            self._reclaim_spills()
         while lib.cp_py_pending(self.plane):
             n = lib.cp_py_peek(self.plane)
             if n <= 0:
@@ -684,11 +796,8 @@ class ShmChannel(Channel):
             if got <= 0:
                 break
             blob = buf.raw[:got]
-            if blob[0] == 0xFF:    # oversize spill note (python-owned pkt)
-                path = blob[1:].decode()
-                with open(path, "rb") as f:
-                    blob = f.read()
-                os.unlink(path)
+            if blob[0] in (0xFE, 0xFF):  # oversize spill note (py-owned)
+                blob = self._consume_spill_note(blob)
             self.engine.enqueue_incoming(decode_packet(blob))
             did = True
         client = self.plane_client
@@ -729,26 +838,84 @@ class ShmChannel(Channel):
     def plane_track_cancel(self, sreq_id: int, req) -> None:
         self._plane_cancels[sreq_id] = req
 
-    # -- zero-copy rendezvous (RGET over a scratch mmap — CMA analog) -----
+    # -- zero-copy rendezvous (RGET handle ladder: CMA > arena > file) ----
     def expose_buffer(self, array: np.ndarray):
-        path = self.path + f".rget-{self.my_rank}-{uuid.uuid4().hex[:8]}"
+        """Register a send buffer for remote pull. Handle ladder, best
+        first: ("cma", pid, addr, tok) — the receiver reads the live
+        buffer via process_vm_readv (zero staging copies); ("arena", off,
+        tok) — one copy into a persistent arena block; ("file", path) —
+        the legacy per-send scratch file, kept as the exhaustion/fallback
+        path. The keepalive (buffer ref / ArenaHandle) lives in the
+        _exposed handle table until release_buffer."""
         arr = np.ascontiguousarray(array).view(np.uint8).reshape(-1)
+        if arr.size == 0:
+            return ("null",)
+        if self.cma_ok:
+            self._expose_tok += 1
+            h = ("cma", os.getpid(), arr.ctypes.data, self._expose_tok)
+            self._exposed[h] = arr
+            return h
+        if self._arena_ready:
+            ah = self.arena.alloc(arr.size)
+            if ah is not None:
+                self.arena.view(ah.off, arr.size)[:] = arr
+                self._expose_tok += 1
+                h = ("arena", ah.off, self._expose_tok)
+                self._exposed[h] = ah
+                return h
+        path = self.path + f".rget-{self.my_rank}-{uuid.uuid4().hex[:8]}"
         with open(path, "wb") as f:
             f.write(arr.tobytes())
-        return path
+        return ("file", path)
 
     def pull_buffer(self, src_world: int, handle, nbytes: int) -> np.ndarray:
-        with open(handle, "rb") as f:
+        """RGET: read the peer's exposed buffer. CMA and arena pulls are
+        chunked (MV2T_RNDV_CHUNK) with a trace instant per chunk; the
+        arena/file paths return views anchored to the shared/mapped
+        memory (no staging copy — the caller reduces/unpacks straight
+        out of the mapping before the FIN releases it)."""
+        tr = getattr(self.engine, "tracer", None) \
+            if hasattr(self, "engine") else None
+        kind = handle[0] if isinstance(handle, tuple) else "path"
+        if kind == "cma":
+            _, pid, addr, _tok = handle
+            out = np.empty(nbytes, dtype=np.uint8)
+            cma_read(pid, addr, out, chunk=get_config()["RNDV_CHUNK"],
+                     tracer=tr)
+            return out
+        if kind == "arena":
+            if tr is not None:
+                tr.record("protocol", "rndv_chunk", "i", dir="arena",
+                          bytes=nbytes)
+            return self.arena.view(handle[1], nbytes)
+        path = handle[1] if kind == "file" else handle
+        with open(path, "rb") as f:
             mm = mmap.mmap(f.fileno(), 0, prot=mmap.PROT_READ)
-            out = np.frombuffer(mm, dtype=np.uint8, count=nbytes).copy()
-            mm.close()
-        return out
+        # a frombuffer view anchored to the mapping: the caller unpacks/
+        # reduces out of it immediately, so no .copy() staging hop — the
+        # view holds the mapping alive (unlink-while-mapped is fine)
+        return np.frombuffer(mm, dtype=np.uint8, count=nbytes)
 
     def release_buffer(self, handle) -> None:
+        if isinstance(handle, tuple):
+            kind = handle[0]
+            if kind == "cma" or kind == "null":
+                self._exposed.pop(handle, None)
+                return
+            if kind == "arena":
+                ah = self._exposed.pop(handle, None)
+                if ah is not None:
+                    self.arena.free(ah)
+                return
+            handle = handle[1]    # ("file", path)
         try:
             os.unlink(handle)
         except OSError:
             pass
+
+    # a cancelled-and-retracted rendezvous send never gets its FIN; the
+    # cancel-resp path releases the exposure through this alias
+    unexpose_buffer = release_buffer
 
     def close(self) -> None:
         if self.plane:
@@ -767,6 +934,19 @@ class ShmChannel(Channel):
             except Exception:
                 pass
             self.plane = None
+        if self.arena is not None:
+            # Finalize leak check: every exposure must have been released
+            # by its FIN/cancel; pending spills may legitimately await
+            # reclaim, so free them silently first.
+            with self._spill_lock:
+                for pend in self._spill_pending.values():
+                    while pend:
+                        self.arena.free(pend.popleft()[1])
+            if self._exposed or self.arena.outstanding:
+                log.warn("arena handle leak at close: %d exposures, %d "
+                         "arena blocks live", len(self._exposed),
+                         self.arena.outstanding)
+            self.arena.close(unlink=self._owner)
         try:
             self._bell.close()
             os.unlink(self._bell_path)

@@ -1,0 +1,213 @@
+"""Persistent shm arena unit + integration tests (transport/arena.py).
+
+Covers the ISSUE-3 satellite checklist: slot exhaustion falls back to
+the scratch-file path (never deadlocks), handle leaks are detected at
+Finalize/close, alloc/free is thread-safe, and a dead leader's segment
+is swept by the next bootstrap on the node.
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import uuid
+
+import numpy as np
+import pytest
+
+from mvapich2_tpu.transport.arena import ShmArena, cma_read
+
+
+def _dir():
+    return "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+
+
+def _mk(n_local=2, my_index=0, part_bytes=1 << 20, create=True, path=None):
+    path = path or os.path.join(
+        _dir(), f"mv2t-arena-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+    return ShmArena(path, n_local, my_index, part_bytes, create=create), path
+
+
+def test_alloc_free_roundtrip():
+    ar, path = _mk()
+    try:
+        h = ar.alloc(1000)
+        assert h is not None
+        assert h.cls >= 1000 and h.off >= 0
+        ar.view(h.off, 1000)[:] = np.arange(1000, dtype=np.uint8) % 251
+        got = ar.view(h.off, 1000)
+        assert np.array_equal(got, np.arange(1000, dtype=np.uint8) % 251)
+        assert ar.outstanding == 1
+        ar.free(h)
+        assert ar.outstanding == 0
+        # freed block is reused (registration-cache discipline)
+        h2 = ar.alloc(1000)
+        assert h2.off == h.off
+        ar.free(h2)
+    finally:
+        ar.close(unlink=True)
+
+
+def test_size_classes_and_reuse():
+    ar, path = _mk(part_bytes=4 << 20)
+    try:
+        small = ar.alloc(1)
+        assert small.cls == ShmArena.MIN_CLASS
+        big = ar.alloc(ShmArena.MIN_CLASS + 1)
+        assert big.cls == 2 * ShmArena.MIN_CLASS
+        assert ar.bytes_in_use == small.cls + big.cls
+        ar.free(small)
+        ar.free(big)
+        assert ar.bytes_in_use == 0
+    finally:
+        ar.close(unlink=True)
+
+
+def test_exhaustion_returns_none_not_deadlock():
+    """A full partition returns None (caller falls back to the scratch
+    file) — alloc never blocks waiting for a free."""
+    ar, path = _mk(part_bytes=256 * 1024)
+    try:
+        held = []
+        while True:
+            h = ar.alloc(ShmArena.MIN_CLASS)
+            if h is None:
+                break
+            held.append(h)
+        assert len(held) == (256 * 1024) // ShmArena.MIN_CLASS
+        # oversize-vs-partition is also a clean None
+        assert ar.alloc(1 << 30) is None
+        ar.free(held.pop())
+        assert ar.alloc(ShmArena.MIN_CLASS) is not None  # reuse after free
+    finally:
+        ar.close(unlink=True)
+
+
+def test_partition_isolation():
+    """Ranks allocate only from their own partition but read anywhere."""
+    ar0, path = _mk(n_local=2, my_index=0)
+    ar1 = ShmArena(path, 2, 1, ar0.part_bytes, create=False)
+    try:
+        h0 = ar0.alloc(4096)
+        h1 = ar1.alloc(4096)
+        lo0, hi0 = ar0._part_lo, ar0._part_hi
+        lo1, hi1 = ar1._part_lo, ar1._part_hi
+        assert lo0 <= h0.off < hi0
+        assert lo1 <= h1.off < hi1
+        assert hi0 <= lo1            # disjoint
+        ar0.view(h0.off, 4)[:] = (1, 2, 3, 4)
+        assert list(ar1.view(h0.off, 4)) == [1, 2, 3, 4]  # cross-read
+        ar0.free(h0)
+        ar1.free(h1)
+    finally:
+        ar1.close()
+        ar0.close(unlink=True)
+
+
+def test_concurrent_alloc_free_two_threads():
+    """MPI-IO workers + THREAD_MULTIPLE hit the allocator concurrently."""
+    ar, path = _mk(part_bytes=8 << 20)
+    errs = []
+
+    def body():
+        try:
+            for _ in range(300):
+                hs = [ar.alloc(ShmArena.MIN_CLASS) for _ in range(4)]
+                for h in hs:
+                    if h is not None:
+                        ar.free(h)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=body) for _ in range(2)]
+    try:
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert not errs, errs
+        assert ar.outstanding == 0
+        assert ar.bytes_in_use == 0
+    finally:
+        ar.close(unlink=True)
+
+
+def test_spill_consumed_counters():
+    ar0, path = _mk(n_local=2, my_index=0)
+    ar1 = ShmArena(path, 2, 1, ar0.part_bytes, create=False)
+    try:
+        assert ar0.spill_consumed(0, 1) == 0
+        ar1.bump_spill(0, 1)
+        ar1.bump_spill(0, 1)
+        assert ar0.spill_consumed(0, 1) == 2
+        assert ar0.spill_consumed(1, 0) == 0
+    finally:
+        ar1.close()
+        ar0.close(unlink=True)
+
+
+def test_sweep_stale_segment():
+    """Crash cleanup: a segment whose creator pid is gone is unlinked by
+    the next leader's sweep; live-pid segments survive."""
+    d = tempfile.mkdtemp(prefix="arena-sweep-")
+    # a pid that cannot exist (> pid_max)
+    dead = os.path.join(d, "mv2t-arena-99999999-deadbeef")
+    open(dead, "wb").close()
+    live = os.path.join(d, f"mv2t-arena-{os.getpid()}-cafecafe")
+    open(live, "wb").close()
+    other = os.path.join(d, "unrelated-file")
+    open(other, "wb").close()
+    n = ShmArena.sweep_stale(d)
+    assert n == 1
+    assert not os.path.exists(dead)
+    assert os.path.exists(live)
+    assert os.path.exists(other)
+    for p in (live, other):
+        os.unlink(p)
+    os.rmdir(d)
+
+
+def test_cma_read_self():
+    """process_vm_readv against our own pid (what the in-process fabric
+    and the unanimous-CMA sectioned exchange rely on)."""
+    src = np.arange(1 << 16, dtype=np.uint8)
+    out = np.empty(1 << 16, dtype=np.uint8)
+    try:
+        cma_read(os.getpid(), src.ctypes.data, out, chunk=4096)
+    except OSError:
+        pytest.skip("process_vm_readv unavailable in this sandbox")
+    assert np.array_equal(out, src)
+
+
+def test_channel_close_detects_handle_leak():
+    """ShmChannel.close() warns when exposures were never released —
+    the Finalize leak check. Drive it through a real 2-rank process run
+    where rank 0 exposes a buffer and exits without its FIN."""
+    prog = os.path.join(os.path.dirname(__file__), "progs",
+                        "arena_leak_prog.py")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MV2T_USE_CMA="0")
+    r = subprocess.run([sys.executable, "-m", "mvapich2_tpu.run", "-np",
+                       "2", sys.executable, prog], cwd=repo,
+                       capture_output=True, text=True, timeout=300,
+                       env=env)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert "LEAK-DETECTED" in r.stdout, f"{r.stdout}\n{r.stderr}"
+
+
+def test_rendezvous_arena_exhaustion_fallback_process_mode():
+    """With a partition too small for even one chunk pair, every large
+    send must fall back to the scratch-file path and still deliver
+    (the cma_rndv integrity prog, CMA off, 64 KiB arena)."""
+    prog = os.path.join(os.path.dirname(__file__), "progs",
+                        "cma_rndv_prog.py")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MV2T_USE_CMA="0",
+               MV2T_ARENA_BYTES="65536")
+    r = subprocess.run([sys.executable, "-m", "mvapich2_tpu.run", "-np",
+                       "2", sys.executable, prog], cwd=repo,
+                       capture_output=True, text=True, timeout=300,
+                       env=env)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert "No Errors" in r.stdout

@@ -1,0 +1,41 @@
+"""Tier-1 perf smoke: the large-message datapath must stay fast.
+
+A 4-rank 1 MiB allreduce through the arena/CMA sectioned exchange runs
+at ~2-3 ms/call; the per-send scratch-file path it replaced was ~33 ms
+(BENCH_OSU_r05 osu_allreduce_np4 @ 1 MiB). The 5 s budget for ten
+timed iterations is generous enough to be variance-proof on an
+oversubscribed CI host while still failing hard if the scratch-file
+cliff (or any comparable per-send staging cost) silently returns.
+
+bin/osu_compare is the fine-grained guard for full bench artifacts;
+this test is the always-on tripwire in the tier-1 lane.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+BUDGET_S = 5.0
+ITERS = 10
+
+
+def test_allreduce_1mib_np4_under_budget():
+    prog = os.path.join(os.path.dirname(__file__), "progs",
+                        "allreduce_smoke_prog.py")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-m", "mvapich2_tpu.run", "-np",
+                       "4", sys.executable, prog], cwd=repo,
+                       capture_output=True, text=True, timeout=600,
+                       env=env)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert "No Errors" in r.stdout, f"{r.stdout}\n{r.stderr}"
+    m = re.search(r"allreduce_1MiB_avg_us=([0-9.]+)", r.stdout)
+    assert m, f"no timing line in output:\n{r.stdout}"
+    avg_us = float(m.group(1))
+    total_s = avg_us * ITERS / 1e6
+    assert total_s < BUDGET_S, (
+        f"1 MiB allreduce too slow: {avg_us:.0f} us/call "
+        f"({total_s:.2f} s for {ITERS} iters, budget {BUDGET_S} s) — "
+        f"did the per-send scratch-file path come back?")
