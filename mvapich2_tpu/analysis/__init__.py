@@ -1,0 +1,28 @@
+"""mv2t-analyze: protocol/concurrency invariant checking.
+
+Two halves, one goal — catch the races and deadlocks that accumulate in
+cross-process shm datapaths (PAPER.md §L3/L4) at lint time instead of in
+a 4-rank hang:
+
+  * ``bin/mv2tlint`` — an AST-based static checker with five pluggable
+    passes over the whole package (core.py drives; one module per pass):
+
+        locks       guarded-by lock discipline (# guarded-by: _lock)
+        tags        tag-namespace disjointness (*_TAG_BASE ranges)
+        pvars       pvar/cvar registry consistency + naming convention
+        blocking    no blocking calls in progress callbacks/pkt handlers
+        traceguard  every trace site behind the one-attribute-check idiom
+
+    Findings ratchet down through a committed suppressions file
+    (analysis/baseline.json); ``--strict`` additionally fails on STALE
+    suppressions so the baseline can only shrink.
+
+  * ``lockorder`` — a runtime lock-order detector (MV2T_LOCKCHECK=1):
+    instrumented lock wrappers build a per-process acquisition-order
+    graph, detect cycles (potential deadlock) and held-across-
+    progress-wait violations, and report through the stall-watchdog /
+    debugger dump path.
+"""
+
+from .core import Finding, load_baseline, run_passes, scan_paths  # noqa: F401
+from .lockorder import get_monitor, tracked  # noqa: F401

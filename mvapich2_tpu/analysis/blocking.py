@@ -1,0 +1,154 @@
+"""Pass ``blocking`` — no blocking calls in progress-engine callbacks.
+
+Packet handlers and progress callbacks run with the engine mutex held,
+on whatever thread progressed the engine — often the SENDER's thread via
+the async-drain path. A blocking call there (sleep, unbounded lock
+acquire, a nested blocking recv/wait) stalls every rank sharing the
+engine and is the classic shm-datapath deadlock shape (PAPER.md §L3:
+handler waits on traffic only its own engine can progress).
+
+Handler contexts are discovered per module:
+  * the callable registered via ``register_handler(pkt, fn)`` /
+    ``register_hook(fn)`` / ``req.add_callback(fn)`` — a ``self._x``
+    method reference, a bare function name, or the function(s) a lambda
+    argument calls;
+  * any def annotated ``# mv2tlint: handler``.
+
+Inside a handler body (nested defs excluded — they run later) these are
+findings:
+  * ``time.sleep(...)``
+  * ``.acquire()`` without ``blocking=False`` or a ``timeout=`` bound
+  * ``.wait()`` / ``.join()`` without a timeout argument
+  * calls to ``progress_wait`` (re-entering the blocking wait)
+  * blocking point-to-point/collective entry points: ``recv``,
+    ``probe``, ``barrier``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import Finding, LintPass, SourceModule, attr_chain
+
+_REGISTRARS = {"register_handler", "register_hook", "add_callback"}
+_BLOCKING_NAMES = {"recv", "probe", "barrier", "progress_wait"}
+
+
+def _called_names(node: ast.AST) -> Set[str]:
+    """Terminal names of everything called inside ``node``."""
+    names: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            if isinstance(fn, ast.Attribute):
+                names.add(fn.attr)
+            elif isinstance(fn, ast.Name):
+                names.add(fn.id)
+    return names
+
+
+def _has_timeout_bound(call: ast.Call) -> bool:
+    if call.args:
+        return True           # positional blocking flag / timeout given
+    return any(kw.arg in ("timeout", "blocking") for kw in call.keywords)
+
+
+class BlockingCallPass(LintPass):
+    id = "blocking"
+    doc = ("no blocking calls (sleep, unbounded acquire/wait, blocking "
+           "recv) inside packet handlers and progress callbacks")
+
+    def run(self, modules: List[SourceModule]) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in modules:
+            defs: Dict[str, List[ast.AST]] = {}
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    defs.setdefault(node.name, []).append(node)
+            handlers: Set[str] = set()
+            registers_pkts = False
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and "handler" in (mod.annotation(node.lineno,
+                                                         "mv2tlint") or ""):
+                    handlers.add(node.name)
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                reg = fn.attr if isinstance(fn, ast.Attribute) else \
+                    (fn.id if isinstance(fn, ast.Name) else None)
+                if reg not in _REGISTRARS:
+                    continue
+                if reg == "register_handler":
+                    registers_pkts = True
+                cb_args = node.args[1:] if reg == "register_handler" \
+                    else node.args[:1]
+                for arg in cb_args:
+                    name = None
+                    if isinstance(arg, ast.Attribute):
+                        name = arg.attr
+                    elif isinstance(arg, ast.Name):
+                        name = arg.id
+                    elif isinstance(arg, ast.Lambda):
+                        for n in _called_names(arg.body):
+                            if n in defs:
+                                handlers.add(n)
+                        continue
+                    if name is not None and name in defs:
+                        handlers.add(name)
+            if registers_pkts:
+                # handler tables built as data (rma/win.py's loop over
+                # (PktType, self._on_x) tuples) hide the callable from
+                # the registrar's argument list — in a module that
+                # registers packet handlers at all, the _on_* naming
+                # convention IS the handler table
+                handlers.update(n for n in defs if n.startswith("_on_"))
+            for name in sorted(handlers):
+                for fndef in defs.get(name, []):
+                    self._check_handler(mod, fndef, out)
+        return out
+
+    # ------------------------------------------------------------------
+    def _check_handler(self, mod: SourceModule, fndef, out: List[Finding]) -> None:
+        qual = fndef.name
+
+        def emit(line: int, what: str) -> None:
+            f = self.finding(mod, line, f"blocking call '{what}' inside "
+                             f"handler/progress-callback '{qual}'")
+            if f is not None:
+                out.append(f)
+
+        def scan(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return        # deferred execution, not this context
+            if isinstance(node, ast.Call):
+                what = self._blocking_what(node)
+                if what is not None:
+                    emit(node.lineno, what)
+            for child in ast.iter_child_nodes(node):
+                scan(child)
+
+        for st in fndef.body:
+            scan(st)
+
+    @staticmethod
+    def _blocking_what(call: ast.Call) -> Optional[str]:
+        fn = call.func
+        chain = attr_chain(fn)
+        if chain is not None and chain.split(".")[-2:] == ["time", "sleep"]:
+            return "time.sleep"
+        name = fn.attr if isinstance(fn, ast.Attribute) else \
+            (fn.id if isinstance(fn, ast.Name) else None)
+        if name == "sleep" and chain == "sleep":
+            return "sleep"
+        recv = attr_chain(fn.value) if isinstance(fn, ast.Attribute) else None
+        if name == "acquire" and not _has_timeout_bound(call):
+            return f"{recv or 'lock'}.acquire() (unbounded)"
+        if name in ("wait", "join") and not _has_timeout_bound(call):
+            return f"{recv or '<expr>'}.{name}() (no timeout)"
+        if name in _BLOCKING_NAMES and isinstance(fn, (ast.Attribute,
+                                                       ast.Name)):
+            return f"{chain or name}"
+        return None

@@ -1,0 +1,125 @@
+"""mv2tlint command-line driver (bin/mv2tlint).
+
+    mv2tlint                         lint the package against the
+                                     committed baseline
+    mv2tlint --strict                CI mode: new findings OR stale
+                                     baseline entries fail (the ratchet)
+    mv2tlint --baseline FILE         alternate suppressions file
+    mv2tlint --write-baseline        snapshot current findings as the
+                                     baseline (each entry then needs a
+                                     hand-written justification)
+    mv2tlint --select locks,tags     run a subset of passes
+    mv2tlint path/to/file.py ...     lint specific files/dirs (fixture
+                                     tests use this)
+
+Exit codes: 0 clean (all findings suppressed; strict also requires no
+stale suppressions), 1 violations, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .core import (DEFAULT_BASELINE, PKG_ROOT, REPO_ROOT, all_passes,
+                   load_baseline, run_passes, scan_paths, write_baseline)
+
+
+def _resolve_baseline(path: Optional[str]) -> str:
+    if path is None:
+        return DEFAULT_BASELINE
+    if os.path.exists(path):
+        return path
+    # allow the repo-root spelling `--baseline analysis/baseline.json`
+    alt = os.path.join(PKG_ROOT, path)
+    if os.path.exists(alt):
+        return alt
+    return path
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mv2tlint",
+        description="protocol/concurrency invariant checker "
+                    "(mvapich2_tpu.analysis)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: the "
+                         "installed mvapich2_tpu package)")
+    ap.add_argument("--baseline", default=None,
+                    help="suppressions file (default: the committed "
+                         "analysis/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline entirely (fixture tests)")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail on stale baseline entries too — the "
+                         "invariant set only ratchets down")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="snapshot current findings into the baseline "
+                         "file and exit 0")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated pass ids to run "
+                         "(default: all)")
+    ap.add_argument("--list-passes", action="store_true")
+    ap.add_argument("--json", dest="as_json", action="store_true",
+                    help="machine-readable findings on stdout")
+    opts = ap.parse_args(argv)
+
+    passes = all_passes()
+    if opts.list_passes:
+        for p in passes:
+            print(f"{p.id:<12} {p.doc}")
+        return 0
+    if opts.select:
+        want = {s.strip() for s in opts.select.split(",") if s.strip()}
+        unknown = want - {p.id for p in passes}
+        if unknown:
+            print(f"mv2tlint: unknown pass(es): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        passes = [p for p in passes if p.id in want]
+
+    paths = opts.paths or [PKG_ROOT]
+    modules, parse_errors = scan_paths(paths)
+    findings = parse_errors + run_passes(modules, passes)
+
+    bl_path = _resolve_baseline(opts.baseline)
+    if opts.write_baseline:
+        write_baseline(bl_path, findings)
+        print(f"# mv2tlint: wrote {len(findings)} suppression(s) to "
+              f"{os.path.relpath(bl_path, REPO_ROOT)}")
+        return 0
+
+    baseline = load_baseline(None if opts.no_baseline else bl_path)
+    if opts.no_baseline:
+        baseline.entries = []
+    new, suppressed, stale = baseline.split(findings)
+
+    if opts.as_json:
+        print(json.dumps({
+            "findings": [{"pass": f.pass_id, "path": f.path, "line": f.line,
+                          "msg": f.msg} for f in new],
+            "suppressed": len(suppressed),
+            "stale": [e for e in stale],
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        for e in stale:
+            print(f"stale baseline entry: [{e['pass']}] {e['path']}: "
+                  f"{e['msg']} (fixed? delete it)")
+        print(f"# mv2tlint: {len(new)} finding(s), {len(suppressed)} "
+              f"suppressed, {len(stale)} stale baseline entr(ies) — "
+              f"{len(modules)} file(s), {len(passes)} pass(es)")
+
+    if new:
+        return 1
+    if opts.strict and stale:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,274 @@
+"""mv2tlint framework: source model, pass protocol, baseline ratchet.
+
+The checker is deliberately whole-package and syntactic: every pass gets
+the full list of parsed modules (cross-module invariants like
+tag-namespace disjointness and pvar registration need the global view)
+plus per-line comment maps so annotations ride ordinary ``#`` comments
+and survive formatting:
+
+    # guarded-by: _lock            attribute may only be touched with
+                                   the named lock held (| separates
+                                   accepted aliases, e.g. a Condition
+                                   wrapping the lock)
+    # holds: _lock                 on a def line: the whole function runs
+                                   with the lock held (caller contract)
+    # tag-span: 32768              width of a *_TAG_BASE namespace
+    # mv2tlint: handler            on a def line: treat as a progress
+                                   callback / packet-handler context
+    # mv2tlint: ignore[locks]      suppress named passes on this line
+    # mv2tlint: ignore             suppress every pass on this line
+
+Baseline discipline (the ratchet): findings are keyed by
+(pass, path, message) — NOT line numbers, so unrelated edits don't churn
+the file — and matched against analysis/baseline.json. A finding with a
+baseline entry is demoted to "suppressed"; in ``--strict`` mode a
+baseline entry that matches nothing is itself an error (stale
+suppression), so the committed invariant set only ratchets down.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(PKG_ROOT)
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+_IGNORE_RE = re.compile(r"mv2tlint:\s*ignore(?:\[([a-z, -]+)\])?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation. ``key`` (pass:path:msg) is the baseline unit —
+    stable across line drift, specific enough not to mask new breakage
+    of the same kind at another site (the message names the symbol)."""
+
+    pass_id: str
+    path: str          # repo-relative
+    line: int
+    msg: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.pass_id}:{self.path}:{self.msg}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_id}] {self.msg}"
+
+
+class SourceModule:
+    """One parsed file: AST + per-line comments + per-line suppressions."""
+
+    def __init__(self, path: str, text: str):
+        self.path = os.path.abspath(path)
+        self.relpath = os.path.relpath(self.path, REPO_ROOT)
+        if self.relpath.startswith(".."):
+            self.relpath = os.path.basename(self.path)
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+        self.comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:
+            pass
+        # line -> set of suppressed pass ids ({"*"} = all)
+        self.ignores: Dict[int, set] = {}
+        for line, c in self.comments.items():
+            m = _IGNORE_RE.search(c)
+            if m:
+                which = m.group(1)
+                self.ignores[line] = ({"*"} if which is None else
+                                      {p.strip() for p in which.split(",")})
+
+    def comment(self, line: int) -> str:
+        return self.comments.get(line, "")
+
+    def annotation(self, line: int, key: str) -> Optional[str]:
+        """Value of ``# <key>: <value>`` on ``line`` (or None)."""
+        m = re.search(rf"#\s*{re.escape(key)}:\s*([^#]+)", self.comment(line))
+        return m.group(1).strip() if m else None
+
+    def suppressed(self, line: int, pass_id: str) -> bool:
+        ign = self.ignores.get(line)
+        return bool(ign) and ("*" in ign or pass_id in ign)
+
+
+class LintPass:
+    """Pass protocol: subclasses set ``id``/``doc`` and implement run()."""
+
+    id = "base"
+    doc = ""
+
+    def run(self, modules: List[SourceModule]) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, mod: SourceModule, line: int, msg: str) -> Optional[Finding]:
+        if mod.suppressed(line, self.id):
+            return None
+        return Finding(self.id, mod.relpath, line, msg)
+
+
+# ---------------------------------------------------------------------------
+# scanning
+# ---------------------------------------------------------------------------
+
+def scan_paths(paths: Sequence[str]) -> Tuple[List[SourceModule], List[Finding]]:
+    """Parse every .py file under ``paths`` (files or directories).
+    Unparseable files become findings of the pseudo-pass ``parse`` so a
+    syntax error can never silently shrink coverage."""
+    files: List[str] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in ("__pycache__", ".git"))
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames) if f.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    modules, errors = [], []
+    for f in files:
+        try:
+            with open(f, encoding="utf-8") as fh:
+                modules.append(SourceModule(f, fh.read()))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            rel = os.path.relpath(f, REPO_ROOT)
+            errors.append(Finding("parse", rel, getattr(e, "lineno", 0) or 0,
+                                  f"unparseable: {e!s:.120}"))
+    return modules, errors
+
+
+def all_passes() -> List[LintPass]:
+    from . import blocking, locks, registry, tags, traceguard
+    return [locks.LockDisciplinePass(), tags.TagNamespacePass(),
+            registry.RegistryPass(), blocking.BlockingCallPass(),
+            traceguard.TraceGuardPass()]
+
+
+def run_passes(modules: List[SourceModule],
+               passes: Optional[List[LintPass]] = None) -> List[Finding]:
+    out: List[Finding] = []
+    for p in passes or all_passes():
+        out.extend(p.run(modules))
+    out.sort(key=lambda f: (f.path, f.line, f.pass_id, f.msg))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# baseline (the ratchet)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Baseline:
+    path: str
+    entries: List[dict] = field(default_factory=list)
+
+    def keys(self) -> Dict[str, dict]:
+        return {f"{e['pass']}:{e['path']}:{e['msg']}": e
+                for e in self.entries}
+
+    def split(self, findings: List[Finding]):
+        """(new, suppressed, stale_entries)."""
+        keys = self.keys()
+        new = [f for f in findings if f.key not in keys]
+        supp = [f for f in findings if f.key in keys]
+        live = {f.key for f in findings}
+        stale = [e for k, e in keys.items() if k not in live]
+        return new, supp, stale
+
+
+def load_baseline(path: Optional[str] = None) -> Baseline:
+    path = path or DEFAULT_BASELINE
+    if not os.path.exists(path):
+        return Baseline(path, [])
+    with open(path) as f:
+        data = json.load(f)
+    return Baseline(path, list(data.get("suppressions", [])))
+
+
+def write_baseline(path: str, findings: List[Finding],
+                   reason: str = "seed baseline") -> None:
+    data = {
+        "comment": "mv2tlint suppressions — the invariant ratchet. Every "
+                   "entry needs a justification; --strict fails on stale "
+                   "entries so this file only shrinks.",
+        "suppressions": [{"pass": f.pass_id, "path": f.path, "msg": f.msg,
+                          "reason": reason} for f in findings],
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers used by several passes
+# ---------------------------------------------------------------------------
+
+def attr_chain(node: ast.AST) -> Optional[str]:
+    """Dotted name for Name/Attribute chains ('self.engine.mutex'),
+    None for anything dynamic."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """Last component of a Name/Attribute chain ('mutex' for
+    self.engine.mutex) — lock identity for the discipline passes."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def const_int(node: ast.AST) -> Optional[int]:
+    """Evaluate a compile-time integer expression (literals, + - * <<
+    | and hex), the shapes *_TAG_BASE constants are written in."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.BinOp):
+        lhs, rhs = const_int(node.left), const_int(node.right)
+        if lhs is None or rhs is None:
+            return None
+        op = node.op
+        if isinstance(op, ast.Add):
+            return lhs + rhs
+        if isinstance(op, ast.Sub):
+            return lhs - rhs
+        if isinstance(op, ast.Mult):
+            return lhs * rhs
+        if isinstance(op, ast.LShift):
+            return lhs << rhs
+        if isinstance(op, ast.BitOr):
+            return lhs | rhs
+        return None
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = const_int(node.operand)
+        return None if v is None else -v
+    return None
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents

@@ -1,0 +1,242 @@
+"""Runtime lock-order detector (MV2T_LOCKCHECK=1).
+
+The static ``locks`` pass proves guarded state is touched under its
+lock; this module catches the failure mode statics can't — two locks
+taken in OPPOSITE orders on different code paths (the AB/BA deadlock)
+and locks held INTO a blocking progress wait (the handler-waits-on-
+traffic-only-it-can-progress hang of PAPER.md §L3).
+
+Mechanism: lock creation sites wrap their lock with ``tracked(lock,
+name)``. When MV2T_LOCKCHECK is off this returns the raw lock — ZERO
+overhead, same discipline as the trace recorder's one-attribute check.
+When on, a ``TrackedLock`` proxy records every successful acquisition
+into a per-thread held stack and a per-process acquisition-order graph:
+
+  * edge a->b = "b acquired while a held", deduplicated, with the
+    source site (file:line) of BOTH acquisitions;
+  * each NEW edge runs a DFS; a path b ~> a closes a cycle = potential
+    deadlock. One report per distinct lock set (a hung job must not
+    emit one report per iteration), counted in the
+    ``lockcheck_cycles`` pvar and written to the mlog stream — the
+    same dump path the stall watchdog uses; ``watchdog.build_report``
+    appends the monitor's summary so a stall diagnostic carries the
+    lock-order evidence automatically.
+  * ``check_wait`` (called from ProgressEngine.progress_wait behind a
+    single attribute check) reports a thread entering the blocking
+    progress wait while holding tracked locks.
+
+Failed try-acquires record nothing (a failed nonblocking probe is
+deadlock-safe); reentrant RLock acquisitions add no self-edges.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..utils.mlog import get_logger
+
+log = get_logger("lockcheck")
+
+
+def _site(depth: int = 2) -> str:
+    try:
+        f = sys._getframe(depth)
+        return f"{f.f_code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno}"
+    except ValueError:  # pragma: no cover
+        return "<unknown>"
+
+
+class LockOrderMonitor:
+    """Per-process acquisition-order graph + per-thread held stacks."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        # (a, b) -> (site a was held from, site b was acquired at)
+        self._edges: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        self._adj: Dict[str, Set[str]] = {}
+        self._cycle_keys: Set[frozenset] = set()
+        self.cycle_reports: List[str] = []
+        self.wait_reports: List[str] = []
+        self._wait_threads: Set[int] = set()
+        from .. import mpit
+        self._pv_edges = mpit.pvar("lockcheck_edges")
+        self._pv_cycles = mpit.pvar("lockcheck_cycles")
+
+    # -- held stack -------------------------------------------------------
+    def _stack(self) -> List[Tuple[str, str]]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def on_acquired(self, name: str, site: str) -> None:
+        st = self._stack()
+        new_edges = []
+        with self._mu:
+            for held, held_site in st:
+                if held == name:
+                    continue          # reentrant RLock: no self-edge
+                key = (held, name)
+                if key not in self._edges:
+                    self._edges[key] = (held_site, site)
+                    self._adj.setdefault(held, set()).add(name)
+                    self._pv_edges.inc()
+                    new_edges.append(key)
+            for a, b in new_edges:
+                self._check_cycle(a, b)
+        st.append((name, site))
+
+    def on_released(self, name: str) -> None:
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][0] == name:
+                del st[i]
+                return
+
+    def held_locks(self) -> List[Tuple[str, str]]:
+        return list(self._stack())
+
+    # -- cycle detection (self._mu held) ----------------------------------
+    def _check_cycle(self, a: str, b: str) -> None:
+        """New edge a->b: a path b ~> a closes a cycle."""
+        path = self._find_path(b, a)
+        if path is None:
+            return
+        cycle = [(a, b)] + list(zip(path, path[1:]))
+        key = frozenset(n for e in cycle for n in e)
+        if key in self._cycle_keys:
+            return
+        self._cycle_keys.add(key)
+        lines = ["# lock-order: potential deadlock cycle "
+                 f"({' -> '.join([a, b] + path[1:])})"]
+        for x, y in cycle:
+            xs, ys = self._edges[(x, y)]
+            lines.append(f"  {x} (held from {xs}) -> {y} (acquired at {ys})")
+        report = "\n".join(lines)
+        self.cycle_reports.append(report)
+        self._pv_cycles.inc()
+        log.warn("%s", report)
+
+    def _find_path(self, src: str, dst: str) -> Optional[List[str]]:
+        seen = {src}
+        stack = [(src, [src])]
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self._adj.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    # -- progress-wait discipline ----------------------------------------
+    def check_wait(self, rank: int) -> None:
+        """Called on entry to the blocking progress wait: holding any
+        tracked lock here risks the handler-deadlock shape. One report
+        per thread (the wait is re-entered every blocking MPI call)."""
+        st = self._stack()
+        if not st:
+            return
+        tid = threading.get_ident()
+        with self._mu:
+            if tid in self._wait_threads:
+                return
+            self._wait_threads.add(tid)
+            report = (f"# lock-order: rank {rank} entered progress_wait "
+                      f"holding {len(st)} tracked lock(s): "
+                      + ", ".join(f"{n} (from {s})" for n, s in st))
+            self.wait_reports.append(report)
+        log.warn("%s", report)
+
+    # -- dump-path integration -------------------------------------------
+    def report(self) -> str:
+        """Summary block appended to the stall watchdog's diagnostic."""
+        with self._mu:
+            lines = [f"## lock-order monitor: {len(self._edges)} edge(s), "
+                     f"{len(self.cycle_reports)} cycle(s), "
+                     f"{len(self.wait_reports)} held-across-wait "
+                     "violation(s)"]
+            lines.extend(self.cycle_reports)
+            lines.extend(self.wait_reports)
+        return "\n".join(lines)
+
+
+class TrackedLock:
+    """Order-recording proxy over a Lock/RLock (lockcheck-on only)."""
+
+    __slots__ = ("_lock", "name", "_mon")
+
+    def __init__(self, lock, name: str, mon: LockOrderMonitor):
+        self._lock = lock
+        self.name = name
+        self._mon = mon
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            self._mon.on_acquired(self.name, _site())
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+        self._mon.on_released(self.name)
+
+    def __enter__(self):
+        ok = self._lock.acquire()
+        if ok:
+            self._mon.on_acquired(self.name, _site())
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __repr__(self):
+        return f"TrackedLock({self.name}, {self._lock!r})"
+
+
+# ---------------------------------------------------------------------------
+# process-global monitor (positive result cached; off re-checks the cvar
+# so in-process test universes can toggle it between runs)
+# ---------------------------------------------------------------------------
+
+_monitor: Optional[LockOrderMonitor] = None
+_mk_lock = threading.Lock()
+
+
+def get_monitor() -> Optional[LockOrderMonitor]:
+    global _monitor
+    if _monitor is not None:
+        return _monitor
+    from .. import mpit  # noqa: F401  (declares the LOCKCHECK cvar)
+    from ..utils.config import get_config
+    if not get_config().get("LOCKCHECK", False):
+        return None
+    with _mk_lock:
+        if _monitor is None:
+            _monitor = LockOrderMonitor()
+    return _monitor
+
+
+def tracked(lock, name: str):
+    """Wrap ``lock`` for order tracking iff MV2T_LOCKCHECK is on;
+    returns the raw lock otherwise (zero overhead off — the lock
+    creation site is the only gate)."""
+    mon = get_monitor()
+    if mon is None:
+        return lock
+    return TrackedLock(lock, name, mon)
+
+
+def configure(engine) -> None:
+    """Attach (or detach) the monitor on ``engine`` — called from
+    Universe.initialize after the config reload, mirroring
+    watchdog.configure, so progress_wait pays one attribute check."""
+    engine._lockcheck = get_monitor()

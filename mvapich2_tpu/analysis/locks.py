@@ -1,0 +1,223 @@
+"""Pass ``locks`` — guarded-by lock discipline.
+
+Attributes registered as guarded-by (via ``# guarded-by: <lock>``
+annotations on their initializing assignment, or the seed registry below
+for the pre-existing hot structs) may only be touched inside a ``with``
+block holding the matching lock. Lock identity is the TERMINAL attribute
+name (``mutex`` matches ``self.mutex``, ``eng.mutex``,
+``self.engine.mutex``): rank-local state in this codebase is always
+guarded by the one lock of that name reachable from the touching scope,
+so the cheap syntactic match is exact in practice.
+
+Scope rules:
+  * checked: ``self.<attr>`` inside the owning class, and bare module
+    globals inside the owning module (cross-object accesses like
+    ``nbc.active`` from another module are out of static reach — keep
+    such state behind accessor methods).
+  * ``__init__`` is exempt (the object is not yet shared).
+  * ``# holds: <lock>[, <lock>]`` on a ``def`` line asserts the caller
+    contract "runs with these locks held" (e.g. request-completion
+    callbacks running under the engine mutex) — the body is checked as
+    if the locks were acquired.
+  * A ``# guarded-by:`` value may list alternatives with ``|``
+    (``_inbox_lock|_inbox_cond`` — a Condition constructed over the
+    lock acquires the same mutex).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, LintPass, SourceModule, terminal_name
+
+# Seed registry for hot structs that predate the annotation syntax:
+# (relpath suffix, class name or None for module globals) ->
+#     {attr: accepted lock terminal names}
+SEED_GUARDS: Dict[Tuple[str, Optional[str]], Dict[str, Set[str]]] = {
+    ("mvapich2_tpu/transport/shm.py", "ShmChannel"): {
+        "_spill_pending": {"_spill_lock"},
+        "_spill_seq": {"_spill_lock"},
+        "_backlog": {"_send_lock"},
+    },
+    ("mvapich2_tpu/coll/nbc/engine.py", "NbcEngine"): {
+        "active": {"mutex"},
+    },
+    ("mvapich2_tpu/trace/recorder.py", None): {
+        "_active": {"_lock"},
+    },
+    ("mvapich2_tpu/transport/arena.py", "ShmArena"): {
+        "_free": {"_lock"},
+        "_brk": {"_lock"},
+        "_outstanding": {"_lock"},
+        "_in_use": {"_lock"},
+    },
+}
+
+_EXEMPT_METHODS = {"__init__", "__new__", "__init_subclass__"}
+
+
+def _parse_guard(value: str) -> Set[str]:
+    return {p.strip() for p in value.split("|") if p.strip()}
+
+
+class _Scope:
+    """Guard tables for one module: per-class and module-global."""
+
+    def __init__(self, mod: SourceModule):
+        self.mod = mod
+        self.cls_guards: Dict[str, Dict[str, Set[str]]] = {}
+        self.mod_guards: Dict[str, Set[str]] = {}
+        for (suffix, cls), attrs in SEED_GUARDS.items():
+            if mod.relpath.endswith(suffix):
+                if cls is None:
+                    for a, locks in attrs.items():
+                        self.mod_guards.setdefault(a, set()).update(locks)
+                else:
+                    g = self.cls_guards.setdefault(cls, {})
+                    for a, locks in attrs.items():
+                        g.setdefault(a, set()).update(locks)
+        # harvest # guarded-by: annotations
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            g = self.cls_guards.setdefault(node.name, {})
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    val = mod.annotation(sub.lineno, "guarded-by")
+                    if not val:
+                        continue
+                    targets = sub.targets if isinstance(sub, ast.Assign) \
+                        else [sub.target]
+                    for t in targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            g.setdefault(t.attr, set()).update(
+                                _parse_guard(val))
+        for node in mod.tree.body:
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                val = mod.annotation(node.lineno, "guarded-by")
+                if not val:
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        self.mod_guards.setdefault(t.id, set()).update(
+                            _parse_guard(val))
+
+
+class LockDisciplinePass(LintPass):
+    id = "locks"
+    doc = ("guarded-by attributes may only be touched inside the "
+           "matching with-lock block")
+
+    def run(self, modules: List[SourceModule]) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in modules:
+            scope = _Scope(mod)
+            if not scope.cls_guards and not scope.mod_guards:
+                continue
+            for node in mod.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    guards = scope.cls_guards.get(node.name, {})
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            self._check_fn(mod, sub, f"{node.name}.{sub.name}",
+                                           guards, scope.mod_guards, out)
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._check_fn(mod, node, node.name, {},
+                                   scope.mod_guards, out)
+        return out
+
+    # ------------------------------------------------------------------
+    def _check_fn(self, mod: SourceModule, fn, qual: str,
+                  guards: Dict[str, Set[str]],
+                  mod_guards: Dict[str, Set[str]],
+                  out: List[Finding]) -> None:
+        if fn.name in _EXEMPT_METHODS:
+            return
+        held: Set[str] = set()
+        holds = mod.annotation(fn.lineno, "holds")
+        if holds:
+            held |= {p.strip() for p in holds.split(",") if p.strip()}
+        params = {a.arg for a in fn.args.args + fn.args.kwonlyargs
+                  + fn.args.posonlyargs}
+        if fn.args.vararg:
+            params.add(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            params.add(fn.args.kwarg.arg)
+        # bare names assigned in the function body shadow module globals
+        local_names = {t.id for sub in ast.walk(fn)
+                       for t in self._stmt_targets(sub)
+                       if isinstance(t, ast.Name)}
+        reported: Set[str] = set()
+
+        def note(line: int, attr: str, locks: Set[str]) -> None:
+            if attr in reported:
+                return
+            reported.add(attr)
+            f = self.finding(mod, line,
+                             f"'{attr}' (guarded-by {'|'.join(sorted(locks))})"
+                             f" touched in {qual} without the lock held")
+            if f is not None:
+                out.append(f)
+
+        def scan(node: ast.AST, held: Set[str]) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner = set(held)
+                for item in node.items:
+                    scan(item.context_expr, held)
+                    t = terminal_name(item.context_expr)
+                    if t is not None:
+                        inner.add(t)
+                for st in node.body:
+                    scan(st, inner)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested def: runs later under its own contract
+                self._check_fn(mod, node, f"{qual}.{node.name}",
+                               guards, mod_guards, out)
+                return
+            if isinstance(node, ast.Lambda):
+                return   # no annotation surface; call targets are checked
+            if isinstance(node, ast.Attribute):
+                if isinstance(node.value, ast.Name) \
+                        and node.value.id == "self" \
+                        and node.attr in guards \
+                        and not (held & guards[node.attr]):
+                    note(node.lineno, node.attr, guards[node.attr])
+                scan(node.value, held)
+                return
+            if isinstance(node, ast.Name):
+                if node.id in mod_guards and node.id not in params \
+                        and node.id not in local_names \
+                        and not (held & mod_guards[node.id]):
+                    note(node.lineno, node.id, mod_guards[node.id])
+                return
+            for child in ast.iter_child_nodes(node):
+                scan(child, held)
+
+        for st in fn.body:
+            scan(st, held)
+
+    @staticmethod
+    def _stmt_targets(sub: ast.AST):
+        if isinstance(sub, ast.Assign):
+            raw = sub.targets
+        elif isinstance(sub, (ast.AnnAssign, ast.AugAssign, ast.For,
+                              ast.NamedExpr)):
+            raw = [sub.target]
+        elif isinstance(sub, ast.withitem) and sub.optional_vars is not None:
+            raw = [sub.optional_vars]
+        else:
+            return []
+        flat = []
+        for t in raw:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                flat.extend(t.elts)
+            else:
+                flat.append(t)
+        return flat

@@ -1,0 +1,101 @@
+"""Pass ``tags`` — tag-namespace disjointness.
+
+Every subsystem that places traffic on a shared context carves its tags
+out of a ``*_TAG_BASE`` constant (coll/nbc/inter.py NBC_TAG_BASE,
+ft/ulfm.py _FT_TAG_BASE). A silent overlap — two subsystems deriving
+the same wire tag on the same context — mismatches messages across
+layers, the worst kind of heisenbug. This pass collects every
+module-level ``*_TAG_BASE`` integer constant, widens each to a range
+using its ``# tag-span: N`` annotation (default 32768 — the 15-bit
+window ``next_coll_tag`` cycles through, which is also what most
+namespaces add to their base), and proves:
+
+  * no two namespace ranges overlap,
+  * no namespace overlaps the dynamic collective-tag window
+    [0, 32768) that ``core/comm.py next_coll_tag`` hands out,
+  * every range fits signed-31-bit tag space (the wire format).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, NamedTuple
+
+from .core import Finding, LintPass, SourceModule, const_int
+
+_NAME_RE = re.compile(r"^_?[A-Z][A-Z0-9_]*_TAG_BASE$")
+DEFAULT_SPAN = 32768          # the next_coll_tag 15-bit window
+DYNAMIC_WINDOW = ("dynamic next_coll_tag window (core/comm.py)", 0,
+                  DEFAULT_SPAN)
+TAG_SPACE = 1 << 31
+
+
+class _Range(NamedTuple):
+    name: str
+    lo: int
+    hi: int
+    mod: SourceModule
+    line: int
+
+    def label(self) -> str:
+        return f"{self.name} [{self.lo:#x}, {self.hi:#x}) ({self.mod.relpath})"
+
+
+class TagNamespacePass(LintPass):
+    id = "tags"
+    doc = "*_TAG_BASE namespaces must be disjoint ranges in tag space"
+
+    def run(self, modules: List[SourceModule]) -> List[Finding]:
+        out: List[Finding] = []
+        ranges: List[_Range] = []
+        for mod in modules:
+            for node in mod.tree.body:
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    if not (isinstance(t, ast.Name) and _NAME_RE.match(t.id)):
+                        continue
+                    base = const_int(node.value)
+                    if base is None:
+                        f = self.finding(mod, node.lineno,
+                                         f"tag base {t.id} is not a "
+                                         "compile-time integer constant")
+                        if f is not None:
+                            out.append(f)
+                        continue
+                    span_s = mod.annotation(node.lineno, "tag-span")
+                    span = DEFAULT_SPAN
+                    if span_s is not None:
+                        # first token only: prose may follow the number
+                        try:
+                            span = int(span_s.split()[0], 0)
+                        except (ValueError, IndexError):
+                            f = self.finding(mod, node.lineno,
+                                             f"unparseable tag-span "
+                                             f"annotation on {t.id}")
+                            if f is not None:
+                                out.append(f)
+                    ranges.append(_Range(t.id, base, base + span,
+                                         mod, node.lineno))
+        ranges.sort(key=lambda r: (r.lo, r.name))
+        dyn_name, dyn_lo, dyn_hi = DYNAMIC_WINDOW
+        for r in ranges:
+            if r.hi > TAG_SPACE:
+                f = self.finding(r.mod, r.line,
+                                 f"{r.label()} exceeds signed-31-bit "
+                                 "tag space")
+                if f is not None:
+                    out.append(f)
+            if r.lo < dyn_hi and dyn_lo < r.hi:
+                f = self.finding(r.mod, r.line,
+                                 f"{r.label()} overlaps the {dyn_name}")
+                if f is not None:
+                    out.append(f)
+        for a, b in zip(ranges, ranges[1:]):
+            if b.lo < a.hi:
+                f = self.finding(b.mod, b.line,
+                                 f"{b.label()} overlaps {a.label()}")
+                if f is not None:
+                    out.append(f)
+        return out

@@ -1,0 +1,130 @@
+"""Pass ``traceguard`` — trace sites behind the one-attribute-check idiom.
+
+The recorder's cost contract (trace/recorder.py): when tracing is off,
+every instrumented site pays exactly ONE attribute check. The compiled
+idioms are
+
+    tr = engine.tracer                 if tracer is not None:
+    if tr is not None:                     tracer.record(...)
+        tr.record(...)
+
+    if (tr := eng.tracer) is not None:
+        tr.record(...)
+
+An unguarded ``X.record(...)`` on a tracer either crashes when tracing
+is off (tracer is None) or hides a config lookup on the hot path. This
+pass finds every ``.record(...)`` call whose receiver looks like a
+tracer — a name in {tr, tracer, rec} or an attribute chain ending in
+``.tracer`` — and requires an enclosing ``is not None`` guard on that
+same receiver (plain if, walrus, ternary) or an early
+``if X is None: return`` in the same function.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .core import Finding, LintPass, SourceModule, attr_chain, parent_map
+
+TRACER_NAMES = {"tr", "tracer", "rec"}
+
+
+def _receiver_key(fn: ast.Attribute) -> Optional[str]:
+    """The guarded expression, as a dotted chain, when the receiver is
+    tracer-shaped; None otherwise."""
+    recv = fn.value
+    if isinstance(recv, ast.Name):
+        return recv.id if recv.id in TRACER_NAMES else None
+    chain = attr_chain(recv)
+    if chain is not None and chain.split(".")[-1] == "tracer":
+        return chain
+    return None
+
+
+def _test_guards(test: ast.AST, key: str) -> bool:
+    """Does ``test`` contain ``<key> is not None`` (walrus included)?"""
+    for node in ast.walk(test):
+        if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+            continue
+        if not isinstance(node.ops[0], ast.IsNot):
+            continue
+        comp = node.comparators[0]
+        if not (isinstance(comp, ast.Constant) and comp.value is None):
+            continue
+        left = node.left
+        if isinstance(left, ast.NamedExpr):
+            if isinstance(left.target, ast.Name) and left.target.id == key:
+                return True
+            left = left.value
+        if attr_chain(left) == key:
+            return True
+    return False
+
+
+def _early_return_guard(fndef, key: str, before_line: int) -> bool:
+    """``if <key> is None: return`` earlier in the same function body."""
+    for st in ast.walk(fndef):
+        if not isinstance(st, ast.If) or st.lineno >= before_line:
+            continue
+        for node in ast.walk(st.test):
+            if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                    and isinstance(node.ops[0], ast.Is) \
+                    and isinstance(node.comparators[0], ast.Constant) \
+                    and node.comparators[0].value is None \
+                    and attr_chain(node.left) == key:
+                if any(isinstance(b, ast.Return) for b in st.body):
+                    return True
+    return False
+
+
+class TraceGuardPass(LintPass):
+    id = "traceguard"
+    doc = ("every tracer .record() site sits behind the single "
+           "attribute-check 'is not None' idiom")
+
+    def run(self, modules: List[SourceModule]) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in modules:
+            parents = parent_map(mod.tree)
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "record"):
+                    continue
+                key = _receiver_key(node.func)
+                if key is None:
+                    continue
+                if self._guarded(node, key, parents):
+                    continue
+                f = self.finding(mod, node.lineno,
+                                 f"trace site '{key}.record(...)' is not "
+                                 "behind an 'is not None' guard "
+                                 "(one-attribute-check idiom)")
+                if f is not None:
+                    out.append(f)
+        return out
+
+    @staticmethod
+    def _guarded(call: ast.Call, key: str, parents) -> bool:
+        node: ast.AST = call
+        fndef = None
+        while node in parents:
+            child, node = node, parents[node]
+            if isinstance(node, ast.If) and child in node.body \
+                    and _test_guards(node.test, key):
+                return True
+            if isinstance(node, ast.IfExp) and child is node.body \
+                    and _test_guards(node.test, key):
+                return True
+            if isinstance(node, (ast.BoolOp,)) and \
+                    isinstance(node.op, ast.And) and node.values \
+                    and child is not node.values[0] \
+                    and any(_test_guards(v, key) for v in node.values[:-1]):
+                return True
+            if fndef is None and isinstance(node, (ast.FunctionDef,
+                                                   ast.AsyncFunctionDef)):
+                fndef = node
+        if fndef is not None:
+            return _early_return_guard(fndef, key, call.lineno)
+        return False

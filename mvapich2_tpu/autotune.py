@@ -29,7 +29,14 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .utils.config import cvar, get_config
 from .utils.mlog import get_logger
+
+cvar("TUNING_PROFILE", "", str, "coll",
+     "Path of a measured tuning profile to load at Init, overriding the "
+     "committed arch-keyed file under profiles/ (no arch check: the "
+     "user said so). Analog of MV2 pointing at a generated tuning "
+     "table.")
 
 log = get_logger("autotune")
 
@@ -285,7 +292,7 @@ def load_default_profile() -> Optional[str]:
     if _default_attempted:
         return _loaded_from
     _default_attempted = True
-    forced = os.environ.get("MV2T_TUNING_PROFILE")
+    forced = get_config().get("TUNING_PROFILE", "") or None
     path = forced or _arch_file()
     if load_profile_file(path, check_arch=not forced):
         _loaded_from = path

@@ -90,7 +90,7 @@ class NbcEngine:
         return st.req
 
     # -- advancement (engine mutex held on every path) --------------------
-    def _advance(self, st: _SchedState) -> None:
+    def _advance(self, st: _SchedState) -> None:  # holds: mutex
         """Issue every runnable vertex. Re-entrant completions (an eager
         send or an already-matched recv finishing inside its own issue)
         land in ``st.ready`` and are picked up by the outer loop — the
@@ -112,7 +112,7 @@ class NbcEngine:
         if not st.done and st.remaining == 0:
             self._complete(st, None)
 
-    def _issue(self, st: _SchedState, vid: int) -> None:
+    def _issue(self, st: _SchedState, vid: int) -> None:  # holds: mutex
         v = st.dag.vertices[vid]
         _pv_issued.inc()
         self._gen += 1
@@ -159,7 +159,7 @@ class NbcEngine:
         req.add_callback(
             lambda r, st=st, vid=vid: self._on_completion(st, vid, r))
 
-    def _vertex_done(self, st: _SchedState, vid: int) -> None:
+    def _vertex_done(self, st: _SchedState, vid: int) -> None:  # holds: mutex
         if (tr := self.engine.tracer) is not None:
             tr.record("nbc", "vertex_complete", "i", sched=st.req.req_id,
                       vid=vid)
@@ -171,7 +171,7 @@ class NbcEngine:
                 st.ready.append(w)
         self._gen += 1
 
-    def _on_completion(self, st: _SchedState, vid: int,
+    def _on_completion(self, st: _SchedState, vid: int,  # holds: mutex
                        req: Request) -> None:
         """Request-completion callback: runs mutex-held from
         ``ProgressEngine.complete_request`` on whatever thread progressed
@@ -187,7 +187,7 @@ class NbcEngine:
         if not st.done and st.remaining == 0:
             self._complete(st, None)
 
-    def _complete(self, st: _SchedState,
+    def _complete(self, st: _SchedState,  # holds: mutex
                   error: Optional[MPIException]) -> None:
         st.done = True
         if (tr := self.engine.tracer) is not None:
@@ -233,7 +233,7 @@ class NbcEngine:
             return True
 
     # -- progress hook (mutex held, from progress_poke) -------------------
-    def _hook(self) -> bool:
+    def _hook(self) -> bool:  # holds: mutex
         if not self.active:
             return False
         did = False

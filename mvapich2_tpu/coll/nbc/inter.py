@@ -34,7 +34,7 @@ from .engine import start
 
 # high, disjoint from the 0..32767 window next_coll_tag cycles through
 # and far below the ULFM agreement range (_FT_TAG_BASE = 0x7F0000)
-NBC_TAG_BASE = 1 << 20
+NBC_TAG_BASE = 1 << 20  # tag-span: 32768 (adds the next_coll_tag window)
 
 
 def _nbc_tag(comm) -> int:

@@ -25,6 +25,14 @@ from .core.errors import MPIException
 from .core.status import ANY_SOURCE, ANY_TAG, PROC_NULL
 from .coll.api import IN_PLACE
 from .runtime import universe as uni
+from .utils.config import cvar, get_config
+
+cvar("CSHIM_PROFILE", "", str, "debug",
+     "When set, cProfile the C-ABI shim for the whole job and "
+     "write per-rank pstats dumps to <value>.rank<r> at Finalize.")
+cvar("UNIVERSE_SIZE", 0, int, "runtime",
+     "MPI_UNIVERSE_SIZE override (spawn capacity); 0 = default "
+     "world+8 (process-mode spawn forks children freely).")
 
 # ---------------------------------------------------------------------------
 # handle tables (mirror the enum values in native/mpi/mpi.h)
@@ -357,7 +365,7 @@ def init() -> int:
         faulthandler.register(_sig.SIGUSR1, all_threads=True)
     except (ImportError, AttributeError, ValueError):
         pass
-    if os.environ.get("MV2T_CSHIM_PROFILE"):
+    if get_config().get("CSHIM_PROFILE", ""):
         import cProfile
         global _profiler
         _profiler = cProfile.Profile()
@@ -374,7 +382,7 @@ def finalize() -> int:
     if _profiler is not None:
         _profiler.disable()
         import pstats
-        path = os.environ.get("MV2T_CSHIM_PROFILE") + \
+        path = get_config().get("CSHIM_PROFILE", "") + \
             f".rank{os.environ.get('MV2T_RANK', '0')}"
         with open(path, "w") as f:
             pstats.Stats(_profiler, stream=f).sort_stats(
@@ -3143,9 +3151,9 @@ def universe_size() -> int:
     """MPI_UNIVERSE_SIZE: spawn capacity. MV2T_UNIVERSE_SIZE overrides;
     default world+8 (process-mode spawn forks children freely, so the
     universe is genuinely larger than the initial world)."""
-    env = os.environ.get("MV2T_UNIVERSE_SIZE")
-    if env:
-        return int(env)
+    override = int(get_config().get("UNIVERSE_SIZE", 0) or 0)
+    if override:
+        return override
     return _comm(0).size + 8
 
 

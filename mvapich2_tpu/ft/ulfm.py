@@ -47,7 +47,7 @@ log = get_logger("ft")
 
 # tag space reserved for the FT agreement protocol — far above the
 # collective sequencer's 15-bit window (core/comm.py next_coll_tag)
-_FT_TAG_BASE = 0x7F0000
+_FT_TAG_BASE = 0x7F0000  # tag-span: 0x10000 (rounds are bounded by world size)
 
 
 # ---------------------------------------------------------------------------

@@ -21,7 +21,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-from .utils.config import CVar, get_config
+from .utils.config import CVar, cvar, get_config
 
 # MPI_T verbosity / scope / binding constants (subset)
 VERBOSITY_USER_BASIC = 221
@@ -245,6 +245,42 @@ def dump() -> str:
         pv = _pvars.get(n)
         lines.append(f"{pv.name:<44} = {pv.read():<14g} [{pv.group}]")
     return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# analysis knobs (mv2t-analyze). Declared HERE — not next to their code —
+# so the MPI_T surface carries the checker's observability even before
+# mvapich2_tpu.analysis is imported (the lockorder module fetches the
+# already-declared pvars on first use).
+# ---------------------------------------------------------------------------
+
+cvar("LOCKCHECK", False, bool, "analysis",
+     "Enable the runtime lock-order detector (analysis/lockorder.py): "
+     "instrumented locks record a per-process acquisition-order graph; "
+     "cycles (potential deadlocks) and locks held across progress_wait "
+     "are reported through the stall-watchdog dump path. Zero overhead "
+     "when off (lock creation sites return the raw lock).")
+
+
+def _lint_baseline_count() -> float:
+    """Committed mv2tlint suppression count — the ratchet position."""
+    try:
+        from .analysis.core import load_baseline
+        return float(len(load_baseline().entries))
+    except Exception:   # tools must never break the registry
+        return -1.0
+
+
+pvar("lint_findings_baseline", PVAR_CLASS_LEVEL, "analysis",
+     "mv2tlint findings suppressed by the committed baseline "
+     "(analysis/baseline.json); --strict only lets this shrink",
+     source=_lint_baseline_count)
+pvar("lockcheck_edges", PVAR_CLASS_COUNTER, "analysis",
+     "distinct lock-acquisition-order edges observed by the "
+     "MV2T_LOCKCHECK monitor")
+pvar("lockcheck_cycles", PVAR_CLASS_COUNTER, "analysis",
+     "distinct lock-order cycles (potential deadlocks) reported by the "
+     "MV2T_LOCKCHECK monitor")
 
 
 # ---------------------------------------------------------------------------

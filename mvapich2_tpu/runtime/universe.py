@@ -74,7 +74,8 @@ class Universe:
         self.comm_self = None
         self._next_ctx = 8  # 0/1: world pt2pt/coll, 2/3: self, 4+: spare
         self._ctx_mask = None   # lazily sized (ctx_mask())
-        self._ctx_lock = threading.Lock()
+        from ..analysis.lockorder import tracked
+        self._ctx_lock = tracked(threading.Lock(), "universe._ctx_lock")
         self._ctx_holder = None   # key of the agreement holding the mask
         self._ctx_waiting = set()  # keys of locally-pending agreements
         self.finalized = False
@@ -198,6 +199,8 @@ class Universe:
                 from .. import trace
                 trace.maybe_attach(self.engine)
                 trace.watchdog.configure(self.engine)
+                from ..analysis import lockorder
+                lockorder.configure(self.engine)
             with ts.phase("protocol + matcher"):
                 self.protocol = Pt2ptProtocol(self)
                 from ..ft import ulfm

@@ -80,6 +80,10 @@ def build_report(engine) -> str:
                      f"in-flight={sorted(st.inflight)} "
                      f"ready={sorted(st.ready)}")
 
+    lockcheck = getattr(engine, "_lockcheck", None)
+    if lockcheck is not None:
+        lines.append(lockcheck.report())
+
     tracer = getattr(engine, "tracer", None)
     if tracer is not None:
         n = int(get_config().get("STALL_EVENTS", 64))

@@ -184,7 +184,8 @@ class ShmArena:
         self._part_hi = self._part_lo + part_bytes
         self._brk = self._part_lo
         self._free: Dict[int, List[int]] = {}
-        self._lock = threading.Lock()
+        from ..analysis.lockorder import tracked
+        self._lock = tracked(threading.Lock(), f"arena[{my_index}]._lock")
         self._outstanding = 0
         self._in_use = 0
 
@@ -233,11 +234,13 @@ class ShmArena:
     @property
     def outstanding(self) -> int:
         """Live handle count (the Finalize leak check)."""
-        return self._outstanding
+        with self._lock:
+            return self._outstanding
 
     @property
     def bytes_in_use(self) -> int:
-        return self._in_use
+        with self._lock:
+            return self._in_use
 
     # -- spill-consumed counters (oversize python packets staged here) ---
     def spill_consumed(self, src_i: int, dst_i: int) -> int:

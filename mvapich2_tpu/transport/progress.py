@@ -33,10 +33,12 @@ log = get_logger("progress")
 
 class ProgressEngine:
     def __init__(self, rank: int):
+        from ..analysis.lockorder import tracked
         self.rank = rank
-        self.mutex = threading.RLock()
-        self._inbox: collections.deque = collections.deque()
-        self._inbox_lock = threading.Lock()
+        self.mutex = tracked(threading.RLock(), f"engine[{rank}].mutex")
+        self._inbox = collections.deque()  # guarded-by: _inbox_lock|_inbox_cond
+        self._inbox_lock = tracked(threading.Lock(),
+                                   f"engine[{rank}]._inbox_lock")
         self._inbox_cond = threading.Condition(self._inbox_lock)
         # bumped on every wakeup/enqueue: the blocking wait re-checks it
         # so a notify that lands between the final poll and the wait is
@@ -78,6 +80,10 @@ class ProgressEngine:
         self.universe = None
         self._stall_limit: Optional[float] = None
         self._stall_tripped = False
+        # lock-order monitor attach point (analysis/lockorder.configure,
+        # from Universe.initialize); None keeps the wait path at one
+        # attribute check when MV2T_LOCKCHECK is off
+        self._lockcheck = None
         from .. import mpit
         self._pv_polls = mpit.pvar("progress_polls",
                                    mpit.PVAR_CLASS_COUNTER, "progress",
@@ -256,6 +262,10 @@ class ProgressEngine:
                     if pred():
                         return
                 spin += 1
+                if self._lockcheck is not None:
+                    # about to block: holding any tracked lock here is
+                    # the handler-deadlock shape (lock-order monitor)
+                    self._lockcheck.check_wait(self.rank)
                 if deadline is not None and time.monotonic() > deadline:
                     raise TimeoutError("progress_wait timed out")
                 if stall_at is not None and not self._stall_tripped \

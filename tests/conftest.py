@@ -37,6 +37,11 @@ def pytest_configure(config):
         "slow: heavy multi-process / C-compile / large-model tests — "
         "skipped by default so the suite finishes in minutes on a "
         "1-core host; run everything with MV2T_TEST_FULL=1")
+    config.addinivalue_line(
+        "markers",
+        "lint: static-analysis gate (bin/mv2tlint --strict) and the "
+        "runtime lock-order detector smoke — tier-1 by default; run "
+        "only these with -m lint")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,18 @@
+"""blocking-pass fixture: THREE seeded violations (sleep + unbounded
+acquire in a registered handler; blocking wait in an _on_* handler)."""
+
+import time
+
+
+class Proto:
+    def install(self, eng):
+        eng.register_handler(1, self._on_pkt)
+
+    def _on_pkt(self, pkt):
+        time.sleep(0.01)              # VIOLATION (line 12)
+        self._lock.acquire()          # VIOLATION (line 13)
+        self._lock.acquire(blocking=False)   # ok: bounded
+
+    def _on_other(self, pkt):         # handler by _on_* convention
+        pkt.req.wait()                # VIOLATION (line 17)
+        pkt.req.wait(timeout=1.0)     # ok: bounded

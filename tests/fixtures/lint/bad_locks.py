@@ -1,0 +1,19 @@
+"""locks-pass fixture: ONE seeded violation (the ``bad`` method)."""
+
+import threading
+
+
+class Hot:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []  # guarded-by: _lock
+
+    def good(self):
+        with self._lock:
+            self.items.append(1)
+
+    def also_good(self):  # holds: _lock
+        self.items.append(2)
+
+    def bad(self):
+        self.items.append(3)      # VIOLATION (line 19)

@@ -1,0 +1,25 @@
+"""pvars-pass fixture: FIVE seeded violations (bad cvar name, bad pvar
+name, undeclared pvar fetch, env read without cvar, config read without
+cvar)."""
+
+import os
+
+from mvapich2_tpu import mpit
+from mvapich2_tpu.utils.config import cvar, get_config
+
+cvar("GOOD_KNOB", 1, int, "test", "well-formed declaration")
+cvar("badLower", 1, int, "test", "x")     # VIOLATION: naming (line 11)
+mpit.pvar("fixture_ok_counter", 0, "test", "well-formed declaration")
+mpit.pvar("Fixture_Bad", 0, "test", "x")  # VIOLATION: naming (line 13)
+
+
+def bump():
+    mpit.pvar("fixture_never_declared").inc()       # VIOLATION (line 17)
+
+
+def read_env():
+    return os.environ.get("MV2T_NOT_A_CVAR")        # VIOLATION (line 21)
+
+
+def read_cfg():
+    return get_config().get("UNDECLARED_KNOB", 0)   # VIOLATION (line 25)

@@ -1,0 +1,6 @@
+"""tags-pass fixture: TWO seeded violations (B overlaps A; C overlaps
+the dynamic next_coll_tag window)."""
+
+ALPHA_TAG_BASE = 1 << 16                  # tag-span: 32768
+BETA_TAG_BASE = (1 << 16) + 100           # VIOLATION: overlaps ALPHA (line 5)
+GAMMA_TAG_BASE = 100                      # VIOLATION: overlaps dynamic (line 6)

@@ -1,0 +1,25 @@
+"""traceguard-pass fixture: TWO seeded violations (unguarded cached
+tracer; unguarded direct .tracer.record)."""
+
+
+class Chan:
+    def bad_cached(self, engine, n):
+        tr = engine.tracer
+        tr.record("channel", "send", "i", bytes=n)      # VIOLATION (line 8)
+
+    def bad_direct(self, engine):
+        engine.tracer.record("mpi", "enter", "B")       # VIOLATION (line 11)
+
+    def good_plain(self, engine, n):
+        tr = engine.tracer
+        if tr is not None:
+            tr.record("channel", "send", "i", bytes=n)
+
+    def good_walrus(self, engine):
+        if (tr := engine.tracer) is not None:
+            tr.record("progress", "wake", "i")
+
+    def good_early_return(self, tracer):
+        if tracer is None:
+            return
+        tracer.record("nbc", "vertex_issue", "i")

@@ -1,0 +1,71 @@
+"""Lock-order detector end-to-end: a deliberate A->B / B->A acquisition
+cycle across two threads must produce EXACTLY ONE cycle report, naming
+both acquisition sites, and the report must ride the watchdog dump path
+(watchdog.build_report carries the monitor's section).
+
+Launched via:
+    MV2T_LOCKCHECK=1 python -m mvapich2_tpu.run -np 1 \
+        tests/progs/lockcheck_cycle_prog.py
+"""
+
+import sys
+import threading
+
+sys.path.insert(0, ".")
+from mvapich2_tpu import mpi, mpit  # noqa: E402
+from mvapich2_tpu.analysis import lockorder  # noqa: E402
+from mvapich2_tpu.trace import watchdog  # noqa: E402
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+
+errs = 0
+mon = lockorder.get_monitor()
+if mon is None:
+    print("MV2T_LOCKCHECK is off; set it to 1 for this prog")
+    errs += 1
+else:
+    lock_a = lockorder.tracked(threading.Lock(), "prog.lock_a")
+    lock_b = lockorder.tracked(threading.Lock(), "prog.lock_b")
+
+    def order_ab():
+        with lock_a:
+            with lock_b:     # edge lock_a -> lock_b
+                pass
+
+    def order_ba():
+        with lock_b:
+            with lock_a:     # edge lock_b -> lock_a: closes the cycle
+                pass
+
+    for fn in (order_ab, order_ba, order_ab, order_ba):  # repeats: no dup
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+
+    ncycles = int(mpit.pvar("lockcheck_cycles").read())
+    if ncycles != 1 or len(mon.cycle_reports) != 1:
+        print(f"expected exactly one cycle report, got pvar={ncycles} "
+              f"reports={len(mon.cycle_reports)}")
+        errs += 1
+    else:
+        report = mon.cycle_reports[0]
+        # both lock sites must be named (file:line of each acquisition)
+        for needle in ("prog.lock_a", "prog.lock_b",
+                       "lockcheck_cycle_prog.py:"):
+            if needle not in report:
+                print(f"cycle report missing {needle!r}:\n{report}")
+                errs += 1
+    # the same evidence must surface through the watchdog dump path
+    wd = watchdog.build_report(comm.u.engine)
+    if "lock-order monitor" not in wd or "potential deadlock cycle" not in wd:
+        print(f"watchdog report carries no lock-order section:\n{wd}")
+        errs += 1
+    if int(mpit.pvar("lockcheck_edges").read()) < 2:
+        print("expected >= 2 recorded edges")
+        errs += 1
+
+mpi.Finalize()
+if errs == 0 and comm.rank == 0:
+    print(" No Errors")
+sys.exit(1 if errs else 0)

@@ -1,0 +1,85 @@
+"""Lockcheck-off overhead guard, mirroring trace_overhead_prog.py: with
+MV2T_LOCKCHECK unset, ``tracked()`` must return the RAW lock (identity —
+zero per-acquisition overhead by construction) and the progress-wait
+gate must stay one attribute check. As with the trace guard there is no
+un-instrumented build to A/B against, so the guard measures the exact
+unit costs on this host and asserts they stay in the noise of the
+measured ping-pong latency.
+
+Launched via: python -m mvapich2_tpu.run -np 2 tests/progs/lockcheck_overhead_prog.py
+"""
+
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from mvapich2_tpu import mpi  # noqa: E402
+from mvapich2_tpu.analysis import lockorder  # noqa: E402
+
+ITERS = 300
+SKIP = 50
+GATE_SITES = 4      # _lockcheck-is-None checks per message (wait cycles)
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+rank, size = comm.rank, comm.size
+assert size == 2, "lockcheck_overhead_prog requires exactly 2 ranks"
+
+sbuf = np.zeros(8, np.uint8)
+rbuf = np.zeros(8, np.uint8)
+comm.barrier()
+if rank == 0:
+    for i in range(ITERS + SKIP):
+        if i == SKIP:
+            t0 = time.perf_counter()
+        comm.send(sbuf, dest=1, tag=1)
+        comm.recv(rbuf, source=1, tag=1)
+    lat = (time.perf_counter() - t0) / ITERS / 2    # one-way seconds
+else:
+    for i in range(ITERS + SKIP):
+        comm.recv(rbuf, source=0, tag=1)
+        comm.send(sbuf, dest=0, tag=1)
+
+errs = 0
+if rank == 0 and lockorder.get_monitor() is not None:
+    print("MV2T_LOCKCHECK is ON; skipping the off-overhead guard")
+elif rank == 0:
+    eng = comm.u.engine
+    # off => tracked() is the identity: the engine's own mutex must be a
+    # plain RLock, not a TrackedLock proxy
+    raw = threading.Lock()
+    if lockorder.tracked(raw, "probe") is not raw:
+        print("tracked() wrapped a lock with MV2T_LOCKCHECK off")
+        errs += 1
+    if type(eng.mutex).__name__ == "TrackedLock":
+        print("engine mutex is wrapped with MV2T_LOCKCHECK off")
+        errs += 1
+    if eng._lockcheck is not None:
+        print("engine._lockcheck armed with MV2T_LOCKCHECK off")
+        errs += 1
+
+    n = 200000
+    t0 = time.perf_counter()
+    hits = 0
+    for _ in range(n):
+        if eng._lockcheck is not None:      # the exact off-gate
+            hits += 1
+    t_gate = (time.perf_counter() - t0) / n
+    assert hits == 0
+
+    overhead = GATE_SITES * t_gate
+    frac = overhead / lat
+    print(f"latency {lat * 1e6:.2f} us/msg; gate {t_gate * 1e9:.1f} ns; "
+          f"lockcheck-off overhead {overhead * 1e6:.4f} us/msg = "
+          f"{frac * 100:.3f}% of latency")
+    if frac >= 0.05:
+        errs += 1
+        print(f"lockcheck-off overhead {frac * 100:.2f}% >= 5% budget")
+
+mpi.Finalize()
+if errs == 0 and rank == 0:
+    print(" No Errors")
+sys.exit(1 if errs else 0)

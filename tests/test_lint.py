@@ -1,0 +1,156 @@
+"""mv2tlint analyzer tests: each pass against its seeded fixture (exact
+finding counts AND locations), a zero-findings clean fixture, the
+baseline ratchet (suppression, stale-entry strictness), the inline
+ignore escape, and the tier-1 gate itself — `mv2tlint --strict` over the
+live repo must exit 0."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mvapich2_tpu.analysis import core
+from mvapich2_tpu.analysis.cli import main as lint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
+
+pytestmark = pytest.mark.lint
+
+
+def _lint(name):
+    mods, errs = core.scan_paths([os.path.join(FIXTURES, name)])
+    assert not errs
+    return core.run_passes(mods)
+
+
+def _locs(findings, pass_id):
+    return [(f.pass_id, f.line) for f in findings if f.pass_id == pass_id]
+
+
+# -- one seeded fixture per pass: exact counts + locations ---------------
+
+def test_locks_pass_fixture():
+    fs = _lint("bad_locks.py")
+    assert _locs(fs, "locks") == [("locks", 19)]
+    assert len(fs) == 1
+    (f,) = fs
+    assert "'items'" in f.msg and "_lock" in f.msg and "Hot.bad" in f.msg
+
+
+def test_tags_pass_fixture():
+    fs = _lint("bad_tags.py")
+    assert _locs(fs, "tags") == [("tags", 5), ("tags", 6)]
+    assert len(fs) == 2
+    assert "overlaps ALPHA_TAG_BASE" in fs[0].msg
+    assert "dynamic next_coll_tag window" in fs[1].msg
+
+
+def test_registry_pass_fixture():
+    fs = _lint("bad_registry.py")
+    assert _locs(fs, "pvars") == [("pvars", 11), ("pvars", 13),
+                                  ("pvars", 17), ("pvars", 21),
+                                  ("pvars", 25)]
+    assert len(fs) == 5
+    msgs = "\n".join(f.msg for f in fs)
+    assert "badLower" in msgs and "Fixture_Bad" in msgs
+    assert "fixture_never_declared" in msgs
+    assert "MV2T_NOT_A_CVAR" in msgs and "UNDECLARED_KNOB" in msgs
+
+
+def test_blocking_pass_fixture():
+    fs = _lint("bad_blocking.py")
+    assert _locs(fs, "blocking") == [("blocking", 12), ("blocking", 13),
+                                     ("blocking", 17)]
+    assert len(fs) == 3
+    msgs = "\n".join(f.msg for f in fs)
+    assert "time.sleep" in msgs and "acquire" in msgs and "wait" in msgs
+
+
+def test_traceguard_pass_fixture():
+    fs = _lint("bad_traceguard.py")
+    assert _locs(fs, "traceguard") == [("traceguard", 8),
+                                       ("traceguard", 11)]
+    assert len(fs) == 2
+
+
+def test_clean_fixture_zero_findings():
+    assert _lint("clean.py") == []
+
+
+# -- suppression machinery ----------------------------------------------
+
+def test_inline_ignore_comment(tmp_path):
+    src = ("class Chan:\n"
+           "    def f(self, engine):\n"
+           "        tr = engine.tracer\n"
+           "        tr.record('x', 'y')  # mv2tlint: ignore[traceguard]\n")
+    p = tmp_path / "ignored.py"
+    p.write_text(src)
+    mods, _ = core.scan_paths([str(p)])
+    assert core.run_passes(mods) == []
+
+
+def test_baseline_suppresses_and_ratchets(tmp_path):
+    fixture = os.path.join(FIXTURES, "bad_locks.py")
+    mods, _ = core.scan_paths([fixture])
+    (f,) = core.run_passes(mods)
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({"suppressions": [
+        {"pass": f.pass_id, "path": f.path, "msg": f.msg, "reason": "t"}]}))
+    # suppressed: exit 0 even under --strict
+    assert lint_main([fixture, "--baseline", str(bl), "--strict"]) == 0
+    # a STALE entry (nothing matches it) passes plain mode but fails
+    # --strict: the invariant set only ratchets down
+    bl.write_text(json.dumps({"suppressions": [
+        {"pass": f.pass_id, "path": f.path, "msg": f.msg, "reason": "t"},
+        {"pass": "tags", "path": "gone.py", "msg": "fixed long ago",
+         "reason": "stale"}]}))
+    assert lint_main([fixture, "--baseline", str(bl)]) == 0
+    assert lint_main([fixture, "--baseline", str(bl), "--strict"]) == 1
+
+
+def test_unsuppressed_finding_fails(tmp_path):
+    fixture = os.path.join(FIXTURES, "bad_tags.py")
+    assert lint_main([fixture, "--no-baseline"]) == 1
+
+
+def test_write_baseline_roundtrip(tmp_path):
+    fixture = os.path.join(FIXTURES, "bad_registry.py")
+    bl = tmp_path / "bl.json"
+    assert lint_main([fixture, "--baseline", str(bl),
+                      "--write-baseline"]) == 0
+    assert len(json.load(open(bl))["suppressions"]) == 5
+    assert lint_main([fixture, "--baseline", str(bl), "--strict"]) == 0
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    mods, errs = core.scan_paths([str(p)])
+    assert not mods and len(errs) == 1 and errs[0].pass_id == "parse"
+
+
+# -- the tier-1 gate: the live repo is clean under --strict --------------
+
+def test_repo_strict_clean():
+    """`mv2tlint --strict` over the package: no new findings, no stale
+    baseline entries. THE ratchet — a regression in any of the five
+    invariant families fails tier-1 here."""
+    assert lint_main(["--strict"]) == 0
+
+
+def test_bin_entrypoint_ci_invocation():
+    """The CI-style command line from the issue, through bin/mv2tlint."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "mv2tlint"),
+         "--baseline", "analysis/baseline.json", "--strict"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "0 finding(s)" in r.stdout
+
+
+def test_list_passes():
+    assert lint_main(["--list-passes"]) == 0

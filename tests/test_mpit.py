@@ -129,6 +129,28 @@ def test_channel_and_protocol_pvars_in_categories():
     assert "pt2pt_rndv_sent" in ptinfo["pvars"]
 
 
+def test_analysis_category_knobs():
+    """The mv2t-analyze knobs enumerate under the 'analysis' category:
+    the MV2T_LOCKCHECK cvar plus the checker/monitor pvars (satellite of
+    the mv2tlint PR) — and lint_findings_baseline is a sourced LEVEL
+    pvar tracking the committed suppression count."""
+    cats = mpit.category_names()
+    assert "analysis" in cats
+    info = mpit.category_get_info(cats.index("analysis"))
+    assert "LOCKCHECK" in info["cvars"]
+    for pv in ("lint_findings_baseline", "lockcheck_cycles",
+               "lockcheck_edges"):
+        assert pv in info["pvars"]
+    pv = mpit._pvars.get("lint_findings_baseline")
+    assert pv.klass == mpit.PVAR_CLASS_LEVEL
+    from mvapich2_tpu.analysis.core import load_baseline
+    assert pv.read() == float(len(load_baseline().entries))
+    assert mpit.pvar_get_info(
+        mpit.pvar_get_index("lint_findings_baseline"))["continuous"]
+    for pv_name in ("lockcheck_cycles", "lockcheck_edges"):
+        assert mpit._pvars.get(pv_name).klass == mpit.PVAR_CLASS_COUNTER
+
+
 def test_sourced_pvar_rebound_across_restart():
     """MPI_T session vs a universe restart: a sourced pvar's callable is
     rebound on re-declare (fresh universe), so a session created after
