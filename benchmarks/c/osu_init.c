@@ -1,0 +1,31 @@
+/* osu_init.c — MPI_Init wall time per rank (startup cost), the
+ * osu_benchmarks/mpi/startup/osu_init.c shape. Used by bin/bench_osu's
+ * init budget check. */
+#include <mpi.h>
+#include <stdio.h>
+#include <time.h>
+
+static double now(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (double)ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+int main(int argc, char **argv) {
+    double t0 = now();
+    MPI_Init(&argc, &argv);
+    double my_ms = (now() - t0) * 1e3;
+    int rank;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    double avg = 0.0, mn = 0.0, mx = 0.0;
+    int np;
+    MPI_Comm_size(MPI_COMM_WORLD, &np);
+    MPI_Reduce(&my_ms, &avg, 1, MPI_DOUBLE, MPI_SUM, 0, MPI_COMM_WORLD);
+    MPI_Reduce(&my_ms, &mn, 1, MPI_DOUBLE, MPI_MIN, 0, MPI_COMM_WORLD);
+    MPI_Reduce(&my_ms, &mx, 1, MPI_DOUBLE, MPI_MAX, 0, MPI_COMM_WORLD);
+    if (rank == 0)
+        printf("nprocs: %d, min: %.0f ms, max: %.0f ms, avg: %.1f ms\n",
+               np, mn, mx, avg / np);
+    MPI_Finalize();
+    return 0;
+}

@@ -1,0 +1,61 @@
+/* osu_latency.c — ping-pong latency, OSU measurement protocol
+ * (skip + timed iterations per size, half round-trip reported).
+ * Fallback source for bin/bench_osu when the reference osu_benchmarks
+ * tree is not present on the host; the measurement loop matches
+ * osu_benchmarks/mpi/pt2pt/osu_latency.c so numbers are comparable. */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define MAX_ALIGN 4096
+
+static int iters_for(long size) { return size > 8192 ? 200 : 1000; }
+static int skip_for(long size) { return size > 8192 ? 10 : 100; }
+
+int main(int argc, char **argv) {
+    long max_size = 1 << 20;
+    if (argc > 2 && strcmp(argv[1], "-m") == 0)
+        max_size = atol(argv[2]);
+    MPI_Init(&argc, &argv);
+    int rank, np;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &np);
+    if (np != 2) {
+        if (rank == 0)
+            fprintf(stderr, "osu_latency requires exactly 2 ranks\n");
+        MPI_Finalize();
+        return 1;
+    }
+    char *sbuf = calloc(1, max_size ? max_size : 1);
+    char *rbuf = calloc(1, max_size ? max_size : 1);
+    if (rank == 0)
+        printf("# OSU MPI Latency Test\n# Size          Latency (us)\n");
+    for (long size = 0; size <= max_size; size = size ? size * 2 : 1) {
+        int iters = iters_for(size), skip = skip_for(size);
+        MPI_Barrier(MPI_COMM_WORLD);
+        double t0 = 0.0;
+        if (rank == 0) {
+            for (int i = 0; i < iters + skip; i++) {
+                if (i == skip)
+                    t0 = MPI_Wtime();
+                MPI_Send(sbuf, size, MPI_CHAR, 1, 1, MPI_COMM_WORLD);
+                MPI_Recv(rbuf, size, MPI_CHAR, 1, 1, MPI_COMM_WORLD,
+                         MPI_STATUS_IGNORE);
+            }
+            double lat = (MPI_Wtime() - t0) * 1e6 / iters / 2;
+            printf("%-10ld%18.2f\n", size, lat);
+            fflush(stdout);
+        } else {
+            for (int i = 0; i < iters + skip; i++) {
+                MPI_Recv(rbuf, size, MPI_CHAR, 0, 1, MPI_COMM_WORLD,
+                         MPI_STATUS_IGNORE);
+                MPI_Send(sbuf, size, MPI_CHAR, 0, 1, MPI_COMM_WORLD);
+            }
+        }
+    }
+    free(sbuf);
+    free(rbuf);
+    MPI_Finalize();
+    return 0;
+}

@@ -52,6 +52,15 @@ SEED_GUARDS: Dict[Tuple[str, Optional[str]], Dict[str, Set[str]]] = {
         "_outstanding": {"_lock"},
         "_in_use": {"_lock"},
     },
+    # The flat-slot collective tier's shared state (cplane.cpp
+    # cp_flat_* slots) is seqlock'd in C, out of this pass's reach; its
+    # python mirror (coll/flatcoll.py _FlatComm, comm._flat_state) is
+    # CONFINED to the collective call path — MPI semantics already
+    # forbid concurrent collectives on one comm, so there is no lock to
+    # register. What IS registrable: the flat-wait progress callback
+    # (transport/shm.py _flat_progress) runs inside the C wait loop and
+    # carries a "# mv2tlint: handler" annotation so the blocking pass
+    # forbids sleeps/unbounded acquires there.
 }
 
 _EXEMPT_METHODS = {"__init__", "__new__", "__init_subclass__"}

@@ -93,6 +93,35 @@ def _plane_thr(pch) -> int:
     return min(thr, cap) if cap else thr
 
 
+def _plane_coll_max(pch, comm) -> int:
+    """Largest payload the plane collective tier carries for ``comm``.
+
+    A comm with any C-ABI member MUST use the C fast path's fpc_enter
+    cap (FP_COLL_MAX, CMA-conditioned) on every member — a mixed
+    C/python job deadlocks if two members pick different algorithm
+    tiers for one collective, and a C-ABI process always dispatches
+    through fastpath.c first. A pure python comm keeps the eager size:
+    above it the tuning tier (arena/slotted) beats the interpreter-hop
+    schedules. Deterministic in static membership, so every member —
+    including the C processes' own python-side fallback dispatch —
+    reaches the same verdict."""
+    from ..utils.config import get_config
+    thr = _plane_thr(pch)
+    if not pch.cma_ok:
+        return thr              # rendezvous hops need the CMA agreement
+    mixed = comm.__dict__.get("_plane_mixed")
+    if mixed is None:
+        cabi = pch.cabi_ranks
+        mixed = bool(cabi) and any(
+            comm.group.world_of_rank(r) in cabi
+            for r in range(comm.size))
+        comm._plane_mixed = mixed
+    if not mixed:
+        return thr
+    cap = int(get_config()["FP_COLL_MAX"])
+    return cap if cap > thr else thr
+
+
 def _plane_coll_tag(pch, comm) -> int:
     return pch._ring.lib.cp_coll_tag(pch.plane, comm.ctx_coll)
 
@@ -121,6 +150,9 @@ def barrier(comm) -> None:
     pch = _plane_engine(comm)
     if pch is not None:
         if comm.size > 1:
+            from . import flatcoll
+            if flatcoll.try_barrier(pch, comm):
+                return
             alg.barrier_dissemination(comm, _plane_coll_tag(pch, comm))
         return
     tag = comm.next_coll_tag()
@@ -133,20 +165,26 @@ def bcast(comm, buf, count: int, datatype: Optional[Datatype],
     mpi_assert(0 <= root < comm.size, MPI_ERR_ROOT, f"bad root {root}")
     datatype = _dt(buf, datatype)
     nbytes = datatype.size * count
+    if comm.size == 1:
+        return
     pch = _plane_engine(comm)
-    if pch is not None and nbytes <= _plane_thr(pch):
+    data = datatype.pack(buf, count) if comm.rank == root \
+        else np.empty(nbytes, dtype=np.uint8)
+    data = np.ascontiguousarray(data)
+    if pch is not None and nbytes <= _plane_coll_max(pch, comm):
         # bcast mixes signature-equivalent datatypes legally, so the
         # delegation gate is the SIGNATURE bytes only — identical on
-        # every rank, identical to the C fast path's gate
+        # every rank, identical to the C fast path's gate. Flat-slot
+        # tier first (same gate order as fp_try_bcast).
+        from . import flatcoll
+        if flatcoll.try_bcast(pch, comm, data, root):
+            if comm.rank != root or not datatype.is_contiguous:
+                datatype.unpack(data, buf, count)
+            return
         fn, tag = alg.bcast_binomial, _plane_coll_tag(pch, comm)
     else:
         tag = comm.next_coll_tag()
         fn = _select(comm, "bcast", nbytes)
-    if comm.size == 1:
-        return
-    data = datatype.pack(buf, count) if comm.rank == root \
-        else np.empty(nbytes, dtype=np.uint8)
-    data = np.ascontiguousarray(data)
     fn(comm, data, root, tag)
     if comm.rank != root or not datatype.is_contiguous:
         datatype.unpack(data, buf, count)
@@ -159,9 +197,16 @@ def reduce(comm, sendbuf, recvbuf, count: int, datatype: Optional[Datatype],
     arr = _packed(src, count, datatype)
     pch = _plane_engine(comm)
     if pch is not None and datatype.basic is not None \
-            and arr.nbytes <= _plane_thr(pch) and _plane_red_ok(op, arr):
-        fn, tag = alg.reduce_binomial, _plane_coll_tag(pch, comm)
+            and arr.nbytes <= _plane_coll_max(pch, comm) and _plane_red_ok(op, arr):
         arr = np.ascontiguousarray(arr)
+        if comm.size > 1:
+            from . import flatcoll
+            taken, got = flatcoll.try_reduce(pch, comm, arr, op, root)
+            if taken:
+                if comm.rank == root:
+                    _unpack(got, recvbuf, count, datatype)
+                return
+        fn, tag = alg.reduce_binomial, _plane_coll_tag(pch, comm)
     else:
         tag = comm.next_coll_tag()
         fn = _select(comm, "reduce", arr.nbytes, op=op)
@@ -177,10 +222,16 @@ def allreduce(comm, sendbuf, recvbuf, count: int,
     arr = _packed_ro(src, count, datatype)
     pch = _plane_engine(comm)
     if pch is not None and datatype.basic is not None \
-            and arr.nbytes <= _plane_thr(pch) and _plane_red_ok(op, arr):
+            and arr.nbytes <= _plane_coll_max(pch, comm) and _plane_red_ok(op, arr):
+        arr = np.ascontiguousarray(arr)
+        if comm.size > 1:
+            from . import flatcoll
+            got = flatcoll.try_allreduce(pch, comm, arr, op)
+            if got is not None:
+                _unpack(got, recvbuf, count, datatype)
+                return
         fn, tag = alg.allreduce_recursive_doubling, \
             _plane_coll_tag(pch, comm)
-        arr = np.ascontiguousarray(arr)
     else:
         tag = comm.next_coll_tag()
         fn = _select(comm, "allreduce", arr.nbytes, op=op)

@@ -1,0 +1,253 @@
+"""Python entry to the flat-slot shared-memory collective tier.
+
+The small-message fast phase (cplane.cpp cp_flat_*): one cache-line-
+padded seqlock'd slot per comm rank in a per-(context, lane) region of
+the node's flat segment — fan-in to the leader (who reduces in place),
+seq-stamped broadcast out. Python ranks and C-ABI ranks (via
+native/mpi/fastpath.c) call the SAME cp_flat_* engine, so the schedule
+is identical across the two ABIs by construction; this module only
+implements the dispatch gate and per-comm call numbering.
+
+Dispatch DETERMINISM is the load-bearing property: every member of a
+comm — python-API or C-ABI — must reach the same flat-or-not verdict
+for each collective, from the call signature and static comm state
+alone. The gates here mirror fastpath.c's fpc_flat_next: plane-owned
+intra comm, size <= cp_flat_nslots, payload <= cp_flat_payload_max,
+(op, dtype) in the shared cp_flat_op_ok kernel table, region mappable
+for (ctx_coll, lane).
+
+Call numbering: seq = region base (broadcast seq at the comm's first
+flat collective) + number of flat collectives issued on the comm. In a
+C-ABI process both this module and the C dispatch can issue flat calls
+on one comm, so the counter is unified through libmpi.so's
+mv2t_fp_flat_next (reached via the process-global symbol table); pure
+python ranks keep the counter on the comm object.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from ..core import op as opmod
+from ..core.errors import (MPIException, MPI_ERR_INTERN, MPI_ERR_TRUNCATE,
+                           MPIX_ERR_PROC_FAILED)
+
+# numpy dtype -> cplane kernel dtype code (the fl_reduce switch). Keyed
+# by (kind, itemsize) so aliases (AINT/LONG/LONG_LONG...) collapse to
+# one kernel the way the C table does.
+_DT_CODES = {
+    ("u", 1): 0, ("i", 1): 1, ("i", 4): 2, ("f", 4): 3, ("f", 8): 4,
+    ("i", 8): 5, ("u", 8): 6, ("i", 2): 7, ("u", 4): 10, ("u", 2): 11,
+    ("f", 16): 12, ("b", 1): 13,
+}
+
+_OP_CODES = {
+    opmod.SUM: 0, opmod.PROD: 1, opmod.MAX: 2, opmod.MIN: 3,
+    opmod.LAND: 4, opmod.LOR: 5, opmod.BAND: 6, opmod.BOR: 7,
+    opmod.BXOR: 8, opmod.LXOR: 9,
+}
+
+_c_next = None          # (mv2t_fp_flat_next, mv2t_fp_flat_poison) or False
+
+
+def _libmpi_hooks():
+    """The embedded C ABI's flat-counter hooks, when this process IS a
+    C MPI program (libmpi.so in the global symbol table)."""
+    global _c_next
+    if _c_next is None:
+        try:
+            dl = ctypes.CDLL(None)
+            nxt = dl.mv2t_fp_flat_next
+            nxt.restype = ctypes.c_longlong
+            nxt.argtypes = [ctypes.c_int, ctypes.c_long]
+            poi = dl.mv2t_fp_flat_poison
+            poi.argtypes = [ctypes.c_int]
+            _c_next = (nxt, poi)
+        except (OSError, AttributeError):
+            _c_next = False
+    return _c_next
+
+
+def _dt_code(dtype: np.dtype) -> int:
+    return _DT_CODES.get((dtype.kind, dtype.itemsize), -1)
+
+
+class _FlatComm:
+    """Per-comm flat-tier state (cached on the comm object)."""
+
+    __slots__ = ("lib", "plane", "ctx", "lane", "rank", "size", "base",
+                 "k", "cabi", "max_nb")
+
+    def __init__(self, lib, plane, ctx, lane, rank, size, base, cabi,
+                 max_nb):
+        self.lib = lib
+        self.plane = plane
+        self.ctx = ctx
+        self.lane = lane
+        self.rank = rank
+        self.size = size
+        self.base = base
+        self.k = 0
+        self.cabi = cabi        # C comm handle when libmpi owns numbering
+        self.max_nb = max_nb
+
+    def next_seq(self, nb: int) -> int:
+        if self.cabi is not None:
+            hooks = _libmpi_hooks()
+            if hooks:
+                return int(hooks[0](self.cabi, nb))
+        self.k += 1
+        return self.base + self.k
+
+    def poison(self, comm) -> None:
+        comm._flat_state = False
+        if self.cabi is not None:
+            hooks = _libmpi_hooks()
+            if hooks:
+                hooks[1](self.cabi)
+
+
+def _state(comm, pch) -> Optional[_FlatComm]:
+    """The comm's flat-tier state, or None when the tier is off for it
+    (cached; the verdict is deterministic in static comm state)."""
+    st = comm.__dict__.get("_flat_state")
+    if st is not None:
+        return st if st is not False else None
+    st = _build_state(comm, pch)
+    comm._flat_state = st if st is not None else False
+    return st
+
+
+def _build_state(comm, pch) -> Optional[_FlatComm]:
+    lib = pch._ring.lib
+    if lib is None or not pch.plane:
+        return None
+    if comm.size < 2 or comm.size > lib.cp_flat_nslots():
+        return None
+    if not lib.cp_flat_ok(pch.plane):
+        return None
+    lane = None
+    for r in range(comm.size):
+        i = pch.local_index.get(comm.group.world_of_rank(r))
+        if i is None:
+            return None
+        lane = i if lane is None or i < lane else lane
+    if lane >= lib.cp_flat_lanes():
+        return None
+    base = int(lib.cp_flat_base(pch.plane, comm.ctx_coll, lane))
+    if base < 0:
+        return None
+    cabi = getattr(comm, "_cabi_handle", None)
+    if cabi is not None and not _libmpi_hooks():
+        cabi = None
+    return _FlatComm(lib, pch.plane, comm.ctx_coll, lane, comm.rank,
+                     comm.size, base, cabi,
+                     int(lib.cp_flat_payload_max()))
+
+
+def _raise_rc(st, comm, rc):
+    st.poison(comm)
+    if rc == -2:
+        raise MPIException(MPIX_ERR_PROC_FAILED,
+                           "peer failure during flat collective")
+    raise MPIException(MPI_ERR_INTERN,
+                       f"flat collective failed (rc {rc})")
+
+
+def _ptr(a: np.ndarray) -> int:
+    return a.ctypes.data if a.size else 0
+
+
+def try_allreduce(pch, comm, arr: np.ndarray, op) -> Optional[np.ndarray]:
+    """Run ``arr``'s allreduce on the flat tier; the reduced array, or
+    None when the tier does not carry this call (caller falls through
+    to the scheduled algorithms)."""
+    opc = _OP_CODES.get(op)
+    dtc = _dt_code(arr.dtype)
+    if opc is None or dtc < 0:
+        return None
+    st = _state(comm, pch)
+    if st is None or arr.nbytes > st.max_nb:
+        return None
+    if not st.lib.cp_flat_op_ok(opc, dtc):
+        return None
+    seq = st.next_seq(arr.nbytes)
+    if seq <= 0:
+        comm._flat_state = False    # C side closed the tier: stay off
+        return None
+    out = np.empty_like(arr)
+    rc = st.lib.cp_flat_allreduce(
+        st.plane, st.ctx, st.lane, st.rank, st.size,
+        ctypes.c_longlong(seq), opc, dtc, _ptr(arr), _ptr(out),
+        arr.size, arr.itemsize)
+    if rc != 0:
+        _raise_rc(st, comm, rc)
+    return out
+
+
+def try_reduce(pch, comm, arr: np.ndarray, op,
+               root: int) -> "tuple[bool, Optional[np.ndarray]]":
+    """(taken, result-at-root) — result is None on non-root ranks."""
+    opc = _OP_CODES.get(op)
+    dtc = _dt_code(arr.dtype)
+    if opc is None or dtc < 0:
+        return False, None
+    st = _state(comm, pch)
+    if st is None or arr.nbytes > st.max_nb:
+        return False, None
+    if not st.lib.cp_flat_op_ok(opc, dtc):
+        return False, None
+    seq = st.next_seq(arr.nbytes)
+    if seq <= 0:
+        comm._flat_state = False
+        return False, None
+    out = np.empty_like(arr) if comm.rank == root else None
+    rc = st.lib.cp_flat_reduce(
+        st.plane, st.ctx, st.lane, st.rank, st.size,
+        ctypes.c_longlong(seq), opc, dtc, root, _ptr(arr),
+        _ptr(out) if out is not None else 0, arr.size, arr.itemsize)
+    if rc != 0:
+        _raise_rc(st, comm, rc)
+    return True, out
+
+
+def try_bcast(pch, comm, data: np.ndarray, root: int) -> bool:
+    """Broadcast ``data`` (packed bytes, filled in place on non-roots)
+    on the flat tier; False when the tier does not carry this call."""
+    st = _state(comm, pch)
+    if st is None or data.nbytes > st.max_nb:
+        return False
+    seq = st.next_seq(data.nbytes)
+    if seq <= 0:
+        comm._flat_state = False
+        return False
+    rc = st.lib.cp_flat_bcast(
+        st.plane, st.ctx, st.lane, st.rank, st.size,
+        ctypes.c_longlong(seq), root, _ptr(data), data.nbytes)
+    if rc == -4:
+        # root sent a different byte count — the wave completed, the
+        # mismatch is reported (errors/coll/bcastlength.c), the tier
+        # stays healthy
+        raise MPIException(MPI_ERR_TRUNCATE,
+                           "bcast length mismatch across ranks")
+    if rc != 0:
+        _raise_rc(st, comm, rc)
+    return True
+
+
+def try_barrier(pch, comm) -> bool:
+    st = _state(comm, pch)
+    if st is None:
+        return False
+    seq = st.next_seq(0)
+    if seq <= 0:
+        comm._flat_state = False
+        return False
+    rc = st.lib.cp_flat_barrier(st.plane, st.ctx, st.lane, st.rank,
+                                st.size, ctypes.c_longlong(seq))
+    if rc != 0:
+        _raise_rc(st, comm, rc)
+    return True

@@ -91,10 +91,15 @@ DEFAULT_TABLES: Dict[str, Dict[str, Table]] = {
     # large-message tier: the arena/CMA sectioned exchange (zero packet
     # handshakes on a single node; reduce-scatter+allgather shape), with
     # graceful internal fallback to two-level/ring when it cannot run.
+    # symbolic bin edges ("eager" = SMP_EAGERSIZE, "coll_max" =
+    # FP_COLL_MAX) resolve against the live cvars at selection time, so
+    # the table's tier switches stay aligned with the protocol
+    # thresholds the plane tier gates on — a drifting constant here is
+    # exactly how the r5 64 KiB allreduce cliff happened
     "allreduce": {
-        "small": [(16 * 1024, "rd"), (32 * 1024, "ring"),
+        "small": [(16 * 1024, "rd"), ("eager", "ring"),
                   (None, "rsa_arena")],
-        "large": [(8 * 1024, "rd"), (64 * 1024, "rsa"),
+        "large": [(8 * 1024, "rd"), ("eager", "rsa"),
                   (None, "rsa_arena")],
     },
     "bcast": {
@@ -177,6 +182,18 @@ def _size_class(comm) -> str:
     return "small" if comm.size <= 8 else "large"
 
 
+def _resolve_edge(bound):
+    """A table bin edge: an int, None (infinity), or a symbolic name
+    tracking the protocol cvars ("eager" = SMP_EAGERSIZE, "coll_max" =
+    FP_COLL_MAX) so tier switches cannot drift from the thresholds the
+    plane tier gates on."""
+    if bound == "eager":
+        return int(get_config()["SMP_EAGERSIZE"])
+    if bound == "coll_max":
+        return int(get_config()["FP_COLL_MAX"])
+    return bound
+
+
 def _lookup(name: str, comm, nbytes: int) -> str:
     cls = _size_class(comm)
     tables = _PROFILE_TABLES.get(name) or DEFAULT_TABLES.get(name)
@@ -188,6 +205,7 @@ def _lookup(name: str, comm, nbytes: int) -> str:
         tables = DEFAULT_TABLES[name]
     rows = tables[cls]
     for bound, algo in rows:
+        bound = _resolve_edge(bound)
         if bound is None or nbytes <= bound:
             return algo
     return rows[-1][1]

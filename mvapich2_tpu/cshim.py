@@ -139,16 +139,21 @@ def _group(h: int):
 
 def _comm(h: int):
     if h == 0:
-        return uni.current_universe().comm_world
-    if h == 1:
-        return uni.current_universe().comm_self
-    got = _comms.get(h)
-    if got is None:
+        c = uni.current_universe().comm_world
+    elif h == 1:
+        c = uni.current_universe().comm_self
+    else:
+        c = _comms.get(h)
+    if c is None:
         # freed or never-allocated handle: a reportable MPI error, not
         # a KeyError crash (errors/comm/cfree.c barriers a freed dup)
         from .core.errors import MPI_ERR_COMM
         raise MPIException(MPI_ERR_COMM, f"invalid communicator {h}")
-    return got
+    if c.__dict__.get("_cabi_handle") is None:
+        # the C handle, for layers that must share per-comm state with
+        # the C fast path (coll/flatcoll.py call numbering)
+        c._cabi_handle = h
+    return c
 
 
 def _arr(view, count: int, dtcode: int) -> np.ndarray:
@@ -356,7 +361,19 @@ def _red_view(view, count: int, dtcode: int):
 # init / world
 # ---------------------------------------------------------------------------
 
+# True once this process entered MPI through the C ABI (libmpi.so ->
+# init() below). Python-side dispatch must then assume the C fast path
+# co-dispatches on every comm (coll/api.py _plane_coll_max).
+_cabi_process = False
+
+
+def is_cabi_process() -> bool:
+    return _cabi_process
+
+
 def init() -> int:
+    global _cabi_process
+    _cabi_process = True
     # debugging aid (MV2_DEBUG-style): SIGUSR1 dumps all Python thread
     # stacks of a rank — how a hung conformance run is diagnosed
     try:
@@ -516,6 +533,14 @@ def plane_eager_threshold() -> int:
     if pch is not None and pch.plane_eager_max():
         t = min(t, pch.plane_eager_max())
     return t
+
+
+def plane_coll_max() -> int:
+    """FP_COLL_MAX for the C fast path's collective gate (fpc_enter) —
+    the same source of truth as coll/api.py's plane-tier gate, so every
+    rank of a mixed C/python job reaches the identical dispatch."""
+    from .utils.config import get_config
+    return int(get_config()["FP_COLL_MAX"])
 
 
 def plane_congest_min() -> int:

@@ -70,6 +70,29 @@ _PV_PLANE_DECLS = [
 for _n, _d in _PV_PLANE_DECLS:
     _mpit.pvar(_n, _mpit.PVAR_CLASS_COUNTER, "shm", _d)
 
+# Fast-path observability (native/mpi/fastpath.c + the flat collective
+# tier in cplane.cpp). Index order mirrors cplane.cpp's FPC_* enum; the
+# counters live in the plane (cp_fp_counters) so both the C ABI's
+# fastpath and python-rank flat collectives feed the same slots.
+_FP_COUNTERS = [
+    ("fp_hits", "pt2pt operations completed on the C fast path"),
+    ("fp_gil_takes",
+     "python progress passes taken from the C fast path's hot loop"),
+    ("fp_fallback_dtype", "fast-path fallbacks: datatype not carryable"),
+    ("fp_fallback_comm", "fast-path fallbacks: comm not plane-owned"),
+    ("fp_fallback_size", "fast-path fallbacks: payload above fp_threshold"),
+    ("fp_fallback_plane", "fast-path fallbacks: plane missing or failed"),
+    ("fp_coll_flat", "collectives completed on the flat-slot shm tier"),
+    ("fp_coll_sched", "collectives completed on the C pt2pt schedules"),
+    ("fp_wait_spin", "fast-path blocking waits satisfied during the spin"),
+    ("fp_wait_bell",
+     "fast-path blocking waits satisfied after the doorbell sleep"),
+    ("fp_flat_progress",
+     "python progress callbacks fired from flat-collective waits"),
+]
+for _n, _d in _FP_COUNTERS:
+    _mpit.pvar(_n, _mpit.PVAR_CLASS_COUNTER, "fastpath", _d)
+
 _HEADER = 128
 _WRAP = 0xFFFFFFFF
 _ALIGN = 8
@@ -209,6 +232,32 @@ def _bind_cplane(lib) -> None:
     lib.cp_congested.argtypes = [L.c_void_p, L.c_int]
     lib.cp_rndv_stats.argtypes = [L.c_void_p, L.POINTER(L.c_ulonglong),
                                   L.POINTER(L.c_ulonglong)]
+    # flat-slot collective tier + fast-path counters
+    lib.cp_flat_attach.argtypes = [L.c_void_p, L.c_char_p, L.c_int]
+    lib.cp_flat_ok.argtypes = [L.c_void_p]
+    lib.cp_flat_disable.argtypes = [L.c_void_p]
+    lib.cp_flat_base.restype = L.c_longlong
+    lib.cp_flat_base.argtypes = [L.c_void_p, L.c_int, L.c_int]
+    lib.cp_flat_op_ok.argtypes = [L.c_int, L.c_int]
+    lib.cp_flat_payload_max.restype = L.c_long
+    lib.cp_flat_nslots.restype = L.c_int
+    lib.cp_flat_lanes.restype = L.c_int
+    lib.cp_flat_allreduce.argtypes = [
+        L.c_void_p, L.c_int, L.c_int, L.c_int, L.c_int, L.c_longlong,
+        L.c_int, L.c_int, L.c_void_p, L.c_void_p, L.c_longlong,
+        L.c_longlong]
+    lib.cp_flat_reduce.argtypes = [
+        L.c_void_p, L.c_int, L.c_int, L.c_int, L.c_int, L.c_longlong,
+        L.c_int, L.c_int, L.c_int, L.c_void_p, L.c_void_p, L.c_longlong,
+        L.c_longlong]
+    lib.cp_flat_bcast.argtypes = [L.c_void_p, L.c_int, L.c_int, L.c_int,
+                                  L.c_int, L.c_longlong, L.c_int,
+                                  L.c_void_p, L.c_longlong]
+    lib.cp_flat_barrier.argtypes = [L.c_void_p, L.c_int, L.c_int, L.c_int,
+                                    L.c_int, L.c_longlong]
+    lib.cp_flat_set_progress_cb.argtypes = [L.c_void_p, L.c_void_p]
+    lib.cp_fp_counter.restype = L.c_ulonglong
+    lib.cp_fp_counter.argtypes = [L.c_void_p, L.c_int]
 
 
 class _PyRing:
@@ -471,6 +520,9 @@ class ShmChannel(Channel):
         self._plane_cancels: Dict[int, object] = {} # sreq id -> SendRequest
         self.plane_client = None                    # Pt2ptProtocol hook
         self._ring_cap = 0
+        self._flat_path = f"{path}.fcoll"
+        self._flat_cb = None           # keepalive for the ctypes callback
+        self.cabi_ranks = set()        # local ranks that are C-ABI procs
         if self.using_native and get_config()["USE_CPLANE"]:
             lib = self._ring.lib
             self.plane = lib.cp_create(self._ring.h, self.local_index[my_rank],
@@ -478,6 +530,13 @@ class ShmChannel(Channel):
             self._ring_cap = lib.sr_capacity(self._ring.h)
             if self.plane:
                 lib.cp_set_wait_fd(self.plane, self._bell.fileno())
+                if self._owner:
+                    # flat-slot collective segment (cp_flat_*): sparse
+                    # per-context regions; created by the leader BEFORE
+                    # the business-card fence so followers can attach in
+                    # finish_wiring without racing the creation
+                    lib.cp_flat_attach(self.plane,
+                                       self._flat_path.encode(), 1)
 
     def plane_eager_max(self) -> int:
         """Largest eager payload the plane can carry: an eager blob is a
@@ -486,6 +545,13 @@ class ShmChannel(Channel):
         truth for the clamp applied by both the python protocol layer
         and the C fast path's cached threshold."""
         return self._ring_cap - 128 if self._ring_cap else 0
+
+    def fp_counter(self, idx: int) -> int:
+        """One fast-path counter slot from the plane (index order =
+        cplane.cpp FPC_* = _FP_COUNTERS)."""
+        if not self.plane:
+            return 0
+        return int(self._ring.lib.cp_fp_counter(self.plane, idx))
 
     def plane_stats(self):
         """(eager_tx, eager_rx, fwd_py, rndv_tx, rndv_rx) from the C
@@ -556,10 +622,30 @@ class ShmChannel(Channel):
         # receive handles it cannot dereference.
         my_ok = bool(get_config()["USE_CMA"]) and self._probe_cma()
         my_arena = self.arena is not None
+        # flat-slot collective segment: followers attach now (the leader
+        # created the file before the fence); usability is unanimous —
+        # one rank that cannot map the segment would hang the node's
+        # flat waves, so everyone must agree to use it (or nobody does)
+        my_flat = False
+        if self.plane:
+            lib = self._ring.lib
+            if not self._owner:
+                lib.cp_flat_attach(self.plane, self._flat_path.encode(), 0)
+            my_flat = bool(lib.cp_flat_ok(self.plane))
+        # C-ABI membership table: a comm with any C-ABI rank must use
+        # the C fast path's collective-tier cap (FP_COLL_MAX) on every
+        # member — coll/api.py._plane_coll_max reads this set. A pure
+        # python comm keeps the tuning tier above the eager size (the
+        # interpreter-hop schedules lose to the arena tier there).
+        from .. import cshim as _cshim
+        my_cabi = _cshim.is_cabi_process()
         self.kvs.put(f"shm-cma-ok-{self.my_rank}", "1" if my_ok else "0")
         self.kvs.put(f"shm-arena-ok-{self.my_rank}",
                      "1" if my_arena else "0")
-        all_ok, all_arena = my_ok, my_arena
+        self.kvs.put(f"shm-flat-ok-{self.my_rank}", "1" if my_flat else "0")
+        self.kvs.put(f"shm-cabi-{self.my_rank}", "1" if my_cabi else "0")
+        all_ok, all_arena, all_flat = my_ok, my_arena, my_flat
+        self.cabi_ranks = {self.my_rank} if my_cabi else set()
         for r in self.local_ranks:
             if r == self.my_rank:
                 continue
@@ -568,8 +654,15 @@ class ShmChannel(Channel):
                     self.kvs.get(f"shm-cma-ok-{r}") == "1"
                 all_arena = all_arena and \
                     self.kvs.get(f"shm-arena-ok-{r}") == "1"
+                all_flat = all_flat and \
+                    self.kvs.get(f"shm-flat-ok-{r}") == "1"
+                if self.kvs.get(f"shm-cabi-{r}") != "0":
+                    # unknown counts as C-ABI: the conservative verdict
+                    # is the shared FP_COLL_MAX cap
+                    self.cabi_ranks.add(r)
             except Exception:
-                all_ok = all_arena = False
+                all_ok = all_arena = all_flat = False
+                self.cabi_ranks.add(r)
         self.cma_ok = all_ok
         if not all_arena and self.arena is not None:
             self.arena.close(unlink=self._owner)
@@ -578,6 +671,27 @@ class ShmChannel(Channel):
         if not self.plane:
             return
         lib = self._ring.lib
+        if not all_flat and my_flat:
+            lib.cp_flat_disable(self.plane)
+        if all_flat:
+            # python-rank progress hook for flat-collective waits: a
+            # rank parked in a flat wave still runs forwarded python
+            # work (rendezvous assists) so peers cannot deadlock.
+            # Runs INSIDE cp_flat_* wait loops, so it must never block
+            # (a sleep here stalls the whole node's wave).
+            import ctypes as _ct
+
+            def _flat_progress():  # mv2tlint: handler
+                from ..runtime import universe as uni
+                try:
+                    u = uni.current_universe()
+                    if u is not None:
+                        u.engine.progress_poke()
+                except Exception:
+                    pass
+            self._flat_cb = _ct.CFUNCTYPE(None)(_flat_progress)
+            lib.cp_flat_set_progress_cb(
+                self.plane, _ct.cast(self._flat_cb, _ct.c_void_p))
         for r in self.local_ranks:
             lib.cp_set_world(self.plane, self.local_index[r], r)
             if r == self.my_rank:
@@ -600,6 +714,12 @@ class ShmChannel(Channel):
             base = pv._value
             pv.source = (lambda i=idx, b=base:
                          b + float(self.plane_stats()[i]))
+        for idx, (name, desc) in enumerate(_FP_COUNTERS):
+            pv = _mpit.pvar(name, _mpit.PVAR_CLASS_COUNTER, "fastpath",
+                            desc)
+            base = pv._value
+            pv.source = (lambda i=idx, b=base:
+                         b + float(self.fp_counter(i)))
 
     def _make_ring(self, path: str, ring_bytes: int, create: bool):
         lib = _load_native()
@@ -933,6 +1053,10 @@ class ShmChannel(Channel):
                     pv = _mpit.pvar(name)
                     pv.source = None
                     pv._value += float(v)   # _value held the prior total
+                for i, (name, _) in enumerate(_FP_COUNTERS):
+                    pv = _mpit.pvar(name)
+                    pv.source = None
+                    pv._value += float(self.fp_counter(i))
             except Exception:
                 pass
             try:
@@ -968,7 +1092,7 @@ class ShmChannel(Channel):
         except Exception:
             pass
         if self._owner:
-            for path in (self.path, self._flags_path):
+            for path in (self.path, self._flags_path, self._flat_path):
                 try:
                     os.unlink(path)
                 except OSError:

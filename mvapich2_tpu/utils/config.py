@@ -173,6 +173,18 @@ cvar("SMP_EAGERSIZE", 32 * 1024, int, "pt2pt",
      "Default measured on the 1-core bench host (see "
      "profiles/pt2pt_crossover.json): eager wins while a 64-deep window "
      "fits the shm ring; the CMA rendezvous wins beyond.")
+cvar("FP_COLL_MAX", 256 * 1024, int, "coll",
+     "Largest payload the plane-native collective tier carries (flat "
+     "slots below cp_flat_payload_max, pt2pt schedules with eager-or-"
+     "rendezvous hops above). Must agree on every rank of a job: the "
+     "C fast path (fastpath.c fpc_enter) and the python dispatch "
+     "(coll/api.py) both gate on it, and a rank that schedules while "
+     "its peer takes the tuning tier deadlocks. Above it the tuning "
+     "table (coll/tuning.py) selects the arena/slotted algorithms. "
+     "Default = the measured sched/arena crossover on the 1-core "
+     "bench host (np4 allreduce: 256 KiB rides the C schedule at "
+     "~940 us vs ~1550 through the arena tier; at 512 KiB the arena's "
+     "~1.1 ms fixed interpreter cost is amortized and it wins).")
 cvar("RNDV_PROTOCOL", "RGET", str, "pt2pt",
      "Rendezvous protocol: RGET (receiver pulls), RPUT (sender pushes), "
      "R3 (packetized through channel). Default mirrors ibv_param.c:116.",

@@ -268,6 +268,35 @@ struct CPlane {
   // stats
   uint64_t n_eager_tx, n_eager_rx, n_fwd_py;
   uint64_t n_rndv_tx, n_rndv_rx;
+  // flat-slot collective segment (cp_flat_*): one mmap'd file per node
+  // of per-context regions — fan-in/fan-out slots for small collectives
+  uint8_t* flat;                 // guarded-by: single-writer-per-slot seqs
+  size_t flat_len;
+  // fast-path observability counters (indices FPC_* below); written by
+  // fastpath.c through cp_fp_counters() and by cp_flat_*, read by the
+  // python mpit layer. Plain u64 slots: every slot has one natural
+  // writer thread and counters tolerate benign races.
+  uint64_t fpctr[16];
+  // python-progress callback for flat waits: invoked (rarely) when
+  // forwarded python work is pending while a rank is parked in a flat
+  // collective, so rendezvous assists cannot deadlock behind it
+  void (*progress_cb)(void);
+};
+
+// fast-path counter indices (mirrored in native/mpi/fastpath.c and
+// mvapich2_tpu/transport/shm.py _FP_COUNTERS — keep all three in sync)
+enum {
+  FPC_HITS = 0,          // pt2pt ops completed on the C fast path
+  FPC_GIL_TAKES = 1,     // python progress runs taken from the hot loop
+  FPC_FB_DTYPE = 2,      // fallbacks: datatype not carryable
+  FPC_FB_COMM = 3,       // fallbacks: comm not plane-owned
+  FPC_FB_SIZE = 4,       // fallbacks: payload above fp_threshold
+  FPC_FB_PLANE = 5,      // fallbacks: plane missing/failed
+  FPC_COLL_FLAT = 6,     // collectives completed on the flat-slot tier
+  FPC_COLL_SCHED = 7,    // collectives completed on the pt2pt schedules
+  FPC_WAIT_SPIN = 8,     // blocking waits satisfied during the spin
+  FPC_WAIT_BELL = 9,     // blocking waits satisfied after doorbell sleep
+  FPC_FLAT_PROGRESS = 10 // python progress callbacks from flat waits
 };
 
 inline uint64_t now_us() {
@@ -792,6 +821,7 @@ void cp_destroy(void* cp) {
   void* g = g_plane.load(std::memory_order_acquire);
   if (g == cp) g_plane.store(nullptr, std::memory_order_release);
   if (p->flags) munmap(p->flags, p->flags_len);
+  if (p->flat) munmap(p->flat, p->flat_len);
   if (p->bell_tx >= 0) close(p->bell_tx);
   for (int d = 0; d < p->n_local; d++) {
     Blob* b = p->backlog_head[d];
@@ -1543,9 +1573,449 @@ void cp_stats(void* cp, unsigned long long* tx, unsigned long long* rx,
   if (fwd) *fwd = p->n_fwd_py;
 }
 
+}  // extern "C" (reopened below — the flat tier's helpers are C++)
+
+// ---------------------------------------------------------------------------
+// flat-slot collective tier (cp_flat_*)
+//
+// The ch3_shmem_coll.c analog for SMALL payloads: one mmap'd per-node
+// file of per-collective-context REGIONS. A region holds one cache-
+// line-padded slot per comm rank (seqlock-style: payload store, release
+// fence, monotonic seq stamp) plus one broadcast block. An allreduce is
+// two counter waves: every rank publishes its contribution under its
+// slot's in_seq, the leader (comm rank 0) folds the slots in rank order
+// into the broadcast block and stamps bseq, everyone copies out and
+// stamps out_seq. No per-hop envelopes, no matching, no doorbells —
+// the fast iteration is two shared-memory stores and one wait.
+//
+// Regions are indexed by the comm's COLLECTIVE context id, so two live
+// comms can never share a region (context ids are unique among live
+// comms). On context reuse the region's counters carry over; a comm
+// reads the broadcast seq once (cp_flat_base) before its first flat
+// collective and numbers its calls from there — quiescence at reuse
+// time is guaranteed because a context id only returns to the pool
+// after every member freed the comm (a collective agreement that
+// happens-after each member's last collective on it).
+//
+// Both consumers — the C fast path (native/mpi/fastpath.c) and python
+// ranks (coll/flatcoll.py via ctypes) — call the SAME entry points, so
+// the schedule is identical across the two ABIs by construction.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr int FLAT_NSLOTS = 8;            // max comm size on this tier
+constexpr long FLAT_MAX = 4096;           // max payload bytes per slot
+constexpr long FLAT_SLOT_STRIDE = 64 + FLAT_MAX;   // hdr line + payload
+constexpr long FLAT_REG_HDR = 64;
+constexpr long FLAT_REG_STRIDE =
+    FLAT_REG_HDR + (FLAT_NSLOTS + 1) * FLAT_SLOT_STRIDE;
+// region index space: predefined contexts [0, 64) + the pooled
+// allocator's window [CTX_MASK_BASE, CTX_MASK_BASE + 4096)
+constexpr int FLAT_SMALL_CTXS = 64;
+constexpr int FLAT_MASK_CTXS = 4096;
+constexpr int32_t FLAT_CTX_MASK_BASE = 1 << 20;   // universe.CTX_MASK_BASE
+// lanes disambiguate DISJOINT comms sharing one context id (MPI_Comm_split
+// allocates a single id across all colors): a comm's lane is the minimum
+// plane ring index among its members — unique per sibling, deterministic
+// from static membership on every member
+constexpr int FLAT_LANES = 8;
+constexpr long FLAT_NREG = FLAT_SMALL_CTXS + FLAT_MASK_CTXS;
+constexpr long FLAT_FILE_LEN = FLAT_NREG * FLAT_LANES * FLAT_REG_STRIDE;
+constexpr uint64_t FLAT_TIMEOUT_US = 120u * 1000000u;
+
+// slot field accessors (in_seq @0, out_seq @8, payload @64; the bcast
+// block reuses the same stride with bseq in the in_seq word)
+inline volatile uint64_t* fl_in(uint8_t* s) {
+  return reinterpret_cast<volatile uint64_t*>(s);
+}
+inline volatile uint64_t* fl_out(uint8_t* s) {
+  return reinterpret_cast<volatile uint64_t*>(s + 8);
+}
+inline uint8_t* fl_pay(uint8_t* s) { return s + 64; }
+
+inline uint64_t fl_load(const volatile uint64_t* a) {
+  return __atomic_load_n(const_cast<const uint64_t*>(a),
+                         __ATOMIC_ACQUIRE);
+}
+inline void fl_store(volatile uint64_t* a, uint64_t v) {
+  __atomic_store_n(const_cast<uint64_t*>(a), v, __ATOMIC_RELEASE);
+}
+
+uint8_t* flat_region(CPlane* p, int ctx, int lane) {
+  if (!p->flat || lane < 0 || lane >= FLAT_LANES) return nullptr;
+  long idx;
+  if (ctx >= 0 && ctx < FLAT_SMALL_CTXS) {
+    idx = ctx;
+  } else if (ctx >= FLAT_CTX_MASK_BASE
+             && ctx < FLAT_CTX_MASK_BASE + FLAT_MASK_CTXS) {
+    idx = FLAT_SMALL_CTXS + (ctx - FLAT_CTX_MASK_BASE);
+  } else {
+    return nullptr;
+  }
+  return p->flat + (idx * FLAT_LANES + lane) * FLAT_REG_STRIDE;
+}
+
+inline uint8_t* flat_slot(uint8_t* reg, int r) {
+  return reg + FLAT_REG_HDR + r * FLAT_SLOT_STRIDE;
+}
+inline uint8_t* flat_bcb(uint8_t* reg) {
+  return reg + FLAT_REG_HDR + FLAT_NSLOTS * FLAT_SLOT_STRIDE;
+}
+
+// one reduction step inout[i] = inout[i] OP in[i] — the builtin-op
+// kernel table shared by every flat consumer (the fpc_reduce table of
+// fastpath.c, hosted here so python ranks get the identical fold)
+template <typename T>
+int fl_red_int(int op, void* inout, const void* in, long n) {
+  T* a = static_cast<T*>(inout);
+  const T* b = static_cast<const T*>(in);
+  switch (op) {
+    case 0: for (long i = 0; i < n; i++) a[i] = (T)(a[i] + b[i]); break;
+    case 1: for (long i = 0; i < n; i++) a[i] = (T)(a[i] * b[i]); break;
+    case 2: for (long i = 0; i < n; i++) if (b[i] > a[i]) a[i] = b[i];
+            break;
+    case 3: for (long i = 0; i < n; i++) if (b[i] < a[i]) a[i] = b[i];
+            break;
+    case 4: for (long i = 0; i < n; i++) a[i] = a[i] && b[i]; break;
+    case 5: for (long i = 0; i < n; i++) a[i] = a[i] || b[i]; break;
+    case 6: for (long i = 0; i < n; i++) a[i] = (T)(a[i] & b[i]); break;
+    case 7: for (long i = 0; i < n; i++) a[i] = (T)(a[i] | b[i]); break;
+    case 8: for (long i = 0; i < n; i++) a[i] = (T)(a[i] ^ b[i]); break;
+    case 9: for (long i = 0; i < n; i++) a[i] = (!!a[i]) ^ (!!b[i]);
+            break;
+    default: return -1;
+  }
+  return 0;
+}
+
+template <typename T>
+int fl_red_flt(int op, void* inout, const void* in, long n) {
+  T* a = static_cast<T*>(inout);
+  const T* b = static_cast<const T*>(in);
+  switch (op) {
+    case 0: for (long i = 0; i < n; i++) a[i] = a[i] + b[i]; break;
+    case 1: for (long i = 0; i < n; i++) a[i] = a[i] * b[i]; break;
+    case 2: for (long i = 0; i < n; i++) if (b[i] > a[i]) a[i] = b[i];
+            break;
+    case 3: for (long i = 0; i < n; i++) if (b[i] < a[i]) a[i] = b[i];
+            break;
+    case 4: for (long i = 0; i < n; i++) a[i] = a[i] && b[i]; break;
+    case 5: for (long i = 0; i < n; i++) a[i] = a[i] || b[i]; break;
+    case 9: for (long i = 0; i < n; i++)
+              a[i] = (a[i] != 0) != (b[i] != 0);
+            break;
+    default: return -1;
+  }
+  return 0;
+}
+
+int fl_reduce(int op, int dt, void* inout, const void* in, long n) {
+  switch (dt) {
+    case 0: return fl_red_int<unsigned char>(op, inout, in, n);
+    case 1: return fl_red_int<signed char>(op, inout, in, n);
+    case 2: return fl_red_int<int>(op, inout, in, n);
+    case 3: return fl_red_flt<float>(op, inout, in, n);
+    case 4: return fl_red_flt<double>(op, inout, in, n);
+    case 5: return fl_red_int<long long>(op, inout, in, n);
+    case 6: return fl_red_int<unsigned long>(op, inout, in, n);
+    case 7: return fl_red_int<short>(op, inout, in, n);
+    case 8: return fl_red_int<unsigned char>(op, inout, in, n);
+    case 10: return fl_red_int<unsigned int>(op, inout, in, n);
+    case 11: return fl_red_int<unsigned short>(op, inout, in, n);
+    case 12: return fl_red_flt<long double>(op, inout, in, n);
+    case 13: return fl_red_int<unsigned char>(op, inout, in, n);
+    case 20: return fl_red_int<long>(op, inout, in, n);
+    default: return -1;
+  }
+}
+
+// wait for *a >= want. Brief spin, then yield (an oversubscribed host
+// needs the core handed to the peer, not burned), then short sleeps.
+// Pumps the plane and the registered python-progress callback while
+// parked so rendezvous assists keep flowing; escapes on peer failure.
+int flat_wait(CPlane* p, const volatile uint64_t* a, uint64_t want) {
+  for (int i = 0; i < 256; i++) {
+    if (fl_load(a) >= want) return 0;
+    for (volatile int j = 0; j < 16; j++) {
+    }
+  }
+  uint64_t start = now_us();
+  int it = 0;
+  while (fl_load(a) < want) {
+    ++it;
+    if (it <= 16) {
+      sched_yield();
+      continue;
+    }
+    // parked: drain our rings (the peer may be blocked injecting
+    // toward us) and run forwarded python work if any piled up
+    cp_advance(p);
+    if (p->progress_cb != nullptr &&
+        (p->assist_count.load(std::memory_order_acquire) > 0 ||
+         p->py_count.load(std::memory_order_acquire) > 0)) {
+      p->fpctr[FPC_FLAT_PROGRESS]++;
+      p->progress_cb();
+    }
+    if (fl_load(a) >= want) return 0;
+    if (g_any_failed.load(std::memory_order_acquire)) return -2;
+    uint64_t waited = now_us() - start;
+    if (waited > FLAT_TIMEOUT_US) return -3;
+    struct timespec ts = {0, waited > 4000 ? 200000 : 50000};
+    nanosleep(&ts, nullptr);
+  }
+  return 0;
+}
+
+// entry stamp: lift a stale out_seq (context reuse with different
+// membership) to seq-1 so the leader's overwrite guard cannot wait on
+// a counter this rank's previous-comm life never advanced
+inline void flat_enter(uint8_t* slot, uint64_t seq) {
+  if (fl_load(fl_out(slot)) < seq - 1) fl_store(fl_out(slot), seq - 1);
+}
+
+}  // namespace
+
+extern "C" {
+
+// can the flat tier fold this (op, dtype) pair? Shared gate: fastpath.c
+// calls it directly, coll/flatcoll.py through ctypes — both sides of a
+// mixed C/python job must reach the identical dispatch verdict.
+int cp_flat_op_ok(int op, int dt) {
+  char a[16] = {0}, b[16] = {0};
+  if (op < 0 || op > 9) return 0;
+  return fl_reduce(op, dt, a, b, 1) == 0;
+}
+
+long cp_flat_payload_max(void) { return FLAT_MAX; }
+int cp_flat_nslots(void) { return FLAT_NSLOTS; }
+int cp_flat_lanes(void) { return FLAT_LANES; }
+
+// map (and on the leader: create) the per-node flat segment. The file
+// is sparse — only regions of contexts that actually run flat
+// collectives materialize pages. Returns 0 ok, -1 unusable.
+int cp_flat_attach(void* cp, const char* path, int create) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (p->flat) return 0;
+  int fd = open(path, create ? (O_CREAT | O_RDWR) : O_RDWR, 0600);
+  if (fd < 0) return -1;
+  if (create && ftruncate(fd, FLAT_FILE_LEN) != 0) {
+    close(fd);
+    return -1;
+  }
+  void* m = mmap(nullptr, FLAT_FILE_LEN, PROT_READ | PROT_WRITE,
+                 MAP_SHARED, fd, 0);
+  close(fd);
+  if (m == MAP_FAILED) return -1;
+  p->flat = static_cast<uint8_t*>(m);
+  p->flat_len = FLAT_FILE_LEN;
+  return 0;
+}
+
+int cp_flat_ok(void* cp) {
+  return static_cast<CPlane*>(cp)->flat != nullptr;
+}
+
+// stand the flat tier down (non-unanimous attach agreement: a node
+// where any rank failed to map the segment must disable it everywhere)
+void cp_flat_disable(void* cp) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (p->flat) {
+    munmap(p->flat, p->flat_len);
+    p->flat = nullptr;
+  }
+}
+
+void cp_flat_set_progress_cb(void* cp, void (*cb)(void)) {
+  static_cast<CPlane*>(cp)->progress_cb = cb;
+}
+
+// the region's current broadcast seq — the per-comm call-numbering base
+// read once before a comm's first flat collective. -1 = no region for
+// this context (caller must not take the flat tier).
+long long cp_flat_base(void* cp, int ctx, int lane) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  uint8_t* reg = flat_region(p, ctx, lane);
+  if (reg == nullptr) return -1;
+  return static_cast<long long>(fl_load(fl_in(flat_bcb(reg))));
+}
+
+// flat allreduce: contributions fan into the slots, the leader folds in
+// rank order into the broadcast block, everyone copies out. sbuf may
+// alias rbuf (MPI_IN_PLACE). Returns 0 ok, -1 bad args, -2 peer
+// failure, -3 stall timeout.
+int cp_flat_allreduce(void* cp, int ctx, int lane, int rank, int n,
+                      long long seq, int op, int dt, const void* sbuf,
+                      void* rbuf, long long count, long long elsz) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  uint8_t* reg = flat_region(p, ctx, lane);
+  long nb = static_cast<long>(count * elsz);
+  if (reg == nullptr || n < 1 || n > FLAT_NSLOTS || rank < 0 ||
+      rank >= n || nb < 0 || nb > FLAT_MAX)
+    return -1;
+  uint64_t s = static_cast<uint64_t>(seq);
+  uint8_t* mine = flat_slot(reg, rank);
+  uint8_t* bcb = flat_bcb(reg);
+  flat_enter(mine, s);
+  int rc = 0;
+  if (rank == 0) {
+    // overwrite guard: every reader of the previous broadcast payload
+    // has stamped out; then fold straight into the broadcast block
+    for (int r = 0; r < n && rc == 0; r++)
+      rc = flat_wait(p, fl_out(flat_slot(reg, r)), s - 1);
+    if (rc == 0) {
+      if (nb > 0) memcpy(fl_pay(bcb), sbuf, nb);
+      for (int r = 1; r < n && rc == 0; r++) {
+        uint8_t* sl = flat_slot(reg, r);
+        rc = flat_wait(p, fl_in(sl), s);
+        if (rc == 0 && nb > 0)
+          fl_reduce(op, dt, fl_pay(bcb), fl_pay(sl), count);
+      }
+    }
+    if (rc == 0) {
+      if (nb > 0 && rbuf != fl_pay(bcb)) memcpy(rbuf, fl_pay(bcb), nb);
+      fl_store(fl_in(bcb), s);
+      fl_store(fl_in(mine), s);
+      fl_store(fl_out(mine), s);
+      p->fpctr[FPC_COLL_FLAT]++;
+    }
+    return rc;
+  }
+  if (nb > 0) memcpy(fl_pay(mine), sbuf, nb);
+  fl_store(fl_in(mine), s);
+  rc = flat_wait(p, fl_in(bcb), s);
+  if (rc != 0) return rc;
+  if (nb > 0) memcpy(rbuf, fl_pay(bcb), nb);
+  fl_store(fl_out(mine), s);
+  p->fpctr[FPC_COLL_FLAT]++;
+  return 0;
+}
+
+// flat reduce to root: fan-in only; the root folds into rbuf, then
+// stamps the broadcast seq as pure flow control (no payload) so
+// contributors know their slots were consumed.
+int cp_flat_reduce(void* cp, int ctx, int lane, int rank, int n,
+                   long long seq, int op, int dt, int root,
+                   const void* sbuf, void* rbuf, long long count,
+                   long long elsz) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  uint8_t* reg = flat_region(p, ctx, lane);
+  long nb = static_cast<long>(count * elsz);
+  if (reg == nullptr || n < 1 || n > FLAT_NSLOTS || rank < 0 ||
+      rank >= n || root < 0 || root >= n || nb < 0 || nb > FLAT_MAX)
+    return -1;
+  uint64_t s = static_cast<uint64_t>(seq);
+  uint8_t* mine = flat_slot(reg, rank);
+  uint8_t* bcb = flat_bcb(reg);
+  flat_enter(mine, s);
+  int rc = 0;
+  if (rank == root) {
+    if (nb > 0 && rbuf != sbuf) memcpy(rbuf, sbuf, nb);
+    for (int r = 0; r < n && rc == 0; r++) {
+      if (r == root) continue;
+      uint8_t* sl = flat_slot(reg, r);
+      rc = flat_wait(p, fl_in(sl), s);
+      if (rc == 0 && nb > 0)
+        fl_reduce(op, dt, rbuf, fl_pay(sl), count);
+    }
+    if (rc == 0) {
+      fl_store(fl_in(bcb), s);
+      fl_store(fl_in(mine), s);
+      fl_store(fl_out(mine), s);
+      p->fpctr[FPC_COLL_FLAT]++;
+    }
+    return rc;
+  }
+  if (nb > 0) memcpy(fl_pay(mine), sbuf, nb);
+  fl_store(fl_in(mine), s);
+  rc = flat_wait(p, fl_in(bcb), s);
+  if (rc != 0) return rc;
+  fl_store(fl_out(mine), s);
+  p->fpctr[FPC_COLL_FLAT]++;
+  return 0;
+}
+
+// flat bcast: seq-stamped broadcast straight from the root's buffer.
+// The root's byte count travels in the block header so a length-
+// mismatched bcast (errors/coll/bcastlength.c) is REPORTED (-4, the
+// caller maps it to MPI_ERR_TRUNCATE) while the wave still completes —
+// no member may hang behind the verdict.
+//
+// FAN-IN-FIRST, like every other flat op: the root must not stamp the
+// broadcast block before every member has arrived (in_seq >= s). The
+// per-comm numbering base is read lazily at each rank's FIRST flat
+// collective, so an op whose writer ran ahead of a slow member would
+// let that member read a base that already counts the in-flight wave
+// — its own first call would number s+1 and the comm desyncs. The
+// reduce-family ops get this ordering for free (the leader folds every
+// slot before stamping); bcast needs the explicit arrival wave.
+int cp_flat_bcast(void* cp, int ctx, int lane, int rank, int n,
+                  long long seq, int root, void* buf, long long nbytes) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  uint8_t* reg = flat_region(p, ctx, lane);
+  if (reg == nullptr || n < 1 || n > FLAT_NSLOTS || rank < 0 ||
+      rank >= n || root < 0 || root >= n || nbytes < 0 ||
+      nbytes > FLAT_MAX)
+    return -1;
+  uint64_t s = static_cast<uint64_t>(seq);
+  uint8_t* mine = flat_slot(reg, rank);
+  uint8_t* bcb = flat_bcb(reg);
+  flat_enter(mine, s);
+  int rc = 0;
+  if (rank == root) {
+    // arrival wave: in_seq >= s also proves the rank consumed wave
+    // s-1's broadcast block (ops are sequential per rank), so this
+    // doubles as the bcb overwrite guard
+    for (int r = 0; r < n && rc == 0; r++) {
+      if (r == root) continue;
+      rc = flat_wait(p, fl_in(flat_slot(reg, r)), s);
+    }
+    if (rc != 0) return rc;
+    if (nbytes > 0) memcpy(fl_pay(bcb), buf, nbytes);
+    fl_store(fl_out(bcb), static_cast<uint64_t>(nbytes));
+    fl_store(fl_in(bcb), s);
+    fl_store(fl_in(mine), s);
+    fl_store(fl_out(mine), s);
+    p->fpctr[FPC_COLL_FLAT]++;
+    return 0;
+  }
+  fl_store(fl_in(mine), s);     // arrival stamp: the root blocks on it
+  rc = flat_wait(p, fl_in(bcb), s);
+  if (rc != 0) return rc;
+  long long have = static_cast<long long>(fl_load(fl_out(bcb)));
+  long long take = have < nbytes ? have : nbytes;
+  if (take > 0) memcpy(buf, fl_pay(bcb), take);
+  fl_store(fl_out(mine), s);
+  p->fpctr[FPC_COLL_FLAT]++;
+  return have != nbytes ? -4 : 0;
+}
+
+// flat barrier: a zero-byte allreduce (fan-in stamps, leader stamps the
+// broadcast seq, everyone acknowledges).
+int cp_flat_barrier(void* cp, int ctx, int lane, int rank, int n,
+                    long long seq) {
+  return cp_flat_allreduce(cp, ctx, lane, rank, n, seq, 0, 0, nullptr,
+                           nullptr, 0, 1);
+}
+
+// fast-path counter surface: fastpath.c caches the pointer and bumps
+// slots inline; python reads through cp_fp_counter.
+unsigned long long* cp_fp_counters(void* cp) {
+  return reinterpret_cast<unsigned long long*>(
+      static_cast<CPlane*>(cp)->fpctr);
+}
+
+unsigned long long cp_fp_counter(void* cp, int idx) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (idx < 0 || idx >= 16) return 0;
+  return p->fpctr[idx];
+}
+
 // C-side blocking wait quantum for one request.
 // Returns: 2 request done, 1 python work pending (assist/inbox — caller
-// must run the python progress engine), 0 quantum elapsed with nothing.
+// must run the python progress engine), 3 woken by the doorbell (the
+// caller's spin-budget adaptation treats this as "the peer needed the
+// core"), 0 quantum elapsed with nothing.
 int cp_wait_quantum(void* cp, long long req, long spin_us, long block_ms) {
   CPlane* p = static_cast<CPlane*>(cp);
   uint64_t spin_end = now_us() + spin_us;
@@ -1580,6 +2050,7 @@ int cp_wait_quantum(void* cp, long long req, long spin_us, long block_ms) {
     if (p->flags) p->flags[p->me] = 0;
     return 1;
   }
+  int woken = 0;
   if (p->bell_fd >= 0) {
     fd_set rf;
     FD_ZERO(&rf);
@@ -1589,6 +2060,7 @@ int cp_wait_quantum(void* cp, long long req, long spin_us, long block_ms) {
     tv.tv_usec = (block_ms % 1000) * 1000;
     int sel = select(p->bell_fd + 1, &rf, nullptr, nullptr, &tv);
     if (sel > 0) {
+      woken = 1;
       char tmp[512];
       while (recv(p->bell_fd, tmp, sizeof(tmp), MSG_DONTWAIT) > 0) {
       }
@@ -1598,7 +2070,7 @@ int cp_wait_quantum(void* cp, long long req, long spin_us, long block_ms) {
     nanosleep(&ts, nullptr);
   }
   if (p->flags) p->flags[p->me] = 0;
-  return 0;
+  return woken ? 3 : 0;
 }
 
 /* Control-plane allgather: one fixed-size record per member, executed

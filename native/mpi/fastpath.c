@@ -73,12 +73,51 @@ static struct {
     long long (*rndv_wire)(long long);
     void (*req_own_tmp)(cph, long long, void *);
     int (*coll_tag)(cph, int);
+    /* flat-slot collective tier + fast-path counters (cplane.cpp) */
+    int (*flat_ok)(cph);
+    long long (*flat_base)(cph, int, int);
+    int (*flat_allreduce)(cph, int, int, int, int, long long, int, int,
+                          const void *, void *, long long, long long);
+    int (*flat_reduce)(cph, int, int, int, int, long long, int, int, int,
+                       const void *, void *, long long, long long);
+    int (*flat_bcast)(cph, int, int, int, int, long long, int, void *,
+                      long long);
+    int (*flat_barrier)(cph, int, int, int, int, long long);
+    int (*flat_lanes)(void);
+    int (*flat_op_ok)(int, int);
+    long (*flat_payload_max)(void);
+    int (*flat_nslots)(void);
+    void (*flat_set_progress_cb)(cph, void (*)(void));
+    unsigned long long *(*fp_counters)(cph);
 } F;
+
+/* fast-path counter indices — mirror of cplane.cpp's FPC_* enum (and
+ * transport/shm.py _FP_COUNTERS); counters live in the plane so the
+ * python mpit layer reads them without touching libmpi.so */
+enum {
+    FPC_HITS = 0,
+    FPC_GIL_TAKES = 1,
+    FPC_FB_DTYPE = 2,
+    FPC_FB_COMM = 3,
+    FPC_FB_SIZE = 4,
+    FPC_FB_PLANE = 5,
+    FPC_COLL_FLAT = 6,
+    FPC_COLL_SCHED = 7,
+    FPC_WAIT_SPIN = 8,
+    FPC_WAIT_BELL = 9
+};
+
+static unsigned long long *fp_ctr;  /* live plane's counter block */
+
+#define FPCTR(i) do { if (fp_ctr != NULL) fp_ctr[i]++; } while (0)
 
 static int fp_state = -1;       /* -1 unknown, 0 unavailable, 1 ready */
 static long fp_threshold = 0;
 static long fp_congest_min = 8192;  /* RNDV_CONGEST_MIN (fetched with
                                      * the eager threshold) */
+static long fp_coll_max = 0;    /* FP_COLL_MAX: collective-tier payload
+                                 * cap — hops above fp_threshold ride
+                                 * the CMA rendezvous (fpc_sendrecv2) */
 static pthread_mutex_t fp_mu = PTHREAD_MUTEX_INITIALIZER;
 static _Atomic long long fp_sreq_next = (1LL << 48);
 
@@ -124,9 +163,26 @@ static int fp_load_locked(void) {
     SYM(rndv_wire, "cp_rndv_wire");
     SYM(req_own_tmp, "cp_req_own_tmp");
     SYM(coll_tag, "cp_coll_tag");
+    SYM(flat_ok, "cp_flat_ok");
+    SYM(flat_base, "cp_flat_base");
+    SYM(flat_allreduce, "cp_flat_allreduce");
+    SYM(flat_reduce, "cp_flat_reduce");
+    SYM(flat_bcast, "cp_flat_bcast");
+    SYM(flat_barrier, "cp_flat_barrier");
+    SYM(flat_op_ok, "cp_flat_op_ok");
+    SYM(flat_payload_max, "cp_flat_payload_max");
+    SYM(flat_nslots, "cp_flat_nslots");
+    SYM(flat_lanes, "cp_flat_lanes");
+    SYM(flat_set_progress_cb, "cp_flat_set_progress_cb");
+    SYM(fp_counters, "cp_fp_counters");
 #undef SYM
     return 1;
 }
+
+/* python-progress hook for flat-collective waits (registered once per
+ * plane): a rank parked in a flat wave must still run forwarded python
+ * work or a peer's rendezvous assist deadlocks behind the collective */
+static void fp_progress_hook(void);
 
 /* the live plane, or NULL when the fast path must stand down */
 static cph fp_plane(void) {
@@ -140,9 +196,18 @@ static cph fp_plane(void) {
         if (fp_state == 0)
             return NULL;
     }
+    static cph fp_ctr_plane;    /* counter block owner (re-init safety) */
     cph p = F.global();
-    if (p == NULL)
+    if (p == NULL) {
+        fp_ctr = NULL;          /* plane gone: never write freed memory */
+        fp_ctr_plane = NULL;
         return NULL;
+    }
+    if (p != fp_ctr_plane) {
+        fp_ctr = F.fp_counters(p);
+        F.flat_set_progress_cb(p, fp_progress_hook);
+        fp_ctr_plane = p;
+    }
     if (F.any_failed(p))
         return NULL;            /* ULFM semantics live in python */
     return p;
@@ -150,6 +215,7 @@ static cph fp_plane(void) {
 
 /* one GIL-held python progress pass (assists, forwarded packets, tcp) */
 static void fp_py_progress(void) {
+    FPCTR(FPC_GIL_TAKES);
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *res = PyObject_CallMethod(g_shim, "plane_progress", NULL);
     if (res == NULL)
@@ -157,6 +223,8 @@ static void fp_py_progress(void) {
     Py_XDECREF(res);
     PyGILState_Release(st);
 }
+
+static void fp_progress_hook(void) { fp_py_progress(); }
 
 /* ------------------------------------------------------------------ */
 /* datatype descriptors (the dataloop cache — mpid_segment.c analog)   */
@@ -249,6 +317,10 @@ typedef struct {
     int state;                  /* 0 unknown, 1 plane-owned, 2 not */
     int ctx, rank, size;
     int *ring;                  /* comm rank -> plane ring index */
+    long long flat_base;        /* flat tier call numbering: 0 unknown,
+                                 * -1 off/poisoned, else region base+1 */
+    long long flat_seq;         /* flat collectives completed here */
+    int flat_lane;              /* min member ring index (region lane) */
 } FpComm;
 
 static FpComm fp_comms[FP_MAX_COMM];
@@ -311,6 +383,9 @@ static FpComm *fp_comm(MPI_Comm comm) {
         t = shim_call_v("plane_congest_min", &tok, "()");
         if (tok && t > 0)
             fp_congest_min = t;
+        t = shim_call_v("plane_coll_max", &tok, "()");
+        if (tok && t > 0)
+            fp_coll_max = t;
     }
     PyGILState_Release(st);
     return fc->state == 1 ? fc : NULL;
@@ -423,36 +498,63 @@ static int fp_recv_status(cph p, long long cpid, MPI_Status *stout,
     return MPI_SUCCESS;
 }
 
-/* adaptive spin: grows when completions land during the spin window
- * (busy peer on another core), shrinks when they arrive after the
- * doorbell sleep (oversubscribed single core — don't burn the peer's
- * timeslice).  Matches the reference's spin-count tuning knob
- * (MV2_SPIN_COUNT, ch3_progress.c). */
+/* adaptive spin: grows additively while completions land during the
+ * spin window (busy peer on another core — keep catching them in
+ * userspace), decays geometrically when they arrive via the doorbell
+ * (oversubscribed host: the peer needs this core, so every spin
+ * microsecond DELAYS the completion; park early and let it run), and
+ * halves on a genuinely idle timeout.  Both directions matter: never
+ * shrinking on a bell wake pins ping-pong at spin_us+wake per hop on a
+ * shared core, while shrinking too eagerly degrades the multi-core
+ * path to a select() syscall per message (the r5 latency regression,
+ * 13 -> 43 us half-RTT).  Matches the reference's spin-count tuning
+ * knob (MV2_SPIN_COUNT, ch3_progress.c). */
 static long fp_spin_us = 40;
 
-static int fp_block_recv(cph p, long long cpid, MPI_Status *stout,
-                         long long basic) {
+/* shared blocking-wait loop for plane requests; returns when the
+ * request is DONE.  The wait outcome feeds both the spin adaptation
+ * and the fp_wait_{spin,bell} pvars. */
+static void fp_block_req(cph p, long long cpid) {
     int idle = 0;
+    int slept = 0;
     for (;;) {
         int rc = F.wait_quantum(p, cpid, fp_spin_us, 2);
         if (rc == 2)
             break;
         if (rc == 1) {
             fp_py_progress();
-        } else {
-            /* doorbell timeout: drop the spin, run python progress
-             * occasionally so non-plane work (tcp accepts, spawned
-             * children) cannot starve */
+        } else if (rc == 0) {
+            /* idle timeout (no bell, nothing arrived): drop the spin,
+             * run python progress occasionally so non-plane work (tcp
+             * accepts, spawned children) cannot starve */
+            slept = 1;
             if (fp_spin_us > 4)
                 fp_spin_us /= 2;
             if (++idle % 16 == 0)
                 fp_py_progress();
+        } else {
+            /* rc 3: woken by the doorbell — the peer only progressed
+             * once we released the core; decay the budget */
+            slept = 1;
+            fp_spin_us -= fp_spin_us / 4 + 1;
+            if (fp_spin_us < 2)
+                fp_spin_us = 2;
         }
         if (F.req_state(p, cpid) == 2)
             break;
     }
-    if (fp_spin_us < 200)
-        fp_spin_us += 4;
+    if (slept) {
+        FPCTR(FPC_WAIT_BELL);
+    } else {
+        FPCTR(FPC_WAIT_SPIN);
+        if (fp_spin_us < 200)
+            fp_spin_us += 4;
+    }
+}
+
+static int fp_block_recv(cph p, long long cpid, MPI_Status *stout,
+                         long long basic) {
+    fp_block_req(p, cpid);
     return fp_recv_status(p, cpid, stout, basic);
 }
 
@@ -494,24 +596,7 @@ static void fp_unpack_spans(FpDt *d, void *buf, int count,
 
 /* block until a rendezvous send request completes; frees it */
 static int fp_block_send_rndv(cph p, long long rid) {
-    int idle = 0;
-    for (;;) {
-        int rc = F.wait_quantum(p, rid, fp_spin_us, 2);
-        if (rc == 2)
-            break;
-        if (rc == 1) {
-            fp_py_progress();
-        } else {
-            if (fp_spin_us > 4)
-                fp_spin_us /= 2;
-            if (++idle % 16 == 0)
-                fp_py_progress();
-        }
-        if (F.req_state(p, rid) == 2)
-            break;
-    }
-    if (fp_spin_us < 200)
-        fp_spin_us += 4;
+    fp_block_req(p, rid);
     int ec = 0;
     F.req_status(p, rid, NULL, NULL, NULL, NULL, &ec);
     F.req_free(p, rid);
@@ -582,17 +667,29 @@ static long long fp_post_recv(cph p, FpDt *d, void *buf, int count,
 int fp_try_send(const void *buf, int count, MPI_Datatype dt, int dest,
                 int tag, MPI_Comm comm, int *out_rc) {
     cph p = fp_plane();
-    if (p == NULL || dest < 0 || count < 0)
+    if (p == NULL) {
+        FPCTR(FPC_FB_PLANE);
+        return 0;
+    }
+    if (dest < 0 || count < 0)
         return 0;
     FpDt *d = fp_dt(dt);
-    if (d == NULL)
+    if (d == NULL) {
+        FPCTR(FPC_FB_DTYPE);
         return 0;
+    }
     FpComm *fc = fp_comm(comm);
-    if (fc == NULL || dest >= fc->size)
+    if (fc == NULL) {
+        FPCTR(FPC_FB_COMM);
+        return 0;
+    }
+    if (dest >= fc->size)
         return 0;
     long nb = (long)(d->size * count);
-    if (fp_threshold <= 0)
+    if (fp_threshold <= 0) {
+        FPCTR(FPC_FB_SIZE);
         return 0;
+    }
     if (fp_want_rndv(p, nb, fc->ring[dest])) {
         /* large (or ring-congested) message: CMA rendezvous, blocking
          * until FIN */
@@ -602,22 +699,30 @@ int fp_try_send(const void *buf, int count, MPI_Datatype dt, int dest,
         if (rid >= 0) {
             *out_rc = fp_block_send_rndv(p, rid);
             free(tmp);
+            FPCTR(FPC_HITS);
             return 1;
         }
-        if (nb > fp_threshold)
+        if (nb > fp_threshold) {
+            FPCTR(FPC_FB_SIZE);
             return 0;           /* too big for eager: slow path */
+        }
     }
     long long sid = atomic_fetch_add(&fp_sreq_next, 1);
     if (fp_do_send(p, d, buf, count, fc, dest, tag, sid) != 0)
         return 0;               /* failed peer / full: slow path decides */
     *out_rc = MPI_SUCCESS;
+    FPCTR(FPC_HITS);
     return 1;
 }
 
 int fp_try_recv(void *buf, int count, MPI_Datatype dt, int source,
                 int tag, MPI_Comm comm, MPI_Status *status, int *out_rc) {
     cph p = fp_plane();
-    if (p == NULL || count < 0)
+    if (p == NULL) {
+        FPCTR(FPC_FB_PLANE);
+        return 0;
+    }
+    if (count < 0)
         return 0;
     /* MPI_BOTTOM (NULL base + absolute typemap): the eager and CMA
      * completions scatter fine, but the python-assist rendezvous path
@@ -629,31 +734,50 @@ int fp_try_recv(void *buf, int count, MPI_Datatype dt, int source,
     if (source < 0 && source != MPI_ANY_SOURCE)
         return 0;
     FpDt *d = fp_dt(dt);
-    if (d == NULL)
+    if (d == NULL) {
+        FPCTR(FPC_FB_DTYPE);
         return 0;
+    }
     FpComm *fc = fp_comm(comm);
-    if (fc == NULL || (source != MPI_ANY_SOURCE && source >= fc->size))
+    if (fc == NULL) {
+        FPCTR(FPC_FB_COMM);
+        return 0;
+    }
+    if (source != MPI_ANY_SOURCE && source >= fc->size)
         return 0;
     long long cpid = fp_post_recv(p, d, buf, count, fc, source, tag);
     *out_rc = fp_block_recv(p, cpid, status, d->basic);
     F.req_free(p, cpid);
+    FPCTR(FPC_HITS);
     return 1;
 }
 
 int fp_try_isend(const void *buf, int count, MPI_Datatype dt, int dest,
                  int tag, MPI_Comm comm, MPI_Request *req, int *out_rc) {
     cph p = fp_plane();
-    if (p == NULL || dest < 0 || count < 0)
+    if (p == NULL) {
+        FPCTR(FPC_FB_PLANE);
+        return 0;
+    }
+    if (dest < 0 || count < 0)
         return 0;
     FpDt *d = fp_dt(dt);
-    if (d == NULL)
+    if (d == NULL) {
+        FPCTR(FPC_FB_DTYPE);
         return 0;
+    }
     FpComm *fc = fp_comm(comm);
-    if (fc == NULL || dest >= fc->size)
+    if (fc == NULL) {
+        FPCTR(FPC_FB_COMM);
+        return 0;
+    }
+    if (dest >= fc->size)
         return 0;
     long nb = (long)(d->size * count);
-    if (fp_threshold <= 0)
+    if (fp_threshold <= 0) {
+        FPCTR(FPC_FB_SIZE);
         return 0;
+    }
     if (fp_want_rndv(p, nb, fc->ring[dest])) {
         /* large (or ring-congested) message: nonblocking CMA rndv */
         int s = fp_slot_alloc();
@@ -673,11 +797,14 @@ int fp_try_isend(const void *buf, int count, MPI_Datatype dt, int dest,
             fp_reqs[s].comm = comm;
             *req = FP_REQ_BASE + s;
             *out_rc = MPI_SUCCESS;
+            FPCTR(FPC_HITS);
             return 1;
         }
         fp_slot_free(s);
-        if (nb > fp_threshold)
+        if (nb > fp_threshold) {
+            FPCTR(FPC_FB_SIZE);
             return 0;           /* too big for eager: slow path */
+        }
     }
     int s = fp_slot_alloc();
     if (s < 0)
@@ -693,13 +820,18 @@ int fp_try_isend(const void *buf, int count, MPI_Datatype dt, int dest,
     fp_reqs[s].comm = comm;
     *req = FP_REQ_BASE + s;
     *out_rc = MPI_SUCCESS;
+    FPCTR(FPC_HITS);
     return 1;
 }
 
 int fp_try_irecv(void *buf, int count, MPI_Datatype dt, int source,
                  int tag, MPI_Comm comm, MPI_Request *req, int *out_rc) {
     cph p = fp_plane();
-    if (p == NULL || count < 0)
+    if (p == NULL) {
+        FPCTR(FPC_FB_PLANE);
+        return 0;
+    }
+    if (count < 0)
         return 0;
     if (buf == NULL && count > 0)   /* MPI_BOTTOM: python matcher
                                      * (see fp_try_recv) */
@@ -707,10 +839,16 @@ int fp_try_irecv(void *buf, int count, MPI_Datatype dt, int source,
     if (source < 0 && source != MPI_ANY_SOURCE)
         return 0;
     FpDt *d = fp_dt(dt);
-    if (d == NULL)
+    if (d == NULL) {
+        FPCTR(FPC_FB_DTYPE);
         return 0;
+    }
     FpComm *fc = fp_comm(comm);
-    if (fc == NULL || (source != MPI_ANY_SOURCE && source >= fc->size))
+    if (fc == NULL) {
+        FPCTR(FPC_FB_COMM);
+        return 0;
+    }
+    if (source != MPI_ANY_SOURCE && source >= fc->size)
         return 0;
     int s = fp_slot_alloc();
     if (s < 0)
@@ -721,6 +859,7 @@ int fp_try_irecv(void *buf, int count, MPI_Datatype dt, int source,
     fp_reqs[s].comm = comm;
     *req = FP_REQ_BASE + s;
     *out_rc = MPI_SUCCESS;
+    FPCTR(FPC_HITS);
     return 1;
 }
 
@@ -1020,20 +1159,37 @@ static int fpc_sendrecv2(cph p, FpComm *fc, int dst, int src, int tag,
     long long rid = -1;
     if (src >= 0)
         rid = F.irecv(p, rb, rnb, cctx, src, tag);
+    long long srid = -1;        /* rendezvous send, when taken */
     if (dst >= 0) {
-        long long sid = atomic_fetch_add(&fp_sreq_next, 1);
-        long long rc = F.send_eager(p, fc->ring[dst], cctx, fc->rank, tag,
-                                    sb, snb, sid);
-        if (rc != 0) {
-            if (rid >= 0) {
-                F.cancel_recv(p, rid);
-                F.req_free(p, rid);
+        /* protocol choice per hop mirrors pt2pt (fp_want_rndv): eager
+         * through the ring below the threshold, CMA rendezvous above
+         * — this is what lets the scheduled collective tier carry
+         * payloads up to FP_COLL_MAX instead of refusing at the eager
+         * size (the r5 64 KiB allreduce cliff) */
+        if (fp_want_rndv(p, snb, fc->ring[dst]) && F.cma_enabled(p))
+            srid = F.send_rndv(p, fc->ring[dst], cctx, fc->rank, tag,
+                               sb, snb);
+        if (srid < 0) {
+            long long rc = -1;
+            if (snb <= fp_threshold) {
+                long long sid = atomic_fetch_add(&fp_sreq_next, 1);
+                rc = F.send_eager(p, fc->ring[dst], cctx, fc->rank, tag,
+                                  sb, snb, sid);
             }
-            return rc == -2 ? MPIX_ERR_PROC_FAILED : MPI_ERR_INTERN;
+            if (rc != 0) {
+                if (rid >= 0) {
+                    F.cancel_recv(p, rid);
+                    F.req_free(p, rid);
+                }
+                return rc == -2 ? MPIX_ERR_PROC_FAILED : MPI_ERR_INTERN;
+            }
         }
     }
+    int rc = MPI_SUCCESS;
     if (rid >= 0) {
-        int rc = fp_block_recv(p, rid, MPI_STATUS_IGNORE, 0);
+        /* recv first: the blocking wait pumps the plane, which also
+         * services our outbound rendezvous (peer CTS, CMA FIN) */
+        rc = fp_block_recv(p, rid, MPI_STATUS_IGNORE, 0);
         if (rgot != NULL) {
             int s2 = 0, t2 = 0, tr2 = 0, ec2 = 0;
             long long nb2 = 0;
@@ -1041,9 +1197,13 @@ static int fpc_sendrecv2(cph p, FpComm *fc, int dst, int src, int tag,
             *rgot = (long)nb2;
         }
         F.req_free(p, rid);
-        return rc;
     }
-    return MPI_SUCCESS;
+    if (srid >= 0) {
+        int src_ = fp_block_send_rndv(p, srid);
+        if (rc == MPI_SUCCESS)
+            rc = src_;
+    }
+    return rc;
 }
 
 static int fpc_sendrecv(cph p, FpComm *fc, int dst, int src, int tag,
@@ -1079,14 +1239,81 @@ static cph fpc_enter(int count, MPI_Datatype dt, MPI_Comm comm,
         return NULL;
     }
     long nb = elsz * count;
-    if (fp_threshold <= 0 || nb > fp_threshold) {
+    /* the extended band (eager size .. FP_COLL_MAX) needs rendezvous
+     * hops, so it exists only under the unanimous CMA agreement. The
+     * python gate (coll/api.py _plane_coll_max) reaches the identical
+     * verdict: same cma condition, and the C band applies to comms
+     * with a C-ABI member — which, from inside this process, is every
+     * comm (this process advertised itself at bootstrap) */
+    long cap = (fp_coll_max > fp_threshold && F.cma_enabled(p))
+               ? fp_coll_max : fp_threshold;
+    if (fp_threshold <= 0 || nb > cap) {
         if (dbg)
-            fprintf(stderr, "fpc: nb %ld vs thr %ld\n", nb, fp_threshold);
+            fprintf(stderr, "fpc: nb %ld vs cap %ld\n", nb, cap);
         return NULL;
     }
     *o_fc = fc;
     *o_nb = nb;
     return p;
+}
+
+/* flat-slot tier dispatch: the next call seq when this collective can
+ * run on the flat slots, 0 otherwise. DETERMINISTIC in the call
+ * signature and static comm/node state, so every member (C-ABI or
+ * python API — coll/flatcoll.py implements the identical predicate)
+ * reaches the same verdict. Increments the per-comm call counter, so
+ * only call it once per collective, on the taken path. */
+static long long fpc_flat_next(cph p, FpComm *fc, long nb) {
+    if (nb > F.flat_payload_max() || fc->size > F.flat_nslots())
+        return 0;
+    if (fc->flat_base == 0) {
+        /* region lane: the minimum ring index among the members —
+         * disambiguates disjoint sibling comms sharing a context id
+         * (one MPI_Comm_split agreement covers every color) */
+        int lane = fc->ring[0];
+        for (int i = 1; i < fc->size; i++)
+            if (fc->ring[i] < lane)
+                lane = fc->ring[i];
+        fc->flat_lane = lane;
+        long long b = (F.flat_ok(p) && lane < F.flat_lanes())
+                      ? F.flat_base(p, fc->ctx + 1, lane) : -1;
+        fc->flat_base = b < 0 ? -1 : b + 1;
+    }
+    if (fc->flat_base < 0)
+        return 0;
+    return (fc->flat_base - 1) + (++fc->flat_seq);
+}
+
+/* a flat collective errored mid-protocol (peer death / stall): the
+ * region's counter waves are no longer coherent — poison the tier for
+ * this comm and surface the error (no mid-protocol fallback exists) */
+static int fpc_flat_err(FpComm *fc, int rc) {
+    fc->flat_base = -1;
+    return rc == -2 ? MPIX_ERR_PROC_FAILED : MPI_ERR_INTERN;
+}
+
+/* Flat-tier call numbering for the embedded python side
+ * (coll/flatcoll.py via ctypes on the global symbol table): in a C-ABI
+ * process a comm's flat collectives may interleave between this file's
+ * C dispatch and shim-routed python dispatch (e.g. MPI_INT vs MPI_AINT
+ * allreduces) — both MUST draw from the ONE FpComm counter or the
+ * region seq numbering splits. Returns the next seq (bumping) when the
+ * flat tier is open for (comm, nb), else 0. */
+long long mv2t_fp_flat_next(MPI_Comm comm, long nb) {
+    cph p = fp_plane();
+    if (p == NULL)
+        return 0;
+    FpComm *fc = fp_comm(comm);
+    if (fc == NULL)
+        return 0;
+    return fpc_flat_next(p, fc, nb);
+}
+
+/* poison the flat tier for a comm after a python-side flat error (the
+ * same stand-down fpc_flat_err applies on the C side) */
+void mv2t_fp_flat_poison(MPI_Comm comm) {
+    if (comm >= 0 && comm < FP_MAX_COMM)
+        fp_comms[comm].flat_base = -1;
 }
 
 int fp_try_allreduce(const void *sendbuf, void *recvbuf, int count,
@@ -1097,13 +1324,25 @@ int fp_try_allreduce(const void *sendbuf, void *recvbuf, int count,
     cph p = fpc_enter(count, dt, comm, &fc, &nb);
     if (p == NULL || !fpc_op_ok(op, dt))
         return 0;
-    if (sendbuf != MPI_IN_PLACE && nb > 0)
-        memcpy(recvbuf, sendbuf, (size_t)nb);
     int n = fc->size, rank = fc->rank;
     if (n == 1) {
+        if (sendbuf != MPI_IN_PLACE && nb > 0)
+            memcpy(recvbuf, sendbuf, (size_t)nb);
         *out_rc = MPI_SUCCESS;
         return 1;
     }
+    long long fseq = fpc_flat_next(p, fc, nb);
+    if (fseq > 0) {
+        const void *sb = sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf;
+        int rc = F.flat_allreduce(p, fc->ctx + 1, fc->flat_lane, rank,
+                                  n, fseq, op, dt, sb, recvbuf, count,
+                                  fpc_elsz(dt));
+        *out_rc = rc == 0 ? MPI_SUCCESS : fpc_flat_err(fc, rc);
+        return 1;
+    }
+    if (sendbuf != MPI_IN_PLACE && nb > 0)
+        memcpy(recvbuf, sendbuf, (size_t)nb);
+    FPCTR(FPC_COLL_SCHED);
     int tag = F.coll_tag(p, fc->ctx + 1);
     void *tmp = malloc(nb > 0 ? (size_t)nb : 1);
     if (tmp == NULL)
@@ -1197,6 +1436,26 @@ int fp_try_bcast(void *buf, int count, MPI_Datatype dt, int root,
         }
         data = tmp;
     }
+    long long fseq = fpc_flat_next(p, fc, nb);
+    if (fseq > 0) {
+        int frc = F.flat_bcast(p, fc->ctx + 1, fc->flat_lane, rank, n,
+                               fseq, root, data, nb);
+        if (frc == 0 || frc == -4) {
+            if (tmp != NULL) {
+                if (rank != root)
+                    fp_unpack_spans(d, buf, count, tmp);
+                free(tmp);
+            }
+            /* -4 = root sent a different byte count: the whole
+             * subtree reports the length mismatch, nobody hangs */
+            *out_rc = frc == 0 ? MPI_SUCCESS : MPI_ERR_TRUNCATE;
+            return 1;
+        }
+        free(tmp);
+        *out_rc = fpc_flat_err(fc, frc);
+        return 1;
+    }
+    FPCTR(FPC_COLL_SCHED);
     int tag = F.coll_tag(p, fc->ctx + 1);
     int relrank = (rank - root + n) % n;
     int rc = MPI_SUCCESS;
@@ -1271,6 +1530,19 @@ int fp_try_reduce(const void *sendbuf, void *recvbuf, int count,
     int n = fc->size, rank = fc->rank;
     if (root >= n)
         return 0;
+    if (n > 1) {
+        long long fseq = fpc_flat_next(p, fc, nb);
+        if (fseq > 0) {
+            const void *sb = sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf;
+            int frc = F.flat_reduce(p, fc->ctx + 1, fc->flat_lane, rank,
+                                    n, fseq, op, dt, root, sb,
+                                    rank == root ? recvbuf : NULL,
+                                    count, fpc_elsz(dt));
+            *out_rc = frc == 0 ? MPI_SUCCESS : fpc_flat_err(fc, frc);
+            return 1;
+        }
+        FPCTR(FPC_COLL_SCHED);
+    }
     /* accumulate into recvbuf at the root, a scratch result elsewhere */
     void *result;
     void *scratch = NULL;
@@ -1337,6 +1609,14 @@ int fp_try_barrier(MPI_Comm comm, int *out_rc) {
         *out_rc = MPI_SUCCESS;
         return 1;
     }
+    long long fseq = fpc_flat_next(p, fc, 0);
+    if (fseq > 0) {
+        int frc = F.flat_barrier(p, fc->ctx + 1, fc->flat_lane, rank, n,
+                                 fseq);
+        *out_rc = frc == 0 ? MPI_SUCCESS : fpc_flat_err(fc, frc);
+        return 1;
+    }
+    FPCTR(FPC_COLL_SCHED);
     int tag = F.coll_tag(p, fc->ctx + 1);
     int rc = MPI_SUCCESS;
     /* dissemination with 1-byte tokens, byte-identical to

@@ -1,0 +1,187 @@
+/* flatcoll_test.c — correctness sweep of the small-message collective
+ * datapath through the unmodified C ABI: allreduce/reduce/bcast/barrier
+ * across ops x dtypes x sizes that straddle every protocol boundary
+ * (flat-slot payload max 4 KiB, the eager size, FP_COLL_MAX), plus
+ * dup'd and split comms so the per-(context, lane) flat regions and
+ * the call-numbering bases are exercised across comm lifetimes.
+ * Prints "No Errors" from rank 0 on success; any rank exits 1 on a
+ * validation failure. Run at np in {2,3,4,8}. */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static int errs;
+
+static void fail(const char *what, long size, int rep) {
+    fprintf(stderr, "FAIL %s size=%ld rep=%d\n", what, size, rep);
+    errs++;
+}
+
+/* sizes (bytes) straddling the tier boundaries: flat max (4096), the
+ * eager size (32 KiB default), FP_COLL_MAX (256 KiB default) */
+static const long SIZES[] = {4,     256,   4096,   4100,  8192,
+                             32768, 32772, 262144, 262148, 524288};
+#define NSIZES ((int)(sizeof(SIZES) / sizeof(SIZES[0])))
+
+static void sweep_int_allreduce(MPI_Comm comm) {
+    int rank, np;
+    MPI_Comm_rank(comm, &rank);
+    MPI_Comm_size(comm, &np);
+    for (int si = 0; si < NSIZES; si++) {
+        long n = SIZES[si] / (long)sizeof(int);
+        if (n < 1)
+            n = 1;
+        int *sb = malloc(n * sizeof(int));
+        int *rb = malloc(n * sizeof(int));
+        for (int rep = 0; rep < 3; rep++) {
+            for (long i = 0; i < n; i++)
+                sb[i] = (int)(i % 911) + rank + rep;
+            memset(rb, -1, n * sizeof(int));
+            MPI_Allreduce(sb, rb, (int)n, MPI_INT, MPI_SUM, comm);
+            for (long i = 0; i < n; i++) {
+                int want = np * ((int)(i % 911) + rep)
+                           + np * (np - 1) / 2;
+                if (rb[i] != want) {
+                    fail("allreduce-sum-int", SIZES[si], rep);
+                    break;
+                }
+            }
+            /* MAX with IN_PLACE */
+            for (long i = 0; i < n; i++)
+                rb[i] = rank == np - 1 ? 7 + (int)(i % 13) : -rank;
+            MPI_Allreduce(MPI_IN_PLACE, rb, (int)n, MPI_INT, MPI_MAX,
+                          comm);
+            for (long i = 0; i < n; i++)
+                if (rb[i] != 7 + (int)(i % 13)) {
+                    fail("allreduce-max-inplace", SIZES[si], rep);
+                    break;
+                }
+        }
+        free(sb);
+        free(rb);
+    }
+}
+
+static void sweep_dtypes(MPI_Comm comm) {
+    int rank, np;
+    MPI_Comm_rank(comm, &rank);
+    MPI_Comm_size(comm, &np);
+    /* double SUM */
+    double dv[64], dr[64];
+    for (int i = 0; i < 64; i++)
+        dv[i] = 0.5 * rank + i;
+    MPI_Allreduce(dv, dr, 64, MPI_DOUBLE, MPI_SUM, comm);
+    for (int i = 0; i < 64; i++) {
+        double want = 0.5 * np * (np - 1) / 2 + (double)np * i;
+        if (dr[i] < want - 1e-9 || dr[i] > want + 1e-9)
+            fail("allreduce-sum-double", 64 * 8, i);
+    }
+    /* float MIN */
+    float fv[9], fr[9];
+    for (int i = 0; i < 9; i++)
+        fv[i] = (float)(rank + 1) * (i + 1);
+    MPI_Allreduce(fv, fr, 9, MPI_FLOAT, MPI_MIN, comm);
+    for (int i = 0; i < 9; i++)
+        if (fr[i] != (float)(i + 1))
+            fail("allreduce-min-float", 9 * 4, i);
+    /* long long PROD over 1s and one 2 */
+    long long lv[3], lr[3];
+    for (int i = 0; i < 3; i++)
+        lv[i] = rank == 0 ? 2 : 1;
+    MPI_Allreduce(lv, lr, 3, MPI_LONG_LONG, MPI_PROD, comm);
+    for (int i = 0; i < 3; i++)
+        if (lr[i] != 2)
+            fail("allreduce-prod-ll", 24, i);
+    /* unsigned char BOR */
+    unsigned char cv[17], cr[17];
+    for (int i = 0; i < 17; i++)
+        cv[i] = (unsigned char)(1u << (rank % 8));
+    MPI_Allreduce(cv, cr, 17, MPI_UNSIGNED_CHAR, MPI_BOR, comm);
+    unsigned char wantc = 0;
+    for (int r = 0; r < np; r++)
+        wantc |= (unsigned char)(1u << (r % 8));
+    for (int i = 0; i < 17; i++)
+        if (cr[i] != wantc)
+            fail("allreduce-bor-uchar", 17, i);
+    /* short MAX, reduce to each root in turn */
+    short sv[5], sr[5];
+    for (int root = 0; root < np; root++) {
+        for (int i = 0; i < 5; i++)
+            sv[i] = (short)(10 * rank + i);
+        memset(sr, 0, sizeof(sr));
+        MPI_Reduce(sv, sr, 5, MPI_SHORT, MPI_MAX, root, comm);
+        if (rank == root)
+            for (int i = 0; i < 5; i++)
+                if (sr[i] != (short)(10 * (np - 1) + i))
+                    fail("reduce-max-short", 10, root);
+    }
+}
+
+static void sweep_bcast_barrier(MPI_Comm comm) {
+    int rank, np;
+    MPI_Comm_rank(comm, &rank);
+    MPI_Comm_size(comm, &np);
+    for (int si = 0; si < NSIZES; si++) {
+        long nb = SIZES[si];
+        char *buf = malloc(nb);
+        for (int root = 0; root < np; root++) {
+            if (rank == root)
+                for (long i = 0; i < nb; i++)
+                    buf[i] = (char)((i + root) % 127);
+            else
+                memset(buf, 0, nb);
+            MPI_Bcast(buf, (int)nb, MPI_CHAR, root, comm);
+            for (long i = 0; i < nb; i++)
+                if (buf[i] != (char)((i + root) % 127)) {
+                    fail("bcast", nb, root);
+                    break;
+                }
+            MPI_Barrier(comm);
+        }
+        free(buf);
+    }
+}
+
+int main(int argc, char **argv) {
+    MPI_Init(&argc, &argv);
+    int rank, np;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &np);
+
+    sweep_int_allreduce(MPI_COMM_WORLD);
+    sweep_dtypes(MPI_COMM_WORLD);
+    sweep_bcast_barrier(MPI_COMM_WORLD);
+
+    /* dup: fresh collective context -> fresh flat region + base */
+    MPI_Comm dup;
+    MPI_Comm_dup(MPI_COMM_WORLD, &dup);
+    sweep_dtypes(dup);
+    MPI_Comm_free(&dup);
+
+    /* split into halves: disjoint sibling comms share one allocated
+     * context id — the flat lane (min member ring index) must keep
+     * their regions apart */
+    if (np >= 2) {
+        MPI_Comm half;
+        MPI_Comm_split(MPI_COMM_WORLD, rank % 2, rank, &half);
+        sweep_dtypes(half);
+        sweep_int_allreduce(half);
+        MPI_Comm_free(&half);
+    }
+    /* context reuse: the freed split's id returns to the pool; a new
+     * split must renumber cleanly from the region's carried-over seq */
+    if (np >= 2) {
+        MPI_Comm half2;
+        MPI_Comm_split(MPI_COMM_WORLD, rank % 2, rank, &half2);
+        sweep_dtypes(half2);
+        MPI_Comm_free(&half2);
+    }
+
+    int total = 0;
+    MPI_Allreduce(&errs, &total, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD);
+    if (rank == 0)
+        printf(total == 0 ? "No Errors\n" : "%d errors\n", total);
+    MPI_Finalize();
+    return total == 0 ? 0 : 1;
+}

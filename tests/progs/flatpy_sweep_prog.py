@@ -1,0 +1,106 @@
+"""Rank program: python-API correctness sweep of the flat-slot
+collective tier (coll/flatcoll.py -> cp_flat_*), mirroring the C-ABI
+sweep in flatcoll_test.c: allreduce/reduce/bcast/barrier across ops x
+dtypes x sizes straddling the protocol boundaries (flat payload max,
+eager size, FP_COLL_MAX), plus dup'd and split comms so the
+per-(context, lane) regions and numbering bases see comm churn. Also
+verifies the flat tier actually carried small collectives
+(fp_coll_flat moved) so the sweep cannot silently pass on a fallback.
+
+Launched via: python -m mvapich2_tpu.run -np N tests/progs/flatpy_sweep_prog.py
+"""
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from mvapich2_tpu import mpi                        # noqa: E402
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+rank, size = comm.rank, comm.size
+errs = 0
+
+# element counts chosen so int32 payloads straddle the 4 KiB flat max,
+# the 32 KiB eager size, and fall inside the scheduled band
+COUNTS = (1, 64, 1024, 1025, 2048, 8192, 8193, 65536)
+OPS = ((mpi.SUM, "sum"), (mpi.MAX, "max"), (mpi.MIN, "min"),
+       (mpi.PROD, "prod"))
+
+
+def sweep(c):
+    global errs
+    n, r_ = c.size, c.rank
+    for cnt in COUNTS:
+        s = (np.arange(cnt) % 97 + r_ + 1).astype(np.int32)
+        out = np.zeros(cnt, np.int32)
+        c.allreduce(s, out)
+        want = (np.arange(cnt) % 97 + 1).astype(np.int64) * n \
+            + n * (n - 1) // 2
+        if not np.array_equal(out.astype(np.int64), want):
+            errs += 1
+            print(f"rank {r_}: allreduce sum cnt={cnt} wrong")
+    # dtype x op coverage at flat-tier sizes
+    for dt in (np.int32, np.float64, np.int64, np.uint8, np.int16,
+               np.float32):
+        for op, _name in OPS:
+            if dt == np.uint8 and op is mpi.PROD:
+                continue        # overflow-wraps; not a useful check
+            s = (np.arange(17) % 5 + r_ + 1).astype(dt)
+            out = np.zeros(17, dt)
+            c.allreduce(s, out, op)
+            ref = np.stack([(np.arange(17) % 5 + rr + 1).astype(dt)
+                            for rr in range(n)])
+            want = {mpi.SUM: ref.sum(0, dtype=dt),
+                    mpi.MAX: ref.max(0), mpi.MIN: ref.min(0),
+                    mpi.PROD: ref.prod(0, dtype=dt)}[op]
+            if not np.array_equal(out, want):
+                errs += 1
+                print(f"rank {r_}: allreduce {_name} {dt.__name__} wrong")
+    # reduce to every root; bcast from every root; barriers interleaved
+    for root in range(n):
+        s = np.full(9, r_ + 2, np.int64)
+        out = np.zeros(9, np.int64)
+        c.reduce(s, out, mpi.SUM, root)
+        if r_ == root and not np.all(out == sum(x + 2 for x in range(n))):
+            errs += 1
+            print(f"rank {r_}: reduce root={root} wrong")
+        b = np.full(33, root + 7, np.int32) if r_ == root \
+            else np.zeros(33, np.int32)
+        c.bcast(b, root)
+        if not np.all(b == root + 7):
+            errs += 1
+            print(f"rank {r_}: bcast root={root} wrong")
+        c.barrier()
+
+
+sweep(comm)
+
+dup = comm.dup()
+sweep(dup)
+dup.free()
+
+if size >= 2:
+    half = comm.split(rank % 2, rank)
+    sweep(half)
+    half.free()
+    # context reuse: the freed id returns; renumbering must be clean
+    half2 = comm.split(rank % 2, rank)
+    sweep(half2)
+    half2.free()
+
+# the flat tier must actually have carried the small ops
+pch = getattr(comm.u, "plane_channel", None)
+if pch is not None and pch.plane and pch._ring.lib.cp_flat_ok(pch.plane):
+    flat = pch.fp_counter(6)    # FPC_COLL_FLAT
+    if flat < 10:
+        errs += 1
+        print(f"rank {rank}: flat tier not exercised (fp_coll_flat={flat})")
+
+total = np.zeros(1, np.int32)
+comm.allreduce(np.full(1, errs, np.int32), total)
+if rank == 0:
+    print("No Errors" if total[0] == 0 else f"{total[0]} errors")
+mpi.Finalize()
+sys.exit(1 if total[0] else 0)

@@ -1,0 +1,60 @@
+"""Rank program: the fast-path observability counters (fastpath.c /
+cp_flat_* via cp_fp_counter) are observable through an MPI_T pvar
+session while the job runs — the regression tripwire the r5 verdict
+asked for: a silent fast-path stand-down now shows as fp_fallback_*
+moving while fp_coll_flat stays flat.
+
+Launched via: python -m mvapich2_tpu.run -np 2 tests/progs/fp_pvar_prog.py
+"""
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from mvapich2_tpu import mpi, mpit  # noqa: E402
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+rank, size = comm.rank, comm.size
+
+NAMES = ("fp_coll_flat", "fp_coll_sched", "fp_hits", "fp_gil_takes",
+         "fp_fallback_dtype", "fp_fallback_comm", "fp_fallback_size",
+         "fp_fallback_plane", "fp_wait_spin", "fp_wait_bell")
+sess = mpit.pvar_session_create()
+handles = {n: sess.handle_alloc(n) for n in NAMES}
+for h in handles.values():
+    sess.start(h)
+
+sbuf = np.arange(16, dtype=np.int32)
+rbuf = np.zeros(16, dtype=np.int32)
+for _ in range(5):
+    comm.allreduce(sbuf, rbuf)
+comm.barrier()
+
+errs = 0
+pch = getattr(comm.u, "plane_channel", None)
+if pch is not None and pch.plane:
+    flat = sess.read(handles["fp_coll_flat"])
+    if pch._ring.lib.cp_flat_ok(pch.plane):
+        # 5 allreduces + 1 barrier rode the flat-slot tier
+        if flat < 6:
+            errs += 1
+            print(f"rank {rank}: fp_coll_flat did not move ({flat})")
+    elif flat != 0:
+        errs += 1
+        print(f"rank {rank}: flat tier off but fp_coll_flat={flat}")
+    for n in NAMES:
+        if sess.read(handles[n]) < 0:
+            errs += 1
+            print(f"rank {rank}: {n} negative")
+else:
+    print(f"rank {rank}: (no native plane; fp pvars not exercised)")
+
+for h in handles.values():
+    sess.handle_free(h)
+
+if rank == 0 and errs == 0:
+    print("No Errors")
+mpi.Finalize()
+sys.exit(1 if errs else 0)

@@ -32,6 +32,28 @@ def _mpirun(np_, prog, *args, timeout=240):
                           timeout=timeout)
 
 
+def test_cshim_bootstrap_stays_light():
+    """The C-ABI bootstrap (libmpi.so embedding -> import cshim) must
+    never pull the device layer: jax et al. cost seconds of MPI_Init
+    wall time on a cold host (r5 measured 3.0 s) for jobs that never
+    touch a device. bin/bench_osu enforces the wall-clock budget; this
+    guards the import graph itself."""
+    code = (
+        "import sys\n"
+        "import mvapich2_tpu.cshim\n"
+        "heavy = [m for m in ('jax', 'jaxlib', 'mvapich2_tpu.ops',\n"
+        "                     'mvapich2_tpu.parallel',\n"
+        "                     'mvapich2_tpu.models',\n"
+        "                     'mvapich2_tpu.coll.device')\n"
+        "         if m in sys.modules]\n"
+        "print('HEAVY=' + ','.join(heavy))\n")
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "HEAVY=\n" in r.stdout or r.stdout.strip().endswith("HEAVY="), \
+        f"heavy modules on the C-ABI bootstrap path: {r.stdout}"
+
+
 def test_cabi_conformance_prog():
     out = os.path.join(tempfile.mkdtemp(), "cabi_test")
     _compile([os.path.join(REPO, "tests", "progs", "cabi_test.c")], out)

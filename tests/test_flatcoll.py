@@ -1,0 +1,68 @@
+"""Flat-slot collective tier correctness (ISSUE 5 tentpole coverage).
+
+Two sweeps of the same surface — allreduce/reduce/bcast/barrier across
+ops x dtypes x sizes straddling every protocol boundary (flat payload
+max 4 KiB, the eager size, FP_COLL_MAX), over world + dup'd + split +
+context-reused comms:
+
+- flatcoll_test.c through the unmodified C ABI (fastpath.c dispatch),
+- flatpy_sweep_prog.py through the python API (coll/flatcoll.py),
+
+both against the ONE cp_flat_* engine in cplane.cpp. np in {2, 3, 4}
+runs tier-1; np=8 (the tier's nslots ceiling) rides the slow lane.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MPICC = os.path.join(REPO, "bin", "mpicc")
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("gcc") is None or shutil.which("python3-config") is None,
+    reason="no C toolchain")
+
+
+def _mpirun(np_, *cmd, timeout=420):
+    r = subprocess.run([sys.executable, "-m", "mvapich2_tpu.run", "-np",
+                        str(np_), *cmd], cwd=REPO, capture_output=True,
+                       text=True, timeout=timeout)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout, f"stdout={r.stdout}\nstderr={r.stderr}"
+
+
+@pytest.fixture(scope="module")
+def flat_c_prog():
+    out = os.path.join(tempfile.mkdtemp(), "flatcoll_test")
+    src = os.path.join(REPO, "tests", "progs", "flatcoll_test.c")
+    r = subprocess.run([MPICC, src, "-o", out], capture_output=True,
+                       text=True, timeout=180)
+    assert r.returncode == 0, f"mpicc failed:\n{r.stdout}\n{r.stderr}"
+    return out
+
+
+@pytest.mark.parametrize("np_", [2, 3, 4])
+def test_flat_sweep_cabi(flat_c_prog, np_):
+    _mpirun(np_, flat_c_prog)
+
+
+@pytest.mark.slow
+def test_flat_sweep_cabi_np8(flat_c_prog):
+    _mpirun(8, flat_c_prog, timeout=600)
+
+
+@pytest.mark.parametrize("np_", [2, 4])
+def test_flat_sweep_python(np_):
+    prog = os.path.join(REPO, "tests", "progs", "flatpy_sweep_prog.py")
+    _mpirun(np_, sys.executable, prog)
+
+
+@pytest.mark.slow
+def test_flat_sweep_python_np3(py=3):
+    prog = os.path.join(REPO, "tests", "progs", "flatpy_sweep_prog.py")
+    _mpirun(3, sys.executable, prog)

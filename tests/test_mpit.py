@@ -254,6 +254,46 @@ def test_timestamps_disabled_no_overhead():
     assert "should_not_record" not in ts.render()
 
 
+def test_fastpath_category():
+    """The fast-path observability counters (ISSUE 5 satellite)
+    enumerate under category "fastpath": hit/fallback/wait-outcome
+    counters shared by the C ABI's fastpath.c and the python flat
+    collective tier, plus the FP_COLL_MAX collective-tier cap cvar
+    under "coll"."""
+    import mvapich2_tpu.transport.shm  # noqa: F401  (declares fp pvars)
+    cats = mpit.category_names()
+    assert "fastpath" in cats
+    info = mpit.category_get_info(cats.index("fastpath"))
+    for pv in ("fp_hits", "fp_gil_takes", "fp_fallback_dtype",
+               "fp_fallback_comm", "fp_fallback_size",
+               "fp_fallback_plane", "fp_coll_flat", "fp_coll_sched",
+               "fp_wait_spin", "fp_wait_bell", "fp_flat_progress"):
+        assert pv in info["pvars"], pv
+        assert mpit._pvars.get(pv).klass == mpit.PVAR_CLASS_COUNTER
+    cinfo = mpit.category_get_info(cats.index("coll"))
+    assert "FP_COLL_MAX" in cinfo["cvars"]
+
+
+def test_fastpath_pvars_observable():
+    """The fast-path counters move for a real flat-tier workload (the
+    plane only exists in process mode, so this drives the launcher)."""
+    import subprocess
+    import sys as _sys
+    from mvapich2_tpu.transport.shm import _load_native
+    if _load_native() is None:
+        import pytest
+        pytest.skip("native plane unavailable")
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prog = os.path.join(repo, "tests", "progs", "fp_pvar_prog.py")
+    r = subprocess.run([_sys.executable, "-m", "mvapich2_tpu.run", "-np",
+                        "2", _sys.executable, prog], cwd=repo,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+    assert "did not move" not in r.stdout
+
+
 def test_plane_pvars_observable():
     """The C plane's counters (cp_stats) surface as MPI_T pvars — the
     fast-path hit-rate for a workload is observable through a session
