@@ -31,7 +31,11 @@ from typing import Dict, List, Optional, Set
 
 from .core import Finding, LintPass, SourceModule, attr_chain
 
-_REGISTRARS = {"register_handler", "register_hook", "add_callback"}
+# register_liveness: the failure-containment probes run at blocking
+# waits' sleep points — a sleep/blocking call inside one stalls every
+# wait in the process, so they are handler-context code too
+_REGISTRARS = {"register_handler", "register_hook", "add_callback",
+               "register_liveness"}
 _BLOCKING_NAMES = {"recv", "probe", "barrier", "progress_wait"}
 
 

@@ -34,7 +34,7 @@ INTERNAL_ENV: Set[str] = {
     "MV2T_WORLD_BASE", "MV2T_SPAWN_CTX", "MV2T_APPNUM",
     "MV2T_PARENT_RANKS", "MV2T_RANK_PLATFORM", "MV2T_PLATFORM_EXPLICIT",
     "MV2T_VPOD_CHILD", "MV2T_VPOD_REAL", "MV2T_TEST_ON_TPU",
-    "MV2T_TEST_FULL",
+    "MV2T_TEST_FULL", "MV2T_FT_WATCHER",
 }
 INTERNAL_PREFIXES = ("MV2T_DEBUG_", "MV2T_STASH_")
 

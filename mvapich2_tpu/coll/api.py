@@ -83,6 +83,19 @@ def _plane_engine(comm):
     if pch is None or not pch.plane or comm.is_inter \
             or not getattr(comm, "_plane_owned", False):
         return None
+    # graceful tier degradation (failure containment): once this comm is
+    # revoked or has a failed member, the python tier owns the operation
+    # — its ULFM semantics raise MPIX_ERR_PROC_FAILED/REVOKED uniformly
+    # instead of entering a flat wave or C schedule some members will
+    # never join. A member that races ahead of the detection still
+    # unwinds: the dead peer's lease expires inside its flat wait /
+    # wait quantum (-2) and the C gather checks per-member failure.
+    if comm.revoked:
+        return None
+    if comm.u.failed_ranks:
+        from ..ft.ulfm import ft_members
+        if any(w in comm.u.failed_ranks for w in ft_members(comm)):
+            return None
     return pch
 
 

@@ -125,6 +125,11 @@ def _build_state(comm, pch) -> Optional[_FlatComm]:
     lib = pch._ring.lib
     if lib is None or not pch.plane:
         return None
+    if lib.cp_any_failed(pch.plane):
+        # post-failure degradation: new comms never key flat regions
+        # (every in-flight wave aborts on g_any_failed anyway); the
+        # sched/python tiers own collectives until the process quiesces
+        return None
     if comm.size < 2 or comm.size > lib.cp_flat_nslots():
         return None
     if not lib.cp_flat_ok(pch.plane):
@@ -148,11 +153,51 @@ def _build_state(comm, pch) -> Optional[_FlatComm]:
                      int(lib.cp_flat_payload_max()))
 
 
-def _raise_rc(st, comm, rc):
+def _raise_rc(st, comm, rc) -> bool:
+    """Handle a failed flat wave (rc -2 peer failure / -3 stall). The
+    region is already sticky-poisoned by the C side (flat_fail) and the
+    comm's tier is closed here in all cases.
+
+    Outcome depends on WHOSE failure tore the wave. g_any_failed is
+    process-global, so a death anywhere aborts every in-flight wave —
+    including waves of comms the dead rank was never a member of. The
+    wave verdict is consistent across members (the leader decides
+    before stamping the broadcast block: either every member completes
+    or every member fails with its send data intact), so:
+
+      * a failed MEMBER -> raise (typed PeerDeadError when the lease is
+        readable, else plain MPIX_ERR_PROC_FAILED) — ULFM semantics;
+      * an UNRELATED failure (rc -2, no member failed) -> return False:
+        the caller falls through to the scheduled tier and the
+        collective completes there. Without this, one SIGKILL made
+        every OTHER comm's next flat collective error — which broke
+        the recovery path itself (shrink -> spawn -> merge runs
+        collectives on healthy comms).
+
+    Returns False for "degrade and retry"; raises otherwise."""
     st.poison(comm)
+    pch = getattr(comm.u, "plane_channel", None)
+    if pch is not None and pch.plane:
+        try:
+            # the C lease scan may have been the detector: reconcile its
+            # marks into universe.failed_ranks before deciding
+            pch._reconcile_plane_failures()
+        except Exception:
+            pass
+    from ..ft.ulfm import ft_members
+    dead = next((w for w in ft_members(comm)
+                 if w in comm.u.failed_ranks), None)
+    if dead is not None:
+        if pch is not None and dead in pch.local_index:
+            from ..core.errors import PeerDeadError
+            age = pch.lease_age(dead)
+            raise PeerDeadError(dead, age if age is not None else 0.0,
+                                "flat collective")
+        raise MPIException(
+            MPIX_ERR_PROC_FAILED,
+            f"peer failure during flat collective (world rank {dead})")
     if rc == -2:
-        raise MPIException(MPIX_ERR_PROC_FAILED,
-                           "peer failure during flat collective")
+        return False        # collateral abort: sched tier retries
     raise MPIException(MPI_ERR_INTERN,
                        f"flat collective failed (rc {rc})")
 
@@ -185,6 +230,7 @@ def try_allreduce(pch, comm, arr: np.ndarray, op) -> Optional[np.ndarray]:
         arr.size, arr.itemsize)
     if rc != 0:
         _raise_rc(st, comm, rc)
+        return None     # collateral abort: fall through to sched tier
     return out
 
 
@@ -211,6 +257,7 @@ def try_reduce(pch, comm, arr: np.ndarray, op,
         _ptr(out) if out is not None else 0, arr.size, arr.itemsize)
     if rc != 0:
         _raise_rc(st, comm, rc)
+        return False, None   # collateral abort: sched tier retries
     return True, out
 
 
@@ -235,6 +282,7 @@ def try_bcast(pch, comm, data: np.ndarray, root: int) -> bool:
                            "bcast length mismatch across ranks")
     if rc != 0:
         _raise_rc(st, comm, rc)
+        return False        # collateral abort: sched tier retries
     return True
 
 
@@ -250,4 +298,5 @@ def try_barrier(pch, comm) -> bool:
                                 st.size, ctypes.c_longlong(seq))
     if rc != 0:
         _raise_rc(st, comm, rc)
+        return False        # collateral abort: sched tier retries
     return True

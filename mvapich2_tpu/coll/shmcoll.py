@@ -203,20 +203,48 @@ class ShmCollSegment:
         # (atexit); a SIGKILLed job leaves the file to the tmp reaper
 
     # -- polling ---------------------------------------------------------
-    @staticmethod
-    def _wait(pred) -> None:
+    def _wait(self, pred) -> None:
         """Spin briefly, then yield the core, then sleep. On an
         oversubscribed host the yield matters most: a hot 1024-spin loop
         before the first sleep burns the very quantum the peer needs to
-        make the predicate true."""
+        make the predicate true.
+
+        Failure containment: the counter we wait on is advanced by a
+        specific peer — if that peer is SIGKILLed it never will be. The
+        slow path runs the liveness probe (peers' heartbeat leases vs
+        MV2T_PEER_TIMEOUT) and unwinds with MPIX_ERR_PROC_FAILED as
+        soon as any member of this shmem comm is known failed (or
+        MPIX_ERR_REVOKED once the comm is revoked) — section reads are
+        gated by these waits, so a torn exchange can never surface as
+        wrong data. The raw 120 s stall timeout remains as the
+        last-resort backstop for live-but-wedged peers."""
+        from ..core.errors import (MPIException, MPIX_ERR_PROC_FAILED,
+                                   MPIX_ERR_REVOKED)
         deadline = None
         spins = 0
+        u = self.comm.u
+        sch = getattr(u, "shm_channel", None)
         while not pred():
             spins += 1
             if spins < 64:
                 continue
             if spins & 7 == 0:
                 os.sched_yield()
+            if spins & 0xFF == 0:
+                if sch is not None \
+                        and getattr(sch, "_peer_timeout", 0) > 0:
+                    sch.check_peer_leases()   # throttled internally
+                if self.comm.revoked:
+                    raise MPIException(
+                        MPIX_ERR_REVOKED,
+                        "communicator revoked during shm-segment "
+                        "collective")
+                if u.failed_ranks and any(
+                        w in u.failed_ranks
+                        for w in self.comm.group.world_ranks):
+                    raise MPIException(
+                        MPIX_ERR_PROC_FAILED,
+                        "peer failure during shm-segment collective")
             if spins & 0xFFF == 0:
                 if deadline is None:
                     deadline = time.monotonic() + _POLL_TIMEOUT

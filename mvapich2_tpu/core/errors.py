@@ -74,6 +74,23 @@ class MPIException(Exception):
         return " <- ".join(reversed(self.stack)) if self.stack else str(self)
 
 
+class PeerDeadError(MPIException):
+    """A peer's liveness lease expired while we depended on it.
+
+    Raised by the failure-containment layer (transport leases +
+    deadline waits) and carried as MPIX_ERR_PROC_FAILED on the MPI
+    surface; the typed subclass lets chaos tests and recovery code
+    distinguish a lease-detected death from a launcher-reported one."""
+
+    def __init__(self, world_rank: int, age_s: float, where: str = ""):
+        self.world_rank = world_rank
+        self.age_s = age_s
+        super().__init__(
+            MPIX_ERR_PROC_FAILED,
+            f"peer world rank {world_rank} lease expired "
+            f"({age_s:.2f}s stale{': ' + where if where else ''})")
+
+
 def error_class_name(klass: int) -> str:
     return _CLASS_NAMES.get(klass, f"MPI_ERR_<{klass}>")
 

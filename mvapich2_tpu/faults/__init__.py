@@ -1,0 +1,196 @@
+"""Deterministic fault-injection engine (the test/mpi/ft die.c analog,
+grown into a first-class subsystem).
+
+The reference proves its failure stack with launcher-driven kill tests;
+this module makes peer death (and the messier failure modes around it)
+a *reproducible input*: named injection sites in the datapath consult a
+seeded spec parsed from ``MV2T_FAULTS`` and fire deterministically on
+the nth eligible event.
+
+Grammar (comma-separated specs)::
+
+    MV2T_FAULTS=<site>[@<world-rank>]:<kind>[:<seed>[:<nth>[+]]]
+
+    site  shm_send | shm_recv | arena_alloc | rndv_chunk | kvs
+          | flat_fold  (handled natively in cplane.cpp so the C-ABI
+          hot path injects without an interpreter round-trip)
+    kind  drop | delay | duplicate | truncate | crash
+    seed  seeds the per-spec RNG (delay durations); default 0
+    nth   fire on the nth eligible event at the site (1-based,
+          default 1); a trailing ``+`` keeps firing from the nth on
+
+``@rank`` scopes the spec to one world rank (default: every rank —
+rarely what a chaos test wants for ``crash``).
+
+Kind semantics are site-interpreted: ``crash`` is applied here
+(``os._exit(17)`` — SIGKILL-equivalent from the peers' point of view:
+no Finalize, no departed-lease stamp), ``delay`` sleeps a seeded 1-20 ms
+inline, and ``drop``/``duplicate``/``truncate`` are returned to the
+call site, which applies the transport-specific meaning (a dropped
+arena_alloc is a simulated exhaustion; a dropped shm_send is a lost
+packet). ``drop``/``truncate`` on transport sites model *unrecoverable*
+corruption — there is no retransmission layer — so the automated chaos
+matrix (tests/test_faults.py) sticks to the terminating kinds and
+leaves those two for interactive hunting.
+
+Zero cost when off: every site calls ``fire(site)``, which returns
+immediately while no spec is configured.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .. import mpit
+from ..utils.config import cvar, get_config
+from ..utils.mlog import get_logger
+
+log = get_logger("faults")
+
+cvar("FAULTS", "", str, "ft",
+     "Deterministic fault-injection spec(s): "
+     "site[@rank]:kind[:seed[:nth[+]]], comma-separated. Sites: "
+     "shm_send shm_recv arena_alloc rndv_chunk kvs flat_fold; kinds: "
+     "drop delay duplicate truncate crash. Empty = engine off "
+     "(zero hot-path cost).")
+cvar("FAULT_DELAY_MS", 0.0, float, "ft",
+     "Fixed delay in ms for the 'delay' kind (0 = seeded 1-20 ms).")
+
+SITES = ("shm_send", "shm_recv", "arena_alloc", "rndv_chunk", "kvs",
+         "flat_fold")
+KINDS = ("drop", "delay", "duplicate", "truncate", "crash")
+
+# containment observability (predeclared in mpit.py so tools enumerate
+# them before any datapath import; fetched-by-name here)
+pv_injected = mpit.pvar("faults_injected", mpit.PVAR_CLASS_COUNTER, "ft",
+                        "faults fired by the MV2T_FAULTS engine "
+                        "(python-side sites)")
+pv_dead_peer = mpit.pvar("dead_peer_detections", mpit.PVAR_CLASS_COUNTER,
+                         "ft", "peers declared dead by liveness-lease "
+                         "expiry (python probe + C-plane scans)")
+pv_deadline = mpit.pvar("wait_deadline_trips", mpit.PVAR_CLASS_COUNTER,
+                        "ft", "blocking waits unwound by a lease "
+                        "deadline instead of completing")
+
+
+class FaultSpec:
+    __slots__ = ("site", "rank", "kind", "seed", "nth", "repeat",
+                 "count", "rng")
+
+    def __init__(self, site: str, rank: Optional[int], kind: str,
+                 seed: int, nth: int, repeat: bool):
+        self.site = site
+        self.rank = rank        # None = every rank
+        self.kind = kind
+        self.seed = seed
+        self.nth = nth
+        self.repeat = repeat
+        self.count = 0          # eligible events seen (guarded-by: _lock)
+        self.rng = random.Random(seed)
+
+    def __repr__(self):
+        at = f"@{self.rank}" if self.rank is not None else ""
+        plus = "+" if self.repeat else ""
+        return (f"FaultSpec({self.site}{at}:{self.kind}:{self.seed}"
+                f":{self.nth}{plus})")
+
+
+def parse(text: str) -> List[FaultSpec]:
+    """Parse a MV2T_FAULTS string; raises ValueError on bad specs."""
+    specs: List[FaultSpec] = []
+    for raw in text.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = raw.split(":")
+        if len(parts) < 2:
+            raise ValueError(f"bad fault spec {raw!r}: need site:kind")
+        site, rank = parts[0], None
+        if "@" in site:
+            site, r = site.split("@", 1)
+            rank = int(r)
+        if site not in SITES:
+            raise ValueError(f"bad fault site {site!r} (know {SITES})")
+        kind = parts[1]
+        if kind not in KINDS:
+            raise ValueError(f"bad fault kind {kind!r} (know {KINDS})")
+        seed = int(parts[2]) if len(parts) > 2 and parts[2] else 0
+        nth_s = parts[3] if len(parts) > 3 and parts[3] else "1"
+        repeat = nth_s.endswith("+")
+        nth = int(nth_s.rstrip("+") or 1)
+        if nth < 1:
+            raise ValueError(f"bad fault nth {nth_s!r} (1-based)")
+        specs.append(FaultSpec(site, rank, kind, seed, nth, repeat))
+    return specs
+
+
+# site -> specs scoped to this rank; None while unconfigured/off —
+# fire() is a single attribute test in that state
+_active: Optional[Dict[str, List[FaultSpec]]] = None
+_lock = threading.Lock()
+
+
+def configure(world_rank: int) -> int:
+    """(Re)build the active spec table for this rank from the FAULTS
+    cvar — called from Universe.initialize after the config reload.
+    Returns how many specs are armed here. ``flat_fold`` specs are
+    listed for visibility but fire natively (cplane.cpp parses the
+    same env var), so they are never armed on the python side."""
+    global _active
+    text = str(get_config().get("FAULTS", "") or "")
+    if not text:
+        _active = None
+        return 0
+    table: Dict[str, List[FaultSpec]] = {}
+    for spec in parse(text):
+        if spec.rank is not None and spec.rank != world_rank:
+            continue
+        if spec.site == "flat_fold":
+            continue            # native site (cplane.cpp flat_fault)
+        table.setdefault(spec.site, []).append(spec)
+    _active = table if table else None
+    if _active:
+        log.info("fault engine armed on rank %d: %s", world_rank,
+                 [s for ss in table.values() for s in ss])
+    return sum(len(v) for v in table.values())
+
+
+def deconfigure() -> None:
+    global _active
+    _active = None
+
+
+def fire(site: str) -> Optional[str]:
+    """Count one eligible event at ``site``; returns the fault kind when
+    a spec fires (after applying crash/delay inline), else None."""
+    table = _active
+    if table is None:
+        return None
+    specs = table.get(site)
+    if not specs:
+        return None
+    for spec in specs:
+        with _lock:
+            spec.count += 1
+            hit = spec.count == spec.nth or \
+                (spec.repeat and spec.count > spec.nth)
+            delay_s = 0.0
+            if hit and spec.kind == "delay":
+                fixed = float(get_config().get("FAULT_DELAY_MS", 0.0))
+                delay_s = (fixed / 1e3) if fixed > 0 \
+                    else (0.001 + spec.rng.random() * 0.019)
+        if not hit:
+            continue
+        pv_injected.inc()
+        if spec.kind == "crash":
+            log.warn("fault engine: crash-self at %s (event %d)",
+                     site, spec.count)
+            os._exit(17)
+        if spec.kind == "delay":
+            time.sleep(delay_s)
+        return spec.kind
+    return None

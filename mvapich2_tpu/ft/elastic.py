@@ -37,8 +37,9 @@ def rebuild_world(comm: Comm,
     ``Comm_get_parent().merge(high=True)``."""
     from ..runtime.spawn import comm_spawn
     if not comm.revoked:
-        comm.revoke()
+        comm.revoke()               # also sticky-poisons the flat region
     shrunk = comm.shrink()
+    _rekey_flat(comm, shrunk)
     lost = comm.size - shrunk.size
     if lost == 0:
         log.info("rebuild_world: no failures; returning shrunk dup")
@@ -49,3 +50,31 @@ def rebuild_world(comm: Comm,
     merged = inter.merge(high=False)
     merged.set_name("rebuilt_world")
     return merged, lost
+
+
+def _rekey_flat(old: Comm, shrunk: Comm) -> None:
+    """Re-key the flat-slot tier after shrink (failure containment).
+
+    The revoked comm's region is sticky-poisoned (ft/ulfm._poison_flat +
+    the C side's flat_fail), so nothing can reuse its torn seqlock
+    counters. The shrunken comm carries an agreed FRESH context id and
+    must build its own flat state from scratch — including the lane:
+    lane = min member ring index, so when the failed rank WAS the
+    flat-tier leader (lowest ring index) the survivors' lane moves to
+    the next-lowest member and lands in a different, healthy region.
+    Dropping any inherited cache here makes that re-derivation explicit
+    and guards against a future Comm-construction path copying cached
+    tier state across shrink."""
+    shrunk.__dict__.pop("_flat_state", None)
+    shrunk.__dict__.pop("_plane_mixed", None)
+    pch = getattr(old.u, "plane_channel", None)
+    st = old.__dict__.get("_flat_state")
+    if pch is None or not pch.plane or not st:
+        return
+    lib = pch._ring.lib
+    if not lib.cp_flat_poisoned(pch.plane, st.ctx, st.lane):
+        # belt-and-braces: revoke should have poisoned it already
+        lib.cp_flat_poison_region(pch.plane, st.ctx, st.lane)
+    log.info("rekey_flat: old (ctx=%d, lane=%d) poisoned; shrunken comm "
+             "ctx=%d re-derives its lane from surviving membership",
+             st.ctx, st.lane, shrunk.ctx_coll)

@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Set
 
 import numpy as np
 
+from .. import mpit as _mpit
 from ..core import datatype as dtmod
 from ..core.errors import (MPIException, MPIX_ERR_PROC_FAILED,
                            MPIX_ERR_REVOKED)
@@ -44,6 +45,14 @@ from ..transport.base import Packet, PktType
 from ..utils.mlog import get_logger
 
 log = get_logger("ft")
+
+_pv_revokes = _mpit.pvar("revokes_propagated", _mpit.PVAR_CLASS_COUNTER,
+                         "ft", "REVOKE floods sent by this rank "
+                         "(initiations + re-floods on first receipt)")
+_pv_reclaimed = _mpit.pvar("arena_reclaimed_dead",
+                           _mpit.PVAR_CLASS_COUNTER, "shm",
+                           "arena blocks/segments reclaimed from dead "
+                           "ranks")
 
 # tag space reserved for the FT agreement protocol — far above the
 # collective sequencer's 15-bit window (core/comm.py next_coll_tag)
@@ -178,9 +187,13 @@ def _fail_dependent_recvs(universe, world_rank: int) -> None:
     # wrappers raise it from _finalize; C waiters map the errclass.
     _fail_plane_recvs(universe, world_rank)
     # rendezvous in flight: tracked sends to the dead rank and matched
-    # recvs whose data must come from it
+    # recvs whose data must come from it. A send's arena pipeline block
+    # and RGET exposure will never see their FIN — release them NOW so
+    # the dead peer's in-flight slots return to the arena instead of
+    # leaking to Finalize (counted via arena_reclaimed_dead).
     for req in list(universe.engine.outstanding.values()):
         if getattr(req, "dest_world", None) == world_rank:
+            _reclaim_send_side(universe, req)
             req.complete(MPIException(
                 MPIX_ERR_PROC_FAILED,
                 f"rendezvous send peer (world rank {world_rank}) failed"))
@@ -194,6 +207,20 @@ def _fail_dependent_recvs(universe, world_rank: int) -> None:
                     MPIX_ERR_PROC_FAILED,
                     f"rendezvous data source (world rank "
                     f"{world_rank}) failed"))
+
+
+def _reclaim_send_side(universe, req) -> None:
+    """Release a failed-peer send's arena/exposure resources (the FIN
+    that would have released them is never coming)."""
+    had = (getattr(req, "_ap", None) is not None
+           or getattr(req, "handle", None) is not None)
+    if not had:
+        return
+    try:
+        universe.protocol._release_send_side(req)
+        _pv_reclaimed.inc()
+    except Exception:   # reclamation must never mask the failure path
+        log.warn("send-side reclaim failed for %r", req, exc_info=True)
 
 
 def comm_failed_world(comm) -> List[int]:
@@ -234,11 +261,33 @@ def revoke(comm) -> None:
             return
         comm.revoked = True
         _fail_ctx_recvs(u, comm)
+    _poison_flat(u, comm)
     _flood_revoke(u, comm)
     u.engine.wakeup()
 
 
+def _poison_flat(u, comm) -> None:
+    """Sticky-poison the revoked comm's flat-slot region (failure
+    containment): its seqlock counters may be torn mid-wave, so no
+    comm that later reuses this (ctx, lane) may key the region —
+    cp_flat_base returns -1 and the reuser degrades to the scheduled
+    tier. Recovery re-keys on the shrunken comm's FRESH context id
+    instead (ft/elastic.py), which maps a healthy region. Also closes
+    the C-ABI side through the existing mv2t_fp_flat_poison path."""
+    st = comm.__dict__.get("_flat_state")
+    if not st:
+        return
+    pch = getattr(u, "plane_channel", None)
+    try:
+        if pch is not None and pch.plane:
+            pch._ring.lib.cp_flat_poison_region(pch.plane, st.ctx, st.lane)
+        st.poison(comm)
+    except Exception:
+        comm._flat_state = False
+
+
 def _flood_revoke(u, comm) -> None:
+    _pv_revokes.inc()       # one propagation event (initiation/re-flood)
     for r in range(comm.size):
         w = comm.world_of(r)
         if w == u.world_rank or w in u.failed_ranks:
@@ -258,6 +307,7 @@ def _on_revoke(u, pkt: Packet) -> None:
         return
     comm.revoked = True
     _fail_ctx_recvs(u, comm)
+    _poison_flat(u, comm)
     _flood_revoke(u, comm)   # re-flood once; `revoked` guards against storms
     u.engine.wakeup()
 
@@ -275,6 +325,57 @@ def _fail_ctx_recvs(u, comm) -> None:
             matcher.posted.remove(req)
             req.complete(MPIException(MPIX_ERR_REVOKED,
                                       "communicator revoked"))
+    # pending SENDS on the revoked contexts unwind too (ULFM: revoke
+    # fails pending AND future ops, both directions): a survivor
+    # blocked in a rendezvous send whose receiver erred out of the
+    # collective pattern and moved on to recovery would otherwise wait
+    # for a FIN that is never coming — no failure fires for it (the
+    # receiver is alive, maybe even already departed cleanly), so
+    # neither the lease scan nor the failure sweep can save it. Found
+    # by the chaos suite: rndv ring, victim's neighbor revokes+shrinks
+    # +finalizes while the opposite neighbor still waits on its FIN.
+    for req in list(u.engine.outstanding.values()):
+        if req.kind == "send" and not req.complete_flag \
+                and getattr(req, "_ctx", None) in (comm.ctx_pt2pt,
+                                                   comm.ctx_coll):
+            _reclaim_send_side(u, req)
+            req.complete(MPIException(MPIX_ERR_REVOKED,
+                                      "communicator revoked"))
+    # plane-posted receives + CMA rendezvous sends on the revoked
+    # contexts (same rules, applied to the C engine's request table): a
+    # survivor blocked in a C-matched recv from a LIVE peer that
+    # diverted to recovery hangs without this.
+    pch = getattr(u, "plane_channel", None)
+    if pch is None or not pch.plane:
+        return
+    import ctypes as ct
+    lib = pch._ring.lib
+    to_fail = []
+    i = 0
+    while True:
+        rid = ct.c_longlong()
+        ctx = ct.c_int()
+        src = ct.c_int()
+        tag = ct.c_int()
+        if lib.cp_posted_get(pch.plane, i, rid, ctx, src, tag) != 0:
+            break
+        i += 1
+        if ctx.value in (comm.ctx_pt2pt, comm.ctx_coll) \
+                and tag.value < _FT_TAG_BASE:
+            to_fail.append(rid.value)
+    for rid in to_fail:
+        lib.cp_error_req(pch.plane, rid, MPIX_ERR_REVOKED)
+        req = pch._plane_recvs.get(rid)
+        if req is not None:
+            req._poll_plane()
+    # CMA sends tracked through the plane-recv table (CPlaneSendRequest)
+    for rid, req in list(pch._plane_recvs.items()):
+        if req is not None and req.kind == "send" \
+                and not req.complete_flag \
+                and getattr(req, "_ctx", None) in (comm.ctx_pt2pt,
+                                                   comm.ctx_coll):
+            lib.cp_error_req(pch.plane, rid, MPIX_ERR_REVOKED)
+            req._poll_plane()
 
 
 # ---------------------------------------------------------------------------

@@ -282,6 +282,27 @@ pvar("lockcheck_cycles", PVAR_CLASS_COUNTER, "analysis",
      "distinct lock-order cycles (potential deadlocks) reported by the "
      "MV2T_LOCKCHECK monitor")
 
+# ---------------------------------------------------------------------------
+# failure-containment observability (mvapich2_tpu/faults + ft/ulfm).
+# Predeclared so tools enumerate them before the datapath imports; the
+# owning modules fetch the same instances by name.
+# ---------------------------------------------------------------------------
+pvar("faults_injected", PVAR_CLASS_COUNTER, "ft",
+     "faults fired by the MV2T_FAULTS deterministic injection engine "
+     "(python-side sites; the native flat_fold site counts via "
+     "fp_dead_peer-adjacent plane counters)")
+pvar("dead_peer_detections", PVAR_CLASS_COUNTER, "ft",
+     "peers declared dead by liveness-lease expiry (python probe + "
+     "reconciled C-plane scans)")
+pvar("wait_deadline_trips", PVAR_CLASS_COUNTER, "ft",
+     "blocking waits unwound by a lease deadline instead of completing")
+pvar("revokes_propagated", PVAR_CLASS_COUNTER, "ft",
+     "REVOKE floods sent by this rank (initiations + re-floods on "
+     "first receipt, ft/ulfm.py)")
+pvar("arena_reclaimed_dead", PVAR_CLASS_COUNTER, "shm",
+     "arena blocks/segments reclaimed from dead ranks (failure sweep, "
+     "Finalize leak-check tolerance, stale-segment sweep)")
+
 
 # ---------------------------------------------------------------------------
 # the autotuner lives beside MPI_T (tools space): mpit.autotune

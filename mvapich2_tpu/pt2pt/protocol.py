@@ -394,10 +394,24 @@ class Pt2ptProtocol:
                     arr = np.asarray(datatype.pack(buf, count)) \
                         .view(np.uint8).reshape(-1)
                 sreq = SendRequest(self.engine, dest_world)
-                rc = pch._ring.lib.cp_send_eager(
-                    pch.plane, pch.local_index[dest_world], ctx, comm_src,
-                    tag, arr.ctypes.data if arr is not None else None,
-                    nbytes, sreq.req_id)
+                from .. import faults
+                fk = faults.fire("shm_send")   # plane eager is a
+                # send site too (send_packet only carries control/rndv
+                # traffic in plane mode)
+                if fk == "drop":
+                    rc = 0          # "sent" but lost on the wire
+                else:
+                    rc = pch._ring.lib.cp_send_eager(
+                        pch.plane, pch.local_index[dest_world], ctx,
+                        comm_src, tag,
+                        arr.ctypes.data if arr is not None else None,
+                        nbytes, sreq.req_id)
+                    if fk == "duplicate" and rc == 0:
+                        pch._ring.lib.cp_send_eager(
+                            pch.plane, pch.local_index[dest_world], ctx,
+                            comm_src, tag,
+                            arr.ctypes.data if arr is not None else None,
+                            nbytes, sreq.req_id)
                 if rc == -2:
                     from ..ft import ulfm
                     ulfm.mark_failed(self.u, dest_world)
@@ -460,6 +474,7 @@ class Pt2ptProtocol:
                 arr = np.asarray(datatype.pack(buf, count)) \
                     .view(np.uint8).reshape(-1)
             sreq = CPlaneSendRequest(self.engine, pch, arr)
+            sreq._ctx = ctx     # revoke sweep keys pending sends by ctx
             with self.engine.mutex:
                 rid = lib.cp_send_rndv(
                     pch.plane, pch.local_index[dest_world], ctx, comm_src,
@@ -487,6 +502,7 @@ class Pt2ptProtocol:
             # rid == -1: CMA raced off — fall through to staged rndv
         sreq = SendRequest(self.engine, dest_world)
         sreq.channel = channel
+        sreq._ctx = ctx         # revoke sweep keys pending sends by ctx
         packed = datatype.pack(buf, count)
         sreq.packed = np.asarray(packed)
         proto = self.cfg["RNDV_PROTOCOL"]
@@ -1073,11 +1089,17 @@ class Pt2ptProtocol:
                    "sreq_id": pkt.sreq_id, "channel": channel,
                    "arena": channel.arena,
                    "env": (pkt.comm_src, pkt.tag, total)}
+        # failure containment: the ULFM sweep recognizes in-flight
+        # rendezvous recvs by _rndv_env — without it a receiver parked
+        # mid-pipeline on a dead sender's next APUB hangs forever
+        req._rndv_env = (pkt.comm_src, pkt.tag, total)
         self.engine.track(req)
         self._apipe_drain(req, pkt.extra["pub"])
 
     def _apipe_drain(self, req: RecvRequest, upto: int) -> None:
+        from .. import faults
         from ..transport import arena as arena_mod
+        faults.fire("rndv_chunk")     # crash/delay mid-pipeline (drain)
         ap = req._ap
         tr = self.engine.tracer
         chunk, n = ap["chunk"], ap["n"]
@@ -1123,7 +1145,9 @@ class Pt2ptProtocol:
         self._apipe_drain(req, pkt.offset + 1)
 
     def _on_apipe_ack(self, pkt: Packet) -> None:
+        from .. import faults
         from ..transport import arena as arena_mod
+        faults.fire("rndv_chunk")     # crash/delay mid-pipeline (refill)
         sreq = self.engine.outstanding.get(pkt.sreq_id)
         if sreq is None or getattr(sreq, "_ap", None) is None:
             return

@@ -34,6 +34,11 @@ def bootstrap_from_env() -> Universe:
         rank, size = rm if rm is not None else (0, 1)
     kvs_addr = os.environ.get("MV2T_KVS")
     get_config().reload()
+    # arm the fault engine before the first KVS traffic so the
+    # bootstrap-exchange injection site (kvs) can fire; Universe.
+    # initialize re-runs configure (idempotent) for the local harness
+    from .. import faults
+    faults.configure(rank)
 
     if os.environ.get("MV2T_WORLD_BASE") is not None and kvs_addr:
         return _bootstrap_spawned(rank, size, kvs_addr)
@@ -76,7 +81,11 @@ def bootstrap_from_env() -> Universe:
         u.shm_channel.finish_wiring()
     u.initialize()
 
-    if os.environ.get("MV2T_FT") == "1":
+    if os.environ.get("MV2T_FT") == "1" \
+            and os.environ.get("MV2T_FT_WATCHER", "1") != "0":
+        # MV2T_FT_WATCHER=0: chaos tests disable the launcher-event
+        # watcher so a passing run proves the liveness LEASES detected
+        # the death, not the launcher
         _start_failure_watcher(u, kvs_addr)
     return u
 
@@ -152,7 +161,8 @@ def _bootstrap_spawned(local: int, size: int, kvs_addr: str) -> Universe:
     if local == 0:
         kvs.put(f"__spawn_ready_{base}",
                 json.dumps(names[base:base + size]))
-    if os.environ.get("MV2T_FT") == "1":
+    if os.environ.get("MV2T_FT") == "1" \
+            and os.environ.get("MV2T_FT_WATCHER", "1") != "0":
         _start_failure_watcher(u, kvs_addr)
     return u
 

@@ -158,9 +158,14 @@ class KVSClient:
         return json.loads(line)
 
     def put(self, key: str, val: str) -> None:
+        from .. import faults
+        if faults.fire("kvs") == "drop":
+            return            # lost bootstrap card: peers' get blocks
         self._rpc({"cmd": "put", "key": key, "val": val})
 
     def get(self, key: str) -> str:
+        from .. import faults
+        faults.fire("kvs")    # crash/delay mid-bootstrap-exchange
         r = self._rpc({"cmd": "get", "key": key})
         if not r.get("ok"):
             raise KeyError(key)

@@ -201,6 +201,17 @@ class Universe:
                 trace.watchdog.configure(self.engine)
                 from ..analysis import lockorder
                 lockorder.configure(self.engine)
+            with ts.phase("failure containment"):
+                # fault-injection engine (MV2T_FAULTS; no-op when unset)
+                # and the liveness probe: blocking waits check co-located
+                # peers' heartbeat leases so a dead peer unwinds the wait
+                # with MPIX_ERR_PROC_FAILED instead of hanging it
+                from .. import faults as faults_mod
+                faults_mod.configure(self.world_rank)
+                sch = self.shm_channel
+                if sch is not None \
+                        and getattr(sch, "_peer_timeout", 0) > 0:
+                    self.engine.register_liveness(sch.check_peer_leases)
             with ts.phase("protocol + matcher"):
                 self.protocol = Pt2ptProtocol(self)
                 from ..ft import ulfm

@@ -84,6 +84,26 @@ def build_report(engine) -> str:
     if lockcheck is not None:
         lines.append(lockcheck.report())
 
+    # failure-containment forensics: which peer went dark, and at which
+    # flat-protocol step. A deadline trip's report names the stale lease
+    # (age vs MV2T_PEER_TIMEOUT) and dumps per-slot seq numbers + fold
+    # epoch + poison flag for every comm on the flat tier, so a wedged
+    # wave reads as "slot 3 never stamped in_seq 17" instead of a blind
+    # stall.
+    pch = getattr(u, "plane_channel", None) if u is not None else None
+    if pch is not None:
+        try:
+            lines.append("## peer liveness leases (node-local, timeout "
+                         f"{getattr(pch, '_peer_timeout', 0)}s)")
+            for ln in pch.lease_report():
+                lines.append(f"  {ln}")
+        except Exception as e:
+            lines.append(f"## peer leases unavailable: {e!r}")
+        try:
+            lines.extend(_flat_report(u, pch))
+        except Exception as e:
+            lines.append(f"## flat-slot state unavailable: {e!r}")
+
     tracer = getattr(engine, "tracer", None)
     if tracer is not None:
         n = int(get_config().get("STALL_EVENTS", 64))
@@ -93,6 +113,47 @@ def build_report(engine) -> str:
             lines.append(f"  {ts:.6f} [{layer}] {name} {ph}"
                          f"{' ' + repr(args) if args else ''}")
     return "\n".join(lines)
+
+
+def _flat_report(u, pch) -> list:
+    """Per-comm flat-slot region state (slots' in/out seqs, fold epoch,
+    poison flag) for every live comm with flat-tier state."""
+    lines = []
+    lib = pch._ring.lib
+    if not pch.plane:
+        return lines
+    import ctypes as ct
+    shown = 0
+    for ctx, comm in sorted(u.comms_by_ctx.items()):
+        st = comm.__dict__.get("_flat_state")
+        if shown >= 8:
+            lines.append("  ... (more comms elided)")
+            break
+        if st is None:
+            continue
+        if st is False:
+            lines.append(f"## flat region for {comm.name} (ctx {ctx}): "
+                         "POISONED/closed for this comm")
+            shown += 1
+            continue
+        poi = lib.cp_flat_poisoned(pch.plane, st.ctx, st.lane)
+        base = lib.cp_flat_base(pch.plane, st.ctx, st.lane)
+        lines.append(f"## flat region {comm.name} (ctx {st.ctx}, lane "
+                     f"{st.lane}): fold epoch/bseq={base} "
+                     f"poison={bool(poi)} local_seq={st.base + st.k}")
+        i = ct.c_longlong()
+        o = ct.c_longlong()
+        for slot in range(st.size):
+            if lib.cp_flat_slot_state(pch.plane, st.ctx, st.lane, slot,
+                                      i, o) == 0:
+                lines.append(f"  slot {slot}: in_seq={i.value} "
+                             f"out_seq={o.value}")
+        if lib.cp_flat_slot_state(pch.plane, st.ctx, st.lane,
+                                  lib.cp_flat_nslots(), i, o) == 0:
+            lines.append(f"  bcast block: bseq={i.value} "
+                         f"last_nbytes={o.value}")
+        shown += 1
+    return lines
 
 
 def trip(engine) -> Optional[str]:

@@ -72,6 +72,10 @@ pv_cma_bytes = _mpit.pvar("rndv_cma_bytes", _mpit.PVAR_CLASS_COUNTER,
                           "pt2pt",
                           "bytes read via cross-memory attach "
                           "(process_vm_readv)")
+pv_reclaimed_dead = _mpit.pvar(
+    "arena_reclaimed_dead", _mpit.PVAR_CLASS_COUNTER, "shm",
+    "arena blocks/segments reclaimed from dead ranks (failure sweep, "
+    "Finalize leak-check tolerance, stale-segment sweep)")
 
 _PAGE = 4096
 
@@ -203,6 +207,9 @@ class ShmArena:
         path — never blocks, never deadlocks)."""
         if nbytes <= 0:
             nbytes = 1
+        from .. import faults
+        if faults.fire("arena_alloc") == "drop":
+            return None     # simulated exhaustion: caller's fallback path
         c = self._class_of(nbytes)
         if c > self.part_bytes:
             return None
@@ -297,5 +304,6 @@ class ShmArena:
             except OSError:
                 pass
         if n:
+            pv_reclaimed_dead.inc(n)
             log.info("swept %d stale arena segment(s) from %s", n, dir_)
         return n

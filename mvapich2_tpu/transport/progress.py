@@ -84,6 +84,13 @@ class ProgressEngine:
         # from Universe.initialize); None keeps the wait path at one
         # attribute check when MV2T_LOCKCHECK is off
         self._lockcheck = None
+        self._in_wait = False
+        # liveness probe (failure containment): a callback run at the
+        # blocking wait's sleep point that checks co-located peers'
+        # heartbeat leases and feeds expiries into the ULFM sink, so a
+        # dead peer unwinds this wait instead of hanging it. None keeps
+        # the wait path at one attribute check when leases are off.
+        self._liveness = None
         from .. import mpit
         self._pv_polls = mpit.pvar("progress_polls",
                                    mpit.PVAR_CLASS_COUNTER, "progress",
@@ -119,6 +126,13 @@ class ProgressEngine:
             self.hooks.remove(fn)
         except ValueError:
             pass
+
+    def register_liveness(self, fn: Optional[Callable[[], int]]) -> None:
+        """Install the liveness probe run at blocking waits' sleep
+        points (``fn() -> peers newly declared dead``). Probes are
+        handler-context code for the blocking lint pass: they run inside
+        every wait, so they must never sleep or block."""
+        self._liveness = fn
 
     # -- packet delivery (any thread) -------------------------------------
     def enqueue_incoming(self, pkt: Packet) -> None:
@@ -225,18 +239,25 @@ class ProgressEngine:
                       timeout: Optional[float] = None) -> None:
         """Poll/sleep until ``pred()`` — MPID_Progress_wait analog."""
         tr = self.tracer
-        if tr is None and self._stall_limit is None:
-            return self._progress_wait(pred, timeout, None, None)
-        stall_at = None
-        if self._stall_limit is not None and not self._stall_tripped:
-            stall_at = time.monotonic() + self._stall_limit
-        if tr is not None:
-            tr.record("progress", "progress_wait", "B")
+        # _in_wait: read by the liveness probe so a lease detection that
+        # lands while a blocking wait is parked counts into the
+        # wait_deadline_trips pvar (detections during plain pokes don't)
+        self._in_wait = True
         try:
-            return self._progress_wait(pred, timeout, tr, stall_at)
-        finally:
+            if tr is None and self._stall_limit is None:
+                return self._progress_wait(pred, timeout, None, None)
+            stall_at = None
+            if self._stall_limit is not None and not self._stall_tripped:
+                stall_at = time.monotonic() + self._stall_limit
             if tr is not None:
-                tr.record("progress", "progress_wait", "E")
+                tr.record("progress", "progress_wait", "B")
+            try:
+                return self._progress_wait(pred, timeout, tr, stall_at)
+            finally:
+                if tr is not None:
+                    tr.record("progress", "progress_wait", "E")
+        finally:
+            self._in_wait = False
 
     def _progress_wait(self, pred: Callable[[], bool],
                        timeout: Optional[float], tr,
@@ -268,6 +289,15 @@ class ProgressEngine:
                     self._lockcheck.check_wait(self.rank)
                 if deadline is not None and time.monotonic() > deadline:
                     raise TimeoutError("progress_wait timed out")
+                if self._liveness is not None and spin >= 2:
+                    # deadline-by-lease: past the first backoff step the
+                    # wait is genuinely idle — check whether a peer we
+                    # may be waiting on has gone dark. A detection
+                    # completes the dependent requests (ULFM sweep), so
+                    # the next pred() check unwinds this wait with
+                    # MPIX_ERR_PROC_FAILED; unrelated waits keep going.
+                    if self._liveness():
+                        continue      # re-check pred before sleeping
                 if stall_at is not None and not self._stall_tripped \
                         and time.monotonic() > stall_at:
                     # one-shot hang diagnostic (queue snapshot, requests,

@@ -53,6 +53,13 @@ cvar("USE_CMA", 1, int, "shm",
      "Use cross-memory-attach (process_vm_readv) for large intra-node "
      "messages when the bootstrap probe succeeds (the CMA/LiMIC2 path of "
      "ch3_smp_progress.c:525). 0 forces the staged rendezvous.")
+cvar("PEER_TIMEOUT", 10.0, float, "ft",
+     "Liveness-lease timeout in seconds: a co-located peer whose "
+     "heartbeat stamp (refreshed by a dedicated thread, so compute-"
+     "silent ranks stay alive) goes stale past this is declared dead — "
+     "blocking waits in the datapath unwind with MPIX_ERR_PROC_FAILED "
+     "instead of hanging. 0 disables lease detection. Containment "
+     "latency for a SIGKILLed peer is <= 2x this value.")
 
 from .. import mpit as _mpit  # noqa: E402  (after cvar decls, same registry)
 
@@ -89,6 +96,9 @@ _FP_COUNTERS = [
      "fast-path blocking waits satisfied after the doorbell sleep"),
     ("fp_flat_progress",
      "python progress callbacks fired from flat-collective waits"),
+    ("fp_dead_peer",
+     "peers declared dead by the C-plane lease scan (flat waits and "
+     "wait quanta)"),
 ]
 for _n, _d in _FP_COUNTERS:
     _mpit.pvar(_n, _mpit.PVAR_CLASS_COUNTER, "fastpath", _d)
@@ -211,6 +221,18 @@ def _bind_cplane(lib) -> None:
     lib.cp_cancel_result.argtypes = [L.c_void_p, L.c_longlong]
     lib.cp_cancel_forget.argtypes = [L.c_void_p, L.c_longlong]
     lib.cp_mark_failed.argtypes = [L.c_void_p, L.c_int]
+    lib.cp_any_failed.argtypes = [L.c_void_p]
+    lib.cp_rank_failed.argtypes = [L.c_void_p, L.c_int]
+    # liveness leases + flat-region forensics (failure containment)
+    lib.cp_set_peer_timeout.argtypes = [L.c_void_p, L.c_longlong]
+    lib.cp_lease_age_us.restype = L.c_longlong
+    lib.cp_lease_age_us.argtypes = [L.c_void_p, L.c_int]
+    lib.cp_lease_scan.argtypes = [L.c_void_p]
+    lib.cp_flat_poisoned.argtypes = [L.c_void_p, L.c_int, L.c_int]
+    lib.cp_flat_poison_region.argtypes = [L.c_void_p, L.c_int, L.c_int]
+    lib.cp_flat_slot_state.argtypes = [L.c_void_p, L.c_int, L.c_int,
+                                       L.c_int, L.POINTER(L.c_longlong),
+                                       L.POINTER(L.c_longlong)]
     lib.cp_posted_count.argtypes = [L.c_void_p]
     lib.cp_posted_get.argtypes = [L.c_void_p, L.c_int,
                                   L.POINTER(L.c_longlong), L.POINTER(L.c_int),
@@ -488,29 +510,57 @@ class ShmChannel(Channel):
                 f"{os.getpid()}:{self._cma_probe.ctypes.data}"
                 f":{self._cma_probe.size}")
         self._peer_bells: Dict[int, str] = {}
+        # liveness-lease timeout (cached: the probe runs at blocking
+        # waits' sleep points; config is reloaded before channels wire)
+        self._peer_timeout = float(
+            get_config().get("PEER_TIMEOUT", 0.0) or 0.0)
         # Adaptive bell: a shared byte per local rank, set while that
         # rank is parked in the engine's blocking wait. Senders skip the
         # doorbell syscall (~0.15 ms on an oversubscribed host) for
         # awake receivers — those are polling anyway. The engine's
         # pre_wait (advertise) -> final poll -> sleep order makes the
         # skip race-free.
+        # flags segment layout: [n_local sleep bytes][pad to 8][n_local
+        # u64 liveness-lease stamps]. The lease tail is the heartbeat
+        # surface of the failure-containment layer: every rank's stamp
+        # is refreshed by a dedicated thread (plus the C plane's
+        # advance_locked), and every blocking wait — python progress
+        # waits, C flat waves, C wait quanta — scans peers' stamps
+        # against MV2T_PEER_TIMEOUT so a SIGKILLed peer is a detectable
+        # event instead of a hang. cplane.cpp maps the same layout.
         flags_path = f"{path}.flags"
+        lease_off = (self.n_local + 7) & ~7
+        flags_len = lease_off + 8 * self.n_local
         if self._owner:
             # write-then-rename so followers never see a short file
             with open(flags_path + ".tmp", "wb") as f:
-                f.write(b"\0" * self.n_local)
+                f.write(b"\0" * flags_len)
             os.replace(flags_path + ".tmp", flags_path)
         else:
             deadline = time.monotonic() + 30.0
             while not (os.path.exists(flags_path)
-                       and os.path.getsize(flags_path) >= self.n_local):
+                       and os.path.getsize(flags_path) >= flags_len):
                 if time.monotonic() > deadline:
                     raise OSError(f"shm flags segment never appeared: "
                                   f"{flags_path}")
                 time.sleep(0.001)
         self._flags_path = flags_path
         self._flags_f = open(flags_path, "r+b")
-        self._flags = mmap.mmap(self._flags_f.fileno(), self.n_local)
+        self._flags = mmap.mmap(self._flags_f.fileno(), flags_len)
+        self._lease = np.frombuffer(self._flags, dtype=np.uint64,
+                                    count=self.n_local, offset=lease_off)
+        self._lease_scan_at = 0.0      # python-probe throttle
+        self._failed_seen: set = set() # C-detections already reconciled
+        self._lease_stamp()
+        # heartbeat thread: the stamp must stay fresh through compute-
+        # silent stretches (a rank deep in user code makes no progress
+        # calls), so refreshing only from the progress loop would
+        # false-kill busy peers. ~10 stamps per timeout period.
+        self._hb_stop = threading.Event()
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop, daemon=True,
+            name=f"mv2t-lease-hb-{my_rank}")
+        self._hb_thread.start()
         # -- native data plane (native/cplane.cpp) -----------------------
         # C-side envelope matching for plane-owned contexts: created when
         # the native ring is live; wired (bells, global registration) in
@@ -566,6 +616,129 @@ class ShmChannel(Channel):
         self._ring.lib.cp_stats(self.plane, tx, rx, fwd)
         self._ring.lib.cp_rndv_stats(self.plane, rtx, rrx)
         return (tx.value, rx.value, fwd.value, rtx.value, rrx.value)
+
+    # -- liveness leases (failure containment) ---------------------------
+    _LEASE_DEPARTED = 0xFFFFFFFFFFFFFFFF
+
+    @staticmethod
+    def _now_us() -> int:
+        return int(time.clock_gettime(time.CLOCK_MONOTONIC) * 1e6)
+
+    def _lease_stamp(self, value: Optional[int] = None) -> None:
+        try:
+            self._lease[self.local_index[self.my_rank]] = np.uint64(
+                self._now_us() if value is None else value)
+        except (ValueError, TypeError):
+            pass                      # mapping already closed
+
+    def _hb_loop(self) -> None:
+        period = max(0.02, min(1.0, self._peer_timeout / 10.0)) \
+            if self._peer_timeout > 0 else 0.5
+        while not self._hb_stop.wait(period):
+            self._lease_stamp()
+
+    def lease_age(self, world_rank: int) -> Optional[float]:
+        """Seconds since ``world_rank``'s heartbeat stamp; None when the
+        rank never stamped (bootstrap) or departed cleanly (Finalize)."""
+        i = self.local_index.get(world_rank)
+        if i is None:
+            return None
+        v = int(self._lease[i])
+        if v == 0 or v == self._LEASE_DEPARTED:
+            return None
+        return max(0.0, (self._now_us() - v) / 1e6)
+
+    def lease_report(self) -> List[str]:
+        """One line per co-located rank for the stall-watchdog dump."""
+        out = []
+        u = getattr(self.engine, "universe", None) \
+            if hasattr(self, "engine") else None
+        failed = getattr(u, "failed_ranks", set()) if u is not None else set()
+        for w in self.local_ranks:
+            i = self.local_index[w]
+            v = int(self._lease[i])
+            if w == self.my_rank:
+                state = "self"
+            elif v == 0:
+                state = "never-stamped"
+            elif v == self._LEASE_DEPARTED:
+                state = "departed"
+            else:
+                state = f"age {(self._now_us() - v) / 1e6:.2f}s"
+            if w in failed:
+                state += " FAILED"
+            out.append(f"world {w} (ring {i}): {state}")
+        return out
+
+    def check_peer_leases(self) -> int:  # mv2tlint: handler
+        """Liveness probe run from the progress engine's idle path (and
+        registered via register_liveness): declare co-located peers dead
+        when their lease goes stale past MV2T_PEER_TIMEOUT. Must never
+        block — it runs at the blocking waits' sleep points. Returns how
+        many peers were newly declared dead."""
+        if self._peer_timeout <= 0:
+            return 0
+        now = time.monotonic()
+        if now < self._lease_scan_at:
+            return self._reconcile_plane_failures()
+        self._lease_scan_at = now + max(0.01, self._peer_timeout / 4.0)
+        eng = getattr(self, "engine", None)
+        u = getattr(eng, "universe", None) if eng is not None else None
+        if u is None:
+            return 0
+        ndead = 0
+        for w in self.local_ranks:
+            if w == self.my_rank or w in u.failed_ranks:
+                continue
+            age = self.lease_age(w)
+            if age is not None and age > self._peer_timeout:
+                from ..core.errors import PeerDeadError
+                from ..faults import pv_dead_peer
+                from ..ft import ulfm
+                err = PeerDeadError(w, age, "liveness probe")
+                log.warn("%s", err)
+                u.last_peer_dead = err
+                pv_dead_peer.inc()
+                if getattr(eng, "_in_wait", False):
+                    from ..faults import pv_deadline
+                    pv_deadline.inc()
+                ulfm.mark_failed(u, w)
+                if self.plane and w in self.local_index:
+                    self._failed_seen.add(w)
+                ndead += 1
+        ndead += self._reconcile_plane_failures()
+        return ndead
+
+    def _reconcile_plane_failures(self) -> int:  # mv2tlint: handler
+        """Feed C-side lease detections (cp_lease_scan inside flat waves
+        and wait quanta) into the python ULFM sink, so posted recvs and
+        in-flight rendezvous unwind with MPIX_ERR_PROC_FAILED on both
+        ABIs. One atomic read when nothing has failed."""
+        if not self.plane:
+            return 0
+        lib = self._ring.lib
+        if not lib.cp_any_failed(self.plane):
+            return 0
+        u = getattr(getattr(self, "engine", None), "universe", None)
+        if u is None:
+            return 0
+        ndead = 0
+        for w in self.local_ranks:
+            if w == self.my_rank or w in self._failed_seen:
+                continue
+            if lib.cp_rank_failed(self.plane, self.local_index[w]):
+                self._failed_seen.add(w)
+                if w not in u.failed_ranks:
+                    from ..faults import pv_dead_peer, pv_deadline
+                    from ..ft import ulfm
+                    pv_dead_peer.inc()
+                    # the C lease scan runs ONLY inside blocking waits
+                    # (flat waves, wait quanta): every reconciled C
+                    # detection is a wait-deadline trip by construction
+                    pv_deadline.inc()
+                    ulfm.mark_failed(u, w)
+                    ndead += 1
+        return ndead
 
     def _probe_cma(self) -> bool:
         """Can this process read a co-resident rank's memory via
@@ -705,6 +878,10 @@ class ShmChannel(Channel):
         lib.cp_register_global(self.plane)
         if all_ok:
             lib.cp_set_cma(self.plane, 1)
+        # arm the C-side lease scans (flat waves, wait quanta) with the
+        # same timeout the python probe uses
+        lib.cp_set_peer_timeout(self.plane,
+                                int(self._peer_timeout * 1e6))
         # rebind the plane counters' sources to this live plane:
         # fast-path hit-rate is the one number that says whether a
         # workload actually rides the C path. Totals from earlier planes
@@ -750,6 +927,17 @@ class ShmChannel(Channel):
 
     def send_packet(self, dest_world: int, pkt: Packet) -> None:
         blob = encode_packet(pkt)
+        from .. import faults
+        kind = faults.fire("shm_send")
+        if kind == "drop":
+            return                    # lost on the (simulated) wire
+        if kind == "truncate":
+            blob = blob[:max(1, len(blob) // 2)]
+        self._inject_blob(dest_world, blob)
+        if kind == "duplicate":
+            self._inject_blob(dest_world, blob)
+
+    def _inject_blob(self, dest_world: int, blob: bytes) -> None:
         # python-injected traffic only; the C plane's eager fast path
         # bypasses send_packet entirely and keeps its own counters
         # (cplane_eager_tx et al.)
@@ -887,6 +1075,7 @@ class ShmChannel(Channel):
         # reclaim one poll; _reclaim_spills itself takes _spill_lock
         if self._spill_pending:  # mv2tlint: ignore[locks]
             self._reclaim_spills()
+        from .. import faults
         for src_i in range(self.n_local):
             if src_i == my_i:
                 continue
@@ -896,9 +1085,13 @@ class ShmChannel(Channel):
                     break
                 if blob[0] in (0xFE, 0xFF):    # oversize spill note
                     blob = self._consume_spill_note(blob)
+                if faults.fire("shm_recv") == "drop":
+                    continue           # inbound packet lost
                 self.account_recv(len(blob))
                 self.engine.enqueue_incoming(decode_packet(blob))
                 did = True
+        if self._peer_timeout > 0:
+            self.check_peer_leases()
         return did
 
     # -- plane mode -------------------------------------------------------
@@ -910,9 +1103,19 @@ class ShmChannel(Channel):
         lib = self._ring.lib
         self._drain_bell()
         did = lib.cp_advance(self.plane) > 0
+        # liveness on the poll path too (throttled): pokers that never
+        # reach progress_wait — the ULFM agreement's poke/sleep loop,
+        # spin-waiters — still detect dead peers; this also reconciles
+        # C-side detections (flat waves, wait quanta) into the ULFM
+        # sink. One atomic read + one time read when healthy.
+        if self._peer_timeout > 0:
+            self.check_peer_leases()
+        else:
+            self._reconcile_plane_failures()
         # racy truthiness gate, same justification as poll()
         if self._spill_pending:  # mv2tlint: ignore[locks]
             self._reclaim_spills()
+        from .. import faults
         while lib.cp_py_pending(self.plane):
             n = lib.cp_py_peek(self.plane)
             if n <= 0:
@@ -924,6 +1127,8 @@ class ShmChannel(Channel):
             blob = buf.raw[:got]
             if blob[0] in (0xFE, 0xFF):  # oversize spill note (py-owned)
                 blob = self._consume_spill_note(blob)
+            if faults.fire("shm_recv") == "drop":
+                continue               # inbound packet lost
             self.engine.enqueue_incoming(decode_packet(blob))
             did = True
         client = self.plane_client
@@ -1000,6 +1205,8 @@ class ShmChannel(Channel):
         arena/file paths return views anchored to the shared/mapped
         memory (no staging copy — the caller reduces/unpacks straight
         out of the mapping before the FIN releases it)."""
+        from .. import faults
+        faults.fire("rndv_chunk")     # crash/delay mid-pull (RGET)
         tr = getattr(self.engine, "tracer", None) \
             if hasattr(self, "engine") else None
         kind = handle[0] if isinstance(handle, tuple) else "path"
@@ -1064,6 +1271,11 @@ class ShmChannel(Channel):
             except Exception:
                 pass
             self.plane = None
+        # clean departure: stamp the lease sentinel (AFTER cp_destroy so
+        # a last advance_locked can't overwrite it) and stop the
+        # heartbeat — peers must read "departed", never "dead"
+        self._hb_stop.set()
+        self._lease_stamp(self._LEASE_DEPARTED)
         if self.arena is not None:
             # Finalize leak check: every exposure must have been released
             # by its FIN/cancel; pending spills may legitimately await
@@ -1073,9 +1285,22 @@ class ShmChannel(Channel):
                     while pend:
                         self.arena.free(pend.popleft()[1])
             if self._exposed or self.arena.outstanding:
-                log.warn("arena handle leak at close: %d exposures, %d "
-                         "arena blocks live", len(self._exposed),
-                         self.arena.outstanding)
+                u = getattr(self.engine, "universe", None) \
+                    if hasattr(self, "engine") else None
+                if u is not None and u.failed_ranks:
+                    # dead peers never FIN: their exposures/blocks are
+                    # reclaimed state, not leaks (counted, not warned)
+                    n = len(self._exposed) + self.arena.outstanding
+                    for h in list(self._exposed):
+                        self.release_buffer(h)
+                    _mpit.pvar("arena_reclaimed_dead").inc(n)
+                    log.info("reclaimed %d arena exposures/blocks "
+                             "stranded by failed ranks %s", n,
+                             sorted(u.failed_ranks))
+                else:
+                    log.warn("arena handle leak at close: %d exposures, "
+                             "%d arena blocks live", len(self._exposed),
+                             self.arena.outstanding)
             self.arena.close(unlink=self._owner)
         try:
             self._bell.close()
@@ -1083,9 +1308,10 @@ class ShmChannel(Channel):
         except OSError:
             pass
         try:
+            self._lease = None     # release the buffer export first
             self._flags.close()
             self._flags_f.close()
-        except (OSError, ValueError):
+        except (OSError, ValueError, BufferError):
             pass
         try:
             self._ring.close()

@@ -255,6 +255,14 @@ struct CPlane {
   // wakeup plumbing (mirrors ShmChannel's adaptive doorbell)
   uint8_t* flags;                // mmap'd sleep flags, one per local rank
   long flags_len;
+  // liveness leases: one u64 CLOCK_MONOTONIC-us stamp per local rank,
+  // in the tail of the flags segment (shm.py owns the layout and the
+  // heartbeat thread; C stamps opportunistically from advance_locked
+  // and SCANS peers from every blocking wait). 0 = never stamped
+  // (bootstrap), ~0 = departed cleanly (Finalize — not a failure).
+  volatile uint64_t* lease;
+  long long peer_timeout_us;     // 0 = lease detection off
+  uint64_t lease_scan_at;        // next scan time (throttle)
   int bell_fd;                   // our bell socket (owned by python side)
   struct sockaddr_un* bells;     // peer bell addresses
   uint8_t* bell_set;
@@ -296,8 +304,11 @@ enum {
   FPC_COLL_SCHED = 7,    // collectives completed on the pt2pt schedules
   FPC_WAIT_SPIN = 8,     // blocking waits satisfied during the spin
   FPC_WAIT_BELL = 9,     // blocking waits satisfied after doorbell sleep
-  FPC_FLAT_PROGRESS = 10 // python progress callbacks from flat waits
+  FPC_FLAT_PROGRESS = 10, // python progress callbacks from flat waits
+  FPC_DEAD_PEER = 11     // peers declared dead by the C lease scan
 };
+
+constexpr uint64_t LEASE_DEPARTED = ~0ull;
 
 inline uint64_t now_us() {
   struct timespec ts;
@@ -718,6 +729,12 @@ void process_blob(CPlane* p, const uint8_t* blob, long len) {
 // drain every inbound ring once (plane mutex held); returns packets seen
 int advance_locked(CPlane* p) {
   int did = 0;
+  // opportunistic heartbeat: the python-side thread is the guarantee
+  // (it stamps through compute-silent stretches); this keeps the stamp
+  // hot-fresh while the progress engine is actually running
+  if (p->lease)
+    __atomic_store_n(const_cast<uint64_t*>(&p->lease[p->me]), now_us(),
+                     __ATOMIC_RELEASE);
   for (int src = 0; src < p->n_local; src++) {
     // opportunistically flush our backlog toward src too; a successful
     // flush rings the doorbell — the original inject's bell may have
@@ -800,19 +817,50 @@ void* cp_create(void* ring, int my_index, int n_local,
   p->bell_fd = -1;
   p->bell_tx = socket(AF_UNIX, SOCK_DGRAM, 0);
   p->flags = nullptr;
+  p->lease = nullptr;
   if (flags_path && flags_path[0]) {
     int fd = open(flags_path, O_RDWR);
     if (fd >= 0) {
-      void* m = mmap(nullptr, n_local, PROT_READ | PROT_WRITE, MAP_SHARED,
+      // layout (shm.py): [n_local sleep bytes][pad to 8][n_local u64
+      // lease stamps]. A shorter file is the pre-lease layout — map
+      // the sleep flags only and leave lease detection off.
+      long pad = (n_local + 7) & ~7;
+      long want = pad + 8L * n_local;
+      struct stat st;
+      long have = (fstat(fd, &st) == 0) ? static_cast<long>(st.st_size)
+                                        : n_local;
+      long maplen = have >= want ? want : n_local;
+      void* m = mmap(nullptr, maplen, PROT_READ | PROT_WRITE, MAP_SHARED,
                      fd, 0);
       if (m != MAP_FAILED) {
         p->flags = static_cast<uint8_t*>(m);
-        p->flags_len = n_local;
+        p->flags_len = maplen;
+        if (maplen >= want)
+          p->lease = reinterpret_cast<volatile uint64_t*>(
+              static_cast<uint8_t*>(m) + pad);
       }
       close(fd);
     }
   }
   return p;
+}
+
+void cp_set_peer_timeout(void* cp, long long timeout_us) {
+  static_cast<CPlane*>(cp)->peer_timeout_us = timeout_us;
+}
+
+// lease age of one local rank in microseconds; -1 = leases off / never
+// stamped, -2 = departed cleanly (Finalize stamp)
+long long cp_lease_age_us(void* cp, int ring_index) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (!p->lease || ring_index < 0 || ring_index >= p->n_local) return -1;
+  uint64_t v = __atomic_load_n(
+      const_cast<const uint64_t*>(&p->lease[ring_index]),
+      __ATOMIC_ACQUIRE);
+  if (v == 0) return -1;
+  if (v == LEASE_DEPARTED) return -2;
+  uint64_t now = now_us();
+  return now > v ? static_cast<long long>(now - v) : 0;
 }
 
 void cp_destroy(void* cp) {
@@ -1528,6 +1576,39 @@ int cp_rank_failed(void* cp, int ring_index) {
   return p->failed[ring_index];
 }
 
+// liveness-lease scan: declare peers dead whose heartbeat stamp went
+// stale past the configured timeout. Called from every C-side blocking
+// wait (flat_wait parked loop, cp_wait_quantum idle path) WITHOUT the
+// plane mutex held (cp_mark_failed takes it). Throttled to 1/4 of the
+// timeout so the scan itself never shows up in a profile. Returns how
+// many peers it newly declared dead.
+int cp_lease_scan(void* cp) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (!p->lease || p->peer_timeout_us <= 0) return 0;
+  uint64_t now = now_us();
+  if (now < p->lease_scan_at) return 0;
+  uint64_t step = static_cast<uint64_t>(p->peer_timeout_us) / 4;
+  p->lease_scan_at = now + (step < 10000 ? 10000 : step);
+  int ndead = 0;
+  for (int i = 0; i < p->n_local; i++) {
+    if (i == p->me || p->failed[i]) continue;
+    uint64_t v = __atomic_load_n(
+        const_cast<const uint64_t*>(&p->lease[i]), __ATOMIC_ACQUIRE);
+    if (v == 0 || v == LEASE_DEPARTED) continue;   // boot / clean exit
+    if (now > v &&
+        now - v > static_cast<uint64_t>(p->peer_timeout_us)) {
+      fprintf(stderr,
+              "cplane: world rank %d (ring %d) lease expired "
+              "(%.2fs stale) — declaring it dead\n",
+              p->world_of[i], i, (now - v) / 1e6);
+      cp_mark_failed(p, i);
+      p->fpctr[FPC_DEAD_PEER]++;
+      ndead++;
+    }
+  }
+  return ndead;
+}
+
 int cp_posted_count(void* cp) {
   CPlane* p = static_cast<CPlane*>(cp);
   pthread_mutex_lock(&p->mu);
@@ -1758,6 +1839,10 @@ int flat_wait(CPlane* p, const volatile uint64_t* a, uint64_t want) {
       p->progress_cb();
     }
     if (fl_load(a) >= want) return 0;
+    // liveness: a SIGKILLed member can never advance the counter we
+    // wait on — the lease scan marks it failed (g_any_failed) and the
+    // wave unwinds with -2 instead of riding out the stall timeout
+    cp_lease_scan(p);
     if (g_any_failed.load(std::memory_order_acquire)) return -2;
     uint64_t waited = now_us() - start;
     if (waited > FLAT_TIMEOUT_US) return -3;
@@ -1772,6 +1857,96 @@ int flat_wait(CPlane* p, const volatile uint64_t* a, uint64_t want) {
 // a counter this rank's previous-comm life never advanced
 inline void flat_enter(uint8_t* slot, uint64_t seq) {
   if (fl_load(fl_out(slot)) < seq - 1) fl_store(fl_out(slot), seq - 1);
+}
+
+// region poison word (region header byte 0): stamped sticky when a wave
+// dies mid-flight (peer failure / stall), checked by cp_flat_base so no
+// later comm can key a region whose slot counters are torn — the comm
+// that would have reused it degrades to the scheduled tier instead of
+// folding a half-written slot (wrong data) or hanging on a stale seq.
+inline volatile uint64_t* fl_poi(uint8_t* reg) {
+  return reinterpret_cast<volatile uint64_t*>(reg);
+}
+
+inline int flat_fail(uint8_t* reg, int rc) {
+  if (rc == -2 || rc == -3) fl_store(fl_poi(reg), 1);
+  return rc;
+}
+
+// native fault injection for the flat fold site (MV2T_FAULTS
+// flat_fold[@rank]:crash|delay[:seed[:nth[+]]]): parsed here — not in
+// the python engine — so the C-ABI hot path (fastpath.c -> cp_flat_*)
+// injects without an interpreter round-trip, and python ranks hit the
+// IDENTICAL site since both ABIs fold through these entry points.
+struct FlatFault {
+  int armed;           // 0 unparsed, -1 off, 1 armed
+  int rank;            // -1 = any world rank
+  int crash;           // 1 crash, 0 delay
+  long nth;
+  int repeat;
+  unsigned seed;
+};
+FlatFault g_ff = {0, -1, 1, 1, 0, 0};
+std::atomic<long> g_ff_count{0};
+
+void flat_fault_parse() {
+  g_ff.armed = -1;
+  const char* env = getenv("MV2T_FAULTS");
+  if (!env || !*env) return;
+  char buf[512];
+  strncpy(buf, env, sizeof(buf) - 1);
+  buf[sizeof(buf) - 1] = 0;
+  char* save = nullptr;
+  for (char* spec = strtok_r(buf, ",", &save); spec;
+       spec = strtok_r(nullptr, ",", &save)) {
+    if (strncmp(spec, "flat_fold", 9) != 0) continue;
+    char* q = spec + 9;
+    int rank = -1;
+    if (*q == '@') rank = static_cast<int>(strtol(q + 1, &q, 10));
+    if (*q != ':') continue;
+    q++;
+    int crash;
+    if (strncmp(q, "crash", 5) == 0) crash = 1;
+    else if (strncmp(q, "delay", 5) == 0) crash = 0;
+    else continue;                       // other kinds: python-only
+    q += 5;
+    unsigned seed = 0;
+    long nth = 1;
+    int repeat = 0;
+    if (*q == ':') {
+      seed = static_cast<unsigned>(strtoul(q + 1, &q, 10));
+      if (*q == ':') {
+        nth = strtol(q + 1, &q, 10);
+        if (nth < 1) nth = 1;
+        if (*q == '+') repeat = 1;
+      }
+    }
+    g_ff.rank = rank;
+    g_ff.crash = crash;
+    g_ff.nth = nth;
+    g_ff.repeat = repeat;
+    g_ff.seed = seed;
+    g_ff.armed = 1;
+    return;
+  }
+}
+
+void flat_fault(CPlane* p) {
+  if (g_ff.armed == 0) flat_fault_parse();
+  if (g_ff.armed < 0) return;
+  if (g_ff.rank >= 0 && p->world_of[p->me] != g_ff.rank) return;
+  long c = g_ff_count.fetch_add(1) + 1;
+  if (c != g_ff.nth && !(g_ff.repeat && c > g_ff.nth)) return;
+  if (g_ff.crash) {
+    fprintf(stderr, "cplane: fault engine crash-self at flat_fold "
+                    "(event %ld, world rank %d)\n",
+            c, p->world_of[p->me]);
+    fflush(stderr);
+    _exit(17);
+  }
+  long ms = 1 + static_cast<long>((g_ff.seed * 2654435761u + c) % 19);
+  struct timespec ts = {0, ms * 1000000L};
+  nanosleep(&ts, nullptr);
 }
 
 }  // namespace
@@ -1837,7 +2012,35 @@ long long cp_flat_base(void* cp, int ctx, int lane) {
   CPlane* p = static_cast<CPlane*>(cp);
   uint8_t* reg = flat_region(p, ctx, lane);
   if (reg == nullptr) return -1;
+  if (fl_load(fl_poi(reg)) != 0) return -1;   // poisoned: re-key or
+                                              // degrade, never reuse
   return static_cast<long long>(fl_load(fl_in(flat_bcb(reg))));
+}
+
+// sticky region poison (failure containment): stamped automatically
+// when a wave dies, and explicitly by recovery code (ft/elastic.py
+// re-keys the shrunken comm instead of reusing the torn lane).
+int cp_flat_poisoned(void* cp, int ctx, int lane) {
+  uint8_t* reg = flat_region(static_cast<CPlane*>(cp), ctx, lane);
+  return (reg != nullptr && fl_load(fl_poi(reg)) != 0) ? 1 : 0;
+}
+
+void cp_flat_poison_region(void* cp, int ctx, int lane) {
+  uint8_t* reg = flat_region(static_cast<CPlane*>(cp), ctx, lane);
+  if (reg != nullptr) fl_store(fl_poi(reg), 1);
+}
+
+// per-slot seq numbers for the stall-watchdog report: slot in
+// [0, FLAT_NSLOTS) = rank slots, slot == FLAT_NSLOTS = the broadcast
+// block (in = fold epoch / bseq, out = byte count of the last bcast).
+int cp_flat_slot_state(void* cp, int ctx, int lane, int slot,
+                       long long* in_seq, long long* out_seq) {
+  uint8_t* reg = flat_region(static_cast<CPlane*>(cp), ctx, lane);
+  if (reg == nullptr || slot < 0 || slot > FLAT_NSLOTS) return -1;
+  uint8_t* s = slot == FLAT_NSLOTS ? flat_bcb(reg) : flat_slot(reg, slot);
+  if (in_seq) *in_seq = static_cast<long long>(fl_load(fl_in(s)));
+  if (out_seq) *out_seq = static_cast<long long>(fl_load(fl_out(s)));
+  return 0;
 }
 
 // flat allreduce: contributions fan into the slots, the leader folds in
@@ -1856,6 +2059,7 @@ int cp_flat_allreduce(void* cp, int ctx, int lane, int rank, int n,
   uint64_t s = static_cast<uint64_t>(seq);
   uint8_t* mine = flat_slot(reg, rank);
   uint8_t* bcb = flat_bcb(reg);
+  flat_fault(p);
   flat_enter(mine, s);
   int rc = 0;
   if (rank == 0) {
@@ -1879,12 +2083,12 @@ int cp_flat_allreduce(void* cp, int ctx, int lane, int rank, int n,
       fl_store(fl_out(mine), s);
       p->fpctr[FPC_COLL_FLAT]++;
     }
-    return rc;
+    return flat_fail(reg, rc);
   }
   if (nb > 0) memcpy(fl_pay(mine), sbuf, nb);
   fl_store(fl_in(mine), s);
   rc = flat_wait(p, fl_in(bcb), s);
-  if (rc != 0) return rc;
+  if (rc != 0) return flat_fail(reg, rc);
   if (nb > 0) memcpy(rbuf, fl_pay(bcb), nb);
   fl_store(fl_out(mine), s);
   p->fpctr[FPC_COLL_FLAT]++;
@@ -1907,6 +2111,7 @@ int cp_flat_reduce(void* cp, int ctx, int lane, int rank, int n,
   uint64_t s = static_cast<uint64_t>(seq);
   uint8_t* mine = flat_slot(reg, rank);
   uint8_t* bcb = flat_bcb(reg);
+  flat_fault(p);
   flat_enter(mine, s);
   int rc = 0;
   if (rank == root) {
@@ -1924,12 +2129,12 @@ int cp_flat_reduce(void* cp, int ctx, int lane, int rank, int n,
       fl_store(fl_out(mine), s);
       p->fpctr[FPC_COLL_FLAT]++;
     }
-    return rc;
+    return flat_fail(reg, rc);
   }
   if (nb > 0) memcpy(fl_pay(mine), sbuf, nb);
   fl_store(fl_in(mine), s);
   rc = flat_wait(p, fl_in(bcb), s);
-  if (rc != 0) return rc;
+  if (rc != 0) return flat_fail(reg, rc);
   fl_store(fl_out(mine), s);
   p->fpctr[FPC_COLL_FLAT]++;
   return 0;
@@ -1960,6 +2165,7 @@ int cp_flat_bcast(void* cp, int ctx, int lane, int rank, int n,
   uint64_t s = static_cast<uint64_t>(seq);
   uint8_t* mine = flat_slot(reg, rank);
   uint8_t* bcb = flat_bcb(reg);
+  flat_fault(p);
   flat_enter(mine, s);
   int rc = 0;
   if (rank == root) {
@@ -1970,7 +2176,7 @@ int cp_flat_bcast(void* cp, int ctx, int lane, int rank, int n,
       if (r == root) continue;
       rc = flat_wait(p, fl_in(flat_slot(reg, r)), s);
     }
-    if (rc != 0) return rc;
+    if (rc != 0) return flat_fail(reg, rc);
     if (nbytes > 0) memcpy(fl_pay(bcb), buf, nbytes);
     fl_store(fl_out(bcb), static_cast<uint64_t>(nbytes));
     fl_store(fl_in(bcb), s);
@@ -1981,7 +2187,7 @@ int cp_flat_bcast(void* cp, int ctx, int lane, int rank, int n,
   }
   fl_store(fl_in(mine), s);     // arrival stamp: the root blocks on it
   rc = flat_wait(p, fl_in(bcb), s);
-  if (rc != 0) return rc;
+  if (rc != 0) return flat_fail(reg, rc);
   long long have = static_cast<long long>(fl_load(fl_out(bcb)));
   long long take = have < nbytes ? have : nbytes;
   if (take > 0) memcpy(buf, fl_pay(bcb), take);
@@ -2070,6 +2276,10 @@ int cp_wait_quantum(void* cp, long long req, long spin_us, long block_ms) {
     nanosleep(&ts, nullptr);
   }
   if (p->flags) p->flags[p->me] = 0;
+  // idle with nothing arriving: the awaited peer may be dead — the
+  // (throttled) lease scan marks it, cp_mark_failed sweeps its sends,
+  // and the python reconciliation unwinds its posted recvs
+  if (!woken) cp_lease_scan(p);
   return woken ? 3 : 0;
 }
 

@@ -104,7 +104,9 @@ enum {
     FPC_COLL_FLAT = 6,
     FPC_COLL_SCHED = 7,
     FPC_WAIT_SPIN = 8,
-    FPC_WAIT_BELL = 9
+    FPC_WAIT_BELL = 9,
+    FPC_FLAT_PROGRESS = 10,
+    FPC_DEAD_PEER = 11   /* peers declared dead by the C lease scan */
 };
 
 static unsigned long long *fp_ctr;  /* live plane's counter block */
@@ -526,11 +528,16 @@ static void fp_block_req(cph p, long long cpid) {
         } else if (rc == 0) {
             /* idle timeout (no bell, nothing arrived): drop the spin,
              * run python progress occasionally so non-plane work (tcp
-             * accepts, spawned children) cannot starve */
+             * accepts, spawned children) cannot starve.  Once ANY
+             * failure is flagged (launcher event or a lease-scan
+             * detection inside the wait quantum), run it EVERY idle
+             * quantum: the python ULFM sweep is what errors our
+             * posted recvs (cp_error_req), and waiting 16 quanta for
+             * it stretches the containment deadline for no reason */
             slept = 1;
             if (fp_spin_us > 4)
                 fp_spin_us /= 2;
-            if (++idle % 16 == 0)
+            if (++idle % 16 == 0 || F.any_failed(p))
                 fp_py_progress();
         } else {
             /* rc 3: woken by the doorbell — the peer only progressed

@@ -42,12 +42,23 @@ def pytest_configure(config):
         "lint: static-analysis gate (bin/mv2tlint --strict) and the "
         "runtime lock-order detector smoke — tier-1 by default; run "
         "only these with -m lint")
+    config.addinivalue_line(
+        "markers",
+        "chaos: full fault-injection matrix (site x kind chaos sweeps, "
+        "mid-collective kills, churn) — a small seeded subset runs in "
+        "tier-1 unmarked; run the full matrix with -m chaos or "
+        "bin/runtests --chaos (or MV2T_TEST_FULL=1)")
 
 
 def pytest_collection_modifyitems(config, items):
     if os.environ.get("MV2T_TEST_FULL"):
         return
+    markexpr = config.getoption("-m", default="") or ""
     skip = pytest.mark.skip(reason="slow lane: set MV2T_TEST_FULL=1")
+    skip_chaos = pytest.mark.skip(
+        reason="chaos lane: run with -m chaos (or MV2T_TEST_FULL=1)")
     for item in items:
         if "slow" in item.keywords:
             item.add_marker(skip)
+        if "chaos" in item.keywords and "chaos" not in markexpr:
+            item.add_marker(skip_chaos)

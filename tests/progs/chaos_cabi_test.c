@@ -1,0 +1,76 @@
+/* Chaos through the C ABI: small allreduces loop on the flat-slot tier
+ * (fastpath.c -> cp_flat_*) while the NATIVE fault engine (MV2T_FAULTS
+ * flat_fold@<victim>:crash:...) kills one rank mid-wave. Survivors run
+ * with MPI_ERRORS_RETURN and must see MPIX_ERR_PROC_FAILED (lease
+ * detection inside the C flat wait — no launcher watcher), then
+ * revoke + shrink and finish a collective on the shrunken comm.
+ *
+ * Run: mpirun -np N  (MPIEXEC_ALLOW_FAULT=1, MV2T_FT_WATCHER=0,
+ *      MV2T_PEER_TIMEOUT=<small>)               prints "No Errors". */
+#include <mpi.h>
+#include <stdio.h>
+
+int main(void) {
+    MPI_Init(NULL, NULL);
+    MPI_Errhandler_set(MPI_COMM_WORLD, MPI_ERRORS_RETURN);
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+    int err = MPI_SUCCESS;
+    for (int i = 0; i < 500; i++) {
+        int s = rank + 1, r = 0;
+        int rc = MPI_Allreduce(&s, &r, 1, MPI_INT, MPI_SUM,
+                               MPI_COMM_WORLD);
+        if (rc != MPI_SUCCESS) {
+            err = rc;
+            break;
+        }
+        if (r != size * (size + 1) / 2) {
+            printf("rank %d: corrupt allreduce %d\n", rank, r);
+            fflush(stdout);
+            MPI_Abort(MPI_COMM_WORLD, 2);
+        }
+    }
+    if (err == MPI_SUCCESS) {
+        /* the victim never gets here (it crashed); a survivor that saw
+         * no error means containment failed to surface */
+        printf("rank %d: fault never surfaced\n", rank);
+        fflush(stdout);
+        MPI_Abort(MPI_COMM_WORLD, 3);
+    }
+    int cls = 0;
+    MPI_Error_class(err, &cls);
+    if (cls != MPIX_ERR_PROC_FAILED && cls != MPIX_ERR_REVOKED) {
+        printf("rank %d: unexpected error class %d\n", rank, cls);
+        fflush(stdout);
+        MPI_Abort(MPI_COMM_WORLD, 4);
+    }
+
+    MPIX_Comm_revoke(MPI_COMM_WORLD);
+    MPIX_Comm_failure_ack(MPI_COMM_WORLD);
+    MPI_Comm small;
+    if (MPIX_Comm_shrink(MPI_COMM_WORLD, &small) != MPI_SUCCESS) {
+        printf("rank %d: shrink failed\n", rank);
+        fflush(stdout);
+        MPI_Abort(MPI_COMM_WORLD, 5);
+    }
+    int nsz, nrank, s = 1, r = 0;
+    MPI_Comm_size(small, &nsz);
+    MPI_Comm_rank(small, &nrank);
+    if (MPI_Allreduce(&s, &r, 1, MPI_INT, MPI_SUM, small)
+            != MPI_SUCCESS || r != nsz) {
+        printf("rank %d: shrunken allreduce wrong (%d/%d)\n", rank, r,
+               nsz);
+        fflush(stdout);
+        MPI_Abort(MPI_COMM_WORLD, 6);
+    }
+    if (nrank == 0) {
+        printf("chaos-cabi: err_class=%d shrunk=%d\n", cls, nsz);
+        printf("No Errors\n");
+    }
+    fflush(stdout);
+    MPI_Comm_free(&small);
+    MPI_Finalize();
+    return 0;
+}

@@ -1,0 +1,158 @@
+"""Chaos rank program: drive the datapath through its tiers while the
+MV2T_FAULTS engine injects faults (crash-self, delay, duplicate, ...),
+then prove failure CONTAINMENT: every survivor must either complete
+correctly or unwind with MPIX_ERR_PROC_FAILED/MPIX_ERR_REVOKED inside
+the lease deadline — never hang, never return wrong data — and then
+recover via revoke + shrink and finish the remaining phases on the
+shrunken comm.
+
+Phases (MV2T_CHAOS_PHASES, default all):
+  pt2pt  eager ring exchange (shm send/recv sites)
+  rndv   512 KiB pairwise exchange (CMA/arena rendezvous sites)
+  flat   4-byte allreduce loop (flat-slot tier; native flat_fold site)
+  arena  1 MiB allreduce (arena/CMA sectioned tier)
+
+Output per survivor:  chaos: rank=R phase=P err=C detect_s=T
+plus the containment pvars, and 'No Errors' from the lowest survivor.
+Run under:  mpirun -np N (with MPIEXEC_ALLOW_FAULT=1 when a crash kind
+is armed; MV2T_FT_WATCHER=0 makes detection lease-only).
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from mvapich2_tpu import mpi, mpit                     # noqa: E402
+from mvapich2_tpu.core.errors import (MPIException,    # noqa: E402
+                                      MPIX_ERR_PROC_FAILED,
+                                      MPIX_ERR_REVOKED)
+
+PHASES = [p for p in os.environ.get(
+    "MV2T_CHAOS_PHASES", "pt2pt,rndv,flat,arena").split(",") if p]
+ITERS = int(os.environ.get("MV2T_CHAOS_ITERS", "30"))
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+world_size = comm.size
+
+# literal-SIGKILL mode ("<rank>:<seconds>"): the victim arms a timer
+# that SIGKILLs the process mid-phase — the acceptance-criteria shape
+# (no atexit, no departed-lease stamp, exactly like an OOM kill)
+_kill = os.environ.get("MV2T_CHAOS_SIGKILL")
+if _kill:
+    _kr, _kt = _kill.split(":")
+    if comm.rank == int(_kr):
+        import signal
+        import threading
+        threading.Timer(float(_kt),
+                        lambda: os.kill(os.getpid(),
+                                        signal.SIGKILL)).start()
+
+err_class = None
+err_phase = None
+detect_s = 0.0
+
+
+def checked(phase, fn):
+    """Run one faulted call; returns False once containment fired."""
+    global err_class, err_phase, detect_s
+    t0 = time.perf_counter()
+    try:
+        fn()
+        return True
+    except MPIException as e:
+        assert e.error_class in (MPIX_ERR_PROC_FAILED, MPIX_ERR_REVOKED), \
+            f"unexpected error class {e.error_class}: {e}"
+        err_class = e.error_class
+        err_phase = phase
+        detect_s = time.perf_counter() - t0
+        return False
+
+
+def run_phases(c, phases, iters=ITERS):
+    n = c.size
+    for phase in phases:
+        if phase == "pt2pt" and n > 1:
+            small = np.full(8, float(c.rank))
+            inbuf = np.zeros(8)
+            for _ in range(iters):
+                def ring():
+                    req = c.isend(small, dest=(c.rank + 1) % n, tag=11)
+                    st = c.recv(inbuf, source=(c.rank - 1) % n, tag=11)
+                    req.wait()
+                    assert inbuf[0] == float((c.rank - 1) % n), \
+                        f"pt2pt payload corrupt: {inbuf[0]}"
+                    assert st.source == (c.rank - 1) % n
+                if not checked(phase, ring):
+                    return False
+        elif phase == "rndv" and n > 1:
+            # ring-shaped so EVERY rank depends (transitively) on every
+            # other — a pairwise scheme would leave non-partner
+            # survivors untouched by the failure and desynchronized
+            # from the recovery collective
+            big = np.full(1 << 16, float(c.rank))      # 512 KiB f64
+            out = np.zeros(1 << 16)
+            src = (c.rank - 1) % n
+            for _ in range(max(2, iters // 10)):
+                def xchg():
+                    req = c.isend(big, dest=(c.rank + 1) % n, tag=13)
+                    c.recv(out, source=src, tag=13)
+                    req.wait()
+                    assert out[0] == float(src) \
+                        and out[-1] == float(src), \
+                        f"rndv payload corrupt: {out[0]}/{out[-1]}"
+                if not checked(phase, xchg):
+                    return False
+        elif phase == "flat" and n > 1:
+            s = np.full(1, np.int32(c.rank + 1))
+            r = np.zeros(1, np.int32)
+            expect = n * (n + 1) // 2
+            for _ in range(iters):
+                def tiny():
+                    c.allreduce(s, r)
+                    assert r[0] == expect, \
+                        f"flat allreduce corrupt: {r[0]} != {expect}"
+                if not checked(phase, tiny):
+                    return False
+        elif phase == "arena" and n > 1:
+            s = np.ones(1 << 17)                        # 1 MiB f64
+            r = np.zeros(1 << 17)
+            for _ in range(max(2, iters // 10)):
+                def big_ar():
+                    c.allreduce(s, r)
+                    assert r[0] == float(n) and r[-1] == float(n), \
+                        f"arena allreduce corrupt: {r[0]}/{r[-1]}"
+                if not checked(phase, big_ar):
+                    return False
+    return True
+
+
+clean = run_phases(comm, PHASES)
+final = comm
+if not clean:
+    # containment fired: recover (revoke -> ack -> shrink) and prove the
+    # shrunken comm works by re-running the remaining tiers on it
+    if not comm.revoked:
+        comm.revoke()
+    comm.failure_ack()
+    final = comm.shrink()
+    redo = [p for p in PHASES if p in ("pt2pt", "flat")]
+    assert run_phases(final, redo, iters=min(ITERS, 30)), \
+        "second failure during recovery"
+
+pv = {n: int(mpit.pvar(n).read())
+      for n in ("dead_peer_detections", "wait_deadline_trips",
+                "revokes_propagated", "faults_injected")}
+print(f"chaos: rank={comm.rank} phase={err_phase} err={err_class} "
+      f"detect_s={detect_s:.2f} shrunk={final.size} "
+      f"dead_peer_detections={pv['dead_peer_detections']} "
+      f"wait_deadline_trips={pv['wait_deadline_trips']} "
+      f"revokes_propagated={pv['revokes_propagated']} "
+      f"faults_injected={pv['faults_injected']}", flush=True)
+if final.rank == 0:
+    print("No Errors")
+mpi.Finalize()
+sys.exit(0)

@@ -1,0 +1,70 @@
+"""Churn chaos (ROADMAP item 3 scenario): repeated split/dup comm churn
+under allreduce load while a member — rank 0, the shm/arena LEADER —
+dies mid-churn. Survivors must unwind (lease detection, MV2T_FT_WATCHER
+off), revoke + shrink, and keep churning on the shrunken world; the
+dead leader's shm state is reclaimed afterwards by the stale-segment
+sweep (the harness verifies). Run under: mpirun -np 4 with
+MPIEXEC_ALLOW_FAULT=1 and a crash fault armed on rank 0.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from mvapich2_tpu import mpi                            # noqa: E402
+from mvapich2_tpu.core.errors import (MPIException,     # noqa: E402
+                                      MPIX_ERR_PROC_FAILED,
+                                      MPIX_ERR_REVOKED)
+
+ROUNDS = 12          # pre-failure budget (the victim dies well inside)
+POST_ROUNDS = 3      # fixed post-recovery rounds: every survivor runs
+                     # exactly these, whatever iteration it failed at,
+                     # so the shrunken world's collectives line up
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+
+
+def churn_round(c, i):
+    """One churn iteration: split, collective load on the halves, a
+    world-wide rendezvous-sized allreduce, free."""
+    sub = c.split(i % 2, c.rank)
+    out = sub.allreduce(np.full(16, 1.0))
+    assert out[0] == float(sub.size), out[0]
+    big = c.allreduce(np.ones(1 << 15))          # 256 KiB load
+    assert big[0] == float(c.size), big[0]
+    d = sub.dup()
+    d.free()
+    sub.free()
+
+
+err = None
+t_detect = 0.0
+for i in range(ROUNDS):
+    t0 = time.perf_counter()
+    try:
+        churn_round(comm, i)
+    except MPIException as e:
+        assert e.error_class in (MPIX_ERR_PROC_FAILED, MPIX_ERR_REVOKED), \
+            f"unexpected class {e.error_class}: {e}"
+        err = e.error_class
+        t_detect = time.perf_counter() - t0
+        break
+
+assert err is not None, "fault never fired (is MV2T_FAULTS armed?)"
+if not comm.revoked:
+    comm.revoke()
+comm.failure_ack()
+work = comm.shrink()
+assert work.size == comm.size - 1, (work.size, comm.size)
+for i in range(POST_ROUNDS):     # join/leave churn continues under load
+    churn_round(work, i)
+
+print(f"churn: rank={comm.rank} err={err} detect_s={t_detect:.2f} "
+      f"shrunk={work.size}", flush=True)
+if work.rank == 0:
+    print("No Errors")
+mpi.Finalize()
+sys.exit(0)

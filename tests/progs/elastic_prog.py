@@ -31,7 +31,16 @@ if parent is not None:
 
 state = np.array([123.0, 4.0, 5.0, 6.0])   # application state to survive
 
-if comm.rank == 1:
+# exercise the flat tier so the victim's region is live when it dies
+# (rebuild must re-key, not reuse, the poisoned lane)
+for _ in range(3):
+    comm.allreduce(np.ones(1, np.int32))
+
+# MV2T_ELASTIC_VICTIM=0 kills the flat-tier LEADER (lowest ring index:
+# lane owner + fold rank + shm/arena segment creator) — the worst case
+# for rebuild_world's re-keying
+VICTIM = int(os.environ.get("MV2T_ELASTIC_VICTIM", "1"))
+if comm.rank == VICTIM:
     os.kill(os.getpid(), 9)                # process failure (die.c analog)
 
 # survivors: wait for launcher-driven detection (SURVEY §5.3)

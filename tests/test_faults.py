@@ -1,0 +1,357 @@
+"""Failure-containment chaos suite (ISSUE 6).
+
+Three layers:
+  * unit tests of the MV2T_FAULTS engine (grammar, nth determinism,
+    rank scoping) — pure python, no processes;
+  * a SMALL seeded tier-1 matrix: one lease-detected crash through the
+    datapath, one flat-tier-leader kill through the native flat_fold
+    site, one fault-free-looking degradation case (simulated arena
+    exhaustion), plus the lease-overhead guard — each a real -np job,
+    deterministic, and bounded;
+  * the FULL site x kind matrix + churn behind the ``chaos`` marker
+    (bin/runtests --chaos, pytest -m chaos, or MV2T_TEST_FULL=1).
+
+Every chaos job runs with MV2T_FT_WATCHER=0: the launcher still
+publishes failure events (MPIEXEC_ALLOW_FAULT), but no rank listens —
+so a passing test PROVES the liveness leases + deadline waits did the
+detection, not the launcher.
+
+The automated matrix sticks to terminating kinds (crash/delay/
+duplicate, drop only at arena_alloc where it means clean fallback):
+``drop``/``truncate`` on transport sites model unrecoverable corruption
+— there is no retransmission layer — and are interactive-hunt tools.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROG = os.path.join(REPO, "tests", "progs", "chaos_prog.py")
+PEER_TIMEOUT = 2.0
+
+
+# ---------------------------------------------------------------------------
+# engine unit tests
+# ---------------------------------------------------------------------------
+
+def test_spec_parse_grammar():
+    from mvapich2_tpu import faults
+    specs = faults.parse(
+        "shm_send@2:crash:7:3,arena_alloc:drop,kvs:delay:1:4+")
+    assert len(specs) == 3
+    s0, s1, s2 = specs
+    assert (s0.site, s0.rank, s0.kind, s0.seed, s0.nth, s0.repeat) == \
+        ("shm_send", 2, "crash", 7, 3, False)
+    assert (s1.site, s1.rank, s1.kind, s1.nth) == \
+        ("arena_alloc", None, "drop", 1)
+    assert (s2.site, s2.kind, s2.nth, s2.repeat) == \
+        ("kvs", "delay", 4, True)
+    for bad in ("nosite:crash", "shm_send:explode", "shm_send",
+                "shm_send:crash:0:0"):
+        with pytest.raises(ValueError):
+            faults.parse(bad)
+
+
+def test_fire_nth_deterministic(monkeypatch):
+    from mvapich2_tpu import faults
+    from mvapich2_tpu.utils.config import get_config
+    get_config().set("FAULTS", "shm_send:drop:0:3")
+    try:
+        faults.configure(0)
+        hits = [faults.fire("shm_send") for _ in range(6)]
+        assert hits == [None, None, "drop", None, None, None]
+        # reconfigure resets the counter: same sequence again
+        faults.configure(0)
+        hits = [faults.fire("shm_send") for _ in range(6)]
+        assert hits == [None, None, "drop", None, None, None]
+        # repeat form fires from nth on
+        get_config().set("FAULTS", "shm_send:drop:0:2+")
+        faults.configure(0)
+        hits = [faults.fire("shm_send") for _ in range(5)]
+        assert hits == [None, "drop", "drop", "drop", "drop"]
+    finally:
+        get_config().set("FAULTS", "")
+        faults.deconfigure()
+
+
+def test_fire_rank_scoping_and_off_cost():
+    from mvapich2_tpu import faults
+    from mvapich2_tpu.utils.config import get_config
+    get_config().set("FAULTS", "shm_send@3:drop")
+    try:
+        assert faults.configure(2) == 0      # spec scoped to rank 3
+        assert faults.fire("shm_send") is None
+        assert faults.configure(3) == 1
+        assert faults.fire("shm_send") == "drop"
+        # flat_fold is a native site: never armed python-side
+        get_config().set("FAULTS", "flat_fold@3:crash")
+        assert faults.configure(3) == 0
+    finally:
+        get_config().set("FAULTS", "")
+        faults.deconfigure()
+    assert faults.fire("shm_send") is None   # off = single attribute test
+
+
+def test_peer_dead_error_type():
+    from mvapich2_tpu.core.errors import (MPIException, PeerDeadError,
+                                          MPIX_ERR_PROC_FAILED)
+    e = PeerDeadError(3, 2.5, "unit")
+    assert isinstance(e, MPIException)
+    assert e.error_class == MPIX_ERR_PROC_FAILED
+    assert e.world_rank == 3 and e.age_s == 2.5
+    assert "lease expired" in str(e)
+
+
+def test_containment_pvars_registered():
+    from mvapich2_tpu import mpit
+    for name in ("faults_injected", "dead_peer_detections",
+                 "wait_deadline_trips", "revokes_propagated",
+                 "arena_reclaimed_dead"):
+        assert mpit.pvar_get_index(name) >= 0
+
+
+# ---------------------------------------------------------------------------
+# chaos job harness
+# ---------------------------------------------------------------------------
+
+def _chaos(np_, faults_spec, phases, timeout=180, strict=False,
+           extra_env=None):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               MV2T_FAULTS=faults_spec,
+               MV2T_CHAOS_PHASES=phases,
+               MV2T_PEER_TIMEOUT=str(PEER_TIMEOUT),
+               MV2T_FT_WATCHER="0")
+    if not strict:
+        env["MPIEXEC_ALLOW_FAULT"] = "1"
+    if extra_env:
+        env.update(extra_env)
+    r = subprocess.run(
+        [sys.executable, "-m", "mvapich2_tpu.run", "-np", str(np_),
+         sys.executable, PROG],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=timeout)
+    assert r.returncode == 0, \
+        f"spec={faults_spec}\nstdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "No Errors" in r.stdout, \
+        f"spec={faults_spec}\nstdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    # regex, not line-based: concurrent ranks' report lines can merge
+    # on the shared stdout pipe, and a line-splitter silently drops the
+    # second half of a merged pair
+    pat = re.compile(
+        r"chaos: rank=(\d+) phase=(\S+) err=(\S+) detect_s=([\d.]+) "
+        r"shrunk=(\d+) dead_peer_detections=(\d+) "
+        r"wait_deadline_trips=(\d+) revokes_propagated=(\d+) "
+        r"faults_injected=(\d+)")
+    keys = ("rank", "phase", "err", "detect_s", "shrunk",
+            "dead_peer_detections", "wait_deadline_trips",
+            "revokes_propagated", "faults_injected")
+    lines = [dict(zip(keys, m.groups())) for m in pat.finditer(r.stdout)]
+    assert lines, f"no survivor report lines:\n{r.stdout}"
+    return lines, r
+
+
+def _assert_contained(lines, expect_shrunk):
+    """Every survivor unwound inside the lease deadline and recovered."""
+    saw_err = False
+    for ln in lines:
+        if ln["err"] != "None":
+            saw_err = True
+            assert float(ln["detect_s"]) < 2 * PEER_TIMEOUT + 20, \
+                f"containment too slow: {ln}"   # 2x timeout + 1-core slack
+            assert int(ln["shrunk"]) == expect_shrunk, ln
+    assert saw_err, f"no survivor saw the failure: {lines}"
+    assert any(int(ln["dead_peer_detections"]) > 0 for ln in lines), \
+        f"lease detection never fired: {lines}"
+    assert any(int(ln["revokes_propagated"]) > 0 for ln in lines), \
+        f"revoke never propagated: {lines}"
+
+
+# ---------------------------------------------------------------------------
+# tier-1 deterministic subset (seeded, bounded)
+# ---------------------------------------------------------------------------
+
+def test_crash_in_pt2pt_detected_by_lease():
+    """Rank 1 crash-selfs on its 10th shm send; the launcher watcher is
+    OFF, so survivors can only unwind via the liveness leases — and must
+    do so within 2x MV2T_PEER_TIMEOUT, then shrink and finish."""
+    lines, _ = _chaos(4, "shm_send@1:crash:1:10", "pt2pt,flat")
+    _assert_contained(lines, expect_shrunk=3)
+
+
+def test_crash_of_flat_leader_mid_collective():
+    """Rank 0 — the flat-tier leader (lowest ring index = the lane
+    owner and the rank that folds) — dies INSIDE a flat wave via the
+    native flat_fold site. Survivors' flat waits must lease-detect,
+    poison the region, degrade, and recover on a shrunken comm whose
+    lane is re-derived from the surviving membership."""
+    lines, _ = _chaos(4, "flat_fold@0:crash:1:5", "flat")
+    _assert_contained(lines, expect_shrunk=3)
+    assert all(int(ln["wait_deadline_trips"]) >= 0 for ln in lines)
+
+
+def test_arena_exhaustion_falls_back_cleanly():
+    """Simulated arena exhaustion (drop at arena_alloc, every call):
+    no death — the job must complete CORRECTLY on the fallback paths,
+    with the injections counted. strict=True: any rank error fails."""
+    lines, _ = _chaos(2, "arena_alloc:drop:0:1+", "rndv,arena",
+                      strict=True,
+                      extra_env={"MV2T_USE_CMA": "0"})
+    for ln in lines:
+        assert ln["err"] == "None", ln
+    assert any(int(ln["faults_injected"]) > 0 for ln in lines)
+
+
+def test_lease_overhead_within_smoke_budget():
+    """Fault-free overhead guard: with leases armed at a TIGHT timeout
+    (0.5 s — 20x more scanning than the default), the small-message
+    smoke must stay inside the same tier-1 budgets as
+    tests/test_perf_smoke.py. The heartbeat is a thread and the scans
+    are throttled to timeout/4, so the hot path carries one attribute
+    test + an occasional clock read."""
+    from test_perf_smoke import (PINGPONG_BUDGET_US,
+                                 TINY_ALLREDUCE_BUDGET_US)
+    prog = os.path.join(REPO, "tests", "progs", "smallmsg_smoke_prog.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MV2T_PEER_TIMEOUT="0.5")
+    r = subprocess.run(
+        [sys.executable, "-m", "mvapich2_tpu.run", "-np", "4",
+         sys.executable, prog],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert "No Errors" in r.stdout
+    pp = float(re.search(r"pingpong_8B_halfrtt_us=([0-9.]+)",
+                         r.stdout).group(1))
+    ar = float(re.search(r"allreduce_4B_avg_us=([0-9.]+)",
+                         r.stdout).group(1))
+    assert pp < PINGPONG_BUDGET_US, \
+        f"leases slowed 8B pingpong to {pp:.0f} us"
+    assert ar < TINY_ALLREDUCE_BUDGET_US, \
+        f"leases slowed 4B allreduce to {ar:.0f} us"
+
+
+# ---------------------------------------------------------------------------
+# full matrix (chaos lane)
+# ---------------------------------------------------------------------------
+
+# (spec, phases, np, strict, env) — strict jobs inject non-fatal kinds
+# and must complete CORRECTLY; non-strict jobs kill a rank and must
+# contain. arena_alloc entries force the staged (non-CMA) rendezvous so
+# the arena allocator is actually on the path.
+_NOCMA = {"MV2T_USE_CMA": "0"}
+_MATRIX = [
+    ("shm_send@1:crash:1:3", "pt2pt,flat", 4, False, None),
+    ("shm_send@2:delay:3:1+", "pt2pt,flat", 4, True, None),
+    ("shm_send@1:duplicate:0:3", "pt2pt", 4, True, None),
+    # shm_recv fires on python-routed packets (rendezvous control); the
+    # C plane matches plane-owned eager internally without touching it
+    ("shm_recv@2:delay:5:1+", "rndv", 4, True, _NOCMA),
+    ("rndv_chunk@1:crash:1:2", "rndv", 4, False, _NOCMA),
+    ("rndv_chunk@0:delay:5:1+", "rndv", 2, True, _NOCMA),
+    ("flat_fold@2:crash:1:7", "flat", 8, False, None),   # np=8 member
+    ("flat_fold@0:crash:1:3", "flat", 8, False, None),   # np=8 LEADER
+    ("flat_fold@1:delay:9:1+", "flat", 4, True, None),
+    ("arena_alloc@1:crash:2:2", "rndv,arena", 4, False, _NOCMA),
+    ("arena_alloc:drop:0:2+", "arena", 4, True, _NOCMA),
+    ("kvs@1:delay:7:1+", "pt2pt", 2, True, None),
+]
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("spec,phases,np_,strict,env", _MATRIX,
+                         ids=[m[0] for m in _MATRIX])
+def test_chaos_matrix(spec, phases, np_, strict, env):
+    lines, _ = _chaos(np_, spec, phases, strict=strict, timeout=300,
+                      extra_env=env)
+    if strict:
+        for ln in lines:
+            assert ln["err"] == "None", f"{spec}: {ln}"
+        assert any(int(ln["faults_injected"]) > 0 for ln in lines) \
+            or spec.startswith(("flat_fold", "kvs")), lines
+    else:
+        _assert_contained(lines, expect_shrunk=np_ - 1)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("np_,victim", [(4, 2), (8, 0)],
+                         ids=["np4-member", "np8-leader"])
+def test_chaos_cabi_flat_crash(np_, victim):
+    """Acceptance: containment demonstrated through the C ABI — pure C
+    ranks (fastpath.c dispatch, no interpreter on the hot path) loop
+    flat allreduces while the NATIVE fault engine kills one mid-wave;
+    survivors' C flat waits lease-detect, return MPIX_ERR_PROC_FAILED,
+    and revoke+shrink through the MPIX_* C surface."""
+    import shutil
+    import tempfile
+    if shutil.which("gcc") is None or shutil.which("python3-config") \
+            is None:
+        pytest.skip("no C toolchain")
+    out = os.path.join(tempfile.mkdtemp(), "chaos_cabi_test")
+    src = os.path.join(REPO, "tests", "progs", "chaos_cabi_test.c")
+    rc = subprocess.run([os.path.join(REPO, "bin", "mpicc"), src, "-o",
+                         out], capture_output=True, text=True,
+                        timeout=180)
+    assert rc.returncode == 0, f"mpicc failed:\n{rc.stdout}\n{rc.stderr}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MV2T_FAULTS=f"flat_fold@{victim}:crash:1:9",
+               MV2T_PEER_TIMEOUT=str(PEER_TIMEOUT),
+               MV2T_FT_WATCHER="0", MPIEXEC_ALLOW_FAULT="1")
+    r = subprocess.run(
+        [sys.executable, "-m", "mvapich2_tpu.run", "-np", str(np_), out],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert "No Errors" in r.stdout, f"{r.stdout}\n{r.stderr}"
+    m = re.search(r"chaos-cabi: err_class=(\d+) shrunk=(\d+)", r.stdout)
+    assert m, r.stdout
+    assert int(m.group(1)) in (75, 76)
+    assert int(m.group(2)) == np_ - 1
+
+
+@pytest.mark.chaos
+def test_chaos_churn_join_leave_under_load():
+    """ROADMAP item-3 scenario: repeated split/dup churn under allreduce
+    load; a member dies mid-churn; survivors shrink and keep churning;
+    the dead leader's shm arena segment is reclaimed by the stale-sweep
+    afterwards (verified here by running the sweep the next bootstrap
+    would run)."""
+    prog = os.path.join(REPO, "tests", "progs", "churn_chaos_prog.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MV2T_PEER_TIMEOUT=str(PEER_TIMEOUT),
+               MV2T_FT_WATCHER="0", MPIEXEC_ALLOW_FAULT="1",
+               # churn traffic rides the C tiers (flat waves, C gather,
+               # CMA/arena) — the native flat_fold site is the one on
+               # the actual hot path; ~1 fold/round puts event 10 a few
+               # rounds into the churn
+               MV2T_FAULTS="flat_fold@0:crash:1:10")
+    r = subprocess.run(
+        [sys.executable, "-m", "mvapich2_tpu.run", "-np", "4",
+         sys.executable, prog],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert "No Errors" in r.stdout, f"{r.stdout}\n{r.stderr}"
+    # the victim was rank 0 = shm/arena leader: its segments outlive it;
+    # the next leader's bootstrap sweep must reclaim them
+    from mvapich2_tpu.transport.arena import ShmArena
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    if base:
+        ShmArena.sweep_stale(base)   # idempotent; counts via pvar
+        import re as _re
+        stale = [n for n in os.listdir(base)
+                 if _re.match(r"mv2t-arena-(\d+)-", n)
+                 and not _pid_alive(int(_re.match(
+                     r"mv2t-arena-(\d+)-", n).group(1)))]
+        assert not stale, f"dead-owned arena segments survived: {stale}"
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True

@@ -308,3 +308,62 @@ def test_elastic_rebuild_world():
                        timeout=240)
     assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
     assert "No Errors" in r.stdout
+
+
+@pytest.mark.chaos
+def test_elastic_rebuild_flat_leader_death():
+    """rebuild_world when the failed rank was the flat-tier LEADER
+    (rank 0: lane owner = min member ring index, fold rank, and the
+    shm/arena segment creator). The shrunken comm must re-derive its
+    lane from the surviving membership and re-key flat regions on its
+    fresh context id — the old lane is sticky-poisoned, never reused
+    (ft/elastic._rekey_flat)."""
+    prog = os.path.join(REPO, "tests", "progs", "elastic_prog.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MV2T_ELASTIC_VICTIM="0",
+               MV2T_PEER_TIMEOUT="2")
+    cmd = [sys.executable, "-m", "mvapich2_tpu.run", "--ft", "-np", "3",
+           sys.executable, prog]
+    r = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                       text=True, timeout=240)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+
+
+@pytest.mark.chaos
+def test_sigkill_mid_flat_allreduce_np8():
+    """Acceptance shape: literal SIGKILL of a mid-table rank during an
+    np=8 4-byte flat allreduce loop; survivors must return
+    MPIX_ERR_PROC_FAILED within the lease deadline (watcher off) and
+    recover on the shrunken comm."""
+    _run_sigkill_chaos(np_=8, victim=3, phases="flat", iters=200000)
+
+
+@pytest.mark.chaos
+def test_sigkill_mid_arena_allreduce_np4():
+    """Literal SIGKILL during the 1 MiB arena/CMA-tier allreduce."""
+    _run_sigkill_chaos(np_=4, victim=2, phases="arena", iters=20000)
+
+
+@pytest.mark.chaos
+def test_sigkill_mid_cma_rendezvous_np4():
+    """Literal SIGKILL during the pipelined CMA rendezvous exchange."""
+    _run_sigkill_chaos(np_=4, victim=1, phases="rndv", iters=20000)
+
+
+def _run_sigkill_chaos(np_, victim, phases, iters):
+    prog = os.path.join(REPO, "tests", "progs", "chaos_prog.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MV2T_CHAOS_PHASES=phases,
+               MV2T_CHAOS_ITERS=str(iters),
+               MV2T_CHAOS_SIGKILL=f"{victim}:1.5",
+               MV2T_PEER_TIMEOUT="2", MV2T_FT_WATCHER="0",
+               MPIEXEC_ALLOW_FAULT="1")
+    cmd = [sys.executable, "-m", "mvapich2_tpu.run", "-np", str(np_),
+           sys.executable, prog]
+    r = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert any("err=75" in ln or "err=76" in ln
+               for ln in r.stdout.splitlines()
+               if ln.startswith("chaos: ")), r.stdout
