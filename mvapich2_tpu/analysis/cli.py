@@ -67,7 +67,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="machine-readable findings on stdout")
     opts = ap.parse_args(argv)
 
-    passes = all_passes()
+    # explicit paths: C/C++ files route to the native pass, .py files to
+    # the AST passes; with no paths the native pass lints the committed
+    # native tree (+ the cross-language layout check)
+    c_exts = (".c", ".cpp", ".cc", ".h", ".hpp")
+    c_paths = [p for p in (opts.paths or []) if p.endswith(c_exts)]
+    py_paths = [p for p in (opts.paths or []) if not p.endswith(c_exts)]
+    if opts.paths:
+        passes = all_passes(native_sources=c_paths, native_layout=False)
+    else:
+        passes = all_passes()
     if opts.list_passes:
         for p in passes:
             print(f"{p.id:<12} {p.doc}")
@@ -81,7 +90,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         passes = [p for p in passes if p.id in want]
 
-    paths = opts.paths or [PKG_ROOT]
+    paths = py_paths if opts.paths else [PKG_ROOT]
     modules, parse_errors = scan_paths(paths)
     findings = parse_errors + run_passes(modules, passes)
 

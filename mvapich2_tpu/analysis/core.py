@@ -149,11 +149,19 @@ def scan_paths(paths: Sequence[str]) -> Tuple[List[SourceModule], List[Finding]]
     return modules, errors
 
 
-def all_passes() -> List[LintPass]:
-    from . import blocking, locks, registry, tags, traceguard
+def all_passes(native_sources: Optional[Sequence[str]] = None,
+               native_layout: bool = True) -> List[LintPass]:
+    """The full pass set. ``native_sources`` overrides the C file set of
+    the native pass (fixture tests); None = the committed native tree.
+    ``native_layout`` gates the cross-language layout check (only
+    meaningful against the real repo)."""
+    from . import blocking, locks, native, registry, tags, traceguard
     return [locks.LockDisciplinePass(), tags.TagNamespacePass(),
             registry.RegistryPass(), blocking.BlockingCallPass(),
-            traceguard.TraceGuardPass()]
+            traceguard.TraceGuardPass(),
+            native.NativeSourcePass(
+                list(native_sources) if native_sources is not None else None,
+                layout=native_layout)]
 
 
 def run_passes(modules: List[SourceModule],

@@ -1,0 +1,62 @@
+"""Shm-protocol model checker.
+
+The native datapath's three lock-free protocols — the seqlock flat-wave
+collective (cplane.cpp cp_flat_*), the adaptive doorbell wait/wake
+(ShmChannel + cp_wait_quantum), and the liveness-lease failure detector
+— re-expressed as small interleaved state machines, explored
+exhaustively (bounded) by ``explorer.explore``. The mv2tlint ``native``
+pass proves the C sources USE the atomic idioms; this package proves
+the PROTOCOLS those idioms implement are actually safe under every
+interleaving the memory model allows:
+
+  * no-torn-read-delivered + agreement  (seqlock.build_allreduce)
+  * poison stickiness across ctx reuse  (seqlock.build_allreduce crash=)
+  * fan-in-first bcast numbering        (seqlock.build_bcast)
+  * no lost wakeup                      (doorbell.build)
+  * death detected within 2x timeout,
+    clean departure never a failure     (lease.build)
+
+Every model takes ``mutation=<name>`` seeding a realistic protocol
+break (stamp-before-copy, missing final poll, throttle past the
+deadline, ...); tests/test_modelcheck.py asserts the checker catches
+each one and that the unmutated models are violation-free.
+"""
+
+from . import doorbell, lease, seqlock  # noqa: F401
+from .explorer import Model, Result, Transition, Violation, explore  # noqa: F401
+
+
+def mutation_matrix():
+    """[(model label, builder kwargs -> Model, mutation name)] — every
+    seeded protocol break the checker must catch. Builders are zero-arg
+    callables returning the smallest model that exhibits the bug."""
+    return [
+        ("seqlock-allreduce", lambda: seqlock.build_allreduce(
+            n=2, waves=1, mutation="stamp_before_copy"),
+         "stamp_before_copy"),
+        ("seqlock-allreduce", lambda: seqlock.build_allreduce(
+            n=2, waves=1, mutation="no_reader_guard"),
+         "no_reader_guard"),
+        ("seqlock-allreduce", lambda: seqlock.build_allreduce(
+            n=2, waves=2, mutation="no_overwrite_guard"),
+         "no_overwrite_guard"),
+        ("seqlock-allreduce", lambda: seqlock.build_allreduce(
+            n=2, waves=1, crash=True, mutation="no_poison"),
+         "no_poison"),
+        ("seqlock-bcast", lambda: seqlock.build_bcast(
+            n=3, mutation="no_arrival_wave"),
+         "no_arrival_wave"),
+        ("doorbell", lambda: doorbell.build(mutation="no_final_poll"),
+         "no_final_poll"),
+        ("doorbell", lambda: doorbell.build(mutation="ring_before_publish"),
+         "ring_before_publish"),
+        ("lease", lambda: lease.build(depart=True,
+                                      mutation="departed_stale"),
+         "departed_stale"),
+        ("lease", lambda: lease.build(crash=True,
+                                      mutation="throttle_too_long"),
+         "throttle_too_long"),
+        ("lease", lambda: lease.build(crash=True,
+                                      mutation="inverted_compare"),
+         "inverted_compare"),
+    ]

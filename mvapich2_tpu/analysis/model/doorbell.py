@@ -1,0 +1,177 @@
+"""Doorbell wait/wake model (ShmChannel adaptive bell + cp_wait_quantum).
+
+The discipline under test is the advertise-sleep / final-poll / sleep
+order on the receiver against the enqueue / read-flag / maybe-ring
+order on the sender. The model's interleaving semantics IS sequential
+consistency — which is exactly what the seq_cst advertise store the
+mv2tlint native pass enforces buys the real code; a relaxed-order
+implementation would not be entitled to this model.
+
+  receiver: poll -> (miss) set flag -> FINAL POLL -> sleep -> wake on
+            bell, clear flag, consume
+  sender:   enqueue -> read flag -> ring iff flag set
+
+Properties: no deadlock (a sleeping receiver with a queued message and
+no pending bell is the lost wakeup), and the message is consumed in
+every complete run.
+
+Mutations:
+  no_final_poll        receiver sleeps without the post-advertise poll
+  ring_before_publish  sender samples the flag BEFORE enqueueing and
+                       rings based on that stale sample
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .explorer import Model, Transition
+
+
+def build(mutation: Optional[str] = None) -> Model:
+    init = {"q": 0, "flag": 0, "bell": 0, "got": 0,
+            "rpc": 0, "spc": 0, "splan": 0}
+
+    def g_poll_hit(s):
+        return s["rpc"] == 0 and s["q"] > 0 and s["got"] == 0
+
+    def a_poll_hit(s):
+        s["q"] -= 1
+        s["got"] += 1
+        return s
+
+    def g_poll_miss(s):
+        return s["rpc"] == 0 and s["q"] == 0 and s["got"] == 0
+
+    def a_poll_miss(s):
+        s["rpc"] = 1
+        return s
+
+    def a_advertise(s):
+        s["flag"] = 1
+        # MUTANT: skip the final poll, go straight to sleep
+        s["rpc"] = 3 if mutation == "no_final_poll" else 2
+        return s
+
+    def g_final_hit(s):
+        return s["rpc"] == 2 and s["q"] > 0
+
+    def a_final_hit(s):
+        s["flag"] = 0
+        s["q"] -= 1
+        s["got"] += 1
+        s["rpc"] = 0
+        return s
+
+    def g_final_miss(s):
+        return s["rpc"] == 2 and s["q"] == 0
+
+    def a_final_miss(s):
+        s["rpc"] = 3                             # asleep
+        return s
+
+    def g_wake(s):
+        return s["rpc"] == 3 and s["bell"] > 0
+
+    def a_wake(s):
+        s["bell"] = 0
+        s["flag"] = 0
+        s["rpc"] = 0
+        return s
+
+    # sender ----------------------------------------------------------
+    if mutation == "ring_before_publish":
+        def g_s0(s):
+            return s["spc"] == 0
+
+        def a_s0(s):                              # MUTANT: stale sample
+            s["splan"] = s["flag"]
+            s["spc"] = 1
+            return s
+
+        def g_s1(s):
+            return s["spc"] == 1
+
+        def a_s1(s):
+            s["q"] += 1
+            s["spc"] = 2
+            return s
+
+        def g_s2(s):
+            return s["spc"] == 2
+
+        def a_s2(s):
+            if s["splan"]:
+                s["bell"] = 1
+            s["spc"] = 3
+            return s
+
+        sender = [
+            Transition("s.sample_flag", "s", g_s0, a_s0,
+                       frozenset({"spc", "flag"}),
+                       frozenset({"splan", "spc"})),
+            Transition("s.enqueue", "s", g_s1, a_s1,
+                       frozenset({"spc"}), frozenset({"q", "spc"})),
+            Transition("s.maybe_ring", "s", g_s2, a_s2,
+                       frozenset({"spc", "splan"}),
+                       frozenset({"bell", "spc"})),
+        ]
+    else:
+        def g_s0(s):
+            return s["spc"] == 0
+
+        def a_s0(s):
+            s["q"] += 1
+            s["spc"] = 1
+            return s
+
+        def g_s1(s):
+            return s["spc"] == 1
+
+        def a_s1(s):
+            if s["flag"]:
+                s["bell"] = 1
+            s["spc"] = 2
+            return s
+
+        sender = [
+            Transition("s.enqueue", "s", g_s0, a_s0,
+                       frozenset({"spc"}), frozenset({"q", "spc"})),
+            Transition("s.ring_if_asleep", "s", g_s1, a_s1,
+                       frozenset({"spc", "flag"}),
+                       frozenset({"bell", "spc"})),
+        ]
+
+    ts = [
+        Transition("r.poll_hit", "r", g_poll_hit, a_poll_hit,
+                   frozenset({"rpc", "q", "got"}),
+                   frozenset({"q", "got"})),
+        Transition("r.poll_miss", "r", g_poll_miss, a_poll_miss,
+                   frozenset({"rpc", "q", "got"}), frozenset({"rpc"})),
+        Transition("r.advertise", "r",
+                   lambda s: s["rpc"] == 1, a_advertise,
+                   frozenset({"rpc"}), frozenset({"flag", "rpc"})),
+        Transition("r.final_poll_hit", "r", g_final_hit, a_final_hit,
+                   frozenset({"rpc", "q"}),
+                   frozenset({"flag", "q", "got", "rpc"})),
+        Transition("r.final_poll_miss", "r", g_final_miss, a_final_miss,
+                   frozenset({"rpc", "q"}), frozenset({"rpc"})),
+        Transition("r.wake", "r", g_wake, a_wake,
+                   frozenset({"rpc", "bell"}),
+                   frozenset({"bell", "flag", "rpc"})),
+    ] + sender
+
+    def inv_lost_wake(s):
+        # stronger than bare deadlock: name the bug while it is forming
+        if s["rpc"] == 3 and s["q"] > 0 and s["bell"] == 0 \
+                and s["spc"] >= (3 if mutation == "ring_before_publish"
+                                 else 2):
+            return ("receiver asleep with a queued message, sender done, "
+                    "no bell pending — lost wakeup")
+        return None
+
+    def final(s):
+        return s["got"] == 1
+
+    return Model(f"doorbell(mut={mutation})", init, ts,
+                 [("no-lost-wake", inv_lost_wake)], final)

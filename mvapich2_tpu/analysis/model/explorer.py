@@ -1,0 +1,189 @@
+"""Bounded exhaustive interleaving explorer with DPOR-style sleep sets.
+
+A model is a set of guarded transitions over one shared state dict
+(values must be hashable). ``explore`` enumerates interleavings
+depth-first:
+
+  * ``reduce=False`` (the default, and what tier-1 runs): plain
+    memoized DFS — every reachable state is visited exactly once and
+    every invariant is evaluated on every reachable state. Genuinely
+    exhaustive within the model's bounds.
+  * ``reduce=True``: classic sleep-set pruning on top. After branch
+    ``t1`` is fully explored from a state, ``t1`` enters the sleep set
+    for the remaining branches and is carried into successor states
+    until a dependent transition (write/read overlap on another actor)
+    wakes it. Search nodes are memoized on (state, sleep set), which
+    keeps the pruning sound for the safety properties checked here —
+    tests assert reduced and full mode agree on every model in the
+    mutation matrix.
+
+Deadlock (no transition enabled in a non-final state) is always a
+violation: the lost-wakeup and numbering-desync bugs the models seed
+manifest exactly that way.
+
+Determinism: transitions fire in declaration order; dict states are
+frozen to sorted tuples. No wall clock, no randomness — a violation
+trace replays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+State = Dict[str, object]
+Key = Tuple[Tuple[str, object], ...]
+
+
+@dataclass(frozen=True)
+class Transition:
+    """One atomic protocol step of one actor.
+
+    ``reads``/``writes`` name the state keys the step touches — the
+    independence relation for sleep-set pruning. Over-approximating is
+    safe (less pruning); under-approximating is NOT.
+    """
+    name: str
+    actor: str
+    guard: Callable[[State], bool]
+    apply: Callable[[State], State]
+    reads: FrozenSet[str] = frozenset()
+    writes: FrozenSet[str] = frozenset()
+
+
+@dataclass
+class Model:
+    name: str
+    init: State
+    transitions: Sequence[Transition]
+    # invariant: state -> error message (None = holds)
+    invariants: Sequence[Tuple[str, Callable[[State], Optional[str]]]]
+    # states where "nothing enabled" is legal termination
+    is_final: Callable[[State], bool] = lambda s: True
+
+
+@dataclass
+class Violation:
+    invariant: str            # invariant name, or "deadlock"
+    message: str
+    state: State
+    trace: List[str]          # transition names from init
+
+
+@dataclass
+class Result:
+    model: str
+    states: int = 0
+    fired: int = 0
+    complete: bool = True     # False = truncated by max_states
+    violations: List[Violation] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def violated(self, invariant: str) -> bool:
+        return any(v.invariant == invariant for v in self.violations)
+
+
+def _freeze(state: State) -> Key:
+    return tuple(sorted(state.items()))
+
+
+def _dependent(a: Transition, b: Transition) -> bool:
+    if a.actor == b.actor:
+        return True
+    return bool(a.writes & (b.reads | b.writes)) \
+        or bool(b.writes & a.reads)
+
+
+def explore(model: Model, max_states: int = 500000,
+            max_violations: int = 16, reduce: bool = False) -> Result:
+    """Exhaustively explore ``model`` within ``max_states`` search
+    nodes. Stops early once ``max_violations`` distinct violations are
+    collected (each invariant reports at most once per distinct
+    message)."""
+    res = Result(model.name)
+    seen_viol: set = set()
+
+    def check(state: State, trace: List[str]) -> None:
+        for name, pred in model.invariants:
+            msg = pred(state)
+            if msg is not None and (name, msg) not in seen_viol:
+                seen_viol.add((name, msg))
+                res.violations.append(
+                    Violation(name, msg, dict(state), list(trace)))
+
+    # stack entries: (state, key, enabled list, next index, sleep set,
+    #                 trace length on entry)
+    init = dict(model.init)
+    visited: set = set()
+    trace: List[str] = []
+
+    def node_key(key: Key, sleep: FrozenSet[int]) -> Tuple:
+        return (key, sleep) if reduce else key
+
+    enabled0 = [t for t in model.transitions if t.guard(init)]
+    key0 = _freeze(init)
+    visited.add(node_key(key0, frozenset()))
+    check(init, trace)
+    if not enabled0 and not model.is_final(init):
+        res.violations.append(Violation("deadlock", "no transition "
+                                        "enabled in initial state",
+                                        dict(init), []))
+    stack: List[list] = [[init, enabled0, 0, frozenset()]]
+
+    while stack:
+        if len(res.violations) >= max_violations:
+            break
+        if res.states >= max_states:
+            res.complete = False
+            break
+        frame = stack[-1]
+        state, enabled, idx, sleep = frame
+        if idx >= len(enabled):
+            stack.pop()
+            if trace:
+                trace.pop()
+            continue
+        frame[2] += 1
+        t = enabled[idx]
+        ti = model.transitions.index(t)
+        if reduce and ti in sleep:
+            # pruned: an independent sibling subtree already covers it
+            continue
+        new_state = t.apply(dict(state))
+        res.fired += 1
+        new_key = _freeze(new_state)
+        # sleep set carried into the successor: executed-earlier
+        # siblings stay asleep until a dependent transition fires
+        new_sleep = frozenset(
+            j for j in sleep
+            if not _dependent(model.transitions[j], t)) if reduce \
+            else frozenset()
+        # siblings explored before t at THIS node go to sleep for t's
+        # subtree when independent of t
+        if reduce:
+            for k in range(idx):
+                u = enabled[k]
+                uj = model.transitions.index(u)
+                if not _dependent(u, t):
+                    new_sleep |= {uj}
+        nk = node_key(new_key, new_sleep)
+        trace.append(t.name)
+        if nk in visited:
+            trace.pop()
+            continue
+        visited.add(nk)
+        res.states += 1
+        check(new_state, trace)
+        new_enabled = [u for u in model.transitions if u.guard(new_state)]
+        if not new_enabled and not model.is_final(new_state):
+            if ("deadlock", "dl") not in seen_viol:
+                seen_viol.add(("deadlock", "dl"))
+                res.violations.append(
+                    Violation("deadlock",
+                              "no transition enabled in a non-final "
+                              "state", dict(new_state), list(trace)))
+        stack.append([new_state, new_enabled, 0, new_sleep])
+    return res

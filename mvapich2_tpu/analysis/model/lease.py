@@ -1,0 +1,126 @@
+"""Liveness-lease model (heartbeat stamps + throttled scan, PR 6).
+
+Discrete bounded time. Each tick advances the clock AND refreshes the
+victim's heartbeat stamp while it lives (the dedicated heartbeat thread
+stamps ~10x per timeout, so at tick granularity a live victim is always
+fresh). The scanner fires when due; time cannot step over a due scan —
+the modeling analog of "every blocking wait runs the throttled scan",
+which is what the real code guarantees by scanning from cp_wait_quantum,
+flat_wait, and the python progress sleep points.
+
+Properties:
+  detect-within-deadline  a crashed victim is flagged failed no later
+                          than died_at + 2*timeout
+  no-false-positive       a live victim is never flagged; a cleanly
+                          departed victim (DEPARTED sentinel) is never
+                          flagged
+
+Mutations:
+  departed_stale    the scanner treats the Finalize sentinel as a stale
+                    stamp (false positive on clean exit)
+  throttle_too_long scan throttle exceeds the detection deadline
+  inverted_compare  staleness compared with the operands swapped —
+                    never detects anything
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .explorer import Model, Transition
+
+DEPARTED = "DEPARTED"
+
+
+def build(timeout: int = 2, horizon: int = 10, crash: bool = False,
+          depart: bool = False,
+          mutation: Optional[str] = None) -> Model:
+    throttle = (2 * timeout + 2 if mutation == "throttle_too_long"
+                else max(1, timeout // 4))
+    init = {"now": 0, "stamp": 0, "alive": 1, "departed": 0,
+            "failed": 0, "scan_at": 0, "died_at": -1}
+
+    def g_tick(s):
+        # time cannot pass a due scan (the waits all scan)
+        return s["now"] < horizon and s["now"] < s["scan_at"]
+
+    def a_tick(s):
+        s["now"] += 1
+        if s["alive"]:
+            s["stamp"] = s["now"]                # heartbeat keeps pace
+        return s
+
+    def g_scan(s):
+        return s["now"] >= s["scan_at"] and s["now"] < horizon
+
+    def a_scan(s):
+        s["scan_at"] = s["now"] + throttle
+        st = s["stamp"]
+        if st == DEPARTED:
+            if mutation == "departed_stale":
+                # MUTANT: sentinel read as a numeric stamp of 0
+                if s["now"] - 0 > timeout:
+                    s["failed"] = 1
+            return s
+        if mutation == "inverted_compare":
+            stale = st - s["now"] > timeout      # MUTANT: swapped
+        else:
+            stale = s["now"] - st > timeout
+        if stale:
+            s["failed"] = 1
+        return s
+
+    ts = [
+        Transition("tick", "clock", g_tick, a_tick,
+                   frozenset({"now", "scan_at", "alive"}),
+                   frozenset({"now", "stamp"})),
+        Transition("scan", "scanner", g_scan, a_scan,
+                   frozenset({"now", "scan_at", "stamp"}),
+                   frozenset({"scan_at", "failed"})),
+    ]
+    if crash:
+        def g_die(s):
+            return s["alive"] == 1 and s["now"] < horizon // 2
+
+        def a_die(s):
+            s["alive"] = 0
+            s["died_at"] = s["now"]
+            return s
+
+        ts.append(Transition("die", "victim", g_die, a_die,
+                             frozenset({"alive", "now"}),
+                             frozenset({"alive", "died_at"})))
+    if depart:
+        def g_depart(s):
+            return s["alive"] == 1
+
+        def a_depart(s):
+            s["alive"] = 0
+            s["departed"] = 1
+            s["stamp"] = DEPARTED                # Finalize sentinel
+            return s
+
+        ts.append(Transition("depart", "victim", g_depart, a_depart,
+                             frozenset({"alive"}),
+                             frozenset({"alive", "departed", "stamp"})))
+
+    def inv_deadline(s):
+        if s["died_at"] >= 0 and not s["failed"] \
+                and s["now"] > s["died_at"] + 2 * timeout:
+            return (f"victim died at t={s['died_at']} and is still "
+                    f"undetected at t={s['now']} (> 2x timeout "
+                    f"{timeout})")
+        return None
+
+    def inv_false_pos(s):
+        if s["failed"] and s["died_at"] < 0:
+            who = "cleanly departed" if s["departed"] else "live"
+            return f"{who} victim flagged as failed"
+        return None
+
+    def final(s):
+        return True          # any quiescent point is a legal end
+
+    return Model(f"lease(T={timeout},mut={mutation})", init, ts,
+                 [("detect-within-deadline", inv_deadline),
+                  ("no-false-positive", inv_false_pos)], final)

@@ -1,0 +1,404 @@
+"""Seqlock flat-wave models (cplane.cpp cp_flat_allreduce / cp_flat_bcast).
+
+State is the flat region reduced to its protocol skeleton: per-rank
+slots (in_seq, out_seq, payload), the broadcast block (bseq, payload),
+the region poison word. Payload writes are deliberately split into a
+TORN step then the value step — the model's stand-in for a non-atomic
+multi-byte memcpy — so any interleaving that lets a reader observe a
+half-written slot delivers the literal value "TORN" and trips the
+``no-torn-read-delivered`` invariant.
+
+Payload values are frozensets of (rank, wave) contributions; a correct
+allreduce delivers the full set for its wave, so agreement and
+stale-read bugs surface as ``agreement`` violations.
+
+Mutations (build_allreduce):
+  stamp_before_copy   writer stamps in_seq BEFORE the payload copy —
+                      the leader folds a torn slot
+  no_reader_guard     reader copies the bcast block without waiting for
+                      bseq >= s — reads mid-write or stale data
+  no_overwrite_guard  leader skips the out_seq overwrite guard — wave
+                      s+1's fold tears the block under a slow wave-s
+                      reader (needs waves=2)
+  no_poison           an aborted wave (peer crash) skips the sticky
+                      poison stamp — context reuse folds the torn slot
+
+Mutations (build_bcast):
+  no_arrival_wave     the root stamps bseq without the fan-in-first
+                      arrival wave — a member that reads its numbering
+                      base late counts the in-flight wave and waits for
+                      a seq nobody will ever stamp (deadlock), the exact
+                      desync PR 5 shipped the arrival wave to prevent
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .explorer import Model, Transition
+
+TORN = "TORN"
+
+
+def _full(n: int, wave: int) -> frozenset:
+    return frozenset((r, wave) for r in range(n))
+
+
+def build_allreduce(n: int = 2, waves: int = 1, crash: bool = False,
+                    mutation: Optional[str] = None) -> Model:
+    """n ranks run ``waves`` sequential flat allreduce waves; rank 0 is
+    the leader (folds slots into the bcast block). ``crash=True`` adds a
+    nondeterministic mid-copy death of rank n-1 plus the abort/poison/
+    reuse machinery."""
+    assert n >= 2
+    ts = []
+    init = {"poison": 0, "bseq": 0, "bpay": frozenset(), "aborted": 0,
+            "reuse_res": None}
+    for r in range(n):
+        init[f"in{r}"] = 0
+        init[f"out{r}"] = 0
+        init[f"pay{r}"] = frozenset()
+        init[f"pc{r}"] = 0
+        init[f"wave{r}"] = 1          # seq of the wave being executed
+        init[f"res{r}"] = ()          # delivered results, one per wave
+        init[f"alive{r}"] = 1
+
+    def seq(s, r):
+        return s[f"wave{r}"]
+
+    def running(s, r):
+        return s[f"alive{r}"] and s[f"wave{r}"] <= waves \
+            and not s["aborted"]
+
+    # ---- non-leader ranks -------------------------------------------
+    for r in range(1, n):
+        def mk(r):
+            stamp_first = mutation == "stamp_before_copy"
+
+            def g_begin(s):
+                return running(s, r) and s[f"pc{r}"] == 0
+
+            def a_begin(s):
+                if stamp_first:
+                    s[f"in{r}"] = seq(s, r)       # MUTANT: stamp early
+                s[f"pay{r}"] = TORN
+                s[f"pc{r}"] = 1
+                return s
+
+            def g_copy(s):
+                return running(s, r) and s[f"pc{r}"] == 1
+
+            def a_copy(s):
+                s[f"pay{r}"] = frozenset({(r, seq(s, r))})
+                s[f"pc{r}"] = 2
+                return s
+
+            def g_stamp(s):
+                return running(s, r) and s[f"pc{r}"] == 2
+
+            def a_stamp(s):
+                if not stamp_first:
+                    s[f"in{r}"] = seq(s, r)       # release stamp
+                s[f"pc{r}"] = 3
+                return s
+
+            def g_read(s):
+                if not (running(s, r) and s[f"pc{r}"] == 3):
+                    return False
+                if mutation == "no_reader_guard":
+                    return True                   # MUTANT: no bseq wait
+                return s["bseq"] >= seq(s, r)
+
+            def a_read(s):
+                s[f"res{r}"] = s[f"res{r}"] + (s["bpay"],)
+                s[f"pc{r}"] = 4
+                return s
+
+            def g_ack(s):
+                return running(s, r) and s[f"pc{r}"] == 4
+
+            def a_ack(s):
+                s[f"out{r}"] = seq(s, r)
+                s[f"wave{r}"] += 1
+                s[f"pc{r}"] = 0
+                return s
+
+            return [
+                Transition(f"r{r}.begin_copy", f"r{r}", g_begin, a_begin,
+                           frozenset({f"pc{r}", f"wave{r}", "aborted"}),
+                           frozenset({f"pay{r}", f"pc{r}", f"in{r}"})),
+                Transition(f"r{r}.end_copy", f"r{r}", g_copy, a_copy,
+                           frozenset({f"pc{r}"}),
+                           frozenset({f"pay{r}", f"pc{r}"})),
+                Transition(f"r{r}.stamp_in", f"r{r}", g_stamp, a_stamp,
+                           frozenset({f"pc{r}"}),
+                           frozenset({f"in{r}", f"pc{r}"})),
+                Transition(f"r{r}.read_bcb", f"r{r}", g_read, a_read,
+                           frozenset({f"pc{r}", "bseq", "bpay"}),
+                           frozenset({f"res{r}", f"pc{r}"})),
+                Transition(f"r{r}.stamp_out", f"r{r}", g_ack, a_ack,
+                           frozenset({f"pc{r}"}),
+                           frozenset({f"out{r}", f"wave{r}", f"pc{r}"})),
+            ]
+        ts.extend(mk(r))
+
+    # ---- leader (rank 0) --------------------------------------------
+    def g_l_guard(s):
+        if not (running(s, 0) and s["pc0"] == 0):
+            return False
+        if mutation == "no_overwrite_guard":
+            return True                           # MUTANT: skip guard
+        return all(s[f"out{r}"] >= seq(s, 0) - 1 for r in range(n))
+
+    def a_l_guard(s):
+        s["pc0"] = 1
+        return s
+
+    def a_l_begin(s):
+        s["bpay"] = TORN                          # fold starts: block torn
+        s["pc0"] = 2
+        return s
+
+    def g_l_fold(s):
+        return running(s, 0) and s["pc0"] == 2 and all(
+            s[f"in{r}"] >= seq(s, 0) for r in range(1, n))
+
+    def a_l_fold(s):
+        acc = frozenset({(0, seq(s, 0))})
+        torn = False
+        for r in range(1, n):
+            if s[f"pay{r}"] == TORN:
+                torn = True
+            else:
+                acc |= s[f"pay{r}"]
+        s["bpay"] = TORN if torn else acc
+        s["pc0"] = 3
+        return s
+
+    def a_l_publish(s):
+        s["res0"] = s["res0"] + (s["bpay"],)
+        s["bseq"] = seq(s, 0)                     # release stamp
+        s["in0"] = seq(s, 0)
+        s["out0"] = seq(s, 0)
+        s["wave0"] += 1
+        s["pc0"] = 0
+        return s
+
+    ts.extend([
+        Transition("L.overwrite_guard", "r0", g_l_guard, a_l_guard,
+                   frozenset({"pc0", "wave0", "aborted"}
+                             | {f"out{r}" for r in range(n)}),
+                   frozenset({"pc0"})),
+        Transition("L.begin_fold", "r0",
+                   lambda s: running(s, 0) and s["pc0"] == 1, a_l_begin,
+                   frozenset({"pc0"}), frozenset({"bpay", "pc0"})),
+        Transition("L.fold", "r0", g_l_fold, a_l_fold,
+                   frozenset({"pc0"} | {f"in{r}" for r in range(1, n)}
+                             | {f"pay{r}" for r in range(1, n)}),
+                   frozenset({"bpay", "pc0"})),
+        Transition("L.publish", "r0",
+                   lambda s: running(s, 0) and s["pc0"] == 3, a_l_publish,
+                   frozenset({"pc0", "bpay"}),
+                   frozenset({"res0", "bseq", "in0", "out0", "wave0",
+                              "pc0"})),
+    ])
+
+    # ---- crash / abort / poison / reuse -----------------------------
+    if crash:
+        victim = n - 1
+
+        def g_die(s):
+            # mid-copy death: the slot is left TORN forever
+            return s[f"alive{victim}"] and s[f"pc{victim}"] == 1
+
+        def a_die(s):
+            s[f"alive{victim}"] = 0
+            return s
+
+        def g_abort(s):
+            # the leader's lease scan notices the dead peer while it
+            # waits on the fold; the wave dies and (correctly) stamps
+            # the sticky region poison
+            return s["alive0"] and not s[f"alive{victim}"] \
+                and not s["aborted"]
+
+        def a_abort(s):
+            s["aborted"] = 1
+            if mutation != "no_poison":
+                s["poison"] = 1                   # MUTANT skips this
+            return s
+
+        def g_reuse(s):
+            # a later comm keys the same region (ctx id reuse): the
+            # cp_flat_base gate must refuse a poisoned region
+            return s["aborted"] and s["reuse_res"] is None
+
+        def a_reuse(s):
+            if s["poison"]:
+                s["reuse_res"] = "refused"
+            else:
+                torn = any(s[f"pay{r}"] == TORN for r in range(1, n))
+                s["reuse_res"] = TORN if torn else "folded"
+            return s
+
+        ts.extend([
+            Transition("V.die", f"r{victim}", g_die, a_die,
+                       frozenset({f"pc{victim}", f"alive{victim}"}),
+                       frozenset({f"alive{victim}"})),
+            Transition("L.abort_poison", "r0", g_abort, a_abort,
+                       frozenset({f"alive{victim}", "aborted"}),
+                       frozenset({"aborted", "poison"})),
+            Transition("reuse.probe", "reuse", g_reuse, a_reuse,
+                       frozenset({"aborted", "poison", "reuse_res"}
+                                 | {f"pay{r}" for r in range(1, n)}),
+                       frozenset({"reuse_res"})),
+        ])
+
+    # ---- invariants --------------------------------------------------
+    def inv_torn(s):
+        for r in range(n):
+            for v in s[f"res{r}"]:
+                if v == TORN:
+                    return f"rank {r} delivered a TORN payload"
+        if s["reuse_res"] == TORN:
+            return "ctx reuse folded a torn slot of the dead wave"
+        return None
+
+    def inv_agree(s):
+        for r in range(n):
+            for w, v in enumerate(s[f"res{r}"], start=1):
+                if v != TORN and v != _full(n, w):
+                    return (f"rank {r} wave {w} delivered {sorted(v)} "
+                            f"!= the full contribution set")
+        return None
+
+    def inv_poison(s):
+        if s["aborted"] and not s["poison"]:
+            return "wave aborted but the region poison is not sticky"
+        return None
+
+    def final(s):
+        if s["aborted"]:
+            return s["reuse_res"] is not None if crash else True
+        return all(s[f"wave{r}"] > waves for r in range(n))
+
+    invs = [("no-torn-read-delivered", inv_torn),
+            ("agreement", inv_agree)]
+    if crash:
+        invs.append(("poison-sticky", inv_poison))
+    return Model(f"seqlock-allreduce(n={n},waves={waves},"
+                 f"crash={crash},mut={mutation})", init, ts, invs, final)
+
+
+def build_bcast(n: int = 3, mutation: Optional[str] = None) -> Model:
+    """One flat bcast wave, root = rank 0, with rank n-1 a LATE member:
+    it reads its per-comm numbering base lazily (cp_flat_base) at its
+    first collective. The correct protocol's fan-in-first arrival wave
+    keeps the root from stamping bseq before everyone arrived; the
+    mutation drops it, so the late member's base already counts the
+    in-flight wave and it waits on a seq that will never be stamped."""
+    assert n >= 2
+    late = n - 1
+    init = {"bseq": 0, "bpay": frozenset()}
+    for r in range(n):
+        init[f"in{r}"] = 0
+        init[f"pc{r}"] = 0
+        init[f"res{r}"] = None
+        init[f"base{r}"] = 0 if r != late else None   # late: lazy read
+
+    ts = []
+
+    def g_base(s):
+        return s[f"base{late}"] is None
+
+    def a_base(s):
+        s[f"base{late}"] = s["bseq"]             # lazy numbering base
+        return s
+
+    ts.append(Transition(f"r{late}.read_base", f"r{late}", g_base, a_base,
+                         frozenset({"bseq", f"base{late}"}),
+                         frozenset({f"base{late}"})))
+
+    # members (non-root): arrive (stamp in_seq), wait bseq, read
+    for r in range(1, n):
+        def mk(r):
+            def g_arrive(s):
+                if s[f"pc{r}"] != 0:
+                    return False
+                if s[f"base{r}"] is None:
+                    return False                 # must read base first
+                return True
+
+            def a_arrive(s):
+                s[f"in{r}"] = s[f"base{r}"] + 1
+                s[f"pc{r}"] = 1
+                return s
+
+            def g_read(s):
+                return s[f"pc{r}"] == 1 \
+                    and s["bseq"] >= s[f"base{r}"] + 1
+
+            def a_read(s):
+                s[f"res{r}"] = s["bpay"]
+                s[f"pc{r}"] = 2
+                return s
+
+            return [
+                Transition(f"r{r}.arrive", f"r{r}", g_arrive, a_arrive,
+                           frozenset({f"pc{r}", f"base{r}"}),
+                           frozenset({f"in{r}", f"pc{r}"})),
+                Transition(f"r{r}.read", f"r{r}", g_read, a_read,
+                           frozenset({f"pc{r}", "bseq", "bpay",
+                                      f"base{r}"}),
+                           frozenset({f"res{r}", f"pc{r}"})),
+            ]
+        ts.extend(mk(r))
+
+    # root: (arrival wave) -> write payload -> stamp bseq
+    def g_root_wave(s):
+        if s["pc0"] != 0:
+            return False
+        if mutation == "no_arrival_wave":
+            return True                          # MUTANT: skip fan-in
+        return all(s[f"in{r}"] >= 1 for r in range(1, n))
+
+    def a_root_wave(s):
+        s["pc0"] = 1
+        return s
+
+    def a_root_write(s):
+        s["bpay"] = frozenset({(0, 1)})
+        s["pc0"] = 2
+        return s
+
+    def a_root_stamp(s):
+        s["bseq"] = 1
+        s["res0"] = s["bpay"]
+        s["pc0"] = 3
+        return s
+
+    ts.extend([
+        Transition("root.arrival_wave", "r0", g_root_wave, a_root_wave,
+                   frozenset({"pc0"} | {f"in{r}" for r in range(1, n)}),
+                   frozenset({"pc0"})),
+        Transition("root.write", "r0", lambda s: s["pc0"] == 1,
+                   a_root_write, frozenset({"pc0"}),
+                   frozenset({"bpay", "pc0"})),
+        Transition("root.stamp", "r0", lambda s: s["pc0"] == 2,
+                   a_root_stamp, frozenset({"pc0", "bpay"}),
+                   frozenset({"bseq", "res0", "pc0"})),
+    ])
+
+    def inv_data(s):
+        for r in range(1, n):
+            v = s[f"res{r}"]
+            if v is not None and v != frozenset({(0, 1)}):
+                return f"rank {r} delivered {v} != the root payload"
+        return None
+
+    def final(s):
+        return all(s[f"res{r}"] is not None for r in range(n)) \
+            and s["pc0"] == 3
+
+    return Model(f"seqlock-bcast(n={n},mut={mutation})", init, ts,
+                 [("bcast-data", inv_data)], final)

@@ -1,0 +1,880 @@
+"""Pass ``native`` — atomic discipline + layout consistency for the C
+sources of the native datapath.
+
+PRs 5-6 moved the hottest protocol logic into lock-free C
+(native/cplane.cpp seqlock flat waves, doorbell waits, liveness leases;
+native/mpi/fastpath.c; native/shmring.cpp SPSC rings). This pass gives
+those files the same opt-in invariant surface the Python half has had
+since PR 4. It is deliberately lexical — a tokenizer plus a
+statement splitter, not a C parser — because every checked idiom is
+local to one statement and the annotation tells us *which* words are
+shared.
+
+Annotation grammar (C comments, attached to the declaration's first
+line):
+
+    /* shared: atomic */             every access to the declared word
+    /* shared: atomic(<region>) */   must ride __atomic_*/std::atomic
+                                     with an EXPLICIT memory order
+    /* shared: seqlock(<region>) */  on data: same discipline; on an
+                                     accessor function returning a
+                                     pointer to protocol words: every
+                                     call site must be wrapped by an
+                                     atomic load/store (or a vetted
+                                     consumer, below)
+    /* shared: guarded-by(<lock>) */ accesses only between
+                                     pthread_mutex_lock(&..<lock>) and
+                                     the matching unlock (or inside a
+                                     function annotated /* holds: <lock> */)
+    /* shared: counter(<why>) */     plain accesses tolerated — a stats
+                                     word with one natural writer; the
+                                     rationale is REQUIRED
+    /* shared-ok: <why> */           on a function definition: vetted
+                                     consumer of shared words (e.g. the
+                                     flat_wait park loop) — its call
+                                     sites bless the statement
+    /* mv2tlint: native-init */      on a function definition: the whole
+                                     body is single-threaded
+                                     init/teardown, exempt
+    // mv2tlint: ignore[native] why  per-line escape (PR-4 syntax)
+
+Sub-checks (all report under pass id ``native``):
+  * plain-access    — a shared word touched outside the atomic idiom
+                      (covers the "lease/doorbell words must never be
+                      plain or volatile-only" rule: volatile carries no
+                      idiom token)
+  * memory-order    — __atomic_* builtin without an explicit __ATOMIC_
+                      order, or a std::atomic method without an explicit
+                      std::memory_order (C11 atomic_* generics keep
+                      their well-defined seq_cst default)
+  * seqlock-pair    — a seqlock region must have BOTH a release-store
+                      writer site and an acquire-load reader site, and
+                      at least one reader must re-check in a loop
+  * layout          — cross-language layout constants: shm_layout.h
+                      #defines / the FPC enum vs the Python mirrors
+                      (transport/shm.py ring + lease constants and
+                      _FP_COUNTERS, transport/base.py packet header,
+                      runtime/universe.py CTX_MASK_BASE)
+
+Atomic wrapper functions (fl_load/fl_store) are auto-detected: a
+function whose body is a single return of __atomic_load_n/__atomic_store_n
+with an explicit order becomes a blessed idiom token.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import struct as _struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, LintPass, REPO_ROOT, SourceModule
+
+# the native file set the tier-1 gate lints (repo-relative)
+NATIVE_SOURCES = [
+    "native/shm_layout.h",
+    "native/shmring.cpp",
+    "native/cplane.cpp",
+    "native/mpi/fastpath.c",
+]
+
+LAYOUT_HEADER = "native/shm_layout.h"
+
+_SHARED_RE = re.compile(r"shared:\s*([a-z-]+)\s*(?:\(([^)]*)\))?")
+_SHARED_OK_RE = re.compile(r"shared-ok:\s*(.+)")
+_NATIVE_INIT_RE = re.compile(r"mv2tlint:\s*native-init")
+_IGNORE_RE = re.compile(r"mv2tlint:\s*ignore(?:\[([a-z, -]+)\])?")
+
+_ATOMIC_BUILTIN_RE = re.compile(r"__atomic_\w+\s*\(")
+_STD_METHOD_RE = re.compile(
+    r"\.\s*(load|store|exchange|fetch_\w+|compare_exchange\w*)\s*\(")
+_CTRL_KEYWORDS = {"if", "while", "for", "switch", "catch", "return",
+                  "sizeof", "do", "else"}
+
+
+# ---------------------------------------------------------------------------
+# C source model: comment stripping + statement splitting + function map
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CStatement:
+    line: int                 # first line of the statement
+    text: str                 # code text, comments stripped
+    func: Optional[str]       # enclosing function name (None = file scope)
+
+
+@dataclass
+class SharedDecl:
+    name: str
+    kind: str                 # atomic | seqlock | guarded-by | counter
+    region: Optional[str]     # seqlock region / atomic group / lock name
+    line: int
+    pointer: bool = False     # declared as a pointer: only derefs checked
+    std_atomic: bool = False  # std::atomic<...>: method discipline
+    is_func: bool = False     # accessor function (seqlock pointer source)
+    member: bool = False      # struct/class member: accessed via -> or .
+                              # only (a bare name is a shadowing local)
+
+
+class CSource:
+    """One C/C++ file: comment map, per-line suppressions, statements."""
+
+    def __init__(self, path: str, text: Optional[str] = None):
+        self.path = os.path.abspath(path)
+        self.relpath = os.path.relpath(self.path, REPO_ROOT)
+        if self.relpath.startswith(".."):
+            self.relpath = os.path.basename(self.path)
+        if text is None:
+            with open(self.path, encoding="utf-8", errors="replace") as f:
+                text = f.read()
+        self.text = text
+        self.code, self.comments = self._split_comments(text)
+        # line -> suppressed pass ids ({"*"} = all). A comment suppresses
+        # the line it STARTS on (same as the Python side).
+        self.ignores: Dict[int, Set[str]] = {}
+        for line, c in self.comments.items():
+            m = _IGNORE_RE.search(c)
+            if m:
+                which = m.group(1)
+                self.ignores[line] = ({"*"} if which is None else
+                                      {p.strip() for p in which.split(",")})
+        # preprocessor directives (incl. \-continuations) are not C
+        # statements: blank them for the splitter so a macro body cannot
+        # merge into the following declaration. Macro bodies are out of
+        # the discipline's scope by design.
+        nopp = re.sub(r"^[ \t]*#(?:[^\n\\]|\\\n|\\.)*",
+                      lambda m: re.sub(r"[^\n]", " ", m.group(0)),
+                      self.code, flags=re.M)
+        (self.statements, self.func_of_line,
+         self.struct_of_line) = self._split_statements(nopp)
+
+    @staticmethod
+    def _split_comments(text: str) -> Tuple[str, Dict[int, str]]:
+        """Blank out comments (and string literals) in ``code`` while
+        preserving offsets; collect comment text keyed by start line."""
+        out = list(text)
+        comments: Dict[int, str] = {}
+        i, n = 0, len(text)
+        line = 1
+
+        def blank(a: int, b: int) -> None:
+            for k in range(a, b):
+                if out[k] != "\n":
+                    out[k] = " "
+
+        while i < n:
+            c = text[i]
+            if c == "\n":
+                line += 1
+                i += 1
+            elif text.startswith("//", i):
+                j = text.find("\n", i)
+                j = n if j < 0 else j
+                comments[line] = (comments.get(line, "") + " "
+                                  + text[i + 2:j]).strip()
+                blank(i, j)
+                i = j
+            elif text.startswith("/*", i):
+                j = text.find("*/", i + 2)
+                j = n if j < 0 else j + 2
+                body = text[i + 2:j - 2 if j <= n else n]
+                comments[line] = (comments.get(line, "") + " "
+                                  + re.sub(r"\s*\n\s*\*?\s*", " ",
+                                           body)).strip()
+                blank(i, j)
+                line += text.count("\n", i, j)
+                i = j
+            elif c in "\"'":
+                q = c
+                j = i + 1
+                while j < n and text[j] != q:
+                    j += 2 if text[j] == "\\" else 1
+                j = min(j + 1, n)
+                blank(i + 1, j - 1)
+                i = j
+            else:
+                i += 1
+        return "".join(out), comments
+
+    @staticmethod
+    def _split_statements(code: str):
+        """Split stripped code into statements on ; { } with enclosing-
+        function tracking (a '{' directly after ')' opens a function
+        when we are not already inside one)."""
+        statements: List[CStatement] = []
+        func_of_line: Dict[int, Optional[str]] = {}
+        struct_of_line: Dict[int, bool] = {}
+        line = 1
+        start_line = 1
+        buf: List[str] = []
+        func: Optional[str] = None
+        func_depth = 0
+        struct_depths: List[int] = []   # depths of open struct/class scopes
+        depth = 0
+
+        def flush() -> None:
+            nonlocal buf, start_line
+            text = " ".join("".join(buf).split())
+            if text:
+                statements.append(CStatement(start_line, text, func))
+            buf = []
+
+        for ch in code:
+            if ch == "\n":
+                func_of_line[line] = func
+                struct_of_line[line] = bool(struct_depths)
+                line += 1
+                if buf:
+                    buf.append(" ")
+                continue
+            if ch == ";":
+                flush()
+                start_line = line
+                continue
+            if ch == "{":
+                sig = " ".join("".join(buf).split())
+                flush()
+                start_line = line
+                depth += 1
+                if re.search(r"\b(struct|class|union)\s+\w*\s*$", sig) \
+                        or re.search(r"\b(struct|class|union)\s*$", sig):
+                    struct_depths.append(depth)
+                elif func is None and sig.endswith(")"):
+                    m = re.search(r"(\w+)\s*\([^()]*(?:\([^()]*\)[^()]*)*\)$",
+                                  sig)
+                    if m and m.group(1) not in _CTRL_KEYWORDS:
+                        func = m.group(1)
+                        func_depth = depth
+                continue
+            if ch == "}":
+                flush()
+                start_line = line
+                if func is not None and depth == func_depth:
+                    func = None
+                if struct_depths and depth == struct_depths[-1]:
+                    struct_depths.pop()
+                depth = max(0, depth - 1)
+                continue
+            if not buf:
+                if ch in " \t":
+                    continue
+                start_line = line
+            buf.append(ch)
+        flush()
+        return statements, func_of_line, struct_of_line
+
+
+# ---------------------------------------------------------------------------
+# annotation harvesting
+# ---------------------------------------------------------------------------
+
+_DECL_RE = re.compile(
+    r"""(?P<type>[A-Za-z_][\w:<>,\s]*?
+         (?:\s|\*|&))\s*
+        (?P<name>[A-Za-z_]\w*)\s*
+        (?P<array>\[[^\]]*\])?\s*
+        (?:=[^;]*)?;""", re.VERBOSE)
+_FUNC_DEF_RE = re.compile(r"(?P<name>[A-Za-z_]\w*)\s*\([^;{]*\)\s*\{")
+
+
+def _decl_on(src: CSource, line: int):
+    """The declaration the annotation on ``line`` attaches to: the same
+    code line, or — when the annotation rides a standalone comment — the
+    next non-blank code line; joined across continuations up to the
+    first ; or { (whichever comes first)."""
+    lines = src.code.split("\n")
+    start = line - 1
+    while start < len(lines) and not lines[start].strip():
+        start += 1
+    chunk = " ".join(lines[start:start + 6])
+    cuts = [k for k in (chunk.find(";"), chunk.find("{")) if k >= 0]
+    if cuts:
+        chunk = chunk[:min(cuts) + 1]
+    return " ".join(chunk.split())
+
+
+def harvest(src: CSource) -> Tuple[Dict[str, SharedDecl], Set[str], Set[str]]:
+    """(shared decls by name, shared-ok function names, native-init
+    function names) from the file's annotations."""
+    decls: Dict[str, SharedDecl] = {}
+    ok_funcs: Set[str] = set()
+    init_funcs: Set[str] = set()
+    for line, comment in sorted(src.comments.items()):
+        if _NATIVE_INIT_RE.search(comment):
+            decl = _decl_on(src, line)
+            m = _FUNC_DEF_RE.search(decl)
+            if m:
+                init_funcs.add(m.group("name"))
+            continue
+        if _SHARED_OK_RE.search(comment):
+            decl = _decl_on(src, line)
+            m = _FUNC_DEF_RE.search(decl)
+            if m:
+                ok_funcs.add(m.group("name"))
+            continue
+        m = _SHARED_RE.search(comment)
+        if not m:
+            continue
+        kind, region = m.group(1), m.group(2)
+        # the annotation may trail a multi-line declaration: find the
+        # declaration line by scanning back to the statement start
+        decl_line = line
+        decl = _decl_on(src, decl_line)
+        fm = _FUNC_DEF_RE.search(decl)
+        if fm and kind == "seqlock":
+            decls[fm.group("name")] = SharedDecl(
+                fm.group("name"), kind, region, decl_line, is_func=True)
+            continue
+        dm = _DECL_RE.search(decl)
+        if not dm:
+            continue
+        name = dm.group("name")
+        typ = dm.group("type")
+        decls[name] = SharedDecl(
+            name, kind, region, decl_line,
+            pointer="*" in typ and "atomic" not in typ,
+            std_atomic="atomic<" in typ.replace(" ", ""),
+            member=src.struct_of_line.get(decl_line, False))
+    return decls, ok_funcs, init_funcs
+
+
+def auto_wrappers(src: CSource) -> Set[str]:
+    """Functions whose body is a single __atomic load/store with an
+    explicit order (fl_load / fl_store): blessed idiom tokens."""
+    out: Set[str] = set()
+    by_func: Dict[str, List[CStatement]] = {}
+    for st in src.statements:
+        if st.func:
+            by_func.setdefault(st.func, []).append(st)
+    for fn, sts in by_func.items():
+        real = [s for s in sts if "__atomic_" in s.text]
+        if len(real) >= 1 and len(sts) <= 2 and all(
+                "__ATOMIC_" in s.text for s in real):
+            out.add(fn)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the pass
+# ---------------------------------------------------------------------------
+
+class NativeSourcePass(LintPass):
+    id = "native"
+    doc = ("C-plane atomic discipline (shared: annotations), explicit "
+           "memory orders, seqlock pairing, cross-language layout")
+
+    def __init__(self, sources: Optional[List[str]] = None,
+                 layout: bool = True,
+                 layout_header: Optional[str] = None):
+        # default: the committed native file set (repo-relative)
+        if sources is None:
+            sources = [os.path.join(REPO_ROOT, p) for p in NATIVE_SOURCES]
+        self.sources = [p for p in sources if os.path.exists(p)]
+        self.layout = layout
+        self.layout_header = layout_header or os.path.join(REPO_ROOT,
+                                                           LAYOUT_HEADER)
+
+    # -- entry ----------------------------------------------------------
+    def run(self, modules: List[SourceModule]) -> List[Finding]:
+        out: List[Finding] = []
+        seq_sites: Dict[str, Dict[str, List[Tuple[CSource, CStatement]]]] = {}
+        for path in self.sources:
+            try:
+                src = CSource(path)
+            except OSError as e:
+                out.append(Finding(self.id, os.path.basename(path), 0,
+                                   f"unreadable: {e!s:.80}"))
+                continue
+            self._check_file(src, out, seq_sites)
+        self._check_seqlock_pairing(seq_sites, out)
+        if self.layout:
+            self._check_layout(out)
+        out.sort(key=lambda f: (f.path, f.line, f.msg))
+        return out
+
+    def _finding(self, src: CSource, line: int, msg: str,
+                 out: List[Finding]) -> None:
+        ign = src.ignores.get(line)
+        if ign and ("*" in ign or self.id in ign):
+            return
+        out.append(Finding(self.id, src.relpath, line, msg))
+
+    # -- per-file discipline -------------------------------------------
+    def _check_file(self, src: CSource, out: List[Finding],
+                    seq_sites) -> None:
+        decls, ok_funcs, init_funcs = harvest(src)
+        wrappers = auto_wrappers(src)
+        blessed = ({"__atomic_"} | {w + "(" for w in wrappers}
+                   | {f + "(" for f in ok_funcs})
+
+        # counter annotations must carry a rationale
+        for d in decls.values():
+            if d.kind == "counter" and not (d.region or "").strip():
+                self._finding(src, d.line,
+                              f"counter '{d.name}' needs an inline "
+                              "rationale: shared: counter(<why>)", out)
+
+        # guarded-by lock-window tracking, per function
+        lock_state: Dict[Tuple[Optional[str], str], int] = {}
+
+        for st in src.statements:
+            text = st.text
+            if st.func in init_funcs:
+                continue
+            # lock windows for guarded-by
+            for lm in re.finditer(r"pthread_mutex_(lock|unlock)\s*\(\s*&?"
+                                  r"[\w.\->]*?(\w+)\s*\)", text):
+                key = (st.func, lm.group(2))
+                lock_state[key] = (lock_state.get(key, 0)
+                                   + (1 if lm.group(1) == "lock" else -1))
+
+            # memory-order explicitness (file-wide, annotation-free)
+            if _ATOMIC_BUILTIN_RE.search(text) and "__ATOMIC_" not in text:
+                self._finding(src, st.line,
+                              "__atomic_* call without an explicit "
+                              f"__ATOMIC_* memory order: '{text[:60]}'",
+                              out)
+            sm = _STD_METHOD_RE.search(text)
+            if sm and "memory_order" not in text \
+                    and "__ATOMIC_" not in text:
+                self._finding(src, st.line,
+                              f"std::atomic .{sm.group(1)}() without an "
+                              "explicit std::memory_order: "
+                              f"'{text[:60]}'", out)
+
+            # shared-word discipline
+            for d in decls.values():
+                if d.is_func:
+                    # seqlock accessor call sites. A file-scope statement
+                    # ending at the parameter list is the accessor's own
+                    # definition signature, not a call.
+                    for m in re.finditer(rf"\b{d.name}\s*\(", text):
+                        if m.start() > 0 and text[m.start() - 1] in "_.":
+                            continue
+                        if st.func is None and text.endswith(")"):
+                            continue
+                        before = text[:m.start()]
+                        wrapped = any(tok in before for tok in blessed)
+                        consumer = any(f + "(" in before
+                                       for f in ok_funcs)
+                        store = any(w + "(" in before
+                                    for w in wrappers
+                                    if "store" in w) \
+                            or "__atomic_store" in before
+                        reg = d.region or "?"
+                        seq_sites.setdefault(reg, {}).setdefault(
+                            "store" if store else "load", []).append(
+                                (src, st, consumer))
+                        if not wrapped:
+                            self._finding(
+                                src, st.line,
+                                f"seqlock({reg}) word from {d.name}() "
+                                "dereferenced outside the atomic "
+                                f"load/store idiom in "
+                                f"{st.func or '<file scope>'}", out)
+                    continue
+                for acc in self._accesses(d, text):
+                    if d.kind == "counter":
+                        continue            # documented tolerance
+                    if d.kind == "guarded-by":
+                        lock = d.region or ""
+                        held = lock_state.get((st.func, lock), 0) > 0
+                        if not held and not self._holds(src, st, lock):
+                            self._finding(
+                                src, st.line,
+                                f"'{d.name}' (guarded-by {lock}) touched "
+                                f"in {st.func or '<file scope>'} without "
+                                "the lock held", out)
+                        continue
+                    if d.std_atomic:
+                        # method access already covered by the
+                        # memory-order check; flag implicit conversions
+                        if not re.search(
+                                rf"\b{d.name}\s*\.\s*(load|store|exchange|"
+                                rf"fetch_\w+|compare_exchange\w*)\s*\(",
+                                text):
+                            self._finding(
+                                src, st.line,
+                                f"std::atomic '{d.name}' accessed without "
+                                "an explicit-order method in "
+                                f"{st.func or '<file scope>'}", out)
+                        continue
+                    if not any(tok in text for tok in blessed):
+                        self._finding(
+                            src, st.line,
+                            f"shared {d.kind}"
+                            f"{'(' + d.region + ')' if d.region else ''} "
+                            f"word '{d.name}' plainly accessed in "
+                            f"{st.func or '<file scope>'} (must ride "
+                            "__atomic_* with an explicit order)", out)
+
+    def _accesses(self, d: SharedDecl, text: str) -> List[int]:
+        """Offsets of shared-word accesses of ``d`` in a statement."""
+        if d.line and re.search(rf"^[\w:<>,*&\s]*[\s*&]{d.name}\s*(\[|=|;|$)",
+                                text) and d.name + "(" not in text:
+            # the declaration statement itself (init before sharing)
+            if re.match(r"(static\s+)?(volatile\s+)?[\w:<>,]+[\s*&]+"
+                        rf"{d.name}", text):
+                return []
+        pat = (rf"(?:->|\.)\s*{d.name}\s*\["
+               if d.pointer and not d.std_atomic
+               else rf"(?:->|\.)\s*{d.name}\b")
+        hits = [m.start() for m in re.finditer(pat, text)]
+        if not hits and not d.member:
+            # file-scope globals are accessed bare (a member's bare name
+            # is a shadowing local — never the shared word)
+            bare = (rf"(?<![\w.>]){d.name}\s*\[" if d.pointer
+                    else rf"(?<![\w.>]){d.name}\b(?!\s*\()")
+            hits = [m.start() for m in re.finditer(bare, text)]
+        return hits
+
+    def _holds(self, src: CSource, st: CStatement, lock: str) -> bool:
+        """``/* holds: <lock> */`` annotation on the enclosing function's
+        definition line."""
+        if st.func is None:
+            return False
+        for line, comment in src.comments.items():
+            m = re.search(r"holds:\s*([\w,\s]+)", comment)
+            if m and lock in {p.strip() for p in m.group(1).split(",")}:
+                decl = _decl_on(src, line)
+                fm = _FUNC_DEF_RE.search(decl)
+                if fm and fm.group("name") == st.func:
+                    return True
+        return False
+
+    # -- seqlock pairing ------------------------------------------------
+    def _check_seqlock_pairing(self, seq_sites, out: List[Finding]) -> None:
+        for region, sites in seq_sites.items():
+            loads = sites.get("load", [])
+            stores = sites.get("store", [])
+            src = (loads or stores)[0][0] if (loads or stores) else None
+            # sites are (CSource, CStatement, consumer_blessed)
+            if src is None:
+                continue
+            if not stores:
+                self._finding(src, 0,
+                              f"seqlock region '{region}' has readers but "
+                              "no release-store writer site", out)
+            if not loads:
+                self._finding(src, 0,
+                              f"seqlock region '{region}' has writers but "
+                              "no acquire-load reader site", out)
+            if loads and not any(
+                    s.text.startswith(("while", "for")) or "while" in s.text
+                    or consumer for _, s, consumer in loads):
+                self._finding(src, loads[0][1].line,
+                              f"seqlock region '{region}' has no reader "
+                              "re-check loop (every reader is a one-shot "
+                              "load or a vetted wait consumer is missing)",
+                              out)
+
+    # -- cross-language layout -----------------------------------------
+    def _check_layout(self, out: List[Finding]) -> None:
+        hdr_path = self.layout_header
+        if not os.path.exists(hdr_path):
+            out.append(Finding(self.id, LAYOUT_HEADER, 0,
+                               "layout: shm_layout.h missing — the "
+                               "cross-language constants have no C source "
+                               "of truth"))
+            return
+        defines, enums, lines = _parse_header(hdr_path)
+        rel = os.path.relpath(hdr_path, REPO_ROOT)
+        if rel.startswith(".."):
+            rel = os.path.basename(hdr_path)
+
+        def bad(name: str, msg: str) -> None:
+            out.append(Finding(self.id, rel, lines.get(name, 0),
+                               f"layout: {msg}"))
+
+        py = _python_layout()
+
+        pairs = [
+            ("MV2T_RING_HDR_BYTES", "shm._HEADER"),
+            ("MV2T_RING_WRAP", "shm._WRAP"),
+            ("MV2T_RING_ALIGN", "shm._ALIGN"),
+            ("MV2T_LEASE_ALIGN", "shm._LEASE_ALIGN"),
+            ("MV2T_LEASE_STAMP_BYTES", "shm._LEASE_STAMP"),
+            ("MV2T_CTX_MASK_BASE", "universe.CTX_MASK_BASE"),
+            ("MV2T_PKT_HDR_BYTES", "base._PKT_HDR.size"),
+        ]
+        for cname, pyname in pairs:
+            if cname not in defines:
+                bad(cname, f"{cname} not defined in shm_layout.h")
+                continue
+            if pyname not in py:
+                bad(cname, f"python mirror {pyname} not found")
+                continue
+            if defines[cname] != py[pyname]:
+                bad(cname,
+                    f"{cname}={defines[cname]} != {pyname}={py[pyname]} "
+                    "— C and python disagree on the shared layout")
+
+        if "MV2T_LEASE_DEPARTED" in defines \
+                and "shm.ShmChannel._LEASE_DEPARTED" in py:
+            c = defines["MV2T_LEASE_DEPARTED"] & 0xFFFFFFFFFFFFFFFF
+            p = py["shm.ShmChannel._LEASE_DEPARTED"] & 0xFFFFFFFFFFFFFFFF
+            if c != p:
+                bad("MV2T_LEASE_DEPARTED",
+                    f"MV2T_LEASE_DEPARTED={c:#x} != "
+                    f"shm._LEASE_DEPARTED={p:#x}")
+
+        # FPC enum <-> _FP_COUNTERS: dense indices, matching names
+        counters = py.get("shm._FP_COUNTERS", [])
+        if not counters:
+            bad("FPC_HITS", "python mirror shm._FP_COUNTERS not found")
+        else:
+            want = {i: _fpc_to_pvar(n) for n, i in enums.items()}
+            for idx in range(len(counters)):
+                if idx not in want:
+                    bad("FPC_HITS",
+                        f"_FP_COUNTERS[{idx}]={counters[idx]} has no FPC_* "
+                        "enum slot in shm_layout.h")
+                elif want[idx] != counters[idx]:
+                    bad("FPC_HITS",
+                        f"FPC slot {idx} is {want[idx]} in shm_layout.h "
+                        f"but _FP_COUNTERS[{idx}] is {counters[idx]}")
+            for name, idx in enums.items():
+                if idx >= len(counters):
+                    bad(name,
+                        f"{name}={idx} has no _FP_COUNTERS pvar (python "
+                        "side shorter than the C enum)")
+            slots = defines.get("MV2T_FPC_SLOTS", 0)
+            if slots and len(counters) > slots:
+                bad("MV2T_FPC_SLOTS",
+                    f"_FP_COUNTERS has {len(counters)} entries but the "
+                    f"fpctr array holds MV2T_FPC_SLOTS={slots}")
+
+        # flat-region geometry sanity: derived defines must re-derive
+        derived = {
+            "MV2T_FLAT_SLOT_STRIDE":
+                64 + defines.get("MV2T_FLAT_MAX", 0),
+            "MV2T_FLAT_REG_STRIDE":
+                defines.get("MV2T_FLAT_REG_HDR", 0)
+                + (defines.get("MV2T_FLAT_NSLOTS", 0) + 1)
+                * defines.get("MV2T_FLAT_SLOT_STRIDE", 0),
+            "MV2T_FLAT_NREG":
+                defines.get("MV2T_FLAT_SMALL_CTXS", 0)
+                + defines.get("MV2T_FLAT_MASK_CTXS", 0),
+            "MV2T_FLAT_FILE_LEN":
+                defines.get("MV2T_FLAT_NREG", 0)
+                * defines.get("MV2T_FLAT_LANES", 0)
+                * defines.get("MV2T_FLAT_REG_STRIDE", 0),
+        }
+        for name, want_v in derived.items():
+            if name in defines and defines[name] != want_v:
+                bad(name, f"{name}={defines[name]} does not re-derive "
+                          f"from its parts ({want_v})")
+
+
+# ---------------------------------------------------------------------------
+# header + python-side parsing helpers
+# ---------------------------------------------------------------------------
+
+def _eval_cexpr(expr: str) -> Optional[int]:
+    """Evaluate a preprocessor-style integer expression (literals, hex,
+    + - * << | ~ and parens; u/l suffixes stripped)."""
+    cleaned = re.sub(r"(?<=[0-9a-fA-FxX])[uUlL]+\b", "", expr)
+    if not re.fullmatch(r"[\s0-9a-fA-FxX()+\-*<>|~]+", cleaned):
+        return None
+    try:
+        node = ast.parse(cleaned, mode="eval")
+        return int(_eval_node(node.body))
+    except Exception:
+        return None
+
+
+def _eval_node(n: ast.AST) -> int:
+    if isinstance(n, ast.Constant) and isinstance(n.value, int):
+        return n.value
+    if isinstance(n, ast.BinOp):
+        a, b = _eval_node(n.left), _eval_node(n.right)
+        op = n.op
+        if isinstance(op, ast.Add):
+            return a + b
+        if isinstance(op, ast.Sub):
+            return a - b
+        if isinstance(op, ast.Mult):
+            return a * b
+        if isinstance(op, ast.LShift):
+            return a << b
+        if isinstance(op, ast.BitOr):
+            return a | b
+        raise ValueError(op)
+    if isinstance(n, ast.UnaryOp):
+        v = _eval_node(n.operand)
+        if isinstance(n.op, ast.Invert):
+            return ~v
+        if isinstance(n.op, ast.USub):
+            return -v
+    raise ValueError(n)
+
+
+def _parse_header(path: str):
+    """(#define values, FPC enum values, name -> line) from
+    shm_layout.h. #defines resolve forward references to one another."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    code, _ = CSource._split_comments(text)
+    # join continuation lines
+    code = code.replace("\\\n", " ")
+    defines: Dict[str, int] = {}
+    lines: Dict[str, int] = {}
+    for i, line in enumerate(code.split("\n"), 1):
+        m = re.match(r"\s*#\s*define\s+(\w+)\s+(.+)", line)
+        if not m:
+            continue
+        name, rhs = m.group(1), m.group(2).strip()
+        for known, v in sorted(defines.items(), key=lambda kv: -len(kv[0])):
+            rhs = re.sub(rf"\b{known}\b", str(v), rhs)
+        v = _eval_cexpr(rhs)
+        if v is not None:
+            defines[name] = v
+            lines[name] = i
+    enums: Dict[str, int] = {}
+    for m in re.finditer(r"enum\s*\{(.*?)\}", code, re.S):
+        nxt = 0
+        for item in m.group(1).split(","):
+            item = item.strip()
+            if not item:
+                continue
+            em = re.match(r"(\w+)\s*(?:=\s*(.+))?$", item, re.S)
+            if not em:
+                continue
+            name = em.group(1)
+            if em.group(2) is not None:
+                v = _eval_cexpr(em.group(2).strip())
+                nxt = v if v is not None else nxt
+            enums[name] = nxt
+            lines.setdefault(
+                name,
+                next((i for i, l in enumerate(code.split("\n"), 1)
+                      if re.search(rf"\b{name}\b", l)), 0))
+            nxt += 1
+    return defines, enums, lines
+
+
+def _fpc_to_pvar(enum_name: str) -> str:
+    """FPC_FB_DTYPE -> fp_fallback_dtype (the _FP_COUNTERS pvar name)."""
+    parts = enum_name.split("_")[1:]          # drop FPC
+    parts = ["fallback" if p == "FB" else p.lower() for p in parts]
+    return "fp_" + "_".join(parts)
+
+
+def _py_const(tree: ast.Module, name: str) -> Optional[object]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    try:
+                        return ast.literal_eval(node.value)
+                    except (ValueError, SyntaxError):
+                        return None
+    return None
+
+
+def _python_layout() -> Dict[str, object]:
+    """Python-side layout constants, parsed from source (no imports —
+    the lint must run without jax/numpy)."""
+    out: Dict[str, object] = {}
+    shm_path = os.path.join(REPO_ROOT, "mvapich2_tpu", "transport", "shm.py")
+    base_path = os.path.join(REPO_ROOT, "mvapich2_tpu", "transport",
+                             "base.py")
+    uni_path = os.path.join(REPO_ROOT, "mvapich2_tpu", "runtime",
+                            "universe.py")
+    try:
+        with open(shm_path, encoding="utf-8") as f:
+            shm_tree = ast.parse(f.read())
+        for n in ("_HEADER", "_WRAP", "_ALIGN", "_LEASE_ALIGN",
+                  "_LEASE_STAMP"):
+            v = _py_const(shm_tree, n)
+            if v is not None:
+                out[f"shm.{n}"] = v
+        counters = None
+        for node in ast.walk(shm_tree):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "_FP_COUNTERS"
+                    for t in node.targets):
+                try:
+                    counters = [pair[0] for pair in
+                                ast.literal_eval(node.value)]
+                except (ValueError, SyntaxError):
+                    counters = None
+        if counters:
+            out["shm._FP_COUNTERS"] = counters
+        for node in ast.walk(shm_tree):
+            if isinstance(node, ast.ClassDef) and node.name == "ShmChannel":
+                for sub in node.body:
+                    if isinstance(sub, ast.Assign) and any(
+                            isinstance(t, ast.Name)
+                            and t.id == "_LEASE_DEPARTED"
+                            for t in sub.targets):
+                        try:
+                            out["shm.ShmChannel._LEASE_DEPARTED"] = \
+                                ast.literal_eval(sub.value)
+                        except (ValueError, SyntaxError):
+                            pass
+    except OSError:
+        pass
+    try:
+        with open(base_path, encoding="utf-8") as f:
+            base_tree = ast.parse(f.read())
+        for node in ast.walk(base_tree):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "_PKT_HDR"
+                    for t in node.targets) \
+                    and isinstance(node.value, ast.Call) \
+                    and node.value.args:
+                fmt = node.value.args[0]
+                if isinstance(fmt, ast.Constant) \
+                        and isinstance(fmt.value, str):
+                    out["base._PKT_HDR.size"] = _struct.calcsize(fmt.value)
+    except OSError:
+        pass
+    try:
+        with open(uni_path, encoding="utf-8") as f:
+            uni_tree = ast.parse(f.read())
+        v = None
+        for node in ast.walk(uni_tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == "CTX_MASK_BASE":
+                        from .core import const_int
+                        v = const_int(node.value)
+        if v is not None:
+            out["universe.CTX_MASK_BASE"] = v
+    except OSError:
+        pass
+    return out
+
+
+# ---------------------------------------------------------------------------
+# shared-field map (stall-watchdog forensics)
+# ---------------------------------------------------------------------------
+
+def shared_field_map(sources: Optional[List[str]] = None) -> Dict[str, dict]:
+    """{word name: {kind, region, file, line}} for every ``shared:``
+    annotation in the native sources — the watchdog uses it to name
+    which protocol region (seqlock/lease/doorbell/...) a dumped word
+    belongs to."""
+    if sources is None:
+        sources = [os.path.join(REPO_ROOT, p) for p in NATIVE_SOURCES]
+    out: Dict[str, dict] = {}
+    for path in sources:
+        if not os.path.exists(path):
+            continue
+        try:
+            src = CSource(path)
+        except OSError:
+            continue
+        decls, _ok, _init = harvest(src)
+        for d in decls.values():
+            out[d.name] = {
+                "kind": d.kind,
+                "region": d.region,
+                "file": src.relpath,
+                "line": d.line,
+                "accessor": d.is_func,
+            }
+    return out

@@ -35,6 +35,10 @@ INTERNAL_ENV: Set[str] = {
     "MV2T_PARENT_RANKS", "MV2T_RANK_PLATFORM", "MV2T_PLATFORM_EXPLICIT",
     "MV2T_VPOD_CHILD", "MV2T_VPOD_REAL", "MV2T_TEST_ON_TPU",
     "MV2T_TEST_FULL", "MV2T_FT_WATCHER",
+    # sanitizer-lane plumbing (bin/runtests --tsan): points every ring
+    # consumer in the job at one instrumented variant .so — a build
+    # coordinate, not a tunable
+    "MV2T_SHMRING_SO",
 }
 INTERNAL_PREFIXES = ("MV2T_DEBUG_", "MV2T_STASH_")
 

@@ -92,17 +92,20 @@ def build_report(engine) -> str:
     # stall.
     pch = getattr(u, "plane_channel", None) if u is not None else None
     if pch is not None:
+        fmap = _field_map()
         try:
             lines.append("## peer liveness leases (node-local, timeout "
-                         f"{getattr(pch, '_peer_timeout', 0)}s)")
+                         f"{getattr(pch, '_peer_timeout', 0)}s)"
+                         f"{_region_tag(fmap, 'lease')}")
             for ln in pch.lease_report():
                 lines.append(f"  {ln}")
         except Exception as e:
             lines.append(f"## peer leases unavailable: {e!r}")
         try:
-            lines.extend(_flat_report(u, pch))
+            lines.extend(_flat_report(u, pch, fmap))
         except Exception as e:
             lines.append(f"## flat-slot state unavailable: {e!r}")
+        lines.extend(_protocol_map_lines(fmap))
 
     tracer = getattr(engine, "tracer", None)
     if tracer is not None:
@@ -115,10 +118,55 @@ def build_report(engine) -> str:
     return "\n".join(lines)
 
 
-def _flat_report(u, pch) -> list:
+def _field_map() -> dict:
+    """The mv2tlint native pass's shared-field map ({word: kind/region/
+    site}), parsed from the C sources' ``shared:`` annotations. The map
+    is what lets a hang report NAME the protocol region (seqlock flat
+    wave / liveness lease / doorbell) a stuck wait belongs to instead
+    of printing bare word dumps. Diagnostics must never kill the
+    waiter, so any parse trouble degrades to an empty map."""
+    try:
+        from ..analysis.native import shared_field_map
+        return shared_field_map()
+    except Exception:
+        return {}
+
+
+def _region_tag(fmap: dict, word: str) -> str:
+    """`` [atomic(lease)]``-style tag for a shared word, or ''."""
+    info = fmap.get(word)
+    if not info:
+        return ""
+    reg = info.get("region")
+    return f" [{info['kind']}({reg})]" if reg else f" [{info['kind']}]"
+
+
+def _protocol_map_lines(fmap: dict) -> list:
+    """One summary section mapping every annotated shared word to its
+    protocol region, grouped by (kind, region)."""
+    if not fmap:
+        return ["## shared-field protocol map unavailable (native "
+                "annotations not parseable)"]
+    by_region = {}
+    for name, info in sorted(fmap.items()):
+        # counter regions are free-text rationales — don't splay them
+        reg = "-" if info["kind"] == "counter" \
+            else (info.get("region") or "-")
+        key = (info["kind"], reg)
+        by_region.setdefault(key, []).append(name)
+    lines = ["## shared-field protocol map (mv2tlint native pass)"]
+    for (kind, reg), names in sorted(by_region.items()):
+        lines.append(f"  {kind}({reg}): {', '.join(names)}")
+    return lines
+
+
+def _flat_report(u, pch, fmap=None) -> list:
     """Per-comm flat-slot region state (slots' in/out seqs, fold epoch,
-    poison flag) for every live comm with flat-tier state."""
+    poison flag) for every live comm with flat-tier state, each word
+    tagged with its protocol region from the shared-field map."""
     lines = []
+    fmap = fmap or {}
+    seq_tag = _region_tag(fmap, "fl_in")
     lib = pch._ring.lib
     if not pch.plane:
         return lines
@@ -140,18 +188,19 @@ def _flat_report(u, pch) -> list:
         base = lib.cp_flat_base(pch.plane, st.ctx, st.lane)
         lines.append(f"## flat region {comm.name} (ctx {st.ctx}, lane "
                      f"{st.lane}): fold epoch/bseq={base} "
-                     f"poison={bool(poi)} local_seq={st.base + st.k}")
+                     f"poison={bool(poi)} local_seq={st.base + st.k}"
+                     f"{seq_tag}")
         i = ct.c_longlong()
         o = ct.c_longlong()
         for slot in range(st.size):
             if lib.cp_flat_slot_state(pch.plane, st.ctx, st.lane, slot,
                                       i, o) == 0:
                 lines.append(f"  slot {slot}: in_seq={i.value} "
-                             f"out_seq={o.value}")
+                             f"out_seq={o.value}{seq_tag}")
         if lib.cp_flat_slot_state(pch.plane, st.ctx, st.lane,
                                   lib.cp_flat_nslots(), i, o) == 0:
             lines.append(f"  bcast block: bseq={i.value} "
-                         f"last_nbytes={o.value}")
+                         f"last_nbytes={o.value}{seq_tag}")
         shown += 1
     return lines
 

@@ -103,9 +103,15 @@ _FP_COUNTERS = [
 for _n, _d in _FP_COUNTERS:
     _mpit.pvar(_n, _mpit.PVAR_CLASS_COUNTER, "fastpath", _d)
 
-_HEADER = 128
-_WRAP = 0xFFFFFFFF
-_ALIGN = 8
+# ring framing + flags-segment layout constants. The C side's numbers
+# live in native/shm_layout.h; the mv2tlint `native` pass checks the two
+# sets byte-for-byte (MV2T_RING_HDR_BYTES <-> _HEADER, ...), so a drift
+# is a lint failure instead of a silent protocol break.
+_HEADER = 128            # per-ring control block (MV2T_RING_HDR_BYTES)
+_WRAP = 0xFFFFFFFF       # wrap marker (MV2T_RING_WRAP)
+_ALIGN = 8               # ring message alignment (MV2T_RING_ALIGN)
+_LEASE_ALIGN = 8         # flags segment: pad sleep bytes to this
+_LEASE_STAMP = 8         # bytes per liveness-lease stamp (u64)
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -120,20 +126,31 @@ def _load_native():
     if _lib_tried:
         return _lib
     _lib_tried = True
-    so = os.path.join(_REPO, "native", "libshmring.so")
+    # sanitizer lane (bin/runtests --tsan): every consumer in the job —
+    # this ctypes loader AND fastpath.c's dlopen — must map the SAME
+    # instrumented ring, so the override is one env var for both
+    so = os.environ.get("MV2T_SHMRING_SO") or os.path.join(
+        _REPO, "native", "libshmring.so")
     # always run make (no-op when fresh): an existence check would keep
     # loading a stale .so after shmring.cpp edits. fcntl.flock serializes
-    # co-launched ranks racing on the shared build target.
+    # co-launched ranks racing on the shared build target. An override
+    # points at a prebuilt variant (the sanitizer lane owns its build).
     try:
-        import fcntl
-        native_dir = os.path.join(_REPO, "native")
-        with open(os.path.join(native_dir, ".build.lock"), "w") as lockf:
-            fcntl.flock(lockf, fcntl.LOCK_EX)
-            try:
-                subprocess.run(["make", "-C", native_dir, "libshmring.so"],
-                               capture_output=True, timeout=120, check=True)
-            finally:
-                fcntl.flock(lockf, fcntl.LOCK_UN)
+        if os.environ.get("MV2T_SHMRING_SO"):
+            if not os.path.exists(so):
+                raise OSError(f"MV2T_SHMRING_SO does not exist: {so}")
+        else:
+            import fcntl
+            native_dir = os.path.join(_REPO, "native")
+            with open(os.path.join(native_dir, ".build.lock"), "w") as lockf:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                try:
+                    subprocess.run(["make", "-C", native_dir,
+                                    "libshmring.so"],
+                                   capture_output=True, timeout=120,
+                                   check=True)
+                finally:
+                    fcntl.flock(lockf, fcntl.LOCK_UN)
     except Exception as e:
         if not os.path.exists(so):
             log.warn("native shmring build failed (%s); python fallback", e)
@@ -529,8 +546,8 @@ class ShmChannel(Channel):
         # against MV2T_PEER_TIMEOUT so a SIGKILLed peer is a detectable
         # event instead of a hang. cplane.cpp maps the same layout.
         flags_path = f"{path}.flags"
-        lease_off = (self.n_local + 7) & ~7
-        flags_len = lease_off + 8 * self.n_local
+        lease_off = (self.n_local + _LEASE_ALIGN - 1) & ~(_LEASE_ALIGN - 1)
+        flags_len = lease_off + _LEASE_STAMP * self.n_local
         if self._owner:
             # write-then-rename so followers never see a short file
             with open(flags_path + ".tmp", "wb") as f:

@@ -54,6 +54,8 @@
 #include <time.h>
 #include <unistd.h>
 
+#include "shm_layout.h"
+
 // ---------------------------------------------------------------------------
 // shared-ring primitives from shmring.cpp (same .so)
 // ---------------------------------------------------------------------------
@@ -118,7 +120,7 @@ struct PktHdr {              // struct.Struct("<Biiiiqqqq8si"), 61 bytes
   int32_t exlen;
 };
 #pragma pack(pop)
-static_assert(sizeof(PktHdr) == 61, "wire header layout");
+static_assert(sizeof(PktHdr) == MV2T_PKT_HDR_BYTES, "wire header layout");
 
 // request states
 enum ReqState { RS_PENDING = 0, RS_ASSIST = 1, RS_DONE = 2, RS_FREE = 3 };
@@ -227,11 +229,11 @@ struct CPlane {
   // forwarded-to-python inbox
   Blob* py_head;
   Blob* py_tail;
-  std::atomic<int> py_count;
+  std::atomic<int> py_count;     /* shared: atomic(inbox) */
   // rendezvous assist queue
   AssistEntry* assist_head;
   AssistEntry* assist_tail;
-  std::atomic<int> assist_count;
+  std::atomic<int> assist_count; /* shared: atomic(inbox) */
   // origin-side cancels
   CancelEntry* cancels;
   // request table (id -> Req) — open chain on a growing array
@@ -247,22 +249,29 @@ struct CPlane {
   // these must be dropped, not re-queued as unexpected (ids are
   // allocated by max-allreduce and never reused, so the set only grows)
   CtxSet retired;
-  // failure set (ring indices)
-  uint8_t* failed;
+  // failure set (ring indices); written by the lease scan / launcher
+  // thread, read lock-free from every send path and flat wait
+  uint8_t* failed;               /* shared: atomic(failure) */
   // ring index <-> world rank (wire src_world carries WORLD ranks so the
   // python matcher and multi-node routing stay consistent)
   int* world_of;
-  // wakeup plumbing (mirrors ShmChannel's adaptive doorbell)
-  uint8_t* flags;                // mmap'd sleep flags, one per local rank
+  // wakeup plumbing (mirrors ShmChannel's adaptive doorbell): one
+  // cross-process sleep byte per local rank — the advertise-sleep /
+  // final-poll / skip-bell discipline is only race-free when every
+  // access is an ordered atomic
+  uint8_t* flags;                /* shared: atomic(doorbell) */
   long flags_len;
   // liveness leases: one u64 CLOCK_MONOTONIC-us stamp per local rank,
   // in the tail of the flags segment (shm.py owns the layout and the
   // heartbeat thread; C stamps opportunistically from advance_locked
   // and SCANS peers from every blocking wait). 0 = never stamped
   // (bootstrap), ~0 = departed cleanly (Finalize — not a failure).
-  volatile uint64_t* lease;
-  long long peer_timeout_us;     // 0 = lease detection off
-  uint64_t lease_scan_at;        // next scan time (throttle)
+  volatile uint64_t* lease;      /* shared: atomic(lease) */
+  long long peer_timeout_us;     // 0 = lease detection off (set once at
+                                 // bootstrap, before any concurrent read)
+  // next scan time (throttle); raced by concurrent blocking waits on
+  // different threads — a lost update only means one extra scan
+  uint64_t lease_scan_at;        /* shared: atomic(lease) */
   int bell_fd;                   // our bell socket (owned by python side)
   struct sockaddr_un* bells;     // peer bell addresses
   uint8_t* bell_set;
@@ -280,35 +289,23 @@ struct CPlane {
   // of per-context regions — fan-in/fan-out slots for small collectives
   uint8_t* flat;                 // guarded-by: single-writer-per-slot seqs
   size_t flat_len;
-  // fast-path observability counters (indices FPC_* below); written by
-  // fastpath.c through cp_fp_counters() and by cp_flat_*, read by the
-  // python mpit layer. Plain u64 slots: every slot has one natural
-  // writer thread and counters tolerate benign races.
-  uint64_t fpctr[16];
+  // fast-path observability counters (indices FPC_*, shm_layout.h);
+  // written by fastpath.c through cp_fp_counters() and by cp_flat_*,
+  // read by the python mpit layer.
+  uint64_t fpctr[MV2T_FPC_SLOTS]; /* shared: counter(one natural writer
+                                   * per slot; stat reads tolerate a
+                                   * stale or torn snapshot) */
   // python-progress callback for flat waits: invoked (rarely) when
   // forwarded python work is pending while a rank is parked in a flat
   // collective, so rendezvous assists cannot deadlock behind it
   void (*progress_cb)(void);
 };
 
-// fast-path counter indices (mirrored in native/mpi/fastpath.c and
-// mvapich2_tpu/transport/shm.py _FP_COUNTERS — keep all three in sync)
-enum {
-  FPC_HITS = 0,          // pt2pt ops completed on the C fast path
-  FPC_GIL_TAKES = 1,     // python progress runs taken from the hot loop
-  FPC_FB_DTYPE = 2,      // fallbacks: datatype not carryable
-  FPC_FB_COMM = 3,       // fallbacks: comm not plane-owned
-  FPC_FB_SIZE = 4,       // fallbacks: payload above fp_threshold
-  FPC_FB_PLANE = 5,      // fallbacks: plane missing/failed
-  FPC_COLL_FLAT = 6,     // collectives completed on the flat-slot tier
-  FPC_COLL_SCHED = 7,    // collectives completed on the pt2pt schedules
-  FPC_WAIT_SPIN = 8,     // blocking waits satisfied during the spin
-  FPC_WAIT_BELL = 9,     // blocking waits satisfied after doorbell sleep
-  FPC_FLAT_PROGRESS = 10, // python progress callbacks from flat waits
-  FPC_DEAD_PEER = 11     // peers declared dead by the C lease scan
-};
+// fast-path counter indices live in shm_layout.h (FPC_*): one enum for
+// this file, fastpath.c AND the mv2tlint layout check against
+// transport/shm.py's _FP_COUNTERS list.
 
-constexpr uint64_t LEASE_DEPARTED = ~0ull;
+constexpr uint64_t LEASE_DEPARTED = static_cast<uint64_t>(MV2T_LEASE_DEPARTED);
 
 inline uint64_t now_us() {
   struct timespec ts;
@@ -393,7 +390,12 @@ int ring_of_world(CPlane* p, int world) {
 
 void ring_bell(CPlane* p, int dst) {
   if (dst < 0 || dst >= p->n_local) return;
-  if (p->flags && p->flags[dst] == 0) return;   // receiver awake: skip
+  // receiver awake: skip the syscall. Acquire pairs with the waiter's
+  // seq_cst advertise store in cp_wait_quantum — a plain read here let
+  // the skip race the peer's sleep transition (seed lint finding).
+  if (p->flags &&
+      __atomic_load_n(&p->flags[dst], __ATOMIC_ACQUIRE) == 0)
+    return;
   if (!p->bell_set[dst] || p->bell_tx < 0) return;
   (void)sendto(p->bell_tx, "x", 1, MSG_DONTWAIT,
                reinterpret_cast<struct sockaddr*>(&p->bells[dst]),
@@ -999,7 +1001,8 @@ long long cp_send_eager(void* cp, int dst, int ctx, int comm_src, int tag,
                         long long sreq_id) {
   CPlane* p = static_cast<CPlane*>(cp);
   if (dst < 0 || dst >= p->n_local) return -1;
-  if (p->failed[dst]) return -2;               // MPIX_ERR_PROC_FAILED
+  if (__atomic_load_n(&p->failed[dst], __ATOMIC_ACQUIRE))
+    return -2;                                 // MPIX_ERR_PROC_FAILED
   // build header + payload contiguously; small messages fit the stack
   long total = sizeof(PktHdr) + nbytes;
   uint8_t stackbuf[4096 + sizeof(PktHdr)];
@@ -1078,7 +1081,7 @@ long long cp_send_eager_sp(void* cp, int dst, int ctx, int comm_src,
                            long long sreq_id) {
   CPlane* p = static_cast<CPlane*>(cp);
   if (dst < 0 || dst >= p->n_local) return -1;
-  if (p->failed[dst]) return -2;
+  if (__atomic_load_n(&p->failed[dst], __ATOMIC_ACQUIRE)) return -2;
   long nbytes = static_cast<long>(elem_size * count);
   long total = sizeof(PktHdr) + nbytes;
   uint8_t stackbuf[8192 + sizeof(PktHdr)];
@@ -1122,7 +1125,7 @@ long long cp_send_rndv(void* cp, int dst, int ctx, int comm_src, int tag,
   CPlane* p = static_cast<CPlane*>(cp);
   if (dst < 0 || dst >= p->n_local) return -1;
   if (!p->cma_enabled) return -1;
-  if (p->failed[dst]) return -2;
+  if (__atomic_load_n(&p->failed[dst], __ATOMIC_ACQUIRE)) return -2;
   pthread_mutex_lock(&p->mu);
   Req* r = new_req(p);
   r->is_send = 1;
@@ -1541,7 +1544,7 @@ static std::atomic<int> g_any_failed{0};
 void cp_mark_failed(void* cp, int ring_index) {
   CPlane* p = static_cast<CPlane*>(cp);
   if (ring_index >= 0 && ring_index < p->n_local)
-    p->failed[ring_index] = 1;
+    __atomic_store_n(&p->failed[ring_index], 1, __ATOMIC_RELEASE);
   g_any_failed.store(1, std::memory_order_release);
   // pending rendezvous sends toward the dead rank can never FIN — fail
   // them now so blocked waiters unwind with MPIX_ERR_PROC_FAILED (the
@@ -1573,7 +1576,7 @@ int cp_any_failed(void* cp) {
 int cp_rank_failed(void* cp, int ring_index) {
   CPlane* p = static_cast<CPlane*>(cp);
   if (ring_index < 0 || ring_index >= p->n_local) return 1;
-  return p->failed[ring_index];
+  return __atomic_load_n(&p->failed[ring_index], __ATOMIC_ACQUIRE);
 }
 
 // liveness-lease scan: declare peers dead whose heartbeat stamp went
@@ -1586,12 +1589,17 @@ int cp_lease_scan(void* cp) {
   CPlane* p = static_cast<CPlane*>(cp);
   if (!p->lease || p->peer_timeout_us <= 0) return 0;
   uint64_t now = now_us();
-  if (now < p->lease_scan_at) return 0;
+  if (now < __atomic_load_n(&p->lease_scan_at, __ATOMIC_RELAXED))
+    return 0;
   uint64_t step = static_cast<uint64_t>(p->peer_timeout_us) / 4;
-  p->lease_scan_at = now + (step < 10000 ? 10000 : step);
+  __atomic_store_n(&p->lease_scan_at,
+                   now + (step < 10000 ? 10000 : step),
+                   __ATOMIC_RELAXED);
   int ndead = 0;
   for (int i = 0; i < p->n_local; i++) {
-    if (i == p->me || p->failed[i]) continue;
+    if (i == p->me ||
+        __atomic_load_n(&p->failed[i], __ATOMIC_ACQUIRE))
+      continue;
     uint64_t v = __atomic_load_n(
         const_cast<const uint64_t*>(&p->lease[i]), __ATOMIC_ACQUIRE);
     if (v == 0 || v == LEASE_DEPARTED) continue;   // boot / clean exit
@@ -1685,32 +1693,34 @@ void cp_stats(void* cp, unsigned long long* tx, unsigned long long* rx,
 
 namespace {
 
-constexpr int FLAT_NSLOTS = 8;            // max comm size on this tier
-constexpr long FLAT_MAX = 4096;           // max payload bytes per slot
-constexpr long FLAT_SLOT_STRIDE = 64 + FLAT_MAX;   // hdr line + payload
-constexpr long FLAT_REG_HDR = 64;
-constexpr long FLAT_REG_STRIDE =
-    FLAT_REG_HDR + (FLAT_NSLOTS + 1) * FLAT_SLOT_STRIDE;
-// region index space: predefined contexts [0, 64) + the pooled
-// allocator's window [CTX_MASK_BASE, CTX_MASK_BASE + 4096)
-constexpr int FLAT_SMALL_CTXS = 64;
-constexpr int FLAT_MASK_CTXS = 4096;
-constexpr int32_t FLAT_CTX_MASK_BASE = 1 << 20;   // universe.CTX_MASK_BASE
+// layout constants live in shm_layout.h (the one cross-language source
+// of truth, checked mechanically by the mv2tlint layout pass)
+constexpr int FLAT_NSLOTS = MV2T_FLAT_NSLOTS;
+constexpr long FLAT_MAX = MV2T_FLAT_MAX;
+constexpr long FLAT_SLOT_STRIDE = MV2T_FLAT_SLOT_STRIDE;
+constexpr long FLAT_REG_HDR = MV2T_FLAT_REG_HDR;
+constexpr long FLAT_REG_STRIDE = MV2T_FLAT_REG_STRIDE;
+constexpr int FLAT_SMALL_CTXS = MV2T_FLAT_SMALL_CTXS;
+constexpr int FLAT_MASK_CTXS = MV2T_FLAT_MASK_CTXS;
+constexpr int32_t FLAT_CTX_MASK_BASE = MV2T_CTX_MASK_BASE;
 // lanes disambiguate DISJOINT comms sharing one context id (MPI_Comm_split
 // allocates a single id across all colors): a comm's lane is the minimum
 // plane ring index among its members — unique per sibling, deterministic
 // from static membership on every member
-constexpr int FLAT_LANES = 8;
-constexpr long FLAT_NREG = FLAT_SMALL_CTXS + FLAT_MASK_CTXS;
-constexpr long FLAT_FILE_LEN = FLAT_NREG * FLAT_LANES * FLAT_REG_STRIDE;
+constexpr int FLAT_LANES = MV2T_FLAT_LANES;
+constexpr long FLAT_NREG = MV2T_FLAT_NREG;
+constexpr long FLAT_FILE_LEN = MV2T_FLAT_FILE_LEN;
 constexpr uint64_t FLAT_TIMEOUT_US = 120u * 1000000u;
 
 // slot field accessors (in_seq @0, out_seq @8, payload @64; the bcast
-// block reuses the same stride with bseq in the in_seq word)
-inline volatile uint64_t* fl_in(uint8_t* s) {
+// block reuses the same stride with bseq in the in_seq word). Pointers
+// they return are seqlock words of the flat-wave protocol: every
+// dereference must ride fl_load/fl_store (acquire/release) — the lint
+// native pass enforces it from the annotations below.
+inline volatile uint64_t* fl_in(uint8_t* s) {   /* shared: seqlock(flat) */
   return reinterpret_cast<volatile uint64_t*>(s);
 }
-inline volatile uint64_t* fl_out(uint8_t* s) {
+inline volatile uint64_t* fl_out(uint8_t* s) {  /* shared: seqlock(flat) */
   return reinterpret_cast<volatile uint64_t*>(s + 8);
 }
 inline uint8_t* fl_pay(uint8_t* s) { return s + 64; }
@@ -1815,6 +1825,8 @@ int fl_reduce(int op, int dt, void* inout, const void* in, long n) {
 // needs the core handed to the peer, not burned), then short sleeps.
 // Pumps the plane and the registered python-progress callback while
 // parked so rendezvous assists keep flowing; escapes on peer failure.
+/* shared-ok: THE seqlock(flat) re-check loop — every load is fl_load
+ * (acquire) and the loop re-reads until the stamp lands */
 int flat_wait(CPlane* p, const volatile uint64_t* a, uint64_t want) {
   for (int i = 0; i < 256; i++) {
     if (fl_load(a) >= want) return 0;
@@ -1864,7 +1876,7 @@ inline void flat_enter(uint8_t* slot, uint64_t seq) {
 // later comm can key a region whose slot counters are torn — the comm
 // that would have reused it degrades to the scheduled tier instead of
 // folding a half-written slot (wrong data) or hanging on a stale seq.
-inline volatile uint64_t* fl_poi(uint8_t* reg) {
+inline volatile uint64_t* fl_poi(uint8_t* reg) { /* shared: seqlock(flat) */
   return reinterpret_cast<volatile uint64_t*>(reg);
 }
 
@@ -1935,7 +1947,7 @@ void flat_fault(CPlane* p) {
   if (g_ff.armed == 0) flat_fault_parse();
   if (g_ff.armed < 0) return;
   if (g_ff.rank >= 0 && p->world_of[p->me] != g_ff.rank) return;
-  long c = g_ff_count.fetch_add(1) + 1;
+  long c = g_ff_count.fetch_add(1, std::memory_order_relaxed) + 1;
   if (c != g_ff.nth && !(g_ff.repeat && c > g_ff.nth)) return;
   if (g_ff.crash) {
     fprintf(stderr, "cplane: fault engine crash-self at flat_fold "
@@ -2240,20 +2252,26 @@ int cp_wait_quantum(void* cp, long long req, long spin_us, long block_ms) {
     for (volatile int i = 0; i < 64; i++) {
     }
   }
-  // advertise sleep, final poll (race-free doorbell discipline), block
-  if (p->flags) p->flags[p->me] = 1;
+  // advertise sleep, final poll (race-free doorbell discipline), block.
+  // The advertise store must order BEFORE the final poll's loads
+  // (store-then-load, Dekker-style) — seq_cst, paired with the sender's
+  // acquire load in ring_bell.
+  if (p->flags)
+    __atomic_store_n(&p->flags[p->me], 1, __ATOMIC_SEQ_CST);
   pthread_mutex_lock(&p->mu);
   advance_locked(p);
   Req* r = get_req(p, req);
   int st = r ? r->state : RS_FREE;
   pthread_mutex_unlock(&p->mu);
   if (st == RS_DONE || st == RS_FREE) {
-    if (p->flags) p->flags[p->me] = 0;
+    if (p->flags)
+      __atomic_store_n(&p->flags[p->me], 0, __ATOMIC_RELEASE);
     return 2;
   }
   if (p->assist_count.load(std::memory_order_acquire) > 0 ||
       p->py_count.load(std::memory_order_acquire) > 0) {
-    if (p->flags) p->flags[p->me] = 0;
+    if (p->flags)
+      __atomic_store_n(&p->flags[p->me], 0, __ATOMIC_RELEASE);
     return 1;
   }
   int woken = 0;
@@ -2275,7 +2293,8 @@ int cp_wait_quantum(void* cp, long long req, long spin_us, long block_ms) {
     struct timespec ts = {0, 200000};          // 200 us fallback nap
     nanosleep(&ts, nullptr);
   }
-  if (p->flags) p->flags[p->me] = 0;
+  if (p->flags)
+    __atomic_store_n(&p->flags[p->me], 0, __ATOMIC_RELEASE);
   // idle with nothing arriving: the awaited peer may be dead — the
   // (throttled) lease scan marks it, cp_mark_failed sweeps its sends,
   // and the python reconciliation unwinds its posted recvs
@@ -2313,7 +2332,9 @@ int cp_coll_gather(void* cp, int cctx, int rank, int n, const int* rings,
    * unwinds with -2 when its send or wait meets the same failure. */
   for (int r = 0; r < n; r++) {
     if (rings[r] < 0 || rings[r] >= p->n_local) return -1;
-    if (r != rank && p->failed[rings[r]]) return -1;
+    if (r != rank &&
+        __atomic_load_n(&p->failed[rings[r]], __ATOMIC_ACQUIRE))
+      return -1;
   }
   int tag = cp_coll_tag(cp, cctx);
   static std::atomic<long long> g_gather_sreq{3LL << 60};
@@ -2328,7 +2349,9 @@ int cp_coll_gather(void* cp, int cctx, int rank, int n, const int* rings,
     if (r == rank) continue;
     for (;;) {
       long long s = cp_send_eager(cp, rings[r], cctx, rank, tag, mine,
-                                  paysz, g_gather_sreq.fetch_add(1));
+                                  paysz,
+                                  g_gather_sreq.fetch_add(
+                                      1, std::memory_order_relaxed));
       if (s == 0) break;
       if (s == -2 || cp_rank_failed(cp, rings[r])) {
         rc = -2;

@@ -32,6 +32,7 @@
 
 #include "mpi.h"
 #include "libmpi_internal.h"
+#include "../shm_layout.h"
 
 #ifndef MV2T_REPO_ROOT
 #define MV2T_REPO_ROOT "."
@@ -91,29 +92,22 @@ static struct {
     unsigned long long *(*fp_counters)(cph);
 } F;
 
-/* fast-path counter indices — mirror of cplane.cpp's FPC_* enum (and
- * transport/shm.py _FP_COUNTERS); counters live in the plane so the
+/* fast-path counter indices come from shm_layout.h (FPC_*) — one enum
+ * for cplane.cpp, this file, and the mv2tlint layout check against
+ * transport/shm.py's _FP_COUNTERS; counters live in the plane so the
  * python mpit layer reads them without touching libmpi.so */
-enum {
-    FPC_HITS = 0,
-    FPC_GIL_TAKES = 1,
-    FPC_FB_DTYPE = 2,
-    FPC_FB_COMM = 3,
-    FPC_FB_SIZE = 4,
-    FPC_FB_PLANE = 5,
-    FPC_COLL_FLAT = 6,
-    FPC_COLL_SCHED = 7,
-    FPC_WAIT_SPIN = 8,
-    FPC_WAIT_BELL = 9,
-    FPC_FLAT_PROGRESS = 10,
-    FPC_DEAD_PEER = 11   /* peers declared dead by the C lease scan */
-};
 
-static unsigned long long *fp_ctr;  /* live plane's counter block */
+/* live plane's counter block; re-bound under fp_mu when the plane
+ * changes, read lock-free by FPCTR */
+static unsigned long long *fp_ctr;  /* shared: counter(stat slots — one
+                                     * natural writer, torn reads
+                                     * tolerated by the mpit reader) */
 
 #define FPCTR(i) do { if (fp_ctr != NULL) fp_ctr[i]++; } while (0)
 
-static int fp_state = -1;       /* -1 unknown, 0 unavailable, 1 ready */
+/* -1 unknown, 0 unavailable, 1 ready; double-checked init — lock-free
+ * readers pair an acquire load with the release store under fp_mu */
+static int fp_state = -1;       /* shared: atomic(init) */
 static long fp_threshold = 0;
 static long fp_congest_min = 8192;  /* RNDV_CONGEST_MIN (fetched with
                                      * the eager threshold) */
@@ -129,8 +123,12 @@ static _Atomic long long fp_sreq_next = (1LL << 48);
 
 static int fp_load_locked(void) {
     char path[1024];
-    snprintf(path, sizeof(path), "%s/native/libshmring.so",
-             MV2T_REPO_ROOT);
+    const char *override = getenv("MV2T_SHMRING_SO");
+    if (override != NULL && override[0] != '\0')
+        snprintf(path, sizeof(path), "%s", override);
+    else
+        snprintf(path, sizeof(path), "%s/native/libshmring.so",
+                 MV2T_REPO_ROOT);
     F.dl = dlopen(path, RTLD_NOW | RTLD_GLOBAL);
     if (F.dl == NULL)
         return 0;
@@ -188,14 +186,17 @@ static void fp_progress_hook(void);
 
 /* the live plane, or NULL when the fast path must stand down */
 static cph fp_plane(void) {
-    if (fp_state == 0)
+    int st_ = __atomic_load_n(&fp_state, __ATOMIC_ACQUIRE);
+    if (st_ == 0)
         return NULL;
-    if (fp_state < 0) {
+    if (st_ < 0) {
         pthread_mutex_lock(&fp_mu);
-        if (fp_state < 0)
-            fp_state = fp_load_locked() ? 1 : 0;
+        if (fp_state < 0)                       /* mv2tlint: ignore[native] under fp_mu */
+            __atomic_store_n(&fp_state, fp_load_locked() ? 1 : 0,
+                             __ATOMIC_RELEASE);
+        st_ = fp_state;                         /* mv2tlint: ignore[native] under fp_mu */
         pthread_mutex_unlock(&fp_mu);
-        if (fp_state == 0)
+        if (st_ == 0)
             return NULL;
     }
     static cph fp_ctr_plane;    /* counter block owner (re-init safety) */
@@ -511,7 +512,10 @@ static int fp_recv_status(cph p, long long cpid, MPI_Status *stout,
  * path to a select() syscall per message (the r5 latency regression,
  * 13 -> 43 us half-RTT).  Matches the reference's spin-count tuning
  * knob (MV2_SPIN_COUNT, ch3_progress.c). */
-static long fp_spin_us = 40;
+static long fp_spin_us = 40;    /* shared: counter(adaptive heuristic —
+                                 * concurrent waiters may interleave
+                                 * updates; any interleaving yields a
+                                 * valid budget) */
 
 /* shared blocking-wait loop for plane requests; returns when the
  * request is DONE.  The wait outcome feeds both the spin adaptation

@@ -1,0 +1,78 @@
+/* shm_layout.h — the ONE definition of every cross-language shared-memory
+ * layout constant.
+ *
+ * Three consumers parse or include this file:
+ *   - native/cplane.cpp and native/shmring.cpp (C++, #include)
+ *   - native/mpi/fastpath.c (C99, #include)
+ *   - mvapich2_tpu/analysis/native.py (the mv2tlint `native` pass parses
+ *     the #defines and the FPC enum mechanically and cross-checks them
+ *     against the Python mirrors: transport/shm.py layout constants,
+ *     transport/base.py's packet-header struct format, and
+ *     runtime/universe.py CTX_MASK_BASE).
+ *
+ * Keep every definition a preprocessor-evaluable integer expression
+ * (literals, + - * << | ~ and parens only): the lint pass evaluates the
+ * right-hand sides with a tiny expression interpreter, so anything
+ * fancier (sizeof, casts, function calls) breaks the mechanical check.
+ */
+#ifndef MV2T_SHM_LAYOUT_H
+#define MV2T_SHM_LAYOUT_H
+
+/* ---- SPSC ring layout (shmring.cpp <-> transport/shm.py fallback) ---- */
+#define MV2T_RING_HDR_BYTES 128   /* per-ring control block (head/tail) */
+#define MV2T_RING_WRAP 0xFFFFFFFF /* wrap marker in the length word */
+#define MV2T_RING_ALIGN 8         /* message alignment in the ring */
+
+/* ---- wire packet header (cplane.cpp PktHdr <-> transport/base.py) ---- */
+#define MV2T_PKT_HDR_BYTES 61     /* struct.calcsize("<Biiiiqqqq8si") */
+
+/* ---- doorbell flags + liveness-lease segment (<path>.flags) ----------
+ * layout: [n_local sleep bytes][pad to MV2T_LEASE_ALIGN][n_local
+ * MV2T_LEASE_STAMP_BYTES monotonic-us stamps]. Both cplane.cpp
+ * (cp_create mmap) and transport/shm.py (ShmChannel) compute the lease
+ * offset from these two numbers. */
+#define MV2T_LEASE_ALIGN 8
+#define MV2T_LEASE_STAMP_BYTES 8
+#define MV2T_LEASE_DEPARTED (~0)  /* u64 sentinel: clean Finalize exit */
+
+/* ---- flat-slot collective segment (cp_flat_*, <path>.fcoll) ---------- */
+#define MV2T_FLAT_NSLOTS 8        /* max comm size on the flat tier */
+#define MV2T_FLAT_MAX 4096        /* max payload bytes per slot */
+#define MV2T_FLAT_REG_HDR 64      /* region header line (poison word) */
+/* per-slot stride: one header cache line (in_seq @0, out_seq @8) +
+ * payload */
+#define MV2T_FLAT_SLOT_STRIDE (64 + MV2T_FLAT_MAX)
+#define MV2T_FLAT_REG_STRIDE \
+    (MV2T_FLAT_REG_HDR + (MV2T_FLAT_NSLOTS + 1) * MV2T_FLAT_SLOT_STRIDE)
+/* region index space: predefined contexts [0, 64) + the pooled
+ * allocator's window [CTX_MASK_BASE, CTX_MASK_BASE + 4096) */
+#define MV2T_FLAT_SMALL_CTXS 64
+#define MV2T_FLAT_MASK_CTXS 4096
+#define MV2T_CTX_MASK_BASE (1 << 20)  /* runtime/universe.py CTX_MASK_BASE */
+#define MV2T_FLAT_LANES 8
+#define MV2T_FLAT_NREG (MV2T_FLAT_SMALL_CTXS + MV2T_FLAT_MASK_CTXS)
+#define MV2T_FLAT_FILE_LEN \
+    (MV2T_FLAT_NREG * MV2T_FLAT_LANES * MV2T_FLAT_REG_STRIDE)
+
+/* ---- fast-path observability counters (CPlane.fpctr) -----------------
+ * Index order is load-bearing across three consumers: cplane.cpp and
+ * fastpath.c bump the slots, transport/shm.py's _FP_COUNTERS list maps
+ * slot index -> pvar name (FPC_HITS <-> fp_hits, ...). The lint pass
+ * checks the enum below against _FP_COUNTERS name-by-name. */
+enum {
+    FPC_HITS = 0,          /* pt2pt ops completed on the C fast path */
+    FPC_GIL_TAKES = 1,     /* python progress runs taken from the hot loop */
+    FPC_FB_DTYPE = 2,      /* fallbacks: datatype not carryable */
+    FPC_FB_COMM = 3,       /* fallbacks: comm not plane-owned */
+    FPC_FB_SIZE = 4,       /* fallbacks: payload above fp_threshold */
+    FPC_FB_PLANE = 5,      /* fallbacks: plane missing/failed */
+    FPC_COLL_FLAT = 6,     /* collectives completed on the flat-slot tier */
+    FPC_COLL_SCHED = 7,    /* collectives completed on the pt2pt schedules */
+    FPC_WAIT_SPIN = 8,     /* blocking waits satisfied during the spin */
+    FPC_WAIT_BELL = 9,     /* blocking waits satisfied after doorbell sleep */
+    FPC_FLAT_PROGRESS = 10, /* python progress callbacks from flat waits */
+    FPC_DEAD_PEER = 11     /* peers declared dead by the C lease scan */
+};
+#define MV2T_FPC_SLOTS 16  /* fpctr array length (spare slots included) */
+
+#endif /* MV2T_SHM_LAYOUT_H */

@@ -17,6 +17,8 @@
 #include <cstdint>
 #include <cstring>
 
+#include "shm_layout.h"
+
 #include <fcntl.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
@@ -24,13 +26,18 @@
 
 namespace {
 
-constexpr uint64_t kHeaderBytes = 128;   // per-ring control block
-constexpr uint32_t kWrapMarker = 0xFFFFFFFFu;
-constexpr uint64_t kAlign = 8;
+// ring framing constants live in shm_layout.h — transport/shm.py's
+// pure-python fallback implements the identical layout and the lint
+// layout pass cross-checks the two mechanically
+constexpr uint64_t kHeaderBytes = MV2T_RING_HDR_BYTES;
+constexpr uint32_t kWrapMarker = MV2T_RING_WRAP;
+constexpr uint64_t kAlign = MV2T_RING_ALIGN;
 
 struct RingHdr {
-  std::atomic<uint64_t> head;  // consumer position (bytes, monotonic)
-  std::atomic<uint64_t> tail;  // producer position (bytes, monotonic)
+  // consumer position (bytes, monotonic)
+  std::atomic<uint64_t> head;  /* shared: atomic(ring) */
+  // producer position (bytes, monotonic)
+  std::atomic<uint64_t> tail;  /* shared: atomic(ring) */
 };
 
 struct Region {

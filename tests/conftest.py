@@ -48,6 +48,12 @@ def pytest_configure(config):
         "mid-collective kills, churn) — a small seeded subset runs in "
         "tier-1 unmarked; run the full matrix with -m chaos or "
         "bin/runtests --chaos (or MV2T_TEST_FULL=1)")
+    config.addinivalue_line(
+        "markers",
+        "modelcheck: full-depth shm-protocol model exploration (np=4 "
+        "seqlock waves, long-horizon lease) — a small-bound subset "
+        "runs in tier-1 unmarked; run the full depth with "
+        "-m modelcheck (or MV2T_TEST_FULL=1)")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -57,8 +63,13 @@ def pytest_collection_modifyitems(config, items):
     skip = pytest.mark.skip(reason="slow lane: set MV2T_TEST_FULL=1")
     skip_chaos = pytest.mark.skip(
         reason="chaos lane: run with -m chaos (or MV2T_TEST_FULL=1)")
+    skip_model = pytest.mark.skip(
+        reason="modelcheck lane: run with -m modelcheck (or "
+               "MV2T_TEST_FULL=1)")
     for item in items:
         if "slow" in item.keywords:
             item.add_marker(skip)
         if "chaos" in item.keywords and "chaos" not in markexpr:
             item.add_marker(skip_chaos)
+        if "modelcheck" in item.keywords and "modelcheck" not in markexpr:
+            item.add_marker(skip_model)

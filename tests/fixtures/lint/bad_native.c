@@ -1,0 +1,83 @@
+/* bad_native.c — seeded violations for the mv2tlint `native` pass.
+ * tests/test_lint.py asserts the exact finding count AND line numbers,
+ * so edits here must update the test. Never compiled — lint input only.
+ *
+ * Seeded breaks (one per protocol family the pass guards):
+ *   line 26  plain store to a doorbell word (the ring_bell seed bug)
+ *   line 30  volatile-only read of a lease word
+ *   line 34  __atomic_* call without an explicit memory order
+ *   line 38  guarded-by word touched without the lock
+ *   line 57  raw deref of a seqlock word outside the load/store idiom
+ *   line 20  counter annotation without the required rationale
+ *   (+ one seqlock-pair structural finding, line 0, for region
+ *    'fanout': a writer exists but no acquire-load reader)
+ */
+#include <pthread.h>
+
+struct Plane {
+  unsigned char *flags;                /* shared: atomic(doorbell) */
+  volatile unsigned long long *lease;  /* shared: atomic(lease) */
+  unsigned long long ctr[4];           /* shared: counter */
+  int queue;                           /* shared: guarded-by(mu) */
+  pthread_mutex_t mu;
+};
+
+static void bad_doorbell(struct Plane *p, int dst) {
+  p->flags[dst] = 1;
+}
+
+static unsigned long long bad_lease(struct Plane *p, int i) {
+  return p->lease[i];
+}
+
+static void bad_order(struct Plane *p, int i) {
+  __atomic_store_n(&p->flags[i], 0);
+}
+
+static void bad_guard(struct Plane *p) {
+  p->queue = 1;
+}
+
+static void good_guard(struct Plane *p) {
+  pthread_mutex_lock(&p->mu);
+  p->queue = 2;
+  pthread_mutex_unlock(&p->mu);
+}
+
+/* seqlock accessors: 'wave' is used correctly below, 'fanout' has a
+ * writer but no reader (structural pairing finding) */
+static volatile unsigned long long *sl_wave(unsigned char *reg) {  /* shared: seqlock(wave) */
+  return (volatile unsigned long long *)reg;
+}
+static volatile unsigned long long *sl_fan(unsigned char *reg) {  /* shared: seqlock(fanout) */
+  return (volatile unsigned long long *)(reg + 8);
+}
+
+static void bad_seqlock_deref(unsigned char *reg) {
+  *sl_wave(reg) = 5;
+}
+
+static void good_wave_writer(unsigned char *reg) {
+  __atomic_store_n(sl_wave(reg), 7, __ATOMIC_RELEASE);
+}
+
+static unsigned long long good_wave_reader(unsigned char *reg) {
+  unsigned long long v = 0;
+  while ((v = __atomic_load_n(sl_wave(reg), __ATOMIC_ACQUIRE)) < 7) {
+  }
+  return v;
+}
+
+static void fan_writer_only(unsigned char *reg) {
+  __atomic_store_n(sl_fan(reg), 1, __ATOMIC_RELEASE);
+}
+
+static void escaped(struct Plane *p, int i) {
+  p->flags[i] = 0;  /* mv2tlint: ignore[native] single-threaded test rig */
+}
+
+/* mv2tlint: native-init */
+static void boot(struct Plane *p) {
+  p->flags[0] = 0;
+  p->lease[0] = 0;
+}

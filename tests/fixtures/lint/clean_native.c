@@ -1,0 +1,76 @@
+/* clean_native.c — every shared-annotation discipline done right: the
+ * mv2tlint `native` pass must report ZERO findings here. Mirrors the
+ * idioms of native/cplane.cpp (doorbell flags, lease stamps, seqlock
+ * accessors with a vetted wait consumer, guarded-by, counters). */
+#include <pthread.h>
+
+struct Plane {
+  unsigned char *flags;                /* shared: atomic(doorbell) */
+  volatile unsigned long long *lease;  /* shared: atomic(lease) */
+  unsigned long long ctr[4];           /* shared: counter(stat slots, one
+                                        * writer, torn reads tolerated) */
+  int queue;                           /* shared: guarded-by(mu) */
+  pthread_mutex_t mu;
+};
+
+static volatile unsigned long long *sl_word(unsigned char *reg) {  /* shared: seqlock(wave) */
+  return (volatile unsigned long long *)reg;
+}
+
+/* auto-detected atomic wrappers (single __atomic statement bodies) */
+static unsigned long long sl_load(const volatile unsigned long long *a) {
+  return __atomic_load_n(a, __ATOMIC_ACQUIRE);
+}
+static void sl_store(volatile unsigned long long *a,
+                     unsigned long long v) {
+  __atomic_store_n(a, v, __ATOMIC_RELEASE);
+}
+
+/* shared-ok: the region's re-check loop — acquire loads until the stamp
+ * lands */
+static int wave_wait(const volatile unsigned long long *a,
+                     unsigned long long want) {
+  while (sl_load(a) < want) {
+  }
+  return 0;
+}
+
+static void doorbell(struct Plane *p, int dst) {
+  if (__atomic_load_n(&p->flags[dst], __ATOMIC_ACQUIRE) == 0)
+    return;
+  __atomic_store_n(&p->flags[dst], 0, __ATOMIC_RELEASE);
+}
+
+static unsigned long long lease_age(struct Plane *p, int i) {
+  return __atomic_load_n(&p->lease[i], __ATOMIC_ACQUIRE);
+}
+
+static void bump(struct Plane *p) {
+  p->ctr[0]++;                     /* counter: tolerated by annotation */
+}
+
+static void locked_queue(struct Plane *p) {
+  pthread_mutex_lock(&p->mu);
+  p->queue = 2;
+  pthread_mutex_unlock(&p->mu);
+}
+
+/* holds: mu */
+static void queue_cb(struct Plane *p) {
+  p->queue = 3;
+}
+
+static void wave_writer(unsigned char *reg) {
+  sl_store(sl_word(reg), 9);
+}
+
+static unsigned long long wave_reader(unsigned char *reg) {
+  wave_wait(sl_word(reg), 9);
+  return sl_load(sl_word(reg));
+}
+
+/* mv2tlint: native-init */
+static void boot(struct Plane *p) {
+  p->flags[0] = 0;
+  p->lease[0] = 0;
+}

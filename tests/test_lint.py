@@ -154,3 +154,126 @@ def test_bin_entrypoint_ci_invocation():
 
 def test_list_passes():
     assert lint_main(["--list-passes"]) == 0
+
+
+# -- the native pass: C-plane atomic discipline + layout -----------------
+
+from mvapich2_tpu.analysis import native as native_mod  # noqa: E402
+
+
+def _lint_native(name):
+    return native_mod.NativeSourcePass(
+        [os.path.join(FIXTURES, name)], layout=False).run([])
+
+
+def test_native_pass_bad_fixture():
+    """Seeded C fixture: exact finding count and locations, one per
+    protocol family (doorbell plain store, volatile-only lease read,
+    order-less __atomic, guarded-by without the lock, raw seqlock
+    deref, rationale-less counter, seqlock pairing)."""
+    fs = _lint_native("bad_native.c")
+    assert [(f.pass_id, f.line) for f in fs] == [
+        ("native", 0), ("native", 20), ("native", 26), ("native", 30),
+        ("native", 34), ("native", 38), ("native", 57)]
+    msgs = "\n".join(f.msg for f in fs)
+    assert "doorbell" in msgs and "lease" in msgs
+    assert "seqlock(wave)" in msgs and "guarded-by mu" in msgs
+    assert "__ATOMIC_" in msgs and "rationale" in msgs
+    assert "fanout" in msgs          # pairing: writer without reader
+
+
+def test_native_pass_clean_fixture():
+    assert _lint_native("clean_native.c") == []
+
+
+def test_native_pass_repo_clean():
+    """The committed native tree is clean: zero unbaselined findings
+    from the native pass (including the layout cross-check)."""
+    fs = native_mod.NativeSourcePass().run([])
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_native_pass_catches_seed_violation_class(tmp_path):
+    """Mutation check with teeth: re-introduce the exact class of bug
+    fixed in this PR's seed run (plain store to the shared failure
+    byte) and prove the pass catches it."""
+    src = open(os.path.join(REPO, "native", "cplane.cpp")).read()
+    mutated = src.replace(
+        "__atomic_store_n(&p->failed[ring_index], 1, __ATOMIC_RELEASE);",
+        "p->failed[ring_index] = 1;")
+    assert mutated != src
+    p = tmp_path / "cplane_mut.cpp"
+    p.write_text(mutated)
+    fs = native_mod.NativeSourcePass([str(p)], layout=False).run([])
+    assert any("'failed' plainly accessed" in f.msg for f in fs), \
+        [f.msg for f in fs]
+
+
+def test_native_layout_mismatch_detected(tmp_path):
+    """A drifted cross-language constant is a finding: doctor the
+    header's ring-header size away from shm.py's _HEADER."""
+    real = open(os.path.join(REPO, "native", "shm_layout.h")).read()
+    hdr = tmp_path / "shm_layout.h"
+    hdr.write_text(real.replace("#define MV2T_RING_HDR_BYTES 128",
+                                "#define MV2T_RING_HDR_BYTES 64"))
+    fs = native_mod.NativeSourcePass([], layout=True,
+                                     layout_header=str(hdr)).run([])
+    assert any("MV2T_RING_HDR_BYTES" in f.msg and "disagree" in f.msg
+               for f in fs), [f.msg for f in fs]
+
+
+def test_native_layout_fpc_drift_detected(tmp_path):
+    """Renumbering a fast-path counter slot desyncs the FPC enum from
+    shm.py's _FP_COUNTERS — mechanical finding, not convention."""
+    real = open(os.path.join(REPO, "native", "shm_layout.h")).read()
+    hdr = tmp_path / "shm_layout.h"
+    hdr.write_text(real.replace("FPC_DEAD_PEER = 11",
+                                "FPC_DEAD_PEER = 12"))
+    fs = native_mod.NativeSourcePass([], layout=True,
+                                     layout_header=str(hdr)).run([])
+    assert any("FPC" in f.msg or "_FP_COUNTERS" in f.msg for f in fs), \
+        [f.msg for f in fs]
+
+
+def test_native_cli_routes_c_paths():
+    """mv2tlint accepts C files on the command line and routes them to
+    the native pass (fixture mode)."""
+    assert lint_main([os.path.join(FIXTURES, "bad_native.c"),
+                      "--no-baseline"]) == 1
+    assert lint_main([os.path.join(FIXTURES, "clean_native.c"),
+                      "--no-baseline"]) == 0
+
+
+def test_native_pass_in_default_gate():
+    """The tier-1 strict gate includes the native pass — a new
+    unbaselined native finding fails tier-1 through
+    test_repo_strict_clean above."""
+    assert any(p.id == "native" for p in core.all_passes())
+
+
+def test_runtests_tsan_lane_wired():
+    """bin/runtests grew the --tsan lane; the Makefile has the variant
+    targets and the vetted suppressions file exists."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "runtests"),
+         "--help"], capture_output=True, text=True, timeout=60)
+    assert "--tsan" in r.stdout and "--lint" in r.stdout
+    mk = open(os.path.join(REPO, "native", "Makefile")).read()
+    assert "fsanitize=thread" in mk and "tsan/libmpi.so" in mk
+    assert os.path.exists(os.path.join(REPO, "native", "tsan.supp"))
+
+
+def test_watchdog_shared_field_map():
+    """The stall watchdog names protocol regions from the native
+    pass's shared-field map (seqlock/lease/doorbell forensics)."""
+    from mvapich2_tpu.trace import watchdog
+    m = watchdog._field_map()
+    assert m, "shared-field map is empty"
+    assert m["fl_in"]["kind"] == "seqlock"
+    assert m["fl_in"]["region"] == "flat"
+    assert m["lease"]["kind"] == "atomic"
+    assert m["flags"]["region"] == "doorbell"
+    assert watchdog._region_tag(m, "lease") == " [atomic(lease)]"
+    lines = watchdog._protocol_map_lines(m)
+    assert any("seqlock(flat)" in ln for ln in lines)
+    assert any("atomic(doorbell)" in ln for ln in lines)

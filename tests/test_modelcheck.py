@@ -1,0 +1,165 @@
+"""Shm-protocol model checker tests (mvapich2_tpu.analysis.model).
+
+Tier-1 (unmarked, small bounds, < 60 s total):
+  * every clean protocol model explores exhaustively with zero
+    violations — no torn read, agreement, poison stickiness, no lost
+    wake, detection within 2x timeout, no false positives;
+  * every seeded protocol mutation in the matrix is CAUGHT, with the
+    expected invariant named;
+  * sleep-set reduced mode agrees with full exploration on every model
+    (the soundness guard for the DPOR-style pruning);
+  * violation traces replay: applying the trace's transitions from the
+    initial state reproduces a violating state.
+
+Full depth (``modelcheck`` marker): np=4 waves=2 (+crash) seqlock,
+np=4 bcast, long-horizon lease — the exhaustive lane bin/runtests'
+lint/tsan lanes complement.
+"""
+
+import pytest
+
+from mvapich2_tpu.analysis import model as M
+from mvapich2_tpu.analysis.model import doorbell, lease, seqlock
+
+pytestmark = pytest.mark.lint
+
+CLEAN = [
+    ("allreduce-n2", lambda: seqlock.build_allreduce(2, 1)),
+    ("allreduce-n3", lambda: seqlock.build_allreduce(3, 1)),
+    ("allreduce-n2-w2", lambda: seqlock.build_allreduce(2, 2)),
+    ("allreduce-n2-crash", lambda: seqlock.build_allreduce(2, 1,
+                                                           crash=True)),
+    ("allreduce-n3-crash", lambda: seqlock.build_allreduce(3, 1,
+                                                           crash=True)),
+    # np=4 (the flat tier's full single-node width at FLAT_NSLOTS=8 is
+    # modeled up to 4 — the protocol is rank-symmetric beyond the
+    # leader/member split): still < 1 s, so tier-1 carries it
+    ("allreduce-n4", lambda: seqlock.build_allreduce(4, 1)),
+    ("allreduce-n4-crash", lambda: seqlock.build_allreduce(4, 1,
+                                                           crash=True)),
+    ("bcast-n3", lambda: seqlock.build_bcast(3)),
+    ("bcast-n4", lambda: seqlock.build_bcast(4)),
+    ("doorbell", lambda: doorbell.build()),
+    ("lease", lambda: lease.build()),
+    ("lease-crash", lambda: lease.build(crash=True)),
+    ("lease-depart", lambda: lease.build(depart=True)),
+]
+
+EXPECTED_INVARIANT = {
+    # mutation -> invariant(s) that must name the bug
+    "stamp_before_copy": {"no-torn-read-delivered"},
+    "no_reader_guard": {"no-torn-read-delivered", "agreement"},
+    "no_overwrite_guard": {"no-torn-read-delivered"},
+    "no_poison": {"poison-sticky", "no-torn-read-delivered"},
+    "no_arrival_wave": {"deadlock"},
+    "no_final_poll": {"no-lost-wake", "deadlock"},
+    "ring_before_publish": {"no-lost-wake", "deadlock"},
+    "departed_stale": {"no-false-positive"},
+    "throttle_too_long": {"detect-within-deadline"},
+    "inverted_compare": {"detect-within-deadline"},
+}
+
+
+# -- clean protocols hold under every interleaving -----------------------
+
+@pytest.mark.parametrize("name,build", CLEAN, ids=[c[0] for c in CLEAN])
+def test_clean_protocol_exhaustive(name, build):
+    r = M.explore(build())
+    assert r.complete, f"{name}: exploration truncated at {r.states}"
+    assert r.ok, f"{name}: {[f'{v.invariant}: {v.message}' for v in r.violations]}"
+    assert r.states > 5      # the model actually explored something
+
+
+# -- every seeded mutation is caught -------------------------------------
+
+@pytest.mark.parametrize("label,build,mutation",
+                         M.mutation_matrix(),
+                         ids=[f"{m[0]}-{m[2]}" for m in M.mutation_matrix()])
+def test_mutation_caught(label, build, mutation):
+    r = M.explore(build())
+    assert not r.ok, f"{label}/{mutation}: seeded break NOT caught"
+    got = {v.invariant for v in r.violations}
+    want = EXPECTED_INVARIANT[mutation]
+    assert got & want, (f"{label}/{mutation}: violated {got}, expected "
+                        f"one of {want}")
+
+
+def test_matrix_has_at_least_six_variants():
+    muts = {m[2] for m in M.mutation_matrix()}
+    assert len(muts) >= 6, muts
+
+
+# -- DPOR sleep-set mode agrees with full exploration --------------------
+
+@pytest.mark.parametrize("label,build,mutation",
+                         M.mutation_matrix(),
+                         ids=[f"{m[0]}-{m[2]}" for m in M.mutation_matrix()])
+def test_reduced_mode_agrees(label, build, mutation):
+    m = build()
+    full = M.explore(m)
+    red = M.explore(m, reduce=True)
+    assert {v.invariant for v in full.violations} \
+        == {v.invariant for v in red.violations}
+
+
+def test_reduced_mode_agrees_on_clean():
+    for name, build in CLEAN[:4]:
+        m = build()
+        assert M.explore(m).ok == M.explore(m, reduce=True).ok
+
+
+# -- violation traces replay ---------------------------------------------
+
+def test_violation_trace_replays():
+    m = seqlock.build_allreduce(2, 1, mutation="stamp_before_copy")
+    r = M.explore(m)
+    v = next(v for v in r.violations
+             if v.invariant == "no-torn-read-delivered")
+    state = dict(m.init)
+    by_name = {t.name: t for t in m.transitions}
+    for step in v.trace:
+        t = by_name[step]
+        assert t.guard(state), f"trace step {step} not enabled on replay"
+        state = t.apply(state)
+    name, pred = next(i for i in m.invariants
+                      if i[0] == "no-torn-read-delivered")
+    assert pred(state) is not None, "replayed state does not violate"
+
+
+def test_deadlock_reported_with_trace():
+    r = M.explore(seqlock.build_bcast(3, mutation="no_arrival_wave"))
+    v = next(v for v in r.violations if v.invariant == "deadlock")
+    assert v.trace, "deadlock must carry its interleaving"
+
+
+# -- full-depth lane (modelcheck marker) ---------------------------------
+
+@pytest.mark.modelcheck
+@pytest.mark.parametrize("n,waves,crash", [(4, 1, False), (4, 2, False),
+                                           (4, 2, True), (3, 3, False)])
+def test_full_depth_allreduce(n, waves, crash):
+    r = M.explore(seqlock.build_allreduce(n, waves, crash=crash))
+    assert r.complete and r.ok, \
+        [f"{v.invariant}: {v.message}" for v in r.violations]
+
+
+@pytest.mark.modelcheck
+def test_full_depth_bcast_np4():
+    r = M.explore(seqlock.build_bcast(4))
+    assert r.complete and r.ok
+
+
+@pytest.mark.modelcheck
+def test_full_depth_lease_long_horizon():
+    r = M.explore(lease.build(timeout=4, horizon=16, crash=True))
+    assert r.complete and r.ok
+    r = M.explore(lease.build(timeout=4, horizon=16, depart=True))
+    assert r.complete and r.ok
+
+
+@pytest.mark.modelcheck
+def test_full_depth_mutations_np3():
+    """The matrix's seqlock mutations still caught at np=3."""
+    for mut in ("stamp_before_copy", "no_reader_guard"):
+        r = M.explore(seqlock.build_allreduce(3, 1, mutation=mut))
+        assert not r.ok, mut
