@@ -151,13 +151,19 @@ def main() -> None:
             return fn_k
 
         # candidates: XLA's fused psum lowering vs the explicit
-        # ppermute ring (MPIR_Allreduce_pt2pt_ring_MV2 form) — the
+        # ppermute ring (MPIR_Allreduce_pt2pt_ring_MV2 form) vs the
+        # HBM-streaming chunked remote-DMA ring (ops/pallas_ici — the
+        # engine behind the large-message device tier) — the
         # measured-crossover discipline of the tuning layer
+        from mvapich2_tpu.ops import pallas_ici
         cands = [
             ("xla_psum",
              mk_fn(lambda a: lax.psum(a, "x") * (1.0 / p))),
             ("ring_manual",
              mk_fn(lambda a: mops.ring_allreduce_manual(a, "x")
+                   * (1.0 / p))),
+            ("ici_ring_hbm",
+             mk_fn(lambda a: pallas_ici.hbm_ring_all_reduce(a, "x", p)
                    * (1.0 / p))),
         ]
         best_t, chosen = None, None

@@ -233,6 +233,147 @@ def measure_kernel_params(msg_bytes: int = 64 * 1024 * 1024,
     return out
 
 
+def _mesh_timer(p, axis, fn, reps: int):
+    """Median wall time of ``jax.block_until_ready(fn(x))`` after one
+    warm-up (compile) call — the device-tier sweep's primitive. On a
+    CPU mesh this times the interpreted kernels: the absolute numbers
+    are emulation cost, but the machinery (sweep -> boundaries ->
+    profile) is identical to the TPU run."""
+    import jax
+    ts = []
+    jax.block_until_ready(fn())
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+DEVICE_TIER_SIZES_TPU = [256 * 1024, 1 << 20, 4 << 20, 16 << 20, 64 << 20]
+DEVICE_TIER_SIZES_CPU = [4096, 16384, 65536, 262144]
+
+
+def measure_device_tiers(sizes: Optional[List[int]] = None, reps: int = 3,
+                         chunk_candidates: Optional[List[int]] = None,
+                         interpret: Optional[bool] = None) -> Dict:
+    """Sweep the three device-collective tiers (VMEM flat ring /
+    HBM-streaming chunked ring / XLA lowering) over per-shard message
+    sizes and derive the tier boundaries from measurement — the
+    producer of the profile's ``device_crossovers.dev_tier_vmem_max`` /
+    ``dev_tier_xla_min`` entries and ``kernel_params.ici_chunk_bytes``
+    (consumed by coll/tuning.device_tier and ops/pallas_ici). Driven by
+    ``bin/measure_crossover --device``. Needs >= 2 devices (a CPU host
+    wants XLA_FLAGS=--xla_force_host_platform_device_count=N set
+    before jax initializes); returns {} otherwise."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .ops import pallas_ici, pallas_ring
+    from .parallel.mesh import make_mesh, shard_map
+
+    devs = jax.devices()
+    p = len(devs)
+    if p < 2:
+        log.warn("device-tier sweep needs >= 2 devices, have %d", p)
+        return {}
+    on_tpu = devs[0].platform == "tpu"
+    if interpret is None:
+        interpret = not on_tpu
+    sizes = sizes or (DEVICE_TIER_SIZES_TPU if on_tpu
+                      else DEVICE_TIER_SIZES_CPU)
+    chunk_candidates = chunk_candidates or (
+        [128 * 1024, 256 * 1024, 1 << 20] if on_tpu else [512, 2048])
+    mesh = make_mesh((p,), ("x",), devs)
+    sharding = NamedSharding(mesh, P("x"))
+
+    def timed(body, nbytes):
+        n = max(4, nbytes // 4) // p * p   # f32 elems, p-divisible
+        x = jax.device_put(jnp.ones((n,), jnp.float32), sharding)
+        f = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("x"),),
+                              out_specs=P("x"), check_vma=False))
+        return _mesh_timer(p, "x", lambda: f(x), reps)
+
+    raw: Dict = {"vmem": {}, "hbm": {}, "xla": {}}
+    for nbytes in sizes:
+        shard = nbytes  # the sweep is keyed by per-shard bytes
+        raw["xla"][str(shard)] = timed(
+            lambda s: jax.numpy.multiply(
+                jax.lax.psum(s, "x"), 1.0), shard * p)
+        try:
+            raw["vmem"][str(shard)] = timed(
+                lambda s: pallas_ring.ring_all_reduce(
+                    s, "x", p, interpret=interpret), shard * p)
+        except Exception as e:
+            log.warn("vmem tier failed at %d bytes: %s", shard, e)
+        try:
+            raw["hbm"][str(shard)] = timed(
+                lambda s: pallas_ici.hbm_ring_all_reduce(
+                    s, "x", p, interpret=interpret), shard * p)
+        except Exception as e:
+            log.warn("hbm tier failed at %d bytes: %s", shard, e)
+
+    # boundaries: vmem keeps the band where it wins (bounded by its hard
+    # VMEM cap); xla re-enters at the first size it beats both kernels
+    vmem_max = 0
+    xla_min = NEVER_CROSS
+    for nbytes in sizes:
+        k = str(nbytes)
+        tv = raw["vmem"].get(k, float("inf"))
+        th = raw["hbm"].get(k, float("inf"))
+        tx = raw["xla"][k]
+        if nbytes <= pallas_ring.VMEM_LIMIT_BYTES and tv <= min(th, tx):
+            vmem_max = max(vmem_max, nbytes)
+        if tx < min(tv, th) and xla_min == NEVER_CROSS:
+            xla_min = nbytes
+        elif tx >= min(tv, th):
+            xla_min = NEVER_CROSS   # a kernel wins again past this size
+
+    # chunk size: measured at the largest swept size on the hbm tier
+    best_chunk, best_t = None, float("inf")
+    big = sizes[-1]
+    for cb in chunk_candidates:
+        try:
+            t = timed(lambda s: pallas_ici.hbm_ring_all_reduce(
+                s, "x", p, chunk_bytes=cb, interpret=interpret), big * p)
+        except Exception as e:
+            log.warn("chunk candidate %d failed: %s", cb, e)
+            continue
+        raw.setdefault("chunk", {})[str(cb)] = t
+        if t < best_t:
+            best_chunk, best_t = cb, t
+
+    out: Dict = {
+        "device_crossovers": {"dev_tier_vmem_max": vmem_max,
+                              "dev_tier_xla_min": xla_min},
+        "raw_device_tiers": raw,
+    }
+    if best_chunk is not None:
+        out["kernel_params"] = {"ici_chunk_bytes": best_chunk}
+    return out
+
+
+def merge_device_profile(fragment: Dict, path: Optional[str] = None) -> str:
+    """Fold a measure_device_tiers fragment into the arch-keyed profile
+    file (creating it when absent) — the --device mode's artifact step.
+    Returns the path written."""
+    path = path or _arch_file()
+    doc_profile: Dict = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            doc_profile = json.load(f).get("profile", {})
+    for key in ("device_crossovers", "kernel_params"):
+        if fragment.get(key):
+            doc_profile.setdefault(key, {}).update(fragment[key])
+    if "raw_device_tiers" in fragment:
+        doc_profile["raw_device_tiers"] = fragment["raw_device_tiers"]
+    save_profile(doc_profile, path)
+    return path
+
+
 # ---------------------------------------------------------------------------
 # artifacts
 # ---------------------------------------------------------------------------

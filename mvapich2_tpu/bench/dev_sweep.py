@@ -1,0 +1,124 @@
+"""Device-collective band sweep — the perf gate's data producer.
+
+Sweeps the tier-dispatched device allreduce (ops/pallas_ici.ici_all_reduce:
+VMEM flat ring / HBM-streaming chunked ring / XLA by measured boundaries)
+across per-shard message sizes and emits an osu_compare-compatible
+artifact::
+
+    {"results": {"dev_allreduce_effbw": {"<bytes>": GB/s, ...}},
+     "tiers":   {"<bytes>": "vmem|hbm|xla", ...}}
+
+``effbw`` is the OSU ring busbw model 2*(p-1)/p * m / t. Two artifacts
+diff through ``bin/osu_compare`` exactly like the host OSU ones — a >10%
+effbw regression or a >3x adjacent-size drop (a new tier cliff) in the
+device band fails the gate. On a CPU host the kernels run under the
+Mosaic interpreter over a forced virtual mesh (tiny sizes, structural
+check — tier-1 uses this); on TPU the numbers are the real device band.
+
+    python -m mvapich2_tpu.bench.dev_sweep --sizes 4096,65536 --out X.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+def _ensure_mesh(np_: int) -> None:
+    """A CPU host needs the virtual mesh flag before jax initializes."""
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={np_}").strip()
+
+
+def sweep(sizes: List[int], iters: int = 5,
+          interpret: Optional[bool] = None) -> Dict:
+    """Measure the tier-dispatched device allreduce at each per-shard
+    size. Returns the artifact dict (see module docstring)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..autotune import load_default_profile
+    from ..ops import pallas_ici
+    from ..parallel.mesh import make_mesh, shard_map
+
+    load_default_profile()   # the measured tier boundaries, when committed
+    devs = jax.devices()
+    p = len(devs)
+    if p < 2:
+        raise RuntimeError("device band sweep needs >= 2 devices "
+                           "(set XLA_FLAGS=--xla_force_host_platform_"
+                           "device_count=N on a CPU host)")
+    if interpret is None:
+        interpret = devs[0].platform != "tpu"
+    mesh = make_mesh((p,), ("x",), devs)
+    sharding = NamedSharding(mesh, P("x"))
+    results: Dict[str, float] = {}
+    tiers: Dict[str, str] = {}
+    for nbytes in sizes:
+        n = max(4, nbytes // 4)           # f32 elems per shard
+        tier, reason = pallas_ici.planned_tier(
+            "allreduce", n * 4, jnp.float32, "sum", interpret)
+        tiers[str(nbytes)] = tier
+        x = jax.device_put(jnp.ones((n * p,), jnp.float32), sharding)
+        f = jax.jit(shard_map(
+            lambda s: pallas_ici.ici_all_reduce(s, "x", p,
+                                                interpret=interpret),
+            mesh=mesh, in_specs=(P("x"),), out_specs=P("x"),
+            check_vma=False))
+        jax.block_until_ready(f(x))       # compile outside the window
+        ts = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(x))
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        t = ts[len(ts) // 2]
+        m = n * 4
+        results[str(nbytes)] = round(2.0 * (p - 1) / p * m / t / 1e9, 6)
+    return {"results": {"dev_allreduce_effbw": results},
+            "tiers": tiers,
+            "detail": {"devices": p,
+                       "platform": devs[0].platform,
+                       "interpret": bool(interpret),
+                       "iters": iters}}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dev_sweep", description=__doc__.splitlines()[0])
+    ap.add_argument("--sizes", default="",
+                    help="comma-separated per-shard bytes (default: a "
+                         "platform-appropriate band)")
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--np", type=int, default=8,
+                    help="virtual mesh width on a CPU host")
+    ap.add_argument("--out", default="",
+                    help="artifact path (default: stdout)")
+    args = ap.parse_args(argv)
+    _ensure_mesh(args.np)
+    import jax
+    on_tpu = jax.devices()[0].platform == "tpu"
+    sizes = ([int(s) for s in args.sizes.split(",")] if args.sizes else
+             ([1 << 20, 4 << 20, 16 << 20, 64 << 20] if on_tpu
+              else [4096, 16384, 65536]))
+    art = sweep(sizes, iters=args.iters)
+    text = json.dumps(art, indent=1, sort_keys=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,7 +122,11 @@ class DeviceCollChannel:
 
         if name in ("allreduce", "reduce"):
             def f(x):                       # block [1, n]
-                return ops.allreduce(x, axis, op)
+                # tier dispatch: VMEM flat ring / HBM-streaming chunked
+                # ring / XLA, by shard bytes (coll/tuning.device_tier)
+                from ..ops import pallas_ici
+                return pallas_ici.ici_all_reduce(
+                    x.reshape(-1), axis, p, op=op).reshape(1, -1)
             out_specs = P(None, None)       # replicated [1, n]
         elif name == "bcast":
             def f(x):
@@ -130,7 +134,9 @@ class DeviceCollChannel:
             out_specs = P(None, None)
         elif name == "allgather":
             def f(x):
-                return ops.all_gather(x, axis, tiled=True, gather_axis=0)
+                from ..ops import pallas_ici
+                return pallas_ici.ici_all_gather(
+                    x.reshape(-1), axis, p).reshape(p, -1)
             out_specs = P(None, None)       # replicated [p, n]
         elif name == "alltoall":
             c = n // p
@@ -236,14 +242,40 @@ class DeviceCollChannel:
             per_dev[s.device] = s.data
         return [per_dev[self.devices[r]] for r in range(self.size)]
 
+    # -- per-call tier accounting (the observable-fallback contract) -----
+    def _note_tier(self, comm, name: str, local, op: Optional[str]) -> None:
+        """Count which device tier THIS call runs (pvars
+        dev_coll_tier_{vmem,hbm} / dev_coll_fallback_*) and drop a trace
+        instant when the XLA lowering is taken — the once-invisible
+        VMEM-cap cliff. Per call, unlike the per-traced-shape counting
+        at the kernel wrappers (programs are cached per signature)."""
+        if self.mesh is None:
+            return          # single-device slot channel: no ICI tiers
+        from .. import mpit
+        from ..ops import pallas_ici
+        n, dtype = self._slot_extent(local)
+        nbytes = n * dtype.itemsize * (self.size if name == "allgather"
+                                       else 1)
+        tier, reason = pallas_ici.planned_tier(name, nbytes, dtype, op)
+        if reason is None:
+            mpit.pvar(f"dev_coll_tier_{tier}").inc()
+            return
+        mpit.pvar(f"dev_coll_fallback_{reason}").inc()
+        tr = getattr(comm.u.engine, "tracer", None)
+        if tr is not None:
+            tr.record("channel", "dev_coll_fallback", "i", coll=name,
+                      nbytes=int(nbytes), reason=reason)
+
     # -- MPI-shaped entry points (match coll_fns signatures) -------------
     def allreduce(self, comm, sendbuf, recvbuf, count, datatype, op):
         local = _as_local(sendbuf, recvbuf, count)
+        self._note_tier(comm, "allreduce", local, _op_name(op))
         out = self._execute("allreduce", local, op=_op_name(op))
         return _deliver(out, recvbuf)
 
     def reduce(self, comm, sendbuf, recvbuf, count, datatype, op, root):
         local = _as_local(sendbuf, recvbuf, count)
+        self._note_tier(comm, "reduce", local, _op_name(op))
         out = self._execute("reduce", local, op=_op_name(op))
         if comm.rank != root:
             return None
@@ -256,6 +288,7 @@ class DeviceCollChannel:
     def allgather(self, comm, sendbuf, recvbuf, count, datatype):
         local = _as_local(sendbuf, recvbuf, count,
                           in_place_start=comm.rank * count)
+        self._note_tier(comm, "allgather", local, None)
         out = self._execute("allgather", local)
         return _deliver(out, recvbuf)
 

@@ -35,6 +35,18 @@ for _c in ("ALLREDUCE", "BCAST", "ALLGATHER", "ALLTOALL", "REDUCE",
 cvar("USE_TWO_LEVEL", True, bool, "coll",
      "Enable hierarchical (node-aware) collectives "
      "(analog of MV2_USE_SHMEM_COLL / two-level paths).")
+cvar("DEV_TIER_VMEM_MAX", 4 * 1024 * 1024, int, "device",
+     "Device-collective tier edge: shards at or below this many bytes "
+     "run the VMEM-resident flat ring kernels (ops/pallas_ring); above "
+     "it the HBM-streaming chunked ring (ops/pallas_ici). Measured "
+     "profiles (device_crossovers.dev_tier_vmem_max) override; "
+     "bin/measure_crossover --device re-derives it.")
+cvar("DEV_TIER_XLA_MIN", -1, int, "device",
+     "Device-collective tier edge: shards at or above this many bytes "
+     "leave the hand-written kernels for the stock XLA lowering "
+     "(-1 = never — the HBM-streaming tier has no size ceiling). "
+     "Measured profiles (device_crossovers.dev_tier_xla_min) override. "
+     "Every XLA take is counted by the dev_coll_fallback_* pvars.")
 
 # ---------------------------------------------------------------------------
 # algorithm registries (name -> fn), per collective
@@ -176,6 +188,28 @@ def device_crossover(name: str, comm) -> int:
     if got is not None:
         return got
     return val
+
+
+def device_tier(name: str, shard_nbytes: int) -> str:
+    """'vmem' | 'hbm' | 'xla' for a device-resident collective shard of
+    ``shard_nbytes`` — the device-side msg-size bin. Edge precedence
+    mirrors device_crossover(): explicitly-set cvar (the user said so)
+    > measured profile entry > cvar default. ``name`` is accepted for
+    future per-collective edges; today the edges are shared."""
+    cfg = get_config()
+    cv = cfg._vars["DEV_TIER_VMEM_MAX"]
+    vmax = cv.value
+    if not cv._explicit:
+        vmax = _DEVICE_CROSSOVERS.get("dev_tier_vmem_max", vmax)
+    cvx = cfg._vars["DEV_TIER_XLA_MIN"]
+    xmin = cvx.value
+    if not cvx._explicit:
+        xmin = _DEVICE_CROSSOVERS.get("dev_tier_xla_min", xmin)
+    if shard_nbytes <= vmax:
+        return "vmem"
+    if xmin is not None and xmin >= 0 and shard_nbytes >= xmin:
+        return "xla"
+    return "hbm"
 
 
 def _size_class(comm) -> str:

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.collectives import axis_size as _ops_axis_size
 from ..ops import ring_shift
 
 NEG_INF = -1e30
@@ -53,7 +54,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
 
     q/k/v: [T_local, H, Dh] for this sequence shard. Returns [T_local, H,
     Dh]. Accumulators are f32 regardless of input dtype."""
-    p = lax.axis_size(axis_name)
+    p = _ops_axis_size(axis_name)
     my = lax.axis_index(axis_name)
     T, H, Dh = q.shape
     scale = scale if scale is not None else Dh ** -0.5
@@ -125,7 +126,7 @@ def ring_attention_flash(q, k, v, axis_name: str, causal: bool = True,
     """
     from .flash import flash_attention_parts
 
-    p = lax.axis_size(axis_name)
+    p = _ops_axis_size(axis_name)
     my = lax.axis_index(axis_name)
     T, H, Dh = q.shape
 

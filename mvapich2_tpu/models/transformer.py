@@ -27,6 +27,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.collectives import axis_size as _ops_axis_size
 from ..ops import all_to_all, allreduce
 from ..parallel.mesh import make_mesh, mesh_shape_for, shard_map
 from .ring_attention import ring_attention
@@ -145,7 +146,7 @@ def _moe_ffn(p, L, x, cfg: Config):
     dispatched to their expert's shard via all_to_all (BASELINE config 3's
     MoE-style shuffle) and return the same way."""
     B, T, D = x.shape
-    ep = lax.axis_size("dp")
+    ep = _ops_axis_size("dp")
     E_local = p[f"{L}/w1"].shape[0]          # experts on this shard
     E = E_local * ep
     h = _layernorm(x, p[f"{L}/ln2"])

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 from jax import lax
 
+from ..ops.collectives import axis_size as _ops_axis_size
 from ..ops import all_to_all
 from .flash import flash_attention
 from .ring_attention import local_attention_reference
@@ -56,7 +57,7 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
     dtype (like ring_attention's accumulators).
     """
     H = q.shape[1]
-    p = lax.axis_size(axis_name)
+    p = _ops_axis_size(axis_name)
     if H % p != 0:
         raise ValueError(f"heads {H} not divisible by axis size {p}")
     qh = _seq_to_heads(q, axis_name)     # [T, H/p, Dh]

@@ -303,6 +303,55 @@ pvar("arena_reclaimed_dead", PVAR_CLASS_COUNTER, "shm",
      "arena blocks/segments reclaimed from dead ranks (failure sweep, "
      "Finalize leak-check tolerance, stale-segment sweep)")
 
+# ---------------------------------------------------------------------------
+# device-collective engine knobs + fallback observability (ops/pallas_ici,
+# ops/pallas_ring, coll/device). Declared HERE so the MPI_T surface
+# enumerates the device lane before any jax/ops import happens — the same
+# early-declaration contract as the analysis knobs above; the kernel
+# modules fetch the already-declared entries by name.
+# ---------------------------------------------------------------------------
+
+cvar("ICI_CHUNK_BYTES", 256 * 1024, int, "device",
+     "VMEM chunk size (bytes) of the HBM-streaming ICI ring kernels: "
+     "each chunk is double-buffered through VMEM scratch while the "
+     "remote DMA of the next chunk is in flight. A measured tuning "
+     "profile (kernel_params.ici_chunk_bytes) overrides this default; "
+     "bin/measure_crossover --device re-derives it.")
+cvar("ICI_PIPELINE_DEPTH", 2, int, "device",
+     "VMEM slots per ring direction in the HBM-streaming kernels "
+     "(2 = classic double buffering). Each slot is one in-flight chunk; "
+     "the credit handshake bounds a sender to this many chunks ahead.")
+cvar("ICI_BIDIR", True, bool, "device",
+     "Drive both ring directions of the mesh axis at once (half of "
+     "every block clockwise, half counter-clockwise) when the axis has "
+     "more than 2 shards — full bisection bandwidth on a physical ring.")
+cvar("ICI_INTERPRET", False, bool, "device",
+     "Force the pallas ICI kernels through the Mosaic interpreter so "
+     "the device tiers run on a CPU mesh (correctness sweeps, CI). "
+     "Off-TPU with this unset, device collectives take the XLA "
+     "lowering and count dev_coll_fallback_platform.")
+
+pvar("dev_coll_fallback_size", PVAR_CLASS_COUNTER, "device",
+     "device collectives routed to the XLA lowering because the shard "
+     "was past the measured XLA crossover (DEV_TIER_XLA_MIN) — the "
+     "once-silent VMEM-cap cliff, now counted")
+pvar("dev_coll_fallback_dtype", PVAR_CLASS_COUNTER, "device",
+     "device collectives routed to the XLA lowering because the "
+     "op/dtype does not lower to the ring kernels")
+pvar("dev_coll_fallback_shape", PVAR_CLASS_COUNTER, "device",
+     "device collectives routed to the XLA lowering because of a "
+     "degenerate buffer extent")
+pvar("dev_coll_fallback_platform", PVAR_CLASS_COUNTER, "device",
+     "device collectives routed to the XLA lowering because the pallas "
+     "kernels cannot run here (no pallas, or off-TPU without "
+     "MV2T_ICI_INTERPRET)")
+pvar("dev_coll_tier_vmem", PVAR_CLASS_COUNTER, "device",
+     "device collective calls served by the VMEM-resident flat ring "
+     "tier (ops/pallas_ring)")
+pvar("dev_coll_tier_hbm", PVAR_CLASS_COUNTER, "device",
+     "device collective calls served by the HBM-streaming chunked ring "
+     "tier (ops/pallas_ici)")
+
 
 # ---------------------------------------------------------------------------
 # the autotuner lives beside MPI_T (tools space): mpit.autotune

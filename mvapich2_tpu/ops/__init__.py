@@ -4,3 +4,6 @@ from .collectives import (all_gather, all_to_all, allreduce, axis_rank,
                           moe_shuffle, ppermute, reduce_scatter,
                           ring_allreduce_manual, ring_shift, scan_axis,
                           sendrecv_shift)
+from . import pallas_ici
+from .pallas_ici import (hbm_ring_all_gather, hbm_ring_all_reduce,
+                         ici_all_gather, ici_all_reduce, remote_sendrecv)

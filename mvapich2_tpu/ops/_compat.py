@@ -1,0 +1,79 @@
+"""Pallas API compatibility + shared fallback accounting for ops/.
+
+The pallas TPU surface moved between jax releases (``pltpu.CompilerParams``
+was ``TPUCompilerParams``; ``InterpretParams`` — the race-detecting
+interpreter config — does not exist before jax 0.5): the kernels in this
+package run against whichever spelling the installed jax provides, so the
+device path cannot be broken by a version skew the way the r6 seed was
+(every pallas test failed with AttributeError on 0.4.x).
+
+Also home of ``note_fallback`` — the observability hook for the
+VMEM-cap / shape / dtype rejections that used to be silent (the invisible
+4 MiB cliff of ops/pallas_ring.py): every rejection bumps one of the
+``dev_coll_fallback_{size,dtype,shape,platform}`` pvars declared in
+mpit.py. Kernel wrappers call it at trace time (once per compiled shape);
+the per-call accounting for the MPI path lives in coll/device.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..utils.mlog import get_logger
+
+log = get_logger("pallas")
+
+try:
+    from jax.experimental import pallas as pl          # noqa: F401
+    from jax.experimental.pallas import tpu as pltpu
+    HAVE_PALLAS = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    HAVE_PALLAS = False
+
+
+def compiler_params(**kw):
+    """A pltpu compiler-params object for this jax version; keyword
+    arguments the local dataclass does not know are dropped (they are
+    scheduling hints, never correctness)."""
+    cp = getattr(pltpu, "CompilerParams", None)
+    if cp is None:
+        cp = pltpu.TPUCompilerParams
+    allowed = {f.name for f in dataclasses.fields(cp)}
+    return cp(**{k: v for k, v in kw.items() if k in allowed})
+
+
+def interpret_params(**kw):
+    """The richest interpreter config this jax supports: the
+    race-detecting ``InterpretParams`` when present, else plain
+    ``interpret=True`` (the 0.4.x emulator is deterministic dataflow —
+    DMA discharge in program order — so the sweep still validates the
+    schedule, just not slot races)."""
+    ip = getattr(pltpu, "InterpretParams", None)
+    if ip is None:
+        return True
+    try:
+        return ip(**kw)
+    except TypeError:   # a field moved; the bare config still interprets
+        return ip()
+
+
+def have_remote_signal() -> bool:
+    """True when remote ``semaphore_signal`` works under the active
+    execution mode — the credit handshake needs it. The 0.4.x
+    interpreter raises NotImplementedError for remote signals, so
+    interpret-mode callers must run creditless (safe there: the
+    emulator is synchronous dataflow, flow control is moot)."""
+    return getattr(pltpu, "InterpretParams", None) is not None
+
+
+def note_fallback(coll: str, reason: str, nbytes: int,
+                  dtype: Optional[object] = None) -> None:
+    """Count one device-collective fallback to the XLA lowering.
+    ``reason`` is one of size/dtype/shape/platform — the pvar family
+    predeclared in mpit.py (fetch-side idiom)."""
+    from .. import mpit
+    mpit.pvar(f"dev_coll_fallback_{reason}").inc()
+    log.dbg(1, "device collective %s fell back to XLA (%s, %d bytes, %s)",
+            coll, reason, nbytes, dtype)

@@ -32,7 +32,12 @@ AxisName = Union[str, Tuple[str, ...]]
 
 
 def axis_size(axis: AxisName) -> int:
-    return lax.axis_size(axis)
+    """Static size of the bound axis (MPI_Comm_size analog). jax < 0.5
+    has no lax.axis_size; psum of a literal 1 constant-folds to the
+    same concrete value there."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return lax.psum(1, axis)
 
 
 def axis_rank(axis: AxisName):
@@ -79,7 +84,7 @@ def scan_axis(x, axis: AxisName):
 
     Lowered as a masked matmul against the gathered axis — O(p) compute on
     the MXU but a single all_gather of comm (fine for p <= 256 shards)."""
-    p = lax.axis_size(axis)
+    p = axis_size(axis)
     idx = lax.axis_index(axis)
     gathered = lax.all_gather(x, axis)            # [p, ...]
     mask = (jnp.arange(p) <= idx).astype(x.dtype)
@@ -123,7 +128,7 @@ def ppermute(x, axis: AxisName, perm: Sequence[Tuple[int, int]]):
 def ring_shift(x, axis: AxisName, shift: int = 1):
     """Rotate shards around the axis ring by ``shift`` (+ = to higher
     ranks). The building block of ring collectives and ring attention."""
-    p = lax.axis_size(axis)
+    p = axis_size(axis)
     perm = [(i, (i + shift) % p) for i in range(p)]
     return lax.ppermute(x, axis, perm)
 
@@ -148,7 +153,7 @@ def halo_exchange(x, axis: AxisName, halo: int, dim: int = 0,
     from_left = ring_shift(hi, axis, 1)    # left neighbor's high slab
     from_right = ring_shift(lo, axis, -1)  # right neighbor's low slab
     if not periodic:
-        p = lax.axis_size(axis)
+        p = axis_size(axis)
         idx = lax.axis_index(axis)
         from_left = jnp.where(idx == 0, jnp.zeros_like(from_left), from_left)
         from_right = jnp.where(idx == p - 1, jnp.zeros_like(from_right),
@@ -177,7 +182,7 @@ def ring_allreduce_manual(x, axis: AxisName):
     the explicit form of MPIR_Allreduce_pt2pt_ring_MV2 (allreduce_osu.c:
     3824). Exists for the tuning layer to benchmark against the fused
     lax.psum lowering (and as the skeleton pallas kernels follow)."""
-    p = lax.axis_size(axis)
+    p = axis_size(axis)
     if p == 1:
         return x
     idx = lax.axis_index(axis)

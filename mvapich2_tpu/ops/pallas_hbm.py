@@ -46,12 +46,11 @@ from typing import Callable, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-try:
+from ._compat import HAVE_PALLAS, compiler_params
+
+if HAVE_PALLAS:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
-    HAVE_PALLAS = True
-except ImportError:  # pragma: no cover
-    HAVE_PALLAS = False
 
 # Measured-best defaults on TPU v5e (64 MiB/rank, 8 ranks); a committed
 # tuning profile overrides them via the kernel-param keys below.
@@ -115,7 +114,7 @@ def fused_reduce_to_slot(x: jax.Array, *, layout: str = "planar",
         in_specs=[in_spec(bm)],
         out_specs=pl.BlockSpec((bm, L), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((M, L), x.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compiler_params(
             dimension_semantics=("arbitrary",),
             has_side_effects=side_effects),
         interpret=_interpret(),
@@ -150,7 +149,7 @@ def fused_allreduce(x: jax.Array, *, block_m: Optional[int] = None,
         in_specs=[pl.BlockSpec((bm, R, L), lambda i: (i, 0, 0))],
         out_specs=pl.BlockSpec((bm, R, L), lambda i: (i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compiler_params(
             dimension_semantics=("parallel" if parallel else "arbitrary",)),
         interpret=_interpret(),
         **kw,

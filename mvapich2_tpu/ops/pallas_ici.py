@@ -1,0 +1,620 @@
+"""HBM-streaming Pallas ICI collective engine — chunked remote-DMA rings.
+
+The large-message tier of the device path. The hand-scheduled kernels in
+ops/pallas_ring.py are VMEM-resident (shard + 2 comm slots must fit in
+~16 MiB; the wrapper refuses past ``VMEM_LIMIT_BYTES``), which capped
+every device perf round since r3 at the XLA lowering's plateau. These
+kernels lift the cap the way the reference lifts the eager->rendezvous
+crossover: inputs and outputs stay in HBM (``TPUMemorySpace.ANY``) and
+the kernel streams fixed-size chunks through double-buffered VMEM
+scratch slots —
+
+    HBM acc ──local DMA──> send slot ──remote DMA (ICI)──> peer recv slot
+    peer recv slot + HBM acc chunk ──VPU reduce──> acc slot ──DMA──> HBM
+
+with the remote DMA of chunk *k+1* overlapping the VPU reduce of chunk
+*k* (the ibv_send.c vbuf pipeline, one level up). The allreduce is the
+pipelined reduce-scatter + all-gather decomposition (the "Multiple
+Processes per GPU" schedule blueprint; EQuARX demonstrates the custom
+chunked form beating stock XLA on TPU); where the mesh axis is a
+physical ring both directions are driven at once (half of every block
+travels clockwise, half counter-clockwise) for full bisection bandwidth.
+
+Flow control on hardware is the per-direction credit handshake of
+pallas_ring.py generalized to chunk granularity: each direction starts
+with ``depth`` credits (one per VMEM slot) and the receiver re-grants a
+credit as it consumes a slot, so a sender can run at most ``depth``
+chunks ahead — slot reuse is race-free because the slot sequence is a
+single global chunk counter per direction (write *k+D* lands in the slot
+freed by consume *k*). Under the 0.4.x interpreter remote semaphore
+signals are unavailable and unnecessary (the emulator is synchronous
+dataflow), so interpret-mode runs are creditless.
+
+Tier selection (``planned_tier``) is data driven: coll/tuning.py's
+``device_tier`` maps shard bytes to vmem (pallas_ring) / hbm (here) /
+xla, with the boundaries re-measurable by ``bin/measure_crossover
+--device``. Every fallback to the XLA lowering is counted by the
+``dev_coll_fallback_*`` pvar family — the 4 MiB cliff is no longer
+silent.
+
+Usage: inside ``shard_map`` over a 1-D mesh axis, or through the
+mesh-bound MPI channel (coll/device.py), which routes per-call.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..utils.config import get_config
+from ..utils.mlog import get_logger
+from ._compat import (HAVE_PALLAS, compiler_params, have_remote_signal,
+                      note_fallback)
+
+log = get_logger("pallas_ici")
+
+if HAVE_PALLAS:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+# cvars ICI_CHUNK_BYTES / ICI_PIPELINE_DEPTH / ICI_BIDIR / ICI_INTERPRET
+# are predeclared in mpit.py (the MPI_T surface enumerates them before
+# this module is imported); importing mpit here guarantees they exist
+# for direct ops users too.
+from .. import mpit  # noqa: F401,E402  — cvar/pvar declarations
+
+_SUPPORTED_OPS = ("sum", "max", "min", "prod")
+
+# distinct Mosaic collective ids (pallas_ring owns 7/8)
+_CID_ALLREDUCE = 9
+_CID_ALLGATHER = 10
+_CID_SENDRECV = 11
+
+
+def _cfg_chunk_elems(dtype, chunk_bytes: Optional[int]) -> int:
+    if chunk_bytes is None:
+        from ..coll.tuning import kernel_param
+        chunk_bytes = kernel_param("ici_chunk_bytes",
+                                   int(get_config()["ICI_CHUNK_BYTES"]))
+    return max(1, int(chunk_bytes) // np.dtype(dtype).itemsize)
+
+
+def _cfg_depth(depth: Optional[int]) -> int:
+    if depth is None:
+        depth = int(get_config()["ICI_PIPELINE_DEPTH"])
+    return max(2, int(depth))
+
+
+def _pad_identity(dtype, op: str):
+    """The reduction identity — pad values that cannot perturb the
+    result of the padded-tail elements."""
+    dt = np.dtype(dtype)
+    if op == "sum":
+        return 0
+    if op == "prod":
+        return 1
+    if dt.kind == "f":
+        lo = -np.inf
+        hi = np.inf
+    else:
+        info = np.iinfo(dt)
+        lo, hi = info.min, info.max
+    return lo if op == "max" else hi
+
+
+def _reducer(op: str):
+    return {"sum": lambda a, b: a + b,
+            "max": jnp.maximum,
+            "min": jnp.minimum,
+            "prod": lambda a, b: a * b}[op]
+
+
+def _chunks(lo: int, hi: int, chunk: int) -> List[Tuple[int, int]]:
+    """Static (offset, size) chunk list covering [lo, hi) — the last
+    chunk carries the remainder."""
+    out = []
+    off = lo
+    while off < hi:
+        out.append((off, min(chunk, hi - off)))
+        off += chunk
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the streaming engine (shared by allreduce / all-gather kernels)
+# ---------------------------------------------------------------------------
+
+class _RingStreamer:
+    """Per-kernel-instance streaming state: scratch refs, DMA handles,
+    and the per-direction global chunk counters whose mod-depth sequence
+    makes slot reuse collision-free (see module docstring)."""
+
+    def __init__(self, p, ndir, depth, credits, left, right,
+                 o_hbm, send_buf, recv_buf, acc_buf,
+                 in_sem, acc_sem, st_sem, send_sem, recv_sem, cap_sem):
+        self.p, self.ndir, self.depth, self.credits = p, ndir, depth, credits
+        self.left, self.right = left, right
+        self.o_hbm = o_hbm
+        self.send_buf, self.recv_buf, self.acc_buf = \
+            send_buf, recv_buf, acc_buf
+        self.in_sem, self.acc_sem, self.st_sem = in_sem, acc_sem, st_sem
+        self.send_sem, self.recv_sem, self.cap_sem = \
+            send_sem, recv_sem, cap_sem
+        self.gc = [0] * ndir                   # global chunk counter / dir
+        self.pending_send: Dict = {}           # (d, slot) -> remote handle
+        self.pending_in: Dict = {}
+        self.pending_acc: Dict = {}
+        self.pending_store: Dict = {}
+
+    def _dev(self, idx):
+        return idx  # logical device id along the 1-D mesh axis
+
+    def grant_initial_credits(self):
+        """Each direction starts with ``depth`` slot credits granted to
+        the upstream neighbor (the rank that remote-writes into us)."""
+        if not self.credits:
+            return
+        for d in range(self.ndir):
+            upstream = self.left if d == 0 else self.right
+            pltpu.semaphore_signal(
+                self.cap_sem.at[d], inc=self.depth,
+                device_id=self._dev(upstream),
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    def drain_stores(self):
+        """Step/phase barrier: every outstanding VMEM->HBM store has
+        landed (the next step's loads read those addresses)."""
+        for key, h in list(self.pending_store.items()):
+            h.wait()
+            del self.pending_store[key]
+
+    def issue(self, d, sb_off, off, sz, with_acc, rb_off):
+        """Front half of the chunk pipeline: load the send chunk (and,
+        for the reduce phase, prefetch the local accumulator chunk),
+        then launch the remote DMA — it flies while the previous
+        chunk's reduce runs."""
+        slot = self.gc[d] % self.depth
+        prev = self.pending_send.pop((d, slot), None)
+        if prev is not None:
+            prev.wait_send()           # send slot free for reload
+        prev_st = self.pending_store.pop((d, slot), None)
+        if prev_st is not None:
+            prev_st.wait()             # acc slot's last store landed
+        ld = pltpu.make_async_copy(
+            self.o_hbm.at[pl.ds(sb_off + off, sz)],
+            self.send_buf.at[d, slot, pl.ds(0, sz)],
+            self.in_sem.at[d, slot])
+        ld.start()
+        if with_acc:
+            la = pltpu.make_async_copy(
+                self.o_hbm.at[pl.ds(rb_off + off, sz)],
+                self.acc_buf.at[d, slot, pl.ds(0, sz)],
+                self.acc_sem.at[d, slot])
+            la.start()
+            self.pending_acc[(d, slot)] = la
+        ld.wait()
+        if self.credits:
+            pltpu.semaphore_wait(self.cap_sem.at[d], 1)
+        dst = self.right if d == 0 else self.left
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=self.send_buf.at[d, slot, pl.ds(0, sz)],
+            dst_ref=self.recv_buf.at[d, slot, pl.ds(0, sz)],
+            send_sem=self.send_sem.at[d, slot],
+            recv_sem=self.recv_sem.at[d, slot],
+            device_id=self._dev(dst),
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        rdma.start()
+        self.pending_send[(d, slot)] = rdma
+        self.gc[d] += 1
+        return slot
+
+    def drain(self, d, slot, rb_off, off, sz, red):
+        """Back half: the chunk from upstream has (or is about to have)
+        landed — reduce it into the accumulator chunk (or store it
+        verbatim for the gather phase) and free the slot."""
+        self.pending_send[(d, slot)].wait_recv()
+        if red is not None:
+            self.pending_acc.pop((d, slot)).wait()
+            self.acc_buf[d, slot, :sz] = red(
+                self.acc_buf[d, slot, :sz], self.recv_buf[d, slot, :sz])
+            # the VPU read of recv_buf is synchronous: the slot is free
+            self._grant(d)
+            st = pltpu.make_async_copy(
+                self.acc_buf.at[d, slot, pl.ds(0, sz)],
+                self.o_hbm.at[pl.ds(rb_off + off, sz)],
+                self.st_sem.at[d, slot])
+            st.start()
+            self.pending_store[(d, slot)] = st
+        else:
+            st = pltpu.make_async_copy(
+                self.recv_buf.at[d, slot, pl.ds(0, sz)],
+                self.o_hbm.at[pl.ds(rb_off + off, sz)],
+                self.st_sem.at[d, slot])
+            st.start()
+            st.wait()                  # slot must land before re-grant
+            self._grant(d)
+
+    def _grant(self, d):
+        if not self.credits:
+            return
+        upstream = self.left if d == 0 else self.right
+        pltpu.semaphore_signal(
+            self.cap_sem.at[d], inc=1, device_id=self._dev(upstream),
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    def finish(self):
+        """Exit barrier: outbound DMAs off the send slots, stores
+        landed, and — with credits — both neighbors have consumed
+        everything we wrote (the remaining balance is exactly
+        ``depth``), so no in-flight write can land after kernel exit."""
+        for key, h in list(self.pending_send.items()):
+            h.wait_send()
+            del self.pending_send[key]
+        self.drain_stores()
+        if self.credits:
+            for d in range(self.ndir):
+                pltpu.semaphore_wait(self.cap_sem.at[d], self.depth)
+
+    def stream_step(self, spans_chunks, sb_offs, rb_offs, red):
+        """One ring step: pipeline every chunk of every direction —
+        issue chunk c, then drain chunk c-1 while c is on the wire."""
+        ndir = self.ndir
+        cmax = max(len(c) for c in spans_chunks)
+        live: List[List[Optional[int]]] = [[None] * len(spans_chunks[d])
+                                           for d in range(ndir)]
+        for c in range(cmax + 1):
+            for d in range(ndir):
+                if c < len(spans_chunks[d]):
+                    off, sz = spans_chunks[d][c]
+                    live[d][c] = self.issue(
+                        d, sb_offs[d], off, sz, red is not None,
+                        rb_offs[d])
+            for d in range(ndir):
+                if 1 <= c and c - 1 < len(spans_chunks[d]):
+                    off, sz = spans_chunks[d][c - 1]
+                    self.drain(d, live[d][c - 1], rb_offs[d], off, sz,
+                               red)
+        self.drain_stores()
+
+
+def _mk_streamer(p, ndir, depth, credits, left, right, o_hbm, scratch):
+    (send_buf, recv_buf, acc_buf, in_sem, acc_sem, st_sem, send_sem,
+     recv_sem, cap_sem) = scratch
+    return _RingStreamer(p, ndir, depth, credits, left, right, o_hbm,
+                         send_buf, recv_buf, acc_buf, in_sem, acc_sem,
+                         st_sem, send_sem, recv_sem, cap_sem)
+
+
+def _scratch_shapes(ndir: int, depth: int, chunk: int, dtype):
+    return [
+        pltpu.VMEM((ndir, depth, chunk), dtype),    # send slots
+        pltpu.VMEM((ndir, depth, chunk), dtype),    # recv slots
+        pltpu.VMEM((ndir, depth, chunk), dtype),    # accumulator slots
+        pltpu.SemaphoreType.DMA((ndir, depth)),     # send-chunk loads
+        pltpu.SemaphoreType.DMA((ndir, depth)),     # acc-chunk loads
+        pltpu.SemaphoreType.DMA((ndir, depth)),     # stores
+        pltpu.SemaphoreType.DMA((ndir, depth)),     # remote send
+        pltpu.SemaphoreType.DMA((ndir, depth)),     # remote recv
+        pltpu.SemaphoreType.REGULAR((ndir,)),       # slot credits
+        pltpu.SemaphoreType.DMA(()),                # init bulk copy
+    ]
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+def _block_spans(nblk: int, ndir: int) -> List[Tuple[int, int]]:
+    """Element ranges of a block per direction: the clockwise lane
+    carries the first half, counter-clockwise the second."""
+    if ndir == 1:
+        return [(0, nblk)]
+    h = (nblk + 1) // 2
+    return [(0, h), (h, nblk)]
+
+
+def _hbm_all_reduce_kernel(axis_name, p, op, nblk, chunk, depth, ndir,
+                           credits, x_hbm, o_hbm, *scratch):
+    my = lax.axis_index(axis_name)
+    right = lax.rem(my + 1, p)
+    left = lax.rem(my - 1 + p, p)
+    red = _reducer(op)
+    init_sem = scratch[-1]
+    st = _mk_streamer(p, ndir, depth, credits, left, right, o_hbm,
+                      scratch[:-1])
+
+    cp = pltpu.make_async_copy(x_hbm, o_hbm, init_sem)
+    cp.start()
+    cp.wait()
+    st.grant_initial_credits()
+
+    spans = _block_spans(nblk, ndir)
+    spans_chunks = [_chunks(lo, hi, chunk) for lo, hi in spans]
+
+    # Phase 1: reduce-scatter — cw round s passes the partial of block
+    # (my-s-1) rightward and folds the arrival into block (my-s-2); the
+    # ccw lane mirrors with +. After p-1 rounds block ``my`` is fully
+    # reduced on both lanes (same convention as pallas_ring.py).
+    for s in range(p - 1):
+        sb = [lax.rem(my - s - 1 + 2 * p, p), lax.rem(my + s + 1, p)]
+        rb = [lax.rem(my - s - 2 + 2 * p, p), lax.rem(my + s + 2, p)]
+        st.stream_step(spans_chunks,
+                       [sb[d] * nblk for d in range(ndir)],
+                       [rb[d] * nblk for d in range(ndir)], red)
+
+    # Phase 2: all-gather — cw round s passes block (my-s) rightward,
+    # receives (my-s-1); ccw mirrors.
+    for s in range(p - 1):
+        sb = [lax.rem(my - s + 2 * p, p), lax.rem(my + s, p)]
+        rb = [lax.rem(my - s - 1 + 2 * p, p), lax.rem(my + s + 1, p)]
+        st.stream_step(spans_chunks,
+                       [sb[d] * nblk for d in range(ndir)],
+                       [rb[d] * nblk for d in range(ndir)], None)
+    st.finish()
+
+
+def _hbm_all_gather_kernel(axis_name, p, nblk, chunk, depth, ndir,
+                           credits, x_hbm, o_hbm, *scratch):
+    my = lax.axis_index(axis_name)
+    right = lax.rem(my + 1, p)
+    left = lax.rem(my - 1 + p, p)
+    init_sem = scratch[-1]
+    st = _mk_streamer(p, ndir, depth, credits, left, right, o_hbm,
+                      scratch[:-1])
+
+    # my shard lands in block ``my`` of the output
+    cp = pltpu.make_async_copy(x_hbm, o_hbm.at[pl.ds(my * nblk, nblk)],
+                               init_sem)
+    cp.start()
+    cp.wait()
+    st.grant_initial_credits()
+
+    spans = _block_spans(nblk, ndir)
+    spans_chunks = [_chunks(lo, hi, chunk) for lo, hi in spans]
+    for s in range(p - 1):
+        sb = [lax.rem(my - s + 2 * p, p), lax.rem(my + s, p)]
+        rb = [lax.rem(my - s - 1 + 2 * p, p), lax.rem(my + s + 1, p)]
+        st.stream_step(spans_chunks,
+                       [sb[d] * nblk for d in range(ndir)],
+                       [rb[d] * nblk for d in range(ndir)], None)
+    st.finish()
+
+
+def _sendrecv_kernel(axis_name, p, src, dst, x_hbm, o_hbm, send_sem,
+                     recv_sem):
+    """Single remote-DMA point-to-point exchange: HBM to remote HBM, no
+    VMEM staging, no ppermute lowering. Every shard runs the same DMA
+    (the transfer is a collective under the hood — the symmetric
+    routing of rma/device.py's pallas_put), directed by a permutation
+    that is identity except src<->dst: src and dst swap buffers, every
+    other shard self-copies. One wait pair consumes both semaphores."""
+    my = lax.axis_index(axis_name)
+    partner = jnp.where(my == src, dst, jnp.where(my == dst, src, my))
+    rdma = pltpu.make_async_remote_copy(
+        src_ref=x_hbm, dst_ref=o_hbm, send_sem=send_sem,
+        recv_sem=recv_sem, device_id=partner,
+        device_id_type=pltpu.DeviceIdType.LOGICAL)
+    rdma.start()
+    rdma.wait()
+
+
+# ---------------------------------------------------------------------------
+# wrappers
+# ---------------------------------------------------------------------------
+
+def _resolve_flags(interpret, credits):
+    if interpret is None:
+        interpret = bool(get_config()["ICI_INTERPRET"])
+    if credits is None:
+        # hardware always runs the credit handshake; the 0.4.x
+        # interpreter cannot (no remote signal) and does not need to
+        credits = (not interpret) or have_remote_signal()
+    return interpret, credits
+
+
+def _resolve_ndir(num_devices: int, bidirectional) -> int:
+    if bidirectional is None:
+        bidirectional = bool(get_config()["ICI_BIDIR"])
+    return 2 if (bidirectional and num_devices > 2) else 1
+
+
+def hbm_ring_all_reduce(x: jax.Array, axis_name: str, num_devices: int,
+                        op: str = "sum", *,
+                        chunk_bytes: Optional[int] = None,
+                        depth: Optional[int] = None,
+                        bidirectional: Optional[bool] = None,
+                        credits: Optional[bool] = None,
+                        interpret=None) -> jax.Array:
+    """Allreduce along ``axis_name`` via the chunked HBM-streaming ring
+    (pipelined reduce-scatter + all-gather). Any shape/size: the shard
+    is flattened and padded to ``p`` blocks with the op identity."""
+    p = num_devices
+    if not HAVE_PALLAS or p == 1:
+        from .collectives import allreduce
+        return allreduce(x, axis_name, op)
+    interpret, credits = _resolve_flags(interpret, credits)
+    shape = x.shape
+    n = int(np.prod(shape)) if shape else 1
+    flat = x.reshape(n)
+    nblk = -(-n // p)
+    n_pad = nblk * p
+    if n_pad > n:
+        flat = jnp.pad(flat, (0, n_pad - n),
+                       constant_values=_pad_identity(x.dtype, op))
+    chunk = min(_cfg_chunk_elems(x.dtype, chunk_bytes), nblk)
+    d = _cfg_depth(depth)
+    ndir = _resolve_ndir(p, bidirectional)
+    kernel = functools.partial(_hbm_all_reduce_kernel, axis_name, p, op,
+                               nblk, chunk, d, ndir, credits)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n_pad,), x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=_scratch_shapes(ndir, d, chunk, x.dtype),
+        compiler_params=compiler_params(collective_id=_CID_ALLREDUCE,
+                                        has_side_effects=True),
+        interpret=interpret,
+    )(flat)
+    return out[:n].reshape(shape)
+
+
+def hbm_ring_all_gather(x: jax.Array, axis_name: str, num_devices: int,
+                        *, chunk_bytes: Optional[int] = None,
+                        depth: Optional[int] = None,
+                        bidirectional: Optional[bool] = None,
+                        credits: Optional[bool] = None,
+                        interpret=None) -> jax.Array:
+    """All-gather along ``axis_name`` via the chunked HBM-streaming
+    ring. ``x``: this shard's block [m, ...]; returns [p*m, ...]
+    (tiled, like lax.all_gather(tiled=True))."""
+    p = num_devices
+    if not HAVE_PALLAS or p == 1:
+        return lax.all_gather(x, axis_name, tiled=True)
+    interpret, credits = _resolve_flags(interpret, credits)
+    shape = x.shape
+    m = int(np.prod(shape)) if shape else 1
+    flat = x.reshape(m)
+    chunk = min(_cfg_chunk_elems(x.dtype, chunk_bytes), m)
+    d = _cfg_depth(depth)
+    ndir = _resolve_ndir(p, bidirectional)
+    kernel = functools.partial(_hbm_all_gather_kernel, axis_name, p, m,
+                               chunk, d, ndir, credits)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((p * m,), x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=_scratch_shapes(ndir, d, chunk, x.dtype),
+        compiler_params=compiler_params(collective_id=_CID_ALLGATHER,
+                                        has_side_effects=True),
+        interpret=interpret,
+    )(flat)
+    return out.reshape((p * shape[0],) + shape[1:]) if shape \
+        else out
+
+
+def remote_sendrecv(x: jax.Array, axis_name: str, num_devices: int,
+                    src: int, dst: int, *, interpret=None) -> jax.Array:
+    """The ppermute-free pt2pt lane: one remote DMA exchanges ``x``
+    between shards ``src`` and ``dst`` (HBM to HBM over ICI, no VMEM
+    staging, no collective lowering) — dst's return is src's buffer and
+    vice versa; every other shard returns its own ``x`` unchanged.
+    MPI_Sendrecv exchange semantics, not ppermute's zero fill."""
+    p = num_devices
+    if not HAVE_PALLAS or p == 1 or src == dst:
+        return x
+    interpret, _ = _resolve_flags(interpret, None)
+    kernel = functools.partial(_sendrecv_kernel, axis_name, p, src, dst)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(())],
+        compiler_params=compiler_params(collective_id=_CID_SENDRECV,
+                                        has_side_effects=True),
+        interpret=interpret,
+    )(x)
+
+
+# ---------------------------------------------------------------------------
+# tier dispatch (the device-side tuning-table moment)
+# ---------------------------------------------------------------------------
+
+def _kernels_runnable(interpret: Optional[bool]) -> bool:
+    """Compiled pallas needs a TPU; anywhere else the kernels run only
+    under the interpreter (tests, the CPU mesh CI)."""
+    if interpret is None:
+        interpret = bool(get_config()["ICI_INTERPRET"])
+    if interpret:
+        return True
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:   # uninitialized backend — resolve at trace time
+        return False
+
+
+def planned_tier(name: str, shard_nbytes: int, dtype, op: Optional[str],
+                 interpret=None) -> Tuple[str, Optional[str]]:
+    """(tier, fallback_reason) for one device collective call. tier is
+    'vmem' | 'hbm' | 'xla'; reason is None unless the XLA lowering was
+    taken, in which case it names the dev_coll_fallback_* pvar bucket:
+    size (past the measured XLA crossover), dtype (op/dtype the kernels
+    cannot reduce), shape (degenerate extent), platform (no pallas /
+    not a TPU and not interpreting)."""
+    if not HAVE_PALLAS or not _kernels_runnable(interpret):
+        return "xla", "platform"
+    if op is not None and op not in _SUPPORTED_OPS:
+        return "xla", "dtype"
+    if np.dtype(dtype).kind not in "fiu":
+        return "xla", "dtype"
+    if shard_nbytes <= 0:
+        return "xla", "shape"
+    from ..coll.tuning import device_tier
+    tier = device_tier(name, shard_nbytes)
+    if tier == "xla":
+        return "xla", "size"
+    return tier, None
+
+
+def ici_all_reduce(x: jax.Array, axis_name: str, num_devices: int,
+                   op: str = "sum", interpret=None) -> jax.Array:
+    """Tier-dispatched device allreduce: VMEM-resident flat ring below
+    the VMEM boundary, HBM-streaming chunked ring above it, XLA past
+    the measured crossover (or when the kernels cannot run). The
+    per-call fallback pvar accounting lives in coll/device.py; direct
+    shard_map users are counted once per traced shape."""
+    p = num_devices
+    if p == 1:
+        from .collectives import allreduce
+        return allreduce(x, axis_name, op)
+    tier, reason = planned_tier("allreduce", x.size * x.dtype.itemsize,
+                                x.dtype, op, interpret)
+    if tier == "vmem":
+        from . import pallas_ring
+        if x.ndim >= 1 and x.shape[0] % p == 0 and op == "sum":
+            ip = True if (interpret is None
+                          and bool(get_config()["ICI_INTERPRET"])) \
+                else (interpret or False)
+            return pallas_ring.ring_all_reduce(x, axis_name, p,
+                                               interpret=ip)
+        # shapes/ops the flat kernel cannot take stream instead (the
+        # chunked engine pads; no fallback)
+        tier = "hbm"
+    if tier == "hbm":
+        return hbm_ring_all_reduce(x, axis_name, p, op,
+                                   interpret=interpret)
+    note_fallback("allreduce", reason or "size",
+                  x.size * x.dtype.itemsize, x.dtype)
+    from .collectives import allreduce
+    return allreduce(x, axis_name, op)
+
+
+def ici_all_gather(x: jax.Array, axis_name: str, num_devices: int,
+                   interpret=None) -> jax.Array:
+    """Tier-dispatched device all-gather (tiled). The gather output is
+    p times the shard, so tier selection keys on the OUTPUT bytes —
+    that is what must fit in VMEM."""
+    p = num_devices
+    if p == 1:
+        return lax.all_gather(x, axis_name, tiled=True)
+    out_nbytes = x.size * x.dtype.itemsize * p
+    tier, reason = planned_tier("allgather", out_nbytes, x.dtype, None,
+                                interpret)
+    if tier == "vmem":
+        from . import pallas_ring
+        ip = True if (interpret is None
+                      and bool(get_config()["ICI_INTERPRET"])) \
+            else (interpret or False)
+        return pallas_ring.ring_all_gather(x, axis_name, p, interpret=ip)
+    if tier == "hbm":
+        return hbm_ring_all_gather(x, axis_name, p, interpret=interpret)
+    note_fallback("allgather", reason or "size", out_nbytes, x.dtype)
+    return lax.all_gather(x, axis_name, tiled=True)

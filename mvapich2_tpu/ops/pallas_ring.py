@@ -32,15 +32,14 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils.mlog import get_logger
+from ._compat import (HAVE_PALLAS, compiler_params, have_remote_signal,
+                      note_fallback)
 
 log = get_logger("pallas")
 
-try:
+if HAVE_PALLAS:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
-    HAVE_PALLAS = True
-except ImportError:  # pragma: no cover
-    HAVE_PALLAS = False
 
 # VMEM budget guard: shard + out + 2 slots, leave headroom
 VMEM_LIMIT_BYTES = 4 * 1024 * 1024
@@ -51,7 +50,12 @@ FROM_RIGHT = 1
 
 def _grant_credits(cap_sem, left, right):
     """Grant one slot-credit to each neighbor (I am my left neighbor's
-    RIGHT, so I bump its FROM_RIGHT slot, and vice versa)."""
+    RIGHT, so I bump its FROM_RIGHT slot, and vice versa). cap_sem=None
+    disables the handshake — required under the jax<0.5 interpreter
+    (no remote signal) and safe there: the emulator is synchronous
+    dataflow, so flow control is moot."""
+    if cap_sem is None:
+        return
     pltpu.semaphore_signal(cap_sem.at[FROM_RIGHT], inc=1, device_id=left,
                            device_id_type=pltpu.DeviceIdType.LOGICAL)
     pltpu.semaphore_signal(cap_sem.at[FROM_LEFT], inc=1, device_id=right,
@@ -61,13 +65,22 @@ def _grant_credits(cap_sem, left, right):
 def _take_credits(cap_sem):
     """Consume one credit from each direction — blocks until both
     neighbors granted this round's slot."""
+    if cap_sem is None:
+        return
     pltpu.semaphore_wait(cap_sem.at[FROM_LEFT], 1)
     pltpu.semaphore_wait(cap_sem.at[FROM_RIGHT], 1)
 
 
-def _ring_all_gather_kernel(axis_name, num_devices, x_ref, out_ref,
-                            comm_buf, send_sem, recv_sem, cap_sem):
+def _creditless(interpret) -> bool:
+    return bool(interpret) and not have_remote_signal()
+
+
+def _ring_all_gather_kernel(axis_name, num_devices, creditless, x_ref,
+                            out_ref, comm_buf, send_sem, recv_sem,
+                            cap_sem):
     my_id = lax.axis_index(axis_name)
+    if creditless:
+        cap_sem = None
     right = lax.rem(my_id + 1, num_devices)
     left = lax.rem(my_id - 1 + num_devices, num_devices)
     chunk = x_ref.shape[0]
@@ -104,11 +117,17 @@ def ring_all_gather(x: jax.Array, axis_name: str, num_devices: int,
     ``x``: this shard's block [chunk, ...]; returns [p*chunk, ...]."""
     if not HAVE_PALLAS or num_devices == 1:
         return lax.all_gather(x, axis_name, tiled=True)
+    if num_devices * x.nbytes > VMEM_LIMIT_BYTES:
+        # the gathered output + comm slots must be VMEM-resident; larger
+        # buffers belong to the HBM-streaming tier (ops/pallas_ici) —
+        # counted, never silent (the r5 4 MiB cliff lesson)
+        note_fallback("allgather", "size", num_devices * x.nbytes, x.dtype)
+        return lax.all_gather(x, axis_name, tiled=True)
     chunk = x.shape[0]
     out_shape = jax.ShapeDtypeStruct((num_devices * chunk,) + x.shape[1:],
                                      x.dtype)
     kernel = functools.partial(_ring_all_gather_kernel, axis_name,
-                               num_devices)
+                               num_devices, _creditless(interpret))
     return pl.pallas_call(
         kernel,
         out_shape=out_shape,
@@ -120,16 +139,19 @@ def ring_all_gather(x: jax.Array, axis_name: str, num_devices: int,
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(collective_id=7),
+        compiler_params=compiler_params(collective_id=7),
         interpret=interpret,
     )(x)
 
 
-def _ring_all_reduce_kernel(axis_name, num_devices, x_ref, out_ref,
-                            comm_buf, send_sem, recv_sem, cap_sem):
+def _ring_all_reduce_kernel(axis_name, num_devices, creditless, x_ref,
+                            out_ref, comm_buf, send_sem, recv_sem,
+                            cap_sem):
     """Reduce-scatter ring + all-gather ring with the reduction fused into
     the receive path (the SHARP-style in-transit reduce, done in VMEM)."""
     my_id = lax.axis_index(axis_name)
+    if creditless:
+        cap_sem = None
     right = lax.rem(my_id + 1, num_devices)
     left = lax.rem(my_id - 1 + num_devices, num_devices)
     p = num_devices
@@ -198,9 +220,17 @@ def ring_all_reduce(x: jax.Array, axis_name: str, num_devices: int,
         return lax.psum(x, axis_name)
     p = num_devices
     if x.shape[0] % p != 0 or x.nbytes > VMEM_LIMIT_BYTES:
+        # observable, not silent: the tuning layer's tier dispatch
+        # (ops/pallas_ici.ici_all_reduce) streams these through HBM
+        # instead; a direct caller landing here is counted per traced
+        # shape via the dev_coll_fallback_* family
+        note_fallback("allreduce",
+                      "shape" if x.shape[0] % p else "size",
+                      x.nbytes, x.dtype)
         return lax.psum(x, axis_name)
     blk = x.shape[0] // p
-    kernel = functools.partial(_ring_all_reduce_kernel, axis_name, p)
+    kernel = functools.partial(_ring_all_reduce_kernel, axis_name, p,
+                               _creditless(interpret))
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
@@ -212,6 +242,6 @@ def ring_all_reduce(x: jax.Array, axis_name: str, num_devices: int,
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(collective_id=8),
+        compiler_params=compiler_params(collective_id=8),
         interpret=interpret,
     )(x)

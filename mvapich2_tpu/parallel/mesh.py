@@ -26,11 +26,23 @@ from ..utils.mlog import get_logger
 log = get_logger("mesh")
 
 shard_map = jax.shard_map if hasattr(jax, "shard_map") else None
-if shard_map is None:  # pragma: no cover — jax < 0.4.35
+if shard_map is None:  # jax < 0.5: experimental shard_map, check_rep era
+    import inspect
+
     from jax.experimental.shard_map import shard_map as _sm
 
+    _SM_PARAMS = set(inspect.signature(_sm).parameters)
+
     def shard_map(f, mesh=None, in_specs=None, out_specs=None, **kw):
-        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+        # callers use the modern keyword (check_vma); the experimental
+        # signature spells it check_rep — translate, and drop anything
+        # the installed version does not know rather than TypeError-ing
+        # the whole device path (the r6 seed failure mode)
+        if "check_vma" in kw and "check_vma" not in _SM_PARAMS:
+            kw["check_rep"] = kw.pop("check_vma")
+        kw = {k: v for k, v in kw.items() if k in _SM_PARAMS}
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   **kw)
 
 
 def mesh_shape_for(n: int, naxes: int = 2) -> Tuple[int, ...]:

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.collectives import axis_size as _ops_axis_size
 from ..ops import ring_shift
 
 
@@ -26,7 +27,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, micro, axis: str):
     stage 0 injects them).
     Returns [n_micro, mb, ...] outputs (valid on the LAST stage; other
     stages return zeros — broadcast with a psum/bcast if needed)."""
-    p = lax.axis_size(axis)
+    p = _ops_axis_size(axis)
     stage = lax.axis_index(axis)
     n_micro = micro.shape[0]
     mb_shape = micro.shape[1:]

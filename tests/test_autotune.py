@@ -82,3 +82,55 @@ def test_mpit_autotune_name_exists():
     """tuning.py's docstring names mpit.autotune — it must resolve."""
     from mvapich2_tpu import mpit
     assert mpit.autotune.profile_comm is autotune.profile_comm
+
+
+def test_committed_profile_carries_device_tiers():
+    """The --device sweep's boundaries are committed for the CI arch
+    and flow through load_profile into coll/tuning.device_tier."""
+    from mvapich2_tpu.coll import tuning
+    path = os.path.join(autotune.PROFILE_DIR, "cpu_cpu_8.json")
+    doc = json.load(open(path))
+    dc = doc["profile"]["device_crossovers"]
+    assert "dev_tier_vmem_max" in dc and "dev_tier_xla_min" in dc
+    assert doc["profile"]["kernel_params"]["ici_chunk_bytes"] > 0
+    saved = dict(tuning._DEVICE_CROSSOVERS)
+    saved_kp = dict(tuning._KERNEL_PARAMS)
+    tuning._DEVICE_CROSSOVERS.clear()
+    tuning._KERNEL_PARAMS.clear()
+    try:
+        assert autotune.load_profile_file(path)
+        # the measured CPU crossovers route this arch's band to XLA
+        # above xla_min — honest: interpreted kernels lose to XLA here
+        assert tuning._DEVICE_CROSSOVERS["dev_tier_xla_min"] == \
+            dc["dev_tier_xla_min"]
+        assert tuning.kernel_param(
+            "ici_chunk_bytes", -1) == \
+            doc["profile"]["kernel_params"]["ici_chunk_bytes"]
+    finally:
+        tuning._DEVICE_CROSSOVERS.clear()
+        tuning._DEVICE_CROSSOVERS.update(saved)
+        tuning._KERNEL_PARAMS.clear()
+        tuning._KERNEL_PARAMS.update(saved_kp)
+
+
+def test_merge_device_profile_roundtrip(tmp_path):
+    """merge_device_profile folds a sweep fragment into an existing
+    arch profile without clobbering the host tables."""
+    path = str(tmp_path / "prof.json")
+    autotune.save_profile(
+        {"tables": {"allreduce": {"small": [[None, "rd"]]}},
+         "device_crossovers": {"allreduce": 1234}}, path)
+    frag = {"device_crossovers": {"dev_tier_vmem_max": 64,
+                                  "dev_tier_xla_min": 4096},
+            "kernel_params": {"ici_chunk_bytes": 2048},
+            "raw_device_tiers": {"vmem": {"64": 0.1}}}
+    out = autotune.merge_device_profile(frag, path)
+    assert out == path
+    doc = json.load(open(path))
+    prof = doc["profile"]
+    assert prof["tables"]["allreduce"]["small"] == [[None, "rd"]]
+    assert prof["device_crossovers"] == {
+        "allreduce": 1234, "dev_tier_vmem_max": 64,
+        "dev_tier_xla_min": 4096}
+    assert prof["kernel_params"]["ici_chunk_bytes"] == 2048
+    assert prof["raw_device_tiers"]["vmem"]["64"] == 0.1

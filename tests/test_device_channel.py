@@ -253,3 +253,28 @@ def test_nonsum_ops_and_in_place():
         assert buf[0] == sum(range(1, comm.size + 1))
 
     run_ranks(N_RANKS, app, device_mesh=True)
+
+
+def test_hbm_streaming_tier_end_to_end():
+    """ISSUE 8 acceptance shape: a buffer past the (here, forced-tiny)
+    VMEM boundary runs the HBM-streaming chunked kernel through the
+    full MPI channel — interpret mode on the CPU mesh — lands the right
+    answer, and the per-call tier pvar counts it (never a silent XLA
+    fallback)."""
+    from mvapich2_tpu import mpit
+    _reload(MV2T_ICI_INTERPRET="1", MV2T_DEV_TIER_VMEM_MAX="64",
+            MV2T_ICI_CHUNK_BYTES="128", MV2T_DEVICE_COLL_MIN_BYTES="1")
+    before = mpit.pvar("dev_coll_tier_hbm").read()
+    try:
+        def app(comm):
+            x = np.full(256, float(comm.rank + 1), np.float32)
+            out = comm.allreduce(x)     # 1 KiB shard > 64 B vmem cap
+            expect = sum(range(1, comm.size + 1))
+            np.testing.assert_array_equal(out, np.full(256, expect,
+                                                       np.float32))
+
+        run_ranks(N_RANKS, app, device_mesh=True)
+        assert mpit.pvar("dev_coll_tier_hbm").read() >= before + N_RANKS
+    finally:
+        _reload(MV2T_ICI_INTERPRET=None, MV2T_DEV_TIER_VMEM_MAX=None,
+                MV2T_ICI_CHUNK_BYTES=None, MV2T_DEVICE_COLL_MIN_BYTES=None)

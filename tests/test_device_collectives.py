@@ -172,8 +172,10 @@ def test_under_jit_compiles_once(comm8):
 # ---------------------------------------------------------------------------
 
 def _interp():
-    from jax.experimental.pallas import tpu as pltpu
-    return pltpu.InterpretParams(detect_races=True)
+    # race-detecting interpreter when this jax has it, plain interpret
+    # otherwise (ops/_compat owns the version seam)
+    from mvapich2_tpu.ops._compat import interpret_params
+    return interpret_params(detect_races=True)
 
 
 def test_pallas_ring_all_gather(comm8):

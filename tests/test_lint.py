@@ -277,3 +277,41 @@ def test_watchdog_shared_field_map():
     lines = watchdog._protocol_map_lines(m)
     assert any("seqlock(flat)" in ln for ln in lines)
     assert any("atomic(doorbell)" in ln for ln in lines)
+
+
+def test_device_engine_under_lint_ratchet():
+    """ISSUE 8 satellite: the HBM-streaming kernel modules ride the
+    same passes as the host path — pallas_ici / _compat / pallas_ring
+    are in the scanned set, their trace site follows the guarded idiom
+    (coll/device.py dev_coll_fallback instant), and a seeded violation
+    of each class in a device-engine-shaped module is caught."""
+    import mvapich2_tpu
+    from mvapich2_tpu.analysis import core as acore
+
+    pkg = os.path.dirname(mvapich2_tpu.__file__)
+    modules, errors = acore.scan_paths([pkg])
+    assert not errors
+    names = {os.path.relpath(m.path, pkg) for m in modules}
+    for need in ("ops/pallas_ici.py", "ops/_compat.py",
+                 "ops/pallas_ring.py", "bench/dev_sweep.py"):
+        assert need in names, need
+    # the committed device modules are clean under the pvars +
+    # traceguard passes (no new baseline entries)
+    from mvapich2_tpu.analysis.registry import RegistryPass
+    from mvapich2_tpu.analysis.traceguard import TraceGuardPass
+    dev = [m for m in modules
+           if os.path.relpath(m.path, pkg).startswith(("ops/", "bench/"))
+           or os.path.relpath(m.path, pkg) == "coll/device.py"]
+    fs = RegistryPass().run(modules)   # pvar decls are cross-module
+    dev_paths = {m.path for m in dev}
+    assert [f for f in fs if f.path in dev_paths] == []
+    assert [f for f in TraceGuardPass().run(dev)] == []
+    # a seeded unguarded trace site + undeclared pvar in a kernel-shaped
+    # module is caught (the ratchet actually bites)
+    bad = acore.SourceModule("ops/bad_ici_fixture.py", (
+        "from .. import mpit\n"
+        "def hbm_ring(tracer):\n"
+        "    mpit.pvar('dev_coll_never_declared').inc()\n"
+        "    tracer.record('channel', 'x', 'i')\n"))
+    assert len(RegistryPass().run(modules + [bad])) == 1
+    assert len(TraceGuardPass().run([bad])) == 1

@@ -315,3 +315,39 @@ def test_plane_pvars_observable():
     assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
     assert "No Errors" in r.stdout
     assert "did not move" not in r.stdout
+
+
+def test_device_category():
+    """The device-collective engine knobs + fallback counters (ISSUE 8
+    satellite) enumerate under category "device": the ICI kernel cvars
+    (chunk bytes, pipeline depth, direction, interpret) and the tier /
+    fallback pvar family shared by ops/pallas_ici, ops/pallas_ring and
+    coll/device — declared in mpit.py so tools see them before any
+    jax import."""
+    cats = mpit.category_names()
+    assert "device" in cats
+    info = mpit.category_get_info(cats.index("device"))
+    for cv in ("ICI_CHUNK_BYTES", "ICI_PIPELINE_DEPTH", "ICI_BIDIR",
+               "ICI_INTERPRET", "DEV_TIER_VMEM_MAX", "DEV_TIER_XLA_MIN"):
+        assert cv in info["cvars"], cv
+    for pv in ("dev_coll_fallback_size", "dev_coll_fallback_dtype",
+               "dev_coll_fallback_shape", "dev_coll_fallback_platform",
+               "dev_coll_tier_vmem", "dev_coll_tier_hbm"):
+        assert pv in info["pvars"], pv
+        assert mpit._pvars.get(pv).klass == mpit.PVAR_CLASS_COUNTER
+    # cvar surface round-trips through the indexed MPI_T view
+    i = mpit.cvar_get_index("ICI_CHUNK_BYTES")
+    assert mpit.cvar_get_info(i)["name"] == "ICI_CHUNK_BYTES"
+    assert int(mpit.cvar_read(i)) > 0
+
+
+def test_device_fallback_pvars_move():
+    """A pvar session sees the fallback family move when a device
+    collective is rejected to the XLA lowering (the once-silent cliff,
+    now MPI_T-visible)."""
+    from mvapich2_tpu.ops._compat import note_fallback
+    sess = mpit.pvar_session_create()
+    h = sess.handle_alloc("dev_coll_fallback_size")
+    sess.start(h)
+    note_fallback("allreduce", "size", 1 << 23, "float32")
+    assert sess.read(h) >= 1

@@ -33,7 +33,8 @@ def test_fused_reduce_to_slot(layout, mean):
         ref = ref / R
     out = ph.fused_reduce_to_slot(x, layout=layout, mean=mean, block_m=4)
     assert out.shape == (M, L)
-    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5,
+                               atol=1e-4)
 
 
 @pytest.mark.parametrize("donate", [False, True])
@@ -43,7 +44,8 @@ def test_fused_allreduce_broadcast(donate):
     ref = np.broadcast_to(
         np.asarray(x).sum(axis=1, keepdims=True), (M, R, L))
     out = ph.fused_allreduce(x, block_m=8, donate=donate)
-    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5,
+                               atol=1e-4)
 
 
 def test_hbm_slot_allreduce_ragged():
@@ -54,7 +56,8 @@ def test_hbm_slot_allreduce_ragged():
     out = ph.hbm_slot_allreduce(bufs)
     assert out.shape == (n,)
     np.testing.assert_allclose(np.asarray(out),
-                               np.asarray(bufs).sum(axis=0), rtol=1e-5)
+                               np.asarray(bufs).sum(axis=0), rtol=1e-5,
+                               atol=1e-4)
 
 
 def test_pack_unpack_roundtrip():

@@ -1,0 +1,267 @@
+"""HBM-streaming ICI collective engine (ops/pallas_ici) — interpret-mode
+correctness sweep on the 8-device virtual CPU mesh.
+
+The chunked remote-DMA kernels must bit-agree with the XLA lowering for
+every op x dtype x chunk-boundary shape (integer-valued data makes
+float sums order-independent, so "bit-agreement" is exact, not rtol);
+the double-buffer schedule must be invariant under pipeline depth; the
+tier dispatcher must route by the measured boundaries and count every
+XLA fallback.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from mvapich2_tpu import mpit  # noqa: E402
+from mvapich2_tpu.ops import pallas_ici, pallas_ring  # noqa: E402
+from mvapich2_tpu.parallel import MeshComm, make_mesh  # noqa: E402
+from mvapich2_tpu.utils.config import get_config  # noqa: E402
+
+NP = 8
+
+
+@pytest.fixture(scope="module")
+def comm8():
+    return MeshComm(make_mesh((NP,), ("x",)))
+
+
+def _reload(**env):
+    for k, v in env.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    get_config().reload()
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    yield
+    _reload(MV2T_ICI_INTERPRET=None, MV2T_DEV_TIER_VMEM_MAX=None,
+            MV2T_DEV_TIER_XLA_MIN=None, MV2T_ICI_CHUNK_BYTES=None,
+            MV2T_ICI_PIPELINE_DEPTH=None, MV2T_ICI_BIDIR=None)
+
+
+def _expect(xv, op):
+    blocks = np.asarray(xv, np.float64).reshape(NP, -1)
+    return {"sum": blocks.sum(0), "max": blocks.max(0),
+            "min": blocks.min(0), "prod": blocks.prod(0)}[op]
+
+
+def _run_ar(comm8, xv, op="sum", **kw):
+    out = comm8.run(lambda s: pallas_ici.hbm_ring_all_reduce(
+        s, "x", NP, op=op, interpret=True, **kw), jnp.asarray(xv))
+    return np.asarray(out).reshape(NP, -1)
+
+
+# ---------------------------------------------------------------------------
+# chunk-boundary shapes (shard x chunk remainders, degenerate 1-chunk)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("shard,chunk_bytes", [
+    (8, 16),          # shard divides p, chunks divide the block exactly
+    (13, 16),         # shard % p != 0: identity-padded tail
+    (37, 64),         # non-divisible block/chunk remainder (last short)
+    (5, 1 << 20),     # 1-chunk degenerate: chunk covers the whole block
+])
+def test_allreduce_chunk_boundaries_bitwise(comm8, shard, chunk_bytes):
+    xv = (np.arange(NP * shard) % 7).astype(np.float32)
+    got = _run_ar(comm8, xv, chunk_bytes=chunk_bytes)
+    exp = _expect(xv, "sum")
+    for row in got:
+        np.testing.assert_array_equal(row, exp)
+
+
+@pytest.mark.parametrize("op,dtype", [
+    ("max", np.int32),
+    ("min", np.int32),
+    ("prod", np.float32),
+])
+def test_allreduce_ops_bitwise(comm8, op, dtype):
+    n = NP * 16
+    xv = ((np.arange(n) % 2 + 1) if op == "prod"
+          else (np.arange(n) % 11 - 5)).astype(dtype)
+    got = _run_ar(comm8, xv, op=op, chunk_bytes=32)
+    exp = _expect(xv, op).astype(dtype)
+    for row in got:
+        np.testing.assert_array_equal(row.astype(dtype), exp)
+
+
+def test_allreduce_bf16_bitwise(comm8):
+    # integer values small enough that every partial is bf16-exact
+    xv = (np.arange(NP * 8) % 5).astype(np.float32)
+    out = comm8.run(lambda s: pallas_ici.hbm_ring_all_reduce(
+        s, "x", NP, interpret=True, chunk_bytes=16),
+        jnp.asarray(xv, dtype=jnp.bfloat16))
+    got = np.asarray(out.astype(jnp.float32)).reshape(NP, -1)
+    exp = _expect(xv, "sum")
+    for row in got:
+        np.testing.assert_array_equal(row, exp)
+
+
+def test_allreduce_agrees_with_xla_lowering(comm8):
+    """The acceptance identity: chunked kernel == lax.psum, bitwise
+    (integer-valued f32 makes the sum order-free)."""
+    xv = (np.arange(NP * 24) % 13).astype(np.float32)
+    got = _run_ar(comm8, xv, chunk_bytes=32)
+    from mvapich2_tpu import ops
+    ref = comm8.run(lambda s: ops.allreduce(s, "x"), jnp.asarray(xv))
+    np.testing.assert_array_equal(got,
+                                  np.asarray(ref).reshape(NP, -1))
+
+
+def test_allreduce_unidirectional(comm8):
+    xv = (np.arange(NP * 12) % 9).astype(np.float32)
+    got = _run_ar(comm8, xv, chunk_bytes=16, bidirectional=False)
+    exp = _expect(xv, "sum")
+    for row in got:
+        np.testing.assert_array_equal(row, exp)
+
+
+# ---------------------------------------------------------------------------
+# pipelining depth (the double-buffer schedule)
+# ---------------------------------------------------------------------------
+
+def test_pipeline_depth_invariance(comm8):
+    """Deeper pipelines reorder DMA issue, never results."""
+    xv = (np.arange(NP * 37) % 7).astype(np.float32)
+    exp = _expect(xv, "sum")
+    for depth in (3, 4):
+        got = _run_ar(comm8, xv, chunk_bytes=64, depth=depth)
+        for row in got:
+            np.testing.assert_array_equal(row, exp)
+
+
+def test_chunk_schedule_unit():
+    """Static schedule invariants: chunks tile the span exactly, the
+    remainder rides the last chunk, and the global-counter slot
+    sequence never lands a write in a slot still inside the
+    outstanding window (the credit-correctness precondition)."""
+    for lo, hi, chunk in [(0, 64, 16), (0, 37, 16), (19, 37, 8),
+                          (0, 5, 1 << 20)]:
+        cl = pallas_ici._chunks(lo, hi, chunk)
+        assert cl[0][0] == lo
+        assert sum(sz for _, sz in cl) == hi - lo
+        offs = [off for off, _ in cl]
+        assert offs == sorted(offs)
+        assert all(sz == chunk for _, sz in cl[:-1])
+    for depth in (2, 3, 4):
+        for total in (1, 3, 7, 8):
+            slots = [k % depth for k in range(total)]
+            for k in range(total):
+                window = slots[k + 1:k + depth]   # outstanding writes
+                if k + depth < total:
+                    assert slots[k + depth] not in window
+                    assert slots[k + depth] == slots[k]
+
+
+def test_scratch_scales_with_depth_and_chunk():
+    a = pallas_ici._scratch_shapes(2, 2, 64, jnp.float32)
+    b = pallas_ici._scratch_shapes(2, 4, 64, jnp.float32)
+    # three data buffers lead; VMEM bytes double with depth
+    assert a[0].shape == (2, 2, 64) and b[0].shape == (2, 4, 64)
+    assert len(a) == len(b)
+
+
+# ---------------------------------------------------------------------------
+# all-gather + the pt2pt lane
+# ---------------------------------------------------------------------------
+
+def test_hbm_all_gather_bitwise(comm8):
+    xv = np.arange(NP * 13, dtype=np.int32)
+    out = comm8.run(lambda s: pallas_ici.hbm_ring_all_gather(
+        s, "x", NP, chunk_bytes=16, interpret=True), jnp.asarray(xv),
+        out_specs=P("x"))
+    got = np.asarray(out).reshape(NP, NP * 13)
+    for row in got:
+        np.testing.assert_array_equal(row, xv)
+
+
+def test_remote_sendrecv_exchange(comm8):
+    xv = np.arange(NP * 4, dtype=np.float32)
+    out = comm8.run(lambda s: pallas_ici.remote_sendrecv(
+        s, "x", NP, src=2, dst=5, interpret=True), jnp.asarray(xv),
+        out_specs=P("x"))
+    got = np.asarray(out).reshape(NP, 4)
+    exp = xv.reshape(NP, 4).copy()
+    exp[[2, 5]] = exp[[5, 2]]        # src<->dst swap; others identity
+    np.testing.assert_array_equal(got, exp)
+
+
+# ---------------------------------------------------------------------------
+# tier dispatch + fallback observability
+# ---------------------------------------------------------------------------
+
+def test_planned_tier_reasons():
+    _reload(MV2T_ICI_INTERPRET="1", MV2T_DEV_TIER_VMEM_MAX="64",
+            MV2T_DEV_TIER_XLA_MIN="4096")
+    assert pallas_ici.planned_tier("allreduce", 64, np.float32,
+                                   "sum") == ("vmem", None)
+    assert pallas_ici.planned_tier("allreduce", 100, np.float32,
+                                   "sum") == ("hbm", None)
+    assert pallas_ici.planned_tier("allreduce", 8192, np.float32,
+                                   "sum") == ("xla", "size")
+    assert pallas_ici.planned_tier("allreduce", 100, np.float32,
+                                   "land") == ("xla", "dtype")
+    assert pallas_ici.planned_tier("allreduce", 100, np.complex64,
+                                   "sum") == ("xla", "dtype")
+    assert pallas_ici.planned_tier("allreduce", 0, np.float32,
+                                   "sum") == ("xla", "shape")
+    _reload(MV2T_ICI_INTERPRET=None)
+    if jax.devices()[0].platform != "tpu":
+        assert pallas_ici.planned_tier(
+            "allreduce", 100, np.float32, "sum") == ("xla", "platform")
+
+
+def test_default_tier_edges_cover_the_old_cliff():
+    """The acceptance bound: with compiled-in defaults (no profile
+    override), buffers past the 4 MiB VMEM cap plan the HBM-streaming
+    tier — never a silent XLA fallback."""
+    from mvapich2_tpu.coll import tuning
+    _reload(MV2T_DEV_TIER_VMEM_MAX=None, MV2T_DEV_TIER_XLA_MIN=None)
+    saved = dict(tuning._DEVICE_CROSSOVERS)
+    tuning._DEVICE_CROSSOVERS.clear()
+    try:
+        assert tuning.device_tier("allreduce", 4 * 1024 * 1024) == "vmem"
+        assert tuning.device_tier("allreduce", 4 * 1024 * 1024 + 1) \
+            == "hbm"
+        assert tuning.device_tier("allreduce", 1 << 30) == "hbm"
+        # a measured profile re-enters XLA above its crossover
+        tuning._DEVICE_CROSSOVERS["dev_tier_xla_min"] = 1 << 26
+        assert tuning.device_tier("allreduce", 1 << 27) == "xla"
+        # an explicit cvar outranks the measurement
+        _reload(MV2T_DEV_TIER_XLA_MIN="-1")
+        assert tuning.device_tier("allreduce", 1 << 27) == "hbm"
+    finally:
+        tuning._DEVICE_CROSSOVERS.clear()
+        tuning._DEVICE_CROSSOVERS.update(saved)
+
+
+def test_dispatcher_routes_hbm(comm8):
+    _reload(MV2T_ICI_INTERPRET="1", MV2T_DEV_TIER_VMEM_MAX="16",
+            MV2T_ICI_CHUNK_BYTES="32")
+    xv = (np.arange(NP * 16) % 7).astype(np.float32)   # shard 64 B > 16
+    out = comm8.run(lambda s: pallas_ici.ici_all_reduce(s, "x", NP),
+                    jnp.asarray(xv))
+    got = np.asarray(out).reshape(NP, -1)
+    exp = _expect(xv, "sum")
+    for row in got:
+        np.testing.assert_array_equal(row, exp)
+
+
+def test_vmem_reject_counts_fallback_pvar(comm8):
+    """The once-silent pallas_ring rejection now bumps the pvar family
+    (per traced shape)."""
+    before = mpit.pvar("dev_coll_fallback_shape").read()
+    xv = np.arange(NP * 5, dtype=np.float32)   # shard 5 % 8 != 0
+    out = comm8.run(lambda s: pallas_ring.ring_all_reduce(s, "x", NP),
+                    jnp.asarray(xv))
+    exp = _expect(xv, "sum")
+    np.testing.assert_array_equal(np.asarray(out).reshape(NP, -1)[0], exp)
+    assert mpit.pvar("dev_coll_fallback_shape").read() >= before + 1
