@@ -1,0 +1,18 @@
+/* churn_cycle.c — one connect/disconnect cycle through the C ABI:
+ * MPI_Init, MPI_Finalize, nothing else. The pure-churn shape stays on
+ * the light boot path end to end (no world build), which is exactly
+ * the session-setup cost a serving workload pays per connection.
+ * Pass any argument to add a 4-byte allreduce, forcing the deferred
+ * world build + lazy wire inside the cycle. Used by bin/bench_osu's
+ * churn measurement (mvapich2_tpu.bench.churn). */
+#include <mpi.h>
+
+int main(int argc, char **argv) {
+    MPI_Init(&argc, &argv);
+    if (argc > 1) {
+        int x = 1, y = 0;
+        MPI_Allreduce(&x, &y, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD);
+    }
+    MPI_Finalize();
+    return 0;
+}

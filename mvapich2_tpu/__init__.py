@@ -15,9 +15,33 @@ Layer map (mirrors SURVEY.md §1, re-targeted):
   L3  pt2pt/ + transport/     — protocols, matching, progress
   L2  transport channels      — local/tcp/shm + the ICI (XLA mesh) path
   L1  runtime/                — KVS bootstrap, launcher, config, logging
+
+Submodules load lazily (PEP 562): the C-ABI light boot path
+(mvapich2_tpu.cabi_boot) must import this package without paying for
+numpy or the protocol stack — ``MPI_Init`` through libmpi.so stays on a
+stdlib-only import graph until the first real MPI operation builds the
+world (README "Startup datapath").
 """
 
 from .version import VERSION as __version__
 
-from . import core, coll, pt2pt, transport, runtime, utils  # noqa: F401
-from .runtime.universe import run_ranks, local_universe  # noqa: F401
+_SUBMODULES = ("core", "coll", "pt2pt", "transport", "runtime", "utils",
+               "ops", "parallel", "models", "mpi", "mpit", "cshim",
+               "cabi_boot", "trace", "analysis", "faults", "ft", "rma",
+               "io", "ckpt", "bench", "profiles", "autotune", "debugger",
+               "profile", "run", "version")
+
+
+def __getattr__(name: str):
+    if name in ("run_ranks", "local_universe"):
+        from .runtime import universe as _uni
+        return getattr(_uni, name)
+    if name in _SUBMODULES:
+        import importlib
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_SUBMODULES)
+                  | {"run_ranks", "local_universe"})

@@ -1,0 +1,83 @@
+"""Sustained rank-churn benchmark: connect/disconnect cycles per second.
+
+The serving-scale startup scenario (ROADMAP item 3): jobs and sessions
+churn constantly, so the metric that matters is not one cold MPI_Init
+but how many full job lifecycles — launch, Init, (optional traffic),
+Finalize, reap — a node sustains per second. One launcher process runs
+N sequential jobs through runtime.launcher.launch, so the measured
+cycle is exactly the per-job cost: rank process spawn + light boot
+(+ world build when the program communicates) + teardown.
+
+Measured with MV2T_DAEMON=0 and 1, the delta is the warm-attach
+daemon's contribution (segment sets claimed from the node daemon
+instead of constructed per job). ``bin/bench_osu`` embeds the result
+in the BENCH_OSU artifact; ``python -m mvapich2_tpu.bench.churn`` is
+the standalone form; tests/test_daemon.py keeps a tier-1 smoke on it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+
+def churn_rate(argv: List[str], np_: int = 2, cycles: int = 8,
+               daemon: int = 0, env_extra: Optional[dict] = None,
+               timeout: float = 120.0) -> dict:
+    """Run ``argv`` as ``cycles`` sequential ``np_``-rank jobs; return
+    {"cps", "s_per_cycle", "per_cycle_s", ...}. Raises on any nonzero
+    job exit — a churn bench that drops cycles is not a benchmark."""
+    from ..runtime.launcher import launch
+    env = dict(env_extra or {})
+    env["MV2T_DAEMON"] = str(daemon)
+    per_cycle = []
+    for i in range(cycles):
+        t0 = time.perf_counter()
+        rc = launch(np_, list(argv), env_extra=env, timeout=timeout)
+        if rc != 0:
+            raise RuntimeError(
+                f"churn cycle {i} (daemon={daemon}) exited rc={rc}")
+        per_cycle.append(time.perf_counter() - t0)
+    total = sum(per_cycle)
+    return {"np": np_, "cycles": cycles, "daemon": daemon,
+            "cps": cycles / total if total else 0.0,
+            "s_per_cycle": total / cycles,
+            "min_s": min(per_cycle), "max_s": max(per_cycle),
+            "per_cycle_s": [round(s, 4) for s in per_cycle]}
+
+
+def _default_prog() -> List[str]:
+    """A python Init/Finalize cycle program (used when no compiled C
+    program is supplied — python ranks build the world at Init, so
+    this exercises the full attach-not-construct path)."""
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return [sys.executable,
+            os.path.join(repo, "tests", "progs", "churn_cycle_prog.py")]
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="connect/disconnect churn rate, daemon off vs on")
+    ap.add_argument("--np", type=int, default=2)
+    ap.add_argument("--cycles", type=int, default=8)
+    ap.add_argument("--prog", nargs="+", default=None,
+                    help="rank program argv (default: python "
+                         "Init/Finalize cycle prog)")
+    ap.add_argument("--daemon", choices=("0", "1", "both"),
+                    default="both")
+    a = ap.parse_args(argv)
+    prog = a.prog or _default_prog()
+    out = {}
+    for dm in ((0, 1) if a.daemon == "both" else (int(a.daemon),)):
+        out[f"daemon{dm}"] = churn_rate(prog, a.np, a.cycles, dm)
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

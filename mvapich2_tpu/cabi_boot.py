@@ -1,0 +1,166 @@
+"""Light C-ABI entry: what libmpi.so imports at MPI_Init.
+
+The embedded interpreter used to import the full shim (numpy + the
+whole protocol stack, ~400 ms) before MPI_Init could even start the
+KVS exchange. Now libmpi.c imports THIS module — stdlib-only — and
+``init()`` runs only the light boot (runtime/boot.py): KVS connect,
+one batched fence for node topology + init-time cards, leader segment
+provisioning (or a daemon warm-attach). World construction is deferred
+to the first MPI call that needs it.
+
+Dispatch contract: libmpi.c resolves every shim function with
+``PyObject_GetAttrString`` against this module. The calls a C program
+can legally make against an unbuilt world (rank/size of the
+predefined comms, Initialized/Finalized, Finalize, Abort, processor
+name) are implemented here from the BootState; everything else falls
+into ``__getattr__``, which builds the world (imports cshim — the one
+deferred heavy import) and forwards. tests/test_cabi.py guards that
+importing this module never pulls numpy/jax.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from .runtime import boot as _boot
+from .utils.config import get_config
+
+_lock = threading.RLock()
+_initialized = False
+_finalized = False
+_cshim = None                # the real shim, once the world is built
+
+
+def _ensure_world():
+    """Deferred world build: import the full shim and construct the
+    Universe from the BootState. Idempotent and thread-safe; every
+    forwarded attribute funnels through here."""
+    global _cshim
+    if _cshim is not None:
+        return _cshim
+    with _lock:
+        if _cshim is not None:
+            return _cshim
+        import sys
+        if sys.flags.no_site:
+            # libmpi.c embeds the interpreter with Py_NoSiteFlag (the
+            # light boot is stdlib-only); the heavy stack below needs
+            # site-packages (.pth processing), so run site now, once
+            import site
+            site.main()
+        from . import cshim as shim
+        if _initialized and not shim.initialized():
+            shim.adopt_boot()
+        _cshim = shim
+        return shim
+
+
+def __getattr__(name: str):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    return getattr(_ensure_world(), name)
+
+
+# ---------------------------------------------------------------------------
+# calls that must work against an unbuilt world
+# ---------------------------------------------------------------------------
+
+def init() -> int:
+    global _initialized
+    with _lock:
+        if _initialized:
+            return 0
+        # debugging aid (MV2_DEBUG-style): SIGUSR1 dumps all Python
+        # thread stacks of a rank — how a hung run is diagnosed
+        try:
+            import faulthandler
+            import signal as _sig
+            faulthandler.register(_sig.SIGUSR1, all_threads=True)
+        except (ImportError, AttributeError, ValueError):
+            pass
+        b = _boot.light_boot_from_env(cabi=True)
+        _initialized = True
+        if b is None \
+                or not int(get_config().get("LAZY_INIT", 1) or 0) \
+                or not int(get_config().get("LAZY_WIRING", 1) or 0):
+            # singletons/spawned children have no light path, and
+            # MV2T_LAZY_INIT=0 / MV2T_LAZY_WIRING=0 restore the eager
+            # build (bit-identical startup semantics)
+            _ensure_world()
+    return 0
+
+
+def initialized() -> int:
+    return 1 if _initialized else 0
+
+
+def finalized() -> int:
+    if _cshim is not None:
+        return _cshim.finalized()
+    return 1 if _finalized else 0
+
+
+def finalize() -> int:
+    global _finalized
+    with _lock:
+        if _finalized:
+            return 0
+        b = _boot.current_boot()
+        if _cshim is None and b is not None and not b.ft \
+                and not b.any_failed():
+            # world never built here: meet everyone at the finalize
+            # rendezvous; stay light when the whole job stayed light
+            b.finalized = True
+            if not _boot.finalize_rendezvous(b):
+                _boot.close_light(b)
+                _finalized = True
+                return 0
+            # a peer built: join the collective teardown
+        rc = _ensure_world().finalize()
+        _finalized = True
+        return rc
+
+
+def comm_rank(ch: int) -> int:
+    if _cshim is None:
+        b = _boot.current_boot()
+        if ch == 1:                 # MPI_COMM_SELF
+            return 0
+        if ch == 0:                 # MPI_COMM_WORLD
+            return b.rank if b is not None \
+                else int(os.environ.get("MV2T_RANK", "0"))
+    return _ensure_world().comm_rank(ch)
+
+
+def comm_size(ch: int) -> int:
+    if _cshim is None:
+        b = _boot.current_boot()
+        if ch == 1:
+            return 1
+        if ch == 0:
+            return b.size if b is not None \
+                else int(os.environ.get("MV2T_SIZE", "1"))
+    return _ensure_world().comm_size(ch)
+
+
+def get_processor_name() -> str:
+    b = _boot.current_boot()
+    if b is not None:
+        return b.nodekey
+    import socket
+    return socket.gethostname()
+
+
+def abort(ch: int, code: int) -> int:
+    """Best-effort job kill, world or no world: broadcast the abort
+    event through the KVS (the launcher watches it) and exit hard."""
+    if _cshim is not None:
+        return _cshim.abort(ch, code)
+    b = _boot.current_boot()
+    if b is not None:
+        try:
+            b.kvs.abort(f"rank {b.rank} called MPI_Abort({code})")
+        except Exception:
+            pass
+    os._exit(code if 0 < code < 256 else 1)

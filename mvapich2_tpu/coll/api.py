@@ -83,6 +83,13 @@ def _plane_engine(comm):
     if pch is None or not pch.plane or comm.is_inter \
             or not getattr(comm, "_plane_owned", False):
         return None
+    if not pch._wired and comm.size > 1:
+        # lazy-wiring gate: tier choice (flat wave vs schedule vs
+        # arena) consults the unanimous node agreement, and EVERY
+        # member must reach the same verdict or the collective
+        # deadlocks across tiers. A collective is the safe place to
+        # block: all members are known to arrive.
+        pch.ensure_wired()
     # graceful tier degradation (failure containment): once this comm is
     # revoked or has a failed member, the python tier owns the operation
     # — its ULFM semantics raise MPIX_ERR_PROC_FAILED/REVOKED uniformly

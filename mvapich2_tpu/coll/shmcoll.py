@@ -590,6 +590,13 @@ def _node_exchange_ctx(comm):
         return None
     if comm.size < 2:
         return None
+    if comm.u.failed_ranks:
+        # any known failure stands the arena tier down (the flat tier's
+        # cp_any_failed discipline): survivors may hold divergent
+        # post-failure wire verdicts, and a mixed arena/schedule
+        # collective deadlocks. The schedule tiers carry ULFM errors
+        # uniformly.
+        return None
     shmem, _ = comm.build_2level()
     if shmem is None or shmem.size != comm.size:
         return None
@@ -598,6 +605,11 @@ def _node_exchange_ctx(comm):
         return None
     ch = getattr(comm.u, "shm_channel", None)
     if ch is not None:
+        if not ch._wired:
+            # lazy-wiring gate: the arena tier rides the unanimous
+            # node agreement; all members of this collective arrive,
+            # so blocking here is safe (see coll/api._plane_engine)
+            ch.ensure_wired()
         arena = ch.arena if getattr(ch, "_arena_ready", False) else None
         cma_ok = bool(getattr(ch, "cma_ok", False))
     else:

@@ -777,6 +777,14 @@ class Comm:
         if (pc is None or not pc.plane or self.is_inter
                 or not self._plane_owned or self.size > 64):
             return None
+        if not pc._wired and self.size > 1:
+            # lazy-wiring gate: cp_coll_gather parks in C, where this
+            # rank's wiring cards would never publish — and a peer
+            # blocked in ITS wire gate (e.g. a sub-comm collective)
+            # may be waiting on exactly those cards. A comm-management
+            # collective is a safe blocking point (all members arrive),
+            # and ensure_wired publishes before it waits.
+            pc.ensure_wired()
         payload = np.ascontiguousarray(payload).view(np.uint8).reshape(-1)
         paysz = payload.nbytes
         cap = pc.plane_eager_max()

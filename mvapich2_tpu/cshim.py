@@ -391,6 +391,23 @@ def init() -> int:
     return 0
 
 
+def adopt_boot() -> int:
+    """Deferred world build for a light-booted C-ABI rank
+    (mvapich2_tpu.cabi_boot): MPI_Init already ran the light boot; the
+    first forwarded call lands here to construct the Universe. Same
+    body as init() minus the signal hook (cabi_boot installed it)."""
+    global _cabi_process
+    _cabi_process = True
+    if get_config().get("CSHIM_PROFILE", ""):
+        import cProfile
+        global _profiler
+        if _profiler is None:
+            _profiler = cProfile.Profile()
+            _profiler.enable()
+    mpi.Init()
+    return 0
+
+
 _profiler = None
 
 

@@ -66,6 +66,31 @@ def Finalized() -> bool:
 
 def Finalize() -> None:
     u = _uni.current_universe()
+    from .runtime import boot as _boot
+    b = _boot.current_boot()
+    if b is not None and not b.finalized:
+        b.finalized = True
+        if b.ft or b.any_failed() or (u is not None and u.failed_ranks):
+            # FT/failed worlds skip the rendezvous fence (dead ranks
+            # would hang it) and keep the pre-lazy semantics: build if
+            # needed, then the ULFM-aware quiesce below
+            if u is None:
+                from .runtime.bootstrap import build_world
+                u = build_world(b)
+                _uni.set_universe(u, process_wide=True)
+        else:
+            built_somewhere = _boot.finalize_rendezvous(b)
+            if u is None and not built_somewhere:
+                # pure Init/Finalize churn: the whole job stayed light —
+                # teardown is a KVS close, no world ever constructed
+                _boot.close_light(b)
+                return
+            if u is None:
+                # a peer built a world: join the collective teardown so
+                # its quiesce barrier completes
+                from .runtime.bootstrap import build_world
+                u = build_world(b)
+                _uni.set_universe(u, process_wide=True)
     if u is None:
         return
     # quiesce: complete outstanding traffic before teardown. A revoked

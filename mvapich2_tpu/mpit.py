@@ -354,6 +354,13 @@ pvar("dev_coll_tier_hbm", PVAR_CLASS_COUNTER, "device",
 
 
 # ---------------------------------------------------------------------------
-# the autotuner lives beside MPI_T (tools space): mpit.autotune
+# the autotuner lives beside MPI_T (tools space): mpit.autotune —
+# re-exported lazily (PEP 562): it imports numpy, and this module sits
+# on the C-ABI light boot path (faults -> mpit), which must stay
+# stdlib-only until the deferred world build
 # ---------------------------------------------------------------------------
-from . import autotune  # noqa: E402  (re-export: mpit.autotune.profile_comm)
+def __getattr__(name: str):
+    if name == "autotune":
+        from . import autotune
+        return autotune
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,2 +1,20 @@
-from . import universe
-from .universe import Universe, current_universe, local_universe, run_ranks
+"""Runtime layer (KVS bootstrap, launcher, universe).
+
+Lazy exports (PEP 562): the C-ABI light boot path imports
+``runtime.boot`` / ``runtime.kvs`` and must not drag in the universe
+(protocol stack + numpy) before the first real MPI operation.
+"""
+
+_EXPORTS = ("Universe", "current_universe", "local_universe", "run_ranks")
+
+
+def __getattr__(name: str):
+    if name in _EXPORTS or name == "universe":
+        import importlib
+        universe = importlib.import_module(".universe", __name__)
+        return universe if name == "universe" else getattr(universe, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS) | {"universe"})

@@ -1,0 +1,413 @@
+"""Light bootstrap: the stdlib-only first phase of MPI_Init.
+
+The fast-startup datapath splits rank initialization in two:
+
+  * **light boot** (this module, run inside ``MPI_Init``): connect to
+    the KVS, exchange node topology and the init-time business cards in
+    ONE fence message (the batched PMI exchange), and — on each node's
+    leader — create (or warm-attach from the node daemon,
+    ``runtime/daemon.py``) the raw shared-memory segment files, so any
+    rank can later map them without cross-rank ordering. Nothing here
+    may import numpy or the protocol stack: the whole point is that
+    ``MPI_Init`` through the C ABI stays on a stdlib import graph
+    (tests/test_cabi.py guards it).
+
+  * **world build** (``runtime/bootstrap.py``), deferred to the first
+    real MPI operation for C-ABI ranks: constructs the Universe,
+    channels and protocol layer from the BootState — fence-free, so
+    ranks can build at different times (the reference's on-demand
+    connection-manager model, lifted one level up).
+
+The per-node segment *content* handshake (CMA/arena/flat agreement) is
+deferred further still — per-channel, to the first send/recv or
+collective that needs it (``ShmChannel.ensure_wired``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+from typing import Dict, List, Optional, Set
+
+from ..utils.config import cvar, get_config
+from ..utils.mlog import get_logger
+from .kvs import KVSClient
+
+log = get_logger("boot")
+
+cvar("LAZY_WIRING", 1, int, "shm",
+     "Defer per-peer shm wiring (CMA/arena/flat agreement, bells) to "
+     "the first operation that needs it, the reference's on-demand CM "
+     "model. 0 restores eager wiring at world build. Observable via "
+     "the wiring_eager/wiring_lazy pvars.")
+cvar("LAZY_INIT", 1, int, "runtime",
+     "C-ABI ranks: defer world construction (numpy + protocol stack) "
+     "past MPI_Init to the first real MPI operation. 0 restores the "
+     "eager build (today's ~0.5 s MPI_Init).")
+cvar("DAEMON", 0, int, "runtime",
+     "Warm-attach startup: node leaders claim pre-provisioned shm "
+     "segment sets (ring/flags/flat/arena) from the per-node daemon "
+     "(runtime/daemon.py) instead of constructing them, and release "
+     "them at Finalize for the next job. 0 (default) = construct "
+     "per-job segments exactly as before.")
+# Declared here as well as next to their owning code (idempotent): the
+# light boot path sizes segment files before transport/shm.py or
+# transport/arena.py are ever imported, and the env override must be
+# honored on BOTH paths or the leader and a follower would disagree on
+# the segment geometry.
+cvar("SHM_RING_BYTES", 0, int, "shm",
+     "Per-(src,dst)-pair ring size in bytes (analog of "
+     "MV2_SMP_QUEUE_LENGTH). 0 = auto: sized by co-located rank count "
+     "(4 MiB for <=2, 2 MiB for <=4, 1 MiB beyond) so a 64-deep window "
+     "of eager-size payloads stays in flight without backpressure.")
+cvar("ARENA_BYTES", 0, int, "shm",
+     "Per-rank partition size of the persistent per-node scratch arena "
+     "in bytes; 0 = auto by co-located rank count (see "
+     "transport/arena.py, the owning declaration).")
+
+# Version of the light-boot card protocol. A leader publishes it with
+# its segment card; a follower that reads a different version ignores
+# the pre-created segments and falls back to the legacy construct-
+# at-build path — so mixed-version jobs degrade instead of mis-mapping.
+BOOT_PROTO_VERSION = 1
+
+# flags-segment layout (mirrors transport/shm.py _LEASE_ALIGN /
+# _LEASE_STAMP and native/shm_layout.h — the mv2tlint native pass pins
+# the C side; boot only needs the total length to size the raw file)
+_LEASE_ALIGN = 8
+_LEASE_STAMP = 8
+
+
+def flags_len(n_local: int) -> int:
+    lease_off = (n_local + _LEASE_ALIGN - 1) & ~(_LEASE_ALIGN - 1)
+    return lease_off + _LEASE_STAMP * n_local
+
+
+def auto_ring_bytes(n_local: int) -> int:
+    """Deterministic per-pair ring size (the vbuf-pool sizing
+    discipline; see the SHM_RING_BYTES cvar in transport/shm.py): every
+    rank computes the same segment layout from n_local alone."""
+    ring = int(get_config().get("SHM_RING_BYTES", 0) or 0)
+    if not ring:
+        if n_local <= 2:
+            ring = 4 << 20
+        elif n_local <= 4:
+            ring = 2 << 20
+        else:
+            ring = 1 << 20
+    return (ring + 7) & ~7
+
+
+def auto_part_bytes(n_local: int) -> int:
+    """Arena partition size (mirrors transport/arena.py)."""
+    part = int(get_config().get("ARENA_BYTES", 0) or 0)
+    if not part:
+        if n_local <= 2:
+            part = 256 << 20
+        elif n_local <= 4:
+            part = 128 << 20
+        else:
+            part = 32 << 20
+    return (part + 4095) & ~4095
+
+
+def shm_base_dir() -> str:
+    if os.path.isdir("/dev/shm"):
+        return "/dev/shm"
+    import tempfile
+    return tempfile.gettempdir()
+
+
+def write_zeros(fd: int, total: int) -> None:
+    """Pre-allocate a segment file's pages (not ftruncate-sparse): the
+    ring is written by the datapath's hot loops, and a sparse file
+    pays a page fault per 4 KiB inside the timed benchmark window
+    until the ring first wraps (measured: up to -40% small-size
+    osu_bw). Allocating here keeps the cost inside MPI_Init — the
+    same place sr_attach(create=1)'s memset used to pay it — and
+    posix_fallocate allocates (zeroed) tmpfs pages ~5x faster than
+    writing them (~1.5 ms vs ~7 ms for a 16 MiB np2 segment)."""
+    try:
+        os.posix_fallocate(fd, 0, total)
+        return
+    except (AttributeError, OSError):
+        pass
+    chunk = b"\0" * (1 << 20)
+    left = total
+    while left > 0:
+        n = min(left, len(chunk))
+        os.write(fd, chunk if n == len(chunk) else chunk[:n])
+        left -= n
+
+
+class BootState:
+    """Everything the deferred world build needs, gathered by light
+    boot. Also the pre-world sink for launcher failure events."""
+
+    def __init__(self, rank: int, size: int, kvs: KVSClient,
+                 kvs_addr: str, nodekey: str):
+        self.rank = rank
+        self.size = size
+        self.kvs = kvs
+        self.kvs_addr = kvs_addr
+        self.nodekey = nodekey
+        self.node_ids: List[int] = []
+        self.node_name_to_id: Dict[str, int] = {}
+        self.local_ranks: List[int] = []
+        self.leader: Optional[int] = None
+        self.cabi = False
+        self.ft = False
+        # leader's segment card for my node (None: no shm / old proto)
+        self.seg_card: Optional[dict] = None
+        self.daemon_claim = None          # runtime.daemon.Claim on leader
+        # pre-world failure sink: the FT watcher records here until the
+        # universe exists, then replays (guarded-by: _lock)
+        self.failed: Set[int] = set()
+        self._lock = threading.Lock()
+        self.universe = None
+        self.world_built = False
+        self.finalized = False
+
+    # -- failure plumbing -------------------------------------------------
+    def mark_failed(self, dead: int) -> None:
+        with self._lock:
+            self.failed.add(dead)
+            u = self.universe
+        if u is not None:
+            u.mark_failed(dead)
+
+    def any_failed(self) -> bool:
+        with self._lock:
+            return bool(self.failed)
+
+    def adopt_universe(self, u) -> None:
+        """World build done: replay pre-world failure events into the
+        ULFM sink and route future ones straight through."""
+        with self._lock:
+            self.universe = u
+            self.world_built = True
+            pending = set(self.failed)
+        for dead in pending:
+            u.mark_failed(dead)
+
+    def is_local(self, r: int) -> bool:
+        return self.node_ids[r] == self.node_ids[self.rank]
+
+
+_current: Optional[BootState] = None
+
+
+def current_boot() -> Optional[BootState]:
+    return _current
+
+
+def set_boot(b: Optional[BootState]) -> None:
+    global _current
+    _current = b
+
+
+def _make_raw_segments(boot: BootState, n_local: int) -> dict:
+    """Leader: provision the node's segment files. With MV2T_DAEMON,
+    warm-attach a reset set from the node daemon (versioned manifest
+    handshake); otherwise create fresh zero-filled files. Either way
+    the files exist and are fully zeroed when the card is published, so
+    any rank attaches without ordering on the leader's world build."""
+    ring_bytes = auto_ring_bytes(n_local)
+    card = {"v": BOOT_PROTO_VERSION, "n_local": n_local,
+            "ring_bytes": ring_bytes, "daemon": 0}
+    if int(get_config().get("DAEMON", 0) or 0):
+        from . import daemon
+        claim = daemon.claim(n_local, ring_bytes,
+                             auto_part_bytes(n_local))
+        if claim is not None:
+            boot.daemon_claim = claim
+            card.update({"daemon": 1, "ring": claim.ring,
+                         "flags": claim.flags, "flat": claim.flat,
+                         "arena": claim.arena,
+                         "part_bytes": claim.part_bytes,
+                         "geokey": claim.geokey, "epoch": claim.epoch})
+            return card
+        log.info("MV2T_DAEMON=1 but no claimable daemon set; "
+                 "constructing fresh segments")
+    base = shm_base_dir()
+    import uuid
+    stem = os.path.join(
+        base, f"mv2t-shm-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+    total = n_local * n_local * ring_bytes
+    fd = os.open(stem, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+    write_zeros(fd, total)
+    os.close(fd)
+    fpath = stem + ".flags"
+    with open(fpath + ".tmp", "wb") as f:
+        f.write(b"\0" * flags_len(n_local))
+    os.replace(fpath + ".tmp", fpath)   # followers never see a short file
+    card.update({"ring": stem, "flags": fpath, "flat": stem + ".fcoll"})
+    return card
+
+
+def light_boot_from_env(cabi: bool = False) -> Optional[BootState]:
+    """Phase one of MPI_Init. Returns None for singleton init (no KVS:
+    the caller takes the legacy full-bootstrap path). Idempotent —
+    a second call returns the existing BootState."""
+    global _current
+    if _current is not None:
+        return _current
+    if "MV2T_RANK" in os.environ:
+        rank = int(os.environ["MV2T_RANK"])
+        size = int(os.environ.get("MV2T_SIZE", "1"))
+    else:
+        from .rm import detect_rm_rank
+        rm = detect_rm_rank()
+        rank, size = rm if rm is not None else (0, 1)
+    kvs_addr = os.environ.get("MV2T_KVS")
+    if kvs_addr is None or os.environ.get("MV2T_WORLD_BASE") is not None:
+        # singleton (no KVS) and spawned children keep their dedicated
+        # bootstrap paths — both are rare and neither is init-latency
+        # critical
+        return None
+    get_config().reload()
+    if os.environ.get("MV2T_" + "FAULTS"):
+        # arm the fault engine before the first KVS traffic so the
+        # bootstrap-exchange injection sites (kvs, wire) can fire.
+        # Import-gated on the env var: the engine is a no-op without a
+        # spec, and its import costs ~25 ms of MPI_Init on the 1-core
+        # bench host (world build re-runs configure unconditionally).
+        from .. import faults
+        faults.configure(rank)
+
+    kvs = KVSClient(kvs_addr)
+    nodekey = os.environ.get("MV2T_FAKE_NODE", socket.gethostname())
+    boot = BootState(rank, size, kvs, kvs_addr, nodekey)
+    boot.cabi = cabi
+    boot.ft = os.environ.get("MV2T_FT") == "1"
+
+    # ONE fence message carries this rank's init-time cards (node key +
+    # ABI flavor); its release implies every rank's cards are readable
+    kvs.fence("__boot", cards={
+        f"node-{rank}": nodekey,
+        f"shm-cabi-{rank}": "1" if cabi else "0",
+    })
+    names = kvs.get_many([f"node-{r}" for r in range(size)])
+    ids: Dict[str, int] = {}
+    boot.node_ids = [ids.setdefault(n, len(ids)) for n in names]
+    boot.node_name_to_id = ids
+    me = boot.node_ids[rank]
+    boot.local_ranks = [r for r in range(size) if boot.node_ids[r] == me]
+    boot.leader = boot.local_ranks[0] if len(boot.local_ranks) > 1 else None
+
+    if boot.leader == rank:
+        try:
+            card = _make_raw_segments(boot, len(boot.local_ranks))
+            boot.seg_card = card
+            kvs.put(f"shm-boot-{rank}", json.dumps(card))
+        except Exception as e:
+            log.warn("light segment provisioning failed (%s); channel "
+                     "construction will create its own", e)
+            kvs.put(f"shm-boot-{rank}", "")
+
+    if boot.ft and os.environ.get("MV2T_FT_WATCHER", "1") != "0":
+        _start_failure_watcher(boot)
+    _current = boot
+    return boot
+
+
+def finalize_rendezvous(boot: BootState) -> bool:
+    """The symmetric half of MPI_Finalize for lazily-built worlds:
+    every original-world rank — built or not — meets at one KVS fence,
+    then checks whether ANY rank built a world. True: the caller must
+    (build and) run the collective teardown so built peers' quiesce
+    barrier completes. False: the whole job stayed light (pure
+    Init/Finalize churn) and teardown is a KVS close.
+
+    FT jobs never take this path (dead ranks would hang the fence);
+    the caller builds unconditionally there and the ULFM layer owns
+    teardown semantics, exactly as before."""
+    try:
+        boot.kvs.fence("__fin")
+        vals = boot.kvs.peek_many(
+            [f"__built-{r}" for r in range(boot.size)])
+        return any(v is not None for v in vals)
+    except Exception:
+        # KVS gone (aborting launcher): fall back to local knowledge
+        return boot.world_built
+
+
+def close_light(boot: BootState) -> None:
+    """Teardown for a rank whose world was never built: release the
+    warm-attach claim (the built path releases through ShmChannel.close)
+    and drop the segment files this leader provisioned for a world
+    nobody constructed."""
+    boot.finalized = True
+    if boot.daemon_claim is not None:
+        from . import daemon
+        daemon.release(boot.daemon_claim)
+        boot.daemon_claim = None
+    elif boot.seg_card is not None and boot.leader == boot.rank:
+        for k in ("ring", "flags", "flat"):
+            p = boot.seg_card.get(k)
+            if p:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+    try:
+        boot.kvs.close()
+    except Exception:
+        pass
+
+
+def leader_seg_card(boot: BootState) -> Optional[dict]:
+    """The node leader's segment card, fetched once (followers).
+    Returns None when the leader provisioned nothing or speaks a
+    different boot protocol version."""
+    if boot.leader is None:
+        return None
+    if boot.seg_card is not None:
+        return boot.seg_card
+    try:
+        raw = boot.kvs.get(f"shm-boot-{boot.leader}")
+    except Exception:
+        return None
+    if not raw:
+        return None
+    try:
+        card = json.loads(raw)
+    except ValueError:
+        return None
+    if card.get("v") != BOOT_PROTO_VERSION:
+        log.warn("leader segment card version %s != %s; falling back to "
+                 "legacy segment construction", card.get("v"),
+                 BOOT_PROTO_VERSION)
+        return None
+    boot.seg_card = card
+    return card
+
+
+def _start_failure_watcher(boot: BootState) -> None:
+    """FT mode: a daemon thread blocks on launcher-published failure
+    events (__failure_ev_N keys) and feeds them into the boot sink —
+    which forwards to the ULFM layer once the world is built. Own KVS
+    connection, so blocking gets don't serialize with bootstrap."""
+
+    def watch():
+        try:
+            # no socket timeout: a healthy job may run arbitrarily long
+            # between failure events (or see none at all)
+            w = KVSClient(boot.kvs_addr, timeout=None)
+            n = 0
+            while True:
+                dead = int(w.get(f"__failure_ev_{n}"))   # blocks until put
+                boot.mark_failed(dead)
+                n += 1
+        except (OSError, ConnectionError, KeyError):
+            # KVS gone = job tearing down; a KeyError is the server
+            # unparking a blocked get because the job aborted
+            pass
+        except Exception as e:   # anything else disables detection: say so
+            log.error("failure watcher died: %r — process failures will "
+                      "no longer be detected on this rank", e)
+
+    threading.Thread(target=watch, daemon=True,
+                     name="ft-failure-watcher").start()

@@ -1,11 +1,21 @@
 """Process-mode bootstrap: build this rank's Universe from the environment.
 
-The analog of MPID_Init's InitPG + address exchange (SURVEY §3.1): the
-launcher exports MV2T_RANK / MV2T_SIZE / MV2T_KVS, ranks publish their
-channel addresses ("business cards") to the KVS, fence, and wire up
-channels. Node topology is derived by exchanging host names — the analog of
-MPIDI_Populate_vc_node_ids (mpid_init.c:373) — so the SMP/2-level paths know
-which ranks are co-located.
+The analog of MPID_Init's InitPG + address exchange (SURVEY §3.1), split
+in two for fast startup (README "Startup datapath"):
+
+  * **light boot** (runtime/boot.py): the launcher exports MV2T_RANK /
+    MV2T_SIZE / MV2T_KVS; ranks exchange node topology + init-time cards
+    in ONE batched KVS fence and the node leader provisions raw segment
+    files (or warm-attaches them from the node daemon). Stdlib-only.
+  * **world build** (here): construct the Universe, channels and
+    protocol layer from the BootState — fence-free, so C-ABI ranks can
+    defer it past MPI_Init to their first real MPI operation
+    (mvapich2_tpu.cabi_boot) while python ranks build inside Init.
+
+Node topology derivation is the analog of MPIDI_Populate_vc_node_ids
+(mpid_init.c:373); per-peer shm wiring is deferred further still, to
+the first operation that needs the per-node agreement
+(transport/shm.py ensure_wired — the on-demand CM model).
 """
 
 from __future__ import annotations
@@ -16,6 +26,7 @@ from typing import List, Optional
 
 from ..utils.config import get_config
 from ..utils.mlog import get_logger
+from . import boot as bootmod
 from .kvs import KVSClient
 from .universe import Universe
 
@@ -23,31 +34,27 @@ log = get_logger("bootstrap")
 
 
 def bootstrap_from_env() -> Universe:
-    if "MV2T_RANK" in os.environ:
-        rank = int(os.environ["MV2T_RANK"])
-        size = int(os.environ.get("MV2T_SIZE", "1"))
-    else:
-        # resource-manager adapters: Slurm/PBS/PMI task env (srun'd
-        # ranks carry identity without our launcher; runtime/rm.py)
-        from .rm import detect_rm_rank
-        rm = detect_rm_rank()
-        rank, size = rm if rm is not None else (0, 1)
-    kvs_addr = os.environ.get("MV2T_KVS")
-    get_config().reload()
-    # arm the fault engine before the first KVS traffic so the
-    # bootstrap-exchange injection site (kvs) can fire; Universe.
-    # initialize re-runs configure (idempotent) for the local harness
-    from .. import faults
-    faults.configure(rank)
-
-    if os.environ.get("MV2T_WORLD_BASE") is not None and kvs_addr:
-        return _bootstrap_spawned(rank, size, kvs_addr)
-
-    if kvs_addr is None:
+    boot = bootmod.current_boot()
+    if boot is None:
+        boot = bootmod.light_boot_from_env()
+    if boot is None:
+        # dedicated paths light boot declines: spawned children and
+        # KVS-less singletons
+        kvs_addr = os.environ.get("MV2T_KVS")
+        if os.environ.get("MV2T_WORLD_BASE") is not None and kvs_addr:
+            rank = int(os.environ["MV2T_RANK"])
+            size = int(os.environ.get("MV2T_SIZE", "1"))
+            get_config().reload()
+            from .. import faults
+            faults.configure(rank)
+            return _bootstrap_spawned(rank, size, kvs_addr)
         # singleton init (mpiexec-less a.out, like MPICH singleton PMI).
         # An np=1 job launched by mpirun still takes the KVS path below:
         # it has a live KVS, so MPI_Comm_spawn / ports work from it
         # (spawn1.c runs np=1 and spawns children).
+        get_config().reload()
+        from .. import faults
+        faults.configure(0)
         from ..transport.local import LocalChannel, LocalFabric
         u = Universe(0, 1)
         fabric = LocalFabric(1)
@@ -55,42 +62,35 @@ def bootstrap_from_env() -> Universe:
         fabric.register(0, u.engine)
         u.initialize()
         return u
+    return build_world(boot)
 
-    kvs = KVSClient(kvs_addr)
-    # node topology: exchange host identifiers. MV2T_FAKE_NODE lets tests
-    # emulate multi-node placement on one host.
-    nodekey = os.environ.get("MV2T_FAKE_NODE", socket.gethostname())
-    kvs.put(f"node-{rank}", nodekey)
-    kvs.fence()
-    names = [kvs.get(f"node-{r}") for r in range(size)]
-    ids: dict = {}
-    node_ids: List[int] = []
-    for n in names:
-        node_ids.append(ids.setdefault(n, len(ids)))
 
-    u = Universe(rank, size, node_ids)
-    u.node_name_to_id = ids
-    u.kvs = kvs
+def build_world(boot: bootmod.BootState) -> Universe:
+    """Phase two: the fence-free world build. Publishes this rank's
+    build cards (channel addresses, CMA probe, arena card) in one
+    batched put and marks the rank built — peers' lazy wiring and the
+    Finalize rendezvous key off these."""
+    u = Universe(boot.rank, boot.size, boot.node_ids)
+    u.node_name_to_id = boot.node_name_to_id
+    u.kvs = boot.kvs
     # CPU binding (hwloc_bind.c analog): bind by node-local rank so
     # co-located ranks take disjoint core slices
     from ..utils.affinity import bind_among
-    bind_among(node_ids, rank)
-    _wire_channels(u, kvs)
-    kvs.fence()   # everyone's business cards are published
-    if u.shm_channel is not None:
-        u.shm_channel.finish_wiring()
+    bind_among(boot.node_ids, boot.rank)
+    _wire_channels(u, boot.kvs, boot)
     u.initialize()
-
-    if os.environ.get("MV2T_FT") == "1" \
-            and os.environ.get("MV2T_FT_WATCHER", "1") != "0":
-        # MV2T_FT_WATCHER=0: chaos tests disable the launcher-event
-        # watcher so a passing run proves the liveness LEASES detected
-        # the death, not the launcher
-        _start_failure_watcher(u, kvs_addr)
+    boot.kvs.put(f"__built-{boot.rank}", "1")
+    boot.adopt_universe(u)
+    if not int(get_config().get("LAZY_WIRING", 1) or 0) \
+            and u.shm_channel is not None:
+        # eager mode: today's semantics — the wire completes inside
+        # Init (every rank builds at Init in this mode, so the blocking
+        # gate sees all cards promptly)
+        u.shm_channel.finish_wiring()
     return u
 
 
-def _wire_channels(u: Universe, kvs) -> None:
+def _wire_channels(u: Universe, kvs, boot=None) -> None:
     """Default tcp channel + shm fast path for co-located ranks (shared by
     the original-world and spawned-child bootstrap paths)."""
     from ..transport.tcp import TcpChannel
@@ -101,7 +101,11 @@ def _wire_channels(u: Universe, kvs) -> None:
         local = [r for r in u.world_ranks
                  if u.node_ids[r] == u.node_ids[pid]]
         if len(local) > 1:
-            shm = ShmChannel(pid, local, kvs)
+            card = bootmod.leader_seg_card(boot) if boot is not None \
+                else None
+            claim = boot.daemon_claim if boot is not None else None
+            shm = ShmChannel(pid, local, kvs, boot_card=card,
+                             daemon_claim=claim)
             for r in local:
                 if r != pid:
                     u.set_channel(r, shm)
@@ -118,7 +122,9 @@ def _bootstrap_spawned(local: int, size: int, kvs_addr: str) -> Universe:
     the sibling group; the parent intercomm is reconstructed from the
     deterministic spawn envelope (ctx + parent group ids in the env) —
     the mpid_comm_spawn_multiple.c:46 parent/child port handshake collapses
-    to env plumbing because both sides already share the KVS."""
+    to env plumbing because both sides already share the KVS. Children
+    keep the eager build + eager wire: spawn worlds are rare and their
+    named fences already order the exchange."""
     import json
 
     from ..core.group import Group
@@ -131,9 +137,9 @@ def _bootstrap_spawned(local: int, size: int, kvs_addr: str) -> Universe:
 
     kvs = KVSClient(kvs_addr)
     nodekey = os.environ.get("MV2T_FAKE_NODE", socket.gethostname())
-    kvs.put(f"node-{pid}", nodekey)
-    kvs.fence(group=f"spawn-{base}", count=size)
-    names = [kvs.get(f"node-{r}") for r in range(base + size)]
+    kvs.fence(group=f"spawn-{base}", count=size,
+              cards={f"node-{pid}": nodekey})
+    names = kvs.get_many([f"node-{r}" for r in range(base + size)])
     ids: dict = {}
     node_ids: List[int] = [ids.setdefault(n, len(ids)) for n in names]
 
@@ -168,11 +174,12 @@ def _bootstrap_spawned(local: int, size: int, kvs_addr: str) -> Universe:
 
 
 def _start_failure_watcher(u: Universe, kvs_addr: str) -> None:
-    """FT mode: a daemon thread blocks on launcher-published failure events
-    (__failure_ev_N keys) and feeds them into the ULFM detection sink —
-    the analog of mpispawn noticing dead children and PMI reporting them
-    (SURVEY §5.3). Uses its own KVS connection so blocking gets don't
-    serialize with the rank's bootstrap client."""
+    """FT mode (spawned children — the original world's watcher lives in
+    runtime/boot.py): a daemon thread blocks on launcher-published
+    failure events (__failure_ev_N keys) and feeds them into the ULFM
+    detection sink — the analog of mpispawn noticing dead children and
+    PMI reporting them (SURVEY §5.3). Uses its own KVS connection so
+    blocking gets don't serialize with the rank's bootstrap client."""
     import threading
 
     def watch():

@@ -1,0 +1,397 @@
+"""Warm-attach node daemon: shm segment sets that outlive jobs.
+
+The attach-not-construct startup model (the process-in-process
+multi-object blueprint, PAPERS.md): serving-scale traffic churns MPI
+worlds constantly, so per-node state that every job rebuilds —
+the shm ring segment, the flags/lease segment, the flat-collective
+segment, the scratch arena — is instead kept alive by a persistent
+per-node daemon. A new job's node leader *claims* a pre-provisioned,
+pre-zeroed segment set (one flock'd manifest transaction) and releases
+it at Finalize for the next job.
+
+Protocol (filesystem only, no sockets — a claim must survive a dead
+daemon and a dead claimer):
+
+  <dir>/manifest.json     {"version", "daemon_pid", "sets": {geokey:
+                           {"state": free|busy, "epoch", "owner_pid",
+                            "files": {...}, "sizes": {...}}}}
+  <dir>/manifest.lock     flock serializing every manifest transaction
+  <dir>/<geokey>.{ring,flags,flat,arena}
+
+* **versioned handshake**: manifest version + the geometry key
+  (``n<local>-r<ring_bytes>-p<part_bytes>``) must match exactly or the
+  claim fails and the job constructs private segments (bit-identical
+  to MV2T_DAEMON=0).
+* **epoch**: bumped on every claim; travels in the leader's boot card
+  so every attacher of a set agrees on which incarnation it maps.
+* **stale-epoch sweep**: a busy set whose owner pid is dead is
+  reclaimed — at the next claim, and by the daemon's sweep loop, which
+  also rides the existing arena sweep (``ShmArena.sweep_stale``) to
+  clean legacy per-job segments of crashed jobs.
+* **reset**: a claim truncates every file to zero and back to size —
+  O(resident pages) on tmpfs — so stale ring heads / flat seq stamps /
+  spill counters from the previous epoch can never be read as live
+  protocol state.
+
+Module import stays stdlib-only: ``claim``/``release`` run inside
+MPI_Init's light boot (tests/test_cabi.py guards the import graph).
+The serve loop may import heavier modules lazily — it runs in its own
+process, never on a rank's init path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import time
+from typing import Dict, Optional
+
+from ..utils.config import cvar, get_config
+from ..utils.mlog import get_logger
+
+log = get_logger("daemon")
+
+cvar("DAEMON_DIR", "", str, "runtime",
+     "Directory holding the warm-attach daemon's manifest and segment "
+     "sets. Empty = /dev/shm/mv2t-daemon-<uid> (tmpdir fallback).")
+cvar("DAEMON_IDLE_S", 600.0, float, "runtime",
+     "Serve loop: exit after this many seconds with no busy set, "
+     "unlinking free sets. 0 = never exit.")
+cvar("DAEMON_SPAWN", 1, int, "runtime",
+     "Auto-spawn the serve loop from the first claim when none is "
+     "running. 0 = claims still work against the manifest, but nothing "
+     "sweeps or expires the directory.")
+
+MANIFEST_VERSION = 1
+
+
+def default_dir() -> str:
+    d = str(get_config().get("DAEMON_DIR", "") or "")
+    if d:
+        return d
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    if base is None:
+        import tempfile
+        base = tempfile.gettempdir()
+    return os.path.join(base, f"mv2t-daemon-{os.getuid()}")
+
+
+def _geokey(n_local: int, ring_bytes: int, part_bytes: int) -> str:
+    return f"n{n_local}-r{ring_bytes}-p{part_bytes}"
+
+
+def _alive(pid: int) -> bool:
+    if not pid:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True     # alive but not ours
+
+
+@contextlib.contextmanager
+def _manifest_txn(dir_: str):
+    """flock'd read-modify-write window over the manifest. Yields the
+    manifest dict; mutations are persisted on clean exit."""
+    import fcntl
+    os.makedirs(dir_, exist_ok=True)
+    with open(os.path.join(dir_, "manifest.lock"), "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            path = os.path.join(dir_, "manifest.json")
+            try:
+                with open(path) as f:
+                    m = json.load(f)
+            except (OSError, ValueError):
+                m = {"version": MANIFEST_VERSION, "daemon_pid": 0,
+                     "sets": {}}
+            yield m
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(m, f)
+            os.replace(tmp, path)   # readers never see a torn manifest
+        finally:
+            import fcntl as _f
+            _f.flock(lockf, _f.LOCK_UN)
+
+
+class Claim:
+    """One claimed segment set (held by a job's node leader)."""
+
+    __slots__ = ("dir", "geokey", "epoch", "ring", "flags", "flat",
+                 "arena", "part_bytes")
+
+    def __init__(self, dir_: str, geokey: str, epoch: int,
+                 files: Dict[str, str], part_bytes: int):
+        self.dir = dir_
+        self.geokey = geokey
+        self.epoch = epoch
+        self.ring = files["ring"]
+        self.flags = files["flags"]
+        self.flat = files["flat"]
+        self.arena = files["arena"]
+        self.part_bytes = part_bytes
+
+
+def _reset_file(path: str, size: int, prefault: bool = False) -> None:
+    """Zero a segment file: drop every page, then restore the size.
+    ``prefault`` (the ring) zero-WRITES instead of ftruncate-sparse —
+    the datapath's hot loops would otherwise pay a page fault per
+    4 KiB until the ring first wraps (see runtime/boot.py
+    write_zeros); everything else re-zero-fills lazily."""
+    os.truncate(path, 0)
+    if not size:
+        return
+    if prefault:
+        from .boot import write_zeros
+        fd = os.open(path, os.O_WRONLY)
+        try:
+            write_zeros(fd, size)
+        finally:
+            os.close(fd)
+    else:
+        os.truncate(path, size)
+
+
+def _set_sizes(n_local: int, ring_bytes: int, part_bytes: int) -> dict:
+    from .boot import flags_len
+    hdr = (n_local * n_local * 8 + 4095) & ~4095   # arena spill grid
+    return {"ring": n_local * n_local * ring_bytes,
+            "flags": flags_len(n_local),
+            "flat": 0,       # cp_flat_attach(create=1) sizes it
+            "arena": hdr + n_local * part_bytes}
+
+
+def claim(n_local: int, ring_bytes: int, part_bytes: int,
+          dir_: Optional[str] = None) -> Optional[Claim]:
+    """Claim (creating on first use) the segment set for this geometry.
+    Returns None when the set is legitimately busy (another live job)
+    or the manifest speaks a different version — callers fall back to
+    private per-job segments."""
+    dir_ = dir_ or default_dir()
+    try:
+        with _manifest_txn(dir_) as m:
+            if m.get("version") != MANIFEST_VERSION:
+                log.warn("daemon manifest version %s != %s; not claiming",
+                         m.get("version"), MANIFEST_VERSION)
+                return None
+            key = _geokey(n_local, ring_bytes, part_bytes)
+            sizes = _set_sizes(n_local, ring_bytes, part_bytes)
+            s = m["sets"].get(key)
+            if s is None:
+                files = {k: os.path.join(dir_, f"{key}.{k}")
+                         for k in ("ring", "flags", "flat", "arena")}
+                for k, p in files.items():
+                    fd = os.open(p, os.O_CREAT | os.O_RDWR, 0o600)
+                    os.ftruncate(fd, sizes[k])
+                    os.close(fd)
+                s = {"state": "free", "epoch": 0, "owner_pid": 0,
+                     "files": files, "sizes": sizes}
+                m["sets"][key] = s
+            elif s["state"] == "busy":
+                if _alive(s["owner_pid"]):
+                    return None
+                # stale epoch: the owner died without releasing — sweep
+                log.info("sweeping stale epoch %d of %s (dead owner %d)",
+                         s["epoch"], key, s["owner_pid"])
+            # reset BEFORE publishing the claim: no attacher may ever
+            # read the previous epoch's protocol words
+            for k, p in s["files"].items():
+                _reset_file(p, sizes[k], prefault=(k == "ring"))
+            s["sizes"] = sizes
+            s["state"] = "busy"
+            s["owner_pid"] = os.getpid()
+            s["epoch"] = int(s["epoch"]) + 1
+            out = Claim(dir_, key, s["epoch"], s["files"], part_bytes)
+        if int(get_config().get("DAEMON_SPAWN", 1) or 0):
+            ensure_daemon(dir_)
+        return out
+    except OSError as e:
+        log.warn("daemon claim failed (%s); private segments", e)
+        return None
+
+
+def release(cl: Claim) -> None:
+    """Return a claimed set (job Finalize). Safe to call once per
+    claim; a crashed owner is handled by the stale-epoch sweep."""
+    try:
+        with _manifest_txn(cl.dir) as m:
+            s = m.get("sets", {}).get(cl.geokey)
+            if s is not None and s.get("epoch") == cl.epoch:
+                s["state"] = "free"
+                s["owner_pid"] = 0
+    except OSError as e:
+        log.warn("daemon release failed (%s)", e)
+
+
+def sweep(dir_: Optional[str] = None) -> int:
+    """Free busy sets whose owner died (the stale-epoch sweep). Returns
+    how many sets were reclaimed."""
+    dir_ = dir_ or default_dir()
+    n = 0
+    try:
+        with _manifest_txn(dir_) as m:
+            for key, s in m.get("sets", {}).items():
+                if s["state"] == "busy" and not _alive(s["owner_pid"]):
+                    s["state"] = "free"
+                    s["owner_pid"] = 0
+                    n += 1
+    except OSError:
+        pass
+    return n
+
+
+def ensure_daemon(dir_: Optional[str] = None) -> bool:
+    """Spawn the serve loop when none is running. Returns True when a
+    daemon is (now) alive. The spawn is detached and best-effort — a
+    claim never depends on it."""
+    dir_ = dir_ or default_dir()
+    try:
+        with _manifest_txn(dir_) as m:
+            if _alive(m.get("daemon_pid", 0)):
+                return True
+    except OSError:
+        return False
+    try:
+        import subprocess
+        from .childenv import strip_tunnel
+        env = strip_tunnel(dict(os.environ))
+        env["JAX_PLATFORMS"] = "cpu"
+        # ranks export MV2T_RANK etc.; the daemon is node-scoped, not a
+        # rank — scrub job identity so nothing in it boots as one
+        for k in ("MV2T_RANK", "MV2T_SIZE", "MV2T_KVS", "MV2T_FT",
+                  "MV2T_WORLD_BASE"):
+            env.pop(k, None)
+        with open(os.devnull, "rb") as nullin, \
+                open(os.devnull, "ab") as nullout:
+            subprocess.Popen(
+                [sys.executable, "-m", "mvapich2_tpu.runtime.daemon",
+                 "--serve", "--dir", dir_],
+                stdin=nullin, stdout=nullout, stderr=nullout,
+                start_new_session=True, env=env)
+        return True
+    except OSError as e:
+        log.warn("could not spawn warm-attach daemon (%s)", e)
+        return False
+
+
+def serve(dir_: Optional[str] = None,
+          idle_s: Optional[float] = None) -> int:
+    """The daemon body: adopt the manifest, then loop — stale-epoch
+    sweep + legacy segment sweep — until idle for DAEMON_IDLE_S."""
+    dir_ = dir_ or default_dir()
+    idle_s = float(get_config().get("DAEMON_IDLE_S", 600.0)
+                   if idle_s is None else idle_s)
+    with _manifest_txn(dir_) as m:
+        if _alive(m.get("daemon_pid", 0)) \
+                and m["daemon_pid"] != os.getpid():
+            log.info("daemon already serving (pid %d)", m["daemon_pid"])
+            return 0
+        m["version"] = MANIFEST_VERSION
+        m["daemon_pid"] = os.getpid()
+    log.info("warm-attach daemon serving %s (pid %d)", dir_, os.getpid())
+    last_busy = time.monotonic()
+    last_legacy = 0.0
+    while True:
+        time.sleep(2.0)
+        busy = False
+        try:
+            with _manifest_txn(dir_) as m:
+                if m.get("daemon_pid") != os.getpid():
+                    return 0    # replaced (e.g. --stop then respawn)
+                for s in m.get("sets", {}).values():
+                    if s["state"] == "busy":
+                        if _alive(s["owner_pid"]):
+                            busy = True
+                        else:
+                            s["state"] = "free"
+                            s["owner_pid"] = 0
+        except OSError:
+            pass
+        now = time.monotonic()
+        if busy:
+            last_busy = now
+        if now - last_legacy > 30.0:
+            last_legacy = now
+            try:
+                # ride the existing arena sweep for crashed per-job
+                # segments outside the daemon dir (lazy import: numpy
+                # lives in the daemon process only, never on a rank's
+                # light-boot path)
+                from ..transport.arena import ShmArena
+                from .boot import shm_base_dir
+                ShmArena.sweep_stale(shm_base_dir())
+            except Exception:
+                pass
+        if idle_s > 0 and now - last_busy > idle_s:
+            break
+    with _manifest_txn(dir_) as m:
+        if m.get("daemon_pid") != os.getpid():
+            return 0
+        m["daemon_pid"] = 0
+        for key, s in list(m.get("sets", {}).items()):
+            if s["state"] == "busy" and _alive(s["owner_pid"]):
+                continue     # never pull a live job's mapping
+            for p in s["files"].values():
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            del m["sets"][key]
+    log.info("warm-attach daemon idle-expired; freed %s", dir_)
+    return 0
+
+
+def status(dir_: Optional[str] = None) -> dict:
+    dir_ = dir_ or default_dir()
+    try:
+        with open(os.path.join(dir_, "manifest.json")) as f:
+            m = json.load(f)
+    except (OSError, ValueError):
+        return {"dir": dir_, "manifest": None}
+    m["daemon_alive"] = _alive(m.get("daemon_pid", 0))
+    m["dir"] = dir_
+    return m
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="mvapich2-tpu warm-attach node daemon")
+    ap.add_argument("--dir", default=None)
+    ap.add_argument("--serve", action="store_true")
+    ap.add_argument("--idle", type=float, default=None,
+                    help="override MV2T_DAEMON_IDLE_S")
+    ap.add_argument("--status", action="store_true")
+    ap.add_argument("--sweep", action="store_true")
+    ap.add_argument("--stop", action="store_true")
+    a = ap.parse_args(argv)
+    if a.status:
+        print(json.dumps(status(a.dir), indent=1))
+        return 0
+    if a.sweep:
+        print(f"swept {sweep(a.dir)} stale set(s)")
+        return 0
+    if a.stop:
+        d = a.dir or default_dir()
+        with _manifest_txn(d) as m:
+            pid = m.get("daemon_pid", 0)
+            m["daemon_pid"] = 0
+        if _alive(pid):
+            import signal
+            os.kill(pid, signal.SIGTERM)
+            print(f"stopped daemon pid {pid}")
+        return 0
+    if a.serve:
+        return serve(a.dir, a.idle)
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

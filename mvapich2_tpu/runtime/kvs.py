@@ -12,13 +12,24 @@ from __future__ import annotations
 import json
 import os
 import socket
-import socketserver
 import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..utils.mlog import get_logger
 
 log = get_logger("kvs")
+
+
+def _fire(site: str):
+    """Fault-injection hook kept import-free: the engine only exists if
+    something imported mvapich2_tpu.faults (the light boot does so iff
+    MV2T_FAULTS is set; any world build does unconditionally). When the
+    module was never imported there is no spec to fire — skipping is
+    the same no-op fire() itself would take, minus ~25 ms of module
+    import inside MPI_Init on the 1-core bench host."""
+    import sys
+    f = sys.modules.get("mvapich2_tpu.faults")
+    return f.fire(site) if f is not None else None
 
 
 class _KVSState:
@@ -32,7 +43,10 @@ class _KVSState:
         self.aborted: Optional[str] = None
 
 
-class _Handler(socketserver.StreamRequestHandler):
+class _HandlerBody:
+    """Verb dispatch shared by the socketserver handler (built lazily in
+    KVSServer — rank clients must not pay the socketserver import)."""
+
     def handle(self):
         state: _KVSState = self.server.state  # type: ignore
         for line in self.rfile:
@@ -46,15 +60,47 @@ class _Handler(socketserver.StreamRequestHandler):
                     state.data[msg["key"]] = msg["val"]
                     state.cond.notify_all()
                 self._reply({"ok": True})
+            elif cmd == "mput":
+                # batched put: one message publishes a whole card set
+                # (the startup-path replacement for N serial round trips)
+                with state.cond:
+                    state.data.update(msg["kv"])
+                    state.cond.notify_all()
+                self._reply({"ok": True})
             elif cmd == "get":
                 with state.cond:
                     while msg["key"] not in state.data and not state.aborted:
                         state.cond.wait(timeout=60)
                     val = state.data.get(msg["key"])
                 self._reply({"ok": val is not None, "val": val})
+            elif cmd == "mget":
+                # batched blocking get: waits until EVERY key is present
+                # (one round trip for a full business-card sweep)
+                keys = msg["keys"]
+                with state.cond:
+                    while not all(k in state.data for k in keys) \
+                            and not state.aborted:
+                        state.cond.wait(timeout=60)
+                    vals = [state.data.get(k) for k in keys]
+                self._reply({"ok": all(v is not None for v in vals),
+                             "vals": vals})
+            elif cmd == "mpeek":
+                # batched nonblocking get (lazy-wiring probes poll peers'
+                # cards without committing to a blocking wait)
+                with state.cond:
+                    vals = [state.data.get(k) for k in msg["keys"]]
+                self._reply({"ok": True, "vals": vals})
             elif cmd == "fence":
                 grp = msg.get("group", "")
                 with state.cond:
+                    # a fence may carry the caller's cards: merge-then-
+                    # barrier in ONE message, so by the time the fence
+                    # releases, every member's cards are readable (the
+                    # PMI put+fence collapse of the batched bootstrap)
+                    cards = msg.get("cards")
+                    if cards:
+                        state.data.update(cards)
+                        state.cond.notify_all()
                     f = state.fences.setdefault(
                         grp, [int(msg.get("count", state.nranks)), 0, 0])
                     gen = f[2]
@@ -101,9 +147,14 @@ class KVSServer:
     """Launcher-side server; one per job."""
 
     def __init__(self, nranks: int, host: str = "127.0.0.1"):
+        import socketserver   # launcher-side only; see _HandlerBody
         self.state = _KVSState(nranks)
         # proc-id watermark for dynamic spawn (runtime/spawn.py)
         self.state.data["__next_proc"] = str(nranks)
+
+        class _Handler(_HandlerBody, socketserver.StreamRequestHandler):
+            pass
+
         self._srv = socketserver.ThreadingTCPServer((host, 0), _Handler,
                                                     bind_and_activate=True)
         self._srv.daemon_threads = True
@@ -158,24 +209,72 @@ class KVSClient:
         return json.loads(line)
 
     def put(self, key: str, val: str) -> None:
-        from .. import faults
-        if faults.fire("kvs") == "drop":
+        if _fire("kvs") == "drop":
             return            # lost bootstrap card: peers' get blocks
         self._rpc({"cmd": "put", "key": key, "val": val})
 
+    def put_many(self, kv: Dict[str, str]) -> None:
+        """Publish a whole card set in one round trip."""
+        if _fire("kvs") == "drop":
+            return            # whole batch lost: peers' get blocks
+        self._rpc({"cmd": "mput", "kv": dict(kv)})
+
     def get(self, key: str) -> str:
-        from .. import faults
-        faults.fire("kvs")    # crash/delay mid-bootstrap-exchange
+        _fire("kvs")          # crash/delay mid-bootstrap-exchange
         r = self._rpc({"cmd": "get", "key": key})
         if not r.get("ok"):
             raise KeyError(key)
         return r["val"]
 
-    def fence(self, group: str = "", count: Optional[int] = None) -> None:
+    def get_many(self, keys: List[str]) -> List[str]:
+        """Blocking multi-get: one round trip, waits for every key."""
+        _fire("kvs")          # crash/delay mid-bootstrap-exchange
+        r = self._rpc({"cmd": "mget", "keys": list(keys)})
+        if not r.get("ok"):
+            raise KeyError(repr(keys))
+        return r["vals"]
+
+    def peek_many(self, keys: List[str]) -> List[Optional[str]]:
+        """Nonblocking multi-peek (None for absent keys)."""
+        return self._rpc({"cmd": "mpeek", "keys": list(keys)})["vals"]
+
+    def fence(self, group: str = "", count: Optional[int] = None,
+              cards: Optional[Dict[str, str]] = None) -> None:
+        """Barrier; ``cards`` rides the fence message, so publication
+        and the barrier cost ONE round trip and the release guarantees
+        every member's cards are readable."""
+        self.fence_end(self.fence_begin(group, count, cards))
+
+    def fence_begin(self, group: str = "", count: Optional[int] = None,
+                    cards: Optional[Dict[str, str]] = None) -> object:
+        """Split fence: send the request and return a token WITHOUT
+        waiting for the release, so the caller can overlap local work
+        (segment creation, channel construction) with the barrier.
+        MUST be completed with fence_end(token) before any other verb —
+        the connection lock is held across the window."""
+        _fire("kvs")
         msg = {"cmd": "fence", "group": group}
         if count is not None:
             msg["count"] = count
-        self._rpc(msg)
+        if cards:
+            msg["cards"] = dict(cards)
+        self._lock.acquire()
+        try:
+            self._f.write((json.dumps(msg) + "\n").encode())
+            self._f.flush()
+        except BaseException:
+            self._lock.release()
+            raise
+        return object()
+
+    def fence_end(self, token: object) -> None:
+        try:
+            line = self._f.readline()
+        finally:
+            self._lock.release()
+        if not line:
+            raise ConnectionError("KVS server closed connection")
+        json.loads(line)
 
     def add(self, key: str, delta: int = 1) -> int:
         """Atomic fetch-add; returns the post-add value."""

@@ -165,7 +165,12 @@ class ShmArena:
     MIN_CLASS = 64 * 1024
 
     def __init__(self, path: str, n_local: int, my_index: int,
-                 part_bytes: Optional[int] = None, create: bool = False):
+                 part_bytes: Optional[int] = None, create: bool = False,
+                 exclusive: bool = True):
+        """``create`` initializes a fresh arena; ``exclusive=False``
+        relaxes O_EXCL for the warm-attach path (runtime/daemon.py),
+        where the file pre-exists but was reset to all-zeroes — which
+        IS the created state (empty spill grid, per-process brk)."""
         if part_bytes is None or part_bytes <= 0:
             part_bytes = int(get_config()["ARENA_BYTES"]) \
                 or _auto_part_bytes(n_local)
@@ -173,7 +178,9 @@ class ShmArena:
         hdr = (n_local * n_local * 8 + _PAGE - 1) & ~(_PAGE - 1)
         total = hdr + n_local * part_bytes
         import mmap as _mmap
-        flags = (os.O_CREAT | os.O_EXCL | os.O_RDWR) if create else os.O_RDWR
+        flags = os.O_RDWR
+        if create:
+            flags |= os.O_CREAT | (os.O_EXCL if exclusive else 0)
         self.fd = os.open(path, flags, 0o600)
         if create:
             os.ftruncate(self.fd, total)   # tmpfs: zero-filled

@@ -53,6 +53,12 @@ cvar("USE_CMA", 1, int, "shm",
      "Use cross-memory-attach (process_vm_readv) for large intra-node "
      "messages when the bootstrap probe succeeds (the CMA/LiMIC2 path of "
      "ch3_smp_progress.c:525). 0 forces the staged rendezvous.")
+cvar("WIRE_TIMEOUT", 120.0, float, "shm",
+     "Deadline in seconds for the blocking per-node wire gate "
+     "(ensure_wired): how long a collective/rendezvous entry waits for "
+     "every co-located rank to publish its wiring cards before failing "
+     "with MPI_ERR_INTERN. Lazy wiring only blocks where all "
+     "participants are known to arrive (collectives, rendezvous).")
 cvar("PEER_TIMEOUT", 10.0, float, "ft",
      "Liveness-lease timeout in seconds: a co-located peer whose "
      "heartbeat stamp (refreshed by a dedicated thread, so compute-"
@@ -76,6 +82,18 @@ _PV_PLANE_DECLS = [
 ]
 for _n, _d in _PV_PLANE_DECLS:
     _mpit.pvar(_n, _mpit.PVAR_CLASS_COUNTER, "shm", _d)
+
+# startup-path observability: every node wire is counted as eager
+# (bootstrap/spawn forced it) or lazy (deferred to the first operation
+# that needed the agreement — the on-demand CM model)
+pv_wiring_eager = _mpit.pvar(
+    "wiring_eager", _mpit.PVAR_CLASS_COUNTER, "shm",
+    "shm channels wired eagerly at bootstrap "
+    "(MV2T_LAZY_WIRING=0 or the spawn path)")
+pv_wiring_lazy = _mpit.pvar(
+    "wiring_lazy", _mpit.PVAR_CLASS_COUNTER, "shm",
+    "shm channels wired on demand, at the first rendezvous/collective "
+    "that needed the per-node agreement")
 
 # Fast-path observability (native/mpi/fastpath.c + the flat collective
 # tier in cplane.cpp). Index order mirrors cplane.cpp's FPC_* enum; the
@@ -268,6 +286,8 @@ def _bind_cplane(lib) -> None:
     lib.cp_coll_tag.argtypes = [L.c_void_p, L.c_int]
     lib.cp_set_cma.argtypes = [L.c_void_p, L.c_int]
     lib.cp_cma_enabled.argtypes = [L.c_void_p]
+    lib.cp_set_wired.argtypes = [L.c_void_p]
+    lib.cp_wired.argtypes = [L.c_void_p]
     lib.cp_congested.argtypes = [L.c_void_p, L.c_int]
     lib.cp_rndv_stats.argtypes = [L.c_void_p, L.POINTER(L.c_ulonglong),
                                   L.POINTER(L.c_ulonglong)]
@@ -407,14 +427,32 @@ class ShmChannel(Channel):
     supports_rget = True
 
     def __init__(self, my_rank: int, local_ranks: List[int], kvs,
-                 ring_bytes: Optional[int] = None):
+                 ring_bytes: Optional[int] = None, boot_card=None,
+                 daemon_claim=None):
         self.my_rank = my_rank           # world rank
         self.local_ranks = sorted(local_ranks)
         self.local_index = {r: i for i, r in enumerate(self.local_ranks)}
         self.n_local = len(self.local_ranks)
         self.kvs = kvs
+        # deferred card publication: everything this constructor would
+        # kvs.put travels in ONE batched put_many at the end (the
+        # serial-RTT collapse of the batched bootstrap)
+        self._cards: Dict[str, str] = {}
+        # boot_card: the node leader's light-boot segment card
+        # (runtime/boot.py) — pre-created zero-filled files every rank
+        # attaches without ordering on the leader's world build.
+        # daemon_claim: the leader's warm-attach claim to release at
+        # close (runtime/daemon.py).
+        self._boot_mode = boot_card is not None
+        self._daemon = bool(boot_card and boot_card.get("daemon"))
+        self._daemon_claim = daemon_claim
         if ring_bytes is None:
-            ring_bytes = get_config()["SHM_RING_BYTES"]
+            if boot_card is not None:
+                # the leader sized the segment at light boot; geometry
+                # is part of the versioned card, never recomputed
+                ring_bytes = int(boot_card["ring_bytes"])
+            else:
+                ring_bytes = get_config()["SHM_RING_BYTES"]
             if not ring_bytes:
                 # auto (the vbuf-pool sizing discipline of ibv_param.c):
                 # with few co-located ranks the n^2 segment is cheap,
@@ -430,55 +468,62 @@ class ShmChannel(Channel):
                     ring_bytes = 1 << 20
         ring_bytes = (ring_bytes + 7) & ~7
         leader = self.local_ranks[0]
+        self._owner = my_rank == leader
         segkey = f"shm-seg-{leader}"
-        if my_rank == leader:
+        if boot_card is not None:
+            # pre-created at light boot: zero-filled IS the initialized
+            # ring state, so every rank (owner included) attaches with
+            # create=0 — no memset, no ordering
+            path = boot_card["ring"]
+            self._ring = self._make_ring(path, ring_bytes, create=False)
+            if self._owner:
+                self._cards[segkey] = path
+        elif self._owner:
             base = "/dev/shm" if os.path.isdir("/dev/shm") \
                 else tempfile.gettempdir()
             path = os.path.join(
                 base, f"mv2t-shm-{os.getpid()}-{uuid.uuid4().hex[:8]}")
             self._ring = self._make_ring(path, ring_bytes, create=True)
             kvs.put(segkey, path)
-            self._owner = True
         else:
             path = kvs.get(segkey)
             self._ring = self._make_ring(path, ring_bytes, create=False)
-            self._owner = False
         self.path = path
         # -- persistent per-node scratch arena (transport/arena.py) ------
-        # created by the leader alongside the ring segment; replaces the
-        # per-send scratch files for RGET exposure and oversize spills.
-        # Usability is agreed unanimously in finish_wiring() (like CMA)
-        # so sender and receiver always dispatch handles identically.
+        # created (or daemon-attached) by the leader alongside the ring
+        # segment; replaces the per-send scratch files for RGET exposure
+        # and oversize spills. Followers attach during wiring — the
+        # leader's card is guaranteed published by then — and usability
+        # is agreed unanimously (like CMA) so sender and receiver always
+        # dispatch handles identically.
         self.arena: Optional[ShmArena] = None
-        self.cma_ok = False          # python-level CMA verdict (post-fence)
+        self.cma_ok = False          # python-level CMA verdict (post-wire)
         self._arena_ready = False    # set after the unanimous agreement
         base = os.path.dirname(path)
         arena_key = f"shm-arena-{leader}"
-        try:
-            if self._owner:
-                ShmArena.sweep_stale(base)
-                apath = os.path.join(
-                    base, f"mv2t-arena-{os.getpid()}-{uuid.uuid4().hex[:8]}")
-                try:
+        if self._owner:
+            try:
+                if self._daemon:
+                    # warm attach: the claimed (reset) arena file; the
+                    # zeroed spill grid is the created state
+                    apath = boot_card["arena"]
+                    self.arena = ShmArena(apath, self.n_local,
+                                          self.local_index[my_rank],
+                                          int(boot_card["part_bytes"]),
+                                          create=True, exclusive=False)
+                else:
+                    ShmArena.sweep_stale(base)
+                    apath = os.path.join(
+                        base,
+                        f"mv2t-arena-{os.getpid()}-{uuid.uuid4().hex[:8]}")
                     self.arena = ShmArena(apath, self.n_local,
                                           self.local_index[my_rank],
                                           create=True)
-                    kvs.put(arena_key,
-                            f"{apath}:{self.arena.part_bytes}")
-                except Exception as e:
-                    log.warn("arena create failed (%s); scratch-file "
-                             "rendezvous", e)
-                    kvs.put(arena_key, "")
-            else:
-                card = kvs.get(arena_key)
-                if card:
-                    apath, part = card.rsplit(":", 1)
-                    self.arena = ShmArena(apath, self.n_local,
-                                          self.local_index[my_rank],
-                                          int(part), create=False)
-        except Exception as e:
-            log.warn("arena attach failed (%s); scratch-file rendezvous", e)
-            self.arena = None
+                self._cards[arena_key] = f"{apath}:{self.arena.part_bytes}"
+            except Exception as e:
+                log.warn("arena create failed (%s); scratch-file "
+                         "rendezvous", e)
+                self._cards[arena_key] = ""
         # exposure table: wire handle -> keepalive (ndarray for CMA,
         # ArenaHandle for arena blocks) — the registration-cache handle
         # table; leak-checked at close()
@@ -517,15 +562,17 @@ class ShmChannel(Channel):
         self._bell.bind(bell_path)
         self._bell.setblocking(False)
         self._bell_path = bell_path
-        kvs.put(f"shm-bell-{my_rank}", bell_path)
-        # CMA probe buffer: published pre-fence; finish_wiring() reads a
-        # neighbor's copy to decide whether process_vm_readv works here
-        # (kept alive for the channel lifetime)
+        self._cards[f"shm-bell-{my_rank}"] = bell_path
+        # CMA probe buffer: published with the build cards; the wire
+        # step reads a neighbor's copy to decide whether
+        # process_vm_readv works here (kept alive for the channel
+        # lifetime). Bell-card presence implies probe-card presence —
+        # they ride the same batched put.
         self._cma_probe = np.frombuffer(
             f"mv2t-cma-{my_rank:012d}".encode(), dtype=np.uint8).copy()
-        kvs.put(f"shm-cma-{my_rank}",
-                f"{os.getpid()}:{self._cma_probe.ctypes.data}"
-                f":{self._cma_probe.size}")
+        self._cards[f"shm-cma-{my_rank}"] = (
+            f"{os.getpid()}:{self._cma_probe.ctypes.data}"
+            f":{self._cma_probe.size}")
         self._peer_bells: Dict[int, str] = {}
         # liveness-lease timeout (cached: the probe runs at blocking
         # waits' sleep points; config is reloaded before channels wire)
@@ -545,10 +592,13 @@ class ShmChannel(Channel):
         # waits, C flat waves, C wait quanta — scans peers' stamps
         # against MV2T_PEER_TIMEOUT so a SIGKILLed peer is a detectable
         # event instead of a hang. cplane.cpp maps the same layout.
-        flags_path = f"{path}.flags"
+        flags_path = boot_card["flags"] if boot_card is not None \
+            else f"{path}.flags"
         lease_off = (self.n_local + _LEASE_ALIGN - 1) & ~(_LEASE_ALIGN - 1)
         flags_len = lease_off + _LEASE_STAMP * self.n_local
-        if self._owner:
+        if boot_card is not None:
+            pass    # pre-created (zeroed) at light boot; just map it
+        elif self._owner:
             # write-then-rename so followers never see a short file
             with open(flags_path + ".tmp", "wb") as f:
                 f.write(b"\0" * flags_len)
@@ -580,14 +630,19 @@ class ShmChannel(Channel):
         self._hb_thread.start()
         # -- native data plane (native/cplane.cpp) -----------------------
         # C-side envelope matching for plane-owned contexts: created when
-        # the native ring is live; wired (bells, global registration) in
-        # finish_wiring() once every rank's business card is published.
+        # the native ring is live. Everything LOCAL — world map, global
+        # registration for the C fast path, lease timeout, flat progress
+        # hook — happens here; only the parts that need peers' cards
+        # (bells, the CMA/arena/flat agreement) wait for ensure_wired().
+        # Pre-wire the plane still carries eager traffic: an unset bell
+        # just means a parked receiver wakes on its poll timeout.
         self.plane = None
         self._plane_recvs: Dict[int, object] = {}   # cp req id -> Request
         self._plane_cancels: Dict[int, object] = {} # sreq id -> SendRequest
         self.plane_client = None                    # Pt2ptProtocol hook
         self._ring_cap = 0
-        self._flat_path = f"{path}.fcoll"
+        self._flat_path = boot_card["flat"] if boot_card is not None \
+            else f"{path}.fcoll"
         self._flat_cb = None           # keepalive for the ctypes callback
         self.cabi_ranks = set()        # local ranks that are C-ABI procs
         if self.using_native and get_config()["USE_CPLANE"]:
@@ -599,11 +654,69 @@ class ShmChannel(Channel):
                 lib.cp_set_wait_fd(self.plane, self._bell.fileno())
                 if self._owner:
                     # flat-slot collective segment (cp_flat_*): sparse
-                    # per-context regions; created by the leader BEFORE
-                    # the business-card fence so followers can attach in
-                    # finish_wiring without racing the creation
+                    # per-context regions; created by the leader before
+                    # its build cards publish, so followers can attach
+                    # during wiring without racing the creation
                     lib.cp_flat_attach(self.plane,
                                        self._flat_path.encode(), 1)
+                for r in self.local_ranks:
+                    lib.cp_set_world(self.plane, self.local_index[r], r)
+                # python-rank progress hook for flat-collective waits: a
+                # rank parked in a flat wave still runs forwarded python
+                # work (rendezvous assists) so peers cannot deadlock.
+                # Runs INSIDE cp_flat_* wait loops, so it must never
+                # block (a sleep here stalls the whole node's wave).
+                import ctypes as _ct
+
+                def _flat_progress():  # mv2tlint: handler
+                    from ..runtime import universe as uni
+                    try:
+                        u = uni.current_universe()
+                        if u is not None:
+                            u.engine.progress_poke()
+                    except Exception:
+                        pass
+                self._flat_cb = _ct.CFUNCTYPE(None)(_flat_progress)
+                lib.cp_flat_set_progress_cb(
+                    self.plane, _ct.cast(self._flat_cb, _ct.c_void_p))
+                # arm the C-side lease scans (flat waves, wait quanta)
+                # with the same timeout the python probe uses
+                lib.cp_set_peer_timeout(self.plane,
+                                        int(self._peer_timeout * 1e6))
+                lib.cp_register_global(self.plane)
+                # bind the plane counters' sources to this live plane:
+                # fast-path hit-rate is the one number that says
+                # whether a workload actually rides the C path — it
+                # must be observable even before the node wires (eager
+                # traffic flows pre-wire). Totals from earlier planes
+                # in this process (latched at close) stay included.
+                for idx, (name, desc) in enumerate(_PV_PLANE_DECLS):
+                    pv = _mpit.pvar(name, _mpit.PVAR_CLASS_COUNTER,
+                                    "shm", desc)
+                    base = pv._value
+                    pv.source = (lambda i=idx, b=base:
+                                 b + float(self.plane_stats()[i]))
+                for idx, (name, desc) in enumerate(_FP_COUNTERS):
+                    pv = _mpit.pvar(name, _mpit.PVAR_CLASS_COUNTER,
+                                    "fastpath", desc)
+                    base = pv._value
+                    pv.source = (lambda i=idx, b=base:
+                                 b + float(self.fp_counter(i)))
+        # -- lazy per-peer wiring state ----------------------------------
+        # the deferred half of bootstrap: bells + the unanimous CMA/
+        # arena/flat agreement complete on the first operation that
+        # needs them (ensure_wired / opportunistic try_wire)
+        self._wired = False
+        self._wire_stage = 0           # 0=idle, 1=verdict published
+        self._wire_eager = False       # attribution for the wiring pvars
+        self._wire_try_at = 0.0        # opportunistic-probe throttle
+        from ..analysis.lockorder import tracked as _tracked
+        self._wire_lock = _tracked(threading.Lock(),
+                                   f"shm[{my_rank}]._wire_lock")
+        # one batched publication for every build card (bell, CMA probe,
+        # segment/arena paths) — peers' wire step peeks these
+        if self._cards:
+            kvs.put_many(self._cards)
 
     def plane_eager_max(self) -> int:
         """Largest eager payload the plane can carry: an eager blob is a
@@ -798,122 +911,218 @@ class ShmChannel(Channel):
                      "staged rendezvous path", got, pid)
         return ok
 
+    # -- lazy per-peer wiring (the deferred half of bootstrap) -----------
+    #
+    # The eager model wired every peer at Init behind a global fence.
+    # Now a channel is BUILT (segments mapped, plane registered, eager
+    # pt2pt live) the moment its constructor returns, and the peer-
+    # dependent half — bells, the unanimous CMA/arena/flat agreement,
+    # the C-ABI membership table — completes on the first operation that
+    # needs it. Two stages, both driven by batched KVS peeks:
+    #
+    #   stage 0->1: every co-located rank's BUILD cards (bell + CMA
+    #     probe) are visible -> set bells, probe the neighbor, attach
+    #     the follower-side arena/flat segments, publish my VERDICT
+    #     card (one batched put).
+    #   stage 1->2: every rank's verdict is visible -> apply the
+    #     unanimous agreements, rebind the plane pvars, wired.
+    #
+    # Blocking (ensure_wired) is only entered where every participant
+    # is known to arrive — collective dispatch and rendezvous — so an
+    # idle peer can never deadlock a wire. Everything else degrades:
+    # eager sends ride the ring bell-less, rendezvous exposes fall back
+    # to the scratch-file ladder until try_wire upgrades them.
+
+    def try_wire(self, force: bool = False) -> bool:
+        """Opportunistic nonblocking wire attempt (throttled). Called
+        from the progress poll path and rendezvous entries; never
+        blocks and never waits on a lock."""
+        if self._wired:
+            return True
+        now = time.monotonic()
+        if not force and now < self._wire_try_at:
+            return False
+        if not self._wire_lock.acquire(blocking=False):
+            return self._wired
+        try:
+            self._wire_try_at = time.monotonic() + 0.01
+            return self._wire_step()
+        finally:
+            self._wire_lock.release()
+
+    def ensure_wired(self, eager: bool = False) -> None:
+        """Blocking wire gate: complete the per-node agreement or raise.
+        Unwinds with MPIX_ERR_PROC_FAILED when a co-located peer dies
+        mid-wire (lease scan / launcher events), and with MPI_ERR_INTERN
+        after MV2T_WIRE_TIMEOUT — never a silent hang."""
+        if self._wired:
+            return
+        self._wire_eager = eager or self._wire_eager
+        deadline = time.monotonic() + max(
+            1.0, float(get_config().get("WIRE_TIMEOUT", 120.0)))
+        while True:
+            with self._wire_lock:
+                if self._wire_step():
+                    return
+            # containment: a peer killed mid-wire must unwind this wait
+            if self._peer_timeout > 0:
+                self.check_peer_leases()
+            u = getattr(self.engine, "universe", None) \
+                if hasattr(self, "engine") else None
+            if u is not None and u.failed_ranks:
+                dead = [r for r in self.local_ranks
+                        if r != self.my_rank and r in u.failed_ranks]
+                if dead:
+                    from ..core.errors import PeerDeadError
+                    raise PeerDeadError(dead[0], 0.0, "node wire gate")
+            if time.monotonic() > deadline:
+                from ..core.errors import MPIException, MPI_ERR_INTERN
+                raise MPIException(
+                    MPI_ERR_INTERN,
+                    f"shm wire gate timed out after MV2T_WIRE_TIMEOUT: "
+                    f"co-located ranks {self.local_ranks} never all "
+                    f"published wiring cards (stage {self._wire_stage})")
+            time.sleep(0.001)
+
     def finish_wiring(self) -> None:
-        """Post-fence wiring: the unanimous CMA + arena agreements (every
-        ShmChannel), then peer bell addresses into the plane and its
-        process-global publication so libmpi.c's C fast path can find it
-        (cp_global). Called by bootstrap after the business-card fence."""
-        # CMA is enabled only by UNANIMOUS agreement: every co-resident
-        # rank publishes its own probe verdict (can it read a neighbor,
-        # is USE_CMA set) and reads everyone else's. The receiver
-        # performs the pull, so a single incapable/opted-out rank must
-        # disable the protocol for the whole node. The arena verdict
-        # rides the same exchange: a rank whose mapping failed would
-        # receive handles it cannot dereference.
-        my_ok = bool(get_config()["USE_CMA"]) and self._probe_cma()
-        my_arena = self.arena is not None
-        # flat-slot collective segment: followers attach now (the leader
-        # created the file before the fence); usability is unanimous —
-        # one rank that cannot map the segment would hang the node's
-        # flat waves, so everyone must agree to use it (or nobody does)
-        my_flat = False
-        if self.plane:
-            lib = self._ring.lib
-            if not self._owner:
-                lib.cp_flat_attach(self.plane, self._flat_path.encode(), 0)
-            my_flat = bool(lib.cp_flat_ok(self.plane))
-        # C-ABI membership table: a comm with any C-ABI rank must use
-        # the C fast path's collective-tier cap (FP_COLL_MAX) on every
-        # member — coll/api.py._plane_coll_max reads this set. A pure
-        # python comm keeps the tuning tier above the eager size (the
-        # interpreter-hop schedules lose to the arena tier there).
-        from .. import cshim as _cshim
-        my_cabi = _cshim.is_cabi_process()
-        self.kvs.put(f"shm-cma-ok-{self.my_rank}", "1" if my_ok else "0")
-        self.kvs.put(f"shm-arena-ok-{self.my_rank}",
-                     "1" if my_arena else "0")
-        self.kvs.put(f"shm-flat-ok-{self.my_rank}", "1" if my_flat else "0")
-        self.kvs.put(f"shm-cabi-{self.my_rank}", "1" if my_cabi else "0")
-        all_ok, all_arena, all_flat = my_ok, my_arena, my_flat
-        self.cabi_ranks = {self.my_rank} if my_cabi else set()
-        for r in self.local_ranks:
-            if r == self.my_rank:
-                continue
-            try:
-                all_ok = all_ok and \
-                    self.kvs.get(f"shm-cma-ok-{r}") == "1"
-                all_arena = all_arena and \
-                    self.kvs.get(f"shm-arena-ok-{r}") == "1"
-                all_flat = all_flat and \
-                    self.kvs.get(f"shm-flat-ok-{r}") == "1"
-                if self.kvs.get(f"shm-cabi-{r}") != "0":
+        """Eager wiring (spawn bootstrap and MV2T_LAZY_WIRING=0): the
+        pre-lazy entry point, kept as the blocking gate with eager
+        attribution."""
+        self.ensure_wired(eager=True)
+
+    def _wire_step(self) -> bool:  # holds: _wire_lock
+        """One nonblocking advance of the wire state machine."""
+        if self._wired:
+            return True
+        from .. import faults
+        faults.fire("wire")    # chaos: crash/delay mid-wire
+        u = getattr(self.engine, "universe", None) \
+            if hasattr(self, "engine") else None
+        failed = getattr(u, "failed_ranks", None) or set()
+        # a peer that died mid-wire can never publish its cards: the
+        # wire completes DEGRADED without it — conservative all-False
+        # agreements (eager + scratch-file rendezvous keep working),
+        # never a permanent stage-1 stall
+        dead = [r for r in self.local_ranks
+                if r != self.my_rank and r in failed]
+        peers = [r for r in self.local_ranks
+                 if r != self.my_rank and r not in failed]
+        if self._wire_stage == 0:
+            vals = self.kvs.peek_many(
+                [f"shm-bell-{r}" for r in peers]
+                + [f"shm-cma-{r}" for r in peers])
+            if any(v is None for v in vals):
+                return False    # some peer has not built its world yet
+            lib = self._ring.lib if self.plane else None
+            for r, addr in zip(peers, vals[:len(peers)]):
+                self._peer_bells[r] = addr
+                if lib is not None:
+                    lib.cp_set_bell(self.plane, self.local_index[r],
+                                    addr.encode())
+            # CMA is enabled only by UNANIMOUS agreement: every
+            # co-resident rank publishes its probe verdict (can it read
+            # a neighbor, is USE_CMA set) and reads everyone else's.
+            # The receiver performs the pull, so a single incapable/
+            # opted-out rank must disable the protocol for the whole
+            # node. The arena and flat verdicts ride the same exchange:
+            # a rank whose mapping failed would receive handles (or
+            # join waves) it cannot dereference.
+            # degraded wire skips the probe: the left neighbor may BE
+            # the dead rank (probe card never published) and the
+            # verdict is forced False at apply anyway
+            my_ok = not dead and bool(get_config()["USE_CMA"]) \
+                and self._probe_cma()
+            if self.arena is None and not self._owner:
+                self._attach_follower_arena()
+            my_arena = self.arena is not None
+            my_flat = False
+            if self.plane:
+                if not self._owner:
+                    lib.cp_flat_attach(self.plane,
+                                       self._flat_path.encode(), 0)
+                my_flat = bool(lib.cp_flat_ok(self.plane))
+            # C-ABI membership: a comm with any C-ABI rank must use the
+            # C fast path's collective-tier cap (FP_COLL_MAX) on every
+            # member — coll/api.py._plane_coll_max reads this set. A
+            # pure python comm keeps the tuning tier above the eager
+            # size (interpreter-hop schedules lose to the arena tier).
+            from .. import cshim as _cshim
+            my_cabi = _cshim.is_cabi_process()
+            self._my_verdicts = (my_ok, my_arena, my_flat)
+            self.kvs.put_many({
+                f"shm-cma-ok-{self.my_rank}": "1" if my_ok else "0",
+                f"shm-arena-ok-{self.my_rank}": "1" if my_arena else "0",
+                f"shm-flat-ok-{self.my_rank}": "1" if my_flat else "0",
+                f"shm-cabi-{self.my_rank}": "1" if my_cabi else "0",
+            })
+            self.cabi_ranks = {self.my_rank} if my_cabi else set()
+            self._wire_stage = 1
+        if self._wire_stage == 1:
+            vals = self.kvs.peek_many(
+                [f"shm-cma-ok-{r}" for r in peers]
+                + [f"shm-arena-ok-{r}" for r in peers]
+                + [f"shm-flat-ok-{r}" for r in peers]
+                + [f"shm-cabi-{r}" for r in peers])
+            if any(v is None for v in vals):
+                return False    # some peer has not published its verdict
+            n = len(peers)
+            my_ok, my_arena, my_flat = self._my_verdicts
+            all_ok = my_ok and all(v == "1" for v in vals[:n])
+            all_arena = my_arena and all(v == "1" for v in vals[n:2 * n])
+            all_flat = my_flat and all(v == "1" for v in vals[2 * n:3 * n])
+            if dead:
+                # degraded wire: a local rank died before its verdict
+                # landed — no unanimous agreement can include it
+                all_ok = all_arena = all_flat = False
+                self.cabi_ranks.update(dead)
+            for r, v in zip(peers, vals[3 * n:]):
+                if v != "0":
                     # unknown counts as C-ABI: the conservative verdict
                     # is the shared FP_COLL_MAX cap
                     self.cabi_ranks.add(r)
-            except Exception:
-                all_ok = all_arena = all_flat = False
-                self.cabi_ranks.add(r)
+            self._apply_wire(all_ok, all_arena, all_flat, my_flat)
+        return self._wired
+
+    def _attach_follower_arena(self) -> None:
+        """Follower-side arena attach, run inside the wire step: the
+        leader's card is published with its build cards (bell presence
+        implies card presence), so this never blocks."""
+        try:
+            card = self.kvs.peek_many(
+                [f"shm-arena-{self.local_ranks[0]}"])[0]
+            if card:
+                apath, part = card.rsplit(":", 1)
+                self.arena = ShmArena(apath, self.n_local,
+                                      self.local_index[self.my_rank],
+                                      int(part), create=False)
+        except Exception as e:
+            log.warn("arena attach failed (%s); scratch-file rendezvous",
+                     e)
+            self.arena = None
+
+    def _apply_wire(self, all_ok: bool, all_arena: bool, all_flat: bool,
+                    my_flat: bool) -> None:  # holds: _wire_lock
+        """Stage 2: apply the unanimous agreements and go live."""
         self.cma_ok = all_ok
         if not all_arena and self.arena is not None:
-            self.arena.close(unlink=self._owner)
+            self.arena.close(unlink=self._owner and not self._daemon)
             self.arena = None
         self._arena_ready = self.arena is not None
-        if not self.plane:
-            return
-        lib = self._ring.lib
-        if not all_flat and my_flat:
-            lib.cp_flat_disable(self.plane)
-        if all_flat:
-            # python-rank progress hook for flat-collective waits: a
-            # rank parked in a flat wave still runs forwarded python
-            # work (rendezvous assists) so peers cannot deadlock.
-            # Runs INSIDE cp_flat_* wait loops, so it must never block
-            # (a sleep here stalls the whole node's wave).
-            import ctypes as _ct
-
-            def _flat_progress():  # mv2tlint: handler
-                from ..runtime import universe as uni
-                try:
-                    u = uni.current_universe()
-                    if u is not None:
-                        u.engine.progress_poke()
-                except Exception:
-                    pass
-            self._flat_cb = _ct.CFUNCTYPE(None)(_flat_progress)
-            lib.cp_flat_set_progress_cb(
-                self.plane, _ct.cast(self._flat_cb, _ct.c_void_p))
-        for r in self.local_ranks:
-            lib.cp_set_world(self.plane, self.local_index[r], r)
-            if r == self.my_rank:
-                continue
-            try:
-                addr = self.kvs.get(f"shm-bell-{r}")
-            except Exception:
-                continue
-            self._peer_bells[r] = addr
-            lib.cp_set_bell(self.plane, self.local_index[r], addr.encode())
-        lib.cp_register_global(self.plane)
-        if all_ok:
-            lib.cp_set_cma(self.plane, 1)
-        # arm the C-side lease scans (flat waves, wait quanta) with the
-        # same timeout the python probe uses
-        lib.cp_set_peer_timeout(self.plane,
-                                int(self._peer_timeout * 1e6))
-        # rebind the plane counters' sources to this live plane:
-        # fast-path hit-rate is the one number that says whether a
-        # workload actually rides the C path. Totals from earlier planes
-        # in this process (latched at close) stay included.
-        for idx, (name, desc) in enumerate(_PV_PLANE_DECLS):
-            pv = _mpit.pvar(name, _mpit.PVAR_CLASS_COUNTER, "shm", desc)
-            base = pv._value
-            pv.source = (lambda i=idx, b=base:
-                         b + float(self.plane_stats()[i]))
-        for idx, (name, desc) in enumerate(_FP_COUNTERS):
-            pv = _mpit.pvar(name, _mpit.PVAR_CLASS_COUNTER, "fastpath",
-                            desc)
-            base = pv._value
-            pv.source = (lambda i=idx, b=base:
-                         b + float(self.fp_counter(i)))
+        if self.plane:
+            lib = self._ring.lib
+            if not all_flat and my_flat:
+                lib.cp_flat_disable(self.plane)
+            if all_ok:
+                lib.cp_set_cma(self.plane, 1)
+            # open the C fast path's collective dispatch LAST: every
+            # agreement verdict above must be visible first (release
+            # store; fpc_enter's acquire load pairs with it)
+            lib.cp_set_wired(self.plane)
+        self._wired = True
+        (pv_wiring_eager if self._wire_eager else pv_wiring_lazy).inc()
+        log.info("node wire complete (cma=%s arena=%s flat=%s, %s)",
+                 all_ok, all_arena, all_flat,
+                 "eager" if self._wire_eager else "lazy")
 
     def _make_ring(self, path: str, ring_bytes: int, create: bool):
         lib = _load_native()
@@ -1080,6 +1289,11 @@ class ShmChannel(Channel):
                     return
 
     def poll(self) -> bool:
+        # opportunistic lazy-wiring probe (throttled; one time read +
+        # attr check when wired): upgrades pt2pt-only workloads to the
+        # full agreement without any blocking gate
+        if not self._wired:
+            self.try_wire()
         if self.plane:
             return self._poll_plane()
         my_i = self.local_index[self.my_rank]
@@ -1198,6 +1412,11 @@ class ShmChannel(Channel):
         arr = np.ascontiguousarray(array).view(np.uint8).reshape(-1)
         if arr.size == 0:
             return ("null",)
+        if not self._wired:
+            # a rendezvous is the natural upgrade point: both ends are
+            # live. Nonblocking — while unwired the ladder degrades to
+            # the scratch-file path, which needs no agreement.
+            self.try_wire(force=True)
         if self.cma_ok:
             self._expose_tok += 1
             h = ("cma", os.getpid(), arr.ctypes.data, self._expose_tok)
@@ -1318,7 +1537,7 @@ class ShmChannel(Channel):
                     log.warn("arena handle leak at close: %d exposures, "
                              "%d arena blocks live", len(self._exposed),
                              self.arena.outstanding)
-            self.arena.close(unlink=self._owner)
+            self.arena.close(unlink=self._owner and not self._daemon)
         try:
             self._bell.close()
             os.unlink(self._bell_path)
@@ -1335,8 +1554,15 @@ class ShmChannel(Channel):
         except Exception:
             pass
         if self._owner:
-            for path in (self.path, self._flags_path, self._flat_path):
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
+            if self._daemon_claim is not None:
+                # warm-attach mode: the segment files belong to the node
+                # daemon — release the claim (next job resets + reuses)
+                from ..runtime import daemon as _daemon
+                _daemon.release(self._daemon_claim)
+            elif not self._daemon:
+                for path in (self.path, self._flags_path,
+                             self._flat_path):
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass

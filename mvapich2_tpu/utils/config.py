@@ -18,8 +18,7 @@ from __future__ import annotations
 
 import os
 import threading
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Dict, Optional
 
 ENV_PREFIX = "MV2T_"
 
@@ -49,22 +48,33 @@ def _parse(typ: type, raw: str) -> Any:
     return raw
 
 
-@dataclass
 class CVar:
     """One control variable: name, type, default, group, description.
 
     Mirrors the fields of the reference's mv2_env_param_list entries
-    (gen2/ibv_env_params.c) and the MPI_T cvar info blocks.
-    """
+    (gen2/ibv_env_params.c) and the MPI_T cvar info blocks. A plain
+    class, not a dataclass: this module sits on the C-ABI light boot
+    path and ``dataclasses`` drags in ``inspect`` (~7 ms of MPI_Init
+    on the 1-core bench host)."""
 
-    name: str
-    default: Any
-    typ: type
-    group: str
-    desc: str
-    choices: Optional[tuple] = None
-    _value: Any = None
-    _explicit: bool = False  # set via env or set_value (not default)
+    __slots__ = ("name", "default", "typ", "group", "desc", "choices",
+                 "_value", "_explicit")
+
+    def __init__(self, name: str, default: Any, typ: type,
+                 group: str = "general", desc: str = "",
+                 choices: Optional[tuple] = None):
+        self.name = name
+        self.default = default
+        self.typ = typ
+        self.group = group
+        self.desc = desc
+        self.choices = choices
+        self._value = None
+        self._explicit = False  # set via env or set_value (not default)
+
+    def __repr__(self):
+        return (f"CVar(name={self.name!r}, default={self.default!r}, "
+                f"typ={self.typ!r}, group={self.group!r})")
 
     @property
     def env_name(self) -> str:

@@ -278,6 +278,12 @@ struct CPlane {
   int bell_tx;                   // unbound dgram socket for sendto
   int cma_enabled;               // large-message CMA rendezvous usable
                                  // (probed by bootstrap, cp_set_cma)
+  // lazy wiring: stores 1 (release) when the python wire step applies
+  // the node's unanimous agreement; the C collective dispatch requires
+  // it (acquire) before choosing a tier — a pre-wire collective falls
+  // back to the shim, whose python gate completes the wire at a point
+  // where every member is known to arrive
+  int wired;                     /* shared: atomic(wire) */
   // per-collective-context tag sequence, shared by the python coll
   // layer and the C fast path so their schedules use matching tags
   int* ctags;                    // (ctx, seq) pairs
@@ -1155,6 +1161,17 @@ long long cp_send_rndv(void* cp, int dst, int ctx, int comm_src, int tag,
 
 void cp_set_cma(void* cp, int enabled) {
   static_cast<CPlane*>(cp)->cma_enabled = enabled;
+}
+
+// wire state (lazy wiring; transport/shm.py _apply_wire)
+void cp_set_wired(void* cp) {
+  __atomic_store_n(&static_cast<CPlane*>(cp)->wired, 1,
+                   __ATOMIC_RELEASE);
+}
+
+int cp_wired(void* cp) {
+  return __atomic_load_n(&static_cast<CPlane*>(cp)->wired,
+                         __ATOMIC_ACQUIRE);
 }
 
 // the wire id a rendezvous send travels under (cancel initiators need

@@ -76,6 +76,7 @@ static struct {
     int (*coll_tag)(cph, int);
     /* flat-slot collective tier + fast-path counters (cplane.cpp) */
     int (*flat_ok)(cph);
+    int (*wired)(cph);
     long long (*flat_base)(cph, int, int);
     int (*flat_allreduce)(cph, int, int, int, int, long long, int, int,
                           const void *, void *, long long, long long);
@@ -164,6 +165,7 @@ static int fp_load_locked(void) {
     SYM(req_own_tmp, "cp_req_own_tmp");
     SYM(coll_tag, "cp_coll_tag");
     SYM(flat_ok, "cp_flat_ok");
+    SYM(wired, "cp_wired");
     SYM(flat_base, "cp_flat_base");
     SYM(flat_allreduce, "cp_flat_allreduce");
     SYM(flat_reduce, "cp_flat_reduce");
@@ -541,7 +543,12 @@ static void fp_block_req(cph p, long long cpid) {
             slept = 1;
             if (fp_spin_us > 4)
                 fp_spin_us /= 2;
-            if (++idle % 16 == 0 || F.any_failed(p))
+            /* while the node is UNWIRED, every idle quantum runs a
+             * python pass: the progress poll's try_wire is what
+             * publishes this rank's wiring cards, and a peer blocked
+             * in its wire gate (collective entry) is waiting on them —
+             * a C-parked rank must not stall the node's wire */
+            if (++idle % 16 == 0 || F.any_failed(p) || !F.wired(p))
                 fp_py_progress();
         } else {
             /* rc 3: woken by the doorbell — the peer only progressed
@@ -1247,6 +1254,20 @@ static cph fpc_enter(int count, MPI_Datatype dt, MPI_Comm comm,
     if (fc == NULL) {
         if (dbg)
             fprintf(stderr, "fpc: comm %d not plane-bound\n", comm);
+        return NULL;
+    }
+    /* lazy wiring: tier choice consults the unanimous node agreement
+     * (flat attach, CMA band), which completes only at the wire step.
+     * Pre-wire, EVERY member must take the shim path — its python gate
+     * (coll/api.py _plane_engine) blocks until the node wires, so the
+     * whole collective re-enters with identical post-wire verdicts.
+     * A mixed wired/unwired collective still agrees: the wired side's
+     * C dispatch and the unwired side's python flatcoll drive the SAME
+     * cp_flat engine and call numbering. */
+    if (!F.wired(p)) {
+        if (dbg)
+            fprintf(stderr, "fpc: node not wired yet\n");
+        FPCTR(FPC_FB_PLANE);
         return NULL;
     }
     long nb = elsz * count;

@@ -73,6 +73,12 @@ int ensure_python(void) {
     if (g_shim != NULL)
         return MPI_SUCCESS;
     if (!Py_IsInitialized()) {
+        /* no `site` at MPI_Init: processing site-packages (.pth files,
+         * sitecustomize -> importlib.util/contextlib) costs ~20 ms of
+         * cold start and the light boot path is stdlib-only. The
+         * deferred world build runs site.main() before importing the
+         * heavy shim (mvapich2_tpu.cabi_boot._ensure_world). */
+        Py_NoSiteFlag = 1;
         Py_InitializeEx(0);
         g_we_initialized_python = 1;
     }
@@ -83,10 +89,13 @@ int ensure_python(void) {
     if (sys_path && root)
         PyList_Insert(sys_path, 0, root);
     Py_XDECREF(root);
-    g_shim = PyImport_ImportModule("mvapich2_tpu.cshim");
+    /* the LIGHT entry module (stdlib-only import): MPI_Init runs the
+     * batched KVS boot; the heavy shim (numpy + protocol stack) loads
+     * lazily on the first call that needs a built world */
+    g_shim = PyImport_ImportModule("mvapich2_tpu.cabi_boot");
     if (g_shim == NULL) {
         PyErr_Print();
-        fprintf(stderr, "libmpi: cannot import mvapich2_tpu.cshim "
+        fprintf(stderr, "libmpi: cannot import mvapich2_tpu.cabi_boot "
                         "(repo root: %s)\n", MV2T_REPO_ROOT);
         PyGILState_Release(st);
         return MPI_ERR_INTERN;

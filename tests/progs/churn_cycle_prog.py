@@ -1,0 +1,27 @@
+"""One python-rank connect/disconnect cycle: Init, optional 4B
+allreduce (argv[1] present), Finalize. The python twin of
+benchmarks/c/churn_cycle.c for hosts without a C toolchain and for the
+tier-1 churn smoke (tests/test_daemon.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np  # noqa: E402
+
+from mvapich2_tpu import mpi  # noqa: E402
+
+
+def main() -> int:
+    mpi.Init()
+    if len(sys.argv) > 1:
+        out = np.zeros(1, dtype=np.int32)
+        mpi.COMM_WORLD.allreduce(np.ones(1, dtype=np.int32), out)
+    mpi.Finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

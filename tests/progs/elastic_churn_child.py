@@ -1,0 +1,22 @@
+"""One elastic session world: join, one exchange with the resident
+world, leave. Spawned per cycle by elastic_churn_prog.py. Intercomm
+allreduce semantics: each side receives the OTHER group's reduction —
+the session contributes 1000, and receives the resident ranks' sum."""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+from mvapich2_tpu import mpi  # noqa: E402
+
+mpi.Init()
+parent = mpi.Comm_get_parent()
+assert parent is not None and parent.is_inter, "no parent intercomm"
+
+got = parent.allreduce(np.array([1000], dtype=np.int64))
+assert int(got[0]) == sum(range(parent.remote_size)), got
+parent.disconnect()
+mpi.Finalize()
+sys.exit(0)

@@ -1,0 +1,69 @@
+"""Elastic rank churn under load (ROADMAP item 3's serving scenario):
+the resident world keeps an allreduce load running while session
+worlds JOIN (MPI_Comm_spawn), do one intercomm exchange, and LEAVE
+(disconnect) — repeatedly. Measures sustained join/leave cycles/s.
+
+argv[1] = number of cycles (default 3). Prints per-cycle timings, the
+cycles/s rate, and 'No Errors' from rank 0. The warm-attach daemon
+(MV2T_DAEMON=1) serves the resident world's segments; the child
+worlds' bootstrap rides the same KVS.
+
+Extends the ft/ dup/split-churn tests into the sustained elastic
+shape (tests/test_ft.py::test_elastic_join_leave_under_load)."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+from mvapich2_tpu import mpi  # noqa: E402
+
+CYCLES = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+child = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "elastic_churn_child.py")
+
+errs = 0
+per_cycle = []
+for i in range(CYCLES):
+    t0 = time.perf_counter()
+    # resident load: the serving world keeps computing while a session
+    # joins — collectives before, between, and after the join
+    out = comm.allreduce(np.full(1024, 1.0 + i))
+    if out[0] != comm.size * (1.0 + i):
+        errs += 1
+        print(f"rank {comm.rank}: load allreduce wrong at cycle {i}")
+    inter, codes = mpi.Comm_spawn([sys.executable, child], maxprocs=1,
+                                  root=0, comm=comm)
+    if any(codes):
+        errs += 1
+        print(f"rank {comm.rank}: cycle {i} spawn codes {codes}")
+    # one session exchange (intercomm semantics: each side receives the
+    # OTHER group's reduction — the child contributes 1000)
+    got = inter.allreduce(np.array([comm.rank], dtype=np.int64))
+    if int(got[0]) != 1000:
+        errs += 1
+        print(f"rank {comm.rank}: cycle {i} inter allreduce {got[0]} "
+              f"!= 1000")
+    inter.disconnect()
+    out = comm.allreduce(np.ones(8))
+    if out[0] != float(comm.size):
+        errs += 1
+        print(f"rank {comm.rank}: post-leave allreduce wrong at {i}")
+    per_cycle.append(time.perf_counter() - t0)
+
+total = sum(per_cycle)
+if comm.rank == 0:
+    print(f"elastic: {CYCLES} join/leave cycles under load, "
+          f"{CYCLES / total:.2f} cycles/s "
+          f"(per-cycle {['%.2f' % s for s in per_cycle]})")
+tot = comm.allreduce(np.array([errs], dtype=np.int64))
+mpi.Finalize()
+if comm.rank == 0 and int(tot[0]) == 0:
+    print("No Errors")
+sys.exit(1 if errs else 0)

@@ -1,0 +1,120 @@
+"""Lazy-wiring first-contact program: touch an unwired peer through
+ONE chosen datapath shape and prove correctness + observability.
+
+Mode (argv[1]):
+  eager   4 B ring send/recv — must complete while the node is still
+          UNWIRED (no agreement needed), then a collective wires it
+  rndv    512 KiB pairwise exchange — first contact via the rendezvous
+          ladder (degrades to scratch-file pre-wire, upgrades in place)
+  flat    4 B allreduce loop — first contact via the flat-slot tier
+          (the collective gate wires before tier choice)
+  arena   1 MiB allreduce — first contact via the arena/CMA sectioned
+          tier
+
+Every rank asserts data correctness and that exactly one wire happened
+on its shm channel, attributed to the expected pvar
+(wiring_lazy by default; wiring_eager under MV2T_LAZY_WIRING=0).
+Prints 'lazywire: rank=R mode=M wired=eager|lazy OK'; the lowest rank
+prints 'No Errors' on success (tests/test_lazy_wiring.py greps it).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np  # noqa: E402
+
+from mvapich2_tpu import mpi, mpit  # noqa: E402
+
+MODE = sys.argv[1] if len(sys.argv) > 1 else "eager"
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+rank, size = comm.rank, comm.size
+sch = comm.u.shm_channel
+
+
+def fail(msg):
+    print(f"lazywire: rank={rank} FAIL {msg}", flush=True)
+    mpi.Abort(comm, 1)
+
+
+if MODE == "eager":
+    # pre-wire eager pt2pt: no agreement required, must not block
+    if sch is not None and sch._wired \
+            and int(os.environ.get("MV2T_LAZY_WIRING", "1")):
+        fail("channel wired before first contact")
+    peer = rank ^ 1
+    if peer < size:
+        s = np.full(1, rank + 1, dtype=np.int32)
+        r = np.zeros(1, dtype=np.int32)
+        if rank < peer:
+            comm.send(s, peer, tag=7)
+            comm.recv(r, peer, tag=7)
+        else:
+            comm.recv(r, peer, tag=7)
+            comm.send(s, peer, tag=7)
+        if r[0] != peer + 1:
+            fail(f"eager exchange got {r[0]} want {peer + 1}")
+    # now force the wire through a collective
+    out = np.zeros(1, dtype=np.int32)
+    comm.allreduce(np.ones(1, dtype=np.int32), out)
+    if out[0] != size:
+        fail(f"allreduce got {out[0]} want {size}")
+elif MODE == "rndv":
+    n = 512 * 1024
+    peer = rank ^ 1
+    if peer < size:
+        s = np.arange(n, dtype=np.uint8)
+        s += np.uint8(rank)
+        r = np.zeros(n, dtype=np.uint8)
+        if rank < peer:
+            comm.send(s, peer, tag=9)
+            comm.recv(r, peer, tag=9)
+        else:
+            comm.recv(r, peer, tag=9)
+            comm.send(s, peer, tag=9)
+        want = np.arange(n, dtype=np.uint8)
+        want += np.uint8(peer)
+        if not np.array_equal(r, want):
+            fail("rendezvous payload mismatch")
+    out = np.zeros(1, dtype=np.int32)
+    comm.allreduce(np.ones(1, dtype=np.int32), out)
+elif MODE == "flat":
+    out = np.zeros(1, dtype=np.int32)
+    for it in range(5):
+        comm.allreduce(np.full(1, rank + it, dtype=np.int32), out)
+        want = sum(r + it for r in range(size))
+        if out[0] != want:
+            fail(f"flat allreduce iter {it} got {out[0]} want {want}")
+elif MODE == "arena":
+    n = (1 << 20) // 8
+    s = np.full(n, float(rank + 1), dtype=np.float64)
+    out = np.zeros(n, dtype=np.float64)
+    comm.allreduce(s, out)
+    want = float(sum(r + 1 for r in range(size)))
+    if not np.allclose(out, want):
+        fail(f"arena allreduce got {out[0]} want {want}")
+else:
+    fail(f"unknown mode {MODE}")
+
+# observability: exactly one wire on this channel, rightly attributed
+lazy = mpit.pvar("wiring_lazy").read()
+eager = mpit.pvar("wiring_eager").read()
+if sch is not None:
+    if not sch._wired:
+        fail("channel still unwired after first contact")
+    expect_lazy = bool(int(os.environ.get("MV2T_LAZY_WIRING", "1")))
+    if expect_lazy and not (lazy == 1 and eager == 0):
+        fail(f"pvars lazy={lazy} eager={eager}, want lazy-only")
+    if not expect_lazy and not (eager == 1 and lazy == 0):
+        fail(f"pvars lazy={lazy} eager={eager}, want eager-only")
+wired_how = "lazy" if lazy else ("eager" if eager else "none")
+print(f"lazywire: rank={rank} mode={MODE} wired={wired_how} OK",
+      flush=True)
+mpi.Finalize()
+if rank == 0:
+    print("No Errors")

@@ -1,0 +1,87 @@
+/* lazywire_test.c — lazy-wiring first contact through the C ABI.
+ * Mode argv[1] (default "eager"):
+ *   eager  4 B ring sendrecv before any collective (must complete
+ *          while the node is unwired), then an allreduce
+ *   rndv   512 KiB pairwise exchange first (rendezvous ladder
+ *          degrades to scratch-file pre-wire, upgrades in place)
+ *   flat   small allreduce loop first (the shim's collective gate
+ *          wires the node, later iterations ride the C flat tier)
+ *   arena  1 MiB allreduce first (arena/CMA sectioned tier)
+ * Prints "No Errors" from rank 0 (tests/test_lazy_wiring.py). */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static int errs = 0;
+
+int main(int argc, char **argv) {
+    const char *mode = argc > 1 ? argv[1] : "eager";
+    MPI_Init(&argc, &argv);
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    int peer = rank ^ 1;
+
+    if (!strcmp(mode, "eager") && peer < size) {
+        int s = rank + 1, r = -1;
+        MPI_Sendrecv(&s, 1, MPI_INT, peer, 7, &r, 1, MPI_INT, peer, 7,
+                     MPI_COMM_WORLD, MPI_STATUS_IGNORE);
+        if (r != peer + 1) {
+            errs++;
+            fprintf(stderr, "rank %d: eager got %d want %d\n",
+                    rank, r, peer + 1);
+        }
+    } else if (!strcmp(mode, "rndv") && peer < size) {
+        long n = 512 * 1024;
+        unsigned char *s = malloc(n), *r = malloc(n);
+        for (long i = 0; i < n; i++) s[i] = (unsigned char)(i + rank);
+        memset(r, 0, n);
+        MPI_Sendrecv(s, (int)n, MPI_BYTE, peer, 9, r, (int)n, MPI_BYTE,
+                     peer, 9, MPI_COMM_WORLD, MPI_STATUS_IGNORE);
+        for (long i = 0; i < n; i++)
+            if (r[i] != (unsigned char)(i + peer)) {
+                errs++;
+                fprintf(stderr, "rank %d: rndv mismatch at %ld\n",
+                        rank, i);
+                break;
+            }
+        free(s);
+        free(r);
+    } else if (!strcmp(mode, "arena")) {
+        long n = (1 << 20) / sizeof(double);
+        double *s = malloc(n * sizeof(double));
+        double *r = malloc(n * sizeof(double));
+        for (long i = 0; i < n; i++) s[i] = rank + 1.0;
+        MPI_Allreduce(s, r, (int)n, MPI_DOUBLE, MPI_SUM,
+                      MPI_COMM_WORLD);
+        double want = size * (size + 1) / 2.0;
+        if (r[0] != want || r[n - 1] != want) {
+            errs++;
+            fprintf(stderr, "rank %d: arena allreduce got %f want %f\n",
+                    rank, r[0], want);
+        }
+        free(s);
+        free(r);
+    }
+
+    /* every mode finishes with small allreduces: wires the node if the
+     * first contact didn't, and exercises the post-wire flat tier */
+    for (int it = 0; it < 5; it++) {
+        int x = rank + it, y = -1;
+        MPI_Allreduce(&x, &y, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD);
+        int want = it * size + size * (size - 1) / 2;
+        if (y != want) {
+            errs++;
+            fprintf(stderr, "rank %d: flat allreduce it=%d got %d "
+                            "want %d\n", rank, it, y, want);
+        }
+    }
+
+    int tot = 0;
+    MPI_Allreduce(&errs, &tot, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD);
+    if (rank == 0 && tot == 0)
+        printf("No Errors\n");
+    MPI_Finalize();
+    return tot ? 1 : 0;
+}

@@ -33,11 +33,11 @@ def _mpirun(np_, prog, *args, timeout=240):
 
 
 def test_cshim_bootstrap_stays_light():
-    """The C-ABI bootstrap (libmpi.so embedding -> import cshim) must
-    never pull the device layer: jax et al. cost seconds of MPI_Init
-    wall time on a cold host (r5 measured 3.0 s) for jobs that never
-    touch a device. bin/bench_osu enforces the wall-clock budget; this
-    guards the import graph itself."""
+    """The C-ABI world build (deferred import of cshim) must never pull
+    the device layer: jax et al. cost seconds of MPI_Init wall time on
+    a cold host (r5 measured 3.0 s) for jobs that never touch a device.
+    bin/bench_osu enforces the wall-clock budget; this guards the
+    import graph itself."""
     code = (
         "import sys\n"
         "import mvapich2_tpu.cshim\n"
@@ -52,6 +52,46 @@ def test_cshim_bootstrap_stays_light():
     assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
     assert "HEAVY=\n" in r.stdout or r.stdout.strip().endswith("HEAVY="), \
         f"heavy modules on the C-ABI bootstrap path: {r.stdout}"
+
+
+def test_light_boot_path_stays_stdlib_only():
+    """The LIGHT entry (what libmpi.so imports at MPI_Init —
+    mvapich2_tpu.cabi_boot, runtime/boot.py, runtime/daemon.py,
+    runtime/kvs.py) must stay numpy-free: numpy import alone is
+    ~70-90 ms on the bench host, more than the whole osu_init budget.
+    The same guard covers the daemon (it runs claim() inside Init)."""
+    code = (
+        "import sys\n"
+        "import mvapich2_tpu.cabi_boot\n"
+        "import mvapich2_tpu.runtime.boot\n"
+        "import mvapich2_tpu.runtime.daemon\n"
+        "import mvapich2_tpu.runtime.kvs\n"
+        "import mvapich2_tpu.faults\n"
+        "heavy = [m for m in ('numpy', 'jax', 'jaxlib',\n"
+        "                     'mvapich2_tpu.core', 'mvapich2_tpu.cshim',\n"
+        "                     'mvapich2_tpu.transport.shm',\n"
+        "                     'mvapich2_tpu.pt2pt.protocol')\n"
+        "         if m in sys.modules]\n"
+        "print('HEAVY=' + ','.join(heavy))\n")
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "HEAVY=\n" in r.stdout or r.stdout.strip().endswith("HEAVY="), \
+        f"heavy modules on the light MPI_Init path: {r.stdout}"
+
+
+def test_init_finalize_only_job_stays_light():
+    """A pure Init/Finalize C job (the churn shape) must complete
+    without ever building the world: no numpy in the rank process.
+    sys.modules can't be read from outside, so assert the observable
+    contract — the job exits 0 fast and the finalize rendezvous kept
+    it light (exercised via benchmarks/c/churn_cycle.c)."""
+    bld = tempfile.mkdtemp()
+    exe = os.path.join(bld, "churn_cycle")
+    _compile([os.path.join(REPO, "benchmarks", "c", "churn_cycle.c")],
+             exe)
+    r = _mpirun(2, exe)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
 
 
 def test_cabi_conformance_prog():

@@ -1,0 +1,172 @@
+"""Warm-attach node daemon (runtime/daemon.py) + churn bench smoke.
+
+Unit level: claim/release/epoch protocol, versioned handshake, reset
+zeroing, stale-epoch sweep. End to end: two sequential jobs with
+MV2T_DAEMON=1 reuse the same segment set (warm attach), and the churn
+bench (mvapich2_tpu.bench.churn) stays wired."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from mvapich2_tpu.runtime import daemon  # noqa: E402
+
+
+@pytest.fixture()
+def ddir(monkeypatch):
+    d = tempfile.mkdtemp(prefix="mv2t-daemon-test-")
+    # unit tests drive the manifest protocol directly — no serve loop
+    monkeypatch.setenv("MV2T_DAEMON_SPAWN", "0")
+    from mvapich2_tpu.utils.config import get_config
+    get_config().reload()
+    yield d
+    shutil.rmtree(d, ignore_errors=True)
+
+
+def test_claim_creates_and_epochs(ddir):
+    c = daemon.claim(2, 1 << 20, 1 << 20, ddir)
+    assert c is not None and c.epoch == 1
+    for p, want in ((c.ring, 4 << 20), (c.flags, 24),
+                    (c.flat, 0), (c.arena, 4096 + 2 * (1 << 20))):
+        assert os.path.getsize(p) == want, p
+    # busy set with a live owner is not claimable
+    assert daemon.claim(2, 1 << 20, 1 << 20, ddir) is None
+    daemon.release(c)
+    c2 = daemon.claim(2, 1 << 20, 1 << 20, ddir)
+    assert c2 is not None and c2.epoch == 2
+    daemon.release(c2)
+
+
+def test_claim_resets_previous_epoch(ddir):
+    c = daemon.claim(2, 1 << 20, 1 << 20, ddir)
+    with open(c.ring, "r+b") as f:
+        f.write(b"\xab" * 4096)   # stale protocol words from this epoch
+    daemon.release(c)
+    c2 = daemon.claim(2, 1 << 20, 1 << 20, ddir)
+    with open(c2.ring, "rb") as f:
+        assert f.read(4096) == b"\x00" * 4096, \
+            "claim must never expose the previous epoch's words"
+    daemon.release(c2)
+
+
+def test_stale_epoch_sweep(ddir):
+    c = daemon.claim(2, 1 << 20, 1 << 20, ddir)
+    # simulate a SIGKILLed owner: mark the set busy under a dead pid
+    with daemon._manifest_txn(ddir) as m:
+        m["sets"][c.geokey]["owner_pid"] = 2 ** 22 + 12345
+    assert daemon.sweep(ddir) == 1
+    c2 = daemon.claim(2, 1 << 20, 1 << 20, ddir)
+    assert c2 is not None and c2.epoch == c.epoch + 1
+    daemon.release(c2)
+
+
+def test_dead_owner_reclaimed_at_claim(ddir):
+    c = daemon.claim(2, 1 << 20, 1 << 20, ddir)
+    with daemon._manifest_txn(ddir) as m:
+        m["sets"][c.geokey]["owner_pid"] = 2 ** 22 + 54321
+    # no sweep in between: the claim itself reclaims the stale epoch
+    c2 = daemon.claim(2, 1 << 20, 1 << 20, ddir)
+    assert c2 is not None and c2.epoch == c.epoch + 1
+    daemon.release(c2)
+
+
+def test_version_handshake_refuses_mismatch(ddir):
+    c = daemon.claim(2, 1 << 20, 1 << 20, ddir)
+    daemon.release(c)
+    with daemon._manifest_txn(ddir) as m:
+        m["version"] = daemon.MANIFEST_VERSION + 1
+    assert daemon.claim(2, 1 << 20, 1 << 20, ddir) is None
+
+
+def test_geometry_keys_are_disjoint(ddir):
+    a = daemon.claim(2, 1 << 20, 1 << 20, ddir)
+    b = daemon.claim(4, 1 << 20, 1 << 20, ddir)
+    assert a is not None and b is not None
+    assert a.geokey != b.geokey and a.ring != b.ring
+    daemon.release(a)
+    daemon.release(b)
+
+
+def test_status_cli(ddir):
+    c = daemon.claim(2, 1 << 20, 1 << 20, ddir)
+    st = daemon.status(ddir)
+    assert st["sets"][c.geokey]["state"] == "busy"
+    assert st["daemon_alive"] is False
+    daemon.release(c)
+
+
+def _run_job(env_extra, argv, timeout=300):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, "-m", "mvapich2_tpu.run", "-np", "2", *argv],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def test_warm_attach_two_jobs_reuse_segments(tmp_path):
+    """End to end: two sequential np2 jobs with MV2T_DAEMON=1 share one
+    segment set (epoch 1 then 2), and the second job's collectives are
+    correct on the reused (reset) segments."""
+    d = str(tmp_path / "dd")
+    prog = os.path.join(REPO, "tests", "progs", "lazywire_prog.py")
+    env = {"MV2T_DAEMON": "1", "MV2T_DAEMON_DIR": d,
+           "MV2T_DAEMON_SPAWN": "0"}
+    for i in (1, 2):
+        r = _run_job(env, [sys.executable, prog, "flat"])
+        assert r.returncode == 0, \
+            f"job {i}: stdout={r.stdout}\nstderr={r.stderr}"
+        assert "No Errors" in r.stdout
+    with open(os.path.join(d, "manifest.json")) as f:
+        m = json.load(f)
+    sets = list(m["sets"].values())
+    assert len(sets) == 1, "both jobs must reuse ONE geometry set"
+    assert sets[0]["epoch"] == 2
+    assert sets[0]["state"] == "free"
+
+
+def test_daemon_off_is_default_path(tmp_path):
+    """MV2T_DAEMON unset: no daemon dir is created or touched."""
+    d = str(tmp_path / "dd")
+    prog = os.path.join(REPO, "tests", "progs", "lazywire_prog.py")
+    r = _run_job({"MV2T_DAEMON_DIR": d}, [sys.executable, prog, "flat"])
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert not os.path.exists(d)
+
+
+def test_churn_smoke(tmp_path):
+    """Tier-1 churn-bench smoke: a few Init/Finalize cycles complete
+    through the launcher with the daemon on and off, and report a
+    positive cycles/s (the full measurement lives in bin/bench_osu)."""
+    from mvapich2_tpu.bench.churn import churn_rate
+    prog = os.path.join(REPO, "tests", "progs", "churn_cycle_prog.py")
+    env = {"MV2T_DAEMON_DIR": str(tmp_path / "dd"),
+           "MV2T_DAEMON_SPAWN": "0", "JAX_PLATFORMS": "cpu"}
+    for dm in (0, 1):
+        r = churn_rate([sys.executable, prog], np_=2, cycles=2,
+                       daemon=dm, env_extra=env, timeout=240)
+        assert r["cps"] > 0 and r["cycles"] == 2, r
+
+
+def test_serve_loop_idle_expiry(ddir):
+    """The serve loop exits after the idle timeout and unlinks free
+    sets (run with a subsecond budget; no background daemon left)."""
+    c = daemon.claim(2, 1 << 20, 1 << 20, ddir)
+    daemon.release(c)
+    rc = subprocess.run(
+        [sys.executable, "-m", "mvapich2_tpu.runtime.daemon", "--serve",
+         "--dir", ddir, "--idle", "0.1"],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert rc.returncode == 0, rc.stderr
+    assert not os.path.exists(c.ring)
+    with open(os.path.join(ddir, "manifest.json")) as f:
+        m = json.load(f)
+    assert m["daemon_pid"] == 0 and m["sets"] == {}

@@ -367,3 +367,21 @@ def _run_sigkill_chaos(np_, victim, phases, iters):
     assert any("err=75" in ln or "err=76" in ln
                for ln in r.stdout.splitlines()
                if ln.startswith("chaos: ")), r.stdout
+
+
+def test_elastic_join_leave_under_load():
+    """The sustained elastic scenario (ROADMAP item 3): session worlds
+    JOIN (spawn), exchange once with the resident world, and LEAVE
+    (disconnect) while the resident world keeps an allreduce load
+    running — at a measured cycles/s rate (printed by the prog). The
+    tier-1 budget keeps the cycle count small; bin/bench_osu's churn
+    measurement is the full-rate form."""
+    prog = os.path.join(REPO, "tests", "progs", "elastic_churn_prog.py")
+    cmd = [sys.executable, "-m", "mvapich2_tpu.run", "-np", "2",
+           sys.executable, prog, "2"]
+    r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                       timeout=300,
+                       env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+    assert "cycles/s" in r.stdout

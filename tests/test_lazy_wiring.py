@@ -1,0 +1,110 @@
+"""Lazy-wiring correctness sweep (PR 9 tentpole).
+
+First contact with an unwired peer through every datapath shape —
+eager send, rendezvous, flat collective, arena collective — through
+BOTH ABIs and np{2,4,8}, plus the kill-during-wire chaos site
+(MV2T_FAULTS=wire:crash) proving lease containment still holds when a
+rank dies inside the wire step."""
+
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROG = os.path.join(REPO, "tests", "progs", "lazywire_prog.py")
+CPROG = os.path.join(REPO, "tests", "progs", "lazywire_test.c")
+
+
+def _mpirun(np_, argv, env=None, timeout=300):
+    e = dict(os.environ)
+    e.setdefault("JAX_PLATFORMS", "cpu")
+    if env:
+        e.update(env)
+    return subprocess.run(
+        [sys.executable, "-m", "mvapich2_tpu.run", "-np", str(np_),
+         *argv],
+        cwd=REPO, env=e, capture_output=True, text=True, timeout=timeout)
+
+
+@pytest.mark.parametrize("np_", [2, 4])
+@pytest.mark.parametrize("mode", ["eager", "rndv", "flat", "arena"])
+def test_lazy_first_contact_python(mode, np_):
+    """Python ABI: first contact through each shape is correct, the
+    node wires exactly once, attributed to wiring_lazy."""
+    r = _mpirun(np_, [sys.executable, PROG, mode])
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+    assert r.stdout.count("wired=lazy OK") == np_
+
+
+@pytest.mark.parametrize("mode", ["eager", "rndv", "flat", "arena"])
+@pytest.mark.slow
+def test_lazy_first_contact_python_np8(mode):
+    r = _mpirun(8, [sys.executable, PROG, mode])
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+    assert r.stdout.count("wired=lazy OK") == 8
+
+
+def test_eager_wiring_mode_preserved():
+    """MV2T_LAZY_WIRING=0 restores the eager-at-Init semantics: the
+    wire happens at bootstrap (wiring_eager), never lazily."""
+    r = _mpirun(2, [sys.executable, PROG, "flat"],
+                env={"MV2T_LAZY_WIRING": "0"})
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+    assert r.stdout.count("wired=eager OK") == 2
+
+
+@pytest.mark.skipif(shutil.which("gcc") is None, reason="no C toolchain")
+@pytest.mark.parametrize("np_", [2, 4])
+@pytest.mark.parametrize("mode", ["eager", "rndv", "flat", "arena"])
+def test_lazy_first_contact_cabi(mode, np_):
+    """C ABI: the same first-contact sweep through libmpi.so — world
+    build AND wire both deferred past MPI_Init."""
+    out = os.path.join(tempfile.mkdtemp(), "lazywire_test")
+    rc = subprocess.run([os.path.join(REPO, "bin", "mpicc"), CPROG,
+                         "-o", out], capture_output=True, text=True,
+                        timeout=180)
+    assert rc.returncode == 0, f"mpicc: {rc.stdout}\n{rc.stderr}"
+    r = _mpirun(np_, [out, mode])
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(shutil.which("gcc") is None, reason="no C toolchain")
+def test_lazy_first_contact_cabi_np8():
+    out = os.path.join(tempfile.mkdtemp(), "lazywire_test")
+    rc = subprocess.run([os.path.join(REPO, "bin", "mpicc"), CPROG,
+                         "-o", out], capture_output=True, text=True,
+                        timeout=180)
+    assert rc.returncode == 0, f"mpicc: {rc.stdout}\n{rc.stderr}"
+    r = _mpirun(8, [out, "flat"], timeout=420)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+
+
+def test_kill_during_wire_contained():
+    """Chaos: rank 1 crashes INSIDE the wire step (site=wire). The
+    survivors' blocking wire gate must unwind with
+    MPIX_ERR_PROC_FAILED via the lease scan / failure events — never
+    hang, never complete a half-wired collective. The chaos prog
+    handles the error, shrinks, and finishes (its normal contract)."""
+    prog = os.path.join(REPO, "tests", "progs", "chaos_prog.py")
+    r = _mpirun(
+        4, [sys.executable, prog],
+        env={"MV2T_FAULTS": "wire@1:crash",
+             "MV2T_PEER_TIMEOUT": "3",
+             "MV2T_FT_WATCHER": "0",       # lease-only detection
+             "MPIEXEC_ALLOW_FAULT": "1",
+             "MV2T_CHAOS_PHASES": "flat,arena"},
+        timeout=420)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+    # the survivor must have seen a contained process-failure error
+    assert "err=" in r.stdout

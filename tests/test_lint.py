@@ -315,3 +315,42 @@ def test_device_engine_under_lint_ratchet():
         "    tracer.record('channel', 'x', 'i')\n"))
     assert len(RegistryPass().run(modules + [bad])) == 1
     assert len(TraceGuardPass().run([bad])) == 1
+
+
+def test_startup_modules_under_lint_ratchet():
+    """ISSUE 9 satellite: the startup-path modules (light boot, warm-
+    attach daemon, cabi_boot, churn bench) ride the same passes as the
+    datapath — they are in the scanned set, clean under the pvars and
+    blocking passes, and a seeded violation of each class in a
+    daemon-shaped module is caught (the ratchet actually bites)."""
+    import mvapich2_tpu
+    from mvapich2_tpu.analysis import core as acore
+
+    pkg = os.path.dirname(mvapich2_tpu.__file__)
+    modules, errors = acore.scan_paths([pkg])
+    assert not errors
+    names = {os.path.relpath(m.path, pkg) for m in modules}
+    for need in ("runtime/boot.py", "runtime/daemon.py", "cabi_boot.py",
+                 "bench/churn.py"):
+        assert need in names, need
+    from mvapich2_tpu.analysis.blocking import BlockingCallPass
+    from mvapich2_tpu.analysis.registry import RegistryPass
+    start_paths = {m.path for m in modules
+                   if os.path.relpath(m.path, pkg) in
+                   ("runtime/boot.py", "runtime/daemon.py",
+                    "cabi_boot.py", "bench/churn.py",
+                    "transport/shm.py")}
+    fs = RegistryPass().run(modules)   # cvar/pvar decls are cross-module
+    assert [f for f in fs if f.path in start_paths] == []
+    assert [f for f in BlockingCallPass().run(
+        [m for m in modules if m.path in start_paths])
+        if f.path in start_paths] == []
+    # a seeded undeclared-cvar env read + undeclared pvar in a
+    # daemon-shaped module is caught
+    bad = acore.SourceModule("runtime/bad_daemon_fixture.py", (
+        "import os\n"
+        "from .. import mpit\n"
+        "def claim():\n"
+        "    os.environ.get('MV2T_DAEMON_NEVER_DECLARED')\n"
+        "    mpit.pvar('daemon_claims_never_declared').inc()\n"))
+    assert len(RegistryPass().run(modules + [bad])) == 2
