@@ -158,7 +158,9 @@ def all_passes(native_sources: Optional[Sequence[str]] = None,
     from . import blocking, locks, native, registry, tags, traceguard
     return [locks.LockDisciplinePass(), tags.TagNamespacePass(),
             registry.RegistryPass(), blocking.BlockingCallPass(),
-            traceguard.TraceGuardPass(),
+            traceguard.TraceGuardPass(
+                list(native_sources) if native_sources is not None
+                else None),
             native.NativeSourcePass(
                 list(native_sources) if native_sources is not None else None,
                 layout=native_layout)]

@@ -596,8 +596,16 @@ class NativeSourcePass(LintPass):
             ("MV2T_RING_ALIGN", "shm._ALIGN"),
             ("MV2T_LEASE_ALIGN", "shm._LEASE_ALIGN"),
             ("MV2T_LEASE_STAMP_BYTES", "shm._LEASE_STAMP"),
+            ("MV2T_FPC_SLOTS", "shm._FPC_SLOTS"),
             ("MV2T_CTX_MASK_BASE", "universe.CTX_MASK_BASE"),
             ("MV2T_PKT_HDR_BYTES", "base._PKT_HDR.size"),
+            # native trace ring geometry (trace/native.py reads the
+            # segment file mechanically — a drifted stride misparses
+            # every record)
+            ("MV2T_NTR_FILE_HDR", "trace_native._NTR_FILE_HDR"),
+            ("MV2T_NTR_HDR_BYTES", "trace_native._NTR_HDR_BYTES"),
+            ("MV2T_NTR_EV_BYTES", "trace_native._NTR_EV_BYTES"),
+            ("MV2T_NTR_RING_EVENTS", "trace_native._NTR_RING_EVENTS"),
         ]
         for cname, pyname in pairs:
             if cname not in defines:
@@ -621,11 +629,15 @@ class NativeSourcePass(LintPass):
                     f"shm._LEASE_DEPARTED={p:#x}")
 
         # FPC enum <-> _FP_COUNTERS: dense indices, matching names
+        # (the header now carries two enums; each check filters its own
+        # prefix so the other's indices can't pollute the slot space)
+        fpc_enums = {n: i for n, i in enums.items()
+                     if n.startswith("FPC_")}
         counters = py.get("shm._FP_COUNTERS", [])
         if not counters:
             bad("FPC_HITS", "python mirror shm._FP_COUNTERS not found")
         else:
-            want = {i: _fpc_to_pvar(n) for n, i in enums.items()}
+            want = {i: _fpc_to_pvar(n) for n, i in fpc_enums.items()}
             for idx in range(len(counters)):
                 if idx not in want:
                     bad("FPC_HITS",
@@ -635,7 +647,7 @@ class NativeSourcePass(LintPass):
                     bad("FPC_HITS",
                         f"FPC slot {idx} is {want[idx]} in shm_layout.h "
                         f"but _FP_COUNTERS[{idx}] is {counters[idx]}")
-            for name, idx in enums.items():
+            for name, idx in fpc_enums.items():
                 if idx >= len(counters):
                     bad(name,
                         f"{name}={idx} has no _FP_COUNTERS pvar (python "
@@ -645,6 +657,38 @@ class NativeSourcePass(LintPass):
                 bad("MV2T_FPC_SLOTS",
                     f"_FP_COUNTERS has {len(counters)} entries but the "
                     f"fpctr array holds MV2T_FPC_SLOTS={slots}")
+
+        # NTE enum <-> trace/native.py _NT_EVENTS: dense indices,
+        # matching names (NTE_FLAT_FANIN <-> flat_fanin) — the native
+        # trace ring's ids are wire format between C and python
+        nte_enums = {n: i for n, i in enums.items()
+                     if n.startswith("NTE_")}
+        nt_names = py.get("trace_native._NT_EVENTS", [])
+        if nte_enums and not nt_names:
+            bad("NTE_FLAT_FANIN",
+                "python mirror trace/native.py _NT_EVENTS not found")
+        elif nte_enums:
+            want_nt = {i: _nte_to_name(n) for n, i in nte_enums.items()}
+            for idx in range(len(nt_names)):
+                if idx not in want_nt:
+                    bad("NTE_FLAT_FANIN",
+                        f"_NT_EVENTS[{idx}]={nt_names[idx]} has no NTE_* "
+                        "enum slot in shm_layout.h")
+                elif want_nt[idx] != nt_names[idx]:
+                    bad("NTE_FLAT_FANIN",
+                        f"NTE slot {idx} is {want_nt[idx]} in "
+                        f"shm_layout.h but _NT_EVENTS[{idx}] is "
+                        f"{nt_names[idx]}")
+            for name, idx in nte_enums.items():
+                if idx >= len(nt_names):
+                    bad(name,
+                        f"{name}={idx} has no _NT_EVENTS entry (python "
+                        "side shorter than the C enum)")
+            count = defines.get("MV2T_NTE_COUNT", 0)
+            if count and count != len(nte_enums):
+                bad("MV2T_NTE_COUNT",
+                    f"MV2T_NTE_COUNT={count} != {len(nte_enums)} NTE_* "
+                    "enum entries")
 
         # flat-region geometry sanity: derived defines must re-derive
         derived = {
@@ -762,6 +806,11 @@ def _fpc_to_pvar(enum_name: str) -> str:
     return "fp_" + "_".join(parts)
 
 
+def _nte_to_name(enum_name: str) -> str:
+    """NTE_FLAT_FANIN -> flat_fanin (the _NT_EVENTS name)."""
+    return "_".join(enum_name.split("_")[1:]).lower()
+
+
 def _py_const(tree: ast.Module, name: str) -> Optional[object]:
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign):
@@ -787,7 +836,7 @@ def _python_layout() -> Dict[str, object]:
         with open(shm_path, encoding="utf-8") as f:
             shm_tree = ast.parse(f.read())
         for n in ("_HEADER", "_WRAP", "_ALIGN", "_LEASE_ALIGN",
-                  "_LEASE_STAMP"):
+                  "_LEASE_STAMP", "_FPC_SLOTS"):
             v = _py_const(shm_tree, n)
             if v is not None:
                 out[f"shm.{n}"] = v
@@ -830,6 +879,27 @@ def _python_layout() -> Dict[str, object]:
                 if isinstance(fmt, ast.Constant) \
                         and isinstance(fmt.value, str):
                     out["base._PKT_HDR.size"] = _struct.calcsize(fmt.value)
+    except OSError:
+        pass
+    nt_path = os.path.join(REPO_ROOT, "mvapich2_tpu", "trace",
+                           "native.py")
+    try:
+        with open(nt_path, encoding="utf-8") as f:
+            nt_tree = ast.parse(f.read())
+        for n in ("_NTR_FILE_HDR", "_NTR_HDR_BYTES", "_NTR_EV_BYTES",
+                  "_NTR_RING_EVENTS"):
+            v = _py_const(nt_tree, n)
+            if v is not None:
+                out[f"trace_native.{n}"] = v
+        for node in ast.walk(nt_tree):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "_NT_EVENTS"
+                    for t in node.targets):
+                try:
+                    out["trace_native._NT_EVENTS"] = [
+                        pair[0] for pair in ast.literal_eval(node.value)]
+                except (ValueError, SyntaxError):
+                    pass
     except OSError:
         pass
     try:

@@ -18,16 +18,40 @@ tracer — a name in {tr, tracer, rec} or an attribute chain ending in
 ``.tracer`` — and requires an enclosing ``is not None`` guard on that
 same receiver (plain if, walrus, ternary) or an early
 ``if X is None: return`` in the same function.
+
+Native half (the C analog of the same cost contract): the C-plane trace
+ring's emit sites must ride the one-branch ``MV2T_NTRACE(...)`` macro,
+never the raw ``nt_emit(...)`` writer — a raw call either crashes when
+the ring is unmapped (nt_mine NULL) or hides the gate inline where the
+next edit loses it. Checked over the committed native sources:
+
+  * raw-call      — ``nt_emit(`` outside nt_emit's own definition and
+                    the exported cp_ntrace_emit wrapper
+  * macro-gate    — every ``#define MV2T_NTRACE`` body must carry the
+                    runtime gate (the nt_mine NULL check) or be the
+                    compiled-out ``((void)0)`` stub
+
+``// mv2tlint: ignore[traceguard]`` suppresses a line, same as the
+python side.
 """
 
 from __future__ import annotations
 
 import ast
+import os
+import re
 from typing import List, Optional
 
 from .core import Finding, LintPass, SourceModule, attr_chain, parent_map
 
 TRACER_NAMES = {"tr", "tracer", "rec"}
+
+# functions allowed to touch the raw ring writer
+_NT_WRITER_FUNCS = {"nt_emit", "cp_ntrace_emit"}
+_NT_CALL_RE = re.compile(r"(?<![\w.>])nt_emit\s*\(")
+_NT_DEFINE_RE = re.compile(
+    r"^[ \t]*#[ \t]*define[ \t]+MV2T_NTRACE\b"
+    r"(?P<body>(?:[^\n\\]|\\\n|\\.)*)", re.M)
 
 
 def _receiver_key(fn: ast.Attribute) -> Optional[str]:
@@ -81,10 +105,23 @@ def _early_return_guard(fndef, key: str, before_line: int) -> bool:
 class TraceGuardPass(LintPass):
     id = "traceguard"
     doc = ("every tracer .record() site sits behind the single "
-           "attribute-check 'is not None' idiom")
+           "attribute-check 'is not None' idiom; native MV2T_NTRACE "
+           "emits stay behind the compiled/env gate")
+
+    def __init__(self, native_sources: Optional[List[str]] = None):
+        # None = the committed native tree (same default file set as
+        # the native pass); [] disables the native half (pure-python
+        # fixture runs)
+        if native_sources is None:
+            from .core import REPO_ROOT
+            from .native import NATIVE_SOURCES
+            native_sources = [os.path.join(REPO_ROOT, p)
+                              for p in NATIVE_SOURCES]
+        self.native_sources = [p for p in native_sources
+                               if os.path.exists(p)]
 
     def run(self, modules: List[SourceModule]) -> List[Finding]:
-        out: List[Finding] = []
+        out: List[Finding] = self._run_native()
         for mod in modules:
             parents = parent_map(mod.tree)
             for node in ast.walk(mod.tree):
@@ -104,6 +141,53 @@ class TraceGuardPass(LintPass):
                 if f is not None:
                     out.append(f)
         return out
+
+    # -- native half (MV2T_NTRACE gate discipline) ----------------------
+    def _run_native(self) -> List[Finding]:
+        out: List[Finding] = []
+        for path in self.native_sources:
+            try:
+                from .native import CSource
+                src = CSource(path)
+            except OSError:
+                continue
+            self._check_native(src, out)
+        return out
+
+    def _check_native(self, src, out: List[Finding]) -> None:
+        def finding(line: int, msg: str) -> None:
+            ign = src.ignores.get(line)
+            if ign and ("*" in ign or self.id in ign):
+                return
+            out.append(Finding(self.id, src.relpath, line, msg))
+
+        # raw-call: nt_emit() outside the writer/wrapper definitions.
+        # A file-scope statement ending at the parameter list is the
+        # writer's own declaration/prototype, not a call.
+        for st in src.statements:
+            if not _NT_CALL_RE.search(st.text):
+                continue
+            if st.func in _NT_WRITER_FUNCS:
+                continue
+            if st.func is None and st.text.endswith(")"):
+                continue
+            finding(st.line,
+                    "raw nt_emit() call in "
+                    f"{st.func or '<file scope>'} — native trace emits "
+                    "must ride the one-branch MV2T_NTRACE(...) macro "
+                    "(compiled/env gate)")
+
+        # macro-gate: every MV2T_NTRACE definition carries the runtime
+        # gate (nt_mine NULL check) or is the compiled-out stub
+        for m in _NT_DEFINE_RE.finditer(src.text):
+            body = m.group("body")
+            if "nt_mine" in body or re.search(r"\(void\)\s*0", body):
+                continue
+            line = src.text.count("\n", 0, m.start()) + 1
+            finding(line,
+                    "MV2T_NTRACE macro definition lacks the one-branch "
+                    "runtime gate (nt_mine check) and is not the "
+                    "((void)0) compiled-out stub")
 
     @staticmethod
     def _guarded(call: ast.Call, key: str, parents) -> bool:

@@ -46,6 +46,47 @@ cvar("DEVICE_COLL_MIN_BYTES", 16384, int, "coll",
 
 from ..utils import is_device_array  # noqa: E402 — shared predicate
 
+# -- MV2T_JAX_PROFILE: hardware-profiler bracket ------------------------
+# When the cvar names a directory, the FIRST device collective starts a
+# jax.profiler trace there and an atexit hook stops it — one xplane
+# trace covering the whole device-collective region of the run, the
+# input the TPU-hardware tuning pass (ROADMAP item 1: ici_chunk_bytes /
+# ICI_PIPELINE_DEPTH at the 64 MiB point) reads in TensorBoard/XProf.
+# Declared in mpit.py (cvar JAX_PROFILE) so MPI_T enumerates it early.
+_jax_profile_started = False
+_jax_profile_lock = threading.Lock()
+
+
+def _maybe_start_jax_profile() -> None:
+    global _jax_profile_started
+    if _jax_profile_started:          # one attr check once started
+        return
+    out_dir = str(get_config().get("JAX_PROFILE", "") or "")
+    if not out_dir:
+        return    # cheap re-check per call: device dispatch is ms-scale
+    with _jax_profile_lock:
+        if _jax_profile_started:
+            return
+        _jax_profile_started = True
+        try:
+            import atexit
+
+            import jax
+            jax.profiler.start_trace(out_dir)
+            atexit.register(_stop_jax_profile)
+            log.info("jax.profiler trace started -> %s "
+                     "(MV2T_JAX_PROFILE)", out_dir)
+        except Exception as e:   # profiling must never kill a collective
+            log.warn("MV2T_JAX_PROFILE start failed: %r", e)
+
+
+def _stop_jax_profile() -> None:
+    try:
+        import jax
+        jax.profiler.stop_trace()
+    except Exception:
+        pass
+
 
 def _op_name(op) -> Optional[str]:
     """Map a core.op builtin to an XLA reduction name (None = no analog)."""
@@ -243,64 +284,101 @@ class DeviceCollChannel:
         return [per_dev[self.devices[r]] for r in range(self.size)]
 
     # -- per-call tier accounting (the observable-fallback contract) -----
-    def _note_tier(self, comm, name: str, local, op: Optional[str]) -> None:
+    def _note_tier(self, comm, name: str, local, op: Optional[str]) -> str:
         """Count which device tier THIS call runs (pvars
         dev_coll_tier_{vmem,hbm} / dev_coll_fallback_*) and drop a trace
         instant when the XLA lowering is taken — the once-invisible
         VMEM-cap cliff. Per call, unlike the per-traced-shape counting
-        at the kernel wrappers (programs are cached per signature)."""
+        at the kernel wrappers (programs are cached per signature).
+        Returns the tier label the call will run on ('vmem'/'hbm'/'xla',
+        'slot' on the single-device channel) — the dispatch span and
+        the dev_effbw watermark key off it."""
         if self.mesh is None:
-            return          # single-device slot channel: no ICI tiers
+            return "slot"   # single-device slot channel: no ICI tiers
         from .. import mpit
         from ..ops import pallas_ici
         n, dtype = self._slot_extent(local)
         nbytes = n * dtype.itemsize * (self.size if name == "allgather"
                                        else 1)
+        if name not in ("allreduce", "reduce", "allgather"):
+            return "xla"    # ops without a ring-kernel lowering
         tier, reason = pallas_ici.planned_tier(name, nbytes, dtype, op)
         if reason is None:
             mpit.pvar(f"dev_coll_tier_{tier}").inc()
-            return
+            return tier
         mpit.pvar(f"dev_coll_fallback_{reason}").inc()
         tr = getattr(comm.u.engine, "tracer", None)
         if tr is not None:
             tr.record("channel", "dev_coll_fallback", "i", coll=name,
                       nbytes=int(nbytes), reason=reason)
+        return "xla"
+
+    def _run(self, comm, name: str, local, op: str = "sum",
+             root: int = 0):
+        """Traced dispatch: one B/E span in the 'device' lane carrying
+        tier/op/bytes/duration around the whole rendezvous+execute, the
+        per-tier dev_effbw watermark (end-to-end GB/s), and the
+        MV2T_JAX_PROFILE bracket for hardware runs. The span is what
+        makes the device path visible on the same Perfetto axis as the
+        host layers — the r5/r6 rounds tuned it blind."""
+        import time as _time
+
+        tier = self._note_tier(comm, name, local,
+                               op if name != "bcast" else None)
+        n, dtype = self._slot_extent(local)
+        nbytes = int(n * dtype.itemsize)
+        tr = getattr(comm.u.engine, "tracer", None)
+        if tr is not None:
+            tr.record("device", f"dev_{name}", "B", tier=tier, op=op,
+                      bytes=nbytes)
+        _maybe_start_jax_profile()
+        t0 = _time.perf_counter()
+        try:
+            out = self._execute(name, local, op=op, root=root)
+        finally:
+            dt = _time.perf_counter() - t0
+            if tr is not None:
+                tr.record("device", f"dev_{name}", "E", tier=tier,
+                          us=round(dt * 1e6, 3))
+        if dt > 0 and nbytes > 0:
+            from .. import mpit
+            mpit.pvar(f"dev_effbw_{tier}").mark(nbytes / dt / 1e9)
+        return out
 
     # -- MPI-shaped entry points (match coll_fns signatures) -------------
     def allreduce(self, comm, sendbuf, recvbuf, count, datatype, op):
         local = _as_local(sendbuf, recvbuf, count)
-        self._note_tier(comm, "allreduce", local, _op_name(op))
-        out = self._execute("allreduce", local, op=_op_name(op))
+        out = self._run(comm, "allreduce", local, op=_op_name(op))
         return _deliver(out, recvbuf)
 
     def reduce(self, comm, sendbuf, recvbuf, count, datatype, op, root):
         local = _as_local(sendbuf, recvbuf, count)
-        self._note_tier(comm, "reduce", local, _op_name(op))
-        out = self._execute("reduce", local, op=_op_name(op))
+        out = self._run(comm, "reduce", local, op=_op_name(op))
         if comm.rank != root:
             return None
         return _deliver(out, recvbuf)
 
     def bcast(self, comm, buf, count, datatype, root):
-        out = self._execute("bcast", _as_local(buf, buf, count), root=root)
+        out = self._run(comm, "bcast", _as_local(buf, buf, count),
+                        root=root)
         return _deliver(out, buf)
 
     def allgather(self, comm, sendbuf, recvbuf, count, datatype):
         local = _as_local(sendbuf, recvbuf, count,
                           in_place_start=comm.rank * count)
-        self._note_tier(comm, "allgather", local, None)
-        out = self._execute("allgather", local)
+        out = self._run(comm, "allgather", local, op=None)
         return _deliver(out, recvbuf)
 
     def alltoall(self, comm, sendbuf, recvbuf, count, datatype):
         local = _as_local(sendbuf, recvbuf, count * comm.size)
-        out = self._execute("alltoall", local)
+        out = self._run(comm, "alltoall", local)
         return _deliver(out, recvbuf)
 
     def reduce_scatter_block(self, comm, sendbuf, recvbuf, count, datatype,
                              op):
         local = _as_local(sendbuf, recvbuf, count * comm.size)
-        out = self._execute("reduce_scatter_block", local, op=_op_name(op))
+        out = self._run(comm, "reduce_scatter_block", local,
+                        op=_op_name(op))
         return _deliver(out, recvbuf)
 
 

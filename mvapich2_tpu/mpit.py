@@ -352,6 +352,23 @@ pvar("dev_coll_tier_hbm", PVAR_CLASS_COUNTER, "device",
      "device collective calls served by the HBM-streaming chunked ring "
      "tier (ops/pallas_ici)")
 
+# device-lane timing observability (ISSUE 10): per-tier effective-
+# bandwidth watermarks measured at the dispatch wrapper
+# (coll/device.py _run — wall time of the whole rendezvous+execute, so
+# the number is end-to-end, not kernel-only), plus the optional
+# hardware-profiler bracket.
+cvar("JAX_PROFILE", "", str, "device",
+     "Directory for a jax.profiler trace bracketing the device-"
+     "collective region (started at the first device collective, "
+     "stopped at process exit). Empty = off. The hardware-tuning "
+     "workflow for ici_chunk_bytes/ICI_PIPELINE_DEPTH on a real TPU "
+     "(ROADMAP item 1) reads this trace in TensorBoard/XProf.")
+for _tier in ("vmem", "hbm", "xla", "slot"):
+    pvar(f"dev_effbw_{_tier}", PVAR_CLASS_HIGHWATERMARK, "device",
+         f"high watermark of end-to-end algorithmic bandwidth (GB/s, "
+         f"payload bytes / wall seconds) observed on the '{_tier}' "
+         "device tier at the collective dispatch wrapper")
+
 
 # ---------------------------------------------------------------------------
 # the autotuner lives beside MPI_T (tools space): mpit.autotune —

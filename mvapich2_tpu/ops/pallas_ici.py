@@ -510,6 +510,8 @@ def remote_sendrecv(x: jax.Array, axis_name: str, num_devices: int,
     if not HAVE_PALLAS or p == 1 or src == dst:
         return x
     interpret, _ = _resolve_flags(interpret, None)
+    _trace_entry("sendrecv", "hbm", x.size * x.dtype.itemsize,
+                 src=src, dst=dst)
     kernel = functools.partial(_sendrecv_kernel, axis_name, p, src, dst)
     return pl.pallas_call(
         kernel,
@@ -564,6 +566,24 @@ def planned_tier(name: str, shard_nbytes: int, dtype, op: Optional[str],
     return tier, None
 
 
+def _trace_entry(coll: str, tier: str, nbytes: int, op=None,
+                 **extra) -> None:
+    """Drop a 'device'-lane instant at an ICI entry point. These
+    wrappers execute at TRACE time (once per compiled signature, not
+    per call — programs are cached), so the instant records which tier
+    a signature LOWERED to; the per-call span lives one level up in
+    coll/device.py. One recorder lookup, nothing when untraced."""
+    try:
+        from ..runtime.universe import current_universe
+        u = current_universe()
+        rec = u.engine.tracer if u is not None else None
+        if rec is not None:
+            rec.record("device", f"ici_{coll}", "i", tier=tier,
+                       bytes=int(nbytes), op=op, **extra)
+    except Exception:   # tracing must never kill a lowering
+        pass
+
+
 def ici_all_reduce(x: jax.Array, axis_name: str, num_devices: int,
                    op: str = "sum", interpret=None) -> jax.Array:
     """Tier-dispatched device allreduce: VMEM-resident flat ring below
@@ -577,6 +597,7 @@ def ici_all_reduce(x: jax.Array, axis_name: str, num_devices: int,
         return allreduce(x, axis_name, op)
     tier, reason = planned_tier("allreduce", x.size * x.dtype.itemsize,
                                 x.dtype, op, interpret)
+    _trace_entry("allreduce", tier, x.size * x.dtype.itemsize, op=op)
     if tier == "vmem":
         from . import pallas_ring
         if x.ndim >= 1 and x.shape[0] % p == 0 and op == "sum":
@@ -608,6 +629,7 @@ def ici_all_gather(x: jax.Array, axis_name: str, num_devices: int,
     out_nbytes = x.size * x.dtype.itemsize * p
     tier, reason = planned_tier("allgather", out_nbytes, x.dtype, None,
                                 interpret)
+    _trace_entry("allgather", tier, out_nbytes)
     if tier == "vmem":
         from . import pallas_ring
         ip = True if (interpret is None

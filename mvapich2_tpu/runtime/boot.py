@@ -74,15 +74,19 @@ cvar("ARENA_BYTES", 0, int, "shm",
 BOOT_PROTO_VERSION = 1
 
 # flags-segment layout (mirrors transport/shm.py _LEASE_ALIGN /
-# _LEASE_STAMP and native/shm_layout.h — the mv2tlint native pass pins
-# the C side; boot only needs the total length to size the raw file)
+# _LEASE_STAMP / _FPC_SLOTS and native/shm_layout.h — the mv2tlint
+# native pass pins the C side; boot only needs the total length to size
+# the raw file). The tail after the lease stamps is the per-rank
+# fast-path counter mirror (n_local x _FPC_SLOTS u64) that lets
+# bin/mpistat read every rank's fp_* pvars without touching the job.
 _LEASE_ALIGN = 8
 _LEASE_STAMP = 8
+_FPC_SLOTS = 16
 
 
 def flags_len(n_local: int) -> int:
     lease_off = (n_local + _LEASE_ALIGN - 1) & ~(_LEASE_ALIGN - 1)
-    return lease_off + _LEASE_STAMP * n_local
+    return lease_off + _LEASE_STAMP * n_local + 8 * _FPC_SLOTS * n_local
 
 
 def auto_ring_bytes(n_local: int) -> int:

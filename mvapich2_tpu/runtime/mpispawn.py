@@ -33,6 +33,20 @@ from .childenv import cpu_rank_env
 from .kvs import KVSClient
 
 
+def publish_failures(kvs, dead: List[int]) -> None:
+    """Publish a batch of rank-failure events in TWO round trips total
+    (one atomic range claim + one mput), not two per event — the
+    launch_tree/mpispawn path's last serial per-key puts, lifted onto
+    PR 9's batched verbs (ROADMAP item 3b). The range claim keeps the
+    sequential failure watcher gap-free when agents on different nodes
+    batch concurrently."""
+    if not dead:
+        return
+    base = kvs.add("__failure_ev_seq", len(dead)) - len(dead)
+    kvs.put_many({f"__failure_ev_{base + i}": str(r)
+                  for i, r in enumerate(dead)})
+
+
 def run_agent(spec: Dict) -> int:
     """Spawn this node's ranks per ``spec`` and babysit them.
 
@@ -68,6 +82,7 @@ def run_agent(spec: Dict) -> int:
 
     codes: Dict[int, Optional[int]] = {r: None for r in procs}
     while any(c is None for c in codes.values()):
+        dead: List[int] = []
         for r, p in procs.items():
             if codes[r] is None:
                 rc = p.poll()
@@ -77,12 +92,12 @@ def run_agent(spec: Dict) -> int:
                 if spec.get("ft") and rc != 0:
                     # any nonzero death = process failure event (the
                     # launcher-driven detection path, SURVEY 5.3; the
-                    # reference's ft suite kills ranks with exit(1)).
-                    # Atomically claim the next global event slot so
-                    # agents on different nodes never collide and the
-                    # sequential failure watcher sees no gaps.
-                    n = kvs.add("__failure_ev_seq", 1) - 1
-                    kvs.put(f"__failure_ev_{n}", str(r))
+                    # reference's ft suite kills ranks with exit(1))
+                    dead.append(r)
+        # one atomic range claim + one batched mput per poll pass, not
+        # two serial round trips per dead rank (a node dying whole used
+        # to pay 2 x n_local RTTs before survivors could unwind)
+        publish_failures(kvs, dead)
         time.sleep(0.01)
     kvs.put(f"__agent_exit_{node}", json.dumps(codes))
     if spec.get("ft"):

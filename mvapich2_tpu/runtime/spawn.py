@@ -163,9 +163,10 @@ def _spawn_procs(comm: Comm, cmds, root: int, ctx: int,
             # __next_proc was already advanced past the reclaimed id range;
             # children of any LATER spawn read node-<r> for every r below
             # their base with a blocking get, so the dead ids must still
-            # publish node keys or those children hang in bootstrap
-            for r in range(base, base + total):
-                kvs.put(f"node-{r}", "__dead__")
+            # publish node keys or those children hang in bootstrap.
+            # One batched mput, not `total` serial round trips.
+            kvs.put_many({f"node-{r}": "__dead__"
+                          for r in range(base, base + total)})
             hdr = {"error": f"spawn failed: errcodes {errcodes}"}
         else:
             # children publish their node names once their world is wired

@@ -25,6 +25,7 @@ import threading
 from .recorder import (LAYERS, Recorder, detach, dump_rank,  # noqa: F401
                        maybe_attach)
 from .perfetto import merge, merge_dir, read_dumps, summarize  # noqa: F401
+from . import native  # noqa: F401  — C-plane ring reader (MV2T_NTRACE)
 from . import watchdog  # noqa: F401
 
 _mpi_lock = threading.Lock()

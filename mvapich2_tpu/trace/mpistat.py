@@ -1,0 +1,329 @@
+"""mpistat — attach-not-construct live monitoring of a running job.
+
+The PiP blueprint (PAPERS.md) applied to observability: a monitor
+should *attach* to a live job's shared-memory state, not require a
+restart with tracing on. Every surface this module reads already lives
+in shm for protocol reasons; mpistat just maps the files read-only
+(``mmap.ACCESS_READ``) and decodes them — no signal, no ptrace, no KVS
+traffic, nothing the job can observe:
+
+  * **flags segment** (``<stem>.flags``): per-rank doorbell sleep
+    bytes, liveness-lease ages, and the fast-path counter mirror the
+    flags tail carries since ISSUE 10 (cp_create points CPlane.fpctr at
+    it) — so per-rank ``fp_*`` pvar snapshots work on an UNTRACED job.
+  * **ring segment** (``<stem>``): per-(src,dst) SPSC ring depths
+    (tail - head of each control block).
+  * **flat segment** (``<stem>.fcoll``): per-region poison flag and
+    bcast seq for the predefined-context regions (the sparse mask
+    window is left unmapped-cold — probing all ~1.2 GB would fault it
+    in).
+  * **native trace ring** (``<stem>.ntrace``, when the job runs with
+    MV2T_NTRACE): per-rank event tails.
+
+Segment discovery: an explicit ``--seg`` stem, the MV2T_DAEMON
+manifest's busy sets, or a scan of the shm dir for ``mv2t-shm-*``
+stems. The flags-file size determines n_local (flags_len is strictly
+monotonic in n), and ring_bytes follows from the ring size / n^2 — no
+cooperation from the job needed.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import mmap
+import os
+import struct
+import time
+from typing import Any, Dict, List, Optional
+
+from . import native as _native
+
+# layout mirrors (transport/shm.py <-> native/shm_layout.h; the lint
+# native pass pins the shm.py copies these are derived from)
+_RING_HDR = 128
+_LEASE_ALIGN = 8
+_LEASE_STAMP = 8
+_FPC_SLOTS = 16
+_LEASE_DEPARTED = 0xFFFFFFFFFFFFFFFF
+
+# _FP_COUNTERS pvar names, by FPC slot index (transport/shm.py)
+FP_NAMES = [
+    "fp_hits", "fp_gil_takes", "fp_fallback_dtype", "fp_fallback_comm",
+    "fp_fallback_size", "fp_fallback_plane", "fp_coll_flat",
+    "fp_coll_sched", "fp_wait_spin", "fp_wait_bell", "fp_flat_progress",
+    "fp_dead_peer",
+]
+
+
+def _flags_len(n: int) -> int:
+    lease_off = (n + _LEASE_ALIGN - 1) & ~(_LEASE_ALIGN - 1)
+    return lease_off + _LEASE_STAMP * n + 8 * _FPC_SLOTS * n
+
+
+def _n_local_from_flags(size: int) -> Optional[int]:
+    """Invert _flags_len (strictly monotonic in n)."""
+    for n in range(1, 1025):
+        ln = _flags_len(n)
+        if ln == size:
+            return n
+        if ln > size:
+            return None
+    return None
+
+
+def _read_only(path: str) -> Optional[mmap.mmap]:
+    try:
+        with open(path, "rb") as f:
+            return mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    except (OSError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# discovery
+# ---------------------------------------------------------------------------
+
+def _shm_dir() -> str:
+    return "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp"
+
+
+def find_segments(seg: Optional[str] = None,
+                  daemon_dir: Optional[str] = None) -> List[str]:
+    """Candidate segment stems, most recently modified first.
+
+    Priority: an explicit stem; then the MV2T_DAEMON manifest's busy
+    sets (attach-not-construct jobs); then a scan for per-job
+    ``mv2t-shm-*`` ring files (a ring stem is the file whose ``.flags``
+    sibling exists)."""
+    if seg:
+        return [seg]
+    out: List[str] = []
+    if daemon_dir is None:
+        try:
+            from ..runtime.daemon import default_dir
+            daemon_dir = default_dir()
+        except Exception:
+            daemon_dir = None
+    if daemon_dir and os.path.isdir(daemon_dir):
+        try:
+            with open(os.path.join(daemon_dir, "manifest.json")) as f:
+                m = json.load(f)
+            for s in m.get("sets", {}).values():
+                if s.get("state") == "busy":
+                    ring = s.get("files", {}).get("ring")
+                    flags = s.get("files", {}).get("flags")
+                    if ring and flags and os.path.exists(flags):
+                        out.append((ring, flags))
+        except (OSError, ValueError):
+            pass
+    for flags in glob.glob(os.path.join(_shm_dir(), "mv2t-shm-*.flags")):
+        ring = flags[:-len(".flags")]
+        if os.path.exists(ring):
+            out.append((ring, flags))
+    # dedupe, newest job first
+    seen = set()
+    stems = []
+    for ring, flags in sorted(
+            out, key=lambda rf: -os.path.getmtime(rf[1])):
+        if ring not in seen:
+            seen.add(ring)
+            stems.append(ring)
+    return stems
+
+
+# ---------------------------------------------------------------------------
+# snapshot
+# ---------------------------------------------------------------------------
+
+def snapshot(stem: str, trace_tail: int = 8,
+             flat_regions: int = 64) -> Dict[str, Any]:
+    """One read-only state snapshot of a job's segment set."""
+    flags_path = stem if stem.endswith(".flags") else stem + ".flags"
+    ring_path = flags_path[:-len(".flags")]
+    out: Dict[str, Any] = {"stem": ring_path, "ranks": []}
+    fsize = os.path.getsize(flags_path)
+    n = _n_local_from_flags(fsize)
+    if n is None:
+        out["error"] = (f"flags segment {flags_path} has unrecognized "
+                        f"size {fsize} (pre-ISSUE-10 layout?)")
+        return out
+    out["n_local"] = n
+    lease_off = (n + _LEASE_ALIGN - 1) & ~(_LEASE_ALIGN - 1)
+    fpc_off = lease_off + _LEASE_STAMP * n
+    mm = _read_only(flags_path)
+    if mm is None:
+        out["error"] = f"cannot map {flags_path}"
+        return out
+    try:
+        now_us = int(time.clock_gettime(time.CLOCK_MONOTONIC) * 1e6)
+        for i in range(n):
+            sleep = mm[i]
+            stamp = struct.unpack_from("<Q", mm, lease_off
+                                       + _LEASE_STAMP * i)[0]
+            if stamp == 0:
+                lease = "never-stamped"
+            elif stamp == _LEASE_DEPARTED:
+                lease = "departed"
+            else:
+                lease = f"{max(0, now_us - stamp) / 1e6:.2f}s"
+            slots = struct.unpack_from(
+                f"<{_FPC_SLOTS}Q", mm, fpc_off + 8 * _FPC_SLOTS * i)
+            out["ranks"].append({
+                "ring_index": i,
+                "sleeping": bool(sleep),
+                "lease_age": lease,
+                "fp": {name: int(v)
+                       for name, v in zip(FP_NAMES, slots) if v},
+            })
+    finally:
+        mm.close()
+    # ring depths: size = n^2 * ring_bytes; head/tail u64s @0/@8 of
+    # each (src,dst) control block
+    try:
+        rsize = os.path.getsize(ring_path)
+        ring_bytes = rsize // (n * n) if n else 0
+        rm = _read_only(ring_path)
+    except OSError:
+        ring_bytes, rm = 0, None
+    if rm is not None and ring_bytes:
+        try:
+            depths = {}
+            for src in range(n):
+                for dst in range(n):
+                    off = (src * n + dst) * ring_bytes
+                    head, tail = struct.unpack_from("<QQ", rm, off)
+                    if tail > head:
+                        depths[f"{src}->{dst}"] = int(tail - head)
+            out["ring_bytes"] = ring_bytes
+            out["ring_depths"] = depths
+        finally:
+            rm.close()
+    # flat regions (predefined contexts only — the mask window stays
+    # cold): region header poison word @0, bcast block in_seq
+    flat_path = ring_path + ".fcoll"
+    fm = _read_only(flat_path) if os.path.exists(flat_path) else None
+    if fm is not None:
+        try:
+            # geometry from shm_layout.h
+            slot_stride = 64 + 4096
+            reg_hdr = 64
+            reg_stride = reg_hdr + 9 * slot_stride
+            lanes = 8
+            active = []
+            for ctx in range(min(flat_regions, 64)):
+                for lane in range(lanes):
+                    base = (ctx * lanes + lane) * reg_stride
+                    if base + reg_stride > len(fm):
+                        break
+                    poison = struct.unpack_from("<Q", fm, base)[0]
+                    bseq = struct.unpack_from(
+                        "<Q", fm, base + reg_hdr + 8 * slot_stride)[0]
+                    if poison or bseq:
+                        active.append({"ctx": ctx, "lane": lane,
+                                       "poisoned": bool(poison),
+                                       "bseq": int(bseq)})
+            out["flat_regions"] = active
+        finally:
+            fm.close()
+    # native trace tail (only when the job runs with MV2T_NTRACE)
+    nt_path = ring_path + ".ntrace"
+    if os.path.exists(nt_path):
+        tails = {}
+        for i in range(n):
+            try:
+                evs = _native.read_ring(nt_path, i, last=trace_tail)
+            except (OSError, struct.error):
+                continue
+            tails[i] = [
+                {"t": ts / 1e6, "ev": _native.event_name(ev),
+                 "a1": a1, "a2": a2}
+                for ts, ev, a1, a2 in evs]
+        out["ntrace"] = tails
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def format_snapshot(snap: Dict[str, Any]) -> str:
+    if "error" in snap:
+        return f"mpistat: {snap['error']}"
+    lines = [f"# {snap['stem']}  ({snap['n_local']} local ranks, "
+             f"ring {snap.get('ring_bytes', '?')} B/pair)"]
+    for r in snap["ranks"]:
+        state = "sleeping" if r["sleeping"] else "polling "
+        lines.append(f"  rank {r['ring_index']}: {state} "
+                     f"lease {r['lease_age']}")
+        if r["fp"]:
+            kv = "  ".join(f"{k}={v}" for k, v in sorted(r["fp"].items()))
+            lines.append(f"    {kv}")
+    depths = snap.get("ring_depths") or {}
+    if depths:
+        kv = "  ".join(f"{k}:{v}B" for k, v in sorted(depths.items()))
+        lines.append(f"  ring backlogs: {kv}")
+    else:
+        lines.append("  ring backlogs: none")
+    for fr in snap.get("flat_regions", []):
+        lines.append(f"  flat region ctx={fr['ctx']} lane={fr['lane']}: "
+                     f"bseq={fr['bseq']}"
+                     f"{' POISONED' if fr['poisoned'] else ''}")
+    for i, evs in sorted((snap.get("ntrace") or {}).items()):
+        lines.append(f"  ntrace rank {i} tail:")
+        for e in evs:
+            lines.append(f"    {e['t']:.6f} {e['ev']} a1={e['a1']} "
+                         f"a2={e['a2']}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="mpistat",
+        description="top-style read-only monitor for a running "
+                    "mvapich2-tpu job's shm segments")
+    ap.add_argument("--seg", default=None,
+                    help="segment stem (the mv2t-shm-* ring file); "
+                         "default: MV2T_DAEMON manifest, then a "
+                         "/dev/shm scan, newest job first")
+    ap.add_argument("--daemon-dir", default=None,
+                    help="warm-attach daemon dir to read the manifest "
+                         "from (default: the MV2T_DAEMON_DIR default)")
+    ap.add_argument("--all", action="store_true",
+                    help="show every discovered job, not just the "
+                         "newest")
+    ap.add_argument("--watch", type=float, default=0.0, metavar="SEC",
+                    help="refresh every SEC seconds until interrupted")
+    ap.add_argument("--tail", type=int, default=8,
+                    help="native trace events shown per rank "
+                         "(default 8)")
+    opts = ap.parse_args(argv)
+
+    def render() -> int:
+        stems = find_segments(opts.seg, opts.daemon_dir)
+        if not stems:
+            print("mpistat: no live mv2t segment sets found "
+                  "(is a job running?)")
+            return 1
+        rc = 0
+        for stem in (stems if opts.all else stems[:1]):
+            try:
+                print(format_snapshot(
+                    snapshot(stem, trace_tail=opts.tail)))
+            except OSError as e:
+                print(f"mpistat: cannot read {stem}: {e}")
+                rc = 1
+        return rc
+
+    if opts.watch <= 0:
+        return render()
+    try:
+        while True:
+            print(f"\x1b[2J\x1b[H== mpistat {time.strftime('%H:%M:%S')} "
+                  f"(refresh {opts.watch}s, ^C quits)")
+            render()
+            time.sleep(opts.watch)
+    except KeyboardInterrupt:
+        pass
+    return 0

@@ -1,0 +1,212 @@
+"""Native C-plane trace ring: the reader half of MV2T_NTRACE.
+
+The writer is native/cplane.cpp (``MV2T_NTRACE(...)`` — one pointer
+branch per site when off, compiled out with ``make NTRACE=0``): a
+per-rank lock-free event ring in its own shm segment
+(``<ring>.ntrace``), emitting at the protocol points the python
+recorder cannot see — flat-wave phases (fan-in/fold/fan-out/poison),
+doorbell ring/wake, spin->bell transitions, lease scans/expiry, and the
+fast path's eager/rendezvous hops. This module parses the segment file
+directly (mmap, read-only, no attach to the process), so the same code
+serves three consumers:
+
+  * the Finalize drain (trace/recorder.py dump_rank) that merges native
+    events into the rank's Perfetto dump on the shared CLOCK_MONOTONIC
+    axis,
+  * the stall watchdog's hang-report tail (every local rank's last N
+    events, region-tagged via the mv2tlint shared-field map),
+  * ``bin/mpistat``'s live tail against a running job.
+
+Geometry and the event-id enum are mirrored from native/shm_layout.h;
+the mv2tlint ``native`` pass cross-checks the numbers AND the event
+names (NTE_FLAT_FANIN <-> ``flat_fanin``) mechanically, so drift is a
+lint failure.
+
+Reader protocol (matches nt_emit): acquire-read the rank header's claim
+seq, walk the last N slots, drop any slot whose ts is 0 (never filled)
+or whose 32-bit claim stamp mismatches the slot's expected claim for
+the acquired window (overwritten mid-read). Torn *payloads* inside a
+validly-claimed slot are impossible to fully exclude without a lock;
+the stamp check bounds the exposure to records claimed while we read.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.config import cvar, get_config
+
+cvar("NTRACE", -1, int, "trace",
+     "Native C-plane trace ring (per-rank lock-free event ring in shm, "
+     "drained into the Perfetto merge / watchdog / mpistat). 1 = on, "
+     "0 = off, -1 (default) = follow MV2T_TRACE. The build-time gate is "
+     "make NTRACE=0 (-DMV2T_NO_NTRACE).")
+
+# geometry mirror of native/shm_layout.h (layout-checked by mv2tlint)
+_NTR_FILE_HDR = 64        # MV2T_NTR_FILE_HDR
+_NTR_HDR_BYTES = 64       # MV2T_NTR_HDR_BYTES (rank header; u64 seq @0)
+_NTR_EV_BYTES = 32        # MV2T_NTR_EV_BYTES
+_NTR_RING_EVENTS = 2048   # MV2T_NTR_RING_EVENTS
+
+_REC = struct.Struct("<QIIqq")      # ts_us, ev, claim, a1, a2
+
+# Event-id mirror of the NTE_* enum: index -> (name, protocol region).
+# The region strings name the shared-field protocol regions of the
+# mv2tlint native pass (watchdog report tags every line with them).
+_NT_EVENTS = [
+    ("flat_fanin", "seqlock(flat)"),
+    ("flat_fold", "seqlock(flat)"),
+    ("flat_fanout", "seqlock(flat)"),
+    ("flat_poison", "seqlock(flat)"),
+    ("bell_ring", "atomic(doorbell)"),
+    ("bell_wake", "atomic(doorbell)"),
+    ("spin_bell", "atomic(doorbell)"),
+    ("lease_scan", "atomic(lease)"),
+    ("lease_expire", "atomic(lease)"),
+    ("eager_tx", "atomic(inbox)"),
+    ("eager_rx", "atomic(inbox)"),
+    ("rndv_tx", "atomic(inbox)"),
+    ("rndv_rx", "atomic(inbox)"),
+    ("coll_dispatch", "seqlock(flat)"),
+]
+
+# the Perfetto lane native events render in (recorder.LAYERS member)
+LAYER = "cplane"
+
+
+def ntrace_enabled() -> bool:
+    """The runtime gate: MV2T_NTRACE, defaulting to MV2T_TRACE."""
+    cfg = get_config()
+    v = int(cfg.get("NTRACE", -1) or 0)
+    if v < 0:
+        return bool(cfg.get("TRACE", False))
+    return v > 0
+
+
+def event_name(ev: int) -> str:
+    return _NT_EVENTS[ev][0] if 0 <= ev < len(_NT_EVENTS) else f"nte_{ev}"
+
+
+def event_region(ev: int) -> Optional[str]:
+    return _NT_EVENTS[ev][1] if 0 <= ev < len(_NT_EVENTS) else None
+
+
+# ---------------------------------------------------------------------------
+# segment parsing (read-only; shared by drain / watchdog / mpistat)
+# ---------------------------------------------------------------------------
+
+def _rank_count(path: str) -> int:
+    """How many rank rings the segment holds (from the file size)."""
+    stride = _NTR_HDR_BYTES + _NTR_RING_EVENTS * _NTR_EV_BYTES
+    return max(0, (os.path.getsize(path) - _NTR_FILE_HDR) // stride)
+
+
+def read_ring(path: str, rank_index: int,
+              last: Optional[int] = None) -> List[Tuple]:
+    """Decode one local rank's ring from the segment file.
+
+    Returns ``[(ts_us, event_id, a1, a2), ...]`` oldest-first, at most
+    ``last`` events (None = the full live window). Unfilled and
+    mid-overwrite slots are dropped (see the module docstring)."""
+    stride = _NTR_HDR_BYTES + _NTR_RING_EVENTS * _NTR_EV_BYTES
+    base = _NTR_FILE_HDR + rank_index * stride
+    with open(path, "rb") as f:
+        mm = mmap.mmap(f.fileno(), 0, prot=mmap.PROT_READ)
+        try:
+            seq = struct.unpack_from("<Q", mm, base)[0]
+            live = min(seq, _NTR_RING_EVENTS)
+            lo = seq - live
+            if last is not None:
+                lo = max(lo, seq - last)
+            out: List[Tuple] = []
+            for idx in range(lo, seq):
+                off = base + _NTR_HDR_BYTES \
+                    + (idx % _NTR_RING_EVENTS) * _NTR_EV_BYTES
+                ts_us, ev, claim, a1, a2 = _REC.unpack_from(mm, off)
+                if ts_us == 0 or claim != (idx & 0xFFFFFFFF):
+                    continue       # unfilled, or overwritten mid-read
+                out.append((ts_us, ev, a1, a2))
+            return out
+        finally:
+            mm.close()
+
+
+def ring_depth(path: str, rank_index: int) -> int:
+    """Total events ever claimed by one rank (the header seq)."""
+    stride = _NTR_HDR_BYTES + _NTR_RING_EVENTS * _NTR_EV_BYTES
+    with open(path, "rb") as f:
+        mm = mmap.mmap(f.fileno(), 0, prot=mmap.PROT_READ)
+        try:
+            return struct.unpack_from(
+                "<Q", mm, _NTR_FILE_HDR + rank_index * stride)[0]
+        finally:
+            mm.close()
+
+
+# ---------------------------------------------------------------------------
+# consumer surfaces
+# ---------------------------------------------------------------------------
+
+def _channel_ring(channel) -> Optional[str]:
+    """The live segment path of a plane channel, or None."""
+    if channel is None or not getattr(channel, "plane", None):
+        return None
+    path = getattr(channel, "_ntrace_path", None)
+    if not path or not os.path.exists(path):
+        return None
+    return path
+
+
+def drain_channel(channel) -> List[List[Any]]:
+    """This rank's native events as recorder-format rows
+    ``[ts_seconds, layer, name, ph, args]`` — appended to the rank's
+    Finalize dump by recorder.dump_rank. Timestamps are the same
+    CLOCK_MONOTONIC the python recorder stamps, so the merged Perfetto
+    JSON time-aligns C events with python spans with no translation."""
+    path = _channel_ring(channel)
+    if path is None:
+        return []
+    me = channel.local_index[channel.my_rank]
+    out: List[List[Any]] = []
+    for ts_us, ev, a1, a2 in read_ring(path, me):
+        out.append([ts_us / 1e6, LAYER, event_name(ev), "i",
+                    {"a1": a1, "a2": a2}])
+    return out
+
+
+def tail_lines(channel, n: int = 16) -> List[str]:
+    """The last ``n`` native events of EVERY co-located rank,
+    region-tagged — the stall watchdog's hang-report section (a wedged
+    flat wave reads as 'rank 2 never reached flat_fanout', not a blind
+    stall)."""
+    path = _channel_ring(channel)
+    if path is None:
+        return ["native trace ring off (MV2T_NTRACE) — no C-plane "
+                "event tail"]
+    lines: List[str] = []
+    for w in channel.local_ranks:
+        i = channel.local_index[w]
+        evs = read_ring(path, i, last=n)
+        lines.append(f"world {w} (ring {i}): {ring_depth(path, i)} "
+                     f"events claimed, last {len(evs)}:")
+        for ts_us, ev, a1, a2 in evs:
+            reg = event_region(ev)
+            tag = f" [{reg}]" if reg else ""
+            lines.append(f"  {ts_us / 1e6:.6f} {event_name(ev)} "
+                         f"a1={a1} a2={a2}{tag}")
+    return lines
+
+
+def summarize(path: str) -> Dict[int, Dict[str, int]]:
+    """Per-rank event-name histogram of a segment file (mpistat)."""
+    out: Dict[int, Dict[str, int]] = {}
+    for i in range(_rank_count(path)):
+        hist: Dict[str, int] = {}
+        for _ts, ev, _a1, _a2 in read_ring(path, i):
+            name = event_name(ev)
+            hist[name] = hist.get(name, 0) + 1
+        out[i] = hist
+    return out

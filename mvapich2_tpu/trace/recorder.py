@@ -43,8 +43,13 @@ cvar("TRACE_DIR", "", str, "trace",
      "bin/mpitrace sets this and merges the dumps into one Perfetto "
      "JSON after the job exits.")
 
-# the five instrumented layers, in lane order for the Perfetto export
-LAYERS = ("mpi", "protocol", "channel", "progress", "nbc")
+# the instrumented layers, in lane order for the Perfetto export. Two
+# lanes beyond the python recorder's five: "device" (coll/device.py
+# dispatch spans + ops/pallas_ici.py entry instants) and "cplane" (the
+# native trace ring of cplane.cpp, merged into the rank dump at
+# Finalize — see trace/native.py).
+LAYERS = ("mpi", "protocol", "channel", "progress", "nbc", "device",
+          "cplane")
 
 
 class Recorder:
@@ -136,7 +141,19 @@ def dump_rank(engine) -> Optional[str]:
     if not out_dir:
         return None
     os.makedirs(out_dir, exist_ok=True)
+    snap = rec.snapshot()
+    # merge the native C-plane ring (MV2T_NTRACE) into this rank's dump:
+    # both clocks are CLOCK_MONOTONIC, so C events and python spans
+    # share the Perfetto time axis with no translation. Diagnostics
+    # must never kill Finalize — any ring-parse trouble drops the lane.
+    try:
+        from . import native as _native
+        u = getattr(engine, "universe", None)
+        pch = getattr(u, "plane_channel", None) if u is not None else None
+        snap["events"].extend(_native.drain_channel(pch))
+    except Exception:
+        pass
     path = os.path.join(out_dir, f"trace-r{rec.rank}.json")
     with open(path, "w") as f:
-        json.dump(rec.snapshot(), f)
+        json.dump(snap, f)
     return path

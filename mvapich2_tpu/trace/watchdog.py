@@ -105,6 +105,18 @@ def build_report(engine) -> str:
             lines.extend(_flat_report(u, pch, fmap))
         except Exception as e:
             lines.append(f"## flat-slot state unavailable: {e!r}")
+        # native trace tail of EVERY co-located rank (MV2T_NTRACE ring,
+        # region-tagged): the hang report shows the last C-plane events
+        # — which flat phase each rank reached, who rang whose bell,
+        # whether a lease scan fired — not just counter values
+        try:
+            from . import native as _native
+            n = int(get_config().get("STALL_EVENTS", 64))
+            lines.append("## native C-plane trace tail (per local rank)")
+            for ln in _native.tail_lines(pch, n):
+                lines.append(f"  {ln}")
+        except Exception as e:
+            lines.append(f"## native trace tail unavailable: {e!r}")
         lines.extend(_protocol_map_lines(fmap))
 
     tracer = getattr(engine, "tracer", None)

@@ -130,6 +130,9 @@ _WRAP = 0xFFFFFFFF       # wrap marker (MV2T_RING_WRAP)
 _ALIGN = 8               # ring message alignment (MV2T_RING_ALIGN)
 _LEASE_ALIGN = 8         # flags segment: pad sleep bytes to this
 _LEASE_STAMP = 8         # bytes per liveness-lease stamp (u64)
+_FPC_SLOTS = 16          # fast-path counter mirror slots per rank
+                         # (MV2T_FPC_SLOTS — the flags-segment tail that
+                         # makes fp_* counters attachable by bin/mpistat)
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -317,6 +320,11 @@ def _bind_cplane(lib) -> None:
     lib.cp_flat_set_progress_cb.argtypes = [L.c_void_p, L.c_void_p]
     lib.cp_fp_counter.restype = L.c_ulonglong
     lib.cp_fp_counter.argtypes = [L.c_void_p, L.c_int]
+    # native trace ring (MV2T_NTRACE; trace/native.py drains the file)
+    lib.cp_ntrace_attach.argtypes = [L.c_void_p, L.c_char_p, L.c_int]
+    lib.cp_ntrace_ok.argtypes = [L.c_void_p]
+    lib.cp_ntrace_emit.argtypes = [L.c_void_p, L.c_int, L.c_longlong,
+                                   L.c_longlong]
 
 
 class _PyRing:
@@ -595,7 +603,8 @@ class ShmChannel(Channel):
         flags_path = boot_card["flags"] if boot_card is not None \
             else f"{path}.flags"
         lease_off = (self.n_local + _LEASE_ALIGN - 1) & ~(_LEASE_ALIGN - 1)
-        flags_len = lease_off + _LEASE_STAMP * self.n_local
+        flags_len = lease_off + _LEASE_STAMP * self.n_local \
+            + 8 * _FPC_SLOTS * self.n_local
         if boot_card is not None:
             pass    # pre-created (zeroed) at light boot; just map it
         elif self._owner:
@@ -616,6 +625,14 @@ class ShmChannel(Channel):
         self._flags = mmap.mmap(self._flags_f.fileno(), flags_len)
         self._lease = np.frombuffer(self._flags, dtype=np.uint64,
                                     count=self.n_local, offset=lease_off)
+        # per-rank fast-path counter mirror (the flags-segment tail):
+        # cp_create points the plane's fpctr at this rank's row, so the
+        # same slots are readable here for every co-located rank — the
+        # surface bin/mpistat attaches to from outside the job
+        self._fpc_mirror = np.frombuffer(
+            self._flags, dtype=np.uint64,
+            count=self.n_local * _FPC_SLOTS,
+            offset=lease_off + _LEASE_STAMP * self.n_local)
         self._lease_scan_at = 0.0      # python-probe throttle
         self._failed_seen: set = set() # C-detections already reconciled
         self._lease_stamp()
@@ -643,6 +660,10 @@ class ShmChannel(Channel):
         self._ring_cap = 0
         self._flat_path = boot_card["flat"] if boot_card is not None \
             else f"{path}.fcoll"
+        # native trace ring segment (beside the ring file; daemon mode
+        # puts it beside the claimed ring, reset implicitly by the
+        # monotonic timestamps — trace/native.py drops zero-ts slots)
+        self._ntrace_path = f"{path}.ntrace"
         self._flat_cb = None           # keepalive for the ctypes callback
         self.cabi_ranks = set()        # local ranks that are C-ABI procs
         if self.using_native and get_config()["USE_CPLANE"]:
@@ -684,6 +705,16 @@ class ShmChannel(Channel):
                 lib.cp_set_peer_timeout(self.plane,
                                         int(self._peer_timeout * 1e6))
                 lib.cp_register_global(self.plane)
+                # native trace ring: armed when the MV2T_NTRACE cvar is
+                # set (or follows MV2T_TRACE when left at its -1
+                # default). Zero-filled is the initialized state, so
+                # every rank creates/attaches without ordering; events
+                # drain at Finalize into the Perfetto merge and live
+                # into the watchdog/mpistat tails (trace/native.py).
+                from ..trace import native as _nt
+                if _nt.ntrace_enabled():
+                    lib.cp_ntrace_attach(self.plane,
+                                         self._ntrace_path.encode(), 1)
                 # bind the plane counters' sources to this live plane:
                 # fast-path hit-rate is the one number that says
                 # whether a workload actually rides the C path — it
@@ -732,6 +763,22 @@ class ShmChannel(Channel):
         if not self.plane:
             return 0
         return int(self._ring.lib.cp_fp_counter(self.plane, idx))
+
+    def fpc_snapshot(self, world_rank: int):
+        """All _FPC_SLOTS counter slots of a CO-LOCATED rank, read from
+        the flags segment's shm mirror (a stale/torn snapshot is fine —
+        stat surface, one natural writer per slot). None when the rank
+        is not local."""
+        i = self.local_index.get(world_rank)
+        if i is None or self._fpc_mirror is None:
+            return None
+        row = self._fpc_mirror[i * _FPC_SLOTS:(i + 1) * _FPC_SLOTS]
+        return [int(v) for v in row]
+
+    def ntrace_active(self) -> bool:
+        """Is the native trace ring armed on this plane?"""
+        return bool(self.plane
+                    and self._ring.lib.cp_ntrace_ok(self.plane))
 
     def plane_stats(self):
         """(eager_tx, eager_rx, fwd_py, rndv_tx, rndv_rx) from the C
@@ -1544,7 +1591,8 @@ class ShmChannel(Channel):
         except OSError:
             pass
         try:
-            self._lease = None     # release the buffer export first
+            self._lease = None     # release the buffer exports first
+            self._fpc_mirror = None
             self._flags.close()
             self._flags_f.close()
         except (OSError, ValueError, BufferError):
@@ -1561,7 +1609,7 @@ class ShmChannel(Channel):
                 _daemon.release(self._daemon_claim)
             elif not self._daemon:
                 for path in (self.path, self._flags_path,
-                             self._flat_path):
+                             self._flat_path, self._ntrace_path):
                     try:
                         os.unlink(path)
                     except OSError:

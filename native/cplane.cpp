@@ -297,10 +297,19 @@ struct CPlane {
   size_t flat_len;
   // fast-path observability counters (indices FPC_*, shm_layout.h);
   // written by fastpath.c through cp_fp_counters() and by cp_flat_*,
-  // read by the python mpit layer.
-  uint64_t fpctr[MV2T_FPC_SLOTS]; /* shared: counter(one natural writer
-                                   * per slot; stat reads tolerate a
-                                   * stale or torn snapshot) */
+  // read by the python mpit layer — and, when the flags segment carries
+  // the counter tail (shm_layout.h), by bin/mpistat attaching from
+  // outside the job: cp_create points this at the rank's shm row.
+  uint64_t* fpctr;               /* shared: counter(one natural writer
+                                  * per slot; stat reads tolerate a
+                                  * stale or torn snapshot) */
+  int fpctr_private;             // 1 = heap block (free in cp_destroy)
+  // native trace ring (<ring path>.ntrace, MV2T_NTRACE macro): mapped
+  // only when tracing is armed — the emit macro's whole off-cost is
+  // the nt_mine NULL check
+  uint8_t* nt;                   // segment base (NULL = tracing off)
+  size_t nt_len;
+  uint8_t* nt_mine;              // this rank's ring (header at +0)
   // python-progress callback for flat waits: invoked (rarely) when
   // forwarded python work is pending while a rank is parked in a flat
   // collective, so rendezvous assists cannot deadlock behind it
@@ -318,6 +327,68 @@ inline uint64_t now_us() {
   clock_gettime(CLOCK_MONOTONIC, &ts);
   return static_cast<uint64_t>(ts.tv_sec) * 1000000u + ts.tv_nsec / 1000;
 }
+
+// ---------------------------------------------------------------------------
+// native trace ring (MV2T_NTRACE) — the C-plane analog of the python
+// recorder (trace/recorder.py): a per-rank lock-free event ring in its
+// own shm segment, drained post-hoc by trace/native.py (Finalize merge
+// into the Perfetto JSON, watchdog hang-report tail, bin/mpistat).
+// Geometry lives in shm_layout.h. Claim protocol: a writer thread
+// fetch-adds the rank header's seq (slot uniqueness across the process'
+// threads), fills the record plainly — torn reads are the READER's
+// problem — and release-stores ts_us LAST; a reader validates each
+// slot's claim stamp against the seq window it acquire-read, so a
+// mid-overwrite slot is dropped, never misparsed.
+// ---------------------------------------------------------------------------
+
+struct NtHdr {                    // per-rank ring header (one cache line)
+  uint64_t seq;                   /* shared: atomic(ntrace) */
+};
+
+struct NtRec {                    // MV2T_NTR_EV_BYTES, mirrored in python
+  uint64_t ts_us;                 /* shared: atomic(ntrace) */
+  uint32_t ev;
+  uint32_t claim;                 // low 32 bits of the claiming seq
+  int64_t a1;
+  int64_t a2;
+};
+static_assert(sizeof(NtRec) == MV2T_NTR_EV_BYTES, "ntrace record layout");
+
+#ifndef MV2T_NO_NTRACE
+void nt_emit(CPlane* p, int ev, int64_t a1, int64_t a2) {
+  uint8_t* ring = p->nt_mine;
+  NtHdr* h = reinterpret_cast<NtHdr*>(ring);
+  uint64_t idx = __atomic_fetch_add(&h->seq, 1, __ATOMIC_RELAXED);
+  NtRec* r = reinterpret_cast<NtRec*>(
+      ring + MV2T_NTR_HDR_BYTES
+      + (idx % MV2T_NTR_RING_EVENTS) * MV2T_NTR_EV_BYTES);
+  r->ev = static_cast<uint32_t>(ev);
+  r->claim = static_cast<uint32_t>(idx);
+  r->a1 = a1;
+  r->a2 = a2;
+  // ts last, release: a reader that sees a nonzero ts sees the record
+  struct timespec ts_;
+  clock_gettime(CLOCK_MONOTONIC, &ts_);
+  __atomic_store_n(&r->ts_us,
+                   static_cast<uint64_t>(ts_.tv_sec) * 1000000u
+                       + ts_.tv_nsec / 1000,
+                   __ATOMIC_RELEASE);
+}
+// ONE branch when tracing is off (nt_mine stays NULL unless the python
+// side armed the ring via cp_ntrace_attach under the MV2T_NTRACE cvar);
+// build with -DMV2T_NO_NTRACE to compile every site to nothing.
+#define MV2T_NTRACE(p, ev, a1, a2)                                      \
+  do {                                                                  \
+    if ((p)->nt_mine)                                                   \
+      nt_emit((p), (ev), static_cast<int64_t>(a1),                      \
+              static_cast<int64_t>(a2));                                \
+  } while (0)
+#else
+// compiled-out stub: evaluates nothing, but still "uses" every
+// argument so -Wextra stays quiet in the NTRACE=0 build
+#define MV2T_NTRACE(p, ev, a1, a2) \
+  ((void)(p), (void)(ev), (void)(a1), (void)(a2), (void)0)
+#endif
 
 void req_destroy(Req* r) {
   if (r->scatter) {
@@ -406,6 +477,7 @@ void ring_bell(CPlane* p, int dst) {
   (void)sendto(p->bell_tx, "x", 1, MSG_DONTWAIT,
                reinterpret_cast<struct sockaddr*>(&p->bells[dst]),
                sizeof(p->bells[dst]));
+  MV2T_NTRACE(p, NTE_BELL_RING, dst, 0);
 }
 
 // try to push dst's backlog into the ring; returns #blobs moved, -1 if
@@ -507,6 +579,7 @@ void complete_eager(CPlane* p, Req* r, const PktHdr* h,
   r->st_nbytes = h->nbytes;
   r->truncated = h->nbytes > r->cap;
   r->state = RS_DONE;
+  MV2T_NTRACE(p, NTE_EAGER_RX, h->src_world, h->nbytes);
   reap_orphan(p, r);
 }
 
@@ -579,6 +652,7 @@ void cma_complete(CPlane* p, Req* r, const PktHdr* h) {
   r->errclass = rc ? ERRCLASS_INTERN : 0;
   r->state = RS_DONE;
   p->n_rndv_rx++;
+  MV2T_NTRACE(p, NTE_RNDV_RX, h->src_world, h->nbytes);
   int sr = ring_of_world(p, h->src_world);
   if (sr >= 0)
     send_fin_cma(p, sr, h->sreq_id, rc ? 0 : n, rc ? -1 : 0);
@@ -826,18 +900,27 @@ void* cp_create(void* ring, int my_index, int n_local,
   p->bell_tx = socket(AF_UNIX, SOCK_DGRAM, 0);
   p->flags = nullptr;
   p->lease = nullptr;
+  // default: private counter block; repointed at the flags segment's
+  // shm mirror below when the file carries the counter tail, so an
+  // attaching monitor (bin/mpistat) reads every rank's slots live
+  p->fpctr = static_cast<uint64_t*>(calloc(MV2T_FPC_SLOTS, 8));
+  p->fpctr_private = 1;
   if (flags_path && flags_path[0]) {
     int fd = open(flags_path, O_RDWR);
     if (fd >= 0) {
       // layout (shm.py): [n_local sleep bytes][pad to 8][n_local u64
-      // lease stamps]. A shorter file is the pre-lease layout — map
-      // the sleep flags only and leave lease detection off.
+      // lease stamps][n_local x MV2T_FPC_SLOTS u64 counter mirror].
+      // A shorter file is an older layout — map what it carries and
+      // degrade (lease off / private counters).
       long pad = (n_local + 7) & ~7;
       long want = pad + 8L * n_local;
+      long want_full = want + 8L * MV2T_FPC_SLOTS * n_local;
       struct stat st;
       long have = (fstat(fd, &st) == 0) ? static_cast<long>(st.st_size)
                                         : n_local;
-      long maplen = have >= want ? want : n_local;
+      long maplen = have >= want_full ? want_full
+                    : have >= want ? want
+                                   : n_local;
       void* m = mmap(nullptr, maplen, PROT_READ | PROT_WRITE, MAP_SHARED,
                      fd, 0);
       if (m != MAP_FAILED) {
@@ -846,6 +929,13 @@ void* cp_create(void* ring, int my_index, int n_local,
         if (maplen >= want)
           p->lease = reinterpret_cast<volatile uint64_t*>(
               static_cast<uint8_t*>(m) + pad);
+        if (maplen >= want_full) {
+          free(p->fpctr);
+          p->fpctr = reinterpret_cast<uint64_t*>(
+                         static_cast<uint8_t*>(m) + want)
+                     + static_cast<long>(my_index) * MV2T_FPC_SLOTS;
+          p->fpctr_private = 0;
+        }
       }
       close(fd);
     }
@@ -876,8 +966,10 @@ void cp_destroy(void* cp) {
   if (!p) return;
   void* g = g_plane.load(std::memory_order_acquire);
   if (g == cp) g_plane.store(nullptr, std::memory_order_release);
+  if (p->fpctr_private) free(p->fpctr);
   if (p->flags) munmap(p->flags, p->flags_len);
   if (p->flat) munmap(p->flat, p->flat_len);
+  if (p->nt) munmap(p->nt, p->nt_len);
   if (p->bell_tx >= 0) close(p->bell_tx);
   for (int d = 0; d < p->n_local; d++) {
     Blob* b = p->backlog_head[d];
@@ -1031,6 +1123,7 @@ long long cp_send_eager(void* cp, int dst, int ctx, int comm_src, int tag,
   pthread_mutex_unlock(&p->mu);
   if (blob != stackbuf) free(blob);
   if (rc <= 0) return -1;
+  MV2T_NTRACE(p, NTE_EAGER_TX, dst, nbytes);
   ring_bell(p, dst);
   return 0;
 }
@@ -1118,6 +1211,7 @@ long long cp_send_eager_sp(void* cp, int dst, int ctx, int comm_src,
   pthread_mutex_unlock(&p->mu);
   if (blob != stackbuf) free(blob);
   if (rc <= 0) return -1;
+  MV2T_NTRACE(p, NTE_EAGER_TX, dst, nbytes);
   ring_bell(p, dst);
   return 0;
 }
@@ -1155,6 +1249,7 @@ long long cp_send_rndv(void* cp, int dst, int ctx, int comm_src, int tag,
   p->n_rndv_tx++;
   long long id = r->id;
   pthread_mutex_unlock(&p->mu);
+  MV2T_NTRACE(p, NTE_RNDV_TX, dst, nbytes);
   ring_bell(p, dst);
   return id;
 }
@@ -1626,11 +1721,14 @@ int cp_lease_scan(void* cp) {
               "cplane: world rank %d (ring %d) lease expired "
               "(%.2fs stale) — declaring it dead\n",
               p->world_of[i], i, (now - v) / 1e6);
+      MV2T_NTRACE(p, NTE_LEASE_EXPIRE, p->world_of[i],
+                  static_cast<int64_t>(now - v));
       cp_mark_failed(p, i);
       p->fpctr[FPC_DEAD_PEER]++;
       ndead++;
     }
   }
+  MV2T_NTRACE(p, NTE_LEASE_SCAN, ndead, 0);
   return ndead;
 }
 
@@ -1897,8 +1995,11 @@ inline volatile uint64_t* fl_poi(uint8_t* reg) { /* shared: seqlock(flat) */
   return reinterpret_cast<volatile uint64_t*>(reg);
 }
 
-inline int flat_fail(uint8_t* reg, int rc) {
-  if (rc == -2 || rc == -3) fl_store(fl_poi(reg), 1);
+inline int flat_fail(CPlane* p, uint8_t* reg, int rc) {
+  if (rc == -2 || rc == -3) {
+    fl_store(fl_poi(reg), 1);
+    MV2T_NTRACE(p, NTE_FLAT_POISON, rc, 0);
+  }
   return rc;
 }
 
@@ -2090,6 +2191,7 @@ int cp_flat_allreduce(void* cp, int ctx, int lane, int rank, int n,
   uint8_t* bcb = flat_bcb(reg);
   flat_fault(p);
   flat_enter(mine, s);
+  MV2T_NTRACE(p, NTE_FLAT_FANIN, ctx, seq);
   int rc = 0;
   if (rank == 0) {
     // overwrite guard: every reader of the previous broadcast payload
@@ -2111,16 +2213,18 @@ int cp_flat_allreduce(void* cp, int ctx, int lane, int rank, int n,
       fl_store(fl_in(mine), s);
       fl_store(fl_out(mine), s);
       p->fpctr[FPC_COLL_FLAT]++;
+      MV2T_NTRACE(p, NTE_FLAT_FOLD, ctx, seq);
     }
-    return flat_fail(reg, rc);
+    return flat_fail(p, reg, rc);
   }
   if (nb > 0) memcpy(fl_pay(mine), sbuf, nb);
   fl_store(fl_in(mine), s);
   rc = flat_wait(p, fl_in(bcb), s);
-  if (rc != 0) return flat_fail(reg, rc);
+  if (rc != 0) return flat_fail(p, reg, rc);
   if (nb > 0) memcpy(rbuf, fl_pay(bcb), nb);
   fl_store(fl_out(mine), s);
   p->fpctr[FPC_COLL_FLAT]++;
+  MV2T_NTRACE(p, NTE_FLAT_FANOUT, ctx, seq);
   return 0;
 }
 
@@ -2142,6 +2246,7 @@ int cp_flat_reduce(void* cp, int ctx, int lane, int rank, int n,
   uint8_t* bcb = flat_bcb(reg);
   flat_fault(p);
   flat_enter(mine, s);
+  MV2T_NTRACE(p, NTE_FLAT_FANIN, ctx, seq);
   int rc = 0;
   if (rank == root) {
     if (nb > 0 && rbuf != sbuf) memcpy(rbuf, sbuf, nb);
@@ -2157,15 +2262,17 @@ int cp_flat_reduce(void* cp, int ctx, int lane, int rank, int n,
       fl_store(fl_in(mine), s);
       fl_store(fl_out(mine), s);
       p->fpctr[FPC_COLL_FLAT]++;
+      MV2T_NTRACE(p, NTE_FLAT_FOLD, ctx, seq);
     }
-    return flat_fail(reg, rc);
+    return flat_fail(p, reg, rc);
   }
   if (nb > 0) memcpy(fl_pay(mine), sbuf, nb);
   fl_store(fl_in(mine), s);
   rc = flat_wait(p, fl_in(bcb), s);
-  if (rc != 0) return flat_fail(reg, rc);
+  if (rc != 0) return flat_fail(p, reg, rc);
   fl_store(fl_out(mine), s);
   p->fpctr[FPC_COLL_FLAT]++;
+  MV2T_NTRACE(p, NTE_FLAT_FANOUT, ctx, seq);
   return 0;
 }
 
@@ -2196,6 +2303,7 @@ int cp_flat_bcast(void* cp, int ctx, int lane, int rank, int n,
   uint8_t* bcb = flat_bcb(reg);
   flat_fault(p);
   flat_enter(mine, s);
+  MV2T_NTRACE(p, NTE_FLAT_FANIN, ctx, seq);
   int rc = 0;
   if (rank == root) {
     // arrival wave: in_seq >= s also proves the rank consumed wave
@@ -2205,23 +2313,25 @@ int cp_flat_bcast(void* cp, int ctx, int lane, int rank, int n,
       if (r == root) continue;
       rc = flat_wait(p, fl_in(flat_slot(reg, r)), s);
     }
-    if (rc != 0) return flat_fail(reg, rc);
+    if (rc != 0) return flat_fail(p, reg, rc);
     if (nbytes > 0) memcpy(fl_pay(bcb), buf, nbytes);
     fl_store(fl_out(bcb), static_cast<uint64_t>(nbytes));
     fl_store(fl_in(bcb), s);
     fl_store(fl_in(mine), s);
     fl_store(fl_out(mine), s);
     p->fpctr[FPC_COLL_FLAT]++;
+    MV2T_NTRACE(p, NTE_FLAT_FOLD, ctx, seq);
     return 0;
   }
   fl_store(fl_in(mine), s);     // arrival stamp: the root blocks on it
   rc = flat_wait(p, fl_in(bcb), s);
-  if (rc != 0) return flat_fail(reg, rc);
+  if (rc != 0) return flat_fail(p, reg, rc);
   long long have = static_cast<long long>(fl_load(fl_out(bcb)));
   long long take = have < nbytes ? have : nbytes;
   if (take > 0) memcpy(buf, fl_pay(bcb), take);
   fl_store(fl_out(mine), s);
   p->fpctr[FPC_COLL_FLAT]++;
+  MV2T_NTRACE(p, NTE_FLAT_FANOUT, ctx, seq);
   return have != nbytes ? -4 : 0;
 }
 
@@ -2231,6 +2341,62 @@ int cp_flat_barrier(void* cp, int ctx, int lane, int rank, int n,
                     long long seq) {
   return cp_flat_allreduce(cp, ctx, lane, rank, n, seq, 0, 0, nullptr,
                            nullptr, 0, 1);
+}
+
+// ---------------------------------------------------------------------------
+// native trace ring plumbing (MV2T_NTRACE). The python side arms the
+// ring (cp_ntrace_attach under the MV2T_NTRACE cvar); once nt_mine is
+// set every MV2T_NTRACE site in this file emits. Readers never attach
+// to the process — trace/native.py parses the segment file directly.
+// ---------------------------------------------------------------------------
+
+// map (creating when asked) the per-node trace ring segment. Zero-filled
+// IS the initialized state (seq 0, ts 0 = empty slots), so every rank
+// may create=1 without ordering: O_CREAT without O_EXCL plus a
+// grow-only ftruncate is idempotent. Returns 0 ok, -1 unusable
+// (compiled out, bad args, mmap failure).
+int cp_ntrace_attach(void* cp, const char* path, int create) {
+#ifdef MV2T_NO_NTRACE
+  (void)cp; (void)path; (void)create;
+  return -1;
+#else
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (!p || !path || !path[0]) return -1;
+  if (p->nt) return 0;
+  long want = MV2T_NTR_FILE_HDR
+              + static_cast<long>(p->n_local) * MV2T_NTR_RANK_STRIDE;
+  int fd = open(path, create ? (O_CREAT | O_RDWR) : O_RDWR, 0600);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0 ||
+      (st.st_size < want && (!create || ftruncate(fd, want) != 0))) {
+    close(fd);
+    return -1;
+  }
+  void* m = mmap(nullptr, want, PROT_READ | PROT_WRITE, MAP_SHARED,
+                 fd, 0);
+  close(fd);
+  if (m == MAP_FAILED) return -1;
+  p->nt = static_cast<uint8_t*>(m);
+  p->nt_len = static_cast<size_t>(want);
+  p->nt_mine = p->nt + MV2T_NTR_FILE_HDR
+               + static_cast<long>(p->me) * MV2T_NTR_RANK_STRIDE;
+  return 0;
+#endif
+}
+
+int cp_ntrace_ok(void* cp) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  return (p && p->nt) ? 1 : 0;
+}
+
+// out-of-line emit for consumers outside this file: fastpath.c's
+// collective dispatch (lenient dlsym — older .so just skips) and the
+// python tests. Same one-branch gate as the macro.
+void cp_ntrace_emit(void* cp, int ev, long long a1, long long a2) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (!p) return;
+  MV2T_NTRACE(p, ev, a1, a2);
 }
 
 // fast-path counter surface: fastpath.c caches the pointer and bumps
@@ -2273,6 +2439,7 @@ int cp_wait_quantum(void* cp, long long req, long spin_us, long block_ms) {
   // The advertise store must order BEFORE the final poll's loads
   // (store-then-load, Dekker-style) — seq_cst, paired with the sender's
   // acquire load in ring_bell.
+  MV2T_NTRACE(p, NTE_SPIN_BELL, req, spin_us);
   if (p->flags)
     __atomic_store_n(&p->flags[p->me], 1, __ATOMIC_SEQ_CST);
   pthread_mutex_lock(&p->mu);
@@ -2312,6 +2479,7 @@ int cp_wait_quantum(void* cp, long long req, long spin_us, long block_ms) {
   }
   if (p->flags)
     __atomic_store_n(&p->flags[p->me], 0, __ATOMIC_RELEASE);
+  if (woken) MV2T_NTRACE(p, NTE_BELL_WAKE, req, 0);
   // idle with nothing arriving: the awaited peer may be dead — the
   // (throttled) lease scan marks it, cp_mark_failed sweeps its sends,
   // and the python reconciliation unwinds its posted recvs

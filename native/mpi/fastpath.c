@@ -91,7 +91,22 @@ static struct {
     int (*flat_nslots)(void);
     void (*flat_set_progress_cb)(cph, void (*)(void));
     unsigned long long *(*fp_counters)(cph);
+    /* native trace ring (optional symbol — an older libshmring.so
+     * simply has no ring; NULL means skip). One NULL check per
+     * dispatch when present, nothing when absent. */
+    void (*ntrace_emit)(cph, int, long long, long long);
 } F;
+
+/* collective-tier dispatch breadcrumb for the native trace ring
+ * (NTE_COLL_DISPATCH, shm_layout.h): tier 0 = flat slots, 1 = pt2pt
+ * schedules. The per-hop events (eager/rendezvous/flat waves) fire
+ * inside cplane.cpp; this names which tier the C ABI picked. */
+#define FPNT(p, tier, nb)                                              \
+    do {                                                               \
+        if (F.ntrace_emit != NULL)                                     \
+            F.ntrace_emit((p), NTE_COLL_DISPATCH, (long long)(tier),   \
+                          (long long)(nb));                            \
+    } while (0)
 
 /* fast-path counter indices come from shm_layout.h (FPC_*) — one enum
  * for cplane.cpp, this file, and the mv2tlint layout check against
@@ -178,6 +193,9 @@ static int fp_load_locked(void) {
     SYM(flat_set_progress_cb, "cp_flat_set_progress_cb");
     SYM(fp_counters, "cp_fp_counters");
 #undef SYM
+    /* lenient: the trace-ring emit is observability, not protocol — a
+     * ring-less .so (NTRACE=0 build) must not disable the fast path */
+    *(void **)&F.ntrace_emit = dlsym(F.dl, "cp_ntrace_emit");
     return 1;
 }
 
@@ -1365,6 +1383,7 @@ int fp_try_allreduce(const void *sendbuf, void *recvbuf, int count,
     }
     long long fseq = fpc_flat_next(p, fc, nb);
     if (fseq > 0) {
+        FPNT(p, 0, nb);
         const void *sb = sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf;
         int rc = F.flat_allreduce(p, fc->ctx + 1, fc->flat_lane, rank,
                                   n, fseq, op, dt, sb, recvbuf, count,
@@ -1374,6 +1393,7 @@ int fp_try_allreduce(const void *sendbuf, void *recvbuf, int count,
     }
     if (sendbuf != MPI_IN_PLACE && nb > 0)
         memcpy(recvbuf, sendbuf, (size_t)nb);
+    FPNT(p, 1, nb);
     FPCTR(FPC_COLL_SCHED);
     int tag = F.coll_tag(p, fc->ctx + 1);
     void *tmp = malloc(nb > 0 ? (size_t)nb : 1);
@@ -1470,6 +1490,7 @@ int fp_try_bcast(void *buf, int count, MPI_Datatype dt, int root,
     }
     long long fseq = fpc_flat_next(p, fc, nb);
     if (fseq > 0) {
+        FPNT(p, 0, nb);
         int frc = F.flat_bcast(p, fc->ctx + 1, fc->flat_lane, rank, n,
                                fseq, root, data, nb);
         if (frc == 0 || frc == -4) {
@@ -1487,6 +1508,7 @@ int fp_try_bcast(void *buf, int count, MPI_Datatype dt, int root,
         *out_rc = fpc_flat_err(fc, frc);
         return 1;
     }
+    FPNT(p, 1, nb);
     FPCTR(FPC_COLL_SCHED);
     int tag = F.coll_tag(p, fc->ctx + 1);
     int relrank = (rank - root + n) % n;
@@ -1565,6 +1587,7 @@ int fp_try_reduce(const void *sendbuf, void *recvbuf, int count,
     if (n > 1) {
         long long fseq = fpc_flat_next(p, fc, nb);
         if (fseq > 0) {
+            FPNT(p, 0, nb);
             const void *sb = sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf;
             int frc = F.flat_reduce(p, fc->ctx + 1, fc->flat_lane, rank,
                                     n, fseq, op, dt, root, sb,
@@ -1573,6 +1596,7 @@ int fp_try_reduce(const void *sendbuf, void *recvbuf, int count,
             *out_rc = frc == 0 ? MPI_SUCCESS : fpc_flat_err(fc, frc);
             return 1;
         }
+        FPNT(p, 1, nb);
         FPCTR(FPC_COLL_SCHED);
     }
     /* accumulate into recvbuf at the root, a scratch result elsewhere */
@@ -1643,11 +1667,13 @@ int fp_try_barrier(MPI_Comm comm, int *out_rc) {
     }
     long long fseq = fpc_flat_next(p, fc, 0);
     if (fseq > 0) {
+        FPNT(p, 0, nb);
         int frc = F.flat_barrier(p, fc->ctx + 1, fc->flat_lane, rank, n,
                                  fseq);
         *out_rc = frc == 0 ? MPI_SUCCESS : fpc_flat_err(fc, frc);
         return 1;
     }
+    FPNT(p, 1, nb);
     FPCTR(FPC_COLL_SCHED);
     int tag = F.coll_tag(p, fc->ctx + 1);
     int rc = MPI_SUCCESS;

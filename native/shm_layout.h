@@ -54,6 +54,52 @@
 #define MV2T_FLAT_FILE_LEN \
     (MV2T_FLAT_NREG * MV2T_FLAT_LANES * MV2T_FLAT_REG_STRIDE)
 
+/* ---- native trace ring segment (<path>.ntrace) -----------------------
+ * One lock-free single-process-writer event ring per local rank,
+ * written by the MV2T_NTRACE(...) macro in cplane.cpp (one pointer
+ * branch when off; compiled out entirely with -DMV2T_NO_NTRACE) and
+ * read — without attaching to the process — by trace/native.py (the
+ * Finalize drain into the Perfetto merge, the watchdog hang-report
+ * tail, and bin/mpistat). Layout:
+ *   [MV2T_NTR_FILE_HDR file header]
+ *   n_local x { [MV2T_NTR_HDR_BYTES rank header: u64 claim seq @0]
+ *               [MV2T_NTR_RING_EVENTS x MV2T_NTR_EV_BYTES records] }
+ * Record: u64 ts_us (CLOCK_MONOTONIC, written LAST with release — a
+ * zero ts marks an unfilled slot), u32 event id (NTE_*), u32 claim
+ * stamp (low 32 bits of the claiming seq — readers drop slots whose
+ * stamp mismatches, which detects mid-overwrite tears), i64 a1, i64 a2.
+ * Python mirrors these numbers in trace/native.py; the mv2tlint layout
+ * pass cross-checks them like every other constant here. */
+#define MV2T_NTR_FILE_HDR 64
+#define MV2T_NTR_HDR_BYTES 64
+#define MV2T_NTR_EV_BYTES 32
+#define MV2T_NTR_RING_EVENTS 2048
+#define MV2T_NTR_RANK_STRIDE \
+    (MV2T_NTR_HDR_BYTES + MV2T_NTR_RING_EVENTS * MV2T_NTR_EV_BYTES)
+
+/* Native trace event ids. Index order is load-bearing: cplane.cpp and
+ * fastpath.c emit the slots, trace/native.py maps id -> (name, protocol
+ * region) name-by-name (NTE_FLAT_FANIN <-> flat_fanin, ...) — checked
+ * by the mv2tlint layout pass exactly like the FPC enum below. */
+enum {
+    NTE_FLAT_FANIN = 0,    /* flat wave: this rank stamped in_seq */
+    NTE_FLAT_FOLD = 1,     /* flat wave: leader folded + stamped bseq */
+    NTE_FLAT_FANOUT = 2,   /* flat wave: this rank copied out */
+    NTE_FLAT_POISON = 3,   /* flat wave died; region poisoned sticky */
+    NTE_BELL_RING = 4,     /* doorbell datagram fired toward a1 */
+    NTE_BELL_WAKE = 5,     /* blocking wait woken by the doorbell */
+    NTE_SPIN_BELL = 6,     /* spin budget spent -> advertised sleep */
+    NTE_LEASE_SCAN = 7,    /* lease scan ran (a1 = peers declared dead) */
+    NTE_LEASE_EXPIRE = 8,  /* peer a1's lease expired (a2 = staleness us) */
+    NTE_EAGER_TX = 9,      /* C-plane eager send (a1 = dst, a2 = bytes) */
+    NTE_EAGER_RX = 10,     /* C-plane eager match (a1 = src, a2 = bytes) */
+    NTE_RNDV_TX = 11,      /* CMA rendezvous exposed (a1 = dst, a2 = bytes) */
+    NTE_RNDV_RX = 12,      /* CMA rendezvous pulled (a1 = src, a2 = bytes) */
+    NTE_COLL_DISPATCH = 13 /* C-ABI collective tier pick (a1 = 0 flat /
+                            * 1 sched, a2 = bytes) */
+};
+#define MV2T_NTE_COUNT 14
+
 /* ---- fast-path observability counters (CPlane.fpctr) -----------------
  * Index order is load-bearing across three consumers: cplane.cpp and
  * fastpath.c bump the slots, transport/shm.py's _FP_COUNTERS list maps
@@ -74,5 +120,14 @@ enum {
     FPC_DEAD_PEER = 11     /* peers declared dead by the C lease scan */
 };
 #define MV2T_FPC_SLOTS 16  /* fpctr array length (spare slots included) */
+
+/* The counters LIVE in a shm mirror so an attaching monitor
+ * (bin/mpistat) reads every co-located rank's slots without touching
+ * the job: the flags segment grows a per-rank counter tail —
+ *   [n_local sleep bytes][pad to MV2T_LEASE_ALIGN]
+ *   [n_local u64 lease stamps][n_local x MV2T_FPC_SLOTS u64 counters]
+ * cp_create points CPlane.fpctr at this rank's row when the file is
+ * big enough (older/shorter files keep a private heap block — counters
+ * still work, they just aren't externally visible). */
 
 #endif /* MV2T_SHM_LAYOUT_H */

@@ -1,0 +1,24 @@
+/* Seeded traceguard-native fixture: raw nt_emit calls + a gateless
+ * MV2T_NTRACE macro. Exact finding count/locations asserted by
+ * tests/test_lint.py. */
+
+/* line 7: gateless macro definition — no nt_mine check, not the
+ * ((void)0) stub */
+#define MV2T_NTRACE(p, ev, a1, a2) nt_emit((p), (ev), (a1), (a2))
+
+void nt_emit(void* p, int ev, long a1, long a2);
+
+static void hot_send(void* p, int dst, long nb) {
+  nt_emit(p, 9, dst, nb);              /* line 12: raw call */
+}
+
+static void parked_wait(void* p) {
+  if (p)
+    nt_emit(p, 6, 0, 0);               /* line 17: a guard inline does
+                                        * not substitute for the macro */
+}
+
+static void vetted(void* p) {
+  nt_emit(p, 4, 0, 0);  /* mv2tlint: ignore[traceguard] teardown-only */
+  MV2T_NTRACE(p, 5, 1, 2);             /* macro use is always fine */
+}

@@ -1,0 +1,66 @@
+"""Mixed-ABI tracing workload (ISSUE 10): rank dispatcher.
+
+Launched as the program of an ``-np 4`` job. EVEN ranks exec the
+compiled C binary (argv[1] — tests/progs/ntrace_cabi_test.c built with
+bin/mpicc), becoming genuine C-ABI processes whose MPI calls never
+cross the interpreter; ODD ranks run the IDENTICAL workload through the
+python API. Under bin/mpitrace every rank — both ABIs — dumps ONE
+trace file at Finalize, and the merge must show the native C-plane
+events (flat waves, doorbells, eager hops) time-aligned with the
+python ranks' mpi-layer spans.
+
+    bin/mpitrace -np 4 --out m.json python tests/progs/mixed_trace_prog.py <cbin>
+"""
+
+import os
+import sys
+
+rank = int(os.environ.get("MV2T_RANK", "0"))
+cbin = sys.argv[1]
+
+if rank % 2 == 0:
+    # become a real C-ABI process (env — MV2T_*, MV2T_TRACE* — rides
+    # along; the exec'd binary bootstraps through libmpi.so)
+    os.execv(cbin, [cbin])
+
+# -- python half: the same sequence as ntrace_cabi_test.c ---------------
+sys.path.insert(0, ".")
+import numpy as np  # noqa: E402
+
+from mvapich2_tpu import mpi  # noqa: E402
+
+N, PP, REPS = 16, 64, 3
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+me, np_ = comm.rank, comm.size
+errs = 0
+
+comm.barrier()
+
+for rep in range(REPS):
+    sb = np.full(N, 1 + rep, np.int32)
+    rb = comm.allreduce(sb)
+    if not (rb == np_ * (1 + rep)).all():
+        errs += 1
+
+if (me ^ 1) < np_:
+    peer = me ^ 1
+    pb = (me * 1000 + np.arange(PP)).astype(np.int32)
+    qb = np.zeros(PP, np.int32)
+    if me % 2 == 0:
+        comm.send(pb, dest=peer, tag=7)
+        comm.recv(qb, source=peer, tag=7)
+    else:
+        comm.recv(qb, source=peer, tag=7)
+        comm.send(pb, dest=peer, tag=7)
+    if not (qb == peer * 1000 + np.arange(PP)).all():
+        errs += 1
+
+comm.barrier()
+
+total = comm.allreduce(np.array([errs], np.int32))
+if me == 0 and int(total[0]) == 0:
+    print("No Errors")
+mpi.Finalize()
+sys.exit(1 if errs else 0)

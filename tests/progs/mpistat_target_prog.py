@@ -1,0 +1,44 @@
+"""mpistat live-attach target: a 2-rank job that prints its shm segment
+stem (rank 0, "SEG <path>") and then runs small allreduces for a few
+seconds so an external bin/mpistat has live state to attach to. The
+duration is MV2T_TEST_STAT_SECONDS (default 6). Prints "No Errors" on
+clean completion — the attach must not have perturbed the job.
+
+Launched via: python -m mvapich2_tpu.run -np 2 tests/progs/mpistat_target_prog.py
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from mvapich2_tpu import mpi  # noqa: E402
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+rank = comm.rank
+
+sch = comm.u.shm_channel
+if rank == 0:
+    print(f"SEG {sch.path if sch is not None else '-'}", flush=True)
+
+# fixed iteration count, NOT a wall-clock deadline: collectives must
+# be issued the same number of times on every rank, and a per-rank
+# deadline would let one rank reach the barrier while its peer issues
+# one more allreduce
+iters = int(float(os.environ.get("MV2T_TEST_STAT_SECONDS", "6")) / 0.01)
+n = 0
+buf = np.ones(16, np.float64)
+for _ in range(iters):
+    out = comm.allreduce(buf)
+    assert out[0] == comm.size
+    n += 1
+    time.sleep(0.005)
+
+comm.barrier()
+if rank == 0:
+    print(f"iterations {n}")
+    print("No Errors")
+mpi.Finalize()

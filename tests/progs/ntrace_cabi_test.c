@@ -1,0 +1,66 @@
+/* ntrace_cabi_test.c — the C half of the mixed-ABI tracing workload
+ * (ISSUE 10). A small, deterministic sequence of flat-tier collectives
+ * and eager pt2pt through the unmodified C ABI, run under MV2T_TRACE
+ * (+ the native ring) by tests/test_trace.py: the C ranks' MPI calls
+ * never cross the interpreter, so their Perfetto lanes carry ONLY the
+ * native C-plane events — proving the ring, not the python recorder,
+ * is what made the fast path visible. tests/progs/mixed_trace_prog.py
+ * runs the IDENTICAL sequence on the python ranks of the same job.
+ * Prints "No Errors" from rank 0 on success. */
+#include <mpi.h>
+#include <stdio.h>
+#include <string.h>
+
+#define N 16
+#define PP 64
+#define REPS 3
+
+int main(int argc, char **argv) {
+    int rank, np, errs = 0;
+    MPI_Init(&argc, &argv);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &np);
+
+    MPI_Barrier(MPI_COMM_WORLD);
+
+    /* flat-tier allreduces (<=4 KiB, np<=8): fan-in/fold/fan-out */
+    int sb[N], rb[N];
+    for (int rep = 0; rep < REPS; rep++) {
+        for (int i = 0; i < N; i++)
+            sb[i] = 1 + rep;
+        memset(rb, -1, sizeof(rb));
+        MPI_Allreduce(sb, rb, N, MPI_INT, MPI_SUM, MPI_COMM_WORLD);
+        for (int i = 0; i < N; i++)
+            if (rb[i] != np * (1 + rep))
+                errs++;
+    }
+
+    /* eager ping-pong with the partner rank (rank ^ 1) */
+    if ((rank ^ 1) < np) {
+        int peer = rank ^ 1;
+        int pb[PP], qb[PP];
+        for (int i = 0; i < PP; i++)
+            pb[i] = rank * 1000 + i;
+        if (rank % 2 == 0) {
+            MPI_Send(pb, PP, MPI_INT, peer, 7, MPI_COMM_WORLD);
+            MPI_Recv(qb, PP, MPI_INT, peer, 7, MPI_COMM_WORLD,
+                     MPI_STATUS_IGNORE);
+        } else {
+            MPI_Recv(qb, PP, MPI_INT, peer, 7, MPI_COMM_WORLD,
+                     MPI_STATUS_IGNORE);
+            MPI_Send(pb, PP, MPI_INT, peer, 7, MPI_COMM_WORLD);
+        }
+        for (int i = 0; i < PP; i++)
+            if (qb[i] != peer * 1000 + i)
+                errs++;
+    }
+
+    MPI_Barrier(MPI_COMM_WORLD);
+
+    int total = 0;
+    MPI_Allreduce(&errs, &total, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD);
+    if (rank == 0 && total == 0)
+        printf("No Errors\n");
+    MPI_Finalize();
+    return total ? 1 : 0;
+}

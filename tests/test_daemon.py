@@ -33,7 +33,9 @@ def ddir(monkeypatch):
 def test_claim_creates_and_epochs(ddir):
     c = daemon.claim(2, 1 << 20, 1 << 20, ddir)
     assert c is not None and c.epoch == 1
-    for p, want in ((c.ring, 4 << 20), (c.flags, 24),
+    # flags = pad8(2) + 2 lease stamps + 2 x 16 fpc-mirror slots
+    # (runtime/boot.py flags_len — the ISSUE 10 counter tail)
+    for p, want in ((c.ring, 4 << 20), (c.flags, 8 + 16 + 256),
                     (c.flat, 0), (c.arena, 4096 + 2 * (1 << 20))):
         assert os.path.getsize(p) == want, p
     # busy set with a live owner is not claimable

@@ -278,3 +278,66 @@ def test_hbm_streaming_tier_end_to_end():
     finally:
         _reload(MV2T_ICI_INTERPRET=None, MV2T_DEV_TIER_VMEM_MAX=None,
                 MV2T_ICI_CHUNK_BYTES=None, MV2T_DEVICE_COLL_MIN_BYTES=None)
+
+
+# -- device-lane observability (ISSUE 10) --------------------------------
+
+def test_device_dispatch_spans_and_effbw_watermark(monkeypatch):
+    """A traced device collective drops a B/E span in the 'device' lane
+    carrying tier/op/bytes + duration, and bumps the per-tier
+    dev_effbw_* high watermark."""
+    from mvapich2_tpu import mpit
+    monkeypatch.setenv("MV2T_TRACE", "1")
+    _reload(MV2T_DEVICE_COLL_MIN_BYTES="1")
+    tiers = ("vmem", "hbm", "xla", "slot")
+    # watermarks are process-global and never decrease: reset them so
+    # an earlier device test in the same process can't mask this mark
+    for t in tiers:
+        mpit.pvar(f"dev_effbw_{t}").reset()
+    spans = []
+
+    def app(comm):
+        out = comm.allreduce(np.ones(BIG, np.float32))
+        assert out[0] == comm.size
+        rec = comm.u.engine.tracer
+        assert rec is not None
+        spans.extend([e for e in rec.events
+                      if e[1] == "device" and e[2] == "dev_allreduce"])
+
+    run_ranks(N_RANKS, app, device_mesh=True)
+    bs = [e for e in spans if e[3] == "B"]
+    es = [e for e in spans if e[3] == "E"]
+    assert bs and es
+    args = bs[0][4]
+    assert args["tier"] in tiers
+    assert args["op"] == "sum" and args["bytes"] > 0
+    assert "us" in es[0][4]
+    after = {t: mpit.pvar(f"dev_effbw_{t}").read() for t in tiers}
+    assert any(v > 0 for v in after.values()), after
+    # watermark semantics: instantaneous, never decreasing
+    hot = max(tiers, key=lambda t: after[t])
+    assert mpit.pvar(f"dev_effbw_{hot}").klass \
+        == mpit.PVAR_CLASS_HIGHWATERMARK
+
+
+def test_jax_profile_hook_brackets_device_region(monkeypatch, tmp_path):
+    """MV2T_JAX_PROFILE=<dir>: the first device collective starts a
+    jax.profiler trace there (stopped at exit); the directory gains
+    profile artifacts."""
+    import mvapich2_tpu.coll.device as devmod
+    monkeypatch.setattr(devmod, "_jax_profile_started", False)
+    prof_dir = str(tmp_path / "xprof")
+    _reload(MV2T_JAX_PROFILE=prof_dir, MV2T_DEVICE_COLL_MIN_BYTES="1")
+    try:
+        def app(comm):
+            comm.allreduce(np.ones(BIG, np.float32))
+
+        run_ranks(N_RANKS, app, device_mesh=True)
+        assert devmod._jax_profile_started
+        devmod._stop_jax_profile()
+        files = [os.path.join(dp, f)
+                 for dp, _dn, fn in os.walk(prof_dir) for f in fn]
+        assert files, "jax.profiler produced no artifacts"
+    finally:
+        _reload(MV2T_JAX_PROFILE=None)
+        monkeypatch.setattr(devmod, "_jax_profile_started", True)

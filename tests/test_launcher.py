@@ -103,3 +103,40 @@ def test_abort_kills_job():
         assert "MPI_Abort(7)" in r.stderr, \
             f"abort banner missing ({ft_args}): {r.stderr[-300:]}"
         assert dt < 30, f"abort teardown too slow ({dt:.1f}s, {ft_args})"
+
+
+def test_mpispawn_batched_failure_publication():
+    """ISSUE 10 satellite (ROADMAP 3b): the mpispawn agent publishes a
+    batch of rank deaths in TWO round trips (one atomic range claim +
+    one mput), not two serial RTTs per event — and the claimed event
+    slots stay dense and gap-free for the sequential watcher."""
+    from mvapich2_tpu.runtime.mpispawn import publish_failures
+
+    class FakeKVS:
+        def __init__(self):
+            self.rpcs = []
+            self.data = {}
+            self.seq = 0
+
+        def add(self, key, delta=1):
+            self.rpcs.append(("add", key, delta))
+            self.seq += delta
+            return self.seq
+
+        def put_many(self, kv):
+            self.rpcs.append(("mput", dict(kv)))
+            self.data.update(kv)
+
+        def put(self, key, val):   # must NOT be used by the batch path
+            self.rpcs.append(("put", key))
+            self.data[key] = val
+
+    kvs = FakeKVS()
+    publish_failures(kvs, [])
+    assert kvs.rpcs == []          # no deaths, no traffic
+    publish_failures(kvs, [3, 1, 7])
+    assert [r[0] for r in kvs.rpcs] == ["add", "mput"]
+    assert kvs.data == {"__failure_ev_0": "3", "__failure_ev_1": "1",
+                        "__failure_ev_2": "7"}
+    publish_failures(kvs, [5])     # next batch continues the sequence
+    assert kvs.data["__failure_ev_3"] == "5"

