@@ -1,0 +1,58 @@
+/* osu_bcast.c — MPI_Bcast average latency, OSU measurement protocol.
+ * Fallback source for bin/bench_osu when the reference osu_benchmarks
+ * tree is absent; the loop matches
+ * osu_benchmarks/mpi/collective/osu_bcast.c (root 0, avg over ranks). */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static int iters_for(long size) { return size > 8192 ? 100 : 1000; }
+static int skip_for(long size) { return size > 8192 ? 10 : 50; }
+
+int main(int argc, char **argv) {
+    long max_size = 1 << 20;
+    int full = 0;
+    for (int i = 1; i < argc; i++) {
+        if (strcmp(argv[i], "-m") == 0 && i + 1 < argc)
+            max_size = atol(argv[++i]);
+        else if (strcmp(argv[i], "-f") == 0)
+            full = 1;
+    }
+    MPI_Init(&argc, &argv);
+    int rank, np;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &np);
+    char *buf = calloc(1, max_size ? max_size : 1);
+    if (rank == 0)
+        printf("# OSU MPI Broadcast Latency Test\n"
+               "# Size       Avg Latency(us)\n");
+    for (long size = 1; size <= max_size; size *= 2) {
+        int iters = iters_for(size), skip = skip_for(size);
+        MPI_Barrier(MPI_COMM_WORLD);
+        double t_total = 0.0;
+        for (int i = 0; i < iters + skip; i++) {
+            double t0 = MPI_Wtime();
+            MPI_Bcast(buf, (int)size, MPI_CHAR, 0, MPI_COMM_WORLD);
+            double dt = MPI_Wtime() - t0;
+            if (i >= skip)
+                t_total += dt;
+        }
+        double lat = t_total * 1e6 / iters;
+        double avg = 0.0, mn = 0.0, mx = 0.0;
+        MPI_Reduce(&lat, &avg, 1, MPI_DOUBLE, MPI_SUM, 0, MPI_COMM_WORLD);
+        MPI_Reduce(&lat, &mn, 1, MPI_DOUBLE, MPI_MIN, 0, MPI_COMM_WORLD);
+        MPI_Reduce(&lat, &mx, 1, MPI_DOUBLE, MPI_MAX, 0, MPI_COMM_WORLD);
+        if (rank == 0) {
+            avg /= np;
+            if (full)
+                printf("%-10ld%18.2f%18.2f%18.2f\n", size, avg, mn, mx);
+            else
+                printf("%-10ld%18.2f\n", size, avg);
+            fflush(stdout);
+        }
+    }
+    free(buf);
+    MPI_Finalize();
+    return 0;
+}

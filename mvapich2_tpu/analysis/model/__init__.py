@@ -22,7 +22,7 @@ deadline, ...); tests/test_modelcheck.py asserts the checker catches
 each one and that the unmutated models are violation-free.
 """
 
-from . import doorbell, lease, seqlock  # noqa: F401
+from . import doorbell, flat2, lease, seqlock  # noqa: F401
 from .explorer import Model, Result, Transition, Violation, explore  # noqa: F401
 
 
@@ -59,4 +59,23 @@ def mutation_matrix():
         ("lease", lambda: lease.build(crash=True,
                                       mutation="inverted_compare"),
          "inverted_compare"),
+        # hierarchical flat tier + multicast bcast (cp_flat2_*)
+        ("flat2-hier", lambda: flat2.build_hier_allreduce(
+            groups=2, k=2, mutation="xchg_no_guard"),
+         "xchg_no_guard"),
+        ("flat2-hier", lambda: flat2.build_hier_allreduce(
+            groups=2, k=2, mutation="fanout_before_xchg"),
+         "fanout_before_xchg"),
+        ("flat2-hier", lambda: flat2.build_hier_allreduce(
+            groups=2, k=2, crash=True, mutation="no_poison"),
+         "no_poison"),
+        ("flat2-mcast", lambda: flat2.build_mcast(
+            n=3, waves=2, nbuf=1, mutation="publish_before_write"),
+         "publish_before_write"),
+        ("flat2-mcast", lambda: flat2.build_mcast(
+            n=3, waves=2, nbuf=1, mutation="no_overwrite_guard"),
+         "no_overwrite_guard"),
+        ("flat2-mcast", lambda: flat2.build_mcast(
+            n=3, waves=1, nbuf=1, mutation="no_first_sync"),
+         "no_first_sync"),
     ]

@@ -1,0 +1,520 @@
+"""Hierarchical flat-tier + multicast-bcast models (cplane.cpp cp_flat2_*).
+
+Two protocols, reduced to their seqlock skeletons exactly like
+``seqlock.py`` (TORN-split payload writes; frozenset contribution
+payloads; a sticky poison word):
+
+``build_hier_allreduce``
+    The leaders-of-k two-level wave: members fold intra-group into
+    their group leader, leaders exchange partials in a leaders-only
+    block folded by the ROOT leader (comm rank 0), seq-stamped fan-out
+    back through the group blocks. Invariants: no torn read delivered,
+    every rank delivers the FULL contribution set (agreement), poison
+    sticky across region re-key after a crash.
+
+    Mutations:
+      xchg_no_guard       the root leader folds the leaders' exchange
+                          slots WITHOUT waiting for their in-stamps —
+                          it folds a torn or stale partial
+      fanout_before_xchg  a group leader publishes its group block
+                          BEFORE reading the leader exchange's total —
+                          its members deliver the group partial
+      no_poison           an aborted wave (member crash) skips the
+                          sticky poison stamp — region re-key/reuse
+                          folds the dead wave's torn slot
+
+``build_mcast``
+    The pipelined single-writer multicast bcast: the root writes each
+    wave's payload ONCE into ring buffer ``wave % nbuf`` and
+    release-stamps the region wave counter mseq; readers consume under
+    the seqlock discipline and ack with out-stamps. The root may run
+    ``nbuf`` waves ahead; buffer overwrite is guarded on every
+    reader's out >= wave - nbuf. The comm's FIRST wave synchronizes
+    (root waits for every arrival) so a LATE member's lazy numbering-
+    base read can never count an in-flight wave.
+
+    Mutations:
+      publish_before_write  the root stamps mseq BEFORE the payload
+                            copy — a reader consumes the torn buffer
+      no_overwrite_guard    the root skips the out-stamp guard — wave
+                            s+nbuf tears the buffer under a slow
+                            wave-s reader (needs waves > nbuf)
+      no_first_sync         the root skips the first-wave arrival
+                            wave — the late member's base counts the
+                            in-flight wave and it waits on a seq
+                            nobody will ever stamp (deadlock), the
+                            flat2 analog of the PR 5 bcast desync
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .explorer import Model, Transition
+from .seqlock import TORN
+
+
+def _full(n: int, wave: int) -> frozenset:
+    return frozenset((r, wave) for r in range(n))
+
+
+def build_hier_allreduce(groups: int = 2, k: int = 2,
+                         crash: bool = False,
+                         mutation: Optional[str] = None) -> Model:
+    """``groups`` groups of ``k`` ranks run ONE hierarchical allreduce
+    wave (rank g*k is group g's leader; rank 0 the root leader).
+    ``crash=True`` adds a mid-copy death of the last member plus the
+    abort/poison/reuse machinery from the flat model."""
+    assert groups >= 2 and k >= 2
+    n = groups * k
+    ts = []
+    init = {"poison": 0, "mseq": 0, "lbseq": 0, "lbpay": frozenset(),
+            "aborted": 0, "reuse_res": None}
+    for g in range(groups):
+        init[f"gbseq{g}"] = 0
+        init[f"gbpay{g}"] = frozenset()
+        init[f"lin{g}"] = 0
+        init[f"lout{g}"] = 0
+        init[f"lpay{g}"] = frozenset()
+        init[f"acc{g}"] = None         # leader's private fold
+    for r in range(n):
+        init[f"in{r}"] = 0
+        init[f"out{r}"] = 0
+        init[f"pay{r}"] = frozenset()
+        init[f"pc{r}"] = 0
+        init[f"res{r}"] = None
+        init[f"alive{r}"] = 1
+
+    def running(s, r):
+        return s[f"alive{r}"] and not s["aborted"]
+
+    # ---- group members (slot j > 0 of each group) -------------------
+    for g in range(groups):
+        for j in range(1, k):
+            r = g * k + j
+
+            def mk(g, r):
+                def g_begin(s):
+                    return running(s, r) and s[f"pc{r}"] == 0
+
+                def a_begin(s):
+                    s[f"pay{r}"] = TORN
+                    s[f"pc{r}"] = 1
+                    return s
+
+                def a_copy(s):
+                    s[f"pay{r}"] = frozenset({(r, 1)})
+                    s[f"pc{r}"] = 2
+                    return s
+
+                def a_stamp(s):
+                    s[f"in{r}"] = 1              # release stamp
+                    s[f"pc{r}"] = 3
+                    return s
+
+                def g_read(s):
+                    return running(s, r) and s[f"pc{r}"] == 3 \
+                        and s[f"gbseq{g}"] >= 1
+
+                def a_read(s):
+                    s[f"res{r}"] = s[f"gbpay{g}"]
+                    s[f"out{r}"] = 1
+                    s[f"pc{r}"] = 4
+                    return s
+
+                return [
+                    Transition(f"m{r}.begin_copy", f"r{r}", g_begin,
+                               a_begin,
+                               frozenset({f"pc{r}", f"alive{r}",
+                                          "aborted"}),
+                               frozenset({f"pay{r}", f"pc{r}"})),
+                    Transition(f"m{r}.end_copy", f"r{r}",
+                               lambda s, r=r: running(s, r)
+                               and s[f"pc{r}"] == 1, a_copy,
+                               frozenset({f"pc{r}"}),
+                               frozenset({f"pay{r}", f"pc{r}"})),
+                    Transition(f"m{r}.stamp_in", f"r{r}",
+                               lambda s, r=r: running(s, r)
+                               and s[f"pc{r}"] == 2, a_stamp,
+                               frozenset({f"pc{r}"}),
+                               frozenset({f"in{r}", f"pc{r}"})),
+                    Transition(f"m{r}.read_gbcb", f"r{r}", g_read, a_read,
+                               frozenset({f"pc{r}", f"gbseq{g}",
+                                          f"gbpay{g}"}),
+                               frozenset({f"res{r}", f"out{r}",
+                                          f"pc{r}"})),
+                ]
+            ts.extend(mk(g, r))
+
+    # ---- group leaders ----------------------------------------------
+    # pc: 0 fold-group -> (non-root: 1 publish lslot, 2 wait lbcb)
+    #     (root: 1 fold leaders, 2 publish lbcb+mseq)
+    #     -> 3 fan-out -> 4 done
+    for g in range(groups):
+        r = g * k
+
+        def mkl(g, r):
+            member_ins = [f"in{g * k + j}" for j in range(1, k)]
+            member_pays = [f"pay{g * k + j}" for j in range(1, k)]
+
+            def g_fold(s):
+                if not (running(s, r) and s[f"pc{r}"] == 0):
+                    return False
+                return all(s[m] >= 1 for m in member_ins)
+
+            def a_fold(s):
+                acc = frozenset({(r, 1)})
+                torn = False
+                for m in member_pays:
+                    if s[m] == TORN:
+                        torn = True
+                    else:
+                        acc |= s[m]
+                s[f"acc{g}"] = TORN if torn else acc
+                s[f"pc{r}"] = 1
+                return s
+
+            steps = [Transition(f"L{g}.fold_group", f"r{r}", g_fold,
+                                a_fold,
+                                frozenset({f"pc{r}", f"alive{r}",
+                                           "aborted"}
+                                          | set(member_ins)
+                                          | set(member_pays)),
+                                frozenset({f"acc{g}", f"pc{r}"}))]
+            if g != 0:
+                def a_pub(s):
+                    s[f"lpay{g}"] = s[f"acc{g}"]
+                    s[f"lin{g}"] = 1             # release stamp
+                    s[f"pc{r}"] = 2
+                    return s
+
+                def g_readl(s):
+                    return running(s, r) and s[f"pc{r}"] == 2 \
+                        and s["lbseq"] >= 1
+
+                def a_readl(s):
+                    s[f"acc{g}"] = s["lbpay"]
+                    s[f"lout{g}"] = 1
+                    s[f"pc{r}"] = 3
+                    return s
+
+                steps += [
+                    Transition(f"L{g}.publish_lslot", f"r{r}",
+                               lambda s, r=r: running(s, r)
+                               and s[f"pc{r}"] == 1, a_pub,
+                               frozenset({f"pc{r}", f"acc{g}"}),
+                               frozenset({f"lpay{g}", f"lin{g}",
+                                          f"pc{r}"})),
+                    Transition(f"L{g}.read_lbcb", f"r{r}", g_readl,
+                               a_readl,
+                               frozenset({f"pc{r}", "lbseq", "lbpay"}),
+                               frozenset({f"acc{g}", f"lout{g}",
+                                          f"pc{r}"})),
+                ]
+            else:
+                other_lins = [f"lin{j}" for j in range(1, groups)]
+                other_lpays = [f"lpay{j}" for j in range(1, groups)]
+
+                def g_xchg(s):
+                    if not (running(s, r) and s[f"pc{r}"] == 1):
+                        return False
+                    if mutation == "xchg_no_guard":
+                        return True              # MUTANT: no in-wait
+                    return all(s[x] >= 1 for x in other_lins)
+
+                def a_xchg(s):
+                    acc = s[f"acc{g}"]
+                    torn = acc == TORN
+                    for x in other_lpays:
+                        if s[x] == TORN or acc == TORN:
+                            torn = True
+                        elif not s[x]:
+                            # stale (never-published) slot folds as a
+                            # MISSING contribution, not a torn one
+                            pass
+                        else:
+                            acc |= s[x]
+                    s[f"acc{g}"] = TORN if torn else acc
+                    s[f"pc{r}"] = 2
+                    return s
+
+                def a_lpub(s):
+                    s["lbpay"] = s[f"acc{g}"]
+                    s["lbseq"] = 1               # release stamp
+                    s["mseq"] = 1                # region wave counter
+                    s[f"lin{g}"] = 1
+                    s[f"lout{g}"] = 1
+                    s[f"pc{r}"] = 3
+                    return s
+
+                steps += [
+                    Transition("L0.fold_leaders", f"r{r}", g_xchg,
+                               a_xchg,
+                               frozenset({f"pc{r}", f"acc{g}"}
+                                         | set(other_lins)
+                                         | set(other_lpays)),
+                               frozenset({f"acc{g}", f"pc{r}"})),
+                    Transition("L0.publish_lbcb", f"r{r}",
+                               lambda s, r=r: running(s, r)
+                               and s[f"pc{r}"] == 2, a_lpub,
+                               frozenset({f"pc{r}", f"acc{g}"}),
+                               frozenset({"lbpay", "lbseq", "mseq",
+                                          f"lin{g}", f"lout{g}",
+                                          f"pc{r}"})),
+                ]
+
+            def g_fanout(s):
+                if not running(s, r):
+                    return False
+                if mutation == "fanout_before_xchg" and g != 0:
+                    # MUTANT: the group leader publishes its group
+                    # block straight after the intra-group fold
+                    return s[f"pc{r}"] == 1
+                return s[f"pc{r}"] == 3
+
+            def a_fanout(s):
+                s[f"gbpay{g}"] = s[f"acc{g}"]
+                s[f"gbseq{g}"] = 1               # release stamp
+                s[f"res{r}"] = s[f"acc{g}"]
+                s[f"in{r}"] = 1
+                s[f"out{r}"] = 1
+                s[f"pc{r}"] = 4
+                return s
+
+            steps.append(
+                Transition(f"L{g}.fanout", f"r{r}", g_fanout, a_fanout,
+                           frozenset({f"pc{r}", f"acc{g}"}),
+                           frozenset({f"gbpay{g}", f"gbseq{g}",
+                                      f"res{r}", f"in{r}", f"out{r}",
+                                      f"pc{r}"})))
+            return steps
+        ts.extend(mkl(g, r))
+
+    # ---- crash / abort / poison / re-key probe ----------------------
+    if crash:
+        victim = n - 1                   # a member of the last group
+
+        def g_die(s):
+            return s[f"alive{victim}"] and s[f"pc{victim}"] == 1
+
+        def a_die(s):
+            s[f"alive{victim}"] = 0
+            return s
+
+        def g_abort(s):
+            return s["alive0"] and not s[f"alive{victim}"] \
+                and not s["aborted"]
+
+        def a_abort(s):
+            s["aborted"] = 1
+            if mutation != "no_poison":
+                s["poison"] = 1                  # MUTANT skips this
+            return s
+
+        def g_reuse(s):
+            # re-key probe: recovery (or ctx reuse) tries to key the
+            # region again — cp_flat2_base must refuse when poisoned
+            return s["aborted"] and s["reuse_res"] is None
+
+        def a_reuse(s):
+            if s["poison"]:
+                s["reuse_res"] = "refused"
+            else:
+                torn = any(s[f"pay{r}"] == TORN for r in range(n))
+                s["reuse_res"] = TORN if torn else "folded"
+            return s
+
+        ts.extend([
+            Transition("V.die", f"r{victim}", g_die, a_die,
+                       frozenset({f"pc{victim}", f"alive{victim}"}),
+                       frozenset({f"alive{victim}"})),
+            Transition("L0.abort_poison", "r0", g_abort, a_abort,
+                       frozenset({f"alive{victim}", "aborted"}),
+                       frozenset({"aborted", "poison"})),
+            Transition("rekey.probe", "rekey", g_reuse, a_reuse,
+                       frozenset({"aborted", "poison", "reuse_res"}
+                                 | {f"pay{r}" for r in range(n)}),
+                       frozenset({"reuse_res"})),
+        ])
+
+    # ---- invariants --------------------------------------------------
+    def inv_torn(s):
+        for r in range(n):
+            if s[f"res{r}"] == TORN:
+                return f"rank {r} delivered a TORN payload"
+        if s["reuse_res"] == TORN:
+            return "region re-key folded a torn slot of the dead wave"
+        return None
+
+    def inv_agree(s):
+        for r in range(n):
+            v = s[f"res{r}"]
+            if v is not None and v != TORN and v != _full(n, 1):
+                return (f"rank {r} delivered {sorted(v)} != the full "
+                        "contribution set")
+        return None
+
+    def inv_poison(s):
+        if s["aborted"] and not s["poison"]:
+            return "wave aborted but the region poison is not sticky"
+        return None
+
+    def final(s):
+        if s["aborted"]:
+            return s["reuse_res"] is not None if crash else True
+        return all(s[f"res{r}"] is not None for r in range(n))
+
+    invs = [("no-torn-read-delivered", inv_torn),
+            ("agreement", inv_agree)]
+    if crash:
+        invs.append(("poison-sticky", inv_poison))
+    return Model(f"flat2-hier-allreduce(g={groups},k={k},crash={crash},"
+                 f"mut={mutation})", init, ts, invs, final)
+
+
+def build_mcast(n: int = 3, waves: int = 2, nbuf: int = 1,
+                mutation: Optional[str] = None) -> Model:
+    """Root rank 0 runs ``waves`` pipelined multicast bcasts over a
+    ``nbuf``-deep buffer ring; rank n-1 is a LATE member whose
+    numbering base is read lazily. Wave w publishes ``{(0, w)}`` in
+    buffer w % nbuf."""
+    assert n >= 2 and waves >= 1 and nbuf >= 1
+    late = n - 1
+    init = {"mseq": 0, "rw": 1}              # rw = root's current wave
+    for b in range(nbuf):
+        init[f"mpay{b}"] = frozenset()
+    for r in range(1, n):
+        init[f"in{r}"] = 0
+        init[f"out{r}"] = 0
+        init[f"w{r}"] = 1
+        init[f"res{r}"] = ()
+        init[f"base{r}"] = 0 if r != late else None   # late: lazy read
+
+    ts = []
+
+    def g_base(s):
+        return s[f"base{late}"] is None
+
+    def a_base(s):
+        s[f"base{late}"] = s["mseq"]             # lazy numbering base
+        return s
+
+    ts.append(Transition(f"r{late}.read_base", f"r{late}", g_base,
+                         a_base, frozenset({"mseq", f"base{late}"}),
+                         frozenset({f"base{late}"})))
+
+    # readers: arrive (in-stamp), wait mseq, consume, ack (out-stamp)
+    for r in range(1, n):
+        def mk(r):
+            def wave_of(s):
+                return s[f"base{r}"] + s[f"w{r}"]
+
+            def g_arrive(s):
+                return s[f"base{r}"] is not None and s[f"w{r}"] <= waves \
+                    and s[f"in{r}"] < wave_of(s)
+
+            def a_arrive(s):
+                s[f"in{r}"] = wave_of(s)
+                return s
+
+            def g_read(s):
+                return s[f"base{r}"] is not None and s[f"w{r}"] <= waves \
+                    and s[f"in{r}"] == wave_of(s) \
+                    and s["mseq"] >= wave_of(s)
+
+            def a_read(s):
+                s[f"res{r}"] = s[f"res{r}"] \
+                    + (s[f"mpay{wave_of(s) % nbuf}"],)
+                s[f"out{r}"] = wave_of(s)
+                s[f"w{r}"] += 1
+                return s
+
+            return [
+                Transition(f"r{r}.arrive", f"r{r}", g_arrive, a_arrive,
+                           frozenset({f"base{r}", f"w{r}", f"in{r}"}),
+                           frozenset({f"in{r}"})),
+                Transition(f"r{r}.consume", f"r{r}", g_read, a_read,
+                           frozenset({f"base{r}", f"w{r}", f"in{r}",
+                                      "mseq"}
+                                     | {f"mpay{b}" for b in range(nbuf)}),
+                           frozenset({f"res{r}", f"out{r}", f"w{r}"})),
+            ]
+        ts.extend(mk(r))
+
+    # root: per wave — (first-wave sync) -> overwrite guard -> torn
+    # write -> value write -> publish stamp. pc encoded in "rpc".
+    init["rpc"] = 0
+
+    def g_guard(s):
+        if s["rpc"] != 0 or s["rw"] > waves:
+            return False
+        w = s["rw"]
+        if w == 1 and mutation != "no_first_sync":
+            if not all(s[f"in{r}"] >= 1 for r in range(1, n)):
+                return False
+        if mutation != "no_overwrite_guard" and w > nbuf:
+            if not all(s[f"out{r}"] >= w - nbuf for r in range(1, n)):
+                return False
+        return True
+
+    def a_guard(s):
+        s["rpc"] = 1
+        return s
+
+    def a_begin(s):
+        s[f"mpay{s['rw'] % nbuf}"] = TORN
+        s["rpc"] = 2
+        if mutation == "publish_before_write":
+            s["mseq"] = s["rw"]                  # MUTANT: stamp early
+        return s
+
+    def a_write(s):
+        s[f"mpay{s['rw'] % nbuf}"] = frozenset({(0, s["rw"])})
+        s["rpc"] = 3
+        return s
+
+    def a_publish(s):
+        s["mseq"] = s["rw"]                      # release publish
+        s["rw"] += 1
+        s["rpc"] = 0
+        return s
+
+    ts.extend([
+        Transition("root.guard", "r0", g_guard, a_guard,
+                   frozenset({"rpc", "rw"}
+                             | {f"in{r}" for r in range(1, n)}
+                             | {f"out{r}" for r in range(1, n)}),
+                   frozenset({"rpc"})),
+        Transition("root.begin_write", "r0",
+                   lambda s: s["rpc"] == 1, a_begin,
+                   frozenset({"rpc", "rw"}),
+                   frozenset({"rpc", "mseq"}
+                             | {f"mpay{b}" for b in range(nbuf)})),
+        Transition("root.end_write", "r0",
+                   lambda s: s["rpc"] == 2, a_write,
+                   frozenset({"rpc", "rw"}),
+                   frozenset({"rpc"}
+                             | {f"mpay{b}" for b in range(nbuf)})),
+        Transition("root.publish", "r0",
+                   lambda s: s["rpc"] == 3, a_publish,
+                   frozenset({"rpc", "rw"}),
+                   frozenset({"mseq", "rw", "rpc"})),
+    ])
+
+    def inv_data(s):
+        for r in range(1, n):
+            for i, v in enumerate(s[f"res{r}"], start=1):
+                if v == TORN:
+                    return f"rank {r} consumed a TORN mcast buffer"
+                if v != frozenset({(0, i)}):
+                    return (f"rank {r} wave {i} consumed {sorted(v)} != "
+                            "the root payload of that wave")
+        return None
+
+    def final(s):
+        return s["rw"] > waves \
+            and all(s[f"w{r}"] > waves for r in range(1, n))
+
+    return Model(f"flat2-mcast(n={n},waves={waves},nbuf={nbuf},"
+                 f"mut={mutation})", init, ts,
+                 [("mcast-data", inv_data)], final)

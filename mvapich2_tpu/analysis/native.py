@@ -606,6 +606,15 @@ class NativeSourcePass(LintPass):
             ("MV2T_NTR_HDR_BYTES", "trace_native._NTR_HDR_BYTES"),
             ("MV2T_NTR_EV_BYTES", "trace_native._NTR_EV_BYTES"),
             ("MV2T_NTR_RING_EVENTS", "trace_native._NTR_RING_EVENTS"),
+            # hierarchical flat2 geometry (bin/mpistat parses the
+            # .fcoll2 file offline from the trace/native.py mirrors)
+            ("MV2T_FLAT2_GROUP", "trace_native._FLAT2_GROUP"),
+            ("MV2T_FLAT2_NGROUPS", "trace_native._FLAT2_NGROUPS"),
+            ("MV2T_FLAT2_MAX", "trace_native._FLAT2_MAX"),
+            ("MV2T_FLAT2_MCAST_NBUF", "trace_native._FLAT2_MCAST_NBUF"),
+            ("MV2T_FLAT2_LANES", "trace_native._FLAT2_LANES"),
+            ("MV2T_FLAT2_SUB_STRIDE", "trace_native._FLAT2_SUB_STRIDE"),
+            ("MV2T_FLAT2_REG_STRIDE", "trace_native._FLAT2_REG_STRIDE"),
         ]
         for cname, pyname in pairs:
             if cname not in defines:
@@ -705,11 +714,41 @@ class NativeSourcePass(LintPass):
                 defines.get("MV2T_FLAT_NREG", 0)
                 * defines.get("MV2T_FLAT_LANES", 0)
                 * defines.get("MV2T_FLAT_REG_STRIDE", 0),
+            # hierarchical flat tier geometry (cp_flat2_*): the region
+            # is NGROUPS+1 flat-shaped sub-regions + the mcast ring
+            "MV2T_FLAT2_MAX_RANKS":
+                defines.get("MV2T_FLAT2_GROUP", 0)
+                * defines.get("MV2T_FLAT2_NGROUPS", 0),
+            "MV2T_FLAT2_SUB_STRIDE":
+                64 + (defines.get("MV2T_FLAT2_GROUP", 0) + 1)
+                * defines.get("MV2T_FLAT_SLOT_STRIDE", 0),
+            "MV2T_FLAT2_MCAST_STRIDE":
+                64 + defines.get("MV2T_FLAT2_MAX", 0),
+            "MV2T_FLAT2_REG_STRIDE":
+                defines.get("MV2T_FLAT2_REG_HDR", 0)
+                + (defines.get("MV2T_FLAT2_NGROUPS", 0) + 1)
+                * defines.get("MV2T_FLAT2_SUB_STRIDE", 0)
+                + defines.get("MV2T_FLAT2_MCAST_NBUF", 0)
+                * defines.get("MV2T_FLAT2_MCAST_STRIDE", 0),
+            "MV2T_FLAT2_NREG":
+                defines.get("MV2T_FLAT2_SMALL_CTXS", 0)
+                + defines.get("MV2T_FLAT2_MASK_CTXS", 0),
+            "MV2T_FLAT2_FILE_LEN":
+                defines.get("MV2T_FLAT2_NREG", 0)
+                * defines.get("MV2T_FLAT2_LANES", 0)
+                * defines.get("MV2T_FLAT2_REG_STRIDE", 0),
         }
         for name, want_v in derived.items():
             if name in defines and defines[name] != want_v:
                 bad(name, f"{name}={defines[name]} does not re-derive "
                           f"from its parts ({want_v})")
+        # the flat2 payload ceiling shares the flat slot layout: a
+        # payload larger than the slot stride's data area would tear
+        if defines.get("MV2T_FLAT2_MAX", 0) \
+                > defines.get("MV2T_FLAT_MAX", 0):
+            bad("MV2T_FLAT2_MAX",
+                "MV2T_FLAT2_MAX exceeds MV2T_FLAT_MAX — flat2 sub-region "
+                "slots reuse the flat slot stride and cannot hold it")
 
 
 # ---------------------------------------------------------------------------
@@ -887,7 +926,9 @@ def _python_layout() -> Dict[str, object]:
         with open(nt_path, encoding="utf-8") as f:
             nt_tree = ast.parse(f.read())
         for n in ("_NTR_FILE_HDR", "_NTR_HDR_BYTES", "_NTR_EV_BYTES",
-                  "_NTR_RING_EVENTS"):
+                  "_NTR_RING_EVENTS", "_FLAT2_GROUP", "_FLAT2_NGROUPS",
+                  "_FLAT2_MAX", "_FLAT2_MCAST_NBUF", "_FLAT2_LANES",
+                  "_FLAT2_SUB_STRIDE", "_FLAT2_REG_STRIDE"):
             v = _py_const(nt_tree, n)
             if v is not None:
                 out[f"trace_native.{n}"] = v

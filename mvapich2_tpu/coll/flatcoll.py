@@ -1,12 +1,23 @@
-"""Python entry to the flat-slot shared-memory collective tier.
+"""Python entry to the flat-slot shared-memory collective tiers.
 
-The small-message fast phase (cplane.cpp cp_flat_*): one cache-line-
-padded seqlock'd slot per comm rank in a per-(context, lane) region of
-the node's flat segment — fan-in to the leader (who reduces in place),
-seq-stamped broadcast out. Python ranks and C-ABI ranks (via
-native/mpi/fastpath.c) call the SAME cp_flat_* engine, so the schedule
-is identical across the two ABIs by construction; this module only
-implements the dispatch gate and per-comm call numbering.
+The small-message fast phase, two tiers sharing one dispatch surface:
+
+  * flat (cplane.cpp cp_flat_*, size <= 8): one cache-line-padded
+    seqlock'd slot per comm rank in a per-(context, lane) region of the
+    node's flat segment — fan-in to the leader (who reduces in place),
+    seq-stamped broadcast out.
+  * hierarchical flat2 (cp_flat2_*, 8 < size <= cp_flat2_max_ranks):
+    leaders-of-k two-level waves — members fold intra-group into their
+    group leader, leaders exchange partials in a leaders-only
+    sub-region, seq-stamped fan-out back through the group blocks —
+    plus a single-writer MULTICAST bcast (the root writes the payload
+    once into the region's mcast block; N readers consume under the
+    seqlock wave discipline).
+
+Python ranks and C-ABI ranks (via native/mpi/fastpath.c) call the SAME
+cp_flat_*/cp_flat2_* engines, so the schedule is identical across the
+two ABIs by construction; this module only implements the dispatch
+gate and per-comm call numbering.
 
 Dispatch DETERMINISM is the load-bearing property: every member of a
 comm — python-API or C-ABI — must reach the same flat-or-not verdict
@@ -76,13 +87,20 @@ def _dt_code(dtype: np.dtype) -> int:
 
 
 class _FlatComm:
-    """Per-comm flat-tier state (cached on the comm object)."""
+    """Per-comm flat-tier state (cached on the comm object).
+
+    ``tier`` is 1 for the flat-slot tier (size <= cp_flat_nslots) and 2
+    for the hierarchical leaders-of-k tier + multicast bcast
+    (cp_flat2_*, nslots < size <= cp_flat2_max_ranks). One comm is
+    served by exactly one tier — the split is on static comm size, so
+    every member (and the C-ABI dispatch, fpc_flat_next/fpc_flat2_next)
+    reaches the same verdict."""
 
     __slots__ = ("lib", "plane", "ctx", "lane", "rank", "size", "base",
-                 "k", "cabi", "max_nb")
+                 "k", "cabi", "max_nb", "tier")
 
     def __init__(self, lib, plane, ctx, lane, rank, size, base, cabi,
-                 max_nb):
+                 max_nb, tier=1):
         self.lib = lib
         self.plane = plane
         self.ctx = ctx
@@ -93,6 +111,7 @@ class _FlatComm:
         self.k = 0
         self.cabi = cabi        # C comm handle when libmpi owns numbering
         self.max_nb = max_nb
+        self.tier = tier
 
     def next_seq(self, nb: int) -> int:
         if self.cabi is not None:
@@ -130,9 +149,18 @@ def _build_state(comm, pch) -> Optional[_FlatComm]:
         # (every in-flight wave aborts on g_any_failed anyway); the
         # sched/python tiers own collectives until the process quiesces
         return None
-    if comm.size < 2 or comm.size > lib.cp_flat_nslots():
+    if comm.size < 2:
         return None
-    if not lib.cp_flat_ok(pch.plane):
+    tier = 1
+    if comm.size > lib.cp_flat_nslots():
+        # hierarchical leaders-of-k tier (cp_flat2_*) past the flat
+        # ceiling; the gates mirror fastpath.c's fpc_flat2_next
+        if comm.size > lib.cp_flat2_max_ranks():
+            return None
+        if not lib.cp_flat2_ok(pch.plane):
+            return None
+        tier = 2
+    elif not lib.cp_flat_ok(pch.plane):
         return None
     lane = None
     for r in range(comm.size):
@@ -140,17 +168,22 @@ def _build_state(comm, pch) -> Optional[_FlatComm]:
         if i is None:
             return None
         lane = i if lane is None or i < lane else lane
-    if lane >= lib.cp_flat_lanes():
+    lanes = lib.cp_flat2_lanes() if tier == 2 else lib.cp_flat_lanes()
+    if lane >= lanes:
         return None
-    base = int(lib.cp_flat_base(pch.plane, comm.ctx_coll, lane))
+    if tier == 2:
+        base = int(lib.cp_flat2_base(pch.plane, comm.ctx_coll, lane))
+        max_nb = int(lib.cp_flat2_payload_max())
+    else:
+        base = int(lib.cp_flat_base(pch.plane, comm.ctx_coll, lane))
+        max_nb = int(lib.cp_flat_payload_max())
     if base < 0:
         return None
     cabi = getattr(comm, "_cabi_handle", None)
     if cabi is not None and not _libmpi_hooks():
         cabi = None
     return _FlatComm(lib, pch.plane, comm.ctx_coll, lane, comm.rank,
-                     comm.size, base, cabi,
-                     int(lib.cp_flat_payload_max()))
+                     comm.size, base, cabi, max_nb, tier)
 
 
 def _raise_rc(st, comm, rc) -> bool:
@@ -224,7 +257,9 @@ def try_allreduce(pch, comm, arr: np.ndarray, op) -> Optional[np.ndarray]:
         comm._flat_state = False    # C side closed the tier: stay off
         return None
     out = np.empty_like(arr)
-    rc = st.lib.cp_flat_allreduce(
+    fn = st.lib.cp_flat2_allreduce if st.tier == 2 \
+        else st.lib.cp_flat_allreduce
+    rc = fn(
         st.plane, st.ctx, st.lane, st.rank, st.size,
         ctypes.c_longlong(seq), opc, dtc, _ptr(arr), _ptr(out),
         arr.size, arr.itemsize)
@@ -251,7 +286,8 @@ def try_reduce(pch, comm, arr: np.ndarray, op,
         comm._flat_state = False
         return False, None
     out = np.empty_like(arr) if comm.rank == root else None
-    rc = st.lib.cp_flat_reduce(
+    fn = st.lib.cp_flat2_reduce if st.tier == 2 else st.lib.cp_flat_reduce
+    rc = fn(
         st.plane, st.ctx, st.lane, st.rank, st.size,
         ctypes.c_longlong(seq), opc, dtc, root, _ptr(arr),
         _ptr(out) if out is not None else 0, arr.size, arr.itemsize)
@@ -271,9 +307,18 @@ def try_bcast(pch, comm, data: np.ndarray, root: int) -> bool:
     if seq <= 0:
         comm._flat_state = False
         return False
-    rc = st.lib.cp_flat_bcast(
-        st.plane, st.ctx, st.lane, st.rank, st.size,
-        ctypes.c_longlong(seq), root, _ptr(data), data.nbytes)
+    if st.tier == 2:
+        # sync=1 on the comm's first flat2 wave (seq == base + 1): the
+        # mcast root runs a full arrival wave so no member's lazy base
+        # read can count an in-flight wave; later waves pipeline
+        rc = st.lib.cp_flat2_bcast(
+            st.plane, st.ctx, st.lane, st.rank, st.size,
+            ctypes.c_longlong(seq), root, _ptr(data), data.nbytes,
+            1 if seq == st.base + 1 else 0)
+    else:
+        rc = st.lib.cp_flat_bcast(
+            st.plane, st.ctx, st.lane, st.rank, st.size,
+            ctypes.c_longlong(seq), root, _ptr(data), data.nbytes)
     if rc == -4:
         # root sent a different byte count — the wave completed, the
         # mismatch is reported (errors/coll/bcastlength.c), the tier
@@ -294,8 +339,10 @@ def try_barrier(pch, comm) -> bool:
     if seq <= 0:
         comm._flat_state = False
         return False
-    rc = st.lib.cp_flat_barrier(st.plane, st.ctx, st.lane, st.rank,
-                                st.size, ctypes.c_longlong(seq))
+    fn = st.lib.cp_flat2_barrier if st.tier == 2 \
+        else st.lib.cp_flat_barrier
+    rc = fn(st.plane, st.ctx, st.lane, st.rank,
+            st.size, ctypes.c_longlong(seq))
     if rc != 0:
         _raise_rc(st, comm, rc)
         return False        # collateral abort: sched tier retries

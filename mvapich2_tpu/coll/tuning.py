@@ -35,6 +35,16 @@ for _c in ("ALLREDUCE", "BCAST", "ALLGATHER", "ALLTOALL", "REDUCE",
 cvar("USE_TWO_LEVEL", True, bool, "coll",
      "Enable hierarchical (node-aware) collectives "
      "(analog of MV2_USE_SHMEM_COLL / two-level paths).")
+cvar("FLAT2", 1, int, "coll",
+     "Hierarchical flat tier + multicast bcast kill switch (cp_flat2_*; "
+     "0 disables the tier at segment attach). Read natively by "
+     "cp_flat2_attach, so it must be launcher-uniform (env), like "
+     "MV2T_FLAT2_GROUP.")
+cvar("FLAT2_GROUP", 8, int, "coll",
+     "Leaders-of-k group width of the hierarchical flat tier (clamped "
+     "to [2, 8]; the np ceiling is k x 8 groups). Read natively by "
+     "cp_flat2_group() from the env so BOTH ABIs derive one geometry — "
+     "set it uniformly at launch, never per-rank.")
 cvar("DEV_TIER_VMEM_MAX", 4 * 1024 * 1024, int, "device",
      "Device-collective tier edge: shards at or below this many bytes "
      "run the VMEM-resident flat ring kernels (ops/pallas_ring); above "
@@ -108,31 +118,46 @@ DEFAULT_TABLES: Dict[str, Dict[str, Table]] = {
     # the table's tier switches stay aligned with the protocol
     # thresholds the plane tier gates on — a drifting constant here is
     # exactly how the r5 64 KiB allreduce cliff happened
+    # "flat2" is the hierarchical-tier comm-size band (8 < np <= 64,
+    # the cp_flat2_* window): these rows are the SCHEDULED fallback for
+    # calls the flat2 tier does not carry (payload > MV2T_FLAT2_MAX,
+    # tier disabled, lane exhausted). Edges measured at np=16 on the
+    # r8 bench host (oversubscribed 1-core): rd's log-depth chain wins
+    # the sub-8 KiB band, the reduce-scatter shapes win the middle,
+    # the arena tier everything above the eager size.
     "allreduce": {
         "small": [(16 * 1024, "rd"), ("eager", "ring"),
+                  (None, "rsa_arena")],
+        "flat2": [(8 * 1024, "rd"), ("eager", "rsa"),
                   (None, "rsa_arena")],
         "large": [(8 * 1024, "rd"), ("eager", "rsa"),
                   (None, "rsa_arena")],
     },
     "bcast": {
         "small": [(64 * 1024, "binomial"), (None, "arena")],
+        "flat2": [(16 * 1024, "binomial"), (None, "arena")],
         "large": [(16 * 1024, "binomial"), (None, "arena")],
     },
     "allgather": {
         "small": [(32 * 1024, "bruck"), (None, "ring")],
+        "flat2": [(8 * 1024, "bruck"), (None, "ring")],
         "large": [(8 * 1024, "bruck"), (None, "ring")],
     },
     "alltoall": {
         "small": [(4 * 1024, "bruck"), (None, "scattered")],
+        "flat2": [(1024, "bruck"), (64 * 1024, "scattered"),
+                  (None, "pairwise")],
         "large": [(1024, "bruck"), (64 * 1024, "scattered"),
                   (None, "pairwise")],
     },
     "reduce": {
         "small": [(None, "binomial")],
+        "flat2": [(None, "binomial")],
         "large": [(None, "binomial")],
     },
     "barrier": {
         "small": [(None, "dissemination")],
+        "flat2": [(None, "dissemination")],
         "large": [(None, "dissemination")],
     },
 }
@@ -213,7 +238,12 @@ def device_tier(name: str, shard_nbytes: int) -> str:
 
 
 def _size_class(comm) -> str:
-    return "small" if comm.size <= 8 else "large"
+    """small (flat-tier window) / flat2 (hierarchical-tier window) /
+    large. The 8 and 64 edges mirror MV2T_FLAT_NSLOTS and
+    MV2T_FLAT2_MAX_RANKS — the np bands the two shm tiers serve."""
+    if comm.size <= 8:
+        return "small"
+    return "flat2" if comm.size <= 64 else "large"
 
 
 def _resolve_edge(bound):

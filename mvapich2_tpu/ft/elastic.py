@@ -72,9 +72,14 @@ def _rekey_flat(old: Comm, shrunk: Comm) -> None:
     if pch is None or not pch.plane or not st:
         return
     lib = pch._ring.lib
-    if not lib.cp_flat_poisoned(pch.plane, st.ctx, st.lane):
+    tier2 = getattr(st, "tier", 1) == 2
+    poisoned = lib.cp_flat2_poisoned if tier2 else lib.cp_flat_poisoned
+    poison = lib.cp_flat2_poison_region if tier2 \
+        else lib.cp_flat_poison_region
+    if not poisoned(pch.plane, st.ctx, st.lane):
         # belt-and-braces: revoke should have poisoned it already
-        lib.cp_flat_poison_region(pch.plane, st.ctx, st.lane)
-    log.info("rekey_flat: old (ctx=%d, lane=%d) poisoned; shrunken comm "
-             "ctx=%d re-derives its lane from surviving membership",
-             st.ctx, st.lane, shrunk.ctx_coll)
+        poison(pch.plane, st.ctx, st.lane)
+    log.info("rekey_flat: old tier-%d (ctx=%d, lane=%d) poisoned; "
+             "shrunken comm ctx=%d re-derives its lane from surviving "
+             "membership", 2 if tier2 else 1, st.ctx, st.lane,
+             shrunk.ctx_coll)

@@ -280,7 +280,14 @@ def _poison_flat(u, comm) -> None:
     pch = getattr(u, "plane_channel", None)
     try:
         if pch is not None and pch.plane:
-            pch._ring.lib.cp_flat_poison_region(pch.plane, st.ctx, st.lane)
+            # the hierarchical tier (flat2) keys its own segment; poison
+            # whichever region this comm's tier actually mapped
+            if getattr(st, "tier", 1) == 2:
+                pch._ring.lib.cp_flat2_poison_region(pch.plane, st.ctx,
+                                                     st.lane)
+            else:
+                pch._ring.lib.cp_flat_poison_region(pch.plane, st.ctx,
+                                                    st.lane)
         st.poison(comm)
     except Exception:
         comm._flat_state = False

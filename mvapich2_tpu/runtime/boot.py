@@ -229,6 +229,7 @@ def _make_raw_segments(boot: BootState, n_local: int) -> dict:
             boot.daemon_claim = claim
             card.update({"daemon": 1, "ring": claim.ring,
                          "flags": claim.flags, "flat": claim.flat,
+                         "flat2": claim.flat2,
                          "arena": claim.arena,
                          "part_bytes": claim.part_bytes,
                          "geokey": claim.geokey, "epoch": claim.epoch})
@@ -247,7 +248,8 @@ def _make_raw_segments(boot: BootState, n_local: int) -> dict:
     with open(fpath + ".tmp", "wb") as f:
         f.write(b"\0" * flags_len(n_local))
     os.replace(fpath + ".tmp", fpath)   # followers never see a short file
-    card.update({"ring": stem, "flags": fpath, "flat": stem + ".fcoll"})
+    card.update({"ring": stem, "flags": fpath, "flat": stem + ".fcoll",
+                 "flat2": stem + ".fcoll2"})
     return card
 
 
@@ -349,7 +351,7 @@ def close_light(boot: BootState) -> None:
         daemon.release(boot.daemon_claim)
         boot.daemon_claim = None
     elif boot.seg_card is not None and boot.leader == boot.rank:
-        for k in ("ring", "flags", "flat"):
+        for k in ("ring", "flags", "flat", "flat2"):
             p = boot.seg_card.get(k)
             if p:
                 try:

@@ -64,7 +64,7 @@ cvar("DAEMON_SPAWN", 1, int, "runtime",
      "running. 0 = claims still work against the manifest, but nothing "
      "sweeps or expires the directory.")
 
-MANIFEST_VERSION = 1
+MANIFEST_VERSION = 2     # v2: segment sets grew the flat2 file
 
 
 def default_dir() -> str:
@@ -124,7 +124,7 @@ class Claim:
     """One claimed segment set (held by a job's node leader)."""
 
     __slots__ = ("dir", "geokey", "epoch", "ring", "flags", "flat",
-                 "arena", "part_bytes")
+                 "flat2", "arena", "part_bytes")
 
     def __init__(self, dir_: str, geokey: str, epoch: int,
                  files: Dict[str, str], part_bytes: int):
@@ -134,6 +134,7 @@ class Claim:
         self.ring = files["ring"]
         self.flags = files["flags"]
         self.flat = files["flat"]
+        self.flat2 = files["flat2"]
         self.arena = files["arena"]
         self.part_bytes = part_bytes
 
@@ -164,6 +165,7 @@ def _set_sizes(n_local: int, ring_bytes: int, part_bytes: int) -> dict:
     return {"ring": n_local * n_local * ring_bytes,
             "flags": flags_len(n_local),
             "flat": 0,       # cp_flat_attach(create=1) sizes it
+            "flat2": 0,      # cp_flat2_attach(create=1) sizes it
             "arena": hdr + n_local * part_bytes}
 
 
@@ -185,7 +187,8 @@ def claim(n_local: int, ring_bytes: int, part_bytes: int,
             s = m["sets"].get(key)
             if s is None:
                 files = {k: os.path.join(dir_, f"{key}.{k}")
-                         for k in ("ring", "flags", "flat", "arena")}
+                         for k in ("ring", "flags", "flat", "flat2",
+                                   "arena")}
                 for k, p in files.items():
                     fd = os.open(p, os.O_CREAT | os.O_RDWR, 0o600)
                     os.ftruncate(fd, sizes[k])
@@ -193,7 +196,15 @@ def claim(n_local: int, ring_bytes: int, part_bytes: int,
                 s = {"state": "free", "epoch": 0, "owner_pid": 0,
                      "files": files, "sizes": sizes}
                 m["sets"][key] = s
-            elif s["state"] == "busy":
+            elif "flat2" not in s.get("files", {}):
+                # pre-v2 set surviving a daemon version adoption:
+                # provision the new segment in place (reset below zeroes
+                # it like every other file)
+                p = os.path.join(dir_, f"{key}.flat2")
+                fd = os.open(p, os.O_CREAT | os.O_RDWR, 0o600)
+                os.close(fd)
+                s["files"]["flat2"] = p
+            if s["state"] == "busy":
                 if _alive(s["owner_pid"]):
                     return None
                 # stale epoch: the owner died without releasing — sweep

@@ -17,6 +17,9 @@ traffic, nothing the job can observe:
     bcast seq for the predefined-context regions (the sparse mask
     window is left unmapped-cold — probing all ~1.2 GB would fault it
     in).
+  * **flat2 segment** (``<stem>.fcoll2``): the hierarchical tier's
+    per-region poison flag and wave counter (mseq), same
+    predefined-context / cold-mask-window discipline.
   * **native trace ring** (``<stem>.ntrace``, when the job runs with
     MV2T_NTRACE): per-rank event tails.
 
@@ -52,7 +55,7 @@ FP_NAMES = [
     "fp_hits", "fp_gil_takes", "fp_fallback_dtype", "fp_fallback_comm",
     "fp_fallback_size", "fp_fallback_plane", "fp_coll_flat",
     "fp_coll_sched", "fp_wait_spin", "fp_wait_bell", "fp_flat_progress",
-    "fp_dead_peer",
+    "fp_dead_peer", "fp_coll_flat2",
 ]
 
 
@@ -226,6 +229,32 @@ def snapshot(stem: str, trace_tail: int = 8,
             out["flat_regions"] = active
         finally:
             fm.close()
+    # hierarchical flat2 regions (<stem>.fcoll2): region header poison
+    # word @0 and wave counter mseq @8 — predefined contexts only, same
+    # cold-mask-window discipline as the flat segment
+    flat2_path = ring_path + ".fcoll2"
+    f2m = _read_only(flat2_path) if os.path.exists(flat2_path) else None
+    if f2m is not None:
+        try:
+            # geometry mirrors from trace/native.py (doctor-pinned
+            # against shm_layout.h's MV2T_FLAT2_*)
+            reg_stride = _native._FLAT2_REG_STRIDE
+            lanes = _native._FLAT2_LANES
+            active = []
+            for ctx in range(min(flat_regions, 64)):
+                for lane in range(lanes):
+                    base = (ctx * lanes + lane) * reg_stride
+                    if base + reg_stride > len(f2m):
+                        break
+                    poison = struct.unpack_from("<Q", f2m, base)[0]
+                    mseq = struct.unpack_from("<Q", f2m, base + 8)[0]
+                    if poison or mseq:
+                        active.append({"ctx": ctx, "lane": lane,
+                                       "poisoned": bool(poison),
+                                       "mseq": int(mseq)})
+            out["flat2_regions"] = active
+        finally:
+            f2m.close()
     # native trace tail (only when the job runs with MV2T_NTRACE)
     nt_path = ring_path + ".ntrace"
     if os.path.exists(nt_path):
@@ -268,6 +297,10 @@ def format_snapshot(snap: Dict[str, Any]) -> str:
     for fr in snap.get("flat_regions", []):
         lines.append(f"  flat region ctx={fr['ctx']} lane={fr['lane']}: "
                      f"bseq={fr['bseq']}"
+                     f"{' POISONED' if fr['poisoned'] else ''}")
+    for fr in snap.get("flat2_regions", []):
+        lines.append(f"  flat2 region ctx={fr['ctx']} "
+                     f"lane={fr['lane']}: mseq={fr['mseq']}"
                      f"{' POISONED' if fr['poisoned'] else ''}")
     for i, evs in sorted((snap.get("ntrace") or {}).items()):
         lines.append(f"  ntrace rank {i} tail:")

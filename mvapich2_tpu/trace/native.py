@@ -51,6 +51,17 @@ _NTR_HDR_BYTES = 64       # MV2T_NTR_HDR_BYTES (rank header; u64 seq @0)
 _NTR_EV_BYTES = 32        # MV2T_NTR_EV_BYTES
 _NTR_RING_EVENTS = 2048   # MV2T_NTR_RING_EVENTS
 
+# hierarchical flat2 segment geometry (MV2T_FLAT2_*, shm_layout.h) —
+# consumed by bin/mpistat's offline .fcoll2 parse; the mv2tlint layout
+# doctor pins every one of these against the header
+_FLAT2_GROUP = 8          # MV2T_FLAT2_GROUP
+_FLAT2_NGROUPS = 8        # MV2T_FLAT2_NGROUPS
+_FLAT2_MAX = 4096         # MV2T_FLAT2_MAX
+_FLAT2_MCAST_NBUF = 8     # MV2T_FLAT2_MCAST_NBUF
+_FLAT2_LANES = 8          # MV2T_FLAT2_LANES
+_FLAT2_SUB_STRIDE = 37504    # 64 + (GROUP+1) * MV2T_FLAT_SLOT_STRIDE
+_FLAT2_REG_STRIDE = 370880   # 64 + (NGROUPS+1)*SUB + NBUF*(64+MAX)
+
 _REC = struct.Struct("<QIIqq")      # ts_us, ev, claim, a1, a2
 
 # Event-id mirror of the NTE_* enum: index -> (name, protocol region).
@@ -71,6 +82,12 @@ _NT_EVENTS = [
     ("rndv_tx", "atomic(inbox)"),
     ("rndv_rx", "atomic(inbox)"),
     ("coll_dispatch", "seqlock(flat)"),
+    # hierarchical flat tier + multicast bcast (cp_flat2_*)
+    ("flat2_fold", "seqlock(flat2)"),
+    ("flat2_xchg", "seqlock(flat2)"),
+    ("flat2_fanout", "seqlock(flat2)"),
+    ("mcast_pub", "seqlock(flat2)"),
+    ("mcast_cons", "seqlock(flat2)"),
 ]
 
 # the Perfetto lane native events render in (recorder.LAYERS member)

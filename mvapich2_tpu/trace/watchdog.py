@@ -196,6 +196,38 @@ def _flat_report(u, pch, fmap=None) -> list:
                          "POISONED/closed for this comm")
             shown += 1
             continue
+        if getattr(st, "tier", 1) == 2:
+            # hierarchical tier: region wave counter + per-group and
+            # leaders-exchange slot seqs (wedged waves name which level
+            # stalled: a lagging group slot = intra-fold, a lagging
+            # leaders slot = leader exchange)
+            f2tag = _region_tag(fmap, "fl2_mseq")
+            poi = lib.cp_flat2_poisoned(pch.plane, st.ctx, st.lane)
+            base = lib.cp_flat2_base(pch.plane, st.ctx, st.lane)
+            k = lib.cp_flat2_group()
+            lines.append(f"## flat2 region {comm.name} (ctx {st.ctx}, "
+                         f"lane {st.lane}, k={k}): mseq={base} "
+                         f"poison={bool(poi)} local_seq={st.base + st.k}"
+                         f"{f2tag}")
+            i = ct.c_longlong()
+            o = ct.c_longlong()
+            ngroups = (st.size + k - 1) // k
+            for g in range(ngroups):
+                gn = min(k, st.size - g * k)
+                for slot in range(gn):
+                    if lib.cp_flat2_slot_state(pch.plane, st.ctx,
+                                               st.lane, g, slot,
+                                               i, o) == 0:
+                        lines.append(f"  g{g} slot {slot}: "
+                                     f"in_seq={i.value} "
+                                     f"out_seq={o.value}{f2tag}")
+            for g in range(ngroups):
+                if lib.cp_flat2_slot_state(pch.plane, st.ctx, st.lane,
+                                           8, g, i, o) == 0:
+                    lines.append(f"  leaders slot {g}: in_seq={i.value} "
+                                 f"out_seq={o.value}{f2tag}")
+            shown += 1
+            continue
         poi = lib.cp_flat_poisoned(pch.plane, st.ctx, st.lane)
         base = lib.cp_flat_base(pch.plane, st.ctx, st.lane)
         lines.append(f"## flat region {comm.name} (ctx {st.ctx}, lane "

@@ -294,7 +294,11 @@ class ShmArena:
         except OSError:
             return 0
         for name in names:
-            m = re.match(r"mv2t-arena-(\d+)-", name)
+            # arena segments AND per-job ring stems with their dotted
+            # siblings (.flags/.fcoll/.fcoll2/.ntrace) — a SIGKILLed
+            # leader leaves them all, and the sparse collective
+            # segments' touched pages are real tmpfs memory
+            m = re.match(r"mv2t-(?:arena|shm)-(\d+)-", name)
             if not m:
                 continue
             pid = int(m.group(1))

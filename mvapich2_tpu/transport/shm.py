@@ -117,6 +117,9 @@ _FP_COUNTERS = [
     ("fp_dead_peer",
      "peers declared dead by the C-plane lease scan (flat waits and "
      "wait quanta)"),
+    ("fp_coll_flat2",
+     "collectives completed on the hierarchical flat tier / multicast "
+     "bcast (cp_flat2_*)"),
 ]
 for _n, _d in _FP_COUNTERS:
     _mpit.pvar(_n, _mpit.PVAR_CLASS_COUNTER, "fastpath", _d)
@@ -318,6 +321,35 @@ def _bind_cplane(lib) -> None:
     lib.cp_flat_barrier.argtypes = [L.c_void_p, L.c_int, L.c_int, L.c_int,
                                     L.c_int, L.c_longlong]
     lib.cp_flat_set_progress_cb.argtypes = [L.c_void_p, L.c_void_p]
+    # hierarchical flat tier + multicast bcast (cp_flat2_*)
+    lib.cp_flat2_attach.argtypes = [L.c_void_p, L.c_char_p, L.c_int]
+    lib.cp_flat2_ok.argtypes = [L.c_void_p]
+    lib.cp_flat2_disable.argtypes = [L.c_void_p]
+    lib.cp_flat2_base.restype = L.c_longlong
+    lib.cp_flat2_base.argtypes = [L.c_void_p, L.c_int, L.c_int]
+    lib.cp_flat2_payload_max.restype = L.c_long
+    lib.cp_flat2_group.restype = L.c_int
+    lib.cp_flat2_max_ranks.restype = L.c_int
+    lib.cp_flat2_lanes.restype = L.c_int
+    lib.cp_flat2_poisoned.argtypes = [L.c_void_p, L.c_int, L.c_int]
+    lib.cp_flat2_poison_region.argtypes = [L.c_void_p, L.c_int, L.c_int]
+    lib.cp_flat2_slot_state.argtypes = [L.c_void_p, L.c_int, L.c_int,
+                                        L.c_int, L.c_int,
+                                        L.POINTER(L.c_longlong),
+                                        L.POINTER(L.c_longlong)]
+    lib.cp_flat2_allreduce.argtypes = [
+        L.c_void_p, L.c_int, L.c_int, L.c_int, L.c_int, L.c_longlong,
+        L.c_int, L.c_int, L.c_void_p, L.c_void_p, L.c_longlong,
+        L.c_longlong]
+    lib.cp_flat2_reduce.argtypes = [
+        L.c_void_p, L.c_int, L.c_int, L.c_int, L.c_int, L.c_longlong,
+        L.c_int, L.c_int, L.c_int, L.c_void_p, L.c_void_p, L.c_longlong,
+        L.c_longlong]
+    lib.cp_flat2_bcast.argtypes = [L.c_void_p, L.c_int, L.c_int, L.c_int,
+                                   L.c_int, L.c_longlong, L.c_int,
+                                   L.c_void_p, L.c_longlong, L.c_int]
+    lib.cp_flat2_barrier.argtypes = [L.c_void_p, L.c_int, L.c_int,
+                                     L.c_int, L.c_int, L.c_longlong]
     lib.cp_fp_counter.restype = L.c_ulonglong
     lib.cp_fp_counter.argtypes = [L.c_void_p, L.c_int]
     # native trace ring (MV2T_NTRACE; trace/native.py drains the file)
@@ -660,6 +692,11 @@ class ShmChannel(Channel):
         self._ring_cap = 0
         self._flat_path = boot_card["flat"] if boot_card is not None \
             else f"{path}.fcoll"
+        # hierarchical flat tier + multicast bcast segment (cp_flat2_*);
+        # older boot cards / daemon manifests may predate it
+        self._flat2_path = (boot_card.get("flat2")
+                            if boot_card is not None else None) \
+            or f"{path}.fcoll2"
         # native trace ring segment (beside the ring file; daemon mode
         # puts it beside the claimed ring, reset implicitly by the
         # monotonic timestamps — trace/native.py drops zero-ts slots)
@@ -680,6 +717,10 @@ class ShmChannel(Channel):
                     # during wiring without racing the creation
                     lib.cp_flat_attach(self.plane,
                                        self._flat_path.encode(), 1)
+                    # hierarchical tier segment: same sparse/idempotent
+                    # creation discipline (zero IS initialized)
+                    lib.cp_flat2_attach(self.plane,
+                                        self._flat2_path.encode(), 1)
                 for r in self.local_ranks:
                     lib.cp_set_world(self.plane, self.local_index[r], r)
                 # python-rank progress hook for flat-collective waits: a
@@ -1083,11 +1124,15 @@ class ShmChannel(Channel):
                 self._attach_follower_arena()
             my_arena = self.arena is not None
             my_flat = False
+            my_flat2 = False
             if self.plane:
                 if not self._owner:
                     lib.cp_flat_attach(self.plane,
                                        self._flat_path.encode(), 0)
+                    lib.cp_flat2_attach(self.plane,
+                                        self._flat2_path.encode(), 0)
                 my_flat = bool(lib.cp_flat_ok(self.plane))
+                my_flat2 = bool(lib.cp_flat2_ok(self.plane))
             # C-ABI membership: a comm with any C-ABI rank must use the
             # C fast path's collective-tier cap (FP_COLL_MAX) on every
             # member — coll/api.py._plane_coll_max reads this set. A
@@ -1095,11 +1140,12 @@ class ShmChannel(Channel):
             # size (interpreter-hop schedules lose to the arena tier).
             from .. import cshim as _cshim
             my_cabi = _cshim.is_cabi_process()
-            self._my_verdicts = (my_ok, my_arena, my_flat)
+            self._my_verdicts = (my_ok, my_arena, my_flat, my_flat2)
             self.kvs.put_many({
                 f"shm-cma-ok-{self.my_rank}": "1" if my_ok else "0",
                 f"shm-arena-ok-{self.my_rank}": "1" if my_arena else "0",
                 f"shm-flat-ok-{self.my_rank}": "1" if my_flat else "0",
+                f"shm-flat2-ok-{self.my_rank}": "1" if my_flat2 else "0",
                 f"shm-cabi-{self.my_rank}": "1" if my_cabi else "0",
             })
             self.cabi_ranks = {self.my_rank} if my_cabi else set()
@@ -1109,25 +1155,29 @@ class ShmChannel(Channel):
                 [f"shm-cma-ok-{r}" for r in peers]
                 + [f"shm-arena-ok-{r}" for r in peers]
                 + [f"shm-flat-ok-{r}" for r in peers]
+                + [f"shm-flat2-ok-{r}" for r in peers]
                 + [f"shm-cabi-{r}" for r in peers])
             if any(v is None for v in vals):
                 return False    # some peer has not published its verdict
             n = len(peers)
-            my_ok, my_arena, my_flat = self._my_verdicts
+            my_ok, my_arena, my_flat, my_flat2 = self._my_verdicts
             all_ok = my_ok and all(v == "1" for v in vals[:n])
             all_arena = my_arena and all(v == "1" for v in vals[n:2 * n])
             all_flat = my_flat and all(v == "1" for v in vals[2 * n:3 * n])
+            all_flat2 = my_flat2 and all(
+                v == "1" for v in vals[3 * n:4 * n])
             if dead:
                 # degraded wire: a local rank died before its verdict
                 # landed — no unanimous agreement can include it
-                all_ok = all_arena = all_flat = False
+                all_ok = all_arena = all_flat = all_flat2 = False
                 self.cabi_ranks.update(dead)
-            for r, v in zip(peers, vals[3 * n:]):
+            for r, v in zip(peers, vals[4 * n:]):
                 if v != "0":
                     # unknown counts as C-ABI: the conservative verdict
                     # is the shared FP_COLL_MAX cap
                     self.cabi_ranks.add(r)
-            self._apply_wire(all_ok, all_arena, all_flat, my_flat)
+            self._apply_wire(all_ok, all_arena, all_flat, my_flat,
+                             all_flat2, my_flat2)
         return self._wired
 
     def _attach_follower_arena(self) -> None:
@@ -1148,7 +1198,8 @@ class ShmChannel(Channel):
             self.arena = None
 
     def _apply_wire(self, all_ok: bool, all_arena: bool, all_flat: bool,
-                    my_flat: bool) -> None:  # holds: _wire_lock
+                    my_flat: bool, all_flat2: bool = False,
+                    my_flat2: bool = False) -> None:  # holds: _wire_lock
         """Stage 2: apply the unanimous agreements and go live."""
         self.cma_ok = all_ok
         if not all_arena and self.arena is not None:
@@ -1159,6 +1210,8 @@ class ShmChannel(Channel):
             lib = self._ring.lib
             if not all_flat and my_flat:
                 lib.cp_flat_disable(self.plane)
+            if not all_flat2 and my_flat2:
+                lib.cp_flat2_disable(self.plane)
             if all_ok:
                 lib.cp_set_cma(self.plane, 1)
             # open the C fast path's collective dispatch LAST: every
@@ -1167,8 +1220,8 @@ class ShmChannel(Channel):
             lib.cp_set_wired(self.plane)
         self._wired = True
         (pv_wiring_eager if self._wire_eager else pv_wiring_lazy).inc()
-        log.info("node wire complete (cma=%s arena=%s flat=%s, %s)",
-                 all_ok, all_arena, all_flat,
+        log.info("node wire complete (cma=%s arena=%s flat=%s flat2=%s, "
+                 "%s)", all_ok, all_arena, all_flat, all_flat2,
                  "eager" if self._wire_eager else "lazy")
 
     def _make_ring(self, path: str, ring_bytes: int, create: bool):
@@ -1609,7 +1662,8 @@ class ShmChannel(Channel):
                 _daemon.release(self._daemon_claim)
             elif not self._daemon:
                 for path in (self.path, self._flags_path,
-                             self._flat_path, self._ntrace_path):
+                             self._flat_path, self._flat2_path,
+                             self._ntrace_path):
                     try:
                         os.unlink(path)
                     except OSError:

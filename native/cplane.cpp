@@ -295,6 +295,10 @@ struct CPlane {
   // of per-context regions — fan-in/fan-out slots for small collectives
   uint8_t* flat;                 // guarded-by: single-writer-per-slot seqs
   size_t flat_len;
+  // hierarchical flat tier + multicast bcast segment (cp_flat2_*): the
+  // leaders-of-k two-level geometry for 8 < np <= MV2T_FLAT2_MAX_RANKS
+  uint8_t* flat2;                // guarded-by: single-writer-per-slot seqs
+  size_t flat2_len;
   // fast-path observability counters (indices FPC_*, shm_layout.h);
   // written by fastpath.c through cp_fp_counters() and by cp_flat_*,
   // read by the python mpit layer — and, when the flags segment carries
@@ -969,6 +973,7 @@ void cp_destroy(void* cp) {
   if (p->fpctr_private) free(p->fpctr);
   if (p->flags) munmap(p->flags, p->flags_len);
   if (p->flat) munmap(p->flat, p->flat_len);
+  if (p->flat2) munmap(p->flat2, p->flat2_len);
   if (p->nt) munmap(p->nt, p->nt_len);
   if (p->bell_tx >= 0) close(p->bell_tx);
   for (int d = 0; d < p->n_local; d++) {
@@ -2341,6 +2346,427 @@ int cp_flat_barrier(void* cp, int ctx, int lane, int rank, int n,
                     long long seq) {
   return cp_flat_allreduce(cp, ctx, lane, rank, n, seq, 0, 0, nullptr,
                            nullptr, 0, 1);
+}
+
+}  // extern "C" (reopened below — the flat2 tier's helpers are C++)
+
+// ---------------------------------------------------------------------------
+// hierarchical flat tier + multicast bcast (cp_flat2_*)
+//
+// The flat tier past its FLAT_NSLOTS=8 ceiling: a two-level leaders-of-k
+// composition (the k-ary group framework of "A Generalization of the
+// Allreduce Operation") over a second per-node segment whose regions
+// hold NGROUPS+1 flat-shaped sub-regions — group g's intra-group arena
+// plus a leaders-only exchange — and one MULTICAST block. An allreduce
+// at np=64 is two 8-wide seqlock waves (members fold into their group
+// leader, leaders exchange partials, seq-stamped fan-out back through
+// the group blocks) instead of a log-depth chain of scheduled pt2pt
+// hops. A bcast is the one-writer/N-readers shape of "Exploiting
+// Multicast for Accelerating Collective Communication": the root
+// writes the payload ONCE into the region's mcast block and every rank
+// consumes it under the same monotonic wave-seq discipline — no
+// per-pair envelopes, no per-group leader re-copy.
+//
+// Wave numbering: the mcast block's mseq word is the region's wave
+// counter AND the lazily-read per-comm numbering base (cp_flat2_base).
+// Every wave's coordinator (comm rank 0 for the reduce family, the
+// root for mcast bcast) stamps it — and only after EVERY member
+// arrived at the wave (the reduce fold implies it; mcast runs an
+// explicit arrival wave), which is the fan-in-first property that
+// keeps a slow member's lazy base read from counting an in-flight
+// wave (see cp_flat_bcast). Failure containment is byte-for-byte the
+// flat tier's: flat_wait escapes on g_any_failed / stall, the region
+// header's poison word is stamped sticky, cp_flat2_base refuses a
+// poisoned region, ft recovery re-keys.
+//
+// Both ABIs drive these entry points (fastpath.c fpc_flat2_next and
+// coll/flatcoll.py via ctypes), so the schedule is identical across a
+// mixed C/python job by construction.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr int FLAT2_GROUP_MAX = MV2T_FLAT2_GROUP;
+constexpr int FLAT2_NGROUPS = MV2T_FLAT2_NGROUPS;
+constexpr long FLAT2_MAX = MV2T_FLAT2_MAX;
+constexpr long FLAT2_SUB_STRIDE = MV2T_FLAT2_SUB_STRIDE;
+constexpr long FLAT2_REG_HDR = MV2T_FLAT2_REG_HDR;
+constexpr long FLAT2_REG_STRIDE = MV2T_FLAT2_REG_STRIDE;
+constexpr int FLAT2_SMALL_CTXS = MV2T_FLAT2_SMALL_CTXS;
+constexpr int FLAT2_MASK_CTXS = MV2T_FLAT2_MASK_CTXS;
+constexpr int FLAT2_LANES = MV2T_FLAT2_LANES;
+constexpr long FLAT2_FILE_LEN = MV2T_FLAT2_FILE_LEN;
+
+// runtime group width k in [2, FLAT2_GROUP_MAX] (MV2T_FLAT2_GROUP env;
+// launcher-uniform, so every rank and both ABIs derive the same
+// geometry). Parsed once.
+std::atomic<int> g_flat2_k{0};   /* shared: atomic(init) */
+
+int flat2_group_width() {
+  int k = g_flat2_k.load(std::memory_order_acquire);
+  if (k == 0) {
+    const char* e = getenv("MV2T_FLAT2_GROUP");
+    k = (e && *e) ? atoi(e) : FLAT2_GROUP_MAX;
+    if (k < 2) k = 2;
+    if (k > FLAT2_GROUP_MAX) k = FLAT2_GROUP_MAX;
+    g_flat2_k.store(k, std::memory_order_release);
+  }
+  return k;
+}
+
+uint8_t* flat2_region(CPlane* p, int ctx, int lane) {
+  if (!p->flat2 || lane < 0 || lane >= FLAT2_LANES) return nullptr;
+  long idx;
+  if (ctx >= 0 && ctx < FLAT2_SMALL_CTXS) {
+    idx = ctx;
+  } else if (ctx >= FLAT_CTX_MASK_BASE
+             && ctx < FLAT_CTX_MASK_BASE + FLAT2_MASK_CTXS) {
+    idx = FLAT2_SMALL_CTXS + (ctx - FLAT_CTX_MASK_BASE);
+  } else {
+    return nullptr;
+  }
+  return p->flat2 + (idx * FLAT2_LANES + lane) * FLAT2_REG_STRIDE;
+}
+
+// sub-region g in [0, NGROUPS) = group g's arena; g == NGROUPS = the
+// leaders-only exchange. Each is flat-shaped: header line + GROUP_MAX
+// slots + one broadcast block, all on the flat tier's slot stride.
+inline uint8_t* flat2_sub(uint8_t* reg, int g) {
+  return reg + FLAT2_REG_HDR + g * FLAT2_SUB_STRIDE;
+}
+inline uint8_t* flat2_slot(uint8_t* sub, int i) {
+  return sub + 64 + i * FLAT_SLOT_STRIDE;
+}
+inline uint8_t* flat2_gbcb(uint8_t* sub) {
+  return sub + 64 + FLAT2_GROUP_MAX * FLAT_SLOT_STRIDE;
+}
+// mcast ring buffer of wave s (s % NBUF): 64-byte header (payload byte
+// count @0) + payload
+inline uint8_t* flat2_mcbuf(uint8_t* reg, uint64_t s) {
+  return reg + FLAT2_REG_HDR + (FLAT2_NGROUPS + 1) * FLAT2_SUB_STRIDE
+         + static_cast<long>(s % MV2T_FLAT2_MCAST_NBUF)
+               * MV2T_FLAT2_MCAST_STRIDE;
+}
+
+// flat2 seqlock words: the region poison (header byte 0, sticky on a
+// dead wave), the region wave counter mseq (header byte 8 — the
+// per-comm numbering base, release-stamped by every completed wave's
+// coordinator), and each mcast buffer's byte count. Slot words inside
+// the sub-regions reuse fl_in/fl_out/fl_pay — identical layout,
+// identical discipline. Every dereference rides fl_load / fl_store
+// (acquire/release); flat_wait is the vetted re-check loop.
+inline volatile uint64_t* fl2_poi(uint8_t* reg) { /* shared: seqlock(flat2) */
+  return reinterpret_cast<volatile uint64_t*>(reg);
+}
+inline volatile uint64_t* fl2_mseq(uint8_t* reg) { /* shared: seqlock(flat2) */
+  return reinterpret_cast<volatile uint64_t*>(reg + 8);
+}
+inline volatile uint64_t* fl2_mlen(uint8_t* buf) { /* shared: seqlock(flat2) */
+  return reinterpret_cast<volatile uint64_t*>(buf);
+}
+inline uint8_t* fl2_mpay(uint8_t* buf) { return buf + 64; }
+
+inline int flat2_fail(CPlane* p, uint8_t* reg, int rc) {
+  if (rc == -2 || rc == -3) {
+    fl_store(fl2_poi(reg), 1);
+    MV2T_NTRACE(p, NTE_FLAT_POISON, rc, 1);
+  }
+  return rc;
+}
+
+}  // namespace
+
+extern "C" {
+
+int cp_flat2_group(void) { return flat2_group_width(); }
+int cp_flat2_max_ranks(void) {
+  return flat2_group_width() * FLAT2_NGROUPS;
+}
+long cp_flat2_payload_max(void) { return FLAT2_MAX; }
+int cp_flat2_lanes(void) { return FLAT2_LANES; }
+
+// map (and on the leader: create) the per-node flat2 segment. Sparse
+// like the flat segment — only regions of contexts that actually run
+// hierarchical collectives materialize pages. MV2T_FLAT2=0 is the tier
+// kill switch (launcher-uniform env, so the refusal is unanimous).
+// Returns 0 ok, -1 unusable/disabled.
+int cp_flat2_attach(void* cp, const char* path, int create) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (p->flat2) return 0;
+  const char* kill = getenv("MV2T_FLAT2");
+  if (kill && *kill && atoi(kill) == 0) return -1;
+  int fd = open(path, create ? (O_CREAT | O_RDWR) : O_RDWR, 0600);
+  if (fd < 0) return -1;
+  if (create && ftruncate(fd, FLAT2_FILE_LEN) != 0) {
+    close(fd);
+    return -1;
+  }
+  void* m = mmap(nullptr, FLAT2_FILE_LEN, PROT_READ | PROT_WRITE,
+                 MAP_SHARED, fd, 0);
+  close(fd);
+  if (m == MAP_FAILED) return -1;
+  p->flat2 = static_cast<uint8_t*>(m);
+  p->flat2_len = FLAT2_FILE_LEN;
+  return 0;
+}
+
+int cp_flat2_ok(void* cp) {
+  return static_cast<CPlane*>(cp)->flat2 != nullptr;
+}
+
+void cp_flat2_disable(void* cp) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  if (p->flat2) {
+    munmap(p->flat2, p->flat2_len);
+    p->flat2 = nullptr;
+  }
+}
+
+// the region's current wave seq (mcast mseq) — the per-comm numbering
+// base read once before a comm's first flat2 collective. -1 = no
+// region for this context / poisoned (caller must not take the tier).
+long long cp_flat2_base(void* cp, int ctx, int lane) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  uint8_t* reg = flat2_region(p, ctx, lane);
+  if (reg == nullptr) return -1;
+  if (fl_load(fl2_poi(reg)) != 0) return -1;
+  return static_cast<long long>(fl_load(fl2_mseq(reg)));
+}
+
+int cp_flat2_poisoned(void* cp, int ctx, int lane) {
+  uint8_t* reg = flat2_region(static_cast<CPlane*>(cp), ctx, lane);
+  return (reg != nullptr && fl_load(fl2_poi(reg)) != 0) ? 1 : 0;
+}
+
+void cp_flat2_poison_region(void* cp, int ctx, int lane) {
+  uint8_t* reg = flat2_region(static_cast<CPlane*>(cp), ctx, lane);
+  if (reg != nullptr) fl_store(fl2_poi(reg), 1);
+}
+
+// forensics for the stall watchdog / bin/mpistat: sub in [0, NGROUPS)
+// = group sub-region, NGROUPS = leaders exchange (slot in [0, GROUP]
+// with GROUP = the broadcast block), NGROUPS+1 = the mcast ring
+// (slot = buffer index; in = region mseq, out = that buffer's
+// published byte count).
+int cp_flat2_slot_state(void* cp, int ctx, int lane, int sub, int slot,
+                        long long* in_seq, long long* out_seq) {
+  uint8_t* reg = flat2_region(static_cast<CPlane*>(cp), ctx, lane);
+  if (reg == nullptr || sub < 0 || sub > FLAT2_NGROUPS + 1) return -1;
+  if (sub == FLAT2_NGROUPS + 1) {
+    if (slot < 0 || slot >= MV2T_FLAT2_MCAST_NBUF) return -1;
+    uint8_t* buf = flat2_mcbuf(reg, static_cast<uint64_t>(slot));
+    if (in_seq) *in_seq = static_cast<long long>(fl_load(fl2_mseq(reg)));
+    if (out_seq)
+      *out_seq = static_cast<long long>(fl_load(fl2_mlen(buf)));
+    return 0;
+  }
+  if (slot < 0 || slot > FLAT2_GROUP_MAX) return -1;
+  uint8_t* sr = flat2_sub(reg, sub);
+  uint8_t* s = slot == FLAT2_GROUP_MAX ? flat2_gbcb(sr)
+                                       : flat2_slot(sr, slot);
+  if (in_seq) *in_seq = static_cast<long long>(fl_load(fl_in(s)));
+  if (out_seq) *out_seq = static_cast<long long>(fl_load(fl_out(s)));
+  return 0;
+}
+
+// hierarchical allreduce: members fold intra-group into their group
+// leader (comm rank g*k), leaders exchange partials in the leaders-only
+// sub-region (root leader = comm rank 0 folds), seq-stamped fan-out
+// back through the group blocks. sbuf may alias rbuf (MPI_IN_PLACE).
+// Returns 0 ok, -1 bad args, -2 peer failure, -3 stall timeout.
+int cp_flat2_allreduce(void* cp, int ctx, int lane, int rank, int n,
+                       long long seq, int op, int dt, const void* sbuf,
+                       void* rbuf, long long count, long long elsz) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  uint8_t* reg = flat2_region(p, ctx, lane);
+  long nb = static_cast<long>(count * elsz);
+  int k = flat2_group_width();
+  if (reg == nullptr || n < 2 || n > k * FLAT2_NGROUPS || rank < 0 ||
+      rank >= n || nb < 0 || nb > FLAT2_MAX)
+    return -1;
+  uint64_t s = static_cast<uint64_t>(seq);
+  int g = rank / k;
+  int gr = rank - g * k;              // slot index within the group
+  int gn = n - g * k < k ? n - g * k : k;   // this group's width
+  int ngroups = (n + k - 1) / k;
+  uint8_t* sub = flat2_sub(reg, g);
+  uint8_t* mine = flat2_slot(sub, gr);
+  uint8_t* gbcb = flat2_gbcb(sub);
+  flat_fault(p);
+  flat_enter(mine, s);
+  MV2T_NTRACE(p, NTE_FLAT_FANIN, ctx, seq);
+  int rc = 0;
+  if (gr != 0) {
+    // group member: publish under my slot's in_seq, wait for the group
+    // result, copy out. Identical to the flat tier's member path.
+    if (nb > 0) memcpy(fl_pay(mine), sbuf, nb);
+    fl_store(fl_in(mine), s);
+    rc = flat_wait(p, fl_in(gbcb), s);
+    if (rc != 0) return flat2_fail(p, reg, rc);
+    if (nb > 0) memcpy(rbuf, fl_pay(gbcb), nb);
+    fl_store(fl_out(mine), s);
+    p->fpctr[FPC_COLL_FLAT2]++;
+    MV2T_NTRACE(p, NTE_FLAT2_FANOUT, ctx, seq);
+    return 0;
+  }
+  // group leader: fold my group into a private accumulator (<= 4 KiB,
+  // stack) — the intra-group wave
+  uint8_t acc[MV2T_FLAT2_MAX];
+  if (nb > 0) memcpy(acc, sbuf, nb);
+  for (int r = 1; r < gn && rc == 0; r++) {
+    uint8_t* sl = flat2_slot(sub, r);
+    rc = flat_wait(p, fl_in(sl), s);
+    if (rc == 0 && nb > 0) fl_reduce(op, dt, acc, fl_pay(sl), count);
+  }
+  if (rc != 0) return flat2_fail(p, reg, rc);
+  MV2T_NTRACE(p, NTE_FLAT2_FOLD, ctx, seq);
+  uint8_t* lsub = flat2_sub(reg, FLAT2_NGROUPS);
+  uint8_t* lslot = flat2_slot(lsub, g);
+  uint8_t* lbcb = flat2_gbcb(lsub);
+  flat_enter(lslot, s);
+  if (g != 0) {
+    // leader exchange, member side: publish my group's partial, wait
+    // for the root leader's fold
+    if (nb > 0) memcpy(fl_pay(lslot), acc, nb);
+    fl_store(fl_in(lslot), s);
+    rc = flat_wait(p, fl_in(lbcb), s);
+    if (rc != 0) return flat2_fail(p, reg, rc);
+    if (nb > 0) memcpy(acc, fl_pay(lbcb), nb);
+    fl_store(fl_out(lslot), s);
+  } else {
+    // root leader: overwrite guard (every leader consumed wave s-1's
+    // exchange block), fold the leader partials in group order, stamp
+    for (int j = 0; j < ngroups && rc == 0; j++)
+      rc = flat_wait(p, fl_out(flat2_slot(lsub, j)), s - 1);
+    for (int j = 1; j < ngroups && rc == 0; j++) {
+      uint8_t* sl = flat2_slot(lsub, j);
+      rc = flat_wait(p, fl_in(sl), s);
+      if (rc == 0 && nb > 0) fl_reduce(op, dt, acc, fl_pay(sl), count);
+    }
+    if (rc != 0) return flat2_fail(p, reg, rc);
+    if (nb > 0) memcpy(fl_pay(lbcb), acc, nb);
+    fl_store(fl_in(lbcb), s);
+    fl_store(fl_in(lslot), s);
+    fl_store(fl_out(lslot), s);
+    // region wave counter (= numbering base): every member has arrived
+    // by now — the leaders fold transitively required every group's
+    // fan-in — so the fan-in-first property holds (see cp_flat_bcast)
+    fl_store(fl2_mseq(reg), s);
+    MV2T_NTRACE(p, NTE_FLAT2_XCHG, ctx, seq);
+  }
+  // fan-out through my group's block: overwrite guard (my group
+  // consumed wave s-1), publish the final result, stamp
+  for (int r = 0; r < gn && rc == 0; r++)
+    rc = flat_wait(p, fl_out(flat2_slot(sub, r)), s - 1);
+  if (rc != 0) return flat2_fail(p, reg, rc);
+  if (nb > 0) {
+    memcpy(fl_pay(gbcb), acc, nb);
+    memcpy(rbuf, acc, nb);
+  }
+  fl_store(fl_in(gbcb), s);
+  fl_store(fl_in(mine), s);
+  fl_store(fl_out(mine), s);
+  p->fpctr[FPC_COLL_FLAT2]++;
+  MV2T_NTRACE(p, NTE_FLAT2_FANOUT, ctx, seq);
+  return 0;
+}
+
+// hierarchical reduce: the allreduce wave delivering only at ``root``
+// (every builtin op here is commutative, so the two-level fold order
+// is legal; the full fan-out keeps the per-wave counters uniform for
+// the next wave's overwrite guards, and at <= 4 KiB the extra copies
+// are noise next to one scheduled hop).
+int cp_flat2_reduce(void* cp, int ctx, int lane, int rank, int n,
+                    long long seq, int op, int dt, int root,
+                    const void* sbuf, void* rbuf, long long count,
+                    long long elsz) {
+  if (root < 0 || root >= n) return -1;
+  uint8_t tmp[MV2T_FLAT2_MAX];
+  void* out = rank == root ? rbuf : tmp;
+  return cp_flat2_allreduce(cp, ctx, lane, rank, n, seq, op, dt, sbuf,
+                            out, count, elsz);
+}
+
+// single-writer multicast bcast, pipelined: the root writes the
+// payload ONCE into mcast ring buffer s % NBUF and release-stamps the
+// region wave counter mseq = s; N readers consume under the seqlock
+// discipline and stamp out. The root may run up to NBUF waves ahead of
+// the slowest reader — the overwrite guard for buffer s % NBUF is
+// every member's out >= s - NBUF (a reader that acked wave s - NBUF
+// can never again touch that buffer's previous content) — so a stream
+// of bcasts is a depth-NBUF producer/consumer pipeline with no global
+// rendezvous per wave. No per-pair envelopes, no leader re-copy per
+// group.
+//
+// ``sync`` MUST be 1 on a comm's FIRST flat2 wave (seq == base + 1;
+// both dispatchers derive it from the numbering base): the root then
+// runs a full arrival wave (every member's in >= s) before publishing,
+// which pins the fan-in-first property for the lazy base read — a
+// member reads its base strictly before it arrives, and the root
+// cannot stamp the first wave's mseq until everyone arrived, so no
+// member can ever read a base that counts an in-flight wave. Past the
+// first wave every member's base is fixed and the pipeline may run
+// ahead safely.
+//
+// The root's byte count travels in the buffer header so a length-
+// mismatched bcast is REPORTED (-4 -> MPI_ERR_TRUNCATE) while the
+// wave still completes.
+int cp_flat2_bcast(void* cp, int ctx, int lane, int rank, int n,
+                   long long seq, int root, void* buf, long long nbytes,
+                   int sync) {
+  CPlane* p = static_cast<CPlane*>(cp);
+  uint8_t* reg = flat2_region(p, ctx, lane);
+  int k = flat2_group_width();
+  if (reg == nullptr || n < 2 || n > k * FLAT2_NGROUPS || rank < 0 ||
+      rank >= n || root < 0 || root >= n || nbytes < 0 ||
+      nbytes > FLAT2_MAX)
+    return -1;
+  uint64_t s = static_cast<uint64_t>(seq);
+  int g = rank / k;
+  uint8_t* mine = flat2_slot(flat2_sub(reg, g), rank - g * k);
+  uint8_t* mcb = flat2_mcbuf(reg, s);
+  flat_fault(p);
+  flat_enter(mine, s);
+  MV2T_NTRACE(p, NTE_FLAT_FANIN, ctx, seq);
+  int rc = 0;
+  if (rank == root) {
+    uint64_t guard = s > MV2T_FLAT2_MCAST_NBUF
+                         ? s - MV2T_FLAT2_MCAST_NBUF : 0;
+    for (int r = 0; r < n && rc == 0; r++) {
+      if (r == rank) continue;
+      int rg = r / k;
+      uint8_t* sl = flat2_slot(flat2_sub(reg, rg), r - rg * k);
+      if (sync) rc = flat_wait(p, fl_in(sl), s);
+      if (rc == 0 && guard > 0) rc = flat_wait(p, fl_out(sl), guard);
+    }
+    if (rc != 0) return flat2_fail(p, reg, rc);
+    if (nbytes > 0) memcpy(fl2_mpay(mcb), buf, nbytes);
+    fl_store(fl2_mlen(mcb), static_cast<uint64_t>(nbytes));
+    fl_store(fl2_mseq(reg), s);    // release publish: readers may go
+    fl_store(fl_in(mine), s);
+    fl_store(fl_out(mine), s);
+    p->fpctr[FPC_COLL_FLAT2]++;
+    MV2T_NTRACE(p, NTE_MCAST_PUB, ctx, nbytes);
+    return 0;
+  }
+  fl_store(fl_in(mine), s);        // arrival stamp (first-wave sync +
+                                   // watchdog forensics)
+  rc = flat_wait(p, fl2_mseq(reg), s);
+  if (rc != 0) return flat2_fail(p, reg, rc);
+  long long have = static_cast<long long>(fl_load(fl2_mlen(mcb)));
+  long long take = have < nbytes ? have : nbytes;
+  if (take > 0) memcpy(buf, fl2_mpay(mcb), take);
+  fl_store(fl_out(mine), s);
+  p->fpctr[FPC_COLL_FLAT2]++;
+  MV2T_NTRACE(p, NTE_MCAST_CONS, ctx, seq);
+  return have != nbytes ? -4 : 0;
+}
+
+// hierarchical barrier: a zero-byte two-level allreduce.
+int cp_flat2_barrier(void* cp, int ctx, int lane, int rank, int n,
+                     long long seq) {
+  return cp_flat2_allreduce(cp, ctx, lane, rank, n, seq, 0, 0, nullptr,
+                            nullptr, 0, 1);
 }
 
 // ---------------------------------------------------------------------------

@@ -90,6 +90,20 @@ static struct {
     long (*flat_payload_max)(void);
     int (*flat_nslots)(void);
     void (*flat_set_progress_cb)(cph, void (*)(void));
+    /* hierarchical flat tier + multicast bcast (cp_flat2_*): the
+     * leaders-of-k two-level waves for 8 < np <= flat2_max_ranks */
+    int (*flat2_ok)(cph);
+    long long (*flat2_base)(cph, int, int);
+    int (*flat2_allreduce)(cph, int, int, int, int, long long, int, int,
+                           const void *, void *, long long, long long);
+    int (*flat2_reduce)(cph, int, int, int, int, long long, int, int,
+                        int, const void *, void *, long long, long long);
+    int (*flat2_bcast)(cph, int, int, int, int, long long, int, void *,
+                       long long, int);
+    int (*flat2_barrier)(cph, int, int, int, int, long long);
+    int (*flat2_lanes)(void);
+    int (*flat2_max_ranks)(void);
+    long (*flat2_payload_max)(void);
     unsigned long long *(*fp_counters)(cph);
     /* native trace ring (optional symbol — an older libshmring.so
      * simply has no ring; NULL means skip). One NULL check per
@@ -191,6 +205,15 @@ static int fp_load_locked(void) {
     SYM(flat_nslots, "cp_flat_nslots");
     SYM(flat_lanes, "cp_flat_lanes");
     SYM(flat_set_progress_cb, "cp_flat_set_progress_cb");
+    SYM(flat2_ok, "cp_flat2_ok");
+    SYM(flat2_base, "cp_flat2_base");
+    SYM(flat2_allreduce, "cp_flat2_allreduce");
+    SYM(flat2_reduce, "cp_flat2_reduce");
+    SYM(flat2_bcast, "cp_flat2_bcast");
+    SYM(flat2_barrier, "cp_flat2_barrier");
+    SYM(flat2_lanes, "cp_flat2_lanes");
+    SYM(flat2_max_ranks, "cp_flat2_max_ranks");
+    SYM(flat2_payload_max, "cp_flat2_payload_max");
     SYM(fp_counters, "cp_fp_counters");
 #undef SYM
     /* lenient: the trace-ring emit is observability, not protocol — a
@@ -344,6 +367,10 @@ typedef struct {
                                  * -1 off/poisoned, else region base+1 */
     long long flat_seq;         /* flat collectives completed here */
     int flat_lane;              /* min member ring index (region lane) */
+    int flat2;                  /* 1 = the base/seq belong to the
+                                 * hierarchical tier (size > nslots; the
+                                 * two tiers are mutually exclusive per
+                                 * comm, so they share the counters) */
 } FpComm;
 
 static FpComm fp_comms[FP_MAX_COMM];
@@ -1342,6 +1369,35 @@ static int fpc_flat_err(FpComm *fc, int rc) {
     return rc == -2 ? MPIX_ERR_PROC_FAILED : MPI_ERR_INTERN;
 }
 
+/* hierarchical-tier dispatch (cp_flat2_*): the next call seq when this
+ * collective can run the leaders-of-k two-level waves, 0 otherwise.
+ * Same determinism contract as fpc_flat_next — python members
+ * (coll/flatcoll.py) implement the identical predicate against the
+ * same cp_flat2_* gates, so every member of a mixed job reaches the
+ * same verdict. The two tiers split on comm size (flat <= nslots <
+ * flat2), so FpComm's flat_base/flat_seq counters are shared. */
+static long long fpc_flat2_next(cph p, FpComm *fc, long nb) {
+    if (nb > F.flat2_payload_max() || fc->size <= F.flat_nslots()
+        || fc->size > F.flat2_max_ranks())
+        return 0;
+    if (fc->flat_base == 0) {
+        /* region lane: minimum ring index among the members (see
+         * fpc_flat_next) */
+        int lane = fc->ring[0];
+        for (int i = 1; i < fc->size; i++)
+            if (fc->ring[i] < lane)
+                lane = fc->ring[i];
+        fc->flat_lane = lane;
+        long long b = (F.flat2_ok(p) && lane < F.flat2_lanes())
+                      ? F.flat2_base(p, fc->ctx + 1, lane) : -1;
+        fc->flat_base = b < 0 ? -1 : b + 1;
+        fc->flat2 = 1;
+    }
+    if (fc->flat_base < 0)
+        return 0;
+    return (fc->flat_base - 1) + (++fc->flat_seq);
+}
+
 /* Flat-tier call numbering for the embedded python side
  * (coll/flatcoll.py via ctypes on the global symbol table): in a C-ABI
  * process a comm's flat collectives may interleave between this file's
@@ -1356,6 +1412,10 @@ long long mv2t_fp_flat_next(MPI_Comm comm, long nb) {
     FpComm *fc = fp_comm(comm);
     if (fc == NULL)
         return 0;
+    /* one comm is served by exactly one tier (split on size), so the
+     * shared counter routes on the same gate both dispatches use */
+    if (fc->size > F.flat_nslots())
+        return fpc_flat2_next(p, fc, nb);
     return fpc_flat_next(p, fc, nb);
 }
 
@@ -1388,6 +1448,16 @@ int fp_try_allreduce(const void *sendbuf, void *recvbuf, int count,
         int rc = F.flat_allreduce(p, fc->ctx + 1, fc->flat_lane, rank,
                                   n, fseq, op, dt, sb, recvbuf, count,
                                   fpc_elsz(dt));
+        *out_rc = rc == 0 ? MPI_SUCCESS : fpc_flat_err(fc, rc);
+        return 1;
+    }
+    fseq = fpc_flat2_next(p, fc, nb);
+    if (fseq > 0) {
+        FPNT(p, 2, nb);
+        const void *sb = sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf;
+        int rc = F.flat2_allreduce(p, fc->ctx + 1, fc->flat_lane, rank,
+                                   n, fseq, op, dt, sb, recvbuf, count,
+                                   fpc_elsz(dt));
         *out_rc = rc == 0 ? MPI_SUCCESS : fpc_flat_err(fc, rc);
         return 1;
     }
@@ -1508,6 +1578,30 @@ int fp_try_bcast(void *buf, int count, MPI_Datatype dt, int root,
         *out_rc = fpc_flat_err(fc, frc);
         return 1;
     }
+    fseq = fpc_flat2_next(p, fc, nb);
+    if (fseq > 0) {
+        /* multicast tier: root writes ONCE, every rank reads the one
+         * seqlock'd mcast block — no binomial relay, no envelopes */
+        FPNT(p, 3, nb);
+        /* sync=1 on the comm's first flat2 wave (seq == base + 1):
+         * pins the fan-in-first property for lazy base reads; later
+         * waves ride the depth-NBUF mcast pipeline */
+        int frc = F.flat2_bcast(p, fc->ctx + 1, fc->flat_lane, rank, n,
+                                fseq, root, data, nb,
+                                fseq == fc->flat_base);
+        if (frc == 0 || frc == -4) {
+            if (tmp != NULL) {
+                if (rank != root)
+                    fp_unpack_spans(d, buf, count, tmp);
+                free(tmp);
+            }
+            *out_rc = frc == 0 ? MPI_SUCCESS : MPI_ERR_TRUNCATE;
+            return 1;
+        }
+        free(tmp);
+        *out_rc = fpc_flat_err(fc, frc);
+        return 1;
+    }
     FPNT(p, 1, nb);
     FPCTR(FPC_COLL_SCHED);
     int tag = F.coll_tag(p, fc->ctx + 1);
@@ -1596,6 +1690,17 @@ int fp_try_reduce(const void *sendbuf, void *recvbuf, int count,
             *out_rc = frc == 0 ? MPI_SUCCESS : fpc_flat_err(fc, frc);
             return 1;
         }
+        fseq = fpc_flat2_next(p, fc, nb);
+        if (fseq > 0) {
+            FPNT(p, 2, nb);
+            const void *sb = sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf;
+            int frc = F.flat2_reduce(p, fc->ctx + 1, fc->flat_lane,
+                                     rank, n, fseq, op, dt, root, sb,
+                                     rank == root ? recvbuf : NULL,
+                                     count, fpc_elsz(dt));
+            *out_rc = frc == 0 ? MPI_SUCCESS : fpc_flat_err(fc, frc);
+            return 1;
+        }
         FPNT(p, 1, nb);
         FPCTR(FPC_COLL_SCHED);
     }
@@ -1670,6 +1775,14 @@ int fp_try_barrier(MPI_Comm comm, int *out_rc) {
         FPNT(p, 0, nb);
         int frc = F.flat_barrier(p, fc->ctx + 1, fc->flat_lane, rank, n,
                                  fseq);
+        *out_rc = frc == 0 ? MPI_SUCCESS : fpc_flat_err(fc, frc);
+        return 1;
+    }
+    fseq = fpc_flat2_next(p, fc, 0);
+    if (fseq > 0) {
+        FPNT(p, 2, nb);
+        int frc = F.flat2_barrier(p, fc->ctx + 1, fc->flat_lane, rank,
+                                  n, fseq);
         *out_rc = frc == 0 ? MPI_SUCCESS : fpc_flat_err(fc, frc);
         return 1;
     }

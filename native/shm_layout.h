@@ -54,6 +54,47 @@
 #define MV2T_FLAT_FILE_LEN \
     (MV2T_FLAT_NREG * MV2T_FLAT_LANES * MV2T_FLAT_REG_STRIDE)
 
+/* ---- hierarchical flat tier + multicast bcast (<path>.fcoll2) --------
+ * Two-level leaders-of-k geometry for 8 < np <= MV2T_FLAT2_MAX_RANKS
+ * (cp_flat2_*): a region holds MV2T_FLAT2_NGROUPS + 1 sub-regions each
+ * shaped exactly like a flat region (header line + GROUP rank slots +
+ * one broadcast block, the same MV2T_FLAT_SLOT_STRIDE slot layout) —
+ * sub-region g < NGROUPS is group g's intra-group fold/fan-out arena,
+ * sub-region NGROUPS is the leaders-only exchange — plus a RING of
+ * MCAST_NBUF multicast buffers (each: payload byte count @0 of a
+ * 64-byte header line, payload @64; wave s publishes in buffer
+ * s % MCAST_NBUF) that a bcast root writes ONCE and every rank
+ * consumes under the seqlock wave discipline, the root running up to
+ * MCAST_NBUF waves ahead of the slowest reader (depth-bounded
+ * single-writer pipeline — no per-wave global rendezvous). The region
+ * header line carries the sticky poison word @0 and the region wave
+ * counter mseq @8 (the per-comm numbering base, release-stamped by
+ * every completed wave's coordinator). Runtime group width k
+ * (MV2T_FLAT2_GROUP env, cp_flat2_group()) may be < GROUP; the
+ * geometry below is the k = GROUP maximum every consumer maps. */
+#define MV2T_FLAT2_GROUP 8        /* max ranks per group (slots/sub-reg) */
+#define MV2T_FLAT2_NGROUPS 8      /* max groups (leaders sub-reg slots) */
+#define MV2T_FLAT2_MAX_RANKS (MV2T_FLAT2_GROUP * MV2T_FLAT2_NGROUPS)
+#define MV2T_FLAT2_MAX 4096       /* max payload bytes per wave */
+#define MV2T_FLAT2_REG_HDR 64     /* region header line (poison word) */
+#define MV2T_FLAT2_SUB_STRIDE \
+    (64 + (MV2T_FLAT2_GROUP + 1) * MV2T_FLAT_SLOT_STRIDE)
+#define MV2T_FLAT2_MCAST_NBUF 8   /* mcast pipeline depth (ring buffers) */
+#define MV2T_FLAT2_MCAST_STRIDE (64 + MV2T_FLAT2_MAX)
+#define MV2T_FLAT2_REG_STRIDE \
+    (MV2T_FLAT2_REG_HDR + (MV2T_FLAT2_NGROUPS + 1) * MV2T_FLAT2_SUB_STRIDE \
+     + MV2T_FLAT2_MCAST_NBUF * MV2T_FLAT2_MCAST_STRIDE)
+/* region index space: predefined contexts [0, 64) + the LOW window of
+ * the pooled allocator's ids (ids recycle lowest-first, so the working
+ * set of live comms lands here; a comm keyed past the window simply
+ * keeps the scheduled tier) */
+#define MV2T_FLAT2_SMALL_CTXS 64
+#define MV2T_FLAT2_MASK_CTXS 512
+#define MV2T_FLAT2_NREG (MV2T_FLAT2_SMALL_CTXS + MV2T_FLAT2_MASK_CTXS)
+#define MV2T_FLAT2_LANES 8
+#define MV2T_FLAT2_FILE_LEN \
+    (MV2T_FLAT2_NREG * MV2T_FLAT2_LANES * MV2T_FLAT2_REG_STRIDE)
+
 /* ---- native trace ring segment (<path>.ntrace) -----------------------
  * One lock-free single-process-writer event ring per local rank,
  * written by the MV2T_NTRACE(...) macro in cplane.cpp (one pointer
@@ -95,10 +136,19 @@ enum {
     NTE_EAGER_RX = 10,     /* C-plane eager match (a1 = src, a2 = bytes) */
     NTE_RNDV_TX = 11,      /* CMA rendezvous exposed (a1 = dst, a2 = bytes) */
     NTE_RNDV_RX = 12,      /* CMA rendezvous pulled (a1 = src, a2 = bytes) */
-    NTE_COLL_DISPATCH = 13 /* C-ABI collective tier pick (a1 = 0 flat /
-                            * 1 sched, a2 = bytes) */
+    NTE_COLL_DISPATCH = 13, /* C-ABI collective tier pick (a1 = 0 flat /
+                             * 1 sched, 2 flat2, 3 mcast; a2 = bytes) */
+    /* hierarchical flat tier (cp_flat2_*) wave phases */
+    NTE_FLAT2_FOLD = 14,   /* group leader folded its group (a1 = ctx,
+                            * a2 = seq) */
+    NTE_FLAT2_XCHG = 15,   /* leader exchange folded + stamped
+                            * (root leader only; a1 = ctx, a2 = seq) */
+    NTE_FLAT2_FANOUT = 16, /* this rank copied the wave result out */
+    NTE_MCAST_PUB = 17,    /* mcast root published the payload ONCE
+                            * (a1 = ctx, a2 = bytes) */
+    NTE_MCAST_CONS = 18    /* mcast reader consumed (a1 = ctx, a2 = seq) */
 };
-#define MV2T_NTE_COUNT 14
+#define MV2T_NTE_COUNT 19
 
 /* ---- fast-path observability counters (CPlane.fpctr) -----------------
  * Index order is load-bearing across three consumers: cplane.cpp and
@@ -117,7 +167,9 @@ enum {
     FPC_WAIT_SPIN = 8,     /* blocking waits satisfied during the spin */
     FPC_WAIT_BELL = 9,     /* blocking waits satisfied after doorbell sleep */
     FPC_FLAT_PROGRESS = 10, /* python progress callbacks from flat waits */
-    FPC_DEAD_PEER = 11     /* peers declared dead by the C lease scan */
+    FPC_DEAD_PEER = 11,    /* peers declared dead by the C lease scan */
+    FPC_COLL_FLAT2 = 12    /* collectives completed on the hierarchical
+                            * flat tier / multicast bcast (cp_flat2_*) */
 };
 #define MV2T_FPC_SLOTS 16  /* fpctr array length (spare slots included) */
 

@@ -1,0 +1,119 @@
+"""Rank program: python-API correctness sweep of the HIERARCHICAL
+flat tier + multicast bcast (coll/flatcoll.py -> cp_flat2_*), the
+np > 8 sibling of flatpy_sweep_prog.py. Run at np in {9..64}.
+
+Covers: allreduce/reduce/bcast/barrier across ops x dtypes x sizes
+straddling the flat2 payload max (4 KiB) and the group boundaries
+(counts chosen so k does and does not divide np at the default k=8 and
+under MV2T_FLAT2_GROUP overrides), long pipelined bcast streams from
+rotating roots (the mcast ring's depth > MCAST_NBUF), dup'd and split
+comms (split halves of np >= 18 land back in the flat2 window; smaller
+halves exercise the flat<->flat2 dispatch split), and context reuse.
+Asserts the flat2 tier actually carried work (fp_coll_flat2 moved) so
+the sweep cannot silently pass on a fallback.
+
+Launched via: python -m mvapich2_tpu.run -np N tests/progs/flat2_sweep_prog.py
+"""
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from mvapich2_tpu import mpi                        # noqa: E402
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+rank, size = comm.rank, comm.size
+errs = 0
+
+# int32 element counts straddling the 4 KiB flat2 max (1024 elements)
+COUNTS = (1, 7, 64, 1023, 1024, 1025, 4096)
+OPS = ((mpi.SUM, "sum"), (mpi.MAX, "max"), (mpi.MIN, "min"))
+
+
+def sweep(c):
+    global errs
+    n, r_ = c.size, c.rank
+    for cnt in COUNTS:
+        s = (np.arange(cnt) % 97 + r_ + 1).astype(np.int32)
+        out = np.zeros(cnt, np.int32)
+        c.allreduce(s, out)
+        want = (np.arange(cnt) % 97 + 1).astype(np.int64) * n \
+            + n * (n - 1) // 2
+        if not np.array_equal(out.astype(np.int64), want):
+            errs += 1
+            print(f"rank {r_}: allreduce sum cnt={cnt} wrong")
+    for dt in (np.int32, np.float64, np.int64, np.uint8):
+        for op, _name in OPS:
+            s = (np.arange(17) % 5 + r_ + 1).astype(dt)
+            out = np.zeros(17, dt)
+            c.allreduce(s, out, op)
+            ref = np.stack([(np.arange(17) % 5 + rr + 1).astype(dt)
+                            for rr in range(n)])
+            want = {mpi.SUM: ref.sum(0, dtype=dt),
+                    mpi.MAX: ref.max(0), mpi.MIN: ref.min(0)}[op]
+            if not np.array_equal(out, want):
+                errs += 1
+                print(f"rank {r_}: allreduce {_name} {dt.__name__} wrong")
+    # reduce to group-boundary roots (group leaders AND mid-group
+    # members at the default k=8), bcast from rotating roots, barriers
+    roots = sorted({0, 1, n - 1, min(8, n - 1), min(9, n - 1)})
+    for root in roots:
+        s = np.full(9, r_ + 2, np.int64)
+        out = np.zeros(9, np.int64)
+        c.reduce(s, out, mpi.SUM, root)
+        if r_ == root and not np.all(out == sum(x + 2 for x in range(n))):
+            errs += 1
+            print(f"rank {r_}: reduce root={root} wrong")
+        b = np.full(33, root + 7, np.int32) if r_ == root \
+            else np.zeros(33, np.int32)
+        c.bcast(b, root)
+        if not np.all(b == root + 7):
+            errs += 1
+            print(f"rank {r_}: bcast root={root} wrong")
+        c.barrier()
+    # pipelined mcast stream: one root, > MCAST_NBUF consecutive waves
+    # with per-wave payloads (a stale or torn ring buffer shows up as a
+    # wrong wave's value), lengths crossing the buffer header path
+    for i in range(20):
+        nb = (i % 3 + 1) * 128
+        b = np.full(nb, i * 11 + 3, np.int32) if r_ == 0 \
+            else np.zeros(nb, np.int32)
+        c.bcast(b, 0)
+        if not np.all(b == i * 11 + 3):
+            errs += 1
+            print(f"rank {r_}: mcast stream wave {i} wrong")
+
+
+sweep(comm)
+
+dup = comm.dup()
+sweep(dup)
+dup.free()
+
+if size >= 2:
+    half = comm.split(rank % 2, rank)
+    sweep(half)
+    half.free()
+    # context reuse: the freed id returns; renumbering must be clean
+    half2 = comm.split(rank % 2, rank)
+    sweep(half2)
+    half2.free()
+
+# the flat2 tier must actually have carried the small ops
+pch = getattr(comm.u, "plane_channel", None)
+if pch is not None and pch.plane \
+        and pch._ring.lib.cp_flat2_ok(pch.plane):
+    flat2 = pch.fp_counter(12)    # FPC_COLL_FLAT2
+    if flat2 < 20:
+        errs += 1
+        print(f"rank {rank}: flat2 tier not exercised "
+              f"(fp_coll_flat2={flat2})")
+
+total = np.zeros(1, np.int32)
+comm.allreduce(np.full(1, errs, np.int32), total)
+if rank == 0:
+    print("No Errors" if total[0] == 0 else f"{total[0]} errors")
+mpi.Finalize()
+sys.exit(1 if total[0] else 0)

@@ -154,13 +154,21 @@ def test_sweep_stale_segment():
     # a pid that cannot exist (> pid_max)
     dead = os.path.join(d, "mv2t-arena-99999999-deadbeef")
     open(dead, "wb").close()
+    # ring stems + dotted siblings of a crashed leader sweep too (the
+    # sparse .fcoll/.fcoll2 segments' touched pages are real tmpfs)
+    dead_ring = os.path.join(d, "mv2t-shm-99999999-deadbeef")
+    dead_f2 = dead_ring + ".fcoll2"
+    open(dead_ring, "wb").close()
+    open(dead_f2, "wb").close()
     live = os.path.join(d, f"mv2t-arena-{os.getpid()}-cafecafe")
     open(live, "wb").close()
     other = os.path.join(d, "unrelated-file")
     open(other, "wb").close()
     n = ShmArena.sweep_stale(d)
-    assert n == 1
+    assert n == 3
     assert not os.path.exists(dead)
+    assert not os.path.exists(dead_ring)
+    assert not os.path.exists(dead_f2)
     assert os.path.exists(live)
     assert os.path.exists(other)
     for p in (live, other):

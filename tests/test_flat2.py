@@ -1,0 +1,168 @@
+"""Hierarchical flat tier + multicast bcast correctness (ISSUE 11).
+
+The np > 8 sibling of test_flatcoll.py, over the SAME one cp_flat2_*
+engine in cplane.cpp from both ABIs:
+
+- flat2_sweep_prog.py through the python API (coll/flatcoll.py):
+  allreduce/reduce/bcast/barrier x ops x dtypes x group-boundary
+  sizes/roots, pipelined mcast streams, dup/split/ctx-reuse, and a
+  tier-usage assertion (fp_coll_flat2 moved);
+- flatcoll_test.c through the unmodified C ABI (fastpath.c
+  fpc_flat2_next dispatch);
+- a mid-wave LEADER-crash chaos job (native flat_fold site fires
+  inside cp_flat2_*): survivors must lease-detect, poison the flat2
+  region, unwind with MPIX_ERR_PROC_FAILED, and recover on a shrunken
+  comm whose tier/lane re-derive from the surviving membership
+  (extends the PR 6 _rekey_flat path to tier 2).
+
+np in {9, 12(k=4), 16} runs tier-1; np in {24, 64} and the C-ABI
+np=16 sweep ride the slow lane.
+"""
+
+import os
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MPICC = os.path.join(REPO, "bin", "mpicc")
+PY_PROG = os.path.join(REPO, "tests", "progs", "flat2_sweep_prog.py")
+CHAOS_PROG = os.path.join(REPO, "tests", "progs", "chaos_prog.py")
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("gcc") is None or shutil.which("python3-config") is None,
+    reason="no C toolchain")
+
+
+def _mpirun(np_, *cmd, timeout=420, env_extra=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if env_extra:
+        env.update(env_extra)
+    r = subprocess.run([sys.executable, "-m", "mvapich2_tpu.run", "-np",
+                        str(np_), *cmd], cwd=REPO, capture_output=True,
+                       text=True, timeout=timeout, env=env)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout, \
+        f"stdout={r.stdout}\nstderr={r.stderr}"
+    return r
+
+
+@pytest.fixture(scope="module")
+def flat_c_prog():
+    out = os.path.join(tempfile.mkdtemp(), "flatcoll_test")
+    src = os.path.join(REPO, "tests", "progs", "flatcoll_test.c")
+    r = subprocess.run([MPICC, src, "-o", out], capture_output=True,
+                       text=True, timeout=180)
+    assert r.returncode == 0, f"mpicc failed:\n{r.stdout}\n{r.stderr}"
+    return out
+
+
+# -- python-API sweeps ----------------------------------------------------
+
+@pytest.mark.parametrize("np_", [9, 16])
+def test_flat2_sweep_python(np_):
+    _mpirun(np_, sys.executable, PY_PROG)
+
+
+def test_flat2_sweep_python_group_width_4():
+    """MV2T_FLAT2_GROUP=4: 12 ranks = 3 groups of 4 — the leaders-of-k
+    geometry at a non-default k, including a k that does not divide
+    np at the split halves (6 = flat tier)."""
+    _mpirun(12, sys.executable, PY_PROG,
+            env_extra={"MV2T_FLAT2_GROUP": "4"})
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("np_", [24, 64])
+def test_flat2_sweep_python_wide(np_):
+    _mpirun(np_, sys.executable, PY_PROG, timeout=900)
+
+
+# -- C-ABI sweeps (flatcoll_test.c is np-generic; at np > 8 the world
+#    comm and its dup ride the flat2 tier, split halves the flat tier) --
+
+def test_flat2_sweep_cabi_np9(flat_c_prog):
+    _mpirun(9, flat_c_prog, timeout=600)
+
+
+@pytest.mark.slow
+def test_flat2_sweep_cabi_np16(flat_c_prog):
+    _mpirun(16, flat_c_prog, timeout=900)
+
+
+# -- kill switch ----------------------------------------------------------
+
+def test_flat2_kill_switch_falls_back_to_sched():
+    """MV2T_FLAT2=0 stands the tier down unanimously at attach; the
+    sweep (minus its tier-usage assertion, which gates on cp_flat2_ok)
+    must pass on the scheduled tier."""
+    _mpirun(9, sys.executable, PY_PROG,
+            env_extra={"MV2T_FLAT2": "0"})
+
+
+# -- leader-crash chaos (extends PR 6 _rekey_flat to tier 2) -------------
+
+def _chaos(np_, faults_spec, timeout=240):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               MV2T_FAULTS=faults_spec,
+               MV2T_CHAOS_PHASES="flat",
+               MV2T_PEER_TIMEOUT="2.0",
+               MV2T_FT_WATCHER="0",
+               MPIEXEC_ALLOW_FAULT="1")
+    r = subprocess.run(
+        [sys.executable, "-m", "mvapich2_tpu.run", "-np", str(np_),
+         sys.executable, CHAOS_PROG],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=timeout)
+    assert r.returncode == 0, \
+        f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "No Errors" in r.stdout, \
+        f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    pat = re.compile(r"chaos: rank=(\d+) phase=(\S+) err=(\S+) "
+                     r"detect_s=([\d.]+) shrunk=(\d+)")
+    return [m.groups() for m in pat.finditer(r.stdout)]
+
+
+def test_flat2_leader_crash_rekeys_and_recovers():
+    """Rank 0 — the ROOT LEADER of the two-level wave (group 0's
+    leader and the leaders-exchange folder) — crash-selfs inside a
+    flat2 wave via the native flat_fold site. Survivors' flat2 waits
+    must lease-detect within the deadline, sticky-poison the region,
+    unwind with MPIX_ERR_PROC_FAILED (err=75), and recover on the
+    shrunken np=8 comm — which re-keys onto the FLAT tier with a lane
+    re-derived from the surviving membership."""
+    lines = _chaos(9, "flat_fold@0:crash:1:5")
+    saw = False
+    for _rank, phase, err, detect_s, shrunk in lines:
+        if err != "None":
+            saw = True
+            assert err == "75", lines         # MPIX_ERR_PROC_FAILED
+            assert phase == "flat"
+            assert float(detect_s) < 24.0, lines   # 2x timeout + slack
+            assert shrunk == "8", lines
+    assert saw, f"no survivor saw the leader failure: {lines}"
+
+
+@pytest.mark.chaos
+def test_flat2_group_leader_crash_np16():
+    """A NON-root group leader (rank 8 = group 1's leader at k=8) dies
+    mid-wave: the root leader's exchange wait and group 1's members'
+    fan-out waits both unwind; survivors shrink to 15 and stay on the
+    flat2 tier (15 > 8) with a fresh region."""
+    lines = _chaos(16, "flat_fold@8:crash:1:5", timeout=420)
+    assert any(err == "75" and shrunk == "15"
+               for _r, _p, err, _d, shrunk in lines), lines
+
+
+@pytest.mark.chaos
+def test_flat2_member_crash_np16():
+    """A plain member (rank 5, mid-group) dies mid-wave; its group
+    leader's fold wait unwinds and containment proceeds as above."""
+    lines = _chaos(16, "flat_fold@5:crash:1:5", timeout=420)
+    assert any(err == "75" and shrunk == "15"
+               for _r, _p, err, _d, shrunk in lines), lines

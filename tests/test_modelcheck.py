@@ -19,7 +19,7 @@ lint/tsan lanes complement.
 import pytest
 
 from mvapich2_tpu.analysis import model as M
-from mvapich2_tpu.analysis.model import doorbell, lease, seqlock
+from mvapich2_tpu.analysis.model import doorbell, flat2, lease, seqlock
 
 pytestmark = pytest.mark.lint
 
@@ -43,13 +43,22 @@ CLEAN = [
     ("lease", lambda: lease.build()),
     ("lease-crash", lambda: lease.build(crash=True)),
     ("lease-depart", lambda: lease.build(depart=True)),
+    # hierarchical flat tier + pipelined multicast bcast (cp_flat2_*)
+    ("flat2-hier-2x2", lambda: flat2.build_hier_allreduce(2, 2)),
+    ("flat2-hier-2x2-crash", lambda: flat2.build_hier_allreduce(
+        2, 2, crash=True)),
+    ("flat2-hier-3x2", lambda: flat2.build_hier_allreduce(3, 2)),
+    ("flat2-mcast", lambda: flat2.build_mcast(3, 2, 1)),
+    ("flat2-mcast-deep", lambda: flat2.build_mcast(3, 3, 2)),
 ]
 
 EXPECTED_INVARIANT = {
     # mutation -> invariant(s) that must name the bug
     "stamp_before_copy": {"no-torn-read-delivered"},
     "no_reader_guard": {"no-torn-read-delivered", "agreement"},
-    "no_overwrite_guard": {"no-torn-read-delivered"},
+    # seqlock leader fold / flat2 mcast ring share the mutation name;
+    # each model names the tear through its own invariant
+    "no_overwrite_guard": {"no-torn-read-delivered", "mcast-data"},
     "no_poison": {"poison-sticky", "no-torn-read-delivered"},
     "no_arrival_wave": {"deadlock"},
     "no_final_poll": {"no-lost-wake", "deadlock"},
@@ -57,6 +66,11 @@ EXPECTED_INVARIANT = {
     "departed_stale": {"no-false-positive"},
     "throttle_too_long": {"detect-within-deadline"},
     "inverted_compare": {"detect-within-deadline"},
+    # flat2 hierarchical wave + multicast bcast
+    "xchg_no_guard": {"no-torn-read-delivered", "agreement"},
+    "fanout_before_xchg": {"agreement", "deadlock"},
+    "publish_before_write": {"mcast-data"},
+    "no_first_sync": {"deadlock"},
 }
 
 

@@ -260,18 +260,23 @@ def test_fastpath_category():
     counters shared by the C ABI's fastpath.c and the python flat
     collective tier, plus the FP_COLL_MAX collective-tier cap cvar
     under "coll"."""
+    import mvapich2_tpu.coll.tuning    # noqa: F401  (declares coll cvars)
     import mvapich2_tpu.transport.shm  # noqa: F401  (declares fp pvars)
     cats = mpit.category_names()
     assert "fastpath" in cats
     info = mpit.category_get_info(cats.index("fastpath"))
     for pv in ("fp_hits", "fp_gil_takes", "fp_fallback_dtype",
                "fp_fallback_comm", "fp_fallback_size",
-               "fp_fallback_plane", "fp_coll_flat", "fp_coll_sched",
-               "fp_wait_spin", "fp_wait_bell", "fp_flat_progress"):
+               "fp_fallback_plane", "fp_coll_flat", "fp_coll_flat2",
+               "fp_coll_sched", "fp_wait_spin", "fp_wait_bell",
+               "fp_flat_progress"):
         assert pv in info["pvars"], pv
         assert mpit._pvars.get(pv).klass == mpit.PVAR_CLASS_COUNTER
     cinfo = mpit.category_get_info(cats.index("coll"))
     assert "FP_COLL_MAX" in cinfo["cvars"]
+    # hierarchical flat2 tier cvars (ISSUE 11)
+    assert "FLAT2" in cinfo["cvars"]
+    assert "FLAT2_GROUP" in cinfo["cvars"]
 
 
 def test_fastpath_pvars_observable():
