@@ -4,14 +4,19 @@ Two halves, one goal — catch the races and deadlocks that accumulate in
 cross-process shm datapaths (PAPER.md §L3/L4) at lint time instead of in
 a 4-rank hang:
 
-  * ``bin/mv2tlint`` — an AST-based static checker with five pluggable
+  * ``bin/mv2tlint`` — an AST-based static checker with pluggable
     passes over the whole package (core.py drives; one module per pass):
 
         locks       guarded-by lock discipline (# guarded-by: _lock)
         tags        tag-namespace disjointness (*_TAG_BASE ranges)
         pvars       pvar/cvar registry consistency + naming convention
+                    + the native/bin/README env-drift doctor
         blocking    no blocking calls in progress callbacks/pkt handlers
         traceguard  every trace site behind the one-attribute-check idiom
+        native      C-plane atomic discipline + cross-language layout
+        device      Pallas DMA/semaphore discipline (copy/wait pairing,
+                    pending-map drains, credit gates, VMEM budgets)
+        profile     tuning-table shape + arch-profile JSON schema
 
     Findings ratchet down through a committed suppressions file
     (analysis/baseline.json); ``--strict`` additionally fails on STALE

@@ -67,14 +67,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="machine-readable findings on stdout")
     opts = ap.parse_args(argv)
 
-    # explicit paths: C/C++ files route to the native pass, .py files to
-    # the AST passes; with no paths the native pass lints the committed
-    # native tree (+ the cross-language layout check)
+    # explicit paths: C/C++ files route to the native pass, .json files
+    # to the profile doctor, .py files to the AST passes; with no paths
+    # the native pass lints the committed native tree (+ the
+    # cross-language layout check) and the profile doctor the committed
+    # profiles/ directory
     c_exts = (".c", ".cpp", ".cc", ".h", ".hpp")
     c_paths = [p for p in (opts.paths or []) if p.endswith(c_exts)]
-    py_paths = [p for p in (opts.paths or []) if not p.endswith(c_exts)]
+    json_paths = [p for p in (opts.paths or []) if p.endswith(".json")]
+    py_paths = [p for p in (opts.paths or [])
+                if not p.endswith(c_exts + (".json",))]
     if opts.paths:
-        passes = all_passes(native_sources=c_paths, native_layout=False)
+        # fixture mode: the committed doc / profile surfaces stay out of
+        # the finding set so counts only reflect the given paths
+        passes = all_passes(native_sources=c_paths, native_layout=False,
+                            doc_sources=[], profile_files=json_paths,
+                            device_profiles=[])
     else:
         passes = all_passes()
     if opts.list_passes:

@@ -150,20 +150,37 @@ def scan_paths(paths: Sequence[str]) -> Tuple[List[SourceModule], List[Finding]]
 
 
 def all_passes(native_sources: Optional[Sequence[str]] = None,
-               native_layout: bool = True) -> List[LintPass]:
+               native_layout: bool = True,
+               doc_sources: Optional[Sequence[str]] = None,
+               profile_files: Optional[Sequence[str]] = None,
+               device_profiles: Optional[Sequence[str]] = None) -> List[LintPass]:
     """The full pass set. ``native_sources`` overrides the C file set of
     the native pass (fixture tests); None = the committed native tree.
     ``native_layout`` gates the cross-language layout check (only
-    meaningful against the real repo)."""
-    from . import blocking, locks, native, registry, tags, traceguard
+    meaningful against the real repo). ``doc_sources`` overrides the
+    non-python surfaces of the env-drift doctor (native getenv / bin /
+    README; [] disables it for fixture runs); ``profile_files`` /
+    ``device_profiles`` override the tuning-profile JSON set of the
+    profile doctor and the device pass's VMEM-budget estimator."""
+    from . import (blocking, device, locks, native, profilecheck, registry,
+                   tags, traceguard)
     return [locks.LockDisciplinePass(), tags.TagNamespacePass(),
-            registry.RegistryPass(), blocking.BlockingCallPass(),
+            registry.RegistryPass(
+                doc_sources=list(doc_sources)
+                if doc_sources is not None else None),
+            blocking.BlockingCallPass(),
             traceguard.TraceGuardPass(
                 list(native_sources) if native_sources is not None
                 else None),
             native.NativeSourcePass(
                 list(native_sources) if native_sources is not None else None,
-                layout=native_layout)]
+                layout=native_layout),
+            device.DevicePass(
+                profiles=list(device_profiles)
+                if device_profiles is not None else None),
+            profilecheck.ProfileDoctorPass(
+                profile_files=list(profile_files)
+                if profile_files is not None else None)]
 
 
 def run_passes(modules: List[SourceModule],

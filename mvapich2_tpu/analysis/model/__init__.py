@@ -16,13 +16,20 @@ interleaving the memory model allows:
   * death detected within 2x timeout,
     clean departure never a failure     (lease.build)
 
+The device lane rides the same net: ``ici.build_ring`` models the
+chunk-credit flow control of the HBM-streaming remote-DMA engine
+(ops/pallas_ici.py) — the handshake the jax<0.5 interpreter can never
+execute — proving no-slot-collision, no-lost-credit, agreement and
+deadlock freedom for uni- and bidirectional rings under the
+global-chunk-counter slot schedule.
+
 Every model takes ``mutation=<name>`` seeding a realistic protocol
 break (stamp-before-copy, missing final poll, throttle past the
 deadline, ...); tests/test_modelcheck.py asserts the checker catches
 each one and that the unmutated models are violation-free.
 """
 
-from . import doorbell, flat2, lease, seqlock  # noqa: F401
+from . import doorbell, flat2, ici, lease, seqlock  # noqa: F401
 from .explorer import Model, Result, Transition, Violation, explore  # noqa: F401
 
 
@@ -78,4 +85,24 @@ def mutation_matrix():
         ("flat2-mcast", lambda: flat2.build_mcast(
             n=3, waves=1, nbuf=1, mutation="no_first_sync"),
          "no_first_sync"),
+        # chunk-credit remote-DMA ring (ops/pallas_ici.py)
+        ("ici-ring", lambda: ici.build_ring(
+            n=2, chunks=4, depth=2, mutation="no_credit_wait"),
+         "no_credit_wait"),
+        ("ici-ring", lambda: ici.build_ring(
+            n=2, chunks=2, depth=2, mutation="slot_off_by_one"),
+         "slot_off_by_one"),
+        ("ici-ring", lambda: ici.build_ring(
+            n=2, chunks=2, depth=2, mutation="depth_mismatch"),
+         "depth_mismatch"),
+        ("ici-ring", lambda: ici.build_ring(
+            n=2, chunks=2, depth=2, mutation="signal_before_copy"),
+         "signal_before_copy"),
+        ("ici-ring", lambda: ici.build_ring(
+            n=3, chunks=2, depth=2, bidir=True,
+            mutation="bidir_shared_slot"),
+         "bidir_shared_slot"),
+        ("ici-ring", lambda: ici.build_ring(
+            n=2, chunks=2, depth=2, mutation="recv_before_send_wave"),
+         "recv_before_send_wave"),
     ]

@@ -1,0 +1,284 @@
+"""Chunk-credit model of the HBM-streaming ICI ring (ops/pallas_ici.py).
+
+The credit handshake of the chunked remote-DMA engine has NEVER
+executed: the jax<0.5 interpreter is creditless (no remote semaphore
+signal), so every interpreter run since PR 8 validated the data
+schedule but not the flow control. This model is the handshake's
+verification net before the first TPU host run — the device analog of
+the seqlock/doorbell/lease models PR 7 built for the host shm
+protocols.
+
+The protocol, reduced to its transport skeleton: each rank streams C
+chunks per ring direction into its downstream neighbor's D-deep VMEM
+slot array, the slot sequence driven by a single **global chunk counter
+per direction** — write ``k`` lands in slot ``k % D``, which is exactly
+the slot freed by consume ``k - D`` ("write k+D lands in the slot freed
+by consume k"). Flow control is ``D`` credits per direction: the sender
+takes a credit before the remote DMA of chunk ``k`` and the receiver
+re-grants one as it consumes a slot, so a sender runs at most ``D``
+chunks ahead and slot reuse needs no per-slot handshake.
+
+Each rank executes the *serialized* program ``stream_step`` actually
+runs (one instruction stream per kernel instance): per chunk index
+``c`` it issues ``c`` on every direction, then drains ``c-1`` on every
+direction. Concurrency comes from rank interleaving and, under the
+``signal_before_copy`` mutation, from the split-landing DMA actor. The
+clean model lands payload + recv-semaphore signal atomically at issue
+time — signal-after-data is a hardware guarantee, and landing as early
+as possible is adversarial for the collision invariant (a later landing
+only gives the consumer more time), so the abstraction is sound.
+
+What the model proves (exhaustively, within N x C x D bounds, uni- and
+bidirectional):
+
+  * **no-slot-collision** — no remote write ever lands in a slot whose
+    previous chunk is unconsumed;
+  * **no-lost-credit** — per (sender, direction), credits held plus
+    chunks in flight always equals exactly D (no leak, no over-grant);
+  * **agreement** — every delivered chunk is exactly the upstream
+    contribution for that index: no tears, no stale slots, no
+    cross-direction mixing;
+  * **no-deadlock** — the wave always completes (explorer built-in).
+
+What it cannot prove: the VPU fold arithmetic and the multi-round
+reduce-scatter block rotation (interpreter-proven: the 0.4.x emulator
+is deterministic dataflow), and Mosaic's lowering of the semaphore ops
+themselves — those wait for the first TPU host (ROADMAP item 1).
+
+Mutations (tests/test_modelcheck.py asserts every one is caught by a
+named invariant):
+
+  no_credit_wait        the sender skips the credit take — it runs past
+                        D chunks ahead and overwrites an unconsumed slot
+  slot_off_by_one       writes land in slot (k+1) % D — the receiver
+                        waits forever on slot k % D (the one-counter
+                        slot discipline, broken)
+  depth_mismatch        sender boots with D+1 credits against D slots
+                        (a chunk/depth retune applied to one side only)
+  signal_before_copy    recv semaphore signaled before the payload
+                        lands — the receiver folds a torn chunk
+  bidir_shared_slot     both ring directions mapped onto one slot array
+                        (the bidir lanes must be disjoint)
+  recv_before_send_wave the receiver consumes without waiting the recv
+                        semaphore — it folds a stale/empty slot
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .explorer import Model, Transition
+from .seqlock import TORN
+
+_FREE = -1     # slot occupant sentinel: never written
+
+
+def _program(C: int, dirs):
+    """The serialized per-rank instruction stream of stream_step:
+    issue c on every direction, then drain c-1; trailing drains of the
+    last chunk close the wave."""
+    prog = []
+    for c in range(C):
+        for d in dirs:
+            prog.append(("issue", c, d))
+        if c >= 1:
+            for d in dirs:
+                prog.append(("drain", c - 1, d))
+    for d in dirs:
+        prog.append(("drain", C - 1, d))
+    return prog
+
+
+def build_ring(n: int = 2, chunks: int = 2, depth: int = 2,
+               bidir: bool = False,
+               mutation: Optional[str] = None) -> Model:
+    """``n`` ranks stream ``chunks`` chunks per direction through
+    ``depth``-deep slot arrays with ``depth`` credits. ``bidir`` adds
+    the counter-clockwise lane (disjoint slots/credits — except under
+    the ``bidir_shared_slot`` mutation, where both lanes share array 0
+    at every receiver)."""
+    assert n >= 2 and chunks >= 1 and depth >= 1
+    C, D = chunks, depth
+    dirs = (0, 1) if bidir else (0,)
+    if mutation == "bidir_shared_slot":
+        assert bidir, "bidir_shared_slot needs the ccw lane"
+    prog = _program(C, dirs)
+    # issued/drained counts per (pc, dir) — for the credit invariant
+    issued_at = [dict.fromkeys(dirs, 0)]
+    drained_at = [dict.fromkeys(dirs, 0)]
+    for op, _c, d in prog:
+        ni = dict(issued_at[-1])
+        nd = dict(drained_at[-1])
+        (ni if op == "issue" else nd)[d] += 1
+        issued_at.append(ni)
+        drained_at.append(nd)
+
+    def dst(r: int, d: int) -> int:
+        return (r + 1) % n if d == 0 else (r - 1 + n) % n
+
+    def up(r: int, d: int) -> int:
+        return (r - 1 + n) % n if d == 0 else (r + 1) % n
+
+    def slot_arr(d: int) -> int:
+        # the mutant collapses both lanes onto one receiver array
+        return 0 if mutation == "bidir_shared_slot" else d
+
+    arrays = sorted({slot_arr(d) for d in dirs})
+
+    init = {"collision": 0}
+    for r in range(n):
+        init[f"pc{r}"] = 0
+        for d in dirs:
+            init[f"cr{r}_{d}"] = D + 1 if mutation == "depth_mismatch" \
+                else D                    # credits held by the sender
+            init[f"wp{r}_{d}"] = None     # in-flight write (mutant only)
+            init[f"res{r}_{d}"] = ()      # delivered payloads, in order
+        for a in arrays:
+            for s in range(D):
+                # (occupant chunk, payload, signaled, consumed)
+                init[f"sl{r}_{a}_{s}"] = (_FREE, frozenset(), False, True)
+
+    def payload(r: int, k: int, d: int) -> frozenset:
+        return frozenset({(r, k, d)})
+
+    ts = []
+    for r in range(n):
+        for i, (op, c, d) in enumerate(prog):
+            def mk(r=r, i=i, op=op, c=c, d=d):
+                pc = f"pc{r}"
+                peer, upr = dst(r, d), up(r, d)
+                a = slot_arr(d)
+                cr, wp = f"cr{r}_{d}", f"wp{r}_{d}"
+                res = f"res{r}_{d}"
+                t = (c + 1) % D if mutation == "slot_off_by_one" \
+                    else c % D
+                wkey = f"sl{peer}_{a}_{t}"          # issue target
+                rkey = f"sl{r}_{a}_{c % D}"          # drain source
+
+                if op == "issue":
+                    def guard(s):
+                        if s[pc] != i or s[wp] is not None:
+                            return False
+                        if mutation == "no_credit_wait":
+                            return True
+                        return s[cr] > 0
+
+                    def apply(s):
+                        if mutation != "no_credit_wait":
+                            s[cr] -= 1
+                        occ, pay, sig, cons = s[wkey]
+                        if not cons:
+                            s["collision"] = 1       # sticky
+                        if mutation == "signal_before_copy":
+                            # MUTANT: hand-rolled signal before the
+                            # payload is on the wire — readable TORN
+                            s[wkey] = (c, TORN, True, False)
+                            s[wp] = c
+                        else:
+                            # hardware DMA: payload + signal atomic
+                            s[wkey] = (c, payload(r, c, d), True, False)
+                        s[pc] = i + 1
+                        return s
+
+                    return Transition(
+                        f"r{r}.issue{c}.d{d}", f"r{r}", guard, apply,
+                        frozenset({pc, wp, cr, wkey}),
+                        frozenset({pc, wp, cr, wkey, "collision"}))
+
+                def guard(s):
+                    if s[pc] != i:
+                        return False
+                    if mutation == "recv_before_send_wave":
+                        return True          # MUTANT: no recv-sem wait
+                    occ, pay, sig, cons = s[rkey]
+                    return occ == c and sig and not cons
+
+                def apply(s):
+                    occ, pay, sig, cons = s[rkey]
+                    s[res] = s[res] + (pay,)
+                    s[rkey] = (occ, pay, sig, True)
+                    s[f"cr{upr}_{d}"] += 1       # re-grant the credit
+                    s[pc] = i + 1
+                    return s
+
+                return Transition(
+                    f"r{r}.drain{c}.d{d}", f"r{r}", guard, apply,
+                    frozenset({pc, rkey}),
+                    frozenset({pc, rkey, res, f"cr{upr}_{d}"}))
+            ts.append(mk())
+
+        # the async landing actor of the split-write mutant
+        if mutation == "signal_before_copy":
+            for d in dirs:
+                def mkland(r=r, d=d):
+                    peer = dst(r, d)
+                    a = slot_arr(d)
+                    wp = f"wp{r}_{d}"
+                    skeys = frozenset(f"sl{peer}_{a}_{s}"
+                                      for s in range(D))
+
+                    def guard(s):
+                        return s[wp] is not None
+
+                    def apply(s):
+                        k = s[wp]
+                        key = f"sl{peer}_{a}_{k % D}"
+                        occ, pay, sig, cons = s[key]
+                        if occ == k and pay == TORN:
+                            s[key] = (k, payload(r, k, d), sig, cons)
+                        s[wp] = None
+                        return s
+
+                    return Transition(f"r{r}.land.d{d}", f"dma{r}_{d}",
+                                      guard, apply,
+                                      frozenset({wp}) | skeys,
+                                      frozenset({wp}) | skeys)
+                ts.append(mkland())
+
+    # ---- invariants --------------------------------------------------
+    end = len(prog)
+
+    def inv_collision(s):
+        if s["collision"]:
+            return ("a remote write landed in a slot whose previous "
+                    "chunk was not consumed")
+        return None
+
+    def inv_credit(s):
+        for r in range(n):
+            for d in dirs:
+                issued = issued_at[s[f"pc{r}"]][d]
+                outstanding = issued - drained_at[s[f"pc{dst(r, d)}"]][d]
+                cr = s[f"cr{r}_{d}"]
+                if cr + outstanding != D:
+                    return (f"rank {r} dir {d}: credits {cr} + "
+                            f"in-flight {outstanding} != depth {D}")
+                if cr > D:
+                    return (f"rank {r} dir {d}: over-credit {cr} > "
+                            f"depth {D}")
+        return None
+
+    def inv_agree(s):
+        for r in range(n):
+            for d in dirs:
+                src = up(r, d)
+                for i, pay in enumerate(s[f"res{r}_{d}"]):
+                    if pay == TORN:
+                        return (f"rank {r} dir {d} folded a TORN "
+                                f"chunk {i}")
+                    if pay != payload(src, i, d):
+                        return (f"rank {r} dir {d} chunk {i} delivered "
+                                f"{sorted(pay)} != the upstream "
+                                "contribution")
+        return None
+
+    def final(s):
+        return all(s[f"pc{r}"] == end for r in range(n))
+
+    label = (f"ici-ring(n={n},C={C},D={D},"
+             f"{'bidir' if bidir else 'uni'},mut={mutation})")
+    return Model(label, init, ts,
+                 [("no-slot-collision", inv_collision),
+                  ("no-lost-credit", inv_credit),
+                  ("agreement", inv_agree)],
+                 final)

@@ -1,0 +1,381 @@
+"""Pass ``profile`` — the tuning-table / arch-profile doctor.
+
+The reference generates 1,377 per-arch tuning headers offline and
+trusts them forever; this repo's tables are data (coll/tuning.py
+DEFAULT_TABLES + the measured JSON profiles under profiles/), which
+means a drifted edge or a typo'd algorithm name is a silent mis-route,
+not a compile error — the r5 64 KiB allreduce cliff was exactly a
+table constant drifting away from the protocol threshold it mirrored.
+Three invariant families, all static:
+
+  * **table shape** — every collective's tuning table carries every
+    comm-size class (the classes are harvested from ``_size_class``,
+    their single source of truth), and every class's bins are total,
+    disjoint and monotone: strictly increasing resolved edges, exactly
+    one open (``None``) top bin, every algorithm name registered in
+    ``ALGOS`` for that collective.
+  * **symbolic edges** — a string edge ("eager", "coll_max",
+    "dev_tier_vmem_max", ...) must be a symbol ``_resolve_edge``
+    actually resolves (harvested from its comparisons) so a renamed
+    threshold cannot leave a dangling alias behind.
+  * **profile schema** — every committed ``mv2t-tuning-profile-v1``
+    JSON under profiles/ has only known keys: collectives/classes/rows
+    as above, ``device_crossovers`` keyed by collective or dev_tier_*
+    edge with sane integer values (``dev_tier_vmem_max`` may not exceed
+    the hard VMEM wrapper cap of ops/pallas_ring.py), ``kernel_params``
+    keyed only by parameters some kernel actually fetches (harvested
+    from the ``kernel_param``/``_tuned_default`` call sites), and a
+    filename that matches the arch key it claims — a mismatched name
+    would simply never auto-load. The first REAL TPU profile commit
+    (ROADMAP item 1) is validated by this pass, mechanically.
+
+Everything is parsed from source/JSON — no package import, so the pass
+runs in the same process-free mode as the native layout doctor.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional, Set
+
+from .core import (Finding, LintPass, PKG_ROOT, REPO_ROOT, SourceModule,
+                   const_int)
+
+PROFILE_DIR = os.path.join(PKG_ROOT, "profiles")
+TUNING_PATH = os.path.join(PKG_ROOT, "coll", "tuning.py")
+RING_PATH = os.path.join(PKG_ROOT, "ops", "pallas_ring.py")
+FORMAT_V1 = "mv2t-tuning-profile-v1"
+
+_PROFILE_KEYS = {"tables", "device_crossovers", "kernel_params",
+                 "raw", "raw_device_tiers"}
+_DOC_KEYS = {"arch_key", "format", "profile", "comment"}
+_DEV_TIER_KEYS = {"dev_tier_vmem_max", "dev_tier_xla_min"}
+
+
+def _load_module(path: str) -> Optional[SourceModule]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return SourceModule(path, f.read())
+    except (OSError, SyntaxError):
+        return None
+
+
+class _TuningFacts:
+    """Statically harvested single-sources-of-truth from coll/tuning.py
+    (+ the kernel-param consumers and the VMEM wrapper cap)."""
+
+    def __init__(self, modules: List[SourceModule]):
+        self.tables: Dict[str, Dict[str, list]] = {}
+        self.tables_line = 0
+        self.algos: Dict[str, Set[str]] = {}
+        self.symbols: Set[str] = set()
+        self.classes: Set[str] = set()
+        self.kernel_params: Set[str] = set()
+        self.vmem_limit: Optional[int] = None
+        self.tuning_mod: Optional[SourceModule] = None
+
+        by_suffix = {m.relpath: m for m in modules}
+
+        def find(suffix: str) -> Optional[SourceModule]:
+            for rel, m in by_suffix.items():
+                if rel.endswith(suffix):
+                    return m
+            return None
+
+        tuning = find("tuning.py") or _load_module(TUNING_PATH)
+        ring = find("ops/pallas_ring.py") or _load_module(RING_PATH)
+        self.tuning_mod = tuning
+        if tuning is not None:
+            self._harvest_tuning(tuning)
+        if ring is not None:
+            for node in ast.walk(ring.tree):
+                if isinstance(node, ast.Assign) \
+                        and any(isinstance(t, ast.Name)
+                                and t.id == "VMEM_LIMIT_BYTES"
+                                for t in node.targets):
+                    self.vmem_limit = const_int(node.value)
+        # kernel-param consumers anywhere in the scanned set (falling
+        # back to the committed ops/ tree when linting fixtures)
+        param_mods = [m for m in modules] or []
+        if not any("ops/" in m.relpath for m in param_mods):
+            for name in ("pallas_ici.py", "pallas_hbm.py"):
+                m = _load_module(os.path.join(PKG_ROOT, "ops", name))
+                if m is not None:
+                    param_mods.append(m)
+        for m in param_mods:
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.Call):
+                    fn = node.func
+                    nm = fn.attr if isinstance(fn, ast.Attribute) else \
+                        (fn.id if isinstance(fn, ast.Name) else None)
+                    if nm in ("kernel_param", "_tuned_default") \
+                            and node.args \
+                            and isinstance(node.args[0], ast.Constant) \
+                            and isinstance(node.args[0].value, str):
+                        self.kernel_params.add(node.args[0].value)
+
+    # ------------------------------------------------------------------
+    def _harvest_tuning(self, mod: SourceModule) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.AnnAssign) and node.value is not None:
+                # normalize `X: T = {...}` to the Assign shape below
+                node = ast.copy_location(
+                    ast.Assign(targets=[node.target], value=node.value),
+                    node)
+            if isinstance(node, ast.Assign) and node.targets:
+                t = node.targets[0]
+                if isinstance(t, ast.Name) and t.id == "DEFAULT_TABLES":
+                    self.tables = self._eval_tables(node.value)
+                    self.tables_line = node.lineno
+                elif isinstance(t, ast.Name) and t.id == "ALGOS" \
+                        and isinstance(node.value, ast.Dict):
+                    for k, v in zip(node.value.keys, node.value.values):
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(v, ast.Dict):
+                            self.algos[k.value] = {
+                                ik.value for ik in v.keys
+                                if isinstance(ik, ast.Constant)}
+                elif isinstance(t, ast.Subscript):
+                    # ALGOS["allreduce"]["rsa_arena"] = fn
+                    inner = t.value
+                    if isinstance(inner, ast.Subscript) \
+                            and isinstance(inner.value, ast.Name) \
+                            and inner.value.id == "ALGOS" \
+                            and isinstance(inner.slice, ast.Constant) \
+                            and isinstance(t.slice, ast.Constant):
+                        self.algos.setdefault(
+                            inner.slice.value, set()).add(t.slice.value)
+            if isinstance(node, ast.FunctionDef):
+                if node.name == "_resolve_edge":
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Compare):
+                            for cmp in sub.comparators:
+                                if isinstance(cmp, ast.Constant) \
+                                        and isinstance(cmp.value, str):
+                                    self.symbols.add(cmp.value)
+                elif node.name == "_size_class":
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Return) \
+                                and isinstance(sub.value, ast.Constant) \
+                                and isinstance(sub.value.value, str):
+                            self.classes.add(sub.value.value)
+                        if isinstance(sub, ast.IfExp):
+                            for side in (sub.body, sub.orelse):
+                                if isinstance(side, ast.Constant) \
+                                        and isinstance(side.value, str):
+                                    self.classes.add(side.value)
+
+    def _eval_tables(self, node: ast.AST) -> Dict[str, Dict[str, list]]:
+        out: Dict[str, Dict[str, list]] = {}
+        if not isinstance(node, ast.Dict):
+            return out
+        for k, v in zip(node.keys, node.values):
+            if not (isinstance(k, ast.Constant) and isinstance(v, ast.Dict)):
+                continue
+            classes: Dict[str, list] = {}
+            for ck, cv in zip(v.keys, v.values):
+                if not (isinstance(ck, ast.Constant)
+                        and isinstance(cv, (ast.List, ast.Tuple))):
+                    continue
+                rows = []
+                for el in cv.elts:
+                    if isinstance(el, (ast.Tuple, ast.List)) \
+                            and len(el.elts) == 2:
+                        bound, algo = el.elts
+                        b = None
+                        if isinstance(bound, ast.Constant):
+                            b = bound.value
+                        else:
+                            b = const_int(bound)
+                        a = algo.value if isinstance(algo, ast.Constant) \
+                            else None
+                        rows.append((b, a))
+                classes[ck.value] = rows
+            out[k.value] = classes
+        return out
+
+    def resolve(self, bound):
+        """Resolved numeric edge for monotonicity checks — symbolic
+        names use representative defaults (drift of the VALUE is the
+        runtime resolver's business; the doctor checks shape)."""
+        reps = {"eager": 32 * 1024, "coll_max": 256 * 1024,
+                "dev_tier_vmem_max": 4 * 1024 * 1024,
+                "dev_tier_xla_min": 1 << 62}
+        if isinstance(bound, str):
+            return reps.get(bound)
+        return bound
+
+
+class ProfileDoctorPass(LintPass):
+    id = "profile"
+    doc = ("tuning tables total/disjoint/monotone with registered "
+           "algos + symbolic edges; committed arch-profile JSONs match "
+           "the v1 schema (known keys, sane edges, loadable filename)")
+
+    def __init__(self, profile_files: Optional[List[str]] = None):
+        # None = every .json under the committed profiles/ directory
+        self.profile_files = profile_files
+
+    # ------------------------------------------------------------------
+    def run(self, modules: List[SourceModule]) -> List[Finding]:
+        out: List[Finding] = []
+        facts = _TuningFacts(modules)
+        if facts.tuning_mod is not None and facts.tables:
+            self._check_tables(facts, out)
+        for path in self._paths():
+            self._check_profile(path, facts, out)
+        return out
+
+    def _paths(self) -> List[str]:
+        if self.profile_files is not None:
+            return list(self.profile_files)
+        try:
+            return sorted(os.path.join(PROFILE_DIR, f)
+                          for f in os.listdir(PROFILE_DIR)
+                          if f.endswith(".json"))
+        except OSError:
+            return []
+
+    # -- DEFAULT_TABLES -------------------------------------------------
+    def _check_tables(self, facts: _TuningFacts, out: List[Finding]) -> None:
+        mod = facts.tuning_mod
+        line = facts.tables_line
+
+        def emit(msg: str) -> None:
+            f = self.finding(mod, line, msg)
+            if f is not None:
+                out.append(f)
+
+        for coll, classes in sorted(facts.tables.items()):
+            missing = facts.classes - set(classes)
+            if missing:
+                emit(f"DEFAULT_TABLES[{coll!r}] lacks comm-size "
+                     f"class(es) {sorted(missing)} — _size_class can "
+                     "select them")
+            unknown = set(classes) - facts.classes
+            if unknown:
+                emit(f"DEFAULT_TABLES[{coll!r}] has unknown comm-size "
+                     f"class(es) {sorted(unknown)}")
+            for cls, rows in sorted(classes.items()):
+                self._check_rows(f"DEFAULT_TABLES[{coll!r}][{cls!r}]",
+                                 coll, rows, facts, emit)
+
+    def _check_rows(self, label: str, coll: str, rows, facts, emit) -> None:
+        if not rows:
+            emit(f"{label} is empty — no bin covers any size")
+            return
+        prev = -1
+        for i, (bound, algo) in enumerate(rows):
+            last = i == len(rows) - 1
+            if algo is not None and facts.algos.get(coll) is not None \
+                    and algo not in facts.algos[coll]:
+                emit(f"{label} names unregistered algorithm {algo!r}")
+            if bound is None:
+                if not last:
+                    emit(f"{label} has a non-final open (None) bin — "
+                         "rows after it are dead")
+                continue
+            if isinstance(bound, str):
+                if bound not in facts.symbols:
+                    emit(f"{label} uses unknown symbolic edge "
+                         f"{bound!r} (not resolved by _resolve_edge)")
+                    continue
+            r = facts.resolve(bound)
+            if r is None:
+                continue
+            if r <= prev:
+                emit(f"{label} bin edge {bound!r} is not strictly "
+                     "increasing — bins overlap or are empty")
+            prev = r
+            if last:
+                emit(f"{label} last bin is bounded ({bound!r}) — sizes "
+                     "above it select nothing (table not total)")
+
+    # -- committed profile JSONs ----------------------------------------
+    def _check_profile(self, path: str, facts: _TuningFacts,
+                       out: List[Finding]) -> None:
+        rel = os.path.relpath(path, REPO_ROOT)
+        if rel.startswith(".."):
+            rel = os.path.basename(path)
+
+        def emit(msg: str) -> None:
+            out.append(Finding(self.id, rel, 0, msg))
+
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            emit(f"unreadable profile JSON: {e!s:.80}")
+            return
+        if not isinstance(doc, dict) or doc.get("format") != FORMAT_V1:
+            return          # freeform measurement docs are out of scope
+        unknown = set(doc) - _DOC_KEYS
+        if unknown:
+            emit(f"unknown top-level key(s) {sorted(unknown)}")
+        arch = doc.get("arch_key")
+        if not (isinstance(arch, str) and arch.count(":") >= 2):
+            emit(f"arch_key {arch!r} is not a '<family>:<chip>:<n>' key")
+        else:
+            want = arch.replace(":", "_").replace(" ", "-") + ".json"
+            if os.path.basename(path) != want:
+                emit(f"filename {os.path.basename(path)!r} does not "
+                     f"match arch_key (want {want!r}) — "
+                     "load_default_profile will never find it")
+        prof = doc.get("profile")
+        if not isinstance(prof, dict):
+            emit("no 'profile' object")
+            return
+        unknown = set(prof) - _PROFILE_KEYS
+        if unknown:
+            emit(f"unknown profile key(s) {sorted(unknown)} — the "
+                 "loader would silently drop them")
+
+        known_colls = set(facts.tables) or None
+        for coll, classes in sorted(prof.get("tables", {}).items()):
+            if known_colls is not None and coll not in known_colls:
+                emit(f"tables[{coll!r}]: unknown collective")
+                continue
+            if not isinstance(classes, dict):
+                emit(f"tables[{coll!r}] is not a class map")
+                continue
+            for cls, rows in sorted(classes.items()):
+                if facts.classes and cls not in facts.classes:
+                    emit(f"tables[{coll!r}][{cls!r}]: unknown comm-"
+                         "size class")
+                    continue
+                rows2 = [tuple(r) if isinstance(r, list) and len(r) == 2
+                         else (None, None) for r in rows]
+                self._check_rows(f"tables[{coll!r}][{cls!r}]", coll,
+                                 rows2, facts,
+                                 lambda m: emit(m))
+
+        dc = prof.get("device_crossovers", {})
+        if isinstance(dc, dict):
+            valid = (set(facts.tables) | _DEV_TIER_KEYS) \
+                if facts.tables else None
+            for key, val in sorted(dc.items()):
+                if valid is not None and key not in valid:
+                    emit(f"device_crossovers[{key!r}]: neither a "
+                         "collective nor a dev_tier_* edge")
+                if not isinstance(val, int) or val < -1:
+                    emit(f"device_crossovers[{key!r}] = {val!r} is not "
+                         "a byte count")
+            vmax = dc.get("dev_tier_vmem_max")
+            if isinstance(vmax, int) and facts.vmem_limit is not None \
+                    and vmax > facts.vmem_limit:
+                emit(f"dev_tier_vmem_max {vmax} exceeds the hard VMEM "
+                     f"wrapper cap {facts.vmem_limit} "
+                     "(ops/pallas_ring.VMEM_LIMIT_BYTES) — the vmem "
+                     "tier would refuse every shard in the band")
+
+        kp = prof.get("kernel_params", {})
+        if isinstance(kp, dict):
+            for key, val in sorted(kp.items()):
+                if facts.kernel_params and key not in facts.kernel_params:
+                    emit(f"kernel_params[{key!r}]: no kernel fetches "
+                         "this parameter (typo'd key tunes nothing)")
+                if not isinstance(val, int) or val <= 0:
+                    emit(f"kernel_params[{key!r}] = {val!r} is not a "
+                         "positive integer")

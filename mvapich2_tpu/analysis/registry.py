@@ -18,15 +18,23 @@ registry idiom is declarative (utils/config.cvar, mpit.pvar):
 
 Dynamic keys (f-strings like ``MV2T_DEBUG_<subsys>``) are out of static
 reach; the exempt prefixes below cover the two families in use.
+
+The env-drift doctor extends the same invariant to the NON-python
+surfaces: every ``getenv("MV2T_*")`` in the native C sources and every
+``MV2T_*`` token in bin/ scripts and the README must resolve to a
+declared cvar (or the internal-plumbing exemptions) — a documented knob
+with no registration, or a native env read the registry never heard of,
+is exactly the doc/env drift that makes ``mpiname -a`` lie.
 """
 
 from __future__ import annotations
 
 import ast
+import os
 import re
-from typing import Dict, List, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
-from .core import Finding, LintPass, SourceModule, attr_chain
+from .core import Finding, LintPass, REPO_ROOT, SourceModule, attr_chain
 
 # launcher<->child wire plumbing: process coordinates, not tunables
 INTERNAL_ENV: Set[str] = {
@@ -39,8 +47,19 @@ INTERNAL_ENV: Set[str] = {
     # consumer in the job at one instrumented variant .so — a build
     # coordinate, not a tunable
     "MV2T_SHMRING_SO",
+    # toolchain coordinates of the compiler wrappers (bin/mpicc and
+    # friends): which cc/f90 to exec, not runtime knobs
+    "MV2T_CC", "MV2T_CXX", "MV2T_FC",
 }
 INTERNAL_PREFIXES = ("MV2T_DEBUG_", "MV2T_STASH_")
+
+# env-drift doctor: the committed non-python surfaces scanned by
+# default (native getenv reads; MV2T_* tokens in bin/ and the README)
+_DOC_NATIVE_DIR = os.path.join(REPO_ROOT, "native")
+_DOC_BIN_DIR = os.path.join(REPO_ROOT, "bin")
+_DOC_README = os.path.join(REPO_ROOT, "README.md")
+_GETENV_RE = re.compile(r'getenv\(\s*"(MV2T_[A-Z0-9_]*)"')
+_TOKEN_RE = re.compile(r"\bMV2T_[A-Z0-9_]*")
 
 _PVAR_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 _CVAR_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
@@ -70,15 +89,41 @@ def _is_environ(node: ast.AST) -> bool:
     return chain is not None and chain.split(".")[-1] == "environ"
 
 
+def _default_doc_sources() -> List[str]:
+    out: List[str] = []
+    for d, exts in ((_DOC_NATIVE_DIR, (".c", ".cpp", ".cc", ".h")),
+                    (_DOC_BIN_DIR, None)):
+        try:
+            names = sorted(os.listdir(d))
+        except OSError:
+            continue
+        for f in names:
+            p = os.path.join(d, f)
+            if not os.path.isfile(p):
+                continue
+            if exts is None or f.endswith(exts):
+                out.append(p)
+    if os.path.exists(_DOC_README):
+        out.append(_DOC_README)
+    return out
+
+
 class RegistryPass(LintPass):
     id = "pvars"
     doc = ("pvars fetched anywhere must be declared; MV2T_* env reads "
-           "must have a declared cvar; names follow convention")
+           "(python, native getenv, bin/ scripts, README) must have a "
+           "declared cvar; names follow convention")
+
+    def __init__(self, doc_sources: Optional[List[str]] = None):
+        # doc_sources: non-python surfaces for the env-drift doctor;
+        # None = the committed native/bin/README set, [] disables
+        self.doc_sources = doc_sources
 
     def run(self, modules: List[SourceModule]) -> List[Finding]:
         out: List[Finding] = []
         declared_pvars: Set[str] = set()
         declared_cvars: Set[str] = set()
+        dynamic_cvar_pats: List[re.Pattern] = []
         pvar_uses: List[Tuple[SourceModule, int, str]] = []
         env_reads: List[Tuple[SourceModule, int, str]] = []
         cfg_reads: List[Tuple[SourceModule, int, str]] = []
@@ -120,6 +165,17 @@ class RegistryPass(LintPass):
                                         and isinstance(fn, ast.Attribute)):
                     cname = _str_arg0(node)
                     if cname is None:
+                        # a loop-generated family (cvar(f"{_c}_ALGO")):
+                        # the constant parts become a match pattern so
+                        # doc mentions of family members still resolve
+                        if node.args and isinstance(node.args[0],
+                                                    ast.JoinedStr):
+                            parts = [re.escape(v.value)
+                                     if isinstance(v, ast.Constant)
+                                     else "[A-Z0-9_]+"
+                                     for v in node.args[0].values]
+                            dynamic_cvar_pats.append(
+                                re.compile("^" + "".join(parts) + "$"))
                         continue
                     declared_cvars.add(cname)
                     decl_sites.setdefault(f"c:{cname}", (mod, node.lineno))
@@ -165,4 +221,49 @@ class RegistryPass(LintPass):
             if key not in declared_cvars:
                 emit(mod, line, f"config read '{key}' names no declared "
                      "cvar")
+
+        # -- env-drift doctor over the non-python surfaces --------------
+        def known(env: str) -> bool:
+            if env in INTERNAL_ENV or env.startswith(INTERNAL_PREFIXES):
+                return True
+            name = env[len("MV2T_"):].rstrip("_")
+            if not name:
+                return True          # a bare 'MV2T_' prefix mention
+            return name in declared_cvars \
+                or any(p.match(name) for p in dynamic_cvar_pats)
+
+        doc_sources = self.doc_sources
+        if doc_sources is None:
+            # only meaningful against the full package: the committed
+            # docs resolve against the whole cvar registry, not a
+            # fixture's subset
+            if any(m.relpath.endswith("mvapich2_tpu/mpit.py")
+                   for m in modules):
+                doc_sources = _default_doc_sources()
+            else:
+                doc_sources = []
+        seen_doc: Set[str] = set()
+        for path in doc_sources:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    text = fh.read()
+            except (OSError, UnicodeDecodeError):
+                continue
+            native = path.endswith((".c", ".cpp", ".cc", ".h"))
+            matcher = _GETENV_RE if native else _TOKEN_RE
+            rel = os.path.relpath(path, REPO_ROOT)
+            if rel.startswith(".."):
+                rel = os.path.basename(path)
+            for i, line_text in enumerate(text.splitlines(), start=1):
+                for m in matcher.finditer(line_text):
+                    env = m.group(1) if native else m.group(0)
+                    if known(env) or (rel, env) in seen_doc:
+                        continue
+                    seen_doc.add((rel, env))
+                    where = "native getenv" if native else "mention"
+                    out.append(Finding(
+                        self.id, rel, i,
+                        f"{where} '{env}' has no declared cvar — "
+                        "register it (utils.config.cvar) or add it to "
+                        "INTERNAL_ENV"))
         return out

@@ -17,6 +17,12 @@ from typing import Callable, Iterable, List
 import numpy as np
 
 from .. import mpi
+from ..utils.config import cvar
+
+cvar("BENCH_INIT_BUDGET_MS", 2000, int, "bench",
+     "bin/bench_osu startup gate: fail the bench run when MPI_Init's "
+     "p50 over the trials exceeds this many milliseconds (0 disables; "
+     "--init-budget-ms overrides per run).")
 
 
 def options(desc: str, default_max: int = 1 << 22, collective: bool = False):

@@ -221,15 +221,8 @@ def device_tier(name: str, shard_nbytes: int) -> str:
     mirrors device_crossover(): explicitly-set cvar (the user said so)
     > measured profile entry > cvar default. ``name`` is accepted for
     future per-collective edges; today the edges are shared."""
-    cfg = get_config()
-    cv = cfg._vars["DEV_TIER_VMEM_MAX"]
-    vmax = cv.value
-    if not cv._explicit:
-        vmax = _DEVICE_CROSSOVERS.get("dev_tier_vmem_max", vmax)
-    cvx = cfg._vars["DEV_TIER_XLA_MIN"]
-    xmin = cvx.value
-    if not cvx._explicit:
-        xmin = _DEVICE_CROSSOVERS.get("dev_tier_xla_min", xmin)
+    vmax = _dev_tier_edge("DEV_TIER_VMEM_MAX", "dev_tier_vmem_max")
+    xmin = _dev_tier_edge("DEV_TIER_XLA_MIN", "dev_tier_xla_min")
     if shard_nbytes <= vmax:
         return "vmem"
     if xmin is not None and xmin >= 0 and shard_nbytes >= xmin:
@@ -248,14 +241,31 @@ def _size_class(comm) -> str:
 
 def _resolve_edge(bound):
     """A table bin edge: an int, None (infinity), or a symbolic name
-    tracking the protocol cvars ("eager" = SMP_EAGERSIZE, "coll_max" =
-    FP_COLL_MAX) so tier switches cannot drift from the thresholds the
-    plane tier gates on."""
+    tracking its single source of truth ("eager" = SMP_EAGERSIZE,
+    "coll_max" = FP_COLL_MAX, "dev_tier_vmem_max"/"dev_tier_xla_min" =
+    the device tier edges, profile-overridable) so tier switches cannot
+    drift from the thresholds the protocol layers gate on. The
+    mv2tlint ``profile`` doctor harvests the known symbols from THIS
+    function — adding one here is the whole registration."""
     if bound == "eager":
         return int(get_config()["SMP_EAGERSIZE"])
     if bound == "coll_max":
         return int(get_config()["FP_COLL_MAX"])
+    if bound == "dev_tier_vmem_max":
+        return _dev_tier_edge("DEV_TIER_VMEM_MAX", "dev_tier_vmem_max")
+    if bound == "dev_tier_xla_min":
+        return _dev_tier_edge("DEV_TIER_XLA_MIN", "dev_tier_xla_min")
     return bound
+
+
+def _dev_tier_edge(cvar_name: str, profile_key: str) -> int:
+    """One device tier edge with the device_tier() precedence:
+    explicitly-set cvar > measured profile entry > cvar default."""
+    cv = get_config()._vars[cvar_name]
+    val = cv.value
+    if not cv._explicit:
+        val = _DEVICE_CROSSOVERS.get(profile_key, val)
+    return int(val)
 
 
 def _lookup(name: str, comm, nbytes: int) -> str:

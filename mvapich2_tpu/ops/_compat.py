@@ -59,12 +59,15 @@ def interpret_params(**kw):
         return ip()
 
 
-def have_remote_signal() -> bool:
+def have_remote_signal() -> bool:             # device: hw-only
     """True when remote ``semaphore_signal`` works under the active
     execution mode — the credit handshake needs it. The 0.4.x
     interpreter raises NotImplementedError for remote signals, so
     interpret-mode callers must run creditless (safe there: the
-    emulator is synchronous dataflow, flow control is moot)."""
+    emulator is synchronous dataflow, flow control is moot). Code
+    gated on this (or on the resolved ``credits`` flag) is exactly the
+    code no interpreter run executes — the mv2tlint ``device`` pass
+    requires every such gate to carry the ``# device: hw-only`` mark."""
     return getattr(pltpu, "InterpretParams", None) is not None
 
 

@@ -147,14 +147,13 @@ class _RingStreamer:
             send_sem, recv_sem, cap_sem
         self.gc = [0] * ndir                   # global chunk counter / dir
         self.pending_send: Dict = {}           # (d, slot) -> remote handle
-        self.pending_in: Dict = {}
         self.pending_acc: Dict = {}
         self.pending_store: Dict = {}
 
     def _dev(self, idx):
         return idx  # logical device id along the 1-D mesh axis
 
-    def grant_initial_credits(self):
+    def grant_initial_credits(self):          # device: hw-only
         """Each direction starts with ``depth`` slot credits granted to
         the upstream neighbor (the rank that remote-writes into us)."""
         if not self.credits:
@@ -198,7 +197,7 @@ class _RingStreamer:
             la.start()
             self.pending_acc[(d, slot)] = la
         ld.wait()
-        if self.credits:
+        if self.credits:                      # device: hw-only
             pltpu.semaphore_wait(self.cap_sem.at[d], 1)
         dst = self.right if d == 0 else self.left
         rdma = pltpu.make_async_remote_copy(
@@ -239,7 +238,7 @@ class _RingStreamer:
             st.wait()                  # slot must land before re-grant
             self._grant(d)
 
-    def _grant(self, d):
+    def _grant(self, d):                      # device: hw-only
         if not self.credits:
             return
         upstream = self.left if d == 0 else self.right
@@ -256,7 +255,7 @@ class _RingStreamer:
             h.wait_send()
             del self.pending_send[key]
         self.drain_stores()
-        if self.credits:
+        if self.credits:                      # device: hw-only
             for d in range(self.ndir):
                 pltpu.semaphore_wait(self.cap_sem.at[d], self.depth)
 
@@ -413,7 +412,7 @@ def _resolve_flags(interpret, credits):
     if credits is None:
         # hardware always runs the credit handshake; the 0.4.x
         # interpreter cannot (no remote signal) and does not need to
-        credits = (not interpret) or have_remote_signal()
+        credits = (not interpret) or have_remote_signal()  # device: hw-only
     return interpret, credits
 
 

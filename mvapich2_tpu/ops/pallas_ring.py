@@ -48,7 +48,7 @@ FROM_LEFT = 0   # credit slots, indexed by which neighbor granted it
 FROM_RIGHT = 1
 
 
-def _grant_credits(cap_sem, left, right):
+def _grant_credits(cap_sem, left, right):     # device: hw-only
     """Grant one slot-credit to each neighbor (I am my left neighbor's
     RIGHT, so I bump its FROM_RIGHT slot, and vice versa). cap_sem=None
     disables the handshake — required under the jax<0.5 interpreter
@@ -62,7 +62,7 @@ def _grant_credits(cap_sem, left, right):
                            device_id_type=pltpu.DeviceIdType.LOGICAL)
 
 
-def _take_credits(cap_sem):
+def _take_credits(cap_sem):                   # device: hw-only
     """Consume one credit from each direction — blocks until both
     neighbors granted this round's slot."""
     if cap_sem is None:

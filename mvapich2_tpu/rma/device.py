@@ -40,9 +40,15 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..utils.config import cvar
 from ..utils.mlog import get_logger
 
 log = get_logger("rma.device")
+
+cvar("DEVICE_WIN", 0, int, "rma",
+     "benchmarks/osu_put_bw mode switch: 1 runs the device-resident "
+     "HBM-window path (DeviceWin + pallas_put remote DMA) instead of "
+     "the host window transport.")
 
 try:
     from jax.experimental import pallas as pl

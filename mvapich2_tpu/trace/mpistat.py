@@ -331,7 +331,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--tail", type=int, default=8,
                     help="native trace events shown per rank "
                          "(default 8)")
+    ap.add_argument("--device-map", action="store_true",
+                    help="print the static device-lane protocol map "
+                         "(pending DMA containers + credit semaphores "
+                         "harvested by the mv2tlint device pass) and "
+                         "exit — the key for reading a hung device "
+                         "job's kernel state")
     opts = ap.parse_args(argv)
+
+    if opts.device_map:
+        # segment-independent: the map names which containers/semaphores
+        # a wedged Mosaic kernel can be stuck on, shm or not
+        from .watchdog import device_map_lines
+        for ln in device_map_lines():
+            print(ln)
+        return 0
 
     def render() -> int:
         stems = find_segments(opts.seg, opts.daemon_dir)

@@ -119,6 +119,19 @@ def build_report(engine) -> str:
             lines.append(f"## native trace tail unavailable: {e!r}")
         lines.extend(_protocol_map_lines(fmap))
 
+    # device-lane forensics: a rank wedged inside a device collective
+    # hangs in the rendezvous or inside a Mosaic kernel whose
+    # outstanding copy/semaphore state is invisible from the host — the
+    # report names the tier the job has been running, the rendezvous
+    # barrier occupancy, and the static copy/semaphore protocol map the
+    # mv2tlint device pass builds (which pending containers and credit
+    # semaphores the kernel can be stuck on).
+    if u is not None:
+        try:
+            lines.extend(_device_report(u))
+        except Exception as e:   # diagnostics must never kill the waiter
+            lines.append(f"## device-lane state unavailable: {e!r}")
+
     tracer = getattr(engine, "tracer", None)
     if tracer is not None:
         n = int(get_config().get("STALL_EVENTS", 64))
@@ -128,6 +141,68 @@ def build_report(engine) -> str:
             lines.append(f"  {ts:.6f} [{layer}] {name} {ph}"
                          f"{' ' + repr(args) if args else ''}")
     return "\n".join(lines)
+
+
+def _device_report(u) -> list:
+    """Device-lane hang section: live channel/rendezvous state plus the
+    static lane map (pending containers + credit semaphores) harvested
+    by the mv2tlint device pass — the device analog of the shared-field
+    protocol map below. Empty when no device channel is bound."""
+    ch = getattr(getattr(u, "comm_world", None), "device_channel", None)
+    if ch is None:
+        return []
+    lines = [f"## device-lane state ({type(ch).__name__}, "
+             f"rank {ch.rank}/{ch.size})"]
+    rv = getattr(ch, "rv", None)
+    if rv is not None:
+        bar = rv.barrier
+        lines.append(f"  rendezvous: {bar.n_waiting}/{rv.size} ranks "
+                     f"waiting, broken={bar.broken}")
+    try:
+        pvs = []
+        for name in ("dev_coll_tier_vmem", "dev_coll_tier_hbm",
+                     "dev_coll_fallback_size", "dev_coll_fallback_dtype",
+                     "dev_coll_fallback_shape",
+                     "dev_coll_fallback_platform"):
+            v = mpit.pvar(name).read()
+            if v:
+                pvs.append(f"{name}={v:g}")
+        lines.append("  tier counters: " + (" ".join(pvs) or "(none)"))
+        bws = [f"{t}={mpit.pvar(f'dev_effbw_{t}').read():.3g}"
+               for t in ("vmem", "hbm", "xla", "slot")
+               if mpit.pvar(f"dev_effbw_{t}").read()]
+        if bws:
+            lines.append("  effbw watermarks (GB/s): " + " ".join(bws))
+    except Exception:
+        pass
+    lines.extend(device_map_lines())
+    return lines
+
+
+def device_map_lines() -> list:
+    """The static device-lane protocol map, one line per pending
+    container / credit semaphore — shared by this report and
+    ``mpistat --device-map``."""
+    try:
+        from ..analysis.device import device_lane_map
+        lane = device_lane_map()
+    except Exception:
+        lane = {}
+    if not lane:
+        return ["## device-lane protocol map unavailable (device "
+                "sources not parseable)"]
+    lines = ["## device-lane protocol map (mv2tlint device pass)"]
+    for name, info in sorted(lane.items()):
+        if info["kind"] == "pending-map":
+            kind = "remote" if info["remote"] else "local"
+            lines.append(
+                f"  pending-map {name} [{kind}] drains="
+                f"{','.join(info['drains']) or '-'} ({info['module']})")
+        else:
+            lines.append(
+                f"  credit-sem {name} signals={info['signals']} "
+                f"waits={info['waits']} ({info['module']})")
+    return lines
 
 
 def _field_map() -> dict:

@@ -49,6 +49,10 @@ cvar("SHM_RING_BYTES", 0, int, "shm",
 cvar("USE_CPLANE", 1, int, "shm",
      "Use the native C data plane (envelope matching in C) when the native "
      "ring is available. 0 falls back to python-side matching.")
+cvar("CPLANE_DEBUG", 0, int, "shm",
+     "Native C-plane debug tracing to stderr (read by cplane.cpp's "
+     "cp_debug() straight from the env at attach, so it must be set at "
+     "launch; any non-empty value enables).")
 cvar("USE_CMA", 1, int, "shm",
      "Use cross-memory-attach (process_vm_readv) for large intra-node "
      "messages when the bootstrap probe succeeds (the CMA/LiMIC2 path of "

@@ -1,0 +1,65 @@
+"""Seeded device-pass fixture: one violation per invariant family.
+
+Parsed, never imported — the pltpu names only need to look like the
+Mosaic API to the AST pass.
+"""
+
+from jax.experimental.pallas import tpu as pltpu  # noqa
+
+import pl  # noqa — stand-in for jax.experimental.pallas
+
+
+class BadStreamer:
+    def __init__(self):
+        self.pending_send = {}
+        self.pending_acc = {}
+        # dead map: never filled, never drained
+        self.pending_ghost = {}
+
+    def early_exit(self, src, dst, sem, flag):
+        ld = pltpu.make_async_copy(src, dst, sem)
+        ld.start()
+        if flag:
+            return None          # copy still in flight past kernel exit
+        ld.wait()
+        return dst
+
+    def unbound(self, src, dst, sem):
+        pltpu.make_async_copy(src, dst, sem).start()
+
+    def park_no_drain(self, src, dst, send_sem, recv_sem, k):
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=src, dst_ref=dst, send_sem=send_sem,
+            recv_sem=recv_sem, device_id=1)
+        rdma.start()
+        self.pending_acc[k] = rdma       # nobody ever waits these
+
+    def park_half_drain(self, src, dst, send_sem, recv_sem, k):
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=src, dst_ref=dst, send_sem=send_sem,
+            recv_sem=recv_sem, device_id=1)
+        rdma.start()
+        self.pending_send[k] = rdma
+
+    def finish(self):
+        for k, h in list(self.pending_send.items()):
+            h.wait_send()                # recv semaphore never consumed
+
+    def grant(self, cap_sem, up, credits):
+        if credits:                      # gate present, not annotated
+            pltpu.semaphore_signal(cap_sem, inc=1, device_id=up)
+
+    def take_credit(self, cap_sem, credits):  # device: hw-only
+        if credits:
+            pltpu.semaphore_wait(cap_sem, 1)  # balances cap_sem pairing
+
+    def take(self, done_sem):
+        # signal-only sem (pairing) AND no creditless gate at all
+        pltpu.semaphore_signal(done_sem, inc=1, device_id=0)
+
+
+def scratch_shapes(dtype):
+    return [
+        # 2 x 8 x 4 Mi elements x 4 B = 256 MiB >> the VMEM tier cap
+        pltpu.VMEM((2, 8, 4 * 1024 * 1024), dtype),
+    ]

@@ -399,6 +399,267 @@ def test_traceguard_ntrace_mutation_caught(tmp_path):
     assert len(fs) == 1 and fs[0].line == 3
 
 
+# -- the device pass: Pallas DMA/semaphore discipline (ISSUE 12) ---------
+
+def test_device_pass_fixture():
+    """Seeded device fixture: exact finding count and locations, one
+    per invariant family (dead pending map, early-exit unawaited copy,
+    unbound copy, park-without-drain, half-drained remote park,
+    unannotated creditless gate, gateless credit op, signal-only
+    semaphore, VMEM budget blow)."""
+    fs = _lint("bad_device.py")
+    assert _locs(fs, "device") == [
+        ("device", 17),   # dead pending_ghost map
+        ("device", 23),   # early-exit return past started 'ld'
+        ("device", 28),   # unbound make_async_copy
+        ("device", 35),   # pending_acc parked, never drained
+        ("device", 42),   # pending_send drains wait_send only
+        ("device", 49),   # gate present but not '# device: hw-only'
+        ("device", 58),   # done_sem op has no creditless gate
+        ("device", 58),   # done_sem signaled, never waited
+        ("device", 64),   # 256 MiB VMEM scratch > tier cap
+    ]
+    assert len(fs) == 9
+    msgs = "\n".join(f.msg for f in fs)
+    assert "pending_ghost" in msgs and "early_exit" in msgs
+    assert "wait_recv" in msgs and "hw-only" in msgs
+    assert "done_sem" in msgs and "VMEM scratch budget" in msgs
+
+
+def test_clean_device_fixture_zero_findings():
+    assert _lint("clean_device.py") == []
+
+
+def test_device_pass_in_default_gate():
+    """The tier-1 strict gate includes the device and profile passes —
+    a new unbaselined finding fails tier-1 through
+    test_repo_strict_clean."""
+    ids = {p.id for p in core.all_passes()}
+    assert {"device", "profile"} <= ids
+
+
+def test_device_pass_committed_kernels_clean():
+    """The committed kernel modules are clean under the device pass —
+    every genuine finding of the seed run (dead pending_in map,
+    unannotated creditless gates) is FIXED, not baselined."""
+    from mvapich2_tpu.analysis.device import DevicePass
+    mods, errs = core.scan_paths([os.path.join(REPO, "mvapich2_tpu")])
+    assert not errs
+    assert DevicePass().run(mods) == []
+
+
+def test_device_pass_catches_seed_violation_classes(tmp_path):
+    """Mutation check with teeth: re-introduce the exact classes fixed
+    in this PR's seed run and prove the pass catches each one."""
+    from mvapich2_tpu.analysis.device import DevicePass
+    src = open(os.path.join(REPO, "mvapich2_tpu", "ops",
+                            "pallas_ici.py")).read()
+    # (a) the dead pending map that shipped with PR 8
+    mut = src.replace(
+        "self.pending_send: Dict = {}           # (d, slot) -> remote handle",
+        "self.pending_send: Dict = {}           # (d, slot) -> remote handle\n"
+        "        self.pending_in: Dict = {}")
+    assert mut != src
+    # (b) strip one hw-only annotation from a creditless gate
+    mut = mut.replace("def _grant(self, d):                      "
+                      "# device: hw-only",
+                      "def _grant(self, d):")
+    p = tmp_path / "pallas_ici_mut.py"
+    p.write_text(mut)
+    mods, errs = core.scan_paths([str(p)])
+    assert not errs
+    fs = DevicePass(profiles=[]).run(mods)
+    msgs = "\n".join(f.msg for f in fs)
+    assert "pending_in" in msgs, msgs
+    assert "not annotated '# device: hw-only'" in msgs, msgs
+    # (c) delete a wait: the handle leaks out of the kernel
+    mut2 = src.replace("        ld.wait()\n", "")
+    assert mut2 != src
+    p2 = tmp_path / "pallas_ici_mut2.py"
+    p2.write_text(mut2)
+    mods2, _ = core.scan_paths([str(p2)])
+    fs2 = DevicePass(profiles=[]).run(mods2)
+    assert any("'ld'" in f.msg and "without a matching wait" in f.msg
+               for f in fs2), [f.msg for f in fs2]
+
+
+def test_device_vmem_budget_rejects_bad_profile(tmp_path):
+    """A committed chunk-size/depth combination that cannot fit in VMEM
+    is a lint failure, not a Mosaic OOM on the TPU host: a profile
+    claiming ici_chunk_bytes=4 MiB blows the scratch budget of the
+    committed streaming kernel (3 buffers x 2 dirs x depth 2)."""
+    import json as _json
+
+    from mvapich2_tpu.analysis.device import DevicePass
+    prof = tmp_path / "cpu_cpu_8.json"
+    prof.write_text(_json.dumps({
+        "arch_key": "cpu:cpu:8", "format": "mv2t-tuning-profile-v1",
+        "profile": {"kernel_params": {"ici_chunk_bytes": 4 << 20}}}))
+    mods, _ = core.scan_paths([os.path.join(REPO, "mvapich2_tpu", "ops",
+                                            "pallas_ici.py"),
+                               os.path.join(REPO, "mvapich2_tpu",
+                                            "mpit.py")])
+    fs = DevicePass(profiles=[str(prof)]).run(mods)
+    assert any("VMEM scratch budget" in f.msg and "cpu_cpu_8.json" in f.msg
+               for f in fs), [f.msg for f in fs]
+    # the committed profiles fit
+    assert DevicePass().run(mods) == []
+
+
+def test_device_lane_map():
+    """The lane map the watchdog/mpistat device sections read: the
+    committed streaming engine's pending containers with their drain
+    kinds, and the paired credit semaphore."""
+    from mvapich2_tpu.analysis.device import device_lane_map
+    m = device_lane_map(refresh=True)
+    assert m["pending_send"]["kind"] == "pending-map"
+    assert m["pending_send"]["remote"] is True
+    assert {"wait_send", "wait_recv"} <= set(m["pending_send"]["drains"])
+    assert m["pending_store"]["drains"] == ["wait"]
+    assert m["cap_sem"]["kind"] == "credit-sem"
+    assert m["cap_sem"]["signals"] >= 1 and m["cap_sem"]["waits"] >= 1
+
+
+def test_watchdog_device_map_lines():
+    """PR 7 parity (shared_field_map region tagging): the stall report
+    and mpistat share one device-lane protocol map section."""
+    from mvapich2_tpu.trace import watchdog
+    lines = watchdog.device_map_lines()
+    text = "\n".join(lines)
+    assert "device-lane protocol map" in text
+    assert "pending-map pending_send [remote]" in text
+    assert "credit-sem cap_sem" in text
+
+
+def test_mpistat_device_map_flag(capsys):
+    from mvapich2_tpu.trace.mpistat import main as mpistat_main
+    assert mpistat_main(["--device-map"]) == 0
+    out = capsys.readouterr().out
+    assert "pending_send" in out and "cap_sem" in out
+
+
+# -- the profile doctor (ISSUE 12 tentpole piece 3) ----------------------
+
+def test_profile_doctor_bad_fixture():
+    """Seeded profile JSON: every schema violation class caught —
+    unknown keys, filename/arch mismatch, unknown collective/class,
+    unregistered algo, non-monotone and non-total bins, unknown
+    symbolic edge, bad crossover keys/values, vmem edge past the hard
+    wrapper cap, typo'd/invalid kernel params."""
+    from mvapich2_tpu.analysis.profilecheck import ProfileDoctorPass
+    mods, _ = core.scan_paths([os.path.join(REPO, "mvapich2_tpu")])
+    fs = ProfileDoctorPass(
+        profile_files=[os.path.join(FIXTURES, "bad_profile.json")]
+    ).run(mods)
+    msgs = "\n".join(f.msg for f in fs)
+    assert len(fs) == 15, msgs
+    for needle in ("surprise", "tpu_TPU-v9_8.json", "mystery_section",
+                   "non-final open (None) bin", "table not total",
+                   "galactic", "warp_speed", "totally_real_algo",
+                   "not strictly increasing", "frobnicate",
+                   "dev_tier_quux", "not a byte count",
+                   "VMEM wrapper cap", "ici_chunk_bites",
+                   "not a positive integer"):
+        assert needle in msgs, needle
+
+
+def test_profile_doctor_committed_profiles_clean():
+    """Every committed arch profile matches the v1 schema — the gate
+    the first REAL TPU profile commit (ROADMAP item 1) must pass."""
+    from mvapich2_tpu.analysis.profilecheck import ProfileDoctorPass
+    mods, _ = core.scan_paths([os.path.join(REPO, "mvapich2_tpu")])
+    fs = ProfileDoctorPass().run(mods)
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_profile_doctor_catches_default_table_drift(tmp_path):
+    """Mutation: drift a DEFAULT_TABLES edge past its neighbor (the r5
+    cliff shape) in a copy of tuning.py — the doctor flags it."""
+    from mvapich2_tpu.analysis.profilecheck import ProfileDoctorPass
+    src = open(os.path.join(REPO, "mvapich2_tpu", "coll",
+                            "tuning.py")).read()
+    mut = src.replace('"small": [(16 * 1024, "rd"), ("eager", "ring"),',
+                      '"small": [(64 * 1024, "rd"), ("eager", "ring"),')
+    assert mut != src
+    d = tmp_path / "coll"
+    d.mkdir()
+    (d / "tuning.py").write_text(mut)
+    mods, _ = core.scan_paths([str(d / "tuning.py")])
+    fs = ProfileDoctorPass(profile_files=[]).run(mods)
+    assert any("not strictly increasing" in f.msg for f in fs), \
+        [f.msg for f in fs]
+    # and a renamed symbolic edge leaves a dangling alias behind
+    mut2 = src.replace('("eager", "ring")', '("eagre", "ring")')
+    (d / "tuning.py").write_text(mut2)
+    mods2, _ = core.scan_paths([str(d / "tuning.py")])
+    fs2 = ProfileDoctorPass(profile_files=[]).run(mods2)
+    assert any("unknown symbolic edge 'eagre'" in f.msg for f in fs2), \
+        [f.msg for f in fs2]
+
+
+def test_profile_doctor_cli_routes_json_paths():
+    """mv2tlint accepts profile JSONs on the command line and routes
+    them to the profile doctor — the 'validate before you commit a new
+    arch profile' workflow from the README."""
+    assert lint_main([os.path.join(FIXTURES, "bad_profile.json"),
+                      "--no-baseline"]) == 1
+    committed = os.path.join(REPO, "mvapich2_tpu", "profiles",
+                             "cpu_cpu_8.json")
+    assert lint_main([committed, "--no-baseline"]) == 0
+
+
+# -- the cvar/env drift doctor (ISSUE 12 satellite) ----------------------
+
+def test_env_drift_doctor_catches_undeclared_surfaces(tmp_path):
+    """Seeded non-python surfaces: a native getenv, a bin script token
+    and a README mention of MV2T_ names with no declared cvar are all
+    findings; declared/internal names are not."""
+    from mvapich2_tpu.analysis.registry import RegistryPass
+    c = tmp_path / "rogue.c"
+    c.write_text('static int dbg() { return getenv("MV2T_ROGUE_KNOB") '
+                 '!= 0; }\n/* MV2T_NOT_A_GETENV_SO_NOT_SCANNED */\n')
+    sh = tmp_path / "rogue_script"
+    sh.write_text("#!/bin/sh\n: ${MV2T_ROGUE_SCRIPT_KNOB:=1}\n"
+                  "echo $MV2T_RANK $MV2T_CC\n")       # internal: exempt
+    md = tmp_path / "README.md"
+    md.write_text("Set MV2T_ROGUE_DOC_KNOB=1 to win. MV2T_PEER_TIMEOUT "
+                  "and MV2T_ALLREDUCE_ALGO are fine.\n")
+    mods, _ = core.scan_paths([os.path.join(REPO, "mvapich2_tpu")])
+    fs = RegistryPass(doc_sources=[str(c), str(sh), str(md)]).run(mods)
+    drift = [f for f in fs if "ROGUE" in f.msg]
+    assert len(drift) == 3, [f.msg for f in fs]
+    assert not any("MV2T_CC" in f.msg or "MV2T_RANK" in f.msg
+                   or "PEER_TIMEOUT" in f.msg
+                   or "ALLREDUCE_ALGO" in f.msg for f in fs)
+
+
+def test_env_drift_doctor_committed_surfaces_clean():
+    """native getenv reads, bin/ scripts and the README all resolve
+    against the registry — the three genuine seed findings
+    (MV2T_CPLANE_DEBUG, MV2T_BENCH_INIT_BUDGET_MS, MV2T_DEVICE_WIN)
+    are fixed by declaration, not exempted."""
+    from mvapich2_tpu.analysis.registry import RegistryPass
+    mods, _ = core.scan_paths([os.path.join(REPO, "mvapich2_tpu")])
+    fs = [f for f in RegistryPass().run(mods)
+          if "getenv" in f.msg or "mention" in f.msg]
+    assert fs == [], [f.render() for f in fs]
+    # the fixes are declarations (enumerable via mpiname/MPI_T), not
+    # INTERNAL_ENV exemptions
+    from mvapich2_tpu.analysis.registry import INTERNAL_ENV
+    for env in ("MV2T_CPLANE_DEBUG", "MV2T_BENCH_INIT_BUDGET_MS",
+                "MV2T_DEVICE_WIN"):
+        assert env not in INTERNAL_ENV
+
+
+def test_runtests_modelcheck_lane_wired():
+    """bin/runtests grew the --modelcheck lane (the exhaustive
+    long-horizon model configs) next to --lint/--tsan/--chaos."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "runtests"),
+         "--help"], capture_output=True, text=True, timeout=60)
+    assert "--modelcheck" in r.stdout
+
+
 def test_ntrace_layout_mirrors_header():
     """The python mirror of the trace-ring geometry + NTE event table
     (trace/native.py) matches native/shm_layout.h — and a drifted

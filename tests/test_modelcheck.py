@@ -19,7 +19,8 @@ lint/tsan lanes complement.
 import pytest
 
 from mvapich2_tpu.analysis import model as M
-from mvapich2_tpu.analysis.model import doorbell, flat2, lease, seqlock
+from mvapich2_tpu.analysis.model import (doorbell, flat2, ici, lease,
+                                         seqlock)
 
 pytestmark = pytest.mark.lint
 
@@ -50,6 +51,14 @@ CLEAN = [
     ("flat2-hier-3x2", lambda: flat2.build_hier_allreduce(3, 2)),
     ("flat2-mcast", lambda: flat2.build_mcast(3, 2, 1)),
     ("flat2-mcast-deep", lambda: flat2.build_mcast(3, 3, 2)),
+    # chunk-credit remote-DMA ring (ops/pallas_ici.py) — small bounds;
+    # the full np<=4 x C<=4 x D<=3 matrix runs in the modelcheck lane
+    ("ici-n2-C2-D2", lambda: ici.build_ring(2, 2, 2)),
+    ("ici-n2-C2-D2-bidir", lambda: ici.build_ring(2, 2, 2, bidir=True)),
+    ("ici-n2-C4-D3", lambda: ici.build_ring(2, 4, 3)),
+    ("ici-n3-C2-D2", lambda: ici.build_ring(3, 2, 2)),
+    ("ici-n3-C2-D2-bidir", lambda: ici.build_ring(3, 2, 2, bidir=True)),
+    ("ici-n4-C2-D2", lambda: ici.build_ring(4, 2, 2)),
 ]
 
 EXPECTED_INVARIANT = {
@@ -71,6 +80,13 @@ EXPECTED_INVARIANT = {
     "fanout_before_xchg": {"agreement", "deadlock"},
     "publish_before_write": {"mcast-data"},
     "no_first_sync": {"deadlock"},
+    # ici chunk-credit ring
+    "no_credit_wait": {"no-slot-collision", "no-lost-credit"},
+    "slot_off_by_one": {"deadlock", "no-slot-collision"},
+    "depth_mismatch": {"no-lost-credit"},
+    "signal_before_copy": {"agreement"},
+    "bidir_shared_slot": {"no-slot-collision", "agreement"},
+    "recv_before_send_wave": {"agreement"},
 }
 
 
@@ -101,6 +117,34 @@ def test_mutation_caught(label, build, mutation):
 def test_matrix_has_at_least_six_variants():
     muts = {m[2] for m in M.mutation_matrix()}
     assert len(muts) >= 6, muts
+
+
+def test_ici_matrix_has_six_mutations():
+    """ISSUE 12: the ici chunk-credit model seeds >= 6 distinct
+    protocol breaks, every one caught by a named invariant (asserted
+    per-mutation by test_mutation_caught over the matrix)."""
+    muts = {m[2] for m in M.mutation_matrix() if m[0] == "ici-ring"}
+    assert muts == {"no_credit_wait", "slot_off_by_one",
+                    "depth_mismatch", "signal_before_copy",
+                    "bidir_shared_slot", "recv_before_send_wave"}
+
+
+def test_ici_violation_trace_replays():
+    """An ici collision trace replays from init to a violating state —
+    the counterexample is actionable, not just a boolean."""
+    m = ici.build_ring(2, 4, 2, mutation="no_credit_wait")
+    r = M.explore(m)
+    v = next(v for v in r.violations
+             if v.invariant == "no-slot-collision")
+    state = dict(m.init)
+    by_name = {t.name: t for t in m.transitions}
+    for step in v.trace:
+        t = by_name[step]
+        assert t.guard(state), f"trace step {step} not enabled on replay"
+        state = t.apply(state)
+    name, pred = next(i for i in m.invariants
+                      if i[0] == "no-slot-collision")
+    assert pred(state) is not None, "replayed state does not violate"
 
 
 # -- DPOR sleep-set mode agrees with full exploration --------------------
@@ -176,4 +220,34 @@ def test_full_depth_mutations_np3():
     """The matrix's seqlock mutations still caught at np=3."""
     for mut in ("stamp_before_copy", "no_reader_guard"):
         r = M.explore(seqlock.build_allreduce(3, 1, mutation=mut))
+        assert not r.ok, mut
+
+
+# -- ici chunk-credit ring: the full acceptance matrix -------------------
+
+@pytest.mark.modelcheck
+@pytest.mark.parametrize("n", [2, 3, 4])
+@pytest.mark.parametrize("chunks", [2, 4])
+@pytest.mark.parametrize("depth", [2, 3])
+@pytest.mark.parametrize("bidir", [False, True],
+                         ids=["uni", "bidir"])
+def test_full_depth_ici_matrix(n, chunks, depth, bidir):
+    """ISSUE 12 acceptance: the clean chunk-credit ring is
+    exhaustively green (no deadlock, no slot collision, no lost
+    credit, agreement) for np in {2,3,4} x chunks in {2,4} x depth in
+    {2,3}, uni + bidir — including the np=4 x C=4 x D=3 corner."""
+    r = M.explore(ici.build_ring(n, chunks, depth, bidir=bidir),
+                  max_states=2_000_000)
+    assert r.complete, f"truncated at {r.states} states"
+    assert r.ok, [f"{v.invariant}: {v.message}" for v in r.violations]
+
+
+@pytest.mark.modelcheck
+def test_full_depth_ici_mutations_np3():
+    """The ici mutations still caught away from their minimal
+    configs (np=3, deeper pipelines)."""
+    for mut, kw in [("no_credit_wait", dict(chunks=4, depth=2)),
+                    ("signal_before_copy", dict(chunks=3, depth=3)),
+                    ("recv_before_send_wave", dict(chunks=3, depth=2))]:
+        r = M.explore(ici.build_ring(3, mutation=mut, **kw))
         assert not r.ok, mut
