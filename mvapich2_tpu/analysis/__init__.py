@@ -17,6 +17,10 @@ a 4-rank hang:
         device      Pallas DMA/semaphore discipline (copy/wait pairing,
                     pending-map drains, credit gates, VMEM budgets)
         profile     tuning-table shape + arch-profile JSON schema
+        proto       control-plane protocol doctors: KVS key flow
+                    (write-only / never-written / drifted families),
+                    bounded KVS retry loops, wire-state totality,
+                    *_VERSION compatibility
 
     Findings ratchet down through a committed suppressions file
     (analysis/baseline.json); ``--strict`` additionally fails on STALE

@@ -68,10 +68,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     opts = ap.parse_args(argv)
 
     # explicit paths: C/C++ files route to the native pass, .json files
-    # to the profile doctor, .py files to the AST passes; with no paths
-    # the native pass lints the committed native tree (+ the
-    # cross-language layout check) and the profile doctor the committed
-    # profiles/ directory
+    # to the profile doctor, .py files to the AST passes (runtime/ and
+    # transport/ control-plane paths thereby reach the proto pass'
+    # key-flow/deadline/wire-state doctors); with no paths the native
+    # pass lints the committed native tree (+ the cross-language layout
+    # check) and the profile doctor the committed profiles/ directory
     c_exts = (".c", ".cpp", ".cc", ".h", ".hpp")
     c_paths = [p for p in (opts.paths or []) if p.endswith(c_exts)]
     json_paths = [p for p in (opts.paths or []) if p.endswith(".json")]

@@ -162,8 +162,8 @@ def all_passes(native_sources: Optional[Sequence[str]] = None,
     README; [] disables it for fixture runs); ``profile_files`` /
     ``device_profiles`` override the tuning-profile JSON set of the
     profile doctor and the device pass's VMEM-budget estimator."""
-    from . import (blocking, device, locks, native, profilecheck, registry,
-                   tags, traceguard)
+    from . import (blocking, device, locks, native, profilecheck, proto,
+                   registry, tags, traceguard)
     return [locks.LockDisciplinePass(), tags.TagNamespacePass(),
             registry.RegistryPass(
                 doc_sources=list(doc_sources)
@@ -180,7 +180,8 @@ def all_passes(native_sources: Optional[Sequence[str]] = None,
                 if device_profiles is not None else None),
             profilecheck.ProfileDoctorPass(
                 profile_files=list(profile_files)
-                if profile_files is not None else None)]
+                if profile_files is not None else None),
+            proto.ProtoPass()]
 
 
 def run_passes(modules: List[SourceModule],

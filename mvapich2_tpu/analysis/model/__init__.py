@@ -23,13 +23,29 @@ execute — proving no-slot-collision, no-lost-credit, agreement and
 deadlock freedom for uni- and bidirectional rings under the
 global-chunk-counter slot schedule.
 
+The CONTROL plane (the one protocol surface PRs 7/11/12 left
+uncovered) gets the same treatment before ROADMAP item 4 grows it:
+
+  * ``wiring.build_wire`` — the 2-stage mpeek-driven lazy wire
+    (ShmChannel.ensure_wired): no hang, no unsafe/mixed tier enable,
+    degraded-all-off on mid-wire death, no post-revoke wire;
+  * ``daemon.build_daemon`` — the warm-attach claim cycle (flock txn /
+    epoch / truncate-reset / stale sweep / idle expiry), including the
+    item-4a concurrent-claims admission VARIANT so its invariant set
+    (per-set exclusivity, epoch freshness, quota) exists before the
+    multi-tenant daemon is built;
+  * ``ft.build_ft`` — lease-detect → revoke flood (with re-flood) →
+    shrink re-key: eventual PROC_FAILED delivery, no survivor parked
+    forever on a dead or diverted peer, re-key never reuses a poisoned
+    ctx/lane, reused regions never deliver torn words.
+
 Every model takes ``mutation=<name>`` seeding a realistic protocol
 break (stamp-before-copy, missing final poll, throttle past the
 deadline, ...); tests/test_modelcheck.py asserts the checker catches
 each one and that the unmutated models are violation-free.
 """
 
-from . import doorbell, flat2, ici, lease, seqlock  # noqa: F401
+from . import daemon, doorbell, flat2, ft, ici, lease, seqlock, wiring  # noqa: F401,E501
 from .explorer import Model, Result, Transition, Violation, explore  # noqa: F401
 
 
@@ -105,4 +121,56 @@ def mutation_matrix():
         ("ici-ring", lambda: ici.build_ring(
             n=2, chunks=2, depth=2, mutation="recv_before_send_wave"),
          "recv_before_send_wave"),
+        # 2-stage lazy wire (ShmChannel.ensure_wired / try_wire)
+        ("wiring", lambda: wiring.build_wire(
+            2, caps=(1, 0), mutation="skip_unanimity"),
+         "skip_unanimity"),
+        ("wiring", lambda: wiring.build_wire(
+            2, crash=True, mutation="no_dead_exclude"),
+         "no_dead_exclude"),
+        ("wiring", lambda: wiring.build_wire(
+            2, crash=True, mutation="no_degrade"),
+         "no_degrade"),
+        ("wiring", lambda: wiring.build_wire(
+            2, caps=(0, 1), mutation="verdict_before_cards"),
+         "verdict_before_cards"),
+        ("wiring", lambda: wiring.build_wire(
+            3, crash=True, revoke=True, mutation="wire_after_revoke"),
+         "wire_after_revoke"),
+        # warm-attach daemon claim cycle (runtime/daemon.py)
+        ("daemon-claim", lambda: daemon.build_daemon(
+            2, crash=True, mutation="no_reset"),
+         "no_reset"),
+        ("daemon-claim", lambda: daemon.build_daemon(
+            3, mutation="release_no_epoch"),
+         "release_no_epoch"),
+        ("daemon-claim", lambda: daemon.build_daemon(
+            2, mutation="sweep_live_owner"),
+         "sweep_live_owner"),
+        ("daemon-claim", lambda: daemon.build_daemon(
+            2, mutation="expiry_reaps_claimed"),
+         "expiry_reaps_claimed"),
+        ("daemon-claim", lambda: daemon.build_daemon(
+            2, crash=True, mutation="sweep_never_fires"),
+         "sweep_never_fires"),
+        ("daemon-claim", lambda: daemon.build_daemon(
+            2, concurrent=True, nsets=2, quota=1,
+            mutation="over_quota"),
+         "over_quota"),
+        # ULFM lease-detect / revoke / shrink propagation (ft/ulfm.py)
+        ("ft-ulfm", lambda: ft.build_ft(
+            3, mutation="no_revoke_unwind"),
+         "no_revoke_unwind"),
+        ("ft-ulfm", lambda: ft.build_ft(
+            3, partial_flood=True, mutation="no_reflood"),
+         "no_reflood"),
+        ("ft-ulfm", lambda: ft.build_ft(
+            3, mutation="detect_disabled"),
+         "detect_disabled"),
+        ("ft-ulfm", lambda: ft.build_ft(
+            3, reuse=True, mutation="no_poison"),
+         "no_poison"),
+        ("ft-ulfm", lambda: ft.build_ft(
+            3, mutation="rekey_same_ctx"),
+         "rekey_same_ctx"),
     ]

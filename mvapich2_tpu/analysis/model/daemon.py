@@ -1,0 +1,276 @@
+"""Warm-attach daemon claim-cycle model (runtime/daemon.py, PR 9).
+
+The manifest protocol, as shipped: every transaction is one flock'd
+read-modify-write (so each model transition is atomic); a claim sweeps
+a dead owner's stale epoch, truncate-resets every segment file BEFORE
+publishing the claim, bumps the epoch, and records the claimer; a
+release is epoch-guarded (a late/double release of a swept-and-
+reclaimed set must be a no-op); the daemon's serve loop sweeps dead
+owners and idle-expires FREE sets only. Jobs retry a busy claim until
+the set frees (the overlapping-jobs shape).
+
+``concurrent=True`` is the ROADMAP item-4a admission variant, modeled
+BEFORE it is built: ``nsets`` independent geometry slots under one
+manifest with an admission quota — so the invariant set (per-set
+exclusivity, per-set epoch freshness, quota) exists before the
+multi-tenant daemon does.
+
+Invariants:
+  exclusivity      at most one live job holds any set at a time
+  epoch-fresh      an attached job never observes a previous epoch's
+                   word in its segment (the truncate-reset guarantee)
+  no-reap          idle-expiry never unlinks a set a live job holds
+  admission        (concurrent) busy sets never exceed the quota
+  no-hang          every job eventually claims+releases (a crashed
+                   owner's set must become claimable again)
+
+Mutations:
+  no_reset             claim skips the truncate-reset
+  release_no_epoch     release ignores the epoch guard (double release
+                       frees the NEXT claimer's set)
+  sweep_live_owner     the stale sweep's alive check is broken
+  expiry_reaps_claimed idle-expiry unlinks busy sets too
+  sweep_never_fires    stale-epoch sweep disabled (crash → dead set)
+  over_quota           (concurrent) admission ignores the quota
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .explorer import Model, Transition
+
+# job phases
+IDLE, CLAIMED, ATTACHED, DONE, CRASHED = 0, 1, 2, 3, 4
+
+
+def build_daemon(jobs: int = 2, crash: bool = False,
+                 concurrent: bool = False, nsets: int = 2,
+                 quota: int = 1,
+                 mutation: Optional[str] = None) -> Model:
+    """``jobs`` claimers cycle claim→write→read→release over one set
+    (or, with ``concurrent``, over ``nsets`` sets under ``quota``)."""
+    ns = nsets if concurrent else 1
+    if not concurrent:
+        quota = 1
+    init = {}
+    for s_ in range(ns):
+        init[f"st{s_}"] = 0          # 0 free / 1 busy
+        init[f"ep{s_}"] = 0          # manifest epoch
+        init[f"own{s_}"] = -1        # owning job (-1 none)
+        init[f"seg{s_}"] = 0         # epoch stamped into the files
+        init[f"ex{s_}"] = 1          # files exist (idle-expiry unlinks)
+    for j in range(jobs):
+        init[f"j{j}"] = IDLE
+        init[f"jep{j}"] = 0          # epoch of j's claim
+        init[f"jset{j}"] = -1        # set j holds
+        init[f"obs{j}"] = -1         # epoch word j observed on read
+        init[f"rel{j}"] = 0          # releases j has issued
+
+    def busy_count(s):
+        return sum(1 for k in range(ns) if s[f"st{k}"] == 1)
+
+    def ts():
+        out = []
+        for j in range(jobs):
+            for k in range(ns):
+                out.extend(claim_ts(j, k))
+            out.extend(job_ts(j))
+            if crash:
+                def g_crash(s, j=j):
+                    return s[f"j{j}"] in (CLAIMED, ATTACHED)
+
+                def a_crash(s, j=j):
+                    s[f"j{j}"] = CRASHED
+                    return s
+                out.append(Transition(
+                    f"crash{j}", f"j{j}", g_crash, a_crash,
+                    frozenset({f"j{j}"}), frozenset({f"j{j}"})))
+        for k in range(ns):
+            out.extend(daemon_ts(k))
+        return out
+
+    def claim_ts(j: int, k: int):
+        def g_claim(s):
+            if s[f"j{j}"] != IDLE:
+                return False
+            if mutation != "over_quota" and s[f"st{k}"] == 0 \
+                    and busy_count(s) >= quota:
+                return False          # admission control
+            if s[f"st{k}"] == 0:
+                return True
+            # busy: claimable only via the at-claim stale sweep
+            owner = s[f"own{k}"]
+            if mutation == "sweep_never_fires":
+                return False
+            if owner >= 0 and s[f"j{owner}"] == CRASHED:
+                return True
+            return False
+
+        def a_claim(s):
+            if s[f"ex{k}"] == 0:
+                s[f"ex{k}"] = 1       # recreate after idle expiry
+                s[f"seg{k}"] = 0
+            s[f"ep{k}"] += 1
+            if mutation != "no_reset":
+                s[f"seg{k}"] = 0      # truncate-reset BEFORE publishing
+            s[f"st{k}"] = 1
+            s[f"own{k}"] = j
+            s[f"j{j}"] = CLAIMED
+            s[f"jep{j}"] = s[f"ep{k}"]
+            s[f"jset{j}"] = k
+            return s
+
+        keys = frozenset({f"st{x}" for x in range(ns)}
+                         | {f"ep{k}", f"own{k}", f"seg{k}", f"ex{k}",
+                            f"j{j}", f"jep{j}", f"jset{j}"}
+                         | {f"j{x}" for x in range(jobs)})
+        return [Transition(f"claim{j}s{k}", f"j{j}", g_claim, a_claim,
+                           keys, frozenset({f"st{k}", f"ep{k}",
+                                            f"own{k}", f"seg{k}",
+                                            f"ex{k}", f"j{j}",
+                                            f"jep{j}", f"jset{j}"}))]
+
+    def job_ts(j: int):
+        def g_write(s):
+            return s[f"j{j}"] == CLAIMED
+
+        def a_write(s):
+            k = s[f"jset{j}"]
+            s[f"seg{k}"] = s[f"jep{j}"]  # stamp my epoch's words
+            s[f"j{j}"] = ATTACHED
+            return s
+
+        def g_read(s):
+            # an attacher reads protocol words (ring heads, flat seqs)
+            # the moment it maps — before its own first write, which is
+            # exactly when a skipped reset leaks the previous epoch
+            return s[f"j{j}"] in (CLAIMED, ATTACHED) and s[f"obs{j}"] < 0
+
+        def a_read(s):
+            k = s[f"jset{j}"]
+            s[f"obs{j}"] = s[f"seg{k}"]
+            return s
+
+        def g_release(s):
+            if s[f"j{j}"] == ATTACHED and s[f"obs{j}"] >= 0:
+                return True
+            # the double-release shape: close_light + ShmChannel.close
+            # both release; the second must be an epoch-guarded no-op
+            return s[f"j{j}"] == DONE and s[f"rel{j}"] == 1
+
+        def a_release(s):
+            k = s[f"jset{j}"]
+            if mutation == "release_no_epoch" \
+                    or s[f"ep{k}"] == s[f"jep{j}"]:
+                s[f"st{k}"] = 0
+                s[f"own{k}"] = -1
+            s[f"j{j}"] = DONE
+            s[f"rel{j}"] += 1
+            return s
+
+        allk = frozenset({f"st{x}" for x in range(ns)}
+                         | {f"ep{x}" for x in range(ns)}
+                         | {f"own{x}" for x in range(ns)}
+                         | {f"seg{x}" for x in range(ns)}
+                         | {f"j{j}", f"jep{j}", f"jset{j}",
+                            f"obs{j}", f"rel{j}"})
+        return [
+            Transition(f"write{j}", f"j{j}", g_write, a_write, allk,
+                       frozenset({f"seg{x}" for x in range(ns)}
+                                 | {f"j{j}"})),
+            Transition(f"read{j}", f"j{j}", g_read, a_read, allk,
+                       frozenset({f"obs{j}", f"j{j}"})),
+            Transition(f"release{j}", f"j{j}", g_release, a_release,
+                       allk,
+                       frozenset({f"st{x}" for x in range(ns)}
+                                 | {f"own{x}" for x in range(ns)}
+                                 | {f"j{j}", f"rel{j}"})),
+        ]
+
+    def daemon_ts(k: int):
+        def g_sweep(s):
+            if s[f"st{k}"] != 1 or mutation == "sweep_never_fires":
+                return False
+            owner = s[f"own{k}"]
+            if owner < 0:
+                return False
+            if mutation == "sweep_live_owner":
+                return s[f"j{owner}"] in (CLAIMED, ATTACHED)  # MUTANT
+            return s[f"j{owner}"] == CRASHED
+
+        def a_sweep(s):
+            s[f"st{k}"] = 0
+            s[f"own{k}"] = -1
+            return s
+
+        def g_expire(s):
+            if s[f"ex{k}"] == 0:
+                return False
+            if mutation == "expiry_reaps_claimed":
+                return True           # MUTANT: reaps busy sets too
+            return s[f"st{k}"] == 0
+
+        def a_expire(s):
+            s[f"ex{k}"] = 0
+            return s
+
+        jk = frozenset({f"j{x}" for x in range(jobs)})
+        return [
+            Transition(f"sweep{k}", "daemon", g_sweep, a_sweep,
+                       frozenset({f"st{k}", f"own{k}"}) | jk,
+                       frozenset({f"st{k}", f"own{k}"})),
+            Transition(f"expire{k}", "daemon", g_expire, a_expire,
+                       frozenset({f"st{k}", f"ex{k}"}),
+                       frozenset({f"ex{k}"})),
+        ]
+
+    def holders(s, k):
+        return [j for j in range(jobs)
+                if s[f"j{j}"] in (CLAIMED, ATTACHED)
+                and s[f"jset{j}"] == k and s[f"jep{j}"] == s[f"ep{k}"]]
+
+    def inv_excl(s):
+        for k in range(ns):
+            h = [j for j in range(jobs)
+                 if s[f"j{j}"] in (CLAIMED, ATTACHED)
+                 and s[f"jset{j}"] == k]
+            if len(h) > 1:
+                return (f"set {k} held by jobs {h} simultaneously — "
+                        "two jobs mapping one segment set")
+        return None
+
+    def inv_fresh(s):
+        for j in range(jobs):
+            if s[f"obs{j}"] >= 0 and s[f"obs{j}"] not in (0, s[f"jep{j}"]):
+                return (f"job {j} (epoch {s[f'jep{j}']}) observed a "
+                        f"word of epoch {s[f'obs{j}']} — the previous "
+                        "incarnation's protocol state leaked through "
+                        "the reset")
+        return None
+
+    def inv_reap(s):
+        for j in range(jobs):
+            if s[f"j{j}"] in (CLAIMED, ATTACHED):
+                k = s[f"jset{j}"]
+                if s[f"ex{k}"] == 0 and j in holders(s, k):
+                    return (f"idle-expiry unlinked set {k} while job "
+                            f"{j} holds it")
+        return None
+
+    def inv_quota(s):
+        if busy_count(s) > quota:
+            return (f"{busy_count(s)} busy sets exceed the admission "
+                    f"quota {quota}")
+        return None
+
+    def final(s):
+        return all(s[f"j{j}"] in (DONE, CRASHED) for j in range(jobs))
+
+    invs = [("exclusivity", inv_excl), ("epoch-fresh", inv_fresh),
+            ("no-reap", inv_reap)]
+    if concurrent:
+        invs.append(("admission", inv_quota))
+    return Model(
+        f"daemon(jobs={jobs},crash={crash},conc={concurrent},"
+        f"mut={mutation})", init, ts(), invs, final)

@@ -1,0 +1,263 @@
+"""ULFM failure-propagation model (ft/ulfm.py + ft/elastic.py, PR 6/11).
+
+The containment pipeline, as shipped: a victim dies mid-wave (its flat
+region may hold torn seqlock words); every survivor can detect the
+death independently (lease scan / launcher events) — detection unwinds
+the survivor's posted recvs ON THE VICTIM with MPIX_ERR_PROC_FAILED.
+A survivor blocked on a LIVE peer that diverted into recovery unwinds
+only through REVOKE (the PR 6 containment gap class): any rank that
+knows of the failure may revoke; the flood delivers to every survivor,
+every first receipt RE-floods (delivery despite a mid-flood crash of
+the initiator — modeled as the victim revoking one peer and dying),
+and receipt both unwinds blocked-on-live operations and sticky-poisons
+the comm's flat region. Shrink then re-keys the flat tier on a FRESH
+context; a later comm may legally reuse the old ctx id — poison is
+what makes that safe.
+
+Invariants:
+  eventual-delivery  every survivor learns PROC_FAILED and unblocks
+                     (deadlock = a survivor parked forever on a dead
+                     or diverted peer)
+  rekey-fresh        shrink never re-keys onto a poisoned ctx/lane
+  no-torn-rekey      a wave on a reused region never delivers the dead
+                     victim's torn words (poison must refuse it first)
+
+Mutations:
+  no_revoke_unwind  REVOKE receipt leaves blocked-on-live recvs posted
+  no_reflood        receivers don't re-flood (initiator died mid-flood
+                    → some survivor never learns)
+  detect_disabled   survivors' lease scans never fire
+  no_poison         revoke skips the sticky poison
+  rekey_same_ctx    shrink re-keys onto the old (poisoned) ctx
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .explorer import Model, Transition
+
+OLD_CTX, FRESH_CTX = 0, 1
+
+
+def build_ft(n: int = 3, partial_flood: bool = False,
+             reuse: bool = False,
+             mutation: Optional[str] = None) -> Model:
+    """``n`` ranks; rank n-1 is the victim and dies mid-wave. Rank 0
+    is blocked receiving from LIVE rank 1 (which diverts to recovery on
+    learning of the failure); every survivor is blocked on the victim.
+    ``partial_flood``: the victim initiates the revoke, delivers it to
+    exactly one survivor, and dies — re-flood must finish the job.
+    ``reuse``: after shrink, a new comm reuses the old ctx id (legal —
+    poison is what protects it)."""
+    victim = n - 1
+    surv = list(range(n - 1))
+    init = {"vdead": 0, "torn": 0, "poison": 0, "revoked_any": 0}
+    for i in surv:
+        init[f"know{i}"] = 0         # PROC_FAILED delivered to i
+        init[f"bv{i}"] = 1           # blocked on the victim
+        init[f"rev{i}"] = 0          # REVOKE seen by i
+        init[f"pend{i}"] = 0         # REVOKE in flight to i
+        init[f"newctx{i}"] = -1      # shrink re-key choice
+        init[f"waved{i}"] = 0        # post-rekey wave done
+        init[f"torn_read{i}"] = 0
+    init["bl0"] = 1                  # rank 0 blocked on LIVE rank 1
+    init["diverted1"] = 0            # rank 1 committed to recovery
+
+    def ts():
+        out = []
+
+        def g_die(s):
+            if s["vdead"]:
+                return False
+            if partial_flood:
+                # the victim revokes first (delivering to exactly one
+                # survivor) and dies mid-flood
+                return s[f"pend{surv[-1]}"] == 1 or s["revoked_any"]
+            return True
+
+        def a_die(s):
+            s["vdead"] = 1
+            s["torn"] = 1            # died mid-wave: torn seqlock words
+            return s
+        out.append(Transition("die", "victim", g_die, a_die,
+                              frozenset({"vdead", "revoked_any",
+                                         f"pend{surv[-1]}"}),
+                              frozenset({"vdead", "torn"})))
+        if partial_flood:
+            def g_vrev(s):
+                return not s["vdead"] and not s["revoked_any"]
+
+            def a_vrev(s):
+                # delivers to ONE survivor only, then the die above
+                s["revoked_any"] = 1
+                if mutation != "no_poison":
+                    s["poison"] = 1
+                s[f"pend{surv[-1]}"] = 1
+                return s
+            out.append(Transition(
+                "victim_revoke_partial", "victim", g_vrev, a_vrev,
+                frozenset({"vdead", "revoked_any"}),
+                frozenset({"revoked_any", "poison",
+                           f"pend{surv[-1]}"})))
+
+        for i in surv:
+            out.extend(surv_ts(i))
+        return out
+
+    def surv_ts(i: int):
+        out = []
+
+        def g_detect(s):
+            if mutation == "detect_disabled":
+                return False
+            return s["vdead"] == 1 and s[f"know{i}"] == 0
+
+        def a_detect(s):
+            s[f"know{i}"] = 1
+            s[f"bv{i}"] = 0          # posted recvs on the victim unwind
+            return s
+        out.append(Transition(f"detect{i}", f"r{i}", g_detect, a_detect,
+                              frozenset({"vdead", f"know{i}"}),
+                              frozenset({f"know{i}", f"bv{i}"})))
+
+        if i == 0 and not partial_flood:
+            # revoke is an APPLICATION decision, not automatic on
+            # detection: exactly one initiator (rank 0 here; the victim
+            # itself in the partial_flood config) — non-initiators learn
+            # the comm is revoked only through the flood, which is what
+            # makes re-flood delivery load-bearing
+            def g_revoke(s):
+                return s[f"know{i}"] == 1 and s[f"rev{i}"] == 0
+
+            def a_revoke(s):
+                s[f"rev{i}"] = 1
+                s["revoked_any"] = 1
+                if mutation != "no_poison":
+                    s["poison"] = 1
+                if i == 0 and mutation != "no_revoke_unwind":
+                    # _fail_ctx_recvs runs locally at initiation too
+                    s["bl0"] = 0
+                for j in surv:
+                    if j != i and s[f"rev{j}"] == 0:
+                        s[f"pend{j}"] = 1
+                return s
+            out.append(Transition(
+                f"revoke{i}", f"r{i}", g_revoke, a_revoke,
+                frozenset({f"know{i}", f"rev{i}"} |
+                          {f"rev{j}" for j in surv}),
+                frozenset({f"rev{i}", "revoked_any", "poison", "bl0"} |
+                          {f"pend{j}" for j in surv})))
+
+        def g_deliver(s):
+            return s[f"pend{i}"] == 1 and s[f"rev{i}"] == 0
+
+        def a_deliver(s):
+            s[f"rev{i}"] = 1
+            s[f"know{i}"] = 1        # REVOKE implies failure knowledge
+            s[f"bv{i}"] = 0
+            if mutation != "no_poison":
+                s["poison"] = 1
+            if mutation != "no_revoke_unwind":
+                if i == 0:
+                    s["bl0"] = 0     # blocked-on-live unwinds too
+            if mutation != "no_reflood":
+                for j in surv:       # first receipt re-floods
+                    if j != i and s[f"rev{j}"] == 0:
+                        s[f"pend{j}"] = 1
+            return s
+        out.append(Transition(
+            f"deliver{i}", f"r{i}", g_deliver, a_deliver,
+            frozenset({f"pend{i}", f"rev{i}"} |
+                      {f"rev{j}" for j in surv}),
+            frozenset({f"rev{i}", f"know{i}", f"bv{i}", "bl0",
+                       "poison"} | {f"pend{j}" for j in surv})))
+
+        if i == 1:
+            def g_divert(s):
+                return (s[f"know1"] == 1 or s[f"rev1"] == 1) \
+                    and s["diverted1"] == 0
+
+            def a_divert(s):
+                s["diverted1"] = 1   # never sends to rank 0 again
+                return s
+            out.append(Transition(
+                "divert1", "r1", g_divert, a_divert,
+                frozenset({"know1", "rev1", "diverted1"}),
+                frozenset({"diverted1"})))
+
+            def g_send(s):
+                return s["diverted1"] == 0 and s["bl0"] == 1 \
+                    and s[f"know1"] == 0 and s[f"rev1"] == 0
+
+            def a_send(s):
+                s["bl0"] = 0         # normal completion
+                return s
+            out.append(Transition(
+                "send1", "r1", g_send, a_send,
+                frozenset({"diverted1", "bl0", "know1", "rev1"}),
+                frozenset({"bl0"})))
+
+        def g_shrink(s):
+            return s[f"rev{i}"] == 1 and s[f"know{i}"] == 1 \
+                and s[f"newctx{i}"] < 0 and s[f"bv{i}"] == 0 \
+                and (i != 0 or s["bl0"] == 0)
+
+        def a_shrink(s):
+            if mutation == "rekey_same_ctx":
+                s[f"newctx{i}"] = OLD_CTX    # MUTANT: reuse the key
+            else:
+                s[f"newctx{i}"] = FRESH_CTX
+            return s
+        out.append(Transition(
+            f"shrink{i}", f"r{i}", g_shrink, a_shrink,
+            frozenset({f"rev{i}", f"know{i}", f"newctx{i}",
+                       f"bv{i}", "bl0"}),
+            frozenset({f"newctx{i}"})))
+
+        def g_wave(s):
+            return s[f"newctx{i}"] >= 0 and s[f"waved{i}"] == 0
+
+        def a_wave(s):
+            ctx = s[f"newctx{i}"]
+            if reuse and mutation != "rekey_same_ctx":
+                # a LATER comm legally reuses the old ctx id; poison is
+                # the only protection
+                ctx = OLD_CTX
+            if ctx == OLD_CTX and not s["poison"] and s["torn"]:
+                s[f"torn_read{i}"] = 1   # folded the victim's torn words
+            s[f"waved{i}"] = 1
+            return s
+        out.append(Transition(
+            f"wave{i}", f"r{i}", g_wave, a_wave,
+            frozenset({f"newctx{i}", f"waved{i}", "poison", "torn"}),
+            frozenset({f"waved{i}", f"torn_read{i}"})))
+        return out
+
+    def inv_rekey(s):
+        for i in surv:
+            if s[f"newctx{i}"] == OLD_CTX and s["poison"]:
+                return (f"rank {i} shrink re-keyed onto the POISONED "
+                        "old ctx/lane")
+        return None
+
+    def inv_torn(s):
+        for i in surv:
+            if s[f"torn_read{i}"]:
+                return (f"rank {i} delivered the dead victim's torn "
+                        "flat words through a reused, unpoisoned "
+                        "region")
+        return None
+
+    def final(s):
+        # eventual delivery: the job only quiesces once every survivor
+        # knows, is unblocked, and finished its post-shrink wave
+        return all(s[f"know{i}"] == 1 and s[f"bv{i}"] == 0
+                   and s[f"waved{i}"] == 1 for i in surv) \
+            and s["bl0"] == 0
+
+    return Model(
+        f"ft(n={n},partial={partial_flood},reuse={reuse},"
+        f"mut={mutation})", init, ts(),
+        [("rekey-fresh", inv_rekey), ("no-torn-rekey", inv_torn)],
+        final)

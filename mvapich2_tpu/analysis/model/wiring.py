@@ -1,0 +1,234 @@
+"""2-stage lazy-wiring model (ShmChannel.ensure_wired/try_wire, PR 9).
+
+The wire state machine, as shipped: every rank publishes its BUILD
+cards (bell + CMA probe buffer) at channel construction; stage 0→1
+peeks every non-dead peer's build cards, computes this rank's verdict
+(its actual capability, forced 0 once any death is known — the
+degraded wire), and publishes it; stage 1→2 peeks every non-dead
+peer's verdict, applies the unanimous AND, and opens the tier. A rank
+SIGKILLed mid-wire can never publish; survivors detect it (lease scan
+/ launcher events) and complete DEGRADED with all-False agreements.
+A revoke observed before the apply also forces the tier off (the
+"no post-revoke wire" rule).
+
+Invariants:
+  no-hang              every live rank wires (deadlock = the mid-wire
+                       stall class ensure_wired's timeout merely bounds)
+  unsafe-enable        a rank never applies tier=1 while some
+                       participating rank's real capability is 0 — the
+                       mixed-tier corruption class (one rank folds into
+                       a flat region another never mapped)
+  degraded-all-off     a wire completed with death knowledge applies
+                       tier 0 (conservative agreements only)
+  clean-agreement      with no deaths and no revoke, all ranks apply
+                       the same tier
+  no-post-revoke-wire  a wire applied after observing a revoke is off
+
+Mutations:
+  skip_unanimity       apply my own verdict instead of the AND
+  no_dead_exclude      stage peeks wait for DEAD peers' cards too
+  no_degrade           death knowledge doesn't force the agreements off
+  verdict_before_cards publish an optimistic verdict without the build-
+                       card wait (the not-yet-attached arena class)
+  wire_after_revoke    the apply ignores the revoked flag
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from .explorer import Model, Transition
+
+
+def build_wire(n: int = 2, caps: Optional[Sequence[int]] = None,
+               crash: bool = False, revoke: bool = False,
+               mutation: Optional[str] = None) -> Model:
+    """``caps[i]`` is rank i's real capability (CMA probe / arena map
+    success). ``crash`` lets the last rank die at any pre-wired step;
+    ``revoke`` adds a ULFM revoke any death-aware rank may flood."""
+    caps = tuple(caps) if caps is not None else tuple([1] * n)
+    assert len(caps) == n
+    victim = n - 1
+    init = {}
+    for i in range(n):
+        init[f"cards{i}"] = 0        # build cards published
+        init[f"verd{i}"] = -1        # published verdict (-1 = none)
+        init[f"tier{i}"] = -1        # applied tier (-1 = unwired)
+        init[f"alive{i}"] = 1
+        init[f"det{i}"] = 0          # victim-death knowledge
+        init[f"deg{i}"] = 0          # wired with death knowledge
+        init[f"wrev{i}"] = 0         # wired after observing revoke
+    init["revoked"] = 0
+
+    def ts():
+        out = []
+        for i in range(n):
+            out.extend(rank_ts(i))
+        if crash:
+            out.append(Transition(
+                "die", f"r{victim}",
+                lambda s: s[f"alive{victim}"] == 1
+                and s[f"tier{victim}"] < 0,
+                lambda s: (s.__setitem__(f"alive{victim}", 0), s)[1],
+                frozenset({f"alive{victim}", f"tier{victim}"}),
+                frozenset({f"alive{victim}"})))
+            for i in range(n):
+                if i == victim:
+                    continue
+                def g_det(s, i=i):
+                    return s[f"alive{i}"] == 1 \
+                        and s[f"alive{victim}"] == 0 and s[f"det{i}"] == 0
+
+                def a_det(s, i=i):
+                    s[f"det{i}"] = 1
+                    return s
+                out.append(Transition(
+                    f"detect{i}", f"r{i}", g_det, a_det,
+                    frozenset({f"alive{i}", f"alive{victim}",
+                               f"det{i}"}),
+                    frozenset({f"det{i}"})))
+        if revoke:
+            for i in range(n):
+                def g_rev(s, i=i):
+                    return s[f"alive{i}"] == 1 and s[f"det{i}"] == 1 \
+                        and s["revoked"] == 0
+
+                def a_rev(s, i=i):
+                    s["revoked"] = 1
+                    return s
+                out.append(Transition(
+                    f"revoke{i}", f"r{i}", g_rev, a_rev,
+                    frozenset({f"alive{i}", f"det{i}", "revoked"}),
+                    frozenset({"revoked"})))
+        return out
+
+    def rank_ts(i: int):
+        def g_build(s):
+            return s[f"alive{i}"] == 1 and s[f"cards{i}"] == 0
+
+        def a_build(s):
+            s[f"cards{i}"] = 1
+            return s
+
+        def peers_ready(s, field: str) -> bool:
+            unpublished = 0 if field == "cards" else -1
+            for j in range(n):
+                if j == i:
+                    continue
+                if mutation != "no_dead_exclude" and s[f"det{i}"] \
+                        and j == victim:
+                    continue          # detected-dead peers are excluded
+                if s[f"{field}{j}"] == unpublished:
+                    return False
+            return True
+
+        def g_verdict(s):
+            if not (s[f"alive{i}"] == 1 and s[f"cards{i}"] == 1
+                    and s[f"verd{i}"] == -1):
+                return False
+            if mutation == "verdict_before_cards":
+                return True           # MUTANT: skip the card wait
+            return peers_ready(s, "cards")
+
+        def a_verdict(s):
+            if mutation == "verdict_before_cards":
+                # MUTANT: optimistic publish before the attach step
+                # that would have discovered the real capability
+                s[f"verd{i}"] = 1
+                return s
+            v = caps[i]
+            if s[f"det{i}"] and mutation != "no_degrade":
+                v = 0                 # degraded wire publishes all-off
+            s[f"verd{i}"] = v
+            return s
+
+        def g_wire(s):
+            return s[f"alive{i}"] == 1 and s[f"verd{i}"] != -1 \
+                and s[f"tier{i}"] < 0 and peers_ready(s, "verd")
+
+        def a_wire(s):
+            if mutation == "skip_unanimity":
+                t = s[f"verd{i}"]     # MUTANT: my verdict, not the AND
+            else:
+                t = s[f"verd{i}"]
+                for j in range(n):
+                    if j == i:
+                        continue
+                    if s[f"det{i}"] and j == victim:
+                        continue
+                    t = min(t, s[f"verd{j}"])
+            if s[f"det{i}"] and mutation != "no_degrade":
+                t = 0
+                s[f"deg{i}"] = 1
+            elif s[f"det{i}"]:
+                s[f"deg{i}"] = 1      # MUTANT kept the agreement on
+            if s["revoked"]:
+                s[f"wrev{i}"] = 1
+                if mutation != "wire_after_revoke":
+                    t = 0
+            s[f"tier{i}"] = t
+            return s
+
+        all_keys = frozenset(
+            [f"cards{j}" for j in range(n)]
+            + [f"verd{j}" for j in range(n)]
+            + [f"alive{i}", f"det{i}", "revoked"])
+        return [
+            Transition(f"build{i}", f"r{i}", g_build, a_build,
+                       frozenset({f"alive{i}", f"cards{i}"}),
+                       frozenset({f"cards{i}"})),
+            Transition(f"verdict{i}", f"r{i}", g_verdict, a_verdict,
+                       all_keys | {f"verd{i}"},
+                       frozenset({f"verd{i}"})),
+            Transition(f"wire{i}", f"r{i}", g_wire, a_wire,
+                       all_keys | {f"tier{i}"},
+                       frozenset({f"tier{i}", f"deg{i}", f"wrev{i}"})),
+        ]
+
+    def inv_unsafe(s):
+        for i in range(n):
+            if s[f"tier{i}"] == 1:
+                bad = [j for j in range(n) if caps[j] == 0]
+                if bad:
+                    return (f"rank {i} enabled the shared tier while "
+                            f"rank(s) {bad} lack the capability — "
+                            "mixed-tier dispatch")
+        return None
+
+    def inv_degraded(s):
+        for i in range(n):
+            if s[f"deg{i}"] == 1 and s[f"tier{i}"] == 1:
+                return (f"rank {i} wired DEGRADED (knew of a death) "
+                        "but still enabled the shared tier")
+        return None
+
+    def inv_agreement(s):
+        if crash and s[f"alive{victim}"] == 0:
+            return None
+        if s["revoked"]:
+            return None
+        tiers = {s[f"tier{i}"] for i in range(n)
+                 if s[f"tier{i}"] >= 0}
+        if len(tiers) > 1:
+            return f"clean run wired mixed tiers {sorted(tiers)}"
+        return None
+
+    def inv_revoke(s):
+        for i in range(n):
+            if s[f"wrev{i}"] == 1 and s[f"tier{i}"] == 1:
+                return (f"rank {i} enabled the shared tier in a wire "
+                        "applied after the comm was revoked")
+        return None
+
+    def final(s):
+        return all(s[f"alive{i}"] == 0 or s[f"tier{i}"] >= 0
+                   for i in range(n))
+
+    return Model(
+        f"wiring(n={n},caps={caps},crash={crash},mut={mutation})",
+        init, ts(),
+        [("unsafe-enable", inv_unsafe),
+         ("degraded-all-off", inv_degraded),
+         ("clean-agreement", inv_agreement),
+         ("no-post-revoke-wire", inv_revoke)],
+        final)

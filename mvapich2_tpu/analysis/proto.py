@@ -1,0 +1,597 @@
+"""Pass ``proto`` — control-plane protocol doctors (KVS key flow,
+bounded waits, wire-state totality, manifest-version compatibility).
+
+The control plane — the KVS fence-with-cards bootstrap, the 2-stage
+lazy-wiring state machine, the warm-attach daemon's manifest cycle —
+is string-keyed and convention-bound: a sender publishing
+``shm-cabi-<r>`` while the reader peeks ``shm_cabi-<r>`` is not a type
+error, it is a silent hang at np=4 three PRs later. Four doctors, all
+syntactic because the KVS idiom is declarative (put/mput vs
+get/mget/mpeek with literal or f-string keys):
+
+  * **key flow**: every key family written (put / put_many / publish /
+    fence ``cards=`` / batched-card containers flowing into put_many)
+    is read somewhere (get / get_many / peek / peek_many), and vice
+    versa. Write-only families are dead weight or a mis-spelled
+    consumer; read-only (never-written) families are a consumer that
+    blocks forever. Families differing only in separator spelling
+    (``-`` vs ``_``) are flagged as drift — the silent-hang class —
+    and subsume their orphan findings.
+  * **deadline**: every retry loop around a KVS wait verb (mpeek/mget/
+    get/fence) carries a bounded deadline (a compare against a
+    ``deadline``/``timeout``-named bound, the MV2T_WIRE_TIMEOUT shape)
+    or an explicit ``# proto: bounded-by(<cvar-or-rationale>)``
+    annotation on the loop.
+  * **wire-state totality**: the ``_wire_stage`` state machine
+    (transport/shm.py ensure_wired/try_wire): every stage value ever
+    stored must have a handling comparison annotated
+    ``# state: wire:<k>``, and every handler's function must carry an
+    exit on peer death (a ``dead``/``failed`` reference) — a stage
+    with no death exit is a permanent stall when a peer is SIGKILLed
+    mid-wire.
+  * **version**: every ``*_VERSION`` protocol constant (daemon
+    MANIFEST_VERSION, boot card version): consumers must compare
+    version fields against the constant, never an integer literal, and
+    a constant at N must keep a ``# proto: <stem>-v<k>`` annotated
+    compatibility handler for every k < N (the pre-v2 set upgrade in
+    runtime/daemon.py is the canonical one).
+
+``proto_state_map()`` exports the harvested key/state maps for the
+stall watchdog's and ``bin/mpistat --proto-map``'s control-plane
+sections — the control-plane analog of the native pass's
+``shared_field_map`` and the device pass's ``device_lane_map``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, LintPass, SourceModule, attr_chain, const_int
+
+WILD = "<*>"
+
+# verbs whose NAME alone identifies the KVS API (no other type in the
+# tree spells them)
+_UNIQUE_WRITE = {"put_many", "publish"}
+_UNIQUE_READ = {"get_many", "peek_many"}
+# ambiguous verbs (dict.get, queue.put, set.add): accepted only on a
+# kvs-chained receiver or — second phase — when the key matches a
+# family already harvested from an unambiguous site
+_AMBIG_WRITE = {"put"}
+_AMBIG_READ = {"get", "peek"}
+_AMBIG_RW = {"add"}
+# read verbs that block (the deadline doctor's wait set); peeks are
+# nonblocking probes but a retry LOOP around one is a wait
+_WAIT_VERBS = {"get", "get_many", "peek", "peek_many", "fence",
+               "fence_begin"}
+
+_BOUND_NAMES = ("deadline", "timeout", "until", "expires", "expiry")
+_BOUNDED_BY_RE = re.compile(r"proto:\s*bounded-by\(([^)]+)\)")
+_VERSION_RE = re.compile(r"^[A-Z][A-Z0-9_]*_VERSION$")
+_STATE_ATTR = "_wire_stage"
+
+
+def _is_kvs_chain(node: ast.AST) -> bool:
+    chain = attr_chain(node)
+    return chain is not None and "kvs" in chain.split(".")
+
+
+def _family(expr: ast.AST, env: Dict[str, ast.AST]) -> Optional[tuple]:
+    """Normalize a key expression to a family tuple: literal fragments
+    with WILD for interpolations ('shm-cma-', WILD). One level of
+    local-variable resolution (segkey = f"shm-seg-{leader}")."""
+    if isinstance(expr, ast.Name):
+        expr = env.get(expr.id)
+        if expr is None:
+            return None
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return (expr.value,)
+    if isinstance(expr, ast.JoinedStr):
+        parts: List[str] = []
+        for v in expr.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            elif parts and parts[-1] == WILD:
+                continue                    # collapse adjacent holes
+            else:
+                parts.append(WILD)
+        return tuple(parts)
+    return None
+
+
+def _families_in_seq(expr: ast.AST,
+                     env: Dict[str, ast.AST]) -> List[tuple]:
+    """Key families inside a *_many argument: list/tuple literals,
+    comprehensions, and `[...] + [...]` concatenations."""
+    out: List[tuple] = []
+    if isinstance(expr, (ast.List, ast.Tuple)):
+        for e in expr.elts:
+            f = _family(e, env)
+            if f is not None:
+                out.append(f)
+    elif isinstance(expr, (ast.ListComp, ast.GeneratorExp)):
+        f = _family(expr.elt, env)
+        if f is not None:
+            out.append(f)
+    elif isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        out.extend(_families_in_seq(expr.left, env))
+        out.extend(_families_in_seq(expr.right, env))
+    return out
+
+
+def _families_in_dict(expr: ast.AST,
+                      env: Dict[str, ast.AST]) -> List[tuple]:
+    out: List[tuple] = []
+    if isinstance(expr, ast.Dict):
+        for k in expr.keys:
+            f = _family(k, env) if k is not None else None
+            if f is not None:
+                out.append(f)
+    elif isinstance(expr, ast.DictComp):
+        f = _family(expr.key, env)
+        if f is not None:
+            out.append(f)
+    return out
+
+
+def render_family(fam: tuple) -> str:
+    return "".join(fam)
+
+
+def _canonical(fam: tuple) -> tuple:
+    """Separator-insensitive spelling for drift detection."""
+    return tuple(WILD if p == WILD else
+                 p.replace("-", "").replace("_", "").lower()
+                 for p in fam)
+
+
+class _Site:
+    __slots__ = ("mod", "line", "verb")
+
+    def __init__(self, mod: SourceModule, line: int, verb: str):
+        self.mod = mod
+        self.line = line
+        self.verb = verb
+
+
+class _Harvest:
+    """Whole-module-set key/wait/state/version harvest (shared by the
+    pass and proto_state_map)."""
+
+    def __init__(self, modules: List[SourceModule]):
+        self.writes: Dict[tuple, List[_Site]] = {}
+        self.reads: Dict[tuple, List[_Site]] = {}
+        # KVS wait-verb call lines per (module, function)
+        self.wait_calls: List[Tuple[SourceModule, ast.Call, str]] = []
+        self.ambig: List[Tuple[SourceModule, ast.Call, str, str,
+                               Optional[tuple]]] = []
+        self.versions: List[Tuple[SourceModule, str, int, int]] = []
+        self.wire_modules: List[SourceModule] = []
+        for mod in modules:
+            self._one_module(mod)
+        # second phase: ambiguous verbs whose key matches a family an
+        # unambiguous site already established
+        known = set(self.writes) | set(self.reads)
+        for mod, call, verb, role, fam in self.ambig:
+            if fam is None or fam not in known:
+                continue
+            site = _Site(mod, call.lineno, verb)
+            if role in ("w", "rw"):
+                self.writes.setdefault(fam, []).append(site)
+            if role in ("r", "rw"):
+                self.reads.setdefault(fam, []).append(site)
+            if verb in _WAIT_VERBS:
+                self.wait_calls.append((mod, call, verb))
+
+    # -- per module ------------------------------------------------------
+    def _one_module(self, mod: SourceModule) -> None:
+        tree = mod.tree
+        # one-level variable resolution (segkey = f"shm-seg-{leader}"):
+        # a module-wide env of simple string assignments — scoping is
+        # ignored (collisions across functions are vanishingly unlikely
+        # for key-shaped strings, and a wrong resolution only shifts
+        # which site records the family, never invents one)
+        env: Dict[str, ast.AST] = {}
+        for st in ast.walk(tree):
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name) \
+                    and isinstance(st.value,
+                                   (ast.Constant, ast.JoinedStr)):
+                env[st.targets[0].id] = st.value
+
+        # publication containers: names flowing into put_many(<name>)
+        containers: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "put_many" and node.args:
+                arg = node.args[0]
+                if isinstance(arg, (ast.Name, ast.Attribute)):
+                    t = arg.attr if isinstance(arg, ast.Attribute) \
+                        else arg.id
+                    containers.add(t)
+
+        self._walk_fn(mod, tree, env, containers)
+
+        # versioned-protocol constants
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and _VERSION_RE.match(node.targets[0].id):
+                v = const_int(node.value)
+                if v is not None:
+                    self.versions.append((mod, node.targets[0].id, v,
+                                          node.lineno))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) \
+                    and node.attr == _STATE_ATTR:
+                if mod not in self.wire_modules:
+                    self.wire_modules.append(mod)
+                break
+
+    def _walk_fn(self, mod: SourceModule, fn, env, containers) -> None:
+        for node in ast.walk(fn):
+            # container subscript stores: self._cards[key] = val
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Subscript):
+                tgt = node.targets[0]
+                t = tgt.value.attr if isinstance(tgt.value, ast.Attribute) \
+                    else (tgt.value.id if isinstance(tgt.value, ast.Name)
+                          else None)
+                if t in containers:
+                    fam = _family(tgt.slice, env)
+                    if fam is not None:
+                        self.writes.setdefault(fam, []).append(
+                            _Site(mod, node.lineno, "put_many"))
+                continue
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute):
+                continue
+            verb = node.func.attr
+            recv = node.func.value
+            line = node.lineno
+            if verb in _UNIQUE_WRITE:
+                if verb == "put_many" and node.args:
+                    for fam in _families_in_dict(node.args[0], env):
+                        self.writes.setdefault(fam, []).append(
+                            _Site(mod, line, verb))
+                elif verb == "publish" and node.args:
+                    fam = _family(node.args[0], env)
+                    if fam is not None:
+                        self.writes.setdefault(fam, []).append(
+                            _Site(mod, line, verb))
+            elif verb in _UNIQUE_READ:
+                if node.args:
+                    for fam in _families_in_seq(node.args[0], env):
+                        self.reads.setdefault(fam, []).append(
+                            _Site(mod, line, verb))
+                self.wait_calls.append((mod, node, verb))
+            elif verb in ("fence", "fence_begin"):
+                if not _is_kvs_chain(recv):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg == "cards":
+                        for fam in _families_in_dict(kw.value, env):
+                            self.writes.setdefault(fam, []).append(
+                                _Site(mod, line, verb))
+                self.wait_calls.append((mod, node, verb))
+            elif verb in (_AMBIG_WRITE | _AMBIG_READ | _AMBIG_RW):
+                fam = _family(node.args[0], env) if node.args else None
+                role = ("w" if verb in _AMBIG_WRITE else
+                        "r" if verb in _AMBIG_READ else "rw")
+                if _is_kvs_chain(recv):
+                    if fam is not None:
+                        site = _Site(mod, line, verb)
+                        if role in ("w", "rw"):
+                            self.writes.setdefault(fam, []).append(site)
+                        if role in ("r", "rw"):
+                            self.reads.setdefault(fam, []).append(site)
+                    if verb in _WAIT_VERBS:
+                        self.wait_calls.append((mod, node, verb))
+                else:
+                    self.ambig.append((mod, node, verb, role, fam))
+
+
+class ProtoPass(LintPass):
+    id = "proto"
+    doc = ("KVS key-flow doctor (write-only / never-written / drifted "
+           "key families), bounded-deadline check on KVS retry loops, "
+           "wire-state totality, *_VERSION compatibility")
+
+    def run(self, modules: List[SourceModule]) -> List[Finding]:
+        out: List[Finding] = []
+        h = _Harvest(modules)
+
+        def emit(mod: SourceModule, line: int, msg: str) -> None:
+            f = self.finding(mod, line, msg)
+            if f is not None:
+                out.append(f)
+
+        # -- key flow ----------------------------------------------------
+        by_canon: Dict[tuple, Set[tuple]] = {}
+        for fam in set(h.writes) | set(h.reads):
+            by_canon.setdefault(_canonical(fam), set()).add(fam)
+        drifted: Set[tuple] = set()
+        for canon, fams in sorted(by_canon.items()):
+            if len(fams) < 2:
+                continue
+            drifted |= fams
+            names = " vs ".join(sorted(render_family(f) for f in fams))
+            site = min((s for f in fams
+                        for s in h.writes.get(f, []) + h.reads.get(f, [])),
+                       key=lambda s: (s.mod.relpath, s.line))
+            emit(site.mod, site.line,
+                 f"KVS key-family drift: {names} differ only in "
+                 "separator spelling — one side will never match the "
+                 "other (silent hang)")
+        for fam in sorted(set(h.writes) - set(h.reads) - drifted):
+            site = h.writes[fam][0]
+            emit(site.mod, site.line,
+                 f"KVS key family '{render_family(fam)}' is written "
+                 f"({site.verb}) but never read anywhere — dead "
+                 "publication or a mis-spelled consumer")
+        for fam in sorted(set(h.reads) - set(h.writes) - drifted):
+            site = h.reads[fam][0]
+            emit(site.mod, site.line,
+                 f"KVS key family '{render_family(fam)}' is read "
+                 f"({site.verb}) but never written anywhere — its "
+                 "consumer blocks forever")
+
+        # -- deadline doctor ---------------------------------------------
+        out.extend(self._deadline_doctor(modules, h))
+        # -- wire-state totality -----------------------------------------
+        for mod in h.wire_modules:
+            out.extend(self._wire_doctor(mod))
+        # -- version compatibility ---------------------------------------
+        out.extend(self._version_doctor(modules, h))
+        out.sort(key=lambda f: (f.path, f.line, f.msg))
+        return out
+
+    # ------------------------------------------------------------------
+    def _deadline_doctor(self, modules: List[SourceModule],
+                         h: _Harvest) -> List[Finding]:
+        out: List[Finding] = []
+        wait_lines: Dict[SourceModule, Set[int]] = {}
+        for mod, call, _verb in h.wait_calls:
+            wait_lines.setdefault(mod, set()).add(call.lineno)
+        for mod in modules:
+            lines = wait_lines.get(mod, set())
+            # functions containing a wait verb (for one-level expansion)
+            fn_waits: Set[str] = set()
+            fns: Dict[str, ast.AST] = {}
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    fns[node.name] = node
+                    span = {n.lineno for n in ast.walk(node)
+                            if hasattr(n, "lineno")}
+                    if span & lines:
+                        fn_waits.add(node.name)
+            if not lines and not fn_waits:
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.While):
+                    continue
+                body_lines = {n.lineno for n in ast.walk(node)
+                              if hasattr(n, "lineno")}
+                is_wait = bool(body_lines & lines)
+                if not is_wait:
+                    # one level of same-module call expansion
+                    # (ensure_wired's loop drives _wire_step's peeks)
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Call):
+                            name = sub.func.attr \
+                                if isinstance(sub.func, ast.Attribute) \
+                                else (sub.func.id
+                                      if isinstance(sub.func, ast.Name)
+                                      else None)
+                            if name in fn_waits:
+                                is_wait = True
+                                break
+                if not is_wait:
+                    continue
+                if self._loop_bounded(mod, node):
+                    continue
+                verb = next((v for m, c, v in h.wait_calls
+                             if m is mod and c.lineno in body_lines),
+                            "kvs wait")
+                f = self.finding(
+                    mod, node.lineno,
+                    f"unbounded KVS wait: retry loop around '{verb}' "
+                    "carries no deadline — add a bounded deadline "
+                    "(the MV2T_WIRE_TIMEOUT shape) or annotate "
+                    "'# proto: bounded-by(<cvar-or-rationale>)'")
+                if f is not None:
+                    out.append(f)
+        return out
+
+    @staticmethod
+    def _loop_bounded(mod: SourceModule, loop: ast.While) -> bool:
+        for line in range(loop.lineno,
+                          getattr(loop, "end_lineno", loop.lineno) + 1):
+            if _BOUNDED_BY_RE.search(mod.comment(line)):
+                return True
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Compare):
+                for side in [node.left] + list(node.comparators):
+                    t = side.attr if isinstance(side, ast.Attribute) \
+                        else (side.id if isinstance(side, ast.Name)
+                              else None)
+                    if t and any(b in t.lower() for b in _BOUND_NAMES):
+                        return True
+        return False
+
+    # ------------------------------------------------------------------
+    _DEATH_NAMES = {"dead", "failed", "failed_ranks",
+                    "check_peer_leases", "PeerDeadError"}
+
+    def _wire_doctor(self, mod: SourceModule) -> List[Finding]:
+        out: List[Finding] = []
+        assigned: Dict[int, int] = {}
+        handled: Dict[int, Tuple[int, ast.AST]] = {}
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(mod.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and t.attr == _STATE_ATTR:
+                        v = const_int(node.value)
+                        if v is not None and v not in assigned:
+                            assigned[v] = node.lineno
+            elif isinstance(node, ast.Compare):
+                sides = [node.left] + list(node.comparators)
+                if any(isinstance(s, ast.Attribute)
+                       and s.attr == _STATE_ATTR for s in sides):
+                    for s in sides:
+                        v = const_int(s)
+                        if v is not None and v not in handled:
+                            fn = node
+                            while fn in parents and not isinstance(
+                                    fn, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                                fn = parents[fn]
+                            handled[v] = (node.lineno, fn)
+
+        def emit(line: int, msg: str) -> None:
+            f = self.finding(mod, line, msg)
+            if f is not None:
+                out.append(f)
+
+        for v, line in sorted(assigned.items()):
+            if v not in handled:
+                emit(line, f"wire state {v} is entered "
+                     f"('{_STATE_ATTR} = {v}') but no handler compares "
+                     "against it — the state machine is not total "
+                     "(a rank parked in it never advances)")
+        for v, (line, fn) in sorted(handled.items()):
+            ann = mod.annotation(line, "state")
+            if ann != f"wire:{v}":
+                emit(line, f"wire state {v} handler lacks its "
+                     f"'# state: wire:{v}' annotation")
+            names = set()
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Attribute):
+                    names.add(sub.attr)
+                elif isinstance(sub, ast.Name):
+                    names.add(sub.id)
+            if not (names & self._DEATH_NAMES):
+                emit(line, f"wire state {v} handler has no exit on "
+                     "peer death (no dead/failed reference in "
+                     f"'{getattr(fn, 'name', '<module>')}') — a peer "
+                     "SIGKILLed mid-wire parks this state forever")
+        return out
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _is_version_field(node: ast.AST) -> bool:
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "get" and node.args:
+            a = node.args[0]
+            return isinstance(a, ast.Constant) \
+                and a.value in ("version", "v")
+        if isinstance(node, ast.Subscript):
+            s = node.slice
+            return isinstance(s, ast.Constant) \
+                and s.value in ("version", "v")
+        return False
+
+    def _version_doctor(self, modules: List[SourceModule],
+                        h: _Harvest) -> List[Finding]:
+        out: List[Finding] = []
+        for mod, name, value, line in h.versions:
+            stem = name[:-len("_VERSION")].lower()
+            for v in range(1, value):
+                pat = re.compile(rf"proto:\s*{re.escape(stem)}-v{v}\b")
+                if not any(pat.search(c) for c in mod.comments.values()):
+                    f = self.finding(
+                        mod, line,
+                        f"{name} is {value} but no "
+                        f"'# proto: {stem}-v{v}' compatibility handler "
+                        f"is annotated in {mod.relpath} — every "
+                        "consumer must handle every version <= current")
+                    if f is not None:
+                        out.append(f)
+        version_mods = {mod for mod, *_ in h.versions}
+        for mod in version_mods:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Compare):
+                    continue
+                sides = [node.left] + list(node.comparators)
+                if not any(self._is_version_field(s) for s in sides):
+                    continue
+                for s in sides:
+                    if isinstance(s, ast.Constant) \
+                            and isinstance(s.value, int) \
+                            and not isinstance(s.value, bool):
+                        f = self.finding(
+                            mod, node.lineno,
+                            f"version field compared against the "
+                            f"literal {s.value} — compare against the "
+                            "*_VERSION constant so a bump cannot "
+                            "orphan this consumer")
+                        if f is not None:
+                            out.append(f)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the exported control-plane map (watchdog / mpistat parity with
+# shared_field_map / device_lane_map)
+# ---------------------------------------------------------------------------
+
+_state_map_cache: Optional[dict] = None
+
+
+def proto_state_map(refresh: bool = False) -> dict:
+    """Key-flow / wire-state / version map of the committed tree:
+
+        {"keys": {family: {"writes": n, "reads": n,
+                           "modules": [...]}},
+         "wire_states": {k: {"module", "line", "annotated"}},
+         "versions": {name: value},
+         "waits": n_bounded_kvs_wait_loops}
+    """
+    global _state_map_cache
+    if _state_map_cache is not None and not refresh:
+        return _state_map_cache
+    from .core import PKG_ROOT, scan_paths
+    modules, _errs = scan_paths([PKG_ROOT])
+    h = _Harvest(modules)
+    keys: Dict[str, dict] = {}
+    for fam in sorted(set(h.writes) | set(h.reads),
+                      key=render_family):
+        w = h.writes.get(fam, [])
+        r = h.reads.get(fam, [])
+        keys[render_family(fam)] = {
+            "writes": len(w), "reads": len(r),
+            "modules": sorted({s.mod.relpath for s in w + r})}
+    wire: Dict[int, dict] = {}
+    for mod in h.wire_modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Compare):
+                sides = [node.left] + list(node.comparators)
+                if any(isinstance(s, ast.Attribute)
+                       and s.attr == _STATE_ATTR for s in sides):
+                    for s in sides:
+                        v = const_int(s)
+                        if v is not None:
+                            wire[v] = {
+                                "module": mod.relpath,
+                                "line": node.lineno,
+                                "annotated": mod.annotation(
+                                    node.lineno, "state")
+                                == f"wire:{v}"}
+    _state_map_cache = {
+        "keys": keys,
+        "wire_states": wire,
+        "versions": {name: value for _m, name, value, _l in h.versions},
+        "waits": len({(m.relpath, c.lineno)
+                      for m, c, _v in h.wait_calls}),
+    }
+    return _state_map_cache

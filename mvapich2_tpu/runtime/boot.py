@@ -403,7 +403,11 @@ def _start_failure_watcher(boot: BootState) -> None:
             # between failure events (or see none at all)
             w = KVSClient(boot.kvs_addr, timeout=None)
             n = 0
-            while True:
+            # bounded by the KVS connection itself, not a deadline: the
+            # launcher closing its server (job teardown) errors the
+            # blocking get; a watcher must outwait arbitrarily long
+            # healthy stretches between failure events
+            while True:   # proto: bounded-by(kvs-connection-lifetime)
                 dead = int(w.get(f"__failure_ev_{n}"))   # blocks until put
                 boot.mark_failed(dead)
                 n += 1

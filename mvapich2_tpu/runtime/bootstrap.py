@@ -188,7 +188,10 @@ def _start_failure_watcher(u: Universe, kvs_addr: str) -> None:
             # between failure events (or see none at all)
             w = KVSClient(kvs_addr, timeout=None)
             n = 0
-            while True:
+            # bounded by the KVS connection itself (launcher teardown
+            # errors the blocking get), not a deadline — see the
+            # original-world watcher in runtime/boot.py
+            while True:   # proto: bounded-by(kvs-connection-lifetime)
                 dead = int(w.get(f"__failure_ev_{n}"))   # blocks until put
                 u.mark_failed(dead)
                 n += 1

@@ -196,7 +196,7 @@ def claim(n_local: int, ring_bytes: int, part_bytes: int,
                 s = {"state": "free", "epoch": 0, "owner_pid": 0,
                      "files": files, "sizes": sizes}
                 m["sets"][key] = s
-            elif "flat2" not in s.get("files", {}):
+            elif "flat2" not in s.get("files", {}):  # proto: manifest-v1
                 # pre-v2 set surviving a daemon version adoption:
                 # provision the new segment in place (reset below zeroes
                 # it like every other file)

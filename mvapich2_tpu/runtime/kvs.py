@@ -175,6 +175,13 @@ class KVSServer:
             self.state.data[key] = val
             self.state.cond.notify_all()
 
+    def peek(self, key: str) -> Optional[str]:
+        """Launcher-side nonblocking read (agent-protocol consumption:
+        launch_tree polls __agent_up_<node> / __agent_exit_<node>
+        without paying itself a client connection)."""
+        with self.state.cond:
+            return self.state.data.get(key)
+
     def shutdown(self) -> None:
         self._srv.shutdown()
         self._srv.server_close()

@@ -236,10 +236,31 @@ def launch_tree(nranks: int, argv: List[str], hostfile_path: str,
                      " ".join(shlex.quote(c) for c in cmd)]))
         deadline = time.monotonic() + timeout if timeout else None
         rcs: List[Optional[int]] = [None] * len(agents)
+        nodes = list(by_node)
+        # agent protocol consumption (runtime/mpispawn.py publishes
+        # these): __agent_up_<node> distinguishes "ssh/boot failed
+        # before any rank started" from "ranks ran and failed", and
+        # __agent_exit_<node> carries the per-rank exit map for the
+        # failure diagnostic — without reading them a dead agent is a
+        # bare nonzero rc with no indication whether its node ever
+        # joined the job
+        agents_up: set = set()
+        exit_reports: dict = {}
         while any(c is None for c in rcs):
             for i, a in enumerate(agents):
                 if rcs[i] is None:
                     rcs[i] = a.poll()
+            for node in nodes:
+                if node not in agents_up \
+                        and srv.peek(f"__agent_up_{node}") is not None:
+                    agents_up.add(node)
+                if node not in exit_reports:
+                    raw = srv.peek(f"__agent_exit_{node}")
+                    if raw:
+                        try:
+                            exit_reports[node] = _json.loads(raw)
+                        except ValueError:
+                            exit_reports[node] = {}
             if srv.state.aborted is not None:
                 # MPI_Abort: tear the whole tree down (agents SIGTERM
                 # their rank processes); propagate the abort errorcode
@@ -251,6 +272,17 @@ def launch_tree(nranks: int, argv: List[str], hostfile_path: str,
                 return _abort_exit_code(srv.state.aborted)
             bad = [c for c in rcs if c is not None and c != 0]
             if bad and not ft:
+                for i, c in enumerate(rcs):
+                    if c is not None and c != 0:
+                        node = nodes[i]
+                        if node not in agents_up:
+                            print(f"mpirun: agent for node {node} died "
+                                  f"(rc {c}) before starting any rank "
+                                  "— ssh/boot failure?", file=sys.stderr)
+                        elif node in exit_reports:
+                            print(f"mpirun: node {node} rank exits: "
+                                  f"{exit_reports[node]}",
+                                  file=sys.stderr)
                 _stop_agents(agents)
                 return max(bad)
             if any(c is not None and c < 0 for c in rcs):

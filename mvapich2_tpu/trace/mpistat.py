@@ -272,6 +272,40 @@ def snapshot(stem: str, trace_tail: int = 8,
     return out
 
 
+def daemon_lines(daemon_dir: Optional[str] = None) -> List[str]:
+    """Warm-attach daemon control-plane section: manifest version,
+    daemon liveness, per-set claim state/epoch/owner — the claim-cycle
+    counterpart of the per-rank wiring view (nothing here touches the
+    job either: one manifest.json read)."""
+    if daemon_dir is None:
+        try:
+            from ..runtime.daemon import default_dir
+            daemon_dir = default_dir()
+        except Exception:
+            return []
+    path = os.path.join(daemon_dir, "manifest.json")
+    try:
+        with open(path) as f:
+            m = json.load(f)
+    except (OSError, ValueError):
+        return []
+    pid = m.get("daemon_pid", 0)
+    alive = False
+    if pid:
+        try:
+            os.kill(pid, 0)
+            alive = True
+        except OSError:
+            alive = False
+    out = [f"# daemon manifest v{m.get('version')} ({daemon_dir}, "
+           f"daemon pid {pid} {'alive' if alive else 'absent'})"]
+    for key, s in sorted(m.get("sets", {}).items()):
+        out.append(f"  set {key}: {s.get('state')} "
+                   f"epoch={s.get('epoch')} "
+                   f"owner={s.get('owner_pid') or '-'}")
+    return out
+
+
 # ---------------------------------------------------------------------------
 # rendering
 # ---------------------------------------------------------------------------
@@ -337,6 +371,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "harvested by the mv2tlint device pass) and "
                          "exit — the key for reading a hung device "
                          "job's kernel state")
+    ap.add_argument("--proto-map", action="store_true",
+                    help="print the static control-plane protocol map "
+                         "(KVS key families, wire states, version "
+                         "constants harvested by the mv2tlint proto "
+                         "pass) and exit — the key for reading a job "
+                         "hung in bootstrap/wiring")
     opts = ap.parse_args(argv)
 
     if opts.device_map:
@@ -346,8 +386,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         for ln in device_map_lines():
             print(ln)
         return 0
+    if opts.proto_map:
+        from .watchdog import proto_map_lines
+        for ln in proto_map_lines():
+            print(ln)
+        return 0
 
     def render() -> int:
+        for ln in daemon_lines(opts.daemon_dir):
+            print(ln)
         stems = find_segments(opts.seg, opts.daemon_dir)
         if not stems:
             print("mpistat: no live mv2t segment sets found "

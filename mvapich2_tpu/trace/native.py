@@ -32,6 +32,7 @@ the stamp check bounds the exposure to records claimed while we read.
 
 from __future__ import annotations
 
+import contextlib
 import mmap
 import os
 import struct
@@ -125,12 +126,19 @@ def read_ring(path: str, rank_index: int,
               last: Optional[int] = None) -> List[Tuple]:
     """Decode one local rank's ring from the segment file.
 
+    ``path`` may also be an already-open binary file (a channel's own
+    fd, held since attach): the segment owner unlinks the file at ITS
+    close, which can precede a slower rank's Finalize drain — reading
+    through the held fd keeps the lane alive across that teardown skew.
+
     Returns ``[(ts_us, event_id, a1, a2), ...]`` oldest-first, at most
     ``last`` events (None = the full live window). Unfilled and
     mid-overwrite slots are dropped (see the module docstring)."""
     stride = _NTR_HDR_BYTES + _NTR_RING_EVENTS * _NTR_EV_BYTES
     base = _NTR_FILE_HDR + rank_index * stride
-    with open(path, "rb") as f:
+    with contextlib.ExitStack() as stack:
+        f = stack.enter_context(open(path, "rb")) \
+            if isinstance(path, str) else path
         mm = mmap.mmap(f.fileno(), 0, prot=mmap.PROT_READ)
         try:
             seq = struct.unpack_from("<Q", mm, base)[0]
@@ -152,9 +160,12 @@ def read_ring(path: str, rank_index: int,
 
 
 def ring_depth(path: str, rank_index: int) -> int:
-    """Total events ever claimed by one rank (the header seq)."""
+    """Total events ever claimed by one rank (the header seq).
+    ``path`` may be an open binary file, like read_ring's."""
     stride = _NTR_HDR_BYTES + _NTR_RING_EVENTS * _NTR_EV_BYTES
-    with open(path, "rb") as f:
+    with contextlib.ExitStack() as stack:
+        f = stack.enter_context(open(path, "rb")) \
+            if isinstance(path, str) else path
         mm = mmap.mmap(f.fileno(), 0, prot=mmap.PROT_READ)
         try:
             return struct.unpack_from(
@@ -167,10 +178,14 @@ def ring_depth(path: str, rank_index: int) -> int:
 # consumer surfaces
 # ---------------------------------------------------------------------------
 
-def _channel_ring(channel) -> Optional[str]:
-    """The live segment path of a plane channel, or None."""
+def _channel_ring(channel):
+    """The channel's readable ring — its own held fd when live (immune
+    to the owner's teardown unlink), else the segment path — or None."""
     if channel is None or not getattr(channel, "plane", None):
         return None
+    f = getattr(channel, "_ntrace_f", None)
+    if f is not None and not f.closed:
+        return f
     path = getattr(channel, "_ntrace_path", None)
     if not path or not os.path.exists(path):
         return None

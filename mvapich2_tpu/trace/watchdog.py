@@ -118,6 +118,14 @@ def build_report(engine) -> str:
         except Exception as e:
             lines.append(f"## native trace tail unavailable: {e!r}")
         lines.extend(_protocol_map_lines(fmap))
+        # control-plane forensics: a job wedged BEFORE the datapath —
+        # mid-wire, mid-claim, waiting on a bootstrap card — shows up
+        # here as "stage 1, 2 peers bell-less, wire deadline in 83s"
+        # instead of a blind stall
+        try:
+            lines.extend(_control_report(pch))
+        except Exception as e:
+            lines.append(f"## control-plane state unavailable: {e!r}")
 
     # device-lane forensics: a rank wedged inside a device collective
     # hangs in the rendezvous or inside a Mosaic kernel whose
@@ -176,6 +184,71 @@ def _device_report(u) -> list:
     except Exception:
         pass
     lines.extend(device_map_lines())
+    return lines
+
+
+def _control_report(pch) -> list:
+    """Live control-plane section: per-peer wiring stage, daemon claim
+    epoch + manifest version, the in-flight wire-gate deadline — then
+    the static key/state map the mv2tlint proto pass harvests."""
+    wired = getattr(pch, "_wired", None)
+    stage = getattr(pch, "_wire_stage", None)
+    lines = [f"## control-plane state (wired={wired}, "
+             f"wire stage={stage})"]
+    bells = getattr(pch, "_peer_bells", {}) or {}
+    for w in getattr(pch, "local_ranks", []):
+        if w == pch.my_rank:
+            continue
+        lines.append(f"  peer {w}: bell "
+                     f"{'set' if w in bells else 'UNSET'}"
+                     f"{' [C-ABI]' if w in pch.cabi_ranks else ''}")
+    dl = getattr(pch, "_wire_deadline", 0.0)
+    if not wired and dl:
+        lines.append(f"  in-flight KVS wait: wire gate, deadline in "
+                     f"{max(0.0, dl - time.monotonic()):.1f}s "
+                     "(MV2T_WIRE_TIMEOUT)")
+    try:
+        from ..runtime import boot as bootmod
+        from ..runtime.daemon import MANIFEST_VERSION
+        b = bootmod.current_boot()
+        cl = getattr(b, "daemon_claim", None) if b is not None else None
+        if cl is not None:
+            lines.append(f"  daemon claim: geokey {cl.geokey} epoch "
+                         f"{cl.epoch} (manifest v{MANIFEST_VERSION})")
+    except Exception:
+        pass
+    lines.extend(proto_map_lines())
+    return lines
+
+
+def proto_map_lines(max_keys: int = 24) -> list:
+    """The static control-plane protocol map (KVS key families +
+    wire states + version constants) harvested by the mv2tlint proto
+    pass — shared by this report and ``mpistat --proto-map``."""
+    try:
+        from ..analysis.proto import proto_state_map
+        m = proto_state_map()
+    except Exception:
+        m = {}
+    if not m:
+        return ["## control-plane protocol map unavailable (proto "
+                "sources not parseable)"]
+    lines = ["## control-plane protocol map (mv2tlint proto pass)"]
+    ws = m.get("wire_states", {})
+    if ws:
+        lines.append("  wire states: " + "  ".join(
+            f"{k} @ {v['module'].rsplit('/', 1)[-1]}:{v['line']}"
+            for k, v in sorted(ws.items())))
+    for name, ver in sorted(m.get("versions", {}).items()):
+        lines.append(f"  version constant: {name} = {ver}")
+    keys = m.get("keys", {})
+    lines.append(f"  kvs key families ({len(keys)}; write/read sites):")
+    for i, (fam, info) in enumerate(sorted(keys.items())):
+        if i >= max_keys:
+            lines.append(f"    ... ({len(keys) - max_keys} more)")
+            break
+        lines.append(f"    {fam}: {info['writes']}w/{info['reads']}r "
+                     f"({', '.join(info['modules'])})")
     return lines
 
 

@@ -705,6 +705,7 @@ class ShmChannel(Channel):
         # puts it beside the claimed ring, reset implicitly by the
         # monotonic timestamps — trace/native.py drops zero-ts slots)
         self._ntrace_path = f"{path}.ntrace"
+        self._ntrace_f = None          # this rank's own fd on the ring
         self._flat_cb = None           # keepalive for the ctypes callback
         self.cabi_ranks = set()        # local ranks that are C-ABI procs
         if self.using_native and get_config()["USE_CPLANE"]:
@@ -760,6 +761,15 @@ class ShmChannel(Channel):
                 if _nt.ntrace_enabled():
                     lib.cp_ntrace_attach(self.plane,
                                          self._ntrace_path.encode(), 1)
+                    # hold our own fd on the ring: the segment OWNER
+                    # unlinks the file at its close, which can precede
+                    # a slower rank's Finalize drain (teardown skew) —
+                    # an unlinked-but-open inode stays readable, so
+                    # this rank's trace lane cannot silently vanish
+                    try:
+                        self._ntrace_f = open(self._ntrace_path, "rb")
+                    except OSError:
+                        self._ntrace_f = None
                 # bind the plane counters' sources to this live plane:
                 # fast-path hit-rate is the one number that says
                 # whether a workload actually rides the C path — it
@@ -786,6 +796,8 @@ class ShmChannel(Channel):
         self._wire_stage = 0           # 0=idle, 1=verdict published
         self._wire_eager = False       # attribution for the wiring pvars
         self._wire_try_at = 0.0        # opportunistic-probe throttle
+        self._wire_deadline = 0.0      # live ensure_wired deadline
+                                       # (watchdog control-plane report)
         from ..analysis.lockorder import tracked as _tracked
         self._wire_lock = _tracked(threading.Lock(),
                                    f"shm[{my_rank}]._wire_lock")
@@ -1052,6 +1064,7 @@ class ShmChannel(Channel):
         self._wire_eager = eager or self._wire_eager
         deadline = time.monotonic() + max(
             1.0, float(get_config().get("WIRE_TIMEOUT", 120.0)))
+        self._wire_deadline = deadline
         while True:
             with self._wire_lock:
                 if self._wire_step():
@@ -1099,7 +1112,7 @@ class ShmChannel(Channel):
                 if r != self.my_rank and r in failed]
         peers = [r for r in self.local_ranks
                  if r != self.my_rank and r not in failed]
-        if self._wire_stage == 0:
+        if self._wire_stage == 0:   # state: wire:0
             vals = self.kvs.peek_many(
                 [f"shm-bell-{r}" for r in peers]
                 + [f"shm-cma-{r}" for r in peers])
@@ -1154,7 +1167,7 @@ class ShmChannel(Channel):
             })
             self.cabi_ranks = {self.my_rank} if my_cabi else set()
             self._wire_stage = 1
-        if self._wire_stage == 1:
+        if self._wire_stage == 1:   # state: wire:1
             vals = self.kvs.peek_many(
                 [f"shm-cma-ok-{r}" for r in peers]
                 + [f"shm-arena-ok-{r}" for r in peers]
@@ -1672,3 +1685,9 @@ class ShmChannel(Channel):
                         os.unlink(path)
                     except OSError:
                         pass
+        if self._ntrace_f is not None:
+            try:
+                self._ntrace_f.close()
+            except OSError:
+                pass
+            self._ntrace_f = None

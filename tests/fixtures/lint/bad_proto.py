@@ -1,0 +1,49 @@
+"""Seeded control-plane fixture for the proto pass: exactly one finding
+per invariant class — write-only key, never-written key, key-family
+drift (which subsumes its orphan findings), unbounded KVS retry loop,
+non-total wire state, and a version-skew consumer."""
+
+
+def publish_cards(kvs, rank):
+    # write-only family: nothing anywhere reads fixture-orphan-<r>
+    kvs.put(f"fixture-orphan-{rank}", "1")
+    # one side of the drift pair (dash spelling)
+    kvs.put(f"boot-card-{rank}", "ready")
+
+
+def consume_cards(kvs, rank):
+    # never-written family: this consumer blocks forever
+    val = kvs.get(f"fixture-ghost-{rank}")
+    # the other side of the drift pair (underscore spelling): will
+    # never match the dash writer above — the silent-hang class
+    card = kvs.get(f"boot_card-{rank}")
+    return val, card
+
+
+def wait_for_peers(kvs, peers):
+    got = []
+    # unbounded KVS retry loop: no deadline, no bounded-by annotation
+    while len(got) < len(peers):
+        vals = kvs.peek_many([f"boot-card-{r}" for r in peers])
+        got = [v for v in vals if v is not None]
+    return got
+
+
+class Wire:
+    def __init__(self):
+        self._wire_stage = 0
+
+    def step(self, kvs):
+        dead = []                      # the peer-death exit reference
+        if self._wire_stage == 0:      # state: wire:0
+            if not dead:
+                # stage 2 is entered but NO handler compares against
+                # it: the machine is not total
+                self._wire_stage = 2
+        return False
+
+
+FIXTURE_MANIFEST_VERSION = 3
+# proto: fixture_manifest-v1   (the v1 upgrade path exists ...)
+# ... but no fixture_manifest-v2 handler was ever written: consumers
+# of a v2 manifest are orphaned — the version-skew class.

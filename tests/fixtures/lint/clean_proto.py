@@ -1,0 +1,62 @@
+"""Control-plane-shaped fixture that satisfies every proto-pass
+doctor: paired key families, a deadline-bounded retry loop, an
+annotated rationale-bounded loop, a total wire-state machine with
+peer-death exits, and a version constant with its compatibility
+handler annotated."""
+
+import time
+
+
+def publish_cards(kvs, rank):
+    kvs.put(f"clean-card-{rank}", "ready")
+    kvs.put_many({f"clean-verdict-{rank}": "1"})
+
+
+def consume_cards(kvs, peers):
+    return kvs.peek_many([f"clean-card-{r}" for r in peers]
+                         + [f"clean-verdict-{r}" for r in peers])
+
+
+def wait_for_peers(kvs, peers, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    got = []
+    while len(got) < len(peers):
+        if time.monotonic() > deadline:
+            raise OSError("peers never published their cards")
+        vals = kvs.peek_many([f"clean-card-{r}" for r in peers])
+        got = [v for v in vals if v is not None]
+    return got
+
+
+def watch_events(kvs, sink):
+    n = 0
+    # a watcher outwaits arbitrarily long healthy stretches; the KVS
+    # connection closing at teardown errors the blocking get
+    while True:    # proto: bounded-by(kvs-connection-lifetime)
+        sink(kvs.get(f"clean-card-{n}"))
+        n += 1
+
+
+class Wire:
+    def __init__(self):
+        self._wire_stage = 0
+
+    def step(self, failed):
+        dead = [r for r in failed]
+        if self._wire_stage == 0:      # state: wire:0
+            if dead:
+                return False
+            self._wire_stage = 1
+        if self._wire_stage == 1:      # state: wire:1
+            if dead:
+                return False
+            return True
+        return False
+
+
+CLEAN_CARD_VERSION = 2
+# proto: clean_card-v1 — v1 cards are upgraded in place here.
+
+
+def check_version(card):
+    return card.get("v") == CLEAN_CARD_VERSION

@@ -660,6 +660,265 @@ def test_runtests_modelcheck_lane_wired():
     assert "--modelcheck" in r.stdout
 
 
+# -- the proto pass: control-plane verification (ISSUE 13) ---------------
+
+_PKG_MODULES_CACHE = []
+
+
+def _pkg_modules():
+    # parsed once per session: SourceModules are read-only for passes,
+    # and the ~130-file parse would otherwise repeat per mutation test
+    if not _PKG_MODULES_CACHE:
+        from mvapich2_tpu.analysis import core as acore
+        mods, errs = acore.scan_paths(
+            [os.path.join(REPO, "mvapich2_tpu")])
+        assert not errs
+        _PKG_MODULES_CACHE.append(mods)
+    return list(_PKG_MODULES_CACHE[0])
+
+
+def _mutated_pkg_modules(rel_suffix, transform):
+    """The whole-package module set with ONE module's source mutated —
+    the reintroduce-the-class harness (key flow is cross-module, so
+    the mutation must be judged against the full tree)."""
+    from mvapich2_tpu.analysis import core as acore
+    out = []
+    hit = False
+    for m in _pkg_modules():
+        if m.relpath.endswith(rel_suffix):
+            src = transform(m.text)
+            assert src != m.text, f"mutation did not apply to {rel_suffix}"
+            out.append(acore.SourceModule(m.path, src))
+            hit = True
+        else:
+            out.append(m)
+    assert hit, rel_suffix
+    return out
+
+
+def test_proto_pass_fixture():
+    """Seeded control-plane fixture: exact finding count and
+    locations, one per invariant class — write-only key, drift pair
+    (subsuming its orphans), never-written key, unbounded KVS retry
+    loop, non-total wire state, version-skew consumer."""
+    fs = _lint("bad_proto.py")
+    assert _locs(fs, "proto") == [
+        ("proto", 9),    # fixture-orphan-<*> written, never read
+        ("proto", 11),   # boot-card-<*> vs boot_card-<*> drift
+        ("proto", 16),   # fixture-ghost-<*> read, never written
+        ("proto", 26),   # peek_many retry loop without a deadline
+        ("proto", 42),   # wire stage 2 entered, never handled
+        ("proto", 46),   # FIXTURE_MANIFEST_VERSION skew (no v2 handler)
+    ]
+    assert len(fs) == 6
+    msgs = "\n".join(f.msg for f in fs)
+    assert "fixture-orphan-<*>" in msgs and "never read" in msgs
+    assert "boot-card-<*> vs boot_card-<*>" in msgs
+    assert "fixture-ghost-<*>" in msgs and "blocks forever" in msgs
+    assert "unbounded KVS wait" in msgs and "bounded-by" in msgs
+    assert "not total" in msgs
+    assert "fixture_manifest-v2" in msgs
+
+
+def test_clean_proto_fixture_zero_findings():
+    assert _lint("clean_proto.py") == []
+
+
+def test_proto_pass_in_default_gate():
+    """The tier-1 strict gate runs 9 passes including proto — a new
+    unbaselined control-plane finding fails tier-1 through
+    test_repo_strict_clean."""
+    ids = [p.id for p in core.all_passes()]
+    assert "proto" in ids and len(ids) == 9
+
+
+def test_proto_baseline_ratchet_stays_empty():
+    """Strict mode for the new pass: the committed baseline carries NO
+    proto entries — every genuine finding was fixed by change, and new
+    ones cannot be baselined away silently."""
+    bl = core.load_baseline()
+    assert [e for e in bl.entries if e.get("pass") == "proto"] == []
+
+
+def test_proto_pass_committed_tree_clean():
+    """The committed control plane is clean under the proto pass —
+    every genuine seed finding (write-only __agent_up_/__agent_exit_
+    keys, timeout-less failure-watcher loops, unannotated wire states,
+    the missing manifest-v1 handler annotation) is FIXED, not
+    baselined."""
+    from mvapich2_tpu.analysis.proto import ProtoPass
+    assert ProtoPass().run(_pkg_modules()) == []
+
+
+def test_proto_catches_agent_key_orphan_mutation():
+    """Reintroduce the seed class: drop launch_tree's agent-protocol
+    consumption and the __agent_up_/__agent_exit_ families go
+    write-only again."""
+    from mvapich2_tpu.analysis.proto import ProtoPass
+    mods = _mutated_pkg_modules(
+        "runtime/launcher.py",
+        lambda s: s.replace('srv.peek(f"__agent_up_{node}")', "None")
+                   .replace('srv.peek(f"__agent_exit_{node}")', "None"))
+    fs = ProtoPass().run(mods)
+    msgs = "\n".join(f.msg for f in fs)
+    assert "'__agent_up_<*>' is written" in msgs, msgs
+    assert "'__agent_exit_<*>' is written" in msgs
+
+
+def test_proto_catches_key_family_drift_mutation():
+    """THE motivating class: drift the verdict card's spelling
+    (shm-cabi- -> shm_cabi-) on the write side only — the pass names
+    both spellings instead of letting np=4 hang silently."""
+    from mvapich2_tpu.analysis.proto import ProtoPass
+    mods = _mutated_pkg_modules(
+        "transport/shm.py",
+        lambda s: s.replace('f"shm-cabi-{self.my_rank}": "1" if my_cabi',
+                            'f"shm_cabi-{self.my_rank}": "1" if my_cabi'))
+    fs = ProtoPass().run(mods)
+    assert any("drift" in f.msg and "shm-cabi-<*>" in f.msg
+               and "shm_cabi-<*>" in f.msg for f in fs), \
+        [f.msg for f in fs]
+
+
+def test_proto_catches_unbounded_watcher_mutation():
+    """Strip the failure watcher's bounded-by annotation: the
+    timeout-less retry loop is a finding again."""
+    from mvapich2_tpu.analysis.proto import ProtoPass
+    mods = _mutated_pkg_modules(
+        "runtime/boot.py",
+        lambda s: s.replace(
+            "# proto: bounded-by(kvs-connection-lifetime)", "", 1))
+    fs = ProtoPass().run(mods)
+    assert any("unbounded KVS wait" in f.msg
+               and f.path.endswith("runtime/boot.py") for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_proto_catches_wire_state_mutations():
+    """Strip a wire-state annotation AND add an unreachable stage:
+    both the annotation discipline and totality bite."""
+    from mvapich2_tpu.analysis.proto import ProtoPass
+    mods = _mutated_pkg_modules(
+        "transport/shm.py",
+        lambda s: s.replace("if self._wire_stage == 1:   # state: wire:1",
+                            "if self._wire_stage == 1:")
+                   .replace("self._wire_stage = 1\n",
+                            "self._wire_stage = 3\n"))
+    fs = ProtoPass().run(mods)
+    msgs = "\n".join(f.msg for f in fs)
+    assert "'# state: wire:1' annotation" in msgs, msgs
+    assert "wire state 3 is entered" in msgs
+
+
+def test_proto_catches_manifest_version_mutations():
+    """Bump MANIFEST_VERSION without a v2 handler annotation, and
+    strip the existing v1 one — both are version-skew findings."""
+    from mvapich2_tpu.analysis.proto import ProtoPass
+    mods = _mutated_pkg_modules(
+        "runtime/daemon.py",
+        lambda s: s.replace("MANIFEST_VERSION = 2", "MANIFEST_VERSION = 3"))
+    fs = ProtoPass().run(mods)
+    assert any("manifest-v2" in f.msg for f in fs), [f.msg for f in fs]
+    mods = _mutated_pkg_modules(
+        "runtime/daemon.py",
+        lambda s: s.replace("# proto: manifest-v1", ""))
+    fs = ProtoPass().run(mods)
+    assert any("manifest-v1" in f.msg for f in fs), [f.msg for f in fs]
+
+
+def test_proto_state_map():
+    """The exported control-plane map (shared_field_map /
+    device_lane_map analog): key families with write/read sites, the
+    annotated wire states, the version constants."""
+    from mvapich2_tpu.analysis.proto import proto_state_map
+    m = proto_state_map(refresh=True)
+    keys = m["keys"]
+    assert keys["shm-cabi-<*>"]["writes"] >= 2
+    assert keys["shm-cabi-<*>"]["reads"] >= 1
+    assert keys["__failure_ev_<*>"]["writes"] >= 2
+    assert keys["tcp-addr-<*>"]["reads"] == 1
+    assert set(m["wire_states"]) == {0, 1}
+    assert all(v["annotated"] for v in m["wire_states"].values())
+    assert m["versions"]["MANIFEST_VERSION"] >= 2
+    assert m["versions"]["BOOT_PROTO_VERSION"] >= 1
+    assert m["waits"] > 10
+
+
+def test_watchdog_proto_map_lines():
+    """PR 7/12 parity: the stall report and mpistat share one
+    control-plane protocol map section."""
+    from mvapich2_tpu.trace import watchdog
+    lines = watchdog.proto_map_lines()
+    text = "\n".join(lines)
+    assert "control-plane protocol map" in text
+    assert "wire states: 0 @" in text
+    assert "MANIFEST_VERSION" in text
+    assert "shm-cabi-<*>" in text
+
+
+def test_watchdog_control_report_section():
+    """The live half: per-peer wiring stage + bells + the in-flight
+    wire deadline, from a channel-shaped object."""
+    from mvapich2_tpu.trace import watchdog
+
+    class FakeChan:
+        my_rank = 0
+        local_ranks = [0, 1, 2]
+        cabi_ranks = {2}
+        _wired = False
+        _wire_stage = 1
+        _peer_bells = {1: "/x"}
+        _wire_deadline = 0.0
+    import time as _t
+    ch = FakeChan()
+    ch._wire_deadline = _t.monotonic() + 42.0
+    lines = watchdog._control_report(ch)
+    text = "\n".join(lines)
+    assert "wired=False, wire stage=1" in text
+    assert "peer 1: bell set" in text
+    assert "peer 2: bell UNSET [C-ABI]" in text
+    assert "wire gate, deadline in" in text
+
+
+def test_mpistat_proto_map_flag(capsys):
+    from mvapich2_tpu.trace.mpistat import main as mpistat_main
+    assert mpistat_main(["--proto-map"]) == 0
+    out = capsys.readouterr().out
+    assert "wire states" in out and "shm-cabi-<*>" in out
+
+
+def test_mpistat_daemon_lines(tmp_path):
+    """The daemon claim-cycle section reads one manifest.json — claim
+    state, epoch, owner, version."""
+    import json as _json
+
+    from mvapich2_tpu.trace.mpistat import daemon_lines
+    (tmp_path / "manifest.json").write_text(_json.dumps({
+        "version": 2, "daemon_pid": 0,
+        "sets": {"n2-r4194304-p268435456": {
+            "state": "busy", "epoch": 7, "owner_pid": 12345}}}))
+    lines = daemon_lines(str(tmp_path))
+    text = "\n".join(lines)
+    assert "manifest v2" in text
+    assert "n2-r4194304-p268435456: busy epoch=7 owner=12345" in text
+    assert daemon_lines(str(tmp_path / "nonexistent")) == []
+
+
+def test_proto_cli_routes_runtime_paths():
+    """mv2tlint accepts control-plane paths on the command line and
+    the proto doctors run on them (fixture mode) — the 'lint the
+    module you are editing' workflow."""
+    assert lint_main([os.path.join(FIXTURES, "bad_proto.py"),
+                      "--no-baseline"]) == 1
+    assert lint_main([os.path.join(FIXTURES, "clean_proto.py"),
+                      "--no-baseline"]) == 0
+    # the committed control-plane modules pass standalone too (their
+    # cross-module key peers ride along via the package default gate,
+    # so standalone runs only the module-local doctors)
+    assert lint_main([os.path.join(REPO, "mvapich2_tpu", "runtime",
+                                   "daemon.py"), "--no-baseline"]) == 0
+
+
 def test_ntrace_layout_mirrors_header():
     """The python mirror of the trace-ring geometry + NTE event table
     (trace/native.py) matches native/shm_layout.h — and a drifted

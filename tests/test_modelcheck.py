@@ -19,8 +19,8 @@ lint/tsan lanes complement.
 import pytest
 
 from mvapich2_tpu.analysis import model as M
-from mvapich2_tpu.analysis.model import (doorbell, flat2, ici, lease,
-                                         seqlock)
+from mvapich2_tpu.analysis.model import (daemon, doorbell, flat2, ft,
+                                         ici, lease, seqlock, wiring)
 
 pytestmark = pytest.mark.lint
 
@@ -59,6 +59,25 @@ CLEAN = [
     ("ici-n3-C2-D2", lambda: ici.build_ring(3, 2, 2)),
     ("ici-n3-C2-D2-bidir", lambda: ici.build_ring(3, 2, 2, bidir=True)),
     ("ici-n4-C2-D2", lambda: ici.build_ring(4, 2, 2)),
+    # control-plane net (ISSUE 13): 2-stage lazy wire, warm-attach
+    # daemon claim cycle (+ the item-4a concurrent-claims variant),
+    # ULFM lease-detect/revoke/shrink propagation — tier-1 bounds all
+    # explore in well under a second each
+    ("wire-n2", lambda: wiring.build_wire(2)),
+    ("wire-n3", lambda: wiring.build_wire(3)),
+    ("wire-n2-nocap", lambda: wiring.build_wire(2, caps=(1, 0))),
+    ("wire-n2-crash", lambda: wiring.build_wire(2, crash=True)),
+    ("wire-n3-crash", lambda: wiring.build_wire(3, crash=True)),
+    ("wire-n3-crash-revoke", lambda: wiring.build_wire(
+        3, crash=True, revoke=True)),
+    ("daemon-j2", lambda: daemon.build_daemon(2)),
+    ("daemon-j2-crash", lambda: daemon.build_daemon(2, crash=True)),
+    ("daemon-j3-crash", lambda: daemon.build_daemon(3, crash=True)),
+    ("daemon-conc-j2-s2", lambda: daemon.build_daemon(
+        2, concurrent=True, nsets=2, quota=1)),
+    ("ft-n3", lambda: ft.build_ft(3)),
+    ("ft-n3-partial", lambda: ft.build_ft(3, partial_flood=True)),
+    ("ft-n3-reuse", lambda: ft.build_ft(3, reuse=True)),
 ]
 
 EXPECTED_INVARIANT = {
@@ -68,7 +87,8 @@ EXPECTED_INVARIANT = {
     # seqlock leader fold / flat2 mcast ring share the mutation name;
     # each model names the tear through its own invariant
     "no_overwrite_guard": {"no-torn-read-delivered", "mcast-data"},
-    "no_poison": {"poison-sticky", "no-torn-read-delivered"},
+    "no_poison": {"poison-sticky", "no-torn-read-delivered",
+                  "no-torn-rekey"},
     "no_arrival_wave": {"deadlock"},
     "no_final_poll": {"no-lost-wake", "deadlock"},
     "ring_before_publish": {"no-lost-wake", "deadlock"},
@@ -80,6 +100,24 @@ EXPECTED_INVARIANT = {
     "fanout_before_xchg": {"agreement", "deadlock"},
     "publish_before_write": {"mcast-data"},
     "no_first_sync": {"deadlock"},
+    # 2-stage lazy wire
+    "skip_unanimity": {"unsafe-enable", "clean-agreement"},
+    "no_dead_exclude": {"deadlock"},
+    "no_degrade": {"degraded-all-off"},
+    "verdict_before_cards": {"unsafe-enable"},
+    "wire_after_revoke": {"no-post-revoke-wire"},
+    # warm-attach daemon claim cycle
+    "no_reset": {"epoch-fresh"},
+    "release_no_epoch": {"exclusivity", "epoch-fresh"},
+    "sweep_live_owner": {"exclusivity"},
+    "expiry_reaps_claimed": {"no-reap"},
+    "sweep_never_fires": {"deadlock"},
+    "over_quota": {"admission"},
+    # ULFM propagation (no_poison shared with seqlock/flat2 below)
+    "no_revoke_unwind": {"deadlock"},
+    "no_reflood": {"deadlock"},
+    "detect_disabled": {"deadlock"},
+    "rekey_same_ctx": {"rekey-fresh"},
     # ici chunk-credit ring
     "no_credit_wait": {"no-slot-collision", "no-lost-credit"},
     "slot_off_by_one": {"deadlock", "no-slot-collision"},
@@ -117,6 +155,32 @@ def test_mutation_caught(label, build, mutation):
 def test_matrix_has_at_least_six_variants():
     muts = {m[2] for m in M.mutation_matrix()}
     assert len(muts) >= 6, muts
+
+
+def test_control_plane_matrix_seeds_sixteen_mutations():
+    """ISSUE 13: the wiring/daemon/ft control-plane models seed >= 15
+    distinct protocol breaks among them (each caught by a named
+    invariant via test_mutation_caught over the matrix)."""
+    muts = {(m[0], m[2]) for m in M.mutation_matrix()
+            if m[0] in ("wiring", "daemon-claim", "ft-ulfm")}
+    assert len(muts) >= 15, muts
+    assert {m[0] for m in muts} == {"wiring", "daemon-claim", "ft-ulfm"}
+
+
+def test_control_plane_violation_trace_replays():
+    """A daemon epoch-leak trace replays from init to a violating
+    state — the counterexample is actionable, not just a boolean."""
+    m = daemon.build_daemon(2, crash=True, mutation="no_reset")
+    r = M.explore(m)
+    v = next(v for v in r.violations if v.invariant == "epoch-fresh")
+    state = dict(m.init)
+    by_name = {t.name: t for t in m.transitions}
+    for step in v.trace:
+        t = by_name[step]
+        assert t.guard(state), f"trace step {step} not enabled on replay"
+        state = t.apply(state)
+    name, pred = next(i for i in m.invariants if i[0] == "epoch-fresh")
+    assert pred(state) is not None, "replayed state does not violate"
 
 
 def test_ici_matrix_has_six_mutations():
@@ -251,3 +315,97 @@ def test_full_depth_ici_mutations_np3():
                     ("recv_before_send_wave", dict(chunks=3, depth=2))]:
         r = M.explore(ici.build_ring(3, mutation=mut, **kw))
         assert not r.ok, mut
+
+
+# -- control-plane net: the full acceptance bounds (ISSUE 13) ------------
+
+@pytest.mark.modelcheck
+@pytest.mark.parametrize("n", [2, 3, 4])
+@pytest.mark.parametrize("crash", [False, True],
+                         ids=["clean", "crash"])
+def test_full_depth_wiring_matrix(n, crash):
+    """The clean 2-stage wire is exhaustively green for np<=4 with the
+    victim crashing at EVERY pre-wired step (die is a free transition,
+    so the DFS covers mid-build, mid-verdict and mid-apply deaths)."""
+    r = M.explore(wiring.build_wire(n, crash=crash))
+    assert r.complete and r.ok, \
+        [f"{v.invariant}: {v.message}" for v in r.violations]
+
+
+@pytest.mark.modelcheck
+def test_full_depth_wiring_revoke_np4():
+    r = M.explore(wiring.build_wire(4, crash=True, revoke=True))
+    assert r.complete and r.ok
+
+
+@pytest.mark.modelcheck
+def test_full_depth_wiring_mixed_caps():
+    """A capability-poor rank disables the tier for the whole node at
+    every size up to 4 — no interleaving enables it anywhere."""
+    for n in (2, 3, 4):
+        for caps in ([0] + [1] * (n - 1), [1] * (n - 1) + [0]):
+            r = M.explore(wiring.build_wire(n, caps=caps))
+            assert r.complete and r.ok
+            # exhaustiveness includes the terminal states: re-check
+            # no rank ever applied tier 1
+            r2 = M.explore(wiring.build_wire(n, caps=caps,
+                                             mutation="skip_unanimity"))
+            assert not r2.ok
+
+
+@pytest.mark.modelcheck
+@pytest.mark.parametrize("jobs", [2, 3])
+def test_full_depth_daemon_overlapping_jobs(jobs):
+    """Overlapping jobs <= 3 with claimer crash at every step: the
+    claim/epoch/reset/sweep/expiry cycle holds exclusivity, epoch
+    freshness and no-reap exhaustively."""
+    r = M.explore(daemon.build_daemon(jobs, crash=True),
+                  max_states=2_000_000)
+    assert r.complete, f"truncated at {r.states}"
+    assert r.ok, [f"{v.invariant}: {v.message}" for v in r.violations]
+
+
+@pytest.mark.modelcheck
+def test_full_depth_daemon_concurrent_admission():
+    """The item-4a pre-verified variant: 3 overlapping jobs over 2
+    geometry sets under quota 2, claimer crash at every step — the
+    invariant set the multi-tenant daemon must keep."""
+    r = M.explore(daemon.build_daemon(3, crash=True, concurrent=True,
+                                      nsets=2, quota=2),
+                  max_states=2_000_000)
+    assert r.complete and r.ok, \
+        [f"{v.invariant}: {v.message}" for v in r.violations]
+
+
+@pytest.mark.modelcheck
+@pytest.mark.parametrize("n", [3, 4])
+@pytest.mark.parametrize("cfg", ["plain", "partial", "reuse"])
+def test_full_depth_ft_matrix(n, cfg):
+    """ULFM propagation at np<=4: eventual PROC_FAILED delivery, no
+    survivor parked on a dead/diverted peer, fresh re-keys, poisoned
+    reuse refused — across the victim-initiated partial flood and the
+    ctx-reuse probe."""
+    m = ft.build_ft(n, partial_flood=(cfg == "partial"),
+                    reuse=(cfg == "reuse"))
+    r = M.explore(m)
+    assert r.complete and r.ok, \
+        [f"{v.invariant}: {v.message}" for v in r.violations]
+
+
+@pytest.mark.modelcheck
+def test_full_depth_control_plane_mutations_wider():
+    """The control-plane mutations still caught away from their
+    minimal configs."""
+    checks = [
+        wiring.build_wire(3, caps=(1, 1, 0),
+                          mutation="skip_unanimity"),
+        wiring.build_wire(3, crash=True, mutation="no_degrade"),
+        daemon.build_daemon(3, crash=True, mutation="no_reset"),
+        daemon.build_daemon(3, concurrent=True, nsets=2, quota=1,
+                            mutation="over_quota"),
+        ft.build_ft(4, mutation="no_revoke_unwind"),
+        ft.build_ft(4, reuse=True, mutation="no_poison"),
+    ]
+    for m in checks:
+        r = M.explore(m, max_states=2_000_000)
+        assert not r.ok, m.name

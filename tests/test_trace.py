@@ -298,6 +298,45 @@ def test_native_ring_disable_env(tmp_path):
     assert "mpi" in layers and "cplane" not in layers
 
 
+def test_ntrace_drain_survives_owner_unlink(tmp_path):
+    """Teardown-skew regression (found as a load-dependent loss of
+    ranks' cplane lanes in the mixed-ABI merge): the segment OWNER
+    unlinks the .ntrace file at its close, which can precede a slower
+    rank's Finalize drain. Each rank holds its own fd from attach time
+    and read_ring accepts it — an unlinked-but-open inode stays
+    readable, so the lane survives; the path-based read (mpistat's
+    attach-from-outside mode) correctly fails once the file is gone."""
+    import struct as _struct
+
+    from mvapich2_tpu.trace import native as nt
+    path = tmp_path / "ring.ntrace"
+    stride = nt._NTR_HDR_BYTES + nt._NTR_RING_EVENTS * nt._NTR_EV_BYTES
+    buf = bytearray(nt._NTR_FILE_HDR + stride)
+    _struct.pack_into("<Q", buf, nt._NTR_FILE_HDR, 2)   # rank 0 seq=2
+    ev_base = nt._NTR_FILE_HDR + nt._NTR_HDR_BYTES
+    nt._REC.pack_into(buf, ev_base, 1000, 1, 0, 7, 8)
+    nt._REC.pack_into(buf, ev_base + nt._NTR_EV_BYTES, 2000, 2, 1, 9, 0)
+    path.write_bytes(buf)
+    held = open(path, "rb")
+    try:
+        os.unlink(path)                      # the owner's close
+        evs = nt.read_ring(held, 0)
+        assert [(e[0], e[1]) for e in evs] == [(1000, 1), (2000, 2)]
+        assert nt.ring_depth(held, 0) == 2
+        with pytest.raises(OSError):
+            nt.read_ring(str(path), 0)
+
+        class Chan:                          # drain_channel via the fd
+            plane = object()
+            _ntrace_f = held
+            my_rank = 0
+            local_index = {0: 0}
+        rows = nt.drain_channel(Chan())
+        assert len(rows) == 2 and rows[0][2] == nt.event_name(1)
+    finally:
+        held.close()
+
+
 @pytest.mark.skipif(
     __import__("shutil").which("gcc") is None
     or __import__("shutil").which("python3-config") is None,
