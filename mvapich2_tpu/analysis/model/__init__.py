@@ -29,11 +29,12 @@ uncovered) gets the same treatment before ROADMAP item 4 grows it:
   * ``wiring.build_wire`` — the 2-stage mpeek-driven lazy wire
     (ShmChannel.ensure_wired): no hang, no unsafe/mixed tier enable,
     degraded-all-off on mid-wire death, no post-revoke wire;
-  * ``daemon.build_daemon`` — the warm-attach claim cycle (flock txn /
-    epoch / truncate-reset / stale sweep / idle expiry), including the
-    item-4a concurrent-claims admission VARIANT so its invariant set
-    (per-set exclusivity, epoch freshness, quota) exists before the
-    multi-tenant daemon is built;
+  * ``daemon.build_daemon`` — the multi-tenant warm-attach claim cycle
+    (flock txn / epoch / truncate-reset / stale sweep / idle expiry),
+    with the concurrent-claims admission variant (nsets instances under
+    a quota — pre-verified in PR 13, shipped in PR 14), the bounded
+    FIFO admission queue, and the exec-cache epoch discipline — the
+    model grows in lockstep with runtime/daemon.py;
   * ``ft.build_ft`` — lease-detect → revoke flood (with re-flood) →
     shrink re-key: eventual PROC_FAILED delivery, no survivor parked
     forever on a dead or diverted peer, re-key never reuses a poisoned
@@ -157,6 +158,22 @@ def mutation_matrix():
             2, concurrent=True, nsets=2, quota=1,
             mutation="over_quota"),
          "over_quota"),
+        # the PR 14 multi-tenant surface: bounded FIFO admission queue,
+        # concurrency-safe idle expiry, exec-cache epoch discipline
+        ("daemon-claim", lambda: daemon.build_daemon(
+            2, concurrent=True, nsets=2, quota=1,
+            mutation="queue_skips_admission"),
+         "queue_skips_admission"),
+        ("daemon-claim", lambda: daemon.build_daemon(
+            2, mutation="queue_drops_waiter"),
+         "queue_drops_waiter"),
+        ("daemon-claim", lambda: daemon.build_daemon(
+            2, concurrent=True, nsets=2, quota=2,
+            mutation="expiry_checks_set0"),
+         "expiry_checks_set0"),
+        ("daemon-claim", lambda: daemon.build_daemon(
+            2, cache=True, mutation="cache_stale_serve"),
+         "cache_stale_serve"),
         # ULFM lease-detect / revoke / shrink propagation (ft/ulfm.py)
         ("ft-ulfm", lambda: ft.build_ft(
             3, mutation="no_revoke_unwind"),

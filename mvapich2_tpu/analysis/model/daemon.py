@@ -1,4 +1,4 @@
-"""Warm-attach daemon claim-cycle model (runtime/daemon.py, PR 9).
+"""Multi-tenant warm-attach daemon model (runtime/daemon.py, PR 9/14).
 
 The manifest protocol, as shipped: every transaction is one flock'd
 read-modify-write (so each model transition is atomic); a claim sweeps
@@ -6,32 +6,52 @@ a dead owner's stale epoch, truncate-resets every segment file BEFORE
 publishing the claim, bumps the epoch, and records the claimer; a
 release is epoch-guarded (a late/double release of a swept-and-
 reclaimed set must be a no-op); the daemon's serve loop sweeps dead
-owners and idle-expires FREE sets only. Jobs retry a busy claim until
-the set frees (the overlapping-jobs shape).
+owners and idle-expires FREE sets only.
 
-``concurrent=True`` is the ROADMAP item-4a admission variant, modeled
-BEFORE it is built: ``nsets`` independent geometry slots under one
-manifest with an admission quota — so the invariant set (per-set
-exclusivity, per-set epoch freshness, quota) exists before the
-multi-tenant daemon does.
+The PR 14 multi-tenant protocol is modeled in lockstep:
+
+  * ``nsets`` independent set instances under one admission ``quota``
+    (``concurrent=True`` — modeled in PR 13 BEFORE the daemon was
+    built, now the shipping shape);
+  * the bounded FIFO admission **queue**: a job that cannot be granted
+    parks with a ticket; only the live head ticket may claim, and an
+    unqueued job may claim directly only while no live waiter is
+    parked (``runtime/daemon.py claim()``'s head rule);
+  * the **executable cache** (``cache=True``): artifacts are stamped
+    with the manifest's exec epoch; a reader must reject any stamp
+    other than the current epoch (invalidation = epoch bump, the
+    truncate-reset discipline applied to executables).
 
 Invariants:
   exclusivity      at most one live job holds any set at a time
   epoch-fresh      an attached job never observes a previous epoch's
                    word in its segment (the truncate-reset guarantee)
-  no-reap          idle-expiry never unlinks a set a live job holds
+  no-reap          idle-expiry never unlinks a set a live job holds —
+                   including while sibling sets/claims are in flight
   admission        (concurrent) busy sets never exceed the quota
+  cache-fresh      (cache) a served artifact always carries the cache
+                   epoch current at serve time
   no-hang          every job eventually claims+releases (a crashed
-                   owner's set must become claimable again)
+                   owner's set must become claimable again; a queued
+                   waiter must eventually be granted) — deadlock
 
 Mutations:
-  no_reset             claim skips the truncate-reset
-  release_no_epoch     release ignores the epoch guard (double release
-                       frees the NEXT claimer's set)
-  sweep_live_owner     the stale sweep's alive check is broken
-  expiry_reaps_claimed idle-expiry unlinks busy sets too
-  sweep_never_fires    stale-epoch sweep disabled (crash → dead set)
-  over_quota           (concurrent) admission ignores the quota
+  no_reset               claim skips the truncate-reset
+  release_no_epoch       release ignores the epoch guard (double
+                         release frees the NEXT claimer's set)
+  sweep_live_owner       the stale sweep's alive check is broken
+  expiry_reaps_claimed   idle-expiry unlinks busy sets too
+  sweep_never_fires      stale-epoch sweep disabled (crash → dead set)
+  over_quota             admission ignores the quota
+  queue_skips_admission  a queued waiter is granted past the quota
+  queue_drops_waiter     a parked waiter is never granted (the queue
+                         loses entries — no-hang/deadlock)
+  expiry_checks_set0     idle-expiry decides from set 0's state alone
+                         (the mis-scoped idle check: reaps a busy
+                         sibling under concurrency)
+  cache_stale_serve      the cache serves an artifact without the
+                         epoch check (a jax/profile change keeps
+                         feeding the old executable)
 """
 
 from __future__ import annotations
@@ -41,19 +61,21 @@ from typing import Optional
 from .explorer import Model, Transition
 
 # job phases
-IDLE, CLAIMED, ATTACHED, DONE, CRASHED = 0, 1, 2, 3, 4
+IDLE, CLAIMED, ATTACHED, DONE, CRASHED, WAITING = 0, 1, 2, 3, 4, 5
 
 
 def build_daemon(jobs: int = 2, crash: bool = False,
                  concurrent: bool = False, nsets: int = 2,
-                 quota: int = 1,
+                 quota: int = 1, cache: bool = False,
                  mutation: Optional[str] = None) -> Model:
     """``jobs`` claimers cycle claim→write→read→release over one set
-    (or, with ``concurrent``, over ``nsets`` sets under ``quota``)."""
+    (or, with ``concurrent``, over ``nsets`` instances under
+    ``quota``), parking in the FIFO admission queue when blocked.
+    ``cache`` adds the exec-cache epoch machinery."""
     ns = nsets if concurrent else 1
     if not concurrent:
         quota = 1
-    init = {}
+    init = {"qn": 0}
     for s_ in range(ns):
         init[f"st{s_}"] = 0          # 0 free / 1 busy
         init[f"ep{s_}"] = 0          # manifest epoch
@@ -66,46 +88,88 @@ def build_daemon(jobs: int = 2, crash: bool = False,
         init[f"jset{j}"] = -1        # set j holds
         init[f"obs{j}"] = -1         # epoch word j observed on read
         init[f"rel{j}"] = 0          # releases j has issued
+        init[f"wt{j}"] = -1          # admission-queue ticket (-1 none)
+    if crash:
+        # bounded-fault convention: at least one survivor — an
+        # all-crashed world satisfies every invariant trivially, and
+        # a starved survivor must register as a deadlock, not escape
+        # by dying too
+        init["cb"] = jobs - 1
+    if cache:
+        init["cgen"] = 1             # manifest exec_epoch
+        init["cart"] = 0             # stored artifact's epoch (0 none)
+        init["fb"] = 0               # fingerprint bumped yet
+        for j in range(jobs):
+            init[f"cobs{j}"] = -1    # artifact epoch j was served
+            init[f"cgat{j}"] = -1    # cache epoch at j's serve time
 
     def busy_count(s):
         return sum(1 for k in range(ns) if s[f"st{k}"] == 1)
+
+    def waiters(s):
+        return [s[f"wt{i}"] for i in range(jobs)
+                if s[f"j{i}"] == WAITING]
+
+    def is_head(s, j):
+        w = waiters(s)
+        return s[f"wt{j}"] >= 0 and s[f"wt{j}"] == min(w)
+
+    jkeys = frozenset({f"j{x}" for x in range(jobs)}
+                      | {f"wt{x}" for x in range(jobs)})
 
     def ts():
         out = []
         for j in range(jobs):
             for k in range(ns):
                 out.extend(claim_ts(j, k))
+            out.extend(queue_ts(j))
             out.extend(job_ts(j))
+            if cache:
+                out.extend(cache_job_ts(j))
             if crash:
                 def g_crash(s, j=j):
-                    return s[f"j{j}"] in (CLAIMED, ATTACHED)
+                    return s["cb"] > 0 \
+                        and s[f"j{j}"] in (CLAIMED, ATTACHED, WAITING)
 
                 def a_crash(s, j=j):
                     s[f"j{j}"] = CRASHED
+                    s["cb"] -= 1
                     return s
                 out.append(Transition(
                     f"crash{j}", f"j{j}", g_crash, a_crash,
-                    frozenset({f"j{j}"}), frozenset({f"j{j}"})))
+                    frozenset({f"j{j}", "cb"}),
+                    frozenset({f"j{j}", "cb"})))
         for k in range(ns):
             out.extend(daemon_ts(k))
+        if cache:
+            out.extend(cache_env_ts())
         return out
 
     def claim_ts(j: int, k: int):
         def g_claim(s):
-            if s[f"j{j}"] != IDLE:
+            ph = s[f"j{j}"]
+            if ph not in (IDLE, WAITING):
                 return False
-            if mutation != "over_quota" and s[f"st{k}"] == 0 \
-                    and busy_count(s) >= quota:
-                return False          # admission control
+            if ph == IDLE and waiters(s):
+                return False     # FIFO: must park behind live waiters
+            if ph == WAITING:
+                if mutation == "queue_drops_waiter":
+                    return False          # MUTANT: queue loses entries
+                if not is_head(s, j):
+                    return False
             if s[f"st{k}"] == 0:
+                if busy_count(s) >= quota \
+                        and mutation != "over_quota" \
+                        and not (mutation == "queue_skips_admission"
+                                 and ph == WAITING):
+                    return False          # admission control
                 return True
-            # busy: claimable only via the at-claim stale sweep
+            # busy: claimable only via the at-claim stale sweep (the
+            # reclaim frees the capacity it consumes, so no quota gate)
             owner = s[f"own{k}"]
             if mutation == "sweep_never_fires":
                 return False
-            if owner >= 0 and s[f"j{owner}"] == CRASHED:
-                return True
-            return False
+            return owner >= 0 and s[f"j{owner}"] == CRASHED
 
         def a_claim(s):
             if s[f"ex{k}"] == 0:
@@ -119,17 +183,36 @@ def build_daemon(jobs: int = 2, crash: bool = False,
             s[f"j{j}"] = CLAIMED
             s[f"jep{j}"] = s[f"ep{k}"]
             s[f"jset{j}"] = k
+            s[f"wt{j}"] = -1          # dequeued on grant
             return s
 
         keys = frozenset({f"st{x}" for x in range(ns)}
                          | {f"ep{k}", f"own{k}", f"seg{k}", f"ex{k}",
-                            f"j{j}", f"jep{j}", f"jset{j}"}
-                         | {f"j{x}" for x in range(jobs)})
+                            f"jep{j}", f"jset{j}"}
+                         | jkeys)
         return [Transition(f"claim{j}s{k}", f"j{j}", g_claim, a_claim,
                            keys, frozenset({f"st{k}", f"ep{k}",
                                             f"own{k}", f"seg{k}",
                                             f"ex{k}", f"j{j}",
-                                            f"jep{j}", f"jset{j}"}))]
+                                            f"jep{j}", f"jset{j}",
+                                            f"wt{j}"}))]
+
+    def queue_ts(j: int):
+        # parking is always legal from IDLE: the implementation's
+        # claim() enqueues whenever its transaction could not grant,
+        # and a spuriously early ticket only strengthens FIFO
+        def g_enq(s):
+            return s[f"j{j}"] == IDLE
+
+        def a_enq(s):
+            s[f"j{j}"] = WAITING
+            s[f"wt{j}"] = s["qn"]
+            s["qn"] += 1
+            return s
+
+        return [Transition(f"enq{j}", f"j{j}", g_enq, a_enq,
+                           frozenset({f"j{j}", f"wt{j}", "qn"}),
+                           frozenset({f"j{j}", f"wt{j}", "qn"}))]
 
     def job_ts(j: int):
         def g_write(s):
@@ -188,6 +271,56 @@ def build_daemon(jobs: int = 2, crash: bool = False,
                                  | {f"j{j}", f"rel{j}"})),
         ]
 
+    def cache_job_ts(j: int):
+        # populate: an attached job stores an artifact stamped with the
+        # CURRENT cache epoch (exec_cache_put under exec_epoch)
+        def g_cput(s):
+            return s[f"j{j}"] == ATTACHED and s["cart"] == 0
+
+        def a_cput(s):
+            s["cart"] = s["cgen"]
+            return s
+
+        # serve: exec_cache_get — the epoch is part of the entry name,
+        # so a stale-epoch artifact must read as a miss, never a hit
+        def g_cget(s):
+            if s[f"j{j}"] != ATTACHED or s[f"cobs{j}"] >= 0 \
+                    or s["cart"] == 0:
+                return False
+            if mutation == "cache_stale_serve":
+                return True           # MUTANT: no epoch check
+            return s["cart"] == s["cgen"]
+
+        def a_cget(s):
+            s[f"cobs{j}"] = s["cart"]
+            s[f"cgat{j}"] = s["cgen"]
+            return s
+
+        ck = frozenset({"cgen", "cart", f"j{j}",
+                        f"cobs{j}", f"cgat{j}"})
+        return [
+            Transition(f"cput{j}", f"j{j}", g_cput, a_cput, ck,
+                       frozenset({"cart"})),
+            Transition(f"cget{j}", f"j{j}", g_cget, a_cget, ck,
+                       frozenset({f"cobs{j}", f"cgat{j}"})),
+        ]
+
+    def cache_env_ts():
+        # the environment invalidates: a jax upgrade / profile change /
+        # explicit --reset-exec-cache bumps the exec epoch exactly like
+        # the claim's truncate-reset bumps the set epoch
+        def g_refp(s):
+            return s["fb"] == 0
+
+        def a_refp(s):
+            s["fb"] = 1
+            s["cgen"] += 1
+            return s
+
+        return [Transition("refp", "env", g_refp, a_refp,
+                           frozenset({"fb", "cgen"}),
+                           frozenset({"fb", "cgen"}))]
+
     def daemon_ts(k: int):
         def g_sweep(s):
             if s[f"st{k}"] != 1 or mutation == "sweep_never_fires":
@@ -209,6 +342,8 @@ def build_daemon(jobs: int = 2, crash: bool = False,
                 return False
             if mutation == "expiry_reaps_claimed":
                 return True           # MUTANT: reaps busy sets too
+            if mutation == "expiry_checks_set0":
+                return s["st0"] == 0  # MUTANT: mis-scoped idle check
             return s[f"st{k}"] == 0
 
         def a_expire(s):
@@ -221,7 +356,7 @@ def build_daemon(jobs: int = 2, crash: bool = False,
                        frozenset({f"st{k}", f"own{k}"}) | jk,
                        frozenset({f"st{k}", f"own{k}"})),
             Transition(f"expire{k}", "daemon", g_expire, a_expire,
-                       frozenset({f"st{k}", f"ex{k}"}),
+                       frozenset({f"st{k}", f"ex{k}", "st0"}),
                        frozenset({f"ex{k}"})),
         ]
 
@@ -264,6 +399,15 @@ def build_daemon(jobs: int = 2, crash: bool = False,
                     f"quota {quota}")
         return None
 
+    def inv_cache(s):
+        for j in range(jobs):
+            if s[f"cobs{j}"] >= 0 and s[f"cobs{j}"] != s[f"cgat{j}"]:
+                return (f"job {j} was served an artifact of cache "
+                        f"epoch {s[f'cobs{j}']} while the current "
+                        f"epoch was {s[f'cgat{j}']} — a stale "
+                        "executable survived the invalidation reset")
+        return None
+
     def final(s):
         return all(s[f"j{j}"] in (DONE, CRASHED) for j in range(jobs))
 
@@ -271,6 +415,8 @@ def build_daemon(jobs: int = 2, crash: bool = False,
             ("no-reap", inv_reap)]
     if concurrent:
         invs.append(("admission", inv_quota))
+    if cache:
+        invs.append(("cache-fresh", inv_cache))
     return Model(
         f"daemon(jobs={jobs},crash={crash},conc={concurrent},"
-        f"mut={mutation})", init, ts(), invs, final)
+        f"cache={cache},mut={mutation})", init, ts(), invs, final)

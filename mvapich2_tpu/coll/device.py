@@ -106,6 +106,67 @@ def _dtype_lowers(dtype: np.dtype) -> bool:
     return dtype.kind in "fiu"
 
 
+# -- daemon device-executable cache (ISSUE 14) --------------------------
+# The PiP attach-not-construct model applied to compiled programs: with
+# MV2T_DAEMON + MV2T_DAEMON_EXEC_CACHE on, a program build first asks
+# the node daemon's exec-cache for a serialized executable under the
+# (kernel, shape, mesh, jax/profile fingerprint) key and deserializes
+# it — skipping jax tracing + Mosaic compile, the dominant cold-start
+# cost of a device job. A miss builds as before and exports the traced
+# program after its first successful call (the only point the concrete
+# input layout exists). Every failure path degrades to the plain build:
+# the cache can be absent, stale-epoch, or unexportable (pre-export
+# jax, interpreter callbacks) without ever breaking a collective.
+
+class _ExportingProgram:
+    """Built program that serializes itself into the daemon exec-cache
+    after its first successful call."""
+
+    __slots__ = ("fn", "key", "_stored")
+
+    def __init__(self, fn, key: str):
+        self.fn = fn
+        self.key = key
+        self._stored = False
+
+    def __call__(self, x):
+        out = self.fn(x)
+        if not self._stored:
+            self._stored = True    # one export attempt per process
+            from ..ops import _compat
+            from ..runtime import daemon
+            blob = _compat.serialize_executable(self.fn, x)
+            if blob is not None:
+                daemon.exec_cache_put(self.key, blob)
+        return out
+
+
+class _ImportedProgram:
+    """Deserialized cached executable; a failure on the FIRST call
+    (corrupt entry, incompatible artifact that slipped the fingerprint)
+    rebuilds from source instead of failing the collective."""
+
+    __slots__ = ("fn", "rebuild", "_proven")
+
+    def __init__(self, fn, rebuild):
+        self.fn = fn
+        self.rebuild = rebuild
+        self._proven = False
+
+    def __call__(self, x):
+        if self._proven:
+            return self.fn(x)
+        try:
+            out = self.fn(x)
+        except Exception as e:   # noqa: BLE001 — cache must not break calls
+            log.warn("cached executable failed on first call (%r); "
+                     "rebuilding from source", e)
+            self.fn = self.rebuild()
+            out = self.fn(x)
+        self._proven = True
+        return out
+
+
 class _Rendezvous:
     """Per-bound-comm meeting point: slots for each rank's shard, two
     barrier phases per collective (deposit -> leader compute -> pickup).
@@ -150,8 +211,36 @@ class DeviceCollChannel:
         key = (name, n, dtype_str, op, root)
         got = self._programs.get(key)
         if got is None:
-            got = self._programs[key] = self._build(name, n, op, root)
+            got = self._programs[key] = self._cached_build(
+                name, n, dtype_str, op, root)
         return got
+
+    def _chan_desc(self) -> str:
+        """The mesh half of the executable-cache key: channel flavor,
+        extent and platform (two geometries must never share an
+        artifact)."""
+        return (f"mesh{self.size}x{self.device.platform}"
+                f"@{self.axis}")
+
+    def _cached_build(self, name: str, n: int, dtype_str: str, op: str,
+                      root: int):
+        """The exec-cache seam around ``_build``: deserialize on hit,
+        build + export-on-first-call on miss, plain build whenever the
+        cache is off or this jax cannot export."""
+        from ..runtime import daemon
+        if not daemon.exec_cache_enabled():
+            return self._build(name, n, op, root)
+        from ..ops import _compat
+        ck = "|".join(("mv2t-exec-v1", self._chan_desc(), name,
+                       f"n{n}", dtype_str, f"op:{op}", f"root:{root}",
+                       _compat.exec_fingerprint()))
+        blob = daemon.exec_cache_get(ck)
+        if blob is not None:
+            fn = _compat.deserialize_executable(blob)
+            if fn is not None:
+                return _ImportedProgram(
+                    fn, lambda: self._build(name, n, op, root))
+        return _ExportingProgram(self._build(name, n, op, root), ck)
 
     def _build(self, name: str, n: int, op: str, root: int):
         import jax
@@ -420,6 +509,9 @@ class HBMSlotChannel(DeviceCollChannel):
     def _use_pallas(self, op: str) -> bool:
         from ..ops import pallas_hbm as ph
         return op == "sum" and ph.HAVE_PALLAS and not self.rv.no_pallas
+
+    def _chan_desc(self) -> str:
+        return f"slot{self.size}x{self.device.platform}"
 
     def _build(self, name: str, n: int, op: str, root: int):
         import jax

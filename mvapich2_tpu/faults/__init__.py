@@ -12,6 +12,8 @@ Grammar (comma-separated specs)::
     MV2T_FAULTS=<site>[@<world-rank>]:<kind>[:<seed>[:<nth>[+]]]
 
     site  shm_send | shm_recv | arena_alloc | rndv_chunk | kvs | wire
+          | claim (warm-attach daemon claim cycle, fired between the
+          grant transaction and the claimer's attach)
           | flat_fold  (handled natively in cplane.cpp so the C-ABI
           hot path injects without an interpreter round-trip)
     kind  drop | delay | duplicate | truncate | crash
@@ -54,14 +56,14 @@ log = get_logger("faults")
 cvar("FAULTS", "", str, "ft",
      "Deterministic fault-injection spec(s): "
      "site[@rank]:kind[:seed[:nth[+]]], comma-separated. Sites: "
-     "shm_send shm_recv arena_alloc rndv_chunk kvs wire flat_fold; "
-     "kinds: drop delay duplicate truncate crash. Empty = engine off "
-     "(zero hot-path cost).")
+     "shm_send shm_recv arena_alloc rndv_chunk kvs wire claim "
+     "flat_fold; kinds: drop delay duplicate truncate crash. Empty = "
+     "engine off (zero hot-path cost).")
 cvar("FAULT_DELAY_MS", 0.0, float, "ft",
      "Fixed delay in ms for the 'delay' kind (0 = seeded 1-20 ms).")
 
 SITES = ("shm_send", "shm_recv", "arena_alloc", "rndv_chunk", "kvs",
-         "wire", "flat_fold")
+         "wire", "claim", "flat_fold")
 KINDS = ("drop", "delay", "duplicate", "truncate", "crash")
 
 # containment observability (predeclared in mpit.py so tools enumerate

@@ -371,6 +371,52 @@ for _tier in ("vmem", "hbm", "xla", "slot"):
 
 
 # ---------------------------------------------------------------------------
+# multi-tenant node-service knobs + observability (runtime/daemon.py,
+# coll/device.py executable cache). Declared HERE — daemon.claim runs
+# inside MPI_Init's stdlib-only light boot and this module is already
+# on that path (faults -> mpit), so the MPI_T surface enumerates the
+# serving-fabric knobs before any heavy import; the owning modules
+# fetch the already-declared entries by name.
+# ---------------------------------------------------------------------------
+
+cvar("DAEMON_NSETS", 4, int, "runtime",
+     "Warm-attach daemon: maximum segment-set instances per geometry "
+     "key. Overlapping jobs of ONE geometry claim distinct instances "
+     "(<geokey>-i<k>) up to this bound; further claims queue under the "
+     "admission quota.")
+cvar("DAEMON_QUOTA", 8, int, "runtime",
+     "Warm-attach daemon: node-wide admission quota — maximum busy "
+     "segment sets across all geometries. Claims past the quota queue "
+     "(bounded) instead of being refused; a timed-out waiter falls "
+     "back to private per-job segments.")
+cvar("DAEMON_EXEC_CACHE", 1, int, "runtime",
+     "Device-executable cache in the daemon dir: coll/device.py "
+     "program builds serialize the traced+compiled executable "
+     "(jax.export) keyed on (kernel, shape, mesh, jax/profile "
+     "fingerprint) so the first device collective of a new process "
+     "deserializes instead of re-tracing. 0 = build per process as "
+     "before. Requires MV2T_DAEMON=1; no-op on jax without the export "
+     "API.")
+
+pvar("daemon_claims_active", PVAR_CLASS_LEVEL, "runtime",
+     "warm-attach segment-set claims this process currently holds "
+     "(claim grants minus epoch-guarded releases)")
+pvar("daemon_queue_waits", PVAR_CLASS_COUNTER, "runtime",
+     "claims that entered the daemon's bounded admission queue "
+     "(all instances busy or quota reached) before being granted or "
+     "timing out")
+pvar("exec_cache_hits", PVAR_CLASS_COUNTER, "runtime",
+     "device-executable cache hits: program builds served by "
+     "deserializing a cached executable instead of trace+compile")
+pvar("exec_cache_misses", PVAR_CLASS_COUNTER, "runtime",
+     "device-executable cache misses (no entry for the key at the "
+     "current cache epoch, or a stale-epoch entry rejected)")
+pvar("exec_cache_bytes", PVAR_CLASS_COUNTER, "runtime",
+     "bytes of serialized executables written into the daemon's "
+     "exec-cache by this process")
+
+
+# ---------------------------------------------------------------------------
 # the autotuner lives beside MPI_T (tools space): mpit.autotune —
 # re-exported lazily (PEP 562): it imports numpy, and this module sits
 # on the C-ABI light boot path (faults -> mpit), which must stay

@@ -71,6 +71,61 @@ def have_remote_signal() -> bool:             # device: hw-only
     return getattr(pltpu, "InterpretParams", None) is not None
 
 
+# -- device-executable export/import seam (the daemon exec cache) ------
+# jax.export serializes a traced+lowered program (StableHLO + the
+# already-compiled Mosaic payloads of any pallas custom calls) to
+# portable bytes; deserializing skips jax tracing and lowering — the
+# dominant cold-start cost of a device job's first collective. The API
+# appeared around jax 0.4.30 and moved (jax.experimental.export before
+# that): both helpers return None when THIS jax cannot, so callers
+# no-op cleanly — the cache degrades to per-process builds, it never
+# breaks the collective. Interpreter-mode kernels that resist export
+# (host callbacks) land in the same None path.
+
+def exec_fingerprint() -> str:
+    """The environment half of the executable-cache key: an artifact is
+    only valid under the jax/backend/precision/tuning-profile that
+    built it. Cheap string compare, never a version parse."""
+    import jax
+
+    from ..utils.config import get_config
+    prof = str(get_config().get("TUNING_PROFILE", "") or "")
+    return (f"jax{jax.__version__}|{jax.default_backend()}"
+            f"|x64:{int(bool(jax.config.jax_enable_x64))}|prof:{prof}")
+
+
+def serialize_executable(fn, *args) -> Optional[bytes]:
+    """Serialize ``fn`` (a jax.jit-wrapped callable) traced at the
+    shapes/dtypes of ``args``. None = this jax has no export API or the
+    program resists export — the caller skips caching."""
+    try:
+        from jax import export as jexp
+    except ImportError:   # pre-export jax: the cache no-ops
+        return None
+    try:
+        import jax
+        specs = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args]
+        return jexp.export(fn)(*specs).serialize()
+    except Exception as e:   # noqa: BLE001 — caching is best-effort
+        log.dbg(1, "executable export unavailable (%r)", e)
+        return None
+
+
+def deserialize_executable(blob: bytes):
+    """Rehydrate a serialized executable as a jitted callable, or None
+    when this jax cannot (the caller rebuilds from source)."""
+    try:
+        from jax import export as jexp
+    except ImportError:
+        return None
+    try:
+        import jax
+        return jax.jit(jexp.deserialize(blob).call)
+    except Exception as e:   # noqa: BLE001
+        log.dbg(1, "executable import failed (%r); rebuilding", e)
+        return None
+
+
 def note_fallback(coll: str, reason: str, nbytes: int,
                   dtype: Optional[object] = None) -> None:
     """Count one device-collective fallback to the XLA lowering.

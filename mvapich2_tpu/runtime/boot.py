@@ -232,7 +232,8 @@ def _make_raw_segments(boot: BootState, n_local: int) -> dict:
                          "flat2": claim.flat2,
                          "arena": claim.arena,
                          "part_bytes": claim.part_bytes,
-                         "geokey": claim.geokey, "epoch": claim.epoch})
+                         "geokey": claim.geokey,
+                         "setkey": claim.setkey, "epoch": claim.epoch})
             return card
         log.info("MV2T_DAEMON=1 but no claimable daemon set; "
                  "constructing fresh segments")

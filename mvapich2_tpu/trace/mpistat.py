@@ -142,8 +142,15 @@ def find_segments(seg: Optional[str] = None,
 def snapshot(stem: str, trace_tail: int = 8,
              flat_regions: int = 64) -> Dict[str, Any]:
     """One read-only state snapshot of a job's segment set."""
-    flags_path = stem if stem.endswith(".flags") else stem + ".flags"
-    ring_path = flags_path[:-len(".flags")]
+    if stem.endswith(".flags"):
+        flags_path, ring_path = stem, stem[:-len(".flags")]
+    else:
+        ring_path = stem
+        flags_path = stem + ".flags"
+        if not os.path.exists(flags_path) and stem.endswith(".ring"):
+            # daemon segment-set naming: <setkey>.ring / <setkey>.flags
+            # (per-job stems are <stem> / <stem>.flags)
+            flags_path = stem[:-len(".ring")] + ".flags"
     out: Dict[str, Any] = {"stem": ring_path, "ranks": []}
     fsize = os.path.getsize(flags_path)
     n = _n_local_from_flags(fsize)
@@ -273,10 +280,11 @@ def snapshot(stem: str, trace_tail: int = 8,
 
 
 def daemon_lines(daemon_dir: Optional[str] = None) -> List[str]:
-    """Warm-attach daemon control-plane section: manifest version,
-    daemon liveness, per-set claim state/epoch/owner — the claim-cycle
-    counterpart of the per-rank wiring view (nothing here touches the
-    job either: one manifest.json read)."""
+    """Multi-tenant daemon control-plane section: manifest version,
+    daemon liveness, per-set claim occupancy (busy instances vs the
+    admission quota), queue depth, and exec-cache size — the claim-
+    cycle counterpart of the per-rank wiring view (nothing here touches
+    the job either: one manifest.json read + one cache-dir scan)."""
     if daemon_dir is None:
         try:
             from ..runtime.daemon import default_dir
@@ -297,12 +305,32 @@ def daemon_lines(daemon_dir: Optional[str] = None) -> List[str]:
             alive = True
         except OSError:
             alive = False
+    sets = m.get("sets", {})
+    busy = sum(1 for s in sets.values() if s.get("state") == "busy")
     out = [f"# daemon manifest v{m.get('version')} ({daemon_dir}, "
            f"daemon pid {pid} {'alive' if alive else 'absent'})"]
-    for key, s in sorted(m.get("sets", {}).items()):
+    quota = os.environ.get("MV2T_DAEMON_QUOTA", "8")
+    out.append(f"  occupancy: {busy} busy / {len(sets)} provisioned "
+               f"set(s), quota {quota}")
+    for key, s in sorted(sets.items()):
         out.append(f"  set {key}: {s.get('state')} "
                    f"epoch={s.get('epoch')} "
                    f"owner={s.get('owner_pid') or '-'}")
+    queue = m.get("queue", [])
+    if queue:
+        heads = ", ".join(f"pid {q.get('pid')} ({q.get('geokey')})"
+                          for q in queue[:4])
+        out.append(f"  queue depth {len(queue)}: {heads}"
+                   f"{' ...' if len(queue) > 4 else ''}")
+    else:
+        out.append("  queue depth 0")
+    try:
+        from ..runtime.daemon import exec_cache_stats
+        ec = exec_cache_stats(daemon_dir)
+        out.append(f"  exec-cache: {ec['entries']} executable(s), "
+                   f"{ec['bytes']} B, epoch {ec['epoch']}")
+    except Exception:
+        pass
     return out
 
 

@@ -213,8 +213,22 @@ def _control_report(pch) -> list:
         b = bootmod.current_boot()
         cl = getattr(b, "daemon_claim", None) if b is not None else None
         if cl is not None:
-            lines.append(f"  daemon claim: geokey {cl.geokey} epoch "
+            lines.append(f"  daemon claim: set {cl.setkey} epoch "
                          f"{cl.epoch} (manifest v{MANIFEST_VERSION})")
+    except Exception:
+        pass
+    try:
+        from .. import mpit
+        active = mpit.pvar("daemon_claims_active").read()
+        waits = mpit.pvar("daemon_queue_waits").read()
+        hits = mpit.pvar("exec_cache_hits").read()
+        misses = mpit.pvar("exec_cache_misses").read()
+        if active or waits or hits or misses:
+            lines.append(f"  daemon: claims active {active:g}, queue "
+                         f"waits {waits:g}; exec-cache {hits:g} hit / "
+                         f"{misses:g} miss "
+                         f"({mpit.pvar('exec_cache_bytes').read():g} B "
+                         "written)")
     except Exception:
         pass
     lines.extend(proto_map_lines())

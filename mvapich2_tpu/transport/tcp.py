@@ -53,10 +53,7 @@ class TcpChannel(Channel):
         self.my_rank = my_rank
         self.kvs = kvs
         self.sel = selectors.DefaultSelector()
-        self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self.listener.bind(("127.0.0.1", 0))
-        self.listener.listen(128)
+        self.listener = self._take_or_bind_listener()
         self.listener.setblocking(False)
         self.sel.register(self.listener, selectors.EVENT_READ, "accept")
         host, port = self.listener.getsockname()[:2]
@@ -71,6 +68,27 @@ class TcpChannel(Channel):
         # channel-local lock (never held while waiting on a peer) so it
         # cannot join a cross-engine wait cycle.
         self._slock = threading.Lock()
+
+    @staticmethod
+    def _take_or_bind_listener() -> socket.socket:
+        """With the node daemon on, adopt a pre-bound listening socket
+        from its pool (SCM_RIGHTS handoff) — bootstrap wiring attaches
+        instead of constructing, the same move the segment claim made
+        for shm. Any failure falls back to a private bind, bit-
+        identical to MV2T_DAEMON=0."""
+        from ..runtime import daemon   # also declares the DAEMON cvar
+        from ..utils.config import get_config
+        if int(get_config().get("DAEMON", 0) or 0):
+            lst = daemon.take_listener()
+            if lst is not None:
+                log.dbg(1, "adopted daemon-served listen socket %s",
+                        lst.getsockname())
+                return lst
+        lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(128)
+        return lst
 
     # -- outgoing ---------------------------------------------------------
     def _connect(self, dest: int) -> _Conn:

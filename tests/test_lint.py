@@ -811,19 +811,21 @@ def test_proto_catches_wire_state_mutations():
 
 
 def test_proto_catches_manifest_version_mutations():
-    """Bump MANIFEST_VERSION without a v2 handler annotation, and
-    strip the existing v1 one — both are version-skew findings."""
+    """Bump MANIFEST_VERSION without a v3 handler annotation, and
+    strip the existing v1/v2 ones — all are version-skew findings."""
     from mvapich2_tpu.analysis.proto import ProtoPass
     mods = _mutated_pkg_modules(
         "runtime/daemon.py",
-        lambda s: s.replace("MANIFEST_VERSION = 2", "MANIFEST_VERSION = 3"))
+        lambda s: s.replace("MANIFEST_VERSION = 3", "MANIFEST_VERSION = 4"))
     fs = ProtoPass().run(mods)
-    assert any("manifest-v2" in f.msg for f in fs), [f.msg for f in fs]
-    mods = _mutated_pkg_modules(
-        "runtime/daemon.py",
-        lambda s: s.replace("# proto: manifest-v1", ""))
-    fs = ProtoPass().run(mods)
-    assert any("manifest-v1" in f.msg for f in fs), [f.msg for f in fs]
+    assert any("manifest-v3" in f.msg for f in fs), [f.msg for f in fs]
+    for stripped in ("# proto: manifest-v1", "# proto: manifest-v2"):
+        mods = _mutated_pkg_modules(
+            "runtime/daemon.py",
+            lambda s, stripped=stripped: s.replace(stripped, ""))
+        fs = ProtoPass().run(mods)
+        want = stripped.split()[-1]
+        assert any(want in f.msg for f in fs), [f.msg for f in fs]
 
 
 def test_proto_state_map():
@@ -902,6 +904,18 @@ def test_mpistat_daemon_lines(tmp_path):
     assert "manifest v2" in text
     assert "n2-r4194304-p268435456: busy epoch=7 owner=12345" in text
     assert daemon_lines(str(tmp_path / "nonexistent")) == []
+    # the multi-tenant (v3) rows: occupancy vs quota, queue depth,
+    # exec-cache size
+    (tmp_path / "manifest.json").write_text(_json.dumps({
+        "version": 3, "daemon_pid": 0, "exec_epoch": 2, "qseq": 3,
+        "queue": [{"pid": 999, "geokey": "n2-x", "seq": 3}],
+        "sets": {"n2-r4194304-p268435456-i0": {
+            "geokey": "n2-r4194304-p268435456",
+            "state": "busy", "epoch": 7, "owner_pid": 12345}}}))
+    text = "\n".join(daemon_lines(str(tmp_path)))
+    assert "occupancy: 1 busy / 1 provisioned" in text
+    assert "queue depth 1" in text
+    assert "exec-cache: 0 executable(s)" in text
 
 
 def test_proto_cli_routes_runtime_paths():

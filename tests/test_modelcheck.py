@@ -75,6 +75,16 @@ CLEAN = [
     ("daemon-j3-crash", lambda: daemon.build_daemon(3, crash=True)),
     ("daemon-conc-j2-s2", lambda: daemon.build_daemon(
         2, concurrent=True, nsets=2, quota=1)),
+    # the PR 14 multi-tenant shape: instances under quota with the FIFO
+    # admission queue, and the exec-cache epoch machinery — clean
+    # protocols explore exhaustively in tier-1 bounds
+    ("daemon-conc-j2-s2-q2", lambda: daemon.build_daemon(
+        2, concurrent=True, nsets=2, quota=2)),
+    ("daemon-cache-j2", lambda: daemon.build_daemon(2, cache=True)),
+    ("daemon-cache-j2-crash", lambda: daemon.build_daemon(
+        2, crash=True, cache=True)),
+    ("daemon-conc-cache-j2-s2", lambda: daemon.build_daemon(
+        2, concurrent=True, nsets=2, quota=2, cache=True)),
     ("ft-n3", lambda: ft.build_ft(3)),
     ("ft-n3-partial", lambda: ft.build_ft(3, partial_flood=True)),
     ("ft-n3-reuse", lambda: ft.build_ft(3, reuse=True)),
@@ -113,6 +123,12 @@ EXPECTED_INVARIANT = {
     "expiry_reaps_claimed": {"no-reap"},
     "sweep_never_fires": {"deadlock"},
     "over_quota": {"admission"},
+    # multi-tenant daemon (PR 14): FIFO admission queue, concurrency-
+    # safe idle expiry, exec-cache epoch discipline
+    "queue_skips_admission": {"admission"},
+    "queue_drops_waiter": {"deadlock"},
+    "expiry_checks_set0": {"no-reap"},
+    "cache_stale_serve": {"cache-fresh"},
     # ULFM propagation (no_poison shared with seqlock/flat2 below)
     "no_revoke_unwind": {"deadlock"},
     "no_reflood": {"deadlock"},
@@ -165,6 +181,16 @@ def test_control_plane_matrix_seeds_sixteen_mutations():
             if m[0] in ("wiring", "daemon-claim", "ft-ulfm")}
     assert len(muts) >= 15, muts
     assert {m[0] for m in muts} == {"wiring", "daemon-claim", "ft-ulfm"}
+
+
+def test_multi_tenant_daemon_seeds_new_mutations():
+    """ISSUE 14: the multi-tenant protocol (admission queue, concurrent
+    expiry, exec-cache epochs) seeds >= 3 NEW mutations beyond the
+    PR 13 set, each caught by a named invariant via
+    test_mutation_caught."""
+    muts = {m[2] for m in M.mutation_matrix() if m[0] == "daemon-claim"}
+    assert {"queue_skips_admission", "queue_drops_waiter",
+            "expiry_checks_set0", "cache_stale_serve"} <= muts, muts
 
 
 def test_control_plane_violation_trace_replays():
@@ -367,13 +393,38 @@ def test_full_depth_daemon_overlapping_jobs(jobs):
 
 @pytest.mark.modelcheck
 def test_full_depth_daemon_concurrent_admission():
-    """The item-4a pre-verified variant: 3 overlapping jobs over 2
-    geometry sets under quota 2, claimer crash at every step — the
-    invariant set the multi-tenant daemon must keep."""
+    """The shipped multi-tenant protocol: 3 overlapping jobs over 2
+    set instances under quota 2 with the FIFO admission queue, claimer
+    crash at every step (incl. parked waiters) — the invariant set the
+    multi-tenant daemon keeps."""
     r = M.explore(daemon.build_daemon(3, crash=True, concurrent=True,
                                       nsets=2, quota=2),
                   max_states=2_000_000)
     assert r.complete and r.ok, \
+        [f"{v.invariant}: {v.message}" for v in r.violations]
+
+
+@pytest.mark.modelcheck
+def test_full_depth_daemon_concurrent_cache():
+    """Exec-cache epoch discipline under concurrent claims + crash:
+    a served artifact always carries the serve-time cache epoch."""
+    r = M.explore(daemon.build_daemon(2, crash=True, concurrent=True,
+                                      nsets=2, quota=2, cache=True),
+                  max_states=2_000_000)
+    assert r.complete and r.ok, \
+        [f"{v.invariant}: {v.message}" for v in r.violations]
+
+
+@pytest.mark.modelcheck
+def test_full_depth_no_reap_under_concurrency():
+    """The no-reap-under-concurrency case away from its minimal
+    config: the mis-scoped idle check (expiry deciding from one set's
+    state) is caught with 3 jobs in flight."""
+    r = M.explore(daemon.build_daemon(3, concurrent=True, nsets=3,
+                                      quota=3,
+                                      mutation="expiry_checks_set0"),
+                  max_states=2_000_000)
+    assert r.violated("no-reap"), \
         [f"{v.invariant}: {v.message}" for v in r.violations]
 
 

@@ -102,6 +102,49 @@ def test_device_band_regression_and_cliff(tmp_path):
     assert "CLIFF" in c.stdout
 
 
+def _churn_artifact(path, serial_cps=1.0, conc_cps=1.1, cold_ms=60.0,
+                    warm_ms=35.0, hit=True):
+    art = {"results": {
+        "churn_np2": {"daemon0": {"cps": serial_cps},
+                      "daemon1": {"cps": serial_cps}},
+        "churn_concurrent": {
+            "serial1": {"cps": serial_cps, "p99_s": 1.5},
+            "conc4": {"cps": conc_cps, "p99_s": 4.9}},
+    }, "exec_cache": {"cold_ms": cold_ms, "warm_ms": warm_ms,
+                      "hit": hit}}
+    with open(path, "w") as f:
+        json.dump(art, f)
+    return path
+
+
+def test_churn_artifact_guards(tmp_path):
+    """ISSUE 14: the churn-artifact lane — clean pair passes; a
+    concurrent band below the serial equal-load baseline fails the
+    in-artifact guard; an exec-cache warm hit costlier than the cold
+    build fails too."""
+    old = _churn_artifact(tmp_path / "BENCH_CHURN_r01.json")
+    good = _churn_artifact(tmp_path / "BENCH_CHURN_r02.json",
+                           conc_cps=1.05)
+    r = _run("--churn-pair", str(old), str(good), "--skip-device",
+             "--osu-pair", str(_osu_artifact(tmp_path / "o.json")),
+             str(_osu_artifact(tmp_path / "n.json")))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "churn (serial + concurrent" in r.stdout
+    slow_conc = _churn_artifact(tmp_path / "slow.json", conc_cps=0.5)
+    r = _run("--churn-pair", str(old), str(slow_conc), "--skip-device",
+             "--osu-pair", str(tmp_path / "o.json"),
+             str(tmp_path / "n.json"))
+    assert r.returncode == 1
+    assert "below the serial equal-load baseline" in r.stdout
+    slow_cache = _churn_artifact(tmp_path / "cache.json",
+                                 warm_ms=200.0)
+    r = _run("--churn-pair", str(old), str(slow_cache),
+             "--skip-device", "--osu-pair", str(tmp_path / "o.json"),
+             str(tmp_path / "n.json"))
+    assert r.returncode == 1
+    assert "exec-cache warm hit" in r.stdout
+
+
 def test_committed_artifacts_discovered_and_green():
     """The no-args CI invocation discovers the committed BENCH pair(s)
     and passes on the repo as committed — the gate must not be a
@@ -110,3 +153,4 @@ def test_committed_artifacts_discovered_and_green():
     assert r.returncode == 0, r.stdout + r.stderr
     assert "host pt2pt + coll + init + churn" in r.stdout
     assert "device coll" in r.stdout
+    assert "churn (" in r.stdout    # the BENCH_CHURN artifact lane
