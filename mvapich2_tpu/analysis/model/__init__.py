@@ -21,7 +21,12 @@ chunk-credit flow control of the HBM-streaming remote-DMA engine
 (ops/pallas_ici.py) — the handshake the jax<0.5 interpreter can never
 execute — proving no-slot-collision, no-lost-credit, agreement and
 deadlock freedom for uni- and bidirectional rings under the
-global-chunk-counter slot schedule.
+global-chunk-counter slot schedule. Its ``quant=True`` variant models
+the block-quantized wire (ops/pallas_quant.py: scale word + packed
+codes per chunk, dequant-fold at consume): same slot/credit schedule
+over the shrunken wire chunks, with agreement tightened to "every
+delivered chunk decodes with its sender's scale word" and the
+``scale_after_payload`` split-landing break seeded against it.
 
 The CONTROL plane (the one protocol surface PRs 7/11/12 left
 uncovered) gets the same treatment before ROADMAP item 4 grows it:
@@ -122,6 +127,9 @@ def mutation_matrix():
         ("ici-ring", lambda: ici.build_ring(
             n=2, chunks=2, depth=2, mutation="recv_before_send_wave"),
          "recv_before_send_wave"),
+        ("ici-ring", lambda: ici.build_ring(
+            n=2, chunks=2, depth=2, mutation="scale_after_payload"),
+         "scale_after_payload"),
         # 2-stage lazy wire (ShmChannel.ensure_wired / try_wire)
         ("wiring", lambda: wiring.build_wire(
             2, caps=(1, 0), mutation="skip_unanimity"),

@@ -61,6 +61,24 @@ named invariant):
                         (the bidir lanes must be disjoint)
   recv_before_send_wave the receiver consumes without waiting the recv
                         semaphore — it folds a stale/empty slot
+  scale_after_payload   (quant wire only) the block scale word lands
+                        AFTER the packed codes + recv signal — the
+                        receiver dequant-folds with a stale scale,
+                        outside the declared block-quant bound
+
+Quantized wire variant (``quant=True`` — ops/pallas_quant.py): each
+wire chunk carries a block scale word plus the packed code payload,
+and the consumer dequant-folds at drain. The slot/credit schedule is
+byte-count-blind, so the shrunken wire chunks (~3.9x smaller than the
+f32 chunks they encode) ride the SAME transitions — the clean quant
+model proves no-slot-collision / no-lost-credit / no-deadlock hold
+unchanged, and the agreement invariant tightens to "every delivered
+chunk decodes with exactly its sender's scale word", i.e. within the
+declared block-quant bound of the exact fold. The clean model lands
+scale + codes + signal atomically (one remote DMA of one wire run —
+the packed-single-buffer design choice this model justifies);
+``scale_after_payload`` is the seeded break of that atomicity, the
+bug a two-buffer scale/payload wire would actually have.
 """
 
 from __future__ import annotations
@@ -91,17 +109,22 @@ def _program(C: int, dirs):
 
 def build_ring(n: int = 2, chunks: int = 2, depth: int = 2,
                bidir: bool = False,
-               mutation: Optional[str] = None) -> Model:
+               mutation: Optional[str] = None,
+               quant: bool = False) -> Model:
     """``n`` ranks stream ``chunks`` chunks per direction through
     ``depth``-deep slot arrays with ``depth`` credits. ``bidir`` adds
     the counter-clockwise lane (disjoint slots/credits — except under
     the ``bidir_shared_slot`` mutation, where both lanes share array 0
-    at every receiver)."""
+    at every receiver). ``quant`` switches the wire chunk to the
+    block-quantized form (scale word + packed codes, dequant-fold at
+    consume; see module docstring)."""
     assert n >= 2 and chunks >= 1 and depth >= 1
     C, D = chunks, depth
     dirs = (0, 1) if bidir else (0,)
     if mutation == "bidir_shared_slot":
         assert bidir, "bidir_shared_slot needs the ccw lane"
+    if mutation == "scale_after_payload":
+        quant = True       # the mutation only exists on the quant wire
     prog = _program(C, dirs)
     # issued/drained counts per (pc, dir) — for the credit invariant
     issued_at = [dict.fromkeys(dirs, 0)]
@@ -139,6 +162,11 @@ def build_ring(n: int = 2, chunks: int = 2, depth: int = 2,
                 init[f"sl{r}_{a}_{s}"] = (_FREE, frozenset(), False, True)
 
     def payload(r: int, k: int, d: int) -> frozenset:
+        if quant:
+            # the quant wire chunk: block scale word + packed codes —
+            # both must be the sender's for chunk k, or the dequant
+            # fold is outside the declared block-quant bound
+            return frozenset({("s", r, k, d), ("q", r, k, d)})
         return frozenset({(r, k, d)})
 
     ts = []
@@ -174,6 +202,14 @@ def build_ring(n: int = 2, chunks: int = 2, depth: int = 2,
                             # payload is on the wire — readable TORN
                             s[wkey] = (c, TORN, True, False)
                             s[wp] = c
+                        elif mutation == "scale_after_payload":
+                            # MUTANT: packed codes + recv signal land
+                            # first, the block scale word rides a
+                            # second landing — readable with the
+                            # scale missing/stale
+                            s[wkey] = (c, frozenset({("q", r, c, d)}),
+                                       True, False)
+                            s[wp] = c
                         else:
                             # hardware DMA: payload + signal atomic
                             s[wkey] = (c, payload(r, c, d), True, False)
@@ -207,8 +243,8 @@ def build_ring(n: int = 2, chunks: int = 2, depth: int = 2,
                     frozenset({pc, rkey, res, f"cr{upr}_{d}"}))
             ts.append(mk())
 
-        # the async landing actor of the split-write mutant
-        if mutation == "signal_before_copy":
+        # the async landing actor of the split-write mutants
+        if mutation in ("signal_before_copy", "scale_after_payload"):
             for d in dirs:
                 def mkland(r=r, d=d):
                     peer = dst(r, d)
@@ -226,6 +262,11 @@ def build_ring(n: int = 2, chunks: int = 2, depth: int = 2,
                         occ, pay, sig, cons = s[key]
                         if occ == k and pay == TORN:
                             s[key] = (k, payload(r, k, d), sig, cons)
+                        elif occ == k \
+                                and mutation == "scale_after_payload":
+                            # the late scale word finally lands
+                            s[key] = (k, pay | {("s", r, k, d)},
+                                      sig, cons)
                         s[wp] = None
                         return s
 
@@ -267,6 +308,13 @@ def build_ring(n: int = 2, chunks: int = 2, depth: int = 2,
                         return (f"rank {r} dir {d} folded a TORN "
                                 f"chunk {i}")
                     if pay != payload(src, i, d):
+                        if quant and isinstance(pay, frozenset) \
+                                and ("s", src, i, d) not in pay:
+                            return (f"rank {r} dir {d} dequant-folded "
+                                    f"chunk {i} with a missing/stale "
+                                    "scale word — outside the declared "
+                                    "block-quant bound of the exact "
+                                    "fold")
                         return (f"rank {r} dir {d} chunk {i} delivered "
                                 f"{sorted(pay)} != the upstream "
                                 "contribution")
@@ -276,7 +324,8 @@ def build_ring(n: int = 2, chunks: int = 2, depth: int = 2,
         return all(s[f"pc{r}"] == end for r in range(n))
 
     label = (f"ici-ring(n={n},C={C},D={D},"
-             f"{'bidir' if bidir else 'uni'},mut={mutation})")
+             f"{'bidir' if bidir else 'uni'}"
+             f"{',quant' if quant else ''},mut={mutation})")
     return Model(label, init, ts,
                  [("no-slot-collision", inv_collision),
                   ("no-lost-credit", inv_credit),

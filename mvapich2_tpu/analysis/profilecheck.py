@@ -51,7 +51,8 @@ FORMAT_V1 = "mv2t-tuning-profile-v1"
 _PROFILE_KEYS = {"tables", "device_crossovers", "kernel_params",
                  "raw", "raw_device_tiers"}
 _DOC_KEYS = {"arch_key", "format", "profile", "comment"}
-_DEV_TIER_KEYS = {"dev_tier_vmem_max", "dev_tier_xla_min"}
+_DEV_TIER_KEYS = {"dev_tier_vmem_max", "dev_tier_xla_min",
+                  "dev_tier_quant_min"}
 
 
 def _load_module(path: str) -> Optional[SourceModule]:
@@ -100,7 +101,8 @@ class _TuningFacts:
         # back to the committed ops/ tree when linting fixtures)
         param_mods = [m for m in modules] or []
         if not any("ops/" in m.relpath for m in param_mods):
-            for name in ("pallas_ici.py", "pallas_hbm.py"):
+            for name in ("pallas_ici.py", "pallas_hbm.py",
+                         "pallas_quant.py"):
                 m = _load_module(os.path.join(PKG_ROOT, "ops", name))
                 if m is not None:
                     param_mods.append(m)
@@ -110,7 +112,8 @@ class _TuningFacts:
                     fn = node.func
                     nm = fn.attr if isinstance(fn, ast.Attribute) else \
                         (fn.id if isinstance(fn, ast.Name) else None)
-                    if nm in ("kernel_param", "_tuned_default") \
+                    if nm in ("kernel_param", "kernel_param_cv",
+                              "_tuned_default") \
                             and node.args \
                             and isinstance(node.args[0], ast.Constant) \
                             and isinstance(node.args[0].value, str):
@@ -202,6 +205,7 @@ class _TuningFacts:
         runtime resolver's business; the doctor checks shape)."""
         reps = {"eager": 32 * 1024, "coll_max": 256 * 1024,
                 "dev_tier_vmem_max": 4 * 1024 * 1024,
+                "dev_tier_quant_min": 1 << 61,
                 "dev_tier_xla_min": 1 << 62}
         if isinstance(bound, str):
             return reps.get(bound)
@@ -369,6 +373,13 @@ class ProfileDoctorPass(LintPass):
                      f"wrapper cap {facts.vmem_limit} "
                      "(ops/pallas_ring.VMEM_LIMIT_BYTES) — the vmem "
                      "tier would refuse every shard in the band")
+            qmin = dc.get("dev_tier_quant_min")
+            if isinstance(qmin, int) and qmin >= 0 \
+                    and isinstance(vmax, int) and qmin < vmax:
+                emit(f"dev_tier_quant_min {qmin} sits below the "
+                     f"vmem->hbm edge {vmax} — the quantized bin "
+                     "would swallow the vmem band (device tier bins "
+                     "no longer disjoint)")
 
         kp = prof.get("kernel_params", {})
         if isinstance(kp, dict):

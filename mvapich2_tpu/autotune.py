@@ -257,11 +257,12 @@ DEVICE_TIER_SIZES_CPU = [4096, 16384, 65536, 262144]
 def measure_device_tiers(sizes: Optional[List[int]] = None, reps: int = 3,
                          chunk_candidates: Optional[List[int]] = None,
                          interpret: Optional[bool] = None) -> Dict:
-    """Sweep the three device-collective tiers (VMEM flat ring /
-    HBM-streaming chunked ring / XLA lowering) over per-shard message
-    sizes and derive the tier boundaries from measurement — the
-    producer of the profile's ``device_crossovers.dev_tier_vmem_max`` /
-    ``dev_tier_xla_min`` entries and ``kernel_params.ici_chunk_bytes``
+    """Sweep the device-collective tiers (VMEM flat ring /
+    HBM-streaming chunked ring / block-scaled quantized wire / XLA
+    lowering) over per-shard message sizes and derive the tier
+    boundaries from measurement — the producer of the profile's
+    ``device_crossovers.dev_tier_vmem_max`` / ``dev_tier_xla_min`` /
+    ``dev_tier_quant_min`` entries and ``kernel_params.ici_chunk_bytes``
     (consumed by coll/tuning.device_tier and ops/pallas_ici). Driven by
     ``bin/measure_crossover --device``. Needs >= 2 devices (a CPU host
     wants XLA_FLAGS=--xla_force_host_platform_device_count=N set
@@ -272,7 +273,7 @@ def measure_device_tiers(sizes: Optional[List[int]] = None, reps: int = 3,
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from .ops import pallas_ici, pallas_ring
+    from .ops import pallas_ici, pallas_quant, pallas_ring
     from .parallel.mesh import make_mesh, shard_map
 
     devs = jax.devices()
@@ -315,6 +316,13 @@ def measure_device_tiers(sizes: Optional[List[int]] = None, reps: int = 3,
                     s, "x", p, interpret=interpret), shard * p)
         except Exception as e:
             log.warn("hbm tier failed at %d bytes: %s", shard, e)
+        try:
+            raw.setdefault("quant", {})[str(shard)] = timed(
+                lambda s: pallas_quant.quant_ring_all_reduce(
+                    s, "x", p, wire="q8", interpret=interpret),
+                shard * p)
+        except Exception as e:
+            log.warn("quant tier failed at %d bytes: %s", shard, e)
 
     # boundaries: vmem keeps the band where it wins (bounded by its hard
     # VMEM cap); xla re-enters at the first size it beats both kernels
@@ -346,11 +354,29 @@ def measure_device_tiers(sizes: Optional[List[int]] = None, reps: int = 3,
         if t < best_t:
             best_chunk, best_t = cb, t
 
+    # quant edge: the smallest size above which the quantized wire
+    # kernel beats the exact hbm kernel and never loses again. Only
+    # committed when a real win is measured — on the CPU interpreter
+    # the codec is pure emulation cost, and a meaningless edge must
+    # not shadow the compiled-in default (the wire-byte win is real
+    # everywhere; the TIME win is a hardware question, ROADMAP item 1).
+    quant_min = -1
+    for nbytes in sizes:
+        k = str(nbytes)
+        tq = raw.get("quant", {}).get(k, float("inf"))
+        th = raw["hbm"].get(k, float("inf"))
+        if tq < th and quant_min < 0:
+            quant_min = nbytes
+        elif tq >= th:
+            quant_min = -1
+
     out: Dict = {
         "device_crossovers": {"dev_tier_vmem_max": vmem_max,
                               "dev_tier_xla_min": xla_min},
         "raw_device_tiers": raw,
     }
+    if quant_min >= 0:
+        out["device_crossovers"]["dev_tier_quant_min"] = quant_min
     if best_chunk is not None:
         out["kernel_params"] = {"ici_chunk_bytes": best_chunk}
     return out

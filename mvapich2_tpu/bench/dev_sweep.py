@@ -5,12 +5,19 @@ VMEM flat ring / HBM-streaming chunked ring / XLA by measured boundaries)
 across per-shard message sizes and emits an osu_compare-compatible
 artifact::
 
-    {"results": {"dev_allreduce_effbw": {"<bytes>": GB/s, ...}},
-     "tiers":   {"<bytes>": "vmem|hbm|xla", ...}}
+    {"results": {"dev_allreduce_effbw":    {"<bytes>": GB/s, ...},
+                 "dev_allreduce_q8_effbw": {"<bytes>": GB/s, ...}},
+     "tiers":      {"<bytes>": "vmem|hbm|quant|xla", ...},
+     "wire_bytes": {"<bytes>": {"exact": N, "quant": N}, ...}}
 
-``effbw`` is the OSU ring busbw model 2*(p-1)/p * m / t. Two artifacts
-diff through ``bin/osu_compare`` exactly like the host OSU ones — a >10%
-effbw regression or a >3x adjacent-size drop (a new tier cliff) in the
+``effbw`` is the OSU ring busbw model 2*(p-1)/p * m / t. The
+``_q8_`` band is the block-scaled quantized tier (ops/pallas_quant,
+int8 wire forced) at the same sizes, and ``wire_bytes`` is the
+per-rank bytes-on-ICI accounting for the exact vs quantized wire —
+the hardware-independent half of the quant-tier claim, guarded by
+bin/perf_gate (quant <= 0.3x exact at >= 1 MiB). Two artifacts diff
+through ``bin/osu_compare`` exactly like the host OSU ones — a >10%
+effbw regression or a >3x adjacent-size drop (a new tier cliff) in any
 device band fails the gate. On a CPU host the kernels run under the
 Mosaic interpreter over a forced virtual mesh (tiny sizes, structural
 check — tier-1 uses this); on TPU the numbers are the real device band.
@@ -46,7 +53,7 @@ def sweep(sizes: List[int], iters: int = 5,
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..autotune import load_default_profile
-    from ..ops import pallas_ici
+    from ..ops import pallas_ici, pallas_quant
     from ..parallel.mesh import make_mesh, shard_map
 
     load_default_profile()   # the measured tier boundaries, when committed
@@ -60,19 +67,10 @@ def sweep(sizes: List[int], iters: int = 5,
         interpret = devs[0].platform != "tpu"
     mesh = make_mesh((p,), ("x",), devs)
     sharding = NamedSharding(mesh, P("x"))
-    results: Dict[str, float] = {}
-    tiers: Dict[str, str] = {}
-    for nbytes in sizes:
-        n = max(4, nbytes // 4)           # f32 elems per shard
-        tier, reason = pallas_ici.planned_tier(
-            "allreduce", n * 4, jnp.float32, "sum", interpret)
-        tiers[str(nbytes)] = tier
-        x = jax.device_put(jnp.ones((n * p,), jnp.float32), sharding)
-        f = jax.jit(shard_map(
-            lambda s: pallas_ici.ici_all_reduce(s, "x", p,
-                                                interpret=interpret),
-            mesh=mesh, in_specs=(P("x"),), out_specs=P("x"),
-            check_vma=False))
+
+    def timed(body, x):
+        f = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("x"),),
+                              out_specs=P("x"), check_vma=False))
         jax.block_until_ready(f(x))       # compile outside the window
         ts = []
         for _ in range(iters):
@@ -80,11 +78,40 @@ def sweep(sizes: List[int], iters: int = 5,
             jax.block_until_ready(f(x))
             ts.append(time.perf_counter() - t0)
         ts.sort()
-        t = ts[len(ts) // 2]
+        return ts[len(ts) // 2]
+
+    results: Dict[str, float] = {}
+    results_q: Dict[str, float] = {}
+    tiers: Dict[str, str] = {}
+    wire_bytes: Dict[str, Dict[str, int]] = {}
+    for nbytes in sizes:
+        n = max(4, nbytes // 4)           # f32 elems per shard
+        tier, reason = pallas_ici.planned_tier(
+            "allreduce", n * 4, jnp.float32, "sum", interpret,
+            num_devices=p)
+        tiers[str(nbytes)] = tier
+        x = jax.device_put(jnp.ones((n * p,), jnp.float32), sharding)
+        t = timed(lambda s: pallas_ici.ici_all_reduce(
+            s, "x", p, interpret=interpret), x)
         m = n * 4
         results[str(nbytes)] = round(2.0 * (p - 1) / p * m / t / 1e9, 6)
-    return {"results": {"dev_allreduce_effbw": results},
+        # the quantized band (int8 wire forced) at the same size, plus
+        # the bytes-on-wire accounting — the perf_gate wire guard's row
+        tq = timed(lambda s: pallas_quant.quant_ring_all_reduce(
+            s, "x", p, wire="q8", interpret=interpret), x)
+        results_q[str(nbytes)] = round(2.0 * (p - 1) / p * m / tq / 1e9,
+                                       6)
+    # bytes-on-wire accounting is analytic (ops/pallas_quant.wire_stats)
+    # so it always covers the >= 1 MiB rows the perf_gate wire guard
+    # reads, even when an interpreter host times a smaller band
+    for nbytes in sorted(set(sizes) | {1 << 20, 4 << 20}):
+        n = max(4, nbytes // 4)
+        exact_b, quant_b = pallas_quant.wire_stats(n, jnp.float32, p)
+        wire_bytes[str(nbytes)] = {"exact": exact_b, "quant": quant_b}
+    return {"results": {"dev_allreduce_effbw": results,
+                        "dev_allreduce_q8_effbw": results_q},
             "tiers": tiers,
+            "wire_bytes": wire_bytes,
             "detail": {"devices": p,
                        "platform": devs[0].platform,
                        "interpret": bool(interpret),

@@ -379,9 +379,9 @@ class DeviceCollChannel:
         instant when the XLA lowering is taken — the once-invisible
         VMEM-cap cliff. Per call, unlike the per-traced-shape counting
         at the kernel wrappers (programs are cached per signature).
-        Returns the tier label the call will run on ('vmem'/'hbm'/'xla',
-        'slot' on the single-device channel) — the dispatch span and
-        the dev_effbw watermark key off it."""
+        Returns the tier label the call will run on ('vmem'/'hbm'/
+        'quant'/'xla', 'slot' on the single-device channel) — the
+        dispatch span and the dev_effbw watermark key off it."""
         if self.mesh is None:
             return "slot"   # single-device slot channel: no ICI tiers
         from .. import mpit
@@ -391,9 +391,18 @@ class DeviceCollChannel:
                                        else 1)
         if name not in ("allreduce", "reduce", "allgather"):
             return "xla"    # ops without a ring-kernel lowering
-        tier, reason = pallas_ici.planned_tier(name, nbytes, dtype, op)
+        tier, reason = pallas_ici.planned_tier(name, nbytes, dtype, op,
+                                               num_devices=self.size)
         if reason is None:
             mpit.pvar(f"dev_coll_tier_{tier}").inc()
+            if tier == "quant":
+                # the measurable half of the quant claim: bytes kept
+                # off the ICI wire by this call, per rank
+                from ..ops import pallas_quant
+                exact_b, wire_b = pallas_quant.wire_stats(
+                    n, dtype, self.size)
+                mpit.pvar("dev_coll_quant_bytes_saved").inc(
+                    max(0, exact_b - wire_b))
             return tier
         mpit.pvar(f"dev_coll_fallback_{reason}").inc()
         tr = getattr(comm.u.engine, "tracer", None)

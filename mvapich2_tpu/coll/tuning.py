@@ -57,6 +57,12 @@ cvar("DEV_TIER_XLA_MIN", -1, int, "device",
      "(-1 = never — the HBM-streaming tier has no size ceiling). "
      "Measured profiles (device_crossovers.dev_tier_xla_min) override. "
      "Every XLA take is counted by the dev_coll_fallback_* pvars.")
+cvar("DEV_TIER_QUANT_MIN", 1024 * 1024, int, "device",
+     "Device-collective tier edge: with an MV2T_QUANT_COLL accuracy "
+     "budget set, float sum-reduce shards at or above this many bytes "
+     "take the block-scaled quantized wire tier (ops/pallas_quant) "
+     "above the exact hbm tier (-1 = never). Measured profiles "
+     "(device_crossovers.dev_tier_quant_min) override.")
 
 # ---------------------------------------------------------------------------
 # algorithm registries (name -> fn), per collective
@@ -191,6 +197,20 @@ def kernel_param(key: str, default: int) -> int:
     return _KERNEL_PARAMS.get(key, default)
 
 
+def kernel_param_cv(key: str, cvar_name: str) -> int:
+    """A cvar-backed kernel parameter with the device-edge precedence
+    (_dev_tier_edge): explicitly-set cvar (the user said so) >
+    measured profile entry > cvar default. Before this, a committed
+    profile's ici_chunk_bytes silently outranked an explicit
+    MV2T_ICI_CHUNK_BYTES — the one device knob the user could never
+    win back from a measurement."""
+    cv = get_config()._vars[cvar_name]
+    val = int(cv.value)
+    if not cv._explicit:
+        val = int(_KERNEL_PARAMS.get(key, val))
+    return val
+
+
 def describe_profile() -> Dict:
     """The loaded measured-profile state, for display tools (mpiname
     -a): {} values when no profile is loaded."""
@@ -215,16 +235,53 @@ def device_crossover(name: str, comm) -> int:
     return val
 
 
+def quant_params() -> Tuple[str, float]:
+    """(wire_format, rel_error_budget) parsed from MV2T_QUANT_COLL.
+    Grammar: '' = off (budget 0); '<budget>' = q8 wire with that max
+    relative-error budget (e.g. '1e-2'); '<wire>:<budget>' selects the
+    wire format explicitly (q8 | fp8). A malformed value logs once and
+    reads as off — a typo must never silently quantize."""
+    raw = str(get_config().get("QUANT_COLL", "") or "").strip()
+    if not raw:
+        return "q8", 0.0
+    wire = "q8"
+    if ":" in raw:
+        wire, _, raw = raw.partition(":")
+        wire = wire.strip().lower()
+    try:
+        budget = float(raw)
+    except ValueError:
+        log.warn("MV2T_QUANT_COLL %r is not '<budget>' or "
+                 "'<wire>:<budget>'; quant tier off", raw)
+        return "q8", 0.0
+    if wire not in ("q8", "fp8"):
+        log.warn("MV2T_QUANT_COLL wire %r is not q8|fp8; quant tier "
+                 "off", wire)
+        return "q8", 0.0
+    return wire, max(0.0, budget)
+
+
 def device_tier(name: str, shard_nbytes: int) -> str:
-    """'vmem' | 'hbm' | 'xla' for a device-resident collective shard of
-    ``shard_nbytes`` — the device-side msg-size bin. Edge precedence
-    mirrors device_crossover(): explicitly-set cvar (the user said so)
-    > measured profile entry > cvar default. ``name`` is accepted for
+    """'vmem' | 'hbm' | 'quant' | 'xla' for a device-resident
+    collective shard of ``shard_nbytes`` — the device-side msg-size
+    bin. Edge precedence mirrors device_crossover(): explicitly-set
+    cvar (the user said so) > measured profile entry > cvar default.
+    The quant bin sits at the top (above hbm AND the xla re-entry: its
+    whole point is shrinking the wire where messages are largest) and
+    only opens when MV2T_QUANT_COLL carries a nonzero accuracy budget;
+    per-call eligibility (op/dtype/bound) is the kernel dispatcher's
+    check (ops/pallas_ici.planned_tier). ``name`` is accepted for
     future per-collective edges; today the edges are shared."""
     vmax = _dev_tier_edge("DEV_TIER_VMEM_MAX", "dev_tier_vmem_max")
     xmin = _dev_tier_edge("DEV_TIER_XLA_MIN", "dev_tier_xla_min")
     if shard_nbytes <= vmax:
         return "vmem"
+    _wire, budget = quant_params()
+    if budget > 0:
+        qmin = _dev_tier_edge("DEV_TIER_QUANT_MIN",
+                              "dev_tier_quant_min")
+        if qmin >= 0 and shard_nbytes >= qmin:
+            return "quant"
     if xmin is not None and xmin >= 0 and shard_nbytes >= xmin:
         return "xla"
     return "hbm"
@@ -255,6 +312,8 @@ def _resolve_edge(bound):
         return _dev_tier_edge("DEV_TIER_VMEM_MAX", "dev_tier_vmem_max")
     if bound == "dev_tier_xla_min":
         return _dev_tier_edge("DEV_TIER_XLA_MIN", "dev_tier_xla_min")
+    if bound == "dev_tier_quant_min":
+        return _dev_tier_edge("DEV_TIER_QUANT_MIN", "dev_tier_quant_min")
     return bound
 
 

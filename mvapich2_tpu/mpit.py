@@ -330,6 +330,21 @@ cvar("ICI_INTERPRET", False, bool, "device",
      "the device tiers run on a CPU mesh (correctness sweeps, CI). "
      "Off-TPU with this unset, device collectives take the XLA "
      "lowering and count dev_coll_fallback_platform.")
+cvar("QUANT_COLL", "", str, "device",
+     "Accuracy budget opening the block-scaled quantized device-"
+     "allreduce tier (ops/pallas_quant): '' = off (exact kernels "
+     "only); '<budget>' = int8 wire with that max relative-error "
+     "budget (e.g. '1e-2'); '<wire>:<budget>' selects the wire format "
+     "(q8 | fp8). Integer dtypes, non-sum ops, budget 0 and budgets "
+     "below the declared per-ring bound all keep the exact hbm tier — "
+     "the quantized path never runs outside its error contract.")
+cvar("QUANT_BLOCK", 512, int, "device",
+     "Quantization block size (bytes of the unquantized dtype) of the "
+     "quantized wire format: each block travels as one f32 absmax "
+     "scale word plus packed int8/fp8 codes, so larger blocks shrink "
+     "the wire further but share one scale across more elements. A "
+     "measured profile (kernel_params.quant_block_bytes) overrides "
+     "this default.")
 
 pvar("dev_coll_fallback_size", PVAR_CLASS_COUNTER, "device",
      "device collectives routed to the XLA lowering because the shard "
@@ -351,6 +366,13 @@ pvar("dev_coll_tier_vmem", PVAR_CLASS_COUNTER, "device",
 pvar("dev_coll_tier_hbm", PVAR_CLASS_COUNTER, "device",
      "device collective calls served by the HBM-streaming chunked ring "
      "tier (ops/pallas_ici)")
+pvar("dev_coll_tier_quant", PVAR_CLASS_COUNTER, "device",
+     "device collective calls served by the block-scaled quantized "
+     "wire tier (ops/pallas_quant, gated by MV2T_QUANT_COLL)")
+pvar("dev_coll_quant_bytes_saved", PVAR_CLASS_COUNTER, "device",
+     "bytes kept off the ICI wire by the quantized tier: exact-wire "
+     "minus quantized-wire accounting (ops/pallas_quant.wire_stats) "
+     "summed per dispatched call at the collective wrapper")
 
 # device-lane timing observability (ISSUE 10): per-tier effective-
 # bandwidth watermarks measured at the dispatch wrapper
@@ -363,7 +385,7 @@ cvar("JAX_PROFILE", "", str, "device",
      "stopped at process exit). Empty = off. The hardware-tuning "
      "workflow for ici_chunk_bytes/ICI_PIPELINE_DEPTH on a real TPU "
      "(ROADMAP item 1) reads this trace in TensorBoard/XProf.")
-for _tier in ("vmem", "hbm", "xla", "slot"):
+for _tier in ("vmem", "hbm", "quant", "xla", "slot"):
     pvar(f"dev_effbw_{_tier}", PVAR_CLASS_HIGHWATERMARK, "device",
          f"high watermark of end-to-end algorithmic bandwidth (GB/s, "
          f"payload bytes / wall seconds) observed on the '{_tier}' "

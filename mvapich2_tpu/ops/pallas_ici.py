@@ -32,8 +32,9 @@ dataflow), so interpret-mode runs are creditless.
 
 Tier selection (``planned_tier``) is data driven: coll/tuning.py's
 ``device_tier`` maps shard bytes to vmem (pallas_ring) / hbm (here) /
-xla, with the boundaries re-measurable by ``bin/measure_crossover
---device``. Every fallback to the XLA lowering is counted by the
+quant (pallas_quant — the block-scaled quantized wire above the hbm
+tier, gated by the MV2T_QUANT_COLL accuracy budget) / xla, with the
+boundaries re-measurable by ``bin/measure_crossover --device``. Every fallback to the XLA lowering is counted by the
 ``dev_coll_fallback_*`` pvar family — the 4 MiB cliff is no longer
 silent.
 
@@ -78,9 +79,9 @@ _CID_SENDRECV = 11
 
 def _cfg_chunk_elems(dtype, chunk_bytes: Optional[int]) -> int:
     if chunk_bytes is None:
-        from ..coll.tuning import kernel_param
-        chunk_bytes = kernel_param("ici_chunk_bytes",
-                                   int(get_config()["ICI_CHUNK_BYTES"]))
+        from ..coll.tuning import kernel_param_cv
+        chunk_bytes = kernel_param_cv("ici_chunk_bytes",
+                                      "ICI_CHUNK_BYTES")
     return max(1, int(chunk_bytes) // np.dtype(dtype).itemsize)
 
 
@@ -197,8 +198,7 @@ class _RingStreamer:
             la.start()
             self.pending_acc[(d, slot)] = la
         ld.wait()
-        if self.credits:                      # device: hw-only
-            pltpu.semaphore_wait(self.cap_sem.at[d], 1)
+        self._take_credit(d)
         dst = self.right if d == 0 else self.left
         rdma = pltpu.make_async_remote_copy(
             src_ref=self.send_buf.at[d, slot, pl.ds(0, sz)],
@@ -237,6 +237,14 @@ class _RingStreamer:
             st.start()
             st.wait()                  # slot must land before re-grant
             self._grant(d)
+
+    def _take_credit(self, d):                # device: hw-only
+        """Consume one slot credit before the remote DMA — the sender
+        half of the chunk-credit handshake (shared with the quantized
+        streamer, ops/pallas_quant.py)."""
+        if not self.credits:
+            return
+        pltpu.semaphore_wait(self.cap_sem.at[d], 1)
 
     def _grant(self, d):                      # device: hw-only
         if not self.credits:
@@ -543,13 +551,18 @@ def _kernels_runnable(interpret: Optional[bool]) -> bool:
 
 
 def planned_tier(name: str, shard_nbytes: int, dtype, op: Optional[str],
-                 interpret=None) -> Tuple[str, Optional[str]]:
+                 interpret=None,
+                 num_devices: Optional[int] = None
+                 ) -> Tuple[str, Optional[str]]:
     """(tier, fallback_reason) for one device collective call. tier is
-    'vmem' | 'hbm' | 'xla'; reason is None unless the XLA lowering was
-    taken, in which case it names the dev_coll_fallback_* pvar bucket:
-    size (past the measured XLA crossover), dtype (op/dtype the kernels
-    cannot reduce), shape (degenerate extent), platform (no pallas /
-    not a TPU and not interpreting)."""
+    'vmem' | 'hbm' | 'quant' | 'xla'; reason is None unless the XLA
+    lowering was taken, in which case it names the dev_coll_fallback_*
+    pvar bucket: size (past the measured XLA crossover), dtype (op/
+    dtype the kernels cannot reduce), shape (degenerate extent),
+    platform (no pallas / not a TPU and not interpreting). A 'quant'
+    bin the call cannot actually quantize (non-sum op, int dtype,
+    budget below the declared bound for ``num_devices``) degrades to
+    the exact 'hbm' tier — a bit-exact fallback, not an XLA take."""
     if not HAVE_PALLAS or not _kernels_runnable(interpret):
         return "xla", "platform"
     if op is not None and op not in _SUPPORTED_OPS:
@@ -560,6 +573,10 @@ def planned_tier(name: str, shard_nbytes: int, dtype, op: Optional[str],
         return "xla", "shape"
     from ..coll.tuning import device_tier
     tier = device_tier(name, shard_nbytes)
+    if tier == "quant":
+        from . import pallas_quant
+        if not pallas_quant.quant_eligible(name, dtype, op, num_devices):
+            tier = "hbm"
     if tier == "xla":
         return "xla", "size"
     return tier, None
@@ -595,8 +612,12 @@ def ici_all_reduce(x: jax.Array, axis_name: str, num_devices: int,
         from .collectives import allreduce
         return allreduce(x, axis_name, op)
     tier, reason = planned_tier("allreduce", x.size * x.dtype.itemsize,
-                                x.dtype, op, interpret)
+                                x.dtype, op, interpret, num_devices=p)
     _trace_entry("allreduce", tier, x.size * x.dtype.itemsize, op=op)
+    if tier == "quant":
+        from . import pallas_quant
+        return pallas_quant.quant_ring_all_reduce(x, axis_name, p, op,
+                                                  interpret=interpret)
     if tier == "vmem":
         from . import pallas_ring
         if x.ndim >= 1 and x.shape[0] % p == 0 and op == "sum":

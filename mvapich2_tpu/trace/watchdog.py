@@ -169,6 +169,8 @@ def _device_report(u) -> list:
     try:
         pvs = []
         for name in ("dev_coll_tier_vmem", "dev_coll_tier_hbm",
+                     "dev_coll_tier_quant",
+                     "dev_coll_quant_bytes_saved",
                      "dev_coll_fallback_size", "dev_coll_fallback_dtype",
                      "dev_coll_fallback_shape",
                      "dev_coll_fallback_platform"):
@@ -177,7 +179,7 @@ def _device_report(u) -> list:
                 pvs.append(f"{name}={v:g}")
         lines.append("  tier counters: " + (" ".join(pvs) or "(none)"))
         bws = [f"{t}={mpit.pvar(f'dev_effbw_{t}').read():.3g}"
-               for t in ("vmem", "hbm", "xla", "slot")
+               for t in ("vmem", "hbm", "quant", "xla", "slot")
                if mpit.pvar(f"dev_effbw_{t}").read()]
         if bws:
             lines.append("  effbw watermarks (GB/s): " + " ".join(bws))

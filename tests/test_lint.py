@@ -545,21 +545,22 @@ def test_profile_doctor_bad_fixture():
     unknown keys, filename/arch mismatch, unknown collective/class,
     unregistered algo, non-monotone and non-total bins, unknown
     symbolic edge, bad crossover keys/values, vmem edge past the hard
-    wrapper cap, typo'd/invalid kernel params."""
+    wrapper cap, a quant edge below the vmem->hbm edge (ISSUE 15),
+    typo'd/invalid kernel params."""
     from mvapich2_tpu.analysis.profilecheck import ProfileDoctorPass
     mods, _ = core.scan_paths([os.path.join(REPO, "mvapich2_tpu")])
     fs = ProfileDoctorPass(
         profile_files=[os.path.join(FIXTURES, "bad_profile.json")]
     ).run(mods)
     msgs = "\n".join(f.msg for f in fs)
-    assert len(fs) == 15, msgs
+    assert len(fs) == 16, msgs
     for needle in ("surprise", "tpu_TPU-v9_8.json", "mystery_section",
                    "non-final open (None) bin", "table not total",
                    "galactic", "warp_speed", "totally_real_algo",
                    "not strictly increasing", "frobnicate",
                    "dev_tier_quux", "not a byte count",
-                   "VMEM wrapper cap", "ici_chunk_bites",
-                   "not a positive integer"):
+                   "VMEM wrapper cap", "quantized bin would swallow",
+                   "ici_chunk_bites", "not a positive integer"):
         assert needle in msgs, needle
 
 

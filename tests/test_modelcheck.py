@@ -59,6 +59,15 @@ CLEAN = [
     ("ici-n3-C2-D2", lambda: ici.build_ring(3, 2, 2)),
     ("ici-n3-C2-D2-bidir", lambda: ici.build_ring(3, 2, 2, bidir=True)),
     ("ici-n4-C2-D2", lambda: ici.build_ring(4, 2, 2)),
+    # the quantized wire variant (ISSUE 15): scale word + packed codes
+    # per chunk, dequant-fold at consume — same slot/credit schedule
+    # over the shrunken wire chunks, agreement tightened to the
+    # declared block-quant bound
+    ("ici-n2-C2-D2-quant", lambda: ici.build_ring(2, 2, 2, quant=True)),
+    ("ici-n3-C2-D2-quant", lambda: ici.build_ring(3, 2, 2, quant=True)),
+    ("ici-n3-C2-D2-quant-bidir", lambda: ici.build_ring(
+        3, 2, 2, bidir=True, quant=True)),
+    ("ici-n2-C4-D3-quant", lambda: ici.build_ring(2, 4, 3, quant=True)),
     # control-plane net (ISSUE 13): 2-stage lazy wire, warm-attach
     # daemon claim cycle (+ the item-4a concurrent-claims variant),
     # ULFM lease-detect/revoke/shrink propagation — tier-1 bounds all
@@ -141,6 +150,10 @@ EXPECTED_INVARIANT = {
     "signal_before_copy": {"agreement"},
     "bidir_shared_slot": {"no-slot-collision", "agreement"},
     "recv_before_send_wave": {"agreement"},
+    # quantized wire (ISSUE 15): the scale word landing after the
+    # packed codes + recv signal -> a dequant-fold outside the
+    # declared block-quant bound
+    "scale_after_payload": {"agreement"},
 }
 
 
@@ -210,13 +223,15 @@ def test_control_plane_violation_trace_replays():
 
 
 def test_ici_matrix_has_six_mutations():
-    """ISSUE 12: the ici chunk-credit model seeds >= 6 distinct
-    protocol breaks, every one caught by a named invariant (asserted
-    per-mutation by test_mutation_caught over the matrix)."""
+    """ISSUE 12 (+ the ISSUE 15 quant-wire break): the ici
+    chunk-credit model seeds >= 7 distinct protocol breaks, every one
+    caught by a named invariant (asserted per-mutation by
+    test_mutation_caught over the matrix)."""
     muts = {m[2] for m in M.mutation_matrix() if m[0] == "ici-ring"}
     assert muts == {"no_credit_wait", "slot_off_by_one",
                     "depth_mismatch", "signal_before_copy",
-                    "bidir_shared_slot", "recv_before_send_wave"}
+                    "bidir_shared_slot", "recv_before_send_wave",
+                    "scale_after_payload"}
 
 
 def test_ici_violation_trace_replays():
@@ -333,12 +348,31 @@ def test_full_depth_ici_matrix(n, chunks, depth, bidir):
 
 
 @pytest.mark.modelcheck
+@pytest.mark.parametrize("n", [2, 3, 4])
+@pytest.mark.parametrize("chunks", [2, 4])
+@pytest.mark.parametrize("depth", [2, 3])
+@pytest.mark.parametrize("bidir", [False, True],
+                         ids=["uni", "bidir"])
+def test_full_depth_ici_quant_matrix(n, chunks, depth, bidir):
+    """ISSUE 15 acceptance: the quantized-wire chunk-credit ring is
+    exhaustively green over the SAME bounds as the exact matrix above
+    — the shrunken wire chunks change payload contents only, never
+    the slot/credit schedule."""
+    r = M.explore(ici.build_ring(n, chunks, depth, bidir=bidir,
+                                 quant=True),
+                  max_states=2_000_000)
+    assert r.complete, f"truncated at {r.states} states"
+    assert r.ok, [f"{v.invariant}: {v.message}" for v in r.violations]
+
+
+@pytest.mark.modelcheck
 def test_full_depth_ici_mutations_np3():
     """The ici mutations still caught away from their minimal
     configs (np=3, deeper pipelines)."""
     for mut, kw in [("no_credit_wait", dict(chunks=4, depth=2)),
                     ("signal_before_copy", dict(chunks=3, depth=3)),
-                    ("recv_before_send_wave", dict(chunks=3, depth=2))]:
+                    ("recv_before_send_wave", dict(chunks=3, depth=2)),
+                    ("scale_after_payload", dict(chunks=3, depth=2))]:
         r = M.explore(ici.build_ring(3, mutation=mut, **kw))
         assert not r.ok, mut
 

@@ -333,13 +333,18 @@ def test_device_category():
     assert "device" in cats
     info = mpit.category_get_info(cats.index("device"))
     for cv in ("ICI_CHUNK_BYTES", "ICI_PIPELINE_DEPTH", "ICI_BIDIR",
-               "ICI_INTERPRET", "DEV_TIER_VMEM_MAX", "DEV_TIER_XLA_MIN"):
+               "ICI_INTERPRET", "DEV_TIER_VMEM_MAX", "DEV_TIER_XLA_MIN",
+               "QUANT_COLL", "QUANT_BLOCK", "DEV_TIER_QUANT_MIN"):
         assert cv in info["cvars"], cv
     for pv in ("dev_coll_fallback_size", "dev_coll_fallback_dtype",
                "dev_coll_fallback_shape", "dev_coll_fallback_platform",
-               "dev_coll_tier_vmem", "dev_coll_tier_hbm"):
+               "dev_coll_tier_vmem", "dev_coll_tier_hbm",
+               "dev_coll_tier_quant", "dev_coll_quant_bytes_saved"):
         assert pv in info["pvars"], pv
         assert mpit._pvars.get(pv).klass == mpit.PVAR_CLASS_COUNTER
+    # the per-tier effbw watermark family covers the quant tier too
+    assert mpit._pvars.get("dev_effbw_quant").klass == \
+        mpit.PVAR_CLASS_HIGHWATERMARK
     # cvar surface round-trips through the indexed MPI_T view
     i = mpit.cvar_get_index("ICI_CHUNK_BYTES")
     assert mpit.cvar_get_info(i)["name"] == "ICI_CHUNK_BYTES"

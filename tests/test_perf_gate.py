@@ -145,6 +145,72 @@ def test_churn_artifact_guards(tmp_path):
     assert "exec-cache warm hit" in r.stdout
 
 
+def _device_repo_artifact(path, rev, pair_session=None, q8=True,
+                          wire_ratio=0.258):
+    """A BENCH_r*.json-shaped artifact for the discovery path."""
+    sizes = [8192, 131072, 1 << 20, 4 << 20]
+    band = {str(s): round(0.1 * (i + 1), 4)
+            for i, s in enumerate(sizes)}
+    dband = {"results": {"dev_allreduce_effbw": band},
+             "wire_bytes": {str(s): {"exact": s * 14,
+                                     "quant": int(s * 14 * wire_ratio)}
+                            for s in sizes}}
+    if q8:
+        dband["results"]["dev_allreduce_q8_effbw"] = dict(band)
+    if pair_session is not None:
+        dband["pair_session"] = pair_session
+    with open(path, "w") as f:
+        json.dump({"device_band": dband}, f)
+    return path
+
+
+def test_quant_wire_guard(tmp_path):
+    """ISSUE 15: the quant wire guard — >= 1 MiB rows where the
+    quantized wire exceeds 0.3x the exact wire fail the gate; rows
+    below 1 MiB and artifacts without wire accounting pass."""
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    _device_repo_artifact(repo / "BENCH_r01.json", 1)
+    r = _run("--repo", str(repo), "--skip-host")
+    assert r.returncode == 0, r.stdout + r.stderr
+    # quantized wire past the bound at >= 1 MiB: guard fails
+    _device_repo_artifact(repo / "BENCH_r02.json", 2, wire_ratio=0.5)
+    r = _run("--repo", str(repo), "--skip-host")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "quant wire" in r.stdout and "0.30x bound" in r.stdout
+
+
+def test_device_pairing_requires_same_session(tmp_path):
+    """ISSUE 15 (the r06b lesson, machine-checked): the newest two
+    device artifacts regression-compare only when both carry one
+    pair_session tag; a session-mismatched pair degrades to the
+    cliff + wire guards on the newest alone — never a coin-flip
+    regression verdict across bench sessions."""
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    _device_repo_artifact(repo / "BENCH_r01.json", 1)   # untagged
+    _device_repo_artifact(repo / "BENCH_r02.json", 2,
+                          pair_session="s2")
+    r = _run("--repo", str(repo), "--skip-host")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "no same-session artifact pair" in r.stdout
+    # a genuine same-session pair regression-compares (and fails on a
+    # seeded 50% drop in the new artifact's band)
+    art = json.load(open(repo / "BENCH_r02.json"))
+    art["device_band"]["pair_session"] = "s3"
+    for k in art["device_band"]["results"]["dev_allreduce_effbw"]:
+        art["device_band"]["results"]["dev_allreduce_effbw"][k] *= 0.5
+    with open(repo / "BENCH_r03.json", "w") as f:
+        json.dump(art, f)
+    art2 = json.load(open(repo / "BENCH_r02.json"))
+    art2["device_band"]["pair_session"] = "s3"
+    with open(repo / "BENCH_r02b.json", "w") as f:
+        json.dump(art2, f)
+    r = _run("--repo", str(repo), "--skip-host")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "REGRESSION" in r.stdout
+
+
 def test_committed_artifacts_discovered_and_green():
     """The no-args CI invocation discovers the committed BENCH pair(s)
     and passes on the repo as committed — the gate must not be a
