@@ -1,0 +1,65 @@
+/* osu_put_bw.c — one-sided put bandwidth, OSU measurement protocol
+ * (window of MPI_Put into a passive-target lock_all epoch, one flush
+ * per window). Fallback source for bin/bench_osu when the reference
+ * osu_benchmarks tree is absent; the loop matches
+ * osu_benchmarks/mpi/one-sided/osu_put_bw.c with the FLUSH sync
+ * option. */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define WINDOW 64
+
+static int iters_for(long size) { return size > 65536 ? 20 : 100; }
+static int skip_for(long size) { return size > 65536 ? 2 : 10; }
+
+int main(int argc, char **argv) {
+    long max_size = 1 << 22;
+    if (argc > 2 && strcmp(argv[1], "-m") == 0)
+        max_size = atol(argv[2]);
+    MPI_Init(&argc, &argv);
+    int rank, np;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &np);
+    if (np != 2) {
+        if (rank == 0)
+            fprintf(stderr, "osu_put_bw requires exactly 2 ranks\n");
+        MPI_Finalize();
+        return 1;
+    }
+    char *sbuf = calloc(1, max_size ? max_size : 1);
+    char *wbuf;
+    MPI_Win win;
+    MPI_Win_allocate(max_size ? max_size : 1, 1, MPI_INFO_NULL,
+                     MPI_COMM_WORLD, &wbuf, &win);
+    if (rank == 0)
+        printf("# OSU MPI_Put Bandwidth Test\n"
+               "# Size      Bandwidth (MB/s)\n");
+    MPI_Win_lock_all(0, win);
+    for (long size = 1; size <= max_size; size *= 2) {
+        int iters = iters_for(size), skip = skip_for(size);
+        MPI_Barrier(MPI_COMM_WORLD);
+        double t0 = 0.0;
+        if (rank == 0) {
+            for (int i = 0; i < iters + skip; i++) {
+                if (i == skip)
+                    t0 = MPI_Wtime();
+                for (int w = 0; w < WINDOW; w++)
+                    MPI_Put(sbuf, size, MPI_CHAR, 1, 0, size, MPI_CHAR,
+                            win);
+                MPI_Win_flush(1, win);
+            }
+            double dt = MPI_Wtime() - t0;
+            double mb = (double)size * iters * WINDOW / 1e6;
+            printf("%-10ld%18.2f\n", size, mb / dt);
+            fflush(stdout);
+        }
+        MPI_Barrier(MPI_COMM_WORLD);
+    }
+    MPI_Win_unlock_all(win);
+    MPI_Win_free(&win);
+    free(sbuf);
+    MPI_Finalize();
+    return 0;
+}

@@ -28,6 +28,18 @@ over the shrunken wire chunks, with agreement tightened to "every
 delivered chunk decodes with its sender's scale word" and the
 ``scale_after_payload`` split-landing break seeded against it.
 
+The one-sided lane (ops/pallas_rma.py + rma/device.py) adds
+``rma.build_passive``: the passive-target epoch — MPI_Win_lock, C
+accumulate chunks through the D-credit slot schedule, flush's
+completion wave, unlock — against a concurrent local reader at the
+target and the two-phase target fold (operand capture + commit store).
+It proves lock exclusivity, no torn window read under concurrent
+Put + local load, flush-completes-all-outstanding, and per-element
+accumulate atomicity; its five seeded breaks (flush one chunk short,
+unlock before the completion wave, fold operand prefetch racing the
+previous commit, lock-bypassing local load, exclusivity-ignoring
+acquire) are each caught by a named invariant.
+
 The CONTROL plane (the one protocol surface PRs 7/11/12 left
 uncovered) gets the same treatment before ROADMAP item 4 grows it:
 
@@ -51,7 +63,7 @@ deadline, ...); tests/test_modelcheck.py asserts the checker catches
 each one and that the unmutated models are violation-free.
 """
 
-from . import daemon, doorbell, flat2, ft, ici, lease, seqlock, wiring  # noqa: F401,E501
+from . import daemon, doorbell, flat2, ft, ici, lease, rma, seqlock, wiring  # noqa: F401,E501
 from .explorer import Model, Result, Transition, Violation, explore  # noqa: F401
 
 
@@ -130,6 +142,22 @@ def mutation_matrix():
         ("ici-ring", lambda: ici.build_ring(
             n=2, chunks=2, depth=2, mutation="scale_after_payload"),
          "scale_after_payload"),
+        # passive-target one-sided epoch (ops/pallas_rma.py)
+        ("rma-passive", lambda: rma.build_passive(
+            chunks=3, depth=2, cells=1, mutation="flush_skips_chunk"),
+         "flush_skips_chunk"),
+        ("rma-passive", lambda: rma.build_passive(
+            chunks=3, depth=2, cells=1, mutation="unlock_before_drain"),
+         "unlock_before_drain"),
+        ("rma-passive", lambda: rma.build_passive(
+            chunks=3, depth=2, cells=1, mutation="no_target_fold_order"),
+         "no_target_fold_order"),
+        ("rma-passive", lambda: rma.build_passive(
+            chunks=3, depth=2, cells=1, mutation="torn_window_read"),
+         "torn_window_read"),
+        ("rma-passive", lambda: rma.build_passive(
+            chunks=3, depth=2, cells=1, mutation="no_lock_wait"),
+         "no_lock_wait"),
         # 2-stage lazy wire (ShmChannel.ensure_wired / try_wire)
         ("wiring", lambda: wiring.build_wire(
             2, caps=(1, 0), mutation="skip_unanimity"),

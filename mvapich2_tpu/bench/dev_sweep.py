@@ -6,8 +6,12 @@ across per-shard message sizes and emits an osu_compare-compatible
 artifact::
 
     {"results": {"dev_allreduce_effbw":    {"<bytes>": GB/s, ...},
-                 "dev_allreduce_q8_effbw": {"<bytes>": GB/s, ...}},
+                 "dev_allreduce_q8_effbw": {"<bytes>": GB/s, ...},
+                 "dev_put_bw":             {"<bytes>": GB/s, ...},
+                 "dev_get_bw":             {"<bytes>": GB/s, ...},
+                 "dev_acc_bw":             {"<bytes>": GB/s, ...}},
      "tiers":      {"<bytes>": "vmem|hbm|quant|xla", ...},
+     "rma_tiers":  {"<bytes>": "rdma|quant|epoch", ...},
      "wire_bytes": {"<bytes>": {"exact": N, "quant": N}, ...}}
 
 ``effbw`` is the OSU ring busbw model 2*(p-1)/p * m / t. The
@@ -15,7 +19,11 @@ artifact::
 int8 wire forced) at the same sizes, and ``wire_bytes`` is the
 per-rank bytes-on-ICI accounting for the exact vs quantized wire —
 the hardware-independent half of the quant-tier claim, guarded by
-bin/perf_gate (quant <= 0.3x exact at >= 1 MiB). Two artifacts diff
+bin/perf_gate (quant <= 0.3x exact at >= 1 MiB). The ``dev_*_bw``
+bands are the one-sided lane (ops/pallas_rma) at OSU one-sided
+shapes: Put/Get/Accumulate of the full per-shard message between the
+rank-0/rank-(p-1) pair, plain bw = m / t (osu_put_bw's model), with
+``rma_tiers`` recording the planned_rma_tier pick. Two artifacts diff
 through ``bin/osu_compare`` exactly like the host OSU ones — a >10%
 effbw regression or a >3x adjacent-size drop (a new tier cliff) in any
 device band fails the gate. On a CPU host the kernels run under the
@@ -53,7 +61,7 @@ def sweep(sizes: List[int], iters: int = 5,
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..autotune import load_default_profile
-    from ..ops import pallas_ici, pallas_quant
+    from ..ops import pallas_ici, pallas_quant, pallas_rma
     from ..parallel.mesh import make_mesh, shard_map
 
     load_default_profile()   # the measured tier boundaries, when committed
@@ -101,6 +109,29 @@ def sweep(sizes: List[int], iters: int = 5,
             s, "x", p, wire="q8", interpret=interpret), x)
         results_q[str(nbytes)] = round(2.0 * (p - 1) / p * m / tq / 1e9,
                                        6)
+    # the one-sided band: Put/Get/Accumulate of the full per-shard
+    # message between the 0/(p-1) pair — osu_put_bw's plain bw = m / t
+    results_1s: Dict[str, Dict[str, float]] = {
+        "dev_put_bw": {}, "dev_get_bw": {}, "dev_acc_bw": {}}
+    rma_tiers: Dict[str, str] = {}
+    for nbytes in sizes:
+        n = max(4, nbytes // 4)
+        m = n * 4
+        rma_tiers[str(nbytes)], _ = pallas_rma.planned_rma_tier(
+            "put", m, jnp.float32, True, interpret, num_devices=p)
+        win = jax.device_put(jnp.zeros((n * p,), jnp.float32), sharding)
+        src = jnp.ones((n,), jnp.float32)
+        ops = {
+            "dev_put_bw": lambda w: pallas_rma.rma_put(
+                src, w, "x", p, 0, p - 1, interpret=interpret),
+            "dev_get_bw": lambda w: pallas_rma.rma_get(
+                w, n, "x", p, 0, p - 1, interpret=interpret),
+            "dev_acc_bw": lambda w: pallas_rma.rma_accumulate(
+                src, w, "x", p, 0, p - 1, interpret=interpret),
+        }
+        for name, body in ops.items():
+            t = timed(body, win)
+            results_1s[name][str(nbytes)] = round(m / t / 1e9, 6)
     # bytes-on-wire accounting is analytic (ops/pallas_quant.wire_stats)
     # so it always covers the >= 1 MiB rows the perf_gate wire guard
     # reads, even when an interpreter host times a smaller band
@@ -109,8 +140,10 @@ def sweep(sizes: List[int], iters: int = 5,
         exact_b, quant_b = pallas_quant.wire_stats(n, jnp.float32, p)
         wire_bytes[str(nbytes)] = {"exact": exact_b, "quant": quant_b}
     return {"results": {"dev_allreduce_effbw": results,
-                        "dev_allreduce_q8_effbw": results_q},
+                        "dev_allreduce_q8_effbw": results_q,
+                        **results_1s},
             "tiers": tiers,
+            "rma_tiers": rma_tiers,
             "wire_bytes": wire_bytes,
             "detail": {"devices": p,
                        "platform": devs[0].platform,

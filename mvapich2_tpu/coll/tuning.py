@@ -63,6 +63,19 @@ cvar("DEV_TIER_QUANT_MIN", 1024 * 1024, int, "device",
      "take the block-scaled quantized wire tier (ops/pallas_quant) "
      "above the exact hbm tier (-1 = never). Measured profiles "
      "(device_crossovers.dev_tier_quant_min) override.")
+cvar("DEV_RMA_RDMA_MIN", 0, int, "device",
+     "One-sided tier edge: contiguous DeviceWin put/get/accumulate "
+     "payloads at or above this many bytes run the chunked remote-DMA "
+     "kernels (ops/pallas_rma) instead of the ppermute epoch compiler "
+     "(-1 = never — everything keeps the epoch tier). Measured "
+     "profiles (device_crossovers.dev_rma_rdma_min) override; every "
+     "epoch take is counted by the dev_rma_fallback_* pvars.")
+cvar("DEV_RMA_QUANT_MIN", 1024 * 1024, int, "device",
+     "One-sided tier edge: with an MV2T_QUANT_COLL accuracy budget "
+     "set, f32 sum accumulates at or above this many bytes carry the "
+     "block-scaled quantized wire over the remote-DMA tier (-1 = "
+     "never). Measured profiles (device_crossovers.dev_rma_quant_min) "
+     "override; ineligible calls keep the exact rdma tier, bit-exact.")
 
 # ---------------------------------------------------------------------------
 # algorithm registries (name -> fn), per collective
@@ -314,6 +327,10 @@ def _resolve_edge(bound):
         return _dev_tier_edge("DEV_TIER_XLA_MIN", "dev_tier_xla_min")
     if bound == "dev_tier_quant_min":
         return _dev_tier_edge("DEV_TIER_QUANT_MIN", "dev_tier_quant_min")
+    if bound == "dev_rma_rdma_min":
+        return _dev_tier_edge("DEV_RMA_RDMA_MIN", "dev_rma_rdma_min")
+    if bound == "dev_rma_quant_min":
+        return _dev_tier_edge("DEV_RMA_QUANT_MIN", "dev_rma_quant_min")
     return bound
 
 

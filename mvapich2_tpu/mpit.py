@@ -391,6 +391,47 @@ for _tier in ("vmem", "hbm", "quant", "xla", "slot"):
          f"payload bytes / wall seconds) observed on the '{_tier}' "
          "device tier at the collective dispatch wrapper")
 
+# device one-sided RMA engine knobs + tier observability (ISSUE 16:
+# ops/pallas_rma, rma/device). Same early-declaration contract; the
+# dev_rma_rdma_min / dev_rma_quant_min tier-edge cvars live with the
+# other DEV_* edges in coll/tuning.py.
+cvar("RMA_CHUNK_BYTES", 0, int, "device",
+     "VMEM chunk size (bytes) of the one-sided remote-DMA kernels "
+     "(ops/pallas_rma): each put/get/accumulate chunk is one remote "
+     "DMA through a depth-slotted landing buffer. 0 (default) inherits "
+     "the ICI chunk edge (kernel_params.ici_chunk_bytes / "
+     "MV2T_ICI_CHUNK_BYTES) so both device lanes tune together.")
+pvar("dev_rma_tier_rdma", PVAR_CLASS_COUNTER, "device",
+     "one-sided window ops served by the chunked remote-DMA tier "
+     "(ops/pallas_rma put/get/accumulate kernels)")
+pvar("dev_rma_tier_quant", PVAR_CLASS_COUNTER, "device",
+     "one-sided accumulates served by the block-scaled quantized "
+     "remote-DMA wire (ops/pallas_rma + the pallas_quant codec, gated "
+     "by MV2T_QUANT_COLL and the dev_rma_quant_min edge)")
+pvar("dev_rma_tier_epoch", PVAR_CLASS_COUNTER, "device",
+     "one-sided window ops served by the ppermute epoch compiler "
+     "(rma/device.py _build_epoch — the scheduled fallback tier)")
+pvar("dev_rma_fallback_noncontig", PVAR_CLASS_COUNTER, "device",
+     "one-sided ops routed to the epoch compiler because the element "
+     "pattern is strided/derived (the epoch compiler's home turf; the "
+     "remote-DMA tier carries contiguous runs only)")
+pvar("dev_rma_fallback_platform", PVAR_CLASS_COUNTER, "device",
+     "one-sided ops routed to the epoch compiler because the pallas "
+     "kernels cannot run here (no pallas, or off-TPU without "
+     "MV2T_ICI_INTERPRET)")
+pvar("dev_rma_fallback_size", PVAR_CLASS_COUNTER, "device",
+     "one-sided ops routed to the epoch compiler because the payload "
+     "is below the dev_rma_rdma_min edge (or degenerate)")
+pvar("dev_rma_fallback_dtype", PVAR_CLASS_COUNTER, "device",
+     "one-sided ops routed to the epoch compiler because the window "
+     "dtype does not lower to the remote-DMA kernels")
+pvar("dev_rma_flush", PVAR_CLASS_COUNTER, "device",
+     "passive-target completion waves (flush/flush_local/unlock) "
+     "closed on a DeviceWin (rma/device.py)")
+pvar("dev_rma_wire_bytes", PVAR_CLASS_COUNTER, "device",
+     "payload bytes the remote-DMA one-sided tier put on the wire "
+     "(quantized accumulates count their shrunken wire run)")
+
 
 # ---------------------------------------------------------------------------
 # multi-tenant node-service knobs + observability (runtime/daemon.py,

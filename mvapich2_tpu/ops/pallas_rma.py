@@ -1,0 +1,564 @@
+"""Device one-sided RMA engine — Put/Get/Accumulate over HBM remote DMA.
+
+The kernel half of the KV-cache-shard lane (rma/device.py owns the
+window/epoch surface). The reference serves one-sided traffic by
+posting verbs work requests straight to the HCA (gen2/rdma_iba_1sc.c);
+here a window is a mesh-sharded HBM buffer and the three MPI one-sided
+ops become three chunked remote-DMA kernels:
+
+* **Put** — ``remote_sendrecv`` (ops/pallas_ici.py) generalized to an
+  arbitrary target offset: each chunk of the origin's source buffer is
+  one ``make_async_remote_copy`` into a VMEM landing slot on the
+  target, which alone commits it into its window shard at
+  ``disp + off`` (the vbuf staging model — a direct copy into the
+  window cannot work because every device must run the same remote DMA
+  and the non-target self-copies would clobber their windows).
+* **Get** — the reversed copy: every device stages its OWN window
+  chunk, the symmetric permutation swaps origin<->target, and the
+  origin alone commits the landed chunk into its result buffer.
+* **Accumulate** — streams chunks through the PR 8 slot/credit
+  schedule (``_RmaStreamer`` below, the partner-pair form of
+  ``_RingStreamer``) with a VPU fold at the target: non-origin devices
+  stage the op identity (zeros for sum), so the fold is uniform across
+  the mesh — every device folds what lands, and only the target's fold
+  changes its window. The optional quantized wire reuses the
+  ``pallas_quant`` block codec (encode fused before the remote DMA,
+  decode fused into the fold) under the same ``declared_bound`` error
+  contract.
+
+Flow control is the chunk-credit handshake of pallas_ici.py with the
+ring neighbors replaced by the put partner: each device grants its
+partner ``depth`` slot credits up front and re-grants as it consumes a
+landing slot, so an origin runs at most ``depth`` chunks ahead of the
+target's folds. Passive-target sync in rma/device.py (lock/unlock,
+flush, flush_local) rides exactly these DMA semaphores — a flush is
+complete when every pending handle in the streamer has been waited and
+the credit balance is back to ``depth``. Under the jax<0.5 interpreter
+remote semaphore signals are unavailable and unnecessary (synchronous
+dataflow), so interpret-mode runs are creditless, following the
+``# device: hw-only`` convention.
+
+Tier selection lives in ``planned_rma_tier``: contiguous ops at or
+above the ``dev_rma_rdma_min`` edge run these kernels ('rdma', or
+'quant' for an eligible Accumulate above ``dev_rma_quant_min``);
+everything else keeps the ppermute epoch compiler ('epoch') with the
+fallback reason named for the dev_rma_fallback_* pvar family.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..utils.mlog import get_logger
+from ._compat import HAVE_PALLAS, compiler_params
+
+log = get_logger("pallas_rma")
+
+if HAVE_PALLAS:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+# cvar RMA_CHUNK_BYTES and the dev_rma_* pvar family are predeclared in
+# mpit.py (the MPI_T surface enumerates them before this module is
+# imported), same early-declaration contract as the ICI_* knobs.
+from .. import mpit  # noqa: F401,E402  — cvar/pvar declarations
+from .pallas_ici import _chunks, _resolve_flags  # noqa: E402
+
+# distinct Mosaic collective ids (pallas_ring owns 7/8, pallas_ici
+# 9-11, pallas_quant 12)
+_CID_PUT = 13
+_CID_GET = 14
+_CID_ACC = 15
+_CID_ACC_QUANT = 16
+
+
+def _cfg_chunk_elems(dtype, chunk_bytes: Optional[int]) -> int:
+    """RMA chunk size: MV2T_RMA_CHUNK_BYTES, inheriting the ICI chunk
+    edge (profile-overridable) when unset (0)."""
+    if chunk_bytes is None:
+        from ..utils.config import get_config
+        chunk_bytes = int(get_config()["RMA_CHUNK_BYTES"])
+        if chunk_bytes <= 0:
+            from ..coll.tuning import kernel_param_cv
+            chunk_bytes = kernel_param_cv("ici_chunk_bytes",
+                                          "ICI_CHUNK_BYTES")
+    return max(1, int(chunk_bytes) // np.dtype(dtype).itemsize)
+
+
+def _cfg_depth(depth: Optional[int]) -> int:
+    if depth is None:
+        from ..utils.config import get_config
+        depth = int(get_config()["ICI_PIPELINE_DEPTH"])
+    return max(2, int(depth))
+
+
+# ---------------------------------------------------------------------------
+# the streaming state (partner-pair form of pallas_ici._RingStreamer)
+# ---------------------------------------------------------------------------
+
+class _RmaStreamer:
+    """Per-kernel-instance one-sided streaming state: scratch refs, DMA
+    handles, and the global chunk counter whose mod-depth sequence makes
+    landing-slot reuse collision-free. The ring neighbors of
+    ``_RingStreamer`` collapse to the single put partner — the device
+    the symmetric origin<->target permutation pairs us with — and the
+    per-direction credit semaphore to one."""
+
+    def __init__(self, partner, depth, credits, stage_buf, landing_buf,
+                 fold_buf, in_sem, fold_sem, st_sem, send_sem, recv_sem,
+                 cap_sem):
+        self.partner, self.depth, self.credits = partner, depth, credits
+        self.stage_buf, self.landing_buf, self.fold_buf = \
+            stage_buf, landing_buf, fold_buf
+        self.in_sem, self.fold_sem, self.st_sem = in_sem, fold_sem, st_sem
+        self.send_sem, self.recv_sem, self.cap_sem = \
+            send_sem, recv_sem, cap_sem
+        self.gc = 0                            # global chunk counter
+        self.pending_send: Dict = {}           # slot -> remote handle
+        self.pending_fold: Dict = {}           # slot -> window-chunk load
+        self.pending_store: Dict = {}          # slot -> commit store
+
+    def grant_initial_credits(self):          # device: hw-only
+        """Grant the partner (the device whose remote DMAs land in our
+        slots) one credit per landing slot."""
+        if not self.credits:
+            return
+        pltpu.semaphore_signal(
+            self.cap_sem, inc=self.depth, device_id=self.partner,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    def _take_credit(self):                   # device: hw-only
+        """Consume one landing-slot credit before the remote DMA — the
+        sender half of the chunk-credit handshake."""
+        if not self.credits:
+            return
+        pltpu.semaphore_wait(self.cap_sem, 1)
+
+    def _grant(self):                         # device: hw-only
+        """Landing slot consumed: re-grant the credit to the partner."""
+        if not self.credits:
+            return
+        pltpu.semaphore_signal(
+            self.cap_sem, inc=1, device_id=self.partner,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    def issue(self, stage_fill, fold_load):
+        """Front half of the chunk pipeline: fill the stage slot
+        (``stage_fill(slot)`` — source chunk, window chunk, or encoded
+        wire words), optionally prefetch the target-side fold operand
+        (``fold_load(slot)`` starts the window-chunk load and parks the
+        handle in ``pending_fold``; None for put/get), then launch the
+        remote DMA — it flies while the previous chunk drains."""
+        slot = self.gc % self.depth
+        prev = self.pending_send.pop(slot, None)
+        if prev is not None:
+            prev.wait_send()           # stage slot free for refill
+        prev_st = self.pending_store.pop(slot, None)
+        if prev_st is not None:
+            prev_st.wait()             # fold slot's last commit landed
+        stage_fill(slot)
+        if fold_load is not None:
+            fold_load(slot)
+        self._take_credit()
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=self.stage_buf.at[slot],
+            dst_ref=self.landing_buf.at[slot],
+            send_sem=self.send_sem.at[slot],
+            recv_sem=self.recv_sem.at[slot],
+            device_id=self.partner,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        rdma.start()
+        self.pending_send[slot] = rdma
+        self.gc += 1
+        return slot
+
+    def drain(self, slot, consume, commit):
+        """Back half: the partner's chunk has landed — ``consume(slot)``
+        performs every read of the landing slot (the VPU fold, or the
+        direct window commit), then the credit is re-granted (the slot
+        is free for the partner's next write) and ``commit(slot)``
+        starts any post-slot store (fold slot -> window HBM, parked in
+        ``pending_store``; None for put/get)."""
+        self.pending_send[slot].wait_recv()
+        pf = self.pending_fold.pop(slot, None)
+        if pf is not None:
+            pf.wait()
+        consume(slot)
+        # every landing-slot read above is synchronous: slot is free
+        self._grant()
+        if commit is not None:
+            commit(slot)
+
+    def finish(self):
+        """Completion wave (= flush): outbound DMAs off the stage
+        slots, commit stores landed, and — with credits — the partner
+        has consumed everything we wrote (the balance is back to
+        ``depth``), so no in-flight write can land after kernel exit.
+        Passive-target flush/unlock and active-target fence both close
+        on exactly this wave."""
+        for key, h in list(self.pending_send.items()):
+            h.wait_send()
+            del self.pending_send[key]
+        for skey, sh in list(self.pending_store.items()):
+            sh.wait()
+            del self.pending_store[skey]
+        if self.credits:                      # device: hw-only
+            pltpu.semaphore_wait(self.cap_sem, self.depth)
+
+
+def _rma_scratch_shapes(depth: int, chunk: int, dtype, wire_chunk=None):
+    """Stage/landing/fold VMEM slots + the semaphore set. With a
+    quantized wire the stage/landing slots carry int32 wire words
+    (``wire_chunk`` per slot) while the fold slot stays the window
+    dtype."""
+    wdt = jnp.int32 if wire_chunk is not None else dtype
+    wck = wire_chunk if wire_chunk is not None else chunk
+    return [
+        pltpu.VMEM((depth, wck), wdt),        # stage slots
+        pltpu.VMEM((depth, wck), wdt),        # landing slots
+        pltpu.VMEM((depth, chunk), dtype),    # fold slots
+        pltpu.SemaphoreType.DMA((depth,)),    # stage loads
+        pltpu.SemaphoreType.DMA((depth,)),    # fold-operand loads
+        pltpu.SemaphoreType.DMA((depth,)),    # commit stores
+        pltpu.SemaphoreType.DMA((depth,)),    # remote send
+        pltpu.SemaphoreType.DMA((depth,)),    # remote recv
+        pltpu.SemaphoreType.REGULAR(()),      # landing-slot credits
+    ]
+
+
+def _mk_streamer(partner, depth, credits, scratch):
+    (stage_buf, landing_buf, fold_buf, in_sem, fold_sem, st_sem,
+     send_sem, recv_sem, cap_sem) = scratch
+    return _RmaStreamer(partner, depth, credits, stage_buf, landing_buf,
+                        fold_buf, in_sem, fold_sem, st_sem, send_sem,
+                        recv_sem, cap_sem)
+
+
+def _partner(me, origin, target):
+    """The symmetric routing permutation: identity except
+    origin<->target — every device runs the same (collective) remote
+    DMA, only the pair actually exchanges foreign data."""
+    return jnp.where(me == origin, target,
+                     jnp.where(me == target, origin, me))
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+def _put_kernel(axis, origin, target, disp, chunks, depth, credits,
+                src_hbm, win_hbm, out_hbm, *scratch):
+    """Chunked one-sided put: per chunk one remote DMA of the origin's
+    stage slot into the target's landing slot; the target alone commits
+    landings into its window shard at ``disp + off``."""
+    me = lax.axis_index(axis)
+    out_hbm[...] = win_hbm[...]
+    st = _mk_streamer(_partner(me, origin, target), depth, credits,
+                      scratch)
+    st.grant_initial_credits()
+    live: List[Optional[int]] = [None] * len(chunks)
+    for c in range(len(chunks) + 1):
+        if c < len(chunks):
+            off, sz = chunks[c]
+
+            def fill(slot, off=off, sz=sz):
+                @pl.when(me == origin)
+                def _():
+                    st.stage_buf[slot, :sz] = src_hbm[pl.ds(off, sz)]
+
+                @pl.when(me != origin)
+                def _():
+                    st.stage_buf[slot, :sz] = jnp.zeros(
+                        (sz,), st.stage_buf.dtype)
+
+            live[c] = st.issue(fill, None)
+        if c >= 1:
+            off, sz = chunks[c - 1]
+
+            def consume(slot, off=off, sz=sz):
+                # direct landing->window commit (repo pallas_put idiom)
+                @pl.when(me == target)
+                def _():
+                    out_hbm[pl.ds(disp + off, sz)] = \
+                        st.landing_buf[slot, :sz]
+
+            st.drain(live[c - 1], consume, None)
+    st.finish()
+
+
+def _get_kernel(axis, origin, target, disp, chunks, depth, credits,
+                win_hbm, out_hbm, *scratch):
+    """Chunked one-sided get — the reversed put: every device stages
+    its OWN window chunk at ``disp + off`` (so the non-pair self-copies
+    and the origin->target lane carry harmless data), and the origin
+    alone commits what lands from the target."""
+    me = lax.axis_index(axis)
+    n = out_hbm.shape[0]
+    out_hbm[...] = jnp.zeros((n,), out_hbm.dtype)
+    st = _mk_streamer(_partner(me, origin, target), depth, credits,
+                      scratch)
+    st.grant_initial_credits()
+    live: List[Optional[int]] = [None] * len(chunks)
+    for c in range(len(chunks) + 1):
+        if c < len(chunks):
+            off, sz = chunks[c]
+
+            def fill(slot, off=off, sz=sz):
+                st.stage_buf[slot, :sz] = win_hbm[pl.ds(disp + off, sz)]
+
+            live[c] = st.issue(fill, None)
+        if c >= 1:
+            off, sz = chunks[c - 1]
+
+            def consume(slot, off=off, sz=sz):
+                @pl.when(me == origin)
+                def _():
+                    out_hbm[pl.ds(off, sz)] = st.landing_buf[slot, :sz]
+
+            st.drain(live[c - 1], consume, lambda slot: None)
+    st.finish()
+
+
+def _acc_kernel(axis, origin, target, disp, chunks, depth, credits,
+                quant_block, wire, src_hbm, win_hbm, out_hbm, *scratch):
+    """Chunked one-sided accumulate (MPI_SUM): the origin streams
+    source chunks through the slot/credit schedule; every device folds
+    what lands into its own window chunk (the fold is uniform — only
+    the target receives nonzero data, everyone else folds the identity
+    it was sent), so no device diverges on the collective DMA sequence.
+    With ``quant_block`` set the stage slot carries the pallas_quant
+    block-scaled int32 wire (encode fused here, decode fused into the
+    fold) under the same declared_bound contract."""
+    me = lax.axis_index(axis)
+    out_hbm[...] = win_hbm[...]
+    st = _mk_streamer(_partner(me, origin, target), depth, credits,
+                      scratch)
+    st.grant_initial_credits()
+    if quant_block is not None:
+        from .pallas_quant import _decode_f32, _encode_f32
+
+        def _ww(sz):
+            # int32 wire words for a block-multiple chunk of sz elems
+            return (sz // quant_block) * (1 + quant_block // 4)
+    live: List[Optional[int]] = [None] * len(chunks)
+    for c in range(len(chunks) + 1):
+        if c < len(chunks):
+            off, sz = chunks[c]
+
+            def fill(slot, off=off, sz=sz):
+                val = jnp.where(me == origin, src_hbm[pl.ds(off, sz)],
+                                jnp.zeros((sz,), src_hbm.dtype))
+                if quant_block is not None:
+                    st.stage_buf[slot, :_ww(sz)] = _encode_f32(
+                        val, quant_block, wire)
+                else:
+                    st.stage_buf[slot, :sz] = val
+
+            def fload(slot, off=off, sz=sz):
+                ld = pltpu.make_async_copy(
+                    out_hbm.at[pl.ds(disp + off, sz)],
+                    st.fold_buf.at[slot, pl.ds(0, sz)],
+                    st.fold_sem.at[slot])
+                ld.start()
+                st.pending_fold[slot] = ld
+
+            live[c] = st.issue(fill, fload)
+        if c >= 1:
+            off, sz = chunks[c - 1]
+
+            def consume(slot, sz=sz):
+                if quant_block is not None:
+                    add = _decode_f32(st.landing_buf[slot, :_ww(sz)],
+                                      quant_block, wire)
+                else:
+                    add = st.landing_buf[slot, :sz]
+                st.fold_buf[slot, :sz] = st.fold_buf[slot, :sz] + add
+
+            def commit(slot, off=off, sz=sz):
+                w = pltpu.make_async_copy(
+                    st.fold_buf.at[slot, pl.ds(0, sz)],
+                    out_hbm.at[pl.ds(disp + off, sz)],
+                    st.st_sem.at[slot])
+                w.start()
+                st.pending_store[slot] = w
+            st.drain(live[c - 1], consume, commit)
+    st.finish()
+
+
+# ---------------------------------------------------------------------------
+# wrappers (call inside shard_map over the window's mesh axis)
+# ---------------------------------------------------------------------------
+
+def rma_put(src, win_shard, axis: str, num_devices: int, origin: int,
+            target: int, disp: int = 0, *,
+            chunk_bytes: Optional[int] = None,
+            depth: Optional[int] = None,
+            credits: Optional[bool] = None, interpret=None):
+    """One-sided contiguous put over remote DMA: origin pushes ``src``
+    into the target's window shard at element offset ``disp``. Returns
+    the updated shard (in-place on the target via aliasing)."""
+    if not HAVE_PALLAS:
+        raise RuntimeError("pallas unavailable")
+    interpret, credits = _resolve_flags(interpret, credits)
+    n = src.shape[0]
+    chunk = min(_cfg_chunk_elems(src.dtype, chunk_bytes), n)
+    d = _cfg_depth(depth)
+    chunks = _chunks(0, n, chunk)
+    kern = functools.partial(_put_kernel, axis, origin, target, disp,
+                             chunks, d, credits)
+    return pl.pallas_call(
+        kern,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        out_shape=jax.ShapeDtypeStruct(win_shard.shape, win_shard.dtype),
+        scratch_shapes=_rma_scratch_shapes(d, chunk, src.dtype),
+        input_output_aliases={1: 0},
+        compiler_params=compiler_params(collective_id=_CID_PUT,
+                                        has_side_effects=True),
+        interpret=interpret,
+    )(src, win_shard)
+
+
+def rma_get(win_shard, n: int, axis: str, num_devices: int, origin: int,
+            target: int, disp: int = 0, *,
+            chunk_bytes: Optional[int] = None,
+            depth: Optional[int] = None,
+            credits: Optional[bool] = None, interpret=None):
+    """One-sided contiguous get — the reversed remote copy: origin
+    pulls ``n`` elements of the target's window shard at ``disp``.
+    Returns the (n,) result — the data on the origin's shard, zeros
+    elsewhere."""
+    if not HAVE_PALLAS:
+        raise RuntimeError("pallas unavailable")
+    interpret, credits = _resolve_flags(interpret, credits)
+    chunk = min(_cfg_chunk_elems(win_shard.dtype, chunk_bytes), n)
+    d = _cfg_depth(depth)
+    chunks = _chunks(0, n, chunk)
+    kern = functools.partial(_get_kernel, axis, origin, target, disp,
+                             chunks, d, credits)
+    return pl.pallas_call(
+        kern,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        out_shape=jax.ShapeDtypeStruct((n,), win_shard.dtype),
+        scratch_shapes=_rma_scratch_shapes(d, chunk, win_shard.dtype),
+        compiler_params=compiler_params(collective_id=_CID_GET,
+                                        has_side_effects=True),
+        interpret=interpret,
+    )(win_shard)
+
+
+def rma_accumulate(src, win_shard, axis: str, num_devices: int,
+                   origin: int, target: int, disp: int = 0, *,
+                   quantized: bool = False,
+                   chunk_bytes: Optional[int] = None,
+                   depth: Optional[int] = None,
+                   credits: Optional[bool] = None, interpret=None):
+    """One-sided accumulate (MPI_SUM) streamed through the slot/credit
+    schedule with the fold at the target. ``quantized=True`` carries
+    each chunk as the pallas_quant block-scaled int32 wire (f32 only;
+    the caller owns the declared_bound budget check — acc_quant_ok)."""
+    if not HAVE_PALLAS:
+        raise RuntimeError("pallas unavailable")
+    interpret, credits = _resolve_flags(interpret, credits)
+    n = src.shape[0]
+    chunk = min(_cfg_chunk_elems(src.dtype, chunk_bytes), n)
+    d = _cfg_depth(depth)
+    quant_block = wire = wire_chunk = None
+    cid = _CID_ACC
+    if quantized:
+        from ..coll.tuning import quant_params
+        from .pallas_quant import quant_block_elems, wire_words
+        quant_block = min(quant_block_elems(src.dtype), n)
+        wire, _budget = quant_params()
+        # wire slots carry whole blocks: chunk snaps to a block multiple
+        chunk = max(quant_block, (chunk // quant_block) * quant_block)
+        if n % quant_block:
+            raise ValueError("quantized accumulate needs a block-"
+                             f"multiple count (n={n}, block="
+                             f"{quant_block})")
+        wire_chunk = wire_words(chunk, quant_block)
+        cid = _CID_ACC_QUANT
+    chunks = _chunks(0, n, chunk)
+    kern = functools.partial(_acc_kernel, axis, origin, target, disp,
+                             chunks, d, credits, quant_block, wire)
+    return pl.pallas_call(
+        kern,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        out_shape=jax.ShapeDtypeStruct(win_shard.shape, win_shard.dtype),
+        scratch_shapes=_rma_scratch_shapes(d, chunk, src.dtype,
+                                           wire_chunk),
+        input_output_aliases={1: 0},
+        compiler_params=compiler_params(collective_id=cid,
+                                        has_side_effects=True),
+        interpret=interpret,
+    )(src, win_shard)
+
+
+# ---------------------------------------------------------------------------
+# tier selection (the one-sided tuning-table moment)
+# ---------------------------------------------------------------------------
+
+def acc_quant_ok(dtype, count: int, num_devices: int) -> bool:
+    """Whether an accumulate sized for the quant bin may actually run
+    quantized: f32 sum into a block-multiple extent, with the user's
+    MV2T_QUANT_COLL budget covering the one-quantization-per-hop bound
+    (an RMA accumulate is a single hop: declared_bound(1, wire))."""
+    dt = np.dtype(dtype)
+    if dt.kind != "f" or dt.itemsize != 4:
+        return False
+    from ..coll.tuning import quant_params
+    from .pallas_quant import declared_bound, quant_block_elems
+    wire, budget = quant_params()
+    if budget <= 0 or budget < declared_bound(1, wire):
+        return False
+    return count % quant_block_elems(dtype) == 0
+
+
+def planned_rma_tier(kind: str, nbytes: int, dtype, contiguous: bool,
+                     interpret=None, num_devices: Optional[int] = None,
+                     count: int = 0) -> Tuple[str, Optional[str]]:
+    """(tier, fallback_reason) for one one-sided op. tier is 'rdma' |
+    'quant' | 'epoch'; reason is None unless the ppermute epoch
+    compiler was taken, in which case it names the dev_rma_fallback_*
+    pvar bucket: noncontig (strided/derived datatype — the epoch
+    compiler's home turf), platform (no pallas / not a TPU and not
+    interpreting), size (below the dev_rma_rdma_min edge), dtype (a
+    kind the kernels cannot carry). A 'quant' bin the accumulate
+    cannot actually quantize degrades to the exact 'rdma' tier."""
+    from .pallas_ici import _kernels_runnable
+    if not HAVE_PALLAS or not _kernels_runnable(interpret):
+        return "epoch", "platform"
+    if not contiguous:
+        return "epoch", "noncontig"
+    if np.dtype(dtype).kind not in "fiu":
+        return "epoch", "dtype"
+    if nbytes <= 0:
+        return "epoch", "size"
+    from ..coll.tuning import _dev_tier_edge
+    rmin = _dev_tier_edge("DEV_RMA_RDMA_MIN", "dev_rma_rdma_min")
+    if rmin < 0 or nbytes < rmin:
+        return "epoch", "size"
+    if kind == "acc":
+        qmin = _dev_tier_edge("DEV_RMA_QUANT_MIN", "dev_rma_quant_min")
+        if qmin >= 0 and nbytes >= qmin and \
+                acc_quant_ok(dtype, count, num_devices):
+            return "quant", None
+    return "rdma", None
+
+
+def note_rma_fallback(kind: str, reason: str, nbytes: int) -> None:
+    """Count one one-sided fallback to the epoch compiler (pvar family
+    dev_rma_fallback_*, predeclared in mpit.py)."""
+    mpit.pvar(f"dev_rma_fallback_{reason}").inc()
+    log.dbg(1, "device RMA %s fell back to the epoch compiler "
+            "(%s, %d bytes)", kind, reason, nbytes)

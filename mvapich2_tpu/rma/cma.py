@@ -128,8 +128,36 @@ class CmaDirect:
             return self._lockf
 
     # -- the per-window accumulate mutex ---------------------------------
-    def acquire(self):
+    def acquire(self, timeout=None):
+        """``timeout`` (seconds) bounds the wait: the flock spins
+        nonblocking against a deadline and expiry raises TimeoutError.
+        Packet handlers run on the engine thread and must never block
+        it unboundedly — holders are short memory-op critical sections,
+        so a timeout firing means a peer died mid-section and the
+        error must surface, not hang the engine."""
         f = self._lockfile()
+        if timeout is not None:
+            import time
+            deadline = time.monotonic() + timeout
+            if not self._tlock.acquire(timeout=timeout):
+                raise TimeoutError(
+                    "accumulate mutex: process-local lock timeout")
+            delay = 0.0002
+            while True:
+                try:
+                    fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    return
+                except OSError:
+                    if time.monotonic() >= deadline:
+                        self._tlock.release()
+                        raise TimeoutError(
+                            "accumulate mutex: flock timeout "
+                            f"({timeout}s)")
+                    time.sleep(delay)
+                    delay = min(delay * 1.5, 0.002)
+                except BaseException:
+                    self._tlock.release()
+                    raise
         self._tlock.acquire()
         try:
             fcntl.flock(f, fcntl.LOCK_EX)

@@ -4,25 +4,47 @@ The host windows in rma/win.py are the packet-protocol analog of the
 reference's one-sided path; THIS module is the direct-RDMA analog
 (gen2/rdma_iba_1sc.c:143-160, where puts/gets post verbs work requests
 straight to the HCA): windows live in device HBM as mesh-sharded jax
-arrays, and synchronization epochs compile to XLA programs over the
-mesh.
+arrays, and synchronization epochs run compiled programs over the mesh.
 
 TPU-first design:
 
 * A ``DeviceWin`` is a jax array of shape (p, n) sharded over a 1-D mesh
   axis — row r is rank r's exposed window memory, resident in its HBM.
 * Communication ops (put/get/accumulate) enqueue static descriptors;
-  ``fence()`` closes the epoch by compiling (and caching, keyed on the
-  epoch's op signature) ONE ``shard_map`` program that applies every op
-  via ``lax.ppermute`` routes + dynamic-slice updates, then executes it.
-  "Fence = one fused collective program" is the XLA-native counterpart
-  of the reference draining its RDMA work queue at MPI_Win_fence.
-* ``pallas_put`` is the explicit remote-DMA form of a contiguous put —
-  ``pltpu.make_async_remote_copy`` from the origin's source buffer into
-  the target's window shard, recv-semaphore-waited on the target (the
-  literal rdma_iba_1sc.c analog; the primitive is proven in
-  ops/pallas_ring.py). It exists for the cases the epoch compiler can't
-  express: overlapping a put with compute inside one kernel.
+  the closing synchronization call dispatches each one to a tier:
+
+  - **rdma** — the chunked remote-DMA kernels of ops/pallas_rma.py
+    (one ``make_async_remote_copy`` per chunk into the target's
+    landing slots; accumulate streams the slot/credit schedule with
+    the fold at the target, optionally over the block-scaled quantized
+    wire — tier 'quant'). Contiguous ops at or above the
+    ``dev_rma_rdma_min`` edge, when the kernels can run.
+  - **epoch** — the ppermute epoch compiler below (``_build_epoch``:
+    ONE fused ``shard_map`` program per op-signature, cached), the
+    scheduled fallback for strided/derived element patterns, sub-edge
+    payloads, and platforms where the kernels cannot run. "Fence = one
+    fused collective program" is the XLA-native counterpart of the
+    reference draining its RDMA work queue at MPI_Win_fence.
+
+  Every dispatch is counted (pvar families ``dev_rma_tier_*`` /
+  ``dev_rma_fallback_*``) and traced (device-lane instants; the sync
+  calls bracket a ``rma_flush`` span) — tier picks are observable, not
+  inferred.
+* Synchronization grammar: active-target ``fence()`` closes everything
+  enqueued (MPI_Win_fence); passive-target ``lock(rank)`` /
+  ``unlock(rank)`` bound an exclusive access epoch on one rank, with
+  ``flush(rank)`` / ``flush_local(rank)`` completing that rank's
+  outstanding ops mid-epoch (MPI_Win_lock family). On the kernels the
+  completion wave is the streamer's ``finish()`` — outbound DMAs off
+  the stage slots, commit stores landed, credit balance restored — so
+  flush/unlock semantics ride the chunk-credit DMA semaphores;
+  single-controller dispatch is synchronous program execution, so
+  local and remote completion coincide and ``flush_local`` ==_
+  ``flush``.
+* ``pallas_put`` is the original single-shot remote-DMA put kernel
+  (the primitive ops/pallas_rma.py grew from); kept for the cases the
+  dispatch surface can't express: overlapping a put with compute
+  inside one hand-written kernel.
 
 Single-controller note: the driving Python program is global (it sees
 all ranks), so op descriptors carry explicit origin/target ranks; the
@@ -57,29 +79,54 @@ try:
 except ImportError:  # pragma: no cover
     HAVE_PALLAS = False
 
+_KIND = {"put": "put", "acc": "acc", "get": "get"}
+
+
+def _trace_rma(name: str, phase: str, **kw) -> None:
+    """Drop a device-lane trace event (instant per dispatched op,
+    B/E span around the sync call). One recorder lookup, nothing when
+    untraced; tracing must never kill a dispatch."""
+    try:
+        from ..runtime.universe import current_universe
+        u = current_universe()
+        rec = u.engine.tracer if u is not None else None
+        if rec is not None:
+            rec.record("device", name, phase, **kw)
+    except Exception:
+        pass
+
 
 class DeviceWin:
     """An MPI-style window whose memory is a mesh-sharded HBM array.
 
-    Epoch model: ``fence()`` opens/closes access epochs (MPI_Win_fence
-    semantics). Ops enqueued between fences are applied, in order, by
-    the epoch program; ``get`` results become available after the
-    closing fence via the handle's ``value()``.
+    Epoch model: ``fence()`` opens/closes active-target access epochs
+    (MPI_Win_fence semantics); ``lock``/``unlock``/``flush`` run the
+    passive-target grammar. Ops enqueued inside an epoch are applied,
+    in order, at the closing sync call; ``get`` results become
+    available after it via the handle's ``value()``.
+
+    ``interpret``: None resolves MV2T_ICI_INTERPRET at dispatch (the
+    remote-DMA tier needs a TPU or the Mosaic interpreter; anywhere
+    else the epoch compiler serves every op and counts
+    dev_rma_fallback_platform).
     """
 
-    def __init__(self, comm, n: int, dtype=jnp.float32):
+    def __init__(self, comm, n: int, dtype=jnp.float32,
+                 interpret: Optional[bool] = None):
         self.comm = comm            # parallel.mesh.MeshComm
         self.axis = comm.axis
         self.p = comm.size
         self.n = int(n)
         self.dtype = jnp.dtype(dtype)
+        self.interpret = interpret
         self.win = jax.device_put(
             jnp.zeros((self.p, self.n), self.dtype),
             NamedSharding(comm.mesh, P(self.axis)))
-        self._ops: List[tuple] = []          # static descriptors
-        self._payloads: List[jnp.ndarray] = []
-        self._gets: List["_GetHandle"] = []
-        self._epoch_cache = {}
+        # queue entries: (op descriptor, payload array, get handle|None)
+        self._queue: List[tuple] = []
+        self._locked: set = set()   # ranks under a passive access epoch
+        self._epoch_cache = {}      # op-signature -> compiled program
+        self._rma_cache = {}        # per-op key -> compiled kernel prog
 
     # -- local access -----------------------------------------------------
     def local(self, rank: int) -> np.ndarray:
@@ -91,54 +138,207 @@ class DeviceWin:
         vals = jnp.asarray(values, self.dtype)
         self.win = self.win.at[rank, disp:disp + vals.size].set(vals)
 
-    # -- one-sided ops (enqueue; applied at the closing fence) ------------
-    def put(self, src, origin: int, target: int, disp: int = 0) -> None:
+    # -- one-sided ops (enqueue; applied at the closing sync call) --------
+    def put(self, src, origin: int, target: int, disp: int = 0,
+            stride: int = 1) -> None:
+        """MPI_Put. ``stride`` > 1 writes every stride-th window element
+        starting at ``disp`` (the vector-datatype case — always served
+        by the epoch compiler)."""
         src = jnp.asarray(src, self.dtype)
-        self._ops.append(("put", origin, target, disp, src.size))
-        self._payloads.append(src)
+        self._queue.append((("put", origin, target, disp, src.size,
+                             int(stride)), src, None))
 
     def accumulate(self, src, origin: int, target: int,
-                   disp: int = 0) -> None:
+                   disp: int = 0, stride: int = 1) -> None:
         """MPI_Accumulate with MPI_SUM (the only device-native op the
-        epoch compiler emits today; others via the host window)."""
+        dispatch tiers emit today; others via the host window)."""
         src = jnp.asarray(src, self.dtype)
-        self._ops.append(("acc", origin, target, disp, src.size))
-        self._payloads.append(src)
+        self._queue.append((("acc", origin, target, disp, src.size,
+                             int(stride)), src, None))
 
     def get(self, n: int, origin: int, target: int,
-            disp: int = 0) -> "_GetHandle":
+            disp: int = 0, stride: int = 1) -> "_GetHandle":
         h = _GetHandle(n)
-        self._ops.append(("get", origin, target, disp, n))
-        self._payloads.append(jnp.zeros((n,), self.dtype))
-        self._gets.append(h)
+        self._queue.append((("get", origin, target, disp, int(n),
+                             int(stride)), jnp.zeros((n,), self.dtype),
+                            h))
         return h
 
     # -- synchronization ---------------------------------------------------
     def fence(self) -> None:
-        """Close the access epoch: apply all enqueued ops in one compiled
-        mesh program, publish get results."""
-        if not self._ops:
+        """Close the active-target access epoch: apply every enqueued
+        op (one completion wave), publish get results."""
+        if not self._queue:
             return
-        sig = tuple(self._ops)
+        _trace_rma("rma_fence", "B", nops=len(self._queue))
+        try:
+            self._dispatch(list(range(len(self._queue))))
+        finally:
+            _trace_rma("rma_fence", "E")
+
+    def lock(self, rank: int) -> None:
+        """Open an exclusive passive-target access epoch on ``rank``
+        (MPI_Win_lock). Exclusivity is structural in the single-
+        controller model — one driving program — so the lock is epoch
+        bookkeeping: double-locking is the caller's bug and raises."""
+        if rank in self._locked:
+            raise RuntimeError(f"rank {rank} already locked")
+        self._locked.add(rank)
+
+    def unlock(self, rank: int) -> None:
+        """Close the passive epoch on ``rank``: flush its outstanding
+        ops (the completion wave), then release (MPI_Win_unlock)."""
+        if rank not in self._locked:
+            raise RuntimeError(f"rank {rank} not locked")
+        self.flush(rank)
+        self._locked.discard(rank)
+
+    def flush(self, rank: Optional[int] = None) -> None:
+        """Complete every outstanding op targeting ``rank`` (None =
+        all ranks) at both origin and target (MPI_Win_flush). On the
+        remote-DMA tier this is the streamer's finish() wave — stage
+        slots drained, commit stores landed, credit balance restored;
+        ops for other targets stay queued (MPI makes no cross-target
+        ordering promise)."""
+        idx = [i for i, (op, _pay, _h) in enumerate(self._queue)
+               if rank is None or op[2] == rank]
+        if not idx:
+            return
+        from .. import mpit
+        mpit.pvar("dev_rma_flush").inc()
+        _trace_rma("rma_flush", "B", rank=-1 if rank is None else rank,
+                   nops=len(idx))
+        try:
+            self._dispatch(idx)
+        finally:
+            _trace_rma("rma_flush", "E")
+
+    def flush_local(self, rank: Optional[int] = None) -> None:
+        """MPI_Win_flush_local: origin-side buffers reusable. Single-
+        controller dispatch is synchronous program execution, so local
+        completion coincides with remote completion — one wave."""
+        self.flush(rank)
+
+    # -- dispatch ----------------------------------------------------------
+    def _op_tier(self, op) -> Tuple[str, Optional[str]]:
+        kind, _origin, _target, _disp, n, stride = op
+        from ..ops import pallas_rma
+        return pallas_rma.planned_rma_tier(
+            _KIND[kind], n * self.dtype.itemsize, self.dtype,
+            stride == 1, self.interpret, self.p, count=n)
+
+    def _dispatch(self, idx: List[int]) -> None:
+        """Apply the queue entries at ``idx`` in order: maximal runs of
+        epoch-tier ops batch into one fused program, remote-DMA ops run
+        their cached per-op kernel programs."""
+        from .. import mpit
+        from ..ops.pallas_rma import note_rma_fallback
+        entries = [self._queue[i] for i in idx]
+        runs: List[Tuple[str, List[tuple]]] = []
+        for op, pay, h in entries:
+            tier, reason = self._op_tier(op)
+            if tier == "epoch":
+                mpit.pvar("dev_rma_tier_epoch").inc()
+                note_rma_fallback(op[0], reason or "size",
+                                  op[4] * self.dtype.itemsize)
+            if runs and runs[-1][0] == "epoch" and tier == "epoch":
+                runs[-1][1].append((op, pay, h))
+            else:
+                runs.append((tier, [(op, pay, h)]))
+        for tier, ents in runs:
+            if tier == "epoch":
+                self._run_epoch(ents)
+            else:
+                for op, pay, h in ents:
+                    self._run_rdma(tier, op, pay, h)
+        done = set(idx)
+        self._queue = [e for i, e in enumerate(self._queue)
+                       if i not in done]
+
+    # -- the remote-DMA tier ----------------------------------------------
+    def _run_rdma(self, tier: str, op, pay, h) -> None:
+        from .. import mpit
+        kind, origin, target, disp, n, _stride = op
+        nbytes = n * self.dtype.itemsize
+        wire = nbytes
+        if tier == "quant":
+            from ..ops.pallas_quant import quant_block_elems, wire_words
+            wire = wire_words(n, quant_block_elems(self.dtype)) * 4
+        mpit.pvar(f"dev_rma_tier_{'quant' if tier == 'quant' else 'rdma'}"
+                  ).inc()
+        mpit.pvar("dev_rma_wire_bytes").inc(wire)
+        _trace_rma(f"rma_{kind}", "i", tier=tier, bytes=int(nbytes),
+                   origin=origin, target=target)
+        key = (tier,) + op
+        prog = self._rma_cache.get(key)
+        if prog is None:
+            prog = self._build_rdma(tier, op)
+            self._rma_cache[key] = prog
+        if kind == "get":
+            out = prog(self.win)
+            h._value = np.asarray(out[origin])[:n]
+        else:
+            self.win = prog(self.win, pay)
+
+    def _build_rdma(self, tier: str, op):
+        """Compile one op's remote-DMA program: the pallas_rma kernel
+        wrapped in shard_map over the window's axis (cached per op
+        signature, like the epoch programs)."""
+        kind, origin, target, disp, n, _stride = op
+        axis, p, interpret = self.axis, self.p, self.interpret
+        from ..ops import pallas_rma
+        from ..parallel.mesh import shard_map
+
+        if kind == "get":
+            def prog(w_row):
+                g = pallas_rma.rma_get(w_row[0], n, axis, p, origin,
+                                       target, disp, interpret=interpret)
+                return g[None, :]
+            f = shard_map(prog, mesh=self.comm.mesh, in_specs=(P(axis),),
+                          out_specs=P(axis), check_vma=False)
+            return jax.jit(f)
+
+        if kind == "put":
+            def prog(w_row, pay):
+                out = pallas_rma.rma_put(pay, w_row[0], axis, p, origin,
+                                         target, disp,
+                                         interpret=interpret)
+                return out[None, :]
+        else:
+            quant = tier == "quant"
+
+            def prog(w_row, pay):
+                out = pallas_rma.rma_accumulate(pay, w_row[0], axis, p,
+                                                origin, target, disp,
+                                                quantized=quant,
+                                                interpret=interpret)
+                return out[None, :]
+        f = shard_map(prog, mesh=self.comm.mesh,
+                      in_specs=(P(axis), P()), out_specs=P(axis),
+                      check_vma=False)
+        return jax.jit(f)
+
+    # -- the epoch-compiler tier ------------------------------------------
+    def _run_epoch(self, ents: List[tuple]) -> None:
+        sig = tuple(op for op, _pay, _h in ents)
         fn = self._epoch_cache.get(sig)
         if fn is None:
             fn = self._build_epoch(sig)
             self._epoch_cache[sig] = fn
         maxn = max(op[4] for op in sig)
-        pay = jnp.stack([jnp.pad(p, (0, maxn - p.size))
-                         for p in self._payloads])
+        pay = jnp.stack([jnp.pad(p_, (0, maxn - p_.size))
+                         for _op, p_, _h in ents])
         self.win, gets = fn(self.win, pay)
         gi = 0
-        for op in sig:
+        for op, _pay, h in ents:
             if op[0] == "get":
-                self._gets[gi]._value = np.asarray(
-                    gets[gi])[: op[4]]
+                h._value = np.asarray(gets[gi])[: op[4]]
                 gi += 1
-        self._ops, self._payloads, self._gets = [], [], []
 
     def _build_epoch(self, sig: Tuple[tuple, ...]):
         """Compile the epoch: each descriptor becomes a ppermute route +
-        slice update inside one shard_map over the window's axis."""
+        slice (stride 1) or gather/scatter (strided) update inside one
+        shard_map over the window's axis."""
         axis, p = self.axis, self.p
         ngets = sum(1 for op in sig if op[0] == "get")
 
@@ -147,17 +347,27 @@ class DeviceWin:
             me = lax.axis_index(axis)
             row = win_row[0]
             gets = []
-            for i, (kind, origin, target, disp, n) in enumerate(sig):
+            for i, (kind, origin, target, disp, n, stride) in \
+                    enumerate(sig):
                 if kind in ("put", "acc"):
                     # route origin's payload to the target rank
                     data = lax.ppermute(pay[i, :n], axis,
                                         [(origin, target)])
-                    cur = lax.dynamic_slice(row, (disp,), (n,))
-                    new = data + cur if kind == "acc" else data
-                    upd = lax.dynamic_update_slice(row, new, (disp,))
+                    if stride == 1:
+                        cur = lax.dynamic_slice(row, (disp,), (n,))
+                        new = data + cur if kind == "acc" else data
+                        upd = lax.dynamic_update_slice(row, new, (disp,))
+                    else:
+                        ix = disp + stride * jnp.arange(n)
+                        cur = row[ix]
+                        new = data + cur if kind == "acc" else data
+                        upd = row.at[ix].set(new)
                     row = jnp.where(me == target, upd, row)
                 else:  # get: route the target's window slice to origin
-                    chunk = lax.dynamic_slice(row, (disp,), (n,))
+                    if stride == 1:
+                        chunk = lax.dynamic_slice(row, (disp,), (n,))
+                    else:
+                        chunk = row[disp + stride * jnp.arange(n)]
                     back = lax.ppermute(chunk, axis, [(target, origin)])
                     got = jnp.where(me == origin, back,
                                     jnp.zeros_like(back))
@@ -193,7 +403,8 @@ class _GetHandle:
 
     def value(self) -> np.ndarray:
         if self._value is None:
-            raise RuntimeError("get not yet completed (fence the epoch)")
+            raise RuntimeError("get not yet completed (close the epoch: "
+                               "fence, or flush/unlock the target)")
         return self._value
 
 

@@ -40,6 +40,11 @@ from ..utils.mlog import get_logger
 
 log = get_logger("rma")
 
+# Bound on the engine-thread wait for the per-window accumulate mutex
+# (rma/cma.py). Holders are short memory-op critical sections in peer
+# processes; 60 s of contention means a peer died holding the flock.
+_ACC_MUTEX_TIMEOUT = 60.0
+
 LOCK_EXCLUSIVE = 1
 LOCK_SHARED = 2
 
@@ -827,10 +832,13 @@ class RmaManager:
         cnt = pkt.extra["count"]
         op = _op_by_name(pkt.extra["op"])
         # a packet acc on a direct-access window must hold the same
-        # mutex direct origins use, or span-overflow fallbacks race them
+        # mutex direct origins use, or span-overflow fallbacks race
+        # them. Bounded: this runs on the engine thread, and holders
+        # are short memory-op critical sections — expiry means a peer
+        # died mid-section and must surface as an error, not a hang.
         cma = win._cma
         if cma is not None:
-            cma.acquire()
+            cma.acquire(timeout=_ACC_MUTEX_TIMEOUT)
         try:
             region = win._region(pkt.extra["disp"], _dt_span(tdt, cnt))
             old = np.asarray(tdt.pack(region, cnt)) if cnt else \
@@ -855,9 +863,11 @@ class RmaManager:
     def _on_cas(self, pkt: Packet) -> None:
         win = self._win(pkt)
         tdt = _deser_dt(pkt.extra["tdt"])
+        # same bounded accumulate mutex as _apply_acc (the r4 lint
+        # baseline entry this call retired)
         cma = win._cma
         if cma is not None:
-            cma.acquire()
+            cma.acquire(timeout=_ACC_MUTEX_TIMEOUT)
         try:
             region = win._region(pkt.extra["disp"], tdt.extent)
             old = np.asarray(tdt.pack(region, 1))

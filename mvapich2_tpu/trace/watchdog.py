@@ -178,6 +178,18 @@ def _device_report(u) -> list:
             if v:
                 pvs.append(f"{name}={v:g}")
         lines.append("  tier counters: " + (" ".join(pvs) or "(none)"))
+        rma = []
+        for name in ("dev_rma_tier_rdma", "dev_rma_tier_quant",
+                     "dev_rma_tier_epoch", "dev_rma_flush",
+                     "dev_rma_wire_bytes",
+                     "dev_rma_fallback_noncontig",
+                     "dev_rma_fallback_platform",
+                     "dev_rma_fallback_size", "dev_rma_fallback_dtype"):
+            v = mpit.pvar(name).read()
+            if v:
+                rma.append(f"{name}={v:g}")
+        if rma:
+            lines.append("  one-sided counters: " + " ".join(rma))
         bws = [f"{t}={mpit.pvar(f'dev_effbw_{t}').read():.3g}"
                for t in ("vmem", "hbm", "quant", "xla", "slot")
                if mpit.pvar(f"dev_effbw_{t}").read()]

@@ -538,6 +538,106 @@ def test_mpistat_device_map_flag(capsys):
     assert "pending_send" in out and "cap_sem" in out
 
 
+# -- the one-sided engine under the device pass (ISSUE 16) ---------------
+
+def test_device_pass_catches_rma_seed_violation_classes(tmp_path):
+    """Mutation check with teeth for ops/pallas_rma.py: re-introduce
+    the violation classes the device pass guards the one-sided engine
+    against — a dead pending map, an unannotated creditless gate, and
+    a started fold-operand load whose handle leaks out of the kernel —
+    and prove the pass catches each one."""
+    from mvapich2_tpu.analysis.device import DevicePass
+    src = open(os.path.join(REPO, "mvapich2_tpu", "ops",
+                            "pallas_rma.py")).read()
+    # (a) a pending map that is never filled or drained
+    mut = src.replace(
+        "self.pending_store: Dict = {}          # slot -> commit store",
+        "self.pending_store: Dict = {}          # slot -> commit store\n"
+        "        self.pending_ack: Dict = {}")
+    assert mut != src
+    # (b) strip the hw-only annotation from the credit re-grant gate
+    mut = mut.replace("def _grant(self):                         "
+                      "# device: hw-only",
+                      "def _grant(self):")
+    p = tmp_path / "pallas_rma_mut.py"
+    p.write_text(mut)
+    mods, errs = core.scan_paths([str(p)])
+    assert not errs
+    fs = DevicePass(profiles=[]).run(mods)
+    msgs = "\n".join(f.msg for f in fs)
+    assert "pending_ack" in msgs, msgs
+    assert "not annotated '# device: hw-only'" in msgs, msgs
+    # (c) drop the park: the started window-operand load leaks out of
+    # the accumulate kernel with no wait on any path
+    mut2 = src.replace("                st.pending_fold[slot] = ld\n", "")
+    assert mut2 != src
+    p2 = tmp_path / "pallas_rma_mut2.py"
+    p2.write_text(mut2)
+    mods2, _ = core.scan_paths([str(p2)])
+    fs2 = DevicePass(profiles=[]).run(mods2)
+    assert any("'ld'" in f.msg and "without a matching wait" in f.msg
+               for f in fs2), [f.msg for f in fs2]
+
+
+def test_device_lane_map_covers_rma_containers():
+    """The lane map the watchdog/mpistat device sections read grows the
+    one-sided engine's containers: the fold-operand prefetch map (local,
+    drained by wait) rides next to the remote send map."""
+    from mvapich2_tpu.analysis.device import device_lane_map
+    m = device_lane_map(refresh=True)
+    assert m["pending_fold"]["kind"] == "pending-map"
+    assert m["pending_fold"]["remote"] is False
+    assert m["pending_fold"]["drains"] == ["wait"]
+    assert m["pending_fold"]["module"].endswith("pallas_rma.py")
+
+
+def test_watchdog_device_report_one_sided_counters():
+    """The stall report's device section prints the dev_rma_* counter
+    line once any one-sided op has run."""
+    from types import SimpleNamespace
+
+    from mvapich2_tpu import mpit
+    from mvapich2_tpu.trace import watchdog
+    mpit.pvar("dev_rma_tier_epoch").inc()
+    mpit.pvar("dev_rma_flush").inc()
+    ch = SimpleNamespace(rank=0, size=1, rv=None)
+    u = SimpleNamespace(comm_world=SimpleNamespace(device_channel=ch))
+    text = "\n".join(watchdog._device_report(u))
+    assert "one-sided counters:" in text
+    assert "dev_rma_tier_epoch" in text and "dev_rma_flush" in text
+
+
+def test_rma_win_acc_mutex_bounded_and_baseline_empty():
+    """The retired r4 baseline entry stays retired: the accumulate
+    mutex acquires in rma/win.py are timeout-bounded (the blocking pass
+    finds nothing), the locks baseline carries zero suppressions, and
+    re-introducing the unbounded acquire is caught again."""
+    win = os.path.join(REPO, "mvapich2_tpu", "rma", "win.py")
+    mods, errs = core.scan_paths([win])
+    assert not errs
+    assert [f for f in core.run_passes(mods)
+            if f.pass_id in ("blocking", "locks")] == []
+    bl = core.load_baseline()
+    assert bl.entries == [], bl.entries
+
+
+def test_rma_win_unbounded_acquire_caught_again(tmp_path):
+    """Strip the timeout bound from the _on_cas mutex acquire: the
+    blocking pass must flag it — the empty baseline means the finding
+    cannot come back silently."""
+    src = open(os.path.join(REPO, "mvapich2_tpu", "rma",
+                            "win.py")).read()
+    mut = src.replace("cma.acquire(timeout=_ACC_MUTEX_TIMEOUT)",
+                      "cma.acquire()")
+    assert mut != src
+    p = tmp_path / "win_mut.py"
+    p.write_text(mut)
+    mods, _ = core.scan_paths([str(p)])
+    fs = [f for f in core.run_passes(mods) if f.pass_id == "blocking"]
+    assert fs and any("acquire" in f.msg for f in fs), \
+        [f.msg for f in core.run_passes(mods)]
+
+
 # -- the profile doctor (ISSUE 12 tentpole piece 3) ----------------------
 
 def test_profile_doctor_bad_fixture():

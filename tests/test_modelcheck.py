@@ -20,7 +20,8 @@ import pytest
 
 from mvapich2_tpu.analysis import model as M
 from mvapich2_tpu.analysis.model import (daemon, doorbell, flat2, ft,
-                                         ici, lease, seqlock, wiring)
+                                         ici, lease, rma, seqlock,
+                                         wiring)
 
 pytestmark = pytest.mark.lint
 
@@ -68,6 +69,13 @@ CLEAN = [
     ("ici-n3-C2-D2-quant-bidir", lambda: ici.build_ring(
         3, 2, 2, bidir=True, quant=True)),
     ("ici-n2-C4-D3-quant", lambda: ici.build_ring(2, 4, 3, quant=True)),
+    # passive-target one-sided epoch (ops/pallas_rma.py + rma/device.py):
+    # lock / chunk-credit accumulate stream / flush / unlock against a
+    # concurrent local reader and the two-phase target fold
+    ("rma-C2-D2-W1", lambda: rma.build_passive(2, 2, 1)),
+    ("rma-C3-D2-W1", lambda: rma.build_passive(3, 2, 1)),
+    ("rma-C3-D2-W2", lambda: rma.build_passive(3, 2, 2)),
+    ("rma-C4-D3-W2", lambda: rma.build_passive(4, 3, 2)),
     # control-plane net (ISSUE 13): 2-stage lazy wire, warm-attach
     # daemon claim cycle (+ the item-4a concurrent-claims variant),
     # ULFM lease-detect/revoke/shrink propagation — tier-1 bounds all
@@ -154,6 +162,12 @@ EXPECTED_INVARIANT = {
     # packed codes + recv signal -> a dequant-fold outside the
     # declared block-quant bound
     "scale_after_payload": {"agreement"},
+    # passive-target one-sided epoch (ops/pallas_rma.py)
+    "flush_skips_chunk": {"flush-completes-all-outstanding"},
+    "unlock_before_drain": {"no-torn-window-read"},
+    "no_target_fold_order": {"acc-atomicity"},
+    "torn_window_read": {"no-torn-window-read"},
+    "no_lock_wait": {"lock-exclusive", "no-torn-window-read"},
 }
 
 
@@ -232,6 +246,36 @@ def test_ici_matrix_has_six_mutations():
                     "depth_mismatch", "signal_before_copy",
                     "bidir_shared_slot", "recv_before_send_wave",
                     "scale_after_payload"}
+
+
+def test_rma_matrix_has_five_mutations():
+    """ISSUE 16: the passive-target one-sided model seeds >= 4
+    distinct protocol breaks (flush one chunk short, unlock before the
+    completion wave, stale fold operand, lock-bypassing local load,
+    plus the exclusivity-ignoring acquire), every one caught by a
+    named invariant via test_mutation_caught over the matrix."""
+    muts = {m[2] for m in M.mutation_matrix() if m[0] == "rma-passive"}
+    assert muts == {"flush_skips_chunk", "unlock_before_drain",
+                    "no_target_fold_order", "torn_window_read",
+                    "no_lock_wait"}
+
+
+def test_rma_violation_trace_replays():
+    """A torn-window-read trace replays from init to a violating
+    state — the counterexample is actionable, not just a boolean."""
+    m = rma.build_passive(3, 2, 1, mutation="unlock_before_drain")
+    r = M.explore(m)
+    v = next(v for v in r.violations
+             if v.invariant == "no-torn-window-read")
+    state = dict(m.init)
+    by_name = {t.name: t for t in m.transitions}
+    for step in v.trace:
+        t = by_name[step]
+        assert t.guard(state), f"trace step {step} not enabled on replay"
+        state = t.apply(state)
+    name, pred = next(i for i in m.invariants
+                      if i[0] == "no-torn-window-read")
+    assert pred(state) is not None, "replayed state does not violate"
 
 
 def test_ici_violation_trace_replays():
@@ -374,6 +418,35 @@ def test_full_depth_ici_mutations_np3():
                     ("recv_before_send_wave", dict(chunks=3, depth=2)),
                     ("scale_after_payload", dict(chunks=3, depth=2))]:
         r = M.explore(ici.build_ring(3, mutation=mut, **kw))
+        assert not r.ok, mut
+
+
+# -- passive-target one-sided epoch: full acceptance bounds (ISSUE 16) ---
+
+@pytest.mark.modelcheck
+@pytest.mark.parametrize("chunks", [2, 3, 4])
+@pytest.mark.parametrize("depth", [2, 3])
+@pytest.mark.parametrize("cells", [1, 2])
+def test_full_depth_rma_matrix(chunks, depth, cells):
+    """ISSUE 16 acceptance: the clean passive-target epoch is
+    exhaustively green (lock exclusivity, no torn window read, flush
+    completeness, accumulate atomicity, no deadlock) for chunks in
+    {2,3,4} x depth in {2,3} x cells in {1,2}."""
+    r = M.explore(rma.build_passive(chunks, depth, cells),
+                  max_states=2_000_000)
+    assert r.complete, f"truncated at {r.states} states"
+    assert r.ok, [f"{v.invariant}: {v.message}" for v in r.violations]
+
+
+@pytest.mark.modelcheck
+def test_full_depth_rma_mutations_wider():
+    """The rma mutations still caught away from their minimal configs
+    (more chunks, deeper credit window — no_target_fold_order needs
+    depth > cells, kept at W=1)."""
+    for mut in ("flush_skips_chunk", "unlock_before_drain",
+                "no_target_fold_order", "torn_window_read",
+                "no_lock_wait"):
+        r = M.explore(rma.build_passive(4, 3, 1, mutation=mut))
         assert not r.ok, mut
 
 

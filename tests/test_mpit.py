@@ -361,3 +361,38 @@ def test_device_fallback_pvars_move():
     sess.start(h)
     note_fallback("allreduce", "size", 1 << 23, "float32")
     assert sess.read(h) >= 1
+
+
+def test_device_category_one_sided():
+    """The one-sided lane (ISSUE 16) declares its surface in mpit.py
+    too: the RMA chunk cvar and the tier/fallback/sync pvar family
+    ops/pallas_rma and rma/device share, under the same "device"
+    category so mpistat/watchdog enumerate them with the collective
+    ones."""
+    cats = mpit.category_names()
+    info = mpit.category_get_info(cats.index("device"))
+    for cv in ("RMA_CHUNK_BYTES", "DEV_RMA_RDMA_MIN",
+               "DEV_RMA_QUANT_MIN"):
+        assert cv in info["cvars"], cv
+    for pv in ("dev_rma_tier_rdma", "dev_rma_tier_quant",
+               "dev_rma_tier_epoch", "dev_rma_fallback_noncontig",
+               "dev_rma_fallback_platform", "dev_rma_fallback_size",
+               "dev_rma_fallback_dtype", "dev_rma_flush",
+               "dev_rma_wire_bytes"):
+        assert pv in info["pvars"], pv
+        assert mpit._pvars.get(pv).klass == mpit.PVAR_CLASS_COUNTER
+    # RMA_CHUNK_BYTES round-trips and defaults to "inherit ici" (<= 0)
+    i = mpit.cvar_get_index("RMA_CHUNK_BYTES")
+    assert mpit.cvar_get_info(i)["name"] == "RMA_CHUNK_BYTES"
+    assert int(mpit.cvar_read(i)) <= 0
+
+
+def test_device_rma_pvars_move():
+    """The one-sided fallback counters move through a pvar session
+    when an op is rejected to the epoch compiler."""
+    from mvapich2_tpu.ops.pallas_rma import note_rma_fallback
+    sess = mpit.pvar_session_create()
+    h = sess.handle_alloc("dev_rma_fallback_noncontig")
+    sess.start(h)
+    note_rma_fallback("put", "noncontig", 4096)
+    assert sess.read(h) >= 1

@@ -1,0 +1,297 @@
+"""One-sided remote-DMA engine (ops/pallas_rma) — interpret-mode
+correctness sweep on the virtual CPU mesh.
+
+Put/Get/Accumulate are exact kernels: the sweep asserts element
+equality (bit equality for integer data) against the window semantics
+for every op x dtype (f32/bf16/i32) x chunk-boundary offset/shape x
+mesh width in {2,4,8}, that only the addressed pair's shard changes,
+and that the quantized accumulate honors the pallas_quant
+``declared_bound`` error contract. Tier selection
+(``planned_rma_tier``) is unit-tested against the coll/tuning
+``dev_rma_*`` edges; the end-to-end DeviceWin dispatch rides in
+tests/test_device_rma.py.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from mvapich2_tpu.ops import pallas_rma  # noqa: E402
+from mvapich2_tpu.parallel import make_mesh  # noqa: E402
+from mvapich2_tpu.parallel.mesh import shard_map  # noqa: E402
+from mvapich2_tpu.utils.config import get_config  # noqa: E402
+
+DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16, "i32": jnp.int32}
+
+
+def _reload(**env):
+    for k, v in env.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    get_config().reload()
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    yield
+    _reload(MV2T_QUANT_COLL=None, MV2T_RMA_CHUNK_BYTES=None,
+            MV2T_ICI_INTERPRET=None, MV2T_DEV_RMA_RDMA_MIN=None,
+            MV2T_DEV_RMA_QUANT_MIN=None)
+
+
+_MESHES = {}
+
+
+def _mesh(nd):
+    if nd not in _MESHES:
+        _MESHES[nd] = make_mesh((nd,), ("x",), jax.devices()[:nd])
+    return _MESHES[nd]
+
+
+def _shard(mesh, arr):
+    return jax.device_put(arr, NamedSharding(mesh, P("x")))
+
+
+def _f32(a):
+    return np.asarray(a.astype(jnp.float32)) if a.dtype == jnp.bfloat16 \
+        else np.asarray(a)
+
+
+def _run(nd, prog, win):
+    mesh = _mesh(nd)
+    f = shard_map(prog, mesh=mesh, in_specs=(P("x"),), out_specs=P("x"),
+                  check_vma=False)
+    return jax.jit(f)(_shard(mesh, win))
+
+
+def _win_rows(nd, n, dtype):
+    """Distinct per-rank window contents, exactly representable in
+    every swept dtype (small integers)."""
+    base = jnp.arange(n, dtype=jnp.float32) % 13
+    rows = jnp.stack([base + 20.0 * r for r in range(nd)])
+    return rows.astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# put / get / accumulate x dtype x mesh width, chunk-boundary shapes
+# ---------------------------------------------------------------------------
+
+# 16-byte chunks -> 4 f32/i32 or 8 bf16 elems per chunk; n spans ~2.5
+# chunks so the sweep always crosses a chunk boundary and ends on a
+# partial chunk, and disp=3 misaligns the window landing.
+_CB = 16
+
+
+def _nelems(dtype):
+    epc = _CB // np.dtype(dtype).itemsize
+    return 2 * epc + epc // 2
+
+
+@pytest.mark.parametrize("nd,dt", [(2, "f32"), (4, "bf16"), (8, "i32"),
+                                   (8, "f32")])
+def test_put_pair_only(nd, dt):
+    dtype = DTYPES[dt]
+    n = _nelems(dtype)
+    N, disp, origin, target = n + 8, 3, nd - 2, nd - 1
+    win = _win_rows(nd, N, dtype)
+    src = (jnp.arange(n, dtype=jnp.float32) + 1.0).astype(dtype)
+
+    def prog(w_row):
+        return pallas_rma.rma_put(src, w_row[0], "x", nd, origin, target,
+                                  disp, chunk_bytes=_CB,
+                                  interpret=True)[None, :]
+
+    out = _run(nd, prog, win)
+    exp = _f32(win).copy()
+    exp[target, disp:disp + n] = _f32(src)
+    if dt == "i32":
+        assert np.array_equal(np.asarray(out), exp.astype(np.int32))
+    else:
+        np.testing.assert_allclose(_f32(out), exp)
+
+
+@pytest.mark.parametrize("nd,dt", [(2, "i32"), (4, "f32"), (8, "bf16")])
+def test_get_origin_only(nd, dt):
+    dtype = DTYPES[dt]
+    n = _nelems(dtype)
+    N, disp, origin, target = n + 8, 5, 0, nd - 1
+    win = _win_rows(nd, N, dtype)
+
+    def prog(w_row):
+        return pallas_rma.rma_get(w_row[0], n, "x", nd, origin, target,
+                                  disp, chunk_bytes=_CB,
+                                  interpret=True)[None, :]
+
+    out = _run(nd, prog, win)
+    exp = np.zeros((nd, n), np.float32)
+    exp[origin] = _f32(win)[target, disp:disp + n]
+    if dt == "i32":
+        assert np.array_equal(np.asarray(out), exp.astype(np.int32))
+    else:
+        np.testing.assert_allclose(_f32(out), exp)
+
+
+@pytest.mark.parametrize("nd,dt", [(2, "bf16"), (4, "i32"), (8, "f32")])
+def test_accumulate_exact(nd, dt):
+    dtype = DTYPES[dt]
+    n = _nelems(dtype)
+    N, disp, origin, target = n + 8, 2, 1, 0
+    win = _win_rows(nd, N, dtype)
+    src = (jnp.arange(n, dtype=jnp.float32) % 7 + 1.0).astype(dtype)
+
+    def prog(w_row):
+        return pallas_rma.rma_accumulate(src, w_row[0], "x", nd, origin,
+                                         target, disp, chunk_bytes=_CB,
+                                         interpret=True)[None, :]
+
+    out = _run(nd, prog, win)
+    exp = _f32(win).copy()
+    exp[target, disp:disp + n] += _f32(src)
+    if dt == "i32":
+        assert np.array_equal(np.asarray(out), exp.astype(np.int32))
+    else:
+        np.testing.assert_allclose(_f32(out), exp)
+
+
+@pytest.mark.parametrize("n,disp,cb", [
+    (8, 0, 16),     # exact chunk multiple at the window base
+    (3, 1, 16),     # single partial chunk
+    (4, 12, 16),    # n == chunk, landing flush with the window end
+    (21, 2, 8),     # many (11) tiny chunks, partial tail
+])
+def test_put_chunk_boundary_shapes(n, disp, cb):
+    nd = 4
+    win = _win_rows(nd, 16 + 21, jnp.float32)
+    src = jnp.arange(n, dtype=jnp.float32) + 0.5
+
+    def prog(w_row):
+        return pallas_rma.rma_put(src, w_row[0], "x", nd, 3, 1, disp,
+                                  chunk_bytes=cb,
+                                  interpret=True)[None, :]
+
+    out = np.asarray(_run(nd, prog, win))
+    exp = np.asarray(win).copy()
+    exp[1, disp:disp + n] = np.asarray(src)
+    np.testing.assert_allclose(out, exp)
+
+
+# ---------------------------------------------------------------------------
+# quantized accumulate: the declared_bound error contract
+# ---------------------------------------------------------------------------
+
+def test_accumulate_quantized_within_declared_bound():
+    from mvapich2_tpu.ops.pallas_quant import declared_bound
+    _reload(MV2T_QUANT_COLL="q8:1e-1")
+    nd, n, disp = 8, 256, 128
+    win = jnp.ones((nd, 512), jnp.float32)
+    src = jnp.linspace(-3.0, 5.0, n, dtype=jnp.float32)
+
+    def prog(w_row):
+        return pallas_rma.rma_accumulate(
+            src, w_row[0], "x", nd, 4, 7, disp, quantized=True,
+            chunk_bytes=512, interpret=True)[None, :]
+
+    out = np.asarray(_run(nd, prog, win))
+    exp = np.ones((nd, 512), np.float32)
+    exp[7, disp:disp + n] += np.asarray(src)
+    # an RMA accumulate is one quantization hop: per element the error
+    # is within declared_bound(1, wire) of the block absmax
+    bound = declared_bound(1, "q8") * np.abs(np.asarray(src)).max()
+    assert np.abs(out[7] - exp[7]).max() <= bound + 1e-6
+    # non-target shards untouched (the identity fold is exact: zeros
+    # encode to zeros)
+    others = [r for r in range(nd) if r != 7]
+    np.testing.assert_array_equal(out[others], exp[others])
+
+
+def test_accumulate_quantized_rejects_non_block_multiple():
+    _reload(MV2T_QUANT_COLL="q8:1e-1")
+    win = _win_rows(2, 300, jnp.float32)
+    src = jnp.ones((130,), jnp.float32)
+    with pytest.raises(ValueError, match="block-multiple"):
+        def prog(w_row):
+            return pallas_rma.rma_accumulate(
+                src, w_row[0], "x", 2, 0, 1, 0, quantized=True,
+                interpret=True)[None, :]
+        _run(2, prog, win)
+
+
+# ---------------------------------------------------------------------------
+# tier selection (planned_rma_tier x the dev_rma_* tuning edges)
+# ---------------------------------------------------------------------------
+
+def test_planned_tier_rdma_for_contiguous():
+    tier, reason = pallas_rma.planned_rma_tier(
+        "put", 4096, jnp.float32, True, interpret=True)
+    assert (tier, reason) == ("rdma", None)
+
+
+def test_planned_tier_epoch_reasons():
+    cases = [
+        (("put", 4096, jnp.float32, False), "noncontig"),
+        (("get", 4096, jnp.complex64, True), "dtype"),
+        (("put", 0, jnp.float32, True), "size"),
+    ]
+    for args, want in cases:
+        tier, reason = pallas_rma.planned_rma_tier(*args, interpret=True)
+        assert (tier, reason) == ("epoch", want), args
+
+
+def test_planned_tier_size_edge_cvar():
+    _reload(MV2T_DEV_RMA_RDMA_MIN="1024")
+    tier, reason = pallas_rma.planned_rma_tier(
+        "put", 512, jnp.float32, True, interpret=True)
+    assert (tier, reason) == ("epoch", "size")
+    tier, reason = pallas_rma.planned_rma_tier(
+        "put", 2048, jnp.float32, True, interpret=True)
+    assert (tier, reason) == ("rdma", None)
+
+
+def test_planned_tier_quant_bin():
+    _reload(MV2T_QUANT_COLL="q8:1e-1", MV2T_DEV_RMA_QUANT_MIN="1024")
+    # a big block-multiple f32 accumulate lands in the quant bin
+    tier, _ = pallas_rma.planned_rma_tier(
+        "acc", 1 << 20, jnp.float32, True, interpret=True,
+        num_devices=8, count=(1 << 20) // 4)
+    assert tier == "quant"
+    # puts never quantize; int accumulates degrade to the exact tier
+    tier, _ = pallas_rma.planned_rma_tier(
+        "put", 1 << 20, jnp.float32, True, interpret=True,
+        num_devices=8, count=(1 << 20) // 4)
+    assert tier == "rdma"
+    tier, _ = pallas_rma.planned_rma_tier(
+        "acc", 1 << 20, jnp.int32, True, interpret=True,
+        num_devices=8, count=(1 << 20) // 4)
+    assert tier == "rdma"
+    # budget off -> exact rdma
+    _reload(MV2T_QUANT_COLL=None, MV2T_DEV_RMA_QUANT_MIN="1024")
+    tier, _ = pallas_rma.planned_rma_tier(
+        "acc", 1 << 20, jnp.float32, True, interpret=True,
+        num_devices=8, count=(1 << 20) // 4)
+    assert tier == "rdma"
+
+
+def test_acc_quant_ok_gates():
+    _reload(MV2T_QUANT_COLL="q8:1e-1")
+    assert pallas_rma.acc_quant_ok(jnp.float32, 512, 8)
+    assert not pallas_rma.acc_quant_ok(jnp.int32, 512, 8)
+    assert not pallas_rma.acc_quant_ok(jnp.float32, 130, 8)
+    _reload(MV2T_QUANT_COLL="q8:1e-4")   # budget below one-hop bound
+    assert not pallas_rma.acc_quant_ok(jnp.float32, 512, 8)
+
+
+def test_rma_chunk_cvar_inherits_ici_edge():
+    _reload(MV2T_RMA_CHUNK_BYTES=None)
+    from mvapich2_tpu.coll.tuning import kernel_param_cv
+    assert pallas_rma._cfg_chunk_elems(jnp.float32, None) == \
+        kernel_param_cv("ici_chunk_bytes", "ICI_CHUNK_BYTES") // 4
+    _reload(MV2T_RMA_CHUNK_BYTES="256")
+    assert pallas_rma._cfg_chunk_elems(jnp.float32, None) == 64
