@@ -615,6 +615,20 @@ class NativeSourcePass(LintPass):
             ("MV2T_FLAT2_LANES", "trace_native._FLAT2_LANES"),
             ("MV2T_FLAT2_SUB_STRIDE", "trace_native._FLAT2_SUB_STRIDE"),
             ("MV2T_FLAT2_REG_STRIDE", "trace_native._FLAT2_REG_STRIDE"),
+            # continuous-metrics ring geometry (metrics/ring.py writes
+            # AND reads the segment from the trace/native.py mirrors —
+            # a drifted stride tears every sampled row)
+            ("MV2T_MET_FILE_HDR", "trace_native._MET_FILE_HDR"),
+            ("MV2T_MET_HDR_BYTES", "trace_native._MET_HDR_BYTES"),
+            ("MV2T_MET_SLOTS", "trace_native._MET_SLOTS"),
+            ("MV2T_MET_PV_BASE", "trace_native._MET_PV_BASE"),
+            ("MV2T_MET_ROW_BYTES", "trace_native._MET_ROW_BYTES"),
+            ("MV2T_MET_RING_ROWS", "trace_native._MET_RING_ROWS"),
+            ("MV2T_MET_NHIST", "trace_native._MET_NHIST"),
+            ("MV2T_MET_HIST_BUCKETS", "trace_native._MET_HIST_BUCKETS"),
+            ("MV2T_MET_HIST_HDR", "trace_native._MET_HIST_HDR"),
+            ("MV2T_MET_HIST_BYTES", "trace_native._MET_HIST_BYTES"),
+            ("MV2T_MET_RANK_STRIDE", "trace_native._MET_RANK_STRIDE"),
         ]
         for cname, pyname in pairs:
             if cname not in defines:
@@ -737,6 +751,22 @@ class NativeSourcePass(LintPass):
                 defines.get("MV2T_FLAT2_NREG", 0)
                 * defines.get("MV2T_FLAT2_LANES", 0)
                 * defines.get("MV2T_FLAT2_REG_STRIDE", 0),
+            # continuous-metrics segment: the row is a 16-byte stamp
+            # header + the value slots; the per-rank stride covers
+            # header + ring + histogram area; the pvar slot window
+            # starts right after the verbatim fpctr mirror
+            "MV2T_MET_PV_BASE": defines.get("MV2T_FPC_SLOTS", 0),
+            "MV2T_MET_ROW_BYTES":
+                16 + defines.get("MV2T_MET_SLOTS", 0) * 8,
+            "MV2T_MET_HIST_BYTES":
+                defines.get("MV2T_MET_HIST_HDR", 0)
+                + defines.get("MV2T_MET_HIST_BUCKETS", 0) * 8,
+            "MV2T_MET_RANK_STRIDE":
+                defines.get("MV2T_MET_HDR_BYTES", 0)
+                + defines.get("MV2T_MET_RING_ROWS", 0)
+                * defines.get("MV2T_MET_ROW_BYTES", 0)
+                + defines.get("MV2T_MET_NHIST", 0)
+                * defines.get("MV2T_MET_HIST_BYTES", 0),
         }
         for name, want_v in derived.items():
             if name in defines and defines[name] != want_v:
@@ -928,7 +958,11 @@ def _python_layout() -> Dict[str, object]:
         for n in ("_NTR_FILE_HDR", "_NTR_HDR_BYTES", "_NTR_EV_BYTES",
                   "_NTR_RING_EVENTS", "_FLAT2_GROUP", "_FLAT2_NGROUPS",
                   "_FLAT2_MAX", "_FLAT2_MCAST_NBUF", "_FLAT2_LANES",
-                  "_FLAT2_SUB_STRIDE", "_FLAT2_REG_STRIDE"):
+                  "_FLAT2_SUB_STRIDE", "_FLAT2_REG_STRIDE",
+                  "_MET_FILE_HDR", "_MET_HDR_BYTES", "_MET_SLOTS",
+                  "_MET_PV_BASE", "_MET_ROW_BYTES", "_MET_RING_ROWS",
+                  "_MET_NHIST", "_MET_HIST_BUCKETS", "_MET_HIST_HDR",
+                  "_MET_HIST_BYTES", "_MET_RANK_STRIDE"):
             v = _py_const(nt_tree, n)
             if v is not None:
                 out[f"trace_native.{n}"] = v

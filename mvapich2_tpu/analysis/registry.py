@@ -51,7 +51,10 @@ INTERNAL_ENV: Set[str] = {
     # friends): which cc/f90 to exec, not runtime knobs
     "MV2T_CC", "MV2T_CXX", "MV2T_FC",
 }
-INTERNAL_PREFIXES = ("MV2T_DEBUG_", "MV2T_STASH_")
+# MV2T_MET_*: the metrics-segment layout #define namespace
+# (native/shm_layout.h, doc-referenced) — cross-language constants
+# pinned by the layout doctor, not env tunables
+INTERNAL_PREFIXES = ("MV2T_DEBUG_", "MV2T_STASH_", "MV2T_MET_")
 
 # env-drift doctor: the committed non-python surfaces scanned by
 # default (native getenv reads; MV2T_* tokens in bin/ and the README)

@@ -8,11 +8,13 @@ tuning layer's per-comm function table (comm.coll_fns — the
 
 from __future__ import annotations
 
+import time as _time
 from typing import List, Optional, Sequence
 
 
 import numpy as np
 
+from .. import metrics as _metrics
 from ..core.datatype import Datatype, as_bytes_view, from_numpy_dtype
 from ..core.errors import MPIException, MPI_ERR_OP, MPI_ERR_ROOT, mpi_assert
 from ..core.op import Op
@@ -205,7 +207,11 @@ def bcast(comm, buf, count: int, datatype: Optional[Datatype],
     else:
         tag = comm.next_coll_tag()
         fn = _select(comm, "bcast", nbytes)
+    mx = _metrics.LIVE
+    t0 = _time.perf_counter() if mx is not None else 0.0
     fn(comm, data, root, tag)
+    if mx is not None:
+        mx.rec_since("lat_coll_sched", t0)
     if comm.rank != root or not datatype.is_contiguous:
         datatype.unpack(data, buf, count)
 
@@ -270,12 +276,18 @@ def allreduce(comm, sendbuf, recvbuf, count: int,
                                      count=n).view(datatype.basic)
         except (ValueError, TypeError):
             dest = None
+    mx = _metrics.LIVE
+    t0 = _time.perf_counter() if mx is not None else 0.0
     if dest is not None:
         out = fn(comm, arr, op, tag, out=dest)
+        if mx is not None:
+            mx.rec_since("lat_coll_sched", t0)
         if out is dest:
             return
     else:
         out = fn(comm, arr, op, tag)
+        if mx is not None:
+            mx.rec_since("lat_coll_sched", t0)
     _unpack(out, recvbuf, count, datatype)
 
 

@@ -441,6 +441,12 @@ class DeviceCollChannel:
         if dt > 0 and nbytes > 0:
             from .. import mpit
             mpit.pvar(f"dev_effbw_{tier}").mark(nbytes / dt / 1e9)
+        from .. import metrics as _metrics
+        mx = _metrics.LIVE
+        if mx is not None:
+            # per-tier latency distribution (the watermark above keeps
+            # only the peak; quantiles need the whole shape)
+            mx.rec_us(f"lat_dev_{tier}", dt * 1e6)
         return out
 
     # -- MPI-shaped entry points (match coll_fns signatures) -------------

@@ -38,10 +38,12 @@ python ranks keep the counter on the comm object.
 from __future__ import annotations
 
 import ctypes
+import time as _time
 from typing import Optional
 
 import numpy as np
 
+from .. import metrics as _metrics
 from ..core import op as opmod
 from ..core.errors import (MPIException, MPI_ERR_INTERN, MPI_ERR_TRUNCATE,
                            MPIX_ERR_PROC_FAILED)
@@ -259,6 +261,8 @@ def try_allreduce(pch, comm, arr: np.ndarray, op) -> Optional[np.ndarray]:
     out = np.empty_like(arr)
     fn = st.lib.cp_flat2_allreduce if st.tier == 2 \
         else st.lib.cp_flat_allreduce
+    mx = _metrics.LIVE
+    t0 = _time.perf_counter() if mx is not None else 0.0
     rc = fn(
         st.plane, st.ctx, st.lane, st.rank, st.size,
         ctypes.c_longlong(seq), opc, dtc, _ptr(arr), _ptr(out),
@@ -266,6 +270,9 @@ def try_allreduce(pch, comm, arr: np.ndarray, op) -> Optional[np.ndarray]:
     if rc != 0:
         _raise_rc(st, comm, rc)
         return None     # collateral abort: fall through to sched tier
+    if mx is not None:
+        mx.rec_since("lat_coll_flat2" if st.tier == 2
+                     else "lat_coll_flat", t0)
     return out
 
 
@@ -287,6 +294,8 @@ def try_reduce(pch, comm, arr: np.ndarray, op,
         return False, None
     out = np.empty_like(arr) if comm.rank == root else None
     fn = st.lib.cp_flat2_reduce if st.tier == 2 else st.lib.cp_flat_reduce
+    mx = _metrics.LIVE
+    t0 = _time.perf_counter() if mx is not None else 0.0
     rc = fn(
         st.plane, st.ctx, st.lane, st.rank, st.size,
         ctypes.c_longlong(seq), opc, dtc, root, _ptr(arr),
@@ -294,6 +303,9 @@ def try_reduce(pch, comm, arr: np.ndarray, op,
     if rc != 0:
         _raise_rc(st, comm, rc)
         return False, None   # collateral abort: sched tier retries
+    if mx is not None:
+        mx.rec_since("lat_coll_flat2" if st.tier == 2
+                     else "lat_coll_flat", t0)
     return True, out
 
 
@@ -307,6 +319,8 @@ def try_bcast(pch, comm, data: np.ndarray, root: int) -> bool:
     if seq <= 0:
         comm._flat_state = False
         return False
+    mx = _metrics.LIVE
+    t0 = _time.perf_counter() if mx is not None else 0.0
     if st.tier == 2:
         # sync=1 on the comm's first flat2 wave (seq == base + 1): the
         # mcast root runs a full arrival wave so no member's lazy base
@@ -328,6 +342,9 @@ def try_bcast(pch, comm, data: np.ndarray, root: int) -> bool:
     if rc != 0:
         _raise_rc(st, comm, rc)
         return False        # collateral abort: sched tier retries
+    if mx is not None:
+        mx.rec_since("lat_coll_flat2" if st.tier == 2
+                     else "lat_coll_flat", t0)
     return True
 
 
@@ -341,9 +358,14 @@ def try_barrier(pch, comm) -> bool:
         return False
     fn = st.lib.cp_flat2_barrier if st.tier == 2 \
         else st.lib.cp_flat_barrier
+    mx = _metrics.LIVE
+    t0 = _time.perf_counter() if mx is not None else 0.0
     rc = fn(st.plane, st.ctx, st.lane, st.rank,
             st.size, ctypes.c_longlong(seq))
     if rc != 0:
         _raise_rc(st, comm, rc)
         return False        # collateral abort: sched tier retries
+    if mx is not None:
+        mx.rec_since("lat_coll_flat2" if st.tier == 2
+                     else "lat_coll_flat", t0)
     return True

@@ -1,0 +1,112 @@
+"""Continuous serving telemetry — the always-on observability layer.
+
+Everything before this package answered "what happened" after the
+fact (Perfetto dumps at Finalize) or "what is true right now"
+(bin/mpistat point snapshots).  This package answers "what has this
+node been doing for the last five minutes" while jobs are running:
+
+  * :mod:`.hist` — log2-bucketed latency distribution math shared by
+    the :class:`mvapich2_tpu.mpit.HistPVar` pvar class, the exporter,
+    and the CLIs (merge / quantile / Prometheus bucket edges);
+  * :mod:`.ring` — reader/writer for the per-rank mmap'd time-series
+    ring in the ``<ring>.metrics`` segment (geometry pinned by the
+    mv2tlint layout doctor against ``native/shm_layout.h``);
+  * :mod:`.sampler` — the per-rank sampler that rides the shm
+    heartbeat thread and snapshots the fp_* counter mirror, selected
+    python pvars, and every latency histogram into that segment;
+  * :mod:`.export` — node-level aggregation (daemon manifest +
+    merged rank histograms) rendered as JSON or Prometheus text, the
+    backing for the daemon's ``metrics`` verb and ``bin/mpimetrics``.
+
+Hot-path contract (the trace-off discipline): recording sites pay ONE
+module-attribute check when telemetry is off::
+
+    mx = _metrics.LIVE
+    if mx is not None:
+        mx.rec_since("lat_coll_flat", t0)
+
+``LIVE`` is ``None`` until :func:`ensure_live` runs with
+``MV2T_METRICS=1`` (the default).  ``tests/progs/trace_overhead_prog.py``
+budgets the off-branch cost alongside the tracer gates.
+
+Stdlib-only on purpose: the daemon's light-boot path imports this
+package (claim attach/queue histograms), and test_cabi.py guards that
+path against heavyweight imports.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from .. import mpit as _mpit
+from ..trace.native import _MET_HISTS
+from ..utils.config import get_config
+
+#: The single telemetry gate. ``None`` = off (sites pay one attribute
+#: check); a :class:`_Live` once :func:`ensure_live` has run under
+#: MV2T_METRICS=1. Module-global on purpose — same idiom as the
+#: tracer's one-attribute-check guard.
+LIVE: Optional["_Live"] = None
+
+
+class _Live:
+    """Prefetched histogram pvars + the record helpers the hot sites
+    call. One dict lookup + one :meth:`HistPVar.rec` per record — no
+    allocation, no registry lock (the pvars are fetched once here)."""
+
+    __slots__ = ("hists",)
+
+    def __init__(self) -> None:
+        # dynamic-name fetch on purpose: the declarations live in
+        # mpit.py's telemetry block; sites never fetch by literal name
+        self.hists = {n: _mpit.pvar(n) for n in _MET_HISTS}
+
+    def rec_us(self, name: str, us: float) -> None:
+        """Record a microsecond latency into histogram ``name``
+        (unknown names are dropped — device tiers are open-ended)."""
+        h = self.hists.get(name)
+        if h is not None:
+            h.rec(int(us))
+
+    def rec_since(self, name: str, t0: float) -> None:
+        """Record elapsed ``time.perf_counter() - t0`` seconds, in us."""
+        h = self.hists.get(name)
+        if h is not None:
+            h.rec(int((time.perf_counter() - t0) * 1e6))
+
+
+def enabled() -> bool:
+    """MV2T_METRICS gate (default on)."""
+    try:
+        return int(get_config().get("METRICS", 1) or 0) > 0
+    except Exception:
+        return False
+
+
+def interval_s() -> float:
+    """Sampler period in seconds (MV2T_METRICS_INTERVAL_MS, floored at
+    20 ms so a typo can't busy-spin the heartbeat thread)."""
+    try:
+        ms = int(get_config().get("METRICS_INTERVAL_MS", 250) or 250)
+    except Exception:
+        ms = 250
+    return max(0.02, ms / 1000.0)
+
+
+def ensure_live() -> Optional["_Live"]:
+    """Idempotently arm the telemetry gate (no-op when MV2T_METRICS=0).
+
+    Called from the three attach points: universe initialize (trace
+    attach phase), ShmChannel construction, and the daemon claim path
+    — whichever runs first wins."""
+    global LIVE
+    if LIVE is None and enabled():
+        LIVE = _Live()
+    return LIVE
+
+
+def _reset() -> None:
+    """Test hook: drop the gate so a re-configured process re-arms."""
+    global LIVE
+    LIVE = None

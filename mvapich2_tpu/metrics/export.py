@@ -1,0 +1,297 @@
+"""Node metrics exporter — aggregation + JSON / Prometheus rendering.
+
+Backs two consumers:
+
+  * the daemon serve loop's ``metrics`` verb on ``daemon.sock``
+    (:func:`node_snapshot` + :func:`to_prometheus`), so one scrape per
+    node covers every job the daemon is serving;
+  * ``bin/mpimetrics``, which prefers the socket (the daemon holds the
+    authoritative manifest view) and falls back to reading the shm
+    segments directly when nothing is serving — same
+    attach-not-construct discipline as mpistat, nothing perturbs the
+    jobs being scraped.
+
+The node view merges three planes: the daemon manifest (occupancy,
+queue, per-job claim attribution), the exec cache (hit/miss totals
+summed from each rank's sampled counters), and the per-rank metrics
+rings (latest counter rows + log2 latency histograms, merged across
+ranks and jobs — merge is element-wise bucket addition, so any order
+gives the same answer).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from typing import Any, Dict, List, Optional
+
+from ..trace import mpistat as _mpistat
+from ..trace.native import _MET_HISTS
+from . import hist as _hist
+from . import ring as _ring
+
+
+# ---------------------------------------------------------------------------
+# aggregation
+# ---------------------------------------------------------------------------
+
+def _daemon_section(daemon_dir: Optional[str]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"alive": False, "sets": 0, "busy": 0,
+                           "queue_depth": 0, "jobs": []}
+    if daemon_dir is None:
+        try:
+            from ..runtime.daemon import default_dir
+            daemon_dir = default_dir()
+        except Exception:
+            return out
+    out["dir"] = daemon_dir
+    try:
+        with open(os.path.join(daemon_dir, "manifest.json")) as f:
+            m = json.load(f)
+    except (OSError, ValueError):
+        return out
+    pid = m.get("daemon_pid", 0)
+    if pid:
+        try:
+            os.kill(pid, 0)
+            out["alive"] = True
+        except OSError:
+            pass
+    sets = m.get("sets", {})
+    out["sets"] = len(sets)
+    out["busy"] = sum(1 for s in sets.values()
+                      if s.get("state") == "busy")
+    out["queue_depth"] = len(m.get("queue", []))
+    for key, s in sorted(sets.items()):
+        if s.get("state") != "busy":
+            continue
+        out["jobs"].append({"set": key, "owner_pid": s.get("owner_pid"),
+                            "epoch": s.get("epoch"),
+                            "geokey": s.get("geokey")})
+    try:
+        from ..runtime.daemon import exec_cache_stats
+        out["exec_cache"] = exec_cache_stats(daemon_dir)
+    except Exception:
+        pass
+    return out
+
+
+def _job_section(stem: str) -> Optional[Dict[str, Any]]:
+    """One job's metrics-segment view: per-rank latest row (+ deltas vs
+    the previous row, for rate panels) and merged histograms."""
+    path = stem + ".metrics"
+    ranks = _ring.read_all(path)
+    if not ranks:
+        return None
+    names = _ring.slot_names()
+    job: Dict[str, Any] = {"stem": stem, "ranks": {}, "hists": {}}
+    merged: Dict[str, List[Any]] = {}
+    for i, d in sorted(ranks.items()):
+        rows = d["rows"]
+        rk: Dict[str, Any] = {}
+        if rows:
+            ts, vals = rows[-1]
+            rk["ts_us"] = ts
+            rk["values"] = {nm: v for nm, v in zip(names, vals) if nm}
+            if len(rows) >= 2:
+                pts, pvals = rows[-2]
+                dt = max(1e-6, (ts - pts) / 1e6)
+                rk["interval_s"] = round(dt, 3)
+                rk["deltas"] = {
+                    nm: v - pv for nm, (v, pv) in
+                    ((n, (a, b)) for n, a, b in
+                     zip(names, vals, pvals)) if nm and v != pv}
+        if d["hists"]:
+            rk["hists"] = {
+                nm: _hist.summarize(c, s, b)
+                for nm, (c, s, b) in sorted(d["hists"].items())}
+            for nm, (c, s, b) in d["hists"].items():
+                if nm in merged:
+                    m = merged[nm]
+                    m[0] += c
+                    m[1] += s
+                    m[2] = _hist.merge(m[2], b)
+                else:
+                    merged[nm] = [c, s, list(b)]
+        job["ranks"][i] = rk
+    job["hists"] = {nm: dict(_hist.summarize(c, s, b), buckets=b)
+                    for nm, (c, s, b) in sorted(merged.items())}
+    return job
+
+
+def node_snapshot(daemon_dir: Optional[str] = None,
+                  seg: Optional[str] = None) -> Dict[str, Any]:
+    """The full node aggregate, JSON-serializable."""
+    snap: Dict[str, Any] = {"ts": time.time(),
+                            "daemon": _daemon_section(daemon_dir),
+                            "jobs": [], "hists": {}}
+    merged: Dict[str, List[Any]] = {}
+    cache_hits = cache_misses = 0
+    for stem in _mpistat.find_segments(seg, daemon_dir):
+        job = _job_section(stem)
+        if job is None:
+            continue
+        snap["jobs"].append(job)
+        for rk in job["ranks"].values():
+            vals = rk.get("values") or {}
+            cache_hits += int(vals.get("exec_cache_hits", 0))
+            cache_misses += int(vals.get("exec_cache_misses", 0))
+        for nm in job["hists"]:
+            c = job["hists"][nm]
+            if nm in merged:
+                m = merged[nm]
+                m[0] += c["count"]
+                m[1] += c["sum_us"]
+                m[2] = _hist.merge(m[2], c["buckets"])
+            else:
+                merged[nm] = [c["count"], c["sum_us"],
+                              list(c["buckets"])]
+    snap["hists"] = {nm: dict(_hist.summarize(int(c), int(s), b),
+                              buckets=b)
+                     for nm, (c, s, b) in sorted(merged.items())}
+    total = cache_hits + cache_misses
+    snap["exec_cache_sampled"] = {
+        "hits": cache_hits, "misses": cache_misses,
+        "hit_rate": (cache_hits / total) if total else 0.0}
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+def _esc(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"')
+
+
+def to_prometheus(snap: Dict[str, Any]) -> str:
+    """Render a node snapshot in Prometheus text exposition format
+    (histograms as the standard cumulative ``_bucket{le=}`` series
+    with log2 upper edges)."""
+    lines: List[str] = []
+
+    def gauge(name: str, value: float, help_: str,
+              labels: str = "") -> None:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{labels} {value}")
+
+    d = snap.get("daemon", {})
+    gauge("mv2t_daemon_alive", 1.0 if d.get("alive") else 0.0,
+          "1 when a warm-attach daemon serves this node")
+    gauge("mv2t_daemon_sets_busy", float(d.get("busy", 0)),
+          "segment sets currently claimed (occupancy)")
+    gauge("mv2t_daemon_sets_provisioned", float(d.get("sets", 0)),
+          "segment sets provisioned in the manifest")
+    gauge("mv2t_daemon_queue_depth", float(d.get("queue_depth", 0)),
+          "claim requests waiting in the admission queue")
+    ec = d.get("exec_cache") or {}
+    if ec:
+        gauge("mv2t_exec_cache_entries", float(ec.get("entries", 0)),
+              "device executables in the daemon exec cache")
+        gauge("mv2t_exec_cache_bytes", float(ec.get("bytes", 0)),
+              "bytes held by the daemon exec cache")
+    ecs = snap.get("exec_cache_sampled") or {}
+    gauge("mv2t_exec_cache_hit_rate", float(ecs.get("hit_rate", 0.0)),
+          "exec-cache hit rate summed from rank-sampled counters")
+    gauge("mv2t_jobs", float(len(snap.get("jobs", []))),
+          "jobs with a live metrics segment on this node")
+    for job in snap.get("jobs", []):
+        stem = _esc(os.path.basename(str(job.get("stem", ""))))
+        lines.append(
+            f'mv2t_job_ranks{{job="{stem}"}} {len(job.get("ranks", {}))}')
+
+    hists = snap.get("hists", {})
+    if hists:
+        lines.append("# HELP mv2t_latency_us log2-bucketed operation "
+                     "latency (microseconds), merged across ranks")
+        lines.append("# TYPE mv2t_latency_us histogram")
+    for nm in _MET_HISTS:
+        h = hists.get(nm)
+        if not h:
+            continue
+        lab = f'hist="{_esc(nm)}"'
+        acc = 0
+        for i, c in enumerate(h.get("buckets", [])):
+            if not c:
+                continue
+            acc += int(c)
+            le = _hist.hist_bucket_hi(i)
+            lines.append(
+                f'mv2t_latency_us_bucket{{{lab},le="{le}"}} {acc}')
+        lines.append(
+            f'mv2t_latency_us_bucket{{{lab},le="+Inf"}} {int(h["count"])}')
+        lines.append(f'mv2t_latency_us_sum{{{lab}}} {int(h["sum_us"])}')
+        lines.append(f'mv2t_latency_us_count{{{lab}}} {int(h["count"])}')
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# daemon.sock scrape client + CLI (bin/mpimetrics)
+# ---------------------------------------------------------------------------
+
+def scrape_daemon(daemon_dir: Optional[str] = None,
+                  fmt: str = "json",
+                  timeout: float = 2.0) -> Optional[str]:
+    """Ask a serving daemon for its node aggregate; None when nothing
+    answers (caller falls back to a direct segment read)."""
+    if daemon_dir is None:
+        try:
+            from ..runtime.daemon import default_dir
+            daemon_dir = default_dir()
+        except Exception:
+            return None
+    path = os.path.join(daemon_dir, "daemon.sock")
+    if not os.path.exists(path):
+        return None
+    try:
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+            s.settimeout(timeout)
+            s.connect(path)
+            s.sendall((json.dumps({"op": "metrics", "fmt": fmt})
+                       + "\n").encode())
+            chunks = []
+            while True:
+                b = s.recv(65536)
+                if not b:
+                    break
+                chunks.append(b)
+        text = b"".join(chunks).decode()
+        return text if text.strip() else None
+    except OSError:
+        return None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="mpimetrics",
+        description="scrape one node's continuous serving telemetry "
+                    "(daemon aggregates + per-job latency histograms) "
+                    "as JSON or Prometheus text")
+    ap.add_argument("--daemon-dir", default=None,
+                    help="warm-attach daemon dir (default: the "
+                         "MV2T_DAEMON_DIR default)")
+    ap.add_argument("--seg", default=None,
+                    help="scrape one segment stem directly instead of "
+                         "everything the node serves")
+    ap.add_argument("--format", choices=("json", "prom"),
+                    default="json", help="output format (default json)")
+    ap.add_argument("--no-sock", action="store_true",
+                    help="skip the daemon.sock scrape and read the shm "
+                         "segments directly")
+    opts = ap.parse_args(argv)
+
+    if not opts.no_sock and opts.seg is None:
+        text = scrape_daemon(opts.daemon_dir, fmt=opts.format)
+        if text is not None:
+            print(text, end="" if text.endswith("\n") else "\n")
+            return 0
+    snap = node_snapshot(daemon_dir=opts.daemon_dir, seg=opts.seg)
+    if opts.format == "prom":
+        print(to_prometheus(snap), end="")
+    else:
+        print(json.dumps(snap, indent=2, sort_keys=True))
+    return 0

@@ -1,0 +1,98 @@
+"""Log2-bucketed latency distribution math.
+
+One histogram = 32 buckets of microsecond latencies: bucket 0 holds
+v <= 0, bucket i >= 1 holds [2^(i-1), 2^i - 1], the last bucket
+saturates (v >= 2^30 us ~= 18 minutes).  The shape is chosen so that
+
+  * record is branch-free-ish integer work (``int.bit_length``), no
+    floats, no allocation — safe on every hot path;
+  * powers of two land EXACTLY on bucket lower edges, so the bucket
+    grammar is auditable (tests/test_metrics.py pins this);
+  * merge across ranks/jobs is element-wise addition — associative and
+    commutative, so any aggregation order gives the same node view;
+  * quantiles interpolate inside one bucket, bounding the estimate
+    error by the bucket width (a factor of 2 worst case, much tighter
+    in practice for smooth distributions).
+
+Functions here operate on plain ``(count, sum, buckets)`` triples /
+bucket lists so the exporter can merge histograms read from shm rings
+of OTHER processes, not just this process's HistPVar objects.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Sequence
+
+from ..mpit import HIST_BUCKETS, hist_bucket_index, hist_bucket_lo
+
+__all__ = [
+    "HIST_BUCKETS", "hist_bucket_index", "hist_bucket_lo",
+    "hist_bucket_hi", "merge", "merge_all", "quantile", "summarize",
+]
+
+
+def hist_bucket_hi(i: int) -> int:
+    """Inclusive upper edge of bucket ``i`` (2^i - 1; the saturating
+    last bucket reports a nominal 2x-lo edge)."""
+    if i <= 0:
+        return 0
+    if i >= HIST_BUCKETS - 1:
+        return hist_bucket_lo(HIST_BUCKETS - 1) * 2
+    return (1 << i) - 1
+
+
+def merge(a: Sequence[int], b: Sequence[int]) -> List[int]:
+    """Element-wise bucket sum (associative + commutative)."""
+    return [int(x) + int(y) for x, y in zip(a, b)]
+
+
+def merge_all(hists: Iterable[Sequence[int]]) -> List[int]:
+    out = [0] * HIST_BUCKETS
+    for h in hists:
+        for i, v in enumerate(h):
+            if i >= HIST_BUCKETS:
+                break
+            out[i] += int(v)
+    return out
+
+
+def quantile(buckets: Sequence[int], q: float) -> float:
+    """Estimate the ``q``-quantile (0..1) of the recorded values.
+
+    Finds the bucket holding the q-th sample and interpolates linearly
+    within its [lo, hi] span — exact for q landing on a bucket edge,
+    within one bucket width otherwise."""
+    total = sum(int(v) for v in buckets)
+    if total <= 0:
+        return 0.0
+    q = min(1.0, max(0.0, q))
+    # 1-based rank of the wanted sample
+    target = q * (total - 1) + 1.0
+    acc = 0
+    for i, c in enumerate(buckets):
+        c = int(c)
+        if not c:
+            continue
+        if acc + c >= target:
+            lo = float(hist_bucket_lo(i))
+            hi = float(hist_bucket_hi(i))
+            if c == 1 or hi <= lo:
+                return lo
+            frac = (target - acc - 1.0) / (c - 1)
+            return lo + (hi - lo) * frac
+        acc += c
+    return float(hist_bucket_hi(HIST_BUCKETS - 1))
+
+
+def summarize(count: int, total_us: int,
+              buckets: Sequence[int]) -> Dict[str, float]:
+    """The scrape-facing digest: count, mean, p50/p90/p99 (us)."""
+    count = int(count)
+    return {
+        "count": float(count),
+        "sum_us": float(total_us),
+        "mean_us": (float(total_us) / count) if count else 0.0,
+        "p50_us": quantile(buckets, 0.50),
+        "p90_us": quantile(buckets, 0.90),
+        "p99_us": quantile(buckets, 0.99),
+    }

@@ -1,0 +1,245 @@
+"""Reader/writer for the ``<ring>.metrics`` time-series segment.
+
+Layout (``native/shm_layout.h`` ``MV2T_MET_*``, python mirror in
+``trace/native.py`` — the mv2tlint layout doctor pins both sides)::
+
+    [64B file hdr]                                  (reserved, zero)
+    n_local x {
+        [64B rank hdr]        u64 row seq @0 (monotonic, never wraps)
+        [256 rows x 256B]     the sampler time-series ring
+        [16 blocks x 320B]    latency histogram mirrors
+    }
+
+Row = ``u64 ts_us | u32 claim | u32 rsv | 30 x u64 slots``; slots 0-15
+mirror the fp_* fast-path counter row verbatim, slots 16+ follow
+``trace/native._MET_PVARS``.  Writes use the ntrace release-store
+discipline: zero the ts word, fill the body, stamp the claim (low 32
+bits of the row seq), store ts LAST — a reader that sees ts == 0 or a
+claim that does not match the ring index it computed dropped a torn or
+half-overwritten row, never a garbled one.  Histogram blocks
+(``u64 count @0 | u64 sum_us @8 | ... | 32 x u64 buckets @64``) carry
+monotonic counters and follow the fp-mirror stat-surface tolerance
+instead: a reader may see a bucket row mid-update and be off by the
+in-flight records — fine for a stat surface, monotonicity repairs it
+on the next scrape.
+
+Single writer per rank region (the owning rank's sampler); any number
+of read-only mappers (mpistat --watch, mpimetrics, the daemon's
+metrics verb) — attach-not-construct, nothing the job can observe.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import struct
+from typing import Any, BinaryIO, Dict, List, Optional, Sequence, Tuple, Union
+
+from ..trace.native import (
+    _MET_FILE_HDR, _MET_HDR_BYTES, _MET_HIST_BUCKETS, _MET_HIST_BYTES,
+    _MET_HIST_HDR, _MET_HISTS, _MET_NHIST, _MET_PV_BASE, _MET_PVARS,
+    _MET_RANK_STRIDE, _MET_RING_ROWS, _MET_ROW_BYTES, _MET_SLOTS,
+)
+
+_U64 = struct.Struct("<Q")
+_U32 = struct.Struct("<I")
+_ROW_BODY = struct.Struct("<%dQ" % _MET_SLOTS)
+_HIST_HDR = struct.Struct("<QQ")
+_HIST_BODY = struct.Struct("<%dQ" % _MET_HIST_BUCKETS)
+_MASK64 = (1 << 64) - 1
+
+
+def file_len(n_local: int) -> int:
+    return _MET_FILE_HDR + n_local * _MET_RANK_STRIDE
+
+
+def n_local_from_size(size: int) -> Optional[int]:
+    """Invert file_len (strict in n) — lets readers size a segment
+    without the job's cooperation, mpistat-style."""
+    body = size - _MET_FILE_HDR
+    if body <= 0 or body % _MET_RANK_STRIDE:
+        return None
+    return body // _MET_RANK_STRIDE
+
+
+def rank_base(i: int) -> int:
+    return _MET_FILE_HDR + i * _MET_RANK_STRIDE
+
+
+def hist_base(i: int) -> int:
+    return rank_base(i) + _MET_HDR_BYTES + _MET_RING_ROWS * _MET_ROW_BYTES
+
+
+def slot_names() -> List[str]:
+    """Row slot names in slot order: the fp_* mirror row, then the
+    sampled python pvars."""
+    from ..trace.mpistat import FP_NAMES
+    names = list(FP_NAMES) + [""] * (_MET_PV_BASE - len(FP_NAMES))
+    names += list(_MET_PVARS)
+    return names[:_MET_SLOTS] + [""] * max(0, _MET_SLOTS - len(names))
+
+
+class RingWriter:
+    """Single-writer appender for one rank's region of a mapped
+    metrics segment (``buf`` is the whole-file mmap)."""
+
+    __slots__ = ("buf", "base", "hbase", "seq")
+
+    def __init__(self, buf: Any, rank_index: int) -> None:
+        self.buf = buf
+        self.base = rank_base(rank_index)
+        self.hbase = hist_base(rank_index)
+        self.seq = 0
+        # fresh epoch: daemon segment sets are reused across jobs, so
+        # scrub THIS rank's region (prior-epoch rows must not leak
+        # into the new job's series); other ranks' regions are theirs
+        self.buf[self.base:self.base + _MET_RANK_STRIDE] = (
+            b"\0" * _MET_RANK_STRIDE)
+
+    def append(self, ts_us: int, values: Sequence[int]) -> None:
+        """Publish one sample row (release-store-ts-last)."""
+        buf = self.buf
+        idx = self.seq
+        off = (self.base + _MET_HDR_BYTES
+               + (idx % _MET_RING_ROWS) * _MET_ROW_BYTES)
+        _U64.pack_into(buf, off, 0)                   # invalidate slot
+        row = [int(v) & _MASK64 for v in values[:_MET_SLOTS]]
+        if len(row) < _MET_SLOTS:
+            row += [0] * (_MET_SLOTS - len(row))
+        _ROW_BODY.pack_into(buf, off + 16, *row)
+        self.seq = idx + 1
+        _U64.pack_into(buf, self.base, self.seq)      # header row seq
+        _U32.pack_into(buf, off + 8, idx & 0xFFFFFFFF)  # claim stamp
+        _U64.pack_into(buf, off, int(ts_us))          # ts LAST
+
+    def write_hist(self, h: int, count: int, total_us: int,
+                   buckets: Sequence[int]) -> None:
+        """Mirror one histogram block (stat-surface discipline: plain
+        stores of monotonic counters, no claim protocol)."""
+        off = self.hbase + h * _MET_HIST_BYTES
+        _HIST_HDR.pack_into(self.buf, off, int(count) & _MASK64,
+                            int(total_us) & _MASK64)
+        row = [int(v) & _MASK64 for v in buckets[:_MET_HIST_BUCKETS]]
+        if len(row) < _MET_HIST_BUCKETS:
+            row += [0] * (_MET_HIST_BUCKETS - len(row))
+        _HIST_BODY.pack_into(self.buf, off + _MET_HIST_HDR, *row)
+
+
+# ---------------------------------------------------------------------------
+# readers (attach-not-construct: a path or an already-held file object)
+# ---------------------------------------------------------------------------
+
+def _open_ro(path_or_file: Union[str, BinaryIO]):
+    stack = contextlib.ExitStack()
+    if isinstance(path_or_file, str):
+        f = stack.enter_context(open(path_or_file, "rb"))
+    else:
+        f = path_or_file
+    return stack, f
+
+
+def read_rows(path_or_file: Union[str, BinaryIO], rank_index: int,
+              last: Optional[int] = None
+              ) -> List[Tuple[int, List[int]]]:
+    """Valid sample rows for one rank, oldest first, as
+    ``(ts_us, [slot values])``.  Torn rows (ts == 0 or claim/seq
+    mismatch — the writer was mid-overwrite) are dropped, mirroring
+    ``trace.native.read_ring``."""
+    stack, f = _open_ro(path_or_file)
+    with stack:
+        base = rank_base(rank_index)
+        f.seek(base)
+        hdr = f.read(_MET_HDR_BYTES)
+        if len(hdr) < _MET_HDR_BYTES:
+            return []
+        seq = _U64.unpack_from(hdr, 0)[0]
+        if seq == 0:
+            return []
+        n = min(seq, _MET_RING_ROWS)
+        if last is not None:
+            n = min(n, last)
+        f.seek(base + _MET_HDR_BYTES)
+        body = f.read(_MET_RING_ROWS * _MET_ROW_BYTES)
+        out: List[Tuple[int, List[int]]] = []
+        for k in range(n):
+            idx = seq - n + k
+            off = (idx % _MET_RING_ROWS) * _MET_ROW_BYTES
+            if off + _MET_ROW_BYTES > len(body):
+                continue
+            ts_us = _U64.unpack_from(body, off)[0]
+            claim = _U32.unpack_from(body, off + 8)[0]
+            if ts_us == 0 or claim != (idx & 0xFFFFFFFF):
+                continue            # torn / mid-overwrite: drop, never garble
+            out.append((ts_us, list(_ROW_BODY.unpack_from(body, off + 16))))
+        return out
+
+
+def read_hists(path_or_file: Union[str, BinaryIO], rank_index: int
+               ) -> Dict[str, Tuple[int, int, List[int]]]:
+    """One rank's histogram blocks as ``name -> (count, sum_us,
+    buckets)``; empty blocks (count == 0) are omitted."""
+    stack, f = _open_ro(path_or_file)
+    with stack:
+        f.seek(hist_base(rank_index))
+        body = f.read(_MET_NHIST * _MET_HIST_BYTES)
+        out: Dict[str, Tuple[int, int, List[int]]] = {}
+        for h, name in enumerate(_MET_HISTS):
+            off = h * _MET_HIST_BYTES
+            if off + _MET_HIST_BYTES > len(body):
+                break
+            count, total = _HIST_HDR.unpack_from(body, off)
+            if not count:
+                continue
+            buckets = list(_HIST_BODY.unpack_from(body, off + _MET_HIST_HDR))
+            out[name] = (int(count), int(total), buckets)
+        return out
+
+
+def read_all(path: str) -> Dict[int, Dict[str, Any]]:
+    """Every rank's tail rows + histograms from a segment path (the
+    exporter's bulk read). Ranks with no published rows AND no
+    histogram records — e.g. C-ABI ranks, which have no python sampler
+    — are omitted."""
+    try:
+        size = int(__import__("os").path.getsize(path))
+    except OSError:
+        return {}
+    n = n_local_from_size(size)
+    if n is None:
+        return {}
+    out: Dict[int, Dict[str, Any]] = {}
+    try:
+        with open(path, "rb") as f:
+            for i in range(n):
+                rows = read_rows(f, i)
+                hists = read_hists(f, i)
+                if rows or hists:
+                    out[i] = {"rows": rows, "hists": hists}
+    except OSError:
+        return out
+    return out
+
+
+def channel_rows(channel: Any, last: Optional[int] = None
+                 ) -> List[Tuple[float, Dict[str, int]]]:
+    """This process's own sampler series via the channel's held fd
+    (named slots, ts in SECONDS) — the recorder/Perfetto embed hook."""
+    f = getattr(channel, "_metrics_f", None)
+    path = getattr(channel, "_metrics_path", None)
+    idx = getattr(channel, "local_index", {}).get(
+        getattr(channel, "my_rank", -1))
+    if idx is None:
+        return []
+    try:
+        if f is not None:
+            f.flush()
+            rows = read_rows(f, idx, last=last)
+        elif path is not None:
+            rows = read_rows(path, idx, last=last)
+        else:
+            return []
+    except (OSError, ValueError, struct.error):
+        return []
+    names = slot_names()
+    return [(ts / 1e6,
+             {nm: v for nm, v in zip(names, vals) if nm and v})
+            for ts, vals in rows]

@@ -1,0 +1,95 @@
+"""The per-rank metrics sampler — rides the shm heartbeat thread.
+
+No thread of its own: ``ShmChannel._hb_loop`` (the PR 6 liveness-lease
+stamper) calls :meth:`Sampler.maybe_tick` on every heartbeat wake, and
+the loop's wait period is clamped to ``min(heartbeat, interval)`` so a
+250 ms default interval costs at most a few extra Event.wait wakeups
+per second.  A tick is one fp-mirror slice copy, a dozen pvar reads,
+and ~600 bytes of struct packing — microseconds, amortized to nothing
+at the default interval.
+
+Snapshot per tick, all cumulative (readers difference consecutive rows
+for rates):
+
+  * slots 0-15:  this rank's fp_* shm counter-mirror row, verbatim;
+  * slots 16+:   ``trace/native._MET_PVARS`` python pvars by name;
+  * hist blocks: every ``_MET_HISTS`` HistPVar (count/sum/buckets),
+    mirrored so attach-not-construct readers get distributions from a
+    live, untraced job.
+
+Failures never propagate: a torn mmap at teardown or a missing pvar
+must not take the heartbeat (and with it fault detection) down.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional, Sequence
+
+from .. import mpit as _mpit
+from ..trace.native import _MET_HISTS, _MET_PV_BASE, _MET_PVARS
+from . import interval_s
+from .ring import RingWriter
+
+
+def _now_us() -> int:
+    return int(time.clock_gettime(time.CLOCK_MONOTONIC) * 1e6)
+
+
+class Sampler:
+    """Owns one rank's region of the metrics segment.
+
+    ``fpc_row`` returns this rank's 16-slot fp-mirror slice (or an
+    empty sequence when the native plane is off); ``now_us`` defaults
+    to CLOCK_MONOTONIC microseconds — the same axis ntrace stamps, so
+    Perfetto can lay samples and spans on one timeline."""
+
+    __slots__ = ("writer", "fpc_row", "now_us", "interval", "_next",
+                 "_pvs", "_hists", "dead")
+
+    def __init__(self, buf: Any, rank_index: int,
+                 fpc_row: Optional[Callable[[], Sequence[int]]] = None,
+                 now_us: Optional[Callable[[], int]] = None) -> None:
+        self.writer = RingWriter(buf, rank_index)
+        self.fpc_row = fpc_row
+        self.now_us = now_us or _now_us
+        self.interval = interval_s()
+        self._next = 0.0                       # first wake samples
+        # dynamic-name fetches (declared in mpit.py's telemetry block)
+        self._pvs = [_mpit.pvar(n) for n in _MET_PVARS]
+        self._hists = [_mpit.pvar(n) for n in _MET_HISTS]
+        self.dead = False
+
+    def maybe_tick(self, now: Optional[float] = None) -> bool:
+        """Heartbeat hook: sample if the interval elapsed. Never
+        raises — a failed tick marks the sampler dead (segment gone
+        at teardown) instead of killing the heartbeat thread."""
+        if self.dead:
+            return False
+        now = time.monotonic() if now is None else now
+        if now < self._next:
+            return False
+        self._next = now + self.interval
+        try:
+            self.tick()
+        except Exception:
+            self.dead = True
+            return False
+        return True
+
+    def tick(self) -> None:
+        """Unconditional sample: one ring row + every histogram block."""
+        row = [0] * _MET_PV_BASE
+        if self.fpc_row is not None:
+            src = self.fpc_row()
+            for i, v in enumerate(src[:_MET_PV_BASE]):
+                row[i] = int(v)
+        row += [int(pv.read()) for pv in self._pvs]
+        self.writer.append(self.now_us(), row)
+        for h, pv in enumerate(self._hists):
+            snap = getattr(pv, "snapshot", None)
+            if snap is None:
+                continue
+            count, total, buckets = snap()
+            if count:
+                self.writer.write_hist(h, count, total, buckets)

@@ -32,6 +32,7 @@ PVAR_CLASS_COUNTER = 0
 PVAR_CLASS_TIMER = 1
 PVAR_CLASS_LEVEL = 2
 PVAR_CLASS_HIGHWATERMARK = 3
+PVAR_CLASS_HISTOGRAM = 4
 
 
 # ---------------------------------------------------------------------------
@@ -129,6 +130,69 @@ class PVar:
                 self._value = 0.0
 
 
+HIST_BUCKETS = 32    # == MV2T_MET_HIST_BUCKETS (metrics shm mirror)
+
+
+def hist_bucket_index(v: int) -> int:
+    """Log2 bucket of a non-negative integer value: bucket 0 holds 0,
+    bucket i >= 1 holds [2**(i-1), 2**i - 1] — every power of two is
+    exactly a bucket's inclusive LOWER edge, so bucket boundaries are
+    value-exact (tested). Values past the last edge saturate into the
+    final bucket."""
+    i = v.bit_length() if v > 0 else 0
+    return i if i < HIST_BUCKETS else HIST_BUCKETS - 1
+
+
+def hist_bucket_lo(i: int) -> int:
+    """Inclusive lower edge of bucket ``i`` (0 for the zero bucket)."""
+    return 0 if i <= 0 else 1 << (i - 1)
+
+
+class HistPVar(PVar):
+    """PVAR_CLASS_HISTOGRAM: a log2-bucketed value distribution —
+    latency in integer microseconds by convention. ``rec`` is the
+    hot-path entry point: no lock, no allocation — one bit_length and
+    three integer bumps into preallocated storage. Concurrent
+    recorders may lose an increment in the GIL's read-modify-write
+    window; this is a stat surface with the same tolerance as the
+    fpctr shm mirror. Quantiles/merges over the bucket lists live in
+    metrics/hist.py (this module stays on the stdlib light-boot
+    path)."""
+
+    def __init__(self, name: str, klass: int, group: str, desc: str,
+                 source: Optional[Callable[[], float]] = None):
+        super().__init__(name, klass, group, desc, source)
+        self.buckets = [0] * HIST_BUCKETS
+        self.count = 0
+        self.sum = 0
+
+    def rec(self, v: int) -> None:
+        if v > 0:
+            i = v.bit_length()
+            self.buckets[i if i < HIST_BUCKETS else HIST_BUCKETS - 1] += 1
+            self.sum += v
+        else:
+            self.buckets[0] += 1
+        self.count += 1
+
+    def snapshot(self) -> tuple:
+        """(count, sum, buckets-copy) — consistent enough for the stat
+        surface (single GIL-held list copy)."""
+        return self.count, self.sum, list(self.buckets)
+
+    def read(self) -> float:
+        if self.source is not None:
+            return float(self.source())
+        return float(self.count)
+
+    def reset(self) -> None:
+        b = self.buckets
+        for i in range(HIST_BUCKETS):
+            b[i] = 0
+        self.count = 0
+        self.sum = 0
+
+
 class _PvarRegistry:
     def __init__(self):
         self._vars: Dict[str, PVar] = {}
@@ -139,7 +203,8 @@ class _PvarRegistry:
         with self._lock:
             pv = self._vars.get(name)
             if pv is None:
-                pv = PVar(name, klass, group, desc, source)
+                cls = HistPVar if klass == PVAR_CLASS_HISTOGRAM else PVar
+                pv = cls(name, klass, group, desc, source)
                 self._vars[name] = pv
             elif source is not None:
                 pv.source = source   # rebind live source (fresh universe)
@@ -477,6 +542,60 @@ pvar("exec_cache_misses", PVAR_CLASS_COUNTER, "runtime",
 pvar("exec_cache_bytes", PVAR_CLASS_COUNTER, "runtime",
      "bytes of serialized executables written into the daemon's "
      "exec-cache by this process")
+
+
+# ---------------------------------------------------------------------------
+# continuous serving telemetry (mvapich2_tpu/metrics). Declared HERE —
+# the daemon claim path records attach/queue histograms inside MPI_Init's
+# stdlib-only light boot, and this module is already on that path; the
+# owning modules (metrics/, coll/, rma/, transport/) fetch the
+# already-declared entries by name.
+# ---------------------------------------------------------------------------
+
+cvar("METRICS", 1, int, "metrics",
+     "Continuous serving telemetry: per-rank latency histograms "
+     "(PVAR_CLASS_HISTOGRAM) at the collective/rendezvous/RMA/daemon "
+     "sites plus the heartbeat-thread sampler that snapshots the fp_* "
+     "shm mirror and selected pvars into the <ring>.metrics "
+     "time-series segment for bin/mpistat --watch / bin/mpimetrics / "
+     "the daemon's `metrics` verb. 1 (default) = on; 0 = off — sites "
+     "then pay one attribute check, nothing else (the trace-off "
+     "discipline, guarded by tests/progs/trace_overhead_prog.py).")
+cvar("METRICS_INTERVAL_MS", 250, int, "metrics",
+     "Sampling period (milliseconds) of the metrics ring sampler. The "
+     "sampler rides the shm heartbeat thread (no thread of its own), "
+     "so the effective period is max(interval, heartbeat wait) and "
+     "never busier than ~20 ms.")
+
+for _h, _d in (
+    ("lat_coll_flat", "host flat-tier collective wave latency "
+     "(coll/flatcoll.py try_* around the cp_flat_* call)"),
+    ("lat_coll_flat2", "host hierarchical flat2-tier collective wave "
+     "latency (coll/flatcoll.py try_* around the cp_flat2_* call)"),
+    ("lat_coll_sched", "host scheduled-algorithm collective latency "
+     "(coll/api.py dispatch around the pt2pt schedule)"),
+    ("lat_dev_vmem", "device collective latency on the VMEM flat ring "
+     "tier (coll/device.py _run end-to-end)"),
+    ("lat_dev_hbm", "device collective latency on the HBM-streaming "
+     "chunked ring tier (coll/device.py _run end-to-end)"),
+    ("lat_dev_quant", "device collective latency on the block-scaled "
+     "quantized wire tier (coll/device.py _run end-to-end)"),
+    ("lat_dev_xla", "device collective latency on the XLA lowering "
+     "(coll/device.py _run end-to-end)"),
+    ("lat_dev_slot", "device collective latency on the slot tier "
+     "(coll/device.py _run end-to-end)"),
+    ("lat_rndv_chunk", "rendezvous pipeline chunk-batch service time "
+     "(transport/base.py account_rndv_chunk: one publish/drain batch "
+     "from first copy to hand-off)"),
+    ("lat_rma_flush", "one-sided completion-wave latency (rma/device.py "
+     "fence/flush/unlock around the queued-op drain)"),
+    ("lat_daemon_attach", "daemon claim attach latency (runtime/"
+     "daemon.py claim entry to grant, queue wait included)"),
+    ("lat_daemon_queue", "daemon admission-queue wait (queue entry to "
+     "grant; only queued claims record)"),
+):
+    pvar(_h, PVAR_CLASS_HISTOGRAM, "metrics",
+         f"log2-bucketed latency histogram (us): {_d}")
 
 
 # ---------------------------------------------------------------------------

@@ -16,6 +16,7 @@ SMP_EAGERSIZE — the ibv_param.c:776-837,2354-2361 analog).
 from __future__ import annotations
 
 import ctypes as ct
+import time as _time
 from typing import Optional, Tuple
 
 import numpy as np
@@ -1102,10 +1103,13 @@ class Pt2ptProtocol:
         faults.fire("rndv_chunk")     # crash/delay mid-pipeline (drain)
         ap = req._ap
         tr = self.engine.tracer
+        from .. import metrics as _metrics
+        mx = _metrics.LIVE
+        t0 = _time.perf_counter() if mx is not None else 0.0
         chunk, n = ap["chunk"], ap["n"]
         nslots, block = ap["nslots"], ap["block"]
         upto = min(upto, ap["nchunks"])
-        k = ap["drained"]
+        k = k0 = ap["drained"]
         while k < upto:
             # drain slot-contiguous runs in one streaming copy: chunks
             # k..k+run-1 are consecutive in the block (no slot wrap)
@@ -1121,6 +1125,8 @@ class Pt2ptProtocol:
                           k=k, chunks=run, bytes=span)
             k += run
         ap["drained"] = k
+        if mx is not None and k > k0:
+            ap["channel"].account_rndv_chunk(t0)
         if ap["drained"] < ap["nchunks"]:
             # one ACK for the whole batch: everything <= drained-1 is
             # consumed, so the sender may refill those chunks' slots
@@ -1166,6 +1172,9 @@ class Pt2ptProtocol:
         k = ap["next"]
         if hi <= k:
             return
+        from .. import metrics as _metrics
+        mx = _metrics.LIVE
+        t0 = _time.perf_counter() if mx is not None else 0.0
         while k < hi:
             run = min(hi - k, nslots - (k % nslots))
             lo = k * chunk
@@ -1178,6 +1187,8 @@ class Pt2ptProtocol:
                           chunks=run, bytes=span)
             k += run
         ap["next"] = hi
+        if mx is not None:
+            sreq.channel.account_rndv_chunk(t0)
         pub = Packet(PktType.RNDV_APUB, self.u.world_rank,
                      rreq_id=pkt.rreq_id, offset=hi - 1)
         sreq.channel.send_packet(pkt.src_world, pub)

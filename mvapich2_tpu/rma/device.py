@@ -54,6 +54,7 @@ per-rank view materializes inside shard_map.
 from __future__ import annotations
 
 import functools
+import time as _time
 from typing import List, Optional, Tuple
 
 import jax
@@ -170,11 +171,16 @@ class DeviceWin:
         op (one completion wave), publish get results."""
         if not self._queue:
             return
+        from .. import metrics as _metrics
+        mx = _metrics.LIVE
+        t0 = _time.perf_counter() if mx is not None else 0.0
         _trace_rma("rma_fence", "B", nops=len(self._queue))
         try:
             self._dispatch(list(range(len(self._queue))))
         finally:
             _trace_rma("rma_fence", "E")
+            if mx is not None:
+                mx.rec_since("lat_rma_flush", t0)
 
     def lock(self, rank: int) -> None:
         """Open an exclusive passive-target access epoch on ``rank``
@@ -204,14 +210,19 @@ class DeviceWin:
                if rank is None or op[2] == rank]
         if not idx:
             return
+        from .. import metrics as _metrics
         from .. import mpit
         mpit.pvar("dev_rma_flush").inc()
+        mx = _metrics.LIVE
+        t0 = _time.perf_counter() if mx is not None else 0.0
         _trace_rma("rma_flush", "B", rank=-1 if rank is None else rank,
                    nops=len(idx))
         try:
             self._dispatch(idx)
         finally:
             _trace_rma("rma_flush", "E")
+            if mx is not None:
+                mx.rec_since("lat_rma_flush", t0)
 
     def flush_local(self, rank: Optional[int] = None) -> None:
         """MPI_Win_flush_local: origin-side buffers reusable. Single-

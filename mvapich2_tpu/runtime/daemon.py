@@ -357,8 +357,10 @@ def claim(n_local: int, ring_bytes: int, part_bytes: int,
     timed out, the queue is full, or the manifest speaks an unknown
     version — callers fall back to private per-job segments."""
     dir_ = dir_ or default_dir()
-    deadline = time.monotonic() + (_CLAIM_WAIT_S if wait_s is None
-                                   else float(wait_s))
+    t_enter = time.monotonic()
+    t_queued = None           # set when this claimer joins the queue
+    deadline = t_enter + (_CLAIM_WAIT_S if wait_s is None
+                          else float(wait_s))
     cfg = get_config()
     nsets = max(1, int(cfg.get("DAEMON_NSETS", 4) or 1))
     quota = max(1, int(cfg.get("DAEMON_QUOTA", 8) or 1))
@@ -413,6 +415,7 @@ def claim(n_local: int, ring_bytes: int, part_bytes: int,
                     m["queue"].append({"pid": me, "geokey": key,
                                        "seq": m["qseq"]})
                     queued = True
+                    t_queued = time.monotonic()
                     pv_queue_waits.inc()
             if out is not None:
                 break
@@ -428,6 +431,17 @@ def claim(n_local: int, ring_bytes: int, part_bytes: int,
         log.warn("daemon claim failed (%s); private segments", e)
         return None
     pv_claims_active.inc()
+    # attach/queue latency distributions for the node exporter: entry->
+    # grant, and (only when this claimer actually queued) queue->grant.
+    # ensure_live here — claim runs inside MPI_Init's light boot, ahead
+    # of the universe's trace-attach phase
+    from .. import metrics as _metrics
+    mx = _metrics.ensure_live()
+    if mx is not None:
+        t_grant = time.monotonic()
+        mx.rec_us("lat_daemon_attach", (t_grant - t_enter) * 1e6)
+        if t_queued is not None:
+            mx.rec_us("lat_daemon_queue", (t_grant - t_queued) * 1e6)
     if os.environ.get("MV2T_" + "FAULTS"):
         # crash-mid-claim site: the grant is published, the claimer has
         # not yet attached — exactly the window the stale-epoch sweep
@@ -680,6 +694,27 @@ class _ListenerServer:
                 try:
                     conn.settimeout(0.5)
                     req = json.loads(conn.makefile().readline() or "{}")
+                    if req.get("op") == "metrics":
+                        # node metrics exporter verb: the whole node
+                        # aggregate (manifest occupancy/queue, exec
+                        # cache, merged per-job rank histograms) as one
+                        # JSON blob or Prometheus text exposition —
+                        # read-only, nothing the jobs can observe
+                        conn.settimeout(5.0)
+                        try:
+                            from ..metrics import export as _export
+                            snap = _export.node_snapshot(
+                                daemon_dir=self.dir)
+                            if str(req.get("fmt", "json")) in (
+                                    "prom", "prometheus"):
+                                payload = _export.to_prometheus(snap)
+                            else:
+                                payload = json.dumps(snap) + "\n"
+                        except Exception as e:
+                            payload = json.dumps(
+                                {"error": str(e)}) + "\n"
+                        conn.sendall(payload.encode())
+                        continue
                     if req.get("op") != "listener":
                         continue
                     if not self._pool:
@@ -843,6 +878,14 @@ def _expire_idle(dir_: str, daemon_pid: int) -> bool:
             for p in s["files"].values():
                 try:
                     os.unlink(p)
+                except OSError:
+                    pass
+            # the metrics time-series segment rides beside the claimed
+            # ring (created lazily by the job, not in the manifest)
+            ring = s["files"].get("ring")
+            if ring:
+                try:
+                    os.unlink(ring + ".metrics")
                 except OSError:
                     pass
             del m["sets"][key]

@@ -201,6 +201,11 @@ class Universe:
                 trace.watchdog.configure(self.engine)
                 from ..analysis import lockorder
                 lockorder.configure(self.engine)
+                # arm the continuous-telemetry gate (MV2T_METRICS,
+                # default on): latency histograms record from here on;
+                # the shm sampler attaches with the channel
+                from .. import metrics as metrics_mod
+                metrics_mod.ensure_live()
             with ts.phase("failure containment"):
                 # fault-injection engine (MV2T_FAULTS; no-op when unset)
                 # and the liveness probe: blocking waits check co-located

@@ -91,6 +91,21 @@ def _shm_dir() -> str:
     return "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp"
 
 
+# discovery cache: --watch re-renders every interval, and re-glob +
+# re-parse per refresh is the expensive part of a refresh. Keyed on
+# the manifest's mtime and the shm dir's mtime — a claim/release
+# rewrites the manifest, a job arriving/leaving touches the dir, so
+# either invalidates; an unchanged key means the same stems.
+_disco_cache: Dict[str, Any] = {"key": None, "stems": []}
+
+
+def _mtime(path: Optional[str]) -> float:
+    try:
+        return os.path.getmtime(path) if path else 0.0
+    except OSError:
+        return 0.0
+
+
 def find_segments(seg: Optional[str] = None,
                   daemon_dir: Optional[str] = None) -> List[str]:
     """Candidate segment stems, most recently modified first.
@@ -98,16 +113,22 @@ def find_segments(seg: Optional[str] = None,
     Priority: an explicit stem; then the MV2T_DAEMON manifest's busy
     sets (attach-not-construct jobs); then a scan for per-job
     ``mv2t-shm-*`` ring files (a ring stem is the file whose ``.flags``
-    sibling exists)."""
+    sibling exists). Results are cached between refreshes and
+    invalidated on manifest/shm-dir mtime change."""
     if seg:
         return [seg]
-    out: List[str] = []
     if daemon_dir is None:
         try:
             from ..runtime.daemon import default_dir
             daemon_dir = default_dir()
         except Exception:
             daemon_dir = None
+    manifest = os.path.join(daemon_dir, "manifest.json") \
+        if daemon_dir else None
+    key = (daemon_dir, _mtime(manifest), _mtime(_shm_dir()))
+    if _disco_cache["key"] == key:
+        return list(_disco_cache["stems"])
+    out: List[str] = []
     if daemon_dir and os.path.isdir(daemon_dir):
         try:
             with open(os.path.join(daemon_dir, "manifest.json")) as f:
@@ -132,6 +153,8 @@ def find_segments(seg: Optional[str] = None,
         if ring not in seen:
             seen.add(ring)
             stems.append(ring)
+    _disco_cache["key"] = key
+    _disco_cache["stems"] = list(stems)
     return stems
 
 
@@ -262,6 +285,43 @@ def snapshot(stem: str, trace_tail: int = 8,
             out["flat2_regions"] = active
         finally:
             f2m.close()
+    # continuous-metrics time-series ring (<stem>.metrics, when the job
+    # runs with MV2T_METRICS — the default): per-rank last sampler row,
+    # per-interval deltas between the last two ring rows, and latency
+    # histogram digests. The --watch loop re-reads this every refresh,
+    # so the deltas ARE the live time-series view of an untraced job.
+    met_path = ring_path + ".metrics"
+    if os.path.exists(met_path):
+        try:
+            from ..metrics import hist as _mhist
+            from ..metrics import ring as _mring
+            names = _mring.slot_names()
+            met: Dict[int, Any] = {}
+            for i, d in sorted(_mring.read_all(met_path).items()):
+                rk: Dict[str, Any] = {}
+                rows = d["rows"]
+                if rows:
+                    ts, vals = rows[-1]
+                    rk["ts_us"] = ts
+                    rk["values"] = {nm: int(v) for nm, v
+                                    in zip(names, vals) if nm and v}
+                    if len(rows) >= 2:
+                        pts, pvals = rows[-2]
+                        rk["interval_s"] = round(
+                            max(1e-6, (ts - pts) / 1e6), 3)
+                        rk["deltas"] = {
+                            nm: int(v - p) for nm, v, p
+                            in zip(names, vals, pvals)
+                            if nm and v != p}
+                if d["hists"]:
+                    rk["hists"] = {
+                        nm: _mhist.summarize(c, s, b) for nm, (c, s, b)
+                        in sorted(d["hists"].items())}
+                met[i] = rk
+            if met:
+                out["metrics"] = met
+        except (OSError, ValueError, struct.error):
+            pass
     # native trace tail (only when the job runs with MV2T_NTRACE)
     nt_path = ring_path + ".ntrace"
     if os.path.exists(nt_path):
@@ -364,6 +424,28 @@ def format_snapshot(snap: Dict[str, Any]) -> str:
         lines.append(f"  flat2 region ctx={fr['ctx']} "
                      f"lane={fr['lane']}: mseq={fr['mseq']}"
                      f"{' POISONED' if fr['poisoned'] else ''}")
+    for i, rk in sorted((snap.get("metrics") or {}).items()):
+        iv = rk.get("interval_s")
+        head = f"  metrics rank {i}"
+        if "ts_us" in rk:
+            head += f" @t={rk['ts_us'] / 1e6:.3f}s"
+        if iv:
+            head += f" (interval {iv}s)"
+        lines.append(head + ":")
+        deltas = rk.get("deltas") or {}
+        if deltas:
+            kv = "  ".join(f"{k}+{v}" if v >= 0 else f"{k}{v}"
+                           for k, v in sorted(deltas.items()))
+            lines.append(f"    delta/{iv}s: {kv}")
+        elif rk.get("values"):
+            kv = "  ".join(f"{k}={v}"
+                           for k, v in sorted(rk["values"].items()))
+            lines.append(f"    totals: {kv}")
+        for nm, h in sorted((rk.get("hists") or {}).items()):
+            lines.append(
+                f"    {nm}: n={int(h['count'])} "
+                f"p50={h['p50_us']:.0f}us p90={h['p90_us']:.0f}us "
+                f"p99={h['p99_us']:.0f}us mean={h['mean_us']:.0f}us")
     for i, evs in sorted((snap.get("ntrace") or {}).items()):
         lines.append(f"  ntrace rank {i} tail:")
         for e in evs:

@@ -65,6 +65,45 @@ _FLAT2_REG_STRIDE = 370880   # 64 + (NGROUPS+1)*SUB + NBUF*(64+MAX)
 
 _REC = struct.Struct("<QIIqq")      # ts_us, ev, claim, a1, a2
 
+# continuous-metrics segment geometry (MV2T_MET_*, shm_layout.h) —
+# consumed by metrics/ring.py (sampler writer + every reader: mpistat
+# --watch, mpimetrics, the daemon metrics verb, the Perfetto counter
+# lanes); the mv2tlint layout doctor pins every one of these against
+# the header like the ntrace numbers above
+_MET_FILE_HDR = 64        # MV2T_MET_FILE_HDR
+_MET_HDR_BYTES = 64       # MV2T_MET_HDR_BYTES (rank header; u64 seq @0)
+_MET_SLOTS = 30           # MV2T_MET_SLOTS (u64 values per row)
+_MET_PV_BASE = 16         # MV2T_MET_PV_BASE (== MV2T_FPC_SLOTS)
+_MET_ROW_BYTES = 256      # 16 + MV2T_MET_SLOTS * 8
+_MET_RING_ROWS = 256      # MV2T_MET_RING_ROWS
+_MET_NHIST = 16           # MV2T_MET_NHIST
+_MET_HIST_BUCKETS = 32    # MV2T_MET_HIST_BUCKETS
+_MET_HIST_HDR = 64        # MV2T_MET_HIST_HDR (u64 count @0, u64 sum @8)
+_MET_HIST_BYTES = 320     # HIST_HDR + HIST_BUCKETS * 8
+_MET_RANK_STRIDE = 70720  # HDR + ROWS*ROW_BYTES + NHIST*HIST_BYTES
+
+# Row slot assignment past the verbatim fpctr mirror (slots
+# [0, _MET_PV_BASE)): python pvars sampled into slots _MET_PV_BASE +
+# index. Order is load-bearing for every ring reader (spare slots past
+# the list stay zero).
+_MET_PVARS = (
+    "daemon_claims_active", "daemon_queue_waits",
+    "exec_cache_hits", "exec_cache_misses",
+    "dev_coll_tier_vmem", "dev_coll_tier_hbm", "dev_coll_tier_quant",
+    "dev_rma_tier_rdma", "dev_rma_tier_epoch", "dev_rma_wire_bytes",
+    "dev_rma_flush", "rndv_pipeline_chunks",
+)
+
+# Histogram block assignment: block h carries the latency-histogram
+# pvar named here (blocks past the list stay zero). Order is
+# load-bearing for every ring reader, exactly like _MET_PVARS.
+_MET_HISTS = (
+    "lat_coll_flat", "lat_coll_flat2", "lat_coll_sched",
+    "lat_dev_vmem", "lat_dev_hbm", "lat_dev_quant", "lat_dev_xla",
+    "lat_dev_slot", "lat_rndv_chunk", "lat_rma_flush",
+    "lat_daemon_attach", "lat_daemon_queue",
+)
+
 # Event-id mirror of the NTE_* enum: index -> (name, protocol region).
 # The region strings name the shared-field protocol regions of the
 # mv2tlint native pass (watchdog report tags every line with them).

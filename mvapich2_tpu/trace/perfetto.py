@@ -36,6 +36,8 @@ def read_dumps(trace_dir: str) -> List[Dict[str, Any]]:
 def merge(dumps: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Per-rank dumps -> one trace-event JSON object."""
     t0 = min((ev[0] for d in dumps for ev in d["events"]), default=0.0)
+    t0 = min([t0] + [s[0] for d in dumps
+                     for s in (d.get("metrics") or [])])
     out: List[Dict[str, Any]] = []
     for d in dumps:
         rank = d["rank"]
@@ -53,6 +55,23 @@ def merge(dumps: List[Dict[str, Any]]) -> Dict[str, Any]:
             if args:
                 ev["args"] = args
             out.append(ev)
+        # MV2T_METRICS sampler series as counter tracks: one counter
+        # lane per rank beside the span lanes (ph "C" groups by pid +
+        # name), so a trace and its metrics share one timeline. Flat
+        # series are skipped — an all-constant counter is dead pixels.
+        samples = d.get("metrics") or []
+        if samples:
+            active = {k for _, vals in samples for k in vals}
+            flat = {k for k in active
+                    if len(samples) > 1
+                    and len({vals.get(k, 0)
+                             for _, vals in samples}) <= 1}
+            for ts, vals in samples:
+                live = {k: v for k, v in vals.items() if k not in flat}
+                for k, v in live.items():
+                    out.append({"name": f"metrics:{k}", "ph": "C",
+                                "pid": rank, "ts": (ts - t0) * 1e6,
+                                "args": {"value": v}})
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 

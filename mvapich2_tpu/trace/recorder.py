@@ -153,6 +153,20 @@ def dump_rank(engine) -> Optional[str]:
         snap["events"].extend(_native.drain_channel(pch))
     except Exception:
         pass
+    # embed this rank's metrics sampler series (MV2T_METRICS): the
+    # merge renders them as Perfetto counter tracks beside the span
+    # lanes — one timeline for spans AND time-series, same monotonic
+    # clock as the ntrace events above. Same never-kill-Finalize rule.
+    try:
+        from ..metrics import ring as _mring
+        u = getattr(engine, "universe", None)
+        sch = getattr(u, "shm_channel", None) if u is not None else None
+        if sch is not None:
+            samples = _mring.channel_rows(sch)
+            if samples:
+                snap["metrics"] = samples
+    except Exception:
+        pass
     path = os.path.join(out_dir, f"trace-r{rec.rank}.json")
     with open(path, "w") as f:
         json.dump(snap, f)

@@ -205,6 +205,17 @@ class Channel:
         if eng is not None and (tr := eng.tracer) is not None:
             tr.record("channel", f"{self.name}_recv", "i", bytes=nbytes)
 
+    def account_rndv_chunk(self, t0: float) -> None:
+        """Rendezvous chunk-batch completion: elapsed seconds since the
+        caller's ``t0`` into the lat_rndv_chunk histogram. Callers gate
+        on ``metrics.LIVE`` themselves (same one-attribute-check
+        discipline as the tracer sites), so the off-path cost is the
+        caller's check, not a call."""
+        from .. import metrics as _metrics
+        mx = _metrics.LIVE
+        if mx is not None:
+            mx.rec_since("lat_rndv_chunk", t0)
+
     def send_packet(self, dest_world: int, pkt: Packet) -> None:
         raise NotImplementedError
 

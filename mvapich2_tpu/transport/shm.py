@@ -676,6 +676,13 @@ class ShmChannel(Channel):
         # silent stretches (a rank deep in user code makes no progress
         # calls), so refreshing only from the progress loop would
         # false-kill busy peers. ~10 stamps per timeout period.
+        # continuous-metrics sampler state: declared BEFORE the thread
+        # starts (the loop re-reads self._sampler every wake; the
+        # sampler itself attaches later in __init__, after the plane)
+        self._sampler = None
+        self._metrics_path = f"{path}.metrics"
+        self._metrics_f = None
+        self._metrics_mm = None
         self._hb_stop = threading.Event()
         self._hb_thread = threading.Thread(
             target=self._hb_loop, daemon=True,
@@ -788,6 +795,47 @@ class ShmChannel(Channel):
                     base = pv._value
                     pv.source = (lambda i=idx, b=base:
                                  b + float(self.fp_counter(i)))
+        # -- continuous-metrics segment (<ring>.metrics) ------------------
+        # per-rank time-series ring + histogram mirrors for the always-on
+        # telemetry layer (mvapich2_tpu/metrics). Creation needs no
+        # ordering: O_CREAT + ftruncate zero-fills, zero rows are the
+        # uninitialized state readers skip, and each rank scrubs only
+        # its OWN region (daemon sets reuse files across epochs). The
+        # sampler rides the heartbeat thread started above.
+        from .. import metrics as _metrics
+        if _metrics.enabled():
+            try:
+                from ..metrics import ring as _mring
+                from ..metrics import sampler as _msampler
+                need = _mring.file_len(self.n_local)
+                fd = os.open(self._metrics_path,
+                             os.O_RDWR | os.O_CREAT, 0o600)
+                try:
+                    if os.fstat(fd).st_size < need:
+                        os.ftruncate(fd, need)
+                    self._metrics_f = os.fdopen(fd, "r+b")
+                except OSError:
+                    os.close(fd)
+                    raise
+                self._metrics_mm = mmap.mmap(self._metrics_f.fileno(),
+                                             need)
+                _metrics.ensure_live()
+
+                def _fpc_row(idx=self.local_index[my_rank]):
+                    m = self._fpc_mirror
+                    if m is None:
+                        return ()
+                    return m[idx * _FPC_SLOTS:(idx + 1) * _FPC_SLOTS]
+                smp = _msampler.Sampler(
+                    self._metrics_mm, self.local_index[my_rank],
+                    fpc_row=_fpc_row, now_us=self._now_us)
+                # first row inline, BEFORE the heartbeat thread can see
+                # the sampler (single-writer: after this handoff only
+                # the hb loop ticks, until close's final tick)
+                smp.maybe_tick()
+                self._sampler = smp
+            except OSError:
+                self._sampler = None
         # -- lazy per-peer wiring state ----------------------------------
         # the deferred half of bootstrap: bells + the unanimous CMA/
         # arena/flat agreement complete on the first operation that
@@ -868,8 +916,19 @@ class ShmChannel(Channel):
     def _hb_loop(self) -> None:
         period = max(0.02, min(1.0, self._peer_timeout / 10.0)) \
             if self._peer_timeout > 0 else 0.5
-        while not self._hb_stop.wait(period):
+        while True:
+            # the metrics sampler rides this thread (no thread of its
+            # own): clamp the wait to its interval and offer a tick on
+            # every wake — re-read each pass, the sampler attaches
+            # after the thread starts and detaches at close
+            smp = self._sampler
+            p = period if smp is None or smp.dead \
+                else min(period, smp.interval)
+            if self._hb_stop.wait(p):
+                return
             self._lease_stamp()
+            if smp is not None:
+                smp.maybe_tick()
 
     def lease_age(self, world_rank: int) -> Optional[float]:
         """Seconds since ``world_rank``'s heartbeat stamp; None when the
@@ -1629,6 +1688,20 @@ class ShmChannel(Channel):
         # heartbeat — peers must read "departed", never "dead"
         self._hb_stop.set()
         self._lease_stamp(self._LEASE_DEPARTED)
+        # final metrics tick BEFORE detaching: a job shorter than one
+        # sampling interval still publishes >= 1 row + its histograms
+        smp, self._sampler = self._sampler, None
+        if smp is not None:
+            try:
+                smp.tick()
+            except Exception:
+                pass
+        if self._metrics_mm is not None:
+            try:
+                self._metrics_mm.close()
+            except (OSError, ValueError, BufferError):
+                pass
+            self._metrics_mm = None
         if self.arena is not None:
             # Finalize leak check: every exposure must have been released
             # by its FIN/cancel; pending spills may legitimately await
@@ -1680,7 +1753,7 @@ class ShmChannel(Channel):
             elif not self._daemon:
                 for path in (self.path, self._flags_path,
                              self._flat_path, self._flat2_path,
-                             self._ntrace_path):
+                             self._ntrace_path, self._metrics_path):
                     try:
                         os.unlink(path)
                     except OSError:
@@ -1691,3 +1764,9 @@ class ShmChannel(Channel):
             except OSError:
                 pass
             self._ntrace_f = None
+        if self._metrics_f is not None:
+            try:
+                self._metrics_f.close()
+            except OSError:
+                pass
+            self._metrics_f = None

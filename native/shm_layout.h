@@ -182,4 +182,47 @@ enum {
  * big enough (older/shorter files keep a private heap block — counters
  * still work, they just aren't externally visible). */
 
+/* ---- continuous-metrics segment (<path>.metrics) ---------------------
+ * The time-series layer over the point-in-time surfaces above: each
+ * rank's MV2T_METRICS sampler (metrics/sampler.py, riding the
+ * heartbeat thread) appends one row per MV2T_METRICS_INTERVAL_MS tick
+ * — a snapshot of the rank's fpctr mirror row plus selected python
+ * pvars — so an attaching reader (bin/mpistat --watch, bin/mpimetrics,
+ * the daemon's `metrics` verb) can compute per-interval deltas and
+ * rates without touching the job. Layout:
+ *   [MV2T_MET_FILE_HDR file header]
+ *   n_local x { [MV2T_MET_HDR_BYTES rank header: u64 claim seq @0]
+ *               [MV2T_MET_RING_ROWS x MV2T_MET_ROW_BYTES rows]
+ *               [MV2T_MET_NHIST x MV2T_MET_HIST_BYTES histograms] }
+ * Row: u64 ts_us (CLOCK_MONOTONIC, written LAST — the ntrace
+ * release-store-ts-last discipline; zero ts marks an unfilled slot),
+ * u32 claim stamp (low 32 bits of the claiming seq; readers drop
+ * mismatched slots — the mid-overwrite tear detector), u32 reserved,
+ * then MV2T_MET_SLOTS u64 values: slots [0, MV2T_FPC_SLOTS) mirror
+ * the rank's fpctr row verbatim, slots from MV2T_MET_PV_BASE carry the
+ * python pvars named by trace/native.py _MET_PVARS, in order.
+ * Histogram block: u64 count @0, u64 sum_us @8 (rest of the header
+ * line reserved), then MV2T_MET_HIST_BUCKETS u64 log2-bucket counts —
+ * block h is the pvar named by trace/native.py _MET_HISTS[h].
+ * Monotonic-counter-only, so histogram blocks follow the fpctr-mirror
+ * discipline (stat surface: a slightly stale copy is fine); only the
+ * ring rows need the claim/stamp protocol. No C writer exists yet —
+ * the geometry lives here so the mv2tlint layout doctor pins the
+ * python mirrors (trace/native.py _MET_*) exactly like the ntrace
+ * ring's, and so a future cplane sampler shares the one definition. */
+#define MV2T_MET_FILE_HDR 64
+#define MV2T_MET_HDR_BYTES 64
+#define MV2T_MET_SLOTS 30
+#define MV2T_MET_PV_BASE 16       /* == MV2T_FPC_SLOTS; first pvar slot */
+#define MV2T_MET_ROW_BYTES (16 + MV2T_MET_SLOTS * 8)
+#define MV2T_MET_RING_ROWS 256
+#define MV2T_MET_NHIST 16
+#define MV2T_MET_HIST_BUCKETS 32
+#define MV2T_MET_HIST_HDR 64
+#define MV2T_MET_HIST_BYTES \
+    (MV2T_MET_HIST_HDR + MV2T_MET_HIST_BUCKETS * 8)
+#define MV2T_MET_RANK_STRIDE \
+    (MV2T_MET_HDR_BYTES + MV2T_MET_RING_ROWS * MV2T_MET_ROW_BYTES \
+     + MV2T_MET_NHIST * MV2T_MET_HIST_BYTES)
+
 #endif /* MV2T_SHM_LAYOUT_H */

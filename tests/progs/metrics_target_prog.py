@@ -1,0 +1,104 @@
+"""metrics live-scrape target (ISSUE 17): a job that prints its shm
+segment stem (``SEG <path>``, from the lowest python rank) and then
+runs collectives long enough for an external bin/mpimetrics /
+bin/mpistat to scrape live telemetry from the metrics ring. Prints
+"No Errors" on clean completion — the scrape must not have perturbed
+the job.
+
+Two modes:
+
+  python tests/progs/metrics_target_prog.py
+      All ranks python. The loop mixes flat-tier allreduces (small,
+      contiguous) with periodic sched-tier allreduces (64 KiB — over
+      the flat-region byte cap, so the schedule path runs and its
+      rendezvous pt2pt traffic exercises the chunk-latency histogram).
+      Duration: MV2T_TEST_STAT_SECONDS (default 6).
+
+  python tests/progs/metrics_target_prog.py <cbin>
+      Mixed-ABI: EVEN ranks exec the compiled ntrace_cabi_test binary;
+      ODD ranks run the IDENTICAL C sequence through the python API so
+      the collectives stay balanced across the ABI boundary. Pace the
+      shared workload with MV2T_TEST_CABI_REPS / MV2T_TEST_CABI_USLEEP
+      (read by both halves).
+
+Launched via: python -m mvapich2_tpu.run -np 4 python tests/progs/metrics_target_prog.py [cbin]
+"""
+
+import os
+import sys
+import time
+
+rank = int(os.environ.get("MV2T_RANK", "0"))
+cbin = sys.argv[1] if len(sys.argv) > 1 else None
+
+if cbin is not None and rank % 2 == 0:
+    # become a real C-ABI process (env — MV2T_METRICS et al — rides
+    # along; the exec'd binary bootstraps through libmpi.so)
+    os.execv(cbin, [cbin])
+
+sys.path.insert(0, ".")
+import numpy as np  # noqa: E402
+
+from mvapich2_tpu import mpi  # noqa: E402
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+me, np_ = comm.rank, comm.size
+errs = 0
+
+# the lowest python rank announces the segment stem for the scraper
+lowest_py = 1 if cbin is not None else 0
+sch = comm.u.shm_channel
+if me == lowest_py:
+    print(f"SEG {sch.path if sch is not None else '-'}", flush=True)
+
+if cbin is None:
+    # -- all-python half: flat + sched tiers, fixed iteration count ----
+    # (NOT a wall-clock deadline: collectives must be issued the same
+    # number of times on every rank)
+    iters = int(float(os.environ.get("MV2T_TEST_STAT_SECONDS", "6"))
+                / 0.01)
+    small = np.ones(16, np.float64)
+    big = np.ones(8192, np.float64)          # 64 KiB: sched tier
+    comm.barrier()
+    for i in range(iters):
+        out = comm.allreduce(small)
+        if out[0] != np_:
+            errs += 1
+        if i % 8 == 0:
+            out = comm.allreduce(big)
+            if out[0] != np_:
+                errs += 1
+        time.sleep(0.005)
+else:
+    # -- python half of the mixed job: ntrace_cabi_test.c's sequence --
+    N, PP = 16, 64
+    reps = int(os.environ.get("MV2T_TEST_CABI_REPS", "3"))
+    pause = int(os.environ.get("MV2T_TEST_CABI_USLEEP", "0")) / 1e6
+    comm.barrier()
+    for rep in range(reps):
+        sb = np.full(N, 1 + rep, np.int32)
+        rb = comm.allreduce(sb)
+        if not (rb == np_ * (1 + rep)).all():
+            errs += 1
+        if pause:
+            time.sleep(pause)
+    if (me ^ 1) < np_:
+        peer = me ^ 1
+        pb = (me * 1000 + np.arange(PP)).astype(np.int32)
+        qb = np.zeros(PP, np.int32)
+        if me % 2 == 0:
+            comm.send(pb, dest=peer, tag=7)
+            comm.recv(qb, source=peer, tag=7)
+        else:
+            comm.recv(qb, source=peer, tag=7)
+            comm.send(pb, dest=peer, tag=7)
+        if not (qb == peer * 1000 + np.arange(PP)).all():
+            errs += 1
+
+comm.barrier()
+total = comm.allreduce(np.array([errs], np.int32))
+if me == lowest_py and int(total[0]) == 0:
+    print("No Errors")
+mpi.Finalize()
+sys.exit(1 if errs else 0)

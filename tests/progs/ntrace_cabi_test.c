@@ -9,11 +9,23 @@
  * Prints "No Errors" from rank 0 on success. */
 #include <mpi.h>
 #include <stdio.h>
+#include <stdlib.h>
 #include <string.h>
+#include <unistd.h>
 
 #define N 16
 #define PP 64
 #define REPS 3
+
+/* ISSUE 17: the metrics live-scrape test reuses this workload as the C
+ * half of a mixed-ABI job and needs it to stay alive long enough for an
+ * external bin/mpimetrics to attach — so the rep count and a per-rep
+ * pause are env-tunable. Defaults keep the original 3-rep sequence
+ * byte-identical for the tracing tests. */
+static int env_int(const char *name, int dflt) {
+    const char *v = getenv(name);
+    return (v && atoi(v) > 0) ? atoi(v) : dflt;
+}
 
 int main(int argc, char **argv) {
     int rank, np, errs = 0;
@@ -24,8 +36,10 @@ int main(int argc, char **argv) {
     MPI_Barrier(MPI_COMM_WORLD);
 
     /* flat-tier allreduces (<=4 KiB, np<=8): fan-in/fold/fan-out */
+    int reps = env_int("MV2T_TEST_CABI_REPS", REPS);
+    int pause_us = env_int("MV2T_TEST_CABI_USLEEP", 0);
     int sb[N], rb[N];
-    for (int rep = 0; rep < REPS; rep++) {
+    for (int rep = 0; rep < reps; rep++) {
         for (int i = 0; i < N; i++)
             sb[i] = 1 + rep;
         memset(rb, -1, sizeof(rb));
@@ -33,6 +47,8 @@ int main(int argc, char **argv) {
         for (int i = 0; i < N; i++)
             if (rb[i] != np * (1 + rep))
                 errs++;
+        if (pause_us)
+            usleep(pause_us);
     }
 
     /* eager ping-pong with the partner rank (rank ^ 1) */

@@ -32,6 +32,11 @@ PVINC_SITES = 8     # channel + protocol counter increments
 # count: eager tx+rx, bell ring, spin->bell, wake, flat fan-in/fold/
 # fan-out, dispatch, plus slack.
 NTRACE_SITES = 12
+# metrics-off (ISSUE 17): every histogram site is ONE module-attribute
+# check (``metrics.LIVE is None``) — same discipline, measured with
+# its own unit cost below. Generous per-message count: collective
+# flat/sched gates, rendezvous drain/publish, RMA, plus slack.
+METRICS_SITES = 8
 
 mpi.Init()
 comm = mpi.COMM_WORLD
@@ -82,16 +87,52 @@ elif rank == 0:
         pv.inc()
     t_inc = (time.perf_counter() - t0) / n
 
+    # the metrics-off branch: the exact gate the histogram sites pay
+    # when MV2T_METRICS=0 (module attribute read + None check). The
+    # job here runs with metrics ON (the default), so LIVE is not None
+    # and the measured cost is the on-path check — an upper bound on
+    # the off-path one (same lookup, same branch shape).
+    from mvapich2_tpu import metrics as _metrics
+    t0 = time.perf_counter()
+    seen = 0
+    for _ in range(n):
+        if _metrics.LIVE is not None:   # the exact metrics gate
+            seen += 1
+    t_met = (time.perf_counter() - t0) / n
+
     overhead = (GATE_SITES + NTRACE_SITES) * t_gate \
-        + PVINC_SITES * t_inc
+        + PVINC_SITES * t_inc + METRICS_SITES * t_met
     frac = overhead / lat
     print(f"latency {lat * 1e6:.2f} us/msg; gate {t_gate * 1e9:.1f} ns; "
-          f"pvar.inc {t_inc * 1e9:.1f} ns; trace-off overhead "
-          f"(incl. {NTRACE_SITES} native ring-off branches) "
+          f"pvar.inc {t_inc * 1e9:.1f} ns; metrics gate "
+          f"{t_met * 1e9:.1f} ns; trace-off overhead "
+          f"(incl. {NTRACE_SITES} native ring-off branches and "
+          f"{METRICS_SITES} metrics gates) "
           f"{overhead * 1e6:.3f} us/msg = {frac * 100:.2f}% of latency")
     if frac >= 0.05:
         errs += 1
         print(f"trace-off overhead {frac * 100:.2f}% >= 5% budget")
+
+    # sampler-on smoke budget: one tick (fp-mirror slice + a dozen
+    # pvar reads + ~600 B of struct packing) must cost well under one
+    # sampling interval — the heartbeat thread absorbs it without ever
+    # falling behind the lease cadence. Budget: 1% of the 250 ms
+    # default interval (2.5 ms/tick) — generous by ~3 orders on any
+    # plausible host, but catches an accidental O(ring) or O(n_local)
+    # regression in the tick path.
+    smp = getattr(sch, "_sampler", None) if sch is not None else None
+    if smp is not None and not smp.dead:
+        reps = 200
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            smp.tick()
+        t_tick = (time.perf_counter() - t0) / reps
+        print(f"sampler tick {t_tick * 1e6:.2f} us "
+              f"(budget {0.01 * smp.interval * 1e6:.0f} us)")
+        if t_tick >= 0.01 * smp.interval:
+            errs += 1
+            print(f"sampler tick {t_tick * 1e6:.1f} us exceeds 1% of "
+                  f"the {smp.interval * 1e3:.0f} ms interval")
 
 comm.barrier()
 if rank == 0 and errs == 0:

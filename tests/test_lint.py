@@ -1046,3 +1046,62 @@ def test_ntrace_layout_mirrors_header():
     from mvapich2_tpu.analysis.native import _nte_to_name
     assert _nte_to_name("NTE_FLAT_FANIN") == "flat_fanin"
     assert _nte_to_name("NTE_BELL_RING") == "bell_ring"
+
+
+# -- ISSUE 17: the metrics subsystem under the lint ratchet ---------------
+
+def test_metrics_modules_under_lint_ratchet():
+    """ISSUE 17 satellite: the telemetry modules (metrics package,
+    sampler-bearing shm channel, exporter) ride the same passes as the
+    datapath — in the scanned set, clean under the pvars + traceguard
+    passes — and ONE seeded violation of each python class in a
+    metrics-shaped module is caught (the ratchet actually bites)."""
+    import mvapich2_tpu
+    from mvapich2_tpu.analysis import core as acore
+
+    pkg = os.path.dirname(mvapich2_tpu.__file__)
+    modules, errors = acore.scan_paths([pkg])
+    assert not errors
+    names = {os.path.relpath(m.path, pkg) for m in modules}
+    for need in ("metrics/__init__.py", "metrics/hist.py",
+                 "metrics/ring.py", "metrics/sampler.py",
+                 "metrics/export.py"):
+        assert need in names, need
+    from mvapich2_tpu.analysis.registry import RegistryPass
+    from mvapich2_tpu.analysis.traceguard import TraceGuardPass
+    met_paths = {m.path for m in modules
+                 if os.path.relpath(m.path, pkg).startswith("metrics/")
+                 or os.path.relpath(m.path, pkg) in
+                 ("mpit.py", "transport/shm.py", "trace/mpistat.py")}
+    fs = RegistryPass().run(modules)   # pvar decls are cross-module
+    assert [f for f in fs if f.path in met_paths] == []
+    assert [f for f in TraceGuardPass().run(
+        [m for m in modules if m.path in met_paths])] == []
+    # seeded: a histogram fetched by a name nothing ever declares
+    # (RegistryPass) + an unguarded tracer.record beside it
+    # (TraceGuardPass) in a sampler-shaped module
+    bad = acore.SourceModule("metrics/bad_sampler_fixture.py", (
+        "from .. import mpit\n"
+        "def tick(tracer):\n"
+        "    mpit.pvar('lat_hist_never_declared').rec(3)\n"
+        "    tracer.record('channel', 'metrics_tick', 'i')\n"))
+    assert len(RegistryPass().run(modules + [bad])) == 1
+    assert len(TraceGuardPass().run([bad])) == 1
+
+
+def test_metrics_layout_drift_detected(tmp_path):
+    """The MV2T_MET_* segment geometry is pinned by the layout doctor:
+    drifting the header's ring-row count (or any derived stride input)
+    away from the trace/native.py mirror is a mechanical finding."""
+    real = open(os.path.join(REPO, "native", "shm_layout.h")).read()
+    hdr = tmp_path / "shm_layout.h"
+    hdr.write_text(real.replace("#define MV2T_MET_RING_ROWS 256",
+                                "#define MV2T_MET_RING_ROWS 255"))
+    fs = native_mod.NativeSourcePass([], layout=True,
+                                     layout_header=str(hdr)).run([])
+    assert any("MV2T_MET_RING_ROWS" in f.msg and "disagree" in f.msg
+               for f in fs), [f.msg for f in fs]
+    # the committed header + mirror agree (no standing finding)
+    fs = [f for f in native_mod.NativeSourcePass().run([])
+          if "MV2T_MET" in f.msg]
+    assert fs == []

@@ -1,0 +1,518 @@
+"""Continuous serving telemetry (ISSUE 17): the metrics shm
+time-series ring, log2 latency histograms, and the node exporter.
+
+Unit level: bucket-edge exactness (powers of two ARE bucket lower
+edges), quantile estimation error bounds, cross-rank merge
+associativity, zero-allocation record on the hot path, ring
+writer/reader round-trip incl. wrap + torn-row drop, the
+file-size -> n_local inversion, sampler tick/interval/dead-sampler
+semantics, offline exporter aggregation + Prometheus rendering, and
+the mpistat discovery cache's manifest-mtime invalidation.
+
+End to end (the ISSUE acceptance): a 4-rank job under MV2T_METRICS=1
+yields a live bin/mpimetrics scrape with non-zero per-tier latency
+histograms and daemon attach-latency quantiles in BOTH JSON and
+Prometheus formats, a bin/mpistat --watch interval showing per-rank
+deltas from the shm ring, and the scraped job still completes with
+"No Errors" (attach-not-construct: reads never perturb the job).  A
+mixed-ABI variant (C even ranks / python odd ranks) proves one scrape
+covers BOTH ABIs — the C ranks' samplers ride the embedded runtime.
+"""
+
+import io
+import json
+import os
+import random
+import signal
+import struct
+import subprocess
+import sys
+import time
+import tracemalloc
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MPIMETRICS = os.path.join(REPO, "bin", "mpimetrics")
+MPISTAT = os.path.join(REPO, "bin", "mpistat")
+TARGET = os.path.join(REPO, "tests", "progs", "metrics_target_prog.py")
+
+from mvapich2_tpu import mpit  # noqa: E402
+from mvapich2_tpu.metrics import export as mexport  # noqa: E402
+from mvapich2_tpu.metrics import hist as mhist  # noqa: E402
+from mvapich2_tpu.metrics import ring as mring  # noqa: E402
+from mvapich2_tpu.metrics import sampler as msampler  # noqa: E402
+from mvapich2_tpu.trace.native import (  # noqa: E402
+    _MET_HISTS, _MET_PV_BASE, _MET_PVARS, _MET_RING_ROWS,
+    _MET_ROW_BYTES, _MET_SLOTS,
+)
+
+
+# -- histogram semantics -------------------------------------------------
+
+def test_bucket_edges_are_exact_powers_of_two():
+    """Every power of two is exactly a bucket's inclusive LOWER edge —
+    the property that makes the bucket grammar auditable."""
+    for i in range(1, mhist.HIST_BUCKETS):
+        lo = mhist.hist_bucket_lo(i)
+        assert lo == 1 << (i - 1)
+        assert mhist.hist_bucket_index(lo) == i
+        # one below the edge falls in the previous bucket
+        assert mhist.hist_bucket_index(lo - 1) == i - 1
+    assert mhist.hist_bucket_index(0) == 0
+    assert mhist.hist_bucket_lo(0) == 0
+
+
+def test_bucket_partition_covers_every_value():
+    """[lo(i), hi(i)] partitions the value axis: every value lands in
+    exactly the bucket whose span contains it (last bucket saturates)."""
+    last = mhist.HIST_BUCKETS - 1
+    for v in list(range(0, 4097)) + [10**6, 2**30, 2**40]:
+        i = mhist.hist_bucket_index(v)
+        assert mhist.hist_bucket_lo(i) <= v or i == 0
+        if 0 < i < last:
+            assert v <= mhist.hist_bucket_hi(i)
+            assert v >= mhist.hist_bucket_lo(i)
+
+
+def test_quantile_exact_on_bucket_edges():
+    """One sample per bucket: every quantile rank lands on a c==1
+    bucket and the estimate is its exact lower edge."""
+    buckets = [0] * mhist.HIST_BUCKETS
+    for i in range(1, 11):
+        buckets[i] = 1
+    assert mhist.quantile(buckets, 0.0) == 1.0          # bucket 1 lo
+    assert mhist.quantile(buckets, 1.0) == 512.0        # bucket 10 lo
+    # empty histogram reports 0, not garbage
+    assert mhist.quantile([0] * mhist.HIST_BUCKETS, 0.5) == 0.0
+
+
+def test_quantile_error_bounded_by_bucket_width():
+    """Uniform 1..1000: each estimated quantile stays within the log2
+    bucket containing the true quantile — a factor of 2 worst case."""
+    buckets = [0] * mhist.HIST_BUCKETS
+    vals = list(range(1, 1001))
+    for v in vals:
+        buckets[mhist.hist_bucket_index(v)] += 1
+    for q in (0.25, 0.5, 0.9, 0.99):
+        true = vals[int(q * (len(vals) - 1))]
+        est = mhist.quantile(buckets, q)
+        assert 0.5 * true <= est <= 2.0 * true, (q, true, est)
+
+
+def test_merge_associative_and_commutative():
+    rng = random.Random(17)
+    mk = lambda: [rng.randrange(0, 50) for _ in range(mhist.HIST_BUCKETS)]
+    a, b, c = mk(), mk(), mk()
+    assert mhist.merge(a, b) == mhist.merge(b, a)
+    assert mhist.merge(mhist.merge(a, b), c) == \
+        mhist.merge(a, mhist.merge(b, c))
+    assert mhist.merge_all([a, b, c]) == mhist.merge(mhist.merge(a, b), c)
+
+
+def test_summarize_digest():
+    buckets = [0] * mhist.HIST_BUCKETS
+    for v in (1, 2, 4, 8):
+        buckets[mhist.hist_bucket_index(v)] += 1
+    d = mhist.summarize(4, 15, buckets)
+    assert d["count"] == 4.0 and d["sum_us"] == 15.0
+    assert d["mean_us"] == pytest.approx(3.75)
+    assert d["p50_us"] <= d["p90_us"] <= d["p99_us"]
+
+
+def test_histpvar_record_is_allocation_free():
+    """The hot-path contract: HistPVar.rec into preallocated storage —
+    no net allocation across a long record burst (the only persistent
+    objects are the rolling count/sum ints)."""
+    h = mpit.pvar("test_metrics_zero_alloc", mpit.PVAR_CLASS_HISTOGRAM,
+                  "test", "zero-allocation guard probe")
+    for v in range(64):
+        h.rec(v)                      # warm freelists / int caches
+    tracemalloc.start()
+    try:
+        base = tracemalloc.get_traced_memory()[0]
+        for v in range(10000):
+            h.rec(v)
+        grew = tracemalloc.get_traced_memory()[0] - base
+    finally:
+        tracemalloc.stop()
+    assert grew < 1024, f"rec allocated {grew} B over 10k records"
+
+
+# -- ring writer/reader --------------------------------------------------
+
+def _row(k):
+    return [k * 100 + s for s in range(_MET_SLOTS)]
+
+
+def test_ring_roundtrip_and_wrap():
+    buf = bytearray(mring.file_len(2))
+    w = mring.RingWriter(buf, 1)
+    total = _MET_RING_ROWS + 44          # forces a wrap
+    for k in range(total):
+        w.append(1000 + k, _row(k))
+    rows = mring.read_rows(io.BytesIO(bytes(buf)), 1)
+    assert len(rows) == _MET_RING_ROWS
+    ks = [ts - 1000 for ts, _ in rows]
+    assert ks == list(range(total - _MET_RING_ROWS, total))  # oldest first
+    ts, vals = rows[-1]
+    assert vals == _row(total - 1)
+    # rank 0's region is untouched by rank 1's writer
+    assert mring.read_rows(io.BytesIO(bytes(buf)), 0) == []
+    assert mring.read_rows(io.BytesIO(bytes(buf)), 1, last=5) == rows[-5:]
+
+
+def test_ring_torn_row_dropped_never_garbled():
+    buf = bytearray(mring.file_len(1))
+    w = mring.RingWriter(buf, 0)
+    for k in range(8):
+        w.append(1000 + k, _row(k))
+    base = mring.rank_base(0) + 64
+    # tear row 3 two ways: a zero ts (writer mid-append) ...
+    struct.pack_into("<Q", buf, base + 3 * _MET_ROW_BYTES, 0)
+    # ... and a stale claim on row 5 (overwritten by a lapped writer)
+    struct.pack_into("<I", buf, base + 5 * _MET_ROW_BYTES + 8, 999)
+    rows = mring.read_rows(io.BytesIO(bytes(buf)), 0)
+    ks = [ts - 1000 for ts, _ in rows]
+    assert ks == [0, 1, 2, 4, 6, 7]
+    for ts, vals in rows:
+        assert vals == _row(ts - 1000)   # survivors are never garbled
+
+
+def test_file_len_inversion():
+    for n in (1, 2, 3, 4, 8, 64, 256):
+        assert mring.n_local_from_size(mring.file_len(n)) == n
+    assert mring.n_local_from_size(mring.file_len(4) + 1) is None
+    assert mring.n_local_from_size(63) is None
+
+
+def test_slot_names_follow_layout():
+    names = mring.slot_names()
+    assert len(names) == _MET_SLOTS
+    assert names[0].startswith("fp_")
+    assert names[_MET_PV_BASE:_MET_PV_BASE + len(_MET_PVARS)] == \
+        list(_MET_PVARS)
+
+
+# -- sampler -------------------------------------------------------------
+
+def test_sampler_tick_mirrors_counters_and_hists():
+    mpit.pvar("lat_coll_flat").rec(5)     # ensure one hist is non-empty
+    buf = bytearray(mring.file_len(1))
+    clock = iter(range(10_000, 20_000, 7))
+    smp = msampler.Sampler(buf, 0, fpc_row=lambda: [7] * 16,
+                           now_us=lambda: next(clock))
+    smp.tick()
+    rows = mring.read_rows(io.BytesIO(bytes(buf)), 0)
+    assert len(rows) == 1
+    _, vals = rows[0]
+    assert vals[:_MET_PV_BASE] == [7] * 16
+    hists = mring.read_hists(io.BytesIO(bytes(buf)), 0)
+    assert "lat_coll_flat" in hists
+    count, total, buckets = hists["lat_coll_flat"]
+    assert count >= 1 and sum(buckets) == count
+
+
+def test_sampler_interval_gating_and_dead_on_failure():
+    buf = bytearray(mring.file_len(1))
+    smp = msampler.Sampler(buf, 0)
+    assert smp.maybe_tick(now=100.0) is True      # first wake samples
+    assert smp.maybe_tick(now=100.001) is False   # not due yet
+    assert smp.maybe_tick(now=100.0 + smp.interval) is True
+    # a torn mapping (segment gone at teardown) kills the sampler,
+    # NEVER the heartbeat thread that hosts it
+    smp.writer.buf = bytearray(8)
+    assert smp.maybe_tick(now=200.0 + smp.interval) is False
+    assert smp.dead
+    assert smp.maybe_tick(now=300.0) is False     # stays dead, no raise
+
+
+# -- exporter (offline segment) -----------------------------------------
+
+def _build_segment(path, n_local=2):
+    buf = bytearray(mring.file_len(n_local))
+    for i in range(n_local):
+        w = mring.RingWriter(buf, i)
+        w.append(1_000_000, [10 * (i + 1)] * _MET_SLOTS)
+        w.append(1_250_000, [10 * (i + 1) + 3] * _MET_SLOTS)
+        buckets = [0] * mhist.HIST_BUCKETS
+        for v in (3, 5, 9):
+            buckets[mhist.hist_bucket_index(v)] += 1
+        w.write_hist(_MET_HISTS.index("lat_coll_flat"), 3, 17, buckets)
+    with open(path, "wb") as f:
+        f.write(buf)
+
+
+def test_node_snapshot_offline_segment(tmp_path):
+    stem = str(tmp_path / "ring")
+    _build_segment(stem + ".metrics")
+    snap = mexport.node_snapshot(daemon_dir=str(tmp_path / "nodaemon"),
+                                 seg=stem)
+    assert [j["stem"] for j in snap["jobs"]] == [stem]
+    job = snap["jobs"][0]
+    assert sorted(job["ranks"]) == [0, 1]
+    rk = job["ranks"][0]
+    assert rk["values"]["fp_coll_flat"] == 13
+    assert rk["deltas"]["fp_coll_flat"] == 3
+    assert rk["interval_s"] == pytest.approx(0.25)
+    # merged across ranks: 3 + 3 records
+    h = snap["hists"]["lat_coll_flat"]
+    assert h["count"] == 6.0 and h["sum_us"] == 34.0
+    assert snap["daemon"]["alive"] is False
+    assert json.loads(json.dumps(snap))          # JSON-serializable
+
+
+def test_prometheus_rendering_cumulative_buckets(tmp_path):
+    stem = str(tmp_path / "ring")
+    _build_segment(stem + ".metrics")
+    snap = mexport.node_snapshot(daemon_dir=str(tmp_path / "nodaemon"),
+                                 seg=stem)
+    text = mexport.to_prometheus(snap)
+    assert "# TYPE mv2t_latency_us histogram" in text
+    assert "mv2t_daemon_alive 0.0" in text
+    accs, inf = [], None
+    for ln in text.splitlines():
+        if ln.startswith('mv2t_latency_us_bucket{hist="lat_coll_flat"'):
+            if 'le="+Inf"' in ln:
+                inf = int(ln.rsplit(" ", 1)[1])
+            else:
+                accs.append(int(ln.rsplit(" ", 1)[1]))
+    assert accs == sorted(accs), "bucket series must be cumulative"
+    assert inf == 6 and accs[-1] == 6
+    assert 'mv2t_latency_us_count{hist="lat_coll_flat"} 6' in text
+
+
+# -- Perfetto counter tracks (satellite) ---------------------------------
+
+def test_perfetto_renders_metrics_counter_tracks():
+    """Sampler series embedded in a rank dump come out as Chrome
+    trace-event counter ("C") events on the rank's pid — flat series
+    are dropped (dead pixels), moving ones keep raw cumulative values
+    on the shared rebased time axis."""
+    from mvapich2_tpu.trace import perfetto
+    dump = {"rank": 2, "events": [[10.0, "mpi", "allreduce", "B", None],
+                                  [10.1, "mpi", "allreduce", "E", None]],
+            "metrics": [(9.5, {"fp_coll_flat": 4, "fp_eager_tx": 1}),
+                        (10.5, {"fp_coll_flat": 9, "fp_eager_tx": 1})]}
+    merged = perfetto.merge([dump])
+    ctr = [e for e in merged["traceEvents"] if e.get("ph") == "C"]
+    names = {e["name"] for e in ctr}
+    assert names == {"metrics:fp_coll_flat"}     # flat series dropped
+    assert [e["args"]["value"] for e in ctr] == [4, 9]
+    assert all(e["pid"] == 2 for e in ctr)
+    # samples rebase against the SAME t0 as the span events (the
+    # earliest timestamp across both streams — here a sample)
+    assert min(e["ts"] for e in ctr) == 0.0
+    span_ts = [e["ts"] for e in merged["traceEvents"]
+               if e.get("ph") == "B"]
+    assert span_ts == [pytest.approx(0.5e6)]
+
+
+# -- mpistat discovery cache (satellite) ---------------------------------
+
+def test_discovery_cache_invalidated_on_manifest_mtime(tmp_path,
+                                                       monkeypatch):
+    from mvapich2_tpu.trace import mpistat as _mpistat
+    shm = tmp_path / "shm"
+    shm.mkdir()
+    monkeypatch.setattr(_mpistat, "_shm_dir", lambda: str(shm))
+    ddir = tmp_path / "dd"
+    ddir.mkdir()
+    ring = tmp_path / "mv2t-ring"
+    flags = tmp_path / "mv2t-ring.flags"
+    ring.write_bytes(b"\0")
+    flags.write_bytes(b"\0")
+    manifest = ddir / "manifest.json"
+    manifest.write_text(json.dumps({"sets": {"g0": {
+        "state": "busy",
+        "files": {"ring": str(ring), "flags": str(flags)}}}}))
+
+    calls = {"n": 0}
+    real_glob = _mpistat.glob.glob
+
+    def counting_glob(*a, **kw):
+        calls["n"] += 1
+        return real_glob(*a, **kw)
+    monkeypatch.setattr(_mpistat.glob, "glob", counting_glob)
+
+    _mpistat._disco_cache["key"] = None
+    assert _mpistat.find_segments(None, str(ddir)) == [str(ring)]
+    assert calls["n"] == 1
+    # unchanged manifest + shm dir: served from the cache, no re-glob
+    assert _mpistat.find_segments(None, str(ddir)) == [str(ring)]
+    assert calls["n"] == 1
+    # a claim/release rewrites the manifest -> mtime bump -> rescan
+    st = os.stat(manifest)
+    os.utime(manifest, (st.st_atime, st.st_mtime + 10))
+    assert _mpistat.find_segments(None, str(ddir)) == [str(ring)]
+    assert calls["n"] == 2
+    _mpistat._disco_cache["key"] = None    # don't poison other tests
+
+
+# -- daemon metrics verb -------------------------------------------------
+
+def test_daemon_sock_metrics_verb(tmp_path):
+    """The serve loop answers {"op": "metrics"} with the node
+    aggregate in both formats (one scrape per node, no shm attach
+    needed by the scraper)."""
+    ddir = str(tmp_path / "dd")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MV2T_DAEMON_SPAWN="0")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "mvapich2_tpu.runtime.daemon", "--serve",
+         "--dir", ddir, "--idle", "60"], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        sock = os.path.join(ddir, "daemon.sock")
+        for _ in range(200):
+            if os.path.exists(sock):
+                break
+            time.sleep(0.05)
+        assert os.path.exists(sock), "daemon.sock never appeared"
+        text = mexport.scrape_daemon(ddir, fmt="json")
+        assert text, "metrics verb returned nothing"
+        snap = json.loads(text)
+        assert snap["daemon"]["alive"] is True
+        assert snap["daemon"]["dir"] == ddir
+        prom = mexport.scrape_daemon(ddir, fmt="prom")
+        assert prom and "mv2t_daemon_alive 1.0" in prom
+        # the CLI prefers the socket when one is serving
+        r = subprocess.run(
+            [sys.executable, MPIMETRICS, "--daemon-dir", ddir],
+            capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert json.loads(r.stdout)["daemon"]["alive"] is True
+    finally:
+        subprocess.run(
+            [sys.executable, "-m", "mvapich2_tpu.runtime.daemon",
+             "--stop", "--dir", ddir], env=env, capture_output=True,
+            text=True, timeout=60)
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+# -- end to end: the ISSUE acceptance ------------------------------------
+
+def _launch_target(env_extra, argv_tail=(), np_=4):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MV2T_TRACE", None)       # the job runs untraced
+    env.pop("MV2T_NTRACE", None)
+    env.update(env_extra)
+    job = subprocess.Popen(
+        [sys.executable, "-m", "mvapich2_tpu.run", "-np", str(np_),
+         sys.executable, TARGET, *argv_tail],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, text=True)
+    seg = None
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        line = job.stdout.readline()
+        if line.startswith("SEG "):
+            seg = line.split()[1]
+            break
+    return job, seg
+
+
+def _finish(job):
+    rest = job.stdout.read()
+    assert job.wait(timeout=120) == 0, rest
+    assert "No Errors" in rest
+
+
+def test_e2e_metrics_live_scrape_4rank(tmp_path):
+    """ISSUE 17 acceptance: a 4-rank job under MV2T_METRICS=1 yields
+    (a) a live bin/mpimetrics scrape with non-zero per-tier latency
+    histograms AND daemon attach-latency quantiles, in both JSON and
+    Prometheus formats; (b) a bin/mpistat --watch interval showing
+    per-rank deltas from the shm ring; (c) the job still finishes with
+    "No Errors" — the scrapes did not perturb it."""
+    ddir = str(tmp_path / "dd")
+    job, seg = _launch_target({
+        "MV2T_METRICS": "1", "MV2T_METRICS_INTERVAL_MS": "50",
+        "MV2T_DAEMON": "1", "MV2T_DAEMON_DIR": ddir,
+        "MV2T_DAEMON_SPAWN": "0", "MV2T_TEST_STAT_SECONDS": "12"})
+    try:
+        assert seg, "target job never printed its segment stem"
+        time.sleep(3.0)               # sampler rows + collectives accrue
+
+        # (a) JSON scrape: per-tier histograms + daemon attach latency
+        r = subprocess.run(
+            [sys.executable, MPIMETRICS, "--daemon-dir", ddir,
+             "--seg", seg], capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stdout + r.stderr
+        snap = json.loads(r.stdout)
+        hists = snap["hists"]
+        for tier in ("lat_coll_flat", "lat_coll_sched"):
+            assert hists.get(tier, {}).get("count", 0) > 0, \
+                (tier, sorted(hists))
+        att = hists["lat_daemon_attach"]
+        assert att["count"] >= 1 and att["p99_us"] >= att["p50_us"] >= 0
+        assert len(snap["jobs"][0]["ranks"]) == 4
+        assert snap["daemon"]["busy"] >= 1
+
+        # (a) Prometheus scrape: same histograms as cumulative buckets
+        r = subprocess.run(
+            [sys.executable, MPIMETRICS, "--daemon-dir", ddir,
+             "--seg", seg, "--format", "prom"],
+            capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stdout + r.stderr
+        prom = r.stdout
+        for tier in ("lat_coll_flat", "lat_coll_sched",
+                     "lat_daemon_attach"):
+            assert f'mv2t_latency_us_count{{hist="{tier}"}}' in prom
+            assert f'mv2t_latency_us_bucket{{hist="{tier}"' in prom
+
+        # (b) mpistat --watch: per-rank time-series deltas
+        w = subprocess.Popen(
+            [sys.executable, MPISTAT, "--seg", seg, "--watch", "0.4"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        time.sleep(2.5)
+        w.send_signal(signal.SIGINT)
+        wout, _ = w.communicate(timeout=60)
+        assert w.returncode == 0, wout
+        assert "metrics rank 0" in wout and "metrics rank 3" in wout
+        assert "delta/" in wout, wout    # rate line needs >= 2 rows
+        assert "lat_coll_flat:" in wout and "p50=" in wout
+
+        # (c) the scraped job was not perturbed
+        _finish(job)
+    finally:
+        if job.poll() is None:
+            job.kill()
+
+
+@pytest.mark.skipif(
+    __import__("shutil").which("gcc") is None
+    or __import__("shutil").which("python3-config") is None,
+    reason="no C toolchain")
+def test_e2e_metrics_mixed_abi_scrape(tmp_path):
+    """Both ABIs under one scrape: EVEN ranks are C-ABI processes
+    (their samplers ride the embedded runtime the heavy data plane
+    builds), ODD ranks python. One live scrape covers all four ranks
+    across the ABI boundary, and the mixed job completes clean."""
+    import tempfile
+    cbin = os.path.join(tempfile.mkdtemp(), "ntrace_cabi_test")
+    r = subprocess.run(
+        [os.path.join(REPO, "bin", "mpicc"),
+         os.path.join(REPO, "tests", "progs", "ntrace_cabi_test.c"),
+         "-o", cbin], capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, f"mpicc failed:\n{r.stdout}\n{r.stderr}"
+    job, seg = _launch_target({
+        "MV2T_METRICS": "1", "MV2T_METRICS_INTERVAL_MS": "50",
+        "MV2T_TEST_CABI_REPS": "150",
+        "MV2T_TEST_CABI_USLEEP": "50000"}, argv_tail=(cbin,))
+    try:
+        assert seg, "mixed job never printed its segment stem"
+        time.sleep(3.0)
+        r = subprocess.run(
+            [sys.executable, MPIMETRICS, "--seg", seg],
+            capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stdout + r.stderr
+        snap = json.loads(r.stdout)
+        ranks = {int(k) for k in snap["jobs"][0]["ranks"]}
+        assert ranks == {0, 1, 2, 3}, ranks   # BOTH ABIs publish
+        assert snap["hists"]["lat_coll_flat"]["count"] > 0
+        _finish(job)
+    finally:
+        if job.poll() is None:
+            job.kill()
