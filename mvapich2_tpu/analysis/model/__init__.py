@@ -27,6 +27,12 @@ codes per chunk, dequant-fold at consume): same slot/credit schedule
 over the shrunken wire chunks, with agreement tightened to "every
 delivered chunk decodes with its sender's scale word" and the
 ``scale_after_payload`` split-landing break seeded against it.
+``ici.build_alltoallv`` extends the net to the MoE-shaped alltoallv
+wire (ops/pallas_alltoall.py): per-peer VARIABLE chunk counts on the
+global-counter slot schedule with per-step credit waves and full-size
+padding chunks — its seeded breaks (slot derived from the local
+valid-chunk tally under skew, credit re-grant skipped on a zero-count
+peer's padding) are each caught by a named invariant.
 
 The one-sided lane (ops/pallas_rma.py + rma/device.py) adds
 ``rma.build_passive``: the passive-target epoch — MPI_Win_lock, C
@@ -142,6 +148,16 @@ def mutation_matrix():
         ("ici-ring", lambda: ici.build_ring(
             n=2, chunks=2, depth=2, mutation="scale_after_payload"),
          "scale_after_payload"),
+        # MoE-shaped alltoallv wire (ops/pallas_alltoall.py): per-peer
+        # variable chunk counts on the global-counter slot schedule
+        ("ici-a2av", lambda: ici.build_alltoallv(
+            n=2, depth=2, counts=[[0, 1], [3, 0]],
+            mutation="skewed_count_slot"),
+         "skewed_count_slot"),
+        ("ici-a2av", lambda: ici.build_alltoallv(
+            n=2, depth=2, counts=[[0, 0], [2, 0]],
+            mutation="zero_count_credit_leak"),
+         "zero_count_credit_leak"),
         # passive-target one-sided epoch (ops/pallas_rma.py)
         ("rma-passive", lambda: rma.build_passive(
             chunks=3, depth=2, cells=1, mutation="flush_skips_chunk"),

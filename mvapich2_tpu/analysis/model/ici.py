@@ -331,3 +331,248 @@ def build_ring(n: int = 2, chunks: int = 2, depth: int = 2,
                   ("no-lost-credit", inv_credit),
                   ("agreement", inv_agree)],
                  final)
+
+
+_PAD = "PAD"   # wire-padding chunk payload (consumed, never delivered)
+
+
+def build_alltoallv(n: int, depth: int, counts,
+                    mutation: Optional[str] = None) -> Model:
+    """Per-peer variable chunk counts on the global-counter slot
+    schedule — the MoE-shaped alltoallv wire (ops/pallas_alltoall.py).
+
+    The protocol skeleton: steps ``t = 1..n-1`` of a rotation schedule
+    (step ``t``: rank ``r`` streams to ``(r+t) % n`` and receives from
+    ``(r-t) % n``). The step-wide wire width ``W_t`` is the MAX chunk
+    count over that step's pairs — wire chunks are always full size, so
+    a pair below the max streams PADDING chunks that the receiver must
+    still consume and credit back (the byte-count-blind slot/credit
+    schedule; ``W_t == 0`` steps are skipped mesh-wide). The slot for
+    wire chunk ``k`` of step ``t`` is ``G(t,k) % depth`` with ``G`` the
+    GLOBAL wire counter (cumulative over steps) — both ends derive it
+    from the same counts matrix, never from their local valid-chunk
+    tallies. Flow control is a per-step credit wave on the sender's
+    per-destination lane: the receiver grants ``depth`` at its step
+    entry (so a sender can never run into slots whose previous-step
+    occupants the receiver has not drained), re-grants one per consume
+    (padding included), and the sender fences its lane back to depth at
+    step exit.
+
+    Mutations (tests/test_modelcheck.py asserts each is caught):
+
+      skewed_count_slot      the sender derives the slot from its own
+                             VALID-chunk counter (padding chunks do not
+                             advance it) — under skewed counts the send
+                             and drain slot sequences diverge and a
+                             write lands in an unconsumed slot
+      zero_count_credit_leak the receiver skips the credit re-grant on
+                             padding chunks — the credit window of any
+                             below-max pair (a zero-count peer in the
+                             extreme) leaks shut and the sender's fence
+                             starves
+    """
+    assert n >= 2 and depth >= 1
+    D = depth
+    counts = [[int(c) for c in row] for row in counts]
+    assert len(counts) == n and all(len(r) == n for r in counts)
+
+    def dst(r: int, t: int) -> int:
+        return (r + t) % n
+
+    def src(r: int, t: int) -> int:
+        return (r - t + n) % n
+
+    # step-wide wire widths (zero-width steps skipped mesh-wide) and
+    # the global wire counter offset of each active step
+    steps = []
+    G0 = {}
+    g = 0
+    for t in range(1, n):
+        W = max(counts[r][dst(r, t)] for r in range(n))
+        if W == 0:
+            continue
+        steps.append((t, W))
+        G0[t] = g
+        g += W
+
+    # the serialized per-rank program (identical across ranks: W is
+    # step-wide): entry grant, issue/drain alternation, exit fence
+    prog = []
+    for t, W in steps:
+        prog.append(("entry", t, 0))
+        for k in range(W):
+            prog.append(("issue", t, k))
+            if k >= 1:
+                prog.append(("drain", t, k - 1))
+        prog.append(("drain", t, W - 1))
+        prog.append(("fence", t, 0))
+
+    init = {"collision": 0}
+    for r in range(n):
+        init[f"pc{r}"] = 0
+        init[f"vc{r}"] = 0          # valid-chunk tally (mutant's slot)
+        init[f"res{r}"] = ()        # delivered valid payloads, in order
+        for d in range(n):
+            if d != r:
+                init[f"cr{r}_{d}"] = 0    # credits held on lane r->d
+                init[f"fl{r}_{d}"] = 0    # chunks in flight on r->d
+                init[f"win{r}_{d}"] = 0   # receiver-granted window
+        for s in range(D):
+            init[f"sl{r}_{s}"] = (_FREE, _PAD, True)
+
+    ts = []
+    for r in range(n):
+        for i, (op, t, k) in enumerate(prog):
+            def mk(r=r, i=i, op=op, t=t, k=k):
+                pc = f"pc{r}"
+                peer, upr = dst(r, t), src(r, t)
+                g = G0[t] + k
+                cr = f"cr{r}_{peer}"
+
+                if op == "entry":
+                    # receiver-side grant: open the upstream's window
+                    ucr, uwin = f"cr{upr}_{r}", f"win{upr}_{r}"
+
+                    def guard(s, pc=pc, i=i):
+                        return s[pc] == i
+
+                    def apply(s):
+                        s[ucr] += D
+                        s[uwin] += D
+                        s[pc] = i + 1
+                        return s
+
+                    return Transition(
+                        f"r{r}.entry.t{t}", f"r{r}", guard, apply,
+                        frozenset({pc}),
+                        frozenset({pc, ucr, uwin}))
+
+                if op == "fence":
+                    def guard(s, pc=pc, i=i, cr=cr):
+                        return s[pc] == i and s[cr] >= D
+
+                    def apply(s, cr=cr, win=f"win{r}_{peer}"):
+                        s[cr] -= D
+                        s[win] -= D
+                        s[pc] = i + 1
+                        return s
+
+                    return Transition(
+                        f"r{r}.fence.t{t}", f"r{r}", guard, apply,
+                        frozenset({pc, cr}),
+                        frozenset({pc, cr, f"win{r}_{peer}"}))
+
+                if op == "issue":
+                    valid = k < counts[r][peer]
+                    fl = f"fl{r}_{peer}"
+                    vc = f"vc{r}"
+                    skeys = frozenset(f"sl{peer}_{s}" for s in range(D))
+
+                    def guard(s, pc=pc, i=i, cr=cr):
+                        return s[pc] == i and s[cr] > 0
+
+                    def apply(s, g=g, valid=valid):
+                        s[cr] -= 1
+                        s[fl] += 1
+                        if mutation == "skewed_count_slot":
+                            # MUTANT: slot from the local valid-chunk
+                            # tally — pads do not advance it, so skewed
+                            # counts desync it from the wire counter
+                            slot = s[vc] % D
+                        else:
+                            slot = g % D
+                        if valid:
+                            s[vc] += 1
+                        wkey = f"sl{peer}_{slot}"
+                        occ, pay, cons = s[wkey]
+                        if not cons:
+                            s["collision"] = 1       # sticky
+                        s[wkey] = (g, (r, t, k) if valid else _PAD,
+                                   False)
+                        s[pc] = i + 1
+                        return s
+
+                    return Transition(
+                        f"r{r}.issue.t{t}.k{k}", f"r{r}", guard, apply,
+                        frozenset({pc, cr, vc}) | skeys,
+                        frozenset({pc, cr, fl, vc, "collision"})
+                        | skeys)
+
+                # drain: consume wire chunk k of step t from upstream
+                rkey = f"sl{r}_{g % D}"
+                is_pad = k >= counts[upr][r]
+                ucr, ufl = f"cr{upr}_{r}", f"fl{upr}_{r}"
+                res = f"res{r}"
+
+                def guard(s, pc=pc, i=i, rkey=rkey, g=g):
+                    if s[pc] != i:
+                        return False
+                    occ, pay, cons = s[rkey]
+                    return occ == g and not cons
+
+                def apply(s, rkey=rkey, is_pad=is_pad):
+                    occ, pay, cons = s[rkey]
+                    if pay != _PAD:
+                        s[res] = s[res] + (pay,)
+                    s[rkey] = (occ, pay, True)
+                    s[ufl] -= 1
+                    if not (is_pad
+                            and mutation == "zero_count_credit_leak"):
+                        s[ucr] += 1      # re-grant (padding included)
+                    s[pc] = i + 1
+                    return s
+
+                return Transition(
+                    f"r{r}.drain.t{t}.k{k}", f"r{r}", guard, apply,
+                    frozenset({pc, rkey}),
+                    frozenset({pc, rkey, res, ucr, ufl}))
+            ts.append(mk())
+
+    # ---- invariants --------------------------------------------------
+    end = len(prog)
+    expected = {}
+    for r in range(n):
+        seq = []
+        for t, W in steps:
+            u = src(r, t)
+            seq += [(u, t, k) for k in range(counts[u][r])]
+        expected[r] = tuple(seq)
+
+    def inv_collision(s):
+        if s["collision"]:
+            return ("a remote write landed in a slot whose previous "
+                    "chunk was not consumed")
+        return None
+
+    def inv_credit(s):
+        for r in range(n):
+            for d in range(n):
+                if d == r:
+                    continue
+                cr, fl, win = (s[f"cr{r}_{d}"], s[f"fl{r}_{d}"],
+                               s[f"win{r}_{d}"])
+                if cr + fl != win:
+                    return (f"lane {r}->{d}: credits {cr} + in-flight "
+                            f"{fl} != granted window {win}")
+                if cr < 0 or win not in (0, D):
+                    return (f"lane {r}->{d}: window {win} / credits "
+                            f"{cr} outside the depth-{D} discipline")
+        return None
+
+    def inv_agree(s):
+        for r in range(n):
+            got = s[f"res{r}"]
+            if got != expected[r][:len(got)]:
+                return (f"rank {r} delivered {got} — not a prefix of "
+                        f"the counts-matrix order {expected[r]}")
+        return None
+
+    def final(s):
+        return all(s[f"pc{r}"] == end for r in range(n))
+
+    label = (f"ici-a2av(n={n},D={D},counts={counts},mut={mutation})")
+    return Model(label, init, ts,
+                 [("no-slot-collision", inv_collision),
+                  ("no-lost-credit", inv_credit),
+                  ("agreement", inv_agree)],
+                 final)

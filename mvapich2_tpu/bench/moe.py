@@ -1,0 +1,224 @@
+"""Token-routing MoE step bench — the device alltoall band's producer.
+
+The workload the device alltoall(v) tier exists for: one
+expert-parallel Mixture-of-Experts step over a p-device mesh (one
+expert per device) is dispatch-alltoallv -> expert matmul ->
+combine-alltoallv, with the per-peer token counts set by the router —
+SKEWED in practice (hot experts), which is exactly what the variable
+chunk schedules of ops/pallas_alltoall.hbm_alltoallv carry without
+padding the wire to the uniform maximum. The bench routes with static
+count matrices (uniform / mildly-skewed zipf / hot-expert) so runs are
+deterministic and two artifacts diff through bin/osu_compare.
+
+Emits an osu_compare-compatible artifact::
+
+    {"results": {"dev_alltoall_effbw": {"<bytes>": GB/s, ...},
+                 "moe_step":           {"<bytes>": us, ...},
+                 "moe_step_skew":      {"<bytes>": us, ...},
+                 "moe_step_hot":       {"<bytes>": us, ...}},
+     "a2a_tiers":   {"<bytes>": "hbm|xla", ...},
+     "wire_bytes":  {"<bytes>": {"uniform": N, "skew": N, "hot": N}},
+     "detail": {...}}
+
+``dev_alltoall_effbw`` is the uniform device alltoall at per-shard
+message size m over ops/pallas_alltoall.ici_all_to_all, effbw =
+(p-1)/p * m / t (the off-chip fraction of the shard — OSU's alltoall
+bus model). The ``moe_step*`` bands are full dispatch+expert+combine
+step latencies in us (lower is better; the "bw"-less name keys
+osu_compare's latency direction) keyed by the per-device token payload
+bytes, one band per routing shape. ``wire_bytes`` is the analytic
+per-rank bytes-on-ICI for each routing shape — skewed routing moves
+FEWER bytes than the uniform pad-to-max wire would, the
+hardware-independent half of the MoE alltoallv claim. On a CPU host
+the kernels run under the Mosaic interpreter over a forced virtual
+mesh (tiny sizes, structural check — BENCH_r09's band); on TPU the
+numbers are the real device band.
+
+    python -m mvapich2_tpu.bench.moe --tokens 64 --dmodel 16 --out X.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+def _ensure_mesh(np_: int) -> None:
+    """A CPU host needs the virtual mesh flag before jax initializes."""
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={np_}").strip()
+
+
+def routing(p: int, tokens: int, shape: str) -> List[List[int]]:
+    """Static per-device routing counts[i][j] = tokens device ``i``
+    sends expert ``j`` (deterministic; rows sum to ``tokens``).
+
+      uniform  every expert gets tokens/p
+      skew     zipf-ish: expert j's share ~ 1/(j+1+i) rotated per
+               device so no expert is globally cold
+      hot      half of every device's tokens pile onto expert 0
+    """
+    out = []
+    for i in range(p):
+        if shape == "uniform":
+            row = [tokens // p] * p
+        elif shape == "hot":
+            rest = tokens - tokens // 2
+            row = [tokens // 2 if j == 0 else 0 for j in range(p)]
+            for j in range(p):
+                row[(i + j) % p] += rest // p
+            row[i] += rest - p * (rest // p)
+        else:                     # skew
+            w = [1.0 / ((i + j) % p + 1) for j in range(p)]
+            tot = sum(w)
+            row = [int(tokens * x / tot) for x in w]
+            row[i] += tokens - sum(row)
+        out.append(row)
+    return out
+
+
+def sweep(token_counts: List[int], dmodel: int = 16, iters: int = 5,
+          interpret: Optional[bool] = None) -> Dict:
+    """Measure the uniform device alltoall band and the MoE step at
+    each per-device token count. Returns the artifact dict (see module
+    docstring)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..ops import pallas_alltoall
+    from ..parallel.mesh import make_mesh, shard_map
+
+    devs = jax.devices()
+    p = len(devs)
+    if p < 2:
+        raise RuntimeError("MoE bench needs >= 2 devices (set XLA_FLAGS="
+                           "--xla_force_host_platform_device_count=N "
+                           "on a CPU host)")
+    if interpret is None:
+        interpret = devs[0].platform != "tpu"
+    mesh = make_mesh((p,), ("x",), devs)
+    sharding = NamedSharding(mesh, P("x", None))
+
+    def timed(body, *xs):
+        f = jax.jit(shard_map(body, mesh=mesh,
+                              in_specs=tuple(P("x", None) for _ in xs),
+                              out_specs=P("x", None), check_vma=False))
+        jax.block_until_ready(f(*xs))     # compile outside the window
+        ts = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(*xs))
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        return ts[len(ts) // 2]
+
+    effbw: Dict[str, float] = {}
+    steps: Dict[str, Dict[str, float]] = {
+        "moe_step": {}, "moe_step_skew": {}, "moe_step_hot": {}}
+    a2a_tiers: Dict[str, str] = {}
+    wire_bytes: Dict[str, Dict[str, int]] = {}
+    shapes = {"moe_step": "uniform", "moe_step_skew": "skew",
+              "moe_step_hot": "hot"}
+    key = jax.random.PRNGKey(0)
+    W = jax.random.normal(key, (dmodel, dmodel), jnp.float32)
+
+    for tokens in token_counts:
+        tokens -= tokens % p                  # uniform band needs p | T
+        tokens = max(tokens, p)
+        n = tokens * dmodel                   # per-shard payload elems
+        m = n * 4
+        tier, _ = pallas_alltoall.planned_a2a_tier(m, jnp.float32,
+                                                   interpret)
+        a2a_tiers[str(m)] = tier
+
+        # uniform device alltoall: the raw wire band
+        x = jax.device_put(
+            jnp.arange(p * n, dtype=jnp.float32).reshape(p, n), sharding)
+        t = timed(lambda s: pallas_alltoall.ici_all_to_all(
+            s.reshape(-1), "x", p, interpret=interpret).reshape(1, -1),
+            x)
+        effbw[str(m)] = round((p - 1) / p * m / t / 1e9, 6)
+
+        # the MoE step per routing shape: dispatch alltoallv ->
+        # expert matmul -> combine alltoallv (reverse counts)
+        wb: Dict[str, int] = {}
+        for band, shape in shapes.items():
+            cm = routing(p, tokens, shape)
+            ecounts = [[c * dmodel for c in row] for row in cm]
+            rcounts = [[ecounts[j][i] for j in range(p)]
+                       for i in range(p)]
+            _, _, in_len, _ = pallas_alltoall.packed_displs(ecounts)
+            wb[shape] = 4 * max(
+                sum(c for j, c in enumerate(row) if j != i)
+                for i, row in enumerate(ecounts))
+
+            def step(v, band=band, ecounts=ecounts, rcounts=rcounts,
+                     in_len=in_len):
+                toks = pallas_alltoall.ici_all_to_allv(
+                    v.reshape(-1), "x", p, ecounts,
+                    interpret=interpret)
+                h = toks.reshape(-1, dmodel) @ W      # expert FFN
+                _, _, rlen, _ = pallas_alltoall.packed_displs(rcounts)
+                back = jnp.zeros((rlen,), jnp.float32)
+                back = back.at[:h.size].set(h.reshape(-1))
+                out = pallas_alltoall.ici_all_to_allv(
+                    back, "x", p, rcounts, interpret=interpret)
+                return jnp.zeros((1, in_len), jnp.float32).at[
+                    0, :out.size].set(out)
+
+            xs = jax.device_put(
+                jnp.ones((p, in_len), jnp.float32), sharding)
+            t = timed(step, xs)
+            steps[band][str(m)] = round(t * 1e6, 3)
+        wire_bytes[str(m)] = wb
+
+    return {"results": {"dev_alltoall_effbw": effbw, **steps},
+            "a2a_tiers": a2a_tiers,
+            "wire_bytes": wire_bytes,
+            "detail": {"devices": p,
+                       "platform": devs[0].platform,
+                       "interpret": bool(interpret),
+                       "dmodel": dmodel,
+                       "iters": iters}}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="moe", description=__doc__.splitlines()[0])
+    ap.add_argument("--tokens", default="",
+                    help="comma-separated per-device token counts "
+                         "(default: a platform-appropriate band)")
+    ap.add_argument("--dmodel", type=int, default=16,
+                    help="model width per token (default 16)")
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--np", type=int, default=8,
+                    help="virtual mesh width on a CPU host")
+    ap.add_argument("--out", default="",
+                    help="artifact path (default: stdout)")
+    args = ap.parse_args(argv)
+    _ensure_mesh(args.np)
+    import jax
+    on_tpu = jax.devices()[0].platform == "tpu"
+    tokens = ([int(s) for s in args.tokens.split(",")] if args.tokens
+              else ([4096, 16384, 65536] if on_tpu else [32, 128]))
+    art = sweep(tokens, dmodel=args.dmodel, iters=args.iters)
+    text = json.dumps(art, indent=1, sort_keys=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

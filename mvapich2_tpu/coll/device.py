@@ -43,6 +43,16 @@ cvar("DEVICE_COLL_MIN_BYTES", 16384, int, "coll",
      "this size keep the host path (device dispatch has fixed "
      "rendezvous+dispatch overhead). Device-resident buffers always take "
      "the device path. Measured profiles override this.")
+cvar("DEVICE_NBC_SEG_BYTES", 1 << 20, int, "coll",
+     "Segment size (bytes per shard) of device NONBLOCKING collectives: "
+     "elementwise-safe ops (iallreduce/ibcast) split into independent "
+     "program segments, each an async dispatch the NBC DAG's poll "
+     "vertices pump to completion — compute overlaps the still-flying "
+     "segments. 0 = one segment (no split).")
+cvar("DEVICE_NBC_MAX_SEGS", 8, int, "coll",
+     "Upper bound on device nonblocking-collective segments per call "
+     "(each segment is one cached program signature; unbounded "
+     "splitting would thrash the program/executable caches).")
 
 from ..utils import is_device_array  # noqa: E402 — shared predicate
 
@@ -179,11 +189,34 @@ class _Rendezvous:
         self.slots: List = [None] * size
         self.result: List = [None] * size
         self.error: Optional[BaseException] = None
+        # nonblocking rendezvous: no barrier to block in — ranks deposit
+        # under nb_lock into per-sequence call records and the NBC DAG's
+        # poll vertices observe arrival/launch/completion state instead
+        self.nb_lock = threading.Lock()
+        self.nb_calls: Dict[int, dict] = {}
+        self.nb_failed = False
 
     def abort(self) -> None:
         """Break the barrier so peers blocked in a device collective see
-        a failure instead of deadlocking (called when a rank dies)."""
+        a failure instead of deadlocking (called when a rank dies).
+        In-flight NONBLOCKING device collectives have no barrier to
+        break: the sticky nb_failed flag makes every later poll raise
+        MPIX_ERR_PROC_FAILED so survivor DAGs unwind."""
+        self.nb_failed = True
         self.barrier.abort()
+
+
+class _VDeposit:
+    """One rank's alltoallv contribution at the rendezvous: the densely
+    packed send payload (canonical packed order — peer 0's elements
+    first) plus this rank's scounts row, from which the leader assembles
+    the full static counts matrix."""
+
+    __slots__ = ("data", "scounts")
+
+    def __init__(self, data, scounts):
+        self.data = data
+        self.scounts = tuple(int(c) for c in scounts)
 
 
 class DeviceCollChannel:
@@ -201,18 +234,19 @@ class DeviceCollChannel:
         # per-instance program cache (a class-level lru_cache would pin
         # freed channels + their compiled executables for process life)
         self._programs: Dict = {}
+        self._nb_seq = 0     # per-rank nonblocking-collective sequence
 
     def abort(self) -> None:
         self.rv.abort()
 
     # -- jitted program cache (per mesh, keyed by op signature) ----------
     def _program(self, name: str, n: int, dtype_str: str, op: str,
-                 root: int):
-        key = (name, n, dtype_str, op, root)
+                 root: int, extra=None):
+        key = (name, n, dtype_str, op, root, extra)
         got = self._programs.get(key)
         if got is None:
             got = self._programs[key] = self._cached_build(
-                name, n, dtype_str, op, root)
+                name, n, dtype_str, op, root, extra)
         return got
 
     def _chan_desc(self) -> str:
@@ -223,26 +257,28 @@ class DeviceCollChannel:
                 f"@{self.axis}")
 
     def _cached_build(self, name: str, n: int, dtype_str: str, op: str,
-                      root: int):
+                      root: int, extra=None):
         """The exec-cache seam around ``_build``: deserialize on hit,
         build + export-on-first-call on miss, plain build whenever the
-        cache is off or this jax cannot export."""
+        cache is off or this jax cannot export. ``extra`` is the
+        per-signature static payload (the alltoallv counts matrix) —
+        part of both cache keys."""
         from ..runtime import daemon
         if not daemon.exec_cache_enabled():
-            return self._build(name, n, op, root)
+            return self._build(name, n, op, root, extra)
         from ..ops import _compat
         ck = "|".join(("mv2t-exec-v1", self._chan_desc(), name,
                        f"n{n}", dtype_str, f"op:{op}", f"root:{root}",
-                       _compat.exec_fingerprint()))
+                       f"x:{extra!r}", _compat.exec_fingerprint()))
         blob = daemon.exec_cache_get(ck)
         if blob is not None:
             fn = _compat.deserialize_executable(blob)
             if fn is not None:
                 return _ImportedProgram(
-                    fn, lambda: self._build(name, n, op, root))
-        return _ExportingProgram(self._build(name, n, op, root), ck)
+                    fn, lambda: self._build(name, n, op, root, extra))
+        return _ExportingProgram(self._build(name, n, op, root, extra), ck)
 
-    def _build(self, name: str, n: int, op: str, root: int):
+    def _build(self, name: str, n: int, op: str, root: int, extra=None):
         import jax
         from jax.sharding import PartitionSpec as P
 
@@ -272,9 +308,20 @@ class DeviceCollChannel:
             c = n // p
 
             def f(x):                       # block [1, n] -> [p, c]
-                v = x.reshape(p, c)
-                return ops.all_to_all(v, axis, split_axis=0, concat_axis=0)
+                # tier dispatch: chunked HBM remote-DMA pairwise streamer
+                # or the XLA lowering (ops/pallas_alltoall)
+                from ..ops import pallas_alltoall
+                return pallas_alltoall.ici_all_to_all(
+                    x.reshape(-1), axis, p).reshape(p, c)
             out_specs = P(axis, None)       # global [p*p, c]
+        elif name == "alltoallv":
+            counts = extra                  # static p x p matrix
+
+            def f(x):                       # block [1, in_len] -> [1, out]
+                from ..ops import pallas_alltoall
+                return pallas_alltoall.ici_all_to_allv(
+                    x.reshape(-1), axis, p, counts).reshape(1, -1)
+            out_specs = P(axis, None)       # global [p, out_len]
         elif name == "reduce_scatter_block":
             c = n // p
             if op == "sum":
@@ -304,6 +351,8 @@ class DeviceCollChannel:
     def _slot_extent(slot):
         """(n, dtype) of a deposited slot without pulling device arrays
         back to the host."""
+        if isinstance(slot, _VDeposit):
+            slot = slot.data
         if is_device_array(slot):
             return int(np.prod(slot.shape)), np.dtype(str(slot.dtype))
         arr = np.asarray(slot)
@@ -352,6 +401,8 @@ class DeviceCollChannel:
         import jax
 
         rv = self.rv
+        if name == "alltoallv":
+            return self._leader_v()
         n, dtype = self._slot_extent(rv.slots[0])
         shards = []
         for r in range(self.size):
@@ -367,6 +418,51 @@ class DeviceCollChannel:
             (self.size, n),
             NamedSharding(self.mesh, P(self.axis, None)), shards)
         out = self._program(name, n, str(dtype), op, root)(global_arr)
+        per_dev: Dict = {}
+        for s in out.addressable_shards:
+            per_dev[s.device] = s.data
+        return [per_dev[self.devices[r]] for r in range(self.size)]
+
+    def _v_shards(self, slots, in_len: int, dtype) -> List:
+        """Per-rank device shards for an alltoallv call: each rank's
+        dense packed payload padded to the mesh-wide ``in_len`` (the
+        shard_map shapes must be uniform)."""
+        import jax
+        shards = []
+        for r in range(self.size):
+            d = slots[r].data
+            if is_device_array(d) and d.devices() == {self.devices[r]}:
+                import jax.numpy as jnp
+                v = d.reshape(-1)
+                if int(v.size) < in_len:
+                    v = jnp.pad(v, (0, in_len - int(v.size)))
+                shards.append(v.reshape(1, in_len))
+            else:
+                buf = np.zeros((1, in_len), dtype)
+                a = np.asarray(d).reshape(-1)
+                buf[0, :a.size] = a
+                shards.append(jax.device_put(buf, self.devices[r]))
+        return shards
+
+    def _leader_v(self) -> List:
+        """Leader compute for alltoallv: assemble the static counts
+        matrix from every rank's deposited scounts row, stage the padded
+        packed payloads, run the counts-keyed program (the matrix is
+        part of the program/executable cache key)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..ops.pallas_alltoall import packed_displs
+        rv = self.rv
+        counts = tuple(tuple(s.scounts) for s in rv.slots)
+        _, _, in_len, _ = packed_displs(counts)
+        _, dtype = self._slot_extent(rv.slots[0])
+        shards = self._v_shards(rv.slots, in_len, dtype)
+        global_arr = jax.make_array_from_single_device_arrays(
+            (self.size, in_len),
+            NamedSharding(self.mesh, P(self.axis, None)), shards)
+        out = self._program("alltoallv", in_len, str(dtype), "none", 0,
+                            counts)(global_arr)
         per_dev: Dict = {}
         for s in out.addressable_shards:
             per_dev[s.device] = s.data
@@ -389,6 +485,19 @@ class DeviceCollChannel:
         n, dtype = self._slot_extent(local)
         nbytes = n * dtype.itemsize * (self.size if name == "allgather"
                                        else 1)
+        if name in ("alltoall", "alltoallv"):
+            from ..ops import pallas_alltoall
+            tier, reason = pallas_alltoall.planned_a2a_tier(
+                max(1, nbytes), dtype)
+            if reason is None:
+                mpit.pvar(f"dev_coll_tier_{tier}").inc()
+                return tier
+            mpit.pvar(f"dev_coll_fallback_{reason}").inc()
+            tr = getattr(comm.u.engine, "tracer", None)
+            if tr is not None:
+                tr.record("channel", "dev_coll_fallback", "i", coll=name,
+                          nbytes=int(nbytes), reason=reason)
+            return "xla"
         if name not in ("allreduce", "reduce", "allgather"):
             return "xla"    # ops without a ring-kernel lowering
         tier, reason = pallas_ici.planned_tier(name, nbytes, dtype, op,
@@ -478,12 +587,329 @@ class DeviceCollChannel:
         out = self._run(comm, "alltoall", local)
         return _deliver(out, recvbuf)
 
+    def alltoallv(self, comm, sendbuf, scounts, sdispls, recvbuf,
+                  rcounts, rdispls, datatype):
+        """MoE-shaped variable-count alltoall: each rank packs its sends
+        densely, deposits payload + scounts row, the leader assembles
+        the static counts matrix and runs the counts-keyed kernel; the
+        canonical packed result is rearranged to the caller's rdispls
+        on the way out."""
+        dep = _VDeposit(_pack_v(sendbuf, scounts, sdispls), scounts)
+        out = self._run(comm, "alltoallv", dep, op=None)
+        return self._deliver_v(out, recvbuf, rcounts, rdispls)
+
+    def _deliver_v(self, out, recvbuf, rcounts, rdispls):
+        """Scatter the canonical packed device result (dense sender
+        order — rank knows its own rcounts column, so no matrix needed)
+        into the caller's layout."""
+        rtotal = int(sum(rcounts))
+        dense = _dense_displs(rcounts)
+        if recvbuf is None or is_device_array(recvbuf) \
+                or type(recvbuf).__name__ == "_InPlace":
+            flat = out.reshape(-1)
+            if list(rdispls) == dense:
+                return flat[:rtotal]
+            # non-dense user layout: assemble on the host, push back
+            import jax
+            host = np.asarray(flat)
+            ext = max((rdispls[j] + rcounts[j]
+                       for j in range(len(rcounts))), default=0)
+            dst = np.zeros(ext, host.dtype)
+            off = 0
+            for j, cnt in enumerate(rcounts):
+                dst[rdispls[j]:rdispls[j] + cnt] = host[off:off + cnt]
+                off += cnt
+            return jax.device_put(dst, self.device)
+        host = np.asarray(out).reshape(-1)
+        dst = np.asarray(recvbuf).reshape(-1)
+        off = 0
+        for j, cnt in enumerate(rcounts):
+            dst[rdispls[j]:rdispls[j] + cnt] = host[off:off + cnt]
+            off += cnt
+        return None
+
     def reduce_scatter_block(self, comm, sendbuf, recvbuf, count, datatype,
                              op):
         local = _as_local(sendbuf, recvbuf, count * comm.size)
         out = self._run(comm, "reduce_scatter_block", local,
                         op=_op_name(op))
         return _deliver(out, recvbuf)
+
+    # -- nonblocking device collectives on the NBC DAG (ISSUE 18) --------
+    # The blocking path rendezvouses on a threading.Barrier; that cannot
+    # ride a schedule vertex (DAG issue must never block). Instead the
+    # i-collective becomes a small DAG: one CALL deposits this rank's
+    # shard into a per-sequence call record, per-segment POLL vertices
+    # launch the async jitted dispatch (first poller past full arrival)
+    # and then re-read its completion state on every engine progress
+    # pass, and a final CALL lands this rank's output shards. drain_all
+    # pumps the parked polls exactly like shm work — communication
+    # overlaps whatever compute the rank does between Icoll and Wait.
+
+    def _nb_segments(self, name: str, n: int, dtype) -> List[tuple]:
+        """[(off, len)] program segments. Elementwise-safe collectives
+        (allreduce/bcast) stream segment-wise — early segments complete
+        while later ones are still flying; structural ones (allgather,
+        alltoall(v)) run as one dispatch."""
+        if name not in ("allreduce", "bcast") or n <= 1:
+            return [(0, n)]
+        cfg = get_config()
+        seg_bytes = int(cfg["DEVICE_NBC_SEG_BYTES"])
+        if seg_bytes <= 0:
+            return [(0, n)]
+        seg = max(1, seg_bytes // max(1, dtype.itemsize))
+        nseg = min(int(cfg["DEVICE_NBC_MAX_SEGS"]),
+                   (n + seg - 1) // seg)
+        if nseg <= 1:
+            return [(0, n)]
+        per = (n + nseg - 1) // nseg
+        return [(o, min(per, n - o)) for o in range(0, n, per)]
+
+    def nonblocking(self, comm, name: str, *a, plan: bool = False):
+        """Build the device-tier request for one i-collective; None when
+        this call cannot route (caller counts dev_coll_fallback_nbc).
+        ``plan=True`` is the MPI_*_init pre-warm: run the same routing
+        gates, then build the program signatures through the exec-cache
+        seam instead of launching (returns True/False)."""
+        if self.mesh is None:
+            return None      # slot channel keeps the host schedule
+        opn, op_sel, root = None, None, 0
+        rcounts = rdispls = None
+        if name == "allreduce":
+            sendbuf, recvbuf, count, datatype, op_sel = a
+            opn = _op_name(op_sel)
+            if opn is None:
+                return None
+            send_eff, n = sendbuf, count
+            wire = count * datatype.size
+        elif name == "bcast":
+            buf, count, datatype, root = a
+            sendbuf = recvbuf = send_eff = buf
+            n = count
+            wire = count * datatype.size
+        elif name == "allgather":
+            sendbuf, recvbuf, count, datatype = a
+            send_eff, n = sendbuf, count
+            wire = count * datatype.size * self.size
+        elif name == "alltoall":
+            sendbuf, recvbuf, count, datatype = a
+            send_eff, n = sendbuf, count * self.size
+            wire = count * datatype.size * self.size
+        elif name == "alltoallv":
+            (sendbuf, scounts, sdispls, recvbuf, rcounts, rdispls,
+             datatype) = a
+            if sdispls is None:
+                sdispls = _dense_displs(scounts)
+            if rdispls is None:
+                rdispls = _dense_displs(rcounts)
+            send_eff, n = sendbuf, int(sum(scounts))
+            wire = n * datatype.size
+        else:
+            return None
+        if type(sendbuf).__name__ == "_InPlace" \
+                or type(recvbuf).__name__ == "_InPlace":
+            return None
+        if recvbuf is None or is_device_array(recvbuf):
+            # jax arrays are immutable: the completion CALL needs a host
+            # recv it can write through at wait() time
+            return None
+        if not _dtype_ok(send_eff) or not _dtype_ok(recvbuf):
+            return None
+        if _select_transport(comm, name, wire, op_sel,
+                             send_eff) != "device":
+            return None
+        if plan:
+            if name == "alltoallv":
+                # the counts MATRIX is cross-rank state: the first
+                # start() assembles it and builds (the build then sticks
+                # in the program + exec caches for every later start)
+                return False
+            return self.prewarm(name, n, np.dtype(send_eff.dtype),
+                                opn or "sum", root)
+        if name == "alltoallv":
+            local = _VDeposit(_pack_v(sendbuf, scounts, sdispls), scounts)
+        else:
+            local = _as_local(sendbuf, recvbuf, n)
+        return self._build_nonblocking(comm, name, local, opn or "sum",
+                                       root, recvbuf, rcounts, rdispls)
+
+    def _build_nonblocking(self, comm, name: str, local, op: str,
+                           root: int, recvbuf, rcounts=None,
+                           rdispls=None):
+        """The i-collective as an NBC DAG (deposit CALL -> per-segment
+        POLLs -> completion CALL); returns the schedule's Request."""
+        from ..core.errors import MPIException, MPIX_ERR_PROC_FAILED
+        from .nbc import engine as nbc_engine
+        from .nbc.dag import SchedDAG
+        rv = self.rv
+        rank = self.rank
+        seq = self._nb_seq
+        self._nb_seq += 1
+        n, dtype = self._slot_extent(local)
+        segs = self._nb_segments(name, n, dtype)
+        dag = SchedDAG()
+
+        def deposit():
+            with rv.nb_lock:
+                if rv.nb_failed:
+                    raise MPIException(
+                        MPIX_ERR_PROC_FAILED,
+                        f"device nonblocking {name}: a peer rank failed")
+                rec = rv.nb_calls.get(seq)
+                if rec is None:
+                    rec = rv.nb_calls[seq] = {
+                        "slots": [None] * self.size, "arrived": 0,
+                        "shards": None, "counts": None,
+                        "outs": [None] * len(segs),
+                        "t0": [None] * len(segs),
+                        "landed": [False] * len(segs),
+                        "picked": 0}
+                rec["slots"][rank] = local
+                rec["arrived"] += 1
+        dep = dag.call(deposit)
+        polls = []
+        for si, (off, ln) in enumerate(segs):
+            polls.append(dag.poll(
+                lambda si=si, off=off, ln=ln: self._nb_poll(
+                    comm, name, seq, si, off, ln, dtype, op, root,
+                    len(segs)),
+                after=(dep,)))
+        dag.call(lambda: self._nb_finish(name, seq, recvbuf, rcounts,
+                                         rdispls),
+                 after=tuple(polls))
+        req = nbc_engine.start(comm, dag, f"dev-i{name}")
+        req.device_nbc = True
+        return req
+
+    def _nb_poll(self, comm, name: str, seq: int, si: int, off: int,
+                 ln: int, dtype, op: str, root: int, nseg: int) -> bool:
+        """One engine pump of a parked device segment. False while peers
+        are still arriving or the dispatch is in flight; the launch
+        itself happens here, on the first poll past full arrival."""
+        import time as _time
+
+        from .. import mpit
+        from ..core.errors import MPIException, MPIX_ERR_PROC_FAILED
+        rv = self.rv
+        if rv.nb_failed:
+            raise MPIException(
+                MPIX_ERR_PROC_FAILED,
+                f"device nonblocking {name}: a peer rank failed")
+        with rv.nb_lock:
+            rec = rv.nb_calls.get(seq)
+            if rec is None or rec["arrived"] < self.size:
+                return False
+            out = rec["outs"][si]
+            if out is None:
+                out = rec["outs"][si] = self._nb_launch(
+                    rec, name, si, off, ln, dtype, op, root)
+                rec["t0"][si] = _time.perf_counter()
+                mpit.pvar("dev_nbc_segments").inc()
+                tr = getattr(comm.u.engine, "tracer", None)
+                if tr is not None:
+                    tr.record("device", "nbc_dev_issue", "i", coll=name,
+                              seg=si, of=nseg, n=int(ln))
+        ready = True
+        if hasattr(out, "is_ready"):
+            try:
+                ready = bool(out.is_ready())
+            except Exception:   # dispatch already resolved: treat as done
+                ready = True
+        if not ready:
+            return False
+        with rv.nb_lock:
+            rec = rv.nb_calls.get(seq)
+            if rec is not None and not rec["landed"][si]:
+                rec["landed"][si] = True
+                dt = _time.perf_counter() - (rec["t0"][si] or 0.0)
+                tr = getattr(comm.u.engine, "tracer", None)
+                if tr is not None:
+                    tr.record("device", "nbc_dev_complete", "i",
+                              coll=name, seg=si, us=round(dt * 1e6, 3))
+                from .. import metrics as _metrics
+                mx = _metrics.LIVE
+                if mx is not None:
+                    mx.rec_us("lat_dev_nbc", dt * 1e6)
+        return True
+
+    def _nb_launch(self, rec: dict, name: str, si: int, off: int,
+                   ln: int, dtype, op: str, root: int):
+        """Dispatch one program segment (under nb_lock, by whichever
+        rank's poll got there first). Staging happens once per call;
+        segment launches are plain async jit dispatches."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if name == "alltoallv":
+            from ..ops.pallas_alltoall import packed_displs
+            counts = tuple(tuple(s.scounts) for s in rec["slots"])
+            _, _, in_len, _ = packed_displs(counts)
+            rec["counts"] = counts
+            shards = self._v_shards(rec["slots"], in_len, dtype)
+            global_arr = jax.make_array_from_single_device_arrays(
+                (self.size, in_len),
+                NamedSharding(self.mesh, P(self.axis, None)), shards)
+            return self._program("alltoallv", in_len, str(dtype), "none",
+                                 0, counts)(global_arr)
+        if rec["shards"] is None:
+            shards = []
+            for r in range(self.size):
+                s = rec["slots"][r]
+                if is_device_array(s) and \
+                        s.devices() == {self.devices[r]}:
+                    shards.append(s.reshape(1, -1))
+                else:
+                    shards.append(jax.device_put(
+                        np.asarray(s).reshape(1, -1), self.devices[r]))
+            rec["shards"] = shards
+        shards = rec["shards"]
+        n = int(shards[0].shape[1])
+        seg = shards if (off, ln) == (0, n) else \
+            [s[:, off:off + ln] for s in shards]
+        global_arr = jax.make_array_from_single_device_arrays(
+            (self.size, ln),
+            NamedSharding(self.mesh, P(self.axis, None)), seg)
+        return self._program(name, ln, str(dtype), op, root)(global_arr)
+
+    def _nb_finish(self, name: str, seq: int, recvbuf, rcounts,
+                   rdispls) -> None:
+        """Completion CALL: every segment polled ready — land this
+        rank's output shards in recvbuf, retire the call record once the
+        last rank picked up."""
+        rv = self.rv
+        with rv.nb_lock:
+            rec = rv.nb_calls[seq]
+            outs = list(rec["outs"])
+        parts = []
+        for out in outs:
+            mine = None
+            for s in out.addressable_shards:
+                if s.device == self.device:
+                    mine = s.data
+                    break
+            parts.append(np.asarray(mine).reshape(-1))
+        res = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        if name == "alltoallv":
+            self._deliver_v(res, recvbuf, rcounts, rdispls)
+        else:
+            _deliver(res, recvbuf)
+        with rv.nb_lock:
+            rec["picked"] += 1
+            if rec["picked"] >= self.size:
+                rv.nb_calls.pop(seq, None)
+
+    def prewarm(self, name: str, n: int, dtype, op: str = "sum",
+                root: int = 0, extra=None) -> bool:
+        """Persistent-init hook: build (or exec-cache fetch) every
+        program signature a start() of this call will dispatch, so the
+        per-start cost is rendezvous + dispatch only. Returns False when
+        a build fails (start falls back to building lazily)."""
+        try:
+            dt = np.dtype(dtype)
+            for _, ln in self._nb_segments(name, n, dt):
+                self._program(name, ln, str(dt), op, root, extra)
+            return True
+        except Exception:   # noqa: BLE001 — warm-up must never fail init
+            return False
 
 
 class HBMSlotChannel(DeviceCollChannel):
@@ -516,6 +942,7 @@ class HBMSlotChannel(DeviceCollChannel):
         self.devices = [device] * size
         self.size = size
         self._programs: Dict = {}
+        self._nb_seq = 0
         # flipped (shared via the rendezvous, since each rank holds its
         # own channel object) when Mosaic rejects the fused kernel on
         # this TPU generation: reductions fall back to the XLA path
@@ -528,7 +955,7 @@ class HBMSlotChannel(DeviceCollChannel):
     def _chan_desc(self) -> str:
         return f"slot{self.size}x{self.device.platform}"
 
-    def _build(self, name: str, n: int, op: str, root: int):
+    def _build(self, name: str, n: int, op: str, root: int, extra=None):
         import jax
         import jax.numpy as jnp
 
@@ -613,6 +1040,36 @@ class HBMSlotChannel(DeviceCollChannel):
         return [out] * R
 
 
+def _dense_displs(counts) -> List[int]:
+    """Dense prefix displacements (the canonical packed layout)."""
+    out, off = [], 0
+    for c in counts:
+        out.append(off)
+        off += int(c)
+    return out
+
+
+def _pack_v(sendbuf, scounts, sdispls):
+    """This rank's alltoallv sends packed densely in peer order (the
+    canonical layout the device kernel's displacement tables assume).
+    Device arrays stay on device; dense user layouts are zero-copy."""
+    if is_device_array(sendbuf):
+        flat = sendbuf.reshape(-1)
+        if list(sdispls) == _dense_displs(scounts):
+            return flat[:int(sum(scounts))]
+        import jax.numpy as jnp
+        parts = [flat[sdispls[j]:sdispls[j] + scounts[j]]
+                 for j in range(len(scounts)) if scounts[j]]
+        return jnp.concatenate(parts) if parts else flat[:0]
+    arr = np.asarray(sendbuf).reshape(-1)
+    if list(sdispls) == _dense_displs(scounts):
+        return np.ascontiguousarray(arr[:int(sum(scounts))])
+    parts = [arr[sdispls[j]:sdispls[j] + scounts[j]]
+             for j in range(len(scounts)) if scounts[j]]
+    return (np.ascontiguousarray(np.concatenate(parts)) if parts
+            else arr[:0].copy())
+
+
 def _as_local(sendbuf, recvbuf, count: int, in_place_start: int = 0):
     """This rank's contribution as a flat [count] array (device or host).
     MPI_IN_PLACE reads from recvbuf; ``in_place_start`` selects the
@@ -655,9 +1112,11 @@ def _deliver(out, recvbuf):
 # ---------------------------------------------------------------------------
 
 # wrapper name -> cvar prefix (reduce_scatter_block shares the
-# REDUCE_SCATTER override, matching the MPI-level collective family)
+# REDUCE_SCATTER override and alltoallv the ALLTOALL one, matching the
+# MPI-level collective family)
 _CVAR_OF = {"allreduce": "ALLREDUCE", "bcast": "BCAST",
             "allgather": "ALLGATHER", "alltoall": "ALLTOALL",
+            "alltoallv": "ALLTOALL",
             "reduce": "REDUCE", "reduce_scatter_block": "REDUCE_SCATTER"}
 
 
@@ -683,6 +1142,13 @@ def _select_transport(comm, name: str, nbytes: int, op, buf) -> str:
         return "host"
     if is_device_array(buf):
         return "device"        # already resident: never stage through host
+    if name == "alltoallv":
+        # the one size input that is NOT required-uniform: each rank
+        # keys on its own sum(scounts), and a zero-count row is legal —
+        # a size-gated decision could diverge (one rank host, peers
+        # device) and deadlock the rendezvous, so the v-variant always
+        # takes the device path once the uniform gates pass
+        return "device"
     # host buffer: crossover (autotuner-overridable)
     from .tuning import device_crossover
     return "device" if nbytes >= device_crossover(name, comm) else "host"
@@ -766,6 +1232,73 @@ def install_device_coll(comm, channel: DeviceCollChannel) -> None:
 
     for name in meta:
         comm.coll_fns[name] = wrap(name)
+
+    # alltoallv: its own wrapper — the signature puts recvbuf at a[3]
+    # (not a[1]) and the transport decision keys on this rank's send
+    # total. Device tier needs the mesh channel (the slot channel keeps
+    # its host path: per-peer variable counts have no slot-transpose).
+    host_a2av = host.get("alltoallv")
+    if host_a2av is not None and channel.mesh is not None:
+        def a2av_entry(comm_, sendbuf, scounts, sdispls, recvbuf,
+                       rcounts, rdispls, datatype):
+            buf = sendbuf
+            if type(buf).__name__ == "_InPlace":
+                buf = recvbuf
+            nbytes = int(sum(scounts)) * datatype.size
+            if type(sendbuf).__name__ != "_InPlace" and \
+                    _select_transport(comm_, "alltoallv", nbytes, None,
+                                      buf) == "device":
+                return channel.alltoallv(
+                    comm_, sendbuf, list(scounts),
+                    list(sdispls) if sdispls is not None
+                    else _dense_displs(scounts),
+                    recvbuf, list(rcounts),
+                    list(rdispls) if rdispls is not None
+                    else _dense_displs(rcounts), datatype)
+            if is_device_array(sendbuf) or is_device_array(recvbuf):
+                raise ValueError(
+                    "alltoallv: device-array buffers need the device "
+                    "transport (host algorithm was forced)")
+            return host_a2av(comm_, sendbuf, scounts, sdispls, recvbuf,
+                             rcounts, rdispls, datatype)
+        comm.coll_fns["alltoallv"] = a2av_entry
+
+
+def build_nonblocking_request(comm, name: str, *a):
+    """Satellite routing hook for coll/nonblocking.py: i-collectives on
+    a device-capable comm ride the device NBC tier; calls the channel
+    cannot route (op/dtype/residency/size, or the slot channel) count
+    dev_coll_fallback_nbc and take the host schedule. Returns the
+    schedule Request or None."""
+    channel = getattr(comm, "device_channel", None)
+    if channel is None or getattr(comm, "is_inter", False):
+        return None
+    try:
+        req = channel.nonblocking(comm, name, *a)
+    except Exception as e:   # noqa: BLE001 — routing must not kill the call
+        log.warn("device nonblocking %s routing failed (%r); host "
+                 "schedule", name, e)
+        req = None
+    if req is None:
+        from .. import mpit
+        mpit.pvar("dev_coll_fallback_nbc").inc()
+    return req
+
+
+def prewarm_persistent(comm, name: str, *a) -> bool:
+    """MPI_*_init hook (core/comm.py _coll_init): when a start() of this
+    persistent collective would route to the device tier, build its
+    program signatures NOW through the exec-cache seam
+    (runtime/daemon.py) — a warm daemon cache turns the init into a
+    deserialize and every start() into rendezvous + dispatch only."""
+    channel = getattr(comm, "device_channel", None)
+    if channel is None:
+        return False
+    try:
+        return bool(channel.nonblocking(comm, name, *a, plan=True))
+    except Exception as e:   # noqa: BLE001 — warm-up must never fail init
+        log.warn("persistent %s pre-warm failed (%r)", name, e)
+        return False
 
 
 # ---------------------------------------------------------------------------

@@ -22,12 +22,14 @@ import numpy as np
 
 # vertex kinds; numeric order IS the issue order inside one ready batch
 # (locals prepare buffers, recvs pre-post before the matching sends go
-# out — the same discipline the legacy phase engine kept per phase)
+# out — the same discipline the legacy phase engine kept per phase;
+# polls issue last so device segments launch after host-side prep)
 CALL = 0
 RECV = 1
 SEND = 2
+POLL = 3
 
-_KIND_NAMES = {CALL: "call", RECV: "recv", SEND: "send"}
+_KIND_NAMES = {CALL: "call", RECV: "recv", SEND: "send", POLL: "poll"}
 
 
 class Vertex:
@@ -83,6 +85,15 @@ class SchedDAG:
              after: Sequence[int] = ()) -> int:
         """Local compute (reduce/copy/unpack) run when its deps finish."""
         return self._add(Vertex(len(self.vertices), CALL, fn=fn), after)
+
+    def poll(self, fn: Callable[[], bool],
+             after: Sequence[int] = ()) -> int:
+        """Asynchronous local work polled to completion: ``fn`` is
+        called when the vertex becomes runnable and then re-polled on
+        every engine progress pass until it returns True (the device-
+        segment shape — issue launches an async Pallas dispatch, the
+        poll reads its completion state instead of blocking)."""
+        return self._add(Vertex(len(self.vertices), POLL, fn=fn), after)
 
     # -- introspection ----------------------------------------------------
     def roots(self) -> List[int]:

@@ -28,7 +28,7 @@ from ... import mpit
 from ...core.datatype import from_numpy_dtype
 from ...core.errors import MPIException, MPI_ERR_INTERN
 from ...core.request import Request
-from .dag import CALL, RECV, SEND, SchedDAG
+from .dag import CALL, POLL, RECV, SEND, SchedDAG
 
 _pv_active = mpit.pvar("nbc_scheds_active", mpit.PVAR_CLASS_LEVEL, "nbc",
                        "nonblocking-collective schedules in flight "
@@ -48,7 +48,7 @@ class _SchedState:
     """One in-flight schedule: runtime dependency counters + requests."""
 
     __slots__ = ("dag", "req", "remaining", "ndeps", "ready", "inflight",
-                 "advancing", "done")
+                 "polling", "advancing", "done")
 
     def __init__(self, dag: SchedDAG, engine, kind: str):
         self.dag = dag
@@ -57,6 +57,7 @@ class _SchedState:
         self.ndeps = [v.ndeps for v in dag.vertices]
         self.ready: List[int] = dag.roots()
         self.inflight: Dict[int, Request] = {}   # vid -> vertex request
+        self.polling: Dict[int, object] = {}     # vid -> poll fn (device)
         self.advancing = False
         self.done = False
 
@@ -131,6 +132,13 @@ class NbcEngine:
                 return
             self._vertex_done(st, vid)
             return
+        if v.kind == POLL:
+            # first poll at issue time (a segment may complete inline —
+            # the interpreter's synchronous dispatch does); incomplete
+            # polls park and are pumped by every engine progress pass
+            if not self._poll_one(st, vid, v.fn):
+                st.polling[vid] = v.fn
+            return
         comm, buf = v.comm, v.buf
         proto = comm.u.protocol
         try:
@@ -158,6 +166,25 @@ class NbcEngine:
         st.inflight[vid] = req
         req.add_callback(
             lambda r, st=st, vid=vid: self._on_completion(st, vid, r))
+
+    def _poll_one(self, st: _SchedState, vid: int,  # holds: mutex
+                  fn) -> bool:
+        """Run one parked poll. True = the vertex completed (or the
+        schedule died); False = still pending, keep it parked."""
+        try:
+            done = bool(fn())
+        except MPIException as e:
+            self._complete(st, e)
+            return True
+        except Exception as e:   # noqa: BLE001 — surfaced at wait()
+            self._complete(st, MPIException(
+                MPI_ERR_INTERN, f"schedule poll op failed: {e!r}"))
+            return True
+        if not done:
+            return False
+        st.polling.pop(vid, None)
+        self._vertex_done(st, vid)
+        return True
 
     def _vertex_done(self, st: _SchedState, vid: int) -> None:  # holds: mutex
         if (tr := self.engine.tracer) is not None:
@@ -209,6 +236,7 @@ class NbcEngine:
                 except MPIException:
                     pass
         st.inflight.clear()
+        st.polling.clear()     # parked device segments: nothing leaks
         st.req.complete(error)
 
     def _cancel(self, st: _SchedState) -> bool:
@@ -230,6 +258,7 @@ class NbcEngine:
                 except MPIException:
                     pass
             st.inflight.clear()
+            st.polling.clear()
             return True
 
     # -- progress hook (mutex held, from progress_poke) -------------------
@@ -238,6 +267,16 @@ class NbcEngine:
             return False
         did = False
         for st in list(self.active):
+            # pump parked device-segment polls: this is how drain_all
+            # progresses device streaming alongside shm work — each
+            # pass re-reads the async dispatch state without blocking
+            for vid, fn in list(st.polling.items()):
+                if st.done:
+                    break
+                if self._poll_one(st, vid, fn):
+                    did = True
+            if st.done:
+                continue
             if st.ready and not st.advancing:
                 self._advance(st)
                 did = True

@@ -105,10 +105,24 @@ def ibarrier(comm) -> Request:
     return s.start()
 
 
+def _device_nbc(comm, name: str, *a) -> Optional[Request]:
+    """Device-tier routing (coll/device.py): i-collectives on a
+    mesh-bound comm become NBC DAGs whose poll vertices pump async
+    device dispatches; every non-routable call on a device-capable comm
+    counts dev_coll_fallback_nbc and builds the host schedule below."""
+    if comm.device_channel is None:
+        return None
+    from . import device as _dev
+    return _dev.build_nonblocking_request(comm, name, *a)
+
+
 def ibcast(comm, buf, count: int, datatype, root: int) -> Request:
     fn = _inter_fn(comm, "ibcast")
     if fn is not None:
         return fn(comm, buf, count, datatype, root)
+    req = _device_nbc(comm, "bcast", buf, count, datatype, root)
+    if req is not None:
+        return req
     tag = comm.next_coll_tag()
     size, rank = comm.size, comm.rank
     s = Sched(comm, tag)
@@ -139,6 +153,10 @@ def iallreduce(comm, sendbuf, recvbuf, count: int, datatype, op: Op
     fn = _inter_fn(comm, "iallreduce")
     if fn is not None:
         return fn(comm, sendbuf, recvbuf, count, datatype, op)
+    req = _device_nbc(comm, "allreduce", sendbuf, recvbuf, count,
+                      datatype, op)
+    if req is not None:
+        return req
     tag = comm.next_coll_tag()
     size, rank = comm.size, comm.rank
     s = Sched(comm, tag)
@@ -220,6 +238,10 @@ def iallgather(comm, sendbuf, recvbuf, count: int, datatype) -> Request:
     fn = _inter_fn(comm, "iallgather")
     if fn is not None:
         return fn(comm, sendbuf, recvbuf, count, datatype)
+    req = _device_nbc(comm, "allgather", sendbuf, recvbuf, count,
+                      datatype)
+    if req is not None:
+        return req
     tag = comm.next_coll_tag()
     size, rank = comm.size, comm.rank
     s = Sched(comm, tag)
@@ -242,6 +264,10 @@ def ialltoall(comm, sendbuf, recvbuf, count: int, datatype) -> Request:
     fn = _inter_fn(comm, "ialltoall")
     if fn is not None:
         return fn(comm, sendbuf, recvbuf, count, datatype)
+    req = _device_nbc(comm, "alltoall", sendbuf, recvbuf, count,
+                      datatype)
+    if req is not None:
+        return req
     tag = comm.next_coll_tag()
     size, rank = comm.size, comm.rank
     s = Sched(comm, tag)
@@ -466,6 +492,10 @@ def iallgatherv(comm, sendbuf, sendcount: int, recvbuf, counts, displs,
 
 def ialltoallv(comm, sendbuf, scounts, sdispls, recvbuf, rcounts,
                rdispls, datatype) -> Request:
+    req = _device_nbc(comm, "alltoallv", sendbuf, scounts, sdispls,
+                      recvbuf, rcounts, rdispls, datatype)
+    if req is not None:
+        return req
     tag = comm.next_coll_tag()
     size, rank = comm.size, comm.rank
     s = Sched(comm, tag)

@@ -312,6 +312,129 @@ class Comm:
         req._start_fn = starter
         return req
 
+    # persistent collectives (MPI_Allreduce_init & friends, MPI-4 §6.12)
+    def _coll_init(self, kind: str, ifn, warm=None) -> Request:
+        """Generic persistent-collective factory: the inactive request
+        re-launches the ``ifn`` nonblocking twin on every start().
+        ``warm`` runs once at init — the device channel uses it to build
+        (or exec-cache fetch) the collective's program signatures so
+        each start() pays rendezvous + dispatch only (coll/device.py
+        prewarm_persistent); starts that ride the device NBC tier count
+        dev_persistent_starts."""
+        req = Request(self.u.engine, f"persistent-{kind}")
+        req.persistent = True
+        if warm is not None:
+            try:
+                warm()
+            except Exception:   # noqa: BLE001 — warm-up is best-effort
+                pass
+
+        def starter(r):
+            i = ifn()
+            if getattr(i, "device_nbc", False):
+                from .. import mpit
+                mpit.pvar("dev_persistent_starts").inc()
+            if not i.complete_flag:
+                def pcancel():
+                    try:
+                        i.cancel()
+                    except MPIException:
+                        pass
+                    return False
+                r._cancel_fn = pcancel
+            else:
+                r._cancel_fn = None
+
+            def done(ireq):
+                r.complete(ireq.error)
+
+            i.add_callback(done)
+
+        req._start_fn = starter
+        return req
+
+    def _coll_warm(self, name: str, *a):
+        """Device pre-warm thunk for ``_coll_init`` (None when this comm
+        has no device channel)."""
+        if self.device_channel is None:
+            return None
+        from ..coll import device as _dev
+        return lambda: _dev.prewarm_persistent(self, name, *a)
+
+    def allreduce_init(self, sendbuf, recvbuf, op=None,
+                       count: Optional[int] = None,
+                       datatype: Optional[Datatype] = None) -> Request:
+        from . import op as opmod
+        op = op or opmod.SUM
+        count, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
+        return self._coll_init(
+            "allreduce",
+            lambda: self.iallreduce(sendbuf, recvbuf, op, count,
+                                    datatype),
+            self._coll_warm("allreduce", sendbuf, recvbuf, count,
+                            datatype, op))
+
+    def bcast_init(self, buf, root: int = 0,
+                   count: Optional[int] = None,
+                   datatype: Optional[Datatype] = None) -> Request:
+        count, datatype = _resolve(buf, count, datatype)
+        return self._coll_init(
+            "bcast",
+            lambda: self.ibcast(buf, root, count, datatype),
+            self._coll_warm("bcast", buf, count, datatype, root))
+
+    def allgather_init(self, sendbuf, recvbuf,
+                       count: Optional[int] = None,
+                       datatype: Optional[Datatype] = None) -> Request:
+        count, datatype = _resolve(sendbuf, count, datatype)
+        return self._coll_init(
+            "allgather",
+            lambda: self.iallgather(sendbuf, recvbuf, count, datatype),
+            self._coll_warm("allgather", sendbuf, recvbuf, count,
+                            datatype))
+
+    def alltoall_init(self, sendbuf, recvbuf,
+                      count: Optional[int] = None,
+                      datatype: Optional[Datatype] = None) -> Request:
+        if count is None:
+            count = np.asarray(sendbuf).size \
+                // getattr(self, "remote_size", self.size)
+        _, datatype = _resolve(sendbuf, count, datatype)
+        return self._coll_init(
+            "alltoall",
+            lambda: self.ialltoall(sendbuf, recvbuf, count, datatype),
+            self._coll_warm("alltoall", sendbuf, recvbuf, count,
+                            datatype))
+
+    def alltoallv_init(self, sendbuf, sendcounts, sdispls, recvbuf,
+                       recvcounts, rdispls,
+                       datatype: Optional[Datatype] = None) -> Request:
+        _, datatype = _resolve(sendbuf, None, datatype)
+        return self._coll_init(
+            "alltoallv",
+            lambda: self.ialltoallv(sendbuf, sendcounts, sdispls,
+                                    recvbuf, recvcounts, rdispls,
+                                    datatype),
+            self._coll_warm("alltoallv", sendbuf, list(sendcounts),
+                            list(sdispls) if sdispls is not None
+                            else None, recvbuf, list(recvcounts),
+                            list(rdispls) if rdispls is not None
+                            else None, datatype))
+
+    def reduce_init(self, sendbuf, recvbuf, op=None, root: int = 0,
+                    count: Optional[int] = None,
+                    datatype: Optional[Datatype] = None) -> Request:
+        from . import op as opmod
+        op = op or opmod.SUM
+        count, datatype = _resolve(sendbuf, count, datatype, alt=recvbuf)
+        return self._coll_init(
+            "reduce",
+            lambda: self.ireduce(sendbuf, recvbuf, op, root, count,
+                                 datatype))
+
+    def barrier_init(self) -> Request:
+        return self._coll_init("barrier", lambda: self.ibarrier())
+
     # ------------------------------------------------------------------
     # collectives — dispatch through coll_fns (the MV2 seam)
     # ------------------------------------------------------------------

@@ -438,6 +438,21 @@ pvar("dev_coll_quant_bytes_saved", PVAR_CLASS_COUNTER, "device",
      "bytes kept off the ICI wire by the quantized tier: exact-wire "
      "minus quantized-wire accounting (ops/pallas_quant.wire_stats) "
      "summed per dispatched call at the collective wrapper")
+pvar("dev_coll_fallback_nbc", PVAR_CLASS_COUNTER, "device",
+     "nonblocking collectives on a device-capable comm that could not "
+     "route through the device tier (op/dtype/residency/size or the "
+     "slot channel) and took the host schedule instead — the NBC "
+     "analog of the dev_coll_fallback_* family (coll/device.py "
+     "build_nonblocking_request)")
+pvar("dev_persistent_starts", PVAR_CLASS_COUNTER, "device",
+     "persistent-collective start() dispatches that rode the device "
+     "nonblocking tier (MPI_*_init handles whose cached program was "
+     "pre-warmed through the exec-cache seam at init time)")
+pvar("dev_nbc_segments", PVAR_CLASS_COUNTER, "device",
+     "device nonblocking-collective program segments launched by the "
+     "NBC DAG's poll vertices (coll/device.py _nb_poll — each launch "
+     "is one async jitted dispatch the engine then pumps to "
+     "completion)")
 
 # device-lane timing observability (ISSUE 10): per-tier effective-
 # bandwidth watermarks measured at the dispatch wrapper
@@ -584,6 +599,9 @@ for _h, _d in (
      "(coll/device.py _run end-to-end)"),
     ("lat_dev_slot", "device collective latency on the slot tier "
      "(coll/device.py _run end-to-end)"),
+    ("lat_dev_nbc", "device nonblocking-collective segment latency "
+     "(coll/device.py _nb_poll: async launch to observed completion "
+     "on the NBC DAG)"),
     ("lat_rndv_chunk", "rendezvous pipeline chunk-batch service time "
      "(transport/base.py account_rndv_chunk: one publish/drain batch "
      "from first copy to hand-off)"),

@@ -1,0 +1,627 @@
+"""Chunked HBM remote-DMA alltoall(v) — the MoE dispatch/combine lane.
+
+The missing workload shape of the device engine: every prior tier moves
+one logical payload (allreduce/bcast/gather); MoE serving moves ``p``
+per-peer payloads per step (token dispatch to experts, then the
+combine), with counts skewed by the router. This module lowers both the
+uniform MPI_Alltoall and the variable-count MPI_Alltoallv onto the same
+slot/credit streaming engine as ops/pallas_ici.py:
+
+  * **Schedule** — the classic pairwise-permutation exchange: at step
+    ``s`` (1..p-1) every shard sends block ``(my+s)%p`` to that peer
+    and receives block ``(my-s)%p`` from the opposite one, so each
+    receiver has exactly one writer per step and the whole step is a
+    fixed permutation (no ring rotation of partials — alltoall payloads
+    are distinct, nothing folds). The local block short-circuits as one
+    HBM-to-HBM DMA before the wire steps.
+  * **Slot discipline** — chunks stream through the same
+    double-buffered VMEM slots, addressed by a per-lane *global* chunk
+    counter that keeps counting across steps (slot = gc % depth): the
+    same collision-free sequence the chunk-credit model proves for the
+    ring, now with the writer changing per step.
+  * **Flow control** — per-step credit waves: at step entry every
+    shard grants ``depth`` slot credits to the shard about to write
+    into it; the receiver re-grants per consumed chunk; at step exit
+    the sender fences on its credit balance returning to ``depth``
+    (its receiver consumed everything), which is exactly the condition
+    that makes the next step's writes land in free slots. Creditless
+    under the 0.4.x interpreter, like every other lane.
+  * **alltoallv** — per-peer counts/displs are static at build time
+    (the mesh channel knows the full count matrix). The wire program
+    (remote DMAs, credit waves, fences) stays a single rank-symmetric
+    op sequence with traced peer indices — paired shards must meet at
+    the SAME op instance, so nothing that rendezvouses may live under
+    a rank conditional; only the local HBM<->VMEM staging, whose
+    offsets and valid prefixes are compile-time constants per rank, is
+    lowered under per-rank ``pl.when(my == r)`` branches. Wire chunks
+    are padded to the step-wide maximum
+    (``W_s = max_r nchunks(counts[r][(r+s)%p])``) and always travel at
+    full chunk size so the DMA byte counts — and therefore the
+    send/recv semaphore pairing — stay uniform along the whole
+    permutation even when the counts are skewed; a pair with fewer (or
+    zero) valid chunks pads with discarded slots but still runs the
+    full credit wave, so no credit leaks on a zero-count peer (the
+    model variant in analysis/model/ici.py seeds exactly that bug).
+  * **Bidirectional** on >2-shard axes: the step list splits across
+    two lanes with disjoint slot arrays (steps 1..ceil((p-1)/2) travel
+    "rightward", the rest "leftward"), both pipelines in flight at
+    once.
+
+Tier selection collapses onto the streaming tier (there is no VMEM
+flat-ring or quantized wire for alltoall yet): coll/tuning's
+``device_tier`` answers hbm or xla, every xla take is counted by the
+``dev_coll_fallback_*`` family, and the XLA lowering (lax.all_to_all,
+plus a scatter-packed emulation for the v-variant) stays the bit-exact
+fallback. Usage: inside ``shard_map`` over a 1-D mesh axis, or through
+the mesh-bound MPI channel (coll/device.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ._compat import HAVE_PALLAS, compiler_params, note_fallback
+from .pallas_ici import (_RingStreamer, _cfg_chunk_elems, _cfg_depth,
+                         _chunks, _resolve_flags, _resolve_ndir,
+                         _trace_entry, planned_tier)
+
+if HAVE_PALLAS:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+# cvar/pvar declarations (ICI_* knobs are shared with the ring engine)
+from .. import mpit  # noqa: F401,E402
+
+# distinct Mosaic collective ids (pallas_ring owns 7/8, pallas_ici
+# 9-11, pallas_quant 12, pallas_rma 13-16)
+_CID_ALLTOALL = 17
+_CID_ALLTOALLV = 18
+
+
+# ---------------------------------------------------------------------------
+# streaming state — the pairwise-permutation form of _RingStreamer
+# ---------------------------------------------------------------------------
+
+class _A2AStreamer(_RingStreamer):
+    """_RingStreamer with the fixed ring neighbors replaced by per-step
+    exchange peers and the single end-of-kernel credit barrier replaced
+    by per-step credit waves (grant depth at entry, fence back to depth
+    at exit — see module docstring). The pending-handle containers,
+    slot counters, and take/grant primitives are inherited unchanged;
+    only the peer routing and the load/store halves differ (alltoall
+    loads from the *input* buffer and never folds)."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        # per-lane step peers — the ring's shared left/right would let
+        # one lane's set_step clobber the other's routing
+        self.step_dst = [None] * self.ndir
+        self.step_up = [None] * self.ndir
+
+    def set_step(self, d, dst, upstream):
+        """Lane ``d`` now sends to ``dst`` and is written by
+        ``upstream``."""
+        self.step_dst[d] = dst
+        self.step_up[d] = upstream
+
+    def grant_step_credits(self, d):          # device: hw-only
+        """Step entry: hand ``depth`` slot credits to the shard about
+        to write into us this step (our slots are provably free — the
+        previous step's fence drained them)."""
+        if not self.credits:
+            return
+        pltpu.semaphore_signal(
+            self.cap_sem.at[d], inc=self.depth,
+            device_id=self._dev(self.step_up[d]),
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    def _grant(self, d):                      # device: hw-only
+        """Per-consume re-grant, targeted at the lane's current step
+        writer (the ring's left/right routing does not apply)."""
+        if not self.credits:
+            return
+        pltpu.semaphore_signal(
+            self.cap_sem.at[d], inc=1,
+            device_id=self._dev(self.step_up[d]),
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    def step_fence(self, d):                  # device: hw-only
+        """Step exit: wait for the credit balance to return to
+        ``depth`` — our receiver consumed every chunk we wrote — then
+        retire the wave's credits so the next step starts from zero."""
+        if not self.credits:
+            return
+        pltpu.semaphore_wait(self.cap_sem.at[d], self.depth)
+
+    def free_slot(self, d):
+        """The slot the next wire chunk will stream through, with its
+        previous outbound DMA retired (send slot free for reload). A
+        shared op — every rank waits on the same handle instance."""
+        slot = self.gc[d] % self.depth
+        prev = self.pending_send.pop((d, slot), None)
+        if prev is not None:
+            prev.wait_send()
+        return slot
+
+    def load_chunk(self, d, x_hbm, src_off, valid):
+        """Local staging (branchable — HBM->VMEM only, no rendezvous):
+        load the valid prefix of the upcoming chunk into its send
+        slot."""
+        slot = self.gc[d] % self.depth
+        ld = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(src_off, valid)],
+            self.send_buf.at[d, slot, pl.ds(0, valid)],
+            self.in_sem.at[d, slot])
+        ld.start()
+        ld.wait()
+
+    def issue_wire(self, d, wire):
+        """Launch the remote DMA at the uniform wire size — the one op
+        both sides of the pair rendezvous on, so it must be traced once
+        for all ranks (peer index stays traced arithmetic)."""
+        slot = self.gc[d] % self.depth
+        self._take_credit(d)
+        dst = self.step_dst[d]
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=self.send_buf.at[d, slot, pl.ds(0, wire)],
+            dst_ref=self.recv_buf.at[d, slot, pl.ds(0, wire)],
+            send_sem=self.send_sem.at[d, slot],
+            recv_sem=self.recv_sem.at[d, slot],
+            device_id=self._dev(dst),
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        rdma.start()
+        self.pending_send[(d, slot)] = rdma
+        self.gc[d] += 1
+        return slot
+
+    def drain_wire(self, d, slot):
+        """The chunk from this step's writer has landed — shared wait
+        on the recv semaphore."""
+        self.pending_send[(d, slot)].wait_recv()
+
+    def store_chunk(self, d, slot, o_hbm, dst_off, valid):
+        """Local staging (branchable): store the landed chunk's valid
+        prefix to its output displacement. The wait keeps the slot's
+        payload live until it is out — the caller re-grants after."""
+        st = pltpu.make_async_copy(
+            self.recv_buf.at[d, slot, pl.ds(0, valid)],
+            o_hbm.at[pl.ds(dst_off, valid)],
+            self.st_sem.at[d, slot])
+        st.start()
+        st.wait()
+
+    def issue_a2a(self, d, x_hbm, src_off, valid, wire):
+        """Front half: load the valid prefix of the chunk from the send
+        buffer (padding chunks skip the load), then launch the remote
+        DMA at the uniform wire size."""
+        self.free_slot(d)
+        if valid > 0:
+            self.load_chunk(d, x_hbm, src_off, valid)
+        return self.issue_wire(d, wire)
+
+    def drain_a2a(self, d, slot, o_hbm, dst_off, valid):
+        """Back half: the chunk from this step's writer has landed —
+        store the valid prefix to its output displacement (padding
+        chunks store nothing) and re-grant the slot."""
+        self.drain_wire(d, slot)
+        if valid > 0:
+            self.store_chunk(d, slot, o_hbm, dst_off, valid)
+        self._grant(d)
+
+    def finish(self):
+        """Exit barrier: outbound DMAs off the send slots. The per-step
+        fences already proved every written chunk was consumed, so
+        there is no final credit wait (the balance is zero by
+        construction, unlike the ring's resting ``depth``)."""
+        for key, h in list(self.pending_send.items()):
+            h.wait_send()
+            del self.pending_send[key]
+        self.drain_stores()
+
+
+def _mk_a2a_streamer(p, ndir, depth, credits, scratch):
+    send_buf, recv_buf, in_sem, st_sem, send_sem, recv_sem, cap_sem = \
+        scratch
+    return _A2AStreamer(p, ndir, depth, credits, 0, 0, None,
+                        send_buf, recv_buf, None, in_sem, None, st_sem,
+                        send_sem, recv_sem, cap_sem)
+
+
+def _a2a_scratch_shapes(ndir: int, depth: int, chunk: int, dtype):
+    return [
+        pltpu.VMEM((ndir, depth, chunk), dtype),    # send slots
+        pltpu.VMEM((ndir, depth, chunk), dtype),    # recv slots
+        pltpu.SemaphoreType.DMA((ndir, depth)),     # send-chunk loads
+        pltpu.SemaphoreType.DMA((ndir, depth)),     # stores
+        pltpu.SemaphoreType.DMA((ndir, depth)),     # remote send
+        pltpu.SemaphoreType.DMA((ndir, depth)),     # remote recv
+        pltpu.SemaphoreType.REGULAR((ndir,)),       # slot credits
+        pltpu.SemaphoreType.DMA(()),                # local-block copy
+    ]
+
+
+def _lane_steps(p: int, ndir: int) -> List[List[int]]:
+    """Permutation steps 1..p-1 split across lanes: the first lane
+    carries the near ("rightward") half, the second the far half —
+    both directions of the physical ring are driven at once on >2
+    shard axes."""
+    steps = list(range(1, p))
+    if ndir == 1:
+        return [steps]
+    h = (len(steps) + 1) // 2
+    return [steps[:h], steps[h:]]
+
+
+def _a2a_wave(st, x_hbm, o_hbm, lanes):
+    """One permutation step across the active lanes: grant the step's
+    credits, pipeline issue-chunk-c / drain-chunk-(c-1) per lane, then
+    fence. ``lanes``: (d, dst, upstream, issues, drains) with
+    issues[k] = (src_off, valid, wire) and drains[k] = (dst_off,
+    valid)."""
+    for d, dst, up, _i, _dr in lanes:
+        st.set_step(d, dst, up)
+        st.grant_step_credits(d)
+    cmax = max(len(i) for _d, _t, _u, i, _dr in lanes)
+    slots = {d: [None] * len(i) for d, _t, _u, i, _dr in lanes}
+    for c in range(cmax + 1):
+        for d, _t, _u, issues, _dr in lanes:
+            if c < len(issues):
+                src_off, valid, wire = issues[c]
+                slots[d][c] = st.issue_a2a(d, x_hbm, src_off, valid,
+                                           wire)
+        for d, _t, _u, issues, drains in lanes:
+            if 1 <= c and c - 1 < len(drains):
+                dst_off, valid = drains[c - 1]
+                st.drain_a2a(d, slots[d][c - 1], o_hbm, dst_off, valid)
+    for d, _t, _u, _i, _dr in lanes:
+        st.step_fence(d)
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+def _hbm_alltoall_kernel(axis_name, p, nblk, chunk, depth, ndir,
+                         credits, x_hbm, o_hbm, *scratch):
+    """Uniform alltoall: input [p*nblk] (block j -> shard j), output
+    [p*nblk] (block j from shard j). The chunk schedule is globally
+    uniform, so the whole program is symmetric — every shard's k-th
+    outgoing handle pairs with its k-th arrival and the peer indices
+    stay traced arithmetic."""
+    my = lax.axis_index(axis_name)
+    init_sem = scratch[-1]
+    st = _mk_a2a_streamer(p, ndir, depth, credits, scratch[:-1])
+
+    # local block: one HBM-to-HBM DMA, no wire
+    cp = pltpu.make_async_copy(x_hbm.at[pl.ds(my * nblk, nblk)],
+                               o_hbm.at[pl.ds(my * nblk, nblk)],
+                               init_sem)
+    cp.start()
+    cp.wait()
+
+    spans = _chunks(0, nblk, chunk)
+    steps = _lane_steps(p, ndir)
+    for q in range(max(len(ls) for ls in steps)):
+        lanes = []
+        for d in range(ndir):
+            if q >= len(steps[d]):
+                continue
+            s = steps[d][q]
+            dst = lax.rem(my + s, p)
+            up = lax.rem(my - s + p, p)
+            lanes.append((d, dst, up,
+                          [(dst * nblk + off, sz, sz)
+                           for off, sz in spans],
+                          [(up * nblk + off, sz) for off, sz in spans]))
+        _a2a_wave(st, x_hbm, o_hbm, lanes)
+    st.finish()
+
+
+def _step_wire(counts: Sequence[Sequence[int]], s: int,
+               chunk: int) -> int:
+    """Wire chunks at permutation step ``s``: the step-wide maximum
+    over every (r -> (r+s)%p) pair — skewed pairs pad up to it so the
+    DMA schedule stays uniform along the permutation."""
+    p = len(counts)
+    return max(-(-counts[r][(r + s) % p] // chunk) for r in range(p))
+
+
+def _hbm_alltoallv_kernel(axis_name, p, chunk, depth, ndir, credits,
+                          counts, sdispls, rdispls, x_hbm, o_hbm,
+                          *scratch):
+    """Variable-count alltoall. Everything that rendezvouses — the
+    remote chunk DMAs, credit signals, fences — is ONE rank-symmetric
+    op sequence with traced peer indices, exactly like the uniform
+    kernel: a pair must meet at the same op instance, so per-rank
+    branches around wire ops would deadlock (each branch would trace
+    its own instance and rank r's op could never pair with rank r+s's).
+    The count matrix only shapes the local staging: per-rank offsets
+    and valid prefixes are compile-time constants lowered under
+    ``pl.when(my == r)``, loads/stores HBM<->VMEM with no cross-device
+    traffic. Every rank runs the full step-wide chunk schedule ``W_s``
+    (skewed pairs pad with discarded slots at the uniform wire size)."""
+    my = lax.axis_index(axis_name)
+    init_sem = scratch[-1]
+    st = _mk_a2a_streamer(p, ndir, depth, credits, scratch[:-1])
+
+    # local block: one HBM-to-HBM DMA per rank, no wire — branch-safe
+    for r in range(p):
+        cloc = counts[r][r]
+        if cloc > 0:
+            @pl.when(my == r)
+            def _local(r=r, cloc=cloc):
+                cp = pltpu.make_async_copy(
+                    x_hbm.at[pl.ds(sdispls[r][r], cloc)],
+                    o_hbm.at[pl.ds(rdispls[r][r], cloc)], init_sem)
+                cp.start()
+                cp.wait()
+
+    def load_branches(d, s, k):
+        """Stage chunk k of the step-s outbound block: each rank's
+        static valid prefix, one local-DMA branch per rank that has
+        payload left at this chunk offset."""
+        off = k * chunk
+        for r in range(p):
+            sv = min(chunk, max(0, counts[r][(r + s) % p] - off))
+            if sv > 0:
+                @pl.when(my == r)
+                def _ld(r=r, sv=sv, off=off):
+                    st.load_chunk(d, x_hbm,
+                                  sdispls[r][(r + s) % p] + off, sv)
+
+    def store_branches(d, slot, s, k):
+        off = k * chunk
+        for r in range(p):
+            up = (r - s) % p
+            rv = min(chunk, max(0, counts[up][r] - off))
+            if rv > 0:
+                @pl.when(my == r)
+                def _st(r=r, up=up, rv=rv, off=off):
+                    st.store_chunk(d, slot, o_hbm,
+                                   rdispls[r][up] + off, rv)
+
+    steps = _lane_steps(p, ndir)
+    for q in range(max(len(ls) for ls in steps)):
+        lanes = []
+        for d in range(ndir):
+            if q >= len(steps[d]):
+                continue
+            s = steps[d][q]
+            W = _step_wire(counts, s, chunk)
+            if W == 0:
+                continue                # whole step is empty mesh-wide
+            st.set_step(d, lax.rem(my + s, p), lax.rem(my - s + p, p))
+            st.grant_step_credits(d)
+            lanes.append((d, s, W))
+        cmax = max((W for _d, _s, W in lanes), default=0)
+        slots = {d: [None] * W for d, _s, W in lanes}
+        for c in range(cmax + 1):
+            for d, s, W in lanes:
+                if c < W:
+                    st.free_slot(d)
+                    load_branches(d, s, c)
+                    slots[d][c] = st.issue_wire(d, chunk)
+            for d, s, W in lanes:
+                if 1 <= c <= W:
+                    st.drain_wire(d, slots[d][c - 1])
+                    store_branches(d, slots[d][c - 1], s, c - 1)
+                    st._grant(d)
+        for d, _s, _W in lanes:
+            st.step_fence(d)
+    st.finish()
+
+
+# ---------------------------------------------------------------------------
+# wrappers
+# ---------------------------------------------------------------------------
+
+def hbm_alltoall(x: jax.Array, axis_name: str, num_devices: int, *,
+                 chunk_bytes: Optional[int] = None,
+                 depth: Optional[int] = None,
+                 bidirectional: Optional[bool] = None,
+                 credits: Optional[bool] = None,
+                 interpret=None) -> jax.Array:
+    """Uniform alltoall along ``axis_name`` via the chunked streaming
+    engine. ``x``: this shard's flat send buffer [p*c] (block j is the
+    payload for shard j); returns [p*c] with block j received from
+    shard j."""
+    p = num_devices
+    if p == 1 or x.size == 0:
+        return x
+    if x.size % p:
+        raise ValueError(f"alltoall shard size {x.size} not divisible "
+                         f"by {p}")
+    if not HAVE_PALLAS:
+        from .collectives import all_to_all
+        c = x.size // p
+        return all_to_all(x.reshape(p, c), axis_name, split_axis=0,
+                          concat_axis=0).reshape(-1)
+    interpret, credits = _resolve_flags(interpret, credits)
+    nblk = x.size // p
+    chunk = min(_cfg_chunk_elems(x.dtype, chunk_bytes), nblk)
+    d = _cfg_depth(depth)
+    ndir = _resolve_ndir(p, bidirectional)
+    kernel = functools.partial(_hbm_alltoall_kernel, axis_name, p,
+                               nblk, chunk, d, ndir, credits)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((x.size,), x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=_a2a_scratch_shapes(ndir, d, chunk, x.dtype),
+        compiler_params=compiler_params(collective_id=_CID_ALLTOALL,
+                                        has_side_effects=True),
+        interpret=interpret,
+    )(x)
+
+
+def packed_displs(counts: Sequence[Sequence[int]]
+                  ) -> Tuple[tuple, tuple, int, int]:
+    """Canonical packed layout for a count matrix: row-major send
+    displacements, column-major receive displacements, and the padded
+    per-shard buffer lengths (every shard's buffers are sized to the
+    mesh-wide maximum so the shard_map shapes stay uniform)."""
+    p = len(counts)
+    sd, rd = [], []
+    in_len = out_len = 1
+    for r in range(p):
+        row, col = [], []
+        so = ro = 0
+        for j in range(p):
+            row.append(so)
+            col.append(ro)
+            so += counts[r][j]
+            ro += counts[j][r]
+        sd.append(tuple(row))
+        rd.append(tuple(col))
+        in_len = max(in_len, so)
+        out_len = max(out_len, ro)
+    return tuple(sd), tuple(rd), in_len, out_len
+
+
+def hbm_alltoallv(x: jax.Array, axis_name: str, num_devices: int,
+                  counts: Sequence[Sequence[int]], *,
+                  sdispls=None, rdispls=None, out_len=None,
+                  chunk_bytes: Optional[int] = None,
+                  depth: Optional[int] = None,
+                  bidirectional: Optional[bool] = None,
+                  credits: Optional[bool] = None,
+                  interpret=None) -> jax.Array:
+    """Variable-count alltoall. ``counts`` is the full static p x p
+    matrix (counts[r][j] = elements shard r sends shard j — the mesh
+    channel assembles it from every rank's scounts); displacements
+    default to the canonical packed layout of ``packed_displs``.
+    ``x``: flat [in_len] per shard; returns flat [out_len] per shard
+    with shard j's payload at rdispls[my][j]."""
+    p = num_devices
+    csd, crd, in_len, c_out = packed_displs(counts)
+    if sdispls is None:
+        sdispls = csd
+    if rdispls is None:
+        rdispls = crd
+    if out_len is None:
+        out_len = c_out
+    if p == 1:
+        return x[:out_len]
+    total = sum(sum(row) for row in counts)
+    if not HAVE_PALLAS or total == 0:
+        return _xla_alltoallv(x, axis_name, p, counts, sdispls, rdispls,
+                              out_len)
+    interpret, credits = _resolve_flags(interpret, credits)
+    cmax = max(max(row) for row in counts)
+    chunk = min(_cfg_chunk_elems(x.dtype, chunk_bytes), max(1, cmax))
+    d = _cfg_depth(depth)
+    ndir = _resolve_ndir(p, bidirectional)
+    counts = tuple(tuple(row) for row in counts)
+    sdispls = tuple(tuple(row) for row in sdispls)
+    rdispls = tuple(tuple(row) for row in rdispls)
+    kernel = functools.partial(_hbm_alltoallv_kernel, axis_name, p,
+                               chunk, d, ndir, credits, counts,
+                               sdispls, rdispls)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((out_len,), x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=_a2a_scratch_shapes(ndir, d, chunk, x.dtype),
+        compiler_params=compiler_params(collective_id=_CID_ALLTOALLV,
+                                        has_side_effects=True),
+        interpret=interpret,
+    )(x)
+
+
+def _xla_alltoallv(x, axis_name, p, counts, sdispls, rdispls, out_len):
+    """Bit-exact XLA emulation of the v-variant: pad every pair to the
+    matrix maximum, run the uniform lax.all_to_all, then scatter each
+    received block's valid prefix to its displacement (out-of-range
+    lanes drop). The padded wire is O(p * cmax) — the streaming kernel
+    exists precisely to beat this."""
+    my = lax.axis_index(axis_name)
+    cmax = max(1, max(max(row) for row in counts))
+    c_arr = jnp.asarray(np.asarray(counts, dtype=np.int32))
+    sd_arr = jnp.asarray(np.asarray(sdispls, dtype=np.int32))
+    rd_arr = jnp.asarray(np.asarray(rdispls, dtype=np.int32))
+    lanes = jnp.arange(cmax, dtype=jnp.int32)
+    xp = jnp.pad(x, (0, cmax))          # safe gather slack
+    blocks = []
+    for j in range(p):                  # pack block j for shard j
+        src = sd_arr[my, j] + lanes
+        seg = jnp.where(lanes < c_arr[my, j], xp[src],
+                        jnp.zeros((), x.dtype))
+        blocks.append(seg)
+    sent = jnp.stack(blocks)            # [p, cmax]
+    recv = lax.all_to_all(sent, axis_name, split_axis=0, concat_axis=0)
+    recv = recv.reshape(p, cmax)
+    out = jnp.zeros((out_len,), x.dtype)
+    for j in range(p):                  # unpack block j from shard j
+        cnt = c_arr[j, my]
+        idx = jnp.where(lanes < cnt, rd_arr[my, j] + lanes, out_len)
+        out = out.at[idx].set(recv[j], mode="drop")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# tier dispatch
+# ---------------------------------------------------------------------------
+
+def planned_a2a_tier(shard_nbytes: int, dtype, interpret=None
+                     ) -> Tuple[str, Optional[str]]:
+    """(tier, fallback_reason) for one device alltoall(v) call — the
+    generic device-tier answer collapsed onto the single streaming
+    engine (no VMEM flat ring or quantized wire for alltoall yet):
+    'hbm' or 'xla'."""
+    tier, reason = planned_tier("alltoall", shard_nbytes, dtype, None,
+                                interpret)
+    if tier in ("vmem", "quant"):
+        tier = "hbm"
+    return tier, reason
+
+
+def ici_all_to_all(x: jax.Array, axis_name: str, num_devices: int,
+                   interpret=None) -> jax.Array:
+    """Tier-dispatched uniform device alltoall: the chunked streaming
+    kernel when the kernels can run, the XLA lowering past the measured
+    crossover or off-platform. ``x``: flat [p*c] send buffer."""
+    p = num_devices
+    if p == 1:
+        return x
+    nbytes = x.size * x.dtype.itemsize
+    tier, reason = planned_a2a_tier(nbytes, x.dtype, interpret)
+    _trace_entry("alltoall", tier, nbytes)
+    if tier == "hbm":
+        return hbm_alltoall(x, axis_name, p, interpret=interpret)
+    note_fallback("alltoall", reason or "size", nbytes, x.dtype)
+    from .collectives import all_to_all
+    c = x.size // p
+    return all_to_all(x.reshape(p, c), axis_name, split_axis=0,
+                      concat_axis=0).reshape(-1)
+
+
+def ici_all_to_allv(x: jax.Array, axis_name: str, num_devices: int,
+                    counts: Sequence[Sequence[int]], *,
+                    out_len: Optional[int] = None,
+                    interpret=None) -> jax.Array:
+    """Tier-dispatched variable-count device alltoall. Tier selection
+    keys on the heaviest shard's send bytes (the wire the busiest
+    expert must move)."""
+    p = num_devices
+    if p == 1:
+        _, _, _, c_out = packed_displs(counts)
+        return x[:out_len if out_len is not None else c_out]
+    itemsize = np.dtype(x.dtype).itemsize
+    nbytes = max(sum(row) for row in counts) * itemsize
+    tier, reason = planned_a2a_tier(max(1, nbytes), x.dtype, interpret)
+    _trace_entry("alltoallv", tier, nbytes)
+    if tier == "hbm":
+        return hbm_alltoallv(x, axis_name, p, counts, out_len=out_len,
+                             interpret=interpret)
+    note_fallback("alltoall", reason or "size", nbytes, x.dtype)
+    sd, rd, _in, c_out = packed_displs(counts)
+    return _xla_alltoallv(x, axis_name, p, counts, sd, rd,
+                          out_len if out_len is not None else c_out)

@@ -101,7 +101,7 @@ _MET_HISTS = (
     "lat_coll_flat", "lat_coll_flat2", "lat_coll_sched",
     "lat_dev_vmem", "lat_dev_hbm", "lat_dev_quant", "lat_dev_xla",
     "lat_dev_slot", "lat_rndv_chunk", "lat_rma_flush",
-    "lat_daemon_attach", "lat_daemon_queue",
+    "lat_daemon_attach", "lat_daemon_queue", "lat_dev_nbc",
 )
 
 # Event-id mirror of the NTE_* enum: index -> (name, protocol region).

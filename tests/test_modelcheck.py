@@ -69,6 +69,19 @@ CLEAN = [
     ("ici-n3-C2-D2-quant-bidir", lambda: ici.build_ring(
         3, 2, 2, bidir=True, quant=True)),
     ("ici-n2-C4-D3-quant", lambda: ici.build_ring(2, 4, 3, quant=True)),
+    # MoE-shaped alltoallv wire (ISSUE 18): per-peer variable chunk
+    # counts on the global-counter slot schedule — uniform, skewed,
+    # zero-count-peer and zero-width-step count matrices all green
+    ("ici-a2av-n2-uniform", lambda: ici.build_alltoallv(
+        2, 2, [[0, 2], [2, 0]])),
+    ("ici-a2av-n2-skew", lambda: ici.build_alltoallv(
+        2, 2, [[0, 1], [3, 0]])),
+    ("ici-a2av-n2-zero-peer", lambda: ici.build_alltoallv(
+        2, 2, [[0, 0], [2, 0]])),
+    ("ici-a2av-n2-D3", lambda: ici.build_alltoallv(
+        2, 3, [[0, 2], [4, 0]])),
+    ("ici-a2av-n3-skew", lambda: ici.build_alltoallv(
+        3, 2, [[0, 2, 1], [1, 0, 2], [0, 1, 0]])),
     # passive-target one-sided epoch (ops/pallas_rma.py + rma/device.py):
     # lock / chunk-credit accumulate stream / flush / unlock against a
     # concurrent local reader and the two-phase target fold
@@ -162,6 +175,10 @@ EXPECTED_INVARIANT = {
     # packed codes + recv signal -> a dequant-fold outside the
     # declared block-quant bound
     "scale_after_payload": {"agreement"},
+    # MoE-shaped alltoallv wire (ISSUE 18): variable per-peer counts
+    # on the global-counter slot schedule
+    "skewed_count_slot": {"no-slot-collision", "agreement"},
+    "zero_count_credit_leak": {"no-lost-credit", "deadlock"},
     # passive-target one-sided epoch (ops/pallas_rma.py)
     "flush_skips_chunk": {"flush-completes-all-outstanding"},
     "unlock_before_drain": {"no-torn-window-read"},
@@ -246,6 +263,34 @@ def test_ici_matrix_has_six_mutations():
                     "depth_mismatch", "signal_before_copy",
                     "bidir_shared_slot", "recv_before_send_wave",
                     "scale_after_payload"}
+
+
+def test_a2av_matrix_has_two_mutations():
+    """ISSUE 18: the alltoallv variant (per-peer variable chunk counts
+    on the global-counter slot schedule) seeds >= 2 distinct protocol
+    breaks, each caught by a named invariant via test_mutation_caught
+    over the matrix."""
+    muts = {m[2] for m in M.mutation_matrix() if m[0] == "ici-a2av"}
+    assert muts == {"skewed_count_slot", "zero_count_credit_leak"}
+
+
+def test_a2av_violation_trace_replays():
+    """A skewed-count slot-collision trace replays from init to a
+    violating state — the counterexample is actionable."""
+    m = ici.build_alltoallv(2, 2, [[0, 1], [3, 0]],
+                            mutation="skewed_count_slot")
+    r = M.explore(m)
+    v = next(v for v in r.violations
+             if v.invariant == "no-slot-collision")
+    state = dict(m.init)
+    by_name = {t.name: t for t in m.transitions}
+    for step in v.trace:
+        t = by_name[step]
+        assert t.guard(state), f"trace step {step} not enabled on replay"
+        state = t.apply(state)
+    name, pred = next(i for i in m.invariants
+                      if i[0] == "no-slot-collision")
+    assert pred(state) is not None, "replayed state does not violate"
 
 
 def test_rma_matrix_has_five_mutations():
@@ -418,6 +463,42 @@ def test_full_depth_ici_mutations_np3():
                     ("recv_before_send_wave", dict(chunks=3, depth=2)),
                     ("scale_after_payload", dict(chunks=3, depth=2))]:
         r = M.explore(ici.build_ring(3, mutation=mut, **kw))
+        assert not r.ok, mut
+
+
+@pytest.mark.modelcheck
+@pytest.mark.parametrize("n", [2, 3, 4])
+@pytest.mark.parametrize("depth", [2, 3])
+@pytest.mark.parametrize("shape", ["uniform", "skew", "zero"])
+def test_full_depth_a2av_matrix(n, depth, shape):
+    """ISSUE 18 acceptance: the clean alltoallv wire is exhaustively
+    green (no slot collision, no lost credit, counts-matrix agreement,
+    no deadlock) for np in {2,3,4} x depth in {2,3} over uniform,
+    skewed and zero-count-peer count matrices."""
+    if shape == "uniform":
+        counts = [[0 if i == j else 2 for j in range(n)]
+                  for i in range(n)]
+    elif shape == "skew":
+        counts = [[0 if i == j else (i + 2 * j) % 3 for j in range(n)]
+                  for i in range(n)]
+    else:
+        counts = [[0] * n for _ in range(n)]
+        for i in range(1, n):
+            counts[i][(i + 1) % n] = 2     # rank 0 sends nothing
+    r = M.explore(ici.build_alltoallv(n, depth, counts),
+                  max_states=2_000_000)
+    assert r.complete, f"truncated at {r.states} states"
+    assert r.ok, [f"{v.invariant}: {v.message}" for v in r.violations]
+
+
+@pytest.mark.modelcheck
+def test_full_depth_a2av_mutations_np3():
+    """The alltoallv mutations still caught away from their minimal
+    configs (np=3, depth 3, multi-step skew)."""
+    for mut in ("skewed_count_slot", "zero_count_credit_leak"):
+        r = M.explore(ici.build_alltoallv(
+            3, 3, [[0, 1, 2], [3, 0, 0], [1, 2, 0]], mutation=mut),
+            max_states=2_000_000)
         assert not r.ok, mut
 
 
