@@ -9,6 +9,11 @@ a 4-rank hang:
 
         locks       guarded-by lock discipline (# guarded-by: _lock)
         tags        tag-namespace disjointness (*_TAG_BASE ranges)
+        events      trace event-coverage doctor: every tracer.record
+                    name, NTE_* member, and rec_us/rec_since histogram
+                    sample must be known to the conformance grammars
+                    (conform.py) / _MET_HISTS — the lat_dev_nbc
+                    silent-drop bug class, caught mechanically
         pvars       pvar/cvar registry consistency + naming convention
                     + the native/bin/README env-drift doctor
         blocking    no blocking calls in progress callbacks/pkt handlers
@@ -31,6 +36,16 @@ a 4-rank hang:
     graph, detect cycles (potential deadlock) and held-across-
     progress-wait violations, and report through the stall-watchdog /
     debugger dump path.
+
+  * ``bin/mv2tconform`` (conform.py) — runtime verification: replays a
+    real run's traces (bin/mpitrace merges, Finalize dump dirs, raw
+    .ntrace/.metrics segments) through per-protocol conformance
+    automata whose invariant names are the model checkers'
+    (analysis/model/*), with replayable counterexample windows; the
+    stall watchdog runs the truncation-safe subset over the trace tail
+    on a hang. The NBC automaton's event grammar is imported from
+    model/nbc.TRACE_EVENTS, so the offline proof and the runtime check
+    cannot drift apart.
 """
 
 from .core import Finding, load_baseline, run_passes, scan_paths  # noqa: F401

@@ -1,0 +1,968 @@
+"""Trace conformance checker (bin/mv2tconform) — runtime verification
+of live runs against the protocol models.
+
+Every protocol surface is model-checked offline (analysis/model/*: 50+
+seeded mutations caught) and every layer emits traces (recorder ring,
+ntrace C-plane ring, metrics rows, Perfetto merges) — this module is
+the bridge: it replays a *real run's* events through per-protocol
+conformance automata whose invariant names are the model checkers'
+invariant names, so "the job ran" becomes "the job ran AND obeyed the
+invariants the models prove". Strictly offline/post-mortem: the checker
+reads merged dumps, Finalize trace files, or (read-only) ntrace/metrics
+segments — it never touches a live job's hot path.
+
+Inputs (auto-detected by ``main``):
+
+  * a merged Perfetto JSON written by ``bin/mpitrace`` (pid = rank,
+    cat = layer, ``metrics:*`` counter tracks);
+  * a trace dump directory / individual ``trace-r*.json`` Finalize
+    dumps (recorder snapshot schema, ntrace + metrics rows embedded);
+  * a raw ntrace segment (``<stem>.ntrace``, read via
+    trace.native.read_ring — works on unlinked-but-open rings);
+  * a raw metrics segment (``<stem>.metrics``).
+
+Automata and their invariants (names shared with analysis/model/*):
+
+  flat-wave   fanin-before-fold-before-fanout, mseq-monotone,
+              poison-sticky, proc-failed-poison (the failure class: a
+              poisoned run is never silently certified clean)
+  doorbell    no-lost-wake
+  lease       detect-within-deadline (2x MV2T_PEER_TIMEOUT),
+              no-false-positive (an expired peer that demonstrably
+              departed cleanly — DEPARTED is never a failure)
+  nbc-dag     nbc-deposit-before-poll, nbc-issue-before-complete,
+              nbc-drained-at-finalize, no-slot-collision (segment
+              POLLs launch in slot-schedule order) — event grammar
+              imported from analysis/model/nbc.TRACE_EVENTS
+  device-lane span-balance over dev_* dispatch spans, ici_* instant
+              grammar
+  rma-epoch   lock-exclusive, flush-completes-all-outstanding (every
+              op dispatch instant lands inside a flush/fence
+              completion wave)
+  metrics     counter-monotone (fp_* mirror + sampled pvars, incl. the
+              daemon claim/epoch counters), gauge-nonnegative
+  spans       span-balance + event grammar for the mpi / protocol /
+              channel / progress layers
+
+Violations are ``Violation(invariant, message, state, trace)`` — the
+model checkers' counterexample format — where ``trace`` is the
+replayable event window that produced the violation: feed it back
+through ``replay()`` and the same invariant trips.
+
+Tail mode (``check_tail``) runs only the truncation-safe invariants
+over a trace-tail window — the stall watchdog calls it on a hang and
+names the first violated invariant in its report. Ranks whose ring
+wrapped (events == capacity in the dump) get the same relaxation in
+full mode: order checks that need the dropped prefix are skipped
+rather than mis-fired.
+
+Exit codes (the conformance-stamp contract for perf/bench sessions):
+0 = clean, 1 = violations found, 2 = usage error, 3 = unreadable
+input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from .model import nbc as _nbc_model
+
+_HIST_CAP = 64          # replay-window cap per automaton scope
+
+
+@dataclass(frozen=True)
+class Event:
+    ts: float
+    rank: int
+    layer: str
+    name: str
+    ph: str
+    args: Optional[Dict[str, Any]] = None
+
+
+@dataclass
+class Violation:
+    """Same shape as model.explorer.Violation, plus the automaton that
+    tripped — ``trace`` is the replayable counterexample window."""
+    invariant: str
+    message: str
+    state: Dict[str, Any]
+    trace: List[str]
+    automaton: str = ""
+    rank: int = -1
+
+
+def fmt_event(ev: Event) -> str:
+    args = json.dumps(ev.args, sort_keys=True) if ev.args else "{}"
+    return (f"{ev.ts:.6f} r{ev.rank} [{ev.layer}] {ev.name} "
+            f"{ev.ph} {args}")
+
+
+def parse_event(line: str) -> Event:
+    ts, rank, layer, name, ph, args = line.split(" ", 5)
+    return Event(float(ts), int(rank[1:]), layer[1:-1], name, ph,
+                 json.loads(args) or None)
+
+
+def _match(pattern: str, name: str) -> bool:
+    if pattern == "*":
+        return True
+    if pattern.endswith("*"):
+        return name.startswith(pattern[:-1])
+    if pattern.startswith("*"):
+        return name.endswith(pattern[1:])
+    return name == pattern
+
+
+# ---------------------------------------------------------------------------
+# automata
+# ---------------------------------------------------------------------------
+
+class Automaton:
+    """One protocol surface's conformance machine. ``grammar`` is the
+    (layer, name-pattern) event vocabulary — the lint event-coverage
+    doctor checks every emitted tracer event lands in some automaton's
+    grammar. ``tail_safe`` names the invariants that stay sound on a
+    truncated window (the watchdog's trace tail / a wrapped ring)."""
+
+    name: str = ""
+    grammar: Tuple[Tuple[str, str], ...] = ()
+    invariants: Tuple[str, ...] = ()
+    tail_safe: FrozenSet[str] = frozenset()
+
+    def __init__(self, tail: bool = False,
+                 options: Optional[Dict[str, Any]] = None):
+        self.tail = tail
+        self.opt = options or {}
+        self.truncated: FrozenSet[int] = frozenset(
+            self.opt.get("truncated", ()))
+        self.ranks: Optional[FrozenSet[int]] = None   # set before finish
+        self.violations: List[Violation] = []
+        self._hist: Dict[Any, List[Event]] = {}
+
+    # -- driver interface -------------------------------------------------
+    def matches(self, ev: Event) -> bool:
+        return any(ev.layer == layer and _match(pat, ev.name)
+                   for layer, pat in self.grammar)
+
+    def feed(self, ev: Event) -> None:
+        raise NotImplementedError
+
+    def finish(self) -> None:
+        pass
+
+    # -- helpers ----------------------------------------------------------
+    def _strict(self, rank: int) -> bool:
+        """Order checks needing the (possibly dropped) prefix."""
+        return not self.tail and rank not in self.truncated
+
+    def _note(self, scope: Any, ev: Event) -> None:
+        h = self._hist.setdefault(scope, [])
+        h.append(ev)
+        if len(h) > _HIST_CAP:
+            del h[0]
+
+    def _viol(self, invariant: str, message: str, scope: Any = None,
+              state: Optional[Dict[str, Any]] = None,
+              rank: int = -1) -> None:
+        if self.tail and invariant not in self.tail_safe:
+            return
+        trace = [fmt_event(e) for e in self._hist.get(scope, [])]
+        self.violations.append(Violation(
+            invariant, message, dict(state or {}), trace,
+            automaton=self.name, rank=rank))
+
+
+class FlatWaveAutomaton(Automaton):
+    """The seqlock flat/flat2/mcast collective waves (cplane.cpp) —
+    shares poison-sticky with model.seqlock/flat2; the wave order and
+    mseq checks are the trace projections of their numbering proofs."""
+
+    name = "flat-wave"
+    grammar = (("cplane", "flat_fanin"), ("cplane", "flat_fold"),
+               ("cplane", "flat_fanout"), ("cplane", "flat_poison"),
+               ("cplane", "flat2_fold"), ("cplane", "flat2_xchg"),
+               ("cplane", "flat2_fanout"), ("cplane", "mcast_pub"),
+               ("cplane", "mcast_cons"), ("cplane", "coll_dispatch"))
+    invariants = ("fanin-before-fold-before-fanout", "mseq-monotone",
+                  "poison-sticky", "proc-failed-poison")
+    tail_safe = frozenset({"mseq-monotone", "poison-sticky",
+                           "proc-failed-poison"})
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._fanin: Dict[Tuple[int, int], set] = {}    # (rank,ctx)->seqs
+        self._mseq: Dict[Tuple[int, int, str], int] = {}
+        self._ctxs: Dict[int, set] = {}                 # rank -> ctxs seen
+        self._poisoned: Dict[int, set] = {}             # rank -> ctx snap
+
+    def feed(self, ev: Event) -> None:
+        r = ev.rank
+        a1 = (ev.args or {}).get("a1", 0)
+        a2 = (ev.args or {}).get("a2", 0)
+        if ev.name == "flat_poison":
+            # a1 is the poison rc, not a ctx: poison seals every ctx
+            # this rank had active — re-key after shrink mints fresh
+            # ctxs, which legitimately keep running
+            self._note(("poison", r), ev)
+            # the poison event also enters every live ctx window on
+            # this rank, so a poison-sticky counterexample replays
+            for c in self._ctxs.get(r, ()):
+                self._note((r, c), ev)
+            self._poisoned.setdefault(r, set()).update(
+                self._ctxs.get(r, ()))
+            self._viol("proc-failed-poison",
+                       f"rank {r} poisoned its flat region (rc={a1}) — "
+                       "a PROC_FAILED unwind ran; this trace is a "
+                       "failure run, not a clean one",
+                       scope=("poison", r),
+                       state={"rank": r, "rc": a1}, rank=r)
+            return
+        if ev.name == "coll_dispatch":
+            return                       # tier-choice instant, no order
+        ctx = a1
+        self._ctxs.setdefault(r, set()).add(ctx)
+        scope = (r, ctx)
+        self._note(scope, ev)
+        if ctx in self._poisoned.get(r, ()):
+            self._viol("poison-sticky",
+                       f"rank {r}: {ev.name} on ctx {ctx} after this "
+                       "rank poisoned it — poison must be sticky "
+                       "until re-key", scope=scope,
+                       state={"rank": r, "ctx": ctx, "event": ev.name},
+                       rank=r)
+        if ev.name == "flat_fanin":
+            self._fanin.setdefault(scope, set()).add(a2)
+        elif ev.name in ("flat_fold", "flat_fanout"):
+            if self._strict(r) and a2 not in self._fanin.get(scope, ()):
+                self._viol("fanin-before-fold-before-fanout",
+                           f"rank {r}: {ev.name} seq {a2} on ctx {ctx} "
+                           "without this rank's fanin for that wave",
+                           scope=scope,
+                           state={"rank": r, "ctx": ctx, "seq": a2},
+                           rank=r)
+        if ev.name in ("flat_fanin", "flat2_fold", "flat2_xchg",
+                       "flat2_fanout", "mcast_pub", "mcast_cons"):
+            mscope = (r, ctx, ev.name)
+            last = self._mseq.get(mscope)
+            if last is not None and a2 < last:
+                self._viol("mseq-monotone",
+                           f"rank {r}: {ev.name} seq went {last} -> "
+                           f"{a2} on ctx {ctx} — wave numbering must "
+                           "be monotone per region", scope=scope,
+                           state={"rank": r, "ctx": ctx,
+                                  "seq": a2, "prev": last}, rank=r)
+            self._mseq[mscope] = max(a2, last or 0)
+
+
+class DoorbellAutomaton(Automaton):
+    """The adaptive wait/wake doorbell — model.doorbell's
+    no-lost-wake, projected onto the merged timeline: a wake implies
+    somebody rang."""
+
+    name = "doorbell"
+    grammar = (("cplane", "bell_ring"), ("cplane", "bell_wake"),
+               ("cplane", "spin_bell"))
+    invariants = ("no-lost-wake",)
+    tail_safe = frozenset()
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._rings = 0
+
+    def feed(self, ev: Event) -> None:
+        self._note("bell", ev)
+        if ev.name == "bell_ring":
+            self._rings += 1
+        elif ev.name == "bell_wake":
+            if self._strict(ev.rank) and self._rings == 0:
+                self._viol("no-lost-wake",
+                           f"rank {ev.rank} woke from the doorbell but "
+                           "no ring was ever published before it",
+                           scope="bell",
+                           state={"rank": ev.rank, "rings": 0},
+                           rank=ev.rank)
+
+
+class LeaseAutomaton(Automaton):
+    """The liveness-lease failure detector — model.lease's deadline and
+    DEPARTED-never-failed invariants, checked from lease_expire's
+    staleness argument and the dump set."""
+
+    name = "lease"
+    grammar = (("cplane", "lease_scan"), ("cplane", "lease_expire"))
+    invariants = ("detect-within-deadline", "no-false-positive")
+    tail_safe = frozenset({"detect-within-deadline"})
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._expired: List[Tuple[int, int, Event]] = []
+
+    def feed(self, ev: Event) -> None:
+        self._note("lease", ev)
+        if ev.name != "lease_expire":
+            return
+        peer = (ev.args or {}).get("a1", -1)
+        stale_us = (ev.args or {}).get("a2", 0)
+        self._expired.append((ev.rank, peer, ev))
+        timeout = float(self.opt.get("peer_timeout", 0.0))
+        if timeout > 0 and stale_us > 2 * timeout * 1e6:
+            self._viol("detect-within-deadline",
+                       f"rank {ev.rank} declared peer {peer} dead at "
+                       f"staleness {stale_us / 1e6:.3f}s — over the "
+                       f"2x deadline of the {timeout:.1f}s lease "
+                       "timeout", scope="lease",
+                       state={"rank": ev.rank, "peer": peer,
+                              "staleness_us": stale_us,
+                              "timeout_s": timeout}, rank=ev.rank)
+
+    def finish(self) -> None:
+        if self.tail or self.ranks is None:
+            return
+        # a peer that wrote a Finalize dump departed cleanly — the
+        # scan skips DEPARTED stamps, so expiring it is a false
+        # positive (the lease model's clean-departure invariant)
+        for rank, peer, ev in self._expired:
+            if peer in self.ranks:
+                self._viol("no-false-positive",
+                           f"rank {rank} declared peer {peer} dead, "
+                           "but that peer reached Finalize and dumped "
+                           "a trace — DEPARTED is never a failure",
+                           scope="lease",
+                           state={"rank": rank, "peer": peer},
+                           rank=rank)
+
+
+class NbcAutomaton(Automaton):
+    """The NBC DAG scheduler — grammar imported from
+    model.nbc.TRACE_EVENTS so this automaton and the exhaustive model
+    can never drift apart; invariant names are the model's."""
+
+    name = "nbc-dag"
+    grammar = tuple((layer, n) for layer, names
+                    in sorted(_nbc_model.TRACE_EVENTS.items())
+                    for n in names)
+    invariants = ("nbc-deposit-before-poll", "nbc-issue-before-complete",
+                  "nbc-drained-at-finalize", "no-slot-collision")
+    tail_safe = frozenset({"nbc-deposit-before-poll",
+                           "nbc-issue-before-complete",
+                           "no-slot-collision"})
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        # (rank, sched) -> state; tracking starts at sched_start, so
+        # tail windows / wrapped rings simply never adopt half-seen
+        # schedules instead of mis-firing on them
+        self._sched: Dict[Tuple[int, Any], Dict[str, Any]] = {}
+        self._dev: Dict[Tuple[int, str, int], int] = {}   # seg inflight
+
+    def feed(self, ev: Event) -> None:
+        r = ev.rank
+        args = ev.args or {}
+        if ev.layer == "device":
+            key = (r, args.get("coll", "?"), args.get("seg", -1))
+            scope = ("dev", r, args.get("coll", "?"))
+            self._note(scope, ev)
+            if ev.name == "nbc_dev_issue":
+                self._dev[key] = self._dev.get(key, 0) + 1
+            elif ev.name == "nbc_dev_complete":
+                left = self._dev.get(key, 0)
+                if left <= 0 and self._strict(r):
+                    self._viol("nbc-issue-before-complete",
+                               f"rank {r}: nbc_dev_complete for "
+                               f"{key[1]} seg {key[2]} with no "
+                               "outstanding nbc_dev_issue",
+                               scope=scope, state={"rank": r,
+                                                   "coll": key[1],
+                                                   "seg": key[2]},
+                               rank=r)
+                else:
+                    self._dev[key] = left - 1
+            return
+        sid = args.get("sched")
+        scope = (r, sid)
+        self._note(scope, ev)
+        st = self._sched.get(scope)
+        if ev.name == "sched_start":
+            self._sched[scope] = {
+                "kind": str(args.get("kind", "")),
+                "vertices": args.get("vertices", 0),
+                "issued": {}, "call_done": False, "done": False,
+                "last_poll_vid": None, "start": ev,
+            }
+            return
+        if st is None:
+            return                      # start outside the window
+        if st["done"]:
+            self._viol("nbc-drained-at-finalize",
+                       f"rank {r}: {ev.name} on schedule {sid} after "
+                       "its sched_complete — completed schedules must "
+                       "be inert", scope=scope,
+                       state={"rank": r, "sched": sid,
+                              "event": ev.name}, rank=r)
+            return
+        if ev.name == "vertex_issue":
+            vid, kind = args.get("vid"), args.get("kind")
+            st["issued"][vid] = kind
+            if kind == _nbc_model.POLL and st["kind"].startswith("dev-i"):
+                if not st["call_done"]:
+                    self._viol("nbc-deposit-before-poll",
+                               f"rank {r}: segment POLL v{vid} of "
+                               f"{st['kind']} sched {sid} issued "
+                               "before the deposit CALL completed",
+                               scope=scope,
+                               state={"rank": r, "sched": sid,
+                                      "vid": vid}, rank=r)
+                lp = st["last_poll_vid"]
+                if lp is not None and vid <= lp:
+                    self._viol("no-slot-collision",
+                               f"rank {r}: {st['kind']} sched {sid} "
+                               f"launched POLL v{vid} after v{lp} — "
+                               "segments must launch in slot-schedule "
+                               "order", scope=scope,
+                               state={"rank": r, "sched": sid,
+                                      "vid": vid, "prev": lp}, rank=r)
+                st["last_poll_vid"] = vid
+        elif ev.name == "vertex_complete":
+            vid = args.get("vid")
+            if vid not in st["issued"]:
+                self._viol("nbc-issue-before-complete",
+                           f"rank {r}: completion wakeup on v{vid} of "
+                           f"schedule {sid}, which was never issued",
+                           scope=scope,
+                           state={"rank": r, "sched": sid, "vid": vid},
+                           rank=r)
+            elif st["issued"][vid] == _nbc_model.CALL:
+                st["call_done"] = True
+        elif ev.name == "sched_complete":
+            st["done"] = True
+
+    def finish(self) -> None:
+        if self.tail:
+            return
+        for (r, sid), st in sorted(self._sched.items(),
+                                   key=lambda kv: repr(kv[0])):
+            if not st["done"] and r not in self.truncated:
+                self._viol("nbc-drained-at-finalize",
+                           f"rank {r}: schedule {sid} ({st['kind']}) "
+                           "started but never completed — "
+                           "nbc_scheds_active not drained at Finalize",
+                           scope=(r, sid),
+                           state={"rank": r, "sched": sid,
+                                  "kind": st["kind"]}, rank=r)
+
+
+class DeviceLaneAutomaton(Automaton):
+    """The device dispatch lane: ici_* kernel-entry instants and dev_*
+    dispatch spans (coll/device.py + ops/pallas_ici.py)."""
+
+    name = "device-lane"
+    grammar = (("device", "ici_*"), ("device", "dev_*"))
+    invariants = ("span-balance",)
+    tail_safe = frozenset()
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._open: Dict[Tuple[int, str], int] = {}
+
+    def feed(self, ev: Event) -> None:
+        if ev.ph not in ("B", "E"):
+            return
+        key = (ev.rank, ev.name)
+        self._note(key, ev)
+        if ev.ph == "B":
+            self._open[key] = self._open.get(key, 0) + 1
+        else:
+            n = self._open.get(key, 0)
+            if n <= 0 and self._strict(ev.rank):
+                self._viol("span-balance",
+                           f"rank {ev.rank}: E for {ev.name} with no "
+                           "open B span", scope=key,
+                           state={"rank": ev.rank, "name": ev.name},
+                           rank=ev.rank)
+            else:
+                self._open[key] = n - 1
+
+    def finish(self) -> None:
+        if self.tail:
+            return
+        for (r, name), n in sorted(self._open.items()):
+            if n > 0 and r not in self.truncated:
+                self._viol("span-balance",
+                           f"rank {r}: {name} span opened {n}x and "
+                           "never closed by Finalize", scope=(r, name),
+                           state={"rank": r, "name": name, "open": n},
+                           rank=r)
+
+
+class RmaAutomaton(Automaton):
+    """The one-sided passive-target epoch grammar (rma/device.py) —
+    model.rma's lock-exclusive and flush-completes-all-outstanding:
+    every op dispatch instant must land inside a flush/fence
+    completion wave, and the lock epoch never double-opens."""
+
+    name = "rma-epoch"
+    grammar = (("device", "rma_*"),)
+    invariants = ("lock-exclusive", "flush-completes-all-outstanding")
+    tail_safe = frozenset({"lock-exclusive"})
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._locked: Dict[int, set] = {}
+        self._wave: Dict[int, int] = {}       # open flush/fence spans
+
+    def feed(self, ev: Event) -> None:
+        r = ev.rank
+        self._note(r, ev)
+        args = ev.args or {}
+        if ev.name == "rma_lock":
+            t = args.get("rank", -1)
+            held = self._locked.setdefault(r, set())
+            if t in held:
+                self._viol("lock-exclusive",
+                           f"rank {r}: MPI_Win_lock on target {t} "
+                           "while already holding that epoch",
+                           scope=r, state={"rank": r, "target": t},
+                           rank=r)
+            held.add(t)
+        elif ev.name == "rma_unlock":
+            t = args.get("rank", -1)
+            held = self._locked.setdefault(r, set())
+            if t not in held:
+                if self._strict(r):
+                    self._viol("lock-exclusive",
+                               f"rank {r}: MPI_Win_unlock on target "
+                               f"{t} without an open lock epoch",
+                               scope=r, state={"rank": r, "target": t},
+                               rank=r)
+            else:
+                held.discard(t)
+        elif ev.name in ("rma_flush", "rma_fence"):
+            if ev.ph == "B":
+                self._wave[r] = self._wave.get(r, 0) + 1
+            elif ev.ph == "E":
+                self._wave[r] = max(0, self._wave.get(r, 0) - 1)
+        elif ev.name in ("rma_put", "rma_acc", "rma_get"):
+            if self._wave.get(r, 0) <= 0 and self._strict(r):
+                self._viol("flush-completes-all-outstanding",
+                           f"rank {r}: {ev.name} dispatched outside "
+                           "any flush/fence completion wave — ops "
+                           "must complete inside the wave that "
+                           "accounts for them", scope=r,
+                           state={"rank": r, "op": ev.name}, rank=r)
+
+
+class MetricsAutomaton(Automaton):
+    """The sampled metrics rows (fp_* fast-path mirror + python pvars,
+    incl. the daemon claim/epoch counters): cumulative series must be
+    monotone per (rank, slot) — the trace projection of
+    model.daemon's epoch-fresh counter discipline — and level gauges
+    never go negative."""
+
+    name = "metrics"
+    grammar = (("metrics", "*"),)
+    invariants = ("counter-monotone", "gauge-nonnegative")
+    tail_safe = frozenset({"counter-monotone", "gauge-nonnegative"})
+    GAUGES = ("daemon_claims_active",)
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._last: Dict[Tuple[int, str], int] = {}
+
+    def feed(self, ev: Event) -> None:
+        val = (ev.args or {}).get("value", 0)
+        key = (ev.rank, ev.name)
+        self._note(key, ev)
+        if ev.name in self.GAUGES:
+            if val < 0:
+                self._viol("gauge-nonnegative",
+                           f"rank {ev.rank}: gauge {ev.name} went "
+                           f"negative ({val})", scope=key,
+                           state={"rank": ev.rank, "slot": ev.name,
+                                  "value": val}, rank=ev.rank)
+            return
+        last = self._last.get(key)
+        if last is not None and val < last:
+            self._viol("counter-monotone",
+                       f"rank {ev.rank}: counter {ev.name} went "
+                       f"{last} -> {val} — cumulative series must be "
+                       "monotone within a job epoch", scope=key,
+                       state={"rank": ev.rank, "slot": ev.name,
+                              "value": val, "prev": last}, rank=ev.rank)
+        self._last[key] = max(val, last or 0)
+
+
+class SpanAutomaton(Automaton):
+    """Grammar + span balance for the python-side layers: mpi entry
+    interposition spans, protocol instants, channel packet instants,
+    progress waits."""
+
+    name = "spans"
+    grammar = (("mpi", "*"),
+               ("protocol", "eager_send"), ("protocol", "eager_recv"),
+               ("protocol", "rndv_rts"), ("protocol", "rndv_rts_recv"),
+               ("protocol", "rndv_cts"), ("protocol", "rndv_fin"),
+               ("protocol", "rndv_chunk"),
+               ("channel", "*_send"), ("channel", "*_recv"),
+               ("channel", "dev_coll_fallback"),
+               ("progress", "progress_wait"), ("progress", "idle"),
+               ("progress", "wake"),
+               ("progress", "stall_watchdog_trip"),
+               ("cplane", "eager_tx"), ("cplane", "eager_rx"),
+               ("cplane", "rndv_tx"), ("cplane", "rndv_rx"))
+    invariants = ("span-balance",)
+    tail_safe = frozenset()
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._open: Dict[Tuple[int, str, str], int] = {}
+
+    def feed(self, ev: Event) -> None:
+        if ev.ph not in ("B", "E"):
+            return
+        key = (ev.rank, ev.layer, ev.name)
+        self._note(key, ev)
+        if ev.ph == "B":
+            self._open[key] = self._open.get(key, 0) + 1
+        else:
+            n = self._open.get(key, 0)
+            if n <= 0 and self._strict(ev.rank):
+                self._viol("span-balance",
+                           f"rank {ev.rank}: [{ev.layer}] E for "
+                           f"{ev.name} with no open B span", scope=key,
+                           state={"rank": ev.rank, "layer": ev.layer,
+                                  "name": ev.name}, rank=ev.rank)
+            else:
+                self._open[key] = n - 1
+
+    def finish(self) -> None:
+        if self.tail:
+            return
+        for (r, layer, name), n in sorted(self._open.items()):
+            if n > 0 and r not in self.truncated:
+                self._viol("span-balance",
+                           f"rank {r}: [{layer}] {name} span opened "
+                           f"{n}x and never closed by Finalize",
+                           scope=(r, layer, name),
+                           state={"rank": r, "layer": layer,
+                                  "name": name, "open": n}, rank=r)
+
+
+AUTOMATA = (FlatWaveAutomaton, DoorbellAutomaton, LeaseAutomaton,
+            NbcAutomaton, DeviceLaneAutomaton, RmaAutomaton,
+            MetricsAutomaton, SpanAutomaton)
+
+
+def build_automata(tail: bool = False,
+                   options: Optional[Dict[str, Any]] = None
+                   ) -> List[Automaton]:
+    return [cls(tail=tail, options=options) for cls in AUTOMATA]
+
+
+def event_grammars() -> Dict[str, Tuple[str, ...]]:
+    """layer -> every automaton name-pattern covering it (the lint
+    event-coverage doctor's ground truth)."""
+    out: Dict[str, List[str]] = {}
+    for cls in AUTOMATA:
+        for layer, pat in cls.grammar:
+            if pat not in out.setdefault(layer, []):
+                out[layer].append(pat)
+    return {layer: tuple(pats) for layer, pats in out.items()}
+
+
+def grammar_covers(layer: str, name: str) -> bool:
+    """Is an emitted event name (or emitted prefix pattern like
+    ``ici_*``) covered by some automaton's grammar?"""
+    pats = event_grammars().get(layer, ())
+    if name in pats or "*" in pats:
+        return True
+    for pat in pats:
+        if _match(pat, name):
+            return True
+        # emitted-pattern vs grammar-pattern: an f-string emission like
+        # ici_* is covered by an identical (or wider) grammar prefix
+        if (name.endswith("*") and pat.endswith("*")
+                and name[:-1].startswith(pat[:-1])):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# the checker driver
+# ---------------------------------------------------------------------------
+
+def check_events(events: Sequence[Event], tail: bool = False,
+                 options: Optional[Dict[str, Any]] = None,
+                 ranks: Optional[FrozenSet[int]] = None
+                 ) -> List[Violation]:
+    """Replay ``events`` (sorted by ts) through every automaton;
+    returns the combined violation list. ``ranks`` is the set of ranks
+    that produced Finalize dumps (None = unknown)."""
+    autos = build_automata(tail=tail, options=options)
+    unknown: Dict[Tuple[str, str], int] = {}
+    for ev in sorted(events, key=lambda e: e.ts):
+        matched = False
+        for a in autos:
+            if a.matches(ev):
+                a.feed(ev)
+                matched = True
+        if not matched:
+            unknown[(ev.layer, ev.name)] = \
+                unknown.get((ev.layer, ev.name), 0) + 1
+    out: List[Violation] = []
+    for a in autos:
+        a.ranks = ranks
+        a.finish()
+        out.extend(a.violations)
+    if unknown and not tail:
+        pairs = ", ".join(f"[{l}] {n} (x{c})"
+                          for (l, n), c in sorted(unknown.items()))
+        out.append(Violation(
+            "grammar-coverage",
+            f"events outside every automaton's grammar: {pairs} — the "
+            "emitter and the conformance grammars have drifted (run "
+            "mv2tlint's event-coverage doctor)",
+            {"unknown": sorted(f"{l}:{n}" for l, n in unknown)}, [],
+            automaton="driver"))
+    return out
+
+
+def check_tail(rank: int, tail_events: Sequence[Sequence[Any]],
+               options: Optional[Dict[str, Any]] = None
+               ) -> List[Violation]:
+    """The stall watchdog's entry point: recorder-format tail rows
+    ``(ts, layer, name, ph, args)`` of ONE rank, checked with only the
+    truncation-safe invariants armed."""
+    evs = [Event(float(ts), rank, layer, name, ph, args or None)
+           for ts, layer, name, ph, args in tail_events]
+    return check_events(evs, tail=True, options=options)
+
+
+def replay(v: Violation,
+           options: Optional[Dict[str, Any]] = None) -> List[Violation]:
+    """Feed a violation's counterexample window back through fresh
+    automata — the replayability contract: the same invariant trips."""
+    evs = [parse_event(line) for line in v.trace]
+    return [w for w in check_events(evs, tail=False, options=options)
+            if w.invariant == v.invariant]
+
+
+# ---------------------------------------------------------------------------
+# loaders
+# ---------------------------------------------------------------------------
+
+def _dump_to_events(d: Dict[str, Any]) -> Tuple[List[Event], bool]:
+    rank = int(d.get("rank", 0))
+    evs = [Event(float(ts), rank, layer, name, ph, args or None)
+           for ts, layer, name, ph, args in d.get("events", ())]
+    for ts, vals in d.get("metrics") or ():
+        for slot, val in vals.items():
+            evs.append(Event(float(ts), rank, "metrics", slot, "C",
+                             {"value": val}))
+    cap = d.get("capacity") or 0
+    truncated = bool(cap) and len(d.get("events", ())) >= cap
+    return evs, truncated
+
+
+def load_dumps(paths: Sequence[str]
+               ) -> Tuple[List[Event], FrozenSet[int], FrozenSet[int]]:
+    """trace-r*.json Finalize dumps -> (events, ranks, truncated)."""
+    events: List[Event] = []
+    ranks, truncated = set(), set()
+    for path in paths:
+        with open(path) as f:
+            d = json.load(f)
+        evs, trunc = _dump_to_events(d)
+        events.extend(evs)
+        ranks.add(int(d.get("rank", 0)))
+        if trunc:
+            truncated.add(int(d.get("rank", 0)))
+    return events, frozenset(ranks), frozenset(truncated)
+
+
+def load_perfetto(path: str) -> Tuple[List[Event], FrozenSet[int]]:
+    """A merged bin/mpitrace JSON -> (events, ranks). Counter tracks
+    (``metrics:*``) become metrics-layer events; metadata is skipped.
+    Ring-wrap information does not survive the merge, so order checks
+    run strict — feed the dump directory instead for wrapped rings."""
+    with open(path) as f:
+        merged = json.load(f)
+    rows = merged.get("traceEvents")
+    if rows is None:
+        raise ValueError(f"{path}: not a merged trace (no traceEvents)")
+    events: List[Event] = []
+    ranks = set()
+    for row in rows:
+        ph = row.get("ph", "")
+        if ph == "M":
+            continue
+        rank = int(row.get("pid", 0))
+        ranks.add(rank)
+        name = row.get("name", "")
+        ts = float(row.get("ts", 0.0)) / 1e6
+        if ph == "C":
+            slot = name[len("metrics:"):] if name.startswith("metrics:") \
+                else name
+            events.append(Event(ts, rank, "metrics", slot, "C",
+                                {"value": (row.get("args") or {}
+                                           ).get("value", 0)}))
+            continue
+        events.append(Event(ts, rank, row.get("cat", "?"), name, ph,
+                            row.get("args") or None))
+    return events, frozenset(ranks)
+
+
+def load_ntrace(path: str) -> List[Event]:
+    """A raw ntrace segment (read-only, works unlinked-but-open):
+    every ring's events as cplane instants, rank = ring index."""
+    from ..trace import native
+    events: List[Event] = []
+    for i in range(native._rank_count(path)):
+        for ts_us, ev, a1, a2 in native.read_ring(path, i):
+            events.append(Event(ts_us / 1e6, i, "cplane",
+                                native.event_name(ev), "i",
+                                {"a1": a1, "a2": a2}))
+    return events
+
+
+def load_metrics_segment(path: str) -> List[Event]:
+    """A raw metrics segment: every rank's sample rows as metrics-layer
+    counter events."""
+    from ..metrics import ring as mring
+    events: List[Event] = []
+    names = mring.slot_names()
+    for i, blob in mring.read_all(path).items():
+        for ts_us, vals in blob.get("rows", ()):
+            for nm, v in zip(names, vals):
+                if nm and v:
+                    events.append(Event(ts_us / 1e6, i, "metrics", nm,
+                                        "C", {"value": v}))
+    return events
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _default_peer_timeout() -> float:
+    try:
+        from ..utils.config import get_config
+        return float(get_config().get("PEER_TIMEOUT", 10.0) or 0.0)
+    except Exception:
+        return 10.0
+
+
+def render(violations: List[Violation], nevents: int,
+           verbose: bool = False) -> str:
+    lines = []
+    for v in violations:
+        where = f" (rank {v.rank})" if v.rank >= 0 else ""
+        lines.append(f"VIOLATION {v.automaton}/{v.invariant}{where}: "
+                     f"{v.message}")
+        if v.state:
+            lines.append(f"  state: {json.dumps(v.state, sort_keys=True)}")
+        if v.trace:
+            lines.append(f"  counterexample ({len(v.trace)} events):")
+            lines.extend(f"    {line}" for line in v.trace)
+    nauto = len(AUTOMATA)
+    lines.append(f"# mv2tconform: {nevents} events through {nauto} "
+                 f"automata, {len(violations)} violation(s)")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mv2tconform",
+        description="Replay a run's traces through the protocol "
+                    "conformance automata. Exit 0 clean, 1 violations, "
+                    "2 usage, 3 unreadable input.")
+    ap.add_argument("inputs", nargs="+",
+                    help="merged Perfetto JSON, trace dump dir, "
+                         "trace-r*.json files, .ntrace or .metrics "
+                         "segments (mixable)")
+    ap.add_argument("--peer-timeout", type=float, default=None,
+                    help="lease timeout seconds for the "
+                         "detect-within-deadline check (default: the "
+                         "MV2T_PEER_TIMEOUT cvar)")
+    ap.add_argument("--tail", action="store_true",
+                    help="truncation-safe invariants only (a partial "
+                         "window, e.g. a hung job's segments)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable violation list")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    try:
+        opts = ap.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+
+    events: List[Event] = []
+    ranks: set = set()
+    truncated: set = set()
+    ranks_known = False
+    try:
+        for inp in opts.inputs:
+            if os.path.isdir(inp):
+                paths = sorted(glob.glob(
+                    os.path.join(inp, "trace-r*.json")))
+                if not paths:
+                    print(f"mv2tconform: no trace-r*.json under {inp}",
+                          file=sys.stderr)
+                    return 3
+                evs, rs, tr = load_dumps(paths)
+                events.extend(evs)
+                ranks.update(rs)
+                truncated.update(tr)
+                ranks_known = True
+            elif inp.endswith(".ntrace"):
+                events.extend(load_ntrace(inp))
+            elif inp.endswith(".metrics"):
+                events.extend(load_metrics_segment(inp))
+            elif inp.endswith(".json"):
+                with open(inp) as f:
+                    head = f.read(4096)
+                if '"traceEvents"' in head:
+                    evs, rs = load_perfetto(inp)
+                    events.extend(evs)
+                    ranks.update(rs)
+                    ranks_known = True
+                else:
+                    evs, rs, tr = load_dumps([inp])
+                    events.extend(evs)
+                    ranks.update(rs)
+                    truncated.update(tr)
+                    ranks_known = True
+            else:
+                print(f"mv2tconform: unrecognized input {inp} (want a "
+                      "dir, .json, .ntrace, or .metrics)",
+                      file=sys.stderr)
+                return 2
+    except (OSError, ValueError, KeyError) as e:
+        print(f"mv2tconform: cannot read input: {e}", file=sys.stderr)
+        return 3
+
+    timeout = opts.peer_timeout
+    if timeout is None:
+        timeout = _default_peer_timeout()
+    violations = check_events(
+        events, tail=opts.tail,
+        options={"peer_timeout": timeout,
+                 "truncated": frozenset(truncated)},
+        ranks=frozenset(ranks) if ranks_known else None)
+    if opts.as_json:
+        print(json.dumps([{
+            "automaton": v.automaton, "invariant": v.invariant,
+            "rank": v.rank, "message": v.message, "state": v.state,
+            "trace": v.trace} for v in violations], indent=2))
+    else:
+        print(render(violations, len(events), opts.verbose))
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -162,9 +162,10 @@ def all_passes(native_sources: Optional[Sequence[str]] = None,
     README; [] disables it for fixture runs); ``profile_files`` /
     ``device_profiles`` override the tuning-profile JSON set of the
     profile doctor and the device pass's VMEM-budget estimator."""
-    from . import (blocking, device, locks, native, profilecheck, proto,
-                   registry, tags, traceguard)
+    from . import (blocking, device, events, locks, native, profilecheck,
+                   proto, registry, tags, traceguard)
     return [locks.LockDisciplinePass(), tags.TagNamespacePass(),
+            events.EventCoveragePass(),
             registry.RegistryPass(
                 doc_sources=list(doc_sources)
                 if doc_sources is not None else None),

@@ -63,13 +63,24 @@ uncovered) gets the same treatment before ROADMAP item 4 grows it:
     forever on a dead or diverted peer, re-key never reuses a poisoned
     ctx/lane, reused regions never deliver torn words.
 
+The nonblocking lane (coll/nbc/engine.py, PR 18's deposit/POLL/
+complete device schedules) gets ``nbc.build_nbc``: the DAG scheduler —
+dependency-ordered vertex issue, segment-wise async hardware dispatch,
+wakeup-driven completion fan-out, the progress hook pumping parked
+polls, persistent start re-init over exec-cache epoch reuse, and the
+cancel/error unwind — proving deps-before-issue, deposit-before-poll,
+issue-before-complete, drained-at-finalize, epoch freshness, and
+deadlock freedom. Its ``TRACE_EVENTS`` table doubles as the runtime
+event grammar of analysis/conform.py's NBC conformance automaton, so
+the offline proof and the live-trace check share one source of truth.
+
 Every model takes ``mutation=<name>`` seeding a realistic protocol
 break (stamp-before-copy, missing final poll, throttle past the
 deadline, ...); tests/test_modelcheck.py asserts the checker catches
 each one and that the unmutated models are violation-free.
 """
 
-from . import daemon, doorbell, flat2, ft, ici, lease, rma, seqlock, wiring  # noqa: F401,E501
+from . import daemon, doorbell, flat2, ft, ici, lease, nbc, rma, seqlock, wiring  # noqa: F401,E501
 from .explorer import Model, Result, Transition, Violation, explore  # noqa: F401
 
 
@@ -158,6 +169,35 @@ def mutation_matrix():
             n=2, depth=2, counts=[[0, 0], [2, 0]],
             mutation="zero_count_credit_leak"),
          "zero_count_credit_leak"),
+        ("ici-a2av", lambda: ici.build_alltoallv(
+            n=2, depth=2, counts=[[0, 1], [3, 0]],
+            mutation="local_width_wire"),
+         "local_width_wire"),
+        ("ici-a2av", lambda: ici.build_alltoallv(
+            n=2, depth=2, counts=[[0, 0], [2, 0]],
+            mutation="zero_count_entry_skip"),
+         "zero_count_entry_skip"),
+        # NBC DAG scheduler (coll/nbc/engine.py)
+        ("nbc-dag", lambda: nbc.build_nbc(
+            shape="device", segs=2, mutation="issue_ignores_deps"),
+         "issue_ignores_deps"),
+        ("nbc-dag", lambda: nbc.build_nbc(
+            shape="device", segs=1, mutation="poll_never_pumped"),
+         "poll_never_pumped"),
+        ("nbc-dag", lambda: nbc.build_nbc(
+            shape="net", mutation="lost_completion_wakeup"),
+         "lost_completion_wakeup"),
+        ("nbc-dag", lambda: nbc.build_nbc(
+            shape="device", segs=2, error=True,
+            mutation="unwind_leaves_inflight"),
+         "unwind_leaves_inflight"),
+        ("nbc-dag", lambda: nbc.build_nbc(
+            shape="device", segs=1, persistent=True,
+            mutation="stale_persistent_reuse"),
+         "stale_persistent_reuse"),
+        ("nbc-dag", lambda: nbc.build_nbc(
+            shape="net", mutation="spurious_completion"),
+         "spurious_completion"),
         # passive-target one-sided epoch (ops/pallas_rma.py)
         ("rma-passive", lambda: rma.build_passive(
             chunks=3, depth=2, cells=1, mutation="flush_skips_chunk"),

@@ -370,6 +370,18 @@ def build_alltoallv(n: int, depth: int, counts,
                              below-max pair (a zero-count peer in the
                              extreme) leaks shut and the sender's fence
                              starves
+      local_width_wire       the sender sizes its wire from its LOCAL
+                             count instead of the step-wide max — no
+                             padding chunks on a below-max lane, so the
+                             receiver's byte-count-blind drain schedule
+                             waits forever on chunks that never launch
+                             (the transport-asymmetry deadlock class
+                             the pad-to-max wire exists to rule out)
+      zero_count_entry_skip  the receiver's step entry skips the
+                             depth-D grant when it expects zero VALID
+                             chunks from its upstream — but the wire
+                             still carries W padding chunks, and the
+                             ungranted sender starves at issue
     """
     assert n >= 2 and depth >= 1
     D = depth
@@ -395,17 +407,26 @@ def build_alltoallv(n: int, depth: int, counts,
         G0[t] = g
         g += W
 
-    # the serialized per-rank program (identical across ranks: W is
-    # step-wide): entry grant, issue/drain alternation, exit fence
-    prog = []
-    for t, W in steps:
-        prog.append(("entry", t, 0))
-        for k in range(W):
-            prog.append(("issue", t, k))
-            if k >= 1:
-                prog.append(("drain", t, k - 1))
-        prog.append(("drain", t, W - 1))
-        prog.append(("fence", t, 0))
+    # the serialized per-rank programs: entry grant, issue/drain
+    # alternation, exit fence. Identical across ranks (W is step-wide)
+    # EXCEPT under the local_width_wire mutant, where a sender streams
+    # only its local count and skips the padding issues
+    progs = []
+    for r in range(n):
+        prog = []
+        for t, W in steps:
+            send_w = W
+            if mutation == "local_width_wire":
+                send_w = min(W, counts[r][dst(r, t)])
+            prog.append(("entry", t, 0))
+            for k in range(W):
+                if k < send_w:
+                    prog.append(("issue", t, k))
+                if k >= 1:
+                    prog.append(("drain", t, k - 1))
+            prog.append(("drain", t, W - 1))
+            prog.append(("fence", t, 0))
+        progs.append(prog)
 
     init = {"collision": 0}
     for r in range(n):
@@ -422,7 +443,7 @@ def build_alltoallv(n: int, depth: int, counts,
 
     ts = []
     for r in range(n):
-        for i, (op, t, k) in enumerate(prog):
+        for i, (op, t, k) in enumerate(progs[r]):
             def mk(r=r, i=i, op=op, t=t, k=k):
                 pc = f"pc{r}"
                 peer, upr = dst(r, t), src(r, t)
@@ -436,9 +457,11 @@ def build_alltoallv(n: int, depth: int, counts,
                     def guard(s, pc=pc, i=i):
                         return s[pc] == i
 
-                    def apply(s):
-                        s[ucr] += D
-                        s[uwin] += D
+                    def apply(s, upr=upr):
+                        if not (mutation == "zero_count_entry_skip"
+                                and counts[upr][r] == 0):
+                            s[ucr] += D
+                            s[uwin] += D
                         s[pc] = i + 1
                         return s
 
@@ -529,7 +552,7 @@ def build_alltoallv(n: int, depth: int, counts,
             ts.append(mk())
 
     # ---- invariants --------------------------------------------------
-    end = len(prog)
+    ends = [len(p) for p in progs]
     expected = {}
     for r in range(n):
         seq = []
@@ -568,7 +591,7 @@ def build_alltoallv(n: int, depth: int, counts,
         return None
 
     def final(s):
-        return all(s[f"pc{r}"] == end for r in range(n))
+        return all(s[f"pc{r}"] == ends[r] for r in range(n))
 
     label = (f"ici-a2av(n={n},D={D},counts={counts},mut={mutation})")
     return Model(label, init, ts,

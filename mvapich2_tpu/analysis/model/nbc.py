@@ -1,0 +1,364 @@
+"""Exhaustive model of the NBC DAG engine (coll/nbc/engine.py +
+coll/nbc/dag.py) — the one protocol surface PR 18 shipped without a
+checker of its own.
+
+The engine, reduced to its scheduling skeleton: a **schedule** is a
+DAG of vertices (CALL / RECV / SEND / POLL). The scheduler issues every
+vertex whose dependency count has drained to zero; CALL completes
+inline at issue, RECV/SEND go inflight until a completion wakeup
+(``_on_completion``) fires, POLL is *parked* after its async hardware
+dispatch launches and is pumped by the progress hook once the hardware
+epoch finishes. Each completion decrements its children's dependency
+counts (the wakeup edge that keeps the DAG advancing without a
+dedicated thread). When every vertex is done the schedule completes and
+``nbc_scheds_active`` drains; an error unwind (``_complete(error=...)``)
+cancels inflight ops and clears the parked-poll set. Persistent
+(MPI_*_init/start) schedules restart: state fully re-initialised, the
+exec-cache epoch reused but every vertex re-issued fresh.
+
+Two DAG shapes are modelled, both taken from the engine's real builders:
+
+  ``device``  the device i-collective shape (coll/device.py): one
+              deposit CALL, ``segs`` segment POLLs depending on it,
+              one finish CALL depending on every POLL
+  ``net``     the host shape: RECV + SEND roots feeding a fold CALL
+
+What the model proves (exhaustively, all interleavings of scheduler,
+completion wakeups, async hardware, and the progress-hook pump):
+
+  * **nbc-deps-before-issue** — no vertex is ever issued while a
+    dependency is outstanding (the DAG order is real, not advisory);
+  * **nbc-deposit-before-poll** — on the device shape no segment POLL
+    launches before the deposit CALL completed (the operand must be in
+    the remote staging slots before any chunk wave starts);
+  * **nbc-issue-before-complete** — a completion wakeup only ever
+    lands on a vertex that was issued;
+  * **nbc-drained-at-finalize** — when the schedule completes (clean
+    or error-unwound), no op is inflight, no poll is parked, and the
+    ``nbc_scheds_active`` gauge is back to zero;
+  * **nbc-exec-epoch-fresh** — a (re)started persistent schedule
+    completes only after issuing every vertex in that run: exec-cache
+    epoch reuse never reuses vertex *state*;
+  * **no-deadlock** — the schedule always completes (explorer
+    built-in): the wakeup/pump edges are sufficient for progress.
+
+Mutations (tests/test_modelcheck.py asserts each is caught by a named
+invariant):
+
+  issue_ignores_deps     the ready-scan drops the ndeps==0 guard —
+                         vertices issue in arbitrary order (finish
+                         before its polls, polls before the deposit)
+  poll_never_pumped      the progress hook loses the parked-poll set
+                         (the _hook pump edge removed) — the schedule
+                         hangs exactly like a lost wakeup
+  lost_completion_wakeup a RECV/SEND completion fails to decrement its
+                         children's dependency counts (_vertex_done's
+                         fan-out dropped) — downstream never readies
+  unwind_leaves_inflight the error unwind forgets to cancel inflight
+                         ops / clear parked polls (_complete's cancel
+                         loop dropped) — the schedule "completes" with
+                         live ops still attached
+  stale_persistent_reuse persistent restart reuses last run's vertex
+                         state instead of re-initialising — run 2
+                         "completes" having issued nothing
+  spurious_completion    a completion wakeup lands on a never-issued
+                         vertex (a stale handle from a prior epoch)
+
+The runtime trace grammar of the engine this model abstracts lives in
+``TRACE_EVENTS`` below; analysis/conform.py imports it so the NBC
+conformance automaton and this model can never drift apart.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .explorer import Model, Transition
+
+# vertex kinds — mirrors coll/nbc/dag.py (CALL/RECV/SEND/POLL)
+CALL, RECV, SEND, POLL = 0, 1, 2, 3
+
+# The event grammar the live engine emits for this protocol surface
+# (trace layer -> event names). coll/nbc/engine.py emits the nbc-layer
+# schedule/vertex events; coll/device.py emits the device-layer
+# per-segment dispatch instants. The conformance automaton derives its
+# grammar from this table — shared source of truth with the model.
+TRACE_EVENTS = {
+    "nbc": ("sched_start", "vertex_issue", "vertex_complete",
+            "sched_complete"),
+    "device": ("nbc_dev_issue", "nbc_dev_complete"),
+}
+
+INVARIANTS = (
+    "nbc-deps-before-issue",
+    "nbc-deposit-before-poll",
+    "nbc-issue-before-complete",
+    "nbc-drained-at-finalize",
+    "nbc-exec-epoch-fresh",
+)
+
+# vertex states
+_WAIT, _INFLIGHT, _PARKED, _DONE, _CANC = 0, 1, 2, 3, 4
+
+
+def _shape(shape: str, segs: int):
+    """(kinds, deps) for the modelled DAG shape."""
+    if shape == "device":
+        # 0 = deposit CALL, 1..segs = segment POLLs, segs+1 = finish
+        kinds = [CALL] + [POLL] * segs + [CALL]
+        deps = [()] + [(0,)] * segs + [tuple(range(1, segs + 1))]
+        return kinds, deps
+    if shape == "net":
+        # RECV + SEND roots feeding a fold CALL (the host ibcast /
+        # ireduce builder shape collapsed to one stage)
+        kinds = [RECV, SEND, CALL]
+        deps = [(), (), (0, 1)]
+        return kinds, deps
+    raise ValueError(f"unknown shape {shape!r}")
+
+
+def build_nbc(shape: str = "device", segs: int = 2,
+              persistent: bool = False, error: bool = False,
+              mutation: Optional[str] = None) -> Model:
+    """One schedule of the given ``shape`` driven to completion by the
+    scheduler / completion-wakeup / async-hardware / progress-hook
+    actors; ``persistent`` adds one restart cycle, ``error`` makes the
+    first segment POLL's hardware epoch fail (PROC_FAILED shape) so the
+    cancel/error unwind runs."""
+    assert not (persistent and error), "modelled one axis at a time"
+    kinds, deps = _shape(shape, segs)
+    V = len(kinds)
+    ndeps0 = [len(d) for d in deps]
+    children = [[w for w in range(V) if v in deps[w]] for v in range(V)]
+    err_vertex = 1 if error else -1    # first segment POLL fails
+
+    init = {"done": 0, "active": 1, "iss": 0,
+            "runs": 1 if persistent else 0,
+            "dv": 0, "pbd": 0, "spur": 0}
+    for v in range(V):
+        init[f"st{v}"] = _WAIT
+        init[f"nd{v}"] = ndeps0[v]
+        if kinds[v] == POLL:
+            init[f"hw{v}"] = 0
+
+    ts = []
+
+    def _propagate(s, v):
+        for w in children[v]:
+            s[f"nd{w}"] -= 1
+
+    # ---- scheduler: issue every ready vertex ---------------------------
+    for v in range(V):
+        def mk_issue(v=v):
+            kind = kinds[v]
+
+            def guard(s):
+                if s["done"] != 0 or s[f"st{v}"] != _WAIT:
+                    return False
+                if mutation == "issue_ignores_deps":
+                    return True
+                return s[f"nd{v}"] == 0
+
+            def apply(s):
+                s["iss"] += 1
+                if s[f"nd{v}"] > 0:
+                    s["dv"] = 1                      # dep still open
+                if kind == POLL and s["st0"] != _DONE \
+                        and kinds[0] == CALL:
+                    s["pbd"] = 1                     # poll pre-deposit
+                if kind == CALL:
+                    s[f"st{v}"] = _DONE              # inline completion
+                    _propagate(s, v)
+                elif kind == POLL:
+                    s[f"st{v}"] = _PARKED            # async dispatch
+                    s[f"hw{v}"] = 1                  # launched
+                else:                                # RECV / SEND
+                    s[f"st{v}"] = _INFLIGHT
+                return s
+            keys = frozenset({"done", f"st{v}", f"nd{v}", "iss", "dv",
+                              "pbd", "st0"}
+                             | {f"nd{w}" for w in children[v]}
+                             | ({f"hw{v}"} if kind == POLL else set()))
+            return Transition(f"sched.issue{v}", "sched", guard, apply,
+                              keys, keys)
+        ts.append(mk_issue())
+
+    # ---- completion wakeups on inflight net ops ------------------------
+    for v in range(V):
+        if kinds[v] not in (RECV, SEND):
+            continue
+
+        def mk_complete(v=v):
+            def guard(s):
+                return s["done"] == 0 and s[f"st{v}"] == _INFLIGHT
+
+            def apply(s):
+                s[f"st{v}"] = _DONE
+                if mutation != "lost_completion_wakeup":
+                    _propagate(s, v)
+                return s
+            keys = frozenset({"done", f"st{v}"}
+                             | {f"nd{w}" for w in children[v]})
+            return Transition(f"net.complete{v}", "net", guard, apply,
+                              keys, keys)
+        ts.append(mk_complete())
+
+    # spurious completion: a stale handle fires a wakeup on a vertex
+    # that was never issued (the mutation the issue-before-complete
+    # invariant exists for)
+    if mutation == "spurious_completion":
+        sv = next(v for v in range(V) if kinds[v] in (RECV, SEND, POLL))
+
+        def sp_guard(s):
+            return s["done"] == 0 and s[f"st{sv}"] == _WAIT
+
+        def sp_apply(s):
+            s["spur"] = 1
+            s[f"st{sv}"] = _DONE
+            _propagate(s, sv)
+            return s
+        keys = frozenset({"done", f"st{sv}", "spur"}
+                         | {f"nd{w}" for w in children[sv]})
+        ts.append(Transition(f"net.spurious{sv}", "net", sp_guard,
+                             sp_apply, keys, keys))
+
+    # ---- async hardware: a launched poll's epoch finishes --------------
+    for v in range(V):
+        if kinds[v] != POLL:
+            continue
+
+        def mk_hw(v=v):
+            def guard(s):
+                return s["done"] == 0 and s[f"hw{v}"] == 1
+
+            def apply(s):
+                s[f"hw{v}"] = 2
+                return s
+            keys = frozenset({"done", f"hw{v}"})
+            return Transition(f"dev.epoch{v}", "dev", guard, apply,
+                              keys, keys)
+        ts.append(mk_hw())
+
+    # ---- progress hook: pump parked polls whose epoch finished ---------
+    for v in range(V):
+        if kinds[v] != POLL:
+            continue
+
+        def mk_pump(v=v):
+            def guard(s):
+                if mutation == "poll_never_pumped":
+                    return False
+                return (s["done"] == 0 and s[f"st{v}"] == _PARKED
+                        and s[f"hw{v}"] == 2)
+
+            def apply(s):
+                if v == err_vertex:
+                    # the poll raises (PROC_FAILED shape): error
+                    # unwind — cancel inflight, clear parked polls,
+                    # drain the active gauge (_complete(error=...))
+                    s["done"] = 2
+                    s["active"] -= 1
+                    if mutation != "unwind_leaves_inflight":
+                        for u in range(V):
+                            if s[f"st{u}"] in (_INFLIGHT, _PARKED):
+                                s[f"st{u}"] = _CANC
+                    else:
+                        s[f"st{v}"] = _CANC   # only the raiser clears
+                    return s
+                s[f"st{v}"] = _DONE
+                _propagate(s, v)
+                return s
+            keys = frozenset({"done", "active", f"st{v}", f"hw{v}"}
+                             | {f"st{u}" for u in range(V)}
+                             | {f"nd{w}" for w in children[v]})
+            return Transition(f"hook.pump{v}", "hook", guard, apply,
+                              keys, keys)
+        ts.append(mk_pump())
+
+    # ---- schedule completion + persistent restart ----------------------
+    def done_guard(s):
+        return s["done"] == 0 and all(s[f"st{v}"] == _DONE
+                                      for v in range(V))
+
+    def done_apply(s):
+        s["done"] = 1
+        s["active"] -= 1
+        return s
+    keys = frozenset({"done", "active"} | {f"st{v}" for v in range(V)})
+    ts.append(Transition("sched.complete", "sched", done_guard,
+                         done_apply, keys, keys))
+
+    if persistent:
+        def re_guard(s):
+            return s["done"] == 1 and s["runs"] > 0
+
+        def re_apply(s):
+            s["runs"] -= 1
+            s["done"] = 0
+            s["active"] += 1
+            s["iss"] = 0
+            if mutation != "stale_persistent_reuse":
+                for v in range(V):        # full state re-init (start())
+                    s[f"st{v}"] = _WAIT
+                    s[f"nd{v}"] = ndeps0[v]
+                    if kinds[v] == POLL:
+                        s[f"hw{v}"] = 0
+            return s
+        keys = frozenset({"done", "active", "iss", "runs"}
+                         | {f"st{v}" for v in range(V)}
+                         | {f"nd{v}" for v in range(V)}
+                         | {f"hw{v}" for v in range(V)
+                            if kinds[v] == POLL})
+        ts.append(Transition("sched.restart", "sched", re_guard,
+                             re_apply, keys, keys))
+
+    # ---- invariants ----------------------------------------------------
+    def inv_deps(s):
+        if s["dv"]:
+            return "vertex issued with an outstanding dependency"
+        return None
+
+    def inv_deposit(s):
+        if s["pbd"]:
+            return "segment POLL launched before the deposit CALL done"
+        return None
+
+    def inv_issue_before_complete(s):
+        if s["spur"]:
+            return "completion wakeup on a never-issued vertex"
+        return None
+
+    def inv_drained(s):
+        if s["done"] == 0:
+            return None
+        live = [v for v in range(V)
+                if s[f"st{v}"] in (_INFLIGHT, _PARKED)]
+        if live:
+            return (f"schedule completed with live vertices {live} "
+                    "(inflight/parked not unwound)")
+        if s["active"] != 0:
+            return f"nbc_scheds_active={s['active']} after completion"
+        return None
+
+    def inv_epoch_fresh(s):
+        if s["done"] == 1 and s["iss"] != V:
+            return (f"run completed having issued {s['iss']}/{V} "
+                    "vertices (stale persistent state reused)")
+        return None
+
+    invs = [
+        ("nbc-deps-before-issue", inv_deps),
+        ("nbc-deposit-before-poll", inv_deposit),
+        ("nbc-issue-before-complete", inv_issue_before_complete),
+        ("nbc-drained-at-finalize", inv_drained),
+        ("nbc-exec-epoch-fresh", inv_epoch_fresh),
+    ]
+
+    def is_final(s):
+        return s["done"] != 0 and (s["runs"] == 0 or s["done"] == 2)
+
+    label = (f"nbc[{shape} segs={segs}"
+             + (" persistent" if persistent else "")
+             + (" error" if error else "")
+             + (f" mut={mutation}" if mutation else "") + "]")
+    return Model(label, init, ts, invs, is_final)

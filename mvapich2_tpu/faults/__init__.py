@@ -16,7 +16,12 @@ Grammar (comma-separated specs)::
           grant transaction and the claimer's attach)
           | flat_fold  (handled natively in cplane.cpp so the C-ABI
           hot path injects without an interpreter round-trip)
+          | trace_stamp  (the Recorder.record stamp site — tracer
+          corruption for conformance-checker tests, never datapath)
     kind  drop | delay | duplicate | truncate | crash
+          | skip_stamp | reorder  (trace_stamp only: silently drop the
+          stamp / swap it behind its predecessor — seeded trace
+          mutations that bin/mv2tconform must catch by name)
     seed  seeds the per-spec RNG (delay durations); default 0
     nth   fire on the nth eligible event at the site (1-based,
           default 1); a trailing ``+`` keeps firing from the nth on
@@ -57,14 +62,16 @@ cvar("FAULTS", "", str, "ft",
      "Deterministic fault-injection spec(s): "
      "site[@rank]:kind[:seed[:nth[+]]], comma-separated. Sites: "
      "shm_send shm_recv arena_alloc rndv_chunk kvs wire claim "
-     "flat_fold; kinds: drop delay duplicate truncate crash. Empty = "
-     "engine off (zero hot-path cost).")
+     "flat_fold trace_stamp; kinds: drop delay duplicate truncate "
+     "crash skip_stamp reorder. Empty = engine off (zero hot-path "
+     "cost).")
 cvar("FAULT_DELAY_MS", 0.0, float, "ft",
      "Fixed delay in ms for the 'delay' kind (0 = seeded 1-20 ms).")
 
 SITES = ("shm_send", "shm_recv", "arena_alloc", "rndv_chunk", "kvs",
-         "wire", "claim", "flat_fold")
-KINDS = ("drop", "delay", "duplicate", "truncate", "crash")
+         "wire", "claim", "flat_fold", "trace_stamp")
+KINDS = ("drop", "delay", "duplicate", "truncate", "crash",
+         "skip_stamp", "reorder")
 
 # containment observability (predeclared in mpit.py so tools enumerate
 # them before any datapath import; fetched-by-name here)

@@ -190,6 +190,7 @@ class DeviceWin:
         if rank in self._locked:
             raise RuntimeError(f"rank {rank} already locked")
         self._locked.add(rank)
+        _trace_rma("rma_lock", "i", rank=rank)
 
     def unlock(self, rank: int) -> None:
         """Close the passive epoch on ``rank``: flush its outstanding
@@ -198,6 +199,7 @@ class DeviceWin:
             raise RuntimeError(f"rank {rank} not locked")
         self.flush(rank)
         self._locked.discard(rank)
+        _trace_rma("rma_unlock", "i", rank=rank)
 
     def flush(self, rank: Optional[int] = None) -> None:
         """Complete every outstanding op targeting ``rank`` (None =

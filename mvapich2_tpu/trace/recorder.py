@@ -66,9 +66,28 @@ class Recorder:
     def record(self, layer: str, name: str, ph: str = "i", **args) -> None:
         """Append one event. ``ph`` follows the Chrome trace-event phases:
         'B'egin / 'E'nd for spans, 'i' for instants. deque.append with a
-        maxlen is atomic under the GIL, so no lock on the hot path."""
+        maxlen is atomic under the GIL, so no lock on the hot path.
+
+        The ``trace_stamp`` fault site lives here: ``skip_stamp`` drops
+        the stamp, ``reorder`` swaps it behind its predecessor — seeded
+        trace corruption that the conformance checker (bin/mv2tconform)
+        must catch by a named invariant, never by silence. The site is
+        one ``fire()`` call (a single attribute test while MV2T_FAULTS
+        is empty) and corrupts only the trace, never the datapath."""
+        from .. import faults
+        kind = faults.fire("trace_stamp")
+        if kind == "skip_stamp":
+            return
         self.events.append((time.monotonic(), layer, name, ph,
                             args or None))
+        if kind == "reorder" and len(self.events) >= 2:
+            # swap ring position AND timestamp with the predecessor, so
+            # the corruption survives both ring-order and ts-order
+            # readers (a stamp that landed with the wrong clock)
+            last = self.events.pop()
+            prev = self.events.pop()
+            self.events.append((prev[0],) + last[1:])
+            self.events.append((last[0],) + prev[1:])
 
     def tail(self, n: int) -> List[tuple]:
         """The most recent ``n`` events (stall-watchdog post-mortem)."""

@@ -148,6 +148,30 @@ def build_report(engine) -> str:
         for ts, layer, name, ph, args in tail:
             lines.append(f"  {ts:.6f} [{layer}] {name} {ph}"
                          f"{' ' + repr(args) if args else ''}")
+        # conformance over the tail: replay the window through the
+        # protocol automata (truncation-safe invariants only) and name
+        # the first violated invariant — a hang with a poisoned flat
+        # region or an un-pumped NBC schedule says so here instead of
+        # leaving the reader to eyeball the event list
+        try:
+            from ..analysis import conform
+            rank = getattr(engine, "rank", -1)
+            viols = conform.check_tail(
+                rank if isinstance(rank, int) else -1, tail,
+                options={"peer_timeout": float(
+                    get_config().get("PEER_TIMEOUT", 0.0) or 0.0)})
+            if viols:
+                v = viols[0]
+                lines.append(f"## trace-tail conformance: "
+                             f"{len(viols)} violation(s), first is "
+                             f"{v.automaton}/{v.invariant}: {v.message}")
+            else:
+                lines.append("## trace-tail conformance: no invariant "
+                             "violated in the tail window (stall is "
+                             "likely a liveness wait, not a protocol "
+                             "break)")
+        except Exception as e:   # diagnostics must never kill the waiter
+            lines.append(f"## trace-tail conformance unavailable: {e!r}")
     return "\n".join(lines)
 
 

@@ -1,0 +1,28 @@
+"""events-pass fixture: THREE seeded record sites outside the
+conformance grammar (literal, f-string prefix, and wrapper-resolved);
+the native.py-keyed NTE / _MET_HISTS checks fire only when the real
+trace/native.py is scanned alongside (see test_lint.py)."""
+
+
+def _emit(tr, name):
+    if tr is None:
+        return
+    tr.record("progress", name, "i")                  # VIOLATION (line 10, via the bogus_wait call site)
+
+
+class Chan:
+    def traced(self, engine, n):
+        if (tr := engine.tracer) is not None:
+            tr.record("device", "bogus_pulse", "i")   # VIOLATION (line 16)
+            tr.record("device", "ici_slot", "i")      # covered literal
+            tr.record("nbc", f"mystery_{n}", "i")     # VIOLATION (line 18: mystery_*)
+            tr.record("mpi", f"evt_{n}", "B")         # mpi grammar is "*"
+            _emit(tr, "progress_wait")                # covered via resolution
+            _emit(tr, "bogus_wait")                   # trips the line-10 site
+
+    def sampled(self, mx):
+        # the rec_us check needs _MET_HISTS, i.e. trace/native.py among
+        # the scanned modules — silent under plain _lint(), one finding
+        # when the events pass runs with the real native.py (line 27)
+        mx.rec_us("lat_bogus_thing", 1.0)
+        mx.rec_us("lat_coll_flat", 2.0)               # known histogram

@@ -5,7 +5,7 @@ tracer; unguarded direct .tracer.record)."""
 class Chan:
     def bad_cached(self, engine, n):
         tr = engine.tracer
-        tr.record("channel", "send", "i", bytes=n)      # VIOLATION (line 8)
+        tr.record("channel", "shm_send", "i", bytes=n)  # VIOLATION (line 8)
 
     def bad_direct(self, engine):
         engine.tracer.record("mpi", "enter", "B")       # VIOLATION (line 11)
@@ -13,7 +13,7 @@ class Chan:
     def good_plain(self, engine, n):
         tr = engine.tracer
         if tr is not None:
-            tr.record("channel", "send", "i", bytes=n)
+            tr.record("channel", "shm_send", "i", bytes=n)
 
     def good_walrus(self, engine):
         if (tr := engine.tracer) is not None:

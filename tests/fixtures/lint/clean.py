@@ -28,4 +28,4 @@ class Clean:
 
     def traced(self, engine):
         if (tr := engine.tracer) is not None:
-            tr.record("channel", "recv", "i")
+            tr.record("channel", "shm_recv", "i")

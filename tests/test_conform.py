@@ -1,0 +1,575 @@
+"""Trace conformance (ISSUE 19 tentpole): bin/mv2tconform replays a
+run's traces through per-protocol automata sharing invariant names
+with analysis/model/*. Covered here:
+
+  * a clean synthetic multi-rank stream and a clean real-Recorder
+    script are violation-free;
+  * ~16 offline seeded mutations of the synthetic stream, each caught
+    by its named invariant (never silence);
+  * >=10 RUNTIME seeded mutations through the real fault engine — the
+    new ``trace_stamp`` site's ``skip_stamp``/``reorder`` kinds armed
+    via MV2T_FAULTS against a live Recorder, each caught by name;
+  * replayable counterexamples: feeding a violation's trace window
+    back through fresh automata trips the same invariant;
+  * tail mode (the stall watchdog's entry point) stays sound on
+    truncated windows and names the first violated invariant;
+  * the CLI exit-code contract (0 clean / 1 violations / 2 usage /
+    3 unreadable) that perf sessions use for conformance stamps;
+  * non-perturbation: the checker reads a LIVE job's ntrace segment
+    read-only while the job runs, and the job still finishes clean
+    (test_mpistat.py style).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import types
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mvapich2_tpu import faults                            # noqa: E402
+from mvapich2_tpu.analysis import conform                  # noqa: E402
+from mvapich2_tpu.trace.recorder import Recorder           # noqa: E402
+from mvapich2_tpu.utils.config import get_config           # noqa: E402
+
+RANKS = frozenset({0, 1, 2, 3})
+OPTS = {"peer_timeout": 10.0}
+
+
+def _check(events, ranks=RANKS, **kw):
+    return conform.check_events(events, options=dict(OPTS), ranks=ranks,
+                                **kw)
+
+
+def _invariants(violations):
+    return {v.invariant for v in violations}
+
+
+# ---------------------------------------------------------------------------
+# synthetic clean stream (4 ranks, every automaton exercised)
+# ---------------------------------------------------------------------------
+
+def _clean_stream():
+    evs = []
+    t = [0.0]
+
+    def ev(r_, layer_, name_, ph="i", **args):
+        t[0] += 0.001
+        e = conform.Event(t[0], r_, layer_, name_, ph, args or None)
+        evs.append(e)
+        return e
+
+    # two flat waves on ctx 9 (fanin all, fold on 0, fanout all)
+    for seq in (1, 2):
+        for r in range(4):
+            ev(r, "cplane", "flat_fanin", a1=9, a2=seq)
+        ev(0, "cplane", "flat_fold", a1=9, a2=seq)
+        for r in range(4):
+            ev(r, "cplane", "flat_fanout", a1=9, a2=seq)
+    ev(0, "cplane", "coll_dispatch", a1=0, a2=0)
+    # doorbell + a lease scan
+    ev(0, "cplane", "bell_ring", a1=1, a2=0)
+    ev(1, "cplane", "bell_wake", a1=0, a2=0)
+    ev(0, "cplane", "lease_scan", a1=0, a2=0)
+    # a device-shaped NBC schedule on rank 2 (deposit, 2 POLLs, close)
+    ev(2, "nbc", "sched_start", sched=7, kind="dev-iallgather",
+       vertices=4)
+    ev(2, "nbc", "vertex_issue", sched=7, vid=0, kind=0)
+    ev(2, "nbc", "vertex_complete", sched=7, vid=0)
+    ev(2, "nbc", "vertex_issue", sched=7, vid=1, kind=3)
+    ev(2, "device", "nbc_dev_issue", coll="iallgather", seg=0, of=2,
+       n=128)
+    ev(2, "device", "nbc_dev_complete", coll="iallgather", seg=0, us=5)
+    ev(2, "nbc", "vertex_complete", sched=7, vid=1)
+    ev(2, "nbc", "vertex_issue", sched=7, vid=2, kind=3)
+    ev(2, "nbc", "vertex_complete", sched=7, vid=2)
+    ev(2, "nbc", "vertex_issue", sched=7, vid=3, kind=0)
+    ev(2, "nbc", "vertex_complete", sched=7, vid=3)
+    ev(2, "nbc", "sched_complete", sched=7, error=False)
+    # device dispatch lane on rank 3
+    ev(3, "device", "dev_coll", "B", tier="vmem")
+    ev(3, "device", "ici_slot", a1=0, a2=1)
+    ev(3, "device", "dev_coll", "E")
+    # a passive-target RMA epoch on rank 1
+    ev(1, "device", "rma_lock", rank=3)
+    ev(1, "device", "rma_flush", "B", rank=3, nops=1)
+    ev(1, "device", "rma_put", tier="rdma", bytes=64)
+    ev(1, "device", "rma_flush", "E")
+    ev(1, "device", "rma_unlock", rank=3)
+    # metrics rows
+    ev(0, "metrics", "fp_hits", "C", value=1)
+    ev(0, "metrics", "fp_hits", "C", value=5)
+    ev(0, "metrics", "daemon_claims_active", "C", value=1)
+    ev(0, "metrics", "daemon_claims_active", "C", value=0)
+    # python mpi spans
+    for r in range(4):
+        ev(r, "mpi", "allreduce", "B")
+        ev(r, "mpi", "allreduce", "E")
+    return evs
+
+
+def _tail_of(evs):
+    t = max(e.ts for e in evs) + 0.001
+    return t
+
+
+def test_clean_stream_violation_free():
+    assert _check(_clean_stream()) == []
+
+
+def _drop(evs, pred, n=1):
+    out, dropped = [], 0
+    for e in evs:
+        if dropped < n and pred(e):
+            dropped += 1
+            continue
+        out.append(e)
+    assert dropped == n, "mutation matched nothing"
+    return out
+
+
+def _append(evs, rank, layer, name, ph="i", **args):
+    evs = list(evs)
+    evs.append(conform.Event(_tail_of(evs), rank, layer, name, ph,
+                             args or None))
+    return evs
+
+
+def _mut_drop_fanin(evs):
+    return _drop(evs, lambda e: e.name == "flat_fanin" and e.rank == 0)
+
+
+def _mut_mseq_regress(evs):
+    return _append(evs, 1, "cplane", "flat_fanin", a1=9, a2=1)
+
+
+def _mut_poison(evs):
+    return _append(evs, 1, "cplane", "flat_poison", a1=-2, a2=0)
+
+
+def _mut_post_poison_wave(evs):
+    return _append(_mut_poison(evs), 1, "cplane", "flat_fanin",
+                   a1=9, a2=3)
+
+
+def _mut_drop_ring(evs):
+    return _drop(evs, lambda e: e.name == "bell_ring")
+
+
+def _mut_stale_lease(evs):
+    return _append(evs, 0, "cplane", "lease_expire", a1=7,
+                   a2=50_000_000)
+
+
+def _mut_false_positive_expire(evs):
+    return _append(evs, 0, "cplane", "lease_expire", a1=3,
+                   a2=1_000_000)
+
+
+def _mut_drop_sched_complete(evs):
+    return _drop(evs, lambda e: e.name == "sched_complete")
+
+
+def _mut_poll_before_deposit(evs):
+    return _drop(evs, lambda e: e.name == "vertex_complete"
+                 and (e.args or {}).get("vid") == 0)
+
+
+def _mut_drop_vertex_issue(evs):
+    return _drop(evs, lambda e: e.name == "vertex_issue"
+                 and (e.args or {}).get("vid") == 1)
+
+
+def _mut_poll_slot_disorder(evs):
+    out = []
+    for e in evs:
+        if e.name == "vertex_issue" and (e.args or {}).get("vid") == 1:
+            e = conform.Event(e.ts, e.rank, e.layer, e.name, e.ph,
+                              dict(e.args, vid=2))
+        elif e.name == "vertex_issue" and (e.args or {}).get("vid") == 2:
+            e = conform.Event(e.ts, e.rank, e.layer, e.name, e.ph,
+                              dict(e.args, vid=1))
+        out.append(e)
+    return out
+
+
+def _mut_dev_complete_without_issue(evs):
+    return _append(evs, 2, "device", "nbc_dev_complete",
+                   coll="ireduce", seg=4, us=1)
+
+
+def _mut_double_lock(evs):
+    out = []
+    for e in evs:
+        out.append(e)
+        if e.name == "rma_lock":
+            out.append(conform.Event(e.ts + 1e-5, e.rank, e.layer,
+                                     e.name, e.ph, dict(e.args)))
+    return out
+
+
+def _mut_naked_rma_op(evs):
+    return _append(evs, 1, "device", "rma_get", tier="rdma", bytes=8)
+
+
+def _mut_counter_regress(evs):
+    return _append(evs, 0, "metrics", "fp_hits", "C", value=2)
+
+
+def _mut_negative_gauge(evs):
+    return _append(evs, 0, "metrics", "daemon_claims_active", "C",
+                   value=-1)
+
+
+def _mut_unbalanced_span(evs):
+    return _append(evs, 3, "mpi", "bcast", "E")
+
+
+def _mut_unknown_event(evs):
+    return _append(evs, 0, "cplane", "mystery_blip", a1=0, a2=0)
+
+
+OFFLINE_MUTATIONS = [
+    ("drop-fanin", _mut_drop_fanin, "fanin-before-fold-before-fanout"),
+    ("mseq-regress", _mut_mseq_regress, "mseq-monotone"),
+    ("poison", _mut_poison, "proc-failed-poison"),
+    ("post-poison-wave", _mut_post_poison_wave, "poison-sticky"),
+    ("drop-bell-ring", _mut_drop_ring, "no-lost-wake"),
+    ("stale-lease", _mut_stale_lease, "detect-within-deadline"),
+    ("expire-departed", _mut_false_positive_expire, "no-false-positive"),
+    ("drop-sched-complete", _mut_drop_sched_complete,
+     "nbc-drained-at-finalize"),
+    ("poll-before-deposit", _mut_poll_before_deposit,
+     "nbc-deposit-before-poll"),
+    ("drop-vertex-issue", _mut_drop_vertex_issue,
+     "nbc-issue-before-complete"),
+    ("poll-slot-disorder", _mut_poll_slot_disorder, "no-slot-collision"),
+    ("dev-complete-no-issue", _mut_dev_complete_without_issue,
+     "nbc-issue-before-complete"),
+    ("double-lock", _mut_double_lock, "lock-exclusive"),
+    ("naked-rma-op", _mut_naked_rma_op,
+     "flush-completes-all-outstanding"),
+    ("counter-regress", _mut_counter_regress, "counter-monotone"),
+    ("negative-gauge", _mut_negative_gauge, "gauge-nonnegative"),
+    ("unbalanced-span", _mut_unbalanced_span, "span-balance"),
+    ("unknown-event", _mut_unknown_event, "grammar-coverage"),
+]
+
+
+@pytest.mark.parametrize("name,mutate,invariant",
+                         OFFLINE_MUTATIONS,
+                         ids=[m[0] for m in OFFLINE_MUTATIONS])
+def test_offline_mutation_caught_by_named_invariant(name, mutate,
+                                                    invariant):
+    viols = _check(mutate(_clean_stream()))
+    assert invariant in _invariants(viols), \
+        f"{name}: expected {invariant}, got {_invariants(viols)}"
+
+
+def test_counterexample_replays():
+    """The model checkers' contract: a violation's trace window, fed
+    back through fresh automata, trips the same invariant."""
+    for mutate, invariant in ((_mut_mseq_regress, "mseq-monotone"),
+                              (_mut_post_poison_wave, "poison-sticky")):
+        viols = [v for v in _check(mutate(_clean_stream()))
+                 if v.invariant == invariant]
+        assert viols and viols[0].trace
+        assert conform.replay(viols[0], options=dict(OPTS)), \
+            f"replay of {invariant} window did not reproduce"
+
+
+# ---------------------------------------------------------------------------
+# runtime seeded mutations: the trace_stamp fault site through a REAL
+# Recorder — MV2T_FAULTS skip_stamp/reorder kinds, each caught by name
+# ---------------------------------------------------------------------------
+
+_SCRIPT = [
+    ("cplane", "flat_fanin", "i", dict(a1=5, a2=1)),        # 1
+    ("cplane", "flat_fold", "i", dict(a1=5, a2=1)),         # 2
+    ("cplane", "flat_fanout", "i", dict(a1=5, a2=1)),       # 3
+    ("cplane", "bell_ring", "i", dict(a1=1, a2=0)),         # 4
+    ("cplane", "bell_wake", "i", dict(a1=0, a2=0)),         # 5
+    ("nbc", "sched_start", "i",
+     dict(sched=7, kind="dev-iallreduce", vertices=3)),     # 6
+    ("nbc", "vertex_issue", "i", dict(sched=7, vid=0, kind=0)),   # 7
+    ("nbc", "vertex_complete", "i", dict(sched=7, vid=0)),        # 8
+    ("nbc", "vertex_issue", "i", dict(sched=7, vid=1, kind=3)),   # 9
+    ("nbc", "vertex_issue", "i", dict(sched=7, vid=2, kind=3)),   # 10
+    ("nbc", "vertex_complete", "i", dict(sched=7, vid=1)),        # 11
+    ("nbc", "vertex_complete", "i", dict(sched=7, vid=2)),        # 12
+    ("nbc", "sched_complete", "i", dict(sched=7, error=False)),   # 13
+    ("device", "rma_lock", "i", dict(rank=1)),              # 14
+    ("device", "rma_flush", "B", dict(rank=1, nops=1)),     # 15
+    ("device", "rma_put", "i", dict(tier="rdma", bytes=64)),  # 16
+    ("device", "rma_flush", "E", dict()),                   # 17
+    ("device", "rma_unlock", "i", dict(rank=1)),            # 18
+    ("mpi", "allreduce", "B", dict()),                      # 19
+    ("mpi", "allreduce", "E", dict()),                      # 20
+]
+
+
+def _run_script(fault_spec=None):
+    """Drive the canonical script through a real Recorder, optionally
+    with a trace_stamp fault armed, and conformance-check the dump."""
+    cfg = get_config()
+    old = cfg.get("FAULTS", "")
+    try:
+        cfg.set("FAULTS", fault_spec or "")
+        if fault_spec:
+            assert faults.configure(0) == 1
+        else:
+            faults.deconfigure()
+        rec = Recorder(0, 4096)
+        for layer, name, ph, args in _SCRIPT:
+            rec.record(layer, name, ph, **args)
+        evs, _trunc = conform._dump_to_events(rec.snapshot())
+        return conform.check_events(evs, options=dict(OPTS),
+                                    ranks=frozenset({0}))
+    finally:
+        cfg.set("FAULTS", old)
+        faults.deconfigure()
+
+
+def test_runtime_clean_script_violation_free():
+    assert _run_script() == []
+
+
+RUNTIME_MUTATIONS = [
+    ("skip-fanin", "trace_stamp:skip_stamp:0:1",
+     "fanin-before-fold-before-fanout"),
+    ("skip-bell-ring", "trace_stamp:skip_stamp:0:4", "no-lost-wake"),
+    ("skip-vertex-issue-call", "trace_stamp:skip_stamp:0:7",
+     "nbc-issue-before-complete"),
+    ("skip-deposit-complete", "trace_stamp:skip_stamp:0:8",
+     "nbc-deposit-before-poll"),
+    ("skip-vertex-issue-poll", "trace_stamp:skip_stamp:0:9",
+     "nbc-issue-before-complete"),
+    ("skip-sched-complete", "trace_stamp:skip_stamp:0:13",
+     "nbc-drained-at-finalize"),
+    ("skip-rma-lock", "trace_stamp:skip_stamp:0:14", "lock-exclusive"),
+    ("skip-flush-begin", "trace_stamp:skip_stamp:0:15",
+     "flush-completes-all-outstanding"),
+    ("skip-mpi-begin", "trace_stamp:skip_stamp:0:19", "span-balance"),
+    ("reorder-fold-before-fanin", "trace_stamp:reorder:0:2",
+     "fanin-before-fold-before-fanout"),
+    ("reorder-wake-before-ring", "trace_stamp:reorder:0:5",
+     "no-lost-wake"),
+    ("reorder-poll-slots", "trace_stamp:reorder:0:10",
+     "no-slot-collision"),
+    ("reorder-op-outside-flush", "trace_stamp:reorder:0:16",
+     "flush-completes-all-outstanding"),
+]
+
+
+@pytest.mark.parametrize("name,spec,invariant", RUNTIME_MUTATIONS,
+                         ids=[m[0] for m in RUNTIME_MUTATIONS])
+def test_runtime_fault_caught_by_named_invariant(name, spec, invariant):
+    viols = _run_script(spec)
+    assert invariant in _invariants(viols), \
+        f"{name} ({spec}): expected {invariant}, " \
+        f"got {_invariants(viols)}"
+
+
+# ---------------------------------------------------------------------------
+# tail mode — the stall watchdog's window
+# ---------------------------------------------------------------------------
+
+def test_tail_mode_names_poison():
+    rows = [(1.0, "cplane", "flat_fanin", "i", {"a1": 5, "a2": 1}),
+            (2.0, "cplane", "flat_poison", "i", {"a1": -2, "a2": 0}),
+            (3.0, "cplane", "flat_fanout", "i", {"a1": 5, "a2": 2})]
+    viols = conform.check_tail(1, rows, options=dict(OPTS))
+    assert "proc-failed-poison" in _invariants(viols)
+    assert "poison-sticky" in _invariants(viols)
+
+
+def test_tail_mode_suppresses_truncation_artifacts():
+    """A window that starts mid-run: E-without-B, an sched with no
+    start, a wake whose ring predates the window — none may fire."""
+    rows = [(1.0, "mpi", "allreduce", "E", None),
+            (2.0, "nbc", "vertex_complete", "i", {"sched": 3, "vid": 1}),
+            (3.0, "cplane", "bell_wake", "i", {"a1": 0, "a2": 0}),
+            (4.0, "device", "rma_unlock", "i", {"rank": 2}),
+            (5.0, "nbc", "sched_start", "i",
+             {"sched": 9, "kind": "net-ibcast", "vertices": 2})]
+    assert conform.check_tail(0, rows, options=dict(OPTS)) == []
+
+
+def test_watchdog_report_names_first_violated_invariant():
+    """The watchdog's hang report runs conformance over the trace tail
+    and names the first violated invariant."""
+    from mvapich2_tpu.trace import watchdog
+    rec = Recorder(0, 256)
+    rec.record("cplane", "flat_fanin", a1=5, a2=1)
+    rec.record("cplane", "flat_poison", a1=-2, a2=0)
+    eng = types.SimpleNamespace(
+        rank=0, mutex=threading.Lock(), outstanding={}, universe=None,
+        nbc=None, _lockcheck=None, _stall_limit=5.0, tracer=rec)
+    report = watchdog.build_report(eng)
+    assert "trace-tail conformance" in report
+    assert "flat-wave/proc-failed-poison" in report
+
+
+def test_watchdog_report_clean_tail_says_liveness():
+    from mvapich2_tpu.trace import watchdog
+    rec = Recorder(0, 256)
+    rec.record("cplane", "flat_fanin", a1=5, a2=1)
+    eng = types.SimpleNamespace(
+        rank=0, mutex=threading.Lock(), outstanding={}, universe=None,
+        nbc=None, _lockcheck=None, _stall_limit=5.0, tracer=rec)
+    report = watchdog.build_report(eng)
+    assert "no invariant violated" in report
+
+
+# ---------------------------------------------------------------------------
+# CLI exit-code contract
+# ---------------------------------------------------------------------------
+
+def _write_dump(tmp_path, events, rank=0):
+    path = tmp_path / f"trace-r{rank}.json"
+    path.write_text(json.dumps({
+        "rank": rank, "clock": "monotonic", "capacity": 4096,
+        "events": [[e.ts, e.layer, e.name, e.ph, e.args]
+                   for e in events if e.rank == rank]}))
+    return str(path)
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    clean = [e for e in _clean_stream() if e.rank == 0
+             and e.layer != "metrics"]
+    _write_dump(tmp_path, clean)
+    assert conform.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "0 violation(s)" in out
+
+    bad = _append(clean, 0, "cplane", "flat_poison", a1=-2, a2=0)
+    bad_dir = tmp_path / "bad"
+    bad_dir.mkdir()
+    _write_dump(bad_dir, bad)
+    assert conform.main([str(bad_dir)]) == 1
+    out = capsys.readouterr().out
+    assert "proc-failed-poison" in out
+
+    assert conform.main([str(tmp_path / "nope.txt")]) == 2
+    empty = tmp_path / "empty-dir"
+    empty.mkdir()
+    assert conform.main([str(empty)]) == 3
+    capsys.readouterr()
+
+
+def test_cli_json_output(tmp_path, capsys):
+    bad = _append([e for e in _clean_stream() if e.rank == 0
+                   and e.layer != "metrics"],
+                  0, "cplane", "flat_poison", a1=-2, a2=0)
+    _write_dump(tmp_path, bad)
+    assert conform.main([str(tmp_path), "--json"]) == 1
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed and parsed[0]["invariant"] == "proc-failed-poison"
+    assert parsed[0]["trace"]
+
+
+# ---------------------------------------------------------------------------
+# the event-coverage doctor <-> checker grammar agreement
+# ---------------------------------------------------------------------------
+
+def test_nbc_grammar_imported_from_model():
+    """The NBC automaton's grammar IS the model's TRACE_EVENTS table —
+    the no-drift coupling the tentpole requires."""
+    from mvapich2_tpu.analysis.model import nbc as nbc_model
+    got = set(conform.NbcAutomaton.grammar)
+    want = {(layer, n) for layer, names
+            in nbc_model.TRACE_EVENTS.items() for n in names}
+    assert got == want
+
+
+def test_native_events_covered_by_grammar():
+    from mvapich2_tpu.trace import native
+    for name, _region in native._NT_EVENTS:
+        assert conform.grammar_covers("cplane", name), name
+
+
+# ---------------------------------------------------------------------------
+# non-perturbation: conformance over a LIVE job's segments, read-only
+# ---------------------------------------------------------------------------
+
+def test_conform_does_not_perturb_live_job():
+    """test_mpistat.py style: attach the conformance checker to a
+    running job's ntrace segment (read-only) while it is mid-collective
+    loop; the job must still finish with "No Errors". Tail mode, since
+    the window is a partial run by construction."""
+    env = dict(os.environ)
+    env["MV2T_TEST_STAT_SECONDS"] = "8"
+    env["MV2T_NTRACE"] = "1"         # native ring on, recorder off
+    env.pop("MV2T_TRACE", None)
+    target = os.path.join(REPO, "tests", "progs",
+                          "mpistat_target_prog.py")
+    job = subprocess.Popen(
+        [sys.executable, "-m", "mvapich2_tpu.run", "-np", "2",
+         sys.executable, target],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, text=True)
+    try:
+        seg = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            line = job.stdout.readline()
+            if line.startswith("SEG "):
+                seg = line.split()[1]
+                break
+        assert seg, "target job never printed its segment stem"
+        time.sleep(2.0)              # let some collectives run
+        nt = seg + ".ntrace"
+        assert os.path.exists(nt)
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "mv2tconform"),
+             nt, "--tail"],
+            capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "0 violation(s)" in r.stdout
+        rest = job.stdout.read()
+        assert job.wait(timeout=120) == 0
+        assert "No Errors" in rest
+    finally:
+        if job.poll() is None:
+            job.kill()
+
+
+# ---------------------------------------------------------------------------
+# the chaos kill class: a seeded MV2T_FAULTS crash is NEVER silence
+# ---------------------------------------------------------------------------
+
+def test_seeded_kill_yields_poison_violation_class(tmp_path):
+    """A mid-collective kill (native flat_fold crash site) must show up
+    in conformance as the PROC_FAILED/poison violation class on the
+    survivors' traces — a failure run can never be certified clean."""
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               MV2T_FAULTS="flat_fold@0:crash:1:5",
+               MV2T_CHAOS_PHASES="flat",
+               MV2T_PEER_TIMEOUT="3.0",
+               MV2T_FT_WATCHER="0",
+               MPIEXEC_ALLOW_FAULT="1",
+               MV2T_TRACE="1",
+               MV2T_TRACE_DIR=str(tmp_path))
+    prog = os.path.join(REPO, "tests", "progs", "chaos_prog.py")
+    r = subprocess.run(
+        [sys.executable, "-m", "mvapich2_tpu.run", "-np", "4",
+         sys.executable, prog],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "No Errors" in r.stdout
+    assert list(tmp_path.glob("trace-r*.json")), "survivors dumped no traces"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "mv2tconform"),
+         str(tmp_path), "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, \
+        f"kill run certified clean (exit {r.returncode}):\n{r.stdout}"
+    parsed = json.loads(r.stdout)
+    assert any(v["invariant"] == "proc-failed-poison" for v in parsed), \
+        [v["invariant"] for v in parsed]

@@ -86,7 +86,7 @@ def test_inline_ignore_comment(tmp_path):
     src = ("class Chan:\n"
            "    def f(self, engine):\n"
            "        tr = engine.tracer\n"
-           "        tr.record('x', 'y')  # mv2tlint: ignore[traceguard]\n")
+           "        tr.record('mpi', 'y')  # mv2tlint: ignore[traceguard]\n")
     p = tmp_path / "ignored.py"
     p.write_text(src)
     mods, _ = core.scan_paths([str(p)])
@@ -826,11 +826,11 @@ def test_clean_proto_fixture_zero_findings():
 
 
 def test_proto_pass_in_default_gate():
-    """The tier-1 strict gate runs 9 passes including proto — a new
-    unbaselined control-plane finding fails tier-1 through
-    test_repo_strict_clean."""
+    """The tier-1 strict gate runs 10 passes including proto and the
+    event-coverage doctor — a new unbaselined control-plane finding
+    fails tier-1 through test_repo_strict_clean."""
     ids = [p.id for p in core.all_passes()]
-    assert "proto" in ids and len(ids) == 9
+    assert "proto" in ids and "events" in ids and len(ids) == 10
 
 
 def test_proto_baseline_ratchet_stays_empty():
@@ -839,6 +839,55 @@ def test_proto_baseline_ratchet_stays_empty():
     ones cannot be baselined away silently."""
     bl = core.load_baseline()
     assert [e for e in bl.entries if e.get("pass") == "proto"] == []
+
+
+# -- pass: events (trace event-coverage doctor) --------------------------
+
+def test_events_pass_fixture():
+    """Three seeded record sites outside the conformance grammar: a
+    literal name, an f-string prefix (mystery_*), and a wrapper whose
+    name parameter resolves through its call sites (the _trace_rma
+    idiom). The covered literals / prefixes / wildcard-mpi sites stay
+    silent, so the counts are exact."""
+    fs = _lint("bad_events.py")
+    assert _locs(fs, "events") == [("events", 10), ("events", 16),
+                                   ("events", 18)]
+    assert len(fs) == 3
+    msgs = "\n".join(f.msg for f in fs)
+    assert "bogus_wait" in msgs and "bogus_pulse" in msgs
+    assert "mystery_*" in msgs
+
+
+def test_events_pass_hist_and_nte_checks():
+    """The _MET_HISTS / _NT_EVENTS halves key on trace/native.py being
+    among the scanned modules: with the real one alongside the fixture,
+    the unknown latency-sample name is a finding, the known one is
+    silent, and the repo's own NTE->region map is fully covered by the
+    cplane conformance grammar (zero NTE findings)."""
+    from mvapich2_tpu.analysis.events import EventCoveragePass
+    native = os.path.join(REPO, "mvapich2_tpu", "trace", "native.py")
+    mods, errs = core.scan_paths(
+        [os.path.join(FIXTURES, "bad_events.py"), native])
+    assert not errs
+    fs = EventCoveragePass().run(mods)
+    assert [(f.line, "lat_bogus_thing" in f.msg) for f in fs
+            if "_MET_HISTS" in f.msg] == [(27, True)]
+    assert not any("NTE event" in f.msg for f in fs)
+
+
+def test_events_grammar_exports():
+    """The doctor consumes conform.event_grammars()/grammar_covers —
+    the same tables the runtime checker matches against, so the static
+    and dynamic views cannot drift apart."""
+    from mvapich2_tpu.analysis import conform
+    grams = conform.event_grammars()
+    for layer in ("mpi", "protocol", "channel", "progress", "nbc",
+                  "device", "cplane", "metrics"):
+        assert layer in grams, layer
+    assert conform.grammar_covers("device", "rma_lock")
+    assert conform.grammar_covers("nbc", "sched_start")
+    assert not conform.grammar_covers("device", "bogus_pulse")
+    assert not conform.grammar_covers("nolayer", "anything")
 
 
 def test_proto_pass_committed_tree_clean():

@@ -20,7 +20,7 @@ import pytest
 
 from mvapich2_tpu.analysis import model as M
 from mvapich2_tpu.analysis.model import (daemon, doorbell, flat2, ft,
-                                         ici, lease, rma, seqlock,
+                                         ici, lease, nbc, rma, seqlock,
                                          wiring)
 
 pytestmark = pytest.mark.lint
@@ -82,6 +82,24 @@ CLEAN = [
         2, 3, [[0, 2], [4, 0]])),
     ("ici-a2av-n3-skew", lambda: ici.build_alltoallv(
         3, 2, [[0, 2, 1], [1, 0, 2], [0, 1, 0]])),
+    # ISSUE 19 satellite: skewed and zero-count-row shapes in tier-1
+    ("ici-a2av-n2-big-skew", lambda: ici.build_alltoallv(
+        2, 2, [[0, 3], [1, 0]])),
+    ("ici-a2av-n3-zero-row", lambda: ici.build_alltoallv(
+        3, 2, [[0, 0, 0], [0, 0, 2], [2, 1, 0]])),
+    # the NBC DAG engine (coll/nbc/engine.py, ISSUE 19 tentpole):
+    # deposit/POLL/complete device schedules, net-shaped recv/send
+    # dependency firing, persistent restart, cancel/error unwind
+    ("nbc-dev-segs1", lambda: nbc.build_nbc("device", segs=1)),
+    ("nbc-dev-segs2", lambda: nbc.build_nbc("device", segs=2)),
+    ("nbc-dev-segs3", lambda: nbc.build_nbc("device", segs=3)),
+    ("nbc-dev-persistent", lambda: nbc.build_nbc(
+        "device", segs=2, persistent=True)),
+    ("nbc-dev-error-unwind", lambda: nbc.build_nbc(
+        "device", segs=2, error=True)),
+    ("nbc-net", lambda: nbc.build_nbc("net")),
+    ("nbc-net-persistent", lambda: nbc.build_nbc(
+        "net", persistent=True)),
     # passive-target one-sided epoch (ops/pallas_rma.py + rma/device.py):
     # lock / chunk-credit accumulate stream / flush / unlock against a
     # concurrent local reader and the two-phase target fold
@@ -179,6 +197,20 @@ EXPECTED_INVARIANT = {
     # on the global-counter slot schedule
     "skewed_count_slot": {"no-slot-collision", "agreement"},
     "zero_count_credit_leak": {"no-lost-credit", "deadlock"},
+    # ISSUE 19 satellite: the transport-asymmetry deadlock class PR 18
+    # fixed (one side wires fewer lanes than the counts matrix needs)
+    # and the zero-count-entry credit hole, reintroduced as mutations
+    "local_width_wire": {"deadlock"},
+    "zero_count_entry_skip": {"deadlock"},
+    # NBC DAG engine (ISSUE 19 tentpole)
+    "issue_ignores_deps": {"nbc-deps-before-issue",
+                           "nbc-deposit-before-poll"},
+    "poll_never_pumped": {"deadlock"},
+    "lost_completion_wakeup": {"deadlock"},
+    "unwind_leaves_inflight": {"nbc-drained-at-finalize"},
+    "stale_persistent_reuse": {"nbc-exec-epoch-fresh"},
+    "spurious_completion": {"nbc-issue-before-complete",
+                            "nbc-exec-epoch-fresh"},
     # passive-target one-sided epoch (ops/pallas_rma.py)
     "flush_skips_chunk": {"flush-completes-all-outstanding"},
     "unlock_before_drain": {"no-torn-window-read"},
@@ -265,13 +297,46 @@ def test_ici_matrix_has_six_mutations():
                     "scale_after_payload"}
 
 
-def test_a2av_matrix_has_two_mutations():
-    """ISSUE 18: the alltoallv variant (per-peer variable chunk counts
-    on the global-counter slot schedule) seeds >= 2 distinct protocol
-    breaks, each caught by a named invariant via test_mutation_caught
-    over the matrix."""
+def test_a2av_matrix_has_four_mutations():
+    """ISSUE 18 + ISSUE 19 satellite: the alltoallv variant (per-peer
+    variable chunk counts on the global-counter slot schedule) seeds
+    >= 4 distinct protocol breaks — including the transport-asymmetry
+    deadlock class PR 18 fixed, reintroduced as local_width_wire —
+    each caught by a named invariant via test_mutation_caught over the
+    matrix."""
     muts = {m[2] for m in M.mutation_matrix() if m[0] == "ici-a2av"}
-    assert muts == {"skewed_count_slot", "zero_count_credit_leak"}
+    assert muts == {"skewed_count_slot", "zero_count_credit_leak",
+                    "local_width_wire", "zero_count_entry_skip"}
+
+
+def test_nbc_matrix_has_six_mutations():
+    """ISSUE 19 tentpole: the NBC DAG model seeds >= 5 distinct
+    engine breaks (dependency-ignoring issue, un-pumped POLL, lost
+    completion wakeup, leaky error unwind, stale persistent reuse,
+    spurious completion), each caught by a named invariant via
+    test_mutation_caught over the matrix."""
+    muts = {m[2] for m in M.mutation_matrix() if m[0] == "nbc-dag"}
+    assert muts == {"issue_ignores_deps", "poll_never_pumped",
+                    "lost_completion_wakeup", "unwind_leaves_inflight",
+                    "stale_persistent_reuse", "spurious_completion"}
+
+
+def test_nbc_violation_trace_replays():
+    """An NBC dependency-break trace replays from init to a violating
+    state — the counterexample is actionable."""
+    m = nbc.build_nbc("device", segs=2, mutation="issue_ignores_deps")
+    r = M.explore(m)
+    v = next(v for v in r.violations
+             if v.invariant == "nbc-deps-before-issue")
+    state = dict(m.init)
+    by_name = {t.name: t for t in m.transitions}
+    for step in v.trace:
+        t = by_name[step]
+        assert t.guard(state), f"trace step {step} not enabled on replay"
+        state = t.apply(state)
+    name, pred = next(i for i in m.invariants
+                      if i[0] == "nbc-deps-before-issue")
+    assert pred(state) is not None, "replayed state does not violate"
 
 
 def test_a2av_violation_trace_replays():
@@ -495,11 +560,46 @@ def test_full_depth_a2av_matrix(n, depth, shape):
 def test_full_depth_a2av_mutations_np3():
     """The alltoallv mutations still caught away from their minimal
     configs (np=3, depth 3, multi-step skew)."""
-    for mut in ("skewed_count_slot", "zero_count_credit_leak"):
+    for mut in ("skewed_count_slot", "zero_count_credit_leak",
+                "local_width_wire", "zero_count_entry_skip"):
         r = M.explore(ici.build_alltoallv(
             3, 3, [[0, 1, 2], [3, 0, 0], [1, 2, 0]], mutation=mut),
             max_states=2_000_000)
         assert not r.ok, mut
+
+
+# -- NBC DAG engine: full acceptance bounds (ISSUE 19) -------------------
+
+@pytest.mark.modelcheck
+@pytest.mark.parametrize("segs", [1, 2, 3, 4])
+@pytest.mark.parametrize("persistent", [False, True])
+def test_full_depth_nbc_device_matrix(segs, persistent):
+    """ISSUE 19 acceptance: the device-shaped NBC schedule (deposit
+    CALL, segs POLL vertices, closing barrier CALL) is exhaustively
+    green across segment counts and the persistent restart cycle."""
+    r = M.explore(nbc.build_nbc("device", segs=segs,
+                                persistent=persistent),
+                  max_states=2_000_000)
+    assert r.complete, f"truncated at {r.states} states"
+    assert r.ok, [f"{v.invariant}: {v.message}" for v in r.violations]
+
+
+@pytest.mark.modelcheck
+def test_full_depth_nbc_mutations_wider():
+    """The NBC mutations still caught away from their minimal configs
+    (deeper segment counts / the error-unwind + persistent shapes)."""
+    for shape, kw, mut in (
+            ("device", dict(segs=3), "issue_ignores_deps"),
+            ("device", dict(segs=2), "poll_never_pumped"),
+            ("net", dict(persistent=True), "lost_completion_wakeup"),
+            ("device", dict(segs=3, error=True),
+             "unwind_leaves_inflight"),
+            ("device", dict(segs=2, persistent=True),
+             "stale_persistent_reuse"),
+            ("net", dict(), "spurious_completion")):
+        r = M.explore(nbc.build_nbc(shape, mutation=mut, **kw),
+                      max_states=2_000_000)
+        assert not r.ok, (shape, kw, mut)
 
 
 # -- passive-target one-sided epoch: full acceptance bounds (ISSUE 16) ---

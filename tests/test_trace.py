@@ -134,6 +134,14 @@ def test_mpitrace_end_to_end(tmp_path):
     assert "# trace summary" in r.stdout
     merged = json.load(open(out))
     _check_merged(merged, 4)
+    # conformance stamp (ISSUE 19): a clean tier-1 run replays through
+    # the protocol automata violation-free, on BOTH loader paths
+    for target in (str(out), str(tmp_path / "dumps")):
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "mv2tconform"),
+             target], capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, f"{target}:\n{r.stdout}{r.stderr}"
+        assert "0 violation(s)" in r.stdout
 
 
 def test_stall_watchdog_trips_exactly_once(monkeypatch):
