@@ -13,11 +13,16 @@ static int skip_for(long size) { return size > 8192 ? 10 : 50; }
 int main(int argc, char **argv) {
     long max_size = 1 << 20;
     int full = 0;
+    int opt_iters = 0, opt_skip = -1;   /* -i/-x: OSU option set */
     for (int i = 1; i < argc; i++) {
         if (strcmp(argv[i], "-m") == 0 && i + 1 < argc)
             max_size = atol(argv[++i]);
         else if (strcmp(argv[i], "-f") == 0)
             full = 1;
+        else if (strcmp(argv[i], "-i") == 0 && i + 1 < argc)
+            opt_iters = atoi(argv[++i]);
+        else if (strcmp(argv[i], "-x") == 0 && i + 1 < argc)
+            opt_skip = atoi(argv[++i]);
     }
     MPI_Init(&argc, &argv);
     int rank, np;
@@ -30,7 +35,8 @@ int main(int argc, char **argv) {
                "# Size       Avg Latency(us)\n");
     for (long size = 4; size <= max_size; size *= 2) {
         long count = size / 4;
-        int iters = iters_for(size), skip = skip_for(size);
+        int iters = opt_iters > 0 ? opt_iters : iters_for(size);
+        int skip = opt_skip >= 0 ? opt_skip : skip_for(size);
         MPI_Barrier(MPI_COMM_WORLD);
         double t_total = 0.0;
         for (int i = 0; i < iters + skip; i++) {

@@ -189,7 +189,8 @@ class FlatWaveAutomaton(Automaton):
                ("cplane", "flat_fanout"), ("cplane", "flat_poison"),
                ("cplane", "flat2_fold"), ("cplane", "flat2_xchg"),
                ("cplane", "flat2_fanout"), ("cplane", "mcast_pub"),
-               ("cplane", "mcast_cons"), ("cplane", "coll_dispatch"))
+               ("cplane", "mcast_cons"), ("cplane", "coll_dispatch"),
+               ("cplane", "net2_*"))
     invariants = ("fanin-before-fold-before-fanout", "mseq-monotone",
                   "poison-sticky", "proc-failed-poison")
     tail_safe = frozenset({"mseq-monotone", "poison-sticky",
@@ -226,6 +227,13 @@ class FlatWaveAutomaton(Automaton):
             return
         if ev.name == "coll_dispatch":
             return                       # tier-choice instant, no order
+        if ev.name.startswith("net2_"):
+            # net2 tier progress instants (coll/netcoll.py: group fold /
+            # leader bridge / fan-out) — the sub-plane collectives they
+            # drive emit their own flat/flat2 events into this
+            # automaton; the net2 markers themselves carry group
+            # counts, not ctx/seq numbering
+            return
         ctx = a1
         self._ctxs.setdefault(r, set()).add(ctx)
         scope = (r, ctx)

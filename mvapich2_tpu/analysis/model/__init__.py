@@ -74,6 +74,19 @@ deadlock freedom. Its ``TRACE_EVENTS`` table doubles as the runtime
 event grammar of analysis/conform.py's NBC conformance automaton, so
 the offline proof and the live-trace check share one source of truth.
 
+The three-level hierarchy (PR 20) adds one model per new level:
+``ici.build_mesh`` carries the multi-axis mesh phase composition
+(RS-x -> RS-y -> AG-y -> AG-x over a px x py chip grid, with the
+leaders-per-chip HBM fold in front) at contribution-set granularity —
+its axis-phase-order invariant pins "no chip starts an axis's AG
+before its own RS of that axis completed", the ordering bug class the
+nested sub-shard decomposition makes load-bearing. ``flat2.build_net2``
+models the np>64 node-leader bridge (coll/netcoll.py): group fold into
+the node leader, seqlock-skeleton lane publish to the root leader's
+bridge fold, fan-out of the total — with a node-leader-crash probe
+proving an aborted wave poisons the cached split so the next
+collective DEGRADES to sched instead of folding the dead lane.
+
 Every model takes ``mutation=<name>`` seeding a realistic protocol
 break (stamp-before-copy, missing final poll, throttle past the
 deadline, ...); tests/test_modelcheck.py asserts the checker catches
@@ -136,6 +149,24 @@ def mutation_matrix():
         ("flat2-mcast", lambda: flat2.build_mcast(
             n=3, waves=1, nbuf=1, mutation="no_first_sync"),
          "no_first_sync"),
+        # three-level hierarchy (PR 20): multi-axis mesh phases with
+        # the leaders-per-chip fold, and the net2 node-leader bridge
+        ("ici-mesh", lambda: ici.build_mesh(
+            px=2, py=2, mutation="ag_before_rs_crossaxis"),
+         "ag_before_rs_crossaxis"),
+        ("ici-mesh", lambda: ici.build_mesh(
+            px=2, py=2, k=2, mutation="leader_fold_skipped"),
+         "leader_fold_skipped"),
+        ("flat2-net2", lambda: flat2.build_net2(
+            groups=2, k=2, mutation="bridge_before_group_fold"),
+         "bridge_before_group_fold"),
+        ("flat2-net2", lambda: flat2.build_net2(
+            groups=2, k=2, mutation="fanout_before_bridge"),
+         "fanout_before_bridge"),
+        ("flat2-net2", lambda: flat2.build_net2(
+            groups=2, k=2, crash=True,
+            mutation="leader_crash_no_poison"),
+         "leader_crash_no_poison"),
         # chunk-credit remote-DMA ring (ops/pallas_ici.py)
         ("ici-ring", lambda: ici.build_ring(
             n=2, chunks=4, depth=2, mutation="no_credit_wait"),

@@ -518,3 +518,364 @@ def build_mcast(n: int = 3, waves: int = 2, nbuf: int = 1,
     return Model(f"flat2-mcast(n={n},waves={waves},nbuf={nbuf},"
                  f"mut={mutation})", init, ts,
                  [("mcast-data", inv_data)], final)
+
+
+def build_net2(groups: int = 2, k: int = 2, crash: bool = False,
+               mutation: Optional[str] = None) -> Model:
+    """The net2 node-leader bridge (coll/netcoll.py): past np=64 the
+    comm splits into ``groups`` node groups of ``k`` ranks; members
+    fold into their node leader over the node-local flat tier, the
+    leaders bridge partials over the KVS/TCP lanes (the ROOT leader,
+    group 0's, folds the lane slots and publishes the total), and each
+    leader fans the total back out through its group block.
+
+    The bridge lane slot is a seqlock skeleton (TORN-split publish +
+    in-stamp) because that is what the TCP-lane exchange actually is:
+    a leader can die mid-publish, and the root must never fold a torn
+    or unstamped lane. ``crash=True`` adds the node-leader-crash probe:
+    the LAST group's leader dies mid-bridge, the root aborts the wave,
+    poisons the net2 state, and a re-entry probe models the next
+    collective on the comm — it must DEGRADE to the sched path (refuse
+    the cached net2 split), never fold the dead wave's lane slots.
+
+    Invariants: no-torn-read-delivered, agreement (every delivered
+    result is the full contribution set), poison-sticky (crash only),
+    plus the explorer's built-in deadlock freedom.
+
+    Mutations (tests/test_modelcheck.py asserts each is caught):
+
+      bridge_before_group_fold  a leader publishes its bridge lane slot
+                                BEFORE folding its group members — the
+                                root's total (and every delivered
+                                result) misses their contributions
+      fanout_before_bridge      a leader fans its group block out
+                                straight after the group fold, before
+                                reading the bridge total — its members
+                                deliver the group partial
+      leader_crash_no_poison    the abort after a mid-bridge leader
+                                death skips the sticky poison — the
+                                next collective re-enters net2 over the
+                                dead split instead of degrading
+    """
+    assert groups >= 2 and k >= 2
+    n = groups * k
+    gv = groups - 1                      # crash victim: last group
+
+    init = {"poison": 0, "aborted": 0, "reuse_res": None,
+            "bseq": 0, "bpay": frozenset()}
+    for g in range(groups):
+        init[f"acc{g}"] = frozenset({(g * k, 1)})  # leader's own share
+        init[f"bl{g}"] = frozenset()     # bridge lane slot
+        init[f"blin{g}"] = 0             # lane in-stamp
+        init[f"gb{g}"] = frozenset()     # group result block
+        init[f"gbseq{g}"] = 0
+        init[f"lalive{g}"] = 1
+        init[f"pl{g}"] = 0
+    for r in range(n):
+        init[f"res{r}"] = None
+    for g in range(groups):
+        for j in range(1, k):
+            r = g * k + j
+            init[f"pay{r}"] = frozenset()
+            init[f"in{r}"] = 0
+
+    def running(s, g):
+        return s[f"lalive{g}"] and not s["aborted"]
+
+    ts = []
+
+    # ---- group members: torn-split contribution copy + delivery -----
+    for g in range(groups):
+        for j in range(1, k):
+            r = g * k + j
+
+            def mkm(g=g, r=r):
+                def a_begin(s):
+                    s[f"pay{r}"] = TORN
+                    s[f"pc_m{r}"] = 1
+                    return s
+
+                def a_copy(s):
+                    s[f"pay{r}"] = frozenset({(r, 1)})
+                    s[f"pc_m{r}"] = 2
+                    return s
+
+                def a_stamp(s):
+                    s[f"in{r}"] = 1
+                    s[f"pc_m{r}"] = 3
+                    return s
+
+                def g_read(s):
+                    return not s["aborted"] and s[f"pc_m{r}"] == 3 \
+                        and s[f"gbseq{g}"] >= 1
+
+                def a_read(s):
+                    s[f"res{r}"] = s[f"gb{g}"]
+                    s[f"pc_m{r}"] = 4
+                    return s
+
+                return [
+                    Transition(f"m{r}.begin_copy", f"r{r}",
+                               lambda s, r=r: not s["aborted"]
+                               and s[f"pc_m{r}"] == 0, a_begin,
+                               frozenset({f"pc_m{r}", "aborted"}),
+                               frozenset({f"pay{r}", f"pc_m{r}"})),
+                    Transition(f"m{r}.end_copy", f"r{r}",
+                               lambda s, r=r: not s["aborted"]
+                               and s[f"pc_m{r}"] == 1, a_copy,
+                               frozenset({f"pc_m{r}", "aborted"}),
+                               frozenset({f"pay{r}", f"pc_m{r}"})),
+                    Transition(f"m{r}.stamp_in", f"r{r}",
+                               lambda s, r=r: not s["aborted"]
+                               and s[f"pc_m{r}"] == 2, a_stamp,
+                               frozenset({f"pc_m{r}", "aborted"}),
+                               frozenset({f"in{r}", f"pc_m{r}"})),
+                    Transition(f"m{r}.read_gb", f"r{r}", g_read, a_read,
+                               frozenset({f"pc_m{r}", "aborted",
+                                          f"gbseq{g}", f"gb{g}"}),
+                               frozenset({f"res{r}", f"pc_m{r}"})),
+                ]
+            init[f"pc_m{r}"] = 0
+            ts.extend(mkm())
+
+    # ---- leader programs --------------------------------------------
+    if mutation == "bridge_before_group_fold":
+        nonroot = ("bpub_begin", "bpub_end", "fold", "bread", "fanout")
+    elif mutation == "fanout_before_bridge":
+        nonroot = ("fold", "bpub_begin", "bpub_end", "fanout", "bread")
+    else:
+        nonroot = ("fold", "bpub_begin", "bpub_end", "bread", "fanout")
+    rootprog = ("fold", "bfold", "btotal", "fanout")
+
+    for g in range(groups):
+        r = g * k
+        prog = rootprog if g == 0 else nonroot
+        for i, stp in enumerate(prog):
+            def mk(g=g, r=r, i=i, stp=stp):
+                pl, acc = f"pl{g}", f"acc{g}"
+
+                if stp == "fold":
+                    stamps = [f"in{g * k + j}" for j in range(1, k)]
+                    pays = [f"pay{g * k + j}" for j in range(1, k)]
+
+                    def guard(s):
+                        return running(s, g) and s[pl] == i \
+                            and all(s[m] >= 1 for m in stamps)
+
+                    def apply(s):
+                        a = s[acc]
+                        torn = a == TORN
+                        for m in pays:
+                            if s[m] == TORN or torn:
+                                torn = True
+                            else:
+                                a = a | s[m]
+                        s[acc] = TORN if torn else a
+                        s[pl] = i + 1
+                        return s
+
+                    return Transition(f"L{g}.fold", f"r{r}", guard,
+                                      apply,
+                                      frozenset({pl, f"lalive{g}",
+                                                 "aborted"}
+                                                | set(stamps)
+                                                | set(pays)),
+                                      frozenset({acc, pl}))
+
+                if stp == "bpub_begin":
+                    def guard(s):
+                        return running(s, g) and s[pl] == i
+
+                    def apply(s):
+                        s[f"bl{g}"] = TORN
+                        s[pl] = i + 1
+                        return s
+
+                    return Transition(f"L{g}.bpub_begin", f"r{r}",
+                                      guard, apply,
+                                      frozenset({pl, f"lalive{g}",
+                                                 "aborted"}),
+                                      frozenset({f"bl{g}", pl}))
+
+                if stp == "bpub_end":
+                    def guard(s):
+                        return running(s, g) and s[pl] == i
+
+                    def apply(s):
+                        s[f"bl{g}"] = s[acc]
+                        s[f"blin{g}"] = 1        # release stamp
+                        s[pl] = i + 1
+                        return s
+
+                    return Transition(f"L{g}.bpub_end", f"r{r}", guard,
+                                      apply,
+                                      frozenset({pl, acc, f"lalive{g}",
+                                                 "aborted"}),
+                                      frozenset({f"bl{g}", f"blin{g}",
+                                                 pl}))
+
+                if stp == "bread":
+                    def guard(s):
+                        return running(s, g) and s[pl] == i \
+                            and s["bseq"] >= 1
+
+                    def apply(s):
+                        s[acc] = s["bpay"]
+                        s[pl] = i + 1
+                        return s
+
+                    return Transition(f"L{g}.bread", f"r{r}", guard,
+                                      apply,
+                                      frozenset({pl, "bseq", "bpay",
+                                                 f"lalive{g}",
+                                                 "aborted"}),
+                                      frozenset({acc, pl}))
+
+                if stp == "bfold":
+                    lins = [f"blin{j}" for j in range(1, groups)]
+                    lslots = [f"bl{j}" for j in range(1, groups)]
+
+                    def guard(s):
+                        return running(s, g) and s[pl] == i \
+                            and all(s[x] >= 1 for x in lins)
+
+                    def apply(s):
+                        a = s[acc]
+                        torn = a == TORN
+                        for x in lslots:
+                            if s[x] == TORN or torn:
+                                torn = True
+                            elif s[x]:
+                                a = a | s[x]
+                        s[acc] = TORN if torn else a
+                        s[pl] = i + 1
+                        return s
+
+                    return Transition("L0.bfold", f"r{r}", guard, apply,
+                                      frozenset({pl, acc, f"lalive{g}",
+                                                 "aborted"}
+                                                | set(lins)
+                                                | set(lslots)),
+                                      frozenset({acc, pl}))
+
+                if stp == "btotal":
+                    def guard(s):
+                        return running(s, g) and s[pl] == i
+
+                    def apply(s):
+                        s["bpay"] = s[acc]
+                        s["bseq"] = 1            # release publish
+                        s[pl] = i + 1
+                        return s
+
+                    return Transition("L0.btotal", f"r{r}", guard,
+                                      apply,
+                                      frozenset({pl, acc, f"lalive{g}",
+                                                 "aborted"}),
+                                      frozenset({"bpay", "bseq", pl}))
+
+                # fanout
+                def guard(s):
+                    return running(s, g) and s[pl] == i
+
+                def apply(s):
+                    s[f"gb{g}"] = s[acc]
+                    s[f"gbseq{g}"] = 1           # release stamp
+                    s[f"res{r}"] = s[acc]
+                    s[pl] = i + 1
+                    return s
+
+                return Transition(f"L{g}.fanout", f"r{r}", guard, apply,
+                                  frozenset({pl, acc, f"lalive{g}",
+                                             "aborted"}),
+                                  frozenset({f"gb{g}", f"gbseq{g}",
+                                             f"res{r}", pl}))
+            ts.append(mk())
+
+    # ---- node-leader-crash probe ------------------------------------
+    if crash:
+        vr = gv * k
+
+        def g_die(s):
+            # die before or mid bridge-publish: the lane slot is
+            # empty-stale or TORN, its in-stamp never lands
+            return s[f"lalive{gv}"] and not s["aborted"] \
+                and s[f"pl{gv}"] in (1, 2)
+
+        def a_die(s):
+            s[f"lalive{gv}"] = 0
+            return s
+
+        def g_abort(s):
+            # the root's lane timeout fires on the dead leader
+            return s["lalive0"] and not s[f"lalive{gv}"] \
+                and not s["aborted"]
+
+        def a_abort(s):
+            s["aborted"] = 1
+            if mutation != "leader_crash_no_poison":
+                s["poison"] = 1                  # MUTANT skips this
+            return s
+
+        def g_probe(s):
+            # the next collective on the comm hits the cached split
+            return s["aborted"] and s["reuse_res"] is None
+
+        def a_probe(s):
+            if s["poison"]:
+                s["reuse_res"] = "degraded"      # falls back to sched
+            else:
+                torn = any(s[f"bl{g}"] == TORN for g in range(groups))
+                s["reuse_res"] = TORN if torn else "folded"
+            return s
+
+        ts.extend([
+            Transition("V.die", f"r{vr}", g_die, a_die,
+                       frozenset({f"pl{gv}", f"lalive{gv}", "aborted"}),
+                       frozenset({f"lalive{gv}"})),
+            Transition("L0.abort_poison", "r0", g_abort, a_abort,
+                       frozenset({"lalive0", f"lalive{gv}", "aborted"}),
+                       frozenset({"aborted", "poison"})),
+            Transition("net2.reenter_probe", "reenter", g_probe,
+                       a_probe,
+                       frozenset({"aborted", "poison", "reuse_res"}
+                                 | {f"bl{g}" for g in range(groups)}),
+                       frozenset({"reuse_res"})),
+        ])
+
+    # ---- invariants --------------------------------------------------
+    def inv_torn(s):
+        for r in range(n):
+            if s[f"res{r}"] == TORN:
+                return f"rank {r} delivered a TORN payload"
+        if s["reuse_res"] == TORN:
+            return ("net2 re-entry folded the dead leader's torn "
+                    "bridge lane slot")
+        return None
+
+    def inv_agree(s):
+        for r in range(n):
+            v = s[f"res{r}"]
+            if v is not None and v != TORN and v != _full(n, 1):
+                return (f"rank {r} delivered {sorted(v)} != the full "
+                        "contribution set")
+        return None
+
+    def inv_poison(s):
+        if s["aborted"] and not s["poison"]:
+            return ("net2 wave aborted on a dead node leader but the "
+                    "split state is not poisoned — the next collective "
+                    "re-enters instead of degrading to sched")
+        return None
+
+    def final(s):
+        if s["aborted"]:
+            return s["reuse_res"] is not None
+        return all(s[f"res{r}"] is not None for r in range(n))
+
+    invs = [("no-torn-read-delivered", inv_torn),
+            ("agreement", inv_agree)]
+    if crash:
+        invs.append(("poison-sticky", inv_poison))
+    return Model(f"flat2-net2(g={groups},k={k},crash={crash},"
+                 f"mut={mutation})", init, ts, invs, final)

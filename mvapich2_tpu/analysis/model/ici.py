@@ -599,3 +599,303 @@ def build_alltoallv(n: int, depth: int, counts,
                   ("no-lost-credit", inv_credit),
                   ("agreement", inv_agree)],
                  final)
+
+
+def build_mesh(px: int = 2, py: int = 2, k: int = 1,
+               mutation: Optional[str] = None) -> Model:
+    """Multi-axis mesh RS/AG phase model (ops/pallas_ici.py
+    ici_all_reduce_mesh + coll/device.py DeviceFoldChannel) at
+    contribution-set granularity.
+
+    A ``px`` x ``py`` chip mesh runs the nested phase decomposition the
+    multi-axis device allreduce executes: reduce-scatter along x, then
+    along y, then all-gather along y, then along x — each axis phase a
+    publish/fold wave over that axis's ring. ``k`` ranks per chip adds
+    the leaders-per-chip HBM fold in front: co-located member ranks
+    stamp their contribution into the chip leader, which folds them
+    before any ICI phase runs. Per-chunk slot/credit flow control is
+    ``build_ring``'s job — this model carries the PHASE-ORDERING bugs
+    of the three-level composition, so payloads are contribution sets
+    and each phase is atomic publish + guarded fold.
+
+    The nesting is what makes ordering load-bearing: RS-y operates on
+    RS-x's per-column partials, and the axis-k AG gathers sub-shard
+    pieces that are only fully reduced once EVERY RS phase has landed.
+    A rank that starts an axis's AG before that axis's RS has completed
+    on it publishes a cross-axis partial, and the piece its ring peers
+    gather is stale forever after.
+
+    Invariants:
+
+      * **axis-phase-order** — no chip starts the AG of an axis (first
+        gather-slot publish) before its own RS of that axis completed;
+      * **agreement** — every delivered result covers the full px x py
+        sub-shard grid and every gathered piece equals the FULL
+        contribution set (all chips x all co-located ranks);
+      * **no-deadlock** — the wave always completes (explorer built-in).
+
+    Mutations (tests/test_modelcheck.py asserts each is caught):
+
+      ag_before_rs_crossaxis  the chip treats the CROSS axis's RS
+                              completion as license to start the axis-y
+                              AG — it publishes its gather slot straight
+                              after RS-x, before its own RS-y fold, so
+                              the slot carries the pre-y row partial
+      leader_fold_skipped     the chip leader enters the ICI phases
+                              without waiting for (or folding) its
+                              co-located members' HBM slots — every
+                              delivered shard misses their contributions
+    """
+    assert px >= 1 and py >= 1 and px * py >= 2 and k >= 1
+    if mutation == "leader_fold_skipped":
+        assert k >= 2, "leader_fold_skipped needs co-located ranks"
+    nc = px * py
+
+    def cx(c: int) -> int:
+        return c % px
+
+    def cy(c: int) -> int:
+        return c // px
+
+    def xring(c: int):
+        return tuple(cy(c) * px + i for i in range(px))
+
+    def yring(c: int):
+        return tuple(j * px + cx(c) for j in range(py))
+
+    full = frozenset((c, j) for c in range(nc) for j in range(k))
+    shards = frozenset((i, j) for i in range(px) for j in range(py))
+
+    # the serialized per-chip phase program; the mutant hoists the
+    # axis-y AG publish to right after the axis-x RS fold
+    if mutation == "ag_before_rs_crossaxis":
+        steps = ("fold", "rsx_pub", "rsx_fold", "agy_pub", "rsy_pub",
+                 "rsy_fold", "agy_fold", "agx_pub", "agx_fold")
+    else:
+        steps = ("fold", "rsx_pub", "rsx_fold", "rsy_pub", "rsy_fold",
+                 "agy_pub", "agy_fold", "agx_pub", "agx_fold")
+    end = len(steps)
+
+    init = {}
+    for c in range(nc):
+        init[f"pc{c}"] = 0
+        init[f"acc{c}"] = frozenset({(c, 0)})   # the leader's own share
+        init[f"gat{c}"] = frozenset()           # gathered (shard, piece)
+        init[f"res{c}"] = None
+        for ph in ("rsx", "rsy", "agy", "agx"):
+            init[f"{ph}_sl{c}"] = frozenset()
+            init[f"{ph}_in{c}"] = 0
+        init[f"rsx_done{c}"] = 0
+        init[f"rsy_done{c}"] = 0
+        for j in range(1, k):
+            init[f"min{c}_{j}"] = 0             # member HBM-slot stamp
+
+    ts = []
+    for c in range(nc):
+        # co-located member ranks: stamp the chip leader's HBM slot.
+        # One atomic step — the torn-copy surface is the hbm slot
+        # model's job; this model carries the ordering bugs.
+        for j in range(1, k):
+            def mkm(c=c, j=j):
+                key = f"min{c}_{j}"
+
+                def guard(s):
+                    return s[key] == 0
+
+                def apply(s):
+                    s[key] = 1
+                    return s
+
+                return Transition(f"c{c}.m{j}.stamp", f"m{c}_{j}",
+                                  guard, apply,
+                                  frozenset({key}), frozenset({key}))
+            ts.append(mkm())
+
+        for i, stp in enumerate(steps):
+            def mk(c=c, i=i, stp=stp):
+                pc, acc = f"pc{c}", f"acc{c}"
+
+                if stp == "fold":
+                    stamps = [f"min{c}_{j}" for j in range(1, k)]
+
+                    def guard(s):
+                        if s[pc] != i:
+                            return False
+                        if mutation == "leader_fold_skipped":
+                            return True      # MUTANT: no member wait
+                        return all(s[m] >= 1 for m in stamps)
+
+                    def apply(s):
+                        if mutation != "leader_fold_skipped":
+                            s[acc] = s[acc] | frozenset(
+                                (c, j) for j in range(1, k))
+                        s[pc] = i + 1
+                        return s
+
+                    return Transition(f"c{c}.fold", f"c{c}", guard,
+                                      apply,
+                                      frozenset({pc} | set(stamps)),
+                                      frozenset({pc, acc}))
+
+                if stp in ("rsx_pub", "rsy_pub"):
+                    ph = stp[:3]
+                    sl, stamp = f"{ph}_sl{c}", f"{ph}_in{c}"
+
+                    def guard(s):
+                        return s[pc] == i
+
+                    def apply(s):
+                        s[sl] = s[acc]
+                        s[stamp] = 1
+                        s[pc] = i + 1
+                        return s
+
+                    return Transition(f"c{c}.{stp}", f"c{c}", guard,
+                                      apply, frozenset({pc, acc}),
+                                      frozenset({pc, sl, stamp}))
+
+                if stp in ("rsx_fold", "rsy_fold"):
+                    ph = stp[:3]
+                    ring = xring(c) if ph == "rsx" else yring(c)
+                    stamps = [f"{ph}_in{p}" for p in ring]
+                    slots = [f"{ph}_sl{p}" for p in ring]
+                    done = f"{ph}_done{c}"
+
+                    def guard(s):
+                        return s[pc] == i \
+                            and all(s[m] >= 1 for m in stamps)
+
+                    def apply(s):
+                        u = frozenset()
+                        for slk in slots:
+                            u = u | s[slk]
+                        s[acc] = u
+                        s[done] = 1
+                        s[pc] = i + 1
+                        return s
+
+                    return Transition(f"c{c}.{stp}", f"c{c}", guard,
+                                      apply,
+                                      frozenset({pc} | set(stamps)
+                                                | set(slots)),
+                                      frozenset({pc, acc, done}))
+
+                if stp == "agy_pub":
+                    sl, stamp = f"agy_sl{c}", f"agy_in{c}"
+
+                    def guard(s):
+                        return s[pc] == i
+
+                    def apply(s):
+                        # publish the (sub-shard, piece) this chip owns
+                        s[sl] = frozenset({((cx(c), cy(c)), s[acc])})
+                        s[stamp] = 1
+                        s[pc] = i + 1
+                        return s
+
+                    return Transition(f"c{c}.agy_pub", f"c{c}", guard,
+                                      apply, frozenset({pc, acc}),
+                                      frozenset({pc, sl, stamp}))
+
+                if stp == "agy_fold":
+                    ring = yring(c)
+                    stamps = [f"agy_in{p}" for p in ring]
+                    slots = [f"agy_sl{p}" for p in ring]
+                    gat = f"gat{c}"
+
+                    def guard(s):
+                        return s[pc] == i \
+                            and all(s[m] >= 1 for m in stamps)
+
+                    def apply(s):
+                        u = frozenset()
+                        for slk in slots:
+                            u = u | s[slk]
+                        s[gat] = u
+                        s[pc] = i + 1
+                        return s
+
+                    return Transition(f"c{c}.agy_fold", f"c{c}", guard,
+                                      apply,
+                                      frozenset({pc} | set(stamps)
+                                                | set(slots)),
+                                      frozenset({pc, gat}))
+
+                if stp == "agx_pub":
+                    sl, stamp = f"agx_sl{c}", f"agx_in{c}"
+                    gat = f"gat{c}"
+
+                    def guard(s):
+                        return s[pc] == i
+
+                    def apply(s):
+                        s[sl] = s[gat]
+                        s[stamp] = 1
+                        s[pc] = i + 1
+                        return s
+
+                    return Transition(f"c{c}.agx_pub", f"c{c}", guard,
+                                      apply, frozenset({pc, gat}),
+                                      frozenset({pc, sl, stamp}))
+
+                # agx_fold: gather the row's column-gathers — delivery
+                ring = xring(c)
+                stamps = [f"agx_in{p}" for p in ring]
+                slots = [f"agx_sl{p}" for p in ring]
+                res = f"res{c}"
+
+                def guard(s):
+                    return s[pc] == i and all(s[m] >= 1 for m in stamps)
+
+                def apply(s):
+                    u = frozenset()
+                    for slk in slots:
+                        u = u | s[slk]
+                    s[res] = u
+                    s[pc] = i + 1
+                    return s
+
+                return Transition(f"c{c}.agx_fold", f"c{c}", guard,
+                                  apply,
+                                  frozenset({pc} | set(stamps)
+                                            | set(slots)),
+                                  frozenset({pc, res}))
+            ts.append(mk())
+
+    # ---- invariants --------------------------------------------------
+    def inv_order(s):
+        for c in range(nc):
+            if s[f"agy_in{c}"] and not s[f"rsy_done{c}"]:
+                return (f"chip {c} started its axis-y AG (published "
+                        "the gather slot) before its own axis-y RS "
+                        "completed")
+            if s[f"agx_in{c}"] and not s[f"rsx_done{c}"]:
+                return (f"chip {c} started its axis-x AG before its "
+                        "own axis-x RS completed")
+        return None
+
+    def inv_agree(s):
+        for c in range(nc):
+            r = s[f"res{c}"]
+            if r is None:
+                continue
+            got = {sh for sh, _ in r}
+            if got != shards:
+                return (f"chip {c} delivered shards {sorted(got)} != "
+                        f"the full {px}x{py} sub-shard cover")
+            for sh, pay in r:
+                if pay != full:
+                    return (f"chip {c} sub-shard {sh} gathered "
+                            f"{sorted(pay)} != the full contribution "
+                            "set — a cross-axis partial leaked through "
+                            "the AG gather")
+        return None
+
+    def final(s):
+        return all(s[f"pc{c}"] == end for c in range(nc))
+
+    label = (f"ici-mesh(px={px},py={py},k={k},mut={mutation})")
+    return Model(label, init, ts,
+                 [("axis-phase-order", inv_order),
+                  ("agreement", inv_agree)],
+                 final)

@@ -5,12 +5,14 @@ VMEM flat ring / HBM-streaming chunked ring / XLA by measured boundaries)
 across per-shard message sizes and emits an osu_compare-compatible
 artifact::
 
-    {"results": {"dev_allreduce_effbw":    {"<bytes>": GB/s, ...},
-                 "dev_allreduce_q8_effbw": {"<bytes>": GB/s, ...},
-                 "dev_put_bw":             {"<bytes>": GB/s, ...},
-                 "dev_get_bw":             {"<bytes>": GB/s, ...},
-                 "dev_acc_bw":             {"<bytes>": GB/s, ...}},
+    {"results": {"dev_allreduce_effbw":      {"<bytes>": GB/s, ...},
+                 "dev_allreduce_q8_effbw":   {"<bytes>": GB/s, ...},
+                 "dev_allreduce_mesh_effbw": {"<bytes>": GB/s, ...},
+                 "dev_put_bw":               {"<bytes>": GB/s, ...},
+                 "dev_get_bw":               {"<bytes>": GB/s, ...},
+                 "dev_acc_bw":               {"<bytes>": GB/s, ...}},
      "tiers":      {"<bytes>": "vmem|hbm|quant|xla", ...},
+     "mesh":       "<px>x<py>",
      "rma_tiers":  {"<bytes>": "rdma|quant|epoch", ...},
      "wire_bytes": {"<bytes>": {"exact": N, "quant": N}, ...}}
 
@@ -52,8 +54,19 @@ def _ensure_mesh(np_: int) -> None:
             + f" --xla_force_host_platform_device_count={np_}").strip()
 
 
+def _parse_mesh(spec: str) -> Optional[tuple]:
+    """'2x4' -> (2, 4); '' -> None (1-D ring only)."""
+    if not spec:
+        return None
+    px, py = (int(t) for t in spec.lower().split("x"))
+    if px < 1 or py < 1:
+        raise ValueError(f"bad mesh spec {spec!r}")
+    return (px, py)
+
+
 def sweep(sizes: List[int], iters: int = 5,
-          interpret: Optional[bool] = None) -> Dict:
+          interpret: Optional[bool] = None,
+          mesh_shape: Optional[tuple] = None) -> Dict:
     """Measure the tier-dispatched device allreduce at each per-shard
     size. Returns the artifact dict (see module docstring)."""
     import jax
@@ -75,10 +88,21 @@ def sweep(sizes: List[int], iters: int = 5,
         interpret = devs[0].platform != "tpu"
     mesh = make_mesh((p,), ("x",), devs)
     sharding = NamedSharding(mesh, P("x"))
+    # the mesh-shape column: a 2-D grid over the SAME devices for the
+    # multi-axis RS/AG band (per-axis phase chains); the 1-D bands
+    # above stay on the plain ring so their history remains comparable
+    if mesh_shape is not None:
+        px, py = mesh_shape
+        if px * py != p:
+            raise RuntimeError(f"mesh {px}x{py} != {p} devices")
+        mesh2 = make_mesh((px, py), ("x", "y"), devs)
+        sharding2 = NamedSharding(mesh2, P(("x", "y")))
 
-    def timed(body, x):
-        f = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("x"),),
-                              out_specs=P("x"), check_vma=False))
+    def timed(body, x, tmesh=None, spec=None):
+        tmesh = mesh if tmesh is None else tmesh
+        spec = P("x") if spec is None else spec
+        f = jax.jit(shard_map(body, mesh=tmesh, in_specs=(spec,),
+                              out_specs=spec, check_vma=False))
         jax.block_until_ready(f(x))       # compile outside the window
         ts = []
         for _ in range(iters):
@@ -90,6 +114,7 @@ def sweep(sizes: List[int], iters: int = 5,
 
     results: Dict[str, float] = {}
     results_q: Dict[str, float] = {}
+    results_mesh: Dict[str, float] = {}
     tiers: Dict[str, str] = {}
     wire_bytes: Dict[str, Dict[str, int]] = {}
     for nbytes in sizes:
@@ -109,6 +134,14 @@ def sweep(sizes: List[int], iters: int = 5,
             s, "x", p, wire="q8", interpret=interpret), x)
         results_q[str(nbytes)] = round(2.0 * (p - 1) / p * m / tq / 1e9,
                                        6)
+        if mesh_shape is not None:
+            x2 = jax.device_put(jnp.ones((n * p,), jnp.float32),
+                                sharding2)
+            tm = timed(lambda s: pallas_ici.ici_all_reduce_mesh(
+                s, (("x", px), ("y", py)), interpret=interpret), x2,
+                tmesh=mesh2, spec=P(("x", "y")))
+            results_mesh[str(nbytes)] = round(
+                2.0 * (p - 1) / p * m / tm / 1e9, 6)
     # the one-sided band: Put/Get/Accumulate of the full per-shard
     # message between the 0/(p-1) pair — osu_put_bw's plain bw = m / t
     results_1s: Dict[str, Dict[str, float]] = {
@@ -139,16 +172,23 @@ def sweep(sizes: List[int], iters: int = 5,
         n = max(4, nbytes // 4)
         exact_b, quant_b = pallas_quant.wire_stats(n, jnp.float32, p)
         wire_bytes[str(nbytes)] = {"exact": exact_b, "quant": quant_b}
-    return {"results": {"dev_allreduce_effbw": results,
-                        "dev_allreduce_q8_effbw": results_q,
-                        **results_1s},
+    bands = {"dev_allreduce_effbw": results,
+             "dev_allreduce_q8_effbw": results_q,
+             **results_1s}
+    mesh_col = "x".join(map(str, mesh_shape)) if mesh_shape else \
+        f"{p}x1"
+    if results_mesh:
+        bands["dev_allreduce_mesh_effbw"] = results_mesh
+    return {"results": bands,
             "tiers": tiers,
+            "mesh": mesh_col,
             "rma_tiers": rma_tiers,
             "wire_bytes": wire_bytes,
             "detail": {"devices": p,
                        "platform": devs[0].platform,
                        "interpret": bool(interpret),
-                       "iters": iters}}
+                       "iters": iters,
+                       "mesh": mesh_col}}
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -160,6 +200,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--np", type=int, default=8,
                     help="virtual mesh width on a CPU host")
+    ap.add_argument("--mesh", default="",
+                    help="2-D grid spec PXxPY over the same devices "
+                         "(e.g. 2x4): adds the multi-axis RS/AG band "
+                         "dev_allreduce_mesh_effbw and stamps the "
+                         "artifact's mesh column")
     ap.add_argument("--out", default="",
                     help="artifact path (default: stdout)")
     args = ap.parse_args(argv)
@@ -169,7 +214,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     sizes = ([int(s) for s in args.sizes.split(",")] if args.sizes else
              ([1 << 20, 4 << 20, 16 << 20, 64 << 20] if on_tpu
               else [4096, 16384, 65536]))
-    art = sweep(sizes, iters=args.iters)
+    art = sweep(sizes, iters=args.iters,
+                mesh_shape=_parse_mesh(args.mesh))
     text = json.dumps(art, indent=1, sort_keys=True)
     if args.out:
         with open(args.out, "w") as f:

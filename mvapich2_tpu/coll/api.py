@@ -175,6 +175,12 @@ def barrier(comm) -> None:
             from . import flatcoll
             if flatcoll.try_barrier(pch, comm):
                 return
+            from . import netcoll
+            if netcoll.net2_applicable(comm):
+                # past the flat2 rank ceiling: the node-leader bridge
+                # (group barrier -> leader barrier -> release bcast)
+                netcoll.barrier_net2(comm, _plane_coll_tag(pch, comm))
+                return
             alg.barrier_dissemination(comm, _plane_coll_tag(pch, comm))
         return
     tag = comm.next_coll_tag()
@@ -203,7 +209,10 @@ def bcast(comm, buf, count: int, datatype: Optional[Datatype],
             if comm.rank != root or not datatype.is_contiguous:
                 datatype.unpack(data, buf, count)
             return
-        fn, tag = alg.bcast_binomial, _plane_coll_tag(pch, comm)
+        from . import netcoll
+        fn = netcoll.bcast_net2 if netcoll.net2_applicable(comm) \
+            else alg.bcast_binomial
+        tag = _plane_coll_tag(pch, comm)
     else:
         tag = comm.next_coll_tag()
         fn = _select(comm, "bcast", nbytes)
@@ -256,8 +265,10 @@ def allreduce(comm, sendbuf, recvbuf, count: int,
             if got is not None:
                 _unpack(got, recvbuf, count, datatype)
                 return
-        fn, tag = alg.allreduce_recursive_doubling, \
-            _plane_coll_tag(pch, comm)
+        from . import netcoll
+        fn = netcoll.allreduce_net2 if netcoll.net2_applicable(comm) \
+            else alg.allreduce_recursive_doubling
+        tag = _plane_coll_tag(pch, comm)
     else:
         tag = comm.next_coll_tag()
         fn = _select(comm, "allreduce", arr.nbytes, op=op)

@@ -26,7 +26,7 @@ is a no-op, logged).
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -222,9 +222,27 @@ class _VDeposit:
 class DeviceCollChannel:
     """One rank's handle on the mesh-bound collective engine."""
 
-    def __init__(self, mesh, axis: str, rendezvous: _Rendezvous, rank: int):
+    # hierarchy levels one call on this channel exercises — the
+    # coll_level_* pvars bumped per call in _run (three-level contract:
+    # chip = HBM slot fold, ici = mesh ring phases, net = node leaders)
+    LEVELS: Tuple[str, ...] = ("ici",)
+    # collectives this channel routes to the device tier; the rest keep
+    # their host entries at install time
+    SUPPORTED: Tuple[str, ...] = ("allreduce", "reduce", "bcast",
+                                  "allgather", "alltoall",
+                                  "reduce_scatter_block", "alltoallv")
+
+    def __init__(self, mesh, axis, rendezvous: _Rendezvous, rank: int):
         self.mesh = mesh
-        self.axis = axis
+        # ``axis``: one mesh axis name, or an ordered tuple of names —
+        # then ranks span the product extent row-major and the programs
+        # lower through the multi-axis torus decomposition
+        # (ops/pallas_ici.ici_*_mesh, ISSUE 20)
+        if isinstance(axis, (tuple, list)):
+            self.axes: Tuple[str, ...] = tuple(str(a) for a in axis)
+        else:
+            self.axes = (str(axis),)
+        self.axis = self.axes[0]
         self.rv = rendezvous
         self.rank = rank
         devices = list(np.asarray(mesh.devices).reshape(-1))
@@ -235,6 +253,25 @@ class DeviceCollChannel:
         # freed channels + their compiled executables for process life)
         self._programs: Dict = {}
         self._nb_seq = 0     # per-rank nonblocking-collective sequence
+
+    @property
+    def multi_axis(self) -> bool:
+        return len(self.axes) > 1
+
+    def _axis_sizes(self) -> Tuple[Tuple[str, int], ...]:
+        return tuple((a, self.mesh.shape[a]) for a in self.axes)
+
+    def _pspec0(self):
+        """The leading PartitionSpec entry covering this channel's
+        ranks: the bare axis name (1-D, the classic binding) or the
+        ordered axes tuple (row-major flattened rank order)."""
+        return self.axes if self.multi_axis else self.axis
+
+    def _mesh_extent(self) -> int:
+        """Participant count of the mesh program: the comm size on the
+        1:1 binding, the chip count on the fold channel (where each
+        mesh shard carries a whole chip's folded contribution)."""
+        return self.size
 
     def abort(self) -> None:
         self.rv.abort()
@@ -252,7 +289,13 @@ class DeviceCollChannel:
     def _chan_desc(self) -> str:
         """The mesh half of the executable-cache key: channel flavor,
         extent and platform (two geometries must never share an
-        artifact)."""
+        artifact). Multi-axis channels key on every (axis, extent)
+        pair — a 2x4 and a 4x2 mesh must never share an artifact
+        either."""
+        if self.multi_axis:
+            shape = "x".join(f"{a}{s}" for a, s in self._axis_sizes())
+            return (f"mesh{self.size}x{self.device.platform}"
+                    f"@{shape}")
         return (f"mesh{self.size}x{self.device.platform}"
                 f"@{self.axis}")
 
@@ -279,12 +322,14 @@ class DeviceCollChannel:
         return _ExportingProgram(self._build(name, n, op, root, extra), ck)
 
     def _build(self, name: str, n: int, op: str, root: int, extra=None):
+        if self.multi_axis:
+            return self._build_mesh(name, n, op, root, extra)
         import jax
         from jax.sharding import PartitionSpec as P
 
         from .. import ops
         from ..parallel.mesh import shard_map
-        axis, p = self.axis, self.size
+        axis, p = self.axis, self._mesh_extent()
 
         if name in ("allreduce", "reduce"):
             def f(x):                       # block [1, n]
@@ -343,6 +388,114 @@ class DeviceCollChannel:
             raise KeyError(name)
 
         sm = shard_map(f, mesh=self.mesh, in_specs=(P(axis, None),),
+                       out_specs=out_specs, check_vma=False)
+        return jax.jit(sm)
+
+    def _flat_rank(self):
+        """Traced flattened rank over this channel's axes (row-major) —
+        the SPMD analog of ``self.rank`` inside a mesh program."""
+        from jax import lax
+        idx = lax.axis_index(self.axes[0])
+        for a in self.axes[1:]:
+            idx = idx * self.mesh.shape[a] + lax.axis_index(a)
+        return idx
+
+    def _build_mesh(self, name: str, n: int, op: str, root: int,
+                    extra=None):
+        """Multi-axis programs: reductions ride the per-axis RS/AG torus
+        decomposition (ici_*_mesh), bcast composes per-axis phases from
+        the root's coordinates innermost-first, and the structural
+        collectives (alltoall(v)) lower through XLA over the flattened
+        axes tuple — the per-axis pairwise streamer is 1-D-addressed
+        (the kernel half is future hardware work, ROADMAP item 2)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from .. import ops
+        from ..parallel.mesh import shard_map
+        axes, p = self.axes, self._mesh_extent()
+        sizes = self._axis_sizes()
+        spec0 = self._pspec0()
+
+        if name in ("allreduce", "reduce"):
+            def f(x):                       # block [1, n]
+                from ..ops import pallas_ici
+                return pallas_ici.ici_all_reduce_mesh(
+                    x.reshape(-1), sizes, op=op).reshape(1, -1)
+            out_specs = P(None, None)       # replicated [1, n]
+        elif name == "bcast":
+            # root's per-axis coordinates, innermost phase first: after
+            # axis k's bcast the root's whole k-line carries the payload
+            coords, r = [], root
+            for a in reversed(axes):
+                coords.append(r % self.mesh.shape[a])
+                r //= self.mesh.shape[a]
+            coords.reverse()
+
+            def f(x):
+                for a, c in reversed(tuple(zip(axes, coords))):
+                    x = ops.bcast(x, a, c)
+                return x
+            out_specs = P(None, None)
+        elif name == "allgather":
+            def f(x):
+                from ..ops import pallas_ici
+                return pallas_ici.ici_all_gather_mesh(
+                    x.reshape(-1), sizes).reshape(p, -1)
+            out_specs = P(None, None)       # replicated [p, n]
+        elif name == "alltoall":
+            c = n // p
+
+            def f(x):                       # block [1, n] -> [p, c]
+                y = lax.all_to_all(x.reshape(p, c), axes, split_axis=0,
+                                   concat_axis=0, tiled=False)
+                return y.reshape(p, c)
+            out_specs = P(spec0, None)      # global [p*p, c]
+        elif name == "alltoallv":
+            counts = extra                  # static p x p matrix
+            from ..ops.pallas_alltoall import packed_displs
+            sdisp, rdisp, in_len, out_len = packed_displs(counts)
+
+            def f(x):                       # block [1, in_len] -> [1, out]
+                # gather every rank's packed payload, then assemble ALL
+                # receive rows statically (counts are static) and keep
+                # this rank's — O(p) memory, but structurally correct on
+                # any torus shape
+                g = x.reshape(1, in_len)
+                for a in reversed(axes):
+                    g = lax.all_gather(g, a, tiled=True, axis=0)
+                rows = []
+                for dst in range(p):
+                    parts = [lax.slice_in_dim(
+                                g[src], sdisp[src][dst],
+                                sdisp[src][dst] + counts[src][dst])
+                             for src in range(p) if counts[src][dst]]
+                    row = (jnp.concatenate(parts) if parts
+                           else g[0][:0])
+                    pad = out_len - row.shape[0]
+                    if pad > 0:
+                        row = jnp.pad(row, (0, pad))
+                    rows.append(row)
+                me = self._flat_rank()
+                return lax.dynamic_index_in_dim(
+                    jnp.stack(rows), me, axis=0,
+                    keepdims=True).reshape(1, -1)
+            out_specs = P(spec0, None)      # global [p, out_len]
+        elif name == "reduce_scatter_block":
+            c = n // p
+
+            def f(x):
+                from ..ops import pallas_ici
+                y = pallas_ici.ici_reduce_scatter_mesh(
+                    x.reshape(n), sizes, op=op)
+                return y.reshape(1, c)
+            out_specs = P(spec0, None)      # global [p, c]
+        else:  # pragma: no cover
+            raise KeyError(name)
+
+        sm = shard_map(f, mesh=self.mesh, in_specs=(P(spec0, None),),
                        out_specs=out_specs, check_vma=False)
         return jax.jit(sm)
 
@@ -416,7 +569,7 @@ class DeviceCollChannel:
         from jax.sharding import NamedSharding, PartitionSpec as P
         global_arr = jax.make_array_from_single_device_arrays(
             (self.size, n),
-            NamedSharding(self.mesh, P(self.axis, None)), shards)
+            NamedSharding(self.mesh, P(self._pspec0(), None)), shards)
         out = self._program(name, n, str(dtype), op, root)(global_arr)
         per_dev: Dict = {}
         for s in out.addressable_shards:
@@ -460,7 +613,7 @@ class DeviceCollChannel:
         shards = self._v_shards(rv.slots, in_len, dtype)
         global_arr = jax.make_array_from_single_device_arrays(
             (self.size, in_len),
-            NamedSharding(self.mesh, P(self.axis, None)), shards)
+            NamedSharding(self.mesh, P(self._pspec0(), None)), shards)
         out = self._program("alltoallv", in_len, str(dtype), "none", 0,
                             counts)(global_arr)
         per_dev: Dict = {}
@@ -501,7 +654,7 @@ class DeviceCollChannel:
         if name not in ("allreduce", "reduce", "allgather"):
             return "xla"    # ops without a ring-kernel lowering
         tier, reason = pallas_ici.planned_tier(name, nbytes, dtype, op,
-                                               num_devices=self.size)
+                                               num_devices=self._mesh_extent())
         if reason is None:
             mpit.pvar(f"dev_coll_tier_{tier}").inc()
             if tier == "quant":
@@ -509,7 +662,7 @@ class DeviceCollChannel:
                 # off the ICI wire by this call, per rank
                 from ..ops import pallas_quant
                 exact_b, wire_b = pallas_quant.wire_stats(
-                    n, dtype, self.size)
+                    n, dtype, self._mesh_extent())
                 mpit.pvar("dev_coll_quant_bytes_saved").inc(
                     max(0, exact_b - wire_b))
             return tier
@@ -532,6 +685,9 @@ class DeviceCollChannel:
 
         tier = self._note_tier(comm, name, local,
                                op if name != "bcast" else None)
+        from .. import mpit
+        for lv in self.LEVELS:   # which hierarchy levels this call rides
+            mpit.pvar(f"coll_level_{lv}").inc()
         n, dtype = self._slot_extent(local)
         nbytes = int(n * dtype.itemsize)
         tr = getattr(comm.u.engine, "tracer", None)
@@ -847,7 +1003,7 @@ class DeviceCollChannel:
             shards = self._v_shards(rec["slots"], in_len, dtype)
             global_arr = jax.make_array_from_single_device_arrays(
                 (self.size, in_len),
-                NamedSharding(self.mesh, P(self.axis, None)), shards)
+                NamedSharding(self.mesh, P(self._pspec0(), None)), shards)
             return self._program("alltoallv", in_len, str(dtype), "none",
                                  0, counts)(global_arr)
         if rec["shards"] is None:
@@ -867,7 +1023,7 @@ class DeviceCollChannel:
             [s[:, off:off + ln] for s in shards]
         global_arr = jax.make_array_from_single_device_arrays(
             (self.size, ln),
-            NamedSharding(self.mesh, P(self.axis, None)), seg)
+            NamedSharding(self.mesh, P(self._pspec0(), None)), seg)
         return self._program(name, ln, str(dtype), op, root)(global_arr)
 
     def _nb_finish(self, name: str, seq: int, recvbuf, rcounts,
@@ -931,6 +1087,10 @@ class HBMSlotChannel(DeviceCollChannel):
     Used when more ranks than devices are bound (the mpirun-on-one-chip
     model); the 1:1 mesh binding uses DeviceCollChannel above.
     """
+
+    LEVELS = ("chip",)
+    SUPPORTED = ("allreduce", "reduce", "bcast", "allgather", "alltoall",
+                 "reduce_scatter_block")
 
     def __init__(self, device, rendezvous: _Rendezvous, rank: int,
                  size: int):
@@ -1038,6 +1198,190 @@ class HBMSlotChannel(DeviceCollChannel):
             return [out[r * c:(r + 1) * c] for r in range(R)]
         # the zero-copy share: every rank gets the same array
         return [out] * R
+
+
+class DeviceFoldChannel(DeviceCollChannel):
+    """Leaders-per-chip fold: more ranks than devices, but more than one
+    device — the middle binding between the 1:1 mesh channel and the
+    single-device slot channel (the two-level shmem/leader split of
+    create_2level_comm.c, with the chip standing in for the node).
+
+    ``n`` ranks over ``ndev`` devices, ``k = n // ndev`` ranks per chip,
+    rank ``r`` on chip ``r // k`` (blocked, so a chip's ranks own
+    contiguous result blocks). Each collective runs in two levels:
+
+      * **chip fold** — every chip's ``k`` deposited slots are staged as
+        one planar ``(k, n)`` array on that chip and folded in HBM (the
+        fused slot-reduce kernel for sum, the XLA reduction otherwise;
+        concatenation for allgather), exactly the slot channel's move
+        applied per chip;
+      * **ICI phase** — the ``ndev`` folded shards form one mesh-sharded
+        global array and ride the ordinary mesh program (ring RS/AG
+        tiers, per-axis torus phases when the mesh is multi-axis), built
+        over the CHIP count (``_mesh_extent``).
+
+    Results fan back zero-copy per chip: every rank on a chip shares its
+    chip's output shard (slices of it for reduce_scatter_block).
+    alltoall(v) has no fold composition (per-peer payloads cross chips
+    pairwise) and keeps the host path; nonblocking calls take the host
+    schedule (counted dev_coll_fallback_nbc).
+    """
+
+    LEVELS = ("chip", "ici")
+    SUPPORTED = ("allreduce", "reduce", "bcast", "allgather",
+                 "reduce_scatter_block")
+
+    def __init__(self, mesh, axis, rendezvous: _Rendezvous, rank: int,
+                 nranks: int):
+        self.mesh = mesh
+        if isinstance(axis, (tuple, list)):
+            self.axes: Tuple[str, ...] = tuple(str(a) for a in axis)
+        else:
+            self.axes = (str(axis),)
+        self.axis = self.axes[0]
+        self.rv = rendezvous
+        self.rank = rank
+        mesh_devs = list(np.asarray(mesh.devices).reshape(-1))
+        self.ndev = len(mesh_devs)
+        self.k = nranks // self.ndev
+        self.size = nranks
+        self.chip = rank // self.k
+        self.device = mesh_devs[self.chip]
+        # rank -> its chip's device (the _leader/_deliver contract)
+        self.devices = [mesh_devs[r // self.k] for r in range(nranks)]
+        self._mesh_devices = mesh_devs
+        self._programs: Dict = {}
+        self._nb_seq = 0
+        # shared via the rendezvous, like the slot channel: Mosaic
+        # rejecting the fused fold kernel demotes every chip's fold to
+        # the XLA reduction for the life of the binding
+        self.rv.no_pallas = getattr(self.rv, "no_pallas", False)
+
+    def _mesh_extent(self) -> int:
+        return self.ndev
+
+    def _chan_desc(self) -> str:
+        return f"fold{self.size}r{self.ndev}d_{super()._chan_desc()}"
+
+    def nonblocking(self, comm, name: str, *a, plan: bool = False):
+        return None     # host NBC schedule (fold has no DAG segments yet)
+
+    def _use_pallas(self, op: str) -> bool:
+        from ..ops import pallas_hbm as ph
+        return op == "sum" and ph.HAVE_PALLAS and not self.rv.no_pallas
+
+    def _fold_prog(self, op: str):
+        """Per-chip fold program: the HBM fused slot-reduce when it
+        lowers, the XLA reduction otherwise (cached like any program)."""
+        key = ("chipfold", 0, "", op, 0, None)
+        got = self._programs.get(key)
+        if got is None:
+            import jax
+            import jax.numpy as jnp
+
+            from ..ops import pallas_hbm as ph
+            red = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min,
+                   "prod": jnp.prod}[op or "sum"]
+            if self._use_pallas(op):
+                def f(x):
+                    return ph.hbm_slot_allreduce(x)
+            else:
+                def f(x):
+                    return red(x, axis=0)
+            got = self._programs[key] = jax.jit(f)
+        return got
+
+    def _chip_stack(self, j: int, n: int, dtype):
+        """Chip ``j``'s k deposited slots as one planar (k, n) array on
+        its device (device-resident slots stack in place)."""
+        import jax
+        import jax.numpy as jnp
+        sl = self.rv.slots[j * self.k:(j + 1) * self.k]
+        dev = self._mesh_devices[j]
+        if all(is_device_array(s) and s.devices() == {dev} for s in sl):
+            return jnp.stack([s.reshape(n) for s in sl])
+        return jax.device_put(
+            np.stack([np.asarray(s).reshape(n) for s in sl]), dev)
+
+    def _fold_chip(self, j: int, n: int, dtype, op: str):
+        """Fold chip ``j``'s slots to one [n] contribution (level 1)."""
+        import jax
+        if self.k == 1:
+            s = self.rv.slots[j]
+            if is_device_array(s) and \
+                    s.devices() == {self._mesh_devices[j]}:
+                return s.reshape(n)
+            return jax.device_put(np.asarray(s).reshape(n),
+                                  self._mesh_devices[j])
+        x = self._chip_stack(j, n, dtype)
+        try:
+            return self._fold_prog(op)(x)
+        except Exception:
+            if not self._use_pallas(op):
+                raise
+            log.warn("pallas chip-fold kernel failed; falling back to "
+                     "the XLA reduction path")
+            self.rv.no_pallas = True
+            self._programs.pop(("chipfold", 0, "", op, 0, None), None)
+            return self._fold_prog(op)(x)
+
+    def _leader(self, name: str, op: str, root: int) -> List:
+        """Leader compute: fold per chip, run the mesh program over the
+        folded shards, fan the chip outputs back to their ranks."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rv = self.rv
+        nd, k = self.ndev, self.k
+        n, dtype = self._slot_extent(rv.slots[0])
+        shards, prog_root, prog_n = [], 0, n
+        if name == "bcast":
+            # only the root chip's shard matters: stage the root rank's
+            # payload there, zero-fill the rest (the mesh bcast program
+            # overwrites them)
+            prog_root = root // k
+            for j in range(nd):
+                if j == prog_root:
+                    s = rv.slots[root]
+                    s = (s.reshape(1, n) if is_device_array(s)
+                         and s.devices() == {self._mesh_devices[j]}
+                         else jax.device_put(
+                             np.asarray(s).reshape(1, n),
+                             self._mesh_devices[j]))
+                else:
+                    s = jax.device_put(np.zeros((1, n), dtype),
+                                       self._mesh_devices[j])
+                shards.append(s)
+        elif name == "allgather":
+            # chip fold is CONCATENATION: blocked rank->chip mapping
+            # makes the stacked chip payload already rank-ordered
+            prog_n = k * n
+            for j in range(nd):
+                shards.append(self._chip_stack(j, n, dtype)
+                              .reshape(1, prog_n))
+        else:   # allreduce / reduce / reduce_scatter_block
+            for j in range(nd):
+                shards.append(self._fold_chip(j, n, dtype, op)
+                              .reshape(1, n))
+        global_arr = jax.make_array_from_single_device_arrays(
+            (nd, prog_n),
+            NamedSharding(self.mesh, P(self._pspec0(), None)), shards)
+        out = self._program(name, prog_n, str(dtype), op, prog_root)(
+            global_arr)
+        per_dev: Dict = {}
+        for s in out.addressable_shards:
+            per_dev[s.device] = s.data
+        if name == "reduce_scatter_block":
+            # chip shard = its k ranks' contiguous blocks: slice per rank
+            c = (n // nd) // k
+            res = []
+            for r in range(self.size):
+                blk = per_dev[self.devices[r]].reshape(-1)
+                s = r % k
+                res.append(blk[s * c:(s + 1) * c])
+            return res
+        # zero-copy share per chip: every rank gets its chip's shard
+        return [per_dev[self.devices[r]] for r in range(self.size)]
 
 
 def _dense_displs(counts) -> List[int]:
@@ -1231,6 +1575,8 @@ def install_device_coll(comm, channel: DeviceCollChannel) -> None:
         return entry
 
     for name in meta:
+        if name not in channel.SUPPORTED:
+            continue    # e.g. alltoall on the fold channel: host path
         comm.coll_fns[name] = wrap(name)
 
     # alltoallv: its own wrapper — the signature puts recvbuf at a[3]
@@ -1238,7 +1584,8 @@ def install_device_coll(comm, channel: DeviceCollChannel) -> None:
     # total. Device tier needs the mesh channel (the slot channel keeps
     # its host path: per-peer variable counts have no slot-transpose).
     host_a2av = host.get("alltoallv")
-    if host_a2av is not None and channel.mesh is not None:
+    if host_a2av is not None and channel.mesh is not None \
+            and "alltoallv" in channel.SUPPORTED:
         def a2av_entry(comm_, sendbuf, scounts, sdispls, recvbuf,
                        rcounts, rdispls, datatype):
             buf = sendbuf
@@ -1305,48 +1652,75 @@ def prewarm_persistent(comm, name: str, *a) -> bool:
 # binding helpers (harness / launcher entry points)
 # ---------------------------------------------------------------------------
 
-def bind_universes(universes, mesh=None, axis: Optional[str] = None) -> bool:
+def bind_universes(universes, mesh=None, axis=None) -> bool:
     """Bind each thread-rank universe's COMM_WORLD to the device mesh —
     called by the in-process harness (run_ranks(device_mesh=...)) and the
     --vpod launcher before rank threads start. Returns False (no-op) when
-    the mesh cannot cover the ranks. ``axis`` defaults to the mesh's first
-    axis name (ranks lay out over the flattened device order)."""
+    the mesh cannot cover the ranks.
+
+    ``axis`` defaults to the mesh's axis names (ALL of them — a
+    multi-axis mesh binds the multi-axis torus channel with ranks
+    row-major over the flattened device order); pass one name or an
+    ordered tuple to span a subset. Geometry selects the channel:
+
+      * ``#devices == n``  -> DeviceCollChannel (1:1, single- or
+        multi-axis mesh programs)
+      * ``1 < #devices < n`` with ``n % #devices == 0``
+                           -> DeviceFoldChannel (leaders-per-chip
+        HBM fold, then the mesh program over chips)
+      * one device         -> HBMSlotChannel (slot segment)
+    """
     import jax
 
     n = len(universes)
     slot_device = None
+    fold = False
     if mesh is None:
         from ..parallel.mesh import make_mesh
         devs = jax.devices()
-        if len(devs) < n:
-            # more ranks than devices: co-residence — the HBM
-            # slot-segment channel on the first device (mpirun on one
-            # chip; the shm-collective analog)
+        if len(devs) >= n:
+            if isinstance(axis, (tuple, list)) and len(axis) > 1:
+                # multi-axis request: near-square factorization of the
+                # n ranks over the named axes (mesh_shape_for)
+                mesh = make_mesh(None, tuple(axis), devs[:n])
+            else:
+                one = axis[0] if isinstance(axis, (tuple, list)) else axis
+                mesh = make_mesh((n,), (one or "x",), devs[:n])
+        elif len(devs) > 1 and n % len(devs) == 0:
+            # more ranks than devices, evenly: the two-level fold —
+            # ranks co-resident on a chip fold in HBM, chips ride ICI
+            fold = True
+            mesh = make_mesh((len(devs),), ("x",), devs)
+            log.info("%d ranks over %d devices; binding the "
+                     "leaders-per-chip fold channel (%d ranks/chip)",
+                     n, len(devs), n // len(devs))
+        else:
+            # indivisible co-residence: the HBM slot-segment channel on
+            # the first device (mpirun on one chip; the shm analog)
             slot_device = devs[0]
             log.info("%d ranks > %d devices; binding the HBM "
                      "slot-segment channel on %s", n, len(devs),
                      slot_device)
-        else:
-            mesh = make_mesh((n,), (axis or "x",), devs[:n])
     if mesh is not None and slot_device is None:
         if axis is None:
-            axis = mesh.axis_names[0]
-        if len(mesh.axis_names) > 1:
-            log.warn("mesh %s has %d axes; the MPI binding needs a 1-D "
-                     "mesh; host path only", dict(mesh.shape),
-                     len(mesh.axis_names))
-            return False
+            names = tuple(mesh.axis_names)
+            axis = names[0] if len(names) == 1 else names
         msize = int(np.prod(list(mesh.shape.values())))
         if msize == 1 and n > 1:
             slot_device = list(np.asarray(mesh.devices).reshape(-1))[0]
-        elif msize != n:
-            log.warn("mesh shape %s does not match %d ranks; host path "
-                     "only", dict(mesh.shape), n)
-            return False
+        elif not fold and msize != n:
+            if 1 < msize < n and n % msize == 0:
+                fold = True
+            else:
+                log.warn("mesh shape %s does not match %d ranks; host "
+                         "path only", dict(mesh.shape), n)
+                return False
     rv = _Rendezvous(n)
     for r, u in enumerate(universes):
         if slot_device is not None:
             ch = HBMSlotChannel(slot_device, rv, r, n)
+        elif fold:
+            ch = DeviceFoldChannel(mesh, axis, rv, r, n)
         else:
             ch = DeviceCollChannel(mesh, axis, rv, r)
         install_device_coll(u.comm_world, ch)

@@ -70,6 +70,24 @@ cvar("DEV_RMA_RDMA_MIN", 0, int, "device",
      "(-1 = never — everything keeps the epoch tier). Measured "
      "profiles (device_crossovers.dev_rma_rdma_min) override; every "
      "epoch take is counted by the dev_rma_fallback_* pvars.")
+cvar("DEV_TIER_AXES_MIN", 4096, int, "device",
+     "Device-collective mesh edge: on a multi-axis torus mesh, shards "
+     "at or above this many bytes decompose allreduce into per-axis "
+     "reduce-scatter/all-gather ring phases (each element crosses each "
+     "axis' ICI links once); below it each axis runs a full allreduce "
+     "in sequence (half the kernel launches — the latency shape). "
+     "-1 = always decompose. Measured profiles "
+     "(device_crossovers.dev_tier_axes_min) override.")
+cvar("NET2", 1, int, "coll",
+     "Three-level network tier kill switch: comms past the np=64 flat2 "
+     "ceiling compose node-local waves under round-robin leader groups "
+     "with an inter-leader exchange (0 disables; the sched table rows "
+     "of the net2 comm-size class take over). Must be launcher-uniform "
+     "— every member must reach the same dispatch verdict.")
+cvar("NET2_MAX_RANKS", 256, int, "coll",
+     "np ceiling of the net2 leader-bridge tier (and of the net2 "
+     "comm-size class): above it comms fall to the generic large-class "
+     "sched rows. Clamped to [65, 4096].")
 cvar("DEV_RMA_QUANT_MIN", 1024 * 1024, int, "device",
      "One-sided tier edge: with an MV2T_QUANT_COLL accuracy budget "
      "set, f32 sum accumulates at or above this many bytes carry the "
@@ -119,6 +137,13 @@ ALGOS["allreduce"]["two_level_slotted"] = allreduce_two_level_slotted
 ALGOS["allreduce"]["rsa_arena"] = allreduce_rsa_arena
 ALGOS["bcast"]["arena"] = bcast_arena
 
+from .netcoll import (allreduce_net2, barrier_net2,  # noqa: E402
+                      bcast_net2)
+
+ALGOS["allreduce"]["net2"] = allreduce_net2
+ALGOS["bcast"]["net2"] = bcast_net2
+ALGOS["barrier"]["net2"] = barrier_net2
+
 # ---------------------------------------------------------------------------
 # default tables: rows of (msg-size upper bound, algo name); the last row's
 # bound is None (infinity). Mirrors the shape of e.g. allreduce_tuning.h:38-90
@@ -144,39 +169,57 @@ DEFAULT_TABLES: Dict[str, Dict[str, Table]] = {
     # r8 bench host (oversubscribed 1-core): rd's log-depth chain wins
     # the sub-8 KiB band, the reduce-scatter shapes win the middle,
     # the arena tier everything above the eager size.
+    # "net2" is the leader-bridge comm-size band (64 < np <=
+    # MV2T_NET2_MAX_RANKS): the net2 algorithm composes node-local
+    # flat2 waves under round-robin leader groups with an inter-leader
+    # exchange (coll/netcoll.py); its small-message band is where the
+    # leaders-of-k fold wins. The remaining rows are the explicit SCHED
+    # FALLBACK for calls the tier does not carry (tier disabled, comm
+    # not plane-owned, payload past the eager band) — before this class
+    # existed, np>64 comms fell through to the generic large rows
+    # silently. The net2 algorithms degrade to these sched shapes
+    # internally when their gates fail, so the verdict stays uniform.
     "allreduce": {
         "small": [(16 * 1024, "rd"), ("eager", "ring"),
                   (None, "rsa_arena")],
         "flat2": [(8 * 1024, "rd"), ("eager", "rsa"),
                   (None, "rsa_arena")],
+        "net2": [(8 * 1024, "net2"), ("eager", "rsa"),
+                 (None, "rsa_arena")],
         "large": [(8 * 1024, "rd"), ("eager", "rsa"),
                   (None, "rsa_arena")],
     },
     "bcast": {
         "small": [(64 * 1024, "binomial"), (None, "arena")],
         "flat2": [(16 * 1024, "binomial"), (None, "arena")],
+        "net2": [(16 * 1024, "net2"), (None, "arena")],
         "large": [(16 * 1024, "binomial"), (None, "arena")],
     },
     "allgather": {
         "small": [(32 * 1024, "bruck"), (None, "ring")],
         "flat2": [(8 * 1024, "bruck"), (None, "ring")],
+        "net2": [(8 * 1024, "bruck"), (None, "ring")],
         "large": [(8 * 1024, "bruck"), (None, "ring")],
     },
     "alltoall": {
         "small": [(4 * 1024, "bruck"), (None, "scattered")],
         "flat2": [(1024, "bruck"), (64 * 1024, "scattered"),
                   (None, "pairwise")],
+        "net2": [(1024, "bruck"), (64 * 1024, "scattered"),
+                 (None, "pairwise")],
         "large": [(1024, "bruck"), (64 * 1024, "scattered"),
                   (None, "pairwise")],
     },
     "reduce": {
         "small": [(None, "binomial")],
         "flat2": [(None, "binomial")],
+        "net2": [(None, "binomial")],
         "large": [(None, "binomial")],
     },
     "barrier": {
         "small": [(None, "dissemination")],
         "flat2": [(None, "dissemination")],
+        "net2": [(None, "net2")],
         "large": [(None, "dissemination")],
     },
 }
@@ -300,13 +343,24 @@ def device_tier(name: str, shard_nbytes: int) -> str:
     return "hbm"
 
 
+def net2_max_ranks() -> int:
+    """np ceiling of the net2 class/tier (cvar, clamped): the leader-
+    bridge geometry caps at ngroups x 64-rank flat2 windows."""
+    return max(65, min(4096, int(get_config()["NET2_MAX_RANKS"])))
+
+
 def _size_class(comm) -> str:
     """small (flat-tier window) / flat2 (hierarchical-tier window) /
-    large. The 8 and 64 edges mirror MV2T_FLAT_NSLOTS and
-    MV2T_FLAT2_MAX_RANKS — the np bands the two shm tiers serve."""
+    net2 (leader-bridge window past the single-node ceiling) / large.
+    The 8 and 64 edges mirror MV2T_FLAT_NSLOTS and
+    MV2T_FLAT2_MAX_RANKS — the np bands the two shm tiers serve; the
+    net2 edge is MV2T_NET2_MAX_RANKS. Before the net2 class, np>64
+    comms silently fell through to the generic large-class rows."""
     if comm.size <= 8:
         return "small"
-    return "flat2" if comm.size <= 64 else "large"
+    if comm.size <= 64:
+        return "flat2"
+    return "net2" if comm.size <= net2_max_ranks() else "large"
 
 
 def _resolve_edge(bound):
@@ -327,6 +381,8 @@ def _resolve_edge(bound):
         return _dev_tier_edge("DEV_TIER_XLA_MIN", "dev_tier_xla_min")
     if bound == "dev_tier_quant_min":
         return _dev_tier_edge("DEV_TIER_QUANT_MIN", "dev_tier_quant_min")
+    if bound == "dev_tier_axes_min":
+        return _dev_tier_edge("DEV_TIER_AXES_MIN", "dev_tier_axes_min")
     if bound == "dev_rma_rdma_min":
         return _dev_tier_edge("DEV_RMA_RDMA_MIN", "dev_rma_rdma_min")
     if bound == "dev_rma_quant_min":

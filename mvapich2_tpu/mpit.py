@@ -444,6 +444,19 @@ pvar("dev_coll_fallback_nbc", PVAR_CLASS_COUNTER, "device",
      "slot channel) and took the host schedule instead — the NBC "
      "analog of the dev_coll_fallback_* family (coll/device.py "
      "build_nonblocking_request)")
+pvar("coll_level_chip", PVAR_CLASS_COUNTER, "device",
+     "collective calls that exercised the chip level of the three-"
+     "level hierarchy: an HBM slot fold among co-resident ranks (the "
+     "slot channel, or the fold stage of the leaders-per-chip channel "
+     "— coll/device.py _run LEVELS accounting)")
+pvar("coll_level_ici", PVAR_CLASS_COUNTER, "device",
+     "collective calls that exercised the ICI level: a mesh program "
+     "over the device ring/torus phases (the 1:1 mesh channel, or the "
+     "inter-chip stage of the fold channel)")
+pvar("coll_level_net", PVAR_CLASS_COUNTER, "device",
+     "collective calls that exercised the network level: the net2 "
+     "node-leader bridge over the KVS/TCP lanes past np=64 "
+     "(coll/netcoll.py)")
 pvar("dev_persistent_starts", PVAR_CLASS_COUNTER, "device",
      "persistent-collective start() dispatches that rode the device "
      "nonblocking tier (MPI_*_init handles whose cached program was "
@@ -589,6 +602,9 @@ for _h, _d in (
      "latency (coll/flatcoll.py try_* around the cp_flat2_* call)"),
     ("lat_coll_sched", "host scheduled-algorithm collective latency "
      "(coll/api.py dispatch around the pt2pt schedule)"),
+    ("lat_coll_net2", "net2 node-leader-tier collective latency "
+     "(coll/netcoll.py: group fold + leader bridge + fan-out, "
+     "end-to-end)"),
     ("lat_dev_vmem", "device collective latency on the VMEM flat ring "
      "tier (coll/device.py _run end-to-end)"),
     ("lat_dev_hbm", "device collective latency on the HBM-streaming "

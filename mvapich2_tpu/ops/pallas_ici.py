@@ -75,6 +75,7 @@ _SUPPORTED_OPS = ("sum", "max", "min", "prod")
 _CID_ALLREDUCE = 9
 _CID_ALLGATHER = 10
 _CID_SENDRECV = 11
+_CID_REDUCE_SCATTER = 19
 
 
 def _cfg_chunk_elems(dtype, chunk_bytes: Optional[int]) -> int:
@@ -137,9 +138,11 @@ class _RingStreamer:
 
     def __init__(self, p, ndir, depth, credits, left, right,
                  o_hbm, send_buf, recv_buf, acc_buf,
-                 in_sem, acc_sem, st_sem, send_sem, recv_sem, cap_sem):
+                 in_sem, acc_sem, st_sem, send_sem, recv_sem, cap_sem,
+                 dev_base=0, dev_stride=1):
         self.p, self.ndir, self.depth, self.credits = p, ndir, depth, credits
         self.left, self.right = left, right
+        self.dev_base, self.dev_stride = dev_base, dev_stride
         self.o_hbm = o_hbm
         self.send_buf, self.recv_buf, self.acc_buf = \
             send_buf, recv_buf, acc_buf
@@ -152,7 +155,12 @@ class _RingStreamer:
         self.pending_store: Dict = {}
 
     def _dev(self, idx):
-        return idx  # logical device id along the 1-D mesh axis
+        # logical device id of ring index ``idx``: the identity on a
+        # 1-D mesh; on a multi-axis torus the ring runs along ONE axis,
+        # so the id is this device's id with that axis' coordinate
+        # replaced (base = id with the coordinate zeroed, stride = the
+        # axis' row-major stride — see _dev_layout)
+        return self.dev_base + idx * self.dev_stride
 
     def grant_initial_credits(self):          # device: hw-only
         """Each direction starts with ``depth`` slot credits granted to
@@ -289,12 +297,35 @@ class _RingStreamer:
         self.drain_stores()
 
 
-def _mk_streamer(p, ndir, depth, credits, left, right, o_hbm, scratch):
+def _mk_streamer(p, ndir, depth, credits, left, right, o_hbm, scratch,
+                 mesh_ctx=None, axis_name=None):
     (send_buf, recv_buf, acc_buf, in_sem, acc_sem, st_sem, send_sem,
      recv_sem, cap_sem) = scratch
+    base, stride = _dev_layout(mesh_ctx, axis_name)
     return _RingStreamer(p, ndir, depth, credits, left, right, o_hbm,
                          send_buf, recv_buf, acc_buf, in_sem, acc_sem,
-                         st_sem, send_sem, recv_sem, cap_sem)
+                         st_sem, send_sem, recv_sem, cap_sem,
+                         dev_base=base, dev_stride=stride)
+
+
+def _dev_layout(mesh_ctx, axis_name):
+    """(base, stride) of the LOGICAL-device-id line a ring along
+    ``axis_name`` walks. ``mesh_ctx`` is the full ordered
+    (axis, size) tuple of the surrounding mesh (row-major device
+    layout, make_mesh's convention) or None for the classic 1-D case.
+    base folds in the traced coordinates of every OTHER axis, so it is
+    a traced scalar; stride is static."""
+    if not mesh_ctx or len(mesh_ctx) <= 1:
+        return 0, 1
+    stride, strides = 1, {}
+    for name, size in reversed(tuple(mesh_ctx)):
+        strides[name] = stride
+        stride *= int(size)
+    base = 0
+    for name, _ in mesh_ctx:
+        if name != axis_name:
+            base = base + lax.axis_index(name) * strides[name]
+    return base, strides[axis_name]
 
 
 def _scratch_shapes(ndir: int, depth: int, chunk: int, dtype):
@@ -326,14 +357,14 @@ def _block_spans(nblk: int, ndir: int) -> List[Tuple[int, int]]:
 
 
 def _hbm_all_reduce_kernel(axis_name, p, op, nblk, chunk, depth, ndir,
-                           credits, x_hbm, o_hbm, *scratch):
+                           credits, mesh_ctx, x_hbm, o_hbm, *scratch):
     my = lax.axis_index(axis_name)
     right = lax.rem(my + 1, p)
     left = lax.rem(my - 1 + p, p)
     red = _reducer(op)
     init_sem = scratch[-1]
     st = _mk_streamer(p, ndir, depth, credits, left, right, o_hbm,
-                      scratch[:-1])
+                      scratch[:-1], mesh_ctx, axis_name)
 
     cp = pltpu.make_async_copy(x_hbm, o_hbm, init_sem)
     cp.start()
@@ -365,14 +396,51 @@ def _hbm_all_reduce_kernel(axis_name, p, op, nblk, chunk, depth, ndir,
     st.finish()
 
 
+def _hbm_reduce_scatter_kernel(axis_name, p, op, nblk, chunk, depth,
+                               ndir, credits, mesh_ctx, x_hbm, w_hbm,
+                               o_hbm, *scratch):
+    """The reduce-scatter phase of the allreduce ring alone — the
+    per-axis primitive of the multi-axis mesh decomposition. Streams
+    the same p-1 fold rounds over the chunk-credit slot schedule into
+    the working buffer ``w_hbm``; after them block ``my`` is fully
+    reduced and lands in the [nblk] output."""
+    my = lax.axis_index(axis_name)
+    right = lax.rem(my + 1, p)
+    left = lax.rem(my - 1 + p, p)
+    red = _reducer(op)
+    init_sem = scratch[-1]
+    st = _mk_streamer(p, ndir, depth, credits, left, right, w_hbm,
+                      scratch[:-1], mesh_ctx, axis_name)
+
+    cp = pltpu.make_async_copy(x_hbm, w_hbm, init_sem)
+    cp.start()
+    cp.wait()
+    st.grant_initial_credits()
+
+    spans = _block_spans(nblk, ndir)
+    spans_chunks = [_chunks(lo, hi, chunk) for lo, hi in spans]
+    for s in range(p - 1):
+        sb = [lax.rem(my - s - 1 + 2 * p, p), lax.rem(my + s + 1, p)]
+        rb = [lax.rem(my - s - 2 + 2 * p, p), lax.rem(my + s + 2, p)]
+        st.stream_step(spans_chunks,
+                       [sb[d] * nblk for d in range(ndir)],
+                       [rb[d] * nblk for d in range(ndir)], red)
+    st.finish()
+
+    out = pltpu.make_async_copy(w_hbm.at[pl.ds(my * nblk, nblk)], o_hbm,
+                                init_sem)
+    out.start()
+    out.wait()
+
+
 def _hbm_all_gather_kernel(axis_name, p, nblk, chunk, depth, ndir,
-                           credits, x_hbm, o_hbm, *scratch):
+                           credits, mesh_ctx, x_hbm, o_hbm, *scratch):
     my = lax.axis_index(axis_name)
     right = lax.rem(my + 1, p)
     left = lax.rem(my - 1 + p, p)
     init_sem = scratch[-1]
     st = _mk_streamer(p, ndir, depth, credits, left, right, o_hbm,
-                      scratch[:-1])
+                      scratch[:-1], mesh_ctx, axis_name)
 
     # my shard lands in block ``my`` of the output
     cp = pltpu.make_async_copy(x_hbm, o_hbm.at[pl.ds(my * nblk, nblk)],
@@ -436,10 +504,13 @@ def hbm_ring_all_reduce(x: jax.Array, axis_name: str, num_devices: int,
                         depth: Optional[int] = None,
                         bidirectional: Optional[bool] = None,
                         credits: Optional[bool] = None,
-                        interpret=None) -> jax.Array:
+                        interpret=None, mesh_ctx=None) -> jax.Array:
     """Allreduce along ``axis_name`` via the chunked HBM-streaming ring
     (pipelined reduce-scatter + all-gather). Any shape/size: the shard
-    is flattened and padded to ``p`` blocks with the op identity."""
+    is flattened and padded to ``p`` blocks with the op identity.
+    ``mesh_ctx``: the surrounding mesh's full ordered (axis, size)
+    tuple when the ring is one phase of a multi-axis decomposition —
+    device ids walk that axis' row-major id line instead of 0..p-1."""
     p = num_devices
     if not HAVE_PALLAS or p == 1:
         from .collectives import allreduce
@@ -457,7 +528,7 @@ def hbm_ring_all_reduce(x: jax.Array, axis_name: str, num_devices: int,
     d = _cfg_depth(depth)
     ndir = _resolve_ndir(p, bidirectional)
     kernel = functools.partial(_hbm_all_reduce_kernel, axis_name, p, op,
-                               nblk, chunk, d, ndir, credits)
+                               nblk, chunk, d, ndir, credits, mesh_ctx)
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((n_pad,), x.dtype),
@@ -476,7 +547,7 @@ def hbm_ring_all_gather(x: jax.Array, axis_name: str, num_devices: int,
                         depth: Optional[int] = None,
                         bidirectional: Optional[bool] = None,
                         credits: Optional[bool] = None,
-                        interpret=None) -> jax.Array:
+                        interpret=None, mesh_ctx=None) -> jax.Array:
     """All-gather along ``axis_name`` via the chunked HBM-streaming
     ring. ``x``: this shard's block [m, ...]; returns [p*m, ...]
     (tiled, like lax.all_gather(tiled=True))."""
@@ -491,7 +562,7 @@ def hbm_ring_all_gather(x: jax.Array, axis_name: str, num_devices: int,
     d = _cfg_depth(depth)
     ndir = _resolve_ndir(p, bidirectional)
     kernel = functools.partial(_hbm_all_gather_kernel, axis_name, p, m,
-                               chunk, d, ndir, credits)
+                               chunk, d, ndir, credits, mesh_ctx)
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((p * m,), x.dtype),
@@ -504,6 +575,68 @@ def hbm_ring_all_gather(x: jax.Array, axis_name: str, num_devices: int,
     )(flat)
     return out.reshape((p * shape[0],) + shape[1:]) if shape \
         else out
+
+
+def hbm_ring_reduce_scatter(x: jax.Array, axis_name: str,
+                            num_devices: int, op: str = "sum", *,
+                            chunk_bytes: Optional[int] = None,
+                            depth: Optional[int] = None,
+                            bidirectional: Optional[bool] = None,
+                            credits: Optional[bool] = None,
+                            interpret=None, mesh_ctx=None) -> jax.Array:
+    """Reduce-scatter along ``axis_name`` via the chunked HBM-streaming
+    ring (the RS phase of the allreduce kernel alone). ``x``: this
+    shard's full contribution [n]; returns block ``my`` of the folded
+    array, [ceil(n/p)] (tiled; the tail blocks carry op-identity pad
+    when p does not divide n)."""
+    p = num_devices
+    if not HAVE_PALLAS or p == 1:
+        return _xla_reduce_scatter(x, axis_name, p, op)
+    interpret, credits = _resolve_flags(interpret, credits)
+    n = int(x.size)
+    flat = x.reshape(n)
+    nblk = -(-n // p)
+    n_pad = nblk * p
+    if n_pad > n:
+        flat = jnp.pad(flat, (0, n_pad - n),
+                       constant_values=_pad_identity(x.dtype, op))
+    chunk = min(_cfg_chunk_elems(x.dtype, chunk_bytes), nblk)
+    d = _cfg_depth(depth)
+    ndir = _resolve_ndir(p, bidirectional)
+    kernel = functools.partial(_hbm_reduce_scatter_kernel, axis_name, p,
+                               op, nblk, chunk, d, ndir, credits,
+                               mesh_ctx)
+    _, out = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((n_pad,), x.dtype),
+                   jax.ShapeDtypeStruct((nblk,), x.dtype)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.ANY),
+                   pl.BlockSpec(memory_space=pltpu.ANY)),
+        scratch_shapes=_scratch_shapes(ndir, d, chunk, x.dtype),
+        compiler_params=compiler_params(
+            collective_id=_CID_REDUCE_SCATTER, has_side_effects=True),
+        interpret=interpret,
+    )(flat)
+    return out
+
+
+def _xla_reduce_scatter(x: jax.Array, axis_name: str, p: int,
+                        op: str) -> jax.Array:
+    """The stock lowering of the tiled reduce-scatter: psum_scatter for
+    sum (the only op it lowers natively), allreduce + slice otherwise.
+    Input length must be a multiple of p (callers pad)."""
+    flat = x.reshape(-1)
+    if p == 1:
+        return flat
+    if op == "sum":
+        return lax.psum_scatter(flat, axis_name, scatter_dimension=0,
+                                tiled=True)
+    from .collectives import allreduce
+    y = allreduce(flat, axis_name, op)
+    nblk = y.size // p
+    i = lax.axis_index(axis_name)
+    return lax.dynamic_slice(y, (i * nblk,), (nblk,))
 
 
 def remote_sendrecv(x: jax.Array, axis_name: str, num_devices: int,
@@ -600,8 +733,25 @@ def _trace_entry(coll: str, tier: str, nbytes: int, op=None,
         pass
 
 
+def _mesh_mode(mesh_ctx, interpret) -> str:
+    """How a per-axis ring behaves inside a multi-axis mesh_ctx:
+    '1d' — no surrounding multi-axis mesh, classic dispatch; 'hw' —
+    multi-axis on hardware, clamp to the HBM streamer with mesh-aware
+    device ids (the VMEM/quant engines only know 1-D addressing);
+    'xla' — multi-axis under the interpreter, whose remote-DMA
+    discharge refuses more than one named axis: the stock lowering
+    carries the phase (the decomposition math above it is identical,
+    which is what the CPU sweep pins)."""
+    if not mesh_ctx or len(mesh_ctx) <= 1:
+        return "1d"
+    if interpret is None:
+        interpret = bool(get_config()["ICI_INTERPRET"])
+    return "xla" if interpret else "hw"
+
+
 def ici_all_reduce(x: jax.Array, axis_name: str, num_devices: int,
-                   op: str = "sum", interpret=None) -> jax.Array:
+                   op: str = "sum", interpret=None,
+                   mesh_ctx=None) -> jax.Array:
     """Tier-dispatched device allreduce: VMEM-resident flat ring below
     the VMEM boundary, HBM-streaming chunked ring above it, XLA past
     the measured crossover (or when the kernels cannot run). The
@@ -611,8 +761,14 @@ def ici_all_reduce(x: jax.Array, axis_name: str, num_devices: int,
     if p == 1:
         from .collectives import allreduce
         return allreduce(x, axis_name, op)
+    mode = _mesh_mode(mesh_ctx, interpret)
+    if mode == "xla":
+        from .collectives import allreduce
+        return allreduce(x, axis_name, op)
     tier, reason = planned_tier("allreduce", x.size * x.dtype.itemsize,
                                 x.dtype, op, interpret, num_devices=p)
+    if mode == "hw" and tier in ("vmem", "quant"):
+        tier = "hbm"
     _trace_entry("allreduce", tier, x.size * x.dtype.itemsize, op=op)
     if tier == "quant":
         from . import pallas_quant
@@ -631,7 +787,8 @@ def ici_all_reduce(x: jax.Array, axis_name: str, num_devices: int,
         tier = "hbm"
     if tier == "hbm":
         return hbm_ring_all_reduce(x, axis_name, p, op,
-                                   interpret=interpret)
+                                   interpret=interpret,
+                                   mesh_ctx=mesh_ctx)
     note_fallback("allreduce", reason or "size",
                   x.size * x.dtype.itemsize, x.dtype)
     from .collectives import allreduce
@@ -639,16 +796,21 @@ def ici_all_reduce(x: jax.Array, axis_name: str, num_devices: int,
 
 
 def ici_all_gather(x: jax.Array, axis_name: str, num_devices: int,
-                   interpret=None) -> jax.Array:
+                   interpret=None, mesh_ctx=None) -> jax.Array:
     """Tier-dispatched device all-gather (tiled). The gather output is
     p times the shard, so tier selection keys on the OUTPUT bytes —
     that is what must fit in VMEM."""
     p = num_devices
     if p == 1:
         return lax.all_gather(x, axis_name, tiled=True)
+    mode = _mesh_mode(mesh_ctx, interpret)
+    if mode == "xla":
+        return lax.all_gather(x, axis_name, tiled=True)
     out_nbytes = x.size * x.dtype.itemsize * p
     tier, reason = planned_tier("allgather", out_nbytes, x.dtype, None,
                                 interpret)
+    if mode == "hw" and tier in ("vmem", "quant"):
+        tier = "hbm"
     _trace_entry("allgather", tier, out_nbytes)
     if tier == "vmem":
         from . import pallas_ring
@@ -657,6 +819,161 @@ def ici_all_gather(x: jax.Array, axis_name: str, num_devices: int,
             else (interpret or False)
         return pallas_ring.ring_all_gather(x, axis_name, p, interpret=ip)
     if tier == "hbm":
-        return hbm_ring_all_gather(x, axis_name, p, interpret=interpret)
+        return hbm_ring_all_gather(x, axis_name, p, interpret=interpret,
+                                   mesh_ctx=mesh_ctx)
     note_fallback("allgather", reason or "size", out_nbytes, x.dtype)
     return lax.all_gather(x, axis_name, tiled=True)
+
+
+def ici_reduce_scatter(x: jax.Array, axis_name: str, num_devices: int,
+                       op: str = "sum", interpret=None,
+                       mesh_ctx=None) -> jax.Array:
+    """Tier-dispatched device reduce-scatter (tiled): this shard's
+    block of the axis-folded array, [ceil(n/p)]. The quant wire has no
+    RS-only form and the flat VMEM kernel has no RS entry, so every
+    non-XLA tier streams through the chunked HBM engine (which has no
+    size floor — it pads)."""
+    p = num_devices
+    if p == 1:
+        return x.reshape(-1)
+    nbytes = x.size * x.dtype.itemsize
+    mode = _mesh_mode(mesh_ctx, interpret)
+    if mode == "xla":
+        n = int(x.size)
+        flat = x.reshape(n)
+        nblk = -(-n // p)
+        if nblk * p > n:
+            flat = jnp.pad(flat, (0, nblk * p - n),
+                           constant_values=_pad_identity(x.dtype, op))
+        return _xla_reduce_scatter(flat, axis_name, p, op)
+    tier, reason = planned_tier("reduce_scatter", nbytes, x.dtype, op,
+                                interpret, num_devices=p)
+    if tier in ("vmem", "quant"):
+        tier = "hbm"
+    _trace_entry("reduce_scatter", tier, nbytes, op=op)
+    if tier == "hbm":
+        return hbm_ring_reduce_scatter(x, axis_name, p, op,
+                                       interpret=interpret,
+                                       mesh_ctx=mesh_ctx)
+    note_fallback("reduce_scatter", reason or "size", nbytes, x.dtype)
+    n = int(x.size)
+    flat = x.reshape(n)
+    nblk = -(-n // p)
+    if nblk * p > n:
+        flat = jnp.pad(flat, (0, nblk * p - n),
+                       constant_values=_pad_identity(x.dtype, op))
+    return _xla_reduce_scatter(flat, axis_name, p, op)
+
+
+# ---------------------------------------------------------------------------
+# multi-axis torus composition (the 2D/3D mesh decomposition)
+# ---------------------------------------------------------------------------
+
+def _mesh_axes_min() -> int:
+    """The dev_tier_axes_min edge (explicit cvar > measured profile >
+    default): shard bytes at or above it take the per-axis RS/AG phase
+    decomposition; below it each axis runs a full allreduce in
+    sequence. -1 = always decompose."""
+    from ..coll.tuning import _dev_tier_edge
+    return _dev_tier_edge("DEV_TIER_AXES_MIN", "dev_tier_axes_min")
+
+
+def _trace_axis(phase: str, axis: str, nbytes: int, op=None) -> None:
+    """Per-axis 'device'-lane instant of the multi-axis decomposition
+    (ici_axis_rs / ici_axis_ag / ici_axis_ar) — recorded at trace time
+    like _trace_entry, one instant per phase per compiled signature."""
+    try:
+        from ..runtime.universe import current_universe
+        u = current_universe()
+        rec = u.engine.tracer if u is not None else None
+        if rec is not None:
+            rec.record("device", f"ici_axis_{phase}", "i", axis=axis,
+                       bytes=int(nbytes), op=op)
+    except Exception:   # tracing must never kill a lowering
+        pass
+
+
+def ici_all_reduce_mesh(x: jax.Array, axes, op: str = "sum",
+                        interpret=None) -> jax.Array:
+    """Allreduce over a multi-axis torus mesh, decomposed as per-axis
+    ring phases: reduce-scatter down the axis list, all-gather back up
+    (RS-x, RS-y, AG-y, AG-x on a 2-D mesh), each phase the chunk-credit
+    slot schedule of the single-axis engine on a payload shrunk by the
+    axes already folded — every element crosses each axis' ICI links
+    once. ``axes``: ordered (axis_name, size) pairs covering the mesh.
+
+    Below the MV2T_DEV_TIER_AXES_MIN edge the decomposition is not
+    worth its phase count (4 kernel launches on 2-D vs 2): each axis
+    runs a full allreduce in sequence instead — the latency shape,
+    VMEM-tier eligible per axis. Unit axes are skipped; a single live
+    axis degenerates to the 1-D dispatch."""
+    allx = tuple((str(a), int(s)) for a, s in axes)
+    live = [(a, s) for a, s in allx if s > 1]
+    if not live:
+        return x
+    # ctx spans EVERY named axis (unit axes included): the interpret
+    # discharge counts axis names, not extents, and the hardware id
+    # line must fold in every coordinate
+    ctx = allx
+    if len(live) == 1:
+        return ici_all_reduce(x, live[0][0], live[0][1], op,
+                              interpret=interpret, mesh_ctx=ctx)
+    shape = x.shape
+    n = int(x.size)
+    nbytes = n * x.dtype.itemsize
+    amin = _mesh_axes_min()
+    if amin >= 0 and nbytes < amin:
+        y = x
+        for a, s in live:
+            _trace_axis("ar", a, nbytes, op=op)
+            y = ici_all_reduce(y, a, s, op, interpret=interpret,
+                               mesh_ctx=ctx)
+        return y
+    ptot = 1
+    for _, s in live:
+        ptot *= s
+    flat = x.reshape(n)
+    n_pad = -(-n // ptot) * ptot
+    if n_pad > n:
+        flat = jnp.pad(flat, (0, n_pad - n),
+                       constant_values=_pad_identity(x.dtype, op))
+    y = flat
+    for a, s in live:
+        _trace_axis("rs", a, y.size * y.dtype.itemsize, op=op)
+        y = ici_reduce_scatter(y, a, s, op, interpret=interpret,
+                               mesh_ctx=ctx)
+    for a, s in reversed(live):
+        _trace_axis("ag", a, y.size * y.dtype.itemsize * s, op=op)
+        y = ici_all_gather(y, a, s, interpret=interpret, mesh_ctx=ctx)
+    if n_pad > n:
+        y = y[:n]
+    return y.reshape(shape)
+
+
+def ici_all_gather_mesh(x: jax.Array, axes, interpret=None) -> jax.Array:
+    """All-gather over a multi-axis mesh (tiled): gather the innermost
+    axis first, then outward — with ranks laid out row-major over the
+    flattened device order, the blocks land in rank order."""
+    ctx = tuple((str(a), int(s)) for a, s in axes)
+    live = [(a, s) for a, s in ctx if s > 1]
+    y = x.reshape(-1)
+    for a, s in reversed(live):
+        _trace_axis("ag", a, y.size * y.dtype.itemsize * s)
+        y = ici_all_gather(y, a, s, interpret=interpret, mesh_ctx=ctx)
+    return y
+
+
+def ici_reduce_scatter_mesh(x: jax.Array, axes, op: str = "sum",
+                            interpret=None) -> jax.Array:
+    """Reduce-scatter over a multi-axis mesh (tiled): fold outermost
+    axis first, then inward — rank (i, j) of a row-major 2-D mesh ends
+    holding block i*py + j, i.e. block ``rank``. Input length must be a
+    multiple of the mesh extent for exact tiling (callers pad)."""
+    ctx = tuple((str(a), int(s)) for a, s in axes)
+    live = [(a, s) for a, s in ctx if s > 1]
+    y = x.reshape(-1)
+    for a, s in live:
+        _trace_axis("rs", a, y.size * y.dtype.itemsize, op=op)
+        y = ici_reduce_scatter(y, a, s, op, interpret=interpret,
+                               mesh_ctx=ctx)
+    return y

@@ -77,45 +77,105 @@ def make_mesh(shape: Optional[Sequence[int]] = None,
 
 
 class MeshComm:
-    """A communicator over one mesh axis (or all axes).
+    """A communicator over one mesh axis, several, or all axes.
 
     Inside a jitted/shard_mapped function, methods are the XLA collectives;
     outside, ``run`` wraps a function in shard_map over the mesh. The
     ``split``/``sub`` methods mirror MPI_Comm_split along orthogonal axes.
+
+    ``axis`` may be a single axis name (the 1-D ring dispatch every PR
+    before 20 had) or an ordered sequence of names — then the comm spans
+    the product extent with ranks row-major over the named axes, and
+    allreduce dispatches the multi-axis torus decomposition
+    (ops/pallas_ici.ici_all_reduce_mesh: per-axis RS/AG ring phases
+    above the dev_tier_axes_min edge). Movement collectives compose
+    per-axis phases in the rank-order-preserving direction (gather
+    innermost-first, scatter outermost-first, bcast from the root's
+    per-axis coordinates innermost-first).
     """
 
-    def __init__(self, mesh: Mesh, axis: Optional[str] = None):
+    def __init__(self, mesh: Mesh, axis=None):
         self.mesh = mesh
-        self.axis = axis if axis is not None else mesh.axis_names[0]
-        if self.axis not in mesh.axis_names:
-            raise ValueError(f"axis {self.axis!r} not in {mesh.axis_names}")
+        if axis is None:
+            axis = mesh.axis_names[0]
+        if isinstance(axis, (tuple, list)):
+            self.axes: Tuple[str, ...] = tuple(str(a) for a in axis)
+        else:
+            self.axes = (str(axis),)
+        for a in self.axes:
+            if a not in mesh.axis_names:
+                raise ValueError(f"axis {a!r} not in {mesh.axis_names}")
+        self.axis = self.axes[0]
 
     # -- introspection ---------------------------------------------------
     @property
+    def multi_axis(self) -> bool:
+        return len(self.axes) > 1
+
+    @property
     def size(self) -> int:
-        return self.mesh.shape[self.axis]
+        return math.prod(self.mesh.shape[a] for a in self.axes)
+
+    def axis_sizes(self) -> Tuple[Tuple[str, int], ...]:
+        """Ordered (axis, extent) pairs this comm spans — the ``axes``
+        argument of the ops-level multi-axis dispatchers."""
+        return tuple((a, self.mesh.shape[a]) for a in self.axes)
 
     def rank(self):
-        """Traced rank along the axis (call inside shard_map)."""
-        return ops.axis_rank(self.axis)
+        """Traced rank (call inside shard_map): the row-major flattened
+        index over this comm's axes."""
+        idx = ops.axis_rank(self.axes[0])
+        for a in self.axes[1:]:
+            idx = idx * self.mesh.shape[a] + ops.axis_rank(a)
+        return idx
 
-    def sub(self, axis: str) -> "MeshComm":
-        """Communicator over a different axis of the same mesh — the
+    def _coords(self, rank: int) -> Tuple[int, ...]:
+        """Static per-axis coordinates of a flattened rank (row-major)."""
+        out = []
+        for a in reversed(self.axes):
+            out.append(rank % self.mesh.shape[a])
+            rank //= self.mesh.shape[a]
+        return tuple(reversed(out))
+
+    def sub(self, axis) -> "MeshComm":
+        """Communicator over different axis/axes of the same mesh — the
         2-level split (e.g. 'host' × 'dcn' axes)."""
         return MeshComm(self.mesh, axis)
 
     # -- collectives (inside shard_map) ----------------------------------
     def allreduce(self, x, op: str = "sum"):
+        if self.multi_axis:
+            from ..ops import pallas_ici
+            return pallas_ici.ici_all_reduce_mesh(
+                x, self.axis_sizes(), op)
         return ops.allreduce(x, self.axis, op)
 
     def bcast(self, x, root: int = 0):
+        if self.multi_axis:
+            # innermost axis first: after bcasting axis k from the
+            # root's coordinate on k, the root's whole k-line carries
+            # the payload, so each outer phase fans a true copy
+            coords = self._coords(root)
+            for a, c in reversed(tuple(zip(self.axes, coords))):
+                x = ops.bcast(x, a, c)
+            return x
         return ops.bcast(x, self.axis, root)
 
     def all_gather(self, x, tiled: bool = False, gather_axis: int = 0):
+        if self.multi_axis:
+            for a in reversed(self.axes):   # innermost first: rank order
+                x = ops.all_gather(x, a, tiled=tiled,
+                                   gather_axis=gather_axis)
+            return x
         return ops.all_gather(x, self.axis, tiled=tiled,
                               gather_axis=gather_axis)
 
     def reduce_scatter(self, x, scatter_dimension: int = 0):
+        if self.multi_axis:
+            for a in self.axes:             # outermost first: rank order
+                x = ops.reduce_scatter(x, a,
+                                       scatter_dimension=scatter_dimension)
+            return x
         return ops.reduce_scatter(x, self.axis,
                                   scatter_dimension=scatter_dimension)
 

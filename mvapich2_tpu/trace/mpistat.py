@@ -441,6 +441,19 @@ def format_snapshot(snap: Dict[str, Any]) -> str:
             kv = "  ".join(f"{k}={v}"
                            for k, v in sorted(rk["values"].items()))
             lines.append(f"    totals: {kv}")
+        # three-level hierarchy view (coll/device.py LEVELS accounting
+        # + coll/netcoll.py): chip = leaders-per-chip HBM folds, ici =
+        # device mesh/ring programs (the sum of the per-tier dispatch
+        # slots), net = node-leader net2 waves
+        vals = rk.get("values") or {}
+        chip = vals.get("coll_level_chip", 0)
+        ici_lv = sum(vals.get(k, 0) for k in ("dev_coll_tier_vmem",
+                                              "dev_coll_tier_hbm",
+                                              "dev_coll_tier_quant"))
+        net = vals.get("coll_level_net", 0)
+        if chip or ici_lv or net:
+            lines.append(f"    hierarchy: chip={chip} ici={ici_lv} "
+                         f"net={net}")
         for nm, h in sorted((rk.get("hists") or {}).items()):
             lines.append(
                 f"    {nm}: n={int(h['count'])} "

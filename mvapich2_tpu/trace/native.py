@@ -92,6 +92,11 @@ _MET_PVARS = (
     "dev_coll_tier_vmem", "dev_coll_tier_hbm", "dev_coll_tier_quant",
     "dev_rma_tier_rdma", "dev_rma_tier_epoch", "dev_rma_wire_bytes",
     "dev_rma_flush", "rndv_pipeline_chunks",
+    # hierarchy levels (ISSUE 20) — chip and net fill the last two row
+    # slots; the ici level is already ring-visible as the sum of the
+    # dev_coll_tier_* slots above (mpistat's hierarchy section adds
+    # them up)
+    "coll_level_chip", "coll_level_net",
 )
 
 # Histogram block assignment: block h carries the latency-histogram
@@ -102,6 +107,7 @@ _MET_HISTS = (
     "lat_dev_vmem", "lat_dev_hbm", "lat_dev_quant", "lat_dev_xla",
     "lat_dev_slot", "lat_rndv_chunk", "lat_rma_flush",
     "lat_daemon_attach", "lat_daemon_queue", "lat_dev_nbc",
+    "lat_coll_net2",
 )
 
 # Event-id mirror of the NTE_* enum: index -> (name, protocol region).

@@ -1,0 +1,52 @@
+"""Standalone program: 4x4 device-mesh collective sweep on 16 fake
+host devices (ISSUE 20 multi-axis tier).
+
+The test-suite conftest pins XLA to 8 host devices, so the 4x4 grid
+cannot run in-process there; this program re-exports the platform
+flags BEFORE importing jax and drives run_ranks itself.
+
+Launched via: python tests/progs/hier_mesh16_prog.py
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+os.environ["MV2T_DEVICE_COLL_MIN_BYTES"] = "1"
+
+sys.path.insert(0, ".")
+
+import numpy as np                                  # noqa: E402
+import jax                                          # noqa: E402
+
+from mvapich2_tpu.runtime.universe import run_ranks  # noqa: E402
+from mvapich2_tpu.parallel.mesh import make_mesh     # noqa: E402
+
+N = 16
+COUNTS = (1024, 1025, 4096)
+
+
+def app(comm):
+    ch = comm.device_channel
+    assert ch.multi_axis and ch.axes == ("x", "y"), ch.axes
+    for cnt in COUNTS:
+        x = (np.arange(cnt) % 251 + comm.rank + 1).astype(np.float32)
+        out = np.asarray(comm.allreduce(x)).reshape(-1)
+        ref = sum((np.arange(cnt) % 251 + r + 1).astype(np.float32)
+                  for r in range(N))
+        np.testing.assert_array_equal(out, ref)
+    b = np.full(512, float(comm.rank), np.float32)
+    comm.bcast(b, root=5)
+    assert b[0] == 5.0 and b[-1] == 5.0
+    g = np.empty(N * 256, np.float32)
+    comm.allgather(np.full(256, float(comm.rank + 10), np.float32), g)
+    for r in range(N):
+        assert g[r * 256] == r + 10
+    return True
+
+
+mesh = make_mesh((4, 4), ("x", "y"), jax.devices()[:16])
+res = run_ranks(N, app, device_mesh=mesh, timeout=600)
+assert all(res)
+print("No Errors")

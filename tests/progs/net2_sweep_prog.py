@@ -1,0 +1,90 @@
+"""Rank program: python-API correctness sweep of the NET2 node-leader
+tier (coll/netcoll.py), the np > 64 sibling of flat2_sweep_prog.py.
+Run at np in {65..MV2T_NET2_MAX_RANKS}.
+
+Covers: allreduce across ops x dtypes x sizes straddling the 8 KiB
+net2 small-message edge (the leaders-of-k fold band vs the rsa sched
+fallback), bcast from rotating roots including non-leader ranks,
+barriers, a dup'd comm (the cached leader split must re-derive), and
+a tier-usage assertion (coll_level_net moved) so the sweep cannot
+silently pass on the generic sched rows.
+
+Launched via: python -m mvapich2_tpu.run -np N tests/progs/net2_sweep_prog.py
+"""
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from mvapich2_tpu import mpi                        # noqa: E402
+
+mpi.Init()
+comm = mpi.COMM_WORLD
+rank, size = comm.rank, comm.size
+errs = 0
+
+# int32 element counts straddling the 8 KiB net2 edge (2048 elements)
+COUNTS = (1, 64, 2047, 2048, 2049)
+OPS = ((mpi.SUM, "sum"), (mpi.MAX, "max"), (mpi.MIN, "min"))
+
+
+def sweep(c):
+    global errs
+    n, r_ = c.size, c.rank
+    for cnt in COUNTS:
+        s = (np.arange(cnt) % 97 + r_ + 1).astype(np.int32)
+        out = np.zeros(cnt, np.int32)
+        c.allreduce(s, out)
+        want = (np.arange(cnt) % 97 + 1).astype(np.int64) * n \
+            + n * (n - 1) // 2
+        if not np.array_equal(out.astype(np.int64), want):
+            errs += 1
+            print(f"rank {r_}: allreduce sum cnt={cnt} wrong")
+    for dt in (np.int32, np.float64):
+        for op, _name in OPS:
+            s = (np.arange(17) % 5 + r_ + 1).astype(dt)
+            out = np.zeros(17, dt)
+            c.allreduce(s, out, op)
+            ref = np.stack([(np.arange(17) % 5 + rr + 1).astype(dt)
+                            for rr in range(n)])
+            want = {mpi.SUM: ref.sum(0, dtype=dt),
+                    mpi.MAX: ref.max(0), mpi.MIN: ref.min(0)}[op]
+            if not np.array_equal(out, want):
+                errs += 1
+                print(f"rank {r_}: allreduce {_name} {dt.__name__} wrong")
+    # bcast from leader (0), last rank, and mid-group non-leader roots
+    for root in sorted({0, 1, n - 1, min(67, n - 1)}):
+        b = np.full(33, root + 7, np.int32) if r_ == root \
+            else np.zeros(33, np.int32)
+        c.bcast(b, root)
+        if not np.all(b == root + 7):
+            errs += 1
+            print(f"rank {r_}: bcast root={root} wrong")
+        c.barrier()
+
+
+sweep(comm)
+
+dup = comm.dup()
+sweep(dup)
+dup.free()
+
+# the net2 tier must actually have carried the small ops
+from mvapich2_tpu import mpit                       # noqa: E402
+from mvapich2_tpu.coll import netcoll               # noqa: E402
+from mvapich2_tpu.utils.config import get_config    # noqa: E402
+
+if get_config()["NET2"] and netcoll.net2_applicable(comm):
+    moved = mpit.pvar("coll_level_net").read()
+    if moved < 4:
+        errs += 1
+        print(f"rank {rank}: net2 tier not exercised "
+              f"(coll_level_net={moved})")
+
+total = np.zeros(1, np.int32)
+comm.allreduce(np.full(1, errs, np.int32), total)
+if rank == 0:
+    print("No Errors" if total[0] == 0 else f"{total[0]} errors")
+mpi.Finalize()
+sys.exit(1 if total[0] else 0)

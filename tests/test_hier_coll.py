@@ -1,0 +1,372 @@
+"""Three-level hierarchical collectives (ISSUE 20).
+
+Device level: multi-axis mesh RS/AG (ops/pallas_ici.py phase chains
+RS-x/RS-y/AG-y/AG-x) across square, rectangular and degenerate 1xN
+grids, and the leaders-per-chip HBM fold when ranks outnumber devices.
+Network level: the net2 node-leader tier (coll/netcoll.py) past the
+np=64 single-node ceiling, plus the comm-size class edges and the
+explicit sched-fallback rows in coll/tuning.py.
+
+Correctness bar: every multi-axis result must agree BITWISE with the
+single-axis ring on the same ranks and with a plain XLA reduction —
+inputs are small integers, so any summation order yields identical
+bits and a mismatch is a real data-movement bug, not float
+reassociation.
+
+np=96 net2 runs tier-1 in-process; np in {128, 256} and the C-ABI
+sweeps ride the slow lane, as does the 16-device 4x4 grid (the
+conftest pins 8 host devices).
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import types
+
+import numpy as np
+import pytest
+import jax
+
+from mvapich2_tpu.runtime.universe import run_ranks
+from mvapich2_tpu.parallel.mesh import make_mesh
+from mvapich2_tpu.utils.config import get_config
+from mvapich2_tpu.core.op import MAX
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MPICC = os.path.join(REPO, "bin", "mpicc")
+NET2_PROG = os.path.join(REPO, "tests", "progs", "net2_sweep_prog.py")
+MESH16_PROG = os.path.join(REPO, "tests", "progs", "hier_mesh16_prog.py")
+
+BIG = 16384
+
+
+def _reload(**env):
+    for k, v in env.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    get_config().reload()
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    yield
+    _reload(MV2T_DEVICE_COLL_MIN_BYTES=None, MV2T_NET2=None,
+            MV2T_NET2_MAX_RANKS=None)
+
+
+# -- device level: multi-axis sweep --------------------------------------
+#
+# Per-rank element counts straddle the per-device chunk edges (1024
+# divides every grid here; 1025 leaves a ragged tail chunk; 4096 spans
+# multiple blocks), x float32/int32.
+
+SWEEP_COUNTS = (1024, 1025, 4096)
+SWEEP_DTYPES = (np.float32, np.int32)
+
+
+def _allreduce_digest(comm):
+    """Run the allreduce sweep; verify vs the exact reference and
+    return the concatenated result bytes for cross-mesh comparison."""
+    nr = comm.size
+    blobs = []
+    for dt in SWEEP_DTYPES:
+        for cnt in SWEEP_COUNTS:
+            x = (np.arange(cnt) % 251 + comm.rank + 1).astype(dt)
+            out = np.asarray(comm.allreduce(x)).reshape(-1)
+            ref = sum((np.arange(cnt) % 251 + r + 1).astype(dt)
+                      for r in range(nr)).astype(dt)
+            np.testing.assert_array_equal(out, ref)
+            blobs.append(out.tobytes())
+    return b"".join(blobs)
+
+
+def _run_mesh_sweep(shape):
+    nr = int(np.prod(shape))
+    axes = ("x", "y")[:len(shape)]
+    mesh = make_mesh(shape, axes, jax.devices()[:nr])
+    res = run_ranks(nr, _allreduce_digest, device_mesh=mesh)
+    assert all(r == res[0] for r in res)
+    return res[0]
+
+
+@pytest.mark.parametrize("shape", [(2, 2), (2, 4), (4, 2), (1, 8)],
+                         ids=lambda s: "x".join(map(str, s)))
+def test_multi_axis_matches_single_axis_bitwise(shape):
+    """2-D mesh allreduce == 1-D ring on the same ranks, bit for bit,
+    across dtypes and chunk-boundary counts — including the degenerate
+    1xN grid, which must behave exactly like the plain ring."""
+    from mvapich2_tpu import mpit
+    _reload(MV2T_DEVICE_COLL_MIN_BYTES="1")
+    nr = int(np.prod(shape))
+    before = mpit.pvar("coll_level_ici").read()
+    got = _run_mesh_sweep(shape)
+    # the sweep must have ridden the ICI level, not a host fallback —
+    # a silent fallback would make the bitwise comparison vacuous
+    assert mpit.pvar("coll_level_ici").read() > before
+    want = _run_mesh_sweep((nr,))
+    assert got == want
+
+
+def test_multi_axis_matches_xla_bitwise():
+    """The 2x2 device allreduce agrees bitwise with a plain XLA
+    reduction over the stacked inputs (exact for small integers)."""
+    import jax.numpy as jnp
+    _reload(MV2T_DEVICE_COLL_MIN_BYTES="1")
+    got = _run_mesh_sweep((2, 2))
+    blobs = []
+    for dt in SWEEP_DTYPES:
+        for cnt in SWEEP_COUNTS:
+            stack = jnp.stack([(np.arange(cnt) % 251 + r + 1).astype(dt)
+                               for r in range(4)])
+            blobs.append(np.asarray(jnp.sum(stack, axis=0,
+                                            dtype=dt)).tobytes())
+    assert got == b"".join(blobs)
+
+
+def test_multi_axis_full_op_surface_2x2():
+    """Every supported collective on a 2x2 mesh: the four-phase chains
+    must preserve placement, not just reductions."""
+    mesh = make_mesh((2, 2), ("x", "y"), jax.devices()[:4])
+
+    def app(comm):
+        ch = comm.device_channel
+        assert ch.multi_axis and ch.axes == ("x", "y"), ch.axes
+        x = np.arange(BIG, dtype=np.float32) + comm.rank
+        out = comm.allreduce(x)
+        ref = sum(np.arange(BIG, dtype=np.float32) + r for r in range(4))
+        np.testing.assert_array_equal(np.asarray(out).reshape(-1), ref)
+        b = np.full(BIG, float(comm.rank), np.float32)
+        comm.bcast(b, root=2)
+        assert b[0] == 2.0 and b[-1] == 2.0
+        g = np.empty(4 * BIG, np.float32)
+        comm.allgather(np.full(BIG, float(comm.rank + 10), np.float32), g)
+        for r in range(4):
+            assert g[r * BIG] == r + 10, (r, g[r * BIG])
+        c = BIG // 4
+        sb = np.arange(BIG, dtype=np.float32) + 100 * comm.rank
+        rb = np.empty(BIG, np.float32)
+        comm.alltoall(sb, rb)
+        for src in range(4):
+            assert rb[src * c] == 100 * src + comm.rank * c
+        rsb = np.empty(c, np.float32)
+        comm.reduce_scatter_block(sb, rsb)
+        exp = sum(np.arange(BIG, dtype=np.float32)
+                  [comm.rank * c:(comm.rank + 1) * c] + 100 * r
+                  for r in range(4))
+        np.testing.assert_array_equal(rsb, exp)
+        return True
+
+    assert all(run_ranks(4, app, device_mesh=mesh))
+
+
+# -- device level: leaders-per-chip fold ---------------------------------
+
+def test_fold_channel_8_ranks_4_devices():
+    """8 ranks over a 4-device mesh: co-located pairs fold into the
+    chip leader over HBM slots before the ICI ring phases; results
+    must cover the full 8-rank contribution set for every op shape."""
+    from mvapich2_tpu.coll.device import DeviceFoldChannel
+    mesh = make_mesh((4,), ("x",), jax.devices()[:4])
+
+    def app(comm):
+        ch = comm.device_channel
+        assert isinstance(ch, DeviceFoldChannel), type(ch)
+        assert ch.k == 2 and ch.ndev == 4
+        x = np.arange(BIG, dtype=np.float32) + comm.rank
+        out = comm.allreduce(x)
+        ref = sum(np.arange(BIG, dtype=np.float32) + r for r in range(8))
+        np.testing.assert_array_equal(np.asarray(out).reshape(-1), ref)
+        om = comm.allreduce(x, op=MAX)
+        np.testing.assert_array_equal(np.asarray(om).reshape(-1),
+                                      np.arange(BIG, dtype=np.float32) + 7)
+        b = np.full(BIG, float(comm.rank), np.float32)
+        comm.bcast(b, root=5)
+        assert b[0] == 5.0, b[0]
+        rb = np.empty(BIG, np.float32)
+        comm.reduce(x, rb, root=3)
+        if comm.rank == 3:
+            np.testing.assert_array_equal(rb, ref)
+        g = np.empty(8 * BIG, np.float32)
+        comm.allgather(np.full(BIG, float(comm.rank + 10), np.float32), g)
+        for r in range(8):
+            assert g[r * BIG] == r + 10, (r, g[r * BIG])
+        c = BIG // 8
+        sb = np.arange(BIG, dtype=np.float32) + 100 * comm.rank
+        rsb = np.empty(c, np.float32)
+        comm.reduce_scatter_block(sb, rsb)
+        exp = sum(np.arange(BIG, dtype=np.float32)
+                  [comm.rank * c:(comm.rank + 1) * c] + 100 * r
+                  for r in range(8))
+        np.testing.assert_array_equal(rsb, exp)
+        return True
+
+    assert all(run_ranks(8, app, device_mesh=mesh))
+    from mvapich2_tpu import mpit
+    assert mpit.pvar("coll_level_chip").read() > 0
+
+
+# -- network level: net2 tier in-process ---------------------------------
+
+def test_net2_np96_in_process():
+    """np=96 world: past the single-node ceiling the node leaders
+    bridge the lanes; both the first (split-deriving) and second
+    (cached-split) calls must be exact, and a non-leader bcast root
+    must route through its leader."""
+    def app(comm):
+        from mvapich2_tpu.coll import netcoll
+        assert netcoll.net2_applicable(comm), (comm.size,)
+        x = np.full(64, float(comm.rank + 1), np.float32)
+        out = comm.allreduce(x)
+        expect = sum(range(1, 97))
+        assert np.asarray(out).reshape(-1)[0] == expect, out
+        b = np.full(64, float(comm.rank), np.float32)
+        comm.bcast(b, root=67)
+        assert b[0] == 67.0, b[0]
+        comm.barrier()
+        out2 = comm.allreduce(x)
+        assert np.asarray(out2).reshape(-1)[-1] == expect
+        st = getattr(comm, "_net2_state", None)
+        if comm.rank == 0:
+            assert st is not None and st.ngroups == 2, st
+        return True
+
+    assert all(run_ranks(96, app, timeout=300))
+    from mvapich2_tpu import mpit
+    assert mpit.pvar("coll_level_net").read() > 0
+
+
+# -- comm-size class edges + sched fallback rows (ISSUE 20 sat. 1) -------
+
+def _sized(n):
+    return types.SimpleNamespace(size=n)
+
+
+def test_size_class_boundaries():
+    """The np edges are load-bearing dispatch geometry: 8 (flat shm
+    window), 64 (flat2 window), net2_max_ranks (leader-bridge window).
+    A drifted edge silently reroutes every collective in the band."""
+    from mvapich2_tpu.coll import tuning
+    assert tuning._size_class(_sized(2)) == "small"
+    assert tuning._size_class(_sized(8)) == "small"
+    assert tuning._size_class(_sized(9)) == "flat2"
+    assert tuning._size_class(_sized(64)) == "flat2"
+    assert tuning._size_class(_sized(65)) == "net2"
+    assert tuning._size_class(_sized(96)) == "net2"
+    assert tuning._size_class(_sized(256)) == "net2"
+    assert tuning._size_class(_sized(257)) == "large"
+
+
+def test_net2_edge_is_profile_overridable():
+    """MV2T_NET2_MAX_RANKS moves the net2/large edge and is clamped to
+    [65, 4096] — the leader geometry cannot shrink below one group."""
+    from mvapich2_tpu.coll import tuning
+    _reload(MV2T_NET2_MAX_RANKS="128")
+    assert tuning.net2_max_ranks() == 128
+    assert tuning._size_class(_sized(128)) == "net2"
+    assert tuning._size_class(_sized(129)) == "large"
+    _reload(MV2T_NET2_MAX_RANKS="10")
+    assert tuning.net2_max_ranks() == 65
+    _reload(MV2T_NET2_MAX_RANKS="100000")
+    assert tuning.net2_max_ranks() == 4096
+
+
+def test_net2_tables_and_sched_fallback_rows():
+    """Every collective's table carries an explicit net2 class; the
+    carried collectives lead with the net2 algo in the small-message
+    band and fall back to the SAME sched shapes the flat2 band uses —
+    np>64 comms must never fall through to the generic large rows."""
+    from mvapich2_tpu.coll.tuning import DEFAULT_TABLES
+    for name, tables in DEFAULT_TABLES.items():
+        assert "net2" in tables, name
+    assert DEFAULT_TABLES["allreduce"]["net2"] == \
+        [(8 * 1024, "net2"), ("eager", "rsa"), (None, "rsa_arena")]
+    assert DEFAULT_TABLES["bcast"]["net2"] == \
+        [(16 * 1024, "net2"), (None, "arena")]
+    assert DEFAULT_TABLES["barrier"]["net2"] == [(None, "net2")]
+    # uncarried collectives: the net2 rows mirror the flat2 sched rows
+    for name in ("allgather", "alltoall", "reduce"):
+        assert DEFAULT_TABLES[name]["net2"] == \
+            DEFAULT_TABLES[name]["flat2"], name
+
+
+def test_net2_algos_registered():
+    from mvapich2_tpu.coll.tuning import ALGOS
+    for name in ("allreduce", "bcast", "barrier"):
+        assert "net2" in ALGOS[name], name
+
+
+# -- slow lane: wide net2 sweeps through both ABIs + the 4x4 grid --------
+
+pytestmark_cabi = pytest.mark.skipif(
+    shutil.which("gcc") is None or shutil.which("python3-config") is None,
+    reason="no C toolchain")
+
+
+def _mpirun(np_, *cmd, timeout=900, env_extra=None, ppn=32):
+    """Launch past the single-node ceiling: --fake-nodes spreads the
+    ranks over emulated nodes at ppn per node, so each shm plane wires
+    a flat2-window population and the net2 node leaders actually
+    bridge an inter-node boundary (128 co-located ranks would instead
+    storm one wire gate)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               # wide oversubscribed launch on a small host: ranks go
+               # compute-silent for minutes while peers hold the core,
+               # so the 10 s liveness lease false-positives — these are
+               # scale knobs, not correctness crutches
+               MV2T_PEER_TIMEOUT="300", MV2T_WIRE_TIMEOUT="600")
+    if env_extra:
+        env.update(env_extra)
+    nodes = ",".join(str(r // ppn) for r in range(np_))
+    r = subprocess.run([sys.executable, "-m", "mvapich2_tpu.run", "-np",
+                        str(np_), "--fake-nodes", nodes, *cmd], cwd=REPO,
+                       capture_output=True,
+                       text=True, timeout=timeout, env=env)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout, \
+        f"stdout={r.stdout}\nstderr={r.stderr}"
+    return r
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("np_", [128, 256])
+def test_net2_sweep_python_wide(np_):
+    # 1-core wall-time calibration: np=96 takes ~14 min end to end
+    # (process boot serializes); scale the ceiling with np
+    _mpirun(np_, sys.executable, NET2_PROG, timeout=np_ * 30)
+
+
+@pytest.fixture(scope="module")
+def flat_c_prog():
+    out = os.path.join(tempfile.mkdtemp(), "flatcoll_test")
+    src = os.path.join(REPO, "tests", "progs", "flatcoll_test.c")
+    r = subprocess.run([MPICC, src, "-o", out], capture_output=True,
+                       text=True, timeout=180)
+    assert r.returncode == 0, f"mpicc failed:\n{r.stdout}\n{r.stderr}"
+    return out
+
+
+@pytest.mark.slow
+@pytestmark_cabi
+@pytest.mark.parametrize("np_", [96, 128])
+def test_net2_sweep_cabi(flat_c_prog, np_):
+    """flatcoll_test.c is np-generic; past np=64 the world comm rides
+    the net2 class through the unmodified C ABI while its split halves
+    land back in the flat2 window."""
+    _mpirun(np_, flat_c_prog, timeout=np_ * 30)
+
+
+@pytest.mark.slow
+def test_mesh_4x4_sweep_subprocess():
+    """4x4 grid needs 16 host devices — the conftest pins 8, so this
+    rides a fresh interpreter that sets XLA_FLAGS before importing jax."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, MESH16_PROG], cwd=REPO,
+                       capture_output=True, text=True, timeout=900,
+                       env=env)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "No Errors" in r.stdout

@@ -87,6 +87,16 @@ CLEAN = [
         2, 2, [[0, 3], [1, 0]])),
     ("ici-a2av-n3-zero-row", lambda: ici.build_alltoallv(
         3, 2, [[0, 0, 0], [0, 0, 2], [2, 1, 0]])),
+    # ISSUE 20: three-level hierarchy — multi-axis mesh RS/AG phases
+    # (with the leaders-per-chip fold) and the net2 node-leader bridge
+    ("ici-mesh-2x2", lambda: ici.build_mesh(2, 2)),
+    ("ici-mesh-2x2-k2", lambda: ici.build_mesh(2, 2, k=2)),
+    ("ici-mesh-1x4", lambda: ici.build_mesh(1, 4)),
+    ("ici-mesh-4x1", lambda: ici.build_mesh(4, 1)),
+    ("flat2-net2-2x2", lambda: flat2.build_net2(2, 2)),
+    ("flat2-net2-2x2-crash", lambda: flat2.build_net2(2, 2,
+                                                      crash=True)),
+    ("flat2-net2-3x2", lambda: flat2.build_net2(3, 2)),
     # the NBC DAG engine (coll/nbc/engine.py, ISSUE 19 tentpole):
     # deposit/POLL/complete device schedules, net-shaped recv/send
     # dependency firing, persistent restart, cancel/error unwind
@@ -202,6 +212,14 @@ EXPECTED_INVARIANT = {
     # and the zero-count-entry credit hole, reintroduced as mutations
     "local_width_wire": {"deadlock"},
     "zero_count_entry_skip": {"deadlock"},
+    # three-level hierarchy (ISSUE 20): multi-axis mesh phase ordering
+    # and the net2 node-leader bridge
+    "ag_before_rs_crossaxis": {"axis-phase-order", "agreement"},
+    "leader_fold_skipped": {"agreement"},
+    "bridge_before_group_fold": {"agreement"},
+    "fanout_before_bridge": {"agreement"},
+    "leader_crash_no_poison": {"poison-sticky",
+                               "no-torn-read-delivered"},
     # NBC DAG engine (ISSUE 19 tentpole)
     "issue_ignores_deps": {"nbc-deps-before-issue",
                            "nbc-deposit-before-poll"},
@@ -307,6 +325,36 @@ def test_a2av_matrix_has_four_mutations():
     muts = {m[2] for m in M.mutation_matrix() if m[0] == "ici-a2av"}
     assert muts == {"skewed_count_slot", "zero_count_credit_leak",
                     "local_width_wire", "zero_count_entry_skip"}
+
+
+def test_mesh_and_net2_matrix_mutations():
+    """ISSUE 20 satellite: per-level model checkers — the multi-axis
+    mesh phase model and the net2 leader-bridge model each seed their
+    exact break set, every one caught by a named invariant via
+    test_mutation_caught over the matrix."""
+    mesh = {m[2] for m in M.mutation_matrix() if m[0] == "ici-mesh"}
+    assert mesh == {"ag_before_rs_crossaxis", "leader_fold_skipped"}
+    net2 = {m[2] for m in M.mutation_matrix() if m[0] == "flat2-net2"}
+    assert net2 == {"bridge_before_group_fold", "fanout_before_bridge",
+                    "leader_crash_no_poison"}
+
+
+def test_mesh_violation_trace_replays():
+    """An axis-phase-order trace replays from init to a violating
+    state — the counterexample is actionable, not just a boolean."""
+    m = ici.build_mesh(2, 2, mutation="ag_before_rs_crossaxis")
+    r = M.explore(m)
+    v = next(v for v in r.violations
+             if v.invariant == "axis-phase-order")
+    state = dict(m.init)
+    by_name = {t.name: t for t in m.transitions}
+    for step in v.trace:
+        t = by_name[step]
+        assert t.guard(state), f"trace step {step} not enabled on replay"
+        state = t.apply(state)
+    name, pred = next(i for i in m.invariants
+                      if i[0] == "axis-phase-order")
+    assert pred(state) is not None, "replayed state does not violate"
 
 
 def test_nbc_matrix_has_six_mutations():
@@ -566,6 +614,61 @@ def test_full_depth_a2av_mutations_np3():
             3, 3, [[0, 1, 2], [3, 0, 0], [1, 2, 0]], mutation=mut),
             max_states=2_000_000)
         assert not r.ok, mut
+
+
+# -- three-level hierarchy: full acceptance bounds (ISSUE 20) ------------
+
+@pytest.mark.modelcheck
+@pytest.mark.parametrize("px,py,k", [(2, 2, 1), (2, 2, 2), (1, 4, 1),
+                                     (4, 1, 1), (2, 3, 1), (3, 2, 1),
+                                     (2, 2, 3)])
+def test_full_depth_mesh_matrix(px, py, k):
+    """ISSUE 20 acceptance: the multi-axis mesh phase model is
+    exhaustively green (axis phase order, full sub-shard agreement, no
+    deadlock) across square, rectangular and degenerate 1xN grids,
+    with and without the leaders-per-chip fold."""
+    r = M.explore(ici.build_mesh(px, py, k=k), max_states=2_000_000)
+    assert r.complete, f"truncated at {r.states} states"
+    assert r.ok, [f"{v.invariant}: {v.message}" for v in r.violations]
+
+
+@pytest.mark.modelcheck
+def test_full_depth_mesh_mutations_wider():
+    """The mesh mutations still caught away from their minimal
+    configs (rectangular grid, deeper fold)."""
+    for kw, mut in ((dict(px=2, py=3), "ag_before_rs_crossaxis"),
+                    (dict(px=2, py=2, k=3), "leader_fold_skipped")):
+        r = M.explore(ici.build_mesh(mutation=mut, **kw),
+                      max_states=2_000_000)
+        assert not r.ok, (kw, mut)
+
+
+@pytest.mark.modelcheck
+@pytest.mark.parametrize("groups,k,crash", [(2, 2, False), (2, 2, True),
+                                            (3, 2, False), (3, 2, True),
+                                            (2, 3, False), (3, 3, True)])
+def test_full_depth_net2_matrix(groups, k, crash):
+    """ISSUE 20 acceptance: the net2 node-leader bridge is
+    exhaustively green (no torn lane fold, full-set agreement, sticky
+    poison + sched degrade after a mid-bridge leader death) across
+    group/member widths."""
+    r = M.explore(flat2.build_net2(groups, k, crash=crash),
+                  max_states=2_000_000)
+    assert r.complete, f"truncated at {r.states} states"
+    assert r.ok, [f"{v.invariant}: {v.message}" for v in r.violations]
+
+
+@pytest.mark.modelcheck
+def test_full_depth_net2_mutations_wider():
+    """The net2 mutations still caught away from their minimal
+    configs (three groups, wider fold)."""
+    for kw, mut in ((dict(groups=3, k=2), "bridge_before_group_fold"),
+                    (dict(groups=3, k=2), "fanout_before_bridge"),
+                    (dict(groups=3, k=2, crash=True),
+                     "leader_crash_no_poison")):
+        r = M.explore(flat2.build_net2(mutation=mut, **kw),
+                      max_states=2_000_000)
+        assert not r.ok, (kw, mut)
 
 
 # -- NBC DAG engine: full acceptance bounds (ISSUE 19) -------------------
